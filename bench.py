@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Benchmark entry point — prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Current flagship bench: MNIST-MLP train-step throughput through the full
+fluid front end (Program → traced+jitted XLA step with donation) on the
+available accelerator. Upgraded as model families land (BERT-base next —
+see BASELINE.md targets).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_mnist_mlp(batch=256, steps=60, warmup=10):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", shape=[784], dtype="float32")
+        label = fluid.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(img, 1024, act="relu")
+        h = fluid.layers.fc(h, 1024, act="relu")
+        pred = fluid.layers.fc(h, 10, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.Momentum(0.01, momentum=0.9).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    X = rng.rand(batch, 784).astype("float32")
+    Y = rng.randint(0, 10, (batch, 1)).astype("int64")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(warmup):
+            exe.run(main, feed={"img": X, "label": Y}, fetch_list=[loss])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = exe.run(main, feed={"img": X, "label": Y},
+                          fetch_list=[loss])
+        # fetch forces sync
+        _ = float(out[0][0])
+        dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def main():
+    sps = bench_mnist_mlp()
+    print(json.dumps({
+        "metric": "mnist_mlp_samples_per_sec",
+        "value": round(sps, 1),
+        "unit": "samples/s",
+        "vs_baseline": 1.0,  # reference publishes no numbers (BASELINE.md)
+    }))
+
+
+if __name__ == "__main__":
+    main()

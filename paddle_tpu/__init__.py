@@ -1,0 +1,21 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities
+of PaddlePaddle v1.7 "Fluid" (see SURVEY.md): Program/Block/Op/Var graph IR,
+fluid.layers API, Executor, append_backward autodiff, optimizers, DyGraph,
+Fleet distributed training — built on JAX/XLA/Pallas/pjit.
+
+Programs compile to single XLA computations per block; parallelism is
+sharding over a jax.sharding.Mesh (ICI collectives), not graph rewrites."""
+
+__version__ = "0.1.0"
+
+from . import ops          # registers the operator set
+from . import fluid        # the Fluid-compatible front end
+
+# 2.0-style convenience aliases (reference: python/paddle/__init__.py
+# re-exports under torch-like names)
+from .fluid import (Program, Executor, CPUPlace, TPUPlace, CUDAPlace,
+                    program_guard, default_main_program,
+                    default_startup_program, global_scope, scope_guard,
+                    ParamAttr)
+
+__all__ = ["fluid", "ops", "__version__"]

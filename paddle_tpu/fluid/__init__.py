@@ -1,0 +1,67 @@
+"""paddle_tpu.fluid — the Fluid-compatible front end, TPU-native underneath.
+
+API surface mirrors the reference python/paddle/fluid/__init__.py so user
+programs written against fluid run here; execution compiles whole programs
+to XLA instead of interpreting ops (see executor.py)."""
+from . import core
+from .core import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, LoDTensor,
+                   LoDTensorArray, Scope, is_compiled_with_cuda,
+                   is_compiled_with_tpu)
+from . import framework
+from .framework import (Program, Variable, program_guard,
+                        default_main_program, default_startup_program,
+                        name_scope, cpu_places, cuda_places, tpu_places,
+                        in_dygraph_mode, device_guard)
+from . import unique_name
+from . import initializer
+from . import regularizer
+from . import clip
+from .clip import GradientClipByGlobalNorm, GradientClipByNorm, \
+    GradientClipByValue
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import layers
+from .layers.io import data
+from . import backward
+from .backward import append_backward, gradients
+from . import optimizer
+from . import executor
+from .executor import Executor, global_scope, scope_guard
+from . import compiler
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from . import io
+from .io import (save_vars, save_params, save_persistables, load_vars,
+                 load_params, load_persistables, save_inference_model,
+                 load_inference_model, save, load)
+from . import dygraph
+from . import metrics
+from . import profiler
+from .data_feeder import DataFeeder
+from . import reader
+from .reader import DataLoader
+from . import contrib
+
+Tensor = LoDTensor
+
+
+def set_flags(d):
+    core.set_flags(d)
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    return {n: core.get_flag(n) for n in names}
+
+
+__all__ = [
+    "core", "framework", "layers", "optimizer", "backward", "initializer",
+    "regularizer", "clip", "io", "dygraph", "metrics", "profiler", "contrib",
+    "Program", "Variable", "Executor", "CompiledProgram", "BuildStrategy",
+    "ExecutionStrategy", "CPUPlace", "TPUPlace", "CUDAPlace",
+    "CUDAPinnedPlace", "LoDTensor", "LoDTensorArray", "Scope", "ParamAttr",
+    "WeightNormParamAttr", "DataFeeder", "DataLoader", "data",
+    "program_guard", "default_main_program", "default_startup_program",
+    "global_scope", "scope_guard", "append_backward", "gradients",
+    "save_inference_model", "load_inference_model", "save", "load",
+    "in_dygraph_mode", "cpu_places", "cuda_places", "tpu_places",
+]

@@ -1,0 +1,290 @@
+"""Autodiff: append_backward (reference: python/paddle/fluid/backward.py:1193).
+
+Walks forward ops in reverse emitting ``<op>_grad`` OpDescs with the
+reference slot convention (inputs = fwd inputs + fwd outputs + Out@GRAD
+slots; outputs = X@GRAD slots; empty slots use the @EMPTY@ sentinel), sums
+fan-in gradients (reference _addup_repetitive_outputs_), and prunes ops not
+on the loss→parameter path.
+
+Grad semantics come from each op's registered grad maker, or mechanically
+from the forward kernel via jax.vjp (ops/registry.py run_generic_grad) —
+the emitted grad op records its forward-input slot names in the ``_fwd_in``
+attr so the executor can reconstruct the vjp closure. Under jit, forward
+re-trace inside vjp is deduplicated by XLA CSE, so this costs nothing.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .framework import (Block, Operator, Parameter, Program, Variable,
+                        default_main_program, grad_var_name)
+from ..ops.registry import OPS
+
+__all__ = ["append_backward", "gradients", "calc_gradient"]
+
+EMPTY_VAR = "@EMPTY@"
+
+# op_role values (reference: framework/op_proto_maker.h OpRole)
+OP_ROLE_FORWARD = 0
+OP_ROLE_BACKWARD = 1
+OP_ROLE_OPTIMIZE = 2
+OP_ROLE_LOSS = 256
+
+
+def _op_no_grad(op_type: str) -> bool:
+    if OPS.has(op_type):
+        info = OPS.get(op_type)
+        return info.no_grad and info.grad_maker is None
+    return True
+
+
+def _find_loss_op(block: Block, loss: Variable) -> int:
+    for i in range(len(block.ops) - 1, -1, -1):
+        if loss.name in block.ops[i].output_arg_names:
+            return i
+    raise ValueError(f"loss var {loss.name} not produced in block")
+
+
+def _vars_requiring_grad(block: Block, ops: List[Operator],
+                         no_grad_set: Set[str]) -> Set[str]:
+    """Forward propagation of requires-grad from trainable params/inputs."""
+    req: Set[str] = set()
+    for v in block.vars.values():
+        if isinstance(v, Parameter) and v.trainable and v.name not in no_grad_set:
+            req.add(v.name)
+        elif not v.stop_gradient and v.name not in no_grad_set:
+            # any var with stop_gradient=False is a grad leaf/carrier
+            # (reference backward.py semantics)
+            req.add(v.name)
+    for op in ops:
+        if _op_no_grad(op.type):
+            continue
+        if any(n in req for n in op.input_arg_names):
+            for n in op.output_arg_names:
+                v = block.vars.get(n)
+                if v is None or not v.stop_gradient:
+                    if n not in no_grad_set:
+                        req.add(n)
+    return req
+
+
+def _ops_on_path(ops: List[Operator], loss_name: str,
+                 req: Set[str]) -> List[int]:
+    """Indices of ops contributing to loss AND touched by requires-grad."""
+    needed = {loss_name}
+    keep = []
+    for i in range(len(ops) - 1, -1, -1):
+        op = ops[i]
+        if any(n in needed for n in op.output_arg_names):
+            keep.append(i)
+            needed.update(op.input_arg_names)
+    return sorted(keep)
+
+
+def _default_grad_op_descs(op: Operator, grad_map: Dict[str, str],
+                           req: Set[str], no_grad_set: Set[str]):
+    """Build the generic ``<op>_grad`` desc for a forward op."""
+    info = OPS.get(op.type) if OPS.has(op.type) else None
+    inputs: Dict[str, List[str]] = {}
+    for slot, names in op.inputs.items():
+        inputs[slot] = list(names)
+    for slot, names in op.outputs.items():
+        if slot in inputs:
+            continue
+        inputs[slot] = list(names)
+    has_any_ograd = False
+    for slot, names in op.outputs.items():
+        gnames = []
+        for n in names:
+            g = grad_map.get(n)
+            gnames.append(g if g is not None else EMPTY_VAR)
+            if g is not None:
+                has_any_ograd = True
+        inputs[slot + "@GRAD"] = gnames
+    if not has_any_ograd:
+        return None
+
+    outputs: Dict[str, List[str]] = {}
+    allowed = set(info.diff_input_slots) if (info and info.diff_input_slots) \
+        else None
+    produced = []
+    for slot, names in op.inputs.items():
+        if allowed is not None and slot not in allowed:
+            continue
+        gnames = []
+        any_real = False
+        for n in names:
+            if n in req and n not in no_grad_set:
+                gnames.append(grad_var_name(n))
+                any_real = True
+                produced.append(n)
+            else:
+                gnames.append(EMPTY_VAR)
+        if any_real:
+            outputs[slot + "@GRAD"] = gnames
+    if not outputs:
+        return None
+    attrs = {k: v for k, v in op.attrs.items()}
+    attrs["_fwd_in"] = list(op.inputs.keys())
+    return [{"type": op.type + "_grad", "inputs": inputs,
+             "outputs": outputs, "attrs": attrs}], produced
+
+
+def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference backward.py:1193 — returns [(param, grad_var), ...]."""
+    program = loss.block.program
+    block = loss.block
+    no_grad = set()
+    if no_grad_set:
+        no_grad.update(v.name if isinstance(v, Variable) else v
+                       for v in no_grad_set)
+    for v in block.vars.values():
+        if v.stop_gradient and not isinstance(v, Parameter):
+            no_grad.add(v.name)
+
+    loss_idx = _find_loss_op(block, loss)
+    fwd_ops = block.ops[:loss_idx + 1]
+    req = _vars_requiring_grad(block, fwd_ops, no_grad)
+    req.add(loss.name)
+    path = set(_ops_on_path(fwd_ops, loss.name, req))
+
+    # mark the loss op
+    block.ops[loss_idx].attrs.setdefault("op_role", OP_ROLE_LOSS)
+
+    # seed: d loss / d loss = 1
+    grad_map: Dict[str, str] = {loss.name: grad_var_name(loss.name)}
+    block.append_op(
+        type="fill_constant", inputs={},
+        outputs={"Out": [grad_var_name(loss.name)]},
+        attrs={"shape": [1], "value": 1.0, "dtype": loss.dtype,
+               "op_role": OP_ROLE_BACKWARD})
+    gv = block.create_var(name=grad_var_name(loss.name), dtype=loss.dtype,
+                          shape=(1,), persistable=False)
+    gv.stop_gradient = False
+
+    # reverse sweep
+    pending_descs = []
+    grad_writers: Dict[str, int] = {}
+    for i in range(loss_idx, -1, -1):
+        if i not in path:
+            continue
+        op = fwd_ops[i]
+        if _op_no_grad(op.type):
+            continue
+        if not any(n in req and n not in no_grad for n in op.input_arg_names):
+            continue
+        info = OPS.get(op.type) if OPS.has(op.type) else None
+        if info is not None and info.grad_maker is not None:
+            descs = info.grad_maker(op, {**{n: grad_map.get(n, EMPTY_VAR)
+                                            for n in op.output_arg_names},
+                                         **{n: grad_var_name(n)
+                                            for n in op.input_arg_names
+                                            if n in req and n not in no_grad}})
+            if descs is None:
+                continue
+        else:
+            res = _default_grad_op_descs(op, grad_map, req, no_grad)
+            if res is None:
+                continue
+            descs, _produced = res
+        for d in descs:
+            pending_descs.append(d)
+            # record primal→grad mapping now: grad ops of earlier forward
+            # ops (emitted later in this sweep) consume these names
+            for slot, names in d["outputs"].items():
+                if not slot.endswith("@GRAD"):
+                    continue
+                primal_slot = slot[:-5]
+                fwd_names = d["inputs"].get(primal_slot, [])
+                for pn, gn in zip(fwd_names, names):
+                    if gn != EMPTY_VAR:
+                        grad_map.setdefault(pn, gn)
+
+    # gradient fan-in: rename duplicate writes, insert sum ops
+    write_counts: Dict[str, int] = {}
+    for d in pending_descs:
+        for slot, names in d["outputs"].items():
+            for n in names:
+                if n != EMPTY_VAR:
+                    write_counts[n] = write_counts.get(n, 0) + 1
+    renamed: Dict[str, List[str]] = {}
+    for d in pending_descs:
+        for slot, names in d["outputs"].items():
+            for k, n in enumerate(names):
+                if n == EMPTY_VAR or write_counts.get(n, 0) <= 1:
+                    continue
+                parts = renamed.setdefault(n, [])
+                new_name = f"{n}@RENAME@{len(parts)}"
+                parts.append(new_name)
+                names[k] = new_name
+
+    final_ops: List[dict] = []
+    summed: Set[str] = set()
+    for d in pending_descs:
+        final_ops.append(d)
+        # after the op that writes the last part, insert the sum
+        for name, parts in renamed.items():
+            if name in summed:
+                continue
+            if parts and parts[-1] in [n for ns in d["outputs"].values()
+                                       for n in ns]:
+                final_ops.append({"type": "sum", "inputs": {"X": list(parts)},
+                                  "outputs": {"Out": [name]}, "attrs": {}})
+                summed.add(name)
+
+    # materialize ops + grad vars
+    for d in final_ops:
+        attrs = dict(d.get("attrs") or {})
+        attrs.setdefault("op_role", OP_ROLE_BACKWARD)
+        block.append_op(type=d["type"], inputs=d["inputs"],
+                        outputs=d["outputs"], attrs=attrs)
+        for slot, names in d["outputs"].items():
+            for n in names:
+                if n == EMPTY_VAR or n in block.vars:
+                    continue
+                primal = n.split("@GRAD")[0]
+                pv = block.vars.get(primal)
+                block.create_var(
+                    name=n, dtype=pv.dtype if pv else loss.dtype,
+                    shape=pv.shape if pv else (), persistable=False)
+
+    # collect params & grads
+    if parameter_list is not None:
+        params = [block.program.global_block().var(p)
+                  if isinstance(p, str) else p for p in parameter_list]
+    else:
+        params = [v for v in block.program.global_block().all_parameters()
+                  if v.trainable]
+    result = []
+    for p in params:
+        gname = grad_var_name(p.name)
+        if gname in block.vars:
+            result.append((p, block.vars[gname]))
+    program._appending_grad_times += 1
+    return result
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference backward.py:1599 — grads of targets w.r.t. inputs."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    assert target_gradients is None, "target_gradients: pending"
+    # scalar proxy with ones cotangent: sum of reduce_sum(target) gives
+    # d(proxy)/d(target) == 1 everywhere (the fluid.gradients contract)
+    from .layers import nn as _nn
+    loss = None
+    for t in targets:
+        m = _nn.reduce_sum(t)
+        loss = m if loss is None else _nn.elementwise_add(loss, m)
+    append_backward(loss, no_grad_set=no_grad_set)
+    block = targets[0].block
+    outs = []
+    for iv in inputs:
+        g = grad_var_name(iv.name)
+        outs.append(block.vars.get(g))
+    return outs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    return calc_gradient(targets, inputs, target_gradients, no_grad_set)

@@ -1,0 +1,98 @@
+"""CompiledProgram / BuildStrategy / ExecutionStrategy (reference:
+python/paddle/fluid/compiler.py:87, pybind BuildStrategy pybind.cc:1946).
+
+Inversion: the reference's ``with_data_parallel`` builds a multi-device SSA
+graph with allreduce op-handles (ParallelExecutor). Here data parallelism is
+sharding metadata: the executor jits the step under a ``jax.sharding.Mesh``
+with the batch sharded over the data axis — XLA inserts the grad all-reduces
+over ICI. BuildStrategy knobs that tune NCCL/fusion behaviour are accepted
+and recorded (XLA already fuses; hierarchical allreduce is automatic)."""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
+
+
+class _ReduceStrategy:
+    AllReduce = 0
+    Reduce = 1
+
+
+class _GradientScaleStrategy:
+    CoeffNumDevice = 0
+    One = 1
+    Customized = 2
+
+
+class BuildStrategy:
+    ReduceStrategy = _ReduceStrategy
+    GradientScaleStrategy = _GradientScaleStrategy
+
+    def __init__(self):
+        self.reduce_strategy = _ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = _GradientScaleStrategy.CoeffNumDevice
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_optimizer_ops = False
+        self.fuse_all_reduce_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_relu_depthwise_conv = False
+        self.fuse_broadcast_ops = False
+        self.sync_batch_norm = False
+        self.enable_sequential_execution = False
+        self.remove_unnecessary_lock = True
+        self.num_trainers = 1
+        self.trainer_id = 0
+        self.trainers_endpoints = []
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 0
+        self.nccl_comm_num = 1
+        self.cache_runtime_context = False
+        self.debug_graphviz_path = ""
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.num_iteration_per_run = 1
+        self.allow_op_delay = False
+        self.use_thread_barrier = True
+
+
+class CompiledProgram:
+    """reference compiler.py:87."""
+
+    def __init__(self, program_or_graph, build_strategy: Optional[BuildStrategy] = None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = None
+        self._is_data_parallel = False
+        self._loss_name = None
+        self._share_vars_from = None
+        self._places = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._share_vars_from = share_vars_from
+        self._places = places
+        return self
+
+    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        """Delegate to the executor. Data-parallel execution shards the feed
+        batch over the device mesh (see parallel/data_parallel.py); on a
+        single chip this is a plain jitted run."""
+        if self._is_data_parallel:
+            from ..parallel.data_parallel import run_data_parallel
+            return run_data_parallel(executor, self, feed, fetch_list, scope,
+                                     return_numpy)
+        return executor.run(self._program, feed=feed, fetch_list=fetch_list,
+                            scope=scope, return_numpy=return_numpy)

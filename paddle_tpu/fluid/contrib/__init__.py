@@ -1,0 +1,3 @@
+"""fluid.contrib (reference: python/paddle/fluid/contrib/) — mixed precision
+lands here; slim/quant in a later round."""
+from . import mixed_precision  # noqa: F401

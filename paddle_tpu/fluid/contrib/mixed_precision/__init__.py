@@ -1,0 +1,10 @@
+"""AMP (reference: python/paddle/fluid/contrib/mixed_precision/ —
+decorator.py:218 decorate → OptimizerWithMixedPrecision:27, white/black op
+lists fp16_lists.py, cast insertion fp16_utils.py, dynamic loss scaling).
+
+TPU inversion: the numerically-safe reduced precision is bfloat16, which
+needs NO loss scaling (same exponent range as fp32). ``decorate`` keeps the
+reference API: it rewrites matmul/conv inputs to bf16 (white list) while
+keeping softmax/norm accumulation fp32 (black list), and exposes the loss
+scaling knobs as inert attributes for script parity."""
+from .decorator import decorate, AutoMixedPrecisionLists  # noqa: F401

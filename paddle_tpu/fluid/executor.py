@@ -1,0 +1,350 @@
+"""Executor — runs Programs on TPU.
+
+The reference Executor (reference: paddle/fluid/framework/executor.cc:184,
+python/paddle/fluid/executor.py:457) interprets a block op-by-op per step,
+doing per-op kernel choice, data transform, InferShape and GC. That design
+is inverted here for TPU: ``Executor.run`` traces the whole block ONCE into
+a pure function ``(state, feeds, rng) -> (fetches, new_state)`` and compiles
+it with ``jax.jit`` — op fusion, layout, memory planning and GC all become
+XLA's job, and parameter updates alias in-place via buffer donation.
+
+Two paths:
+  * compiled (default): pure-traceable blocks. Program cache keyed like the
+    reference's (executor.py:1171 cache) by (program id, version, feeds,
+    fetches, scope).
+  * interpreted: the correctness oracle, also used for startup programs and
+    blocks containing stateful/host ops (control flow over scopes, save/load,
+    py_func, readers). Still executes on device, just eagerly.
+
+Feed/fetch: direct dict-in/list-out like the reference API; programs that
+already contain feed/fetch ops (e.g. deserialized reference models) work
+too — their feed/fetch ops read/write the same feed/fetch list variables
+(reference: executor.cc:195-306).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import core
+from .core import LoDTensor, Scope, global_scope
+from .framework import Program, Variable, default_main_program
+from ..ops.registry import OPS, run_generic_grad, GRAD_SUFFIX
+
+__all__ = ["Executor", "global_scope", "scope_guard"]
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    old = core._switch_scope(scope)
+    try:
+        yield
+    finally:
+        core._switch_scope(old)
+
+
+class ExecContext:
+    """Handed to stateful kernels via attrs['_ctx']."""
+    __slots__ = ("scope", "executor", "op", "place", "rng_base")
+
+    def __init__(self, scope, executor, op, place, rng_base):
+        self.scope = scope
+        self.executor = executor
+        self.op = op
+        self.place = place
+        self.rng_base = rng_base
+
+
+def _as_lodtensor(data, place) -> LoDTensor:
+    if isinstance(data, LoDTensor):
+        if not isinstance(data.array, jax.Array):
+            data.set(np.asarray(data.array), place)
+        return data
+    t = LoDTensor()
+    t.set(np.asarray(data), place)
+    return t
+
+
+def _op_is_stateful(op) -> bool:
+    if OPS.has(op.type):
+        return OPS.get(op.type).stateful
+    if op.type.endswith("_grad") and OPS.has(op.type[:-5]):
+        return OPS.get(op.type[:-5]).stateful
+    return True  # unknown op: be safe, run eagerly (will raise with context)
+
+
+class _CompiledBlock:
+    """One traced+jitted step function for (program, feeds, fetches)."""
+
+    def __init__(self, program: Program, feed_names: Tuple[str, ...],
+                 fetch_names: Tuple[str, ...], scope: Scope, seed: int):
+        import weakref
+        self._scope_ref = weakref.ref(scope)
+        self.program = program
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+        block = program.global_block()
+        ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+        self.ops = ops
+
+        # classify variables: read-before-write & initialized in scope -> state
+        written: set = set()
+        state_names: List[str] = []
+        block_vars = block.vars
+        for op in ops:
+            for name in op.input_arg_names:
+                if name in written or name in feed_names or name in state_names:
+                    continue
+                bv = block_vars.get(name)
+                if bv is not None and (bv.is_data or bv.need_check_feed):
+                    # a data var must come from the feed dict — pulling a
+                    # stale value from scope would silently compute on the
+                    # previous batch (reference: executor feed checks)
+                    raise KeyError(
+                        f"feed variable '{name}' is required by the program "
+                        f"but was not provided in feed=")
+                v = scope.find_var(name)
+                if v is not None and v.is_initialized() and isinstance(
+                        v.value(), LoDTensor):
+                    state_names.append(name)
+                elif bv is not None and bv.persistable:
+                    raise RuntimeError(
+                        f"persistable variable '{name}' (read by op "
+                        f"'{op.type}') is not initialized in the scope — "
+                        f"run the startup program first")
+            written.update(op.output_arg_names)
+        self.written = written
+        # state vars that get overwritten -> donated & written back
+        self.mut_state = tuple(n for n in state_names if n in written)
+        self.ro_state = tuple(n for n in state_names if n not in written)
+        # persistable outputs not in state (e.g. newly created opt moments
+        # already initialized by startup → they are in state; anything else
+        # persistable written gets written back too)
+        persistable = {v.name for v in block.vars.values() if v.persistable}
+        self.extra_writeback = tuple(
+            n for n in written
+            if n in persistable and n not in self.mut_state
+            and n not in feed_names)
+        self.seed = seed
+        self._jitted = jax.jit(self._step, donate_argnums=(0,))
+
+    def _step(self, mut_state: Dict[str, Any], ro_state: Dict[str, Any],
+              feeds: Dict[str, Any], rng):
+        env: Dict[str, Any] = {}
+        env.update(ro_state)
+        env.update(mut_state)
+        env.update(feeds)
+        for idx, op in enumerate(self.ops):
+            ins = {}
+            for slot, names in op.inputs.items():
+                ins[slot] = [env.get(n) for n in names]
+            attrs = op.attrs
+            otype = op.type
+            if OPS.has(otype):
+                info = OPS.get(otype)
+                if info.needs_rng:
+                    attrs = dict(attrs)
+                    if attrs.get("fix_seed", False) or attrs.get("seed", 0):
+                        attrs["_rng"] = jax.random.key(int(attrs.get("seed", 0)))
+                    else:
+                        attrs["_rng"] = jax.random.fold_in(rng, idx)
+                outs = info.kernel(ins, attrs)
+            elif otype.endswith("_grad") and OPS.has(otype[:-5]):
+                outs = run_generic_grad(
+                    otype[:-5], ins, attrs,
+                    wanted_grad_slots=list(op.outputs.keys()),
+                    fwd_input_slots=attrs.get("_fwd_in", list(op.inputs.keys())))
+            else:
+                raise NotImplementedError(f"op {otype} not registered")
+            for slot, names in op.outputs.items():
+                vals = outs.get(slot)
+                if vals is None:
+                    continue
+                for n, v in zip(names, vals):
+                    if v is not None and n != "@EMPTY@":
+                        env[n] = v
+        fetches = []
+        for n in self.fetch_names:
+            if n not in env:
+                raise KeyError(f"fetch var '{n}' not produced by program")
+            fetches.append(env[n])
+        new_mut = {n: env[n] for n in self.mut_state}
+        extra = {n: env[n] for n in self.extra_writeback if n in env}
+        return fetches, new_mut, extra
+
+    def run(self, scope: Scope, feeds: Dict[str, Any], rng):
+        mut = {n: scope.find_var(n).get_tensor().array for n in self.mut_state}
+        ro = {n: scope.find_var(n).get_tensor().array for n in self.ro_state}
+        fetches, new_mut, extra = self._jitted(mut, ro, feeds, rng)
+        for n, v in {**new_mut, **extra}.items():
+            scope.var(n).set_value(LoDTensor(v))
+        return fetches
+
+
+class Executor:
+    """Drop-in equivalent of fluid.Executor (reference executor.py:457)."""
+
+    def __init__(self, place=None):
+        self.place = place if place is not None else (
+            core.TPUPlace(0) if core.is_compiled_with_tpu() else core.CPUPlace())
+        self._compiled_cache: Dict[Tuple, _CompiledBlock] = {}
+        self._closed = False
+
+    # ------------------------------------------------------------------ API
+    def close(self):
+        self._closed = True
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, feed_var_name="feed", fetch_var_name="fetch",
+            scope: Optional[Scope] = None, return_numpy: bool = True,
+            use_program_cache: bool = False, use_prune: bool = False):
+        from .compiler import CompiledProgram
+        if program is None:
+            program = default_main_program()
+        if isinstance(program, CompiledProgram):
+            return program._run(self, feed, fetch_list, scope, return_numpy)
+        if scope is None:
+            scope = global_scope()
+        feed = feed or {}
+        fetch_names = _to_fetch_names(fetch_list)
+
+        # materialize program vars' metadata for persistables (create slots)
+        # feeds → device
+        feed_arrays = {}
+        for name, data in feed.items():
+            t = _as_lodtensor(data, self.place)
+            scope.var(name).set_value(t)
+            feed_arrays[name] = t.array
+
+        mode = core.globals_["FLAGS_executor_mode"]
+        has_stateful = any(_op_is_stateful(op) for op in
+                           program.global_block().ops
+                           if op.type not in ("feed", "fetch"))
+        compiled_ok = (mode == "compiled" and not has_stateful
+                       and program.num_blocks == 1)
+
+        if compiled_ok:
+            key = (id(program), program._version, tuple(sorted(feed)),
+                   tuple(fetch_names), id(scope))
+            cb = self._compiled_cache.get(key)
+            # guard id() reuse: a dead scope's id can be recycled by a new
+            # scope with different state — validate the weakref identity
+            if cb is not None and (cb._scope_ref() is not scope):
+                cb = None
+            if cb is None:
+                cb = _CompiledBlock(program, tuple(sorted(feed)),
+                                    tuple(fetch_names), scope,
+                                    program.random_seed
+                                    or core.globals_["FLAGS_seed"])
+                self._compiled_cache[key] = cb
+            rng = self._next_rng(scope, program)
+            fetched = cb.run(scope, feed_arrays, rng)
+        else:
+            rng = self._next_rng(scope, program)
+            self._run_block_eager(program.global_block(), scope, rng)
+            fetched = []
+            for n in fetch_names:
+                v = scope.find_var(n)
+                if v is None:
+                    raise KeyError(f"fetch var '{n}' not found in scope")
+                val = v.value()
+                fetched.append(val.array if isinstance(val, LoDTensor) else val)
+
+        if fetch_names and return_numpy:
+            return [np.asarray(f) for f in fetched]
+        if fetch_names:
+            return [LoDTensor(f) for f in fetched]
+        return []
+
+    # --------------------------------------------------------------- eager
+    def _next_rng(self, scope: Scope, program: Program):
+        v = scope.var("@RNG_COUNTER@")
+        cnt = 0
+        if v.is_initialized():
+            cnt = int(np.asarray(v.get_tensor().array).reshape(-1)[0])
+        v.set_value(LoDTensor(jnp.asarray([cnt + 1], jnp.int32)))
+        seed = program.random_seed or core.globals_["FLAGS_seed"]
+        return jax.random.fold_in(jax.random.key(int(seed)), cnt)
+
+    def _run_block_eager(self, block, scope: Scope, rng_base):
+        for idx, op in enumerate(block.ops):
+            self._run_op_eager(op, scope, rng_base, idx)
+
+    def _run_op_eager(self, op, scope: Scope, rng_base, idx: int = 0):
+        otype = op.type
+        stateful = _op_is_stateful(op)
+        attrs = op.attrs
+        if stateful:
+            if not OPS.has(otype):
+                raise NotImplementedError(f"op '{otype}' is not implemented")
+            info = OPS.get(otype)
+            attrs = dict(attrs)
+            attrs["_ctx"] = ExecContext(scope, self, op, self.place, rng_base)
+            if info.needs_rng:
+                attrs["_rng"] = jax.random.fold_in(rng_base, idx)
+        ins = {}
+        for slot, names in op.inputs.items():
+            vals = []
+            for n in names:
+                v = scope.find_var(n)
+                if v is None or not v.is_initialized():
+                    vals.append(None)
+                elif isinstance(v.value(), LoDTensor):
+                    vals.append(v.value().array)
+                else:
+                    vals.append(None)  # stateful kernels read scope directly
+            ins[slot] = vals
+        if OPS.has(otype):
+            info = OPS.get(otype)
+            if info.needs_rng and "_rng" not in attrs:
+                attrs = dict(attrs)
+                if attrs.get("fix_seed", False) or attrs.get("seed", 0):
+                    attrs["_rng"] = jax.random.key(int(attrs.get("seed", 0)))
+                else:
+                    attrs["_rng"] = jax.random.fold_in(rng_base, idx)
+            outs = info.kernel(ins, attrs)
+        elif otype.endswith("_grad") and OPS.has(otype[:-5]):
+            outs = run_generic_grad(
+                otype[:-5], ins, attrs,
+                wanted_grad_slots=list(op.outputs.keys()),
+                fwd_input_slots=op.attrs.get("_fwd_in", list(op.inputs.keys())))
+        else:
+            raise NotImplementedError(f"op '{otype}' is not implemented")
+        if core.globals_["FLAGS_check_nan_inf"]:
+            for slot, vals in (outs or {}).items():
+                for v in vals or []:
+                    if v is not None and jnp.issubdtype(v.dtype, jnp.inexact):
+                        if not bool(jnp.all(jnp.isfinite(v))):
+                            raise FloatingPointError(
+                                f"NaN/Inf in output {slot} of op {otype}")
+        for slot, names in op.outputs.items():
+            vals = (outs or {}).get(slot)
+            if vals is None:
+                continue
+            for n, v in zip(names, vals):
+                if v is not None and n != "@EMPTY@":
+                    scope.var(n).set_value(LoDTensor(v))
+
+
+def _to_fetch_names(fetch_list) -> List[str]:
+    names = []
+    if fetch_list is None:
+        return names
+    if not isinstance(fetch_list, (list, tuple)):
+        fetch_list = [fetch_list]
+    for f in fetch_list:
+        if isinstance(f, Variable):
+            names.append(f.name)
+        elif isinstance(f, str):
+            names.append(f)
+        elif isinstance(f, (list, tuple)):
+            names.extend(_to_fetch_names(f))
+        else:
+            raise TypeError(f"bad fetch entry {f!r}")
+    return names

@@ -1,0 +1,180 @@
+"""Parameter initializers (reference: python/paddle/fluid/initializer.py —
+ConstantInitializer, UniformInitializer, NormalInitializer,
+TruncatedNormalInitializer, XavierInitializer, MSRAInitializer,
+BilinearInitializer, NumpyArrayInitializer).
+
+Each initializer appends an init op to the startup program; the startup run
+executes them once on device (same contract as the reference, where startup
+ops fill parameter memory)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import framework, core
+from .core import VarDesc
+
+__all__ = [
+    "Constant", "Uniform", "Normal", "TruncatedNormal", "Xavier", "MSRA",
+    "Bilinear", "NumpyArrayInitializer", "ConstantInitializer",
+    "UniformInitializer", "NormalInitializer", "TruncatedNormalInitializer",
+    "XavierInitializer", "MSRAInitializer", "BilinearInitializer",
+]
+
+
+class Initializer:
+    def __call__(self, var, block):
+        raise NotImplementedError
+
+    def _compute_fans(self, var):
+        shape = var.shape
+        if not shape:
+            return 1, 1
+        if len(shape) == 1:
+            return shape[0], shape[0]
+        if len(shape) == 2:
+            return shape[0], shape[1]
+        receptive = int(np.prod(shape[2:]))
+        return shape[1] * receptive, shape[0] * receptive
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0, force_cpu=False):
+        self._value = float(value)
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="fill_constant", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "value": self._value})
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self._low, self._high, self._seed = low, high, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="uniform_random", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "min": self._low, "max": self._high, "seed": self._seed})
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self._mean, self._std, self._seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="gaussian_random", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "mean": self._mean, "std": self._std, "seed": self._seed})
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self._mean, self._std, self._seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="truncated_gaussian_random", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "mean": self._mean, "std": self._std, "seed": self._seed})
+
+
+class XavierInitializer(Initializer):
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self._uniform, self._seed = uniform, seed
+        self._fan_in, self._fan_out = fan_in, fan_out
+
+    def __call__(self, var, block):
+        fin, fout = self._compute_fans(var)
+        fin = self._fan_in if self._fan_in is not None else fin
+        fout = self._fan_out if self._fan_out is not None else fout
+        if self._uniform:
+            limit = math.sqrt(6.0 / (fin + fout))
+            return block.append_op(
+                type="uniform_random", outputs={"Out": var},
+                attrs={"shape": list(var.shape), "dtype": var.dtype,
+                       "min": -limit, "max": limit, "seed": self._seed})
+        std = math.sqrt(2.0 / (fin + fout))
+        return block.append_op(
+            type="gaussian_random", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "mean": 0.0, "std": std, "seed": self._seed})
+
+
+class MSRAInitializer(Initializer):
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self._uniform, self._seed, self._fan_in = uniform, seed, fan_in
+
+    def __call__(self, var, block):
+        fin, _ = self._compute_fans(var)
+        fin = self._fan_in if self._fan_in is not None else fin
+        if self._uniform:
+            limit = math.sqrt(6.0 / fin)
+            return block.append_op(
+                type="uniform_random", outputs={"Out": var},
+                attrs={"shape": list(var.shape), "dtype": var.dtype,
+                       "min": -limit, "max": limit, "seed": self._seed})
+        std = math.sqrt(2.0 / fin)
+        return block.append_op(
+            type="gaussian_random", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "mean": 0.0, "std": std, "seed": self._seed})
+
+
+class BilinearInitializer(Initializer):
+    """For conv-transpose upsampling kernels."""
+
+    def __call__(self, var, block):
+        shape = var.shape
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        weight = np.zeros(shape, dtype=np.float32)
+        size = int(np.prod(shape))
+        idx = np.arange(size)
+        x = (idx % shape[3]).astype(np.float64)
+        y = ((idx // shape[3]) % shape[2]).astype(np.float64)
+        vals = (1 - np.abs(x / f - c)) * (1 - np.abs(y / f - c))
+        weight.flat[:] = vals
+        return block.append_op(
+            type="assign_value", outputs={"Out": var},
+            attrs={"shape": list(shape), "dtype": var.dtype,
+                   "fp32_values": [float(v) for v in weight.flatten()]})
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self._value = np.asarray(value)
+
+    def __call__(self, var, block):
+        v = self._value
+        if v.dtype in (np.float32, np.float64, np.float16):
+            attr = {"fp32_values": [float(x) for x in v.astype(np.float32).flatten()]}
+        else:
+            attr = {"int32_values": [int(x) for x in v.flatten()]}
+        return block.append_op(
+            type="assign_value", outputs={"Out": var},
+            attrs={"shape": list(v.shape), "dtype": var.dtype, **attr})
+
+
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Bilinear = BilinearInitializer
+
+_global_weight_initializer_ = None
+_global_bias_initializer_ = None
+
+
+def _global_weight_initializer():
+    return _global_weight_initializer_
+
+
+def _global_bias_initializer():
+    return _global_bias_initializer_

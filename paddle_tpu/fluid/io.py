@@ -1,0 +1,259 @@
+"""Model save/load — wire-compatible with the reference's tensor stream
+format (reference: paddle/fluid/framework/tensor_util.cc:386 TensorToStream,
+lod_tensor.cc:220 SerializeToStream; python/paddle/fluid/io.py
+save_persistables/save_inference_model/load_*), so checkpoints move between
+the frameworks in both directions.
+
+Format per LoDTensor:
+  u32 version(=0)
+  u64 lod_level; per level: u64 byte_size, then size_t[] offsets
+  u32 tensor version(=0)
+  i32 TensorDesc proto size; TensorDesc{data_type, dims} proto bytes
+  raw buffer (C order)
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Optional
+
+import numpy as np
+
+from . import core
+from .core import LoDTensor, VarDesc, global_scope
+from .framework import Program, Parameter, Variable, default_main_program
+from .proto import framework_pb2
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "save", "load",
+]
+
+
+def _serialize_lod_tensor(t: LoDTensor, as_fp16: bool = False) -> bytes:
+    arr = np.asarray(t.array)
+    if as_fp16:
+        arr = arr.astype(np.float16)
+    parts = [struct.pack("<I", 0)]
+    lod = t.lod()
+    parts.append(struct.pack("<Q", len(lod)))
+    for level in lod:
+        parts.append(struct.pack("<Q", len(level) * 8))
+        parts.append(np.asarray(level, np.uint64).tobytes())
+    parts.append(struct.pack("<I", 0))
+    desc = framework_pb2.VarType.TensorDesc()
+    desc.data_type = core.np_to_dtype(arr.dtype)
+    desc.dims.extend(arr.shape)
+    db = desc.SerializeToString()
+    parts.append(struct.pack("<i", len(db)))
+    parts.append(db)
+    parts.append(np.ascontiguousarray(arr).tobytes())
+    return b"".join(parts)
+
+
+def _deserialize_lod_tensor(data: bytes, offset: int = 0):
+    t, _ = _deserialize_one(data, offset)
+    return t
+
+
+def _deserialize_one(data: bytes, off: int):
+    (ver,) = struct.unpack_from("<I", data, off)
+    off += 4
+    assert ver == 0, f"unsupported tensor version {ver}"
+    (lod_level,) = struct.unpack_from("<Q", data, off)
+    off += 8
+    lod = []
+    for _ in range(lod_level):
+        (nbytes,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        level = np.frombuffer(data, np.uint64, nbytes // 8, off).tolist()
+        off += nbytes
+        lod.append([int(x) for x in level])
+    (tver,) = struct.unpack_from("<I", data, off)
+    off += 4
+    assert tver == 0
+    (dsize,) = struct.unpack_from("<i", data, off)
+    off += 4
+    desc = framework_pb2.VarType.TensorDesc()
+    desc.ParseFromString(data[off:off + dsize])
+    off += dsize
+    np_dtype = np.dtype(core.dtype_to_np(desc.data_type))
+    count = int(np.prod(desc.dims)) if desc.dims else 1
+    arr = np.frombuffer(data, np_dtype, count, off).reshape(list(desc.dims))
+    off += count * np_dtype.itemsize
+    t = LoDTensor()
+    t.set(arr.copy())
+    t.set_lod(lod)
+    return t, off
+
+
+def _deserialize_lod_tensor_stream(data: bytes, n: int) -> List[LoDTensor]:
+    res, off = [], 0
+    for _ in range(n):
+        t, off = _deserialize_one(data, off)
+        res.append(t)
+    return res
+
+
+# --------------------------------------------------------------------------
+# save/load APIs (reference: python/paddle/fluid/io.py)
+# --------------------------------------------------------------------------
+def _is_persistable(var: Variable) -> bool:
+    return (var.persistable and var.type not in (
+        VarDesc.VarType.FEED_MINIBATCH, VarDesc.VarType.FETCH_LIST,
+        VarDesc.VarType.READER, VarDesc.VarType.RAW))
+
+
+def _is_parameter(var) -> bool:
+    return isinstance(var, Parameter)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    if main_program is None:
+        main_program = default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    scope = global_scope()
+    if filename is None:
+        os.makedirs(dirname or ".", exist_ok=True)
+        for v in vars:
+            sv = scope.find_var(v.name)
+            if sv is None or not sv.is_initialized():
+                continue
+            with open(os.path.join(dirname, v.name), "wb") as f:
+                f.write(_serialize_lod_tensor(sv.get_tensor()))
+    else:
+        os.makedirs(dirname or ".", exist_ok=True)
+        with open(os.path.join(dirname, filename), "wb") as f:
+            for v in vars:
+                sv = scope.find_var(v.name)
+                f.write(_serialize_lod_tensor(sv.get_tensor()))
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=_is_parameter,
+              filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=_is_persistable,
+              filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    if main_program is None:
+        main_program = default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    scope = global_scope()
+    if filename is None:
+        for v in vars:
+            path = os.path.join(dirname, v.name)
+            if not os.path.exists(path):
+                raise RuntimeError(f"missing checkpoint file {path}")
+            with open(path, "rb") as f:
+                scope.var(v.name).set_value(_deserialize_lod_tensor(f.read()))
+    else:
+        with open(os.path.join(dirname, filename), "rb") as f:
+            data = f.read()
+        for v, t in zip(vars, _deserialize_lod_tensor_stream(data, len(vars))):
+            scope.var(v.name).set_value(t)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_parameter,
+              filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_persistable,
+              filename=filename)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    if main_program is None:
+        main_program = default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    pruned = main_program.clone(for_test=True)._prune(
+        [v.name if isinstance(v, Variable) else v for v in target_vars])
+    model_name = model_filename or "__model__"
+    with open(os.path.join(dirname, model_name), "wb") as f:
+        f.write(pruned.serialize_to_string())
+    if not program_only:
+        save_persistables(executor, dirname, main_program, params_filename)
+    return [v.name if isinstance(v, Variable) else v for v in target_vars]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    model_name = model_filename or "__model__"
+    with open(os.path.join(dirname, model_name), "rb") as f:
+        program = Program.parse_from_string(f.read())
+    load_persistables(executor, dirname, program, params_filename)
+    feed_names = []
+    fetch_names = []
+    for op in program.global_block().ops:
+        if op.type == "feed":
+            feed_names.append(op.output("Out")[0])
+        elif op.type == "fetch":
+            fetch_names.append(op.input("X")[0])
+    if not fetch_names:
+        # programs saved by this framework: treat last op outputs as targets
+        if program.global_block().ops:
+            fetch_names = program.global_block().ops[-1].output_arg_names
+    fetch_targets = [program.global_block().var(n) for n in fetch_names
+                     if program.global_block().has_var(n)]
+    # strip feed/fetch ops so the program body is runnable directly
+    block = program.global_block()
+    block.ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+    return program, feed_names, fetch_targets
+
+
+def save(program: Program, model_path: str):
+    """2.0-style single-file save (reference: framework/save_load_util.cc via
+    fluid.save) — here: pickle of name→ndarray + program."""
+    import pickle
+    scope = global_scope()
+    params = {}
+    opt_vars = {}
+    for v in program.list_vars():
+        if not _is_persistable(v):
+            continue
+        sv = scope.find_var(v.name)
+        if sv is None or not sv.is_initialized():
+            continue
+        arr = np.asarray(sv.get_tensor().array)
+        if _is_parameter(v):
+            params[v.name] = arr
+        else:
+            opt_vars[v.name] = arr
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(params, f)
+    with open(model_path + ".pdopt", "wb") as f:
+        pickle.dump(opt_vars, f)
+    with open(model_path + ".pdmodel", "wb") as f:
+        f.write(program.serialize_to_string())
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    import pickle
+    scope = global_scope()
+    loaded = {}
+    for suffix in (".pdparams", ".pdopt"):
+        path = model_path + suffix
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                loaded.update(pickle.load(f))
+    for name, arr in loaded.items():
+        t = LoDTensor()
+        t.set(arr)
+        scope.var(name).set_value(t)

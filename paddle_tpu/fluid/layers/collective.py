@@ -1,0 +1,92 @@
+"""Collective op-builder helpers (reference:
+python/paddle/fluid/layers/collective.py — _allreduce:20, _c_allreduce:64,
+_c_broadcast:93 …). The c_* ops map ring_id → a named mesh axis and lower to
+XLA ICI collectives (see paddle_tpu/ops/collective_ops.py)."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["_allreduce", "_broadcast", "_c_allreduce", "_c_broadcast",
+           "_c_allgather", "_c_reducescatter", "_c_sync_calc_stream",
+           "_c_sync_comm_stream"]
+
+
+def _allreduce(x, out=None, reduce_type="sum", sync_mode=False):
+    helper = LayerHelper("allreduce")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+        out.shape = x.shape
+    helper.append_op(type="allreduce", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"reduce_type": {"sum": 0, "prod": 1, "max": 2,
+                                            "min": 3}[reduce_type],
+                            "sync_mode": sync_mode})
+    return out
+
+
+def _broadcast(x, root, sync_mode=False):
+    helper = LayerHelper("broadcast")
+    helper.append_op(type="broadcast", inputs={"X": [x]},
+                     outputs={"Out": [x]},
+                     attrs={"sync_mode": sync_mode, "root": root})
+    return x
+
+
+def _c_allreduce(x, out=None, reduce_type="sum", ring_id=0,
+                 use_calc_stream=False):
+    helper = LayerHelper("c_allreduce")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+        out.shape = x.shape
+    helper.append_op(type=f"c_allreduce_{reduce_type}",
+                     inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"ring_id": ring_id,
+                            "use_calc_stream": use_calc_stream})
+    return out
+
+
+def _c_broadcast(x, root=0, ring_id=0, use_calc_stream=False):
+    helper = LayerHelper("c_broadcast")
+    helper.append_op(type="c_broadcast", inputs={"X": [x]},
+                     outputs={"Out": [x]},
+                     attrs={"root": root, "ring_id": ring_id,
+                            "use_calc_stream": use_calc_stream})
+    return x
+
+
+def _c_allgather(x, nranks, ring_id=0, use_calc_stream=False):
+    helper = LayerHelper("c_allgather")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if x.shape:
+        out.shape = tuple([x.shape[0] * nranks] + list(x.shape[1:]))
+    helper.append_op(type="c_allgather", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"nranks": nranks, "ring_id": ring_id,
+                            "use_calc_stream": use_calc_stream})
+    return out
+
+
+def _c_reducescatter(x, nranks, ring_id=0, use_calc_stream=False):
+    helper = LayerHelper("c_reducescatter")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if x.shape:
+        out.shape = tuple([x.shape[0] // nranks] + list(x.shape[1:]))
+    helper.append_op(type="c_reducescatter", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"nranks": nranks, "ring_id": ring_id,
+                            "use_calc_stream": use_calc_stream})
+    return out
+
+
+def _c_sync_calc_stream(x):
+    helper = LayerHelper("c_sync_calc_stream")
+    helper.append_op(type="c_sync_calc_stream", inputs={"X": [x]},
+                     outputs={"Out": [x]})
+    return x
+
+
+def _c_sync_comm_stream(x, ring_id=0):
+    helper = LayerHelper("c_sync_comm_stream")
+    helper.append_op(type="c_sync_comm_stream", inputs={"X": [x]},
+                     outputs={"Out": [x]}, attrs={"ring_id": ring_id})
+    return x

@@ -1,0 +1,352 @@
+"""Control-flow layers (reference: python/paddle/fluid/layers/control_flow.py
+— While:3739, cond, increment, array ops, comparison wrappers)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import VarDesc
+from ..framework import Variable, default_main_program
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "While", "Switch", "increment", "array_write", "create_array",
+    "less_than", "less_equal", "greater_than", "greater_equal", "equal",
+    "not_equal", "array_read", "array_length", "cond", "IfElse",
+    "StaticRNN", "Print", "Assert", "is_empty", "case", "switch_case",
+    "while_loop", "DynamicRNN", "reorder_lod_tensor_by_rank",
+]
+
+
+def _cmp(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(VarDesc.VarType.BOOL)
+        cond.stop_gradient = True
+        cond.shape = x.shape
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    return _cmp("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _cmp("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _cmp("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _cmp("greater_equal", x, y, cond)
+
+
+def equal(x, y, cond=None):
+    return _cmp("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _cmp("not_equal", x, y, cond)
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype)
+        out.shape = x.shape
+    helper.append_op(type="increment", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"step": float(value)})
+    return out
+
+
+def create_array(dtype):
+    helper = LayerHelper("array")
+    return helper.main_program.current_block().create_var(
+        name="{}.out".format(helper.name),
+        type=VarDesc.VarType.LOD_TENSOR_ARRAY, dtype=dtype)
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op(type="write_to_array",
+                     inputs={"X": [x], "I": [i]}, outputs={"Out": [array]})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op(type="read_from_array",
+                     inputs={"X": [array], "I": [i]}, outputs={"Out": [out]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference(VarDesc.VarType.INT64)
+    out.stop_gradient = True
+    helper.append_op(type="lod_array_length", inputs={"X": [array]},
+                     outputs={"Out": [out]})
+    return out
+
+
+class While:
+    """while loop over a sub-block (reference control_flow.py While)."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+        self.is_test = is_test
+
+    class _BlockGuard:
+        def __init__(self, while_obj):
+            self.w = while_obj
+
+        def __enter__(self):
+            self.w._main = default_main_program()
+            self.w._block = self.w._main._create_block()
+            return self.w._block
+
+        def __exit__(self, exc_type, exc_val, exc_tb):
+            if exc_type is not None:
+                return False
+            main = self.w._main
+            sub_block = main.current_block()
+            main._rollback()
+            parent = main.current_block()
+            x_names = set()
+            inner_outputs = {self.w.cond_var.name}
+            for op in sub_block.ops:
+                for name in op.input_arg_names:
+                    if name not in inner_outputs:
+                        x_names.add(name)
+                inner_outputs.update(op.output_arg_names)
+            out_vars = [n for n in inner_outputs
+                        if parent.has_var_recursive(n)]
+            step_scope = parent.create_var(
+                type=VarDesc.VarType.STEP_SCOPES,
+                name=self.w.helper.name + ".step_scopes")
+            parent.append_op(
+                type="while",
+                inputs={"X": sorted(x_names), "Condition": [self.w.cond_var]},
+                outputs={"Out": sorted(out_vars),
+                         "StepScopes": [step_scope]},
+                attrs={"sub_block": sub_block, "is_test": self.w.is_test})
+            return True
+
+    def block(self):
+        return While._BlockGuard(self)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """functional while (reference control_flow.py:3739 while_loop)."""
+    pre_cond = cond(*loop_vars)
+    w = While(pre_cond, is_test, name)
+    with w.block():
+        new_vars = body(*loop_vars)
+        if not isinstance(new_vars, (list, tuple)):
+            new_vars = [new_vars]
+        from .tensor import assign
+        for old, new in zip(loop_vars, new_vars):
+            assign(new, old)
+        new_cond = cond(*loop_vars)
+        assign(new_cond, pre_cond)
+    return loop_vars
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """two-branch conditional via conditional_block + select (reference
+    control_flow.py cond)."""
+    helper = LayerHelper("cond", name=name)
+    main = default_main_program()
+    from .tensor import cast, fill_constant
+    from .nn import logical_not
+
+    def _run_branch(fn, cond_var):
+        block = main._create_block()
+        out = fn() if fn is not None else None
+        sub = main.current_block()
+        main._rollback()
+        parent = main.current_block()
+        inner_out = set()
+        x_names = set()
+        for op in sub.ops:
+            for n in op.input_arg_names:
+                if n not in inner_out:
+                    x_names.add(n)
+            inner_out.update(op.output_arg_names)
+        scope_var = parent.create_var(
+            type=VarDesc.VarType.STEP_SCOPES,
+            name=helper.name + ".branch_scope")
+        parent.append_op(
+            type="conditional_block",
+            inputs={"Cond": [cond_var], "Input": sorted(x_names)},
+            outputs={"Out": sorted(inner_out), "Scope": [scope_var]},
+            attrs={"sub_block": sub, "is_scalar_condition": True})
+        return out
+
+    true_out = _run_branch(true_fn, pred)
+    not_pred = logical_not(pred)
+    false_out = _run_branch(false_fn, not_pred)
+    if true_out is None and false_out is None:
+        return None
+
+    def _select(t, f):
+        mask = cast(pred, VarDesc.VarType.INT32)
+        o = helper.create_variable_for_type_inference(t.dtype)
+        o.shape = t.shape
+        helper.append_op(type="select_input",
+                         inputs={"X": [f, t], "Mask": [mask]},
+                         outputs={"Out": [o]})
+        return o
+
+    if isinstance(true_out, (list, tuple)):
+        return [_select(t, f) for t, f in zip(true_out, false_out)]
+    return _select(true_out, false_out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference control_flow.py case — chained cond."""
+    pred, fn = pred_fn_pairs[0]
+    if len(pred_fn_pairs) == 1:
+        return cond(pred, fn, default, name)
+    return cond(pred, fn, lambda: case(pred_fn_pairs[1:], default), name)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    from .tensor import fill_constant
+    pairs = []
+    for idx, fn in (branch_fns.items() if isinstance(branch_fns, dict)
+                    else enumerate(branch_fns)):
+        c = fill_constant([1], branch_index.dtype, idx)
+        pairs.append((equal(branch_index, c), fn))
+    return case(pairs, default, name)
+
+
+class Switch:
+    """reference control_flow.py Switch — used by lr schedulers."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self.pre_not_conditions = []
+
+    class _CaseGuard:
+        def __init__(self, switch, cond_var):
+            self.switch = switch
+            self.cond_var = cond_var
+            self.main = None
+
+        def __enter__(self):
+            from .nn import logical_and, logical_not
+            self.main = default_main_program()
+            s = self.switch
+            if self.cond_var is not None:
+                c = self.cond_var
+                for nc in s.pre_not_conditions:
+                    c = logical_and(c, nc)
+                s.pre_not_conditions.append(logical_not(self.cond_var))
+            else:
+                c = None
+                for i, nc in enumerate(s.pre_not_conditions):
+                    c = nc if c is None else logical_and(c, nc)
+            self.run_cond = c
+            self.block = self.main._create_block()
+            return self.block
+
+        def __exit__(self, exc_type, exc_val, exc_tb):
+            if exc_type is not None:
+                return False
+            main = self.main
+            sub = main.current_block()
+            main._rollback()
+            parent = main.current_block()
+            inner_out = set()
+            x_names = set()
+            for op in sub.ops:
+                for n in op.input_arg_names:
+                    if n not in inner_out:
+                        x_names.add(n)
+                inner_out.update(op.output_arg_names)
+            scope_var = parent.create_var(
+                type=VarDesc.VarType.STEP_SCOPES,
+                name=self.switch.helper.name + ".case_scope")
+            parent.append_op(
+                type="conditional_block",
+                inputs={"Cond": [self.run_cond], "Input": sorted(x_names)},
+                outputs={"Out": sorted(inner_out), "Scope": [scope_var]},
+                attrs={"sub_block": sub, "is_scalar_condition": True})
+            return True
+
+    def case(self, condition):
+        return Switch._CaseGuard(self, condition)
+
+    def default(self):
+        return Switch._CaseGuard(self, None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *args):
+        return False
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="print", inputs={"In": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"first_n": first_n, "message": message or "",
+                            "summarize": summarize,
+                            "print_tensor_name": print_tensor_name,
+                            "print_tensor_type": print_tensor_type,
+                            "print_tensor_shape": print_tensor_shape,
+                            "print_tensor_lod": print_tensor_lod,
+                            "print_phase": print_phase.upper()})
+    return out
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    helper = LayerHelper("assert", name=name)
+    helper.append_op(type="assert",
+                     inputs={"Cond": [cond],
+                             "Data": list(data) if data else []},
+                     outputs={}, attrs={"summarize": summarize})
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(VarDesc.VarType.BOOL)
+        cond.stop_gradient = True
+    helper.append_op(type="is_empty", inputs={"X": [x]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+class StaticRNN:
+    def __init__(self, name=None):
+        raise NotImplementedError("StaticRNN: use layers.rnn / lax.scan path")
+
+
+class DynamicRNN:
+    def __init__(self, name=None):
+        raise NotImplementedError("DynamicRNN: use layers.rnn / lax.scan path")
+
+
+class IfElse:
+    def __init__(self, cond, name=None):
+        raise NotImplementedError("IfElse: use layers.cond")
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    raise NotImplementedError("reorder_lod_tensor_by_rank: pending LoD batch")

@@ -1,0 +1,50 @@
+"""Detection layers (reference: python/paddle/fluid/layers/detection.py —
+prior_box, yolo_box, multiclass_nms, …). Round-1: API surface present;
+kernels land with the detection batch (these are host/inference-side ops,
+not on the training hot path)."""
+from __future__ import annotations
+
+__all__ = [
+    "prior_box", "density_prior_box", "multi_box_head", "bipartite_match",
+    "target_assign", "detection_output", "ssd_loss", "rpn_target_assign",
+    "retinanet_target_assign", "sigmoid_focal_loss", "anchor_generator",
+    "roi_perspective_transform", "generate_proposal_labels",
+    "generate_proposals", "generate_mask_labels", "iou_similarity",
+    "box_coder", "polygon_box_transform", "yolov3_loss", "yolo_box",
+    "box_clip", "multiclass_nms", "locality_aware_nms",
+    "retinanet_detection_output", "distribute_fpn_proposals",
+    "box_decoder_and_assign", "collect_fpn_proposals",
+]
+
+
+def _nyi(name):
+    def fn(*a, **k):
+        raise NotImplementedError(f"{name}: detection batch pending")
+    fn.__name__ = name
+    return fn
+
+
+for _n in __all__:
+    globals()[_n] = _nyi(_n)
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("sigmoid_focal_loss")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="sigmoid_focal_loss",
+                     inputs={"X": [x], "Label": [label], "FgNum": [fg_num]},
+                     outputs={"Out": [out]},
+                     attrs={"gamma": gamma, "alpha": alpha})
+    return out
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"box_normalized": box_normalized})
+    return out

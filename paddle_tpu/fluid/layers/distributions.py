@@ -1,0 +1,75 @@
+"""Probability distributions (reference:
+python/paddle/fluid/layers/distributions.py)."""
+from __future__ import annotations
+
+import math
+
+__all__ = ["Uniform", "Normal", "Categorical", "MultivariateNormalDiag"]
+
+
+class Distribution:
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, shape, seed=0):
+        from .nn import uniform_random
+        from .tensor import cast
+        return uniform_random(shape, min=0.0, max=1.0, seed=seed) \
+            * (self.high - self.low) + self.low
+
+    def log_prob(self, value):
+        from . import ops
+        from .tensor import fill_constant
+        rng = self.high - self.low
+        return 0.0 - ops.log(value * 0.0 + rng)
+
+    def entropy(self):
+        from . import ops
+        return ops.log(self.high - self.low)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc, self.scale = loc, scale
+
+    def sample(self, shape, seed=0):
+        from .nn import gaussian_random
+        return gaussian_random(shape, mean=0.0, std=1.0, seed=seed) \
+            * self.scale + self.loc
+
+    def log_prob(self, value):
+        from . import ops
+        var = self.scale * self.scale
+        return -1.0 * ((value - self.loc) * (value - self.loc)) / (2.0 * var) \
+            - ops.log(self.scale) - math.log(math.sqrt(2.0 * math.pi))
+
+    def entropy(self):
+        from . import ops
+        return 0.5 + 0.5 * math.log(2.0 * math.pi) + ops.log(self.scale)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits):
+        self.logits = logits
+
+    def entropy(self):
+        from .nn import softmax, reduce_sum
+        from . import ops
+        p = softmax(self.logits)
+        return 0.0 - reduce_sum(p * ops.log(p + 1e-10), dim=-1)
+
+
+class MultivariateNormalDiag(Distribution):
+    def __init__(self, loc, scale):
+        self.loc, self.scale = loc, scale

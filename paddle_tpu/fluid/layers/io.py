@@ -1,0 +1,30 @@
+"""Data-input layers (reference: python/paddle/fluid/layers/io.py — data,
+py_reader, double_buffer). On TPU the device feed pipeline is the host→HBM
+transfer inside jit; py_reader maps to the DataLoader path (fluid/reader.py)."""
+from __future__ import annotations
+
+from ..core import VarDesc, convert_np_dtype_to_dtype_
+from ..framework import default_main_program, default_startup_program
+from ..layer_helper import LayerHelper
+
+__all__ = ["data", "read_file", "double_buffer"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         type=VarDesc.VarType.LOD_TENSOR, stop_gradient=True):
+    helper = LayerHelper("data")
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper.main_program.global_block().create_var(
+        name=name, shape=shape, dtype=convert_np_dtype_to_dtype_(dtype),
+        lod_level=lod_level, type=type, stop_gradient=stop_gradient,
+        is_data=True, need_check_feed=True)
+
+
+def read_file(reader):
+    raise NotImplementedError("read_file: use DataLoader feeds")
+
+
+def double_buffer(reader, place=None, name=None):
+    return reader

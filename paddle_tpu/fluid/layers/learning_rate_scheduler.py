@@ -1,0 +1,135 @@
+"""LR schedulers as in-graph ops (reference:
+python/paddle/fluid/layers/learning_rate_scheduler.py). Each returns a
+Variable recomputed each step from the auto-incremented global counter."""
+from __future__ import annotations
+
+import math
+
+from ..core import VarDesc
+from ..framework import Variable, default_main_program
+from ..layer_helper import LayerHelper
+from ..initializer import Constant
+from .nn import autoincreased_step_counter, elementwise_div
+from .tensor import fill_constant, cast
+from . import ops
+from . import control_flow
+from .control_flow import Switch
+
+__all__ = [
+    "exponential_decay", "natural_exp_decay", "inverse_time_decay",
+    "polynomial_decay", "piecewise_decay", "noam_decay", "cosine_decay",
+    "linear_lr_warmup",
+]
+
+
+def _decay_step_counter(begin=0):
+    counter = autoincreased_step_counter(
+        counter_name="@LR_DECAY_COUNTER@", begin=begin, step=1)
+    return cast(counter, "float32")
+
+
+def noam_decay(d_model, warmup_steps):
+    step = _decay_step_counter(1)
+    a = step ** -0.5
+    b = step * (warmup_steps ** -1.5)
+    from .nn import elementwise_min
+    return (d_model ** -0.5) * elementwise_min(a, b)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return float(learning_rate) * (float(decay_rate) ** div)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return float(learning_rate) * ops.exp(div * float(-decay_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return float(learning_rate) / (div * float(decay_rate) + 1.0)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    step = _decay_step_counter()
+    if cycle:
+        div_res = ops.ceil(step / float(decay_steps))
+        from .nn import equal as _  # noqa
+        decay_steps_var = div_res * float(decay_steps)
+        # guard step==0 → one cycle
+        decayed = (step / decay_steps_var)
+        frac = 1.0 - decayed
+    else:
+        from .nn import elementwise_min
+        capped = elementwise_min(
+            step, fill_constant([1], "float32", float(decay_steps)))
+        frac = 1.0 - capped / float(decay_steps)
+    return ((float(learning_rate) - float(end_learning_rate))
+            * (frac ** power)) + float(end_learning_rate)
+
+
+def piecewise_decay(boundaries, values):
+    helper = LayerHelper("piecewise_decay")
+    step = autoincreased_step_counter(counter_name="@LR_DECAY_COUNTER@",
+                                      begin=0, step=1)
+    lr = helper.create_or_get_global_variable(
+        name=helper.name + ".lr", dtype=VarDesc.VarType.FP32, shape=[1])
+    lr.persistable = True
+    helper.set_variable_initializer(lr, Constant(float(values[0])))
+    with Switch() as switch:
+        for i, b in enumerate(boundaries):
+            bval = fill_constant([1], VarDesc.VarType.INT64, int(b))
+            with switch.case(control_flow.less_than(step, bval)):
+                v = fill_constant([1], "float32", float(values[i]))
+                from .tensor import assign
+                assign(v, lr)
+        with switch.default():
+            v = fill_constant([1], "float32", float(values[-1]))
+            from .tensor import assign
+            assign(v, lr)
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _decay_step_counter()
+    epoch = ops.floor(step / float(step_each_epoch))
+    return float(learning_rate) * 0.5 * (
+        ops.cos(epoch * (math.pi / float(epochs))) + 1.0)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    helper = LayerHelper("linear_warmup")
+    lr = helper.create_or_get_global_variable(
+        name=helper.name + ".warmup_lr", dtype=VarDesc.VarType.FP32,
+        shape=[1])
+    lr.persistable = True
+    helper.set_variable_initializer(lr, Constant(float(start_lr)))
+    step = autoincreased_step_counter(counter_name="@LR_DECAY_COUNTER@",
+                                      begin=0, step=1)
+    with Switch() as switch:
+        wval = fill_constant([1], VarDesc.VarType.INT64, int(warmup_steps))
+        with switch.case(control_flow.less_than(step, wval)):
+            fstep = cast(step, "float32")
+            warm = float(start_lr) + (float(end_lr) - float(start_lr)) \
+                * fstep / float(warmup_steps)
+            from .tensor import assign
+            assign(warm, lr)
+        with switch.default():
+            from .tensor import assign
+            if isinstance(learning_rate, Variable):
+                assign(learning_rate, lr)
+            else:
+                assign(fill_constant([1], "float32", float(learning_rate)), lr)
+    return lr

@@ -1,0 +1,185 @@
+"""Loss layers (reference: python/paddle/fluid/layers/loss.py)."""
+from __future__ import annotations
+
+from ..core import VarDesc
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "cross_entropy", "square_error_cost", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "rank_loss", "margin_rank_loss",
+    "huber_loss", "kldiv_loss", "mse_loss", "bpr_loss", "center_loss",
+    "edit_distance", "warpctc", "nce", "hsigmoid",
+    "sampled_softmax_with_cross_entropy", "teacher_student_sigmoid_loss",
+    "npair_loss",
+]
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = tuple(list(input.shape[:-1]) + [1])
+    helper.append_op(type="cross_entropy",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]},
+                     attrs={"soft_label": soft_label,
+                            "ignore_index": ignore_index})
+    return out
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type="square_error_cost",
+                     inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax = helper.create_variable_for_type_inference(logits.dtype)
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    softmax.shape = logits.shape
+    lshape = list(logits.shape)
+    lshape[axis] = 1
+    loss.shape = tuple(lshape)
+    helper.append_op(type="softmax_with_cross_entropy",
+                     inputs={"Logits": [logits], "Label": [label]},
+                     outputs={"Softmax": [softmax], "Loss": [loss]},
+                     attrs={"soft_label": soft_label,
+                            "ignore_index": ignore_index,
+                            "numeric_stable_mode": numeric_stable_mode,
+                            "axis": axis})
+    if return_softmax:
+        return loss, softmax
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None,
+                                      normalize=False):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="sigmoid_cross_entropy_with_logits",
+                     inputs={"X": [x], "Label": [label]},
+                     outputs={"Out": [out]},
+                     attrs={"ignore_index": ignore_index,
+                            "normalize": normalize})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    out.shape = left.shape
+    helper.append_op(type="rank_loss",
+                     inputs={"Label": [label], "Left": [left],
+                             "Right": [right]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    act = helper.create_variable_for_type_inference(left.dtype)
+    out.shape = left.shape
+    helper.append_op(type="margin_rank_loss",
+                     inputs={"Label": [label], "X1": [left], "X2": [right]},
+                     outputs={"Out": [out], "Activated": [act]},
+                     attrs={"margin": margin})
+    return out
+
+
+def huber_loss(input, label, delta):
+    helper = LayerHelper("huber_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    residual = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type="huber_loss",
+                     inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [out], "Residual": [residual]},
+                     attrs={"delta": delta})
+    return out
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    helper = LayerHelper("kldiv_loss", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="kldiv_loss",
+                     inputs={"X": [x], "Target": [target]},
+                     outputs={"Loss": [out]}, attrs={"reduction": reduction})
+    return out
+
+
+def mse_loss(input, label):
+    helper = LayerHelper("mse_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = (1,)
+    helper.append_op(type="mse_loss", inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    helper = LayerHelper("bpr_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = (input.shape[0], 1)
+    helper.append_op(type="bpr_loss",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]})
+    return out
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    raise NotImplementedError("center_loss: pending")
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    raise NotImplementedError("edit_distance: pending sequence batch")
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    raise NotImplementedError("warpctc: pending CTC kernel")
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    raise NotImplementedError("nce: pending sampled-softmax batch")
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    raise NotImplementedError("hsigmoid: pending")
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples, **kw):
+    raise NotImplementedError("sampled_softmax: pending")
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    raise NotImplementedError("teacher_student_sigmoid_loss: pending")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    from .nn import reduce_sum, reduce_mean, matmul, transpose
+    from . import ops
+    from .loss import softmax_with_cross_entropy
+    reg = reduce_mean(reduce_sum(ops.square(anchor), 1)) + reduce_mean(
+        reduce_sum(ops.square(positive), 1))
+    l2loss = reg * l2_reg * 0.25
+    sim = matmul(anchor, positive, transpose_y=True)
+    from .nn import softmax as _sm
+    import numpy as _np
+    ce = softmax_with_cross_entropy(sim, labels, soft_label=True)
+    return reduce_mean(ce) + l2loss

@@ -1,0 +1,1496 @@
+"""Core NN layers (reference: python/paddle/fluid/layers/nn.py, 14.4K LoC).
+Op-builder functions with inline shape inference; -1 marks unknown dims."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import core
+from ..core import VarDesc, convert_np_dtype_to_dtype_
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..initializer import Constant, Normal, Xavier
+from ..param_attr import ParamAttr
+
+__all__ = [
+    "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose", "pool2d",
+    "pool3d", "adaptive_pool2d", "batch_norm", "instance_norm", "layer_norm",
+    "group_norm", "data_norm", "dropout", "softmax", "reshape", "squeeze",
+    "unsqueeze", "transpose", "split", "concat_", "reduce_sum", "reduce_mean",
+    "reduce_max", "reduce_min", "reduce_prod", "reduce_all", "reduce_any",
+    "matmul", "topk", "stack", "unstack", "expand", "expand_as", "slice",
+    "strided_slice", "gather", "gather_nd", "scatter", "scatter_nd_add",
+    "scatter_nd", "one_hot", "l2_normalize", "clip", "clip_by_norm", "mean",
+    "mul", "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min", "elementwise_pow",
+    "elementwise_mod", "elementwise_floordiv", "uniform_random",
+    "gaussian_random", "flatten", "pad", "pad2d", "label_smooth", "where",
+    "sign", "shard_index", "relu", "logical_and", "logical_or", "logical_xor",
+    "logical_not", "shape", "rank", "size", "lod_reset", "lod_append",
+    "image_resize", "resize_bilinear", "resize_nearest", "grid_sampler",
+    "unfold", "crop", "crop_tensor", "sum", "cast_", "maxout",
+    "space_to_depth", "affine_channel", "similarity_focus", "hash",
+    "log_loss", "add_position_encoding", "bilinear_tensor_product",
+    "merge_selected_rows", "get_tensor_from_selected_rows", "py_func",
+    "pixel_shuffle", "fsp_matrix", "continuous_value_model", "unique",
+    "unique_with_counts", "interpolate", "smooth_l1", "multiplex",
+    "prelu", "brelu", "leaky_relu", "soft_relu", "swish", "hard_swish",
+    "elu", "relu6", "pow", "stanh", "hard_sigmoid", "im2sequence",
+    "row_conv", "autoincreased_step_counter", "unbind", "roll",
+    "index_select", "index_sample", "temporal_shift", "spectral_norm",
+    "random_crop", "mean_iou", "dice_loss",
+]
+
+
+def _prod(xs):
+    r = 1
+    for x in xs:
+        r *= x
+    return r
+
+
+# --------------------------------------------------------------------------
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """reference: layers/nn.py fc — mul(+sum) + bias + act."""
+    helper = LayerHelper("fc", **locals())
+    dtype = helper.input_dtype()
+    inputs = helper.multiple_input()
+    mul_results = []
+    for inp, pa in zip(inputs, helper.multiple_param_attr(len(inputs))):
+        shape = inp.shape
+        in_features = _prod(shape[num_flatten_dims:])
+        w = helper.create_parameter(attr=pa, shape=[in_features, size],
+                                    dtype=dtype)
+        tmp = helper.create_variable_for_type_inference(dtype)
+        tmp.shape = tuple(shape[:num_flatten_dims]) + (size,)
+        helper.append_op(type="mul", inputs={"X": [inp], "Y": [w]},
+                         outputs={"Out": [tmp]},
+                         attrs={"x_num_col_dims": num_flatten_dims,
+                                "y_num_col_dims": 1})
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(dtype)
+        pre_bias.shape = mul_results[0].shape
+        helper.append_op(type="sum", inputs={"X": mul_results},
+                         outputs={"Out": [pre_bias]})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """reference: layers/nn.py embedding → lookup_table op."""
+    helper = LayerHelper("embedding", **locals())
+    dtype = convert_np_dtype_to_dtype_(dtype)
+    w = helper.create_parameter(attr=helper.param_attr, shape=size,
+                                dtype=dtype, is_bias=False)
+    out = helper.create_variable_for_type_inference(dtype)
+    pad = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    ishape = list(input.shape)
+    if ishape and ishape[-1] == 1:
+        out.shape = tuple(ishape[:-1]) + (size[1],)
+    else:
+        out.shape = tuple(ishape) + (size[1],)
+    helper.append_op(type="lookup_table",
+                     inputs={"W": [w], "Ids": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"is_sparse": is_sparse,
+                            "is_distributed": is_distributed,
+                            "remote_prefetch": False,
+                            "padding_idx": pad})
+    return out
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+def _conv_out_size(i, k, p0, p1, s, d=1):
+    if i < 0:
+        return -1
+    return (i + p0 + p1 - (d * (k - 1) + 1)) // s + 1
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    helper = LayerHelper("conv2d", **locals())
+    dtype = helper.input_dtype()
+    groups = groups or 1
+    ksize = _pair(filter_size)
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad_algo = "EXPLICIT"
+    if isinstance(padding, str):
+        pad_algo = padding.upper()
+        padding = [0, 0]
+    padding = _pair(padding)
+    ch_axis = 1 if data_format == "NCHW" else 3
+    num_channels = input.shape[ch_axis]
+    w_shape = [num_filters, num_channels // groups] + ksize
+    default_init = Normal(0.0, (2.0 / (num_channels // groups * _prod(ksize))) ** 0.5)
+    w = helper.create_parameter(attr=helper.param_attr, shape=w_shape,
+                                dtype=dtype, default_initializer=default_init)
+    out = helper.create_variable_for_type_inference(dtype)
+    if data_format == "NCHW":
+        h = _conv_out_size(input.shape[2], ksize[0], padding[0], padding[0],
+                           stride[0], dilation[0])
+        wd = _conv_out_size(input.shape[3], ksize[1], padding[1], padding[1],
+                            stride[1], dilation[1])
+        out.shape = (input.shape[0], num_filters, h, wd)
+    helper.append_op(
+        type="conv2d",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding, "dilations": dilation,
+               "groups": groups, "use_cudnn": use_cudnn,
+               "padding_algorithm": pad_algo, "data_format": data_format})
+    pre_act = helper.append_bias_op(out, dim_start=ch_axis,
+                                    dim_end=ch_axis + 1)
+    return helper.append_activation(pre_act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    helper = LayerHelper("conv3d", **locals())
+    dtype = helper.input_dtype()
+    groups = groups or 1
+    ksize = _pair(filter_size, 3)
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    padding = _pair(padding, 3)
+    num_channels = input.shape[1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_filters, num_channels // groups] + ksize,
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv3d", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding, "dilations": dilation,
+               "groups": groups, "use_cudnn": use_cudnn,
+               "padding_algorithm": "EXPLICIT", "data_format": data_format})
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    helper = LayerHelper("conv2d_transpose", **locals())
+    dtype = helper.input_dtype()
+    groups = groups or 1
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    padding = _pair(padding)
+    in_c = input.shape[1]
+    if filter_size is None:
+        assert output_size is not None
+        output_size = _pair(output_size)
+        filter_size = [
+            (output_size[i] - (input.shape[2 + i] - 1) * stride[i]
+             + 2 * padding[i] - 1) // dilation[i] + 1 for i in (0, 1)]
+    else:
+        filter_size = _pair(filter_size)
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[in_c, num_filters // groups] + filter_size, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv2d_transpose", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding, "dilations": dilation,
+               "groups": groups, "use_cudnn": use_cudnn,
+               "output_size": list(_pair(output_size)) if output_size else [],
+               "padding_algorithm": "EXPLICIT", "data_format": data_format})
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCHW"):
+    helper = LayerHelper("pool2d", **locals())
+    ksize = _pair(pool_size)
+    stride = _pair(pool_stride)
+    padding = _pair(pool_padding)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    if global_pooling:
+        out.shape = (input.shape[0], input.shape[1], 1, 1)
+    elif data_format == "NCHW" and len(input.shape) == 4:
+        h = _conv_out_size(input.shape[2], ksize[0], padding[0], padding[0], stride[0])
+        w = _conv_out_size(input.shape[3], ksize[1], padding[1], padding[1], stride[1])
+        out.shape = (input.shape[0], input.shape[1], h, w)
+    helper.append_op(
+        type="pool2d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": ksize,
+               "global_pooling": global_pooling, "strides": stride,
+               "paddings": padding, "use_cudnn": use_cudnn,
+               "ceil_mode": ceil_mode, "exclusive": exclusive,
+               "data_format": data_format, "padding_algorithm": "EXPLICIT"})
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCDHW"):
+    helper = LayerHelper("pool3d", **locals())
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    helper.append_op(
+        type="pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": _pair(pool_size, 3),
+               "global_pooling": global_pooling,
+               "strides": _pair(pool_stride, 3),
+               "paddings": _pair(pool_padding, 3), "use_cudnn": use_cudnn,
+               "ceil_mode": ceil_mode, "exclusive": exclusive,
+               "data_format": data_format, "padding_algorithm": "EXPLICIT"})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    helper = LayerHelper("adaptive_pool2d", **locals())
+    ksize = _pair(pool_size)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    out.shape = (input.shape[0], input.shape[1], ksize[0], ksize[1])
+    helper.append_op(
+        type="pool2d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": ksize, "adaptive": True,
+               "strides": [1, 1], "paddings": [0, 0],
+               "global_pooling": False, "data_format": "NCHW",
+               "padding_algorithm": "EXPLICIT"})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    helper = LayerHelper("batch_norm", **locals())
+    dtype = helper.input_dtype()
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(attr=helper.param_attr, shape=[c],
+                                    dtype=dtype,
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(attr=helper.bias_attr, shape=[c],
+                                   dtype=dtype, is_bias=True)
+    mean = helper.create_parameter(
+        attr=ParamAttr(name=moving_mean_name, initializer=Constant(0.0),
+                       trainable=False), shape=[c], dtype=dtype)
+    mean.stop_gradient = True
+    variance = helper.create_parameter(
+        attr=ParamAttr(name=moving_variance_name, initializer=Constant(1.0),
+                       trainable=False), shape=[c], dtype=dtype)
+    variance.stop_gradient = True
+    saved_mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    out = (input if in_place
+           else helper.create_variable_for_type_inference(dtype))
+    out.shape = input.shape
+    helper.append_op(
+        type="batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [variance]},
+        outputs={"Y": [out], "MeanOut": [mean], "VarianceOut": [variance],
+                 "SavedMean": [saved_mean], "SavedVariance": [saved_var]},
+        attrs={"momentum": momentum, "epsilon": epsilon,
+               "is_test": is_test, "data_layout": data_layout,
+               "use_global_stats": use_global_stats})
+    return helper.append_activation(out)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper("instance_norm", **locals())
+    dtype = helper.input_dtype()
+    c = input.shape[1]
+    scale = helper.create_parameter(attr=helper.param_attr, shape=[c],
+                                    dtype=dtype,
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(attr=helper.bias_attr, shape=[c],
+                                   dtype=dtype, is_bias=True)
+    saved_mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = input.shape
+    helper.append_op(
+        type="instance_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias]},
+        outputs={"Y": [out], "SavedMean": [saved_mean],
+                 "SavedVariance": [saved_var]},
+        attrs={"epsilon": epsilon})
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper("layer_norm", **locals())
+    dtype = helper.input_dtype()
+    norm_shape = [_prod(input.shape[begin_norm_axis:])]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(attr=helper.param_attr, shape=norm_shape,
+                                    dtype=dtype,
+                                    default_initializer=Constant(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=norm_shape,
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = input.shape
+    helper.append_op(type="layer_norm", inputs=inputs,
+                     outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+                     attrs={"epsilon": epsilon,
+                            "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(out)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm", **locals())
+    dtype = helper.input_dtype()
+    c = input.shape[1]
+    inputs = {"X": [input]}
+    if param_attr is not False:
+        s = helper.create_parameter(attr=helper.param_attr, shape=[c],
+                                    dtype=dtype,
+                                    default_initializer=Constant(1.0))
+        inputs["Scale"] = [s]
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=[c],
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = input.shape
+    helper.append_op(type="group_norm", inputs=inputs,
+                     outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+                     attrs={"epsilon": epsilon, "groups": groups,
+                            "data_layout": data_layout})
+    return helper.append_activation(out)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True):
+    helper = LayerHelper("data_norm", **locals())
+    dtype = helper.input_dtype()
+    c = input.shape[-1]
+    batch_size = helper.create_parameter(
+        attr=ParamAttr(initializer=Constant(1e4)), shape=[c], dtype=dtype)
+    batch_sum = helper.create_parameter(
+        attr=ParamAttr(initializer=Constant(0.0)), shape=[c], dtype=dtype)
+    batch_square_sum = helper.create_parameter(
+        attr=ParamAttr(initializer=Constant(1e4)), shape=[c], dtype=dtype)
+    means = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    scales = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = input.shape
+    helper.append_op(
+        type="data_norm",
+        inputs={"X": [input], "BatchSize": [batch_size],
+                "BatchSum": [batch_sum], "BatchSquareSum": [batch_square_sum]},
+        outputs={"Y": [out], "Means": [means], "Scales": [scales]},
+        attrs={"epsilon": epsilon})
+    return helper.append_activation(out)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    mask = helper.create_variable_for_type_inference(
+        VarDesc.VarType.UINT8, stop_gradient=True)
+    helper.append_op(
+        type="dropout", inputs={"X": [x]},
+        outputs={"Out": [out], "Mask": [mask]},
+        attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+               "fix_seed": seed is not None, "seed": seed or 0,
+               "dropout_implementation": dropout_implementation})
+    return out
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    helper = LayerHelper("softmax", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type="softmax", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape2", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    inputs = {"X": [x]}
+    attrs = {}
+    if isinstance(shape, Variable):
+        inputs["Shape"] = [shape]
+        attrs["shape"] = []
+    elif any(isinstance(s, Variable) for s in shape):
+        inputs["ShapeTensor"] = [s for s in shape if isinstance(s, Variable)]
+        attrs["shape"] = [s if not isinstance(s, Variable) else -1 for s in shape]
+    else:
+        attrs["shape"] = [int(s) for s in shape]
+        # static shape inference with 0/-1 rules
+        tgt = list(attrs["shape"])
+        for i, t in enumerate(tgt):
+            if t == 0:
+                tgt[i] = x.shape[i]
+        if -1 in tgt and all(s >= 0 for s in x.shape):
+            known = _prod([t for t in tgt if t != -1])
+            tgt[tgt.index(-1)] = _prod(x.shape) // max(known, 1)
+        out.shape = tuple(tgt)
+    helper.append_op(type="reshape2", inputs=inputs,
+                     outputs={"Out": [out], "XShape": [xshape]}, attrs=attrs)
+    return helper.append_activation(out)
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    shp = [s for i, s in enumerate(input.shape)
+           if not (i in [a % max(len(input.shape), 1) for a in axes] and s == 1)]
+    out.shape = tuple(shp)
+    helper.append_op(type="squeeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axes": axes})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    shp = list(input.shape)
+    for a in sorted(axes):
+        shp.insert(a if a >= 0 else len(shp) + a + 1, 1)
+    out.shape = tuple(shp)
+    helper.append_op(type="unsqueeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axes": axes})
+    return out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    if x.shape:
+        out.shape = tuple(x.shape[p] for p in perm)
+    helper.append_op(type="transpose2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axis": list(perm)})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", **locals())
+    dim = dim if dim >= 0 else dim + len(input.shape)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        sections = []
+        sizes = [input.shape[dim] // n] * n if input.shape[dim] > 0 else [-1] * n
+    else:
+        sections = list(num_or_sections)
+        n = len(sections)
+        sizes = sections
+    outs = []
+    for i in range(n):
+        o = helper.create_variable_for_type_inference(input.dtype)
+        shp = list(input.shape)
+        shp[dim] = sizes[i] if not isinstance(sizes[i], Variable) else -1
+        o.shape = tuple(shp)
+        outs.append(o)
+    helper.append_op(type="split", inputs={"X": [input]},
+                     outputs={"Out": outs},
+                     attrs={"axis": dim, "num": 0 if sections else n,
+                            "sections": [s if not isinstance(s, Variable)
+                                         else -1 for s in sections]})
+    return outs
+
+
+def concat_(input, axis=0, name=None):
+    from .tensor import concat
+    return concat(input, axis, name)
+
+
+def _reduce(op_type, input, dim, keep_dim, name):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if dim is None:
+        dims = []
+        reduce_all = True
+        out.shape = (1,)
+    else:
+        dims = [dim] if isinstance(dim, int) else list(dim)
+        reduce_all = len(dims) == len(input.shape)
+        nd = [d % len(input.shape) for d in dims]
+        if keep_dim:
+            out.shape = tuple(1 if i in nd else s
+                              for i, s in enumerate(input.shape))
+        else:
+            out.shape = tuple(s for i, s in enumerate(input.shape)
+                              if i not in nd) or (1,)
+    helper.append_op(type=op_type, inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"dim": dims or [0], "keep_dim": keep_dim,
+                            "reduce_all": reduce_all})
+    return out
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_min", input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_prod", input, dim, keep_dim, name)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_all", input, dim, keep_dim, name)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_any", input, dim, keep_dim, name)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xs, ys = list(x.shape), list(y.shape)
+    if len(xs) >= 2 and len(ys) >= 2:
+        if transpose_x:
+            xs[-1], xs[-2] = xs[-2], xs[-1]
+        if transpose_y:
+            ys[-1], ys[-2] = ys[-2], ys[-1]
+        batch = xs[:-2] if len(xs) >= len(ys) else ys[:-2]
+        out.shape = tuple(batch + [xs[-2], ys[-1]])
+    helper.append_op(type="matmul", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"transpose_X": transpose_x,
+                            "transpose_Y": transpose_y, "alpha": float(alpha)})
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", **locals())
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference(VarDesc.VarType.INT64)
+    inputs = {"X": [input]}
+    attrs = {"k": k if not isinstance(k, Variable) else 1}
+    if isinstance(k, Variable):
+        inputs["K"] = [k]
+    else:
+        values.shape = tuple(list(input.shape[:-1]) + [k])
+        indices.shape = values.shape
+    helper.append_op(type="top_k", inputs=inputs,
+                     outputs={"Out": [values], "Indices": [indices]},
+                     attrs=attrs)
+    indices.stop_gradient = True
+    return values, indices
+
+
+def stack(x, axis=0):
+    helper = LayerHelper("stack")
+    x = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(x[0].dtype)
+    shp = list(x[0].shape)
+    shp.insert(axis if axis >= 0 else len(shp) + axis + 1, len(x))
+    out.shape = tuple(shp)
+    helper.append_op(type="stack", inputs={"X": list(x)},
+                     outputs={"Y": [out]}, attrs={"axis": axis})
+    return out
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper("unstack")
+    if num is None:
+        num = x.shape[axis]
+    outs = []
+    for _ in range(num):
+        o = helper.create_variable_for_type_inference(x.dtype)
+        shp = list(x.shape)
+        shp.pop(axis if axis >= 0 else len(shp) + axis)
+        o.shape = tuple(shp)
+        outs.append(o)
+    helper.append_op(type="unstack", inputs={"X": [x]}, outputs={"Y": outs},
+                     attrs={"axis": axis, "num": num})
+    return outs
+
+
+def unbind(input, axis=0):
+    helper = LayerHelper("unbind")
+    num = input.shape[axis]
+    outs = [helper.create_variable_for_type_inference(input.dtype)
+            for _ in range(num)]
+    helper.append_op(type="unbind", inputs={"X": [input]},
+                     outputs={"Out": outs}, attrs={"axis": axis})
+    return outs
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if not any(isinstance(t, Variable) for t in expand_times):
+        out.shape = tuple(s * t if s > 0 else -1
+                          for s, t in zip(x.shape, expand_times))
+    helper.append_op(type="expand", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"expand_times": [t if not isinstance(t, Variable)
+                                             else -1 for t in expand_times]})
+    return out
+
+
+def expand_as(x, target_tensor, name=None):
+    helper = LayerHelper("expand_as", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = target_tensor.shape
+    helper.append_op(type="expand_as",
+                     inputs={"X": [x], "target_tensor": [target_tensor]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper("slice")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    shp = list(input.shape)
+    ok = all(not isinstance(s, Variable) for s in list(starts) + list(ends))
+    if ok:
+        for ax, s, e in zip(axes, starts, ends):
+            if shp[ax] < 0:
+                continue
+            d = shp[ax]
+            s2 = max(s + d, 0) if s < 0 else min(s, d)
+            e2 = max(e + d, 0) if e < 0 else min(e, d)
+            shp[ax] = max(e2 - s2, 0)
+        out.shape = tuple(shp)
+    helper.append_op(type="slice", inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends), "decrease_axis": [],
+                            "infer_flags": [1] * len(axes)})
+    return out
+
+
+def strided_slice(input, axes, starts, ends, strides):
+    helper = LayerHelper("strided_slice")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="strided_slice", inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends), "strides": list(strides),
+                            "decrease_axis": [],
+                            "infer_flags": [1] * len(axes)})
+    return out
+
+
+def gather(input, index, overwrite=True):
+    helper = LayerHelper("gather")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = tuple([index.shape[0]] + list(input.shape[1:]))
+    helper.append_op(type="gather", inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = tuple(list(index.shape[:-1])
+                      + list(input.shape[index.shape[-1]:]))
+    helper.append_op(type="gather_nd", inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def scatter(input, index, updates, name=None, overwrite=True):
+    helper = LayerHelper("scatter", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type="scatter",
+                     inputs={"X": [input], "Ids": [index],
+                             "Updates": [updates]},
+                     outputs={"Out": [out]}, attrs={"overwrite": overwrite})
+    return out
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    helper = LayerHelper("scatter_nd_add", **locals())
+    out = helper.create_variable_for_type_inference(ref.dtype)
+    out.shape = ref.shape
+    helper.append_op(type="scatter_nd_add",
+                     inputs={"X": [ref], "Index": [index],
+                             "Updates": [updates]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .tensor import fill_constant
+    zero = fill_constant(shape, updates.dtype, 0.0)
+    return scatter_nd_add(zero, index, updates, name)
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    helper = LayerHelper("one_hot")
+    out = helper.create_variable_for_type_inference(VarDesc.VarType.FP32)
+    shp = list(input.shape)
+    if shp and shp[-1] == 1:
+        shp = shp[:-1]
+    out.shape = tuple(shp + [depth if not isinstance(depth, Variable) else -1])
+    inputs = {"X": [input]}
+    attrs = {"allow_out_of_range": allow_out_of_range}
+    if isinstance(depth, Variable):
+        inputs["depth_tensor"] = [depth]
+        attrs["depth"] = 1
+    else:
+        attrs["depth"] = depth
+    helper.append_op(type="one_hot", inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    norm = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="norm", inputs={"X": [x]},
+                     outputs={"Out": [out], "Norm": [norm]},
+                     attrs={"axis": 1 if axis is None else axis,
+                            "epsilon": epsilon})
+    return out
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="clip", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"min": float(min), "max": float(max)})
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="clip_by_norm", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"max_norm": float(max_norm)})
+    return out
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = (1,)
+    helper.append_op(type="mean", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = tuple(list(x.shape[:x_num_col_dims])
+                      + list(y.shape[y_num_col_dims:]))
+    helper.append_op(type="mul", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"x_num_col_dims": x_num_col_dims,
+                            "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def _elementwise(op_type, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape if len(x.shape) >= len(y.shape) else y.shape
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    helper.kwargs["act"] = act
+    return helper.append_activation(out)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_add", x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_sub", x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_mul", x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_div", x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_max", x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_min", x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_pow", x, y, axis, act, name)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_mod", x, y, axis, act, name)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_floordiv", x, y, axis, act, name)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random")
+    dtype = convert_np_dtype_to_dtype_(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    if not any(isinstance(s, Variable) for s in shape):
+        out.shape = tuple(int(s) for s in shape)
+    helper.append_op(type="uniform_random", outputs={"Out": [out]},
+                     attrs={"shape": [int(s) for s in shape
+                                      if not isinstance(s, Variable)],
+                            "min": float(min), "max": float(max),
+                            "seed": seed, "dtype": dtype})
+    out.stop_gradient = True
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random")
+    dtype = convert_np_dtype_to_dtype_(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = tuple(int(s) for s in shape)
+    helper.append_op(type="gaussian_random", outputs={"Out": [out]},
+                     attrs={"shape": [int(s) for s in shape],
+                            "mean": float(mean), "std": float(std),
+                            "seed": seed, "dtype": dtype})
+    out.stop_gradient = True
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    out.shape = (_prod(x.shape[:axis]), _prod(x.shape[axis:]))
+    helper.append_op(type="flatten2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axis": axis})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = tuple(s + paddings[2 * i] + paddings[2 * i + 1] if s >= 0 else -1
+                      for i, s in enumerate(x.shape))
+    helper.append_op(type="pad", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"paddings": list(paddings),
+                            "pad_value": float(pad_value)})
+    return out
+
+
+def pad2d(input, paddings=[0, 0, 0, 0], mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    helper = LayerHelper("pad2d", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="pad2d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"paddings": list(paddings), "mode": mode,
+                            "pad_value": float(pad_value),
+                            "data_format": data_format})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", **locals())
+    out = helper.create_variable_for_type_inference(label.dtype)
+    out.shape = label.shape
+    inputs = {"X": [label]}
+    if prior_dist is not None:
+        inputs["PriorDist"] = [prior_dist]
+    helper.append_op(type="label_smooth", inputs=inputs,
+                     outputs={"Out": [out]}, attrs={"epsilon": float(epsilon)})
+    return out
+
+
+def where(condition):
+    helper = LayerHelper("where_index")
+    out = helper.create_variable_for_type_inference(VarDesc.VarType.INT64)
+    helper.append_op(type="where_index", inputs={"Condition": [condition]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sign(x):
+    helper = LayerHelper("sign")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="sign", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    helper = LayerHelper("shard_index")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type="shard_index", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"index_num": index_num, "nshards": nshards,
+                            "shard_id": shard_id,
+                            "ignore_value": ignore_value})
+    return out
+
+
+def relu(x, name=None):
+    helper = LayerHelper("relu", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="relu", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def _logical(op_type, x, y, out=None, name=None):
+    helper = LayerHelper(op_type, name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(VarDesc.VarType.BOOL)
+        out.shape = x.shape
+    ins = {"X": [x]} if y is None else {"X": [x], "Y": [y]}
+    helper.append_op(type=op_type, inputs=ins, outputs={"Out": [out]})
+    return out
+
+
+def logical_and(x, y, out=None, name=None):
+    return _logical("logical_and", x, y, out, name)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _logical("logical_or", x, y, out, name)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _logical("logical_xor", x, y, out, name)
+
+
+def logical_not(x, out=None, name=None):
+    return _logical("logical_not", x, None, out, name)
+
+
+def shape(input):
+    helper = LayerHelper("shape")
+    out = helper.create_variable_for_type_inference(VarDesc.VarType.INT32)
+    out.shape = (len(input.shape),)
+    helper.append_op(type="shape", inputs={"Input": [input]},
+                     outputs={"Out": [out]})
+    out.stop_gradient = True
+    return out
+
+
+def rank(input):
+    from .tensor import assign
+    return assign(np.asarray([len(input.shape)], np.int32))
+
+
+def size(input):
+    helper = LayerHelper("size")
+    out = helper.create_variable_for_type_inference(VarDesc.VarType.INT64)
+    helper.append_op(type="size", inputs={"Input": [input]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    # LoD is host metadata — compiled path treats data unchanged
+    from .tensor import assign
+    return assign(x)
+
+
+def lod_append(x, level):
+    from .tensor import assign
+    return assign(x)
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1, data_format="NCHW"):
+    helper = LayerHelper("image_resize", **locals())
+    op_type = {"BILINEAR": "bilinear_interp", "NEAREST": "nearest_interp",
+               "TRILINEAR": "trilinear_interp"}[resample.upper()]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"align_corners": align_corners, "align_mode": align_mode,
+             "interp_method": op_type.split("_")[0],
+             "data_layout": data_format}
+    inputs = {"X": [input]}
+    if out_shape is not None:
+        if isinstance(out_shape, Variable):
+            inputs["OutSize"] = [out_shape]
+            attrs.update({"out_h": -1, "out_w": -1, "scale": 0.0})
+        else:
+            attrs.update({"out_h": int(out_shape[0]),
+                          "out_w": int(out_shape[1]), "scale": 0.0})
+            out.shape = (input.shape[0], input.shape[1],
+                         int(out_shape[0]), int(out_shape[1]))
+    else:
+        attrs.update({"out_h": -1, "out_w": -1, "scale": float(scale)})
+    helper.append_op(type=op_type, inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True, data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        actual_shape, align_corners, 1, data_format)
+
+
+def grid_sampler(x, grid, name=None):
+    raise NotImplementedError("grid_sampler: pending Pallas gather kernel")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    helper = LayerHelper("unfold", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="unfold", inputs={"X": [x]}, outputs={"Y": [out]},
+                     attrs={"kernel_sizes": _pair(kernel_sizes),
+                            "strides": _pair(strides),
+                            "paddings": _pair(paddings, 4)
+                            if isinstance(paddings, int) else list(paddings),
+                            "dilations": _pair(dilations)})
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    return crop_tensor(x, shape, offsets, name)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop_tensor", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    attrs = {}
+    if isinstance(shape, (list, tuple)):
+        attrs["shape"] = [int(s) for s in shape]
+        out.shape = tuple(attrs["shape"])
+    if isinstance(offsets, (list, tuple)):
+        attrs["offsets"] = [int(o) for o in offsets]
+    elif offsets is None:
+        attrs["offsets"] = [0] * len(x.shape)
+    helper.append_op(type="crop_tensor", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def sum(x):
+    from .tensor import sums
+    return sums(x if isinstance(x, (list, tuple)) else [x])
+
+
+def cast_(x, dtype):
+    from .tensor import cast
+    return cast(x, dtype)
+
+
+def maxout(x, groups, name=None, axis=1):
+    helper = LayerHelper("maxout", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="maxout", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"groups": groups, "axis": axis})
+    return out
+
+
+def space_to_depth(x, blocksize, name=None):
+    helper = LayerHelper("space_to_depth", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="space_to_depth", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"blocksize": blocksize})
+    return out
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
+                   act=None):
+    helper = LayerHelper("affine_channel", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="affine_channel",
+                     inputs={"X": [x], "Scale": [scale], "Bias": [bias]},
+                     outputs={"Out": [out]},
+                     attrs={"data_layout": data_layout})
+    return helper.append_activation(out)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    raise NotImplementedError("similarity_focus: rarely-used; pending")
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    raise NotImplementedError("hash op pending host-side impl")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    helper = LayerHelper("log_loss", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type="log_loss",
+                     inputs={"Predicted": [input], "Labels": [label]},
+                     outputs={"Loss": [out]}, attrs={"epsilon": epsilon})
+    return out
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    helper = LayerHelper("add_position_encoding", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type="add_position_encoding", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"alpha": float(alpha), "beta": float(beta)})
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", **locals())
+    dtype = helper.input_dtype("x") if False else x.dtype
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[size, x.shape[1], y.shape[1]],
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = (x.shape[0], size)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if helper.bias_attr:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=[1, size],
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def merge_selected_rows(x, name=None):
+    helper = LayerHelper("merge_selected_rows", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="merge_selected_rows", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    helper = LayerHelper("get_tensor_from_selected_rows", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="get_tensor_from_selected_rows", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    from .py_func_registry import register_callable
+    helper = LayerHelper("py_func")
+    fid = register_callable(func)
+    bid = register_callable(backward_func) if backward_func else -1
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    helper.append_op(type="py_func", inputs={"X": list(xs)},
+                     outputs={"Out": list(outs)},
+                     attrs={"forward_callable_id": fid,
+                            "backward_callable_id": bid})
+    return out
+
+
+def pixel_shuffle(x, upscale_factor):
+    helper = LayerHelper("pixel_shuffle")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="pixel_shuffle", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"upscale_factor": upscale_factor})
+    return out
+
+
+def fsp_matrix(x, y):
+    helper = LayerHelper("fsp_matrix")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="fsp", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    helper = LayerHelper("cvm")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="cvm", inputs={"X": [input], "CVM": [cvm]},
+                     outputs={"Y": [out]}, attrs={"use_cvm": use_cvm})
+    return out
+
+
+def unique(x, dtype="int32"):
+    helper = LayerHelper("unique")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(
+        convert_np_dtype_to_dtype_(dtype))
+    helper.append_op(type="unique", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index]},
+                     attrs={"dtype": convert_np_dtype_to_dtype_(dtype)})
+    return out, index
+
+
+def unique_with_counts(x, dtype="int32"):
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(
+        convert_np_dtype_to_dtype_(dtype))
+    count = helper.create_variable_for_type_inference(
+        convert_np_dtype_to_dtype_(dtype))
+    helper.append_op(type="unique_with_counts", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index],
+                              "Count": [count]},
+                     attrs={"dtype": convert_np_dtype_to_dtype_(dtype)})
+    return out, index, count
+
+
+interpolate = image_resize
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1_loss")
+    diff = helper.create_variable_for_type_inference(x.dtype)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    loss.shape = (x.shape[0], 1)
+    inputs = {"X": [x], "Y": [y]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight]
+    helper.append_op(type="smooth_l1_loss", inputs=inputs,
+                     outputs={"Diff": [diff], "Out": [loss]},
+                     attrs={"sigma": sigma if sigma is not None else 1.0})
+    return loss
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    out.shape = inputs[0].shape
+    helper.append_op(type="multiplex",
+                     inputs={"X": list(inputs), "Ids": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def _act_layer(op_type, x, attrs=None, name=None):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type=op_type, inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs=attrs or {})
+    return out
+
+
+def prelu(x, mode, param_attr=None, name=None):
+    helper = LayerHelper("prelu", **locals())
+    alpha_shape = [1]
+    if mode == "channel":
+        alpha_shape = [1, x.shape[1], 1, 1] if False else [x.shape[1]]
+    elif mode == "element":
+        alpha_shape = list(x.shape[1:])
+    alpha = helper.create_parameter(attr=helper.param_attr, shape=alpha_shape,
+                                    dtype=x.dtype,
+                                    default_initializer=Constant(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="prelu", inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]}, attrs={"mode": mode})
+    return out
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _act_layer("brelu", x, {"t_min": t_min, "t_max": t_max}, name)
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    return _act_layer("leaky_relu", x, {"alpha": alpha}, name)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _act_layer("soft_relu", x, {"threshold": threshold}, name)
+
+
+def swish(x, beta=1.0, name=None):
+    return _act_layer("swish", x, {"beta": beta}, name)
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    return _act_layer("hard_swish", x,
+                      {"threshold": threshold, "scale": scale,
+                       "offset": offset}, name)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _act_layer("elu", x, {"alpha": alpha}, name)
+
+
+def relu6(x, threshold=6.0, name=None):
+    return _act_layer("relu6", x, {"threshold": threshold}, name)
+
+
+def pow(x, factor=1.0, name=None):
+    return _act_layer("pow", x, {"factor": factor}, name)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _act_layer("stanh", x, {"scale_a": scale_a, "scale_b": scale_b},
+                      name)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _act_layer("hard_sigmoid", x, {"slope": slope, "offset": offset},
+                      name)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    raise NotImplementedError("im2sequence: pending sequence-op batch")
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", **locals())
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[future_context_size + 1,
+                                       input.shape[-1]],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type="row_conv", inputs={"X": [input], "Filter": [w]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    helper = LayerHelper("global_step_counter")
+    counter_name = counter_name or "@STEP_COUNTER@"
+    counter = helper.create_or_get_global_variable(
+        name=counter_name, dtype=VarDesc.VarType.INT64, shape=[1],
+        persistable=True)
+    if not getattr(counter, "_step_init", False):
+        helper.set_variable_initializer(counter, Constant(float(begin - 1)))
+        counter._step_init = True
+        helper.main_program.global_block()._prepend_op(
+            type="increment", inputs={"X": [counter]},
+            outputs={"Out": [counter]}, attrs={"step": float(step)})
+        counter.stop_gradient = True
+    return counter
+
+
+def roll(input, shifts, dims=None):
+    helper = LayerHelper("roll")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type="roll", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"shifts": shifts if isinstance(shifts, list)
+                            else [shifts],
+                            "dims": dims if isinstance(dims, list)
+                            else ([dims] if dims is not None else [])})
+    return out
+
+
+def index_select(input, index, dim=0):
+    helper = LayerHelper("index_select")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="index_select",
+                     inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]}, attrs={"dim": dim})
+    return out
+
+
+def index_sample(x, index):
+    helper = LayerHelper("index_sample")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = index.shape
+    helper.append_op(type="index_sample",
+                     inputs={"X": [x], "Index": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    helper = LayerHelper("temporal_shift", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="temporal_shift", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"seg_num": seg_num, "shift_ratio": shift_ratio})
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    raise NotImplementedError("spectral_norm: pending")
+
+
+def random_crop(x, shape, seed=None):
+    raise NotImplementedError("random_crop: pending")
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    iou = helper.create_variable_for_type_inference(VarDesc.VarType.FP32)
+    out_wrong = helper.create_variable_for_type_inference(VarDesc.VarType.INT32)
+    out_correct = helper.create_variable_for_type_inference(VarDesc.VarType.INT32)
+    helper.append_op(type="mean_iou",
+                     inputs={"Predictions": [input], "Labels": [label]},
+                     outputs={"OutMeanIou": [iou], "OutWrong": [out_wrong],
+                              "OutCorrect": [out_correct]},
+                     attrs={"num_classes": num_classes})
+    return iou, out_wrong, out_correct
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    from . import loss as _  # noqa
+    label = one_hot(label, depth=input.shape[-1])
+    reduce_dims = list(range(1, len(input.shape)))
+    inse = reduce_sum(input * label, dim=reduce_dims)
+    dice_denominator = reduce_sum(input, dim=reduce_dims) + reduce_sum(
+        label, dim=reduce_dims)
+    dice_score = 1 - inse * 2 / (dice_denominator + epsilon)
+    return mean(dice_score)

@@ -1,0 +1,68 @@
+"""Generated activation-style layer wrappers (reference:
+python/paddle/fluid/layers/ops.py via layer_function_generator.py)."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "sigmoid", "logsigmoid", "exp", "tanh", "atan", "tanh_shrink",
+    "softshrink", "sqrt", "rsqrt", "abs", "ceil", "floor", "cos", "acos",
+    "asin", "sin", "sinh", "cosh", "round", "reciprocal", "square",
+    "softplus", "softsign", "erf", "gelu", "hard_shrink", "thresholded_relu",
+    "log", "log1p", "cumsum", "selu",
+]
+
+
+def _make_act(op_type, extra_attrs=()):
+    def layer(x, name=None, **kwargs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        out.shape = x.shape
+        attrs = {k: kwargs[k] for k in extra_attrs if k in kwargs}
+        helper.append_op(type=op_type, inputs={"X": [x]},
+                         outputs={"Out": [out]}, attrs=attrs)
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+sigmoid = _make_act("sigmoid")
+logsigmoid = _make_act("logsigmoid")
+exp = _make_act("exp")
+tanh = _make_act("tanh")
+atan = _make_act("atan")
+tanh_shrink = _make_act("tanh_shrink")
+softshrink = _make_act("softshrink", ("lambda",))
+sqrt = _make_act("sqrt")
+rsqrt = _make_act("rsqrt")
+abs = _make_act("abs")
+ceil = _make_act("ceil")
+floor = _make_act("floor")
+cos = _make_act("cos")
+acos = _make_act("acos")
+asin = _make_act("asin")
+sin = _make_act("sin")
+sinh = _make_act("sinh")
+cosh = _make_act("cosh")
+round = _make_act("round")
+reciprocal = _make_act("reciprocal")
+square = _make_act("square")
+softplus = _make_act("softplus")
+softsign = _make_act("softsign")
+erf = _make_act("erf")
+gelu = _make_act("gelu", ("approximate",))
+hard_shrink = _make_act("hard_shrink", ("threshold",))
+thresholded_relu = _make_act("thresholded_relu", ("threshold",))
+log = _make_act("log")
+log1p = _make_act("log1p")
+selu = _make_act("selu", ("scale", "alpha"))
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False, name=None):
+    helper = LayerHelper("cumsum", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="cumsum", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": axis, "exclusive": exclusive,
+                            "reverse": reverse})
+    return out

@@ -1,0 +1,14 @@
+"""Registry backing the py_func op (reference: operators/py_func_op.cc keeps
+a global callable vector; same idea host-side)."""
+from __future__ import annotations
+
+_CALLABLES = []
+
+
+def register_callable(fn) -> int:
+    _CALLABLES.append(fn)
+    return len(_CALLABLES) - 1
+
+
+def get_callable(idx: int):
+    return _CALLABLES[idx]

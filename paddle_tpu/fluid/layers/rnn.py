@@ -1,0 +1,152 @@
+"""RNN cells and decoding (reference: python/paddle/fluid/layers/rnn.py —
+RNNCell/GRUCell/LSTMCell, rnn(), dynamic_decode, BeamSearchDecoder).
+TPU design: static-length scan (padded) is the fast path; rnn() builds the
+unrolled/scan graph. Round-1 ships cells + static rnn; dynamic_decode and
+beam search land with the seq2seq batch."""
+from __future__ import annotations
+
+__all__ = [
+    "RNNCell", "GRUCell", "LSTMCell", "rnn", "Decoder", "BeamSearchDecoder",
+    "dynamic_decode", "dynamic_lstm", "dynamic_lstmp", "dynamic_gru",
+    "gru_unit", "lstm_unit", "lstm", "beam_search", "beam_search_decode",
+]
+
+from .. import layers as _L  # noqa — resolved lazily below
+from ..layer_helper import LayerHelper
+
+
+class RNNCell:
+    def call(self, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, inputs, states, **kwargs):
+        return self.call(inputs, states, **kwargs)
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        from .nn import fill_constant_batch_size_like
+        shape = shape or self.state_shape
+        if isinstance(shape[0], (list, tuple)):
+            return [fill_constant_batch_size_like(
+                batch_ref, [-1] + list(s), dtype, init_value) for s in shape]
+        return fill_constant_batch_size_like(
+            batch_ref, [-1] + list(shape), dtype, init_value)
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError
+
+
+class GRUCell(RNNCell):
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, dtype="float32",
+                 name="GRUCell"):
+        self.hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._dtype = dtype
+        self._name = name
+
+    def call(self, inputs, states):
+        from .nn import fc, elementwise_add, elementwise_mul, split
+        from . import ops
+        h = states
+        gates = fc([inputs, h], 3 * self.hidden_size,
+                   param_attr=self._param_attr, bias_attr=self._bias_attr)
+        r, z, c = split(gates, 3, dim=-1)
+        r, z = ops.sigmoid(r), ops.sigmoid(z)
+        c = ops.tanh(c)
+        new_h = z * h + (1.0 - z) * c
+        return new_h, new_h
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+
+class LSTMCell(RNNCell):
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, forget_bias=1.0,
+                 dtype="float32", name="LSTMCell"):
+        self.hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._forget_bias = forget_bias
+        self._dtype = dtype
+
+    def call(self, inputs, states):
+        from .nn import fc, split
+        from . import ops
+        h, c = states
+        gates = fc([inputs, h], 4 * self.hidden_size,
+                   param_attr=self._param_attr, bias_attr=self._bias_attr)
+        i, f, o, j = split(gates, 4, dim=-1)
+        i, f, o = ops.sigmoid(i), ops.sigmoid(f + self._forget_bias), ops.sigmoid(o)
+        j = ops.tanh(j)
+        new_c = c * f + i * j
+        new_h = ops.tanh(new_c) * o
+        return new_h, [new_h, new_c]
+
+    @property
+    def state_shape(self):
+        return [[self.hidden_size], [self.hidden_size]]
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Static unrolled RNN over padded input [B, T, D] (or [T, B, D] when
+    time_major). XLA unrolls into a fused loop; for long T prefer the scan
+    path (models/ use lax.scan via dygraph)."""
+    from .nn import transpose, stack, unstack
+    from .tensor import concat
+    if initial_states is None:
+        initial_states = cell.get_initial_states(inputs)
+    if not time_major:
+        inputs_t = transpose(inputs, [1, 0] + list(range(2, len(inputs.shape))))
+    else:
+        inputs_t = inputs
+    steps = unstack(inputs_t, axis=0)
+    if is_reverse:
+        steps = steps[::-1]
+    states = initial_states
+    outs = []
+    for x_t in steps:
+        o, states = cell(x_t, states, **kwargs)
+        outs.append(o)
+    if is_reverse:
+        outs = outs[::-1]
+    outputs = stack(outs, axis=0)
+    if not time_major:
+        outputs = transpose(outputs,
+                            [1, 0] + list(range(2, len(outputs.shape))))
+    return outputs, states
+
+
+class Decoder:
+    pass
+
+
+class BeamSearchDecoder(Decoder):
+    def __init__(self, *a, **k):
+        raise NotImplementedError("BeamSearchDecoder: seq2seq batch pending")
+
+
+def dynamic_decode(*a, **k):
+    raise NotImplementedError("dynamic_decode: seq2seq batch pending")
+
+
+def _nyi(name):
+    def fn(*a, **k):
+        raise NotImplementedError(f"{name}: LoD RNN pending; use rnn()/cells")
+    fn.__name__ = name
+    return fn
+
+
+dynamic_lstm = _nyi("dynamic_lstm")
+dynamic_lstmp = _nyi("dynamic_lstmp")
+dynamic_gru = _nyi("dynamic_gru")
+gru_unit = _nyi("gru_unit")
+lstm_unit = _nyi("lstm_unit")
+lstm = _nyi("lstm")
+beam_search = _nyi("beam_search")
+beam_search_decode = _nyi("beam_search_decode")

@@ -1,0 +1,60 @@
+"""Sequence layers over LoD tensors (reference:
+python/paddle/fluid/layers/sequence_lod.py). TPU strategy: ragged sequences
+run as padded/packed dense ops (sequence_pad/unpad/mask are the bridge);
+true LoD-dependent ops execute in interpreter mode where LoD metadata is
+host-side. Round-1 provides the padded-path ops; LoD-interpreted ops land
+with the sequence batch."""
+from __future__ import annotations
+
+from ..core import VarDesc
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "sequence_conv", "sequence_softmax", "sequence_pool", "sequence_concat",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate", "sequence_mask", "sequence_reverse",
+]
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ..core import convert_np_dtype_to_dtype_
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(
+        convert_np_dtype_to_dtype_(dtype))
+    inputs = {"X": [x]}
+    attrs = {"out_dtype": convert_np_dtype_to_dtype_(dtype)}
+    if maxlen is not None and not isinstance(maxlen, (int,)):
+        inputs["MaxLenTensor"] = [maxlen]
+        attrs["maxlen"] = -1
+    else:
+        attrs["maxlen"] = maxlen if maxlen is not None else -1
+    helper.append_op(type="sequence_mask", inputs=inputs,
+                     outputs={"Y": [out]}, attrs=attrs)
+    return out
+
+
+def _nyi(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"{name}: LoD sequence op pending (interpreter batch)")
+    fn.__name__ = name
+    return fn
+
+
+sequence_conv = _nyi("sequence_conv")
+sequence_softmax = _nyi("sequence_softmax")
+sequence_pool = _nyi("sequence_pool")
+sequence_concat = _nyi("sequence_concat")
+sequence_first_step = _nyi("sequence_first_step")
+sequence_last_step = _nyi("sequence_last_step")
+sequence_slice = _nyi("sequence_slice")
+sequence_expand = _nyi("sequence_expand")
+sequence_expand_as = _nyi("sequence_expand_as")
+sequence_pad = _nyi("sequence_pad")
+sequence_unpad = _nyi("sequence_unpad")
+sequence_reshape = _nyi("sequence_reshape")
+sequence_scatter = _nyi("sequence_scatter")
+sequence_enumerate = _nyi("sequence_enumerate")
+sequence_reverse = _nyi("sequence_reverse")

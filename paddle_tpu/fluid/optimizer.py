@@ -1,0 +1,639 @@
+"""Optimizers (reference: python/paddle/fluid/optimizer.py — Optimizer:55,
+SGD:842, Momentum:936, Adagrad:1598, Adam:1714, Adamax:1980, Dpsgd:2152,
+DecayedAdagrad:2247, Adadelta:2357, RMSProp:2476, Ftrl:2664, Lamb:2823,
+LarsMomentum:1484, ModelAverage:2995, ExponentialMovingAverage:3302,
+RecomputeOptimizer:3850, LookaheadOptimizer:4138, PipelineOptimizer:3550).
+
+``minimize`` = append_backward + regularization + grad clip + one update op
+per parameter — identical contract to the reference. On TPU the whole
+optimizer pass lives inside the jitted step, so "fuse_all_optimizer_ops"
+style passes are unnecessary: XLA fuses them.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import core, unique_name
+from .backward import append_backward, OP_ROLE_OPTIMIZE
+from .clip import append_gradient_clip_ops
+from .core import VarDesc
+from .framework import (Parameter, Program, Variable, default_main_program,
+                        default_startup_program, in_dygraph_mode,
+                        program_guard)
+from .initializer import Constant
+from .layer_helper import LayerHelper
+from .regularizer import append_regularization_ops
+
+__all__ = [
+    "SGD", "Momentum", "Adagrad", "Adam", "Adamax", "Dpsgd", "DecayedAdagrad",
+    "Ftrl", "SGDOptimizer", "MomentumOptimizer", "AdagradOptimizer",
+    "AdamOptimizer", "AdamaxOptimizer", "DpsgdOptimizer",
+    "DecayedAdagradOptimizer", "RMSPropOptimizer", "FtrlOptimizer", "Adadelta",
+    "AdadeltaOptimizer", "ModelAverage", "LarsMomentum",
+    "LarsMomentumOptimizer", "LambOptimizer", "ExponentialMovingAverage",
+    "PipelineOptimizer", "LookaheadOptimizer", "RecomputeOptimizer",
+]
+
+
+class Optimizer:
+    """Base (reference optimizer.py:55)."""
+
+    def __init__(self, learning_rate, parameter_list=None,
+                 regularization=None, name=None, grad_clip=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = parameter_list
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name
+        self._learning_rate_map: Dict[int, Variable] = {}
+        self._accumulators: Dict[str, Dict[str, Variable]] = defaultdict(dict)
+        self.helper = None
+        self.type = getattr(self, "type", "sgd")
+
+    # ------------------------------------------------------------- lr
+    def _create_global_learning_rate(self):
+        if in_dygraph_mode():
+            if not hasattr(self, "_dygraph_lr_var"):
+                from .dygraph.base import VarBase
+                import jax.numpy as jnp
+                lr = self._learning_rate
+                if callable(lr) and not isinstance(lr, Variable):
+                    lr = lr()
+                val = lr.array if hasattr(lr, "array") else float(lr)
+                self._dygraph_lr_var = VarBase(
+                    jnp.asarray(val, jnp.float32).reshape(1),
+                    stop_gradient=True)
+            return
+        program = default_main_program()
+        lr = self._learning_rate_map.get(id(program))
+        if lr is not None:
+            return
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_map[id(program)] = self._learning_rate
+            return
+        lr_name = unique_name.generate("learning_rate")
+        lr_var = program.global_block().create_var(
+            name=lr_name, shape=(1,), persistable=True,
+            dtype=VarDesc.VarType.FP32)
+        lr_var.stop_gradient = True
+        startup = default_startup_program().global_block()
+        sv = startup.create_var(name=lr_name, shape=(1,), persistable=True,
+                                dtype=VarDesc.VarType.FP32)
+        Constant(float(self._learning_rate))(sv, startup)
+        self._learning_rate_map[id(program)] = lr_var
+
+    def _global_learning_rate(self, program=None):
+        if in_dygraph_mode():
+            return self._dygraph_lr_var
+        if program is None:
+            program = default_main_program()
+        return self._learning_rate_map.get(id(program))
+
+    def _create_param_lr(self, param_and_grad):
+        param = param_and_grad[0]
+        base = self._global_learning_rate()
+        plr = (param.optimize_attr or {}).get("learning_rate", 1.0)
+        if plr == 1.0:
+            return base
+        from .layers import nn as _nn
+        return _nn._act_layer("scale", base, {"scale": float(plr)})
+
+    # ----------------------------------------------------- accumulators
+    def _add_accumulator(self, name, param, dtype=None, fill_value=0.0,
+                         shape=None):
+        if param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        if in_dygraph_mode():
+            from .dygraph.base import VarBase
+            import jax.numpy as jnp
+            from .core import dtype_to_jnp
+            shp = shape if shape is not None else param.shape
+            acc = VarBase(jnp.full([int(s) for s in shp], float(fill_value),
+                                   dtype_to_jnp(dtype or param.dtype)),
+                          stop_gradient=True, persistable=True)
+            self._accumulators[name][param.name] = acc
+            return acc
+        block = default_main_program().global_block()
+        var_name = unique_name.generate(param.name + "_" + name)
+        shape = shape if shape is not None else param.shape
+        var = block.create_var(name=var_name, shape=shape, persistable=True,
+                               dtype=dtype or param.dtype,
+                               belong_to_optimizer=True)
+        var.stop_gradient = True
+        startup = default_startup_program().global_block()
+        sv = startup.create_var(name=var_name, shape=shape, persistable=True,
+                                dtype=dtype or param.dtype)
+        Constant(float(fill_value))(sv, startup)
+        self._accumulators[name][param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # ------------------------------------------------------------- api
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _finish_update(self, block, parameters_and_grads):
+        pass
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        if in_dygraph_mode():
+            from .dygraph.base import _dygraph_backward
+            return _dygraph_backward(self, loss, parameter_list
+                                     or self._parameter_list)
+        return append_backward(loss, parameter_list or self._parameter_list,
+                               no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        params_grads = sorted(params_grads, key=lambda x: x[0].name)
+        if self._grad_clip is not None:
+            params_grads = [self._grad_clip._process(p, g) if g is not None
+                            else (p, g) for p, g in params_grads] \
+                if not hasattr(self._grad_clip, "_process_group") \
+                else self._grad_clip._process_group(params_grads)
+        else:
+            params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        return self._create_optimization_pass(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        with program_guard(default_main_program(),
+                           startup_program or default_startup_program()):
+            return self.apply_gradients(params_grads)
+
+    def _create_optimization_pass(self, parameters_and_grads):
+        block = default_main_program().global_block()
+        self.helper = LayerHelper(self.__class__.__name__)
+        self._create_global_learning_rate()
+        self._create_accumulators(
+            block, [p for p, g in parameters_and_grads if g is not None])
+        ops = []
+        for param_and_grad in parameters_and_grads:
+            if param_and_grad[1] is None:
+                continue
+            if param_and_grad[0].trainable:
+                op = self._append_optimize_op(block, param_and_grad)
+                if hasattr(op, "attrs"):
+                    op.attrs["op_role"] = OP_ROLE_OPTIMIZE
+                    op.attrs["op_role_var"] = [param_and_grad[0].name,
+                                               param_and_grad[1].name]
+                ops.append(op)
+        self._finish_update(block, parameters_and_grads)
+        return ops
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        if in_dygraph_mode():
+            from .dygraph.base import _dygraph_minimize
+            return _dygraph_minimize(self, loss, startup_program,
+                                     parameter_list or self._parameter_list,
+                                     no_grad_set)
+        with program_guard(loss.block.program,
+                           startup_program or default_startup_program()):
+            params_grads = self.backward(loss, startup_program,
+                                         parameter_list, no_grad_set)
+            optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    # dygraph helpers
+    def set_dict(self, state_dict):
+        self._dygraph_state = dict(state_dict)
+
+    def state_dict(self):
+        return getattr(self, "_dygraph_state", {})
+
+    def current_step_lr(self):
+        lr = self._learning_rate
+        return float(lr) if not isinstance(lr, Variable) else lr
+
+    def clear_gradients(self):
+        from .dygraph.base import _clear_gradients
+        _clear_gradients(self._parameter_list)
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, learning_rate, parameter_list=None,
+                 regularization=None, name=None, grad_clip=None):
+        super().__init__(learning_rate, parameter_list, regularization, name,
+                         grad_clip)
+        self.type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        return block.append_op(
+            type="sgd",
+            inputs={"Param": [param_and_grad[0]], "Grad": [param_and_grad[1]],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]]})
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, parameter_list=None,
+                 use_nesterov=False, regularization=None, name=None,
+                 grad_clip=None):
+        super().__init__(learning_rate, parameter_list, regularization, name,
+                         grad_clip)
+        self.type = "momentum"
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        velocity = self._get_accumulator("velocity", param_and_grad[0])
+        return block.append_op(
+            type="momentum",
+            inputs={"Param": [param_and_grad[0]], "Grad": [param_and_grad[1]],
+                    "Velocity": [velocity],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]],
+                     "VelocityOut": [velocity]},
+            attrs={"mu": self._momentum,
+                   "use_nesterov": self._use_nesterov})
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameter_list=None,
+                 regularization=None, name=None, grad_clip=None):
+        super().__init__(learning_rate, parameter_list, regularization, name,
+                         grad_clip)
+        self.type = "lars_momentum"
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        velocity = self._get_accumulator("velocity", param_and_grad[0])
+        return block.append_op(
+            type="lars_momentum",
+            inputs={"Param": [param_and_grad[0]], "Grad": [param_and_grad[1]],
+                    "Velocity": [velocity],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]],
+                     "VelocityOut": [velocity]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay})
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameter_list=None,
+                 regularization=None, name=None, initial_accumulator_value=0.0,
+                 grad_clip=None):
+        super().__init__(learning_rate, parameter_list, regularization, name,
+                         grad_clip)
+        self.type = "adagrad"
+        self._epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p,
+                                  fill_value=self.initial_accumulator_value)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator("moment", param_and_grad[0])
+        return block.append_op(
+            type="adagrad",
+            inputs={"Param": [param_and_grad[0]], "Grad": [param_and_grad[1]],
+                    "Moment": [moment],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]], "MomentOut": [moment]},
+            attrs={"epsilon": self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameter_list=None, regularization=None,
+                 name=None, lazy_mode=False, grad_clip=None):
+        super().__init__(learning_rate, parameter_list, regularization, name,
+                         grad_clip)
+        self.type = "adam"
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1
+                                  if not isinstance(self._beta1, Variable)
+                                  else 0.9, shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2
+                                  if not isinstance(self._beta2, Variable)
+                                  else 0.999, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        m1 = self._get_accumulator("moment1", param_and_grad[0])
+        m2 = self._get_accumulator("moment2", param_and_grad[0])
+        b1p = self._get_accumulator("beta1_pow_acc", param_and_grad[0])
+        b2p = self._get_accumulator("beta2_pow_acc", param_and_grad[0])
+        return block.append_op(
+            type="adam",
+            inputs={"Param": [param_and_grad[0]], "Grad": [param_and_grad[1]],
+                    "Moment1": [m1], "Moment2": [m2],
+                    "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]],
+                     "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "lazy_mode": self._lazy_mode})
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameter_list=None, regularization=None,
+                 name=None, grad_clip=None):
+        super().__init__(learning_rate, parameter_list, regularization, name,
+                         grad_clip)
+        self.type = "adamax"
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator("moment", param_and_grad[0])
+        inf_norm = self._get_accumulator("inf_norm", param_and_grad[0])
+        b1p = self._get_accumulator("beta1_pow_acc", param_and_grad[0])
+        op = block.append_op(
+            type="adamax",
+            inputs={"Param": [param_and_grad[0]], "Grad": [param_and_grad[1]],
+                    "Moment": [moment], "InfNorm": [inf_norm],
+                    "Beta1Pow": [b1p],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]], "MomentOut": [moment],
+                     "InfNormOut": [inf_norm]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+        # scale beta1^t (reference appends scale op per step)
+        block.append_op(type="scale", inputs={"X": [b1p]},
+                        outputs={"Out": [b1p]},
+                        attrs={"scale": self._beta1,
+                               "op_role": OP_ROLE_OPTIMIZE})
+        return op
+
+
+class DpsgdOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999,
+                 sigma=1e-8, parameter_list=None):
+        super().__init__(learning_rate, parameter_list)
+        self.type = "dpsgd"
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _append_optimize_op(self, block, param_and_grad):
+        return block.append_op(
+            type="dpsgd",
+            inputs={"Param": [param_and_grad[0]], "Grad": [param_and_grad[1]],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]]},
+            attrs={"clip": self._clip, "batch_size": self._batch_size,
+                   "sigma": self._sigma})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 parameter_list=None, regularization=None, name=None,
+                 grad_clip=None):
+        super().__init__(learning_rate, parameter_list, regularization, name,
+                         grad_clip)
+        self.type = "decayed_adagrad"
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator("moment", param_and_grad[0])
+        return block.append_op(
+            type="decayed_adagrad",
+            inputs={"Param": [param_and_grad[0]], "Grad": [param_and_grad[1]],
+                    "Moment": [moment],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]], "MomentOut": [moment]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95,
+                 parameter_list=None, regularization=None, name=None,
+                 grad_clip=None):
+        super().__init__(learning_rate, parameter_list, regularization, name,
+                         grad_clip)
+        self.type = "adadelta"
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("__avg_squared_grad", p)
+            self._add_accumulator("__avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        g = self._get_accumulator("__avg_squared_grad", param_and_grad[0])
+        u = self._get_accumulator("__avg_squared_update", param_and_grad[0])
+        return block.append_op(
+            type="adadelta",
+            inputs={"Param": [param_and_grad[0]], "Grad": [param_and_grad[1]],
+                    "AvgSquaredGrad": [g], "AvgSquaredUpdate": [u]},
+            outputs={"ParamOut": [param_and_grad[0]],
+                     "AvgSquaredGradOut": [g], "AvgSquaredUpdateOut": [u]},
+            attrs={"epsilon": self._epsilon, "rho": self._rho})
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameter_list=None, regularization=None,
+                 name=None, grad_clip=None):
+        super().__init__(learning_rate, parameter_list, regularization, name,
+                         grad_clip)
+        self.type = "rmsprop"
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("momentum", p)
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        mom = self._get_accumulator("momentum", param_and_grad[0])
+        ms = self._get_accumulator("mean_square", param_and_grad[0])
+        mg = self._get_accumulator("mean_grad", param_and_grad[0])
+        return block.append_op(
+            type="rmsprop",
+            inputs={"Param": [param_and_grad[0]], "Grad": [param_and_grad[1]],
+                    "Moment": [mom], "MeanSquare": [ms], "MeanGrad": [mg],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]], "MomentOut": [mom],
+                     "MeanSquareOut": [ms], "MeanGradOut": [mg]},
+            attrs={"epsilon": self._epsilon, "decay": self._rho,
+                   "momentum": self._momentum, "centered": self._centered})
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameter_list=None, regularization=None, name=None,
+                 grad_clip=None):
+        super().__init__(learning_rate, parameter_list, regularization, name,
+                         grad_clip)
+        self.type = "ftrl"
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        sq = self._get_accumulator("squared", param_and_grad[0])
+        lin = self._get_accumulator("linear", param_and_grad[0])
+        return block.append_op(
+            type="ftrl",
+            inputs={"Param": [param_and_grad[0]], "Grad": [param_and_grad[1]],
+                    "SquaredAccumulator": [sq], "LinearAccumulator": [lin],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]],
+                     "SquaredAccumOut": [sq], "LinearAccumOut": [lin]},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   "lr_power": self._lr_power})
+
+
+class LambOptimizer(AdamOptimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameter_list=None,
+                 regularization=None, exclude_from_weight_decay_fn=None,
+                 name=None, grad_clip=None):
+        super().__init__(learning_rate=learning_rate, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon,
+                         parameter_list=parameter_list,
+                         regularization=regularization, name=name,
+                         grad_clip=grad_clip)
+        self.type = "lamb"
+        self._weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, block, param_and_grad):
+        m1 = self._get_accumulator("moment1", param_and_grad[0])
+        m2 = self._get_accumulator("moment2", param_and_grad[0])
+        b1p = self._get_accumulator("beta1_pow_acc", param_and_grad[0])
+        b2p = self._get_accumulator("beta2_pow_acc", param_and_grad[0])
+        wd = 0.0 if (self._exclude_fn is not None
+                     and self._exclude_fn(param_and_grad[0])) \
+            else self._weight_decay
+        return block.append_op(
+            type="lamb",
+            inputs={"Param": [param_and_grad[0]], "Grad": [param_and_grad[1]],
+                    "Moment1": [m1], "Moment2": [m2],
+                    "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [self._create_param_lr(param_and_grad)]},
+            outputs={"ParamOut": [param_and_grad[0]],
+                     "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "weight_decay": wd})
+
+
+class ModelAverage(Optimizer):
+    """reference optimizer.py:2995 — kept as API; apply/restore via
+    accumulated param sums."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization=regularization, name=name)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        raise NotImplementedError(
+            "ModelAverage: pending (round-2 aux-optimizer batch)")
+
+
+class ExponentialMovingAverage:
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        raise NotImplementedError(
+            "ExponentialMovingAverage: pending (round-2 aux-optimizer batch)")
+
+
+class PipelineOptimizer:
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0):
+        raise NotImplementedError(
+            "PipelineOptimizer: lands with parallel/pipeline.py (shard_map "
+            "stage schedule)")
+
+
+class RecomputeOptimizer(Optimizer):
+    """reference optimizer.py:3850 — rematerialization. On TPU this is
+    jax.checkpoint over segment boundaries; the static-graph path marks
+    checkpoint vars for the executor's segment-remat planner (pending);
+    meanwhile backward works without remat (more memory, same numerics)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        callbacks)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self._optimizer.apply_optimize(loss, startup_program,
+                                              params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
+        return optimize_ops, params_grads
+
+
+class LookaheadOptimizer:
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        raise NotImplementedError(
+            "LookaheadOptimizer: pending (round-2 aux-optimizer batch)")
+
+
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+Dpsgd = DpsgdOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+LarsMomentum = LarsMomentumOptimizer
+Lamb = LambOptimizer

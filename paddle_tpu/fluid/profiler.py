@@ -1,0 +1,59 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py over
+platform/profiler.h RecordEvent/CUPTI DeviceTracer).
+
+TPU equivalent: jax.profiler — XPlane traces viewable in TensorBoard /
+Perfetto replace the chrome://tracing timeline (reference tools/timeline.py).
+API shape preserved: profiler(...)/start_profiler/stop_profiler context."""
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+__all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
+           "stop_profiler"]
+
+_trace_dir = None
+
+
+def start_profiler(state="All", tracer_option="Default",
+                   trace_dir="/tmp/paddle_tpu_profile"):
+    global _trace_dir
+    _trace_dir = trace_dir
+    jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    jax.profiler.stop_trace()
+    if _trace_dir:
+        print(f"[profiler] XPlane trace written to {_trace_dir} "
+              f"(view with TensorBoard)")
+
+
+def reset_profiler():
+    pass
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option="Default"):
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    # accelerator profiler alias — same jax trace
+    with profiler():
+        yield
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """RecordEvent RAII span (reference platform/profiler.h:124)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -1,0 +1,147 @@
+"""DataLoader (reference: python/paddle/fluid/reader.py:100 —
+DataLoader.from_generator/from_dataset, GeneratorLoader).
+
+TPU design: the async C++ BufferedReader/py_reader double-buffering of the
+reference is replaced by a host-side prefetch thread; device transfer
+overlaps with compute because jax dispatch is async. set_sample_generator /
+set_sample_list_generator / set_batch_generator mirror the reference API."""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from . import core
+from .data_feeder import DataFeeder
+from .framework import Variable
+
+__all__ = ["DataLoader", "PyReader"]
+
+
+class _GeneratorLoader:
+    def __init__(self, feed_list, capacity=16, iterable=True,
+                 return_list=False):
+        self._feed_list = feed_list or []
+        self._capacity = capacity
+        self._iterable = iterable
+        self._return_list = return_list
+        self._batch_fn: Optional[Callable] = None
+        self._places = None
+
+    # -- reference API -----------------------------------------------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        def batch_reader():
+            batch = []
+            for sample in reader():
+                batch.append(sample if isinstance(sample, (list, tuple))
+                             else (sample,))
+                if len(batch) == batch_size:
+                    yield batch
+                    batch = []
+            if batch and not drop_last:
+                yield batch
+        return self.set_sample_list_generator(batch_reader, places)
+
+    def set_sample_list_generator(self, reader, places=None):
+        places = _first_place(places)
+        feeder = DataFeeder(self._feed_list, places)
+
+        def fn():
+            for sample_list in reader():
+                yield feeder.feed(sample_list)
+        self._batch_fn = fn
+        self._places = places
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        places = _first_place(places)
+        names = [v.name if isinstance(v, Variable) else v
+                 for v in self._feed_list]
+
+        def fn():
+            for batch in reader():
+                if isinstance(batch, dict):
+                    yield batch
+                else:
+                    if not isinstance(batch, (list, tuple)):
+                        batch = (batch,)
+                    yield {n: b for n, b in zip(names, batch)}
+        self._batch_fn = fn
+        self._places = places
+        return self
+
+    def __iter__(self):
+        assert self._batch_fn is not None, "no generator set"
+        if self._capacity <= 1:
+            yield from self._batch_fn()
+            return
+        q: "queue.Queue" = queue.Queue(self._capacity)
+        DONE = object()
+
+        def producer():
+            try:
+                for item in self._batch_fn():
+                    q.put(item)
+            finally:
+                q.put(DONE)
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is DONE:
+                break
+            yield item
+
+    def __call__(self):
+        return iter(self)
+
+    # non-iterable (start/reset) mode used with py_reader-style loops
+    def start(self):
+        self._it = iter(self)
+
+    def reset(self):
+        self._it = None
+
+
+def _first_place(places):
+    if places is None:
+        return core.TPUPlace(0) if core.is_compiled_with_tpu() \
+            else core.CPUPlace()
+    if isinstance(places, (list, tuple)):
+        return places[0]
+    return places
+
+
+class DataLoader:
+    @staticmethod
+    def from_generator(feed_list=None, capacity=16, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False, drop_last=True):
+        return _GeneratorLoader(feed_list, capacity, iterable, return_list)
+
+    @staticmethod
+    def from_dataset(dataset, places, drop_last=True):
+        from .dataset_loader import DatasetLoader
+        return DatasetLoader(dataset, places, drop_last)
+
+
+class PyReader(_GeneratorLoader):
+    """reference reader.py PyReader — same loader, py_reader-era name."""
+
+    def __init__(self, feed_list=None, capacity=16, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        super().__init__(feed_list, capacity, iterable, return_list)
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        return self.set_sample_generator(sample_generator, batch_size,
+                                         drop_last, places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.set_batch_generator(reader, places)

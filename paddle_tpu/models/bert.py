@@ -1,0 +1,138 @@
+"""BERT-base / transformer encoder built on the fluid layers API
+(reference models: the transformer encoder used by
+python/paddle/fluid/tests/unittests/test_imperative_transformer* and the
+ERNIE/BERT configs named in BASELINE.md; fused attention replaces the
+reference's fused/multihead_matmul_op.cu).
+
+Attention goes through the `multihead_matmul` op, which dispatches to the
+Pallas flash-attention kernel on TPU (ops/pallas/flash_attention.py) and a
+plain jax composition elsewhere."""
+from __future__ import annotations
+
+import math
+
+from .. import fluid
+from ..fluid import layers
+from ..fluid.framework import Variable
+from ..fluid.layer_helper import LayerHelper
+from ..fluid.param_attr import ParamAttr
+
+__all__ = ["multi_head_attention", "encoder_layer", "encoder",
+           "bert_base_config", "build_bert_pretrain_program"]
+
+
+def bert_base_config():
+    return dict(vocab_size=30522, hidden=768, layers=12, heads=12,
+                ffn=3072, max_len=512, type_vocab=2)
+
+
+def fused_multihead_attention(q, k, v, n_head, dropout_rate=0.0):
+    """One fused attention op (Pallas on TPU). q/k/v: [B, S, H]."""
+    helper = LayerHelper("multihead_matmul")
+    out = helper.create_variable_for_type_inference(q.dtype)
+    out.shape = q.shape
+    helper.append_op(type="fused_attention_qkv",
+                     inputs={"Q": [q], "K": [k], "V": [v]},
+                     outputs={"Out": [out]},
+                     attrs={"num_heads": n_head,
+                            "dropout_rate": dropout_rate})
+    return out
+
+
+def multi_head_attention(queries, keys, values, d_model, n_head,
+                         dropout_rate=0.0, param_initializer=None):
+    keys = queries if keys is None else keys
+    values = keys if values is None else values
+    q = layers.fc(queries, d_model, num_flatten_dims=2,
+                  param_attr=ParamAttr(initializer=param_initializer))
+    k = layers.fc(keys, d_model, num_flatten_dims=2,
+                  param_attr=ParamAttr(initializer=param_initializer))
+    v = layers.fc(values, d_model, num_flatten_dims=2,
+                  param_attr=ParamAttr(initializer=param_initializer))
+    ctx = fused_multihead_attention(q, k, v, n_head, dropout_rate)
+    return layers.fc(ctx, d_model, num_flatten_dims=2,
+                     param_attr=ParamAttr(initializer=param_initializer))
+
+
+def positionwise_ffn(x, d_inner, d_model, dropout_rate=0.0,
+                     param_initializer=None):
+    h = layers.fc(x, d_inner, num_flatten_dims=2, act="gelu",
+                  param_attr=ParamAttr(initializer=param_initializer))
+    if dropout_rate:
+        h = layers.dropout(h, dropout_rate,
+                           dropout_implementation="upscale_in_train")
+    return layers.fc(h, d_model, num_flatten_dims=2,
+                     param_attr=ParamAttr(initializer=param_initializer))
+
+
+def _add_norm(x, y, dropout_rate=0.0):
+    if dropout_rate:
+        y = layers.dropout(y, dropout_rate,
+                           dropout_implementation="upscale_in_train")
+    return layers.layer_norm(layers.elementwise_add(x, y),
+                             begin_norm_axis=len(x.shape) - 1)
+
+
+def encoder_layer(x, d_model, n_head, d_inner, dropout_rate=0.0,
+                  param_initializer=None):
+    attn = multi_head_attention(x, None, None, d_model, n_head,
+                                dropout_rate, param_initializer)
+    x = _add_norm(x, attn, dropout_rate)
+    ffn = positionwise_ffn(x, d_inner, d_model, dropout_rate,
+                           param_initializer)
+    return _add_norm(x, ffn, dropout_rate)
+
+
+def encoder(x, n_layer, d_model, n_head, d_inner, dropout_rate=0.0,
+            param_initializer=None):
+    for _ in range(n_layer):
+        x = encoder_layer(x, d_model, n_head, d_inner, dropout_rate,
+                          param_initializer)
+    return x
+
+
+def bert_embedding(src_ids, pos_ids, sent_ids, cfg, dropout_rate=0.0):
+    from ..fluid.initializer import TruncatedNormal
+    init = TruncatedNormal(scale=0.02)
+    emb = layers.embedding(src_ids, [cfg["vocab_size"], cfg["hidden"]],
+                           param_attr=ParamAttr(name="word_embedding",
+                                                initializer=init))
+    pos = layers.embedding(pos_ids, [cfg["max_len"], cfg["hidden"]],
+                           param_attr=ParamAttr(name="pos_embedding",
+                                                initializer=init))
+    sent = layers.embedding(sent_ids, [cfg["type_vocab"], cfg["hidden"]],
+                            param_attr=ParamAttr(name="sent_embedding",
+                                                 initializer=init))
+    x = layers.elementwise_add(layers.elementwise_add(emb, pos), sent)
+    x = layers.layer_norm(x, begin_norm_axis=len(x.shape) - 1)
+    if dropout_rate:
+        x = layers.dropout(x, dropout_rate,
+                           dropout_implementation="upscale_in_train")
+    return x
+
+
+def build_bert_pretrain_program(cfg=None, seq_len=128, dropout=0.0,
+                                lr=1e-4, mlm_frac=0.15):
+    """Masked-LM pretraining step program. Feeds: src_ids, pos_ids,
+    sent_ids [B,S] int64; mask_pos [M] int64 (flattened positions),
+    mask_label [M,1] int64."""
+    cfg = cfg or bert_base_config()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.data("src_ids", shape=[seq_len], dtype="int64")
+        pos = fluid.data("pos_ids", shape=[seq_len], dtype="int64")
+        sent = fluid.data("sent_ids", shape=[seq_len], dtype="int64")
+        mask_pos = fluid.data("mask_pos", shape=[1], dtype="int64",
+                              append_batch_size=True)
+        mask_label = fluid.data("mask_label", shape=[1], dtype="int64")
+        x = bert_embedding(src, pos, sent, cfg, dropout)
+        enc = encoder(x, cfg["layers"], cfg["hidden"], cfg["heads"],
+                      cfg["ffn"], dropout)
+        flat = layers.reshape(enc, [-1, cfg["hidden"]])
+        picked = layers.gather(flat, mask_pos)
+        logits = layers.fc(picked, cfg["vocab_size"])
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, mask_label))
+        opt = fluid.optimizer.Adam(lr)
+        opt.minimize(loss)
+    return main, startup, [src, pos, sent, mask_pos, mask_label], [loss]

@@ -1,0 +1,14 @@
+"""Operator kernel library — importing this package registers all ops.
+
+The registry (registry.py) replaces the reference's OpInfoMap/kernel
+registries (reference: framework/op_registry.h, op_info.h); kernels are pure
+JAX functions compiled by XLA rather than per-device C++ functors."""
+from .registry import OPS, register_op, register_grad_maker  # noqa: F401
+
+from . import math_ops       # noqa: F401
+from . import tensor_ops     # noqa: F401
+from . import nn_ops         # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import framework_ops  # noqa: F401
+from . import nn_extra_ops   # noqa: F401
+from . import collective_ops  # noqa: F401

@@ -1,0 +1,150 @@
+"""Collective ops — the reference's NCCL data path re-expressed as XLA ICI
+collectives (reference: paddle/fluid/operators/collective/ —
+c_allreduce_op.h:73,105 looks up an NCCL comm by ring_id and issues
+ncclAllReduce on the comm stream; c_broadcast, c_allgather, c_reducescatter,
+c_comm_init*, c_gen_nccl_id, c_sync_*_stream).
+
+TPU design: a ring_id maps to a *named mesh axis* (registered by the
+parallel runtime — parallel/env.py). Inside a pjit/shard_map trace over that
+axis the kernels lower to lax.psum / all_gather / psum_scatter / ppermute,
+which XLA schedules on ICI. Outside any mesh (single chip, world_size 1)
+they are identity — exactly matching NCCL semantics with one rank.
+Stream-sync ops are no-ops: XLA's schedule already orders compute and
+collectives. Comm-bootstrap ops (c_gen_nccl_id/c_comm_init) register the
+ring→axis mapping instead of exchanging NCCL ids."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, first, out
+
+# ring_id -> mesh axis name; None = not in a mesh (identity collectives)
+_RING_AXIS: Dict[int, Optional[str]] = {}
+
+
+def set_ring_axis(ring_id: int, axis_name: Optional[str]):
+    _RING_AXIS[ring_id] = axis_name
+
+
+def get_ring_axis(ring_id: int) -> Optional[str]:
+    return _RING_AXIS.get(int(ring_id))
+
+
+def _axis_in_scope(axis: Optional[str]) -> bool:
+    if axis is None:
+        return False
+    try:
+        lax.axis_index(axis)  # raises NameError outside the axis scope
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def _register_allreduce(name, op):
+    @register_op(name, attr_defaults={"ring_id": 0, "use_calc_stream": False})
+    def _kernel(ins, attrs, _op=op):
+        x = first(ins, "X")
+        axis = get_ring_axis(attrs.get("ring_id", 0))
+        if not _axis_in_scope(axis):
+            return out(Out=x)
+        return out(Out=_op(x, axis))
+    return _kernel
+
+
+_register_allreduce("c_allreduce_sum", lambda x, a: lax.psum(x, a))
+_register_allreduce("c_allreduce_max", lambda x, a: lax.pmax(x, a))
+_register_allreduce("c_allreduce_min", lambda x, a: lax.pmin(x, a))
+_register_allreduce("c_allreduce_prod",
+                    lambda x, a: jnp.exp(lax.psum(jnp.log(x), a)))
+_register_allreduce("allreduce", lambda x, a: lax.psum(x, a))
+
+
+@register_op("c_broadcast", attr_defaults={"ring_id": 0, "root": 0,
+                                           "use_calc_stream": False})
+def _c_broadcast(ins, attrs):
+    x = first(ins, "X")
+    axis = get_ring_axis(attrs.get("ring_id", 0))
+    if not _axis_in_scope(axis):
+        return out(Out=x)
+    root = attrs.get("root", 0)
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return out(Out=lax.psum(masked, axis))
+
+
+@register_op("broadcast", attr_defaults={"root": 0, "sync_mode": False})
+def _broadcast(ins, attrs):
+    return _c_broadcast(ins, {"ring_id": 0, "root": attrs.get("root", 0)})
+
+
+@register_op("c_allgather", attr_defaults={"ring_id": 0, "nranks": 1,
+                                           "use_calc_stream": False})
+def _c_allgather(ins, attrs):
+    x = first(ins, "X")
+    axis = get_ring_axis(attrs.get("ring_id", 0))
+    if not _axis_in_scope(axis):
+        return out(Out=x)
+    return out(Out=lax.all_gather(x, axis, axis=0, tiled=True))
+
+
+@register_op("c_reducescatter", attr_defaults={"ring_id": 0, "nranks": 1,
+                                               "use_calc_stream": False})
+def _c_reducescatter(ins, attrs):
+    x = first(ins, "X")
+    axis = get_ring_axis(attrs.get("ring_id", 0))
+    if not _axis_in_scope(axis):
+        return out(Out=x)
+    return out(Out=lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True))
+
+
+@register_op("c_sync_calc_stream")
+def _c_sync_calc_stream(ins, attrs):
+    return out(Out=first(ins, "X"))  # XLA schedule orders compute
+
+
+@register_op("c_sync_comm_stream", attr_defaults={"ring_id": 0})
+def _c_sync_comm_stream(ins, attrs):
+    return out(Out=first(ins, "X"))  # XLA schedule orders collectives
+
+
+@register_op("c_comm_init", stateful=True, no_grad=True,
+             attr_defaults={"nranks": 1, "rank": 0, "ring_id": 0,
+                            "device_id": 0})
+def _c_comm_init(ins, attrs):
+    # NCCL comm creation ⇒ ring→axis registration (axis named by the
+    # parallel runtime; default data-parallel axis is "dp")
+    ring = attrs.get("ring_id", 0)
+    if get_ring_axis(ring) is None and attrs.get("nranks", 1) > 1:
+        set_ring_axis(ring, "dp")
+    return {}
+
+
+@register_op("c_comm_init_all", stateful=True, no_grad=True,
+             attr_defaults={"devices": [], "ring_id": 0})
+def _c_comm_init_all(ins, attrs):
+    ring = attrs.get("ring_id", 0)
+    if get_ring_axis(ring) is None:
+        set_ring_axis(ring, "dp")
+    return {}
+
+
+@register_op("c_gen_nccl_id", stateful=True, no_grad=True,
+             attr_defaults={"rank": 0, "endpoint": "",
+                            "other_endpoints": [], "ring_id": 0})
+def _c_gen_nccl_id(ins, attrs):
+    return {}  # no NCCL id on TPU: ICI topology is static
+
+
+@register_op("gen_nccl_id", stateful=True, no_grad=True,
+             attr_defaults={"trainers": [], "trainer_id": 0,
+                            "nccl_comm_num": 1,
+                            "use_hierarchical_allreduce": False,
+                            "hierarchical_allreduce_inter_nranks": 1})
+def _gen_nccl_id(ins, attrs):
+    return {}

@@ -1,0 +1,305 @@
+"""Framework-level ops: feed/fetch, control flow (while / conditional_block),
+LoDTensorArray ops, save/load, print, py_func
+(reference: operators/feed_op.cc, fetch_op.cc, controlflow/while_op.cc,
+controlflow/conditional_block_op.cc, controlflow/tensor_array_read_write_op.cc,
+save_op.cc, load_op.cc, assign_op.cc, print_op.cc, py_func_op.cc).
+
+These are ``stateful``: they touch the Scope / host side and therefore run in
+the interpreter path. The executor's compiled path refuses programs that
+contain them in the hot block (control flow lowers to lax primitives via the
+compiled path's dedicated handling — see executor._lower_control_flow).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from .registry import register_op, first, seq, out, mark_no_grad
+from ..fluid import core
+
+
+@register_op("feed", stateful=True, no_grad=True, attr_defaults={"col": 0})
+def _feed(ins, attrs):
+    ctx = attrs["_ctx"]
+    name = ctx.op.output("Out")[0]
+    col = attrs.get("col", 0)
+    feed_var = ctx.scope.find_var(ctx.op.input("X")[0])
+    val = feed_var.value()[col]
+    ctx.scope.var(name).set_value(val if isinstance(val, core.LoDTensor)
+                                  else core.LoDTensor(jnp.asarray(val)))
+    return {}
+
+
+@register_op("fetch", stateful=True, no_grad=True, attr_defaults={"col": 0})
+def _fetch(ins, attrs):
+    ctx = attrs["_ctx"]
+    src = ctx.scope.find_var(ctx.op.input("X")[0]).value()
+    fetch_var = ctx.scope.var(ctx.op.output("Out")[0])
+    lst = fetch_var.value()
+    if not isinstance(lst, list):
+        lst = core.LoDTensorArray()
+        fetch_var.set_value(lst)
+    col = attrs.get("col", 0)
+    while len(lst) <= col:
+        lst.append(None)
+    lst[col] = src
+    return {}
+
+
+@register_op("while", stateful=True, no_grad=True,
+             attr_defaults={"is_test": False})
+def _while(ins, attrs):
+    ctx = attrs["_ctx"]
+    block = attrs["sub_block"]
+    cond_name = ctx.op.input("Condition")[0]
+    max_iters = 10_000_000
+    it = 0
+    while True:
+        cond = ctx.scope.find_var(cond_name)
+        c = np.asarray(cond.get_tensor().array).reshape(-1)
+        if not bool(c[0]):
+            break
+        ctx.executor._run_block_eager(block, ctx.scope, ctx.rng_base)
+        it += 1
+        if it > max_iters:
+            raise RuntimeError("while op exceeded max iterations")
+    return {}
+
+
+@register_op("conditional_block", stateful=True, no_grad=True,
+             attr_defaults={"is_scalar_condition": False})
+def _conditional_block(ins, attrs):
+    ctx = attrs["_ctx"]
+    block = attrs["sub_block"]
+    if attrs.get("is_scalar_condition", False):
+        cvar = ctx.scope.find_var(ctx.op.input("Cond")[0])
+        run = bool(np.asarray(cvar.get_tensor().array).reshape(-1)[0])
+    else:
+        xs = [ctx.scope.find_var(n) for n in ctx.op.input("Input")]
+        run = all(v is not None and v.is_initialized() for v in xs)
+    if run:
+        ctx.executor._run_block_eager(block, ctx.scope, ctx.rng_base)
+    return {}
+
+
+@register_op("select_input", stateful=True, no_grad=True)
+def _select_input(ins, attrs):
+    ctx = attrs["_ctx"]
+    mask = int(np.asarray(first(ins, "Mask")).reshape(-1)[0])
+    src = ctx.scope.find_var(ctx.op.input("X")[mask]).value()
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(src)
+    return {}
+
+
+@register_op("select_output", stateful=True, no_grad=True)
+def _select_output(ins, attrs):
+    ctx = attrs["_ctx"]
+    mask = int(np.asarray(first(ins, "Mask")).reshape(-1)[0])
+    src = ctx.scope.find_var(ctx.op.input("X")[0]).value()
+    ctx.scope.var(ctx.op.output("Out")[mask]).set_value(src)
+    return {}
+
+
+# ---- LoDTensorArray ------------------------------------------------------
+@register_op("write_to_array", stateful=True, no_grad=True)
+def _write_to_array(ins, attrs):
+    ctx = attrs["_ctx"]
+    i = int(np.asarray(first(ins, "I")).reshape(-1)[0])
+    arr = ctx.scope.var(ctx.op.output("Out")[0]).get_lod_tensor_array()
+    x = ctx.scope.find_var(ctx.op.input("X")[0]).get_tensor()
+    while len(arr) <= i:
+        arr.append(core.LoDTensor())
+    arr[i] = core.LoDTensor(x.array, x.lod())
+    return {}
+
+
+@register_op("read_from_array", stateful=True, no_grad=True)
+def _read_from_array(ins, attrs):
+    ctx = attrs["_ctx"]
+    i = int(np.asarray(first(ins, "I")).reshape(-1)[0])
+    arr = ctx.scope.find_var(ctx.op.input("X")[0]).get_lod_tensor_array()
+    t = arr[i]
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(
+        core.LoDTensor(t.array, t.lod()))
+    return {}
+
+
+@register_op("lod_array_length", stateful=True, no_grad=True)
+def _lod_array_length(ins, attrs):
+    ctx = attrs["_ctx"]
+    arr = ctx.scope.find_var(ctx.op.input("X")[0]).get_lod_tensor_array()
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(
+        core.LoDTensor(jnp.asarray([len(arr)], jnp.int32)))
+    return {}
+
+
+@register_op("tensor_array_to_tensor", stateful=True, no_grad=True,
+             attr_defaults={"axis": 0, "use_stack": False})
+def _tensor_array_to_tensor(ins, attrs):
+    ctx = attrs["_ctx"]
+    arr = ctx.scope.find_var(ctx.op.input("X")[0]).get_lod_tensor_array()
+    xs = [t.array for t in arr]
+    ax = attrs.get("axis", 0)
+    o = jnp.stack(xs, ax) if attrs.get("use_stack", False) else jnp.concatenate(xs, ax)
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(core.LoDTensor(o))
+    idx = jnp.asarray([x.shape[ax] for x in xs], jnp.int32)
+    outs = ctx.op.output("OutIndex")
+    if outs:
+        ctx.scope.var(outs[0]).set_value(core.LoDTensor(idx))
+    return {}
+
+
+@register_op("array_to_lod_tensor", stateful=True, no_grad=True)
+def _array_to_lod_tensor(ins, attrs):
+    ctx = attrs["_ctx"]
+    arr = ctx.scope.find_var(ctx.op.input("X")[0]).get_lod_tensor_array()
+    o = jnp.concatenate([t.array for t in arr], axis=0)
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(core.LoDTensor(o))
+    return {}
+
+
+# ---- save / load (wire format: see fluid/io.py serializer) ---------------
+@register_op("save", stateful=True, no_grad=True,
+             attr_defaults={"overwrite": True, "save_as_fp16": False,
+                            "file_path": ""})
+def _save(ins, attrs):
+    from ..fluid.io import _serialize_lod_tensor
+    ctx = attrs["_ctx"]
+    path = attrs["file_path"]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if os.path.exists(path) and not attrs.get("overwrite", True):
+        raise RuntimeError(f"{path} exists and overwrite is False")
+    t = ctx.scope.find_var(ctx.op.input("X")[0]).get_tensor()
+    with open(path, "wb") as f:
+        f.write(_serialize_lod_tensor(t, attrs.get("save_as_fp16", False)))
+    return {}
+
+
+@register_op("load", stateful=True, no_grad=True,
+             attr_defaults={"file_path": "", "load_as_fp16": False})
+def _load(ins, attrs):
+    from ..fluid.io import _deserialize_lod_tensor
+    ctx = attrs["_ctx"]
+    with open(attrs["file_path"], "rb") as f:
+        t = _deserialize_lod_tensor(f.read())
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(t)
+    return {}
+
+
+@register_op("save_combine", stateful=True, no_grad=True,
+             attr_defaults={"overwrite": True, "save_as_fp16": False,
+                            "file_path": ""})
+def _save_combine(ins, attrs):
+    from ..fluid.io import _serialize_lod_tensor
+    ctx = attrs["_ctx"]
+    path = attrs["file_path"]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        for name in ctx.op.input("X"):
+            t = ctx.scope.find_var(name).get_tensor()
+            f.write(_serialize_lod_tensor(t, attrs.get("save_as_fp16", False)))
+    return {}
+
+
+@register_op("load_combine", stateful=True, no_grad=True,
+             attr_defaults={"file_path": "", "load_as_fp16": False,
+                            "model_from_memory": False})
+def _load_combine(ins, attrs):
+    from ..fluid.io import _deserialize_lod_tensor_stream
+    ctx = attrs["_ctx"]
+    with open(attrs["file_path"], "rb") as f:
+        data = f.read()
+    tensors = _deserialize_lod_tensor_stream(data, len(ctx.op.output("Out")))
+    for name, t in zip(ctx.op.output("Out"), tensors):
+        ctx.scope.var(name).set_value(t)
+    return {}
+
+
+@register_op("print", stateful=True, no_grad=True,
+             attr_defaults={"first_n": -1, "message": "", "summarize": 20,
+                            "print_tensor_name": True, "print_tensor_type": True,
+                            "print_tensor_shape": True, "print_tensor_lod": True,
+                            "print_phase": "BOTH"})
+def _print(ins, attrs):
+    ctx = attrs["_ctx"]
+    name = ctx.op.input("In")[0]
+    t = ctx.scope.find_var(name).get_tensor()
+    msg = attrs.get("message", "")
+    print(f"{msg} Variable: {name} shape: {t.shape()} data: "
+          f"{np.asarray(t.array).reshape(-1)[:attrs.get('summarize', 20)]}")
+    o = ctx.op.output("Out")
+    if o:
+        ctx.scope.var(o[0]).set_value(core.LoDTensor(t.array, t.lod()))
+    return {}
+
+
+@register_op("assert", stateful=True, no_grad=True,
+             attr_defaults={"summarize": -1})
+def _assert(ins, attrs):
+    ctx = attrs["_ctx"]
+    cond = np.asarray(first(ins, "Cond")).reshape(-1)
+    if not bool(cond.all()):
+        data = [np.asarray(ctx.scope.find_var(n).get_tensor().array)
+                for n in ctx.op.input("Data")]
+        raise AssertionError(f"Assert failed; data={data}")
+    return {}
+
+
+@register_op("py_func", stateful=True, no_grad=True,
+             attr_defaults={"forward_callable_id": 0, "backward_callable_id": -1,
+                            "backward_skip_vars": []})
+def _py_func(ins, attrs):
+    from ..fluid.layers.py_func_registry import get_callable
+    fn = get_callable(attrs["forward_callable_id"])
+    xs = [np.asarray(x) for x in seq(ins, "X")]
+    res = fn(*xs)
+    if not isinstance(res, (list, tuple)):
+        res = [res]
+    return out(Out=[jnp.asarray(np.asarray(r)) for r in res])
+
+
+@register_op("delete_var", stateful=True, no_grad=True)
+def _delete_var(ins, attrs):
+    ctx = attrs["_ctx"]
+    for n in ctx.op.input("X"):
+        ctx.scope.erase(n)
+    return {}
+
+
+@register_op("rnn_memory_helper", inputs=("X",))
+def _rnn_memory_helper(ins, attrs):
+    return out(Out=first(ins, "X"))
+
+
+@register_op("fake_init", stateful=True, no_grad=True,
+             attr_defaults={"shape": [], "dtype": 5})
+def _fake_init(ins, attrs):
+    return {}
+
+
+@register_op("get_tensor_from_selected_rows", stateful=True, no_grad=True)
+def _get_tensor_from_selected_rows(ins, attrs):
+    ctx = attrs["_ctx"]
+    sr = ctx.scope.find_var(ctx.op.input("X")[0]).get_selected_rows()
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(
+        core.LoDTensor(sr.get_tensor().array))
+    return {}
+
+
+@register_op("merge_selected_rows", stateful=True, no_grad=True)
+def _merge_selected_rows(ins, attrs):
+    ctx = attrs["_ctx"]
+    sr = ctx.scope.find_var(ctx.op.input("X")[0]).get_selected_rows()
+    rows = np.asarray(sr.rows())
+    val = np.asarray(sr.get_tensor().array)
+    uniq, inv = np.unique(rows, return_inverse=True)
+    merged = np.zeros((len(uniq),) + val.shape[1:], val.dtype)
+    np.add.at(merged, inv, val)
+    o = ctx.scope.var(ctx.op.output("Out")[0]).get_selected_rows()
+    o.set_rows(uniq.tolist())
+    o.set_height(sr.height())
+    o.get_tensor().set(merged)
+    return {}

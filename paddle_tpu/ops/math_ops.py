@@ -1,0 +1,487 @@
+"""Dense math op kernels (TPU-native re-implementations of the reference
+operator set under paddle/fluid/operators/ — elementwise/, reduce_ops/,
+matmul/mul, activations). Each kernel is a pure JAX function; gradients come
+from the generic vjp path (registry.run_generic_grad) unless noted.
+
+Semantics follow the reference op contracts:
+  * elementwise_* broadcast: Y aligns to X at ``axis`` (default -1 = trailing
+    alignment), trailing size-1 dims of Y trimmed
+    (reference: operators/elementwise/elementwise_op_function.h).
+  * mul: flatten X by num_col_dims into 2-D (reference: operators/mul_op.cc).
+  * matmul: optional transpose + alpha, batched with broadcast
+    (reference: operators/matmul_op.cc).
+  * reduce_*: dim list + keep_dim + reduce_all (reference: operators/reduce_ops/).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, register_grad_maker, first, seq, out
+
+
+# --------------------------------------------------------------------------
+# elementwise binary family
+# --------------------------------------------------------------------------
+def _align_y(x, y, axis):
+    """Paddle elementwise broadcast: reshape Y so it aligns to X at axis."""
+    if x.shape == y.shape:
+        return y
+    axis = int(axis)
+    yshape = list(y.shape)
+    while yshape and yshape[-1] == 1:
+        yshape.pop()
+    if axis == -1:
+        axis = x.ndim - len(yshape)
+    new_shape = [1] * axis + yshape + [1] * (x.ndim - axis - len(yshape))
+    return y.reshape(new_shape)
+
+
+def _register_elementwise(name, fn):
+    @register_op(name, inputs=("X", "Y"), attr_defaults={"axis": -1})
+    def _kernel(ins, attrs, _fn=fn):
+        x, y = first(ins, "X"), first(ins, "Y")
+        return out(Out=_fn(x, _align_y(x, y, attrs.get("axis", -1))))
+    return _kernel
+
+
+_register_elementwise("elementwise_add", lambda x, y: x + y)
+_register_elementwise("elementwise_sub", lambda x, y: x - y)
+_register_elementwise("elementwise_mul", lambda x, y: x * y)
+_register_elementwise("elementwise_div", lambda x, y: x / y)
+_register_elementwise("elementwise_max", jnp.maximum)
+_register_elementwise("elementwise_min", jnp.minimum)
+_register_elementwise("elementwise_pow", lambda x, y: x ** y)
+_register_elementwise("elementwise_mod", lambda x, y: x % y)
+_register_elementwise("elementwise_floordiv", lambda x, y: x // y)
+
+
+# --------------------------------------------------------------------------
+# mul / matmul / bmm / dot  (MXU-bound ops — keep as single dot_generals)
+# --------------------------------------------------------------------------
+@register_op("mul", inputs=("X", "Y"),
+             attr_defaults={"x_num_col_dims": 1, "y_num_col_dims": 1})
+def _mul(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xn])), -1))
+    y2 = y.reshape((int(np.prod(ys[:yn])), -1))
+    o = x2 @ y2
+    return out(Out=o.reshape(xs[:xn] + ys[yn:]))
+
+
+@register_op("matmul", inputs=("X", "Y"),
+             attr_defaults={"transpose_X": False, "transpose_Y": False,
+                            "alpha": 1.0})
+def _matmul(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    tx = attrs.get("transpose_X", False)
+    ty = attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    # 1-D operands follow reference rules: vec@vec -> [1], promote otherwise.
+    squeeze_front = squeeze_back = False
+    if x.ndim == 1:
+        x = x[None, :]
+        squeeze_front = True
+    if y.ndim == 1:
+        y = y[:, None]
+        squeeze_back = True
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    o = jnp.matmul(x, y)
+    if squeeze_front:
+        o = jnp.squeeze(o, -2)
+    if squeeze_back:
+        o = jnp.squeeze(o, -1)
+    if squeeze_front and squeeze_back:
+        o = o.reshape((1,))
+    if alpha != 1.0:
+        o = o * jnp.asarray(alpha, o.dtype)
+    return out(Out=o)
+
+
+@register_op("matmul_v2", inputs=("X", "Y"),
+             attr_defaults={"trans_x": False, "trans_y": False})
+def _matmul_v2(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    if attrs.get("trans_x", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("trans_y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    return out(Out=jnp.matmul(x, y))
+
+
+@register_op("bmm", inputs=("X", "Y"))
+def _bmm(ins, attrs):
+    return out(Out=jnp.matmul(first(ins, "X"), first(ins, "Y")))
+
+
+@register_op("dot", inputs=("X", "Y"))
+def _dot(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    return out(Out=jnp.sum(x * y, axis=-1, keepdims=x.ndim == 1))
+
+
+@register_op("mv", inputs=("X", "Vec"))
+def _mv(ins, attrs):
+    return out(Out=jnp.matmul(first(ins, "X"), first(ins, "Vec")))
+
+
+# --------------------------------------------------------------------------
+# reductions
+# --------------------------------------------------------------------------
+def _reduce_axes(x, attrs):
+    if attrs.get("reduce_all", False):
+        return None
+    dims = attrs.get("dim", [0])
+    if isinstance(dims, int):
+        dims = [dims]
+    if not dims:
+        return None
+    return tuple(int(d) % x.ndim for d in dims)
+
+
+def _register_reduce(name, fn):
+    @register_op(name, inputs=("X",),
+                 attr_defaults={"dim": [0], "keep_dim": False,
+                                "reduce_all": False})
+    def _kernel(ins, attrs, _fn=fn):
+        x = first(ins, "X")
+        axes = _reduce_axes(x, attrs)
+        o = _fn(x, axis=axes, keepdims=attrs.get("keep_dim", False))
+        if o.ndim == 0:
+            o = o.reshape((1,))
+        return out(Out=o)
+    return _kernel
+
+
+_register_reduce("reduce_sum", jnp.sum)
+_register_reduce("reduce_mean", jnp.mean)
+_register_reduce("reduce_max", jnp.max)
+_register_reduce("reduce_min", jnp.min)
+_register_reduce("reduce_prod", jnp.prod)
+_register_reduce("reduce_all", lambda x, axis, keepdims: jnp.all(x, axis=axis, keepdims=keepdims))
+_register_reduce("reduce_any", lambda x, axis, keepdims: jnp.any(x, axis=axis, keepdims=keepdims))
+
+
+@register_op("mean", inputs=("X",))
+def _mean(ins, attrs):
+    return out(Out=jnp.mean(first(ins, "X")).reshape((1,)))
+
+
+@register_op("sum", inputs=("X",))
+def _sum(ins, attrs):
+    xs = seq(ins, "X")
+    acc = xs[0]
+    for x in xs[1:]:
+        acc = acc + x
+    return out(Out=acc)
+
+
+@register_op("logsumexp", inputs=("X",),
+             attr_defaults={"axis": [0], "keepdim": False, "reduce_all": False})
+def _logsumexp(ins, attrs):
+    x = first(ins, "X")
+    axes = None if attrs.get("reduce_all") else tuple(
+        int(d) % x.ndim for d in (attrs.get("axis") or [0]))
+    o = jax.scipy.special.logsumexp(x, axis=axes,
+                                    keepdims=attrs.get("keepdim", False))
+    if o.ndim == 0:
+        o = o.reshape((1,))
+    return out(Out=o)
+
+
+# --------------------------------------------------------------------------
+# activations (reference: operators/activation_op.cc REGISTER_ACTIVATION_OP)
+# --------------------------------------------------------------------------
+def _register_act(name, fn, **kw):
+    @register_op(name, inputs=("X",), **kw)
+    def _kernel(ins, attrs, _fn=fn):
+        return out(Out=_fn(first(ins, "X"), attrs))
+    return _kernel
+
+
+_register_act("relu", lambda x, a: jnp.maximum(x, 0))
+_register_act("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_register_act("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_register_act("tanh", lambda x, a: jnp.tanh(x))
+_register_act("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_register_act("sqrt", lambda x, a: jnp.sqrt(x))
+_register_act("rsqrt", lambda x, a: lax.rsqrt(x))
+_register_act("abs", lambda x, a: jnp.abs(x))
+_register_act("ceil", lambda x, a: jnp.ceil(x), no_grad=True)
+_register_act("floor", lambda x, a: jnp.floor(x), no_grad=True)
+_register_act("round", lambda x, a: jnp.round(x), no_grad=True)
+_register_act("cos", lambda x, a: jnp.cos(x))
+_register_act("sin", lambda x, a: jnp.sin(x))
+_register_act("acos", lambda x, a: jnp.arccos(x))
+_register_act("asin", lambda x, a: jnp.arcsin(x))
+_register_act("atan", lambda x, a: jnp.arctan(x))
+_register_act("sinh", lambda x, a: jnp.sinh(x))
+_register_act("cosh", lambda x, a: jnp.cosh(x))
+_register_act("reciprocal", lambda x, a: 1.0 / x)
+_register_act("log", lambda x, a: jnp.log(x))
+_register_act("log1p", lambda x, a: jnp.log1p(x))
+_register_act("square", lambda x, a: jnp.square(x))
+_register_act("exp", lambda x, a: jnp.exp(x))
+_register_act("softplus", lambda x, a: jax.nn.softplus(x))
+_register_act("softsign", lambda x, a: x / (1 + jnp.abs(x)))
+_register_act("erf", lambda x, a: jax.scipy.special.erf(x))
+_register_act("sign", lambda x, a: jnp.sign(x), no_grad=True)
+
+_register_act("leaky_relu", lambda x, a: jnp.where(x >= 0, x, x * a.get("alpha", 0.02)),
+              attr_defaults={"alpha": 0.02})
+_register_act("elu", lambda x, a: jnp.where(x > 0, x, a.get("alpha", 1.0) * (jnp.exp(x) - 1)),
+              attr_defaults={"alpha": 1.0})
+_register_act("selu",
+              lambda x, a: a.get("scale", 1.0507009873554805) * jnp.where(
+                  x > 0, x, a.get("alpha", 1.6732632423543772) * (jnp.exp(x) - 1)),
+              attr_defaults={"scale": 1.0507009873554805,
+                             "alpha": 1.6732632423543772})
+_register_act("relu6", lambda x, a: jnp.clip(x, 0, a.get("threshold", 6.0)),
+              attr_defaults={"threshold": 6.0})
+_register_act("brelu", lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)),
+              attr_defaults={"t_min": 0.0, "t_max": 24.0})
+_register_act("soft_relu",
+              lambda x, a: jnp.log1p(jnp.exp(jnp.clip(x, -a.get("threshold", 40.0),
+                                                      a.get("threshold", 40.0)))),
+              attr_defaults={"threshold": 40.0})
+_register_act("gelu",
+              lambda x, a: (0.5 * x * (1.0 + jnp.tanh(
+                  np.sqrt(2.0 / np.pi) * (x + 0.044715 * x ** 3)))
+                  ) if a.get("approximate", False) else jax.nn.gelu(x, approximate=False),
+              attr_defaults={"approximate": False})
+_register_act("hard_sigmoid",
+              lambda x, a: jnp.clip(a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0),
+              attr_defaults={"slope": 0.2, "offset": 0.5})
+_register_act("hard_swish",
+              lambda x, a: x * jnp.clip(x + a.get("offset", 3.0), 0,
+                                        a.get("threshold", 6.0)) / a.get("scale", 6.0),
+              attr_defaults={"threshold": 6.0, "scale": 6.0, "offset": 3.0})
+_register_act("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x),
+              attr_defaults={"beta": 1.0})
+_register_act("stanh",
+              lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(a.get("scale_a", 0.67) * x),
+              attr_defaults={"scale_a": 0.67, "scale_b": 1.7159})
+_register_act("softshrink",
+              lambda x, a: jnp.where(x > a.get("lambda", 0.5), x - a.get("lambda", 0.5),
+                                     jnp.where(x < -a.get("lambda", 0.5),
+                                               x + a.get("lambda", 0.5), 0.0)),
+              attr_defaults={"lambda": 0.5})
+_register_act("hard_shrink",
+              lambda x, a: jnp.where(jnp.abs(x) > a.get("threshold", 0.5), x, 0.0),
+              attr_defaults={"threshold": 0.5})
+_register_act("thresholded_relu",
+              lambda x, a: jnp.where(x > a.get("threshold", 1.0), x, 0.0),
+              attr_defaults={"threshold": 1.0})
+_register_act("pow", lambda x, a: x ** a.get("factor", 1.0),
+              attr_defaults={"factor": 1.0})
+
+
+@register_op("prelu", inputs=("X", "Alpha"), attr_defaults={"mode": "all"})
+def _prelu(ins, attrs):
+    x, alpha = first(ins, "X"), first(ins, "Alpha")
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "element":
+        alpha = alpha.reshape((1,) + x.shape[1:])
+    return out(Out=jnp.where(x > 0, x, alpha * x))
+
+
+# --------------------------------------------------------------------------
+# scale / clip / misc unary
+# --------------------------------------------------------------------------
+@register_op("scale", inputs=("X", "ScaleTensor"),
+             attr_defaults={"scale": 1.0, "bias": 0.0, "bias_after_scale": True})
+def _scale(ins, attrs):
+    x = first(ins, "X")
+    s = first(ins, "ScaleTensor")
+    s = jnp.asarray(attrs.get("scale", 1.0), x.dtype) if s is None else s.astype(x.dtype)
+    b = jnp.asarray(attrs.get("bias", 0.0), x.dtype)
+    if attrs.get("bias_after_scale", True):
+        return out(Out=x * s + b)
+    return out(Out=(x + b) * s)
+
+
+@register_op("clip", inputs=("X",), attr_defaults={"min": 0.0, "max": 0.0})
+def _clip(ins, attrs):
+    return out(Out=jnp.clip(first(ins, "X"), attrs.get("min"), attrs.get("max")))
+
+
+@register_op("clip_by_norm", inputs=("X",), attr_defaults={"max_norm": 1.0})
+def _clip_by_norm(ins, attrs):
+    x = first(ins, "X")
+    mn = attrs.get("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return out(Out=jnp.where(norm > mn, x * (mn / norm), x))
+
+
+@register_op("squared_l2_norm", inputs=("X",))
+def _squared_l2_norm(ins, attrs):
+    return out(Out=jnp.sum(jnp.square(first(ins, "X"))).reshape((1,)))
+
+
+@register_op("l1_norm", inputs=("X",))
+def _l1_norm(ins, attrs):
+    return out(Out=jnp.sum(jnp.abs(first(ins, "X"))).reshape((1,)))
+
+
+@register_op("frobenius_norm", inputs=("X",),
+             attr_defaults={"dim": [0], "keep_dim": False, "reduce_all": False})
+def _frobenius_norm(ins, attrs):
+    x = first(ins, "X")
+    axes = _reduce_axes(x, attrs)
+    o = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes,
+                         keepdims=attrs.get("keep_dim", False)))
+    if o.ndim == 0:
+        o = o.reshape((1,))
+    return out(Out=o)
+
+
+@register_op("p_norm", inputs=("X",),
+             attr_defaults={"porder": 2.0, "axis": -1, "epsilon": 1e-12,
+                            "keepdim": False})
+def _p_norm(ins, attrs):
+    x = first(ins, "X")
+    p = attrs.get("porder", 2.0)
+    ax = int(attrs.get("axis", -1))
+    o = jnp.sum(jnp.abs(x) ** p, axis=ax,
+                keepdims=attrs.get("keepdim", False)) ** (1.0 / p)
+    return out(Out=o)
+
+
+@register_op("cumsum", inputs=("X",),
+             attr_defaults={"axis": -1, "flatten": False, "exclusive": False,
+                            "reverse": False})
+def _cumsum(ins, attrs):
+    x = first(ins, "X")
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+    ax = int(attrs.get("axis", -1))
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, ax)
+    o = jnp.cumsum(x, axis=ax)
+    if attrs.get("exclusive", False):
+        o = o - x
+    if attrs.get("reverse", False):
+        o = jnp.flip(o, ax)
+    return out(Out=o)
+
+
+@register_op("kron", inputs=("X", "Y"))
+def _kron(ins, attrs):
+    return out(Out=jnp.kron(first(ins, "X"), first(ins, "Y")))
+
+
+@register_op("trace", inputs=("Input",),
+             attr_defaults={"offset": 0, "axis1": 0, "axis2": 1})
+def _trace(ins, attrs):
+    return out(Out=jnp.trace(first(ins, "Input"), offset=attrs.get("offset", 0),
+                             axis1=attrs.get("axis1", 0),
+                             axis2=attrs.get("axis2", 1)))
+
+
+@register_op("addmm", inputs=("Input", "X", "Y"),
+             attr_defaults={"Alpha": 1.0, "Beta": 1.0})
+def _addmm(ins, attrs):
+    inp, x, y = first(ins, "Input"), first(ins, "X"), first(ins, "Y")
+    return out(Out=attrs.get("Beta", 1.0) * inp + attrs.get("Alpha", 1.0) * (x @ y))
+
+
+@register_op("increment", inputs=("X",), attr_defaults={"step": 1.0})
+def _increment(ins, attrs):
+    x = first(ins, "X")
+    return out(Out=x + jnp.asarray(attrs.get("step", 1.0), x.dtype))
+
+
+@register_op("minus", inputs=("X", "Y"))
+def _minus(ins, attrs):
+    return out(Out=first(ins, "X") - first(ins, "Y"))
+
+
+@register_op("cos_sim", inputs=("X", "Y"))
+def _cos_sim(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), -1, keepdims=True))
+    xy = jnp.sum(x * y, -1, keepdims=True)
+    return out(Out=xy / (xn * yn), XNorm=xn, YNorm=yn)
+
+
+@register_op("isfinite", inputs=("X",), no_grad=True)
+def _isfinite(ins, attrs):
+    return out(Out=jnp.all(jnp.isfinite(first(ins, "X"))).reshape((1,)))
+
+
+@register_op("allclose", inputs=("Input", "Other"), no_grad=True,
+             attr_defaults={"rtol": 1e-5, "atol": 1e-8, "equal_nan": False})
+def _allclose(ins, attrs):
+    return out(Out=jnp.allclose(first(ins, "Input"), first(ins, "Other"),
+                                rtol=attrs.get("rtol", 1e-5),
+                                atol=attrs.get("atol", 1e-8),
+                                equal_nan=attrs.get("equal_nan", False)).reshape((1,)))
+
+
+# comparison / logical (no grad)
+def _register_cmp(name, fn):
+    @register_op(name, inputs=("X", "Y"), no_grad=True,
+                 attr_defaults={"axis": -1})
+    def _kernel(ins, attrs, _fn=fn):
+        x, y = first(ins, "X"), first(ins, "Y")
+        return out(Out=_fn(x, _align_y(x, y, attrs.get("axis", -1))))
+    return _kernel
+
+
+_register_cmp("less_than", lambda x, y: x < y)
+_register_cmp("less_equal", lambda x, y: x <= y)
+_register_cmp("greater_than", lambda x, y: x > y)
+_register_cmp("greater_equal", lambda x, y: x >= y)
+_register_cmp("equal", lambda x, y: x == y)
+_register_cmp("not_equal", lambda x, y: x != y)
+_register_cmp("logical_and", jnp.logical_and)
+_register_cmp("logical_or", jnp.logical_or)
+_register_cmp("logical_xor", jnp.logical_xor)
+
+
+@register_op("logical_not", inputs=("X",), no_grad=True)
+def _logical_not(ins, attrs):
+    return out(Out=jnp.logical_not(first(ins, "X")))
+
+
+@register_op("maximum", inputs=("X", "Y"))
+def _maximum(ins, attrs):
+    return out(Out=jnp.maximum(first(ins, "X"), first(ins, "Y")))
+
+
+@register_op("inverse", inputs=("Input",))
+def _inverse(ins, attrs):
+    return out(Output=jnp.linalg.inv(first(ins, "Input")))
+
+
+@register_op("cholesky", inputs=("X",), attr_defaults={"upper": False})
+def _cholesky(ins, attrs):
+    l = jnp.linalg.cholesky(first(ins, "X"))
+    if attrs.get("upper", False):
+        l = jnp.swapaxes(l, -1, -2)
+    return out(Out=l)
+
+
+@register_op("dist", inputs=("X", "Y"), attr_defaults={"p": 2.0})
+def _dist(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    p = attrs.get("p", 2.0)
+    d = jnp.abs(x - y).reshape(-1)
+    if p == 0:
+        o = jnp.sum(d != 0).astype(x.dtype)
+    elif np.isinf(p):
+        o = jnp.max(d)
+    else:
+        o = jnp.sum(d ** p) ** (1.0 / p)
+    return out(Out=o.reshape((1,)))

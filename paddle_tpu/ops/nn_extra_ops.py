@@ -1,0 +1,270 @@
+"""Additional NN op kernels: maxout, affine_channel, position encoding,
+bilinear tensor product, CVM, FSP, temporal shift, unfold, mean_iou,
+sequence_mask, row_conv, focal loss, iou (reference: the same-named ops
+under paddle/fluid/operators/)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, first, seq, out
+from ..fluid.core import dtype_to_jnp
+
+
+@register_op("maxout", inputs=("X",), attr_defaults={"groups": 1, "axis": 1})
+def _maxout(ins, attrs):
+    x = first(ins, "X")
+    g = attrs.get("groups", 1)
+    ax = attrs.get("axis", 1) % x.ndim
+    c = x.shape[ax]
+    shape = x.shape[:ax] + (c // g, g) + x.shape[ax + 1:]
+    return out(Out=jnp.max(x.reshape(shape), axis=ax + 1))
+
+
+@register_op("affine_channel", inputs=("X", "Scale", "Bias"),
+             diff_inputs=("X", "Scale", "Bias"),
+             attr_defaults={"data_layout": "NCHW"})
+def _affine_channel(ins, attrs):
+    x, scale, bias = first(ins, "X"), first(ins, "Scale"), first(ins, "Bias")
+    c_axis = 1 if attrs.get("data_layout", "NCHW") == "NCHW" else x.ndim - 1
+    shp = [1] * x.ndim
+    shp[c_axis] = x.shape[c_axis]
+    return out(Out=x * scale.reshape(shp) + bias.reshape(shp))
+
+
+@register_op("add_position_encoding", inputs=("X",),
+             attr_defaults={"alpha": 1.0, "beta": 1.0})
+def _add_position_encoding(ins, attrs):
+    x = first(ins, "X")
+    b, t, d = x.shape
+    half = d // 2
+    pos = jnp.arange(t, dtype=x.dtype)[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype=x.dtype) / half)[None, :]
+    enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    return out(Out=attrs.get("alpha", 1.0) * x
+               + attrs.get("beta", 1.0) * enc[None, :, :])
+
+
+@register_op("bilinear_tensor_product", inputs=("X", "Y", "Weight", "Bias"),
+             diff_inputs=("X", "Y", "Weight", "Bias"))
+def _bilinear_tensor_product(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    w = first(ins, "Weight")  # [size, dx, dy]
+    o = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    b = first(ins, "Bias")
+    if b is not None:
+        o = o + b.reshape(1, -1)
+    return out(Out=o)
+
+
+@register_op("cvm", inputs=("X", "CVM"), diff_inputs=("X",),
+             attr_defaults={"use_cvm": True})
+def _cvm(ins, attrs):
+    x = first(ins, "X")
+    if attrs.get("use_cvm", True):
+        show_clk = jnp.log(jnp.maximum(x[:, :2], 0.0) + 1.0)
+        return out(Y=jnp.concatenate([show_clk, x[:, 2:]], axis=1))
+    return out(Y=x[:, 2:])
+
+
+@register_op("fsp", inputs=("X", "Y"))
+def _fsp(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    n, cx = x.shape[0], x.shape[1]
+    cy = y.shape[1]
+    h = x.shape[2] * x.shape[3]
+    xf = x.reshape(n, cx, h)
+    yf = y.reshape(n, cy, h)
+    return out(Out=jnp.einsum("nch,ndh->ncd", xf, yf) / h)
+
+
+@register_op("temporal_shift", inputs=("X",),
+             attr_defaults={"seg_num": 1, "shift_ratio": 0.25})
+def _temporal_shift(ins, attrs):
+    x = first(ins, "X")
+    seg = attrs["seg_num"]
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    n = nt // seg
+    xr = x.reshape(n, seg, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    pad = jnp.pad(xr, [(0, 0), (1, 1), (0, 0), (0, 0), (0, 0)])
+    slice1 = pad[:, :seg, :c1]
+    slice2 = pad[:, 2:seg + 2, c1:c2]
+    slice3 = pad[:, 1:seg + 1, c2:]
+    return out(Out=jnp.concatenate([slice1, slice2, slice3],
+                                   axis=2).reshape(nt, c, h, w))
+
+
+@register_op("unfold", inputs=("X",), diff_inputs=("X",),
+             attr_defaults={"kernel_sizes": [1, 1], "strides": [1, 1],
+                            "paddings": [0, 0, 0, 0], "dilations": [1, 1]})
+def _unfold(ins, attrs):
+    x = first(ins, "X")
+    kh, kw = attrs["kernel_sizes"]
+    sh, sw = attrs["strides"]
+    p = attrs["paddings"]
+    dh, dw = attrs["dilations"]
+    n, c = x.shape[0], x.shape[1]
+    xp = jnp.pad(x, [(0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])])
+    H, W = xp.shape[2], xp.shape[3]
+    oh = (H - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W - (dw * (kw - 1) + 1)) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(lax.slice(
+                xp, (0, 0, i * dh, j * dw),
+                (n, c, i * dh + (oh - 1) * sh + 1, j * dw + (ow - 1) * sw + 1),
+                (1, 1, sh, sw)))
+    stacked = jnp.stack(patches, axis=2)  # [n, c, kh*kw, oh, ow]
+    return out(Y=stacked.reshape(n, c * kh * kw, oh * ow))
+
+
+@register_op("mean_iou", inputs=("Predictions", "Labels"), no_grad=True,
+             attr_defaults={"num_classes": 2})
+def _mean_iou(ins, attrs):
+    pred = first(ins, "Predictions").reshape(-1)
+    label = first(ins, "Labels").reshape(-1)
+    k = attrs["num_classes"]
+    valid = (label >= 0) & (label < k)
+    idx = label * k + pred
+    cm = jnp.zeros((k * k,), jnp.int32).at[idx].add(valid.astype(jnp.int32))
+    cm = cm.reshape(k, k)
+    inter = jnp.diag(cm).astype(jnp.float32)
+    union = (jnp.sum(cm, 0) + jnp.sum(cm, 1)).astype(jnp.float32) - inter
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1.0), 0.0)
+    denom = jnp.maximum(jnp.sum(union > 0), 1)
+    wrong = (jnp.sum(cm, 0) - jnp.diag(cm)).astype(jnp.int32)
+    correct = jnp.diag(cm).astype(jnp.int32)
+    return out(OutMeanIou=(jnp.sum(iou) / denom).reshape((1,)),
+               OutWrong=wrong, OutCorrect=correct)
+
+
+@register_op("sequence_mask", inputs=("X", "MaxLenTensor"), no_grad=True,
+             attr_defaults={"maxlen": -1, "out_dtype": 3})
+def _sequence_mask(ins, attrs):
+    x = first(ins, "X")
+    mt = first(ins, "MaxLenTensor")
+    maxlen = attrs.get("maxlen", -1)
+    if mt is not None:
+        maxlen = int(np.asarray(mt).reshape(()))
+    if maxlen is None or maxlen < 0:
+        maxlen = int(np.asarray(jnp.max(x)))
+    rng = jnp.arange(maxlen)
+    mask = rng[None, :] < x.reshape(-1, 1)
+    mask = mask.reshape(x.shape + (maxlen,))
+    return out(Y=mask.astype(dtype_to_jnp(attrs.get("out_dtype", 3))))
+
+
+@register_op("row_conv", inputs=("X", "Filter"), diff_inputs=("X", "Filter"))
+def _row_conv(ins, attrs):
+    x, w = first(ins, "X"), first(ins, "Filter")
+    # batched dense path: x [B, T, D], filter [future+1, D]
+    k = w.shape[0]
+    t = x.shape[-2]
+    pad = jnp.pad(x, [(0, 0)] * (x.ndim - 2) + [(0, k - 1), (0, 0)])
+    o = sum(pad[..., i:i + t, :] * w[i] for i in range(k))
+    return out(Out=o)
+
+
+@register_op("sigmoid_focal_loss", inputs=("X", "Label", "FgNum"),
+             diff_inputs=("X",),
+             attr_defaults={"gamma": 2.0, "alpha": 0.25})
+def _sigmoid_focal_loss(ins, attrs):
+    x, label, fg = first(ins, "X"), first(ins, "Label"), first(ins, "FgNum")
+    gamma, alpha = attrs.get("gamma", 2.0), attrs.get("alpha", 0.25)
+    n, c = x.shape
+    fg = jnp.maximum(fg.reshape(()).astype(x.dtype), 1.0)
+    t = jax.nn.one_hot(jnp.squeeze(label, -1) if label.ndim == 2 else label,
+                       c + 1)[:, 1:]
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * t + (1 - p) * (1 - t)
+    a_t = alpha * t + (1 - alpha) * (1 - t)
+    return out(Out=a_t * ((1 - p_t) ** gamma) * ce / fg)
+
+
+@register_op("iou_similarity", inputs=("X", "Y"), no_grad=True,
+             attr_defaults={"box_normalized": True})
+def _iou_similarity(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    norm = attrs.get("box_normalized", True)
+    eps = 0.0 if norm else 1.0
+    ax1, ay1, ax2, ay2 = [x[..., i] for i in range(4)]
+    bx1, by1, bx2, by2 = [y[..., i] for i in range(4)]
+    area_a = (ax2 - ax1 + eps) * (ay2 - ay1 + eps)
+    area_b = (bx2 - bx1 + eps) * (by2 - by1 + eps)
+    ix1 = jnp.maximum(ax1[:, None], bx1[None, :])
+    iy1 = jnp.maximum(ay1[:, None], by1[None, :])
+    ix2 = jnp.minimum(ax2[:, None], bx2[None, :])
+    iy2 = jnp.minimum(ay2[:, None], by2[None, :])
+    iw = jnp.maximum(ix2 - ix1 + eps, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + eps, 0.0)
+    inter = iw * ih
+    return out(Out=inter / (area_a[:, None] + area_b[None, :] - inter))
+
+
+@register_op("grid_sampler", inputs=("X", "Grid"),
+             diff_inputs=("X", "Grid"))
+def _grid_sampler(ins, attrs):
+    x, grid = first(ins, "X"), first(ins, "Grid")
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wa = (x1 - gx) * (y1 - gy)
+    wb = (x1 - gx) * (gy - y0)
+    wc = (gx - x0) * (y1 - gy)
+    wd = (gx - x0) * (gy - y0)
+
+    def sample(xi, yi):
+        xi = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yi = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        bidx = jnp.arange(n)[:, None, None]
+        return x[bidx, :, yi, xi]  # [n, oh, ow, c]
+    va = sample(x0, y0)
+    vb = sample(x0, y1)
+    vc = sample(x1, y0)
+    vd = sample(x1, y1)
+    o = (va * wa[..., None] + vb * wb[..., None] + vc * wc[..., None]
+         + vd * wd[..., None])
+    return out(Output=jnp.transpose(o, (0, 3, 1, 2)))
+
+
+@register_op("pad_constant_batch_size_like", inputs=("X", "Y"),
+             diff_inputs=("Y",))
+def _pad_constant_bsl(ins, attrs):
+    return out(Out=first(ins, "Y"))
+
+
+@register_op("squared_l2_distance", inputs=("X", "Y"))
+def _squared_l2_distance(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    d = x - y
+    return out(sub_result=d,
+               Out=jnp.sum(jnp.square(d).reshape(d.shape[0], -1), -1,
+                           keepdims=True))
+
+
+@register_op("center_loss",
+             inputs=("X", "Label", "Centers", "CenterUpdateRate"),
+             diff_inputs=("X",), attr_defaults={"cluster_num": 0,
+                                                "need_update": True})
+def _center_loss(ins, attrs):
+    x, label = first(ins, "X"), first(ins, "Label")
+    centers = first(ins, "Centers")
+    rate = first(ins, "CenterUpdateRate").reshape(())
+    lbl = label.reshape(-1).astype(jnp.int32)
+    picked = centers[lbl]
+    diff = x - picked
+    loss = 0.5 * jnp.sum(jnp.square(diff), -1, keepdims=True)
+    counts = jnp.zeros((centers.shape[0],), x.dtype).at[lbl].add(1.0)
+    upd = jnp.zeros_like(centers).at[lbl].add(diff)
+    new_centers = centers + rate * upd / (counts[:, None] + 1.0)
+    return out(Loss=loss, SampleCenterDiff=diff, CentersOut=new_centers)

@@ -1,0 +1,263 @@
+"""Optimizer update ops (reference: paddle/fluid/operators/optimizers/ —
+sgd_op.cc, momentum_op.cc, adam_op.cc, adamax_op.cc, adagrad_op.cc,
+adadelta_op.cc, rmsprop_op.cc, decayed_adagrad_op.cc, ftrl_op.cc, lamb_op.cc,
+lars_momentum_op.cc, dpsgd_op.cc, proximal_gd_op.cc, proximal_adagrad_op.cc).
+
+The reference mutates Param in place on its device stream; here each op
+returns ParamOut/MomentOut arrays that the executor threads back into the
+state dict — inside a jitted step the whole optimizer pass fuses with the
+backward and XLA donates the old buffers, so updates stay in-place on HBM.
+
+All are no_grad (nothing differentiates through an optimizer step).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, first, out
+
+
+@register_op("sgd", no_grad=True)
+def _sgd(ins, attrs):
+    p, g, lr = first(ins, "Param"), first(ins, "Grad"), first(ins, "LearningRate")
+    return out(ParamOut=p - lr.reshape(()).astype(p.dtype) * g.astype(p.dtype))
+
+
+@register_op("momentum", no_grad=True,
+             attr_defaults={"mu": 0.9, "use_nesterov": False,
+                            "regularization_method": "",
+                            "regularization_coeff": 0.0})
+def _momentum(ins, attrs):
+    p, g = first(ins, "Param"), first(ins, "Grad")
+    v = first(ins, "Velocity")
+    lr = first(ins, "LearningRate").reshape(()).astype(p.dtype)
+    mu = attrs.get("mu", 0.9)
+    if attrs.get("regularization_method", "") == "l2_decay":
+        g = g + attrs.get("regularization_coeff", 0.0) * p
+    v_new = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return out(ParamOut=p_new, VelocityOut=v_new)
+
+
+@register_op("adam", no_grad=True,
+             attr_defaults={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+                            "lazy_mode": False, "min_row_size_to_use_multithread": 1000})
+def _adam(ins, attrs):
+    p, g = first(ins, "Param"), first(ins, "Grad")
+    m, v = first(ins, "Moment1"), first(ins, "Moment2")
+    lr = first(ins, "LearningRate").reshape(()).astype(p.dtype)
+    b1p = first(ins, "Beta1Pow").reshape(()).astype(p.dtype)
+    b2p = first(ins, "Beta2Pow").reshape(()).astype(p.dtype)
+    b1t = first(ins, "Beta1Tensor")
+    b2t = first(ins, "Beta2Tensor")
+    b1 = b1t.reshape(()).astype(p.dtype) if b1t is not None else attrs.get("beta1", 0.9)
+    b2 = b2t.reshape(()).astype(p.dtype) if b2t is not None else attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps * jnp.sqrt(1 - b2p))
+    return out(ParamOut=p_new, Moment1Out=m_new, Moment2Out=v_new,
+               Beta1PowOut=b1p * b1, Beta2PowOut=b2p * b2)
+
+
+@register_op("adamax", no_grad=True,
+             attr_defaults={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8})
+def _adamax(ins, attrs):
+    p, g = first(ins, "Param"), first(ins, "Grad")
+    m, inf = first(ins, "Moment"), first(ins, "InfNorm")
+    lr = first(ins, "LearningRate").reshape(()).astype(p.dtype)
+    b1p = first(ins, "Beta1Pow").reshape(()).astype(p.dtype)
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf, jnp.abs(g))
+    p_new = p - (lr / (1 - b1p)) * (m_new / (inf_new + eps))
+    return out(ParamOut=p_new, MomentOut=m_new, InfNormOut=inf_new)
+
+
+@register_op("adagrad", no_grad=True, attr_defaults={"epsilon": 1e-6})
+def _adagrad(ins, attrs):
+    p, g, m = first(ins, "Param"), first(ins, "Grad"), first(ins, "Moment")
+    lr = first(ins, "LearningRate").reshape(()).astype(p.dtype)
+    eps = attrs.get("epsilon", 1e-6)
+    m_new = m + jnp.square(g)
+    return out(ParamOut=p - lr * g / (jnp.sqrt(m_new) + eps), MomentOut=m_new)
+
+
+@register_op("decayed_adagrad", no_grad=True,
+             attr_defaults={"decay": 0.95, "epsilon": 1e-6})
+def _decayed_adagrad(ins, attrs):
+    p, g, m = first(ins, "Param"), first(ins, "Grad"), first(ins, "Moment")
+    lr = first(ins, "LearningRate").reshape(()).astype(p.dtype)
+    d, eps = attrs.get("decay", 0.95), attrs.get("epsilon", 1e-6)
+    m_new = d * m + (1 - d) * jnp.square(g)
+    return out(ParamOut=p - lr * g / (jnp.sqrt(m_new) + eps), MomentOut=m_new)
+
+
+@register_op("adadelta", no_grad=True,
+             attr_defaults={"rho": 0.95, "epsilon": 1e-6})
+def _adadelta(ins, attrs):
+    p, g = first(ins, "Param"), first(ins, "Grad")
+    ag, au = first(ins, "AvgSquaredGrad"), first(ins, "AvgSquaredUpdate")
+    rho, eps = attrs.get("rho", 0.95), attrs.get("epsilon", 1e-6)
+    ag_new = rho * ag + (1 - rho) * jnp.square(g)
+    upd = -jnp.sqrt((au + eps) / (ag_new + eps)) * g
+    au_new = rho * au + (1 - rho) * jnp.square(upd)
+    return out(ParamOut=p + upd, AvgSquaredGradOut=ag_new,
+               AvgSquaredUpdateOut=au_new)
+
+
+@register_op("rmsprop", no_grad=True,
+             attr_defaults={"decay": 0.9, "momentum": 0.0, "epsilon": 1e-10,
+                            "centered": False})
+def _rmsprop(ins, attrs):
+    p, g = first(ins, "Param"), first(ins, "Grad")
+    ms, mom = first(ins, "MeanSquare"), first(ins, "Moment")
+    mg = first(ins, "MeanGrad")
+    lr = first(ins, "LearningRate").reshape(()).astype(p.dtype)
+    rho = attrs.get("decay", 0.9)
+    mu = attrs.get("momentum", 0.0)
+    eps = attrs.get("epsilon", 1e-10)
+    ms_new = rho * ms + (1 - rho) * jnp.square(g)
+    if attrs.get("centered", False):
+        mg_new = rho * mg + (1 - rho) * g
+        denom = ms_new - jnp.square(mg_new) + eps
+    else:
+        mg_new = mg
+        denom = ms_new + eps
+    mom_new = mu * mom + lr * g / jnp.sqrt(denom)
+    res = out(ParamOut=p - mom_new, MeanSquareOut=ms_new, MomentOut=mom_new)
+    if mg is not None:
+        res.update(out(MeanGradOut=mg_new))
+    return res
+
+
+@register_op("ftrl", no_grad=True,
+             attr_defaults={"l1": 0.0, "l2": 0.0, "lr_power": -0.5})
+def _ftrl(ins, attrs):
+    p, g = first(ins, "Param"), first(ins, "Grad")
+    sq, lin = first(ins, "SquaredAccumulator"), first(ins, "LinearAccumulator")
+    lr = first(ins, "LearningRate").reshape(()).astype(p.dtype)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lp = attrs.get("lr_power", -0.5)
+    new_sq = sq + jnp.square(g)
+    if lp == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (new_sq ** (-lp) - sq ** (-lp)) / lr
+    new_lin = lin + g - sigma * p
+    if lp == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = new_sq ** (-lp) / lr + 2 * l2
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    # zero-grad elements have denom==0 (fresh accumulator): keep the param
+    p_new = jnp.where(denom > 0, pre / jnp.where(denom > 0, denom, 1.0), p)
+    return out(ParamOut=p_new, SquaredAccumOut=new_sq, LinearAccumOut=new_lin)
+
+
+@register_op("lamb", no_grad=True,
+             attr_defaults={"weight_decay": 0.01, "beta1": 0.9, "beta2": 0.999,
+                            "epsilon": 1e-6})
+def _lamb(ins, attrs):
+    p, g = first(ins, "Param"), first(ins, "Grad")
+    m, v = first(ins, "Moment1"), first(ins, "Moment2")
+    lr = first(ins, "LearningRate").reshape(()).astype(p.dtype)
+    b1p = first(ins, "Beta1Pow").reshape(()).astype(p.dtype)
+    b2p = first(ins, "Beta2Pow").reshape(()).astype(p.dtype)
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * jnp.square(g)
+    m_hat = m_new / (1 - b1p)
+    v_hat = v_new / (1 - b2p)
+    r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    ratio = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    return out(ParamOut=p - lr * ratio * r, Moment1Out=m_new, Moment2Out=v_new,
+               Beta1PowOut=b1p * b1, Beta2PowOut=b2p * b2)
+
+
+@register_op("lars_momentum", no_grad=True,
+             attr_defaults={"mu": 0.9, "lars_coeff": 0.001,
+                            "lars_weight_decay": 0.0005, "epsilon": 0.0})
+def _lars_momentum(ins, attrs):
+    p, g = first(ins, "Param"), first(ins, "Grad")
+    v = first(ins, "Velocity")
+    lr = first(ins, "LearningRate").reshape(()).astype(p.dtype)
+    mu = attrs.get("mu", 0.9)
+    lars_coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * lars_coeff * p_norm / (g_norm + wd * p_norm), lr)
+    v_new = mu * v + local_lr * (g + wd * p)
+    return out(ParamOut=p - v_new, VelocityOut=v_new)
+
+
+@register_op("dpsgd", no_grad=True, needs_rng=True,
+             attr_defaults={"clip": 10.0, "batch_size": 16.0, "sigma": 1.0})
+def _dpsgd(ins, attrs):
+    import jax
+    p, g = first(ins, "Param"), first(ins, "Grad")
+    lr = first(ins, "LearningRate").reshape(()).astype(p.dtype)
+    clip = attrs.get("clip", 10.0)
+    sigma = attrs.get("sigma", 1.0)
+    bs = attrs.get("batch_size", 16.0)
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    scale = jnp.minimum(1.0, clip / jnp.maximum(g_norm, 1e-10))
+    noise = sigma * clip * jax.random.normal(attrs["_rng"], g.shape, g.dtype)
+    return out(ParamOut=p - lr * (g * scale + noise) / bs)
+
+
+@register_op("proximal_gd", no_grad=True,
+             attr_defaults={"l1": 0.0, "l2": 0.0})
+def _proximal_gd(ins, attrs):
+    p, g = first(ins, "Param"), first(ins, "Grad")
+    lr = first(ins, "LearningRate").reshape(()).astype(p.dtype)
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    prox = p - lr * g
+    p_new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0) / (1 + lr * l2)
+    return out(ParamOut=p_new)
+
+
+@register_op("proximal_adagrad", no_grad=True,
+             attr_defaults={"l1": 0.0, "l2": 0.0})
+def _proximal_adagrad(ins, attrs):
+    p, g, m = first(ins, "Param"), first(ins, "Grad"), first(ins, "Moment")
+    lr = first(ins, "LearningRate").reshape(()).astype(p.dtype)
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    m_new = m + jnp.square(g)
+    eff_lr = lr / jnp.sqrt(m_new)
+    prox = p - eff_lr * g
+    p_new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff_lr * l1, 0) / (1 + eff_lr * l2)
+    return out(ParamOut=p_new, MomentOut=m_new)
+
+
+@register_op("average_accumulates", no_grad=True,
+             attr_defaults={"average_window": 0.0, "max_average_window": 0,
+                            "min_average_window": 10000})
+def _average_accumulates(ins, attrs):
+    param = first(ins, "param")
+    s1 = first(ins, "in_sum_1")
+    s2 = first(ins, "in_sum_2")
+    s3 = first(ins, "in_sum_3")
+    num_acc = first(ins, "in_num_accumulates")
+    old_num = first(ins, "in_old_num_accumulates")
+    num_upd = first(ins, "in_num_updates")
+    s1 = s1 + param
+    num_acc = num_acc + 1
+    num_upd = num_upd + 1
+    return out(out_sum_1=s1, out_sum_2=s2, out_sum_3=s3,
+               out_num_accumulates=num_acc, out_old_num_accumulates=old_num,
+               out_num_updates=num_upd)

@@ -1,0 +1,233 @@
+"""Operator registry — TPU-native equivalent of the reference's OpInfoMap +
+kernel registry (reference: paddle/fluid/framework/op_info.h:124,
+op_registry.h:223, operator.h:442).
+
+Design inversion for TPU: the reference registers per-device C++ kernel
+functions chosen at run time by OpKernelType; here every op has ONE pure
+JAX kernel ``kernel(ins, attrs) -> outs`` that the executor either applies
+eagerly (interpreter oracle) or traces into a single XLA computation for the
+whole block (compiled mode). Device placement, layout, fusion and memory are
+XLA's job.
+
+Gradient strategy (replaces reference GradOpDescMaker C++ classes,
+grad_op_desc_maker.h): by default an op's grad is derived mechanically from
+its forward kernel with ``jax.vjp``. The generated ``<op>_grad`` op follows
+the reference slot convention: inputs = forward inputs + forward outputs +
+``<out_slot>@GRAD``; outputs = ``<in_slot>@GRAD``. When forward+backward are
+jitted together XLA CSE merges the re-traced forward, so this costs nothing
+at run time. Ops whose reference grad semantics differ (dropout via Mask,
+integer-indexed scatters, …) register custom grad ops / grad makers.
+
+Kernel calling convention:
+    ins:   dict slot_name -> list of jnp arrays (or None for absent
+           dispensable slots). Duplicable slots hold len>1 lists.
+    attrs: dict of python attr values. The executor injects:
+           ``_rng``   (jax PRNG key) if the op declared needs_rng,
+           ``_ctx``   (ExecContext) if the op declared needs_ctx —
+                      such ops are stateful and break pure tracing.
+    returns: dict slot_name -> list of jnp arrays.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+class OpInfo:
+    __slots__ = (
+        "type", "kernel", "infer_shape", "infer_dtype", "grad_maker",
+        "no_grad", "needs_rng", "stateful", "diff_input_slots",
+        "diff_output_slots", "attr_defaults", "input_slots", "output_slots",
+    )
+
+    def __init__(self, type_: str):
+        self.type = type_
+        self.kernel: Optional[Callable] = None
+        self.infer_shape: Optional[Callable] = None
+        self.infer_dtype: Optional[Callable] = None
+        self.grad_maker: Optional[Callable] = None  # custom: (op) -> [opdesc dicts]
+        self.no_grad = False
+        self.needs_rng = False
+        self.stateful = False
+        self.diff_input_slots: Optional[Sequence[str]] = None
+        self.diff_output_slots: Optional[Sequence[str]] = None
+        self.attr_defaults: Dict[str, Any] = {}
+        self.input_slots: Optional[Sequence[str]] = None
+        self.output_slots: Optional[Sequence[str]] = None
+
+
+class OpInfoMap:
+    def __init__(self):
+        self._map: Dict[str, OpInfo] = {}
+
+    def get(self, type_: str) -> OpInfo:
+        info = self._map.get(type_)
+        if info is None:
+            raise KeyError(f"operator '{type_}' is not registered")
+        return info
+
+    def has(self, type_: str) -> bool:
+        return type_ in self._map
+
+    def get_or_create(self, type_: str) -> OpInfo:
+        if type_ not in self._map:
+            self._map[type_] = OpInfo(type_)
+        return self._map[type_]
+
+    def all_op_types(self):
+        return sorted(self._map.keys())
+
+
+OPS = OpInfoMap()
+
+
+def register_op(type_: str, *, no_grad: bool = False, needs_rng: bool = False,
+                stateful: bool = False,
+                diff_inputs: Optional[Sequence[str]] = None,
+                diff_outputs: Optional[Sequence[str]] = None,
+                infer_shape: Optional[Callable] = None,
+                attr_defaults: Optional[Dict[str, Any]] = None,
+                inputs: Optional[Sequence[str]] = None,
+                outputs: Optional[Sequence[str]] = None):
+    """Decorator registering a forward kernel under op name ``type_``."""
+    def deco(fn: Callable):
+        info = OPS.get_or_create(type_)
+        info.kernel = fn
+        info.no_grad = no_grad
+        info.needs_rng = needs_rng
+        info.stateful = stateful
+        info.diff_input_slots = diff_inputs
+        info.diff_output_slots = diff_outputs
+        info.infer_shape = infer_shape
+        info.attr_defaults = dict(attr_defaults or {})
+        info.input_slots = inputs
+        info.output_slots = outputs
+        return fn
+    return deco
+
+
+def register_grad_maker(type_: str):
+    """Decorator registering a custom grad maker for op ``type_``. The maker
+    receives the forward Operator and a dict mapping each forward-output var
+    name to its grad var name, and returns a list of op-desc dicts:
+    ``{"type":..., "inputs": {...}, "outputs": {...}, "attrs": {...}}``."""
+    def deco(fn: Callable):
+        OPS.get_or_create(type_).grad_maker = fn
+        return fn
+    return deco
+
+
+def mark_no_grad(*types: str):
+    for t in types:
+        OPS.get_or_create(t).no_grad = True
+
+
+# --------------------------------------------------------------------------
+# kernel helpers
+# --------------------------------------------------------------------------
+def first(ins: Dict[str, List], slot: str):
+    """Single (non-duplicable) input."""
+    v = ins.get(slot)
+    if not v:
+        return None
+    return v[0]
+
+
+def seq(ins: Dict[str, List], slot: str) -> List:
+    return ins.get(slot) or []
+
+
+def out(**kwargs) -> Dict[str, List]:
+    """outs(Out=x, Mask=[m]) — scalars are wrapped into 1-element lists."""
+    res = {}
+    for k, v in kwargs.items():
+        if v is None:
+            continue
+        res[k] = v if isinstance(v, list) else [v]
+    return res
+
+
+# --------------------------------------------------------------------------
+# generic vjp-based grad execution
+# --------------------------------------------------------------------------
+def _is_diff_leaf(x) -> bool:
+    return x is not None and jnp.issubdtype(jnp.result_type(x), jnp.inexact)
+
+
+def run_generic_grad(fwd_type: str, ins: Dict[str, List], attrs: Dict,
+                     wanted_grad_slots: Sequence[str],
+                     fwd_input_slots: Sequence[str]) -> Dict[str, List]:
+    """Execute ``<fwd_type>_grad`` via jax.vjp over the forward kernel.
+
+    ``ins`` holds forward inputs/outputs by their original slot names plus
+    output grads under ``<slot>@GRAD``. ``fwd_input_slots`` names the slots
+    that were genuine forward inputs (recorded by the default grad maker in
+    the grad op's ``_fwd_in`` attr — slot names like "Y" are inputs for some
+    ops and outputs for others, so this must be explicit). Returns
+    ``<slot>@GRAD`` lists for the requested input slots."""
+    info = OPS.get(fwd_type)
+    fwd_in_slots = [s for s in fwd_input_slots if s in ins]
+    # Partition forward-input leaves into differentiable / constant.
+    diff_sel: Dict[str, List[bool]] = {}
+    allowed = set(info.diff_input_slots) if info.diff_input_slots else None
+    for s in fwd_in_slots:
+        vals = ins[s] or []
+        diff_sel[s] = [
+            _is_diff_leaf(v) and (allowed is None or s in allowed)
+            for v in vals
+        ]
+    diff_part = {s: [v for v, d in zip(ins[s], diff_sel[s]) if d]
+                 for s in fwd_in_slots}
+    diff_part = {s: v for s, v in diff_part.items() if v}
+
+    def fwd(dp):
+        merged = {}
+        for s in fwd_in_slots:
+            vals = list(ins[s] or [])
+            it = iter(dp.get(s, []))
+            merged[s] = [next(it) if d else v for v, d in zip(vals, diff_sel[s])]
+        outs = info.kernel(merged, attrs)
+        # Only outputs that have incoming grads (or are float) participate.
+        return {k: v for k, v in outs.items()
+                if any(_is_diff_leaf(x) for x in v)}
+
+    primals_out, vjp_fn = jax.vjp(fwd, diff_part)
+
+    cotangents = {}
+    for oslot, ovals in primals_out.items():
+        gslot = oslot + GRAD_SUFFIX
+        gvals = ins.get(gslot)
+        cots = []
+        for i, ov in enumerate(ovals):
+            g = gvals[i] if gvals is not None and i < len(gvals) and gvals[i] is not None else None
+            if g is None:
+                g = jnp.zeros_like(ov)
+            else:
+                g = jnp.asarray(g, ov.dtype) if g.dtype != ov.dtype else g
+                if g.shape != ov.shape:
+                    g = jnp.broadcast_to(g, ov.shape)
+            cots.append(g)
+        cotangents[oslot] = cots
+
+    (grads_in,) = vjp_fn(cotangents)
+
+    result: Dict[str, List] = {}
+    for s in fwd_in_slots:
+        gslot = s + GRAD_SUFFIX
+        if gslot not in wanted_grad_slots:
+            continue
+        gl = []
+        it = iter(grads_in.get(s, []))
+        for v, d in zip(ins[s] or [], diff_sel[s]):
+            gl.append(next(it) if d else None)
+        result[gslot] = gl
+    return result

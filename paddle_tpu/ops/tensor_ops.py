@@ -1,0 +1,728 @@
+"""Tensor creation / manipulation op kernels (reference: the corresponding
+operators under paddle/fluid/operators/: fill_constant_op.cc, reshape_op.cc,
+transpose_op.cc, concat_op.cc, split_op.cc, gather/scatter, slice_op.cc,
+one_hot, cast, random ops …).
+
+Random ops draw from the executor-threaded PRNG key (attrs["_rng"]) instead
+of stateful cuRAND generators — this keeps the whole block a pure function
+of (state, feeds, step key), which is what lets it live under one jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, first, seq, out
+from ..fluid.core import dtype_to_jnp
+
+
+def _shape_from(ins, attrs, key="shape"):
+    """Resolve shape from ShapeTensor/ShapeTensorList inputs or attr."""
+    st = first(ins, "ShapeTensor")
+    if st is not None:
+        return [int(x) for x in np.asarray(st)]
+    stl = seq(ins, "ShapeTensorList")
+    if stl:
+        return [int(np.asarray(s).reshape(())) for s in stl]
+    return [int(s) for s in attrs.get(key, [])]
+
+
+# --------------------------------------------------------------------------
+# creation
+# --------------------------------------------------------------------------
+@register_op("fill_constant", inputs=("ShapeTensor", "ShapeTensorList", "ValueTensor"),
+             no_grad=True, attr_defaults={"value": 0.0, "shape": [],
+                                          "dtype": 5, "str_value": ""})
+def _fill_constant(ins, attrs):
+    shape = _shape_from(ins, attrs)
+    dt = dtype_to_jnp(attrs.get("dtype", 5))
+    vt = first(ins, "ValueTensor")
+    if vt is not None:
+        return out(Out=jnp.broadcast_to(vt.astype(dt).reshape(()), shape))
+    sv = attrs.get("str_value", "")
+    val = float(sv) if sv not in ("", None) else attrs.get("value", 0.0)
+    return out(Out=jnp.full(shape, val, dt))
+
+
+@register_op("fill_any_like", inputs=("X",), no_grad=True,
+             attr_defaults={"value": 0.0, "dtype": -1})
+def _fill_any_like(ins, attrs):
+    x = first(ins, "X")
+    dt = attrs.get("dtype", -1)
+    dt = x.dtype if dt in (-1, None) else dtype_to_jnp(dt)
+    return out(Out=jnp.full(x.shape, attrs.get("value", 0.0), dt))
+
+
+@register_op("fill_zeros_like", inputs=("X",), no_grad=True)
+def _fill_zeros_like(ins, attrs):
+    return out(Out=jnp.zeros_like(first(ins, "X")))
+
+
+@register_op("fill_constant_batch_size_like", inputs=("Input",), no_grad=True,
+             attr_defaults={"shape": [], "value": 0.0, "dtype": 5,
+                            "input_dim_idx": 0, "output_dim_idx": 0})
+def _fill_constant_bsl(ins, attrs):
+    x = first(ins, "Input")
+    shape = [int(s) for s in attrs["shape"]]
+    shape[attrs.get("output_dim_idx", 0)] = x.shape[attrs.get("input_dim_idx", 0)]
+    return out(Out=jnp.full(shape, attrs.get("value", 0.0),
+                            dtype_to_jnp(attrs.get("dtype", 5))))
+
+
+@register_op("eye", no_grad=True,
+             attr_defaults={"num_rows": 1, "num_columns": -1, "dtype": 5})
+def _eye(ins, attrs):
+    n = attrs["num_rows"]
+    m = attrs.get("num_columns", -1)
+    m = n if m in (-1, None) else m
+    return out(Out=jnp.eye(n, m, dtype=dtype_to_jnp(attrs.get("dtype", 5))))
+
+
+@register_op("diag", inputs=("Diagonal",), no_grad=True)
+def _diag(ins, attrs):
+    return out(Out=jnp.diag(first(ins, "Diagonal")))
+
+
+@register_op("diag_embed", inputs=("Input",),
+             attr_defaults={"offset": 0, "dim1": -2, "dim2": -1})
+def _diag_embed(ins, attrs):
+    x = first(ins, "Input")
+    offset = int(attrs.get("offset", 0))
+    n = x.shape[-1] + abs(offset)
+    i = jnp.arange(x.shape[-1])
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    o = jnp.zeros(x.shape[:-1] + (n, n), x.dtype).at[..., r, c].set(x)
+    ndim = o.ndim
+    dim1 = int(attrs.get("dim1", -2)) % ndim
+    dim2 = int(attrs.get("dim2", -1)) % ndim
+    if (dim1, dim2) != (ndim - 2, ndim - 1):
+        o = jnp.moveaxis(o, (ndim - 2, ndim - 1), (dim1, dim2))
+    return out(Out=o)
+
+
+@register_op("range", inputs=("Start", "End", "Step"), no_grad=True)
+def _range(ins, attrs):
+    s = float(np.asarray(first(ins, "Start")).reshape(()))
+    e = float(np.asarray(first(ins, "End")).reshape(()))
+    st = float(np.asarray(first(ins, "Step")).reshape(()))
+    dt = first(ins, "Start").dtype
+    return out(Out=jnp.arange(s, e, st, dtype=dt))
+
+
+@register_op("linspace", inputs=("Start", "Stop", "Num"), no_grad=True)
+def _linspace(ins, attrs):
+    s = np.asarray(first(ins, "Start")).reshape(())
+    e = np.asarray(first(ins, "Stop")).reshape(())
+    n = int(np.asarray(first(ins, "Num")).reshape(()))
+    return out(Out=jnp.linspace(s, e, n, dtype=first(ins, "Start").dtype))
+
+
+@register_op("assign", inputs=("X",))
+def _assign(ins, attrs):
+    return out(Out=first(ins, "X"))
+
+
+@register_op("assign_value", no_grad=True,
+             attr_defaults={"shape": [], "dtype": 5, "fp32_values": [],
+                            "int32_values": [], "int64_values": [],
+                            "bool_values": []})
+def _assign_value(ins, attrs):
+    dt = attrs.get("dtype", 5)
+    vals = (attrs.get("fp32_values") or attrs.get("int32_values")
+            or attrs.get("int64_values") or attrs.get("bool_values") or [])
+    return out(Out=jnp.asarray(np.array(vals, dtype=np.dtype(dtype_to_jnp(dt)))
+                               .reshape([int(s) for s in attrs["shape"]])))
+
+
+@register_op("shape", inputs=("Input",), no_grad=True)
+def _shape(ins, attrs):
+    return out(Out=jnp.asarray(first(ins, "Input").shape, jnp.int32))
+
+
+@register_op("size", inputs=("Input",), no_grad=True)
+def _size(ins, attrs):
+    return out(Out=jnp.asarray(first(ins, "Input").size, jnp.int32).reshape((1,)))
+
+
+@register_op("cast", inputs=("X",),
+             attr_defaults={"in_dtype": 5, "out_dtype": 5})
+def _cast(ins, attrs):
+    return out(Out=first(ins, "X").astype(dtype_to_jnp(attrs["out_dtype"])))
+
+
+# --------------------------------------------------------------------------
+# random (rng threaded by executor via attrs["_rng"])
+# --------------------------------------------------------------------------
+@register_op("uniform_random", needs_rng=True, no_grad=True,
+             inputs=("ShapeTensor", "ShapeTensorList"),
+             attr_defaults={"shape": [], "min": -1.0, "max": 1.0, "seed": 0,
+                            "dtype": 5})
+def _uniform_random(ins, attrs):
+    shape = _shape_from(ins, attrs)
+    dt = dtype_to_jnp(attrs.get("dtype", 5))
+    return out(Out=jax.random.uniform(attrs["_rng"], shape, dt,
+                                      attrs.get("min", -1.0),
+                                      attrs.get("max", 1.0)))
+
+
+@register_op("uniform_random_batch_size_like", needs_rng=True, no_grad=True,
+             inputs=("Input",),
+             attr_defaults={"shape": [], "min": -1.0, "max": 1.0, "seed": 0,
+                            "dtype": 5, "input_dim_idx": 0, "output_dim_idx": 0})
+def _uniform_random_bsl(ins, attrs):
+    x = first(ins, "Input")
+    shape = [int(s) for s in attrs["shape"]]
+    shape[attrs.get("output_dim_idx", 0)] = x.shape[attrs.get("input_dim_idx", 0)]
+    return out(Out=jax.random.uniform(attrs["_rng"], shape,
+                                      dtype_to_jnp(attrs.get("dtype", 5)),
+                                      attrs.get("min", -1.0), attrs.get("max", 1.0)))
+
+
+@register_op("gaussian_random", needs_rng=True, no_grad=True,
+             inputs=("ShapeTensor", "ShapeTensorList"),
+             attr_defaults={"shape": [], "mean": 0.0, "std": 1.0, "seed": 0,
+                            "dtype": 5})
+def _gaussian_random(ins, attrs):
+    shape = _shape_from(ins, attrs)
+    dt = dtype_to_jnp(attrs.get("dtype", 5))
+    return out(Out=attrs.get("mean", 0.0)
+               + attrs.get("std", 1.0) * jax.random.normal(attrs["_rng"], shape, dt))
+
+
+@register_op("gaussian_random_batch_size_like", needs_rng=True, no_grad=True,
+             inputs=("Input",),
+             attr_defaults={"shape": [], "mean": 0.0, "std": 1.0, "seed": 0,
+                            "dtype": 5, "input_dim_idx": 0, "output_dim_idx": 0})
+def _gaussian_random_bsl(ins, attrs):
+    x = first(ins, "Input")
+    shape = [int(s) for s in attrs["shape"]]
+    shape[attrs.get("output_dim_idx", 0)] = x.shape[attrs.get("input_dim_idx", 0)]
+    return out(Out=attrs.get("mean", 0.0) + attrs.get("std", 1.0)
+               * jax.random.normal(attrs["_rng"], shape,
+                                   dtype_to_jnp(attrs.get("dtype", 5))))
+
+
+@register_op("truncated_gaussian_random", needs_rng=True, no_grad=True,
+             attr_defaults={"shape": [], "mean": 0.0, "std": 1.0, "seed": 0,
+                            "dtype": 5})
+def _truncated_gaussian_random(ins, attrs):
+    shape = [int(s) for s in attrs["shape"]]
+    dt = dtype_to_jnp(attrs.get("dtype", 5))
+    t = jax.random.truncated_normal(attrs["_rng"], -2.0, 2.0, shape, dt)
+    return out(Out=attrs.get("mean", 0.0) + attrs.get("std", 1.0) * t)
+
+
+@register_op("randint", needs_rng=True, no_grad=True,
+             inputs=("ShapeTensor", "ShapeTensorList"),
+             attr_defaults={"shape": [], "low": 0, "high": 0, "seed": 0,
+                            "dtype": 3})
+def _randint(ins, attrs):
+    shape = _shape_from(ins, attrs)
+    return out(Out=jax.random.randint(attrs["_rng"], shape, attrs.get("low", 0),
+                                      attrs.get("high", 1),
+                                      dtype_to_jnp(attrs.get("dtype", 3))))
+
+
+@register_op("randperm", needs_rng=True, no_grad=True,
+             attr_defaults={"n": 1, "seed": 0, "dtype": 3})
+def _randperm(ins, attrs):
+    return out(Out=jax.random.permutation(attrs["_rng"], attrs["n"]).astype(
+        dtype_to_jnp(attrs.get("dtype", 3))))
+
+
+@register_op("sampling_id", needs_rng=True, no_grad=True, inputs=("X",),
+             attr_defaults={"min": 0.0, "max": 1.0, "seed": 0})
+def _sampling_id(ins, attrs):
+    x = first(ins, "X")
+    return out(Out=jax.random.categorical(attrs["_rng"], jnp.log(x + 1e-20), -1))
+
+
+@register_op("seed", no_grad=True, attr_defaults={"seed": 0})
+def _seed(ins, attrs):
+    return out(Out=jnp.asarray([attrs.get("seed", 0)], jnp.int32))
+
+
+# --------------------------------------------------------------------------
+# shape manipulation
+# --------------------------------------------------------------------------
+def _infer_reshape(x_shape, target):
+    target = list(target)
+    for i, t in enumerate(target):
+        if t == 0:
+            target[i] = x_shape[i]
+    if -1 in target:
+        known = int(np.prod([t for t in target if t != -1]))
+        target[target.index(-1)] = int(np.prod(x_shape)) // max(known, 1)
+    return target
+
+
+@register_op("reshape", inputs=("X", "Shape", "ShapeTensor"),
+             attr_defaults={"shape": []})
+def _reshape(ins, attrs):
+    x = first(ins, "X")
+    sh = first(ins, "Shape")
+    target = ([int(v) for v in np.asarray(sh)] if sh is not None
+              else _shape_from(ins, attrs))
+    return out(Out=x.reshape(_infer_reshape(x.shape, target)))
+
+
+@register_op("reshape2", inputs=("X", "Shape", "ShapeTensor"),
+             attr_defaults={"shape": []})
+def _reshape2(ins, attrs):
+    x = first(ins, "X")
+    sh = first(ins, "Shape")
+    target = ([int(v) for v in np.asarray(sh)] if sh is not None
+              else _shape_from(ins, attrs))
+    return out(Out=x.reshape(_infer_reshape(x.shape, target)),
+               XShape=jnp.zeros((0,) + x.shape, x.dtype))
+
+
+@register_op("transpose", inputs=("X",), attr_defaults={"axis": []})
+def _transpose(ins, attrs):
+    return out(Out=jnp.transpose(first(ins, "X"), attrs["axis"]))
+
+
+@register_op("transpose2", inputs=("X",), attr_defaults={"axis": []})
+def _transpose2(ins, attrs):
+    x = first(ins, "X")
+    return out(Out=jnp.transpose(x, attrs["axis"]),
+               XShape=jnp.zeros((0,) + x.shape, x.dtype))
+
+
+@register_op("flatten", inputs=("X",), attr_defaults={"axis": 1})
+def _flatten(ins, attrs):
+    x = first(ins, "X")
+    ax = attrs.get("axis", 1)
+    return out(Out=x.reshape((int(np.prod(x.shape[:ax])), -1)))
+
+
+@register_op("flatten2", inputs=("X",), attr_defaults={"axis": 1})
+def _flatten2(ins, attrs):
+    x = first(ins, "X")
+    ax = attrs.get("axis", 1)
+    return out(Out=x.reshape((int(np.prod(x.shape[:ax])), -1)),
+               XShape=jnp.zeros((0,) + x.shape, x.dtype))
+
+
+@register_op("flatten_contiguous_range", inputs=("X",),
+             attr_defaults={"start_axis": 1, "stop_axis": 1})
+def _flatten_range(ins, attrs):
+    x = first(ins, "X")
+    s, e = attrs.get("start_axis", 1), attrs.get("stop_axis", 1)
+    s, e = s % x.ndim, e % x.ndim
+    shape = x.shape[:s] + (int(np.prod(x.shape[s:e + 1])),) + x.shape[e + 1:]
+    return out(Out=x.reshape(shape), XShape=jnp.zeros((0,) + x.shape, x.dtype))
+
+
+@register_op("squeeze", inputs=("X",), attr_defaults={"axes": []})
+def _squeeze(ins, attrs):
+    x = first(ins, "X")
+    axes = [a % x.ndim for a in attrs.get("axes", [])]
+    if not axes:
+        axes = [i for i, s in enumerate(x.shape) if s == 1]
+    axes = [a for a in axes if x.shape[a] == 1]
+    return out(Out=jnp.squeeze(x, tuple(axes)))
+
+
+@register_op("squeeze2", inputs=("X",), attr_defaults={"axes": []})
+def _squeeze2(ins, attrs):
+    x = first(ins, "X")
+    o = _squeeze(ins, attrs)["Out"][0]
+    return out(Out=o, XShape=jnp.zeros((0,) + x.shape, x.dtype))
+
+
+@register_op("unsqueeze", inputs=("X",), attr_defaults={"axes": []})
+def _unsqueeze(ins, attrs):
+    x = first(ins, "X")
+    for a in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return out(Out=x)
+
+
+@register_op("unsqueeze2", inputs=("X",), attr_defaults={"axes": []})
+def _unsqueeze2(ins, attrs):
+    x = first(ins, "X")
+    o = x
+    for a in sorted(attrs["axes"]):
+        o = jnp.expand_dims(o, a)
+    return out(Out=o, XShape=jnp.zeros((0,) + x.shape, x.dtype))
+
+
+@register_op("concat", inputs=("X", "AxisTensor"), attr_defaults={"axis": 0})
+def _concat(ins, attrs):
+    xs = seq(ins, "X")
+    at = first(ins, "AxisTensor")
+    ax = int(np.asarray(at).reshape(())) if at is not None else int(attrs.get("axis", 0))
+    return out(Out=jnp.concatenate(xs, axis=ax))
+
+
+@register_op("split", inputs=("X", "AxisTensor", "SectionsTensorList"),
+             attr_defaults={"axis": 0, "num": 0, "sections": []})
+def _split(ins, attrs):
+    x = first(ins, "X")
+    at = first(ins, "AxisTensor")
+    ax = int(np.asarray(at).reshape(())) if at is not None else int(attrs.get("axis", 0))
+    sections = attrs.get("sections") or []
+    num = attrs.get("num", 0)
+    if sections:
+        sections = list(sections)
+        if -1 in sections:
+            known = sum(s for s in sections if s != -1)
+            sections[sections.index(-1)] = x.shape[ax] - known
+        idx = np.cumsum(sections)[:-1].tolist()
+        parts = jnp.split(x, idx, axis=ax)
+    else:
+        parts = jnp.split(x, num, axis=ax)
+    return out(Out=list(parts))
+
+
+@register_op("stack", inputs=("X",), attr_defaults={"axis": 0})
+def _stack(ins, attrs):
+    return out(Y=jnp.stack(seq(ins, "X"), axis=attrs.get("axis", 0)))
+
+
+@register_op("unstack", inputs=("X",), attr_defaults={"axis": 0, "num": 0})
+def _unstack(ins, attrs):
+    x = first(ins, "X")
+    ax = attrs.get("axis", 0) % x.ndim
+    return out(Y=[jnp.squeeze(s, ax) for s in jnp.split(x, x.shape[ax], axis=ax)])
+
+
+@register_op("unbind", inputs=("X",), attr_defaults={"axis": 0})
+def _unbind(ins, attrs):
+    x = first(ins, "X")
+    ax = attrs.get("axis", 0) % x.ndim
+    return out(Out=[jnp.squeeze(s, ax) for s in jnp.split(x, x.shape[ax], axis=ax)])
+
+
+@register_op("expand", inputs=("X", "ExpandTimes"),
+             attr_defaults={"expand_times": []})
+def _expand(ins, attrs):
+    x = first(ins, "X")
+    et = first(ins, "ExpandTimes")
+    times = ([int(v) for v in np.asarray(et)] if et is not None
+             else [int(t) for t in attrs["expand_times"]])
+    return out(Out=jnp.tile(x, times))
+
+
+@register_op("expand_as", inputs=("X", "target_tensor"))
+def _expand_as(ins, attrs):
+    x, t = first(ins, "X"), first(ins, "target_tensor")
+    times = [ts // xs for ts, xs in zip(t.shape, x.shape)]
+    return out(Out=jnp.tile(x, times))
+
+
+@register_op("tile", inputs=("X",), attr_defaults={"repeat_times": []})
+def _tile(ins, attrs):
+    return out(Out=jnp.tile(first(ins, "X"), attrs["repeat_times"]))
+
+
+@register_op("slice", inputs=("Input", "StartsTensor", "EndsTensor"),
+             attr_defaults={"axes": [], "starts": [], "ends": [],
+                            "decrease_axis": [], "infer_flags": []})
+def _slice(ins, attrs):
+    x = first(ins, "Input")
+    st = first(ins, "StartsTensor")
+    et = first(ins, "EndsTensor")
+    starts = ([int(v) for v in np.asarray(st)] if st is not None
+              else [int(s) for s in attrs["starts"]])
+    ends = ([int(v) for v in np.asarray(et)] if et is not None
+            else [int(e) for e in attrs["ends"]])
+    idx = [slice(None)] * x.ndim
+    for ax, s, e in zip(attrs["axes"], starts, ends):
+        dim = x.shape[ax]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[ax] = slice(s, e)
+    o = x[tuple(idx)]
+    dec = attrs.get("decrease_axis") or []
+    if dec:
+        o = jnp.squeeze(o, tuple(d for d in dec if o.shape[d] == 1))
+        if o.ndim == 0:
+            o = o.reshape((1,))
+    return out(Out=o)
+
+
+@register_op("strided_slice", inputs=("Input",),
+             attr_defaults={"axes": [], "starts": [], "ends": [],
+                            "strides": [], "decrease_axis": [],
+                            "infer_flags": []})
+def _strided_slice(ins, attrs):
+    x = first(ins, "Input")
+    idx = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                            attrs["strides"]):
+        idx[ax] = slice(s, e, st)
+    o = x[tuple(idx)]
+    dec = attrs.get("decrease_axis") or []
+    if dec:
+        o = jnp.squeeze(o, tuple(dec))
+    return out(Out=o)
+
+
+@register_op("gather", inputs=("X", "Index"), diff_inputs=("X",))
+def _gather(ins, attrs):
+    x, idx = first(ins, "X"), first(ins, "Index")
+    return out(Out=jnp.take(x, idx.reshape(-1), axis=0))
+
+
+@register_op("gather_nd", inputs=("X", "Index"), diff_inputs=("X",))
+def _gather_nd(ins, attrs):
+    x, idx = first(ins, "X"), first(ins, "Index")
+    return out(Out=x[tuple(jnp.moveaxis(idx, -1, 0))])
+
+
+@register_op("scatter", inputs=("X", "Ids", "Updates"),
+             diff_inputs=("X", "Updates"), attr_defaults={"overwrite": True})
+def _scatter(ins, attrs):
+    x, ids, upd = first(ins, "X"), first(ins, "Ids"), first(ins, "Updates")
+    ids = ids.reshape(-1)
+    if attrs.get("overwrite", True):
+        return out(Out=x.at[ids].set(upd))
+    return out(Out=x.at[ids].set(0.0 * x[ids]).at[ids].add(upd))
+
+
+@register_op("scatter_nd_add", inputs=("X", "Index", "Updates"),
+             diff_inputs=("X", "Updates"))
+def _scatter_nd_add(ins, attrs):
+    x, idx, upd = first(ins, "X"), first(ins, "Index"), first(ins, "Updates")
+    return out(Out=x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd))
+
+
+@register_op("index_select", inputs=("X", "Index"), diff_inputs=("X",),
+             attr_defaults={"dim": 0})
+def _index_select(ins, attrs):
+    return out(Out=jnp.take(first(ins, "X"), first(ins, "Index"),
+                            axis=attrs.get("dim", 0)))
+
+
+@register_op("index_sample", inputs=("X", "Index"), diff_inputs=("X",))
+def _index_sample(ins, attrs):
+    x, idx = first(ins, "X"), first(ins, "Index")
+    return out(Out=jnp.take_along_axis(x, idx, axis=1))
+
+
+@register_op("where", inputs=("Condition", "X", "Y"), diff_inputs=("X", "Y"))
+def _where(ins, attrs):
+    return out(Out=jnp.where(first(ins, "Condition"), first(ins, "X"),
+                             first(ins, "Y")))
+
+
+@register_op("where_index", inputs=("Condition",), no_grad=True, stateful=True)
+def _where_index(ins, attrs):
+    # data-dependent shape: interpreter-only (like reference where_index)
+    cond = np.asarray(first(ins, "Condition"))
+    return out(Out=jnp.asarray(np.stack(np.nonzero(cond), axis=1), jnp.int32))
+
+
+@register_op("one_hot", inputs=("X", "depth_tensor"), no_grad=True,
+             attr_defaults={"depth": 1, "dtype": 5, "allow_out_of_range": False})
+def _one_hot(ins, attrs):
+    x = first(ins, "X")
+    dt = first(ins, "depth_tensor")
+    depth = int(np.asarray(dt).reshape(())) if dt is not None else attrs["depth"]
+    o = jax.nn.one_hot(jnp.squeeze(x, -1) if x.shape[-1] == 1 else x, depth,
+                       dtype=dtype_to_jnp(attrs.get("dtype", 5)))
+    return out(Out=o)
+
+
+@register_op("one_hot_v2", inputs=("X", "depth_tensor"), no_grad=True,
+             attr_defaults={"depth": 1, "dtype": 5, "allow_out_of_range": False})
+def _one_hot_v2(ins, attrs):
+    x = first(ins, "X")
+    dt = first(ins, "depth_tensor")
+    depth = int(np.asarray(dt).reshape(())) if dt is not None else attrs["depth"]
+    return out(Out=jax.nn.one_hot(x, depth, dtype=dtype_to_jnp(attrs.get("dtype", 5))))
+
+
+@register_op("arg_max", inputs=("X",), no_grad=True,
+             attr_defaults={"axis": -1, "keepdims": False, "dtype": 3})
+def _arg_max(ins, attrs):
+    return out(Out=jnp.argmax(first(ins, "X"), axis=attrs.get("axis", -1)).astype(
+        dtype_to_jnp(attrs.get("dtype", 3) if attrs.get("dtype", 3) > 0 else 3)))
+
+
+@register_op("arg_min", inputs=("X",), no_grad=True,
+             attr_defaults={"axis": -1, "keepdims": False, "dtype": 3})
+def _arg_min(ins, attrs):
+    return out(Out=jnp.argmin(first(ins, "X"), axis=attrs.get("axis", -1)).astype(jnp.int32))
+
+
+@register_op("argsort", inputs=("X",), no_grad=True,
+             attr_defaults={"axis": -1, "descending": False})
+def _argsort(ins, attrs):
+    x = first(ins, "X")
+    ax = attrs.get("axis", -1)
+    if attrs.get("descending", False):
+        idx = jnp.argsort(-x, axis=ax)
+    else:
+        idx = jnp.argsort(x, axis=ax)
+    o = jnp.take_along_axis(x, idx, axis=ax)
+    return out(Out=o, Indices=idx.astype(jnp.int32))
+
+
+@register_op("top_k", inputs=("X", "K"), diff_inputs=("X",),
+             attr_defaults={"k": 1})
+def _top_k(ins, attrs):
+    x = first(ins, "X")
+    kt = first(ins, "K")
+    k = int(np.asarray(kt).reshape(())) if kt is not None else attrs.get("k", 1)
+    vals, idx = lax.top_k(x, k)
+    return out(Out=vals, Indices=idx.astype(jnp.int32))
+
+
+@register_op("top_k_v2", inputs=("X", "K"), diff_inputs=("X",),
+             attr_defaults={"k": 1, "axis": -1, "largest": True, "sorted": True})
+def _top_k_v2(ins, attrs):
+    x = first(ins, "X")
+    kt = first(ins, "K")
+    k = int(np.asarray(kt).reshape(())) if kt is not None else attrs.get("k", 1)
+    ax = attrs.get("axis", -1) % x.ndim
+    xs = jnp.moveaxis(x, ax, -1)
+    if attrs.get("largest", True):
+        vals, idx = lax.top_k(xs, k)
+    else:
+        vals, idx = lax.top_k(-xs, k)
+        vals = -vals
+    return out(Out=jnp.moveaxis(vals, -1, ax),
+               Indices=jnp.moveaxis(idx, -1, ax).astype(jnp.int32))
+
+
+@register_op("reverse", inputs=("X",), attr_defaults={"axis": []})
+def _reverse(ins, attrs):
+    x = first(ins, "X")
+    return out(Out=jnp.flip(x, [a % x.ndim for a in attrs["axis"]]))
+
+
+@register_op("flip", inputs=("X",), attr_defaults={"axis": []})
+def _flip(ins, attrs):
+    x = first(ins, "X")
+    return out(Out=jnp.flip(x, [a % x.ndim for a in attrs["axis"]]))
+
+
+@register_op("roll", inputs=("X",), attr_defaults={"shifts": [], "dims": []})
+def _roll(ins, attrs):
+    x = first(ins, "X")
+    dims = attrs.get("dims") or attrs.get("axis") or []
+    if not dims:
+        return out(Out=jnp.roll(x.reshape(-1), attrs["shifts"][0]).reshape(x.shape))
+    return out(Out=jnp.roll(x, attrs["shifts"], dims))
+
+
+@register_op("pad", inputs=("X",),
+             attr_defaults={"paddings": [], "pad_value": 0.0})
+def _pad(ins, attrs):
+    x = first(ins, "X")
+    p = attrs["paddings"]
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return out(Out=jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0)))
+
+
+@register_op("pad2d", inputs=("X",),
+             attr_defaults={"paddings": [0, 0, 0, 0], "mode": "constant",
+                            "pad_value": 0.0, "data_format": "NCHW"})
+def _pad2d(ins, attrs):
+    x = first(ins, "X")
+    p = attrs["paddings"]
+    mode = {"constant": "constant", "reflect": "reflect", "edge": "edge"}[
+        attrs.get("mode", "constant")]
+    if attrs.get("data_format", "NCHW") == "NCHW":
+        pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    else:
+        pads = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    kw = {"constant_values": attrs.get("pad_value", 0.0)} if mode == "constant" else {}
+    return out(Out=jnp.pad(x, pads, mode=mode, **kw))
+
+
+@register_op("pad_constant_like", inputs=("X", "Y"), diff_inputs=("Y",))
+def _pad_constant_like(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return out(Out=jnp.pad(y, pads, constant_values=attrs.get("pad_value", 0.0)))
+
+
+@register_op("meshgrid", inputs=("X",))
+def _meshgrid(ins, attrs):
+    return out(Out=list(jnp.meshgrid(*seq(ins, "X"), indexing="ij")))
+
+
+@register_op("tril_triu", inputs=("X",),
+             attr_defaults={"diagonal": 0, "lower": True})
+def _tril_triu(ins, attrs):
+    x = first(ins, "X")
+    d = attrs.get("diagonal", 0)
+    o = jnp.tril(x, d) if attrs.get("lower", True) else jnp.triu(x, d)
+    return out(Out=o)
+
+
+@register_op("unique", inputs=("X",), no_grad=True, stateful=True,
+             attr_defaults={"dtype": 2})
+def _unique(ins, attrs):
+    x = np.asarray(first(ins, "X"))
+    o, idx = np.unique(x, return_inverse=True)
+    # reference keeps first-occurrence order
+    order = np.argsort(np.unique(x, return_index=True)[1])
+    remap = np.empty_like(order)
+    remap[order] = np.arange(len(order))
+    return out(Out=jnp.asarray(o[order]),
+               Index=jnp.asarray(remap[idx], dtype_to_jnp(attrs.get("dtype", 2))))
+
+
+@register_op("unique_with_counts", inputs=("X",), no_grad=True, stateful=True,
+             attr_defaults={"dtype": 2})
+def _unique_with_counts(ins, attrs):
+    x = np.asarray(first(ins, "X"))
+    o, first_idx, inv, counts = np.unique(x, return_index=True,
+                                          return_inverse=True,
+                                          return_counts=True)
+    order = np.argsort(first_idx)
+    remap = np.empty_like(order)
+    remap[order] = np.arange(len(order))
+    return out(Out=jnp.asarray(o[order]),
+               Index=jnp.asarray(remap[inv], dtype_to_jnp(attrs.get("dtype", 2))),
+               Count=jnp.asarray(counts[order], dtype_to_jnp(attrs.get("dtype", 2))))
+
+
+@register_op("shard_index", inputs=("X",), no_grad=True,
+             attr_defaults={"index_num": 0, "nshards": 1, "shard_id": 0,
+                            "ignore_value": -1})
+def _shard_index(ins, attrs):
+    x = first(ins, "X")
+    shard_size = (attrs["index_num"] + attrs["nshards"] - 1) // attrs["nshards"]
+    lo = attrs["shard_id"] * shard_size
+    in_shard = (x // shard_size) == attrs["shard_id"]
+    return out(Out=jnp.where(in_shard, x - lo, attrs.get("ignore_value", -1)))
+
+
+@register_op("multiplex", inputs=("X", "Ids"), diff_inputs=("X",))
+def _multiplex(ins, attrs):
+    xs = jnp.stack(seq(ins, "X"), axis=0)  # [k, n, d]
+    ids = first(ins, "Ids").reshape(-1)
+    n = xs.shape[1]
+    return out(Out=xs[ids, jnp.arange(n)])
+
+
+@register_op("cross", inputs=("X", "Y"), attr_defaults={"dim": -1})
+def _cross(ins, attrs):
+    d = attrs.get("dim", -1)
+    return out(Out=jnp.cross(first(ins, "X"), first(ins, "Y"), axis=d))
+
+
+@register_op("is_empty", inputs=("X",), no_grad=True)
+def _is_empty(ins, attrs):
+    return out(Out=jnp.asarray([first(ins, "X").size == 0]))
+
+
+@register_op("label_smooth", inputs=("X", "PriorDist"), diff_inputs=("X",),
+             attr_defaults={"epsilon": 0.0})
+def _label_smooth(ins, attrs):
+    x = first(ins, "X")
+    eps = attrs.get("epsilon", 0.0)
+    prior = first(ins, "PriorDist")
+    k = x.shape[-1]
+    if prior is None:
+        return out(Out=(1 - eps) * x + eps / k)
+    return out(Out=(1 - eps) * x + eps * prior.reshape((1,) * (x.ndim - 1) + (k,)))

@@ -1,0 +1,4 @@
+"""Parallelism runtime: mesh management, data/model/pipeline parallel,
+Fleet API (reference: Fleet + transpiler + ParallelExecutor stack, re-built
+on jax.sharding.Mesh + pjit/shard_map over ICI)."""
+from . import env  # noqa: F401

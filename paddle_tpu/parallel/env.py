@@ -1,0 +1,32 @@
+"""Distributed environment: mesh registry (ring_id → axis) + PADDLE_* env
+contract (reference: launch env in python/paddle/distributed/launch.py:193
+and role_maker.py:442)."""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_mesh = None
+
+
+def set_mesh(mesh):
+    global _mesh
+    _mesh = mesh
+
+
+def get_mesh():
+    return _mesh
+
+
+def world_size() -> int:
+    return int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+
+
+def rank() -> int:
+    return int(os.getenv("PADDLE_TRAINER_ID", "0"))
+
+
+def local_device_count() -> int:
+    return len(jax.local_devices())

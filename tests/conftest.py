@@ -1,0 +1,18 @@
+"""Test config: run on a virtual 8-device CPU mesh so sharding/collective
+tests work without TPU hardware (same strategy as the reference's
+multiprocess-on-localhost distributed tests — SURVEY.md §4).
+
+The machine's sitecustomize imports jax and pins JAX_PLATFORMS to the TPU
+plugin at interpreter start, so plain env vars are too late — switch the
+platform through jax.config before any backend initializes."""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

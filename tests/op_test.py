@@ -1,0 +1,177 @@
+"""OpTest harness — port of the reference's op unit-test contract
+(reference: python/paddle/fluid/tests/unittests/op_test.py — OpTest:170,
+get_numeric_gradient:57): build a one-op program from inputs/attrs/outputs,
+run it, compare against a numpy reference, and check gradients numerically
+with central finite differences against the framework's grad path."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.framework import Program, program_guard
+from paddle_tpu.ops.registry import OPS
+
+
+class OpTest:
+    """Subclass sets: self.op_type, self.inputs, self.outputs, self.attrs."""
+
+    op_type: str = ""
+    inputs: Dict = {}
+    outputs: Dict = {}
+    attrs: Dict = {}
+
+    def setUp(self):  # unittest compat
+        pass
+
+    # -- helpers -----------------------------------------------------------
+    def _build_program(self):
+        prog = Program()
+        with program_guard(prog, Program()):
+            block = prog.global_block()
+            in_names = {}
+            for slot, val in self.inputs.items():
+                if isinstance(val, list) and val and isinstance(val[0], tuple):
+                    names = []
+                    for name, arr in val:
+                        block.create_var(name=name, shape=np.asarray(arr).shape,
+                                         dtype=core.np_to_dtype(np.asarray(arr).dtype))
+                        names.append(name)
+                    in_names[slot] = names
+                else:
+                    name = f"{slot}_in"
+                    arr = np.asarray(val)
+                    block.create_var(name=name, shape=arr.shape,
+                                     dtype=core.np_to_dtype(arr.dtype))
+                    in_names[slot] = [name]
+            out_names = {}
+            for slot, val in self.outputs.items():
+                if isinstance(val, list) and val and isinstance(val[0], tuple):
+                    names = []
+                    for name, arr in val:
+                        block.create_var(name=name)
+                        names.append(name)
+                    out_names[slot] = names
+                else:
+                    name = f"{slot}_out"
+                    block.create_var(name=name)
+                    out_names[slot] = [name]
+            block.append_op(type=self.op_type, inputs=in_names,
+                            outputs=out_names,
+                            attrs=dict(getattr(self, "attrs", {}) or {}))
+        return prog, in_names, out_names
+
+    def _feed_dict(self):
+        feed = {}
+        for slot, val in self.inputs.items():
+            if isinstance(val, list) and val and isinstance(val[0], tuple):
+                for name, arr in val:
+                    feed[name] = np.asarray(arr)
+            else:
+                feed[f"{slot}_in"] = np.asarray(val)
+        return feed
+
+    # -- checks ------------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=None):
+        prog, _, out_names = self._build_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = core.Scope()
+        fetch = []
+        expected = []
+        for slot, val in self.outputs.items():
+            if no_check_set and slot in no_check_set:
+                continue
+            if isinstance(val, list) and val and isinstance(val[0], tuple):
+                for name, arr in val:
+                    fetch.append(name)
+                    expected.append(np.asarray(arr))
+            else:
+                fetch.append(f"{slot}_out")
+                expected.append(np.asarray(val))
+        got = exe.run(prog, feed=self._feed_dict(), fetch_list=fetch,
+                      scope=scope)
+        for g, e, name in zip(got, expected, fetch):
+            np.testing.assert_allclose(
+                np.asarray(g, np.float64) if e.dtype.kind == "f" else g,
+                e.astype(np.float64) if e.dtype.kind == "f" else e,
+                atol=atol, rtol=rtol,
+                err_msg=f"output mismatch for {name} of op {self.op_type}")
+
+    def check_grad(self, inputs_to_check: List[str], output_name: str,
+                   max_relative_error=0.005, delta=0.005,
+                   no_grad_set=None):
+        """Central finite differences vs the framework grad (reference
+        op_test.py get_numeric_gradient)."""
+        feed = self._feed_dict()
+        base_prog, in_names, out_names = self._build_program()
+
+        def run_forward_sum(feed_override):
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = core.Scope()
+            oname = f"{output_name}_out" if f"{output_name}_out" in [
+                n for ns in out_names.values() for n in ns] else output_name
+            vals = exe.run(base_prog, feed=feed_override, fetch_list=[oname],
+                           scope=scope)
+            return float(np.sum(np.asarray(vals[0], np.float64)))
+
+        # analytic grads via append_backward on mean-free sum loss
+        prog = Program()
+        with program_guard(prog, Program()):
+            block = prog.global_block()
+            in_name_map = {}
+            for slot, val in self.inputs.items():
+                arr = np.asarray(val)
+                name = f"{slot}_in"
+                v = block.create_var(name=name, shape=arr.shape,
+                                     dtype=core.np_to_dtype(arr.dtype))
+                v.stop_gradient = not (slot in inputs_to_check)
+                # mark as requiring grad (leaf)
+                in_name_map[slot] = [name]
+            out_name_map = {}
+            for slot, val in self.outputs.items():
+                out_name_map[slot] = [f"{slot}_out"]
+                block.create_var(name=f"{slot}_out")
+            block.append_op(type=self.op_type, inputs=in_name_map,
+                            outputs=out_name_map,
+                            attrs=dict(getattr(self, "attrs", {}) or {}))
+            from paddle_tpu.fluid import layers
+            target = block.var(f"{output_name}_out")
+            target.dtype = core.VarDesc.VarType.FP32
+            # loss = sum(out) so dloss/dout = 1
+            red = layers.reduce_sum(target)
+            from paddle_tpu.fluid.backward import append_backward
+            # make checked inputs "parameters" for grad collection purposes
+            append_backward(red, no_grad_set=no_grad_set)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = core.Scope()
+        grad_fetch = [f"{s}_in@GRAD" for s in inputs_to_check]
+        analytic = exe.run(prog, feed=feed, fetch_list=grad_fetch,
+                           scope=scope)
+
+        for slot, ag in zip(inputs_to_check, analytic):
+            x0 = np.asarray(self.inputs[slot], np.float64).copy()
+            numeric = np.zeros_like(x0, np.float64)
+            flat = x0.reshape(-1)
+            num_flat = numeric.reshape(-1)
+            for i in range(flat.size):
+                orig = flat[i]
+                flat[i] = orig + delta
+                f_plus = run_forward_sum(
+                    {**feed, f"{slot}_in": x0.astype(
+                        np.asarray(self.inputs[slot]).dtype)})
+                flat[i] = orig - delta
+                f_minus = run_forward_sum(
+                    {**feed, f"{slot}_in": x0.astype(
+                        np.asarray(self.inputs[slot]).dtype)})
+                flat[i] = orig
+                num_flat[i] = (f_plus - f_minus) / (2 * delta)
+            a = np.asarray(ag, np.float64)
+            abs_err = np.abs(a - numeric)
+            denom = np.maximum(np.abs(numeric), 1.0)
+            rel = (abs_err / denom).max() if a.size else 0.0
+            assert rel <= max_relative_error, (
+                f"grad check failed for {slot} of {self.op_type}: "
+                f"max rel err {rel:.5f} > {max_relative_error}\n"
+                f"analytic={a.reshape(-1)[:8]}\nnumeric={numeric.reshape(-1)[:8]}")

@@ -1,0 +1,190 @@
+"""append_backward + executor + optimizer end-to-end tests (reference:
+unittests/test_backward.py, test_optimizer.py, tests/book/test_recognize_digits
+convergence oracle)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def _build_mlp():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[8], dtype="float32")
+        label = fluid.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    return main, startup, x, label, loss
+
+
+def test_append_backward_creates_grads():
+    main, startup, x, label, loss = _build_mlp()
+    with program_guard(main, startup):
+        params_grads = fluid.append_backward(loss)
+    assert len(params_grads) == 4  # 2 weights + 2 biases
+    names = {p.name for p, g in params_grads}
+    grads = {g.name for p, g in params_grads}
+    for p, g in params_grads:
+        assert g.name == p.name + "@GRAD"
+    types = [op.type for op in main.global_block().ops]
+    assert "mul_grad" in types
+    assert "elementwise_add_grad" in types
+
+
+def test_sgd_training_converges():
+    np.random.seed(1)
+    main, startup, x, label, loss = _build_mlp()
+    with program_guard(main, startup):
+        opt = fluid.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(loss)
+    scope = core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        X = np.random.rand(512, 8).astype("float32")
+        W = np.random.rand(8, 4).astype("float32")
+        Y = (X @ W).argmax(1).astype("int64").reshape(-1, 1)
+        losses = []
+        for i in range(40):
+            idx = np.random.randint(0, 512, 64)
+            lv, = exe.run(main, feed={"x": X[idx], "y": Y[idx]},
+                          fetch_list=[loss])
+            losses.append(float(lv[0]))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+@pytest.mark.parametrize("opt_name", ["Adam", "Momentum", "Adagrad",
+                                      "RMSProp", "Lamb", "Adamax",
+                                      "Adadelta", "DecayedAdagrad", "Ftrl",
+                                      "LarsMomentum"])
+def test_all_optimizers_step(opt_name):
+    np.random.seed(2)
+    main, startup, x, label, loss = _build_mlp()
+    with program_guard(main, startup):
+        kw = {}
+        if opt_name in ("Momentum", "LarsMomentum"):
+            kw["momentum"] = 0.9
+        lr = 0.01 if opt_name in ("RMSProp", "Adam", "Lamb") else 0.1
+        opt = getattr(fluid.optimizer, opt_name)(learning_rate=lr, **kw)
+        opt.minimize(loss)
+    scope = core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        X = np.random.rand(64, 8).astype("float32")
+        Y = np.random.randint(0, 4, (64, 1)).astype("int64")
+        l0 = None
+        for i in range(5):
+            lv, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            if l0 is None:
+                l0 = float(lv[0])
+        assert np.isfinite(lv[0])
+        # same batch repeated → the update must move the loss (strictly
+        # decreasing for well-conditioned optimizers; Ftrl/Adadelta move
+        # slowly, so just require change + no blowup)
+        if opt_name in ("SGD", "Adam", "Momentum", "Adagrad", "RMSProp"):
+            assert float(lv[0]) < l0
+        else:
+            assert float(lv[0]) != l0 and float(lv[0]) < l0 * 3
+
+
+def test_interpreted_matches_compiled():
+    """The eager interpreter is the correctness oracle for the jit path."""
+    np.random.seed(3)
+    results = {}
+    for mode in ("compiled", "interpreted"):
+        core.set_flag("FLAGS_executor_mode", mode)
+        try:
+            main, startup, x, label, loss = _build_mlp()
+            main.random_seed = 7
+            startup.random_seed = 7
+            with program_guard(main, startup):
+                fluid.optimizer.SGD(0.1).minimize(loss)
+            scope = core.Scope()
+            exe = fluid.Executor(fluid.CPUPlace())
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                X = np.random.RandomState(0).rand(32, 8).astype("float32")
+                Y = np.random.RandomState(1).randint(
+                    0, 4, (32, 1)).astype("int64")
+                ls = []
+                for _ in range(3):
+                    lv, = exe.run(main, feed={"x": X, "y": Y},
+                                  fetch_list=[loss])
+                    ls.append(float(lv[0]))
+                results[mode] = ls
+        finally:
+            core.set_flag("FLAGS_executor_mode", "compiled")
+    np.testing.assert_allclose(results["compiled"], results["interpreted"],
+                               rtol=1e-5)
+
+
+def test_gradient_accumulation_fanin():
+    """var consumed by two ops gets summed grads (reference
+    _addup_repetitive_outputs_)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        a = fluid.layers.relu(x)
+        b1 = a * a
+        b2 = a + a
+        loss = fluid.layers.mean(b1 + b2)
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        xv = np.asarray([[1.0, 2.0, -1.0, 3.0]], np.float32)
+        g, = exe.run(main, feed={"x": xv}, fetch_list=["x@GRAD"])
+    # d/dx mean(x^2 + 2x) for x>0 = (2x + 2)/4 ; 0 for x<0
+    expect = np.where(xv > 0, (2 * xv + 2) / 4.0, 0.0)
+    np.testing.assert_allclose(g, expect, rtol=1e-5)
+
+
+def test_lr_scheduler_in_program():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, 2)
+        loss = fluid.layers.mean(h)
+        lr = fluid.layers.exponential_decay(0.1, decay_steps=1,
+                                            decay_rate=0.5)
+        opt = fluid.optimizer.SGD(learning_rate=lr)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        X = np.random.rand(4, 4).astype("float32")
+        lrs = []
+        for _ in range(3):
+            lv = exe.run(main, feed={"x": X}, fetch_list=[lr])
+            lrs.append(float(lv[0][0]))
+    # counter starts at 0 on first run? first value 0.1*0.5^1 since counter
+    # increments before read (prepend increment). Just check halving:
+    assert abs(lrs[1] / lrs[0] - 0.5) < 1e-5
+    assert abs(lrs[2] / lrs[1] - 0.5) < 1e-5
+
+
+def test_save_load_persistables(tmp_path):
+    np.random.seed(4)
+    main, startup, x, label, loss = _build_mlp()
+    with program_guard(main, startup):
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        X = np.random.rand(16, 8).astype("float32")
+        Y = np.random.randint(0, 4, (16, 1)).astype("int64")
+        exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        fluid.save_persistables(exe, str(tmp_path), main)
+        l1, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    scope2 = core.Scope()
+    with fluid.scope_guard(scope2):
+        fluid.load_persistables(exe, str(tmp_path), main)
+        l2, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)

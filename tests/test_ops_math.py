@@ -1,0 +1,178 @@
+"""Op unit tests — math/elementwise/reduce/matmul (reference:
+unittests/test_elementwise_*_op.py, test_matmul_op.py, test_reduce_op.py,
+test_activation_op.py via the OpTest numeric contract)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    def setup(self):
+        self.op_type = "elementwise_add"
+        x = np.random.rand(3, 4).astype("float32")
+        y = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+        self.attrs = {"axis": -1}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    def test_axis_broadcast(self):
+        self.op_type = "elementwise_add"
+        x = np.random.rand(2, 3, 4).astype("float32")
+        y = np.random.rand(3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+        self.attrs = {"axis": 1}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMul(OpTest):
+    def test_mul(self):
+        self.op_type = "mul"
+        x = np.random.rand(3, 4).astype("float32")
+        y = np.random.rand(4, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+    def test_mul_4d(self):
+        self.op_type = "mul"
+        x = np.random.rand(2, 3, 4).astype("float32")
+        y = np.random.rand(12, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": (x.reshape(2, 12) @ y)}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.check_output()
+
+
+class TestMatmul(OpTest):
+    def test_transpose(self):
+        self.op_type = "matmul"
+        x = np.random.rand(4, 3).astype("float32")
+        y = np.random.rand(5, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x.T @ y.T}
+        self.attrs = {"transpose_X": True, "transpose_Y": True, "alpha": 1.0}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+    def test_batched(self):
+        self.op_type = "matmul"
+        x = np.random.rand(2, 3, 4).astype("float32")
+        y = np.random.rand(2, 4, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.matmul(x, y)}
+        self.attrs = {}
+        self.check_output()
+
+
+class TestReduce(OpTest):
+    def test_reduce_sum(self):
+        self.op_type = "reduce_sum"
+        x = np.random.rand(3, 4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.sum(1)}
+        self.attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+    def test_reduce_mean_all(self):
+        self.op_type = "reduce_mean"
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray([x.mean()])}
+        self.attrs = {"reduce_all": True, "dim": [0], "keep_dim": False}
+        self.check_output()
+
+    def test_reduce_max(self):
+        self.op_type = "reduce_max"
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.max(0)}
+        self.attrs = {"dim": [0], "keep_dim": False, "reduce_all": False}
+        self.check_output()
+
+
+class TestActivations(OpTest):
+    def _run(self, op, fn, grad=True, atol=1e-5, **attrs):
+        self.op_type = op
+        x = (np.random.rand(3, 4).astype("float32") * 2 - 1) * 0.9 + 1.1
+        self.inputs = {"X": x}
+        self.outputs = {"Out": fn(x)}
+        self.attrs = attrs
+        self.check_output(atol=atol)
+        if grad:
+            self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+    def test_relu(self):
+        self._run("relu", lambda x: np.maximum(x, 0), grad=False)
+
+    def test_sigmoid(self):
+        self._run("sigmoid", lambda x: 1 / (1 + np.exp(-x)))
+
+    def test_tanh(self):
+        self._run("tanh", np.tanh)
+
+    def test_exp(self):
+        self._run("exp", np.exp)
+
+    def test_sqrt(self):
+        self._run("sqrt", np.sqrt)
+
+    def test_gelu(self):
+        def ref(x):
+            return 0.5 * x * (1 + _vec_erf(x / np.sqrt(2)))
+        self._run("gelu", ref, grad=False, atol=1e-4)
+
+    def test_leaky_relu(self):
+        self._run("leaky_relu", lambda x: np.where(x >= 0, x, 0.1 * x),
+                  grad=False, alpha=0.1)
+
+
+def _vec_erf(x):
+    from math import erf
+    return np.vectorize(erf)(x)
+
+
+class TestScale(OpTest):
+    def test_scale(self):
+        self.op_type = "scale"
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x * 2.5 + 1.0}
+        self.attrs = {"scale": 2.5, "bias": 1.0, "bias_after_scale": True}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestSum(OpTest):
+    def test_sum3(self):
+        self.op_type = "sum"
+        xs = [np.random.rand(3, 4).astype("float32") for _ in range(3)]
+        self.inputs = {"X": [(f"x{i}", x) for i, x in enumerate(xs)]}
+        self.outputs = {"Out": xs[0] + xs[1] + xs[2]}
+        self.attrs = {}
+        self.check_output()
+
+
+class TestClip(OpTest):
+    def test_clip(self):
+        self.op_type = "clip"
+        x = np.random.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.clip(x, 0.3, 0.7)}
+        self.attrs = {"min": 0.3, "max": 0.7}
+        self.check_output()

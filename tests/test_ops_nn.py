@@ -1,0 +1,282 @@
+"""NN op tests: softmax/cross-entropy/conv/pool/norms/embedding/dropout
+(reference: unittests/test_softmax_op.py, test_conv2d_op.py,
+test_pool2d_op.py, test_batch_norm_op.py, test_layer_norm_op.py,
+test_lookup_table_op.py, test_dropout_op.py)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestSoftmax(OpTest):
+    def test_softmax(self):
+        self.op_type = "softmax"
+        x = np.random.rand(4, 7).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": _softmax_np(x)}
+        self.attrs = {"axis": -1}
+        self.check_output()
+        self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+class TestCrossEntropy(OpTest):
+    def test_hard_label(self):
+        self.op_type = "cross_entropy"
+        probs = _softmax_np(np.random.rand(5, 7).astype("float32"))
+        labels = np.random.randint(0, 7, (5, 1)).astype("int64")
+        loss = -np.log(probs[np.arange(5), labels[:, 0]] + 1e-20)
+        self.inputs = {"X": probs, "Label": labels}
+        self.outputs = {"Y": loss.reshape(5, 1)}
+        self.attrs = {}
+        self.check_output()
+
+    def test_soft_label(self):
+        self.op_type = "cross_entropy"
+        probs = _softmax_np(np.random.rand(5, 7).astype("float32"))
+        soft = _softmax_np(np.random.rand(5, 7).astype("float32"))
+        loss = -(soft * np.log(probs + 1e-20)).sum(1, keepdims=True)
+        self.inputs = {"X": probs, "Label": soft}
+        self.outputs = {"Y": loss}
+        self.attrs = {"soft_label": True}
+        self.check_output()
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    def test_swce(self):
+        self.op_type = "softmax_with_cross_entropy"
+        logits = np.random.rand(5, 7).astype("float32")
+        labels = np.random.randint(0, 7, (5, 1)).astype("int64")
+        sm = _softmax_np(logits)
+        loss = -np.log(sm[np.arange(5), labels[:, 0]])
+        self.inputs = {"Logits": logits, "Label": labels}
+        self.outputs = {"Softmax": sm, "Loss": loss.reshape(5, 1)}
+        self.attrs = {}
+        self.check_output(atol=1e-5)
+        self.check_grad(["Logits"], "Loss", max_relative_error=0.01)
+
+
+def _conv2d_np(x, w, stride, pad):
+    n, c, h, wd = x.shape
+    oc, ic, kh, kw = w.shape
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    xp = np.pad(x, [(0, 0), (0, 0), (pad, pad), (pad, pad)])
+    out = np.zeros((n, oc, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.tensordot(patch, w, ([1, 2, 3], [1, 2, 3]))
+    return out
+
+
+class TestConv2D(OpTest):
+    def test_conv(self):
+        self.op_type = "conv2d"
+        x = np.random.rand(2, 3, 8, 8).astype("float32")
+        w = np.random.rand(4, 3, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": _conv2d_np(x, w, 1, 1)}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1,
+                      "padding_algorithm": "EXPLICIT", "data_format": "NCHW"}
+        self.check_output(atol=1e-4)
+
+    def test_conv_stride2(self):
+        self.op_type = "conv2d"
+        x = np.random.rand(1, 2, 6, 6).astype("float32")
+        w = np.random.rand(3, 2, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": _conv2d_np(x, w, 2, 0)}
+        self.attrs = {"strides": [2, 2], "paddings": [0, 0],
+                      "dilations": [1, 1], "groups": 1,
+                      "padding_algorithm": "EXPLICIT", "data_format": "NCHW"}
+        self.check_output(atol=1e-4)
+
+
+class TestPool2D(OpTest):
+    def test_maxpool(self):
+        self.op_type = "pool2d"
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        ref = x.reshape(2, 3, 2, 2, 2, 2).max((3, 5))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": ref}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0],
+                      "global_pooling": False, "exclusive": True,
+                      "adaptive": False, "data_format": "NCHW",
+                      "padding_algorithm": "EXPLICIT"}
+        self.check_output()
+
+    def test_avgpool_global(self):
+        self.op_type = "pool2d"
+        x = np.random.rand(2, 3, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.mean((2, 3), keepdims=True)}
+        self.attrs = {"pooling_type": "avg", "ksize": [1, 1],
+                      "global_pooling": True, "strides": [1, 1],
+                      "paddings": [0, 0], "data_format": "NCHW",
+                      "padding_algorithm": "EXPLICIT"}
+        self.check_output()
+
+
+class TestBatchNorm(OpTest):
+    def test_train_stats(self):
+        self.op_type = "batch_norm"
+        x = np.random.rand(4, 3, 2, 2).astype("float32")
+        scale = np.random.rand(3).astype("float32")
+        bias = np.random.rand(3).astype("float32")
+        mean = np.zeros(3, np.float32)
+        var = np.ones(3, np.float32)
+        bm = x.mean((0, 2, 3))
+        bv = x.var((0, 2, 3))
+        eps = 1e-5
+        y = (x - bm.reshape(1, 3, 1, 1)) / np.sqrt(bv + eps).reshape(1, 3, 1, 1)
+        y = y * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.outputs = {"Y": y,
+                        "MeanOut": 0.9 * mean + 0.1 * bm,
+                        "VarianceOut": 0.9 * var + 0.1 * bv,
+                        "SavedMean": bm,
+                        "SavedVariance": 1.0 / np.sqrt(bv + eps)}
+        self.attrs = {"momentum": 0.9, "epsilon": eps, "is_test": False,
+                      "data_layout": "NCHW"}
+        self.check_output(atol=2e-4)
+
+
+class TestLayerNorm(OpTest):
+    def test_ln(self):
+        self.op_type = "layer_norm"
+        x = np.random.rand(4, 6).astype("float32")
+        scale = np.random.rand(6).astype("float32")
+        bias = np.random.rand(6).astype("float32")
+        mean = x.mean(1, keepdims=True)
+        var = x.var(1, keepdims=True)
+        eps = 1e-5
+        y = (x - mean) / np.sqrt(var + eps) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.outputs = {"Y": y, "Mean": mean.reshape(4),
+                        "Variance": var.reshape(4)}
+        self.attrs = {"epsilon": eps, "begin_norm_axis": 1}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=0.02)
+
+
+class TestLookupTable(OpTest):
+    def test_lookup(self):
+        self.op_type = "lookup_table_v2"
+        w = np.random.rand(10, 4).astype("float32")
+        ids = np.random.randint(0, 10, (5,)).astype("int64")
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": w[ids]}
+        self.attrs = {}
+        self.check_output()
+        self.check_grad(["W"], "Out")
+
+    def test_padding_idx(self):
+        self.op_type = "lookup_table_v2"
+        w = np.random.rand(10, 4).astype("float32")
+        ids = np.asarray([1, 2, 2, 1, 0]).astype("int64")
+        ref = w[ids].copy()
+        ref[ids == 2] = 0.0
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": ref}
+        self.attrs = {"padding_idx": 2}
+        self.check_output()
+
+
+class TestDropout(OpTest):
+    def test_eval_mode(self):
+        self.op_type = "dropout"
+        x = np.random.rand(4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x * 0.7,
+                        "Mask": np.ones_like(x, np.uint8)}
+        self.attrs = {"dropout_prob": 0.3, "is_test": True,
+                      "dropout_implementation": "downgrade_in_infer"}
+        self.check_output()
+
+    def test_train_mask_consistent(self):
+        # Out == X * Mask for downgrade impl
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid.framework import Program, program_guard
+        prog = Program()
+        with program_guard(prog, Program()):
+            x = fluid.data("x", shape=[100], dtype="float32",
+                           append_batch_size=False)
+            o = fluid.layers.dropout(x, 0.5)
+        exe = fluid.Executor(fluid.CPUPlace())
+        xv = np.random.rand(100).astype("float32") + 0.5
+        ov, = exe.run(prog, feed={"x": xv}, fetch_list=[o])
+        kept = ov != 0
+        np.testing.assert_allclose(ov[kept], xv[kept], rtol=1e-6)
+        assert 10 < kept.sum() < 90  # ~50%
+
+
+class TestTransposeReshape(OpTest):
+    def test_transpose2(self):
+        self.op_type = "transpose2"
+        x = np.random.rand(2, 3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.transpose(1, 0, 2)}
+        self.attrs = {"axis": [1, 0, 2]}
+        self.check_output(no_check_set={"XShape"})
+        self.check_grad(["X"], "Out")
+
+    def test_reshape2(self):
+        self.op_type = "reshape2"
+        x = np.random.rand(2, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.reshape(2, 3, 2)}
+        self.attrs = {"shape": [0, 3, -1]}
+        self.check_output(no_check_set={"XShape"})
+        self.check_grad(["X"], "Out")
+
+
+class TestConcatSplit(OpTest):
+    def test_concat(self):
+        self.op_type = "concat"
+        xs = [np.random.rand(2, 3).astype("float32") for _ in range(3)]
+        self.inputs = {"X": [(f"x{i}", x) for i, x in enumerate(xs)]}
+        self.outputs = {"Out": np.concatenate(xs, 1)}
+        self.attrs = {"axis": 1}
+        self.check_output()
+
+    def test_split(self):
+        self.op_type = "split"
+        x = np.random.rand(2, 6).astype("float32")
+        parts = np.split(x, [2, 5], axis=1)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": [(f"o{i}", p) for i, p in enumerate(parts)]}
+        self.attrs = {"axis": 1, "sections": [2, 3, 1], "num": 0}
+        self.check_output()
+
+
+class TestGatherScatter(OpTest):
+    def test_gather(self):
+        self.op_type = "gather"
+        x = np.random.rand(5, 3).astype("float32")
+        idx = np.asarray([0, 2, 4]).astype("int32")
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[idx]}
+        self.attrs = {}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+    def test_scatter_overwrite(self):
+        self.op_type = "scatter"
+        x = np.random.rand(5, 3).astype("float32")
+        ids = np.asarray([1, 3]).astype("int32")
+        upd = np.random.rand(2, 3).astype("float32")
+        ref = x.copy()
+        ref[ids] = upd
+        self.inputs = {"X": x, "Ids": ids, "Updates": upd}
+        self.outputs = {"Out": ref}
+        self.attrs = {"overwrite": True}
+        self.check_output()
