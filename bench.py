@@ -2,16 +2,18 @@
 """Benchmark entry point — prints ONE JSON line:
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Current flagship bench: MNIST-MLP train-step throughput through the full
-fluid front end (Program → traced+jitted XLA step with donation) on the
-available accelerator. Upgraded as model families land (BERT-base next —
-see BASELINE.md targets).
+Headline config (BASELINE.md): BERT-base MLM train step, samples/sec/chip,
+through the full fluid front end (Program → jitted XLA step with donation,
+Pallas flash attention). ``python bench.py mnist`` runs the MLP smoke bench
+instead. MFU is reported in the JSON payload against v5e bf16 peak.
 """
 import json
 import sys
 import time
 
 import numpy as np
+
+V5E_PEAK_FLOPS = 197e12  # bf16 peak per chip
 
 
 def bench_mnist_mlp(batch=256, steps=60, warmup=10):
@@ -27,7 +29,6 @@ def bench_mnist_mlp(batch=256, steps=60, warmup=10):
         pred = fluid.layers.fc(h, 10, act="softmax")
         loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
         fluid.optimizer.Momentum(0.01, momentum=0.9).minimize(loss)
-
     exe = fluid.Executor()
     scope = core.Scope()
     rng = np.random.RandomState(0)
@@ -41,20 +42,65 @@ def bench_mnist_mlp(batch=256, steps=60, warmup=10):
         for _ in range(steps):
             out = exe.run(main, feed={"img": X, "label": Y},
                           fetch_list=[loss])
-        # fetch forces sync
         _ = float(out[0][0])
         dt = time.perf_counter() - t0
-    return batch * steps / dt
+    return {"metric": "mnist_mlp_samples_per_sec",
+            "value": round(batch * steps / dt, 1), "unit": "samples/s",
+            "vs_baseline": 1.0}
+
+
+def bench_bert_base(batch=256, seq_len=128, steps=20, warmup=5):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.models import bert
+
+    core.set_flag("FLAGS_use_bf16_matmul", True)  # MXU-native math
+    cfg = bert.bert_base_config()
+    main, startup, feeds, fetches = bert.build_bert_pretrain_program(
+        cfg, seq_len=seq_len, dropout=0.0, lr=1e-4)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    n_mask = max(1, int(batch * seq_len * 0.15))
+    feed = {
+        "src_ids": rng.randint(0, cfg["vocab_size"],
+                               (batch, seq_len)).astype("int64"),
+        "pos_ids": np.tile(np.arange(seq_len), (batch, 1)).astype("int64"),
+        "sent_ids": np.zeros((batch, seq_len), "int64"),
+        "mask_pos": rng.randint(0, batch * seq_len,
+                                (n_mask, 1)).astype("int64"),
+        "mask_label": rng.randint(0, cfg["vocab_size"],
+                                  (n_mask, 1)).astype("int64"),
+    }
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(warmup):
+            exe.run(main, feed=feed, fetch_list=fetches)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = exe.run(main, feed=feed, fetch_list=fetches)
+        _ = float(out[0][0])
+        dt = time.perf_counter() - t0
+    sps = batch * steps / dt
+    # 6·N·tokens FLOPs estimate (fwd+bwd), N = transformer params (no embed)
+    h, L, f = cfg["hidden"], cfg["layers"], cfg["ffn"]
+    n_params = L * (4 * h * h + 2 * h * f)
+    flops_per_sample = 6 * n_params * seq_len \
+        + 12 * L * seq_len * seq_len * h  # attention scores fwd+bwd
+    mfu = sps * flops_per_sample / V5E_PEAK_FLOPS
+    return {"metric": "bert_base_samples_per_sec_per_chip",
+            "value": round(sps, 2), "unit": "samples/s",
+            "vs_baseline": 1.0, "mfu_vs_v5e_bf16_peak": round(mfu, 4),
+            "batch": batch, "seq_len": seq_len}
 
 
 def main():
-    sps = bench_mnist_mlp()
-    print(json.dumps({
-        "metric": "mnist_mlp_samples_per_sec",
-        "value": round(sps, 1),
-        "unit": "samples/s",
-        "vs_baseline": 1.0,  # reference publishes no numbers (BASELINE.md)
-    }))
+    which = sys.argv[1] if len(sys.argv) > 1 else "bert"
+    if which == "mnist":
+        res = bench_mnist_mlp()
+    else:
+        res = bench_bert_base()
+    print(json.dumps(res))
 
 
 if __name__ == "__main__":

@@ -456,6 +456,10 @@ class _GlobalFlags:
         "FLAGS_sync_nccl_allreduce": True,   # no-op: ICI collectives are compiled
         "FLAGS_executor_mode": "compiled",   # compiled | interpreted
         "FLAGS_seed": 0,
+        # bf16 inputs on MXU matmuls/convs with f32 accumulate (params and
+        # activations stay f32 outside the unit) — the TPU-native analogue
+        # of the reference's TF32/fp16 math modes
+        "FLAGS_use_bf16_matmul": False,
     }
 
     def __init__(self):

@@ -90,11 +90,15 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     pad = -1 if padding_idx is None else (
         padding_idx if padding_idx >= 0 else size[0] + padding_idx)
     ishape = list(input.shape)
+    # v1 op contract wants Ids [..., 1]; ids without the trailing 1 go
+    # through lookup_table_v2 (reference: lookup_table_v2_op.cc)
     if ishape and ishape[-1] == 1:
         out.shape = tuple(ishape[:-1]) + (size[1],)
+        op_type = "lookup_table"
     else:
         out.shape = tuple(ishape) + (size[1],)
-    helper.append_op(type="lookup_table",
+        op_type = "lookup_table_v2"
+    helper.append_op(type=op_type,
                      inputs={"W": [w], "Ids": [input]},
                      outputs={"Out": [out]},
                      attrs={"is_sparse": is_sparse,

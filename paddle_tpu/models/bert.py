@@ -112,10 +112,11 @@ def bert_embedding(src_ids, pos_ids, sent_ids, cfg, dropout_rate=0.0):
 
 
 def build_bert_pretrain_program(cfg=None, seq_len=128, dropout=0.0,
-                                lr=1e-4, mlm_frac=0.15):
+                                lr=1e-4, mlm_frac=0.15, use_amp=False):
     """Masked-LM pretraining step program. Feeds: src_ids, pos_ids,
     sent_ids [B,S] int64; mask_pos [M] int64 (flattened positions),
-    mask_label [M,1] int64."""
+    mask_label [M,1] int64. use_amp: bf16 activations via
+    contrib.mixed_precision (f32 master weights + f32 norm/softmax)."""
     cfg = cfg or bert_base_config()
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -134,5 +135,8 @@ def build_bert_pretrain_program(cfg=None, seq_len=128, dropout=0.0,
         loss = layers.mean(
             layers.softmax_with_cross_entropy(logits, mask_label))
         opt = fluid.optimizer.Adam(lr)
+        if use_amp:
+            from ..fluid.contrib import mixed_precision
+            opt = mixed_precision.decorate(opt)
         opt.minimize(loss)
     return main, startup, [src, pos, sent, mask_pos, mask_label], [loss]

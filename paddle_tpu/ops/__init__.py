@@ -12,3 +12,4 @@ from . import optimizer_ops  # noqa: F401
 from . import framework_ops  # noqa: F401
 from . import nn_extra_ops   # noqa: F401
 from . import collective_ops  # noqa: F401
+from . import attention_ops  # noqa: F401

@@ -61,6 +61,15 @@ _register_elementwise("elementwise_floordiv", lambda x, y: x // y)
 # --------------------------------------------------------------------------
 # mul / matmul / bmm / dot  (MXU-bound ops — keep as single dot_generals)
 # --------------------------------------------------------------------------
+def _mm(a, b):
+    """MXU matmul honoring FLAGS_use_bf16_matmul (bf16 inputs, f32 accum)."""
+    from ..fluid import core as _core
+    if _core.globals_["FLAGS_use_bf16_matmul"] and a.dtype == jnp.float32:
+        return jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+    return jnp.matmul(a, b)
+
+
 @register_op("mul", inputs=("X", "Y"),
              attr_defaults={"x_num_col_dims": 1, "y_num_col_dims": 1})
 def _mul(ins, attrs):
@@ -70,7 +79,7 @@ def _mul(ins, attrs):
     xs, ys = x.shape, y.shape
     x2 = x.reshape((int(np.prod(xs[:xn])), -1))
     y2 = y.reshape((int(np.prod(ys[:yn])), -1))
-    o = x2 @ y2
+    o = _mm(x2, y2)
     return out(Out=o.reshape(xs[:xn] + ys[yn:]))
 
 
@@ -94,7 +103,7 @@ def _matmul(ins, attrs):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
-    o = jnp.matmul(x, y)
+    o = _mm(x, y)
     if squeeze_front:
         o = jnp.squeeze(o, -2)
     if squeeze_back:
@@ -114,12 +123,12 @@ def _matmul_v2(ins, attrs):
         x = jnp.swapaxes(x, -1, -2)
     if attrs.get("trans_y", False):
         y = jnp.swapaxes(y, -1, -2)
-    return out(Out=jnp.matmul(x, y))
+    return out(Out=_mm(x, y))
 
 
 @register_op("bmm", inputs=("X", "Y"))
 def _bmm(ins, attrs):
-    return out(Out=jnp.matmul(first(ins, "X"), first(ins, "Y")))
+    return out(Out=_mm(first(ins, "X"), first(ins, "Y")))
 
 
 @register_op("dot", inputs=("X", "Y"))

@@ -484,11 +484,15 @@ def _conv2d(ins, attrs):
     pads = _conv_padding(attrs.get("paddings", [0, 0]),
                          attrs.get("padding_algorithm", "EXPLICIT"),
                          2, w.shape[2:], strides, dil, spatial)
+    from ..fluid import core as _core
+    orig_dtype = x.dtype
+    if _core.globals_["FLAGS_use_bf16_matmul"] and x.dtype == jnp.float32:
+        x, w = x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
     o = lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pads, rhs_dilation=dil,
         dimension_numbers=dn, feature_group_count=attrs.get("groups", 1),
         preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-    o = o.astype(x.dtype)
+    o = o.astype(orig_dtype)
     b = first(ins, "Bias")
     if b is not None:
         c_axis = 1 if fmt in ("NCHW", "AnyLayout") else 3
@@ -564,6 +568,26 @@ def _conv2d_transpose(ins, attrs):
     return out(Output=o)
 
 
+def _max_pool_slices(x, ksize, strides, pads, init):
+    """NCHW max pool as max over kh·kw strided slices."""
+    n, c, H, W = x.shape
+    kh, kw = ksize
+    sh, sw = strides
+    (pt, pb), (pl_, pr) = pads
+    xp = jnp.pad(x, [(0, 0), (0, 0), (pt, pb), (pl_, pr)],
+                 constant_values=init)
+    oh = (H + pt + pb - kh) // sh + 1
+    ow = (W + pl_ + pr - kw) // sw + 1
+    o = None
+    for i in range(kh):
+        for j in range(kw):
+            s = lax.slice(xp, (0, 0, i, j),
+                          (n, c, i + (oh - 1) * sh + 1,
+                           j + (ow - 1) * sw + 1), (1, 1, sh, sw))
+            o = s if o is None else jnp.maximum(o, s)
+    return o
+
+
 def _pool2d_impl(x, attrs):
     ptype = attrs.get("pooling_type", "max")
     ksize = [int(k) for k in attrs.get("ksize", [1, 1])]
@@ -600,9 +624,15 @@ def _pool2d_impl(x, attrs):
         wstrides = (1, strides[0], strides[1], 1)
         wpads = [(0, 0), pads[0], pads[1], (0, 0)]
     if ptype == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
-                                 wdims, wstrides, wpads)
+        # stacked-slices max (differentiable through jnp.max; the
+        # reduce_window max path lacks a vjp under this jax version)
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        if ch_last:
+            x_nchw = jnp.transpose(x, (0, 3, 1, 2))
+            o = _max_pool_slices(x_nchw, ksize, strides, pads, init)
+            return jnp.transpose(o, (0, 2, 3, 1))
+        return _max_pool_slices(x, ksize, strides, pads, init)
     s = lax.reduce_window(x, jnp.asarray(0.0, x.dtype), lax.add,
                           wdims, wstrides, wpads)
     if attrs.get("exclusive", True):

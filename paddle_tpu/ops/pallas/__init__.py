@@ -1,0 +1,2 @@
+"""Pallas TPU kernels — hand-tiled hot ops (the TPU-native replacement for
+the reference's fused CUDA ops under operators/fused/)."""

@@ -1,0 +1,113 @@
+"""Flash attention (Pallas TPU) — replaces the reference's fused transformer
+attention kernel (reference: operators/fused/multihead_matmul_op.cu, which
+does QK^T→softmax→V with cuBLAS batched GEMMs in one op).
+
+TPU design: one pallas_call per (batch·head, q-block): the q block and the
+full K/V for that head live in VMEM; scores tile onto the MXU; softmax is
+computed in fp32 on the VPU. For round-1 the full-S K/V fits VMEM for
+BERT-scale sequences (S≤2048, d≤128 → ≤2·2048·128·4B = 2MB); the blocked
+online-softmax variant (and ring attention over ICI for long context) hangs
+off the same entry point.
+
+Backward: flash-style recompute — custom_vjp whose bwd re-derives grads
+from the pure-jax reference attention under XLA (one extra forward, fused).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+DEFAULT_BLOCK_Q = 256
+
+
+def _ref_attention(q, k, v, sm_scale, causal=False):
+    """Pure-jax reference: q,k,v [B,H,S,D]."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        S = q.shape[2]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, blk_q):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale        # [blk_q, d]
+    k = k_ref[0].astype(jnp.float32)                   # [S, d]
+    v = v_ref[0].astype(jnp.float32)                   # [S, d]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [blk_q, S]
+    if causal:
+        S = k.shape[0]
+        rows = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                     (blk_q, S), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (blk_q, S), 1)
+        s = jnp.where(rows >= cols, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    o_ref[0] = jnp.dot(p, v,
+                       preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _pallas_attention(q, k, v, sm_scale, causal=False,
+                      blk_q=DEFAULT_BLOCK_Q):
+    B, H, S, D = q.shape
+    blk_q = min(blk_q, S)
+    assert S % blk_q == 0, (S, blk_q)
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    grid = (B * H, S // blk_q)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          blk_q=blk_q),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
+    )(qf, kf, vf)
+    return out.reshape(B, H, S, D)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, sm_scale, causal=False):
+    """q,k,v: [B,H,S,D] → [B,H,S,D]."""
+    if _HAS_PALLAS and _on_tpu():
+        return _pallas_attention(q, k, v, sm_scale, causal)
+    return _ref_attention(q, k, v, sm_scale, causal)
+
+
+def _fa_fwd(q, k, v, sm_scale, causal):
+    return flash_attention(q, k, v, sm_scale, causal), (q, k, v)
+
+
+def _fa_bwd(sm_scale, causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _ref_attention(q, k, v, sm_scale,
+                                                    causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
