@@ -1,0 +1,1 @@
+"""paddle_tpu.distributed — launcher (reference: python/paddle/distributed/)."""

@@ -83,9 +83,15 @@ class _CompiledBlock:
     """One traced+jitted step function for (program, feeds, fetches)."""
 
     def __init__(self, program: Program, feed_names: Tuple[str, ...],
-                 fetch_names: Tuple[str, ...], scope: Scope, seed: int):
+                 fetch_names: Tuple[str, ...], scope: Scope, seed: int,
+                 mesh=None, param_shardings=None):
         import weakref
         self._scope_ref = weakref.ref(scope)
+        self.mesh = mesh
+        # name → PartitionSpec for tensor-parallel params (anything absent
+        # is replicated); the optimizer state for a sharded param follows
+        # the param's spec automatically when shapes match
+        self.param_shardings = dict(param_shardings or {})
         self.program = program
         self.feed_names = feed_names
         self.fetch_names = fetch_names
@@ -181,10 +187,45 @@ class _CompiledBlock:
     def run(self, scope: Scope, feeds: Dict[str, Any], rng):
         mut = {n: scope.find_var(n).get_tensor().array for n in self.mut_state}
         ro = {n: scope.find_var(n).get_tensor().array for n in self.ro_state}
+        if self.mesh is not None:
+            # data-parallel placement: params/state replicated, feed batch
+            # sharded on the dp axis. XLA's sharding propagation inserts the
+            # grad all-reduces over ICI (replaces reference allreduce
+            # op-handles — multi_devices_graph_pass.cc:604).
+            from ..parallel.mesh import replicated, shard_feed
+            from jax.sharding import NamedSharding
+            repl = replicated(self.mesh)
+
+            def place(n, a):
+                spec = self._sharding_for(n, a)
+                if spec is None:
+                    return jax.device_put(a, repl)
+                return jax.device_put(a, NamedSharding(self.mesh, spec))
+            mut = {n: place(n, a) for n, a in mut.items()}
+            ro = {n: place(n, a) for n, a in ro.items()}
+            feeds = {n: shard_feed(self.mesh, n, a)
+                     for n, a in feeds.items()}
+            rng = jax.device_put(rng, repl)
         fetches, new_mut, extra = self._jitted(mut, ro, feeds, rng)
         for n, v in {**new_mut, **extra}.items():
             scope.var(n).set_value(LoDTensor(v))
         return fetches
+
+    def _sharding_for(self, name: str, a):
+        """TP spec for a state var: exact param match, or an optimizer
+        accumulator named '<param>_<acc>' with the param's shape."""
+        spec = self.param_shardings.get(name)
+        if spec is not None:
+            return spec
+        for pname, pspec in self.param_shardings.items():
+            if name.startswith(pname + "_"):
+                try:
+                    ndim = len(pspec)
+                except TypeError:
+                    return None
+                if hasattr(a, "ndim") and a.ndim == ndim:
+                    return pspec
+        return None
 
 
 class Executor:
@@ -203,7 +244,8 @@ class Executor:
     def run(self, program: Optional[Program] = None, feed=None,
             fetch_list=None, feed_var_name="feed", fetch_var_name="fetch",
             scope: Optional[Scope] = None, return_numpy: bool = True,
-            use_program_cache: bool = False, use_prune: bool = False):
+            use_program_cache: bool = False, use_prune: bool = False,
+            mesh=None, param_shardings=None):
         from .compiler import CompiledProgram
         if program is None:
             program = default_main_program()
@@ -231,7 +273,9 @@ class Executor:
 
         if compiled_ok:
             key = (id(program), program._version, tuple(sorted(feed)),
-                   tuple(fetch_names), id(scope))
+                   tuple(fetch_names), id(scope),
+                   None if mesh is None else
+                   (tuple(mesh.shape.items()), tuple(map(id, mesh.devices.flat))))
             cb = self._compiled_cache.get(key)
             # guard id() reuse: a dead scope's id can be recycled by a new
             # scope with different state — validate the weakref identity
@@ -241,7 +285,9 @@ class Executor:
                 cb = _CompiledBlock(program, tuple(sorted(feed)),
                                     tuple(fetch_names), scope,
                                     program.random_seed
-                                    or core.globals_["FLAGS_seed"])
+                                    or core.globals_["FLAGS_seed"],
+                                    mesh=mesh,
+                                    param_shardings=param_shardings)
                 self._compiled_cache[key] = cb
             rng = self._next_rng(scope, program)
             fetched = cb.run(scope, feed_arrays, rng)

@@ -1,0 +1,153 @@
+"""Role makers — cluster-membership discovery (reference:
+python/paddle/fluid/incubate/fleet/base/role_maker.py — RoleMakerBase:33,
+PaddleCloudRoleMaker:442 reading PADDLE_* envs, UserDefinedRoleMaker:946).
+
+Same env contract as the reference launcher: PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS, PADDLE_CURRENT_ENDPOINT,
+and for PS mode TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "UserDefinedCollectiveRoleMaker",
+           "GeneralRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._worker_endpoints = []
+        self._server_endpoints = []
+        self._role_is_generated = False
+        self._role = None
+        self._current_id = -1
+
+    def is_worker(self):
+        raise NotImplementedError
+
+    def is_server(self):
+        raise NotImplementedError
+
+    def is_first_worker(self):
+        return self.is_worker() and self.worker_index() == 0
+
+    def worker_num(self):
+        return len(self._worker_endpoints) or 1
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+    def generate_role(self):
+        raise NotImplementedError
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=False):
+        super().__init__()
+        self._is_collective = is_collective
+
+    def generate_role(self):
+        if self._role_is_generated:
+            return
+        if self._is_collective:
+            self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+            self._worker_endpoints = [
+                e for e in os.getenv("PADDLE_TRAINER_ENDPOINTS",
+                                     "").split(",") if e]
+            self._training_role = "TRAINER"
+            self._role = Role.WORKER
+        else:
+            role = os.getenv("TRAINING_ROLE", "TRAINER")
+            self._worker_endpoints = [
+                e for e in os.getenv("PADDLE_TRAINER_ENDPOINTS",
+                                     "").split(",") if e]
+            self._server_endpoints = [
+                e for e in os.getenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                                     "").split(",") if e]
+            if role == "TRAINER":
+                self._role = Role.WORKER
+                self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+            else:
+                self._role = Role.SERVER
+                cur = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+                port = os.getenv("PADDLE_PORT", "")
+                ip = os.getenv("POD_IP", "")
+                ep = cur or f"{ip}:{port}"
+                self._current_id = self._server_endpoints.index(ep) \
+                    if ep in self._server_endpoints else 0
+        self._role_is_generated = True
+
+    def is_worker(self):
+        self.generate_role()
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        self.generate_role()
+        return self._role == Role.SERVER
+
+    def worker_num(self):
+        self.generate_role()
+        return max(len(self._worker_endpoints),
+                   int(os.getenv("PADDLE_TRAINERS_NUM", "1")))
+
+    def worker_index(self):
+        self.generate_role()
+        return self._current_id
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._worker_num = worker_num
+        self._server_endpoints = server_endpoints or []
+
+    def generate_role(self):
+        self._role_is_generated = True
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def worker_num(self):
+        return self._worker_num
+
+
+class UserDefinedCollectiveRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, worker_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._worker_endpoints = worker_endpoints or []
+
+    def generate_role(self):
+        self._role_is_generated = True
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+
+GeneralRoleMaker = PaddleCloudRoleMaker

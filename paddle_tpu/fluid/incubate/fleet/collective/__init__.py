@@ -1,0 +1,177 @@
+"""Fleet Collective mode — multi-device/multi-host data-parallel training
+(reference: incubate/fleet/collective/__init__.py — Collective fleet:64,
+CollectiveOptimizer:384, DistributedStrategy:36, _try_to_compile:516).
+
+Inversion (SURVEY.md §2.3): the reference transpiles c_allreduce ops into
+the program and builds NCCL rings keyed by ring_id. Here
+``CollectiveOptimizer.minimize`` leaves the program alone; fleet's
+``main_program`` becomes a CompiledProgram bound to a jax Mesh spanning all
+devices of all hosts — batch sharded on "dp", params replicated; XLA emits
+the ICI/DCN all-reduces. Multi-host rendezvous: jax.distributed.initialize
+over the same PADDLE_TRAINER_* env contract. The knobs on
+DistributedStrategy (nccl_comm_num, hierarchical allreduce, fuse_*) are
+accepted for script parity; XLA already fuses and picks topologies."""
+from __future__ import annotations
+
+import os
+
+from ..base.fleet_base import Fleet, DistributedOptimizer, Mode
+from ..... import fluid as fluid_pkg  # paddle_tpu.fluid
+from .....fluid import core, io as fluid_io
+from .....fluid.compiler import CompiledProgram, BuildStrategy, \
+    ExecutionStrategy
+from .....fluid.framework import default_main_program, \
+    default_startup_program
+from .....fluid.executor import Executor
+
+__all__ = ["fleet", "Collective", "CollectiveOptimizer",
+           "DistributedStrategy", "CollectiveOpBasedOptimizer"]
+
+
+class DistributedStrategy:
+    """reference: collective/__init__.py:36 + pybind BuildStrategy knobs."""
+
+    def __init__(self):
+        self.use_local_sgd = False
+        self.use_dist_fc = False
+        self.mode = "collective"
+        self.collective_mode = "grad_allreduce"
+        self.nccl_comm_num = 1
+        self.forward_recompute = False
+        self.recompute_checkpoints = []
+        self.use_amp = False
+        self.amp_loss_scaling = 2 ** 15
+        self.exec_strategy = ExecutionStrategy()
+        self._build_strategy = BuildStrategy()
+
+    @property
+    def build_strategy(self):
+        return self._build_strategy
+
+    @build_strategy.setter
+    def build_strategy(self, value):
+        self._build_strategy = value
+
+
+class Collective(Fleet):
+    def __init__(self):
+        super().__init__(Mode.COLLECTIVE)
+        self._local_ip = 0
+        self.startup_program = None
+        self._origin_program = None
+        self._transpiled_program = None
+        self.main_program = None
+
+    def init(self, role_maker=None):
+        super().init(role_maker)
+        self._init_distributed_runtime()
+
+    def _init_distributed_runtime(self):
+        """NCCL-id bootstrap equivalent: bring up jax.distributed across
+        hosts using the PADDLE_* env contract (reference: gen_nccl_id over
+        gRPC — operators/collective/c_gen_nccl_id_op.cc)."""
+        import jax
+        nranks = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        if nranks > 1 and not jax.distributed.is_initialized():
+            eps = self.worker_endpoints()
+            coordinator = eps[0] if eps else os.getenv(
+                "PADDLE_TRAINER_ENDPOINTS", "").split(",")[0]
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=nranks,
+                process_id=self.worker_index())
+
+    def init_worker(self):
+        pass
+
+    def run_worker(self, main_programs=None, scopes=None):
+        pass
+
+    def init_server(self, model_dir=None):
+        pass
+
+    def run_server(self):
+        pass
+
+    def stop_worker(self):
+        pass
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = CollectiveOptimizer(optimizer, strategy)
+        return self._optimizer
+
+    def save_inference_model(self, executor, dirname, feeded_var_names=None,
+                             target_vars=None, main_program=None,
+                             export_for_deployment=True):
+        fluid_io.save_inference_model(dirname, feeded_var_names,
+                                      target_vars, executor,
+                                      main_program or self._origin_program,
+                                      None, None, export_for_deployment)
+
+    def save_persistables(self, executor, dirname, main_program=None,
+                          filename=None):
+        fluid_io.save_persistables(executor, dirname,
+                                   main_program or self._origin_program,
+                                   filename)
+
+
+fleet = Collective()
+
+
+class CollectiveOpBasedOptimizer(DistributedOptimizer):
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+
+class CollectiveOptimizer(DistributedOptimizer):
+    """reference: collective/__init__.py:384."""
+
+    def __init__(self, optimizer, strategy=None):
+        if strategy is None:
+            strategy = DistributedStrategy()
+        super().__init__(optimizer, strategy)
+        self._strategy = strategy
+        self.print_config = False
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def _compile(self, main_program, loss_name):
+        cp = CompiledProgram(main_program,
+                             self._strategy.build_strategy)
+        cp.with_data_parallel(loss_name=loss_name,
+                              exec_strategy=self._strategy.exec_strategy)
+        return cp
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        opt = self._optimizer
+        if self._strategy.forward_recompute:
+            from .....fluid.optimizer import RecomputeOptimizer
+            opt = RecomputeOptimizer(opt)
+            opt._set_checkpoints(self._strategy.recompute_checkpoints)
+        if self._strategy.use_amp:
+            from .....fluid.contrib import mixed_precision
+            opt = mixed_precision.decorate(
+                opt, init_loss_scaling=self._strategy.amp_loss_scaling)
+        optimize_ops, param_grads = opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        main = loss.block.program
+        fleet._origin_program = main
+        fleet._transpiled_program = main
+        fleet.main_program = self._compile(main, loss.name)
+        fleet.startup_program = startup_program or \
+            default_startup_program()
+        return optimize_ops, param_grads

@@ -2,20 +2,30 @@
 ParallelExecutor path — compiler.py:308, parallel_executor.cc:442).
 
 TPU design: no per-device graph clones or allreduce op-handles. The step
-function the executor already traces is jitted under a 1-axis Mesh ("dp")
-with the feed batch sharded on axis 0 and params replicated; grad psums are
-inserted by XLA from the sharding propagation. Single-device: plain run.
-"""
+function the executor already traces is run under a 1-axis Mesh ("dp") with
+the feed batch sharded on dim 0 and params replicated; the gradient
+all-reduces come from XLA sharding propagation over ICI. Single device: a
+plain jitted run."""
 from __future__ import annotations
 
 import jax
 
+from .mesh import build_mesh
+
 
 def run_data_parallel(executor, compiled_program, feed, fetch_list, scope,
                       return_numpy):
-    # Round-1: single-process path — jit over the local mesh. With one
-    # device this is exactly Executor.run; the mesh path lands with
-    # parallel/fleet (see dryrun_multichip in __graft_entry__.py).
+    n = len(jax.devices())
+    if n <= 1:
+        return executor.run(compiled_program._program, feed=feed,
+                            fetch_list=fetch_list, scope=scope,
+                            return_numpy=return_numpy)
+    mesh = getattr(compiled_program, "_mesh", None)
+    if mesh is None:
+        places = compiled_program._places
+        num = len(places) if places else n
+        mesh = build_mesh(num_devices=num)
+        compiled_program._mesh = mesh
     return executor.run(compiled_program._program, feed=feed,
                         fetch_list=fetch_list, scope=scope,
-                        return_numpy=return_numpy)
+                        return_numpy=return_numpy, mesh=mesh)

@@ -1,0 +1,56 @@
+"""Mesh construction + feed sharding for data/model parallel execution.
+
+Replaces the reference's multi-device machinery (reference:
+framework/parallel_executor.cc:442 — per-device graph clones, NCCL comms,
+allreduce op-handles) with sharding metadata: ONE jitted step function whose
+feed batch is sharded over the "dp" mesh axis and whose parameters are
+replicated; XLA's sharding propagation inserts the gradient all-reduces over
+ICI. Multi-host: the same code with jax.distributed initialized — each host
+provides its local shard via make_array_from_process_local_data (DCN/ICI
+handled by XLA).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "dp"
+MODEL_AXIS = "mp"
+
+
+def build_mesh(num_devices: Optional[int] = None, model_parallel: int = 1,
+               devices=None) -> Mesh:
+    devs = list(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    n = len(devs)
+    mp = max(1, model_parallel)
+    dp = n // mp
+    arr = np.asarray(devs).reshape(dp, mp)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, ndim: int) -> NamedSharding:
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def shard_feed(mesh: Mesh, name: str, array) -> jax.Array:
+    """Place a host batch onto the mesh, sharded on dim 0. In multi-process
+    mode the given array is this process's LOCAL shard."""
+    arr = np.asarray(array)
+    dp = mesh.shape[DATA_AXIS]
+    sharding = batch_sharded(mesh, max(arr.ndim, 1))
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(sharding, arr)
+    if arr.shape[0] % dp != 0:
+        raise ValueError(
+            f"feed '{name}' batch {arr.shape[0]} not divisible by "
+            f"data-parallel degree {dp}")
+    return jax.device_put(arr, sharding)
