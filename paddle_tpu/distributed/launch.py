@@ -33,14 +33,37 @@ def _parse_args():
     return p.parse_args()
 
 
+def _local_addrs():
+    import socket
+    names = {"127.0.0.1", "localhost", socket.gethostname()}
+    try:
+        names.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    return names
+
+
 def launch():
     args = _parse_args()
     hosts = [h for h in args.ips.split(",") if h]
     nproc = args.nproc if args.nproc is not None else len(hosts)
     endpoints = [f"{hosts[i % len(hosts)]}:{args.start_port + i}"
                  for i in range(nproc)]
+    # one worker per host: only spawn the ranks whose endpoint names THIS
+    # machine (reference launch.py filters by node IP the same way); local
+    # --nproc testing spawns everything.
+    local = _local_addrs()
+    if args.nproc is None and len(hosts) > 1:
+        ranks = [r for r in range(nproc)
+                 if endpoints[r].rsplit(":", 1)[0] in local]
+        if not ranks:
+            raise SystemExit(
+                f"none of --ips={args.ips} matches this host "
+                f"({sorted(local)}); run the launcher on each host")
+    else:
+        ranks = list(range(nproc))
     procs = []
-    for rank in range(nproc):
+    for rank in ranks:
         env = dict(os.environ)
         env.update({
             "PADDLE_TRAINER_ID": str(rank),

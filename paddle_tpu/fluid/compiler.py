@@ -86,13 +86,16 @@ class CompiledProgram:
         self._places = places
         return self
 
-    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+    def _run(self, executor, feed, fetch_list, scope, return_numpy,
+             mesh=None, param_shardings=None):
         """Delegate to the executor. Data-parallel execution shards the feed
         batch over the device mesh (see parallel/data_parallel.py); on a
         single chip this is a plain jitted run."""
         if self._is_data_parallel:
             from ..parallel.data_parallel import run_data_parallel
             return run_data_parallel(executor, self, feed, fetch_list, scope,
-                                     return_numpy)
+                                     return_numpy,
+                                     param_shardings=param_shardings)
         return executor.run(self._program, feed=feed, fetch_list=fetch_list,
-                            scope=scope, return_numpy=return_numpy)
+                            scope=scope, return_numpy=return_numpy,
+                            mesh=mesh, param_shardings=param_shardings)

@@ -250,7 +250,8 @@ class Executor:
         if program is None:
             program = default_main_program()
         if isinstance(program, CompiledProgram):
-            return program._run(self, feed, fetch_list, scope, return_numpy)
+            return program._run(self, feed, fetch_list, scope, return_numpy,
+                                mesh=mesh, param_shardings=param_shardings)
         if scope is None:
             scope = global_scope()
         feed = feed or {}
@@ -275,7 +276,10 @@ class Executor:
             key = (id(program), program._version, tuple(sorted(feed)),
                    tuple(fetch_names), id(scope),
                    None if mesh is None else
-                   (tuple(mesh.shape.items()), tuple(map(id, mesh.devices.flat))))
+                   (tuple(mesh.shape.items()), tuple(map(id, mesh.devices.flat))),
+                   None if not param_shardings else
+                   tuple(sorted((k, str(v))
+                                for k, v in param_shardings.items())))
             cb = self._compiled_cache.get(key)
             # guard id() reuse: a dead scope's id can be recycled by a new
             # scope with different state — validate the weakref identity

@@ -16,13 +16,10 @@ from __future__ import annotations
 import os
 
 from ..base.fleet_base import Fleet, DistributedOptimizer, Mode
-from ..... import fluid as fluid_pkg  # paddle_tpu.fluid
-from .....fluid import core, io as fluid_io
+from .....fluid import io as fluid_io
 from .....fluid.compiler import CompiledProgram, BuildStrategy, \
     ExecutionStrategy
-from .....fluid.framework import default_main_program, \
-    default_startup_program
-from .....fluid.executor import Executor
+from .....fluid.framework import default_startup_program
 
 __all__ = ["fleet", "Collective", "CollectiveOptimizer",
            "DistributedStrategy", "CollectiveOpBasedOptimizer"]

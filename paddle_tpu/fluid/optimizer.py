@@ -577,12 +577,88 @@ class ExponentialMovingAverage:
 
 
 class PipelineOptimizer:
+    """Pipeline-parallel training (reference optimizer.py:3550).
+
+    The reference splits the program into sections at ``cut_list`` variables
+    and hands them to `PipelineTrainer`/`SectionWorker` threads that move
+    scopes through blocking queues (reference: pipeline_trainer.cc:24,
+    section_worker.cc:142). Here the split is the same — contiguous op
+    sections bounded at the producer of each cut variable — but execution is
+    compiled, not threaded: section metadata is attached to the program as
+    ``program._pipeline_opt`` and lowered onto the GPipe tick schedule in
+    `paddle_tpu.parallel.pipeline.gpipe` (shard_map over the "pp" mesh axis,
+    `lax.ppermute` stage transfers over ICI). Queue-runtime knobs
+    (`queue_size`, `concurrency_list`, `start_cpu_core_id`) have no compiled
+    equivalent and are recorded but inert; ``sync_steps`` maps to the
+    microbatch count of the schedule.
+    """
+
     def __init__(self, optimizer, cut_list=None, place_list=None,
                  concurrency_list=None, queue_size=30, sync_steps=1,
                  start_cpu_core_id=0):
-        raise NotImplementedError(
-            "PipelineOptimizer: lands with parallel/pipeline.py (shard_map "
-            "stage schedule)")
+        self._optimizer = optimizer
+        self._cut_list = cut_list or []
+        self._place_list = place_list or []
+        self._concurrency_list = concurrency_list or []
+        self._queue_size = queue_size
+        self._sync_steps = sync_steps
+
+    def _cut_var_names(self):
+        names = []
+        for group in self._cut_list:
+            items = group if isinstance(group, (list, tuple)) else [group]
+            for v in items:
+                names.append(v.name if hasattr(v, "name") else str(v))
+        return names
+
+    def _split_program(self, program):
+        """Section i = ops [bounds[i], bounds[i+1]); a section ends right
+        after the op that first produces a cut variable (mirrors reference
+        optimizer.py:3550 section extraction)."""
+        block = program.global_block()
+        producer = {}
+        for i, op in enumerate(block.ops):
+            for n in op.output_arg_names:
+                producer.setdefault(n, i)
+        cuts = sorted({producer[n] + 1 for n in self._cut_var_names()
+                       if n in producer})
+        bounds = [0] + cuts + [len(block.ops)]
+        return [list(range(bounds[i], bounds[i + 1]))
+                for i in range(len(bounds) - 1)
+                if bounds[i] < bounds[i + 1]]
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        optimize_ops, params_grads = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        program = loss.block.program
+        sections = self._split_program(program)
+        # params owned by a section = params read by its ops (stage placement)
+        block = program.global_block()
+        pnames = {p.name for p, _ in params_grads}
+        section_params = []
+        seen = set()
+        for sec in sections:
+            # owner stage of a param = the section that FIRST reads it (its
+            # forward use); backward/optimizer ops reading it later stay on
+            # the owner stage, matching reference section placement
+            used = []
+            for i in sec:
+                for n in block.ops[i].input_arg_names:
+                    if n in pnames and n not in seen:
+                        seen.add(n)
+                        used.append(n)
+            section_params.append(used)
+        program._pipeline_opt = {
+            "sections": sections,
+            "section_params": section_params,
+            "cut_vars": self._cut_var_names(),
+            "num_microbatches": max(1, self._sync_steps),
+            "place_list": list(self._place_list),
+            "concurrency_list": list(self._concurrency_list),
+            "queue_size": self._queue_size,
+        }
+        return optimize_ops, params_grads
 
 
 class RecomputeOptimizer(Optimizer):

@@ -14,12 +14,13 @@ from .mesh import build_mesh
 
 
 def run_data_parallel(executor, compiled_program, feed, fetch_list, scope,
-                      return_numpy):
+                      return_numpy, param_shardings=None):
     n = len(jax.devices())
     if n <= 1:
         return executor.run(compiled_program._program, feed=feed,
                             fetch_list=fetch_list, scope=scope,
-                            return_numpy=return_numpy)
+                            return_numpy=return_numpy,
+                            param_shardings=param_shardings)
     mesh = getattr(compiled_program, "_mesh", None)
     if mesh is None:
         places = compiled_program._places
@@ -28,4 +29,5 @@ def run_data_parallel(executor, compiled_program, feed, fetch_list, scope,
         compiled_program._mesh = mesh
     return executor.run(compiled_program._program, feed=feed,
                         fetch_list=fetch_list, scope=scope,
-                        return_numpy=return_numpy, mesh=mesh)
+                        return_numpy=return_numpy, mesh=mesh,
+                        param_shardings=param_shardings)

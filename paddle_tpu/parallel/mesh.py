@@ -28,6 +28,9 @@ def build_mesh(num_devices: Optional[int] = None, model_parallel: int = 1,
         devs = devs[:num_devices]
     n = len(devs)
     mp = max(1, model_parallel)
+    if n % mp != 0:
+        raise ValueError(
+            f"device count {n} is not divisible by model_parallel={mp}")
     dp = n // mp
     arr = np.asarray(devs).reshape(dp, mp)
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))

@@ -1,0 +1,146 @@
+"""Pipeline parallelism over a TPU mesh axis — GPipe schedule as a compiled
+collective program.
+
+The reference implements pipelining as a *runtime*: `PipelineOptimizer`
+(reference: python/paddle/fluid/optimizer.py:3550) cuts the program into
+sections, and `PipelineTrainer`/`SectionWorker` threads move scopes through
+blocking queues between devices (reference:
+paddle/fluid/framework/pipeline_trainer.cc:24, section_worker.cc:142,
+trainer_desc.proto:77 SectionWorkerParameter).
+
+On TPU the schedule is *compiled* instead: every stage lives on one slice of
+a mesh axis (``"pp"``), stage parameters are sharded over that axis with a
+leading stage dimension, and one `shard_map`-ped function runs the classic
+GPipe tick loop — at tick t, stage s computes microbatch (t - s), then the
+activation ring-shifts one stage forward via `lax.ppermute` over ICI. The
+whole forward (and, through `jax.grad`, the reverse pipeline — ppermute
+transposes to the opposite shift) is a single XLA computation: no queues, no
+threads, no host in the loop.
+
+Two layers:
+  * `gpipe(...)`      — the functional scheduler (this file), used directly
+                        by model code for peak MFU.
+  * `PipelineOptimizer` (fluid/optimizer.py) — reference-API program
+    splitter that lowers section metadata onto this scheduler (homogeneous
+    stacks) or onto a microbatch-accumulation loop (heterogeneous).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+PIPELINE_AXIS = "pp"
+
+__all__ = ["PIPELINE_AXIS", "stack_stage_params", "pipeline_mesh", "gpipe",
+           "gpipe_loss_fn"]
+
+
+def pipeline_mesh(n_stages: int, devices=None) -> Mesh:
+    import numpy as np
+    devs = list(devices if devices is not None else jax.devices())[:n_stages]
+    if len(devs) != n_stages:
+        raise ValueError(f"need {n_stages} devices, have {len(devs)}")
+    return Mesh(np.asarray(devs), (PIPELINE_AXIS,))
+
+
+def stack_stage_params(per_stage: Sequence[Any]):
+    """Stack N same-structure stage param trees along a new leading stage
+    axis (the axis `gpipe` shards over ``"pp"``)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def _shard_stacked(mesh: Mesh, stacked):
+    """Place stacked stage params: leading (stage) dim over the pp axis."""
+    def put(x):
+        spec = P(PIPELINE_AXIS, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map(put, stacked)
+
+
+def gpipe(stage_fn: Callable[[Any, Any], Any], stacked_params, xs, *,
+          mesh: Mesh, axis: str = PIPELINE_AXIS):
+    """Run microbatches ``xs`` through an ``n_stages``-deep pipeline.
+
+    stage_fn(params_i, x) -> y          one stage; same signature per stage
+                                        (heterogeneity via lax.switch inside)
+    stacked_params                      pytree, leading dim n_stages
+                                        (see `stack_stage_params`)
+    xs : [n_micro, mb, ...]             microbatched input (replicated)
+    returns ys : [n_micro, mb, ...]     last stage's outputs (replicated)
+
+    Stage activations must keep the input's shape/dtype contract
+    (y.shape == stage input shape) — the usual transformer/MLP residual-width
+    case. The tick loop runs n_micro + n_stages - 1 steps; bubbles compute on
+    garbage and are masked out, exactly the GPipe cost model.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = xs.shape[0]
+    total = n_micro + n_stages - 1
+    stacked_params = _shard_stacked(mesh, stacked_params)
+
+    pspec_params = jax.tree_util.tree_map(
+        lambda x: P(axis, *([None] * (x.ndim - 1))), stacked_params)
+
+    def per_device(params, xs_local):
+        # params leaves arrive with leading dim 1 (this stage's slice)
+        params = jax.tree_util.tree_map(lambda x: x[0], params)
+        sidx = lax.axis_index(axis)
+        right = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            inbuf, ys = carry
+            # stage 0 ingests microbatch t (clamped; bubbles masked later)
+            mb = lax.dynamic_index_in_dim(
+                xs_local, jnp.clip(t, 0, n_micro - 1), keepdims=False)
+            x = jnp.where(sidx == 0, mb, inbuf)
+            y = stage_fn(params, x)
+            # last stage writes microbatch (t - n_stages + 1) when valid
+            oidx = t - (n_stages - 1)
+            valid = jnp.logical_and(sidx == n_stages - 1, oidx >= 0)
+            upd = lax.dynamic_update_index_in_dim(
+                ys, y, jnp.clip(oidx, 0, n_micro - 1), 0)
+            ys = jnp.where(valid, upd, ys)
+            nxt = lax.ppermute(y, axis, right)
+            return (nxt, ys), None
+
+        init = (jnp.zeros_like(xs_local[0]),
+                jnp.zeros((n_micro,) + xs_local.shape[1:], xs_local.dtype))
+        # carry becomes device-varying after the first tick; mark it so
+        if hasattr(lax, "pcast"):
+            init = jax.tree_util.tree_map(
+                lambda x: lax.pcast(x, (axis,), to="varying"), init)
+        else:
+            init = jax.tree_util.tree_map(
+                lambda x: lax.pvary(x, (axis,)), init)
+        (_, ys), _ = lax.scan(tick, init, jnp.arange(total))
+        # ys is only populated on the last stage; zero elsewhere + psum
+        # replicates it to every stage (single all-reduce over ICI).
+        ys = lax.psum(jnp.where(sidx == n_stages - 1, ys,
+                                jnp.zeros_like(ys)), axis)
+        return ys
+
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(pspec_params, P()), out_specs=P())
+    return fn(stacked_params, xs)
+
+
+def gpipe_loss_fn(stage_fn, loss_fn):
+    """Compose gpipe with a per-microbatch loss → mean scalar, for jax.grad.
+
+    loss_fn(y, target_microbatch) -> scalar.  Targets shaped like xs'
+    leading microbatch dim. Backward through the pipeline is automatic:
+    jax.grad transposes the ppermute ring into the reverse schedule.
+    """
+    def fn(stacked_params, xs, targets, *, mesh, axis=PIPELINE_AXIS):
+        ys = gpipe(stage_fn, stacked_params, xs, mesh=mesh, axis=axis)
+        losses = jax.vmap(loss_fn)(ys, targets)
+        return jnp.mean(losses)
+    return fn
