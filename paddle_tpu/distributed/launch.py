@@ -53,7 +53,7 @@ def launch():
     # machine (reference launch.py filters by node IP the same way); local
     # --nproc testing spawns everything.
     local = _local_addrs()
-    if args.nproc is None and len(hosts) > 1:
+    if len(hosts) > 1:
         ranks = [r for r in range(nproc)
                  if endpoints[r].rsplit(":", 1)[0] in local]
         if not ranks:

@@ -93,6 +93,10 @@ class CompiledProgram:
         single chip this is a plain jitted run."""
         if self._is_data_parallel:
             from ..parallel.data_parallel import run_data_parallel
+            if mesh is not None:
+                # an explicit mesh (e.g. dp×mp) overrides the auto-built
+                # 1-axis dp mesh; cached for subsequent steps
+                self._mesh = mesh
             return run_data_parallel(executor, self, feed, fetch_list, scope,
                                      return_numpy,
                                      param_shardings=param_shardings)

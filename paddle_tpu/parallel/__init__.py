@@ -1,4 +1,8 @@
-"""Parallelism runtime: mesh management, data/model/pipeline parallel,
-Fleet API (reference: Fleet + transpiler + ParallelExecutor stack, re-built
-on jax.sharding.Mesh + pjit/shard_map over ICI)."""
+"""Parallelism runtime: mesh management, data/model/pipeline/sequence
+parallel, Fleet API (reference: Fleet + transpiler + ParallelExecutor
+stack, re-built on jax.sharding.Mesh + pjit/shard_map over ICI)."""
 from . import env  # noqa: F401
+from .mesh import build_mesh  # noqa: F401
+from .pipeline import gpipe, pipeline_mesh, stack_stage_params  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention, sequence_mesh, ulysses_attention)

@@ -20,6 +20,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "dp"
 MODEL_AXIS = "mp"
 
+try:
+    from jax import shard_map  # noqa: F401  (re-exported for parallel/*)
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+
+def axis_mesh(n: int, axis_name: str, devices=None) -> Mesh:
+    """1-D named-axis mesh over the first n devices (pp/sp helpers)."""
+    devs = list(devices if devices is not None else jax.devices())[:n]
+    if len(devs) != n:
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    return Mesh(np.asarray(devs), (axis_name,))
+
 
 def build_mesh(num_devices: Optional[int] = None, model_parallel: int = 1,
                devices=None) -> Mesh:

@@ -32,10 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+
+from .mesh import axis_mesh, shard_map
 
 PIPELINE_AXIS = "pp"
 
@@ -44,11 +42,7 @@ __all__ = ["PIPELINE_AXIS", "stack_stage_params", "pipeline_mesh", "gpipe",
 
 
 def pipeline_mesh(n_stages: int, devices=None) -> Mesh:
-    import numpy as np
-    devs = list(devices if devices is not None else jax.devices())[:n_stages]
-    if len(devs) != n_stages:
-        raise ValueError(f"need {n_stages} devices, have {len(devs)}")
-    return Mesh(np.asarray(devs), (PIPELINE_AXIS,))
+    return axis_mesh(n_stages, PIPELINE_AXIS, devices)
 
 
 def stack_stage_params(per_stage: Sequence[Any]):

@@ -1,0 +1,135 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO sequence parallelism (SURVEY.md §5 long-context:
+absent in v1.7 — long sequences meant LoDTensor ragged batching,
+lod_tensor.h:104, plus a fused attention op for inference,
+operators/fused/multihead_matmul_op.cu). This module is the TPU-first
+extension that makes long-context training first-class:
+
+* `ring_attention` — blockwise attention with online-softmax accumulation;
+  Q stays resident on its sequence shard while K/V blocks rotate around the
+  "sp" mesh axis via `lax.ppermute` (one ICI hop per step, compute/comms
+  overlap under XLA). Memory per chip is O(S/n · S/n) scores instead of
+  O(S·S); max sequence length scales linearly with the ring size.
+* `ulysses_attention` — all-to-all sequence parallelism: resharding
+  [B,H,S/n,D] → [B,H/n,S,D] with `lax.all_to_all`, local (flash) attention
+  over the full sequence on each chip's head slice, then the inverse
+  all-to-all. Cheaper comms than the ring when H ≥ n.
+
+Both are pure-JAX differentiable (ppermute/all_to_all transpose to their
+inverses, so the backward pass is automatically the reverse ring/reshard)
+and run under one `shard_map` over the "sp" axis.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import axis_mesh, shard_map
+
+SEQUENCE_AXIS = "sp"
+_NEG = -1e30
+
+__all__ = ["SEQUENCE_AXIS", "sequence_mesh", "ring_attention",
+           "ulysses_attention"]
+
+
+def sequence_mesh(n: int, devices=None) -> Mesh:
+    return axis_mesh(n, SEQUENCE_AXIS, devices)
+
+
+def _block_update(q, k, v, o, m, l, sm_scale, q_off, k_off, causal):
+    """One flash/online-softmax accumulation step against a K/V block.
+
+    q [B,H,s,D]; k,v [B,H,c,D]; o accum [B,H,s,D] (fp32);
+    m,l running max / normalizer [B,H,s,1] (fp32).
+    q_off/k_off: global sequence offsets of this q shard / k block.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        sq, ck = q.shape[2], k.shape[2]
+        rows = q_off + lax.broadcasted_iota(jnp.int32, (sq, ck), 0)
+        cols = k_off + lax.broadcasted_iota(jnp.int32, (sq, ck), 1)
+        mask = rows >= cols
+        s = jnp.where(mask, s, _NEG)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    alpha = jnp.exp(m - m_new)
+    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    o = o * alpha + jnp.einsum("bhqk,bhkd->bhqd",
+                               p.astype(v.dtype), v).astype(jnp.float32)
+    return o, m_new, l
+
+
+def ring_attention(q, k, v, sm_scale=None, causal=False, *, mesh,
+                   axis: str = SEQUENCE_AXIS):
+    """Attention over a sequence sharded on `axis`. q,k,v: [B,H,S,D] global
+    (S = n · S_local). Returns [B,H,S,D] with the same sharding."""
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    n = mesh.shape[axis]
+    seq_spec = P(None, None, axis, None)
+
+    def per_device(q, k, v):
+        B, H, sq, D = q.shape
+        idx = lax.axis_index(axis)
+        right = [(i, (i + 1) % n) for i in range(n)]
+        o = jnp.zeros(q.shape, jnp.float32)
+        m = jnp.full((B, H, sq, 1), _NEG, jnp.float32)
+        l = jnp.zeros((B, H, sq, 1), jnp.float32)
+        k_cur, v_cur = k, v
+        for step in range(n):
+            src = (idx - step) % n  # owner of the block we hold now
+            o, m, l = _block_update(q, k_cur, v_cur, o, m, l, sm_scale,
+                                    q_off=idx * sq, k_off=src * sq,
+                                    causal=causal)
+            if step != n - 1:
+                k_cur = lax.ppermute(k_cur, axis, right)
+                v_cur = lax.ppermute(v_cur, axis, right)
+        return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(seq_spec, seq_spec, seq_spec),
+                   out_specs=seq_spec)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, sm_scale=None, causal=False, *, mesh,
+                      axis: str = SEQUENCE_AXIS, attn_fn=None):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style). q,k,v:
+    [B,H,S,D] sharded on S over `axis`; H must be divisible by the axis
+    size. Internally each chip attends over the FULL sequence for H/n heads
+    (using `attn_fn`, default the Pallas flash attention), so any local
+    attention kernel becomes sequence-parallel for free."""
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    n = mesh.shape[axis]
+    H = q.shape[1]
+    if H % n != 0:
+        raise ValueError(f"heads {H} not divisible by sp={n}")
+    if attn_fn is None:
+        from ..ops.pallas.flash_attention import flash_attention
+        attn_fn = flash_attention
+    seq_spec = P(None, None, axis, None)
+
+    def per_device(q, k, v):
+        # [B, H, s, D] -> [B, H/n, S, D]: split heads, gather sequence
+        def fwd(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        def inv(x):
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+        o = attn_fn(fwd(q), fwd(k), fwd(v), sm_scale, causal)
+        return inv(o)
+
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(seq_spec, seq_spec, seq_spec),
+                   out_specs=seq_spec)
+    return fn(q, k, v)
