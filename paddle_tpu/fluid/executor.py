@@ -79,14 +79,72 @@ def _op_is_stateful(op) -> bool:
     return True  # unknown op: be safe, run eagerly (will raise with context)
 
 
+# ------------------------------------------------------------------ LoD
+# LoD (variable-length sequence) metadata rides NEXT TO arrays as
+# host-static nested tuples; under jit it is trace-time constant (the jit
+# cache is keyed per feed-LoD bucket), so segment ids computed from it
+# lower to XLA constants. Replaces the reference's per-step LoD InferShape
+# (framework/lod_tensor.h:104, operator.cc:967).
+def _normalize_lod(lod):
+    if not lod:
+        return None
+    return tuple(tuple(int(x) for x in lvl) for lvl in lod)
+
+
+def _op_needs_lod(op) -> bool:
+    if OPS.has(op.type):
+        return OPS.get(op.type).needs_lod
+    if op.type.endswith("_grad") and OPS.has(op.type[:-5]):
+        return OPS.get(op.type[:-5]).needs_lod
+    return False
+
+
+def _collect_in_lods(op, lookup):
+    return {slot: [lookup(n) for n in names]
+            for slot, names in op.inputs.items()}
+
+
+def _propagate_lods(op, outs, in_lods, set_lod, get_len):
+    """Apply kernel-declared output LoDs; else share the first lod-bearing
+    input's LoD with outputs of matching leading length (reference ShareLoD
+    default)."""
+    explicit = None
+    if isinstance(outs, dict):
+        explicit = outs.pop("_lod", None)
+    if explicit:
+        for slot, levels_list in explicit.items():
+            names = op.outputs.get(slot) or []
+            for n, lv in zip(names, levels_list):
+                set_lod(n, _normalize_lod(lv))
+        return
+    src = None
+    for slot, lods in in_lods.items():
+        for lv in lods:
+            if lv:
+                src = lv
+                break
+        if src:
+            break
+    if not src:
+        return
+    total = src[-1][-1]
+    for slot, names in op.outputs.items():
+        for n in names:
+            if get_len(n) == total:
+                set_lod(n, src)
+
+
 class _CompiledBlock:
     """One traced+jitted step function for (program, feeds, fetches)."""
 
     def __init__(self, program: Program, feed_names: Tuple[str, ...],
                  fetch_names: Tuple[str, ...], scope: Scope, seed: int,
-                 mesh=None, param_shardings=None):
+                 mesh=None, param_shardings=None, feed_lods=None):
         import weakref
         self._scope_ref = weakref.ref(scope)
+        # trace-time-static LoD of feeds + initialized state vars
+        self._init_lods: Dict[str, tuple] = dict(feed_lods or {})
+        self.fetch_lods: List = [None] * len(fetch_names)
         self.mesh = mesh
         # name → PartitionSpec for tensor-parallel params (anything absent
         # is replicated); the optimizer state for a sharded param follows
@@ -129,6 +187,10 @@ class _CompiledBlock:
         # state vars that get overwritten -> donated & written back
         self.mut_state = tuple(n for n in state_names if n in written)
         self.ro_state = tuple(n for n in state_names if n not in written)
+        for n in state_names:
+            lv = _normalize_lod(scope.find_var(n).get_tensor().lod())
+            if lv:
+                self._init_lods.setdefault(n, lv)
         # persistable outputs not in state (e.g. newly created opt moments
         # already initialized by startup → they are in state; anything else
         # persistable written gets written back too)
@@ -146,12 +208,17 @@ class _CompiledBlock:
         env.update(ro_state)
         env.update(mut_state)
         env.update(feeds)
+        lod_env: Dict[str, tuple] = dict(self._init_lods)
         for idx, op in enumerate(self.ops):
             ins = {}
             for slot, names in op.inputs.items():
                 ins[slot] = [env.get(n) for n in names]
             attrs = op.attrs
             otype = op.type
+            in_lods = _collect_in_lods(op, lod_env.get)
+            if _op_needs_lod(op):
+                attrs = dict(attrs)
+                attrs["_lod"] = in_lods
             if OPS.has(otype):
                 info = OPS.get(otype)
                 if info.needs_rng:
@@ -175,11 +242,17 @@ class _CompiledBlock:
                 for n, v in zip(names, vals):
                     if v is not None and n != "@EMPTY@":
                         env[n] = v
+            _propagate_lods(
+                op, outs, in_lods,
+                lod_env.__setitem__,
+                lambda n: (env[n].shape[0] if n in env and
+                           getattr(env[n], "ndim", 0) else None))
         fetches = []
-        for n in self.fetch_names:
+        for i, n in enumerate(self.fetch_names):
             if n not in env:
                 raise KeyError(f"fetch var '{n}' not produced by program")
             fetches.append(env[n])
+            self.fetch_lods[i] = lod_env.get(n)
         new_mut = {n: env[n] for n in self.mut_state}
         extra = {n: env[n] for n in self.extra_writeback if n in env}
         return fetches, new_mut, extra
@@ -260,10 +333,14 @@ class Executor:
         # materialize program vars' metadata for persistables (create slots)
         # feeds → device
         feed_arrays = {}
+        feed_lods = {}
         for name, data in feed.items():
             t = _as_lodtensor(data, self.place)
             scope.var(name).set_value(t)
             feed_arrays[name] = t.array
+            lv = _normalize_lod(t.lod())
+            if lv:
+                feed_lods[name] = lv
 
         mode = core.globals_["FLAGS_executor_mode"]
         has_stateful = any(_op_is_stateful(op) for op in
@@ -275,6 +352,7 @@ class Executor:
         if compiled_ok:
             key = (id(program), program._version, tuple(sorted(feed)),
                    tuple(fetch_names), id(scope),
+                   tuple(sorted(feed_lods.items())),
                    None if mesh is None else
                    (tuple(mesh.shape.items()), tuple(map(id, mesh.devices.flat))),
                    None if not param_shardings else
@@ -291,25 +369,33 @@ class Executor:
                                     program.random_seed
                                     or core.globals_["FLAGS_seed"],
                                     mesh=mesh,
-                                    param_shardings=param_shardings)
+                                    param_shardings=param_shardings,
+                                    feed_lods=feed_lods)
                 self._compiled_cache[key] = cb
             rng = self._next_rng(scope, program)
             fetched = cb.run(scope, feed_arrays, rng)
+            fetch_lods = cb.fetch_lods
         else:
             rng = self._next_rng(scope, program)
             self._run_block_eager(program.global_block(), scope, rng)
             fetched = []
+            fetch_lods = []
             for n in fetch_names:
                 v = scope.find_var(n)
                 if v is None:
                     raise KeyError(f"fetch var '{n}' not found in scope")
                 val = v.value()
-                fetched.append(val.array if isinstance(val, LoDTensor) else val)
+                if isinstance(val, LoDTensor):
+                    fetched.append(val.array)
+                    fetch_lods.append(_normalize_lod(val.lod()))
+                else:
+                    fetched.append(val)
+                    fetch_lods.append(None)
 
         if fetch_names and return_numpy:
             return [np.asarray(f) for f in fetched]
         if fetch_names:
-            return [LoDTensor(f) for f in fetched]
+            return [LoDTensor(f, lod=lv) for f, lv in zip(fetched, fetch_lods)]
         return []
 
     # --------------------------------------------------------------- eager
@@ -350,6 +436,17 @@ class Executor:
                 else:
                     vals.append(None)  # stateful kernels read scope directly
             ins[slot] = vals
+
+        def _scope_lod(n):
+            v = scope.find_var(n)
+            if v is not None and v.is_initialized() and isinstance(
+                    v.value(), LoDTensor):
+                return _normalize_lod(v.value().lod())
+            return None
+        in_lods = _collect_in_lods(op, _scope_lod)
+        if _op_needs_lod(op):
+            attrs = dict(attrs)
+            attrs["_lod"] = in_lods
         if OPS.has(otype):
             info = OPS.get(otype)
             if info.needs_rng and "_rng" not in attrs:
@@ -368,6 +465,8 @@ class Executor:
             raise NotImplementedError(f"op '{otype}' is not implemented")
         if core.globals_["FLAGS_check_nan_inf"]:
             for slot, vals in (outs or {}).items():
+                if slot.startswith("_"):  # "_lod"-style metadata, not tensors
+                    continue
                 for v in vals or []:
                     if v is not None and jnp.issubdtype(v.dtype, jnp.inexact):
                         if not bool(jnp.all(jnp.isfinite(v))):
@@ -380,6 +479,21 @@ class Executor:
             for n, v in zip(names, vals):
                 if v is not None and n != "@EMPTY@":
                     scope.var(n).set_value(LoDTensor(v))
+
+        def _set_scope_lod(n, lv):
+            v = scope.find_var(n)
+            if v is not None and v.is_initialized() and isinstance(
+                    v.value(), LoDTensor):
+                v.value().set_lod([list(l) for l in lv] if lv else [])
+
+        def _scope_len(n):
+            v = scope.find_var(n)
+            if (v is not None and v.is_initialized()
+                    and isinstance(v.value(), LoDTensor)
+                    and getattr(v.value().array, "ndim", 0)):
+                return v.value().array.shape[0]
+            return None
+        _propagate_lods(op, outs, in_lods, _set_scope_lod, _scope_len)
 
 
 def _to_fetch_names(fetch_list) -> List[str]:

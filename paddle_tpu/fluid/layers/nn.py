@@ -1042,14 +1042,27 @@ def size(input):
 
 
 def lod_reset(x, y=None, target_lod=None):
-    # LoD is host metadata — compiled path treats data unchanged
-    from .tensor import assign
-    return assign(x)
+    """reference: layers/nn.py lod_reset — data unchanged, LoD replaced."""
+    if y is None and target_lod is None:
+        raise ValueError("lod_reset: either y or target_lod should be set")
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    if y is not None:
+        inputs["Y"] = [y]
+    helper.append_op(type="lod_reset", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"target_lod": list(target_lod or [])})
+    return out
 
 
 def lod_append(x, level):
-    from .tensor import assign
-    return assign(x)
+    helper = LayerHelper("lod_append")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="lod_append", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"level": list(level)})
+    return out
 
 
 def image_resize(input, out_shape=None, scale=None, name=None,
@@ -1394,7 +1407,21 @@ def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
 
 def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
                 out_stride=1, name=None):
-    raise NotImplementedError("im2sequence: pending sequence-op batch")
+    """reference: layers/nn.py im2sequence — image patches to LoD sequence."""
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    kernels = _pair(filter_size)
+    strides = _pair(stride)
+    pads = [padding] * 4 if isinstance(padding, int) else list(padding)
+    if len(pads) == 2:
+        pads = pads * 2
+    helper = LayerHelper("im2sequence", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="im2sequence", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"kernels": kernels, "strides": strides,
+                            "paddings": pads})
+    return out
 
 
 def row_conv(input, future_context_size, param_attr=None, act=None):

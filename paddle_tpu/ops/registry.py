@@ -47,6 +47,7 @@ class OpInfo:
         "type", "kernel", "infer_shape", "infer_dtype", "grad_maker",
         "no_grad", "needs_rng", "stateful", "diff_input_slots",
         "diff_output_slots", "attr_defaults", "input_slots", "output_slots",
+        "needs_lod",
     )
 
     def __init__(self, type_: str):
@@ -63,6 +64,7 @@ class OpInfo:
         self.attr_defaults: Dict[str, Any] = {}
         self.input_slots: Optional[Sequence[str]] = None
         self.output_slots: Optional[Sequence[str]] = None
+        self.needs_lod = False
 
 
 class OpInfoMap:
@@ -91,20 +93,32 @@ OPS = OpInfoMap()
 
 
 def register_op(type_: str, *, no_grad: bool = False, needs_rng: bool = False,
-                stateful: bool = False,
+                stateful: bool = False, needs_lod: bool = False,
                 diff_inputs: Optional[Sequence[str]] = None,
                 diff_outputs: Optional[Sequence[str]] = None,
                 infer_shape: Optional[Callable] = None,
                 attr_defaults: Optional[Dict[str, Any]] = None,
                 inputs: Optional[Sequence[str]] = None,
                 outputs: Optional[Sequence[str]] = None):
-    """Decorator registering a forward kernel under op name ``type_``."""
+    """Decorator registering a forward kernel under op name ``type_``.
+
+    ``needs_lod``: the kernel consumes LoD (variable-length sequence)
+    metadata. The executor injects ``attrs["_lod"] = {slot: [levels|None]}``
+    where ``levels`` is a tuple of offset-tuples — HOST-STATIC under jit
+    (the jit cache is keyed per feed-LoD bucket), so segment ids derived
+    from it are XLA constants (TPU-friendly; replaces the reference's
+    per-step dynamic LoD InferShape, lod_tensor.h:104). Kernels may return
+    a special ``"_lod"`` entry ``{out_slot: [levels|None]}`` to set output
+    LoD; absent that, the executor shares the first lod-bearing input's LoD
+    with any output of matching leading length (the reference's ShareLoD
+    default)."""
     def deco(fn: Callable):
         info = OPS.get_or_create(type_)
         info.kernel = fn
         info.no_grad = no_grad
         info.needs_rng = needs_rng
         info.stateful = stateful
+        info.needs_lod = needs_lod
         info.diff_input_slots = diff_inputs
         info.diff_output_slots = diff_outputs
         info.infer_shape = infer_shape
@@ -196,9 +210,10 @@ def run_generic_grad(fwd_type: str, ins: Dict[str, List], attrs: Dict,
             it = iter(dp.get(s, []))
             merged[s] = [next(it) if d else v for v, d in zip(vals, diff_sel[s])]
         outs = info.kernel(merged, attrs)
-        # Only outputs that have incoming grads (or are float) participate.
+        # Only outputs that have incoming grads (or are float) participate;
+        # "_lod"-style metadata entries are not tensors.
         return {k: v for k, v in outs.items()
-                if any(_is_diff_leaf(x) for x in v)}
+                if not k.startswith("_") and any(_is_diff_leaf(x) for x in v)}
 
     primals_out, vjp_fn = jax.vjp(fwd, diff_part)
 

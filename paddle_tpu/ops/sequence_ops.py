@@ -1,0 +1,507 @@
+"""Sequence (LoD) operators — variable-length sequence math over packed
+rows, the TPU-native rebuild of the reference's LoD op family
+(reference: paddle/fluid/operators/sequence_ops/*.cc, framework/lod_tensor.h:104).
+
+Representation inversion for TPU: the reference carries LoD on the tensor
+and re-runs InferShape per step; here the packed buffer ``[total_rows, ...]``
+is the device array and the LoD offsets are HOST-STATIC trace-time metadata
+(executor keys the jit cache per LoD bucket). Every index/segment/mask array
+derived from offsets is therefore an XLA constant: sequence pooling lowers
+to segment-sum/max with constant segment ids, expansion/reversal/concat to
+constant-index gathers — no dynamic shapes, MXU-friendly.
+
+Kernels receive ``attrs["_lod"][slot] = [levels|None]`` where ``levels`` is
+a tuple of offset tuples (last level = finest). They may return
+``{"_lod": {out_slot: [levels]}}`` to declare output LoD.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import (register_op, register_grad_maker, first, seq, out,
+                       mark_no_grad, OPS)
+
+
+# --------------------------------------------------------------------------
+# helpers (all host-side numpy on static offsets)
+# --------------------------------------------------------------------------
+def _lod_of(attrs, slot, idx=0):
+    lods = attrs.get("_lod") or {}
+    vals = lods.get(slot)
+    if not vals or vals[idx] is None:
+        return None
+    return vals[idx]
+
+
+def _offs(levels):
+    """Finest-level offsets as an int64 numpy array."""
+    return np.asarray(levels[-1], np.int64)
+
+
+def _require_lod(attrs, slot, op_name):
+    lv = _lod_of(attrs, slot)
+    if lv is None:
+        raise ValueError(f"{op_name}: input '{slot}' must carry LoD")
+    return lv
+
+
+def _lens(offs):
+    return offs[1:] - offs[:-1]
+
+
+def _seg_ids(offs):
+    return np.repeat(np.arange(len(offs) - 1), _lens(offs))
+
+
+def _offsets_from_lens(lens):
+    return tuple(int(x) for x in np.concatenate([[0], np.cumsum(lens)]))
+
+
+# --------------------------------------------------------------------------
+# sequence_pool / first / last  (reference: sequence_ops/sequence_pool_op.cc)
+# --------------------------------------------------------------------------
+@register_op("sequence_pool", needs_lod=True, diff_inputs=["X"],
+             attr_defaults={"pooltype": "AVERAGE", "pad_value": 0.0})
+def _sequence_pool(ins, attrs):
+    x = first(ins, "X")
+    levels = _require_lod(attrs, "X", "sequence_pool")
+    offs = _offs(levels)
+    n = len(offs) - 1
+    lens = _lens(offs)
+    segs = jnp.asarray(_seg_ids(offs))
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    lens_j = jnp.asarray(np.maximum(lens, 1)).reshape(
+        (-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+    max_index = None
+    if ptype == "SUM":
+        o = jax.ops.segment_sum(x, segs, num_segments=n)
+    elif ptype == "AVERAGE":
+        o = jax.ops.segment_sum(x, segs, num_segments=n) / lens_j
+    elif ptype == "SQRT":
+        o = jax.ops.segment_sum(x, segs, num_segments=n) / jnp.sqrt(lens_j)
+    elif ptype == "MAX":
+        o = jax.ops.segment_max(x, segs, num_segments=n)
+        # MaxIndex: flat row index of the per-feature max (parity with the
+        # reference's MAX_INDEX output used by its grad kernel)
+        eq = (x == o[segs])
+        idx_src = jnp.arange(x.shape[0]).reshape((-1,) + (1,) * (x.ndim - 1))
+        big = jnp.where(eq, idx_src, x.shape[0])
+        max_index = jax.ops.segment_min(
+            jnp.broadcast_to(big, x.shape), segs, num_segments=n
+        ).astype(jnp.int32)
+    elif ptype in ("FIRST", "LAST"):
+        idx = offs[:-1] if ptype == "FIRST" else offs[1:] - 1
+        o = jnp.take(x, jnp.asarray(np.where(lens > 0, idx, 0)), axis=0)
+    else:
+        raise ValueError(f"sequence_pool: unknown pooltype {ptype}")
+    if np.any(lens == 0):
+        empty = jnp.asarray(lens == 0).reshape(
+            (-1,) + (1,) * (x.ndim - 1))
+        o = jnp.where(empty, jnp.asarray(attrs.get("pad_value", 0.0), x.dtype), o)
+    res = out(Out=o)
+    if max_index is not None:
+        res["MaxIndex"] = [max_index]
+    # pooled output is one row per sequence: lod of upper levels only
+    res["_lod"] = {"Out": [tuple(levels[:-1]) or None]}
+    return res
+
+
+# --------------------------------------------------------------------------
+# sequence_softmax (reference: sequence_ops/sequence_softmax_op.cc)
+# --------------------------------------------------------------------------
+@register_op("sequence_softmax", needs_lod=True, diff_inputs=["X"])
+def _sequence_softmax(ins, attrs):
+    x = first(ins, "X")
+    levels = _require_lod(attrs, "X", "sequence_softmax")
+    offs = _offs(levels)
+    n = len(offs) - 1
+    segs = jnp.asarray(_seg_ids(offs))
+    flat = x.reshape(x.shape[0])
+    m = jax.ops.segment_max(flat, segs, num_segments=n)
+    e = jnp.exp(flat - m[segs])
+    s = jax.ops.segment_sum(e, segs, num_segments=n)
+    y = (e / s[segs]).reshape(x.shape)
+    return {"Out": [y], "_lod": {"Out": [levels]}}
+
+
+# --------------------------------------------------------------------------
+# sequence_expand / sequence_expand_as
+# (reference: sequence_ops/sequence_expand_op.cc, sequence_expand_as_op.cc)
+# --------------------------------------------------------------------------
+@register_op("sequence_expand", needs_lod=True, diff_inputs=["X"],
+             attr_defaults={"ref_level": -1})
+def _sequence_expand(ins, attrs):
+    x = first(ins, "X")
+    y_levels = _require_lod(attrs, "Y", "sequence_expand")
+    ref_level = attrs.get("ref_level", -1)
+    if ref_level < 0:
+        ref_level += len(y_levels)
+    y_offs = np.asarray(y_levels[ref_level], np.int64)
+    rep = _lens(y_offs)  # times to repeat x's i-th sequence
+    x_levels = _lod_of(attrs, "X")
+    if x_levels is None:
+        x_offs = np.arange(x.shape[0] + 1, dtype=np.int64)  # each row a seq
+    else:
+        x_offs = _offs(x_levels)
+    nseq = len(x_offs) - 1
+    if len(rep) != nseq:
+        raise ValueError(
+            f"sequence_expand: X has {nseq} sequences but Y ref_level has "
+            f"{len(rep)}")
+    idx_parts, new_lens = [], []
+    for i in range(nseq):
+        rows = np.arange(x_offs[i], x_offs[i + 1])
+        for _ in range(int(rep[i])):
+            idx_parts.append(rows)
+            new_lens.append(len(rows))
+    idx = np.concatenate(idx_parts) if idx_parts else np.zeros(0, np.int64)
+    o = jnp.take(x, jnp.asarray(idx), axis=0)
+    new_lod = (_offsets_from_lens(np.asarray(new_lens, np.int64)),)
+    return {"Out": [o], "_lod": {"Out": [new_lod]}}
+
+
+@register_op("sequence_expand_as", needs_lod=True, diff_inputs=["X"])
+def _sequence_expand_as(ins, attrs):
+    x = first(ins, "X")
+    y_levels = _require_lod(attrs, "Y", "sequence_expand_as")
+    rep = _lens(_offs(y_levels))
+    if len(rep) != x.shape[0]:
+        raise ValueError("sequence_expand_as: Y must have one sequence per "
+                         "row of X")
+    idx = np.repeat(np.arange(x.shape[0]), rep)
+    o = jnp.take(x, jnp.asarray(idx), axis=0)
+    return {"Out": [o], "_lod": {"Out": [(tuple(int(v) for v in _offs(y_levels)),)]}}
+
+
+# --------------------------------------------------------------------------
+# sequence_concat (reference: sequence_ops/sequence_concat_op.cc)
+# --------------------------------------------------------------------------
+@register_op("sequence_concat", needs_lod=True, diff_inputs=["X"])
+def _sequence_concat(ins, attrs):
+    xs = seq(ins, "X")
+    lods = (attrs.get("_lod") or {}).get("X") or [None] * len(xs)
+    all_offs = []
+    for i, (x, lv) in enumerate(zip(xs, lods)):
+        if lv is None:
+            raise ValueError(f"sequence_concat: input {i} must carry LoD")
+        all_offs.append(_offs(lv))
+    nseq = len(all_offs[0]) - 1
+    base = 0
+    idx_parts, new_lens = [], []
+    starts = np.concatenate(
+        [[0], np.cumsum([x.shape[0] for x in xs])])[:-1]
+    for s in range(nseq):
+        total = 0
+        for k, offs in enumerate(all_offs):
+            rows = np.arange(offs[s], offs[s + 1]) + starts[k]
+            idx_parts.append(rows)
+            total += len(rows)
+        new_lens.append(total)
+    big = jnp.concatenate([jnp.asarray(x) for x in xs], axis=0)
+    idx = np.concatenate(idx_parts)
+    o = jnp.take(big, jnp.asarray(idx), axis=0)
+    return {"Out": [o],
+            "_lod": {"Out": [(_offsets_from_lens(np.asarray(new_lens)),)]}}
+
+
+# --------------------------------------------------------------------------
+# sequence_conv (reference: sequence_ops/sequence_conv_op.cc — context
+# window projection; im2col across sequence boundaries is masked to zero)
+# --------------------------------------------------------------------------
+@register_op("sequence_conv", needs_lod=True, diff_inputs=["X", "Filter"],
+             attr_defaults={"contextLength": 3, "contextStart": -1,
+                            "contextStride": 1})
+def _sequence_conv(ins, attrs):
+    x = first(ins, "X")
+    filt = first(ins, "Filter")  # [contextLength * D, out_D]
+    levels = _require_lod(attrs, "X", "sequence_conv")
+    offs = _offs(levels)
+    clen = int(attrs.get("contextLength", 3))
+    cstart = int(attrs.get("contextStart", -1))
+    T, D = x.shape[0], x.shape[1]
+    segs = _seg_ids(offs)
+    seg_start = offs[:-1][segs] if T else np.zeros(0, np.int64)
+    seg_end = offs[1:][segs] if T else np.zeros(0, np.int64)
+    t = np.arange(T)
+    cols = []
+    masks = []
+    for k in range(clen):
+        src = t + cstart + k
+        valid = (src >= seg_start) & (src < seg_end)
+        cols.append(np.where(valid, src, 0))
+        masks.append(valid)
+    idx = np.stack(cols, 1)           # [T, clen]
+    mask = np.stack(masks, 1)         # [T, clen]
+    patches = jnp.take(x, jnp.asarray(idx), axis=0)  # [T, clen, D]
+    patches = patches * jnp.asarray(mask[..., None], x.dtype)
+    o = patches.reshape(T, clen * D) @ filt
+    return {"Out": [o], "_lod": {"Out": [levels]}}
+
+
+# --------------------------------------------------------------------------
+# sequence_pad / sequence_unpad
+# (reference: sequence_ops/sequence_pad_op.cc, sequence_unpad_op.cc)
+# --------------------------------------------------------------------------
+@register_op("sequence_pad", needs_lod=True, diff_inputs=["X"],
+             attr_defaults={"padded_length": -1})
+def _sequence_pad(ins, attrs):
+    x = first(ins, "X")
+    pad_value = first(ins, "PadValue")
+    levels = _require_lod(attrs, "X", "sequence_pad")
+    offs = _offs(levels)
+    lens = _lens(offs)
+    n = len(lens)
+    plen = int(attrs.get("padded_length", -1))
+    maxlen = int(lens.max()) if n else 0
+    if plen < 0:
+        plen = maxlen
+    if plen < maxlen:
+        raise ValueError("sequence_pad: padded_length < longest sequence")
+    pos = np.arange(plen)[None, :] + offs[:-1, None]     # [n, plen]
+    valid = np.arange(plen)[None, :] < lens[:, None]
+    idx = np.where(valid, pos, 0)
+    o = jnp.take(x, jnp.asarray(idx), axis=0)            # [n, plen, ...]
+    pv = jnp.asarray(pad_value, x.dtype)
+    o = jnp.where(jnp.asarray(valid).reshape(valid.shape + (1,) * (x.ndim - 1)),
+                  o, pv)
+    # Length also carries X's LoD as metadata so a downstream sequence_unpad
+    # can recover host-static lengths under jit (its Length *array* is a
+    # tracer there)
+    return {"Out": [o], "Length": [jnp.asarray(lens, jnp.int64)],
+            "_lod": {"Out": [None], "Length": [levels]}}
+
+
+def _unpad_lens(ins, attrs):
+    """Sequence lengths for unpad: prefer the LoD metadata sequence_pad
+    attached to Length (host-static under jit); fall back to the concrete
+    Length array in eager mode."""
+    lv = _lod_of(attrs, "Length")
+    if lv is not None:
+        return _lens(_offs(lv))
+    return np.asarray(first(ins, "Length"), np.int64)
+
+
+def _unpad_indices(lens):
+    rows = [np.stack([np.full(int(L), i), np.arange(int(L))], 1)
+            for i, L in enumerate(lens)]
+    return np.concatenate(rows) if rows else np.zeros((0, 2), np.int64)
+
+
+@register_op("sequence_unpad", needs_lod=True, diff_inputs=["X"])
+def _sequence_unpad(ins, attrs):
+    x = first(ins, "X")          # [n, plen, ...]
+    lens = _unpad_lens(ins, attrs)
+    rc = _unpad_indices(lens)
+    o = x[jnp.asarray(rc[:, 0]), jnp.asarray(rc[:, 1])]
+    return {"Out": [o], "_lod": {"Out": [(_offsets_from_lens(lens),)]}}
+
+
+@register_grad_maker("sequence_unpad")
+def _sequence_unpad_grad_maker(op, grad_map):
+    return [{
+        "type": "sequence_unpad_grad",
+        "inputs": {"X": op.input("X"), "Length": op.input("Length"),
+                   "Out@GRAD": [grad_map[op.output("Out")[0]]]},
+        "outputs": {"X@GRAD": [grad_map[op.input("X")[0]]]},
+        "attrs": {},
+    }]
+
+
+@register_op("sequence_unpad_grad", no_grad=True, needs_lod=True)
+def _sequence_unpad_grad(ins, attrs):
+    x = first(ins, "X")
+    g = first(ins, "Out@GRAD")
+    rc = _unpad_indices(_unpad_lens(ins, attrs))
+    gx = jnp.zeros_like(x).at[jnp.asarray(rc[:, 0]),
+                              jnp.asarray(rc[:, 1])].set(g)
+    return {"X@GRAD": [gx]}
+
+
+# --------------------------------------------------------------------------
+# sequence_reshape / sequence_reverse / sequence_slice / sequence_scatter
+# --------------------------------------------------------------------------
+@register_op("sequence_reshape", needs_lod=True, diff_inputs=["X"],
+             attr_defaults={"new_dim": 1})
+def _sequence_reshape(ins, attrs):
+    x = first(ins, "X")
+    levels = _require_lod(attrs, "X", "sequence_reshape")
+    offs = _offs(levels)
+    new_dim = int(attrs["new_dim"])
+    D = x.shape[1]
+    new_offs = offs * D // new_dim
+    if np.any((offs * D) % new_dim):
+        raise ValueError("sequence_reshape: sequence byte length not "
+                         "divisible by new_dim")
+    o = x.reshape(-1, new_dim)
+    return {"Out": [o],
+            "_lod": {"Out": [(tuple(int(v) for v in new_offs),)]}}
+
+
+@register_op("sequence_reverse", needs_lod=True, diff_inputs=["X"])
+def _sequence_reverse(ins, attrs):
+    x = first(ins, "X")
+    levels = _require_lod(attrs, "X", "sequence_reverse")
+    offs = _offs(levels)
+    idx = np.concatenate(
+        [np.arange(offs[i + 1] - 1, offs[i] - 1, -1)
+         for i in range(len(offs) - 1)]
+    ) if len(offs) > 1 else np.zeros(0, np.int64)
+    o = jnp.take(x, jnp.asarray(idx), axis=0)
+    return {"Y": [o], "_lod": {"Y": [levels]}}
+
+
+def _slice_indices(ins, attrs, op_name):
+    """Row indices selected per sequence by the Offset/Length inputs.
+    Offset/Length are data — these ops are ``stateful`` (eager-only, like
+    the reference's host-side LoD handling) because output extent is
+    data-dependent."""
+    offset = np.asarray(first(ins, "Offset"), np.int64).reshape(-1)
+    length = np.asarray(first(ins, "Length"), np.int64).reshape(-1)
+    offs = _offs(_require_lod(attrs, "X", op_name))
+    idx_parts, new_lens = [], []
+    for i in range(len(offs) - 1):
+        s = offs[i] + offset[i]
+        idx_parts.append(np.arange(s, s + length[i]))
+        new_lens.append(int(length[i]))
+    idx = np.concatenate(idx_parts) if idx_parts else np.zeros(0, np.int64)
+    return idx, np.asarray(new_lens)
+
+
+@register_op("sequence_slice", needs_lod=True, stateful=True,
+             diff_inputs=["X"])
+def _sequence_slice(ins, attrs):
+    x = first(ins, "X")
+    idx, new_lens = _slice_indices(ins, attrs, "sequence_slice")
+    o = jnp.take(x, jnp.asarray(idx), axis=0)
+    return {"Out": [o],
+            "_lod": {"Out": [(_offsets_from_lens(new_lens),)]}}
+
+
+@register_grad_maker("sequence_slice")
+def _sequence_slice_grad_maker(op, grad_map):
+    return [{
+        "type": "sequence_slice_grad",
+        "inputs": {"X": op.input("X"), "Offset": op.input("Offset"),
+                   "Length": op.input("Length"),
+                   "Out@GRAD": [grad_map[op.output("Out")[0]]]},
+        "outputs": {"X@GRAD": [grad_map[op.input("X")[0]]]},
+        "attrs": {},
+    }]
+
+
+@register_op("sequence_slice_grad", no_grad=True, needs_lod=True,
+             stateful=True)
+def _sequence_slice_grad(ins, attrs):
+    x = first(ins, "X")
+    g = first(ins, "Out@GRAD")
+    idx, _ = _slice_indices(ins, attrs, "sequence_slice_grad")
+    gx = jnp.zeros_like(x).at[jnp.asarray(idx)].set(g)
+    return {"X@GRAD": [gx]}
+
+
+@register_op("sequence_scatter", needs_lod=True,
+             diff_inputs=["X", "Updates"])
+def _sequence_scatter(ins, attrs):
+    x = first(ins, "X")          # [n, d]
+    ids = first(ins, "Ids")      # packed [total, 1] int
+    upd = first(ins, "Updates")  # packed [total, 1]
+    levels = _require_lod(attrs, "Ids", "sequence_scatter")
+    offs = _offs(levels)
+    rows = jnp.asarray(_seg_ids(offs))
+    cols = ids.reshape(-1)
+    o = x.at[rows, cols].add(upd.reshape(-1))
+    return out(Out=o)
+
+
+# --------------------------------------------------------------------------
+# sequence_enumerate / sequence_erase / sequence_mask already exists
+# --------------------------------------------------------------------------
+@register_op("sequence_enumerate", needs_lod=True, no_grad=True,
+             attr_defaults={"win_size": 1, "pad_value": 0})
+def _sequence_enumerate(ins, attrs):
+    x = first(ins, "X")
+    levels = _require_lod(attrs, "X", "sequence_enumerate")
+    offs = _offs(levels)
+    win = int(attrs.get("win_size", 1))
+    pad = attrs.get("pad_value", 0)
+    T = x.shape[0]
+    segs = _seg_ids(offs)
+    seg_end = offs[1:][segs] if T else np.zeros(0, np.int64)
+    t = np.arange(T)
+    idx, mask = [], []
+    for k in range(win):
+        src = t + k
+        valid = src < seg_end
+        idx.append(np.where(valid, src, 0))
+        mask.append(valid)
+    idx = np.stack(idx, 1)
+    mask = np.stack(mask, 1)
+    vals = jnp.take(x.reshape(-1), jnp.asarray(idx), axis=0)
+    o = jnp.where(jnp.asarray(mask), vals,
+                  jnp.asarray(pad, x.dtype))
+    return {"Out": [o], "_lod": {"Out": [levels]}}
+
+
+@register_op("sequence_erase", needs_lod=True, no_grad=True, stateful=True,
+             attr_defaults={"tokens": []})
+def _sequence_erase(ins, attrs):
+    x = np.asarray(first(ins, "X"))  # host op: output size is data-dependent
+    levels = _require_lod(attrs, "X", "sequence_erase")
+    offs = _offs(levels)
+    tokens = set(attrs.get("tokens", []))
+    keep = ~np.isin(x.reshape(-1), list(tokens))
+    new_lens = [int(keep[offs[i]:offs[i + 1]].sum())
+                for i in range(len(offs) - 1)]
+    o = jnp.asarray(x.reshape(-1)[keep].reshape(-1, *x.shape[1:]))
+    return {"Out": [o],
+            "_lod": {"Out": [(_offsets_from_lens(np.asarray(new_lens)),)]}}
+
+
+# --------------------------------------------------------------------------
+# lod_reset / lod_append (reference: lod_reset_op.cc, lod_append_op.cc)
+# --------------------------------------------------------------------------
+@register_op("lod_reset", needs_lod=True, diff_inputs=["X"],
+             attr_defaults={"target_lod": []})
+def _lod_reset(ins, attrs):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    if y is not None:
+        y_levels = _lod_of(attrs, "Y")
+        if y_levels is not None:
+            new = y_levels
+        else:  # Y holds offsets as data
+            new = (tuple(int(v) for v in np.asarray(y).reshape(-1)),)
+    else:
+        tl = attrs.get("target_lod") or []
+        new = (tuple(int(v) for v in tl),)
+    return {"Out": [x], "_lod": {"Out": [new]}}
+
+
+@register_op("lod_append", needs_lod=True, diff_inputs=["X"],
+             attr_defaults={"level": []})
+def _lod_append(ins, attrs):
+    x = first(ins, "X")
+    cur = _lod_of(attrs, "X") or ()
+    lvl = tuple(int(v) for v in attrs.get("level", []))
+    return {"Out": [x], "_lod": {"Out": [tuple(cur) + (lvl,)]}}
+
+
+# --------------------------------------------------------------------------
+# im2sequence (reference: im2sequence_op.cc — image patches to sequence)
+# --------------------------------------------------------------------------
+@register_op("im2sequence", needs_lod=True, diff_inputs=["X"],
+             attr_defaults={"kernels": [1, 1], "strides": [1, 1],
+                            "paddings": [0, 0, 0, 0]})
+def _im2sequence(ins, attrs):
+    x = first(ins, "X")  # NCHW
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs.get("strides", [1, 1])
+    pu, pl, pd, pr = attrs.get("paddings", [0, 0, 0, 0])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(pu, pd), (pl, pr)])
+    N, CKK, OH, OW = patches.shape
+    o = patches.transpose(0, 2, 3, 1).reshape(N * OH * OW, CKK)
+    lod = (_offsets_from_lens(np.full(N, OH * OW)),)
+    return {"Out": [o], "_lod": {"Out": [lod]}}

@@ -1,0 +1,248 @@
+"""LoD sequence op tests — numeric parity with the reference semantics
+(reference: python/paddle/fluid/tests/unittests/test_sequence_*.py,
+test_lod_reset_op.py). LoD rides as host-static metadata; these tests
+exercise both the eager oracle and (for the train-path ops) gradients."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+
+
+def run_seq_op(op_type, x, lod, extra_inputs=None, attrs=None,
+               outputs=("Out",), extra_lods=None):
+    """Run a single sequence op eagerly via the executor, returning
+    (out_arrays, out_lods)."""
+    prog = fluid.Program()
+    block = prog.global_block()
+    scope = core.Scope()
+    names_in = {"X": ["x"]}
+    t = core.LoDTensor(np.asarray(x))
+    if lod:
+        t.set_recursive_sequence_lengths(lod)
+    scope.var("x").set_value(t)
+    for i, (slot, arr, elod) in enumerate(extra_inputs or []):
+        nm = f"in{i}"
+        et = core.LoDTensor(np.asarray(arr))
+        if elod:
+            et.set_recursive_sequence_lengths(elod)
+        scope.var(nm).set_value(et)
+        names_in.setdefault(slot, []).append(nm)
+    out_names = {o: [f"out_{o}"] for o in outputs}
+    from paddle_tpu.fluid.framework import Operator
+    op = Operator(block, type=op_type, inputs=names_in,
+                  outputs=out_names, attrs=dict(attrs or {}))
+    exe = fluid.Executor()
+    import jax
+    exe._run_op_eager(op, scope, jax.random.key(0))
+    outs, lods = [], []
+    for o in outputs:
+        var = scope.find_var(f"out_{o}")
+        if var is None or not var.is_initialized():
+            outs.append(None)
+            lods.append(None)
+            continue
+        v = var.value()
+        outs.append(np.asarray(v.array))
+        lods.append(v.lod())
+    return outs, lods
+
+
+class TestSequencePool:
+    lod = [[2, 3, 1]]
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+
+    def test_sum(self):
+        (o, _), _ = run_seq_op("sequence_pool", self.x, self.lod,
+                               attrs={"pooltype": "SUM"},
+                               outputs=("Out", "MaxIndex"))[0], None
+        np.testing.assert_allclose(o[0], self.x[0:2].sum(0))
+        np.testing.assert_allclose(o[1], self.x[2:5].sum(0))
+        np.testing.assert_allclose(o[2], self.x[5:6].sum(0))
+
+    def test_mean_sqrt_max_first_last(self):
+        for ptype, ref in [
+            ("AVERAGE", [self.x[0:2].mean(0), self.x[2:5].mean(0), self.x[5]]),
+            ("SQRT", [self.x[0:2].sum(0) / np.sqrt(2),
+                      self.x[2:5].sum(0) / np.sqrt(3), self.x[5]]),
+            ("MAX", [self.x[0:2].max(0), self.x[2:5].max(0), self.x[5]]),
+            ("FIRST", [self.x[0], self.x[2], self.x[5]]),
+            ("LAST", [self.x[1], self.x[4], self.x[5]]),
+        ]:
+            (o, *_), _ = run_seq_op("sequence_pool", self.x, self.lod,
+                                    attrs={"pooltype": ptype},
+                                    outputs=("Out", "MaxIndex"))
+            np.testing.assert_allclose(o, np.stack(ref), rtol=1e-6,
+                                       err_msg=ptype)
+
+
+def test_sequence_softmax():
+    x = np.random.RandomState(0).rand(7, 1).astype(np.float32)
+    (o,), (olod,) = run_seq_op("sequence_softmax", x, [[3, 4]])
+    ref = np.concatenate([
+        np.exp(x[:3]) / np.exp(x[:3]).sum(),
+        np.exp(x[3:]) / np.exp(x[3:]).sum()])
+    np.testing.assert_allclose(o, ref, rtol=1e-5)
+    assert olod == [[0, 3, 7]]
+
+
+def test_sequence_expand():
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+    y = np.zeros((5, 1), np.float32)
+    (o,), (olod,) = run_seq_op(
+        "sequence_expand", x, [[2, 2]],
+        extra_inputs=[("Y", y, [[2, 3]])], attrs={"ref_level": 0})
+    # seq0 (rows 0:2) repeated 2x, seq1 (rows 2:4) repeated 3x
+    ref = np.concatenate([x[0:2], x[0:2], x[2:4], x[2:4], x[2:4]])
+    np.testing.assert_allclose(o, ref)
+
+
+def test_sequence_expand_as():
+    x = np.arange(6, dtype=np.float32).reshape(3, 2)
+    y = np.zeros((6, 1), np.float32)
+    (o,), (olod,) = run_seq_op("sequence_expand_as", x, None,
+                               extra_inputs=[("Y", y, [[1, 2, 3]])])
+    ref = np.concatenate([x[0:1], x[1:2], x[1:2], x[2:3], x[2:3], x[2:3]])
+    np.testing.assert_allclose(o, ref)
+    assert olod == [[0, 1, 3, 6]]
+
+
+def test_sequence_concat():
+    a = np.arange(6, dtype=np.float32).reshape(3, 2)
+    b = 10 + np.arange(8, dtype=np.float32).reshape(4, 2)
+    prog = fluid.Program()
+    scope = core.Scope()
+    ta = core.LoDTensor(a)
+    ta.set_recursive_sequence_lengths([[1, 2]])
+    tb = core.LoDTensor(b)
+    tb.set_recursive_sequence_lengths([[3, 1]])
+    scope.var("a").set_value(ta)
+    scope.var("b").set_value(tb)
+    from paddle_tpu.fluid.framework import Operator
+    op = Operator(prog.global_block(), type="sequence_concat",
+                  inputs={"X": ["a", "b"]}, outputs={"Out": ["o"]}, attrs={})
+    import jax
+    fluid.Executor()._run_op_eager(op, scope, jax.random.key(0))
+    o = np.asarray(scope.find_var("o").value().array)
+    ref = np.concatenate([a[0:1], b[0:3], a[1:3], b[3:4]])
+    np.testing.assert_allclose(o, ref)
+    assert scope.find_var("o").value().lod() == [[0, 4, 7]]
+
+
+def test_sequence_pad_unpad_roundtrip():
+    x = np.random.RandomState(1).rand(5, 3).astype(np.float32)
+    pv = np.zeros((1,), np.float32)
+    (padded, length), _ = run_seq_op(
+        "sequence_pad", x, [[2, 3]],
+        extra_inputs=[("PadValue", pv, None)],
+        attrs={"padded_length": -1}, outputs=("Out", "Length"))
+    assert padded.shape == (2, 3, 3)
+    np.testing.assert_allclose(padded[0, :2], x[:2])
+    np.testing.assert_allclose(padded[0, 2], 0.0)
+    np.testing.assert_allclose(padded[1], x[2:5])
+    np.testing.assert_array_equal(length, [2, 3])
+    (unp,), (ulod,) = run_seq_op(
+        "sequence_unpad", padded, None,
+        extra_inputs=[("Length", length, None)])
+    np.testing.assert_allclose(unp, x)
+    assert ulod == [[0, 2, 5]]
+
+
+def test_sequence_reshape_reverse_slice():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    (o,), (olod,) = run_seq_op("sequence_reshape", x, [[2, 4]],
+                               attrs={"new_dim": 4})
+    assert o.shape == (3, 4)
+    assert olod == [[0, 1, 3]]
+
+    (r,), (rlod,) = run_seq_op("sequence_reverse", x, [[2, 4]],
+                               outputs=("Y",))
+    ref = np.concatenate([x[1::-1], x[5:1:-1]])
+    np.testing.assert_allclose(r, ref)
+
+    (s,), (slod,) = run_seq_op(
+        "sequence_slice", x, [[3, 3]],
+        extra_inputs=[("Offset", np.array([[1], [0]], np.int64), None),
+                      ("Length", np.array([[2], [1]], np.int64), None)])
+    ref = np.concatenate([x[1:3], x[3:4]])
+    np.testing.assert_allclose(s, ref)
+    assert slod == [[0, 2, 3]]
+
+
+def test_sequence_enumerate_erase():
+    x = np.array([[1], [2], [3], [4], [5]], np.int64)
+    (o,), _ = run_seq_op("sequence_enumerate", x, [[2, 3]],
+                         attrs={"win_size": 2, "pad_value": 0})
+    ref = np.array([[1, 2], [2, 0], [3, 4], [4, 5], [5, 0]])
+    np.testing.assert_array_equal(o, ref)
+
+    (e,), (elod,) = run_seq_op("sequence_erase", x, [[2, 3]],
+                               attrs={"tokens": [2, 5]})
+    np.testing.assert_array_equal(e.reshape(-1), [1, 3, 4])
+    assert elod == [[0, 1, 3]]
+
+
+def test_lod_reset():
+    x = np.arange(6, dtype=np.float32).reshape(6, 1)
+    (o,), (olod,) = run_seq_op("lod_reset", x, [[3, 3]],
+                               attrs={"target_lod": [0, 2, 6]})
+    assert olod == [[0, 2, 6]]
+
+
+def test_im2sequence():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    (o,), (olod,) = run_seq_op("im2sequence", x, None,
+                               attrs={"kernels": [2, 2], "strides": [2, 2],
+                                      "paddings": [0, 0, 0, 0]})
+    assert o.shape == (4, 4)
+    np.testing.assert_allclose(o[0], [0, 1, 4, 5])
+    assert olod == [[0, 4]]
+
+
+def test_sequence_conv_masks_boundaries():
+    x = np.random.RandomState(2).rand(5, 2).astype(np.float32)
+    filt = np.random.RandomState(3).rand(6, 3).astype(np.float32)
+    (o,), (olod,) = run_seq_op(
+        "sequence_conv", x, [[2, 3]],
+        extra_inputs=[("Filter", filt, None)],
+        attrs={"contextLength": 3, "contextStart": -1, "contextStride": 1})
+    # row 0 of seq0: context rows [-1,0,1] -> [0, x0, x1]
+    patch = np.concatenate([np.zeros(2, np.float32), x[0], x[1]])
+    np.testing.assert_allclose(o[0], patch @ filt, rtol=1e-5)
+    # row 4 (last of seq1): context [3,4,5] -> [x3, x4, 0]
+    patch = np.concatenate([x[3], x[4], np.zeros(2, np.float32)])
+    np.testing.assert_allclose(o[4], patch @ filt, rtol=1e-5)
+    assert olod == [[0, 2, 5]]
+
+
+def test_sequence_train_end_to_end_compiled():
+    """Text-CNN-ish: embedding → sequence_conv → sequence_pool(MAX) → fc →
+    loss; trains through the COMPILED path with LoD buckets keyed in the
+    jit cache."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        word = fluid.data("word", shape=[1], dtype="int64", lod_level=1)
+        label = fluid.data("label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(word, size=[20, 8])
+        conv = fluid.layers.sequence_conv(emb, num_filters=8, filter_size=3)
+        pooled = fluid.layers.sequence_pool(conv, "max")
+        pred = fluid.layers.fc(pooled, 4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for step in range(4):
+            lens = [3, 5] if step % 2 == 0 else [2, 6]  # two LoD buckets
+            total = sum(lens)
+            w = core.LoDTensor(rng.randint(0, 20, (total, 1)).astype("int64"))
+            w.set_recursive_sequence_lengths([lens])
+            y = rng.randint(0, 4, (2, 1)).astype("int64")
+            (lv,) = exe.run(main, feed={"word": w, "label": y},
+                            fetch_list=[loss])
+            losses.append(float(lv))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] + 1.0  # trains without blow-up
