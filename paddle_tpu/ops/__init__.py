@@ -14,3 +14,4 @@ from . import nn_extra_ops   # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import sequence_ops   # noqa: F401
+from . import rnn_ops        # noqa: F401

@@ -26,9 +26,17 @@ from .registry import register_op, register_grad_maker, first, seq, out
 # elementwise binary family
 # --------------------------------------------------------------------------
 def _align_y(x, y, axis):
-    """Paddle elementwise broadcast: reshape Y so it aligns to X at axis."""
+    """Paddle elementwise broadcast: reshape Y so it aligns to X at axis.
+    Shapes that already broadcast numpy-style (the axis=-1 rightmost
+    alignment) pass through unchanged."""
     if x.shape == y.shape:
         return y
+    if int(axis) == -1:
+        try:
+            np.broadcast_shapes(x.shape, y.shape)
+            return y
+        except ValueError:
+            pass
     axis = int(axis)
     yshape = list(y.shape)
     while yshape and yshape[-1] == 1:

@@ -1,0 +1,465 @@
+"""Recurrent ops — dynamic_lstm/dynamic_lstmp/dynamic_gru over LoD input,
+gru_unit/lstm_unit single steps, fused multi-layer lstm, gather_tree
+(reference: paddle/fluid/operators/lstm_op.cc, lstmp_op.cc, gru_op.cc,
+gru_unit_op.cc, lstm_unit_op.cc, cudnn_lstm_op.cc, gather_tree_op.cc).
+
+TPU design: the reference reorders LoD rows into time-major "batches"
+(math/sequence2batch.h) and steps a per-timestep GEMM; here the packed
+sequence is padded to ``[N, maxT, ·]`` with host-static LoD indices and the
+recurrence is one ``lax.scan`` whose per-step update is masked past each
+sequence's length — XLA keeps the whole scan on-device and the gate matmuls
+on the MXU. Grads fall out of vjp through the scan.
+
+Gate layout convention (documented contract of this framework): LSTM gates
+are ordered ``[i, f, c, o]`` along the last axis; GRU gates ``[u, r, c]``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, first, out, mark_no_grad
+
+
+# --------------------------------------------------------------------------
+# LoD pack <-> pad helpers (host-static indices)
+# --------------------------------------------------------------------------
+def _offs_of(attrs, slot):
+    lods = attrs.get("_lod") or {}
+    vals = lods.get(slot)
+    if not vals or vals[0] is None:
+        raise ValueError(f"rnn op: input '{slot}' must carry LoD")
+    return np.asarray(vals[0][-1], np.int64)
+
+
+def _pad_from_lod(x, offs):
+    """packed [T, D] -> padded [N, maxT, D] + bool mask [N, maxT]."""
+    lens = offs[1:] - offs[:-1]
+    n, maxT = len(lens), int(lens.max()) if len(lens) else 0
+    pos = np.arange(maxT)[None, :] + offs[:-1, None]
+    valid = np.arange(maxT)[None, :] < lens[:, None]
+    idx = np.where(valid, pos, 0)
+    padded = jnp.take(x, jnp.asarray(idx), axis=0)
+    padded = padded * jnp.asarray(valid[..., None], x.dtype)
+    return padded, valid, lens
+
+
+def _unpad_to_packed(padded, offs):
+    """padded [N, maxT, D] -> packed [T, D] in LoD row order."""
+    lens = offs[1:] - offs[:-1]
+    rows = [np.stack([np.full(int(L), i), np.arange(int(L))], 1)
+            for i, L in enumerate(lens)]
+    rc = np.concatenate(rows) if rows else np.zeros((0, 2), np.int64)
+    return padded[jnp.asarray(rc[:, 0]), jnp.asarray(rc[:, 1])]
+
+
+def _act(name):
+    return {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": (lambda v: v),
+            "": jnp.tanh}[name or "tanh"]
+
+
+# --------------------------------------------------------------------------
+# scan cores (padded time-major scan with per-step masking)
+# --------------------------------------------------------------------------
+def _lstm_scan(xw, h0, c0, w_rec, bias, mask, gate_act, cell_act, cand_act,
+               peephole=None):
+    """xw: [N, T, 4H] pre-projected input; returns padded H, C [N, T, H]."""
+    H = w_rec.shape[0]
+    ga, ca, na = _act(gate_act), _act(cell_act), _act(cand_act)
+
+    def step(carry, t_in):
+        h, c = carry
+        x_t, m_t = t_in           # [N, 4H], [N, 1]
+        g = x_t + h @ w_rec
+        if bias is not None:
+            g = g + bias.reshape(1, -1)[:, :4 * H]
+        i, f, cc, o = jnp.split(g, 4, axis=-1)
+        if peephole is not None:
+            w_ic, w_fc, w_oc = peephole
+            i = i + c * w_ic
+            f = f + c * w_fc
+        i, f = ga(i), ga(f)
+        cand = na(cc)
+        c_new = f * c + i * cand
+        if peephole is not None:
+            o = o + c_new * w_oc
+        o = ga(o)
+        h_new = o * ca(c_new)
+        h = jnp.where(m_t, h_new, h)
+        c = jnp.where(m_t, c_new, c)
+        return (h, c), (h, c)
+
+    xw_t = jnp.swapaxes(xw, 0, 1)               # [T, N, 4H]
+    m_t = jnp.swapaxes(mask, 0, 1)[..., None]   # [T, N, 1]
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), (xw_t, m_t))
+    return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+
+def _gru_scan(xw, h0, w, bias, mask, gate_act, cand_act, origin_mode):
+    """xw: [N, T, 3H]; w: [H, 3H] ([:, :2H] update/reset, [:, 2H:] cand)."""
+    H = w.shape[0]
+    ga, na = _act(gate_act), _act(cand_act)
+    w_ur, w_c = w[:, :2 * H], w[:, 2 * H:]
+
+    def step(h, t_in):
+        x_t, m_t = t_in
+        if bias is not None:
+            x_t = x_t + bias.reshape(1, -1)
+        xu, xr, xc = jnp.split(x_t, 3, axis=-1)
+        ur = jnp.concatenate([xu, xr], -1) + h @ w_ur
+        u, r = jnp.split(ga(ur), 2, axis=-1)
+        c = na(xc + (r * h) @ w_c)
+        if origin_mode:
+            h_new = u * h + (1.0 - u) * c
+        else:
+            h_new = (1.0 - u) * h + u * c
+        h = jnp.where(m_t, h_new, h)
+        return h, h
+
+    xw_t = jnp.swapaxes(xw, 0, 1)
+    m_t = jnp.swapaxes(mask, 0, 1)[..., None]
+    _, hs = jax.lax.scan(step, h0, (xw_t, m_t))
+    return jnp.swapaxes(hs, 0, 1)
+
+
+# --------------------------------------------------------------------------
+# dynamic_lstm / dynamic_lstmp (reference: lstm_op.cc, lstmp_op.cc)
+# --------------------------------------------------------------------------
+def _dyn_lstm_common(ins, attrs, proj_weight=None):
+    x = first(ins, "Input")            # packed [T, 4H], pre-projected
+    w = first(ins, "Weight")           # [H or P, 4H] recurrent
+    bias = first(ins, "Bias")
+    h0, c0 = first(ins, "H0"), first(ins, "C0")
+    offs = _offs_of(attrs, "Input")
+    H = w.shape[1] // 4
+    n = len(offs) - 1
+    use_peepholes = attrs.get("use_peepholes", False)
+    peep = None
+    if use_peepholes and bias is not None:
+        b = bias.reshape(-1)
+        peep = (b[4 * H:5 * H], b[5 * H:6 * H], b[6 * H:7 * H])
+    if attrs.get("is_reverse", False):
+        # reverse rows within each sequence, scan, reverse back
+        rev_idx = np.concatenate(
+            [np.arange(offs[i + 1] - 1, offs[i] - 1, -1)
+             for i in range(n)]) if n else np.zeros(0, np.int64)
+        x = jnp.take(x, jnp.asarray(rev_idx), axis=0)
+    padded, valid, _lens = _pad_from_lod(x, offs)
+    dtype = x.dtype
+    if h0 is None:
+        h0 = jnp.zeros((n, w.shape[0]), dtype)
+    if c0 is None:
+        c0 = jnp.zeros((n, H), dtype)
+    hs, cs = _lstm_scan(
+        padded, h0, c0, w, bias, jnp.asarray(valid),
+        attrs.get("gate_activation", "sigmoid"),
+        attrs.get("cell_activation", "tanh"),
+        attrs.get("candidate_activation", "tanh"), peephole=peep)
+    if proj_weight is not None:
+        hs = _act(attrs.get("proj_activation", "identity"))(hs @ proj_weight)
+    h_packed = _unpad_to_packed(hs, offs)
+    c_packed = _unpad_to_packed(cs, offs)
+    if attrs.get("is_reverse", False):
+        h_packed = jnp.take(h_packed, jnp.asarray(rev_idx), axis=0)
+        c_packed = jnp.take(c_packed, jnp.asarray(rev_idx), axis=0)
+    return h_packed, c_packed
+
+
+@register_op("dynamic_lstm", needs_lod=True,
+             diff_inputs=["Input", "Weight", "Bias", "H0", "C0"],
+             attr_defaults={"use_peepholes": True, "is_reverse": False,
+                            "gate_activation": "sigmoid",
+                            "cell_activation": "tanh",
+                            "candidate_activation": "tanh"})
+def _dynamic_lstm(ins, attrs):
+    h, c = _dyn_lstm_common(ins, attrs)
+    lod = (attrs.get("_lod") or {}).get("Input")[0]
+    return {"Hidden": [h], "Cell": [c],
+            "_lod": {"Hidden": [lod], "Cell": [lod]}}
+
+
+@register_op("dynamic_lstmp", needs_lod=True,
+             diff_inputs=["Input", "Weight", "ProjWeight", "Bias", "H0", "C0"],
+             attr_defaults={"use_peepholes": True, "is_reverse": False,
+                            "gate_activation": "sigmoid",
+                            "cell_activation": "tanh",
+                            "candidate_activation": "tanh",
+                            "proj_activation": "tanh"})
+def _dynamic_lstmp(ins, attrs):
+    h, c = _dyn_lstm_common(ins, attrs, proj_weight=first(ins, "ProjWeight"))
+    lod = (attrs.get("_lod") or {}).get("Input")[0]
+    return {"Projection": [h], "Cell": [c],
+            "_lod": {"Projection": [lod], "Cell": [lod]}}
+
+
+# --------------------------------------------------------------------------
+# dynamic_gru (reference: gru_op.cc)
+# --------------------------------------------------------------------------
+@register_op("dynamic_gru", needs_lod=True,
+             diff_inputs=["Input", "Weight", "Bias", "H0"],
+             attr_defaults={"is_reverse": False, "origin_mode": False,
+                            "gate_activation": "sigmoid",
+                            "activation": "tanh"})
+def _dynamic_gru(ins, attrs):
+    x = first(ins, "Input")            # packed [T, 3H]
+    w = first(ins, "Weight")           # [H, 3H]
+    bias = first(ins, "Bias")
+    h0 = first(ins, "H0")
+    offs = _offs_of(attrs, "Input")
+    n = len(offs) - 1
+    H = w.shape[0]
+    if attrs.get("is_reverse", False):
+        rev_idx = np.concatenate(
+            [np.arange(offs[i + 1] - 1, offs[i] - 1, -1)
+             for i in range(n)]) if n else np.zeros(0, np.int64)
+        x = jnp.take(x, jnp.asarray(rev_idx), axis=0)
+    padded, valid, _lens = _pad_from_lod(x, offs)
+    if h0 is None:
+        h0 = jnp.zeros((n, H), x.dtype)
+    hs = _gru_scan(padded, h0, w, bias, jnp.asarray(valid),
+                   attrs.get("gate_activation", "sigmoid"),
+                   attrs.get("activation", "tanh"),
+                   attrs.get("origin_mode", False))
+    h_packed = _unpad_to_packed(hs, offs)
+    if attrs.get("is_reverse", False):
+        h_packed = jnp.take(h_packed, jnp.asarray(rev_idx), axis=0)
+    lod = (attrs.get("_lod") or {}).get("Input")[0]
+    return {"Hidden": [h_packed], "_lod": {"Hidden": [lod]}}
+
+
+# --------------------------------------------------------------------------
+# single-step units (reference: gru_unit_op.cc, lstm_unit_op.cc)
+# --------------------------------------------------------------------------
+@register_op("gru_unit",
+             diff_inputs=["Input", "HiddenPrev", "Weight", "Bias"],
+             attr_defaults={"activation": "tanh",
+                            "gate_activation": "sigmoid",
+                            "origin_mode": False})
+def _gru_unit(ins, attrs):
+    x = first(ins, "Input")            # [N, 3H]
+    h_prev = first(ins, "HiddenPrev")  # [N, H]
+    w = first(ins, "Weight")           # [H, 3H]
+    bias = first(ins, "Bias")
+    H = w.shape[0]
+    ga, na = _act(attrs.get("gate_activation")), _act(attrs.get("activation"))
+    if bias is not None:
+        x = x + bias.reshape(1, -1)
+    xu, xr, xc = jnp.split(x, 3, axis=-1)
+    ur = jnp.concatenate([xu, xr], -1) + h_prev @ w[:, :2 * H]
+    u, r = jnp.split(ga(ur), 2, axis=-1)
+    reset_h = r * h_prev
+    c = na(xc + reset_h @ w[:, 2 * H:])
+    if attrs.get("origin_mode", False):
+        h = u * h_prev + (1.0 - u) * c
+    else:
+        h = (1.0 - u) * h_prev + u * c
+    gate = jnp.concatenate([u, r, c], -1)
+    return out(Gate=gate, ResetHiddenPrev=reset_h, Hidden=h)
+
+
+@register_op("lstm_unit", diff_inputs=["X", "C_prev"],
+             attr_defaults={"forget_bias": 0.0})
+def _lstm_unit(ins, attrs):
+    x = first(ins, "X")                # [N, 4H] pre-projected gates
+    c_prev = first(ins, "C_prev")
+    i, f, cc, o = jnp.split(x, 4, axis=-1)
+    f = f + attrs.get("forget_bias", 0.0)
+    c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(cc)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return out(C=c, H=h)
+
+
+# --------------------------------------------------------------------------
+# fused multi-layer lstm (reference: cudnn_lstm_op.cc / layers.lstm)
+# --------------------------------------------------------------------------
+@register_op("lstm", needs_rng=True,
+             diff_inputs=["Input", "W", "InitH", "InitC"],
+             attr_defaults={"max_len": 0, "hidden_size": 0, "num_layers": 1,
+                            "is_bidirec": False, "dropout_prob": 0.0,
+                            "input_size": 0, "is_test": False, "seed": 0})
+def _lstm(ins, attrs):
+    """Dense multi-layer (bi)LSTM over padded [B, T, D] input. The flat W
+    buffer packs per-layer/direction [Wx, Wh, b] the way the reference
+    packs cudnn weights (cudnn_lstm_op.cc) — layout documented in
+    layers.lstm which allocates it."""
+    x = first(ins, "Input")            # [B, T, D]
+    w_flat = first(ins, "W").reshape(-1)
+    init_h = first(ins, "InitH")       # [L*dirs, B, H]
+    init_c = first(ins, "InitC")
+    H = int(attrs["hidden_size"])
+    L = int(attrs.get("num_layers", 1))
+    bidi = bool(attrs.get("is_bidirec", False))
+    dirs = 2 if bidi else 1
+    B, T, _D = x.shape
+    mask = jnp.ones((B, T), bool)
+    ptr = 0
+    layer_in = x
+    last_hs, last_cs = [], []
+    for layer in range(L):
+        outs_dir = []
+        in_dim = layer_in.shape[-1]
+        for d in range(dirs):
+            wx = w_flat[ptr:ptr + in_dim * 4 * H].reshape(in_dim, 4 * H)
+            ptr += in_dim * 4 * H
+            wh = w_flat[ptr:ptr + H * 4 * H].reshape(H, 4 * H)
+            ptr += H * 4 * H
+            b = w_flat[ptr:ptr + 4 * H]
+            ptr += 4 * H
+            inp = layer_in[:, ::-1] if d == 1 else layer_in
+            xw = inp @ wx
+            h0 = init_h[layer * dirs + d]
+            c0 = init_c[layer * dirs + d]
+            hs, cs = _lstm_scan(xw, h0, c0, wh, b, mask,
+                                "sigmoid", "tanh", "tanh")
+            last_hs.append(hs[:, -1])
+            last_cs.append(cs[:, -1])
+            outs_dir.append(hs[:, ::-1] if d == 1 else hs)
+        layer_in = (jnp.concatenate(outs_dir, -1) if bidi else outs_dir[0])
+        p = attrs.get("dropout_prob", 0.0)
+        if p and not attrs.get("is_test", False) and layer < L - 1:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(attrs["_rng"], layer), 1.0 - p,
+                layer_in.shape)
+            layer_in = jnp.where(keep, layer_in / (1.0 - p),
+                                 jnp.zeros_like(layer_in))
+    return out(Out=layer_in, LastH=jnp.stack(last_hs),
+               LastC=jnp.stack(last_cs))
+
+
+# --------------------------------------------------------------------------
+# gather_tree (reference: gather_tree_op.cc — beam-search backtrace)
+# --------------------------------------------------------------------------
+@register_op("gather_tree", no_grad=True)
+def _gather_tree(ins, attrs):
+    ids = jnp.asarray(first(ins, "Ids"))    # [max_time, batch, beam]
+    parents = jnp.asarray(first(ins, "Parents"))
+    T = ids.shape[0]
+    beams = ids.shape[2]
+    beam_idx = jnp.arange(beams)[None, :]
+
+    def step(carry, t):
+        parent = carry                      # [batch, beam]
+        tok = jnp.take_along_axis(ids[t], parent, axis=1)
+        parent_new = jnp.take_along_axis(parents[t], parent, axis=1)
+        return parent_new, tok
+
+    init = jnp.broadcast_to(beam_idx, ids.shape[1:]).astype(ids.dtype)
+    _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return out(Out=toks[::-1])
+
+
+# --------------------------------------------------------------------------
+# beam_search / beam_search_decode (reference: beam_search_op.cc,
+# beam_search_decode_op.cc — the v1.7 LoD-based While-loop decode path).
+# Host ops (stateful): selection counts are data-dependent; the
+# tensor-based fast path on TPU is layers.BeamSearchDecoder + gather_tree.
+# --------------------------------------------------------------------------
+@register_op("beam_search", needs_lod=True, stateful=True, no_grad=True,
+             attr_defaults={"level": 0, "beam_size": 1, "end_id": 0,
+                            "is_accumulated": True})
+def _beam_search(ins, attrs):
+    import numpy as _np
+    pre_ids = _np.asarray(first(ins, "pre_ids")).reshape(-1)
+    pre_scores = _np.asarray(first(ins, "pre_scores")).reshape(-1)
+    ids_in = first(ins, "ids")
+    cand_ids = (_np.asarray(ids_in) if ids_in is not None else None)
+    cand_scores = _np.asarray(first(ins, "scores"))
+    beam_size = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+    lods = (attrs.get("_lod") or {}).get("pre_ids")
+    if lods and lods[0] and len(lods[0]) >= 1:
+        src_offs = _np.asarray(lods[0][0], _np.int64)
+    else:  # single source covering all branches
+        src_offs = _np.asarray([0, len(pre_ids)], _np.int64)
+    sel_ids, sel_scores = [], []
+    sel_counts_per_branch = _np.zeros(len(pre_ids), _np.int64)
+    src_counts = []
+    for s in range(len(src_offs) - 1):
+        lo, hi = int(src_offs[s]), int(src_offs[s + 1])
+        cands = []  # (score, token, parent_branch)
+        for b in range(lo, hi):
+            if pre_ids[b] == end_id and pre_ids[b] != -1:
+                # finished branch: carries itself forward unchanged
+                cands.append((float(pre_scores[b]), end_id, b))
+                continue
+            for k in range(cand_scores.shape[1]):
+                tok = (int(cand_ids[b, k]) if cand_ids is not None else k)
+                cands.append((float(cand_scores[b, k]), tok, b))
+        cands.sort(key=lambda c: -c[0])
+        top = cands[:beam_size]
+        top.sort(key=lambda c: (c[2], -c[0]))  # group rows by parent branch
+        for sc, tok, b in top:
+            sel_ids.append(tok)
+            sel_scores.append(sc)
+            sel_counts_per_branch[b] += 1
+        src_counts.append(len(top))
+    lod0 = _np.concatenate([[0], _np.cumsum(src_counts)])
+    lod1 = _np.concatenate([[0], _np.cumsum(sel_counts_per_branch)])
+    o_ids = jnp.asarray(_np.asarray(sel_ids, _np.int64).reshape(-1, 1))
+    o_sc = jnp.asarray(_np.asarray(sel_scores, _np.float32).reshape(-1, 1))
+    new_lod = (tuple(int(v) for v in lod0), tuple(int(v) for v in lod1))
+    return {"selected_ids": [o_ids], "selected_scores": [o_sc],
+            "parent_idx": [jnp.asarray(
+                _np.repeat(_np.arange(len(pre_ids)), sel_counts_per_branch))],
+            "_lod": {"selected_ids": [new_lod],
+                     "selected_scores": [new_lod]}}
+
+
+@register_op("beam_search_decode", needs_lod=True, stateful=True,
+             no_grad=True, attr_defaults={"beam_size": 1, "end_id": 0})
+def _beam_search_decode(ins, attrs):
+    """Backtracks a LoDTensorArray of per-step beam selections into full
+    hypotheses (reference: beam_search_decode_op.cc). Reads the arrays from
+    the scope via _ctx (LoDTensorArray is a host container)."""
+    import numpy as _np
+    ctx = attrs["_ctx"]
+    end_id = int(attrs.get("end_id", 0))
+    ids_arr = ctx.scope.find_var(ctx.op.input("Ids")[0]).value()
+    scores_arr = ctx.scope.find_var(ctx.op.input("Scores")[0]).value()
+    steps = []
+    for t in range(len(ids_arr)):
+        it, st = ids_arr[t], scores_arr[t]
+        steps.append((
+            _np.asarray(it.array).reshape(-1),
+            _np.asarray(st.array).reshape(-1),
+            [_np.asarray(l, _np.int64) for l in it.lod()]))
+    if not steps:
+        raise ValueError("beam_search_decode: empty Ids array")
+    n_src = len(steps[0][2][0]) - 1
+    hyps, hyp_scores = [[] for _ in range(n_src)], [[] for _ in range(n_src)]
+
+    def parent_of(lod1, row):
+        return int(_np.searchsorted(lod1, row, side="right") - 1)
+
+    T = len(steps)
+    last_ids, last_scores, last_lod = steps[-1]
+    for s in range(n_src):
+        lo, hi = int(steps[-1][2][0][s]), int(steps[-1][2][0][s + 1])
+        for row in range(lo, hi):
+            toks, r = [], row
+            for t in range(T - 1, -1, -1):
+                ids_t, sc_t, lod_t = steps[t]
+                toks.append(int(ids_t[r]))
+                if t > 0:
+                    r = parent_of(lod_t[1], r)
+            toks.reverse()
+            # trim everything after the first end_id
+            if end_id in toks:
+                toks = toks[:toks.index(end_id) + 1]
+            hyps[s].append(toks)
+            hyp_scores[s].append(float(last_scores[row]))
+    flat_ids, flat_sc, lens, src_counts = [], [], [], []
+    for s in range(n_src):
+        src_counts.append(len(hyps[s]))
+        for toks, sc in zip(hyps[s], hyp_scores[s]):
+            flat_ids.extend(toks)
+            flat_sc.extend([sc] * len(toks))
+            lens.append(len(toks))
+    lod0 = _np.concatenate([[0], _np.cumsum(src_counts)])
+    lod1 = _np.concatenate([[0], _np.cumsum(lens)])
+    new_lod = (tuple(int(v) for v in lod0), tuple(int(v) for v in lod1))
+    return {"SentenceIds": [jnp.asarray(_np.asarray(flat_ids, _np.int64))],
+            "SentenceScores": [jnp.asarray(_np.asarray(flat_sc, _np.float32))],
+            "_lod": {"SentenceIds": [new_lod], "SentenceScores": [new_lod]}}

