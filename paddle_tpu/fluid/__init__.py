@@ -39,6 +39,9 @@ from .data_feeder import DataFeeder
 from . import reader
 from .reader import DataLoader
 from . import contrib
+from . import transpiler
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from .communicator import Communicator
 
 Tensor = LoDTensor
 
@@ -64,4 +67,6 @@ __all__ = [
     "global_scope", "scope_guard", "append_backward", "gradients",
     "save_inference_model", "load_inference_model", "save", "load",
     "in_dygraph_mode", "cpu_places", "cuda_places", "tpu_places",
+    "transpiler", "DistributeTranspiler", "DistributeTranspilerConfig",
+    "Communicator",
 ]

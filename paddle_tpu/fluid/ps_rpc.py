@@ -1,0 +1,188 @@
+"""Parameter-server RPC plane — threaded TCP + length-prefixed pickle.
+
+TPU-native stand-in for the reference's gRPC/BRPC variable RPC stack
+(reference: paddle/fluid/operators/distributed/send_recv.proto.in —
+SendVariable/GetVariable/PrefetchVariable; grpc/grpc_client.h:95,
+request_handler_impl.cc). On TPU pods the DENSE data path is ICI
+collectives under pjit; this host-side DCN plane exists for the sparse
+parameter-server configs (beyond-HBM embedding tables live in host RAM on
+pserver processes, like the reference's Wide&Deep path). Python threads are
+fine here: the payloads are numpy blobs and the work is IO-bound.
+
+Wire format: 8-byte big-endian length + pickle of a dict
+{"method": ..., **kwargs}; response likewise {"ok": bool, ...}.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+_LEN = struct.Struct(">Q")
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class VarServer:
+    """Serves variables + barriers for one pserver process (reference:
+    listen_and_serv_op.cc:333 RunImpl's gRPC server)."""
+
+    def __init__(self, endpoint: str,
+                 handlers: Dict[str, Callable[..., Any]]):
+        host, port = endpoint.rsplit(":", 1)
+        self._handlers = handlers
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        method = msg.pop("method")
+                        if method == "stop":
+                            _send_msg(self.request, {"ok": True})
+                            outer._stop_evt.set()
+                            return
+                        fn = outer._handlers.get(method)
+                        if fn is None:
+                            _send_msg(self.request,
+                                      {"ok": False,
+                                       "error": f"no method {method}"})
+                            continue
+                        try:
+                            res = fn(**msg)
+                            _send_msg(self.request, {"ok": True, "result": res})
+                        except Exception as e:  # surfaced to the client
+                            _send_msg(self.request,
+                                      {"ok": False, "error": repr(e)})
+                except (ConnectionError, OSError):
+                    return
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _Server((host, int(port)), _Handler)
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def wait_stopped(self, timeout: Optional[float] = None) -> bool:
+        return self._stop_evt.wait(timeout)
+
+    def shutdown(self):
+        self._stop_evt.set()
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class VarClient:
+    """Per-endpoint client with one persistent connection (reference:
+    grpc_client.h AsyncSendVar/AsyncGetVar calling convention)."""
+
+    _pool: Dict[str, "VarClient"] = {}
+    _pool_lock = threading.Lock()
+
+    def __init__(self, endpoint: str, connect_timeout: float = 30.0):
+        self.endpoint = endpoint
+        host, port = endpoint.rsplit(":", 1)
+        deadline = time.time() + connect_timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                self._sock = socket.create_connection((host, int(port)),
+                                                      timeout=120.0)
+                break
+            except OSError as e:  # server may not be up yet — retry
+                last = e
+                time.sleep(0.1)
+        else:
+            raise ConnectionError(
+                f"cannot reach pserver {endpoint}: {last}")
+        self._lock = threading.Lock()
+
+    @classmethod
+    def of(cls, endpoint: str) -> "VarClient":
+        with cls._pool_lock:
+            c = cls._pool.get(endpoint)
+            if c is None:
+                c = cls._pool[endpoint] = VarClient(endpoint)
+            return c
+
+    @classmethod
+    def reset_pool(cls):
+        with cls._pool_lock:
+            for c in cls._pool.values():
+                try:
+                    c._sock.close()
+                except OSError:
+                    pass
+            cls._pool.clear()
+
+    def call(self, method: str, **kwargs):
+        with self._lock:
+            _send_msg(self._sock, {"method": method, **kwargs})
+            resp = _recv_msg(self._sock)
+        if not resp.get("ok"):
+            raise RuntimeError(
+                f"rpc {method} on {self.endpoint} failed: "
+                f"{resp.get('error')}")
+        return resp.get("result")
+
+    # convenience wrappers mirroring send_recv.proto service methods
+    def send_var(self, name: str, value: np.ndarray, trainer_id: int = 0,
+                 rows=None, height: int = 0):
+        return self.call("send_var", name=name, value=np.asarray(value),
+                         trainer_id=trainer_id,
+                         rows=None if rows is None else list(map(int, rows)),
+                         height=int(height))
+
+    def get_var(self, name: str, trainer_id: int = 0) -> np.ndarray:
+        return self.call("get_var", name=name, trainer_id=trainer_id)
+
+    def prefetch_rows(self, name: str, rows) -> np.ndarray:
+        return self.call("prefetch_rows", name=name,
+                         rows=list(map(int, rows)))
+
+    def barrier(self, kind: str, trainer_id: int = 0):
+        return self.call("barrier", kind=kind, trainer_id=trainer_id)
+
+    def stop(self):
+        try:
+            with self._lock:
+                _send_msg(self._sock, {"method": "stop"})
+                _recv_msg(self._sock)
+        except (ConnectionError, OSError):
+            pass

@@ -1,0 +1,17 @@
+"""Distributed transpilers (reference: python/paddle/fluid/transpiler/)."""
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig)
+from .ps_dispatcher import HashName, RoundRobin
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "HashName", "RoundRobin", "memory_optimize", "release_memory"]
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """No-op: XLA owns memory planning on TPU (reference transpiler/
+    memory_optimization_transpiler.py is likewise deprecated)."""
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """No-op: see memory_optimize."""

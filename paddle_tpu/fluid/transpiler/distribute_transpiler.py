@@ -1,0 +1,214 @@
+"""DistributeTranspiler — rewrites a training Program into trainer and
+pserver Programs (reference: python/paddle/fluid/transpiler/
+distribute_transpiler.py — transpile:540, get_trainer_program:1011,
+get_pserver_program:1146, get_startup_program:1448).
+
+Behavioral parity, TPU framing: the trainer program keeps forward+backward
+(compiled to XLA where pure) and ends in send/send_barrier/recv/
+fetch_barrier host ops; the pserver program is one listen_and_serv op whose
+optimize sub-blocks are the original optimizer ops, applied after summing
+each grad across trainers (sync) or on arrival (async). Parameters are
+placed whole, round-robin (reference's slice_var_up block-splitting is a
+bandwidth optimization for GPU clusters; the TPU dense path uses ICI
+collectives instead, so the PS plane only carries the sparse/host-table
+configs). ``is_distributed`` embeddings are rewritten to
+distributed_lookup_table pulls with sparse push-grads served row-wise.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..framework import (Program, default_main_program,
+                         default_startup_program)
+from ..backward import OP_ROLE_OPTIMIZE
+from .ps_dispatcher import RoundRobin
+
+
+class DistributeTranspilerConfig:
+    """reference: transpiler/distribute_transpiler.py:154."""
+    slice_var_up = False          # whole-param placement (see module doc)
+    split_method = None
+    min_block_size = 8192
+    enable_dc_asgd = False
+    mode = "pserver"
+    print_log = False
+    wait_port = True
+    runtime_split_send_recv = False
+    sync_mode = True
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+
+    # ------------------------------------------------------------------
+    def transpile(self, trainer_id: int, program: Optional[Program] = None,
+                  pservers: str = "127.0.0.1:6174", trainers: int = 1,
+                  sync_mode: bool = True,
+                  startup_program: Optional[Program] = None,
+                  current_endpoint: str = ""):
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode
+        self.origin_program = program or default_main_program()
+        self.origin_startup = startup_program or default_startup_program()
+        self.pserver_endpoints = [ep.strip() for ep in pservers.split(",")
+                                  if ep.strip()]
+
+        # 1. discover (param, grad, optimize op) triples
+        self.param_grad_ops = []     # (param_name, grad_name, op)
+        block = self.origin_program.global_block()
+        for op in block.ops:
+            if op.attrs.get("op_role") == OP_ROLE_OPTIMIZE and \
+                    op.attrs.get("op_role_var"):
+                p, g = op.attrs["op_role_var"][:2]
+                self.param_grad_ops.append((p, g, op))
+        if not self.param_grad_ops:
+            raise ValueError("transpile: no optimizer ops found — call "
+                             "optimizer.minimize(loss) first")
+
+        # 2. identify distributed sparse tables (is_distributed lookups)
+        self.sparse_tables = set()
+        for op in block.ops:
+            if op.type in ("lookup_table", "lookup_table_v2") and \
+                    op.attrs.get("is_distributed"):
+                self.sparse_tables.add(op.input("W")[0])
+
+        # 3. place params on pservers
+        dispatcher = RoundRobin(self.pserver_endpoints)
+        names = [p for p, _, _ in self.param_grad_ops]
+        eps = dispatcher.dispatch(names)
+        self.param_ep: Dict[str, str] = dict(zip(names, eps))
+        self.grad_of: Dict[str, str] = {p: g for p, g, _ in
+                                        self.param_grad_ops}
+
+        self._build_trainer_program()
+        return self
+
+    # ------------------------------------------------------------------
+    def _build_trainer_program(self):
+        prog = self.origin_program.clone()
+        block = prog.global_block()
+        # drop optimizer ops — updates happen on the pservers
+        keep = [op for op in block.ops
+                if not (op.attrs.get("op_role") == OP_ROLE_OPTIMIZE
+                        and op.attrs.get("op_role_var"))]
+        # rewrite distributed embeddings to remote pulls
+        for op in keep:
+            if op.type in ("lookup_table", "lookup_table_v2") and \
+                    op.input("W")[0] in self.sparse_tables:
+                w = op.input("W")[0]
+                op.type = "distributed_lookup_table"
+                op.inputs = {"Ids": op.input("Ids"), "W": [w]}
+                op.outputs = {"Outputs": op.output("Out")}
+                op.attrs.update({
+                    "table_names": [w],
+                    "epmap": [self.param_ep[w]],
+                    "trainer_id": self.trainer_id})
+        block.ops[:] = keep
+
+        # group dense sends/recvs per endpoint
+        by_ep_grads: Dict[str, List[str]] = {}
+        by_ep_params: Dict[str, List[str]] = {}
+        for p, g, _op in self.param_grad_ops:
+            if p in self.sparse_tables:
+                continue  # sparse grads ride distributed_lookup_table_grad
+            ep = self.param_ep[p]
+            by_ep_grads.setdefault(ep, []).append(g)
+            by_ep_params.setdefault(ep, []).append(p)
+        eps = sorted(by_ep_grads)
+        for ep in eps:
+            block.append_op(
+                type="send", inputs={"X": by_ep_grads[ep]}, outputs={},
+                attrs={"epmap": [ep] * len(by_ep_grads[ep]),
+                       "trainer_id": self.trainer_id})
+        if self.sync_mode:
+            block.append_op(type="send_barrier", inputs={}, outputs={},
+                            attrs={"endpoints": eps,
+                                   "trainer_id": self.trainer_id})
+        for ep in eps:
+            block.append_op(
+                type="recv", inputs={},
+                outputs={"Out": by_ep_params[ep]},
+                attrs={"epmap": [ep] * len(by_ep_params[ep]),
+                       "trainer_id": self.trainer_id})
+        if self.sync_mode:
+            block.append_op(type="fetch_barrier", inputs={}, outputs={},
+                            attrs={"endpoints": eps,
+                                   "trainer_id": self.trainer_id})
+        self.trainer_program = prog
+
+    # ------------------------------------------------------------------
+    def get_trainer_program(self, wait_port: bool = True) -> Program:
+        return self.trainer_program
+
+    def get_pserver_program(self, endpoint: str) -> Program:
+        prog = Program()
+        gblock = prog.global_block()
+        origin_block = self.origin_program.global_block()
+
+        mine = [(p, g, op) for p, g, op in self.param_grad_ops
+                if self.param_ep[p] == endpoint]
+        optimize_blocks = []
+        grad_to_block_id = []
+        needed_vars = set()
+        for i, (p, g, op) in enumerate(mine):
+            blk = prog._create_block(parent_idx=0)
+            blk.append_op(type=op.type,
+                          inputs={k: list(v) for k, v in op.inputs.items()},
+                          outputs={k: list(v) for k, v in op.outputs.items()},
+                          attrs={k: v for k, v in op.attrs.items()
+                                 if k != "op_role"})
+            prog._rollback()
+            optimize_blocks.append(blk)
+            if p not in self.sparse_tables:
+                grad_to_block_id.append(f"{g}:{i}")
+            needed_vars.update(op.input_arg_names)
+            needed_vars.update(op.output_arg_names)
+        for name in sorted(needed_vars):
+            src = origin_block.vars.get(name)
+            if src is not None:
+                gblock.create_var(name=name, shape=src.shape,
+                                  dtype=src.dtype, persistable=True)
+            else:
+                gblock.create_var(name=name, persistable=True)
+        gblock.append_op(
+            type="listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": endpoint, "sync_mode": self.sync_mode,
+                   "Fanin": self.trainer_num,
+                   "optimize_blocks": optimize_blocks,
+                   "grad_to_block_id": grad_to_block_id,
+                   "distributed_mode": 0 if self.sync_mode else 1})
+        prog._ps_endpoint = endpoint
+        prog._pserver_params = [p for p, _, _ in mine]
+        return prog
+
+    def get_startup_program(self, endpoint: str,
+                            pserver_program: Optional[Program] = None,
+                            startup_program: Optional[Program] = None
+                            ) -> Program:
+        """Init program for one pserver: the original init ops of every var
+        the pserver hosts (params, accumulators, lr)."""
+        src = startup_program or self.origin_startup
+        hosted = set()
+        if pserver_program is not None:
+            hosted.update(v for v in pserver_program.global_block().vars)
+        else:
+            hosted.update(p for p, ep in self.param_ep.items()
+                          if ep == endpoint)
+        prog = Program()
+        block = prog.global_block()
+        for op in src.global_block().ops:
+            outs = set(op.output_arg_names)
+            if outs & hosted:
+                for name in outs:
+                    sv = src.global_block().vars.get(name)
+                    if sv is not None and name not in block.vars:
+                        block.create_var(name=name, shape=sv.shape,
+                                         dtype=sv.dtype, persistable=True)
+                block.append_op(
+                    type=op.type,
+                    inputs={k: list(v) for k, v in op.inputs.items()},
+                    outputs={k: list(v) for k, v in op.outputs.items()},
+                    attrs=dict(op.attrs))
+        return prog

@@ -1,0 +1,40 @@
+"""Parameter-to-pserver placement (reference:
+python/paddle/fluid/transpiler/ps_dispatcher.py — RoundRobin:57,
+HashName:31). Whole-parameter placement: the reference optionally slices
+big params into blocks (slice_var_up); on the TPU build the dense path
+never goes through the PS plane, so whole-param round-robin keeps the
+sparse/host path simple."""
+from __future__ import annotations
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class HashName(PSDispatcher):
+    """hash(varname) % #pservers."""
+
+    def dispatch(self, varlist):
+        return [self._eps[abs(hash(v.name if hasattr(v, "name") else v))
+                          % len(self._eps)] for v in varlist]
+
+
+class RoundRobin(PSDispatcher):
+    def dispatch(self, varlist):
+        out = []
+        for _v in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out

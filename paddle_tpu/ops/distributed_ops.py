@@ -1,0 +1,285 @@
+"""Parameter-server ops — send / recv / barriers / listen_and_serv /
+distributed_lookup_table (reference: paddle/fluid/operators/distributed_ops/
+send_op.cc, recv_op.cc, send_barrier_op.cc, fetch_barrier_op.cc,
+listen_and_serv_op.cc:333,110,226, distributed_lookup_table_op.cc,
+checkpoint_notify_op.cc; RPC plane in ../fluid/ps_rpc.py).
+
+All stateful host ops: the PS plane lives on TPU-VM hosts over DCN; the
+dense data path on TPU uses ICI collectives instead (parallel/). Sync-mode
+server semantics follow RunSyncLoop (listen_and_serv_op.cc:110): collect
+each trainer's grads + a send barrier, SUM per grad name, run the optimize
+blocks, then serve gets until the next round. Async follows RunAsyncLoop
+(:226): apply a grad's optimize block on arrival.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+from .registry import register_op, register_grad_maker, first, seq, out
+from ..fluid import core
+
+
+def _client(ep):
+    from ..fluid.ps_rpc import VarClient
+    return VarClient.of(ep)
+
+
+def _np_of(scope, name):
+    v = scope.find_var(name)
+    if v is None or not v.is_initialized():
+        return None
+    val = v.value()
+    if isinstance(val, core.SelectedRows):
+        return val
+    return np.asarray(val.array)
+
+
+# --------------------------------------------------------------------------
+# trainer-side ops
+# --------------------------------------------------------------------------
+@register_op("send", stateful=True, no_grad=True,
+             attr_defaults={"epmap": [], "trainer_id": 0})
+def _send(ins, attrs):
+    ctx = attrs["_ctx"]
+    names = ctx.op.input("X")
+    epmap = attrs.get("epmap") or []
+    tid = int(attrs.get("trainer_id", 0))
+    for i, name in enumerate(names):
+        ep = epmap[i if i < len(epmap) else -1]
+        val = _np_of(ctx.scope, name)
+        if isinstance(val, core.SelectedRows):
+            _client(ep).send_var(name, np.asarray(val.get_tensor().array),
+                                 trainer_id=tid, rows=val.rows(),
+                                 height=val.height())
+        else:
+            _client(ep).send_var(name, val, trainer_id=tid)
+    return {}
+
+
+@register_op("recv", stateful=True, no_grad=True,
+             attr_defaults={"epmap": [], "trainer_id": 0})
+def _recv(ins, attrs):
+    ctx = attrs["_ctx"]
+    names = ctx.op.output("Out")
+    epmap = attrs.get("epmap") or []
+    tid = int(attrs.get("trainer_id", 0))
+    for i, name in enumerate(names):
+        ep = epmap[i if i < len(epmap) else -1]
+        arr = _client(ep).get_var(name, trainer_id=tid)
+        ctx.scope.var(name).set_value(core.LoDTensor(jnp.asarray(arr)))
+    return {}
+
+
+def _barrier_op(kind):
+    def _kernel(ins, attrs):
+        ctx = attrs["_ctx"]
+        tid = int(attrs.get("trainer_id", 0))
+        for ep in dict.fromkeys(attrs.get("endpoints") or []):
+            _client(ep).barrier(kind, trainer_id=tid)
+        return {}
+    return _kernel
+
+
+register_op("send_barrier", stateful=True, no_grad=True,
+            attr_defaults={"endpoints": [], "trainer_id": 0})(
+    _barrier_op("send"))
+register_op("fetch_barrier", stateful=True, no_grad=True,
+            attr_defaults={"endpoints": [], "trainer_id": 0})(
+    _barrier_op("fetch"))
+
+
+@register_op("checkpoint_notify", stateful=True, no_grad=True,
+             attr_defaults={"epmap": [], "dir": ""})
+def _checkpoint_notify(ins, attrs):
+    for ep in dict.fromkeys(attrs.get("epmap") or []):
+        _client(ep).call("checkpoint", dir=attrs.get("dir", ""))
+    return {}
+
+
+@register_op("distributed_lookup_table", stateful=True,
+             attr_defaults={"epmap": [], "table_names": [], "padding_idx": -1,
+                            "is_distributed": True, "trainer_id": 0})
+def _distributed_lookup_table(ins, attrs):
+    """Pulls embedding rows from the pserver-resident table (reference:
+    distributed_lookup_table_op.cc over parameter_prefetch.cc)."""
+    ctx = attrs["_ctx"]
+    id_names = ctx.op.input("Ids")
+    w_name = (attrs.get("table_names") or ctx.op.input("W"))[0]
+    ep = (attrs.get("epmap") or [None])[0]
+    outs = []
+    for nm in id_names:
+        ids = np.asarray(ctx.scope.find_var(nm).value().array).reshape(-1)
+        rows = _client(ep).prefetch_rows(w_name, ids)
+        outs.append(jnp.asarray(rows))
+    return {"Outputs": outs}
+
+
+@register_grad_maker("distributed_lookup_table")
+def _dist_lookup_grad_maker(op, grad_map):
+    return [{
+        "type": "distributed_lookup_table_grad",
+        "inputs": {"Ids": op.input("Ids"), "W": op.input("W"),
+                   "Outputs@GRAD": [grad_map[n]
+                                    for n in op.output("Outputs")]},
+        "outputs": {},
+        "attrs": {k: v for k, v in op.attrs.items()
+                  if not k.startswith("_")},
+    }]
+
+
+@register_op("distributed_lookup_table_grad", stateful=True, no_grad=True,
+             attr_defaults={"epmap": [], "table_names": [], "trainer_id": 0})
+def _distributed_lookup_table_grad(ins, attrs):
+    """Pushes SelectedRows gradients back to the table's pserver."""
+    ctx = attrs["_ctx"]
+    id_names = ctx.op.input("Ids")
+    w_name = (attrs.get("table_names") or ctx.op.input("W"))[0]
+    ep = (attrs.get("epmap") or [None])[0]
+    tid = int(attrs.get("trainer_id", 0))
+    g_names = ctx.op.input("Outputs@GRAD")
+    for nm, gn in zip(id_names, g_names):
+        ids = np.asarray(ctx.scope.find_var(nm).value().array).reshape(-1)
+        g = np.asarray(ctx.scope.find_var(gn).value().array)
+        g = g.reshape(len(ids), -1)
+        _client(ep).send_var(w_name + "@GRAD", g, trainer_id=tid,
+                             rows=ids, height=0)
+    return {}
+
+
+# --------------------------------------------------------------------------
+# split/merge helpers for sharded sparse ids (reference: split_ids_op.cc,
+# merge_ids_op.cc — used when a table spans several pservers)
+# --------------------------------------------------------------------------
+@register_op("split_ids", stateful=True, no_grad=True)
+def _split_ids(ins, attrs):
+    ctx = attrs["_ctx"]
+    ids = np.asarray(
+        ctx.scope.find_var(ctx.op.input("Ids")[0]).value().array).reshape(-1)
+    n = len(ctx.op.output("Out"))
+    return {"Out": [jnp.asarray(ids[ids % n == k]) for k in range(n)]}
+
+
+@register_op("merge_ids", stateful=True, no_grad=True)
+def _merge_ids(ins, attrs):
+    ctx = attrs["_ctx"]
+    ids = np.asarray(
+        ctx.scope.find_var(ctx.op.input("Ids")[0]).value().array).reshape(-1)
+    n = len(ctx.op.input("X"))
+    parts = [np.asarray(ctx.scope.find_var(nm).value().array)
+             for nm in ctx.op.input("X")]
+    dim = parts[0].shape[-1]
+    merged = np.zeros((len(ids), dim), parts[0].dtype)
+    counters = [0] * n
+    for i, idv in enumerate(ids):
+        k = int(idv) % n
+        merged[i] = parts[k][counters[k]]
+        counters[k] += 1
+    return {"Out": [jnp.asarray(merged)]}
+
+
+# --------------------------------------------------------------------------
+# listen_and_serv (reference: listen_and_serv_op.cc)
+# --------------------------------------------------------------------------
+@register_op("listen_and_serv", stateful=True, no_grad=True,
+             attr_defaults={"endpoint": "", "sync_mode": True, "Fanin": 1,
+                            "grad_to_block_id": [], "sparse_lr": 0.01,
+                            "distributed_mode": 0})
+def _listen_and_serv(ins, attrs):
+    """Server loop: blocks until a stop RPC (parity with RunImpl's
+    server_thread join, listen_and_serv_op.cc:382)."""
+    from ..fluid.ps_rpc import VarServer
+    ctx = attrs["_ctx"]
+    scope, executor = ctx.scope, ctx.executor
+    endpoint = attrs["endpoint"]
+    sync = bool(attrs.get("sync_mode", True))
+    fanin = int(attrs.get("Fanin", 1))
+    optimize_blocks = attrs.get("optimize_blocks") or []
+    grad_to_block = dict(
+        kv.split(":") for kv in attrs.get("grad_to_block_id") or [])
+    sparse_lr = float(attrs.get("sparse_lr", 0.01))
+
+    lock = threading.Condition()
+    state = {"pending": {}, "send_barriers": 0, "round": 0}
+
+    def _apply_sparse(name, value, rows):
+        # row-wise SGD on the host-resident table (reference async sparse
+        # update path; communicator.h AsyncCommunicator)
+        pname = name[:-5] if name.endswith("@GRAD") else name
+        var = scope.find_var(pname)
+        tbl = np.asarray(var.value().array)
+        np.subtract.at(tbl, np.asarray(rows, np.int64),
+                       sparse_lr * np.asarray(value))
+        var.set_value(core.LoDTensor(jnp.asarray(tbl)))
+
+    def _run_block_for(grad_name):
+        blk_id = grad_to_block.get(grad_name)
+        for i, blk in enumerate(optimize_blocks):
+            if blk_id is None or str(i) == str(blk_id):
+                executor._run_block_eager(blk, scope, ctx.rng_base)
+                if blk_id is not None:
+                    break
+
+    def h_send_var(name, value, trainer_id=0, rows=None, height=0):
+        with lock:
+            if rows is not None:
+                _apply_sparse(name, value, rows)
+                return True
+            if sync:
+                state["pending"].setdefault(name, []).append(
+                    np.asarray(value))
+            else:
+                scope.var(name).set_value(
+                    core.LoDTensor(jnp.asarray(value)))
+                _run_block_for(name)
+        return True
+
+    def h_barrier(kind, trainer_id=0):
+        if not sync or kind != "send":
+            return True
+        with lock:
+            state["send_barriers"] += 1
+            if state["send_barriers"] >= fanin:
+                # aggregate: sum each grad across trainers, run optimize
+                for name, parts in state["pending"].items():
+                    total = parts[0]
+                    for p in parts[1:]:
+                        total = total + p
+                    scope.var(name).set_value(
+                        core.LoDTensor(jnp.asarray(total)))
+                for name in list(state["pending"]):
+                    _run_block_for(name)
+                state["pending"].clear()
+                state["send_barriers"] = 0
+                state["round"] += 1
+                lock.notify_all()
+            else:
+                rnd = state["round"]
+                while state["round"] == rnd:
+                    lock.wait(timeout=120.0)
+        return True
+
+    def h_get_var(name, trainer_id=0):
+        arr = _np_of(scope, name)
+        if arr is None:
+            raise KeyError(f"pserver has no var '{name}'")
+        return np.asarray(arr)
+
+    def h_prefetch_rows(name, rows):
+        tbl = np.asarray(scope.find_var(name).value().array)
+        return tbl[np.asarray(rows, np.int64)]
+
+    def h_checkpoint(dir=""):
+        return True
+
+    srv = VarServer(endpoint, {
+        "send_var": h_send_var, "barrier": h_barrier, "get_var": h_get_var,
+        "prefetch_rows": h_prefetch_rows, "checkpoint": h_checkpoint,
+    }).start()
+    try:
+        srv.wait_stopped()
+    finally:
+        srv.shutdown()
+    return {}

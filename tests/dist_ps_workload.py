@@ -1,0 +1,97 @@
+"""Tiny PS workload script, launched as pserver or trainer subprocess by
+test_dist_ps.py (reference pattern: tests/unittests/test_dist_base.py:506
+_run_cluster with dist_mnist.py-style workload scripts).
+
+Roles via argv: role endpoint(s) trainer_id trainers steps outfile
+Model: linear regression y = x @ w + b on a fixed dataset; sync PS SGD.
+With --sparse: adds a distributed embedding pulled from the pserver.
+"""
+import json
+import os
+import sys
+
+# CPU keeps subprocess startup fast and deterministic for the loss oracle.
+# The machine sitecustomize pins the TPU platform in-process, so env vars
+# are too late — switch through jax.config before any backend use.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.transpiler import (DistributeTranspiler,
+                                         DistributeTranspilerConfig)
+
+
+def build(sparse):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="float32")
+        feat = x
+        if sparse:
+            tok = fluid.data("tok", shape=[1], dtype="int64")
+            emb = fluid.layers.embedding(
+                tok, size=[10, 4], is_distributed=True,
+                param_attr=fluid.ParamAttr(name="dist_emb"))
+            emb = fluid.layers.reshape(emb, [-1, 4])
+            feat = fluid.layers.concat([x, emb], axis=1)
+        pred = fluid.layers.fc(feat, 1,
+                               param_attr=fluid.ParamAttr(name="w"),
+                               bias_attr=fluid.ParamAttr(name="b"))
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    role, eps, tid, trainers, steps, outfile = sys.argv[1:7]
+    sparse = "--sparse" in sys.argv
+    tid, trainers, steps = int(tid), int(trainers), int(steps)
+    main_prog, startup, loss = build(sparse)
+
+    t = DistributeTranspiler(DistributeTranspilerConfig())
+    with fluid.program_guard(main_prog, startup):
+        t.transpile(trainer_id=tid, pservers=eps, trainers=trainers,
+                    sync_mode=True, program=main_prog,
+                    startup_program=startup)
+
+    exe = fluid.Executor()
+    scope = core.Scope()
+    if role == "pserver":
+        ep = eps.split(",")[0]
+        pprog = t.get_pserver_program(ep)
+        pstart = t.get_startup_program(ep, pprog)
+        with fluid.scope_guard(scope):
+            exe.run(pstart)
+            open(outfile, "w").write("ready")
+            exe.run(pprog)   # blocks until stop rpc
+        return
+
+    rng = np.random.RandomState(7)
+    X = rng.rand(8, 4).astype("float32")
+    W_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    Y = X @ W_true + 0.25
+    toks = (np.arange(8) % 10).astype("int64").reshape(-1, 1)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        prog = t.get_trainer_program()
+        for s in range(steps):
+            feed = {"x": X, "y": Y}
+            if sparse:
+                feed["tok"] = toks
+            (lv,) = exe.run(prog, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    json.dump(losses, open(outfile, "w"))
+    if tid == 0:
+        from paddle_tpu.fluid.ps_rpc import VarClient
+        for ep in eps.split(","):
+            VarClient.of(ep).stop()
+
+
+if __name__ == "__main__":
+    main()
