@@ -42,6 +42,8 @@ from . import contrib
 from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from .communicator import Communicator
+from . import dataset
+from .dataset import DatasetFactory, InMemoryDataset
 
 Tensor = LoDTensor
 
@@ -68,5 +70,5 @@ __all__ = [
     "save_inference_model", "load_inference_model", "save", "load",
     "in_dygraph_mode", "cpu_places", "cuda_places", "tpu_places",
     "transpiler", "DistributeTranspiler", "DistributeTranspilerConfig",
-    "Communicator",
+    "Communicator", "dataset", "DatasetFactory", "InMemoryDataset",
 ]

@@ -398,6 +398,49 @@ class Executor:
             return [LoDTensor(f, lod=lv) for f, lv in zip(fetched, fetch_lods)]
         return []
 
+    # ------------------------------------------------------ dataset path
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """One pass over a Dataset (reference: executor.py:1438
+        train_from_dataset → C++ MultiTrainer/HogwildWorker threads,
+        trainer.h:64). The TPU inversion: batches stream from the native
+        C++ feed engine into the ONE jitted step — XLA pipelining replaces
+        the reference's per-thread op loops."""
+        return self._run_from_dataset(program, dataset, scope, fetch_list,
+                                      fetch_info, print_period)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        return self._run_from_dataset(program, dataset, scope, fetch_list,
+                                      fetch_info, print_period)
+
+    def _run_from_dataset(self, program, dataset, scope, fetch_list,
+                          fetch_info, print_period):
+        if dataset is None:
+            raise ValueError("dataset must be provided")
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = global_scope()
+        dataset._ensure_handle()
+        if dataset.get_memory_data_size() == 0:
+            dataset._load()
+        fetch_names = _to_fetch_names(fetch_list)
+        step = 0
+        last = []
+        for feed in dataset._iter_batches():
+            last = self.run(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope)
+            if fetch_names and print_period and step % print_period == 0:
+                infos = fetch_info or fetch_names
+                msg = ", ".join(f"{i}={np.asarray(v).reshape(-1)[0]:.6f}"
+                                for i, v in zip(infos, last))
+                print(f"[train_from_dataset] step {step}: {msg}")
+            step += 1
+        return last
+
     # --------------------------------------------------------------- eager
     def _next_rng(self, scope: Scope, program: Program):
         v = scope.var("@RNG_COUNTER@")

@@ -1,0 +1,59 @@
+"""Native (C++) runtime components, built on demand with g++ and loaded
+via ctypes — the parts of the framework that stay host-native, mirroring
+the reference's C++ runtime (data feed: framework/data_feed.cc)."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIBS = {}
+
+
+def _build(name: str) -> str:
+    src = os.path.join(_DIR, name + ".cpp")
+    so = os.path.join(_DIR, "lib" + name + ".so")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               src, "-o", so]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return so
+
+
+def load(name: str) -> ctypes.CDLL:
+    """Build (if stale) and dlopen paddle_tpu/native/<name>.cpp."""
+    with _LOCK:
+        lib = _LIBS.get(name)
+        if lib is None:
+            lib = _LIBS[name] = ctypes.CDLL(_build(name))
+        return lib
+
+
+def datafeed_lib() -> ctypes.CDLL:
+    lib = load("datafeed")
+    if not getattr(lib, "_sigs_done", False):
+        c = ctypes
+        lib.df_create.restype = c.c_void_p
+        lib.df_create.argtypes = [c.c_char_p]
+        lib.df_set_filelist.argtypes = [c.c_void_p,
+                                        c.POINTER(c.c_char_p), c.c_int]
+        lib.df_set_batch.argtypes = [c.c_void_p, c.c_int]
+        lib.df_set_threads.argtypes = [c.c_void_p, c.c_int]
+        lib.df_load_into_memory.argtypes = [c.c_void_p]
+        lib.df_local_shuffle.argtypes = [c.c_void_p, c.c_uint64]
+        lib.df_epoch_begin.argtypes = [c.c_void_p]
+        lib.df_next_batch.restype = c.c_int
+        lib.df_next_batch.argtypes = [c.c_void_p]
+        lib.df_slot_total.restype = c.c_int64
+        lib.df_slot_total.argtypes = [c.c_void_p, c.c_int]
+        lib.df_slot_copy.argtypes = [c.c_void_p, c.c_int, c.c_void_p,
+                                     c.POINTER(c.c_int64)]
+        lib.df_memory_size.restype = c.c_int64
+        lib.df_memory_size.argtypes = [c.c_void_p]
+        lib.df_release.argtypes = [c.c_void_p]
+        lib._sigs_done = True
+    return lib
