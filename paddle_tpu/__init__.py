@@ -10,6 +10,7 @@ __version__ = "0.1.0"
 
 from . import ops          # registers the operator set
 from . import fluid        # the Fluid-compatible front end
+from . import inference    # AnalysisPredictor engine
 
 # 2.0-style convenience aliases (reference: python/paddle/__init__.py
 # re-exports under torch-like names)
@@ -18,4 +19,4 @@ from .fluid import (Program, Executor, CPUPlace, TPUPlace, CUDAPlace,
                     default_startup_program, global_scope, scope_guard,
                     ParamAttr)
 
-__all__ = ["fluid", "ops", "__version__"]
+__all__ = ["fluid", "ops", "inference", "__version__"]

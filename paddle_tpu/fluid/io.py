@@ -182,8 +182,24 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     if main_program is None:
         main_program = default_main_program()
     os.makedirs(dirname, exist_ok=True)
-    pruned = main_program.clone(for_test=True)._prune(
-        [v.name if isinstance(v, Variable) else v for v in target_vars])
+    target_names = [v.name if isinstance(v, Variable) else v
+                    for v in target_vars]
+    pruned = main_program.clone(for_test=True)._prune(target_names)
+    # inject feed/fetch ops so the serialized program records its interface
+    # (reference io.py prepend_feed_ops/append_fetch_ops — the wire format
+    # AnalysisPredictor and Executor both understand, executor.cc:195-306)
+    block = pruned.global_block()
+    feed_ops = []
+    for i, name in enumerate(feeded_var_names):
+        from .framework import Operator
+        feed_ops.append(Operator(block, type="feed",
+                                 inputs={"X": ["feed"]},
+                                 outputs={"Out": [name]},
+                                 attrs={"col": i}))
+    block.ops[:0] = feed_ops
+    for i, name in enumerate(target_names):
+        block.append_op(type="fetch", inputs={"X": [name]},
+                        outputs={"Out": ["fetch"]}, attrs={"col": i})
     model_name = model_filename or "__model__"
     with open(os.path.join(dirname, model_name), "wb") as f:
         f.write(pruned.serialize_to_string())
@@ -206,7 +222,7 @@ def load_inference_model(dirname, executor, model_filename=None,
         elif op.type == "fetch":
             fetch_names.append(op.input("X")[0])
     if not fetch_names:
-        # programs saved by this framework: treat last op outputs as targets
+        # legacy programs without fetch ops: last op outputs are targets
         if program.global_block().ops:
             fetch_names = program.global_block().ops[-1].output_arg_names
     fetch_targets = [program.global_block().var(n) for n in fetch_names

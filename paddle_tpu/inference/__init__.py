@@ -1,0 +1,182 @@
+"""Inference engine (reference: paddle/fluid/inference/ —
+AnalysisPredictor analysis_predictor.cc:288, AnalysisConfig
+api/analysis_config.cc, ZeroCopyTensor, C API capi/).
+
+TPU inversion of the reference pipeline: the reference loads a
+ProgramDesc, runs ~30 IR fusion passes, optionally captures TensorRT/Lite
+subgraphs, then interprets with NaiveExecutor (analysis_predictor.cc:497,
+:235). Here the load step jits the whole pruned program once — operator
+fusion, layout and memory planning are XLA's; the "TensorRT engine"
+becomes the XLA executable itself, and warmup/compile caching replaces
+subgraph capture.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "AnalysisConfig", "Predictor", "AnalysisPredictor",
+           "create_predictor", "create_paddle_predictor", "PredictTensor"]
+
+
+class AnalysisConfig:
+    """reference: api/paddle_analysis_config.h. GPU/MKLDNN/TensorRT knobs
+    are accepted and recorded; on TPU they map to one compiled executable,
+    so they only gate diagnostics."""
+
+    def __init__(self, model_dir: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self._model_dir = model_dir
+        self._prog_file = None
+        self._params_file = params_file
+        self._ir_optim = True
+        self._use_feed_fetch_ops = False
+        self._enable_memory_optim = True
+        self._tensorrt = False
+        self._device = "tpu"
+
+    # --- model location ---------------------------------------------------
+    def set_model(self, model_dir, params_file=None):
+        self._model_dir = model_dir
+        self._params_file = params_file
+
+    def set_prog_file(self, f):
+        self._prog_file = f
+
+    def set_params_file(self, f):
+        self._params_file = f
+
+    def model_dir(self):
+        return self._model_dir
+
+    # --- toggles (parity surface) ----------------------------------------
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = bool(flag)
+
+    def switch_use_feed_fetch_ops(self, flag=True):
+        self._use_feed_fetch_ops = bool(flag)
+
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = bool(flag)
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"  # single accelerator backend on this build
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_tensorrt_engine(self, **kwargs):
+        """TensorRT subgraphs ≈ the jitted XLA executable; recorded only."""
+        self._tensorrt = True
+
+    def tensorrt_engine_enabled(self):
+        return self._tensorrt
+
+    def switch_specify_input_names(self, flag=True):
+        pass
+
+    def specify_input_name(self):
+        return True
+
+
+Config = AnalysisConfig
+
+
+class PredictTensor:
+    """Zero-copy style handle (reference: ZeroCopyTensor
+    inference/api/details/zero_copy_tensor.cc)."""
+
+    def __init__(self, predictor: "AnalysisPredictor", name: str,
+                 is_input: bool):
+        self._p = predictor
+        self.name = name
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        if not self._is_input:
+            raise RuntimeError(f"'{self.name}' is an output tensor")
+        self._p._inputs[self.name] = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._is_input:
+            raise RuntimeError(f"'{self.name}' is an input tensor")
+        return np.asarray(self._p._outputs[self.name])
+
+    def reshape(self, shape):
+        pass  # shapes flow from copy_from_cpu
+
+    @property
+    def lod(self):
+        return self._p._output_lods.get(self.name, [])
+
+
+class AnalysisPredictor:
+    """reference: analysis_predictor.cc:288 Run / :235 PrepareExecutor."""
+
+    def __init__(self, config: AnalysisConfig):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import core
+        self.config = config
+        self._exe = fluid.Executor()
+        self._scope = core.Scope()
+        with fluid.scope_guard(self._scope):
+            (self._program, self._feed_names,
+             self._fetch_targets) = fluid.io.load_inference_model(
+                 config.model_dir(), self._exe,
+                 model_filename=config._prog_file,
+                 params_filename=config._params_file)
+        self._fetch_names = [v.name for v in self._fetch_targets]
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+        self._output_lods: Dict[str, list] = {}
+
+    # --- interface --------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name) -> PredictTensor:
+        if name not in self._feed_names:
+            raise KeyError(f"unknown input '{name}'")
+        return PredictTensor(self, name, True)
+
+    def get_output_handle(self, name) -> PredictTensor:
+        if name not in self._fetch_names:
+            raise KeyError(f"unknown output '{name}'")
+        return PredictTensor(self, name, False)
+
+    # reference AnalysisPredictor::Run — one call, feeds set beforehand
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        import paddle_tpu.fluid as fluid
+        if inputs is not None:
+            for name, arr in zip(self._feed_names, inputs):
+                self._inputs[name] = np.asarray(arr)
+        missing = [n for n in self._feed_names if n not in self._inputs]
+        if missing:
+            raise KeyError(f"inputs not set: {missing}")
+        with fluid.scope_guard(self._scope):
+            fetched = self._exe.run(self._program, feed=dict(self._inputs),
+                                    fetch_list=self._fetch_names,
+                                    return_numpy=False)
+        self._outputs = {}
+        self._output_lods = {}
+        for n, t in zip(self._fetch_names, fetched):
+            self._outputs[n] = np.asarray(t.array)
+            self._output_lods[n] = t.lod()
+        return [self._outputs[n] for n in self._fetch_names]
+
+    def clone(self) -> "AnalysisPredictor":
+        return AnalysisPredictor(self.config)
+
+
+Predictor = AnalysisPredictor
+
+
+def create_predictor(config: AnalysisConfig) -> AnalysisPredictor:
+    return AnalysisPredictor(config)
+
+
+create_paddle_predictor = create_predictor

@@ -1,0 +1,70 @@
+"""Inference engine tests: save_inference_model → AnalysisPredictor round
+trip (reference: inference/tests/api + tests/unittests/
+test_inference_model_io.py)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import inference
+from paddle_tpu.fluid import core
+
+
+def train_and_save(dirname):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    X = rng.rand(16, 4).astype("float32")
+    W = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+    Y = X @ W
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(60):
+            exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        fluid.io.save_inference_model(dirname, ["x"], [pred], exe, main)
+        (out,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[pred])
+    return X, out
+
+
+def test_predictor_matches_training_forward(tmp_path):
+    d = str(tmp_path / "model")
+    X, want = train_and_save(d)
+    config = inference.Config(d)
+    predictor = inference.create_predictor(config)
+    assert predictor.get_input_names() == ["x"]
+    inp = predictor.get_input_handle("x")
+    inp.copy_from_cpu(X)
+    predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0])
+    got = out.copy_to_cpu()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_run_list_api_and_clone(tmp_path):
+    d = str(tmp_path / "model")
+    X, want = train_and_save(d)
+    predictor = inference.create_predictor(inference.Config(d))
+    (got,) = predictor.run([X])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    clone = predictor.clone()
+    (got2,) = clone.run([X[:3]])
+    np.testing.assert_allclose(got2, want[:3], rtol=1e-5, atol=1e-6)
+
+
+def test_load_inference_model_executor_path(tmp_path):
+    """The classic fluid path: load_inference_model + exe.run (reference
+    io.py usage), including pruning of train-only vars."""
+    d = str(tmp_path / "model")
+    X, want = train_and_save(d)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        assert feeds == ["x"]
+        (got,) = exe.run(prog, feed={"x": X}, fetch_list=fetches)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
