@@ -1,0 +1,756 @@
+"""Detection operators (reference: paddle/fluid/operators/detection/ —
+prior_box_op.cc, density_prior_box_op.cc, anchor_generator_op.cc,
+box_coder_op.cc, bipartite_match_op.cc, target_assign_op.cc,
+multiclass_nms_op.cc, yolo_box_op.cc, yolov3_loss_op.cc, roi_align_op.cc,
+roi_pool_op.cc, box_clip_op.cc, generate_proposals_op.cc,
+distribute_fpn_proposals_op.cc, collect_fpn_proposals_op.cc).
+
+TPU split: geometry generators and decoders (priors/anchors/box_coder/
+yolo_box/roi_align/roi_pool) are pure jnp — static shapes, fused by XLA.
+Selection ops with data-dependent output counts (NMS family, proposal
+generation, matching) are host ops (``stateful``) exactly like the
+reference's CPU-only kernels for the same ops; their outputs carry LoD."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, first, seq, out, mark_no_grad
+
+
+# --------------------------------------------------------------------------
+# prior / anchor generators (pure)
+# --------------------------------------------------------------------------
+@register_op("prior_box", no_grad=True,
+             attr_defaults={"min_sizes": [], "max_sizes": [],
+                            "aspect_ratios": [1.0], "variances":
+                            [0.1, 0.1, 0.2, 0.2], "flip": False,
+                            "clip": False, "step_w": 0.0, "step_h": 0.0,
+                            "offset": 0.5, "min_max_aspect_ratios_order":
+                            False})
+def _prior_box(ins, attrs):
+    """SSD prior boxes per feature-map cell (reference prior_box_op.cc)."""
+    feat = first(ins, "Input")    # [N, C, H, W]
+    image = first(ins, "Image")   # [N, C, IH, IW]
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = image.shape[2], image.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes") or []]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", [1.0]):
+        ar = float(ar)
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if attrs.get("flip", False):
+                ars.append(1.0 / ar)
+    step_w = attrs.get("step_w") or IW / W
+    step_h = attrs.get("step_h") or IH / H
+    offset = attrs.get("offset", 0.5)
+
+    boxes = []
+    for ms in min_sizes:
+        for ar in ars:
+            boxes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            boxes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    num_priors = len(boxes)
+    bw = np.asarray([b[0] for b in boxes], np.float32) / 2.0
+    bh = np.asarray([b[1] for b in boxes], np.float32) / 2.0
+
+    cx = (np.arange(W, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(H, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)                        # [H, W]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    out_boxes = np.stack([
+        (cxg - bw) / IW, (cyg - bh) / IH,
+        (cxg + bw) / IW, (cyg + bh) / IH], axis=-1)       # [H, W, P, 4]
+    if attrs.get("clip", False):
+        out_boxes = np.clip(out_boxes, 0.0, 1.0)
+    var = np.broadcast_to(
+        np.asarray(attrs["variances"], np.float32),
+        out_boxes.shape).copy()
+    return out(Boxes=jnp.asarray(out_boxes.astype(np.float32)),
+               Variances=jnp.asarray(var))
+
+
+@register_op("density_prior_box", no_grad=True,
+             attr_defaults={"variances": [0.1, 0.1, 0.2, 0.2], "clip": False,
+                            "step_w": 0.0, "step_h": 0.0, "offset": 0.5,
+                            "fixed_sizes": [], "fixed_ratios": [],
+                            "densities": [], "flatten_to_2d": False})
+def _density_prior_box(ins, attrs):
+    """Densified priors (reference density_prior_box_op.cc)."""
+    feat = first(ins, "Input")
+    image = first(ins, "Image")
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = image.shape[2], image.shape[3]
+    step_w = attrs.get("step_w") or IW / W
+    step_h = attrs.get("step_h") or IH / H
+    offset = attrs.get("offset", 0.5)
+    fixed_sizes = [float(s) for s in attrs["fixed_sizes"]]
+    fixed_ratios = [float(r) for r in attrs["fixed_ratios"]]
+    densities = [int(d) for d in attrs["densities"]]
+    all_boxes = []
+    for y in range(H):
+        for x in range(W):
+            cx = (x + offset) * step_w
+            cy = (y + offset) * step_h
+            for size, dens in zip(fixed_sizes, densities):
+                for ratio in fixed_ratios:
+                    bw = size * np.sqrt(ratio)
+                    bh = size / np.sqrt(ratio)
+                    shift = size / dens
+                    for di in range(dens):
+                        for dj in range(dens):
+                            ccx = cx - size / 2.0 + shift / 2.0 + dj * shift
+                            ccy = cy - size / 2.0 + shift / 2.0 + di * shift
+                            all_boxes.append([
+                                (ccx - bw / 2.0) / IW, (ccy - bh / 2.0) / IH,
+                                (ccx + bw / 2.0) / IW, (ccy + bh / 2.0) / IH])
+    boxes = np.asarray(all_boxes, np.float32)
+    if attrs.get("clip", False):
+        boxes = np.clip(boxes, 0.0, 1.0)
+    P = len(boxes) // (H * W)
+    boxes = boxes.reshape(H, W, P, 4)
+    var = np.broadcast_to(np.asarray(attrs["variances"], np.float32),
+                          boxes.shape).copy()
+    if attrs.get("flatten_to_2d", False):
+        boxes = boxes.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return out(Boxes=jnp.asarray(boxes), Variances=jnp.asarray(var))
+
+
+@register_op("anchor_generator", no_grad=True,
+             attr_defaults={"anchor_sizes": [64.0, 128.0, 256.0, 512.0],
+                            "aspect_ratios": [0.5, 1.0, 2.0],
+                            "variances": [0.1, 0.1, 0.2, 0.2],
+                            "stride": [16.0, 16.0], "offset": 0.5})
+def _anchor_generator(ins, attrs):
+    """RPN anchors (reference anchor_generator_op.cc)."""
+    feat = first(ins, "Input")
+    H, W = feat.shape[2], feat.shape[3]
+    sizes = [float(s) for s in attrs["anchor_sizes"]]
+    ratios = [float(r) for r in attrs["aspect_ratios"]]
+    sw, sh = [float(s) for s in attrs["stride"]]
+    offset = attrs.get("offset", 0.5)
+    base = []
+    for r in ratios:
+        for s in sizes:
+            area = sw * sh
+            area_ratio = area / r
+            bw = np.sqrt(area_ratio)
+            bh = bw * r
+            sc_w = s / sw * bw / 2.0
+            sc_h = s / sh * bh / 2.0
+            base.append([-sc_w, -sc_h, sc_w, sc_h])
+    base = np.asarray(base, np.float32)              # [A, 4]
+    cx = (np.arange(W, dtype=np.float32) + offset) * sw
+    cy = (np.arange(H, dtype=np.float32) + offset) * sh
+    cxg, cyg = np.meshgrid(cx, cy)
+    shift = np.stack([cxg, cyg, cxg, cyg], -1)[..., None, :]  # [H, W, 1, 4]
+    anchors = shift + base[None, None]
+    var = np.broadcast_to(np.asarray(attrs["variances"], np.float32),
+                          anchors.shape).copy()
+    return out(Anchors=jnp.asarray(anchors.astype(np.float32)),
+               Variances=jnp.asarray(var))
+
+
+# --------------------------------------------------------------------------
+# box_coder / box_clip (pure)
+# --------------------------------------------------------------------------
+@register_op("box_coder", diff_inputs=["TargetBox"],
+             attr_defaults={"code_type": "encode_center_size",
+                            "box_normalized": True, "axis": 0,
+                            "variance": []})
+def _box_coder(ins, attrs):
+    """Encode/decode boxes against priors (reference box_coder_op.cc)."""
+    prior = jnp.asarray(first(ins, "PriorBox"))       # [M, 4]
+    pvar = first(ins, "PriorBoxVar")
+    target = jnp.asarray(first(ins, "TargetBox"))
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = attrs.get("box_normalized", True)
+    axis = int(attrs.get("axis", 0))
+    avar = attrs.get("variance") or []
+    off = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if pvar is not None:
+        pvar = jnp.asarray(pvar)
+    if code_type.lower() == "encode_center_size":
+        # target [N, 4] vs prior [M, 4] -> out [N, M, 4]
+        tw = (target[:, 2] - target[:, 0] + off)[:, None]
+        th = (target[:, 3] - target[:, 1] + off)[:, None]
+        tcx = (target[:, 0:1] + target[:, 2:3]) * 0.5 + (0 if norm else 0.5)
+        tcy = (target[:, 1:2] + target[:, 3:4]) * 0.5 + (0 if norm else 0.5)
+        ex = (tcx - pcx[None, :]) / pw[None, :]
+        ey = (tcy - pcy[None, :]) / ph[None, :]
+        ew = jnp.log(jnp.abs(tw / pw[None, :]))
+        eh = jnp.log(jnp.abs(th / ph[None, :]))
+        o = jnp.stack([ex, ey, ew, eh], -1)
+        if pvar is not None:
+            o = o / pvar[None, :, :]
+        elif avar:
+            o = o / jnp.asarray(avar, o.dtype)
+    else:  # decode_center_size
+        # target [N, M, 4] (axis selects prior broadcast dim)
+        if target.ndim == 2:
+            target = target[:, None, :]
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (pw[None, :, None], ph[None, :, None],
+                                    pcx[None, :, None], pcy[None, :, None])
+            if pvar is not None:
+                pvar_b = pvar[None, :, :]
+        else:
+            pw_, ph_, pcx_, pcy_ = (pw[:, None, None], ph[:, None, None],
+                                    pcx[:, None, None], pcy[:, None, None])
+            if pvar is not None:
+                pvar_b = pvar[:, None, :]
+        t = target
+        if pvar is not None:
+            t = t * pvar_b
+        elif avar:
+            t = t * jnp.asarray(avar, t.dtype)
+        dcx = t[..., 0:1] * pw_ + pcx_
+        dcy = t[..., 1:2] * ph_ + pcy_
+        dw = jnp.exp(t[..., 2:3]) * pw_
+        dh = jnp.exp(t[..., 3:4]) * ph_
+        o = jnp.concatenate([dcx - dw * 0.5, dcy - dh * 0.5,
+                             dcx + dw * 0.5 - off, dcy + dh * 0.5 - off], -1)
+        if o.shape[1] == 1 and target.shape[1] == 1:
+            o = o[:, 0, :]
+    return out(OutputBox=o)
+
+
+@register_op("box_clip", needs_lod=True, diff_inputs=["Input"])
+def _box_clip(ins, attrs):
+    """Clip boxes to image bounds (reference box_clip_op.cc); ImInfo rows
+    are [h, w, scale]."""
+    boxes = jnp.asarray(first(ins, "Input"))     # LoD [T, 4] or [N, B, 4]
+    im_info = jnp.asarray(first(ins, "ImInfo"))  # [N, 3]
+    lods = (attrs.get("_lod") or {}).get("Input")
+    if lods and lods[0]:
+        offs = np.asarray(lods[0][-1], np.int64)
+        segs = np.repeat(np.arange(len(offs) - 1), offs[1:] - offs[:-1])
+        h = im_info[jnp.asarray(segs), 0] / im_info[jnp.asarray(segs), 2]
+        w = im_info[jnp.asarray(segs), 1] / im_info[jnp.asarray(segs), 2]
+        h, w = h[:, None] - 1, w[:, None] - 1
+    else:
+        h = (im_info[:, 0] / im_info[:, 2] - 1).reshape(-1, 1, 1)
+        w = (im_info[:, 1] / im_info[:, 2] - 1).reshape(-1, 1, 1)
+    x1 = jnp.clip(boxes[..., 0::2], 0, None)
+    y1 = jnp.clip(boxes[..., 1::2], 0, None)
+    x1 = jnp.minimum(x1, w[..., None] if x1.ndim > w.ndim else w)
+    y1 = jnp.minimum(y1, h[..., None] if y1.ndim > h.ndim else h)
+    o = jnp.stack([x1[..., 0], y1[..., 0], x1[..., 1], y1[..., 1]], -1)
+    return {"Output": [o]}
+
+
+# --------------------------------------------------------------------------
+# matching / assignment (host)
+# --------------------------------------------------------------------------
+@register_op("bipartite_match", stateful=True, no_grad=True, needs_lod=True,
+             attr_defaults={"match_type": "bipartite",
+                            "dist_threshold": 0.5})
+def _bipartite_match(ins, attrs):
+    """Greedy bipartite matching on a distance matrix (reference
+    bipartite_match_op.cc). DistMat LoD groups rows per image."""
+    dist = np.asarray(first(ins, "DistMat"))     # [T, M] (T = sum rows)
+    lods = (attrs.get("_lod") or {}).get("DistMat")
+    if lods and lods[0]:
+        offs = np.asarray(lods[0][-1], np.int64)
+    else:
+        offs = np.asarray([0, dist.shape[0]], np.int64)
+    M = dist.shape[1]
+    n_img = len(offs) - 1
+    match_idx = np.full((n_img, M), -1, np.int32)
+    match_dist = np.zeros((n_img, M), np.float32)
+    for i in range(n_img):
+        sub = dist[offs[i]:offs[i + 1]].copy()    # [rows, M]
+        rows = sub.shape[0]
+        used_r, used_c = set(), set()
+        # greedy global-max matching
+        while len(used_r) < rows and len(used_c) < M:
+            flat = np.argmax(np.where(
+                np.isin(np.arange(rows), list(used_r))[:, None] |
+                np.isin(np.arange(M), list(used_c))[None, :],
+                -np.inf, sub))
+            r, c = divmod(int(flat), M)
+            if sub[r, c] <= 0:
+                break
+            match_idx[i, c] = r
+            match_dist[i, c] = sub[r, c]
+            used_r.add(r)
+            used_c.add(c)
+        if attrs.get("match_type") == "per_prediction":
+            thr = float(attrs.get("dist_threshold", 0.5))
+            for c in range(M):
+                if match_idx[i, c] == -1:
+                    r = int(np.argmax(sub[:, c]))
+                    if sub[r, c] >= thr:
+                        match_idx[i, c] = r
+                        match_dist[i, c] = sub[r, c]
+    return out(ColToRowMatchIndices=jnp.asarray(match_idx),
+               ColToRowMatchDist=jnp.asarray(match_dist))
+
+
+@register_op("target_assign", stateful=True, no_grad=True, needs_lod=True,
+             attr_defaults={"mismatch_value": 0})
+def _target_assign(ins, attrs):
+    """Gather per-prior targets by match indices (reference
+    target_assign_op.cc). X is LoD [T, K]; MatchIndices [N, M]."""
+    x = np.asarray(first(ins, "X"))
+    mi = np.asarray(first(ins, "MatchIndices"))
+    lods = (attrs.get("_lod") or {}).get("X")
+    offs = (np.asarray(lods[0][-1], np.int64) if lods and lods[0]
+            else np.asarray([0, x.shape[0]], np.int64))
+    mismatch = attrs.get("mismatch_value", 0)
+    N, M = mi.shape
+    K = x.shape[-1] if x.ndim > 1 else 1
+    o = np.full((N, M, K), mismatch, x.dtype)
+    w = np.zeros((N, M, 1), np.float32)
+    for i in range(N):
+        for c in range(M):
+            r = mi[i, c]
+            if r >= 0:
+                if x.ndim == 3:
+                    # per-prior codes: X [T, M, K] (ssd_loss box encodings)
+                    o[i, c] = x[offs[i] + r, c]
+                else:
+                    o[i, c] = x.reshape(-1, K)[offs[i] + r]
+                w[i, c] = 1.0
+    return out(Out=jnp.asarray(o), OutWeight=jnp.asarray(w))
+
+
+# --------------------------------------------------------------------------
+# NMS family (host)
+# --------------------------------------------------------------------------
+def _iou_xyxy(a, b, norm=True):
+    off = 0.0 if norm else 1.0
+    ix1 = np.maximum(a[0], b[0])
+    iy1 = np.maximum(a[1], b[1])
+    ix2 = np.minimum(a[2], b[2])
+    iy2 = np.minimum(a[3], b[3])
+    iw = np.maximum(ix2 - ix1 + off, 0)
+    ih = np.maximum(iy2 - iy1 + off, 0)
+    inter = iw * ih
+    ua = ((a[2] - a[0] + off) * (a[3] - a[1] + off)
+          + (b[2] - b[0] + off) * (b[3] - b[1] + off) - inter)
+    return inter / ua if ua > 0 else 0.0
+
+
+def _nms(boxes, scores, thresh, top_k, norm=True, eta=1.0):
+    order = np.argsort(-scores)
+    if top_k > 0:
+        order = order[:top_k]
+    keep = []
+    adaptive = thresh
+    while len(order):
+        i = order[0]
+        keep.append(int(i))
+        rest = []
+        for j in order[1:]:
+            if _iou_xyxy(boxes[i], boxes[j], norm) <= adaptive:
+                rest.append(j)
+        order = np.asarray(rest, np.int64)
+        if eta < 1.0 and adaptive > 0.5:
+            adaptive *= eta
+    return keep
+
+
+@register_op("multiclass_nms", stateful=True, no_grad=True, needs_lod=True,
+             attr_defaults={"score_threshold": 0.05, "nms_top_k": 400,
+                            "keep_top_k": 200, "nms_threshold": 0.3,
+                            "nms_eta": 1.0, "background_label": 0,
+                            "normalized": True})
+def _multiclass_nms(ins, attrs):
+    """Per-class NMS then cross-class top-k (reference
+    multiclass_nms_op.cc). BBoxes [N, M, 4], Scores [N, C, M]; output LoD
+    [T, 6] rows [label, score, x1, y1, x2, y2]."""
+    bboxes = np.asarray(first(ins, "BBoxes"))
+    scores = np.asarray(first(ins, "Scores"))
+    st = float(attrs["score_threshold"])
+    nt = float(attrs["nms_threshold"])
+    ntk = int(attrs["nms_top_k"])
+    ktk = int(attrs["keep_top_k"])
+    bg = int(attrs.get("background_label", 0))
+    norm = bool(attrs.get("normalized", True))
+    eta = float(attrs.get("nms_eta", 1.0))
+    N, C, M = scores.shape
+    all_rows, lens = [], []
+    for n in range(N):
+        dets = []
+        for c in range(C):
+            if c == bg:
+                continue
+            mask = scores[n, c] > st
+            idx = np.where(mask)[0]
+            if not len(idx):
+                continue
+            keep = _nms(bboxes[n][idx], scores[n, c][idx], nt, ntk, norm,
+                        eta)
+            for k in keep:
+                i = idx[k]
+                dets.append([float(c), float(scores[n, c, i]),
+                             *map(float, bboxes[n, i])])
+        dets.sort(key=lambda d: -d[1])
+        if ktk > 0:
+            dets = dets[:ktk]
+        all_rows.extend(dets)
+        lens.append(len(dets))
+    if not all_rows:
+        o = np.full((1, 1), -1.0, np.float32)  # reference empty marker
+        lod = (tuple([0, 1] + [1] * (N - 1)),) if N else ((0, 1),)
+        return {"Out": [jnp.asarray(o)],
+                "_lod": {"Out": [(tuple(np.concatenate(
+                    [[0], np.cumsum([1] + [0] * (N - 1))]).tolist()),)]}}
+    o = np.asarray(all_rows, np.float32)
+    lod0 = tuple(int(v) for v in np.concatenate([[0], np.cumsum(lens)]))
+    return {"Out": [jnp.asarray(o)], "_lod": {"Out": [(lod0,)]}}
+
+
+register_op("multiclass_nms2", stateful=True, no_grad=True, needs_lod=True,
+            attr_defaults={"score_threshold": 0.05, "nms_top_k": 400,
+                           "keep_top_k": 200, "nms_threshold": 0.3,
+                           "nms_eta": 1.0, "background_label": 0,
+                           "normalized": True})(_multiclass_nms)
+
+
+# --------------------------------------------------------------------------
+# YOLO (pure decode, host-free loss)
+# --------------------------------------------------------------------------
+@register_op("yolo_box", no_grad=True,
+             attr_defaults={"anchors": [], "class_num": 1,
+                            "conf_thresh": 0.01, "downsample_ratio": 32,
+                            "clip_bbox": True})
+def _yolo_box(ins, attrs):
+    """Decode a YOLOv3 head to boxes+scores (reference yolo_box_op.cc)."""
+    x = jnp.asarray(first(ins, "X"))          # [N, A*(5+C), H, W]
+    img_size = jnp.asarray(first(ins, "ImgSize"))  # [N, 2] (h, w)
+    anchors = [int(a) for a in attrs["anchors"]]
+    A = len(anchors) // 2
+    C = int(attrs["class_num"])
+    ds = int(attrs["downsample_ratio"])
+    conf = float(attrs["conf_thresh"])
+    N, _, H, W = x.shape
+    x = x.reshape(N, A, 5 + C, H, W)
+    gx = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    gy = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], x.dtype)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], x.dtype)[None, :, None, None]
+    cx = (jax.nn.sigmoid(x[:, :, 0]) + gx) / W
+    cy = (jax.nn.sigmoid(x[:, :, 1]) + gy) / H
+    bw = jnp.exp(x[:, :, 2]) * aw / (ds * W)
+    bh = jnp.exp(x[:, :, 3]) * ah / (ds * H)
+    obj = jax.nn.sigmoid(x[:, :, 4])
+    cls = jax.nn.sigmoid(x[:, :, 5:])
+    obj = jnp.where(obj < conf, 0.0, obj)
+    imh = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    imw = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    x1 = (cx - bw / 2) * imw
+    y1 = (cy - bh / 2) * imh
+    x2 = (cx + bw / 2) * imw
+    y2 = (cy + bh / 2) * imh
+    if attrs.get("clip_bbox", True):
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(N, A * H * W, 4)
+    scores = (obj[..., None] * jnp.moveaxis(cls, 2, -1)).reshape(
+        N, A * H * W, C)
+    return out(Boxes=boxes, Scores=scores)
+
+
+@register_op("yolov3_loss", diff_inputs=["X"],
+             attr_defaults={"anchors": [], "anchor_mask": [], "class_num": 1,
+                            "ignore_thresh": 0.7, "downsample_ratio": 32,
+                            "use_label_smooth": True})
+def _yolov3_loss(ins, attrs):
+    """YOLOv3 training loss (reference yolov3_loss_op.cc): coordinate
+    losses on responsible anchors, objectness BCE with ignore region,
+    class BCE. GTBox [N, B, 4] (cx, cy, w, h relative), GTLabel [N, B]."""
+    x = jnp.asarray(first(ins, "X"))
+    gt_box = jnp.asarray(first(ins, "GTBox"))
+    gt_label = jnp.asarray(first(ins, "GTLabel"))
+    anchors = [float(a) for a in attrs["anchors"]]
+    mask = [int(m) for m in attrs["anchor_mask"]]
+    C = int(attrs["class_num"])
+    ds = int(attrs["downsample_ratio"])
+    ignore = float(attrs["ignore_thresh"])
+    N, _, H, W = x.shape
+    A = len(mask)
+    x = x.reshape(N, A, 5 + C, H, W)
+    input_size = ds * H
+
+    def bce(p, t):
+        p = jax.nn.sigmoid(p)
+        p = jnp.clip(p, 1e-7, 1 - 1e-7)
+        return -(t * jnp.log(p) + (1 - t) * jnp.log(1 - p))
+
+    total = jnp.zeros((N,), x.dtype)
+    # responsible cell/anchor per gt (host-static loop over B boxes)
+    B = gt_box.shape[1]
+    obj_target = jnp.zeros((N, A, H, W), x.dtype)
+    obj_mask = jnp.ones((N, A, H, W), x.dtype)
+    for b in range(B):
+        gx, gy = gt_box[:, b, 0] * W, gt_box[:, b, 1] * H
+        gw, gh = gt_box[:, b, 2], gt_box[:, b, 3]
+        valid = (gw > 0) & (gh > 0)
+        gi = jnp.clip(gx.astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip(gy.astype(jnp.int32), 0, H - 1)
+        # best anchor by wh IoU against ALL anchors
+        gw_pix = gw * input_size
+        gh_pix = gh * input_size
+        best_iou = None
+        best_a = jnp.zeros((N,), jnp.int32)
+        for ai in range(len(anchors) // 2):
+            aw, ah = anchors[2 * ai], anchors[2 * ai + 1]
+            inter = (jnp.minimum(gw_pix, aw) * jnp.minimum(gh_pix, ah))
+            iou = inter / (gw_pix * gh_pix + aw * ah - inter + 1e-9)
+            if best_iou is None:
+                best_iou = iou
+            else:
+                best_a = jnp.where(iou > best_iou, ai, best_a)
+                best_iou = jnp.maximum(iou, best_iou)
+        # only anchors in this head's mask contribute
+        for mi, ai in enumerate(mask):
+            sel = valid & (best_a == ai)
+            scale = 2.0 - gw * gh
+            nrange = jnp.arange(N)
+            tx = gx - jnp.floor(gx)
+            ty = gy - jnp.floor(gy)
+            tw = jnp.log(gw_pix / anchors[2 * ai] + 1e-9)
+            th = jnp.log(gh_pix / anchors[2 * ai + 1] + 1e-9)
+            px = x[nrange, mi, 0, gj, gi]
+            py = x[nrange, mi, 1, gj, gi]
+            pw = x[nrange, mi, 2, gj, gi]
+            ph = x[nrange, mi, 3, gj, gi]
+            coord = (bce(px, tx) + bce(py, ty)
+                     + scale * (jnp.abs(pw - tw) + jnp.abs(ph - th)))
+            pcls = x[nrange, mi, 5:, gj, gi]
+            tcls = jax.nn.one_hot(gt_label[:, b], C, dtype=x.dtype)
+            cls_loss = bce(pcls, tcls).sum(-1)
+            total = total + jnp.where(sel, scale * coord + cls_loss, 0.0)
+            obj_target = obj_target.at[nrange, mi, gj, gi].max(
+                jnp.where(sel, 1.0, 0.0))
+    obj_loss = bce(x[:, :, 4], obj_target) * obj_mask
+    total = total + obj_loss.sum((1, 2, 3))
+    return out(Loss=total)
+
+
+# --------------------------------------------------------------------------
+# RoI ops (pure)
+# --------------------------------------------------------------------------
+@register_op("roi_align", needs_lod=True, diff_inputs=["X"],
+             attr_defaults={"pooled_height": 1, "pooled_width": 1,
+                            "spatial_scale": 1.0, "sampling_ratio": -1})
+def _roi_align(ins, attrs):
+    """RoIAlign with bilinear sampling (reference roi_align_op.cc)."""
+    x = jnp.asarray(first(ins, "X"))         # [N, C, H, W]
+    rois = jnp.asarray(first(ins, "ROIs"))   # LoD [R, 4]
+    lods = (attrs.get("_lod") or {}).get("ROIs")
+    offs = (np.asarray(lods[0][-1], np.int64) if lods and lods[0]
+            else np.asarray([0, rois.shape[0]], np.int64))
+    batch_of = np.repeat(np.arange(len(offs) - 1), offs[1:] - offs[:-1])
+    ph, pw = int(attrs["pooled_height"]), int(attrs["pooled_width"])
+    scale = float(attrs["spatial_scale"])
+    sratio = int(attrs.get("sampling_ratio", -1))
+    N, C, H, W = x.shape
+
+    def one_roi(roi, bidx):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        s = sratio if sratio > 0 else 2
+        # per-bin sample coords: s×s samples per pooled cell
+        iy = jnp.arange(s) + 0.5
+        ix = jnp.arange(s) + 0.5
+        py = y1 + (jnp.arange(ph)[:, None] + iy[None, :] / s) * bin_h
+        px = x1 + (jnp.arange(pw)[:, None] + ix[None, :] / s) * bin_w
+
+        def bilinear(yy, xx):
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            ly = jnp.clip(yy - y0, 0, 1)
+            lx = jnp.clip(xx - x0, 0, 1)
+            img = x[bidx]                     # [C, H, W]
+            v00 = img[:, y0.astype(int), x0.astype(int)]
+            v01 = img[:, y0.astype(int), x1_.astype(int)]
+            v10 = img[:, y1_.astype(int), x0.astype(int)]
+            v11 = img[:, y1_.astype(int), x1_.astype(int)]
+            return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+                    + v10 * ly * (1 - lx) + v11 * ly * lx)
+
+        # accumulate over s*s samples per bin
+        acc = jnp.zeros((C, ph, pw), x.dtype)
+        for i in range(s):
+            for j in range(s):
+                yy = py[:, i][:, None] * jnp.ones((1, pw))   # [ph, pw]
+                xx = px[:, j][None, :] * jnp.ones((ph, 1))
+                acc = acc + bilinear(yy, xx)
+        return acc / (s * s)
+
+    outs = [one_roi(rois[r], int(batch_of[r]))
+            for r in range(rois.shape[0])]
+    o = (jnp.stack(outs) if outs
+         else jnp.zeros((0, C, ph, pw), x.dtype))
+    return {"Out": [o]}
+
+
+@register_op("roi_pool", needs_lod=True, diff_inputs=["X"],
+             attr_defaults={"pooled_height": 1, "pooled_width": 1,
+                            "spatial_scale": 1.0})
+def _roi_pool(ins, attrs):
+    """Max RoI pooling (reference roi_pool_op.cc)."""
+    x = np.asarray(first(ins, "X"))
+    rois = np.asarray(first(ins, "ROIs"))
+    lods = (attrs.get("_lod") or {}).get("ROIs")
+    offs = (np.asarray(lods[0][-1], np.int64) if lods and lods[0]
+            else np.asarray([0, rois.shape[0]], np.int64))
+    batch_of = np.repeat(np.arange(len(offs) - 1), offs[1:] - offs[:-1])
+    ph, pw = int(attrs["pooled_height"]), int(attrs["pooled_width"])
+    scale = float(attrs["spatial_scale"])
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    o = np.zeros((R, C, ph, pw), x.dtype)
+    argmax = np.zeros((R, C, ph, pw), np.int64)
+    for r in range(R):
+        b = batch_of[r]
+        x1, y1, x2, y2 = np.round(rois[r] * scale).astype(np.int64)
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        for i in range(ph):
+            hs = y1 + (i * rh) // ph
+            he = y1 + ((i + 1) * rh + ph - 1) // ph
+            hs, he = np.clip([hs, he], 0, H)
+            for j in range(pw):
+                ws = x1 + (j * rw) // pw
+                we = x1 + ((j + 1) * rw + pw - 1) // pw
+                ws, we = np.clip([ws, we], 0, W)
+                if he > hs and we > ws:
+                    patch = x[b, :, hs:he, ws:we].reshape(C, -1)
+                    o[r, :, i, j] = patch.max(-1)
+                    argmax[r, :, i, j] = patch.argmax(-1)
+    return out(Out=jnp.asarray(o), Argmax=jnp.asarray(argmax))
+
+
+# --------------------------------------------------------------------------
+# proposal generation (host)
+# --------------------------------------------------------------------------
+@register_op("generate_proposals", stateful=True, no_grad=True,
+             attr_defaults={"pre_nms_topN": 6000, "post_nms_topN": 1000,
+                            "nms_thresh": 0.5, "min_size": 0.1, "eta": 1.0})
+def _generate_proposals(ins, attrs):
+    """RPN proposal generation: decode deltas on anchors, clip, filter
+    small, NMS (reference generate_proposals_op.cc)."""
+    scores = np.asarray(first(ins, "Scores"))      # [N, A, H, W]
+    deltas = np.asarray(first(ins, "BboxDeltas"))  # [N, A*4, H, W]
+    im_info = np.asarray(first(ins, "ImInfo"))     # [N, 3]
+    anchors = np.asarray(first(ins, "Anchors")).reshape(-1, 4)
+    variances = np.asarray(first(ins, "Variances")).reshape(-1, 4)
+    pre_n = int(attrs["pre_nms_topN"])
+    post_n = int(attrs["post_nms_topN"])
+    nt = float(attrs["nms_thresh"])
+    min_size = float(attrs["min_size"])
+    N = scores.shape[0]
+    all_rois, all_scores, lens = [], [], []
+    for n in range(N):
+        sc = scores[n].transpose(1, 2, 0).reshape(-1)
+        dl = deltas[n].reshape(-1, 4, *deltas.shape[2:]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-sc)[:pre_n]
+        sc, dl = sc[order], dl[order]
+        an, va = anchors[order], variances[order]
+        # decode (anchor-center form with variances)
+        aw = an[:, 2] - an[:, 0] + 1
+        ah = an[:, 3] - an[:, 1] + 1
+        acx = an[:, 0] + aw / 2
+        acy = an[:, 1] + ah / 2
+        cx = va[:, 0] * dl[:, 0] * aw + acx
+        cy = va[:, 1] * dl[:, 1] * ah + acy
+        w = np.exp(np.minimum(va[:, 2] * dl[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(va[:, 3] * dl[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - 1, cy + h / 2 - 1], 1)
+        ih, iw = im_info[n, 0], im_info[n, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - 1)
+        ms = min_size * im_info[n, 2]
+        keep = np.where((boxes[:, 2] - boxes[:, 0] + 1 >= ms)
+                        & (boxes[:, 3] - boxes[:, 1] + 1 >= ms))[0]
+        boxes, sc = boxes[keep], sc[keep]
+        keep = _nms(boxes, sc, nt, post_n, norm=False)
+        boxes, sc = boxes[keep], sc[keep]
+        all_rois.append(boxes)
+        all_scores.append(sc)
+        lens.append(len(boxes))
+    rois = (np.concatenate(all_rois) if all_rois
+            else np.zeros((0, 4), np.float32))
+    scs = (np.concatenate(all_scores) if all_scores
+           else np.zeros((0,), np.float32))
+    lod0 = tuple(int(v) for v in np.concatenate([[0], np.cumsum(lens)]))
+    return {"RpnRois": [jnp.asarray(rois.astype(np.float32))],
+            "RpnRoiProbs": [jnp.asarray(scs.astype(np.float32)
+                                        .reshape(-1, 1))],
+            "RpnRoisNum": [jnp.asarray(np.asarray(lens, np.int32))],
+            "_lod": {"RpnRois": [(lod0,)], "RpnRoiProbs": [(lod0,)]}}
+
+
+@register_op("distribute_fpn_proposals", stateful=True, no_grad=True,
+             needs_lod=True,
+             attr_defaults={"min_level": 2, "max_level": 5,
+                            "refer_level": 4, "refer_scale": 224})
+def _distribute_fpn_proposals(ins, attrs):
+    """Route RoIs to FPN levels by scale (reference
+    distribute_fpn_proposals_op.cc)."""
+    rois = np.asarray(first(ins, "FpnRois"))
+    lods = (attrs.get("_lod") or {}).get("FpnRois")
+    offs = (np.asarray(lods[0][-1], np.int64) if lods and lods[0]
+            else np.asarray([0, rois.shape[0]], np.int64))
+    lo, hi = int(attrs["min_level"]), int(attrs["max_level"])
+    rl, rs = int(attrs["refer_level"]), int(attrs["refer_scale"])
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = np.sqrt(np.maximum(w * h, 1e-6))
+    lvl = np.floor(np.log2(scale / rs + 1e-6) + rl).astype(np.int64)
+    lvl = np.clip(lvl, lo, hi)
+    n_lvl = hi - lo + 1
+    outs, out_lods, restore = [], [], np.zeros(len(rois), np.int64)
+    pos = 0
+    for L in range(lo, hi + 1):
+        idx = np.where(lvl == L)[0]
+        outs.append(jnp.asarray(rois[idx]))
+        lens = [int(((lvl[offs[i]:offs[i + 1]] == L)).sum())
+                for i in range(len(offs) - 1)]
+        out_lods.append((tuple(int(v) for v in
+                               np.concatenate([[0], np.cumsum(lens)])),))
+        restore[idx] = np.arange(pos, pos + len(idx))
+        pos += len(idx)
+    return {"MultiFpnRois": outs,
+            "RestoreIndex": [jnp.asarray(restore.reshape(-1, 1))],
+            "_lod": {"MultiFpnRois": out_lods}}
+
+
+@register_op("collect_fpn_proposals", stateful=True, no_grad=True,
+             needs_lod=True, attr_defaults={"post_nms_topN": 100})
+def _collect_fpn_proposals(ins, attrs):
+    """Merge per-level RoIs back, keep top-N by score (reference
+    collect_fpn_proposals_op.cc)."""
+    roi_list = [np.asarray(r) for r in seq(ins, "MultiLevelRois")]
+    score_list = [np.asarray(s).reshape(-1) for s in
+                  seq(ins, "MultiLevelScores")]
+    rois = np.concatenate(roi_list) if roi_list else np.zeros((0, 4))
+    scores = np.concatenate(score_list) if score_list else np.zeros((0,))
+    topn = int(attrs["post_nms_topN"])
+    order = np.argsort(-scores)[:topn]
+    return {"FpnRois": [jnp.asarray(rois[order].astype(np.float32))],
+            "_lod": {"FpnRois": [((0, len(order)),)]}}

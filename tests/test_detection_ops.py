@@ -1,0 +1,199 @@
+"""Detection op tests (reference: tests/unittests/test_prior_box_op.py,
+test_anchor_generator_op.py, test_box_coder_op.py,
+test_bipartite_match_op.py, test_multiclass_nms_op.py, test_yolo_box_op.py,
+test_roi_pool_op.py, test_roi_align_op.py, test_generate_proposals_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from tests.test_sequence_ops import run_seq_op
+
+
+def test_prior_box_counts_and_geometry():
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+    (boxes, var), _ = run_seq_op(
+        "prior_box", feat, None, x_slot="Input",
+        extra_inputs=[("Image", img, None)],
+        attrs={"min_sizes": [4.0], "max_sizes": [8.0],
+               "aspect_ratios": [1.0, 2.0], "flip": True, "clip": True,
+               "variances": [0.1, 0.1, 0.2, 0.2]},
+        outputs=("Boxes", "Variances"))
+    # priors per cell: ar {1, 2, 0.5} for min + 1 for sqrt(min*max) = 4
+    assert boxes.shape == (4, 4, 4, 4)
+    assert var.shape == boxes.shape
+    assert (boxes >= 0).all() and (boxes <= 1).all()
+    # center cell (0,0): first box is min_size square around (4, 4)
+    np.testing.assert_allclose(
+        boxes[0, 0, 0], [(4 - 2) / 32, (4 - 2) / 32,
+                         (4 + 2) / 32, (4 + 2) / 32], atol=1e-6)
+
+
+def test_anchor_generator_shape():
+    feat = np.zeros((1, 8, 3, 5), np.float32)
+    (anchors, var), _ = run_seq_op(
+        "anchor_generator", feat, None, x_slot="Input",
+        attrs={"anchor_sizes": [64.0, 128.0], "aspect_ratios": [1.0],
+               "stride": [16.0, 16.0], "variances": [0.1, 0.1, 0.2, 0.2]},
+        outputs=("Anchors", "Variances"))
+    assert anchors.shape == (3, 5, 2, 4)
+    # anchors centered on strided cell centers
+    c = anchors[0, 0, 0]
+    assert abs((c[0] + c[2]) / 2 - 8.0) < 1e-4
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    prior = np.abs(rng.rand(5, 4)).astype(np.float32)
+    prior[:, 2:] = prior[:, :2] + 0.5 + prior[:, 2:]
+    pvar = np.full((5, 4), 0.1, np.float32)
+    target = prior + 0.05  # boxes near priors
+    (enc,), _ = run_seq_op(
+        "box_coder", prior, None, x_slot="PriorBox",
+        extra_inputs=[("PriorBoxVar", pvar, None),
+                      ("TargetBox", target, None)],
+        attrs={"code_type": "encode_center_size"},
+        outputs=("OutputBox",))
+    assert enc.shape == (5, 5, 4)
+    # decode the diagonal back
+    diag = np.stack([enc[i, i] for i in range(5)])[:, None, :]
+    (dec,), _ = run_seq_op(
+        "box_coder", prior, None, x_slot="PriorBox",
+        extra_inputs=[("PriorBoxVar", pvar, None),
+                      ("TargetBox", diag, None)],
+        attrs={"code_type": "decode_center_size", "axis": 0},
+        outputs=("OutputBox",))
+    got = np.stack([dec[i, i] for i in range(5)])
+    np.testing.assert_allclose(got, target, rtol=1e-4, atol=1e-5)
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[0.9, 0.2, 0.1],
+                     [0.6, 0.8, 0.3]], np.float32)
+    (idx, d), _ = run_seq_op(
+        "bipartite_match", dist, [[2]], x_slot="DistMat",
+        outputs=("ColToRowMatchIndices", "ColToRowMatchDist"))
+    # global max 0.9 -> row0/col0; next best among remaining 0.8 row1/col1
+    np.testing.assert_array_equal(idx[0], [0, 1, -1])
+    np.testing.assert_allclose(d[0], [0.9, 0.8, 0.0], atol=1e-6)
+
+
+def test_multiclass_nms_basic():
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [20, 20, 30, 30]]], np.float32)
+    scores = np.array([[[0.0, 0.0, 0.0],      # class 0 = background
+                        [0.9, 0.85, 0.8]]], np.float32)  # class 1
+    (o,), (olod,) = run_seq_op(
+        "multiclass_nms", boxes, None, x_slot="BBoxes",
+        extra_inputs=[("Scores", scores, None)],
+        attrs={"score_threshold": 0.1, "nms_top_k": 10, "keep_top_k": 10,
+               "nms_threshold": 0.5, "background_label": 0,
+               "normalized": False})
+    # boxes 0 and 1 overlap heavily -> one survives; box 2 separate
+    assert o.shape[0] == 2
+    assert olod == [[0, 2]]
+    assert o[0, 0] == 1.0  # label
+    assert o[0, 1] >= o[1, 1]  # sorted by score
+
+
+def test_yolo_box_decode():
+    N, A, C, H, W = 1, 2, 3, 2, 2
+    x = np.zeros((N, A * (5 + C), H, W), np.float32)
+    img = np.array([[64, 64]], np.int32)
+    (boxes, scores), _ = run_seq_op(
+        "yolo_box", x, None, x_slot="X",
+        extra_inputs=[("ImgSize", img, None)],
+        attrs={"anchors": [10, 14, 23, 27], "class_num": C,
+               "conf_thresh": 0.005, "downsample_ratio": 32},
+        outputs=("Boxes", "Scores"))
+    assert boxes.shape == (1, A * H * W, 4)
+    assert scores.shape == (1, A * H * W, C)
+    # zero logits: sigmoid=0.5 -> center of cell 0 = 0.5/2 * 64 = 16
+    cx = (boxes[0, 0, 0] + boxes[0, 0, 2]) / 2
+    assert abs(cx - 16.0) < 1e-3
+
+
+def test_roi_pool_and_align():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 3, 3]], np.float32)
+    (o, argmax), _ = run_seq_op(
+        "roi_pool", x, None, x_slot="X",
+        extra_inputs=[("ROIs", rois, [[1]])],
+        attrs={"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
+        outputs=("Out", "Argmax"))
+    np.testing.assert_allclose(o[0, 0], [[5, 7], [13, 15]])
+
+    (oa,), _ = run_seq_op(
+        "roi_align", x, None, x_slot="X",
+        extra_inputs=[("ROIs", rois, [[1]])],
+        attrs={"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0,
+               "sampling_ratio": 2},
+        outputs=("Out",))
+    assert oa.shape == (1, 1, 2, 2)
+    # average-ish of the quadrant, strictly between min and max
+    assert 0 < oa[0, 0, 0, 0] < 15
+
+
+def test_generate_proposals_smoke():
+    rng = np.random.RandomState(0)
+    N, A, H, W = 1, 3, 4, 4
+    scores = rng.rand(N, A, H, W).astype(np.float32)
+    deltas = (rng.rand(N, A * 4, H, W).astype(np.float32) - 0.5) * 0.1
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for i in range(H):
+        for j in range(W):
+            for a in range(A):
+                cx, cy = j * 16 + 8, i * 16 + 8
+                s = 8 * (a + 1)
+                anchors[i, j, a] = [cx - s, cy - s, cx + s, cy + s]
+    var = np.full((H, W, A, 4), 1.0, np.float32)
+    (rois, probs, num), lods = run_seq_op(
+        "generate_proposals", scores, None, x_slot="Scores",
+        extra_inputs=[("BboxDeltas", deltas, None),
+                      ("ImInfo", im_info, None),
+                      ("Anchors", anchors, None),
+                      ("Variances", var, None)],
+        attrs={"pre_nms_topN": 20, "post_nms_topN": 5, "nms_thresh": 0.7,
+               "min_size": 1.0},
+        outputs=("RpnRois", "RpnRoiProbs", "RpnRoisNum"))
+    assert rois.shape[1] == 4 and rois.shape[0] <= 5
+    assert (rois[:, 0] >= 0).all() and (rois[:, 2] <= 63).all()
+    assert probs.shape[0] == rois.shape[0]
+
+
+def test_ssd_loss_layer_trains():
+    """detection_output + ssd_loss through the program path."""
+    main, startup = fluid.Program(), fluid.Program()
+    M = 6  # priors
+    with fluid.program_guard(main, startup):
+        loc = fluid.data("loc", shape=[M, 4], dtype="float32")
+        conf = fluid.data("conf", shape=[M, 3], dtype="float32")
+        gt_box = fluid.data("gt_box", shape=[4], dtype="float32",
+                            lod_level=1)
+        gt_label = fluid.data("gt_label", shape=[1], dtype="int32",
+                              lod_level=1)
+        pb = fluid.layers.create_tensor(dtype="float32", name="pb")
+        pbv = fluid.layers.create_tensor(dtype="float32", name="pbv")
+        loss = fluid.layers.ssd_loss(loc, conf, gt_box, gt_label, pb, pbv)
+        avg = fluid.layers.mean(loss)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    priors = np.stack([np.array([i * 0.1, i * 0.1, i * 0.1 + 0.2,
+                                 i * 0.1 + 0.2]) for i in range(M)]
+                      ).astype(np.float32)
+    pvar = np.full((M, 4), 0.1, np.float32)
+    gt = core.LoDTensor(priors[1:2] + 0.01)
+    gt.set_recursive_sequence_lengths([[1]])
+    gl = core.LoDTensor(np.array([[1]], np.int32))
+    gl.set_recursive_sequence_lengths([[1]])
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (lv,) = exe.run(main, feed={
+            "loc": rng.rand(1, M, 4).astype(np.float32) * 0.1,
+            "conf": rng.rand(1, M, 3).astype(np.float32),
+            "gt_box": gt, "gt_label": gl, "pb": priors, "pbv": pvar,
+        }, fetch_list=[avg])
+    assert np.isfinite(np.asarray(lv)).all()
