@@ -130,6 +130,15 @@ def _default_grad_op_descs(op: Operator, grad_map: Dict[str, str],
              "outputs": outputs, "attrs": attrs}], produced
 
 
+def _mark_fwd_idx(descs, fwd_idx):
+    """Record the forward op's block index on its grad descs so the
+    executor re-derives the SAME per-op PRNG key when a vjp grad re-runs a
+    needs_rng forward kernel (sampling ops: nce, sampled softmax, …)."""
+    for d in descs:
+        d["attrs"].setdefault("_fwd_idx", fwd_idx)
+    return descs
+
+
 def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
                     callbacks=None, checkpoints=None):
     """reference backward.py:1193 — returns [(param, grad_var), ...]."""
@@ -188,6 +197,8 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
             if res is None:
                 continue
             descs, _produced = res
+            if info is not None and info.needs_rng:
+                _mark_fwd_idx(descs, i)
         for d in descs:
             pending_descs.append(d)
             # record primal→grad mapping now: grad ops of earlier forward

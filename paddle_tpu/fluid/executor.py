@@ -229,6 +229,13 @@ class _CompiledBlock:
                         attrs["_rng"] = jax.random.fold_in(rng, idx)
                 outs = info.kernel(ins, attrs)
             elif otype.endswith("_grad") and OPS.has(otype[:-5]):
+                base = OPS.get(otype[:-5])
+                if base.needs_rng:
+                    # same key as the forward op (stamped _fwd_idx) so the
+                    # vjp re-run samples identically
+                    attrs = dict(attrs)
+                    attrs["_rng"] = jax.random.fold_in(
+                        rng, int(attrs.get("_fwd_idx", idx)))
                 outs = run_generic_grad(
                     otype[:-5], ins, attrs,
                     wanted_grad_slots=list(op.outputs.keys()),
@@ -500,6 +507,11 @@ class Executor:
                     attrs["_rng"] = jax.random.fold_in(rng_base, idx)
             outs = info.kernel(ins, attrs)
         elif otype.endswith("_grad") and OPS.has(otype[:-5]):
+            base = OPS.get(otype[:-5])
+            if base.needs_rng:
+                attrs = dict(attrs)
+                attrs["_rng"] = jax.random.fold_in(
+                    rng_base, int(attrs.get("_fwd_idx", idx)))
             outs = run_generic_grad(
                 otype[:-5], ins, attrs,
                 wanted_grad_slots=list(op.outputs.keys()),

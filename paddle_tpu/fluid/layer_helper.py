@@ -118,6 +118,12 @@ class LayerHelper:
         param.stop_gradient = stop_gradient
         return param
 
+    def get_parameter(self, name: str):
+        param = self.main_program.global_block().vars.get(name)
+        if param is None:
+            raise ValueError(f"parameter '{name}' not found")
+        return param
+
     def create_variable_for_type_inference(self, dtype,
                                            stop_gradient=False) -> Variable:
         if in_dygraph_mode():

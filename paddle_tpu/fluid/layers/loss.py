@@ -137,38 +137,139 @@ def bpr_loss(input, label, name=None):
 
 def center_loss(input, label, num_classes, alpha, param_attr=None,
                 update_center=True):
-    raise NotImplementedError("center_loss: pending")
+    """reference: layers/loss.py center_loss — intra-class center pull;
+    Centers updated in place by the op (CentersOut aliases Centers)."""
+    from ..initializer import Constant
+    helper = LayerHelper("center_loss", **locals())
+    dtype = helper.input_dtype()
+    centers = helper.create_parameter(
+        attr=param_attr, shape=[num_classes, input.shape[-1]], dtype=dtype,
+        default_initializer=Constant(0.0))
+    centers.stop_gradient = True
+    rate = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="fill_constant", inputs={},
+                     outputs={"Out": [rate]},
+                     attrs={"shape": [1], "value": float(alpha),
+                            "dtype": rate.dtype})
+    loss = helper.create_variable_for_type_inference(dtype)
+    diff = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="center_loss",
+        inputs={"X": [input], "Label": [label], "Centers": [centers],
+                "CenterUpdateRate": [rate]},
+        outputs={"Loss": [loss], "SampleCenterDiff": [diff],
+                 "CentersOut": [centers]},
+        attrs={"cluster_num": num_classes, "alpha": float(alpha),
+               "need_update": update_center})
+    return loss
 
 
 def edit_distance(input, label, normalized=True, ignored_tokens=None,
                   input_length=None, label_length=None):
-    raise NotImplementedError("edit_distance: pending sequence batch")
+    from ..core import VarDesc
+    helper = LayerHelper("edit_distance")
+    out = helper.create_variable_for_type_inference(VarDesc.VarType.FP32)
+    seq_num = helper.create_variable_for_type_inference(
+        VarDesc.VarType.INT64)
+    helper.append_op(type="edit_distance",
+                     inputs={"Hyps": [input], "Refs": [label]},
+                     outputs={"Out": [out], "SequenceNum": [seq_num]},
+                     attrs={"normalized": normalized})
+    return out, seq_num
 
 
 def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
             label_length=None):
-    raise NotImplementedError("warpctc: pending CTC kernel")
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    loss.shape = (-1, 1)
+    helper.append_op(type="warpctc",
+                     inputs={"Logits": [input], "Label": [label]},
+                     outputs={"Loss": [loss]},
+                     attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss
 
 
 def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
         custom_dist=None, seed=0, is_sparse=False):
-    raise NotImplementedError("nce: pending sampled-softmax batch")
+    """reference: layers/loss.py nce — NCE over sampled negatives."""
+    helper = LayerHelper("nce", **locals())
+    dtype = helper.input_dtype()
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=param_attr,
+                                shape=[num_total_classes, dim], dtype=dtype)
+    b = (helper.create_parameter(attr=bias_attr,
+                                 shape=[num_total_classes, 1], dtype=dtype,
+                                 is_bias=True)
+         if bias_attr is not False else None)
+    cost = helper.create_variable_for_type_inference(dtype)
+    cost.shape = (-1, 1)
+    slog = helper.create_variable_for_type_inference(dtype)
+    slab = helper.create_variable_for_type_inference(label.dtype)
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if b is not None:
+        inputs["Bias"] = [b]
+    helper.append_op(
+        type="nce", inputs=inputs,
+        outputs={"Cost": [cost], "SampleLogits": [slog],
+                 "SampleLabels": [slab]},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples or 10, "seed": seed,
+               "sampler": {"uniform": 0, "log_uniform": 1,
+                           "custom_dist": 2}.get(sampler, 0),
+               "is_sparse": is_sparse})
+    return cost
 
 
 def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
              name=None, path_table=None, path_code=None, is_custom=False,
              is_sparse=False):
-    raise NotImplementedError("hsigmoid: pending")
+    """reference: layers/loss.py hsigmoid — complete-binary-tree codes."""
+    helper = LayerHelper("hierarchical_sigmoid", **locals())
+    dtype = helper.input_dtype()
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=param_attr,
+                                shape=[num_classes - 1, dim], dtype=dtype)
+    b = (helper.create_parameter(attr=bias_attr, shape=[num_classes - 1, 1],
+                                 dtype=dtype, is_bias=True)
+         if bias_attr is not False else None)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = (-1, 1)
+    pre = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    if b is not None:
+        inputs["Bias"] = [b]
+    helper.append_op(type="hierarchical_sigmoid", inputs=inputs,
+                     outputs={"Out": [out], "PreOut": [pre]},
+                     attrs={"num_classes": num_classes,
+                            "is_sparse": is_sparse})
+    return out
 
 
-def sampled_softmax_with_cross_entropy(logits, label, num_samples, **kw):
-    raise NotImplementedError("sampled_softmax: pending")
+def sampled_softmax_with_cross_entropy(logits, label, num_samples, seed=0,
+                                       **kw):
+    helper = LayerHelper("sampled_softmax_with_cross_entropy")
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    loss.shape = (-1, 1)
+    helper.append_op(type="sampled_softmax_with_cross_entropy",
+                     inputs={"Logits": [logits], "Label": [label]},
+                     outputs={"Loss": [loss]},
+                     attrs={"num_samples": num_samples, "seed": seed})
+    return loss
 
 
 def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
                                  soft_max_lower_bound=-15.0):
-    raise NotImplementedError("teacher_student_sigmoid_loss: pending")
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = (-1, 1)
+    helper.append_op(type="teacher_student_sigmoid_loss",
+                     inputs={"X": [input], "Label": [label]},
+                     outputs={"Y": [out]},
+                     attrs={"soft_max_up_bound": soft_max_up_bound,
+                            "soft_max_lower_bound": soft_max_lower_bound})
+    return out
 
 
 def npair_loss(anchor, positive, labels, l2_reg=0.002):

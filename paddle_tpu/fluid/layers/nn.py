@@ -1106,7 +1106,13 @@ def resize_nearest(input, out_shape=None, scale=None, name=None,
 
 
 def grid_sampler(x, grid, name=None):
-    raise NotImplementedError("grid_sampler: pending Pallas gather kernel")
+    helper = LayerHelper("grid_sampler", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="grid_sampler",
+                     inputs={"X": [x], "Grid": [grid]},
+                     outputs={"Output": [out]})
+    return out
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
@@ -1496,11 +1502,94 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
 
 
 def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
-    raise NotImplementedError("spectral_norm: pending")
+    """reference: layers/nn.py spectral_norm — power-iteration u/v state."""
+    from ..initializer import Normal
+    helper = LayerHelper("spectral_norm", **locals())
+    dtype = weight.dtype
+    h = weight.shape[dim]
+    w_dims = 1
+    for i, d in enumerate(weight.shape):
+        if i != dim:
+            w_dims *= d
+    u = helper.create_parameter(attr=None, shape=[h], dtype=dtype,
+                                default_initializer=Normal(0.0, 1.0))
+    u.stop_gradient = True
+    v = helper.create_parameter(attr=None, shape=[w_dims], dtype=dtype,
+                                default_initializer=Normal(0.0, 1.0))
+    v.stop_gradient = True
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = weight.shape
+    helper.append_op(type="spectral_norm",
+                     inputs={"Weight": [weight], "U": [u], "V": [v]},
+                     outputs={"Out": [out]},
+                     attrs={"dim": dim, "power_iters": power_iters,
+                            "eps": eps})
+    return out
 
 
 def random_crop(x, shape, seed=None):
-    raise NotImplementedError("random_crop: pending")
+    helper = LayerHelper("random_crop")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="random_crop", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"shape": list(shape),
+                            "seed": int(seed) if seed else 0})
+    return out
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """reference: layers/nn.py linear_chain_crf — CRF NLL; Transition rows
+    [start; end; tags x tags]."""
+    helper = LayerHelper("linear_chain_crf", **locals())
+    dtype = helper.input_dtype()
+    num_tags = input.shape[-1]
+    transition = helper.create_parameter(attr=param_attr,
+                                         shape=[num_tags + 2, num_tags],
+                                         dtype=dtype)
+    ll = helper.create_variable_for_type_inference(dtype)
+    alpha = helper.create_variable_for_type_inference(dtype)
+    e_exps = helper.create_variable_for_type_inference(dtype)
+    t_exps = helper.create_variable_for_type_inference(dtype)
+    ll.shape = (-1, 1)
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input], "Transition": [transition],
+                "Label": [label]},
+        outputs={"LogLikelihood": [ll], "Alpha": [alpha],
+                 "EmissionExps": [e_exps], "TransitionExps": [t_exps]})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    from ..core import VarDesc
+    helper = LayerHelper("crf_decoding", **locals())
+    transition = helper.get_parameter(param_attr.name)
+    path = helper.create_variable_for_type_inference(
+        VarDesc.VarType.INT64)
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [path]})
+    return path
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """reference: layers/nn.py ctc_greedy_decoder — argmax then merge
+    repeats + drop blanks (ctc_align)."""
+    from ..core import VarDesc
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    # argmax over classes, keep LoD of input
+    amax = helper.create_variable_for_type_inference(VarDesc.VarType.INT64)
+    helper.append_op(type="arg_max", inputs={"X": [input]},
+                     outputs={"Out": [amax]},
+                     attrs={"axis": -1, "keepdims": True})
+    out = helper.create_variable_for_type_inference(VarDesc.VarType.INT64)
+    helper.append_op(type="ctc_align", inputs={"Input": [amax]},
+                     outputs={"Output": [out]},
+                     attrs={"blank": blank, "merge_repeated": True})
+    return out
 
 
 def mean_iou(input, label, num_classes):

@@ -1,0 +1,468 @@
+"""Specialty losses & remaining nn ops — CTC, NCE, hierarchical sigmoid,
+linear-chain CRF, sampled softmax, center loss, grid sampler, spectral
+norm, random crop, edit distance (reference: operators/warpctc_op.cc,
+ctc_align_op.cc, edit_distance_op.cc, nce_op.cc, hierarchical_sigmoid_op.cc,
+linear_chain_crf_op.cc, crf_decoding_op.cc, sample_logits_op.cc,
+center_loss_op.cc, grid_sampler_op.cc (cudnn), spectral_norm_op.cc,
+random_crop_op.cc, teacher_student_sigmoid_loss_op.cc).
+
+TPU notes: CTC replaces the vendored warp-ctc library with a log-domain
+dynamic program under lax.scan (padded per LoD bucket, masked); CRF
+forward/viterbi likewise. NCE/sampled-softmax draw negatives with the
+op-seeded PRNG. Host-only ops (edit_distance, ctc_align) are stateful."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, register_grad_maker, first, out
+
+NEG_INF = -1e30
+
+
+def _offs(attrs, slot):
+    lods = attrs.get("_lod") or {}
+    vals = lods.get(slot)
+    if not vals or vals[0] is None:
+        return None
+    return np.asarray(vals[0][-1], np.int64)
+
+
+def _pad_seqs(x, offs, maxlen=None, fill=0.0):
+    lens = offs[1:] - offs[:-1]
+    n = len(lens)
+    T = int(maxlen or (lens.max() if n else 0))
+    pos = np.arange(T)[None, :] + offs[:-1, None]
+    valid = np.arange(T)[None, :] < lens[:, None]
+    idx = np.where(valid, pos, 0)
+    p = jnp.take(jnp.asarray(x), jnp.asarray(idx), axis=0)
+    p = jnp.where(jnp.asarray(valid).reshape(valid.shape + (1,) *
+                                             (p.ndim - 2)), p, fill)
+    return p, jnp.asarray(lens), valid
+
+
+# --------------------------------------------------------------------------
+# CTC (reference: warpctc_op.cc — vendored warp-ctc → log-domain scan)
+# --------------------------------------------------------------------------
+@register_op("warpctc", needs_lod=True, diff_inputs=["Logits"],
+             attr_defaults={"blank": 0, "norm_by_times": False})
+def _warpctc(ins, attrs):
+    logits = first(ins, "Logits")      # LoD [T, C] or padded [Tm, N, C]
+    label = first(ins, "Label")        # LoD [L, 1] int32
+    blank = int(attrs.get("blank", 0))
+    l_offs = _offs(attrs, "Logits")
+    lab_offs = _offs(attrs, "Label")
+    if l_offs is None or lab_offs is None:
+        raise ValueError("warpctc: Logits and Label must carry LoD")
+    logp_all = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+    lp, t_lens, _ = _pad_seqs(logp_all, l_offs, fill=0.0)  # [N, Tm, C]
+    labels_np = np.asarray(label).reshape(-1)
+    lab_lens = lab_offs[1:] - lab_offs[:-1]
+    N = len(lab_lens)
+    Lm = int(lab_lens.max()) if N else 0
+    lab_pad = np.zeros((N, Lm), np.int32)
+    for i in range(N):
+        lab_pad[i, :lab_lens[i]] = labels_np[lab_offs[i]:lab_offs[i + 1]]
+    # extended label sequence with blanks: S = 2*Lm + 1
+    S = 2 * Lm + 1
+    ext = np.full((N, S), blank, np.int32)
+    ext[:, 1::2] = lab_pad
+    ext_j = jnp.asarray(ext)
+    lab_lens_j = jnp.asarray(lab_lens)
+    s_lens = 2 * lab_lens_j + 1
+    # allowed skip transition: ext[s] != blank and ext[s] != ext[s-2]
+    skip_ok = np.zeros((N, S), bool)
+    skip_ok[:, 2:] = (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])
+    skip_ok = jnp.asarray(skip_ok)
+    Tm = lp.shape[1]
+
+    def lse(a, b):
+        m = jnp.maximum(a, b)
+        m_safe = jnp.where(m <= NEG_INF, 0.0, m)
+        r = m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe))
+        return jnp.where(m <= NEG_INF, NEG_INF, r)
+
+    emit0 = jnp.take_along_axis(lp[:, 0], ext_j, axis=1)  # [N, S]
+    alpha0 = jnp.full((N, S), NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(emit0[:, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(lab_lens_j > 0, emit0[:, 1], NEG_INF))
+
+    def step(alpha, t):
+        emit = jnp.take_along_axis(lp[:, t], ext_j, axis=1)
+        prev1 = jnp.concatenate(
+            [jnp.full((N, 1), NEG_INF), alpha[:, :-1]], 1)
+        prev2 = jnp.concatenate(
+            [jnp.full((N, 2), NEG_INF), alpha[:, :-2]], 1)
+        a = lse(alpha, prev1)
+        a = jnp.where(skip_ok, lse(a, prev2), a)
+        new = a + emit
+        # freeze past each sequence's length
+        active = (t < t_lens)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, Tm))
+    idx_last = (s_lens - 1).astype(jnp.int32)
+    idx_prev = jnp.maximum(idx_last - 1, 0)
+    ll = lse(jnp.take_along_axis(alpha, idx_last[:, None], 1)[:, 0],
+             jnp.take_along_axis(alpha, idx_prev[:, None], 1)[:, 0])
+    loss = -ll
+    if attrs.get("norm_by_times", False):
+        loss = loss / t_lens.astype(loss.dtype)
+    return {"Loss": [loss.reshape(-1, 1)], "_lod": {"Loss": [None]}}
+
+
+@register_op("ctc_align", needs_lod=True, no_grad=True, stateful=True,
+             attr_defaults={"blank": 0, "merge_repeated": True})
+def _ctc_align(ins, attrs):
+    """Merge repeats + drop blanks (reference ctc_align_op.cc)."""
+    x = np.asarray(first(ins, "Input")).reshape(-1)
+    offs = _offs(attrs, "Input")
+    blank = int(attrs.get("blank", 0))
+    merge = bool(attrs.get("merge_repeated", True))
+    rows, lens = [], []
+    for i in range(len(offs) - 1):
+        seq = x[offs[i]:offs[i + 1]]
+        kept = []
+        prev = None
+        for v in seq:
+            if merge and prev is not None and v == prev:
+                prev = v
+                continue
+            prev = v
+            if v != blank:
+                kept.append(int(v))
+        if not kept:
+            kept = [-1]  # reference emits -1 row for empty result
+        rows.extend(kept)
+        lens.append(len(kept))
+    lod0 = tuple(int(v) for v in np.concatenate([[0], np.cumsum(lens)]))
+    return {"Output": [jnp.asarray(np.asarray(rows, np.int32)
+                                   .reshape(-1, 1))],
+            "_lod": {"Output": [(lod0,)]}}
+
+
+@register_op("edit_distance", needs_lod=True, no_grad=True, stateful=True,
+             attr_defaults={"normalized": False})
+def _edit_distance(ins, attrs):
+    """Levenshtein distance per sequence pair (reference
+    edit_distance_op.cc)."""
+    hyp = np.asarray(first(ins, "Hyps")).reshape(-1)
+    ref = np.asarray(first(ins, "Refs")).reshape(-1)
+    h_offs = _offs(attrs, "Hyps")
+    r_offs = _offs(attrs, "Refs")
+    n = len(h_offs) - 1
+    dists = np.zeros((n, 1), np.float32)
+    for i in range(n):
+        a = hyp[h_offs[i]:h_offs[i + 1]]
+        b = ref[r_offs[i]:r_offs[i + 1]]
+        dp = np.arange(len(b) + 1, dtype=np.int64)
+        for x_ in a:
+            prev = dp.copy()
+            dp[0] = prev[0] + 1
+            for j in range(1, len(b) + 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (x_ != b[j - 1]))
+        d = float(dp[-1])
+        if attrs.get("normalized", False) and len(b):
+            d /= len(b)
+        dists[i, 0] = d
+    return out(Out=jnp.asarray(dists),
+               SequenceNum=jnp.asarray(np.asarray([n], np.int64)))
+
+
+# --------------------------------------------------------------------------
+# NCE / sampled softmax / hierarchical sigmoid
+# --------------------------------------------------------------------------
+@register_op("nce", needs_rng=True,
+             diff_inputs=["Input", "Weight", "Bias"],
+             attr_defaults={"num_total_classes": 2, "num_neg_samples": 10,
+                            "sampler": 0, "seed": 0, "is_sparse": False})
+def _nce(ins, attrs):
+    """Noise-contrastive estimation (reference nce_op.cc): binary
+    logistic on the true class + k uniform noise classes."""
+    x = first(ins, "Input")            # [N, D]
+    label = first(ins, "Label")        # [N, 1]
+    w = first(ins, "Weight")           # [V, D]
+    b = first(ins, "Bias")             # [V]
+    V = int(attrs["num_total_classes"])
+    k = int(attrs["num_neg_samples"])
+    N = x.shape[0]
+    rng = attrs["_rng"]
+    neg = jax.random.randint(rng, (N, k), 0, V)      # uniform sampler
+    lab = label.reshape(N).astype(jnp.int32)
+    pos_logit = jnp.sum(x * w[lab], -1)
+    if b is not None:
+        pos_logit = pos_logit + b.reshape(-1)[lab]
+    neg_logit = jnp.einsum("nd,nkd->nk", x, w[neg])
+    if b is not None:
+        neg_logit = neg_logit + b.reshape(-1)[neg]
+    # NCE with uniform noise: q = k/V constant, folded into the sigmoid
+    logq = jnp.log(jnp.asarray(k / V, x.dtype))
+    pos_loss = jax.nn.softplus(-(pos_logit - logq))
+    neg_loss = jax.nn.softplus(neg_logit - logq).sum(-1)
+    cost = (pos_loss + neg_loss).reshape(N, 1)
+    return out(Cost=cost,
+               SampleLogits=neg_logit,
+               SampleLabels=neg.astype(jnp.int64))
+
+
+@register_op("sampled_softmax_with_cross_entropy", needs_rng=True,
+             diff_inputs=["Logits"],
+             attr_defaults={"num_samples": 5, "seed": 0,
+                            "use_customized_samples": False})
+def _sampled_softmax(ins, attrs):
+    """Softmax CE over {true, sampled} classes (reference
+    sample_logits_op.cc + tests)."""
+    logits = first(ins, "Logits")      # [N, V]
+    label = first(ins, "Label")        # [N, 1]
+    S = int(attrs["num_samples"])
+    N, V = logits.shape
+    rng = attrs["_rng"]
+    samples = jax.random.randint(rng, (N, S), 0, V)
+    lab = label.reshape(N, 1).astype(jnp.int32)
+    cols = jnp.concatenate([lab, samples], 1)        # [N, 1+S]
+    sub = jnp.take_along_axis(logits, cols, axis=1)
+    ce = -jax.nn.log_softmax(sub, -1)[:, 0]
+    return out(Loss=ce.reshape(N, 1))
+
+
+@register_op("hierarchical_sigmoid",
+             diff_inputs=["X", "W", "Bias"],
+             attr_defaults={"num_classes": 2, "is_sparse": False})
+def _hierarchical_sigmoid(ins, attrs):
+    """Complete-binary-tree hierarchical sigmoid (reference
+    hierarchical_sigmoid_op.cc; SimpleCode in matrix_bit_code.h: for label
+    l the path code is c = l + num_classes, node at depth j is
+    (c >> (j+1)) - 1, bit j is (c >> j) & 1)."""
+    x = first(ins, "X")                # [N, D]
+    w = first(ins, "W")                # [num_classes-1, D]
+    label = first(ins, "Label")        # [N, 1]
+    bias = first(ins, "Bias")
+    V = int(attrs["num_classes"])
+    N = x.shape[0]
+    c = label.reshape(N).astype(jnp.int32) + V
+    depth = int(np.ceil(np.log2(max(V, 2)))) + 1
+    loss = jnp.zeros((N,), x.dtype)
+    for j in range(depth):
+        node = (c >> (j + 1)) - 1
+        bit = (c >> j) & 1
+        active = node >= 0
+        node_c = jnp.clip(node, 0, w.shape[0] - 1)
+        logit = jnp.sum(x * w[node_c], -1)
+        if bias is not None:
+            logit = logit + bias.reshape(-1)[node_c]
+        # bit==1 → sigmoid(logit), bit==0 → 1-sigmoid
+        l = jax.nn.softplus(jnp.where(bit == 1, -logit, logit))
+        loss = loss + jnp.where(active, l, 0.0)
+    pre = jnp.zeros((N, w.shape[0]), x.dtype)  # PreOut parity slot
+    return out(Out=loss.reshape(N, 1), PreOut=pre)
+
+
+# --------------------------------------------------------------------------
+# linear-chain CRF + viterbi decode
+# --------------------------------------------------------------------------
+@register_op("linear_chain_crf", needs_lod=True,
+             diff_inputs=["Emission", "Transition"])
+def _linear_chain_crf(ins, attrs):
+    """Negative log-likelihood of a linear-chain CRF (reference
+    linear_chain_crf_op.cc). Transition layout: row 0 start weights,
+    row 1 end weights, rows 2.. the [tags, tags] transition matrix."""
+    emission = first(ins, "Emission")  # LoD [T, K]
+    transition = first(ins, "Transition")  # [K+2, K]
+    label = first(ins, "Label")        # LoD [T, 1]
+    offs = _offs(attrs, "Emission")
+    K = emission.shape[-1]
+    start_w, end_w = transition[0], transition[1]
+    trans = transition[2:]             # [K, K] from->to
+    em_p, lens, _ = _pad_seqs(emission, offs, fill=0.0)   # [N, Tm, K]
+    lab_np = np.asarray(label).reshape(-1)
+    N, Tm = em_p.shape[0], em_p.shape[1]
+    lab_p = np.zeros((N, Tm), np.int32)
+    for i in range(N):
+        L = offs[i + 1] - offs[i]
+        lab_p[i, :L] = lab_np[offs[i]:offs[i + 1]]
+    lab_p = jnp.asarray(lab_p)
+
+    # log partition via forward recursion
+    alpha0 = start_w[None, :] + em_p[:, 0]
+
+    def fstep(alpha, t):
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) \
+            + em_p[:, t]
+        active = (t < lens)[:, None]
+        return jnp.where(active, nxt, alpha), None
+
+    alpha, _ = jax.lax.scan(fstep, alpha0, jnp.arange(1, Tm))
+    last_lab = jnp.take_along_axis(
+        lab_p, jnp.maximum(lens - 1, 0)[:, None].astype(jnp.int32), 1)[:, 0]
+    logZ = jax.nn.logsumexp(alpha + end_w[None], -1)
+
+    # gold path score
+    em_gold = jnp.take_along_axis(em_p, lab_p[..., None], -1)[..., 0]
+    tmask = jnp.asarray(np.arange(Tm))[None, :] < lens[:, None]
+    gold = (em_gold * tmask).sum(-1)
+    tr_gold = trans[lab_p[:, :-1], lab_p[:, 1:]]
+    tr_mask = jnp.asarray(np.arange(1, Tm))[None, :] < lens[:, None]
+    gold = gold + (tr_gold * tr_mask).sum(-1)
+    gold = gold + start_w[lab_p[:, 0]] + end_w[last_lab]
+    ll = gold - logZ
+    return {"LogLikelihood": [(-ll).reshape(-1, 1)],
+            "Alpha": [alpha], "EmissionExps": [jnp.exp(em_p[:, 0])],
+            "TransitionExps": [jnp.exp(transition)],
+            "_lod": {"LogLikelihood": [None]}}
+
+
+@register_op("crf_decoding", needs_lod=True, no_grad=True)
+def _crf_decoding(ins, attrs):
+    """Viterbi decode (reference crf_decoding_op.cc)."""
+    emission = first(ins, "Emission")
+    transition = first(ins, "Transition")
+    label = first(ins, "Label")
+    offs = _offs(attrs, "Emission")
+    start_w, end_w = transition[0], transition[1]
+    trans = np.asarray(transition[2:])
+    em = np.asarray(emission)
+    sw, ew = np.asarray(start_w), np.asarray(end_w)
+    paths = []
+    for i in range(len(offs) - 1):
+        e = em[offs[i]:offs[i + 1]]
+        T = len(e)
+        K = e.shape[1]
+        delta = sw + e[0]
+        back = np.zeros((T, K), np.int32)
+        for t in range(1, T):
+            cand = delta[:, None] + trans
+            back[t] = cand.argmax(0)
+            delta = cand.max(0) + e[t]
+        delta = delta + ew
+        path = np.zeros(T, np.int64)
+        path[-1] = delta.argmax()
+        for t in range(T - 1, 0, -1):
+            path[t - 1] = back[t, path[t]]
+        paths.append(path)
+    viterbi = np.concatenate(paths).reshape(-1, 1) if paths else \
+        np.zeros((0, 1), np.int64)
+    o = jnp.asarray(viterbi)
+    if label is not None:
+        lab = np.asarray(label).reshape(-1, 1)
+        o = jnp.asarray((viterbi == lab).astype(np.int64))
+    lod = (attrs.get("_lod") or {}).get("Emission")[0]
+    return {"ViterbiPath": [o], "_lod": {"ViterbiPath": [lod]}}
+
+
+# --------------------------------------------------------------------------
+# misc nn ops
+# --------------------------------------------------------------------------
+@register_op("center_loss", diff_inputs=["X"],
+             attr_defaults={"cluster_num": 2, "alpha": 0.1,
+                            "need_update": True})
+def _center_loss(ins, attrs):
+    """Center loss + center update (reference center_loss_op.cc)."""
+    x = first(ins, "X")                # [N, D]
+    label = first(ins, "Label").reshape(-1).astype(jnp.int32)
+    centers = first(ins, "Centers")    # [C, D]
+    lr = first(ins, "CenterUpdateRate")
+    alpha = (lr.reshape(-1)[0] if lr is not None
+             else jnp.asarray(attrs.get("alpha", 0.1), x.dtype))
+    diff = x - centers[label]
+    loss = 0.5 * jnp.sum(diff * diff, -1, keepdims=True)
+    new_centers = centers
+    if attrs.get("need_update", True):
+        counts = jnp.zeros((centers.shape[0],), x.dtype) \
+            .at[label].add(1.0) + 1.0
+        delta = jnp.zeros_like(centers).at[label].add(diff)
+        new_centers = centers + alpha * delta / counts[:, None]
+    return out(Loss=loss, SampleCenterDiff=diff, CentersOut=new_centers)
+
+
+@register_op("grid_sampler", diff_inputs=["X", "Grid"],
+             attr_defaults={"align_corners": True, "mode": "bilinear",
+                            "padding_mode": "zeros"})
+def _grid_sampler(ins, attrs):
+    """Bilinear grid sampling, grid in [-1, 1] (reference
+    grid_sampler_op.cc / cudnn)."""
+    x = first(ins, "X")        # [N, C, H, W]
+    grid = first(ins, "Grid")  # [N, Ho, Wo, 2] (x, y)
+    N, C, H, W = x.shape
+    gx = (grid[..., 0] + 1) * (W - 1) / 2
+    gy = (grid[..., 1] + 1) * (H - 1) / 2
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    lx, ly = gx - x0, gy - y0
+
+    def gather(yy, xx):
+        inside = ((yy >= 0) & (yy < H) & (xx >= 0) & (xx < W))
+        yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        v = x[jnp.arange(N)[:, None, None], :, yc, xc]   # [N, Ho, Wo, C]
+        return v * inside[..., None]
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    o = (v00 * ((1 - ly) * (1 - lx))[..., None]
+         + v01 * ((1 - ly) * lx)[..., None]
+         + v10 * (ly * (1 - lx))[..., None]
+         + v11 * (ly * lx)[..., None])
+    return out(Output=jnp.moveaxis(o, -1, 1))
+
+
+@register_op("spectral_norm", diff_inputs=["Weight"],
+             attr_defaults={"dim": 0, "power_iters": 1, "eps": 1e-12})
+def _spectral_norm(ins, attrs):
+    """Weight / sigma_max via power iteration (reference
+    spectral_norm_op.cc)."""
+    w = first(ins, "Weight")
+    u = first(ins, "U").reshape(-1)
+    v = first(ins, "V").reshape(-1)
+    dim = int(attrs.get("dim", 0))
+    eps = float(attrs.get("eps", 1e-12))
+    mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    for _ in range(int(attrs.get("power_iters", 1))):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ mat @ v
+    return out(Out=w / sigma)
+
+
+@register_op("random_crop", needs_rng=True, no_grad=True,
+             attr_defaults={"shape": [], "startup_seed": 0})
+def _random_crop(ins, attrs):
+    x = first(ins, "X")
+    shape = [int(s) for s in attrs["shape"]]
+    rng = attrs["_rng"]
+    nd = len(shape)
+    starts = []
+    for i, s in enumerate(shape):
+        dim = x.shape[x.ndim - nd + i]
+        rng, sub = jax.random.split(rng)
+        starts.append(jax.random.randint(sub, (), 0, dim - s + 1))
+    o = jax.lax.dynamic_slice(
+        x, [0] * (x.ndim - nd) + [s for s in starts],
+        list(x.shape[:x.ndim - nd]) + shape)
+    return out(Out=o)
+
+
+@register_op("teacher_student_sigmoid_loss",
+             diff_inputs=["X"],
+             attr_defaults={"soft_max_up_bound": 15.0,
+                            "soft_max_lower_bound": -15.0})
+def _teacher_student_sigmoid_loss(ins, attrs):
+    """reference teacher_student_sigmoid_loss_op.cc: CE where label < 0
+    marks teacher soft score encoded as label = -score - 1."""
+    x = first(ins, "X").reshape(-1)
+    label = first(ins, "Label").reshape(-1)
+    x = jnp.clip(x, attrs["soft_max_lower_bound"],
+                 attrs["soft_max_up_bound"])
+    hard = jax.nn.softplus(x) - x * (label > 0)
+    soft_t = -(label + 1.0)
+    soft = jax.nn.softplus(x) - x * soft_t
+    loss = jnp.where(label < 0, soft, hard)
+    return out(Y=loss.reshape(-1, 1))

@@ -1,0 +1,173 @@
+"""CTC / CRF / NCE / hsigmoid / misc op tests (reference:
+tests/unittests/test_warpctc_op.py, test_edit_distance_op.py,
+test_linear_chain_crf_op.py, test_crf_decoding_op.py, test_nce.py,
+test_hsigmoid_op.py, test_grid_sampler_op.py, test_spectral_norm_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from tests.test_sequence_ops import run_seq_op
+
+
+def ref_ctc_loss(logp, labels, blank=0):
+    """Brute-force CTC -log p(labels) by enumerating alignments."""
+    T, C = logp.shape
+    import itertools
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        # collapse
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev:
+                if s != blank:
+                    collapsed.append(s)
+            prev = s
+        if collapsed == list(labels):
+            lp = sum(logp[t, path[t]] for t in range(T))
+            total = np.logaddexp(total, lp)
+    return -total
+
+
+def test_warpctc_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    T, C = 4, 3
+    logits = rng.randn(T, C).astype(np.float32)
+    labels = np.array([[1], [2]], np.int32)
+    (loss,), _ = run_seq_op(
+        "warpctc", logits, [[T]], x_slot="Logits",
+        extra_inputs=[("Label", labels, [[2]])],
+        attrs={"blank": 0}, outputs=("Loss",))
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    want = ref_ctc_loss(logp, [1, 2])
+    np.testing.assert_allclose(loss[0, 0], want, rtol=1e-4)
+
+
+def test_warpctc_two_sequences_and_grad():
+    rng = np.random.RandomState(1)
+    lens = [3, 5]
+    C = 4
+    logits = rng.randn(sum(lens), C).astype(np.float32)
+    labels = np.array([[1], [2], [3]], np.int32)
+    (loss,), _ = run_seq_op(
+        "warpctc", logits, [lens], x_slot="Logits",
+        extra_inputs=[("Label", labels, [[1, 2]])],
+        attrs={"blank": 0}, outputs=("Loss",))
+    assert loss.shape == (2, 1)
+    assert np.isfinite(loss).all()
+    logp = logits[:3] - np.log(np.exp(logits[:3]).sum(-1, keepdims=True))
+    np.testing.assert_allclose(loss[0, 0], ref_ctc_loss(logp, [1]),
+                               rtol=1e-4)
+
+
+def test_ctc_align_and_edit_distance():
+    x = np.array([[0], [1], [1], [0], [2], [2], [0]], np.int32)
+    (o,), (olod,) = run_seq_op("ctc_align", x, [[7]], x_slot="Input",
+                               attrs={"blank": 0, "merge_repeated": True},
+                               outputs=("Output",))
+    np.testing.assert_array_equal(o.reshape(-1), [1, 2])
+
+    hyp = np.array([[1], [2], [3]], np.int64)
+    ref = np.array([[1], [3]], np.int64)
+    (d, n), _ = run_seq_op("edit_distance", hyp, [[3]], x_slot="Hyps",
+                           extra_inputs=[("Refs", ref, [[2]])],
+                           outputs=("Out", "SequenceNum"))
+    assert d[0, 0] == 1.0  # one insertion
+
+
+def test_linear_chain_crf_single_tag_seq():
+    """With one tag, NLL must be 0 (only one path)."""
+    em = np.zeros((3, 1), np.float32)
+    lab = np.zeros((3, 1), np.int64)
+    trans = np.zeros((3, 1), np.float32)
+    (nll,), _ = run_seq_op(
+        "linear_chain_crf", em, [[3]], x_slot="Emission",
+        extra_inputs=[("Transition", trans, None), ("Label", lab, [[3]])],
+        outputs=("LogLikelihood",))
+    np.testing.assert_allclose(nll[0, 0], 0.0, atol=1e-5)
+
+
+def test_crf_decoding_matches_argmax_when_no_transitions():
+    rng = np.random.RandomState(2)
+    K = 4
+    em = rng.randn(5, K).astype(np.float32)
+    trans = np.zeros((K + 2, K), np.float32)
+    (path,), _ = run_seq_op(
+        "crf_decoding", em, [[5]], x_slot="Emission",
+        extra_inputs=[("Transition", trans, None)],
+        outputs=("ViterbiPath",))
+    np.testing.assert_array_equal(path.reshape(-1), em.argmax(-1))
+
+
+def test_nce_and_hsigmoid_and_sampled_softmax_train():
+    """All three sampled losses drive a small LM-style model down."""
+    V, D, N = 20, 8, 16
+    for loss_kind in ("nce", "hsigmoid", "sampled"):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[D], dtype="float32")
+            y = fluid.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, D, act="relu")
+            if loss_kind == "nce":
+                cost = fluid.layers.nce(h, y, V, num_neg_samples=5, seed=1)
+            elif loss_kind == "hsigmoid":
+                cost = fluid.layers.hsigmoid(h, y, V)
+            else:
+                logits = fluid.layers.fc(h, V)
+                cost = fluid.layers.sampled_softmax_with_cross_entropy(
+                    logits, y, num_samples=5, seed=1)
+            loss = fluid.layers.mean(cost)
+            fluid.optimizer.Adam(0.05).minimize(loss)
+        exe = fluid.Executor()
+        scope = core.Scope()
+        rng = np.random.RandomState(3)
+        X = rng.rand(N, D).astype("float32")
+        Y = (np.arange(N) % V).reshape(-1, 1).astype("int64")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            losses = []
+            for _ in range(15):
+                (lv,) = exe.run(main, feed={"x": X, "y": Y},
+                                fetch_list=[loss])
+                losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        assert np.isfinite(losses).all(), loss_kind
+        assert losses[-1] < losses[0], (loss_kind, losses)
+
+
+def test_grid_sampler_identity():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    grid = np.stack([xs, ys], -1)[None].astype(np.float32)
+    (o,), _ = run_seq_op("grid_sampler", x, None, x_slot="X",
+                         extra_inputs=[("Grid", grid, None)],
+                         outputs=("Output",))
+    np.testing.assert_allclose(o, x, atol=1e-5)
+
+
+def test_spectral_norm_unit_sigma():
+    rng = np.random.RandomState(4)
+    w = rng.randn(4, 6).astype(np.float32)
+    u = rng.randn(4).astype(np.float32)
+    v = rng.randn(6).astype(np.float32)
+    (o,), _ = run_seq_op("spectral_norm", w, None, x_slot="Weight",
+                         extra_inputs=[("U", u, None), ("V", v, None)],
+                         attrs={"power_iters": 20}, outputs=("Out",))
+    s = np.linalg.svd(o, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+
+def test_center_loss_pulls_to_centers():
+    x = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    lab = np.array([[0], [1]], np.int64)
+    centers = np.zeros((2, 2), np.float32)
+    rate = np.array([0.5], np.float32)
+    (loss, diff, new_c), _ = run_seq_op(
+        "center_loss", x, None, x_slot="X",
+        extra_inputs=[("Label", lab, None), ("Centers", centers, None),
+                      ("CenterUpdateRate", rate, None)],
+        attrs={"cluster_num": 2, "need_update": True},
+        outputs=("Loss", "SampleCenterDiff", "CentersOut"))
+    np.testing.assert_allclose(loss.reshape(-1), [0.5, 0.5])
+    assert new_c[0, 0] > 0  # moved toward sample
