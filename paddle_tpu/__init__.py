@@ -11,6 +11,8 @@ __version__ = "0.1.0"
 from . import ops          # registers the operator set
 from . import fluid        # the Fluid-compatible front end
 from . import inference    # AnalysisPredictor engine
+from . import nn           # 2.0-preview namespaces
+from . import tensor
 
 # 2.0-style convenience aliases (reference: python/paddle/__init__.py
 # re-exports under torch-like names)

@@ -1,3 +1,4 @@
 """fluid.contrib (reference: python/paddle/fluid/contrib/) — mixed precision
 lands here; slim/quant in a later round."""
 from . import mixed_precision  # noqa: F401
+from . import slim  # noqa: F401

@@ -1,0 +1,9 @@
+"""Quantization-aware training passes (reference:
+python/paddle/fluid/contrib/slim/quantization/quantization_pass.py —
+QuantizationTransformPass, QuantizationFreezePass; post_training_
+quantization.py)."""
+from .quantization_pass import (QuantizationTransformPass,
+                                QuantizationFreezePass, quantize_program)
+
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
+           "quantize_program"]

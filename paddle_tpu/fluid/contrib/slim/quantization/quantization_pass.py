@@ -1,0 +1,122 @@
+"""QAT program transform (reference: contrib/slim/quantization/
+quantization_pass.py — QuantizationTransformPass inserts fake_quant ops on
+the inputs of quantizable ops; QuantizationFreezePass flips them to test
+mode for inference export).
+
+The reference rewrites an IrGraph; this build rewrites the Program
+directly (the Program IS the graph here, and XLA does the rest). Weights
+use quantize_dequantize_abs_max, activations use the moving-average
+variant with a persistable scale state."""
+from __future__ import annotations
+
+from ....framework import Operator, default_main_program
+from ....core import VarDesc
+from .... import unique_name
+
+QUANTIZABLE = {"conv2d", "depthwise_conv2d", "mul", "matmul", "matmul_v2"}
+_WEIGHT_SLOTS = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
+                 "mul": "Y", "matmul": "Y", "matmul_v2": "Y"}
+_ACT_SLOTS = {"conv2d": "Input", "depthwise_conv2d": "Input",
+              "mul": "X", "matmul": "X", "matmul_v2": "X"}
+
+
+def quantize_program(program=None, startup_program=None, weight_bits=8,
+                     activation_bits=8, moving_rate=0.9,
+                     quantizable_op_type=None, for_test=False):
+    """Insert fake quant-dequant before every quantizable op's weight and
+    activation input. Returns the (in-place modified) program."""
+    import paddle_tpu.fluid as fluid
+    program = program or default_main_program()
+    startup = startup_program or fluid.default_startup_program()
+    qtypes = set(quantizable_op_type or QUANTIZABLE)
+    block = program.global_block()
+    quantized = {}  # var name -> quantized var name (per program)
+    new_ops = []
+    params = {p.name for p in program.all_parameters()}
+    for op in block.ops:
+        if op.type in qtypes:
+            for slot, bits, is_weight in (
+                    (_ACT_SLOTS.get(op.type), activation_bits, False),
+                    (_WEIGHT_SLOTS.get(op.type), weight_bits, True)):
+                if slot is None or not op.input(slot):
+                    continue
+                name = op.input(slot)[0]
+                if name in quantized:
+                    op.inputs[slot] = [quantized[name]]
+                    continue
+                src = block.vars.get(name)
+                qname = unique_name.generate(name + ".quantized.dequantized")
+                qv = block.create_var(name=qname,
+                                      dtype=src.dtype if src else
+                                      VarDesc.VarType.FP32,
+                                      shape=tuple(src.shape) if src else ())
+                scale_name = unique_name.generate(name + ".quant_scale")
+                sv = block.create_var(name=scale_name, shape=(1,),
+                                      persistable=True,
+                                      dtype=VarDesc.VarType.FP32)
+                ssv = startup.global_block().create_var(
+                    name=scale_name, shape=(1,), persistable=True,
+                    dtype=VarDesc.VarType.FP32)
+                startup.global_block().append_op(
+                    type="fill_constant", inputs={},
+                    outputs={"Out": [ssv]},
+                    attrs={"shape": [1], "value": 0.0, "dtype": sv.dtype})
+                if is_weight:
+                    qop = Operator(
+                        block, "fake_quantize_dequantize_abs_max",
+                        inputs={"X": [name]},
+                        outputs={"Out": [qname], "OutScale": [scale_name]},
+                        attrs={"bit_length": bits})
+                else:
+                    qop = Operator(
+                        block,
+                        "fake_quantize_dequantize_moving_average_abs_max",
+                        inputs={"X": [name], "InScale": [scale_name]},
+                        outputs={"Out": [qname], "OutScale": [scale_name]},
+                        attrs={"bit_length": bits,
+                               "moving_rate": moving_rate,
+                               "is_test": for_test})
+                new_ops.append((op, qop))
+                quantized[name] = qname
+                op.inputs[slot] = [qname]
+    # splice each quant op immediately before its consumer
+    for consumer, qop in new_ops:
+        idx = block.ops.index(consumer)
+        block.ops.insert(idx, qop)
+    return program
+
+
+class QuantizationTransformPass:
+    """reference QuantizationTransformPass — program-rewrite form."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, activation_quantize_type=
+                 "moving_average_abs_max",
+                 weight_quantize_type="abs_max", window_size=10000,
+                 moving_rate=0.9, quantizable_op_type=None,
+                 skip_pattern="skip_quant"):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        self.quantizable_op_type = quantizable_op_type
+
+    def apply(self, program, startup_program=None, for_test=False):
+        return quantize_program(
+            program, startup_program, self.weight_bits,
+            self.activation_bits, self.moving_rate,
+            self.quantizable_op_type, for_test)
+
+
+class QuantizationFreezePass:
+    """reference QuantizationFreezePass — flip activation quant ops to
+    test mode (frozen scales) for inference export."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, weight_quantize_type="abs_max"):
+        pass
+
+    def apply(self, program):
+        for op in program.global_block().ops:
+            if op.type.startswith("fake_quantize") and "is_test" in op.attrs:
+                op.attrs["is_test"] = True
+        return program

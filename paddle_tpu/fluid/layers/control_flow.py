@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import unique_name
 from ..core import VarDesc
 from ..framework import Variable, default_main_program
 from ..layer_helper import LayerHelper
@@ -334,8 +335,185 @@ def is_empty(x, cond=None):
 
 
 class StaticRNN:
+    """Fixed-length RNN over time-major input (reference:
+    control_flow.py StaticRNN:336 — the reference records a step sub-block
+    executed by the recurrent op; here the recorded step ops are UNROLLED
+    across time with per-step var renaming, which XLA then rolls back into
+    efficient code — compiler-friendly static control flow).
+
+    with rnn.step():
+        x_t = rnn.step_input(x)          # x: [T, batch, ...]
+        prev = rnn.memory(shape=[-1, H], batch_ref=x_t)
+        h = some_layers(x_t, prev)
+        rnn.update_memory(prev, h)
+        rnn.step_output(h)
+    out = rnn()                          # [T, batch, ...]
+    """
+
     def __init__(self, name=None):
-        raise NotImplementedError("StaticRNN: use layers.rnn / lax.scan path")
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._block = self.helper.main_program.current_block()
+        self._step_inputs = []     # (placeholder_var, source_var)
+        self._memories = []        # dicts: placeholder, init_name, link
+        self._step_outputs = []    # placeholder names
+        self._template = None
+        self._seq_len = None
+        self._outputs = None
+        self._in_step = False
+
+    # ------------------------------------------------------------- API
+    def step(self):
+        rnn = self
+
+        class _Guard:
+            def __enter__(self):
+                rnn._in_step = True
+                rnn._n0 = len(rnn._block.ops)
+                return rnn
+
+            def __exit__(self, *exc):
+                rnn._in_step = False
+                if exc[0] is None:
+                    rnn._complete()
+                return False
+        return _Guard()
+
+    def _check_in_step(self):
+        if not self._in_step:
+            raise ValueError("StaticRNN: call inside 'with rnn.step():'")
+
+    def step_input(self, x):
+        self._check_in_step()
+        if self._seq_len is None:
+            self._seq_len = int(x.shape[0])
+        elif int(x.shape[0]) != self._seq_len:
+            raise ValueError("StaticRNN: step inputs disagree on seq_len")
+        ph = self._block.create_var(
+            name=unique_name.generate("static_rnn_x"),
+            dtype=x.dtype, shape=tuple(x.shape[1:]))
+        self._step_inputs.append((ph, x))
+        return ph
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        self._check_in_step()
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError(
+                    "StaticRNN.memory: need init or (shape, batch_ref)")
+            from .tensor import fill_constant_batch_size_like
+            # build the init OUTSIDE the recorded template, referencing the
+            # SOURCE sequence var (a step placeholder has no runtime value;
+            # the source is time-major so its batch dim is ref_batch_dim_idx)
+            src_ref = batch_ref
+            dim_idx = 0
+            for ph2, src in self._step_inputs:
+                if ph2.name == batch_ref.name:
+                    src_ref = src
+                    dim_idx = ref_batch_dim_idx
+                    break
+            ops_before = self._block.ops[self._n0:]
+            del self._block.ops[self._n0:]
+            init = fill_constant_batch_size_like(
+                src_ref, [-1] + [int(s) for s in shape if s != -1],
+                "float32", init_value, input_dim_idx=dim_idx,
+                output_dim_idx=0)
+            init_ops = self._block.ops[self._n0:]
+            del self._block.ops[self._n0:]
+            self._block.ops[self._n0:self._n0] = init_ops
+            self._n0 += len(init_ops)
+            self._block.ops.extend(ops_before)
+        ph = self._block.create_var(
+            name=unique_name.generate("static_rnn_mem"),
+            dtype=init.dtype, shape=tuple(init.shape))
+        self._memories.append({"ph": ph.name, "init": init.name,
+                               "link": None})
+        return ph
+
+    def update_memory(self, mem, var):
+        self._check_in_step()
+        for m in self._memories:
+            if m["ph"] == mem.name:
+                m["link"] = var.name
+                return
+        raise ValueError("StaticRNN.update_memory: unknown memory")
+
+    def step_output(self, o):
+        self._check_in_step()
+        self._step_outputs.append(o.name)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    # --------------------------------------------------------- unrolling
+    def _complete(self):
+        block = self._block
+        template = block.ops[self._n0:]
+        del block.ops[self._n0:]
+        if self._seq_len is None:
+            raise ValueError("StaticRNN: no step_input given")
+        T = self._seq_len
+        from ..framework import Operator
+        collected = {name: [] for name in self._step_outputs}
+        mem_cur = {m["ph"]: m["init"] for m in self._memories}
+        for t in range(T):
+            rename = dict(mem_cur)
+            # slice step inputs: x[t]
+            for ph, src in self._step_inputs:
+                st = block.create_var(
+                    name=unique_name.generate(f"{ph.name}@{t}"),
+                    dtype=ph.dtype, shape=tuple(ph.shape))
+                block.append_op(
+                    type="slice", inputs={"Input": [src]},
+                    outputs={"Out": [st]},
+                    attrs={"axes": [0], "starts": [t], "ends": [t + 1],
+                           "decrease_axis": [0]})
+                rename[ph.name] = st.name
+            # clone template ops with per-step output renaming
+            for op in template:
+                new_out = {}
+                for slot, names in op.outputs.items():
+                    outs = []
+                    for n in names:
+                        nn = f"{n}@t{t}"
+                        src_v = block.vars.get(n)
+                        if src_v is not None and nn not in block.vars:
+                            block.create_var(name=nn, dtype=src_v.dtype,
+                                             shape=tuple(src_v.shape))
+                        rename[n] = nn
+                        outs.append(nn)
+                    new_out[slot] = outs
+                new_in = {slot: [rename.get(n, n) for n in names]
+                          for slot, names in op.inputs.items()}
+                block.ops.append(Operator(block, op.type, inputs=new_in,
+                                          outputs=new_out,
+                                          attrs=dict(op.attrs)))
+            for name in self._step_outputs:
+                collected[name].append(rename.get(name, name))
+            mem_cur = {m["ph"]: rename.get(m["link"], m["link"])
+                       for m in self._memories if m["link"]}
+        # stack step outputs back to [T, ...]
+        from .nn import stack
+        outs = []
+        for name in self._step_outputs:
+            vars_t = [block.vars[n] if n in block.vars else
+                      self._var_of(n) for n in collected[name]]
+            outs.append(stack(vars_t, axis=0))
+        self._outputs = outs
+
+    def _var_of(self, name):
+        v = self._block.vars.get(name)
+        if v is None:
+            raise KeyError(f"StaticRNN: var {name} missing")
+        return v
+
+    def __call__(self, *args):
+        if self._outputs is None:
+            raise ValueError("StaticRNN: use inside step() first")
+        if len(self._outputs) == 1:
+            return self._outputs[0]
+        return self._outputs
 
 
 class DynamicRNN:

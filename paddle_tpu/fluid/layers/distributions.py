@@ -60,16 +60,64 @@ class Normal(Distribution):
 
 
 class Categorical(Distribution):
+    """reference distributions.py Categorical — entropy + KL over logits."""
+
     def __init__(self, logits):
         self.logits = logits
 
+    def _probs(self):
+        from .nn import softmax
+        return softmax(self.logits)
+
     def entropy(self):
-        from .nn import softmax, reduce_sum
+        from .nn import reduce_sum
         from . import ops
-        p = softmax(self.logits)
+        p = self._probs()
         return 0.0 - reduce_sum(p * ops.log(p + 1e-10), dim=-1)
+
+    def kl_divergence(self, other):
+        from .nn import reduce_sum
+        from . import ops
+        p = self._probs()
+        q = other._probs()
+        return reduce_sum(p * (ops.log(p + 1e-10) - ops.log(q + 1e-10)),
+                          dim=-1)
 
 
 class MultivariateNormalDiag(Distribution):
+    """reference distributions.py MultivariateNormalDiag — diagonal-scale
+    gaussian; entropy + KL (scale is the [D, D] diagonal matrix like the
+    reference, only its diagonal participates)."""
+
     def __init__(self, loc, scale):
         self.loc, self.scale = loc, scale
+
+    def entropy(self):
+        import math as _m
+        from .nn import reduce_sum
+        from . import ops
+        # 0.5 * (D * (1 + log(2π)) + log det Σ), Σ = scale²
+        d = float(self.loc.shape[-1])
+        logdet = reduce_sum(ops.log(_diag_part(self.scale) + 1e-10), dim=-1)
+        return 0.5 * d * (1.0 + _m.log(2.0 * _m.pi)) + logdet
+
+    def kl_divergence(self, other):
+        from .nn import reduce_sum
+        from . import ops
+        s1 = _diag_part(self.scale)
+        s2 = _diag_part(other.scale)
+        var1, var2 = s1 * s1, s2 * s2
+        mu = other.loc - self.loc
+        return 0.5 * (reduce_sum(var1 / var2, dim=-1)
+                      + reduce_sum(mu * mu / var2, dim=-1)
+                      - float(self.loc.shape[-1])
+                      + 2.0 * (reduce_sum(ops.log(s2 + 1e-10), dim=-1)
+                               - reduce_sum(ops.log(s1 + 1e-10), dim=-1)))
+
+
+def _diag_part(mat):
+    """Diagonal of the trailing [D, D] block via elementwise mask-sum."""
+    from .nn import reduce_sum
+    from .tensor import eye
+    d = int(mat.shape[-1])
+    return reduce_sum(mat * eye(d, dtype=mat.dtype), dim=-1)

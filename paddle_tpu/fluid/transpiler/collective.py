@@ -1,0 +1,113 @@
+"""Collective program transpilers (reference:
+python/paddle/fluid/transpiler/collective.py — Collective:36,
+GradAllReduce:178 `_insert_allreduce_ops`:209, LocalSGD:270).
+
+Rewrites a single-process training program for multi-worker collective
+training by inserting c_* ops. On TPU the FAST path is mesh sharding
+(parallel/ — XLA inserts the collectives); this transpiler exists for
+wire-level parity so reference-style transpiled programs still build and
+execute: ring_id maps to a named mesh axis, c_allreduce_sum to lax.psum
+(ops/collective_ops.py), and on a single chip the collectives are
+identities."""
+from __future__ import annotations
+
+from ..backward import OP_ROLE_OPTIMIZE
+
+OP_ROLE_KEY = "op_role"
+
+
+class Collective:
+    """Base (reference transpiler/collective.py:36)."""
+
+    def __init__(self, nrings=1):
+        self.nrings = nrings
+        self.endpoints = None
+        self.current_endpoint = None
+        self.rank = 0
+        self.nranks = 1
+
+    def transpile(self, startup_program, main_program, rank, endpoints,
+                  current_endpoint, wait_port=True):
+        self.startup_program = startup_program
+        self.main_program = main_program
+        self.rank = rank
+        self.endpoints = (endpoints.split(",")
+                          if isinstance(endpoints, str) else list(endpoints))
+        self.current_endpoint = current_endpoint
+        self.nranks = len(self.endpoints)
+        self._transpile_startup_program()
+        self._transpile_main_program()
+        return self
+
+    # ------------------------------------------------------------------
+    def _transpile_startup_program(self):
+        block = self.startup_program.global_block()
+        for ring_id in range(self.nrings):
+            block.append_op(
+                type="c_comm_init_all", inputs={}, outputs={},
+                attrs={"ring_id": ring_id, "devices": [],
+                       "rank": self.rank, "nranks": self.nranks,
+                       "endpoints": self.endpoints})
+
+    def _transpile_main_program(self):
+        raise NotImplementedError
+
+    def _insert_allreduce(self, block, idx, var_name, ring_id):
+        block._insert_op(
+            idx, type="c_allreduce_sum",
+            inputs={"X": [var_name]}, outputs={"Out": [var_name]},
+            attrs={"ring_id": ring_id, "use_calc_stream": True,
+                   OP_ROLE_KEY: 1})
+
+
+class GradAllReduce(Collective):
+    """Sum-allreduce every grad before its optimizer op, scale by 1/nranks
+    (reference :178)."""
+
+    def __init__(self, nrings=1):
+        super().__init__(nrings)
+
+    def _transpile_main_program(self):
+        block = self.main_program.global_block()
+        ring = 0
+        # find (grad var, first optimizer-op index) pairs
+        grads = []
+        for i, op in enumerate(block.ops):
+            if op.attrs.get(OP_ROLE_KEY) == OP_ROLE_OPTIMIZE and \
+                    op.attrs.get("op_role_var"):
+                grads.append((op.attrs["op_role_var"][1], i))
+        inserted = 0
+        for g, i in grads:
+            idx = i + inserted
+            block._insert_op(
+                idx, type="scale", inputs={"X": [g]}, outputs={"Out": [g]},
+                attrs={"scale": 1.0 / self.nranks, OP_ROLE_KEY: 1})
+            self._insert_allreduce(block, idx, g, ring)
+            inserted += 2
+            ring = (ring + 1) % self.nrings
+
+
+class LocalSGD(Collective):
+    """Periodic parameter averaging instead of per-step grad allreduce
+    (reference :270): params snapshot before optimize, delta averaged
+    across workers every step (the reference's k_steps pacing is driven by
+    the caller)."""
+
+    def _transpile_main_program(self):
+        block = self.main_program.global_block()
+        params = []
+        for op in block.ops:
+            if op.attrs.get(OP_ROLE_KEY) == OP_ROLE_OPTIMIZE and \
+                    op.attrs.get("op_role_var"):
+                params.append(op.attrs["op_role_var"][0])
+        ring = 0
+        for p in dict.fromkeys(params):
+            block.append_op(
+                type="scale", inputs={"X": [p]}, outputs={"Out": [p]},
+                attrs={"scale": 1.0 / self.nranks, OP_ROLE_KEY: 2})
+            block.append_op(
+                type="c_allreduce_sum", inputs={"X": [p]},
+                outputs={"Out": [p]},
+                attrs={"ring_id": ring, "use_calc_stream": True,
+                       OP_ROLE_KEY: 2})
+            ring = (ring + 1) % self.nrings

@@ -1,0 +1,57 @@
+"""paddle.nn 2.0-preview namespace (reference: python/paddle/nn/
+__init__.py:18-37 — thin torch-style aliases over fluid ops/layers;
+python/paddle/nn/functional/ re-exports the functional forms)."""
+from __future__ import annotations
+
+# layers (classes) — the dygraph module library
+from .fluid.dygraph.nn import (Conv2D, Conv3D, Pool2D, Linear, BatchNorm,
+                               Dropout, Embedding, LayerNorm, GRUUnit,
+                               InstanceNorm, PRelu, BilinearTensorProduct,
+                               Conv2DTranspose, GroupNorm, SpectralNorm)
+from .fluid.dygraph.layers import Layer
+from .fluid.dygraph.container import Sequential, LayerList, ParameterList
+
+# functional
+from .fluid import layers as _L
+
+functional = _L
+
+relu = _L.relu
+sigmoid = _L.sigmoid
+tanh = _L.tanh
+softmax = _L.softmax
+log_softmax = getattr(_L, "log_softmax", None)
+elu = _L.elu
+gelu = _L.gelu
+leaky_relu = _L.leaky_relu
+relu6 = _L.relu6
+selu = _L.selu
+hard_sigmoid = _L.hard_sigmoid
+hard_swish = _L.hard_swish
+swish = _L.swish
+conv2d = _L.conv2d
+conv3d = _L.conv3d
+pool2d = _L.pool2d
+pool3d = _L.pool3d
+batch_norm = _L.batch_norm
+layer_norm = _L.layer_norm
+instance_norm = _L.instance_norm
+group_norm = _L.group_norm
+dropout = _L.dropout
+embedding = _L.embedding
+one_hot = _L.one_hot
+cross_entropy = _L.cross_entropy
+mse_loss = _L.mse_loss
+nce = _L.nce
+pad = _L.pad
+pad2d = _L.pad2d
+grid_sampler = _L.grid_sampler
+pixel_shuffle = _L.pixel_shuffle
+interpolate = getattr(_L, "image_resize", None)
+
+__all__ = [
+    "Layer", "Sequential", "LayerList", "ParameterList", "Conv2D", "Conv3D",
+    "Pool2D", "Linear", "BatchNorm", "Dropout", "Embedding", "LayerNorm",
+    "GRUUnit", "InstanceNorm", "PRelu", "BilinearTensorProduct",
+    "Conv2DTranspose", "GroupNorm", "SpectralNorm", "functional",
+]

@@ -866,3 +866,20 @@ def _auc(ins, attrs):
     return out(AUC=jnp.asarray([auc_val], jnp.float64),
                StatPosOut=jnp.asarray(stat_pos),
                StatNegOut=jnp.asarray(stat_neg))
+
+
+# sync_batch_norm (reference: operators/sync_batch_norm_op.cu + the
+# sync_batch_norm BuildStrategy flag, pybind.cc:2266): the reference
+# hand-inserts NCCL allreduces of batch statistics. Here the batch is
+# SHARDED over the dp mesh axis inside ONE jitted computation, so the
+# kernel's plain batch-axis mean/var reductions are already global — XLA
+# inserts the cross-replica psum. Same kernel as batch_norm, by design.
+register_op("sync_batch_norm",
+            inputs=("X", "Scale", "Bias", "Mean", "Variance",
+                    "MomentumTensor"),
+            diff_inputs=("X", "Scale", "Bias"),
+            attr_defaults={"momentum": 0.9, "epsilon": 1e-5,
+                           "data_layout": "NCHW", "is_test": False,
+                           "use_global_stats": False,
+                           "trainable_statistics": False,
+                           "fuse_with_relu": False})(_batch_norm)

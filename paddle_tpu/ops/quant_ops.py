@@ -1,0 +1,154 @@
+"""Fake-quantization ops for QAT and post-training quant (reference:
+paddle/fluid/operators/fake_quantize_op.cc — fake_quantize_abs_max,
+fake_quantize_moving_average_abs_max, fake_quantize_range_abs_max,
+fake_channel_wise_quantize_abs_max, fake_dequantize_max_abs,
+fake_quantize_dequantize_moving_average_abs_max).
+
+Quant math: scale = max|x| (per tensor or channel); q = round(x / scale *
+(2^(bits-1) - 1)), clipped; dequant multiplies back. Gradients are
+straight-through (identity within range) via custom grad makers — the jit
+fuses the whole quant-dequant pair into the surrounding computation."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, register_grad_maker, first, out
+
+
+def _qrange(bits):
+    return float((1 << (int(bits) - 1)) - 1)
+
+
+def _ste_grad_maker(op_type, x_slot="X", out_slot="Out"):
+    @register_grad_maker(op_type)
+    def _maker(op, grad_map, _x=x_slot, _o=out_slot):
+        g_out = grad_map.get(op.output(_o)[0])
+        g_in = grad_map.get(op.input(_x)[0])
+        if not g_out or not g_in or "@EMPTY@" in (g_out, g_in):
+            return None
+        return [{"type": "assign", "inputs": {"X": [g_out]},
+                 "outputs": {"Out": [g_in]}, "attrs": {}}]
+    return _maker
+
+
+@register_op("fake_quantize_abs_max", diff_inputs=["X"],
+             attr_defaults={"bit_length": 8})
+def _fake_quantize_abs_max(ins, attrs):
+    x = first(ins, "X")
+    r = _qrange(attrs.get("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    s = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x / s * r), -r, r)
+    return out(Out=q, OutScale=scale.reshape(1))
+
+
+_ste_grad_maker("fake_quantize_abs_max")
+
+
+@register_op("fake_dequantize_max_abs", diff_inputs=["X"],
+             attr_defaults={"max_range": 127.0})
+def _fake_dequantize_max_abs(ins, attrs):
+    x = first(ins, "X")
+    scale = first(ins, "Scale")
+    return out(Out=x * scale.reshape(()) / float(attrs["max_range"]))
+
+
+@register_op("fake_quantize_dequantize_abs_max", diff_inputs=["X"],
+             attr_defaults={"bit_length": 8})
+def _fake_qdq_abs_max(ins, attrs):
+    x = first(ins, "X")
+    r = _qrange(attrs.get("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    s = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x / s * r), -r, r)
+    return out(Out=q * s / r, OutScale=scale.reshape(1))
+
+
+_ste_grad_maker("fake_quantize_dequantize_abs_max")
+
+
+@register_op("fake_quantize_moving_average_abs_max", diff_inputs=["X"],
+             attr_defaults={"bit_length": 8, "moving_rate": 0.9,
+                            "is_test": False})
+def _fake_quant_moving(ins, attrs):
+    x = first(ins, "X")
+    in_scale = first(ins, "InScale")
+    r = _qrange(attrs.get("bit_length", 8))
+    cur = jnp.max(jnp.abs(x))
+    if attrs.get("is_test", False):
+        scale = in_scale.reshape(())
+    else:
+        m = attrs.get("moving_rate", 0.9)
+        prev = in_scale.reshape(())
+        scale = jnp.where(prev > 0, m * prev + (1 - m) * cur, cur)
+    s = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x / s * r), -r, r)
+    return out(Out=q, OutScale=scale.reshape(1))
+
+
+_ste_grad_maker("fake_quantize_moving_average_abs_max")
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             diff_inputs=["X"],
+             attr_defaults={"bit_length": 8, "moving_rate": 0.9,
+                            "is_test": False})
+def _fake_qdq_moving(ins, attrs):
+    x = first(ins, "X")
+    in_scale = first(ins, "InScale")
+    r = _qrange(attrs.get("bit_length", 8))
+    cur = jnp.max(jnp.abs(x))
+    if attrs.get("is_test", False) and in_scale is not None:
+        scale = in_scale.reshape(())
+    elif in_scale is not None:
+        m = attrs.get("moving_rate", 0.9)
+        prev = in_scale.reshape(())
+        scale = jnp.where(prev > 0, m * prev + (1 - m) * cur, cur)
+    else:
+        scale = cur
+    s = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x / s * r), -r, r)
+    return out(Out=q * s / r, OutScale=scale.reshape(1))
+
+
+_ste_grad_maker("fake_quantize_dequantize_moving_average_abs_max")
+
+
+@register_op("fake_channel_wise_quantize_abs_max", diff_inputs=["X"],
+             attr_defaults={"bit_length": 8, "quant_axis": 0})
+def _fake_channel_quant(ins, attrs):
+    x = first(ins, "X")
+    r = _qrange(attrs.get("bit_length", 8))
+    ax = int(attrs.get("quant_axis", 0))
+    red = tuple(i for i in range(x.ndim) if i != ax)
+    scale = jnp.max(jnp.abs(x), axis=red)
+    shape = [1] * x.ndim
+    shape[ax] = -1
+    s = jnp.where(scale > 0, scale, 1.0).reshape(shape)
+    q = jnp.clip(jnp.round(x / s * r), -r, r)
+    return out(Out=q, OutScale=scale)
+
+
+_ste_grad_maker("fake_channel_wise_quantize_abs_max")
+
+
+@register_op("fake_quantize_range_abs_max", diff_inputs=["X"],
+             attr_defaults={"bit_length": 8, "window_size": 10000,
+                            "is_test": False})
+def _fake_quant_range(ins, attrs):
+    x = first(ins, "X")
+    in_scale = first(ins, "InScale")
+    r = _qrange(attrs.get("bit_length", 8))
+    cur = jnp.max(jnp.abs(x))
+    scale = (in_scale.reshape(()) if attrs.get("is_test", False)
+             and in_scale is not None
+             else (jnp.maximum(cur, in_scale.reshape(()))
+                   if in_scale is not None else cur))
+    s = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x / s * r), -r, r)
+    return out(Out=q, OutScale=scale.reshape(1))
+
+
+_ste_grad_maker("fake_quantize_range_abs_max")

@@ -1,0 +1,88 @@
+"""paddle.tensor 2.0-preview namespace (reference: python/paddle/tensor/ —
+creation/linalg/math/manipulation/search re-exports of fluid ops)."""
+from __future__ import annotations
+
+from .fluid import layers as _L
+
+# creation
+ones = _L.ones
+zeros = _L.zeros
+ones_like = _L.ones_like
+zeros_like = _L.zeros_like
+fill_constant = _L.fill_constant
+full = getattr(_L, "full", None)
+arange = _L.range
+linspace = _L.linspace
+eye = _L.eye
+diag = _L.diag
+
+# math
+add = _L.elementwise_add
+subtract = _L.elementwise_sub
+multiply = _L.elementwise_mul
+divide = _L.elementwise_div
+pow = _L.pow
+sqrt = _L.sqrt
+exp = _L.exp
+log = _L.log
+abs = _L.abs
+sign = _L.sign
+floor = _L.floor
+ceil = _L.ceil
+round = _L.round
+sin = _L.sin
+cos = _L.cos
+tanh = _L.tanh
+sum = _L.reduce_sum
+mean = _L.reduce_mean
+max = _L.reduce_max
+min = _L.reduce_min
+prod = _L.reduce_prod
+cumsum = _L.cumsum
+clip = _L.clip
+logsumexp = getattr(_L, "logsumexp", None)
+kron = getattr(_L, "kron", None)
+trace = getattr(_L, "trace", None)
+
+# linalg
+matmul = _L.matmul
+bmm = getattr(_L, "bmm", None)
+dot = getattr(_L, "dot", None)
+dist = getattr(_L, "dist", None)
+norm = getattr(_L, "l2_normalize", None)
+cholesky = getattr(_L, "cholesky", None)
+cross = getattr(_L, "cross", None)
+inverse = getattr(_L, "inverse", None)
+
+# manipulation
+concat = _L.concat
+stack = _L.stack
+unstack = _L.unstack
+split = _L.split
+squeeze = _L.squeeze
+unsqueeze = _L.unsqueeze
+reshape = _L.reshape
+transpose = _L.transpose
+flip = getattr(_L, "flip", None)
+roll = getattr(_L, "roll", None)
+gather = _L.gather
+gather_nd = _L.gather_nd
+scatter = _L.scatter
+slice = _L.slice
+strided_slice = _L.strided_slice
+expand = _L.expand
+tile = getattr(_L, "tile", None)
+flatten = _L.flatten
+unbind = getattr(_L, "unbind", None)
+unique = _L.unique
+where = _L.where
+meshgrid = getattr(_L, "meshgrid", None)
+
+# search / sort
+argmax = getattr(_L, "argmax", None)
+argmin = getattr(_L, "argmin", None)
+argsort = _L.argsort
+topk = _L.topk
+index_select = getattr(_L, "index_select", None)
+index_sample = getattr(_L, "index_sample", None)
+nonzero = getattr(_L, "where_index", None)

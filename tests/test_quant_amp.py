@@ -1,0 +1,120 @@
+"""QAT + AMP + collective-transpiler + sync-BN tests (reference:
+tests/unittests/test_quantization_pass.py, test_fake_quantize_op.py,
+contrib/tests/test_image_classification_fp16.py,
+test_sync_batch_norm_op.py)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from tests.test_sequence_ops import run_seq_op
+
+
+def test_fake_quantize_abs_max_levels():
+    x = np.array([[0.5, -1.0, 0.25]], np.float32)
+    (q, s), _ = run_seq_op("fake_quantize_abs_max", x, None,
+                           attrs={"bit_length": 8},
+                           outputs=("Out", "OutScale"))
+    assert s[0] == 1.0
+    np.testing.assert_allclose(q, np.round(x * 127), atol=0)
+
+
+def test_fake_quant_dequant_roundtrip_error_bound():
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 32).astype(np.float32)
+    (qdq, s), _ = run_seq_op("fake_quantize_dequantize_abs_max", x, None,
+                             attrs={"bit_length": 8},
+                             outputs=("Out", "OutScale"))
+    # quantization error bounded by scale/127/2 per element
+    assert np.abs(qdq - x).max() <= s[0] / 127.0 * 0.5 + 1e-6
+
+
+def test_qat_program_trains():
+    from paddle_tpu.fluid.contrib.slim.quantization import (
+        QuantizationTransformPass)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[8], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+    # quantize BEFORE building the backward, like the reference QAT flow
+    with fluid.program_guard(main, startup):
+        QuantizationTransformPass().apply(main, startup)
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    qtypes = [op.type for op in main.global_block().ops]
+    assert "fake_quantize_dequantize_abs_max" in qtypes
+    assert "fake_quantize_dequantize_moving_average_abs_max" in qtypes
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    X = rng.rand(16, 8).astype("float32")
+    Y = rng.randint(0, 4, (16, 1)).astype("int64")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(20):
+            (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_amp_decorate_trains_bf16():
+    from paddle_tpu.fluid.contrib.mixed_precision import decorate
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[8], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+        opt = decorate(fluid.optimizer.Adam(0.05))
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(1)
+    X = rng.rand(16, 8).astype("float32")
+    Y = rng.randint(0, 4, (16, 1)).astype("int64")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        first = last = None
+        for _ in range(15):
+            (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            v = float(np.asarray(lv).reshape(-1)[0])
+            first = first if first is not None else v
+            last = v
+    assert last < first
+
+
+def test_sync_batch_norm_same_as_batch_norm_single_chip():
+    x = np.random.RandomState(2).rand(4, 3, 2, 2).astype(np.float32)
+    args = dict(
+        extra_inputs=[("Scale", np.ones(3, np.float32), None),
+                      ("Bias", np.zeros(3, np.float32), None),
+                      ("Mean", np.zeros(3, np.float32), None),
+                      ("Variance", np.ones(3, np.float32), None)],
+        attrs={"is_test": False, "epsilon": 1e-5},
+        outputs=("Y",))
+    (a,), _ = run_seq_op("batch_norm", x, None, x_slot="X", **args)
+    (b,), _ = run_seq_op("sync_batch_norm", x, None, x_slot="X", **args)
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_collective_transpiler_grad_allreduce():
+    from paddle_tpu.fluid.transpiler.collective import GradAllReduce
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    GradAllReduce().transpile(startup, main, rank=0,
+                              endpoints="127.0.0.1:1,127.0.0.1:2",
+                              current_endpoint="127.0.0.1:1")
+    types = [op.type for op in main.global_block().ops]
+    assert "c_allreduce_sum" in types
+    assert "scale" in types
+    assert "c_comm_init_all" in [op.type for op in
+                                 startup.global_block().ops]

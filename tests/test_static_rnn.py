@@ -1,0 +1,68 @@
+"""StaticRNN unroll tests (reference: tests/unittests/
+test_recurrent_op.py / StaticRNN usage in test_rnn_memory_helper_op)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+
+
+def test_static_rnn_cumsum_semantics():
+    """mem' = mem + x_t → outputs are the running prefix sums."""
+    T, B, D = 4, 2, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[T, B, D], dtype="float32",
+                       append_batch_size=False)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            mem = rnn.memory(shape=[-1, D], batch_ref=x_t)
+            acc = fluid.layers.elementwise_add(mem, x_t)
+            rnn.update_memory(mem, acc)
+            rnn.step_output(acc)
+        out = rnn()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    X = np.random.RandomState(0).rand(T, B, D).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (o,) = exe.run(main, feed={"x": X}, fetch_list=[out])
+    np.testing.assert_allclose(o, np.cumsum(X, axis=0), rtol=1e-5)
+
+
+def test_static_rnn_with_fc_trains():
+    T, B, D, H = 3, 4, 5, 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[T, B, D], dtype="float32",
+                       append_batch_size=False)
+        y = fluid.data("y", shape=[B, 1], dtype="int64",
+                       append_batch_size=False)
+        w = fluid.ParamAttr(name="rnn_fc_w")
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(shape=[-1, H], batch_ref=x_t)
+            cat = fluid.layers.concat([x_t, h_prev], axis=1)
+            h = fluid.layers.fc(cat, H, act="tanh", param_attr=w,
+                                bias_attr=False)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        seq = rnn()                     # [T, B, H]
+        last = fluid.layers.slice(seq, axes=[0], starts=[T - 1], ends=[T])
+        last = fluid.layers.squeeze(last, [0])
+        pred = fluid.layers.fc(last, 3, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(1)
+    X = rng.rand(T, B, D).astype("float32")
+    Y = rng.randint(0, 3, (B, 1)).astype("int64")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(20):
+            (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.5, losses
