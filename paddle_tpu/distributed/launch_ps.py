@@ -1,0 +1,78 @@
+"""Local parameter-server bringup — spawns pserver + trainer processes on
+one host (reference: python/paddle/distributed/launch_ps.py; cloud_utils).
+
+    python -m paddle_tpu.distributed.launch_ps \
+        --worker_num 2 --server_num 2 train.py [args...]
+
+Each child gets the PADDLE_* env contract the fleet role makers read
+(reference role_maker.py PaddleCloudRoleMaker:442): TRAINING_ROLE,
+PADDLE_PORT/PADDLE_PSERVERS_IP_PORT_LIST for servers,
+PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM for workers."""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def launch_ps():
+    parser = argparse.ArgumentParser("launch_ps")
+    parser.add_argument("--worker_num", type=int, default=2)
+    parser.add_argument("--server_num", type=int, default=2)
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+
+    ports = _free_ports(args.server_num)
+    server_eps = ",".join(f"127.0.0.1:{p}" for p in ports)
+    procs = []
+    base_env = dict(os.environ,
+                    PADDLE_PSERVERS_IP_PORT_LIST=server_eps,
+                    PADDLE_TRAINERS_NUM=str(args.worker_num))
+    cmd = [sys.executable, args.training_script] + args.training_script_args
+    for i, p in enumerate(ports):
+        env = dict(base_env, TRAINING_ROLE="PSERVER", PADDLE_PORT=str(p),
+                   POD_IP="127.0.0.1", PADDLE_TRAINER_ID=str(i))
+        procs.append(subprocess.Popen(cmd, env=env))
+    for i in range(args.worker_num):
+        env = dict(base_env, TRAINING_ROLE="TRAINER",
+                   PADDLE_TRAINER_ID=str(i))
+        procs.append(subprocess.Popen(cmd, env=env))
+    # watch like launch.py: poll ALL children so a crash in any trainer
+    # tears the pod down even while its peers block in a barrier
+    # (reference launch.py:219 watch loop)
+    import time
+    trainers = procs[args.server_num:]
+    rc = 0
+    try:
+        while True:
+            codes = [p.poll() for p in trainers]
+            if any(c not in (None, 0) for c in codes):
+                rc = next(c for c in codes if c not in (None, 0))
+                break
+            if all(c == 0 for c in codes):
+                break
+            time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    launch_ps()

@@ -1,0 +1,75 @@
+"""User-side data generator protocol for Dataset ingestion (reference:
+python/paddle/fluid/incubate/data_generator/__init__.py —
+DataGenerator:20, MultiSlotDataGenerator; emits the slot text format the
+native feed engine parses, paddle_tpu/native/datafeed.cpp)."""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    # user overrides -----------------------------------------------------
+    def generate_sample(self, line):
+        """Returns a generator of [(slot_name, [values]), ...] per line."""
+        raise NotImplementedError(
+            "implement generate_sample(self, line) in your subclass")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # runtime ------------------------------------------------------------
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        self._run(sys.stdin)
+
+    def run_from_memory(self):
+        self._run([None])
+
+    def _run(self, lines):
+        # accumulate batch_size_ samples, route each full batch through
+        # generate_batch (user hook for per-batch pad/shuffle/merge), then
+        # serialize — the reference DataGenerator contract
+        batch = []
+        for line in lines:
+            for sample in self.generate_sample(line)():
+                batch.append(sample)
+                if len(batch) >= self.batch_size_:
+                    self._flush(batch)
+                    batch = []
+        if batch:
+            self._flush(batch)
+
+    def _flush(self, samples):
+        for sample in self.generate_batch(samples)():
+            sys.stdout.write(self._gen_str(sample))
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Emits `<n> v1 .. vn` per slot, space-joined (the MultiSlotDataFeed
+    wire grammar — reference data_feed.cc CheckFile)."""
+
+    def _gen_str(self, sample):
+        parts = []
+        for _name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    pass

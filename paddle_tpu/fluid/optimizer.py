@@ -35,6 +35,7 @@ __all__ = [
     "AdadeltaOptimizer", "ModelAverage", "LarsMomentum",
     "LarsMomentumOptimizer", "LambOptimizer", "ExponentialMovingAverage",
     "PipelineOptimizer", "LookaheadOptimizer", "RecomputeOptimizer",
+    "DGCMomentumOptimizer", "DGCMomentum", "Lookahead", "Lamb",
 ]
 
 
@@ -699,12 +700,95 @@ class RecomputeOptimizer(Optimizer):
 
 
 class LookaheadOptimizer:
+    """reference optimizer.py:4138 — slow weights track fast weights every
+    k steps: slow += alpha * (fast - slow); fast := slow. Implemented as
+    extra graph ops gated on a step counter (k is compiled in; XLA folds
+    the cond into a select)."""
+
     def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
         self.inner_optimizer = inner_optimizer
         self.alpha = alpha
-        self.k = k
-        raise NotImplementedError(
-            "LookaheadOptimizer: pending (round-2 aux-optimizer batch)")
+        self.k = int(k)
+
+    def minimize(self, loss, startup_program=None):
+        import paddle_tpu.fluid.layers as L
+        mini_out = self.inner_optimizer.minimize(
+            loss, startup_program=startup_program)
+        main = loss.block.program
+        startup = startup_program or default_startup_program()
+        with program_guard(main, startup):
+            self._append_lookahead_ops(main, startup, L)
+        return mini_out
+
+    def _append_lookahead_ops(self, main, startup, L):
+        block = main.global_block()
+        # step counter (integer: an fp32 counter stops incrementing at 2^24)
+        step = block.create_var(name=unique_name.generate("lookahead_step"),
+                                shape=(1,), persistable=True,
+                                dtype=VarDesc.VarType.INT64)
+        sv = startup.global_block().create_var(
+            name=step.name, shape=(1,), persistable=True,
+            dtype=VarDesc.VarType.INT64)
+        Constant(0)(sv, startup.global_block())
+        block.append_op(type="increment", inputs={"X": [step]},
+                        outputs={"Out": [step]}, attrs={"step": 1.0})
+        # every k steps blend slow/fast
+        kmod = L.elementwise_mod(step, L.fill_constant([1], "int64", self.k))
+        is_sync = L.cast(L.equal(kmod, L.fill_constant([1], "int64", 0)),
+                         "float32")
+        for param in main.all_parameters():
+            slow = block.create_var(
+                name=unique_name.generate(param.name + "_slow"),
+                shape=param.shape, persistable=True, dtype=param.dtype)
+            ssv = startup.global_block().create_var(
+                name=slow.name, shape=param.shape, persistable=True,
+                dtype=param.dtype)
+            # slow starts equal to the param's init
+            startup.global_block().append_op(
+                type="assign", inputs={"X": [param.name]},
+                outputs={"Out": [ssv]})
+            blended = L.elementwise_add(
+                slow, L.elementwise_mul(
+                    L.elementwise_sub(param, slow),
+                    L.fill_constant([1], "float32", self.alpha)))
+            new_slow = L.elementwise_add(
+                L.elementwise_mul(blended, is_sync),
+                L.elementwise_mul(slow, 1.0 - is_sync))
+            new_fast = L.elementwise_add(
+                L.elementwise_mul(blended, is_sync),
+                L.elementwise_mul(param, 1.0 - is_sync))
+            block.append_op(type="assign", inputs={"X": [new_slow]},
+                            outputs={"Out": [slow]})
+            block.append_op(type="assign", inputs={"X": [new_fast]},
+                            outputs={"Out": [param]})
+
+
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """reference optimizer.py:1071 — deep gradient compression momentum.
+    The reference top-k sparsifies grads to save NCCL bandwidth
+    (operators/dgc_op.cc + SparseAllReduceOpHandle). On TPU the grad
+    reduction rides ICI inside the jitted step where bandwidth is not the
+    bottleneck, so this optimizer preserves the API/momentum semantics and
+    the rampup knobs; compression itself is intentionally a no-op (the
+    reference behavior below rampup_begin_step)."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step,
+                 rampup_step=1, sparsity=None, parameter_list=None,
+                 use_nesterov=False, local_grad_clip_norm=None,
+                 num_trainers=None, regularization=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, momentum,
+                         parameter_list=parameter_list,
+                         use_nesterov=use_nesterov,
+                         regularization=regularization,
+                         grad_clip=grad_clip, name=name)
+        self._rampup_begin_step = rampup_begin_step
+        self._rampup_step = rampup_step
+        self._sparsity = sparsity or [0.999]
 
 
 SGD = SGDOptimizer
@@ -719,3 +803,5 @@ RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 LarsMomentum = LarsMomentumOptimizer
 Lamb = LambOptimizer
+DGCMomentum = DGCMomentumOptimizer
+Lookahead = LookaheadOptimizer

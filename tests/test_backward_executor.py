@@ -91,6 +91,31 @@ def test_all_optimizers_step(opt_name):
             assert float(lv[0]) != l0 and float(lv[0]) < l0 * 3
 
 
+def test_lookahead_and_dgc_momentum():
+    """Lookahead (reference optimizer.py:4138) + DGCMomentum (:1071)."""
+    np.random.seed(7)
+    for make in (lambda: fluid.optimizer.LookaheadOptimizer(
+                     fluid.optimizer.SGD(0.3), alpha=0.5, k=3),
+                 lambda: fluid.optimizer.DGCMomentumOptimizer(
+                     0.1, momentum=0.9, rampup_begin_step=0)):
+        main, startup, x, label, loss = _build_mlp()
+        with program_guard(main, startup):
+            make().minimize(loss)
+        scope = core.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            X = np.random.rand(64, 8).astype("float32")
+            Y = np.random.randint(0, 4, (64, 1)).astype("int64")
+            l0 = None
+            for _ in range(7):
+                lv, = exe.run(main, feed={"x": X, "y": Y},
+                              fetch_list=[loss])
+                if l0 is None:
+                    l0 = float(lv[0])
+            assert np.isfinite(lv[0]) and float(lv[0]) < l0
+
+
 def test_interpreted_matches_compiled():
     """The eager interpreter is the correctness oracle for the jit path."""
     np.random.seed(3)
