@@ -34,7 +34,16 @@ def _nyi(name):
 roi_perspective_transform = _nyi("roi_perspective_transform")
 generate_proposal_labels = _nyi("generate_proposal_labels")
 generate_mask_labels = _nyi("generate_mask_labels")
-polygon_box_transform = _nyi("polygon_box_transform")
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type="polygon_box_transform",
+                     inputs={"Input": [input]},
+                     outputs={"Output": [out]})
+    return out
 locality_aware_nms = _nyi("locality_aware_nms")
 retinanet_detection_output = _nyi("retinanet_detection_output")
 retinanet_target_assign = _nyi("retinanet_target_assign")

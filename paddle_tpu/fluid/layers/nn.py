@@ -38,6 +38,13 @@ __all__ = [
     "row_conv", "autoincreased_step_counter", "unbind", "roll",
     "index_select", "index_sample", "temporal_shift", "spectral_norm",
     "random_crop", "mean_iou", "dice_loss",
+    "linear_chain_crf", "crf_decoding", "cos_sim", "lrn",
+    "pad_constant_like", "roi_pool", "roi_align", "scale",
+    "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
+    "sampling_id", "shuffle_channel", "adaptive_pool3d", "inplace_abn",
+    "conv3d_transpose", "resize_trilinear", "image_resize_short",
+    "affine_grid", "psroi_pool", "prroi_pool", "deformable_conv",
+    "deformable_roi_pooling",
 ]
 
 
@@ -1186,11 +1193,22 @@ def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
 
 
 def similarity_focus(input, axis, indexes, name=None):
-    raise NotImplementedError("similarity_focus: rarely-used; pending")
+    helper = LayerHelper("similarity_focus", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type="similarity_focus", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axis": axis, "indexes": list(indexes)})
+    return out
 
 
 def hash(input, hash_size, num_hash=1, name=None):
-    raise NotImplementedError("hash op pending host-side impl")
+    helper = LayerHelper("hash", **locals())
+    out = helper.create_variable_for_type_inference(VarDesc.VarType.INT64)
+    helper.append_op(type="hash", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"mod_by": hash_size, "num_hash": num_hash})
+    return out
 
 
 def log_loss(input, label, epsilon=1e-4, name=None):
@@ -1614,3 +1632,391 @@ def dice_loss(input, label, epsilon=1e-5):
         label, dim=reduce_dims)
     dice_score = 1 - inse * 2 / (dice_denominator + epsilon)
     return mean(dice_score)
+
+
+# --------------------------------------------------------------------------
+# batch-2 wrappers (vision/misc ops — reference layers/nn.py same names)
+# --------------------------------------------------------------------------
+def cos_sim(X, Y):
+    helper = LayerHelper("cos_sim", **locals())
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xnorm = helper.create_variable_for_type_inference(X.dtype)
+    ynorm = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op(type="cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xnorm],
+                              "YNorm": [ynorm]})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    helper = LayerHelper("lrn", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    helper.append_op(type="lrn", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta,
+                            "data_format": data_format})
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    helper = LayerHelper("pad_constant_like", **locals())
+    out = helper.create_variable_for_type_inference(y.dtype)
+    out.shape = x.shape
+    helper.append_op(type="pad_constant_like",
+                     inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
+                     attrs={"pad_value": float(pad_value)})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_lod=None):
+    helper = LayerHelper("roi_pool", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmax = helper.create_variable_for_type_inference(VarDesc.VarType.INT32)
+    out.shape = (-1, input.shape[1], pooled_height, pooled_width)
+    helper.append_op(type="roi_pool",
+                     inputs={"X": [input], "ROIs": [rois]},
+                     outputs={"Out": [out], "Argmax": [argmax]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None,
+              rois_lod=None):
+    helper = LayerHelper("roi_align", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = (-1, input.shape[1], pooled_height, pooled_width)
+    helper.append_op(type="roi_align",
+                     inputs={"X": [input], "ROIs": [rois]},
+                     outputs={"Out": [out]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale,
+                            "sampling_ratio": sampling_ratio})
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    helper = LayerHelper("scale", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="scale", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"scale": float(scale), "bias": float(bias),
+                            "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random_batch_size_like", **locals())
+    out = helper.create_variable_for_type_inference(
+        convert_np_dtype_to_dtype_(dtype))
+    helper.append_op(type="uniform_random_batch_size_like",
+                     inputs={"Input": [input]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "min": float(min),
+                            "max": float(max), "seed": seed,
+                            "dtype": convert_np_dtype_to_dtype_(dtype),
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random_batch_size_like", **locals())
+    out = helper.create_variable_for_type_inference(
+        convert_np_dtype_to_dtype_(dtype))
+    helper.append_op(type="gaussian_random_batch_size_like",
+                     inputs={"Input": [input]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "mean": float(mean),
+                            "std": float(std), "seed": seed,
+                            "dtype": convert_np_dtype_to_dtype_(dtype),
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("sampling_id", **locals())
+    out = helper.create_variable_for_type_inference(VarDesc.VarType.INT64)
+    helper.append_op(type="sampling_id", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"min": min, "max": max, "seed": seed})
+    return out
+
+
+def shuffle_channel(x, group, name=None):
+    helper = LayerHelper("shuffle_channel", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="shuffle_channel", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"group": group})
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    helper = LayerHelper("adaptive_pool3d", **locals())
+    ksize = _pair(pool_size, 3)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    out.shape = (input.shape[0], input.shape[1]) + tuple(ksize)
+    if require_index:
+        if pool_type != "max":
+            raise ValueError("require_index needs pool_type='max'")
+        mask = helper.create_variable_for_type_inference(
+            VarDesc.VarType.INT32)
+        mask.shape = out.shape
+        helper.append_op(
+            type="max_pool3d_with_index", inputs={"X": [input]},
+            outputs={"Out": [out], "Mask": [mask]},
+            attrs={"ksize": ksize, "adaptive": True,
+                   "strides": [1, 1, 1], "paddings": [0, 0, 0]})
+        return out, mask
+    helper.append_op(
+        type="pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": ksize, "adaptive": True,
+               "strides": [1, 1, 1], "paddings": [0, 0, 0],
+               "global_pooling": False, "data_format": "NCDHW",
+               "padding_algorithm": "EXPLICIT"})
+    return out
+
+
+def inplace_abn(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+                param_attr=None, bias_attr=None, data_layout="NCHW",
+                name=None, moving_mean_name=None, moving_variance_name=None,
+                do_model_average_for_mean_and_var=True,
+                use_global_stats=False, act_alpha=1.0):
+    """batch_norm fused with an in-place activation (reference
+    inplace_abn_op.cc; memory aliasing is XLA's concern on TPU)."""
+    helper = LayerHelper("inplace_abn", **locals())
+    dtype = helper.input_dtype()
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale_p = helper.create_parameter(attr=helper.param_attr, shape=[c],
+                                      dtype=dtype,
+                                      default_initializer=Constant(1.0))
+    bias_p = helper.create_parameter(attr=helper.bias_attr, shape=[c],
+                                     dtype=dtype, is_bias=True)
+    mean = helper.create_parameter(
+        attr=ParamAttr(name=moving_mean_name, initializer=Constant(0.0),
+                       trainable=False), shape=[c], dtype=dtype)
+    mean.stop_gradient = True
+    variance = helper.create_parameter(
+        attr=ParamAttr(name=moving_variance_name, initializer=Constant(1.0),
+                       trainable=False), shape=[c], dtype=dtype)
+    variance.stop_gradient = True
+    saved_mean = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = input.shape
+    helper.append_op(
+        type="inplace_abn",
+        inputs={"X": [input], "Scale": [scale_p], "Bias": [bias_p],
+                "Mean": [mean], "Variance": [variance]},
+        outputs={"Y": [out], "MeanOut": [mean], "VarianceOut": [variance],
+                 "SavedMean": [saved_mean], "SavedVariance": [saved_var]},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+               "data_layout": data_layout,
+               "use_global_stats": use_global_stats,
+               "activation": act or "identity", "alpha": act_alpha})
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    helper = LayerHelper("conv3d_transpose", **locals())
+    dtype = helper.input_dtype()
+    groups = groups or 1
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    padding = _pair(padding, 3)
+    in_c = input.shape[1]
+    if filter_size is None:
+        assert output_size is not None
+        output_size = _pair(output_size, 3)
+        filter_size = [
+            (output_size[i] - (input.shape[2 + i] - 1) * stride[i]
+             + 2 * padding[i] - 1) // dilation[i] + 1 for i in (0, 1, 2)]
+    else:
+        filter_size = _pair(filter_size, 3)
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[in_c, num_filters // groups] + list(filter_size), dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = (input.shape[0], num_filters) + tuple(
+        output_size if output_size else (
+            (input.shape[2 + i] - 1) * stride[i] - 2 * padding[i]
+            + dilation[i] * (filter_size[i] - 1) + 1 for i in (0, 1, 2)))
+    helper.append_op(
+        type="conv3d_transpose", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding, "dilations": dilation,
+               "groups": groups, "use_cudnn": use_cudnn,
+               "output_size": list(_pair(output_size, 3)) if output_size
+               else [],
+               "padding_algorithm": "EXPLICIT", "data_format": data_format})
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    helper = LayerHelper("resize_trilinear", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"align_corners": align_corners, "align_mode": align_mode,
+             "interp_method": "trilinear", "data_layout": data_format}
+    inputs = {"X": [input]}
+    if out_shape is not None:
+        if isinstance(out_shape, Variable):
+            inputs["OutSize"] = [out_shape]
+            attrs.update({"out_d": -1, "out_h": -1, "out_w": -1,
+                          "scale": 0.0})
+        else:
+            attrs.update({"out_d": int(out_shape[0]),
+                          "out_h": int(out_shape[1]),
+                          "out_w": int(out_shape[2]), "scale": 0.0})
+            out.shape = (input.shape[0], input.shape[1]) + tuple(
+                int(s) for s in out_shape)
+    else:
+        attrs.update({"out_d": -1, "out_h": -1, "out_w": -1,
+                      "scale": float(scale)})
+    helper.append_op(type="trilinear_interp", inputs=inputs,
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORT side equals out_short_len, keeping aspect
+    (reference layers/nn.py image_resize_short)."""
+    in_shape = input.shape
+    hw = in_shape[2:4]
+    short_idx = hw.index(min(hw))
+    out_shape = list(hw)
+    out_shape[short_idx] = out_short_len
+    out_shape[1 - short_idx] = int(
+        round(hw[1 - short_idx] * out_short_len / hw[short_idx]))
+    return image_resize(input, out_shape=out_shape, resample=resample)
+
+
+def affine_grid(theta, out_shape, name=None):
+    helper = LayerHelper("affine_grid", **locals())
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    inputs = {"Theta": [theta]}
+    attrs = {"align_corners": True}
+    if isinstance(out_shape, Variable):
+        inputs["OutputShape"] = [out_shape]
+    else:
+        attrs["output_shape"] = [int(s) for s in out_shape]
+        out.shape = (out_shape[0], out_shape[2], out_shape[3], 2)
+    helper.append_op(type="affine_grid", inputs=inputs,
+                     outputs={"Output": [out]}, attrs=attrs)
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    helper = LayerHelper("psroi_pool", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = (-1, output_channels, pooled_height, pooled_width)
+    helper.append_op(type="psroi_pool",
+                     inputs={"X": [input], "ROIs": [rois]},
+                     outputs={"Out": [out]},
+                     attrs={"output_channels": output_channels,
+                            "spatial_scale": spatial_scale,
+                            "pooled_height": pooled_height,
+                            "pooled_width": pooled_width})
+    return out
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    helper = LayerHelper("prroi_pool", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if batch_roi_nums is not None:
+        inputs["BatchRoINums"] = [batch_roi_nums]
+    out.shape = (-1, input.shape[1], pooled_height, pooled_width)
+    helper.append_op(type="prroi_pool", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"spatial_scale": spatial_scale,
+                            "pooled_height": pooled_height,
+                            "pooled_width": pooled_width})
+    return out
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=None,
+                    deformable_groups=None, im2col_step=None,
+                    param_attr=None, bias_attr=None, modulated=True,
+                    name=None):
+    helper = LayerHelper("deformable_conv", **locals())
+    dtype = helper.input_dtype()
+    groups = groups or 1
+    deformable_groups = deformable_groups or 1
+    filter_size = _pair(filter_size)
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[num_filters, input.shape[1] // groups] + list(filter_size),
+        dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    st, pd, dl = _pair(stride), _pair(padding), _pair(dilation)
+    out.shape = (input.shape[0], num_filters) + tuple(
+        (input.shape[2 + i] + 2 * pd[i] - (dl[i] * (filter_size[i] - 1) + 1))
+        // st[i] + 1 for i in (0, 1))
+    attrs = {"strides": _pair(stride), "paddings": _pair(padding),
+             "dilations": _pair(dilation), "groups": groups,
+             "deformable_groups": deformable_groups,
+             "im2col_step": im2col_step or 64}
+    if modulated and mask is None:
+        raise ValueError(
+            "deformable_conv: mask is required when modulated=True "
+            "(pass modulated=False for the v1 op)")
+    if modulated:
+        helper.append_op(
+            type="deformable_conv",
+            inputs={"Input": [input], "Offset": [offset], "Mask": [mask],
+                    "Filter": [w]},
+            outputs={"Output": [out]}, attrs=attrs)
+    else:
+        helper.append_op(
+            type="deformable_conv_v1",
+            inputs={"Input": [input], "Offset": [offset], "Filter": [w]},
+            outputs={"Output": [out]}, attrs=attrs)
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=[1, 1],
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=False,
+                           name=None):
+    helper = LayerHelper("deformable_roi_pooling", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    top_count = helper.create_variable_for_type_inference(input.dtype)
+    part_size = part_size or [pooled_height, pooled_width]
+    output_dim = (input.shape[1] // (group_size[0] * group_size[1])
+                  if position_sensitive else input.shape[1])
+    helper.append_op(
+        type="deformable_psroi_pooling",
+        inputs={"Input": [input], "ROIs": [rois], "Trans": [trans]},
+        outputs={"Output": [out], "TopCount": [top_count]},
+        attrs={"no_trans": no_trans, "spatial_scale": spatial_scale,
+               "output_dim": output_dim, "group_size": list(group_size),
+               "pooled_height": pooled_height, "pooled_width": pooled_width,
+               "part_size": list(part_size),
+               "sample_per_part": sample_per_part, "trans_std": trans_std})
+    return out

@@ -19,3 +19,5 @@ from . import distributed_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import loss_extra_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
+from . import vision_ops  # noqa: F401
+from . import misc_ops   # noqa: F401

@@ -1,0 +1,34 @@
+"""Miscellaneous host/feature ops: hash (reference: hash_op.cc — xxhash
+bucketing of int id sequences for sparse features; here a deterministic
+32-bit avalanche mix per hash seed — same bucketing semantics though not
+bit-identical values, and ids are mixed modulo 2^32 since this build runs
+with jax x64 disabled)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, first, seq, out
+
+
+@register_op("hash", inputs=("X",), no_grad=True,
+             attr_defaults={"num_hash": 1, "mod_by": 100000})
+def _hash(ins, attrs):
+    x = first(ins, "X")
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs.get("mod_by", 100000))
+    ids = x.reshape(x.shape[0], -1).astype(jnp.uint32)
+    # combine the row's ids into one key (polynomial roll), then num_hash
+    # independent avalanche mixes
+    key = jnp.zeros((x.shape[0],), jnp.uint32)
+    for j in range(ids.shape[1]):
+        key = key * jnp.uint32(1000003) + ids[:, j]
+    outs = []
+    for h in range(num_hash):
+        v = key ^ jnp.uint32((0x9E3779B9 + 0x61C88647 * h) & 0xFFFFFFFF)
+        v = (v ^ (v >> 16)) * jnp.uint32(0x85EBCA6B)
+        v = (v ^ (v >> 13)) * jnp.uint32(0xC2B2AE35)
+        v = v ^ (v >> 16)
+        outs.append((v % jnp.uint32(mod_by)).astype(jnp.int64))
+    return out(Out=jnp.stack(outs, axis=1)[:, :, None])

@@ -430,9 +430,13 @@ def _data_norm(ins, attrs):
 
 
 @register_op("lrn", inputs=("X",),
-             attr_defaults={"n": 5, "k": 2.0, "alpha": 1e-4, "beta": 0.75})
+             attr_defaults={"n": 5, "k": 2.0, "alpha": 1e-4, "beta": 0.75,
+                            "data_format": "NCHW"})
 def _lrn(ins, attrs):
     x = first(ins, "X")
+    nhwc = attrs.get("data_format", "NCHW") == "NHWC"
+    if nhwc:
+        x = jnp.transpose(x, (0, 3, 1, 2))
     n, k = attrs.get("n", 5), attrs.get("k", 2.0)
     alpha, beta = attrs.get("alpha", 1e-4), attrs.get("beta", 0.75)
     sq = jnp.square(x)
@@ -440,7 +444,11 @@ def _lrn(ins, attrs):
     pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
     mid = sum(pad[:, i:i + x.shape[1]] for i in range(n))
     mid = k + alpha * mid
-    return out(Out=x / (mid ** beta), MidOut=mid)
+    o = x / (mid ** beta)
+    if nhwc:
+        o = jnp.transpose(o, (0, 2, 3, 1))
+        mid = jnp.transpose(mid, (0, 2, 3, 1))
+    return out(Out=o, MidOut=mid)
 
 
 # --------------------------------------------------------------------------
@@ -561,6 +569,11 @@ def _conv2d_transpose(ins, attrs):
         feature_group_count=g)
     osize = attrs.get("output_size") or []
     if osize:
+        # paddle allows any size in [natural, natural+stride): pad up or
+        # crop down to the requested size
+        grow = [max(0, osize[i] - o.shape[2 + i]) for i in (0, 1)]
+        if any(grow):
+            o = jnp.pad(o, [(0, 0), (0, 0), (0, grow[0]), (0, grow[1])])
         o = o[:, :, :osize[0], :osize[1]]
     b = first(ins, "Bias")
     if b is not None:
@@ -664,9 +677,19 @@ def _pool3d(ins, attrs):
     x = first(ins, "X")
     ksize = [int(k) for k in attrs.get("ksize")]
     strides = [int(s) for s in attrs.get("strides")]
-    if attrs.get("global_pooling", False):
+    if attrs.get("global_pooling", False) or (
+            attrs.get("adaptive", False) and ksize == [1, 1, 1]):
         red = jnp.max if attrs.get("pooling_type") == "max" else jnp.mean
         return out(Out=red(x, axis=(2, 3, 4), keepdims=True))
+    if attrs.get("adaptive", False):
+        od, oh, ow = ksize
+        n, c = x.shape[:2]
+        d, h, w = x.shape[2:]
+        assert d % od == 0 and h % oh == 0 and w % ow == 0, \
+            "adaptive pool3d requires divisible sizes in this build"
+        xr = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        red = jnp.max if attrs.get("pooling_type") == "max" else jnp.mean
+        return out(Out=red(xr, axis=(3, 5, 7)))
     pads = _conv_padding(attrs.get("paddings"), attrs.get("padding_algorithm"),
                          3, ksize, strides, [1, 1, 1], x.shape[2:])
     wdims = (1, 1) + tuple(ksize)
@@ -716,6 +739,69 @@ def _max_pool2d_with_index(ins, attrs):
                 (i + (oh - 1) * sh + 1, j + (ow - 1) * sw + 1), (sh, sw)))
     stacked = jnp.stack(patches, axis=-1)            # [n,c,oh,ow,kh*kw]
     sidx = jnp.stack(idx_patches, axis=-1)           # [oh,ow,kh*kw]
+    arg = jnp.argmax(stacked, axis=-1)
+    o = jnp.max(stacked, axis=-1)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(sidx, stacked.shape), arg[..., None], -1)[..., 0]
+    return out(Out=o, Mask=mask.astype(jnp.int32))
+
+
+@register_op("max_pool3d_with_index", inputs=("X",),
+             attr_defaults={"ksize": [1, 1, 1], "strides": [1, 1, 1],
+                            "paddings": [0, 0, 0], "global_pooling": False,
+                            "adaptive": False})
+def _max_pool3d_with_index(ins, attrs):
+    """3d max pool returning the flat DxHxW argmax per window (reference
+    math/pooling.cc MaxPool3dWithIndex). Adaptive mode needs divisible
+    sizes (static-shape TPU build)."""
+    x = first(ins, "X")
+    n, c, D, H, W = x.shape
+    if attrs.get("adaptive", False):
+        od, oh, ow = [int(k) for k in attrs.get("ksize")]
+        assert D % od == 0 and H % oh == 0 and W % ow == 0
+        kd, kh, kw = D // od, H // oh, W // ow
+        xr = x.reshape(n, c, od, kd, oh, kh, ow, kw)
+        xr = jnp.transpose(xr, (0, 1, 2, 4, 6, 3, 5, 7)).reshape(
+            n, c, od, oh, ow, kd * kh * kw)
+        arg = jnp.argmax(xr, axis=-1)
+        o = jnp.max(xr, axis=-1)
+        # local (di,hi,wi) within the bin -> flat index in the full plane
+        di = arg // (kh * kw)
+        hi = (arg // kw) % kh
+        wi = arg % kw
+        gd = jnp.arange(od)[None, None, :, None, None] * kd + di
+        gh = jnp.arange(oh)[None, None, None, :, None] * kh + hi
+        gw = jnp.arange(ow)[None, None, None, None, :] * kw + wi
+        return out(Out=o, Mask=(gd * H * W + gh * W + gw).astype(jnp.int32))
+    kd, kh, kw = [int(k) for k in attrs.get("ksize")]
+    sd, sh, sw = [int(s) for s in attrs.get("strides")]
+    pd, ph, pw = [int(p) for p in attrs.get("paddings")]
+    if attrs.get("global_pooling", False):
+        kd, kh, kw = D, H, W
+        sd, sh, sw, pd, ph, pw = kd, kh, kw, 0, 0, 0
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    xp = jnp.pad(x, [(0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)],
+                 constant_values=neg)
+    flat_idx = ((jnp.arange(D + 2 * pd)[:, None, None] - pd) * H * W
+                + (jnp.arange(H + 2 * ph)[None, :, None] - ph) * W
+                + (jnp.arange(W + 2 * pw)[None, None, :] - pw))
+    od = (D + 2 * pd - kd) // sd + 1
+    oh = (H + 2 * ph - kh) // sh + 1
+    ow = (W + 2 * pw - kw) // sw + 1
+    patches, idx_patches = [], []
+    for a in range(kd):
+        for i in range(kh):
+            for j in range(kw):
+                patches.append(lax.slice(
+                    xp, (0, 0, a, i, j),
+                    (n, c, a + (od - 1) * sd + 1, i + (oh - 1) * sh + 1,
+                     j + (ow - 1) * sw + 1), (1, 1, sd, sh, sw)))
+                idx_patches.append(lax.slice(
+                    flat_idx, (a, i, j),
+                    (a + (od - 1) * sd + 1, i + (oh - 1) * sh + 1,
+                     j + (ow - 1) * sw + 1), (sd, sh, sw)))
+    stacked = jnp.stack(patches, axis=-1)
+    sidx = jnp.stack(idx_patches, axis=-1)
     arg = jnp.argmax(stacked, axis=-1)
     o = jnp.max(stacked, axis=-1)
     mask = jnp.take_along_axis(

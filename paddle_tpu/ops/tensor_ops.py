@@ -234,10 +234,21 @@ def _randperm(ins, attrs):
 
 
 @register_op("sampling_id", needs_rng=True, no_grad=True, inputs=("X",),
-             attr_defaults={"min": 0.0, "max": 1.0, "seed": 0})
+             attr_defaults={"min": 0.0, "max": 1.0, "seed": 0, "dtype": 5})
 def _sampling_id(ins, attrs):
+    """Draw one class index per row by inverse-CDF over the given
+    probabilities: r ~ U[min,max), index = #{cumsum(p) < r} (reference
+    sampling_id_op.h). seed!=0 pins the stream for reproducibility."""
     x = first(ins, "X")
-    return out(Out=jax.random.categorical(attrs["_rng"], jnp.log(x + 1e-20), -1))
+    rng = (jax.random.key(int(attrs["seed"])) if attrs.get("seed", 0)
+           else attrs["_rng"])
+    r = jax.random.uniform(rng, (x.shape[0],), x.dtype,
+                           attrs.get("min", 0.0), attrs.get("max", 1.0))
+    cum = jnp.cumsum(x, axis=1)
+    idx = jnp.sum((cum < r[:, None]).astype(jnp.int32), axis=1)
+    idx = jnp.clip(idx, 0, x.shape[1] - 1)
+    return out(Out=idx.astype(dtype_to_jnp(attrs.get("dtype", 5))
+                              if attrs.get("dtype", 5) != 5 else jnp.int64))
 
 
 @register_op("seed", no_grad=True, attr_defaults={"seed": 0})

@@ -1,0 +1,653 @@
+"""Vision op batch 2: crop, affine_grid, unpool, SPP, position-sensitive /
+precise RoI pooling, transposed 3d/depthwise convs, deformable convs,
+conv_shift, bicubic/trilinear interpolation, similarity_focus,
+polygon_box_transform, inplace_abn (reference: the same-named ops under
+paddle/fluid/operators/ — crop_op.cc, affine_grid_op.cc, unpool_op.cc,
+spp_op.cc, psroi_pool_op.cc, prroi_pool_op.cc, conv_transpose_op.cc,
+deformable_conv_op.cc, conv_shift_op.cc, interpolate_op.cc,
+similarity_focus_op.cc, polygon_box_transform_op.cc, inplace_abn_op.cc).
+
+All kernels are pure JAX: gathers/scatters and einsums XLA maps onto the
+TPU VPU/MXU; no per-pixel host loops."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, first, seq, out
+
+
+# --------------------------------------------------------------------------
+# crop family
+# --------------------------------------------------------------------------
+def _crop_impl(x, offsets, shape):
+    offsets = [int(o) for o in offsets]
+    shape = [x.shape[i] if s in (-1, 0) else int(s)
+             for i, s in enumerate(shape)]
+    return lax.slice(x, offsets, [o + s for o, s in zip(offsets, shape)])
+
+
+@register_op("crop", inputs=("X", "Y", "Offsets"), diff_inputs=("X",),
+             attr_defaults={"offsets": [], "shape": []})
+def _crop(ins, attrs):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    shape = list(y.shape) if y is not None else attrs.get("shape") or list(x.shape)
+    off_t = first(ins, "Offsets")
+    offsets = (list(np.asarray(off_t).astype(int)) if off_t is not None
+               else attrs.get("offsets") or [0] * x.ndim)
+    return out(Out=_crop_impl(x, offsets, shape))
+
+
+@register_op("crop_tensor", inputs=("X", "Shape", "Offsets", "ShapeTensor",
+                                    "OffsetsTensor"),
+             diff_inputs=("X",),
+             attr_defaults={"offsets": [], "shape": []})
+def _crop_tensor(ins, attrs):
+    x = first(ins, "X")
+    sh_t = first(ins, "Shape")
+    if sh_t is not None:
+        shape = list(np.asarray(sh_t).astype(int))
+    elif seq(ins, "ShapeTensor"):
+        shape = [int(np.asarray(s).reshape(())) for s in seq(ins, "ShapeTensor")]
+    else:
+        shape = attrs.get("shape") or list(x.shape)
+    off_t = first(ins, "Offsets")
+    if off_t is not None:
+        offsets = list(np.asarray(off_t).astype(int))
+    elif seq(ins, "OffsetsTensor"):
+        offsets = [int(np.asarray(o).reshape(()))
+                   for o in seq(ins, "OffsetsTensor")]
+    else:
+        offsets = attrs.get("offsets") or [0] * x.ndim
+    return out(Out=_crop_impl(x, offsets, shape))
+
+
+# --------------------------------------------------------------------------
+# affine_grid — theta [N,2,3] -> sampling grid [N,H,W,2] in [-1,1] coords
+# --------------------------------------------------------------------------
+@register_op("affine_grid", inputs=("Theta", "OutputShape"),
+             diff_inputs=("Theta",),
+             attr_defaults={"output_shape": [], "align_corners": True})
+def _affine_grid(ins, attrs):
+    theta = first(ins, "Theta")
+    osh = first(ins, "OutputShape")
+    if osh is not None:
+        n, c, h, w = [int(v) for v in np.asarray(osh)]
+    else:
+        n, c, h, w = [int(v) for v in attrs.get("output_shape")]
+    ac = attrs.get("align_corners", True)
+    if ac:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+        xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H,W,3]
+    o = jnp.einsum("hwk,njk->nhwj", base.astype(theta.dtype), theta)
+    return out(Output=o)
+
+
+# --------------------------------------------------------------------------
+# unpool — max-unpooling by the Mask produced by max_pool2d_with_index
+# --------------------------------------------------------------------------
+@register_op("unpool", inputs=("X", "Indices"), diff_inputs=("X",),
+             attr_defaults={"unpooling_type": "max", "ksize": [2, 2],
+                            "strides": [2, 2], "paddings": [0, 0]})
+def _unpool(ins, attrs):
+    x, idx = first(ins, "X"), first(ins, "Indices")
+    n, c, ih, iw = x.shape
+    kh, kw = [int(k) for k in attrs.get("ksize", [2, 2])]
+    sh, sw = [int(s) for s in attrs.get("strides", [2, 2])]
+    ph, pw = [int(p) for p in attrs.get("paddings", [0, 0])]
+    oh = (ih - 1) * sh - 2 * ph + kh
+    ow = (iw - 1) * sw - 2 * pw + kw
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    flat = flat.at[
+        jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, ih * iw).astype(jnp.int32)].add(
+            x.reshape(n, c, ih * iw))
+    return out(Out=flat.reshape(n, c, oh, ow))
+
+
+# --------------------------------------------------------------------------
+# spp — spatial pyramid pooling: level p pools to 2^p x 2^p bins, concat
+# --------------------------------------------------------------------------
+@register_op("spp", inputs=("X",),
+             attr_defaults={"pyramid_height": 1, "pooling_type": "max"})
+def _spp(ins, attrs):
+    x = first(ins, "X")
+    n, c, h, w = x.shape
+    ptype = attrs.get("pooling_type", "max")
+    pieces = []
+    for p in range(int(attrs.get("pyramid_height", 1))):
+        bins = 2 ** p
+        kh, kw = int(np.ceil(h / bins)), int(np.ceil(w / bins))
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        if ptype == "max":
+            neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                   else jnp.iinfo(x.dtype).min)
+            xp = jnp.pad(x, [(0, 0), (0, 0), (ph, kh * bins - h - ph),
+                             (pw, kw * bins - w - pw)], constant_values=neg)
+            r = jnp.max(xp.reshape(n, c, bins, kh, bins, kw), axis=(3, 5))
+        else:
+            xp = jnp.pad(x, [(0, 0), (0, 0), (ph, kh * bins - h - ph),
+                             (pw, kw * bins - w - pw)])
+            r = jnp.mean(xp.reshape(n, c, bins, kh, bins, kw), axis=(3, 5))
+        pieces.append(r.reshape(n, c * bins * bins))
+    return out(Out=jnp.concatenate(pieces, axis=1))
+
+
+# --------------------------------------------------------------------------
+# position-sensitive / precise RoI pooling
+# --------------------------------------------------------------------------
+def _roi_batch_ids(attrs, slot, num_rois):
+    """Map each RoI to its image index from the slot's host-static LoD
+    (same contract as detection_ops._roi_align)."""
+    lod = (attrs.get("_lod") or {}).get(slot)
+    if lod and lod[0]:
+        offs = np.asarray(lod[0][-1], np.int64)
+        bids = np.repeat(np.arange(len(offs) - 1), offs[1:] - offs[:-1])
+        return jnp.asarray(bids[:num_rois], jnp.int32)
+    return jnp.zeros(num_rois, jnp.int32)
+
+
+@register_op("psroi_pool", inputs=("X", "ROIs"), diff_inputs=("X",),
+             needs_lod=True,
+             attr_defaults={"output_channels": 1, "spatial_scale": 1.0,
+                            "pooled_height": 1, "pooled_width": 1})
+def _psroi_pool(ins, attrs):
+    x, rois = first(ins, "X"), first(ins, "ROIs")
+    n, c, h, w = x.shape
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    oc = int(attrs.get("output_channels", 1))
+    scale = attrs.get("spatial_scale", 1.0)
+    batch_ids = _roi_batch_ids(attrs, "ROIs", rois.shape[0])
+
+    x0 = jnp.round(rois[:, 0]) * scale
+    y0 = jnp.round(rois[:, 1]) * scale
+    x1 = jnp.round(rois[:, 2] + 1.0) * scale
+    y1 = jnp.round(rois[:, 3] + 1.0) * scale
+    rw = jnp.maximum(x1 - x0, 0.1)
+    rh = jnp.maximum(y1 - y0, 0.1)
+    bin_h = rh / ph          # [R]
+    bin_w = rw / pw
+    # per (roi, oc, i, j): average x[b, oc*ph*pw block, bin] — gather a
+    # fixed 2x2 sample grid per bin (TPU-friendly static shapes)
+    S = 2
+    iy = jnp.arange(ph)
+    ix = jnp.arange(pw)
+    sy = (jnp.arange(S) + 0.5) / S
+    # sample coords [R, ph, S]
+    ys = y0[:, None, None] + (iy[None, :, None] + sy[None, None, :]) * bin_h[:, None, None]
+    xs = x0[:, None, None] + (ix[None, :, None] + sy[None, None, :]) * bin_w[:, None, None]
+    yc = jnp.clip(ys, 0, h - 1).astype(jnp.int32)
+    xc = jnp.clip(xs, 0, w - 1).astype(jnp.int32)
+    # channel map: out channel k, bin (i,j) reads input channel k*ph*pw + i*pw + j
+    chan = (jnp.arange(oc)[:, None, None] * (ph * pw)
+            + iy[None, :, None] * pw + ix[None, None, :])  # [oc,ph,pw]
+    # gather: v[r, k, i, j, a, b] = x[bid[r], chan[k,i,j], yc[r,i,a], xc[r,j,b]]
+    v = x[batch_ids[:, None, None, None, None, None],
+          chan[None, :, :, :, None, None],
+          yc[:, None, :, None, :, None],
+          xc[:, None, None, :, None, :]]
+    return out(Out=jnp.mean(v, axis=(4, 5)))
+
+
+@register_op("prroi_pool", inputs=("X", "ROIs", "BatchRoINums"),
+             diff_inputs=("X",), needs_lod=True,
+             attr_defaults={"spatial_scale": 1.0, "pooled_height": 1,
+                            "pooled_width": 1})
+def _prroi_pool(ins, attrs):
+    """Precise RoI pooling (integral of bilinear surface) approximated by a
+    dense 4x4 bilinear sample grid per bin — differentiable and static."""
+    x, rois = first(ins, "X"), first(ins, "ROIs")
+    n, c, h, w = x.shape
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = attrs.get("spatial_scale", 1.0)
+    brn = first(ins, "BatchRoINums")
+    if brn is not None:
+        counts = np.asarray(brn).astype(int)
+        bids = np.repeat(np.arange(len(counts)), counts)
+        if len(bids) < rois.shape[0]:
+            bids = np.pad(bids, (0, rois.shape[0] - len(bids)))
+        batch_ids = jnp.asarray(bids[:rois.shape[0]], jnp.int32)
+    else:
+        batch_ids = _roi_batch_ids(attrs, "ROIs", rois.shape[0])
+    x0, y0, x1, y1 = (rois[:, 0] * scale, rois[:, 1] * scale,
+                      rois[:, 2] * scale, rois[:, 3] * scale)
+    bin_h = jnp.maximum(y1 - y0, 0.0) / ph
+    bin_w = jnp.maximum(x1 - x0, 0.0) / pw
+    S = 4
+    fy = (jnp.arange(S) + 0.5) / S
+    ys = (y0[:, None, None] + (jnp.arange(ph)[None, :, None] + fy[None, None, :])
+          * bin_h[:, None, None])            # [R,ph,S]
+    xs = (x0[:, None, None] + (jnp.arange(pw)[None, :, None] + fy[None, None, :])
+          * bin_w[:, None, None])            # [R,pw,S]
+    ysc = jnp.clip(ys, 0, h - 1)
+    xsc = jnp.clip(xs, 0, w - 1)
+    yi0 = jnp.floor(ysc).astype(jnp.int32)
+    xi0 = jnp.floor(xsc).astype(jnp.int32)
+    yi1 = jnp.minimum(yi0 + 1, h - 1)
+    xi1 = jnp.minimum(xi0 + 1, w - 1)
+    wy = ysc - yi0
+    wx = xsc - xi0
+    b = batch_ids[:, None, None, None, None, None]
+
+    def g(yi, xi):
+        return x[b, jnp.arange(c)[None, :, None, None, None, None],
+                 yi[:, None, :, None, :, None], xi[:, None, None, :, None, :]]
+    v = (g(yi0, xi0) * (1 - wy)[:, None, :, None, :, None] * (1 - wx)[:, None, None, :, None, :]
+         + g(yi0, xi1) * (1 - wy)[:, None, :, None, :, None] * wx[:, None, None, :, None, :]
+         + g(yi1, xi0) * wy[:, None, :, None, :, None] * (1 - wx)[:, None, None, :, None, :]
+         + g(yi1, xi1) * wy[:, None, :, None, :, None] * wx[:, None, None, :, None, :])
+    return out(Out=jnp.mean(v, axis=(4, 5)))
+
+
+# --------------------------------------------------------------------------
+# transposed convs (3d / depthwise)
+# --------------------------------------------------------------------------
+@register_op("conv3d_transpose", inputs=("Input", "Filter", "Bias"),
+             diff_inputs=("Input", "Filter", "Bias"),
+             attr_defaults={"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                            "dilations": [1, 1, 1], "groups": 1,
+                            "output_size": [], "padding_algorithm": "EXPLICIT",
+                            "data_format": "NCDHW", "use_cudnn": True})
+def _conv3d_transpose(ins, attrs):
+    from .nn_ops import _conv_padding
+    x, w = first(ins, "Input"), first(ins, "Filter")  # w: [in_c, out_c/g, kd, kh, kw]
+    strides = [int(s) for s in attrs.get("strides", [1, 1, 1])]
+    dil = [int(d) for d in attrs.get("dilations", [1, 1, 1])]
+    pads = _conv_padding(attrs.get("paddings", [0, 0, 0]),
+                         attrs.get("padding_algorithm", "EXPLICIT"),
+                         3, w.shape[2:], strides, dil, x.shape[2:])
+    g = attrs.get("groups", 1)
+    k = w.shape[2:]
+    w_t = jnp.swapaxes(w, 0, 1)[:, :, ::-1, ::-1, ::-1]
+    if g > 1:
+        w_t = w_t.reshape(w.shape[1], g, w.shape[0] // g, *k)
+        w_t = jnp.concatenate([w_t[:, i] for i in range(g)], axis=0)
+    tp = [((k[i] - 1) * dil[i] - pads[i][0], (k[i] - 1) * dil[i] - pads[i][1])
+          for i in range(3)]
+    o = lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1, 1), padding=tp, lhs_dilation=strides,
+        rhs_dilation=dil, dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=g)
+    osize = attrs.get("output_size") or []
+    if osize:
+        # pad up or crop down into paddle's legal [natural, natural+stride)
+        grow = [max(0, osize[i] - o.shape[2 + i]) for i in (0, 1, 2)]
+        if any(grow):
+            o = jnp.pad(o, [(0, 0), (0, 0), (0, grow[0]), (0, grow[1]),
+                            (0, grow[2])])
+        o = o[:, :, :osize[0], :osize[1], :osize[2]]
+    b = first(ins, "Bias")
+    if b is not None:
+        o = o + b.reshape(1, -1, 1, 1, 1)
+    return out(Output=o)
+
+
+@register_op("depthwise_conv2d_transpose", inputs=("Input", "Filter", "Bias"),
+             diff_inputs=("Input", "Filter", "Bias"),
+             attr_defaults={"strides": [1, 1], "paddings": [0, 0],
+                            "dilations": [1, 1], "groups": 1,
+                            "output_size": [], "padding_algorithm": "EXPLICIT",
+                            "data_format": "NCHW", "use_cudnn": False})
+def _depthwise_conv2d_transpose(ins, attrs):
+    from .nn_ops import _conv2d_transpose
+    return _conv2d_transpose(ins, attrs)
+
+
+# --------------------------------------------------------------------------
+# deformable convs — bilinear sampling at offset positions, then matmul
+# --------------------------------------------------------------------------
+def _bilinear_at(x, ys, xs):
+    """x [C,H,W]; ys/xs [...]: bilinear sample, per-corner zero padding
+    outside the image (matches the reference deformable_im2col: a corner
+    out of range contributes 0, so border samples keep fractional weight
+    rather than being clipped to full weight)."""
+    c, h, w = x.shape
+    y0f = jnp.floor(ys)
+    x0f = jnp.floor(xs)
+    y0 = y0f.astype(jnp.int32)
+    x0 = x0f.astype(jnp.int32)
+    wy = ys - y0f
+    wx = xs - x0f
+
+    def corner(yi, xi, wgt):
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        v = x[:, jnp.clip(yi, 0, h - 1), jnp.clip(xi, 0, w - 1)]
+        return v * (wgt * valid)
+    return (corner(y0, x0, (1 - wy) * (1 - wx))
+            + corner(y0, x0 + 1, (1 - wy) * wx)
+            + corner(y0 + 1, x0, wy * (1 - wx))
+            + corner(y0 + 1, x0 + 1, wy * wx))
+
+
+def _deformable_conv_impl(ins, attrs, modulated):
+    x = first(ins, "Input")
+    offset = first(ins, "Offset")
+    mask = first(ins, "Mask") if modulated else None
+    w = first(ins, "Filter")  # [out_c, in_c/g, kh, kw]
+    n, cin, H, W = x.shape
+    oc, cpg, kh, kw = w.shape
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    pads = [int(p) for p in attrs.get("paddings", [0, 0])]
+    dil = [int(d) for d in attrs.get("dilations", [1, 1])]
+    g = int(attrs.get("groups", 1))
+    dg = int(attrs.get("deformable_groups", 1))
+    oh = (H + 2 * pads[0] - (dil[0] * (kh - 1) + 1)) // strides[0] + 1
+    ow = (W + 2 * pads[1] - (dil[1] * (kw - 1) + 1)) // strides[1] + 1
+    # base sampling positions [oh,ow,kh,kw]
+    py = (jnp.arange(oh)[:, None, None, None] * strides[0] - pads[0]
+          + jnp.arange(kh)[None, None, :, None] * dil[0])
+    px = (jnp.arange(ow)[None, :, None, None] * strides[1] - pads[1]
+          + jnp.arange(kw)[None, None, None, :] * dil[1])
+    py = jnp.broadcast_to(py, (oh, ow, kh, kw)).astype(x.dtype)
+    px = jnp.broadcast_to(px, (oh, ow, kh, kw)).astype(x.dtype)
+    # offset layout [N, dg*2*kh*kw, oh, ow]: (dy,dx) interleaved per tap
+    off = offset.reshape(n, dg, kh * kw, 2, oh, ow)
+    dy = jnp.transpose(off[:, :, :, 0], (0, 1, 3, 4, 2)).reshape(
+        n, dg, oh, ow, kh, kw)
+    dx = jnp.transpose(off[:, :, :, 1], (0, 1, 3, 4, 2)).reshape(
+        n, dg, oh, ow, kh, kw)
+    if mask is not None:
+        m = jnp.transpose(mask.reshape(n, dg, kh * kw, oh, ow),
+                          (0, 1, 3, 4, 2)).reshape(n, dg, oh, ow, kh, kw)
+    cols = []
+    cper = cin // dg
+    for d in range(dg):
+        ys = py[None] + dy[:, d]
+        xs = px[None] + dx[:, d]
+        sampled = jax.vmap(
+            lambda xi, yi, xj: _bilinear_at(xi, yi, xj)
+        )(x[:, d * cper:(d + 1) * cper], ys, xs)  # [n, cper, oh,ow,kh,kw]
+        if mask is not None:
+            sampled = sampled * m[:, d][:, None]
+        cols.append(sampled)
+    col = jnp.concatenate(cols, axis=1)  # [n, cin, oh, ow, kh, kw]
+    # grouped contraction with the filter
+    col = col.reshape(n, g, cin // g, oh, ow, kh, kw)
+    wg = w.reshape(g, oc // g, cpg, kh, kw)
+    o = jnp.einsum("ngchwij,gocij->ngohw", col, wg).reshape(n, oc, oh, ow)
+    return out(Output=o)
+
+
+@register_op("deformable_conv",
+             inputs=("Input", "Offset", "Mask", "Filter"),
+             diff_inputs=("Input", "Offset", "Mask", "Filter"),
+             attr_defaults={"strides": [1, 1], "paddings": [0, 0],
+                            "dilations": [1, 1], "groups": 1,
+                            "deformable_groups": 1, "im2col_step": 64})
+def _deformable_conv(ins, attrs):
+    return _deformable_conv_impl(ins, attrs, modulated=True)
+
+
+@register_op("deformable_conv_v1", inputs=("Input", "Offset", "Filter"),
+             diff_inputs=("Input", "Offset", "Filter"),
+             attr_defaults={"strides": [1, 1], "paddings": [0, 0],
+                            "dilations": [1, 1], "groups": 1,
+                            "deformable_groups": 1, "im2col_step": 64})
+def _deformable_conv_v1(ins, attrs):
+    return _deformable_conv_impl(ins, attrs, modulated=False)
+
+
+@register_op("deformable_psroi_pooling",
+             inputs=("Input", "ROIs", "Trans"),
+             diff_inputs=("Input", "Trans"), needs_lod=True,
+             attr_defaults={"no_trans": False, "spatial_scale": 1.0,
+                            "output_dim": 1, "group_size": [1],
+                            "pooled_height": 1, "pooled_width": 1,
+                            "part_size": [1], "sample_per_part": 4,
+                            "trans_std": 0.1})
+def _deformable_psroi_pooling(ins, attrs):
+    x, rois = first(ins, "Input"), first(ins, "ROIs")
+    trans = first(ins, "Trans")
+    n, c, h, w = x.shape
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    od = int(attrs.get("output_dim", 1))
+    scale = attrs.get("spatial_scale", 1.0)
+    ts = attrs.get("trans_std", 0.1)
+    no_trans = attrs.get("no_trans", False)
+    batch_ids = _roi_batch_ids(attrs, "ROIs", rois.shape[0])
+    R = rois.shape[0]
+    x0 = jnp.round(rois[:, 0]) * scale - 0.5
+    y0 = jnp.round(rois[:, 1]) * scale - 0.5
+    x1 = (jnp.round(rois[:, 2]) + 1.0) * scale - 0.5
+    y1 = (jnp.round(rois[:, 3]) + 1.0) * scale - 0.5
+    rw = jnp.maximum(x1 - x0, 0.1)
+    rh = jnp.maximum(y1 - y0, 0.1)
+    bin_h = (rh / ph)[:, None, None]
+    bin_w = (rw / pw)[:, None, None]
+    iy = jnp.arange(ph)[None, :, None]
+    ix = jnp.arange(pw)[None, None, :]
+    if no_trans or trans is None:
+        dy = jnp.zeros((R, ph, pw))
+        dx = jnp.zeros((R, ph, pw))
+    else:
+        # trans [R, 2, part_h, part_w] -> nearest part per bin
+        pth, ptw = trans.shape[2], trans.shape[3]
+        pyi = jnp.clip((iy * pth // ph), 0, pth - 1)
+        pxi = jnp.clip((ix * ptw // pw), 0, ptw - 1)
+        dy = trans[jnp.arange(R)[:, None, None], 0, pyi, pxi] * ts * rh[:, None, None]
+        dx = trans[jnp.arange(R)[:, None, None], 1, pyi, pxi] * ts * rw[:, None, None]
+    S = int(attrs.get("sample_per_part", 4))
+    fs = (jnp.arange(S) + 0.5) / S
+    ys = (y0[:, None, None] + iy * bin_h + dy)[..., None] + fs * bin_h[..., None]
+    xs = (x0[:, None, None] + ix * bin_w + dx)[..., None] + fs * bin_w[..., None]
+    gs = attrs.get("group_size", [1])
+    gh = int(gs[0])
+    gw = int(gs[1] if len(gs) > 1 else gs[0])
+    # PS channel map with group_size: bin (i,j) reads input channel
+    # (k*gh + floor(i*gh/ph))*gw + floor(j*gw/pw)
+    gy = jnp.arange(ph) * gh // ph
+    gx = jnp.arange(pw) * gw // pw
+    chan = ((jnp.arange(od)[:, None, None] * gh + gy[None, :, None]) * gw
+            + gx[None, None, :])  # [od,ph,pw]
+    yc = jnp.clip(ys, 0, h - 1)
+    xc = jnp.clip(xs, 0, w - 1)
+    yi0 = jnp.floor(yc).astype(jnp.int32)
+    xi0 = jnp.floor(xc).astype(jnp.int32)
+    yi1 = jnp.minimum(yi0 + 1, h - 1)
+    xi1 = jnp.minimum(xi0 + 1, w - 1)
+    wy = yc - yi0
+    wx = xc - xi0
+    b = batch_ids[:, None, None, None, None, None]
+    ch = chan[None, :, :, :, None, None]
+
+    def g(yi, xi):
+        return x[b, ch, yi[:, None, :, :, :, None], xi[:, None, :, :, None, :]]
+    wyE = wy[:, None, :, :, :, None]
+    wxE = wx[:, None, :, :, None, :]
+    v = (g(yi0, xi0) * (1 - wyE) * (1 - wxE) + g(yi0, xi1) * (1 - wyE) * wxE
+         + g(yi1, xi0) * wyE * (1 - wxE) + g(yi1, xi1) * wyE * wxE)
+    o = jnp.mean(v, axis=(4, 5))
+    return out(Output=o.astype(x.dtype), TopCount=jnp.ones_like(o))
+
+
+# --------------------------------------------------------------------------
+# conv_shift — circular correlation (NTM addressing)
+# --------------------------------------------------------------------------
+@register_op("conv_shift", inputs=("X", "Y"), diff_inputs=("X", "Y"))
+def _conv_shift(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    b, w = x.shape
+    k = y.shape[1]
+    half = k // 2
+    shifts = [jnp.roll(x, half - j, axis=1) for j in range(k)]
+    stacked = jnp.stack(shifts, axis=2)          # [b, w, k]
+    return out(Out=jnp.einsum("bwk,bk->bw", stacked, y))
+
+
+# --------------------------------------------------------------------------
+# bicubic / trilinear interpolation
+# --------------------------------------------------------------------------
+def _cubic_w(t, a=-0.75):
+    t = jnp.abs(t)
+    t2, t3 = t * t, t * t * t
+    w1 = (a + 2) * t3 - (a + 3) * t2 + 1
+    w2 = a * t3 - 5 * a * t2 + 8 * a * t - 4 * a
+    return jnp.where(t <= 1, w1, jnp.where(t < 2, w2, 0.0))
+
+
+@register_op("bicubic_interp", inputs=("X", "OutSize", "SizeTensor", "Scale"),
+             diff_inputs=("X",),
+             attr_defaults={"out_h": -1, "out_w": -1, "scale": 0.0,
+                            "interp_method": "bicubic", "align_corners": True,
+                            "align_mode": 1, "data_layout": "NCHW"})
+def _bicubic_interp(ins, attrs):
+    from .nn_ops import _interp_size
+    x = first(ins, "X")
+    oh, ow = _interp_size(ins, attrs, x)
+    h, w = x.shape[2], x.shape[3]
+    if attrs.get("align_corners", True):
+        hs = jnp.arange(oh) * ((h - 1) / max(oh - 1, 1))
+        ws = jnp.arange(ow) * ((w - 1) / max(ow - 1, 1))
+    else:
+        hs = (jnp.arange(oh) + 0.5) * h / oh - 0.5
+        ws = (jnp.arange(ow) + 0.5) * w / ow - 0.5
+    h0 = jnp.floor(hs).astype(jnp.int32)
+    w0 = jnp.floor(ws).astype(jnp.int32)
+    fy = hs - h0
+    fx = ws - w0
+    o = 0.0
+    for i in range(-1, 3):
+        wyi = _cubic_w(fy - i)[None, None, :, None]
+        hi = jnp.clip(h0 + i, 0, h - 1)
+        row = 0.0
+        for j in range(-1, 3):
+            wxj = _cubic_w(fx - j)[None, None, None, :]
+            wj = jnp.clip(w0 + j, 0, w - 1)
+            row = row + x[:, :, hi][:, :, :, wj] * wxj
+        o = o + row * wyi
+    return out(Out=o.astype(x.dtype))
+
+
+@register_op("trilinear_interp", inputs=("X", "OutSize", "SizeTensor", "Scale"),
+             diff_inputs=("X",),
+             attr_defaults={"out_d": -1, "out_h": -1, "out_w": -1,
+                            "scale": 0.0, "interp_method": "trilinear",
+                            "align_corners": True, "align_mode": 1,
+                            "data_layout": "NCDHW"})
+def _trilinear_interp(ins, attrs):
+    x = first(ins, "X")
+    ost = first(ins, "OutSize")
+    st = seq(ins, "SizeTensor")
+    if ost is not None:
+        od, oh, ow = [int(v) for v in np.asarray(ost)]
+    elif st:
+        od, oh, ow = [int(np.asarray(s).reshape(())) for s in st[:3]]
+    else:
+        sct = first(ins, "Scale")
+        sc = (float(np.asarray(sct).reshape(())) if sct is not None
+              else attrs.get("scale", 0.0))
+        if sc and sc > 0:
+            od, oh, ow = (int(x.shape[2] * sc), int(x.shape[3] * sc),
+                          int(x.shape[4] * sc))
+        else:
+            od, oh, ow = (attrs.get("out_d"), attrs.get("out_h"),
+                          attrs.get("out_w"))
+    d, h, w = x.shape[2:]
+    ac = attrs.get("align_corners", True)
+
+    def axis_coords(o, n):
+        if ac:
+            return jnp.arange(o) * ((n - 1) / max(o - 1, 1))
+        if attrs.get("align_mode", 1) == 0:
+            return jnp.clip((jnp.arange(o) + 0.5) * n / o - 0.5, 0, n - 1)
+        return jnp.clip(jnp.arange(o) * n / o, 0, n - 1)
+    ds, hs, ws = axis_coords(od, d), axis_coords(oh, h), axis_coords(ow, w)
+    d0 = jnp.floor(ds).astype(jnp.int32); d1 = jnp.minimum(d0 + 1, d - 1)
+    h0 = jnp.floor(hs).astype(jnp.int32); h1 = jnp.minimum(h0 + 1, h - 1)
+    w0 = jnp.floor(ws).astype(jnp.int32); w1 = jnp.minimum(w0 + 1, w - 1)
+    ad = (ds - d0)[None, None, :, None, None]
+    ah = (hs - h0)[None, None, None, :, None]
+    aw = (ws - w0)[None, None, None, None, :]
+
+    def gv(di, hi, wi):
+        return x[:, :, di][:, :, :, hi][:, :, :, :, wi]
+    o = (gv(d0, h0, w0) * (1 - ad) * (1 - ah) * (1 - aw)
+         + gv(d0, h0, w1) * (1 - ad) * (1 - ah) * aw
+         + gv(d0, h1, w0) * (1 - ad) * ah * (1 - aw)
+         + gv(d0, h1, w1) * (1 - ad) * ah * aw
+         + gv(d1, h0, w0) * ad * (1 - ah) * (1 - aw)
+         + gv(d1, h0, w1) * ad * (1 - ah) * aw
+         + gv(d1, h1, w0) * ad * ah * (1 - aw)
+         + gv(d1, h1, w1) * ad * ah * aw)
+    return out(Out=o.astype(x.dtype))
+
+
+# --------------------------------------------------------------------------
+# similarity_focus / polygon_box_transform / inplace_abn
+# --------------------------------------------------------------------------
+@register_op("similarity_focus", inputs=("X",),
+             attr_defaults={"axis": 1, "indexes": [0]})
+def _similarity_focus(ins, attrs):
+    """For each selected channel (indexes along `axis`), mark with 1 the
+    rows/cols holding per-row / per-col maxima; union over indexes
+    (reference similarity_focus_op.h greedy selection approximated by the
+    row/col argmax union — static-shape TPU formulation)."""
+    x = first(ins, "X")
+    ax = attrs.get("axis", 1)
+    idxs = attrs.get("indexes", [0])
+    # the two dims remaining after removing batch + the selected axis
+    rem = [a for a in (1, 2, 3) if a != ax]
+    d1, d2 = x.shape[rem[0]], x.shape[rem[1]]
+    masks = jnp.zeros((x.shape[0], d1, d2), x.dtype)
+    for k in idxs:
+        plane = jnp.take(x, k, axis=ax)  # [n, d1, d2]
+        rmax = jnp.argmax(plane, axis=2)          # [n, d1]
+        cmax = jnp.argmax(plane, axis=1)          # [n, d2]
+        rm = jax.nn.one_hot(rmax, d2, dtype=x.dtype)          # [n,d1,d2]
+        cm = jnp.transpose(jax.nn.one_hot(cmax, d1, dtype=x.dtype),
+                           (0, 2, 1))
+        # union of per-row and per-column maxima of every selected plane
+        masks = jnp.maximum(masks, jnp.maximum(rm, cm))
+    o = jnp.broadcast_to(jnp.expand_dims(masks, ax), x.shape)
+    return out(Out=o)
+
+
+@register_op("polygon_box_transform", inputs=("Input",))
+def _polygon_box_transform(ins, attrs):
+    """EAST geometry decoding: for x-offset channels (even) the absolute
+    coordinate is 4*col - offset; for y channels 4*row - offset; zero
+    offsets stay zero (reference polygon_box_transform_op.cc)."""
+    x = first(ins, "Input")
+    n, c, h, w = x.shape
+    col = jnp.broadcast_to(jnp.arange(w, dtype=x.dtype)[None, :], (h, w))
+    row = jnp.broadcast_to(jnp.arange(h, dtype=x.dtype)[:, None], (h, w))
+    is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    base = jnp.where(is_x, col[None, None], row[None, None]) * 4.0
+    return out(Output=jnp.where(x != 0, base - x, x))
+
+
+@register_op("inplace_abn",
+             inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+             diff_inputs=("X", "Scale", "Bias"), stateful=True,
+             attr_defaults={"momentum": 0.9, "epsilon": 1e-5,
+                            "is_test": False, "data_layout": "NCHW",
+                            "activation": "identity", "alpha": 0.01,
+                            "use_global_stats": False,
+                            "trainable_statistics": False})
+def _inplace_abn(ins, attrs):
+    """Activated batch norm — batch_norm followed by identity/elu/leakyrelu
+    (reference inplace_abn_op.cc; the in-place memory trick is moot under
+    XLA's buffer planner)."""
+    from .nn_ops import _batch_norm
+    r = _batch_norm(ins, attrs)
+    act = attrs.get("activation", "identity")
+    y = r["Y"][0] if isinstance(r["Y"], list) else r["Y"]
+    if act == "elu":
+        a = attrs.get("alpha", 1.0)
+        y = jnp.where(y > 0, y, a * (jnp.exp(y) - 1.0))
+    elif act == "leaky_relu":
+        a = attrs.get("alpha", 0.01)
+        y = jnp.where(y > 0, y, a * y)
+    r["Y"] = [y]
+    return r

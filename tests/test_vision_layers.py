@@ -1,0 +1,114 @@
+"""Layer-API tests for the batch-2 vision wrappers (reference:
+python/paddle/fluid/layers/nn.py same-named functions) — built into real
+Programs and run through Executor, including a backward pass."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def _run(prog, startup, feed, fetch):
+    scope = core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(prog, feed=feed, fetch_list=fetch)
+
+
+def test_vision_layer_pipeline_forward():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("img", shape=[3, 16, 16], dtype="float32")
+        y = fluid.layers.scale(x, scale=2.0, bias=1.0)
+        y = fluid.layers.lrn(y, n=3)
+        y = fluid.layers.shuffle_channel(y, group=3)
+        up = fluid.layers.resize_trilinear(
+            fluid.layers.reshape(y, [-1, 3, 4, 4, 16]),
+            out_shape=[6, 6, 18])
+        pooled = fluid.layers.adaptive_pool3d(up, pool_size=[3, 3, 6],
+                                              pool_type="avg")
+        flat = fluid.layers.flatten(pooled)
+        sf = fluid.layers.similarity_focus(y, axis=1, indexes=[0])
+    X = np.random.RandomState(0).rand(2, 3, 16, 16).astype("float32")
+    o_flat, o_sf = _run(main, startup, {"img": X}, [flat, sf])
+    assert o_flat.shape == (2, 3 * 3 * 3 * 6)
+    assert o_sf.shape == X.shape
+    assert set(np.unique(o_sf)).issubset({0.0, 1.0})
+
+
+def test_deformable_and_transpose_conv_train():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("img", shape=[4, 8, 8], dtype="float32")
+        offset = fluid.layers.conv2d(x, num_filters=2 * 9, filter_size=3,
+                                     padding=1)
+        mask = fluid.layers.conv2d(x, num_filters=9, filter_size=3,
+                                   padding=1, act="sigmoid")
+        y = fluid.layers.deformable_conv(x, offset, mask, num_filters=6,
+                                         filter_size=3, padding=1)
+        y5d = fluid.layers.reshape(y, [-1, 6, 2, 8, 4])
+        up = fluid.layers.conv3d_transpose(y5d, num_filters=3,
+                                           filter_size=2, stride=2)
+        loss = fluid.layers.mean(fluid.layers.square(up))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    X = np.random.RandomState(1).rand(2, 4, 8, 8).astype("float32")
+    scope = core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        l1, = exe.run(main, feed={"img": X}, fetch_list=[loss])
+        for _ in range(3):
+            l2, = exe.run(main, feed={"img": X}, fetch_list=[loss])
+    assert np.isfinite(l1[0]) and float(l2[0]) < float(l1[0])
+
+
+def test_roi_and_grid_layers():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("feat", shape=[8, 10, 10], dtype="float32")
+        rois = fluid.layers.data("rois", shape=[4], dtype="float32",
+                                 lod_level=1)
+        theta = fluid.layers.data("theta", shape=[2, 3], dtype="float32")
+        pp = fluid.layers.psroi_pool(x, rois, output_channels=2,
+                                     spatial_scale=1.0, pooled_height=2,
+                                     pooled_width=2)
+        ra = fluid.layers.roi_align(x, rois, pooled_height=2,
+                                    pooled_width=2)
+        grid = fluid.layers.affine_grid(theta, out_shape=[1, 8, 5, 5])
+    X = np.random.RandomState(2).rand(1, 8, 10, 10).astype("float32")
+    R = np.array([[0, 0, 7, 7], [2, 2, 9, 9]], np.float32)
+    T = np.array([[[1, 0, 0], [0, 1, 0]]], np.float32)
+    scope = core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rt = core.LoDTensor(R)
+        rt.set_recursive_sequence_lengths([[2]])
+        o_pp, o_ra, o_g = exe.run(main, feed={"feat": X, "rois": rt,
+                                              "theta": T},
+                                  fetch_list=[pp, ra, grid])
+    assert o_pp.shape == (2, 2, 2, 2)
+    assert o_ra.shape == (2, 8, 2, 2)
+    assert o_g.shape == (1, 5, 5, 2)
+    # identity theta -> grid spans [-1,1]
+    np.testing.assert_allclose(o_g[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(o_g[0, -1, -1], [1, 1], atol=1e-6)
+
+
+def test_hash_and_misc_layers():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        h = fluid.layers.hash(ids, hash_size=1000, num_hash=3)
+        a = fluid.layers.data("a", shape=[6], dtype="float32")
+        b = fluid.layers.data("b", shape=[6], dtype="float32")
+        cs = fluid.layers.cos_sim(a, b)
+    I = np.array([[7], [7], [9]], np.int64)
+    A = np.random.RandomState(3).rand(3, 6).astype("float32")
+    o_h, o_cs = _run(main, startup, {"ids": I, "a": A, "b": A}, [h, cs])
+    assert o_h.shape == (3, 3, 1)
+    assert (o_h >= 0).all() and (o_h < 1000).all()
+    np.testing.assert_array_equal(o_h[0], o_h[1])   # same id, same buckets
+    assert (o_h[0] != o_h[2]).any()                 # different id differs
+    np.testing.assert_allclose(o_cs.ravel(), 1.0, rtol=1e-5)  # cos(x,x)=1

@@ -1,0 +1,325 @@
+"""Vision op batch 2 tests (reference: tests/unittests/test_crop_op.py,
+test_affine_grid_op.py, test_unpool_op.py, test_spp_op.py,
+test_psroi_pool_op.py, test_prroi_pool_op.py, test_conv3d_transpose_op.py,
+test_deformable_conv_op.py, test_conv_shift_op.py,
+test_bicubic_interp_op.py, test_trilinear_interp_op.py,
+test_polygon_box_transform.py, test_inplace_abn_op.py).
+
+Numeric oracles are torch CPU where the semantics coincide, else numpy."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from tests.test_sequence_ops import run_seq_op
+
+
+def test_crop_and_crop_tensor():
+    x = np.arange(60, dtype=np.float32).reshape(3, 4, 5)
+    (o,), _ = run_seq_op("crop", x, None,
+                         attrs={"offsets": [1, 0, 2], "shape": [2, 3, 3]})
+    np.testing.assert_array_equal(o, x[1:3, 0:3, 2:5])
+    sh = np.array([2, 2, 2], np.int32)
+    off = np.array([0, 1, 1], np.int32)
+    (o2,), _ = run_seq_op("crop_tensor", x, None,
+                          extra_inputs=[("Shape", sh, None),
+                                        ("Offsets", off, None)])
+    np.testing.assert_array_equal(o2, x[0:2, 1:3, 1:3])
+
+
+def test_affine_grid_matches_torch():
+    theta = np.random.RandomState(0).rand(2, 2, 3).astype(np.float32)
+    (o,), _ = run_seq_op("affine_grid", theta, None, x_slot="Theta",
+                         attrs={"output_shape": [2, 3, 4, 5],
+                                "align_corners": True},
+                         outputs=("Output",))
+    ref = F.affine_grid(torch.from_numpy(theta), (2, 3, 4, 5),
+                        align_corners=True).numpy()
+    np.testing.assert_allclose(o, ref, atol=1e-5)
+
+
+def test_unpool_inverts_max_pool_with_index():
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 3, 4, 4).astype(np.float32)
+    (pooled, mask), _ = run_seq_op(
+        "max_pool2d_with_index", x, None,
+        attrs={"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]},
+        outputs=("Out", "Mask"))
+    (up,), _ = run_seq_op(
+        "unpool", pooled, None,
+        extra_inputs=[("Indices", mask, None)],
+        attrs={"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+               "unpooling_type": "max"})
+    assert up.shape == x.shape
+    # unpooled plane holds exactly the pooled maxima at their argmax spots
+    np.testing.assert_allclose(up.sum(axis=(2, 3)), pooled.sum(axis=(2, 3)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(up.max(axis=(2, 3)), pooled.max(axis=(2, 3)),
+                               rtol=1e-6)
+
+
+def test_spp_levels():
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    (o,), _ = run_seq_op("spp", x, None,
+                         attrs={"pyramid_height": 2, "pooling_type": "max"})
+    assert o.shape == (2, 3 * (1 + 4))
+    np.testing.assert_allclose(o[:, :3], x.max(axis=(2, 3)), rtol=1e-6)
+    # level 1: 2x2 bins of 4x4
+    ref = x.reshape(2, 3, 2, 4, 2, 4).max(axis=(3, 5)).reshape(2, 12)
+    np.testing.assert_allclose(o[:, 3:], ref, rtol=1e-6)
+
+
+def test_psroi_pool_constant_plane():
+    # constant input per channel -> each output bin equals the channel value
+    ph = pw = 2
+    oc = 2
+    c = oc * ph * pw
+    x = np.arange(c, dtype=np.float32).reshape(1, c, 1, 1) * np.ones(
+        (1, c, 6, 6), np.float32)
+    rois = np.array([[0.0, 0.0, 5.0, 5.0]], np.float32)
+    (o,), _ = run_seq_op("psroi_pool", x, None,
+                         extra_inputs=[("ROIs", rois, [[1]])],
+                         attrs={"output_channels": oc, "spatial_scale": 1.0,
+                                "pooled_height": ph, "pooled_width": pw})
+    assert o.shape == (1, oc, ph, pw)
+    expect = np.arange(c, dtype=np.float32).reshape(oc, ph, pw)
+    np.testing.assert_allclose(o[0], expect, rtol=1e-5)
+
+
+def test_prroi_pool_mean_of_region():
+    x = np.ones((1, 2, 8, 8), np.float32) * \
+        np.array([3.0, 7.0], np.float32).reshape(1, 2, 1, 1)
+    rois = np.array([[1.0, 1.0, 7.0, 7.0]], np.float32)
+    (o,), _ = run_seq_op("prroi_pool", x, None,
+                         extra_inputs=[("ROIs", rois, [[1]])],
+                         attrs={"spatial_scale": 1.0, "pooled_height": 2,
+                                "pooled_width": 2})
+    assert o.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(o[0, 0], 3.0, rtol=1e-5)
+    np.testing.assert_allclose(o[0, 1], 7.0, rtol=1e-5)
+
+
+def test_conv3d_transpose_matches_torch():
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 4, 3, 5, 5).astype(np.float32)
+    w = rng.rand(4, 3, 2, 3, 3).astype(np.float32)  # [in, out, kd, kh, kw]
+    (o,), _ = run_seq_op("conv3d_transpose", x, None, x_slot="Input",
+                         extra_inputs=[("Filter", w, None)],
+                         attrs={"strides": [2, 1, 2], "paddings": [1, 0, 1],
+                                "dilations": [1, 1, 1]},
+                         outputs=("Output",))
+    ref = F.conv_transpose3d(torch.from_numpy(x), torch.from_numpy(w),
+                             stride=(2, 1, 2), padding=(1, 0, 1)).numpy()
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_depthwise_conv2d_transpose_matches_torch():
+    rng = np.random.RandomState(4)
+    x = rng.rand(1, 4, 6, 6).astype(np.float32)
+    w = rng.rand(4, 1, 3, 3).astype(np.float32)
+    (o,), _ = run_seq_op("depthwise_conv2d_transpose", x, None,
+                         x_slot="Input",
+                         extra_inputs=[("Filter", w, None)],
+                         attrs={"strides": [2, 2], "paddings": [1, 1],
+                                "dilations": [1, 1], "groups": 4},
+                         outputs=("Output",))
+    ref = F.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                             stride=2, padding=1, groups=4).numpy()
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("op,extra", [("deformable_conv", True),
+                                      ("deformable_conv_v1", False)])
+def test_deformable_conv_zero_offset_is_conv(op, extra):
+    rng = np.random.RandomState(5)
+    x = rng.rand(2, 4, 5, 5).astype(np.float32)
+    w = rng.rand(6, 4, 3, 3).astype(np.float32)
+    offset = np.zeros((2, 2 * 9, 5, 5), np.float32)
+    mask = np.ones((2, 9, 5, 5), np.float32)
+    extra_inputs = [("Offset", offset, None), ("Filter", w, None)]
+    if extra:
+        extra_inputs.insert(1, ("Mask", mask, None))
+    (o,), _ = run_seq_op(op, x, None, x_slot="Input",
+                         extra_inputs=extra_inputs,
+                         attrs={"strides": [1, 1], "paddings": [1, 1],
+                                "dilations": [1, 1], "groups": 1,
+                                "deformable_groups": 1},
+                         outputs=("Output",))
+    ref = F.conv2d(torch.from_numpy(x), torch.from_numpy(w), padding=1).numpy()
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv_shift_circular():
+    rng = np.random.RandomState(6)
+    x = rng.rand(3, 7).astype(np.float32)
+    y = rng.rand(3, 3).astype(np.float32)
+    (o,), _ = run_seq_op("conv_shift", x, None,
+                         extra_inputs=[("Y", y, None)])
+    W, K = 7, 3
+    ref = np.zeros_like(x)
+    for i in range(3):
+        for j in range(W):
+            ref[i, j] = sum(x[i, (j + k - K // 2) % W] * y[i, k]
+                            for k in range(K))
+    np.testing.assert_allclose(o, ref, rtol=1e-5)
+
+
+def test_bicubic_interp_matches_torch():
+    rng = np.random.RandomState(7)
+    x = rng.rand(2, 3, 6, 6).astype(np.float32)
+    (o,), _ = run_seq_op("bicubic_interp", x, None,
+                         attrs={"out_h": 9, "out_w": 12,
+                                "align_corners": True})
+    ref = F.interpolate(torch.from_numpy(x), size=(9, 12), mode="bicubic",
+                        align_corners=True).numpy()
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_trilinear_interp_matches_torch():
+    rng = np.random.RandomState(8)
+    x = rng.rand(1, 2, 4, 5, 6).astype(np.float32)
+    (o,), _ = run_seq_op("trilinear_interp", x, None,
+                         attrs={"out_d": 6, "out_h": 8, "out_w": 9,
+                                "align_corners": True})
+    ref = F.interpolate(torch.from_numpy(x), size=(6, 8, 9),
+                        mode="trilinear", align_corners=True).numpy()
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_polygon_box_transform():
+    x = np.zeros((1, 4, 2, 3), np.float32)
+    x[0, 0, 1, 2] = 1.0   # x-channel offset
+    x[0, 1, 1, 2] = 2.0   # y-channel offset
+    (o,), _ = run_seq_op("polygon_box_transform", x, None, x_slot="Input",
+                         outputs=("Output",))
+    assert o[0, 0, 1, 2] == 4 * 2 - 1.0
+    assert o[0, 1, 1, 2] == 4 * 1 - 2.0
+    assert o[0, 2, 0, 0] == 0.0
+
+
+def test_similarity_focus_mask():
+    rng = np.random.RandomState(9)
+    x = rng.rand(2, 3, 4, 5).astype(np.float32)
+    (o,), _ = run_seq_op("similarity_focus", x, None,
+                         attrs={"axis": 1, "indexes": [0]})
+    assert o.shape == x.shape
+    assert set(np.unique(o)).issubset({0.0, 1.0})
+    # every row of the selected channel contributes at least one 1
+    assert (o[:, 0].sum(axis=2) >= 1).all()
+
+
+def test_similarity_focus_axis2():
+    rng = np.random.RandomState(11)
+    x = rng.rand(2, 3, 4, 5).astype(np.float32)
+    (o,), _ = run_seq_op("similarity_focus", x, None,
+                         attrs={"axis": 2, "indexes": [1]})
+    assert o.shape == x.shape
+    assert set(np.unique(o)).issubset({0.0, 1.0})
+
+
+def test_trilinear_interp_size_tensor():
+    rng = np.random.RandomState(12)
+    x = rng.rand(1, 2, 4, 4, 4).astype(np.float32)
+    sizes = [("d", np.array([6], np.int32)), ("h", np.array([8], np.int32)),
+             ("w", np.array([8], np.int32))]
+    (o,), _ = run_seq_op(
+        "trilinear_interp", x, None,
+        extra_inputs=[("SizeTensor", s, None) for _, s in sizes],
+        attrs={"align_corners": True})
+    ref = F.interpolate(torch.from_numpy(x), size=(6, 8, 8),
+                        mode="trilinear", align_corners=True).numpy()
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_border_zero_padding():
+    # a sample half a pixel above the image keeps weight 0.5 on row 0
+    x = np.ones((1, 1, 2, 2), np.float32)
+    w = np.ones((1, 1, 1, 1), np.float32)
+    offset = np.zeros((1, 2, 2, 2), np.float32)
+    offset[0, 0] = -0.5  # dy = -0.5 everywhere
+    (o,), _ = run_seq_op("deformable_conv_v1", x, None, x_slot="Input",
+                         extra_inputs=[("Offset", offset, None),
+                                       ("Filter", w, None)],
+                         attrs={"strides": [1, 1], "paddings": [0, 0],
+                                "dilations": [1, 1], "groups": 1,
+                                "deformable_groups": 1},
+                         outputs=("Output",))
+    np.testing.assert_allclose(o[0, 0, 0], 0.5, rtol=1e-6)  # half outside
+    np.testing.assert_allclose(o[0, 0, 1], 1.0, rtol=1e-6)  # interior
+
+
+def test_inplace_abn_is_bn_plus_activation():
+    rng = np.random.RandomState(10)
+    x = rng.rand(4, 3, 5, 5).astype(np.float32)
+    scale = np.ones(3, np.float32)
+    bias = np.zeros(3, np.float32)
+    mean = np.zeros(3, np.float32)
+    var = np.ones(3, np.float32)
+    (y,), _ = run_seq_op(
+        "inplace_abn", x, None,
+        extra_inputs=[("Scale", scale, None), ("Bias", bias, None),
+                      ("Mean", mean, None), ("Variance", var, None)],
+        attrs={"is_test": True, "epsilon": 1e-5, "use_global_stats": True,
+               "activation": "leaky_relu", "alpha": 0.01},
+        outputs=("Y",))
+    ref = F.leaky_relu(
+        F.batch_norm(torch.from_numpy(x), torch.zeros(3), torch.ones(3),
+                     torch.ones(3), torch.zeros(3), training=False,
+                     eps=1e-5), 0.01).numpy()
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_max_pool3d_with_index_and_output_size_grow():
+    rng = np.random.RandomState(13)
+    x = rng.rand(1, 2, 4, 4, 4).astype(np.float32)
+    (o, mask), _ = run_seq_op(
+        "max_pool3d_with_index", x, None,
+        attrs={"ksize": [2, 2, 2], "strides": [2, 2, 2],
+               "paddings": [0, 0, 0]}, outputs=("Out", "Mask"))
+    ref = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).transpose(
+        0, 1, 2, 4, 6, 3, 5, 7).reshape(1, 2, 2, 2, 2, 8).max(-1)
+    np.testing.assert_allclose(o, ref, rtol=1e-6)
+    # mask holds flat D*H*W indices of the maxima
+    flat = x.reshape(1, 2, 64)
+    np.testing.assert_allclose(
+        np.take_along_axis(flat, mask.reshape(1, 2, 8), axis=2).reshape(o.shape),
+        o, rtol=1e-6)
+    # adaptive variant
+    (oa, ma), _ = run_seq_op(
+        "max_pool3d_with_index", x, None,
+        attrs={"ksize": [2, 2, 2], "adaptive": True,
+               "strides": [1, 1, 1], "paddings": [0, 0, 0]},
+        outputs=("Out", "Mask"))
+    np.testing.assert_allclose(oa, ref, rtol=1e-6)
+
+    # conv2d_transpose output_size one larger than natural -> padded up
+    xc = rng.rand(1, 2, 4, 4).astype(np.float32)
+    w = rng.rand(2, 3, 3, 3).astype(np.float32)
+    (oc,), _ = run_seq_op("conv2d_transpose", xc, None, x_slot="Input",
+                          extra_inputs=[("Filter", w, None)],
+                          attrs={"strides": [2, 2], "paddings": [0, 0],
+                                 "dilations": [1, 1],
+                                 "output_size": [10, 10]},
+                          outputs=("Output",))
+    assert oc.shape == (1, 3, 10, 10)
+    nat = F.conv_transpose2d(torch.from_numpy(xc), torch.from_numpy(w),
+                             stride=2).numpy()  # natural 9x9
+    np.testing.assert_allclose(oc[:, :, :9, :9], nat, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(oc[:, :, 9, :], 0.0)
+
+
+def test_sampling_id_distribution():
+    probs = np.tile(np.array([[0.0, 1.0, 0.0]], np.float32), (64, 1))
+    (o,), _ = run_seq_op("sampling_id", probs, None)
+    assert (o == 1).all()
+
+
+def test_lrn_nhwc_matches_nchw():
+    rng = np.random.RandomState(14)
+    x = rng.rand(2, 4, 5, 6).astype(np.float32)
+    (o_nchw,), _ = run_seq_op("lrn", x, None, attrs={"n": 3})
+    (o_nhwc,), _ = run_seq_op("lrn", x.transpose(0, 2, 3, 1).copy(), None,
+                              attrs={"n": 3, "data_format": "NHWC"})
+    np.testing.assert_allclose(o_nhwc.transpose(0, 3, 1, 2), o_nchw,
+                               rtol=1e-5)
