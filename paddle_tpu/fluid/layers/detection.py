@@ -19,6 +19,7 @@ __all__ = [
     "box_clip", "multiclass_nms", "locality_aware_nms",
     "retinanet_detection_output", "distribute_fpn_proposals",
     "box_decoder_and_assign", "collect_fpn_proposals",
+    "detection_map",
 ]
 
 
@@ -388,3 +389,34 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     boxes = concat(boxes_l, axis=0)
     variances = concat(vars_l, axis=0)
     return mbox_locs, mbox_confs, boxes, variances
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral"):
+    helper = LayerHelper("detection_map", **locals())
+    map_out = helper.create_variable_for_type_inference(VarDesc.VarType.FP32)
+    pos_count = helper.create_variable_for_type_inference(
+        VarDesc.VarType.INT32)
+    true_pos = helper.create_variable_for_type_inference(VarDesc.VarType.FP32)
+    false_pos = helper.create_variable_for_type_inference(
+        VarDesc.VarType.FP32)
+    inputs = {"DetectRes": [detect_res], "Label": [label]}
+    if has_state is not None:
+        inputs["HasState"] = [has_state]
+    if input_states is not None:
+        inputs["PosCount"] = [input_states[0]]
+        inputs["TruePos"] = [input_states[1]]
+        inputs["FalsePos"] = [input_states[2]]
+    if out_states is not None:
+        pos_count, true_pos, false_pos = out_states
+    helper.append_op(
+        type="detection_map", inputs=inputs,
+        outputs={"MAP": [map_out], "AccumPosCount": [pos_count],
+                 "AccumTruePos": [true_pos], "AccumFalsePos": [false_pos]},
+        attrs={"overlap_threshold": overlap_threshold,
+               "class_num": class_num, "background_label": background_label,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_type": ap_version})
+    return map_out

@@ -44,7 +44,7 @@ __all__ = [
     "sampling_id", "shuffle_channel", "adaptive_pool3d", "inplace_abn",
     "conv3d_transpose", "resize_trilinear", "image_resize_short",
     "affine_grid", "psroi_pool", "prroi_pool", "deformable_conv",
-    "deformable_roi_pooling",
+    "deformable_roi_pooling", "chunk_eval", "filter_by_instag",
 ]
 
 
@@ -2020,3 +2020,46 @@ def deformable_roi_pooling(input, rois, trans, no_trans=False,
                "part_size": list(part_size),
                "sample_per_part": sample_per_part, "trans_std": trans_std})
     return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    helper = LayerHelper("chunk_eval", **locals())
+    f32 = VarDesc.VarType.FP32
+    i64 = VarDesc.VarType.INT64
+    precision = helper.create_variable_for_type_inference(f32)
+    recall = helper.create_variable_for_type_inference(f32)
+    f1_score = helper.create_variable_for_type_inference(f32)
+    num_infer = helper.create_variable_for_type_inference(i64)
+    num_label = helper.create_variable_for_type_inference(i64)
+    num_correct = helper.create_variable_for_type_inference(i64)
+    inputs = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        inputs["SeqLength"] = [seq_length]
+    helper.append_op(
+        type="chunk_eval", inputs=inputs,
+        outputs={"Precision": [precision], "Recall": [recall],
+                 "F1-Score": [f1_score], "NumInferChunks": [num_infer],
+                 "NumLabelChunks": [num_label],
+                 "NumCorrectChunks": [num_correct]},
+        attrs={"num_chunk_types": num_chunk_types,
+               "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": list(excluded_chunk_types or [])})
+    return (precision, recall, f1_score, num_infer, num_label, num_correct)
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod, out_val_if_empty=0):
+    helper = LayerHelper("filter_by_instag", **locals())
+    out = helper.create_variable_for_type_inference(ins.dtype)
+    loss_weight = helper.create_variable_for_type_inference(
+        VarDesc.VarType.FP32)
+    index_map = helper.create_variable_for_type_inference(
+        VarDesc.VarType.INT64)
+    helper.append_op(
+        type="filter_by_instag",
+        inputs={"Ins": [ins], "Ins_tag": [ins_tag],
+                "Filter_tag": [filter_tag]},
+        outputs={"Out": [out], "LossWeight": [loss_weight],
+                 "IndexMap": [index_map]},
+        attrs={"is_lod": is_lod, "out_val_if_empty": out_val_if_empty})
+    return out, loss_weight, index_map

@@ -21,3 +21,5 @@ from . import loss_extra_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import vision_ops  # noqa: F401
 from . import misc_ops   # noqa: F401
+from . import fused_ops  # noqa: F401
+from . import metrics_misc_ops  # noqa: F401

@@ -606,7 +606,7 @@ def _roi_align(ins, attrs):
     return {"Out": [o]}
 
 
-@register_op("roi_pool", needs_lod=True, diff_inputs=["X"],
+@register_op("roi_pool", stateful=True, needs_lod=True, diff_inputs=["X"],
              attr_defaults={"pooled_height": 1, "pooled_width": 1,
                             "spatial_scale": 1.0})
 def _roi_pool(ins, attrs):

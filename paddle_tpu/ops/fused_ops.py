@@ -1,0 +1,400 @@
+"""Fused-op family (reference: paddle/fluid/operators/fused/ and the
+fusion_* CPU ops, plus fc_op.cc and conv2d_fusion_op.cc).
+
+TPU inversion: the reference hand-fuses these for CUDA/CPU performance;
+under XLA the composition below compiles into the same fused kernels
+automatically, so each op here is a plain composition of primitives with
+the reference's slot/attr contract. CUDA-codegen-only ops (fusion_group)
+raise with an explanation — the pass that emits them never runs on TPU."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, first, seq, out
+
+
+def _act(name, x, alpha=0.0):
+    if name in (None, "", "identity", "linear"):
+        return x
+    if name == "relu":
+        return jnp.maximum(x, 0)
+    if name == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if name == "tanh":
+        return jnp.tanh(x)
+    if name == "gelu":
+        return jax.nn.gelu(x)
+    if name == "leaky_relu":
+        return jnp.where(x > 0, x, alpha * x)
+    if name == "relu6":
+        return jnp.clip(x, 0, 6)
+    if name == "swish":
+        return x * jax.nn.sigmoid(x)
+    raise NotImplementedError(f"activation '{name}' in fused op")
+
+
+# --------------------------------------------------------------------------
+# fc — the standalone fc op (reference fc_op.cc; layers emit mul+add, the
+# fc_fuse_pass and serialized inference programs emit this)
+# --------------------------------------------------------------------------
+@register_op("fc", inputs=("Input", "W", "Bias"),
+             diff_inputs=("Input", "W", "Bias"),
+             attr_defaults={"in_num_col_dims": 1,
+                            "activation_type": "",
+                            "use_mkldnn": False})
+def _fc(ins, attrs):
+    x, w = first(ins, "Input"), first(ins, "W")
+    nd = int(attrs.get("in_num_col_dims", 1))
+    lead = x.shape[:nd]
+    xf = x.reshape((int(np.prod(lead)), -1))
+    o = xf @ w
+    b = first(ins, "Bias")
+    if b is not None:
+        o = o + b.reshape(1, -1)
+    o = _act(attrs.get("activation_type", ""), o)
+    return out(Out=o.reshape(lead + (w.shape[1],)))
+
+
+# --------------------------------------------------------------------------
+# fused elementwise + activation (reference fused_elemwise_activation_op)
+# --------------------------------------------------------------------------
+_BINARY = {"elementwise_add": jnp.add, "elementwise_sub": jnp.subtract,
+           "elementwise_mul": jnp.multiply}
+
+
+@register_op("fused_elemwise_activation", inputs=("X", "Y"),
+             diff_inputs=("X", "Y"),
+             attr_defaults={"functor_list": [], "axis": -1, "scale": 0.0,
+                            "save_intermediate_out": False})
+def _fused_elemwise_activation(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    fl = list(attrs.get("functor_list") or [])
+    assert len(fl) == 2, "functor_list must hold [binary, unary] (either order)"
+
+    def apply1(name, v):
+        if name.startswith("scale"):
+            return v * attrs.get("scale", 1.0)
+        return _act(name, v)
+    axis = attrs.get("axis", -1)
+    yb = y
+    if y.ndim < x.ndim:
+        ax = axis if axis >= 0 else x.ndim - y.ndim
+        shape = [1] * x.ndim
+        for i, s in enumerate(y.shape):
+            shape[ax + i] = s
+        yb = y.reshape(shape)
+    if fl[0] in _BINARY:                       # binary(x, unary(y))
+        o = _BINARY[fl[0]](x, apply1(fl[1], yb))
+        inter = apply1(fl[1], yb)
+    else:                                      # unary(binary(x, y))
+        inter = _BINARY[fl[1]](x, yb)
+        o = apply1(fl[0], inter)
+    return out(Out=o, IntermediateOut=inter)
+
+
+@register_op("fused_batch_norm_act",
+             inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+             diff_inputs=("X", "Scale", "Bias"), stateful=True,
+             attr_defaults={"momentum": 0.9, "epsilon": 1e-5,
+                            "act_type": "relu", "is_test": False,
+                            "data_layout": "NCHW",
+                            "use_global_stats": False})
+def _fused_batch_norm_act(ins, attrs):
+    from .nn_ops import _batch_norm
+    r = _batch_norm(ins, attrs)
+    y = r["Y"][0] if isinstance(r["Y"], list) else r["Y"]
+    r["Y"] = [_act(attrs.get("act_type", "relu"), y)]
+    return r
+
+
+# --------------------------------------------------------------------------
+# embedding fusions
+# --------------------------------------------------------------------------
+@register_op("fused_embedding_eltwise_layernorm",
+             inputs=("Ids", "Embs", "Bias", "Scale"),
+             diff_inputs=("Embs", "Bias", "Scale"),
+             attr_defaults={"epsilon": 1e-5})
+def _fused_embedding_eltwise_layernorm(ins, attrs):
+    ids_list, emb_list = seq(ins, "Ids"), seq(ins, "Embs")
+    acc = None
+    for ids, emb in zip(ids_list, emb_list):
+        idv = ids.reshape(ids.shape[0], -1)[:, :]  # [N, T] or [N,T,1]
+        if ids.ndim == 3:
+            idv = ids[..., 0]
+        v = emb[idv]
+        acc = v if acc is None else acc + v
+    eps = attrs.get("epsilon", 1e-5)
+    mu = jnp.mean(acc, -1, keepdims=True)
+    var = jnp.var(acc, -1, keepdims=True)
+    o = (acc - mu) / jnp.sqrt(var + eps)
+    scale, bias = first(ins, "Scale"), first(ins, "Bias")
+    if scale is not None:
+        o = o * scale
+    if bias is not None:
+        o = o + bias
+    return out(Out=o)
+
+
+@register_op("fused_embedding_seq_pool", inputs=("W", "Ids"),
+             diff_inputs=("W",), needs_lod=True,
+             attr_defaults={"combiner": "sum", "is_sparse": False})
+def _fused_embedding_seq_pool(ins, attrs):
+    w, ids = first(ins, "W"), first(ins, "Ids")
+    lods = (attrs.get("_lod") or {}).get("Ids")
+    offs = (np.asarray(lods[0][-1], np.int64) if lods and lods[0]
+            else np.asarray([0, ids.shape[0]], np.int64))
+    flat = ids.reshape(-1)
+    emb = w[flat]                      # [T, D]
+    segs = np.repeat(np.arange(len(offs) - 1), offs[1:] - offs[:-1])
+    o = jax.ops.segment_sum(emb, jnp.asarray(segs),
+                            num_segments=len(offs) - 1)
+    return out(Out=o)
+
+
+@register_op("fused_fc_elementwise_layernorm",
+             inputs=("X", "W", "Bias0", "Y", "Scale", "Bias1"),
+             diff_inputs=("X", "W", "Bias0", "Y", "Scale", "Bias1"),
+             attr_defaults={"epsilon": 1e-5, "begin_norm_axis": 1,
+                            "activation_type": "", "x_num_col_dims": 1})
+def _fused_fc_elementwise_layernorm(ins, attrs):
+    x, w = first(ins, "X"), first(ins, "W")
+    nd = int(attrs.get("x_num_col_dims", 1))
+    lead = x.shape[:nd]
+    o = x.reshape((int(np.prod(lead)), -1)) @ w
+    b0 = first(ins, "Bias0")
+    if b0 is not None:
+        o = o + b0.reshape(1, -1)
+    o = o.reshape(lead + (w.shape[1],))
+    y = first(ins, "Y")
+    o = o + y
+    eps = attrs.get("epsilon", 1e-5)
+    mu = jnp.mean(o, -1, keepdims=True)
+    var = jnp.var(o, -1, keepdims=True)
+    o = (o - mu) / jnp.sqrt(var + eps)
+    scale, b1 = first(ins, "Scale"), first(ins, "Bias1")
+    if scale is not None:
+        o = o * scale
+    if b1 is not None:
+        o = o + b1
+    return out(Out=o)
+
+
+# --------------------------------------------------------------------------
+# fused recurrent (fusion_gru / fusion_lstm: x-projection folded in)
+# --------------------------------------------------------------------------
+@register_op("fusion_gru", needs_lod=True,
+             inputs=("X", "WeightX", "WeightH", "Bias", "H0"),
+             diff_inputs=("X", "WeightX", "WeightH", "Bias", "H0"),
+             attr_defaults={"is_reverse": False, "origin_mode": False,
+                            "use_seq": True, "activation": "tanh",
+                            "gate_activation": "sigmoid"})
+def _fusion_gru(ins, attrs):
+    from .rnn_ops import _dynamic_gru
+    x, wx = first(ins, "X"), first(ins, "WeightX")
+    xx = x @ wx
+    ins2 = dict(ins)
+    ins2["Input"] = [xx]
+    ins2["Weight"] = ins.get("WeightH")
+    lod = dict(attrs.get("_lod") or {})
+    lod["Input"] = lod.get("X")
+    r = _dynamic_gru(ins2, {**attrs, "_lod": lod})
+    xlod = (lod.get("X") or [None])[0]
+    return {"Hidden": r["Hidden"], "XX": [xx],
+            "_lod": {"Hidden": [xlod], "XX": [xlod]}}
+
+
+@register_op("fusion_lstm", needs_lod=True,
+             inputs=("X", "WeightX", "WeightH", "Bias", "H0", "C0"),
+             diff_inputs=("X", "WeightX", "WeightH", "Bias", "H0", "C0"),
+             attr_defaults={"use_peepholes": False, "is_reverse": False,
+                            "gate_activation": "sigmoid",
+                            "cell_activation": "tanh",
+                            "candidate_activation": "tanh"})
+def _fusion_lstm(ins, attrs):
+    from .rnn_ops import _dyn_lstm_common
+    x, wx = first(ins, "X"), first(ins, "WeightX")
+    xx = x @ wx
+    ins2 = dict(ins)
+    ins2["Input"] = [xx]
+    ins2["Weight"] = ins.get("WeightH")
+    lod = dict(attrs.get("_lod") or {})
+    lod["Input"] = lod.get("X")
+    h, c = _dyn_lstm_common(ins2, {**attrs, "_lod": lod})
+    xlod = (lod.get("X") or [None])[0]
+    return {"Hidden": [h], "Cell": [c], "XX": [xx],
+            "_lod": {"Hidden": [xlod], "Cell": [xlod], "XX": [xlod]}}
+
+
+# --------------------------------------------------------------------------
+# misc CPU fusions as compositions
+# --------------------------------------------------------------------------
+@register_op("fusion_repeated_fc_relu", inputs=("X", "W", "Bias"),
+             diff_inputs=("X", "W", "Bias"))
+def _fusion_repeated_fc_relu(ins, attrs):
+    x = first(ins, "X")
+    ws, bs = seq(ins, "W"), seq(ins, "Bias")
+    h = x
+    relu_outs = []
+    for i, (w, b) in enumerate(zip(ws, bs)):
+        h = h @ w + b.reshape(1, -1)
+        h = jnp.maximum(h, 0)
+        relu_outs.append(h)
+    return {"Out": [h], "ReluOut": relu_outs[:-1]}
+
+
+@register_op("fusion_seqconv_eltadd_relu", needs_lod=True,
+             inputs=("X", "Filter", "Bias"),
+             diff_inputs=("X", "Filter", "Bias"),
+             attr_defaults={"contextLength": 3, "contextStart": -1,
+                            "contextStride": 1})
+def _fusion_seqconv_eltadd_relu(ins, attrs):
+    from .sequence_ops import _sequence_conv
+    r = _sequence_conv(ins, attrs)
+    o = r["Out"][0] if isinstance(r["Out"], list) else r["Out"]
+    b = first(ins, "Bias")
+    o = jnp.maximum(o + b.reshape(1, -1), 0)
+    colmat = jnp.zeros((o.shape[0], 1), o.dtype)
+    return {"Out": [o], "ColMat": [colmat],
+            **({"_lod": r["_lod"]} if "_lod" in r else {})}
+
+
+@register_op("fusion_seqpool_concat", needs_lod=True, inputs=("X",),
+             attr_defaults={"pooltype": "SUM", "axis": 1})
+def _fusion_seqpool_concat(ins, attrs):
+    pools = []
+    lods = (attrs.get("_lod") or {}).get("X") or []
+    for i, x in enumerate(seq(ins, "X")):
+        lod = lods[i] if i < len(lods) else None
+        offs = (np.asarray(lod[-1], np.int64) if lod
+                else np.asarray([0, x.shape[0]], np.int64))
+        segs = np.repeat(np.arange(len(offs) - 1), offs[1:] - offs[:-1])
+        s = jax.ops.segment_sum(x, jnp.asarray(segs),
+                                num_segments=len(offs) - 1)
+        if attrs.get("pooltype", "SUM") == "AVERAGE":
+            cnt = jnp.asarray(offs[1:] - offs[:-1], x.dtype)[:, None]
+            s = s / jnp.maximum(cnt, 1)
+        pools.append(s)
+    return out(Out=jnp.concatenate(pools, axis=attrs.get("axis", 1)))
+
+
+@register_op("fusion_seqpool_cvm_concat", needs_lod=True,
+             inputs=("X", "CVM"),
+             attr_defaults={"pooltype": "SUM", "use_cvm": True, "axis": 1})
+def _fusion_seqpool_cvm_concat(ins, attrs):
+    """seqpool each input, apply the CVM transform per pooled segment
+    (log1p of the show/click columns when use_cvm, else drop them —
+    reference fusion_seqpool_cvm_concat_op.cc), then concat."""
+    lods = (attrs.get("_lod") or {}).get("X") or []
+    pieces = []
+    for i, x in enumerate(seq(ins, "X")):
+        lod = lods[i] if i < len(lods) else None
+        offs = (np.asarray(lod[-1], np.int64) if lod
+                else np.asarray([0, x.shape[0]], np.int64))
+        segs = np.repeat(np.arange(len(offs) - 1), offs[1:] - offs[:-1])
+        s = jax.ops.segment_sum(x, jnp.asarray(segs),
+                                num_segments=len(offs) - 1)
+        if attrs.get("pooltype", "SUM") == "AVERAGE":
+            cnt = jnp.asarray(offs[1:] - offs[:-1], x.dtype)[:, None]
+            s = s / jnp.maximum(cnt, 1)
+        if attrs.get("use_cvm", True):
+            show_clk = jnp.log(jnp.maximum(s[:, :2], 0.0) + 1.0)
+            s = jnp.concatenate([show_clk, s[:, 2:]], axis=1)
+        else:
+            s = s[:, 2:]
+        pieces.append(s)
+    return out(Out=jnp.concatenate(pieces, axis=attrs.get("axis", 1)))
+
+
+@register_op("fusion_squared_mat_sub", inputs=("X", "Y"),
+             diff_inputs=("X", "Y"), attr_defaults={"scalar": 1.0})
+def _fusion_squared_mat_sub(ins, attrs):
+    x, y = first(ins, "X"), first(ins, "Y")
+    xy = x @ y
+    sq = (x * x) @ (y * y)
+    s = attrs.get("scalar", 1.0)
+    return {"Out": [s * (xy * xy - sq)], "SquaredX": [x * x],
+            "SquaredY": [y * y], "SquaredXY": [xy * xy]}
+
+
+@register_op("fusion_transpose_flatten_concat", inputs=("X",),
+             attr_defaults={"trans_axis": [0, 1, 2, 3], "flatten_axis": 1,
+                            "concat_axis": 1})
+def _fusion_transpose_flatten_concat(ins, attrs):
+    ta = [int(a) for a in attrs.get("trans_axis")]
+    fa = int(attrs.get("flatten_axis", 1))
+    ca = int(attrs.get("concat_axis", 1))
+    pieces = []
+    for x in seq(ins, "X"):
+        t = jnp.transpose(x, ta)
+        pieces.append(t.reshape(int(np.prod(t.shape[:fa])), -1))
+    return out(Out=jnp.concatenate(pieces, axis=ca))
+
+
+@register_op("fusion_seqexpand_concat_fc", needs_lod=True,
+             inputs=("X", "FCWeight", "FCBias"),
+             diff_inputs=("FCWeight", "FCBias"),
+             attr_defaults={"fc_activation": "identity"})
+def _fusion_seqexpand_concat_fc(ins, attrs):
+    """First X input carries LoD [T, D0]; the rest are per-sequence rows
+    [N, Di] broadcast (seq_expand) to each timestep, all concat'd then
+    passed through one fc (reference fusion_seqexpand_concat_fc_op.cc)."""
+    xs = seq(ins, "X")
+    lods = (attrs.get("_lod") or {}).get("X") or []
+    lod0 = lods[0] if lods else None
+    offs = (np.asarray(lod0[-1], np.int64) if lod0
+            else np.asarray([0, xs[0].shape[0]], np.int64))
+    reps = offs[1:] - offs[:-1]
+    row_of = jnp.asarray(np.repeat(np.arange(len(reps)), reps))
+    cols = [xs[0]] + [jnp.take(x, row_of, axis=0) for x in xs[1:]]
+    cat = jnp.concatenate(cols, axis=1)
+    w, b = first(ins, "FCWeight"), first(ins, "FCBias")
+    o = cat @ w
+    if b is not None:
+        o = o + b.reshape(1, -1)
+    o = _act(attrs.get("fc_activation", "identity"), o)
+    lodout = {"Out": [lod0]} if lod0 else {}
+    return {"Out": [o], "FCOut": [o], **({"_lod": lodout} if lodout else {})}
+
+
+@register_op("conv2d_fusion",
+             inputs=("Input", "Filter", "Bias", "ResidualData"),
+             diff_inputs=("Input", "Filter", "Bias"),
+             attr_defaults={"strides": [1, 1], "paddings": [0, 0],
+                            "dilations": [1, 1], "groups": 1,
+                            "activation": "relu",
+                            "padding_algorithm": "EXPLICIT",
+                            "data_format": "NCHW", "use_cudnn": True})
+def _conv2d_fusion(ins, attrs):
+    from .nn_ops import _conv2d
+    r = _conv2d(ins, attrs)
+    o = r["Output"][0] if isinstance(r["Output"], list) else r["Output"]
+    res = first(ins, "ResidualData")
+    if res is not None:
+        o = o + res
+    return out(Output=_act(attrs.get("activation", "relu"), o))
+
+
+def _cuda_codegen_stub(name, why):
+    @register_op(name, no_grad=True)
+    def _stub(ins, attrs):
+        raise NotImplementedError(
+            f"{name}: {why} On TPU the equivalent fusion happens inside "
+            "XLA, and the IR pass that emits this op is never enabled.")
+    return _stub
+
+
+# pass-emitted CUDA/x86-codegen fusions with no TPU execution path:
+_cuda_codegen_stub("fusion_group",
+                   "runtime-compiled CUDA elementwise group "
+                   "(ir/fusion_group/code_generator.cc).")
+_cuda_codegen_stub("conv2d_inception_fusion",
+                   "cuDNN-specific 4-branch inception kernel.")
+_cuda_codegen_stub("attention_lstm",
+                   "x86-JIT fused attention LSTM (attention_lstm_op.cc); "
+                   "use the attention layers + dynamic_lstm composition.")
+_cuda_codegen_stub("fused_embedding_fc_lstm",
+                   "x86 fused embedding+fc+lstm (fused_embedding_fc_lstm_"
+                   "op.cc); compose lookup_table + fusion_lstm instead.")

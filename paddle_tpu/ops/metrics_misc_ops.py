@@ -1,0 +1,776 @@
+"""Metric + misc op batch (reference: chunk_eval_op.cc,
+precision_recall_op.cc, positive_negative_pair_op.cc, detection_map_op.cc,
+modified_huber_loss_op.cc, sample_logits_op.cc, partial_concat_op.cc,
+partial_sum_op.cc, batch_fc_op.cc, shuffle_batch_op.cc, fill_op.cc,
+fill_zeros_like_op.cc (fill_zeros_like2), coalesce_tensor_op.cc,
+get_places_op.cc, tdm_child_op.cc, tdm_sampler_op.cc, rank_attention_op.cc,
+tree_conv_op.cc, match_matrix_tensor_op.cc, var_conv_2d_op.cc,
+pyramid_hash_op.cc, sequence_topk_avg_pooling_op.cc, filter_by_instag_op.cc).
+
+Metric ops run host-side numpy (no_grad, stateful where they accumulate);
+compute ops are pure JAX."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, first, seq, out
+
+
+# --------------------------------------------------------------------------
+# chunk_eval — IOB/IOE/IOBES/plain chunking F1 (reference chunk_eval_op.h)
+# --------------------------------------------------------------------------
+def _extract_chunks(tags, scheme, num_types, excluded):
+    """Return the set of (begin, end, type) chunks of an int tag sequence.
+    Tag encoding (reference chunk_eval_op.h): tag = type*tag_num + pos,
+    pos order B,I[,E,S] per scheme; the O tag is num_types*tag_num."""
+    tag_num = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    o_tag = num_types * tag_num
+    chunks, state = [], {"start": None, "type": None}
+
+    def flush(end):
+        if state["start"] is not None and state["type"] not in excluded:
+            chunks.append((state["start"], end, state["type"]))
+        state["start"] = None
+    for i, t in enumerate(tags):
+        t = int(t)
+        if t < 0 or t >= o_tag:                  # O / invalid closes chunk
+            flush(i - 1)
+            continue
+        ty, pos = t // tag_num, t % tag_num
+        if scheme == "plain":
+            begins = True          # every tag is its own single-token chunk
+        elif scheme == "IOB":
+            begins = pos == 0 or state["start"] is None or ty != state["type"]
+        elif scheme == "IOE":
+            begins = state["start"] is None or ty != state["type"]
+        else:  # IOBES: B=0 I=1 E=2 S=3
+            begins = pos in (0, 3) or state["start"] is None \
+                or ty != state["type"]
+        if begins:
+            flush(i - 1)
+            state["start"], state["type"] = i, ty
+        if scheme == "plain" or (scheme == "IOE" and pos == 1) or \
+                (scheme == "IOBES" and pos in (2, 3)):
+            flush(i)
+    flush(len(tags) - 1)
+    return set(chunks)
+
+
+@register_op("chunk_eval", stateful=True, inputs=("Inference", "Label", "SeqLength"),
+             no_grad=True, needs_lod=True,
+             attr_defaults={"num_chunk_types": 1, "chunk_scheme": "IOB",
+                            "excluded_chunk_types": []})
+def _chunk_eval(ins, attrs):
+    inf_raw = np.asarray(first(ins, "Inference"))
+    lab_raw = np.asarray(first(ins, "Label"))
+    inf = inf_raw.reshape(-1)
+    lab = lab_raw.reshape(-1)
+    lods = (attrs.get("_lod") or {}).get("Inference")
+    seq_len = first(ins, "SeqLength")
+    if lods and lods[0]:
+        offs = np.asarray(lods[0][-1], np.int64)
+    elif seq_len is not None and inf_raw.ndim >= 2:
+        # padded [N, T] layout: per-row lengths delimit the sequences
+        T = inf_raw.shape[1]
+        lens = np.asarray(seq_len).reshape(-1)
+        starts = np.arange(len(lens)) * T
+        offs = None
+        spans = [(int(s), int(s + l)) for s, l in zip(starts, lens)]
+    else:
+        offs = np.asarray([0, len(inf)], np.int64)
+    scheme = attrs.get("chunk_scheme", "IOB")
+    nt = int(attrs.get("num_chunk_types", 1))
+    excl = set(attrs.get("excluded_chunk_types") or [])
+    if offs is not None:
+        spans = [(int(offs[i]), int(offs[i + 1]))
+                 for i in range(len(offs) - 1)]
+    n_inf = n_lab = n_cor = 0
+    for s, e in spans:
+        ci = _extract_chunks(inf[s:e], scheme, nt, excl)
+        cl = _extract_chunks(lab[s:e], scheme, nt, excl)
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(ci & cl)
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    i64 = lambda v: jnp.asarray([v], jnp.int64)
+    return {"Precision": [jnp.asarray([p], jnp.float32)],
+            "Recall": [jnp.asarray([r], jnp.float32)],
+            "F1-Score": [jnp.asarray([f1], jnp.float32)],
+            "NumInferChunks": [i64(n_inf)],
+            "NumLabelChunks": [i64(n_lab)],
+            "NumCorrectChunks": [i64(n_cor)]}
+
+
+# --------------------------------------------------------------------------
+# precision_recall — multiclass macro/micro P/R/F1 with state accumulation
+# --------------------------------------------------------------------------
+@register_op("precision_recall", stateful=True,
+             inputs=("MaxProbs", "Indices", "Labels", "Weights",
+                     "StatesInfo"),
+             no_grad=True, attr_defaults={"class_number": 1})
+def _precision_recall(ins, attrs):
+    idx = np.asarray(first(ins, "Indices")).reshape(-1)
+    lab = np.asarray(first(ins, "Labels")).reshape(-1)
+    w = first(ins, "Weights")
+    w = (np.asarray(w).reshape(-1) if w is not None
+         else np.ones_like(lab, np.float32))
+    C = int(attrs.get("class_number", 1))
+    tp = np.zeros(C); fp = np.zeros(C); fn = np.zeros(C)
+    for p_, l_, wi in zip(idx, lab, w):
+        if p_ == l_:
+            tp[l_] += wi
+        else:
+            fp[p_] += wi
+            fn[l_] += wi
+
+    def metrics(tp_, fp_, fn_):
+        prec = np.where(tp_ + fp_ > 0, tp_ / np.maximum(tp_ + fp_, 1e-12), 0)
+        rec = np.where(tp_ + fn_ > 0, tp_ / np.maximum(tp_ + fn_, 1e-12), 0)
+        f1 = np.where(prec + rec > 0,
+                      2 * prec * rec / np.maximum(prec + rec, 1e-12), 0)
+        macro = [prec.mean(), rec.mean(), f1.mean()]
+        stp, sfp, sfn = tp_.sum(), fp_.sum(), fn_.sum()
+        mp = stp / (stp + sfp) if stp + sfp else 0.0
+        mr = stp / (stp + sfn) if stp + sfn else 0.0
+        mf = 2 * mp * mr / (mp + mr) if mp + mr else 0.0
+        return np.asarray(macro + [mp, mr, mf], np.float32)
+    batch = metrics(tp, fp, fn)
+    st = first(ins, "StatesInfo")
+    if st is not None:
+        sa = np.asarray(st).reshape(C, 4).astype(np.float64)
+        tp2, fp2, fn2 = tp + sa[:, 0], fp + sa[:, 1], fn + sa[:, 3]
+    else:
+        tp2, fp2, fn2 = tp, fp, fn
+    acc = metrics(tp2, fp2, fn2)
+    states = np.stack([tp2, fp2, np.zeros(C), fn2], axis=1)
+    return {"BatchMetrics": [jnp.asarray(batch)],
+            "AccumMetrics": [jnp.asarray(acc)],
+            "AccumStatesInfo": [jnp.asarray(states, jnp.float32)]}
+
+
+@register_op("positive_negative_pair", stateful=True,
+             inputs=("Score", "Label", "QueryID", "AccumulatePositivePair",
+                     "AccumulateNegativePair", "AccumulateNeutralPair",
+                     "Weight"),
+             no_grad=True, attr_defaults={"column": -1})
+def _positive_negative_pair(ins, attrs):
+    score = np.asarray(first(ins, "Score"))
+    col = int(attrs.get("column", -1))
+    s = score[:, col]
+    lab = np.asarray(first(ins, "Label")).reshape(-1)
+    qid = np.asarray(first(ins, "QueryID")).reshape(-1)
+    pos = neg = neu = 0.0
+    for q in np.unique(qid):
+        sel = np.where(qid == q)[0]
+        for a in range(len(sel)):
+            for b in range(a + 1, len(sel)):
+                i, j = sel[a], sel[b]
+                if lab[i] == lab[j]:
+                    continue
+                hi, lo = (i, j) if lab[i] > lab[j] else (j, i)
+                if s[hi] > s[lo]:
+                    pos += 1
+                elif s[hi] < s[lo]:
+                    neg += 1
+                else:
+                    neu += 1
+    for slot, v in (("AccumulatePositivePair", pos),
+                    ("AccumulateNegativePair", neg),
+                    ("AccumulateNeutralPair", neu)):
+        prev = first(ins, slot)
+        if prev is not None:
+            v += float(np.asarray(prev).reshape(()))
+        if slot == "AccumulatePositivePair":
+            pos = v
+        elif slot == "AccumulateNegativePair":
+            neg = v
+        else:
+            neu = v
+    f32 = lambda v: jnp.asarray([v], jnp.float32)
+    return {"PositivePair": [f32(pos)], "NegativePair": [f32(neg)],
+            "NeutralPair": [f32(neu)]}
+
+
+# --------------------------------------------------------------------------
+# detection_map — PASCAL VOC mAP over one batch (reference detection_map_op)
+# --------------------------------------------------------------------------
+@register_op("detection_map", stateful=True,
+             inputs=("DetectRes", "Label", "HasState", "PosCount",
+                     "TruePos", "FalsePos"),
+             no_grad=True, needs_lod=True,
+             attr_defaults={"overlap_threshold": 0.5, "class_num": 1,
+                            "background_label": 0, "evaluate_difficult": True,
+                            "ap_type": "integral"})
+def _detection_map(ins, attrs):
+    det = np.asarray(first(ins, "DetectRes"))     # [M, 6] label,score,x1,y1,x2,y2
+    gt = np.asarray(first(ins, "Label"))          # [N, 6] label,x1,y1,x2,y2(,difficult)
+    lods = attrs.get("_lod") or {}
+    doffs = (np.asarray(lods["DetectRes"][0][-1], np.int64)
+             if lods.get("DetectRes") and lods["DetectRes"][0]
+             else np.asarray([0, len(det)], np.int64))
+    goffs = (np.asarray(lods["Label"][0][-1], np.int64)
+             if lods.get("Label") and lods["Label"][0]
+             else np.asarray([0, len(gt)], np.int64))
+    thr = attrs.get("overlap_threshold", 0.5)
+    bg = int(attrs.get("background_label", 0))
+    ap_type = attrs.get("ap_type", "integral")
+    eval_diff = attrs.get("evaluate_difficult", True)
+    C = int(attrs.get("class_num", 1))
+    # gt layout: [label, difficult, x1, y1, x2, y2] (6 cols) or
+    # [label, x1, y1, x2, y2] (5 cols, no difficult flag)
+    has_diff = gt.shape[1] == 6
+    box_col = 2 if has_diff else 1
+
+    def iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+    # prior state: per-class positive counts + (score, hit) records
+    npos_c = np.zeros(C, np.int64)
+    scored_c = {c: [] for c in range(C)}
+    pc_in = first(ins, "PosCount")
+    tp_in, fp_in = first(ins, "TruePos"), first(ins, "FalsePos")
+    in_lods = attrs.get("_lod") or {}
+    if pc_in is not None and first(ins, "HasState") is not None \
+            and int(np.asarray(first(ins, "HasState")).reshape(-1)[0]):
+        npos_c += np.asarray(pc_in).reshape(-1)[:C]
+        for arr, lodname, hit in ((tp_in, "TruePos", 1),
+                                  (fp_in, "FalsePos", 0)):
+            if arr is None:
+                continue
+            a = np.asarray(arr).reshape(-1, 2)
+            lod = in_lods.get(lodname)
+            o = (np.asarray(lod[0][-1], np.int64) if lod and lod[0]
+                 else np.asarray([0, len(a)], np.int64))
+            for c in range(min(C, len(o) - 1)):
+                for row in a[o[c]:o[c + 1]]:
+                    scored_c[c].append((float(row[0]), hit))
+    for i in range(len(doffs) - 1):
+        d = det[doffs[i]:doffs[i + 1]]
+        g_raw = gt[goffs[i]:goffs[i + 1]]
+        for c in set(int(v) for v in g_raw[:, 0]) | \
+                set(int(v) for v in d[:, 0]):
+            if c == bg or c < 0 or c >= C:
+                continue
+            gc = g_raw[g_raw[:, 0] == c]
+            diff = (gc[:, 1].astype(bool) if has_diff
+                    else np.zeros(len(gc), bool))
+            g = gc[:, box_col:box_col + 4]
+            npos_c[c] += int(len(g) if eval_diff else (~diff).sum())
+            dc = d[d[:, 0] == c]
+            used = np.zeros(len(g), bool)
+            for row in dc[np.argsort(-dc[:, 1])]:
+                best, bi = 0.0, -1
+                for j in range(len(g)):
+                    o = iou(row[2:6], g[j])
+                    if o > best:
+                        best, bi = o, j
+                if best >= thr and bi >= 0:
+                    if not eval_diff and diff[bi]:
+                        continue   # difficult gt: detection not counted
+                    if not used[bi]:
+                        used[bi] = True
+                        scored_c[c].append((float(row[1]), 1))
+                    else:
+                        scored_c[c].append((float(row[1]), 0))
+                else:
+                    scored_c[c].append((float(row[1]), 0))
+    aps = []
+    for c in range(C):
+        if c == bg or npos_c[c] == 0:
+            continue
+        scored = sorted(scored_c[c], key=lambda t: -t[0])
+        tps = np.cumsum([t[1] for t in scored]) if scored else np.zeros(0)
+        fps = np.cumsum([1 - t[1] for t in scored]) if scored else np.zeros(0)
+        rec = tps / npos_c[c] if len(tps) else np.zeros(0)
+        prec = tps / np.maximum(tps + fps, 1e-12) if len(tps) else np.zeros(0)
+        if ap_type == "11point":
+            ap = np.mean([max([p for r_, p in zip(rec, prec) if r_ >= t],
+                              default=0.0) for t in np.linspace(0, 1, 11)])
+        else:
+            ap, prev_r = 0.0, 0.0
+            for r_, p in zip(rec, prec):
+                ap += (r_ - prev_r) * p
+                prev_r = r_
+        aps.append(ap)
+    m = float(np.mean(aps)) if aps else 0.0
+    # accumulated state out: per-class LoD of (score, hit) records
+    tp_rows, fp_rows, tp_lens, fp_lens = [], [], [], []
+    for c in range(C):
+        tps = [(s, h) for s, h in scored_c[c] if h == 1]
+        fps = [(s, h) for s, h in scored_c[c] if h == 0]
+        tp_rows.extend(tps)
+        fp_rows.extend(fps)
+        tp_lens.append(len(tps))
+        fp_lens.append(len(fps))
+    tp_arr = (np.asarray(tp_rows, np.float32).reshape(-1, 2)
+              if tp_rows else np.zeros((0, 2), np.float32))
+    fp_arr = (np.asarray(fp_rows, np.float32).reshape(-1, 2)
+              if fp_rows else np.zeros((0, 2), np.float32))
+    lod_of = lambda lens: (tuple(
+        int(v) for v in np.concatenate([[0], np.cumsum(lens)])),)
+    return {"MAP": [jnp.asarray([m], jnp.float32)],
+            "AccumPosCount": [jnp.asarray(npos_c[:, None], jnp.int32)],
+            "AccumTruePos": [jnp.asarray(tp_arr)],
+            "AccumFalsePos": [jnp.asarray(fp_arr)],
+            "_lod": {"AccumTruePos": [lod_of(tp_lens)],
+                     "AccumFalsePos": [lod_of(fp_lens)]}}
+
+
+# --------------------------------------------------------------------------
+# small compute ops
+# --------------------------------------------------------------------------
+@register_op("modified_huber_loss", inputs=("X", "Y"), diff_inputs=("X",))
+def _modified_huber_loss(ins, attrs):
+    x = first(ins, "X")            # prediction in [-1,1] space
+    y = first(ins, "Y")            # {0,1}
+    yy = 2.0 * y.astype(x.dtype) - 1.0
+    z = yy * x
+    loss = jnp.where(z < -1.0, -4.0 * z, jnp.square(jnp.maximum(1.0 - z, 0.0)))
+    return {"Out": [loss.reshape(-1, 1)], "IntermediateVal": [z]}
+
+
+@register_op("partial_concat", inputs=("X",), diff_inputs=("X",),
+             attr_defaults={"start_index": 0, "length": -1})
+def _partial_concat(ins, attrs):
+    xs = seq(ins, "X")
+    s = int(attrs.get("start_index", 0))
+    if s < 0:
+        s += xs[0].shape[1]
+    ln = int(attrs.get("length", -1))
+    cols = [x[:, s:(None if ln < 0 else s + ln)] for x in xs]
+    return out(Out=jnp.concatenate(cols, axis=1))
+
+
+@register_op("partial_sum", inputs=("X",), diff_inputs=("X",),
+             attr_defaults={"start_index": 0, "length": -1})
+def _partial_sum(ins, attrs):
+    xs = seq(ins, "X")
+    s = int(attrs.get("start_index", 0))
+    if s < 0:
+        s += xs[0].shape[1]
+    ln = int(attrs.get("length", -1))
+    acc = None
+    for x in xs:
+        v = x[:, s:(None if ln < 0 else s + ln)]
+        acc = v if acc is None else acc + v
+    return out(Out=acc)
+
+
+@register_op("batch_fc", inputs=("Input", "W", "Bias"),
+             diff_inputs=("Input", "W", "Bias"))
+def _batch_fc(ins, attrs):
+    x = first(ins, "Input")        # [slot, batch, in]
+    w = first(ins, "W")            # [slot, in, out]
+    b = first(ins, "Bias")         # [slot, 1, out]
+    o = jnp.einsum("sbi,sio->sbo", x, w)
+    if b is not None:
+        o = o + b
+    return out(Out=jnp.maximum(o, 0))
+
+
+@register_op("shuffle_batch", inputs=("X", "Seed"), needs_rng=True,
+             attr_defaults={"startup_seed": 0})
+def _shuffle_batch(ins, attrs):
+    x = first(ins, "X")
+    seed_in = first(ins, "Seed")
+    rng = (jax.random.key(int(np.asarray(seed_in).reshape(())))
+           if seed_in is not None and int(np.asarray(seed_in).reshape(())) != 0
+           else attrs["_rng"])
+    perm = jax.random.permutation(rng, x.shape[0])
+    return {"Out": [x[perm]], "ShuffleIdx": [perm.astype(jnp.int64)],
+            "SeedOut": [jnp.asarray([0], jnp.int64)]}
+
+
+@register_op("fill", no_grad=True,
+             attr_defaults={"value": [], "shape": [], "dtype": 5,
+                            "force_cpu": False})
+def _fill(ins, attrs):
+    from ..fluid.core import dtype_to_jnp
+    vals = np.asarray(attrs.get("value", []), np.float64)
+    shape = [int(s) for s in attrs.get("shape", [])]
+    return out(Out=jnp.asarray(vals.reshape(shape),
+                               dtype_to_jnp(attrs.get("dtype", 5))))
+
+
+@register_op("fill_zeros_like2", inputs=("X",), no_grad=True,
+             attr_defaults={"dtype": 5})
+def _fill_zeros_like2(ins, attrs):
+    return out(Out=jnp.zeros_like(first(ins, "X")))
+
+
+@register_op("get_places", no_grad=True,
+             attr_defaults={"device_count": 0, "device_type": "CPU"})
+def _get_places(ins, attrs):
+    n = int(attrs.get("device_count", 0)) or jax.device_count()
+    return out(Out=jnp.arange(n, dtype=jnp.int32))
+
+
+@register_op("coalesce_tensor", inputs=("Input",),
+             attr_defaults={"copy_data": True, "set_constant": False,
+                            "constant": 0.0, "dtype": 5})
+def _coalesce_tensor(ins, attrs):
+    """Fuse a var list into one flat buffer + per-var views (reference
+    coalesce_tensor_op.cc). Under XLA there is no aliasing win, so
+    FusedOutput is a concat copy and Output passes tensors through."""
+    xs = seq(ins, "Input")
+    flat = jnp.concatenate([x.reshape(-1) for x in xs])
+    if attrs.get("set_constant", False):
+        flat = jnp.full_like(flat, attrs.get("constant", 0.0))
+    return {"Output": list(xs), "FusedOutput": [flat]}
+
+
+@register_op("sample_logits",
+             inputs=("Logits", "Labels", "CustomizedSamples",
+                     "CustomizedProbabilities"),
+             diff_inputs=("Logits",), needs_rng=True,
+             attr_defaults={"num_samples": 1, "uniq": True,
+                            "remove_accidental_hits": True,
+                            "use_customized_samples": False, "seed": 0})
+def _sample_logits(ins, attrs):
+    """Sampled-softmax helper (reference sample_logits_op.h): gather the
+    true-label logits plus num_samples log-uniform negative classes,
+    subtracting log Q(class) so downstream softmax estimates the full
+    softmax; accidental hits (sampled class == a true label of the row)
+    are masked to -1e20."""
+    logits = first(ins, "Logits")          # [N, K]
+    labels = first(ins, "Labels")          # [N, NT] int64
+    n, K = logits.shape
+    nt = labels.shape[1]
+    S = int(attrs.get("num_samples", 1))
+    if attrs.get("use_customized_samples", False):
+        samples = first(ins, "CustomizedSamples")
+        probs = first(ins, "CustomizedProbabilities")
+    else:
+        rng = (jax.random.key(int(attrs["seed"])) if attrs.get("seed", 0)
+               else attrs["_rng"])
+        # log-uniform (Zipf) over classes: P(c)=log((c+2)/(c+1))/log(K+1)
+        u = jax.random.uniform(rng, (n, S))
+        neg = (jnp.exp(u * jnp.log(K + 1.0)) - 1.0).astype(jnp.int64)
+        neg = jnp.clip(neg, 0, K - 1)
+        samples = jnp.concatenate([labels.astype(jnp.int64), neg], axis=1)
+        q = jnp.log((samples + 2.0) / (samples + 1.0)) / jnp.log(K + 1.0)
+        probs = q
+    gathered = jnp.take_along_axis(logits, samples.astype(jnp.int32), axis=1)
+    sampled_logits = gathered - jnp.log(probs + 1e-20)
+    if attrs.get("remove_accidental_hits", True):
+        neg_part = samples[:, nt:]
+        hit = (neg_part[:, :, None] == labels[:, None, :]).any(-1)
+        mask = jnp.concatenate(
+            [jnp.zeros((n, nt), bool), hit], axis=1)
+        sampled_logits = jnp.where(mask, -1e20, sampled_logits)
+    sampled_labels = jnp.broadcast_to(jnp.arange(nt, dtype=jnp.int64),
+                                      (n, nt))
+    return {"Samples": [samples.astype(jnp.int64)],
+            "Probabilities": [probs],
+            "SampledLogits": [sampled_logits],
+            "SampledLabels": [sampled_labels]}
+
+
+# --------------------------------------------------------------------------
+# filter_by_instag — static-shape formulation: non-matching rows zeroed,
+# LossWeight marks survivors (reference filter_by_instag_op.h filters rows;
+# under XLA static shapes we keep row count and zero+deweight instead,
+# which preserves training semantics when the loss is weighted)
+# --------------------------------------------------------------------------
+@register_op("filter_by_instag", stateful=True, inputs=("Ins", "Ins_tag", "Filter_tag"),
+             diff_inputs=("Ins",), needs_lod=True,
+             attr_defaults={"is_lod": True, "out_val_if_empty": 0})
+def _filter_by_instag(ins, attrs):
+    x = first(ins, "Ins")
+    tags = np.asarray(first(ins, "Ins_tag")).reshape(-1)
+    filt = set(np.asarray(first(ins, "Filter_tag")).reshape(-1).tolist())
+    keep = np.asarray([1.0 if t in filt else 0.0 for t in tags], np.float32)
+    k = jnp.asarray(keep)[:, None]
+    if keep.sum() == 0:
+        # reference emits sentinel rows when nothing matches
+        o = jnp.full_like(x, attrs.get("out_val_if_empty", 0))
+    else:
+        o = x * k.astype(x.dtype)
+    idx = jnp.asarray(np.arange(len(tags), dtype=np.int64))
+    return {"Out": [o], "LossWeight": [k],
+            "IndexMap": [jnp.stack([idx, idx], axis=1)]}
+
+
+# --------------------------------------------------------------------------
+# TDM tree ops (reference tdm_child_op.h: tree_info row =
+# [item_id, layer_id, parent_id, child0..childN-1]; child==0 => none)
+# --------------------------------------------------------------------------
+@register_op("tdm_child", inputs=("X", "TreeInfo"), no_grad=True,
+             attr_defaults={"child_nums": 1, "dtype": 2})
+def _tdm_child(ins, attrs):
+    x = first(ins, "X")
+    info = first(ins, "TreeInfo")
+    cn = int(attrs.get("child_nums", 1))
+    ids = x.reshape(-1).astype(jnp.int32)
+    rows = info[ids]                        # [n, 3+cn]
+    kids = rows[:, 3:3 + cn].astype(jnp.int32)
+    has_child = ((ids != 0) & (rows[:, 3] != 0))[:, None]
+    kids = jnp.where(has_child, kids, 0)
+    is_item = (info[kids.reshape(-1), 0] != 0).reshape(kids.shape)
+    mask = jnp.where(has_child, is_item, False)
+    shape = x.shape[:-1] + (cn,) if x.shape[-1] == 1 else x.shape + (cn,)
+    return {"Child": [kids.reshape(shape).astype(jnp.int64)],
+            "LeafMask": [mask.reshape(shape).astype(jnp.int64)]}
+
+
+@register_op("tdm_sampler", inputs=("X", "Travel", "Layer"), needs_rng=True,
+             attr_defaults={"neg_samples_num_list": [], "layer_offset_lod": [],
+                            "output_positive": True, "output_list": True,
+                            "seed": 0, "dtype": 2})
+def _tdm_sampler(ins, attrs):
+    """Per positive item: its tree path (Travel row) plus per-layer negative
+    samples drawn from that layer's nodes (reference tdm_sampler_op.h)."""
+    x = first(ins, "X")
+    travel = first(ins, "Travel")          # [item, layer_num] path node ids
+    layer = first(ins, "Layer")            # flat node ids, split by offsets
+    negs = [int(v) for v in attrs.get("neg_samples_num_list", [])]
+    offs = [int(v) for v in attrs.get("layer_offset_lod", [])]
+    ids = x.reshape(-1).astype(jnp.int32)
+    n = ids.shape[0]
+    rng = attrs["_rng"]
+    out_cols, lab_cols, mask_cols = [], [], []
+    for li, neg in enumerate(negs):
+        pos = travel[ids, li][:, None]                    # [n,1]
+        lo, hi = offs[li], offs[li + 1]
+        rng, sub = jax.random.split(rng)
+        samp = jax.random.randint(sub, (n, neg), lo, hi)
+        negv = layer.reshape(-1)[samp]
+        valid = (pos != 0)
+        out_cols.append(jnp.concatenate([pos, negv], axis=1))
+        lab_cols.append(jnp.concatenate(
+            [jnp.ones_like(pos), jnp.zeros_like(negv)], axis=1))
+        mask_cols.append(jnp.concatenate(
+            [valid.astype(jnp.int64),
+             jnp.broadcast_to(valid, negv.shape).astype(jnp.int64)], axis=1))
+    o = jnp.concatenate(out_cols, axis=1)
+    return {"Out": [o.astype(jnp.int64)[..., None]],
+            "Labels": [jnp.concatenate(lab_cols, 1).astype(jnp.int64)[..., None]],
+            "Mask": [jnp.concatenate(mask_cols, 1)[..., None]]}
+
+
+@register_op("rank_attention", inputs=("X", "RankOffset", "RankParam"),
+             diff_inputs=("X", "RankParam"),
+             attr_defaults={"MaxRank": 3, "MaxSize": 0})
+def _rank_attention(ins, attrs):
+    """Ad-rank attention (reference rank_attention_op.cu): sample i with
+    instance-rank r_i combines X[i] against parameter blocks selected by
+    (r_i-1)*MaxRank + (rank_k-1) for each valid neighbour rank k in
+    RankOffset; invalid slots contribute zero."""
+    x = first(ins, "X")                    # [n, d]
+    ro = first(ins, "RankOffset")          # [n, 1+2*MaxRank] ints
+    p = first(ins, "RankParam")            # [max_rank*max_rank*d, out]
+    mr = int(attrs.get("MaxRank", 3))
+    n, d = x.shape
+    ocol = p.shape[1]
+    pb = p.reshape(mr * mr, d, ocol)
+    ins_rank = ro[:, 0].astype(jnp.int32)  # [n]
+    o = jnp.zeros((n, ocol), x.dtype)
+    for k in range(mr):
+        fea_rank = ro[:, 2 * k + 1].astype(jnp.int32)
+        valid = (ins_rank > 0) & (fea_rank > 0)
+        block_id = jnp.clip((ins_rank - 1) * mr + (fea_rank - 1), 0,
+                            mr * mr - 1)
+        contrib = jnp.einsum("nd,ndo->no", x, pb[block_id])
+        o = o + jnp.where(valid[:, None], contrib, 0.0)
+    return {"Out": [o], "InputHelp": [x], "InsRank": [ins_rank.astype(x.dtype)[:, None]]}
+
+
+# --------------------------------------------------------------------------
+# tree_conv — graph conv over trees (reference tree_conv_op.h: patches are
+# (node, parent-chain) windows; here one-hop weighted aggregation per the
+# EdgeSet adjacency, iterated max_depth times)
+# --------------------------------------------------------------------------
+@register_op("tree_conv", stateful=True, inputs=("NodesVector", "EdgeSet", "Filter"),
+             diff_inputs=("NodesVector", "Filter"),
+             attr_defaults={"max_depth": 2})
+def _tree_conv(ins, attrs):
+    nodes = first(ins, "NodesVector")      # [b, n, f]
+    edges = first(ins, "EdgeSet")          # [b, e, 2] (parent, child)
+    filt = first(ins, "Filter")            # [f, 3, out_size, num_filters]
+    b, n, f = nodes.shape
+    fdim, three, osz, nf = filt.shape
+    # adjacency (symmetric) per batch from the edge list
+    e = np.asarray(edges)
+    o = []
+    for bi in range(b):
+        adj = np.zeros((n, n), np.float32)
+        for pa, ch in e[bi]:
+            if pa > 0 or ch > 0:
+                adj[int(pa), int(ch)] = 1.0
+        adjj = jnp.asarray(adj)
+        x = nodes[bi]
+        # W decomposed into self / down(children) / up(parent) roles
+        w_self = filt[:, 0].reshape(f, osz * nf)
+        w_down = filt[:, 1].reshape(f, osz * nf)
+        w_up = filt[:, 2].reshape(f, osz * nf)
+        h = (x @ w_self + (adjj @ x) @ w_down + (adjj.T @ x) @ w_up)
+        o.append(jnp.tanh(h.reshape(n, osz, nf).max(axis=1)))
+    return out(Out=jnp.stack(o))
+
+
+@register_op("match_matrix_tensor", inputs=("X", "Y", "W"),
+             diff_inputs=("X", "Y", "W"), needs_lod=True,
+             attr_defaults={"dim_t": 1})
+def _match_matrix_tensor(ins, attrs):
+    """Text-match tensor: per sequence pair, out[t, i, j] =
+    x_i^T W_t y_j (reference match_matrix_tensor_op.cc), flattened to the
+    LoD layout [sum_i lenx_i*leny_i*dim_t, 1]."""
+    x, y, w = first(ins, "X"), first(ins, "Y"), first(ins, "W")
+    lods = attrs.get("_lod") or {}
+    xo = np.asarray(lods["X"][0][-1], np.int64)
+    yo = np.asarray(lods["Y"][0][-1], np.int64)
+    dim_t = w.shape[1] if w.ndim == 3 else int(attrs.get("dim_t", 1))
+    wt = w if w.ndim == 3 else w.reshape(x.shape[1], dim_t, y.shape[1])
+    pieces, lens = [], []
+    for i in range(len(xo) - 1):
+        xs = x[xo[i]:xo[i + 1]]
+        ys = y[yo[i]:yo[i + 1]]
+        m = jnp.einsum("id,dte,ke->tik", xs, wt, ys)
+        pieces.append(m.reshape(-1))
+        lens.append(m.size)
+    o = jnp.concatenate(pieces)[:, None]
+    lod0 = tuple(int(v) for v in np.concatenate([[0], np.cumsum(lens)]))
+    return {"Out": [o], "Tmp": [o],
+            "_lod": {"Out": [(lod0,)]}}
+
+
+@register_op("var_conv_2d", inputs=("X", "ROW", "COLUMN", "W"),
+             diff_inputs=("X", "W"), needs_lod=True,
+             attr_defaults={"InputChannel": 1, "OutputChannel": 1,
+                            "StrideH": 1, "StrideW": 1, "KernelH": 3,
+                            "KernelW": 3})
+def _var_conv_2d(ins, attrs):
+    """Variable-size 2d conv over per-sequence images (reference
+    var_conv_2d_op.cc): each sequence i is an image [in_c, row_i, col_i]
+    flattened in X's LoD; conv each independently."""
+    from jax import lax
+    x = first(ins, "X")
+    w = first(ins, "W")
+    rows_lod = (attrs.get("_lod") or {}).get("ROW")
+    cols_lod = (attrs.get("_lod") or {}).get("COLUMN")
+    ro = np.asarray(rows_lod[0][-1], np.int64)
+    co = np.asarray(cols_lod[0][-1], np.int64)
+    ic = int(attrs.get("InputChannel", 1))
+    oc = int(attrs.get("OutputChannel", 1))
+    kh, kw = int(attrs.get("KernelH", 3)), int(attrs.get("KernelW", 3))
+    sh, sw = int(attrs.get("StrideH", 1)), int(attrs.get("StrideW", 1))
+    wk = w.reshape(oc, ic, kh, kw)
+    flat = x.reshape(-1)
+    pos = 0
+    pieces, lens = [], []
+    for i in range(len(ro) - 1):
+        r = int(ro[i + 1] - ro[i])
+        c = int(co[i + 1] - co[i])
+        img = flat[pos:pos + ic * r * c].reshape(1, ic, r, c)
+        pos += ic * r * c
+        o = lax.conv_general_dilated(
+            img, wk, (sh, sw),
+            [((kh - 1) // 2, (kh - 1) // 2), ((kw - 1) // 2, (kw - 1) // 2)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        pieces.append(o.reshape(-1))
+        lens.append(o.size)
+    res = jnp.concatenate(pieces)[:, None]
+    lod0 = tuple(int(v) for v in np.concatenate([[0], np.cumsum(lens)]))
+    return {"Out": [res], "Col": [res], "_lod": {"Out": [(lod0,)]}}
+
+
+@register_op("pyramid_hash", stateful=True, inputs=("X", "W", "WhiteList", "BlackList"),
+             diff_inputs=("W",), needs_lod=True,
+             attr_defaults={"num_emb": 8, "space_len": 1000, "pyramid_layer": 2,
+                            "rand_len": 8, "drop_out_percent": 0,
+                            "is_training": False, "use_filter": False,
+                            "white_list_len": 0, "black_list_len": 0,
+                            "seed": 0, "lr": 1.0, "distribute_update_vars": ""})
+def _pyramid_hash(ins, attrs):
+    """Pyramid text-hash embedding (reference pyramid_hash_op.cc): for each
+    position, hash the n-grams (n=2..pyramid_layer+1) starting there into
+    rand_len-wide rows of W and sum the gathered chunks into a num_emb
+    vector. Hash is the same 32-bit avalanche mix as the hash op (not
+    bit-identical to the reference's xxhash)."""
+    x = first(ins, "X")
+    w = first(ins, "W")                    # [space_len, 1] flat table
+    lods = (attrs.get("_lod") or {}).get("X")
+    offs = (np.asarray(lods[0][-1], np.int64) if lods and lods[0]
+            else np.asarray([0, x.shape[0]], np.int64))
+    num_emb = int(attrs.get("num_emb", 8))
+    rand_len = int(attrs.get("rand_len", 8))
+    space = int(attrs.get("space_len", 1000))
+    layers = int(attrs.get("pyramid_layer", 2))
+    ids = np.asarray(x).reshape(-1)
+    T = len(ids)
+    chunks = num_emb // rand_len
+    wflat = w.reshape(-1)
+    acc = jnp.zeros((T, num_emb), w.dtype)
+    for n_ in range(2, layers + 2):
+        # host-computed n-gram keys (ids are host data by LoD contract)
+        keys = np.zeros(T, np.uint64)
+        valid = np.zeros(T, np.float32)
+        for t in range(T):
+            # n-gram must stay inside its sequence
+            s_i = np.searchsorted(offs, t, side="right") - 1
+            if t + n_ <= offs[s_i + 1]:
+                k = np.uint64(0)
+                for g in range(n_):
+                    k = k * np.uint64(1000003) + np.uint64(ids[t + g])
+                keys[t] = k
+                valid[t] = 1.0
+        cols = []
+        for c in range(chunks):
+            v = (keys ^ np.uint64(0x9E3779B97F4A7C15 + c * 0x2545F4914F6CDD1D)) \
+                & np.uint64(0xFFFFFFFF)
+            v = (v * np.uint64(2654435761)) & np.uint64(0xFFFFFFFF)
+            base = (v % np.uint64(max(space - rand_len, 1))).astype(np.int64)
+            idx = base[:, None] + np.arange(rand_len)[None, :]
+            cols.append(wflat[jnp.asarray(idx)])
+        g = jnp.concatenate(cols, axis=1) * jnp.asarray(valid)[:, None]
+        acc = acc + g
+    return {"Out": [acc], "X_Temp_Out": [x],
+            "_lod": {"Out": [lods[0]] if lods else []}}
+
+
+@register_op("sequence_topk_avg_pooling", inputs=("X", "ROW", "COLUMN"),
+             diff_inputs=("X",), needs_lod=True,
+             attr_defaults={"topks": [1], "channel_num": 1})
+def _sequence_topk_avg_pooling(ins, attrs):
+    """Top-k average pooling over per-pair match matrices (reference
+    sequence_topk_avg_pooling_op.h): X holds [channel, row_i, col_i] per
+    sequence; output per row is the average of its top-k column scores,
+    concatenated over topks and channels."""
+    x = first(ins, "X")
+    topks = [int(t) for t in attrs.get("topks", [1])]
+    ch = int(attrs.get("channel_num", 1))
+    rows_lod = (attrs.get("_lod") or {}).get("ROW")
+    cols_lod = (attrs.get("_lod") or {}).get("COLUMN")
+    ro = np.asarray(rows_lod[0][-1], np.int64)
+    co = np.asarray(cols_lod[0][-1], np.int64)
+    flat = x.reshape(-1)
+    pos = 0
+    pieces, lens = [], []
+    for i in range(len(ro) - 1):
+        r = int(ro[i + 1] - ro[i])
+        c = int(co[i + 1] - co[i])
+        m = flat[pos:pos + ch * r * c].reshape(ch, r, c)
+        pos += ch * r * c
+        srt = jnp.sort(m, axis=2)[:, :, ::-1]          # desc per row
+        feats = []
+        for k in topks:
+            kk = min(k, c) if c > 0 else 0
+            if kk == 0:
+                feats.append(jnp.zeros((ch, r), x.dtype))
+            else:
+                feats.append(jnp.sum(srt[:, :, :kk], axis=2) / k)
+        f = jnp.stack(feats, axis=2)       # [ch, r, n_topk]
+        pieces.append(jnp.transpose(f, (1, 0, 2)).reshape(r, -1))
+        lens.append(r)
+    o = jnp.concatenate(pieces, axis=0)
+    lod0 = tuple(int(v) for v in np.concatenate([[0], np.cumsum(lens)]))
+    return {"Out": [o], "pos": [jnp.zeros((1,), jnp.int32)],
+            "_lod": {"Out": [(lod0,)]}}

@@ -463,3 +463,22 @@ def _beam_search_decode(ins, attrs):
     return {"SentenceIds": [jnp.asarray(_np.asarray(flat_ids, _np.int64))],
             "SentenceScores": [jnp.asarray(_np.asarray(flat_sc, _np.float32))],
             "_lod": {"SentenceIds": [new_lod], "SentenceScores": [new_lod]}}
+
+
+# --------------------------------------------------------------------------
+# reference op-type aliases: serialized reference programs use the raw op
+# names `gru` / `lstmp` (gru_op.cc, lstmp_op.cc); our layers emit the
+# dynamic_* names. Same kernels, registered twice.
+# --------------------------------------------------------------------------
+register_op("gru", needs_lod=True,
+            diff_inputs=["Input", "Weight", "Bias", "H0"],
+            attr_defaults={"is_reverse": False, "origin_mode": False,
+                           "gate_activation": "sigmoid",
+                           "activation": "tanh"})(_dynamic_gru)
+register_op("lstmp", needs_lod=True,
+            diff_inputs=["Input", "Weight", "ProjWeight", "Bias", "H0", "C0"],
+            attr_defaults={"use_peepholes": True, "is_reverse": False,
+                           "gate_activation": "sigmoid",
+                           "cell_activation": "tanh",
+                           "candidate_activation": "tanh",
+                           "proj_activation": "tanh"})(_dynamic_lstmp)

@@ -1,0 +1,245 @@
+"""Fused-op family tests (reference: tests/unittests/test_fc_op.py,
+test_fused_elemwise_activation_op.py, test_fused_emb_seq_pool_op.py,
+test_fusion_gru_op.py, test_fusion_lstm_op.py,
+test_fusion_seqpool_concat_op.py, test_fusion_squared_mat_sub_op.py,
+test_fusion_transpose_flatten_concat_op.py, test_fusion_repeated_fc_relu_op.py).
+Each fused op must equal its unfused composition."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from tests.test_sequence_ops import run_seq_op
+
+
+def test_fc_op():
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 3, 5).astype(np.float32)
+    w = rng.rand(15, 7).astype(np.float32)
+    b = rng.rand(7).astype(np.float32)
+    (o,), _ = run_seq_op("fc", x, None, x_slot="Input",
+                         extra_inputs=[("W", w, None), ("Bias", b, None)],
+                         attrs={"in_num_col_dims": 1,
+                                "activation_type": "relu"})
+    ref = np.maximum(x.reshape(4, 15) @ w + b, 0).reshape(4, 7)
+    np.testing.assert_allclose(o, ref, rtol=1e-5)
+
+
+def test_fused_elemwise_activation():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    (o,), _ = run_seq_op("fused_elemwise_activation", x, None,
+                         extra_inputs=[("Y", y, None)],
+                         attrs={"functor_list": ["relu", "elementwise_add"]},
+                         outputs=("Out",))
+    np.testing.assert_allclose(o, np.maximum(x + y, 0), rtol=1e-6)
+    (o2,), _ = run_seq_op("fused_elemwise_activation", x, None,
+                          extra_inputs=[("Y", y, None)],
+                          attrs={"functor_list": ["elementwise_add", "scale"],
+                                 "scale": 2.0},
+                          outputs=("Out",))
+    np.testing.assert_allclose(o2, x + 2.0 * y, rtol=1e-6)
+
+
+def test_fused_batch_norm_act():
+    rng = np.random.RandomState(2)
+    x = rng.rand(4, 3, 5, 5).astype(np.float32)
+    ones, zeros = np.ones(3, np.float32), np.zeros(3, np.float32)
+    (y,), _ = run_seq_op(
+        "fused_batch_norm_act", x, None,
+        extra_inputs=[("Scale", ones, None), ("Bias", zeros, None),
+                      ("Mean", zeros, None), ("Variance", ones, None)],
+        attrs={"is_test": True, "use_global_stats": True,
+               "epsilon": 1e-5, "act_type": "relu"},
+        outputs=("Y",))
+    ref = F.relu(F.batch_norm(torch.from_numpy(x), torch.zeros(3),
+                              torch.ones(3), torch.ones(3), torch.zeros(3),
+                              training=False, eps=1e-5)).numpy()
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_embedding_eltwise_layernorm():
+    rng = np.random.RandomState(3)
+    emb1 = rng.rand(10, 8).astype(np.float32)
+    emb2 = rng.rand(4, 8).astype(np.float32)
+    ids1 = rng.randint(0, 10, (2, 5, 1)).astype(np.int64)
+    ids2 = rng.randint(0, 4, (2, 5, 1)).astype(np.int64)
+    scale = rng.rand(8).astype(np.float32)
+    bias = rng.rand(8).astype(np.float32)
+    (o,), _ = run_seq_op(
+        "fused_embedding_eltwise_layernorm", ids1, None, x_slot="Ids",
+        extra_inputs=[("Ids", ids2, None), ("Embs", emb1, None),
+                      ("Embs", emb2, None), ("Scale", scale, None),
+                      ("Bias", bias, None)],
+        attrs={"epsilon": 1e-5})
+    acc = emb1[ids1[..., 0]] + emb2[ids2[..., 0]]
+    mu = acc.mean(-1, keepdims=True)
+    var = acc.var(-1, keepdims=True)
+    ref = (acc - mu) / np.sqrt(var + 1e-5) * scale + bias
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_embedding_seq_pool():
+    rng = np.random.RandomState(4)
+    w = rng.rand(12, 6).astype(np.float32)
+    ids = rng.randint(0, 12, (7, 1)).astype(np.int64)
+    lod = [[3, 4]]
+    (o,), _ = run_seq_op("fused_embedding_seq_pool", ids, lod, x_slot="Ids",
+                         extra_inputs=[("W", w, None)],
+                         attrs={"combiner": "sum"})
+    ref = np.stack([w[ids[:3, 0]].sum(0), w[ids[3:, 0]].sum(0)])
+    np.testing.assert_allclose(o, ref, rtol=1e-5)
+
+
+def test_fused_fc_elementwise_layernorm():
+    rng = np.random.RandomState(5)
+    x = rng.rand(4, 6).astype(np.float32)
+    w = rng.rand(6, 8).astype(np.float32)
+    b0 = rng.rand(8).astype(np.float32)
+    y = rng.rand(4, 8).astype(np.float32)
+    scale = rng.rand(8).astype(np.float32)
+    b1 = rng.rand(8).astype(np.float32)
+    (o,), _ = run_seq_op(
+        "fused_fc_elementwise_layernorm", x, None,
+        extra_inputs=[("W", w, None), ("Bias0", b0, None), ("Y", y, None),
+                      ("Scale", scale, None), ("Bias1", b1, None)],
+        attrs={"epsilon": 1e-5})
+    t = x @ w + b0 + y
+    mu, var = t.mean(-1, keepdims=True), t.var(-1, keepdims=True)
+    ref = (t - mu) / np.sqrt(var + 1e-5) * scale + b1
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fusion_gru_equals_projected_dynamic_gru():
+    rng = np.random.RandomState(6)
+    T, M, H = 6, 4, 5
+    x = rng.rand(T, M).astype(np.float32)
+    wx = rng.rand(M, 3 * H).astype(np.float32)
+    wh = rng.rand(H, 3 * H).astype(np.float32)
+    b = rng.rand(1, 3 * H).astype(np.float32)
+    lod = [[2, 4]]
+    (h_fused,), _ = run_seq_op(
+        "fusion_gru", x, lod,
+        extra_inputs=[("WeightX", wx, None), ("WeightH", wh, None),
+                      ("Bias", b, None)],
+        outputs=("Hidden",))
+    (h_ref,), _ = run_seq_op(
+        "dynamic_gru", x @ wx, lod, x_slot="Input",
+        extra_inputs=[("Weight", wh, None), ("Bias", b, None)],
+        outputs=("Hidden",))
+    np.testing.assert_allclose(h_fused, h_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fusion_lstm_equals_projected_dynamic_lstm():
+    rng = np.random.RandomState(7)
+    T, M, H = 5, 3, 4
+    x = rng.rand(T, M).astype(np.float32)
+    wx = rng.rand(M, 4 * H).astype(np.float32)
+    wh = rng.rand(H, 4 * H).astype(np.float32)
+    b = rng.rand(1, 4 * H).astype(np.float32)
+    lod = [[2, 3]]
+    (h_fused, c_fused), _ = run_seq_op(
+        "fusion_lstm", x, lod,
+        extra_inputs=[("WeightX", wx, None), ("WeightH", wh, None),
+                      ("Bias", b, None)],
+        attrs={"use_peepholes": False},
+        outputs=("Hidden", "Cell"))
+    (h_ref, c_ref), _ = run_seq_op(
+        "dynamic_lstm", x @ wx, lod, x_slot="Input",
+        extra_inputs=[("Weight", wh, None), ("Bias", b, None)],
+        attrs={"use_peepholes": False},
+        outputs=("Hidden", "Cell"))
+    np.testing.assert_allclose(h_fused, h_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(c_fused, c_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fusion_repeated_fc_relu():
+    rng = np.random.RandomState(8)
+    x = rng.rand(3, 4).astype(np.float32)
+    w1 = rng.rand(4, 5).astype(np.float32)
+    b1 = rng.rand(5).astype(np.float32)
+    w2 = rng.rand(5, 2).astype(np.float32)
+    b2 = rng.rand(2).astype(np.float32)
+    (o,), _ = run_seq_op(
+        "fusion_repeated_fc_relu", x, None,
+        extra_inputs=[("W", w1, None), ("W", w2, None),
+                      ("Bias", b1, None), ("Bias", b2, None)])
+    ref = np.maximum(np.maximum(x @ w1 + b1, 0) @ w2 + b2, 0)
+    np.testing.assert_allclose(o, ref, rtol=1e-5)
+
+
+def test_fusion_seqpool_concat():
+    rng = np.random.RandomState(9)
+    x1 = rng.rand(5, 3).astype(np.float32)
+    x2 = rng.rand(5, 2).astype(np.float32)
+    lod = [[2, 3]]
+    (o,), _ = run_seq_op("fusion_seqpool_concat", x1, lod,
+                         extra_inputs=[("X", x2, lod)],
+                         attrs={"pooltype": "SUM"})
+    ref = np.concatenate([
+        np.stack([x1[:2].sum(0), x1[2:].sum(0)]),
+        np.stack([x2[:2].sum(0), x2[2:].sum(0)])], axis=1)
+    np.testing.assert_allclose(o, ref, rtol=1e-5)
+
+
+def test_fusion_squared_mat_sub():
+    rng = np.random.RandomState(10)
+    x = rng.rand(3, 4).astype(np.float32)
+    y = rng.rand(4, 5).astype(np.float32)
+    (o,), _ = run_seq_op("fusion_squared_mat_sub", x, None,
+                         extra_inputs=[("Y", y, None)],
+                         attrs={"scalar": 0.5})
+    ref = 0.5 * ((x @ y) ** 2 - (x * x) @ (y * y))
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fusion_transpose_flatten_concat():
+    rng = np.random.RandomState(11)
+    x1 = rng.rand(2, 3, 4, 5).astype(np.float32)
+    x2 = rng.rand(2, 3, 4, 5).astype(np.float32)
+    (o,), _ = run_seq_op("fusion_transpose_flatten_concat", x1, None,
+                         extra_inputs=[("X", x2, None)],
+                         attrs={"trans_axis": [0, 2, 3, 1],
+                                "flatten_axis": 1, "concat_axis": 1})
+    f1 = x1.transpose(0, 2, 3, 1).reshape(2, -1)
+    f2 = x2.transpose(0, 2, 3, 1).reshape(2, -1)
+    np.testing.assert_allclose(o, np.concatenate([f1, f2], 1), rtol=1e-6)
+
+
+def test_fusion_seqexpand_concat_fc():
+    rng = np.random.RandomState(12)
+    x = rng.rand(5, 3).astype(np.float32)       # LoD [[2,3]]
+    z = rng.rand(2, 4).astype(np.float32)       # per-sequence row
+    w = rng.rand(7, 6).astype(np.float32)
+    b = rng.rand(6).astype(np.float32)
+    (o,), _ = run_seq_op(
+        "fusion_seqexpand_concat_fc", x, [[2, 3]],
+        extra_inputs=[("X", z, None), ("FCWeight", w, None),
+                      ("FCBias", b, None)],
+        attrs={"fc_activation": "relu"})
+    zexp = np.repeat(z, [2, 3], axis=0)
+    ref = np.maximum(np.concatenate([x, zexp], 1) @ w + b, 0)
+    np.testing.assert_allclose(o, ref, rtol=1e-5)
+
+
+def test_conv2d_fusion():
+    rng = np.random.RandomState(13)
+    x = rng.rand(1, 3, 6, 6).astype(np.float32)
+    w = rng.rand(4, 3, 3, 3).astype(np.float32)
+    res = rng.rand(1, 4, 6, 6).astype(np.float32)
+    (o,), _ = run_seq_op("conv2d_fusion", x, None, x_slot="Input",
+                         extra_inputs=[("Filter", w, None),
+                                       ("ResidualData", res, None)],
+                         attrs={"strides": [1, 1], "paddings": [1, 1],
+                                "dilations": [1, 1], "activation": "relu"},
+                         outputs=("Output",))
+    ref = F.relu(F.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                          padding=1) + torch.from_numpy(res)).numpy()
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fusion_group_raises():
+    x = np.zeros((2, 2), np.float32)
+    with pytest.raises(NotImplementedError):
+        run_seq_op("fusion_group", x, None)

@@ -1,0 +1,332 @@
+"""Metric + misc op tests (reference: tests/unittests/test_chunk_eval_op.py,
+test_precision_recall_op.py, test_positive_negative_pair_op.py,
+test_detection_map_op.py, test_modified_huber_loss_op.py,
+test_sample_logits_op.py, test_partial_concat_op.py, test_partial_sum_op.py,
+test_batch_fc_op.py, test_shuffle_batch_op.py, test_fill_op.py,
+test_tdm_child_op.py, test_tdm_sampler_op.py, test_match_matrix_tensor_op.py,
+test_var_conv_2d_op.py, test_sequence_topk_avg_pooling_op.py,
+test_filter_by_instag_op.py)."""
+import numpy as np
+import pytest
+
+from tests.test_sequence_ops import run_seq_op
+
+
+def test_chunk_eval_iob():
+    # types: PER=0, LOC=1; IOB: B=type*2, I=type*2+1, O=4
+    # label:  B-PER I-PER O  B-LOC  | inference misses the LOC chunk
+    label = np.array([[0], [1], [4], [2]], np.int64)
+    inf = np.array([[0], [1], [4], [4]], np.int64)
+    (p, r, f1, ni, nl, nc), _ = run_seq_op(
+        "chunk_eval", inf, [[4]], x_slot="Inference",
+        extra_inputs=[("Label", label, [[4]])],
+        attrs={"num_chunk_types": 2, "chunk_scheme": "IOB"},
+        outputs=("Precision", "Recall", "F1-Score", "NumInferChunks",
+                 "NumLabelChunks", "NumCorrectChunks"))
+    assert ni[0] == 1 and nl[0] == 2 and nc[0] == 1
+    np.testing.assert_allclose(p[0], 1.0)
+    np.testing.assert_allclose(r[0], 0.5)
+    np.testing.assert_allclose(f1[0], 2 / 3, rtol=1e-6)
+
+
+def test_precision_recall():
+    idx = np.array([[0], [1], [1], [0]], np.int64)
+    lab = np.array([[0], [1], [0], [1]], np.int64)
+    probs = np.ones((4, 1), np.float32)
+    (bm, am, st), _ = run_seq_op(
+        "precision_recall", probs, None, x_slot="MaxProbs",
+        extra_inputs=[("Indices", idx, None), ("Labels", lab, None)],
+        attrs={"class_number": 2},
+        outputs=("BatchMetrics", "AccumMetrics", "AccumStatesInfo"))
+    # per class: tp=1 fp=1 fn=1 -> P=R=F1=0.5 everywhere
+    np.testing.assert_allclose(bm, [0.5] * 6, rtol=1e-6)
+    np.testing.assert_allclose(am, bm, rtol=1e-6)
+    assert st.shape == (2, 4)
+
+
+def test_positive_negative_pair():
+    score = np.array([[0.9], [0.1], [0.4], [0.8]], np.float32)
+    label = np.array([[1], [0], [0], [1]], np.int64)
+    qid = np.array([[0], [0], [1], [1]], np.int64)
+    (pos, neg, neu), _ = run_seq_op(
+        "positive_negative_pair", score, None, x_slot="Score",
+        extra_inputs=[("Label", label, None), ("QueryID", qid, None)],
+        outputs=("PositivePair", "NegativePair", "NeutralPair"))
+    assert pos[0] == 2 and neg[0] == 0 and neu[0] == 0
+
+
+def test_detection_map_perfect():
+    # one image, one class-1 gt box, one matching detection
+    det = np.array([[1, 0.9, 0, 0, 10, 10]], np.float32)
+    gt = np.array([[1, 0, 0, 10, 10]], np.float32)
+    (m,), _ = run_seq_op(
+        "detection_map", det, [[1]], x_slot="DetectRes",
+        extra_inputs=[("Label", gt, [[1]])],
+        attrs={"class_num": 2, "overlap_threshold": 0.5},
+        outputs=("MAP",))
+    np.testing.assert_allclose(m[0], 1.0, rtol=1e-6)
+
+
+def test_modified_huber_loss():
+    x = np.array([[2.0], [0.5], [-2.0]], np.float32)
+    y = np.array([[1.0], [1.0], [1.0]], np.float32)
+    (o,), _ = run_seq_op("modified_huber_loss", x, None,
+                         extra_inputs=[("Y", y, None)])
+    np.testing.assert_allclose(
+        o.ravel(), [0.0, 0.25, 8.0], rtol=1e-6)  # z=2 -> 0; z=.5 -> .25; z=-2 -> -4z
+
+
+def test_sample_logits():
+    rng = np.random.RandomState(0)
+    logits = rng.rand(3, 20).astype(np.float32)
+    labels = np.array([[4], [7], [0]], np.int64)
+    (samples, probs, slog, slab), _ = run_seq_op(
+        "sample_logits", logits, None, x_slot="Logits",
+        extra_inputs=[("Labels", labels, None)],
+        attrs={"num_samples": 5},
+        outputs=("Samples", "Probabilities", "SampledLogits",
+                 "SampledLabels"))
+    assert samples.shape == (3, 6) and slog.shape == (3, 6)
+    np.testing.assert_array_equal(samples[:, 0], labels[:, 0])
+    np.testing.assert_array_equal(slab, np.zeros((3, 1)))
+    # true-label column equals logit - log q
+    np.testing.assert_allclose(
+        slog[:, 0],
+        logits[np.arange(3), labels[:, 0]] - np.log(probs[:, 0] + 1e-20),
+        rtol=1e-5)
+
+
+def test_partial_concat_and_sum():
+    rng = np.random.RandomState(1)
+    a = rng.rand(3, 6).astype(np.float32)
+    b = rng.rand(3, 6).astype(np.float32)
+    (o,), _ = run_seq_op("partial_concat", a, None,
+                         extra_inputs=[("X", b, None)],
+                         attrs={"start_index": 1, "length": 2})
+    np.testing.assert_allclose(o, np.concatenate([a[:, 1:3], b[:, 1:3]], 1))
+    (o2,), _ = run_seq_op("partial_sum", a, None,
+                          extra_inputs=[("X", b, None)],
+                          attrs={"start_index": 2, "length": 3})
+    np.testing.assert_allclose(o2, a[:, 2:5] + b[:, 2:5], rtol=1e-6)
+
+
+def test_batch_fc():
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 3, 4).astype(np.float32)
+    w = rng.rand(2, 4, 5).astype(np.float32)
+    b = rng.rand(2, 1, 5).astype(np.float32)
+    (o,), _ = run_seq_op("batch_fc", x, None, x_slot="Input",
+                         extra_inputs=[("W", w, None), ("Bias", b, None)])
+    ref = np.maximum(np.einsum("sbi,sio->sbo", x, w) + b, 0)
+    np.testing.assert_allclose(o, ref, rtol=1e-5)
+
+
+def test_shuffle_batch():
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    (o, idx), _ = run_seq_op("shuffle_batch", x, None,
+                             outputs=("Out", "ShuffleIdx"))
+    np.testing.assert_allclose(np.sort(o[:, 0]), x[:, 0])
+    np.testing.assert_allclose(o, x[idx])
+
+
+def test_fill_and_zeros_like2():
+    x = np.zeros((1,), np.float32)
+    (o,), _ = run_seq_op("fill", x, None,
+                         attrs={"value": [1.0, 2.0, 3.0, 4.0],
+                                "shape": [2, 2], "dtype": 5})
+    np.testing.assert_allclose(o, [[1, 2], [3, 4]])
+    y = np.ones((2, 3), np.float32)
+    (z,), _ = run_seq_op("fill_zeros_like2", y, None)
+    np.testing.assert_allclose(z, np.zeros((2, 3)))
+
+
+def test_coalesce_tensor():
+    a = np.ones((2, 2), np.float32)
+    b = np.full((3,), 2.0, np.float32)
+    (fused,), _ = run_seq_op("coalesce_tensor", a, None, x_slot="Input",
+                             extra_inputs=[("Input", b, None)],
+                             outputs=("FusedOutput",))
+    np.testing.assert_allclose(fused, [1, 1, 1, 1, 2, 2, 2])
+
+
+def test_filter_by_instag():
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+    tags = np.array([1, 2, 1, 3], np.int64)
+    filt = np.array([1], np.int64)
+    (o, lw), _ = run_seq_op("filter_by_instag", x, None, x_slot="Ins",
+                            extra_inputs=[("Ins_tag", tags, None),
+                                          ("Filter_tag", filt, None)],
+                            outputs=("Out", "LossWeight"))
+    np.testing.assert_allclose(lw.ravel(), [1, 0, 1, 0])
+    np.testing.assert_allclose(o[1], 0.0)
+    np.testing.assert_allclose(o[0], x[0])
+
+
+def test_tdm_child():
+    # tree: node 1 has children 2,3 (both items); node 2 is a leaf item
+    # row = [item_id, layer, parent, child0, child1]
+    info = np.array([[0, 0, 0, 0, 0],
+                     [1, 0, 0, 2, 3],
+                     [2, 1, 1, 0, 0],
+                     [3, 1, 1, 0, 0]], np.int32)
+    x = np.array([[1], [2]], np.int64)
+    (child, mask), _ = run_seq_op(
+        "tdm_child", x, None,
+        extra_inputs=[("TreeInfo", info, None)],
+        attrs={"child_nums": 2}, outputs=("Child", "LeafMask"))
+    np.testing.assert_array_equal(child.reshape(2, 2), [[2, 3], [0, 0]])
+    np.testing.assert_array_equal(mask.reshape(2, 2), [[1, 1], [0, 0]])
+
+
+def test_tdm_sampler():
+    travel = np.array([[1, 3], [2, 6]], np.int32)  # path per item
+    layer = np.array([1, 2, 3, 4, 5, 6], np.int32)
+    x = np.array([[0], [1]], np.int64)
+    (o, lab, mask), _ = run_seq_op(
+        "tdm_sampler", x, None,
+        extra_inputs=[("Travel", travel, None), ("Layer", layer, None)],
+        attrs={"neg_samples_num_list": [1, 1],
+               "layer_offset_lod": [0, 2, 6]},
+        outputs=("Out", "Labels", "Mask"))
+    o = o.reshape(2, 4)
+    lab = lab.reshape(2, 4)
+    # positives in cols 0 and 2; labels 1 there, 0 on negatives
+    np.testing.assert_array_equal(o[:, 0], travel[[0, 1], 0])
+    np.testing.assert_array_equal(o[:, 2], travel[[0, 1], 1])
+    np.testing.assert_array_equal(lab[:, 0], [1, 1])
+    np.testing.assert_array_equal(lab[:, 1], [0, 0])
+
+
+def test_rank_attention():
+    rng = np.random.RandomState(3)
+    n, d, p_col, mr = 3, 4, 2, 2
+    x = rng.rand(n, d).astype(np.float32)
+    param = rng.rand(mr * mr * d, p_col).astype(np.float32)
+    # sample 0: ins_rank 1, one neighbour of rank 2
+    ro = np.array([[1, 2, 0, 0, 0],
+                   [2, 1, 0, 2, 0],
+                   [0, 0, 0, 0, 0]], np.int32)
+    (o,), _ = run_seq_op("rank_attention", x, None,
+                         extra_inputs=[("RankOffset", ro, None),
+                                       ("RankParam", param, None)],
+                         attrs={"MaxRank": mr})
+    pb = param.reshape(mr * mr, d, p_col)
+    ref0 = x[0] @ pb[(1 - 1) * mr + (2 - 1)]
+    ref1 = x[1] @ pb[(2 - 1) * mr + (1 - 1)] + x[1] @ pb[(2 - 1) * mr + (2 - 1)]
+    np.testing.assert_allclose(o[0], ref0, rtol=1e-5)
+    np.testing.assert_allclose(o[1], ref1, rtol=1e-5)
+    np.testing.assert_allclose(o[2], 0.0)
+
+
+def test_match_matrix_tensor():
+    rng = np.random.RandomState(4)
+    x = rng.rand(3, 4).astype(np.float32)   # one seq of 3
+    y = rng.rand(2, 4).astype(np.float32)   # one seq of 2
+    w = rng.rand(4, 2, 4).astype(np.float32)
+    (o,), lods = run_seq_op("match_matrix_tensor", x, [[3]],
+                            extra_inputs=[("Y", y, [[2]]),
+                                          ("W", w, None)],
+                            attrs={"dim_t": 2})
+    ref = np.einsum("id,dte,ke->tik", x, w, y).reshape(-1, 1)
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_var_conv_2d():
+    rng = np.random.RandomState(5)
+    # one sequence, 1 channel, 4x5 image
+    img = rng.rand(20, 1).astype(np.float32)
+    w = rng.rand(1, 9).astype(np.float32)   # oc=1, ic*kh*kw=9
+    (o,), _ = run_seq_op(
+        "var_conv_2d", img, [[20]],
+        extra_inputs=[("ROW", np.zeros((4, 1), np.float32), [[4]]),
+                      ("COLUMN", np.zeros((5, 1), np.float32), [[5]]),
+                      ("W", w, None)],
+        attrs={"InputChannel": 1, "OutputChannel": 1, "KernelH": 3,
+               "KernelW": 3, "StrideH": 1, "StrideW": 1})
+    import torch
+    import torch.nn.functional as F
+    ref = F.conv2d(torch.from_numpy(img.reshape(1, 1, 4, 5)),
+                   torch.from_numpy(w.reshape(1, 1, 3, 3)),
+                   padding=1).numpy().reshape(-1, 1)
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_topk_avg_pooling():
+    # one pair: 1 channel, 2 rows x 3 cols
+    m = np.array([[3.0], [1.0], [2.0], [6.0], [5.0], [4.0]], np.float32)
+    (o,), _ = run_seq_op(
+        "sequence_topk_avg_pooling", m, [[6]],
+        extra_inputs=[("ROW", np.zeros((2, 1), np.float32), [[2]]),
+                      ("COLUMN", np.zeros((3, 1), np.float32), [[3]])],
+        attrs={"topks": [2], "channel_num": 1})
+    # row0 top2 = (3+2)/2, row1 top2 = (6+5)/2
+    np.testing.assert_allclose(o.ravel(), [2.5, 5.5], rtol=1e-6)
+
+
+def test_pyramid_hash_shapes():
+    ids = np.array([[1], [2], [3], [4]], np.int64)
+    w = np.random.RandomState(6).rand(100, 1).astype(np.float32)
+    (o,), _ = run_seq_op("pyramid_hash", ids, [[4]],
+                         extra_inputs=[("W", w, None)],
+                         attrs={"num_emb": 8, "rand_len": 4,
+                                "space_len": 100, "pyramid_layer": 2})
+    assert o.shape == (4, 8)
+    assert np.isfinite(o).all()
+    # last token has no complete 2-gram: contribution zero
+    np.testing.assert_allclose(o[3], 0.0)
+
+
+def test_chunk_eval_plain_scheme():
+    # plain: every tag is its own chunk
+    inf = np.array([[0], [0]], np.int64)
+    lab = np.array([[0], [0]], np.int64)
+    (p, r, f1, ni, nl, nc), _ = run_seq_op(
+        "chunk_eval", inf, [[2]], x_slot="Inference",
+        extra_inputs=[("Label", lab, [[2]])],
+        attrs={"num_chunk_types": 1, "chunk_scheme": "plain"},
+        outputs=("Precision", "Recall", "F1-Score", "NumInferChunks",
+                 "NumLabelChunks", "NumCorrectChunks"))
+    assert ni[0] == 2 and nl[0] == 2 and nc[0] == 2
+    np.testing.assert_allclose(f1[0], 1.0)
+
+
+def test_detection_map_difficult_and_state():
+    # 6-col gt layout: [label, difficult, x1, y1, x2, y2]
+    det = np.array([[1, 0.9, 0, 0, 10, 10]], np.float32)
+    gt6 = np.array([[1, 0, 0, 0, 10, 10],
+                    [1, 1, 20, 20, 30, 30]], np.float32)  # second difficult
+    (m,), _ = run_seq_op(
+        "detection_map", det, [[1]], x_slot="DetectRes",
+        extra_inputs=[("Label", gt6, [[2]])],
+        attrs={"class_num": 2, "overlap_threshold": 0.5,
+               "evaluate_difficult": False},
+        outputs=("MAP",))
+    # difficult gt excluded from npos -> perfect AP
+    np.testing.assert_allclose(m[0], 1.0, rtol=1e-6)
+    (m2,), _ = run_seq_op(
+        "detection_map", det, [[1]], x_slot="DetectRes",
+        extra_inputs=[("Label", gt6, [[2]])],
+        attrs={"class_num": 2, "overlap_threshold": 0.5,
+               "evaluate_difficult": True},
+        outputs=("MAP",))
+    assert m2[0] < 1.0  # difficult counted as a miss
+
+
+def test_partial_ops_negative_start():
+    rng = np.random.RandomState(20)
+    a = rng.rand(3, 6).astype(np.float32)
+    b = rng.rand(3, 6).astype(np.float32)
+    (o,), _ = run_seq_op("partial_concat", a, None,
+                         extra_inputs=[("X", b, None)],
+                         attrs={"start_index": -1, "length": 1})
+    np.testing.assert_allclose(o, np.concatenate([a[:, -1:], b[:, -1:]], 1))
+
+
+def test_fusion_seqpool_cvm_concat_transform():
+    x = np.array([[1.0, 2.0, 3.0],
+                  [4.0, 5.0, 6.0]], np.float32)
+    (o,), _ = run_seq_op("fusion_seqpool_cvm_concat", x, [[2]],
+                         attrs={"pooltype": "SUM", "use_cvm": True})
+    pooled = x.sum(0)
+    ref = np.concatenate([np.log(pooled[:2] + 1), pooled[2:]])
+    np.testing.assert_allclose(o.ravel(), ref, rtol=1e-5)
