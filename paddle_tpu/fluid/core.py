@@ -336,6 +336,20 @@ class LoDTensorArray(list):
     pass
 
 
+class LoDRankTable:
+    """reference: framework/lod_rank_table.h — sequences of one LoD level
+    sorted by length descending; items are (index, length)."""
+
+    __slots__ = ("items", "level")
+
+    def __init__(self, items=None, level=0):
+        self.items = list(items or [])  # [(seq_index, length), ...]
+        self.level = level
+
+    def __repr__(self):
+        return f"LoDRankTable({self.items})"
+
+
 # --------------------------------------------------------------------------
 # Variable / Scope (reference: framework/variable.h:26, scope.h:46)
 # --------------------------------------------------------------------------
@@ -362,6 +376,11 @@ class Variable:
     def get_lod_tensor_array(self) -> LoDTensorArray:
         if self._holder is None:
             self._holder = LoDTensorArray()
+        return self._holder
+
+    def get_lod_rank_table(self) -> "LoDRankTable":
+        if self._holder is None:
+            self._holder = LoDRankTable()
         return self._holder
 
     def set_value(self, v):

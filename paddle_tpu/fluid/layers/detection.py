@@ -23,20 +23,6 @@ __all__ = [
 ]
 
 
-def _nyi(name):
-    def fn(*a, **k):
-        raise NotImplementedError(
-            f"{name}: not yet implemented in paddle_tpu")
-    fn.__name__ = name
-    return fn
-
-
-# lower-priority long tail — explicit NYI (kept out of the op registry)
-roi_perspective_transform = _nyi("roi_perspective_transform")
-generate_proposal_labels = _nyi("generate_proposal_labels")
-generate_mask_labels = _nyi("generate_mask_labels")
-
-
 def polygon_box_transform(input, name=None):
     helper = LayerHelper("polygon_box_transform", **locals())
     out = helper.create_variable_for_type_inference(input.dtype)
@@ -45,11 +31,190 @@ def polygon_box_transform(input, name=None):
                      inputs={"Input": [input]},
                      outputs={"Output": [out]})
     return out
-locality_aware_nms = _nyi("locality_aware_nms")
-retinanet_detection_output = _nyi("retinanet_detection_output")
-retinanet_target_assign = _nyi("retinanet_target_assign")
-rpn_target_assign = _nyi("rpn_target_assign")
-box_decoder_and_assign = _nyi("box_decoder_and_assign")
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    helper = LayerHelper("rpn_target_assign", **locals())
+    i32 = VarDesc.VarType.INT32
+    loc_index = _mk_out(helper, i32)
+    score_index = _mk_out(helper, i32)
+    loc_index.shape = (-1,)
+    score_index.shape = (-1,)
+    target_label = _mk_out(helper, i32)
+    target_bbox = _mk_out(helper)
+    bbox_inside_weight = _mk_out(helper)
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+                "IsCrowd": [is_crowd], "ImInfo": [im_info]},
+        outputs={"LocationIndex": [loc_index], "ScoreIndex": [score_index],
+                 "TargetLabel": [target_label], "TargetBBox": [target_bbox],
+                 "BBoxInsideWeight": [bbox_inside_weight]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_straddle_thresh": rpn_straddle_thresh,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "use_random": use_random})
+    from .nn import gather as _gather, reshape as _reshape
+    pred_loc = _gather(_reshape(bbox_pred, [-1, 4]), loc_index)
+    pred_score = _gather(_reshape(cls_logits, [-1, 1]), score_index)
+    return (pred_score, pred_loc, target_label, target_bbox,
+            bbox_inside_weight)
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    helper = LayerHelper("retinanet_target_assign", **locals())
+    i32 = VarDesc.VarType.INT32
+    loc_index = _mk_out(helper, i32)
+    score_index = _mk_out(helper, i32)
+    loc_index.shape = (-1,)
+    score_index.shape = (-1,)
+    target_label = _mk_out(helper, i32)
+    target_bbox = _mk_out(helper)
+    bbox_inside_weight = _mk_out(helper)
+    fg_num = _mk_out(helper, i32)
+    helper.append_op(
+        type="retinanet_target_assign",
+        inputs={"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+                "GtLabels": [gt_labels], "IsCrowd": [is_crowd],
+                "ImInfo": [im_info]},
+        outputs={"LocationIndex": [loc_index], "ScoreIndex": [score_index],
+                 "TargetLabel": [target_label], "TargetBBox": [target_bbox],
+                 "BBoxInsideWeight": [bbox_inside_weight],
+                 "ForegroundNumber": [fg_num]},
+        attrs={"positive_overlap": positive_overlap,
+               "negative_overlap": negative_overlap})
+    from .nn import gather as _gather, reshape as _reshape
+    pred_loc = _gather(_reshape(bbox_pred, [-1, 4]), loc_index)
+    pred_score = _gather(_reshape(cls_logits, [-1, num_classes]),
+                         score_index)
+    return (pred_score, pred_loc, target_label, target_bbox,
+            bbox_inside_weight, fg_num)
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    helper = LayerHelper("retinanet_detection_output", **locals())
+    out = _mk_out(helper)
+    helper.append_op(
+        type="retinanet_detection_output",
+        inputs={"BBoxes": list(bboxes), "Scores": list(scores),
+                "Anchors": list(anchors), "ImInfo": [im_info]},
+        outputs={"Out": [out]},
+        attrs={"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+               "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+               "nms_eta": nms_eta})
+    return out
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    helper = LayerHelper("locality_aware_nms", **locals())
+    out = _mk_out(helper)
+    helper.append_op(
+        type="locality_aware_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out]},
+        attrs={"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+               "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+               "normalized": normalized, "nms_eta": nms_eta,
+               "background_label": background_label})
+    return out
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    helper = LayerHelper("box_decoder_and_assign", **locals())
+    decoded = _mk_out(helper)
+    assigned = _mk_out(helper)
+    helper.append_op(
+        type="box_decoder_and_assign",
+        inputs={"PriorBox": [prior_box], "PriorBoxVar": [prior_box_var],
+                "TargetBox": [target_box], "BoxScore": [box_score]},
+        outputs={"DecodeBox": [decoded], "OutputAssignBox": [assigned]},
+        attrs={"box_clip": box_clip})
+    return decoded, assigned
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=[0.1, 0.1, 0.2, 0.2],
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    helper = LayerHelper("generate_proposal_labels", **locals())
+    rois = _mk_out(helper)
+    labels_int32 = _mk_out(helper, VarDesc.VarType.INT32)
+    bbox_targets = _mk_out(helper)
+    bbox_inside_weights = _mk_out(helper)
+    bbox_outside_weights = _mk_out(helper)
+    helper.append_op(
+        type="generate_proposal_labels",
+        inputs={"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+                "IsCrowd": [is_crowd], "GtBoxes": [gt_boxes],
+                "ImInfo": [im_info]},
+        outputs={"Rois": [rois], "LabelsInt32": [labels_int32],
+                 "BboxTargets": [bbox_targets],
+                 "BboxInsideWeights": [bbox_inside_weights],
+                 "BboxOutsideWeights": [bbox_outside_weights]},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": bbox_reg_weights,
+               "class_nums": class_nums or 81, "use_random": use_random,
+               "is_cls_agnostic": is_cls_agnostic,
+               "is_cascade_rcnn": is_cascade_rcnn})
+    return (rois, labels_int32, bbox_targets, bbox_inside_weights,
+            bbox_outside_weights)
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    helper = LayerHelper("generate_mask_labels", **locals())
+    mask_rois = _mk_out(helper)
+    roi_has_mask_int32 = _mk_out(helper, VarDesc.VarType.INT32)
+    mask_int32 = _mk_out(helper, VarDesc.VarType.INT32)
+    helper.append_op(
+        type="generate_mask_labels",
+        inputs={"ImInfo": [im_info], "GtClasses": [gt_classes],
+                "IsCrowd": [is_crowd], "GtSegms": [gt_segms],
+                "Rois": [rois], "LabelsInt32": [labels_int32]},
+        outputs={"MaskRois": [mask_rois],
+                 "RoiHasMaskInt32": [roi_has_mask_int32],
+                 "MaskInt32": [mask_int32]},
+        attrs={"num_classes": num_classes, "resolution": resolution})
+    return mask_rois, roi_has_mask_int32, mask_int32
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    helper = LayerHelper("roi_perspective_transform", **locals())
+    out = _mk_out(helper)
+    mask = _mk_out(helper, VarDesc.VarType.INT32)
+    matrix = _mk_out(helper)
+    out2in_idx = _mk_out(helper, VarDesc.VarType.INT32)
+    out2in_w = _mk_out(helper)
+    helper.append_op(
+        type="roi_perspective_transform",
+        inputs={"X": [input], "ROIs": [rois]},
+        outputs={"Out": [out], "Mask": [mask], "TransformMatrix": [matrix],
+                 "Out2InIdx": [out2in_idx], "Out2InWeights": [out2in_w]},
+        attrs={"transformed_height": transformed_height,
+               "transformed_width": transformed_width,
+               "spatial_scale": spatial_scale})
+    return out, mask, matrix
 
 
 def _mk_out(helper, dtype=None):

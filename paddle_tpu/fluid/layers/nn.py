@@ -728,7 +728,8 @@ def strided_slice(input, axes, starts, ends, strides):
 def gather(input, index, overwrite=True):
     helper = LayerHelper("gather")
     out = helper.create_variable_for_type_inference(input.dtype)
-    out.shape = tuple([index.shape[0]] + list(input.shape[1:]))
+    idx_rows = index.shape[0] if index.shape else -1
+    out.shape = tuple([idx_rows] + list(input.shape[1:]))
     helper.append_op(type="gather", inputs={"X": [input], "Index": [index]},
                      outputs={"Out": [out]})
     return out

@@ -23,3 +23,5 @@ from . import vision_ops  # noqa: F401
 from . import misc_ops   # noqa: F401
 from . import fused_ops  # noqa: F401
 from . import metrics_misc_ops  # noqa: F401
+from . import detection_train_ops  # noqa: F401
+from . import lod_control_ops  # noqa: F401

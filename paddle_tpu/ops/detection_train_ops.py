@@ -1,0 +1,639 @@
+"""Detection-training long tail (reference:
+operators/detection/rpn_target_assign_op.cc (also retinanet variant),
+retinanet_detection_output_op.cc, locality_aware_nms_op.cc,
+box_decoder_and_assign_op.cc, generate_proposal_labels_op.cc,
+generate_mask_labels_op.cc, mine_hard_examples_op.cc,
+roi_perspective_transform_op.cc).
+
+All are host-side sampling/matching ops in the reference too (CPU-only
+kernels); here host numpy flagged ``stateful`` so the executor runs their
+blocks eagerly. Box coordinates follow the reference xyxy convention."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, first, seq, out
+from .detection_ops import _iou_xyxy, _nms
+
+
+# persistent sampling stream: a per-call RandomState(seed=0) would replay
+# the identical fg/bg sample every training step
+_SAMPLER = np.random.RandomState(12345)
+
+
+def _rng_of(attrs):
+    seed = int(attrs.get("seed", 0))
+    return np.random.RandomState(seed) if seed else _SAMPLER
+
+
+def _lod_offs(attrs, slot, n):
+    lod = (attrs.get("_lod") or {}).get(slot)
+    if lod and lod[0]:
+        return np.asarray(lod[0][-1], np.int64)
+    return np.asarray([0, n], np.int64)
+
+
+def _box_encode(gt, anchor, weights=(1.0, 1.0, 1.0, 1.0)):
+    """encode_center_size deltas of gt w.r.t. anchors (both [N,4] xyxy)."""
+    aw = anchor[:, 2] - anchor[:, 0] + 1.0
+    ah = anchor[:, 3] - anchor[:, 1] + 1.0
+    ax = anchor[:, 0] + aw * 0.5
+    ay = anchor[:, 1] + ah * 0.5
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gx = gt[:, 0] + gw * 0.5
+    gy = gt[:, 1] + gh * 0.5
+    wx, wy, ww, wh = weights
+    return np.stack([wx * (gx - ax) / aw, wy * (gy - ay) / ah,
+                     ww * np.log(gw / aw), wh * np.log(gh / ah)], axis=1)
+
+
+def _iou_matrix(a, b, norm=False):
+    """[Na,4] x [Nb,4] -> [Na,Nb] IoU (xyxy, +1 pixel convention)."""
+    if len(a) == 0 or len(b) == 0:
+        return np.zeros((len(a), len(b)), np.float32)
+    off = 0.0 if norm else 1.0
+    ix1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.maximum(ix2 - ix1 + off, 0) * np.maximum(iy2 - iy1 + off, 0)
+    ar_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    ar_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    return inter / np.maximum(ar_a[:, None] + ar_b[None, :] - inter, 1e-10)
+
+
+def _rpn_assign_one(anchors, gts, rng, pos_thr, neg_thr, fg_frac, batch,
+                    use_random, retinanet=False, gt_labels=None,
+                    valid=None):
+    """Shared anchor-target sampling. Returns (fg_idx, bg_idx, gt_of_fg)."""
+    if valid is None:
+        valid = np.ones(len(anchors), bool)
+    iou = _iou_matrix(anchors, gts)
+    if iou.size == 0:
+        return (np.zeros(0, np.int64),
+                np.where(valid)[0][:batch], np.zeros(0, np.int64))
+    best_gt = iou.argmax(axis=1)
+    best_iou = iou.max(axis=1)
+    fg_mask = best_iou >= pos_thr
+    # every gt's best anchor is positive regardless of threshold
+    fg_mask[iou.argmax(axis=0)] = True
+    fg_mask &= valid
+    bg_mask = (best_iou < neg_thr) & ~fg_mask & valid
+    fg_idx = np.where(fg_mask)[0]
+    bg_idx = np.where(bg_mask)[0]
+    if retinanet:
+        # retinanet keeps ALL fg/bg (focal loss handles imbalance)
+        return fg_idx, bg_idx, best_gt[fg_idx]
+    n_fg = int(batch * fg_frac)
+    if len(fg_idx) > n_fg:
+        fg_idx = (rng.permutation(fg_idx)[:n_fg] if use_random
+                  else fg_idx[:n_fg])
+    n_bg = batch - len(fg_idx)
+    if len(bg_idx) > n_bg:
+        bg_idx = (rng.permutation(bg_idx)[:n_bg] if use_random
+                  else bg_idx[:n_bg])
+    return fg_idx, bg_idx, best_gt[fg_idx]
+
+
+def _rpn_like(ins, attrs, retinanet):
+    anchors = np.asarray(first(ins, "Anchor")).reshape(-1, 4)
+    gtb = np.asarray(first(ins, "GtBoxes"))
+    goffs = _lod_offs(attrs, "GtBoxes", len(gtb))
+    glab = (np.asarray(first(ins, "GtLabels")).reshape(-1)
+            if retinanet else None)
+    crowd_in = first(ins, "IsCrowd")
+    crowd = (np.asarray(crowd_in).reshape(-1).astype(bool)
+             if crowd_in is not None else np.zeros(len(gtb), bool))
+    im_info = first(ins, "ImInfo")
+    rng = _rng_of(attrs)
+    A = len(anchors)
+    # straddle filter: anchors poking further than straddle_thresh outside
+    # the image are excluded from sampling (reference rpn_target_assign_op)
+    straddle = attrs.get("rpn_straddle_thresh", 0.0)
+    if im_info is not None and straddle >= 0 and not retinanet:
+        hi = np.asarray(im_info)[0]
+        h, w = float(hi[0]), float(hi[1])
+        inside = ((anchors[:, 0] >= -straddle) & (anchors[:, 1] >= -straddle)
+                  & (anchors[:, 2] < w + straddle)
+                  & (anchors[:, 3] < h + straddle))
+    else:
+        inside = np.ones(A, bool)
+    loc_idx, score_idx, tgt_lab, tgt_box, fg_counts = [], [], [], [], []
+    lens_loc, lens_score = [], []
+    for i in range(len(goffs) - 1):
+        keep_gt = ~crowd[goffs[i]:goffs[i + 1]]
+        gts = gtb[goffs[i]:goffs[i + 1]][keep_gt]
+        labs = (glab[goffs[i]:goffs[i + 1]][keep_gt]
+                if retinanet else None)
+        fg, bg, gt_of = _rpn_assign_one(
+            anchors, gts, rng,
+            attrs.get("rpn_positive_overlap", 0.7),
+            attrs.get("rpn_negative_overlap", 0.3),
+            attrs.get("rpn_fg_fraction", 0.5),
+            int(attrs.get("rpn_batch_size_per_im", 256)),
+            attrs.get("use_random", True), retinanet=retinanet,
+            valid=inside)
+        base = i * A
+        loc_idx.extend(base + fg)
+        score_idx.extend(base + np.concatenate([fg, bg]))
+        if retinanet:
+            tgt_lab.extend([int(labs[g]) for g in gt_of] + [0] * len(bg))
+        else:
+            tgt_lab.extend([1] * len(fg) + [0] * len(bg))
+        if len(fg):
+            tgt_box.append(_box_encode(gts[gt_of], anchors[fg]))
+        fg_counts.append(len(fg))
+        lens_loc.append(len(fg))
+        lens_score.append(len(fg) + len(bg))
+    tb = (np.concatenate(tgt_box, axis=0) if tgt_box
+          else np.zeros((0, 4), np.float32))
+    li = np.asarray(loc_idx, np.int32)[:, None]
+    si = np.asarray(score_idx, np.int32)[:, None]
+    tl = np.asarray(tgt_lab, np.int32)[:, None]
+    lod_of = lambda lens: (tuple(
+        int(v) for v in np.concatenate([[0], np.cumsum(lens)])),)
+    res = {"LocationIndex": [jnp.asarray(li.reshape(-1))],
+           "ScoreIndex": [jnp.asarray(si.reshape(-1))],
+           "TargetLabel": [jnp.asarray(tl)],
+           "TargetBBox": [jnp.asarray(tb.astype(np.float32))],
+           "BBoxInsideWeight": [jnp.ones((len(tb), 4), jnp.float32)],
+           "_lod": {"LocationIndex": [lod_of(lens_loc)],
+                    "ScoreIndex": [lod_of(lens_score)],
+                    "TargetLabel": [lod_of(lens_score)],
+                    "TargetBBox": [lod_of(lens_loc)]}}
+    if retinanet:
+        res["ForegroundNumber"] = [jnp.asarray(
+            np.asarray(fg_counts, np.int32)[:, None])]
+    return res
+
+
+@register_op("rpn_target_assign", stateful=True, no_grad=True,
+             needs_lod=True,
+             inputs=("Anchor", "GtBoxes", "IsCrowd", "ImInfo"),
+             attr_defaults={"rpn_batch_size_per_im": 256,
+                            "rpn_straddle_thresh": 0.0,
+                            "rpn_fg_fraction": 0.5,
+                            "rpn_positive_overlap": 0.7,
+                            "rpn_negative_overlap": 0.3,
+                            "use_random": True, "seed": 0})
+def _rpn_target_assign(ins, attrs):
+    return _rpn_like(ins, attrs, retinanet=False)
+
+
+@register_op("retinanet_target_assign", stateful=True, no_grad=True,
+             needs_lod=True,
+             inputs=("Anchor", "GtBoxes", "GtLabels", "IsCrowd", "ImInfo"),
+             attr_defaults={"positive_overlap": 0.5,
+                            "negative_overlap": 0.4, "seed": 0})
+def _retinanet_target_assign(ins, attrs):
+    a2 = dict(attrs)
+    a2["rpn_positive_overlap"] = attrs.get("positive_overlap", 0.5)
+    a2["rpn_negative_overlap"] = attrs.get("negative_overlap", 0.4)
+    return _rpn_like(ins, a2, retinanet=True)
+
+
+@register_op("retinanet_detection_output", stateful=True, no_grad=True,
+             needs_lod=True,
+             inputs=("BBoxes", "Scores", "Anchors", "ImInfo"),
+             attr_defaults={"score_threshold": 0.05, "nms_top_k": 1000,
+                            "nms_threshold": 0.3, "keep_top_k": 100,
+                            "nms_eta": 1.0})
+def _retinanet_detection_output(ins, attrs):
+    """Decode per-FPN-level regression against anchors, merge levels, NMS
+    per class (reference retinanet_detection_output_op.cc). Single-image
+    batch per LoD row of ImInfo."""
+    bbox_levels = [np.asarray(b) for b in seq(ins, "BBoxes")]
+    score_levels = [np.asarray(s) for s in seq(ins, "Scores")]
+    anchor_levels = [np.asarray(a).reshape(-1, 4)
+                     for a in seq(ins, "Anchors")]
+    im_info = np.asarray(first(ins, "ImInfo"))
+    n_img = im_info.shape[0]
+    thr = attrs.get("score_threshold", 0.05)
+    out_rows, lens = [], []
+    for i in range(n_img):
+        boxes_all, scores_all, labels_all = [], [], []
+        for bl, sl, al in zip(bbox_levels, score_levels, anchor_levels):
+            deltas = bl[i] if bl.ndim == 3 else bl
+            scores = sl[i] if sl.ndim == 3 else sl
+            # decode center-size deltas vs anchors
+            aw = al[:, 2] - al[:, 0] + 1.0
+            ah = al[:, 3] - al[:, 1] + 1.0
+            ax = al[:, 0] + aw / 2
+            ay = al[:, 1] + ah / 2
+            cx = deltas[:, 0] * aw + ax
+            cy = deltas[:, 1] * ah + ay
+            w = np.exp(np.clip(deltas[:, 2], -10, 10)) * aw
+            h = np.exp(np.clip(deltas[:, 3], -10, 10)) * ah
+            dec = np.stack([cx - w / 2, cy - h / 2,
+                            cx + w / 2, cy + h / 2], axis=1)
+            C = scores.shape[1]
+            for c in range(C):
+                sel = np.where(scores[:, c] > thr)[0]
+                boxes_all.append(dec[sel])
+                scores_all.append(scores[sel, c])
+                labels_all.append(np.full(len(sel), c, np.int64))
+        boxes = np.concatenate(boxes_all) if boxes_all else np.zeros((0, 4))
+        scores = np.concatenate(scores_all) if scores_all else np.zeros(0)
+        labels = np.concatenate(labels_all) if labels_all else np.zeros(0, np.int64)
+        rows = []
+        for c in np.unique(labels):
+            selc = labels == c
+            keep = _nms(boxes[selc], scores[selc],
+                        attrs.get("nms_threshold", 0.3),
+                        attrs.get("nms_top_k", 1000), norm=False,
+                        eta=attrs.get("nms_eta", 1.0))
+            bsel = boxes[selc][keep]
+            ssel = scores[selc][keep]
+            for b, s_ in zip(bsel, ssel):
+                rows.append([float(c), float(s_), *map(float, b)])
+        rows.sort(key=lambda r: -r[1])
+        rows = rows[:int(attrs.get("keep_top_k", 100))]
+        out_rows.extend(rows)
+        lens.append(len(rows))
+    o = (np.asarray(out_rows, np.float32) if out_rows
+         else np.zeros((0, 6), np.float32))
+    lod0 = tuple(int(v) for v in np.concatenate([[0], np.cumsum(lens)]))
+    return {"Out": [jnp.asarray(o)], "_lod": {"Out": [(lod0,)]}}
+
+
+@register_op("locality_aware_nms", stateful=True, no_grad=True,
+             needs_lod=True, inputs=("BBoxes", "Scores"),
+             attr_defaults={"score_threshold": 0.0, "nms_top_k": -1,
+                            "nms_threshold": 0.3, "keep_top_k": -1,
+                            "background_label": -1, "normalized": False,
+                            "nms_eta": 1.0})
+def _locality_aware_nms(ins, attrs):
+    """EAST-style NMS: first weighted-merge consecutive overlapping boxes
+    (score-weighted average of coordinates), then standard NMS
+    (reference locality_aware_nms_op.cc)."""
+    boxes = np.asarray(first(ins, "BBoxes"))
+    scores = np.asarray(first(ins, "Scores"))
+    if boxes.ndim == 3:
+        boxes = boxes[0]
+    if scores.ndim == 3:
+        scores = scores[0]
+    C = scores.shape[0] if scores.ndim == 2 else 1
+    scores = scores.reshape(C, -1)
+    thr = attrs.get("nms_threshold", 0.3)
+    rows = []
+    for c in range(C):
+        if c == attrs.get("background_label", -1):
+            continue
+        s = scores[c].copy()
+        sel = np.where(s > attrs.get("score_threshold", 0.0))[0]
+        merged_boxes, merged_scores = [], []
+        for i in sel:   # locality pass: merge into the previous if overlap
+            b, sc = boxes[i].astype(np.float64), float(s[i])
+            if merged_boxes and _iou_xyxy(
+                    merged_boxes[-1], b,
+                    attrs.get("normalized", False)) > thr:
+                pb, ps = merged_boxes[-1], merged_scores[-1]
+                wsum = ps + sc
+                merged_boxes[-1] = (pb * ps + b * sc) / wsum
+                merged_scores[-1] = wsum
+            else:
+                merged_boxes.append(b)
+                merged_scores.append(sc)
+        if not merged_boxes:
+            continue
+        mb = np.asarray(merged_boxes)
+        ms = np.asarray(merged_scores)
+        keep = _nms(mb, ms, thr, attrs.get("nms_top_k", -1),
+                    attrs.get("normalized", False),
+                    attrs.get("nms_eta", 1.0))
+        for k in keep:
+            rows.append([float(c), float(ms[k]), *map(float, mb[k])])
+    rows.sort(key=lambda r: -r[1])
+    if attrs.get("keep_top_k", -1) > 0:
+        rows = rows[:attrs["keep_top_k"]]
+    o = (np.asarray(rows, np.float32) if rows
+         else np.zeros((0, 6), np.float32))
+    lod0 = (0, len(rows))
+    return {"Out": [jnp.asarray(o)], "_lod": {"Out": [(lod0,)]}}
+
+
+@register_op("box_decoder_and_assign", no_grad=True,
+             inputs=("PriorBox", "PriorBoxVar", "TargetBox", "BoxScore"),
+             attr_defaults={"box_clip": 4.135})
+def _box_decoder_and_assign(ins, attrs):
+    """Decode per-class box deltas vs priors and pick each roi's best-class
+    box (reference box_decoder_and_assign_op.cc)."""
+    prior = first(ins, "PriorBox")         # [R, 4]
+    pvar = first(ins, "PriorBoxVar")       # [4] or [R,4]
+    deltas = first(ins, "TargetBox")       # [R, C*4]
+    score = first(ins, "BoxScore")         # [R, C]
+    clip = attrs.get("box_clip", 4.135)
+    R = prior.shape[0]
+    C = score.shape[1]
+    d = deltas.reshape(R, C, 4)
+    if pvar is not None:
+        pv = pvar.reshape(-1, 4) if pvar.ndim > 1 else pvar.reshape(1, 4)
+        d = d * pv[:, None, :] if pv.shape[0] == R else d * pv[None, :, :]
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    px = prior[:, 0] + pw * 0.5
+    py = prior[:, 1] + ph * 0.5
+    cx = d[:, :, 0] * pw[:, None] + px[:, None]
+    cy = d[:, :, 1] * ph[:, None] + py[:, None]
+    w = jnp.exp(jnp.minimum(d[:, :, 2], clip)) * pw[:, None]
+    h = jnp.exp(jnp.minimum(d[:, :, 3], clip)) * ph[:, None]
+    dec = jnp.stack([cx - w / 2, cy - h / 2,
+                     cx + w / 2 - 1.0, cy + h / 2 - 1.0], axis=2)
+    best = jnp.argmax(score, axis=1)
+    assigned = dec[jnp.arange(R), best]
+    return {"DecodeBox": [dec.reshape(R, C * 4)],
+            "OutputAssignBox": [assigned]}
+
+
+@register_op("mine_hard_examples", stateful=True, no_grad=True,
+             needs_lod=True,
+             inputs=("ClsLoss", "LocLoss", "MatchIndices", "MatchDist"),
+             attr_defaults={"neg_pos_ratio": 3.0, "neg_dist_threshold": 0.5,
+                            "mining_type": "max_negative", "sample_size": 0})
+def _mine_hard_examples(ins, attrs):
+    """SSD hard-negative mining (reference mine_hard_examples_op.cc):
+    keep the highest-loss negatives up to neg_pos_ratio * #pos per image."""
+    cls_loss = np.asarray(first(ins, "ClsLoss"))     # [N, P]
+    loc_loss = first(ins, "LocLoss")
+    loss = cls_loss + (np.asarray(loc_loss) if loc_loss is not None else 0.0)
+    match = np.asarray(first(ins, "MatchIndices"))   # [N, P]
+    dist = first(ins, "MatchDist")
+    dist = np.asarray(dist) if dist is not None else None
+    ratio = attrs.get("neg_pos_ratio", 3.0)
+    neg_thr = attrs.get("neg_dist_threshold", 0.5)
+    N, P = match.shape
+    hard_mode = attrs.get("mining_type", "max_negative") == "hard_example"
+    neg_rows, neg_lens = [], []
+    upd = match.copy()
+    for i in range(N):
+        pos = match[i] != -1
+        n_pos = int(pos.sum())
+        n_neg = int(n_pos * ratio)
+        if hard_mode and attrs.get("sample_size", 0):
+            n_neg = int(attrs["sample_size"])
+        cand = np.where(~pos & ((dist[i] < neg_thr) if dist is not None
+                                else np.ones(P, bool)))[0]
+        cand = cand[np.argsort(-loss[i][cand])][:n_neg]
+        neg_rows.extend(int(c) for c in sorted(cand))
+        neg_lens.append(len(cand))
+        if hard_mode:
+            # hard-example mode resets matches outside positives + the
+            # selected hard negatives (reference mine_hard_examples_op.cc)
+            keep = pos.copy()
+            keep[cand] = True
+            upd[i][~keep] = -1
+    lod0 = tuple(int(v) for v in np.concatenate([[0], np.cumsum(neg_lens)]))
+    neg = (np.asarray(neg_rows, np.int32)[:, None] if neg_rows
+           else np.zeros((0, 1), np.int32))
+    return {"NegIndices": [jnp.asarray(neg)],
+            "UpdatedMatchIndices": [jnp.asarray(upd)],
+            "_lod": {"NegIndices": [(lod0,)]}}
+
+
+@register_op("generate_proposal_labels", stateful=True, no_grad=True,
+             needs_lod=True,
+             inputs=("RpnRois", "GtClasses", "IsCrowd", "GtBoxes", "ImInfo"),
+             attr_defaults={"batch_size_per_im": 256, "fg_fraction": 0.25,
+                            "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
+                            "bg_thresh_lo": 0.0,
+                            "bbox_reg_weights": [0.1, 0.1, 0.2, 0.2],
+                            "class_nums": 81, "use_random": True,
+                            "is_cls_agnostic": False, "is_cascade_rcnn": False,
+                            "seed": 0})
+def _generate_proposal_labels(ins, attrs):
+    """Fast R-CNN stage-2 sampling (reference generate_proposal_labels_op):
+    match proposals to gt, sample fg/bg per image, emit rois + per-class
+    regression targets."""
+    rois = np.asarray(first(ins, "RpnRois"))
+    gcls = np.asarray(first(ins, "GtClasses")).reshape(-1)
+    gbox = np.asarray(first(ins, "GtBoxes"))
+    roffs = _lod_offs(attrs, "RpnRois", len(rois))
+    goffs = _lod_offs(attrs, "GtBoxes", len(gbox))
+    B = int(attrs.get("batch_size_per_im", 256))
+    fgf = attrs.get("fg_fraction", 0.25)
+    fgt = attrs.get("fg_thresh", 0.5)
+    bgh = attrs.get("bg_thresh_hi", 0.5)
+    bgl = attrs.get("bg_thresh_lo", 0.0)
+    C = int(attrs.get("class_nums", 81))
+    wts = attrs.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+    rng = _rng_of(attrs)
+    use_rand = attrs.get("use_random", True)
+    crowd_in = first(ins, "IsCrowd")
+    crowd = (np.asarray(crowd_in).reshape(-1).astype(bool)
+             if crowd_in is not None else np.zeros(len(gbox), bool))
+    o_rois, o_lab, o_tgt, o_inw, lens = [], [], [], [], []
+    for i in range(len(roffs) - 1):
+        r = rois[roffs[i]:roffs[i + 1]]
+        keep_gt = ~crowd[goffs[i]:goffs[i + 1]]
+        g = gbox[goffs[i]:goffs[i + 1]][keep_gt]
+        gl = gcls[goffs[i]:goffs[i + 1]][keep_gt]
+        # gt boxes join the proposal pool (reference behavior)
+        r = np.concatenate([r, g], axis=0) if len(g) else r
+        iou = _iou_matrix(r, g, norm=True)
+        best = iou.argmax(axis=1) if iou.size else np.zeros(len(r), np.int64)
+        biou = iou.max(axis=1) if iou.size else np.zeros(len(r))
+        fg = np.where(biou >= fgt)[0]
+        bg = np.where((biou < bgh) & (biou >= bgl))[0]
+        nfg = min(int(B * fgf), len(fg))
+        nbg = min(B - nfg, len(bg))
+        if use_rand:
+            fg = rng.permutation(fg)[:nfg]
+            bg = rng.permutation(bg)[:nbg]
+        else:
+            fg, bg = fg[:nfg], bg[:nbg]
+        sel = np.concatenate([fg, bg]).astype(np.int64)
+        labs = np.concatenate([gl[best[fg]].astype(np.int64),
+                               np.zeros(len(bg), np.int64)])
+        tgts = np.zeros((len(sel), 4 * C), np.float32)
+        inw = np.zeros((len(sel), 4 * C), np.float32)
+        if len(fg):
+            enc = _box_encode(g[best[fg]], r[fg],
+                              [1.0 / w for w in wts])
+            for k, (lab, e) in enumerate(zip(labs[:len(fg)], enc)):
+                c = 1 if attrs.get("is_cls_agnostic", False) else int(lab)
+                tgts[k, 4 * c:4 * c + 4] = e
+                inw[k, 4 * c:4 * c + 4] = 1.0
+        o_rois.append(r[sel])
+        o_lab.append(labs)
+        o_tgt.append(tgts)
+        o_inw.append(inw)
+        lens.append(len(sel))
+    rois_o = np.concatenate(o_rois) if o_rois else np.zeros((0, 4), np.float32)
+    lab_o = np.concatenate(o_lab) if o_lab else np.zeros(0, np.int64)
+    tgt_o = np.concatenate(o_tgt) if o_tgt else np.zeros((0, 4 * C), np.float32)
+    inw_o = np.concatenate(o_inw) if o_inw else np.zeros((0, 4 * C), np.float32)
+    lod0 = (tuple(int(v) for v in np.concatenate([[0], np.cumsum(lens)])),)
+    return {"Rois": [jnp.asarray(rois_o.astype(np.float32))],
+            "LabelsInt32": [jnp.asarray(lab_o.astype(np.int32)[:, None])],
+            "BboxTargets": [jnp.asarray(tgt_o)],
+            "BboxInsideWeights": [jnp.asarray(inw_o)],
+            "BboxOutsideWeights": [jnp.asarray((inw_o > 0)
+                                               .astype(np.float32))],
+            "_lod": {"Rois": [lod0], "LabelsInt32": [lod0],
+                     "BboxTargets": [lod0], "BboxInsideWeights": [lod0],
+                     "BboxOutsideWeights": [lod0]}}
+
+
+def _rasterize_polygon(poly, h, w):
+    """Even-odd fill of one polygon [[x0,y0,x1,y1,...]] onto an h x w grid."""
+    ys, xs = np.mgrid[0:h, 0:w]
+    px = np.asarray(poly[0::2])
+    py = np.asarray(poly[1::2])
+    n = len(px)
+    inside = np.zeros((h, w), bool)
+    j = n - 1
+    for i in range(n):
+        cond = ((py[i] > ys + 0.5) != (py[j] > ys + 0.5))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            xcross = (px[j] - px[i]) * (ys + 0.5 - py[i]) \
+                / (py[j] - py[i] + 1e-12) + px[i]
+        inside ^= cond & (xs + 0.5 < xcross)
+        j = i
+    return inside
+
+
+@register_op("generate_mask_labels", stateful=True, no_grad=True,
+             needs_lod=True,
+             inputs=("ImInfo", "GtClasses", "IsCrowd", "GtSegms", "Rois",
+                     "LabelsInt32"),
+             attr_defaults={"num_classes": 81, "resolution": 14})
+def _generate_mask_labels(ins, attrs):
+    """Mask R-CNN mask targets (reference generate_mask_labels_op.cc):
+    rasterize each fg roi's matched gt polygon into a resolution^2 grid.
+    Matching is by gt index order per image (gt polygons in GtSegms LoD)."""
+    rois = np.asarray(first(ins, "Rois"))
+    labels = np.asarray(first(ins, "LabelsInt32")).reshape(-1)
+    segs = np.asarray(first(ins, "GtSegms"))      # [S, 2] flattened xy pairs
+    roffs = _lod_offs(attrs, "Rois", len(rois))
+    lods = (attrs.get("_lod") or {}).get("GtSegms")
+    res = int(attrs.get("resolution", 14))
+    C = int(attrs.get("num_classes", 81))
+    # polygons per gt: 2-level LoD (gt -> polys -> points)
+    if lods and lods[0] and len(lods[0]) >= 2:
+        gt_offs = np.asarray(lods[0][0], np.int64)    # gt -> poly index
+        pt_offs = np.asarray(lods[0][-1], np.int64)   # poly -> point index
+    else:
+        gt_offs = np.asarray([0, 1], np.int64)
+        pt_offs = np.asarray([0, len(segs)], np.int64)
+    # gt polygons are distributed per image by GtClasses' LoD
+    gcls_offs = _lod_offs(attrs, "GtClasses", len(gt_offs) - 1)
+    n_gt = len(gt_offs) - 1
+    # each gt's FIRST polygon + its bbox (for roi->gt matching)
+    gt_polys, gt_boxes = [], np.zeros((n_gt, 4), np.float64)
+    for g_ in range(n_gt):
+        p0 = pt_offs[gt_offs[g_]]
+        p1 = pt_offs[min(gt_offs[g_] + 1, len(pt_offs) - 1)]
+        poly_ = segs[p0:p1].reshape(-1)
+        gt_polys.append(poly_)
+        xs_, ys_ = poly_[0::2], poly_[1::2]
+        if len(xs_):
+            gt_boxes[g_] = [xs_.min(), ys_.min(), xs_.max(), ys_.max()]
+    mask_rois, mask_lens, roi_has, masks = [], [], [], []
+    for i in range(len(roffs) - 1):
+        rs = rois[roffs[i]:roffs[i + 1]]
+        ls = labels[roffs[i]:roffs[i + 1]]
+        g_lo = int(gcls_offs[min(i, len(gcls_offs) - 2)])
+        g_hi = int(gcls_offs[min(i + 1, len(gcls_offs) - 1)])
+        n_this = 0
+        for k, (r, lab) in enumerate(zip(rs, ls)):
+            if lab <= 0 or g_hi <= g_lo:
+                continue
+            # match this roi to the image's gt with the highest bbox IoU
+            ious = _iou_matrix(r[None, :4].astype(np.float64),
+                               gt_boxes[g_lo:g_hi], norm=True)[0]
+            gi = g_lo + int(np.argmax(ious))
+            poly = gt_polys[gi]
+            x1, y1, x2, y2 = r[:4]
+            w = max(x2 - x1, 1e-3)
+            h = max(y2 - y1, 1e-3)
+            # polygon into roi-local resolution grid
+            local = poly.copy().astype(np.float64)
+            local[0::2] = (local[0::2] - x1) / w * res
+            local[1::2] = (local[1::2] - y1) / h * res
+            m = _rasterize_polygon(local, res, res)
+            cls_mask = np.full((C, res, res), 0, np.int32)
+            cls_mask[int(lab)] = m.astype(np.int32)
+            masks.append(cls_mask.reshape(-1))
+            mask_rois.append(r[:4])
+            roi_has.append(k + int(roffs[i]))
+            n_this += 1
+        mask_lens.append(n_this)
+    mr = (np.asarray(mask_rois, np.float32) if mask_rois
+          else np.zeros((0, 4), np.float32))
+    mi = (np.asarray(masks, np.int32) if masks
+          else np.zeros((0, C * res * res), np.int32))
+    ridx = (np.asarray(roi_has, np.int32)[:, None] if roi_has
+            else np.zeros((0, 1), np.int32))
+    lod0 = (tuple(int(v)
+                  for v in np.concatenate([[0], np.cumsum(mask_lens)])),)
+    return {"MaskRois": [jnp.asarray(mr)],
+            "RoiHasMaskInt32": [jnp.asarray(ridx)],
+            "MaskInt32": [jnp.asarray(mi)],
+            "_lod": {"MaskRois": [lod0], "RoiHasMaskInt32": [lod0],
+                     "MaskInt32": [lod0]}}
+
+
+@register_op("roi_perspective_transform", stateful=True,
+             needs_lod=True, inputs=("X", "ROIs"),
+             attr_defaults={"transformed_height": 8, "transformed_width": 8,
+                            "spatial_scale": 1.0})
+def _roi_perspective_transform(ins, attrs):
+    """Warp quadrilateral rois to a fixed rectangle by the homography
+    mapping the output grid onto the quad, bilinear-sampling the input
+    (reference roi_perspective_transform_op.cc). ROIs rows are 8 coords
+    (x1 y1 ... x4 y4, clockwise from top-left)."""
+    x = np.asarray(first(ins, "X"))        # [N, C, H, W]
+    rois = np.asarray(first(ins, "ROIs"))  # [R, 8]
+    offs = _lod_offs(attrs, "ROIs", len(rois))
+    bids = np.repeat(np.arange(len(offs) - 1), offs[1:] - offs[:-1])
+    th = int(attrs.get("transformed_height", 8))
+    tw = int(attrs.get("transformed_width", 8))
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, H, W = x.shape
+    outs, mats, masks = [], [], []
+    for r in range(len(rois)):
+        quad = rois[r].reshape(4, 2) * scale
+        # homography from unit rect corners to quad (DLT, 4 points)
+        src = np.asarray([[0, 0], [tw - 1, 0], [tw - 1, th - 1],
+                          [0, th - 1]], np.float64)
+        A = []
+        for (sx, sy), (dx_, dy_) in zip(src, quad):
+            A.append([sx, sy, 1, 0, 0, 0, -dx_ * sx, -dx_ * sy, -dx_])
+            A.append([0, 0, 0, sx, sy, 1, -dy_ * sx, -dy_ * sy, -dy_])
+        _, _, vt = np.linalg.svd(np.asarray(A))
+        Hm = vt[-1].reshape(3, 3)
+        gy, gx = np.mgrid[0:th, 0:tw]
+        ones = np.ones_like(gx)
+        pts = Hm @ np.stack([gx.ravel(), gy.ravel(),
+                             ones.ravel()]).astype(np.float64)
+        px = pts[0] / (pts[2] + 1e-12)
+        py = pts[1] / (pts[2] + 1e-12)
+        x0 = np.floor(px).astype(int)
+        y0 = np.floor(py).astype(int)
+        wx = px - x0
+        wy = py - y0
+        img = x[bids[r]]
+
+        def g(yi, xi):
+            valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            v = img[:, np.clip(yi, 0, H - 1), np.clip(xi, 0, W - 1)]
+            return v * valid
+        v = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x0 + 1) * (1 - wy) * wx
+             + g(y0 + 1, x0) * wy * (1 - wx) + g(y0 + 1, x0 + 1) * wy * wx)
+        outs.append(v.reshape(c, th, tw))
+        mats.append(Hm.reshape(9) / (Hm[2, 2] if Hm[2, 2] != 0 else 1.0))
+        in_img = ((px >= 0) & (px <= W - 1) & (py >= 0) & (py <= H - 1))
+        masks.append(in_img.reshape(1, th, tw))
+    o = (np.stack(outs) if outs
+         else np.zeros((0, c, th, tw), np.float32))
+    mat = (np.stack(mats) if mats else np.zeros((0, 9), np.float32))
+    msk = (np.stack(masks) if masks
+           else np.zeros((0, 1, th, tw), bool))
+    return {"Out": [jnp.asarray(o.astype(np.float32))],
+            "Out2InIdx": [jnp.zeros((len(rois), 1), jnp.int32)],
+            "Out2InWeights": [jnp.ones((len(rois), 1), jnp.float32)],
+            "Mask": [jnp.asarray(msk.astype(np.int32))],
+            "TransformMatrix": [jnp.asarray(mat.astype(np.float32))]}

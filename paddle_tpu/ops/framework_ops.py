@@ -156,6 +156,26 @@ def _tensor_array_to_tensor(ins, attrs):
 def _array_to_lod_tensor(ins, attrs):
     ctx = attrs["_ctx"]
     arr = ctx.scope.find_var(ctx.op.input("X")[0]).get_lod_tensor_array()
+    rt_in = ctx.op.input("RankTable") if "RankTable" in ctx.op.input_names \
+        else []
+    if rt_in:
+        # invert lod_tensor_to_array: arr[t] row r is step t of the rank-r
+        # sequence; reassemble sequences and restore ORIGINAL order + LoD
+        table = ctx.scope.find_var(rt_in[0]).get_lod_rank_table()
+        width = arr[0].array.shape[1:] if arr else ()
+        dt = arr[0].array.dtype if arr else jnp.float32
+        empty = jnp.zeros((0,) + tuple(width), dt)
+        per_seq = {}
+        for r, (i, l) in enumerate(table.items):
+            steps = [arr[t].array[r] for t in range(l)]
+            per_seq[i] = jnp.stack(steps) if steps else empty
+        order = sorted(per_seq)
+        o = jnp.concatenate([per_seq[i] for i in order], axis=0)
+        lens = [int(per_seq[i].shape[0]) for i in order]
+        offs = tuple(int(v) for v in np.concatenate([[0], np.cumsum(lens)]))
+        ctx.scope.var(ctx.op.output("Out")[0]).set_value(
+            core.LoDTensor(o, (offs,)))
+        return {}
     o = jnp.concatenate([t.array for t in arr], axis=0)
     ctx.scope.var(ctx.op.output("Out")[0]).set_value(core.LoDTensor(o))
     return {}

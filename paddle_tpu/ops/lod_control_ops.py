@@ -1,0 +1,228 @@
+"""DynamicRNN-era LoD control ops (reference:
+operators/lod_rank_table_op.cc, max_sequence_len_op.cc,
+lod_tensor_to_array_op.cc, array_to_lod_tensor_op.cc (RankTable path),
+shrink_rnn_memory_op.cc, reorder_lod_tensor_by_rank_op.cc,
+controlflow/split_lod_tensor_op.cc, merge_lod_tensor_op.cc,
+recurrent_op.cc, conditional_block_infer).
+
+These drive the reference's dynamic (variable-length) RNN machinery: the
+rank table sorts sequences by length so each time step processes the
+still-alive prefix. All are scope-level host ops (``stateful``); the math
+inside the per-step sub-blocks still runs as JAX ops."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .registry import register_op, first, seq, out
+from ..fluid import core
+
+
+def _lod_of_var(var):
+    t = var.get_tensor()
+    return t.lod()
+
+
+@register_op("lod_rank_table", stateful=True, no_grad=True,
+             attr_defaults={"level": 0})
+def _lod_rank_table(ins, attrs):
+    ctx = attrs["_ctx"]
+    xvar = ctx.scope.find_var(ctx.op.input("X")[0])
+    lod = _lod_of_var(xvar)
+    level = int(attrs.get("level", 0))
+    if lod and len(lod) > level:
+        offs = lod[level]
+        lens = [(i, int(offs[i + 1] - offs[i]))
+                for i in range(len(offs) - 1)]
+    else:  # no LoD: every row is a length-1 sequence
+        n = xvar.get_tensor().array.shape[0]
+        lens = [(i, 1) for i in range(n)]
+    # stable sort by length descending (reference lod_rank_table.cc)
+    lens.sort(key=lambda t: -t[1])
+    table = core.LoDRankTable(lens)
+    table.level = level
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(table)
+    return {}
+
+
+@register_op("max_sequence_len", stateful=True, no_grad=True)
+def _max_sequence_len(ins, attrs):
+    ctx = attrs["_ctx"]
+    table = ctx.scope.find_var(
+        ctx.op.input("RankTable")[0]).get_lod_rank_table()
+    m = table.items[0][1] if table.items else 0
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(
+        core.LoDTensor(jnp.asarray([m], jnp.int64)))
+    return {}
+
+
+@register_op("lod_tensor_to_array", stateful=True, no_grad=True)
+def _lod_tensor_to_array(ins, attrs):
+    """Split X into per-timestep batches ordered by the rank table: array[t]
+    holds row t of every sequence still alive at step t, in rank order."""
+    ctx = attrs["_ctx"]
+    xvar = ctx.scope.find_var(ctx.op.input("X")[0])
+    x = xvar.get_tensor().array
+    lod = _lod_of_var(xvar)
+    table = ctx.scope.find_var(
+        ctx.op.input("RankTable")[0]).get_lod_rank_table()
+    level = getattr(table, "level", 0)
+    if lod and level != len(lod) - 1:
+        raise NotImplementedError(
+            "lod_tensor_to_array: splitting at a non-innermost LoD level "
+            f"(level={level} of {len(lod)}) — each step would itself be a "
+            "ragged sub-sequence; flatten the inner level first")
+    offs = (np.asarray(lod[level], np.int64) if lod
+            else np.arange(x.shape[0] + 1, dtype=np.int64))
+    arr = ctx.scope.var(ctx.op.output("Out")[0]).get_lod_tensor_array()
+    arr.clear()
+    max_len = table.items[0][1] if table.items else 0
+    for t in range(max_len):
+        rows = [int(offs[i] + t) for i, l in table.items if t < l]
+        arr.append(core.LoDTensor(x[jnp.asarray(rows, jnp.int32)]))
+    return {}
+
+
+@register_op("shrink_rnn_memory", stateful=True,
+             attr_defaults={})
+def _shrink_rnn_memory(ins, attrs):
+    """At step I, keep only the first K rows of X where K = number of
+    sequences whose length > I per the rank table (rows are rank-ordered,
+    so survivors are a prefix — reference shrink_rnn_memory_op.cc)."""
+    ctx = attrs["_ctx"]
+    x = ctx.scope.find_var(ctx.op.input("X")[0]).get_tensor().array
+    i = int(np.asarray(ctx.scope.find_var(
+        ctx.op.input("I")[0]).get_tensor().array).reshape(-1)[0])
+    table = ctx.scope.find_var(
+        ctx.op.input("RankTable")[0]).get_lod_rank_table()
+    k = sum(1 for _, l in table.items if l > i)
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(
+        core.LoDTensor(x[:k]))
+    return {}
+
+
+@register_op("reorder_lod_tensor_by_rank", stateful=True)
+def _reorder_lod_tensor_by_rank(ins, attrs):
+    """Reorder X's sequences into rank-table order (reference
+    reorder_lod_tensor_by_rank_op.cc)."""
+    ctx = attrs["_ctx"]
+    xvar = ctx.scope.find_var(ctx.op.input("X")[0])
+    x = xvar.get_tensor().array
+    lod = _lod_of_var(xvar)
+    table = ctx.scope.find_var(
+        ctx.op.input("RankTable")[0]).get_lod_rank_table()
+    if lod:
+        offs = np.asarray(lod[0], np.int64)
+        rows, new_lens = [], []
+        for i, l in table.items:
+            rows.extend(range(int(offs[i]), int(offs[i + 1])))
+            new_lens.append(int(offs[i + 1] - offs[i]))
+        o = x[jnp.asarray(rows, jnp.int32)]
+        new_offs = tuple(int(v)
+                         for v in np.concatenate([[0], np.cumsum(new_lens)]))
+        t = core.LoDTensor(o, (new_offs,))
+    else:
+        rows = [i for i, _ in table.items]
+        t = core.LoDTensor(x[jnp.asarray(rows, jnp.int32)])
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(t)
+    return {}
+
+
+@register_op("split_lod_tensor", stateful=True, no_grad=True,
+             attr_defaults={"level": 0})
+def _split_lod_tensor(ins, attrs):
+    """Rows where Mask is false go to OutFalse, true to OutTrue
+    (reference controlflow/split_lod_tensor_op.cc; used by IfElse)."""
+    ctx = attrs["_ctx"]
+    x = ctx.scope.find_var(ctx.op.input("X")[0]).get_tensor().array
+    mask = np.asarray(ctx.scope.find_var(
+        ctx.op.input("Mask")[0]).get_tensor().array).reshape(-1).astype(bool)
+    t_rows = np.where(mask)[0]
+    f_rows = np.where(~mask)[0]
+    ctx.scope.var(ctx.op.output("OutTrue")[0]).set_value(
+        core.LoDTensor(x[jnp.asarray(t_rows, jnp.int32)]))
+    ctx.scope.var(ctx.op.output("OutFalse")[0]).set_value(
+        core.LoDTensor(x[jnp.asarray(f_rows, jnp.int32)]))
+    return {}
+
+
+def _merge_lod_tensor_impl(ins, attrs):
+    ctx = attrs["_ctx"]
+    mask = np.asarray(ctx.scope.find_var(
+        ctx.op.input("Mask")[0]).get_tensor().array).reshape(-1).astype(bool)
+    in_true = ctx.scope.find_var(ctx.op.input("InTrue")[0]).get_tensor().array
+    in_false = ctx.scope.find_var(
+        ctx.op.input("InFalse")[0]).get_tensor().array
+    width = in_true.shape[1:] if in_true.size else in_false.shape[1:]
+    o = np.zeros((len(mask),) + tuple(width),
+                 np.asarray(in_true if in_true.size else in_false).dtype)
+    o[mask] = np.asarray(in_true)
+    o[~mask] = np.asarray(in_false)
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(
+        core.LoDTensor(jnp.asarray(o)))
+    return {}
+
+
+@register_op("merge_lod_tensor", stateful=True, no_grad=True,
+             attr_defaults={"level": 0})
+def _merge_lod_tensor(ins, attrs):
+    return _merge_lod_tensor_impl(ins, attrs)
+
+
+@register_op("merge_lod_tensor_infer", stateful=True, no_grad=True,
+             attr_defaults={"level": 0})
+def _merge_lod_tensor_infer(ins, attrs):
+    return _merge_lod_tensor_impl(ins, attrs)
+
+
+@register_op("conditional_block_infer", stateful=True, no_grad=True,
+             attr_defaults={"is_scalar_condition": False})
+def _conditional_block_infer(ins, attrs):
+    from .framework_ops import _conditional_block
+    return _conditional_block(ins, attrs)
+
+
+@register_op("recurrent", stateful=True, no_grad=True,
+             attr_defaults={"has_states": True, "ex_states": [],
+                            "states": [], "reverse": False,
+                            "is_train": True})
+def _recurrent(ins, attrs):
+    """StaticRNN step-block runner (reference recurrent_op.cc): each time
+    step runs the sub-block in a fresh step scope where every sequence
+    input var (same name, time-major [T, ...]) holds its row t, each
+    ex-state var holds the previous step's state (seeded from
+    initial_states, matched by position), and per-step outputs are stacked
+    into [T, ...] results in the outer scope."""
+    ctx = attrs["_ctx"]
+    block = attrs["sub_block"]
+    xs = ctx.op.input("inputs")
+    init_states = ctx.op.input("initial_states")
+    outs = ctx.op.output("outputs")
+    ex_states = list(attrs.get("ex_states", []))
+    states = list(attrs.get("states", []))
+    T = ctx.scope.find_var(xs[0]).get_tensor().array.shape[0]
+    rev = attrs.get("reverse", False)
+    prev = {ex: ctx.scope.find_var(init).get_tensor().array
+            for ex, init in zip(ex_states, init_states)}
+    collected = {o: [] for o in outs}
+    seqs = {name: ctx.scope.find_var(name).get_tensor().array
+            for name in xs}
+    for t in (range(T - 1, -1, -1) if rev else range(T)):
+        step_scope = ctx.scope.new_scope()
+        for name, x in seqs.items():
+            step_scope.var(name).set_value(core.LoDTensor(x[t]))
+        for ex in ex_states:
+            step_scope.var(ex).set_value(core.LoDTensor(prev[ex]))
+        ctx.executor._run_block_eager(block, step_scope, ctx.rng_base)
+        for ex, st in zip(ex_states, states):
+            prev[ex] = step_scope.find_var(st).get_tensor().array
+        for o in collected:
+            v = step_scope.find_var(o)
+            if v is not None and v.is_initialized():
+                collected[o].append(v.get_tensor().array)
+    for o, vals in collected.items():
+        if vals:
+            if rev:
+                vals = vals[::-1]
+            ctx.scope.var(o).set_value(core.LoDTensor(jnp.stack(vals)))
+    return {}

@@ -1,0 +1,193 @@
+"""Detection-training op tests (reference:
+tests/unittests/test_rpn_target_assign_op.py,
+test_retinanet_detection_output.py, test_locality_aware_nms_op.py,
+test_box_decoder_and_assign_op.py, test_generate_proposal_labels_op.py,
+test_generate_mask_labels_op.py, test_mine_hard_examples_op.py,
+test_roi_perspective_transform_op.py)."""
+import numpy as np
+import pytest
+
+from tests.test_sequence_ops import run_seq_op
+
+
+def _grid_anchors():
+    # 4 anchors tiling a 20x20 image
+    return np.array([[0, 0, 9, 9], [10, 0, 19, 9],
+                     [0, 10, 9, 19], [10, 10, 19, 19]], np.float32)
+
+
+def test_rpn_target_assign():
+    anchors = _grid_anchors()
+    gt = np.array([[0, 0, 9, 9]], np.float32)       # matches anchor 0
+    im_info = np.array([[20, 20, 1]], np.float32)
+    crowd = np.zeros((1, 1), np.float32)
+    (loc, score, lab, tbox, biw), _ = run_seq_op(
+        "rpn_target_assign", anchors, None, x_slot="Anchor",
+        extra_inputs=[("GtBoxes", gt, [[1]]), ("IsCrowd", crowd, [[1]]),
+                      ("ImInfo", im_info, None)],
+        attrs={"rpn_batch_size_per_im": 4, "use_random": False},
+        outputs=("LocationIndex", "ScoreIndex", "TargetLabel",
+                 "TargetBBox", "BBoxInsideWeight"))
+    assert 0 in loc                      # anchor 0 is fg
+    assert lab.ravel()[list(score.ravel()).index(0)] == 1
+    # perfectly matched anchor -> zero regression target
+    np.testing.assert_allclose(tbox[0], 0.0, atol=1e-6)
+
+
+def test_retinanet_target_assign():
+    anchors = _grid_anchors()
+    gt = np.array([[10, 10, 19, 19]], np.float32)   # matches anchor 3
+    labs = np.array([[5]], np.int32)
+    im_info = np.array([[20, 20, 1]], np.float32)
+    crowd = np.zeros((1, 1), np.float32)
+    (loc, lab, fg), _ = run_seq_op(
+        "retinanet_target_assign", anchors, None, x_slot="Anchor",
+        extra_inputs=[("GtBoxes", gt, [[1]]), ("GtLabels", labs, [[1]]),
+                      ("IsCrowd", crowd, [[1]]), ("ImInfo", im_info, None)],
+        outputs=("LocationIndex", "TargetLabel", "ForegroundNumber"))
+    assert 3 in loc
+    assert 5 in lab.ravel()            # class label preserved
+    assert fg.ravel()[0] == 1
+
+
+def test_retinanet_detection_output():
+    anchors = np.array([[0, 0, 9, 9], [10, 10, 19, 19]], np.float32)
+    deltas = np.zeros((2, 4), np.float32)            # decode = anchors
+    scores = np.array([[0.9, 0.01], [0.01, 0.8]], np.float32)
+    im_info = np.array([[20, 20, 1]], np.float32)
+    (o,), _ = run_seq_op(
+        "retinanet_detection_output", deltas, None, x_slot="BBoxes",
+        extra_inputs=[("Scores", scores, None), ("Anchors", anchors, None),
+                      ("ImInfo", im_info, None)],
+        attrs={"score_threshold": 0.05})
+    assert o.shape[1] == 6 and len(o) == 2
+    classes = sorted(o[:, 0])
+    assert classes == [0.0, 1.0]
+
+
+def test_locality_aware_nms_merges():
+    # two nearly identical boxes -> merged into one, score-weighted
+    boxes = np.array([[0, 0, 10, 10], [0.5, 0, 10.5, 10],
+                      [50, 50, 60, 60]], np.float32)
+    scores = np.array([[0.8, 0.6, 0.9]], np.float32)
+    (o,), _ = run_seq_op("locality_aware_nms", boxes, None, x_slot="BBoxes",
+                         extra_inputs=[("Scores", scores, None)],
+                         attrs={"nms_threshold": 0.5,
+                                "score_threshold": 0.1,
+                                "keep_top_k": -1, "nms_top_k": -1,
+                                "normalized": False})
+    assert len(o) == 2                 # merged pair + far box
+    merged = o[o[:, 1] > 1.0]          # merged score = 0.8+0.6
+    np.testing.assert_allclose(merged[0, 1], 1.4, rtol=1e-5)
+    # merged x1 = (0*0.8 + 0.5*0.6)/1.4
+    np.testing.assert_allclose(merged[0, 2], 0.3 / 1.4, rtol=1e-4)
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 9, 9]], np.float32)
+    pvar = np.array([1, 1, 1, 1], np.float32)
+    deltas = np.zeros((1, 8), np.float32)           # 2 classes
+    score = np.array([[0.2, 0.8]], np.float32)
+    (dec, assigned), _ = run_seq_op(
+        "box_decoder_and_assign", prior, None, x_slot="PriorBox",
+        extra_inputs=[("PriorBoxVar", pvar, None),
+                      ("TargetBox", deltas, None),
+                      ("BoxScore", score, None)],
+        outputs=("DecodeBox", "OutputAssignBox"))
+    assert dec.shape == (1, 8)
+    # zero deltas decode back to the prior box
+    np.testing.assert_allclose(assigned[0], prior[0], atol=1e-4)
+
+
+def test_mine_hard_examples():
+    cls_loss = np.array([[0.1, 0.9, 0.5, 0.3]], np.float32)
+    match = np.array([[0, -1, -1, -1]], np.int32)   # prior 0 positive
+    (neg, upd), _ = run_seq_op(
+        "mine_hard_examples", cls_loss, None, x_slot="ClsLoss",
+        extra_inputs=[("MatchIndices", match, None)],
+        attrs={"neg_pos_ratio": 2.0},
+        outputs=("NegIndices", "UpdatedMatchIndices"))
+    # 1 positive -> 2 hardest negatives: priors 1 (0.9) and 2 (0.5)
+    assert sorted(neg.ravel().tolist()) == [1, 2]
+    np.testing.assert_array_equal(upd, match)
+
+
+def test_generate_proposal_labels():
+    rois = np.array([[0, 0, 9, 9], [50, 50, 60, 60]], np.float32)
+    gt = np.array([[0, 0, 9, 9]], np.float32)
+    gcls = np.array([[3]], np.int32)
+    crowd = np.zeros((1, 1), np.float32)
+    im_info = np.array([[100, 100, 1]], np.float32)
+    (r, lab, tgt, inw, outw), _ = run_seq_op(
+        "generate_proposal_labels", rois, [[2]], x_slot="RpnRois",
+        extra_inputs=[("GtClasses", gcls, [[1]]), ("IsCrowd", crowd, [[1]]),
+                      ("GtBoxes", gt, [[1]]), ("ImInfo", im_info, None)],
+        attrs={"batch_size_per_im": 4, "fg_thresh": 0.5, "class_nums": 5,
+               "use_random": False},
+        outputs=("Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+                 "BboxOutsideWeights"))
+    labs = lab.ravel()
+    assert 3 in labs                  # fg labeled with its gt class
+    assert 0 in labs                  # bg present
+    fg_row = list(labs).index(3)
+    # fg row regression target stored in class-3 slot
+    assert inw[fg_row, 12:16].sum() == 4
+    np.testing.assert_allclose(tgt[fg_row, 12:16], 0.0, atol=1e-5)
+
+
+def test_generate_mask_labels():
+    rois = np.array([[0, 0, 10, 10]], np.float32)
+    labels = np.array([[1]], np.int32)
+    # square polygon covering left half of the roi
+    segms = np.array([[0, 0], [5, 0], [5, 10], [0, 10]], np.float32)
+    im_info = np.array([[20, 20, 1]], np.float32)
+    gcls = np.array([[1]], np.int32)
+    crowd = np.zeros((1, 1), np.float32)
+    (mrois, has, mask), _ = run_seq_op(
+        "generate_mask_labels", im_info, None, x_slot="ImInfo",
+        extra_inputs=[("GtClasses", gcls, [[1]]), ("IsCrowd", crowd, [[1]]),
+                      ("GtSegms", segms, [[[1], [4]]][0]),
+                      ("Rois", rois, [[1]]),
+                      ("LabelsInt32", labels, [[1]])],
+        attrs={"num_classes": 2, "resolution": 8},
+        outputs=("MaskRois", "RoiHasMaskInt32", "MaskInt32"))
+    m = mask.reshape(2, 8, 8)
+    assert m[1, :, :3].mean() > 0.9    # left band inside polygon
+    assert m[1, :, 5:].mean() < 0.1    # right band outside
+
+
+def test_roi_perspective_transform_identity():
+    x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    # axis-aligned quad == crop: corners tl,tr,br,bl of a 4x4 region
+    rois = np.array([[2, 2, 5, 2, 5, 5, 2, 5]], np.float32)
+    (o,), _ = run_seq_op("roi_perspective_transform", x, None,
+                         extra_inputs=[("ROIs", rois, [[1]])],
+                         attrs={"transformed_height": 4,
+                                "transformed_width": 4,
+                                "spatial_scale": 1.0})
+    np.testing.assert_allclose(o[0, 0], x[0, 0, 2:6, 2:6], atol=1e-4)
+
+
+def test_mine_hard_examples_hard_mode_resets_matches():
+    cls_loss = np.array([[0.1, 0.9, 0.5, 0.3]], np.float32)
+    match = np.array([[0, 2, -1, -1]], np.int32)
+    (neg, upd), _ = run_seq_op(
+        "mine_hard_examples", cls_loss, None, x_slot="ClsLoss",
+        extra_inputs=[("MatchIndices", match, None)],
+        attrs={"mining_type": "hard_example", "sample_size": 1},
+        outputs=("NegIndices", "UpdatedMatchIndices"))
+    # positives (0,1) kept; hardest negative is prior 2 (0.5); prior 3 reset
+    assert neg.ravel().tolist() == [2]
+    np.testing.assert_array_equal(upd, [[0, 2, -1, -1]])
+
+
+def test_roi_perspective_outputs_matrix_and_mask():
+    x = np.ones((1, 1, 4, 4), np.float32)
+    rois = np.array([[0, 0, 3, 0, 3, 3, 0, 3]], np.float32)
+    (o, m, mat), _ = run_seq_op(
+        "roi_perspective_transform", x, None,
+        extra_inputs=[("ROIs", rois, [[1]])],
+        attrs={"transformed_height": 4, "transformed_width": 4},
+        outputs=("Out", "Mask", "TransformMatrix"))
+    assert m.shape == (1, 1, 4, 4) and m.all()   # quad inside image
+    assert mat.shape == (1, 9) and abs(mat[0, 8] - 1.0) < 1e-6

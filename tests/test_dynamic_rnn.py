@@ -1,0 +1,158 @@
+"""DynamicRNN / IfElse / LoD control-flow op tests (reference:
+tests/unittests/test_dyn_rnn.py, test_lod_rank_table.py,
+test_lod_tensor_array_ops.py, test_shrink_rnn_memory.py,
+test_reorder_lod_tensor.py, test_split_and_merge_lod_tensor_op.py,
+test_recurrent_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+def test_lod_rank_table_and_friends():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        mlen = fluid.layers.max_sequence_len(table)
+        arr = fluid.layers.lod_tensor_to_array(x, table)
+        back = fluid.layers.array_to_lod_tensor(arr, table)
+        reord = fluid.layers.reorder_lod_tensor_by_rank(x, table)
+    X = np.arange(12, dtype=np.float32).reshape(6, 2)
+    scope = core.Scope()
+    exe = _exe()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        t = core.LoDTensor(X)
+        t.set_recursive_sequence_lengths([[2, 3, 1]])  # lens 2,3,1
+        ml, bk, ro = exe.run(main, feed={"x": t},
+                             fetch_list=[mlen, back, reord])
+    assert ml[0] == 3
+    np.testing.assert_allclose(bk, X)            # round trip restores order
+    # rank order: seq1(len3), seq0(len2), seq2(len1)
+    np.testing.assert_allclose(ro[:3], X[2:5])
+    np.testing.assert_allclose(ro[3:5], X[0:2])
+    np.testing.assert_allclose(ro[5:], X[5:])
+
+
+def test_split_merge_lod_tensor():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32")
+        mask = fluid.layers.data("m", shape=[1], dtype="bool")
+        ie = fluid.layers.IfElse(mask)
+        with ie.true_block():
+            xt = ie.input(x)
+            ie.output(fluid.layers.scale(xt, scale=10.0))
+        with ie.false_block():
+            xf = ie.input(x)
+            ie.output(fluid.layers.scale(xf, scale=-1.0))
+        out = ie()[0]
+    X = np.array([[1, 1], [2, 2], [3, 3]], np.float32)
+    M = np.array([[True], [False], [True]])
+    scope = core.Scope()
+    exe = _exe()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        o, = exe.run(main, feed={"x": X, "m": M}, fetch_list=[out])
+    np.testing.assert_allclose(o, [[10, 10], [-2, -2], [30, 30]])
+
+
+def test_dynamic_rnn_accumulates():
+    """Memory carries a running sum over each sequence: final per-step
+    output equals the prefix-sum of the sequence."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            step = drnn.step_input(x)
+            mem = drnn.memory(shape=[2], value=0.0)
+            acc = fluid.layers.elementwise_add(step, mem)
+            drnn.update_memory(mem, acc)
+            drnn.output(acc)
+        out = drnn()
+        last = fluid.layers.sequence_last_step(out)
+    X = np.array([[1, 1], [2, 2], [10, 10], [20, 20], [30, 30]], np.float32)
+    scope = core.Scope()
+    exe = _exe()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        t = core.LoDTensor(X)
+        t.set_recursive_sequence_lengths([[2, 3]])  # seqs [1,2] and [10,20,30]
+        o, lst = exe.run(main, feed={"x": t}, fetch_list=[out, last])
+    # prefix sums per sequence, in original order
+    np.testing.assert_allclose(o, [[1, 1], [3, 3],
+                                   [10, 10], [30, 30], [60, 60]])
+    np.testing.assert_allclose(lst, [[3, 3], [60, 60]])
+
+
+def test_dynamic_rnn_with_init_memory_and_static_input():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        boot = fluid.layers.data("boot", shape=[2], dtype="float32")
+        stat = fluid.layers.data("stat", shape=[2], dtype="float32")
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            step = drnn.step_input(x)
+            sv = drnn.static_input(stat)
+            mem = drnn.memory(init=boot, need_reorder=True)
+            nxt = fluid.layers.elementwise_add(
+                fluid.layers.elementwise_add(step, mem), sv)
+            drnn.update_memory(mem, nxt)
+            drnn.output(nxt)
+        out = drnn()
+    X = np.array([[1, 1], [2, 2], [3, 3]], np.float32)  # seqs len 1, 2
+    B = np.array([[100, 100], [200, 200]], np.float32)
+    S = np.array([[0.5, 0.5], [0.25, 0.25]], np.float32)
+    scope = core.Scope()
+    exe = _exe()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        t = core.LoDTensor(X)
+        t.set_recursive_sequence_lengths([[1, 2]])
+        st = core.LoDTensor(S)
+        st.set_recursive_sequence_lengths([[1, 1]])
+        o, = exe.run(main, feed={"x": t, "boot": B, "stat": st},
+                     fetch_list=[out])
+    # seq0 (len1, boot 100): 1+100+0.5 = 101.5
+    # seq1 (len2, boot 200): 2+200+0.25=202.25; 3+202.25+0.25=205.5
+    np.testing.assert_allclose(o, [[101.5, 101.5], [202.25, 202.25],
+                                   [205.5, 205.5]])
+
+
+def test_recurrent_op_direct():
+    """recurrent op run directly: running sum over time-major input."""
+    from paddle_tpu.fluid.framework import Operator
+    main = Program()
+    block = main.global_block()
+    sub = main._create_block()
+    main._rollback()
+    scope = core.Scope()
+    T, B, D = 3, 2, 2
+    x = np.arange(T * B * D, dtype=np.float32).reshape(T, B, D)
+    scope.var("x").set_value(core.LoDTensor(x))
+    scope.var("h0").set_value(core.LoDTensor(np.zeros((B, D), np.float32)))
+    # sub-block: h = x_t + h_prev
+    sub.append_op(type="elementwise_add",
+                  inputs={"X": ["x"], "Y": ["h@pre"]},
+                  outputs={"Out": ["h"]}, attrs={"axis": -1})
+    op = Operator(block, type="recurrent",
+                  inputs={"inputs": ["x"], "initial_states": ["h0"],
+                          "parameters": []},
+                  outputs={"outputs": ["h"], "step_scopes": []},
+                  attrs={"sub_block": sub, "ex_states": ["h@pre"],
+                         "states": ["h"], "reverse": False,
+                         "has_states": True})
+    exe = _exe()
+    import jax
+    exe._run_op_eager(op, scope, jax.random.key(0))
+    o = np.asarray(scope.find_var("h").get_tensor().array)
+    np.testing.assert_allclose(o, np.cumsum(x, axis=0))
