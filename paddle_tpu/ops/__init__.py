@@ -25,3 +25,4 @@ from . import fused_ops  # noqa: F401
 from . import metrics_misc_ops  # noqa: F401
 from . import detection_train_ops  # noqa: F401
 from . import lod_control_ops  # noqa: F401
+from . import ps_quant_misc_ops  # noqa: F401

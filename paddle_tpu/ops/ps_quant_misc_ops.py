@@ -1,0 +1,534 @@
+"""Final Appendix-A op batch: INT8 quant runtime ops (reference:
+operators/quantize_op.cc, dequantize_op.cc, requantize_op.cc,
+fake_dequantize_op.cc (dequantize_abs_max, fake_channel_wise_dequantize_
+max_abs), dequantize_log_op.cc, fake_quantize_op.cc
+(moving_average_abs_max_scale), lookup_table_dequant_op.cc), PSLib-style
+sparse pull/push (pull_sparse_op.cc, push_dense_op.cc, pull_box_sparse,
+lookup_sparse_table_op.cc), PS plumbing (split_selected_rows_op.cc,
+split_byref_op.cc, recv_save_op.cc, ref_by_trainer_id_op.cc,
+prefetch_op.cc, fl_listen_and_serv), DGC (dgc_op.cc, dgc_clip_by_norm,
+dgc_momentum), reader ops (create_py_reader, read — reader/
+create_py_reader_op.cc, read_op.cc), cudnn_lstm alias, run_program, and
+engine-offload stubs (tensorrt_engine, lite_engine).
+
+Sparse pull/push run against host-resident tables in the scope (the
+single-process PSLib fallback; multi-host sparse rides the ps_rpc plane
+from the transpiler path). Quant ops are pure JAX."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, first, seq, out
+from ..fluid import core
+
+
+# --------------------------------------------------------------------------
+# INT8 runtime quant family
+# --------------------------------------------------------------------------
+def _round_away(x):
+    # C++ std::round semantics (half away from zero); jnp.round is
+    # half-to-even
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+
+
+@register_op("quantize", inputs=("Input",), no_grad=True,
+             attr_defaults={"Scale": 1.0, "is_negative_input": False})
+def _quantize(ins, attrs):
+    x = first(ins, "Input")
+    s = attrs.get("Scale", 1.0)
+    q = _round_away(x * s)
+    if attrs.get("is_negative_input", False):
+        return out(Output=jnp.clip(q, -128, 127).astype(jnp.int8))
+    return out(Output=jnp.clip(q, 0, 255).astype(jnp.uint8))
+
+
+@register_op("dequantize", inputs=("Input",), no_grad=True,
+             attr_defaults={"Scale": 1.0})
+def _dequantize(ins, attrs):
+    x = first(ins, "Input")
+    return out(Output=x.astype(jnp.float32) / attrs.get("Scale", 1.0))
+
+
+@register_op("requantize", inputs=("Input",), no_grad=True,
+             attr_defaults={"Scale_in": 1.0, "Scale_out": 1.0})
+def _requantize(ins, attrs):
+    x = first(ins, "Input")
+    r = attrs.get("Scale_out", 1.0) / attrs.get("Scale_in", 1.0)
+    return out(Output=jnp.clip(_round_away(x.astype(jnp.float32) * r),
+                               -128, 127).astype(x.dtype))
+
+
+@register_op("dequantize_abs_max", inputs=("X", "Scale"), no_grad=True,
+             attr_defaults={"max_range": 127.0})
+def _dequantize_abs_max(ins, attrs):
+    x, scale = first(ins, "X"), first(ins, "Scale")
+    return out(Out=x.astype(jnp.float32) * scale.reshape(())
+               / attrs.get("max_range", 127.0))
+
+
+@register_op("dequantize_log", inputs=("X", "Dict"), no_grad=True)
+def _dequantize_log(ins, attrs):
+    """4-bit log-quant decode: code's low bits index the dict, high bit is
+    the sign (reference dequantize_log_op.cc)."""
+    x, d = first(ins, "X"), first(ins, "Dict")
+    code = x.astype(jnp.int32)
+    neg = code >= 128
+    idx = jnp.where(neg, code - 128, code)
+    v = d.reshape(-1)[idx]
+    return out(Out=jnp.where(neg, -v, v))
+
+
+@register_op("fake_channel_wise_dequantize_max_abs",
+             inputs=("X", "Scales"), diff_inputs=["X"],
+             attr_defaults={"quant_bits": [8], "quant_axis": 0,
+                            "x_num_col_dims": 1})
+def _fake_channel_wise_dequantize_max_abs(ins, attrs):
+    x = first(ins, "X")
+    scales = seq(ins, "Scales")
+    bits = attrs.get("quant_bits", [8])
+    ax = attrs.get("quant_axis", 0)
+    s0 = scales[0]
+    shape = [1] * x.ndim
+    shape[ax] = -1
+    o = x * s0.reshape(shape) / (2.0 ** (bits[0] - 1) - 1)
+    if len(scales) > 1 and scales[1] is not None:
+        o = o * scales[1].reshape(()) / (2.0 ** (bits[1] - 1) - 1)
+    return out(Out=o)
+
+
+@register_op("moving_average_abs_max_scale", inputs=("X", "InScale",
+                                                     "InAccum", "InState"),
+             no_grad=True, stateful=False,
+             attr_defaults={"moving_rate": 0.9, "is_test": False})
+def _moving_average_abs_max_scale(ins, attrs):
+    x = first(ins, "X")
+    rate = attrs.get("moving_rate", 0.9)
+    cur = jnp.max(jnp.abs(x))
+    in_state = first(ins, "InState")
+    in_accum = first(ins, "InAccum")
+    if attrs.get("is_test", False):
+        scale = first(ins, "InScale")
+        return {"Out": [x], "OutScale": [scale]}
+    state = (in_state.reshape(()) if in_state is not None else 0.0) * rate + 1.0
+    accum = (in_accum.reshape(()) if in_accum is not None else 0.0) * rate + cur
+    scale = accum / state
+    return {"Out": [x], "OutScale": [scale.reshape(1)],
+            "OutState": [state.reshape(1)], "OutAccum": [accum.reshape(1)]}
+
+
+@register_op("lookup_table_dequant", inputs=("W", "Ids"), diff_inputs=(),
+             no_grad=True,
+             attr_defaults={"padding_idx": -1, "is_sparse": False})
+def _lookup_table_dequant(ins, attrs):
+    """Embedding lookup over a row-quantized table: each row stores
+    [min, scale_range] as two float32 then uint8 codes; value =
+    min + code * scale_range / 255 (reference lookup_table_dequant_op.h)."""
+    w, ids = first(ins, "W"), first(ins, "Ids")
+    idv = ids.reshape(-1)
+    rows = w[idv]                         # [N, 2 + ceil(D/4)] float32 view
+    mins = rows[:, 0:1]
+    rng = rows[:, 1:2]
+    codes = rows[:, 2:]
+    # codes packed 4-per-float: reinterpret bytes
+    byte_view = jax.lax.bitcast_convert_type(
+        codes.astype(jnp.float32), jnp.uint8).reshape(codes.shape[0], -1)
+    vals = mins + byte_view.astype(jnp.float32) * rng / 255.0
+    shape = tuple(ids.shape[:-1]) + (vals.shape[1],)
+    return out(Out=vals.reshape(shape))
+
+
+# --------------------------------------------------------------------------
+# PSLib-style sparse/dense pull & push (host-table fallback)
+# --------------------------------------------------------------------------
+def _table_of(ctx, name):
+    var = ctx.scope.find_var(name)
+    if var is None:
+        raise RuntimeError(f"sparse table var '{name}' not found in scope")
+    return var
+
+
+def _pull_sparse_impl(ins, attrs):
+    ctx = attrs["_ctx"]
+    ids_names = ctx.op.input("Ids")
+    w_name = (ctx.op.input("W") or [None])[0]
+    emb_dim = int(attrs.get("EmbeddingDim", attrs.get("size", 8)))
+    outs = ctx.op.output("Out")
+    for idn, on in zip(ids_names, outs):
+        ids = np.asarray(ctx.scope.find_var(idn).get_tensor().array)
+        if w_name:
+            tbl = np.asarray(_table_of(ctx, w_name).value().array)
+            vals = tbl[ids.reshape(-1) % len(tbl)][:, :emb_dim]
+        else:
+            vals = np.zeros((ids.size, emb_dim), np.float32)
+        shape = tuple(ids.shape[:-1]) + (emb_dim,) if ids.ndim > 1 \
+            else (ids.shape[0], emb_dim)
+        ctx.scope.var(on).set_value(
+            core.LoDTensor(jnp.asarray(vals.reshape(shape),
+                                       jnp.float32)))
+    return {}
+
+
+register_op("pull_sparse", stateful=True, no_grad=True,
+            attr_defaults={"EmbeddingDim": 8, "TableId": 0})(
+    _pull_sparse_impl)
+register_op("pull_sparse_v2", stateful=True, no_grad=True,
+            attr_defaults={"EmbeddingDim": 8, "TableId": 0})(
+    _pull_sparse_impl)
+register_op("pull_box_sparse", stateful=True, no_grad=True,
+            attr_defaults={"size": 1})(_pull_sparse_impl)
+
+
+def _push_sparse_impl(ins, attrs):
+    ctx = attrs["_ctx"]
+    ids_names = ctx.op.input("Ids")
+    w_name = (ctx.op.input("W") or [None])[0]
+    grads = ctx.op.input("Grads") or ctx.op.input("Out@GRAD") or []
+    lr = float(attrs.get("lr", 0.01))
+    if not w_name:
+        return {}
+    var = _table_of(ctx, w_name)
+    tbl = np.asarray(var.value().array).copy()
+    for idn, gn in zip(ids_names, grads):
+        gvar = ctx.scope.find_var(gn)
+        if gvar is None:
+            continue
+        ids = np.asarray(ctx.scope.find_var(idn).get_tensor().array)
+        g = np.asarray(gvar.get_tensor().array).reshape(ids.size, -1)
+        np.subtract.at(tbl, ids.reshape(-1) % len(tbl),
+                       lr * np.pad(g, ((0, 0),
+                                       (0, tbl.shape[1] - g.shape[1]))))
+    var.set_value(core.LoDTensor(jnp.asarray(tbl)))
+    return {}
+
+
+register_op("push_sparse", stateful=True, no_grad=True,
+            attr_defaults={"EmbeddingDim": 8, "TableId": 0, "lr": 0.01})(
+    _push_sparse_impl)
+register_op("push_sparse_v2", stateful=True, no_grad=True,
+            attr_defaults={"EmbeddingDim": 8, "TableId": 0, "lr": 0.01})(
+    _push_sparse_impl)
+register_op("push_box_sparse", stateful=True, no_grad=True,
+            attr_defaults={"size": 1, "lr": 0.01})(_push_sparse_impl)
+
+
+@register_op("push_dense", stateful=True, no_grad=True,
+             attr_defaults={"TableId": 0, "ScaleDataNorm": -1.0,
+                            "InputNames": []})
+def _push_dense(ins, attrs):
+    # dense grads ride the collective path on TPU; the PSLib dense push is
+    # a no-op acknowledgement here (single-process fallback)
+    return {}
+
+
+@register_op("lookup_sparse_table", stateful=True, no_grad=True,
+             attr_defaults={"value_names": ["Param"], "padding_idx": -1,
+                            "auto_grown_table": True, "is_test": False})
+def _lookup_sparse_table(ins, attrs):
+    """Lookup into a SelectedRows-backed table, auto-growing missing rows
+    with zeros (reference lookup_sparse_table_op.cc)."""
+    ctx = attrs["_ctx"]
+    ids = np.asarray(ctx.scope.find_var(
+        ctx.op.input("Ids")[0]).get_tensor().array).reshape(-1)
+    wvar = ctx.scope.find_var(ctx.op.input("W")[0])
+    holder = wvar.value()
+    if isinstance(holder, core.SelectedRows):
+        rows = list(holder.rows())
+        val = np.asarray(holder.get_tensor().array)
+        row_of = {r: i for i, r in enumerate(rows)}
+        D = val.shape[1] if val.ndim == 2 else 1
+        outv = np.zeros((len(ids), D), np.float32)
+        grown = False
+        for j, idv in enumerate(ids):
+            if int(idv) in row_of:
+                outv[j] = val[row_of[int(idv)]]
+            elif attrs.get("auto_grown_table", True) and \
+                    not attrs.get("is_test", False):
+                rows.append(int(idv))
+                val = np.concatenate([val, np.zeros((1, D), val.dtype)])
+                row_of[int(idv)] = len(rows) - 1
+                grown = True
+        if grown:
+            holder.set_rows(rows)
+            holder.get_tensor().set(jnp.asarray(val))
+    else:
+        tbl = np.asarray(holder.array)
+        outv = tbl[ids % len(tbl)]
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(
+        core.LoDTensor(jnp.asarray(outv)))
+    return {}
+
+
+@register_op("split_selected_rows", stateful=True, no_grad=True,
+             attr_defaults={"height_sections": []})
+def _split_selected_rows(ins, attrs):
+    """Split a SelectedRows by height sections for per-pserver dispatch
+    (reference split_selected_rows_op.cc)."""
+    ctx = attrs["_ctx"]
+    src = ctx.scope.find_var(ctx.op.input("X")[0]).value()
+    secs = [int(s) for s in attrs.get("height_sections", [])]
+    bounds = np.concatenate([[0], np.cumsum(secs)])
+    rows = np.asarray(src.rows(), np.int64)
+    val = np.asarray(src.get_tensor().array)
+    for k, on in enumerate(ctx.op.output("Out")):
+        sel = (rows >= bounds[k]) & (rows < bounds[k + 1])
+        piece = core.SelectedRows(rows=(rows[sel] - bounds[k]).tolist(),
+                                  height=secs[k])
+        piece.get_tensor().set(jnp.asarray(val[sel]))
+        ctx.scope.var(on).set_value(piece)
+    return {}
+
+
+@register_op("split_byref", stateful=True, no_grad=True,
+             attr_defaults={"sections": [], "num": 0})
+def _split_byref(ins, attrs):
+    """Row-split without copy semantics (reference split_byref_op.cc; under
+    XLA 'by reference' has no meaning, plain slices)."""
+    ctx = attrs["_ctx"]
+    x = ctx.scope.find_var(ctx.op.input("X")[0]).get_tensor().array
+    outs = ctx.op.output("Out")
+    secs = [int(s) for s in attrs.get("sections") or []]
+    if not secs:
+        n = int(attrs.get("num", len(outs))) or len(outs)
+        secs = [x.shape[0] // n] * n
+    off = 0
+    for on, s in zip(outs, secs):
+        ctx.scope.var(on).set_value(core.LoDTensor(x[off:off + s]))
+        off += s
+    return {}
+
+
+@register_op("recv_save", stateful=True, no_grad=True,
+             attr_defaults={"endpoints": [], "file_path": "", "shape": [],
+                            "slice_shapes": [], "slice_varnames": [],
+                            "remote_varnames": [], "is_sparse": False,
+                            "trainer_id": 0})
+def _recv_save(ins, attrs):
+    """Fetch parameter slices from pservers and save the concatenation to
+    disk (reference recv_save_op.cc)."""
+    from ..fluid.ps_rpc import VarClient
+    from ..fluid.io import _serialize_lod_tensor
+    pieces = []
+    for ep, name in zip(attrs.get("endpoints") or [],
+                        attrs.get("remote_varnames") or []):
+        c = VarClient(ep)
+        v = c.get_var(name)
+        pieces.append(np.asarray(v))
+    if pieces:
+        full = np.concatenate([p.reshape(-1) for p in pieces]).reshape(
+            [int(s) for s in attrs.get("shape")])
+        with open(attrs["file_path"], "wb") as f:
+            f.write(_serialize_lod_tensor(core.LoDTensor(
+                jnp.asarray(full)), None))
+    return {}
+
+
+@register_op("ref_by_trainer_id", stateful=True, no_grad=True)
+def _ref_by_trainer_id(ins, attrs):
+    """Select X[trainer_id] (reference ref_by_trainer_id_op.cc)."""
+    ctx = attrs["_ctx"]
+    tid = int(np.asarray(ctx.scope.find_var(
+        ctx.op.input("TrainerId")[0]).get_tensor().array).reshape(-1)[0])
+    src = ctx.scope.find_var(ctx.op.input("X")[tid]).value()
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(src)
+    return {}
+
+
+@register_op("prefetch", stateful=True, no_grad=True,
+             attr_defaults={"epmap": [], "table_names": [],
+                            "trainer_id": 0})
+def _prefetch(ins, attrs):
+    """Prefetch remote embedding rows by id (reference prefetch_op.cc) —
+    same remote path as distributed_lookup_table."""
+    from .distributed_ops import _distributed_lookup_table
+    return _distributed_lookup_table(ins, attrs)
+
+
+# fl_listen_and_serv: federated variant — same server loop
+def _fl_listen_and_serv(ins, attrs):
+    from .distributed_ops import _listen_and_serv
+    return _listen_and_serv(ins, attrs)
+
+
+register_op("fl_listen_and_serv", stateful=True, no_grad=True,
+            attr_defaults={"endpoint": "", "sync_mode": True, "Fanin": 1,
+                           "grad_to_block_id": [], "sparse_lr": 0.01,
+                           "distributed_mode": 0})(_fl_listen_and_serv)
+
+
+# --------------------------------------------------------------------------
+# DGC — deep gradient compression (reference dgc_op.cc): top-k sparsify
+# with momentum correction; U/V are the velocity/error-feedback buffers
+# --------------------------------------------------------------------------
+@register_op("dgc", inputs=("U", "V", "Grad", "Param",
+                            "current_step", "nranks"),
+             no_grad=True,
+             attr_defaults={"m": 0.9, "use_nesterov": False,
+                            "sparsity": [0.999], "rampup_begin_step": 0.0,
+                            "rampup_step": 1.0, "regular_coeff": 0.0,
+                            "regular_type": 0})
+def _dgc(ins, attrs):
+    u, v, g = first(ins, "U"), first(ins, "V"), first(ins, "Grad")
+    step_t = first(ins, "current_step")
+    step = jnp.asarray(step_t.reshape(()) if step_t is not None else 0.0,
+                       jnp.float32)
+    m = attrs.get("m", 0.9)
+    begin = attrs.get("rampup_begin_step", 0.0)
+    sparsity = attrs.get("sparsity", [0.999]) or [0.999]
+    s = float(sparsity[-1])
+    numel = g.size
+    # momentum correction + error feedback (DGC paper / dgc_op.h)
+    u_new = m * u + g
+    v_new = v + u_new
+    flat = v_new.reshape(-1)
+    k = max(1, int(numel * (1.0 - s)))
+    thr = jnp.sort(jnp.abs(flat))[numel - k]
+    mask = (jnp.abs(flat) >= thr)
+    encode = jnp.where(mask, flat, 0.0)
+    residual = jnp.where(mask, 0.0, flat)
+    ramping = step >= begin
+    u_out = jnp.where(ramping, jnp.where(mask, 0.0, u_new.reshape(-1)),
+                      u_new.reshape(-1)).reshape(u.shape)
+    v_out = jnp.where(ramping, residual, jnp.zeros_like(flat)).reshape(
+        v.shape)
+    g_out = jnp.where(ramping, encode, g.reshape(-1)).reshape(g.shape)
+    return {"U_out": [u_out], "V_out": [v_out],
+            "EncodeGrad": [g_out.reshape(-1)], "Grad_out": [g_out],
+            "k": [jnp.asarray([float(k)], jnp.float32)],
+            "GatherBuff": [g_out.reshape(-1)]}
+
+
+@register_op("dgc_clip_by_norm", inputs=("X", "current_step"),
+             diff_inputs=("X",),
+             attr_defaults={"max_norm": 1.0, "rampup_begin_step": 0.0})
+def _dgc_clip_by_norm(ins, attrs):
+    x = first(ins, "X")
+    step = first(ins, "current_step")
+    begin = attrs.get("rampup_begin_step", 0.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    mx = attrs.get("max_norm", 1.0)
+    clipped = x * jnp.minimum(1.0, mx / jnp.maximum(norm, 1e-10))
+    use = (step.reshape(())[()] if step is not None else 0.0)
+    return out(Out=jnp.where(
+        (jnp.asarray(use, jnp.float32) >= begin), clipped, x))
+
+
+@register_op("dgc_momentum",
+             inputs=("Param", "Grad", "Velocity", "LearningRate",
+                     "current_step", "nranks"),
+             no_grad=True, stateful=False,
+             attr_defaults={"mu": 0.9, "use_nesterov": False,
+                            "rampup_begin_step": 0.0})
+def _dgc_momentum(ins, attrs):
+    """Before rampup_begin_step: plain momentum; after: SGD (the momentum
+    correction then lives inside the dgc op — reference
+    dgc_momentum_op.h)."""
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    vel = first(ins, "Velocity")
+    lr = first(ins, "LearningRate").reshape(())
+    step = first(ins, "current_step")
+    mu = attrs.get("mu", 0.9)
+    begin = attrs.get("rampup_begin_step", 0.0)
+    use_mom = (jnp.asarray(step.reshape(()) if step is not None else 0.0,
+                           jnp.float32) < begin)
+    new_vel = mu * vel + g
+    upd_mom = p - lr * (g + mu * new_vel if attrs.get("use_nesterov", False)
+                        else new_vel)
+    upd_sgd = p - lr * g
+    return {"ParamOut": [jnp.where(use_mom, upd_mom, upd_sgd)],
+            "VelocityOut": [jnp.where(use_mom, new_vel, vel)]}
+
+
+# --------------------------------------------------------------------------
+# reader ops
+# --------------------------------------------------------------------------
+@register_op("create_py_reader", stateful=True, no_grad=True,
+             attr_defaults={"shape_concat": [], "lod_levels": [],
+                            "ranks": [], "dtypes": []})
+def _create_py_reader(ins, attrs):
+    """Bind a blocking queue var into a reader var (reference
+    reader/create_py_reader_op.cc)."""
+    ctx = attrs["_ctx"]
+    qvar = ctx.scope.find_var(ctx.op.input("blocking_queue")[0])
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(qvar.value())
+    return {}
+
+
+def _identity_reader(ins, attrs):
+    ctx = attrs["_ctx"]
+    src = ctx.scope.find_var(ctx.op.input("UnderlyingReader")[0]).value()
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(src)
+    return {}
+
+
+register_op("create_double_buffer_reader", stateful=True, no_grad=True,
+            attr_defaults={"place": ""})(_identity_reader)
+register_op("create_custom_reader", stateful=True, no_grad=True,
+            attr_defaults={})(_identity_reader)
+
+
+@register_op("read", stateful=True, no_grad=True,
+             attr_defaults={"throw_eof_exp": True})
+def _read(ins, attrs):
+    """Pop one batch from the reader queue into the output vars
+    (reference reader/read_op.cc); raises StopIteration at end of data."""
+    ctx = attrs["_ctx"]
+    q = ctx.scope.find_var(ctx.op.input("Reader")[0]).value()
+    batch = q.pop()
+    if batch is None:
+        raise StopIteration("read op: reader exhausted")
+    outs = ctx.op.output("Out")
+    for on, arr in zip(outs, batch):
+        if isinstance(arr, core.LoDTensor):
+            ctx.scope.var(on).set_value(arr)
+        else:
+            ctx.scope.var(on).set_value(
+                core.LoDTensor(jnp.asarray(arr)))
+    return {}
+
+
+# --------------------------------------------------------------------------
+# cudnn_lstm alias / run_program / engine stubs
+# --------------------------------------------------------------------------
+def _cudnn_lstm(ins, attrs):
+    """Dense multi-layer (bi)LSTM — same kernel as the `lstm` op; the
+    cudnn-specific weight-buffer layout is shared (rnn_ops._lstm)."""
+    from .rnn_ops import _lstm
+    return _lstm(ins, attrs)
+
+
+register_op("cudnn_lstm", needs_rng=True,
+            diff_inputs=["Input", "W", "InitH", "InitC"],
+            attr_defaults={"max_len": 0, "hidden_size": 0, "num_layers": 1,
+                           "is_bidirec": False, "dropout_prob": 0.0,
+                           "input_size": 0, "is_test": False,
+                           "seed": 0})(_cudnn_lstm)
+
+
+@register_op("run_program", stateful=True, no_grad=True,
+             attr_defaults={"is_test": False})
+def _run_program(ins, attrs):
+    """Execute a captured sub-block over the current scope (reference
+    run_program_op.cc — the dygraph-to-static bridge)."""
+    ctx = attrs["_ctx"]
+    block = attrs.get("sub_block") or attrs.get("global_block")
+    if block is None:
+        raise ValueError("run_program: missing sub_block attr")
+    ctx.executor._run_block_eager(block, ctx.scope, ctx.rng_base)
+    return {}
+
+
+def _engine_stub(name, what):
+    @register_op(name, stateful=True, no_grad=True)
+    def _stub(ins, attrs):
+        raise NotImplementedError(
+            f"{name}: {what} On TPU the inference path is XLA ahead-of-time "
+            "compilation (AnalysisPredictor compiles the whole program); "
+            "no engine subgraph offload exists or is needed.")
+    return _stub
+
+
+_engine_stub("tensorrt_engine", "TensorRT subgraph offload op.")
+_engine_stub("lite_engine", "Paddle-Lite subgraph offload op.")

@@ -1,0 +1,234 @@
+"""Final op-batch tests (reference: tests/unittests/test_quantize_op.py,
+test_dequantize_op.py, test_requantize_op.py, test_fake_dequantize_op.py,
+test_dequantize_log_op.py, test_moving_average_abs_max_scale_op.py,
+test_lookup_sparse_table_op.py, test_split_selected_rows_op.py,
+test_dgc_op.py, test_dgc_momentum_op.py, test_ref_by_trainer_id_op.py,
+test_run_program_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.framework import Program, Operator
+from tests.test_sequence_ops import run_seq_op
+
+
+def test_quantize_dequantize_requantize():
+    x = np.array([[0.5, -0.25]], np.float32)
+    (q,), _ = run_seq_op("quantize", x, None, x_slot="Input",
+                         attrs={"Scale": 100.0, "is_negative_input": True},
+                         outputs=("Output",))
+    np.testing.assert_array_equal(q, [[50, -25]])
+    (d,), _ = run_seq_op("dequantize", q, None, x_slot="Input",
+                         attrs={"Scale": 100.0}, outputs=("Output",))
+    np.testing.assert_allclose(d, x, atol=1e-6)
+    (r,), _ = run_seq_op("requantize", q, None, x_slot="Input",
+                         attrs={"Scale_in": 100.0, "Scale_out": 50.0},
+                         outputs=("Output",))
+    np.testing.assert_array_equal(r, [[25, -13]])  # round(50*0.5)=25
+
+
+def test_dequantize_abs_max_and_channel_wise():
+    x = np.array([[127, -64]], np.int8)
+    scale = np.array([2.0], np.float32)
+    (o,), _ = run_seq_op("dequantize_abs_max", x, None,
+                         extra_inputs=[("Scale", scale, None)],
+                         attrs={"max_range": 127.0})
+    np.testing.assert_allclose(o, [[2.0, -64 * 2 / 127]], rtol=1e-5)
+    xc = np.array([[127.0, 127.0], [63.5, 127.0]], np.float32)
+    scales = np.array([2.0, 4.0], np.float32)
+    (oc,), _ = run_seq_op("fake_channel_wise_dequantize_max_abs", xc, None,
+                          extra_inputs=[("Scales", scales, None)],
+                          attrs={"quant_bits": [8], "quant_axis": 0})
+    np.testing.assert_allclose(oc, [[2.0, 2.0], [2.0, 4.0]], rtol=1e-5)
+
+
+def test_dequantize_log():
+    d = np.array([1.0, 2.0, 4.0, 8.0], np.float32)
+    x = np.array([[0, 2, 129]], np.uint8)  # 129 = sign bit + index 1
+    (o,), _ = run_seq_op("dequantize_log", x, None,
+                         extra_inputs=[("Dict", d, None)])
+    np.testing.assert_allclose(o, [[1.0, 4.0, -2.0]])
+
+
+def test_moving_average_abs_max_scale():
+    x = np.array([[3.0, -5.0]], np.float32)
+    (o, sc, st, ac), _ = run_seq_op(
+        "moving_average_abs_max_scale", x, None,
+        attrs={"moving_rate": 0.9},
+        outputs=("Out", "OutScale", "OutState", "OutAccum"))
+    np.testing.assert_allclose(o, x)
+    np.testing.assert_allclose(sc[0], 5.0, rtol=1e-6)  # accum/state = 5/1
+
+
+def test_dgc_topk():
+    g = np.array([0.1, -2.0, 0.3, 5.0], np.float32)
+    u = np.zeros(4, np.float32)
+    v = np.zeros(4, np.float32)
+    step = np.array([10.0], np.float32)
+    (uo, vo, go, k), _ = run_seq_op(
+        "dgc", u, None, x_slot="U",
+        extra_inputs=[("V", v, None), ("Grad", g, None),
+                      ("current_step", step, None)],
+        attrs={"m": 0.9, "sparsity": [0.5], "rampup_begin_step": 0.0},
+        outputs=("U_out", "V_out", "Grad_out", "k"))
+    assert k[0] == 2
+    # top-2 |values| are 5.0 and -2.0 -> kept in grad, zeroed in residual
+    np.testing.assert_allclose(go, [0, -2.0 * 0.9 ** 0, 0, 5.0], atol=1e-6)
+    assert vo[1] == 0 and vo[3] == 0 and vo[0] != 0 and vo[2] != 0
+
+
+def test_dgc_momentum_switches():
+    p = np.ones(3, np.float32)
+    g = np.full(3, 0.5, np.float32)
+    vel = np.zeros(3, np.float32)
+    lr = np.array([0.1], np.float32)
+    for step, expect in ((np.array([0.0], np.float32), 1 - 0.1 * 0.5),
+                         (np.array([100.0], np.float32), 1 - 0.1 * 0.5)):
+        (po, vo), _ = run_seq_op(
+            "dgc_momentum", p, None, x_slot="Param",
+            extra_inputs=[("Grad", g, None), ("Velocity", vel, None),
+                          ("LearningRate", lr, None),
+                          ("current_step", step, None)],
+            attrs={"mu": 0.9, "rampup_begin_step": 50.0},
+            outputs=("ParamOut", "VelocityOut"))
+        np.testing.assert_allclose(po, expect, rtol=1e-6)
+    # below rampup the velocity accumulates, above it stays untouched
+    (po, vo), _ = run_seq_op(
+        "dgc_momentum", p, None, x_slot="Param",
+        extra_inputs=[("Grad", g, None), ("Velocity", vel, None),
+                      ("LearningRate", lr, None),
+                      ("current_step", np.array([0.0], np.float32), None)],
+        attrs={"mu": 0.9, "rampup_begin_step": 50.0},
+        outputs=("ParamOut", "VelocityOut"))
+    np.testing.assert_allclose(vo, 0.5)
+
+
+def test_split_selected_rows_and_lookup_sparse_table():
+    import jax.numpy as jnp
+    scope = core.Scope()
+    main = Program()
+    block = main.global_block()
+    sr = core.SelectedRows(rows=[1, 5, 8], height=10)
+    sr.get_tensor().set(jnp.asarray(
+        np.array([[1, 1], [5, 5], [8, 8]], np.float32)))
+    scope.var("X").set_value(sr)
+    op = Operator(block, type="split_selected_rows",
+                  inputs={"X": ["X"]}, outputs={"Out": ["o1", "o2"]},
+                  attrs={"height_sections": [6, 4]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    import jax
+    exe._run_op_eager(op, scope, jax.random.key(0))
+    o1 = scope.find_var("o1").value()
+    o2 = scope.find_var("o2").value()
+    assert o1.rows() == [1, 5] and o2.rows() == [2]  # 8-6=2
+    np.testing.assert_allclose(np.asarray(o2.get_tensor().array), [[8, 8]])
+
+    # lookup_sparse_table: hit + auto-grown miss
+    scope.var("Ids").set_value(core.LoDTensor(
+        np.array([[5], [3]], np.int64)))
+    op2 = Operator(block, type="lookup_sparse_table",
+                   inputs={"Ids": ["Ids"], "W": ["X"]},
+                   outputs={"Out": ["lk"]}, attrs={})
+    exe._run_op_eager(op2, scope, jax.random.key(0))
+    lk = np.asarray(scope.find_var("lk").get_tensor().array)
+    np.testing.assert_allclose(lk, [[5, 5], [0, 0]])
+    assert 3 in scope.find_var("X").value().rows()  # auto-grown
+
+
+def test_ref_by_trainer_id_and_run_program():
+    import jax
+    scope = core.Scope()
+    main = Program()
+    block = main.global_block()
+    scope.var("a").set_value(core.LoDTensor(np.array([1.0], np.float32)))
+    scope.var("b").set_value(core.LoDTensor(np.array([2.0], np.float32)))
+    scope.var("tid").set_value(core.LoDTensor(np.array([1], np.int64)))
+    op = Operator(block, type="ref_by_trainer_id",
+                  inputs={"X": ["a", "b"], "TrainerId": ["tid"]},
+                  outputs={"Out": ["sel"]}, attrs={})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe._run_op_eager(op, scope, jax.random.key(0))
+    assert float(scope.find_var("sel").get_tensor().array[0]) == 2.0
+
+    sub = main._create_block()
+    main._rollback()
+    sub.append_op(type="scale", inputs={"X": ["a"]},
+                  outputs={"Out": ["a2"]},
+                  attrs={"scale": 10.0, "bias": 0.0,
+                         "bias_after_scale": True})
+    op2 = Operator(block, type="run_program", inputs={"X": ["a"]},
+                   outputs={"Out": ["a2"]}, attrs={"sub_block": sub})
+    exe._run_op_eager(op2, scope, jax.random.key(0))
+    assert float(scope.find_var("a2").get_tensor().array[0]) == 10.0
+
+
+def test_pull_push_sparse_local_table():
+    import jax
+    scope = core.Scope()
+    main = Program()
+    block = main.global_block()
+    tbl = np.arange(20, dtype=np.float32).reshape(10, 2)
+    scope.var("W").set_value(core.LoDTensor(tbl.copy()))
+    scope.var("Ids").set_value(core.LoDTensor(
+        np.array([[2], [7]], np.int64)))
+    op = Operator(block, type="pull_sparse",
+                  inputs={"Ids": ["Ids"], "W": ["W"]},
+                  outputs={"Out": ["emb"]}, attrs={"EmbeddingDim": 2})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe._run_op_eager(op, scope, jax.random.key(0))
+    emb = np.asarray(scope.find_var("emb").get_tensor().array)
+    np.testing.assert_allclose(emb.reshape(2, 2), tbl[[2, 7]])
+    # push grads back (sgd step on the rows)
+    scope.var("G").set_value(core.LoDTensor(np.ones((2, 2), np.float32)))
+    op2 = Operator(block, type="push_sparse",
+                   inputs={"Ids": ["Ids"], "W": ["W"], "Grads": ["G"]},
+                   outputs={}, attrs={"EmbeddingDim": 2, "lr": 0.5})
+    exe._run_op_eager(op2, scope, jax.random.key(0))
+    t2 = np.asarray(scope.find_var("W").value().array)
+    np.testing.assert_allclose(t2[[2, 7]], tbl[[2, 7]] - 0.5)
+    np.testing.assert_allclose(t2[[0, 1]], tbl[[0, 1]])
+
+
+def test_reader_ops_roundtrip():
+    import jax
+    scope = core.Scope()
+    main = Program()
+    block = main.global_block()
+
+    class _Q:
+        def __init__(self, items):
+            self.items = list(items)
+
+        def pop(self):
+            return self.items.pop(0) if self.items else None
+    scope.var("queue").set_value(_Q([
+        (np.array([[1.0]], np.float32), np.array([[2]], np.int64))]))
+    op = Operator(block, type="create_py_reader",
+                  inputs={"blocking_queue": ["queue"]},
+                  outputs={"Out": ["reader"]}, attrs={})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe._run_op_eager(op, scope, jax.random.key(0))
+    op2 = Operator(block, type="read", inputs={"Reader": ["reader"]},
+                   outputs={"Out": ["x", "y"]}, attrs={})
+    exe._run_op_eager(op2, scope, jax.random.key(0))
+    np.testing.assert_allclose(
+        np.asarray(scope.find_var("x").get_tensor().array), [[1.0]])
+    with pytest.raises(StopIteration):
+        exe._run_op_eager(op2, scope, jax.random.key(0))
+
+
+def test_cudnn_lstm_alias_runs():
+    rng = np.random.RandomState(0)
+    B, T, I, H = 2, 3, 4, 5
+    x = rng.rand(B, T, I).astype(np.float32)
+    # flat weight buffer: [Wx(I*4H) + Wh(H*4H) + 2 biases(2*4H)]
+    w = rng.rand(I * 4 * H + H * 4 * H + 8 * H).astype(np.float32) * 0.1
+    h0 = np.zeros((1, B, H), np.float32)
+    c0 = np.zeros((1, B, H), np.float32)
+    (o,), _ = run_seq_op("cudnn_lstm", x, None, x_slot="Input",
+                         extra_inputs=[("W", w, None), ("InitH", h0, None),
+                                       ("InitC", c0, None)],
+                         attrs={"hidden_size": H, "num_layers": 1,
+                                "input_size": I, "is_test": True})
+    assert o.shape == (B, T, H) and np.isfinite(o).all()
