@@ -111,8 +111,12 @@ def _moving_average_abs_max_scale(ins, attrs):
     if attrs.get("is_test", False):
         scale = first(ins, "InScale")
         return {"Out": [x], "OutScale": [scale]}
-    state = (in_state.reshape(()) if in_state is not None else 0.0) * rate + 1.0
-    accum = (in_accum.reshape(()) if in_accum is not None else 0.0) * rate + cur
+    state = jnp.asarray(
+        in_state.reshape(()) if in_state is not None else 0.0,
+        jnp.float32) * rate + 1.0
+    accum = jnp.asarray(
+        in_accum.reshape(()) if in_accum is not None else 0.0,
+        jnp.float32) * rate + cur
     scale = accum / state
     return {"Out": [x], "OutScale": [scale.reshape(1)],
             "OutState": [state.reshape(1)], "OutAccum": [accum.reshape(1)]}
