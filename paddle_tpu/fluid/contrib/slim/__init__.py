@@ -1,1 +1,11 @@
+"""slim — model compression toolkit (reference:
+python/paddle/fluid/contrib/slim/): quantization (QAT + post-training),
+structured/unstructured pruning, knowledge distillation, light-NAS with a
+simulated-annealing controller, all driven by the Compressor epoch loop."""
+from . import core  # noqa: F401
 from . import quantization  # noqa: F401
+from . import prune  # noqa: F401
+from . import distillation  # noqa: F401
+from . import nas  # noqa: F401
+from . import searcher  # noqa: F401
+from .core import Compressor  # noqa: F401

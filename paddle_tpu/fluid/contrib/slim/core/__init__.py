@@ -1,0 +1,6 @@
+"""slim core (reference: contrib/slim/core/)."""
+from .strategy import Strategy
+from .compressor import Compressor, Context
+from .config import ConfigFactory
+
+__all__ = ["Strategy", "Compressor", "Context", "ConfigFactory"]

@@ -1,0 +1,134 @@
+"""Compressor — the slim training driver (reference:
+contrib/slim/core/compressor.py Compressor/Context): runs the train program
+epoch by epoch, invoking each strategy's hooks, evaluating and
+checkpointing. The TPU build keeps the same control surface; the step
+itself is the compiled executor step."""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .strategy import Strategy
+
+__all__ = ["Context", "Compressor"]
+
+
+class Context:
+    """Shared state handed to strategy hooks (reference compressor.py
+    Context)."""
+
+    def __init__(self, place, scope, train_graph=None, eval_graph=None,
+                 train_reader=None, eval_reader=None, optimizer=None):
+        self.place = place
+        self.scope = scope
+        self.train_graph = train_graph
+        self.eval_graph = eval_graph
+        self.train_reader = train_reader
+        self.eval_reader = eval_reader
+        self.optimizer = optimizer
+        self.epoch_id = 0
+        self.batch_id = 0
+        self.eval_results = {}
+
+    def run_eval_graph(self):
+        raise NotImplementedError(
+            "provide eval via Compressor(eval_func=...)")
+
+
+class Compressor:
+    """reference compressor.py Compressor — config-driven epoch loop."""
+
+    def __init__(self, place, scope, train_program, train_reader=None,
+                 train_feed_list: Optional[Sequence[str]] = None,
+                 train_fetch_list: Optional[Sequence] = None,
+                 eval_program=None, eval_reader=None,
+                 eval_feed_list: Optional[Sequence[str]] = None,
+                 eval_fetch_list: Optional[Sequence] = None,
+                 eval_func: Optional[Callable[[], float]] = None,
+                 teacher_programs: Sequence = (), optimizer=None,
+                 epoch: int = 1, checkpoint_path: Optional[str] = None):
+        self.place = place
+        self.scope = scope
+        self.train_program = train_program
+        self.train_reader = train_reader
+        self.train_feed_list = list(train_feed_list or [])
+        self.train_fetch_list = list(train_fetch_list or [])
+        self.eval_program = eval_program
+        self.eval_reader = eval_reader
+        self.eval_feed_list = list(eval_feed_list or [])
+        self.eval_fetch_list = list(eval_fetch_list or [])
+        self.eval_func = eval_func
+        self.teacher_programs = list(teacher_programs)
+        self.optimizer = optimizer
+        self.epoch = epoch
+        self.checkpoint_path = checkpoint_path
+        self.strategies: List[Strategy] = []
+
+    def config(self, config_or_strategies):
+        """Accept a list of strategies or a ConfigFactory result."""
+        from .config import ConfigFactory
+        if isinstance(config_or_strategies, ConfigFactory):
+            self.strategies = config_or_strategies.strategies
+            self.epoch = max(self.epoch, config_or_strategies.epoch)
+        elif isinstance(config_or_strategies, str):
+            fac = ConfigFactory(config_or_strategies)
+            self.strategies = fac.strategies
+            self.epoch = max(self.epoch, fac.epoch)
+        else:
+            self.strategies = list(config_or_strategies)
+        return self
+
+    # ------------------------------------------------------------------
+    def run(self):
+        from ....executor import Executor, scope_guard
+        exe = Executor(self.place)
+        ctx = Context(self.place, self.scope,
+                      train_graph=self.train_program,
+                      eval_graph=self.eval_program,
+                      train_reader=self.train_reader,
+                      eval_reader=self.eval_reader,
+                      optimizer=self.optimizer)
+        for s in self.strategies:
+            s.on_compression_begin(ctx)
+        with scope_guard(self.scope):
+            for epoch_id in range(self.epoch):
+                ctx.epoch_id = epoch_id
+                for s in self.strategies:
+                    s.on_epoch_begin(ctx)
+                if self.train_reader is not None:
+                    for batch_id, data in enumerate(self.train_reader()):
+                        ctx.batch_id = batch_id
+                        for s in self.strategies:
+                            s.on_batch_begin(ctx)
+                        feed = data if isinstance(data, dict) else dict(
+                            zip(self.train_feed_list, data))
+                        ctx.last_fetch = exe.run(
+                            self.train_program, feed=feed,
+                            fetch_list=self.train_fetch_list)
+                        for s in self.strategies:
+                            s.on_batch_end(ctx)
+                if self.eval_func is not None:
+                    ctx.eval_results.setdefault("metric", []).append(
+                        float(self.eval_func()))
+                for s in self.strategies:
+                    s.on_epoch_end(ctx)
+                if self.checkpoint_path:
+                    self._save_checkpoint(epoch_id)
+        for s in self.strategies:
+            s.on_compression_end(ctx)
+        return ctx
+
+    def _save_checkpoint(self, epoch_id: int):
+        os.makedirs(self.checkpoint_path, exist_ok=True)
+        params = {}
+        for v in self.train_program.global_block().vars.values():
+            if v.persistable:
+                sv = self.scope.find_var(v.name)
+                if sv is not None and sv.is_initialized():
+                    params[v.name] = np.asarray(sv.get_tensor().array)
+        with open(os.path.join(self.checkpoint_path,
+                               f"epoch_{epoch_id}.pkl"), "wb") as f:
+            pickle.dump({"epoch": epoch_id, "params": params}, f)

@@ -1,0 +1,49 @@
+"""Config loader for slim strategies (reference: contrib/slim/core/config.py
+ConfigFactory — YAML of strategy class names + kwargs). Accepts a dict (or
+YAML text if pyyaml happens to be importable) of the same shape:
+
+    {"strategies": {"prune_0": {"class": "UniformPruneStrategy",
+                                "target_ratio": 0.5, ...}},
+     "compressor": {"epoch": 10, "strategies": ["prune_0"]}}
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+__all__ = ["ConfigFactory"]
+
+
+def _strategy_registry():
+    from ..prune import (PruneStrategy, UniformPruneStrategy,
+                         SensitivePruneStrategy)
+    from ..distillation import DistillationStrategy
+    from ..quantization import QuantizationStrategy
+    from ..nas import LightNASStrategy
+    return {c.__name__: c for c in (
+        PruneStrategy, UniformPruneStrategy, SensitivePruneStrategy,
+        DistillationStrategy, QuantizationStrategy, LightNASStrategy)}
+
+
+class ConfigFactory:
+    def __init__(self, config):
+        if isinstance(config, str):
+            try:
+                import yaml
+            except ImportError as e:
+                raise ImportError(
+                    "string configs need pyyaml; pass a dict instead") from e
+            config = yaml.safe_load(open(config) if "\n" not in config
+                                    else config)
+        self._build(config)
+
+    def _build(self, cfg: Dict[str, Any]):
+        reg = _strategy_registry()
+        defined = {}
+        for name, spec in (cfg.get("strategies") or {}).items():
+            spec = dict(spec)
+            cls = reg[spec.pop("class")]
+            defined[name] = cls(**spec)
+        comp = cfg.get("compressor") or {}
+        order = comp.get("strategies") or list(defined)
+        self.strategies = [defined[n] for n in order]
+        self.epoch = int(comp.get("epoch", 1))

@@ -1,0 +1,7 @@
+"""Knowledge distillation (reference: contrib/slim/distillation/)."""
+from .distiller import (L2Distiller, FSPDistiller, SoftLabelDistiller,
+                        merge_teacher_program)
+from .distillation_strategy import DistillationStrategy
+
+__all__ = ["L2Distiller", "FSPDistiller", "SoftLabelDistiller",
+           "merge_teacher_program", "DistillationStrategy"]

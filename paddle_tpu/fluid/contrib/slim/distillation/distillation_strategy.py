@@ -1,0 +1,28 @@
+"""DistillationStrategy (reference: contrib/slim/distillation/
+distillation_strategy.py) — at start_epoch, appends the distiller losses to
+the training loss; the Compressor then trains on the combined objective."""
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..core.strategy import Strategy
+
+__all__ = ["DistillationStrategy"]
+
+
+class DistillationStrategy(Strategy):
+    def __init__(self, distillers: Sequence = (), start_epoch: int = 0,
+                 end_epoch: int = 0):
+        super().__init__(start_epoch, end_epoch)
+        self.distillers = list(distillers)
+
+    def on_compression_begin(self, context):
+        from ....framework import program_guard
+        from .... import layers
+        program = context.train_graph
+        with program_guard(program):
+            losses = [d.distiller_loss(program) for d in self.distillers]
+            total = losses[0]
+            for l in losses[1:]:
+                total = layers.elementwise_add(total, l)
+            context.distill_loss = total

@@ -1,0 +1,121 @@
+"""Distillers — build the distillation loss into the student program
+(reference: contrib/slim/distillation/distiller.py — L2Distiller:25,
+FSPDistiller:103, SoftLabelDistiller:195; each has an IrGraph "Pass" that
+appends loss ops and sums with the existing loss).
+
+Here the student program IS the graph; ``merge_teacher_program`` clones the
+teacher's ops/vars into it under a ``teacher_`` prefix (the reference
+merges IrGraphs the same way), then the distillers append loss ops."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["merge_teacher_program", "L2Distiller", "FSPDistiller",
+           "SoftLabelDistiller"]
+
+TEACHER_PREFIX = "teacher_"
+
+
+def merge_teacher_program(teacher_program, student_program,
+                          data_name_map: Optional[Dict[str, str]] = None,
+                          name_prefix: str = TEACHER_PREFIX) -> Dict[str, str]:
+    """Clone teacher ops+vars into the student program, renaming every
+    teacher var ``name_prefix+name`` except feed data vars, which map onto
+    the student's own data vars via ``data_name_map`` {teacher: student}.
+    Returns {teacher_var: merged_name}. Teacher persistables must then be
+    loaded into the scope under their prefixed names."""
+    data_name_map = data_name_map or {}
+    tb = teacher_program.global_block()
+    sb = student_program.global_block()
+    rename: Dict[str, str] = {}
+    for name, var in tb.vars.items():
+        if name in data_name_map:
+            rename[name] = data_name_map[name]
+            continue
+        new = name_prefix + name
+        rename[name] = new
+        if new not in sb.vars:
+            sb.create_var(name=new, shape=tuple(var.shape), dtype=var.dtype,
+                          persistable=var.persistable,
+                          stop_gradient=True, lod_level=var.lod_level)
+    for op in tb.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        ins = {s: [rename.get(n, name_prefix + n) for n in ns]
+               for s, ns in op.inputs.items()}
+        outs = {s: [rename.get(n, name_prefix + n) for n in ns]
+                for s, ns in op.outputs.items()}
+        sb.append_op(type=op.type, inputs=ins, outputs=outs,
+                     attrs=dict(op.attrs))
+    return rename
+
+
+class L2Distiller:
+    """MSE between a student feature var and a teacher feature var
+    (reference distiller.py:25)."""
+
+    def __init__(self, student_feature_map: str, teacher_feature_map: str,
+                 distillation_loss_weight: float = 1.0):
+        self.student = student_feature_map
+        self.teacher = teacher_feature_map
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        from .... import layers
+        b = program.global_block()
+        s, t = b.vars[self.student], b.vars[self.teacher]
+        diff = layers.elementwise_sub(s, t)
+        loss = layers.reduce_mean(layers.elementwise_mul(diff, diff))
+        return layers.scale(loss, self.weight)
+
+
+class FSPDistiller:
+    """Flow-of-solution-procedure loss over (layer-pair) feature maps
+    (reference distiller.py:103; fsp op — operators/fsp_op.cc)."""
+
+    def __init__(self, student_pairs: Sequence[Sequence[str]],
+                 teacher_pairs: Sequence[Sequence[str]],
+                 distillation_loss_weight: float = 1.0):
+        self.student_pairs = student_pairs
+        self.teacher_pairs = teacher_pairs
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        from .... import layers
+        b = program.global_block()
+        losses = []
+        for (s0, s1), (t0, t1) in zip(self.student_pairs,
+                                      self.teacher_pairs):
+            s_fsp = layers.fsp_matrix(b.vars[s0], b.vars[s1])
+            t_fsp = layers.fsp_matrix(b.vars[t0], b.vars[t1])
+            diff = layers.elementwise_sub(s_fsp, t_fsp)
+            losses.append(
+                layers.reduce_mean(layers.elementwise_mul(diff, diff)))
+        total = losses[0]
+        for l in losses[1:]:
+            total = layers.elementwise_add(total, l)
+        return layers.scale(total, self.weight)
+
+
+class SoftLabelDistiller:
+    """Cross-entropy of temperature-softened teacher logits against
+    student logits (reference distiller.py:195)."""
+
+    def __init__(self, student_feature_map: str, teacher_feature_map: str,
+                 student_temperature: float = 1.0,
+                 teacher_temperature: float = 1.0,
+                 distillation_loss_weight: float = 1.0):
+        self.student = student_feature_map
+        self.teacher = teacher_feature_map
+        self.st = student_temperature
+        self.tt = teacher_temperature
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        from .... import layers
+        b = program.global_block()
+        s = layers.softmax(layers.scale(b.vars[self.student], 1.0 / self.st))
+        t = layers.softmax(layers.scale(b.vars[self.teacher], 1.0 / self.tt))
+        loss = layers.reduce_mean(
+            layers.cross_entropy(s, t, soft_label=True))
+        return layers.scale(loss, self.weight)

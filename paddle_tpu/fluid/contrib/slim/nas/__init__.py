@@ -1,0 +1,8 @@
+"""Light-NAS (reference: contrib/slim/nas/)."""
+from .search_space import SearchSpace
+from .light_nas_strategy import LightNASStrategy
+from .controller_server import ControllerServer
+from .search_agent import SearchAgent
+
+__all__ = ["SearchSpace", "LightNASStrategy", "ControllerServer",
+           "SearchAgent"]

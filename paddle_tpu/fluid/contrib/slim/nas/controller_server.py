@@ -1,0 +1,77 @@
+"""ControllerServer — serve a controller to remote search agents over TCP
+(reference: contrib/slim/nas/controller_server.py; line protocol
+"next_tokens" / "update <reward> <tokens...>")."""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Optional
+
+__all__ = ["ControllerServer"]
+
+
+class ControllerServer:
+    def __init__(self, controller, address=("127.0.0.1", 0),
+                 max_client_num: int = 100, search_steps: int = 10,
+                 key: str = "light-nas"):
+        self._controller = controller
+        self._address = address
+        self._max_client_num = max_client_num
+        self._key = key
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._lock = threading.Lock()
+
+    def start(self):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(self._address)
+        self._sock.listen(self._max_client_num)
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def ip(self):
+        return self._sock.getsockname()[0]
+
+    def port(self):
+        return self._sock.getsockname()[1]
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _serve(self):
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            with conn:
+                data = conn.makefile("r").readline()
+                if not data:
+                    continue
+                try:
+                    req = json.loads(data)
+                except json.JSONDecodeError:
+                    continue
+                if req.get("key") != self._key:
+                    conn.sendall(b'{"error": "bad key"}\n')
+                    continue
+                with self._lock:
+                    if req.get("cmd") == "next_tokens":
+                        resp = {"tokens": self._controller.next_tokens()}
+                    elif req.get("cmd") == "update":
+                        self._controller.update(req["tokens"],
+                                                float(req["reward"]))
+                        resp = {"ok": True,
+                                "best_tokens": self._controller.best_tokens,
+                                "max_reward": self._controller.max_reward}
+                    else:
+                        resp = {"error": "unknown cmd"}
+                conn.sendall((json.dumps(resp) + "\n").encode())

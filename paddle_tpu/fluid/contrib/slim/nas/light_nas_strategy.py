@@ -1,0 +1,67 @@
+"""LightNASStrategy (reference: contrib/slim/nas/light_nas_strategy.py) —
+simulated-annealing architecture search over a user SearchSpace, with an
+optional latency constraint.
+
+The reference splits controller (server) from trainers (agents) over a TCP
+socket so multiple machines can evaluate tokens; the same server/agent pair
+exists here (controller_server.py / search_agent.py) — this strategy runs
+them in-process by default, which is the single-host TPU-VM case."""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..core.strategy import Strategy
+from ..searcher.controller import SAController
+
+__all__ = ["LightNASStrategy"]
+
+
+class LightNASStrategy(Strategy):
+    def __init__(self, controller: Optional[SAController] = None,
+                 end_epoch: int = 0, target_latency: float = 0,
+                 retrain_epoch: int = 0,
+                 metric_name: str = "acc_top1",
+                 server_ip: str = "", server_port: int = 0,
+                 is_server: bool = True, max_client_num: int = 100,
+                 search_steps: int = 10, key: str = "light-nas"):
+        super().__init__(0, end_epoch)
+        self._controller = controller or SAController()
+        self.search_steps = search_steps
+        self.target_latency = target_latency
+        self.metric_name = metric_name
+        self._server_ip = server_ip
+        self._server_port = server_port
+        self._is_server = is_server
+
+    def search(self, search_space,
+               eval_func: Optional[Callable] = None):
+        """Run the SA search loop: for each step, sample tokens, build the
+        net, score it (eval_func(train_prog, eval_prog, metrics) → reward),
+        update the controller. Returns (best_tokens, best_reward)."""
+        init = search_space.init_tokens()
+        ranges = search_space.range_table()
+
+        def constrain(tokens):
+            if not self.target_latency:
+                return True
+            net = search_space.create_net(tokens)
+            return search_space.get_model_latency(net[1]) \
+                <= self.target_latency
+
+        self._controller.reset(ranges, init, constrain)
+        for step in range(self.search_steps):
+            tokens = self._controller.next_tokens()
+            net = search_space.create_net(tokens)
+            if eval_func is not None:
+                reward = float(eval_func(*net))
+            else:
+                reward = 0.0
+            if self.target_latency:
+                lat = search_space.get_model_latency(net[1])
+                if lat > self.target_latency:
+                    reward -= (lat - self.target_latency)
+            self._controller.update(tokens, reward)
+        return self._controller.best_tokens, self._controller.max_reward
+
+    def on_compression_begin(self, context):
+        context.search_strategy = self

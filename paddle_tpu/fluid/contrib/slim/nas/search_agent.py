@@ -1,0 +1,28 @@
+"""SearchAgent — client side of the NAS controller service (reference:
+contrib/slim/nas/search_agent.py)."""
+from __future__ import annotations
+
+import json
+import socket
+
+__all__ = ["SearchAgent"]
+
+
+class SearchAgent:
+    def __init__(self, server_ip: str, server_port: int,
+                 key: str = "light-nas"):
+        self._addr = (server_ip, server_port)
+        self._key = key
+
+    def _request(self, payload: dict) -> dict:
+        payload["key"] = self._key
+        with socket.create_connection(self._addr, timeout=30) as conn:
+            conn.sendall((json.dumps(payload) + "\n").encode())
+            return json.loads(conn.makefile("r").readline())
+
+    def next_tokens(self):
+        return self._request({"cmd": "next_tokens"})["tokens"]
+
+    def update(self, tokens, reward: float) -> dict:
+        return self._request({"cmd": "update", "tokens": list(tokens),
+                              "reward": float(reward)})

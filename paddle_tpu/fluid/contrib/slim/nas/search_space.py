@@ -1,0 +1,26 @@
+"""SearchSpace contract the user implements (reference:
+contrib/slim/nas/search_space.py)."""
+from __future__ import annotations
+
+__all__ = ["SearchSpace"]
+
+
+class SearchSpace:
+    """Subclass and implement the four methods (reference search_space.py):
+    init_tokens / range_table define the token space; create_net builds the
+    train/eval programs for a token vector; get_model_latency scores cost."""
+
+    def init_tokens(self):
+        raise NotImplementedError
+
+    def range_table(self):
+        raise NotImplementedError
+
+    def create_net(self, tokens=None):
+        """Return (startup_program, train_program, eval_program,
+        train_metrics, eval_metrics) for the given tokens."""
+        raise NotImplementedError
+
+    def get_model_latency(self, program) -> float:
+        """Optional cost model used by the latency constraint."""
+        return 0.0

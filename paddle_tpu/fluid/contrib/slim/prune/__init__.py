@@ -1,0 +1,7 @@
+"""Model pruning (reference: python/paddle/fluid/contrib/slim/prune/)."""
+from .pruner import Pruner, StructurePruner, RatioPruner
+from .prune_strategy import (PruneStrategy, UniformPruneStrategy,
+                             SensitivePruneStrategy, sensitivity)
+
+__all__ = ["Pruner", "StructurePruner", "RatioPruner", "PruneStrategy",
+           "UniformPruneStrategy", "SensitivePruneStrategy", "sensitivity"]

@@ -1,0 +1,137 @@
+"""Prune strategies (reference: contrib/slim/prune/prune_strategy.py —
+PruneStrategy, UniformPruneStrategy, SensitivePruneStrategy;
+auto_prune_strategy.py).
+
+Strategies mutate the parameters living in a Scope (masked pruning — see
+pruner.py for the TPU rationale) at the epochs the Compressor schedule
+dictates."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.strategy import Strategy
+from .pruner import StructurePruner
+
+__all__ = ["PruneStrategy", "UniformPruneStrategy",
+           "SensitivePruneStrategy", "sensitivity"]
+
+
+def _get_param(scope, name: str) -> np.ndarray:
+    var = scope.find_var(name)
+    if var is None or not var.is_initialized():
+        raise KeyError(f"parameter '{name}' not found in scope")
+    return np.asarray(var.get_tensor().array)
+
+
+def _set_param(scope, name: str, value: np.ndarray):
+    import jax.numpy as jnp
+    from ....core import LoDTensor
+    scope.var(name).set_value(LoDTensor(jnp.asarray(value)))
+
+
+class PruneStrategy(Strategy):
+    """Apply a pruner to listed params at ``start_epoch``
+    (reference prune_strategy.py PruneStrategy)."""
+
+    def __init__(self, pruner: Optional[StructurePruner] = None,
+                 start_epoch: int = 0, end_epoch: int = 0,
+                 params: Sequence[str] = (), ratios: Sequence[float] = ()):
+        super().__init__(start_epoch, end_epoch)
+        self.pruner = pruner or StructurePruner()
+        self.params = list(params)
+        self.ratios = list(ratios)
+        self._masks: Dict[str, np.ndarray] = {}
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id == self.start_epoch:
+            self._prune(context.scope)
+
+    def on_batch_end(self, context):
+        # re-apply masks so optimizer updates cannot resurrect pruned
+        # channels (the reference re-writes shrunk tensors instead)
+        scope = context.scope
+        for name, mask in self._masks.items():
+            _set_param(scope, name, _get_param(scope, name) * mask)
+
+    def _prune(self, scope):
+        for name, ratio in zip(self.params, self.ratios):
+            p = _get_param(scope, name)
+            idx = self.pruner.cal_pruned_idx(name, p, ratio)
+            axis = self.pruner._axis(name)
+            pruned = self.pruner.prune_tensor(p, idx, axis, lazy=True)
+            mask = np.ones_like(p)
+            sl = [slice(None)] * p.ndim
+            sl[axis] = idx
+            mask[tuple(sl)] = 0
+            self._masks[name] = mask
+            _set_param(scope, name, pruned)
+
+
+class UniformPruneStrategy(PruneStrategy):
+    """One ratio for every listed param (reference
+    prune_strategy.py UniformPruneStrategy)."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 target_ratio=0.5, params: Sequence[str] = ()):
+        super().__init__(pruner, start_epoch, end_epoch, params,
+                         [target_ratio] * len(params))
+        self.target_ratio = target_ratio
+
+
+def sensitivity(program, scope, exe, params: Sequence[str],
+                eval_func: Callable[[], float],
+                ratios: Sequence[float] = (0.1, 0.3, 0.5, 0.7),
+                pruner: Optional[StructurePruner] = None
+                ) -> Dict[str, Dict[float, float]]:
+    """Per-parameter sensitivity curve: metric loss at each prune ratio
+    (reference sensitive_prune; restores the original weights after each
+    probe)."""
+    pruner = pruner or StructurePruner()
+    result: Dict[str, Dict[float, float]] = {}
+    baseline = eval_func()
+    for name in params:
+        orig = _get_param(scope, name).copy()
+        curve: Dict[float, float] = {}
+        for r in ratios:
+            _set_param(scope, name, pruner.prune(orig, r, name=name))
+            curve[r] = float(baseline - eval_func())
+        _set_param(scope, name, orig)
+        result[name] = curve
+    return result
+
+
+class SensitivePruneStrategy(PruneStrategy):
+    """Pick per-param ratios from a sensitivity analysis so total pruning
+    hits ``target_ratio`` while cheap-to-prune params take more of it
+    (reference prune_strategy.py SensitivePruneStrategy)."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 target_ratio=0.5, params: Sequence[str] = (),
+                 eval_func: Optional[Callable[[], float]] = None,
+                 sensitivity_loss_bound: float = 0.05,
+                 probe_ratios: Sequence[float] = (0.1, 0.3, 0.5, 0.7)):
+        super().__init__(pruner, start_epoch, end_epoch, params, [])
+        self.target_ratio = target_ratio
+        self.eval_func = eval_func
+        self.loss_bound = sensitivity_loss_bound
+        self.probe_ratios = probe_ratios
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id != self.start_epoch:
+            return
+        scope = context.scope
+        if self.eval_func is None:
+            self.ratios = [self.target_ratio] * len(self.params)
+        else:
+            sens = sensitivity(None, scope, None, self.params,
+                               self.eval_func, self.probe_ratios,
+                               self.pruner)
+            self.ratios = []
+            for name in self.params:
+                curve = sens[name]
+                ok = [r for r, loss in sorted(curve.items())
+                      if loss <= self.loss_bound]
+                self.ratios.append(max(ok) if ok else min(curve))
+        self._prune(scope)

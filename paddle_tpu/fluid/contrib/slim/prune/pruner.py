@@ -1,0 +1,97 @@
+"""Pruners — compute which filters/rows of a parameter to drop
+(reference: contrib/slim/prune/pruner.py — Pruner:22, StructurePruner:34,
+cal_pruned_idx:55, prune_tensor:81).
+
+TPU design note: the reference physically shrinks tensors and patches every
+downstream op's shape (graph surgery). On TPU, shape-changing surgery
+re-triggers XLA compilation per ratio and produces MXU-unfriendly odd dims,
+so the default here is masked (``lazy``) pruning — zeroing pruned channels
+in place, keeping static shapes and letting sparsity show up as model-size
+reduction at export. ``prune_tensor(lazy=False)`` still materializes the
+physically smaller tensor for export paths."""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+__all__ = ["Pruner", "StructurePruner", "RatioPruner"]
+
+
+class Pruner:
+    """Base class (reference pruner.py:22)."""
+
+    def prune(self, param, ratio: float):
+        raise NotImplementedError
+
+
+class StructurePruner(Pruner):
+    """Structured (whole filter/row) pruning by importance criterion
+    (reference pruner.py:34).
+
+    pruning_axis: {param_name_or_"*": axis}
+    criterions:   {param_name_or_"*": "l1_norm" | "l2_norm" | "random"}
+    """
+
+    def __init__(self, pruning_axis: Dict[str, int] = None,
+                 criterions: Dict[str, str] = None):
+        self.pruning_axis = pruning_axis or {"*": 0}
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def _axis(self, name: str) -> int:
+        return self.pruning_axis.get(name, self.pruning_axis.get("*", 0))
+
+    def _criterion(self, name: str) -> str:
+        return self.criterions.get(name, self.criterions.get("*", "l1_norm"))
+
+    def cal_pruned_idx(self, name: str, param: np.ndarray, ratio: float,
+                       axis: int = None) -> List[int]:
+        """Indices along ``axis`` to prune, lowest-importance first
+        (reference pruner.py:55)."""
+        axis = self._axis(name) if axis is None else axis
+        crit = self._criterion(name)
+        p = np.asarray(param, dtype=np.float64)
+        reduce_axes = tuple(i for i in range(p.ndim) if i != axis)
+        if crit == "l1_norm":
+            scores = np.abs(p).sum(axis=reduce_axes)
+        elif crit == "l2_norm":
+            scores = np.sqrt((p * p).sum(axis=reduce_axes))
+        elif crit == "random":
+            scores = np.random.rand(p.shape[axis])
+        else:
+            raise ValueError(f"unknown criterion {crit}")
+        n_prune = int(round(p.shape[axis] * ratio))
+        order = np.argsort(scores, kind="stable")
+        return sorted(order[:n_prune].tolist())
+
+    def prune_tensor(self, tensor: np.ndarray, pruned_idx: Sequence[int],
+                     pruned_axis: int, lazy: bool = True) -> np.ndarray:
+        """lazy=True → zero the pruned slices (static shapes, TPU default);
+        lazy=False → physically remove them (reference pruner.py:81)."""
+        t = np.array(tensor)
+        if lazy:
+            sl = [slice(None)] * t.ndim
+            sl[pruned_axis] = list(pruned_idx)
+            t[tuple(sl)] = 0
+            return t
+        keep = [i for i in range(t.shape[pruned_axis]) if i not in
+                set(pruned_idx)]
+        return np.take(t, keep, axis=pruned_axis)
+
+    def prune(self, param: np.ndarray, ratio: float, name: str = "*",
+              lazy: bool = True) -> np.ndarray:
+        idx = self.cal_pruned_idx(name, param, ratio)
+        return self.prune_tensor(param, idx, self._axis(name), lazy=lazy)
+
+
+class RatioPruner(Pruner):
+    """Unstructured magnitude pruning to a target sparsity ratio."""
+
+    def prune(self, param: np.ndarray, ratio: float) -> np.ndarray:
+        p = np.array(param)
+        k = int(round(p.size * ratio))
+        if k == 0:
+            return p
+        thresh = np.partition(np.abs(p).ravel(), k - 1)[k - 1]
+        p[np.abs(p) <= thresh] = 0
+        return p

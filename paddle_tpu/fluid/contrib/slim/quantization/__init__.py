@@ -4,6 +4,9 @@ QuantizationTransformPass, QuantizationFreezePass; post_training_
 quantization.py)."""
 from .quantization_pass import (QuantizationTransformPass,
                                 QuantizationFreezePass, quantize_program)
+from .post_training_quantization import PostTrainingQuantization
+from .quantization_strategy import QuantizationStrategy
 
 __all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
-           "quantize_program"]
+           "quantize_program", "PostTrainingQuantization",
+           "QuantizationStrategy"]

@@ -1,0 +1,214 @@
+"""Post-training quantization (reference: contrib/slim/quantization/
+post_training_quantization.py — PostTrainingQuantization:68: load model,
+run calibration batches collecting per-tensor thresholds (abs_max / KL),
+then rewrite the program with quant/dequant at the sampled scales and save).
+
+TPU framing: the quantized program still executes as float math with
+quantize→dequantize roundtrips (fake-quant), which XLA folds into the
+surrounding ops — the artifact records int8 scales for deployment while
+the simulation stays MXU-friendly."""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .quantization_pass import QUANTIZABLE, _WEIGHT_SLOTS, _ACT_SLOTS
+
+__all__ = ["PostTrainingQuantization"]
+
+
+def _abs_max(samples: List[np.ndarray]) -> float:
+    return float(max(np.abs(s).max() for s in samples)) or 1e-8
+
+
+def _percentile(samples: List[np.ndarray], q: float = 99.99) -> float:
+    flat = np.concatenate([np.abs(s).ravel() for s in samples])
+    return float(np.percentile(flat, q)) or 1e-8
+
+
+def _kl_threshold(samples: List[np.ndarray], bins: int = 2048,
+                  levels: int = 128) -> float:
+    """Entropy-calibrated threshold (reference _get_kl_scaling_factor):
+    choose the clip that minimizes KL(P||Q) between the fp32 histogram and
+    its quantized projection."""
+    flat = np.abs(np.concatenate([s.ravel() for s in samples]))
+    amax = float(flat.max()) or 1e-8
+    hist, edges = np.histogram(flat, bins=bins, range=(0, amax))
+    hist = hist.astype(np.float64)
+    best_kl, best_i = None, bins - 1
+    for i in range(levels, bins + 1, 8):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()  # clip outliers into last bin
+        if p.sum() == 0:
+            continue
+        # quantize p into `levels` buckets then expand back
+        factor = i / levels
+        q = np.zeros(i)
+        for l in range(levels):
+            lo, hi = int(round(l * factor)), int(round((l + 1) * factor))
+            hi = max(hi, lo + 1)
+            chunk = p[lo:hi]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[lo:hi][chunk > 0] = chunk.sum() / nz
+        pn, qn = p / p.sum(), q / max(q.sum(), 1e-12)
+        mask = pn > 0
+        kl = float(np.sum(pn[mask] * np.log(pn[mask] /
+                                            np.maximum(qn[mask], 1e-12))))
+        if best_kl is None or kl < best_kl:
+            best_kl, best_i = kl, i
+    return float(edges[best_i])
+
+
+_ALGOS = {"abs_max": _abs_max, "hist": _percentile, "KL": _kl_threshold}
+
+
+class PostTrainingQuantization:
+    """reference post_training_quantization.py:68.
+
+    Either pass ``program`` (+ executor & scope holding trained params) or
+    ``model_dir`` saved by save_inference_model. ``sample_generator`` yields
+    feed dicts for calibration."""
+
+    def __init__(self, executor, sample_generator,
+                 model_dir: Optional[str] = None, program=None,
+                 feed_names: Optional[Sequence[str]] = None,
+                 fetch_names: Optional[Sequence[str]] = None,
+                 scope=None, batch_nums: Optional[int] = 10,
+                 algo: str = "KL",
+                 quantizable_op_type: Optional[Sequence[str]] = None,
+                 weight_bits: int = 8, activation_bits: int = 8):
+        from ....executor import global_scope
+        from .... import io as fluid_io
+        if algo not in _ALGOS:
+            raise ValueError(f"algo must be one of {sorted(_ALGOS)}")
+        self._exe = executor
+        self._scope = scope if scope is not None else global_scope()
+        self._algo = algo
+        self._batch_nums = batch_nums
+        self._sample_generator = sample_generator
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._qtypes = set(quantizable_op_type or QUANTIZABLE)
+        if model_dir is not None:
+            from ....executor import scope_guard
+            with scope_guard(self._scope):
+                prog, feeds, fetches = fluid_io.load_inference_model(
+                    model_dir, executor)
+            self._program, self._feeds, self._fetches = prog, feeds, fetches
+        else:
+            if program is None:
+                raise ValueError("need model_dir or program")
+            self._program = program
+            self._feeds = list(feed_names or [])
+            self._fetches = list(fetch_names or [])
+        self.scales: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------
+    def _target_var_names(self):
+        acts, weights = set(), set()
+        persistable = {v.name for v in
+                       self._program.global_block().vars.values()
+                       if v.persistable}
+        for op in self._program.global_block().ops:
+            if op.type not in self._qtypes:
+                continue
+            w = _WEIGHT_SLOTS.get(op.type)
+            a = _ACT_SLOTS.get(op.type)
+            if w and op.input(w):
+                (weights if op.input(w)[0] in persistable
+                 else acts).add(op.input(w)[0])
+            if a and op.input(a):
+                acts.add(op.input(a)[0])
+            for slot, names in op.outputs.items():
+                acts.update(n for n in names if n not in persistable)
+        return acts, weights
+
+    def quantize(self):
+        """Run calibration then rewrite the program (reference :264)."""
+        from ....executor import scope_guard
+        acts, weights = self._target_var_names()
+        samples: Dict[str, List[np.ndarray]] = {n: [] for n in acts}
+        fetch_names = sorted(acts)
+        with scope_guard(self._scope):
+            for i, feed in enumerate(self._sample_generator()):
+                if self._batch_nums and i >= self._batch_nums:
+                    break
+                vals = self._exe.run(self._program, feed=feed,
+                                     fetch_list=fetch_names)
+                for n, v in zip(fetch_names, vals):
+                    samples[n].append(np.asarray(v))
+        algo_fn = _ALGOS[self._algo]
+        for n, s in samples.items():
+            if s:
+                self.scales[n] = algo_fn(s)
+        for n in weights:  # weights always abs_max per reference
+            v = self._scope.find_var(n)
+            if v is not None and v.is_initialized():
+                self.scales[n] = _abs_max([np.asarray(
+                    v.get_tensor().array)])
+        self._rewrite()
+        return self._program
+
+    def _rewrite(self):
+        """Insert fake_quantize_dequantize ops at the calibrated scales."""
+        from ....framework import Operator
+        from ....core import VarDesc
+        from .... import unique_name
+        import jax.numpy as jnp
+        from ....core import LoDTensor
+        block = self._program.global_block()
+        new_ops: List = []
+        quantized: Dict[str, str] = {}
+        for op in block.ops:
+            if op.type in self._qtypes:
+                for slot_map, bits in ((_ACT_SLOTS, self._abits),
+                                       (_WEIGHT_SLOTS, self._wbits)):
+                    slot = slot_map.get(op.type)
+                    if not slot or not op.input(slot):
+                        continue
+                    name = op.input(slot)[0]
+                    if name not in self.scales:
+                        continue
+                    if name not in quantized:
+                        qname = unique_name.generate(
+                            name + ".quantized.dequantized")
+                        src = block.vars.get(name)
+                        block.create_var(
+                            name=qname,
+                            dtype=src.dtype if src else VarDesc.VarType.FP32,
+                            shape=tuple(src.shape) if src else ())
+                        sname = unique_name.generate(name + ".ptq_scale")
+                        block.create_var(name=sname, shape=(1,),
+                                         persistable=True,
+                                         dtype=VarDesc.VarType.FP32)
+                        self._scope.var(sname).set_value(LoDTensor(
+                            jnp.asarray([self.scales[name]], jnp.float32)))
+                        new_ops.append(Operator(
+                            block, type="fake_quantize_dequantize_moving_average_abs_max",
+                            inputs={"X": [name], "InScale": [sname]},
+                            outputs={"Out": [qname], "OutScale": [sname]},
+                            attrs={"bit_length": bits, "is_test": True}))
+                        quantized[name] = qname
+                    op.inputs[slot] = [quantized[name]]
+            new_ops.append(op)
+        # interleave: place each quant op right before its first consumer
+        block.ops = []
+        for op in new_ops:
+            block.ops.append(op)
+        self._program._version += 1
+
+    def save_quantized_model(self, save_model_path: str):
+        """reference :310 — export program+params with scales baked in."""
+        from .... import io as fluid_io
+        from ....executor import scope_guard
+        with scope_guard(self._scope):
+            block = self._program.global_block()
+            targets = [block.vars[n] if not hasattr(n, "name") else n
+                       for n in self._fetches]
+            feed_names = [n if isinstance(n, str) else n.name
+                          for n in self._feeds]
+            fluid_io.save_inference_model(save_model_path, feed_names,
+                                          targets, self._exe,
+                                          main_program=self._program)

@@ -1,0 +1,43 @@
+"""QuantizationStrategy (reference: contrib/slim/quantization/
+quantization_strategy.py) — applies the QAT transform at start_epoch and
+freezes for inference export at end_epoch."""
+from __future__ import annotations
+
+from ..core.strategy import Strategy
+from .quantization_pass import quantize_program
+
+__all__ = ["QuantizationStrategy"]
+
+
+class QuantizationStrategy(Strategy):
+    def __init__(self, start_epoch: int = 0, end_epoch: int = 0,
+                 weight_bits: int = 8, activation_bits: int = 8,
+                 save_in_nodes=None, save_out_nodes=None,
+                 float_model_save_path=None, int8_model_save_path=None):
+        super().__init__(start_epoch, end_epoch)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.save_in_nodes = save_in_nodes
+        self.save_out_nodes = save_out_nodes
+        self.float_model_save_path = float_model_save_path
+        self.int8_model_save_path = int8_model_save_path
+
+    def on_epoch_begin(self, context):
+        if context.epoch_id == self.start_epoch:
+            quantize_program(context.train_graph,
+                             weight_bits=self.weight_bits,
+                             activation_bits=self.activation_bits)
+
+    def on_epoch_end(self, context):
+        if context.epoch_id == self.end_epoch and self.float_model_save_path:
+            from ....executor import Executor, scope_guard
+            from .... import io as fluid_io
+            exe = Executor(context.place)
+            block = context.train_graph.global_block()
+            outs = [block.vars[n] for n in (self.save_out_nodes or [])]
+            if outs:
+                with scope_guard(context.scope):
+                    fluid_io.save_inference_model(
+                        self.float_model_save_path,
+                        list(self.save_in_nodes or []), outs, exe,
+                        main_program=context.train_graph)

@@ -1,0 +1,4 @@
+"""Evolutionary search controllers (reference: contrib/slim/searcher/)."""
+from .controller import EvolutionaryController, SAController
+
+__all__ = ["EvolutionaryController", "SAController"]

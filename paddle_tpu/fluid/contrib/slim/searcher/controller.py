@@ -1,0 +1,105 @@
+"""Search controllers (reference: contrib/slim/searcher/controller.py —
+EvolutionaryController:28, SAController:59 simulated annealing over integer
+token vectors)."""
+from __future__ import annotations
+
+import math
+import random
+from typing import Callable, List, Optional, Sequence
+
+__all__ = ["EvolutionaryController", "SAController"]
+
+
+class EvolutionaryController:
+    """reference controller.py:28."""
+
+    def update(self, tokens: Sequence[int], reward: float):
+        raise NotImplementedError
+
+    def reset(self, range_table: Sequence[int], init_tokens: Sequence[int],
+              constrain_func: Optional[Callable] = None):
+        raise NotImplementedError
+
+    def next_tokens(self) -> List[int]:
+        raise NotImplementedError
+
+
+class SAController(EvolutionaryController):
+    """Simulated annealing (reference controller.py:59): propose a mutated
+    token vector; accept if reward improves, else with probability
+    exp((reward - best) / temperature)."""
+
+    def __init__(self, range_table: Optional[Sequence[int]] = None,
+                 reduce_rate: float = 0.85, init_temperature: float = 1024,
+                 max_iter_number: int = 300, seed: Optional[int] = None):
+        self._range_table = list(range_table or [])
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._iter = 0
+        self._temperature = init_temperature
+        self._tokens: List[int] = []
+        self._reward = -float("inf")
+        self._best_tokens: List[int] = []
+        self._max_reward = -float("inf")
+        self._constrain_func: Optional[Callable] = None
+        self._rng = random.Random(seed)
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d.pop("_constrain_func", None)
+        return d
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._constrain_func = None
+
+    def reset(self, range_table: Sequence[int],
+              init_tokens: Sequence[int],
+              constrain_func: Optional[Callable] = None):
+        self._range_table = list(range_table)
+        self._tokens = list(init_tokens)
+        self._best_tokens = list(init_tokens)
+        self._constrain_func = constrain_func
+        self._iter = 0
+        self._temperature = self._init_temperature
+        self._reward = -float("inf")
+        self._max_reward = -float("inf")
+
+    def update(self, tokens: Sequence[int], reward: float):
+        """Accept/reject ``tokens`` given its measured ``reward``."""
+        self._iter += 1
+        temperature = self._init_temperature * (
+            self._reduce_rate ** self._iter)
+        self._temperature = temperature
+        if (reward > self._reward
+                or self._rng.random() < math.exp(
+                    min((reward - self._reward) / max(temperature, 1e-9),
+                        0.0))):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self) -> List[int]:
+        """Mutate the current tokens (reference: flips one random slot)."""
+        for _ in range(100):
+            tokens = list(self._tokens)
+            i = self._rng.randrange(len(tokens))
+            tokens[i] = self._rng.randrange(self._range_table[i])
+            if self._constrain_func is None or self._constrain_func(tokens):
+                return tokens
+        return list(self._tokens)
+
+    @property
+    def best_tokens(self):
+        return list(self._best_tokens)
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    @property
+    def current_tokens(self):
+        return list(self._tokens)

@@ -10,6 +10,7 @@ from .base import VarBase
 from .parallel import DataParallel, ParallelEnv, prepare_context
 from .checkpoint import save_dygraph, load_dygraph
 from . import jit
-from .jit import TracedLayer, declarative
+from .jit import TracedLayer, declarative, ProgramTranslator
+from . import dygraph_to_static
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from .container import Sequential, ParameterList, LayerList

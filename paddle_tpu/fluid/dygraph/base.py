@@ -58,6 +58,12 @@ class VarBase:
             return tuple(self._array.shape)
         return tuple(self._declared_shape or ())
 
+    @shape.setter
+    def shape(self, value):
+        # static layer helpers annotate declared shape before the op runs;
+        # once an array exists, its real shape wins
+        self._declared_shape = tuple(value)
+
     @property
     def dtype(self):
         if self._array is not None:
@@ -124,6 +130,21 @@ class VarBase:
     __truediv__ = lambda s, o: s._binary(o, "elementwise_div")
     __rtruediv__ = lambda s, o: s._binary(o, "elementwise_div", True)
     __pow__ = lambda s, o: s._binary(o, "elementwise_pow")
+    __lt__ = lambda s, o: s._binary(o, "less_than")
+    __le__ = lambda s, o: s._binary(o, "less_equal")
+    __gt__ = lambda s, o: s._binary(o, "greater_than")
+    __ge__ = lambda s, o: s._binary(o, "greater_equal")
+
+    def __bool__(self):
+        return bool(np.asarray(self._array).reshape(-1)[0]) \
+            if np.asarray(self._array).size == 1 \
+            else bool(np.asarray(self._array).any())
+
+    def __float__(self):
+        return float(np.asarray(self._array).reshape(-1)[0])
+
+    def __int__(self):
+        return int(np.asarray(self._array).reshape(-1)[0])
 
     def __len__(self):
         return int(self.shape[0]) if self.shape else 0

@@ -1,0 +1,16 @@
+"""dygraph_to_static — AST transpiler + program translator (reference:
+python/paddle/fluid/dygraph/dygraph_to_static/)."""
+from .ast_transformer import (DygraphToStaticAst, convert_to_static,
+                              transformed_source)
+from .convert_operators import (convert_ifelse, convert_while_loop,
+                                convert_logical_and, convert_logical_or,
+                                convert_logical_not, convert_len)
+from .program_translator import (ProgramTranslator, ConcreteProgram,
+                                 StaticFunction, declarative)
+
+__all__ = [
+    "DygraphToStaticAst", "convert_to_static", "transformed_source",
+    "convert_ifelse", "convert_while_loop", "convert_logical_and",
+    "convert_logical_or", "convert_logical_not", "convert_len",
+    "ProgramTranslator", "ConcreteProgram", "StaticFunction", "declarative",
+]

@@ -1,0 +1,338 @@
+"""AST transpiler: rewrite Python control flow over tensors into converter
+calls (reference: python/paddle/fluid/dygraph/dygraph_to_static/
+ast_transformer.py + ifelse_transformer / loop_transformer).
+
+The transform is semantics-preserving for plain Python values (converters
+fall back to host control flow) and turns tensor-dependent ``if`` / ``while``
+/ ``for range()`` / ``and`` / ``or`` / ``not`` into ``layers.cond`` /
+``layers.while_loop`` graph ops during a to-static trace — which the TPU
+executor compiles to ``lax.cond`` / ``lax.while_loop`` inside one XLA
+computation (no host round-trips inside the step).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import List, Set
+
+__all__ = ["DygraphToStaticAst", "convert_to_static", "transformed_source"]
+
+_JST = "_jst"  # module alias injected into the transformed function's globals
+
+
+# --------------------------------------------------------------------------
+# name analysis
+# --------------------------------------------------------------------------
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by simple assignments in a statement list (no descent
+    into nested function/class definitions)."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def visit_FunctionDef(self, node):  # do not descend
+        self.names.add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def _target(self, t):
+        if isinstance(t, ast.Name):
+            self.names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e)
+
+
+def _assigned_in(stmts: List[ast.stmt]) -> Set[str]:
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+class _HasNode(ast.NodeVisitor):
+    def __init__(self, kinds):
+        self.kinds = kinds
+        self.found = False
+
+    def generic_visit(self, node):
+        if isinstance(node, self.kinds):
+            self.found = True
+            return
+        # don't descend into nested function defs: their returns are theirs
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        super().generic_visit(node)
+
+
+def _contains(stmts, kinds) -> bool:
+    v = _HasNode(kinds)
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+# --------------------------------------------------------------------------
+# the transformer
+# --------------------------------------------------------------------------
+class DygraphToStaticAst(ast.NodeTransformer):
+    def __init__(self):
+        self._counter = 0
+
+    def _uid(self) -> int:
+        self._counter += 1
+        return self._counter
+
+    # -------------------------------------------------------------- exprs
+    def visit_BoolOp(self, node: ast.BoolOp):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        expr = node.values[0]
+        for rhs in node.values[1:]:
+            expr = ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                                   attr=fn, ctx=ast.Load()),
+                args=[ast.Lambda(args=_empty_args(), body=expr),
+                      ast.Lambda(args=_empty_args(), body=rhs)],
+                keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node: ast.UnaryOp):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", [node.operand])
+        return node
+
+    # -------------------------------------------------------------- stmts
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        uid = self._uid()
+        body, orelse = node.body, node.orelse or [ast.Pass()]
+        body_returns = _contains(body, ast.Return)
+        else_returns = _contains(orelse, ast.Return)
+
+        if body_returns or else_returns:
+            if not (body_returns and else_returns):
+                raise NotImplementedError(
+                    "dygraph_to_static: an `if` where only one branch "
+                    "returns is not supported — give both branches a "
+                    "return (or assign to a variable and return after "
+                    "the if)")
+            # both branches return: branch fns keep their returns; the
+            # whole statement becomes `return convert_ifelse(...)`
+            t_def = _make_fn(f"_jst_true_fn_{uid}", [], body)
+            f_def = _make_fn(f"_jst_false_fn_{uid}", [], orelse)
+            call = _jst_call("convert_ifelse",
+                             [node.test,
+                              ast.Name(id=t_def.name, ctx=ast.Load()),
+                              ast.Name(id=f_def.name, ctx=ast.Load())])
+            return [t_def, f_def, ast.Return(value=call)]
+
+        assigned = sorted(_assigned_in(body) | _assigned_in(orelse))
+        ret_tuple = ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in assigned],
+            ctx=ast.Load())
+        # branch fns take the assigned names as PARAMETERS: a branch that
+        # assigns `s` makes `s` local, so it cannot read the pre-branch
+        # value through a closure
+        t_def = _make_fn(f"_jst_true_fn_{uid}", assigned,
+                         body + [ast.Return(value=ret_tuple)])
+        f_def = _make_fn(f"_jst_false_fn_{uid}", assigned,
+                         orelse + [ast.Return(value=ret_tuple)])
+        call = _jst_call("convert_ifelse",
+                         [node.test,
+                          ast.Name(id=t_def.name, ctx=ast.Load()),
+                          ast.Name(id=f_def.name, ctx=ast.Load()),
+                          ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                          for n in assigned],
+                                    ctx=ast.Load())])
+        if assigned:
+            tgt = ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in assigned],
+                ctx=ast.Store())
+            res = ast.Assign(targets=[tgt], value=call)
+        else:
+            res = ast.Expr(value=call)
+        return _undef_guards(assigned) + [t_def, f_def, res]
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if _contains(node.body, (ast.Break, ast.Continue, ast.Return)):
+            raise NotImplementedError(
+                "dygraph_to_static: break/continue/return inside a `while` "
+                "over tensors is not supported — restructure with the loop "
+                "condition")
+        uid = self._uid()
+        loop_vars = sorted(_assigned_in(node.body))
+        args = _name_args(loop_vars)
+        ret_tuple = ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in loop_vars],
+            ctx=ast.Load())
+        cond_def = _make_fn(f"_jst_cond_{uid}", loop_vars,
+                            [ast.Return(value=node.test)])
+        body_def = _make_fn(f"_jst_body_{uid}", loop_vars,
+                            node.body + [ast.Return(value=ret_tuple)])
+        guards = _undef_guards(loop_vars)
+        call = _jst_call("convert_while_loop",
+                         [ast.Name(id=cond_def.name, ctx=ast.Load()),
+                          ast.Name(id=body_def.name, ctx=ast.Load()),
+                          ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                          for n in loop_vars],
+                                    ctx=ast.Load())])
+        if loop_vars:
+            tgt = ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in loop_vars],
+                ctx=ast.Store())
+            res = ast.Assign(targets=[tgt], value=call)
+        else:
+            res = ast.Expr(value=call)
+        return guards + [cond_def, body_def, res]
+
+    def visit_For(self, node: ast.For):
+        # only `for <name> in range(...)` is rewritten (tensor trip counts);
+        # other iterables keep Python semantics
+        if not (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and isinstance(node.target, ast.Name)
+                and not node.iter.keywords
+                and not node.orelse):
+            self.generic_visit(node)
+            return node
+        uid = self._uid()
+        i = node.target.id
+        start_n, stop_n, step_n = (f"_jst_start_{uid}", f"_jst_stop_{uid}",
+                                   f"_jst_step_{uid}")
+        init = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store())
+                      for n in (start_n, stop_n, step_n)],
+                ctx=ast.Store())],
+            value=_jst_call("normalize_range", list(node.iter.args)))
+        set_i = ast.Assign(targets=[ast.Name(id=i, ctx=ast.Store())],
+                           value=ast.Name(id=start_n, ctx=ast.Load()))
+        test = _jst_call("range_cond",
+                         [ast.Name(id=i, ctx=ast.Load()),
+                          ast.Name(id=stop_n, ctx=ast.Load()),
+                          ast.Name(id=step_n, ctx=ast.Load())])
+        inc = ast.Assign(
+            targets=[ast.Name(id=i, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=i, ctx=ast.Load()),
+                            op=ast.Add(),
+                            right=ast.Name(id=step_n, ctx=ast.Load())))
+        loop = ast.While(test=test, body=node.body + [inc], orelse=[])
+        out = [init, set_i]
+        res = self.visit_While(loop)
+        out.extend(res if isinstance(res, list) else [res])
+        return out
+
+
+def _undef_guards(names):
+    """For each name: bind the UNDEFINED sentinel if currently unbound, so
+    pre-branch/pre-loop value tuples can always be built."""
+    guards = []
+    for n in names:
+        guards.append(ast.Try(
+            body=[ast.Expr(value=ast.Name(id=n, ctx=ast.Load()))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(
+                    elts=[ast.Name(id="NameError", ctx=ast.Load()),
+                          ast.Name(id="UnboundLocalError", ctx=ast.Load())],
+                    ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    targets=[ast.Name(id=n, ctx=ast.Store())],
+                    value=ast.Attribute(
+                        value=ast.Name(id=_JST, ctx=ast.Load()),
+                        attr="UNDEFINED", ctx=ast.Load()))])],
+            orelse=[], finalbody=[]))
+    return guards
+
+
+def _empty_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                         kw_defaults=[], kwarg=None, defaults=[])
+
+
+def _name_args(names):
+    return ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=n) for n in names], vararg=None,
+        kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+
+
+def _make_fn(name, argnames, body):
+    return ast.FunctionDef(
+        name=name, args=_name_args(argnames), body=body, decorator_list=[],
+        returns=None, type_comment=None, type_params=[])
+
+
+def _jst_call(fn, args):
+    return ast.Call(
+        func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                           attr=fn, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+# --------------------------------------------------------------------------
+# function-level entry points
+# --------------------------------------------------------------------------
+def _transform_tree(fn) -> ast.Module:
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    fdef.decorator_list = []  # strip @declarative etc. to avoid recursion
+    DygraphToStaticAst().visit(tree)
+    ast.fix_missing_locations(tree)
+    return tree
+
+
+def transformed_source(fn) -> str:
+    """Source of the converted function (ProgramTranslator.get_code)."""
+    return ast.unparse(_transform_tree(fn))
+
+
+def convert_to_static(fn):
+    """Return a new function object with tensor control flow routed through
+    the converters. Closure variables of the original are rebound."""
+    from . import convert_operators
+    tree = _transform_tree(fn)
+    g = dict(fn.__globals__)
+    g[_JST] = convert_operators
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                g[name] = cell.cell_contents
+            except ValueError:  # empty cell
+                pass
+    code = compile(tree, filename=f"<dygraph_to_static {fn.__qualname__}>",
+                   mode="exec")
+    ns: dict = {}
+    exec(code, g, ns)
+    new_fn = ns[fn.__name__]
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    return new_fn

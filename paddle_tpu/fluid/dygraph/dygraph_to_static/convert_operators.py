@@ -1,0 +1,218 @@
+"""Runtime converters the AST transpiler targets (reference:
+python/paddle/fluid/dygraph/dygraph_to_static/convert_operators.py-era
+behavior inside program_translator.py + loop/ifelse transformers).
+
+Each converter dispatches on the runtime type of its tensor arguments:
+
+* static ``framework.Variable`` (to-static trace in progress) — build the
+  real control-flow ops (``layers.cond`` / ``layers.while_loop``), which the
+  TPU executor lowers to ``lax.cond`` / ``lax.while_loop`` inside the one
+  jitted step function;
+* dygraph ``VarBase`` holding a concrete array — plain Python control flow
+  on the host value (eager semantics, reference Tracer behavior);
+* plain Python values — untouched Python semantics.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import framework
+
+__all__ = [
+    "convert_ifelse", "convert_while_loop", "convert_logical_and",
+    "convert_logical_or", "convert_logical_not", "convert_len",
+    "normalize_range", "range_cond", "UNDEFINED",
+]
+
+
+class _Undefined:
+    """Sentinel for loop vars first assigned inside the loop body."""
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEFINED = _Undefined()
+
+
+def _is_static_var(x) -> bool:
+    return isinstance(x, framework.Variable)
+
+
+def _to_bool(x) -> bool:
+    """Host truth value of a dygraph tensor / numpy / python value."""
+    if hasattr(x, "numpy"):
+        x = x.numpy()
+    arr = np.asarray(x)
+    return bool(arr.reshape(-1)[0]) if arr.size == 1 else bool(arr.any())
+
+
+def convert_ifelse(pred, true_fn, false_fn, init_args=()):
+    """``if pred: ... else: ...`` → layers.cond when pred is a static
+    Variable (→ lax.cond on TPU), else Python branch selection.
+
+    ``init_args`` holds the pre-branch values of every name either branch
+    assigns (the transpiler passes them as parameters — branch bodies can't
+    read them through closures because assignment makes them function-local).
+    """
+    init_args = tuple(init_args)
+    if _is_static_var(pred):
+        from ...layers import control_flow
+        return control_flow.cond(pred, lambda: true_fn(*init_args),
+                                 lambda: false_fn(*init_args))
+    if _to_bool(pred):
+        return true_fn(*init_args)
+    return false_fn(*init_args)
+
+
+def convert_while_loop(cond_fn, body_fn, loop_vars):
+    """``while cond: body`` over ``loop_vars`` (tuple).
+
+    Static path: promotes Python scalars to fill_constant vars and builds a
+    while op (→ lax.while_loop). Loop vars that are ``UNDEFINED`` on entry
+    (first assigned inside the body) stay host-side — they cannot carry
+    state across compiled iterations, matching Python scoping."""
+    loop_vars = tuple(loop_vars)
+    probe = cond_fn(*loop_vars)
+    if not _is_static_var(probe):
+        while _to_bool(probe):
+            new_vars = body_fn(*loop_vars)
+            loop_vars = tuple(new_vars) if isinstance(
+                new_vars, (list, tuple)) else (new_vars,)
+            probe = cond_fn(*loop_vars)
+        return loop_vars
+
+    # ---- static trace: build the while op over the Variable subset ----
+    from ...layers import control_flow, tensor as ltensor
+    from ...core import VarDesc
+
+    promoted = []
+    for v in loop_vars:
+        if _is_static_var(v) or v is UNDEFINED:
+            promoted.append(v)
+        elif isinstance(v, bool):
+            promoted.append(ltensor.fill_constant([1], VarDesc.VarType.BOOL,
+                                                  v))
+        elif isinstance(v, int):
+            promoted.append(ltensor.fill_constant([1], VarDesc.VarType.INT64,
+                                                  v))
+        elif isinstance(v, float):
+            promoted.append(ltensor.fill_constant([1], VarDesc.VarType.FP32,
+                                                  v))
+        else:
+            # non-tensor loop-carried object (list, dict, ...) — cannot be
+            # compiled state; keep it closed-over/host-side
+            promoted.append(v)
+    carried_idx = [i for i, v in enumerate(promoted) if _is_static_var(v)]
+
+    def _expand(carried):
+        full = list(promoted)
+        for i, v in zip(carried_idx, carried):
+            full[i] = v
+        return full
+
+    def _cond(*carried):
+        return cond_fn(*_expand(carried))
+
+    def _body(*carried):
+        new_vars = body_fn(*_expand(carried))
+        if not isinstance(new_vars, (list, tuple)):
+            new_vars = (new_vars,)
+        return [new_vars[i] for i in carried_idx]
+
+    carried = [promoted[i] for i in carried_idx]
+    out = control_flow.while_loop(_cond, _body, carried)
+    return tuple(_expand(out))
+
+
+def convert_logical_and(x_fn, y_fn):
+    x = x_fn()
+    if _is_static_var(x):
+        from ...layers.nn import logical_and
+        return logical_and(x, _as_static_bool(y_fn()))
+    return _to_bool(x) and y_fn()
+
+
+def convert_logical_or(x_fn, y_fn):
+    x = x_fn()
+    if _is_static_var(x):
+        from ...layers.nn import logical_or
+        return logical_or(x, _as_static_bool(y_fn()))
+    return _to_bool(x) or y_fn()
+
+
+def convert_logical_not(x):
+    if _is_static_var(x):
+        from ...layers.nn import logical_not
+        return logical_not(x)
+    return not _to_bool(x)
+
+
+def _as_static_bool(y):
+    if _is_static_var(y):
+        return y
+    from ...layers import tensor as ltensor
+    from ...core import VarDesc
+    return ltensor.fill_constant([1], VarDesc.VarType.BOOL, bool(y))
+
+
+def convert_len(x):
+    if _is_static_var(x):
+        from ...layer_helper import LayerHelper
+        from ...core import VarDesc
+        helper = LayerHelper("convert_len")
+        shp = helper.create_variable_for_type_inference(VarDesc.VarType.INT32)
+        helper.append_op(type="shape", inputs={"Input": [x]},
+                         outputs={"Out": [shp]})
+        out = helper.create_variable_for_type_inference(VarDesc.VarType.INT32)
+        helper.append_op(type="slice", inputs={"Input": [shp]},
+                         outputs={"Out": [out]},
+                         attrs={"axes": [0], "starts": [0], "ends": [1]})
+        return out
+    return len(x)
+
+
+def normalize_range(*args):
+    """range(stop) / range(start, stop[, step]) → (start, stop, step)."""
+    if len(args) == 1:
+        return 0, args[0], 1
+    if len(args) == 2:
+        return args[0], args[1], 1
+    return args[0], args[1], args[2]
+
+
+def range_cond(i, stop, step):
+    """Continue-iterating predicate valid for either sign of step:
+    (i - stop) * sign(step) < 0 — works on Python ints and tensors."""
+    if _is_static_var(i) or _is_static_var(stop) or _is_static_var(step):
+        from ...layers import math_op, sign
+
+        def _v(x):
+            if _is_static_var(x):
+                return x
+            from ...layers import tensor as ltensor
+            ref = i if _is_static_var(i) else (
+                stop if _is_static_var(stop) else step)
+            return ltensor.fill_constant([1], ref.dtype, x)
+        i_v, stop_v, step_v = _v(i), _v(stop), _v(step)
+        diff = math_op("elementwise_sub", i_v, stop_v)
+        signed = math_op("elementwise_mul", diff,
+                         sign(step_v.astype(diff.dtype)))
+        from ...layers import tensor as ltensor
+        zero = ltensor.fill_constant([1], signed.dtype, 0)
+        return signed < zero
+    if step > 0:
+        return _host_val(i) < _host_val(stop)
+    return _host_val(i) > _host_val(stop)
+
+
+def _host_val(x):
+    if hasattr(x, "numpy"):
+        return np.asarray(x.numpy()).reshape(-1)[0]
+    return x

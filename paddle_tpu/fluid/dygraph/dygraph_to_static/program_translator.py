@@ -1,0 +1,304 @@
+"""ProgramTranslator — dygraph function → static Program → compiled XLA step
+(reference: python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py ProgramTranslator/ConcreteProgram + the run_program
+op bridge, paddle/fluid/operators/run_program_op.cc).
+
+TPU inversion of the reference design: the reference re-traces Python into a
+ProgramDesc and executes it op-by-op through a nested PartialProgram. Here
+the traced Program is compiled ONCE into a pure jitted function
+``(feeds, params, rng) -> (outputs, updated_state)`` and the dygraph side
+sees it as a single tape op (``run_program_dy``) whose gradient is the exact
+``jax.vjp`` of that function — so a @declarative forward participates in
+eager autograd while running as one fused XLA computation.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ... import core, framework, unique_name
+from ...core import LoDTensor, Scope, VarDesc
+from ....ops.registry import OPS, register_op
+from ..base import VarBase
+from ..layers import Layer
+from .ast_transformer import convert_to_static, transformed_source
+
+__all__ = ["ProgramTranslator", "ConcreteProgram", "StaticFunction",
+           "declarative"]
+
+
+def _one_sig(a):
+    if isinstance(a, VarBase):
+        return ("VB", tuple(a.shape), int(a.dtype))
+    if isinstance(a, (np.ndarray, jax.Array)):
+        return ("ARR", tuple(a.shape), str(a.dtype))
+    if isinstance(a, Layer):
+        return ("LAYER", id(a))
+    return ("PY", repr(a))
+
+
+def _sig_of(args, kwargs) -> Tuple:
+    parts = [_one_sig(a) for a in args]
+    for k in sorted(kwargs):
+        parts.append((k,) + _one_sig(kwargs[k]))
+    return tuple(parts)
+
+
+def _is_tensor(a) -> bool:
+    return isinstance(a, (VarBase, np.ndarray, jax.Array))
+
+
+class ConcreteProgram:
+    """One (function, input-spec) trace: static Program + compiled step."""
+
+    def __init__(self, func, args, kwargs, param_sources: Dict[str, VarBase]):
+        self.main_program = framework.Program()
+        self.startup_program = framework.Program()
+        self.feed_names: List[str] = []
+        static_inputs: List[Any] = []
+        static_kwargs: Dict[str, Any] = {}
+        self._input_pos: List[int] = []   # arg positions that are tensors
+        self._input_keys: List[str] = []  # kwarg names that are tensors
+
+        with framework.program_guard(self.main_program,
+                                     self.startup_program):
+            block = self.main_program.global_block()
+
+            def _lift(a, tag):
+                shape = tuple(a.shape)
+                dtype = (a.dtype if isinstance(a, VarBase)
+                         else core.np_to_dtype(str(np.asarray(a).dtype)))
+                name = unique_name.generate(f"_jst_input_{tag}")
+                v = block.create_var(name=name, shape=shape, dtype=dtype,
+                                     is_data=True, need_check_feed=True,
+                                     stop_gradient=False)
+                self.feed_names.append(name)
+                return v
+
+            for i, a in enumerate(args):
+                if _is_tensor(a):
+                    static_inputs.append(_lift(a, str(i)))
+                    self._input_pos.append(i)
+                else:
+                    static_inputs.append(a)
+            for k in sorted(kwargs):
+                if _is_tensor(kwargs[k]):
+                    static_kwargs[k] = _lift(kwargs[k], k)
+                    self._input_keys.append(k)
+                else:
+                    static_kwargs[k] = kwargs[k]
+            with framework._dygraph_guard(None):  # static trace
+                outputs = func(*static_inputs, **static_kwargs)
+
+        self._single_out = not isinstance(outputs, (list, tuple))
+        out_list = [outputs] if self._single_out else list(outputs)
+        for o in out_list:
+            if not isinstance(o, framework.Variable):
+                raise TypeError(
+                    "dygraph_to_static: converted function must return "
+                    f"static Variables, got {type(o).__name__}")
+        self.fetch_names = [o.name for o in out_list]
+
+        # resolve names referenced by ops but not defined in any block →
+        # dygraph parameters/buffers (reference param_guard behavior)
+        defined = set(self.feed_names)
+        for b in self.main_program.blocks:
+            defined.update(b.vars.keys())
+        self.param_vars: Dict[str, VarBase] = {}
+        gb = self.main_program.global_block()
+        for b in self.main_program.blocks:
+            for op in b.ops:
+                for n in list(op.input_arg_names) + list(op.output_arg_names):
+                    if n in defined or n in self.param_vars:
+                        continue
+                    src = param_sources.get(n)
+                    if src is None or src._array is None:
+                        raise KeyError(
+                            f"dygraph_to_static: op '{op.type}' references "
+                            f"'{n}' which is neither produced by the traced "
+                            f"program nor a known dygraph parameter/buffer")
+                    gb.create_var(name=n, shape=tuple(src.shape),
+                                  dtype=src.dtype, persistable=True,
+                                  stop_gradient=src.stop_gradient)
+                    self.param_vars[n] = src
+        self._cb = None
+
+    # ------------------------------------------------------------ compile
+    def _ensure_compiled(self):
+        if self._cb is not None:
+            return
+        from ...executor import _CompiledBlock
+        scope = Scope()
+        for n, p in self.param_vars.items():
+            scope.var(n).set_value(LoDTensor(p._array))
+        self._scope = scope
+        self._cb = _CompiledBlock(
+            self.main_program, tuple(self.feed_names),
+            tuple(self.fetch_names), scope,
+            self.main_program.random_seed or core.globals_["FLAGS_seed"])
+        self.mut_names = list(self._cb.mut_state)
+        self.ro_names = list(self._cb.ro_state)
+        self.state_names = self.mut_names + self.ro_names
+        cb = self._cb
+
+        def _flat(xs, mut_ps, ro_ps, rng):
+            fetches, new_mut, _extra = cb._step(
+                dict(zip(self.mut_names, mut_ps)),
+                dict(zip(self.ro_names, ro_ps)),
+                dict(zip(self.feed_names, xs)), rng)
+            return tuple(fetches), tuple(new_mut[n] for n in self.mut_names)
+
+        self._flat = _flat
+        self._jitted = jax.jit(_flat)
+
+    def run_kernel(self, ins, attrs):
+        """Pure kernel body for the run_program_dy tape op. Dispatches the
+        jitted whole-program function (one fused XLA computation); under
+        jax.vjp the jitted call is differentiated as a unit."""
+        self._ensure_compiled()
+        xs = tuple(ins.get("X") or [])
+        ps = tuple(ins.get("Params") or [])
+        k = len(self.mut_names)
+        fetches, new_mut = self._jitted(xs, ps[:k], ps[k:], attrs["_rng"])
+        return {"Out": list(fetches), "ParamsOut": list(new_mut)}
+
+    # ------------------------------------------------------------- invoke
+    def call_dygraph(self, args, kwargs):
+        self._ensure_compiled()
+        tracer = framework._dygraph_tracer()
+        input_vbs = []
+        for a in ([args[i] for i in self._input_pos]
+                  + [kwargs[k] for k in self._input_keys]):
+            input_vbs.append(a if isinstance(a, VarBase)
+                             else VarBase(jnp.asarray(a)))
+        param_vbs = [self.param_vars[n] for n in self.state_names]
+        out_vbs = [VarBase(None) for _ in self.fetch_names]
+        mut_vbs = [self.param_vars[n] for n in self.mut_names]
+        tracer.trace_op(
+            "run_program_dy",
+            {"X": input_vbs, "Params": param_vbs},
+            {"Out": out_vbs, "ParamsOut": mut_vbs},
+            {"_cp": self})
+        if self._single_out:
+            return out_vbs[0]
+        return out_vbs
+
+
+@register_op("run_program_dy", needs_rng=True,
+             diff_inputs=("X", "Params"), inputs=("X", "Params"),
+             outputs=("Out", "ParamsOut"))
+def _run_program_dy(ins, attrs):
+    """Compiled-program bridge op (reference: run_program_op.cc — the
+    dygraph↔static boundary). Forward executes the jitted program; the
+    gradient falls out of the generic jax.vjp machinery because this kernel
+    is a pure traceable function of its tensor inputs."""
+    return attrs["_cp"].run_kernel(ins, attrs)
+
+
+class StaticFunction:
+    """Callable (and descriptor, so it works on methods) wrapping a
+    converted function with a per-input-spec ConcreteProgram cache."""
+
+    def __init__(self, fn):
+        functools.update_wrapper(self, fn)
+        self._fn = fn
+        self._converted = None
+        self._cache: Dict[Tuple, ConcreteProgram] = {}
+        self._is_declarative = True
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = functools.partial(self.__call__, instance)
+        bound.__wrapped__ = self  # for introspection
+        return bound
+
+    @property
+    def converted(self):
+        if self._converted is None:
+            self._converted = convert_to_static(self._fn)
+        return self._converted
+
+    def code(self) -> str:
+        return transformed_source(self._fn)
+
+    def _param_sources(self, args) -> Dict[str, VarBase]:
+        sources: Dict[str, VarBase] = {}
+        tracer = framework._dygraph_tracer()
+        if tracer is not None:
+            sources.update(tracer._params)
+        for a in args:
+            if isinstance(a, Layer):
+                for _, p in a.named_parameters():
+                    sources[p.name] = p
+                for _, sub in a.named_sublayers(include_self=True):
+                    for b in sub._buffers.values():
+                        if isinstance(b, VarBase):
+                            sources[b.name] = b
+        return sources
+
+    def concrete_program(self, *args, **kwargs) -> ConcreteProgram:
+        key = _sig_of(args, kwargs)
+        cp = self._cache.get(key)
+        if cp is None:
+            cp = ConcreteProgram(self.converted, args, kwargs,
+                                 self._param_sources(args))
+            self._cache[key] = cp
+        return cp
+
+    def __call__(self, *args, **kwargs):
+        if (not framework.in_dygraph_mode()
+                or not ProgramTranslator().enable_to_static):
+            # already building a static graph (or to-static disabled with
+            # no dygraph tracer): run the converted function directly so
+            # control flow lowers into the current program
+            if not framework.in_dygraph_mode():
+                return self.converted(*args, **kwargs)
+            return self._fn(*args, **kwargs)  # disabled: plain eager
+        cp = self.concrete_program(*args, **kwargs)
+        return cp.call_dygraph(args, kwargs)
+
+
+def declarative(fn):
+    """@declarative — convert + compile a dygraph function on first call
+    (reference dygraph/jit.py:121)."""
+    if isinstance(fn, StaticFunction):
+        return fn
+    return StaticFunction(fn)
+
+
+class ProgramTranslator:
+    """Singleton control surface (reference program_translator.py)."""
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enable_to_static = True
+        return cls._instance
+
+    def enable(self, enable_to_static: bool):
+        self.enable_to_static = bool(enable_to_static)
+
+    # ----- reference API: get_output / get_func / get_program / get_code
+    def get_func(self, dygraph_func):
+        return declarative(dygraph_func).converted
+
+    def get_code(self, dygraph_func):
+        return transformed_source(dygraph_func)
+
+    def get_output(self, dygraph_func, *args, **kwargs):
+        return declarative(dygraph_func)(*args, **kwargs)
+
+    def get_program(self, dygraph_func, *args, **kwargs):
+        cp = declarative(dygraph_func).concrete_program(*args, **kwargs)
+        inputs = [cp.main_program.global_block().vars[n]
+                  for n in cp.feed_names]
+        outputs = [cp.main_program.global_block().vars[n]
+                   if n in cp.main_program.global_block().vars else n
+                   for n in cp.fetch_names]
+        return cp.main_program, cp.startup_program, inputs, outputs

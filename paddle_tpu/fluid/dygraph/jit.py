@@ -1,12 +1,17 @@
-"""dygraph.jit — trace imperative code into compiled functions (reference:
-dygraph/jit.py TracedLayer:224, declarative:121 + dygraph_to_static/).
+"""dygraph.jit — compile imperative code (reference: dygraph/jit.py
+TracedLayer:224, declarative:121 + dygraph_to_static/).
 
-TPU inversion: the reference re-traces Python into a ProgramDesc; here the
-natural compile target is jax.jit directly — the layer's forward becomes a
-pure function of (params, inputs) and XLA compiles it once per shape."""
+Two compile paths, both ending in one XLA computation:
+
+* ``TracedLayer`` — data-flow-only layers traced straight into ``jax.jit``
+  over (params, inputs);
+* ``@declarative`` — the full dygraph_to_static pipeline: AST transpile of
+  tensor control flow (if/while/for → cond/while ops → lax.cond /
+  lax.while_loop), static Program build, jit of the whole program, exact
+  grads via jax.vjp through the run_program_dy tape op.
+"""
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, List
 
 import numpy as np
@@ -14,10 +19,14 @@ import jax
 import jax.numpy as jnp
 
 from .. import framework
+from ..core import Scope
 from .base import VarBase, guard
 from .layers import Layer
+from .dygraph_to_static import (declarative, ProgramTranslator,
+                                StaticFunction)
 
-__all__ = ["TracedLayer", "declarative", "dygraph_to_static_func"]
+__all__ = ["TracedLayer", "declarative", "dygraph_to_static_func",
+           "ProgramTranslator"]
 
 
 def _functionalize(layer: Layer):
@@ -42,18 +51,20 @@ def _functionalize(layer: Layer):
 
 
 class TracedLayer:
-    """reference dygraph/jit.py:224 — here a jax.jit wrapper with the same
-    static_graph-deployable contract (save_inference_model exports a
-    Program via the static re-trace, pending)."""
+    """reference dygraph/jit.py:224 — a jax.jit wrapper with the same
+    static-graph-deployable contract: save_inference_model re-traces the
+    layer's forward into a static Program via dygraph_to_static."""
 
     def __init__(self, layer: Layer):
         self._layer = layer
         self._fn, self._named = _functionalize(layer)
         self._jitted = jax.jit(self._fn)
+        self._input_spec: List[VarBase] = []
 
     @staticmethod
     def trace(layer: Layer, inputs: List[VarBase]):
         tl = TracedLayer(layer)
+        tl._input_spec = list(inputs)
         outs = tl(*inputs)
         return outs, tl
 
@@ -67,21 +78,33 @@ class TracedLayer:
         return VarBase(outs, stop_gradient=True)
 
     def save_inference_model(self, dirname, feed=None, fetch=None):
-        raise NotImplementedError(
-            "TracedLayer.save_inference_model: static re-trace pending "
-            "(dygraph_to_static batch)")
-
-
-def declarative(fn):
-    """@declarative — compile an imperative function with jax.jit on first
-    call (reference dygraph/jit.py:121 builds a static program instead)."""
-    jitted = {}
-
-    @functools.wraps(fn)
-    def wrapper(*args, **kwargs):
-        return fn(*args, **kwargs)  # eager; jit handled by TracedLayer path
-    wrapper._is_declarative = True
-    return wrapper
+        """Export a deployable static Program + params (reference
+        TracedLayer.save_inference_model): re-trace the layer's forward
+        through dygraph_to_static, then io.save_inference_model."""
+        if not self._input_spec:
+            raise RuntimeError(
+                "TracedLayer.save_inference_model requires the layer to "
+                "have been built via TracedLayer.trace(layer, inputs)")
+        from .. import io as fluid_io
+        from ..executor import Executor, scope_guard
+        from ..core import LoDTensor
+        sf = declarative(type(self._layer).forward)
+        cp = sf.concrete_program(self._layer, *self._input_spec)
+        block = cp.main_program.global_block()
+        feed_names = list(cp.feed_names)
+        if feed is not None:
+            feed_names = [feed_names[i] for i in feed]
+        targets = [block.vars[n] for n in cp.fetch_names]
+        if fetch is not None:
+            targets = [targets[i] for i in fetch]
+        scope = Scope()
+        for n, p in cp.param_vars.items():
+            scope.var(n).set_value(LoDTensor(p._array))
+        exe = Executor()
+        with framework._dygraph_guard(None), scope_guard(scope):
+            return fluid_io.save_inference_model(
+                dirname, feed_names, targets, exe,
+                main_program=cp.main_program)
 
 
 dygraph_to_static_func = declarative

@@ -23,6 +23,26 @@ __all__ = ["Conv2D", "Conv3D", "Pool2D", "Linear", "BatchNorm", "Dropout",
 
 def _op(type_, ins, outs_spec, attrs):
     tracer = framework._dygraph_tracer()
+    if tracer is None:
+        # to-static trace in progress (dygraph_to_static): build the op into
+        # the current static program. Inputs may be static Variables or
+        # VarBase parameters — ops record names either way; the program
+        # translator registers matching persistable vars for the params.
+        from ..layer_helper import LayerHelper
+        helper = LayerHelper(type_)
+        dtype = None
+        for vals in ins.values():
+            for v in vals or []:
+                if v is not None and dtype is None:
+                    dtype = v.dtype
+        outs = {slot: [helper.create_variable_for_type_inference(
+                    dtype if dtype is not None else VarDesc.VarType.FP32)
+                    for _ in range(n)]
+                for slot, n in outs_spec.items()}
+        helper.append_op(type=type_, inputs=ins, outputs=outs, attrs=attrs)
+        first_slot = next(iter(outs.values()), [None])
+        return first_slot[0] if len(outs) == 1 and len(first_slot) == 1 \
+            else outs
     outs = {slot: [VarBase(None) for _ in range(n)]
             for slot, n in outs_spec.items()}
     res = tracer.trace_op(type_, ins, outs, attrs)

@@ -79,6 +79,27 @@ def _op_is_stateful(op) -> bool:
     return True  # unknown op: be safe, run eagerly (will raise with context)
 
 
+# control-flow ops the compiled path lowers to lax primitives instead of
+# scope interpretation (see _CompiledBlock._exec_ops)
+_LOWERED_CONTROL = frozenset({"while", "conditional_block",
+                              "conditional_block_infer", "select_input"})
+
+
+def _ops_compilable(ops) -> bool:
+    """True if every op either has a pure kernel or is control flow whose
+    sub-blocks are themselves compilable."""
+    for op in ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        if op.type in _LOWERED_CONTROL:
+            sub = op.attrs.get("sub_block")
+            if sub is not None and not _ops_compilable(sub.ops):
+                return False
+        elif _op_is_stateful(op):
+            return False
+    return True
+
+
 # ------------------------------------------------------------------ LoD
 # LoD (variable-length sequence) metadata rides NEXT TO arrays as
 # host-static nested tuples; under jit it is trace-time constant (the jit
@@ -183,6 +204,16 @@ class _CompiledBlock:
                         f"'{op.type}') is not initialized in the scope — "
                         f"run the startup program first")
             written.update(op.output_arg_names)
+            sub = op.attrs.get("sub_block")
+            if sub is not None:
+                stack = [sub]
+                while stack:
+                    b = stack.pop()
+                    for sop in b.ops:
+                        written.update(sop.output_arg_names)
+                        sb = sop.attrs.get("sub_block")
+                        if sb is not None:
+                            stack.append(sb)
         self.written = written
         # state vars that get overwritten -> donated & written back
         self.mut_state = tuple(n for n in state_names if n in written)
@@ -209,12 +240,121 @@ class _CompiledBlock:
         env.update(mut_state)
         env.update(feeds)
         lod_env: Dict[str, tuple] = dict(self._init_lods)
-        for idx, op in enumerate(self.ops):
+        self._exec_ops(self.ops, env, lod_env, rng)
+        fetches = []
+        for i, n in enumerate(self.fetch_names):
+            if n not in env:
+                raise KeyError(f"fetch var '{n}' not produced by program")
+            fetches.append(env[n])
+            self.fetch_lods[i] = lod_env.get(n)
+        new_mut = {n: env[n] for n in self.mut_state}
+        extra = {n: env[n] for n in self.extra_writeback if n in env}
+        return fetches, new_mut, extra
+
+    # -------------------------------------------------- control-flow lowering
+    # The reference interprets while/conditional_block by re-entering the
+    # scope-based executor on the sub-block (while_op.cc,
+    # conditional_block_op.cc). Compiled lowering instead: conditional
+    # branches trace unconditionally and merge at select_input (on TPU a
+    # vectorized select is the idiomatic lowering — lax.cond frequently
+    # becomes a select anyway), and `while` becomes lax.while_loop with the
+    # loop-carried names as the carry dict.
+    def _exec_while(self, op, env, lod_env, rng):
+        import jax.lax as lax
+        sub = op.attrs["sub_block"]
+        cond_name = op.inputs["Condition"][0]
+        x_names = list(op.inputs.get("X", []))
+        written = set()
+        for sop in sub.ops:
+            written.update(sop.output_arg_names)
+        out_names = [n for n in op.outputs.get("Out", []) if n in env]
+        carry_names = sorted({cond_name}
+                             | set(out_names)
+                             | {n for n in x_names
+                                if n in written and n in env})
+        missing = [n for n in carry_names if n not in env]
+        if missing:
+            raise KeyError(
+                f"while op reads undefined vars {missing} — outer program "
+                f"did not produce them")
+        base_env = dict(env)
+        sub_ops = sub.ops
+        _IT = "@while_iter@"  # loop counter so per-iteration RNG differs
+
+        def cond_fn(carry):
+            return jnp.reshape(carry[cond_name], ()).astype(bool)
+
+        def body_fn(carry):
+            e = dict(base_env)
+            it = carry[_IT]
+            e.update({n: v for n, v in carry.items() if n != _IT})
+            le = dict(lod_env)
+            self._exec_ops(sub_ops, e, le, jax.random.fold_in(rng, it))
+            out = {n: e[n] for n in carry_names}
+            out[_IT] = it + 1
+            return out
+
+        init = {n: env[n] for n in carry_names}
+        init[_IT] = jnp.zeros((), jnp.int32)
+        final = lax.while_loop(cond_fn, body_fn, init)
+        final.pop(_IT, None)
+        env.update(final)
+
+    def _exec_ops(self, ops, env, lod_env, rng):
+        for idx, op in enumerate(ops):
+            otype = op.type
+            if otype == "while":
+                self._exec_while(op, env, lod_env, rng)
+                continue
+            if otype in ("conditional_block", "conditional_block_infer"):
+                # Trace the branch unconditionally on an env COPY (both-
+                # branch compute = TPU select idiom), then mask-merge any
+                # write to a pre-existing outer var so the untaken branch
+                # cannot clobber state; fresh vars flow through for
+                # select_input to pick.
+                branch_env = dict(env)
+                self._exec_ops(op.attrs["sub_block"].ops, branch_env,
+                               lod_env, rng)
+                cnames = op.inputs.get("Cond") or []
+                mask = (jnp.reshape(env[cnames[0]], ()) != 0) \
+                    if cnames and cnames[0] in env else None
+                for n, v in branch_env.items():
+                    old = env.get(n)
+                    if old is v:
+                        continue
+                    if old is None or mask is None:
+                        env[n] = v
+                    elif getattr(old, "shape", None) == getattr(v, "shape",
+                                                                None):
+                        env[n] = jnp.where(mask, v, old)
+                    else:
+                        raise NotImplementedError(
+                            f"conditional_block branch changes the shape of "
+                            f"outer var '{n}' ({getattr(old, 'shape', None)}"
+                            f" -> {getattr(v, 'shape', None)}); conditional "
+                            f"shape-changing writes cannot be compiled — "
+                            f"produce a new variable instead")
+                continue
+            if otype == "select_input":
+                mask = jnp.reshape(env[op.inputs["Mask"][0]], ()) != 0
+                xf = env.get(op.inputs["X"][0])
+                xt = env.get(op.inputs["X"][1])
+                if xf is None or xt is None:
+                    picked = xt if xf is None else xf
+                elif xt.shape == xf.shape:
+                    picked = jnp.where(mask, xt, xf)
+                else:
+                    raise NotImplementedError(
+                        f"cond branches produce different shapes "
+                        f"({xt.shape} vs {xf.shape}) for the same output — "
+                        f"XLA needs matching branch shapes; pad or "
+                        f"restructure the branches")
+                env[op.outputs["Out"][0]] = picked
+                continue
             ins = {}
             for slot, names in op.inputs.items():
                 ins[slot] = [env.get(n) for n in names]
             attrs = op.attrs
-            otype = op.type
             in_lods = _collect_in_lods(op, lod_env.get)
             if _op_needs_lod(op):
                 attrs = dict(attrs)
@@ -254,15 +394,6 @@ class _CompiledBlock:
                 lod_env.__setitem__,
                 lambda n: (env[n].shape[0] if n in env and
                            getattr(env[n], "ndim", 0) else None))
-        fetches = []
-        for i, n in enumerate(self.fetch_names):
-            if n not in env:
-                raise KeyError(f"fetch var '{n}' not produced by program")
-            fetches.append(env[n])
-            self.fetch_lods[i] = lod_env.get(n)
-        new_mut = {n: env[n] for n in self.mut_state}
-        extra = {n: env[n] for n in self.extra_writeback if n in env}
-        return fetches, new_mut, extra
 
     def run(self, scope: Scope, feeds: Dict[str, Any], rng):
         mut = {n: scope.find_var(n).get_tensor().array for n in self.mut_state}
@@ -350,11 +481,8 @@ class Executor:
                 feed_lods[name] = lv
 
         mode = core.globals_["FLAGS_executor_mode"]
-        has_stateful = any(_op_is_stateful(op) for op in
-                           program.global_block().ops
-                           if op.type not in ("feed", "fetch"))
-        compiled_ok = (mode == "compiled" and not has_stateful
-                       and program.num_blocks == 1)
+        compiled_ok = (mode == "compiled"
+                       and _ops_compilable(program.global_block().ops))
 
         if compiled_ok:
             key = (id(program), program._version, tuple(sorted(feed)),

@@ -364,7 +364,10 @@ def _normalize_slots(slots) -> Dict[str, List[str]]:
             continue
         if not isinstance(args, (list, tuple)):
             args = [args]
-        res[slot] = [a.name if isinstance(a, Variable) else str(a) for a in args]
+        # anything with a .name (static Variable, dygraph VarBase during a
+        # to-static trace) records by name; bare strings pass through
+        res[slot] = [a if isinstance(a, str) else getattr(a, "name", None)
+                     or str(a) for a in args]
     return res
 
 
