@@ -1,0 +1,274 @@
+"""fleet pslib mode — massive-sparse parameter server (reference:
+incubate/fleet/parameter_server/pslib/__init__.py — PSLib:28 wrapping the
+external Baidu PSLib downpour server via FleetWrapper, fleet_wrapper.h:86).
+
+TPU-native replacement: the downpour tables are the in-repo host-RAM
+sparse tables (sparse_table.py) sharded by feature id across pserver
+processes and served over the ps_rpc TCP plane; the dense model never
+leaves the chip. Same fleet API surface: init/init_worker/init_server/
+run_server, distributed_optimizer → DownpourOptimizer, table save/load/
+shrink/clear/stat."""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...base.fleet_base import Fleet, DistributedOptimizer, Mode
+from .node import DownpourServer, DownpourWorker
+from .sparse_table import (DownpourSparseTable, DownpourDenseTable,
+                           TableRegistry)
+from .optimizer_factory import DistributedAdam
+
+__all__ = ["PSLib", "fleet", "DownpourOptimizer", "DownpourServer",
+           "DownpourWorker", "DownpourSparseTable", "TableRegistry"]
+
+
+class _PslibRuntime:
+    """Routes table ops: local registry (single host / server process) or
+    id-sharded RPC to pserver endpoints (worker in a multi-host job)."""
+
+    def __init__(self):
+        self.registry = TableRegistry()
+        self.specs: Dict[int, dict] = {}
+        self.endpoints: List[str] = []
+        self._remote = False
+
+    def register_table_spec(self, tid: int, emb_dim: int,
+                            optimizer: str = "sgd",
+                            learning_rate: float = 0.05,
+                            initial_range: float = 0.01):
+        self.specs[tid] = {"emb_dim": emb_dim, "optimizer": optimizer,
+                           "learning_rate": learning_rate,
+                           "initial_range": initial_range}
+        if tid not in self.registry.sparse:
+            self.registry.add_sparse(DownpourSparseTable(
+                tid, emb_dim, optimizer, learning_rate,
+                initial_range=initial_range))
+
+    def connect(self, endpoints: List[str]):
+        self.endpoints = list(endpoints)
+        self._remote = bool(endpoints)
+
+    def disconnect(self):
+        self._remote = False
+        self.endpoints = []
+
+    # ------------------------------------------------------ pull / push
+    def pull(self, tid: int, ids: np.ndarray) -> np.ndarray:
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        if not self._remote:
+            return self.registry.sparse[tid].pull(flat)
+        from .....ps_rpc import VarClient
+        n = len(self.endpoints)
+        shard = flat % n
+        dim = self.specs[tid]["emb_dim"]
+        out = np.zeros((flat.size, dim), np.float32)
+        for s, ep in enumerate(self.endpoints):
+            mask = shard == s
+            if not mask.any():
+                continue
+            rows = VarClient.of(ep).call("pslib_pull", tid=tid,
+                                         ids=flat[mask].tolist())
+            out[mask] = np.asarray(rows, np.float32)
+        return out
+
+    def push(self, tid: int, ids: np.ndarray, grads: np.ndarray):
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        dim = self.specs[tid]["emb_dim"]
+        grads = np.asarray(grads, np.float32).reshape(-1, dim)
+        if not self._remote:
+            self.registry.sparse[tid].push(flat, grads)
+            return
+        from .....ps_rpc import VarClient
+        n = len(self.endpoints)
+        shard = flat % n
+        for s, ep in enumerate(self.endpoints):
+            mask = shard == s
+            if not mask.any():
+                continue
+            VarClient.of(ep).call("pslib_push", tid=tid,
+                                  ids=flat[mask].tolist(),
+                                  grads=grads[mask])
+
+
+_runtime = _PslibRuntime()
+
+
+class DownpourOptimizer(DistributedOptimizer):
+    """reference __init__.py DownpourOptimizer — delegates to the
+    DistributedAdam factory, stores worker/server descriptors on fleet."""
+
+    def __init__(self, optimizer, strategy=None, fleet_ref=None):
+        super().__init__(optimizer, strategy or {})
+        self._impl = DistributedAdam(optimizer)
+        self._fleet_ref = fleet_ref
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, losses, scopes=None, startup_programs=None,
+                 parameter_list=None, no_grad_set=None):
+        opt_ops, params_grads, descs = self._impl.minimize(
+            losses, startup_programs, parameter_list, no_grad_set,
+            strategy=self._strategy)
+        owner = self._fleet_ref if self._fleet_ref is not None else fleet
+        owner._server_desc, owner._worker_desc = descs
+        if owner is not fleet:  # keep the module singleton in sync too
+            fleet._server_desc, fleet._worker_desc = descs
+        return opt_ops, params_grads
+
+
+class PSLib(Fleet):
+    def __init__(self):
+        super().__init__(Mode.PSLIB)
+        self._server_desc = None
+        self._worker_desc = None
+        self._server = None
+        self._main_programs = []
+
+    # ------------------------------------------------------------- roles
+    def init_worker(self):
+        """Connect to the pserver shard ring (reference :57 — starts the
+        PSLib client + barriers)."""
+        eps = self._role_maker.get_pserver_endpoints() or []
+        if len(eps) > 0 and self._role_maker.server_num() > 0:
+            _runtime.connect(eps)
+
+    def run_worker(self, main_programs=None, scopes=None):
+        self._main_programs = main_programs or []
+
+    def init_server(self, model_dir: Optional[str] = None, **kwargs):
+        """Materialize tables from the descriptors; optionally warm-start
+        (reference :134)."""
+        desc = self._server_desc or {"sparse_tables": {}}
+        for tid, spec in desc["sparse_tables"].items():
+            _runtime.register_table_spec(
+                tid, spec["emb_dim"], spec["optimizer"],
+                spec["learning_rate"],
+                spec.get("initial_range", 0.01))
+        if model_dir:
+            _runtime.registry.load_model(model_dir)
+
+    def run_server(self):
+        """Serve this shard's tables over ps_rpc (reference :156)."""
+        from .....ps_rpc import VarServer, ReduceService
+        idx = self._role_maker.server_index()
+        ep = self._role_maker.get_pserver_endpoints()[idx]
+        reg = _runtime.registry
+
+        def _pull(tid, ids):
+            return reg.sparse[tid].pull(ids)
+
+        def _push(tid, ids, grads):
+            reg.sparse[tid].push(ids, np.asarray(grads))
+            return True
+
+        def _stat(tid):
+            return reg.sparse[tid].stat()
+
+        def _shrink(tid, max_idle_seconds):
+            return reg.sparse[tid].shrink(max_idle_seconds)
+
+        def _save(dirname):
+            reg.save_model(dirname)
+            return True
+
+        port = ep.rsplit(":", 1)[1]
+        handlers = {
+            "pslib_pull": _pull, "pslib_push": _push,
+            "pslib_stat": _stat, "pslib_shrink": _shrink,
+            "pslib_save": _save}
+        handlers.update(ReduceService().handlers())  # FleetUtil reductions
+        self._server = VarServer(f"0.0.0.0:{port}", handlers).start()
+        return self._server
+
+    def stop_worker(self):
+        _runtime.disconnect()
+
+    def stop_server(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+    # --------------------------------------------------------- optimizer
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = DownpourOptimizer(optimizer, strategy,
+                                            fleet_ref=self)
+        return self._optimizer
+
+    # ------------------------------------------------------- save / load
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from ..... import io as fluid_io
+        return fluid_io.save_inference_model(dirname, feeded_var_names,
+                                             target_vars, executor,
+                                             main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None,
+                          **kwargs):
+        from ..... import io as fluid_io
+        fluid_io.save_persistables(executor, dirname, main_program)
+        self.save_model(dirname)
+
+    def save_model(self, model_dir=None, **kwargs):
+        """Snapshot the sparse tables (reference :617)."""
+        _runtime.registry.save_model(model_dir)
+
+    def load_model(self, model_dir=None, **kwargs):
+        _runtime.registry.load_model(model_dir)
+
+    def save_cache_model(self, executor, dirname, main_program=None,
+                         cache_threshold: int = 0, **kwargs):
+        """Export only hot rows for the serving cache (reference :301 —
+        PSLib's cache table). Here: rows touched most recently first,
+        keeping ``cache_threshold`` rows per table (0 = all)."""
+        os.makedirs(dirname, exist_ok=True)
+        import pickle
+        for tid, t in _runtime.registry.sparse.items():
+            with t._lock:
+                items = sorted(t._last_seen.items(), key=lambda kv: -kv[1])
+                if cache_threshold:
+                    items = items[:cache_threshold]
+                rows = {i: t._rows[i] for i, _ in items if i in t._rows}
+            with open(os.path.join(dirname, f"cache_table_{tid}.pkl"),
+                      "wb") as f:
+                pickle.dump({"emb_dim": t.emb_dim, "rows": rows}, f)
+        return sum(len(t._rows) for t in _runtime.registry.sparse.values())
+
+    # ----------------------------------------------------- table control
+    def print_table_stat(self, table_id):
+        st = _runtime.registry.sparse[table_id].stat()
+        print(f"table {table_id}: rows={st['row_count']} "
+              f"mem={st['mem_bytes']}B dim={st['emb_dim']}")
+        return st
+
+    def shrink_sparse_table(self, max_idle_seconds: float = 0.0):
+        return {tid: t.shrink(max_idle_seconds)
+                for tid, t in _runtime.registry.sparse.items()}
+
+    def shrink_dense_table(self, decay, emb_dim=11, scope=None,
+                           table_id=None):
+        for tid, t in _runtime.registry.dense.items():
+            if table_id is not None and tid != table_id:
+                continue
+            with t._lock:
+                for n in t._params:
+                    t._params[n] *= decay
+
+    def clear_one_table(self, table_id):
+        _runtime.registry.sparse[table_id].clear()
+
+    def clear_model(self):
+        for t in _runtime.registry.sparse.values():
+            t.clear()
+
+
+fleet = PSLib()

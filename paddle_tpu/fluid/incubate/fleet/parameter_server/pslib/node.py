@@ -1,0 +1,98 @@
+"""Downpour server/worker descriptors (reference: pslib/node.py —
+DownpourServer:38 add_sparse_table/add_dense_table, DownpourWorker:~).
+
+The reference emits protobuf ps.proto descriptors consumed by the external
+Baidu PSLib binary; here the descriptors are plain dicts that configure the
+in-repo host-RAM table service (sparse_table.py) — same knobs (table id,
+accessor class, emb dim, lr), TPU-native backend."""
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = ["Server", "Worker", "DownpourServer", "DownpourWorker"]
+
+_ACCESSOR_TO_OPT = {
+    "DownpourSparseValueAccessor": "sgd",
+    "DownpourCtrAccessor": "adagrad",
+    "DownpourCtrDoubleAccessor": "adagrad",
+    "DownpourUnitAccessor": "adam",
+    "DownpourDoubleUnitAccessor": "adam",
+}
+
+
+class Server:
+    def __init__(self):
+        self._desc: Dict = {"sparse_tables": {}, "dense_tables": {},
+                            "service": {"server_class": "TpuPsServer",
+                                        "client_class": "TpuPsClient"}}
+
+    def get_desc(self):
+        return self._desc
+
+
+class Worker:
+    def __init__(self):
+        self._desc: Dict = {"sparse_tables": {}, "dense_tables": {}}
+
+    def get_desc(self):
+        return self._desc
+
+
+class DownpourServer(Server):
+    """reference node.py:38 — accumulates table descriptors."""
+
+    def add_sparse_table(self, table_id: int, strategy: Dict = None,
+                         emb_dim: int = 8, learning_rate: float = 0.05):
+        strategy = dict(strategy or {})
+        accessor = strategy.get("sparse_accessor_class",
+                                "DownpourSparseValueAccessor")
+        if accessor not in _ACCESSOR_TO_OPT:
+            raise ValueError(
+                f"unsupported accessor {accessor}; one of "
+                f"{sorted(_ACCESSOR_TO_OPT)}")
+        self._desc["sparse_tables"][int(table_id)] = {
+            "table_id": int(table_id),
+            "emb_dim": int(strategy.get("sparse_embedx_dim", emb_dim)),
+            "optimizer": _ACCESSOR_TO_OPT[accessor],
+            "accessor_class": accessor,
+            "learning_rate": float(
+                strategy.get("sparse_learning_rate", learning_rate)),
+            "initial_range": float(
+                strategy.get("sparse_initial_range", 1e-4)),
+        }
+
+    def add_dense_table(self, table_id: int, param_shapes: Dict[str, tuple],
+                        learning_rate: float = 0.05, strategy: Dict = None):
+        strategy = dict(strategy or {})
+        self._desc["dense_tables"][int(table_id)] = {
+            "table_id": int(table_id),
+            "param_shapes": {k: tuple(v) for k, v in param_shapes.items()},
+            "learning_rate": float(
+                strategy.get("dense_learning_rate", learning_rate)),
+        }
+
+
+class DownpourWorker(Worker):
+    """reference node.py DownpourWorker — mirrors the tables the worker
+    pulls/pushes."""
+
+    def __init__(self, window: int = 1):
+        super().__init__()
+        self.window = window
+
+    def add_sparse_table(self, table_id: int, slot_key_vars=None,
+                         slot_value_vars=None):
+        self._desc["sparse_tables"][int(table_id)] = {
+            "table_id": int(table_id),
+            "slot_key": [getattr(v, "name", v) for v in slot_key_vars or []],
+            "slot_value": [getattr(v, "name", v)
+                           for v in slot_value_vars or []],
+        }
+
+    def add_dense_table(self, table_id: int, learning_rate: float = 0.05,
+                        param_vars=None, grad_vars=None):
+        self._desc["dense_tables"][int(table_id)] = {
+            "table_id": int(table_id),
+            "params": [getattr(v, "name", v) for v in param_vars or []],
+            "grads": [getattr(v, "name", v) for v in grad_vars or []],
+        }

@@ -1,0 +1,133 @@
+"""DownpourOptimizer program rewrite (reference:
+pslib/optimizer_factory.py — DistributedAdam:68 finds the
+distributed-lookup-table inputs/outputs/grads in the program and emits the
+worker/server descriptors).
+
+Rewrite performed here (TPU framing — dense math stays one jitted XLA step
+on the chip; only the beyond-HBM sparse tables leave the graph):
+
+  lookup_table(W, is_distributed=True)      →  pslib_pull_sparse(Ids)
+  lookup_table_grad + W's optimizer-update  →  pslib_push_sparse(Ids, G)
+
+Each rewritten embedding param becomes a DownpourSparseTable on the PS
+side; everything else trains unchanged."""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from .node import DownpourServer, DownpourWorker
+
+__all__ = ["DistributedOptimizerImplBase", "DistributedAdam"]
+
+_SPARSE_OPS = ("lookup_table", "lookup_table_v2")
+
+
+class DistributedOptimizerImplBase:
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def minimize(self, losses, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        raise NotImplementedError
+
+
+class DistributedAdam(DistributedOptimizerImplBase):
+    """reference optimizer_factory.py:68 — despite the name it wraps any
+    inner optimizer; 'Adam' is the default server-side accessor."""
+
+    def __init__(self, optimizer):
+        super().__init__(optimizer)
+        self.supported_embedding_types = list(_SPARSE_OPS)
+
+    # ------------------------------------------------------------ scans
+    def _find_sparse_params(self, program) -> Dict[str, List]:
+        """{embedding param name: [its lookup ops]} for is_distributed
+        lookups (reference :91 _find_distributed_lookup_table_inputs)."""
+        found: Dict[str, List] = {}
+        for op in program.global_block().ops:
+            if op.type in _SPARSE_OPS and op.attrs.get("is_distributed"):
+                w = op.input("W")[0]
+                found.setdefault(w, []).append(op)
+        return found
+
+    # ---------------------------------------------------------- rewrite
+    def minimize(self, losses, startup_program=None, parameter_list=None,
+                 no_grad_set=None, strategy=None):
+        from . import _runtime
+        strategy = dict(strategy or {})
+        if not isinstance(losses, (list, tuple)):
+            losses = [losses]
+        loss = losses[0]
+        program = loss.block.program
+        opt_ops, params_grads = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+
+        server = DownpourServer()
+        worker = DownpourWorker()
+        sparse = self._find_sparse_params(program)
+        block = program.global_block()
+        table_ids: Dict[str, int] = {}
+        for tid, (w_name, lookups) in enumerate(sorted(sparse.items())):
+            emb_dim = int(block.vars[w_name].shape[-1])
+            server.add_sparse_table(
+                tid, dict(strategy, sparse_embedx_dim=emb_dim))
+            worker.add_sparse_table(
+                tid,
+                slot_key_vars=[lookups[0].input("Ids")[0]],
+                slot_value_vars=[lookups[0].output("Out")[0]])
+            table_ids[w_name] = tid
+            spec = server.get_desc()["sparse_tables"][tid]
+            _runtime.register_table_spec(
+                tid, emb_dim, optimizer=spec["optimizer"],
+                learning_rate=spec["learning_rate"],
+                initial_range=spec["initial_range"])
+
+        if table_ids:
+            self._rewrite_program(program, table_ids)
+
+        return opt_ops, params_grads, (server.get_desc(), worker.get_desc())
+
+    def _rewrite_program(self, program, table_ids: Dict[str, int]):
+        block = program.global_block()
+        new_ops = []
+        grad_of = {w + "@GRAD" for w in table_ids}
+        for op in block.ops:
+            if op.type in _SPARSE_OPS and op.attrs.get("is_distributed") \
+                    and op.input("W")[0] in table_ids:
+                w = op.input("W")[0]
+                op.type = "pslib_pull_sparse"
+                op.inputs = {"Ids": op.input("Ids")}
+                op.attrs = {"TableId": table_ids[w],
+                            "EmbeddingDim":
+                                int(block.vars[w].shape[-1]),
+                            "padding_idx": op.attrs.get("padding_idx", -1)}
+                new_ops.append(op)
+                continue
+            if op.type in tuple(t + "_grad" for t in _SPARSE_OPS) \
+                    and op.input("W") and op.input("W")[0] in table_ids:
+                # grad wrt the table rows: push instead of materializing a
+                # dense W@GRAD
+                w = op.input("W")[0]
+                pad = op.attrs.get("padding_idx", -1)
+                op.type = "pslib_push_sparse"
+                op.inputs = {"Ids": op.input("Ids"),
+                             "Grads": op.input("Out@GRAD")}
+                op.outputs = {}
+                op.attrs = {"TableId": table_ids[w],
+                            "EmbeddingDim":
+                                int(block.vars[w].shape[-1]),
+                            "padding_idx": pad}
+                new_ops.append(op)
+                continue
+            # drop the dense optimizer update of a PS-held param
+            if op.input_names and "Param" in op.inputs \
+                    and op.inputs["Param"] \
+                    and op.inputs["Param"][0] in table_ids:
+                continue
+            # drop ops consuming the (now absent) dense W@GRAD
+            if any(n in grad_of for n in op.output_arg_names) \
+                    or any(n in grad_of for n in op.input_arg_names):
+                continue
+            new_ops.append(op)
+        block.ops = new_ops
+        program._version += 1

@@ -1,0 +1,215 @@
+"""Downpour sparse tables — host-RAM id→row embedding store with built-in
+optimizers (TPU-native replacement for the reference's external Baidu PSLib
+C++ server the pslib fleet mode wraps: fleet_wrapper.h:86-190 pull/push,
+node.py DownpourServer table descriptors).
+
+Design: TPU HBM holds the dense model; beyond-HBM sparse embeddings live in
+host RAM sharded by id across trainer hosts (id % shard_num). Rows are
+created on first touch (lazy init), updated by the table's accessor rule
+(sgd / adagrad / adam — the reference's DownpourSparseTable accessors), and
+can be shrunk by last-seen time, saved/loaded, and served over the ps_rpc
+plane for multi-host."""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["DownpourSparseTable", "DownpourDenseTable", "TableRegistry"]
+
+
+class DownpourSparseTable:
+    """One sparse table (reference: DownpourServer.add_sparse_table —
+    pslib/node.py:55)."""
+
+    def __init__(self, table_id: int, emb_dim: int, optimizer: str = "sgd",
+                 learning_rate: float = 0.05, initial_range: float = 0.01,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8, seed: int = 0):
+        self.table_id = table_id
+        self.emb_dim = int(emb_dim)
+        self.optimizer = optimizer
+        self.lr = float(learning_rate)
+        self.initial_range = float(initial_range)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self._rows: Dict[int, np.ndarray] = {}
+        self._moments: Dict[int, np.ndarray] = {}
+        self._moments2: Dict[int, np.ndarray] = {}
+        self._step: Dict[int, int] = {}
+        self._last_seen: Dict[int, float] = {}
+        self._rng = np.random.RandomState(seed + table_id)
+        self._lock = threading.RLock()
+
+    # ----------------------------------------------------------- pull/push
+    def _row(self, fid: int) -> np.ndarray:
+        row = self._rows.get(fid)
+        if row is None:
+            row = self._rng.uniform(-self.initial_range, self.initial_range,
+                                    self.emb_dim).astype(np.float32)
+            self._rows[fid] = row
+        self._last_seen[fid] = time.time()
+        return row
+
+    def pull(self, ids: Sequence[int]) -> np.ndarray:
+        with self._lock:
+            return np.stack([self._row(int(i)) for i in ids]) \
+                if len(ids) else np.zeros((0, self.emb_dim), np.float32)
+
+    def push(self, ids: Sequence[int], grads: np.ndarray):
+        """Apply grads row-wise under the table's accessor rule. Duplicate
+        ids accumulate (reference sparse push semantics)."""
+        grads = np.asarray(grads, np.float32).reshape(-1, self.emb_dim)
+        with self._lock:
+            agg: Dict[int, np.ndarray] = {}
+            for i, g in zip(ids, grads):
+                i = int(i)
+                if i in agg:
+                    agg[i] = agg[i] + g
+                else:
+                    agg[i] = g.copy()
+            for i, g in agg.items():
+                row = self._row(i)
+                if self.optimizer == "sgd" or self.optimizer == "naive":
+                    row -= self.lr * g
+                elif self.optimizer == "adagrad":
+                    m = self._moments.setdefault(
+                        i, np.zeros(self.emb_dim, np.float32))
+                    m += g * g
+                    row -= self.lr * g / (np.sqrt(m) + self.epsilon)
+                elif self.optimizer == "adam":
+                    m = self._moments.setdefault(
+                        i, np.zeros(self.emb_dim, np.float32))
+                    v = self._moments2.setdefault(
+                        i, np.zeros(self.emb_dim, np.float32))
+                    t = self._step.get(i, 0) + 1
+                    self._step[i] = t
+                    m[:] = self.beta1 * m + (1 - self.beta1) * g
+                    v[:] = self.beta2 * v + (1 - self.beta2) * g * g
+                    mhat = m / (1 - self.beta1 ** t)
+                    vhat = v / (1 - self.beta2 ** t)
+                    row -= self.lr * mhat / (np.sqrt(vhat) + self.epsilon)
+                else:
+                    raise ValueError(f"unknown accessor {self.optimizer}")
+
+    # ----------------------------------------------------------- lifecycle
+    def shrink(self, max_idle_seconds: Optional[float] = None,
+               keep_ids: Optional[set] = None) -> int:
+        """Drop rows idle longer than the threshold (reference
+        shrink_sparse_table)."""
+        with self._lock:
+            now = time.time()
+            drop = [i for i, seen in self._last_seen.items()
+                    if (max_idle_seconds is not None
+                        and now - seen > max_idle_seconds)
+                    and (keep_ids is None or i not in keep_ids)]
+            for i in drop:
+                self._rows.pop(i, None)
+                self._moments.pop(i, None)
+                self._moments2.pop(i, None)
+                self._step.pop(i, None)
+                self._last_seen.pop(i, None)
+            return len(drop)
+
+    def clear(self):
+        with self._lock:
+            self._rows.clear()
+            self._moments.clear()
+            self._moments2.clear()
+            self._step.clear()
+            self._last_seen.clear()
+
+    def stat(self) -> Dict[str, float]:
+        with self._lock:
+            mem = sum(r.nbytes for r in self._rows.values())
+            return {"row_count": len(self._rows), "mem_bytes": mem,
+                    "emb_dim": self.emb_dim}
+
+    def save(self, path: str):
+        with self._lock, open(path, "wb") as f:
+            pickle.dump({"emb_dim": self.emb_dim, "rows": self._rows,
+                         "moments": self._moments,
+                         "moments2": self._moments2,
+                         "step": self._step}, f)
+
+    def load(self, path: str):
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+        with self._lock:
+            if data["emb_dim"] != self.emb_dim:
+                raise ValueError(
+                    f"table {self.table_id}: dim {data['emb_dim']} != "
+                    f"{self.emb_dim}")
+            self._rows = data["rows"]
+            self._moments = data.get("moments", {})
+            self._moments2 = data.get("moments2", {})
+            self._step = data.get("step", {})
+            now = time.time()
+            self._last_seen = {i: now for i in self._rows}
+
+
+class DownpourDenseTable:
+    """Dense param table for PS-held dense weights (reference
+    add_dense_table)."""
+
+    def __init__(self, table_id: int, shapes: Dict[str, tuple],
+                 learning_rate: float = 0.05):
+        self.table_id = table_id
+        self.lr = learning_rate
+        self._params = {n: np.zeros(s, np.float32)
+                        for n, s in shapes.items()}
+        self._lock = threading.RLock()
+
+    def pull(self):
+        with self._lock:
+            return {n: p.copy() for n, p in self._params.items()}
+
+    def push(self, grads: Dict[str, np.ndarray]):
+        with self._lock:
+            for n, g in grads.items():
+                self._params[n] -= self.lr * np.asarray(g, np.float32)
+
+    def set(self, values: Dict[str, np.ndarray]):
+        with self._lock:
+            for n, v in values.items():
+                self._params[n] = np.asarray(v, np.float32).copy()
+
+
+class TableRegistry:
+    """Process-local table store, the 'server' of the single-host pslib
+    deployment; multi-host shards it behind ps_rpc.VarServer handlers."""
+
+    def __init__(self):
+        self.sparse: Dict[int, DownpourSparseTable] = {}
+        self.dense: Dict[int, DownpourDenseTable] = {}
+
+    def add_sparse(self, table: DownpourSparseTable):
+        self.sparse[table.table_id] = table
+        return table
+
+    def add_dense(self, table: DownpourDenseTable):
+        self.dense[table.table_id] = table
+        return table
+
+    def save_model(self, dirname: str):
+        os.makedirs(dirname, exist_ok=True)
+        for tid, t in self.sparse.items():
+            t.save(os.path.join(dirname, f"sparse_table_{tid}.pkl"))
+        for tid, t in self.dense.items():
+            with open(os.path.join(dirname, f"dense_table_{tid}.pkl"),
+                      "wb") as f:
+                pickle.dump(t.pull(), f)
+
+    def load_model(self, dirname: str):
+        for tid, t in self.sparse.items():
+            p = os.path.join(dirname, f"sparse_table_{tid}.pkl")
+            if os.path.exists(p):
+                t.load(p)
+        for tid, t in self.dense.items():
+            p = os.path.join(dirname, f"dense_table_{tid}.pkl")
+            if os.path.exists(p):
+                with open(p, "rb") as f:
+                    t.set(pickle.load(f))

@@ -1,0 +1,5 @@
+"""fleet utils (reference: incubate/fleet/utils/)."""
+from .fleet_util import FleetUtil
+from .hdfs import HDFSClient, LocalFS
+
+__all__ = ["FleetUtil", "HDFSClient", "LocalFS"]

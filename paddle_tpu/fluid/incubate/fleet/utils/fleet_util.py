@@ -1,0 +1,190 @@
+"""FleetUtil — distributed training utilities (reference:
+incubate/fleet/utils/fleet_util.py, 1,617 LoC: rank-0 logging, global AUC
+and CTR metrics via GlooWrapper allreduce of local stat arrays, model
+save/load over afs/hdfs).
+
+TPU framing: inside a pod slice, metrics reductions belong IN the jitted
+step (psum over the mesh). This host-side path covers the PS/dataset jobs
+(reference's gloo ring): local stat arrays are summed across workers over
+the ps_rpc plane (or trivially, single-host), then the metric closes the
+same formula the metric ops use."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["FleetUtil"]
+
+
+class FleetUtil:
+    def __init__(self, mode: str = "pslib", fleet=None):
+        self._fleet = fleet
+        if fleet is None:
+            if mode == "pslib":
+                from ..parameter_server.pslib import fleet as f
+            else:
+                from ..collective import fleet as f
+            self._fleet = f
+
+    # ------------------------------------------------------------ logging
+    def rank0_print(self, s: str):
+        """reference fleet_util.py rank0_print."""
+        try:
+            if self._fleet.worker_index() != 0:
+                return
+        except Exception:
+            pass
+        print(s)
+        sys.stdout.flush()
+
+    rank0_info = rank0_print
+
+    def rank0_error(self, s: str):
+        try:
+            if self._fleet.worker_index() != 0:
+                return
+        except Exception:
+            pass
+        print(s, file=sys.stderr)
+
+    # ------------------------------------------------- global reductions
+    def _all_reduce(self, arr: np.ndarray) -> np.ndarray:
+        """Sum a host array across workers. Single-process jobs return the
+        input; multi-host jobs ride the ps_rpc accumulate handler (the
+        reference uses Gloo all_reduce — gloo_wrapper.h:146)."""
+        arr = np.asarray(arr, np.float64)
+        try:
+            n = self._fleet.worker_num()
+        except Exception:
+            n = 1
+        if n <= 1:
+            return arr
+        from ....ps_rpc import VarClient
+        eps = self._fleet.server_endpoints()
+        if not eps:
+            return arr
+        # sum on server 0's ReduceService (the pslib server registers
+        # reduce_push/reduce_get handlers)
+        cli = VarClient.of(eps[0])
+        tid = self._fleet.worker_index()
+        self._reduce_seq = getattr(self, "_reduce_seq", 0) + 1
+        name = f"__fleet_util_reduce_{self._reduce_seq}__"
+        cli.call("reduce_push", name=name, value=arr, trainer_id=tid)
+        return np.asarray(cli.call("reduce_get", name=name, trainer_id=tid,
+                                   world=n))
+
+    # ------------------------------------------------------------ metrics
+    def get_global_auc(self, scope=None, stat_pos: str = "_generated_var_2",
+                       stat_neg: str = "_generated_var_3") -> float:
+        """Close the AUC over the globally-summed threshold histograms
+        (reference fleet_util.py get_global_auc; matches the auc op's
+        StatPos/StatNeg layout — operators/metrics/auc_op)."""
+        from ....executor import global_scope
+        scope = scope or global_scope()
+        pos = self._read(scope, stat_pos)
+        neg = self._read(scope, stat_neg)
+        pos = self._all_reduce(pos)
+        neg = self._all_reduce(neg)
+        from .....utils.metrics import auc_from_histograms
+        return auc_from_histograms(pos, neg)
+
+    def print_global_auc(self, scope=None, stat_pos="_generated_var_2",
+                         stat_neg="_generated_var_3",
+                         print_prefix: str = ""):
+        auc = self.get_global_auc(scope, stat_pos, stat_neg)
+        self.rank0_print(f"{print_prefix} global auc = {auc:.6f}")
+        return auc
+
+    def get_global_metrics(self, scope=None, stat_pos_name=None,
+                           stat_neg_name=None, sqrerr_name=None,
+                           abserr_name=None, prob_name=None, q_name=None,
+                           pos_ins_num_name=None, total_ins_num_name=None):
+        """reference get_global_metrics: returns [auc, bucket_error, mae,
+        rmse, actual_ctr, predicted_ctr, copc, mean_q, pos_ins, total_ins]
+        from globally-summed stat vars."""
+        from ....executor import global_scope
+        scope = scope or global_scope()
+
+        def rd(name):
+            return float(self._all_reduce(
+                self._read(scope, name)).sum()) if name else 0.0
+
+        total = rd(total_ins_num_name) or 1.0
+        pos = rd(pos_ins_num_name)
+        mae = rd(abserr_name) / total
+        rmse = (rd(sqrerr_name) / total) ** 0.5
+        predicted_ctr = rd(prob_name) / total
+        actual_ctr = pos / total
+        copc = actual_ctr / predicted_ctr if predicted_ctr > 0 else 0.0
+        mean_q = rd(q_name) / pos if pos > 0 else 0.0
+        auc = self.get_global_auc(scope, stat_pos_name, stat_neg_name) \
+            if stat_pos_name and stat_neg_name else 0.0
+        return [auc, 0.0, mae, rmse, actual_ctr, predicted_ctr, copc,
+                mean_q, pos, total]
+
+    @staticmethod
+    def _read(scope, name: str) -> np.ndarray:
+        v = scope.find_var(name)
+        if v is None or not v.is_initialized():
+            raise KeyError(f"stat var '{name}' not found in scope")
+        return np.asarray(v.get_tensor().array, np.float64)
+
+    # --------------------------------------------------------- save/load
+    def save_paddle_model(self, executor, scope, program, model_path: str,
+                          feeded_vars: Sequence[str] = (),
+                          target_vars: Sequence = (), fs_client=None):
+        """Save an inference model locally, then optionally upload
+        (reference save_paddle_inference_model over hdfs)."""
+        from .... import io as fluid_io
+        from ....executor import scope_guard
+        import tempfile
+        local = model_path
+        remote = None
+        if fs_client is not None:
+            remote = model_path
+            local = tempfile.mkdtemp(prefix="fleet_model_")
+        with scope_guard(scope):
+            fluid_io.save_inference_model(local, list(feeded_vars),
+                                          list(target_vars), executor,
+                                          main_program=program)
+        if fs_client is not None:
+            fs_client.upload(local, remote)
+        return local
+
+    def load_paddle_model(self, executor, scope, model_path: str,
+                          fs_client=None):
+        from .... import io as fluid_io
+        from ....executor import scope_guard
+        import tempfile
+        local = model_path
+        if fs_client is not None:
+            local = tempfile.mkdtemp(prefix="fleet_model_")
+            fs_client.download(model_path, local)
+        with scope_guard(scope):
+            return fluid_io.load_inference_model(local, executor)
+
+    # ------------------------------------------------------------- misc
+    def print_on_rank(self, message: str, rank_id: int):
+        try:
+            if self._fleet.worker_index() != rank_id:
+                return
+        except Exception:
+            pass
+        print(message)
+
+    def get_last_save_model(self, output_path: str, fs_client=None):
+        """Newest saved epoch dir under output_path (reference
+        get_last_save_model)."""
+        fs = fs_client
+        if fs is None:
+            from .hdfs import LocalFS
+            fs = LocalFS()
+        if not fs.is_exist(output_path):
+            return ""
+        cands = [p for p in fs.ls(output_path)
+                 if os.path.basename(p).startswith(("epoch_", "batch_"))]
+        return max(cands, default="")

@@ -1,0 +1,135 @@
+"""Filesystem clients for fleet checkpoints/data (reference:
+incubate/fleet/utils/hdfs.py HDFSClient — shells out to ``hadoop fs`` the
+same way framework/io/{fs.cc,shell.cc} do; plus a LocalFS with the same
+interface so fleet code paths are testable without a cluster)."""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+__all__ = ["HDFSClient", "LocalFS"]
+
+
+class FSClientBase:
+    def ls(self, path) -> List[str]:
+        raise NotImplementedError
+
+    def is_exist(self, path) -> bool:
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdir(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def mv(self, src, dst):
+        raise NotImplementedError
+
+
+class LocalFS(FSClientBase):
+    """Same interface over the local filesystem (used by single-host tests
+    and the default checkpoint path)."""
+
+    def ls(self, path):
+        return sorted(os.path.join(path, p) for p in os.listdir(path))
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def upload(self, local_path, fs_path):
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path, dirs_exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(fs_path) or ".", exist_ok=True)
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def mkdir(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst):
+        shutil.move(src, dst)
+
+
+class HDFSClient(FSClientBase):
+    """``hadoop fs`` wrapper (reference hdfs.py HDFSClient — same shell
+    strategy). Needs a hadoop binary on PATH or hadoop_home."""
+
+    def __init__(self, hadoop_home: Optional[str] = None,
+                 configs: Optional[dict] = None, retry_times: int = 3):
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else "hadoop"
+        self._configs = configs or {}
+        self._retry = retry_times
+
+    def _base_cmd(self) -> List[str]:
+        cmd = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        return cmd
+
+    def _run(self, args: List[str], retry: bool = True) -> Tuple[int, str]:
+        """retry=False for probes (``-test``, ``-ls``) where a nonzero exit
+        is an expected answer, not a transient failure."""
+        last = (1, "")
+        for _ in range(self._retry if retry else 1):
+            try:
+                p = subprocess.run(self._base_cmd() + args,
+                                   capture_output=True, text=True,
+                                   timeout=300)
+            except (FileNotFoundError, subprocess.TimeoutExpired) as e:
+                raise RuntimeError(
+                    f"hadoop binary unavailable or timed out: {e}") from e
+            last = (p.returncode, p.stdout + p.stderr)
+            if p.returncode == 0:
+                return last
+        return last
+
+    def ls(self, path):
+        code, out = self._run(["-ls", path], retry=False)
+        if code != 0:
+            return []
+        return [line.split()[-1] for line in out.splitlines()
+                if line and not line.startswith("Found")]
+
+    def is_exist(self, path):
+        code, _ = self._run(["-test", "-e", path], retry=False)
+        return code == 0
+
+    def upload(self, local_path, fs_path):
+        code, out = self._run(["-put", "-f", local_path, fs_path])
+        if code != 0:
+            raise RuntimeError(f"hdfs upload failed: {out}")
+
+    def download(self, fs_path, local_path):
+        code, out = self._run(["-get", fs_path, local_path])
+        if code != 0:
+            raise RuntimeError(f"hdfs download failed: {out}")
+
+    def mkdir(self, path):
+        self._run(["-mkdir", "-p", path])
+
+    def delete(self, path):
+        self._run(["-rm", "-r", "-skipTrash", path])
+
+    def mv(self, src, dst):
+        code, out = self._run(["-mv", src, dst])
+        if code != 0:
+            raise RuntimeError(f"hdfs mv failed: {out}")

@@ -106,12 +106,8 @@ class Auc(MetricBase):
                 self._stat_neg[b] += 1
 
     def eval(self):
-        tot_pos = tot_neg = auc = 0.0
-        for i in range(self._num_thresholds, -1, -1):
-            auc += self._stat_pos[i] * (tot_neg + self._stat_neg[i] / 2.0)
-            tot_pos += self._stat_pos[i]
-            tot_neg += self._stat_neg[i]
-        return auc / (tot_pos * tot_neg) if tot_pos * tot_neg else 0.0
+        from ..utils.metrics import auc_from_histograms
+        return auc_from_histograms(self._stat_pos, self._stat_neg)
 
 
 class CompositeMetric(MetricBase):

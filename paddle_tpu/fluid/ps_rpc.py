@@ -186,3 +186,55 @@ class VarClient:
                 _recv_msg(self._sock)
         except (ConnectionError, OSError):
             pass
+
+
+class ReduceService:
+    """Sum-across-workers service for host-side metric reductions (the
+    reference's GlooWrapper::AllReduce role — gloo_wrapper.h:146). Workers
+    push a named array; get blocks until all ``world`` contributions of the
+    current generation arrived, then every worker reads the sum. The
+    generation resets once all workers fetched, so the same name can be
+    reduced repeatedly."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._sums: Dict[str, np.ndarray] = {}
+        self._contrib: Dict[str, set] = {}
+        self._fetched: Dict[str, set] = {}
+
+    def push(self, name: str, value, trainer_id: int):
+        arr = np.asarray(value, np.float64)
+        with self._cv:
+            if trainer_id in self._contrib.setdefault(name, set()):
+                raise RuntimeError(
+                    f"reduce '{name}': trainer {trainer_id} pushed twice in "
+                    f"one generation")
+            cur = self._sums.get(name)
+            self._sums[name] = arr if cur is None else cur + arr
+            self._contrib[name].add(trainer_id)
+            self._cv.notify_all()
+        return True
+
+    def get(self, name: str, trainer_id: int, world: int,
+            timeout: float = 300.0):
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: len(self._contrib.get(name, ())) >= world, timeout)
+            if not ok:
+                raise TimeoutError(
+                    f"reduce '{name}': only "
+                    f"{len(self._contrib.get(name, ()))}/{world} workers "
+                    f"contributed within {timeout}s")
+            result = self._sums[name]
+            fetched = self._fetched.setdefault(name, set())
+            fetched.add(trainer_id)
+            if len(fetched) >= world:  # everyone has it → reset generation
+                self._sums.pop(name, None)
+                self._contrib.pop(name, None)
+                self._fetched.pop(name, None)
+                self._cv.notify_all()
+            return result
+
+    def handlers(self) -> Dict[str, Callable[..., Any]]:
+        return {"reduce_push": self.push, "reduce_get": self.get}

@@ -283,3 +283,57 @@ def _listen_and_serv(ins, attrs):
     finally:
         srv.shutdown()
     return {}
+
+
+# ---------------------------------------------------------------- pslib ops
+@register_op("pslib_pull_sparse", stateful=True, no_grad=True,
+             attr_defaults={"TableId": 0, "EmbeddingDim": 8,
+                            "padding_idx": -1})
+def _pslib_pull_sparse(ins, attrs):
+    """Pull rows from a downpour sparse table (TPU-native replacement for
+    the reference PSLib pull path — fleet_wrapper.h:86 PullSparseVarsSync).
+    Emitted by DownpourOptimizer's rewrite of is_distributed lookups."""
+    from ..fluid.incubate.fleet.parameter_server.pslib import _runtime
+    ctx = attrs["_ctx"]
+    name = ctx.op.input("Ids")[0]
+    ids = np.asarray(ctx.scope.find_var(name).value().array)
+    flat = ids.reshape(-1)
+    pad = int(attrs.get("padding_idx", -1))
+    dim = int(attrs["EmbeddingDim"])
+    # padding ids never touch the table (no lazy row creation, no
+    # last-seen refresh) — reference lookup_table padding semantics
+    live = flat != pad if pad >= 0 else np.ones(flat.shape, bool)
+    rows = np.zeros((flat.size, dim), np.float32)
+    if live.any():
+        rows[live] = _runtime.pull(int(attrs["TableId"]), flat[live])
+    lead = ids.shape[:-1] if ids.ndim > 1 and ids.shape[-1] == 1 \
+        else ids.shape
+    out = jnp.asarray(rows).reshape(tuple(lead) + (dim,))
+    return {"Out": [out]}
+
+
+@register_op("pslib_push_sparse", stateful=True, no_grad=True,
+             attr_defaults={"TableId": 0, "EmbeddingDim": 8,
+                            "padding_idx": -1})
+def _pslib_push_sparse(ins, attrs):
+    """Push row gradients to a downpour sparse table (reference
+    fleet_wrapper.h:130 PushSparseVarsWithLabelAsync). padding_idx rows get
+    no gradient, matching lookup_table."""
+    from ..fluid.incubate.fleet.parameter_server.pslib import _runtime
+    ctx = attrs["_ctx"]
+    ids = np.asarray(
+        ctx.scope.find_var(ctx.op.input("Ids")[0]).value().array)
+    gname = ctx.op.input("Grads")[0]
+    gvar = ctx.scope.find_var(gname)
+    if gvar is None or not gvar.is_initialized():
+        return {}
+    dim = int(attrs["EmbeddingDim"])
+    flat = ids.reshape(-1)
+    grads = np.asarray(gvar.value().array).reshape(-1, dim)
+    pad = int(attrs.get("padding_idx", -1))
+    if pad >= 0:
+        live = flat != pad
+        flat, grads = flat[live], grads[live]
+    if flat.size:
+        _runtime.push(int(attrs["TableId"]), flat, grads)
+    return {}

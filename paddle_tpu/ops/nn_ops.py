@@ -942,13 +942,8 @@ def _auc(ins, attrs):
             stat_pos[b] += 1
         else:
             stat_neg[b] += 1
-    tot_pos = neg_acc = auc_val = 0.0
-    tot_neg = 0.0
-    for i in range(nt, -1, -1):
-        auc_val += stat_pos[i] * (tot_neg + stat_neg[i] / 2.0)
-        tot_pos += stat_pos[i]
-        tot_neg += stat_neg[i]
-    auc_val = auc_val / (tot_pos * tot_neg) if tot_pos * tot_neg > 0 else 0.0
+    from ..utils.metrics import auc_from_histograms
+    auc_val = auc_from_histograms(stat_pos, stat_neg)
     return out(AUC=jnp.asarray([auc_val], jnp.float64),
                StatPosOut=jnp.asarray(stat_pos),
                StatNegOut=jnp.asarray(stat_neg))

@@ -1,0 +1,25 @@
+"""Shared metric math used by the auc op, fluid.metrics.Auc and
+FleetUtil.get_global_auc (one implementation so the three call sites cannot
+diverge; reference formula: operators/metrics/auc_op.h trapezoid sweep)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["auc_from_histograms"]
+
+
+def auc_from_histograms(stat_pos, stat_neg) -> float:
+    """ROC AUC from per-threshold-bucket positive/negative counts.
+
+    Descending-threshold trapezoid sweep in (FP, TP) space: each bucket
+    contributes width = neg[i] at mean height = TP_before + pos[i]/2."""
+    pos = np.asarray(stat_pos, np.float64).reshape(-1)
+    neg = np.asarray(stat_neg, np.float64).reshape(-1)
+    tot_pos = tot_neg = area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        area += neg[i] * (tot_pos + pos[i] / 2.0)
+        tot_pos += pos[i]
+        tot_neg += neg[i]
+    if tot_pos * tot_neg == 0:
+        return 0.0
+    return float(area / (tot_pos * tot_neg))

@@ -1,0 +1,260 @@
+"""fleet pslib mode: downpour sparse tables, DownpourOptimizer program
+rewrite, RPC-served tables, FleetUtil metrics, fs clients (reference:
+incubate/fleet/parameter_server/pslib/, incubate/fleet/utils/)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.incubate.fleet.base.role_maker import (
+    UserDefinedRoleMaker, Role)
+from paddle_tpu.fluid.incubate.fleet.parameter_server.pslib import (
+    fleet, PSLib, DownpourSparseTable, TableRegistry, _runtime)
+from paddle_tpu.fluid.incubate.fleet.parameter_server.pslib.node import (
+    DownpourServer, DownpourWorker)
+from paddle_tpu.fluid.incubate.fleet.utils import FleetUtil, LocalFS
+
+
+# ----------------------------------------------------------- sparse tables
+def test_sparse_table_pull_lazy_init_and_push_sgd():
+    t = DownpourSparseTable(0, emb_dim=4, optimizer="sgd",
+                            learning_rate=0.5, initial_range=0.0)
+    rows = t.pull([7, 9, 7])
+    assert rows.shape == (3, 4)
+    np.testing.assert_array_equal(rows, np.zeros((3, 4)))
+    g = np.ones((3, 4), np.float32)
+    t.push([7, 9, 7], g)  # id 7 twice -> accumulated grad 2
+    after = t.pull([7, 9])
+    np.testing.assert_allclose(after[0], -1.0 * np.ones(4))   # 0.5*2
+    np.testing.assert_allclose(after[1], -0.5 * np.ones(4))
+
+
+def test_sparse_table_adam_and_shrink():
+    t = DownpourSparseTable(1, emb_dim=2, optimizer="adam",
+                            learning_rate=0.1, initial_range=0.0)
+    t.push([1], np.ones((1, 2), np.float32))
+    r = t.pull([1])[0]
+    assert np.all(r < 0)  # moved against the gradient
+    assert t.stat()["row_count"] == 1
+    assert t.shrink(max_idle_seconds=0.0) == 1  # everything idle → dropped
+    assert t.stat()["row_count"] == 0
+
+
+def test_table_registry_save_load(tmp_path):
+    reg = TableRegistry()
+    t = reg.add_sparse(DownpourSparseTable(3, 4, initial_range=0.1))
+    before = t.pull([5, 6]).copy()
+    reg.save_model(str(tmp_path))
+    t.clear()
+    reg.load_model(str(tmp_path))
+    np.testing.assert_array_equal(t.pull([5, 6]), before)
+
+
+def test_node_descriptors():
+    s = DownpourServer()
+    s.add_sparse_table(0, {"sparse_embedx_dim": 16,
+                           "sparse_accessor_class": "DownpourUnitAccessor"})
+    s.add_dense_table(1, {"w": (4, 4)})
+    d = s.get_desc()
+    assert d["sparse_tables"][0]["emb_dim"] == 16
+    assert d["sparse_tables"][0]["optimizer"] == "adam"
+    w = DownpourWorker()
+    w.add_sparse_table(0, ["ids"], ["emb"])
+    assert w.get_desc()["sparse_tables"][0]["slot_key"] == ["ids"]
+    with pytest.raises(ValueError):
+        s.add_sparse_table(2, {"sparse_accessor_class": "NoSuch"})
+
+
+# ----------------------------------------------- end-to-end pslib training
+def _build_ctr_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        dense = fluid.layers.data("dense", shape=[4], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[1000, 8],
+                                     is_distributed=True)
+        concat = fluid.layers.concat([emb, dense], axis=1)
+        fc = fluid.layers.fc(concat, 16, act="relu")
+        pred = fluid.layers.fc(fc, 2, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    return main, startup, loss
+
+
+def test_downpour_optimizer_rewrite_and_train():
+    _runtime.registry.sparse.clear()
+    _runtime.specs.clear()
+    _runtime.disconnect()
+    role = UserDefinedRoleMaker(current_id=0, role=Role.WORKER,
+                                worker_num=1, server_endpoints=[])
+    f = PSLib()
+    f.init(role)
+    main, startup, loss = _build_ctr_program()
+    with fluid.program_guard(main, startup):
+        opt = f.distributed_optimizer(fluid.optimizer.SGD(0.1))
+        opt.minimize(loss)
+    assert f._server_desc and 0 in f._server_desc["sparse_tables"]
+    ops = [op.type for op in main.global_block().ops]
+    assert "pslib_pull_sparse" in ops
+    assert "pslib_push_sparse" in ops
+    assert "lookup_table" not in ops
+    # dense sgd updates survive; the embedding's dense update is gone
+    f.init_server()
+    assert 0 in _runtime.registry.sparse
+
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 1000, (32, 1)).astype("int64")
+    dense = rng.rand(32, 4).astype("float32")
+    label = (rng.rand(32, 1) > 0.5).astype("int64")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(15):
+            out = exe.run(main, feed={"ids": ids, "dense": dense,
+                                      "label": label}, fetch_list=[loss])
+            losses.append(float(np.asarray(out[0]).ravel()[0]))
+    assert losses[-1] < losses[0], losses
+    assert _runtime.registry.sparse[0].stat()["row_count"] > 0
+
+
+def test_pslib_rpc_server_roundtrip():
+    _runtime.registry.sparse.clear()
+    _runtime.specs.clear()
+    _runtime.register_table_spec(0, 4, "sgd", 0.5)
+    role = UserDefinedRoleMaker(current_id=0, role=Role.SERVER,
+                                worker_num=1,
+                                server_endpoints=["127.0.0.1:0"])
+    f = PSLib()
+    f.init(role)
+    f._server_desc = {"sparse_tables": {0: {"emb_dim": 4,
+                                            "optimizer": "sgd",
+                                            "learning_rate": 0.5}}}
+    f.init_server()
+    # bind to an ephemeral port
+    from paddle_tpu.fluid.ps_rpc import VarServer, VarClient
+    srv = f.run_server()
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        rt = _runtime
+        rt.connect([ep])
+        rows = rt.pull(0, np.array([[3], [4]]))
+        assert rows.shape == (2, 4)
+        rt.push(0, np.array([3, 4]), np.ones((2, 4), np.float32))
+        after = rt.pull(0, np.array([3]))
+        assert after[0][0] < rows[0][0]  # sgd moved it down
+        cli = VarClient.of(ep)
+        st = cli.call("pslib_stat", tid=0)
+        assert st["row_count"] >= 2
+    finally:
+        rt.disconnect()
+        f.stop_server()
+        VarClient.reset_pool()
+
+
+def test_save_cache_model_and_table_control(tmp_path):
+    _runtime.registry.sparse.clear()
+    _runtime.specs.clear()
+    _runtime.register_table_spec(0, 4, "sgd", 0.1)
+    _runtime.pull(0, np.arange(10))
+    f = PSLib()
+    n = f.save_cache_model(None, str(tmp_path), cache_threshold=5)
+    assert n == 10
+    import pickle
+    with open(tmp_path / "cache_table_0.pkl", "rb") as fh:
+        cache = pickle.load(fh)
+    assert len(cache["rows"]) == 5
+    st = f.print_table_stat(0)
+    assert st["row_count"] == 10
+    f.clear_one_table(0)
+    assert _runtime.registry.sparse[0].stat()["row_count"] == 0
+
+
+def test_padding_idx_never_touches_table():
+    _runtime.registry.sparse.clear()
+    _runtime.specs.clear()
+    _runtime.register_table_spec(0, 4, "sgd", 0.5)
+
+    class _Op:
+        def input(self, slot):
+            return {"Ids": ["ids"], "Grads": ["g"]}[slot]
+
+    class _Ctx:
+        op = _Op()
+        scope = core.Scope()
+    import jax.numpy as jnp
+    _Ctx.scope.var("ids").set_value(core.LoDTensor(
+        jnp.asarray(np.array([[0], [5], [0]], np.int64))))
+    _Ctx.scope.var("g").set_value(core.LoDTensor(
+        jnp.asarray(np.ones((3, 4), np.float32))))
+    from paddle_tpu.ops.registry import OPS
+    out = OPS.get("pslib_pull_sparse").kernel(
+        {}, {"_ctx": _Ctx, "TableId": 0, "EmbeddingDim": 4,
+             "padding_idx": 0})
+    rows = np.asarray(out["Out"][0])
+    assert rows.shape == (3, 4)  # ids [N,1] -> out [N, dim]
+    np.testing.assert_array_equal(rows[0], 0)
+    # only id 5 was materialized — padding id 0 created no row
+    assert set(_runtime.registry.sparse[0]._rows) == {5}
+    OPS.get("pslib_push_sparse").kernel(
+        {}, {"_ctx": _Ctx, "TableId": 0, "EmbeddingDim": 4,
+             "padding_idx": 0})
+    assert set(_runtime.registry.sparse[0]._rows) == {5}
+
+
+def test_reduce_service_multi_worker():
+    from paddle_tpu.fluid.ps_rpc import ReduceService
+    import threading
+    svc = ReduceService()
+    results = {}
+
+    def worker(tid):
+        svc.push("m", np.full(3, tid + 1.0), tid)
+        results[tid] = svc.get("m", tid, world=3, timeout=10)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    for tid in range(3):
+        np.testing.assert_array_equal(results[tid], np.full(3, 6.0))
+    # next generation works after reset
+    svc.push("m", np.ones(1), 0)
+    svc.push("m", np.ones(1), 1)
+    svc.push("m", np.ones(1), 2)
+    np.testing.assert_array_equal(svc.get("m", 0, 3), np.full(1, 3.0))
+
+
+# ------------------------------------------------------------- fleet utils
+def test_fleet_util_global_auc_single_host():
+    scope = core.Scope()
+    import jax.numpy as jnp
+    # perfect separation → auc 1.0
+    pos = np.zeros(100)
+    neg = np.zeros(100)
+    pos[90] = 10   # positives at high scores
+    neg[10] = 10   # negatives at low scores
+    scope.var("sp").set_value(core.LoDTensor(jnp.asarray(pos)))
+    scope.var("sn").set_value(core.LoDTensor(jnp.asarray(neg)))
+    util = FleetUtil(fleet=fleet)
+    auc = util.get_global_auc(scope, "sp", "sn")
+    assert auc == pytest.approx(1.0)
+    metrics = util.get_global_metrics(
+        scope, "sp", "sn", total_ins_num_name=None)
+    assert metrics[0] == pytest.approx(1.0)
+
+
+def test_local_fs_roundtrip(tmp_path):
+    fs = LocalFS()
+    src = tmp_path / "a.txt"
+    src.write_text("hello")
+    dst = tmp_path / "sub" / "b.txt"
+    fs.upload(str(src), str(dst))
+    assert fs.is_exist(str(dst))
+    assert str(dst) in fs.ls(str(tmp_path / "sub"))
+    fs.mv(str(dst), str(tmp_path / "c.txt"))
+    assert fs.is_exist(str(tmp_path / "c.txt"))
+    fs.delete(str(tmp_path / "c.txt"))
+    assert not fs.is_exist(str(tmp_path / "c.txt"))

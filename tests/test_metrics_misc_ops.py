@@ -330,3 +330,33 @@ def test_fusion_seqpool_cvm_concat_transform():
     pooled = x.sum(0)
     ref = np.concatenate([np.log(pooled[:2] + 1), pooled[2:]])
     np.testing.assert_allclose(o.ravel(), ref, rtol=1e-5)
+
+
+def test_auc_op_separable_and_random():
+    """ROC AUC from threshold histograms (reference: metrics/auc_op.h).
+    Regression: the trapezoid sweep was inverted, returning 1-AUC."""
+    from paddle_tpu.ops.nn_ops import _auc
+
+    def auc_of(pred_pos_scores, labels, nt=255):
+        pred = np.stack([1 - pred_pos_scores, pred_pos_scores], axis=1)
+        ins = {"Predict": [pred.astype(np.float32)],
+               "Label": [np.asarray(labels, np.int64)],
+               "StatPos": [np.zeros(nt + 1)],
+               "StatNeg": [np.zeros(nt + 1)]}
+        out = _auc(ins, {"num_thresholds": nt})
+        return float(np.asarray(out["AUC"][0])[0])
+
+    # perfect separation
+    scores = np.array([0.9] * 5 + [0.1] * 5)
+    labels = np.array([1] * 5 + [0] * 5)
+    assert auc_of(scores, labels) == pytest.approx(1.0, abs=1e-6)
+    # inverted ranking -> 0
+    assert auc_of(1 - scores, labels) == pytest.approx(0.0, abs=1e-6)
+    # compare against an sklearn-free exact pairwise AUC on random data
+    rng = np.random.RandomState(0)
+    s = rng.rand(200)
+    l = (rng.rand(200) > 0.5).astype(np.int64)
+    pos, neg = s[l == 1], s[l == 0]
+    exact = np.mean([(pos[:, None] > neg[None, :]).mean()
+                     + 0.5 * (pos[:, None] == neg[None, :]).mean()])
+    assert auc_of(s, l, nt=4095) == pytest.approx(exact, abs=2e-3)
