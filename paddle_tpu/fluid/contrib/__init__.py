@@ -1,4 +1,11 @@
-"""fluid.contrib (reference: python/paddle/fluid/contrib/) — mixed precision
-lands here; slim/quant in a later round."""
+"""fluid.contrib (reference: python/paddle/fluid/contrib/): mixed
+precision, slim compression toolkit, decoupled-weight-decay optimizers,
+memory/FLOPs estimators, op frequency stats."""
 from . import mixed_precision  # noqa: F401
 from . import slim  # noqa: F401
+from . import extend_optimizer  # noqa: F401
+from .extend_optimizer import (  # noqa: F401
+    extend_with_decoupled_weight_decay, DecoupledWeightDecay)
+from .memory_usage_calc import memory_usage  # noqa: F401
+from .op_frequence import op_freq_statistic  # noqa: F401
+from .model_stat import summary  # noqa: F401

@@ -1,0 +1,89 @@
+"""Decoupled weight decay for any optimizer (reference:
+contrib/extend_optimizer/extend_optimizer_with_weight_decay.py —
+extend_with_decoupled_weight_decay builds an Optimizer subclass that
+subtracts lr*coeff*param AFTER the gradient step, i.e. AdamW-style decay
+that does not flow through the adaptive moments)."""
+from __future__ import annotations
+
+from typing import Callable, Optional, Type
+
+__all__ = ["DecoupledWeightDecay", "extend_with_decoupled_weight_decay"]
+
+
+class DecoupledWeightDecay:
+    """Mixin applied in front of an Optimizer class by
+    extend_with_decoupled_weight_decay."""
+
+    def __init__(self, weight_decay: float = 0.0,
+                 apply_decay_param_fun: Optional[Callable[[str], bool]]
+                 = None, **kwargs):
+        self._coeff = float(weight_decay)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        super().__init__(**kwargs)
+
+    def _decays(self, params_grads):
+        for p, g in params_grads:
+            if g is None or self._coeff == 0.0:
+                continue
+            if self._apply_decay_param_fun is not None \
+                    and not self._apply_decay_param_fun(p.name):
+                continue
+            yield p
+
+    def _create_optimization_pass(self, params_grads):
+        # hook the path BOTH modes share (dygraph minimize bypasses
+        # apply_gradients — dygraph/base.py _dygraph_minimize)
+        result = super()._create_optimization_pass(params_grads)
+        from ... import framework
+        if framework.in_dygraph_mode():
+            # eager: scale the updated params in place
+            lr = self._get_lr_value()
+            for p in self._decays(params_grads):
+                p._array = p._array * (1.0 - lr * self._coeff)
+            return result
+        # static: append param = param*(1 - lr*coeff) after the update ops
+        block = framework.default_main_program().global_block()
+        for p in self._decays(params_grads):
+            lr_var = self._create_param_lr((p, None))
+            scaled = block.create_var(
+                name=p.name + "@WD", dtype=p.dtype, shape=tuple(p.shape))
+            block.append_op(type="elementwise_mul",
+                            inputs={"X": [p.name], "Y": [lr_var]},
+                            outputs={"Out": [scaled]},
+                            attrs={"axis": -1, "_wd_coeff": 1.0})
+            coeffed = block.create_var(
+                name=p.name + "@WDC", dtype=p.dtype, shape=tuple(p.shape))
+            block.append_op(type="scale", inputs={"X": [scaled]},
+                            outputs={"Out": [coeffed]},
+                            attrs={"scale": self._coeff, "bias": 0.0,
+                                   "bias_after_scale": True})
+            block.append_op(type="elementwise_sub",
+                            inputs={"X": [p.name], "Y": [coeffed]},
+                            outputs={"Out": [p.name]},
+                            attrs={"axis": -1})
+        return result
+
+    def _get_lr_value(self) -> float:
+        lr = getattr(self, "_learning_rate", 0.0)
+        return float(lr() if callable(lr) else lr)
+
+
+def extend_with_decoupled_weight_decay(base_optimizer: Type) -> Type:
+    """reference extend_with_decoupled_weight_decay(OptimizerClass) →
+    OptimizerWithDecoupledWeightDecay."""
+    from ...optimizer import Optimizer
+    if not (isinstance(base_optimizer, type)
+            and issubclass(base_optimizer, Optimizer)):
+        raise TypeError("base_optimizer must be an Optimizer subclass")
+
+    class OptimizerWithDecoupledWeightDecay(DecoupledWeightDecay,
+                                            base_optimizer):
+        def __init__(self, weight_decay, apply_decay_param_fun=None,
+                     **kwargs):
+            super().__init__(weight_decay=weight_decay,
+                             apply_decay_param_fun=apply_decay_param_fun,
+                             **kwargs)
+
+    OptimizerWithDecoupledWeightDecay.__name__ = (
+        base_optimizer.__name__ + "WithDecoupledWeightDecay")
+    return OptimizerWithDecoupledWeightDecay

@@ -1,0 +1,32 @@
+"""Estimate a program's activation+param memory (reference:
+contrib/memory_usage_calc.py memory_usage:46 — sums var bytes with the
+batch dim substituted). On TPU this is the HBM footprint estimate before
+XLA's buffer sharing; useful for picking batch size / remat points."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import dtype_to_np
+
+__all__ = ["memory_usage"]
+
+_GB = 1024 ** 3
+
+
+def memory_usage(program, batch_size: int):
+    """Return (lower_gb, upper_gb) like the reference (the upper bound
+    adds a 1.5x slack for fusion temporaries)."""
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive")
+    total = 0
+    for block in program.blocks:
+        for var in block.vars.values():
+            shape = [batch_size if d in (-1, 0) else d for d in var.shape]
+            if not shape:
+                shape = [1]
+            try:
+                itemsize = np.dtype(dtype_to_np(var.dtype)).itemsize
+            except Exception:
+                itemsize = 4
+            total += int(np.prod(shape)) * itemsize
+    return total / _GB, total * 1.5 / _GB

@@ -1,0 +1,63 @@
+"""Model parameter/FLOPs summary (reference: contrib/model_stat.py
+summary() — walks the program and tabulates per-layer params and FLOPs)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["summary"]
+
+_CONV_OPS = {"conv2d", "depthwise_conv2d", "conv2d_transpose"}
+
+
+def _flops_of(op, block):
+    try:
+        if op.type in _CONV_OPS:
+            out = block._find_var_recursive(op.output("Output")[0])
+            flt = block._find_var_recursive(op.input("Filter")[0])
+            if out is None or flt is None:
+                return 0
+            o = [d for d in out.shape if d > 0]
+            f = list(flt.shape)
+            return 2 * int(np.prod(o)) * int(np.prod(f[1:]))
+        if op.type in ("mul", "matmul", "matmul_v2"):
+            x = block._find_var_recursive(op.input("X")[0])
+            y = block._find_var_recursive(op.input("Y")[0])
+            if x is None or y is None:
+                return 0
+            xs = [d for d in x.shape if d > 0]
+            ty = op.attrs.get("transpose_Y") or op.attrs.get("trans_y")
+            n = int(y.shape[-2]) if ty and len(y.shape) >= 2 \
+                else int(y.shape[-1])
+            return 2 * int(np.prod(xs)) * n
+    except (IndexError, KeyError, ValueError):
+        return 0
+    return 0
+
+
+def summary(main_program, print_table: bool = True):
+    """Return (total_params, total_flops); optionally print the per-op
+    table (reference summary prints the same columns)."""
+    total_params = 0
+    total_flops = 0
+    rows = []
+    for block in main_program.blocks:
+        for var in block.vars.values():
+            from ..framework import Parameter
+            # only real Parameters: optimizer accumulators are persistable
+            # too and would inflate the count after minimize()
+            if isinstance(var, Parameter):
+                n = int(np.prod([d for d in var.shape if d > 0] or [0]))
+                total_params += n
+        for op in block.ops:
+            fl = _flops_of(op, block)
+            if fl:
+                rows.append((op.type, fl))
+                total_flops += fl
+    if print_table:
+        print(f"{'op':<24}{'FLOPs':>16}")
+        for t, fl in rows:
+            print(f"{t:<24}{fl:>16,}")
+        print(f"Total params: {total_params:,}")
+        print(f"Total FLOPs:  {total_flops:,} "
+              f"({total_flops / 1e9:.3f} GFLOPs)")
+    return total_params, total_flops

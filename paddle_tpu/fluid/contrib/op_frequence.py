@@ -1,0 +1,25 @@
+"""Op frequency statistics (reference: contrib/op_frequence.py
+op_freq_statistic:23 — counts op types and adjacent-pair frequencies over a
+program; the pair counts were used to pick fusion candidates)."""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Return (uni_op_freq, adj_2_op_freq) ordered by count desc."""
+    uni = {}
+    adj = {}
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] = uni.get(op.type, 0) + 1
+            if prev is not None:
+                key = f"{prev}->{op.type}"
+                adj[key] = adj.get(key, 0) + 1
+            prev = op.type
+    uni_sorted = OrderedDict(sorted(uni.items(), key=lambda kv: -kv[1]))
+    adj_sorted = OrderedDict(sorted(adj.items(), key=lambda kv: -kv[1]))
+    return uni_sorted, adj_sorted

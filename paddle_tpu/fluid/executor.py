@@ -417,7 +417,15 @@ class _CompiledBlock:
             feeds = {n: shard_feed(self.mesh, n, a)
                      for n, a in feeds.items()}
             rng = jax.device_put(rng, repl)
-        fetches, new_mut, extra = self._jitted(mut, ro, feeds, rng)
+        from . import profiler as _profiler
+        if _profiler.is_profiling():
+            # the whole program is ONE dispatch on TPU — a single span
+            # (per-op timing lives in the device XPlane trace)
+            with _profiler.RecordEvent("compiled_step"):
+                fetches, new_mut, extra = self._jitted(mut, ro, feeds, rng)
+                jax.block_until_ready(fetches)
+        else:
+            fetches, new_mut, extra = self._jitted(mut, ro, feeds, rng)
         for n, v in {**new_mut, **extra}.items():
             scope.var(n).set_value(LoDTensor(v))
         return fetches
@@ -591,6 +599,15 @@ class Executor:
             self._run_op_eager(op, scope, rng_base, idx)
 
     def _run_op_eager(self, op, scope: Scope, rng_base, idx: int = 0):
+        from . import profiler as _profiler
+        if _profiler.is_profiling():
+            # per-op host span (reference operator.cc:948-977 RecordEvent
+            # hooks around prepare/infer_shape/compute)
+            with _profiler.RecordEvent(op.type):
+                return self._run_op_eager_impl(op, scope, rng_base, idx)
+        return self._run_op_eager_impl(op, scope, rng_base, idx)
+
+    def _run_op_eager_impl(self, op, scope: Scope, rng_base, idx: int = 0):
         otype = op.type
         stateful = _op_is_stateful(op)
         attrs = op.attrs

@@ -1,0 +1,52 @@
+"""Program visualization (reference: python/paddle/fluid/net_drawer.py —
+emits Graphviz of ops/vars). Writes .dot text (graphviz python binding not
+required); ``dot -Tpng`` renders it."""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["draw_graph", "draw_block_graphviz"]
+
+
+def _esc(s: str) -> str:
+    return s.replace('"', r'\"')
+
+
+def draw_block_graphviz(block, highlights=None, path: Optional[str] = None
+                        ) -> str:
+    """One block → dot digraph: op nodes (boxes) wired through var nodes
+    (ellipses)."""
+    highlights = set(highlights or [])
+    lines = ["digraph G {", "  rankdir=TB;"]
+    var_nodes = set()
+
+    def var_node(name):
+        vid = f"var_{abs(hash(name)) % (10 ** 10)}"
+        if name not in var_nodes:
+            var_nodes.add(name)
+            color = ', style=filled, fillcolor="lightsalmon"' \
+                if name in highlights else ""
+            lines.append(f'  {vid} [label="{_esc(name)}", shape=ellipse'
+                         f'{color}];')
+        return vid
+
+    for i, op in enumerate(block.ops):
+        oid = f"op_{i}"
+        lines.append(f'  {oid} [label="{_esc(op.type)}", shape=box, '
+                     f'style=filled, fillcolor="lightblue"];')
+        for name in op.input_arg_names:
+            lines.append(f"  {var_node(name)} -> {oid};")
+        for name in op.output_arg_names:
+            lines.append(f"  {oid} -> {var_node(name)};")
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot
+
+
+def draw_graph(startup_program, main_program, path: Optional[str] = None,
+               **kwargs) -> str:
+    """reference net_drawer.draw_graph — main program block 0."""
+    return draw_block_graphviz(main_program.global_block(), path=path)

@@ -1,43 +1,211 @@
 """Profiler (reference: python/paddle/fluid/profiler.py over
-platform/profiler.h RecordEvent/CUPTI DeviceTracer).
+platform/profiler.h — RecordEvent:124 RAII spans nested per op,
+EnableProfiler/DisableProfiler:206 with sorted summary tables
+(profiler_helper.h), CUPTI DeviceTracer → chrome://tracing via
+tools/timeline.py).
 
-TPU equivalent: jax.profiler — XPlane traces viewable in TensorBoard /
-Perfetto replace the chrome://tracing timeline (reference tools/timeline.py).
-API shape preserved: profiler(...)/start_profiler/stop_profiler context."""
+TPU layering:
+  * host spans — RecordEvent stack collected here; the executor wraps each
+    eager op and each compiled-step dispatch (operator.cc:948-977 hook
+    points). stop_profiler prints the reference-style sorted table and
+    writes a chrome://tracing JSON that tools/timeline.py merges/views.
+  * device timeline — jax.profiler XPlane trace (TensorBoard/Perfetto),
+    the DeviceTracer/CUPTI replacement; enabled when state includes the
+    accelerator.
+"""
 from __future__ import annotations
 
 import contextlib
+import json
+import os
+import threading
 import time
+from typing import Dict, List, Optional
 
 import jax
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
-           "stop_profiler"]
+           "stop_profiler", "record_event", "RecordEvent", "is_profiling"]
 
-_trace_dir = None
+
+class _Event:
+    __slots__ = ("name", "start", "end", "tid")
+
+    def __init__(self, name, start, end, tid):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.tid = tid
+
+
+class _ProfilerState:
+    def __init__(self):
+        self.enabled = False
+        self.state = "All"
+        self.events: List[_Event] = []
+        self.lock = threading.Lock()
+        self.t0 = 0.0
+        self.trace_dir: Optional[str] = None
+        self.device_tracing = False
+        self.depth = 0  # nested profiler()/cuda_profiler() contexts
+
+
+_prof = _ProfilerState()
+
+
+def is_profiling() -> bool:
+    return _prof.enabled
 
 
 def start_profiler(state="All", tracer_option="Default",
                    trace_dir="/tmp/paddle_tpu_profile"):
-    global _trace_dir
-    _trace_dir = trace_dir
-    jax.profiler.start_trace(trace_dir)
+    """reference profiler.py start_profiler / EnableProfiler. ``state``:
+    'CPU' = host spans only; 'GPU'/'All' also starts the device (XPlane)
+    trace."""
+    if _prof.enabled:
+        _prof.depth += 1  # nested enable: inner stop becomes a no-op pair
+        return
+    _prof.depth = 1
+    _prof.enabled = True
+    _prof.state = state
+    _prof.events = []
+    _prof.t0 = time.perf_counter()
+    _prof.device_tracing = state in ("GPU", "All")
+    if _prof.device_tracing:
+        _prof.trace_dir = trace_dir
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except RuntimeError:
+            _prof.device_tracing = False
 
 
-def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
-    jax.profiler.stop_trace()
-    if _trace_dir:
-        print(f"[profiler] XPlane trace written to {_trace_dir} "
-              f"(view with TensorBoard)")
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: str = "/tmp/profile"):
+    """Print the sorted summary table (reference profiler_helper.h
+    PrintProfiler) and write a chrome://tracing JSON to ``profile_path``
+    (consumed by tools/timeline.py)."""
+    if not _prof.enabled:
+        return
+    _prof.depth -= 1
+    if _prof.depth > 0:  # inner context of a nested session: keep going
+        return
+    _prof.enabled = False
+    if _prof.device_tracing:
+        jax.profiler.stop_trace()
+        print(f"[profiler] device XPlane trace in {_prof.trace_dir} "
+              f"(TensorBoard / Perfetto)")
+    events = _prof.events
+    _summary(events, sorted_key)
+    if profile_path:
+        _write_chrome_trace(events, profile_path)
+        print(f"[profiler] host timeline written to {profile_path} "
+              f"(tools/timeline.py or chrome://tracing)")
 
 
 def reset_profiler():
-    pass
+    with _prof.lock:
+        _prof.events = []
+        _prof.t0 = time.perf_counter()
+
+
+def _record(name: str, start: float, end: float):
+    with _prof.lock:
+        _prof.events.append(_Event(name, start, end,
+                                   threading.get_ident()))
+
+
+class RecordEvent:
+    """RAII span (reference platform/profiler.h:124). Usable as a context
+    manager or decorator; no-op when profiling is off."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._start = 0.0
+
+    def __enter__(self):
+        if _prof.enabled:
+            self._start = time.perf_counter()
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        # gate on the per-span state, not the global flag: a stop_profiler
+        # landing mid-span must not leak the entered TraceAnnotation
+        if self._start:
+            self._ann.__exit__(exc_type, exc_val, exc_tb)
+            _record(self.name, self._start, time.perf_counter())
+            self._start = 0.0
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with RecordEvent(self.name):
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    with RecordEvent(name):
+        yield
+
+
+# ---------------------------------------------------------------- reports
+_SORT_KEYS = {"total", "calls", "max", "min", "ave", None}
+
+
+def _summary(events: List[_Event], sorted_key: Optional[str]):
+    if sorted_key not in _SORT_KEYS:
+        raise ValueError(f"sorted_key must be one of {_SORT_KEYS}")
+    if not events:
+        print("[profiler] no host events recorded")
+        return
+    agg: Dict[str, List[float]] = {}
+    for e in events:
+        agg.setdefault(e.name, []).append((e.end - e.start) * 1000.0)
+    total_all = sum(sum(v) for v in agg.values())
+    rows = []
+    for name, vals in agg.items():
+        tot = sum(vals)
+        rows.append((name, len(vals), tot, tot / len(vals), max(vals),
+                     min(vals), tot / total_all if total_all else 0.0))
+    key_idx = {"calls": 1, "total": 2, "ave": 3, "max": 4, "min": 5,
+               None: 2}[sorted_key]
+    rows.sort(key=lambda r: -r[key_idx])
+    hdr = (f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}"
+           f"{'Max(ms)':>10}{'Min(ms)':>10}{'Ratio':>8}")
+    print("-------------------------     Profiling Report     "
+          "-------------------------")
+    print(hdr)
+    for name, calls, tot, ave, mx, mn, ratio in rows:
+        print(f"{name[:39]:<40}{calls:>8}{tot:>12.4f}{ave:>10.4f}"
+              f"{mx:>10.4f}{mn:>10.4f}{ratio:>8.2%}")
+
+
+def _write_chrome_trace(events: List[_Event], path: str):
+    """chrome://tracing JSON (the format tools/timeline.py emits in the
+    reference)."""
+    trace = {"traceEvents": [], "displayTimeUnit": "ms"}
+    for e in events:
+        trace["traceEvents"].append({
+            "name": e.name, "ph": "X", "pid": os.getpid(), "tid": e.tid,
+            "ts": (e.start - _prof.t0) * 1e6,
+            "dur": (e.end - e.start) * 1e6, "cat": "host"})
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
 
 
 @contextlib.contextmanager
 def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
              tracer_option="Default"):
+    """reference profiler.py profiler context manager."""
     start_profiler(state, tracer_option)
     try:
         yield
@@ -46,14 +214,7 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
 
 
 @contextlib.contextmanager
-def cuda_profiler(output_file, output_mode=None, config=None):
-    # accelerator profiler alias — same jax trace
-    with profiler():
-        yield
-
-
-@contextlib.contextmanager
-def record_event(name: str):
-    """RecordEvent RAII span (reference platform/profiler.h:124)."""
-    with jax.profiler.TraceAnnotation(name):
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    # accelerator profiler alias — same device trace
+    with profiler(state="All", profile_path=output_file or "/tmp/profile"):
         yield

@@ -1,0 +1,246 @@
+"""Profiler, timeline tool, op bench harness, debugger/net_drawer, and
+contrib estimators (reference: platform/profiler.h, tools/timeline.py,
+operators/benchmark/op_tester.cc, fluid/debugger.py, contrib/
+memory_usage_calc.py, op_frequence.py, extend_optimizer/)."""
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core, profiler
+
+
+def _mlp_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+        y = fluid.layers.fc(h, 4, act="softmax")
+        loss = fluid.layers.mean(y)
+    return main, startup, loss
+
+
+# ----------------------------------------------------------------- profiler
+def test_profiler_collects_and_reports(tmp_path, capsys):
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    ppath = str(tmp_path / "profile.json")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with profiler.profiler(state="CPU", sorted_key="total",
+                               profile_path=ppath):
+            for _ in range(3):
+                exe.run(main, feed={"x": np.ones((2, 8), "float32")},
+                        fetch_list=[loss])
+    out = capsys.readouterr().out
+    assert "Profiling Report" in out
+    assert "compiled_step" in out
+    trace = json.load(open(ppath))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "compiled_step" in names
+    assert len(trace["traceEvents"]) >= 3
+
+
+def test_profiler_record_event_nesting(tmp_path):
+    profiler.start_profiler(state="CPU")
+    with profiler.record_event("outer"):
+        with profiler.record_event("inner"):
+            pass
+    from paddle_tpu.fluid.profiler import _prof
+    names = [e.name for e in _prof.events]
+    profiler.stop_profiler(profile_path=str(tmp_path / "p.json"))
+    assert names == ["inner", "outer"]  # inner closes first
+
+
+def test_profiler_eager_per_op_spans(tmp_path):
+    # stateful op (py print path) forces the eager executor → per-op spans
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, 4)
+        arr = fluid.layers.create_array("float32")
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        fluid.layers.array_write(y, i, arr)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    profiler.start_profiler(state="CPU")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                fetch_list=[y])
+    from paddle_tpu.fluid.profiler import _prof
+    names = {e.name for e in _prof.events}
+    profiler.stop_profiler(profile_path="")
+    assert "mul" in names or "elementwise_add" in names
+
+
+# ----------------------------------------------------------------- timeline
+def test_timeline_merge(tmp_path):
+    p0 = tmp_path / "p0.json"
+    p1 = tmp_path / "p1.json"
+    for i, p in enumerate((p0, p1)):
+        p.write_text(json.dumps({"traceEvents": [
+            {"name": f"op{i}", "ph": "X", "pid": 99, "tid": 1,
+             "ts": 0, "dur": 10}]}))
+    out = tmp_path / "t.json"
+    r = subprocess.run(
+        [sys.executable, "tools/timeline.py",
+         "--profile_path", f"w0={p0},w1={p1}",
+         "--timeline_path", str(out)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    merged = json.loads(out.read_text())
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert pids == {0, 1}
+    names = {e.get("args", {}).get("name") for e in merged["traceEvents"]
+             if e.get("ph") == "M"}
+    assert names == {"w0", "w1"}
+
+
+# ----------------------------------------------------------------- op bench
+def test_op_bench_harness():
+    sys.path.insert(0, "tools")
+    try:
+        from op_bench import bench_op, parse_inputs, parse_attrs
+    finally:
+        sys.path.pop(0)
+    res = bench_op("softmax", parse_inputs("X:8x32:float32"),
+                   parse_attrs(["axis=-1"]), repeat=5, warmup=1)
+    assert res["op"] == "softmax"
+    assert res["eager_ms"] > 0 and res["jit_ms"] > 0
+
+
+# ------------------------------------------------------ debugger/net_drawer
+def test_debugger_and_net_drawer(tmp_path):
+    from paddle_tpu.fluid import debugger, net_drawer
+    main, startup, loss = _mlp_program()
+    text = debugger.pprint_program_codes(main)
+    assert "softmax" in text and "mul" in text
+    dot = net_drawer.draw_graph(startup, main,
+                                path=str(tmp_path / "g.dot"))
+    assert dot.startswith("digraph") and "softmax" in dot
+    assert (tmp_path / "g.dot").exists()
+
+
+# ------------------------------------------------------- contrib estimators
+def test_memory_usage_and_op_freq_and_model_stat():
+    from paddle_tpu.fluid.contrib import (memory_usage, op_freq_statistic,
+                                          summary)
+    main, startup, loss = _mlp_program()
+    lo, hi = memory_usage(main, batch_size=32)
+    assert 0 < lo < hi
+    uni, adj = op_freq_statistic(main)
+    assert uni["mul"] == 2
+    assert any("mul->elementwise_add" == k for k in adj)
+    params, flops = summary(main, print_table=False)
+    assert params == 8 * 16 + 16 + 16 * 4 + 4
+    assert flops > 0
+
+
+def test_profiler_nested_sessions(tmp_path, capsys):
+    """Inner profiler context must not end the outer session."""
+    profiler.start_profiler(state="CPU")
+    with profiler.record_event("a"):
+        pass
+    with profiler.profiler(state="CPU",
+                           profile_path=str(tmp_path / "inner.json")):
+        with profiler.record_event("b"):
+            pass
+    assert profiler.is_profiling()  # outer still live
+    with profiler.record_event("c"):
+        pass
+    from paddle_tpu.fluid.profiler import _prof
+    names = [e.name for e in _prof.events]
+    profiler.stop_profiler(profile_path=str(tmp_path / "outer.json"))
+    assert names == ["a", "b", "c"]
+    assert not (tmp_path / "inner.json").exists()
+    assert (tmp_path / "outer.json").exists()
+
+
+def test_record_event_decorator():
+    calls = []
+
+    @profiler.RecordEvent("decorated")
+    def fn(x):
+        calls.append(x)
+        return x + 1
+
+    profiler.start_profiler(state="CPU")
+    assert fn(1) == 2
+    from paddle_tpu.fluid.profiler import _prof
+    names = [e.name for e in _prof.events]
+    profiler.stop_profiler(profile_path="")
+    assert names == ["decorated"] and calls == [1]
+
+
+def test_model_stat_excludes_optimizer_state_and_transpose():
+    from paddle_tpu.fluid.contrib import summary
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, 4)
+        loss = fluid.layers.mean(y)
+    p0, _ = summary(main, print_table=False)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    p1, _ = summary(main, print_table=False)
+    assert p0 == p1 == 4 * 4 + 4  # adam moments don't inflate the count
+    # transpose_Y matmul flops use the transposed output dim
+    m2, s2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m2, s2):
+        a = fluid.data("a", shape=[8, 16], dtype="float32",
+                       append_batch_size=False)
+        b = fluid.data("b", shape=[32, 16], dtype="float32",
+                       append_batch_size=False)
+        fluid.layers.matmul(a, b, transpose_y=True)
+    _, fl = summary(m2, print_table=False)
+    assert fl == 2 * 8 * 16 * 32
+
+
+def test_decoupled_decay_dygraph_mode():
+    import paddle_tpu.fluid.dygraph as dygraph
+    from paddle_tpu.fluid.dygraph import to_variable
+    from paddle_tpu.fluid.contrib import extend_with_decoupled_weight_decay
+    SGDW = extend_with_decoupled_weight_decay(fluid.optimizer.SGD)
+    with dygraph.guard():
+        net = dygraph.Linear(4, 4)
+        opt = SGDW(weight_decay=0.5, learning_rate=0.1,
+                   parameter_list=net.parameters())
+        before = np.abs(net.weight.numpy()).sum()
+        # zero input -> zero grads; only the decoupled decay moves W
+        loss = fluid.layers.reduce_mean(
+            net(to_variable(np.zeros((2, 4), "float32"))))
+        loss.backward()
+        opt.minimize(loss)
+        after = np.abs(net.weight.numpy()).sum()
+    np.testing.assert_allclose(after, before * (1 - 0.1 * 0.5), rtol=1e-5)
+
+
+def test_extend_with_decoupled_weight_decay():
+    from paddle_tpu.fluid.contrib import extend_with_decoupled_weight_decay
+    AdamW = extend_with_decoupled_weight_decay(fluid.optimizer.Adam)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, 4)
+        loss = fluid.layers.mean(y)
+        opt = AdamW(weight_decay=0.5, learning_rate=0.1)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        wname = [p.name for p in main.all_parameters()
+                 if p.shape == (4, 4)][0]
+        before = np.asarray(scope.find_var(wname).get_tensor().array).copy()
+        exe.run(main, feed={"x": np.zeros((2, 4), "float32")},
+                fetch_list=[loss])
+        after = np.asarray(scope.find_var(wname).get_tensor().array)
+    # zero input -> zero grad for W; decay still shrinks W (decoupled)
+    assert np.abs(after).sum() < np.abs(before).sum()
+    with pytest.raises(TypeError):
+        extend_with_decoupled_weight_decay(object)

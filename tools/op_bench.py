@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Single-op benchmark harness (reference:
+paddle/fluid/operators/benchmark/op_tester.cc — standalone binary
+benchmarking one op from a config of input shapes/dtypes/attrs).
+
+TPU framing: measures both the eager dispatch and the jitted (XLA-compiled)
+kernel, which is what actually runs inside a compiled program step.
+
+Usage:
+    python tools/op_bench.py --op softmax --inputs X:64x1024:float32 \
+        --attrs axis=-1 --repeat 200
+    python tools/op_bench.py --op elementwise_add \
+        --inputs X:1024x1024:float32,Y:1024x1024:float32
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def parse_inputs(spec: str):
+    """"X:64x128:float32,Y:128:int64" -> {slot: (shape, dtype)}"""
+    out = {}
+    for item in spec.split(","):
+        parts = item.split(":")
+        slot = parts[0]
+        shape = tuple(int(d) for d in parts[1].split("x")) if len(parts) > 1 \
+            else (1,)
+        dtype = parts[2] if len(parts) > 2 else "float32"
+        out[slot] = (shape, dtype)
+    return out
+
+
+def parse_attrs(items):
+    attrs = {}
+    for item in items or []:
+        k, v = item.split("=", 1)
+        try:
+            attrs[k] = json.loads(v)
+        except json.JSONDecodeError:
+            attrs[k] = v
+    return attrs
+
+
+def make_array(rng, shape, dtype):
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return rng.randint(0, 10, shape).astype(dtype)
+    return rng.rand(*shape).astype(dtype)
+
+
+def bench_op(op_type: str, input_spec, attrs, repeat=100, warmup=10,
+             grad=False, seed=0):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import OPS
+    import paddle_tpu.ops  # noqa: F401 — registrations
+    info = OPS.get(op_type)
+    rng = np.random.RandomState(seed)
+    ins = {slot: [jnp.asarray(make_array(rng, shape, dtype))]
+           for slot, (shape, dtype) in input_spec.items()}
+    attrs = dict(attrs)
+    if info.needs_rng:
+        attrs["_rng"] = jax.random.key(seed)
+    if info.stateful:
+        raise SystemExit(f"op {op_type} is host-stateful; not benchable "
+                         f"standalone")
+
+    def run(xs):
+        merged = {s: [x] for s, x in zip(ins.keys(), xs)}
+        return info.kernel(merged, attrs)
+    flat = [v[0] for v in ins.values()]
+
+    # eager
+    for _ in range(warmup):
+        jax.block_until_ready(list(run(flat).values())[0])
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        out = run(flat)
+    jax.block_until_ready(list(out.values())[0])
+    eager_ms = (time.perf_counter() - t0) / repeat * 1e3
+
+    # jitted
+    jitted = jax.jit(lambda *xs: run(list(xs)))
+    jax.block_until_ready(list(jitted(*flat).values())[0])
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        out = jitted(*flat)
+    jax.block_until_ready(list(out.values())[0])
+    jit_ms = (time.perf_counter() - t0) / repeat * 1e3
+
+    nbytes = sum(np.prod(s) * np.dtype(d).itemsize
+                 for s, d in input_spec.values())
+    return {"op": op_type, "eager_ms": round(eager_ms, 4),
+            "jit_ms": round(jit_ms, 4),
+            "approx_gbps": round(nbytes / (jit_ms * 1e-3) / 1e9, 2),
+            "repeat": repeat}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--op", required=True)
+    p.add_argument("--inputs", required=True,
+                   help="slot:shape:dtype[,slot:shape:dtype...] e.g. "
+                        "X:64x1024:float32")
+    p.add_argument("--attrs", nargs="*", help="k=v (v json-parsed)")
+    p.add_argument("--repeat", type=int, default=100)
+    p.add_argument("--warmup", type=int, default=10)
+    args = p.parse_args()
+    res = bench_op(args.op, parse_inputs(args.inputs),
+                   parse_attrs(args.attrs), args.repeat, args.warmup)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Timeline viewer/merger (reference: tools/timeline.py — converts profiler
+protobufs to chrome://tracing). Our profiler already writes chrome-trace
+JSON; this tool merges several profile files (e.g. one per worker) into one
+timeline with distinct pids, ready for chrome://tracing or Perfetto.
+
+Usage:
+    python tools/timeline.py --profile_path p0.json,p1.json \
+        --timeline_path timeline.json
+Also accepts the reference's "name=file" form: trainer0=prof0.json.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def load_profile(path: str):
+    with open(path) as f:
+        data = json.load(f)
+    if "traceEvents" not in data:
+        raise ValueError(f"{path}: not a chrome-trace JSON")
+    return data["traceEvents"]
+
+
+def merge(profiles, timeline_path: str):
+    out = {"traceEvents": [], "displayTimeUnit": "ms"}
+    for rank, (name, path) in enumerate(profiles):
+        events = load_profile(path)
+        for e in events:
+            e = dict(e)
+            e["pid"] = rank
+            out["traceEvents"].append(e)
+        # process-name metadata row so chrome://tracing labels each worker
+        out["traceEvents"].append({
+            "name": "process_name", "ph": "M", "pid": rank,
+            "args": {"name": name}})
+    with open(timeline_path, "w") as f:
+        json.dump(out, f)
+    print(f"merged {len(profiles)} profile(s) -> {timeline_path}")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--profile_path", required=True,
+                   help="comma-separated profile files; each may be "
+                        "'name=path' or bare 'path'")
+    p.add_argument("--timeline_path", default="timeline.json")
+    args = p.parse_args()
+    profiles = []
+    for item in args.profile_path.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" in item:
+            name, path = item.split("=", 1)
+        else:
+            name, path = f"worker{len(profiles)}", item
+        profiles.append((name, path))
+    merge(profiles, args.timeline_path)
+
+
+if __name__ == "__main__":
+    main()
