@@ -1,0 +1,10 @@
+"""Model zoo — the reference's book/test model families built on the fluid
+front end (reference: python/paddle/fluid/tests/book/ +
+test_imperative_{resnet,se_resnext,transformer,ptb_rnn}.py)."""
+from . import bert  # noqa: F401
+from . import resnet  # noqa: F401
+from . import transformer  # noqa: F401
+from . import word2vec  # noqa: F401
+from . import ptb_lm  # noqa: F401
+from . import se_resnext  # noqa: F401
+from . import mnist  # noqa: F401

@@ -26,21 +26,28 @@ def bert_base_config():
                 ffn=3072, max_len=512, type_vocab=2)
 
 
-def fused_multihead_attention(q, k, v, n_head, dropout_rate=0.0):
-    """One fused attention op (Pallas on TPU). q/k/v: [B, S, H]."""
+def fused_multihead_attention(q, k, v, n_head, dropout_rate=0.0,
+                              attn_bias=None, causal=False):
+    """One fused attention op (Pallas on TPU). q/k/v: [B, S, H];
+    attn_bias: optional additive mask broadcastable to [B, H, Sq, Sk]."""
     helper = LayerHelper("multihead_matmul")
     out = helper.create_variable_for_type_inference(q.dtype)
     out.shape = q.shape
+    ins = {"Q": [q], "K": [k], "V": [v]}
+    if attn_bias is not None:
+        ins["Bias"] = [attn_bias]
     helper.append_op(type="fused_attention_qkv",
-                     inputs={"Q": [q], "K": [k], "V": [v]},
+                     inputs=ins,
                      outputs={"Out": [out]},
                      attrs={"num_heads": n_head,
-                            "dropout_rate": dropout_rate})
+                            "dropout_rate": dropout_rate,
+                            "causal": causal})
     return out
 
 
 def multi_head_attention(queries, keys, values, d_model, n_head,
-                         dropout_rate=0.0, param_initializer=None):
+                         dropout_rate=0.0, param_initializer=None,
+                         attn_bias=None, causal=False):
     keys = queries if keys is None else keys
     values = keys if values is None else values
     q = layers.fc(queries, d_model, num_flatten_dims=2,
@@ -49,7 +56,8 @@ def multi_head_attention(queries, keys, values, d_model, n_head,
                   param_attr=ParamAttr(initializer=param_initializer))
     v = layers.fc(values, d_model, num_flatten_dims=2,
                   param_attr=ParamAttr(initializer=param_initializer))
-    ctx = fused_multihead_attention(q, k, v, n_head, dropout_rate)
+    ctx = fused_multihead_attention(q, k, v, n_head, dropout_rate,
+                                    attn_bias=attn_bias, causal=causal)
     return layers.fc(ctx, d_model, num_flatten_dims=2,
                      param_attr=ParamAttr(initializer=param_initializer))
 
@@ -74,9 +82,10 @@ def _add_norm(x, y, dropout_rate=0.0):
 
 
 def encoder_layer(x, d_model, n_head, d_inner, dropout_rate=0.0,
-                  param_initializer=None):
+                  param_initializer=None, attn_bias=None):
     attn = multi_head_attention(x, None, None, d_model, n_head,
-                                dropout_rate, param_initializer)
+                                dropout_rate, param_initializer,
+                                attn_bias=attn_bias)
     x = _add_norm(x, attn, dropout_rate)
     ffn = positionwise_ffn(x, d_inner, d_model, dropout_rate,
                            param_initializer)
@@ -84,11 +93,19 @@ def encoder_layer(x, d_model, n_head, d_inner, dropout_rate=0.0,
 
 
 def encoder(x, n_layer, d_model, n_head, d_inner, dropout_rate=0.0,
-            param_initializer=None):
+            param_initializer=None, attn_bias=None):
     for _ in range(n_layer):
         x = encoder_layer(x, d_model, n_head, d_inner, dropout_rate,
-                          param_initializer)
+                          param_initializer, attn_bias=attn_bias)
     return x
+
+
+def padding_attn_bias(input_mask):
+    """[B, S] 1/0 keep-mask → additive bias [B, 1, 1, S] for the fused
+    attention ops (pads get -1e9)."""
+    neg = layers.scale(input_mask, scale=-1.0, bias=1.0)
+    bias = layers.scale(neg, scale=-1e9)
+    return layers.unsqueeze(layers.unsqueeze(bias, [1]), [1])
 
 
 def bert_embedding(src_ids, pos_ids, sent_ids, cfg, dropout_rate=0.0):
@@ -112,11 +129,14 @@ def bert_embedding(src_ids, pos_ids, sent_ids, cfg, dropout_rate=0.0):
 
 
 def build_bert_pretrain_program(cfg=None, seq_len=128, dropout=0.0,
-                                lr=1e-4, mlm_frac=0.15, use_amp=False):
+                                lr=1e-4, mlm_frac=0.15, use_amp=False,
+                                use_input_mask=False):
     """Masked-LM pretraining step program. Feeds: src_ids, pos_ids,
     sent_ids [B,S] int64; mask_pos [M] int64 (flattened positions),
-    mask_label [M,1] int64. use_amp: bf16 activations via
-    contrib.mixed_precision (f32 master weights + f32 norm/softmax)."""
+    mask_label [M,1] int64; plus input_mask [B,S] float32 when
+    use_input_mask (pads excluded from attention). use_amp: bf16
+    activations via contrib.mixed_precision (f32 master weights + f32
+    norm/softmax)."""
     cfg = cfg or bert_base_config()
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -126,9 +146,16 @@ def build_bert_pretrain_program(cfg=None, seq_len=128, dropout=0.0,
         mask_pos = fluid.data("mask_pos", shape=[1], dtype="int64",
                               append_batch_size=True)
         mask_label = fluid.data("mask_label", shape=[1], dtype="int64")
+        attn_bias = None
+        extra_feeds = []
+        if use_input_mask:
+            input_mask = fluid.data("input_mask", shape=[seq_len],
+                                    dtype="float32")
+            attn_bias = padding_attn_bias(input_mask)
+            extra_feeds = [input_mask]
         x = bert_embedding(src, pos, sent, cfg, dropout)
         enc = encoder(x, cfg["layers"], cfg["hidden"], cfg["heads"],
-                      cfg["ffn"], dropout)
+                      cfg["ffn"], dropout, attn_bias=attn_bias)
         flat = layers.reshape(enc, [-1, cfg["hidden"]])
         picked = layers.gather(flat, mask_pos)
         logits = layers.fc(picked, cfg["vocab_size"])
@@ -139,4 +166,5 @@ def build_bert_pretrain_program(cfg=None, seq_len=128, dropout=0.0,
             from ..fluid.contrib import mixed_precision
             opt = mixed_precision.decorate(opt)
         opt.minimize(loss)
-    return main, startup, [src, pos, sent, mask_pos, mask_label], [loss]
+    return main, startup, \
+        [src, pos, sent, mask_pos, mask_label] + extra_feeds, [loss]

@@ -31,19 +31,47 @@ def _merge_heads(x):
     return jnp.transpose(x, (0, 2, 1, 3)).reshape(b, s, h * d)
 
 
-@register_op("fused_attention_qkv", inputs=("Q", "K", "V"),
-             diff_inputs=("Q", "K", "V"),
+@register_op("fused_attention_qkv", inputs=("Q", "K", "V", "Bias"),
+             diff_inputs=("Q", "K", "V"), needs_rng=True,
              attr_defaults={"num_heads": 1, "dropout_rate": 0.0,
                             "causal": False})
 def _fused_attention_qkv(ins, attrs):
+    """Optional Bias: additive attention mask broadcastable to
+    [B, H, Sq, Sk] (e.g. padding mask [B, 1, 1, Sk] with -inf/0).
+
+    Dispatch: the Pallas flash kernel when there is no bias and no
+    attention dropout; otherwise the einsum path (XLA fuses it), which
+    supports the additive bias and samples a dropout mask on the attention
+    probabilities (reference multi_head_attention dropout semantics).
+    Causal masking is TOP-LEFT aligned (query i sees keys <= i) on both
+    paths."""
     q = first(ins, "Q")
     k = first(ins, "K")
     v = first(ins, "V")
+    bias = first(ins, "Bias")
     h = attrs.get("num_heads", 1)
     d = q.shape[-1] // h
     sm_scale = 1.0 / math.sqrt(d)
     qh, kh, vh = (_split_heads(t, h) for t in (q, k, v))
-    o = flash_attention(qh, kh, vh, sm_scale, attrs.get("causal", False))
+    causal = attrs.get("causal", False)
+    drop = float(attrs.get("dropout_rate", 0.0) or 0.0)
+    if bias is None and drop == 0.0:
+        o = flash_attention(qh, kh, vh, sm_scale, causal)
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh).astype(jnp.float32) \
+            * sm_scale
+        if bias is not None:
+            s = s + bias.astype(jnp.float32)
+        if causal:
+            S, Sk = qh.shape[2], kh.shape[2]
+            idx_q = jnp.arange(S)[:, None]
+            idx_k = jnp.arange(Sk)[None, :]
+            s = jnp.where(idx_q >= idx_k, s, jnp.finfo(jnp.float32).min)
+        p = jax.nn.softmax(s, axis=-1).astype(qh.dtype)
+        if drop > 0.0:
+            keep = jax.random.bernoulli(attrs["_rng"], 1.0 - drop, p.shape)
+            p = jnp.where(keep, p / (1.0 - drop), 0.0).astype(p.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
     return out(Out=_merge_heads(o))
 
 
