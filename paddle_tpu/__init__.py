@@ -13,6 +13,16 @@ from . import fluid        # the Fluid-compatible front end
 from . import inference    # AnalysisPredictor engine
 from . import nn           # 2.0-preview namespaces
 from . import tensor
+from . import framework
+from . import optimizer
+from . import metric
+from . import device
+from . import distribution
+from . import incubate
+from .batch import batch
+from .framework import manual_seed, get_default_dtype, set_default_dtype
+# tensor functions at top level (reference paddle/__init__.py re-exports)
+from .tensor import *  # noqa: F401,F403
 
 # 2.0-style convenience aliases (reference: python/paddle/__init__.py
 # re-exports under torch-like names)

@@ -1,0 +1,23 @@
+"""paddle.batch (reference: python/paddle/batch.py — wraps a sample reader
+into a batched reader)."""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reader() yields samples → returns a reader yielding lists of
+    ``batch_size`` samples (reference batch.py batch)."""
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batch_reader

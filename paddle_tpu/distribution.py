@@ -1,0 +1,10 @@
+"""paddle.distribution 2.0-preview (reference: python/paddle/
+distribution.py — Uniform/Normal/Categorical over the fluid
+distributions)."""
+from __future__ import annotations
+
+from .fluid.layers.distributions import (  # noqa: F401
+    Distribution, Uniform, Normal, Categorical, MultivariateNormalDiag)
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "MultivariateNormalDiag"]

@@ -1,0 +1,44 @@
+"""paddle.framework 2.0-preview (reference: python/paddle/framework/ —
+random.py manual_seed, framework.py get/set_default_dtype + re-exports of
+the core graph types)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .fluid import core
+from .fluid.framework import (Program, Block, Operator, Variable,  # noqa
+                              Parameter, program_guard,
+                              default_main_program,
+                              default_startup_program, in_dygraph_mode)
+from .fluid.core import CPUPlace, TPUPlace, CUDAPlace  # noqa: F401
+
+__all__ = ["manual_seed", "seed", "get_default_dtype", "set_default_dtype",
+           "Program", "Block", "Operator", "Variable", "Parameter",
+           "program_guard", "default_main_program",
+           "default_startup_program", "in_dygraph_mode", "CPUPlace",
+           "TPUPlace", "CUDAPlace"]
+
+_default_dtype = "float32"
+
+
+def manual_seed(seed: int):
+    """reference framework/random.py manual_seed — seeds program RNG."""
+    core.globals_["FLAGS_seed"] = int(seed)
+    default_main_program().random_seed = int(seed)
+    default_startup_program().random_seed = int(seed)
+    return seed
+
+
+seed = manual_seed
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = np.dtype(d).name if not isinstance(d, str) else d
+    if d not in ("float16", "bfloat16", "float32", "float64"):
+        raise TypeError(f"default dtype must be a float type, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> str:
+    return _default_dtype

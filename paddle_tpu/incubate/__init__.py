@@ -1,0 +1,4 @@
+"""paddle.incubate 2.0-preview (reference: python/paddle/incubate/ — the
+hapi high-level Model API and complex-tensor helpers)."""
+from . import hapi  # noqa: F401
+from . import complex  # noqa: F401

@@ -1,0 +1,8 @@
+"""Complex-tensor helpers (reference: python/paddle/incubate/complex/ —
+a ComplexVariable carrying separate real/imag tensors plus elementwise /
+matmul ops over them; pre-dates native complex dtype support)."""
+from .tensor_op import (ComplexVariable, elementwise_add, elementwise_sub,
+                        elementwise_mul, elementwise_div, matmul, kron)
+
+__all__ = ["ComplexVariable", "elementwise_add", "elementwise_sub",
+           "elementwise_mul", "elementwise_div", "matmul", "kron"]

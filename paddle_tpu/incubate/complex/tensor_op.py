@@ -1,0 +1,91 @@
+"""Complex arithmetic over (real, imag) tensor pairs (reference:
+incubate/complex/tensor/math.py + linalg.py — the v1.7-era complex support
+kept real and imaginary parts as two fluid Variables)."""
+from __future__ import annotations
+
+from ...fluid import layers as L
+
+__all__ = ["ComplexVariable", "elementwise_add", "elementwise_sub",
+           "elementwise_mul", "elementwise_div", "matmul", "kron"]
+
+
+class ComplexVariable:
+    """A (real, imag) pair of Variables/VarBases."""
+
+    def __init__(self, real, imag):
+        self.real = real
+        self.imag = imag
+
+    @property
+    def shape(self):
+        return self.real.shape
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self.real.numpy()) + 1j * np.asarray(
+            self.imag.numpy())
+
+    __add__ = lambda s, o: elementwise_add(s, o)
+    __sub__ = lambda s, o: elementwise_sub(s, o)
+    __mul__ = lambda s, o: elementwise_mul(s, o)
+    __truediv__ = lambda s, o: elementwise_div(s, o)
+
+
+def _as_complex(x):
+    if isinstance(x, ComplexVariable):
+        return x
+    return ComplexVariable(x, L.zeros_like(x))
+
+
+def elementwise_add(x, y, axis=-1, name=None):
+    x, y = _as_complex(x), _as_complex(y)
+    return ComplexVariable(L.elementwise_add(x.real, y.real, axis=axis),
+                           L.elementwise_add(x.imag, y.imag, axis=axis))
+
+
+def elementwise_sub(x, y, axis=-1, name=None):
+    x, y = _as_complex(x), _as_complex(y)
+    return ComplexVariable(L.elementwise_sub(x.real, y.real, axis=axis),
+                           L.elementwise_sub(x.imag, y.imag, axis=axis))
+
+
+def elementwise_mul(x, y, axis=-1, name=None):
+    x, y = _as_complex(x), _as_complex(y)
+    rr = L.elementwise_mul(x.real, y.real, axis=axis)
+    ii = L.elementwise_mul(x.imag, y.imag, axis=axis)
+    ri = L.elementwise_mul(x.real, y.imag, axis=axis)
+    ir = L.elementwise_mul(x.imag, y.real, axis=axis)
+    return ComplexVariable(L.elementwise_sub(rr, ii),
+                           L.elementwise_add(ri, ir))
+
+
+def elementwise_div(x, y, axis=-1, name=None):
+    x, y = _as_complex(x), _as_complex(y)
+    denom = L.elementwise_add(
+        L.elementwise_mul(y.real, y.real, axis=axis),
+        L.elementwise_mul(y.imag, y.imag, axis=axis))
+    num = elementwise_mul(x, ComplexVariable(
+        y.real, L.scale(y.imag, scale=-1.0)))
+    return ComplexVariable(L.elementwise_div(num.real, denom),
+                           L.elementwise_div(num.imag, denom))
+
+
+def matmul(x, y, name=None):
+    x, y = _as_complex(x), _as_complex(y)
+    rr = L.matmul(x.real, y.real)
+    ii = L.matmul(x.imag, y.imag)
+    ri = L.matmul(x.real, y.imag)
+    ir = L.matmul(x.imag, y.real)
+    return ComplexVariable(L.elementwise_sub(rr, ii),
+                           L.elementwise_add(ri, ir))
+
+
+def kron(x, y, name=None):
+    from ...tensor import kron as _kron
+    x, y = _as_complex(x), _as_complex(y)
+    rr = _kron(x.real, y.real)
+    ii = _kron(x.imag, y.imag)
+    ri = _kron(x.real, y.imag)
+    ir = _kron(x.imag, y.real)
+    return ComplexVariable(L.elementwise_sub(rr, ii),
+                           L.elementwise_add(ri, ir))

@@ -1,0 +1,10 @@
+"""hapi — the high-level Model/fit API (reference:
+python/paddle/incubate/hapi/)."""
+from .model import Model, Input
+from .callbacks import Callback, CallbackList, ProgBarLogger, ModelCheckpoint
+from .loss import Loss, CrossEntropy, SoftmaxWithCrossEntropy
+from .metrics import Metric, Accuracy
+
+__all__ = ["Model", "Input", "Callback", "CallbackList", "ProgBarLogger",
+           "ModelCheckpoint", "Loss", "CrossEntropy",
+           "SoftmaxWithCrossEntropy", "Metric", "Accuracy"]

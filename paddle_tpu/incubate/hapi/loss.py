@@ -1,0 +1,44 @@
+"""hapi losses (reference: incubate/hapi/loss.py — Loss base,
+CrossEntropy, SoftmaxWithCrossEntropy)."""
+from __future__ import annotations
+
+from ...fluid import layers
+
+__all__ = ["Loss", "CrossEntropy", "SoftmaxWithCrossEntropy"]
+
+
+class Loss:
+    def __init__(self, average=True):
+        self.average = average
+
+    def forward(self, outputs, labels):
+        raise NotImplementedError
+
+    def __call__(self, outputs, labels):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        outputs = outputs if isinstance(outputs, (list, tuple)) \
+            else [outputs]
+        losses = self.forward(outputs, labels)
+        if not isinstance(losses, (list, tuple)):
+            losses = [losses]
+        if self.average:
+            losses = [layers.reduce_mean(l) for l in losses]
+        return losses
+
+
+class CrossEntropy(Loss):
+    """softmax outputs vs integer labels (reference loss.py CrossEntropy)."""
+
+    def forward(self, outputs, labels):
+        return [layers.cross_entropy(o, l)
+                for o, l in zip(outputs, labels)]
+
+
+class SoftmaxWithCrossEntropy(Loss):
+    """raw logits vs integer labels (reference loss.py
+    SoftmaxWithCrossEntropy)."""
+
+    def forward(self, outputs, labels):
+        return [layers.softmax_with_cross_entropy(o, l,
+                                                  return_softmax=False)
+                for o, l in zip(outputs, labels)]

@@ -1,0 +1,62 @@
+"""hapi metrics (reference: incubate/hapi/metrics.py — Metric base with
+add_metric_op/update/accumulate/reset; Accuracy with top-k)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Metric", "Accuracy"]
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def add_metric_op(self, pred, label):
+        """Post-process forward outputs into the tensors update() eats."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def add_metric_op(self, pred, label):
+        return pred, label
+
+    def update(self, pred, label):
+        pred = np.asarray(pred)
+        label = np.asarray(label).reshape(-1)
+        topk_idx = np.argsort(-pred, axis=-1)[:, :self.maxk]
+        corrects = topk_idx == label[:, None]
+        res = []
+        for i, k in enumerate(self.topk):
+            acc = corrects[:, :k].any(axis=1).mean()
+            self.total[i] += float(acc) * len(label)
+            self.count[i] += len(label)
+            res.append(float(acc))
+        return res if len(res) > 1 else res[0]
+
+    def accumulate(self):
+        res = [t / c if c else 0.0 for t, c in zip(self.total, self.count)]
+        return res if len(res) > 1 else res[0]
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]

@@ -1,0 +1,179 @@
+"""hapi Model — Keras-style train/eval/predict driver over a dygraph Layer
+(reference: incubate/hapi/model.py — Model with prepare/fit/evaluate/
+predict/save/load; the reference runs either a static or dygraph adapter,
+here the dygraph path IS the compiled path via the framework's tracing).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ...fluid import core
+from ...fluid import dygraph
+from ...fluid.dygraph.base import to_variable
+from .callbacks import config_callbacks
+from .loss import Loss
+from .metrics import Metric
+
+__all__ = ["Model", "Input"]
+
+
+class Input:
+    """Input spec (reference hapi/model.py Input): name/shape/dtype."""
+
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+
+
+class Model:
+    """Wraps a dygraph Layer with a training loop (reference Model)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+
+    # ------------------------------------------------------------ prepare
+    def prepare(self, optimizer=None, loss_function=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss = loss_function
+        metrics = metrics or []
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+            else [metrics]
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a hapi Metric")
+        return self
+
+    # ------------------------------------------------------- step helpers
+    def _to_vars(self, data):
+        if isinstance(data, (list, tuple)):
+            return [to_variable(np.asarray(d)) for d in data]
+        return [to_variable(np.asarray(data))]
+
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        ins = self._to_vars(inputs)
+        lbs = self._to_vars(labels) if labels is not None else []
+        outs = self.network(*ins)
+        losses = self._loss(outs, lbs) if isinstance(self._loss, Loss) \
+            else [self._loss(outs, *lbs)]
+        total = losses[0]
+        for l in losses[1:]:
+            from ...fluid import layers
+            total = layers.elementwise_add(total, l)
+        total.backward()
+        self._optimizer.minimize(total)
+        self.network.clear_gradients()
+        return [float(np.asarray(l.numpy()).ravel()[0]) for l in losses]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = self._to_vars(inputs)
+        lbs = self._to_vars(labels) if labels is not None else []
+        outs = self.network(*ins)
+        losses = self._loss(outs, lbs) if self._loss else []
+        metrics = []
+        for m in self._metrics:
+            o = outs[0] if isinstance(outs, (list, tuple)) else outs
+            pred, lab = m.add_metric_op(o.numpy(), lbs[0].numpy()
+                                        if lbs else None)
+            metrics.append(m.update(pred, lab))
+        self.network.train()
+        return ([float(np.asarray(l.numpy()).ravel()[0]) for l in losses],
+                metrics)
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        outs = self.network(*self._to_vars(inputs))
+        self.network.train()
+        if isinstance(outs, (list, tuple)):
+            return [np.asarray(o.numpy()) for o in outs]
+        return np.asarray(outs.numpy())
+
+    # ------------------------------------------------------------ fitting
+    def fit(self, train_data=None, eval_data=None, epochs=1,
+            log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            callbacks=None):
+        """train_data: callable -> iterable of (inputs, labels) batches
+        (a paddle.batch reader or any generator factory)."""
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                log_freq=log_freq, verbose=verbose,
+                                save_freq=save_freq, save_dir=save_dir,
+                                metrics=[n for m in self._metrics
+                                         for n in m.name()])
+        cbks.on_train_begin({})
+        history = []
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch, {})
+            losses = []
+            for step, batch in enumerate(train_data()):
+                inputs, labels = batch
+                cbks.on_train_batch_begin(step, {})
+                losses = self.train_batch(inputs, labels)
+                cbks.on_train_batch_end(step, {"loss": losses[0]})
+            logs = {"loss": losses[0] if losses else None}
+            if eval_data is not None:
+                logs.update(self.evaluate(eval_data, verbose=0))
+            history.append(logs)
+            cbks.on_epoch_end(epoch, logs)
+        cbks.on_train_end({})
+        return history
+
+    def evaluate(self, eval_data, log_freq=10, verbose=2, callbacks=None):
+        from .callbacks import CallbackList
+        cbks = CallbackList(callbacks or [])
+        cbks.set_model(self)
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin({})
+        losses_all = []
+        for step, batch in enumerate(eval_data()):
+            inputs, labels = batch
+            cbks.on_eval_batch_begin(step, {})
+            losses, _ = self.eval_batch(inputs, labels)
+            losses_all.extend(losses)
+            cbks.on_eval_batch_end(
+                step, {"loss": losses[0] if losses else None})
+        res = {}
+        if losses_all:
+            res["loss"] = float(np.mean(losses_all))
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            res.update(dict(zip(names, vals)))
+        cbks.on_eval_end(res)
+        if verbose:
+            print("eval: " + " - ".join(f"{k}: {v}" for k, v in
+                                        res.items()))
+        return res
+
+    def predict(self, test_data):
+        return [self.predict_batch(inputs) for inputs in test_data()]
+
+    # --------------------------------------------------------- save/load
+    def save(self, path: str):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        state = {name: np.asarray(p.numpy())
+                 for name, p in self.network.state_dict().items()}
+        with open(path + ".pdparams", "wb") as f:
+            pickle.dump(state, f)
+
+    def load(self, path: str):
+        with open(path + ".pdparams", "rb") as f:
+            state = pickle.load(f)
+        self.network.load_dict(state)
+
+    def parameters(self):
+        return self.network.parameters()

@@ -1,0 +1,10 @@
+"""paddle.metric 2.0-preview (reference: python/paddle/metric/ — Accuracy,
+Auc, Precision, Recall over the fluid metrics implementations)."""
+from __future__ import annotations
+
+from .fluid.metrics import (  # noqa: F401
+    MetricBase, Accuracy, Auc, Precision, Recall, CompositeMetric,
+    ChunkEvaluator, EditDistance)
+
+__all__ = ["MetricBase", "Accuracy", "Auc", "Precision", "Recall",
+           "CompositeMetric", "ChunkEvaluator", "EditDistance"]

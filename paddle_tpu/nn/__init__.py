@@ -4,17 +4,16 @@ python/paddle/nn/functional/ re-exports the functional forms)."""
 from __future__ import annotations
 
 # layers (classes) — the dygraph module library
-from .fluid.dygraph.nn import (Conv2D, Conv3D, Pool2D, Linear, BatchNorm,
+from ..fluid.dygraph.nn import (Conv2D, Conv3D, Pool2D, Linear, BatchNorm,
                                Dropout, Embedding, LayerNorm, GRUUnit,
                                InstanceNorm, PRelu, BilinearTensorProduct,
                                Conv2DTranspose, GroupNorm, SpectralNorm)
-from .fluid.dygraph.layers import Layer
-from .fluid.dygraph.container import Sequential, LayerList, ParameterList
+from ..fluid.dygraph.layers import Layer
+from ..fluid.dygraph.container import Sequential, LayerList, ParameterList
 
 # functional
-from .fluid import layers as _L
-
-functional = _L
+from ..fluid import layers as _L
+from . import functional
 
 relu = _L.relu
 sigmoid = _L.sigmoid

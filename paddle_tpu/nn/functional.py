@@ -1,0 +1,119 @@
+"""paddle.nn.functional (reference: python/paddle/nn/functional/ —
+activation/common/conv/extension/norm/pooling/loss re-exports of the fluid
+functional ops under torch-style names)."""
+from __future__ import annotations
+
+from ..fluid import layers as _L
+
+# activations
+relu = _L.relu
+relu6 = _L.relu6
+elu = _L.elu
+selu = _L.selu
+gelu = _L.gelu
+sigmoid = _L.sigmoid
+log_sigmoid = getattr(_L, "logsigmoid", None)
+tanh = _L.tanh
+tanhshrink = getattr(_L, "tanh_shrink", None)
+softmax = _L.softmax
+softplus = _L.softplus
+softsign = _L.softsign
+softshrink = getattr(_L, "softshrink", None)
+hardshrink = getattr(_L, "hard_shrink", None)
+hardsigmoid = _L.hard_sigmoid
+hardswish = _L.hard_swish
+swish = _L.swish
+leaky_relu = _L.leaky_relu
+prelu = _L.prelu
+maxout = _L.maxout
+thresholded_relu = getattr(_L, "thresholded_relu", None)
+erf = _L.erf
+
+# conv / pool
+conv2d = _L.conv2d
+conv3d = _L.conv3d
+conv2d_transpose = _L.conv2d_transpose
+conv3d_transpose = _L.conv3d_transpose
+avg_pool2d = lambda x, **kw: _L.pool2d(x, pool_type="avg", **kw)
+max_pool2d = lambda x, **kw: _L.pool2d(x, pool_type="max", **kw)
+adaptive_avg_pool2d = lambda x, output_size, **kw: _L.adaptive_pool2d(
+    x, output_size, pool_type="avg", **kw)
+adaptive_max_pool2d = lambda x, output_size, **kw: _L.adaptive_pool2d(
+    x, output_size, pool_type="max", **kw)
+
+# norm
+batch_norm = _L.batch_norm
+layer_norm = _L.layer_norm
+instance_norm = _L.instance_norm
+group_norm = _L.group_norm
+l2_normalize = _L.l2_normalize
+normalize = _L.l2_normalize
+
+# common
+linear = _L.fc
+dropout = _L.dropout
+embedding = _L.embedding
+one_hot = _L.one_hot
+pad = _L.pad
+pad2d = _L.pad2d
+unfold = _L.unfold
+interpolate = _L.image_resize
+upsample = _L.image_resize
+pixel_shuffle = _L.pixel_shuffle
+grid_sample = _L.grid_sampler
+affine_grid = _L.affine_grid
+label_smooth = _L.label_smooth
+
+# losses
+cross_entropy = _L.cross_entropy
+softmax_with_cross_entropy = _L.softmax_with_cross_entropy
+mse_loss = _L.mse_loss
+kl_div = _L.kldiv_loss
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    diff = _L.abs(_L.elementwise_sub(input, label))
+    if reduction == "mean":
+        return _L.reduce_mean(diff)
+    if reduction == "sum":
+        return _L.reduce_sum(diff)
+    return diff
+
+
+def _loss_op(op_type, ins, attrs=None, out_slot="Out"):
+    from ..fluid.layer_helper import LayerHelper
+    helper = LayerHelper(op_type)
+    dtype = next(v.dtype for vals in ins.values() for v in vals
+                 if v is not None)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type=op_type, inputs=ins, outputs={out_slot: [out]},
+                     attrs=attrs or {})
+    return out
+
+
+def nll_loss(input, label, weight=None, reduction="mean", name=None):
+    ins = {"X": [input], "Label": [label]}
+    if weight is not None:
+        ins["Weight"] = [weight]
+    return _loss_op("nll_loss", ins, {"reduction": reduction,
+                                      "ignore_index": -100})
+
+
+def bce_loss(input, label, reduction="mean", name=None):
+    out = _loss_op("bce_loss", {"X": [input], "Label": [label]})
+    if reduction == "mean":
+        return _L.reduce_mean(out)
+    if reduction == "sum":
+        return _L.reduce_sum(out)
+    return out
+
+
+binary_cross_entropy = bce_loss
+binary_cross_entropy_with_logits = \
+    _L.sigmoid_cross_entropy_with_logits
+margin_ranking_loss = _L.margin_rank_loss
+smooth_l1_loss = getattr(_L, "smooth_l1", None)
+ctc_loss = _L.warpctc
+npair_loss = _L.npair_loss
+square_error_cost = _L.square_error_cost
+log_loss = _L.log_loss

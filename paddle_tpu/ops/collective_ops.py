@@ -148,3 +148,17 @@ def _c_gen_nccl_id(ins, attrs):
                             "hierarchical_allreduce_inter_nranks": 1})
 def _gen_nccl_id(ins, attrs):
     return {}
+
+
+# Legacy single-op NCCL path (reference: operators/nccl/nccl_op.cu.cc —
+# the pre-c_* allreduce op). Same semantics as c_allreduce_sum on the dp
+# mesh axis; registered so reference-era programs still load.
+from .registry import OPS as _OPS
+if not _OPS.has("nccl"):
+    _nccl_info = _OPS.get_or_create("nccl")
+    _src = _OPS.get("allreduce") if _OPS.has("allreduce") else \
+        _OPS.get("c_allreduce_sum")
+    _nccl_info.kernel = _src.kernel
+    _nccl_info.no_grad = True
+    _nccl_info.stateful = _src.stateful
+    _nccl_info.attr_defaults = dict(_src.attr_defaults)

@@ -1,0 +1,19 @@
+"""paddle.optimizer 2.0-preview (reference: python/paddle/optimizer/
+__init__.py — torch-style names over the fluid optimizers)."""
+from __future__ import annotations
+
+from .fluid.optimizer import (  # noqa: F401
+    SGD, Momentum, Adagrad, Adam, Adamax, RMSProp, Adadelta, Ftrl, Lamb,
+    LarsMomentum, DecayedAdagrad, Dpsgd, ModelAverage,
+    ExponentialMovingAverage, PipelineOptimizer, RecomputeOptimizer,
+    LookaheadOptimizer)
+from .fluid.contrib.extend_optimizer import (
+    extend_with_decoupled_weight_decay as _extend)
+from .fluid.optimizer import Adam as _Adam
+
+AdamW = _extend(_Adam)
+
+__all__ = ["SGD", "Momentum", "Adagrad", "Adam", "AdamW", "Adamax",
+           "RMSProp", "Adadelta", "Ftrl", "Lamb", "LarsMomentum",
+           "DecayedAdagrad", "ModelAverage", "ExponentialMovingAverage",
+           "PipelineOptimizer", "RecomputeOptimizer", "LookaheadOptimizer"]

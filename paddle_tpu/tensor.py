@@ -1,8 +1,129 @@
 """paddle.tensor 2.0-preview namespace (reference: python/paddle/tensor/ —
-creation/linalg/math/manipulation/search re-exports of fluid ops)."""
+creation.py / linalg.py / math.py / manipulation.py / search.py / logic.py
+re-exports of fluid ops under torch-style names)."""
 from __future__ import annotations
 
 from .fluid import layers as _L
+from .fluid.layer_helper import LayerHelper as _LayerHelper
+
+
+def _build_op(op_type, ins, attrs=None, n_out=1, dtype=None,
+              out_slot="Out"):
+    """Generic single-output op builder (works in static and dygraph modes
+    through append_op routing)."""
+    helper = _LayerHelper(op_type)
+    if dtype is None:
+        for vals in ins.values():
+            for v in (vals if isinstance(vals, (list, tuple)) else [vals]):
+                if v is not None and hasattr(v, "dtype"):
+                    dtype = v.dtype
+                    break
+            if dtype is not None:
+                break
+    outs = [helper.create_variable_for_type_inference(dtype)
+            for _ in range(n_out)]
+    helper.append_op(type=op_type, inputs=ins,
+                     outputs={out_slot: outs}, attrs=attrs or {})
+    return outs[0] if n_out == 1 else outs
+
+
+# ops registered in the op set but without fluid.layers wrappers —
+# exposed here under their 2.0 names (reference tensor/linalg.py math.py)
+def bmm(x, y, name=None):
+    return _build_op("bmm", {"X": [x], "Y": [y]})
+
+
+def dot(x, y, name=None):
+    return _build_op("dot", {"X": [x], "Y": [y]})
+
+
+def cross(x, y, axis=None, name=None):
+    if axis is None:
+        # reference default: the first axis of length 3
+        for i, d in enumerate(x.shape):
+            if d == 3:
+                axis = i
+                break
+        else:
+            raise ValueError(
+                "cross: no axis of length 3 found; pass axis explicitly")
+    return _build_op("cross", {"X": [x], "Y": [y]}, {"dim": axis})
+
+
+def cholesky(x, upper=False, name=None):
+    return _build_op("cholesky", {"X": [x]}, {"upper": upper})
+
+
+def inverse(x, name=None):
+    return _build_op("inverse", {"Input": [x]}, out_slot="Output")
+
+
+def dist(x, y, p=2.0, name=None):
+    return _build_op("dist", {"X": [x], "Y": [y]}, {"p": float(p)})
+
+
+def kron(x, y, name=None):
+    return _build_op("kron", {"X": [x], "Y": [y]})
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _build_op("trace", {"Input": [x]},
+                     {"offset": offset, "axis1": axis1, "axis2": axis2})
+
+
+def flip(x, axis, name=None):
+    return _build_op("flip", {"X": [x]},
+                     {"axis": [axis] if isinstance(axis, int) else
+                      list(axis)})
+
+
+def meshgrid(*args, name=None):
+    inputs = list(args[0]) if len(args) == 1 and isinstance(
+        args[0], (list, tuple)) else list(args)
+    return _build_op("meshgrid", {"X": inputs}, n_out=len(inputs))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if dtype is None:
+        from .framework import get_default_dtype
+        dtype = get_default_dtype()
+    return _L.fill_constant(shape, dtype, fill_value)
+
+
+def tile(x, repeat_times, name=None):
+    return _L.expand(x, list(repeat_times))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    m = _L.reduce_max(x, dim=axis, keep_dim=True)
+    s = _L.reduce_sum(_L.exp(_L.elementwise_sub(x, m)), dim=axis,
+                      keep_dim=keepdim)
+    m_out = m if keepdim or axis is None else _L.squeeze(
+        m, [axis] if isinstance(axis, int) else list(axis))
+    if axis is None and not keepdim:
+        m_out = _L.reshape(m, [1])
+        s = _L.reshape(s, [1])
+    return _L.elementwise_add(_L.log(s), m_out)
+
+
+def nonzero(x, as_tuple=False):
+    from .fluid import framework as _fw
+    if _fw.in_dygraph_mode():
+        # dynamic output shape: computed on host (the static where_index
+        # op is scope-interpreted for the same reason)
+        import numpy as _np
+        import jax.numpy as _jnp
+        from .fluid.dygraph.base import VarBase
+        idx = _np.argwhere(_np.asarray(x.numpy()))
+        if as_tuple:
+            return tuple(VarBase(_jnp.asarray(idx[:, i]))
+                         for i in range(idx.shape[1]))
+        return VarBase(_jnp.asarray(idx))
+    if as_tuple:
+        raise NotImplementedError(
+            "nonzero(as_tuple=True) needs dygraph mode — static programs "
+            "have static shapes")
+    return _build_op("where_index", {"Condition": [x]}, dtype="int64")
 
 # creation
 ones = _L.ones
@@ -10,7 +131,6 @@ zeros = _L.zeros
 ones_like = _L.ones_like
 zeros_like = _L.zeros_like
 fill_constant = _L.fill_constant
-full = getattr(_L, "full", None)
 arange = _L.range
 linspace = _L.linspace
 eye = _L.eye
@@ -40,19 +160,10 @@ min = _L.reduce_min
 prod = _L.reduce_prod
 cumsum = _L.cumsum
 clip = _L.clip
-logsumexp = getattr(_L, "logsumexp", None)
-kron = getattr(_L, "kron", None)
-trace = getattr(_L, "trace", None)
 
 # linalg
 matmul = _L.matmul
-bmm = getattr(_L, "bmm", None)
-dot = getattr(_L, "dot", None)
-dist = getattr(_L, "dist", None)
 norm = getattr(_L, "l2_normalize", None)
-cholesky = getattr(_L, "cholesky", None)
-cross = getattr(_L, "cross", None)
-inverse = getattr(_L, "inverse", None)
 
 # manipulation
 concat = _L.concat
@@ -63,7 +174,6 @@ squeeze = _L.squeeze
 unsqueeze = _L.unsqueeze
 reshape = _L.reshape
 transpose = _L.transpose
-flip = getattr(_L, "flip", None)
 roll = getattr(_L, "roll", None)
 gather = _L.gather
 gather_nd = _L.gather_nd
@@ -71,12 +181,10 @@ scatter = _L.scatter
 slice = _L.slice
 strided_slice = _L.strided_slice
 expand = _L.expand
-tile = getattr(_L, "tile", None)
 flatten = _L.flatten
 unbind = getattr(_L, "unbind", None)
 unique = _L.unique
 where = _L.where
-meshgrid = getattr(_L, "meshgrid", None)
 
 # search / sort
 argmax = getattr(_L, "argmax", None)
@@ -85,4 +193,3 @@ argsort = _L.argsort
 topk = _L.topk
 index_select = getattr(_L, "index_select", None)
 index_sample = getattr(_L, "index_sample", None)
-nonzero = getattr(_L, "where_index", None)

@@ -1,0 +1,108 @@
+"""incubate.hapi Model API + incubate.complex (reference:
+python/paddle/incubate/hapi/model.py tests + incubate/complex/)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.dygraph as dygraph
+from paddle_tpu.incubate.hapi import (Model, CrossEntropy, Accuracy,
+                                      ModelCheckpoint, Callback)
+
+
+class _Net(dygraph.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = dygraph.Linear(8, 32, act="relu")
+        self.fc2 = dygraph.Linear(32, 4, act="softmax")
+
+    def forward(self, x):
+        return self.fc2(self.fc1(x))
+
+
+def _data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 8).astype("float32")
+    W = rng.rand(4, 8).astype("float32")
+    Y = (X @ W.T).argmax(1)[:, None].astype("int64")
+    return X, Y
+
+
+def _reader(X, Y, bs=16):
+    def r():
+        for i in range(0, len(X), bs):
+            yield X[i:i + bs], Y[i:i + bs]
+    return r
+
+
+def test_model_fit_evaluate_predict(tmp_path, capsys):
+    X, Y = _data()
+    with dygraph.guard():
+        net = _Net()
+        model = Model(net)
+        model.prepare(
+            optimizer=fluid.optimizer.Adam(
+                0.05, parameter_list=net.parameters()),
+            loss_function=CrossEntropy(),
+            metrics=Accuracy())
+        hist = model.fit(_reader(X, Y), eval_data=_reader(X, Y),
+                         epochs=8, verbose=0)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+        res = model.evaluate(_reader(X, Y))
+        assert res["acc"] > 0.6, res
+        preds = model.predict(lambda: (x for x, _ in _reader(X, Y)()))
+        assert np.concatenate([np.asarray(p) for p in preds]).shape \
+            == (64, 4)
+        # save / load round trip restores weights
+        p = str(tmp_path / "ckpt")
+        model.save(p)
+        w_before = net.fc1.weight.numpy().copy()
+        net.fc1.weight.set_value(np.zeros_like(w_before))
+        model.load(p)
+        np.testing.assert_array_equal(net.fc1.weight.numpy(), w_before)
+
+
+def test_model_callbacks(tmp_path):
+    X, Y = _data(32)
+    events = []
+
+    class Spy(Callback):
+        def on_epoch_begin(self, epoch, logs=None):
+            events.append(("begin", epoch))
+
+        def on_epoch_end(self, epoch, logs=None):
+            events.append(("end", epoch))
+
+    with dygraph.guard():
+        net = _Net()
+        model = Model(net)
+        model.prepare(fluid.optimizer.SGD(
+            0.1, parameter_list=net.parameters()), CrossEntropy())
+        model.fit(_reader(X, Y), epochs=2, verbose=0,
+                  callbacks=[Spy(),
+                             ModelCheckpoint(save_dir=str(tmp_path))])
+    assert ("begin", 0) in events and ("end", 1) in events
+    assert os.path.exists(tmp_path / "final.pdparams")
+
+
+def test_complex_ops():
+    from paddle_tpu.incubate.complex import (ComplexVariable,
+                                             elementwise_mul, matmul)
+    rng = np.random.RandomState(0)
+    ar, ai = rng.rand(3, 3), rng.rand(3, 3)
+    br, bi = rng.rand(3, 3), rng.rand(3, 3)
+    with dygraph.guard():
+        from paddle_tpu.fluid.dygraph import to_variable
+        a = ComplexVariable(to_variable(ar.astype("float32")),
+                            to_variable(ai.astype("float32")))
+        b = ComplexVariable(to_variable(br.astype("float32")),
+                            to_variable(bi.astype("float32")))
+        prod = elementwise_mul(a, b)
+        mm = matmul(a, b)
+        s = a + b
+    za, zb = ar + 1j * ai, br + 1j * bi
+    np.testing.assert_allclose(prod.numpy(), za * zb, rtol=1e-5)
+    np.testing.assert_allclose(mm.numpy(), za @ zb, rtol=1e-5)
+    np.testing.assert_allclose(s.numpy(), za + zb, rtol=1e-5)

@@ -1,0 +1,224 @@
+"""2.0-preview namespaces (reference: python/paddle/{nn,tensor,framework,
+optimizer,metric,device,distribution,batch}.py thin aliases over fluid)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+
+
+def _run(build_fn, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build_fn()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=list(outs))
+
+
+def test_tensor_linalg_ops():
+    rng = np.random.RandomState(0)
+    A = rng.rand(2, 3, 4).astype("float32")
+    B = rng.rand(2, 4, 5).astype("float32")
+    v = rng.rand(4).astype("float32")
+
+    def build():
+        a = fluid.data("a", shape=[3, 4], dtype="float32")
+        b = fluid.data("b", shape=[4, 5], dtype="float32")
+        x = fluid.data("x", shape=[4], dtype="float32",
+                       append_batch_size=False)
+        return (paddle.tensor.bmm(a, b), paddle.tensor.dot(x, x))
+
+    bm, dt = _run(build, {"a": A, "b": B, "x": v})
+    np.testing.assert_allclose(bm, A @ B, rtol=1e-5)
+    np.testing.assert_allclose(dt, (v * v).sum(), rtol=1e-5)
+
+
+def test_tensor_trace_flip_kron_full_tile():
+    rng = np.random.RandomState(0)
+    M = rng.rand(3, 3).astype("float32")
+
+    def build():
+        m = fluid.data("m", shape=[3, 3], dtype="float32",
+                       append_batch_size=False)
+        return (paddle.tensor.trace(m), paddle.tensor.flip(m, axis=0),
+                paddle.tensor.kron(m, m),
+                paddle.tensor.full([2, 2], 7.0),
+                paddle.tensor.logsumexp(m))
+
+    tr, fl, kr, fu, lse = _run(build, {"m": M})
+    np.testing.assert_allclose(tr, np.trace(M), rtol=1e-5)
+    np.testing.assert_allclose(fl, M[::-1], rtol=1e-6)
+    np.testing.assert_allclose(kr, np.kron(M, M), rtol=1e-5)
+    np.testing.assert_allclose(fu, np.full((2, 2), 7.0))
+    np.testing.assert_allclose(
+        np.asarray(lse).ravel()[0],
+        np.log(np.exp(M).sum()), rtol=1e-5)
+
+
+def test_tensor_cholesky_inverse_meshgrid():
+    rng = np.random.RandomState(0)
+    A = rng.rand(3, 3).astype("float32")
+    spd = (A @ A.T + 3 * np.eye(3)).astype("float32")
+
+    def build():
+        m = fluid.data("m", shape=[3, 3], dtype="float32",
+                       append_batch_size=False)
+        xs = fluid.data("xs", shape=[3], dtype="float32",
+                        append_batch_size=False)
+        ys = fluid.data("ys", shape=[2], dtype="float32",
+                        append_batch_size=False)
+        g0, g1 = paddle.tensor.meshgrid(xs, ys)
+        return (paddle.tensor.cholesky(m), paddle.tensor.inverse(m), g0, g1)
+
+    ch, inv, g0, g1 = _run(build, {"m": spd,
+                                   "xs": np.arange(3, dtype="float32"),
+                                   "ys": np.arange(2, dtype="float32")})
+    np.testing.assert_allclose(ch, np.linalg.cholesky(spd), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(inv, np.linalg.inv(spd), rtol=1e-4,
+                               atol=1e-5)
+    assert g0.shape == (3, 2) and g1.shape == (3, 2)
+
+
+def test_nn_functional_and_layers():
+    import paddle_tpu.nn.functional as F
+
+    def build():
+        x = fluid.data("x", shape=[4], dtype="float32")
+        return (F.relu(x), F.softmax(x), F.gelu(x))
+
+    X = np.array([[-1.0, 0.0, 1.0, 2.0]], "float32")
+    r, s, g = _run(build, {"x": X})
+    np.testing.assert_allclose(r, np.maximum(X, 0), rtol=1e-6)
+    np.testing.assert_allclose(s.sum(), 1.0, rtol=1e-5)
+
+
+def test_optimizer_adamw_namespace():
+    def build():
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, 2)
+        loss = fluid.layers.mean(y)
+        paddle.optimizer.AdamW(weight_decay=0.01,
+                               learning_rate=0.01).minimize(loss)
+        return (loss,)
+
+    out = _run(build, {"x": np.ones((2, 4), "float32")})
+    assert np.isfinite(np.asarray(out[0])).all()
+
+
+def test_metric_namespace():
+    m = paddle.metric.Accuracy()
+    m.update(value=np.array([0.8]), weight=10)
+    assert m.eval() == pytest.approx(0.8)
+
+
+def test_framework_seed_and_dtype():
+    paddle.manual_seed(1234)
+    assert fluid.default_main_program().random_seed == 1234
+    paddle.set_default_dtype("float64")
+    assert paddle.get_default_dtype() == "float64"
+    paddle.set_default_dtype("float32")
+    with pytest.raises(TypeError):
+        paddle.set_default_dtype("int32")
+
+
+def test_batch_reader():
+    def reader():
+        yield from range(7)
+
+    batches = list(paddle.batch(reader, 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    batches = list(paddle.batch(reader, 3, drop_last=True)())
+    assert batches == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_device_namespace():
+    d = paddle.device.get_device()
+    assert d.startswith(("cpu", "tpu"))
+    assert isinstance(paddle.device.set_device("cpu"), core.CPUPlace)
+    with pytest.raises(ValueError):
+        paddle.device.set_device("weird")
+
+
+def test_cross_default_axis_and_losses():
+    rng = np.random.RandomState(0)
+    A = rng.rand(3, 4).astype("float32")
+    B = rng.rand(3, 4).astype("float32")
+
+    def build():
+        a = fluid.data("a", shape=[3, 4], dtype="float32",
+                       append_batch_size=False)
+        b = fluid.data("b", shape=[3, 4], dtype="float32",
+                       append_batch_size=False)
+        import paddle_tpu.nn.functional as F
+        return (paddle.tensor.cross(a, b), F.l1_loss(a, b))
+
+    cr, l1 = _run(build, {"a": A, "b": B})
+    np.testing.assert_allclose(cr, np.cross(A, B, axis=0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(l1).ravel()[0],
+                               np.abs(A - B).mean(), rtol=1e-5)
+
+
+def test_nonzero_dygraph_and_as_tuple():
+    import paddle_tpu.fluid.dygraph as dygraph
+    from paddle_tpu.fluid.dygraph import to_variable
+    with dygraph.guard():
+        x = to_variable(np.array([[1, 0], [0, 2]], "float32"))
+        idx = paddle.tensor.nonzero(x)
+        np.testing.assert_array_equal(idx.numpy(),
+                                      [[0, 0], [1, 1]])
+        rows, cols = paddle.tensor.nonzero(x, as_tuple=True)
+        np.testing.assert_array_equal(rows.numpy(), [0, 1])
+        np.testing.assert_array_equal(cols.numpy(), [0, 1])
+
+
+def test_full_honors_default_dtype():
+    paddle.set_default_dtype("float64")
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            v = paddle.tensor.full([2], 1.0)
+            assert v.dtype == core.VarDesc.VarType.FP64
+    finally:
+        paddle.set_default_dtype("float32")
+
+
+def test_device_index_round_trip():
+    paddle.device.set_device("cpu")
+    assert paddle.device.get_device() == "cpu"
+    if paddle.device.is_compiled_with_tpu():
+        paddle.device.set_device("tpu:1")
+        assert paddle.device.get_device() == "tpu:1"
+        paddle.device.set_device("cpu")
+
+
+def test_model_fit_empty_reader():
+    import paddle_tpu.fluid.dygraph as dygraph
+    from paddle_tpu.incubate.hapi import Model, CrossEntropy
+    with dygraph.guard():
+        net = dygraph.Linear(4, 2)
+
+        class M(dygraph.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = net
+
+            def forward(self, x):
+                return self.fc(x)
+        model = Model(M())
+        model.prepare(fluid.optimizer.SGD(
+            0.1, parameter_list=net.parameters()), CrossEntropy())
+        hist = model.fit(lambda: iter([]), epochs=1, verbose=0)
+    assert hist[0]["loss"] is None
+
+
+def test_distribution_namespace():
+    import paddle_tpu.fluid.dygraph as dygraph
+    with dygraph.guard():
+        n = paddle.distribution.Normal(loc=0.0, scale=1.0)
+        s = n.sample([100])
+        assert np.asarray(s.numpy()).shape[0] == 100
