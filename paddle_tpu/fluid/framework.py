@@ -733,6 +733,18 @@ class Program:
 
     @staticmethod
     def parse_from_string(binary: bytes) -> "Program":
+        # native wire-format validation first (programdesc.cpp): catches
+        # truncation / dangling var refs with a precise report instead of
+        # a deep KeyError later (reference: the C++ ProgramDesc layer
+        # validates on load)
+        try:
+            from ..native import inspect_program_bytes
+            report = inspect_program_bytes(binary)
+        except Exception:
+            report = None  # native toolchain unavailable: python path only
+        if report and report.get("errors"):
+            raise ValueError(
+                "invalid ProgramDesc: " + "; ".join(report["errors"][:8]))
         pd = framework_pb2.ProgramDesc()
         pd.ParseFromString(binary)
         return Program._from_proto(pd)

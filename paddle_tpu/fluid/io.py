@@ -189,6 +189,16 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     # (reference io.py prepend_feed_ops/append_fetch_ops — the wire format
     # AnalysisPredictor and Executor both understand, executor.cc:195-306)
     block = pruned.global_block()
+    # declare the feed/fetch holder vars (reference io.py
+    # prepend_feed_ops creates the FEED_MINIBATCH/FETCH_LIST VarDescs so
+    # the serialized program is structurally complete)
+    from .core import VarDesc as _VD
+    if not block.has_var("feed"):
+        block.create_var(name="feed", type=_VD.VarType.FEED_MINIBATCH,
+                         persistable=True)
+    if not block.has_var("fetch"):
+        block.create_var(name="fetch", type=_VD.VarType.FETCH_LIST,
+                         persistable=True)
     feed_ops = []
     for i, name in enumerate(feeded_var_names):
         from .framework import Operator

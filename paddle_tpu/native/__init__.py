@@ -24,12 +24,28 @@ def _build(name: str) -> str:
     return so
 
 
+class _BuildFailed:
+    """Sentinel cached when a native build fails: attempt once per
+    process, don't re-spawn a failing compiler on every call."""
+
+    def __init__(self, err: Exception):
+        self.err = err
+
+
 def load(name: str) -> ctypes.CDLL:
     """Build (if stale) and dlopen paddle_tpu/native/<name>.cpp."""
     with _LOCK:
         lib = _LIBS.get(name)
+        if isinstance(lib, _BuildFailed):
+            raise RuntimeError(
+                f"native module '{name}' previously failed to build: "
+                f"{lib.err}") from lib.err
         if lib is None:
-            lib = _LIBS[name] = ctypes.CDLL(_build(name))
+            try:
+                lib = _LIBS[name] = ctypes.CDLL(_build(name))
+            except Exception as e:
+                _LIBS[name] = _BuildFailed(e)
+                raise
         return lib
 
 
@@ -57,3 +73,33 @@ def datafeed_lib() -> ctypes.CDLL:
         lib.df_release.argtypes = [c.c_void_p]
         lib._sigs_done = True
     return lib
+
+
+def programdesc_lib() -> ctypes.CDLL:
+    """Native ProgramDesc wire parser/validator (programdesc.cpp)."""
+    lib = load("programdesc")
+    if not getattr(lib, "_sigs_done", False):
+        c = ctypes
+        lib.pd_parse.restype = c.c_void_p
+        lib.pd_parse.argtypes = [c.c_char_p, c.c_int64]
+        lib.pd_ok.restype = c.c_int
+        lib.pd_ok.argtypes = [c.c_void_p]
+        lib.pd_json.restype = c.c_char_p
+        lib.pd_json.argtypes = [c.c_void_p]
+        lib.pd_release.argtypes = [c.c_void_p]
+        lib._sigs_done = True
+    return lib
+
+
+def inspect_program_bytes(data: bytes) -> dict:
+    """Parse+validate a serialized ProgramDesc natively; returns the JSON
+    summary dict {n_blocks, n_ops, n_vars, ops: {type: count}, errors}."""
+    import json
+    lib = programdesc_lib()
+    h = lib.pd_parse(data, len(data))
+    try:
+        # names in corrupt inputs can hold arbitrary bytes; the C++ side
+        # hex-escapes them, replace is belt-and-braces
+        return json.loads(lib.pd_json(h).decode("utf-8", "replace"))
+    finally:
+        lib.pd_release(h)

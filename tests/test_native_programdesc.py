@@ -1,0 +1,119 @@
+"""Native ProgramDesc wire parser/validator (paddle_tpu/native/
+programdesc.cpp; reference: the C++ ProgramDesc layer —
+framework/program_desc.cc over framework.proto)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.native import inspect_program_bytes
+
+
+def _program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        y = fluid.layers.fc(h, 2, act="softmax")
+    return main, startup, y
+
+
+def test_native_parse_valid_program():
+    main, _, _ = _program()
+    report = inspect_program_bytes(main.serialize_to_string())
+    assert report["errors"] == []
+    assert report["n_blocks"] == 1
+    assert report["ops"]["mul"] == 2
+    assert report["ops"]["softmax"] == 1
+    assert report["n_ops"] == sum(report["ops"].values())
+    assert report["n_vars"] >= 8
+
+
+def test_native_detects_truncation():
+    main, _, _ = _program()
+    data = main.serialize_to_string()
+    report = inspect_program_bytes(data[:len(data) // 2])
+    assert report["errors"]
+
+
+def test_native_detects_undefined_var():
+    main, _, _ = _program()
+    blk = main.global_block()
+    blk.append_op(type="relu", inputs={"X": ["no_such_var"]},
+                  outputs={"Out": ["also_missing"]})
+    report = inspect_program_bytes(main.serialize_to_string())
+    assert any("no_such_var" in e for e in report["errors"])
+
+
+def test_parse_from_string_uses_native_validation():
+    main, _, _ = _program()
+    blk = main.global_block()
+    blk.append_op(type="relu", inputs={"X": ["ghost"]},
+                  outputs={"Out": ["ghost_out"]})
+    data = main.serialize_to_string()
+    with pytest.raises(ValueError, match="ghost"):
+        fluid.Program.parse_from_string(data)
+
+
+def test_roundtrip_still_loads():
+    main, startup, y = _program()
+    prog2 = fluid.Program.parse_from_string(main.serialize_to_string())
+    assert [op.type for op in prog2.global_block().ops] == \
+        [op.type for op in main.global_block().ops]
+    # and it still executes
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # run the RAW original (prog2 lacks initialized params in scope)
+        out = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                      fetch_list=[y])
+    assert np.asarray(out[0]).shape == (2, 2)
+
+
+def test_non_utf8_names_dont_crash():
+    """Corrupt inputs can carry arbitrary bytes in names; the report must
+    come back as clean JSON, not a UnicodeDecodeError."""
+    main, _, _ = _program()
+    blk = main.global_block()
+    blk.append_op(type="relu", inputs={"X": ["ghost"]},
+                  outputs={"Out": ["g2"]})
+    data = main.serialize_to_string()
+    bad = data.replace(b"ghost", b"gh\xff\xfet")
+    report = inspect_program_bytes(bad)
+    assert report["errors"]
+    assert any("\\xff" in e for e in report["errors"])
+
+
+def test_quote_in_name_single_escape():
+    main, _, _ = _program()
+    blk = main.global_block()
+    blk.append_op(type="relu", inputs={"X": ['q"uo\\te']},
+                  outputs={"Out": ["qq"]})
+    report = inspect_program_bytes(main.serialize_to_string())
+    assert any('q"uo\\te' in e for e in report["errors"])
+
+
+def test_saved_model_declares_feed_fetch_vars(tmp_path):
+    main, startup, y = _program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(str(tmp_path / "m"), ["x"], [y], exe,
+                                      main_program=main)
+    with open(tmp_path / "m" / "__model__", "rb") as f:
+        report = inspect_program_bytes(f.read())
+    assert report["errors"] == []  # feed/fetch holder vars are declared
+
+
+def test_sub_block_validation():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32",
+                       append_batch_size=False)
+        pred = fluid.layers.reduce_sum(x) > 0.0
+        fluid.layers.cond(pred, lambda: x + 1.0, lambda: x - 1.0)
+    report = inspect_program_bytes(main.serialize_to_string())
+    assert report["n_blocks"] == 3  # global + 2 branches
+    assert report["errors"] == []

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Inspect a serialized ProgramDesc (__model__) with the native parser
+(reference: the debugging several reference tools do over ProgramDesc;
+backed by paddle_tpu/native/programdesc.cpp).
+
+Usage: python tools/inspect_program.py path/to/__model__
+"""
+import json
+import sys
+
+
+def main():
+    if len(sys.argv) != 2:
+        raise SystemExit(__doc__)
+    with open(sys.argv[1], "rb") as f:
+        data = f.read()
+    from paddle_tpu.native import inspect_program_bytes
+    print(json.dumps(inspect_program_bytes(data), indent=2))
+
+
+if __name__ == "__main__":
+    main()
