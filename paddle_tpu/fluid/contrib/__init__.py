@@ -4,6 +4,7 @@ memory/FLOPs estimators, op frequency stats."""
 from . import mixed_precision  # noqa: F401
 from . import slim  # noqa: F401
 from . import extend_optimizer  # noqa: F401
+from . import decoder  # noqa: F401
 from .extend_optimizer import (  # noqa: F401
     extend_with_decoupled_weight_decay, DecoupledWeightDecay)
 from .memory_usage_calc import memory_usage  # noqa: F401

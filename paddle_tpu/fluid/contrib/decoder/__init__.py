@@ -1,0 +1,5 @@
+"""contrib.decoder (reference: contrib/decoder/beam_search_decoder.py)."""
+from .beam_search_decoder import (InitState, StateCell, TrainingDecoder,
+                                  BeamSearchDecoder)
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder", "BeamSearchDecoder"]

@@ -111,6 +111,101 @@ class Collective(Fleet):
                                    main_program or self._origin_program,
                                    filename)
 
+    # ------------------------------------------------- epoch checkpoints
+    # (reference collective/__init__.py:236 save_check_point / :287
+    # load_check_point — HDFS-aware resumable epoch checkpoints tracked
+    # by a TrainStatus)
+    _CKPT_DIR = "__paddle_checkpoint__"
+
+    def save_check_point(self, executor, path, train_status,
+                         main_program=None, fs=None,
+                         local_cache_path=".cache",
+                         remain_all_checkpoint=False):
+        """Save persistables + train status as checkpoint N under
+        ``path/__paddle_checkpoint__/N`` via the fs client (LocalFS
+        default; pass utils.HDFSClient for a cluster store)."""
+        import json
+        import os
+        import shutil
+        if fs is None:
+            from ..utils.hdfs import LocalFS
+            fs = LocalFS()
+        root = os.path.join(path, self._CKPT_DIR)
+        fs.mkdir(root)
+        nums = self._checkpoint_nums(fs, root)
+        n = (max(nums) + 1) if nums else 0
+        local = os.path.join(local_cache_path, f"ckpt_{n}")
+        # fresh staging dir: stale files from an earlier run must not ride
+        # into (or nest under) the new checkpoint
+        shutil.rmtree(local, ignore_errors=True)
+        os.makedirs(local, exist_ok=True)
+        self.save_persistables(executor, local, main_program)
+        with open(os.path.join(local, "train_status.json"), "w") as f:
+            json.dump({"epoch_no": train_status.epoch_no}, f)
+        fs.upload(local, os.path.join(root, str(n)))
+        if not remain_all_checkpoint:
+            for old in nums:
+                fs.delete(os.path.join(root, str(old)))
+        return n
+
+    def load_check_point(self, executor, path, trainer_id=0,
+                         main_program=None, fs=None,
+                         local_cache_path=".cache", ignore_empty=True):
+        """Restore the newest checkpoint; returns a TrainStatus (epoch -1
+        when nothing saved yet and ignore_empty)."""
+        import json
+        import os
+        if fs is None:
+            from ..utils.hdfs import LocalFS
+            fs = LocalFS()
+        root = os.path.join(path, self._CKPT_DIR)
+        nums = self._checkpoint_nums(fs, root) if fs.is_exist(root) else []
+        if not nums:
+            if ignore_empty:
+                return TrainStatus(-1)
+            raise RuntimeError(f"no checkpoint under {root}")
+        n = max(nums)
+        local = os.path.join(local_cache_path, f"ckpt_load_{trainer_id}")
+        # fresh download target: hadoop -get into an existing dir nests
+        # instead of overwriting, silently restoring a stale checkpoint
+        import shutil
+        shutil.rmtree(local, ignore_errors=True)
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+        fs.download(os.path.join(root, str(n)), local)
+        fluid_io.load_persistables(executor, local,
+                                   main_program or self._origin_program)
+        with open(os.path.join(local, "train_status.json")) as f:
+            return TrainStatus(json.load(f)["epoch_no"])
+
+    @staticmethod
+    def _checkpoint_nums(fs, root):
+        import os
+        if not fs.is_exist(root):
+            return []
+        nums = []
+        for p in fs.ls(root):
+            base = os.path.basename(p.rstrip("/"))
+            if base.isdigit():
+                nums.append(int(base))
+        return nums
+
+
+class TrainStatus:
+    """Resumable-epoch tracker (reference collective/__init__.py:49)."""
+
+    def __init__(self, epoch_no: int = -1):
+        self.epoch_no = epoch_no
+
+    def next(self) -> int:
+        return self.epoch_no + 1
+
+    def __eq__(self, other):
+        return isinstance(other, TrainStatus) and \
+            self.epoch_no == other.epoch_no
+
+    def __ne__(self, other):
+        return not self == other
+
 
 fleet = Collective()
 

@@ -1,0 +1,112 @@
+"""contrib.decoder legacy API (reference: contrib/decoder/
+beam_search_decoder.py — InitState/StateCell/TrainingDecoder over
+StaticRNN + beam step construction)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.contrib.decoder import (InitState, StateCell,
+                                              TrainingDecoder,
+                                              BeamSearchDecoder)
+
+
+def test_training_decoder_gru_like():
+    """Teacher-forced decoder: h_t = tanh(W x_t + U h_{t-1}); verify the
+    unrolled StaticRNN matches a numpy loop."""
+    T, B, D, H = 4, 2, 3, 5
+    rng = np.random.RandomState(0)
+    X = rng.rand(T, B, D).astype("float32")
+    H0 = rng.rand(B, H).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[T, B, D], dtype="float32",
+                       append_batch_size=False)
+        h0 = fluid.data("h0", shape=[B, H], dtype="float32",
+                        append_batch_size=False)
+        cell = StateCell(inputs={"x": None},
+                         states={"h": InitState(init=h0)},
+                         out_state="h")
+
+        @cell.state_updater
+        def updater(c):
+            xt = c.get_input("x")
+            h_prev = c.get_state("h")
+            concat = fluid.layers.concat([xt, h_prev], axis=1)
+            h = fluid.layers.fc(concat, H, act="tanh",
+                                param_attr=fluid.ParamAttr(name="w"),
+                                bias_attr=False)
+            c.set_state("h", h)
+
+        decoder = TrainingDecoder(cell)
+        with decoder.block():
+            xt = decoder.step_input(x)
+            cell.compute_state({"x": xt})
+            decoder.output(cell.out_state())
+        outs = decoder()
+
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        W = np.asarray(scope.find_var("w").get_tensor().array)
+        got = exe.run(main, feed={"x": X, "h0": H0}, fetch_list=[outs])[0]
+    # numpy oracle
+    h = H0
+    expect = []
+    for t in range(T):
+        h = np.tanh(np.concatenate([X[t], h], axis=1) @ W)
+        expect.append(h)
+    np.testing.assert_allclose(got, np.stack(expect), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_state_cell_errors():
+    cell = StateCell({"x": None}, {}, "h")
+    with pytest.raises(ValueError):
+        cell.get_input("x")
+    with pytest.raises(ValueError):
+        cell.get_state("h")
+    with pytest.raises(RuntimeError):
+        cell.compute_state({"x": 1})
+
+
+def test_beam_search_decoder_step_builds():
+    V, B = 16, 4  # beam-width batch
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        init_ids = fluid.data("init_ids", shape=[B, 1], dtype="int64",
+                              append_batch_size=False)
+        init_scores = fluid.data("init_scores", shape=[B, 1],
+                                 dtype="float32", append_batch_size=False)
+        enc = fluid.data("enc", shape=[B, 8], dtype="float32",
+                         append_batch_size=False)
+        cell = StateCell(inputs={"x": None},
+                         states={"h": InitState(init=enc)},
+                         out_state="h")
+
+        @cell.state_updater
+        def updater(c):
+            xt = c.get_input("x")
+            h = fluid.layers.fc(
+                fluid.layers.concat([xt, c.get_state("h")], axis=1),
+                8, act="tanh")
+            c.set_state("h", h)
+
+        bsd = BeamSearchDecoder(cell, init_ids, init_scores,
+                                target_dict_dim=V, word_dim=6,
+                                beam_size=2, end_id=0)
+
+        @bsd.embedding
+        def emb(ids):
+            return fluid.layers.embedding(ids, [V, 6])
+
+        @bsd.scoring
+        def score(state):
+            return fluid.layers.fc(state, V)
+
+        sel_ids, sel_scores, parent = bsd.decode()
+    op_types = [op.type for op in main.global_block().ops]
+    assert "beam_search" in op_types
+    assert "top_k" in op_types or "topk" in op_types

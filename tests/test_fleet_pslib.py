@@ -258,3 +258,44 @@ def test_local_fs_roundtrip(tmp_path):
     assert fs.is_exist(str(tmp_path / "c.txt"))
     fs.delete(str(tmp_path / "c.txt"))
     assert not fs.is_exist(str(tmp_path / "c.txt"))
+
+
+def test_collective_checkpoint_roundtrip(tmp_path):
+    """fleet collective epoch checkpoints (reference collective
+    save_check_point:236 / load_check_point:287)."""
+    import jax.numpy as jnp
+    from paddle_tpu.fluid.incubate.fleet.collective import (Collective,
+                                                            TrainStatus)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, 2)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    f = Collective()
+    f._origin_program = main
+    ckpt_root = str(tmp_path / "out")
+    cache = str(tmp_path / "cache")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        wname = [p.name for p in main.all_parameters()][0]
+        w0 = np.asarray(scope.find_var(wname).get_tensor().array).copy()
+        n = f.save_check_point(exe, ckpt_root, TrainStatus(3),
+                               main_program=main, local_cache_path=cache)
+        assert n == 0
+        # second save rotates the old one out
+        n = f.save_check_point(exe, ckpt_root, TrainStatus(4),
+                               main_program=main, local_cache_path=cache)
+        assert n == 1
+        # clobber the weights, then restore
+        scope.var(wname).set_value(core.LoDTensor(
+            jnp.zeros_like(jnp.asarray(w0))))
+        ts = f.load_check_point(exe, ckpt_root, main_program=main,
+                                local_cache_path=cache)
+        assert ts.epoch_no == 4
+        w1 = np.asarray(scope.find_var(wname).get_tensor().array)
+    np.testing.assert_array_equal(w0, w1)
+    # empty path -> ignore_empty default
+    ts = f.load_check_point(exe, str(tmp_path / "nothing"),
+                            main_program=main, local_cache_path=cache)
+    assert ts.epoch_no == -1
