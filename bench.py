@@ -2,10 +2,14 @@
 """Benchmark entry point — prints ONE JSON line:
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Headline config (BASELINE.md): BERT-base MLM train step, samples/sec/chip,
-through the full fluid front end (Program → jitted XLA step with donation,
-Pallas flash attention). ``python bench.py mnist`` runs the MLP smoke bench
-instead. MFU is reported in the JSON payload against v5e bf16 peak.
+Headline config (BASELINE.md, the default): BERT-base MLM train step,
+samples/sec/chip, through the full fluid front end (Program → jitted XLA
+step with donation, Pallas flash attention). MFU is reported against v5e
+bf16 peak. Other modes:
+
+    python bench.py mnist       MLP smoke bench
+    python bench.py resnet      ResNet-50 train step (BASELINE row 1)
+    python bench.py allreduce   Fleet DP step time, transformer-big WMT
 """
 import json
 import sys
@@ -14,6 +18,18 @@ import time
 import numpy as np
 
 V5E_PEAK_FLOPS = 197e12  # bf16 peak per chip
+
+
+def _timed_steps(exe, main, feed, fetch_list, steps, warmup, mesh=None):
+    """Shared timing harness: warmup, then time `steps` runs, forcing a
+    host sync on the last fetch before stopping the clock."""
+    for _ in range(warmup):
+        exe.run(main, feed=feed, fetch_list=fetch_list, mesh=mesh)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(main, feed=feed, fetch_list=fetch_list, mesh=mesh)
+    _ = float(np.asarray(out[0]).ravel()[0])  # sync
+    return time.perf_counter() - t0
 
 
 def bench_mnist_mlp(batch=256, steps=60, warmup=10):
@@ -36,14 +52,8 @@ def bench_mnist_mlp(batch=256, steps=60, warmup=10):
     Y = rng.randint(0, 10, (batch, 1)).astype("int64")
     with fluid.scope_guard(scope):
         exe.run(startup)
-        for _ in range(warmup):
-            exe.run(main, feed={"img": X, "label": Y}, fetch_list=[loss])
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            out = exe.run(main, feed={"img": X, "label": Y},
-                          fetch_list=[loss])
-        _ = float(out[0][0])
-        dt = time.perf_counter() - t0
+        dt = _timed_steps(exe, main, {"img": X, "label": Y}, [loss],
+                          steps, warmup)
     return {"metric": "mnist_mlp_samples_per_sec",
             "value": round(batch * steps / dt, 1), "unit": "samples/s",
             "vs_baseline": 1.0}
@@ -74,13 +84,7 @@ def bench_bert_base(batch=256, seq_len=128, steps=20, warmup=5):
     }
     with fluid.scope_guard(scope):
         exe.run(startup)
-        for _ in range(warmup):
-            exe.run(main, feed=feed, fetch_list=fetches)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            out = exe.run(main, feed=feed, fetch_list=fetches)
-        _ = float(out[0][0])
-        dt = time.perf_counter() - t0
+        dt = _timed_steps(exe, main, feed, fetches, steps, warmup)
     sps = batch * steps / dt
     # 6·N·tokens FLOPs estimate (fwd+bwd), N = transformer params (no embed)
     h, L, f = cfg["hidden"], cfg["layers"], cfg["ffn"]
@@ -94,13 +98,89 @@ def bench_bert_base(batch=256, seq_len=128, steps=20, warmup=5):
             "batch": batch, "seq_len": seq_len}
 
 
+def bench_resnet50(batch=64, image_size=224, steps=10, warmup=3):
+    """ResNet-50 ImageNet train step (BASELINE.md row 1)."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.models.resnet import build_resnet_train_program
+
+    if jax.devices()[0].platform == "cpu":  # CPU smoke: keep tractable
+        batch, image_size, steps = 8, 64, 3
+    main, startup, feeds, fetches = build_resnet_train_program(
+        depth=50, class_dim=1000, image_size=image_size)
+    loss = fetches[0]
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    img = rng.rand(batch, 3, image_size, image_size).astype("float32")
+    lbl = rng.randint(0, 1000, (batch, 1)).astype("int64")
+    feed = {"image": img, "label": lbl}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        dt = _timed_steps(exe, main, feed, [loss], steps, warmup)
+    sps = batch * steps / dt
+    # ~3.8 GFLOPs fwd per 224x224 sample (scales ~quadratically with
+    # resolution); x3 for fwd+bwd
+    flops_fwd = 3.8e9 * (image_size / 224.0) ** 2
+    mfu = sps * flops_fwd * 3 / V5E_PEAK_FLOPS
+    return {"metric": "resnet50_samples_per_sec_per_chip",
+            "value": round(sps, 2), "unit": "samples/s",
+            "vs_baseline": 1.0, "mfu_vs_v5e_bf16_peak": round(mfu, 4),
+            "batch": batch}
+
+
+def bench_allreduce_dp(steps=10, warmup=3):
+    """Fleet-collective data-parallel step time over the available mesh
+    (BASELINE.md: allreduce step-time, Transformer-big WMT config scaled
+    to fit). XLA inserts the grad all-reduce over ICI inside the one
+    jitted step; this measures the whole DP step including it."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.parallel.mesh import build_mesh
+    from paddle_tpu.models.transformer import (build_wmt_train_program,
+                                               transformer_big_config)
+
+    n_dev = len(jax.devices())
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    cfg = transformer_big_config()
+    cfg.update(src_vocab=4096, trg_vocab=4096, enc_layers=2, dec_layers=2,
+               dropout=0.0)
+    if not on_tpu:  # CPU smoke: shrink to keep compile+run tractable
+        cfg.update(d_model=128, d_inner=256, heads=4)
+    B, S = (8 if on_tpu else 2) * max(1, n_dev), 64 if on_tpu else 16
+    main, startup, feeds, loss = build_wmt_train_program(
+        cfg, src_len=S, trg_len=S, lr=1e-4)
+    mesh = build_mesh(n_dev) if n_dev > 1 else None
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    sv, tv = cfg["src_vocab"], cfg["trg_vocab"]
+    feed = {
+        "src_ids": rng.randint(0, sv, (B, S)).astype("int64"),
+        "src_mask": np.ones((B, S), "float32"),
+        "trg_ids": rng.randint(0, tv, (B, S)).astype("int64"),
+        "trg_mask": np.ones((B, S), "float32"),
+        "labels": rng.randint(0, tv, (B, S, 1)).astype("int64"),
+    }
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        dt = _timed_steps(exe, main, feed, [loss], steps, warmup,
+                          mesh=mesh)
+    return {"metric": "fleet_dp_step_ms_transformer_big",
+            "value": round(dt / steps * 1e3, 2), "unit": "ms/step",
+            "vs_baseline": 1.0, "devices": n_dev, "batch": B}
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "bert"
-    if which == "mnist":
-        res = bench_mnist_mlp()
-    else:
-        res = bench_bert_base()
-    print(json.dumps(res))
+    benches = {"bert": bench_bert_base, "mnist": bench_mnist_mlp,
+               "resnet": bench_resnet50, "allreduce": bench_allreduce_dp}
+    if which not in benches:
+        raise SystemExit(f"unknown bench '{which}'; one of "
+                         f"{sorted(benches)}")
+    print(json.dumps(benches[which]()))
 
 
 if __name__ == "__main__":

@@ -10,3 +10,6 @@ from .extend_optimizer import (  # noqa: F401
 from .memory_usage_calc import memory_usage  # noqa: F401
 from .op_frequence import op_freq_statistic  # noqa: F401
 from .model_stat import summary  # noqa: F401
+from . import layers  # noqa: F401
+from . import reader  # noqa: F401
+from . import quantize  # noqa: F401

@@ -1,0 +1,16 @@
+"""contrib.layers (reference: contrib/layers/ — nn.py specialty-op
+wrappers, rnn_impl.py basic GRU/LSTM, metric_op.py ctr metric bundle)."""
+from .nn import (fused_elemwise_activation, var_conv_2d,
+                 match_matrix_tensor, sequence_topk_avg_pooling, tree_conv,
+                 fused_embedding_seq_pool, multiclass_nms2, shuffle_batch,
+                 partial_concat, partial_sum, rank_attention, batch_fc)
+from .rnn_impl import BasicGRUUnit, BasicLSTMUnit, basic_gru, basic_lstm
+from .metric_op import ctr_metric_bundle
+
+__all__ = [
+    "fused_elemwise_activation", "var_conv_2d", "match_matrix_tensor",
+    "sequence_topk_avg_pooling", "tree_conv", "fused_embedding_seq_pool",
+    "multiclass_nms2", "shuffle_batch", "partial_concat", "partial_sum",
+    "rank_attention", "batch_fc", "BasicGRUUnit", "BasicLSTMUnit",
+    "basic_gru", "basic_lstm", "ctr_metric_bundle",
+]

@@ -1,0 +1,281 @@
+"""Basic GRU/LSTM built from elementary ops (reference:
+contrib/layers/rnn_impl.py — BasicGRUUnit/BasicLSTMUnit dygraph-style
+units plus basic_gru/basic_lstm sequence runners; here the sequence loop
+is the framework's StaticRNN unroll → lax.scan under XLA)."""
+from __future__ import annotations
+
+from ... import layers
+from ...dygraph import Layer
+from ...param_attr import ParamAttr
+
+__all__ = ["BasicGRUUnit", "BasicLSTMUnit", "basic_gru", "basic_lstm"]
+
+
+class BasicGRUUnit(Layer):
+    """One GRU step (reference rnn_impl.py BasicGRUUnit)."""
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype="float32"):
+        super().__init__(name_scope)
+        self._hidden_size = hidden_size
+        self._gate_act = gate_activation or layers.sigmoid
+        self._act = activation or layers.tanh
+        self._dtype = dtype
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._built = False
+
+    def _build_once(self, input):
+        in_dim = int(input.shape[-1])
+        H = self._hidden_size
+        self._gate_w = self.create_parameter(
+            [in_dim + H, 2 * H], attr=self._param_attr, dtype=self._dtype)
+        self._gate_b = self.create_parameter(
+            [2 * H], attr=self._bias_attr, dtype=self._dtype, is_bias=True)
+        self._cand_w = self.create_parameter(
+            [in_dim + H, H], attr=self._param_attr, dtype=self._dtype)
+        self._cand_b = self.create_parameter(
+            [H], attr=self._bias_attr, dtype=self._dtype, is_bias=True)
+        self._built = True
+
+    def forward(self, input, pre_hidden):
+        if not self._built:
+            self._build_once(input)
+        concat = layers.concat([input, pre_hidden], axis=1)
+        gates = layers.elementwise_add(
+            layers.matmul(concat, self._gate_w), self._gate_b)
+        # reference gate order: (reset, update)
+        r, u = layers.split(self._gate_act(gates), 2, dim=1)
+        r_hidden = layers.elementwise_mul(r, pre_hidden)
+        cand = self._act(layers.elementwise_add(
+            layers.matmul(layers.concat([input, r_hidden], axis=1),
+                          self._cand_w), self._cand_b))
+        one_minus_u = layers.scale(u, scale=-1.0, bias=1.0)
+        return layers.elementwise_add(
+            layers.elementwise_mul(pre_hidden, u),
+            layers.elementwise_mul(cand, one_minus_u))
+
+
+class BasicLSTMUnit(Layer):
+    """One LSTM step (reference rnn_impl.py BasicLSTMUnit)."""
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32"):
+        super().__init__(name_scope)
+        self._hidden_size = hidden_size
+        self._gate_act = gate_activation or layers.sigmoid
+        self._act = activation or layers.tanh
+        self._forget_bias = forget_bias
+        self._dtype = dtype
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._built = False
+
+    def _build_once(self, input):
+        in_dim = int(input.shape[-1])
+        H = self._hidden_size
+        self._w = self.create_parameter(
+            [in_dim + H, 4 * H], attr=self._param_attr, dtype=self._dtype)
+        self._b = self.create_parameter(
+            [4 * H], attr=self._bias_attr, dtype=self._dtype, is_bias=True)
+        self._built = True
+
+    def forward(self, input, pre_hidden, pre_cell):
+        if not self._built:
+            self._build_once(input)
+        concat = layers.concat([input, pre_hidden], axis=1)
+        gates = layers.elementwise_add(layers.matmul(concat, self._w),
+                                       self._b)
+        i, j, f, o = layers.split(gates, 4, dim=1)
+        f = layers.scale(f, bias=self._forget_bias)
+        new_cell = layers.elementwise_add(
+            layers.elementwise_mul(pre_cell, self._gate_act(f)),
+            layers.elementwise_mul(self._gate_act(i), self._act(j)))
+        new_hidden = layers.elementwise_mul(self._act(new_cell),
+                                            self._gate_act(o))
+        return new_hidden, new_cell
+
+
+def _run_static_rnn(input, init_states, step_fn, time_major):
+    """Unroll step_fn over time with StaticRNN; input [T,B,D] inside."""
+    if not time_major:
+        input = layers.transpose(input, [1, 0, 2])
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(input)
+        mems = [rnn.memory(init=s) for s in init_states]
+        outs, new_states = step_fn(x_t, mems)
+        for m, ns in zip(mems, new_states):
+            rnn.update_memory(m, ns)
+        rnn.step_output(outs)
+    out = rnn()
+    if not time_major:
+        out = layers.transpose(out, [1, 0, 2])
+    return out
+
+
+def _gru_stack(x, init_hidden, hidden_size, num_layers, dropout_prob,
+               batch_first, param_attr, bias_attr, gate_activation,
+               activation, dtype, name):
+    batch_dim = 0 if batch_first else 1
+    lasts = []
+    for layer in range(num_layers):
+        unit = BasicGRUUnit(f"{name}_l{layer}", hidden_size, param_attr,
+                            bias_attr, gate_activation, activation, dtype)
+        if init_hidden is not None:
+            h0 = layers.squeeze(
+                layers.slice(init_hidden, axes=[0], starts=[layer],
+                             ends=[layer + 1]), [0])
+        else:
+            h0 = layers.fill_constant_batch_size_like(
+                x, [-1, hidden_size], dtype, 0.0,
+                input_dim_idx=batch_dim)
+
+        def step(x_t, mems, _unit=unit):
+            h = _unit(x_t, mems[0])
+            return h, [h]
+
+        x = _run_static_rnn(x, [h0], step, time_major=not batch_first)
+        if dropout_prob:
+            x = layers.dropout(x, dropout_prob)
+        time_axis = 1 if batch_first else 0
+        last = layers.slice(x, axes=[time_axis], starts=[-1],
+                            ends=[2 ** 31 - 1])
+        if batch_first:
+            last = layers.transpose(last, [1, 0, 2])  # → [1, B, H]
+        lasts.append(last)
+    return x, layers.concat(lasts, axis=0)  # out, [num_layers, B, H]
+
+
+def _run_static_rnn_multi(input, init_states, step_fn, time_major):
+    """Like _run_static_rnn but step_fn returns (tuple_of_outputs,
+    new_states); all output sequences come back (same layout as input)."""
+    if not time_major:
+        input = layers.transpose(input, [1, 0, 2])
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(input)
+        mems = [rnn.memory(init=s) for s in init_states]
+        outs, new_states = step_fn(x_t, mems)
+        for m, ns in zip(mems, new_states):
+            rnn.update_memory(m, ns)
+        rnn.output(*outs)
+    result = rnn()
+    if not isinstance(result, (list, tuple)):
+        result = [result]
+    if not time_major:
+        result = [layers.transpose(r, [1, 0, 2]) for r in result]
+    return tuple(result)
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype="float32",
+              name="basic_gru"):
+    """Multi-layer GRU over a sequence (reference rnn_impl.py basic_gru).
+    init_hidden: [num_layers(*2 if bidirectional), B, H] or None. Returns
+    (output, last_hidden). Fixed-length windows only (the TPU batching
+    discipline): ragged batches should be packed/padded upstream."""
+    if sequence_length is not None:
+        raise NotImplementedError(
+            "basic_gru: per-sample sequence_length is not supported — pad "
+            "or pack to fixed length (see SURVEY §5 long-context notes)")
+    fwd_init = bwd_init = init_hidden
+    if init_hidden is not None and bidirectional:
+        fwd_init = layers.slice(init_hidden, axes=[0], starts=[0],
+                                ends=[num_layers])
+        bwd_init = layers.slice(init_hidden, axes=[0],
+                                starts=[num_layers],
+                                ends=[2 * num_layers])
+    out, last = _gru_stack(input, fwd_init, hidden_size, num_layers,
+                           dropout_prob, batch_first, param_attr,
+                           bias_attr, gate_activation, activation, dtype,
+                           name)
+    if not bidirectional:
+        return out, last
+    time_axis = 1 if batch_first else 0
+    rev_in = layers.reverse(input, axis=time_axis)
+    rout, rlast = _gru_stack(rev_in, bwd_init, hidden_size, num_layers,
+                             dropout_prob, batch_first, param_attr,
+                             bias_attr, gate_activation, activation,
+                             dtype, name + "_reverse")
+    rout = layers.reverse(rout, axis=time_axis)
+    return (layers.concat([out, rout], axis=2),
+            layers.concat([last, rlast], axis=0))
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0,
+               bidirectional=False, batch_first=True, param_attr=None,
+               bias_attr=None, gate_activation=None, activation=None,
+               forget_bias=1.0, dtype="float32", name="basic_lstm"):
+    """Multi-layer LSTM over BasicLSTMUnit (reference rnn_impl.py
+    basic_lstm — same gate math incl. forget_bias and custom
+    activations). Returns (output, last_hidden, last_cell) with state
+    shapes [num_layers(*2 if bidirectional), B, H]."""
+    if sequence_length is not None:
+        raise NotImplementedError(
+            "basic_lstm: per-sample sequence_length is not supported — "
+            "pad or pack to fixed length")
+
+    def stack(x, ih, ic, tag):
+        batch_dim = 0 if batch_first else 1
+        lh, lc = [], []
+        for layer in range(num_layers):
+            unit = BasicLSTMUnit(f"{tag}_l{layer}", hidden_size,
+                                 param_attr, bias_attr, gate_activation,
+                                 activation, forget_bias, dtype)
+
+            def pick(src):
+                if src is None:
+                    return layers.fill_constant_batch_size_like(
+                        x, [-1, hidden_size], dtype, 0.0,
+                        input_dim_idx=batch_dim)
+                return layers.squeeze(
+                    layers.slice(src, axes=[0], starts=[layer],
+                                 ends=[layer + 1]), [0])
+
+            def step(x_t, mems, _unit=unit):
+                h, c = _unit(x_t, mems[0], mems[1])
+                return (h, c), [h, c]
+
+            h_seq, c_seq = _run_static_rnn_multi(
+                x, [pick(ih), pick(ic)], step,
+                time_major=not batch_first)
+            if dropout_prob:
+                h_seq = layers.dropout(h_seq, dropout_prob)
+            x = h_seq
+            time_axis = 1 if batch_first else 0
+            for seq, acc in ((h_seq, lh), (c_seq, lc)):
+                last = layers.slice(seq, axes=[time_axis], starts=[-1],
+                                    ends=[2 ** 31 - 1])
+                if batch_first:
+                    last = layers.transpose(last, [1, 0, 2])
+                acc.append(last)
+        return x, layers.concat(lh, axis=0), layers.concat(lc, axis=0)
+
+    fwd_ih = bwd_ih = init_hidden
+    fwd_ic = bwd_ic = init_cell
+    if bidirectional and init_hidden is not None:
+        fwd_ih = layers.slice(init_hidden, axes=[0], starts=[0],
+                              ends=[num_layers])
+        bwd_ih = layers.slice(init_hidden, axes=[0], starts=[num_layers],
+                              ends=[2 * num_layers])
+    if bidirectional and init_cell is not None:
+        fwd_ic = layers.slice(init_cell, axes=[0], starts=[0],
+                              ends=[num_layers])
+        bwd_ic = layers.slice(init_cell, axes=[0], starts=[num_layers],
+                              ends=[2 * num_layers])
+    out, lh, lc = stack(input, fwd_ih, fwd_ic, name)
+    if not bidirectional:
+        return out, lh, lc
+    time_axis = 1 if batch_first else 0
+    rev = layers.reverse(input, axis=time_axis)
+    rout, rlh, rlc = stack(rev, bwd_ih, bwd_ic, name + "_reverse")
+    rout = layers.reverse(rout, axis=time_axis)
+    return (layers.concat([out, rout], axis=2),
+            layers.concat([lh, rlh], axis=0),
+            layers.concat([lc, rlc], axis=0))

@@ -1,0 +1,6 @@
+"""contrib.quantize (reference: contrib/quantize/quantize_transpiler.py —
+the pre-slim quantization transpiler; same program rewrite as
+slim.quantization here)."""
+from .quantize_transpiler import QuantizeTranspiler
+
+__all__ = ["QuantizeTranspiler"]

@@ -1,0 +1,44 @@
+"""QuantizeTranspiler (reference: contrib/quantize/quantize_transpiler.py
+— training_transpile inserts fake-quant ops; freeze_program flips them for
+deployment). Delegates to the slim quantization pass, which owns the
+program rewrite in this build."""
+from __future__ import annotations
+
+from ..slim.quantization.quantization_pass import (quantize_program,
+                                                   QuantizationFreezePass)
+
+__all__ = ["QuantizeTranspiler"]
+
+
+class QuantizeTranspiler:
+    _ACT_TYPES = ("abs_max", "moving_average_abs_max")
+    _WEIGHT_TYPES = ("abs_max",)
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 activation_quantize_type: str = "abs_max",
+                 weight_quantize_type: str = "abs_max",
+                 window_size: int = 10000, moving_rate: float = 0.9):
+        if activation_quantize_type not in self._ACT_TYPES:
+            raise NotImplementedError(
+                f"activation_quantize_type "
+                f"'{activation_quantize_type}' not supported; one of "
+                f"{self._ACT_TYPES}")
+        if weight_quantize_type not in self._WEIGHT_TYPES:
+            raise NotImplementedError(
+                f"weight_quantize_type '{weight_quantize_type}' not "
+                f"supported; one of {self._WEIGHT_TYPES}")
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+
+    def training_transpile(self, program=None, startup_program=None):
+        """Insert fake quant-dequant for QAT (reference
+        training_transpile)."""
+        return quantize_program(program, startup_program,
+                                weight_bits=self.weight_bits,
+                                activation_bits=self.activation_bits,
+                                moving_rate=self.moving_rate)
+
+    def freeze_program(self, program, place=None, scope=None):
+        """Flip quant ops to inference mode (reference freeze_program)."""
+        return QuantizationFreezePass().apply(program)

@@ -1,0 +1,4 @@
+"""contrib.reader (reference: contrib/reader/distributed_reader.py)."""
+from .distributed_reader import distributed_batch_reader
+
+__all__ = ["distributed_batch_reader"]

@@ -1,0 +1,24 @@
+"""Shard a batch reader across trainers (reference:
+contrib/reader/distributed_reader.py — round-robin batches by
+PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM so each worker sees a disjoint
+stream)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    if trainer_id >= trainers:
+        raise ValueError(
+            f"PADDLE_TRAINER_ID {trainer_id} >= PADDLE_TRAINERS_NUM "
+            f"{trainers}")
+
+    def decorated():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainers == trainer_id:
+                yield batch
+    return decorated

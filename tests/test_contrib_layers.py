@@ -1,0 +1,208 @@
+"""contrib.layers / reader / quantize (reference: contrib/layers/nn.py,
+rnn_impl.py, metric_op.py; contrib/reader/distributed_reader.py;
+contrib/quantize/quantize_transpiler.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.contrib import layers as contrib_layers
+
+
+def _run(main, startup, feed, fetch_list):
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch_list)
+
+
+def test_fused_elemwise_activation():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.data("y", shape=[4], dtype="float32")
+        # unary-first => Unary(Binary(X, Y)); binary-first would be
+        # Binary(X, Unary(Y)) per the reference functor convention
+        out = contrib_layers.fused_elemwise_activation(
+            x, y, ["relu", "elementwise_add"])
+    X = np.array([[-2.0, -1.0, 1.0, 2.0]], "float32")
+    Y = np.array([[1.0, 0.0, -3.0, 1.0]], "float32")
+    got = _run(main, startup, {"x": X, "y": Y}, [out])[0]
+    np.testing.assert_allclose(got, np.maximum(X + Y, 0), rtol=1e-6)
+
+
+def test_partial_concat_and_sum():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.data("a", shape=[4], dtype="float32")
+        b = fluid.data("b", shape=[4], dtype="float32")
+        pc = contrib_layers.partial_concat([a, b], start_index=1, length=2)
+        ps = contrib_layers.partial_sum([a, b], start_index=0, length=3)
+    A = np.arange(8, dtype="float32").reshape(2, 4)
+    B = A + 10
+    pcv, psv = _run(main, startup, {"a": A, "b": B}, [pc, ps])
+    np.testing.assert_allclose(
+        pcv, np.concatenate([A[:, 1:3], B[:, 1:3]], axis=1))
+    np.testing.assert_allclose(psv, A[:, :3] + B[:, :3])
+
+
+def test_batch_fc():
+    # Input [slot, batch, in] with per-slot weights [slot, in, out]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2, 5, 3], dtype="float32",
+                              append_batch_size=False)
+        out = contrib_layers.batch_fc(
+            x, param_size=[2, 3, 4], param_attr=fluid.ParamAttr(name="bw"),
+            bias_size=[2, 1, 4], bias_attr=fluid.ParamAttr(name="bb"))
+    X = np.random.RandomState(0).rand(2, 5, 3).astype("float32")
+    got = _run(main, startup, {"x": X}, [out])[0]
+    assert got.shape == (2, 5, 4)
+    assert (got >= 0).all()  # kernel applies relu
+
+
+def test_basic_gru_runs_and_shapes():
+    from paddle_tpu.fluid.contrib.layers import basic_gru
+    B, T, D, H = 2, 5, 3, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, D], dtype="float32")
+        out, last = basic_gru(x, None, H, num_layers=2)
+    X = np.random.RandomState(0).rand(B, T, D).astype("float32")
+    o, l = _run(main, startup, {"x": X}, [out, last])
+    assert o.shape == (B, T, H)
+    assert l.shape == (2, B, H)
+    np.testing.assert_allclose(o[:, -1], l[1], rtol=1e-5)
+
+
+def test_basic_lstm_runs_and_matches_numpy():
+    from paddle_tpu.fluid.contrib.layers import basic_lstm
+    B, T, D, H = 2, 4, 3, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, D], dtype="float32")
+        out, lh, lc = basic_lstm(x, None, None, H, num_layers=1,
+                                 forget_bias=1.0,
+                                 param_attr=fluid.ParamAttr(name="lw"),
+                                 bias_attr=fluid.ParamAttr(name="lb"))
+    X = np.random.RandomState(0).rand(B, T, D).astype("float32")
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        W = np.asarray(scope.find_var("lw").get_tensor().array)
+        bias = np.asarray(scope.find_var("lb").get_tensor().array)
+        o, h, c = exe.run(main, feed={"x": X}, fetch_list=[out, lh, lc])
+    assert o.shape == (B, T, H)
+    assert h.shape == (1, B, H) and c.shape == (1, B, H)
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+    hh = np.zeros((B, H)); cc = np.zeros((B, H))
+    for t in range(T):
+        g = np.concatenate([X[:, t], hh], axis=1) @ W + bias
+        i, j, f, oo = np.split(g, 4, axis=1)
+        cc = cc * sig(f + 1.0) + sig(i) * np.tanh(j)
+        hh = np.tanh(cc) * sig(oo)
+    np.testing.assert_allclose(o[:, -1], hh, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(c[0], cc, rtol=1e-4, atol=1e-5)
+
+
+def test_basic_gru_time_major_and_bidirectional():
+    from paddle_tpu.fluid.contrib.layers import basic_gru
+    B, T, D, H = 3, 5, 2, 4  # T != B to catch batch-dim mixups
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, B, D], dtype="float32",
+                              append_batch_size=False)
+        out, last = basic_gru(x, None, H, num_layers=1,
+                              batch_first=False)
+        xb = fluid.layers.data("xb", shape=[B, T, D], dtype="float32",
+                               append_batch_size=False)
+        bout, blast = basic_gru(xb, None, H, num_layers=1,
+                                bidirectional=True)
+    Xtm = np.random.RandomState(0).rand(T, B, D).astype("float32")
+    Xbf = np.transpose(Xtm, (1, 0, 2))
+    o, l, bo, bl = _run(main, startup, {"x": Xtm, "xb": Xbf},
+                        [out, last, bout, blast])
+    assert o.shape == (T, B, H) and l.shape == (1, B, H)
+    assert bo.shape == (B, T, 2 * H) and bl.shape == (2, B, H)
+
+
+def test_contrib_api_guards():
+    import pytest as _pytest
+    from paddle_tpu.fluid.contrib.layers import (basic_gru,
+                                                 multiclass_nms2)
+    from paddle_tpu.fluid.contrib.quantize import QuantizeTranspiler
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4, 2], dtype="float32")
+        with _pytest.raises(NotImplementedError):
+            basic_gru(x, None, 4, sequence_length=x)
+        bb = fluid.layers.data("bb", shape=[4, 4], dtype="float32")
+        sc = fluid.layers.data("sc", shape=[2, 4], dtype="float32")
+        with _pytest.raises(NotImplementedError):
+            multiclass_nms2(bb, sc, 0.1, 10, 5, return_index=True)
+    with _pytest.raises(NotImplementedError):
+        QuantizeTranspiler(weight_quantize_type="channel_wise_abs_max")
+
+
+def test_ctr_metric_bundle_accumulates():
+    from paddle_tpu.fluid.contrib.layers import ctr_metric_bundle
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pred = fluid.layers.data("pred", shape=[1], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="float32")
+        stats = ctr_metric_bundle(pred, label)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    P = np.array([[0.2], [0.8]], "float32")
+    L = np.array([[0.0], [1.0]], "float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"pred": P, "label": L},
+                fetch_list=list(stats))
+        vals = exe.run(main, feed={"pred": P, "label": L},
+                       fetch_list=list(stats))
+    sqr, abse, prob, q, pos, total = [float(np.asarray(v).ravel()[0])
+                                      for v in vals]
+    assert total == pytest.approx(4.0)   # two batches of 2
+    assert pos == pytest.approx(2.0)
+    assert prob == pytest.approx(2.0)    # 2*(0.2+0.8)
+    assert q == pytest.approx(1.6)       # 2*0.8
+    assert sqr == pytest.approx(2 * (0.04 + 0.04))
+
+
+def test_distributed_batch_reader_shards():
+    from paddle_tpu.fluid.contrib.reader import distributed_batch_reader
+
+    def reader():
+        yield from range(10)
+
+    os.environ["PADDLE_TRAINER_ID"] = "1"
+    os.environ["PADDLE_TRAINERS_NUM"] = "3"
+    try:
+        got = list(distributed_batch_reader(reader)())
+    finally:
+        os.environ.pop("PADDLE_TRAINER_ID")
+        os.environ.pop("PADDLE_TRAINERS_NUM")
+    assert got == [1, 4, 7]
+
+
+def test_quantize_transpiler_delegates():
+    from paddle_tpu.fluid.contrib.quantize import QuantizeTranspiler
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        fluid.layers.fc(x, 2)
+    qt = QuantizeTranspiler()
+    qt.training_transpile(main, startup)
+    assert any("fake_quantize" in op.type
+               for op in main.global_block().ops)
+    qt.freeze_program(main)
+    assert all(op.attrs.get("is_test", True)
+               for op in main.global_block().ops
+               if op.type.startswith("fake_quantize"))
