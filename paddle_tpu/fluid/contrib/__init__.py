@@ -13,3 +13,4 @@ from .model_stat import summary  # noqa: F401
 from . import layers  # noqa: F401
 from . import reader  # noqa: F401
 from . import quantize  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,9 @@
+"""contrib.utils (reference: contrib/utils/ — hdfs_utils re-exports the
+HDFS client; lookup_table_utils converts distributed-lookup programs for
+increment/inference loading)."""
+from .hdfs_utils import HDFSClient, multi_download, multi_upload
+from .lookup_table_utils import (convert_dist_to_sparse_program,
+                                 get_inference_model)
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload",
+           "convert_dist_to_sparse_program", "get_inference_model"]

@@ -1,0 +1,58 @@
+"""HDFS helpers (reference: contrib/utils/hdfs_utils.py — HDFSClient +
+multi_download/multi_upload thread pools over hadoop fs)."""
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import List
+
+from ...incubate.fleet.utils.hdfs import HDFSClient
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload"]
+
+
+def multi_download(client: HDFSClient, hdfs_path: str, local_path: str,
+                   trainer_id: int = 0, trainers: int = 1,
+                   multi_processes: int = 5) -> List[str]:
+    """Download this trainer's shard of the files under hdfs_path
+    (round-robin by index — reference multi_download)."""
+    files = client.ls(hdfs_path)
+    mine = [f for i, f in enumerate(sorted(files))
+            if i % trainers == trainer_id]
+    os.makedirs(local_path, exist_ok=True)
+
+    def pull(f):
+        dst = os.path.join(local_path, os.path.basename(f))
+        client.download(f, dst)
+        return dst
+    with ThreadPoolExecutor(max_workers=multi_processes) as pool:
+        return list(pool.map(pull, mine))
+
+
+def multi_upload(client: HDFSClient, hdfs_path: str, local_path: str,
+                 multi_processes: int = 5, overwrite: bool = False):
+    """Upload every file under local_path concurrently (reference
+    multi_upload). overwrite=False skips files already at the
+    destination."""
+    todo = []
+    parents = set()
+    for root, _dirs, files in os.walk(local_path):
+        for f in files:
+            src = os.path.join(root, f)
+            rel = os.path.relpath(src, local_path)
+            dst = os.path.join(hdfs_path, rel)
+            parents.add(os.path.dirname(dst))
+            todo.append((src, dst))
+    # hadoop -put does not create missing parent dirs
+    for p in sorted(parents):
+        client.mkdir(p)
+
+    def push(pair):
+        src, dst = pair
+        if not overwrite and client.is_exist(dst):
+            return None
+        client.upload(src, dst)
+        return dst
+    with ThreadPoolExecutor(max_workers=multi_processes) as pool:
+        done = list(pool.map(push, todo))
+    return [d for d in done if d is not None]

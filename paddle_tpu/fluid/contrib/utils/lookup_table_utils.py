@@ -1,0 +1,53 @@
+"""Distributed lookup-table program conversion (reference:
+contrib/utils/lookup_table_utils.py — convert_dist_to_sparse_program:85
+rewrites distributed_lookup_table prefetch plumbing back into local
+sparse lookups so a PS-trained model can be loaded for increment training
+or inference; get_inference_model:413)."""
+from __future__ import annotations
+
+__all__ = ["convert_dist_to_sparse_program", "get_inference_model"]
+
+
+def convert_dist_to_sparse_program(program):
+    """Clone the program with every distributed/pslib sparse lookup
+    replaced by a plain is_sparse lookup_table over a local table var —
+    the inverse of the PS transpile, for single-host loading."""
+    prog = program.clone()
+    block = prog.global_block()
+    new_ops = []
+    for op in block.ops:
+        if op.type in ("distributed_lookup_table",):
+            w = op.input("W")[0]
+            ids = op.input("Ids")
+            outs = op.output("Outputs") or op.output("Out")
+            for idn, outn in zip(ids, outs):
+                from ...framework import Operator
+                new_ops.append(Operator(
+                    block, type="lookup_table",
+                    inputs={"W": [w], "Ids": [idn]},
+                    outputs={"Out": [outn]},
+                    attrs={"is_sparse": True,
+                           "padding_idx":
+                               op.attrs.get("padding_idx", -1)}))
+            continue
+        # pslib_pull_sparse ops pass through unchanged: the pslib runtime
+        # serves them locally in single-host mode
+        new_ops.append(op)
+    block.ops = new_ops
+    prog._version += 1
+    return prog
+
+
+def get_inference_model(main_program, feeded_var_names, target_vars):
+    """Prune + convert for inference (reference :413): returns the
+    converted program pruned to the targets; feed names are validated
+    against the program."""
+    prog = convert_dist_to_sparse_program(main_program)
+    block = prog.global_block()
+    missing = [n for n in (feeded_var_names or []) if not block.has_var(n)]
+    if missing:
+        raise ValueError(
+            f"feeded_var_names not found in program: {missing}")
+    target_names = [v if isinstance(v, str) else v.name
+                    for v in target_vars]
+    return prog.clone(for_test=True)._prune(target_names)

@@ -13,13 +13,34 @@ _LOCK = threading.Lock()
 _LIBS = {}
 
 
+def _embed_flags(rpath: bool = False):
+    """Compile/link flags for modules that embed CPython."""
+    import sysconfig
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") \
+        or sysconfig.get_config_var("VERSION")
+    ld = [f"-L{libdir}"] if libdir else []
+    if rpath and libdir:
+        ld.append(f"-Wl,-rpath,{libdir}")
+    return [f"-I{inc}"], ld + [f"-lpython{ver}"]
+
+
+def _module_flags(name: str):
+    """Extra compile/link flags per native module (capi embeds CPython)."""
+    if name == "capi":
+        return _embed_flags()
+    return [], []
+
+
 def _build(name: str) -> str:
     src = os.path.join(_DIR, name + ".cpp")
     so = os.path.join(_DIR, "lib" + name + ".so")
     if (not os.path.exists(so)
             or os.path.getmtime(so) < os.path.getmtime(src)):
-        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-               src, "-o", so]
+        cflags, ldflags = _module_flags(name)
+        cmd = (["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                "-pthread"] + cflags + [src, "-o", so] + ldflags)
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     return so
 
@@ -30,6 +51,36 @@ class _BuildFailed:
 
     def __init__(self, err: Exception):
         self.err = err
+
+
+def build_executable(name: str) -> str:
+    """Build paddle_tpu/native/<name>.cpp as a standalone binary (the C++
+    train demo — reference paddle/fluid/train/). Same once-per-process
+    failure caching and locking as load()."""
+    key = "exe:" + name
+    with _LOCK:
+        cached = _LIBS.get(key)
+        if isinstance(cached, _BuildFailed):
+            raise RuntimeError(
+                f"native executable '{name}' previously failed to "
+                f"build: {cached.err}") from cached.err
+        if isinstance(cached, str):
+            return cached
+        src = os.path.join(_DIR, name + ".cpp")
+        exe = os.path.join(_DIR, name)
+        try:
+            if (not os.path.exists(exe)
+                    or os.path.getmtime(exe) < os.path.getmtime(src)):
+                cflags, ldflags = _embed_flags(rpath=True)
+                cmd = (["g++", "-O2", "-std=c++17", "-pthread"] + cflags
+                       + [src, "-o", exe] + ldflags)
+                subprocess.run(cmd, check=True, capture_output=True,
+                               text=True)
+        except Exception as e:
+            _LIBS[key] = _BuildFailed(e)
+            raise
+        _LIBS[key] = exe
+        return exe
 
 
 def load(name: str) -> ctypes.CDLL:

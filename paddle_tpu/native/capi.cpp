@@ -1,0 +1,236 @@
+// Inference C API (reference: paddle/fluid/inference/capi/ — C wrappers
+// over the AnalysisPredictor so C/C++ serving apps can run models).
+//
+// TPU framing: the predictor itself is the XLA path (load ProgramDesc →
+// jit once → dispatch); this C ABI embeds the CPython runtime and drives
+// paddle_tpu.inference.AnalysisPredictor through it. Works both from a
+// standalone C program (initializes Python) and when dlopen'd inside an
+// existing Python process (takes the GIL).
+//
+// Surface (float32 tensors; the reference's PD_PaddleBuf subset):
+//   PD_NewPredictor(model_dir)                    -> handle | NULL
+//   PD_GetInputNum / PD_GetOutputNum(handle)      -> int
+//   PD_GetInputName / PD_GetOutputName(handle, i) -> const char*
+//   PD_SetInput(handle, name, data, shape, ndim)  -> 0 | -1
+//   PD_RunPredictor(handle)                       -> 0 | -1
+//   PD_GetOutput(handle, name, buf, cap, out_len, out_shape, out_ndim)
+//   PD_DeletePredictor(handle)
+//   PD_LastError()                                -> const char*
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_err;
+
+struct Predictor {
+  PyObject* pred = nullptr;                 // AnalysisPredictor
+  std::vector<std::string> input_names;
+  std::vector<std::string> output_names;
+};
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() { st = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+bool record_py_error(const char* where) {
+  if (!PyErr_Occurred()) {
+    g_err = std::string(where) + ": unknown failure";
+    return false;
+  }
+  PyObject *t, *v, *tb;
+  PyErr_Fetch(&t, &v, &tb);
+  PyObject* s = v ? PyObject_Str(v) : nullptr;
+  g_err = std::string(where) + ": " +
+          (s ? PyUnicode_AsUTF8(s) : "unprintable python error");
+  Py_XDECREF(s);
+  Py_XDECREF(t);
+  Py_XDECREF(v);
+  Py_XDECREF(tb);
+  return false;
+}
+
+bool names_of(PyObject* pred, const char* method,
+              std::vector<std::string>* out) {
+  PyObject* lst = PyObject_CallMethod(pred, method, nullptr);
+  if (!lst) return record_py_error(method);
+  for (Py_ssize_t i = 0; i < PyList_Size(lst); i++)
+    out->push_back(PyUnicode_AsUTF8(PyList_GetItem(lst, i)));
+  Py_DECREF(lst);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* PD_LastError() { return g_err.c_str(); }
+
+void* PD_NewPredictor(const char* model_dir) {
+  if (!Py_IsInitialized()) {
+    Py_Initialize();
+    // release the GIL acquired by initialization so OTHER threads'
+    // PyGILState_Ensure can take it (C serving apps dispatch PD_* calls
+    // from worker threads); every entry point below re-acquires via Gil
+    PyEval_SaveThread();
+  }
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (!mod) {
+    record_py_error("import paddle_tpu.inference");
+    return nullptr;
+  }
+  PyObject* cfg = PyObject_CallMethod(mod, "AnalysisConfig", "s",
+                                      model_dir);
+  if (!cfg) {
+    Py_DECREF(mod);
+    record_py_error("AnalysisConfig");
+    return nullptr;
+  }
+  PyObject* pred = PyObject_CallMethod(mod, "create_predictor", "O", cfg);
+  Py_DECREF(cfg);
+  Py_DECREF(mod);
+  if (!pred) {
+    record_py_error("create_predictor");
+    return nullptr;
+  }
+  auto* p = new Predictor();
+  p->pred = pred;
+  if (!names_of(pred, "get_input_names", &p->input_names) ||
+      !names_of(pred, "get_output_names", &p->output_names)) {
+    Py_DECREF(pred);
+    delete p;
+    return nullptr;
+  }
+  return p;
+}
+
+int PD_GetInputNum(void* h) {
+  return int(static_cast<Predictor*>(h)->input_names.size());
+}
+
+int PD_GetOutputNum(void* h) {
+  return int(static_cast<Predictor*>(h)->output_names.size());
+}
+
+const char* PD_GetInputName(void* h, int i) {
+  auto* p = static_cast<Predictor*>(h);
+  return (i >= 0 && i < int(p->input_names.size()))
+             ? p->input_names[i].c_str()
+             : nullptr;
+}
+
+const char* PD_GetOutputName(void* h, int i) {
+  auto* p = static_cast<Predictor*>(h);
+  return (i >= 0 && i < int(p->output_names.size()))
+             ? p->output_names[i].c_str()
+             : nullptr;
+}
+
+int PD_SetInput(void* h, const char* name, const float* data,
+                const int64_t* shape, int ndim) {
+  auto* p = static_cast<Predictor*>(h);
+  Gil gil;
+  PyObject* handle =
+      PyObject_CallMethod(p->pred, "get_input_handle", "s", name);
+  if (!handle) return record_py_error("get_input_handle"), -1;
+  // build a numpy array from the raw buffer via the buffer-free path:
+  // numpy.frombuffer(bytes, float32).reshape(shape)
+  int64_t numel = 1;
+  for (int i = 0; i < ndim; i++) numel *= shape[i];
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), numel * 4);
+  PyObject* flat = PyObject_CallMethod(np, "frombuffer", "Os", bytes,
+                                       "float32");
+  Py_DECREF(bytes);
+  Py_DECREF(np);
+  if (!flat) {
+    Py_DECREF(handle);
+    return record_py_error("frombuffer"), -1;
+  }
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; i++)
+    PyTuple_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+  PyObject* arr = PyObject_CallMethod(flat, "reshape", "O", shp);
+  Py_DECREF(flat);
+  Py_DECREF(shp);
+  if (!arr) {
+    Py_DECREF(handle);
+    return record_py_error("reshape"), -1;
+  }
+  PyObject* r = PyObject_CallMethod(handle, "copy_from_cpu", "O", arr);
+  Py_DECREF(arr);
+  Py_DECREF(handle);
+  if (!r) return record_py_error("copy_from_cpu"), -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int PD_RunPredictor(void* h) {
+  auto* p = static_cast<Predictor*>(h);
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(p->pred, "run", nullptr);
+  if (!r) return record_py_error("run"), -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int PD_GetOutput(void* h, const char* name, float* buf,
+                 int64_t capacity, int64_t* out_len, int64_t* out_shape,
+                 int* out_ndim) {
+  auto* p = static_cast<Predictor*>(h);
+  Gil gil;
+  PyObject* handle =
+      PyObject_CallMethod(p->pred, "get_output_handle", "s", name);
+  if (!handle) return record_py_error("get_output_handle"), -1;
+  PyObject* arr = PyObject_CallMethod(handle, "copy_to_cpu", nullptr);
+  Py_DECREF(handle);
+  if (!arr) return record_py_error("copy_to_cpu"), -1;
+  // float32 contiguous view → bytes
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* f32 = PyObject_CallMethod(np, "ascontiguousarray", "Os", arr,
+                                      "float32");
+  Py_DECREF(np);
+  Py_DECREF(arr);
+  if (!f32) return record_py_error("ascontiguousarray"), -1;
+  PyObject* shape = PyObject_GetAttrString(f32, "shape");
+  int nd = int(PyTuple_Size(shape));
+  int64_t numel = 1;
+  for (int i = 0; i < nd; i++) {
+    int64_t d = PyLong_AsLongLong(PyTuple_GetItem(shape, i));
+    if (out_shape && i < 16) out_shape[i] = d;
+    numel *= d;
+  }
+  if (out_ndim) *out_ndim = nd;
+  Py_DECREF(shape);
+  if (out_len) *out_len = numel;
+  if (numel > capacity) {
+    Py_DECREF(f32);
+    g_err = "output larger than caller buffer";
+    return -2;  // caller: grow buffer to *out_len and retry
+  }
+  PyObject* bytes = PyObject_CallMethod(f32, "tobytes", nullptr);
+  Py_DECREF(f32);
+  if (!bytes) return record_py_error("tobytes"), -1;
+  std::memcpy(buf, PyBytes_AsString(bytes), size_t(numel) * 4);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+void PD_DeletePredictor(void* h) {
+  auto* p = static_cast<Predictor*>(h);
+  if (p) {
+    Gil gil;
+    Py_XDECREF(p->pred);
+    delete p;
+  }
+}
+
+}  // extern "C"

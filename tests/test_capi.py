@@ -1,0 +1,102 @@
+"""Inference C API (paddle_tpu/native/capi.cpp; reference:
+paddle/fluid/inference/capi/) — save a model, then drive it purely
+through the C ABI via ctypes, as a C serving app would."""
+import ctypes
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("capi_model") / "m")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu",
+                            param_attr=fluid.ParamAttr(name="w1"))
+        y = fluid.layers.fc(h, 2, act="softmax",
+                            param_attr=fluid.ParamAttr(name="w2"))
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [y], exe,
+                                      main_program=main)
+        X = np.random.RandomState(0).rand(3, 4).astype("float32")
+        expect = exe.run(main, feed={"x": X}, fetch_list=[y])[0]
+    return d, X, expect
+
+
+def _capi():
+    from paddle_tpu.native import load
+    lib = load("capi")
+    c = ctypes
+    lib.PD_NewPredictor.restype = c.c_void_p
+    lib.PD_NewPredictor.argtypes = [c.c_char_p]
+    lib.PD_LastError.restype = c.c_char_p
+    lib.PD_GetInputNum.argtypes = [c.c_void_p]
+    lib.PD_GetOutputNum.argtypes = [c.c_void_p]
+    lib.PD_GetInputName.restype = c.c_char_p
+    lib.PD_GetInputName.argtypes = [c.c_void_p, c.c_int]
+    lib.PD_GetOutputName.restype = c.c_char_p
+    lib.PD_GetOutputName.argtypes = [c.c_void_p, c.c_int]
+    lib.PD_SetInput.argtypes = [c.c_void_p, c.c_char_p,
+                                c.POINTER(c.c_float),
+                                c.POINTER(c.c_int64), c.c_int]
+    lib.PD_RunPredictor.argtypes = [c.c_void_p]
+    lib.PD_GetOutput.argtypes = [c.c_void_p, c.c_char_p,
+                                 c.POINTER(c.c_float), c.c_int64,
+                                 c.POINTER(c.c_int64),
+                                 c.POINTER(c.c_int64),
+                                 c.POINTER(c.c_int)]
+    lib.PD_DeletePredictor.argtypes = [c.c_void_p]
+    return lib
+
+
+def test_capi_full_inference_round_trip(saved_model):
+    d, X, expect = saved_model
+    lib = _capi()
+    h = lib.PD_NewPredictor(d.encode())
+    assert h, lib.PD_LastError().decode()
+    try:
+        assert lib.PD_GetInputNum(h) == 1
+        assert lib.PD_GetOutputNum(h) == 1
+        in_name = lib.PD_GetInputName(h, 0)
+        out_name = lib.PD_GetOutputName(h, 0)
+        assert in_name == b"x"
+        shape = (ctypes.c_int64 * 2)(*X.shape)
+        data = X.ravel().ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        assert lib.PD_SetInput(h, in_name, data, shape, 2) == 0, \
+            lib.PD_LastError().decode()
+        assert lib.PD_RunPredictor(h) == 0, lib.PD_LastError().decode()
+        buf = (ctypes.c_float * 64)()
+        out_len = ctypes.c_int64()
+        out_shape = (ctypes.c_int64 * 16)()
+        out_ndim = ctypes.c_int()
+        rc = lib.PD_GetOutput(h, out_name, buf, 64,
+                              ctypes.byref(out_len), out_shape,
+                              ctypes.byref(out_ndim))
+        assert rc == 0, lib.PD_LastError().decode()
+        assert out_ndim.value == 2
+        got = np.ctypeslib.as_array(buf)[:out_len.value].reshape(
+            out_shape[0], out_shape[1])
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+        # buffer-too-small contract: rc -2 + required length reported
+        small = (ctypes.c_float * 1)()
+        rc = lib.PD_GetOutput(h, out_name, small, 1,
+                              ctypes.byref(out_len), out_shape,
+                              ctypes.byref(out_ndim))
+        assert rc == -2 and out_len.value == expect.size
+    finally:
+        lib.PD_DeletePredictor(h)
+
+
+def test_capi_bad_model_dir_reports_error(tmp_path):
+    lib = _capi()
+    h = lib.PD_NewPredictor(str(tmp_path / "nope").encode())
+    assert not h
+    assert lib.PD_LastError()

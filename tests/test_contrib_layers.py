@@ -206,3 +206,46 @@ def test_quantize_transpiler_delegates():
     assert all(op.attrs.get("is_test", True)
                for op in main.global_block().ops
                if op.type.startswith("fake_quantize"))
+
+
+def test_contrib_utils_multi_upload_download(tmp_path):
+    """contrib.utils thread-pooled transfer over the LocalFS-compatible
+    client interface (reference contrib/utils/hdfs_utils.py)."""
+    import os
+    from paddle_tpu.fluid.contrib.utils import multi_download, multi_upload
+    from paddle_tpu.fluid.incubate.fleet.utils.hdfs import LocalFS
+
+    src = tmp_path / "src"
+    os.makedirs(src)
+    for i in range(5):
+        (src / f"part-{i}").write_text(str(i))
+    remote = tmp_path / "remote"
+    fs = LocalFS()
+    uploaded = multi_upload(fs, str(remote), str(src))
+    assert len(uploaded) == 5
+    got = multi_download(fs, str(remote), str(tmp_path / "dl"),
+                         trainer_id=1, trainers=2)
+    # files sorted; trainer 1 of 2 gets indices 1,3
+    assert len(got) == 2
+    assert sorted(os.path.basename(g) for g in got) == \
+        ["part-1", "part-3"]
+
+
+def test_convert_dist_to_sparse_program():
+    from paddle_tpu.fluid.contrib.utils import convert_dist_to_sparse_program
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        block = main.global_block()
+        w = block.create_var(name="emb_w", shape=(100, 8),
+                             dtype="float32", persistable=True)
+        out_v = block.create_var(name="emb_out", shape=(-1, 8),
+                                 dtype="float32")
+        block.append_op(type="distributed_lookup_table",
+                        inputs={"Ids": [ids], "W": [w]},
+                        outputs={"Outputs": [out_v]},
+                        attrs={"padding_idx": -1})
+    prog = convert_dist_to_sparse_program(main)
+    types = [op.type for op in prog.global_block().ops]
+    assert "lookup_table" in types
+    assert "distributed_lookup_table" not in types

@@ -1,0 +1,47 @@
+"""C++ train demo (paddle_tpu/native/train_demo.cpp; reference:
+paddle/fluid/train/test_train_recognize_digits.cc) — save a train program,
+then train it from a standalone C++ binary."""
+import json
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def test_cpp_train_demo(tmp_path):
+    # a small regression train program
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[8], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        diff = fluid.layers.elementwise_sub(pred, y)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.elementwise_mul(diff, diff))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    d = tmp_path / "m"
+    os.makedirs(d)
+    (d / "__main__").write_bytes(main.serialize_to_string())
+    (d / "__startup__").write_bytes(startup.serialize_to_string())
+    (d / "feeds.json").write_text(json.dumps({
+        "feeds": [{"name": "x", "shape": [16, 8], "dtype": "float32"},
+                  {"name": "y", "shape": [16, 1], "dtype": "float32"}],
+        "fetch": loss.name}))
+
+    from paddle_tpu.native import build_executable
+    exe_path = build_executable("train_demo")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH",
+                                                           "")
+    env["PADDLE_TPU_FORCE_CPU"] = "1"
+    r = subprocess.run([exe_path, str(d), "8"], capture_output=True,
+                       text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = [l for l in r.stdout.splitlines() if l.startswith("step")]
+    assert len(lines) == 8
+    first = float(lines[0].split()[-1])
+    last = float(lines[-1].split()[-1])
+    assert np.isfinite(last) and last <= first
