@@ -5,6 +5,8 @@ sparse lookups so a PS-trained model can be loaded for increment training
 or inference; get_inference_model:413)."""
 from __future__ import annotations
 
+from ...framework import Operator
+
 __all__ = ["convert_dist_to_sparse_program", "get_inference_model"]
 
 
@@ -21,7 +23,6 @@ def convert_dist_to_sparse_program(program):
             ids = op.input("Ids")
             outs = op.output("Outputs") or op.output("Out")
             for idn, outn in zip(ids, outs):
-                from ...framework import Operator
                 new_ops.append(Operator(
                     block, type="lookup_table",
                     inputs={"W": [w], "Ids": [idn]},

@@ -29,7 +29,9 @@ def _embed_flags(rpath: bool = False):
 def _module_flags(name: str):
     """Extra compile/link flags per native module (capi embeds CPython)."""
     if name == "capi":
-        return _embed_flags()
+        # rpath so a standalone C program's dlopen finds libpython even
+        # in a non-default prefix
+        return _embed_flags(rpath=True)
     return [], []
 
 

@@ -12,8 +12,11 @@
 //   PD_GetInputNum / PD_GetOutputNum(handle)      -> int
 //   PD_GetInputName / PD_GetOutputName(handle, i) -> const char*
 //   PD_SetInput(handle, name, data, shape, ndim)  -> 0 | -1
+//       (all dims concrete/positive; no -1 batch placeholders)
 //   PD_RunPredictor(handle)                       -> 0 | -1
 //   PD_GetOutput(handle, name, buf, cap, out_len, out_shape, out_ndim)
+//       out_shape must hold 16 int64 slots; rc -2 = grow buf to *out_len
+//       and retry; rc -3 = output rank exceeds 16
 //   PD_DeletePredictor(handle)
 //   PD_LastError()                                -> const char*
 #include <Python.h>
@@ -137,16 +140,33 @@ int PD_SetInput(void* h, const char* name, const float* data,
                 const int64_t* shape, int ndim) {
   auto* p = static_cast<Predictor*>(h);
   Gil gil;
+  if (ndim <= 0) {
+    g_err = "PD_SetInput: ndim must be positive";
+    return -1;
+  }
+  int64_t numel = 1;
+  for (int i = 0; i < ndim; i++) {
+    if (shape[i] <= 0) {  // concrete shapes only — no -1 batch dims here
+      g_err = "PD_SetInput: all shape dims must be positive (got " +
+              std::to_string(shape[i]) + ")";
+      return -1;
+    }
+    numel *= shape[i];
+  }
   PyObject* handle =
       PyObject_CallMethod(p->pred, "get_input_handle", "s", name);
   if (!handle) return record_py_error("get_input_handle"), -1;
   // build a numpy array from the raw buffer via the buffer-free path:
   // numpy.frombuffer(bytes, float32).reshape(shape)
-  int64_t numel = 1;
-  for (int i = 0; i < ndim; i++) numel *= shape[i];
   PyObject* np = PyImport_ImportModule("numpy");
-  PyObject* bytes = PyBytes_FromStringAndSize(
-      reinterpret_cast<const char*>(data), numel * 4);
+  PyObject* bytes = np ? PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), numel * 4) : nullptr;
+  if (!np || !bytes) {
+    Py_XDECREF(np);
+    Py_XDECREF(bytes);
+    Py_DECREF(handle);
+    return record_py_error("numpy buffer"), -1;
+  }
   PyObject* flat = PyObject_CallMethod(np, "frombuffer", "Os", bytes,
                                        "float32");
   Py_DECREF(bytes);
@@ -202,10 +222,16 @@ int PD_GetOutput(void* h, const char* name, float* buf,
   if (!f32) return record_py_error("ascontiguousarray"), -1;
   PyObject* shape = PyObject_GetAttrString(f32, "shape");
   int nd = int(PyTuple_Size(shape));
+  if (nd > 16) {
+    Py_DECREF(shape);
+    Py_DECREF(f32);
+    g_err = "output rank exceeds the 16-slot out_shape contract";
+    return -3;
+  }
   int64_t numel = 1;
   for (int i = 0; i < nd; i++) {
     int64_t d = PyLong_AsLongLong(PyTuple_GetItem(shape, i));
-    if (out_shape && i < 16) out_shape[i] = d;
+    if (out_shape) out_shape[i] = d;
     numel *= d;
   }
   if (out_ndim) *out_ndim = nd;

@@ -100,3 +100,19 @@ def test_capi_bad_model_dir_reports_error(tmp_path):
     h = lib.PD_NewPredictor(str(tmp_path / "nope").encode())
     assert not h
     assert lib.PD_LastError()
+
+
+def test_capi_rejects_bad_shape(saved_model):
+    """Negative/dynamic dims must produce rc -1 + error, not a crash."""
+    d, X, expect = saved_model
+    lib = _capi()
+    h = lib.PD_NewPredictor(d.encode())
+    assert h
+    try:
+        shape = (ctypes.c_int64 * 2)(-1, 4)
+        data = X.ravel().ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        assert lib.PD_SetInput(h, b"x", data, shape, 2) == -1
+        assert b"positive" in lib.PD_LastError()
+        assert lib.PD_SetInput(h, b"x", data, shape, 0) == -1
+    finally:
+        lib.PD_DeletePredictor(h)

@@ -33,9 +33,10 @@ def test_cpp_train_demo(tmp_path):
 
     from paddle_tpu.native import build_executable
     exe_path = build_executable("train_demo")
+    import paddle_tpu
+    repo_root = os.path.dirname(os.path.dirname(paddle_tpu.__file__))
     env = dict(os.environ)
-    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH",
-                                                           "")
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     env["PADDLE_TPU_FORCE_CPU"] = "1"
     r = subprocess.run([exe_path, str(d), "8"], capture_output=True,
                        text=True, timeout=300, env=env)
