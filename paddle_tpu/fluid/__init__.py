@@ -13,6 +13,7 @@ from .framework import (Program, Variable, program_guard,
                         name_scope, cpu_places, cuda_places, tpu_places,
                         in_dygraph_mode, device_guard)
 from . import unique_name
+from . import ir
 from . import initializer
 from . import regularizer
 from . import clip

@@ -86,11 +86,53 @@ class CompiledProgram:
         self._places = places
         return self
 
+    def _apply_build_strategy_passes(self, scope, fetch_list=None):
+        """Run the ir passes the BuildStrategy flags select (reference
+        BuildStrategy::Apply, parallel_executor.cc:575). Fusion patterns
+        whose intermediates feed grad ops simply don't match, so this is
+        safe on programs that already carry backward ops. Each call's fetch
+        vars are protected from fusion; if a later call fetches an
+        intermediate the first application fused away, the pipeline is
+        re-applied from the pristine program with the union of fetch
+        sets (fusion can't be undone in place)."""
+        fetch_names = set()
+        for f in fetch_list or ():
+            fetch_names.add(f if isinstance(f, str) else f.name)
+        if getattr(self, "_bs_passes_applied", False):
+            prev = getattr(self, "_bs_protected", set())
+            if fetch_names <= prev:
+                return
+            # restore the pre-pass program and redo with the union
+            self._program = self._bs_pristine.clone()
+            fetch_names |= prev
+        else:
+            self._bs_pristine = self._program.clone()
+        self._bs_passes_applied = True
+        self._bs_protected = set(fetch_names)
+        names = []
+        bs = self._build_strategy
+        if bs.fuse_elewise_add_act_ops:
+            names.append("fuse_elewise_add_act_pass")
+        if bs.fuse_bn_act_ops:
+            names.append("fuse_bn_act_pass")
+        if bs.debug_graphviz_path:
+            names.append("graph_viz_pass")
+        if not names:
+            return
+        from .ir import PassManager
+        pm = PassManager(names, scope=scope)
+        if bs.debug_graphviz_path:
+            for p in pm.passes:
+                if p.name == "graph_viz_pass":
+                    p.set("graph_viz_path", bs.debug_graphviz_path)
+        self._program = pm.apply(self._program, protected=fetch_names)
+
     def _run(self, executor, feed, fetch_list, scope, return_numpy,
              mesh=None, param_shardings=None):
         """Delegate to the executor. Data-parallel execution shards the feed
         batch over the device mesh (see parallel/data_parallel.py); on a
         single chip this is a plain jitted run."""
+        self._apply_build_strategy_passes(scope, fetch_list)
         if self._is_data_parallel:
             from ..parallel.data_parallel import run_data_parallel
             if mesh is not None:

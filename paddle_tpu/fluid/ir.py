@@ -1,0 +1,943 @@
+"""Graph IR + pass system (reference: paddle/fluid/framework/ir/ —
+Pass base/registry ir/pass.h:38,184,273; GraphPatternDetector
+ir/graph_pattern_detector.h; 85 REGISTER_PASS'd passes, Appendix B of
+SURVEY.md).
+
+TPU inversion: the reference needs its pass zoo because an interpreted op
+loop can't fuse or plan memory — every fusion must be materialised as a
+graph rewrite into a hand-written fused kernel, and every memory/schedule
+decision as a pass. On this build XLA owns fusion, layout, scheduling and
+memory planning for everything inside the jitted step, so the pass system
+has two jobs only:
+
+1. *Program-level* rewrites that change which ops get traced — useful to
+   shrink trace size, canonicalise inference programs (fold BN into conv
+   weights, drop dropout, strip fake-quant), and exercise the same fused
+   ops serialized reference inference programs contain.
+2. API parity: `Graph`, `Pass`, `PassManager`, `get_pass`, and the
+   registered pass-name namespace, so tooling written against the
+   reference keeps working. Passes whose capability is absorbed by XLA
+   (memory reuse, op scheduling, mkldnn/cudnn placement) are registered
+   as documented no-ops.
+
+Pattern matching is a small backtracking DAG matcher over op nodes
+(`OpPattern`) rather than the reference's PDNode/PDPattern machinery —
+programs here are metadata-only and small, so exhaustive matching is fine.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "Graph", "IrGraph", "Pass", "PassManager", "register_pass", "get_pass",
+    "all_registered_passes", "apply_inference_passes",
+]
+
+
+# --------------------------------------------------------------------------
+# Graph: a live view over one Program block
+# --------------------------------------------------------------------------
+class Graph:
+    """Op/var graph over ``program``'s block ``idx`` (reference ir/graph.h:
+    nodes are OpDesc/VarDesc; here the Operator/Variable objects themselves
+    are the nodes and the block stays the source of truth, so a Graph is
+    always convertible back to a Program for free — the reference needs an
+    explicit graph_to_program_pass)."""
+
+    def __init__(self, program, idx: int = 0, for_test: bool = False):
+        self.program = program
+        self.block = program.block(idx)
+        self.for_test = for_test
+        self._attrs: Dict[str, Any] = {}
+
+    # -- nodes ------------------------------------------------------------
+    def all_op_nodes(self):
+        return list(self.block.ops)
+
+    def all_var_nodes(self):
+        return list(self.block.vars.values())
+
+    def op_index(self, op) -> int:
+        return self.block.ops.index(op)
+
+    # -- dataflow ---------------------------------------------------------
+    def var_producer(self, name: str, before: Optional[int] = None):
+        """Last op writing ``name`` (before position ``before`` if given)."""
+        ops = self.block.ops if before is None else self.block.ops[:before]
+        for op in reversed(ops):
+            if name in op.output_arg_names:
+                return op
+        return None
+
+    def var_consumers(self, name: str) -> List:
+        return [op for op in self.block.ops if name in op.input_arg_names]
+
+    def is_internal(self, name: str) -> bool:
+        """True if ``name`` is a pure intermediate: produced AND consumed
+        here, not persistable. Consumer-less outputs may be fetch targets
+        (the fetch list isn't part of the program), so they are never
+        internal — the reference guards these as graph outputs."""
+        v = self.block.vars.get(name)
+        if v is None:
+            return False
+        if getattr(v, "persistable", False):
+            return False
+        if name in self.get("protected_vars", ()):
+            return False  # fetch targets named by the caller
+        if self.var_producer(name) is None:
+            return False
+        return len(self.var_consumers(name)) > 0
+
+    # -- mutation ---------------------------------------------------------
+    def insert_op_at(self, index: int, type: str, inputs, outputs, attrs):
+        from .framework import Operator
+        op = Operator(self.block, type, inputs=inputs, outputs=outputs,
+                      attrs=attrs)
+        self.block.ops.insert(index, op)
+        self.program._version += 1
+        return op
+
+    def remove_ops(self, ops: Sequence) -> None:
+        dead = set(id(o) for o in ops)
+        self.block.ops = [o for o in self.block.ops if id(o) not in dead]
+        self.program._version += 1
+
+    def fuse(self, matched_ops: Sequence, type: str, inputs, outputs,
+             attrs) -> Any:
+        """Replace ``matched_ops`` with one op of ``type`` placed at the
+        position of the LAST matched op (all inputs are defined by then;
+        consumers of the fused output come later) — the standard rewrite
+        step of every fusion pass."""
+        pos = max(self.op_index(o) for o in matched_ops)
+        new_op = self.insert_op_at(pos + 1, type, inputs, outputs, attrs)
+        self.remove_ops(matched_ops)
+        return new_op
+
+    def drop_orphan_vars(self) -> int:
+        """Remove non-persistable vars that no op reads or writes."""
+        used = set()
+        for op in self.block.ops:
+            used.update(op.input_arg_names)
+            used.update(op.output_arg_names)
+        dead = [n for n, v in self.block.vars.items()
+                if n not in used and not getattr(v, "persistable", False)
+                and not getattr(v, "is_data", False)]
+        for n in dead:
+            del self.block.vars[n]
+        return len(dead)
+
+    # -- attrs (reference Pass::Set/Get) ----------------------------------
+    def set(self, key: str, val: Any):
+        self._attrs[key] = val
+
+    def get(self, key: str, default: Any = None):
+        return self._attrs.get(key, default)
+
+    def to_program(self):
+        return self.program
+
+
+# Alias used by the slim/quantization surface (reference pybind IrGraph).
+IrGraph = Graph
+
+
+# --------------------------------------------------------------------------
+# Pattern matching
+# --------------------------------------------------------------------------
+class OpPattern:
+    """A DAG of op specs with symbolic var links.
+
+    Each spec is ``(op_type, input_links, output_links)`` where links map
+    slot name -> "$sym" (or a list of "$sym"). Two specs sharing a symbol
+    are connected through that var. ``match()`` yields dicts
+    ``{"$sym": var_name, "#i": op}`` for each non-overlapping match, in
+    program order. Symbols appearing as one spec's output and another's
+    input are required to be *internal* single-consumer vars unless listed
+    in ``shared`` (the reference expresses this with
+    AsIntermediate() — graph_pattern_detector.h)."""
+
+    def __init__(self, specs, shared: Sequence[str] = ()):
+        self.specs = specs
+        self.shared = set(shared)
+        produced = set()
+        consumed = set()
+        for _, ins, outs in specs:
+            for v in self._syms(ins):
+                consumed.add(v)
+            for v in self._syms(outs):
+                produced.add(v)
+        self.intermediate = (produced & consumed) - self.shared
+
+    @staticmethod
+    def _syms(links):
+        for v in (links or {}).values():
+            if isinstance(v, (list, tuple)):
+                yield from v
+            else:
+                yield v
+
+    def _bind(self, op, links, env) -> Optional[Dict[str, str]]:
+        """Try binding one op's slots against symbolic links."""
+        new = {}
+        slots_of = {True: op.inputs, False: op.outputs}
+        for is_in, side in ((True, links[0]), (False, links[1])):
+            for slot, sym in (side or {}).items():
+                names = slots_of[is_in].get(slot, [])
+                syms = sym if isinstance(sym, (list, tuple)) else [sym]
+                if len(names) != len(syms):
+                    return None
+                for s, n in zip(syms, names):
+                    bound = env.get(s, new.get(s))
+                    if bound is None:
+                        new[s] = n
+                    elif bound != n:
+                        return None
+        return new
+
+    def match(self, graph: Graph):
+        ops = graph.all_op_nodes()
+        taken: set = set()
+        results = []
+        first_type = self.specs[0][0]
+        for anchor in ops:
+            if anchor.type != first_type or id(anchor) in taken:
+                continue
+            env: Dict[str, Any] = {}
+            chosen: List = []
+
+            def try_specs(i) -> bool:
+                if i == len(self.specs):
+                    return True
+                op_type, ins, outs = self.specs[i]
+                cands = [anchor] if i == 0 else [
+                    o for o in ops
+                    if o.type == op_type and id(o) not in taken
+                    and o not in chosen]
+                for cand in cands:
+                    new = self._bind(cand, (ins, outs), env)
+                    if new is None:
+                        continue
+                    env.update(new)
+                    chosen.append(cand)
+                    if try_specs(i + 1):
+                        return True
+                    chosen.pop()
+                    for k in new:
+                        env.pop(k, None)
+                return False
+
+            if not try_specs(0):
+                continue
+            # intermediates must be single-consumer internal vars
+            ok = True
+            for sym in self.intermediate:
+                name = env[sym]
+                if not graph.is_internal(name):
+                    ok = False
+                    break
+                cons = graph.var_consumers(name)
+                if len(cons) != 1 or cons[0] not in chosen:
+                    ok = False
+                    break
+            if not ok:
+                continue
+            for o in chosen:
+                taken.add(id(o))
+            m = dict(env)
+            for i, o in enumerate(chosen):
+                m[f"#{i}"] = o
+            m["#ops"] = list(chosen)
+            results.append(m)
+        return results
+
+
+# --------------------------------------------------------------------------
+# Pass base + registry
+# --------------------------------------------------------------------------
+class Pass:
+    """reference ir/pass.h:38 — apply(graph) -> graph, with Set/Get attrs
+    (param scope etc.)."""
+
+    name = "pass"
+    note = ""
+
+    def __init__(self):
+        self._attrs: Dict[str, Any] = {}
+
+    def set(self, key: str, val: Any) -> "Pass":
+        self._attrs[key] = val
+        return self
+
+    def get(self, key: str, default=None):
+        return self._attrs.get(key, default)
+
+    def apply(self, graph: Graph) -> Graph:
+        graph = self.apply_impl(graph)
+        graph.drop_orphan_vars()
+        return graph
+
+    def apply_impl(self, graph: Graph) -> Graph:
+        return graph
+
+
+_PASS_REGISTRY: Dict[str, Callable[[], Pass]] = {}
+
+
+def register_pass(name: str):
+    def deco(cls):
+        cls.name = name
+        _PASS_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def get_pass(name: str) -> Pass:
+    try:
+        return _PASS_REGISTRY[name]()
+    except KeyError:
+        raise ValueError(f"ir pass '{name}' is not registered") from None
+
+
+def all_registered_passes() -> List[str]:
+    return sorted(_PASS_REGISTRY)
+
+
+class PassManager:
+    """Ordered pass pipeline (reference inference/analysis/ir_pass_manager.cc
+    + pybind PassBuilder)."""
+
+    def __init__(self, names: Sequence[str], scope=None):
+        self.passes = [get_pass(n) for n in names]
+        self.scope = scope
+
+    def apply(self, program, idx: int = 0, for_test: bool = False,
+              protected: Sequence[str] = ()):
+        """``protected``: var names the caller will fetch — the fetch list
+        is not part of the program, so passes must be told which outputs
+        may not be fused away (the reference protects these as graph
+        outputs in each pass's subgraph detector)."""
+        graph = Graph(program, idx, for_test=for_test)
+        graph.set("protected_vars", set(protected))
+        for p in self.passes:
+            if self.scope is not None:
+                p.set("param_scope", self.scope)
+            graph = p.apply(graph)
+        return graph.to_program()
+
+
+# --------------------------------------------------------------------------
+# Helper: scope param access for weight-folding passes
+# --------------------------------------------------------------------------
+def _scope_get(scope, name: str) -> Optional[np.ndarray]:
+    var = scope.find_var(name)
+    if var is None:
+        return None
+    return np.asarray(var.get_tensor().array)
+
+
+def _scope_set(scope, name: str, arr: np.ndarray) -> None:
+    scope.var(name).get_tensor().set(np.ascontiguousarray(arr))
+
+
+# --------------------------------------------------------------------------
+# Real passes
+# --------------------------------------------------------------------------
+@register_pass("is_test_pass")
+class IsTestPass(Pass):
+    """Set is_test=True on every op carrying the attr (ir/is_test_pass.cc)."""
+
+    def apply_impl(self, graph):
+        for op in graph.all_op_nodes():
+            if "is_test" in op.attrs:
+                op.attrs["is_test"] = True
+        return graph
+
+
+@register_pass("simplify_with_basic_ops_pass")
+class SimplifyWithBasicOpsPass(Pass):
+    """Inference canonicalisation (ir/simplify_with_basic_ops_pass.cc):
+    dropout(is_test) becomes identity (upscale_in_train) or scale(1-p)."""
+
+    def apply_impl(self, graph):
+        for op in list(graph.all_op_nodes()):
+            if op.type != "dropout":
+                continue
+            if not op.attr("is_test"):
+                continue  # the reference only simplifies is_test dropouts
+            x = op.input("X")[0]
+            y = op.output("Out")[0]
+            impl = op.attr("dropout_implementation") or "downgrade_in_infer"
+            if impl == "upscale_in_train":
+                graph.fuse([op], "assign", {"X": [x]}, {"Out": [y]}, {})
+            else:
+                p = float(op.attr("dropout_prob") or 0.0)
+                graph.fuse([op], "scale", {"X": [x]}, {"Out": [y]},
+                           {"scale": 1.0 - p, "bias": 0.0,
+                            "bias_after_scale": True})
+        return graph
+
+
+@register_pass("identity_scale_op_clean_pass")
+class IdentityScaleOpCleanPass(Pass):
+    """Drop scale(scale=1, bias=0) ops, rewiring consumers
+    (ir/identity_scale_op_clean_pass.cc)."""
+
+    def apply_impl(self, graph):
+        for op in list(graph.all_op_nodes()):
+            if op.type != "scale":
+                continue
+            if op.input("ScaleTensor"):
+                continue
+            s = op.attr("scale")
+            b = op.attr("bias")
+            if float(1.0 if s is None else s) != 1.0 or \
+               float(0.0 if b is None else b) != 0.0:
+                continue
+            x, y = op.input("X")[0], op.output("Out")[0]
+            if not graph.is_internal(y):
+                continue  # output is fetched/persistable: keep the copy
+            for c in graph.var_consumers(y):
+                c._rename_input(y, x)
+            graph.remove_ops([op])
+        return graph
+
+
+@register_pass("delete_quant_dequant_op_pass")
+class DeleteQuantDequantOpPass(Pass):
+    """Strip fake quant/dequant ops for deployment
+    (ir/delete_quant_dequant_op_pass.cc)."""
+
+    _TYPES = ("fake_quantize_dequantize_moving_average_abs_max",
+              "fake_quantize_dequantize_abs_max")
+
+    def apply_impl(self, graph):
+        for op in list(graph.all_op_nodes()):
+            if op.type not in self._TYPES:
+                continue
+            x, y = op.input("X")[0], op.output("Out")[0]
+            consumers = graph.var_consumers(y)
+            if graph.is_internal(y):
+                for c in consumers:
+                    c._rename_input(y, x)
+                graph.remove_ops([op])
+            else:
+                graph.fuse([op], "assign", {"X": [x]}, {"Out": [y]}, {})
+        return graph
+
+
+@register_pass("fc_fuse_pass")
+class FcFusePass(Pass):
+    """mul + elementwise_add -> fc, optionally absorbing a following relu
+    into activation_type (ir/fc_fuse_pass.cc)."""
+
+    def apply_impl(self, graph):
+        pat = OpPattern([
+            ("mul", {"X": "$x", "Y": "$w"}, {"Out": "$mm"}),
+            ("elementwise_add", {"X": "$mm", "Y": "$b"}, {"Out": "$out"}),
+        ])
+        for m in pat.match(graph):
+            mul_op = m["#0"]
+            bias = graph.block._find_var_recursive(m["$b"])
+            if bias is None or not getattr(bias, "persistable", False):
+                continue  # fc requires a real bias parameter
+            if int(mul_op.attr("y_num_col_dims") or 1) != 1:
+                continue
+            matched = list(m["#ops"])
+            out_name = m["$out"]
+            act = ""
+            consumers = graph.var_consumers(out_name)
+            if (len(consumers) == 1 and consumers[0].type == "relu"
+                    and graph.is_internal(out_name)):
+                act_op = consumers[0]
+                matched.append(act_op)
+                out_name = act_op.output("Out")[0]
+                act = "relu"
+            graph.fuse(matched, "fc",
+                       {"Input": [m["$x"]], "W": [m["$w"]], "Bias": [m["$b"]]},
+                       {"Out": [out_name]},
+                       {"in_num_col_dims":
+                        int(mul_op.attr("x_num_col_dims") or 1),
+                        "activation_type": act})
+        return graph
+
+
+@register_pass("fuse_elewise_add_act_pass")
+class FuseElewiseAddActPass(Pass):
+    """elementwise_add + {relu,tanh,sigmoid,scale} ->
+    fused_elemwise_activation (ir/fuse_elewise_add_act_pass.cc). Training-
+    safe: the fused op registers grads via jax.vjp."""
+
+    _ACTS = ("relu", "tanh", "sigmoid", "scale")
+
+    def apply_impl(self, graph):
+        for act in self._ACTS:
+            pat = OpPattern([
+                ("elementwise_add", {"X": "$x", "Y": "$y"}, {"Out": "$mid"}),
+                (act, {"X": "$mid"}, {"Out": "$out"}),
+            ])
+            for m in pat.match(graph):
+                add_op, act_op = m["#0"], m["#1"]
+                if int(add_op.attr("axis") if add_op.attr("axis") is not None
+                       else -1) != -1:
+                    continue
+                functor = act
+                attrs = {"functor_list": [functor, "elementwise_add"],
+                         "axis": -1, "save_intermediate_out": False}
+                if act == "scale":
+                    if act_op.input("ScaleTensor"):
+                        continue  # runtime scale can't fold into an attr
+                    b = act_op.attr("bias")
+                    if float(0.0 if b is None else b) != 0.0:
+                        continue
+                    s = act_op.attr("scale")
+                    attrs["scale"] = float(1.0 if s is None else s)
+                inter = graph.block.create_var(
+                    name=m["$out"] + ".fused_intermediate")
+                graph.fuse(m["#ops"], "fused_elemwise_activation",
+                           {"X": [m["$x"]], "Y": [m["$y"]]},
+                           {"Out": [m["$out"]],
+                            "IntermediateOut": [inter.name]}, attrs)
+        return graph
+
+
+@register_pass("fuse_bn_act_pass")
+class FuseBnActPass(Pass):
+    """batch_norm + relu -> fused_batch_norm_act (ir/fuse_bn_act_pass.cc)."""
+
+    def apply_impl(self, graph):
+        pat = OpPattern([
+            ("batch_norm",
+             {"X": "$x", "Scale": "$scale", "Bias": "$bias",
+              "Mean": "$mean", "Variance": "$var"},
+             {"Y": "$y"}),
+            ("relu", {"X": "$y"}, {"Out": "$out"}),
+        ])
+        for m in pat.match(graph):
+            bn = m["#0"]
+            outs = {"Y": [m["$out"]]}
+            for slot in ("MeanOut", "VarianceOut", "SavedMean",
+                         "SavedVariance", "ReserveSpace"):
+                names = bn.output(slot)
+                if names:
+                    outs[slot] = names
+            attrs = {k: bn.attr(k) for k in
+                     ("momentum", "epsilon", "data_layout", "is_test",
+                      "use_global_stats") if bn.attr(k) is not None}
+            attrs["act_type"] = "relu"
+            graph.fuse(m["#ops"], "fused_batch_norm_act",
+                       {"X": [m["$x"]], "Scale": [m["$scale"]],
+                        "Bias": [m["$bias"]], "Mean": [m["$mean"]],
+                        "Variance": [m["$var"]]}, outs, attrs)
+        return graph
+
+
+class _ConvBnFoldBase(Pass):
+    """Shared weight-folding logic for the conv+bn family. Requires
+    ``param_scope`` (reference passes fetch it with
+    Get<Scope>(kParamScopeAttr)); numerical folding happens eagerly on the
+    host exactly like conv_bn_fuse_pass.cc:ConvBNFuser."""
+
+    eltwise_before_bn = False
+
+    def _fold(self, graph, conv, bn, extra_bias_name=None):
+        scope = self.get("param_scope")
+        if scope is None:
+            return False
+        w = _scope_get(scope, conv.input("Filter")[0])
+        scale = _scope_get(scope, bn.input("Scale")[0])
+        bias = _scope_get(scope, bn.input("Bias")[0])
+        mean = _scope_get(scope, bn.input("Mean")[0])
+        var = _scope_get(scope, bn.input("Variance")[0])
+        if any(a is None for a in (w, scale, bias, mean, var)):
+            return False
+        eps = float(bn.attr("epsilon") or 1e-5)
+        inv_std = 1.0 / np.sqrt(var + eps)
+        alpha = scale * inv_std                         # [C_out]
+        _scope_set(scope, conv.input("Filter")[0],
+                   (w * alpha[:, None, None, None]).astype(w.dtype))
+        prior = np.zeros_like(bias)
+        if conv.input("Bias"):
+            b0 = _scope_get(scope, conv.input("Bias")[0])
+            if b0 is not None:
+                prior = b0
+        if extra_bias_name is not None:
+            eb = _scope_get(scope, extra_bias_name)
+            if eb is not None:
+                prior = prior + eb.reshape(-1)
+        new_bias = (prior - mean) * alpha + bias
+        return new_bias.astype(w.dtype)
+
+    def _rewrite(self, graph, conv, bn, matched, out_name, new_bias):
+        scope = self.get("param_scope")
+        bias_name = conv.output("Output")[0] + ".bn_folded_bias"
+        graph.block.create_var(name=bias_name, shape=[len(new_bias)],
+                               dtype="float32", persistable=True)
+        _scope_set(scope, bias_name, new_bias)
+        ins = {"Input": conv.input("Input"), "Filter": conv.input("Filter"),
+               "Bias": [bias_name]}
+        graph.fuse(matched, "conv2d_fusion", ins, {"Output": [out_name]},
+                   {**{k: conv.attr(k) for k in
+                       ("strides", "paddings", "dilations", "groups",
+                        "padding_algorithm", "data_format")
+                       if conv.attr(k) is not None},
+                    "activation": "identity"})
+
+
+@register_pass("conv_bn_fuse_pass")
+class ConvBnFusePass(_ConvBnFoldBase):
+    """conv2d + batch_norm(is_test) -> conv2d_fusion with folded weights
+    (ir/conv_bn_fuse_pass.cc)."""
+
+    def apply_impl(self, graph):
+        pat = OpPattern([
+            ("conv2d", {"Input": "$in", "Filter": "$w"}, {"Output": "$conv"}),
+            ("batch_norm", {"X": "$conv"}, {"Y": "$y"}),
+        ])
+        for m in pat.match(graph):
+            conv, bn = m["#0"], m["#1"]
+            if not (bn.attr("is_test") or bn.attr("use_global_stats")):
+                continue
+            new_bias = self._fold(graph, conv, bn)
+            if new_bias is False:
+                continue
+            self._rewrite(graph, conv, bn, m["#ops"], m["$y"], new_bias)
+        return graph
+
+
+@register_pass("conv_eltwiseadd_bn_fuse_pass")
+class ConvEltwiseAddBnFusePass(_ConvBnFoldBase):
+    """conv2d + elementwise_add(bias param) + batch_norm(is_test) ->
+    conv2d_fusion (ir/conv_eltwiseadd_bn_fuse_pass.cc)."""
+
+    def apply_impl(self, graph):
+        pat = OpPattern([
+            ("conv2d", {"Input": "$in", "Filter": "$w"}, {"Output": "$conv"}),
+            ("elementwise_add", {"X": "$conv", "Y": "$b"}, {"Out": "$add"}),
+            ("batch_norm", {"X": "$add"}, {"Y": "$y"}),
+        ])
+        for m in pat.match(graph):
+            conv, add_op, bn = m["#0"], m["#1"], m["#2"]
+            if not (bn.attr("is_test") or bn.attr("use_global_stats")):
+                continue
+            bvar = graph.block._find_var_recursive(m["$b"])
+            if bvar is None or not getattr(bvar, "persistable", False):
+                continue
+            new_bias = self._fold(graph, conv, bn, extra_bias_name=m["$b"])
+            if new_bias is False:
+                continue
+            self._rewrite(graph, conv, bn, m["#ops"], m["$y"], new_bias)
+        return graph
+
+
+@register_pass("conv_affine_channel_fuse_pass")
+class ConvAffineChannelFusePass(_ConvBnFoldBase):
+    """conv2d + affine_channel -> conv2d_fusion with folded weights
+    (ir/conv_affine_channel_fuse_pass.cc)."""
+
+    def apply_impl(self, graph):
+        pat = OpPattern([
+            ("conv2d", {"Input": "$in", "Filter": "$w"}, {"Output": "$conv"}),
+            ("affine_channel", {"X": "$conv", "Scale": "$s", "Bias": "$b"},
+             {"Out": "$y"}),
+        ])
+        for m in pat.match(graph):
+            scope = self.get("param_scope")
+            if scope is None:
+                break
+            conv = m["#0"]
+            w = _scope_get(scope, conv.input("Filter")[0])
+            scale = _scope_get(scope, m["$s"])
+            bias = _scope_get(scope, m["$b"])
+            if any(a is None for a in (w, scale, bias)):
+                continue
+            _scope_set(scope, conv.input("Filter")[0],
+                       (w * scale[:, None, None, None]).astype(w.dtype))
+            prior = np.zeros_like(bias)
+            if conv.input("Bias"):
+                b0 = _scope_get(scope, conv.input("Bias")[0])
+                if b0 is not None:
+                    prior = b0
+            self._rewrite(graph, conv, m["#1"], m["#ops"], m["$y"],
+                          (prior * scale + bias).astype(w.dtype))
+        return graph
+
+
+@register_pass("fc_elementwise_layernorm_fuse_pass")
+class FcElementwiseLayerNormFusePass(Pass):
+    """fc + elementwise_add(residual) + layer_norm ->
+    fused_fc_elementwise_layernorm
+    (ir/fc_elementwise_layernorm_fuse_pass.cc). Run after fc_fuse_pass."""
+
+    def apply_impl(self, graph):
+        pat = OpPattern([
+            ("fc", {"Input": "$x", "W": "$w", "Bias": "$b0"},
+             {"Out": "$fc"}),
+            ("elementwise_add", {"X": "$fc", "Y": "$res"}, {"Out": "$add"}),
+            ("layer_norm", {"X": "$add", "Scale": "$s", "Bias": "$b1"},
+             {"Y": "$y"}),
+        ])
+        for m in pat.match(graph):
+            fc, ln = m["#0"], m["#2"]
+            if fc.attr("activation_type"):
+                continue
+            add_var = graph.block._find_var_recursive(m["$add"])
+            shape = getattr(add_var, "shape", None) if add_var else None
+            if not shape or int(ln.attr("begin_norm_axis") or 1) != \
+                    len(shape) - 1:
+                # the fused kernel normalises the last axis only
+                continue
+            graph.fuse(m["#ops"], "fused_fc_elementwise_layernorm",
+                       {"X": [m["$x"]], "W": [m["$w"]], "Bias0": [m["$b0"]],
+                        "Y": [m["$res"]], "Scale": [m["$s"]],
+                        "Bias1": [m["$b1"]]},
+                       {"Out": [m["$y"]]},
+                       {"epsilon": float(ln.attr("epsilon") or 1e-5),
+                        "begin_norm_axis":
+                        int(ln.attr("begin_norm_axis") or 1),
+                        "x_num_col_dims":
+                        int(fc.attr("in_num_col_dims") or 1)})
+        return graph
+
+
+@register_pass("skip_layernorm_fuse_pass")
+class SkipLayerNormFusePass(Pass):
+    """elementwise_add + layer_norm -> skip_layernorm (residual-add fused
+    into the norm; ir/skip_layernorm_fuse_pass.cc)."""
+
+    def apply_impl(self, graph):
+        pat = OpPattern([
+            ("elementwise_add", {"X": "$x", "Y": "$y"}, {"Out": "$add"}),
+            ("layer_norm", {"X": "$add", "Scale": "$s", "Bias": "$b"},
+             {"Y": "$out"}),
+        ])
+        for m in pat.match(graph):
+            ln = m["#1"]
+            add_var = graph.block._find_var_recursive(m["$add"])
+            shape = getattr(add_var, "shape", None) if add_var else None
+            if not shape or int(ln.attr("begin_norm_axis") or 1) != \
+                    len(shape) - 1:
+                continue  # skip_layernorm normalises the last axis only;
+                # no shape metadata -> can't prove legality, don't fuse
+            graph.fuse(m["#ops"], "skip_layernorm",
+                       {"X": [m["$x"]], "Y": [m["$y"]], "Scale": [m["$s"]],
+                        "Bias": [m["$b"]]},
+                       {"Out": [m["$out"]]},
+                       {"epsilon": float(ln.attr("epsilon") or 1e-5),
+                        "begin_norm_axis":
+                        int(ln.attr("begin_norm_axis") or 1)})
+        return graph
+
+
+@register_pass("embedding_eltwise_layernorm_fuse_pass")
+class EmbeddingEltwiseLayerNormFusePass(Pass):
+    """k x lookup_table + (k-1) adds + layer_norm ->
+    fused_embedding_eltwise_layernorm
+    (ir/embedding_eltwise_layernorm_fuse_pass.cc). Matches the BERT-style
+    2- and 3-embedding input stacks."""
+
+    @staticmethod
+    def _patterns():
+        for lt in ("lookup_table", "lookup_table_v2"):
+            yield OpPattern([
+                (lt, {"W": "$w1", "Ids": "$id1"}, {"Out": "$e1"}),
+                (lt, {"W": "$w2", "Ids": "$id2"}, {"Out": "$e2"}),
+                (lt, {"W": "$w3", "Ids": "$id3"}, {"Out": "$e3"}),
+                ("elementwise_add", {"X": "$e1", "Y": "$e2"}, {"Out": "$a1"}),
+                ("elementwise_add", {"X": "$a1", "Y": "$e3"}, {"Out": "$a2"}),
+                ("layer_norm", {"X": "$a2", "Scale": "$s", "Bias": "$b"},
+                 {"Y": "$y"}),
+            ]), 3
+            yield OpPattern([
+                (lt, {"W": "$w1", "Ids": "$id1"}, {"Out": "$e1"}),
+                (lt, {"W": "$w2", "Ids": "$id2"}, {"Out": "$e2"}),
+                ("elementwise_add", {"X": "$e1", "Y": "$e2"}, {"Out": "$a1"}),
+                ("layer_norm", {"X": "$a1", "Scale": "$s", "Bias": "$b"},
+                 {"Y": "$y"}),
+            ]), 2
+
+    def apply_impl(self, graph):
+        for pat, k in self._patterns():
+            for m in pat.match(graph):
+                lookups = m["#ops"][:k]
+                # the fused kernel has no padding handling — only fuse
+                # lookups without a padding row (padding_idx zeroes the
+                # padding token's embedding in the unfused op)
+                if any(int(op.attr("padding_idx")
+                           if op.attr("padding_idx") is not None else -1)
+                       >= 0 for op in lookups):
+                    continue
+                ln = m["#ops"][-1]
+                add_name = m["$a2"] if k == 3 else m["$a1"]
+                add_var = graph.block._find_var_recursive(add_name)
+                shape = getattr(add_var, "shape", None) if add_var else None
+                if not shape or int(ln.attr("begin_norm_axis") or 1) != \
+                        len(shape) - 1:
+                    continue  # fused kernel normalises the last axis only
+                ids = [m[f"$id{i}"] for i in range(1, k + 1)]
+                embs = [m[f"$w{i}"] for i in range(1, k + 1)]
+                graph.fuse(m["#ops"], "fused_embedding_eltwise_layernorm",
+                           {"Ids": ids, "Embs": embs,
+                            "Scale": [m["$s"]], "Bias": [m["$b"]]},
+                           {"Out": [m["$y"]]},
+                           {"epsilon": float(ln.attr("epsilon") or 1e-5)})
+        return graph
+
+
+@register_pass("graph_viz_pass")
+class GraphVizPass(Pass):
+    """Dump the graph as graphviz dot (ir/graph_viz_pass.cc). Set
+    'graph_viz_path' for the output file."""
+
+    def apply_impl(self, graph):
+        path = self.get("graph_viz_path", "/tmp/paddle_tpu_graph.dot")
+        lines = ["digraph G {"]
+        for i, op in enumerate(graph.all_op_nodes()):
+            lines.append(f'  op{i} [label="{op.type}" shape=box '
+                         'style=filled fillcolor=lightskyblue];')
+            for n in op.input_arg_names:
+                lines.append(f'  "{n}" -> op{i};')
+            for n in op.output_arg_names:
+                lines.append(f'  op{i} -> "{n}";')
+        lines.append("}")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+        return graph
+
+
+@register_pass("graph_to_program_pass")
+class GraphToProgramPass(Pass):
+    """Identity here: Graph is a live view of the Program
+    (ir/graph_to_program_pass.cc exists because the reference's Graph is a
+    separate structure)."""
+
+
+# --------------------------------------------------------------------------
+# Absorbed passes: capability owned by XLA on this build. Registered so the
+# reference pass-name namespace resolves; apply() is the identity.
+# --------------------------------------------------------------------------
+class AbsorbedPass(Pass):
+    """A pass whose job the XLA compiler performs inside the jitted step."""
+
+
+def _register_absorbed(name: str, note: str):
+    cls = type(name.title().replace("_", ""), (AbsorbedPass,),
+               {"note": note, "__doc__": note})
+    register_pass(name)(cls)
+
+
+for _n, _note in {
+    # memory planning — XLA buffer assignment + donation
+    "eager_deletion_pass": "scope GC; XLA buffer liveness handles it",
+    "reference_count_pass": "refcount GC plan; XLA buffer liveness",
+    "buffer_shared_inplace_pass": "inplace reuse; XLA buffer assignment",
+    "buffer_shared_cross_op_memory_reuse_pass":
+        "cross-op reuse; XLA buffer assignment",
+    "memory_optimize_pass": "memory planning; XLA buffer assignment",
+    "inplace_op_pass": "inplace rewrite; XLA aliasing/donation",
+    "while_op_eager_deletion_pass": "while scope GC; lax.while_loop scoping",
+    "recurrent_op_eager_deletion_pass": "recurrent GC; lax.scan scoping",
+    "conditional_block_op_eager_deletion_pass":
+        "cond-block GC; lax.cond scoping",
+    # scheduling/dependency — everything is one XLA computation
+    "all_reduce_deps_pass": "allreduce ordering; XLA schedules collectives",
+    "backward_optimizer_op_deps_pass": "dep edges; single XLA computation",
+    "sequential_execution_pass": "serial order; XLA schedule",
+    "modify_op_lock_and_record_event_pass": "stream events; XLA streams",
+    "add_reader_dependency_pass": "reader deps; host input pipeline",
+    "runtime_context_cache_pass": "op ctx cache; no per-op dispatch here",
+    "lock_free_optimize_pass": "lock-free updates; functional updates",
+    # multi-device graph building — sharding metadata instead of rewrites
+    "fuse_all_reduce_op_pass": "allreduce bucketing; XLA fuses collectives",
+    "coalesce_grad_tensor_pass": "grad bucketing; XLA fuses collectives",
+    "multi_batch_merge_pass":
+        "batch-merge replication; gradient-merge loop in the jitted step",
+    "multi_devices_check_pass": "SSA graph validation; pjit partitioner",
+    "multi_devices_print_pass": "SSA graph dump; use graph_viz_pass",
+    "sync_batch_norm_pass":
+        "sync_batch_norm swap; psum of batch stats inside the step",
+    # optimizer-op fusion — one jitted update already
+    "fuse_adam_op_pass": "N adam ops -> 1; XLA fuses the update",
+    "fuse_sgd_op_pass": "N sgd ops -> 1; XLA fuses the update",
+    "fuse_momentum_op_pass": "N momentum ops -> 1; XLA fuses the update",
+    # elementwise/matmul micro-fusions — XLA fusion pass
+    "fuse_relu_depthwise_conv_pass": "XLA fuses relu into conv",
+    "squared_mat_sub_fuse_pass": "XLA fuses the expression",
+    "repeated_fc_relu_fuse_pass": "XLA fuses chained fc+relu",
+    "seq_concat_fc_fuse_pass": "XLA fuses",
+    "seqconv_eltadd_relu_fuse_pass": "XLA fuses",
+    "seqpool_concat_fuse_pass": "XLA fuses",
+    "seqpool_cvm_concat_fuse_pass": "XLA fuses",
+    "transpose_flatten_concat_fuse_pass": "XLA fuses",
+    "shuffle_channel_detect_pass": "XLA fuses",
+    "matmul_transpose_reshape_fuse_pass": "XLA fuses",
+    "scale_matmul_fuse_pass": "XLA folds scale into dot",
+    "fusion_group_pass": "runtime CUDA codegen; XLA codegen",
+    "fuse_elewise_add_act_ops_pass_placeholder":
+        "see fuse_elewise_add_act_pass",
+    # backend-placement passes — single TPU backend
+    "cudnn_placement_pass": "cudnn kernel choice; XLA picks TPU kernels",
+    "mkldnn_placement_pass": "mkldnn placement; n/a on TPU",
+    "mkldnn_inplace_pass": "mkldnn inplace; n/a on TPU",
+    "conv_bias_mkldnn_fuse_pass": "mkldnn; XLA fuses conv+bias",
+    "conv3d_bias_mkldnn_fuse_pass": "mkldnn; XLA fuses",
+    "conv_activation_mkldnn_fuse_pass": "mkldnn; XLA fuses",
+    "conv_relu_mkldnn_fuse_pass": "mkldnn; XLA fuses",
+    "conv_relu6_mkldnn_fuse_pass": "mkldnn; XLA fuses",
+    "conv_leaky_relu_mkldnn_fuse_pass": "mkldnn; XLA fuses",
+    "conv_swish_mkldnn_fuse_pass": "mkldnn; XLA fuses",
+    "conv_concat_relu_mkldnn_fuse_pass": "mkldnn; XLA fuses",
+    "conv_elementwise_add_mkldnn_fuse_pass": "mkldnn; XLA fuses",
+    "conv_transpose_bias_mkldnn_fuse_pass": "mkldnn; XLA fuses",
+    "depthwise_conv_mkldnn_pass": "mkldnn; XLA lowers depthwise conv",
+    "fc_mkldnn_pass": "mkldnn fc; XLA dot",
+    "reshape_transpose_matmul_mkldnn_fuse_pass": "mkldnn; XLA fuses",
+    "cpu_quantize_pass": "int8 CPU; out of scope on TPU",
+    "cpu_quantize_placement_pass": "int8 CPU; out of scope on TPU",
+    "cpu_quantize_squash_pass": "int8 CPU; out of scope on TPU",
+    # misc fusion passes with cudnn-era kernels
+    "conv_elementwise_add_fuse_pass": "XLA fuses conv+add",
+    "conv_elementwise_add_act_fuse_pass": "XLA fuses conv+add+act",
+    "conv_elementwise_add2_act_fuse_pass": "XLA fuses",
+    "conv_eltwiseadd_affine_channel_fuse_pass":
+        "covered by conv_affine_channel_fuse_pass + XLA",
+    "conv_transpose_bn_fuse_pass": "XLA folds at inference const-folding",
+    "conv_transpose_eltwiseadd_bn_fuse_pass": "XLA folds",
+    "attention_lstm_fuse_pass": "attention_lstm op exists; XLA fuses",
+    "embedding_fc_lstm_fuse_pass": "XLA fuses",
+    "fc_gru_fuse_pass": "fusion_gru op exists; XLA fuses",
+    "fc_lstm_fuse_pass": "fusion_lstm op exists; XLA fuses",
+    "mul_gru_fuse_pass": "XLA fuses",
+    "mul_lstm_fuse_pass": "XLA fuses",
+    "multihead_matmul_fuse_pass": "BERT path emits the fused op directly",
+    "multihead_matmul_fuse_pass_v2": "BERT path emits the fused op directly",
+    "quant_conv2d_dequant_fuse_pass": "int8 deploy; out of scope on TPU",
+}.items():
+    _register_absorbed(_n, _note)
+
+
+# --------------------------------------------------------------------------
+# Canonical pipelines
+# --------------------------------------------------------------------------
+# reference: inference/api/paddle_pass_builder.cc GpuPassStrategy
+INFERENCE_PASSES = [
+    "is_test_pass",
+    "simplify_with_basic_ops_pass",
+    "delete_quant_dequant_op_pass",
+    "conv_affine_channel_fuse_pass",
+    "conv_eltwiseadd_bn_fuse_pass",
+    "conv_bn_fuse_pass",
+    "embedding_eltwise_layernorm_fuse_pass",
+    "fc_fuse_pass",
+    "fc_elementwise_layernorm_fuse_pass",
+    "identity_scale_op_clean_pass",
+]
+
+
+def apply_inference_passes(program, scope=None, extra: Sequence[str] = ()):
+    """Run the inference canonicalisation pipeline in place (reference
+    AnalysisPredictor::OptimizeInferenceProgram,
+    analysis_predictor.cc:497)."""
+    pm = PassManager(list(INFERENCE_PASSES) + list(extra), scope=scope)
+    return pm.apply(program, for_test=True)

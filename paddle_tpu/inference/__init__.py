@@ -127,6 +127,15 @@ class AnalysisPredictor:
                  model_filename=config._prog_file,
                  params_filename=config._params_file)
         self._fetch_names = [v.name for v in self._fetch_targets]
+        if config._ir_optim:
+            # reference AnalysisPredictor::OptimizeInferenceProgram
+            # (analysis_predictor.cc:497): canonicalise + fuse with the
+            # param scope so conv+bn folding can rewrite weights; the
+            # model's fetch targets are protected from fusion.
+            from paddle_tpu.fluid.ir import INFERENCE_PASSES, PassManager
+            pm = PassManager(INFERENCE_PASSES, scope=self._scope)
+            self._program = pm.apply(self._program, for_test=True,
+                                     protected=self._fetch_names)
         self._inputs: Dict[str, np.ndarray] = {}
         self._outputs: Dict[str, np.ndarray] = {}
         self._output_lods: Dict[str, list] = {}

@@ -182,6 +182,34 @@ def _fused_fc_elementwise_layernorm(ins, attrs):
     return out(Out=o)
 
 
+@register_op("skip_layernorm", inputs=("X", "Y", "Scale", "Bias"),
+             diff_inputs=("X", "Y", "Scale", "Bias"),
+             attr_defaults={"epsilon": 1e-5, "begin_norm_axis": -1})
+def _skip_layernorm(ins, attrs):
+    """Residual add fused into layer_norm: Out = LN(X + Y) (the op the
+    reference's skip_layernorm_fuse_pass targets for transformer
+    inference)."""
+    x, y = first(ins, "X"), first(ins, "Y")
+    o = x + y
+    eps = attrs.get("epsilon", 1e-5)
+    bna = int(attrs.get("begin_norm_axis", -1))
+    if bna < 0:
+        bna = o.ndim - 1
+    axes = tuple(range(bna, o.ndim))
+    # statistics in f32 like the unfused layer_norm, so fusing never
+    # degrades bf16 numerics
+    of = o.astype(jnp.float32)
+    mu = jnp.mean(of, axes, keepdims=True)
+    var = jnp.var(of, axes, keepdims=True)
+    o = ((of - mu) * jax.lax.rsqrt(var + eps)).astype(o.dtype)
+    scale, bias = first(ins, "Scale"), first(ins, "Bias")
+    if scale is not None:
+        o = o * scale.reshape((1,) * bna + scale.shape)
+    if bias is not None:
+        o = o + bias.reshape((1,) * bna + bias.shape)
+    return out(Out=o)
+
+
 # --------------------------------------------------------------------------
 # fused recurrent (fusion_gru / fusion_lstm: x-projection folded in)
 # --------------------------------------------------------------------------

@@ -1,0 +1,486 @@
+"""Graph IR + pass system tests (reference test model:
+unittests/ir/pass_test.py — build program, apply pass, compare outputs
+numerically before/after)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.ir import (Graph, OpPattern, PassManager, get_pass,
+                                 all_registered_passes,
+                                 apply_inference_passes)
+
+
+def _run(program, scope, feed, fetch):
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        return exe.run(program, feed=feed, fetch_list=fetch)
+
+
+def _fresh(build):
+    """Build a program via `build(main)` returning fetch var; init params."""
+    main, startup = fluid.Program(), fluid.Program()
+    scope = core.Scope()
+    with fluid.program_guard(main, startup):
+        fetch = build()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    return main, scope, fetch
+
+
+def _op_types(program):
+    return [op.type for op in program.global_block().ops]
+
+
+# --------------------------------------------------------------------------
+# pattern detector
+# --------------------------------------------------------------------------
+def test_pattern_detector_matches_chain():
+    main, scope, out = _fresh(lambda: fluid.layers.fc(
+        fluid.data("x", shape=[4], dtype="float32"), 3))
+    g = Graph(main)
+    pat = OpPattern([
+        ("mul", {"X": "$x", "Y": "$w"}, {"Out": "$mm"}),
+        ("elementwise_add", {"X": "$mm", "Y": "$b"}, {"Out": "$out"}),
+    ])
+    ms = pat.match(g)
+    assert len(ms) == 1
+    assert ms[0]["#0"].type == "mul"
+    assert ms[0]["$out"] == out.name
+
+
+def test_pattern_rejects_multi_consumer_intermediate():
+    def build():
+        x = fluid.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, 3)          # mul + add
+        # second consumer of the mul output would break fusion — simulate
+        # by consuming the fc output twice; the *mul* intermediate is still
+        # single-consumer, so fc fusion stays legal
+        return fluid.layers.elementwise_add(h, h)
+    main, scope, out = _fresh(build)
+    g = Graph(main)
+    pat = OpPattern([("mul", {"X": "$x", "Y": "$w"}, {"Out": "$mm"}),
+                     ("elementwise_add", {"X": "$mm", "Y": "$b"},
+                      {"Out": "$o"})])
+    assert len(pat.match(g)) == 1
+
+
+# --------------------------------------------------------------------------
+# fc_fuse
+# --------------------------------------------------------------------------
+def test_fc_fuse_pass_numeric():
+    main, scope, out = _fresh(lambda: fluid.layers.fc(
+        fluid.data("x", shape=[4], dtype="float32"), 3, act="relu"))
+    x = np.random.RandomState(0).rand(2, 4).astype("float32")
+    before = _run(main, scope, {"x": x}, [out.name])[0]
+    PassManager(["fc_fuse_pass"], scope).apply(main)
+    types = _op_types(main)
+    assert "fc" in types and "mul" not in types and "relu" not in types
+    after = _run(main, scope, {"x": x}, [out.name])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-6, atol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# dropout simplification + identity scale cleanup
+# --------------------------------------------------------------------------
+def test_simplify_and_identity_scale_clean():
+    def build():
+        x = fluid.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.dropout(x, dropout_prob=0.3)
+        h = fluid.layers.scale(h, scale=1.0, bias=0.0)
+        return fluid.layers.scale(h, scale=2.0)
+    main, scope, out = _fresh(build)
+    x = np.random.RandomState(1).rand(2, 4).astype("float32")
+    PassManager(["is_test_pass", "simplify_with_basic_ops_pass",
+                 "identity_scale_op_clean_pass"], scope).apply(main)
+    types = _op_types(main)
+    assert "dropout" not in types
+    # identity scale removed; dropout became scale(0.7); final scale kept
+    scales = [op for op in main.global_block().ops if op.type == "scale"]
+    assert len(scales) == 2
+    got = _run(main, scope, {"x": x}, [out.name])[0]
+    np.testing.assert_allclose(got, x * 0.7 * 2.0, rtol=1e-6)
+
+
+def test_identity_scale_clean_keeps_zero_scale():
+    """scale(x, 0.0) zeroes its input — must never be cleaned as identity."""
+    def build():
+        x = fluid.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.scale(x, scale=0.0, bias=0.0)
+        return fluid.layers.elementwise_add(h, h)
+    main, scope, out = _fresh(build)
+    x = np.random.RandomState(10).rand(2, 4).astype("float32")
+    PassManager(["identity_scale_op_clean_pass"], scope).apply(main)
+    assert "scale" in _op_types(main)
+    got = _run(main, scope, {"x": x}, [out.name])[0]
+    np.testing.assert_allclose(got, np.zeros_like(x))
+
+
+def test_fuse_elewise_add_scale_zero_keeps_numerics():
+    def build():
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.data("y", shape=[4], dtype="float32")
+        h = fluid.layers.scale(fluid.layers.elementwise_add(x, y), scale=0.0)
+        return fluid.layers.elementwise_add(h, h)
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(11)
+    feed = {"x": rng.randn(2, 4).astype("float32"),
+            "y": rng.randn(2, 4).astype("float32")}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["fuse_elewise_add_act_pass"], scope).apply(main)
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-6)
+    np.testing.assert_allclose(after, np.zeros_like(feed["x"]))
+
+
+# --------------------------------------------------------------------------
+# fuse_elewise_add_act (training-safe fused op)
+# --------------------------------------------------------------------------
+def test_fuse_elewise_add_act_pass():
+    def build():
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.data("y", shape=[4], dtype="float32")
+        return fluid.layers.relu(fluid.layers.elementwise_add(x, y))
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(2)
+    feed = {"x": rng.randn(3, 4).astype("float32"),
+            "y": rng.randn(3, 4).astype("float32")}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["fuse_elewise_add_act_pass"], scope).apply(main)
+    assert "fused_elemwise_activation" in _op_types(main)
+    assert "relu" not in _op_types(main)
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-6)
+
+
+def test_fuse_elewise_add_act_skips_grad_consumed_intermediate():
+    """When backward ops consume the add output, fusion must not fire."""
+    def build():
+        x = fluid.data("x", shape=[4], dtype="float32")
+        w = fluid.layers.create_parameter([4], "float32", name="w_fuse_t")
+        h = fluid.layers.elementwise_add(x, w)
+        loss = fluid.layers.mean(fluid.layers.relu(h))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        return loss
+    main, scope, loss = _fresh(build)
+    n_ops = len(main.global_block().ops)
+    PassManager(["fuse_elewise_add_act_pass"], scope).apply(main)
+    assert len(main.global_block().ops) == n_ops  # nothing fused
+
+
+# --------------------------------------------------------------------------
+# conv+bn folding (inference)
+# --------------------------------------------------------------------------
+def test_conv_bn_fuse_pass_numeric():
+    def build():
+        img = fluid.data("img", shape=[3, 8, 8], dtype="float32")
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                padding=1, bias_attr=False)
+        return fluid.layers.batch_norm(c, is_test=True)
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(3)
+    bn_ops = [op for op in main.global_block().ops if op.type == "batch_norm"]
+    mean_name = bn_ops[0].input("Mean")[0]
+    var_name = bn_ops[0].input("Variance")[0]
+    scope.find_var(mean_name).get_tensor().set(
+        rng.rand(4).astype("float32") * 0.5)
+    scope.find_var(var_name).get_tensor().set(
+        rng.rand(4).astype("float32") + 0.5)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    before = _run(main, scope, {"img": x}, [out.name])[0]
+    PassManager(["conv_bn_fuse_pass"], scope).apply(main)
+    types = _op_types(main)
+    assert "batch_norm" not in types and "conv2d_fusion" in types
+    after = _run(main, scope, {"img": x}, [out.name])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_eltwiseadd_bn_fuse_pass_numeric():
+    def build():
+        img = fluid.data("img", shape=[3, 6, 6], dtype="float32")
+        c = fluid.layers.conv2d(img, num_filters=2, filter_size=3,
+                                bias_attr=True)
+        return fluid.layers.batch_norm(c, is_test=True)
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(4)
+    bn_ops = [op for op in main.global_block().ops if op.type == "batch_norm"]
+    scope.find_var(bn_ops[0].input("Mean")[0]).get_tensor().set(
+        rng.rand(2).astype("float32"))
+    scope.find_var(bn_ops[0].input("Variance")[0]).get_tensor().set(
+        rng.rand(2).astype("float32") + 0.3)
+    # give the conv bias a non-zero value so folding is exercised
+    conv_ops = [op for op in main.global_block().ops
+                if op.type in ("conv2d",)]
+    add_ops = [op for op in main.global_block().ops
+               if op.type == "elementwise_add"]
+    if add_ops:
+        bias_name = add_ops[0].input("Y")[0]
+        scope.find_var(bias_name).get_tensor().set(
+            rng.rand(2).astype("float32"))
+    x = rng.randn(2, 3, 6, 6).astype("float32")
+    before = _run(main, scope, {"img": x}, [out.name])[0]
+    PassManager(["conv_eltwiseadd_bn_fuse_pass"], scope).apply(main)
+    assert "batch_norm" not in _op_types(main)
+    after = _run(main, scope, {"img": x}, [out.name])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# transformer-ish fusions
+# --------------------------------------------------------------------------
+def test_fc_elementwise_layernorm_fuse_numeric():
+    def build():
+        x = fluid.data("x", shape=[8], dtype="float32")
+        res = fluid.data("res", shape=[6], dtype="float32")
+        h = fluid.layers.fc(x, 6)
+        return fluid.layers.layer_norm(
+            fluid.layers.elementwise_add(h, res), begin_norm_axis=1)
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(5)
+    feed = {"x": rng.randn(3, 8).astype("float32"),
+            "res": rng.randn(3, 6).astype("float32")}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["fc_fuse_pass", "fc_elementwise_layernorm_fuse_pass"],
+                scope).apply(main)
+    assert _op_types(main) == ["fused_fc_elementwise_layernorm"]
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-5)
+
+
+def test_skip_layernorm_fuse_numeric():
+    def build():
+        x = fluid.data("x", shape=[6], dtype="float32")
+        y = fluid.data("y", shape=[6], dtype="float32")
+        return fluid.layers.layer_norm(
+            fluid.layers.elementwise_add(x, y), begin_norm_axis=1)
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(6)
+    feed = {"x": rng.randn(2, 6).astype("float32"),
+            "y": rng.randn(2, 6).astype("float32")}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["skip_layernorm_fuse_pass"], scope).apply(main)
+    assert _op_types(main) == ["skip_layernorm"]
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-5)
+
+
+def test_embedding_eltwise_layernorm_fuse_numeric():
+    def build():
+        a = fluid.data("a", shape=[16, 1], dtype="int64")
+        b = fluid.data("b", shape=[16, 1], dtype="int64")
+        ea = fluid.layers.embedding(a, size=[30, 8])
+        eb = fluid.layers.embedding(b, size=[30, 8])
+        return fluid.layers.layer_norm(
+            fluid.layers.elementwise_add(ea, eb), begin_norm_axis=2)
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(7)
+    feed = {"a": rng.randint(0, 30, (2, 16, 1)).astype("int64"),
+            "b": rng.randint(0, 30, (2, 16, 1)).astype("int64")}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["embedding_eltwise_layernorm_fuse_pass"], scope).apply(main)
+    assert _op_types(main) == ["fused_embedding_eltwise_layernorm"]
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-5)
+
+
+def test_fc_elementwise_layernorm_guards_begin_norm_axis():
+    """3-D fc output with begin_norm_axis=1 (joint S,H normalisation) must
+    NOT fuse — the fused kernel normalises the last axis only."""
+    def build():
+        x = fluid.data("x", shape=[4, 8], dtype="float32")
+        res = fluid.data("res", shape=[4, 6], dtype="float32")
+        h = fluid.layers.fc(x, 6, num_flatten_dims=2)
+        return fluid.layers.layer_norm(
+            fluid.layers.elementwise_add(h, res), begin_norm_axis=1)
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(12)
+    feed = {"x": rng.randn(2, 4, 8).astype("float32"),
+            "res": rng.randn(2, 4, 6).astype("float32")}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["fc_fuse_pass", "fc_elementwise_layernorm_fuse_pass"],
+                scope).apply(main)
+    assert "fused_fc_elementwise_layernorm" not in _op_types(main)
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-5)
+
+
+def test_embedding_fuse_skips_padding_idx():
+    def build():
+        a = fluid.data("a", shape=[16, 1], dtype="int64")
+        b = fluid.data("b", shape=[16, 1], dtype="int64")
+        ea = fluid.layers.embedding(a, size=[30, 8], padding_idx=0)
+        eb = fluid.layers.embedding(b, size=[30, 8])
+        return fluid.layers.layer_norm(
+            fluid.layers.elementwise_add(ea, eb), begin_norm_axis=2)
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(13)
+    feed = {"a": rng.randint(0, 30, (2, 16, 1)).astype("int64"),
+            "b": rng.randint(0, 30, (2, 16, 1)).astype("int64")}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["embedding_eltwise_layernorm_fuse_pass"], scope).apply(main)
+    assert "fused_embedding_eltwise_layernorm" not in _op_types(main)
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-5)
+
+
+def test_protected_fetch_vars_not_fused():
+    """A fetched intermediate must survive fusion (the fetch list is
+    outside the program, so the caller names it via `protected`)."""
+    def build():
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.data("y", shape=[4], dtype="float32")
+        h = fluid.layers.elementwise_add(x, y)
+        return h, fluid.layers.relu(h)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = core.Scope()
+    with fluid.program_guard(main, startup):
+        mid, out = build()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    PassManager(["fuse_elewise_add_act_pass"], scope).apply(
+        main, protected=[mid.name])
+    assert "fused_elemwise_activation" not in _op_types(main)
+    # without protection it fuses
+    PassManager(["fuse_elewise_add_act_pass"], scope).apply(main)
+    assert "fused_elemwise_activation" in _op_types(main)
+
+
+def test_compiled_program_refetch_after_fusion():
+    """Fetching an intermediate on a later CompiledProgram run restores the
+    pristine program and re-applies passes with the var protected."""
+    def build():
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.data("y", shape=[4], dtype="float32")
+        h = fluid.layers.elementwise_add(x, y)
+        return h, fluid.layers.relu(h)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = core.Scope()
+    with fluid.program_guard(main, startup):
+        mid, out = build()
+    exe = fluid.Executor()
+    bs = fluid.compiler.BuildStrategy()
+    bs.fuse_elewise_add_act_ops = True
+    cp = fluid.compiler.CompiledProgram(main, build_strategy=bs)
+    rng = np.random.RandomState(14)
+    feed = {"x": rng.randn(2, 4).astype("float32"),
+            "y": rng.randn(2, 4).astype("float32")}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (o1,) = exe.run(cp, feed=feed, fetch_list=[out.name])
+        assert "fused_elemwise_activation" in [
+            op.type for op in cp._program.global_block().ops]
+        # now fetch the intermediate fused away on the first application
+        o2, m2 = exe.run(cp, feed=feed, fetch_list=[out.name, mid.name])
+    np.testing.assert_allclose(o1, o2, rtol=1e-6)
+    np.testing.assert_allclose(m2, feed["x"] + feed["y"], rtol=1e-6)
+
+
+def test_embedding_fuse_matches_lookup_table_v2():
+    def build():
+        blk = fluid.default_main_program().global_block()
+        a = fluid.data("a", shape=[16], dtype="int64")
+        b = fluid.data("b", shape=[16], dtype="int64")
+        wa = fluid.layers.create_parameter([30, 8], "float32", name="va_w")
+        wb = fluid.layers.create_parameter([30, 8], "float32", name="vb_w")
+        ea = blk.create_var(name="ea_v2", dtype="float32",
+                            shape=[-1, 16, 8])
+        eb = blk.create_var(name="eb_v2", dtype="float32",
+                            shape=[-1, 16, 8])
+        blk.append_op(type="lookup_table_v2",
+                      inputs={"W": [wa.name], "Ids": [a.name]},
+                      outputs={"Out": [ea.name]}, attrs={"padding_idx": -1})
+        blk.append_op(type="lookup_table_v2",
+                      inputs={"W": [wb.name], "Ids": [b.name]},
+                      outputs={"Out": [eb.name]}, attrs={"padding_idx": -1})
+        return fluid.layers.layer_norm(
+            fluid.layers.elementwise_add(ea, eb), begin_norm_axis=2)
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(15)
+    feed = {"a": rng.randint(0, 30, (2, 16)).astype("int64"),
+            "b": rng.randint(0, 30, (2, 16)).astype("int64")}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["embedding_eltwise_layernorm_fuse_pass"], scope).apply(main)
+    assert "fused_embedding_eltwise_layernorm" in _op_types(main)
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# quant/dequant strip
+# --------------------------------------------------------------------------
+def test_delete_quant_dequant_pass():
+    def build():
+        x = fluid.data("x", shape=[4], dtype="float32")
+        blk = fluid.default_main_program().global_block()
+        q = blk.create_var(name="q_out", dtype="float32")
+        scale_var = blk.create_var(name="q_scale", dtype="float32")
+        blk.append_op(
+            type="fake_quantize_dequantize_moving_average_abs_max",
+            inputs={"X": [x.name]},
+            outputs={"Out": [q.name], "OutScale": [scale_var.name]},
+            attrs={"bit_length": 8, "moving_rate": 0.9})
+        return fluid.layers.scale(q, scale=2.0)
+    main, scope, out = _fresh(build)
+    x = np.random.RandomState(8).rand(2, 4).astype("float32")
+    PassManager(["delete_quant_dequant_op_pass"], scope).apply(main)
+    assert all("fake_quantize" not in t for t in _op_types(main))
+    got = _run(main, scope, {"x": x}, [out.name])[0]
+    np.testing.assert_allclose(got, x * 2.0, rtol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# registry, viz, absorbed passes, end-to-end pipeline
+# --------------------------------------------------------------------------
+def test_registry_covers_reference_namespace():
+    names = all_registered_passes()
+    for n in ("fc_fuse_pass", "conv_bn_fuse_pass", "graph_viz_pass",
+              "eager_deletion_pass", "reference_count_pass",
+              "fuse_all_reduce_op_pass", "mkldnn_placement_pass",
+              "sync_batch_norm_pass", "fuse_adam_op_pass"):
+        assert n in names, n
+    assert len(names) >= 80
+
+
+def test_absorbed_pass_is_identity():
+    main, scope, out = _fresh(lambda: fluid.layers.fc(
+        fluid.data("x", shape=[4], dtype="float32"), 3))
+    types = _op_types(main)
+    PassManager(["eager_deletion_pass", "fuse_adam_op_pass"],
+                scope).apply(main)
+    assert _op_types(main) == types
+
+
+def test_graph_viz_pass(tmp_path):
+    main, scope, out = _fresh(lambda: fluid.layers.fc(
+        fluid.data("x", shape=[4], dtype="float32"), 3))
+    p = get_pass("graph_viz_pass")
+    p.set("graph_viz_path", str(tmp_path / "g.dot"))
+    p.apply(Graph(main))
+    dot = (tmp_path / "g.dot").read_text()
+    assert "digraph" in dot and "mul" in dot
+
+
+def test_inference_pipeline_end_to_end():
+    """Full inference pass pipeline on a conv+bn+fc+dropout model keeps
+    numerics and shrinks the op list."""
+    def build():
+        img = fluid.data("img", shape=[3, 8, 8], dtype="float32")
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                padding=1, bias_attr=False)
+        c = fluid.layers.batch_norm(c, is_test=True)
+        h = fluid.layers.fc(c, 10, num_flatten_dims=1)
+        h = fluid.layers.dropout(h, dropout_prob=0.1, is_test=True)
+        return fluid.layers.scale(h, scale=1.0, bias=0.0)
+    main, scope, out = _fresh(build)
+    x = np.random.RandomState(9).randn(2, 3, 8, 8).astype("float32")
+    before = _run(main, scope, {"img": x}, [out.name])[0]
+    n_before = len(main.global_block().ops)
+    apply_inference_passes(main, scope)
+    n_after = len(main.global_block().ops)
+    assert n_after < n_before
+    types = _op_types(main)
+    assert "batch_norm" not in types and "dropout" not in types
+    after = _run(main, scope, {"img": x}, [out.name])[0]
+    np.testing.assert_allclose(before, after, rtol=1e-4, atol=1e-5)
