@@ -31,14 +31,21 @@ class DistributedTranspiler(Fleet):
 
     # ------------------------------------------------------------ worker
     def init_worker(self):
-        """Reference starts the async Communicator here; sync mode needs
-        nothing — send/recv ops carry the traffic."""
+        """Reference starts the async Communicator here (plus worker→server
+        heartbeats — heart_beat_monitor.h); sync mode's variable traffic
+        rides the send/recv ops, so only the beat thread starts."""
+        from paddle_tpu.fluid.ps_rpc import WorkerHeartBeat
+        self._heartbeat = WorkerHeartBeat(
+            self.server_endpoints(), self.worker_index()).start()
 
     def run_worker(self, main_programs=None, scopes=None):
         pass
 
     def stop_worker(self):
         from paddle_tpu.fluid.ps_rpc import VarClient
+        hb = getattr(self, "_heartbeat", None)
+        if hb is not None:
+            hb.stop()
         if self.worker_index() == 0:
             for ep in self.server_endpoints():
                 try:

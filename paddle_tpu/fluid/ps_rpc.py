@@ -188,6 +188,105 @@ class VarClient:
             pass
 
 
+class HeartBeatMonitor:
+    """Worker-liveness watchdog on the pserver (reference:
+    operators/distributed/heart_beat_monitor.h:54 — every worker RPC
+    updates its beat; a monitor thread flags workers whose last beat is
+    older than the timeout). Detection only, like the reference: dead
+    workers are logged and queryable; tearing the job down is the
+    launcher's job (launch.py watch loop)."""
+
+    def __init__(self, worker_num: int, timeout: float = 60.0,
+                 check_interval: float = 3.0,
+                 on_dead: Optional[Callable[[int], None]] = None):
+        self.worker_num = worker_num
+        self.timeout = timeout
+        self.check_interval = check_interval
+        self._on_dead = on_dead
+        self._beats: Dict[int, float] = {}
+        self._dead: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def update(self, worker_id: int) -> None:
+        now = time.time()
+        with self._lock:
+            self._beats[int(worker_id)] = now
+            self._dead.discard(int(worker_id))
+
+    def dead_workers(self):
+        with self._lock:
+            return sorted(self._dead)
+
+    def alive_workers(self):
+        with self._lock:
+            return sorted(set(self._beats) - self._dead)
+
+    def _scan(self):
+        while not self._stop.wait(self.check_interval):
+            now = time.time()
+            newly_dead = []
+            with self._lock:
+                for wid, t in self._beats.items():
+                    if wid not in self._dead and now - t > self.timeout:
+                        self._dead.add(wid)
+                        newly_dead.append(wid)
+            for wid in newly_dead:
+                import logging
+                logging.getLogger("paddle_tpu.ps").warning(
+                    "HeartBeatMonitor: worker %d silent for >%.0fs — "
+                    "presumed dead", wid, self.timeout)
+                if self._on_dead is not None:
+                    self._on_dead(wid)
+
+    def start_monitor(self) -> "HeartBeatMonitor":
+        self._thread = threading.Thread(target=self._scan, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.check_interval * 2)
+
+    def handlers(self) -> Dict[str, Callable[..., Any]]:
+        return {"heartbeat": lambda trainer_id=0: (self.update(trainer_id)
+                                                   or True)}
+
+
+class WorkerHeartBeat:
+    """Worker-side beat thread: pings every pserver endpoint periodically
+    (reference workers beat inside their send RPCs; an idle worker still
+    beats here so slow data pipelines aren't declared dead)."""
+
+    def __init__(self, endpoints, trainer_id: int, interval: float = 5.0):
+        self.endpoints = list(endpoints)
+        self.trainer_id = trainer_id
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            for ep in self.endpoints:
+                try:
+                    VarClient.of(ep).call("heartbeat",
+                                          trainer_id=self.trainer_id)
+                except Exception:
+                    pass  # server gone/restarting; the monitor sees silence
+
+    def start(self) -> "WorkerHeartBeat":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval * 2)
+
+
 class ReduceService:
     """Sum-across-workers service for host-side metric reductions (the
     reference's GlooWrapper::AllReduce role — gloo_wrapper.h:146). Workers

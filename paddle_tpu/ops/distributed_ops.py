@@ -190,7 +190,7 @@ def _merge_ids(ins, attrs):
 def _listen_and_serv(ins, attrs):
     """Server loop: blocks until a stop RPC (parity with RunImpl's
     server_thread join, listen_and_serv_op.cc:382)."""
-    from ..fluid.ps_rpc import VarServer
+    from ..fluid.ps_rpc import HeartBeatMonitor, VarServer
     ctx = attrs["_ctx"]
     scope, executor = ctx.scope, ctx.executor
     endpoint = attrs["endpoint"]
@@ -223,6 +223,7 @@ def _listen_and_serv(ins, attrs):
                     break
 
     def h_send_var(name, value, trainer_id=0, rows=None, height=0):
+        monitor.update(trainer_id)
         with lock:
             if rows is not None:
                 _apply_sparse(name, value, rows)
@@ -237,6 +238,7 @@ def _listen_and_serv(ins, attrs):
         return True
 
     def h_barrier(kind, trainer_id=0):
+        monitor.update(trainer_id)
         if not sync or kind != "send":
             return True
         with lock:
@@ -274,13 +276,16 @@ def _listen_and_serv(ins, attrs):
     def h_checkpoint(dir=""):
         return True
 
+    monitor = HeartBeatMonitor(fanin).start_monitor()
     srv = VarServer(endpoint, {
         "send_var": h_send_var, "barrier": h_barrier, "get_var": h_get_var,
         "prefetch_rows": h_prefetch_rows, "checkpoint": h_checkpoint,
+        **monitor.handlers(),
     }).start()
     try:
         srv.wait_stopped()
     finally:
+        monitor.stop()
         srv.shutdown()
     return {}
 

@@ -61,8 +61,10 @@ def _adam(ins, attrs):
     v_new = b2 * v + (1 - b2) * jnp.square(g)
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps * jnp.sqrt(1 - b2p))
+    b1p_in, b2p_in = first(ins, "Beta1Pow"), first(ins, "Beta2Pow")
     return out(ParamOut=p_new, Moment1Out=m_new, Moment2Out=v_new,
-               Beta1PowOut=b1p * b1, Beta2PowOut=b2p * b2)
+               Beta1PowOut=(b1p * b1).reshape(b1p_in.shape).astype(b1p_in.dtype),
+               Beta2PowOut=(b2p * b2).reshape(b2p_in.shape).astype(b2p_in.dtype))
 
 
 @register_op("adamax", no_grad=True,
@@ -182,8 +184,10 @@ def _lamb(ins, attrs):
     p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
     r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
     ratio = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    b1p_in, b2p_in = first(ins, "Beta1Pow"), first(ins, "Beta2Pow")
     return out(ParamOut=p - lr * ratio * r, Moment1Out=m_new, Moment2Out=v_new,
-               Beta1PowOut=b1p * b1, Beta2PowOut=b2p * b2)
+               Beta1PowOut=(b1p * b1).reshape(b1p_in.shape).astype(b1p_in.dtype),
+               Beta2PowOut=(b2p * b2).reshape(b2p_in.shape).astype(b2p_in.dtype))
 
 
 @register_op("lars_momentum", no_grad=True,

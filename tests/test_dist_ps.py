@@ -98,3 +98,39 @@ def test_ps_sync_two_trainers_match_and_converge(tmp_path):
 def test_ps_sparse_distributed_embedding(tmp_path):
     (losses,) = run_cluster(1, 60, str(tmp_path), sparse=True)
     assert losses[-1] < losses[0] * 0.3, losses
+
+
+# --------------------------------------------------------------------------
+# heartbeat monitor (reference: heart_beat_monitor.h:54 — pserver-side
+# worker liveness detection; in-process like rpc_server_test.cc)
+# --------------------------------------------------------------------------
+def test_heartbeat_monitor_detects_dead_worker():
+    from paddle_tpu.fluid.ps_rpc import (HeartBeatMonitor, VarClient,
+                                         VarServer, WorkerHeartBeat)
+    dead = []
+    mon = HeartBeatMonitor(2, timeout=0.6, check_interval=0.1,
+                           on_dead=dead.append).start_monitor()
+    srv = VarServer(f"127.0.0.1:{free_port()}", mon.handlers()).start()
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        hb0 = WorkerHeartBeat([ep], trainer_id=0, interval=0.1).start()
+        hb1 = WorkerHeartBeat([ep], trainer_id=1, interval=0.1).start()
+        time.sleep(0.5)
+        assert mon.alive_workers() == [0, 1]
+        assert mon.dead_workers() == []
+        hb1.stop()                       # worker 1 goes silent
+        deadline = time.time() + 5.0
+        while time.time() < deadline and mon.dead_workers() != [1]:
+            time.sleep(0.1)
+        assert mon.dead_workers() == [1]
+        assert mon.alive_workers() == [0]
+        hb1 = WorkerHeartBeat([ep], trainer_id=1, interval=0.1).start()
+        time.sleep(0.3)                  # a new beat revives the worker
+        assert mon.dead_workers() == []
+        assert dead == [1]
+        hb0.stop()
+        hb1.stop()
+    finally:
+        mon.stop()
+        srv.shutdown()
+        VarClient.reset_pool()

@@ -1,0 +1,117 @@
+"""GradientMergeOptimizer — gradient accumulation over k micro-batches
+(reference capability: ir/multi_batch_merge_pass.cc,
+test_dist_mnist_batch_merge.py oracle: merged micro-batches match one big
+batch)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+
+
+def _build(lr=0.1):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return main, startup, loss
+
+
+def _param_values(scope, program):
+    out = {}
+    for p in program.global_block().all_parameters():
+        out[p.name] = np.asarray(scope.find_var(p.name).value().array)
+    return out
+
+
+def _init_with_seed(exe, startup, scope, seed):
+    startup.random_seed = seed
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+
+
+def test_gradient_merge_matches_big_batch():
+    rng = np.random.RandomState(0)
+    b1 = {"x": rng.randn(8, 4).astype("float32"),
+          "y": rng.randn(8, 1).astype("float32")}
+    b2 = {"x": rng.randn(8, 4).astype("float32"),
+          "y": rng.randn(8, 1).astype("float32")}
+    big = {"x": np.concatenate([b1["x"], b2["x"]]),
+           "y": np.concatenate([b1["y"], b2["y"]])}
+    exe = fluid.Executor()
+
+    # GM(k=2, avg): two micro-batches then one update
+    main, startup, loss = _build()
+    with fluid.program_guard(main, startup):
+        opt = fluid.optimizer.GradientMergeOptimizer(
+            fluid.optimizer.SGD(0.1), k_steps=2, avg=True)
+        opt.minimize(loss)
+    scope_gm = core.Scope()
+    _init_with_seed(exe, startup, scope_gm, 7)
+    with fluid.scope_guard(scope_gm):
+        exe.run(main, feed=b1, fetch_list=[loss.name])
+        exe.run(main, feed=b2, fetch_list=[loss.name])
+    gm = _param_values(scope_gm, main)
+
+    # plain SGD on the concatenated batch, one step
+    main2, startup2, loss2 = _build()
+    with fluid.program_guard(main2, startup2):
+        fluid.optimizer.SGD(0.1).minimize(loss2)
+    scope_big = core.Scope()
+    _init_with_seed(exe, startup2, scope_big, 7)
+    with fluid.scope_guard(scope_big):
+        exe.run(main2, feed=big, fetch_list=[loss2.name])
+    ref = _param_values(scope_big, main2)
+
+    assert set(gm) == set(ref)
+    for name in ref:
+        np.testing.assert_allclose(gm[name], ref[name], rtol=1e-5,
+                                   atol=1e-6, err_msg=name)
+
+
+def test_gradient_merge_no_update_mid_window():
+    """Params must be untouched until the k-th micro-batch."""
+    main, startup, loss = _build()
+    with fluid.program_guard(main, startup):
+        opt = fluid.optimizer.GradientMergeOptimizer(
+            fluid.optimizer.SGD(0.1), k_steps=3)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    _init_with_seed(exe, startup, scope, 3)
+    before = _param_values(scope, main)
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.randn(4, 4).astype("float32"),
+            "y": rng.randn(4, 1).astype("float32")}
+    with fluid.scope_guard(scope):
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        mid = _param_values(scope, main)
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        exe.run(main, feed=feed, fetch_list=[loss.name])
+        after = _param_values(scope, main)
+    for name in before:
+        np.testing.assert_allclose(mid[name], before[name], err_msg=name)
+        assert abs(after[name] - before[name]).max() > 1e-6, name
+
+
+def test_gradient_merge_with_adam_converges():
+    main, startup, loss = _build()
+    with fluid.program_guard(main, startup):
+        opt = fluid.optimizer.GradientMergeOptimizer(
+            fluid.optimizer.Adam(5e-2), k_steps=2)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    _init_with_seed(exe, startup, scope, 11)
+    rng = np.random.RandomState(5)
+    w_true = rng.randn(4, 1).astype("float32")
+    losses = []
+    with fluid.scope_guard(scope):
+        for i in range(120):
+            x = rng.randn(16, 4).astype("float32")
+            y = x @ w_true
+            (lv,) = exe.run(main, feed={"x": x, "y": y},
+                            fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < 0.2 * losses[0], (losses[0], losses[-1])
