@@ -173,10 +173,40 @@ def bench_allreduce_dp(steps=10, warmup=3):
             "vs_baseline": 1.0, "devices": n_dev, "batch": B}
 
 
+def bench_wide_deep(batch=4096, steps=20, warmup=5):
+    """Wide&Deep CTR train step, samples/sec (BASELINE.md sparse-scale row
+    scaled to one chip: dense embeddings + MLP compile into the jitted
+    step; the beyond-HBM table path is exercised by the PS tests)."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.models import wide_deep
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if not on_tpu:
+        batch, steps = 256, 5
+    main, startup, feeds, loss, auc = wide_deep.build_wide_deep_program(
+        num_dense=13, num_slots=26, sparse_dim=int(1e6), embedding_dim=16,
+        hidden=(400, 400, 400), lr=1e-3)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    nb = wide_deep.ctr_reader(batch, num_dense=13, num_slots=26,
+                              sparse_dim=int(1e6), seed=0)
+    feed = nb()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        dt = _timed_steps(exe, main, feed, [loss], steps, warmup)
+    return {"metric": "wide_deep_ctr_samples_per_sec_per_chip",
+            "value": round(batch * steps / dt, 1), "unit": "samples/s",
+            "vs_baseline": 1.0, "batch": batch,
+            "embedding_params": int(26 * 1e6 * 16 + 26 * 1e6)}
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "bert"
     benches = {"bert": bench_bert_base, "mnist": bench_mnist_mlp,
-               "resnet": bench_resnet50, "allreduce": bench_allreduce_dp}
+               "resnet": bench_resnet50, "allreduce": bench_allreduce_dp,
+               "wide_deep": bench_wide_deep}
     if which not in benches:
         raise SystemExit(f"unknown bench '{which}'; one of "
                          f"{sorted(benches)}")

@@ -22,11 +22,12 @@ __all__ = ["DataLoader", "PyReader"]
 
 class _GeneratorLoader:
     def __init__(self, feed_list, capacity=16, iterable=True,
-                 return_list=False):
+                 return_list=False, use_multiprocess=False):
         self._feed_list = feed_list or []
         self._capacity = capacity
         self._iterable = iterable
         self._return_list = return_list
+        self._use_multiprocess = use_multiprocess
         self._batch_fn: Optional[Callable] = None
         self._places = None
 
@@ -75,6 +76,9 @@ class _GeneratorLoader:
 
     def __iter__(self):
         assert self._batch_fn is not None, "no generator set"
+        if self._use_multiprocess:
+            yield from self._iter_multiprocess()
+            return
         if self._capacity <= 1:
             yield from self._batch_fn()
             return
@@ -94,6 +98,63 @@ class _GeneratorLoader:
             if item is DONE:
                 break
             yield item
+
+    def _iter_multiprocess(self):
+        """Producer process + shared-memory batch transport (reference:
+        reader.py:684-760 multiprocess GeneratorLoader whose LoDTensors ride
+        mmap allocations — memory/allocation/mmap_allocator.cc; here each
+        array crosses via multiprocessing.shared_memory and only metadata is
+        pickled). The child is a daemon: an abandoned iterator or a parent
+        crash cannot leak it."""
+        import multiprocessing as mp
+        from multiprocessing import shared_memory
+
+        ctx = mp.get_context("fork")  # the generator closure must carry over
+        meta_q = ctx.Queue(self._capacity)
+        batch_fn = self._batch_fn
+
+        def producer():
+            segs = []
+            try:
+                for item in batch_fn():
+                    meta = {}
+                    for name, arr in item.items():
+                        a = np.ascontiguousarray(arr)
+                        shm = shared_memory.SharedMemory(create=True,
+                                                         size=max(1, a.nbytes))
+                        shm.buf[:a.nbytes] = a.tobytes()
+                        meta[name] = (shm.name, a.shape, a.dtype.str)
+                        segs.append(shm)
+                        shm.close()
+                    meta_q.put(("batch", meta))
+                meta_q.put(("done", None))
+            except Exception as e:  # surface the generator's error
+                meta_q.put(("error", repr(e)))
+
+        proc = ctx.Process(target=producer, daemon=True)
+        proc.start()
+        try:
+            while True:
+                kind, meta = meta_q.get()
+                if kind == "done":
+                    break
+                if kind == "error":
+                    raise RuntimeError(
+                        f"multiprocess DataLoader worker failed: {meta}")
+                batch = {}
+                for name, (shm_name, shape, dtype) in meta.items():
+                    shm = shared_memory.SharedMemory(name=shm_name)
+                    n = int(np.prod(shape)) if shape else 1
+                    arr = np.frombuffer(
+                        shm.buf, dtype=np.dtype(dtype),
+                        count=n).reshape(shape).copy()
+                    shm.close()
+                    shm.unlink()
+                    batch[name] = arr
+                yield batch
+        finally:
+            proc.terminate()
+            proc.join(timeout=5.0)
 
     def __call__(self):
         return iter(self)
@@ -120,7 +181,8 @@ class DataLoader:
     def from_generator(feed_list=None, capacity=16, use_double_buffer=True,
                        iterable=True, return_list=False,
                        use_multiprocess=False, drop_last=True):
-        return _GeneratorLoader(feed_list, capacity, iterable, return_list)
+        return _GeneratorLoader(feed_list, capacity, iterable, return_list,
+                                use_multiprocess=use_multiprocess)
 
     @staticmethod
     def from_dataset(dataset, places, drop_last=True):

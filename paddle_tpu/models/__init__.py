@@ -8,3 +8,4 @@ from . import word2vec  # noqa: F401
 from . import ptb_lm  # noqa: F401
 from . import se_resnext  # noqa: F401
 from . import mnist  # noqa: F401
+from . import wide_deep  # noqa: F401

@@ -244,3 +244,34 @@ def test_extend_with_decoupled_weight_decay():
     assert np.abs(after).sum() < np.abs(before).sum()
     with pytest.raises(TypeError):
         extend_with_decoupled_weight_decay(object)
+
+
+def test_multiprocess_dataloader_matches_inline():
+    """use_multiprocess=True runs the generator in a child process with
+    shared-memory batch transport (reference reader.py:684 multiprocess
+    GeneratorLoader over mmap allocations) and must yield identical
+    batches."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+
+    def make_reader():
+        def reader():
+            rng = np.random.RandomState(42)
+            for i in range(7):
+                yield {"x": rng.rand(4, 3).astype("float32"),
+                       "y": np.full((4, 1), i, "int64")}
+        return reader
+
+    inline = fluid.DataLoader.from_generator(feed_list=[], capacity=4)
+    inline.set_batch_generator(make_reader())
+    mp_loader = fluid.DataLoader.from_generator(
+        feed_list=[], capacity=4, use_multiprocess=True)
+    mp_loader.set_batch_generator(make_reader())
+
+    got_inline = list(inline)
+    got_mp = list(mp_loader)
+    assert len(got_inline) == len(got_mp) == 7
+    for a, b in zip(got_inline, got_mp):
+        assert set(a) == set(b)
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
