@@ -107,6 +107,7 @@ def bench_resnet50(batch=64, image_size=224, steps=10, warmup=3):
 
     if jax.devices()[0].platform == "cpu":  # CPU smoke: keep tractable
         batch, image_size, steps = 8, 64, 3
+    core.set_flag("FLAGS_use_bf16_matmul", True)  # MXU-native convs
     main, startup, feeds, fetches = build_resnet_train_program(
         depth=50, class_dim=1000, image_size=image_size)
     loss = fetches[0]

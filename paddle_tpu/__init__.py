@@ -19,6 +19,9 @@ from . import metric
 from . import device
 from . import distribution
 from . import incubate
+from . import dataset      # offline dataset readers (synthetic fallback)
+from . import reader       # reader decorators (map/shuffle/buffered/...)
+from . import version
 from .batch import batch
 from .framework import manual_seed, get_default_dtype, set_default_dtype
 # tensor functions at top level (reference paddle/__init__.py re-exports)

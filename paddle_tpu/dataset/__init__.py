@@ -1,0 +1,20 @@
+"""paddle.dataset — dataset readers (reference: python/paddle/dataset/ —
+mnist, cifar, uci_housing, imdb, movielens, wmt16, flowers, common).
+
+This environment has no network egress, so each module first looks for the
+real data in ``common.DATA_HOME`` and otherwise serves a DETERMINISTIC
+SYNTHETIC stand-in with the exact sample shapes/dtypes/vocab contracts of
+the original (clearly marked via ``<module>.SYNTHETIC``). The reader
+protocol — zero-arg callables yielding samples — matches the reference, so
+book models and tests run unchanged either way."""
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import movielens  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import flowers  # noqa: F401
+
+__all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "movielens",
+           "wmt16", "flowers"]

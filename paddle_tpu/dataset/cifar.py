@@ -1,0 +1,63 @@
+"""CIFAR readers (reference: python/paddle/dataset/cifar.py — samples
+(img[3072] float32 in [0,1], label int); cifar-10 and cifar-100)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100", "SYNTHETIC"]
+
+SYNTHETIC = True
+
+
+def _synthetic(n, classes, seed):
+    trng = np.random.RandomState(4321)
+    tmpl = trng.rand(classes, 3072).astype("float32")
+
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            y = int(r.randint(0, classes))
+            x = np.clip(tmpl[y] + 0.25 * r.randn(3072), 0, 1)
+            yield (x.astype("float32"), y)
+    return reader
+
+
+def _reader(tarname, keys, classes, n_synth, seed):
+    global SYNTHETIC
+    try:
+        import pickle
+        import tarfile
+        path = common.download("", "cifar", save_name=tarname)
+        SYNTHETIC = False
+
+        def reader():
+            with tarfile.open(path) as tf:
+                for m in tf.getmembers():
+                    if any(k in m.name for k in keys):
+                        batch = pickle.load(tf.extractfile(m),
+                                            encoding="latin1")
+                        labels = batch.get("labels") or \
+                            batch.get("fine_labels")
+                        for img, lab in zip(batch["data"], labels):
+                            yield (img.astype("float32") / 255.0, int(lab))
+        return reader
+    except FileNotFoundError:
+        return _synthetic(n_synth, classes, seed)
+
+
+def train10():
+    return _reader("cifar-10-python.tar.gz", ["data_batch"], 10, 4096, 0)
+
+
+def test10():
+    return _reader("cifar-10-python.tar.gz", ["test_batch"], 10, 512, 1)
+
+
+def train100():
+    return _reader("cifar-100-python.tar.gz", ["train"], 100, 4096, 2)
+
+
+def test100():
+    return _reader("cifar-100-python.tar.gz", ["test"], 100, 512, 3)

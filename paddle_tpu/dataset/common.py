@@ -1,0 +1,79 @@
+"""Shared dataset plumbing (reference: python/paddle/dataset/common.py —
+DATA_HOME, download with md5 check, cluster file splitting)."""
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+import pickle
+
+__all__ = ["DATA_HOME", "download", "md5file", "split", "cluster_files_reader"]
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def _ensure_dir(path):
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def md5file(fname: str) -> str:
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str | None = None,
+             save_name: str | None = None) -> str:
+    """Resolve a dataset file path under DATA_HOME. This build runs with no
+    network egress: if the file was pre-placed (or cached by an earlier
+    environment) it is used — and md5-verified when a sum is given;
+    otherwise FileNotFoundError tells the caller to fall back to the
+    synthetic reader."""
+    dirname = _ensure_dir(os.path.join(DATA_HOME, module_name))
+    filename = os.path.join(
+        dirname, save_name if save_name else url.split("/")[-1])
+    if os.path.exists(filename):
+        if md5sum and md5file(filename) != md5sum:
+            raise IOError(f"{filename} exists but fails its md5 check")
+        return filename
+    raise FileNotFoundError(
+        f"dataset file {filename} not present and downloads are disabled "
+        f"(no egress); place the file there or use the synthetic reader")
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Split a reader's samples into pickled chunk files (reference
+    common.py split)."""
+    dumper = dumper or (lambda obj, f: pickle.dump(obj, f))
+    lines = []
+    idx = 0
+    for sample in reader():
+        lines.append(sample)
+        if len(lines) == line_count:
+            with open(suffix % idx, "wb") as f:
+                dumper(lines, f)
+            lines = []
+            idx += 1
+    if lines:
+        with open(suffix % idx, "wb") as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Read this trainer's shard of chunk files (reference common.py
+    cluster_files_reader)."""
+    loader = loader or (lambda f: pickle.load(f))
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        for i, fn in enumerate(flist):
+            if i % trainer_count == trainer_id:
+                with open(fn, "rb") as f:
+                    for sample in loader(f):
+                        yield sample
+    return reader

@@ -1,0 +1,40 @@
+"""Oxford-102 flowers readers (reference: python/paddle/dataset/flowers.py
+— samples (img[3,224,224] float32, label int in [0,102)))."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "valid", "SYNTHETIC"]
+
+SYNTHETIC = True
+
+_CLASSES = 102
+_SIZE = 224
+
+
+def _synthetic(n, seed):
+    trng = np.random.RandomState(555)
+    # coarse 8x8 color templates upsampled — cheap but class-separable
+    tmpl = trng.rand(_CLASSES, 3, 8, 8).astype("float32")
+
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            y = int(r.randint(0, _CLASSES))
+            coarse = tmpl[y] + 0.2 * r.randn(3, 8, 8).astype("float32")
+            img = np.kron(coarse, np.ones((1, _SIZE // 8, _SIZE // 8),
+                                          "float32"))
+            yield (np.clip(img, 0, 1).reshape(3, _SIZE, _SIZE), y)
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _synthetic(512, seed=0)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _synthetic(128, seed=1)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _synthetic(128, seed=2)

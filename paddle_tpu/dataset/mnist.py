@@ -1,0 +1,67 @@
+"""MNIST readers (reference: python/paddle/dataset/mnist.py — idx-format
+parsing, samples (img[784] float32 in [-1,1], label int)). Falls back to a
+deterministic synthetic set when the idx files aren't cached locally."""
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "SYNTHETIC"]
+
+SYNTHETIC = True  # flipped off when real idx files are found
+
+_TRAIN_IMAGES = "train-images-idx3-ubyte.gz"
+_TRAIN_LABELS = "train-labels-idx1-ubyte.gz"
+_TEST_IMAGES = "t10k-images-idx3-ubyte.gz"
+_TEST_LABELS = "t10k-labels-idx1-ubyte.gz"
+
+
+def _parse_idx(img_path, label_path, buffer_size=100):
+    with gzip.open(img_path, "rb") as fi, gzip.open(label_path, "rb") as fl:
+        magic, n, rows, cols = struct.unpack(">IIII", fi.read(16))
+        lmagic, ln = struct.unpack(">II", fl.read(8))
+        for _ in range(n):
+            img = np.frombuffer(fi.read(rows * cols), np.uint8)
+            label = struct.unpack("B", fl.read(1))[0]
+            yield (img.astype("float32") / 127.5 - 1.0, int(label))
+
+
+def _synthetic(n, seed):
+    """Digits drawn as coarse template patterns + noise — learnable by the
+    book models, deterministic across runs."""
+    trng = np.random.RandomState(1234)  # templates shared by train/test
+    tmpl = trng.rand(10, 784).astype("float32")
+
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            y = int(r.randint(0, 10))
+            x = tmpl[y] + 0.35 * r.randn(784).astype("float32")
+            yield (np.clip(x, 0, 1).astype("float32") * 2.0 - 1.0, y)
+    return reader
+
+
+def _reader(images, labels, n_synth, seed):
+    global SYNTHETIC
+    try:
+        img = common.download("", "mnist", save_name=images)
+        lab = common.download("", "mnist", save_name=labels)
+        SYNTHETIC = False
+
+        def reader():
+            yield from _parse_idx(img, lab)
+        return reader
+    except FileNotFoundError:
+        return _synthetic(n_synth, seed)
+
+
+def train():
+    return _reader(_TRAIN_IMAGES, _TRAIN_LABELS, n_synth=8192, seed=0)
+
+
+def test():
+    return _reader(_TEST_IMAGES, _TEST_LABELS, n_synth=1024, seed=1)

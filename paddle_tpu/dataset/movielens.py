@@ -1,0 +1,65 @@
+"""MovieLens-1M readers (reference: python/paddle/dataset/movielens.py —
+samples [user_id, gender, age, job, movie_id, category_ids, title_ids,
+rating]; the book's recommender dataset)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "max_user_id", "max_movie_id", "max_job_id",
+           "age_table", "movie_categories", "SYNTHETIC"]
+
+SYNTHETIC = True
+
+_N_USERS = 600
+_N_MOVIES = 400
+_N_JOBS = 21
+_N_CATES = 18
+_TITLE_VOCAB = 1000
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def movie_categories():
+    return {("cat%d" % i): i for i in range(_N_CATES)}
+
+
+def _synthetic(n, seed):
+    trng = np.random.RandomState(99)
+    user_bias = trng.randn(_N_USERS + 1) * 0.5
+    movie_bias = trng.randn(_N_MOVIES + 1) * 0.8
+
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            uid = int(r.randint(1, _N_USERS + 1))
+            mid = int(r.randint(1, _N_MOVIES + 1))
+            gender = int(r.randint(0, 2))
+            age = int(r.randint(0, len(age_table)))
+            job = int(r.randint(0, _N_JOBS))
+            cats = list(map(int, r.randint(0, _N_CATES,
+                                           size=r.randint(1, 4))))
+            title = list(map(int, r.randint(0, _TITLE_VOCAB,
+                                            size=r.randint(1, 6))))
+            score = 3.0 + user_bias[uid] + movie_bias[mid] + 0.3 * r.randn()
+            rating = float(min(5.0, max(1.0, round(score))))
+            yield [uid, gender, age, job, mid, cats, title, rating]
+    return reader
+
+
+def train():
+    return _synthetic(6000, seed=0)
+
+
+def test():
+    return _synthetic(1200, seed=1)

@@ -1,0 +1,62 @@
+"""UCI housing readers (reference: python/paddle/dataset/uci_housing.py —
+13 normalized features + price; the book's fit_a_line dataset)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "feature_names", "SYNTHETIC"]
+
+SYNTHETIC = True
+
+feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS",
+                 "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
+
+def _load_real():
+    path = common.download("", "uci_housing", save_name="housing.data")
+    data = np.loadtxt(path)
+    feats = data[:, :13]
+    feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-8)
+    return np.concatenate([feats, data[:, 13:14]], axis=1)
+
+
+def _load_synthetic():
+    """y = w·x + noise over normalized features — same shapes, learnable."""
+    rng = np.random.RandomState(7)
+    n = 506
+    x = rng.randn(n, 13).astype("float32")
+    w = rng.randn(13).astype("float32") * 2.0
+    y = (x @ w + 22.5 + rng.randn(n).astype("float32")).reshape(-1, 1)
+    return np.concatenate([x, y], axis=1)
+
+
+def _data():
+    global SYNTHETIC
+    try:
+        d = _load_real()
+        SYNTHETIC = False
+        return d
+    except FileNotFoundError:
+        return _load_synthetic()
+
+
+def train():
+    def reader():
+        d = _data()
+        split = int(len(d) * 0.8)
+        for row in d[:split]:
+            yield (row[:13].astype("float32"),
+                   row[13:14].astype("float32"))
+    return reader
+
+
+def test():
+    def reader():
+        d = _data()
+        split = int(len(d) * 0.8)
+        for row in d[split:]:
+            yield (row[:13].astype("float32"),
+                   row[13:14].astype("float32"))
+    return reader

@@ -1,0 +1,55 @@
+"""WMT16 en-de readers (reference: python/paddle/dataset/wmt16.py — BPE
+vocab, samples (src_ids, trg_ids_next, trg_ids) with <s>/<e>/<unk>)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "validation", "get_dict", "SYNTHETIC"]
+
+SYNTHETIC = True
+
+_SRC_VOCAB = 2000
+_TRG_VOCAB = 2000
+_BOS, _EOS, _UNK = 0, 1, 2
+
+
+def get_dict(lang, dict_size, reverse=False):
+    size = _SRC_VOCAB if lang == "en" else _TRG_VOCAB
+    size = min(size, dict_size)
+    d = {"<s>": _BOS, "<e>": _EOS, "<unk>": _UNK}
+    d.update({("%s_tok%d" % (lang, i)): i for i in range(3, size)})
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def _synthetic(n, seed, src_dict_size, trg_dict_size):
+    sv = min(_SRC_VOCAB, src_dict_size)
+    tv = min(_TRG_VOCAB, trg_dict_size)
+
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            length = int(r.randint(3, 30))
+            src = r.randint(3, sv, size=length)
+            # the synthetic "translation": a deterministic token map with
+            # occasional reordering — learnable structure for seq2seq
+            trg = (src * 7 + 3) % (tv - 3) + 3
+            if length > 4:
+                trg = np.concatenate([trg[1:3], trg[:1], trg[3:]])
+            src_ids = list(map(int, src))
+            trg_full = [_BOS] + list(map(int, trg)) + [_EOS]
+            yield (src_ids, trg_full[1:], trg_full[:-1])
+    return reader
+
+
+def train(src_dict_size=2000, trg_dict_size=2000, src_lang="en"):
+    return _synthetic(4000, 0, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size=2000, trg_dict_size=2000, src_lang="en"):
+    return _synthetic(400, 1, src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size=2000, trg_dict_size=2000, src_lang="en"):
+    return _synthetic(400, 2, src_dict_size, trg_dict_size)

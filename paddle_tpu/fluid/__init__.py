@@ -21,6 +21,9 @@ from .clip import GradientClipByGlobalNorm, GradientClipByNorm, \
     GradientClipByValue
 from .param_attr import ParamAttr, WeightNormParamAttr
 from . import layers
+from . import nets
+from . import average
+from . import install_check
 from .layers.io import data
 from . import backward
 from .backward import append_backward, gradients

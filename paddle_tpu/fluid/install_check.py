@@ -1,0 +1,42 @@
+"""fluid.install_check — post-install sanity check (reference:
+python/paddle/fluid/install_check.py run_check — builds a tiny linear
+model, runs it single-device and data-parallel, prints the verdict)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    """Train one step of a 2-feature linear model on the default device,
+    then over every available device via a mesh (the reference's
+    ParallelExecutor leg)."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    prog = fluid.Program()
+    startup = fluid.Program()
+    scope = core.Scope()
+    with fluid.program_guard(prog, startup), fluid.unique_name.guard():
+        x = fluid.data("inp", shape=[2], dtype="float32")
+        linear = fluid.layers.fc(x, 4)
+        loss = fluid.layers.mean(linear)
+        fluid.optimizer.SGD(0.01).minimize(loss)
+    exe = fluid.Executor()
+    feed = {"inp": np.ones((2, 2), np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(prog, feed=feed, fetch_list=[loss.name])
+
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        from paddle_tpu.parallel.mesh import build_mesh
+        mesh = build_mesh(n_dev)
+        with fluid.scope_guard(scope):
+            exe.run(prog, feed={"inp": np.ones((2 * n_dev, 2), np.float32)},
+                    fetch_list=[loss.name], mesh=mesh)
+        print("Your paddle-tpu works well on MUTIPLE devices.")
+    print("Your paddle-tpu is installed successfully! Let's start deep "
+          "Learning with paddle-tpu now")

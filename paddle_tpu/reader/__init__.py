@@ -1,0 +1,11 @@
+"""paddle.reader — functional reader decorators (reference:
+python/paddle/reader/decorator.py — map_readers, buffered, compose, chain,
+shuffle, firstn, xmap_readers, cache, multiprocess_reader). A "reader" is a
+zero-arg callable returning an iterable of samples; decorators wrap readers
+into new readers. These run on the host feeding the device step, so plain
+Python + threads is the right tool."""
+from .decorator import (buffered, cache, chain, compose, firstn, map_readers,
+                        multiprocess_reader, shuffle, xmap_readers)
+
+__all__ = ["buffered", "cache", "chain", "compose", "firstn", "map_readers",
+           "multiprocess_reader", "shuffle", "xmap_readers"]

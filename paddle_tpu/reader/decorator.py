@@ -1,0 +1,217 @@
+"""Reader decorators (reference: python/paddle/reader/decorator.py)."""
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+
+__all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
+           "firstn", "xmap_readers", "cache", "multiprocess_reader"]
+
+
+def map_readers(func, *readers):
+    """Yield func applied across samples of several readers in lockstep
+    (reference decorator.py map_readers)."""
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Shuffle within a sliding buffer (reference decorator.py shuffle)."""
+    def shuffled(reader_inner=reader, buf_size_inner=buf_size):
+        buf = []
+        for e in reader_inner():
+            buf.append(e)
+            if len(buf) >= buf_size_inner:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    """Concatenate readers back to back (reference decorator.py chain)."""
+    def reader():
+        for r in readers:
+            yield from r()
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """Zip several readers into flat tuples: (a, b1, b2) from readers
+    yielding a and (b1, b2) (reference decorator.py compose)."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(map(make_tuple, outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum(map(make_tuple, outputs), ())
+    return reader
+
+
+def buffered(reader, size):
+    """Prefetch up to ``size`` samples in a background thread (reference
+    decorator.py buffered)."""
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q: "queue.Queue" = queue.Queue(maxsize=size)
+
+        def feed():
+            try:
+                for d in r:
+                    q.put(d)
+            finally:
+                q.put(_End)
+        t = threading.Thread(target=feed, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+    return data_reader
+
+
+def firstn(reader, n):
+    """Keep only the first n samples (reference decorator.py firstn)."""
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with worker threads (reference
+    decorator.py xmap_readers; thread-based — mappers are IO/numpy-bound
+    on the host)."""
+    END = object()
+
+    def data_reader():
+        in_q: "queue.Queue" = queue.Queue(buffer_size)
+        out_q: "queue.Queue" = queue.Queue(buffer_size)
+
+        def feeder():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(END)
+
+        def worker():
+            while True:
+                item = in_q.get()
+                if item is END:
+                    out_q.put(END)
+                    return
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feeder, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=worker, daemon=True).start()
+
+        finished = 0
+        if not order:
+            while finished < process_num:
+                item = out_q.get()
+                if item is END:
+                    finished += 1
+                else:
+                    yield item[1]
+        else:
+            next_idx = 0
+            held = {}
+            while finished < process_num or held:
+                if next_idx in held:
+                    yield held.pop(next_idx)
+                    next_idx += 1
+                    continue
+                if finished == process_num:
+                    # drain remaining in order
+                    for k in sorted(held):
+                        yield held.pop(k)
+                    break
+                item = out_q.get()
+                if item is END:
+                    finished += 1
+                else:
+                    held[item[0]] = item[1]
+    return data_reader
+
+
+def cache(reader):
+    """Materialise the reader once, replay from memory after (reference
+    decorator.py cache)."""
+    all_data = []
+    filled = [False]
+
+    def cache_reader():
+        if not filled[0]:
+            for sample in reader():
+                all_data.append(sample)
+                yield sample
+            filled[0] = True
+        else:
+            yield from all_data
+    return cache_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave several readers, each in its own process (reference
+    decorator.py multiprocess_reader over pipes)."""
+    import multiprocessing as mp
+    import pickle
+
+    def data_reader():
+        ctx = mp.get_context("fork")
+        q = ctx.Queue(queue_size)
+
+        def worker(r):
+            try:
+                for sample in r():
+                    q.put(pickle.dumps(sample))
+            finally:
+                q.put(None)
+
+        procs = [ctx.Process(target=worker, args=(r,), daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        try:
+            while finished < len(readers):
+                item = q.get()
+                if item is None:
+                    finished += 1
+                else:
+                    yield pickle.loads(item)
+        finally:
+            for p in procs:
+                p.terminate()
+                p.join(timeout=5.0)
+    return data_reader

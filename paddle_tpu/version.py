@@ -1,0 +1,13 @@
+"""Version info (reference: generated python/paddle/version.py)."""
+full_version = "1.7.0+tpu"
+major = "1"
+minor = "7"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native-build"
+with_mkl = "OFF"
+
+
+def show():
+    print("paddle-tpu", full_version, "commit:", commit)

@@ -1,0 +1,204 @@
+"""fluid.nets composites, paddle.dataset readers, paddle.reader decorators,
+WeightedAverage, install_check (reference: nets.py, dataset/, reader/
+decorator.py, average.py, install_check.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+
+
+# --------------------------------------------------------------------------
+# nets
+# --------------------------------------------------------------------------
+def test_simple_img_conv_pool_forward():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", shape=[1, 28, 28], dtype="float32")
+        out = fluid.nets.simple_img_conv_pool(
+            img, num_filters=4, filter_size=5, pool_size=2, pool_stride=2,
+            act="relu")
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (o,) = exe.run(main, feed={"img": np.random.rand(
+            2, 1, 28, 28).astype("float32")}, fetch_list=[out.name])
+    assert np.asarray(o).shape == (2, 4, 12, 12)
+    assert np.asarray(o).min() >= 0.0  # relu applied
+
+
+def test_img_conv_group_with_bn():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", shape=[3, 16, 16], dtype="float32")
+        out = fluid.nets.img_conv_group(
+            img, conv_num_filter=[8, 8], pool_size=2, pool_stride=2,
+            conv_act="relu", conv_with_batchnorm=True)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (o,) = exe.run(main, feed={"img": np.random.rand(
+            2, 3, 16, 16).astype("float32")}, fetch_list=[out.name])
+    assert np.asarray(o).shape == (2, 8, 8, 8)
+
+
+def test_glu_halves_last_dim():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[8], dtype="float32")
+        out = fluid.nets.glu(x, dim=-1)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    xv = np.random.randn(3, 8).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (o,) = exe.run(main, feed={"x": xv}, fetch_list=[out.name])
+    a, b = xv[:, :4], xv[:, 4:]
+    np.testing.assert_allclose(np.asarray(o), a / (1 + np.exp(-b)),
+                               rtol=1e-5)
+
+
+def test_scaled_dot_product_attention_shapes():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = fluid.data("q", shape=[6, 16], dtype="float32")
+        k = fluid.data("k", shape=[6, 16], dtype="float32")
+        v = fluid.data("v", shape=[6, 16], dtype="float32")
+        out = fluid.nets.scaled_dot_product_attention(q, k, v, num_heads=4)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    feed = {n: rng.randn(2, 6, 16).astype("float32") for n in "qkv"}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (o,) = exe.run(main, feed=feed, fetch_list=[out.name])
+    assert np.asarray(o).shape == (2, 6, 16)
+
+
+# --------------------------------------------------------------------------
+# datasets (synthetic fallback, deterministic)
+# --------------------------------------------------------------------------
+def test_dataset_mnist_contract():
+    samples = list(paddle.dataset.mnist.test()())
+    assert len(samples) == 1024
+    img, label = samples[0]
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    assert 0 <= label <= 9
+    again = list(paddle.dataset.mnist.test()())
+    np.testing.assert_array_equal(samples[0][0], again[0][0])
+
+
+def test_dataset_uci_housing_trains_linear_model():
+    data = list(paddle.dataset.uci_housing.train()())
+    x = np.stack([d[0] for d in data])
+    y = np.stack([d[1] for d in data])
+    w, *_ = np.linalg.lstsq(
+        np.concatenate([x, np.ones((len(x), 1), "float32")], 1), y,
+        rcond=None)
+    pred = np.concatenate([x, np.ones((len(x), 1), "float32")], 1) @ w
+    resid = np.mean((pred - y) ** 2)
+    assert resid < np.var(y) * 0.2  # the synthetic data is linear+noise
+
+
+def test_dataset_imdb_and_wmt16_and_movielens_shapes():
+    wd = paddle.dataset.imdb.word_dict()
+    assert len(wd) > 5000
+    s = next(iter(paddle.dataset.imdb.train(wd)()))
+    assert isinstance(s[0], list) and s[1] in (0, 1)
+
+    src, trg_next, trg_in = next(iter(paddle.dataset.wmt16.train(2000,
+                                                                 2000)()))
+    assert trg_in[0] == 0 and trg_next[-1] == 1  # <s> ... <e>
+    assert len(trg_next) == len(trg_in)
+
+    rec = next(iter(paddle.dataset.movielens.train()()))
+    assert len(rec) == 8 and 1.0 <= rec[-1] <= 5.0
+
+    img, label = next(iter(paddle.dataset.flowers.train()()))
+    assert img.shape == (3, 224, 224) and 0 <= label < 102
+
+    img10, lab10 = next(iter(paddle.dataset.cifar.train10()()))
+    assert img10.shape == (3072,) and 0 <= lab10 < 10
+
+
+# --------------------------------------------------------------------------
+# reader decorators
+# --------------------------------------------------------------------------
+def _counter(n):
+    def reader():
+        yield from range(n)
+    return reader
+
+
+def test_reader_decorators():
+    r = paddle.reader.firstn(_counter(100), 10)
+    assert list(r()) == list(range(10))
+
+    r = paddle.reader.chain(_counter(3), _counter(2))
+    assert list(r()) == [0, 1, 2, 0, 1]
+
+    r = paddle.reader.map_readers(lambda a, b: a + b, _counter(4),
+                                  _counter(4))
+    assert list(r()) == [0, 2, 4, 6]
+
+    r = paddle.reader.buffered(_counter(50), 8)
+    assert sorted(r()) == list(range(50))
+
+    r = paddle.reader.shuffle(_counter(20), 10)
+    got = list(r())
+    assert sorted(got) == list(range(20))
+
+    r = paddle.reader.compose(_counter(3), _counter(3))
+    assert list(r()) == [(0, 0), (1, 1), (2, 2)]
+    with pytest.raises(paddle.reader.decorator.ComposeNotAligned):
+        list(paddle.reader.compose(_counter(3), _counter(4))())
+
+    calls = []
+
+    def tracked():
+        def reader():
+            calls.append(1)
+            yield from range(5)
+        return reader
+    r = paddle.reader.cache(tracked())
+    assert list(r()) == list(range(5))
+    assert list(r()) == list(range(5))
+    assert len(calls) == 1
+
+    r = paddle.reader.xmap_readers(lambda x: x * 2, _counter(30), 4, 8,
+                                   order=True)
+    assert list(r()) == [2 * i for i in range(30)]
+    r = paddle.reader.xmap_readers(lambda x: x * 2, _counter(30), 4, 8)
+    assert sorted(r()) == [2 * i for i in range(30)]
+
+    r = paddle.reader.multiprocess_reader([_counter(10), _counter(5)])
+    assert sorted(r()) == sorted(list(range(10)) + list(range(5)))
+
+
+# --------------------------------------------------------------------------
+# average / install_check / version
+# --------------------------------------------------------------------------
+def test_weighted_average():
+    avg = fluid.average.WeightedAverage()
+    with pytest.raises(ValueError):
+        avg.eval()
+    avg.add(1.0, 1)
+    avg.add(np.array([3.0, 5.0]), 2)
+    assert abs(avg.eval() - (1.0 + 4.0 * 2) / 3) < 1e-9
+    avg.reset()
+    avg.add(2.0, 1)
+    assert avg.eval() == 2.0
+
+
+def test_install_check_runs(capsys):
+    fluid.install_check.run_check()
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+
+
+def test_version():
+    assert paddle.version.full_version.startswith("1.7")

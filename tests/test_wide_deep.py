@@ -46,9 +46,11 @@ def test_wide_deep_sparse_flag_builds_selected_rows_path():
     scope = core.Scope()
     nb = wide_deep.ctr_reader(batch=64, num_dense=4, num_slots=2,
                               sparse_dim=20, seed=1)
+    losses = []
     with fluid.scope_guard(scope):
         exe.run(startup)
-        l0 = exe.run(main, feed=nb(), fetch_list=[loss.name])[0]
-        for _ in range(15):
-            lN = exe.run(main, feed=nb(), fetch_list=[loss.name])[0]
-    assert float(np.asarray(lN).ravel()[0]) < float(np.asarray(l0).ravel()[0])
+        for _ in range(30):
+            lv = exe.run(main, feed=nb(), fetch_list=[loss.name])[0]
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    # single-batch losses are noisy: compare window means
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
