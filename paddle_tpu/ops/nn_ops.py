@@ -581,6 +581,36 @@ def _conv2d_transpose(ins, attrs):
     return out(Output=o)
 
 
+def _avg_pool_slices(x, ksize, strides, pads, exclusive):
+    """NCHW avg pool as sum over kh·kw strided slices, divided by a static
+    valid-element count map (exclusive=True: pad elements don't count)."""
+    n, c, H, W = x.shape
+    kh, kw = ksize
+    sh, sw = strides
+    (pt, pb), (pl_, pr) = pads
+    xp = jnp.pad(x, [(0, 0), (0, 0), (pt, pb), (pl_, pr)])
+    oh = (H + pt + pb - kh) // sh + 1
+    ow = (W + pl_ + pr - kw) // sw + 1
+    o = None
+    for i in range(kh):
+        for j in range(kw):
+            s = lax.slice(xp, (0, 0, i, j),
+                          (n, c, i + (oh - 1) * sh + 1,
+                           j + (ow - 1) * sw + 1), (1, 1, sh, sw))
+            o = s if o is None else o + s
+    if exclusive and (pt or pb or pl_ or pr):
+        ones = np.zeros((H + pt + pb, W + pl_ + pr), np.float32)
+        ones[pt:pt + H, pl_:pl_ + W] = 1.0
+        cnt = np.zeros((oh, ow), np.float32)
+        for i in range(kh):
+            for j in range(kw):
+                cnt += ones[i:i + (oh - 1) * sh + 1:sh,
+                            j:j + (ow - 1) * sw + 1:sw]
+        cnt = np.maximum(cnt, 1.0)
+        return o / jnp.asarray(cnt, x.dtype)
+    return o / float(kh * kw)
+
+
 def _max_pool_slices(x, ksize, strides, pads, init):
     """NCHW max pool as max over kh·kw strided slices."""
     n, c, H, W = x.shape
@@ -646,13 +676,15 @@ def _pool2d_impl(x, attrs):
             o = _max_pool_slices(x_nchw, ksize, strides, pads, init)
             return jnp.transpose(o, (0, 2, 3, 1))
         return _max_pool_slices(x, ksize, strides, pads, init)
-    s = lax.reduce_window(x, jnp.asarray(0.0, x.dtype), lax.add,
-                          wdims, wstrides, wpads)
-    if attrs.get("exclusive", True):
-        cnt = lax.reduce_window(jnp.ones_like(x), jnp.asarray(0.0, x.dtype),
-                                lax.add, wdims, wstrides, wpads)
-        return s / cnt
-    return s / float(ksize[0] * ksize[1])
+    # avg: stacked-slices sum (reduce_window(add) also lacks a vjp here);
+    # the per-window divisor is a static constant map
+    if ch_last:
+        x_nchw = jnp.transpose(x, (0, 3, 1, 2))
+        o = _avg_pool_slices(x_nchw, ksize, strides, pads,
+                             attrs.get("exclusive", True))
+        return jnp.transpose(o, (0, 2, 3, 1))
+    return _avg_pool_slices(x, ksize, strides, pads,
+                            attrs.get("exclusive", True))
 
 
 @register_op("pool2d", inputs=("X",),
@@ -693,16 +725,47 @@ def _pool3d(ins, attrs):
     pads = _conv_padding(attrs.get("paddings"), attrs.get("padding_algorithm"),
                          3, ksize, strides, [1, 1, 1], x.shape[2:])
     wdims = (1, 1) + tuple(ksize)
-    wstrides = (1, 1) + tuple(strides)
-    wpads = [(0, 0), (0, 0)] + pads
-    if attrs.get("pooling_type", "max") == "max":
-        return out(Out=lax.reduce_window(x, jnp.asarray(-jnp.inf, x.dtype),
-                                         lax.max, wdims, wstrides, wpads))
-    s = lax.reduce_window(x, jnp.asarray(0.0, x.dtype), lax.add, wdims,
-                          wstrides, wpads)
-    cnt = lax.reduce_window(jnp.ones_like(x), jnp.asarray(0.0, x.dtype),
-                            lax.add, wdims, wstrides, wpads)
-    return out(Out=s / cnt)
+    # stacked-slices pooling: differentiable (reduce_window max/add lack a
+    # vjp under this jax version)
+    is_max = attrs.get("pooling_type", "max") == "max"
+    kd, kh, kw = ksize
+    sd, sh, sw = strides
+    n, c, D, H, W = x.shape
+    init = -jnp.inf if is_max else 0.0
+    xp = jnp.pad(x, [(0, 0), (0, 0), pads[0], pads[1], pads[2]],
+                 constant_values=init)
+    od = (D + sum(pads[0]) - kd) // sd + 1
+    oh = (H + sum(pads[1]) - kh) // sh + 1
+    ow = (W + sum(pads[2]) - kw) // sw + 1
+    o = None
+    for a in range(kd):
+        for i in range(kh):
+            for j in range(kw):
+                s = lax.slice(xp, (0, 0, a, i, j),
+                              (n, c, a + (od - 1) * sd + 1,
+                               i + (oh - 1) * sh + 1,
+                               j + (ow - 1) * sw + 1),
+                              (1, 1, sd, sh, sw))
+                if o is None:
+                    o = s
+                else:
+                    o = jnp.maximum(o, s) if is_max else o + s
+    if is_max:
+        return out(Out=o)
+    if attrs.get("exclusive", True) and any(sum(p) for p in pads):
+        ones = np.zeros((D + sum(pads[0]), H + sum(pads[1]),
+                         W + sum(pads[2])), np.float32)
+        ones[pads[0][0]:pads[0][0] + D, pads[1][0]:pads[1][0] + H,
+             pads[2][0]:pads[2][0] + W] = 1.0
+        cnt = np.zeros((od, oh, ow), np.float32)
+        for a in range(kd):
+            for i in range(kh):
+                for j in range(kw):
+                    cnt += ones[a:a + (od - 1) * sd + 1:sd,
+                                i:i + (oh - 1) * sh + 1:sh,
+                                j:j + (ow - 1) * sw + 1:sw]
+        return out(Out=o / jnp.asarray(np.maximum(cnt, 1.0), x.dtype))
+    return out(Out=o / float(kd * kh * kw))
 
 
 @register_op("max_pool2d_with_index", inputs=("X",),
