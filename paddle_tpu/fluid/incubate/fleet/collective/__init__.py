@@ -66,17 +66,11 @@ class Collective(Fleet):
     def _init_distributed_runtime(self):
         """NCCL-id bootstrap equivalent: bring up jax.distributed across
         hosts using the PADDLE_* env contract (reference: gen_nccl_id over
-        gRPC — operators/collective/c_gen_nccl_id_op.cc)."""
-        import jax
-        nranks = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
-        if nranks > 1 and not jax.distributed.is_initialized():
-            eps = self.worker_endpoints()
-            coordinator = eps[0] if eps else os.getenv(
-                "PADDLE_TRAINER_ENDPOINTS", "").split(",")[0]
-            jax.distributed.initialize(
-                coordinator_address=coordinator,
-                num_processes=nranks,
-                process_id=self.worker_index())
+        gRPC — operators/collective/c_gen_nccl_id_op.cc). Shared logic
+        lives in parallel.env.init_distributed."""
+        from paddle_tpu.parallel.env import init_distributed
+        eps = self.worker_endpoints()
+        init_distributed(coordinator_address=eps[0] if eps else None)
 
     def init_worker(self):
         pass

@@ -30,3 +30,37 @@ def rank() -> int:
 
 def local_device_count() -> int:
     return len(jax.local_devices())
+
+
+_distributed_initialized = False
+
+
+def init_distributed(coordinator_address: Optional[str] = None) -> bool:
+    """Bring up the cross-host runtime from the PADDLE_* env contract
+    (reference: the NCCL-id bootstrap c_gen_nccl_id + NCCLCommContext init,
+    collective/c_gen_nccl_id_op.cc — here one jax.distributed.initialize
+    makes every host's chips visible as one global mesh over ICI/DCN).
+
+    Coordinator: `JAX_COORDINATOR_ADDRESS` env if set, else trainer 0's
+    endpoint from PADDLE_TRAINER_ENDPOINTS (free in this build's collective
+    mode — no server binds it). Returns True if a multi-host init ran;
+    single-process jobs are a no-op."""
+    global _distributed_initialized
+    n = world_size()
+    try:
+        already = jax.distributed.is_initialized()
+    except AttributeError:  # older jax
+        already = _distributed_initialized
+    if n <= 1 or already:
+        return False
+    addr = (coordinator_address
+            or os.getenv("JAX_COORDINATOR_ADDRESS")
+            or os.getenv("PADDLE_TRAINER_ENDPOINTS", "").split(",")[0])
+    if not addr:
+        raise RuntimeError(
+            "init_distributed needs PADDLE_TRAINER_ENDPOINTS or "
+            "JAX_COORDINATOR_ADDRESS to locate the coordinator")
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=n, process_id=rank())
+    _distributed_initialized = True
+    return True

@@ -153,3 +153,26 @@ def test_collective_ops_inside_shard_map():
                                    np.full((8, 1), 28.0))
     finally:
         collective_ops.set_ring_axis(0, None)
+
+
+def test_init_distributed_wiring(monkeypatch):
+    """parallel.env.init_distributed maps the PADDLE_* env contract onto
+    jax.distributed.initialize (reference: gen_nccl_id bootstrap)."""
+    import jax
+    from paddle_tpu.parallel import env as penv
+
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setattr(jax.distributed, "is_initialized",
+                        lambda: False, raising=False)
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                       "10.0.0.1:6170,10.0.0.2:6170")
+    assert penv.init_distributed() is True
+    assert calls == [{"coordinator_address": "10.0.0.1:6170",
+                      "num_processes": 4, "process_id": 2}]
+    # single-process: no-op
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+    assert penv.init_distributed() is False
