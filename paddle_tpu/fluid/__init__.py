@@ -29,7 +29,7 @@ from . import backward
 from .backward import append_backward, gradients
 from . import optimizer
 from . import executor
-from .executor import Executor, global_scope, scope_guard
+from .executor import Executor, FetchHandler, global_scope, scope_guard
 from . import compiler
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
 from . import io

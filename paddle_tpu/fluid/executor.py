@@ -36,7 +36,69 @@ from .core import LoDTensor, Scope, global_scope
 from .framework import Program, Variable, default_main_program
 from ..ops.registry import OPS, run_generic_grad, GRAD_SUFFIX
 
-__all__ = ["Executor", "global_scope", "scope_guard"]
+__all__ = ["Executor", "global_scope", "scope_guard", "FetchHandler"]
+
+
+class FetchHandler:
+    """Periodic async fetch during dataset training (reference:
+    executor.py FetchHandler + trainer FetchHandlerMonitor thread — user
+    overrides handler(); it receives {var_name: numpy|None} snapshots every
+    ``period_secs`` while train_from_dataset runs)."""
+
+    def __init__(self, var_dict=None, period_secs=60):
+        if var_dict is None or not isinstance(var_dict, dict):
+            raise TypeError("var_dict must be a {name: Variable} dict")
+        self.var_dict = var_dict
+        self.period_secs = period_secs
+
+    def handler(self, res_dict):
+        for key in res_dict:
+            if isinstance(res_dict[key], np.ndarray):
+                print(f"{key}[0]: {res_dict[key][0]} ")
+
+    @staticmethod
+    def help():
+        print("""
+class FetchHandlerExample(FetchHandler):
+    def handler(self, res_dict):
+        print(res_dict["var1"])  # numpy snapshot (None if not yet set)
+handler = FetchHandlerExample(var_dict={"var1": var1}, period_secs=60)
+""")
+
+
+class _FetchHandlerMonitor:
+    """Daemon thread sampling scope vars for a FetchHandler (reference:
+    trainer_factory.py FetchHandlerMonitor)."""
+
+    def __init__(self, scope: Scope, handler: FetchHandler):
+        import threading
+        self._scope = scope
+        self._handler = handler
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _sample(self):
+        res = {}
+        for name, var in self._handler.var_dict.items():
+            vname = getattr(var, "name", var)
+            v = self._scope.find_var(vname)
+            if v is None or not v.is_initialized():
+                res[name] = None
+            else:
+                res[name] = np.asarray(v.get_tensor().array)
+        return res
+
+    def _loop(self):
+        while not self._stop_evt.wait(self._handler.period_secs):
+            self._handler.handler(self._sample())
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        # final synchronous sample so short runs still see one callback
+        self._handler.handler(self._sample())
+        self._stop_evt.set()
 
 
 import contextlib
@@ -546,23 +608,25 @@ class Executor:
     # ------------------------------------------------------ dataset path
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
         """One pass over a Dataset (reference: executor.py:1438
         train_from_dataset → C++ MultiTrainer/HogwildWorker threads,
         trainer.h:64). The TPU inversion: batches stream from the native
         C++ feed engine into the ONE jitted step — XLA pipelining replaces
         the reference's per-thread op loops."""
         return self._run_from_dataset(program, dataset, scope, fetch_list,
-                                      fetch_info, print_period)
+                                      fetch_info, print_period, fetch_handler)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
         return self._run_from_dataset(program, dataset, scope, fetch_list,
-                                      fetch_info, print_period)
+                                      fetch_info, print_period, fetch_handler)
 
     def _run_from_dataset(self, program, dataset, scope, fetch_list,
-                          fetch_info, print_period):
+                          fetch_info, print_period, fetch_handler=None):
         if dataset is None:
             raise ValueError("dataset must be provided")
         if program is None:
@@ -573,17 +637,26 @@ class Executor:
         if dataset.get_memory_data_size() == 0:
             dataset._load()
         fetch_names = _to_fetch_names(fetch_list)
+        monitor = None
+        if fetch_handler is not None:
+            monitor = _FetchHandlerMonitor(scope, fetch_handler)
+            monitor.start()
         step = 0
         last = []
-        for feed in dataset._iter_batches():
-            last = self.run(program, feed=feed, fetch_list=fetch_list,
-                            scope=scope)
-            if fetch_names and print_period and step % print_period == 0:
-                infos = fetch_info or fetch_names
-                msg = ", ".join(f"{i}={np.asarray(v).reshape(-1)[0]:.6f}"
-                                for i, v in zip(infos, last))
-                print(f"[train_from_dataset] step {step}: {msg}")
-            step += 1
+        try:
+            for feed in dataset._iter_batches():
+                last = self.run(program, feed=feed, fetch_list=fetch_list,
+                                scope=scope)
+                if fetch_names and print_period and \
+                        step % print_period == 0:
+                    infos = fetch_info or fetch_names
+                    msg = ", ".join(f"{i}={np.asarray(v).reshape(-1)[0]:.6f}"
+                                    for i, v in zip(infos, last))
+                    print(f"[train_from_dataset] step {step}: {msg}")
+                step += 1
+        finally:
+            if monitor is not None:
+                monitor.stop()
         return last
 
     # --------------------------------------------------------------- eager

@@ -35,6 +35,11 @@ class DistributeTranspilerConfig:
     wait_port = True
     runtime_split_send_recv = False
     sync_mode = True
+    # GEO-SGD async mode (reference: geo_sgd_transpiler.py +
+    # GeoSgdCommunicator communicator.h:383): train locally, push param
+    # DELTAS to the pservers every geo_sgd_need_push_nums steps
+    geo_sgd_mode = False
+    geo_sgd_need_push_nums = 100
 
 
 class DistributeTranspiler:
@@ -82,8 +87,30 @@ class DistributeTranspiler:
         self.grad_of: Dict[str, str] = {p: g for p, g, _ in
                                         self.param_grad_ops}
 
-        self._build_trainer_program()
+        if self.config.geo_sgd_mode:
+            self._build_geo_trainer_program()
+        else:
+            self._build_trainer_program()
         return self
+
+    # ------------------------------------------------------------------
+    def _build_geo_trainer_program(self):
+        """GEO: keep the local optimizer ops; append one geo_sgd_send op
+        that every N steps pushes (param - snapshot) deltas to each param's
+        pserver and pulls the merged global params back (reference:
+        geo_sgd_transpiler.py builds the local program;
+        GeoSgdCommunicator does the delta sync)."""
+        prog = self.origin_program.clone()
+        block = prog.global_block()
+        dense = [p for p, _, _ in self.param_grad_ops
+                 if p not in self.sparse_tables]
+        block.append_op(
+            type="geo_sgd_send", inputs={"Params": dense}, outputs={},
+            attrs={"epmap": [self.param_ep[p] for p in dense],
+                   "push_nums": int(self.config.geo_sgd_need_push_nums),
+                   "trainer_id": self.trainer_id,
+                   "trainers": self.trainer_num})
+        self.trainer_program = prog
 
     # ------------------------------------------------------------------
     def _build_trainer_program(self):
@@ -149,6 +176,24 @@ class DistributeTranspiler:
 
         mine = [(p, g, op) for p, g, op in self.param_grad_ops
                 if self.param_ep[p] == endpoint]
+
+        if self.config.geo_sgd_mode:
+            # GEO pserver: hosts the params, applies pushed deltas on
+            # arrival, serves pulls — no optimize blocks (the optimizer
+            # ran on the trainers)
+            for p, _g, _op in mine:
+                src = origin_block.vars.get(p)
+                gblock.create_var(name=p, shape=getattr(src, "shape", None),
+                                  dtype=getattr(src, "dtype", None),
+                                  persistable=True)
+            gblock.append_op(
+                type="listen_and_serv", inputs={}, outputs={},
+                attrs={"endpoint": endpoint, "sync_mode": False,
+                       "Fanin": self.trainer_num, "optimize_blocks": [],
+                       "grad_to_block_id": [], "distributed_mode": 2})
+            prog._ps_endpoint = endpoint
+            prog._pserver_params = [p for p, _, _ in mine]
+            return prog
         optimize_blocks = []
         grad_to_block_id = []
         needed_vars = set()

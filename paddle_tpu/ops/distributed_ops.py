@@ -59,6 +59,56 @@ def _send(ins, attrs):
     return {}
 
 
+@register_op("geo_sgd_send", stateful=True, no_grad=True,
+             attr_defaults={"epmap": [], "push_nums": 100, "trainer_id": 0,
+                            "trainers": 1})
+def _geo_sgd_send(ins, attrs):
+    """GEO-SGD delta sync (reference: GeoSgdCommunicator,
+    communicator.h:383): every ``push_nums`` local steps push
+    (param - snapshot) to the param's pserver, pull the merged global
+    param back, and reset the snapshot. Between syncs training is fully
+    local, so the step stays on-device."""
+    ctx = attrs["_ctx"]
+    scope = ctx.scope
+    names = ctx.op.input("Params")
+    epmap = attrs.get("epmap") or []
+    tid = int(attrs.get("trainer_id", 0))
+    push_nums = max(1, int(attrs.get("push_nums", 100)))
+
+    cvar = scope.var("@GEO_STEP@")
+    step = 0
+    if cvar.is_initialized():
+        step = int(np.asarray(cvar.get_tensor().array).reshape(-1)[0])
+    step += 1
+    cvar.set_value(core.LoDTensor(np.asarray([step], np.int64)))
+
+    if step == 1:
+        # anchor: snapshot the server's params as the delta baseline
+        # (reference GeoSgdCommunicator pulls at init_worker; trainers and
+        # server share the startup init, so this is the common start)
+        for i, name in enumerate(names):
+            ep = epmap[i if i < len(epmap) else -1]
+            fresh = np.asarray(_client(ep).get_var(name, trainer_id=tid))
+            scope.var(name + "@GEO_OLD").set_value(
+                core.LoDTensor(fresh.copy()))
+        return {}
+    if step % push_nums != 0:
+        return {}
+
+    for i, name in enumerate(names):
+        ep = epmap[i if i < len(epmap) else -1]
+        cur = np.asarray(scope.find_var(name).value().array)
+        old_var = scope.var(name + "@GEO_OLD")
+        old = np.asarray(old_var.get_tensor().array)
+        _client(ep).call("geo_delta", name=name,
+                         value=np.ascontiguousarray(cur - old),
+                         trainer_id=tid)
+        fresh = np.asarray(_client(ep).get_var(name, trainer_id=tid))
+        scope.find_var(name).set_value(core.LoDTensor(jnp.asarray(fresh)))
+        old_var.set_value(core.LoDTensor(fresh.copy()))
+    return {}
+
+
 @register_op("recv", stateful=True, no_grad=True,
              attr_defaults={"epmap": [], "trainer_id": 0})
 def _recv(ins, attrs):
@@ -276,10 +326,24 @@ def _listen_and_serv(ins, attrs):
     def h_checkpoint(dir=""):
         return True
 
+    def h_geo_delta(name, value, trainer_id=0):
+        """GEO-SGD delta apply: param += delta on arrival (reference
+        GeoSgdCommunicator server side, communicator.h:383)."""
+        monitor.update(trainer_id)
+        with lock:
+            var = scope.find_var(name)
+            if var is None:
+                raise KeyError(f"geo pserver has no param '{name}'")
+            cur = np.asarray(var.value().array)
+            var.set_value(core.LoDTensor(
+                jnp.asarray(cur + np.asarray(value))))
+        return True
+
     monitor = HeartBeatMonitor(fanin).start_monitor()
     srv = VarServer(endpoint, {
         "send_var": h_send_var, "barrier": h_barrier, "get_var": h_get_var,
         "prefetch_rows": h_prefetch_rows, "checkpoint": h_checkpoint,
+        "geo_delta": h_geo_delta,
         **monitor.handlers(),
     }).start()
     try:

@@ -50,13 +50,18 @@ def build(sparse):
 def main():
     role, eps, tid, trainers, steps, outfile = sys.argv[1:7]
     sparse = "--sparse" in sys.argv
+    geo = "--geo" in sys.argv
     tid, trainers, steps = int(tid), int(trainers), int(steps)
     main_prog, startup, loss = build(sparse)
 
-    t = DistributeTranspiler(DistributeTranspilerConfig())
+    cfg = DistributeTranspilerConfig()
+    if geo:
+        cfg.geo_sgd_mode = True
+        cfg.geo_sgd_need_push_nums = 5
+    t = DistributeTranspiler(cfg)
     with fluid.program_guard(main_prog, startup):
         t.transpile(trainer_id=tid, pservers=eps, trainers=trainers,
-                    sync_mode=True, program=main_prog,
+                    sync_mode=not geo, program=main_prog,
                     startup_program=startup)
 
     exe = fluid.Executor()

@@ -119,3 +119,53 @@ def test_train_from_dataset(tmp_path, capsys):
         final = float(np.asarray(out[0]).reshape(-1)[0])
     assert np.isfinite(final)
     assert final <= first + 0.5
+
+
+def test_fetch_handler(tmp_path):
+    """FetchHandler gets periodic {name: numpy} snapshots during
+    train_from_dataset (reference: executor.py FetchHandler +
+    trainer_factory FetchHandlerMonitor)."""
+    files, rows = make_files(tmp_path, n_files=1, rows_per_file=8)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", shape=[1], dtype="int64", lod_level=1)
+        dense = fluid.data("dense", shape=[4], dtype="float32")
+        label = fluid.data("label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[20, 4])
+        pooled = fluid.layers.sequence_pool(emb, "sum")
+        feat = fluid.layers.concat([pooled, dense], axis=1)
+        pred = fluid.layers.fc(feat, 2, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_thread(1)
+    ds.set_filelist(files)
+    ds.set_use_var([ids, dense, label])
+    ds.load_into_memory()
+
+    seen = []
+
+    class H(fluid.FetchHandler):
+        def handler(self, res_dict):
+            seen.append({k: None if v is None else np.asarray(v).copy()
+                         for k, v in res_dict.items()})
+
+    # sample a parameter: in the compiled executor fetch intermediates are
+    # returned to the caller, while scope state holds params/accumulators
+    w = main.global_block().all_parameters()[0]
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.train_from_dataset(main, ds, fetch_list=[loss],
+                               print_period=0,
+                               fetch_handler=H({"w": w},
+                                               period_secs=0.05))
+    assert seen, "handler never called"
+    assert "w" in seen[-1] and seen[-1]["w"] is not None
+    assert np.isfinite(seen[-1]["w"]).all()
+
+    with pytest.raises(TypeError):
+        fluid.FetchHandler(var_dict=None)

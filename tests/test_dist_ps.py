@@ -23,7 +23,8 @@ def free_port():
     return p
 
 
-def run_cluster(trainers, steps, tmpdir, sparse=False, timeout=240):
+def run_cluster(trainers, steps, tmpdir, sparse=False, geo=False,
+                timeout=240):
     ep = f"127.0.0.1:{free_port()}"
     env = dict(os.environ, PYTHONPATH=REPO + os.pathsep +
                os.environ.get("PYTHONPATH", ""), JAX_PLATFORMS="cpu")
@@ -48,7 +49,8 @@ def run_cluster(trainers, steps, tmpdir, sparse=False, timeout=240):
     ps_out = os.path.join(tmpdir, "ps.ready")
     ps = spawn("ps", [sys.executable, WORKLOAD, "pserver", ep, "0",
                       str(trainers), str(steps), ps_out] +
-               (["--sparse"] if sparse else []))
+               (["--sparse"] if sparse else []) +
+               (["--geo"] if geo else []))
     deadline = time.time() + 90
     while not os.path.exists(ps_out):
         if ps.poll() is not None:
@@ -66,7 +68,8 @@ def run_cluster(trainers, steps, tmpdir, sparse=False, timeout=240):
         trainer_procs.append(spawn(
             f"t{tid}", [sys.executable, WORKLOAD, "trainer", ep, str(tid),
                         str(trainers), str(steps), out] +
-            (["--sparse"] if sparse else [])))
+            (["--sparse"] if sparse else []) +
+            (["--geo"] if geo else [])))
     try:
         for tid, p in enumerate(trainer_procs):
             p.wait(timeout=timeout)
@@ -93,6 +96,20 @@ def test_ps_sync_two_trainers_match_and_converge(tmp_path):
     # compares 1- vs 2-trainer losses within delta)
     np.testing.assert_allclose(l0, l1, rtol=1e-4, atol=1e-5)
     assert l0[-1] < l0[0] * 0.5, l0
+
+
+def test_ps_geo_sgd_converges(tmp_path):
+    """GEO async mode: local training with periodic delta pushes
+    (reference: geo_sgd_transpiler + GeoSgdCommunicator oracle —
+    convergence despite async syncs)."""
+    (losses,) = run_cluster(1, 60, str(tmp_path), geo=True)
+    assert losses[-1] < losses[0] * 0.2, losses
+
+
+def test_ps_geo_sgd_two_trainers(tmp_path):
+    l0, l1 = run_cluster(2, 40, str(tmp_path), geo=True)
+    assert l0[-1] < l0[0] * 0.5, l0
+    assert l1[-1] < l1[0] * 0.5, l1
 
 
 def test_ps_sparse_distributed_embedding(tmp_path):
