@@ -14,6 +14,21 @@ from .base import VarBase, _run_initializer
 __all__ = ["Layer"]
 
 
+class _HookRemoveHelper:
+    """Removable handle for a registered hook (reference:
+    layers.py HookRemoveHelper)."""
+
+    _next_id = 0
+
+    def __init__(self, hooks):
+        self._hooks = hooks
+        self._hook_id = _HookRemoveHelper._next_id
+        _HookRemoveHelper._next_id += 1
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
 class Layer:
     def __init__(self, name_scope=None, dtype=VarDesc.VarType.FP32):
         if name_scope is None:
@@ -27,6 +42,10 @@ class Layer:
         self._sub_layers: "collections.OrderedDict[str, Layer]" = \
             collections.OrderedDict()
         self._buffers: "collections.OrderedDict[str, VarBase]" = \
+            collections.OrderedDict()
+        self._forward_pre_hooks: "collections.OrderedDict[int, object]" = \
+            collections.OrderedDict()
+        self._forward_post_hooks: "collections.OrderedDict[int, object]" = \
             collections.OrderedDict()
 
     def full_name(self):
@@ -139,10 +158,33 @@ class Layer:
             f"'{type(self).__name__}' object has no attribute '{name}'")
 
     def __call__(self, *inputs, **kwargs):
-        return self.forward(*inputs, **kwargs)
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
 
     def forward(self, *inputs, **kwargs):
         raise NotImplementedError
+
+    # ------------------------------------------------------------- hooks
+    def register_forward_pre_hook(self, hook):
+        """hook(layer, inputs) -> None | new inputs (reference
+        layers.py register_forward_pre_hook + HookRemoveHelper)."""
+        helper = _HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[helper._hook_id] = hook
+        return helper
+
+    def register_forward_post_hook(self, hook):
+        """hook(layer, inputs, outputs) -> None | new outputs."""
+        helper = _HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[helper._hook_id] = hook
+        return helper
 
     def clear_gradients(self):
         for p in self.parameters():

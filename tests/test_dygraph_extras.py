@@ -1,0 +1,113 @@
+"""DyGraph extras: layer forward hooks and a GAN-style two-optimizer
+training loop (reference: test_imperative_hook_for_layer.py,
+test_imperative_gan.py — tape isolation across alternating backward
+passes)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.dygraph as dygraph
+
+
+class MLP(dygraph.Layer):
+    def __init__(self, in_dim, hidden, out_dim):
+        super().__init__()
+        self.l1 = dygraph.Linear(in_dim, hidden, act="relu")
+        self.l2 = dygraph.Linear(hidden, out_dim)
+
+    def forward(self, x):
+        return self.l2(self.l1(x))
+
+
+def test_forward_hooks_fire_and_remove():
+    with dygraph.guard():
+        net = MLP(4, 8, 2)
+        calls = {"pre": 0, "post": 0}
+
+        def pre_hook(layer, inputs):
+            calls["pre"] += 1
+            return None
+
+        def post_hook(layer, inputs, outputs):
+            calls["post"] += 1
+            return outputs * 2.0
+
+        h1 = net.register_forward_pre_hook(pre_hook)
+        h2 = net.register_forward_post_hook(post_hook)
+        x = dygraph.to_variable(np.ones((3, 4), np.float32))
+        base = np.asarray(MLP.forward(net, x).numpy())  # bypass hooks
+        out = np.asarray(net(x).numpy())
+        assert calls == {"pre": 1, "post": 1}
+        np.testing.assert_allclose(out, base * 2.0, rtol=1e-6)
+        h1.remove()
+        h2.remove()
+        out2 = np.asarray(net(x).numpy())
+        assert calls == {"pre": 1, "post": 1}  # removed hooks are silent
+        np.testing.assert_allclose(out2, base, rtol=1e-6)
+
+
+def test_forward_pre_hook_can_rewrite_inputs():
+    with dygraph.guard():
+        net = MLP(4, 8, 2)
+        x = dygraph.to_variable(np.ones((2, 4), np.float32))
+        zero = dygraph.to_variable(np.zeros((2, 4), np.float32))
+        base_zero = np.asarray(net(zero).numpy())
+        net.register_forward_pre_hook(lambda layer, inputs: (zero,))
+        np.testing.assert_allclose(np.asarray(net(x).numpy()), base_zero,
+                                   rtol=1e-6)
+
+
+def test_gan_style_alternating_optimizers():
+    """Generator/discriminator with separate optimizers: each backward
+    only touches its own parameters (the reference's imperative GAN
+    oracle)."""
+    rng = np.random.RandomState(0)
+    with dygraph.guard():
+        gen = MLP(2, 16, 2)
+        disc = MLP(2, 16, 1)
+        opt_g = fluid.optimizer.Adam(
+            1e-2, parameter_list=gen.parameters())
+        opt_d = fluid.optimizer.Adam(
+            1e-2, parameter_list=disc.parameters())
+
+        d_losses, g_losses = [], []
+        for step in range(200):
+            real = rng.randn(32, 2).astype("float32") * 0.5 + 2.0
+            noise = rng.randn(32, 2).astype("float32")
+
+            # --- discriminator step
+            fake = gen(dygraph.to_variable(noise))
+            d_real = disc(dygraph.to_variable(real))
+            d_fake = disc(fake.detach())
+            loss_d = fluid.layers.mean(
+                fluid.layers.sigmoid_cross_entropy_with_logits(
+                    d_real, fluid.layers.ones_like(d_real))) + \
+                fluid.layers.mean(
+                    fluid.layers.sigmoid_cross_entropy_with_logits(
+                        d_fake, fluid.layers.zeros_like(d_fake)))
+            loss_d.backward()
+            opt_d.minimize(loss_d)
+            gen.clear_gradients()
+            disc.clear_gradients()
+            d_losses.append(float(loss_d.numpy()))
+
+            # --- generator step
+            fake = gen(dygraph.to_variable(noise))
+            d_out = disc(fake)
+            loss_g = fluid.layers.mean(
+                fluid.layers.sigmoid_cross_entropy_with_logits(
+                    d_out, fluid.layers.ones_like(d_out)))
+            loss_g.backward()
+            opt_g.minimize(loss_g)
+            gen.clear_gradients()
+            disc.clear_gradients()
+            g_losses.append(float(loss_g.numpy()))
+
+        # adversarial training ran: finite losses, and the generator's
+        # output distribution moved toward the real mean
+        assert np.isfinite(d_losses).all() and np.isfinite(g_losses).all()
+        fake = gen(dygraph.to_variable(
+            rng.randn(256, 2).astype("float32"))).numpy()
+        # generator started at mean ~0; after adversarial training it has
+        # moved decisively toward the real cluster at mean 2.0 (GAN
+        # dynamics oscillate, so assert direction not convergence)
+        assert np.mean(fake) > 0.5, np.mean(fake)
