@@ -33,5 +33,41 @@ from .fluid import (Program, Executor, CPUPlace, TPUPlace, CUDAPlace,
                     program_guard, default_main_program,
                     default_startup_program, global_scope, scope_guard,
                     ParamAttr)
+from .fluid.dygraph import (enable_dygraph, disable_dygraph, grad, no_grad,
+                            to_variable)
+from .fluid.framework import in_dygraph_mode as in_dynamic_mode
+
+
+def enable_static():
+    """2.0 naming: leave imperative mode (reference paddle.enable_static)."""
+    disable_dygraph()
+
+
+def disable_static():
+    """2.0 naming: enter imperative mode (reference
+    paddle.disable_static)."""
+    enable_dygraph()
+
+
+def summary(net, input_size=None, dtypes=None):
+    """Parameter summary of a dygraph Layer (reference paddle.summary's
+    role; prints the per-parameter shapes and the total count)."""
+    import builtins
+    rows = []
+    total = 0
+    for name, p in net.named_parameters():
+        n = 1
+        for s in p.shape:
+            n *= int(s)
+        total += n
+        rows.append((name, tuple(p.shape), n))
+    # builtins.max: `from .tensor import *` above shadows max with the
+    # tensor reduction at module scope
+    width = builtins.max((len(r[0]) for r in rows), default=10) + 2
+    print(f"{'Param':<{width}}{'Shape':<20}{'Count':>12}")
+    for name, shape, n in rows:
+        print(f"{name:<{width}}{str(shape):<20}{n:>12}")
+    print(f"{'Total params:':<{width + 20}}{total:>12}")
+    return {"total_params": total, "trainable_params": total}
 
 __all__ = ["fluid", "ops", "inference", "__version__"]

@@ -52,6 +52,90 @@ from .dataset import DatasetFactory, InMemoryDataset
 Tensor = LoDTensor
 
 
+def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """Deprecated no-op (reference: legacy memory_optimization_transpiler,
+    already deprecated in v1.6+). XLA buffer assignment plans memory for
+    the whole jitted step, so there is nothing to rewrite."""
+    import warnings
+    warnings.warn("fluid.memory_optimize is deprecated and a no-op on this "
+                  "build: XLA plans memory inside the compiled step",
+                  DeprecationWarning, stacklevel=2)
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """Deprecated no-op — see memory_optimize."""
+    import warnings
+    warnings.warn("fluid.release_memory is deprecated and a no-op on this "
+                  "build", DeprecationWarning, stacklevel=2)
+
+
+def require_version(min_version, max_version=None):
+    """Abort unless the installed version falls in [min, max] (reference:
+    fluid/framework.py require_version)."""
+    from .. import version as _v
+
+    def parse(s):
+        parts = str(s).replace("+", ".").split(".")
+        nums = []
+        for p in parts[:3]:
+            nums.append(int(p) if p.isdigit() else 0)
+        return tuple(nums + [0] * (3 - len(nums)))
+    if not isinstance(min_version, str) or (
+            max_version is not None and not isinstance(max_version, str)):
+        raise TypeError("version arguments must be strings like '1.7.0'")
+    cur = parse(_v.full_version)
+    if cur < parse(min_version):
+        raise Exception(
+            f"installed version {_v.full_version} < required {min_version}")
+    if max_version is not None and cur > parse(max_version):
+        raise Exception(
+            f"installed version {_v.full_version} > allowed {max_version}")
+
+
+def load_op_library(lib_filename):
+    """Reference loads a custom-op .so into the registry. Custom ops on
+    this build are Python kernels registered via
+    paddle_tpu.ops.registry.register_op — point users there."""
+    raise NotImplementedError(
+        "C++ custom-op libraries don't apply to the TPU build; register a "
+        "JAX kernel with paddle_tpu.ops.registry.register_op instead")
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    """v1.7 unified one_hot (no trailing-1 dim required — one_hot_v2)."""
+    from .layer_helper import LayerHelper
+    helper = LayerHelper("one_hot_v2")
+    out = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.FP32)
+    out.shape = tuple(input.shape) + (depth,)
+    helper.append_op(type="one_hot_v2", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"depth": depth,
+                            "allow_out_of_range": allow_out_of_range})
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """v1.7 unified embedding (ids without trailing-1 dim —
+    lookup_table_v2)."""
+    from .layer_helper import LayerHelper
+    helper = LayerHelper("embedding", **locals())
+    w = helper.create_parameter(attr=helper.param_attr, shape=size,
+                                dtype=dtype, is_bias=False)
+    out = helper.create_variable_for_type_inference(dtype)
+    pad = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    out.shape = tuple(input.shape) + (size[1],)
+    helper.append_op(type="lookup_table_v2",
+                     inputs={"W": [w], "Ids": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"padding_idx": pad, "is_sparse": is_sparse,
+                            "is_distributed": is_distributed})
+    return out
+
+
 def set_flags(d):
     core.set_flags(d)
 
@@ -75,4 +159,7 @@ __all__ = [
     "in_dygraph_mode", "cpu_places", "cuda_places", "tpu_places",
     "transpiler", "DistributeTranspiler", "DistributeTranspilerConfig",
     "Communicator", "dataset", "DatasetFactory", "InMemoryDataset",
+    "memory_optimize", "release_memory", "require_version",
+    "load_op_library", "one_hot", "embedding", "FetchHandler",
+    "nets", "average", "install_check",
 ]

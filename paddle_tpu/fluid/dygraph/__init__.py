@@ -2,7 +2,8 @@
 Eager op execution on jax arrays with an autograd tape; traces into jax.jit
 via TracedLayer/declarative. Implementation in base.py/layers.py/nn.py."""
 from . import base
-from .base import guard, to_variable, enabled, no_grad, grad
+from .base import (guard, to_variable, enabled, no_grad, grad,
+                   enable_dygraph, disable_dygraph)
 from .layers import Layer
 from . import nn
 from .nn import *  # noqa: F401,F403

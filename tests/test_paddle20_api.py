@@ -222,3 +222,64 @@ def test_distribution_namespace():
         n = paddle.distribution.Normal(loc=0.0, scale=1.0)
         s = n.sample([100])
         assert np.asarray(s.numpy()).shape[0] == 100
+
+
+def test_legacy_and_20_shims(capsys):
+    """fluid.memory_optimize/require_version/one_hot/embedding + 2.0-style
+    paddle.enable_static/disable_static/in_dynamic_mode/summary."""
+    import warnings
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        fluid.memory_optimize(None)
+        fluid.release_memory(None)
+    assert len(w) == 2 and all(issubclass(x.category, DeprecationWarning)
+                               for x in w)
+
+    fluid.require_version("1.0.0")
+    fluid.require_version("1.0.0", "99.0")
+    with pytest.raises(Exception):
+        fluid.require_version("99.0.0")
+    with pytest.raises(TypeError):
+        fluid.require_version(1)
+    with pytest.raises(NotImplementedError):
+        fluid.load_op_library("libfoo.so")
+
+    # v1.7 unified one_hot/embedding: ids WITHOUT trailing-1 dim
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids20", shape=[5], dtype="int64")
+        oh = fluid.one_hot(ids, depth=7)
+        emb = fluid.embedding(ids, size=[7, 3])
+    exe = fluid.Executor()
+    scope = core.Scope()
+    idv = np.array([[0, 2, 6, 1, 3]], "int64")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        o, e = exe.run(main, feed={"ids20": idv},
+                       fetch_list=[oh.name, emb.name])
+    assert np.asarray(o).shape == (1, 5, 7)
+    np.testing.assert_allclose(np.asarray(o).sum(-1), np.ones((1, 5)))
+    assert np.asarray(e).shape == (1, 5, 3)
+
+    # 2.0 mode toggles
+    assert not paddle.in_dynamic_mode()
+    paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+    x = paddle.to_variable(np.ones((2, 2), np.float32))
+    assert float(x.numpy().sum()) == 4.0
+    paddle.enable_static()
+    assert not paddle.in_dynamic_mode()
+
+    # summary over a dygraph layer
+    import paddle_tpu.fluid.dygraph as dygraph
+    with dygraph.guard():
+        net = dygraph.Linear(4, 2)
+        info = paddle.summary(net)
+    out = capsys.readouterr().out
+    assert "Total params" in out
+    assert info["total_params"] == 4 * 2 + 2
