@@ -1,0 +1,57 @@
+"""Multi-process launcher test (reference: launch.py sets PADDLE_TRAINER_*
+env per spawned worker and watches them — multi_process test pattern)."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import json, os, sys
+out = sys.argv[1]
+rec = {k: os.environ.get(k) for k in (
+    "PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+    "PADDLE_TRAINER_ENDPOINTS", "PADDLE_CURRENT_ENDPOINT")}
+with open(os.path.join(out, "r%s.json" % rec["PADDLE_TRAINER_ID"]), "w") as f:
+    json.dump(rec, f)
+"""
+
+
+def test_launch_spawns_workers_with_env(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    # drop the TPU-plugin sitecustomize from PYTHONPATH: the launcher
+    # process itself must import without touching the device tunnel
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS",)}
+    env["PYTHONPATH"] = REPO
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc=2", "--start_port=7701", str(script), str(tmp_path)],
+        env=env, capture_output=True, timeout=120)
+    assert res.returncode == 0, res.stderr.decode()[-2000:]
+    recs = []
+    for r in range(2):
+        p = tmp_path / f"r{r}.json"
+        assert p.exists(), (r, res.stderr.decode()[-2000:])
+        recs.append(json.load(open(p)))
+    assert [r["PADDLE_TRAINER_ID"] for r in recs] == ["0", "1"]
+    assert all(r["PADDLE_TRAINERS_NUM"] == "2" for r in recs)
+    eps = recs[0]["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    assert len(eps) == 2
+    assert recs[0]["PADDLE_CURRENT_ENDPOINT"] == eps[0]
+    assert recs[1]["PADDLE_CURRENT_ENDPOINT"] == eps[1]
+
+
+def test_launch_propagates_worker_failure(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys; sys.exit(3)\n")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS",)}
+    env["PYTHONPATH"] = REPO
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc=2", "--start_port=7711", str(bad)],
+        env=env, capture_output=True, timeout=120)
+    assert res.returncode != 0
