@@ -9,3 +9,4 @@ from . import ptb_lm  # noqa: F401
 from . import se_resnext  # noqa: F401
 from . import mnist  # noqa: F401
 from . import wide_deep  # noqa: F401
+from . import book_extra  # noqa: F401

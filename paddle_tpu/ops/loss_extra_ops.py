@@ -277,13 +277,11 @@ def _linear_chain_crf(ins, attrs):
     start_w, end_w = transition[0], transition[1]
     trans = transition[2:]             # [K, K] from->to
     em_p, lens, _ = _pad_seqs(emission, offs, fill=0.0)   # [N, Tm, K]
-    lab_np = np.asarray(label).reshape(-1)
     N, Tm = em_p.shape[0], em_p.shape[1]
-    lab_p = np.zeros((N, Tm), np.int32)
-    for i in range(N):
-        L = offs[i + 1] - offs[i]
-        lab_p[i, :L] = lab_np[offs[i]:offs[i + 1]]
-    lab_p = jnp.asarray(lab_p)
+    # label padding must stay traceable: the values may be jit tracers
+    # (only the LoD offsets are host-static)
+    lab_pad, _, _ = _pad_seqs(label.reshape(-1, 1), offs, fill=0)
+    lab_p = lab_pad[..., 0].astype(jnp.int32)
 
     # log partition via forward recursion
     alpha0 = start_w[None, :] + em_p[:, 0]
@@ -316,38 +314,50 @@ def _linear_chain_crf(ins, attrs):
 
 @register_op("crf_decoding", needs_lod=True, no_grad=True)
 def _crf_decoding(ins, attrs):
-    """Viterbi decode (reference crf_decoding_op.cc)."""
+    """Viterbi decode (reference crf_decoding_op.cc). Traceable: padded
+    batch viterbi via lax.scan with backpointers; LoD offsets are static,
+    emission values may be jit tracers."""
     emission = first(ins, "Emission")
     transition = first(ins, "Transition")
     label = first(ins, "Label")
     offs = _offs(attrs, "Emission")
     start_w, end_w = transition[0], transition[1]
-    trans = np.asarray(transition[2:])
-    em = np.asarray(emission)
-    sw, ew = np.asarray(start_w), np.asarray(end_w)
-    paths = []
-    for i in range(len(offs) - 1):
-        e = em[offs[i]:offs[i + 1]]
-        T = len(e)
-        K = e.shape[1]
-        delta = sw + e[0]
-        back = np.zeros((T, K), np.int32)
-        for t in range(1, T):
-            cand = delta[:, None] + trans
-            back[t] = cand.argmax(0)
-            delta = cand.max(0) + e[t]
-        delta = delta + ew
-        path = np.zeros(T, np.int64)
-        path[-1] = delta.argmax()
-        for t in range(T - 1, 0, -1):
-            path[t - 1] = back[t, path[t]]
-        paths.append(path)
-    viterbi = np.concatenate(paths).reshape(-1, 1) if paths else \
-        np.zeros((0, 1), np.int64)
-    o = jnp.asarray(viterbi)
+    trans = transition[2:]                               # [K, K]
+    em_p, lens, _ = _pad_seqs(emission, offs, fill=0.0)  # [N, Tm, K]
+    N, Tm = em_p.shape[0], em_p.shape[1]
+    lens_np = np.asarray(offs[1:] - offs[:-1])
+
+    if Tm == 0 or N == 0:
+        o = jnp.zeros((0, 1), jnp.int64)
+    else:
+        score0 = start_w[None, :] + em_p[:, 0]
+
+        def step(score, t):
+            cand = score[:, :, None] + trans[None]       # [N, from, to]
+            bp = jnp.argmax(cand, axis=1).astype(jnp.int32)
+            nxt = jnp.max(cand, axis=1) + em_p[:, t]
+            active = (t < lens)[:, None]
+            return jnp.where(active, nxt, score), bp
+
+        score, bps = jax.lax.scan(step, score0, jnp.arange(1, Tm))
+        # bps[t-1]: backpointer INTO position t-1 from tags at position t
+        last_tag = jnp.argmax(score + end_w[None], -1).astype(jnp.int32)
+        rows = jnp.arange(N)
+        tags = [None] * Tm
+        cur = last_tag
+        for t in range(Tm - 1, -1, -1):
+            # (re)anchor each sequence's backtrace at its own end position
+            cur = jnp.where(jnp.asarray(lens_np - 1 == t), last_tag, cur)
+            tags[t] = cur
+            if t > 0:
+                cur = bps[t - 1][rows, cur]
+        tags = jnp.stack(tags, axis=1)                   # [N, Tm]
+        # unpad with static offsets
+        o = jnp.concatenate(
+            [tags[i, :int(lens_np[i])] for i in range(N)]
+        ).reshape(-1, 1).astype(jnp.int64)
     if label is not None:
-        lab = np.asarray(label).reshape(-1, 1)
-        o = jnp.asarray((viterbi == lab).astype(np.int64))
+        o = (o == label.reshape(-1, 1)).astype(jnp.int64)
     lod = (attrs.get("_lod") or {}).get("Emission")[0]
     return {"ViterbiPath": [o], "_lod": {"ViterbiPath": [lod]}}
 

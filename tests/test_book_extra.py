@@ -1,0 +1,159 @@
+"""Remaining book-model family (reference: tests/book/test_fit_a_line.py,
+test_image_classification.py, notest_understand_sentiment.py,
+test_recommender_system.py, test_label_semantic_roles.py — convergence
+oracles on the dataset readers)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.models import book_extra
+
+
+def _batch(reader, n):
+    buf = []
+    for s in reader():
+        buf.append(s)
+        if len(buf) == n:
+            yield buf
+            buf = []
+
+
+def test_fit_a_line_converges():
+    main, startup, feeds, loss = book_extra.build_fit_a_line(lr=0.02)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _epoch in range(8):
+            for batch in _batch(paddle.dataset.uci_housing.train(), 64):
+                x = np.stack([b[0] for b in batch])
+                y = np.stack([b[1] for b in batch])
+                (lv,) = exe.run(main, feed={"x": x, "y": y},
+                                fetch_list=[loss.name])
+                losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+
+def test_vgg_cifar_trains():
+    main, startup, feeds, loss, acc = book_extra.build_vgg_cifar(
+        image_size=32, lr=2e-3)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rdr = paddle.dataset.cifar.train10()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i, batch in enumerate(_batch(rdr, 32)):
+            if i == 20:
+                break
+            img = np.stack([b[0] for b in batch]).reshape(-1, 3, 32, 32)
+            lab = np.array([[b[1]] for b in batch], "int64")
+            lv, av = exe.run(main, feed={"img": img, "label": lab},
+                             fetch_list=[loss.name, acc.name])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
+def test_vgg16_builds():
+    main, startup, feeds, loss, acc = book_extra.build_vgg_cifar(
+        image_size=32, depth="16")
+    convs = [op for op in main.global_block().ops if op.type == "conv2d"]
+    assert len(convs) == 13  # VGG16: 13 conv layers
+
+
+def test_sentiment_conv_net_converges():
+    wd = paddle.dataset.imdb.word_dict()
+    main, startup, feeds, loss, acc = book_extra.build_sentiment_program(
+        len(wd), lr=5e-2)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    losses, accs = [], []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _epoch in range(2):
+            for i, batch in enumerate(_batch(
+                    paddle.dataset.imdb.train(wd), 32)):
+                if i == 25:
+                    break
+                flat = np.concatenate(
+                    [np.asarray(b[0], "int64") for b in batch])
+                offs = np.cumsum([0] + [len(b[0]) for b in batch]).tolist()
+                words = core.LoDTensor(flat.reshape(-1, 1), lod=[offs])
+                lab = np.array([[b[1]] for b in batch], "int64")
+                lv, av = exe.run(main, feed={"words": words, "label": lab},
+                                 fetch_list=[loss.name, acc.name])
+                losses.append(float(np.asarray(lv).ravel()[0]))
+                accs.append(float(np.asarray(av).ravel()[0]))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]), losses
+    assert np.mean(accs[-10:]) > 0.6, np.mean(accs[-10:])
+
+
+def test_recommender_system_converges():
+    ml = paddle.dataset.movielens
+    main, startup, feeds, loss = book_extra.build_recommender_program(
+        ml.max_user_id(), ml.max_movie_id())
+    exe = fluid.Executor()
+    scope = core.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i, batch in enumerate(_batch(ml.train(), 64)):
+            if i == 40:
+                break
+            feed = {
+                "user_id": np.array([[b[0]] for b in batch], "int64"),
+                "gender_id": np.array([[b[1]] for b in batch], "int64"),
+                "age_id": np.array([[b[2]] for b in batch], "int64"),
+                "job_id": np.array([[b[3]] for b in batch], "int64"),
+                "movie_id": np.array([[b[4]] for b in batch], "int64"),
+                "score": np.array([[b[7]] for b in batch], "float32"),
+            }
+            for key, idx in (("category_id", 5), ("movie_title", 6)):
+                flat = np.concatenate(
+                    [np.asarray(b[idx], "int64") for b in batch])
+                offs = np.cumsum([0] + [len(b[idx]) for b in batch]).tolist()
+                feed[key] = core.LoDTensor(flat.reshape(-1, 1), lod=[offs])
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    assert np.mean(losses[-8:]) < np.mean(losses[:8]), losses
+
+
+def test_srl_crf_trains_and_decodes():
+    """CRF tagging: NLL falls and viterbi decoding recovers the pattern on
+    a synthetic id→tag task."""
+    V, T = 30, 5
+    main, startup, feeds, loss, decode = book_extra.build_srl_crf_program(
+        V, T, lr=5e-2)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+
+    def make_batch(n=16):
+        lens = rng.randint(3, 9, n)
+        words = np.concatenate([rng.randint(0, V, L) for L in lens])
+        tags = words % T  # deterministic tag rule
+        offs = np.cumsum([0] + list(lens)).tolist()
+        return (core.LoDTensor(words.reshape(-1, 1).astype("int64"),
+                               lod=[offs]),
+                core.LoDTensor(tags.reshape(-1, 1).astype("int64"),
+                               lod=[offs]))
+
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i in range(60):
+            w, t = make_batch()
+            (lv,) = exe.run(main, feed={"word": w, "target": t},
+                            fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+        w, t = make_batch(8)
+        (path,) = exe.run(main, feed={"word": w, "target": t},
+                          fetch_list=[decode.name], return_numpy=False)
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    got = np.asarray(path.array).reshape(-1)
+    want = np.asarray(t.array).reshape(-1)
+    assert (got == want).mean() > 0.8, (got[:20], want[:20])
