@@ -22,10 +22,19 @@ from .io import *              # noqa: F401,F403
 from . import sequence_lod as _seq_mod
 from .sequence_lod import *    # noqa: F401,F403
 from . import collective as _coll_mod
+from . import collective  # noqa: F401
+# the reference exports these underscore helpers at layers scope
+# (layers/collective.py __all__ lists them, so * picks them up there)
+from .collective import (_allreduce, _broadcast, _c_allreduce,  # noqa: F401
+                         _c_broadcast, _c_allgather,  # noqa: F401
+                         _c_reducescatter, _c_sync_calc_stream,  # noqa: F401
+                         _c_sync_comm_stream)  # noqa: F401
 from . import detection as _det_mod
 from .detection import *       # noqa: F401,F403
 from . import rnn as _rnn_mod
 from .rnn import *             # noqa: F401,F403
 from . import distributions  # noqa: F401
+from .distributions import (Uniform, Normal, Categorical,  # noqa: F401
+                            MultivariateNormalDiag)  # noqa: F401
 
 from .tensor import math_op  # noqa: F401

@@ -7,7 +7,8 @@ from ..core import VarDesc, convert_np_dtype_to_dtype_
 from ..framework import default_main_program, default_startup_program
 from ..layer_helper import LayerHelper
 
-__all__ = ["data", "read_file", "double_buffer"]
+__all__ = ["data", "read_file", "double_buffer", "py_reader",
+           "create_py_reader_by_data", "load"]
 
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
@@ -23,8 +24,53 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
 
 
 def read_file(reader):
-    raise NotImplementedError("read_file: use DataLoader feeds")
+    """Consume one batch from a py_reader handle (reference layers/io.py
+    read_file over the read op). The PyReader loader yields feed dicts;
+    in-graph consumption maps to the declared data vars."""
+    from ..reader import PyReader
+    if isinstance(reader, PyReader):
+        return list(reader._feed_list)
+    raise NotImplementedError("read_file: pass the py_reader handle, or "
+                              "feed batches through DataLoader")
 
 
 def double_buffer(reader, place=None, name=None):
     return reader
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Legacy in-graph reader (reference layers/io.py py_reader →
+    create_py_reader + LoDTensorBlockingQueue). Returns a PyReader whose
+    decorate_* methods accept the python-side generators; the executor
+    consumes its batches as feeds — the TPU build's double buffering is
+    the loader's background prefetch thread."""
+    from ..reader import PyReader
+    names = [(name or "py_reader") + f"_{i}" for i in range(len(shapes))]
+    lod_levels = lod_levels or [0] * len(shapes)
+    feed_vars = [data(n, shape=list(s), append_batch_size=False, dtype=d,
+                      lod_level=l)
+                 for n, s, d, l in zip(names, shapes, dtypes, lod_levels)]
+    return PyReader(feed_list=feed_vars, capacity=capacity,
+                    use_double_buffer=use_double_buffer, iterable=True)
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Append a load op restoring ``out`` from a saved tensor file
+    (reference layers/io.py load → load_op.cc)."""
+    helper = LayerHelper("load")
+    attrs = {"file_path": file_path}
+    if load_as_fp16 is not None:
+        attrs["load_as_fp16"] = bool(load_as_fp16)
+    helper.append_op(type="load", inputs={}, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """reference layers/io.py create_py_reader_by_data — py_reader over
+    existing data vars."""
+    from ..reader import PyReader
+    return PyReader(feed_list=list(feed_list), capacity=capacity,
+                    use_double_buffer=use_double_buffer, iterable=True)

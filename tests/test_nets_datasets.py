@@ -202,3 +202,70 @@ def test_install_check_runs(capsys):
 
 def test_version():
     assert paddle.version.full_version.startswith("1.7")
+
+
+def test_py_reader_feeds_training():
+    """Legacy py_reader surface: decorate a generator, iterate batches into
+    exe.run (reference layers/io.py py_reader contract)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.py_reader(
+            capacity=4, shapes=[(-1, 4), (-1, 1)],
+            dtypes=["float32", "float32"], name="pyr")
+        x, y = fluid.layers.read_file(reader)
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    w = rng.rand(4, 1).astype("float32")
+
+    def gen():
+        r = np.random.RandomState(1)
+        for _ in range(20):
+            xb = r.rand(16, 4).astype("float32")
+            yield xb, xb @ w
+
+    reader.decorate_batch_generator(gen)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for batch in reader():
+            (lv,) = exe.run(main, feed=batch, fetch_list=[loss.name])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    assert len(losses) == 20
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_layers_load_restores_saved_tensor(tmp_path):
+    """save op -> layers.load round trip (reference save_op/load_op wire
+    format)."""
+    val = np.arange(12, dtype="float32").reshape(3, 4)
+    sp = str(tmp_path / "w.pdtensor")
+
+    save_prog = fluid.Program()
+    with fluid.program_guard(save_prog, fluid.Program()):
+        blk = save_prog.global_block()
+        v = blk.create_var(name="w_save", shape=[3, 4], dtype="float32",
+                           persistable=True)
+        blk.append_op(type="save", inputs={"X": ["w_save"]}, outputs={},
+                      attrs={"file_path": sp})
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        scope.var("w_save").set_value(core.LoDTensor(val))
+        exe.run(save_prog)
+
+    load_prog = fluid.Program()
+    with fluid.program_guard(load_prog, fluid.Program()):
+        blk = load_prog.global_block()
+        out = blk.create_var(name="w_load", shape=[3, 4], dtype="float32",
+                             persistable=True)
+        fluid.layers.load(out, sp)
+    scope2 = core.Scope()
+    with fluid.scope_guard(scope2):
+        exe.run(load_prog)
+        got = np.asarray(scope2.find_var("w_load").get_tensor().array)
+    np.testing.assert_array_equal(got, val)
