@@ -193,3 +193,73 @@ argsort = _L.argsort
 topk = _L.topk
 index_select = getattr(_L, "index_select", None)
 index_sample = getattr(_L, "index_sample", None)
+
+
+# --- 2.0 conveniences over the op set ------------------------------------
+def rand(shape, dtype="float32", name=None):
+    return _L.uniform_random(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype="float32", name=None):
+    return _L.gaussian_random(shape, mean=0.0, std=1.0, dtype=dtype)
+
+
+def clamp(x, min=None, max=None, name=None):
+    lo = float("-1e38") if min is None else float(min)
+    hi = float("1e38") if max is None else float(max)
+    return _L.clip(x, lo, hi)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return _build_op("fill_any_like", {"X": [x]},
+                     {"value": float(fill_value)}, dtype=dtype)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    sm = _L.softmax(x, axis=axis)
+    return _L.log(sm)
+
+
+def addcmul(input, tensor1, tensor2, value=1.0, name=None):
+    return _L.elementwise_add(
+        input, _L.scale(_L.elementwise_mul(tensor1, tensor2),
+                        scale=float(value)))
+
+
+def t(x, name=None):
+    nd = len(x.shape)
+    if nd > 2:
+        raise ValueError("paddle.t only transposes 0/1/2-D tensors")
+    if nd < 2:
+        return x
+    return _L.transpose(x, [1, 0])
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    mu = _L.reduce_mean(x, dim=axis, keep_dim=True)
+    sq = _L.square(_L.elementwise_sub(x, mu))
+    out = _L.reduce_mean(sq, dim=axis, keep_dim=keepdim)
+    if unbiased:
+        # reduced-element count at runtime (batch dims are dynamic):
+        # n = numel(x) / numel(mean_keepdim)
+        n = _L.elementwise_div(
+            _L.cast(_L.reshape(_L.size(x), [1]), x.dtype),
+            _L.cast(_L.reshape(_L.size(mu), [1]), x.dtype))
+        factor = _L.elementwise_div(
+            n, _L.elementwise_sub(n, _L.fill_constant([1], x.dtype, 1.0)))
+        out = _L.elementwise_mul(out, factor)
+    return out
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _L.sqrt(var(x, axis, unbiased, keepdim))
+
+
+def numel(x, name=None):
+    return _L.size(x)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _build_op("allclose", {"Input": [x], "Other": [y]},
+                     {"rtol": float(rtol), "atol": float(atol),
+                      "equal_nan": bool(equal_nan)}, dtype="bool")

@@ -283,3 +283,54 @@ def test_legacy_and_20_shims(capsys):
     out = capsys.readouterr().out
     assert "Total params" in out
     assert info["total_params"] == 4 * 2 + 2
+
+
+def test_tensor_20_extras_numeric():
+    """paddle.{clamp,full_like,log_softmax,t,var,std,numel,addcmul,
+    allclose,rand,randn} (reference 2.0 tensor API tests)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    main, st = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, st):
+        x = fluid.data("x20", shape=[3, 4], dtype="float32")
+        y2 = fluid.data("y20", shape=[4], dtype="float32")
+        outs = dict(
+            clamp=paddle.clamp(x, 0.2, 0.8),
+            fl=paddle.full_like(x, 7.0),
+            ls=paddle.log_softmax(x),
+            tt=paddle.t(y2),
+            v=paddle.var(x), s=paddle.std(x), n=paddle.numel(x),
+            v1=paddle.var(x, axis=1),
+            ac=paddle.addcmul(x, x, x, value=0.5),
+            alc=paddle.allclose(x, x),
+            rn=paddle.randn([2, 2]), rd=paddle.rand([2, 2]))
+    exe = fluid.Executor()
+    scope = core.Scope()
+    xv = np.random.RandomState(0).rand(2, 3, 4).astype("float32")
+    yv = np.random.RandomState(1).rand(2, 4).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(st)
+        names = {k: v.name for k, v in outs.items()}
+        res = exe.run(main, feed={"x20": xv, "y20": yv},
+                      fetch_list=list(names.values()))
+    res = dict(zip(names, [np.asarray(r) for r in res]))
+    np.testing.assert_allclose(res["clamp"], np.clip(xv, 0.2, 0.8),
+                               rtol=1e-6)
+    np.testing.assert_allclose(res["fl"], np.full_like(xv, 7.0))
+    e = np.exp(xv - xv.max(-1, keepdims=True))
+    np.testing.assert_allclose(res["ls"], np.log(e / e.sum(-1,
+                                                           keepdims=True)),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(res["tt"], yv.T, rtol=1e-6)
+    np.testing.assert_allclose(res["v"].ravel()[0], xv.var(ddof=1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(res["s"].ravel()[0], xv.std(ddof=1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(res["v1"], xv.var(1, ddof=1), rtol=1e-5)
+    assert int(res["n"].ravel()[0]) == xv.size
+    np.testing.assert_allclose(res["ac"], xv + 0.5 * xv * xv, rtol=1e-6)
+    assert bool(res["alc"].ravel()[0])
+    assert res["rn"].shape == (2, 2) and res["rd"].shape == (2, 2)
