@@ -48,9 +48,79 @@ grid_sampler = _L.grid_sampler
 pixel_shuffle = _L.pixel_shuffle
 interpolate = getattr(_L, "image_resize", None)
 
+# ---- activation / loss Layer classes (reference paddle/nn: thin class
+# wrappers over the functional forms)
+class ReLU(Layer):
+    def forward(self, x):
+        return _L.relu(x)
+
+
+class Sigmoid(Layer):
+    def forward(self, x):
+        return _L.sigmoid(x)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return _L.softmax(x, axis=self._axis)
+
+
+class _Loss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        if reduction not in ("mean", "sum", "none"):
+            raise ValueError("reduction must be mean|sum|none")
+        self.reduction = reduction
+
+    def _reduce(self, loss):
+        if self.reduction == "mean":
+            return _L.reduce_mean(loss)
+        if self.reduction == "sum":
+            return _L.reduce_sum(loss)
+        return loss
+
+
+class CrossEntropyLoss(_Loss):
+    """softmax + cross-entropy over logits (reference
+    nn.CrossEntropyLoss)."""
+
+    def forward(self, input, label):
+        return self._reduce(
+            _L.softmax_with_cross_entropy(input, label))
+
+
+class MSELoss(_Loss):
+    def forward(self, input, label):
+        return self._reduce(_L.square_error_cost(input, label))
+
+
+class L1Loss(_Loss):
+    def forward(self, input, label):
+        from . import functional as F
+        return F.l1_loss(input, label, reduction=self.reduction)
+
+
+class NLLLoss(_Loss):
+    def forward(self, input, label):
+        from . import functional as F
+        return F.nll_loss(input, label, reduction=self.reduction)
+
+
+class BCELoss(_Loss):
+    def forward(self, input, label):
+        from . import functional as F
+        return F.bce_loss(input, label, reduction=self.reduction)
+
+
 __all__ = [
     "Layer", "Sequential", "LayerList", "ParameterList", "Conv2D", "Conv3D",
     "Pool2D", "Linear", "BatchNorm", "Dropout", "Embedding", "LayerNorm",
     "GRUUnit", "InstanceNorm", "PRelu", "BilinearTensorProduct",
     "Conv2DTranspose", "GroupNorm", "SpectralNorm", "functional",
+    "ReLU", "Sigmoid", "Softmax", "CrossEntropyLoss", "MSELoss", "L1Loss",
+    "NLLLoss", "BCELoss",
 ]

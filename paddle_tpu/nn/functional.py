@@ -117,3 +117,13 @@ ctc_loss = _L.warpctc
 npair_loss = _L.npair_loss
 square_error_cost = _L.square_error_cost
 log_loss = _L.log_loss
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ..fluid import layers as _L
+    return _L.log(_L.softmax(x, axis=axis))
+
+
+def pool2d(x, **kw):
+    from ..fluid import layers as _L
+    return _L.pool2d(x, **kw)

@@ -334,3 +334,46 @@ def test_tensor_20_extras_numeric():
     np.testing.assert_allclose(res["ac"], xv + 0.5 * xv * xv, rtol=1e-6)
     assert bool(res["alc"].ravel()[0])
     assert res["rn"].shape == (2, 2) and res["rd"].shape == (2, 2)
+
+
+def test_nn_loss_and_activation_classes():
+    """paddle.nn class wrappers (reference paddle/nn layer classes)."""
+    import numpy as np
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.fluid.dygraph as dygraph
+
+    rng = np.random.RandomState(0)
+    with dygraph.guard():
+        x = dygraph.to_variable(rng.randn(4, 5).astype("float32"))
+        lab = dygraph.to_variable(
+            rng.randint(0, 5, (4, 1)).astype("int64"))
+        ce = nn.CrossEntropyLoss()(x, lab)
+        e = np.exp(np.asarray(x.numpy())
+                   - np.asarray(x.numpy()).max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        want = -np.log(sm[np.arange(4),
+                          np.asarray(lab.numpy()).ravel()]).mean()
+        np.testing.assert_allclose(
+            np.asarray(ce.numpy()).ravel()[0], want, rtol=1e-5)
+
+        r = nn.ReLU()(x)
+        assert float(np.asarray(r.numpy()).min()) >= 0.0
+        s = nn.Softmax()(x)
+        np.testing.assert_allclose(np.asarray(s.numpy()).sum(-1),
+                                   np.ones(4), rtol=1e-5)
+        mse = nn.MSELoss()(x, x)
+        assert abs(float(np.asarray(mse.numpy()).ravel()[0])) < 1e-7
+        ls = F.log_softmax(x)
+        np.testing.assert_allclose(np.asarray(ls.numpy()), np.log(sm),
+                                   rtol=1e-4, atol=1e-5)
+        probs = dygraph.to_variable(
+            rng.rand(4, 1).astype("float32") * 0.8 + 0.1)
+        tgt = dygraph.to_variable(
+            rng.randint(0, 2, (4, 1)).astype("float32"))
+        bce = nn.BCELoss()(probs, tgt)
+        p = np.asarray(probs.numpy())
+        t = np.asarray(tgt.numpy())
+        want_bce = -(t * np.log(p) + (1 - t) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(np.asarray(bce.numpy()).ravel()[0],
+                                   want_bce, rtol=1e-4)
