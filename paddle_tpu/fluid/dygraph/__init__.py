@@ -3,7 +3,7 @@ Eager op execution on jax arrays with an autograd tape; traces into jax.jit
 via TracedLayer/declarative. Implementation in base.py/layers.py/nn.py."""
 from . import base
 from .base import (guard, to_variable, enabled, no_grad, grad,
-                   enable_dygraph, disable_dygraph)
+                   enable_dygraph, disable_dygraph, BackwardStrategy)
 from .layers import Layer
 from . import nn
 from .nn import *  # noqa: F401,F403

@@ -26,7 +26,18 @@ from ..core import VarDesc, convert_np_dtype_to_dtype_, dtype_to_jnp
 from ...ops.registry import OPS, run_generic_grad, GRAD_SUFFIX
 
 __all__ = ["guard", "to_variable", "enabled", "no_grad", "grad", "VarBase",
-           "Tracer", "enable_dygraph", "disable_dygraph"]
+           "Tracer", "enable_dygraph", "disable_dygraph",
+           "BackwardStrategy"]
+
+
+class BackwardStrategy:
+    """reference: pybind imperative.cc BackwardStrategy — sort_sum_gradient
+    forces deterministic gradient accumulation order. The tape here sums
+    fan-in in recorded order, which is already deterministic, so the knob
+    is accepted and recorded only."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False
 
 
 class VarBase:
