@@ -15,6 +15,10 @@ from . import imdb  # noqa: F401
 from . import movielens  # noqa: F401
 from . import wmt16  # noqa: F401
 from . import flowers  # noqa: F401
+from . import conll05  # noqa: F401
+from . import sentiment  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import voc2012  # noqa: F401
 
 __all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "movielens",
-           "wmt16", "flowers"]
+           "wmt16", "flowers", "conll05", "sentiment", "wmt14", "voc2012"]

@@ -1,0 +1,50 @@
+"""CoNLL-2005 SRL readers (reference: python/paddle/dataset/conll05.py —
+get_dict() returning (word, verb, label) dicts and a test() reader of
+8-slot samples: word_ids, ctx_n2/n1/0/p1/p2 ids, mark_ids, label_ids)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["get_dict", "get_embedding", "test", "SYNTHETIC"]
+
+SYNTHETIC = True
+
+_WORDS = 1200
+_VERBS = 60
+_LABELS = 30  # BIO-style tag inventory size
+
+
+def get_dict():
+    word_dict = {("w%d" % i): i for i in range(_WORDS)}
+    verb_dict = {("v%d" % i): i for i in range(_VERBS)}
+    label_dict = {("L%d" % i): i for i in range(_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Deterministic stand-in for the pretrained emb32 table."""
+    return np.random.RandomState(77).rand(_WORDS, 32).astype("float32")
+
+
+def _synthetic(n, seed):
+    def reader2():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            L = int(r.randint(4, 20))
+            words = r.randint(0, _WORDS, L)
+            verb_pos = int(r.randint(0, L))
+            mark = np.zeros(L, np.int64)
+            mark[verb_pos] = 1
+            labels = (words + np.abs(np.arange(L) - verb_pos)) % _LABELS
+            ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2 = (
+                np.roll(words, 2), np.roll(words, 1), words,
+                np.roll(words, -1), np.roll(words, -2))
+            yield (list(map(int, words)), list(map(int, ctx_n2)),
+                   list(map(int, ctx_n1)), list(map(int, ctx_0)),
+                   list(map(int, ctx_p1)), list(map(int, ctx_p2)),
+                   list(map(int, mark)), list(map(int, labels)))
+    return reader2
+
+
+def test():
+    return _synthetic(400, seed=0)

@@ -1,0 +1,42 @@
+"""NLTK movie-reviews sentiment readers (reference:
+python/paddle/dataset/sentiment.py — get_word_dict(), train/test readers of
+(word_id_list, 0/1)). Shares the synthetic corpus shape with imdb but a
+smaller vocabulary, like the original."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["get_word_dict", "train", "test", "SYNTHETIC"]
+
+SYNTHETIC = True
+
+_VOCAB = 2000
+_POS = list(range(5, 45))
+_NEG = list(range(45, 85))
+
+
+def get_word_dict():
+    return {("w%d" % i): i for i in range(_VOCAB)}
+
+
+def _synthetic(n, seed):
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(r.randint(0, 2))
+            length = int(r.randint(5, 60))
+            base = r.randint(0, _VOCAB, size=length)
+            marker = r.choice(_POS if label == 0 else _NEG,
+                              size=max(2, length // 5))
+            ids = np.concatenate([base, marker])
+            r.shuffle(ids)
+            yield (list(map(int, ids)), label)
+    return reader
+
+
+def train():
+    return _synthetic(1600, seed=0)
+
+
+def test():
+    return _synthetic(400, seed=1)

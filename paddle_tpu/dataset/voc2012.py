@@ -1,0 +1,40 @@
+"""PASCAL VOC2012 segmentation readers (reference:
+python/paddle/dataset/voc2012.py — samples (img[3,H,W] float32, seg
+label[H,W] int32 with 21 classes))."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "val", "SYNTHETIC"]
+
+SYNTHETIC = True
+
+_CLASSES = 21
+_SIZE = 64  # synthetic stand-in keeps test memory small
+
+
+def _synthetic(n, seed):
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            # blocky class regions + color correlated with class
+            coarse = r.randint(0, _CLASSES, (4, 4))
+            seg = np.kron(coarse, np.ones((_SIZE // 4, _SIZE // 4),
+                                          np.int32))
+            img = np.stack([(seg * 37 % 255), (seg * 91 % 255),
+                            (seg * 53 % 255)]).astype("float32") / 255.0
+            img = img + 0.05 * r.randn(3, _SIZE, _SIZE).astype("float32")
+            yield (np.clip(img, 0, 1), seg.astype("int32"))
+    return reader
+
+
+def train():
+    return _synthetic(256, seed=0)
+
+
+def test():
+    return _synthetic(64, seed=1)
+
+
+def val():
+    return _synthetic(64, seed=2)

@@ -1,0 +1,27 @@
+"""WMT14 fr-en readers (reference: python/paddle/dataset/wmt14.py — samples
+(src_ids, trg_ids, trg_ids_next) with <s>=0 <e>=1 <unk>=2). Same synthetic
+mapping machinery as wmt16 with the wmt14 sample ordering."""
+from __future__ import annotations
+
+from . import wmt16 as _w16
+
+__all__ = ["train", "test", "SYNTHETIC"]
+
+SYNTHETIC = True
+
+
+def _reorder(reader):
+    # wmt16 yields (src, trg_next, trg_in); wmt14's contract is
+    # (src, trg, trg_next) where trg includes <s> and trg_next shifts
+    def r():
+        for src, trg_next, trg_in in reader():
+            yield (src, trg_in, trg_next)
+    return r
+
+
+def train(dict_size=2000):
+    return _reorder(_w16.train(dict_size, dict_size))
+
+
+def test(dict_size=2000):
+    return _reorder(_w16.test(dict_size, dict_size))

@@ -269,3 +269,27 @@ def test_layers_load_restores_saved_tensor(tmp_path):
         exe.run(load_prog)
         got = np.asarray(scope2.find_var("w_load").get_tensor().array)
     np.testing.assert_array_equal(got, val)
+
+
+def test_dataset_conll05_sentiment_wmt14_voc2012():
+    wd, vd, ld = paddle.dataset.conll05.get_dict()
+    assert len(wd) > 1000 and len(ld) == 30
+    s = next(iter(paddle.dataset.conll05.test()()))
+    assert len(s) == 8  # word, 5 ctx windows, mark, labels
+    assert len(s[0]) == len(s[6]) == len(s[7])
+    assert sum(s[6]) == 1  # exactly one predicate mark
+    emb = paddle.dataset.conll05.get_embedding()
+    assert emb.shape[1] == 32
+
+    sw = paddle.dataset.sentiment.get_word_dict()
+    samp = next(iter(paddle.dataset.sentiment.train()()))
+    assert isinstance(samp[0], list) and samp[1] in (0, 1)
+    assert max(samp[0]) < len(sw)
+
+    src, trg, trg_next = next(iter(paddle.dataset.wmt14.train(2000)()))
+    assert trg[0] == 0 and trg_next[-1] == 1  # <s> prefix / <e> suffix
+    assert trg[1:] == trg_next[:-1]
+
+    img, seg = next(iter(paddle.dataset.voc2012.train()()))
+    assert img.shape[0] == 3 and img.shape[1:] == seg.shape
+    assert 0 <= seg.min() and seg.max() < 21
