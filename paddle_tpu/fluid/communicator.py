@@ -3,31 +3,113 @@
 operators/distributed/communicator.h — AsyncCommunicator:237 merge queues,
 HalfAsyncCommunicator:299, GeoCommunicator:383).
 
-TPU framing: in this build the async PS plane applies updates server-side
-on arrival (ops/distributed_ops.py listen_and_serv), so per-grad client
-merge queues collapse to an optional batching thread. The API surface
-(start/stop/is_running) is kept for fleet parity; SYNC mode needs no
-communicator at all (send/recv ops carry the traffic in-program)."""
+TPU framing: the pserver applies updates on arrival
+(ops/distributed_ops.py listen_and_serv async loop), so correctness never
+needs client-side queues — but the reference's merge behavior matters for
+RPC load: with a running Communicator, async-mode send ops enqueue grads
+here instead of issuing one RPC each; per-var merge threads sum up to
+``max_merge_var_num`` pending grads and ship one merged send (the
+AsyncCommunicator contract). SYNC mode needs no communicator at all."""
 from __future__ import annotations
 
+import queue
 import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
 
 __all__ = ["Communicator", "LargeScaleKV"]
 
 
 class Communicator:
+    _global: Optional["Communicator"] = None
+
     def __init__(self, program=None, mode=None, kwargs=None, envs=None):
         self._running = False
         self._program = program
+        envs = envs or {}
+        self._max_merge = int(envs.get("communicator_max_merge_var_num", 20))
+        self._wait_times = float(
+            envs.get("communicator_send_wait_times", 0.005))
+        self._queues: Dict[Tuple[str, str], "queue.Queue"] = {}
+        self._threads: list = []
+        self._lock = threading.Lock()
 
+    # ---------------------------------------------------------- lifecycle
     def start(self):
         self._running = True
+        Communicator._global = self
 
     def stop(self):
         self._running = False
+        if Communicator._global is self:
+            Communicator._global = None
+        # flush whatever is still queued
+        for key in list(self._queues):
+            self._drain(key)
 
     def is_running(self):
         return self._running
+
+    @classmethod
+    def global_instance(cls) -> Optional["Communicator"]:
+        c = cls._global
+        return c if c is not None and c._running else None
+
+    # ------------------------------------------------------------- queues
+    def push(self, name: str, value, endpoint: str, trainer_id: int = 0):
+        """Called by the async send op: enqueue one gradient; a per-var
+        daemon merges and sends (reference AsyncCommunicator::Send)."""
+        key = (name, endpoint)
+        with self._lock:
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = queue.Queue()
+                t = threading.Thread(target=self._merge_loop,
+                                     args=(key, trainer_id), daemon=True)
+                t.start()
+                self._threads.append(t)
+        q.put(np.asarray(value))
+
+    def _drain(self, key, trainer_id=0):
+        name, ep = key
+        q = self._queues.get(key)
+        if q is None:
+            return
+        merged = None
+        n = 0
+        while n < self._max_merge:
+            try:
+                v = q.get_nowait()
+            except queue.Empty:
+                break
+            merged = v if merged is None else merged + v
+            n += 1
+        if merged is not None:
+            from .ps_rpc import VarClient
+            VarClient.of(ep).send_var(name, merged, trainer_id=trainer_id)
+
+    def _merge_loop(self, key, trainer_id):
+        name, ep = key
+        q = self._queues[key]
+        while self._running:
+            try:
+                first = q.get(timeout=self._wait_times * 10)
+            except queue.Empty:
+                continue
+            merged = np.asarray(first)
+            n = 1
+            # short grace window lets a burst of pending grads coalesce
+            deadline = threading.Event()
+            deadline.wait(self._wait_times)
+            while n < self._max_merge:
+                try:
+                    merged = merged + q.get_nowait()
+                    n += 1
+                except queue.Empty:
+                    break
+            from .ps_rpc import VarClient
+            VarClient.of(ep).send_var(name, merged, trainer_id=trainer_id)
 
     def recv(self):
         pass

@@ -43,10 +43,12 @@ def _np_of(scope, name):
 @register_op("send", stateful=True, no_grad=True,
              attr_defaults={"epmap": [], "trainer_id": 0})
 def _send(ins, attrs):
+    from ..fluid.communicator import Communicator
     ctx = attrs["_ctx"]
     names = ctx.op.input("X")
     epmap = attrs.get("epmap") or []
     tid = int(attrs.get("trainer_id", 0))
+    comm = Communicator.global_instance()
     for i, name in enumerate(names):
         ep = epmap[i if i < len(epmap) else -1]
         val = _np_of(ctx.scope, name)
@@ -54,6 +56,10 @@ def _send(ins, attrs):
             _client(ep).send_var(name, np.asarray(val.get_tensor().array),
                                  trainer_id=tid, rows=val.rows(),
                                  height=val.height())
+        elif comm is not None:
+            # async mode with a running Communicator: enqueue for the
+            # merge thread (reference AsyncCommunicator::Send)
+            comm.push(name, val, ep, trainer_id=tid)
         else:
             _client(ep).send_var(name, val, trainer_id=tid)
     return {}

@@ -151,3 +151,47 @@ def test_heartbeat_monitor_detects_dead_worker():
         mon.stop()
         srv.shutdown()
         VarClient.reset_pool()
+
+
+def test_async_communicator_merges_sends():
+    """A running Communicator batches queued grads: N pushes arrive at the
+    server as fewer, summed sends (reference AsyncCommunicator merge
+    contract, communicator.h:237)."""
+    from paddle_tpu.fluid.communicator import Communicator
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+
+    got = []
+    lock = __import__("threading").Lock()
+
+    def h_send_var(name, value, trainer_id=0, rows=None, height=0):
+        with lock:
+            got.append((name, np.asarray(value)))
+        return True
+
+    srv = VarServer(f"127.0.0.1:{free_port()}",
+                    {"send_var": h_send_var}).start()
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        comm = Communicator(envs={"communicator_max_merge_var_num": 50,
+                                  "communicator_send_wait_times": 0.05})
+        comm.start()
+        assert Communicator.global_instance() is comm
+        for i in range(20):
+            comm.push("w@GRAD", np.full((4,), 1.0, np.float32), ep)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with lock:
+                total = sum(v.sum() for _, v in got)
+            if total >= 20 * 4:
+                break
+            time.sleep(0.05)
+        comm.stop()
+        assert Communicator.global_instance() is None
+        with lock:
+            total = sum(float(v.sum()) for _, v in got)
+            n_rpcs = len(got)
+        assert total == 20 * 4.0, total          # nothing lost
+        assert n_rpcs < 20, n_rpcs               # merging happened
+    finally:
+        srv.shutdown()
+        VarClient.reset_pool()
