@@ -111,3 +111,35 @@ def test_gan_style_alternating_optimizers():
         # moved decisively toward the real cluster at mean 2.0 (GAN
         # dynamics oscillate, so assert direction not convergence)
         assert np.mean(fake) > 0.5, np.mean(fake)
+
+
+def test_reinforce_policy_gradient():
+    """REINFORCE on a contextual bandit: -log pi(a|s) * advantage backward
+    through softmax (reference test_imperative_reinforcement.py shape)."""
+    rng = np.random.RandomState(0)
+    with dygraph.guard():
+        policy = MLP(3, 16, 2)
+        opt = fluid.optimizer.Adam(5e-2,
+                                   parameter_list=policy.parameters())
+        avg_rewards = []
+        for step in range(80):
+            state = rng.randn(64, 3).astype("float32")
+            logits = policy(dygraph.to_variable(state))
+            probs = np.asarray(fluid.layers.softmax(logits).numpy())
+            actions = (rng.rand(64) < probs[:, 1]).astype("int64")
+            # reward: action 1 is right when state[0] > 0
+            reward = np.where((state[:, 0] > 0) == (actions == 1),
+                              1.0, 0.0).astype("float32")
+            advantage = reward - reward.mean()
+            logp = fluid.layers.softmax_with_cross_entropy(
+                logits, dygraph.to_variable(actions.reshape(-1, 1)))
+            loss = fluid.layers.mean(
+                logp * dygraph.to_variable(
+                    advantage.reshape(-1, 1)))
+            loss.backward()
+            opt.minimize(loss)
+            policy.clear_gradients()
+            avg_rewards.append(float(reward.mean()))
+        # the policy learns the context rule well above the 0.5 baseline
+        assert np.mean(avg_rewards[-10:]) > 0.75, \
+            np.mean(avg_rewards[-10:])
