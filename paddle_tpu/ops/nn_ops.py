@@ -495,11 +495,14 @@ def _conv2d(ins, attrs):
     from ..fluid import core as _core
     orig_dtype = x.dtype
     if _core.globals_["FLAGS_use_bf16_matmul"] and x.dtype == jnp.float32:
+        # bf16 in AND out: a mixed-dtype conv (preferred_element_type=f32)
+        # has no transpose rule in this jax version, which breaks the
+        # generic vjp grad path; the MXU still accumulates in f32
+        # internally, the output just rounds to bf16 once
         x, w = x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
     o = lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pads, rhs_dilation=dil,
-        dimension_numbers=dn, feature_group_count=attrs.get("groups", 1),
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        dimension_numbers=dn, feature_group_count=attrs.get("groups", 1))
     o = o.astype(orig_dtype)
     b = first(ins, "Bias")
     if b is not None:

@@ -118,3 +118,40 @@ def test_collective_transpiler_grad_allreduce():
     assert "scale" in types
     assert "c_comm_init_all" in [op.type for op in
                                  startup.global_block().ops]
+
+
+def test_bf16_matmul_flag_conv_training():
+    """FLAGS_use_bf16_matmul must keep conv/matmul grads working (the
+    mixed-dtype conv transpose has no vjp rule, so the kernel computes in
+    bf16 end-to-end and casts back)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    core.set_flag("FLAGS_use_bf16_matmul", True)
+    try:
+        main, st = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, st), fluid.unique_name.guard():
+            img = fluid.data("img", shape=[3, 8, 8], dtype="float32")
+            lab = fluid.data("lab", shape=[1], dtype="int64")
+            c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                    act="relu")
+            p = fluid.layers.fc(c, 10, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(p, lab))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor()
+        sc = core.Scope()
+        rng = np.random.RandomState(0)
+        losses = []
+        with fluid.scope_guard(sc):
+            exe.run(st)
+            for _ in range(10):
+                x = rng.rand(8, 3, 8, 8).astype("float32")
+                y = (x.mean((1, 2, 3)) * 10).astype("int64").reshape(-1, 1) % 10
+                (lv,) = exe.run(main, feed={"img": x, "lab": y},
+                                fetch_list=[loss.name])
+                losses.append(float(np.asarray(lv).ravel()[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+    finally:
+        core.set_flag("FLAGS_use_bf16_matmul", False)
