@@ -143,3 +143,48 @@ def test_reinforce_policy_gradient():
         # the policy learns the context rule well above the 0.5 baseline
         assert np.mean(avg_rewards[-10:]) > 0.75, \
             np.mean(avg_rewards[-10:])
+
+
+def test_gcn_node_classification():
+    """Two-layer GCN on a tiny graph (reference test_imperative_gnn.py):
+    matmul with a normalized adjacency + gather-style supervision."""
+    rng = np.random.RandomState(0)
+    N, F, C = 12, 6, 3
+    # two clusters + ring edges; labels = cluster id pattern
+    adj = np.eye(N, dtype="float32")
+    for i in range(N):
+        adj[i, (i + 1) % N] = adj[(i + 1) % N, i] = 1.0
+    deg = adj.sum(1, keepdims=True)
+    adj_n = (adj / np.sqrt(deg) / np.sqrt(deg.T)).astype("float32")
+    feats = rng.randn(N, F).astype("float32")
+    labels = (np.arange(N) * C // N).astype("int64").reshape(-1, 1)
+    feats[:, 0] = labels[:, 0] * 2.0  # learnable signal
+
+    with dygraph.guard():
+        w1 = dygraph.to_variable(
+            (rng.randn(F, 16) * 0.3).astype("float32"))
+        w1.stop_gradient = False
+        w1.trainable = True
+        w2 = dygraph.to_variable(
+            (rng.randn(16, C) * 0.3).astype("float32"))
+        w2.stop_gradient = False
+        w2.trainable = True
+        a = dygraph.to_variable(adj_n)
+        x = dygraph.to_variable(feats)
+        y = dygraph.to_variable(labels)
+        opt = fluid.optimizer.Adam(5e-2, parameter_list=[w1, w2])
+        losses = []
+        for _ in range(60):
+            h = fluid.layers.relu(
+                fluid.layers.matmul(fluid.layers.matmul(a, x), w1))
+            logits = fluid.layers.matmul(fluid.layers.matmul(a, h), w2)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            loss.backward()
+            opt.minimize(loss)
+            for p in (w1, w2):
+                p.clear_gradient()
+            losses.append(float(np.asarray(loss.numpy()).ravel()[0]))
+        pred = np.asarray(logits.numpy()).argmax(-1)
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+    assert (pred == labels[:, 0]).mean() > 0.8
