@@ -540,6 +540,20 @@ class Executor:
         feed = feed or {}
         fetch_names = _to_fetch_names(fetch_list)
 
+        if use_prune and fetch_names:
+            # backward-slice to the fetch targets (reference executor.py
+            # _prune_program + prune cache keyed like the run cache). Note
+            # the reference caveat applies: pruning a training program by
+            # its loss drops the optimizer ops.
+            pkey = (id(program), program._version, tuple(fetch_names))
+            cache = getattr(self, "_prune_cache", None)
+            if cache is None:
+                cache = self._prune_cache = {}
+            pruned = cache.get(pkey)
+            if pruned is None:
+                pruned = cache[pkey] = program._prune(list(fetch_names))
+            program = pruned
+
         # materialize program vars' metadata for persistables (create slots)
         # feeds → device
         feed_arrays = {}

@@ -213,3 +213,40 @@ def test_save_load_persistables(tmp_path):
         fluid.load_persistables(exe, str(tmp_path), main)
         l2, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
     np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_use_prune_skips_untargeted_branches():
+    """exe.run(use_prune=True) backward-slices to the fetch targets: a side
+    branch writing a counter var must not execute (reference executor.py
+    prune semantics)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    main, st = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, st), fluid.unique_name.guard():
+        x = fluid.data("x", shape=[4], dtype="float32")
+        kept = fluid.layers.scale(x, scale=2.0)
+        # side branch: increments a persistable counter when executed
+        blk = main.global_block()
+        cnt = blk.create_var(name="side_counter", shape=[1],
+                             dtype="float32", persistable=True)
+        blk.append_op(type="increment", inputs={"X": [cnt.name]},
+                      outputs={"Out": [cnt.name]}, attrs={"step": 1.0})
+    exe = fluid.Executor()
+    scope = core.Scope()
+    xv = np.ones((2, 4), np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(st)
+        scope.var("side_counter").set_value(
+            core.LoDTensor(np.zeros(1, np.float32)))
+        (o,) = exe.run(main, feed={"x": xv}, fetch_list=[kept.name],
+                       use_prune=True)
+        after_pruned = float(np.asarray(
+            scope.find_var("side_counter").get_tensor().array)[0])
+        exe.run(main, feed={"x": xv}, fetch_list=[kept.name])
+        after_full = float(np.asarray(
+            scope.find_var("side_counter").get_tensor().array)[0])
+    np.testing.assert_allclose(np.asarray(o), xv * 2.0)
+    assert after_pruned == 0.0, "pruned run must skip the side branch"
+    assert after_full == 1.0, "full run executes the side branch"
