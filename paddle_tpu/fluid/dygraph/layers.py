@@ -136,6 +136,27 @@ class Layer:
         self._buffers[name] = tensor
         return tensor
 
+    def buffers(self, include_sublayers=True):
+        return [b for _n, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, buf in self._buffers.items():
+            if buf is not None:
+                yield (prefix + ("." if prefix else "") + name, buf)
+        if include_sublayers:
+            for lname, sub in self._sub_layers.items():
+                sp = prefix + ("." if prefix else "") + lname
+                yield from sub.named_buffers(sp, include_sublayers)
+
+    def apply(self, fn):
+        """Apply ``fn`` to self and every sublayer (reference layers.py
+        Layer.apply — init helpers)."""
+        for sub in self.sublayers():
+            sub.apply(fn)
+        fn(self)
+        return self
+
     # ------------------------------------------------------------- magic
     def __setattr__(self, name, value):
         params = self.__dict__.get("_parameters")
