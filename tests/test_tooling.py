@@ -275,3 +275,20 @@ def test_multiprocess_dataloader_matches_inline():
         assert set(a) == set(b)
         for k in a:
             np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_api_signatures_tool():
+    """tools/api_signatures.py dumps the public surface without import
+    failures (reference print_signatures.py for API-diff checking)."""
+    import subprocess, sys, os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "api_signatures.py"),
+         "--module", "paddle_tpu.fluid.layers"],
+        capture_output=True, text=True, timeout=180,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert res.returncode == 0, res.stderr[-1500:]
+    lines = res.stdout.strip().splitlines()
+    assert len(lines) > 150
+    assert not any("import failed" in l for l in lines)
+    assert any(l.startswith("paddle_tpu.fluid.layers.fc(") for l in lines)

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Dump the public API surface with signatures (reference:
+tools/print_signatures.py feeding the API-diff checkers). One line per
+symbol, sorted, so two dumps diff cleanly across versions:
+
+    python tools/api_signatures.py > /tmp/api.txt
+    python tools/api_signatures.py --module paddle_tpu.fluid.layers
+"""
+from __future__ import annotations
+
+import argparse
+import inspect
+import sys
+
+
+DEFAULT_MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.fluid",
+    "paddle_tpu.fluid.layers",
+    "paddle_tpu.fluid.optimizer",
+    "paddle_tpu.fluid.dygraph",
+    "paddle_tpu.fluid.io",
+    "paddle_tpu.fluid.nets",
+    "paddle_tpu.nn",
+    "paddle_tpu.nn.functional",
+    "paddle_tpu.tensor",
+    "paddle_tpu.dataset",
+    "paddle_tpu.reader",
+    "paddle_tpu.distribution",
+    "paddle_tpu.inference",
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def dump(module_name, out):
+    import importlib
+    try:
+        mod = importlib.import_module(module_name)
+    except Exception as e:  # surface but keep dumping the rest
+        print(f"{module_name}  <import failed: {type(e).__name__}>",
+              file=out)
+        return
+    names = getattr(mod, "__all__", None) or [
+        n for n in dir(mod) if not n.startswith("_")]
+    for name in sorted(set(names)):
+        obj = getattr(mod, name, None)
+        if obj is None:
+            continue
+        if inspect.isclass(obj):
+            print(f"{module_name}.{name}{_sig(obj.__init__)}  [class]",
+                  file=out)
+            for m_name, m in sorted(vars(obj).items()):
+                if m_name.startswith("_") or not callable(m):
+                    continue
+                print(f"{module_name}.{name}.{m_name}{_sig(m)}", file=out)
+        elif callable(obj):
+            print(f"{module_name}.{name}{_sig(obj)}", file=out)
+        elif not inspect.ismodule(obj):
+            print(f"{module_name}.{name}  [value]", file=out)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--module", action="append", default=None)
+    args = p.parse_args()
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    for m in (args.module or DEFAULT_MODULES):
+        dump(m, sys.stdout)
+
+
+if __name__ == "__main__":
+    main()
