@@ -9,8 +9,17 @@ __all__ = ["set_device", "get_device", "is_compiled_with_cuda",
 
 from .fluid.core import TPUPlace, CPUPlace
 
-_current = "tpu" if core.is_compiled_with_tpu() else "cpu"
+# Resolved lazily on first use: probing the backend at import time would
+# make `import paddle_tpu` hang/die whenever the TPU tunnel is broken.
+_current = None
 _current_idx = 0
+
+
+def _default_device() -> str:
+    global _current
+    if _current is None:
+        _current = "tpu" if core.is_compiled_with_tpu() else "cpu"
+    return _current
 
 
 def set_device(device: str):
@@ -30,7 +39,8 @@ def set_device(device: str):
 
 
 def get_device() -> str:
-    return _current + (f":{_current_idx}" if _current != "cpu" else "")
+    cur = _default_device()
+    return cur + (f":{_current_idx}" if cur != "cpu" else "")
 
 
 def is_compiled_with_cuda() -> bool:

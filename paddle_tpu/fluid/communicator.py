@@ -44,9 +44,23 @@ class Communicator:
         self._running = False
         if Communicator._global is self:
             Communicator._global = None
-        # flush whatever is still queued
-        for key in list(self._queues):
-            self._drain(key)
+        for t in self._threads:
+            t.join(timeout=1.0)
+        # flush whatever is still queued — fully, not just one merge batch.
+        # Snapshot under the lock and bound the loop so a misbehaving
+        # producer still pushing during stop() can't spin this forever.
+        with self._lock:
+            snapshot = dict(self._queues)
+        for key, q in snapshot.items():
+            flushes = 0
+            while not q.empty() and flushes < 1000:
+                self._drain(key)
+                flushes += 1
+        with self._lock:
+            # drop queues so a later start()/push() spawns fresh merge
+            # threads (the old ones exited when _running went False)
+            self._queues.clear()
+            self._threads.clear()
 
     def is_running(self):
         return self._running

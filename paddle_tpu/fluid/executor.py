@@ -96,9 +96,17 @@ class _FetchHandlerMonitor:
         self._thread.start()
 
     def stop(self):
+        # stop the periodic loop and join BEFORE the final synchronous
+        # sample, so the user handler is never invoked concurrently with
+        # (or after) it
+        self._stop_evt.set()
+        if self._thread.is_alive():
+            # unbounded: the loop exits as soon as any in-flight handler
+            # call returns (the event is already set), and joining fully is
+            # what guarantees no concurrent handler invocation below
+            self._thread.join()
         # final synchronous sample so short runs still see one callback
         self._handler.handler(self._sample())
-        self._stop_evt.set()
 
 
 import contextlib
@@ -545,10 +553,11 @@ class Executor:
             # _prune_program + prune cache keyed like the run cache). Note
             # the reference caveat applies: pruning a training program by
             # its loss drops the optimizer ops.
-            pkey = (id(program), program._version, tuple(fetch_names))
-            cache = getattr(self, "_prune_cache", None)
-            if cache is None:
-                cache = self._prune_cache = {}
+            # cache lives ON the program object (not keyed by id()), so it
+            # dies with the program and a recycled id can never serve a
+            # stale pruned copy
+            pkey = (program._version, tuple(fetch_names))
+            cache = program.__dict__.setdefault("_prune_cache", {})
             pruned = cache.get(pkey)
             if pruned is None:
                 pruned = cache[pkey] = program._prune(list(fetch_names))

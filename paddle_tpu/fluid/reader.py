@@ -114,7 +114,22 @@ class _GeneratorLoader:
         batch_fn = self._batch_fn
 
         def producer():
-            segs = []
+            import signal
+            pending = []  # names created but whose meta hasn't been sent
+
+            def _cleanup_pending(*_):
+                # terminate() while blocked in meta_q.put: the consumer
+                # will never see these names, unlink them ourselves
+                for shm_name in pending:
+                    try:
+                        s = shared_memory.SharedMemory(name=shm_name)
+                        s.close()
+                        s.unlink()
+                    except FileNotFoundError:
+                        pass
+                raise SystemExit(0)
+
+            signal.signal(signal.SIGTERM, _cleanup_pending)
             try:
                 for item in batch_fn():
                     meta = {}
@@ -124,18 +139,38 @@ class _GeneratorLoader:
                                                          size=max(1, a.nbytes))
                         shm.buf[:a.nbytes] = a.tobytes()
                         meta[name] = (shm.name, a.shape, a.dtype.str)
-                        segs.append(shm)
+                        pending.append(shm.name)
                         shm.close()
                     meta_q.put(("batch", meta))
+                    pending.clear()  # consumer owns them now
                 meta_q.put(("done", None))
             except Exception as e:  # surface the generator's error
                 meta_q.put(("error", repr(e)))
 
         proc = ctx.Process(target=producer, daemon=True)
         proc.start()
+
+        def _unlink_meta(meta):
+            for shm_name, _, _ in meta.values():
+                try:
+                    s = shared_memory.SharedMemory(name=shm_name)
+                    s.close()
+                    s.unlink()
+                except FileNotFoundError:
+                    pass
+
         try:
             while True:
-                kind, meta = meta_q.get()
+                try:
+                    # bounded get + liveness check: a killed child must not
+                    # hang the consumer forever
+                    kind, meta = meta_q.get(timeout=5.0)
+                except queue.Empty:
+                    if not proc.is_alive():
+                        raise RuntimeError(
+                            "multiprocess DataLoader worker died without "
+                            f"posting 'done' (exitcode={proc.exitcode})")
+                    continue
                 if kind == "done":
                     break
                 if kind == "error":
@@ -155,6 +190,16 @@ class _GeneratorLoader:
         finally:
             proc.terminate()
             proc.join(timeout=5.0)
+            # drain the queue unlinking any segments the consumer never
+            # touched (early break / producer error), so /dev/shm doesn't
+            # accumulate leaked blocks
+            while True:
+                try:
+                    kind, meta = meta_q.get_nowait()
+                except queue.Empty:
+                    break
+                if kind == "batch":
+                    _unlink_meta(meta)
 
     def __call__(self):
         return iter(self)

@@ -104,6 +104,16 @@ class DistributeTranspiler:
         block = prog.global_block()
         dense = [p for p, _, _ in self.param_grad_ops
                  if p not in self.sparse_tables]
+        if self.sparse_tables:
+            # the reference GeoSgdCommunicator delta-syncs sparse ids too;
+            # this build's geo_sgd_send covers dense params only — refuse
+            # to silently diverge
+            import warnings
+            warnings.warn(
+                "geo_sgd_mode syncs only dense params in this build; "
+                f"sparse tables {sorted(self.sparse_tables)} will NOT be "
+                "synchronized across trainers — use sync/async mode for "
+                "sparse embeddings", UserWarning)
         block.append_op(
             type="geo_sgd_send", inputs={"Params": dense}, outputs={},
             attrs={"epmap": [self.param_ep[p] for p in dense],

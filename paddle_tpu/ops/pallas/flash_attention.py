@@ -2,20 +2,40 @@
 attention kernel (reference: operators/fused/multihead_matmul_op.cu, which
 does QK^T→softmax→V with cuBLAS batched GEMMs in one op).
 
-TPU design: one pallas_call per (batch·head, q-block): the q block and the
-full K/V for that head live in VMEM; scores tile onto the MXU; softmax is
-computed in fp32 on the VPU. For round-1 the full-S K/V fits VMEM for
-BERT-scale sequences (S≤2048, d≤128 → ≤2·2048·128·4B = 2MB); the blocked
-online-softmax variant (and ring attention over ICI for long context) hangs
-off the same entry point.
+TPU design (FlashAttention-2 style, written for the MXU/VMEM hierarchy):
 
-Backward: flash-style recompute — custom_vjp whose bwd re-derives grads
-from the pure-jax reference attention under XLA (one extra forward, fused).
+Forward: grid (B·H, S/blk_q, S/blk_k) with the K dimension innermost —
+Pallas TPU executes the innermost grid dimension sequentially, so the
+online-softmax state (f32 accumulator, running row-max m, normalizer l)
+lives in VMEM scratch and is carried across K blocks. Only one
+(blk_q × D) Q tile and one (blk_k × D) K/V tile are resident per step, so
+sequence length is NOT bounded by VMEM (the round-1 full-K/V-in-VMEM
+S≤2048 restriction is gone); VMEM per step is ~4·blk·D·4B ≈ 400KB at
+blk=128, D=64. Score tiles hit the MXU via jnp.dot with
+preferred_element_type=f32; softmax runs in f32 on the VPU. The kernel
+also emits the log-sum-exp per row, the residual the backward needs.
+
+Backward: two Pallas kernels (the FlashAttention-2 recipe):
+  dK/dV: grid (B·H, S/blk_k, S/blk_q) accumulating over Q blocks,
+  dQ:    grid (B·H, S/blk_q, S/blk_k) accumulating over K blocks,
+both recomputing P = exp(scale·QKᵀ − lse) tile-by-tile from the stored
+lse — no O(S²) materialization anywhere. delta = rowsum(dO ∘ O) is a
+cheap elementwise+reduce that XLA fuses outside the kernels.
+
+Causal masking is top-left aligned; fully-masked K blocks are skipped
+with pl.when (upper-triangular blocks cost nothing).
+
+CPU/tests: `interpret_mode(True)` (or PADDLE_TPU_FLASH_INTERPRET=1) runs
+the very same kernels through the Pallas interpreter so the suite
+exercises the real kernel, not a fallback. Shapes the kernel doesn't
+support (S not divisible by the block) take the pure-XLA reference path,
+which is differentiable as-is.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
-import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -27,61 +47,39 @@ try:
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
-DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30  # finite mask value: avoids inf-inf → NaN in the rescale
+
+_INTERPRET = os.environ.get("PADDLE_TPU_FLASH_INTERPRET", "") in ("1", "true")
+
+
+def interpret_mode(enable: bool):
+    """Force the Pallas kernels through the interpreter (CPU testing)."""
+    global _INTERPRET
+    _INTERPRET = bool(enable)
+
+
+@contextlib.contextmanager
+def interpret_guard():
+    global _INTERPRET
+    prev = _INTERPRET
+    _INTERPRET = True
+    try:
+        yield
+    finally:
+        _INTERPRET = prev
 
 
 def _ref_attention(q, k, v, sm_scale, causal=False):
     """Pure-jax reference: q,k,v [B,H,S,D]."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
     if causal:
-        S = q.shape[2]
-        mask = jnp.tril(jnp.ones((S, S), bool))
+        S, Sk = q.shape[2], k.shape[2]
+        mask = jnp.arange(S)[:, None] >= jnp.arange(Sk)[None, :]
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
-
-
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, blk_q):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale        # [blk_q, d]
-    k = k_ref[0].astype(jnp.float32)                   # [S, d]
-    v = v_ref[0].astype(jnp.float32)                   # [S, d]
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [blk_q, S]
-    if causal:
-        S = k.shape[0]
-        rows = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32,
-                                                     (blk_q, S), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (blk_q, S), 1)
-        s = jnp.where(rows >= cols, s, -jnp.inf)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    e = jnp.exp(s - m)
-    p = e / jnp.sum(e, axis=-1, keepdims=True)
-    o_ref[0] = jnp.dot(p, v,
-                       preferred_element_type=jnp.float32).astype(o_ref.dtype)
-
-
-def _pallas_attention(q, k, v, sm_scale, causal=False,
-                      blk_q=DEFAULT_BLOCK_Q):
-    B, H, S, D = q.shape
-    blk_q = min(blk_q, S)
-    assert S % blk_q == 0, (S, blk_q)
-    qf = q.reshape(B * H, S, D)
-    kf = k.reshape(B * H, S, D)
-    vf = v.reshape(B * H, S, D)
-    grid = (B * H, S // blk_q)
-    out = pl.pallas_call(
-        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          blk_q=blk_q),
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
-    )(qf, kf, vf)
-    return out.reshape(B, H, S, D)
 
 
 def _on_tpu() -> bool:
@@ -91,23 +89,290 @@ def _on_tpu() -> bool:
         return False
 
 
+def _mask_scores(s, q_start, k_start, blk_q, blk_k):
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, sm_scale, causal, blk_q, blk_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q_start = qi * blk_q
+    k_start = ki * blk_k
+
+    def _block():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [blk_q, blk_k]
+        if causal:
+            s = _mask_scores(s, q_start, k_start, blk_q, blk_k)
+        m_prev = m_ref[:, :1]                             # [blk_q, 1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p, v_ref[0].astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # blocks strictly above the diagonal are fully masked: skip them
+        @pl.when(k_start <= q_start + blk_q - 1)
+        def _():
+            _block()
+    else:
+        _block()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, 0] + jnp.log(l[:, 0])
+
+
+def _pallas_fwd(q, k, v, sm_scale, causal, blk_q, blk_k):
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    qf, kf, vf = (t.reshape(B * H, t.shape[2], D) for t in (q, k, v))
+    grid = (B * H, S // blk_q, Sk // blk_k)
+    kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                             blk_q=blk_q, blk_k=blk_k)
+    o, lse = pl.pallas_call(
+        kern,
+        out_shape=(jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+                   jax.ShapeDtypeStruct((B * H, S), jnp.float32)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=(pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i))),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, D), jnp.float32),
+            pltpu.VMEM((blk_q, 128), jnp.float32),
+            pltpu.VMEM((blk_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_INTERPRET and not _on_tpu(),
+    )(qf, kf, vf)
+    return o.reshape(B, H, S, D), lse.reshape(B, H, S)
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, dk_acc, dv_acc,
+                   *, sm_scale, causal, blk_q, blk_k):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * blk_q
+    k_start = ki * blk_k
+
+    def _block():
+        q = q_ref[0].astype(jnp.float32)
+        kk = k_ref[0].astype(jnp.float32)
+        vv = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]                         # [blk_q, 1]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _mask_scores(s, q_start, k_start, blk_q, blk_k)
+        p = jnp.exp(s - lse)                              # [blk_q, blk_k]
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # pᵀ·dO
+        dp = jax.lax.dot_general(
+            do, vv, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # dO·Vᵀ
+        ds = p * (dp - delta) * sm_scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # dsᵀ·Q
+
+    if causal:
+        @pl.when(k_start <= q_start + blk_q - 1)
+        def _():
+            _block()
+    else:
+        _block()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                  dq_ref, dq_acc, *, sm_scale, causal, blk_q, blk_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * blk_q
+    k_start = ki * blk_k
+
+    def _block():
+        q = q_ref[0].astype(jnp.float32)
+        kk = k_ref[0].astype(jnp.float32)
+        vv = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _mask_scores(s, q_start, k_start, blk_q, blk_k)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, vv, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[...] += jnp.dot(ds, kk, preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(k_start <= q_start + blk_q - 1)
+        def _():
+            _block()
+    else:
+        _block()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _pallas_bwd(q, k, v, o, lse, g, sm_scale, causal, blk_q, blk_k):
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    BH = B * H
+    qf, kf, vf, of, gf = (t.reshape(BH, t.shape[2], D)
+                          for t in (q, k, v, o, g))
+    lsef = lse.reshape(BH, S)
+    delta = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32), -1)
+    interp = _INTERPRET and not _on_tpu()
+    common = dict(sm_scale=sm_scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kv_kernel, **common),
+        out_shape=(jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Sk, D), v.dtype)),
+        grid=(BH, Sk // blk_k, S // blk_q),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0)),   # q
+            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),   # k
+            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),   # v
+            pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0)),   # do
+            pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i)),         # lse
+            pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i)),         # delta
+        ],
+        out_specs=(pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
+                   pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0))),
+        scratch_shapes=[pltpu.VMEM((blk_k, D), jnp.float32),
+                        pltpu.VMEM((blk_k, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interp,
+    )(qf, kf, vf, gf, lsef, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_q_kernel, **common),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        grid=(BH, S // blk_q, Sk // blk_k),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),   # q
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),   # k
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),   # v
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),   # do
+            pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),         # lse
+            pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),         # delta
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interp,
+    )(qf, kf, vf, gf, lsef, delta)
+
+    shape = (B, H, S, D)
+    return dq.reshape(shape), dk.reshape(B, H, Sk, D), dv.reshape(B, H, Sk, D)
+
+
+# --------------------------------------------------------------------------
+# public entry
+# --------------------------------------------------------------------------
+def _block_sizes(S, Sk):
+    blk_q = min(DEFAULT_BLOCK_Q, S)
+    blk_k = min(DEFAULT_BLOCK_K, Sk)
+    return blk_q, blk_k
+
+
+def _pallas_ok(q, k):
+    if not _HAS_PALLAS or not (_on_tpu() or _INTERPRET):
+        return False
+    S, Sk = q.shape[2], k.shape[2]
+    blk_q, blk_k = _block_sizes(S, Sk)
+    return S % blk_q == 0 and Sk % blk_k == 0
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_pallas(q, k, v, sm_scale, causal):
+    blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2])
+    o, _ = _pallas_fwd(q, k, v, sm_scale, causal, blk_q, blk_k)
+    return o
+
+
+def _fp_fwd(q, k, v, sm_scale, causal):
+    blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2])
+    o, lse = _pallas_fwd(q, k, v, sm_scale, causal, blk_q, blk_k)
+    return o, (q, k, v, o, lse)
+
+
+def _fp_bwd(sm_scale, causal, res, g):
+    q, k, v, o, lse = res
+    blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2])
+    return _pallas_bwd(q, k, v, o, lse, g, sm_scale, causal, blk_q, blk_k)
+
+
+_flash_pallas.defvjp(_fp_fwd, _fp_bwd)
+
+
 def flash_attention(q, k, v, sm_scale, causal=False):
-    """q,k,v: [B,H,S,D] → [B,H,S,D]."""
-    if _HAS_PALLAS and _on_tpu():
-        return _pallas_attention(q, k, v, sm_scale, causal)
+    """q,k,v: [B,H,S,D] → [B,H,S,D]. Pallas flash kernel when the backend
+    (or interpret mode) supports it; pure-XLA reference otherwise."""
+    if _pallas_ok(q, k):
+        return _flash_pallas(q, k, v, sm_scale, causal)
     return _ref_attention(q, k, v, sm_scale, causal)
-
-
-def _fa_fwd(q, k, v, sm_scale, causal):
-    return flash_attention(q, k, v, sm_scale, causal), (q, k, v)
-
-
-def _fa_bwd(sm_scale, causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _ref_attention(q, k, v, sm_scale,
-                                                    causal), q, k, v)
-    return vjp(g)
-
-
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
