@@ -533,6 +533,99 @@ class FuseBnActPass(Pass):
         return graph
 
 
+@register_pass("multihead_matmul_fuse_pass_v2")
+class MultiheadMatmulFusePassV2(Pass):
+    """Generic BERT/ERNIE attention subgraph → one ``multihead_matmul`` op
+    (reference: ir/multihead_matmul_fuse_pass.cc:435 MultiHeadMatmulV2FusePass,
+    pattern ops per MultiHeadMatmulPattern at :235).
+
+    Matches the 19-op decomposed attention that a reference-serialized
+    transformer program carries — three mul/elementwise_add/reshape2/
+    transpose2 projection chains, the Q-side scale, QKᵀ matmul, +BiasQK,
+    softmax, PV matmul, and the transpose2+reshape2 head merge — packs
+    Wq/Wk/Wv into the combined [N, 3, H·D] weight and [3, H·D] bias the
+    fused op takes (reference packing: multihead_matmul_fuse_pass.cc:470),
+    and rewrites the whole subgraph to one op, which this framework then
+    dispatches onto the fused XLA attention path. Requires ``param_scope``
+    for the weight packing."""
+
+    _PAT = OpPattern([
+        # Q path (the branch carrying the softmax scale)
+        ("mul", {"X": "$x", "Y": "$wq"}, {"Out": "$q_mm"}),
+        ("elementwise_add", {"X": "$q_mm", "Y": "$bq"}, {"Out": "$q_add"}),
+        ("reshape2", {"X": "$q_add"}, {"Out": "$q_rs"}),
+        ("transpose2", {"X": "$q_rs"}, {"Out": "$q_tr"}),
+        ("scale", {"X": "$q_tr"}, {"Out": "$q_sc"}),
+        # K path
+        ("mul", {"X": "$x", "Y": "$wk"}, {"Out": "$k_mm"}),
+        ("elementwise_add", {"X": "$k_mm", "Y": "$bk"}, {"Out": "$k_add"}),
+        ("reshape2", {"X": "$k_add"}, {"Out": "$k_rs"}),
+        ("transpose2", {"X": "$k_rs"}, {"Out": "$k_tr"}),
+        # V path
+        ("mul", {"X": "$x", "Y": "$wv"}, {"Out": "$v_mm"}),
+        ("elementwise_add", {"X": "$v_mm", "Y": "$bv"}, {"Out": "$v_add"}),
+        ("reshape2", {"X": "$v_add"}, {"Out": "$v_rs"}),
+        ("transpose2", {"X": "$v_rs"}, {"Out": "$v_tr"}),
+        # attention core
+        ("matmul", {"X": "$q_sc", "Y": "$k_tr"}, {"Out": "$qk"}),
+        ("elementwise_add", {"X": "$qk", "Y": "$mask"}, {"Out": "$qk_b"}),
+        ("softmax", {"X": "$qk_b"}, {"Out": "$attn"}),
+        ("matmul", {"X": "$attn", "Y": "$v_tr"}, {"Out": "$ctx"}),
+        ("transpose2", {"X": "$ctx"}, {"Out": "$ctx_tr"}),
+        ("reshape2", {"X": "$ctx_tr"}, {"Out": "$out"}),
+    ])
+
+    def apply_impl(self, graph):
+        scope = self.get("param_scope")
+        if scope is None:
+            return graph  # weight packing needs the parameters
+        for m in self._PAT.match(graph):
+            qk_op, pv_op = m["#13"], m["#16"]
+            if not qk_op.attr("transpose_Y") or pv_op.attr("transpose_Y"):
+                continue
+            scale_op = m["#4"]
+            sb = scale_op.attr("bias")
+            if float(0.0 if sb is None else sb) != 0.0:
+                continue
+            alpha = float(scale_op.attr("scale") or 1.0) \
+                * float(qk_op.attr("alpha") or 1.0)
+            rs_shape = m["#2"].attr("shape") or []
+            if len(rs_shape) != 4:
+                continue
+            head_number = int(rs_shape[2])
+            wq, wk, wv = (_scope_get(scope, m[s])
+                          for s in ("$wq", "$wk", "$wv"))
+            bq, bk, bv = (_scope_get(scope, m[s])
+                          for s in ("$bq", "$bk", "$bv"))
+            if any(a is None for a in (wq, wk, wv, bq, bk, bv)):
+                continue
+            comb_w = np.stack([wq, wk, wv], axis=1)          # [N, 3, H·D]
+            comb_b = np.stack([bq.reshape(-1), bk.reshape(-1),
+                               bv.reshape(-1)], axis=0)      # [3, H·D]
+            w_name = m["$out"] + ".multihead_w"
+            b_name = m["$out"] + ".multihead_bias"
+            graph.block.create_var(name=w_name, shape=list(comb_w.shape),
+                                   dtype="float32", persistable=True)
+            graph.block.create_var(name=b_name, shape=list(comb_b.shape),
+                                   dtype="float32", persistable=True)
+            _scope_set(scope, w_name, comb_w)
+            _scope_set(scope, b_name, comb_b)
+            graph.fuse(m["#ops"], "multihead_matmul",
+                       {"Input": [m["$x"]], "W": [w_name],
+                        "Bias": [b_name], "BiasQK": [m["$mask"]]},
+                       {"Out": [m["$out"]]},
+                       {"alpha": alpha, "head_number": head_number,
+                        "transpose_Q": False, "transpose_K": True,
+                        "transpose_V": False})
+        return graph
+
+
+@register_pass("multihead_matmul_fuse_pass")
+class MultiheadMatmulFusePass(MultiheadMatmulFusePassV2):
+    """v1 name; same semantic subgraph on this framework (reference v1
+    matched an older stack-based emission — ir/multihead_matmul_fuse_pass.cc:46)."""
+
+
 class _ConvBnFoldBase(Pass):
     """Shared weight-folding logic for the conv+bn family. Requires
     ``param_scope`` (reference passes fetch it with
@@ -910,8 +1003,6 @@ for _n, _note in {
     "fc_lstm_fuse_pass": "fusion_lstm op exists; XLA fuses",
     "mul_gru_fuse_pass": "XLA fuses",
     "mul_lstm_fuse_pass": "XLA fuses",
-    "multihead_matmul_fuse_pass": "BERT path emits the fused op directly",
-    "multihead_matmul_fuse_pass_v2": "BERT path emits the fused op directly",
     "quant_conv2d_dequant_fuse_pass": "int8 deploy; out of scope on TPU",
 }.items():
     _register_absorbed(_n, _note)
@@ -925,6 +1016,9 @@ INFERENCE_PASSES = [
     "is_test_pass",
     "simplify_with_basic_ops_pass",
     "delete_quant_dequant_op_pass",
+    # must run before fc_fuse_pass, which would eat the projection
+    # mul+elementwise_add pairs the attention pattern anchors on
+    "multihead_matmul_fuse_pass_v2",
     "conv_affine_channel_fuse_pass",
     "conv_eltwiseadd_bn_fuse_pass",
     "conv_bn_fuse_pass",

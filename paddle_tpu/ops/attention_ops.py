@@ -81,20 +81,34 @@ def _fused_attention_qkv(ins, attrs):
                             "transpose_V": False, "alpha": 1.0,
                             "head_number": 1})
 def _multihead_matmul(ins, attrs):
-    """Reference contract: Input [B,S,3HD] fused with W [3HD? ...] — the
-    v1.7 op takes pre-projected packed QKV Input [B, S, 3, H, D] plus the
-    additive BiasQK mask. Support the packed-QKV form."""
+    """Reference contract (operators/fused/multihead_matmul_op.cc:80 —
+    MultiHeadMatMulV2Op): Input is the RAW hidden [B, S, N] with the
+    packed projection W [N, 3, H·D] and Bias [3, H·D] (the layout
+    multihead_matmul_fuse_pass_v2 packs, ir/multihead_matmul_fuse_pass.cc:470);
+    the op does QKV projection + alpha·QKᵀ + BiasQK + softmax + PV + merge
+    in one fused computation. Pre-projected packed-QKV inputs
+    ([B,S,3,H,D] / [B,S,3HD] without W) are also accepted."""
     x = first(ins, "Input")
+    w = first(ins, "W")
+    b = first(ins, "Bias")
     bias_qk = first(ins, "BiasQK")
     h = attrs.get("head_number", 1)
     alpha = attrs.get("alpha", 1.0)
-    if x.ndim == 5:  # [B, S, 3, H, D]
+    if w is not None and w.ndim >= 3:  # raw hidden + packed projection
+        wm = w.reshape(w.shape[0], 3, -1)            # [N, 3, H·D]
+        qkv = jnp.einsum("bsn,nch->bsch", x, wm)     # [B, S, 3, H·D]
+        if b is not None:
+            qkv = qkv + b.reshape(3, -1)
+        q = _split_heads(qkv[:, :, 0], h)
+        k = _split_heads(qkv[:, :, 1], h)
+        v = _split_heads(qkv[:, :, 2], h)
+    elif x.ndim == 5:  # [B, S, 3, H, D]
         q = jnp.transpose(x[:, :, 0], (0, 2, 1, 3))
         k = jnp.transpose(x[:, :, 1], (0, 2, 1, 3))
         v = jnp.transpose(x[:, :, 2], (0, 2, 1, 3))
     else:  # [B, S, 3·H·D]
-        b, s, hd3 = x.shape
-        x5 = x.reshape(b, s, 3, h, hd3 // (3 * h))
+        bsz, s, hd3 = x.shape
+        x5 = x.reshape(bsz, s, 3, h, hd3 // (3 * h))
         q = jnp.transpose(x5[:, :, 0], (0, 2, 1, 3))
         k = jnp.transpose(x5[:, :, 1], (0, 2, 1, 3))
         v = jnp.transpose(x5[:, :, 2], (0, 2, 1, 3))

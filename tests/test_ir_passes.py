@@ -484,3 +484,71 @@ def test_inference_pipeline_end_to_end():
     assert "batch_norm" not in types and "dropout" not in types
     after = _run(main, scope, {"img": x}, [out.name])[0]
     np.testing.assert_allclose(before, after, rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# multihead attention fusion (reference ir/multihead_matmul_fuse_pass.cc)
+# --------------------------------------------------------------------------
+def _build_raw_attention(H=2, D=4, N=8, S=6):
+    """The decomposed attention subgraph a reference-serialized
+    transformer carries: per-branch mul/elementwise_add/reshape2/
+    transpose2, Q scale, QK^T, +BiasQK, softmax, PV, merge."""
+    x = fluid.data("x", shape=[S, N], dtype="float32")
+    mask = fluid.data("mask", shape=[H, S, S], dtype="float32")
+
+    def proj(tag):
+        p = fluid.layers.fc(x, H * D, num_flatten_dims=2,
+                            param_attr=fluid.ParamAttr(name=tag + "_w"),
+                            bias_attr=fluid.ParamAttr(name=tag + "_b"))
+        r = fluid.layers.reshape(p, [0, 0, H, D])
+        return fluid.layers.transpose(r, [0, 2, 1, 3])
+
+    q, k, v = proj("q"), proj("k"), proj("v")
+    qs = fluid.layers.scale(q, scale=float(1.0 / np.sqrt(D)))
+    qk = fluid.layers.matmul(qs, k, transpose_y=True)
+    qk_b = fluid.layers.elementwise_add(qk, mask)
+    attn = fluid.layers.softmax(qk_b)
+    ctx = fluid.layers.matmul(attn, v)
+    ctx_t = fluid.layers.transpose(ctx, [0, 2, 1, 3])
+    return fluid.layers.reshape(ctx_t, [0, 0, H * D])
+
+
+def test_multihead_matmul_fuse_pass_v2():
+    main, scope, out = _fresh(_build_raw_attention)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(2, 6, 8).astype("float32"),
+            "mask": rng.uniform(-1, 0, (2, 2, 6, 6)).astype("float32")}
+    before = np.asarray(_run(main, scope, feed, [out])[0])
+
+    pm = PassManager(["multihead_matmul_fuse_pass_v2"], scope=scope)
+    fused = pm.apply(main, protected=[out.name])
+    types = _op_types(fused)
+    assert types.count("multihead_matmul") == 1, types
+    for gone in ("softmax", "mul", "matmul", "reshape2", "transpose2",
+                 "scale"):
+        assert gone not in types, types
+
+    after = np.asarray(_run(fused, scope, feed, [out])[0])
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-5)
+
+
+def test_multihead_fuse_in_inference_pipeline():
+    """End-to-end: the canonical inference pipeline reaches the fused op
+    even though fc_fuse_pass also wants the projection mul+add pairs."""
+    main, scope, out = _fresh(_build_raw_attention)
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.rand(1, 6, 8).astype("float32"),
+            "mask": np.zeros((1, 2, 6, 6), "float32")}
+    before = np.asarray(_run(main, scope, feed, [out])[0])
+    fused = apply_inference_passes(main, scope=scope)
+    assert _op_types(fused).count("multihead_matmul") == 1, _op_types(fused)
+    after = np.asarray(_run(fused, scope, feed, [out])[0])
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-5)
+
+
+def test_multihead_fuse_skips_without_scope():
+    main, _, out = _fresh(_build_raw_attention)
+    n_ops = len(main.global_block().ops)
+    fused = PassManager(["multihead_matmul_fuse_pass_v2"]).apply(
+        main, protected=[out.name])
+    assert len(fused.global_block().ops) == n_ops  # no scope → no rewrite
