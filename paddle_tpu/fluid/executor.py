@@ -479,16 +479,34 @@ class _CompiledBlock:
             from jax.sharding import NamedSharding
             repl = replicated(self.mesh)
 
+            multiproc = jax.process_count() > 1
+
             def place(n, a):
                 spec = self._sharding_for(n, a)
-                if spec is None:
-                    return jax.device_put(a, repl)
-                return jax.device_put(a, NamedSharding(self.mesh, spec))
+                sh = repl if spec is None else NamedSharding(self.mesh, spec)
+                if multiproc:
+                    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+                        return a  # already global (written back last step)
+                    # device_put can't target non-addressable devices; every
+                    # process holds the full value (startup ran identically
+                    # on all ranks), so assemble the global array from the
+                    # process-local copy. global_shape MUST be passed: it is
+                    # the documented "data is identical across hosts" mode —
+                    # without it a cross-process sharded dim would be
+                    # inferred as local_size × process_slices (2× too big)
+                    host = np.asarray(a)
+                    return jax.make_array_from_process_local_data(
+                        sh, host, global_shape=host.shape)
+                return jax.device_put(a, sh)
             mut = {n: place(n, a) for n, a in mut.items()}
             ro = {n: place(n, a) for n, a in ro.items()}
             feeds = {n: shard_feed(self.mesh, n, a)
                      for n, a in feeds.items()}
-            rng = jax.device_put(rng, repl)
+            if not multiproc:
+                # multi-process: leave the key uncommitted — identical on
+                # every rank, jit replicates it (key arrays can't go
+                # through make_array_from_process_local_data)
+                rng = jax.device_put(rng, repl)
         from . import profiler as _profiler
         if _profiler.is_profiling():
             # the whole program is ONE dispatch on TPU — a single span
@@ -565,10 +583,14 @@ class Executor:
 
         # materialize program vars' metadata for persistables (create slots)
         # feeds → device
+        use_feed_cache = core.globals_["FLAGS_feed_device_cache"]
         feed_arrays = {}
         feed_lods = {}
         for name, data in feed.items():
-            t = _as_lodtensor(data, self.place)
+            t = (self._feed_device_cached(name, data)
+                 if use_feed_cache else None)
+            if t is None:
+                t = _as_lodtensor(data, self.place)
             scope.var(name).set_value(t)
             feed_arrays[name] = t.array
             lv = _normalize_lod(t.lod())
@@ -623,9 +645,18 @@ class Executor:
                     fetch_lods.append(None)
 
         if fetch_names and return_numpy:
-            return [np.asarray(f) for f in fetched]
+            return [_restore_fetch_dtype(program, n, _fetch_to_host(f))
+                    for n, f in zip(fetch_names, fetched)]
         if fetch_names:
-            return [LoDTensor(f, lod=lv) for f, lv in zip(fetched, fetch_lods)]
+            # LoDTensor fetches stay LAZY device arrays (the async
+            # training-loop contract — no per-step sync); only a
+            # non-addressable multi-process global must gather here. The
+            # int64-restore policy applies at np conversion, i.e. on the
+            # return_numpy=True path.
+            return [LoDTensor(f if not (isinstance(f, jax.Array)
+                                        and not f.is_fully_addressable)
+                              else _fetch_to_host(f), lod=lv)
+                    for f, lv in zip(fetched, fetch_lods)]
         return []
 
     # ------------------------------------------------------ dataset path
@@ -707,6 +738,31 @@ class Executor:
                 self._seed_cache[0] != seed:
             self._seed_cache = (seed, jnp.int32(seed))
         return Executor._fold_rng(self._seed_cache[1], np.int32(cnt))
+
+    def _feed_device_cached(self, name: str, data) -> Optional[LoDTensor]:
+        """Identity-keyed feed→device cache (FLAGS_feed_device_cache):
+        when the SAME ndarray object (same buffer address) is fed again,
+        reuse the device array and skip the per-step device_put — the
+        dominant host cost of a small training step. Off by default:
+        in-place mutation of a previously-fed array is undetectable, so
+        callers opt in when feeds are immutable (benches, static eval
+        loops)."""
+        if not isinstance(data, np.ndarray):
+            return None
+        cache = getattr(self, "_feed_cache", None)
+        if cache is None:
+            cache = self._feed_cache = {}
+        key = (id(data), data.__array_interface__["data"][0],
+               data.shape, data.dtype.str)
+        hit = cache.get(name)
+        if hit is not None and hit[0] == key:
+            return hit[2]
+        t = _as_lodtensor(data, self.place)
+        # pin the source ndarray: while the entry lives, its id/buffer
+        # address cannot be recycled by a new array (which would
+        # otherwise falsely hit this key)
+        cache[name] = (key, data, t)
+        return t
 
     def _run_block_eager(self, block, scope: Scope, rng_base):
         for idx, op in enumerate(block.ops):
@@ -808,6 +864,43 @@ class Executor:
                 return v.value().array.shape[0]
             return None
         _propagate_lods(op, outs, in_lods, _set_scope_lod, _scope_len)
+
+
+def _fetch_to_host(f) -> np.ndarray:
+    """Fetched value → host numpy. In multi-process runs a fetched global
+    array spans non-addressable devices: replicated values read the local
+    copy, sharded values gather across processes (the reference pulls
+    fetches to trainer rank over gRPC — operators/distributed; here the
+    collective rides jax's runtime)."""
+    if isinstance(f, jax.Array) and not f.is_fully_addressable:
+        if f.sharding.is_fully_replicated:
+            return np.asarray(f.addressable_data(0))
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(f, tiled=True))
+    return np.asarray(f)
+
+
+def _restore_fetch_dtype(program, name: str, arr: np.ndarray) -> np.ndarray:
+    """Device integers are 32-bit by policy (core._to_device_array); widen
+    a fetched int32/uint32 back to the program-declared 64-bit dtype so
+    user-visible numpy matches the reference op contracts."""
+    if arr.dtype not in (np.int32, np.uint32):
+        return arr
+    try:
+        v = program.global_block()._find_var_recursive(name)
+    except Exception:
+        return arr
+    want = getattr(v, "dtype", None) if v is not None else None
+    if want is None:
+        return arr
+    try:  # var dtype may be a string ("int64") or a VarType enum
+        np_want = np.dtype(want) if isinstance(want, str) \
+            else np.dtype(core.dtype_to_np(want))
+    except Exception:
+        return arr
+    if np_want in (np.dtype(np.int64), np.dtype(np.uint64)):
+        return arr.astype(np_want)
+    return arr
 
 
 def _to_fetch_names(fetch_list) -> List[str]:

@@ -252,7 +252,11 @@ class HeartBeatMonitor:
 
     def handlers(self) -> Dict[str, Callable[..., Any]]:
         return {"heartbeat": lambda trainer_id=0: (self.update(trainer_id)
-                                                   or True)}
+                                                   or True),
+                # liveness is queryable over RPC (the reference exposes it
+                # via GetWorkerStatus on the monitor thread)
+                "dead_workers": lambda trainer_id=0: self.dead_workers(),
+                "alive_workers": lambda trainer_id=0: self.alive_workers()}
 
 
 class WorkerHeartBeat:

@@ -40,6 +40,9 @@ class DistributeTranspilerConfig:
     # DELTAS to the pservers every geo_sgd_need_push_nums steps
     geo_sgd_mode = False
     geo_sgd_need_push_nums = 100
+    # memory bound for LazyEmbeddingTable-hosted sparse tables (rows kept
+    # per pserver before LRU eviction); 0 = unbounded
+    sparse_table_max_rows = 0
 
 
 class DistributeTranspiler:
@@ -78,6 +81,53 @@ class DistributeTranspiler:
             if op.type in ("lookup_table", "lookup_table_v2") and \
                     op.attrs.get("is_distributed"):
                 self.sparse_tables.add(op.input("W")[0])
+
+        # 2b. beyond-HBM sparse tables (reference fleet_wrapper.h:86-190
+        # DownpourSparseTable): above the threshold the table is hosted as
+        # an init-on-touch LazyEmbeddingTable on every pserver (row-sharded
+        # by id) and must NEVER materialize on a trainer — rewrite its
+        # trainer-startup init to fake_init and keep a pristine startup
+        # for the pserver side
+        import numpy as _np
+        from .. import core as _core
+        thresh = int(_core.globals_["FLAGS_lazy_sparse_table_threshold"])
+        self.lazy_tables: Dict[str, tuple] = {}
+        for w in self.sparse_tables:
+            v = block.vars.get(w)
+            shape = tuple(getattr(v, "shape", ()) or ())
+            if shape and int(_np.prod(shape)) >= thresh:
+                self.lazy_tables[w] = (int(shape[0]),
+                                       int(_np.prod(shape[1:])))
+        self._startup_src = (self.origin_startup.clone()
+                            if self.lazy_tables else self.origin_startup)
+        if self.lazy_tables:
+            from ..core import _STR_TO_DTYPE
+            sblock = self.origin_startup.global_block()
+            for op in list(sblock.ops):
+                hit = [n for n in op.output_arg_names
+                       if n in self.lazy_tables]
+                if not hit:
+                    continue
+                others = [n for n in op.output_arg_names if n not in hit]
+                if others:
+                    # a multi-output init also feeding non-lazy vars must
+                    # keep initializing them — only a single-output init
+                    # op can be rewritten in place
+                    raise NotImplementedError(
+                        f"startup op '{op.type}' initializes lazy table "
+                        f"{hit} together with {others}; split the "
+                        "initializers")
+                w = hit[0]
+                _h, d = self.lazy_tables[w]
+                sv = block.vars.get(w)
+                dt = getattr(sv, "dtype", None)
+                if isinstance(dt, str):
+                    dt = _STR_TO_DTYPE.get(dt, 5)
+                op.type = "fake_init"
+                op.inputs = {}
+                op.outputs = {"Out": [w]}
+                op.attrs = {"shape": [1, d],
+                            "dtype": int(dt) if dt is not None else 5}
 
         # 3. place params on pservers
         dispatcher = RoundRobin(self.pserver_endpoints)
@@ -140,7 +190,10 @@ class DistributeTranspiler:
                 op.outputs = {"Outputs": op.output("Out")}
                 op.attrs.update({
                     "table_names": [w],
-                    "epmap": [self.param_ep[w]],
+                    # row-sharded across every pserver (id % n_eps), the
+                    # reference's table-section split; each pserver holds
+                    # its id-subset (lazily for beyond-HBM tables)
+                    "epmap": list(self.pserver_endpoints),
                     "trainer_id": self.trainer_id})
         block.ops[:] = keep
 
@@ -184,8 +237,9 @@ class DistributeTranspiler:
         gblock = prog.global_block()
         origin_block = self.origin_program.global_block()
 
+        # sparse tables are row-sharded: EVERY pserver hosts its id-subset
         mine = [(p, g, op) for p, g, op in self.param_grad_ops
-                if self.param_ep[p] == endpoint]
+                if self.param_ep[p] == endpoint or p in self.sparse_tables]
 
         if self.config.geo_sgd_mode:
             # GEO pserver: hosts the params, applies pushed deltas on
@@ -243,27 +297,50 @@ class DistributeTranspiler:
                             startup_program: Optional[Program] = None
                             ) -> Program:
         """Init program for one pserver: the original init ops of every var
-        the pserver hosts (params, accumulators, lr)."""
-        src = startup_program or self.origin_startup
+        the pserver hosts (params, accumulators, lr). Beyond-threshold
+        sparse tables initialize as LazyEmbeddingTable (init-on-touch)
+        instead of running their dense initializer."""
+        src = startup_program or getattr(self, "_startup_src",
+                                         self.origin_startup)
         hosted = set()
         if pserver_program is not None:
             hosted.update(v for v in pserver_program.global_block().vars)
         else:
             hosted.update(p for p, ep in self.param_ep.items()
                           if ep == endpoint)
+            hosted.update(getattr(self, "lazy_tables", {}))
         prog = Program()
         block = prog.global_block()
+        lazy = getattr(self, "lazy_tables", {})
+        emitted_lazy = set()
         for op in src.global_block().ops:
             outs = set(op.output_arg_names)
-            if outs & hosted:
-                for name in outs:
-                    sv = src.global_block().vars.get(name)
-                    if sv is not None and name not in block.vars:
-                        block.create_var(name=name, shape=sv.shape,
-                                         dtype=sv.dtype, persistable=True)
-                block.append_op(
-                    type=op.type,
-                    inputs={k: list(v) for k, v in op.inputs.items()},
-                    outputs={k: list(v) for k, v in op.outputs.items()},
-                    attrs=dict(op.attrs))
+            if not (outs & hosted):
+                continue
+            hit = [n for n in outs if n in lazy]
+            if hit:
+                w = hit[0]
+                if w not in emitted_lazy:
+                    emitted_lazy.add(w)
+                    h, d = lazy[w]
+                    block.create_var(name=w, persistable=True)
+                    block.append_op(
+                        type="lazy_table_init", inputs={},
+                        outputs={"Out": [w]},
+                        attrs={"height": h, "dim": d, "seed": 0,
+                               "scale": 0.0,
+                               "max_rows": int(getattr(
+                                   self.config,
+                                   "sparse_table_max_rows", 0))})
+                continue
+            for name in outs:
+                sv = src.global_block().vars.get(name)
+                if sv is not None and name not in block.vars:
+                    block.create_var(name=name, shape=sv.shape,
+                                     dtype=sv.dtype, persistable=True)
+            block.append_op(
+                type=op.type,
+                inputs={k: list(v) for k, v in op.inputs.items()},
+                outputs={k: list(v) for k, v in op.outputs.items()},
+                attrs=dict(op.attrs))
         return prog

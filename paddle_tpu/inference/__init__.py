@@ -17,7 +17,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["Config", "AnalysisConfig", "Predictor", "AnalysisPredictor",
-           "create_predictor", "create_paddle_predictor", "PredictTensor"]
+           "create_predictor", "create_paddle_predictor", "PredictTensor",
+           "PassStrategy", "PredictorPool"]
 
 
 class AnalysisConfig:
@@ -30,16 +31,32 @@ class AnalysisConfig:
         self._model_dir = model_dir
         self._prog_file = None
         self._params_file = params_file
+        self._prog_bytes = None
+        self._params_bytes = None
         self._ir_optim = True
         self._use_feed_fetch_ops = False
         self._enable_memory_optim = True
         self._tensorrt = False
         self._device = "tpu"
+        self._bf16 = False
+        self._profile = False
+        self._pass_builder = None
 
     # --- model location ---------------------------------------------------
     def set_model(self, model_dir, params_file=None):
         self._model_dir = model_dir
         self._params_file = params_file
+
+    def set_model_buffer(self, prog_bytes: bytes, params_bytes: bytes):
+        """Serve a model from in-memory byte buffers — the reference's
+        SetModelBuffer path (analysis_config.cc SetModelBuffer), used by
+        services that ship models over the wire. The bytes are the
+        standard serialized ProgramDesc + save_combine stream."""
+        self._prog_bytes = bytes(prog_bytes)
+        self._params_bytes = bytes(params_bytes)
+
+    def model_from_memory(self) -> bool:
+        return self._prog_bytes is not None
 
     def set_prog_file(self, f):
         self._prog_file = f
@@ -79,6 +96,49 @@ class AnalysisConfig:
     def specify_input_name(self):
         return True
 
+    def enable_bf16(self):
+        """bf16 inference (the reference's enable_mkldnn_bfloat16 /
+        TRT-fp16 role): matmuls/convs run MXU-native bf16."""
+        self._bf16 = True
+
+    def bf16_enabled(self):
+        return self._bf16
+
+    def enable_profile(self):
+        self._profile = True
+
+    def pass_builder(self) -> "PassStrategy":
+        """Customizable IR pass pipeline (reference: PaddlePassBuilder,
+        api/paddle_pass_builder.h) — mutations here change which passes
+        the predictor applies at load."""
+        if self._pass_builder is None:
+            from paddle_tpu.fluid.ir import INFERENCE_PASSES
+            self._pass_builder = PassStrategy(list(INFERENCE_PASSES))
+        return self._pass_builder
+
+
+class PassStrategy:
+    """reference: paddle_pass_builder.h PaddlePassBuilder."""
+
+    def __init__(self, passes: List[str]):
+        self._passes = list(passes)
+
+    def all_passes(self) -> List[str]:
+        return list(self._passes)
+
+    def append_pass(self, name: str):
+        from paddle_tpu.fluid.ir import get_pass
+        get_pass(name)  # validate it exists
+        self._passes.append(name)
+
+    def insert_pass(self, idx: int, name: str):
+        from paddle_tpu.fluid.ir import get_pass
+        get_pass(name)
+        self._passes.insert(idx, name)
+
+    def delete_pass(self, name: str):
+        self._passes = [p for p in self._passes if p != name]
+
 
 Config = AnalysisConfig
 
@@ -114,31 +174,86 @@ class PredictTensor:
 class AnalysisPredictor:
     """reference: analysis_predictor.cc:288 Run / :235 PrepareExecutor."""
 
-    def __init__(self, config: AnalysisConfig):
+    def __init__(self, config: AnalysisConfig, _shared=None):
         import paddle_tpu.fluid as fluid
         from paddle_tpu.fluid import core
         self.config = config
         self._exe = fluid.Executor()
-        self._scope = core.Scope()
-        with fluid.scope_guard(self._scope):
-            (self._program, self._feed_names,
-             self._fetch_targets) = fluid.io.load_inference_model(
-                 config.model_dir(), self._exe,
-                 model_filename=config._prog_file,
-                 params_filename=config._params_file)
-        self._fetch_names = [v.name for v in self._fetch_targets]
-        if config._ir_optim:
-            # reference AnalysisPredictor::OptimizeInferenceProgram
-            # (analysis_predictor.cc:497): canonicalise + fuse with the
-            # param scope so conv+bn folding can rewrite weights; the
-            # model's fetch targets are protected from fusion.
-            from paddle_tpu.fluid.ir import INFERENCE_PASSES, PassManager
-            pm = PassManager(INFERENCE_PASSES, scope=self._scope)
-            self._program = pm.apply(self._program, for_test=True,
-                                     protected=self._fetch_names)
+        if _shared is not None:
+            # weight-sharing clone (reference AnalysisPredictor::Clone
+            # shares the params scope across predictors serving threads)
+            (self._scope, self._program, self._feed_names,
+             self._fetch_names) = _shared
+        elif config.model_from_memory():
+            self._scope = core.Scope()
+            self._program, self._feed_names, self._fetch_names = \
+                self._load_from_memory(config)
+            self._optimize(config)
+        else:
+            self._scope = core.Scope()
+            with fluid.scope_guard(self._scope):
+                (self._program, self._feed_names,
+                 fetch_targets) = fluid.io.load_inference_model(
+                     config.model_dir(), self._exe,
+                     model_filename=config._prog_file,
+                     params_filename=config._params_file)
+            self._fetch_names = [v.name for v in fetch_targets]
+            self._optimize(config)
+        if config._bf16:
+            core.set_flag("FLAGS_use_bf16_matmul", True)
         self._inputs: Dict[str, np.ndarray] = {}
         self._outputs: Dict[str, np.ndarray] = {}
         self._output_lods: Dict[str, list] = {}
+
+    def _load_from_memory(self, config):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import core
+        from paddle_tpu.fluid.framework import Program
+        from paddle_tpu.fluid.io import _deserialize_lod_tensor_stream
+        prog = Program.parse_from_string(config._prog_bytes)
+        block = prog.global_block()
+        persistables = sorted(
+            v.name for v in block.vars.values()
+            if v.persistable and v.name not in ("feed", "fetch"))
+        tensors = _deserialize_lod_tensor_stream(config._params_bytes,
+                                                 len(persistables))
+        for name, t in zip(persistables, tensors):
+            self._scope.var(name).set_value(t)
+        feed_names = [v.name for v in block.vars.values()
+                      if getattr(v, "need_check_feed", False)
+                      or getattr(v, "is_data", False)]
+        written, written_order = set(), []
+        for op in block.ops:
+            for n in op.output_arg_names:
+                if n not in written:
+                    written.add(n)
+                    written_order.append(n)
+        consumed = set()
+        for op in block.ops:
+            consumed.update(op.input_arg_names)
+        # program order, not set order: output position must be stable
+        # across processes (clients index Predictor.run results)
+        fetch_names = [n for n in written_order
+                       if n not in consumed
+                       and block.vars.get(n) is not None
+                       and not block.vars[n].persistable]
+        return prog, feed_names, fetch_names
+
+    def _optimize(self, config):
+        if not config._ir_optim:
+            return
+        # reference AnalysisPredictor::OptimizeInferenceProgram
+        # (analysis_predictor.cc:497): canonicalise + fuse with the
+        # param scope so conv+bn folding can rewrite weights; the
+        # model's fetch targets are protected from fusion. A customized
+        # config.pass_builder() overrides the canonical pipeline.
+        from paddle_tpu.fluid.ir import INFERENCE_PASSES, PassManager
+        names = (config._pass_builder.all_passes()
+                 if config._pass_builder is not None
+                 else INFERENCE_PASSES)
+        pm = PassManager(names, scope=self._scope)
+        self._program = pm.apply(self._program, for_test=True,
+                                 protected=self._fetch_names)
 
     # --- interface --------------------------------------------------------
     def get_input_names(self) -> List[str]:
@@ -177,11 +292,45 @@ class AnalysisPredictor:
             self._output_lods[n] = t.lod()
         return [self._outputs[n] for n in self._fetch_names]
 
-    def clone(self) -> "AnalysisPredictor":
+    def get_input_tensor_shape(self) -> Dict[str, List[int]]:
+        block = self._program.global_block()
+        return {n: list(getattr(block.vars.get(n), "shape", ()) or ())
+                for n in self._feed_names}
+
+    def try_shrink_memory(self):
+        """Drop cached executables/feed copies (reference
+        TryShrinkMemory); the next run re-jits."""
+        self._exe._compiled_cache.clear()
+        if hasattr(self._exe, "_feed_cache"):
+            self._exe._feed_cache.clear()
+
+    def clone(self, share_weights: bool = True) -> "AnalysisPredictor":
+        """Reference Clone(): the clone serves from the SAME params scope
+        (zero weight duplication) with its own feed/fetch state."""
+        if share_weights:
+            return AnalysisPredictor(
+                self.config, _shared=(self._scope, self._program,
+                                      list(self._feed_names),
+                                      list(self._fetch_names)))
         return AnalysisPredictor(self.config)
 
 
 Predictor = AnalysisPredictor
+
+
+class PredictorPool:
+    """reference: api/paddle_inference_api.h PredictorPool — one loaded
+    predictor cloned per serving slot, weights shared."""
+
+    def __init__(self, config: AnalysisConfig, size: int = 1):
+        first = AnalysisPredictor(config)
+        self._preds = [first] + [first.clone() for _ in range(size - 1)]
+
+    def retrieve(self, idx: int) -> AnalysisPredictor:
+        return self._preds[idx]
+
+    def size(self) -> int:
+        return len(self._preds)
 
 
 def create_predictor(config: AnalysisConfig) -> AnalysisPredictor:

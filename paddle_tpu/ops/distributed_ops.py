@@ -13,6 +13,7 @@ blocks, then serve gets until the next round. Async follows RunAsyncLoop
 """
 from __future__ import annotations
 
+import os
 import threading
 
 import numpy as np
@@ -159,16 +160,34 @@ def _checkpoint_notify(ins, attrs):
              attr_defaults={"epmap": [], "table_names": [], "padding_idx": -1,
                             "is_distributed": True, "trainer_id": 0})
 def _distributed_lookup_table(ins, attrs):
-    """Pulls embedding rows from the pserver-resident table (reference:
-    distributed_lookup_table_op.cc over parameter_prefetch.cc)."""
+    """Pulls embedding rows from the pserver-resident table, row-sharded
+    across ALL endpoints in epmap by ``id %% n_pservers`` (reference:
+    distributed_lookup_table_op.cc over parameter_prefetch.cc, which
+    splits ids per-section the same way)."""
     ctx = attrs["_ctx"]
     id_names = ctx.op.input("Ids")
     w_name = (attrs.get("table_names") or ctx.op.input("W"))[0]
-    ep = (attrs.get("epmap") or [None])[0]
+    eps = [e for e in (attrs.get("epmap") or []) if e] or [None]
     outs = []
     for nm in id_names:
         ids = np.asarray(ctx.scope.find_var(nm).value().array).reshape(-1)
-        rows = _client(ep).prefetch_rows(w_name, ids)
+        if len(eps) == 1:
+            rows = np.asarray(_client(eps[0]).prefetch_rows(w_name, ids))
+        else:
+            shard = ids % len(eps)
+            rows = None
+            for k, ep in enumerate(eps):
+                sel = np.where(shard == k)[0]
+                if not len(sel):
+                    continue
+                part = np.asarray(
+                    _client(ep).prefetch_rows(w_name, ids[sel]))
+                if rows is None:
+                    rows = np.zeros((len(ids), part.shape[-1]),
+                                    part.dtype)
+                rows[sel] = part
+            if rows is None:
+                rows = np.zeros((0, 1), np.float32)
         outs.append(jnp.asarray(rows))
     return {"Outputs": outs}
 
@@ -189,19 +208,47 @@ def _dist_lookup_grad_maker(op, grad_map):
 @register_op("distributed_lookup_table_grad", stateful=True, no_grad=True,
              attr_defaults={"epmap": [], "table_names": [], "trainer_id": 0})
 def _distributed_lookup_table_grad(ins, attrs):
-    """Pushes SelectedRows gradients back to the table's pserver."""
+    """Pushes SelectedRows gradients back, row-sharded across epmap the
+    same way the forward pull routes ids."""
     ctx = attrs["_ctx"]
     id_names = ctx.op.input("Ids")
     w_name = (attrs.get("table_names") or ctx.op.input("W"))[0]
-    ep = (attrs.get("epmap") or [None])[0]
+    eps = [e for e in (attrs.get("epmap") or []) if e] or [None]
     tid = int(attrs.get("trainer_id", 0))
     g_names = ctx.op.input("Outputs@GRAD")
     for nm, gn in zip(id_names, g_names):
         ids = np.asarray(ctx.scope.find_var(nm).value().array).reshape(-1)
         g = np.asarray(ctx.scope.find_var(gn).value().array)
         g = g.reshape(len(ids), -1)
-        _client(ep).send_var(w_name + "@GRAD", g, trainer_id=tid,
-                             rows=ids, height=0)
+        if len(eps) == 1:
+            _client(eps[0]).send_var(w_name + "@GRAD", g, trainer_id=tid,
+                                     rows=ids, height=0)
+            continue
+        shard = ids % len(eps)
+        for k, ep in enumerate(eps):
+            sel = np.where(shard == k)[0]
+            if len(sel):
+                _client(ep).send_var(w_name + "@GRAD", g[sel],
+                                     trainer_id=tid, rows=ids[sel],
+                                     height=0)
+    return {}
+
+
+@register_op("lazy_table_init", stateful=True, no_grad=True,
+             attr_defaults={"height": 0, "dim": 0, "seed": 0,
+                            "scale": 0.0, "max_rows": 0})
+def _lazy_table_init(ins, attrs):
+    """Initializes a pserver var as a LazyEmbeddingTable: rows materialize
+    on first touch, so the logical [height, dim] never allocates
+    (reference: fleet_wrapper.h DownpourSparseTable pull-creates)."""
+    ctx = attrs["_ctx"]
+    scale = float(attrs.get("scale") or 0.0)
+    tbl = core.LazyEmbeddingTable(
+        height=int(attrs["height"]), dim=int(attrs["dim"]),
+        seed=int(attrs.get("seed", 0)),
+        scale=scale if scale > 0 else None,
+        max_rows=int(attrs.get("max_rows") or 0) or None)
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(tbl)
     return {}
 
 
@@ -265,7 +312,11 @@ def _listen_and_serv(ins, attrs):
         # update path; communicator.h AsyncCommunicator)
         pname = name[:-5] if name.endswith("@GRAD") else name
         var = scope.find_var(pname)
-        tbl = np.asarray(var.value().array)
+        val = var.value()
+        if isinstance(val, core.LazyEmbeddingTable):
+            val.apply_grad(rows, value, sparse_lr)
+            return
+        tbl = np.asarray(val.array)
         np.subtract.at(tbl, np.asarray(rows, np.int64),
                        sparse_lr * np.asarray(value))
         var.set_value(core.LoDTensor(jnp.asarray(tbl)))
@@ -326,8 +377,24 @@ def _listen_and_serv(ins, attrs):
         return np.asarray(arr)
 
     def h_prefetch_rows(name, rows):
-        tbl = np.asarray(scope.find_var(name).value().array)
+        val = scope.find_var(name).value()
+        if isinstance(val, core.LazyEmbeddingTable):
+            return val.get_rows(rows)
+        tbl = np.asarray(val.array)
         return tbl[np.asarray(rows, np.int64)]
+
+    def h_table_stats(name):
+        """Introspection for tests/monitoring: touched rows + evictions."""
+        val = scope.find_var(name).value()
+        if isinstance(val, core.LazyEmbeddingTable):
+            return {"touched": val.touched_rows(),
+                    "evictions": val.evictions,
+                    "nbytes": val.nbytes(),
+                    "logical_params": val.logical_params()}
+        arr = np.asarray(val.array)
+        return {"touched": int(arr.shape[0]), "evictions": 0,
+                "nbytes": int(arr.nbytes),
+                "logical_params": int(arr.size)}
 
     def h_checkpoint(dir=""):
         return True
@@ -345,10 +412,16 @@ def _listen_and_serv(ins, attrs):
                 jnp.asarray(cur + np.asarray(value))))
         return True
 
-    monitor = HeartBeatMonitor(fanin).start_monitor()
+    # failure-detection cadence is deploy-tunable (tests shrink it to
+    # seconds; reference FLAGS_worker_update_interval_secs plays this role)
+    hb_timeout = float(os.environ.get("PADDLE_PS_HEARTBEAT_TIMEOUT", 60.0))
+    monitor = HeartBeatMonitor(
+        fanin, timeout=hb_timeout,
+        check_interval=min(3.0, max(0.2, hb_timeout / 4))).start_monitor()
     srv = VarServer(endpoint, {
         "send_var": h_send_var, "barrier": h_barrier, "get_var": h_get_var,
         "prefetch_rows": h_prefetch_rows, "checkpoint": h_checkpoint,
+        "table_stats": h_table_stats,
         "geo_delta": h_geo_delta,
         **monitor.handlers(),
     }).start()

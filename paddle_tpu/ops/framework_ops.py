@@ -297,6 +297,14 @@ def _rnn_memory_helper(ins, attrs):
 @register_op("fake_init", stateful=True, no_grad=True,
              attr_defaults={"shape": [], "dtype": 5})
 def _fake_init(ins, attrs):
+    """Marks the output var initialized without meaningful contents
+    (reference fake_init_op.cc: allocates, leaves memory unset — trainers
+    use it for vars the pserver owns). Zeros keep it deterministic."""
+    ctx = attrs["_ctx"]
+    from ..fluid.core import dtype_to_jnp
+    shape = [int(s) for s in attrs.get("shape", [])]
+    ctx.scope.var(ctx.op.output("Out")[0]).set_value(
+        core.LoDTensor(jnp.zeros(shape, dtype_to_jnp(attrs.get("dtype", 5)))))
     return {}
 
 

@@ -52,7 +52,7 @@ def _max_sequence_len(ins, attrs):
         ctx.op.input("RankTable")[0]).get_lod_rank_table()
     m = table.items[0][1] if table.items else 0
     ctx.scope.var(ctx.op.output("Out")[0]).set_value(
-        core.LoDTensor(jnp.asarray([m], jnp.int64)))
+        core.LoDTensor(jnp.asarray([m], jnp.int32)))
     return {}
 
 

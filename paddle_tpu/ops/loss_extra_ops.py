@@ -205,7 +205,7 @@ def _nce(ins, attrs):
     cost = (pos_loss + neg_loss).reshape(N, 1)
     return out(Cost=cost,
                SampleLogits=neg_logit,
-               SampleLabels=neg.astype(jnp.int64))
+               SampleLabels=neg.astype(jnp.int32))
 
 
 @register_op("sampled_softmax_with_cross_entropy", needs_rng=True,
@@ -328,7 +328,7 @@ def _crf_decoding(ins, attrs):
     lens_np = np.asarray(offs[1:] - offs[:-1])
 
     if Tm == 0 or N == 0:
-        o = jnp.zeros((0, 1), jnp.int64)
+        o = jnp.zeros((0, 1), jnp.int32)
     else:
         score0 = start_w[None, :] + em_p[:, 0]
 
@@ -355,9 +355,9 @@ def _crf_decoding(ins, attrs):
         # unpad with static offsets
         o = jnp.concatenate(
             [tags[i, :int(lens_np[i])] for i in range(N)]
-        ).reshape(-1, 1).astype(jnp.int64)
+        ).reshape(-1, 1).astype(jnp.int32)
     if label is not None:
-        o = (o == label.reshape(-1, 1)).astype(jnp.int64)
+        o = (o == label.reshape(-1, 1)).astype(jnp.int32)
     lod = (attrs.get("_lod") or {}).get("Emission")[0]
     return {"ViterbiPath": [o], "_lod": {"ViterbiPath": [lod]}}
 

@@ -96,7 +96,7 @@ def _chunk_eval(ins, attrs):
     p = n_cor / n_inf if n_inf else 0.0
     r = n_cor / n_lab if n_lab else 0.0
     f1 = 2 * p * r / (p + r) if p + r else 0.0
-    i64 = lambda v: jnp.asarray([v], jnp.int64)
+    i64 = lambda v: jnp.asarray([v], jnp.int32)
     return {"Precision": [jnp.asarray([p], jnp.float32)],
             "Recall": [jnp.asarray([r], jnp.float32)],
             "F1-Score": [jnp.asarray([f1], jnp.float32)],
@@ -385,8 +385,8 @@ def _shuffle_batch(ins, attrs):
            if seed_in is not None and int(np.asarray(seed_in).reshape(())) != 0
            else attrs["_rng"])
     perm = jax.random.permutation(rng, x.shape[0])
-    return {"Out": [x[perm]], "ShuffleIdx": [perm.astype(jnp.int64)],
-            "SeedOut": [jnp.asarray([0], jnp.int64)]}
+    return {"Out": [x[perm]], "ShuffleIdx": [perm.astype(jnp.int32)],
+            "SeedOut": [jnp.asarray([0], jnp.int32)]}
 
 
 @register_op("fill", no_grad=True,
@@ -453,9 +453,9 @@ def _sample_logits(ins, attrs):
                else attrs["_rng"])
         # log-uniform (Zipf) over classes: P(c)=log((c+2)/(c+1))/log(K+1)
         u = jax.random.uniform(rng, (n, S))
-        neg = (jnp.exp(u * jnp.log(K + 1.0)) - 1.0).astype(jnp.int64)
+        neg = (jnp.exp(u * jnp.log(K + 1.0)) - 1.0).astype(jnp.int32)
         neg = jnp.clip(neg, 0, K - 1)
-        samples = jnp.concatenate([labels.astype(jnp.int64), neg], axis=1)
+        samples = jnp.concatenate([labels.astype(jnp.int32), neg], axis=1)
         q = jnp.log((samples + 2.0) / (samples + 1.0)) / jnp.log(K + 1.0)
         probs = q
     gathered = jnp.take_along_axis(logits, samples.astype(jnp.int32), axis=1)
@@ -466,9 +466,9 @@ def _sample_logits(ins, attrs):
         mask = jnp.concatenate(
             [jnp.zeros((n, nt), bool), hit], axis=1)
         sampled_logits = jnp.where(mask, -1e20, sampled_logits)
-    sampled_labels = jnp.broadcast_to(jnp.arange(nt, dtype=jnp.int64),
+    sampled_labels = jnp.broadcast_to(jnp.arange(nt, dtype=jnp.int32),
                                       (n, nt))
-    return {"Samples": [samples.astype(jnp.int64)],
+    return {"Samples": [samples.astype(jnp.int32)],
             "Probabilities": [probs],
             "SampledLogits": [sampled_logits],
             "SampledLabels": [sampled_labels]}
@@ -517,8 +517,8 @@ def _tdm_child(ins, attrs):
     is_item = (info[kids.reshape(-1), 0] != 0).reshape(kids.shape)
     mask = jnp.where(has_child, is_item, False)
     shape = x.shape[:-1] + (cn,) if x.shape[-1] == 1 else x.shape + (cn,)
-    return {"Child": [kids.reshape(shape).astype(jnp.int64)],
-            "LeafMask": [mask.reshape(shape).astype(jnp.int64)]}
+    return {"Child": [kids.reshape(shape).astype(jnp.int32)],
+            "LeafMask": [mask.reshape(shape).astype(jnp.int32)]}
 
 
 @register_op("tdm_sampler", inputs=("X", "Travel", "Layer"), needs_rng=True,
@@ -548,11 +548,11 @@ def _tdm_sampler(ins, attrs):
         lab_cols.append(jnp.concatenate(
             [jnp.ones_like(pos), jnp.zeros_like(negv)], axis=1))
         mask_cols.append(jnp.concatenate(
-            [valid.astype(jnp.int64),
-             jnp.broadcast_to(valid, negv.shape).astype(jnp.int64)], axis=1))
+            [valid.astype(jnp.int32),
+             jnp.broadcast_to(valid, negv.shape).astype(jnp.int32)], axis=1))
     o = jnp.concatenate(out_cols, axis=1)
-    return {"Out": [o.astype(jnp.int64)[..., None]],
-            "Labels": [jnp.concatenate(lab_cols, 1).astype(jnp.int64)[..., None]],
+    return {"Out": [o.astype(jnp.int32)[..., None]],
+            "Labels": [jnp.concatenate(lab_cols, 1).astype(jnp.int32)[..., None]],
             "Mask": [jnp.concatenate(mask_cols, 1)[..., None]]}
 
 

@@ -30,5 +30,5 @@ def _hash(ins, attrs):
         v = (v ^ (v >> 16)) * jnp.uint32(0x85EBCA6B)
         v = (v ^ (v >> 13)) * jnp.uint32(0xC2B2AE35)
         v = v ^ (v >> 16)
-        outs.append((v % jnp.uint32(mod_by)).astype(jnp.int64))
+        outs.append((v % jnp.uint32(mod_by)).astype(jnp.int32))
     return out(Out=jnp.stack(outs, axis=1)[:, :, None])

@@ -1010,7 +1010,7 @@ def _auc(ins, attrs):
             stat_neg[b] += 1
     from ..utils.metrics import auc_from_histograms
     auc_val = auc_from_histograms(stat_pos, stat_neg)
-    return out(AUC=jnp.asarray([auc_val], jnp.float64),
+    return out(AUC=jnp.asarray([auc_val], jnp.float32),
                StatPosOut=jnp.asarray(stat_pos),
                StatNegOut=jnp.asarray(stat_neg))
 

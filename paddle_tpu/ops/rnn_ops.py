@@ -64,13 +64,17 @@ def _act(name):
 # scan cores (padded time-major scan with per-step masking)
 # --------------------------------------------------------------------------
 def _lstm_scan(xw, h0, c0, w_rec, bias, mask, gate_act, cell_act, cand_act,
-               peephole=None):
-    """xw: [N, T, 4H] pre-projected input; returns padded H, C [N, T, H]."""
-    H = w_rec.shape[0]
+               peephole=None, proj=None, proj_act="tanh"):
+    """xw: [N, T, 4H] pre-projected input. w_rec is [H, 4H] (lstm) or
+    [P, 4H] (lstmp, where the RECURRENT state is the P-dim projection —
+    reference lstmp_op.h projects inside the recurrence, not after it).
+    Returns padded (H-or-P state, C) [N, T, ·]."""
+    H = w_rec.shape[1] // 4
     ga, ca, na = _act(gate_act), _act(cell_act), _act(cand_act)
+    pa = _act(proj_act)
 
     def step(carry, t_in):
-        h, c = carry
+        h, c = carry              # h: [N, H] or [N, P] with projection
         x_t, m_t = t_in           # [N, 4H], [N, 1]
         g = x_t + h @ w_rec
         if bias is not None:
@@ -87,6 +91,8 @@ def _lstm_scan(xw, h0, c0, w_rec, bias, mask, gate_act, cell_act, cand_act,
             o = o + c_new * w_oc
         o = ga(o)
         h_new = o * ca(c_new)
+        if proj is not None:
+            h_new = pa(h_new @ proj)
         h = jnp.where(m_t, h_new, h)
         c = jnp.where(m_t, c_new, c)
         return (h, c), (h, c)
@@ -156,9 +162,9 @@ def _dyn_lstm_common(ins, attrs, proj_weight=None):
         padded, h0, c0, w, bias, jnp.asarray(valid),
         attrs.get("gate_activation", "sigmoid"),
         attrs.get("cell_activation", "tanh"),
-        attrs.get("candidate_activation", "tanh"), peephole=peep)
-    if proj_weight is not None:
-        hs = _act(attrs.get("proj_activation", "identity"))(hs @ proj_weight)
+        attrs.get("candidate_activation", "tanh"), peephole=peep,
+        proj=proj_weight,
+        proj_act=attrs.get("proj_activation", "identity"))
     h_packed = _unpad_to_packed(hs, offs)
     c_packed = _unpad_to_packed(cs, offs)
     if attrs.get("is_reverse", False):
@@ -460,7 +466,7 @@ def _beam_search_decode(ins, attrs):
     lod0 = _np.concatenate([[0], _np.cumsum(src_counts)])
     lod1 = _np.concatenate([[0], _np.cumsum(lens)])
     new_lod = (tuple(int(v) for v in lod0), tuple(int(v) for v in lod1))
-    return {"SentenceIds": [jnp.asarray(_np.asarray(flat_ids, _np.int64))],
+    return {"SentenceIds": [jnp.asarray(_np.asarray(flat_ids, _np.int32))],
             "SentenceScores": [jnp.asarray(_np.asarray(flat_sc, _np.float32))],
             "_lod": {"SentenceIds": [new_lod], "SentenceScores": [new_lod]}}
 

@@ -269,7 +269,9 @@ def _sequence_pad(ins, attrs):
     # Length also carries X's LoD as metadata so a downstream sequence_unpad
     # can recover host-static lengths under jit (its Length *array* is a
     # tracer there)
-    return {"Out": [o], "Length": [jnp.asarray(lens, jnp.int64)],
+    # device ints are 32-bit by policy; the executor fetch boundary widens
+    # Length back to the declared int64 (reference sequence_pad_op.cc)
+    return {"Out": [o], "Length": [jnp.asarray(lens, jnp.int32)],
             "_lod": {"Out": [None], "Length": [levels]}}
 
 
@@ -438,9 +440,10 @@ def _sequence_enumerate(ins, attrs):
         mask.append(valid)
     idx = np.stack(idx, 1)
     mask = np.stack(mask, 1)
-    vals = jnp.take(x.reshape(-1), jnp.asarray(idx), axis=0)
+    vals = jnp.take(x.reshape(-1), jnp.asarray(idx.astype(np.int32)), axis=0)
     o = jnp.where(jnp.asarray(mask), vals,
-                  jnp.asarray(pad, x.dtype))
+                  jnp.asarray(pad, vals.dtype))  # vals carries the
+    # canonical device dtype (int64 feeds land as int32 by policy)
     return {"Out": [o], "_lod": {"Out": [levels]}}
 
 

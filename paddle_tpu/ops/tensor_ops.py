@@ -103,7 +103,8 @@ def _diag_embed(ins, attrs):
     return out(Out=o)
 
 
-@register_op("range", inputs=("Start", "End", "Step"), no_grad=True)
+@register_op("range", inputs=("Start", "End", "Step"), no_grad=True,
+             stateful=True)  # output SHAPE depends on input values: host op
 def _range(ins, attrs):
     s = float(np.asarray(first(ins, "Start")).reshape(()))
     e = float(np.asarray(first(ins, "End")).reshape(()))
@@ -112,7 +113,8 @@ def _range(ins, attrs):
     return out(Out=jnp.arange(s, e, st, dtype=dt))
 
 
-@register_op("linspace", inputs=("Start", "Stop", "Num"), no_grad=True)
+@register_op("linspace", inputs=("Start", "Stop", "Num"), no_grad=True,
+             stateful=True)  # output SHAPE depends on Num's value: host op
 def _linspace(ins, attrs):
     s = np.asarray(first(ins, "Start")).reshape(())
     e = np.asarray(first(ins, "Stop")).reshape(())
@@ -248,7 +250,7 @@ def _sampling_id(ins, attrs):
     idx = jnp.sum((cum < r[:, None]).astype(jnp.int32), axis=1)
     idx = jnp.clip(idx, 0, x.shape[1] - 1)
     return out(Out=idx.astype(dtype_to_jnp(attrs.get("dtype", 5))
-                              if attrs.get("dtype", 5) != 5 else jnp.int64))
+                              if attrs.get("dtype", 5) != 5 else jnp.int32))
 
 
 @register_op("seed", no_grad=True, attr_defaults={"seed": 0})

@@ -1,0 +1,73 @@
+"""Worker for the 2-process data-parallel parity test (reference pattern:
+unittests/test_dist_base.py:506 TestDistRunnerBase.run_trainer — same
+model run 1-process and N-process, per-step losses compared).
+
+Forces the CPU backend with 2 local devices per process; under the
+launcher env (PADDLE_TRAINERS_NUM=2) it brings up jax.distributed so the
+two processes form one 4-device global dp mesh, each feeding its LOCAL
+half of the deterministic global batch."""
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid import core  # noqa: E402
+from paddle_tpu.parallel import env as penv  # noqa: E402
+from paddle_tpu.parallel.mesh import build_mesh  # noqa: E402
+
+STEPS = 5
+GLOBAL_BATCH = 16
+
+
+def build_program():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[8], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, 16, act="tanh")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    out_path = sys.argv[1]
+    penv.init_distributed()
+    rank, world = penv.rank(), penv.world_size()
+
+    main_prog, startup, loss = build_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    mesh = build_mesh()  # every global device on the dp axis
+
+    rng = np.random.RandomState(0)  # identical on all ranks: global batch
+    X = rng.rand(GLOBAL_BATCH, 8).astype("float32")
+    Y = (X.sum(1, keepdims=True) * 0.3).astype("float32")
+    per = GLOBAL_BATCH // world
+    lo, hi = rank * per, (rank + 1) * per
+
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(STEPS):
+            o = exe.run(main_prog, feed={"x": X[lo:hi], "y": Y[lo:hi]},
+                        fetch_list=[loss], mesh=mesh)
+            losses.append(float(np.asarray(o[0]).ravel()[0]))
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump(losses, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -25,7 +25,7 @@ from paddle_tpu.fluid.transpiler import (DistributeTranspiler,
                                          DistributeTranspilerConfig)
 
 
-def build(sparse):
+def build(sparse, sparse_dim=10, emb_dim=4):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         x = fluid.data("x", shape=[4], dtype="float32")
@@ -34,9 +34,9 @@ def build(sparse):
         if sparse:
             tok = fluid.data("tok", shape=[1], dtype="int64")
             emb = fluid.layers.embedding(
-                tok, size=[10, 4], is_distributed=True,
+                tok, size=[sparse_dim, emb_dim], is_distributed=True,
                 param_attr=fluid.ParamAttr(name="dist_emb"))
-            emb = fluid.layers.reshape(emb, [-1, 4])
+            emb = fluid.layers.reshape(emb, [-1, emb_dim])
             feat = fluid.layers.concat([x, emb], axis=1)
         pred = fluid.layers.fc(feat, 1,
                                param_attr=fluid.ParamAttr(name="w"),
@@ -47,17 +47,32 @@ def build(sparse):
     return main, startup, loss
 
 
+def _flag_value(name, default=None):
+    for a in sys.argv:
+        if a.startswith(name + "="):
+            return a.split("=", 1)[1]
+    return default
+
+
 def main():
     role, eps, tid, trainers, steps, outfile = sys.argv[1:7]
     sparse = "--sparse" in sys.argv
     geo = "--geo" in sys.argv
+    no_stop = "--no-stop" in sys.argv
+    die_after = int(_flag_value("--die-after", 0) or 0)
+    step_sleep = float(_flag_value("--step-sleep", 0) or 0)
     tid, trainers, steps = int(tid), int(trainers), int(steps)
-    main_prog, startup, loss = build(sparse)
+    sparse_dim = int(_flag_value("--sparse-dim", 10) or 10)
+    emb_dim = int(_flag_value("--emb-dim", 4) or 4)
+    max_rows = int(_flag_value("--max-rows", 0) or 0)
+    main_prog, startup, loss = build(sparse, sparse_dim, emb_dim)
 
     cfg = DistributeTranspilerConfig()
     if geo:
         cfg.geo_sgd_mode = True
         cfg.geo_sgd_need_push_nums = 5
+    if max_rows:
+        cfg.sparse_table_max_rows = max_rows
     t = DistributeTranspiler(cfg)
     with fluid.program_guard(main_prog, startup):
         t.transpile(trainer_id=tid, pservers=eps, trainers=trainers,
@@ -67,7 +82,7 @@ def main():
     exe = fluid.Executor()
     scope = core.Scope()
     if role == "pserver":
-        ep = eps.split(",")[0]
+        ep = eps.split(",")[tid]  # tid = this pserver's index
         pprog = t.get_pserver_program(ep)
         pstart = t.get_startup_program(ep, pprog)
         with fluid.scope_guard(scope):
@@ -80,19 +95,48 @@ def main():
     X = rng.rand(8, 4).astype("float32")
     W_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
     Y = X @ W_true + 0.25
-    toks = (np.arange(8) % 10).astype("int64").reshape(-1, 1)
+    # ids spread across the whole [0, sparse_dim) range so a lazy table
+    # proves init-on-touch at beyond-RAM logical sizes
+    toks = ((np.arange(8) * 7919 + 3) % sparse_dim).astype(
+        "int64").reshape(-1, 1)
+    from paddle_tpu.fluid.ps_rpc import WorkerHeartBeat
+    beat = WorkerHeartBeat(eps.split(","), tid, interval=0.5).start()
     losses = []
-    with fluid.scope_guard(scope):
-        exe.run(startup)
-        prog = t.get_trainer_program()
-        for s in range(steps):
-            feed = {"x": X, "y": Y}
-            if sparse:
-                feed["tok"] = toks
-            (lv,) = exe.run(prog, feed=feed, fetch_list=[loss])
-            losses.append(float(np.asarray(lv).reshape(-1)[0]))
-    json.dump(losses, open(outfile, "w"))
-    if tid == 0:
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            prog = t.get_trainer_program()
+            for s in range(steps):
+                if die_after and s >= die_after:
+                    os._exit(1)  # simulated crash: no cleanup at all
+                feed = {"x": X, "y": Y}
+                if sparse:
+                    feed["tok"] = toks
+                (lv,) = exe.run(prog, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(lv).reshape(-1)[0]))
+                if step_sleep:
+                    import time
+                    time.sleep(step_sleep)
+    except BaseException:
+        # a failed step must still release the pservers, or the cluster
+        # test dies by timeout hiding the real traceback
+        beat.stop()
+        try:
+            from paddle_tpu.fluid.ps_rpc import VarClient
+            for ep in eps.split(","):
+                VarClient.of(ep).stop()
+        except Exception:
+            pass
+        raise
+    beat.stop()
+    if "--stats" in sys.argv and sparse:
+        from paddle_tpu.fluid.ps_rpc import VarClient
+        stats = [VarClient.of(ep).call("table_stats", name="dist_emb")
+                 for ep in eps.split(",")]
+        json.dump({"losses": losses, "stats": stats}, open(outfile, "w"))
+    else:
+        json.dump(losses, open(outfile, "w"))
+    if tid == 0 and not no_stop:
         from paddle_tpu.fluid.ps_rpc import VarClient
         for ep in eps.split(","):
             VarClient.of(ep).stop()

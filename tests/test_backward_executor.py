@@ -250,3 +250,32 @@ def test_use_prune_skips_untargeted_branches():
     np.testing.assert_allclose(np.asarray(o), xv * 2.0)
     assert after_pruned == 0.0, "pruned run must skip the side branch"
     assert after_full == 1.0, "full run executes the side branch"
+
+
+def test_feed_device_cache_correctness():
+    """FLAGS_feed_device_cache reuses the device copy only for the SAME
+    ndarray object; a different object (even equal-shaped) must trigger a
+    fresh transfer and fresh results."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    X1 = np.random.rand(3, 4).astype("float32")
+    X2 = (X1 * 5.0).copy()
+    old = core.globals_["FLAGS_feed_device_cache"]
+    core.set_flag("FLAGS_feed_device_cache", True)
+    try:
+        with fluid.scope_guard(scope):
+            (o1,) = exe.run(main, feed={"x": X1}, fetch_list=[y])
+            (o1b,) = exe.run(main, feed={"x": X1}, fetch_list=[y])
+            (o2,) = exe.run(main, feed={"x": X2}, fetch_list=[y])
+        np.testing.assert_allclose(o1, X1 * 2.0, rtol=1e-6)
+        np.testing.assert_allclose(o1b, o1, rtol=1e-6)
+        np.testing.assert_allclose(o2, X2 * 2.0, rtol=1e-6)
+    finally:
+        core.set_flag("FLAGS_feed_device_cache", old)

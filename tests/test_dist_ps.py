@@ -24,8 +24,9 @@ def free_port():
 
 
 def run_cluster(trainers, steps, tmpdir, sparse=False, geo=False,
-                timeout=240):
-    ep = f"127.0.0.1:{free_port()}"
+                timeout=240, n_pservers=1, extra_args=()):
+    eps = ",".join(f"127.0.0.1:{free_port()}" for _ in range(n_pservers))
+    ep = eps
     env = dict(os.environ, PYTHONPATH=REPO + os.pathsep +
                os.environ.get("PYTHONPATH", ""), JAX_PLATFORMS="cpu")
     procs = []
@@ -46,20 +47,26 @@ def run_cluster(trainers, steps, tmpdir, sparse=False, geo=False,
                 return log.read().decode(errors="replace")[-3000:]
         return ""
 
-    ps_out = os.path.join(tmpdir, "ps.ready")
-    ps = spawn("ps", [sys.executable, WORKLOAD, "pserver", ep, "0",
-                      str(trainers), str(steps), ps_out] +
-               (["--sparse"] if sparse else []) +
-               (["--geo"] if geo else []))
+    flags = (["--sparse"] if sparse else []) + \
+            (["--geo"] if geo else []) + list(extra_args)
+    ps_procs = []
+    for pid in range(n_pservers):
+        ps_out = os.path.join(tmpdir, f"ps{pid}.ready")
+        ps_procs.append((spawn(f"ps{pid}",
+                               [sys.executable, WORKLOAD, "pserver", ep,
+                                str(pid), str(trainers), str(steps),
+                                ps_out] + flags), ps_out))
     deadline = time.time() + 90
-    while not os.path.exists(ps_out):
-        if ps.poll() is not None:
-            raise RuntimeError("pserver died:\n" + log_tail("ps"))
-        if time.time() > deadline:
-            ps.kill()
-            raise TimeoutError("pserver never became ready:\n" +
-                               log_tail("ps"))
-        time.sleep(0.2)
+    for pid, (psp, ps_out) in enumerate(ps_procs):
+        while not os.path.exists(ps_out):
+            if psp.poll() is not None:
+                raise RuntimeError(f"pserver {pid} died:\n"
+                                   + log_tail(f"ps{pid}"))
+            if time.time() > deadline:
+                psp.kill()
+                raise TimeoutError(f"pserver {pid} never became ready:\n"
+                                   + log_tail(f"ps{pid}"))
+            time.sleep(0.2)
     touts = []
     trainer_procs = []
     for tid in range(trainers):
@@ -67,15 +74,14 @@ def run_cluster(trainers, steps, tmpdir, sparse=False, geo=False,
         touts.append(out)
         trainer_procs.append(spawn(
             f"t{tid}", [sys.executable, WORKLOAD, "trainer", ep, str(tid),
-                        str(trainers), str(steps), out] +
-            (["--sparse"] if sparse else []) +
-            (["--geo"] if geo else [])))
+                        str(trainers), str(steps), out] + flags))
     try:
         for tid, p in enumerate(trainer_procs):
             p.wait(timeout=timeout)
             if p.returncode != 0:
                 raise RuntimeError("trainer failed:\n" + log_tail(f"t{tid}"))
-        ps.wait(timeout=30)
+        for psp, _ in ps_procs:
+            psp.wait(timeout=30)
     finally:
         for p in procs:
             if p.poll() is None:
@@ -195,3 +201,106 @@ def test_async_communicator_merges_sends():
     finally:
         srv.shutdown()
         VarClient.reset_pool()
+
+
+def test_trainer_failure_detection(tmp_path):
+    """Kill a trainer mid-run: the pserver's HeartBeatMonitor flags it,
+    the server keeps serving, and the surviving trainer completes
+    (reference: operators/distributed/heart_beat_monitor.h:54)."""
+    ep = f"127.0.0.1:{free_port()}"
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep +
+               os.environ.get("PYTHONPATH", ""), JAX_PLATFORMS="cpu",
+               PADDLE_PS_HEARTBEAT_TIMEOUT="3")
+    logs = {}
+
+    def spawn(tag, args):
+        log = open(os.path.join(str(tmp_path), tag + ".log"), "wb+")
+        logs[tag] = log
+        return subprocess.Popen(args, env=env, stdout=log, stderr=log)
+
+    def tail(tag):
+        logs[tag].flush(); logs[tag].seek(0)
+        return logs[tag].read().decode(errors="replace")[-3000:]
+
+    ps_out = os.path.join(str(tmp_path), "ps.ready")
+    ps = spawn("ps", [sys.executable, WORKLOAD, "pserver", ep, "0", "2",
+                      "40", ps_out, "--geo"])
+    deadline = time.time() + 90
+    while not os.path.exists(ps_out):
+        assert ps.poll() is None, "pserver died:\n" + tail("ps")
+        assert time.time() < deadline, "pserver not ready:\n" + tail("ps")
+        time.sleep(0.2)
+
+    t0_out = os.path.join(str(tmp_path), "t0.json")
+    t0 = spawn("t0", [sys.executable, WORKLOAD, "trainer", ep, "0", "2",
+                      "40", t0_out, "--geo", "--step-sleep=0.3",
+                      "--no-stop"])
+    t1 = spawn("t1", [sys.executable, WORKLOAD, "trainer", ep, "1", "2",
+                      "40", os.path.join(str(tmp_path), "t1.json"),
+                      "--geo", "--step-sleep=0.3", "--die-after=3"])
+    try:
+        t1.wait(timeout=120)
+        assert t1.returncode == 1, tail("t1")  # simulated crash
+
+        from paddle_tpu.fluid.ps_rpc import VarClient
+        cli = VarClient.of(ep)
+        deadline = time.time() + 45
+        dead = []
+        while time.time() < deadline:
+            dead = list(cli.call("dead_workers"))
+            if 1 in dead:
+                break
+            time.sleep(0.5)
+        assert 1 in dead, (dead, tail("ps"))
+        assert 0 not in dead, dead  # the live trainer keeps beating
+
+        t0.wait(timeout=240)
+        assert t0.returncode == 0, tail("t0")
+        losses = json.load(open(t0_out))
+        assert losses[-1] < losses[0] * 0.5, losses
+        # server survived the whole episode and still serves parameters
+        w = np.asarray(cli.call("get_var", name="w"))
+        assert w.shape == (4, 1) and np.isfinite(w).all()
+        cli.stop()
+        ps.wait(timeout=30)
+    finally:
+        for p in (ps, t0, t1):
+            if p.poll() is None:
+                p.kill()
+        for log in logs.values():
+            log.close()
+
+
+def test_ps_billion_param_lazy_sparse_table(tmp_path):
+    """Beyond-HBM sparse scale (reference fleet_wrapper.h:86-190): a
+    [62.5M, 16] = 1e9-float logical embedding (4GB dense) row-sharded
+    over TWO pservers as init-on-touch LazyEmbeddingTable — training
+    converges while each pserver materializes only the rows actually
+    touched."""
+    (res,) = run_cluster(1, 20, str(tmp_path), sparse=True, n_pservers=2,
+                         extra_args=["--sparse-dim=62500000",
+                                     "--emb-dim=16", "--stats"],
+                         timeout=300)
+    losses, stats = res["losses"], res["stats"]
+    assert losses[-1] < losses[0] * 0.5, losses
+    total_logical = sum(s["logical_params"] for s in stats)
+    assert total_logical >= 2 * int(1e9)  # each shard spans the table
+    touched = sum(s["touched"] for s in stats)
+    assert 0 < touched <= 8, stats       # only the 8 distinct ids exist
+    assert sum(s["nbytes"] for s in stats) < 1 << 20, stats
+    # both shards served ids (the id spread hits both parities)
+    assert all(s["touched"] > 0 for s in stats), stats
+
+
+def test_ps_lazy_table_eviction_bound(tmp_path):
+    """The LRU bound caps pserver memory: with max_rows=4 and 8 distinct
+    ids, rows are evicted and the resident count never exceeds the bound
+    (the reference's shrink()/eviction trade)."""
+    (res,) = run_cluster(1, 6, str(tmp_path), sparse=True, n_pservers=1,
+                         extra_args=["--sparse-dim=80000000",
+                                     "--emb-dim=8", "--max-rows=4",
+                                     "--stats"],
+                         timeout=300)
+    (stats,) = res["stats"]
+    assert stats["touched"] <= 4, stats
+    assert stats["evictions"] > 0, stats

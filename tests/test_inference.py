@@ -68,3 +68,83 @@ def test_load_inference_model_executor_path(tmp_path):
         assert feeds == ["x"]
         (got,) = exe.run(prog, feed={"x": X}, fetch_list=fetches)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_from_memory_buffers_golden_format():
+    """SetModelBuffer path: serve a model whose ProgramDesc + params are
+    reference-format byte buffers (the golden fixtures were produced
+    independently via protoc over the reference framework.proto)."""
+    import os
+    from paddle_tpu import inference
+    fix = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures")
+    prog_bytes = open(os.path.join(fix, "golden_fc.program.pb"),
+                      "rb").read()
+    params = (open(os.path.join(fix, "golden_fc_b.tensor"), "rb").read()
+              + open(os.path.join(fix, "golden_fc_w.tensor"), "rb").read())
+    # params stream order = sorted persistable names: fc_b then fc_w
+    cfg = inference.Config()
+    cfg.set_model_buffer(prog_bytes, params)
+    assert cfg.model_from_memory()
+    pred = inference.create_predictor(cfg)
+    exp = np.load(os.path.join(fix, "golden_expected.npz"))
+    x = np.random.RandomState(3).rand(5, 4).astype("float32")
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(out, x @ exp["w"] + exp["b"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_clone_shares_weights(tmp_path):
+    from paddle_tpu import inference
+    d = str(tmp_path / "m1")
+    train_and_save(d)
+    cfg = inference.Config(d)
+    p1 = inference.create_predictor(cfg)
+    p2 = p1.clone()
+    assert p2._scope is p1._scope  # zero weight duplication
+    x = np.random.rand(2, 4).astype("float32")
+    np.testing.assert_allclose(p1.run([x])[0], p2.run([x])[0], rtol=1e-6)
+    pool = inference.PredictorPool(cfg, size=3)
+    assert pool.size() == 3
+    np.testing.assert_allclose(pool.retrieve(2).run([x])[0],
+                               p1.run([x])[0], rtol=1e-6)
+
+
+def test_pass_builder_customization(tmp_path):
+    from paddle_tpu import inference
+    d = str(tmp_path / "m2")
+    train_and_save(d)
+    cfg = inference.Config(d)
+    pb = cfg.pass_builder()
+    n0 = len(pb.all_passes())
+    pb.delete_pass("fc_fuse_pass")
+    assert len(pb.all_passes()) == n0 - 1
+    pred = inference.create_predictor(cfg)
+    # without fc_fuse_pass the mul+elementwise_add stay decomposed
+    types = [op.type for op in pred._program.global_block().ops]
+    assert "fc" not in types and "mul" in types
+    x = np.random.rand(2, 4).astype("float32")
+    assert pred.run([x])[0].shape == (2, 1)
+    import pytest
+    with pytest.raises(ValueError):
+        pb.append_pass("not_a_real_pass")
+
+
+def test_predictor_misc_api(tmp_path):
+    from paddle_tpu import inference
+    d = str(tmp_path / "m3")
+    train_and_save(d)
+    cfg = inference.Config(d)
+    cfg.enable_bf16()
+    assert cfg.bf16_enabled()
+    pred = inference.create_predictor(cfg)
+    shapes = pred.get_input_tensor_shape()
+    assert list(shapes) == pred.get_input_names()
+    x = np.random.rand(2, 4).astype("float32")
+    y1 = pred.run([x])[0]
+    pred.try_shrink_memory()
+    y2 = pred.run([x])[0]
+    np.testing.assert_allclose(np.asarray(y1, np.float32),
+                               np.asarray(y2, np.float32), rtol=1e-2)
+    from paddle_tpu.fluid import core
+    core.set_flag("FLAGS_use_bf16_matmul", False)  # reset global

@@ -55,3 +55,32 @@ def test_launch_propagates_worker_failure(tmp_path):
          "--nproc=2", "--start_port=7711", str(bad)],
         env=env, capture_output=True, timeout=120)
     assert res.returncode != 0
+
+
+def test_two_process_dp_matches_single_process(tmp_path):
+    """The reference's N-vs-1 oracle (test_dist_base.py:933): the same
+    model trained on a 2-process 4-device jax.distributed CPU mesh through
+    the launcher must produce the same per-step losses as one process."""
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["PYTHONPATH"] = REPO
+    workload = os.path.join(REPO, "tests", "dist_dp_workload.py")
+
+    multi_out = tmp_path / "multi.json"
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc=2", "--start_port=7821", workload, str(multi_out)],
+        env=env, capture_output=True, timeout=420)
+    assert res.returncode == 0, res.stderr.decode()[-3000:]
+    assert multi_out.exists(), res.stderr.decode()[-3000:]
+
+    single_out = tmp_path / "single.json"
+    res1 = subprocess.run(
+        [sys.executable, workload, str(single_out)],
+        env=env, capture_output=True, timeout=420)
+    assert res1.returncode == 0, res1.stderr.decode()[-3000:]
+
+    multi = json.load(open(multi_out))
+    single = json.load(open(single_out))
+    assert len(multi) == len(single) == 5
+    for a, b in zip(multi, single):
+        assert abs(a - b) < 1e-4, (multi, single)

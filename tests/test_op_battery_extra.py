@@ -1,0 +1,740 @@
+"""Second half of the op-registry battery: ops that need program context
+(LoD feeds, tensor arrays, control flow, SelectedRows, RPC-free
+single-device collectives), optimizer update rules vs their numpy
+formulas, and statistical checks for random ops (reference contract:
+unittests/op_test.py + the per-op test files it serves)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from op_test import OpTest
+
+rng = np.random.RandomState(7)
+
+
+def _run_single_op(op_type, inputs, attrs, out_slots, lod=None):
+    """Build one-op program, feed numpy/LoDTensors, fetch out_slots."""
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        block = prog.global_block()
+        in_names = {}
+        for slot, val in inputs.items():
+            if isinstance(val, list):
+                names = []
+                for i, arr in enumerate(val):
+                    nm = f"{slot}_{i}"
+                    block.create_var(name=nm, shape=np.asarray(arr).shape,
+                                     dtype=core.np_to_dtype(
+                                         np.asarray(arr).dtype))
+                    names.append(nm)
+                in_names[slot] = names
+            else:
+                arr = np.asarray(val.array if isinstance(val, core.LoDTensor)
+                                 else val)
+                block.create_var(name=f"{slot}_in", shape=arr.shape,
+                                 dtype=core.np_to_dtype(arr.dtype))
+                in_names[slot] = [f"{slot}_in"]
+        out_names = {}
+        for slot in out_slots:
+            block.create_var(name=f"{slot}_out")
+            out_names[slot] = [f"{slot}_out"]
+        block.append_op(type=op_type, inputs=in_names, outputs=out_names,
+                        attrs=attrs)
+    feed = {}
+    for slot, val in inputs.items():
+        if isinstance(val, list):
+            for i, arr in enumerate(val):
+                feed[f"{slot}_{i}"] = np.asarray(arr)
+        else:
+            feed[f"{slot}_in"] = val
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        return exe.run(prog, feed=feed,
+                       fetch_list=[f"{s}_out" for s in out_slots])
+
+
+# --------------------------------------------------------------- exact refs
+def test_accuracy():
+    out = rng.rand(4, 3).astype(np.float32)
+    idx = np.asarray([[2], [0], [1], [2]], np.int64)
+    lbl = np.asarray([[2], [1], [1], [0]], np.int64)
+    (acc,) = _run_single_op("accuracy",
+                            {"Out": out, "Indices": idx, "Label": lbl},
+                            {}, ["Accuracy"])
+    np.testing.assert_allclose(np.asarray(acc), [0.5], atol=1e-6)
+
+
+def test_argsort_and_topk():
+    x = np.asarray([[3., 1., 2.], [0., 5., 4.]], np.float32)
+    o, i = _run_single_op("argsort", {"X": x}, {"axis": -1},
+                          ["Out", "Indices"])
+    np.testing.assert_array_equal(np.asarray(o), np.sort(x, -1))
+    np.testing.assert_array_equal(np.asarray(i), np.argsort(x, -1))
+    o, i = _run_single_op("top_k_v2", {"X": x}, {"k": 2, "axis": -1},
+                          ["Out", "Indices"])
+    np.testing.assert_array_equal(np.asarray(o),
+                                  [[3., 2.], [5., 4.]])
+
+
+def test_add_position_encoding_alpha_only():
+    x = rng.rand(2, 4, 6).astype(np.float32)
+    (o,) = _run_single_op("add_position_encoding", {"X": x},
+                          {"alpha": 1.0, "beta": 0.0}, ["Out"])
+    np.testing.assert_allclose(np.asarray(o), x, atol=1e-6)
+
+
+def test_affine_channel():
+    x = rng.rand(2, 3, 2, 2).astype(np.float32)
+    s = rng.rand(3).astype(np.float32)
+    b = rng.rand(3).astype(np.float32)
+    (o,) = _run_single_op("affine_channel",
+                          {"X": x, "Scale": s, "Bias": b},
+                          {"data_layout": "NCHW"}, ["Out"])
+    np.testing.assert_allclose(
+        np.asarray(o), x * s[None, :, None, None] + b[None, :, None, None],
+        rtol=1e-5)
+
+
+def test_interp_identity_size():
+    x = rng.rand(1, 2, 4, 4).astype(np.float32)
+    for op in ("bilinear_interp", "nearest_interp"):
+        (o,) = _run_single_op(op, {"X": x},
+                              {"out_h": 4, "out_w": 4,
+                               "align_corners": True}, ["Out"])
+        np.testing.assert_allclose(np.asarray(o), x, atol=1e-5,
+                                   err_msg=op)
+
+
+def test_bilinear_tensor_product():
+    x = rng.rand(2, 3).astype(np.float32)
+    y = rng.rand(2, 4).astype(np.float32)
+    w = rng.rand(5, 3, 4).astype(np.float32)
+    b = rng.rand(1, 5).astype(np.float32)
+    (o,) = _run_single_op("bilinear_tensor_product",
+                          {"X": x, "Y": y, "Weight": w, "Bias": b}, {},
+                          ["Out"])
+    ref = np.einsum("nd,kde,ne->nk", x, w, y) + b
+    np.testing.assert_allclose(np.asarray(o), ref, rtol=1e-4)
+
+
+def test_conv3d_pointwise():
+    x = rng.rand(1, 2, 3, 3, 3).astype(np.float32)
+    f = rng.rand(4, 2, 1, 1, 1).astype(np.float32)
+    (o,) = _run_single_op("conv3d", {"Input": x, "Filter": f},
+                          {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                           "dilations": [1, 1, 1], "groups": 1},
+                          ["Output"])
+    ref = np.einsum("ncdhw,kc->nkdhw", x, f[:, :, 0, 0, 0])
+    np.testing.assert_allclose(np.asarray(o), ref, rtol=1e-4)
+
+
+def test_cvm_no_use():
+    x = rng.rand(3, 5).astype(np.float32)
+    cvm = np.ones((3, 2), np.float32)
+    (y,) = _run_single_op("cvm", {"X": x, "CVM": cvm},
+                          {"use_cvm": False}, ["Y"])
+    np.testing.assert_allclose(np.asarray(y), x[:, 2:], rtol=1e-6)
+
+
+def test_dgc_clip_by_norm_past_rampup():
+    x = rng.rand(2, 3).astype(np.float32)
+    step = np.asarray([5.0], np.float32)
+    (o,) = _run_single_op("dgc_clip_by_norm",
+                          {"X": x, "current_step": step},
+                          {"max_norm": 0.1, "rampup_begin_step": 0.0},
+                          ["Out"])
+    norm = np.linalg.norm(x.ravel())
+    np.testing.assert_allclose(np.asarray(o), x * (0.1 / norm), rtol=1e-4)
+
+
+def test_fsp():
+    x = rng.rand(2, 3, 4, 5).astype(np.float32)
+    y = rng.rand(2, 6, 4, 5).astype(np.float32)
+    (o,) = _run_single_op("fsp", {"X": x, "Y": y}, {}, ["Out"])
+    xf = x.reshape(2, 3, 20)
+    yf = y.reshape(2, 6, 20)
+    np.testing.assert_allclose(np.asarray(o),
+                               np.einsum("nch,ndh->ncd", xf, yf) / 20,
+                               rtol=1e-4)
+
+
+def test_fake_quant_dequant_family():
+    x = rng.uniform(-1, 1, (2, 4)).astype(np.float32)
+    scale = np.abs(x).max()
+    (q, s) = _run_single_op("fake_quantize_range_abs_max",
+                            {"X": x, "InScale": np.asarray([0.0],
+                                                           np.float32)},
+                            {"bit_length": 8, "is_test": False},
+                            ["Out", "OutScale"])
+    np.testing.assert_allclose(np.asarray(s), [scale], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(q) * scale / 127.0, x,
+                               atol=scale / 127.0 + 1e-6)
+    (dq,) = _run_single_op("fake_dequantize_max_abs",
+                           {"X": np.asarray(q), "Scale": np.asarray(
+                               [scale], np.float32)},
+                           {"max_range": 127.0}, ["Out"])
+    np.testing.assert_allclose(np.asarray(dq), x, atol=scale / 120.0)
+    (qc, sc) = _run_single_op("fake_channel_wise_quantize_abs_max",
+                              {"X": x}, {"bit_length": 8, "quant_axis": 0},
+                              ["Out", "OutScale"])
+    np.testing.assert_allclose(np.asarray(sc), np.abs(x).max(1), rtol=1e-5)
+    (qm, sm) = _run_single_op("fake_quantize_moving_average_abs_max",
+                              {"X": x, "InScale": np.asarray([scale],
+                                                             np.float32)},
+                              {"bit_length": 8, "is_test": False,
+                               "moving_rate": 0.9}, ["Out", "OutScale"])
+    assert np.isfinite(np.asarray(qm)).all()
+
+
+def test_hash_properties():
+    x = np.asarray([[1], [7], [1]], np.int64)
+    (h1,) = _run_single_op("hash", {"X": x},
+                           {"num_hash": 2, "mod_by": 1000}, ["Out"])
+    (h2,) = _run_single_op("hash", {"X": x},
+                           {"num_hash": 2, "mod_by": 1000}, ["Out"])
+    h1, h2 = np.asarray(h1), np.asarray(h2)
+    np.testing.assert_array_equal(h1, h2)      # deterministic
+    assert h1.shape == (3, 2, 1)
+    assert (0 <= h1).all() and (h1 < 1000).all()
+    np.testing.assert_array_equal(h1[0], h1[2])  # same key → same hash
+
+
+def test_iou_similarity():
+    a = np.asarray([[0., 0., 2., 2.]], np.float32)
+    b = np.asarray([[1., 1., 3., 3.], [0., 0., 2., 2.]], np.float32)
+    (o,) = _run_single_op("iou_similarity", {"X": a, "Y": b},
+                          {"box_normalized": True}, ["Out"])
+    np.testing.assert_allclose(np.asarray(o), [[1. / 7., 1.0]], rtol=1e-4)
+
+
+def test_maxout():
+    x = rng.rand(2, 6, 2, 2).astype(np.float32)
+    (o,) = _run_single_op("maxout", {"X": x}, {"groups": 3, "axis": 1},
+                          ["Out"])
+    ref = x.reshape(2, 2, 3, 2, 2).max(2)
+    np.testing.assert_allclose(np.asarray(o), ref, rtol=1e-5)
+
+
+def test_mean_iou():
+    pred = np.asarray([0, 1, 1, 0], np.int64)
+    lbl = np.asarray([0, 1, 0, 0], np.int64)
+    (miou,) = _run_single_op("mean_iou",
+                             {"Predictions": pred, "Labels": lbl},
+                             {"num_classes": 2}, ["OutMeanIou"])
+    # class0: inter 2, union 3; class1: inter 1, union 2
+    np.testing.assert_allclose(np.asarray(miou),
+                               [(2 / 3 + 1 / 2) / 2], rtol=1e-4)
+
+
+def test_pixel_shuffle_space_to_depth_shuffle_channel():
+    x = rng.rand(1, 4, 2, 2).astype(np.float32)
+    (o,) = _run_single_op("pixel_shuffle", {"X": x},
+                          {"upscale_factor": 2}, ["Out"])
+    ref = x.reshape(1, 1, 2, 2, 2, 2).transpose(
+        0, 1, 4, 2, 5, 3).reshape(1, 1, 4, 4)
+    np.testing.assert_allclose(np.asarray(o), ref, rtol=1e-5)
+    (back,) = _run_single_op("space_to_depth", {"X": np.asarray(ref)},
+                             {"blocksize": 2}, ["Out"])
+    assert np.asarray(back).shape == (1, 4, 2, 2)
+    (sc,) = _run_single_op("shuffle_channel", {"X": x}, {"group": 2},
+                           ["Out"])
+    ref_sc = x.reshape(1, 2, 2, 2, 2).transpose(0, 2, 1, 3, 4).reshape(
+        1, 4, 2, 2)
+    np.testing.assert_allclose(np.asarray(sc), ref_sc, rtol=1e-5)
+
+
+def test_temporal_shift():
+    x = rng.rand(4, 4, 2, 2).astype(np.float32)  # N*T with T=2
+    (o,) = _run_single_op("temporal_shift", {"X": x},
+                          {"seg_num": 2, "shift_ratio": 0.25}, ["Out"])
+    o = np.asarray(o)
+    assert o.shape == x.shape
+    # fold ratio of channels shifts along T; untouched middle channels stay
+    xt = x.reshape(2, 2, 4, 2, 2)
+    ot = o.reshape(2, 2, 4, 2, 2)
+    np.testing.assert_allclose(ot[:, :, 2:3], xt[:, :, 2:3], rtol=1e-5)
+
+
+def test_unfold():
+    x = rng.rand(1, 2, 3, 3).astype(np.float32)
+    (y,) = _run_single_op("unfold", {"X": x},
+                          {"kernel_sizes": [2, 2], "strides": [1, 1],
+                           "paddings": [0, 0, 0, 0], "dilations": [1, 1]},
+                          ["Y"])
+    y = np.asarray(y)
+    assert y.shape == (1, 8, 4)
+    # first output column = the top-left 2x2 patch, channel-major
+    patch = x[0, :, 0:2, 0:2].reshape(-1)
+    np.testing.assert_allclose(y[0, :, 0], patch, rtol=1e-5)
+
+
+def test_sigmoid_focal_loss():
+    x = rng.uniform(-1, 1, (3, 2)).astype(np.float32)
+    lbl = np.asarray([[1], [0], [2]], np.int32)
+    fg = np.asarray([[2]], np.int32)
+    (o,) = _run_single_op("sigmoid_focal_loss",
+                          {"X": x, "Label": lbl, "FgNum": fg},
+                          {"gamma": 2.0, "alpha": 0.25}, ["Out"])
+    p = 1 / (1 + np.exp(-x))
+    pos = np.zeros_like(x, bool)
+    for i in range(3):
+        if lbl[i, 0] > 0:
+            pos[i, lbl[i, 0] - 1] = True
+    p_t = np.where(pos, p, 1 - p)
+    a_t = np.where(pos, 0.25, 0.75)
+    ref = a_t * (1 - p_t) ** 2.0 * -np.log(np.clip(p_t, 1e-8, 1)) / 2
+    np.testing.assert_allclose(np.asarray(o), ref, rtol=1e-3, atol=1e-5)
+
+
+def test_tree_conv_zero_filter():
+    nodes = rng.rand(1, 4, 3).astype(np.float32)
+    edges = np.asarray([[[0, 1], [0, 2], [2, 3]]], np.int32)
+    filt = np.zeros((3, 3, 2, 5), np.float32)
+    (o,) = _run_single_op("tree_conv",
+                          {"NodesVector": nodes, "EdgeSet": edges,
+                           "Filter": filt}, {"max_depth": 2}, ["Out"])
+    assert np.allclose(np.asarray(o), 0.0)
+
+
+def test_lstm_unit():
+    x = rng.rand(2, 12).astype(np.float32)  # gates i,f,c,o for hidden 3
+    c_prev = rng.rand(2, 3).astype(np.float32)
+    (c, h) = _run_single_op("lstm_unit", {"X": x, "C_prev": c_prev},
+                            {"forget_bias": 0.0}, ["C", "H"])
+    i, f, cc, o = np.split(x, 4, 1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    ref_c = sig(f) * c_prev + sig(i) * np.tanh(cc)
+    ref_h = sig(o) * np.tanh(ref_c)
+    np.testing.assert_allclose(np.asarray(c), ref_c, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(h), ref_h, rtol=1e-4)
+
+
+def test_beam_search_decode_single_beam():
+    """Two steps, single source, single beam: the decoded hypothesis is
+    the token chain [3, 5] with the final step's score."""
+    step = lambda v, s: (core.LoDTensor(np.asarray([[v]], np.int64),
+                                        lod=[[0, 1], [0, 1]]),
+                         core.LoDTensor(np.asarray([[s]], np.float32),
+                                        lod=[[0, 1], [0, 1]]))
+    (i0, s0), (i1, s1) = step(3, 0.5), step(5, 0.7)
+    scope = core.Scope()
+    scope.var("ta_ids").set_value([i0, i1])
+    scope.var("ta_scores").set_value([s0, s1])
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        b = prog.global_block()
+        for n in ("ta_ids", "ta_scores", "sent_ids", "sent_scores"):
+            b.create_var(name=n)
+        b.append_op(type="beam_search_decode",
+                    inputs={"Ids": ["ta_ids"], "Scores": ["ta_scores"]},
+                    outputs={"SentenceIds": ["sent_ids"],
+                             "SentenceScores": ["sent_scores"]},
+                    attrs={"beam_size": 1, "end_id": 0})
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        (ids, sc) = exe.run(prog, feed={},
+                            fetch_list=["sent_ids", "sent_scores"])
+    np.testing.assert_array_equal(np.asarray(ids).ravel(), [3, 5])
+    np.testing.assert_allclose(np.asarray(sc).ravel(), [0.7, 0.7],
+                               rtol=1e-6)
+
+
+# ------------------------------------------------------------- optimizers
+def _opt_inputs(shape=(3,)):
+    p = rng.rand(*shape).astype(np.float32)
+    g = rng.rand(*shape).astype(np.float32)
+    lr = np.asarray([0.1], np.float32)
+    return p, g, lr
+
+
+def test_adagrad():
+    p, g, lr = _opt_inputs()
+    m = np.zeros_like(p) + 0.5
+    (po, mo) = _run_single_op(
+        "adagrad", {"Param": p, "Grad": g, "Moment": m,
+                    "LearningRate": lr},
+        {"epsilon": 1e-6}, ["ParamOut", "MomentOut"])
+    m_new = m + g * g
+    np.testing.assert_allclose(np.asarray(mo), m_new, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(po),
+                               p - 0.1 * g / (np.sqrt(m_new) + 1e-6),
+                               rtol=1e-5)
+
+
+def test_decayed_adagrad():
+    p, g, lr = _opt_inputs()
+    m = np.zeros_like(p) + 0.5
+    (po,) = _run_single_op(
+        "decayed_adagrad", {"Param": p, "Grad": g, "Moment": m,
+                            "LearningRate": lr},
+        {"decay": 0.95, "epsilon": 1e-6}, ["ParamOut"])
+    m_new = 0.95 * m + 0.05 * g * g
+    np.testing.assert_allclose(np.asarray(po),
+                               p - 0.1 * g / (np.sqrt(m_new) + 1e-6),
+                               rtol=1e-5)
+
+
+def test_adadelta():
+    p, g, lr = _opt_inputs()
+    ag = np.zeros_like(p) + 0.3
+    au = np.zeros_like(p) + 0.2
+    (po,) = _run_single_op(
+        "adadelta", {"Param": p, "Grad": g, "AvgSquaredGrad": ag,
+                     "AvgSquaredUpdate": au},
+        {"rho": 0.95, "epsilon": 1e-6}, ["ParamOut"])
+    ag_n = 0.95 * ag + 0.05 * g * g
+    upd = -np.sqrt((au + 1e-6) / (ag_n + 1e-6)) * g
+    np.testing.assert_allclose(np.asarray(po), p + upd, rtol=1e-4)
+
+
+def test_adamax():
+    p, g, lr = _opt_inputs()
+    m = np.zeros_like(p)
+    inf = np.zeros_like(p)
+    b1p = np.asarray([0.9], np.float32)
+    (po,) = _run_single_op(
+        "adamax", {"Param": p, "Grad": g, "Moment": m, "InfNorm": inf,
+                   "LearningRate": lr, "Beta1Pow": b1p},
+        {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8}, ["ParamOut"])
+    m_n = 0.9 * m + 0.1 * g
+    inf_n = np.maximum(0.999 * inf, np.abs(g))
+    ref = p - (0.1 / (1 - 0.9)) * m_n / (inf_n + 1e-8)
+    np.testing.assert_allclose(np.asarray(po), ref, rtol=1e-4)
+
+
+def test_rmsprop():
+    p, g, lr = _opt_inputs()
+    ms = np.zeros_like(p) + 0.4
+    mom = np.zeros_like(p)
+    (po,) = _run_single_op(
+        "rmsprop", {"Param": p, "Grad": g, "MeanSquare": ms,
+                    "Moment": mom, "LearningRate": lr,
+                    "MeanGrad": np.zeros_like(p)},
+        {"decay": 0.9, "momentum": 0.0, "epsilon": 1e-10}, ["ParamOut"])
+    ms_n = 0.9 * ms + 0.1 * g * g
+    np.testing.assert_allclose(np.asarray(po),
+                               p - 0.1 * g / np.sqrt(ms_n + 1e-10),
+                               rtol=1e-4)
+
+
+def test_ftrl():
+    p, g, lr = _opt_inputs()
+    sq = np.zeros_like(p) + 0.2
+    lin = np.zeros_like(p)
+    (po,) = _run_single_op(
+        "ftrl", {"Param": p, "Grad": g, "SquaredAccumulator": sq,
+                 "LinearAccumulator": lin, "LearningRate": lr},
+        {"l1": 0.0, "l2": 0.0, "lr_power": -0.5}, ["ParamOut"])
+    assert np.isfinite(np.asarray(po)).all()
+    assert not np.allclose(np.asarray(po), p)
+
+
+def test_lars_momentum():
+    p, g, lr = _opt_inputs()
+    v = np.zeros_like(p)
+    (po,) = _run_single_op(
+        "lars_momentum", {"Param": p, "Grad": g, "Velocity": v,
+                          "LearningRate": lr},
+        {"mu": 0.9, "lars_coeff": 0.001, "lars_weight_decay": 0.0005},
+        ["ParamOut"])
+    local_lr = 0.1 * 0.001 * np.linalg.norm(p) / (
+        np.linalg.norm(g) + 0.0005 * np.linalg.norm(p))
+    v_new = 0.9 * v + local_lr * (g + 0.0005 * p)
+    np.testing.assert_allclose(np.asarray(po), p - v_new, rtol=1e-3)
+
+
+def test_proximal_ops():
+    p, g, lr = _opt_inputs()
+    (po,) = _run_single_op("proximal_gd",
+                           {"Param": p, "Grad": g, "LearningRate": lr},
+                           {"l1": 0.0, "l2": 0.0}, ["ParamOut"])
+    np.testing.assert_allclose(np.asarray(po), p - 0.1 * g, rtol=1e-5)
+    m = np.zeros_like(p) + 0.2
+    (po2,) = _run_single_op(
+        "proximal_adagrad", {"Param": p, "Grad": g, "Moment": m,
+                             "LearningRate": lr},
+        {"l1": 0.0, "l2": 0.0}, ["ParamOut"])
+    m_n = m + g * g
+    np.testing.assert_allclose(np.asarray(po2),
+                               p - 0.1 / np.sqrt(m_n) * g, rtol=1e-4)
+
+
+def test_dpsgd_sigma_zero():
+    p, g, lr = _opt_inputs()
+    (po,) = _run_single_op("dpsgd",
+                           {"Param": p, "Grad": g, "LearningRate": lr},
+                           {"clip": 1e9, "batch_size": 1.0, "sigma": 0.0},
+                           ["ParamOut"])
+    np.testing.assert_allclose(np.asarray(po), p - 0.1 * g, rtol=1e-4)
+
+
+def test_lamb():
+    p, g, lr = _opt_inputs()
+    (po,) = _run_single_op(
+        "lamb", {"Param": p, "Grad": g, "Moment1": np.zeros_like(p),
+                 "Moment2": np.zeros_like(p), "LearningRate": lr,
+                 "Beta1Pow": np.asarray([0.9], np.float32),
+                 "Beta2Pow": np.asarray([0.999], np.float32)},
+        {"weight_decay": 0.0, "beta1": 0.9, "beta2": 0.999,
+         "epsilon": 1e-6}, ["ParamOut"])
+    po = np.asarray(po)
+    assert np.isfinite(po).all() and not np.allclose(po, p)
+    # update direction opposes the gradient (all-positive grads here)
+    assert (po <= p + 1e-7).all()
+
+
+def test_average_accumulates():
+    p, g, lr = _opt_inputs()
+    outs = _run_single_op(
+        "average_accumulates",
+        {"param": p, "in_sum_1": np.zeros_like(p),
+         "in_sum_2": np.zeros_like(p), "in_sum_3": np.zeros_like(p),
+         "in_num_accumulates": np.asarray([0], np.int64),
+         "in_old_num_accumulates": np.asarray([0], np.int64),
+         "in_num_updates": np.asarray([0], np.int64)},
+        {"average_window": 10.0, "max_average_window": 100,
+         "min_average_window": 1},
+        ["out_sum_1", "out_num_accumulates"])
+    np.testing.assert_allclose(np.asarray(outs[0]), p, rtol=1e-6)
+
+
+# ------------------------------------------------------------ random ops
+def test_random_ops_stats_and_shapes():
+    (g,) = _run_single_op("gaussian_random", {},
+                          {"shape": [2000], "mean": 1.0, "std": 2.0,
+                           "dtype": 5}, ["Out"])
+    g = np.asarray(g)
+    assert abs(g.mean() - 1.0) < 0.2 and abs(g.std() - 2.0) < 0.2
+    (t,) = _run_single_op("truncated_gaussian_random", {},
+                          {"shape": [2000], "mean": 0.0, "std": 1.0,
+                           "dtype": 5}, ["Out"])
+    t = np.asarray(t)
+    assert np.abs(t).max() <= 2.0 + 1e-5  # truncated at 2 std
+    (r,) = _run_single_op("randint", {},
+                          {"shape": [1000], "low": 3, "high": 7,
+                           "dtype": 3}, ["Out"])
+    r = np.asarray(r)
+    assert r.min() >= 3 and r.max() < 7
+    (perm,) = _run_single_op("randperm", {}, {"n": 50, "dtype": 3},
+                             ["Out"])
+    np.testing.assert_array_equal(np.sort(np.asarray(perm)),
+                                  np.arange(50))
+    x = rng.rand(4, 6).astype(np.float32)
+    (u,) = _run_single_op("uniform_random_batch_size_like", {"Input": x},
+                          {"shape": [0, 8], "min": -1.0, "max": 1.0,
+                           "dtype": 5}, ["Out"])
+    u = np.asarray(u)
+    assert u.shape == (4, 8) and u.min() >= -1 and u.max() <= 1
+    (gb,) = _run_single_op("gaussian_random_batch_size_like",
+                           {"Input": x}, {"shape": [0, 8], "dtype": 5},
+                           ["Out"])
+    assert np.asarray(gb).shape == (4, 8)
+    img = rng.rand(3, 8, 8).astype(np.float32)
+    (c,) = _run_single_op("random_crop", {"X": img, "Seed": np.asarray(
+        [1], np.int64)}, {"shape": [3, 5, 5], "startup_seed": 1}, ["Out"])
+    assert np.asarray(c).shape == (3, 5, 5)
+
+
+# ------------------------------------- LoD / sequence / SelectedRows ops
+def test_sequence_mask():
+    x = np.asarray([2, 0, 3], np.int64)
+    (y,) = _run_single_op("sequence_mask", {"X": x},
+                          {"maxlen": 4, "out_dtype": 5}, ["Y"])
+    ref = np.asarray([[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]],
+                     np.float32)
+    np.testing.assert_array_equal(np.asarray(y), ref)
+
+
+def test_unique_family():
+    x = np.asarray([2, 3, 2, 5], np.int64)
+    (o, idx) = _run_single_op("unique", {"X": x}, {"dtype": 2},
+                              ["Out", "Index"])
+    o = np.asarray(o)
+    assert set(o.tolist()) == {2, 3, 5}
+    np.testing.assert_array_equal(o[np.asarray(idx)], x)
+    (o2, _i, cnt) = _run_single_op("unique_with_counts", {"X": x},
+                                   {"dtype": 2},
+                                   ["Out", "Index", "Count"])
+    cm = dict(zip(np.asarray(o2).tolist(), np.asarray(cnt).tolist()))
+    assert cm == {2: 2, 3: 1, 5: 1}
+
+
+def test_row_conv():
+    # single sequence of length 4, lookahead window 2
+    x = rng.rand(4, 3).astype(np.float32)
+    f = rng.rand(2, 3).astype(np.float32)
+    t = core.LoDTensor(x, lod=[[0, 4]])
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        xv = fluid.data("xr", shape=[3], dtype="float32", lod_level=1)
+        fv = fluid.data("fr", shape=[3], dtype="float32")
+        out = prog.global_block().create_var(name="rc_out")
+        prog.global_block().append_op(
+            type="row_conv", inputs={"X": ["xr"], "Filter": ["fr"]},
+            outputs={"Out": ["rc_out"]}, attrs={})
+    exe = fluid.Executor()
+    with fluid.scope_guard(core.Scope()):
+        (o,) = exe.run(prog, feed={"xr": t, "fr": f},
+                       fetch_list=["rc_out"])
+    ref = np.zeros_like(x)
+    for i in range(4):
+        for j in range(2):
+            if i + j < 4:
+                ref[i] += x[i + j] * f[j]
+    np.testing.assert_allclose(np.asarray(o), ref, rtol=1e-4)
+
+
+def test_selected_rows_ops():
+    scope = core.Scope()
+    sr = core.SelectedRows(rows=[1, 1, 3], height=5)
+    sr.get_tensor().set(np.asarray([[1., 1.], [2., 2.], [3., 3.]],
+                                   np.float32))
+    scope.var("sr_in").set_value(sr)
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        b = prog.global_block()
+        b.create_var(name="sr_in")
+        b.create_var(name="merged")
+        b.create_var(name="dense")
+        b.append_op(type="merge_selected_rows", inputs={"X": ["sr_in"]},
+                    outputs={"Out": ["merged"]}, attrs={})
+        b.append_op(type="get_tensor_from_selected_rows",
+                    inputs={"X": ["merged"]}, outputs={"Out": ["dense"]},
+                    attrs={})
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(prog, feed={}, fetch_list=[])
+        merged = scope.find_var("merged").value()
+        assert sorted(merged.rows()) == [1, 3]
+        dense = np.asarray(scope.find_var("dense").value().array)
+    np.testing.assert_allclose(dense, [[3., 3.], [3., 3.]], rtol=1e-6)
+
+
+def test_split_merge_ids():
+    ids = np.asarray([[1], [4], [7]], np.int64)
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        b = prog.global_block()
+        b.create_var(name="ids_in", shape=(3, 1), dtype="int64")
+        for n in ("s0", "s1", "s2", "m_out"):
+            b.create_var(name=n)
+        b.append_op(type="split_ids", inputs={"Ids": ["ids_in"]},
+                    outputs={"Out": ["s0", "s1", "s2"]}, attrs={})
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(prog, feed={"ids_in": ids}, fetch_list=[])
+        parts = [np.asarray(scope.find_var(n).value().array)
+                 for n in ("s0", "s1", "s2")]
+    assert sorted(int(p) for part in parts for p in part.ravel()) \
+        == [1, 4, 7]
+    for shard, part in enumerate(parts):
+        assert all(int(v) % 3 == shard for v in part.ravel())
+
+
+# ------------------------------------------- single-device collectives
+@pytest.mark.parametrize("op", ["allreduce", "broadcast",
+                                "c_allreduce_min", "c_allreduce_prod",
+                                "c_sync_comm_stream"])
+def test_single_device_collectives_identity(op):
+    x = rng.rand(2, 3).astype(np.float32)
+    (o,) = _run_single_op(op, {"X": x}, {"ring_id": 0}, ["Out"])
+    np.testing.assert_allclose(np.asarray(o), x, rtol=1e-6)
+
+
+def test_comm_bootstrap_ops_no_op_single_device():
+    for op, attrs in (("c_comm_init", {"nranks": 1, "rank": 0}),
+                      ("c_gen_nccl_id", {"rank": 0}),
+                      ("gen_nccl_id", {"trainer_id": 0})):
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            prog.global_block().append_op(type=op, inputs={}, outputs={},
+                                          attrs=attrs)
+        exe = fluid.Executor()
+        with fluid.scope_guard(core.Scope()):
+            exe.run(prog, feed={}, fetch_list=[])  # must not raise
+
+
+# ---------------------------------------------- program/infra utilities
+def test_print_assert_delete_var():
+    x = np.asarray([1.0], np.float32)
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        b = prog.global_block()
+        b.create_var(name="px", shape=(1,), dtype="float32")
+        b.create_var(name="p_out")
+        b.append_op(type="print", inputs={"In": ["px"]},
+                    outputs={"Out": ["p_out"]},
+                    attrs={"message": "battery"})
+        b.append_op(type="assert", inputs={"Cond": ["px"]}, outputs={},
+                    attrs={"summarize": 1})
+        b.append_op(type="delete_var", inputs={"X": ["p_out"]},
+                    outputs={}, attrs={})
+    exe = fluid.Executor()
+    with fluid.scope_guard(core.Scope()):
+        exe.run(prog, feed={"px": x}, fetch_list=[])
+
+
+def test_save_load_ops_roundtrip(tmp_path):
+    w = rng.rand(3, 2).astype(np.float32)
+    path = str(tmp_path / "w.pdparams")
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        b = prog.global_block()
+        b.create_var(name="w_save", shape=(3, 2), dtype="float32",
+                     persistable=True)
+        b.append_op(type="save", inputs={"X": ["w_save"]}, outputs={},
+                    attrs={"file_path": path})
+    prog2 = fluid.Program()
+    with fluid.program_guard(prog2, fluid.Program()):
+        b = prog2.global_block()
+        b.create_var(name="w_load", shape=(3, 2), dtype="float32",
+                     persistable=True)
+        b.append_op(type="load", inputs={}, outputs={"Out": ["w_load"]},
+                    attrs={"file_path": path})
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        scope.var("w_save").set_value(core.LoDTensor(w))
+        exe.run(prog, feed={}, fetch_list=[])
+        exe.run(prog2, feed={}, fetch_list=[])
+        got = np.asarray(scope.find_var("w_load").value().array)
+    np.testing.assert_allclose(got, w, rtol=1e-6)
+
+    # combined save/load of two vars
+    path2 = str(tmp_path / "combined.pdparams")
+    v2 = rng.rand(2,).astype(np.float32)
+    prog3 = fluid.Program()
+    with fluid.program_guard(prog3, fluid.Program()):
+        b = prog3.global_block()
+        b.create_var(name="cw", persistable=True)
+        b.create_var(name="cv", persistable=True)
+        b.append_op(type="save_combine", inputs={"X": ["cw", "cv"]},
+                    outputs={}, attrs={"file_path": path2})
+        b.append_op(type="load_combine", inputs={},
+                    outputs={"Out": ["cw2", "cv2"]},
+                    attrs={"file_path": path2})
+        b.create_var(name="cw2", persistable=True)
+        b.create_var(name="cv2", persistable=True)
+    with fluid.scope_guard(scope):
+        scope.var("cw").set_value(core.LoDTensor(w))
+        scope.var("cv").set_value(core.LoDTensor(v2))
+        exe.run(prog3, feed={}, fetch_list=[])
+        np.testing.assert_allclose(
+            np.asarray(scope.find_var("cv2").value().array), v2)
+
+
+def test_fake_init_marks_initialized():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        b = prog.global_block()
+        b.create_var(name="fi", persistable=True)
+        b.append_op(type="fake_init", inputs={}, outputs={"Out": ["fi"]},
+                    attrs={"shape": [2, 2], "dtype": 5})
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(prog, feed={}, fetch_list=[])
+        assert scope.find_var("fi").is_initialized()

@@ -246,3 +246,24 @@ def test_sequence_train_end_to_end_compiled():
             losses.append(float(lv))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] + 1.0  # trains without blow-up
+
+
+def test_length_fetch_dtype_is_int64():
+    """Device ints are 32-bit by policy, but fetched Length must come back
+    as the declared int64 (reference sequence_pad_op.cc emits int64)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32", lod_level=1)
+        pad_value = fluid.layers.assign(np.asarray([0.0], "float32"))
+        out, length = fluid.layers.sequence_pad(x, pad_value)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    t = core.LoDTensor(np.random.rand(5, 4).astype("float32"),
+                       lod=[[0, 2, 5]])
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        vals = exe.run(main, feed={"x": t}, fetch_list=[length])
+    assert vals[0].dtype == np.int64, vals[0].dtype
+    np.testing.assert_array_equal(vals[0], [2, 3])

@@ -1,0 +1,1 @@
+"""Framework tooling (reference: tools/ — timeline, benchmarks, inspectors)."""

@@ -1,0 +1,100 @@
+"""Generate tests/fixtures/* golden wire-format blobs INDEPENDENTLY of
+paddle_tpu's serializers: the ProgramDesc/TensorDesc bytes come from the
+Google protobuf runtime over the reference framework.proto (compiled with
+protoc), and the tensor streams are hand-packed per the reference layout
+(lod_tensor.cc:220 SerializeToStream, tensor_util.cc:385 TensorToStream).
+
+Regenerate with:
+    workdir=$(mktemp -d)
+    cp <reference>/paddle/fluid/framework/framework.proto $workdir
+    sed -i 's/^syntax.*$/syntax = "proto2";/' $workdir/framework.proto
+    (cd $workdir && protoc --python_out=. framework.proto)
+    PYTHONPATH=$workdir python tools/make_golden_fixtures.py
+(the sed keeps proto2 field semantics protoc 3.21 accepts)."""
+import os
+import struct
+import sys
+
+import numpy as np
+
+import framework_pb2 as ref_pb  # protoc output from reference framework.proto
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "fixtures")
+
+FP32 = ref_pb.VarType.FP32
+LOD_TENSOR = ref_pb.VarType.LOD_TENSOR
+
+pd = ref_pb.ProgramDesc()
+pd.version.version = 0
+blk = pd.blocks.add()
+blk.idx = 0
+blk.parent_idx = -1
+
+
+def add_var(name, shape, persistable=False, need_check_feed=False):
+    v = blk.vars.add()
+    v.name = name
+    v.type.type = LOD_TENSOR
+    v.type.lod_tensor.tensor.data_type = FP32
+    v.type.lod_tensor.tensor.dims.extend(shape)
+    v.persistable = persistable
+    v.need_check_feed = need_check_feed
+    return v
+
+
+add_var("x", [-1, 4], need_check_feed=True)
+add_var("fc_w", [4, 3], persistable=True)
+add_var("fc_b", [3], persistable=True)
+add_var("tmp_mul", [-1, 3])
+add_var("out", [-1, 3])
+
+mul = blk.ops.add()
+mul.type = "mul"
+iv = mul.inputs.add(); iv.parameter = "X"; iv.arguments.append("x")
+iv = mul.inputs.add(); iv.parameter = "Y"; iv.arguments.append("fc_w")
+ov = mul.outputs.add(); ov.parameter = "Out"; ov.arguments.append("tmp_mul")
+a = mul.attrs.add(); a.name = "x_num_col_dims"; a.type = ref_pb.INT; a.i = 1
+a = mul.attrs.add(); a.name = "y_num_col_dims"; a.type = ref_pb.INT; a.i = 1
+
+add_op_add = blk.ops.add()
+add_op_add.type = "elementwise_add"
+iv = add_op_add.inputs.add(); iv.parameter = "X"; iv.arguments.append("tmp_mul")
+iv = add_op_add.inputs.add(); iv.parameter = "Y"; iv.arguments.append("fc_b")
+ov = add_op_add.outputs.add(); ov.parameter = "Out"; ov.arguments.append("out")
+a = add_op_add.attrs.add(); a.name = "axis"; a.type = ref_pb.INT; a.i = -1
+
+os.makedirs(OUT, exist_ok=True)
+with open(f"{OUT}/golden_fc.program.pb", "wb") as f:
+    f.write(pd.SerializeToString())
+
+
+def tensor_stream(arr, lod=()):
+    """Reference LoDTensor stream: lod_tensor.cc:220 SerializeToStream +
+    tensor_util.cc:385 TensorToStream."""
+    parts = [struct.pack("<I", 0), struct.pack("<Q", len(lod))]
+    for level in lod:
+        parts.append(struct.pack("<Q", len(level) * 8))
+        parts.append(np.asarray(level, np.uint64).tobytes())
+    parts.append(struct.pack("<I", 0))
+    desc = ref_pb.VarType.TensorDesc()
+    desc.data_type = FP32
+    desc.dims.extend(arr.shape)
+    db = desc.SerializeToString()
+    parts.append(struct.pack("<i", len(db)))
+    parts.append(db)
+    parts.append(np.ascontiguousarray(arr).tobytes())
+    return b"".join(parts)
+
+
+rng = np.random.RandomState(42)
+w = rng.uniform(-1, 1, (4, 3)).astype(np.float32)
+b = rng.uniform(-1, 1, (3,)).astype(np.float32)
+open(f"{OUT}/golden_fc_w.tensor", "wb").write(tensor_stream(w))
+open(f"{OUT}/golden_fc_b.tensor", "wb").write(tensor_stream(b))
+# a ragged LoDTensor fixture exercises the LoD header path
+seq = rng.uniform(-1, 1, (5, 2)).astype(np.float32)
+open(f"{OUT}/golden_seq.lodtensor", "wb").write(
+    tensor_stream(seq, lod=[[0, 2, 5]]))
+np.savez(f"{OUT}/golden_expected.npz", w=w, b=b, seq=seq)
+print("fixtures written")

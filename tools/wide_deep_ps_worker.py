@@ -1,0 +1,68 @@
+"""Wide&Deep CTR over the distributed parameter-server plane at 1e9+
+embedding parameters (reference CTR job: fleet downpour over
+fleet_wrapper.h DownpourSparseTable). Used by `bench.py wide_deep_1b`
+(trainer in-process, pservers as subprocesses of this module).
+
+The per-slot tables are marked is_distributed; above
+FLAGS_lazy_sparse_table_threshold they are hosted on every pserver as
+row-sharded init-on-touch LazyEmbeddingTable, so the 1e9-parameter
+logical size costs only O(touched rows) host RAM.
+"""
+import os
+import sys
+
+os.environ.setdefault("FLAGS_lazy_sparse_table_threshold", "1000000")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _fluid():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.fluid as fluid
+    return fluid
+
+
+def build(sparse_dim, embedding_dim=16, num_dense=13, num_slots=26,
+          hidden=(64, 64)):
+    fluid = _fluid()
+    from paddle_tpu.models import wide_deep
+    # SGD: pserver-side row updates are plain SGD on the sparse plane
+    return wide_deep.build_wide_deep_program(
+        num_dense=num_dense, num_slots=num_slots, sparse_dim=sparse_dim,
+        embedding_dim=embedding_dim, hidden=hidden, lr=1e-3,
+        is_distributed=True,
+        optimizer=fluid.optimizer.SGD(1e-3))
+
+
+def transpile(main, startup, eps, trainer_id=0, trainers=1):
+    fluid = _fluid()
+    from paddle_tpu.fluid.transpiler import DistributeTranspiler
+    t = DistributeTranspiler()
+    with fluid.program_guard(main, startup):
+        t.transpile(trainer_id=trainer_id, pservers=eps, trainers=trainers,
+                    sync_mode=True, program=main, startup_program=startup)
+    return t
+
+
+def run_pserver(eps, idx, sparse_dim):
+    fluid = _fluid()
+    from paddle_tpu.fluid import core
+    main, startup, feeds, loss, auc = build(sparse_dim)
+    t = transpile(main, startup, eps)
+    ep = eps.split(",")[idx]
+    pprog = t.get_pserver_program(ep)
+    pstart = t.get_startup_program(ep, pprog)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(pstart)
+        print("PSERVER_READY", flush=True)
+        exe.run(pprog)  # blocks until stop rpc
+
+
+if __name__ == "__main__":
+    role = sys.argv[1]
+    if role == "pserver":
+        run_pserver(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+    else:
+        raise SystemExit(f"unknown role {role!r}")
