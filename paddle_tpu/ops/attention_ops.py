@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 
 from .registry import register_op, register_grad_maker, first, out
-from .pallas.flash_attention import flash_attention, _ref_attention
+from .pallas.flash_attention import (flash_attention, _pallas_ok,
+                                     _ref_attention)
 
 
 def _split_heads(x, n_head):
@@ -39,12 +40,12 @@ def _fused_attention_qkv(ins, attrs):
     """Optional Bias: additive attention mask broadcastable to
     [B, H, Sq, Sk] (e.g. padding mask [B, 1, 1, Sk] with -inf/0).
 
-    Dispatch: the Pallas flash kernel when there is no bias and no
-    attention dropout; otherwise the einsum path (XLA fuses it), which
-    supports the additive bias and samples a dropout mask on the attention
-    probabilities (reference multi_head_attention dropout semantics).
-    Causal masking is TOP-LEFT aligned (query i sees keys <= i) on both
-    paths."""
+    Dispatch: the Pallas flash kernel whenever there is no bias —
+    attention dropout runs INSIDE the kernel (mask regenerated in the
+    backward, seeded per step from the executor rng). The einsum path
+    (XLA fuses it) serves the additive-bias case and shapes the kernel
+    doesn't cover. Causal masking is TOP-LEFT aligned (query i sees keys
+    <= i) on both paths."""
     q = first(ins, "Q")
     k = first(ins, "K")
     v = first(ins, "V")
@@ -55,8 +56,13 @@ def _fused_attention_qkv(ins, attrs):
     qh, kh, vh = (_split_heads(t, h) for t in (q, k, v))
     causal = attrs.get("causal", False)
     drop = float(attrs.get("dropout_rate", 0.0) or 0.0)
-    if bias is None and drop == 0.0:
-        o = flash_attention(qh, kh, vh, sm_scale, causal)
+    if bias is None and (drop == 0.0 or _pallas_ok(qh, kh)):
+        seed = None
+        if drop > 0.0:
+            seed = jax.random.randint(attrs["_rng"], (1,), 0,
+                                      2 ** 31 - 1, dtype=jnp.int32)
+        o = flash_attention(qh, kh, vh, sm_scale, causal,
+                            dropout_rate=drop, dropout_seed=seed)
     else:
         s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh).astype(jnp.float32) \
             * sm_scale

@@ -39,6 +39,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 try:
     from jax.experimental import pallas as pl
@@ -95,12 +96,51 @@ def _mask_scores(s, q_start, k_start, blk_q, blk_k):
     return jnp.where(rows >= cols, s, NEG_INF)
 
 
+def _keep_mask(seed, bh, q_start, k_start, blk_q, blk_k, rate):
+    """Deterministic counter-based dropout mask for one score tile:
+    a Wang-style integer mix over (seed, batch·head, absolute row,
+    absolute col) — plain VPU integer ops, so the SAME mask regenerates
+    in the backward kernels and in the interpreter (the TPU PRNG
+    primitives have no CPU interpret rule). Keep probability 1-rate to
+    24-bit resolution."""
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+    x = (rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         ^ cols.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+         ^ (seed.astype(jnp.uint32) + jnp.uint32(0x27D4EB2F)
+            * bh.astype(jnp.uint32)))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    thresh = jnp.uint32(int(rate * float(1 << 24)))
+    return ((x & jnp.uint32(0xFFFFFF)) >= thresh)
+
+
+def keep_mask_reference(seed, bh, rows, cols, rate):
+    """Numpy twin of _keep_mask for exact-parity tests."""
+    rows = np.asarray(rows, np.uint32)[:, None]
+    cols = np.asarray(cols, np.uint32)[None, :]
+    x = (rows * np.uint32(0x9E3779B1)
+         ^ cols * np.uint32(0x85EBCA77)
+         ^ np.uint32((seed + 0x27D4EB2F * bh) & 0xFFFFFFFF))
+    x = x ^ (x >> np.uint32(16))
+    x = (x * np.uint32(0x7FEB352D)) & np.uint32(0xFFFFFFFF)
+    x = x ^ (x >> np.uint32(15))
+    x = (x * np.uint32(0x846CA68B)) & np.uint32(0xFFFFFFFF)
+    x = x ^ (x >> np.uint32(16))
+    thresh = np.uint32(int(rate * float(1 << 24)))
+    return (x & np.uint32(0xFFFFFF)) >= thresh
+
+
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, sm_scale, causal, blk_q, blk_k):
-    qi, ki = pl.program_id(1), pl.program_id(2)
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref,
+                *, sm_scale, causal, blk_q, blk_k, dropout_rate):
+    bh, qi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
     @pl.when(ki == 0)
@@ -125,7 +165,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        # the normalizer l uses the FULL probabilities (softmax first);
+        # dropout scales only the value accumulation — elementwise, so it
+        # commutes with the final 1/l
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed_ref[0], bh, q_start, k_start,
+                              blk_q, blk_k, dropout_rate)
+            p = p * keep.astype(p.dtype) / (1.0 - dropout_rate)
         acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
             p, v_ref[0].astype(jnp.float32),
             preferred_element_type=jnp.float32)
@@ -147,19 +194,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0] = m_ref[:, 0] + jnp.log(l[:, 0])
 
 
-def _pallas_fwd(q, k, v, sm_scale, causal, blk_q, blk_k):
+def _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
+                dropout_rate=0.0):
     B, H, S, D = q.shape
     Sk = k.shape[2]
     qf, kf, vf = (t.reshape(B * H, t.shape[2], D) for t in (q, k, v))
     grid = (B * H, S // blk_q, Sk // blk_k)
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                             blk_q=blk_q, blk_k=blk_k)
+                             blk_q=blk_q, blk_k=blk_k,
+                             dropout_rate=dropout_rate)
     o, lse = pl.pallas_call(
         kern,
         out_shape=(jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
                    jax.ShapeDtypeStruct((B * H, S), jnp.float32)),
         grid=grid,
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                # seed
             pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
@@ -174,17 +224,17 @@ def _pallas_fwd(q, k, v, sm_scale, causal, blk_q, blk_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_INTERPRET and not _on_tpu(),
-    )(qf, kf, vf)
+    )(seed, qf, kf, vf)
     return o.reshape(B, H, S, D), lse.reshape(B, H, S)
 
 
 # --------------------------------------------------------------------------
 # backward
 # --------------------------------------------------------------------------
-def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dk_ref, dv_ref, dk_acc, dv_acc,
-                   *, sm_scale, causal, blk_q, blk_k):
-    ki, qi = pl.program_id(1), pl.program_id(2)
+def _bwd_kv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                   *, sm_scale, causal, blk_q, blk_k, dropout_rate):
+    bh, ki, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
     @pl.when(qi == 0)
@@ -208,12 +258,23 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             s = _mask_scores(s, q_start, k_start, blk_q, blk_k)
         p = jnp.exp(s - lse)                              # [blk_q, blk_k]
-        dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)           # pᵀ·dO
         dp = jax.lax.dot_general(
             do, vv, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # dO·Vᵀ
+        if dropout_rate > 0.0:
+            # regenerate the forward's mask; dV sees the dropped/scaled
+            # probabilities, dS sees the masked dP (softmax-bwd delta
+            # identity still holds: delta = rowsum(dO∘O))
+            keep = _keep_mask(seed_ref[0], bh, q_start, k_start,
+                              blk_q, blk_k,
+                              dropout_rate).astype(jnp.float32)
+            p_eff = p * keep / (1.0 - dropout_rate)
+            dp = dp * keep / (1.0 - dropout_rate)
+        else:
+            p_eff = p
+        dv_acc[...] += jax.lax.dot_general(
+            p_eff, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # p'ᵀ·dO
         ds = p * (dp - delta) * sm_scale
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -232,9 +293,10 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                  dq_ref, dq_acc, *, sm_scale, causal, blk_q, blk_k):
-    qi, ki = pl.program_id(1), pl.program_id(2)
+def _bwd_q_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                  delta_ref, dq_ref, dq_acc,
+                  *, sm_scale, causal, blk_q, blk_k, dropout_rate):
+    bh, qi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
     @pl.when(ki == 0)
@@ -260,6 +322,11 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, vv, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed_ref[0], bh, q_start, k_start,
+                              blk_q, blk_k,
+                              dropout_rate).astype(jnp.float32)
+            dp = dp * keep / (1.0 - dropout_rate)
         ds = p * (dp - delta) * sm_scale
         dq_acc[...] += jnp.dot(ds, kk, preferred_element_type=jnp.float32)
 
@@ -275,7 +342,8 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _pallas_bwd(q, k, v, o, lse, g, sm_scale, causal, blk_q, blk_k):
+def _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal, blk_q, blk_k,
+                dropout_rate=0.0):
     B, H, S, D = q.shape
     Sk = k.shape[2]
     BH = B * H
@@ -284,7 +352,8 @@ def _pallas_bwd(q, k, v, o, lse, g, sm_scale, causal, blk_q, blk_k):
     lsef = lse.reshape(BH, S)
     delta = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32), -1)
     interp = _INTERPRET and not _on_tpu()
-    common = dict(sm_scale=sm_scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
+    common = dict(sm_scale=sm_scale, causal=causal, blk_q=blk_q,
+                  blk_k=blk_k, dropout_rate=dropout_rate)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_kv_kernel, **common),
@@ -292,6 +361,7 @@ def _pallas_bwd(q, k, v, o, lse, g, sm_scale, causal, blk_q, blk_k):
                    jax.ShapeDtypeStruct((BH, Sk, D), v.dtype)),
         grid=(BH, Sk // blk_k, S // blk_q),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                    # seed
             pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0)),   # q
             pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),   # k
             pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),   # v
@@ -306,13 +376,14 @@ def _pallas_bwd(q, k, v, o, lse, g, sm_scale, causal, blk_q, blk_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interp,
-    )(qf, kf, vf, gf, lsef, delta)
+    )(seed, qf, kf, vf, gf, lsef, delta)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_q_kernel, **common),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         grid=(BH, S // blk_q, Sk // blk_k),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                    # seed
             pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),   # q
             pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),   # k
             pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),   # v
@@ -325,7 +396,7 @@ def _pallas_bwd(q, k, v, o, lse, g, sm_scale, causal, blk_q, blk_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interp,
-    )(qf, kf, vf, gf, lsef, delta)
+    )(seed, qf, kf, vf, gf, lsef, delta)
 
     shape = (B, H, S, D)
     return dq.reshape(shape), dk.reshape(B, H, Sk, D), dv.reshape(B, H, Sk, D)
@@ -348,31 +419,58 @@ def _pallas_ok(q, k):
     return S % blk_q == 0 and Sk % blk_k == 0
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_pallas(q, k, v, sm_scale, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_pallas(q, k, v, seed, sm_scale, causal, dropout_rate):
     blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2])
-    o, _ = _pallas_fwd(q, k, v, sm_scale, causal, blk_q, blk_k)
+    o, _ = _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
+                       dropout_rate)
     return o
 
 
-def _fp_fwd(q, k, v, sm_scale, causal):
+def _fp_fwd(q, k, v, seed, sm_scale, causal, dropout_rate):
     blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2])
-    o, lse = _pallas_fwd(q, k, v, sm_scale, causal, blk_q, blk_k)
-    return o, (q, k, v, o, lse)
+    o, lse = _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
+                         dropout_rate)
+    return o, (q, k, v, o, lse, seed)
 
 
-def _fp_bwd(sm_scale, causal, res, g):
-    q, k, v, o, lse = res
+def _fp_bwd(sm_scale, causal, dropout_rate, res, g):
+    q, k, v, o, lse, seed = res
     blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2])
-    return _pallas_bwd(q, k, v, o, lse, g, sm_scale, causal, blk_q, blk_k)
+    dq, dk, dv = _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal,
+                             blk_q, blk_k, dropout_rate)
+    dseed = np.zeros(seed.shape, jax.dtypes.float0)  # int arg: zero tangent
+    return dq, dk, dv, dseed
 
 
 _flash_pallas.defvjp(_fp_fwd, _fp_bwd)
 
+_ZERO_SEED = None
 
-def flash_attention(q, k, v, sm_scale, causal=False):
+
+def flash_attention(q, k, v, sm_scale, causal=False, dropout_rate=0.0,
+                    dropout_seed=None):
     """q,k,v: [B,H,S,D] → [B,H,S,D]. Pallas flash kernel when the backend
-    (or interpret mode) supports it; pure-XLA reference otherwise."""
+    (or interpret mode) supports it; pure-XLA reference otherwise.
+    dropout_rate > 0 applies attention-probability dropout INSIDE the
+    kernel (mask regenerated in the backward from dropout_seed, an int32
+    [1] array — pass a fresh per-step value when training)."""
+    if dropout_rate > 0.0 and dropout_seed is None:
+        # a silent default seed would drop the SAME attention entries
+        # every step — training bias with no symptom
+        raise ValueError(
+            "flash_attention: dropout_rate > 0 requires dropout_seed "
+            "(int32 [1] array, fresh per training step)")
     if _pallas_ok(q, k):
-        return _flash_pallas(q, k, v, sm_scale, causal)
+        global _ZERO_SEED
+        if dropout_seed is None:
+            if _ZERO_SEED is None:
+                _ZERO_SEED = jnp.zeros((1,), jnp.int32)
+            dropout_seed = _ZERO_SEED
+        return _flash_pallas(q, k, v, dropout_seed, sm_scale, causal,
+                             float(dropout_rate))
+    if dropout_rate > 0.0:
+        raise NotImplementedError(
+            "attention dropout requires the Pallas path (shapes "
+            "divisible by the block size)")
     return _ref_attention(q, k, v, sm_scale, causal)

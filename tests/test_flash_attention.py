@@ -70,7 +70,8 @@ def test_multi_kblock_online_softmax():
 
 def test_lse_residual():
     q, k, v = _rand_qkv(1, 1, 128, 32, seed=4)
-    o, lse = fa._pallas_fwd(q, k, v, 0.2, False, 128, 128)
+    seed = jnp.zeros((1,), jnp.int32)
+    o, lse = fa._pallas_fwd(q, k, v, seed, 0.2, False, 128, 128)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.2
     ref_lse = jax.scipy.special.logsumexp(s, axis=-1)
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
@@ -96,3 +97,71 @@ def test_fallback_on_odd_shapes():
     out = fa.flash_attention(q, k, v, 0.25, False)
     ref = fa._ref_attention(q, k, v, 0.25, False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+# ---------------------------------------------------------------- dropout
+def _dropout_reference(q, k, v, sm_scale, causal, rate, seed):
+    """jnp twin of the in-kernel dropout: softmax first, then the SAME
+    counter-based keep mask (keep_mask_reference), scaled by 1/(1-rate)."""
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        m = jnp.arange(S)[:, None] >= jnp.arange(Sk)[None, :]
+        s = jnp.where(m, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    masks = np.stack([
+        fa.keep_mask_reference(seed, bh, np.arange(S), np.arange(Sk), rate)
+        for bh in range(B * H)]).reshape(B, H, S, Sk)
+    p = p * jnp.asarray(masks, jnp.float32) / (1.0 - rate)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+def test_dropout_matches_mask_reference():
+    q, k, v = _rand_qkv(1, 2, 256, 32, seed=8)
+    seed = jnp.asarray([1234], jnp.int32)
+    out = fa.flash_attention(q, k, v, 0.125, False, dropout_rate=0.1,
+                             dropout_seed=seed)
+    ref = _dropout_reference(q, k, v, 0.125, False, 0.1, 1234)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_dropout_determinism_and_rate():
+    q, k, v = _rand_qkv(1, 1, 128, 32, seed=9)
+    s1 = jnp.asarray([7], jnp.int32)
+    s2 = jnp.asarray([8], jnp.int32)
+    o1 = fa.flash_attention(q, k, v, 0.2, False, dropout_rate=0.3,
+                            dropout_seed=s1)
+    o1b = fa.flash_attention(q, k, v, 0.2, False, dropout_rate=0.3,
+                             dropout_seed=s1)
+    o2 = fa.flash_attention(q, k, v, 0.2, False, dropout_rate=0.3,
+                            dropout_seed=s2)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o1b))
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+    # empirical keep fraction of the mask generator ≈ 1 - rate
+    m = fa.keep_mask_reference(7, 0, np.arange(512), np.arange(512), 0.3)
+    assert abs(m.mean() - 0.7) < 0.01
+
+
+def test_dropout_grads_match_mask_reference():
+    q, k, v = _rand_qkv(1, 1, 128, 16, seed=10)
+    seed = jnp.asarray([55], jnp.int32)
+    w = jnp.asarray(np.random.RandomState(11).normal(
+        size=q.shape).astype(np.float32))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(fa.flash_attention(
+            q, k, v, 0.25, True, dropout_rate=0.2, dropout_seed=seed) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_dropout_reference(q, k, v, 0.25, True, 0.2, 55)
+                       * w)
+
+    g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_rf = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_fl, g_rf, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4,
+                                   err_msg=f"d{name}")
