@@ -40,12 +40,13 @@ def _fused_attention_qkv(ins, attrs):
     """Optional Bias: additive attention mask broadcastable to
     [B, H, Sq, Sk] (e.g. padding mask [B, 1, 1, Sk] with -inf/0).
 
-    Dispatch: the Pallas flash kernel whenever there is no bias —
-    attention dropout runs INSIDE the kernel (mask regenerated in the
-    backward, seeded per step from the executor rng). The einsum path
-    (XLA fuses it) serves the additive-bias case and shapes the kernel
-    doesn't cover. Causal masking is TOP-LEFT aligned (query i sees keys
-    <= i) on both paths."""
+    Dispatch: the Pallas flash kernel serves the no-bias case AND the
+    exact key-padding bias form [B, 1, 1, Sk] (in-kernel); attention
+    dropout runs INSIDE the kernel (mask regenerated in the backward,
+    seeded per step from the executor rng). The einsum path (XLA fuses
+    it) serves every other bias shape and shapes the kernel doesn't
+    cover. Causal masking is TOP-LEFT aligned (query i sees keys <= i)
+    on both paths."""
     q = first(ins, "Q")
     k = first(ins, "K")
     v = first(ins, "V")
@@ -56,13 +57,24 @@ def _fused_attention_qkv(ins, attrs):
     qh, kh, vh = (_split_heads(t, h) for t in (q, k, v))
     causal = attrs.get("causal", False)
     drop = float(attrs.get("dropout_rate", 0.0) or 0.0)
-    if bias is None and (drop == 0.0 or _pallas_ok(qh, kh)):
+    # ONLY the exact [B,1,1,Sk] key-padding form goes in-kernel — a
+    # merely broadcastable bias (e.g. [B,1,1,1] or [1,1,1,Sk]) must take
+    # the einsum path, since the kernel's (1, blk_k) bias block indexes
+    # the real B and Sk extents
+    kp_bias = None
+    if bias is not None and bias.ndim == 4 and bias.shape[1] == 1 \
+            and bias.shape[2] == 1 and bias.shape[0] == qh.shape[0] \
+            and bias.shape[3] == kh.shape[2]:
+        kp_bias = bias.reshape(bias.shape[0], bias.shape[3])
+    flash_can = _pallas_ok(qh, kh) and (bias is None or kp_bias is not None)
+    if (bias is None and drop == 0.0) or flash_can:
         seed = None
         if drop > 0.0:
             seed = jax.random.randint(attrs["_rng"], (1,), 0,
                                       2 ** 31 - 1, dtype=jnp.int32)
         o = flash_attention(qh, kh, vh, sm_scale, causal,
-                            dropout_rate=drop, dropout_seed=seed)
+                            dropout_rate=drop, dropout_seed=seed,
+                            bias=kp_bias if flash_can else None)
     else:
         s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh).astype(jnp.float32) \
             * sm_scale

@@ -134,12 +134,40 @@ def keep_mask_reference(seed, bh, rows, cols, rate):
     return (x & np.uint32(0xFFFFFF)) >= thresh
 
 
+def _with_optional_bias(kernel, n_named, has_bias):
+    """Adapter shared by all three pallas_calls: refs arrive as
+    (inputs..., outputs..., scratch...); the kernels take bias_ref (or
+    None) right after their ``n_named`` data inputs."""
+    def _inner(*refs):
+        named = refs[:n_named]
+        if has_bias:
+            return kernel(*named, refs[n_named], *refs[n_named + 1:])
+        return kernel(*named, None, *refs[n_named:])
+    return _inner
+
+
+def _append_bias_input(in_specs, args, bias, H, blk_k, k_axis):
+    """Append the [B, Sk] key-padding bias input (cast once to f32).
+    ``k_axis``: which grid dimension indexes K blocks (1 for the bwd-kv
+    kernel, 2 for fwd/bwd-q)."""
+    if bias is None:
+        return
+    if k_axis == 1:
+        spec = pl.BlockSpec((1, blk_k), lambda b, j, i: (b // H, j))
+    else:
+        spec = pl.BlockSpec((1, blk_k), lambda b, i, j: (b // H, j))
+    in_specs.append(spec)
+    args.append(bias if bias.dtype == jnp.float32
+                else bias.astype(jnp.float32))
+
+
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
-def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref,
-                *, sm_scale, causal, blk_q, blk_k, dropout_rate):
+                *, sm_scale, causal, blk_q, blk_k, dropout_rate,
+                has_bias):
     bh, qi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -158,6 +186,11 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [blk_q, blk_k]
+        if has_bias:
+            # key-padding bias [B, Sk] broadcast over query rows (the
+            # reference BiasQK padding-mask form); clamped so -inf masks
+            # can't produce inf-inf → NaN in the rescale
+            s = s + jnp.maximum(bias_ref[0][None, :], NEG_INF)
         if causal:
             s = _mask_scores(s, q_start, k_start, blk_q, blk_k)
         m_prev = m_ref[:, :1]                             # [blk_q, 1]
@@ -195,25 +228,30 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
-                dropout_rate=0.0):
+                dropout_rate=0.0, bias=None):
     B, H, S, D = q.shape
     Sk = k.shape[2]
     qf, kf, vf = (t.reshape(B * H, t.shape[2], D) for t in (q, k, v))
     grid = (B * H, S // blk_q, Sk // blk_k)
+    has_bias = bias is not None
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                              blk_q=blk_q, blk_k=blk_k,
-                             dropout_rate=dropout_rate)
+                             dropout_rate=dropout_rate, has_bias=has_bias)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),                # seed
+        pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [seed, qf, kf, vf]
+    _append_bias_input(in_specs, args, bias, H, blk_k, k_axis=2)
+
     o, lse = pl.pallas_call(
-        kern,
+        _with_optional_bias(kern, 4, has_bias),
         out_shape=(jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
                    jax.ShapeDtypeStruct((B * H, S), jnp.float32)),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),                # seed
-            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=(pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
                    pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i))),
         scratch_shapes=[
@@ -224,7 +262,7 @@ def _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_INTERPRET and not _on_tpu(),
-    )(seed, qf, kf, vf)
+    )(*args)
     return o.reshape(B, H, S, D), lse.reshape(B, H, S)
 
 
@@ -232,8 +270,9 @@ def _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
 # backward
 # --------------------------------------------------------------------------
 def _bwd_kv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                   delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
-                   *, sm_scale, causal, blk_q, blk_k, dropout_rate):
+                   delta_ref, bias_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                   *, sm_scale, causal, blk_q, blk_k, dropout_rate,
+                   has_bias):
     bh, ki, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -255,6 +294,8 @@ def _bwd_kv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = jax.lax.dot_general(
             q, kk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
+        if has_bias:
+            s = s + jnp.maximum(bias_ref[0][None, :], NEG_INF)
         if causal:
             s = _mask_scores(s, q_start, k_start, blk_q, blk_k)
         p = jnp.exp(s - lse)                              # [blk_q, blk_k]
@@ -294,8 +335,9 @@ def _bwd_kv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _bwd_q_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                  delta_ref, dq_ref, dq_acc,
-                  *, sm_scale, causal, blk_q, blk_k, dropout_rate):
+                  delta_ref, bias_ref, dq_ref, dq_acc,
+                  *, sm_scale, causal, blk_q, blk_k, dropout_rate,
+                  has_bias):
     bh, qi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -316,6 +358,8 @@ def _bwd_q_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = jax.lax.dot_general(
             q, kk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
+        if has_bias:
+            s = s + jnp.maximum(bias_ref[0][None, :], NEG_INF)
         if causal:
             s = _mask_scores(s, q_start, k_start, blk_q, blk_k)
         p = jnp.exp(s - lse)
@@ -343,7 +387,7 @@ def _bwd_q_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal, blk_q, blk_k,
-                dropout_rate=0.0):
+                dropout_rate=0.0, bias=None):
     B, H, S, D = q.shape
     Sk = k.shape[2]
     BH = B * H
@@ -352,23 +396,31 @@ def _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal, blk_q, blk_k,
     lsef = lse.reshape(BH, S)
     delta = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32), -1)
     interp = _INTERPRET and not _on_tpu()
+    has_bias = bias is not None
     common = dict(sm_scale=sm_scale, causal=causal, blk_q=blk_q,
-                  blk_k=blk_k, dropout_rate=dropout_rate)
+                  blk_k=blk_k, dropout_rate=dropout_rate,
+                  has_bias=has_bias)
+
+    kv_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),                    # seed
+        pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0)),   # q
+        pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),   # k
+        pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),   # v
+        pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0)),   # do
+        pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i)),         # lse
+        pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i)),         # delta
+    ]
+    kv_args = [seed, qf, kf, vf, gf, lsef, delta]
+    bias_f32 = None if bias is None else bias.astype(jnp.float32)
+    _append_bias_input(kv_specs, kv_args, bias_f32, H, blk_k, k_axis=1)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_kv_kernel, **common),
+        _with_optional_bias(
+            functools.partial(_bwd_kv_kernel, **common), 7, has_bias),
         out_shape=(jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
                    jax.ShapeDtypeStruct((BH, Sk, D), v.dtype)),
         grid=(BH, Sk // blk_k, S // blk_q),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),                    # seed
-            pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0)),   # q
-            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),   # k
-            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),   # v
-            pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0)),   # do
-            pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i)),         # lse
-            pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i)),         # delta
-        ],
+        in_specs=kv_specs,
         out_specs=(pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
                    pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0))),
         scratch_shapes=[pltpu.VMEM((blk_k, D), jnp.float32),
@@ -376,27 +428,32 @@ def _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal, blk_q, blk_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interp,
-    )(seed, qf, kf, vf, gf, lsef, delta)
+    )(*kv_args)
+
+    q_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),                    # seed
+        pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),   # q
+        pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),   # k
+        pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),   # v
+        pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),   # do
+        pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),         # lse
+        pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),         # delta
+    ]
+    q_args = [seed, qf, kf, vf, gf, lsef, delta]
+    _append_bias_input(q_specs, q_args, bias_f32, H, blk_k, k_axis=2)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_q_kernel, **common),
+        _with_optional_bias(
+            functools.partial(_bwd_q_kernel, **common), 7, has_bias),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         grid=(BH, S // blk_q, Sk // blk_k),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),                    # seed
-            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),   # q
-            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),   # k
-            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),   # v
-            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),   # do
-            pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),         # lse
-            pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),         # delta
-        ],
+        in_specs=q_specs,
         out_specs=pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
         scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interp,
-    )(seed, qf, kf, vf, gf, lsef, delta)
+    )(*q_args)
 
     shape = (B, H, S, D)
     return dq.reshape(shape), dk.reshape(B, H, Sk, D), dv.reshape(B, H, Sk, D)
@@ -419,28 +476,29 @@ def _pallas_ok(q, k):
     return S % blk_q == 0 and Sk % blk_k == 0
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_pallas(q, k, v, seed, sm_scale, causal, dropout_rate):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_pallas(q, k, v, seed, bias, sm_scale, causal, dropout_rate):
     blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2])
     o, _ = _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
-                       dropout_rate)
+                       dropout_rate, bias=bias)
     return o
 
 
-def _fp_fwd(q, k, v, seed, sm_scale, causal, dropout_rate):
+def _fp_fwd(q, k, v, seed, bias, sm_scale, causal, dropout_rate):
     blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2])
     o, lse = _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
-                         dropout_rate)
-    return o, (q, k, v, o, lse, seed)
+                         dropout_rate, bias=bias)
+    return o, (q, k, v, o, lse, seed, bias)
 
 
 def _fp_bwd(sm_scale, causal, dropout_rate, res, g):
-    q, k, v, o, lse, seed = res
+    q, k, v, o, lse, seed, bias = res
     blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2])
     dq, dk, dv = _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal,
-                             blk_q, blk_k, dropout_rate)
+                             blk_q, blk_k, dropout_rate, bias=bias)
     dseed = np.zeros(seed.shape, jax.dtypes.float0)  # int arg: zero tangent
-    return dq, dk, dv, dseed
+    dbias = None if bias is None else jnp.zeros_like(bias)  # mask input
+    return dq, dk, dv, dseed, dbias
 
 
 _flash_pallas.defvjp(_fp_fwd, _fp_bwd)
@@ -449,12 +507,14 @@ _ZERO_SEED = None
 
 
 def flash_attention(q, k, v, sm_scale, causal=False, dropout_rate=0.0,
-                    dropout_seed=None):
+                    dropout_seed=None, bias=None):
     """q,k,v: [B,H,S,D] → [B,H,S,D]. Pallas flash kernel when the backend
     (or interpret mode) supports it; pure-XLA reference otherwise.
     dropout_rate > 0 applies attention-probability dropout INSIDE the
     kernel (mask regenerated in the backward from dropout_seed, an int32
-    [1] array — pass a fresh per-step value when training)."""
+    [1] array — pass a fresh per-step value when training). ``bias`` is
+    an additive key-padding mask [B, Sk] broadcast over query rows (the
+    reference BiasQK padding form); it is a constant wrt gradients."""
     if dropout_rate > 0.0 and dropout_seed is None:
         # a silent default seed would drop the SAME attention entries
         # every step — training bias with no symptom
@@ -467,10 +527,23 @@ def flash_attention(q, k, v, sm_scale, causal=False, dropout_rate=0.0,
             if _ZERO_SEED is None:
                 _ZERO_SEED = jnp.zeros((1,), jnp.int32)
             dropout_seed = _ZERO_SEED
-        return _flash_pallas(q, k, v, dropout_seed, sm_scale, causal,
-                             float(dropout_rate))
+        return _flash_pallas(q, k, v, dropout_seed, bias, sm_scale,
+                             causal, float(dropout_rate))
     if dropout_rate > 0.0:
         raise NotImplementedError(
             "attention dropout requires the Pallas path (shapes "
             "divisible by the block size)")
-    return _ref_attention(q, k, v, sm_scale, causal)
+    o = _ref_attention(q, k, v, sm_scale, causal) if bias is None else \
+        _ref_attention_bias(q, k, v, sm_scale, causal, bias)
+    return o
+
+
+def _ref_attention_bias(q, k, v, sm_scale, causal, bias):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    s = s + jnp.maximum(bias.astype(jnp.float32), NEG_INF)[:, None, None, :]
+    if causal:
+        S, Sk = q.shape[2], k.shape[2]
+        mask = jnp.arange(S)[:, None] >= jnp.arange(Sk)[None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)

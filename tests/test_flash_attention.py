@@ -165,3 +165,58 @@ def test_dropout_grads_match_mask_reference():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-4,
                                    err_msg=f"d{name}")
+
+
+# -------------------------------------------------------- key-padding bias
+def test_bias_matches_reference():
+    q, k, v = _rand_qkv(2, 2, 128, 32, seed=12)
+    # mask out a key suffix per batch row (padding form)
+    bias = np.zeros((2, 128), np.float32)
+    bias[0, 100:] = -1e9
+    bias[1, 64:] = -1e9
+    bias = jnp.asarray(bias)
+    out = fa.flash_attention(q, k, v, 0.125, False, bias=bias)
+    ref = fa._ref_attention_bias(q, k, v, 0.125, False, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_bias_grads_and_causal_dropout_combo():
+    q, k, v = _rand_qkv(1, 2, 128, 16, seed=14)
+    bias = np.zeros((1, 128), np.float32)
+    bias[0, 96:] = -1e9
+    bias = jnp.asarray(bias)
+    seed = jnp.asarray([99], jnp.int32)
+    w = jnp.asarray(np.random.RandomState(15).normal(
+        size=q.shape).astype(np.float32))
+
+    def masked_ref(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.25
+        s = s + jnp.maximum(bias, fa.NEG_INF)[:, None, None, :]
+        S = q.shape[2]
+        cm = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        s = jnp.where(cm, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        masks = np.stack([
+            fa.keep_mask_reference(99, bh, np.arange(S), np.arange(S), 0.1)
+            for bh in range(2)]).reshape(1, 2, S, S)
+        p = p * jnp.asarray(masks, jnp.float32) / 0.9
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(fa.flash_attention(
+            q, k, v, 0.25, True, dropout_rate=0.1, dropout_seed=seed,
+            bias=bias) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(masked_ref(q, k, v) * w)
+
+    np.testing.assert_allclose(
+        np.asarray(loss_flash(q, k, v)), np.asarray(loss_ref(q, k, v)),
+        rtol=1e-3)
+    g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_rf = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_fl, g_rf, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4,
+                                   err_msg=f"d{name}")

@@ -243,3 +243,33 @@ def test_fusion_group_raises():
     x = np.zeros((2, 2), np.float32)
     with pytest.raises(NotImplementedError):
         run_seq_op("fusion_group", x, None)
+
+
+def test_fused_attention_broadcastable_bias_routes_to_einsum():
+    """A merely BROADCASTABLE bias ([B,1,1,1] scalar-per-batch) must NOT
+    take the flash kernel (its (1, blk_k) bias block indexes real B/Sk
+    extents); the einsum path broadcasts it correctly. Regression: this
+    produced NaN when routed to the kernel."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.ops.registry import OPS
+    r = np.random.RandomState(0)
+    B, S, H, D = 2, 128, 2, 32
+    q = jnp.asarray(r.normal(size=(B, S, H * D)), jnp.float32)
+    k = jnp.asarray(r.normal(size=(B, S, H * D)), jnp.float32)
+    v = jnp.asarray(r.normal(size=(B, S, H * D)), jnp.float32)
+    bias = jnp.asarray(r.normal(size=(B, 1, 1, 1)), jnp.float32)
+    with fa.interpret_guard():  # make the flash path eligible on CPU
+        outs = OPS.get("fused_attention_qkv").kernel(
+            {"Q": [q], "K": [k], "V": [v], "Bias": [bias]},
+            {"num_heads": H, "dropout_rate": 0.0, "causal": False})
+    o = np.asarray(outs["Out"][0])
+    assert np.isfinite(o).all()
+    # scalar-per-batch bias shifts all scores equally → same as no bias
+    with fa.interpret_guard():
+        outs2 = OPS.get("fused_attention_qkv").kernel(
+            {"Q": [q], "K": [k], "V": [v], "Bias": [None]},
+            {"num_heads": H, "dropout_rate": 0.0, "causal": False})
+    np.testing.assert_allclose(o, np.asarray(outs2["Out"][0]),
+                               rtol=2e-4, atol=2e-5)
