@@ -154,19 +154,21 @@ class DistributeTranspiler:
         block = prog.global_block()
         dense = [p for p, _, _ in self.param_grad_ops
                  if p not in self.sparse_tables]
-        if self.sparse_tables:
-            # the reference GeoSgdCommunicator delta-syncs sparse ids too;
-            # this build's geo_sgd_send covers dense params only — refuse
-            # to silently diverge
-            import warnings
-            warnings.warn(
-                "geo_sgd_mode syncs only dense params in this build; "
-                f"sparse tables {sorted(self.sparse_tables)} will NOT be "
-                "synchronized across trainers — use sync/async mode for "
-                "sparse embeddings", UserWarning)
+        sparse = [p for p, _, _ in self.param_grad_ops
+                  if p in self.sparse_tables]
+        if any(p in getattr(self, "lazy_tables", {}) for p in sparse):
+            raise NotImplementedError(
+                "geo_sgd_mode keeps a local optimizer, so beyond-HBM "
+                "lazy sparse tables can't train GEO — use sync/async "
+                "PS mode for tables above "
+                "FLAGS_lazy_sparse_table_threshold")
+        # sparse tables delta-sync row-wise (reference GeoSgdCommunicator
+        # SendUpdateSparseVars); in GEO mode the local optimizer keeps
+        # the table in trainer scope, so lookups stay LOCAL
         block.append_op(
-            type="geo_sgd_send", inputs={"Params": dense}, outputs={},
-            attrs={"epmap": [self.param_ep[p] for p in dense],
+            type="geo_sgd_send",
+            inputs={"Params": dense, "SparseParams": sparse}, outputs={},
+            attrs={"epmap": [self.param_ep[p] for p in dense + sparse],
                    "push_nums": int(self.config.geo_sgd_need_push_nums),
                    "trainer_id": self.trainer_id,
                    "trainers": self.trainer_num})

@@ -90,10 +90,12 @@ def _geo_sgd_send(ins, attrs):
     cvar.set_value(core.LoDTensor(np.asarray([step], np.int64)))
 
     if step == 1:
-        # anchor: snapshot the server's params as the delta baseline
-        # (reference GeoSgdCommunicator pulls at init_worker; trainers and
-        # server share the startup init, so this is the common start)
-        for i, name in enumerate(names):
+        # anchor: snapshot the server's params (dense AND sparse tables)
+        # as the delta baseline (reference GeoSgdCommunicator pulls at
+        # init_worker; trainers and server share the startup init, so
+        # this is the common start)
+        all_names = list(names) + list(ctx.op.input("SparseParams") or [])
+        for i, name in enumerate(all_names):
             ep = epmap[i if i < len(epmap) else -1]
             fresh = np.asarray(_client(ep).get_var(name, trainer_id=tid))
             scope.var(name + "@GEO_OLD").set_value(
@@ -113,6 +115,34 @@ def _geo_sgd_send(ins, attrs):
         fresh = np.asarray(_client(ep).get_var(name, trainer_id=tid))
         scope.find_var(name).set_value(core.LoDTensor(jnp.asarray(fresh)))
         old_var.set_value(core.LoDTensor(fresh.copy()))
+
+    # sparse tables: push only the TOUCHED row deltas, pull those rows'
+    # merged values back (reference GeoSgdCommunicator
+    # SendUpdateSparseVars / RecvUpdateSparseVars)
+    n_dense = len(names)
+    for j, name in enumerate(ctx.op.input("SparseParams") or []):
+        ep_idx = n_dense + j
+        ep = epmap[ep_idx if ep_idx < len(epmap) else -1]
+        cur = np.asarray(scope.find_var(name).value().array)
+        old_var = scope.var(name + "@GEO_OLD")
+        if not old_var.is_initialized():
+            old_var.set_value(core.LoDTensor(cur.copy()))
+            continue
+        old = np.asarray(old_var.get_tensor().array)
+        delta = cur - old
+        touched = np.where(np.abs(delta).reshape(len(delta), -1)
+                           .max(axis=1) > 0)[0]
+        if len(touched):
+            _client(ep).call("geo_delta", name=name,
+                             value=np.ascontiguousarray(delta[touched]),
+                             rows=touched, trainer_id=tid)
+            fresh_rows = np.asarray(
+                _client(ep).prefetch_rows(name, touched))
+            cur = cur.copy()
+            cur[touched] = fresh_rows
+            scope.find_var(name).set_value(
+                core.LoDTensor(jnp.asarray(cur)))
+        old_var.set_value(core.LoDTensor(cur.copy()))
     return {}
 
 
@@ -399,17 +429,24 @@ def _listen_and_serv(ins, attrs):
     def h_checkpoint(dir=""):
         return True
 
-    def h_geo_delta(name, value, trainer_id=0):
-        """GEO-SGD delta apply: param += delta on arrival (reference
-        GeoSgdCommunicator server side, communicator.h:383)."""
+    def h_geo_delta(name, value, trainer_id=0, rows=None):
+        """GEO-SGD delta apply: param += delta on arrival; with ``rows``
+        only those table rows are touched (reference GeoSgdCommunicator
+        sparse-id sync, communicator.h:383 SendUpdateSparseVars)."""
         monitor.update(trainer_id)
         with lock:
             var = scope.find_var(name)
             if var is None:
                 raise KeyError(f"geo pserver has no param '{name}'")
             cur = np.asarray(var.value().array)
-            var.set_value(core.LoDTensor(
-                jnp.asarray(cur + np.asarray(value))))
+            if rows is not None:
+                cur = np.array(cur)  # jax-array views are read-only
+                np.add.at(cur, np.asarray(rows, np.int64),
+                          np.asarray(value))
+                var.set_value(core.LoDTensor(jnp.asarray(cur)))
+            else:
+                var.set_value(core.LoDTensor(
+                    jnp.asarray(cur + np.asarray(value))))
         return True
 
     # failure-detection cadence is deploy-tunable (tests shrink it to

@@ -304,3 +304,17 @@ def test_ps_lazy_table_eviction_bound(tmp_path):
     (stats,) = res["stats"]
     assert stats["touched"] <= 4, stats
     assert stats["evictions"] > 0, stats
+
+
+def test_ps_geo_sgd_sparse_embedding(tmp_path):
+    """GEO mode with a sparse embedding: local training, row-wise delta
+    pushes every N steps (reference GeoSgdCommunicator
+    SendUpdateSparseVars) — converges like the dense GEO case."""
+    (losses,) = run_cluster(1, 60, str(tmp_path), sparse=True, geo=True)
+    assert losses[-1] < losses[0] * 0.3, losses
+
+
+def test_ps_geo_sgd_sparse_two_trainers(tmp_path):
+    l0, l1 = run_cluster(2, 40, str(tmp_path), sparse=True, geo=True)
+    assert l0[-1] < l0[0] * 0.6, l0
+    assert l1[-1] < l1[0] * 0.6, l1
