@@ -23,22 +23,43 @@ import numpy as np
 V5E_PEAK_FLOPS = 197e12  # bf16 peak per chip
 
 
-def _ensure_backend(probe_timeout=150):
+PROBE_ERROR = None  # diagnostic from the last failed backend probe
+
+
+def _ensure_backend(probe_timeouts=(80, 80, 150), spacing=10):
     """Bounded-time backend probe, run in a subprocess so a hung TPU
     tunnel (the sitecustomize-pinned 'axon' plugin blocks forever inside
-    jax.devices()) cannot hang the bench itself. On probe failure, force
-    the CPU backend in this process before jax initializes, so every
-    bench mode still produces its JSON line."""
-    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
-    try:
-        out = subprocess.run([sys.executable, "-c", code],
-                             capture_output=True, text=True,
-                             timeout=probe_timeout, env=os.environ.copy())
-        for line in out.stdout.splitlines():
-            if line.startswith("PLATFORM="):
-                return line.split("=", 1)[1]
-    except (subprocess.TimeoutExpired, OSError):
-        pass
+    jax.devices()) cannot hang the bench itself. The tunnel is known to
+    have transient live windows, so the probe retries `attempts` times
+    with `spacing` seconds between tries before degrading. On failure,
+    force the CPU backend in this process before jax initializes, so
+    every bench mode still produces its JSON line; the reason is kept in
+    PROBE_ERROR and emitted as `probe_error` in the JSON."""
+    global PROBE_ERROR
+    code = ("import jax; d = jax.devices()[0]; "
+            "jax.numpy.ones(4).sum().block_until_ready(); "
+            "print('PLATFORM=' + d.platform)")
+    errs = []
+    for i, probe_timeout in enumerate(probe_timeouts):
+        if i:
+            time.sleep(spacing)
+        try:
+            out = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, text=True,
+                                 timeout=probe_timeout,
+                                 env=os.environ.copy())
+            for line in out.stdout.splitlines():
+                if line.startswith("PLATFORM="):
+                    PROBE_ERROR = None
+                    return line.split("=", 1)[1]
+            errs.append(f"attempt {i + 1}: rc={out.returncode} "
+                        + out.stderr.strip()[-200:])
+        except subprocess.TimeoutExpired:
+            errs.append(f"attempt {i + 1}: probe timeout {probe_timeout}s "
+                        "(tunnel hang)")
+        except OSError as e:
+            errs.append(f"attempt {i + 1}: {e!r}")
+    PROBE_ERROR = "; ".join(errs)[:500]
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     try:
@@ -336,12 +357,25 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
                 w.kill()
 
 
+def bench_flash():
+    """Pallas flash-attention Mosaic bring-up: compile (no interpret),
+    parity vs einsum, block-size sweep. Per-config JSON rows go to
+    stderr; the contract line (summary) is the return value."""
+    import jax
+    from tools import flash_smoke
+    backend = jax.devices()[0].platform
+    rows = flash_smoke.sweep(on_tpu=backend not in ("cpu",),
+                             emit=lambda s: print(s, file=sys.stderr))
+    return flash_smoke.summarize(rows, backend)
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "bert"
     benches = {"bert": bench_bert_base, "mnist": bench_mnist_mlp,
                "resnet": bench_resnet50, "allreduce": bench_allreduce_dp,
                "wide_deep": bench_wide_deep,
-               "wide_deep_1b": bench_wide_deep_1b}
+               "wide_deep_1b": bench_wide_deep_1b,
+               "flash": bench_flash}
     if which not in benches:
         raise SystemExit(f"unknown bench '{which}'; one of "
                          f"{sorted(benches)}")
@@ -353,6 +387,8 @@ def main():
         res = {"metric": f"{which}_error", "value": 0.0, "unit": "error",
                "vs_baseline": 0.0, "error": repr(e)[:500]}
     res.setdefault("backend", backend)
+    if PROBE_ERROR:
+        res.setdefault("probe_error", PROBE_ERROR)
     print(json.dumps(res))
 
 

@@ -462,7 +462,27 @@ def _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal, blk_q, blk_k,
 # --------------------------------------------------------------------------
 # public entry
 # --------------------------------------------------------------------------
+_BLOCK_OVERRIDE = None  # (blk_q, blk_k) set by block_override()
+
+
+@contextlib.contextmanager
+def block_override(blk_q, blk_k):
+    """Pin the kernel block sizes inside the context — the hardware
+    bring-up sweep (tools/flash_smoke.py) uses this to measure
+    blk_q×blk_k configurations; the override applies to forward AND the
+    custom-vjp backward, so wrap the whole grad computation."""
+    global _BLOCK_OVERRIDE
+    prev = _BLOCK_OVERRIDE
+    _BLOCK_OVERRIDE = (int(blk_q), int(blk_k))
+    try:
+        yield
+    finally:
+        _BLOCK_OVERRIDE = prev
+
+
 def _block_sizes(S, Sk):
+    if _BLOCK_OVERRIDE is not None:
+        return min(_BLOCK_OVERRIDE[0], S), min(_BLOCK_OVERRIDE[1], Sk)
     blk_q = min(DEFAULT_BLOCK_Q, S)
     blk_k = min(DEFAULT_BLOCK_K, Sk)
     return blk_q, blk_k

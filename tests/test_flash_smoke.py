@@ -1,0 +1,79 @@
+"""The flash hardware bring-up harness (tools/flash_smoke.py) must stay
+ready to fire the moment a TPU tunnel window opens — these tests keep
+its plumbing (config runner, parity math, JSON schema, summary) green on
+the CPU interpreter so first chip contact produces data, not debugging.
+Reference counterpart: operators/benchmark/op_tester.cc (measure, don't
+assert)."""
+import json
+
+import numpy as np
+import pytest
+
+from tools import flash_smoke
+
+
+def test_run_config_ok_schema():
+    row = flash_smoke.run_config(128, 64, 64, B=1, H=2, steps=2,
+                                 interpret=True)
+    assert row["status"] == "ok", row
+    for key in ("seq_len", "blk_q", "blk_k", "vmem_kb_est", "fwd_ms",
+                "fwdbwd_ms", "tflops_fwd", "max_err_fwd", "max_err_dq",
+                "max_err_dk", "max_err_dv"):
+        assert key in row, key
+    assert row["max_err_fwd"] < 2e-2
+    json.dumps(row)  # every row must be JSON-serializable
+
+
+def test_run_config_dropout_deterministic():
+    row = flash_smoke.run_config(128, 64, 64, B=1, H=2, steps=2,
+                                 dropout=0.1, interpret=True)
+    assert row["status"] == "ok", row
+    assert row["dropout_deterministic"] is True
+
+
+def test_run_config_indivisible_block_skipped():
+    row = flash_smoke.run_config(100, 64, 64, interpret=True)
+    assert row["status"] == "skipped"
+
+
+def test_run_config_never_raises_on_compile_error(monkeypatch):
+    # force a kernel failure; the harness must return a row, not raise
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic says no")
+
+    monkeypatch.setattr(fa, "flash_attention", boom)
+    row = flash_smoke.run_config(128, 64, 64, B=1, H=2, interpret=True)
+    assert row["status"] == "compile_error"
+    assert "mosaic says no" in row["error"]
+
+
+def test_run_config_restores_interpret_mode():
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    before = fa._INTERPRET
+    flash_smoke.run_config(128, 64, 64, B=1, H=2, steps=1, interpret=True)
+    assert fa._INTERPRET == before
+
+
+def test_summarize_picks_best_and_reports_failures():
+    rows = [
+        {"status": "ok", "tflops_fwd": 1.0, "seq_len": 128, "blk_q": 64,
+         "blk_k": 64, "fwd_ms": 1.0, "fwdbwd_ms": 3.0},
+        {"status": "ok", "tflops_fwd": 5.0, "seq_len": 512, "blk_q": 256,
+         "blk_k": 256, "fwd_ms": 0.5, "fwdbwd_ms": 1.5},
+        {"status": "compile_error", "seq_len": 2048, "blk_q": 512,
+         "blk_k": 512, "error": "VMEM OOM"},
+    ]
+    s = flash_smoke.summarize(rows, "tpu")
+    assert s["value"] == 5.0
+    assert s["configs_ok"] == 2 and s["configs_failed"] == 1
+    assert s["best_config"]["blk_q"] == 256
+    assert s["first_failure"]["error"] == "VMEM OOM"
+    json.dumps(s)
+
+
+def test_vmem_estimate_monotone_in_blocks():
+    a = flash_smoke._vmem_kb_estimate(128, 128, 64, bwd=True)
+    b = flash_smoke._vmem_kb_estimate(512, 512, 64, bwd=True)
+    assert b > a > 0
