@@ -233,7 +233,22 @@ def _to_device_array(data, place: Optional[Place] = None, dtype=None):
     # code still sees the reference's int64 contracts (e.g. sequence_pad
     # Length — reference sequence_pad_op.cc).
     if not jax.config.jax_enable_x64 and arr.dtype in (np.int64, np.uint64):
-        arr = arr.astype(np.int32 if arr.dtype == np.int64 else np.uint32)
+        tgt = np.int32 if arr.dtype == np.int64 else np.uint32
+        info = np.iinfo(tgt)
+        if arr.size and (int(arr.min()) < info.min
+                         or int(arr.max()) > info.max):
+            # astype would WRAP (e.g. a 64-bit hashed CTR feature id
+            # becoming a negative row index) — that corruption is silent
+            # and unrecoverable at the fetch boundary, so refuse. Feeds
+            # carrying genuine 64-bit ids belong on the host-side PS
+            # lookup path (distributed_lookup_table), not on-device.
+            raise ValueError(
+                f"int64/uint64 feed value out of {np.dtype(tgt).name} "
+                f"range (min={arr.min()}, max={arr.max()}): the device "
+                "integer width is 32-bit (TPU has no native int64 path). "
+                "Route >32-bit ids through the parameter-server lookup "
+                "(distributed_lookup_table) or pre-hash them below 2^31.")
+        arr = arr.astype(tgt)
     if place is None:
         return jnp.asarray(arr)
     return jax.device_put(arr, _as_place(place).jax_device())

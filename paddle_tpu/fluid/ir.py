@@ -579,9 +579,23 @@ class MultiheadMatmulFusePassV2(Pass):
         scope = self.get("param_scope")
         if scope is None:
             return graph  # weight packing needs the parameters
+        dead_candidates = set()
         for m in self._PAT.match(graph):
             qk_op, pv_op = m["#13"], m["#16"]
             if not qk_op.attr("transpose_Y") or pv_op.attr("transpose_Y"):
+                continue
+            # a structurally matching subgraph with different perm/axis
+            # attrs would be silently mis-fused — gate on the exact
+            # head-split/merge layout the fused op implements
+            # (reference: multihead_matmul_fuse_pass.cc pattern attrs)
+            if any(list(m[f"#{i}"].attr("axis") or []) != [0, 2, 1, 3]
+                   for i in (3, 8, 12, 17)):
+                continue
+            sm_axis = m["#15"].attr("axis")
+            if sm_axis is not None and int(sm_axis) not in (-1, 3):
+                continue
+            mask_axis = m["#14"].attr("axis")
+            if mask_axis is not None and int(mask_axis) not in (-1, 0):
                 continue
             scale_op = m["#4"]
             sb = scale_op.attr("bias")
@@ -617,6 +631,18 @@ class MultiheadMatmulFusePassV2(Pass):
                        {"alpha": alpha, "head_number": head_number,
                         "transpose_Q": False, "transpose_K": True,
                         "transpose_V": False})
+            dead_candidates.update(
+                m[s] for s in ("$wq", "$wk", "$wv", "$bq", "$bk", "$bv"))
+        if dead_candidates:
+            # the per-branch weights are dead after packing (reference
+            # erases them) — one usage sweep after all rewrites, then
+            # drop any candidate no remaining op reads
+            still_used = set()
+            for op in graph.block.ops:
+                still_used.update(op.input_arg_names)
+            for name in dead_candidates - still_used:
+                scope.erase(name)
+                graph.block.vars.pop(name, None)
         return graph
 
 

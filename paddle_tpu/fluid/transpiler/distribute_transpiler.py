@@ -16,6 +16,7 @@ distributed_lookup_table pulls with sparse push-grads served row-wise.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Dict, List, Optional
 
 from ..framework import (Program, default_main_program,
@@ -325,12 +326,35 @@ class DistributeTranspiler:
                 if w not in emitted_lazy:
                     emitted_lazy.add(w)
                     h, d = lazy[w]
+                    # carry the model-declared initializer into the lazy
+                    # table where representable (row init is
+                    # uniform(±scale)): a symmetric uniform_random maps
+                    # exactly; other families fall back to the
+                    # ±1/sqrt(dim) default with a warning (ADVICE r2)
+                    seed = int(op.attrs.get("seed") or 0)
+                    scale = 0.0
+                    if op.type == "uniform_random":
+                        mn = float(op.attrs.get("min", -1.0))
+                        mx = float(op.attrs.get("max", 1.0))
+                        if mx > 0 and abs(mn + mx) <= 1e-9 * mx:
+                            scale = mx
+                        else:
+                            warnings.warn(
+                                f"lazy table {w}: asymmetric "
+                                f"uniform_random({mn}, {mx}) is not "
+                                "representable by the row init; using "
+                                "uniform(±1/sqrt(dim))")
+                    else:
+                        warnings.warn(
+                            f"lazy table {w}: initializer '{op.type}' is "
+                            "not representable by the row init; using "
+                            "uniform(±1/sqrt(dim))")
                     block.create_var(name=w, persistable=True)
                     block.append_op(
                         type="lazy_table_init", inputs={},
                         outputs={"Out": [w]},
-                        attrs={"height": h, "dim": d, "seed": 0,
-                               "scale": 0.0,
+                        attrs={"height": h, "dim": d, "seed": seed,
+                               "scale": scale,
                                "max_rows": int(getattr(
                                    self.config,
                                    "sparse_table_max_rows", 0))})

@@ -21,6 +21,18 @@ from .pallas.flash_attention import (flash_attention, _pallas_ok,
                                      _ref_attention)
 
 
+def _keypad_bias(bias, q, k):
+    """[B, Sk] view of ``bias`` iff it is EXACTLY the key-padding form
+    [B, 1, 1, Sk] (else None). A merely broadcastable bias (e.g.
+    [B,1,1,1] or [1,1,1,Sk]) must NOT qualify — the kernel's (1, blk_k)
+    bias block indexes the real B and Sk extents. q, k: [B, H, S, D]."""
+    if bias is not None and bias.ndim == 4 and bias.shape[1] == 1 \
+            and bias.shape[2] == 1 and bias.shape[0] == q.shape[0] \
+            and bias.shape[3] == k.shape[2]:
+        return bias.reshape(bias.shape[0], bias.shape[3])
+    return None
+
+
 def _split_heads(x, n_head):
     b, s, hd = x.shape
     d = hd // n_head
@@ -57,15 +69,7 @@ def _fused_attention_qkv(ins, attrs):
     qh, kh, vh = (_split_heads(t, h) for t in (q, k, v))
     causal = attrs.get("causal", False)
     drop = float(attrs.get("dropout_rate", 0.0) or 0.0)
-    # ONLY the exact [B,1,1,Sk] key-padding form goes in-kernel — a
-    # merely broadcastable bias (e.g. [B,1,1,1] or [1,1,1,Sk]) must take
-    # the einsum path, since the kernel's (1, blk_k) bias block indexes
-    # the real B and Sk extents
-    kp_bias = None
-    if bias is not None and bias.ndim == 4 and bias.shape[1] == 1 \
-            and bias.shape[2] == 1 and bias.shape[0] == qh.shape[0] \
-            and bias.shape[3] == kh.shape[2]:
-        kp_bias = bias.reshape(bias.shape[0], bias.shape[3])
+    kp_bias = _keypad_bias(bias, qh, kh)
     flash_can = _pallas_ok(qh, kh) and (bias is None or kp_bias is not None)
     if (bias is None and drop == 0.0) or flash_can:
         seed = None
@@ -130,9 +134,19 @@ def _multihead_matmul(ins, attrs):
         q = jnp.transpose(x5[:, :, 0], (0, 2, 1, 3))
         k = jnp.transpose(x5[:, :, 1], (0, 2, 1, 3))
         v = jnp.transpose(x5[:, :, 2], (0, 2, 1, 3))
-    s_mat = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * alpha
-    if bias_qk is not None:
-        s_mat = s_mat + bias_qk.astype(jnp.float32)
-    p = jax.nn.softmax(s_mat, axis=-1).astype(q.dtype)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    # Fast path (the reference op IS its fast path — multihead_matmul_op.cu):
+    # no bias, or the exact key-padding BiasQK form [B,1,1,Sk] (the common
+    # BERT inference padding mask), dispatches to the Pallas flash kernel
+    # via its in-kernel bias input. Generic [B,H,Sq,Sk] biases keep the
+    # einsum path (XLA fuses it).
+    kp_bias = _keypad_bias(bias_qk, q, k)
+    if _pallas_ok(q, k) and (bias_qk is None or kp_bias is not None):
+        o = flash_attention(q, k, v, alpha, causal=False, bias=kp_bias)
+    else:
+        s_mat = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) \
+            * alpha
+        if bias_qk is not None:
+            s_mat = s_mat + bias_qk.astype(jnp.float32)
+        p = jax.nn.softmax(s_mat, axis=-1).astype(q.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
     return out(Out=_merge_heads(o))

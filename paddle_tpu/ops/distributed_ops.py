@@ -186,6 +186,19 @@ def _checkpoint_notify(ins, attrs):
     return {}
 
 
+def _table_dim(ctx, w_name):
+    """Embedding dim of the (possibly remote-only) table, from the block
+    var desc; last resort 1 when the program never declared the var."""
+    try:
+        v = ctx.op.block.var(w_name)
+        shape = list(getattr(v, "shape", None) or [])
+        if shape and int(shape[-1]) > 0:
+            return int(shape[-1])
+    except Exception:
+        pass
+    return 1
+
+
 @register_op("distributed_lookup_table", stateful=True,
              attr_defaults={"epmap": [], "table_names": [], "padding_idx": -1,
                             "is_distributed": True, "trainer_id": 0})
@@ -201,6 +214,13 @@ def _distributed_lookup_table(ins, attrs):
     outs = []
     for nm in id_names:
         ids = np.asarray(ctx.scope.find_var(nm).value().array).reshape(-1)
+        if len(ids) == 0:
+            # legitimately empty id batch: no RPC; the result must still
+            # carry the table's embedding dim or downstream ops reject
+            # the shape (ADVICE r2)
+            outs.append(jnp.zeros((0, _table_dim(ctx, w_name)),
+                                  jnp.float32))
+            continue
         if len(eps) == 1:
             rows = np.asarray(_client(eps[0]).prefetch_rows(w_name, ids))
         else:
@@ -216,8 +236,6 @@ def _distributed_lookup_table(ins, attrs):
                     rows = np.zeros((len(ids), part.shape[-1]),
                                     part.dtype)
                 rows[sel] = part
-            if rows is None:
-                rows = np.zeros((0, 1), np.float32)
         outs.append(jnp.asarray(rows))
     return {"Outputs": outs}
 
@@ -248,6 +266,8 @@ def _distributed_lookup_table_grad(ins, attrs):
     g_names = ctx.op.input("Outputs@GRAD")
     for nm, gn in zip(id_names, g_names):
         ids = np.asarray(ctx.scope.find_var(nm).value().array).reshape(-1)
+        if len(ids) == 0:
+            continue  # nothing to push, no RPC
         g = np.asarray(ctx.scope.find_var(gn).value().array)
         g = g.reshape(len(ids), -1)
         if len(eps) == 1:

@@ -223,8 +223,18 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
     @pl.when(ki == nk - 1)
     def _finalize():
         l = l_ref[:, :1]
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[:, 0] + jnp.log(l[:, 0])
+        m = m_ref[:, :1]
+        # fully-masked rows (every key at the clamped NEG_INF, e.g. a
+        # key-padding bias masking ALL keys): emit zeros, and poison the
+        # lse to +1e30 so the backward's exp(s - lse) underflows to 0 —
+        # zero grads instead of data-dependent garbage. Same semantics
+        # as _ref_attention_bias.
+        dead = m <= NEG_INF * 0.5
+        safe_l = jnp.where(dead, 1.0, l)
+        o_ref[0] = jnp.where(dead, 0.0,
+                             acc_ref[...] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(dead[:, 0], -NEG_INF,
+                               m[:, 0] + jnp.log(safe_l[:, 0]))
 
 
 def _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
@@ -523,7 +533,9 @@ def _fp_bwd(sm_scale, causal, dropout_rate, res, g):
 
 _flash_pallas.defvjp(_fp_fwd, _fp_bwd)
 
-_ZERO_SEED = None
+# numpy, NOT jnp: a lazily-created jnp array inside someone's jit trace
+# would cache a tracer in this global and poison every later trace
+_ZERO_SEED = np.zeros((1,), np.int32)
 
 
 def flash_attention(q, k, v, sm_scale, causal=False, dropout_rate=0.0,
@@ -542,10 +554,7 @@ def flash_attention(q, k, v, sm_scale, causal=False, dropout_rate=0.0,
             "flash_attention: dropout_rate > 0 requires dropout_seed "
             "(int32 [1] array, fresh per training step)")
     if _pallas_ok(q, k):
-        global _ZERO_SEED
         if dropout_seed is None:
-            if _ZERO_SEED is None:
-                _ZERO_SEED = jnp.zeros((1,), jnp.int32)
             dropout_seed = _ZERO_SEED
         return _flash_pallas(q, k, v, dropout_seed, bias, sm_scale,
                              causal, float(dropout_rate))
@@ -565,5 +574,8 @@ def _ref_attention_bias(q, k, v, sm_scale, causal, bias):
         S, Sk = q.shape[2], k.shape[2]
         mask = jnp.arange(S)[:, None] >= jnp.arange(Sk)[None, :]
         s = jnp.where(mask, s, -jnp.inf)
+    # fully-masked rows → zeros (matches the Pallas kernel's finalize)
+    dead = jnp.max(s, axis=-1, keepdims=True) <= NEG_INF * 0.5
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    p = jnp.where(dead, 0.0, p).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)

@@ -318,3 +318,79 @@ def test_ps_geo_sgd_sparse_two_trainers(tmp_path):
     l0, l1 = run_cluster(2, 40, str(tmp_path), sparse=True, geo=True)
     assert l0[-1] < l0[0] * 0.6, l0
     assert l1[-1] < l1[0] * 0.6, l1
+
+
+def test_lazy_table_startup_carries_initializer_seed_scale():
+    """get_startup_program must derive the lazy table's row-init
+    seed/scale from the model-declared initializer (a symmetric
+    uniform_random maps exactly), not hardcode seed=0/scale=0
+    (ADVICE r2)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.transpiler import (DistributeTranspiler,
+                                             DistributeTranspilerConfig)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        tok = fluid.data("tok", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(
+            tok, size=[10_000_000, 8], is_distributed=True,
+            param_attr=fluid.ParamAttr(
+                name="big_emb",
+                initializer=fluid.initializer.Uniform(
+                    low=-0.01, high=0.01, seed=7)))
+        emb = fluid.layers.reshape(emb, [-1, 8])
+        pred = fluid.layers.fc(emb, 1)
+        loss = fluid.layers.mean(pred)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    cfg = DistributeTranspilerConfig()
+    t = DistributeTranspiler(cfg)
+    with fluid.program_guard(main, startup):
+        t.transpile(trainer_id=0, pservers="127.0.0.1:16999", trainers=1,
+                    sync_mode=True, program=main, startup_program=startup)
+    sprog = t.get_startup_program("127.0.0.1:16999")
+    inits = [op for op in sprog.global_block().ops
+             if op.type == "lazy_table_init"]
+    assert inits, [op.type for op in sprog.global_block().ops]
+    attrs = inits[0].attrs
+    assert attrs["seed"] == 7, attrs
+    assert abs(attrs["scale"] - 0.01) < 1e-12, attrs
+
+
+def test_distributed_lookup_empty_ids_keeps_embedding_dim(monkeypatch):
+    """An empty id batch must return a [0, emb_dim] result, not [0, 1]
+    (ADVICE r2) — downstream concat/fc ops reject the wrong dim."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.fluid.executor import ExecContext
+    from paddle_tpu.ops import distributed_ops as D
+    from paddle_tpu.ops.registry import OPS
+
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        blk = main.global_block()
+        blk.create_var(name="ids", shape=[-1, 1], dtype="int64")
+        blk.create_var(name="emb_w", shape=[1000, 16], dtype="float32",
+                       persistable=True)
+        blk.create_var(name="out", shape=[-1, 16], dtype="float32")
+        op = blk.append_op(type="distributed_lookup_table",
+                           inputs={"Ids": ["ids"], "W": ["emb_w"]},
+                           outputs={"Outputs": ["out"]},
+                           attrs={"epmap": ["ep0", "ep1"],
+                                  "table_names": ["emb_w"]})
+
+    scope = core.Scope()
+    scope.var("ids").set_value(
+        core.LoDTensor(np.zeros((0,), np.int32)))
+
+    def no_rpc(ep):
+        raise AssertionError("no RPC expected for an empty id batch")
+
+    monkeypatch.setattr(D, "_client", no_rpc)
+    ctx = ExecContext(scope, None, op, None, 0)
+    outs = OPS.get("distributed_lookup_table").kernel(
+        {}, {"epmap": ["ep0", "ep1"], "table_names": ["emb_w"],
+             "_ctx": ctx})
+    (res,) = outs["Outputs"]
+    assert tuple(res.shape) == (0, 16), res.shape

@@ -220,3 +220,33 @@ def test_bias_grads_and_causal_dropout_combo():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-4,
                                    err_msg=f"d{name}")
+
+
+def test_fully_masked_rows_emit_zeros_on_both_paths():
+    """A key-padding bias masking ALL keys of a batch row used to yield
+    finite garbage (~mean of V) on the Pallas path and NaN-adjacent
+    output on the reference path; the defined semantics are now zeros
+    and zero grads on both (ADVICE r2)."""
+    B, H, S, D = 2, 2, 128, 64
+    q, k, v = _rand_qkv(B, H, S, D, seed=7)
+    bias = np.zeros((B, S), np.float32)
+    bias[0, :] = -1e30  # batch row 0: every key masked
+    bias = jnp.asarray(bias)
+
+    o_pallas = fa.flash_attention(q, k, v, 0.125, bias=bias)
+    o_ref = fa._ref_attention_bias(q, k, v, 0.125, False, bias)
+    np.testing.assert_array_equal(np.asarray(o_pallas[0]), 0.0)
+    np.testing.assert_array_equal(np.asarray(o_ref[0]), 0.0)
+    # unmasked batch row is untouched and the two paths agree
+    np.testing.assert_allclose(np.asarray(o_pallas[1], np.float32),
+                               np.asarray(o_ref[1], np.float32),
+                               rtol=2e-4, atol=2e-5)
+
+    def loss_pallas(q, k, v):
+        return jnp.sum(fa.flash_attention(
+            q, k, v, 0.125, bias=bias).astype(jnp.float32) ** 2)
+
+    dq, dk, dv = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    for g in (dq, dk, dv):
+        assert np.isfinite(np.asarray(g, np.float32)).all()
+    np.testing.assert_array_equal(np.asarray(dq[0], np.float32), 0.0)

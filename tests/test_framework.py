@@ -87,3 +87,22 @@ def test_operator_rename():
         op = prog.global_block().ops[-1]
         op._rename_input("x", "z")
         assert op.input("X") == ["z"]
+
+
+def test_int64_feed_overflow_raises():
+    """The device integer width is 32-bit; an id >= 2^31 must REFUSE at
+    the feed boundary instead of silently wrapping to a wrong (possibly
+    negative) row index (ADVICE r2, medium)."""
+    import numpy as np
+    import pytest
+    from paddle_tpu.fluid import core
+
+    ok = core._to_device_array(np.array([1, 2 ** 31 - 1], np.int64))
+    assert np.asarray(ok).dtype == np.int32
+
+    with pytest.raises(ValueError, match="out of int32 range"):
+        core._to_device_array(np.array([2 ** 31], np.int64))
+    with pytest.raises(ValueError, match="out of uint32 range"):
+        core._to_device_array(np.array([2 ** 32], np.uint64))
+    with pytest.raises(ValueError, match="out of int32 range"):
+        core._to_device_array(np.array([-2 ** 31 - 1], np.int64))

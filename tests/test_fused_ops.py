@@ -273,3 +273,70 @@ def test_fused_attention_broadcastable_bias_routes_to_einsum():
             {"num_heads": H, "dropout_rate": 0.0, "causal": False})
     np.testing.assert_allclose(o, np.asarray(outs2["Out"][0]),
                                rtol=2e-4, atol=2e-5)
+
+
+def _mhm_qkv_packed(B, S, H, D, seed=0):
+    r = np.random.RandomState(seed)
+    import jax.numpy as jnp
+    return jnp.asarray(r.normal(size=(B, S, 3, H, D)) * 0.3, jnp.float32)
+
+
+def test_multihead_matmul_keypad_bias_takes_flash_path(monkeypatch):
+    """The fused inference op must ride the Pallas flash kernel for the
+    key-padding BiasQK form [B,1,1,Sk] — the common BERT inference mask
+    (reference: multihead_matmul_op.cu IS the fast path) — and its
+    numerics must match the einsum path it replaces."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops import attention_ops
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.ops.registry import OPS
+    B, S, H, D = 2, 128, 2, 32
+    x = _mhm_qkv_packed(B, S, H, D)
+    pad = np.zeros((B, 1, 1, S), np.float32)
+    pad[:, :, :, S // 2:] = -1e9  # mask the right half of the keys
+    bias_qk = jnp.asarray(pad)
+    attrs = {"head_number": H, "alpha": 1.0 / np.sqrt(D)}
+
+    calls = []
+    real = fa.flash_attention
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(attention_ops, "flash_attention", counting)
+    with fa.interpret_guard():
+        o_flash = np.asarray(OPS.get("multihead_matmul").kernel(
+            {"Input": [x], "W": [None], "Bias": [None],
+             "BiasQK": [bias_qk]}, dict(attrs))["Out"][0])
+    assert calls, "key-padding BiasQK did not dispatch to the flash kernel"
+
+    # einsum oracle: same op with the kernel ineligible (no interpret)
+    o_einsum = np.asarray(OPS.get("multihead_matmul").kernel(
+        {"Input": [x], "W": [None], "Bias": [None],
+         "BiasQK": [bias_qk]}, dict(attrs))["Out"][0])
+    np.testing.assert_allclose(o_flash, o_einsum, rtol=2e-4, atol=2e-5)
+
+
+def test_multihead_matmul_generic_bias_keeps_einsum(monkeypatch):
+    """A generic [B,H,Sq,Sk] BiasQK has no in-kernel form — it must stay
+    on the einsum path even when the kernel is eligible."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops import attention_ops
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.ops.registry import OPS
+    B, S, H, D = 2, 128, 2, 32
+    x = _mhm_qkv_packed(B, S, H, D)
+    bias_qk = jnp.asarray(
+        np.random.RandomState(1).uniform(-1, 0, (B, H, S, S)), jnp.float32)
+
+    def boom(*a, **kw):
+        raise AssertionError("generic bias must not reach the flash kernel")
+
+    monkeypatch.setattr(attention_ops, "flash_attention", boom)
+    with fa.interpret_guard():
+        o = np.asarray(OPS.get("multihead_matmul").kernel(
+            {"Input": [x], "W": [None], "Bias": [None],
+             "BiasQK": [bias_qk]},
+            {"head_number": H, "alpha": 1.0 / np.sqrt(D)})["Out"][0])
+    assert np.isfinite(o).all()
