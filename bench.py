@@ -268,12 +268,14 @@ def bench_wide_deep(batch=4096, steps=20, warmup=5):
 
 
 def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
-                       sparse_dim=int(2.5e6)):
+                       sparse_dim=int(2.5e6), n_trainers=2):
     """Wide&Deep CTR with ≥1e9 embedding parameters over the distributed
     PS plane (BASELINE.md sparse-scale row): 26 deep [2.5M, 16] + 26 wide
     [2.5M, 1] per-slot tables, row-sharded across pserver subprocesses as
     init-on-touch lazy tables (fleet_wrapper.h DownpourSparseTable role).
-    Measures end-to-end trainer samples/sec including the RPC pulls."""
+    ``n_trainers`` data-parallel trainers train in lock step through the
+    sync plane (trainer 0 in-process, the rest as subprocesses); the row
+    reports the SUMMED samples/sec. Includes the RPC pulls."""
     import socket
     import numpy as np
 
@@ -292,6 +294,7 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
     workers = []
+    trainer_procs = []
     try:
         import tempfile
         logfiles = []
@@ -303,7 +306,8 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
             logfiles.append(lf)
             workers.append(subprocess.Popen(
                 [sys.executable, "-m", "tools.wide_deep_ps_worker",
-                 "pserver", eps, str(i), str(sparse_dim)],
+                 "pserver", eps, str(i), str(sparse_dim),
+                 str(n_trainers)],
                 env=env, stdout=lf, stderr=subprocess.STDOUT))
         deadline = time.time() + 180
         for w, lf in zip(workers, logfiles):
@@ -321,26 +325,69 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
                                        + lf.name)
                 time.sleep(0.3)
 
+        # trainers 1..N-1 as subprocesses, lock-stepped with trainer 0
+        # through the sync barriers (same warmup+steps count)
+        trainer_outs, trainer_logs = [], []
+        for tid in range(1, n_trainers):
+            tf = tempfile.NamedTemporaryFile("r", prefix=f"tr{tid}_",
+                                             suffix=".json", delete=False)
+            trainer_outs.append(tf.name)
+            tl = tempfile.NamedTemporaryFile("wb+", prefix=f"tr{tid}_",
+                                             suffix=".log", delete=False)
+            trainer_logs.append(tl)
+            trainer_procs.append(subprocess.Popen(
+                [sys.executable, "-m", "tools.wide_deep_ps_worker",
+                 "trainer", eps, str(tid), str(n_trainers),
+                 str(sparse_dim), str(batch), str(steps), str(warmup),
+                 tf.name],
+                env=env, stdout=tl, stderr=subprocess.STDOUT))
+        # startup grace: a trainer that dies before its first barrier
+        # would hang trainer 0 in the sync plane (the pserver-side
+        # dead-trainer barrier check needs one heartbeat first)
+        time.sleep(2.0)
+        for p, tl in zip(trainer_procs, trainer_logs):
+            if p.poll() is not None:
+                raise RuntimeError(
+                    f"trainer subprocess died rc={p.returncode}: "
+                    + open(tl.name, "rb").read()[-1500:].decode(
+                        errors="replace"))
+
         import paddle_tpu.fluid as fluid
         from paddle_tpu.fluid import core
         from paddle_tpu.models import wide_deep
         main_p, startup, feeds, loss, auc = W.build(sparse_dim)
-        t = W.transpile(main_p, startup, eps)
+        t = W.transpile(main_p, startup, eps, trainer_id=0,
+                        trainers=n_trainers)
         prog = t.get_trainer_program()
         exe = fluid.Executor()
         scope = core.Scope()
         nb = wide_deep.ctr_reader(batch, num_dense=13, num_slots=26,
                                   sparse_dim=sparse_dim, seed=0)
         feed = nb()
-        with fluid.scope_guard(scope):
-            exe.run(startup)
-            dt = _timed_steps(exe, prog, feed, [loss], steps, warmup)
+        from paddle_tpu.fluid.ps_rpc import WorkerHeartBeat
+        beat = WorkerHeartBeat(eps.split(","), 0, interval=1.0).start()
+        try:
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                dt = _timed_steps(exe, prog, feed, [loss], steps, warmup)
+        finally:
+            beat.stop()
+        total_sps = batch * steps / dt
+        for p, out_path, tl in zip(trainer_procs, trainer_outs,
+                                   trainer_logs):
+            p.wait(timeout=120)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"trainer subprocess rc={p.returncode}: "
+                    + open(tl.name, "rb").read()[-1500:].decode(
+                        errors="replace"))
+            total_sps += json.load(open(out_path))["samples_per_sec"]
         emb_params = 26 * sparse_dim * 16 + 26 * sparse_dim
         return {"metric": "wide_deep_1b_ps_samples_per_sec",
-                "value": round(batch * steps / dt, 1), "unit": "samples/s",
+                "value": round(total_sps, 1), "unit": "samples/s",
                 "vs_baseline": 1.0, "batch": batch,
                 "embedding_params": int(emb_params),
-                "pservers": n_pservers}
+                "pservers": n_pservers, "trainers": n_trainers}
     finally:
         try:
             from paddle_tpu.fluid.ps_rpc import VarClient
@@ -348,7 +395,7 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
                 VarClient.of(ep).stop()
         except Exception:
             pass
-        for w in workers:
+        for w in workers + trainer_procs:
             if w.poll() is None:
                 w.terminate()
             try:

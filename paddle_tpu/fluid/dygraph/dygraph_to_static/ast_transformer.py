@@ -96,6 +96,336 @@ def _contains(stmts, kinds) -> bool:
 
 
 # --------------------------------------------------------------------------
+# early-exit pre-pass: break/continue/return inside loops
+# --------------------------------------------------------------------------
+# The reference handles these in loop_transformer.py / break_continue_
+# transformer.py / return_transformer.py with the early-exit-flag recipe;
+# this pre-pass applies the same recipe BEFORE the main transform, so the
+# main transform only ever sees clean loops:
+#   * `break`    → `_jst_break_K = True`, loop test gains `not _jst_break_K`,
+#                  statements after a possible break are guarded.
+#   * `continue` → `_jst_continue_K = True` (reset each iteration),
+#                  following statements guarded.
+#   * `return e` inside a loop → function-wide return unification:
+#                  `_jst_ret_flag/_jst_ret_val` assignments, every loop the
+#                  return can escape gains `not _jst_ret_flag` in its test,
+#                  and ONE `return _jst_ret_val` is appended at the end.
+# Flags start as Python bools; convert_while_loop promotes them to BOOL
+# loop vars when the loop goes static, so a tensor-dependent
+# `if cond: break` composes into the compiled while condition.
+_RET_FLAG = "_jst_ret_flag"
+_RET_VAL = "_jst_ret_val"
+
+
+def _assign(name, value_node):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=value_node)
+
+
+def _bool_const(v):
+    return ast.Constant(value=bool(v))
+
+
+def _stores_name(node, names) -> bool:
+    """Does `node` (at any depth, skipping nested function defs) assign
+    one of `names`?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)) and sub is not node:
+            continue
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store) \
+                and sub.id in names:
+            return True
+    return False
+
+
+def _not_any(flags):
+    """`not (f1 or f2 or ...)` — converted later into tensor logic when
+    the flags go static."""
+    test = ast.Name(id=flags[0], ctx=ast.Load())
+    if len(flags) > 1:
+        test = ast.BoolOp(op=ast.Or(),
+                          values=[ast.Name(id=f, ctx=ast.Load())
+                                  for f in flags])
+    return ast.UnaryOp(op=ast.Not(), operand=test)
+
+
+def _guard_rest(stmts, flags):
+    """After any statement that may set one of `flags`, wrap the remaining
+    statements in `if not (f1 or ...): ...`."""
+    if not flags:
+        return list(stmts)
+    out = []
+    for idx, s in enumerate(stmts):
+        out.append(s)
+        rest = stmts[idx + 1:]
+        if rest and _stores_name(s, set(flags)):
+            out.append(ast.If(test=_not_any(flags),
+                              body=_guard_rest(rest, flags), orelse=[]))
+            return out
+    return out
+
+
+class _EarlyExitTransformer(ast.NodeTransformer):
+    """Rewrites break/continue/return-in-loop into flag form (see module
+    note above). Applied to one FunctionDef before DygraphToStaticAst."""
+
+    def __init__(self):
+        self._uid = 0
+        self.uses_ret = False
+
+    def run(self, fdef: ast.FunctionDef):
+        self.uses_ret = self._has_return_in_loop(fdef.body)
+        body = [self._process(s) for s in fdef.body]
+        body = _flatten(body)
+        if self.uses_ret:
+            body = self._rewrite_returns(body)
+            body = _guard_rest(body, [_RET_FLAG])
+            body = ([_assign(_RET_FLAG, _bool_const(False)),
+                     _assign(_RET_VAL, ast.Constant(value=None))]
+                    + body
+                    + [ast.Return(value=ast.Name(id=_RET_VAL,
+                                                 ctx=ast.Load()))])
+        fdef.body = body
+        return fdef
+
+    # -- analysis ---------------------------------------------------------
+    def _has_return_in_loop(self, stmts) -> bool:
+        for s in stmts:
+            for sub in ast.walk(s):
+                if isinstance(sub, (ast.While, ast.For)) \
+                        and _contains(sub.body, ast.Return):
+                    return True
+        return False
+
+    # -- recursive processing --------------------------------------------
+    def _process(self, stmt):
+        """Returns a stmt or list of stmts with loops rewritten."""
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return stmt  # nested defs keep their own control flow
+        if isinstance(stmt, ast.While):
+            return self._process_loop(stmt, for_parts=None)
+        if isinstance(stmt, ast.For):
+            return self._process_for(stmt)
+        # compound statements: process blocks in place
+        for field in ("body", "orelse", "finalbody"):
+            blk = getattr(stmt, field, None)
+            if blk:
+                setattr(stmt, field,
+                        _flatten([self._process(s) for s in blk]))
+        for h in getattr(stmt, "handlers", []) or []:
+            h.body = _flatten([self._process(s) for s in h.body])
+        return stmt
+
+    def _process_for(self, node: ast.For):
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and isinstance(node.target, ast.Name)
+                    and not node.iter.keywords)
+        direct_exits = self._direct_exits(node.body)
+        has_any_exit = direct_exits or (self.uses_ret
+                                        and _contains(node.body, ast.Return))
+        if not is_range:
+            if not has_any_exit:
+                node.body = _flatten([self._process(s) for s in node.body])
+                return node
+            # host iterable with early exits: a native break/continue
+            # cannot survive the if-branch functionization, so lower to
+            # an indexed range loop over the materialized sequence and
+            # recurse (matches the reference loop_transformer's
+            # iterable→index rewrite; generators are materialized)
+            self._uid += 1
+            seq_n = f"_jst_seq_{self._uid}"
+            idx_n = f"_jst_i_{self._uid}"
+            mk_seq = _assign(seq_n, ast.Call(
+                func=ast.Name(id="list", ctx=ast.Load()),
+                args=[node.iter], keywords=[]))
+            get_item = ast.Assign(
+                targets=[node.target],
+                value=ast.Subscript(
+                    value=ast.Name(id=seq_n, ctx=ast.Load()),
+                    slice=ast.Name(id=idx_n, ctx=ast.Load()),
+                    ctx=ast.Load()))
+            rng = ast.Call(func=ast.Name(id="range", ctx=ast.Load()),
+                           args=[ast.Call(
+                               func=ast.Name(id="len", ctx=ast.Load()),
+                               args=[ast.Name(id=seq_n, ctx=ast.Load())],
+                               keywords=[])],
+                           keywords=[])
+            lowered = ast.For(target=ast.Name(id=idx_n, ctx=ast.Store()),
+                              iter=rng, body=[get_item] + node.body,
+                              orelse=node.orelse)
+            return [mk_seq] + _as_list(self._process_for(lowered))
+        if not (direct_exits or _contains(node.body, ast.Return)):
+            node.body = _flatten([self._process(s) for s in node.body])
+            return node
+        if node.orelse:
+            raise NotImplementedError(
+                "dygraph_to_static: for/else with early exits is not "
+                "supported")
+        # lower `for i in range(...)` to a while over a HIDDEN counter,
+        # assigning `i = start + k*step` at body top — so after the loop
+        # (break OR natural exit) `i` holds its last iterate, exactly
+        # Python's for semantics; the k increment runs even on `continue`
+        self._uid += 1
+        uid = self._uid
+        i = node.target.id
+        start_n, stop_n, step_n, k_n = (
+            f"_jst_start_{uid}", f"_jst_stop_{uid}", f"_jst_step_{uid}",
+            f"_jst_k_{uid}")
+        init = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store())
+                      for n in (start_n, stop_n, step_n)],
+                ctx=ast.Store())],
+            value=_jst_call("normalize_range", list(node.iter.args)))
+        set_k = _assign(k_n, ast.Constant(value=0))
+
+        def cur_i():
+            return ast.BinOp(
+                left=ast.Name(id=start_n, ctx=ast.Load()), op=ast.Add(),
+                right=ast.BinOp(left=ast.Name(id=k_n, ctx=ast.Load()),
+                                op=ast.Mult(),
+                                right=ast.Name(id=step_n, ctx=ast.Load())))
+
+        test = _jst_call("range_cond",
+                         [cur_i(), ast.Name(id=stop_n, ctx=ast.Load()),
+                          ast.Name(id=step_n, ctx=ast.Load())])
+        set_i = _assign(i, cur_i())
+        inc = _assign(k_n, ast.BinOp(
+            left=ast.Name(id=k_n, ctx=ast.Load()), op=ast.Add(),
+            right=ast.Constant(value=1)))
+        loop = ast.While(test=test, body=[set_i] + node.body, orelse=[])
+        out = [init, set_k]
+        out.extend(_as_list(self._process_loop(loop, for_parts=(inc,))))
+        return out
+
+    def _process_loop(self, node: ast.While, for_parts):
+        # inner loops first (bottom-up), so remaining exits are OURS
+        body = _flatten([self._process(s) for s in node.body])
+        exits = self._direct_exits(body)
+        if node.orelse and exits:
+            raise NotImplementedError(
+                "dygraph_to_static: while/else with early exits is not "
+                "supported")
+        has_ret = self.uses_ret and _contains(body, ast.Return)
+        if not (exits or has_ret or _stores_name(
+                ast.Module(body=body, type_ignores=[]), {_RET_FLAG})):
+            node.body = body + list(for_parts or ())
+            return node
+        self._uid += 1
+        uid = self._uid
+        brk = f"_jst_break_{uid}" if (ast.Break in exits or has_ret) \
+            else None
+        cont = f"_jst_continue_{uid}" if ast.Continue in exits else None
+        if has_ret:
+            body = self._rewrite_returns(body)
+        body = self._rewrite_break_continue(body, brk, cont)
+        flags = [f for f in (brk, cont) if f] \
+            + ([_RET_FLAG] if _stores_name(
+                ast.Module(body=body, type_ignores=[]), {_RET_FLAG})
+               else [])
+        body = _guard_rest(body, flags)
+        if cont:
+            body = [_assign(cont, _bool_const(False))] + body
+        exit_flags = [f for f in flags if f != cont]
+        # the hidden-counter increment runs even on `continue` (Python's
+        # for advances the iterator); the loop variable itself is
+        # assigned at body TOP from the counter, so break/return leave it
+        # at its last iterate
+        body = body + list(for_parts or ())
+        pre = []
+        if brk:
+            pre.append(_assign(brk, _bool_const(False)))
+        # loop exits when a break/return flag is up
+        test = node.test
+        if exit_flags:
+            test = ast.BoolOp(op=ast.And(),
+                              values=[_not_any(exit_flags), test])
+        new_loop = ast.While(test=test, body=body, orelse=node.orelse)
+        return pre + [new_loop]
+
+    # -- exit rewriting ---------------------------------------------------
+    def _direct_exits(self, stmts):
+        """Break/Continue kinds directly in these statements (not inside
+        nested loops or function defs)."""
+        found = set()
+
+        def scan(ss):
+            for s in ss:
+                if isinstance(s, (ast.Break, ast.Continue)):
+                    found.add(type(s))
+                    continue
+                if isinstance(s, (ast.While, ast.For, ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                    continue
+                for field in ("body", "orelse", "finalbody"):
+                    blk = getattr(s, field, None)
+                    if blk:
+                        scan(blk)
+                for h in getattr(s, "handlers", []) or []:
+                    scan(h.body)
+        scan(stmts)
+        return found
+
+    def _rewrite_block(self, stmts, match, replace):
+        """Replace statements matching `match(stmt)` with `replace(stmt)`
+        (a list); statements after a replaced exit in the same list are
+        unreachable and dropped. Does not descend into loops/defs."""
+        out = []
+        for s in stmts:
+            if match(s):
+                out.extend(replace(s))
+                break  # the rest of this list is dead code
+            if not isinstance(s, (ast.While, ast.For, ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                for field in ("body", "orelse", "finalbody"):
+                    blk = getattr(s, field, None)
+                    if blk:
+                        setattr(s, field,
+                                self._rewrite_block(blk, match, replace))
+                for h in getattr(s, "handlers", []) or []:
+                    h.body = self._rewrite_block(h.body, match, replace)
+            out.append(s)
+        return out
+
+    def _rewrite_break_continue(self, stmts, brk, cont):
+        if brk:
+            stmts = self._rewrite_block(
+                stmts, lambda s: isinstance(s, ast.Break),
+                lambda s: [_assign(brk, _bool_const(True))])
+        if cont:
+            stmts = self._rewrite_block(
+                stmts, lambda s: isinstance(s, ast.Continue),
+                lambda s: [_assign(cont, _bool_const(True))])
+        return stmts
+
+    def _rewrite_returns(self, stmts, after=()):
+        def repl(s):
+            val = s.value if s.value is not None \
+                else ast.Constant(value=None)
+            # value FIRST, flag LAST: _guard_rest guards everything after
+            # the statement that stores the flag — the pair must not be
+            # split by its own guard
+            return [_assign(_RET_VAL, val),
+                    _assign(_RET_FLAG, _bool_const(True))] + list(after)
+        return self._rewrite_block(
+            stmts, lambda s: isinstance(s, ast.Return), repl)
+
+
+def _as_list(x):
+    return x if isinstance(x, list) else [x]
+
+
+def _flatten(items):
+    out = []
+    for it in items:
+        out.extend(it if isinstance(it, list) else [it])
+    return out
+
+
+# --------------------------------------------------------------------------
 # the transformer
 # --------------------------------------------------------------------------
 class DygraphToStaticAst(ast.NodeTransformer):
@@ -182,10 +512,11 @@ class DygraphToStaticAst(ast.NodeTransformer):
     def visit_While(self, node: ast.While):
         self.generic_visit(node)
         if _contains(node.body, (ast.Break, ast.Continue, ast.Return)):
+            # the early-exit pre-pass rewrites these into flag form before
+            # this transform runs — reaching here means it missed a case
             raise NotImplementedError(
-                "dygraph_to_static: break/continue/return inside a `while` "
-                "over tensors is not supported — restructure with the loop "
-                "condition")
+                "dygraph_to_static: unhandled break/continue/return inside "
+                "a `while` (early-exit pre-pass missed it) — please report")
         uid = self._uid()
         loop_vars = sorted(_assigned_in(node.body))
         args = _name_args(loop_vars)
@@ -225,27 +556,40 @@ class DygraphToStaticAst(ast.NodeTransformer):
             return node
         uid = self._uid()
         i = node.target.id
-        start_n, stop_n, step_n = (f"_jst_start_{uid}", f"_jst_stop_{uid}",
-                                   f"_jst_step_{uid}")
+        # hidden-counter lowering (same recipe as the early-exit
+        # pre-pass): `i = start + k*step` at body top keeps Python's
+        # after-loop value of the target (last iterate, not one past)
+        start_n, stop_n, step_n, k_n = (
+            f"_jst_start_{uid}", f"_jst_stop_{uid}", f"_jst_step_{uid}",
+            f"_jst_k_{uid}")
         init = ast.Assign(
             targets=[ast.Tuple(
                 elts=[ast.Name(id=n, ctx=ast.Store())
                       for n in (start_n, stop_n, step_n)],
                 ctx=ast.Store())],
             value=_jst_call("normalize_range", list(node.iter.args)))
-        set_i = ast.Assign(targets=[ast.Name(id=i, ctx=ast.Store())],
-                           value=ast.Name(id=start_n, ctx=ast.Load()))
+        set_k = ast.Assign(targets=[ast.Name(id=k_n, ctx=ast.Store())],
+                           value=ast.Constant(value=0))
+
+        def cur_i():
+            return ast.BinOp(
+                left=ast.Name(id=start_n, ctx=ast.Load()), op=ast.Add(),
+                right=ast.BinOp(left=ast.Name(id=k_n, ctx=ast.Load()),
+                                op=ast.Mult(),
+                                right=ast.Name(id=step_n, ctx=ast.Load())))
+
         test = _jst_call("range_cond",
-                         [ast.Name(id=i, ctx=ast.Load()),
-                          ast.Name(id=stop_n, ctx=ast.Load()),
+                         [cur_i(), ast.Name(id=stop_n, ctx=ast.Load()),
                           ast.Name(id=step_n, ctx=ast.Load())])
+        set_i = ast.Assign(targets=[ast.Name(id=i, ctx=ast.Store())],
+                           value=cur_i())
         inc = ast.Assign(
-            targets=[ast.Name(id=i, ctx=ast.Store())],
-            value=ast.BinOp(left=ast.Name(id=i, ctx=ast.Load()),
-                            op=ast.Add(),
-                            right=ast.Name(id=step_n, ctx=ast.Load())))
-        loop = ast.While(test=test, body=node.body + [inc], orelse=[])
-        out = [init, set_i]
+            targets=[ast.Name(id=k_n, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=k_n, ctx=ast.Load()),
+                            op=ast.Add(), right=ast.Constant(value=1)))
+        loop = ast.While(test=test, body=[set_i] + node.body + [inc],
+                         orelse=[])
+        out = [init, set_k]
         res = self.visit_While(loop)
         out.extend(res if isinstance(res, list) else [res])
         return out
@@ -305,6 +649,7 @@ def _transform_tree(fn) -> ast.Module:
     tree = ast.parse(src)
     fdef = tree.body[0]
     fdef.decorator_list = []  # strip @declarative etc. to avoid recursion
+    _EarlyExitTransformer().run(fdef)  # break/continue/return in loops
     DygraphToStaticAst().visit(tree)
     ast.fix_missing_locations(tree)
     return tree

@@ -143,6 +143,16 @@ def _as_lodtensor(data, place) -> LoDTensor:
     return t
 
 
+def _op_reads_host_values(op) -> bool:
+    """Ops whose kernels read input VALUES host-side (registry
+    host_inputs) cannot take those values as traced jit arguments."""
+    if OPS.has(op.type):
+        return bool(OPS.get(op.type).host_inputs)
+    if op.type.endswith("_grad") and OPS.has(op.type[:-5]):
+        return bool(OPS.get(op.type[:-5]).host_inputs)
+    return False
+
+
 def _op_is_stateful(op) -> bool:
     if OPS.has(op.type):
         return OPS.get(op.type).stateful
@@ -167,7 +177,7 @@ def _ops_compilable(ops) -> bool:
             sub = op.attrs.get("sub_block")
             if sub is not None and not _ops_compilable(sub.ops):
                 return False
-        elif _op_is_stateful(op):
+        elif _op_is_stateful(op) or _op_reads_host_values(op):
             return False
     return True
 

@@ -201,7 +201,27 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
     if true_out is None and false_out is None:
         return None
 
+    def _promote(v, like):
+        """Host scalar branch outputs (e.g. the early-exit transformer's
+        `flag = True`) become constants so select_input can pick between
+        a Variable and a literal."""
+        if isinstance(v, Variable) or not isinstance(v, (bool, int, float)):
+            return v
+        if isinstance(like, Variable):
+            dt = like.dtype
+        elif isinstance(v, bool):
+            dt = VarDesc.VarType.BOOL
+        elif isinstance(v, int):
+            dt = VarDesc.VarType.INT64
+        else:
+            dt = VarDesc.VarType.FP32
+        return fill_constant([1], dt, v)
+
     def _select(t, f):
+        t = _promote(t, f)
+        f = _promote(f, t)
+        if not isinstance(t, Variable) and not isinstance(f, Variable):
+            return t  # both host-side: branches agree structurally
         mask = cast(pred, VarDesc.VarType.INT32)
         o = helper.create_variable_for_type_inference(t.dtype)
         o.shape = t.shape

@@ -359,16 +359,20 @@ def _listen_and_serv(ins, attrs):
 
     def _apply_sparse(name, value, rows):
         # row-wise SGD on the host-resident table (reference async sparse
-        # update path; communicator.h AsyncCommunicator)
+        # update path; communicator.h AsyncCommunicator). In sync mode
+        # each trainer's grad is the mean over ITS shard of the global
+        # batch, so 1/fanin makes the applied sum the full-batch mean —
+        # the reference transpiler's scale(1/trainers) on the server.
+        scale = 1.0 / fanin if sync else 1.0
         pname = name[:-5] if name.endswith("@GRAD") else name
         var = scope.find_var(pname)
         val = var.value()
         if isinstance(val, core.LazyEmbeddingTable):
-            val.apply_grad(rows, value, sparse_lr)
+            val.apply_grad(rows, np.asarray(value) * scale, sparse_lr)
             return
         tbl = np.asarray(val.array)
         np.subtract.at(tbl, np.asarray(rows, np.int64),
-                       sparse_lr * np.asarray(value))
+                       sparse_lr * scale * np.asarray(value))
         var.set_value(core.LoDTensor(jnp.asarray(tbl)))
 
     def _run_block_for(grad_name):
@@ -401,13 +405,15 @@ def _listen_and_serv(ins, attrs):
         with lock:
             state["send_barriers"] += 1
             if state["send_barriers"] >= fanin:
-                # aggregate: sum each grad across trainers, run optimize
+                # aggregate: average each grad across trainers (the
+                # reference transpiler's sum + scale(1/trainers) on the
+                # server optimize path), then run optimize
                 for name, parts in state["pending"].items():
                     total = parts[0]
                     for p in parts[1:]:
                         total = total + p
                     scope.var(name).set_value(
-                        core.LoDTensor(jnp.asarray(total)))
+                        core.LoDTensor(jnp.asarray(total / len(parts))))
                 for name in list(state["pending"]):
                     _run_block_for(name)
                 state["pending"].clear()
@@ -417,7 +423,17 @@ def _listen_and_serv(ins, attrs):
             else:
                 rnd = state["round"]
                 while state["round"] == rnd:
-                    lock.wait(timeout=120.0)
+                    lock.wait(timeout=5.0)
+                    # a dead peer would leave this barrier waiting
+                    # forever — surface it to the caller as an RPC error
+                    # instead (the monitor flags workers silent past the
+                    # heartbeat timeout)
+                    dead = [d for d in monitor.dead_workers()
+                            if d != trainer_id]
+                    if dead and state["round"] == rnd:
+                        raise RuntimeError(
+                            f"sync send barrier: waiting on dead "
+                            f"trainer(s) {dead}")
         return True
 
     def h_get_var(name, trainer_id=0):

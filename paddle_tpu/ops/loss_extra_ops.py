@@ -46,6 +46,7 @@ def _pad_seqs(x, offs, maxlen=None, fill=0.0):
 # CTC (reference: warpctc_op.cc — vendored warp-ctc → log-domain scan)
 # --------------------------------------------------------------------------
 @register_op("warpctc", needs_lod=True, diff_inputs=["Logits"],
+             host_inputs=("Label",),
              attr_defaults={"blank": 0, "norm_by_times": False})
 def _warpctc(ins, attrs):
     logits = first(ins, "Logits")      # LoD [T, C] or padded [Tm, N, C]

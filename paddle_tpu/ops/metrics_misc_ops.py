@@ -377,6 +377,7 @@ def _batch_fc(ins, attrs):
 
 
 @register_op("shuffle_batch", inputs=("X", "Seed"), needs_rng=True,
+             host_inputs=("Seed",),
              attr_defaults={"startup_seed": 0})
 def _shuffle_batch(ins, attrs):
     x = first(ins, "X")

@@ -47,7 +47,7 @@ class OpInfo:
         "type", "kernel", "infer_shape", "infer_dtype", "grad_maker",
         "no_grad", "needs_rng", "stateful", "diff_input_slots",
         "diff_output_slots", "attr_defaults", "input_slots", "output_slots",
-        "needs_lod",
+        "needs_lod", "host_inputs",
     )
 
     def __init__(self, type_: str):
@@ -65,6 +65,10 @@ class OpInfo:
         self.input_slots: Optional[Sequence[str]] = None
         self.output_slots: Optional[Sequence[str]] = None
         self.needs_lod = False
+        # input slots whose VALUES the kernel reads host-side (trace-time):
+        # the executor must run blocks containing such ops interpreted —
+        # feeding them as traced jit arguments would TracerError
+        self.host_inputs: Sequence[str] = ()
 
 
 class OpInfoMap:
@@ -99,7 +103,8 @@ def register_op(type_: str, *, no_grad: bool = False, needs_rng: bool = False,
                 infer_shape: Optional[Callable] = None,
                 attr_defaults: Optional[Dict[str, Any]] = None,
                 inputs: Optional[Sequence[str]] = None,
-                outputs: Optional[Sequence[str]] = None):
+                outputs: Optional[Sequence[str]] = None,
+                host_inputs: Optional[Sequence[str]] = None):
     """Decorator registering a forward kernel under op name ``type_``.
 
     ``needs_lod``: the kernel consumes LoD (variable-length sequence)
@@ -125,6 +130,7 @@ def register_op(type_: str, *, no_grad: bool = False, needs_rng: bool = False,
         info.attr_defaults = dict(attr_defaults or {})
         info.input_slots = inputs
         info.output_slots = outputs
+        info.host_inputs = tuple(host_inputs or ())
         return fn
     return deco
 

@@ -291,7 +291,8 @@ def _unpad_indices(lens):
     return np.concatenate(rows) if rows else np.zeros((0, 2), np.int64)
 
 
-@register_op("sequence_unpad", needs_lod=True, diff_inputs=["X"])
+@register_op("sequence_unpad", needs_lod=True, diff_inputs=["X"],
+             host_inputs=("Length",))
 def _sequence_unpad(ins, attrs):
     x = first(ins, "X")          # [n, plen, ...]
     lens = _unpad_lens(ins, attrs)
@@ -311,7 +312,8 @@ def _sequence_unpad_grad_maker(op, grad_map):
     }]
 
 
-@register_op("sequence_unpad_grad", no_grad=True, needs_lod=True)
+@register_op("sequence_unpad_grad", no_grad=True, needs_lod=True,
+             host_inputs=("Length",))
 def _sequence_unpad_grad(ins, attrs):
     x = first(ins, "X")
     g = first(ins, "Out@GRAD")

@@ -200,6 +200,7 @@ def _psroi_pool(ins, attrs):
 
 @register_op("prroi_pool", inputs=("X", "ROIs", "BatchRoINums"),
              diff_inputs=("X",), needs_lod=True,
+             host_inputs=("BatchRoINums",),
              attr_defaults={"spatial_scale": 1.0, "pooled_height": 1,
                             "pooled_width": 1})
 def _prroi_pool(ins, attrs):

@@ -98,7 +98,7 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, mesh: Mesh,
         back = back.reshape(E, capacity, D)
         return _combine_local(back, info).reshape(xs.shape)
 
-    from jax.experimental.shard_map import shard_map
+    from .mesh import shard_map
     fn = shard_map(shard_fn, mesh=mesh,
                    in_specs=(P(EP_AXIS, None, None), P(None, None),
                              P(EP_AXIS, None, None), P(EP_AXIS, None),

@@ -1,0 +1,154 @@
+"""Combined-topology worker (VERDICT r2 #5): launcher-driven DP trainer
+processes x pservers hosting beyond-threshold LAZY sparse tables — the
+BASELINE.md Wide&Deep shape (reference: test_dist_base.py:506 run_trainer
++ fleet_wrapper.h:86-190 DownpourSparseTable).
+
+Trainer role (spawned by paddle_tpu.distributed.launch): brings up
+jax.distributed from the PADDLE_* env (the multi-process bring-up the
+launcher provides), transpiles a wide&deep-lite model against the PS
+plane (sync mode — the trainers are data-parallel THROUGH the pserver
+grad averaging, the reference's sync-DP semantics), trains on its half
+of a deterministic global batch, and writes per-step losses + a
+throughput row from rank 0.
+
+Pserver role: hosts its shard; the sparse table exceeds
+FLAGS_lazy_sparse_table_threshold, so it materializes as an
+init-on-touch LazyEmbeddingTable.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ["FLAGS_lazy_sparse_table_threshold"] = "1000000"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid import core  # noqa: E402
+
+STEPS = 5
+GLOBAL_BATCH = 16
+SPARSE_DIM = int(2.5e6)   # > threshold → lazy tables on the pservers
+EMB_DIM = 8
+
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        tok = fluid.data("tok", shape=[1], dtype="int64")
+        y = fluid.data("y", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(
+            tok, size=[SPARSE_DIM, EMB_DIM], is_distributed=True,
+            param_attr=fluid.ParamAttr(name="wd_emb"))
+        emb = fluid.layers.reshape(emb, [-1, EMB_DIM])
+        feat = fluid.layers.concat([x, emb], axis=1)
+        h = fluid.layers.fc(feat, 16, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def transpile(main, startup, eps, trainer_id, trainers):
+    from paddle_tpu.fluid.transpiler import DistributeTranspiler
+    t = DistributeTranspiler()
+    with fluid.program_guard(main, startup):
+        t.transpile(trainer_id=trainer_id, pservers=eps, trainers=trainers,
+                    sync_mode=True, program=main, startup_program=startup)
+    return t
+
+
+def global_batch():
+    rng = np.random.RandomState(3)
+    X = rng.rand(GLOBAL_BATCH, 4).astype("float32")
+    # ids spread over the whole [0, SPARSE_DIM) range: proves
+    # init-on-touch at beyond-RAM logical size, and hits both shards
+    toks = ((np.arange(GLOBAL_BATCH) * 104729 + 11) % SPARSE_DIM
+            ).astype("int64").reshape(-1, 1)
+    Y = (X.sum(1, keepdims=True) * 0.5).astype("float32")
+    return X, toks, Y
+
+
+def run_trainer(eps, out_path):
+    from paddle_tpu.parallel import env as penv
+    from paddle_tpu.fluid.ps_rpc import WorkerHeartBeat
+
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    tid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if trainers > 1:
+        penv.init_distributed()   # jax.distributed over the launcher env
+        assert penv.world_size() == trainers, (
+            penv.world_size(), trainers)
+
+    main, startup, loss = build()
+    t = transpile(main, startup, eps, tid, trainers)
+    prog = t.get_trainer_program()
+
+    X, toks, Y = global_batch()
+    per = GLOBAL_BATCH // trainers
+    lo, hi = tid * per, (tid + 1) * per
+
+    beat = WorkerHeartBeat(eps.split(","), tid, interval=0.5).start()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    losses = []
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                (lv,) = exe.run(prog,
+                                feed={"x": X[lo:hi], "tok": toks[lo:hi],
+                                      "y": Y[lo:hi]},
+                                fetch_list=[loss])
+                losses.append(float(np.asarray(lv).ravel()[0]))
+            dt = time.perf_counter() - t0
+    finally:
+        beat.stop()
+    # every rank reports: each trainer's loss is over ITS half of the
+    # global batch, so the cross-rank MEAN is the full-batch loss the
+    # single-process oracle computes
+    with open(f"{out_path}.r{tid}", "w") as f:
+        json.dump({"losses": losses,
+                   "samples_per_sec": per * trainers * STEPS / dt,
+                   "trainers": trainers}, f)
+
+
+def run_pserver(eps, idx, trainers):
+    main, startup, loss = build()
+    t = transpile(main, startup, eps, 0, trainers)
+    ep = eps.split(",")[idx]
+    pprog = t.get_pserver_program(ep)
+    pstart = t.get_startup_program(ep, pprog)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(pstart)
+        tbl = scope.find_var("wd_emb")
+        lazy = tbl is not None and isinstance(tbl.value(),
+                                              core.LazyEmbeddingTable)
+        print(f"PSERVER_READY lazy={lazy}", flush=True)
+        exe.run(pprog)  # blocks until stop rpc
+
+
+def main():
+    role = sys.argv[1]
+    if role == "pserver":
+        run_pserver(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+    elif role == "trainer":
+        run_trainer(sys.argv[2], sys.argv[3])
+    else:
+        raise SystemExit(f"unknown role {role!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -394,3 +394,48 @@ def test_distributed_lookup_empty_ids_keeps_embedding_dim(monkeypatch):
              "_ctx": ctx})
     (res,) = outs["Outputs"]
     assert tuple(res.shape) == (0, 16), res.shape
+
+
+def test_recv_save_writes_reference_format_blob(tmp_path):
+    """recv_save (reference recv_save_op.cc): fetch parameter slices
+    from pservers and persist the concatenation in the reference
+    LoDTensor serialization; the saved blob round-trips through the io
+    deserializer."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+    from paddle_tpu.fluid.io import _deserialize_lod_tensor
+
+    w0 = np.arange(6, dtype=np.float32).reshape(3, 2)
+    w1 = np.arange(6, 14, dtype=np.float32).reshape(4, 2)
+    store = {}
+    handlers = {
+        "send_var": lambda name, value, trainer_id=0, rows=None,
+        height=0: store.__setitem__(name, np.asarray(value)),
+        "get_var": lambda name, trainer_id=0: store[name],
+    }
+    srv = VarServer(f"127.0.0.1:{free_port()}", handlers).start()
+    ep = f"127.0.0.1:{srv.port}"
+    path = str(tmp_path / "w.blob")
+    try:
+        cli = VarClient.of(ep)
+        cli.send_var("w.block0", w0)
+        cli.send_var("w.block1", w1)
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            prog.global_block().append_op(
+                type="recv_save", inputs={}, outputs={},
+                attrs={"endpoints": [ep, ep], "file_path": path,
+                       "shape": [7, 2],
+                       "remote_varnames": ["w.block0", "w.block1"]})
+        exe = fluid.Executor()
+        with fluid.scope_guard(core.Scope()):
+            exe.run(prog, feed={}, fetch_list=[])
+        blob = open(path, "rb").read()
+        t = _deserialize_lod_tensor(blob)
+        np.testing.assert_array_equal(np.asarray(t.array),
+                                      np.concatenate([w0, w1]))
+    finally:
+        srv.shutdown()
+        VarClient.reset_pool()

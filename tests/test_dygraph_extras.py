@@ -88,7 +88,7 @@ def test_gan_style_alternating_optimizers():
             opt_d.minimize(loss_d)
             gen.clear_gradients()
             disc.clear_gradients()
-            d_losses.append(float(loss_d.numpy()))
+            d_losses.append(float(loss_d.numpy().ravel()[0]))
 
             # --- generator step
             fake = gen(dygraph.to_variable(noise))
@@ -100,7 +100,7 @@ def test_gan_style_alternating_optimizers():
             opt_g.minimize(loss_g)
             gen.clear_gradients()
             disc.clear_gradients()
-            g_losses.append(float(loss_g.numpy()))
+            g_losses.append(float(loss_g.numpy().ravel()[0]))
 
         # adversarial training ran: finite losses, and the generator's
         # output distribution moved toward the real mean

@@ -128,7 +128,7 @@ def test_declarative_grad_flows():
         y = np.ones((2, 4), "float32") @ w + b
         scale = 2.0 if y.sum() > 0 else 3.0
         expect = float((y * scale).sum())
-        np.testing.assert_allclose(float(loss.numpy()), expect, rtol=1e-5)
+        np.testing.assert_allclose(float(loss.numpy().ravel()[0]), expect, rtol=1e-5)
 
 
 def test_declarative_training_converges():
@@ -156,7 +156,7 @@ def test_declarative_training_converges():
             loss.backward()
             opt.minimize(loss)
             net.clear_gradients()
-            v = float(loss.numpy())
+            v = float(loss.numpy().ravel()[0])
             first = first if first is not None else v
             last = v
         assert last < first * 0.2, (first, last)
@@ -328,3 +328,193 @@ def test_traced_layer_save_inference_model(tmp_path):
         prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
         got = exe.run(prog, feed={feeds[0]: x}, fetch_list=fetches)
     np.testing.assert_allclose(got[0], expect, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------- early exits in loops
+# (reference: dygraph_to_static/loop_transformer.py +
+#  break_continue_transformer.py + return_transformer.py test cases from
+#  unittests/dygraph_to_static/test_break_continue.py, test_return.py)
+def test_host_break_in_while():
+    def f(a):
+        s = 0
+        while True:
+            s = s + a
+            if s > 10:
+                break
+        return s
+    g = convert_to_static(f)
+    for a in (3, 5, 11):
+        assert g(a) == f(a), a
+
+
+def test_host_continue_in_for_range():
+    def f(n):
+        s = 0
+        for i in range(n):
+            if i % 2 == 0:
+                continue
+            s = s + i
+        return s
+    g = convert_to_static(f)
+    for n in (0, 1, 7, 10):
+        assert g(n) == f(n), n
+
+
+def test_host_break_in_for_range():
+    def f(n):
+        s = 0
+        for i in range(n):
+            if i > 4:
+                break
+            s = s + i
+        return s
+    g = convert_to_static(f)
+    for n in (0, 3, 9):
+        assert g(n) == f(n), n
+
+
+def test_host_return_inside_while():
+    def f(a):
+        s = 0
+        while s < 100:
+            s = s + a
+            if s > 10:
+                return s * 10
+        return s
+    g = convert_to_static(f)
+    for a in (3, 200):
+        assert g(a) == f(a), a
+
+
+def test_host_return_inside_for_and_after():
+    def f(n):
+        for i in range(n):
+            if i == 3:
+                return "early"
+        return "late"
+    g = convert_to_static(f)
+    assert g(10) == "early" and g(2) == "late"
+
+
+def test_host_nested_loop_break_continue():
+    def f(n, m):
+        total = 0
+        for i in range(n):
+            if i == 4:
+                break
+            j = 0
+            while j < m:
+                j = j + 1
+                if j % 2 == 0:
+                    continue
+                total = total + 1
+        return total
+    g = convert_to_static(f)
+    for n, m in [(2, 3), (6, 4), (0, 5)]:
+        assert g(n, m) == f(n, m), (n, m)
+
+
+def test_host_return_from_nested_loop():
+    def f(n):
+        for i in range(n):
+            for j in range(n):
+                if i * j > 6:
+                    return i * 10 + j
+        return -1
+    g = convert_to_static(f)
+    for n in (2, 5):
+        assert g(n) == f(n), n
+
+
+def test_host_break_in_plain_for_iterable():
+    def f(xs):
+        s = 0
+        for v in xs:
+            if v < 0:
+                break
+            s = s + v
+        return s
+    g = convert_to_static(f)
+    assert g([1, 2, -1, 5]) == 3
+    assert g([1, 2, 3]) == 6
+
+
+def test_host_return_in_plain_for_iterable():
+    def f(xs):
+        for v in xs:
+            if v > 10:
+                return v
+        return 0
+    g = convert_to_static(f)
+    assert g([1, 20, 3]) == 20 and g([1, 2]) == 0
+
+
+def test_tensor_break_in_while():
+    @declarative
+    def f(x):
+        while fluid.layers.reduce_sum(x) < 100.0:
+            x = x * 2.0
+            if fluid.layers.reduce_sum(x) > 20.0:
+                break
+        return x
+
+    # sums: 4 -> 8 -> 16 -> 32 (>20 breaks)
+    x = np.ones((4,), "float32")
+    np.testing.assert_allclose(_run_decl(f, x), np.full((4,), 8.0),
+                               rtol=1e-6)
+
+
+def test_tensor_continue_in_for_range():
+    @declarative
+    def f(x):
+        s = x * 0.0
+        for i in range(6):
+            if fluid.layers.reduce_sum(s) > 6.0:
+                continue
+            s = s + x
+        return s
+
+    # adds until sum exceeds 6 (x of ones(2): sums 2,4,6,8 stop), then
+    # skips remaining iterations
+    x = np.ones((2,), "float32")
+    np.testing.assert_allclose(_run_decl(f, x), np.full((2,), 4.0),
+                               rtol=1e-6)
+
+
+def test_transformed_source_has_no_raw_break():
+    def f(a):
+        s = 0
+        while s < 10:
+            s = s + a
+            if s > 5:
+                break
+        return s
+    src = transformed_source(f)
+    import re
+    assert not re.search(r"(?<![\w])break(?![\w])", src), src
+    assert "_jst_break_" in src and "convert_while_loop" in src
+
+
+def test_host_break_leaves_loop_var_at_exit_value():
+    """Python semantics: on break, the for variable keeps its current
+    value (the increment is skipped)."""
+    def f(n):
+        for i in range(10):
+            if i == n:
+                break
+        return i
+    g = convert_to_static(f)
+    for n in (0, 3, 9, 12):
+        assert g(n) == f(n), (n, g(n), f(n))
+
+
+def test_host_continue_still_advances_loop_var():
+    def f():
+        out = []
+        for i in range(6):
+            if i % 2 == 0:
+                continue
+            out.append(i)
+        return out, i
+    g = convert_to_static(f)
+    assert g() == f()

