@@ -592,9 +592,12 @@ class _GlobalFlags:
         # sparse tables with at least this many elements are hosted as
         # init-on-touch LazyEmbeddingTable on pservers (beyond-HBM scale)
         "FLAGS_lazy_sparse_table_threshold": 1 << 26,
-        # reuse the device copy when the SAME ndarray object is fed again
-        # (skips per-step device_put; unsafe with in-place feed mutation)
-        "FLAGS_feed_device_cache": False,
+        # reuse the device copy when the SAME ndarray object with the
+        # SAME content fingerprint is fed again (skips the per-step
+        # device_put — the dominant host cost of a small step); the
+        # fingerprint makes this safe under in-place mutation, so it is
+        # ON by default
+        "FLAGS_feed_device_cache": True,
     }
 
     def __init__(self):

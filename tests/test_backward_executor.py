@@ -279,3 +279,34 @@ def test_feed_device_cache_correctness():
         np.testing.assert_allclose(o2, X2 * 2.0, rtol=1e-6)
     finally:
         core.set_flag("FLAGS_feed_device_cache", old)
+
+
+def test_feed_device_cache_default_on_and_mutation_safe():
+    """The feed→device cache is ON by default and must be SAFE: an
+    in-place mutation of a previously-fed ndarray changes the content
+    fingerprint, so the stale device copy is not reused (round-2 weak
+    item: the cache was opt-in precisely because mutation was
+    undetectable)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    assert core.globals_["FLAGS_feed_device_cache"] is True
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[3], dtype="float32")
+        out = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    X = np.asarray([[1.0, 2.0, 3.0]], np.float32)
+    with fluid.scope_guard(scope):
+        (a,) = exe.run(main, feed={"x": X}, fetch_list=[out])
+        # cache hit: same object, same content → same device tensor
+        t1 = exe._feed_device_cached("x", X)
+        t2 = exe._feed_device_cached("x", X)
+        assert t1 is t2
+        X[0, 0] = 100.0      # in-place mutation
+        (b,) = exe.run(main, feed={"x": X}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(a)[0], [2.0, 4.0, 6.0])
+    np.testing.assert_allclose(np.asarray(b)[0], [200.0, 4.0, 6.0])

@@ -70,3 +70,41 @@ def test_native_loader_accepts_golden_program():
     report = inspect_program_bytes(_golden("golden_fc.program.pb"))
     assert not report.get("errors"), report
     assert report.get("num_ops", 2) == 2 or report.get("ops") is not None
+
+
+def test_golden_inference_model_dir_loads_and_runs():
+    """VERDICT r2 #10: a reference-format save_inference_model DIRECTORY
+    (__model__ + per-param LoDTensor streams, generated via protoc over
+    the reference framework.proto) loads through BOTH the executor
+    load_inference_model path and the AnalysisPredictor IR pipeline
+    (reference analysis_predictor.cc:288)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu import inference
+
+    model_dir = os.path.join(FIX, "golden_infer_model")
+    exp = np.load(os.path.join(FIX, "golden_expected.npz"))
+    x = np.random.RandomState(5).rand(3, 4).astype(np.float32)
+    want = x @ exp["w"] + exp["b"]
+
+    # executor path
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        prog, feeds, fetches = fluid.io.load_inference_model(model_dir,
+                                                             exe)
+        assert feeds == ["x"]
+        (got,) = exe.run(prog, feed={"x": x}, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-6)
+
+    # AnalysisPredictor path (IR pass pipeline; mul+add fuse to fc)
+    cfg = inference.Config(model_dir)
+    predictor = inference.create_predictor(cfg)
+    (name,) = predictor.get_input_names()
+    h = predictor.get_input_handle(name)
+    h.copy_from_cpu(x)
+    predictor.run()
+    (oname,) = predictor.get_output_names()
+    out = predictor.get_output_handle(oname).copy_to_cpu()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)

@@ -98,3 +98,72 @@ open(f"{OUT}/golden_seq.lodtensor", "wb").write(
     tensor_stream(seq, lod=[[0, 2, 5]]))
 np.savez(f"{OUT}/golden_expected.npz", w=w, b=b, seq=seq)
 print("fixtures written")
+
+
+# --------------------------------------------------------------------------
+# golden save_inference_model DIRECTORY (reference io.py save_inference_model
+# layout consumed by analysis_predictor.cc:288 — __model__ program with
+# feed/fetch ops + one reference-format LoDTensor stream file per param)
+# --------------------------------------------------------------------------
+ipd = ref_pb.ProgramDesc()
+ipd.version.version = 0
+iblk = ipd.blocks.add()
+iblk.idx = 0
+iblk.parent_idx = -1
+
+
+def iadd_var(name, shape, vtype=LOD_TENSOR, persistable=False,
+             need_check_feed=False):
+    v = iblk.vars.add()
+    v.name = name
+    v.type.type = vtype
+    if vtype == LOD_TENSOR:
+        v.type.lod_tensor.tensor.data_type = FP32
+        v.type.lod_tensor.tensor.dims.extend(shape)
+    v.persistable = persistable
+    v.need_check_feed = need_check_feed
+    return v
+
+
+iadd_var("feed", [], vtype=ref_pb.VarType.FEED_MINIBATCH, persistable=True)
+iadd_var("fetch", [], vtype=ref_pb.VarType.FETCH_LIST, persistable=True)
+iadd_var("x", [-1, 4], need_check_feed=True)
+iadd_var("fc_w", [4, 3], persistable=True)
+iadd_var("fc_b", [3], persistable=True)
+iadd_var("tmp_mul", [-1, 3])
+iadd_var("out", [-1, 3])
+
+
+def iop(type_, ins, outs, attrs=()):
+    op = iblk.ops.add()
+    op.type = type_
+    for slot, args in ins:
+        iv = op.inputs.add()
+        iv.parameter = slot
+        iv.arguments.extend(args)
+    for slot, args in outs:
+        ov = op.outputs.add()
+        ov.parameter = slot
+        ov.arguments.extend(args)
+    for name, val in attrs:
+        a = op.attrs.add()
+        a.name = name
+        a.type = ref_pb.INT
+        a.i = val
+    return op
+
+
+iop("feed", [("X", ["feed"])], [("Out", ["x"])], [("col", 0)])
+iop("mul", [("X", ["x"]), ("Y", ["fc_w"])], [("Out", ["tmp_mul"])],
+    [("x_num_col_dims", 1), ("y_num_col_dims", 1)])
+iop("elementwise_add", [("X", ["tmp_mul"]), ("Y", ["fc_b"])],
+    [("Out", ["out"])], [("axis", -1)])
+iop("fetch", [("X", ["out"])], [("Out", ["fetch"])], [("col", 0)])
+
+model_dir = os.path.join(OUT, "golden_infer_model")
+os.makedirs(model_dir, exist_ok=True)
+with open(os.path.join(model_dir, "__model__"), "wb") as f:
+    f.write(ipd.SerializeToString())
+open(os.path.join(model_dir, "fc_w"), "wb").write(tensor_stream(w))
+open(os.path.join(model_dir, "fc_b"), "wb").write(tensor_stream(b))
+print("inference model dir written:", model_dir)
