@@ -310,3 +310,40 @@ def test_feed_device_cache_default_on_and_mutation_safe():
         (b,) = exe.run(main, feed={"x": X}, fetch_list=[out])
     np.testing.assert_allclose(np.asarray(a)[0], [2.0, 4.0, 6.0])
     np.testing.assert_allclose(np.asarray(b)[0], [200.0, 4.0, 6.0])
+
+
+def test_feed_device_cache_detects_inplace_shuffle():
+    """A row shuffle / element swap leaves a word-SUM unchanged — the
+    CRC32 fingerprint must catch it (review finding: permutation-
+    invariant fingerprints silently reuse stale device data under the
+    classic np.random.shuffle(X) training loop)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[2], dtype="float64")
+        out = fluid.layers.scale(x, scale=1.0)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    X = np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float64)
+    with fluid.scope_guard(scope):
+        (a,) = exe.run(main, feed={"x": X}, fetch_list=[out])
+        X[[0, 1]] = X[[1, 0]]          # in-place row swap, sum unchanged
+        (b,) = exe.run(main, feed={"x": X}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(a), [[1., 2.], [3., 4.]])
+    np.testing.assert_allclose(np.asarray(b), [[3., 4.], [1., 2.]])
+
+
+def test_feed_device_cache_gives_up_on_fresh_arrays():
+    """A name fed a fresh ndarray each step (dataloader pattern) must
+    stop being fingerprinted after a short miss streak."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    exe = fluid.Executor()
+    for i in range(20):
+        exe._feed_device_cached("x", np.full((4,), float(i), np.float32))
+    assert exe._feed_cache.get("x") == "uncacheable"

@@ -25,44 +25,63 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 FP32 = ref_pb.VarType.FP32
 LOD_TENSOR = ref_pb.VarType.LOD_TENSOR
 
-pd = ref_pb.ProgramDesc()
-pd.version.version = 0
-blk = pd.blocks.add()
-blk.idx = 0
-blk.parent_idx = -1
+def new_program():
+    pd = ref_pb.ProgramDesc()
+    pd.version.version = 0
+    blk = pd.blocks.add()
+    blk.idx = 0
+    blk.parent_idx = -1
+    return pd, blk
 
 
-def add_var(name, shape, persistable=False, need_check_feed=False):
+def add_var(blk, name, shape, vtype=LOD_TENSOR, persistable=False,
+            need_check_feed=False):
     v = blk.vars.add()
     v.name = name
-    v.type.type = LOD_TENSOR
-    v.type.lod_tensor.tensor.data_type = FP32
-    v.type.lod_tensor.tensor.dims.extend(shape)
+    v.type.type = vtype
+    if vtype == LOD_TENSOR:
+        v.type.lod_tensor.tensor.data_type = FP32
+        v.type.lod_tensor.tensor.dims.extend(shape)
     v.persistable = persistable
     v.need_check_feed = need_check_feed
     return v
 
 
-add_var("x", [-1, 4], need_check_feed=True)
-add_var("fc_w", [4, 3], persistable=True)
-add_var("fc_b", [3], persistable=True)
-add_var("tmp_mul", [-1, 3])
-add_var("out", [-1, 3])
+def add_op(blk, type_, ins, outs, attrs=()):
+    op = blk.ops.add()
+    op.type = type_
+    for slot, args in ins:
+        iv = op.inputs.add()
+        iv.parameter = slot
+        iv.arguments.extend(args)
+    for slot, args in outs:
+        ov = op.outputs.add()
+        ov.parameter = slot
+        ov.arguments.extend(args)
+    for name, val in attrs:
+        a = op.attrs.add()
+        a.name = name
+        a.type = ref_pb.INT
+        a.i = val
+    return op
 
-mul = blk.ops.add()
-mul.type = "mul"
-iv = mul.inputs.add(); iv.parameter = "X"; iv.arguments.append("x")
-iv = mul.inputs.add(); iv.parameter = "Y"; iv.arguments.append("fc_w")
-ov = mul.outputs.add(); ov.parameter = "Out"; ov.arguments.append("tmp_mul")
-a = mul.attrs.add(); a.name = "x_num_col_dims"; a.type = ref_pb.INT; a.i = 1
-a = mul.attrs.add(); a.name = "y_num_col_dims"; a.type = ref_pb.INT; a.i = 1
 
-add_op_add = blk.ops.add()
-add_op_add.type = "elementwise_add"
-iv = add_op_add.inputs.add(); iv.parameter = "X"; iv.arguments.append("tmp_mul")
-iv = add_op_add.inputs.add(); iv.parameter = "Y"; iv.arguments.append("fc_b")
-ov = add_op_add.outputs.add(); ov.parameter = "Out"; ov.arguments.append("out")
-a = add_op_add.attrs.add(); a.name = "axis"; a.type = ref_pb.INT; a.i = -1
+def add_fc_body(blk):
+    """The shared x·W+b body both golden programs carry."""
+    add_var(blk, "x", [-1, 4], need_check_feed=True)
+    add_var(blk, "fc_w", [4, 3], persistable=True)
+    add_var(blk, "fc_b", [3], persistable=True)
+    add_var(blk, "tmp_mul", [-1, 3])
+    add_var(blk, "out", [-1, 3])
+    add_op(blk, "mul", [("X", ["x"]), ("Y", ["fc_w"])],
+           [("Out", ["tmp_mul"])],
+           [("x_num_col_dims", 1), ("y_num_col_dims", 1)])
+    add_op(blk, "elementwise_add", [("X", ["tmp_mul"]), ("Y", ["fc_b"])],
+           [("Out", ["out"])], [("axis", -1)])
+
+
+pd, blk = new_program()
+add_fc_body(blk)
 
 os.makedirs(OUT, exist_ok=True)
 with open(f"{OUT}/golden_fc.program.pb", "wb") as f:
@@ -105,60 +124,20 @@ print("fixtures written")
 # layout consumed by analysis_predictor.cc:288 — __model__ program with
 # feed/fetch ops + one reference-format LoDTensor stream file per param)
 # --------------------------------------------------------------------------
-ipd = ref_pb.ProgramDesc()
-ipd.version.version = 0
-iblk = ipd.blocks.add()
-iblk.idx = 0
-iblk.parent_idx = -1
-
-
-def iadd_var(name, shape, vtype=LOD_TENSOR, persistable=False,
-             need_check_feed=False):
-    v = iblk.vars.add()
-    v.name = name
-    v.type.type = vtype
-    if vtype == LOD_TENSOR:
-        v.type.lod_tensor.tensor.data_type = FP32
-        v.type.lod_tensor.tensor.dims.extend(shape)
-    v.persistable = persistable
-    v.need_check_feed = need_check_feed
-    return v
-
-
-iadd_var("feed", [], vtype=ref_pb.VarType.FEED_MINIBATCH, persistable=True)
-iadd_var("fetch", [], vtype=ref_pb.VarType.FETCH_LIST, persistable=True)
-iadd_var("x", [-1, 4], need_check_feed=True)
-iadd_var("fc_w", [4, 3], persistable=True)
-iadd_var("fc_b", [3], persistable=True)
-iadd_var("tmp_mul", [-1, 3])
-iadd_var("out", [-1, 3])
-
-
-def iop(type_, ins, outs, attrs=()):
-    op = iblk.ops.add()
-    op.type = type_
-    for slot, args in ins:
-        iv = op.inputs.add()
-        iv.parameter = slot
-        iv.arguments.extend(args)
-    for slot, args in outs:
-        ov = op.outputs.add()
-        ov.parameter = slot
-        ov.arguments.extend(args)
-    for name, val in attrs:
-        a = op.attrs.add()
-        a.name = name
-        a.type = ref_pb.INT
-        a.i = val
-    return op
-
-
-iop("feed", [("X", ["feed"])], [("Out", ["x"])], [("col", 0)])
-iop("mul", [("X", ["x"]), ("Y", ["fc_w"])], [("Out", ["tmp_mul"])],
-    [("x_num_col_dims", 1), ("y_num_col_dims", 1)])
-iop("elementwise_add", [("X", ["tmp_mul"]), ("Y", ["fc_b"])],
-    [("Out", ["out"])], [("axis", -1)])
-iop("fetch", [("X", ["out"])], [("Out", ["fetch"])], [("col", 0)])
+ipd, iblk = new_program()
+add_var(iblk, "feed", [], vtype=ref_pb.VarType.FEED_MINIBATCH,
+        persistable=True)
+add_var(iblk, "fetch", [], vtype=ref_pb.VarType.FETCH_LIST,
+        persistable=True)
+# feed op first, then the shared body, then fetch — the reference
+# save_inference_model op order
+tmp = ref_pb.ProgramDesc()
+tmp_blk = tmp.blocks.add()
+add_op(tmp_blk, "feed", [("X", ["feed"])], [("Out", ["x"])], [("col", 0)])
+add_fc_body(iblk)
+iblk.ops.insert(0, tmp_blk.ops[0])
+add_op(iblk, "fetch", [("X", ["out"])], [("Out", ["fetch"])],
+       [("col", 0)])
 
 model_dir = os.path.join(OUT, "golden_infer_model")
 os.makedirs(model_dir, exist_ok=True)
