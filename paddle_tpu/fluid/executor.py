@@ -788,14 +788,12 @@ class Executor:
             return None
         prefix = (id(data), data.__array_interface__["data"][0],
                   data.shape, data.dtype.str)
-        if entry is not None and entry[0] == prefix:
-            fp = Executor._feed_fingerprint(data)
-            if fp == entry[1]:
-                entry[4][0] = 0
-                return entry[3]
         fp = Executor._feed_fingerprint(data)
         if fp is None:
             return None
+        if entry is not None and entry[0] == prefix and fp == entry[1]:
+            entry[4][0] = 0
+            return entry[3]
         if entry is not None and entry[0] != prefix:
             misses = entry[4]
             misses[0] += 1

@@ -39,7 +39,8 @@ def test_profiler_collects_and_reports(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "Profiling Report" in out
     assert "compiled_step" in out
-    trace = json.load(open(ppath))
+    with open(ppath) as _pf:
+        trace = json.load(_pf)
     names = {e["name"] for e in trace["traceEvents"]}
     assert "compiled_step" in names
     assert len(trace["traceEvents"]) >= 3
@@ -292,3 +293,21 @@ def test_api_signatures_tool():
     assert len(lines) > 150
     assert not any("import failed" in l for l in lines)
     assert any(l.startswith("paddle_tpu.fluid.layers.fc(") for l in lines)
+
+
+def test_mfu_report_xla_cost_analysis():
+    """tools/mfu_report.py (perf pre-staging): XLA's own cost analysis of
+    the FULL compiled train step — flops, bytes accessed, arithmetic
+    intensity — plus measured step time, one JSON-able dict."""
+    import json
+    from tools.mfu_report import report
+
+    out = report("mnist", steps=2, warmup=1)
+    assert out["xla_flops_per_step"] > 1e6
+    assert out["step_ms"] > 0
+    # bytes-accessed keys are optional per the tool's contract (some
+    # jax/backends omit "bytes accessed" from cost_analysis)
+    if "xla_bytes_accessed" in out:
+        assert out["xla_bytes_accessed"] > 0
+        assert out["flops_per_byte"] > 0
+    json.dumps(out)

@@ -1,0 +1,165 @@
+"""MFU report from XLA's OWN cost analysis of the compiled train step
+(pre-staged for the first live TPU window; reference counterpart:
+operators/benchmark/op_tester.cc's measure-don't-assert discipline, plus
+the BASELINE.md "≥45% MFU" bar this framework is judged against).
+
+Instead of the hand 6·N·D FLOP formula, this lowers the FULL fluid
+program (fwd+bwd+optimizer, the same _CompiledBlock step the executor
+runs) and asks the compiler: `compiled.cost_analysis()["flops"]`. MFU is
+then measured-time against peak. Optionally captures a profiler trace
+directory for TensorBoard/XProf offline reading.
+
+Usage:
+    python -m tools.mfu_report [bert|mnist] [--trace-dir DIR]
+Emits one JSON line:
+    {"model": ..., "xla_flops_per_step": ..., "step_ms": ...,
+     "achieved_tflops": ..., "mfu_vs_v5e_bf16_peak": ..., "backend": ...}
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+V5E_PEAK_FLOPS = 197e12  # bf16 per chip
+
+
+def compiled_step_of(exe):
+    """The executor's jitted step for the LAST program it ran (its
+    _CompiledBlock), for lowering/cost analysis."""
+    if not exe._compiled_cache:
+        raise RuntimeError("run the program once before asking for its "
+                           "compiled step")
+    return list(exe._compiled_cache.values())[-1]
+
+
+def analyze(cb, scope, feed_arrays, rng):
+    """Lower the step and return XLA's cost analysis dict. Reuses the
+    executor's OWN jitted step (cb._jitted), so the already-compiled
+    train step is not re-compiled — on TPU that second compile would
+    roughly double the tool's wall time."""
+    mut = {n: scope.find_var(n).get_tensor().array for n in cb.mut_state}
+    ro = {n: scope.find_var(n).get_tensor().array for n in cb.ro_state}
+    lowered = cb._jitted.lower(mut, ro, feed_arrays, rng)
+    cost = lowered.compile().cost_analysis()
+    if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+        cost = cost[0]
+    return cost or {}
+
+
+def report(model="bert", steps=10, warmup=3, trace_dir=None):
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    backend = jax.devices()[0].platform
+    smoke = backend == "cpu"
+    if model == "bert":
+        from paddle_tpu.models import bert
+        core.set_flag("FLAGS_use_bf16_matmul", True)
+        cfg = bert.bert_base_config()
+        if smoke:
+            cfg.update(layers=2, hidden=128, heads=2, ffn=256)
+            batch, seq_len, steps, warmup = 4, 64, 3, 1
+        else:
+            batch, seq_len = 256, 128
+        main, startup, feeds, fetches = bert.build_bert_pretrain_program(
+            cfg, seq_len=seq_len, dropout=0.0, lr=1e-4)
+        rng_np = np.random.RandomState(0)
+        n_mask = max(1, int(batch * seq_len * 0.15))
+        feed = {
+            "src_ids": rng_np.randint(0, cfg["vocab_size"],
+                                      (batch, seq_len)).astype("int64"),
+            "pos_ids": np.tile(np.arange(seq_len),
+                               (batch, 1)).astype("int64"),
+            "sent_ids": np.zeros((batch, seq_len), "int64"),
+            "mask_pos": rng_np.randint(0, batch * seq_len,
+                                       (n_mask, 1)).astype("int64"),
+            "mask_label": rng_np.randint(0, cfg["vocab_size"],
+                                         (n_mask, 1)).astype("int64"),
+        }
+        fetch_list = fetches
+    else:
+        batch = 64
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.data("img", shape=[784], dtype="float32")
+            label = fluid.data("label", shape=[1], dtype="int64")
+            h = fluid.layers.fc(img, 256, act="relu")
+            pred = fluid.layers.fc(h, 10, act="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(pred, label))
+            fluid.optimizer.SGD(0.01).minimize(loss)
+        rng_np = np.random.RandomState(0)
+        feed = {"img": rng_np.rand(batch, 784).astype("float32"),
+                "label": rng_np.randint(0, 10, (batch, 1)).astype("int64")}
+        fetch_list = [loss]
+
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=fetch_list,
+                return_numpy=False)          # compile + cache
+        cb = compiled_step_of(exe)
+        feed_arrays = {k: core._to_device_array(v)
+                       for k, v in feed.items()}
+        cost = analyze(cb, scope, feed_arrays, jax.random.key(0))
+
+        def timed():
+            for _ in range(warmup):
+                exe.run(main, feed=feed, fetch_list=fetch_list,
+                        return_numpy=False)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                o = exe.run(main, feed=feed, fetch_list=fetch_list,
+                            return_numpy=False)
+            _ = np.asarray(o[0].array).ravel()[:1]
+            return (time.perf_counter() - t0) / steps
+
+        if trace_dir:
+            import jax.profiler
+            with jax.profiler.trace(trace_dir):
+                dt = timed()
+        else:
+            dt = timed()
+
+    flops = float(cost.get("flops", 0.0))
+    out = {"model": model, "xla_flops_per_step": flops,
+           "step_ms": round(dt * 1e3, 3),
+           "achieved_tflops": round(flops / dt / 1e12, 3) if flops else 0.0,
+           "mfu_vs_v5e_bf16_peak": round(flops / dt / V5E_PEAK_FLOPS, 4)
+           if flops else 0.0,
+           "batch": batch, "backend": backend}
+    if cost.get("bytes accessed") is not None:
+        ba = float(cost["bytes accessed"])
+        out["xla_bytes_accessed"] = ba
+        # arithmetic intensity — below ~240 flops/byte the step is
+        # HBM-bound on v5e (197e12 / 819e9)
+        out["flops_per_byte"] = round(flops / ba, 2) if ba else 0.0
+    if smoke:
+        out["cpu_smoke"] = True
+    if trace_dir:
+        out["trace_dir"] = trace_dir
+    return out
+
+
+def main():
+    model = "bert"
+    trace_dir = None
+    args = sys.argv[1:]
+    if args and not args[0].startswith("-"):
+        model = args[0]
+        args = args[1:]
+    if "--trace-dir" in args:
+        i = args.index("--trace-dir")
+        if i + 1 >= len(args) or args[i + 1].startswith("-"):
+            raise SystemExit("--trace-dir requires a directory argument")
+        trace_dir = args[i + 1]
+    print(json.dumps(report(model, trace_dir=trace_dir)))
+
+
+if __name__ == "__main__":
+    main()
