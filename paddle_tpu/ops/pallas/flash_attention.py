@@ -27,9 +27,12 @@ with pl.when (upper-triangular blocks cost nothing).
 
 CPU/tests: `interpret_mode(True)` (or PADDLE_TPU_FLASH_INTERPRET=1) runs
 the very same kernels through the Pallas interpreter so the suite
-exercises the real kernel, not a fallback. Shapes the kernel doesn't
-support (S not divisible by the block) take the pure-XLA reference path,
-which is differentiable as-is.
+exercises the real kernel, not a fallback. Ragged lengths (S or Sk not
+divisible by the block) STAY on the kernel: boundary blocks are handled
+by in-kernel bounds masking, with padded tile regions zeroed at load
+(they are uninitialized — NaN under the interpreter — and 0·NaN would
+leak through the contractions). The pure-XLA reference path remains
+only for backends with no Pallas at all.
 """
 from __future__ import annotations
 
@@ -88,6 +91,24 @@ def _on_tpu() -> bool:
         return jax.default_backend() not in ("cpu",)
     except Exception:
         return False
+
+
+def _mask_cols(s, k_start, blk_q, blk_k, sk_len):
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+    return jnp.where(cols < sk_len, s, NEG_INF)
+
+
+def _zero_pad_rows(t, start, limit):
+    """Zero a tile's rows past the true length — padded regions of a
+    boundary block are UNINITIALIZED (NaN under the interpreter), and
+    0·NaN = NaN would leak through the contractions."""
+    rows = start + jax.lax.broadcasted_iota(jnp.int32, t.shape, 0)
+    return jnp.where(rows < limit, t, 0.0)
+
+
+def _valid_rows(q_start, blk_q, blk_k, s_len):
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+    return rows < s_len
 
 
 def _mask_scores(s, q_start, k_start, blk_q, blk_k):
@@ -167,7 +188,7 @@ def _append_bias_input(in_specs, args, bias, H, blk_k, k_axis):
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref,
                 *, sm_scale, causal, blk_q, blk_k, dropout_rate,
-                has_bias):
+                has_bias, sk_len=0):
     bh, qi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -191,6 +212,10 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
             # reference BiasQK padding-mask form); clamped so -inf masks
             # can't produce inf-inf → NaN in the rescale
             s = s + jnp.maximum(bias_ref[0][None, :], NEG_INF)
+        if sk_len:
+            # ragged Sk: the last K block is padded — mask the columns
+            # past the true length (padded bias/K values are overridden)
+            s = _mask_cols(s, k_start, blk_q, blk_k, sk_len)
         if causal:
             s = _mask_scores(s, q_start, k_start, blk_q, blk_k)
         m_prev = m_ref[:, :1]                             # [blk_q, 1]
@@ -206,9 +231,11 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
             keep = _keep_mask(seed_ref[0], bh, q_start, k_start,
                               blk_q, blk_k, dropout_rate)
             p = p * keep.astype(p.dtype) / (1.0 - dropout_rate)
+        v = v_ref[0].astype(jnp.float32)
+        if sk_len:
+            v = _zero_pad_rows(v, k_start, sk_len)
         acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
-            p, v_ref[0].astype(jnp.float32),
-            preferred_element_type=jnp.float32)
+            p, v, preferred_element_type=jnp.float32)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -242,11 +269,12 @@ def _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
     B, H, S, D = q.shape
     Sk = k.shape[2]
     qf, kf, vf = (t.reshape(B * H, t.shape[2], D) for t in (q, k, v))
-    grid = (B * H, S // blk_q, Sk // blk_k)
+    grid = (B * H, pl.cdiv(S, blk_q), pl.cdiv(Sk, blk_k))
     has_bias = bias is not None
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                              blk_q=blk_q, blk_k=blk_k,
-                             dropout_rate=dropout_rate, has_bias=has_bias)
+                             dropout_rate=dropout_rate, has_bias=has_bias,
+                             sk_len=0 if Sk % blk_k == 0 else Sk)
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),                # seed
         pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
@@ -282,7 +310,7 @@ def _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
 def _bwd_kv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                    delta_ref, bias_ref, dk_ref, dv_ref, dk_acc, dv_acc,
                    *, sm_scale, causal, blk_q, blk_k, dropout_rate,
-                   has_bias):
+                   has_bias, s_len=0, sk_len=0):
     bh, ki, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -299,6 +327,9 @@ def _bwd_kv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         kk = k_ref[0].astype(jnp.float32)
         vv = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
+        if s_len:
+            q = _zero_pad_rows(q, q_start, s_len)
+            do = _zero_pad_rows(do, q_start, s_len)
         lse = lse_ref[0][:, None]                         # [blk_q, 1]
         delta = delta_ref[0][:, None]
         s = jax.lax.dot_general(
@@ -309,6 +340,11 @@ def _bwd_kv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         if causal:
             s = _mask_scores(s, q_start, k_start, blk_q, blk_k)
         p = jnp.exp(s - lse)                              # [blk_q, blk_k]
+        if s_len:
+            # ragged S: padded Q/dO/lse/delta rows would contribute
+            # garbage to EVERY dk/dv column — zero their probabilities
+            p = jnp.where(_valid_rows(q_start, blk_q, blk_k, s_len),
+                          p, 0.0)
         dp = jax.lax.dot_general(
             do, vv, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # dO·Vᵀ
@@ -327,6 +363,10 @@ def _bwd_kv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             p_eff, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # p'ᵀ·dO
         ds = p * (dp - delta) * sm_scale
+        if s_len:
+            # padded lse/delta rows are NaN and 0·NaN = NaN — hard-zero
+            ds = jnp.where(_valid_rows(q_start, blk_q, blk_k, s_len),
+                           ds, 0.0)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # dsᵀ·Q
@@ -347,7 +387,7 @@ def _bwd_kv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def _bwd_q_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                   delta_ref, bias_ref, dq_ref, dq_acc,
                   *, sm_scale, causal, blk_q, blk_k, dropout_rate,
-                  has_bias):
+                  has_bias, sk_len=0):
     bh, qi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -363,6 +403,9 @@ def _bwd_q_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         kk = k_ref[0].astype(jnp.float32)
         vv = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
+        if sk_len:
+            kk = _zero_pad_rows(kk, k_start, sk_len)
+            vv = _zero_pad_rows(vv, k_start, sk_len)
         lse = lse_ref[0][:, None]
         delta = delta_ref[0][:, None]
         s = jax.lax.dot_general(
@@ -370,6 +413,9 @@ def _bwd_q_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32) * sm_scale
         if has_bias:
             s = s + jnp.maximum(bias_ref[0][None, :], NEG_INF)
+        if sk_len:
+            # ragged Sk: padded K/V columns must not leak into dq
+            s = _mask_cols(s, k_start, blk_q, blk_k, sk_len)
         if causal:
             s = _mask_scores(s, q_start, k_start, blk_q, blk_k)
         p = jnp.exp(s - lse)
@@ -407,6 +453,8 @@ def _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal, blk_q, blk_k,
     delta = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32), -1)
     interp = _INTERPRET and not _on_tpu()
     has_bias = bias is not None
+    ragged_s = 0 if S % blk_q == 0 else S
+    ragged_sk = 0 if Sk % blk_k == 0 else Sk
     common = dict(sm_scale=sm_scale, causal=causal, blk_q=blk_q,
                   blk_k=blk_k, dropout_rate=dropout_rate,
                   has_bias=has_bias)
@@ -426,10 +474,11 @@ def _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal, blk_q, blk_k,
 
     dk, dv = pl.pallas_call(
         _with_optional_bias(
-            functools.partial(_bwd_kv_kernel, **common), 7, has_bias),
+            functools.partial(_bwd_kv_kernel, s_len=ragged_s,
+                              sk_len=ragged_sk, **common), 7, has_bias),
         out_shape=(jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
                    jax.ShapeDtypeStruct((BH, Sk, D), v.dtype)),
-        grid=(BH, Sk // blk_k, S // blk_q),
+        grid=(BH, pl.cdiv(Sk, blk_k), pl.cdiv(S, blk_q)),
         in_specs=kv_specs,
         out_specs=(pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
                    pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0))),
@@ -454,9 +503,10 @@ def _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal, blk_q, blk_k,
 
     dq = pl.pallas_call(
         _with_optional_bias(
-            functools.partial(_bwd_q_kernel, **common), 7, has_bias),
+            functools.partial(_bwd_q_kernel, sk_len=ragged_sk, **common),
+            7, has_bias),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-        grid=(BH, S // blk_q, Sk // blk_k),
+        grid=(BH, pl.cdiv(S, blk_q), pl.cdiv(Sk, blk_k)),
         in_specs=q_specs,
         out_specs=pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
         scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
@@ -490,20 +540,28 @@ def block_override(blk_q, blk_k):
         _BLOCK_OVERRIDE = prev
 
 
+def _round_up8(n):
+    return max(8, ((n + 7) // 8) * 8)
+
+
 def _block_sizes(S, Sk):
+    """Ragged S/Sk are supported via in-kernel bounds masking, so blocks
+    need not divide the lengths; small inputs still shrink the block (to
+    an 8-multiple, the f32 sublane tile) to bound padding waste."""
     if _BLOCK_OVERRIDE is not None:
-        return min(_BLOCK_OVERRIDE[0], S), min(_BLOCK_OVERRIDE[1], Sk)
-    blk_q = min(DEFAULT_BLOCK_Q, S)
-    blk_k = min(DEFAULT_BLOCK_K, Sk)
+        return (min(_BLOCK_OVERRIDE[0], _round_up8(S)),
+                min(_BLOCK_OVERRIDE[1], _round_up8(Sk)))
+    blk_q = min(DEFAULT_BLOCK_Q, _round_up8(S))
+    blk_k = min(DEFAULT_BLOCK_K, _round_up8(Sk))
     return blk_q, blk_k
 
 
 def _pallas_ok(q, k):
-    if not _HAS_PALLAS or not (_on_tpu() or _INTERPRET):
-        return False
-    S, Sk = q.shape[2], k.shape[2]
-    blk_q, blk_k = _block_sizes(S, Sk)
-    return S % blk_q == 0 and Sk % blk_k == 0
+    # ragged lengths are handled in-kernel (bounds masking); the only
+    # remaining requirement is a Pallas backend (TPU, or the interpreter
+    # for tests). q/k stay in the signature for future shape gating.
+    del q, k
+    return _HAS_PALLAS and (_on_tpu() or _INTERPRET)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
@@ -560,8 +618,8 @@ def flash_attention(q, k, v, sm_scale, causal=False, dropout_rate=0.0,
                              causal, float(dropout_rate))
     if dropout_rate > 0.0:
         raise NotImplementedError(
-            "attention dropout requires the Pallas path (shapes "
-            "divisible by the block size)")
+            "attention dropout requires the Pallas path (a TPU backend "
+            "or interpret_mode(True))")
     o = _ref_attention(q, k, v, sm_scale, causal) if bias is None else \
         _ref_attention_bias(q, k, v, sm_scale, causal, bias)
     return o

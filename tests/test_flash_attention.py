@@ -89,14 +89,41 @@ def test_bf16_inputs():
                                rtol=2e-2, atol=2e-2)
 
 
-def test_fallback_on_odd_shapes():
-    """S > block and not divisible by it: the XLA reference path serves
-    it (S <= block just shrinks the block to S)."""
-    q, k, v = _rand_qkv(1, 1, 192, 16, seed=6)
-    assert not fa._pallas_ok(q, k)
+@pytest.mark.parametrize("S,Sk", [(192, 192), (100, 100), (130, 75),
+                                  (100, 256)])
+def test_ragged_shapes_stay_on_kernel(S, Sk):
+    """Non-block-divisible lengths run the Pallas kernels via in-kernel
+    bounds masking (padded rows/cols contribute nothing) — no einsum
+    fallback, forward AND grads."""
+    r = np.random.RandomState(6)
+    q = jnp.asarray(r.normal(size=(1, 2, S, 16)).astype(np.float32))
+    k = jnp.asarray(r.normal(size=(1, 2, Sk, 16)).astype(np.float32))
+    v = jnp.asarray(r.normal(size=(1, 2, Sk, 16)).astype(np.float32))
+    assert fa._pallas_ok(q, k)
     out = fa.flash_attention(q, k, v, 0.25, False)
     ref = fa._ref_attention(q, k, v, 0.25, False)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+    def loss(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, 0.25, False) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(fa._ref_attention(q, k, v, 0.25, False) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ragged_causal_matches_reference():
+    q, k, v = _rand_qkv(1, 2, 100, 16, seed=8)
+    out = fa.flash_attention(q, k, v, 0.25, causal=True)
+    ref = fa._ref_attention(q, k, v, 0.25, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
 
 
 # ---------------------------------------------------------------- dropout

@@ -49,20 +49,24 @@ def analyze(cb, scope, feed_arrays, rng):
     return cost or {}
 
 
-def report(model="bert", steps=10, warmup=3, trace_dir=None):
+def report(model="bert", steps=None, warmup=None, trace_dir=None):
     import jax
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import core
 
     backend = jax.devices()[0].platform
     smoke = backend == "cpu"
+    # explicit caller args always win; defaults shrink on the CPU smoke
+    steps = steps if steps is not None else (3 if smoke else 10)
+    warmup = warmup if warmup is not None else (1 if smoke else 3)
+    prev_bf16 = core.globals_["FLAGS_use_bf16_matmul"]
     if model == "bert":
         from paddle_tpu.models import bert
         core.set_flag("FLAGS_use_bf16_matmul", True)
         cfg = bert.bert_base_config()
         if smoke:
             cfg.update(layers=2, hidden=128, heads=2, ffn=256)
-            batch, seq_len, steps, warmup = 4, 64, 3, 1
+            batch, seq_len = 4, 64
         else:
             batch, seq_len = 256, 128
         main, startup, feeds, fetches = bert.build_bert_pretrain_program(
@@ -119,12 +123,15 @@ def report(model="bert", steps=10, warmup=3, trace_dir=None):
             _ = np.asarray(o[0].array).ravel()[:1]
             return (time.perf_counter() - t0) / steps
 
-        if trace_dir:
-            import jax.profiler
-            with jax.profiler.trace(trace_dir):
+        try:
+            if trace_dir:
+                import jax.profiler
+                with jax.profiler.trace(trace_dir):
+                    dt = timed()
+            else:
                 dt = timed()
-        else:
-            dt = timed()
+        finally:
+            core.set_flag("FLAGS_use_bf16_matmul", prev_bf16)
 
     flops = float(cost.get("flops", 0.0))
     out = {"model": model, "xla_flops_per_step": flops,
