@@ -31,9 +31,12 @@ def test_run_config_dropout_deterministic():
     assert row["dropout_deterministic"] is True
 
 
-def test_run_config_indivisible_block_skipped():
-    row = flash_smoke.run_config(100, 64, 64, interpret=True)
-    assert row["status"] == "skipped"
+def test_run_config_ragged_runs_on_kernel():
+    row = flash_smoke.run_config(100, 64, 64, B=1, H=2, steps=2,
+                                 interpret=True)
+    assert row["status"] == "ok", row
+    assert row["ragged"] is True
+    assert row["max_err_fwd"] < 2e-2
 
 
 def test_run_config_never_raises_on_compile_error(monkeypatch):

@@ -58,8 +58,7 @@ def run_config(S, blk_q, blk_k, *, B=4, H=8, D=64, dtype="bfloat16",
            "dropout": dropout,
            "vmem_kb_est": round(_vmem_kb_estimate(blk_q, blk_k, D, True), 1)}
     if S % blk_q or S % blk_k:
-        row.update(status="skipped", error="S not divisible by block")
-        return row
+        row["ragged"] = True  # boundary blocks masked in-kernel
     # the custom-vjp backward kernels are traced when the grad is built,
     # AFTER the wrapped forward returns — so the interpret/block
     # overrides must span the whole computation, not just the fwd call
@@ -165,12 +164,16 @@ def sweep(on_tpu, emit=print):
                 r = run_config(S, bq, bk, interpret=not on_tpu)
                 rows.append(r)
                 emit(json.dumps(r))
-    # causal + dropout legs on the best-known block config
+    # causal + dropout + ragged legs on the best-known block config
     for (S, bq, bk) in dchecks:
         r = run_config(S, bq, bk, causal=True, interpret=not on_tpu)
         rows.append(r)
         emit(json.dumps(r))
         r = run_config(S, bq, bk, dropout=0.1, interpret=not on_tpu)
+        rows.append(r)
+        emit(json.dumps(r))
+        # ragged boundary block (S not a multiple of the block)
+        r = run_config(S - S // 4 - 3, bq, bk, interpret=not on_tpu)
         rows.append(r)
         emit(json.dumps(r))
     return rows
