@@ -213,6 +213,19 @@ def is_compiled_with_cuda() -> bool:
     return is_compiled_with_tpu()
 
 
+def start_forked_quietly(procs):
+    """Start fork-context worker processes with the fork-under-threads
+    warnings suppressed: fork is deliberate at these call sites (reader
+    closures can't be pickled for spawn) and the children never touch
+    JAX, so an inherited JAX-internal lock can't deadlock them."""
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        warnings.simplefilter("ignore", RuntimeWarning)
+        for p in procs:
+            p.start()
+
+
 def _as_place(place) -> Place:
     if place is None:
         return TPUPlace(0) if is_compiled_with_tpu() else CPUPlace()

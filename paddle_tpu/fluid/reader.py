@@ -148,15 +148,7 @@ class _GeneratorLoader:
                 meta_q.put(("error", repr(e)))
 
         proc = ctx.Process(target=producer, daemon=True)
-        # fork-under-threads DeprecationWarning: fork is deliberate (the
-        # user's generator closure cannot be pickled for spawn), and the
-        # child only runs the generator + numpy + shared_memory — it
-        # never touches JAX, so an inherited JAX-internal lock can't
-        # deadlock it. Scope the suppression to the start() call only.
-        import warnings as _warnings
-        with _warnings.catch_warnings():
-            _warnings.simplefilter("ignore", DeprecationWarning)
-            proc.start()
+        core.start_forked_quietly([proc])
 
         def _unlink_meta(meta):
             for shm_name, _, _ in meta.values():

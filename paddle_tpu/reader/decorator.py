@@ -200,8 +200,8 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
 
         procs = [ctx.Process(target=worker, args=(r,), daemon=True)
                  for r in readers]
-        for p in procs:
-            p.start()
+        from ..fluid.core import start_forked_quietly
+        start_forked_quietly(procs)
         finished = 0
         try:
             while finished < len(readers):
