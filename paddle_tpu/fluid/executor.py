@@ -84,8 +84,16 @@ class _FetchHandlerMonitor:
             v = self._scope.find_var(vname)
             if v is None or not v.is_initialized():
                 res[name] = None
-            else:
+                continue
+            try:
                 res[name] = np.asarray(v.get_tensor().array)
+            except (RuntimeError, TypeError):
+                # RuntimeError: donated state buffer invalidated between
+                # the scope read and the host copy (the training step
+                # aliases it in place). TypeError: non-LoDTensor holder
+                # (e.g. SelectedRows) has no dense tensor view.
+                # Monitoring is best-effort — report None.
+                res[name] = None
         return res
 
     def _loop(self):
