@@ -407,13 +407,38 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
 def bench_flash():
     """Pallas flash-attention Mosaic bring-up: compile (no interpret),
     parity vs einsum, block-size sweep. Per-config JSON rows go to
-    stderr; the contract line (summary) is the return value."""
+    stderr AND are banked in tools/flash_rows.jsonl — a tunnel window
+    can close mid-sweep, and the next run resumes from the banked ok
+    rows instead of restarting. The contract line (summary over all
+    banked rows) is the return value."""
     import jax
     from tools import flash_smoke
     backend = jax.devices()[0].platform
-    rows = flash_smoke.sweep(on_tpu=backend not in ("cpu",),
-                             emit=lambda s: print(s, file=sys.stderr))
-    return flash_smoke.summarize(rows, backend)
+    on_tpu = backend not in ("cpu",)
+    bank = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "flash_rows.jsonl")
+    prior, done = [], set()
+    if on_tpu and os.path.exists(bank):
+        kfp = flash_smoke.kernel_fingerprint()
+        for line in open(bank):
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            # rows banked under an OLDER kernel neither satisfy nor
+            # pollute a resumed sweep — re-measure them
+            if r.get("status") == "ok" and r.get("kfp") == kfp:
+                prior.append(r)
+                done.add(flash_smoke.config_key(r))
+
+    def emit(s):
+        print(s, file=sys.stderr)
+        if on_tpu:
+            with open(bank, "a") as f:
+                f.write(s + "\n")
+
+    rows = flash_smoke.sweep(on_tpu=on_tpu, emit=emit, done=done)
+    return flash_smoke.summarize(prior + rows, backend)
 
 
 def main():

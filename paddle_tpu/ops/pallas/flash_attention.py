@@ -54,6 +54,11 @@ except Exception:  # pragma: no cover
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30  # finite mask value: avoids inf-inf → NaN in the rescale
+# Mosaic requires the last two dims of every block shape to be divisible
+# by (8, 128) or equal to the array dims. Row-statistics arrays (lse,
+# delta) therefore carry a broadcast 128-lane minor dimension on the
+# wire — the same layout jax's own TPU flash kernel uses for l/m.
+LANES = 128
 
 _INTERPRET = os.environ.get("PADDLE_TPU_FLASH_INTERPRET", "") in ("1", "true")
 
@@ -168,18 +173,20 @@ def _with_optional_bias(kernel, n_named, has_bias):
 
 
 def _append_bias_input(in_specs, args, bias, H, blk_k, k_axis):
-    """Append the [B, Sk] key-padding bias input (cast once to f32).
+    """Append the key-padding bias input as [B, 1, Sk] (cast once to
+    f32) — the middle singleton makes the block's second-to-last dim
+    equal to the array dim, which Mosaic accepts for any size.
     ``k_axis``: which grid dimension indexes K blocks (1 for the bwd-kv
     kernel, 2 for fwd/bwd-q)."""
     if bias is None:
         return
     if k_axis == 1:
-        spec = pl.BlockSpec((1, blk_k), lambda b, j, i: (b // H, j))
+        spec = pl.BlockSpec((1, 1, blk_k), lambda b, j, i: (b // H, 0, j))
     else:
-        spec = pl.BlockSpec((1, blk_k), lambda b, i, j: (b // H, j))
+        spec = pl.BlockSpec((1, 1, blk_k), lambda b, i, j: (b // H, 0, j))
     in_specs.append(spec)
-    args.append(bias if bias.dtype == jnp.float32
-                else bias.astype(jnp.float32))
+    args.append(bias.astype(jnp.float32).reshape(bias.shape[0], 1,
+                                                 bias.shape[-1]))
 
 
 # --------------------------------------------------------------------------
@@ -208,10 +215,10 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [blk_q, blk_k]
         if has_bias:
-            # key-padding bias [B, Sk] broadcast over query rows (the
+            # key-padding bias [B, 1, Sk] broadcast over query rows (the
             # reference BiasQK padding-mask form); clamped so -inf masks
             # can't produce inf-inf → NaN in the rescale
-            s = s + jnp.maximum(bias_ref[0][None, :], NEG_INF)
+            s = s + jnp.maximum(bias_ref[0], NEG_INF)
         if sk_len:
             # ragged Sk: the last K block is padded — mask the columns
             # past the true length (padded bias/K values are overridden)
@@ -260,8 +267,8 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         safe_l = jnp.where(dead, 1.0, l)
         o_ref[0] = jnp.where(dead, 0.0,
                              acc_ref[...] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = jnp.where(dead[:, 0], -NEG_INF,
-                               m[:, 0] + jnp.log(safe_l[:, 0]))
+        lse_col = jnp.where(dead, -NEG_INF, m + jnp.log(safe_l))
+        lse_ref[0] = jnp.broadcast_to(lse_col, lse_ref.shape[1:])
 
 
 def _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
@@ -287,11 +294,12 @@ def _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
     o, lse = pl.pallas_call(
         _with_optional_bias(kern, 4, has_bias),
         out_shape=(jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-                   jax.ShapeDtypeStruct((B * H, S), jnp.float32)),
+                   jax.ShapeDtypeStruct((B * H, S, LANES), jnp.float32)),
         grid=grid,
         in_specs=in_specs,
         out_specs=(pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
-                   pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i))),
+                   pl.BlockSpec((1, blk_q, LANES),
+                                lambda b, i, j: (b, i, 0))),
         scratch_shapes=[
             pltpu.VMEM((blk_q, D), jnp.float32),
             pltpu.VMEM((blk_q, 128), jnp.float32),
@@ -301,7 +309,9 @@ def _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_INTERPRET and not _on_tpu(),
     )(*args)
-    return o.reshape(B, H, S, D), lse.reshape(B, H, S)
+    # lse stays in its (B·H, S, LANES) wire form — the backward consumes
+    # it as-is, so no slice-then-rebroadcast materialization
+    return o.reshape(B, H, S, D), lse
 
 
 # --------------------------------------------------------------------------
@@ -330,13 +340,13 @@ def _bwd_kv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         if s_len:
             q = _zero_pad_rows(q, q_start, s_len)
             do = _zero_pad_rows(do, q_start, s_len)
-        lse = lse_ref[0][:, None]                         # [blk_q, 1]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]                           # [blk_q, 1]
+        delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(
             q, kk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         if has_bias:
-            s = s + jnp.maximum(bias_ref[0][None, :], NEG_INF)
+            s = s + jnp.maximum(bias_ref[0], NEG_INF)
         if causal:
             s = _mask_scores(s, q_start, k_start, blk_q, blk_k)
         p = jnp.exp(s - lse)                              # [blk_q, blk_k]
@@ -406,13 +416,13 @@ def _bwd_q_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         if sk_len:
             kk = _zero_pad_rows(kk, k_start, sk_len)
             vv = _zero_pad_rows(vv, k_start, sk_len)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(
             q, kk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         if has_bias:
-            s = s + jnp.maximum(bias_ref[0][None, :], NEG_INF)
+            s = s + jnp.maximum(bias_ref[0], NEG_INF)
         if sk_len:
             # ragged Sk: padded K/V columns must not leak into dq
             s = _mask_cols(s, k_start, blk_q, blk_k, sk_len)
@@ -449,8 +459,13 @@ def _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal, blk_q, blk_k,
     BH = B * H
     qf, kf, vf, of, gf = (t.reshape(BH, t.shape[2], D)
                           for t in (q, k, v, o, g))
-    lsef = lse.reshape(BH, S)
-    delta = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32), -1)
+    # row statistics enter the kernels with the broadcast 128-lane minor
+    # dim (see LANES), materialized HERE as transients — the residual
+    # held from forward to backward is the 2-D (BH, S) slice, 1/128th
+    # the memory (at S=2048 the lane form would pin 32 MB per layer).
+    lsef = jnp.broadcast_to(lse.reshape(BH, S)[:, :, None], (BH, S, LANES))
+    delta2 = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32), -1)
+    delta = jnp.broadcast_to(delta2[:, :, None], (BH, S, LANES))
     interp = _INTERPRET and not _on_tpu()
     has_bias = bias is not None
     ragged_s = 0 if S % blk_q == 0 else S
@@ -465,12 +480,11 @@ def _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal, blk_q, blk_k,
         pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),   # k
         pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),   # v
         pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0)),   # do
-        pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i)),         # lse
-        pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i)),         # delta
+        pl.BlockSpec((1, blk_q, LANES), lambda b, j, i: (b, i, 0)),  # lse
+        pl.BlockSpec((1, blk_q, LANES), lambda b, j, i: (b, i, 0)),  # delta
     ]
     kv_args = [seed, qf, kf, vf, gf, lsef, delta]
-    bias_f32 = None if bias is None else bias.astype(jnp.float32)
-    _append_bias_input(kv_specs, kv_args, bias_f32, H, blk_k, k_axis=1)
+    _append_bias_input(kv_specs, kv_args, bias, H, blk_k, k_axis=1)
 
     dk, dv = pl.pallas_call(
         _with_optional_bias(
@@ -495,11 +509,11 @@ def _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal, blk_q, blk_k,
         pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),   # k
         pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),   # v
         pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),   # do
-        pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),         # lse
-        pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),         # delta
+        pl.BlockSpec((1, blk_q, LANES), lambda b, i, j: (b, i, 0)),  # lse
+        pl.BlockSpec((1, blk_q, LANES), lambda b, i, j: (b, i, 0)),  # delta
     ]
     q_args = [seed, qf, kf, vf, gf, lsef, delta]
-    _append_bias_input(q_specs, q_args, bias_f32, H, blk_k, k_axis=2)
+    _append_bias_input(q_specs, q_args, bias, H, blk_k, k_axis=2)
 
     dq = pl.pallas_call(
         _with_optional_bias(
@@ -540,19 +554,17 @@ def block_override(blk_q, blk_k):
         _BLOCK_OVERRIDE = prev
 
 
-def _round_up8(n):
-    return max(8, ((n + 7) // 8) * 8)
-
-
 def _block_sizes(S, Sk):
     """Ragged S/Sk are supported via in-kernel bounds masking, so blocks
-    need not divide the lengths; small inputs still shrink the block (to
-    an 8-multiple, the f32 sublane tile) to bound padding waste."""
+    need not divide the lengths. Inputs smaller than the default block
+    use the EXACT dimension as the block — a block equal to the array
+    dim is always Mosaic-legal regardless of (8, 128) alignment, so tiny
+    and tiny-ragged shapes lower without padding games."""
     if _BLOCK_OVERRIDE is not None:
-        return (min(_BLOCK_OVERRIDE[0], _round_up8(S)),
-                min(_BLOCK_OVERRIDE[1], _round_up8(Sk)))
-    blk_q = min(DEFAULT_BLOCK_Q, _round_up8(S))
-    blk_k = min(DEFAULT_BLOCK_K, _round_up8(Sk))
+        bq, bk = _BLOCK_OVERRIDE
+        return (S if S <= bq else bq), (Sk if Sk <= bk else bk)
+    blk_q = S if S <= DEFAULT_BLOCK_Q else DEFAULT_BLOCK_Q
+    blk_k = Sk if Sk <= DEFAULT_BLOCK_K else DEFAULT_BLOCK_K
     return blk_q, blk_k
 
 
@@ -576,7 +588,9 @@ def _fp_fwd(q, k, v, seed, bias, sm_scale, causal, dropout_rate):
     blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2])
     o, lse = _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
                          dropout_rate, bias=bias)
-    return o, (q, k, v, o, lse, seed, bias)
+    # residual: the 2-D row stat, not the 128-lane wire form (128× less
+    # memory held across fwd→bwd; the bwd re-broadcasts transiently)
+    return o, (q, k, v, o, lse[:, :, 0], seed, bias)
 
 
 def _fp_bwd(sm_scale, causal, dropout_rate, res, g):

@@ -72,10 +72,14 @@ def test_lse_residual():
     q, k, v = _rand_qkv(1, 1, 128, 32, seed=4)
     seed = jnp.zeros((1,), jnp.int32)
     o, lse = fa._pallas_fwd(q, k, v, seed, 0.2, False, 128, 128)
+    # wire form: (B·H, S, LANES) with the row stat broadcast across lanes
+    assert lse.shape == (1, 128, fa.LANES)
+    lse_np = np.asarray(lse)
+    assert (lse_np == lse_np[:, :, :1]).all()
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.2
     ref_lse = jax.scipy.special.logsumexp(s, axis=-1)
-    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
-                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(lse_np[:, :, 0].reshape(1, 1, 128),
+                               np.asarray(ref_lse), rtol=1e-5, atol=1e-5)
 
 
 def test_bf16_inputs():
