@@ -185,6 +185,15 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
             continue
         info = OPS.get(op.type) if OPS.has(op.type) else None
         if info is not None and info.grad_maker is not None:
+            # a params-reachable branch no loss-grad flows into (e.g. an
+            # auxiliary head outside the fetched loss) reaches custom
+            # makers with every output grad EMPTY — apply the generic
+            # path's has_any_ograd rule BEFORE the maker runs instead of
+            # handing kernels a None cotangent. (Not a desc-level filter:
+            # makers like the quant STE emit descs whose grad inputs sit
+            # in plain slots such as assign's "X".)
+            if not any(n in grad_map for n in op.output_arg_names):
+                continue
             descs = info.grad_maker(op, {**{n: grad_map.get(n, EMPTY_VAR)
                                             for n in op.output_arg_names},
                                          **{n: grad_var_name(n)
@@ -211,6 +220,19 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
                 for pn, gn in zip(fwd_names, names):
                     if gn != EMPTY_VAR:
                         grad_map.setdefault(pn, gn)
+        # custom makers' descs need not mirror the primal slots (e.g.
+        # dropout_grad has no "X" input; the quant STE emits a plain
+        # assign) — without this fallback their input grads were never
+        # recorded and every op upstream of a dropout/quant silently got
+        # EMPTY cotangents (models trained only their heads). The makers
+        # receive grads under the grad_var_name convention, so any desc
+        # output matching grad_var_name(input) IS that input's grad.
+        produced = {n2 for d in descs
+                    for ns in d["outputs"].values() for n2 in ns}
+        for pn in op.input_arg_names:
+            gn = grad_var_name(pn)
+            if gn in produced:
+                grad_map.setdefault(pn, gn)
 
     # gradient fan-in: rename duplicate writes, insert sum ops
     write_counts: Dict[str, int] = {}

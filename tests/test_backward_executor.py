@@ -347,3 +347,85 @@ def test_feed_device_cache_gives_up_on_fresh_arrays():
     for i in range(20):
         exe._feed_device_cached("x", np.full((4,), float(i), np.float32))
     assert exe._feed_cache.get("x") == "uncacheable"
+
+
+def _train_two_steps(build_mid):
+    """fc1 → <mid> → fc2 → loss, SGD, 2 steps; returns fc1's weight
+    before/after (the canary for grads flowing PAST a custom-grad op)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, 8)
+        canary = main.all_parameters()[0].name  # fc1's weight
+        h = build_mid(fluid, h)
+        loss = fluid.layers.mean(fluid.layers.fc(h, 2))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    X = np.random.RandomState(0).rand(3, 4).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(
+            scope.find_var(canary).get_tensor().array).copy()
+        for _ in range(2):
+            (l,) = exe.run(main, feed={"x": X}, fetch_list=[loss])
+        assert np.isfinite(np.asarray(l)).all()
+        w1 = np.asarray(scope.find_var(canary).get_tensor().array)
+    return w0, w1
+
+
+def test_grads_flow_past_dropout():
+    """Custom grad makers (dropout_grad has no "X" input slot) must
+    still record their input's grad in grad_map — round-4 fix: before
+    it, every op upstream of a dropout silently received EMPTY
+    cotangents and models trained only their heads."""
+    import numpy as np
+    w0, w1 = _train_two_steps(
+        lambda fluid, h: fluid.layers.dropout(
+            h, 0.3, dropout_implementation="upscale_in_train"))
+    assert np.abs(w1 - w0).max() > 0, \
+        "fc upstream of dropout got no gradient"
+
+
+def test_grads_flow_past_two_dropouts_in_series():
+    """TWO custom-grad ops in series was the crash shape: the first
+    (in reverse order) broke the grad chain, the second's maker then
+    consumed an @EMPTY@ cotangent and the kernel crashed on None."""
+    import numpy as np
+
+    def mid(fluid, h):
+        h = fluid.layers.dropout(h, 0.3,
+                                 dropout_implementation="upscale_in_train")
+        h = fluid.layers.fc(h, 8)
+        return fluid.layers.dropout(
+            h, 0.3, dropout_implementation="upscale_in_train")
+
+    w0, w1 = _train_two_steps(mid)
+    assert np.abs(w1 - w0).max() > 0
+
+
+def test_grads_flow_past_quant_ste():
+    """The quant STE maker emits a plain `assign` (grad input in slot
+    "X", output in slot "Out") — both the desc-level grad recording and
+    any *@GRAD-slot filter miss it; upstream params must still train."""
+    import numpy as np
+
+    def mid(fluid, h):
+        helper = fluid.layer_helper.LayerHelper("fq", name="fq")
+        out = helper.create_variable_for_type_inference("float32")
+        out.shape = tuple(h.shape)
+        scale = helper.create_variable_for_type_inference("float32")
+        scale.shape = (1,)
+        helper.append_op(type="fake_quantize_dequantize_abs_max",
+                         inputs={"X": [h]},
+                         outputs={"Out": [out], "OutScale": [scale]},
+                         attrs={"bit_length": 8})
+        return out
+
+    w0, w1 = _train_two_steps(mid)
+    assert np.abs(w1 - w0).max() > 0, \
+        "fc upstream of fake_quantize got no gradient"
