@@ -195,7 +195,10 @@ class LayerHelper:
 
 
 def copy_attr(attr: ParamAttr) -> ParamAttr:
-    return ParamAttr(initializer=attr.initializer,
+    # the NAME is kept (reference layer_helper_base.create_parameter
+    # deepcopies the attr): a named attr shared across a multi-input fc
+    # means ONE shared parameter, never silently-fresh per-input weights
+    return ParamAttr(name=attr.name, initializer=attr.initializer,
                      learning_rate=attr.learning_rate,
                      regularizer=attr.regularizer, trainable=attr.trainable,
                      gradient_clip=attr.gradient_clip)

@@ -42,6 +42,7 @@ __all__ = [
     "pad_constant_like", "roi_pool", "roi_align", "scale",
     "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
     "sampling_id", "shuffle_channel", "adaptive_pool3d", "inplace_abn",
+    "ctc_greedy_decoder",
     "conv3d_transpose", "resize_trilinear", "image_resize_short",
     "affine_grid", "psroi_pool", "prroi_pool", "deformable_conv",
     "deformable_roi_pooling", "chunk_eval", "filter_by_instag",
@@ -1746,9 +1747,42 @@ def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
     return out
 
 
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Greedy CTC decode: per-step argmax, merge repeats, drop blanks
+    (reference layers/nn.py ctc_greedy_decoder:5116 → ctc_align_op.cc).
+    LoD mode (input_length None): LoD [T, C] probs → LoD [Tout, 1] ids.
+    Padding mode: [N, T, C] + input_length [N, 1] → (padded ids [N, T],
+    output lengths [N, 1])."""
+    helper = LayerHelper("ctc_greedy_decoder", **locals())
+    _, idx = topk(input, k=1)
+    ctc_out = helper.create_variable_for_type_inference(
+        VarDesc.VarType.INT64)
+    if input_length is None:
+        helper.append_op(type="ctc_align", inputs={"Input": [idx]},
+                         outputs={"Output": [ctc_out]},
+                         attrs={"merge_repeated": True, "blank": blank})
+        ctc_out.shape = (-1, 1)
+        return ctc_out
+    ctc_out_len = helper.create_variable_for_type_inference(
+        VarDesc.VarType.INT64)
+    ctc_input = squeeze(idx, [2])
+    helper.append_op(type="ctc_align",
+                     inputs={"Input": [ctc_input],
+                             "InputLength": [input_length]},
+                     outputs={"Output": [ctc_out],
+                              "OutputLength": [ctc_out_len]},
+                     attrs={"merge_repeated": True, "blank": blank,
+                            "padding_value": padding_value})
+    ctc_out.shape = tuple(input.shape[:-1])
+    ctc_out_len.shape = (-1, 1)
+    return ctc_out, ctc_out_len
+
+
 def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
     helper = LayerHelper("sampling_id", **locals())
     out = helper.create_variable_for_type_inference(VarDesc.VarType.INT64)
+    out.shape = tuple(x.shape[:-1])  # one drawn id per distribution row
     helper.append_op(type="sampling_id", inputs={"X": [x]},
                      outputs={"Out": [out]},
                      attrs={"min": min, "max": max, "seed": seed})

@@ -13,7 +13,9 @@ from __future__ import annotations
 
 __all__ = [
     "RNNCell", "GRUCell", "LSTMCell", "rnn", "Decoder", "BeamSearchDecoder",
-    "dynamic_decode", "dynamic_lstm", "dynamic_lstmp", "dynamic_gru",
+    "dynamic_decode", "DecodeHelper", "TrainingHelper",
+    "GreedyEmbeddingHelper", "SampleEmbeddingHelper", "BasicDecoder",
+    "dynamic_lstm", "dynamic_lstmp", "dynamic_gru",
     "gru_unit", "lstm_unit", "lstm", "beam_search", "beam_search_decode",
     "gather_tree",
 ]
@@ -32,6 +34,26 @@ def _fixed_attr(attr, fallback_name):
     return ParamAttr(name=unique_name.generate(fallback_name))
 
 
+def _cell_weight_attrs(attr, fallback_base):
+    """TWO pinned names — input- and hidden-projection — for the cell's
+    two-input fc. One shared name would tie Wx to Wh (round-4 fix: the
+    name-dropping copy the helper used to make instead created a FRESH
+    hidden weight per unrolled step, so the recurrence never shared
+    weights across time). A user list of attrs passes through; a single
+    user attr keeps all its fields (initializer, trainable, ...) in both
+    derived copies — only the names are suffixed."""
+    from ..layer_helper import copy_attr
+    if isinstance(attr, (list, tuple)):
+        return list(attr)
+    if isinstance(attr, ParamAttr):
+        base = attr.name or unique_name.generate(fallback_base)
+        ax, ah = copy_attr(attr), copy_attr(attr)
+        ax.name, ah.name = base + "_x", base + "_h"
+        return [ax, ah]
+    base = unique_name.generate(fallback_base)
+    return [ParamAttr(name=base + "_x"), ParamAttr(name=base + "_h")]
+
+
 class RNNCell:
     def call(self, inputs, states, **kwargs):
         raise NotImplementedError
@@ -41,7 +63,7 @@ class RNNCell:
 
     def get_initial_states(self, batch_ref, shape=None, dtype="float32",
                            init_value=0.0, batch_dim_idx=0):
-        from .nn import fill_constant_batch_size_like
+        from .tensor import fill_constant_batch_size_like
         shape = shape or self.state_shape
         if isinstance(shape[0], (list, tuple)):
             return [fill_constant_batch_size_like(
@@ -59,7 +81,7 @@ class GRUCell(RNNCell):
                  gate_activation=None, activation=None, dtype="float32",
                  name="GRUCell"):
         self.hidden_size = hidden_size
-        self._param_attr = _fixed_attr(param_attr, name + "_w")
+        self._param_attr = _cell_weight_attrs(param_attr, name + "_w")
         self._bias_attr = (bias_attr if bias_attr is False
                            else _fixed_attr(bias_attr, name + "_b"))
         self._dtype = dtype
@@ -87,7 +109,7 @@ class LSTMCell(RNNCell):
                  gate_activation=None, activation=None, forget_bias=1.0,
                  dtype="float32", name="LSTMCell"):
         self.hidden_size = hidden_size
-        self._param_attr = _fixed_attr(param_attr, name + "_w")
+        self._param_attr = _cell_weight_attrs(param_attr, name + "_w")
         self._bias_attr = (bias_attr if bias_attr is False
                            else _fixed_attr(bias_attr, name + "_b"))
         self._forget_bias = forget_bias
@@ -367,6 +389,108 @@ def gather_tree(ids, parents):
 
 
 # --------------------------------------------------------------------------
+# decode helpers (reference rnn.py DecodeHelper:1375, TrainingHelper:1444,
+# GreedyEmbeddingHelper:1597, SampleEmbeddingHelper:1728, BasicDecoder:1829)
+#
+# TPU inversion: dynamic_decode runs a STATIC trip-count unrolled loop,
+# so `time` reaches the helpers as a Python int (compile-time constant)
+# instead of an int64 Variable — slices are static and XLA-friendly.
+# --------------------------------------------------------------------------
+class DecodeHelper:
+    """Sampling + next-step-input strategy plugged into BasicDecoder."""
+
+    def initialize(self):
+        """-> (initial_inputs, initial_finished)."""
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        """-> int64 sample ids for the current step."""
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        """-> (finished, next_inputs, next_states)."""
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher-forcing helper: step inputs are slices of the full target
+    sequence; sample() is argmax (ids mostly unused)."""
+
+    def __init__(self, inputs, sequence_length, time_major=False):
+        self.inputs = inputs
+        self.sequence_length = sequence_length
+        self.time_major = time_major
+
+    def _slice(self, t):
+        import paddle_tpu.fluid.layers as L
+        axis = 0 if self.time_major else 1
+        T = self.inputs.shape[axis]
+        t = min(t, T - 1)  # clamp instead of the reference's pad-by-one
+        return L.squeeze(L.slice(self.inputs, axes=[axis], starts=[t],
+                                 ends=[t + 1]), [axis])
+
+    def initialize(self):
+        import paddle_tpu.fluid.layers as L
+        zero = L.fill_constant([1], self.sequence_length.dtype, 0)
+        return self._slice(0), L.equal(self.sequence_length, zero)
+
+    def sample(self, time, outputs, states):
+        import paddle_tpu.fluid.layers as L
+        return L.cast(L.argmax(outputs, axis=-1), "int64")
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        import paddle_tpu.fluid.layers as L
+        nxt = L.fill_constant([1], self.sequence_length.dtype,
+                              int(time) + 1)
+        finished = L.less_equal(self.sequence_length, nxt)
+        return finished, self._slice(int(time) + 1), states
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Inference helper: argmax ids fed back through an embedding."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        import paddle_tpu.fluid.layers as L
+        self.embedding_fn = embedding_fn
+        self.start_tokens = start_tokens
+        self.end_token = L.fill_constant([1], "int64", end_token)
+
+    def initialize(self):
+        import paddle_tpu.fluid.layers as L
+        finished = L.cast(L.zeros_like(self.start_tokens), "bool")
+        return self.embedding_fn(self.start_tokens), finished
+
+    def sample(self, time, outputs, states):
+        import paddle_tpu.fluid.layers as L
+        return L.cast(L.argmax(outputs, axis=-1), "int64")
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        import paddle_tpu.fluid.layers as L
+        finished = L.equal(sample_ids, self.end_token)
+        return finished, self.embedding_fn(sample_ids), states
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Like GreedyEmbeddingHelper but draws from softmax(logits/T)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.softmax_temperature = softmax_temperature
+        self.seed = seed
+
+    def sample(self, time, outputs, states):
+        import paddle_tpu.fluid.layers as L
+        logits = outputs
+        if self.softmax_temperature is not None:
+            logits = L.scale(logits,
+                             scale=1.0 / float(self.softmax_temperature))
+        probs = L.softmax(logits)
+        probs.stop_gradient = True
+        return L.sampling_id(probs, seed=self.seed or 0)
+
+
+# --------------------------------------------------------------------------
 # tensor-based decode
 # --------------------------------------------------------------------------
 class Decoder:
@@ -389,13 +513,91 @@ class BeamSearchDecoder(Decoder):
         self.output_fn = output_fn
 
 
+class BasicDecoder(Decoder):
+    """Cell + DecodeHelper assembly (reference rnn.py BasicDecoder:1829):
+    step = cell.call → output_fn → helper.sample → helper.next_inputs."""
+    import collections as _collections
+    OutputWrapper = _collections.namedtuple("OutputWrapper",
+                                            ("cell_outputs", "sample_ids"))
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        initial_inputs, initial_finished = self.helper.initialize()
+        return initial_inputs, initial_cell_states, initial_finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_outputs, cell_states = self.cell(inputs, states, **kwargs)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        sample_ids = self.helper.sample(time=time, outputs=cell_outputs,
+                                        states=cell_states)
+        sample_ids.stop_gradient = True
+        finished, next_inputs, next_states = self.helper.next_inputs(
+            time=time, outputs=cell_outputs, states=cell_states,
+            sample_ids=sample_ids)
+        return (self.OutputWrapper(cell_outputs, sample_ids), next_states,
+                next_inputs, finished)
+
+
+def _dynamic_decode_generic(decoder, inits, max_step_num,
+                            output_time_major, return_length=False,
+                            **kwargs):
+    """decoder.initialize/step protocol (BasicDecoder et al.) under the
+    same static-trip-count inversion: `time` is a Python int, finished
+    status latches via logical_or, outputs are stacked over time.
+    Returns (outputs_structure, final_states) like the reference, plus
+    the decode lengths when return_length (the step emitting the end
+    token counts, later steps don't)."""
+    import paddle_tpu.fluid.layers as L
+    if max_step_num is None:
+        max_step_num = 32
+    inputs, states, finished = decoder.initialize(inits)
+    steps = []
+    lengths = None
+    for t in range(int(max_step_num)):
+        outputs, states, inputs, step_fin = decoder.step(
+            t, inputs, states, **kwargs)
+        alive = L.cast(L.logical_not(finished), "int64")
+        lengths = alive if lengths is None \
+            else L.elementwise_add(lengths, alive)
+        finished = L.logical_or(finished, step_fin)
+        steps.append(outputs)
+
+    def _stack(field_vals):
+        s = L.stack(list(field_vals), axis=0)          # [T, B, ...]
+        if not output_time_major:
+            s = L.transpose(s, [1, 0] + list(range(2, len(s.shape))))
+        return s
+
+    first = steps[0]
+    if hasattr(first, "_fields"):  # namedtuple of per-step tensors
+        final = type(first)(*[_stack([getattr(s, f) for s in steps])
+                              for f in first._fields])
+    else:
+        final = _stack(steps)
+    if return_length:
+        return final, states, lengths
+    return final, states
+
+
 def dynamic_decode(decoder, inits=None, max_step_num=None,
-                   output_time_major=False, **kwargs):
-    """Beam-search decode with a STATIC trip count (TPU inversion of the
-    reference's While loop, rnn.py dynamic_decode:865): every step extends
-    all beams; finished beams are frozen by score masking; gather_tree
-    backtracks at the end. Returns (predicted_ids [B, T, beam],
-    final_scores [B, beam])."""
+                   output_time_major=False, return_length=False, **kwargs):
+    """Decode with a STATIC trip count (TPU inversion of the reference's
+    While loop, rnn.py dynamic_decode:865). BeamSearchDecoder: every step
+    extends all beams; finished beams are frozen by score masking;
+    gather_tree backtracks at the end; returns (predicted_ids
+    [B, T, beam], final_scores [B, beam]). Decoders exposing the
+    initialize/step protocol (BasicDecoder) return
+    (outputs_structure, final_states[, lengths when return_length])."""
+    if not isinstance(decoder, BeamSearchDecoder) and \
+            hasattr(decoder, "initialize") and hasattr(decoder, "step"):
+        return _dynamic_decode_generic(decoder, inits, max_step_num,
+                                       output_time_major, return_length,
+                                       **kwargs)
     import paddle_tpu.fluid.layers as L
     from paddle_tpu.fluid.layers import (
         topk, reshape, expand, unsqueeze, squeeze, transpose, cast, gather,

@@ -114,26 +114,50 @@ def _warpctc(ins, attrs):
     return {"Loss": [loss.reshape(-1, 1)], "_lod": {"Loss": [None]}}
 
 
+def _merge_drop(seq, blank, merge):
+    kept = []
+    prev = None
+    for v in seq:
+        if merge and prev is not None and v == prev:
+            prev = v
+            continue
+        prev = v
+        if v != blank:
+            kept.append(int(v))
+    return kept
+
+
 @register_op("ctc_align", needs_lod=True, no_grad=True, stateful=True,
-             attr_defaults={"blank": 0, "merge_repeated": True})
+             host_inputs=("InputLength",),
+             attr_defaults={"blank": 0, "merge_repeated": True,
+                            "padding_value": 0})
 def _ctc_align(ins, attrs):
-    """Merge repeats + drop blanks (reference ctc_align_op.cc)."""
-    x = np.asarray(first(ins, "Input")).reshape(-1)
-    offs = _offs(attrs, "Input")
+    """Merge repeats + drop blanks (reference ctc_align_op.cc). Two
+    modes like the reference: LoD ([T, 1] + lod), or padded ([N, T] +
+    InputLength → padded Output + OutputLength)."""
     blank = int(attrs.get("blank", 0))
     merge = bool(attrs.get("merge_repeated", True))
+    in_len = first(ins, "InputLength")
+    if in_len is not None:  # padding mode
+        x = np.asarray(first(ins, "Input"))
+        lens = np.asarray(in_len).reshape(-1).astype(np.int64)
+        pad = int(attrs.get("padding_value", 0))
+        N, T = x.shape[0], x.shape[-1]
+        x2 = x.reshape(N, T)
+        out = np.full((N, T), pad, np.int32)
+        out_lens = np.zeros((N, 1), np.int64)
+        for i in range(N):
+            kept = _merge_drop(x2[i, :int(lens[i])], blank, merge)
+            out[i, :len(kept)] = kept
+            out_lens[i, 0] = len(kept)
+        return {"Output": [jnp.asarray(out)],
+                "OutputLength": [jnp.asarray(out_lens)],
+                "_lod": {"Output": [None], "OutputLength": [None]}}
+    x = np.asarray(first(ins, "Input")).reshape(-1)
+    offs = _offs(attrs, "Input")
     rows, lens = [], []
     for i in range(len(offs) - 1):
-        seq = x[offs[i]:offs[i + 1]]
-        kept = []
-        prev = None
-        for v in seq:
-            if merge and prev is not None and v == prev:
-                prev = v
-                continue
-            prev = v
-            if v != blank:
-                kept.append(int(v))
+        kept = _merge_drop(x[offs[i]:offs[i + 1]], blank, merge)
         if not kept:
             kept = [-1]  # reference emits -1 row for empty result
         rows.extend(kept)

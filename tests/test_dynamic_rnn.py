@@ -156,3 +156,185 @@ def test_recurrent_op_direct():
     exe._run_op_eager(op, scope, jax.random.key(0))
     o = np.asarray(scope.find_var("h").get_tensor().array)
     np.testing.assert_allclose(o, np.cumsum(x, axis=0))
+
+
+# ----------------------------------------------------------- decode helpers
+def _decode_program(helper_kind, V=7, H=8, B=3, T=5):
+    """Tiny GRU decoder program through BasicDecoder + dynamic_decode."""
+    import paddle_tpu.fluid.layers as layers
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        enc = fluid.data("enc", shape=[H], dtype="float32")
+        cell = layers.GRUCell(hidden_size=H)
+
+        def embedder(ids):
+            return layers.embedding(
+                layers.reshape(ids, [-1, 1]), size=[V, H],
+                param_attr=fluid.ParamAttr(name="trg_emb"))
+
+        def output_fn(x):
+            return layers.fc(x, V,
+                             param_attr=fluid.ParamAttr(name="out_w"),
+                             bias_attr=False)
+
+        if helper_kind == "training":
+            trg = fluid.data("trg_emb_seq", shape=[T, H], dtype="float32")
+            trg_len = fluid.data("trg_len", shape=[], dtype="int64")
+            helper = layers.TrainingHelper(trg, trg_len)
+        elif helper_kind == "greedy":
+            start = fluid.data("start", shape=[], dtype="int64")
+            helper = layers.GreedyEmbeddingHelper(
+                lambda ids: layers.squeeze(embedder(ids), [1]), start, 1)
+        else:
+            start = fluid.data("start", shape=[], dtype="int64")
+            helper = layers.SampleEmbeddingHelper(
+                lambda ids: layers.squeeze(embedder(ids), [1]), start, 1,
+                softmax_temperature=2.0, seed=7)
+        decoder = layers.BasicDecoder(cell, helper, output_fn=output_fn)
+        outputs, final_states = layers.dynamic_decode(
+            decoder, inits=enc, max_step_num=T)
+    return main, startup, outputs, final_states
+
+
+@pytest.mark.parametrize("kind", ["training", "greedy", "sample"])
+def test_basic_decoder_helpers(kind):
+    """BasicDecoder + each DecodeHelper decodes to [B, T, ...] outputs
+    (reference rnn.py BasicDecoder:1829 + helpers; static-trip-count
+    inversion — `time` is a compile-time int)."""
+    V, H, B, T = 7, 8, 3, 5
+    main, startup, outputs, _ = _decode_program(kind, V, H, B, T)
+    exe = _exe()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    feed = {"enc": rng.rand(B, H).astype("float32")}
+    if kind == "training":
+        feed["trg_emb_seq"] = rng.rand(B, T, H).astype("float32")
+        feed["trg_len"] = np.full((B,), T, "int64")
+    else:
+        feed["start"] = np.zeros((B,), "int64")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        co, ids = exe.run(main, feed=feed,
+                          fetch_list=[outputs.cell_outputs,
+                                      outputs.sample_ids])
+    co, ids = np.asarray(co), np.asarray(ids)
+    assert co.shape == (B, T, V)
+    assert ids.shape == (B, T)
+    assert ids.min() >= 0 and ids.max() < V
+    if kind != "sample":
+        # argmax sampling: ids must equal argmax of the logits
+        np.testing.assert_array_equal(ids, co.argmax(-1))
+
+
+def test_ctc_greedy_decoder_padding_mode():
+    """[N, T, C] + lengths → merged/blank-stripped padded ids + lengths
+    (reference layers/nn.py ctc_greedy_decoder, ctc_align_op.cc)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[4, 4], dtype="float32")
+        xl = fluid.data("xl", shape=[1], dtype="int64")
+        out, out_len = fluid.layers.ctc_greedy_decoder(
+            x, blank=0, input_length=xl, padding_value=-5)
+    probs = np.array([[[0.6, 0.1, 0.3, 0.0],    # 0 (blank)
+                       [0.3, 0.2, 0.4, 0.1],    # 2
+                       [0.1, 0.5, 0.1, 0.3],    # 1
+                       [0.5, 0.1, 0.3, 0.1]],   # 0 (blank)
+                      [[0.1, 0.1, 0.7, 0.1],    # 2
+                       [0.2, 0.2, 0.5, 0.1],    # 2 (merged)
+                       [0.2, 0.2, 0.1, 0.5],    # 3
+                       [0.5, 0.1, 0.3, 0.1]]],  # beyond length
+                     np.float32)
+    lens = np.array([[4], [3]], np.int64)
+    exe = _exe()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ids, olen = exe.run(main, feed={"x": probs, "xl": lens},
+                            fetch_list=[out, out_len])
+    ids, olen = np.asarray(ids), np.asarray(olen)
+    np.testing.assert_array_equal(olen.ravel(), [2, 2])
+    np.testing.assert_array_equal(ids[0, :2], [2, 1])
+    np.testing.assert_array_equal(ids[1, :2], [2, 3])
+    assert (ids[:, 2:] == -5).all()
+
+
+def test_ctc_greedy_decoder_lod_mode():
+    """LoD [T, C] probs → LoD [Tout, 1] ids."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32", lod_level=1)
+        out = fluid.layers.ctc_greedy_decoder(x, blank=0)
+    probs = np.array([[0.6, 0.1, 0.3, 0.0],
+                      [0.3, 0.2, 0.4, 0.1],
+                      [0.1, 0.5, 0.1, 0.3],
+                      [0.5, 0.1, 0.3, 0.1],
+                      [0.1, 0.1, 0.7, 0.1],
+                      [0.2, 0.2, 0.5, 0.1],
+                      [0.2, 0.2, 0.1, 0.5],
+                      [0.5, 0.1, 0.3, 0.1]], np.float32)
+    lt = core.LoDTensor(probs, lod=[[0, 4, 8]])
+    exe = _exe()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (ids,) = exe.run(main, feed={"x": lt}, fetch_list=[out],
+                         return_numpy=False)
+    vals = np.asarray(ids.array).ravel()
+    lod = ids.lod()[0]
+    np.testing.assert_array_equal(vals, [2, 1, 2, 3])
+    assert tuple(lod) == (0, 2, 4)
+
+
+def test_basic_decoder_return_length():
+    """return_length=True yields decode lengths: the step emitting the
+    end token counts, later steps don't (reference dynamic_decode's
+    return_length contract)."""
+    import paddle_tpu.fluid.layers as layers
+    V, H, B, T = 7, 8, 3, 5
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        enc = fluid.data("enc", shape=[H], dtype="float32")
+        start = fluid.data("start", shape=[], dtype="int64")
+        cell = layers.GRUCell(hidden_size=H)
+        embed = lambda ids: layers.squeeze(layers.embedding(
+            layers.reshape(ids, [-1, 1]), size=[V, H],
+            param_attr=fluid.ParamAttr(name="emb_rl")), [1])
+        helper = layers.GreedyEmbeddingHelper(embed, start, end_token=1)
+        out_fn = lambda x: layers.fc(x, V, bias_attr=False)
+        dec = layers.BasicDecoder(cell, helper, output_fn=out_fn)
+        outs, _, lens = layers.dynamic_decode(dec, inits=enc,
+                                              max_step_num=T,
+                                              return_length=True)
+    exe = _exe()
+    scope = core.Scope()
+    rng = np.random.RandomState(3)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ids, L = exe.run(main,
+                         feed={"enc": rng.rand(B, H).astype("float32"),
+                               "start": np.zeros((B,), "int64")},
+                         fetch_list=[outs.sample_ids, lens])
+    ids, L = np.asarray(ids), np.asarray(L)
+    assert L.shape == (B,) and (L >= 1).all() and (L <= T).all()
+    for b in range(B):
+        end_hits = np.where(ids[b] == 1)[0]
+        expect = (end_hits[0] + 1) if len(end_hits) else T
+        assert L[b] == expect, (b, ids[b], L[b])
+
+
+def test_cell_attrs_keep_user_fields():
+    """A user ParamAttr passed to a cell keeps its non-name fields
+    (trainable, initializer) in BOTH derived weights."""
+    import paddle_tpu.fluid.layers as layers
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[4, 5], dtype="float32")
+        cell = layers.GRUCell(
+            hidden_size=5,
+            param_attr=fluid.ParamAttr(name="frozen_w", trainable=False))
+        layers.rnn(cell, x)
+    frozen = [p for p in main.all_parameters()
+              if p.name.startswith("frozen_w")]
+    assert len(frozen) == 2
+    assert all(not p.trainable for p in frozen), \
+        [(p.name, p.trainable) for p in frozen]

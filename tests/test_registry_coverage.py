@@ -319,3 +319,35 @@ def test_lazy_table_init_op():
     tbl.apply_grad([7], np.ones((1, 4), np.float32), lr=0.5)
     rows2 = tbl.get_rows([7])
     np.testing.assert_allclose(rows2[0], rows[0] - 0.5, rtol=1e-6)
+
+
+def test_fluid_layers_covers_reference_surface():
+    """Surface lock (round-4, VERDICT item 6): every public name in every
+    reference fluid.layers module __all__ must resolve on our
+    fluid.layers — so the API surface cannot silently regress. The
+    reference tree is parsed (AST), never imported."""
+    import ast
+    ref_dir = "/root/reference/python/paddle/fluid/layers"
+    if not os.path.isdir(ref_dir):
+        pytest.skip("reference tree not present")
+    missing = []
+    for path in sorted(glob.glob(os.path.join(ref_dir, "*.py"))):
+        mod = os.path.basename(path)
+        if mod.startswith("_") or mod == "layer_function_generator.py":
+            continue
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", SyntaxWarning)
+            tree = ast.parse(open(path).read())
+        names = []
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    getattr(t, "id", None) == "__all__"
+                    for t in node.targets):
+                if isinstance(node.value, (ast.List, ast.Tuple)):
+                    names = [c.value for c in node.value.elts
+                             if isinstance(c, ast.Constant)]
+        for n in names:
+            if not hasattr(layers, n):
+                missing.append(f"{mod}:{n}")
+    assert not missing, f"fluid.layers missing reference names: {missing}"

@@ -66,3 +66,24 @@ def test_static_rnn_with_fc_trains():
             (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
             losses.append(float(np.asarray(lv).reshape(-1)[0]))
     assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_cell_weights_shared_across_unrolled_steps():
+    """Round-4 fix: the cell's two-input fc used to get a name-dropping
+    attr copy for the hidden projection — a FRESH Wh per unrolled step.
+    The recurrence must create exactly Wx + Wh (+ bias) however long the
+    unroll is, and Wx must not be tied to Wh."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.fluid.layers as layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[7, 5], dtype="float32")  # T=7, D=5
+        cell = layers.GRUCell(hidden_size=5)
+        out, _ = layers.rnn(cell, x)
+    names = sorted(p.name for p in main.all_parameters())
+    assert len(names) == 3, names  # Wx, Wh, bias — not 2*T weights
+    wx = [n for n in names if n.endswith("_x")]
+    wh = [n for n in names if n.endswith("_h")]
+    assert len(wx) == 1 and len(wh) == 1 and wx[0] != wh[0], names
