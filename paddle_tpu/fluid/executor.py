@@ -321,6 +321,14 @@ class _CompiledBlock:
             if n in persistable and n not in self.mut_state
             and n not in feed_names)
         self.seed = seed
+        # PipelineOptimizer-sectioned program + a mesh with a "pp" axis:
+        # lower the homogeneous interior onto the compiled gpipe schedule
+        # (fused fallback with a warning otherwise)
+        self._pipeline_plan = None
+        popt = getattr(program, "_pipeline_opt", None)
+        if popt and mesh is not None and "pp" in mesh.axis_names:
+            from .pipeline_lowering import build_plan
+            self._pipeline_plan = build_plan(self, popt)
         self._jitted = jax.jit(self._step, donate_argnums=(0,))
 
     def _step(self, mut_state: Dict[str, Any], ro_state: Dict[str, Any],
@@ -330,7 +338,11 @@ class _CompiledBlock:
         env.update(mut_state)
         env.update(feeds)
         lod_env: Dict[str, tuple] = dict(self._init_lods)
-        self._exec_ops(self.ops, env, lod_env, rng)
+        if self._pipeline_plan is not None:
+            from .pipeline_lowering import exec_plan
+            exec_plan(self, self._pipeline_plan, env, lod_env, rng)
+        else:
+            self._exec_ops(self.ops, env, lod_env, rng)
         fetches = []
         for i, n in enumerate(self.fetch_names):
             if n not in env:

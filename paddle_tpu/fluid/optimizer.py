@@ -590,17 +590,18 @@ class PipelineOptimizer:
     sections bounded at the producer of each cut variable — and the section
     metadata is attached to the program as ``program._pipeline_opt``.
 
-    Execution semantics: `Executor.run` executes the sectioned program as
-    one fused compiled step (numerically identical to pipelined execution;
-    pipelining is a throughput schedule, not a semantic change). The
-    pipelined *schedule* itself is provided by
-    `paddle_tpu.parallel.pipeline.gpipe` (shard_map over the "pp" mesh
-    axis, `lax.ppermute` stage transfers over ICI); model code drives it
-    directly with the recorded section/stage-param metadata. Automatic
-    lowering of arbitrary sectioned programs onto that schedule is not yet
-    wired. Queue-runtime knobs (`queue_size`, `concurrency_list`,
-    `start_cpu_core_id`) have no compiled equivalent and are recorded but
-    inert; ``sync_steps`` maps to the microbatch count of the schedule.
+    Execution semantics: `Executor.run(..., mesh=<pp mesh>)` lowers the
+    sectioned program onto the compiled GPipe schedule
+    (`fluid/pipeline_lowering.py` → `parallel.pipeline.gpipe`: shard_map
+    over the "pp" mesh axis, `lax.ppermute` stage transfers over ICI,
+    backward via the vjp's transposed ring) when the interior sections
+    are homogeneous; anything else — and runs without a pp mesh —
+    executes as one fused compiled step with a warning (numerically
+    identical to pipelined execution; pipelining is a throughput
+    schedule, not a semantic change). Queue-runtime knobs (`queue_size`,
+    `concurrency_list`, `start_cpu_core_id`) have no compiled equivalent
+    and are recorded but inert; ``sync_steps`` maps to the microbatch
+    count of the schedule.
     """
 
     def __init__(self, optimizer, cut_list=None, place_list=None,

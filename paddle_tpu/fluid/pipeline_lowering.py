@@ -1,0 +1,265 @@
+"""Lower a PipelineOptimizer-sectioned fluid program onto the compiled
+GPipe schedule.
+
+The reference executes sectioned programs through a thread/queue runtime
+(reference: python/paddle/fluid/optimizer.py:3550 PipelineOptimizer,
+paddle/fluid/framework/section_worker.cc:142, pipeline_trainer.cc:24).
+The TPU inversion compiles the schedule instead: the homogeneous interior
+sections become ONE `parallel.pipeline.gpipe` call (shard_map over the
+"pp" mesh axis, lax.ppermute stage handoff) embedded in the executor's
+single jitted step, and the interior's backward ops are replaced by the
+`jax.vjp` of that call — the ppermute transposes run the reverse
+pipeline. Pre ops (up to the first cut), post/loss/optimizer ops and
+every non-interior gradient still execute on the normal traced path, so
+feeds, state donation, fetches and the optimizer all work unchanged.
+
+Lowering preconditions (checked by `build_plan`; anything else falls
+back to the fused path with a warning — numerically identical, just not
+stage-parallel):
+  * mesh has a "pp" axis whose size == number of interior sections
+  * interior sections are homogeneous: same op types/attrs positionally,
+    stage-varying inputs have matching shapes (params stack)
+  * interior ops are batch-row-independent (no batch_norm/data_norm),
+    rng-free (dropout inside a stage would draw per-stage masks the
+    fused oracle can't mirror), and sub-block-free
+  * the microbatch count divides the feed batch
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .backward import grad_var_name
+
+# ops whose output for one batch row depends on other rows — microbatch
+# splitting changes their semantics, so the interior may not contain them
+_BATCH_MIXING = {"batch_norm", "sync_batch_norm", "data_norm"}
+
+
+class PipelinePlan:
+    def __init__(self):
+        self.pre_ops = []           # ops up to and incl. the c0 producer
+        self.template_ops = []      # section-1 ops (the stage body)
+        self.post_ops = []          # post fwd + loss + post bwd
+        self.tail_ops = []          # pre bwd + optimizer updates
+        self.n_stages = 0
+        self.n_micro = 1
+        self.c0 = None              # activation entering the interior
+        self.c_last = None          # activation leaving the interior
+        self.template_out = None    # template name of the stage output
+        self.closure_names = []     # externals shared by every stage
+        self.param_template = []    # template name per stacked position
+        self.param_stage_names = []  # per position: [stage0.., stageN-1..]
+
+
+def _op_signature(op):
+    attrs = {k: v for k, v in op.attrs.items()
+             if not k.startswith("_") and k != "op_role"}
+    return (op.type, sorted(attrs.items(), key=lambda kv: kv[0]))
+
+
+def _fallback(reason):
+    warnings.warn(
+        f"PipelineOptimizer program not lowerable onto the gpipe "
+        f"schedule ({reason}); executing fused (numerically identical, "
+        f"not stage-parallel)", stacklevel=3)
+    return None
+
+
+def build_plan(cb, popt) -> Optional[PipelinePlan]:
+    """cb: the _CompiledBlock being built. Returns a PipelinePlan or None
+    (fused fallback)."""
+    mesh = cb.mesh
+    ops = cb.ops
+    cut_vars = list(popt.get("cut_vars") or [])
+    if len(cut_vars) < 3:
+        return _fallback("need >= 3 cut vars (pre | stages... | post)")
+    producer = {}
+    for i, op in enumerate(ops):
+        for n in op.output_arg_names:
+            producer.setdefault(n, i)
+    missing = [c for c in cut_vars if c not in producer]
+    if missing:
+        return _fallback(f"cut vars {missing} not produced")
+    cut_vars.sort(key=lambda c: producer[c])
+    bounds = [producer[c] + 1 for c in cut_vars]
+    plan = PipelinePlan()
+    plan.n_stages = len(cut_vars) - 1
+    if mesh.shape.get("pp") != plan.n_stages:
+        return _fallback(
+            f"{plan.n_stages} interior sections vs pp axis size "
+            f"{mesh.shape.get('pp')}")
+    plan.n_micro = max(1, int(popt.get("num_microbatches", 1)))
+    plan.c0, plan.c_last = cut_vars[0], cut_vars[-1]
+    # activation contract: every cut var has the same shape (gpipe ring
+    # buffers one activation shape through all stages)
+    bvars = cb.program.global_block().vars
+    cshapes = {tuple(bvars[c].shape) for c in cut_vars if c in bvars}
+    if len(cshapes) != 1:
+        return _fallback(
+            f"cut activations have mismatched shapes {sorted(cshapes)}")
+    plan.pre_ops = ops[:bounds[0]]
+    sections = [ops[bounds[i]:bounds[i + 1]]
+                for i in range(plan.n_stages)]
+    rest = ops[bounds[-1]:]
+
+    # ---- homogeneity + positional rename maps ---------------------------
+    template = sections[0]
+    if any(len(s) != len(template) for s in sections):
+        return _fallback("sections differ in op count")
+    for op in template:
+        if op.type in _BATCH_MIXING:
+            return _fallback(f"batch-mixing op '{op.type}' in a stage")
+        if op.attrs.get("sub_block") is not None:
+            return _fallback("control flow inside a stage")
+        from ..ops.registry import OPS
+        if OPS.has(op.type) and OPS.get(op.type).needs_rng:
+            return _fallback(f"rng op '{op.type}' in a stage")
+    maps: List[Dict[str, str]] = []  # template name -> stage-i name
+    for sec in sections:
+        m: Dict[str, str] = {}
+        for top, sop in zip(template, sec):
+            if _op_signature(top) != _op_signature(sop):
+                return _fallback(
+                    f"op mismatch: {top.type} vs {sop.type}")
+            for tn, sn in zip(
+                    list(top.input_arg_names) + list(top.output_arg_names),
+                    list(sop.input_arg_names) + list(sop.output_arg_names)):
+                if m.setdefault(tn, sn) != sn:
+                    return _fallback(
+                        f"inconsistent rename {tn} -> {m[tn]}/{sn}")
+        maps.append(m)
+
+    # externals of the template = read before written inside the section
+    written: set = set()
+    externals: List[str] = []
+    for op in template:
+        for n in op.input_arg_names:
+            if n not in written and n not in externals:
+                externals.append(n)
+        written.update(op.output_arg_names)
+    state = set(cb.mut_state) | set(cb.ro_state)
+    all_written = set()
+    for op in ops:
+        all_written.update(op.output_arg_names)
+    for n in externals:
+        stage_names = [m[n] for m in maps]
+        if n == plan.c0:
+            continue  # the pipelined activation input
+        if all(sn == n for sn in stage_names):
+            if n in state and grad_var_name(n) in all_written:
+                # a trainable param SHARED by every stage: its grad ops
+                # live inside the interior span the vjp replaces, but
+                # the vjp differentiates only stacked params + x0 — the
+                # tied weight would silently get no gradient
+                return _fallback(
+                    f"stage-shared trainable param '{n}' (tied weights "
+                    f"across stages can't ride the stacked vjp)")
+            plan.closure_names.append(n)
+            continue
+        if not all(sn in state for sn in stage_names):
+            return _fallback(
+                f"stage-varying input '{n}' is not persistent state "
+                f"({stage_names})")
+        scope = cb._scope_ref()
+        shapes = {tuple(scope.find_var(sn).get_tensor().array.shape)
+                  for sn in stage_names}
+        if len(shapes) != 1:
+            return _fallback(
+                f"stage-varying input '{n}' has mismatched shapes "
+                f"across stages ({sorted(shapes)}) — params must stack")
+        plan.param_template.append(n)
+        plan.param_stage_names.append(stage_names)
+    # the template's cut output (stage i writes cut_vars[i+1])
+    out_name = None
+    for tn, sn in maps[0].items():
+        if sn == cut_vars[1] and tn in written:
+            out_name = tn
+            break
+    if out_name is None or any(m.get(out_name) != cut_vars[i + 1]
+                               for i, m in enumerate(maps)):
+        return _fallback("stage output does not line up with cut vars")
+    plan.template_out = out_name
+    plan.template_ops = template
+
+    # ---- split the remainder: post span / interior bwd span / tail ------
+    interior_written = set()
+    for sec in sections:
+        for op in sec:
+            interior_written.update(op.output_arg_names)
+    # interior activations never materialize under the plan — a fetch of
+    # one must take the fused path (c_last itself IS produced)
+    hidden = (interior_written - {plan.c_last}) & set(cb.fetch_names)
+    if hidden:
+        return _fallback(
+            f"fetch of interior activation(s) {sorted(hidden)} — the "
+            f"pipelined schedule does not materialize them")
+    grad_owned = set()
+    for v in (interior_written - {plan.c_last}) | {plan.c0} | {
+            n for names in plan.param_stage_names for n in names}:
+        grad_owned.add(grad_var_name(v))
+
+    def _writes_interior_grad(op):
+        for n in op.output_arg_names:
+            for g in grad_owned:
+                if n == g or n.startswith(g + "@"):
+                    return True
+        return False
+
+    idxs = [i for i, op in enumerate(rest) if _writes_interior_grad(op)]
+    if not idxs:
+        return _fallback("no interior gradient ops found in remainder")
+    lo, hi = min(idxs), max(idxs)
+    span = rest[lo:hi + 1]
+    if any(not _writes_interior_grad(op) for op in span):
+        return _fallback("interior gradient ops are not contiguous")
+    plan.post_ops = rest[:lo]
+    plan.tail_ops = rest[hi + 1:]
+    return plan
+
+
+def exec_plan(cb, plan: PipelinePlan, env: Dict[str, Any], lod_env, rng):
+    """Execute one pipelined step into ``env`` (called from
+    _CompiledBlock._step inside jit)."""
+    from ..parallel.pipeline import gpipe
+
+    cb._exec_ops(plan.pre_ops, env, lod_env, rng)
+    x0 = env[plan.c0]
+    B = x0.shape[0]
+    if B % plan.n_micro:
+        raise ValueError(
+            f"batch {B} not divisible by num_microbatches={plan.n_micro}")
+    stacked = [jnp.stack([env[n] for n in names])
+               for names in plan.param_stage_names]
+    closure = {n: env[n] for n in plan.closure_names}
+
+    def stage_fn(params, x):
+        e = dict(closure)
+        for tn, v in zip(plan.param_template, params):
+            e[tn] = v
+        e[plan.c0] = x
+        cb._exec_ops(plan.template_ops, e, dict(lod_env), rng)
+        return e[plan.template_out]
+
+    def interior(stacked_params, x0_):
+        xs = x0_.reshape((plan.n_micro, B // plan.n_micro) + x0_.shape[1:])
+        ys = gpipe(stage_fn, stacked_params, xs, mesh=cb.mesh)
+        return ys.reshape(x0_.shape)
+
+    y, vjp_fn = jax.vjp(interior, stacked, x0)
+    env[plan.c_last] = y
+    cb._exec_ops(plan.post_ops, env, lod_env, rng)
+    gy_name = grad_var_name(plan.c_last)
+    if gy_name not in env:
+        raise KeyError(
+            f"post span did not produce {gy_name} — cannot run the "
+            f"reverse pipeline")
+    d_stacked, d_x0 = vjp_fn(env[gy_name].astype(y.dtype))
+    env[grad_var_name(plan.c0)] = d_x0
+    for names, g in zip(plan.param_stage_names, d_stacked):
+        for i, n in enumerate(names):
+            env[grad_var_name(n)] = g[i]
+    cb._exec_ops(plan.tail_ops, env, lod_env, rng)

@@ -59,8 +59,17 @@ def batch_sharded(mesh: Mesh, ndim: int) -> NamedSharding:
 
 def shard_feed(mesh: Mesh, name: str, array) -> jax.Array:
     """Place a host batch onto the mesh, sharded on dim 0. In multi-process
-    mode the given array is this process's LOCAL shard."""
+    mode the given array is this process's LOCAL shard. Meshes without a
+    data axis (e.g. a pure "pp" pipeline mesh) replicate the feed."""
     arr = np.asarray(array)
+    if DATA_AXIS not in mesh.shape:
+        repl = replicated(mesh)
+        if jax.process_count() > 1:
+            # device_put can't target non-addressable devices; every
+            # process holds the identical full value
+            return jax.make_array_from_process_local_data(
+                repl, arr, global_shape=arr.shape)
+        return jax.device_put(arr, repl)
     dp = mesh.shape[DATA_AXIS]
     sharding = batch_sharded(mesh, max(arr.ndim, 1))
     if jax.process_count() > 1:

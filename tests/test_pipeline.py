@@ -127,3 +127,140 @@ def test_pipeline_optimizer_sections():
     # no param is assigned to more than one section
     all_params = [p for sec in meta["section_params"] for p in sec]
     assert len(set(all_params)) == len(all_params)
+
+
+# ---------------------------------------------------- fluid-API lowering
+def _build_pipelined_mlp(n_stages=4, width=WIDTH, lr=0.1, n_micro=4):
+    """pre-fc | n_stages homogeneous tanh-fc blocks (cut at each block
+    boundary) | head + loss. Returns (main, startup, loss, feeds)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[width], dtype="float32")
+        label = fluid.data("label", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, width, act="tanh",
+                            param_attr=fluid.ParamAttr(name="pre_w"))
+        cuts = [h]
+        for i in range(n_stages):
+            h = fluid.layers.fc(
+                h, width, act="tanh",
+                param_attr=fluid.ParamAttr(name=f"s{i}_w"),
+                bias_attr=fluid.ParamAttr(name=f"s{i}_b"))
+            cuts.append(h)
+        pred = fluid.layers.fc(h, 1,
+                               param_attr=fluid.ParamAttr(name="head_w"))
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, label)))
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(lr), cut_list=cuts, sync_steps=n_micro)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _run_steps(mesh, steps=4, batch=8):
+    from paddle_tpu.fluid import core
+    main, startup, loss = _build_pipelined_mlp()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    X = rng.rand(batch, WIDTH).astype("float32")
+    Y = rng.rand(batch, 1).astype("float32")
+    out = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            (l,) = exe.run(main, feed={"x": X, "label": Y},
+                           fetch_list=[loss], mesh=mesh)
+            out.append(float(np.asarray(l).ravel()[0]))
+    return out
+
+
+def test_pipeline_optimizer_lowers_to_gpipe():
+    """A cut_list fluid program runs stage-parallel on the pp mesh and
+    matches the fused run's losses step for step (VERDICT r03 item 2;
+    reference optimizer.py:3550 + section_worker.cc:142 semantics)."""
+    import warnings as _w
+    mesh = pipeline_mesh(N_STAGES)
+    with _w.catch_warnings():
+        _w.simplefilter("error")  # a fallback warning means NOT lowered
+        piped = _run_steps(mesh)
+    fused = _run_steps(None)
+    np.testing.assert_allclose(piped, fused, rtol=2e-5, atol=1e-6)
+    assert piped[-1] < piped[0]  # it actually trains
+
+
+def test_pipeline_optimizer_heterogeneous_falls_back():
+    """Sections that don't stack (different widths) execute fused, with
+    a warning — not a crash."""
+    from paddle_tpu.fluid import core
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[WIDTH], dtype="float32")
+        h = fluid.layers.fc(x, WIDTH, act="tanh")
+        cuts = [h]
+        for w in (WIDTH, 2 * WIDTH, WIDTH, WIDTH):  # heterogeneous
+            h = fluid.layers.fc(h, w, act="tanh")
+            cuts.append(h)
+        loss = fluid.layers.mean(h)
+        opt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(0.1), cut_list=cuts, sync_steps=2)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    mesh = pipeline_mesh(N_STAGES)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.warns(UserWarning, match="not lowerable"):
+            (l,) = exe.run(main,
+                           feed={"x": rng.rand(8, WIDTH).astype("float32")},
+                           fetch_list=[loss], mesh=mesh)
+    assert np.isfinite(np.asarray(l)).all()
+
+
+def test_pipeline_fallback_on_tied_weights_and_interior_fetch():
+    """Two confirmed non-lowerable shapes must FALL BACK (warning), not
+    crash: (1) a trainable param shared by every stage (its grad ops
+    live inside the replaced span); (2) fetching an interior
+    activation (never materialized under the schedule)."""
+    from paddle_tpu.fluid import core
+
+    def build(tied):
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = fluid.data("x", shape=[WIDTH], dtype="float32")
+            h = fluid.layers.fc(x, WIDTH, act="tanh")
+            cuts = [h]
+            for i in range(N_STAGES):
+                pa = fluid.ParamAttr(
+                    name="tied_w" if tied else f"tw{i}_w")
+                h = fluid.layers.fc(h, WIDTH, act="tanh", param_attr=pa,
+                                    bias_attr=False)
+                cuts.append(h)
+            loss = fluid.layers.mean(h)
+            fluid.optimizer.PipelineOptimizer(
+                fluid.optimizer.SGD(0.1), cut_list=cuts,
+                sync_steps=2).minimize(loss)
+        return main, startup, loss, cuts
+
+    mesh = pipeline_mesh(N_STAGES)
+    rng = np.random.RandomState(0)
+    X = rng.rand(8, WIDTH).astype("float32")
+
+    main, startup, loss, cuts = build(tied=True)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.warns(UserWarning, match="tied"):
+            (l,) = exe.run(main, feed={"x": X}, fetch_list=[loss],
+                           mesh=mesh)
+    assert np.isfinite(np.asarray(l)).all()
+
+    main, startup, loss, cuts = build(tied=False)
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.warns(UserWarning, match="interior activation"):
+            l, mid = exe.run(main, feed={"x": X},
+                             fetch_list=[loss, cuts[2]], mesh=mesh)
+    assert np.isfinite(np.asarray(mid)).all()
