@@ -35,6 +35,10 @@ def _op_no_grad(op_type: str) -> bool:
     if OPS.has(op_type):
         info = OPS.get(op_type)
         return info.no_grad and info.grad_maker is None
+    if op_type.endswith("_grad") and op_type != "_grad":
+        # a grad op is differentiable iff its base is (static double
+        # grad: gradient-penalty sweeps differentiate *_grad ops)
+        return _op_no_grad(op_type[:-5])
     return True
 
 
@@ -125,6 +129,11 @@ def _default_grad_op_descs(op: Operator, grad_map: Dict[str, str],
     if not outputs:
         return None
     attrs = {k: v for k, v in op.attrs.items()}
+    if "_fwd_in" in attrs:
+        # differentiating a *_grad op: keep the BASE op's forward slots
+        # for the nested vjp (run_generic_grad_grad) before recording
+        # this op's own slots
+        attrs.setdefault("_fwd_in_base", attrs["_fwd_in"])
     attrs["_fwd_in"] = list(op.inputs.keys())
     return [{"type": op.type + "_grad", "inputs": inputs,
              "outputs": outputs, "attrs": attrs}], produced
@@ -310,7 +319,16 @@ def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
     for t in targets:
         m = _nn.reduce_sum(t)
         loss = m if loss is None else _nn.elementwise_add(loss, m)
-    append_backward(loss, no_grad_set=no_grad_set)
+    # requested inputs (often stop_gradient data vars) must join the
+    # requires-grad set or no grad ops are emitted for them
+    restore = [(iv, iv.stop_gradient) for iv in inputs]
+    for iv in inputs:
+        iv.stop_gradient = False
+    try:
+        append_backward(loss, no_grad_set=no_grad_set)
+    finally:
+        for iv, sg in restore:
+            iv.stop_gradient = sg
     block = targets[0].block
     outs = []
     for iv in inputs:

@@ -269,6 +269,22 @@ class Tracer:
                 id(v) in grads for vals in entry.outs.values() for v in vals)
             if not ograds_present:
                 continue
+            if entry.op_type == "@functional@":
+                # a dygraph.grad(create_graph=True) node: backward is the
+                # vjp of the recorded grad computation (vjp-of-the-vjp)
+                in_vbs = entry.ins["In"]
+                out_vbs = entry.outs["Out"]
+                in_arrays = [v._array for v in in_vbs]
+                outs_vals, vjp_fn = jax.vjp(entry.attrs["_fn"], *in_arrays)
+                cots = tuple(
+                    grads.get(id(v), None) if grads.get(id(v), None)
+                    is not None else jnp.zeros_like(o)
+                    for v, o in zip(out_vbs, outs_vals))
+                for v, g in zip(in_vbs, vjp_fn(cots)):
+                    if isinstance(v, VarBase) and not v.stop_gradient:
+                        prev = grads.get(id(v))
+                        grads[id(v)] = g if prev is None else prev + g
+                continue
             info = OPS.get(entry.op_type)
             ins = {slot: [v._array for v in vals]
                    for slot, vals in entry.ins.items()}
@@ -300,12 +316,20 @@ class Tracer:
                     # GradientAccumulator: sum fan-in
                     prev = grads.get(id(v))
                     grads[id(v)] = g if prev is None else prev + g
-        # write grads onto leaves (params + any var the user watches)
+        # write grads onto leaves (params + any var the user watches) —
+        # ONCE per var: grads[] already holds the fan-in total, and a var
+        # appearing in several tape entries (x*x, residual reuse) must
+        # not have its total added per occurrence (round-4 fix: y=x*x
+        # used to report dx=4x). The += below is only the accumulation
+        # ACROSS separate backward() calls, per reference semantics.
+        written_leaves = set()
         for entry in self._tape:
             for vals in entry.ins.values():
                 for v in vals:
                     if isinstance(v, VarBase) and not v.stop_gradient \
-                            and id(v) in grads:
+                            and id(v) in grads \
+                            and id(v) not in written_leaves:
+                        written_leaves.add(id(v))
                         g = grads[id(v)]
                         v._grad = g if v._grad is None else v._grad + g
         self._tape.clear()
@@ -452,16 +476,198 @@ def no_grad(fn=None):
     return wrapper
 
 
+def _reachable(tape, inputs, no_grad_ids):
+    """Structural reachability: ids of every var transitively computed
+    from ``inputs`` along the tape (no kernels executed)."""
+    live = {id(v) for v in inputs if id(v) not in no_grad_ids}
+    for entry in tape:
+        if any(id(v) in live
+               for vals in entry.ins.values() for v in vals):
+            live.update(id(v) for vals in entry.outs.values()
+                        for v in vals)
+    return live
+
+
+def _replayable_fn(tape, inputs, outputs, no_grad_ids):
+    """Build a PURE function f(*input_arrays) -> output_arrays by
+    replaying the tape segment between ``inputs`` and ``outputs`` with
+    the recorded attrs (rng keys included, so dropout replays the same
+    mask). Vars outside the input-reachable set enter as recorded
+    constants. An input that is ITSELF produced by a replayed entry
+    (grad(z, [x, y]) with y on the x→z path) is rebound as
+    recomputed + (arg − stop_gradient(arg)): the value stays the
+    recomputed one (total derivative flows through to x) while the
+    identity residual routes the partial ∂/∂y to the y argument —
+    the reference/PyTorch multi-input grad contract."""
+    input_ids = {id(v): k for k, v in enumerate(inputs)}
+
+    def f(*in_arrays):
+        env = {id(v): a for v, a in zip(inputs, in_arrays)
+               if id(v) not in no_grad_ids}
+
+        def bind(v, a):
+            k = input_ids.get(id(v))
+            if k is None:
+                env[id(v)] = a
+            else:
+                arg = in_arrays[k]
+                env[id(v)] = a + (arg - jax.lax.stop_gradient(arg))
+
+        for entry in tape:
+            if entry.op_type == "@functional@":
+                if not any(id(v) in env for v in entry.ins["In"]):
+                    continue
+                vals = [env.get(id(v), v._array) for v in entry.ins["In"]]
+                outs = entry.attrs["_fn"](*vals)
+                for v, a in zip(entry.outs["Out"], outs):
+                    bind(v, a)
+                continue
+            if not any(id(v) in env
+                       for vals in entry.ins.values() for v in vals):
+                continue
+            ins = {slot: [env.get(id(v), v._array) for v in vals]
+                   for slot, vals in entry.ins.items()}
+            outs = OPS.get(entry.op_type).kernel(ins, entry.attrs)
+            for slot, vals in entry.outs.items():
+                produced = (outs or {}).get(slot)
+                if produced is None:
+                    continue
+                for v, a in zip(vals, produced):
+                    if a is not None:
+                        bind(v, a)
+        return tuple(env.get(id(o), o._array) for o in outputs)
+    return f
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None, backward_strategy=None):
-    """double-grad API (reference imperative/partial_grad_engine.cc). v0:
-    first-order only via a fresh tape sweep."""
-    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
-    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    for o in outputs:
-        o.backward()
-    return [i._grad_ivar for i in inputs]
+    """Gradients of ``outputs`` w.r.t. ``inputs`` over the live tape
+    (reference imperative/partial_grad_engine.cc). The tape segment is
+    replayed as a pure function and differentiated with jax.vjp; with
+    ``create_graph=True`` the grad computation is recorded back onto the
+    tape as a functional node whose backward is the vjp-of-the-vjp, so
+    losses built from these grads (gradient penalty) differentiate
+    correctly. ``grad_outputs`` seeds the cotangents (None entries mean
+    ones); ``allow_unused`` returns None for disconnected inputs instead
+    of raising. The tape is NOT consumed (retain_graph semantics are
+    automatic; pass retain_graph=False alongside create_graph=False to
+    release it)."""
+    if not only_inputs:
+        raise NotImplementedError("only_inputs=False is deprecated in the "
+                                  "reference and unsupported here")
+    tracer = framework._dygraph_tracer()
+    assert tracer is not None, "dygraph.grad() outside dygraph guard"
+    outputs = list(outputs) if isinstance(outputs, (list, tuple)) \
+        else [outputs]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+        else [inputs]
+    if grad_outputs is not None:
+        grad_outputs = list(grad_outputs) \
+            if isinstance(grad_outputs, (list, tuple)) else [grad_outputs]
+        if len(grad_outputs) != len(outputs):
+            raise ValueError("grad_outputs must match outputs length")
+    no_grad_ids = {id(v) for v in (no_grad_vars or [])}
+    tape = list(tracer._tape)
+    out_ids = {id(o) for o in outputs}
+    # per-input structural connectivity (which outputs each input reaches)
+    input_connected = [bool(out_ids & _reachable(tape, [v], no_grad_ids))
+                       for v in inputs]
+    if not any(input_connected):
+        if not allow_unused:
+            raise RuntimeError(
+                "dygraph.grad: outputs are not connected to inputs "
+                "(pass allow_unused=True to get None)")
+        return [None for _ in inputs]
+
+    # every OTHER differentiable leaf the segment reads (params, earlier
+    # activations from outside the segment): they must be real arguments
+    # of the replayed function, not captured constants — otherwise
+    # create_graph second-order grads can't flow to them (the gradient-
+    # penalty-to-weights path)
+    seen = {id(v) for v in inputs}
+    produced = {id(v) for e in tape
+                for vals in e.outs.values() for v in vals}
+    extras: List[VarBase] = []
+    for e in tape:
+        for vals in e.ins.values():
+            for v in vals:
+                if isinstance(v, VarBase) and not v.stop_gradient \
+                        and id(v) not in seen and id(v) not in produced \
+                        and id(v) not in no_grad_ids:
+                    seen.add(id(v))
+                    extras.append(v)
+
+    f = _replayable_fn(tape, inputs + extras, outputs, no_grad_ids)
+
+    cots = []
+    cot_vbs = []  # VarBase cotangents participate in the graph
+    for k, o in enumerate(outputs):
+        g = grad_outputs[k] if grad_outputs is not None else None
+        if g is None:
+            cots.append(jnp.ones_like(o._array))
+            cot_vbs.append(None)
+        elif isinstance(g, VarBase):
+            cots.append(g._array)
+            cot_vbs.append(g)
+        else:
+            cots.append(jnp.asarray(g))
+            cot_vbs.append(None)
+
+    n_in, n_out, n_extra = len(inputs), len(outputs), len(extras)
+
+    def gfn(*arrays):
+        """arrays = input vals + cotangent vals + extra-leaf vals ->
+        grads w.r.t. the inputs only."""
+        ivals = arrays[:n_in]
+        cvals = arrays[n_in:n_in + n_out]
+        evals = arrays[n_in + n_out:]
+        _, vjp_fn = jax.vjp(f, *(tuple(ivals) + tuple(evals)))
+        return tuple(vjp_fn(tuple(cvals))[:n_in])
+
+    call_args = [v._array for v in inputs] + cots \
+        + [v._array for v in extras]
+    gin = gfn(*call_args)
+
+    # disconnected inputs -> None per the reference contract
+    results: List[Optional[VarBase]] = []
+    for k, (v, g) in enumerate(zip(inputs, gin)):
+        if not input_connected[k]:
+            if not allow_unused:
+                raise RuntimeError(
+                    f"dygraph.grad: input {v.name} is unreachable from "
+                    f"outputs (pass allow_unused=True to get None)")
+            results.append(None)
+            continue
+        results.append(VarBase(g, name=v.name + "@GRAD",
+                               stop_gradient=not create_graph))
+
+    if create_graph:
+        # record the whole grad computation as ONE tape node; its
+        # backward is jax.vjp(gfn, ...) — true second order, with
+        # cotangents flowing to inputs, VarBase grad_outputs AND the
+        # extra leaves (params)
+        live_cots = [c for c in cot_vbs if c is not None]
+        in_vbs = list(inputs) + live_cots + list(extras)
+
+        def gfn_tape(*arrays):
+            ins = list(arrays[:n_in])
+            j = n_in
+            cs = list(cots)
+            for k, c in enumerate(cot_vbs):
+                if c is not None:
+                    cs[k] = arrays[j]
+                    j += 1
+            evals = list(arrays[j:])
+            full = gfn(*(ins + cs + evals))
+            return tuple(full[k] for k in range(len(inputs))
+                         if results[k] is not None)
+
+        tracer._tape.append(_TapeEntry(
+            "@functional@", {"In": in_vbs},
+            {"Out": [r for r in results if r is not None]},
+            {"_fn": gfn_tape}))
+    return results
 
 
 # hooks used by Optimizer in dygraph mode

@@ -161,12 +161,24 @@ def _op_reads_host_values(op) -> bool:
     return False
 
 
+def _resolve_base_info(op_type: str):
+    """Registry info for an op type, resolving *_grad / *_grad_grad
+    names to their base op. None for unknown types."""
+    t = op_type
+    if OPS.has(t):
+        return OPS.get(t)
+    while t.endswith("_grad"):
+        t = t[:-5]
+        if OPS.has(t):
+            return OPS.get(t)
+    return None
+
+
 def _op_is_stateful(op) -> bool:
-    if OPS.has(op.type):
-        return OPS.get(op.type).stateful
-    if op.type.endswith("_grad") and OPS.has(op.type[:-5]):
-        return OPS.get(op.type[:-5]).stateful
-    return True  # unknown op: be safe, run eagerly (will raise with context)
+    info = _resolve_base_info(op.type)
+    if info is None:
+        return True  # unknown op: be safe, run eagerly (raises w/ context)
+    return info.stateful
 
 
 # control-flow ops the compiled path lowers to lax primitives instead of
@@ -175,17 +187,30 @@ _LOWERED_CONTROL = frozenset({"while", "conditional_block",
                               "conditional_block_infer", "select_input"})
 
 
-def _ops_compilable(ops) -> bool:
+def _op_needs_rng(op_type: str) -> bool:
+    info = _resolve_base_info(op_type)
+    return info.needs_rng if info is not None else False
+
+
+def _ops_compilable(ops, in_cond=False) -> bool:
     """True if every op either has a pure kernel or is control flow whose
-    sub-blocks are themselves compilable."""
+    sub-blocks are themselves compilable. ``in_cond``: inside a
+    conditional_block sub-block, where the compiled lowering traces BOTH
+    branches and mask-merges — an rng op there would draw in the untaken
+    branch too, so such programs route to the interpreter's
+    single-branch semantics instead (reference
+    conditional_block_op.cc executes only the taken branch)."""
     for op in ops:
         if op.type in ("feed", "fetch"):
             continue
         if op.type in _LOWERED_CONTROL:
             sub = op.attrs.get("sub_block")
-            if sub is not None and not _ops_compilable(sub.ops):
+            cond = in_cond or op.type.startswith("conditional_block")
+            if sub is not None and not _ops_compilable(sub.ops, cond):
                 return False
         elif _op_is_stateful(op) or _op_reads_host_values(op):
+            return False
+        elif in_cond and _op_needs_rng(op.type):
             return False
     return True
 
@@ -482,6 +507,22 @@ class _CompiledBlock:
                     otype[:-5], ins, attrs,
                     wanted_grad_slots=list(op.outputs.keys()),
                     fwd_input_slots=attrs.get("_fwd_in", list(op.inputs.keys())))
+            elif otype.endswith("_grad_grad") and OPS.has(otype[:-10]):
+                # static double grad: vjp THROUGH the generic grad of the
+                # base op (gradient-penalty losses differentiate *_grad
+                # ops; reference imperative/partial_grad_engine.cc role)
+                from ..ops.registry import run_generic_grad_grad
+                if OPS.get(otype[:-10]).needs_rng:
+                    # same key as the forward op, like the *_grad branch:
+                    # the doubly-nested vjp must replay the SAME draws
+                    attrs = dict(attrs)
+                    attrs["_rng"] = jax.random.fold_in(
+                        rng, int(attrs.get("_fwd_idx", idx)))
+                outs = run_generic_grad_grad(
+                    otype[:-10], ins, attrs,
+                    wanted_grad_slots=list(op.outputs.keys()),
+                    gradop_slots=attrs.get("_fwd_in",
+                                           list(op.inputs.keys())))
             else:
                 raise NotImplementedError(f"op {otype} not registered")
             for slot, names in op.outputs.items():
@@ -896,6 +937,17 @@ class Executor:
                 otype[:-5], ins, attrs,
                 wanted_grad_slots=list(op.outputs.keys()),
                 fwd_input_slots=op.attrs.get("_fwd_in", list(op.inputs.keys())))
+        elif otype.endswith("_grad_grad") and OPS.has(otype[:-10]):
+            from ..ops.registry import run_generic_grad_grad
+            if OPS.get(otype[:-10]).needs_rng:
+                attrs = dict(attrs)
+                attrs["_rng"] = jax.random.fold_in(
+                    rng_base, int(attrs.get("_fwd_idx", idx)))
+            outs = run_generic_grad_grad(
+                otype[:-10], ins, attrs,
+                wanted_grad_slots=list(op.outputs.keys()),
+                gradop_slots=op.attrs.get("_fwd_in",
+                                          list(op.inputs.keys())))
         else:
             raise NotImplementedError(f"op '{otype}' is not implemented")
         if core.globals_["FLAGS_check_nan_inf"]:

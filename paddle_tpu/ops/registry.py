@@ -195,10 +195,59 @@ def run_generic_grad(fwd_type: str, ins: Dict[str, List], attrs: Dict,
     ops and outputs for others, so this must be explicit). Returns
     ``<slot>@GRAD`` lists for the requested input slots."""
     info = OPS.get(fwd_type)
+    return _vjp_through(info.kernel, info.diff_input_slots, ins, attrs,
+                        wanted_grad_slots, fwd_input_slots)
+
+
+def run_generic_grad_grad(base_type: str, ins: Dict[str, List], attrs: Dict,
+                          wanted_grad_slots: Sequence[str],
+                          gradop_slots: Sequence[str]) -> Dict[str, List]:
+    """Execute ``<base>_grad_grad`` — the vjp of the generic grad
+    computation itself (static double grad: gradient-penalty losses).
+
+    ``gradop_slots`` names the ``<base>_grad`` op's own input slots
+    (primals + outputs + output cotangents); ``ins`` additionally holds
+    the first-order grads under their slots plus the incoming
+    second-order cotangents under ``<slot>@GRAD@GRAD``-style names. The
+    base op's true forward slots ride in ``attrs["_fwd_in_base"]``."""
+    base_attrs = {k: v for k, v in attrs.items()
+                  if k not in ("_fwd_in", "_fwd_in_base")}
+    base_fwd = list(attrs.get("_fwd_in_base")
+                    or [s for s in gradop_slots
+                        if not s.endswith(GRAD_SUFFIX)])
+    base_attrs["_fwd_in"] = base_fwd
+    # the inner grad op's outputs = slots that carry a cotangent here
+    inner_out_slots = [s for s in ins
+                       if s.endswith(GRAD_SUFFIX)
+                       and s + GRAD_SUFFIX in ins]
+
+    def inner_kernel(merged, _attrs):
+        gouts = run_generic_grad(base_type, merged, base_attrs,
+                                 inner_out_slots, base_fwd)
+        fixed = {}
+        for s, vals in gouts.items():
+            prim = merged.get(s[:-len(GRAD_SUFFIX)], [])
+            fixed[s] = [
+                v if v is not None else
+                (jnp.zeros_like(prim[i]) if i < len(prim)
+                 and _is_diff_leaf(prim[i]) else None)
+                for i, v in enumerate(vals)]
+        return fixed
+
+    return _vjp_through(inner_kernel, None, ins, base_attrs,
+                        wanted_grad_slots, gradop_slots)
+
+
+def _vjp_through(kernel, diff_input_slots, ins: Dict[str, List],
+                 attrs: Dict, wanted_grad_slots: Sequence[str],
+                 fwd_input_slots: Sequence[str]) -> Dict[str, List]:
+    """Shared vjp core: differentiate ``kernel(ins, attrs)`` w.r.t. the
+    differentiable leaves of ``fwd_input_slots``, with cotangents taken
+    from ``<slot>@GRAD`` entries of ``ins``."""
     fwd_in_slots = [s for s in fwd_input_slots if s in ins]
     # Partition forward-input leaves into differentiable / constant.
     diff_sel: Dict[str, List[bool]] = {}
-    allowed = set(info.diff_input_slots) if info.diff_input_slots else None
+    allowed = set(diff_input_slots) if diff_input_slots else None
     for s in fwd_in_slots:
         vals = ins[s] or []
         diff_sel[s] = [
@@ -215,7 +264,7 @@ def run_generic_grad(fwd_type: str, ins: Dict[str, List], attrs: Dict,
             vals = list(ins[s] or [])
             it = iter(dp.get(s, []))
             merged[s] = [next(it) if d else v for v, d in zip(vals, diff_sel[s])]
-        outs = info.kernel(merged, attrs)
+        outs = kernel(merged, attrs)
         # Only outputs that have incoming grads (or are float) participate;
         # "_lod"-style metadata entries are not tensors.
         return {k: v for k, v in outs.items()
@@ -229,6 +278,9 @@ def run_generic_grad(fwd_type: str, ins: Dict[str, List], attrs: Dict,
         gvals = ins.get(gslot)
         cots = []
         for i, ov in enumerate(ovals):
+            if ov is None:  # non-diff entry kept for slot alignment
+                cots.append(None)
+                continue
             g = gvals[i] if gvals is not None and i < len(gvals) and gvals[i] is not None else None
             if g is None:
                 g = jnp.zeros_like(ov)

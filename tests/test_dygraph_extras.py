@@ -188,3 +188,131 @@ def test_gcn_node_classification():
         pred = np.asarray(logits.numpy()).argmax(-1)
     assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
     assert (pred == labels[:, 0]).mean() > 0.8
+
+
+# ------------------------------------------------------------ double grad
+def test_double_grad_closed_form():
+    """y = sum(x^3): dy/dx = 3x^2; z = sum(dy/dx) then dz/dx = 6x
+    (reference imperative/partial_grad_engine.cc semantics)."""
+    with dygraph.guard():
+        X = np.array([1.0, 2.0, -3.0], np.float32)
+        x = dygraph.to_variable(X)
+        x.stop_gradient = False
+        y = x * x * x
+        (g,) = dygraph.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(g.numpy(), 3 * X ** 2, rtol=1e-6)
+        z = g * dygraph.to_variable(np.ones_like(X))
+        z.backward()
+        np.testing.assert_allclose(x.gradient(), 6 * X, rtol=1e-6)
+
+
+def test_double_grad_gradient_penalty_matches_fd():
+    """WGAN-GP-style penalty: p(w) = mean((|dD/dx|_2 - 1)^2) for a tiny
+    linear critic D(x) = tanh(x@w) summed. dp/dw via create_graph
+    backward must match central finite differences."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(4, 3).astype("float32")
+    W0 = (rng.rand(3, 2).astype("float32") - 0.5)
+
+    def penalty_value(Wnp):
+        import jax.numpy as jnp
+
+        def p(W):
+            def D(xv):
+                return jnp.sum(jnp.tanh(xv @ W))
+            import jax as _jax
+            g = _jax.vmap(_jax.grad(D))(jnp.asarray(X))
+            nrm = jnp.sqrt(jnp.sum(g * g, axis=1) + 1e-12)
+            return jnp.mean((nrm - 1.0) ** 2)
+        return p(jnp.asarray(Wnp))
+
+    with dygraph.guard():
+        w = dygraph.to_variable(W0.copy())
+        w.trainable = True
+        w.stop_gradient = False
+        x = dygraph.to_variable(X)
+        x.stop_gradient = False
+        h = fluid.layers.tanh(fluid.layers.matmul(x, w))
+        d_out = fluid.layers.reduce_sum(h)
+        (gx,) = dygraph.grad(d_out, x, create_graph=True)
+        nrm = fluid.layers.sqrt(fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(gx, gx), dim=1) + 1e-12)
+        one = dygraph.to_variable(np.ones((4,), np.float32))
+        pen = fluid.layers.reduce_mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(nrm, one)))
+        pen.backward()
+        got = w.gradient()
+
+    eps = 1e-3
+    fd = np.zeros_like(W0)
+    for i in range(W0.shape[0]):
+        for j in range(W0.shape[1]):
+            Wp, Wm = W0.copy(), W0.copy()
+            Wp[i, j] += eps
+            Wm[i, j] -= eps
+            fd[i, j] = (float(penalty_value(Wp))
+                        - float(penalty_value(Wm))) / (2 * eps)
+    np.testing.assert_allclose(got, fd, rtol=5e-3, atol=5e-4)
+
+
+def test_grad_allow_unused_and_grad_outputs():
+    with dygraph.guard():
+        X = np.array([2.0, 3.0], np.float32)
+        x = dygraph.to_variable(X)
+        x.stop_gradient = False
+        u = dygraph.to_variable(np.ones(2, np.float32))
+        u.stop_gradient = False
+        y = x * x
+        # u is unused: None with allow_unused, error without
+        gx, gu = dygraph.grad(y, [x, u], allow_unused=True)
+        assert gu is None
+        np.testing.assert_allclose(gx.numpy(), 2 * X, rtol=1e-6)
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError, match="allow_unused"):
+            dygraph.grad(y, [u])
+        # grad_outputs seeds the cotangent
+        seed = np.array([10.0, 100.0], np.float32)
+        (gs,) = dygraph.grad(y, x, grad_outputs=[
+            dygraph.to_variable(seed)])
+        np.testing.assert_allclose(gs.numpy(), 2 * X * seed, rtol=1e-6)
+
+
+def test_backward_leaf_grad_not_inflated_by_reuse():
+    """A VarBase appearing in several tape entries (x*x, residual
+    reuse) must get its fan-in total ONCE (round-4 fix: y=x*x reported
+    dx=4x because the total was added per occurrence)."""
+    with dygraph.guard():
+        X = np.array([3.0], np.float32)
+        x = dygraph.to_variable(X)
+        x.stop_gradient = False
+        y = x * x
+        y.backward()
+        np.testing.assert_allclose(x.gradient(), 2 * X)
+    with dygraph.guard():
+        x = dygraph.to_variable(X)
+        x.stop_gradient = False
+        (x * x * x).backward()
+        np.testing.assert_allclose(x.gradient(), 3 * X ** 2)
+    with dygraph.guard():  # residual reuse: y = h + 2h
+        x = dygraph.to_variable(X)
+        x.stop_gradient = False
+        h = x * 2.0
+        y = h + h * 2.0
+        y.backward()
+        np.testing.assert_allclose(x.gradient(), [6.0])
+
+
+def test_grad_multi_input_chain_partials():
+    """grad(z, [x, y]) with y = 2x, z = 3y: dz/dx must be the TOTAL
+    derivative through y (6) and dz/dy the partial (3) — an input
+    produced by the replayed segment must not sever either path
+    (reference/PyTorch multi-input grad contract)."""
+    with dygraph.guard():
+        X = np.array([5.0], np.float32)
+        x = dygraph.to_variable(X)
+        x.stop_gradient = False
+        y = x * 2.0
+        z = y * 3.0
+        gx, gy = dygraph.grad(z, [x, y])
+        np.testing.assert_allclose(gx.numpy(), [6.0])
+        np.testing.assert_allclose(gy.numpy(), [3.0])
