@@ -9,16 +9,20 @@ import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import core
 
 
-def _train(main, startup, feed_fn, loss, steps=12):
+def _train(main, startup, feed_fn, loss, steps=12, extra_fetch=None):
     exe = fluid.Executor()
     scope = core.Scope()
-    losses = []
+    losses, extras = [], []
+    fetches = [loss] + list(extra_fetch or [])
     with fluid.scope_guard(scope):
         exe.run(startup)
         for i in range(steps):
-            out = exe.run(main, feed=feed_fn(i), fetch_list=[loss])
+            out = exe.run(main, feed=feed_fn(i), fetch_list=fetches)
             losses.append(float(np.asarray(out[0]).ravel()[0]))
-    return losses
+            if extra_fetch:
+                extras.append([float(np.asarray(o).ravel()[0])
+                               for o in out[1:]])
+    return (losses, extras) if extra_fetch else losses
 
 
 def test_mnist_mlp_and_conv_train():
@@ -28,9 +32,13 @@ def test_mnist_mlp_and_conv_train():
     W = rng.rand(10, 784).astype("float32")
     Y = (X @ W.T).argmax(1)[:, None].astype("int64")
     main, startup, feeds, loss, acc = build_mnist_program("mlp", lr=0.01)
-    losses = _train(main, startup,
-                    lambda i: {"img": X, "label": Y}, loss, steps=15)
-    assert losses[-1] < losses[0] * 0.8, losses
+    # convergence threshold, not just self-descent (reference book tests
+    # run to an accuracy bar): the learnable batch must be fit
+    losses, accs = _train(main, startup,
+                          lambda i: {"img": X, "label": Y}, loss,
+                          steps=120, extra_fetch=[acc])
+    assert losses[-1] < 0.1, losses[-5:]
+    assert accs[-1][0] >= 0.95, accs[-5:]
 
     Xc = X.reshape(64, 1, 28, 28)
     main, startup, feeds, loss, acc = build_mnist_program("conv", lr=0.01)
@@ -200,3 +208,58 @@ def test_se_resnext_forward_and_one_step():
     losses = _train(main, startup,
                     lambda i: {"image": img, "label": lbl}, loss, steps=2)
     assert np.isfinite(losses).all()
+
+
+def test_mnist_mlp_golden_trajectory_parity():
+    """BASELINE.md "MNIST loss-parity" row, actually checked: the
+    compiled executor's 10-step loss trajectory must match the
+    independently-generated pure-NumPy fixture (same weights/data via
+    NumpyArrayInitializer, same SGD math — tools/make_golden_trajectory
+    .py; reference tests/book/test_recognize_digits.py role). Catches
+    any systematic executor/op/optimizer drift, not just self-descent."""
+    import os
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    fx = np.load(os.path.join(os.path.dirname(__file__), "fixtures",
+                              "golden_mnist_trajectory.npz"))
+    w1, b1, w2, b2 = fx["w1"], fx["b1"], fx["w2"], fx["b2"]
+    X, Y = fx["X"].astype("float32"), fx["Y"]
+    golden = fx["losses"]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", shape=[784], dtype="float32")
+        label = fluid.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(
+            img, 64, act="relu",
+            param_attr=fluid.ParamAttr(
+                name="g_w1", initializer=fluid.initializer
+                .NumpyArrayInitializer(w1.astype("float32"))),
+            bias_attr=fluid.ParamAttr(
+                name="g_b1", initializer=fluid.initializer
+                .NumpyArrayInitializer(b1.astype("float32"))))
+        pred = fluid.layers.fc(
+            h, 10, act="softmax",
+            param_attr=fluid.ParamAttr(
+                name="g_w2", initializer=fluid.initializer
+                .NumpyArrayInitializer(w2.astype("float32"))),
+            bias_attr=fluid.ParamAttr(
+                name="g_b2", initializer=fluid.initializer
+                .NumpyArrayInitializer(b2.astype("float32"))))
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = core.Scope()
+    got = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(len(golden)):
+            (l,) = exe.run(main, feed={"img": X, "label": Y},
+                           fetch_list=[loss])
+            got.append(float(np.asarray(l).ravel()[0]))
+    # float32 executor vs float64 oracle: growth of rounding error over
+    # 10 steps stays well inside 1e-4 relative
+    np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)

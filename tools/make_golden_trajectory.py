@@ -1,0 +1,74 @@
+"""Generate tests/fixtures/golden_mnist_trajectory.npz INDEPENDENTLY of
+paddle_tpu: a pure-NumPy implementation of the MNIST-MLP smoke config
+(BASELINE.md "loss-parity with reference CPU run" row; reference
+tests/book/test_recognize_digits.py trains this exact shape) — fc(64,
+relu) → fc(10, softmax) → cross_entropy mean, plain SGD. Same fixed
+weights/data the fluid test builds via NumpyArrayInitializer, 10 steps,
+per-step losses recorded in float64.
+
+Regenerate with:
+    python tools/make_golden_trajectory.py
+"""
+import os
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "fixtures", "golden_mnist_trajectory.npz")
+
+BATCH, D_IN, D_H, D_OUT, STEPS, LR = 32, 784, 64, 10, 10, 0.1
+
+
+def init(seed=1234):
+    r = np.random.RandomState(seed)
+    return {
+        "w1": (r.rand(D_IN, D_H) * 0.02 - 0.01).astype(np.float64),
+        "b1": np.zeros(D_H, np.float64),
+        "w2": (r.rand(D_H, D_OUT) * 0.02 - 0.01).astype(np.float64),
+        "b2": np.zeros(D_OUT, np.float64),
+        "X": r.rand(BATCH, D_IN).astype(np.float64),
+        "Y": r.randint(0, D_OUT, (BATCH, 1)).astype(np.int64),
+    }
+
+
+def run(p):
+    w1, b1, w2, b2 = (p[k].copy() for k in ("w1", "b1", "w2", "b2"))
+    X, Y = p["X"], p["Y"]
+    losses = []
+    onehot = np.eye(D_OUT)[Y[:, 0]]
+    for _ in range(STEPS):
+        h_lin = X @ w1 + b1
+        h = np.maximum(h_lin, 0.0)
+        logits = h @ w2 + b2
+        z = logits - logits.max(axis=1, keepdims=True)
+        ez = np.exp(z)
+        probs = ez / ez.sum(axis=1, keepdims=True)
+        loss = float(np.mean(-np.log(
+            probs[np.arange(BATCH), Y[:, 0]] + 0.0)))
+        losses.append(loss)
+        # backward (mean cross-entropy over softmax)
+        dlogits = (probs - onehot) / BATCH
+        dw2 = h.T @ dlogits
+        db2 = dlogits.sum(0)
+        dh = dlogits @ w2.T
+        dh_lin = dh * (h_lin > 0.0)
+        dw1 = X.T @ dh_lin
+        db1 = dh_lin.sum(0)
+        w1 -= LR * dw1
+        b1 -= LR * db1
+        w2 -= LR * dw2
+        b2 -= LR * db2
+    return np.asarray(losses, np.float64)
+
+
+def main():
+    p = init()
+    losses = run(p)
+    np.savez(OUT, losses=losses,
+             **{k: p[k] for k in ("w1", "b1", "w2", "b2", "X", "Y")})
+    print("wrote", OUT)
+    print("losses:", np.round(losses, 6))
+
+
+if __name__ == "__main__":
+    main()
