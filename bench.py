@@ -18,7 +18,21 @@ import sys
 import time
 import traceback
 
-import numpy as np
+
+def _pin_host_threads(n=8):
+    """Fix BLAS/OMP pools so CPU trend rows are comparable across
+    sessions (round-3 drift 5.19 -> 4.61 samples/s had no in-repo
+    explanation; ambient thread-pool sizing was the suspect). MUST run
+    before numpy loads OpenBLAS/MKL — the pools size themselves at
+    library load. Explicit env set by the caller wins."""
+    for var in ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS",
+                "MKL_NUM_THREADS", "NUMEXPR_NUM_THREADS"):
+        os.environ.setdefault(var, str(n))
+
+
+_pin_host_threads()
+
+import numpy as np  # noqa: E402  (after the thread pinning, by design)
 
 V5E_PEAK_FLOPS = 197e12  # bf16 peak per chip
 

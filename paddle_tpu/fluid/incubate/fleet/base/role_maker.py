@@ -11,7 +11,7 @@ import os
 
 __all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
            "UserDefinedRoleMaker", "UserDefinedCollectiveRoleMaker",
-           "GeneralRoleMaker"]
+           "GeneralRoleMaker", "MPIRoleMaker", "MPISymetricRoleMaker"]
 
 
 class Role:
@@ -148,6 +148,93 @@ class UserDefinedCollectiveRoleMaker(RoleMakerBase):
 
     def is_server(self):
         return False
+
+
+class MPIRoleMaker(RoleMakerBase):
+    """Name-compat shim for the reference's mpi4py-backed role maker
+    (reference role_maker.py:151). Rank/size come from the launcher's
+    env (PADDLE_TRAINER_ID / OMPI_COMM_WORLD_RANK); there is no MPI in
+    the TPU runtime — collective messaging rides XLA collectives or the
+    fleet TCP plane, so the MPI gather/barrier helpers raise with that
+    pointer instead of silently doing nothing."""
+
+    def __init__(self):
+        super().__init__()
+        self._rank = int(os.getenv("PADDLE_TRAINER_ID",
+                                   os.getenv("OMPI_COMM_WORLD_RANK", "0")))
+        self._size = int(os.getenv("PADDLE_TRAINERS_NUM",
+                                   os.getenv("OMPI_COMM_WORLD_SIZE", "1")))
+        self._role_is_generated = False
+
+    def _get_rank(self):
+        return self._rank
+
+    def _get_size(self):
+        return self._size
+
+    def _no_mpi(self, what):
+        raise RuntimeError(
+            f"MPIRoleMaker.{what}: no MPI runtime on TPU — use the fleet "
+            f"collective mode (XLA collectives over ICI/DCN) or the PS "
+            f"TCP plane (fluid.ps_rpc) for cross-process messaging")
+
+    def _all_gather(self, obj):
+        self._no_mpi("_all_gather")
+
+    def _worker_gather(self, obj):
+        self._no_mpi("_worker_gather")
+
+    def _barrier_all(self):
+        self._no_mpi("_barrier_all")
+
+    def _finalize(self):
+        pass
+
+
+class MPISymetricRoleMaker(MPIRoleMaker):
+    """reference role_maker.py:226 — every node hosts one worker AND one
+    pserver process: even ranks are servers (node_type 0), odd ranks
+    workers (node_type 1), proc_per_node=2."""
+
+    def __init__(self):
+        super().__init__()
+        self._proc_per_node = 2
+        self._node_type = None
+
+    def generate_role(self):
+        if not self._role_is_generated:
+            self._node_type = self._rank % self._proc_per_node
+            self._role_is_generated = True
+
+    def _check_role_generation(self):
+        if not self._role_is_generated:
+            raise NameError("generate_role() should be called first")
+        return True
+
+    def is_worker(self):
+        return self._check_role_generation() and self._node_type == 1
+
+    def is_server(self):
+        return self._check_role_generation() and self._node_type == 0
+
+    def is_first_worker(self):
+        return self.is_worker() and self.worker_index() == 0
+
+    def worker_num(self):
+        self._check_role_generation()
+        return self._size // self._proc_per_node
+
+    def server_num(self):
+        self._check_role_generation()
+        return self._size // self._proc_per_node
+
+    def worker_index(self):
+        self._check_role_generation()
+        return self._rank // self._proc_per_node
+
+    def server_index(self):
+        self._check_role_generation()
+        return self._rank // self._proc_per_node
 
 
 GeneralRoleMaker = PaddleCloudRoleMaker

@@ -18,7 +18,34 @@ import numpy as np
 
 __all__ = ["Config", "AnalysisConfig", "Predictor", "AnalysisPredictor",
            "create_predictor", "create_paddle_predictor", "PredictTensor",
-           "PassStrategy", "PredictorPool"]
+           "PassStrategy", "PredictorPool", "enable_compile_cache"]
+
+
+_COMPILE_CACHE_DIR = None
+
+
+def enable_compile_cache(cache_dir: str):
+    """Point XLA's persistent compilation cache at ``cache_dir`` — the
+    TPU-native role of the reference's serialized TensorRT engine cache
+    (analysis_config.cc SetOptimCacheDir + tensorrt/ engine
+    serialization): a SECOND process loading the same model skips the
+    XLA compile entirely (the executable is loaded from disk, keyed by
+    HLO hash). Process-global; idempotent per dir. Every compile in the
+    process benefits (training steps included), which matches how the
+    engine cache removes the reference's cold-start."""
+    global _COMPILE_CACHE_DIR
+    import os
+    import jax
+    cache_dir = os.path.abspath(cache_dir)
+    if _COMPILE_CACHE_DIR == cache_dir:
+        return
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache every executable: the defaults skip small/fast compiles,
+    # which is exactly the cold-start this exists to remove
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _COMPILE_CACHE_DIR = cache_dir
 
 
 class AnalysisConfig:
@@ -41,6 +68,7 @@ class AnalysisConfig:
         self._bf16 = False
         self._profile = False
         self._pass_builder = None
+        self._optim_cache_dir = None
 
     # --- model location ---------------------------------------------------
     def set_model(self, model_dir, params_file=None):
@@ -57,6 +85,13 @@ class AnalysisConfig:
 
     def model_from_memory(self) -> bool:
         return self._prog_bytes is not None
+
+    def set_optim_cache_dir(self, cache_dir: str):
+        """reference analysis_config.cc SetOptimCacheDir — on TPU this
+        activates the persistent XLA executable cache (see
+        enable_compile_cache): later processes loading this model skip
+        the compile."""
+        self._optim_cache_dir = cache_dir
 
     def set_prog_file(self, f):
         self._prog_file = f
@@ -178,6 +213,8 @@ class AnalysisPredictor:
         import paddle_tpu.fluid as fluid
         from paddle_tpu.fluid import core
         self.config = config
+        if config._optim_cache_dir:
+            enable_compile_cache(config._optim_cache_dir)
         self._exe = fluid.Executor()
         if _shared is not None:
             # weight-sharing clone (reference AnalysisPredictor::Clone

@@ -20,3 +20,29 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running model tests")
+    config.addinivalue_line(
+        "markers", "full: full-tier-only tests (skipped by the quick "
+        "per-commit tier: pytest -m 'not full')")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Two suite tiers (VERDICT r03 item 9): the quick per-commit tier
+    (`pytest -m "not full"`, target < 5 min) skips tests listed in
+    tests/full_tier.txt — one nodeid prefix per line, maintained from
+    `pytest --durations` output. The full tier (plain `pytest tests/`)
+    runs everything and stays the round-end gate."""
+    import pytest
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "full_tier.txt")
+    if not os.path.exists(path):
+        return
+    prefixes = tuple(
+        ln.strip() for ln in open(path)
+        if ln.strip() and not ln.strip().startswith("#"))
+    if not prefixes:
+        return
+    mark = pytest.mark.full
+    for item in items:
+        nid = item.nodeid.replace(os.sep, "/")
+        if nid.startswith(prefixes):
+            item.add_marker(mark)

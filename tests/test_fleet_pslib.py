@@ -299,3 +299,27 @@ def test_collective_checkpoint_roundtrip(tmp_path):
     ts = f.load_check_point(exe, str(tmp_path / "nothing"),
                             main_program=main, local_cache_path=cache)
     assert ts.epoch_no == -1
+
+
+def test_mpi_symetric_role_maker_shim(monkeypatch):
+    """Name-compat shim for the reference's mpi4py role maker
+    (role_maker.py:226): env-based ranks, even=server odd=worker,
+    MPI messaging helpers raise actionably."""
+    from paddle_tpu.fluid.incubate.fleet.base.role_maker import (
+        MPIRoleMaker, MPISymetricRoleMaker)
+    import pytest as _pytest
+
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    rm = MPISymetricRoleMaker()
+    with _pytest.raises(NameError):
+        rm.is_worker()  # before generate_role, like the reference
+    rm.generate_role()
+    assert rm.is_worker() and not rm.is_server()
+    assert rm.worker_num() == 2 and rm.worker_index() == 1
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    rm2 = MPISymetricRoleMaker()
+    rm2.generate_role()
+    assert rm2.is_server() and rm2.server_index() == 1
+    with _pytest.raises(RuntimeError, match="no MPI runtime"):
+        MPIRoleMaker()._all_gather(1)

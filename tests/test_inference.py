@@ -148,3 +148,74 @@ def test_predictor_misc_api(tmp_path):
                                np.asarray(y2, np.float32), rtol=1e-2)
     from paddle_tpu.fluid import core
     core.set_flag("FLAGS_use_bf16_matmul", False)  # reset global
+
+
+def test_predictor_aot_compile_cache_cross_process(tmp_path):
+    """set_optim_cache_dir (reference analysis_config.cc SetOptimCacheDir
+    / TensorRT engine-cache role): a SECOND process loading the same
+    model must hit the persistent XLA executable cache instead of
+    recompiling. The child reports jax's own 'compilation cache hit'
+    log plus its outputs; outputs must also match across processes."""
+    import json
+    import subprocess
+    import sys
+
+    model_dir = str(tmp_path / "model")
+    cache_dir = str(tmp_path / "xla_cache")
+    build = """
+import json, logging, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+import paddle_tpu.inference as infer
+
+model_dir, cache_dir, make = MODEL_DIR, CACHE_DIR, MAKE
+if make:
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[64], dtype="float32")
+        h = x
+        for i in range(4):
+            h = fluid.layers.fc(h, 64, act="relu")
+        out = fluid.layers.fc(h, 8, act="softmax")
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ["x"], [out], exe,
+                                      main_program=main)
+
+records = []
+h = logging.Handler()
+h.emit = lambda r: records.append(r.getMessage())
+logging.getLogger("jax._src.compiler").addHandler(h)
+logging.getLogger("jax._src.compiler").setLevel(logging.DEBUG)
+
+cfg = infer.Config(model_dir)
+cfg.set_optim_cache_dir(cache_dir)
+pred = infer.create_predictor(cfg)
+X = np.linspace(0, 1, 2 * 64, dtype="float32").reshape(2, 64)
+(y,) = pred.run([X])
+hit = any("compilation cache hit" in m for m in records)
+print(json.dumps({"hit": hit, "y": np.asarray(y).ravel().tolist()}))
+"""
+    build = build.replace("MODEL_DIR", repr(model_dir)) \
+                 .replace("CACHE_DIR", repr(cache_dir))
+    env = dict(__import__("os").environ)
+    out1 = subprocess.run([sys.executable, "-c",
+                           build.replace("MAKE", "True")],
+                          capture_output=True, text=True, env=env,
+                          timeout=300)
+    assert out1.returncode == 0, out1.stderr[-2000:]
+    r1 = json.loads(out1.stdout.strip().splitlines()[-1])
+    assert __import__("os").listdir(cache_dir), "no cache entries written"
+    out2 = subprocess.run([sys.executable, "-c",
+                           build.replace("MAKE", "False")],
+                          capture_output=True, text=True, env=env,
+                          timeout=300)
+    assert out2.returncode == 0, out2.stderr[-2000:]
+    r2 = json.loads(out2.stdout.strip().splitlines()[-1])
+    assert r2["hit"], "second process recompiled instead of cache hit"
+    np.testing.assert_allclose(r1["y"], r2["y"], rtol=1e-6)
