@@ -128,13 +128,18 @@ class CompiledProgram:
         self._program = pm.apply(self._program, protected=fetch_names)
 
     def _run(self, executor, feed, fetch_list, scope, return_numpy,
-             mesh=None, param_shardings=None):
+             mesh=None, param_shardings=None, n_steps=1):
         """Delegate to the executor. Data-parallel execution shards the feed
         batch over the device mesh (see parallel/data_parallel.py); on a
         single chip this is a plain jitted run."""
         self._apply_build_strategy_passes(scope, fetch_list)
         if self._is_data_parallel:
             from ..parallel.data_parallel import run_data_parallel
+            if n_steps != 1:
+                raise NotImplementedError(
+                    "n_steps > 1 with CompiledProgram.with_data_parallel "
+                    "is not supported — pass mesh= to a plain Executor.run "
+                    "for scanned multi-step windows")
             if mesh is not None:
                 # an explicit mesh (e.g. dp×mp) overrides the auto-built
                 # 1-axis dp mesh; cached for subsequent steps
@@ -144,4 +149,5 @@ class CompiledProgram:
                                      param_shardings=param_shardings)
         return executor.run(self._program, feed=feed, fetch_list=fetch_list,
                             scope=scope, return_numpy=return_numpy,
-                            mesh=mesh, param_shardings=param_shardings)
+                            mesh=mesh, param_shardings=param_shardings,
+                            n_steps=n_steps)

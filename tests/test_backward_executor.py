@@ -539,3 +539,58 @@ def test_rng_op_inside_cond_routes_to_interpreter():
     np.testing.assert_allclose(np.asarray(o), 2 * X)
     assert not any(k[0] == id(main) for k in exe._compiled_cache), \
         "program with rng-in-cond was compiled"
+
+
+def test_run_n_steps_scanned_matches_loop():
+    """exe.run(n_steps=K) executes K optimizer steps inside ONE
+    dispatched lax.scan; the stacked per-step losses and the final
+    weights must match K separate run() calls (same feeds)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[6], dtype="float32")
+            y = fluid.data("y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(x, 8, act="tanh")
+            pred = fluid.layers.fc(h, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(8, 6).astype("float32")
+    Y = rng.rand(8, 1).astype("float32")
+    K = 6
+
+    main, startup, loss = build()
+    exe = fluid.Executor()
+    s1 = core.Scope()
+    loop_losses = []
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        for _ in range(K):
+            (l,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            loop_losses.append(float(np.asarray(l).ravel()[0]))
+        w_loop = np.asarray(
+            s1.find_var(main.all_parameters()[0].name)
+            .get_tensor().array).copy()
+
+    main2, startup2, loss2 = build()
+    exe2 = fluid.Executor()
+    s2 = core.Scope()
+    with fluid.scope_guard(s2):
+        exe2.run(startup2)
+        (stacked,) = exe2.run(main2, feed={"x": X, "y": Y},
+                              fetch_list=[loss2], n_steps=K)
+        w_scan = np.asarray(
+            s2.find_var(main2.all_parameters()[0].name)
+            .get_tensor().array)
+    stacked = np.asarray(stacked).ravel()
+    assert stacked.shape == (K,)
+    np.testing.assert_allclose(stacked, loop_losses, rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(w_scan, w_loop, rtol=2e-5, atol=1e-6)
