@@ -463,6 +463,70 @@ class FcFusePass(Pass):
         return graph
 
 
+class _FcRecurrentFuseBase(Pass):
+    """Shared rewrite for fc_gru/fc_lstm fusion (ir/fc_gru_fuse_pass.cc,
+    ir/fc_lstm_fuse_pass.cc): the input projection mul(X, Wx) feeding a
+    LoD recurrence becomes the fused op's WeightX leg, and the mul's
+    output IS the fused op's XX output — so consumers of either name
+    keep resolving and the wire shape matches the reference's fused
+    inference graphs. The fc-with-bias variant is left unfused (folding
+    the fc bias into the recurrence bias would need scope rewriting)."""
+
+    _recur_type = None      # "dynamic_gru" / "dynamic_lstm"
+    _fused_type = None      # "fusion_gru" / "fusion_lstm"
+    _extra_outs = ()        # extra recurrence outputs to carry over
+    _attr_names = ()
+
+    def apply_impl(self, graph):
+        pat = OpPattern([
+            ("mul", {"X": "$x", "Y": "$wx"}, {"Out": "$xx"}),
+            (self._recur_type, {"Input": "$xx", "Weight": "$wh"},
+             {"Hidden": "$h"}),
+        ])
+        for m in pat.match(graph):
+            mul_op, rec_op = m["#0"], m["#1"]
+            if int(mul_op.attr("x_num_col_dims") or 1) != 1 or \
+                    int(mul_op.attr("y_num_col_dims") or 1) != 1:
+                continue
+            # $xx internality/single-consumer is guaranteed by the
+            # matcher (OpPattern intermediates)
+            inputs = {"X": [m["$x"]], "WeightX": [m["$wx"]],
+                      "WeightH": [m["$wh"]]}
+            for slot in ("Bias", "H0", "C0"):
+                names = rec_op.input(slot)
+                if names:
+                    inputs[slot] = list(names)
+            outputs = {"Hidden": [m["$h"]], "XX": [m["$xx"]]}
+            for slot in self._extra_outs:
+                names = rec_op.output(slot)
+                if names:
+                    outputs[slot] = list(names)
+            attrs = {k: rec_op.attr(k) for k in self._attr_names
+                     if rec_op.attr(k) is not None}
+            graph.fuse([mul_op, rec_op], self._fused_type,
+                       inputs, outputs, attrs)
+        return graph
+
+
+@register_pass("fc_gru_fuse_pass")
+class FcGruFusePass(_FcRecurrentFuseBase):
+    """mul + dynamic_gru -> fusion_gru (ir/fc_gru_fuse_pass.cc)."""
+    _recur_type = "dynamic_gru"
+    _fused_type = "fusion_gru"
+    _attr_names = ("is_reverse", "origin_mode", "gate_activation",
+                   "activation")
+
+
+@register_pass("fc_lstm_fuse_pass")
+class FcLstmFusePass(_FcRecurrentFuseBase):
+    """mul + dynamic_lstm -> fusion_lstm (ir/fc_lstm_fuse_pass.cc)."""
+    _recur_type = "dynamic_lstm"
+    _fused_type = "fusion_lstm"
+    _extra_outs = ("Cell",)
+    _attr_names = ("use_peepholes", "is_reverse", "gate_activation",
+                   "cell_activation", "candidate_activation")
+
+
 @register_pass("fuse_elewise_add_act_pass")
 class FuseElewiseAddActPass(Pass):
     """elementwise_add + {relu,tanh,sigmoid,scale} ->
@@ -1025,8 +1089,6 @@ for _n, _note in {
     "conv_transpose_eltwiseadd_bn_fuse_pass": "XLA folds",
     "attention_lstm_fuse_pass": "attention_lstm op exists; XLA fuses",
     "embedding_fc_lstm_fuse_pass": "XLA fuses",
-    "fc_gru_fuse_pass": "fusion_gru op exists; XLA fuses",
-    "fc_lstm_fuse_pass": "fusion_lstm op exists; XLA fuses",
     "mul_gru_fuse_pass": "XLA fuses",
     "mul_lstm_fuse_pass": "XLA fuses",
     "quant_conv2d_dequant_fuse_pass": "int8 deploy; out of scope on TPU",
@@ -1049,6 +1111,10 @@ INFERENCE_PASSES = [
     "conv_eltwiseadd_bn_fuse_pass",
     "conv_bn_fuse_pass",
     "embedding_eltwise_layernorm_fuse_pass",
+    # before fc_fuse_pass: the recurrence patterns anchor on the raw
+    # projection mul feeding dynamic_gru/dynamic_lstm
+    "fc_gru_fuse_pass",
+    "fc_lstm_fuse_pass",
     "fc_fuse_pass",
     "fc_elementwise_layernorm_fuse_pass",
     "identity_scale_op_clean_pass",

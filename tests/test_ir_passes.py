@@ -680,3 +680,72 @@ def test_multihead_fused_op_hits_flash_kernel_for_keypad_mask():
         attention_ops.flash_attention = orig
     assert calls, "fused multihead_matmul did not reach the flash kernel"
     np.testing.assert_allclose(before, after, rtol=2e-4, atol=2e-5)
+
+
+# --------------------------------------------------------------------------
+# fc + recurrence fusion (wire-shape parity with the reference's fused
+# inference graphs — ir/fc_gru_fuse_pass.cc, ir/fc_lstm_fuse_pass.cc)
+# --------------------------------------------------------------------------
+def _lod_x(rng, rows=7, dim=4):
+    t = core.LoDTensor(rng.rand(rows, dim).astype("float32"),
+                       lod=[[0, 3, rows]])
+    return t
+
+
+def test_fc_gru_fuse_pass_numeric():
+    H = 5
+
+    def build():
+        x = fluid.layers.data("x", shape=[4], dtype="float32", lod_level=1)
+        proj = fluid.layers.fc(x, 3 * H, bias_attr=False)
+        return fluid.layers.dynamic_gru(proj, H)
+
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(0)
+    feed = {"x": _lod_x(rng)}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["fc_gru_fuse_pass"], scope).apply(main)
+    types = _op_types(main)
+    assert "fusion_gru" in types and "dynamic_gru" not in types \
+        and "mul" not in types, types
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fc_lstm_fuse_pass_numeric():
+    H = 5
+
+    def build():
+        x = fluid.layers.data("x", shape=[4], dtype="float32", lod_level=1)
+        proj = fluid.layers.fc(x, 4 * H, bias_attr=False)
+        hidden, cell = fluid.layers.dynamic_lstm(proj, 4 * H,
+                                                 use_peepholes=False)
+        return hidden
+
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(1)
+    feed = {"x": _lod_x(rng)}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["fc_lstm_fuse_pass"], scope).apply(main)
+    types = _op_types(main)
+    assert "fusion_lstm" in types and "dynamic_lstm" not in types \
+        and "mul" not in types, types
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fc_gru_fuse_skips_biased_projection():
+    """The fc-with-bias variant stays unfused (folding the projection
+    bias into the recurrence bias would need scope rewriting)."""
+    H = 5
+
+    def build():
+        x = fluid.layers.data("x", shape=[4], dtype="float32", lod_level=1)
+        proj = fluid.layers.fc(x, 3 * H)  # with bias -> mul + ew_add
+        return fluid.layers.dynamic_gru(proj, H)
+
+    main, scope, out = _fresh(build)
+    PassManager(["fc_gru_fuse_pass"], scope).apply(main)
+    assert "dynamic_gru" in _op_types(main)

@@ -302,7 +302,7 @@ def test_mfu_report_xla_cost_analysis():
     import json
     from tools.mfu_report import report
 
-    out = report("mnist", steps=2, warmup=1)
+    out = report("mnist", steps=2)
     assert out["xla_flops_per_step"] > 1e6
     assert out["step_ms"] > 0
     # bytes-accessed keys are optional per the tool's contract (some

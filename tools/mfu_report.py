@@ -49,7 +49,7 @@ def analyze(cb, scope, feed_arrays, rng):
     return cost or {}
 
 
-def report(model="bert", steps=None, warmup=None, trace_dir=None):
+def report(model="bert", steps=None, trace_dir=None):
     import jax
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import core
@@ -58,7 +58,6 @@ def report(model="bert", steps=None, warmup=None, trace_dir=None):
     smoke = backend == "cpu"
     # explicit caller args always win; defaults shrink on the CPU smoke
     steps = steps if steps is not None else (3 if smoke else 10)
-    warmup = warmup if warmup is not None else (1 if smoke else 3)
     prev_bf16 = core.globals_["FLAGS_use_bf16_matmul"]
     if model == "bert":
         from paddle_tpu.models import bert
@@ -113,13 +112,17 @@ def report(model="bert", steps=None, warmup=None, trace_dir=None):
         cost = analyze(cb, scope, feed_arrays, jax.random.key(0))
 
         def timed():
-            for _ in range(warmup):
-                exe.run(main, feed=feed, fetch_list=fetch_list,
-                        return_numpy=False)
+            # one dispatched scan per window (exe.run n_steps): the
+            # tunnel's ~10 ms/dispatch stays out of the measured MFU;
+            # the compile run below doubles as the warmup — and must be
+            # SYNCED before the clock starts, or the timed dispatch
+            # queues behind the still-executing warm window
+            w = exe.run(main, feed=feed, fetch_list=fetch_list,
+                        return_numpy=False, n_steps=steps)
+            _ = np.asarray(w[0].array).ravel()[:1]
             t0 = time.perf_counter()
-            for _ in range(steps):
-                o = exe.run(main, feed=feed, fetch_list=fetch_list,
-                            return_numpy=False)
+            o = exe.run(main, feed=feed, fetch_list=fetch_list,
+                        return_numpy=False, n_steps=steps)
             _ = np.asarray(o[0].array).ravel()[:1]
             return (time.perf_counter() - t0) / steps
 
