@@ -153,6 +153,32 @@ def bench_mnist_mlp(batch=256, steps=60, warmup=10):
             "vs_baseline": 1.0}
 
 
+def _is_oom(e) -> bool:
+    s = repr(e)
+    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s \
+        or "out of memory" in s
+
+
+def _run_with_oom_ladder(name, batches, run_once):
+    """First contact must land a number, not an OOM: try each batch in
+    ``batches`` (descending); ``run_once(b) -> dt`` raises on OOM.
+    Returns (chosen_batch, dt)."""
+    last_err = None
+    for i, b in enumerate(batches):
+        if b < 1:
+            break
+        try:
+            return b, run_once(b)
+        except Exception as e:  # noqa: BLE001 — OOM shapes vary by backend
+            if not _is_oom(e):
+                raise
+            last_err = e
+            if i + 1 < len(batches):
+                print(f"{name}: batch {b} OOM, retrying at "
+                      f"{batches[i + 1]}", file=sys.stderr)
+    raise last_err
+
+
 def bench_bert_base(batch=256, seq_len=128, steps=20, warmup=5):
     import jax
     import paddle_tpu.fluid as fluid
@@ -167,23 +193,31 @@ def bench_bert_base(batch=256, seq_len=128, steps=20, warmup=5):
         batch, seq_len, steps, warmup = 8, 64, 3, 1
     main, startup, feeds, fetches = bert.build_bert_pretrain_program(
         cfg, seq_len=seq_len, dropout=0.0, lr=1e-4)
-    exe = fluid.Executor()
-    scope = core.Scope()
     rng = np.random.RandomState(0)
-    n_mask = max(1, int(batch * seq_len * 0.15))
-    feed = {
-        "src_ids": rng.randint(0, cfg["vocab_size"],
-                               (batch, seq_len)).astype("int64"),
-        "pos_ids": np.tile(np.arange(seq_len), (batch, 1)).astype("int64"),
-        "sent_ids": np.zeros((batch, seq_len), "int64"),
-        "mask_pos": rng.randint(0, batch * seq_len,
-                                (n_mask, 1)).astype("int64"),
-        "mask_label": rng.randint(0, cfg["vocab_size"],
-                                  (n_mask, 1)).astype("int64"),
-    }
-    with fluid.scope_guard(scope):
-        exe.run(startup)
-        dt = _timed_steps(exe, main, feed, fetches, steps, warmup)
+
+    def feed_of(b):
+        n_mask = max(1, int(b * seq_len * 0.15))
+        return {
+            "src_ids": rng.randint(0, cfg["vocab_size"],
+                                   (b, seq_len)).astype("int64"),
+            "pos_ids": np.tile(np.arange(seq_len), (b, 1)).astype("int64"),
+            "sent_ids": np.zeros((b, seq_len), "int64"),
+            "mask_pos": rng.randint(0, b * seq_len,
+                                    (n_mask, 1)).astype("int64"),
+            "mask_label": rng.randint(0, cfg["vocab_size"],
+                                      (n_mask, 1)).astype("int64"),
+        }
+
+    def run_once(b):
+        exe = fluid.Executor()
+        scope = core.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            return _timed_steps(exe, main, feed_of(b), fetches, steps,
+                                warmup)
+
+    batch, dt = _run_with_oom_ladder(
+        "bert", (batch, batch // 2, batch // 4, batch // 8), run_once)
     sps = batch * steps / dt
     # 6·N·tokens FLOPs estimate (fwd+bwd), N = transformer params (no embed)
     h, L, f = cfg["hidden"], cfg["layers"], cfg["ffn"]
@@ -213,15 +247,20 @@ def bench_resnet50(batch=64, image_size=224, steps=10, warmup=3):
     main, startup, feeds, fetches = build_resnet_train_program(
         depth=50, class_dim=1000, image_size=image_size)
     loss = fetches[0]
-    exe = fluid.Executor()
-    scope = core.Scope()
     rng = np.random.RandomState(0)
-    img = rng.rand(batch, 3, image_size, image_size).astype("float32")
-    lbl = rng.randint(0, 1000, (batch, 1)).astype("int64")
-    feed = {"image": img, "label": lbl}
-    with fluid.scope_guard(scope):
-        exe.run(startup)
-        dt = _timed_steps(exe, main, feed, [loss], steps, warmup)
+
+    def run_once(b):
+        exe = fluid.Executor()
+        scope = core.Scope()
+        img = rng.rand(b, 3, image_size, image_size).astype("float32")
+        lbl = rng.randint(0, 1000, (b, 1)).astype("int64")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            return _timed_steps(exe, main, {"image": img, "label": lbl},
+                                [loss], steps, warmup)
+
+    batch, dt = _run_with_oom_ladder(
+        "resnet", (batch, batch // 2, batch // 4), run_once)
     sps = batch * steps / dt
     # ~3.8 GFLOPs fwd per 224x224 sample (scales ~quadratically with
     # resolution); x3 for fwd+bwd

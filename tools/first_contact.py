@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""One-command TPU first-contact plan (VERDICT r03 item 1).
+
+Runs the whole measurement sequence the moment a tunnel window opens,
+prioritized so a SHORT window still banks the headline number first:
+
+  1. flash_gate  — ONE flash config compile+parity (~1 min): validates
+                   the current kernel layout lowers under Mosaic before
+                   anything depends on it
+  2. bert        — bench.py bert (headline samples/s + MFU)
+  3. mfu_bert    — tools/mfu_report.py bert (XLA cost-analysis MFU)
+  4. flash_sweep — bench.py flash (resumable block sweep; banks rows)
+  5. resnet      — bench.py resnet
+  6. mnist       — bench.py mnist (host-overhead trend row)
+
+Every stage runs in a SUBPROCESS with its own timeout (a hung tunnel
+cannot take the plan down) and its one-line JSON result is appended to
+tools/first_contact_log.jsonl as it lands — a window that closes
+mid-plan keeps everything banked so far. Stages run in order regardless
+of earlier failures (a flash-gate failure skips only the sweep).
+
+Usage:  python tools/first_contact.py [--stages bert,mfu_bert,...]
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+LOG = os.path.join(HERE, "first_contact_log.jsonl")
+
+GATE_CODE = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from tools import flash_smoke
+row = flash_smoke.run_config(512, 128, 128)
+print("ROW=" + json.dumps(row))
+"""
+
+
+def bank(stage, payload):
+    rec = {"t": time.strftime("%Y-%m-%dT%H:%M:%S"), "stage": stage,
+           **payload}
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def run_stage(stage, argv, timeout, parse_prefix=None):
+    t0 = time.time()
+    try:
+        out = subprocess.run(argv, capture_output=True, text=True,
+                             timeout=timeout, cwd=REPO,
+                             env=os.environ.copy())
+    except subprocess.TimeoutExpired:
+        return bank(stage, {"ok": False, "error": f"timeout {timeout}s",
+                            "wall_s": round(time.time() - t0, 1)})
+    line = None
+    for ln in reversed(out.stdout.strip().splitlines() or []):
+        if parse_prefix and ln.startswith(parse_prefix):
+            line = ln[len(parse_prefix):]
+            break
+        if not parse_prefix and ln.startswith("{"):
+            line = ln
+            break
+    if out.returncode != 0 or line is None:
+        return bank(stage, {"ok": False, "rc": out.returncode,
+                            "stderr_tail": out.stderr.strip()[-400:],
+                            "wall_s": round(time.time() - t0, 1)})
+    try:
+        payload = json.loads(line)
+    except ValueError:
+        payload = {"raw": line[:400]}
+    # bench.py's contract prints a JSON line and exits 0 even on errors —
+    # an `error` payload is a FAILED stage, not a banked number
+    errored = isinstance(payload, dict) and (
+        payload.get("unit") == "error" or "error" in payload)
+    return bank(stage, {"ok": not errored,
+                        "wall_s": round(time.time() - t0, 1),
+                        "result": payload})
+
+
+def probe_alive(timeout=90):
+    """One bounded tunnel probe (bench.py's probe shape) — a dead tunnel
+    must cost ~80 s, not the first stage's full timeout."""
+    code = ("import jax; d = jax.devices()[0]; "
+            "jax.numpy.ones(4).sum().block_until_ready(); "
+            "print('PLATFORM=' + d.platform)")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout, env=os.environ.copy())
+        return any(ln.startswith("PLATFORM=") and "cpu" not in ln
+                   for ln in out.stdout.splitlines())
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def main():
+    stages = ["flash_gate", "bert", "mfu_bert", "flash_sweep", "resnet",
+              "mnist"]
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == "--stages" and i + 1 < len(argv):
+            stages = argv[i + 1].split(",")
+        elif a.startswith("--stages="):
+            stages = a.split("=", 1)[1].split(",")
+    if os.environ.get("FIRST_CONTACT_SKIP_PROBE") != "1" and \
+            not probe_alive():
+        bank("probe", {"ok": False,
+                       "error": "tunnel dead at launch (80s probe); "
+                                "set FIRST_CONTACT_SKIP_PROBE=1 to force"})
+        return 3
+    py = sys.executable
+    results = {}
+    consecutive_timeouts = 0
+    for s in stages:
+        if consecutive_timeouts >= 2:
+            bank(s, {"ok": False,
+                     "error": "skipped: 2 consecutive stage timeouts "
+                              "(tunnel window closed)"})
+            continue
+        if s == "flash_gate":
+            results[s] = run_stage(
+                s, [py, "-c", GATE_CODE.format(repo=REPO)], 600,
+                parse_prefix="ROW=")
+        elif s == "bert":
+            results[s] = run_stage(s, [py, "bench.py", "bert"], 1800)
+        elif s == "mfu_bert":
+            results[s] = run_stage(s, [py, "-m", "tools.mfu_report",
+                                       "bert"], 1800)
+        elif s == "flash_sweep":
+            gate = results.get("flash_gate")
+            if gate is not None and not gate.get("ok"):
+                bank(s, {"ok": False, "error": "skipped: flash_gate failed"})
+                continue
+            results[s] = run_stage(s, [py, "bench.py", "flash"], 2400)
+        elif s == "resnet":
+            results[s] = run_stage(s, [py, "bench.py", "resnet"], 1800)
+        elif s == "mnist":
+            results[s] = run_stage(s, [py, "bench.py", "mnist"], 900)
+        else:
+            bank(s, {"ok": False, "error": "unknown stage"})
+            continue
+        r = results.get(s)
+        if r is not None and not r.get("ok") \
+                and "timeout" in str(r.get("error", "")):
+            consecutive_timeouts += 1
+        elif r is not None and r.get("ok"):
+            consecutive_timeouts = 0
+    ok = sum(1 for r in results.values() if r.get("ok"))
+    print(f"first_contact: {ok}/{len(results)} stages ok — log {LOG}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
