@@ -354,6 +354,18 @@ class _CompiledBlock:
         if popt and mesh is not None and "pp" in mesh.axis_names:
             from .pipeline_lowering import build_plan
             self._pipeline_plan = build_plan(self, popt)
+        # RecomputeOptimizer checkpoints → jax.checkpoint segments
+        self._remat_plan = None
+        ropt = getattr(program, "_recompute_opt", None)
+        if ropt and self._pipeline_plan is None:
+            from .recompute_lowering import build_plan as build_remat
+            self._remat_plan = build_remat(self, ropt["checkpoints"])
+        elif ropt and self._pipeline_plan is not None:
+            import warnings as _warnings
+            _warnings.warn(
+                "program carries BOTH pipeline sections and recompute "
+                "checkpoints; the pipelined schedule runs and the "
+                "checkpoints are NOT rematerialized", stacklevel=2)
         self._jitted = jax.jit(self._step, donate_argnums=(0,))
         self._multi_jit: Dict[int, Any] = {}  # n_steps → scanned jit
 
@@ -367,6 +379,9 @@ class _CompiledBlock:
         if self._pipeline_plan is not None:
             from .pipeline_lowering import exec_plan
             exec_plan(self, self._pipeline_plan, env, lod_env, rng)
+        elif self._remat_plan is not None:
+            from .recompute_lowering import exec_plan as exec_remat
+            exec_remat(self, self._remat_plan, env, lod_env, rng)
         else:
             self._exec_ops(self.ops, env, lod_env, rng)
         fetches = []
@@ -799,24 +814,30 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           fetch_handler=None):
+                           fetch_handler=None, mesh=None):
         """One pass over a Dataset (reference: executor.py:1438
         train_from_dataset → C++ MultiTrainer/HogwildWorker threads,
         trainer.h:64). The TPU inversion: batches stream from the native
         C++ feed engine into the ONE jitted step — XLA pipelining replaces
-        the reference's per-thread op loops."""
+        the reference's per-thread op loops. ``mesh``: a device mesh for
+        the step; with a "pp" axis, a PipelineOptimizer-sectioned program
+        runs stage-parallel (the SectionWorker/PipelineTrainer role —
+        section_worker.cc:142 — via fluid/pipeline_lowering.py)."""
         return self._run_from_dataset(program, dataset, scope, fetch_list,
-                                      fetch_info, print_period, fetch_handler)
+                                      fetch_info, print_period,
+                                      fetch_handler, mesh=mesh)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           fetch_handler=None):
+                           fetch_handler=None, mesh=None):
         return self._run_from_dataset(program, dataset, scope, fetch_list,
-                                      fetch_info, print_period, fetch_handler)
+                                      fetch_info, print_period,
+                                      fetch_handler, mesh=mesh)
 
     def _run_from_dataset(self, program, dataset, scope, fetch_list,
-                          fetch_info, print_period, fetch_handler=None):
+                          fetch_info, print_period, fetch_handler=None,
+                          mesh=None):
         if dataset is None:
             raise ValueError("dataset must be provided")
         if program is None:
@@ -836,7 +857,7 @@ class Executor:
         try:
             for feed in dataset._iter_batches():
                 last = self.run(program, feed=feed, fetch_list=fetch_list,
-                                scope=scope)
+                                scope=scope, mesh=mesh)
                 if fetch_names and print_period and \
                         step % print_period == 0:
                     infos = fetch_info or fetch_names

@@ -673,10 +673,15 @@ class PipelineOptimizer:
 
 
 class RecomputeOptimizer(Optimizer):
-    """reference optimizer.py:3850 — rematerialization. On TPU this is
-    jax.checkpoint over segment boundaries; the static-graph path marks
-    checkpoint vars for the executor's segment-remat planner (pending);
-    meanwhile backward works without remat (more memory, same numerics)."""
+    """reference optimizer.py:3850 — rematerialization. The checkpoint
+    var names are recorded on the program (``_recompute_opt``) and the
+    compiled executor lowers the segments between them onto
+    ``jax.checkpoint`` + vjp span replacement
+    (fluid/recompute_lowering.py): activations inside a segment are
+    recomputed in the backward instead of stored, so only segment
+    boundaries stay live between forward and backward. Non-lowerable
+    shapes execute without remat (same numerics, more memory), with a
+    warning."""
 
     def __init__(self, optimizer):
         self._optimizer = optimizer
@@ -700,6 +705,10 @@ class RecomputeOptimizer(Optimizer):
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
         optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
+        if self._checkpoints:
+            names = [v.name if hasattr(v, "name") else str(v)
+                     for v in self._checkpoints]
+            loss.block.program._recompute_opt = {"checkpoints": names}
         return optimize_ops, params_grads
 
 

@@ -1,0 +1,282 @@
+"""Lower RecomputeOptimizer checkpoints onto jax.checkpoint segments.
+
+The reference rewrites the backward program to re-run forward subgraphs
+between user-chosen checkpoint variables so activations inside a segment
+are never stored (reference: python/paddle/fluid/optimizer.py
+RecomputeOptimizer:3850, backward.py _append_backward_ops_with_
+checkpoints_). A plain program-level rewrite would be undone by XLA's
+CSE (the recomputed subgraph is identical to the stored one), so the
+TPU lowering happens at trace level instead: each forward segment
+becomes ONE ``jax.checkpoint``-wrapped function (XLA keeps the
+rematerialization barrier), and the segment's backward ops are replaced
+by the ``jax.vjp`` of that wrapped function — only the segment-boundary
+values stay live between forward and backward.
+
+Lowering preconditions (else fused fallback with a warning — same
+numerics, more memory):
+  * checkpoints are produced in the main block, no control flow inside
+    a segment
+  * every external input of a segment (params, earlier activations)
+    receives its gradient ONLY from that segment's backward span, and
+    the spans are contiguous per segment in reverse order — shared
+    params across segments would fan-in through rename/sum ops the span
+    classifier cannot split
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .backward import grad_var_name
+
+
+class Segment:
+    def __init__(self):
+        self.ops = []
+        self.ins: List[str] = []     # external reads, in first-use order
+        self.outs: List[str] = []    # written AND read outside
+
+
+class RematPlan:
+    def __init__(self):
+        self.pre_ops = []            # ops before the first segment
+        self.segments: List[Segment] = []
+        self.rest_head = []          # loss head + its bwd (after last seg)
+        self.spans: List[List] = []  # per segment: replaced bwd ops
+        self.between: List[List] = []  # rest ops between spans (reverse)
+        self.span_order: List[int] = []  # segment index per span, in order
+        self.tail_ops = []           # pre-segment bwd + optimizer ops
+
+
+def _fallback(reason):
+    warnings.warn(
+        f"RecomputeOptimizer checkpoints not lowerable onto "
+        f"jax.checkpoint segments ({reason}); executing without "
+        f"rematerialization (same numerics, more memory)", stacklevel=3)
+    return None
+
+
+def build_plan(cb, ckpt_names) -> Optional[RematPlan]:
+    ops = cb.ops
+    producer = {}
+    for i, op in enumerate(ops):
+        for n in op.output_arg_names:
+            producer.setdefault(n, i)
+    missing = [c for c in ckpt_names if c not in producer]
+    if missing:
+        return _fallback(f"checkpoint vars {missing} not produced")
+    cks = sorted(set(ckpt_names), key=lambda c: producer[c])
+    # find where the forward ends: the loss-grad seed fill_constant is
+    # the first op whose outputs are all @GRAD names
+    fwd_end = len(ops)
+    for i, op in enumerate(ops):
+        outs = op.output_arg_names
+        if outs and all("@GRAD" in n for n in outs):
+            fwd_end = i
+            break
+    bounds = [producer[c] + 1 for c in cks]
+    if bounds[-1] > fwd_end:
+        return _fallback("a checkpoint is produced by a backward op")
+
+    plan = RematPlan()
+    # segments live BETWEEN checkpoints: the region up to the first
+    # checkpoint stays un-remat'ed (its inputs are the feeds; storing
+    # them is free), matching the reference's use of checkpoints as
+    # segment boundaries
+    plan.pre_ops = ops[:bounds[0]]
+    seg_ranges = [(bounds[i], bounds[i + 1])
+                  for i in range(len(bounds) - 1)]
+    if bounds[-1] < fwd_end:
+        seg_ranges.append((bounds[-1], fwd_end))
+    if not seg_ranges:
+        return _fallback("need at least one segment after a checkpoint")
+    rest = ops[fwd_end:]
+
+    from .executor import _op_needs_rng
+    # writeback names that must survive even if no forward op reads
+    # them: mutable state + persistable outputs (batch_norm running
+    # stats, counters) — a segment-local write would otherwise be
+    # silently dropped and the old value written back every step
+    writeback = set(cb.mut_state) | set(cb.extra_writeback)
+    fwd_reads: Dict[int, set] = {}
+    for i, op in enumerate(ops[:fwd_end]):
+        fwd_reads[i] = set(op.input_arg_names)
+    for lo, hi in seg_ranges:
+        seg = Segment()
+        seg.ops = ops[lo:hi]
+        if not seg.ops:
+            return _fallback("empty checkpoint segment")
+        for op in seg.ops:
+            if op.attrs.get("sub_block") is not None:
+                return _fallback("control flow inside a segment")
+            if _op_needs_rng(op.type):
+                # segment-local rng indices would collide across
+                # segments and diverge from the fused run's keys
+                return _fallback(
+                    f"rng op '{op.type}' inside a segment")
+        written = set()
+        for op in seg.ops:
+            for n in op.input_arg_names:
+                if n not in written and n not in seg.ins:
+                    seg.ins.append(n)
+            written.update(op.output_arg_names)
+        # outputs = the segment BOUNDARY: vars consumed by other FORWARD
+        # ops, fetched, or state/persistable writebacks. Backward reads
+        # of internals don't count — the segment's grad ops are replaced
+        # by the vjp, which recomputes those values (that IS the
+        # rematerialization); a non-replaced rest op reading an internal
+        # is checked at the end.
+        outside = set(cb.fetch_names) | writeback
+        for i in range(fwd_end):
+            if lo <= i < hi:
+                continue
+            outside |= fwd_reads[i]
+        seg.outs = [n for n in written if n in outside]
+        if not seg.outs:
+            return _fallback("segment writes nothing consumed outside")
+        plan.segments.append(seg)
+
+    # ---- classify the backward spans ------------------------------------
+    def grad_names_of(names):
+        g = set()
+        for v in names:
+            g.add(grad_var_name(v))
+        return g
+
+    span_sets = []
+    for seg in plan.segments:
+        written = set()
+        for op in seg.ops:
+            written.update(op.output_arg_names)
+        # a segment's span produces grads of its INTERNALS and INPUTS;
+        # its outputs' grads come from the CONSUMER segment's span (or
+        # the loss head), so they are not owned here
+        owned = (written - set(seg.outs)) | set(seg.ins)
+        span_sets.append(grad_names_of(owned))
+
+    grad_owner: Dict[str, int] = {}
+    for k, gset in enumerate(span_sets):
+        for g in gset:
+            if g in grad_owner and grad_owner[g] != k:
+                return _fallback(
+                    f"grad name '{g}' claimed by two segments")
+            grad_owner[g] = k
+
+    def owner_of(op):
+        hits = set()
+        for n in op.output_arg_names:
+            # fan-in renames look like '<primal>@GRAD@RENAME@...' —
+            # normalize to the base grad name for the dict lookup
+            base = n
+            i = n.find("@GRAD")
+            if i >= 0:
+                base = n[:i + 5]
+            k = grad_owner.get(base)
+            if k is not None:
+                hits.add(k)
+        return hits
+
+    idxs: Dict[int, List[int]] = {k: [] for k in range(len(plan.segments))}
+    for i, op in enumerate(rest):
+        hits = owner_of(op)
+        if len(hits) > 1:
+            return _fallback(
+                f"grad op '{op.type}' mixes segments {sorted(hits)} "
+                f"(shared params across segments)")
+        if hits:
+            idxs[hits.pop()].append(i)
+    live = [k for k in idxs if idxs[k]]
+    if not live:
+        return _fallback("no segment gradient ops found")
+    # spans must be contiguous and in reverse segment order
+    ordered = sorted(live, key=lambda k: idxs[k][0])
+    if ordered != sorted(live, reverse=True):
+        return _fallback("backward spans not in reverse segment order")
+    marks = []
+    for k in ordered:
+        lo, hi = min(idxs[k]), max(idxs[k])
+        if any(i not in idxs[k] for i in range(lo, hi + 1)):
+            return _fallback(f"segment {k} backward span not contiguous")
+        marks.append((k, lo, hi))
+    # a segment input's grad must come ONLY from its own span: every
+    # grad-of-input write outside the span falls back (fan-in)
+    plan.rest_head = rest[:marks[0][1]]
+    plan.spans = [None] * len(plan.segments)
+    plan.between = []
+    cur = None
+    for j, (k, lo, hi) in enumerate(marks):
+        plan.spans[k] = rest[lo:hi + 1]
+        nxt_lo = marks[j + 1][1] if j + 1 < len(marks) else None
+        seg_after = rest[hi + 1:nxt_lo] if nxt_lo is not None \
+            else rest[hi + 1:]
+        plan.between.append(seg_after)
+    plan.span_order = [k for k, _, _ in marks]
+    plan.tail_ops = plan.between.pop() if plan.between else []
+    # every rest op that SURVIVES (not in a replaced span) must not read
+    # a segment internal — those values are never materialized in env
+    internals = set()
+    for seg in plan.segments:
+        w = set()
+        for op in seg.ops:
+            w.update(op.output_arg_names)
+        internals |= (w - set(seg.outs))
+    replaced = {id(op) for span in plan.spans if span for op in span}
+    for op in rest:
+        if id(op) in replaced:
+            continue
+        bad = internals & set(op.input_arg_names)
+        if bad:
+            return _fallback(
+                f"op '{op.type}' outside the replaced spans reads "
+                f"segment internals {sorted(bad)[:3]}")
+    return plan
+
+
+def exec_plan(cb, plan: RematPlan, env: Dict[str, Any], lod_env, rng):
+    """One rematerialized step into ``env`` (called inside jit)."""
+    cb._exec_ops(plan.pre_ops, env, lod_env, rng)
+
+    vjps = []
+    for seg in plan.segments:
+        ins = [env[n] for n in seg.ins]
+
+        def seg_fn(vals, _seg=seg):
+            e = {n: v for n, v in zip(_seg.ins, vals)}
+            cb._exec_ops(_seg.ops, e, dict(lod_env), rng)
+            return tuple(e[n] for n in _seg.outs)
+
+        wrapped = jax.checkpoint(seg_fn)
+        outs, vjp_fn = jax.vjp(wrapped, ins)
+        for n, v in zip(seg.outs, outs):
+            env[n] = v
+        vjps.append(vjp_fn)
+
+    cb._exec_ops(plan.rest_head, env, lod_env, rng)
+
+    import numpy as _np
+    for j, k in enumerate(plan.span_order):
+        seg = plan.segments[k]
+        cots = []
+        for n in seg.outs:
+            out_val = env[n]
+            if not jnp.issubdtype(out_val.dtype, jnp.inexact):
+                # integer/bool boundary: vjp wants a float0 tangent
+                cots.append(_np.zeros(out_val.shape, jax.dtypes.float0))
+                continue
+            g = env.get(grad_var_name(n))
+            if g is None:
+                cots.append(jnp.zeros_like(out_val))
+            else:
+                cots.append(g.astype(out_val.dtype)
+                            if g.dtype != out_val.dtype else g)
+        (d_ins,) = vjps[k](tuple(cots))
+        for n, g in zip(seg.ins, d_ins):
+            if g is not None:
+                env[grad_var_name(n)] = g
+        after = plan.between[j] if j < len(plan.between) else []
+        cb._exec_ops(after, env, lod_env, rng)
+
+    cb._exec_ops(plan.tail_ops, env, lod_env, rng)
