@@ -191,8 +191,12 @@ def bench_bert_base(batch=256, seq_len=128, steps=20, warmup=5):
     if smoke:  # CPU fallback: prove the path, not the number
         cfg.update(layers=2, hidden=256, heads=4, ffn=1024)
         batch, seq_len, steps, warmup = 8, 64, 3, 1
+    # PADDLE_TPU_BENCH_RECOMPUTE=1: per-layer activation remat — if the
+    # default batch OOMs, this usually buys it back for ~1/3 extra FLOPs
+    # (often a better MFU trade than halving the batch)
+    recompute = os.environ.get("PADDLE_TPU_BENCH_RECOMPUTE") == "1"
     main, startup, feeds, fetches = bert.build_bert_pretrain_program(
-        cfg, seq_len=seq_len, dropout=0.0, lr=1e-4)
+        cfg, seq_len=seq_len, dropout=0.0, lr=1e-4, recompute=recompute)
     rng = np.random.RandomState(0)
 
     def feed_of(b):

@@ -96,6 +96,20 @@ def build_plan(cb, ckpt_names) -> Optional[RematPlan]:
     rest = ops[fwd_end:]
 
     from .executor import _op_needs_rng
+
+    def _op_uses_rng(op):
+        """rng-REGISTERED is not rng-USING: an attention/dropout op with
+        rate 0 (or is_test) draws nothing, so remat replay is exact."""
+        if not _op_needs_rng(op.type):
+            return False
+        if op.attrs.get("is_test"):
+            return False
+        rate_keys = [k for k in op.attrs
+                     if k in ("dropout_rate", "dropout_prob")]
+        if rate_keys:
+            return max(float(op.attrs[k] or 0.0) for k in rate_keys) > 0.0
+        return True  # unconditional generator (uniform_random, ...)
+
     # writeback names that must survive even if no forward op reads
     # them: mutable state + persistable outputs (batch_norm running
     # stats, counters) — a segment-local write would otherwise be
@@ -112,7 +126,7 @@ def build_plan(cb, ckpt_names) -> Optional[RematPlan]:
         for op in seg.ops:
             if op.attrs.get("sub_block") is not None:
                 return _fallback("control flow inside a segment")
-            if _op_needs_rng(op.type):
+            if _op_uses_rng(op):
                 # segment-local rng indices would collide across
                 # segments and diverge from the fused run's keys
                 return _fallback(

@@ -105,3 +105,49 @@ def test_fused_attention_op_grad():
     q2 = q.at[0, 1, 2].add(eps)
     num = (f(q2) - f(q)) / eps
     assert abs(float(g[0, 1, 2]) - float(num)) < 1e-2
+
+
+def test_bert_recompute_checkpoints_engage_and_match():
+    """build_bert_pretrain_program(recompute=True): per-layer remat
+    engages (no fallback warning, plan present) and per-step losses
+    match the plain build exactly."""
+    import warnings as _w
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.models import bert
+
+    cfg = bert.bert_base_config()
+    cfg.update(layers=3, hidden=64, heads=4, ffn=128)
+    rng = np.random.RandomState(0)
+    feed = {
+        "src_ids": rng.randint(0, cfg["vocab_size"],
+                               (2, 16)).astype("int64"),
+        "pos_ids": np.tile(np.arange(16), (2, 1)).astype("int64"),
+        "sent_ids": np.zeros((2, 16), "int64"),
+        "mask_pos": rng.randint(0, 32, (4, 1)).astype("int64"),
+        "mask_label": rng.randint(0, cfg["vocab_size"],
+                                  (4, 1)).astype("int64"),
+    }
+    out = {}
+    for recompute in (False, True):
+        main, startup, feeds, fetches = bert.build_bert_pretrain_program(
+            cfg, seq_len=16, dropout=0.0, lr=1e-3, recompute=recompute)
+        main.random_seed = startup.random_seed = 3
+        exe = fluid.Executor()
+        scope = core.Scope()
+        ctx = _w.catch_warnings()
+        with ctx:
+            if recompute:
+                _w.simplefilter("error")  # fallback warning = failure
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                ls = []
+                for _ in range(3):
+                    (l,) = exe.run(main, feed=feed, fetch_list=fetches)
+                    ls.append(float(np.asarray(l).ravel()[0]))
+        if recompute:
+            cb = list(exe._compiled_cache.values())[-1]
+            assert cb._remat_plan is not None
+        out[recompute] = ls
+    np.testing.assert_allclose(out[True], out[False], rtol=2e-5)
