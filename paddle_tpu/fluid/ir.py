@@ -527,6 +527,84 @@ class FcLstmFusePass(_FcRecurrentFuseBase):
                    "cell_activation", "candidate_activation")
 
 
+@register_pass("seqconv_eltadd_relu_fuse_pass")
+class SeqconvEltaddReluFusePass(Pass):
+    """sequence_conv + elementwise_add(bias) + relu ->
+    fusion_seqconv_eltadd_relu (ir/seqconv_eltadd_relu_fuse_pass.cc) —
+    the reference's fused CTR text-conv inference block."""
+
+    def apply_impl(self, graph):
+        pat = OpPattern([
+            ("sequence_conv", {"X": "$x", "Filter": "$f"}, {"Out": "$c"}),
+            ("elementwise_add", {"X": "$c", "Y": "$b"}, {"Out": "$cb"}),
+            ("relu", {"X": "$cb"}, {"Out": "$out"}),
+        ])
+        for m in pat.match(graph):
+            conv = m["#0"]
+            bias = graph.block._find_var_recursive(m["$b"])
+            if bias is None or not getattr(bias, "persistable", False):
+                continue
+            graph.fuse(list(m["#ops"]), "fusion_seqconv_eltadd_relu",
+                       {"X": [m["$x"]], "Filter": [m["$f"]],
+                        "Bias": [m["$b"]]},
+                       {"Out": [m["$out"]]},
+                       {k: conv.attr(k) for k in
+                        ("contextLength", "contextStart", "contextStride")
+                        if conv.attr(k) is not None})
+        return graph
+
+
+@register_pass("seqpool_concat_fuse_pass")
+class SeqpoolConcatFusePass(Pass):
+    """N sequence_pool (same SUM/AVERAGE pooltype) feeding one concat ->
+    fusion_seqpool_concat (ir/seqpool_concat_fuse_pass.cc). Hand-rolled
+    matching: the shape is a FAN-IN (N parallel producers into one
+    consumer), which the chain-based OpPattern doesn't express."""
+
+    def apply_impl(self, graph):
+        for concat in [op for op in graph.block.ops
+                       if op.type == "concat"]:
+            xs = list(concat.input("X"))
+            if len(xs) < 2:
+                continue
+            axis = concat.attr("axis")
+            if axis is None or int(axis) != 1:
+                continue  # the fused kernel concats pooled FEATURES
+            if concat.input("AxisTensor"):
+                continue  # runtime axis can't fold into a static attr
+            pools = []
+            ptype = None
+            ok = True
+            for n in xs:
+                prods = [op for op in graph.block.ops
+                         if n in op.output("Out")
+                         and op.type == "sequence_pool"]
+                if len(prods) != 1 or not graph.is_internal(n) \
+                        or len(graph.var_consumers(n)) != 1:
+                    ok = False
+                    break
+                p = prods[0]
+                pt = (p.attr("pooltype") or "SUM").upper()
+                if pt not in ("SUM", "AVERAGE") or \
+                        (ptype is not None and pt != ptype):
+                    ok = False
+                    break
+                if float(p.attr("pad_value") or 0.0) != 0.0:
+                    # empty sequences pool to pad_value; the fused
+                    # kernel has no pad_value leg
+                    ok = False
+                    break
+                ptype = pt
+                pools.append(p)
+            if not ok or not pools:
+                continue
+            graph.fuse(pools + [concat], "fusion_seqpool_concat",
+                       {"X": [p.input("X")[0] for p in pools]},
+                       {"Out": [concat.output("Out")[0]]},
+                       {"pooltype": ptype, "axis": 1})
+        return graph
+
+
 @register_pass("fuse_elewise_add_act_pass")
 class FuseElewiseAddActPass(Pass):
     """elementwise_add + {relu,tanh,sigmoid,scale} ->
@@ -1049,8 +1127,6 @@ for _n, _note in {
     "squared_mat_sub_fuse_pass": "XLA fuses the expression",
     "repeated_fc_relu_fuse_pass": "XLA fuses chained fc+relu",
     "seq_concat_fc_fuse_pass": "XLA fuses",
-    "seqconv_eltadd_relu_fuse_pass": "XLA fuses",
-    "seqpool_concat_fuse_pass": "XLA fuses",
     "seqpool_cvm_concat_fuse_pass": "XLA fuses",
     "transpose_flatten_concat_fuse_pass": "XLA fuses",
     "shuffle_channel_detect_pass": "XLA fuses",

@@ -749,3 +749,83 @@ def test_fc_gru_fuse_skips_biased_projection():
     main, scope, out = _fresh(build)
     PassManager(["fc_gru_fuse_pass"], scope).apply(main)
     assert "dynamic_gru" in _op_types(main)
+
+
+def test_seqconv_eltadd_relu_fuse_pass_numeric():
+    def build():
+        x = fluid.layers.data("x", shape=[4], dtype="float32", lod_level=1)
+        return fluid.layers.sequence_conv(x, 6, filter_size=3,
+                                          bias_attr=True, act="relu")
+
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(2)
+    feed = {"x": _lod_x(rng)}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["seqconv_eltadd_relu_fuse_pass"], scope).apply(main)
+    types = _op_types(main)
+    assert "fusion_seqconv_eltadd_relu" in types \
+        and "sequence_conv" not in types and "relu" not in types, types
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_seqpool_concat_fuse_pass_numeric():
+    def build():
+        a = fluid.layers.data("a", shape=[4], dtype="float32", lod_level=1)
+        b = fluid.layers.data("b", shape=[4], dtype="float32", lod_level=1)
+        pa = fluid.layers.sequence_pool(a, "sum")
+        pb = fluid.layers.sequence_pool(b, "sum")
+        return fluid.layers.concat([pa, pb], axis=1)
+
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(3)
+    feed = {"a": _lod_x(rng), "b": _lod_x(rng)}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["seqpool_concat_fuse_pass"], scope).apply(main)
+    types = _op_types(main)
+    assert "fusion_seqpool_concat" in types \
+        and "sequence_pool" not in types and "concat" not in types, types
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_seqpool_concat_fuse_skips_axis0_and_pad_value():
+    """Confirmed review repros: axis=0 concats and pad_value pools must
+    NOT fuse (the fused kernel concats features on axis 1 and has no
+    pad_value leg)."""
+    def build_axis0():
+        a = fluid.layers.data("a", shape=[4], dtype="float32", lod_level=1)
+        b = fluid.layers.data("b", shape=[4], dtype="float32", lod_level=1)
+        return fluid.layers.concat([fluid.layers.sequence_pool(a, "sum"),
+                                    fluid.layers.sequence_pool(b, "sum")],
+                                   axis=0)
+
+    main, scope, out = _fresh(build_axis0)
+    rng = np.random.RandomState(4)
+    feed = {"a": _lod_x(rng), "b": _lod_x(rng)}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["seqpool_concat_fuse_pass"], scope).apply(main)
+    assert "sequence_pool" in _op_types(main)  # not fused
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after))
+
+    def build_pad():
+        a = fluid.layers.data("a", shape=[4], dtype="float32", lod_level=1)
+        b = fluid.layers.data("b", shape=[4], dtype="float32", lod_level=1)
+        pa = fluid.layers.sequence_pool(a, "sum", pad_value=7.0)
+        pb = fluid.layers.sequence_pool(b, "sum", pad_value=7.0)
+        return fluid.layers.concat([pa, pb], axis=1)
+
+    main, scope, out = _fresh(build_pad)
+    feed = {"a": core.LoDTensor(rng.rand(5, 4).astype("float32"),
+                                lod=[[0, 3, 3, 5]]),  # one EMPTY seq
+            "b": core.LoDTensor(rng.rand(5, 4).astype("float32"),
+                                lod=[[0, 2, 4, 5]])}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["seqpool_concat_fuse_pass"], scope).apply(main)
+    assert "sequence_pool" in _op_types(main)  # not fused
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after))
+    assert np.any(np.asarray(before) == 7.0)  # the empty seq padded
