@@ -45,6 +45,18 @@ def enable_compile_cache(cache_dir: str):
     # which is exactly the cold-start this exists to remove
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # LRU-bound the directory: programs change every commit and orphaned
+    # HLO-keyed entries would otherwise accumulate forever
+    try:
+        jax.config.update("jax_compilation_cache_max_size",
+                          4 * 1024 * 1024 * 1024)
+    except Exception:
+        pass  # older jax: no eviction knob
+    # env too, so SUBPROCESS workers (multi-process benches/predictor
+    # pools, the backend probe) inherit the cache
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+    os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "-1"
     _COMPILE_CACHE_DIR = cache_dir
 
 
