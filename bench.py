@@ -520,6 +520,12 @@ def bench_flash():
                 f.write(s + "\n")
 
     rows = flash_smoke.sweep(on_tpu=on_tpu, emit=emit, done=done)
+    if on_tpu:
+        # bank the measured-best blocks so later kernel calls use them
+        flash_smoke.write_tuning(
+            prior + rows,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools", "flash_blocks.json"))
     return flash_smoke.summarize(prior + rows, backend)
 
 

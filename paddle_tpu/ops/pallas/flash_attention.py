@@ -554,17 +554,52 @@ def block_override(blk_q, blk_k):
         _BLOCK_OVERRIDE = prev
 
 
-def _block_sizes(S, Sk):
+_TUNED = None  # lazy: (seq_len, head_dim) -> (blk_q, blk_k) from sweep
+
+
+def _tuned_blocks(S, D):
+    """Best measured (blk_q, blk_k) for the nearest swept seq length at
+    the SAME head_dim — the hardware sweep (tools/flash_smoke.py) banks
+    its fastest config per (seq, head_dim) bucket, fingerprint-stamped
+    so a kernel edit invalidates it. Returns None (defaults apply) when
+    no valid table exists, the fingerprint mismatches, or no entry
+    matches this head_dim (blocks tuned at another D could blow the
+    VMEM budget here)."""
+    global _TUNED
+    if _TUNED is None:
+        import json
+        table = {}
+        try:
+            from tools.flash_smoke import kernel_fingerprint, tuning_path
+            data = json.load(open(tuning_path()))
+            if data.get("kfp") == kernel_fingerprint():
+                for k, v in (data.get("entries") or {}).items():
+                    s, d = k.split(":")
+                    table[(int(s), int(d))] = (int(v[0]), int(v[1]))
+        except Exception:
+            pass  # no table / stale / not importable: defaults apply
+        _TUNED = table
+    cands = [sd for sd in _TUNED if sd[1] == D]
+    if not cands:
+        return None
+    nearest = min(cands, key=lambda sd: abs(sd[0] - S))
+    return _TUNED[nearest]
+
+
+def _block_sizes(S, Sk, D=64):
     """Ragged S/Sk are supported via in-kernel bounds masking, so blocks
     need not divide the lengths. Inputs smaller than the default block
     use the EXACT dimension as the block — a block equal to the array
     dim is always Mosaic-legal regardless of (8, 128) alignment, so tiny
-    and tiny-ragged shapes lower without padding games."""
+    and tiny-ragged shapes lower without padding games. A banked
+    hardware sweep overrides the defaults (see _tuned_blocks)."""
     if _BLOCK_OVERRIDE is not None:
         bq, bk = _BLOCK_OVERRIDE
         return (S if S <= bq else bq), (Sk if Sk <= bk else bk)
-    blk_q = S if S <= DEFAULT_BLOCK_Q else DEFAULT_BLOCK_Q
-    blk_k = Sk if Sk <= DEFAULT_BLOCK_K else DEFAULT_BLOCK_K
+    tuned = _tuned_blocks(max(S, Sk), D)
+    dq, dk = tuned if tuned else (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    blk_q = S if S <= dq else dq
+    blk_k = Sk if Sk <= dk else dk
     return blk_q, blk_k
 
 
@@ -578,14 +613,14 @@ def _pallas_ok(q, k):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def _flash_pallas(q, k, v, seed, bias, sm_scale, causal, dropout_rate):
-    blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2])
+    blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2], q.shape[3])
     o, _ = _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
                        dropout_rate, bias=bias)
     return o
 
 
 def _fp_fwd(q, k, v, seed, bias, sm_scale, causal, dropout_rate):
-    blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2])
+    blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2], q.shape[3])
     o, lse = _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
                          dropout_rate, bias=bias)
     # residual: the 2-D row stat, not the 128-lane wire form (128× less
@@ -595,7 +630,7 @@ def _fp_fwd(q, k, v, seed, bias, sm_scale, causal, dropout_rate):
 
 def _fp_bwd(sm_scale, causal, dropout_rate, res, g):
     q, k, v, o, lse, seed, bias = res
-    blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2])
+    blk_q, blk_k = _block_sizes(q.shape[2], k.shape[2], q.shape[3])
     dq, dk, dv = _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal,
                              blk_q, blk_k, dropout_rate, bias=bias)
     dseed = np.zeros(seed.shape, jax.dtypes.float0)  # int arg: zero tangent

@@ -80,3 +80,42 @@ def test_vmem_estimate_monotone_in_blocks():
     a = flash_smoke._vmem_kb_estimate(128, 128, 64, bwd=True)
     b = flash_smoke._vmem_kb_estimate(512, 512, 64, bwd=True)
     assert b > a > 0
+
+
+def test_write_tuning_and_tuned_blocks(tmp_path):
+    """The sweep banks best (blk_q, blk_k) per seq len; the kernel's
+    block chooser picks the nearest bucket once the file exists."""
+    import json
+    from tools import flash_smoke
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    rows = [
+        {"seq_len": 512, "blk_q": 128, "blk_k": 128, "fwdbwd_ms": 5.0,
+         "head_dim": 64, "status": "ok", "causal": False, "dropout": 0.0},
+        {"seq_len": 512, "blk_q": 256, "blk_k": 128, "fwdbwd_ms": 3.0,
+         "head_dim": 64, "status": "ok", "causal": False, "dropout": 0.0},
+        {"seq_len": 512, "blk_q": 512, "blk_k": 512, "fwdbwd_ms": 1.0,
+         "head_dim": 64, "status": "ok", "causal": True,
+         "dropout": 0.0},  # causal: skip
+        {"seq_len": 2048, "blk_q": 512, "blk_k": 256, "fwdbwd_ms": 9.0,
+         "head_dim": 64, "status": "ok", "causal": False, "dropout": 0.0},
+    ]
+    path = tmp_path / "flash_blocks.json"
+    assert flash_smoke.write_tuning(rows, str(path))
+    table = json.load(open(path))
+    assert table["kfp"] == flash_smoke.kernel_fingerprint()
+    assert table["entries"]["512:64"] == [256, 128]
+    assert table["entries"]["2048:64"] == [512, 256]
+    assert fa._TUNED is None  # cache invalidated by write_tuning
+
+    old = fa._TUNED
+    try:
+        fa._TUNED = {(int(k.split(":")[0]), int(k.split(":")[1])):
+                     tuple(v) for k, v in table["entries"].items()}
+        assert fa._block_sizes(512, 512, 64) == (256, 128)
+        assert fa._block_sizes(1900, 1900, 64) == (512, 256)  # nearest
+        assert fa._block_sizes(64, 64, 64) == (64, 64)  # small: exact
+        # DIFFERENT head_dim: tuned entries must not apply
+        assert fa._block_sizes(512, 512, 256) == (128, 128)
+    finally:
+        fa._TUNED = old

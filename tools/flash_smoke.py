@@ -235,6 +235,48 @@ def sweep(on_tpu, emit=print, done=frozenset()):
     return rows
 
 
+def tuning_path():
+    """The ONE location of the banked block-tuning table — the kernel's
+    `_tuned_blocks` and `write_tuning` both resolve it here, so they
+    cannot silently diverge."""
+    import os
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "flash_blocks.json")
+
+
+def write_tuning(rows, path=None):
+    """Bank the fastest (blk_q, blk_k) per (seq_len, head_dim)
+    (training criterion: fwd+bwd ms; clean non-causal/no-dropout/
+    non-ragged rows only), stamped with the kernel fingerprint so a
+    kernel edit invalidates the table like it invalidates the row bank.
+    `flash_attention._tuned_blocks` picks these up, so every kernel call
+    after a hardware sweep runs the measured-best blocks."""
+    best = {}
+    for r in rows:
+        if r.get("status") != "ok" or r.get("causal") \
+                or r.get("dropout") or r.get("ragged"):
+            continue
+        if "fwdbwd_ms" not in r:
+            continue
+        key = (int(r["seq_len"]), int(r.get("head_dim", 64)))
+        cur = best.get(key)
+        if cur is None or r["fwdbwd_ms"] < cur["fwdbwd_ms"]:
+            best[key] = r
+    if not best:
+        return False
+    path = path or tuning_path()
+    with open(path, "w") as f:
+        json.dump({"kfp": kernel_fingerprint(),
+                   "entries": {f"{s}:{d}": [int(r["blk_q"]),
+                                            int(r["blk_k"])]
+                               for (s, d), r in sorted(best.items())}},
+                  f, indent=1)
+    # the kernel's lazy cache may hold the pre-file (empty) table
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    fa._TUNED = None
+    return True
+
+
 def summarize(rows, backend):
     ok = [r for r in rows if r.get("status") == "ok"]
     fails = [r for r in rows if r.get("status") in ("parity_fail",
