@@ -527,6 +527,75 @@ class FcLstmFusePass(_FcRecurrentFuseBase):
                    "cell_activation", "candidate_activation")
 
 
+@register_pass("seq_concat_fc_fuse_pass")
+class SeqConcatFcFusePass(Pass):
+    """sequence_expand(x_i, ref) fan-in + concat(axis=1) + fc ->
+    fusion_seqexpand_concat_fc (ir/seq_concat_fc_fuse_pass.cc) — the
+    reference's fused attention-input block: per-sequence rows broadcast
+    to each timestep of the reference sequence, concatenated, projected
+    through one fc."""
+
+    _ACTS = {"relu", "tanh", "sigmoid"}
+
+    def apply_impl(self, graph):
+        for concat in [op for op in graph.block.ops
+                       if op.type == "concat"]:
+            xs = list(concat.input("X"))
+            if len(xs) < 2 or concat.input("AxisTensor"):
+                continue
+            if int(concat.attr("axis") or 0) != 1:
+                continue
+            ref = xs[0]  # the LoD sequence every expand broadcasts to
+            expands, raw = [], []
+            ok = True
+            for n in xs[1:]:
+                prods = [op for op in graph.block.ops
+                         if n in op.output("Out")
+                         and op.type == "sequence_expand"]
+                if len(prods) != 1 or not graph.is_internal(n) \
+                        or len(graph.var_consumers(n)) != 1 \
+                        or prods[0].input("Y") != [ref]:
+                    ok = False
+                    break
+                expands.append(prods[0])
+                raw.append(prods[0].input("X")[0])
+            cat_out = concat.output("Out")[0]
+            consumers = graph.var_consumers(cat_out)
+            if not ok or not expands or len(consumers) != 1 \
+                    or consumers[0].type != "mul" \
+                    or not graph.is_internal(cat_out):
+                continue
+            mul = consumers[0]
+            if int(mul.attr("x_num_col_dims") or 1) != 1:
+                continue
+            matched = expands + [concat, mul]
+            out_name = mul.output("Out")[0]
+            bias = None
+            act = "identity"
+            nxt = graph.var_consumers(out_name)
+            if len(nxt) == 1 and nxt[0].type == "elementwise_add" \
+                    and graph.is_internal(out_name):
+                bv = graph.block._find_var_recursive(nxt[0].input("Y")[0])
+                if bv is not None and getattr(bv, "persistable", False):
+                    bias = nxt[0].input("Y")[0]
+                    matched.append(nxt[0])
+                    out_name = nxt[0].output("Out")[0]
+                    after = graph.var_consumers(out_name)
+                    if len(after) == 1 and after[0].type in self._ACTS \
+                            and graph.is_internal(out_name):
+                        act = after[0].type
+                        matched.append(after[0])
+                        out_name = after[0].output("Out")[0]
+            inputs = {"X": [ref] + raw,
+                      "FCWeight": [mul.input("Y")[0]]}
+            if bias is not None:
+                inputs["FCBias"] = [bias]
+            graph.fuse(matched, "fusion_seqexpand_concat_fc",
+                       inputs, {"Out": [out_name]},
+                       {"fc_activation": act})
+        return graph
+
+
 @register_pass("seqconv_eltadd_relu_fuse_pass")
 class SeqconvEltaddReluFusePass(Pass):
     """sequence_conv + elementwise_add(bias) + relu ->
@@ -1126,7 +1195,6 @@ for _n, _note in {
     "fuse_relu_depthwise_conv_pass": "XLA fuses relu into conv",
     "squared_mat_sub_fuse_pass": "XLA fuses the expression",
     "repeated_fc_relu_fuse_pass": "XLA fuses chained fc+relu",
-    "seq_concat_fc_fuse_pass": "XLA fuses",
     "seqpool_cvm_concat_fuse_pass": "XLA fuses",
     "transpose_flatten_concat_fuse_pass": "XLA fuses",
     "shuffle_channel_detect_pass": "XLA fuses",

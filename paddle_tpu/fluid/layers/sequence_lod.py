@@ -38,6 +38,11 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
 def _simple(op_type, x, out_slot="Out", extra_inputs=None, **attrs):
     helper = LayerHelper(op_type)
     out = helper.create_variable_for_type_inference(x.dtype)
+    # sequence ops keep the feature dims; the row count is LoD-dynamic.
+    # Without this, downstream builders (concat width -> fc weight
+    # shapes) silently see () and create wrong parameters.
+    if x.shape:
+        out.shape = (-1,) + tuple(x.shape[1:])
     inputs = {"X": [x]}
     if extra_inputs:
         inputs.update(extra_inputs)

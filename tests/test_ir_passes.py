@@ -829,3 +829,31 @@ def test_seqpool_concat_fuse_skips_axis0_and_pad_value():
     after = _run(main, scope, feed, [out.name])[0]
     np.testing.assert_allclose(np.asarray(before), np.asarray(after))
     assert np.any(np.asarray(before) == 7.0)  # the empty seq padded
+
+
+def test_seq_concat_fc_fuse_pass_numeric():
+    """sequence_expand fan-in + concat + fc(relu) fuses into
+    fusion_seqexpand_concat_fc and matches unfused numerics."""
+    def build():
+        seq = fluid.layers.data("seq", shape=[4], dtype="float32",
+                                lod_level=1)
+        d1 = fluid.layers.data("d1", shape=[3], dtype="float32")
+        d2 = fluid.layers.data("d2", shape=[2], dtype="float32")
+        e1 = fluid.layers.sequence_expand(d1, seq, ref_level=0)
+        e2 = fluid.layers.sequence_expand(d2, seq, ref_level=0)
+        cat = fluid.layers.concat([seq, e1, e2], axis=1)
+        return fluid.layers.fc(cat, 5, act="relu")
+
+    main, scope, out = _fresh(build)
+    rng = np.random.RandomState(5)
+    feed = {"seq": _lod_x(rng),  # lod [[0, 3, 7]] -> 2 sequences
+            "d1": rng.rand(2, 3).astype("float32"),
+            "d2": rng.rand(2, 2).astype("float32")}
+    before = _run(main, scope, feed, [out.name])[0]
+    PassManager(["seq_concat_fc_fuse_pass"], scope).apply(main)
+    types = _op_types(main)
+    assert "fusion_seqexpand_concat_fc" in types, types
+    assert "sequence_expand" not in types and "concat" not in types, types
+    after = _run(main, scope, feed, [out.name])[0]
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                               rtol=1e-5, atol=1e-6)
