@@ -4,24 +4,35 @@ GPipe schedule.
 The reference executes sectioned programs through a thread/queue runtime
 (reference: python/paddle/fluid/optimizer.py:3550 PipelineOptimizer,
 paddle/fluid/framework/section_worker.cc:142, pipeline_trainer.cc:24).
-The TPU inversion compiles the schedule instead: the homogeneous interior
-sections become ONE `parallel.pipeline.gpipe` call (shard_map over the
-"pp" mesh axis, lax.ppermute stage handoff) embedded in the executor's
-single jitted step, and the interior's backward ops are replaced by the
-`jax.vjp` of that call — the ppermute transposes run the reverse
-pipeline. Pre ops (up to the first cut), post/loss/optimizer ops and
-every non-interior gradient still execute on the normal traced path, so
-feeds, state donation, fetches and the optimizer all work unchanged.
+The TPU inversion compiles the schedule instead: the interior sections
+become ONE `parallel.pipeline` call (shard_map over the "pp" mesh axis,
+lax.ppermute stage handoff) embedded in the executor's single jitted
+step, and the interior's backward ops are replaced by the `jax.vjp` of
+that call — the ppermute transposes run the reverse pipeline. Pre ops
+(up to the first cut), post/loss/optimizer ops and every non-interior
+gradient still execute on the normal traced path, so feeds, state
+donation, fetches and the optimizer all work unchanged.
 
-Lowering preconditions (checked by `build_plan`; anything else falls
-back to the fused path with a warning — numerically identical, just not
-stage-parallel):
+Two schedules, tried in order (`build_plan`):
+  * homogeneous — sections share one op template; stage params STACK
+    with a leading stage dim sharded over "pp" (`parallel.pipeline.gpipe`).
+    Work- and memory-optimal.
+  * heterogeneous — arbitrary per-stage bodies and activation shapes
+    (`parallel.pipeline.gpipe_het`, lax.switch over the stage index on a
+    flat max-size ring buffer — the compiled equivalent of the
+    reference's SectionWorker running arbitrary sections,
+    section_worker.cc:142). Params are replicated; per-device compute is
+    still one stage per tick. Tied (stage-shared) trainable params ride
+    this path too: each owning stage contributes a grad and they sum.
+
+Common preconditions (anything else falls back to the fused path with a
+warning — numerically identical, just not stage-parallel):
   * mesh has a "pp" axis whose size == number of interior sections
-  * interior sections are homogeneous: same op types/attrs positionally,
-    stage-varying inputs have matching shapes (params stack)
   * interior ops are batch-row-independent (no batch_norm/data_norm),
     rng-free (dropout inside a stage would draw per-stage masks the
     fused oracle can't mirror), and sub-block-free
+  * no cross-stage reads of interior activations (skip connections
+    across cut boundaries don't fit a 1-activation ring)
   * the microbatch count divides the feed batch
 """
 from __future__ import annotations
@@ -41,18 +52,24 @@ _BATCH_MIXING = {"batch_norm", "sync_batch_norm", "data_norm"}
 
 class PipelinePlan:
     def __init__(self):
+        self.het = False            # heterogeneous schedule?
         self.pre_ops = []           # ops up to and incl. the c0 producer
-        self.template_ops = []      # section-1 ops (the stage body)
+        self.template_ops = []      # homog: section-1 ops (the stage body)
         self.post_ops = []          # post fwd + loss + post bwd
         self.tail_ops = []          # pre bwd + optimizer updates
         self.n_stages = 0
         self.n_micro = 1
         self.c0 = None              # activation entering the interior
         self.c_last = None          # activation leaving the interior
-        self.template_out = None    # template name of the stage output
-        self.closure_names = []     # externals shared by every stage
-        self.param_template = []    # template name per stacked position
-        self.param_stage_names = []  # per position: [stage0.., stageN-1..]
+        self.template_out = None    # homog: template name of stage output
+        self.closure_names = []     # homog: externals shared by every stage
+        self.param_template = []    # homog: template name per stacked pos
+        self.param_stage_names = []  # homog per position: [stage0..]
+        # het fields
+        self.sections = []          # het: per-stage op lists
+        self.cut_vars = []          # het: sorted cut vars (len n_stages+1)
+        self.sec_param_names = []   # het: per stage, differentiable externals
+        self.sec_closure = []       # het: per stage, closure externals
 
 
 def _op_signature(op):
@@ -67,6 +84,193 @@ def _fallback(reason):
         f"schedule ({reason}); executing fused (numerically identical, "
         f"not stage-parallel)", stacklevel=3)
     return None
+
+
+def _section_externals(sec):
+    """Names a section reads before writing, in first-use order."""
+    written: set = set()
+    externals: List[str] = []
+    for op in sec:
+        for n in op.input_arg_names:
+            if n not in written and n not in externals:
+                externals.append(n)
+        written.update(op.output_arg_names)
+    return externals, written
+
+
+def _finish_plan(cb, plan, rest, interior_written, param_names_flat):
+    """Shared tail of both planners: split the remainder around the
+    interior-backward span the vjp replaces, and statically verify the
+    replacement is sound. Fills plan.post_ops/tail_ops; returns None on
+    success, a reason string on failure."""
+    grad_owned = set()
+    for v in (interior_written - {plan.c_last}) | {plan.c0} | set(
+            param_names_flat):
+        grad_owned.add(grad_var_name(v))
+
+    def writes_interior_grad(op):
+        for n in op.output_arg_names:
+            for g in grad_owned:
+                if n == g or n.startswith(g + "@"):
+                    return True
+        return False
+
+    idxs = [i for i, op in enumerate(rest) if writes_interior_grad(op)]
+    if not idxs:
+        return "no interior gradient ops found in remainder"
+    lo, hi = min(idxs), max(idxs)
+    span = rest[lo:hi + 1]
+    if any(not writes_interior_grad(op) for op in span):
+        return "interior gradient ops are not contiguous"
+    post, tail = rest[:lo], rest[hi + 1:]
+    # a stage param also read by the pre/post FORWARD spans (e.g. an
+    # embedding tied to the output head) would contribute gradient from
+    # outside the interior — the vjp we substitute only sums the
+    # interior contributions, so the grad would be silently wrong
+    outside_reads = set()
+    for op in list(plan.pre_ops) + list(post):
+        outside_reads.update(op.input_arg_names)
+    shared = sorted(set(param_names_flat) & outside_reads)
+    if shared:
+        return (f"stage param(s) {shared} also read by pre/post ops — "
+                f"their out-of-interior grad contributions can't ride "
+                f"the interior vjp")
+    # the reverse pipeline needs the c_last cotangent from the post span
+    gy = grad_var_name(plan.c_last)
+    if not any(gy in op.output_arg_names for op in post):
+        return (f"post span does not produce {gy} — cannot run the "
+                f"reverse pipeline")
+    # outputs of the replaced span may only be consumed downstream if we
+    # recompute them ourselves; anything else read later would vanish
+    recomputed = {grad_var_name(plan.c0)}
+    recomputed.update(grad_var_name(n) for n in param_names_flat)
+    dropped = set()
+    for op in span:
+        dropped.update(op.output_arg_names)
+    later_reads = set(cb.fetch_names)
+    for op in tail:
+        later_reads.update(op.input_arg_names)
+    leaked = sorted((dropped - recomputed) & later_reads)
+    if leaked:
+        return (f"replaced backward span outputs {leaked} are consumed "
+                f"outside the interior")
+    plan.post_ops, plan.tail_ops = post, tail
+    return None
+
+
+def _plan_homogeneous(cb, plan, sections, rest, all_written,
+                      interior_written):
+    """Fill the stacked-template fields of ``plan``; returns the plan or
+    a reason string."""
+    cut_vars = plan.cut_vars
+    bvars = cb.program.global_block().vars
+    cshapes = {tuple(bvars[c].shape) for c in cut_vars if c in bvars}
+    if len(cshapes) != 1:
+        return f"cut activations have mismatched shapes {sorted(cshapes)}"
+    template = sections[0]
+    if any(len(s) != len(template) for s in sections):
+        return "sections differ in op count"
+    maps: List[Dict[str, str]] = []  # template name -> stage-i name
+    for sec in sections:
+        m: Dict[str, str] = {}
+        for top, sop in zip(template, sec):
+            if _op_signature(top) != _op_signature(sop):
+                return f"op mismatch: {top.type} vs {sop.type}"
+            for tn, sn in zip(
+                    list(top.input_arg_names) + list(top.output_arg_names),
+                    list(sop.input_arg_names) + list(sop.output_arg_names)):
+                if m.setdefault(tn, sn) != sn:
+                    return f"inconsistent rename {tn} -> {m[tn]}/{sn}"
+        maps.append(m)
+
+    externals, written = _section_externals(template)
+    state = set(cb.mut_state) | set(cb.ro_state)
+    for n in externals:
+        stage_names = [m[n] for m in maps]
+        if n == plan.c0:
+            continue  # the pipelined activation input
+        if all(sn == n for sn in stage_names):
+            if n in state and grad_var_name(n) in all_written:
+                # a trainable param SHARED by every stage can't ride the
+                # stacked vjp (the het path handles it instead)
+                return (f"stage-shared trainable param '{n}' (tied "
+                        f"weights across stages can't stack)")
+            plan.closure_names.append(n)
+            continue
+        if not all(sn in state for sn in stage_names):
+            return (f"stage-varying input '{n}' is not persistent state "
+                    f"({stage_names})")
+        scope = cb._scope_ref()
+        shapes = {tuple(scope.find_var(sn).get_tensor().array.shape)
+                  for sn in stage_names}
+        if len(shapes) != 1:
+            return (f"stage-varying input '{n}' has mismatched shapes "
+                    f"across stages ({sorted(shapes)}) — params must stack")
+        plan.param_template.append(n)
+        plan.param_stage_names.append(stage_names)
+    # the template's cut output (stage i writes cut_vars[i+1])
+    out_name = None
+    for tn, sn in maps[0].items():
+        if sn == cut_vars[1] and tn in written:
+            out_name = tn
+            break
+    if out_name is None or any(m.get(out_name) != cut_vars[i + 1]
+                               for i, m in enumerate(maps)):
+        return "stage output does not line up with cut vars"
+    plan.template_out = out_name
+    plan.template_ops = template
+
+    err = _finish_plan(cb, plan, rest, interior_written,
+                       [n for names in plan.param_stage_names
+                        for n in names])
+    return plan if err is None else err
+
+
+def _plan_het(cb, plan, sections, rest, all_written, interior_written):
+    """Fill the heterogeneous fields of ``plan``; returns the plan or a
+    reason string. Reference semantics: section_worker.cc:142 runs
+    arbitrary per-device sections."""
+    cut_vars = plan.cut_vars
+    state = set(cb.mut_state) | set(cb.ro_state)
+    bvars = cb.program.global_block().vars
+    cdtypes = {str(bvars[c].dtype) for c in cut_vars if c in bvars}
+    if len(cdtypes) > 1:
+        return (f"cut activations have mismatched dtypes "
+                f"{sorted(cdtypes)} — the ring buffer carries one dtype")
+    sec_written = []
+    for sec in sections:
+        w = set()
+        for op in sec:
+            w.update(op.output_arg_names)
+        sec_written.append(w)
+    for i, sec in enumerate(sections):
+        if cut_vars[i + 1] not in sec_written[i]:
+            return (f"section {i} does not produce its cut var "
+                    f"'{cut_vars[i + 1]}'")
+        externals, _ = _section_externals(sec)
+        params, closure = [], []
+        for n in externals:
+            if n == cut_vars[i]:
+                continue  # the ring activation input
+            if n in interior_written and n not in sec_written[i]:
+                return (f"section {i} reads '{n}' produced by another "
+                        f"section (cross-stage skip doesn't fit the "
+                        f"1-activation ring)")
+            if n in state and grad_var_name(n) in all_written:
+                params.append(n)
+            else:
+                if grad_var_name(n) in all_written:
+                    return (f"section {i} closure input '{n}' needs a "
+                            f"gradient but is not persistent state")
+                closure.append(n)
+        plan.sec_param_names.append(params)
+        plan.sec_closure.append(closure)
+    plan.het = True
+    plan.sections = sections
+    err = _finish_plan(cb, plan, rest, interior_written,
+                       [n for names in plan.sec_param_names
+                        for n in names])
+    return plan if err is None else err
 
 
 def build_plan(cb, popt) -> Optional[PipelinePlan]:
@@ -94,136 +298,58 @@ def build_plan(cb, popt) -> Optional[PipelinePlan]:
             f"{mesh.shape.get('pp')}")
     plan.n_micro = max(1, int(popt.get("num_microbatches", 1)))
     plan.c0, plan.c_last = cut_vars[0], cut_vars[-1]
-    # activation contract: every cut var has the same shape (gpipe ring
-    # buffers one activation shape through all stages)
-    bvars = cb.program.global_block().vars
-    cshapes = {tuple(bvars[c].shape) for c in cut_vars if c in bvars}
-    if len(cshapes) != 1:
-        return _fallback(
-            f"cut activations have mismatched shapes {sorted(cshapes)}")
+    plan.cut_vars = cut_vars
     plan.pre_ops = ops[:bounds[0]]
     sections = [ops[bounds[i]:bounds[i + 1]]
                 for i in range(plan.n_stages)]
     rest = ops[bounds[-1]:]
 
-    # ---- homogeneity + positional rename maps ---------------------------
-    template = sections[0]
-    if any(len(s) != len(template) for s in sections):
-        return _fallback("sections differ in op count")
-    for op in template:
-        if op.type in _BATCH_MIXING:
-            return _fallback(f"batch-mixing op '{op.type}' in a stage")
-        if op.attrs.get("sub_block") is not None:
-            return _fallback("control flow inside a stage")
-        from ..ops.registry import OPS
-        if OPS.has(op.type) and OPS.get(op.type).needs_rng:
-            return _fallback(f"rng op '{op.type}' in a stage")
-    maps: List[Dict[str, str]] = []  # template name -> stage-i name
+    # common per-op checks over EVERY section
+    from ..ops.registry import OPS
     for sec in sections:
-        m: Dict[str, str] = {}
-        for top, sop in zip(template, sec):
-            if _op_signature(top) != _op_signature(sop):
-                return _fallback(
-                    f"op mismatch: {top.type} vs {sop.type}")
-            for tn, sn in zip(
-                    list(top.input_arg_names) + list(top.output_arg_names),
-                    list(sop.input_arg_names) + list(sop.output_arg_names)):
-                if m.setdefault(tn, sn) != sn:
-                    return _fallback(
-                        f"inconsistent rename {tn} -> {m[tn]}/{sn}")
-        maps.append(m)
+        for op in sec:
+            if op.type in _BATCH_MIXING:
+                return _fallback(f"batch-mixing op '{op.type}' in a stage")
+            if op.attrs.get("sub_block") is not None:
+                return _fallback("control flow inside a stage")
+            if OPS.has(op.type) and OPS.get(op.type).needs_rng:
+                return _fallback(f"rng op '{op.type}' in a stage")
 
-    # externals of the template = read before written inside the section
-    written: set = set()
-    externals: List[str] = []
-    for op in template:
-        for n in op.input_arg_names:
-            if n not in written and n not in externals:
-                externals.append(n)
-        written.update(op.output_arg_names)
-    state = set(cb.mut_state) | set(cb.ro_state)
     all_written = set()
     for op in ops:
         all_written.update(op.output_arg_names)
-    for n in externals:
-        stage_names = [m[n] for m in maps]
-        if n == plan.c0:
-            continue  # the pipelined activation input
-        if all(sn == n for sn in stage_names):
-            if n in state and grad_var_name(n) in all_written:
-                # a trainable param SHARED by every stage: its grad ops
-                # live inside the interior span the vjp replaces, but
-                # the vjp differentiates only stacked params + x0 — the
-                # tied weight would silently get no gradient
-                return _fallback(
-                    f"stage-shared trainable param '{n}' (tied weights "
-                    f"across stages can't ride the stacked vjp)")
-            plan.closure_names.append(n)
-            continue
-        if not all(sn in state for sn in stage_names):
-            return _fallback(
-                f"stage-varying input '{n}' is not persistent state "
-                f"({stage_names})")
-        scope = cb._scope_ref()
-        shapes = {tuple(scope.find_var(sn).get_tensor().array.shape)
-                  for sn in stage_names}
-        if len(shapes) != 1:
-            return _fallback(
-                f"stage-varying input '{n}' has mismatched shapes "
-                f"across stages ({sorted(shapes)}) — params must stack")
-        plan.param_template.append(n)
-        plan.param_stage_names.append(stage_names)
-    # the template's cut output (stage i writes cut_vars[i+1])
-    out_name = None
-    for tn, sn in maps[0].items():
-        if sn == cut_vars[1] and tn in written:
-            out_name = tn
-            break
-    if out_name is None or any(m.get(out_name) != cut_vars[i + 1]
-                               for i, m in enumerate(maps)):
-        return _fallback("stage output does not line up with cut vars")
-    plan.template_out = out_name
-    plan.template_ops = template
-
-    # ---- split the remainder: post span / interior bwd span / tail ------
+    # interior activations never materialize under either plan — a fetch
+    # of one must take the fused path (c_last itself IS produced)
     interior_written = set()
     for sec in sections:
         for op in sec:
             interior_written.update(op.output_arg_names)
-    # interior activations never materialize under the plan — a fetch of
-    # one must take the fused path (c_last itself IS produced)
     hidden = (interior_written - {plan.c_last}) & set(cb.fetch_names)
     if hidden:
         return _fallback(
             f"fetch of interior activation(s) {sorted(hidden)} — the "
             f"pipelined schedule does not materialize them")
-    grad_owned = set()
-    for v in (interior_written - {plan.c_last}) | {plan.c0} | {
-            n for names in plan.param_stage_names for n in names}:
-        grad_owned.add(grad_var_name(v))
 
-    def _writes_interior_grad(op):
-        for n in op.output_arg_names:
-            for g in grad_owned:
-                if n == g or n.startswith(g + "@"):
-                    return True
-        return False
-
-    idxs = [i for i, op in enumerate(rest) if _writes_interior_grad(op)]
-    if not idxs:
-        return _fallback("no interior gradient ops found in remainder")
-    lo, hi = min(idxs), max(idxs)
-    span = rest[lo:hi + 1]
-    if any(not _writes_interior_grad(op) for op in span):
-        return _fallback("interior gradient ops are not contiguous")
-    plan.post_ops = rest[:lo]
-    plan.tail_ops = rest[hi + 1:]
-    return plan
+    homog = _plan_homogeneous(cb, plan, sections, rest, all_written,
+                              interior_written)
+    if isinstance(homog, PipelinePlan):
+        return homog
+    plan2 = PipelinePlan()
+    plan2.n_stages, plan2.n_micro = plan.n_stages, plan.n_micro
+    plan2.c0, plan2.c_last = plan.c0, plan.c_last
+    plan2.cut_vars, plan2.pre_ops = plan.cut_vars, plan.pre_ops
+    het = _plan_het(cb, plan2, sections, rest, all_written,
+                    interior_written)
+    if isinstance(het, PipelinePlan):
+        return het
+    return _fallback(f"homogeneous: {homog}; heterogeneous: {het}")
 
 
 def exec_plan(cb, plan: PipelinePlan, env: Dict[str, Any], lod_env, rng):
     """Execute one pipelined step into ``env`` (called from
     _CompiledBlock._step inside jit)."""
+    if plan.het:
+        return _exec_het(cb, plan, env, lod_env, rng)
     from ..parallel.pipeline import gpipe
 
     cb._exec_ops(plan.pre_ops, env, lod_env, rng)
@@ -262,4 +388,57 @@ def exec_plan(cb, plan: PipelinePlan, env: Dict[str, Any], lod_env, rng):
     for names, g in zip(plan.param_stage_names, d_stacked):
         for i, n in enumerate(names):
             env[grad_var_name(n)] = g[i]
+    cb._exec_ops(plan.tail_ops, env, lod_env, rng)
+
+
+def _exec_het(cb, plan: PipelinePlan, env: Dict[str, Any], lod_env, rng):
+    """Heterogeneous schedule: per-stage bodies via gpipe_het."""
+    from ..parallel.pipeline import gpipe_het
+
+    cb._exec_ops(plan.pre_ops, env, lod_env, rng)
+    x0 = env[plan.c0]
+    B = x0.shape[0]
+    if B % plan.n_micro:
+        raise ValueError(
+            f"batch {B} not divisible by num_microbatches={plan.n_micro}")
+    params = [[env[n] for n in names] for names in plan.sec_param_names]
+    closures = [{n: env[n] for n in cl} for cl in plan.sec_closure]
+
+    def mk_stage(i):
+        sec = plan.sections[i]
+        in_name, out_name = plan.cut_vars[i], plan.cut_vars[i + 1]
+
+        def f(p, x):
+            e = dict(closures[i])
+            for n, v in zip(plan.sec_param_names[i], p):
+                e[n] = v
+            e[in_name] = x
+            cb._exec_ops(sec, e, dict(lod_env), rng)
+            return e[out_name]
+        return f
+
+    stage_fns = [mk_stage(i) for i in range(plan.n_stages)]
+
+    def interior(params_, x0_):
+        xs = x0_.reshape((plan.n_micro, B // plan.n_micro) + x0_.shape[1:])
+        ys = gpipe_het(stage_fns, params_, xs, mesh=cb.mesh)
+        return ys.reshape((B,) + ys.shape[2:])
+
+    y, vjp_fn = jax.vjp(interior, params, x0)
+    env[plan.c_last] = y
+    cb._exec_ops(plan.post_ops, env, lod_env, rng)
+    gy_name = grad_var_name(plan.c_last)
+    if gy_name not in env:
+        raise KeyError(
+            f"post span did not produce {gy_name} — cannot run the "
+            f"reverse pipeline")
+    d_params, d_x0 = vjp_fn(env[gy_name].astype(y.dtype))
+    env[grad_var_name(plan.c0)] = d_x0
+    # tied params may appear in several sections — their grads SUM
+    acc: Dict[str, Any] = {}
+    for names, gs in zip(plan.sec_param_names, d_params):
+        for n, g in zip(names, gs):
+            acc[n] = g if n not in acc else acc[n] + g
+    for n, g in acc.items():
+        env[grad_var_name(n)] = g
     cb._exec_ops(plan.tail_ops, env, lod_env, rng)

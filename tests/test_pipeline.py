@@ -181,86 +181,140 @@ def test_pipeline_optimizer_lowers_to_gpipe():
     import warnings as _w
     mesh = pipeline_mesh(N_STAGES)
     with _w.catch_warnings():
-        _w.simplefilter("error")  # a fallback warning means NOT lowered
+        _w.simplefilter("error", UserWarning)  # a fallback warning means NOT lowered
         piped = _run_steps(mesh)
     fused = _run_steps(None)
     np.testing.assert_allclose(piped, fused, rtol=2e-5, atol=1e-6)
     assert piped[-1] < piped[0]  # it actually trains
 
 
-def test_pipeline_optimizer_heterogeneous_falls_back():
-    """Sections that don't stack (different widths) execute fused, with
-    a warning — not a crash."""
-    from paddle_tpu.fluid import core
+def _build_het_tower(widths, lr=0.02, n_micro=2):
+    """pre-fc | len(widths) heterogeneous tanh-fc stages | loss.
+    Stage widths differ, so sections can NOT stack (reference
+    SectionWorker runs arbitrary sections — section_worker.cc:142)."""
     main, startup = Program(), Program()
     with program_guard(main, startup):
         x = fluid.data("x", shape=[WIDTH], dtype="float32")
-        h = fluid.layers.fc(x, WIDTH, act="tanh")
+        label = fluid.data("label", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, WIDTH, act="tanh",
+                            param_attr=fluid.ParamAttr(name="het_pre_w"))
         cuts = [h]
-        for w in (WIDTH, 2 * WIDTH, WIDTH, WIDTH):  # heterogeneous
-            h = fluid.layers.fc(h, w, act="tanh")
+        for i, w in enumerate(widths):
+            h = fluid.layers.fc(
+                h, w, act="tanh",
+                param_attr=fluid.ParamAttr(name=f"het_s{i}_w"),
+                bias_attr=fluid.ParamAttr(name=f"het_s{i}_b"))
+            cuts.append(h)
+        pred = fluid.layers.fc(h, 1,
+                               param_attr=fluid.ParamAttr(name="het_head_w"))
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, label)))
+        fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(lr), cut_list=cuts,
+            sync_steps=n_micro).minimize(loss)
+    return main, startup, loss
+
+
+def _run_het_steps(mesh, widths, steps=4, batch=8):
+    from paddle_tpu.fluid import core
+    main, startup, loss = _build_het_tower(widths)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(3)
+    X = rng.rand(batch, WIDTH).astype("float32")
+    Y = rng.rand(batch, 1).astype("float32")
+    out = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            (l,) = exe.run(main, feed={"x": X, "label": Y},
+                           fetch_list=[loss], mesh=mesh)
+            out.append(float(np.asarray(l).ravel()[0]))
+    return out
+
+
+def test_pipeline_optimizer_heterogeneous_lowers():
+    """Sections that don't stack (different widths) now pipeline through
+    the heterogeneous schedule (gpipe_het flat ring buffer + lax.switch
+    stage bodies) and match the fused run's losses step for step
+    (VERDICT r04 item 4; reference section_worker.cc:142 runs arbitrary
+    sections)."""
+    import warnings as _w
+    widths = (WIDTH, 2 * WIDTH, WIDTH, WIDTH)  # heterogeneous
+    mesh = pipeline_mesh(N_STAGES)
+    with _w.catch_warnings():
+        _w.simplefilter("error", UserWarning)  # a fallback warning means NOT lowered
+        piped = _run_het_steps(mesh, widths)
+    fused = _run_het_steps(None, widths)
+    np.testing.assert_allclose(piped, fused, rtol=2e-5, atol=1e-6)
+    assert piped[-1] < piped[0]  # it actually trains
+
+
+def _build_tied_tower(tied, lr=0.1):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[WIDTH], dtype="float32")
+        h = fluid.layers.fc(x, WIDTH, act="tanh",
+                            param_attr=fluid.ParamAttr(name="tp_pre_w"))
+        cuts = [h]
+        for i in range(N_STAGES):
+            pa = fluid.ParamAttr(
+                name="tied_w" if tied else f"tw{i}_w")
+            h = fluid.layers.fc(h, WIDTH, act="tanh", param_attr=pa,
+                                bias_attr=False)
             cuts.append(h)
         loss = fluid.layers.mean(h)
-        opt = fluid.optimizer.PipelineOptimizer(
-            fluid.optimizer.SGD(0.1), cut_list=cuts, sync_steps=2)
-        opt.minimize(loss)
-    exe = fluid.Executor()
-    scope = core.Scope()
-    rng = np.random.RandomState(0)
-    mesh = pipeline_mesh(N_STAGES)
-    with fluid.scope_guard(scope):
-        exe.run(startup)
-        with pytest.warns(UserWarning, match="not lowerable"):
-            (l,) = exe.run(main,
-                           feed={"x": rng.rand(8, WIDTH).astype("float32")},
-                           fetch_list=[loss], mesh=mesh)
-    assert np.isfinite(np.asarray(l)).all()
+        fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(lr), cut_list=cuts,
+            sync_steps=2).minimize(loss)
+    return main, startup, loss, cuts
 
 
-def test_pipeline_fallback_on_tied_weights_and_interior_fetch():
-    """Two confirmed non-lowerable shapes must FALL BACK (warning), not
-    crash: (1) a trainable param shared by every stage (its grad ops
-    live inside the replaced span); (2) fetching an interior
-    activation (never materialized under the schedule)."""
+def test_pipeline_tied_weights_lower_via_het():
+    """A trainable param shared by every stage can't ride the stacked
+    vjp, but the heterogeneous schedule carries it per-section and SUMS
+    the per-stage grads — losses must match the fused run step for step
+    (the reference runtime shares the scope across sections, so tied
+    weights just work there; section_worker.cc:142)."""
+    import warnings as _w
     from paddle_tpu.fluid import core
 
-    def build(tied):
-        main, startup = Program(), Program()
-        with program_guard(main, startup):
-            x = fluid.data("x", shape=[WIDTH], dtype="float32")
-            h = fluid.layers.fc(x, WIDTH, act="tanh")
-            cuts = [h]
-            for i in range(N_STAGES):
-                pa = fluid.ParamAttr(
-                    name="tied_w" if tied else f"tw{i}_w")
-                h = fluid.layers.fc(h, WIDTH, act="tanh", param_attr=pa,
-                                    bias_attr=False)
-                cuts.append(h)
-            loss = fluid.layers.mean(h)
-            fluid.optimizer.PipelineOptimizer(
-                fluid.optimizer.SGD(0.1), cut_list=cuts,
-                sync_steps=2).minimize(loss)
-        return main, startup, loss, cuts
+    def run(mesh, steps=4):
+        main, startup, loss, _ = _build_tied_tower(tied=True)
+        exe = fluid.Executor()
+        scope = core.Scope()
+        rng = np.random.RandomState(0)
+        X = rng.rand(8, WIDTH).astype("float32")
+        out = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _i in range(steps):
+                (l,) = exe.run(main, feed={"x": X}, fetch_list=[loss],
+                               mesh=mesh)
+                out.append(float(np.asarray(l).ravel()[0]))
+        return out
 
-    mesh = pipeline_mesh(N_STAGES)
+    with _w.catch_warnings():
+        _w.simplefilter("error", UserWarning)  # a fallback warning means NOT lowered
+        piped = run(pipeline_mesh(N_STAGES))
+    fused = run(None)
+    np.testing.assert_allclose(piped, fused, rtol=2e-5, atol=1e-6)
+
+
+def test_pipeline_fallback_on_interior_fetch():
+    """Fetching an interior activation (never materialized under either
+    schedule) must FALL BACK (warning), not crash."""
+    from paddle_tpu.fluid import core
+
+    main, startup, loss, cuts = _build_tied_tower(tied=False)
+    exe = fluid.Executor()
     rng = np.random.RandomState(0)
     X = rng.rand(8, WIDTH).astype("float32")
-
-    main, startup, loss, cuts = build(tied=True)
-    exe = fluid.Executor()
-    scope = core.Scope()
-    with fluid.scope_guard(scope):
-        exe.run(startup)
-        with pytest.warns(UserWarning, match="tied"):
-            (l,) = exe.run(main, feed={"x": X}, fetch_list=[loss],
-                           mesh=mesh)
-    assert np.isfinite(np.asarray(l)).all()
-
-    main, startup, loss, cuts = build(tied=False)
     scope = core.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
         with pytest.warns(UserWarning, match="interior activation"):
             l, mid = exe.run(main, feed={"x": X},
-                             fetch_list=[loss, cuts[2]], mesh=mesh)
+                             fetch_list=[loss, cuts[2]],
+                             mesh=pipeline_mesh(N_STAGES))
     assert np.isfinite(np.asarray(mid)).all()

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Round-5 tunnel watcher: probe until a TPU window opens, then run the
+# first-contact plan immediately; repeat for the whole round so a second
+# window is spent iterating (flash sweep tail, batch ladder) rather than
+# being missed. All output goes to tools/round5_watch.log.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+DEADLINE=$(( $(date +%s) + ${ROUND5_WATCH_HOURS:-11} * 3600 ))
+cd "$REPO"
+n=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  n=$((n + 1))
+  left_h=$(( (DEADLINE - $(date +%s)) / 3600 ))
+  echo "=== watch cycle $n ($(date -u +%FT%TZ), ~${left_h}h left) ==="
+  python tools/tpu_probe_loop.py 180 "$(( (DEADLINE - $(date +%s)) / 3600 + 1 ))"
+  rc=$?
+  if [ "$rc" -eq 0 ]; then
+    echo "=== TUNNEL LIVE — running first_contact ($(date -u +%FT%TZ)) ==="
+    FIRST_CONTACT_SKIP_PROBE=1 python tools/first_contact.py
+    echo "=== first_contact done rc=$? ($(date -u +%FT%TZ)) ==="
+    sleep 20
+  else
+    echo "=== probe loop exited rc=$rc (deadline) ==="
+    break
+  fi
+done
+echo "=== watcher done after $n cycles ($(date -u +%FT%TZ)) ==="
