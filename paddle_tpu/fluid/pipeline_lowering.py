@@ -98,6 +98,14 @@ def _section_externals(sec):
     return externals, written
 
 
+def _batch_aligned(cb, name):
+    """True when the block var's leading dim is the dynamic batch (-1):
+    such a closure input would enter the per-MICROBATCH stage body at
+    full-batch shape — broken semantics, so the planner must reject it."""
+    v = cb.program.global_block().vars.get(name)
+    return v is not None and len(v.shape) > 0 and v.shape[0] == -1
+
+
 def _finish_plan(cb, plan, rest, interior_written, param_names_flat):
     """Shared tail of both planners: split the remainder around the
     interior-backward span the vjp replaces, and statically verify the
@@ -195,6 +203,9 @@ def _plan_homogeneous(cb, plan, sections, rest, all_written,
                 # stacked vjp (the het path handles it instead)
                 return (f"stage-shared trainable param '{n}' (tied "
                         f"weights across stages can't stack)")
+            if _batch_aligned(cb, n):
+                return (f"stage closure input '{n}' is batch-aligned — "
+                        f"it cannot enter the per-microbatch stage body")
             plan.closure_names.append(n)
             continue
         if not all(sn in state for sn in stage_names):
@@ -262,6 +273,10 @@ def _plan_het(cb, plan, sections, rest, all_written, interior_written):
                 if grad_var_name(n) in all_written:
                     return (f"section {i} closure input '{n}' needs a "
                             f"gradient but is not persistent state")
+                if _batch_aligned(cb, n):
+                    return (f"section {i} closure input '{n}' is "
+                            f"batch-aligned — it cannot enter the "
+                            f"per-microbatch stage body")
                 closure.append(n)
         plan.sec_param_names.append(params)
         plan.sec_closure.append(closure)

@@ -18,11 +18,14 @@ transposes to the opposite shift) is a single XLA computation: no queues, no
 threads, no host in the loop.
 
 Two layers:
-  * `gpipe(...)`      — the functional scheduler (this file), used directly
-                        by model code for peak MFU.
+  * `gpipe(...)` / `gpipe_het(...)` — the functional schedulers (this
+    file): stacked stage params for homogeneous stages, a flat
+    lax.switch ring for arbitrary per-stage bodies. Used directly by
+    model code for peak MFU.
   * `PipelineOptimizer` (fluid/optimizer.py) — reference-API program
-    splitter that lowers section metadata onto this scheduler (homogeneous
-    stacks) or onto a microbatch-accumulation loop (heterogeneous).
+    splitter whose section metadata `fluid/pipeline_lowering.py` lowers
+    onto `gpipe` (homogeneous sections) or `gpipe_het` (heterogeneous),
+    falling back to fused execution when neither schedule applies.
 """
 from __future__ import annotations
 

@@ -318,3 +318,40 @@ def test_pipeline_fallback_on_interior_fetch():
                              fetch_list=[loss, cuts[2]],
                              mesh=pipeline_mesh(N_STAGES))
     assert np.isfinite(np.asarray(mid)).all()
+
+
+def test_pipeline_fallback_on_batch_aligned_closure():
+    """A non-trainable batch-aligned tensor read inside a stage (e.g. a
+    feed mask) cannot enter the per-microbatch stage body — the planner
+    must fall back fused (warning), not crash inside jit."""
+    from paddle_tpu.fluid import core
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = fluid.data("x", shape=[WIDTH], dtype="float32")
+        m = fluid.data("m", shape=[WIDTH], dtype="float32")  # batch mask
+        h = fluid.layers.fc(x, WIDTH, act="tanh")
+        cuts = [h]
+        for i in range(N_STAGES):
+            h = fluid.layers.fc(
+                h, WIDTH, act="tanh",
+                param_attr=fluid.ParamAttr(name=f"bm{i}_w"),
+                bias_attr=False)
+            h = fluid.layers.elementwise_mul(h, m)  # mask inside stage
+            cuts.append(h)
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(0.1), cut_list=cuts,
+            sync_steps=2).minimize(loss)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.warns(UserWarning, match="not lowerable"):
+            (l,) = exe.run(
+                main,
+                feed={"x": rng.rand(8, WIDTH).astype("float32"),
+                      "m": np.ones((8, WIDTH), "float32")},
+                fetch_list=[loss], mesh=pipeline_mesh(N_STAGES))
+    assert np.isfinite(np.asarray(l)).all()

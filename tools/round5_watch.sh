@@ -19,9 +19,13 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     FIRST_CONTACT_SKIP_PROBE=1 python tools/first_contact.py
     echo "=== first_contact done rc=$? ($(date -u +%FT%TZ)) ==="
     sleep 20
-  else
-    echo "=== probe loop exited rc=$rc (deadline) ==="
+  elif [ "$rc" -eq 3 ]; then
+    echo "=== probe loop exited rc=3 (deadline) ==="
     break
+  else
+    # a transient probe-loop crash must NOT end the round's watching
+    echo "=== probe loop crashed rc=$rc — retrying in 60s ==="
+    sleep 60
   fi
 done
 echo "=== watcher done after $n cycles ($(date -u +%FT%TZ)) ==="
