@@ -263,3 +263,119 @@ def test_mnist_mlp_golden_trajectory_parity():
     # float32 executor vs float64 oracle: growth of rounding error over
     # 10 steps stays well inside 1e-4 relative
     np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_lenet_conv_golden_trajectory_parity():
+    """Conv-path golden oracle (VERDICT r04 item 6): the executor's
+    10-step loss trajectory through conv2d → relu → max-pool → fc
+    softmax → cross-entropy → SGD must match the torch-float64 fixture
+    (tools/make_golden_trajectory.py conv) step for step. Catches
+    numeric drift in the conv/pool/im2col grad paths that an accuracy
+    bar would miss (reference role: book tests, SURVEY §4.3)."""
+    import os
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    fx = np.load(os.path.join(os.path.dirname(__file__), "fixtures",
+                              "golden_lenet_trajectory.npz"))
+    golden = fx["losses"]
+    ini = fluid.initializer.NumpyArrayInitializer
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", shape=[1, 14, 14], dtype="float32")
+        label = fluid.data("label", shape=[1], dtype="int64")
+        c = fluid.layers.conv2d(
+            img, 4, 5, act="relu",
+            param_attr=fluid.ParamAttr(
+                name="gl_cw", initializer=ini(fx["cw"].astype("float32"))),
+            bias_attr=fluid.ParamAttr(
+                name="gl_cb", initializer=ini(fx["cb"].astype("float32"))))
+        pl = fluid.layers.pool2d(c, 2, "max", 2)
+        pred = fluid.layers.fc(
+            pl, 10, act="softmax",
+            param_attr=fluid.ParamAttr(
+                name="gl_fw", initializer=ini(fx["fw"].astype("float32"))),
+            bias_attr=fluid.ParamAttr(
+                name="gl_fb", initializer=ini(fx["fb"].astype("float32"))))
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = core.Scope()
+    got = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(len(golden)):
+            (l,) = exe.run(main,
+                           feed={"img": fx["X"].astype("float32"),
+                                 "label": fx["Y"]},
+                           fetch_list=[loss])
+            got.append(float(np.asarray(l).ravel()[0]))
+    np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_encoder_golden_trajectory_parity():
+    """Attention-path golden oracle (VERDICT r04 item 6): one
+    transformer encoder layer (2-head fused attention, gelu FFN, two
+    layer_norms) under MSE + SGD must reproduce the torch-float64
+    8-step loss trajectory (tools/make_golden_trajectory.py bert).
+    Catches numeric drift in the fused-attention/layernorm/gelu grad
+    paths the BERT bench rides."""
+    import os
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.models.bert import fused_multihead_attention
+
+    fx = np.load(os.path.join(os.path.dirname(__file__), "fixtures",
+                              "golden_encoder_trajectory.npz"))
+    golden = fx["losses"]
+    ini = fluid.initializer.NumpyArrayInitializer
+
+    def pa(key):
+        return fluid.ParamAttr(name=f"ge_{key}",
+                               initializer=ini(fx[key].astype("float32")))
+
+    H = fx["wq"].shape[0]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[6, H], dtype="float32")
+        t = fluid.data("t", shape=[6, H], dtype="float32")
+        q = fluid.layers.fc(x, H, num_flatten_dims=2,
+                            param_attr=pa("wq"), bias_attr=pa("bq"))
+        k = fluid.layers.fc(x, H, num_flatten_dims=2,
+                            param_attr=pa("wk"), bias_attr=pa("bk"))
+        v = fluid.layers.fc(x, H, num_flatten_dims=2,
+                            param_attr=pa("wv"), bias_attr=pa("bv"))
+        ctx = fused_multihead_attention(q, k, v, n_head=2)
+        attn = fluid.layers.fc(ctx, H, num_flatten_dims=2,
+                               param_attr=pa("wo"), bias_attr=pa("bo"))
+        h1 = fluid.layers.layer_norm(
+            fluid.layers.elementwise_add(x, attn), begin_norm_axis=2,
+            param_attr=pa("g1"), bias_attr=pa("e1"))
+        f = fluid.layers.fc(h1, fx["w1"].shape[1], num_flatten_dims=2,
+                            act="gelu", param_attr=pa("w1"),
+                            bias_attr=pa("b1"))
+        f2 = fluid.layers.fc(f, H, num_flatten_dims=2,
+                             param_attr=pa("w2"), bias_attr=pa("b2"))
+        out2 = fluid.layers.layer_norm(
+            fluid.layers.elementwise_add(h1, f2), begin_norm_axis=2,
+            param_attr=pa("g2"), bias_attr=pa("e2"))
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(out2, t)))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = core.Scope()
+    got = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(len(golden)):
+            (l,) = exe.run(main,
+                           feed={"x": fx["X"].astype("float32"),
+                                 "t": fx["T"].astype("float32")},
+                           fetch_list=[loss])
+            got.append(float(np.asarray(l).ravel()[0]))
+    np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)

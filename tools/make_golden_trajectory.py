@@ -1,20 +1,33 @@
-"""Generate tests/fixtures/golden_mnist_trajectory.npz INDEPENDENTLY of
-paddle_tpu: a pure-NumPy implementation of the MNIST-MLP smoke config
-(BASELINE.md "loss-parity with reference CPU run" row; reference
-tests/book/test_recognize_digits.py trains this exact shape) — fc(64,
-relu) → fc(10, softmax) → cross_entropy mean, plain SGD. Same fixed
-weights/data the fluid test builds via NumpyArrayInitializer, 10 steps,
-per-step losses recorded in float64.
+"""Generate golden loss-trajectory fixtures INDEPENDENTLY of paddle_tpu
+(reference role: the book tests' convergence contract, SURVEY §4.3 —
+but checked numerically, step for step, not as an accuracy bar):
+
+  mnist — pure-NumPy MLP: fc(64, relu) → fc(10, softmax) →
+          cross_entropy mean, plain SGD, 10 steps (BASELINE.md
+          "loss-parity with reference CPU run" row; reference
+          tests/book/test_recognize_digits.py trains this shape).
+  conv  — torch-float64 LeNet-tiny: conv2d(4, 5×5) + relu → maxpool2×2
+          → fc softmax → cross_entropy mean, SGD, 10 steps. Pins the
+          conv/pool/im2col grad paths.
+  bert  — torch-float64 single transformer encoder layer (2-head
+          attention, gelu FFN, two layer_norms, eps 1e-5) under an MSE
+          loss, SGD, 8 steps. Pins the attention/layernorm/gelu paths.
+
+torch (CPU) is an independent oracle: none of paddle_tpu's executor,
+op registry, or JAX is involved in producing the fixtures.
 
 Regenerate with:
-    python tools/make_golden_trajectory.py
+    python tools/make_golden_trajectory.py [mnist|conv|bert|all]
 """
 import os
+import sys
 
 import numpy as np
 
-OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "tests", "fixtures", "golden_mnist_trajectory.npz")
+FIXDIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures")
+OUT = os.path.join(FIXDIR, "golden_mnist_trajectory.npz")
 
 BATCH, D_IN, D_H, D_OUT, STEPS, LR = 32, 784, 64, 10, 10, 0.1
 
@@ -61,13 +74,143 @@ def run(p):
     return np.asarray(losses, np.float64)
 
 
-def main():
+def make_mnist():
     p = init()
     losses = run(p)
     np.savez(OUT, losses=losses,
              **{k: p[k] for k in ("w1", "b1", "w2", "b2", "X", "Y")})
     print("wrote", OUT)
     print("losses:", np.round(losses, 6))
+
+
+# ------------------------------------------------------------------ conv
+CONV = dict(B=16, C=4, K=5, IMG=14, CLS=10, STEPS=10, LR=0.1)
+
+
+def conv_init(seed=4321):
+    r = np.random.RandomState(seed)
+    B, C, K, IMG, CLS = (CONV[k] for k in ("B", "C", "K", "IMG", "CLS"))
+    pooled = ((IMG - K + 1) // 2) ** 2 * C
+    return {
+        "cw": (r.rand(C, 1, K, K) * 0.2 - 0.1).astype(np.float64),
+        "cb": np.zeros(C, np.float64),
+        "fw": (r.rand(pooled, CLS) * 0.02 - 0.01).astype(np.float64),
+        "fb": np.zeros(CLS, np.float64),
+        "X": r.rand(B, 1, IMG, IMG).astype(np.float64),
+        "Y": r.randint(0, CLS, (B, 1)).astype(np.int64),
+    }
+
+
+def make_conv():
+    import torch
+    import torch.nn.functional as F
+    p = conv_init()
+    B, STEPS, LR = CONV["B"], CONV["STEPS"], CONV["LR"]
+    cw = torch.tensor(p["cw"], requires_grad=True)
+    cb = torch.tensor(p["cb"], requires_grad=True)
+    fw = torch.tensor(p["fw"], requires_grad=True)
+    fb = torch.tensor(p["fb"], requires_grad=True)
+    X = torch.tensor(p["X"])
+    yidx = torch.tensor(p["Y"][:, 0])
+    losses = []
+    for _ in range(STEPS):
+        h = F.relu(F.conv2d(X, cw, cb))
+        h = F.max_pool2d(h, 2, 2)
+        logits = h.reshape(B, -1) @ fw + fb
+        probs = F.softmax(logits, dim=1)
+        loss = -torch.log(probs[torch.arange(B), yidx]).mean()
+        losses.append(float(loss))
+        for t in (cw, cb, fw, fb):
+            t.grad = None
+        loss.backward()
+        with torch.no_grad():
+            for t in (cw, cb, fw, fb):
+                t -= LR * t.grad
+    path = os.path.join(FIXDIR, "golden_lenet_trajectory.npz")
+    np.savez(path, losses=np.asarray(losses, np.float64),
+             **{k: p[k] for k in ("cw", "cb", "fw", "fb", "X", "Y")})
+    print("wrote", path)
+    print("losses:", np.round(losses, 6))
+
+
+# ------------------------------------------------------------------ bert
+ENC = dict(B=4, S=6, H=16, HEADS=2, FFN=32, STEPS=8, LR=0.05)
+
+
+def enc_init(seed=777):
+    r = np.random.RandomState(seed)
+    B, S, H, FFN = (ENC[k] for k in ("B", "S", "H", "FFN"))
+
+    def m(*shape, scale=0.2):
+        return (r.rand(*shape) * 2 * scale - scale).astype(np.float64)
+
+    return {
+        "wq": m(H, H), "bq": np.zeros(H, np.float64),
+        "wk": m(H, H), "bk": np.zeros(H, np.float64),
+        "wv": m(H, H), "bv": np.zeros(H, np.float64),
+        "wo": m(H, H), "bo": np.zeros(H, np.float64),
+        "g1": np.ones(H, np.float64), "e1": np.zeros(H, np.float64),
+        "w1": m(H, FFN), "b1": np.zeros(FFN, np.float64),
+        "w2": m(FFN, H), "b2": np.zeros(H, np.float64),
+        "g2": np.ones(H, np.float64), "e2": np.zeros(H, np.float64),
+        "X": m(B, S, H, scale=1.0), "T": m(B, S, H, scale=1.0),
+    }
+
+
+def make_bert():
+    import math
+
+    import torch
+    import torch.nn.functional as F
+    p = enc_init()
+    B, S, H, HEADS, STEPS, LR = (ENC[k] for k in
+                                 ("B", "S", "H", "HEADS", "STEPS", "LR"))
+    D = H // HEADS
+    names = ("wq", "bq", "wk", "bk", "wv", "bv", "wo", "bo",
+             "g1", "e1", "w1", "b1", "w2", "b2", "g2", "e2")
+    t = {k: torch.tensor(p[k], requires_grad=True) for k in names}
+    X, T = torch.tensor(p["X"]), torch.tensor(p["T"])
+
+    def heads(x):  # [B,S,H] -> [B,HEADS,S,D]
+        return x.reshape(B, S, HEADS, D).permute(0, 2, 1, 3)
+
+    losses = []
+    for _ in range(STEPS):
+        q, k, v = (heads(X @ t[f"w{n}"] + t[f"b{n}"]) for n in "qkv")
+        scores = (q @ k.transpose(-1, -2)) / math.sqrt(D)
+        ctx = F.softmax(scores, dim=-1) @ v
+        ctx = ctx.permute(0, 2, 1, 3).reshape(B, S, H)
+        attn = ctx @ t["wo"] + t["bo"]
+        h1 = F.layer_norm(X + attn, (H,), t["g1"], t["e1"], eps=1e-5)
+        f = F.gelu(h1 @ t["w1"] + t["b1"])
+        f2 = f @ t["w2"] + t["b2"]
+        out2 = F.layer_norm(h1 + f2, (H,), t["g2"], t["e2"], eps=1e-5)
+        loss = ((out2 - T) ** 2).mean()
+        losses.append(float(loss))
+        for v_ in t.values():
+            v_.grad = None
+        loss.backward()
+        with torch.no_grad():
+            for v_ in t.values():
+                v_ -= LR * v_.grad
+    path = os.path.join(FIXDIR, "golden_encoder_trajectory.npz")
+    np.savez(path, losses=np.asarray(losses, np.float64),
+             X=p["X"], T=p["T"], **{k: p[k] for k in names})
+    print("wrote", path)
+    print("losses:", np.round(losses, 6))
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which not in ("mnist", "conv", "bert", "all"):
+        raise SystemExit(f"unknown fixture '{which}'; one of "
+                         f"mnist|conv|bert|all")
+    if which in ("mnist", "all"):
+        make_mnist()
+    if which in ("conv", "all"):
+        make_conv()
+    if which in ("bert", "all"):
+        make_bert()
 
 
 if __name__ == "__main__":
