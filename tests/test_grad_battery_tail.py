@@ -10,11 +10,11 @@ check_grad:170 — central finite differences of sum(output) vs the
 framework's analytic grad path (append_backward over the one-op
 program).
 
-Harness notes: unlike tests/op_test.py's check_grad, ONE executor and
-ONE forward program are reused across every FD evaluation, so each
-perturbed run is a compiled-cache hit — this keeps ~200 cases tractable.
-Inputs are tiny (≤ ~30 elements) and chosen away from kinks/ties so the
-FD quotient is meaningful.
+Harness notes: ONE executor and ONE forward program are reused across
+every FD evaluation (as op_test.py's check_grad also does since round
+5), so each perturbed run is a compiled-cache hit — this keeps ~200
+cases tractable. Inputs are tiny (≤ ~30 elements) and chosen away from
+kinks/ties so the FD quotient is meaningful.
 """
 import numpy as np
 import pytest

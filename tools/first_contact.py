@@ -7,11 +7,16 @@ prioritized so a SHORT window still banks the headline number first:
   1. flash_gate  — ONE flash config compile+parity (~1 min): validates
                    the current kernel layout lowers under Mosaic before
                    anything depends on it
-  2. bert        — bench.py bert (headline samples/s + MFU)
-  3. mfu_bert    — tools/mfu_report.py bert (XLA cost-analysis MFU)
-  4. flash_sweep — bench.py flash (resumable block sweep; banks rows)
-  5. resnet      — bench.py resnet
-  6. mnist       — bench.py mnist (host-overhead trend row)
+  2. bert        — bench.py bert (headline samples/s + MFU; cold
+                   compile, seeds the .xla_cache executable cache)
+  3. bert_warm   — bench.py bert AGAIN in a fresh process: banks the
+                   executable-cache-reload proof (xla_cache_entries_
+                   before > 0, compile_s collapsed) for the fluid
+                   entrypoint, plus a second timing sample
+  4. mfu_bert    — tools/mfu_report.py bert (XLA cost-analysis MFU)
+  5. flash_sweep — bench.py flash (resumable block sweep; banks rows)
+  6. resnet      — bench.py resnet
+  7. mnist       — bench.py mnist (host-overhead trend row)
 
 Every stage runs in a SUBPROCESS with its own timeout (a hung tunnel
 cannot take the plan down) and its one-line JSON result is appended to
@@ -100,8 +105,8 @@ def probe_alive(timeout=90):
 
 
 def main():
-    stages = ["flash_gate", "bert", "mfu_bert", "flash_sweep", "resnet",
-              "mnist"]
+    stages = ["flash_gate", "bert", "bert_warm", "mfu_bert",
+              "flash_sweep", "resnet", "mnist"]
     argv = sys.argv[1:]
     for i, a in enumerate(argv):
         if a == "--stages" and i + 1 < len(argv):
@@ -127,7 +132,14 @@ def main():
             results[s] = run_stage(
                 s, [py, "-c", GATE_CODE.format(repo=REPO)], 600,
                 parse_prefix="ROW=")
-        elif s == "bert":
+        elif s in ("bert", "bert_warm"):
+            if s == "bert_warm":
+                cold = results.get("bert")
+                if cold is not None and not cold.get("ok"):
+                    # nothing seeded the cache; identical command would
+                    # fail identically and burn window time
+                    bank(s, {"ok": False, "error": "skipped: bert failed"})
+                    continue
             results[s] = run_stage(s, [py, "bench.py", "bert"], 1800)
         elif s == "mfu_bert":
             results[s] = run_stage(s, [py, "-m", "tools.mfu_report",
