@@ -355,3 +355,62 @@ def test_pipeline_fallback_on_batch_aligned_closure():
                       "m": np.ones((8, WIDTH), "float32")},
                 fetch_list=[loss], mesh=pipeline_mesh(N_STAGES))
     assert np.isfinite(np.asarray(l)).all()
+
+
+def test_gpipe_het_matches_sequential():
+    """gpipe_het with shape-changing stages (widths 8->16->12->4->6) must
+    match running the stages sequentially, forward and backward — the
+    flat ring buffer + lax.switch schedule is numerically transparent."""
+    from paddle_tpu.parallel.pipeline import gpipe_het
+
+    r = np.random.RandomState(0)
+    widths = [8, 16, 12, 4, 6]
+    params, fns = [], []
+    for i in range(4):
+        w = jnp.asarray(r.normal(size=(widths[i], widths[i + 1])) * 0.3,
+                        jnp.float32)
+        b = jnp.asarray(r.normal(size=(widths[i + 1],)) * 0.1, jnp.float32)
+        params.append({"w": w, "b": b})
+        fns.append(lambda p, x: jnp.tanh(x @ p["w"] + p["b"]))
+    mesh = pipeline_mesh(4)
+    xs = jnp.asarray(r.normal(size=(4, 2, 8)), jnp.float32)
+
+    ys = gpipe_het(fns, params, xs, mesh=mesh)
+    ref = xs
+    for p in params:
+        ref = jnp.tanh(ref @ p["w"] + p["b"])
+    assert ys.shape == (4, 2, 6)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+    def loss_pipe(params, xs):
+        return jnp.sum(gpipe_het(fns, params, xs, mesh=mesh) ** 2)
+
+    def loss_ref(params, xs):
+        h = xs
+        for p in params:
+            h = jnp.tanh(h @ p["w"] + p["b"])
+        return jnp.sum(h ** 2)
+
+    gp, gx = jax.grad(loss_pipe, argnums=(0, 1))(params, xs)
+    gr, gxr = jax.grad(loss_ref, argnums=(0, 1))(params, xs)
+    for a, b in zip(jax.tree_util.tree_leaves((gp, gx)),
+                    jax.tree_util.tree_leaves((gr, gxr))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_gpipe_het_rejects_bad_arity_and_dtype():
+    """Stage-count mismatch vs the pp axis and dtype-changing stages are
+    explicit errors, not silent mis-schedules."""
+    from paddle_tpu.parallel.pipeline import gpipe_het
+
+    mesh = pipeline_mesh(4)
+    xs = jnp.zeros((2, 2, 8), jnp.float32)
+    fns2 = [lambda p, x: x] * 2
+    with pytest.raises(ValueError, match="pp axis size"):
+        gpipe_het(fns2, [None] * 2, xs, mesh=mesh)
+    fns_cast = [lambda p, x: x.astype(jnp.bfloat16)] + \
+        [lambda p, x: x] * 3
+    with pytest.raises(ValueError, match="dtype"):
+        gpipe_het(fns_cast, [None] * 4, xs, mesh=mesh)
