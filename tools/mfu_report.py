@@ -70,19 +70,23 @@ def report(model="bert", steps=None, trace_dir=None):
             batch, seq_len = 256, 128
         main, startup, feeds, fetches = bert.build_bert_pretrain_program(
             cfg, seq_len=seq_len, dropout=0.0, lr=1e-4)
-        rng_np = np.random.RandomState(0)
-        n_mask = max(1, int(batch * seq_len * 0.15))
-        feed = {
-            "src_ids": rng_np.randint(0, cfg["vocab_size"],
-                                      (batch, seq_len)).astype("int64"),
-            "pos_ids": np.tile(np.arange(seq_len),
-                               (batch, 1)).astype("int64"),
-            "sent_ids": np.zeros((batch, seq_len), "int64"),
-            "mask_pos": rng_np.randint(0, batch * seq_len,
-                                       (n_mask, 1)).astype("int64"),
-            "mask_label": rng_np.randint(0, cfg["vocab_size"],
-                                         (n_mask, 1)).astype("int64"),
-        }
+
+        def bert_feed(b):
+            rng_np = np.random.RandomState(0)
+            n_mask = max(1, int(b * seq_len * 0.15))
+            return {
+                "src_ids": rng_np.randint(0, cfg["vocab_size"],
+                                          (b, seq_len)).astype("int64"),
+                "pos_ids": np.tile(np.arange(seq_len),
+                                   (b, 1)).astype("int64"),
+                "sent_ids": np.zeros((b, seq_len), "int64"),
+                "mask_pos": rng_np.randint(0, b * seq_len,
+                                           (n_mask, 1)).astype("int64"),
+                "mask_label": rng_np.randint(0, cfg["vocab_size"],
+                                             (n_mask, 1)).astype("int64"),
+            }
+
+        feed = bert_feed(batch)
         fetch_list = fetches
     else:
         batch = 64
@@ -100,12 +104,31 @@ def report(model="bert", steps=None, trace_dir=None):
                 "label": rng_np.randint(0, 10, (batch, 1)).astype("int64")}
         fetch_list = [loss]
 
-    exe = fluid.Executor()
-    scope = core.Scope()
+    from bench import _is_oom
+
+    # OOM ladder (bench.py's): land a number, not an OOM. Every attempt
+    # gets a FRESH executor+scope with startup re-run: the step is jitted
+    # with donated state, so a failed run leaves the old scope's param
+    # buffers deleted — retrying on it would die on "Array has been
+    # deleted" instead of recovering.
+    while True:
+        exe = fluid.Executor()
+        scope = core.Scope()
+        try:
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                exe.run(main, feed=feed, fetch_list=fetch_list,
+                        return_numpy=False)  # compile + cache
+            break
+        except Exception as e:  # noqa: BLE001 — OOM shapes vary
+            if not _is_oom(e) or model != "bert" or batch <= 8:
+                raise
+            batch //= 2
+            print(f"mfu_report: OOM, retrying at batch {batch}",
+                  file=sys.stderr)
+            feed = bert_feed(batch)
+
     with fluid.scope_guard(scope):
-        exe.run(startup)
-        exe.run(main, feed=feed, fetch_list=fetch_list,
-                return_numpy=False)          # compile + cache
         cb = compiled_step_of(exe)
         feed_arrays = {k: core._to_device_array(v)
                        for k, v in feed.items()}
