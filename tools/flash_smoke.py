@@ -199,7 +199,9 @@ def sweep_plan(on_tpu):
     """The full config list, as (S, bq, bk, causal, dropout) tuples."""
     plan = []
     if on_tpu:
-        seqs, blocks = [512, 1024, 2048], [128, 256, 512]
+        # 128/256 first: the headline bench (bert seq_len=128, D=64)
+        # must get a tuned row even if the window closes mid-sweep
+        seqs, blocks = [128, 256, 512, 1024, 2048], [128, 256, 512]
         dchecks = [(512, 128, 128)]
     else:
         seqs, blocks = [128, 256], [64, 128]
