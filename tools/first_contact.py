@@ -13,10 +13,14 @@ prioritized so a SHORT window still banks the headline number first:
                    executable-cache-reload proof (xla_cache_entries_
                    before > 0, compile_s collapsed) for the fluid
                    entrypoint, plus a second timing sample
-  4. mfu_bert    — tools/mfu_report.py bert (XLA cost-analysis MFU)
-  5. flash_sweep — bench.py flash (resumable block sweep; banks rows)
-  6. resnet      — bench.py resnet
-  7. mnist       — bench.py mnist (host-overhead trend row)
+  4. bert_b512   — bench.py bert at PADDLE_TPU_BENCH_BATCH=512: the
+                   upward MFU probe (bigger batch = better MXU
+                   utilization if it fits; the OOM ladder walks back
+                   down if it doesn't)
+  5. mfu_bert    — tools/mfu_report.py bert (XLA cost-analysis MFU)
+  6. flash_sweep — bench.py flash (resumable block sweep; banks rows)
+  7. resnet      — bench.py resnet
+  8. mnist       — bench.py mnist (host-overhead trend row)
 
 Every stage runs in a SUBPROCESS with its own timeout (a hung tunnel
 cannot take the plan down) and its one-line JSON result is appended to
@@ -54,12 +58,14 @@ def bank(stage, payload):
     return rec
 
 
-def run_stage(stage, argv, timeout, parse_prefix=None):
+def run_stage(stage, argv, timeout, parse_prefix=None, extra_env=None):
     t0 = time.time()
+    env = os.environ.copy()
+    if extra_env:
+        env.update(extra_env)
     try:
         out = subprocess.run(argv, capture_output=True, text=True,
-                             timeout=timeout, cwd=REPO,
-                             env=os.environ.copy())
+                             timeout=timeout, cwd=REPO, env=env)
     except subprocess.TimeoutExpired:
         return bank(stage, {"ok": False, "error": f"timeout {timeout}s",
                             "wall_s": round(time.time() - t0, 1)})
@@ -105,7 +111,7 @@ def probe_alive(timeout=90):
 
 
 def main():
-    stages = ["flash_gate", "bert", "bert_warm", "mfu_bert",
+    stages = ["flash_gate", "bert", "bert_warm", "bert_b512", "mfu_bert",
               "flash_sweep", "resnet", "mnist"]
     argv = sys.argv[1:]
     for i, a in enumerate(argv):
@@ -132,15 +138,25 @@ def main():
             results[s] = run_stage(
                 s, [py, "-c", GATE_CODE.format(repo=REPO)], 600,
                 parse_prefix="ROW=")
-        elif s in ("bert", "bert_warm"):
-            if s == "bert_warm":
+        elif s in ("bert", "bert_warm", "bert_b512"):
+            if s != "bert":
                 cold = results.get("bert")
                 if cold is not None and not cold.get("ok"):
-                    # nothing seeded the cache; identical command would
-                    # fail identically and burn window time
+                    # nothing seeded the cache; a rerun/bigger batch
+                    # would fail identically and burn window time
                     bank(s, {"ok": False, "error": "skipped: bert failed"})
                     continue
-            results[s] = run_stage(s, [py, "bench.py", "bert"], 1800)
+                if s == "bert_b512" and cold is not None and \
+                        (cold.get("result") or {}).get("cpu_smoke"):
+                    # tunnel died mid-window and bert fell back to the
+                    # CPU smoke config — a batch-512 CPU row is noise
+                    bank(s, {"ok": False,
+                             "error": "skipped: bert ran cpu_smoke"})
+                    continue
+            env = {"PADDLE_TPU_BENCH_BATCH": "512"} \
+                if s == "bert_b512" else None
+            results[s] = run_stage(s, [py, "bench.py", "bert"], 1800,
+                                   extra_env=env)
         elif s == "mfu_bert":
             results[s] = run_stage(s, [py, "-m", "tools.mfu_report",
                                        "bert"], 1800)
