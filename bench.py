@@ -206,9 +206,12 @@ def bench_bert_base(batch=256, seq_len=128, steps=20, warmup=5):
         batch, seq_len, steps, warmup = 8, 64, 3, 1
     # in-window iteration knobs (first_contact's bert_b512 stage, manual
     # MFU ladder work): override the measured config without edits —
-    # the OOM ladder still walks DOWN from the override
-    batch = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", batch))
-    seq_len = int(os.environ.get("PADDLE_TPU_BENCH_SEQ", seq_len))
+    # the OOM ladder still walks DOWN from the override. Ignored in CPU
+    # smoke (a tunnel dying between stages must not produce a batch-512
+    # row over the shrunken smoke config)
+    if not smoke:
+        batch = int(os.environ.get("PADDLE_TPU_BENCH_BATCH", batch))
+        seq_len = int(os.environ.get("PADDLE_TPU_BENCH_SEQ", seq_len))
     # PADDLE_TPU_BENCH_RECOMPUTE=1: per-layer activation remat — if the
     # default batch OOMs, this usually buys it back for ~1/3 extra FLOPs
     # (often a better MFU trade than halving the batch)

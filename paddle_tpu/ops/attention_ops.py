@@ -17,8 +17,9 @@ import jax
 import jax.numpy as jnp
 
 from .registry import register_op, register_grad_maker, first, out
-from .pallas.flash_attention import (flash_attention, _pallas_ok,
-                                     _ref_attention)
+from .math_ops import mxu_available as _mxu_backend
+from .pallas.flash_attention import flash_attention, _pallas_ok, \
+    _ref_attention
 
 
 def _keypad_bias(bias, q, k):
@@ -66,6 +67,14 @@ def _fused_attention_qkv(ins, attrs):
     h = attrs.get("num_heads", 1)
     d = q.shape[-1] // h
     sm_scale = 1.0 / math.sqrt(d)
+    out_dtype = q.dtype
+    from ..fluid import core as _core
+    if _core.globals_["FLAGS_use_bf16_matmul"] and q.dtype == jnp.float32 \
+            and _mxu_backend():
+        # MXU-native attention (same contract as _mm in math_ops): bf16
+        # QK^T/PV matmuls — softmax statistics stay f32 inside both the
+        # flash kernel and the einsum path; output restored to f32
+        q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))
     qh, kh, vh = (_split_heads(t, h) for t in (q, k, v))
     causal = attrs.get("causal", False)
     drop = float(attrs.get("dropout_rate", 0.0) or 0.0)
@@ -94,7 +103,7 @@ def _fused_attention_qkv(ins, attrs):
             keep = jax.random.bernoulli(attrs["_rng"], 1.0 - drop, p.shape)
             p = jnp.where(keep, p / (1.0 - drop), 0.0).astype(p.dtype)
         o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
-    return out(Out=_merge_heads(o))
+    return out(Out=_merge_heads(o).astype(out_dtype))
 
 
 @register_op("multihead_matmul", inputs=("Input", "W", "Bias", "BiasQK"),

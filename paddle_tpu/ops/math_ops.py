@@ -69,10 +69,20 @@ _register_elementwise("elementwise_floordiv", lambda x, y: x // y)
 # --------------------------------------------------------------------------
 # mul / matmul / bmm / dot  (MXU-bound ops — keep as single dot_generals)
 # --------------------------------------------------------------------------
+def mxu_available():
+    """ONE bf16-matmul gate for every FLAGS_use_bf16_matmul consumer
+    (mul/matmul here, conv in nn_ops, fused attention): bf16 only pays
+    off where there IS an MXU — on CPU the emulation is a ~2.5x
+    pessimization (measured on the bert smoke bench)."""
+    from .pallas.flash_attention import _on_tpu
+    return _on_tpu()
+
+
 def _mm(a, b):
     """MXU matmul honoring FLAGS_use_bf16_matmul (bf16 inputs, f32 accum)."""
     from ..fluid import core as _core
-    if _core.globals_["FLAGS_use_bf16_matmul"] and a.dtype == jnp.float32:
+    if _core.globals_["FLAGS_use_bf16_matmul"] and a.dtype == jnp.float32 \
+            and mxu_available():
         return jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
                           preferred_element_type=jnp.float32)
     return jnp.matmul(a, b)

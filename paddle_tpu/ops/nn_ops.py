@@ -493,8 +493,10 @@ def _conv2d(ins, attrs):
                          attrs.get("padding_algorithm", "EXPLICIT"),
                          2, w.shape[2:], strides, dil, spatial)
     from ..fluid import core as _core
+    from .math_ops import mxu_available
     orig_dtype = x.dtype
-    if _core.globals_["FLAGS_use_bf16_matmul"] and x.dtype == jnp.float32:
+    if _core.globals_["FLAGS_use_bf16_matmul"] and x.dtype == jnp.float32 \
+            and mxu_available():
         # bf16 in AND out: a mixed-dtype conv (preferred_element_type=f32)
         # has no transpose rule in this jax version, which breaks the
         # generic vjp grad path; the MXU still accumulates in f32

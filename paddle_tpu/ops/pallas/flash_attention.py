@@ -98,6 +98,15 @@ def _on_tpu() -> bool:
         return False
 
 
+def _mxu_operand(x):
+    """Dot operand in MXU-native dtype: bf16 tiles feed the MXU dots
+    directly (f32 accumulation comes from preferred_element_type), which
+    runs at full systolic-array rate; anything else upcasts to f32. The
+    softmax statistics (m/l/lse/delta) and accumulators stay f32 either
+    way."""
+    return x if x.dtype == jnp.bfloat16 else x.astype(jnp.float32)
+
+
 def _mask_cols(s, k_start, blk_q, blk_k, sk_len):
     cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
     return jnp.where(cols < sk_len, s, NEG_INF)
@@ -209,8 +218,8 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
     k_start = ki * blk_k
 
     def _block():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+        q = _mxu_operand(q_ref[0])
+        k = _mxu_operand(k_ref[0])
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [blk_q, blk_k]
@@ -238,11 +247,11 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
             keep = _keep_mask(seed_ref[0], bh, q_start, k_start,
                               blk_q, blk_k, dropout_rate)
             p = p * keep.astype(p.dtype) / (1.0 - dropout_rate)
-        v = v_ref[0].astype(jnp.float32)
+        v = _mxu_operand(v_ref[0])
         if sk_len:
             v = _zero_pad_rows(v, k_start, sk_len)
         acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -333,10 +342,10 @@ def _bwd_kv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     k_start = ki * blk_k
 
     def _block():
-        q = q_ref[0].astype(jnp.float32)
-        kk = k_ref[0].astype(jnp.float32)
-        vv = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = _mxu_operand(q_ref[0])
+        kk = _mxu_operand(k_ref[0])
+        vv = _mxu_operand(v_ref[0])
+        do = _mxu_operand(do_ref[0])
         if s_len:
             q = _zero_pad_rows(q, q_start, s_len)
             do = _zero_pad_rows(do, q_start, s_len)
@@ -370,7 +379,7 @@ def _bwd_kv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         else:
             p_eff = p
         dv_acc[...] += jax.lax.dot_general(
-            p_eff, do, (((0,), (0,)), ((), ())),
+            p_eff.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # p'ᵀ·dO
         ds = p * (dp - delta) * sm_scale
         if s_len:
@@ -378,7 +387,7 @@ def _bwd_kv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             ds = jnp.where(_valid_rows(q_start, blk_q, blk_k, s_len),
                            ds, 0.0)
         dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # dsᵀ·Q
 
     if causal:
@@ -409,10 +418,10 @@ def _bwd_q_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     k_start = ki * blk_k
 
     def _block():
-        q = q_ref[0].astype(jnp.float32)
-        kk = k_ref[0].astype(jnp.float32)
-        vv = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = _mxu_operand(q_ref[0])
+        kk = _mxu_operand(k_ref[0])
+        vv = _mxu_operand(v_ref[0])
+        do = _mxu_operand(do_ref[0])
         if sk_len:
             kk = _zero_pad_rows(kk, k_start, sk_len)
             vv = _zero_pad_rows(vv, k_start, sk_len)
@@ -438,7 +447,8 @@ def _bwd_q_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                               dropout_rate).astype(jnp.float32)
             dp = dp * keep / (1.0 - dropout_rate)
         ds = p * (dp - delta) * sm_scale
-        dq_acc[...] += jnp.dot(ds, kk, preferred_element_type=jnp.float32)
+        dq_acc[...] += jnp.dot(ds.astype(kk.dtype), kk,
+                               preferred_element_type=jnp.float32)
 
     if causal:
         @pl.when(k_start <= q_start + blk_q - 1)
@@ -660,6 +670,12 @@ def flash_attention(q, k, v, sm_scale, causal=False, dropout_rate=0.0,
         raise ValueError(
             "flash_attention: dropout_rate > 0 requires dropout_seed "
             "(int32 [1] array, fresh per training step)")
+    if not (q.dtype == k.dtype == v.dtype):
+        # the in-kernel MXU-native dots require matching operand dtypes
+        # (bf16 tiles are fed to the MXU unconverted) — normalize mixed
+        # inputs up front instead of failing inside the kernel trace
+        ct = jnp.result_type(q.dtype, k.dtype, v.dtype)
+        q, k, v = (t.astype(ct) for t in (q, k, v))
     if _pallas_ok(q, k):
         if dropout_seed is None:
             dropout_seed = _ZERO_SEED

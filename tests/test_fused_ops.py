@@ -340,3 +340,46 @@ def test_multihead_matmul_generic_bias_keeps_einsum(monkeypatch):
              "BiasQK": [bias_qk]},
             {"head_number": H, "alpha": 1.0 / np.sqrt(D)})["Out"][0])
     assert np.isfinite(o).all()
+
+
+def test_fused_attention_bf16_matmul_flag(monkeypatch):
+    """FLAGS_use_bf16_matmul casts the attention matmuls to bf16 (MXU
+    native rate — same contract as math_ops._mm) while keeping the f32
+    output dtype; result stays inside bf16 tolerance of the f32 path,
+    and gradients still flow. The cast is gated to non-CPU backends
+    (emulated bf16 is a pessimization without an MXU), so the test
+    spoofs a TPU backend to exercise it."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.fluid import core
+    from paddle_tpu.ops.registry import OPS
+
+    r = np.random.RandomState(3)
+    B, S, H, D = 2, 16, 2, 8
+    q, k, v = (jnp.asarray(r.normal(size=(B, S, H * D)) * 0.5, jnp.float32)
+               for _ in range(3))
+    kern = OPS.get("fused_attention_qkv").kernel
+    attrs = {"num_heads": H, "dropout_rate": 0.0, "causal": False}
+    ref = np.asarray(kern({"Q": [q], "K": [k], "V": [v], "Bias": [None]},
+                          dict(attrs))["Out"][0])
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    prev = core.globals_["FLAGS_use_bf16_matmul"]
+    core.set_flag("FLAGS_use_bf16_matmul", True)
+    from paddle_tpu.ops import attention_ops as ao
+    monkeypatch.setattr(ao, "_mxu_backend", lambda: True)
+    try:
+        with fa.interpret_guard():  # spoofed TPU backend, CPU execution
+            got = kern({"Q": [q], "K": [k], "V": [v], "Bias": [None]},
+                       dict(attrs))["Out"][0]
+            assert got.dtype == jnp.float32  # output dtype contract kept
+            np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-2,
+                                       atol=2e-2)
+
+            def loss(q_):
+                return jnp.sum(kern(
+                    {"Q": [q_], "K": [k], "V": [v], "Bias": [None]},
+                    dict(attrs))["Out"][0] ** 2)
+            g = jax.grad(loss)(q)
+            assert np.isfinite(np.asarray(g)).all() and np.abs(g).max() > 0
+    finally:
+        core.set_flag("FLAGS_use_bf16_matmul", prev)
