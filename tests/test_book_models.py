@@ -316,13 +316,10 @@ def test_lenet_conv_golden_trajectory_parity():
     np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
 
 
-def test_encoder_golden_trajectory_parity():
-    """Attention-path golden oracle (VERDICT r04 item 6): one
-    transformer encoder layer (2-head fused attention, gelu FFN, two
-    layer_norms) under MSE + SGD must reproduce the torch-float64
-    8-step loss trajectory (tools/make_golden_trajectory.py bert).
-    Catches numeric drift in the fused-attention/layernorm/gelu grad
-    paths the BERT bench rides."""
+def _run_encoder_golden(fixture, make_optimizer, prefix):
+    """Shared encoder-layer golden harness: build the single-layer
+    transformer against the fixture's init, train len(losses) steps
+    with make_optimizer(), return (got, golden)."""
     import os
     import numpy as np
     import paddle_tpu.fluid as fluid
@@ -330,12 +327,12 @@ def test_encoder_golden_trajectory_parity():
     from paddle_tpu.models.bert import fused_multihead_attention
 
     fx = np.load(os.path.join(os.path.dirname(__file__), "fixtures",
-                              "golden_encoder_trajectory.npz"))
+                              fixture))
     golden = fx["losses"]
     ini = fluid.initializer.NumpyArrayInitializer
 
     def pa(key):
-        return fluid.ParamAttr(name=f"ge_{key}",
+        return fluid.ParamAttr(name=f"{prefix}_{key}",
                                initializer=ini(fx[key].astype("float32")))
 
     H = fx["wq"].shape[0]
@@ -365,7 +362,7 @@ def test_encoder_golden_trajectory_parity():
             param_attr=pa("g2"), bias_attr=pa("e2"))
         loss = fluid.layers.mean(fluid.layers.square(
             fluid.layers.elementwise_sub(out2, t)))
-        fluid.optimizer.SGD(0.05).minimize(loss)
+        make_optimizer().minimize(loss)
 
     exe = fluid.Executor()
     scope = core.Scope()
@@ -378,4 +375,37 @@ def test_encoder_golden_trajectory_parity():
                                  "t": fx["T"].astype("float32")},
                            fetch_list=[loss])
             got.append(float(np.asarray(l).ravel()[0]))
+    return got, golden
+
+
+def test_encoder_golden_trajectory_parity():
+    """Attention-path golden oracle (VERDICT r04 item 6): one
+    transformer encoder layer (2-head fused attention, gelu FFN, two
+    layer_norms) under MSE + SGD must reproduce the torch-float64
+    8-step loss trajectory (tools/make_golden_trajectory.py bert).
+    Catches numeric drift in the fused-attention/layernorm/gelu grad
+    paths the BERT bench rides."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+
+    got, golden = _run_encoder_golden(
+        "golden_encoder_trajectory.npz",
+        lambda: fluid.optimizer.SGD(0.05), "ge")
+    np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_encoder_adam_golden_trajectory_parity():
+    """Optimizer-path golden oracle: the same encoder layer under ADAM
+    (the bench optimizer) must reproduce the hand-rolled paddle-formula
+    Adam trajectory (tools/make_golden_trajectory.py bert_adam — pow
+    accumulators start at beta, eps scales by sqrt(1-b2^t)). Catches
+    numeric drift in the adam op and its accumulator wiring, which the
+    SGD oracles can't see."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+
+    got, golden = _run_encoder_golden(
+        "golden_encoder_adam_trajectory.npz",
+        lambda: fluid.optimizer.Adam(0.01, beta1=0.9, beta2=0.999,
+                                     epsilon=1e-8), "gea")
     np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)

@@ -157,25 +157,30 @@ def enc_init(seed=777):
     }
 
 
-def make_bert():
+ENC_NAMES = ("wq", "bq", "wk", "bk", "wv", "bv", "wo", "bo",
+             "g1", "e1", "w1", "b1", "w2", "b2", "g2", "e2")
+
+
+def _enc_trajectory(update):
+    """Run the encoder-layer model for ENC['STEPS'] steps, calling
+    ``update(params_dict, step)`` UNDER torch.no_grad after each
+    backward. Returns (init_dict, losses)."""
     import math
 
     import torch
     import torch.nn.functional as F
     p = enc_init()
-    B, S, H, HEADS, STEPS, LR = (ENC[k] for k in
-                                 ("B", "S", "H", "HEADS", "STEPS", "LR"))
+    B, S, H, HEADS, STEPS = (ENC[k] for k in
+                             ("B", "S", "H", "HEADS", "STEPS"))
     D = H // HEADS
-    names = ("wq", "bq", "wk", "bk", "wv", "bv", "wo", "bo",
-             "g1", "e1", "w1", "b1", "w2", "b2", "g2", "e2")
-    t = {k: torch.tensor(p[k], requires_grad=True) for k in names}
+    t = {k: torch.tensor(p[k], requires_grad=True) for k in ENC_NAMES}
     X, T = torch.tensor(p["X"]), torch.tensor(p["T"])
 
     def heads(x):  # [B,S,H] -> [B,HEADS,S,D]
         return x.reshape(B, S, HEADS, D).permute(0, 2, 1, 3)
 
     losses = []
-    for _ in range(STEPS):
+    for step in range(STEPS):
         q, k, v = (heads(X @ t[f"w{n}"] + t[f"b{n}"]) for n in "qkv")
         scores = (q @ k.transpose(-1, -2)) / math.sqrt(D)
         ctx = F.softmax(scores, dim=-1) @ v
@@ -191,26 +196,71 @@ def make_bert():
             v_.grad = None
         loss.backward()
         with torch.no_grad():
-            for v_ in t.values():
-                v_ -= LR * v_.grad
-    path = os.path.join(FIXDIR, "golden_encoder_trajectory.npz")
+            update(t, step)
+    return p, losses
+
+
+def _write_enc_fixture(name, p, losses):
+    path = os.path.join(FIXDIR, name)
     np.savez(path, losses=np.asarray(losses, np.float64),
-             X=p["X"], T=p["T"], **{k: p[k] for k in names})
+             X=p["X"], T=p["T"], **{k: p[k] for k in ENC_NAMES})
     print("wrote", path)
     print("losses:", np.round(losses, 6))
 
 
+def make_bert():
+    LR = ENC["LR"]
+
+    def sgd(t, step):
+        for v_ in t.values():
+            v_ -= LR * v_.grad
+    p, losses = _enc_trajectory(sgd)
+    _write_enc_fixture("golden_encoder_trajectory.npz", p, losses)
+
+
+ADAM = dict(LR=0.01, B1=0.9, B2=0.999, EPS=1e-8)
+
+
+def make_bert_adam():
+    """Same encoder model under Adam with the PADDLE update semantics
+    (operators/optimizers/adam_op.h contract, mirrored by
+    paddle_tpu/ops/optimizer_ops.py:46): pow accumulators START at
+    beta (so step 1 corrects by 1-beta^1), lr_t = lr*sqrt(1-b2p)/(1-b1p),
+    and epsilon scales by sqrt(1-b2p) inside the denominator — this
+    differs from torch.optim.Adam's eps placement, so the update is
+    hand-rolled on torch's float64 grads."""
+    LR, B1, B2, EPS = ADAM["LR"], ADAM["B1"], ADAM["B2"], ADAM["EPS"]
+    state = {}
+
+    def adam(t, step):
+        import torch
+        for k, v_ in t.items():
+            if k not in state:
+                state[k] = [torch.zeros_like(v_), torch.zeros_like(v_)]
+            m, v2 = state[k]
+            g = v_.grad
+            m.mul_(B1).add_(g, alpha=1 - B1)
+            v2.mul_(B2).addcmul_(g, g, value=1 - B2)
+            b1p, b2p = B1 ** (step + 1), B2 ** (step + 1)
+            lr_t = LR * np.sqrt(1 - b2p) / (1 - b1p)
+            v_ -= lr_t * m / (v2.sqrt() + EPS * np.sqrt(1 - b2p))
+    p, losses = _enc_trajectory(adam)
+    _write_enc_fixture("golden_encoder_adam_trajectory.npz", p, losses)
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    if which not in ("mnist", "conv", "bert", "all"):
+    if which not in ("mnist", "conv", "bert", "bert_adam", "all"):
         raise SystemExit(f"unknown fixture '{which}'; one of "
-                         f"mnist|conv|bert|all")
+                         f"mnist|conv|bert|bert_adam|all")
     if which in ("mnist", "all"):
         make_mnist()
     if which in ("conv", "all"):
         make_conv()
     if which in ("bert", "all"):
         make_bert()
+    if which in ("bert_adam", "all"):
+        make_bert_adam()
 
 
 if __name__ == "__main__":
