@@ -17,7 +17,7 @@ torch (CPU) is an independent oracle: none of paddle_tpu's executor,
 op registry, or JAX is involved in producing the fixtures.
 
 Regenerate with:
-    python tools/make_golden_trajectory.py [mnist|conv|bert|all]
+    python tools/make_golden_trajectory.py [mnist|conv|bert|bert_adam|all]
 """
 import os
 import sys
