@@ -409,3 +409,52 @@ def test_encoder_adam_golden_trajectory_parity():
         lambda: fluid.optimizer.Adam(0.01, beta1=0.9, beta2=0.999,
                                      epsilon=1e-8), "gea")
     np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_golden_trajectory_parity():
+    """Sparse-lookup golden oracle: embedding (lookup_table_v2, repeated
+    ids in-batch) → mean pool → fc softmax → cross-entropy under SGD
+    must reproduce the torch-float64 fixture
+    (tools/make_golden_trajectory.py embedding). Pins the gather
+    forward / scatter-add gradient path numerically."""
+    import os
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    fx = np.load(os.path.join(os.path.dirname(__file__), "fixtures",
+                              "golden_embedding_trajectory.npz"))
+    golden = fx["losses"]
+    ini = fluid.initializer.NumpyArrayInitializer
+    V, E = fx["ew"].shape
+    T = fx["IDS"].shape[1]
+    CLS = fx["fw"].shape[1]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", shape=[T], dtype="int64")
+        label = fluid.data("label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, [V, E],
+            param_attr=fluid.ParamAttr(
+                name="gemb_w", initializer=ini(fx["ew"].astype("float32"))))
+        pooled = fluid.layers.reduce_mean(emb, dim=1)
+        pred = fluid.layers.fc(
+            pooled, CLS, act="softmax",
+            param_attr=fluid.ParamAttr(
+                name="gemb_fw", initializer=ini(fx["fw"].astype("float32"))),
+            bias_attr=fluid.ParamAttr(
+                name="gemb_fb", initializer=ini(fx["fb"].astype("float32"))))
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(0.2).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = core.Scope()
+    got = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(len(golden)):
+            (l,) = exe.run(main, feed={"ids": fx["IDS"], "label": fx["Y"]},
+                           fetch_list=[loss])
+            got.append(float(np.asarray(l).ravel()[0]))
+    np.testing.assert_allclose(got, golden, rtol=1e-4, atol=1e-5)

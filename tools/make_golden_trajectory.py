@@ -17,7 +17,7 @@ torch (CPU) is an independent oracle: none of paddle_tpu's executor,
 op registry, or JAX is involved in producing the fixtures.
 
 Regenerate with:
-    python tools/make_golden_trajectory.py [mnist|conv|bert|bert_adam|all]
+    python tools/make_golden_trajectory.py [mnist|conv|bert|bert_adam|embedding|all]
 """
 import os
 import sys
@@ -248,11 +248,65 @@ def make_bert_adam():
     _write_enc_fixture("golden_encoder_adam_trajectory.npz", p, losses)
 
 
+# ------------------------------------------------------------- embedding
+EMB = dict(B=8, T=5, V=32, E=12, CLS=6, STEPS=10, LR=0.2)
+
+
+def emb_init(seed=2468):
+    r = np.random.RandomState(seed)
+    B, T, V, E, CLS = (EMB[k] for k in ("B", "T", "V", "E", "CLS"))
+    return {
+        "ew": (r.rand(V, E) * 0.4 - 0.2).astype(np.float64),
+        "fw": (r.rand(E, CLS) * 0.2 - 0.1).astype(np.float64),
+        "fb": np.zeros(CLS, np.float64),
+        # every id appears in the batch several times → the scatter-add
+        # grad path accumulates colliding rows, the case worth pinning
+        "IDS": r.randint(0, V, (B, T)).astype(np.int64),
+        "Y": r.randint(0, CLS, (B, 1)).astype(np.int64),
+    }
+
+
+def make_embedding():
+    """Sparse-lookup path oracle: embedding (lookup_table_v2) → mean
+    pool over time → fc softmax → cross-entropy, SGD. Pins the
+    gather fwd / scatter-add grad path (reference lookup_table_v2_op.cc
+    + its _grad), the last numeric family without a golden fixture."""
+    import torch
+    import torch.nn.functional as F
+    p = emb_init()
+    B, STEPS, LR = EMB["B"], EMB["STEPS"], EMB["LR"]
+    ew = torch.tensor(p["ew"], requires_grad=True)
+    fw = torch.tensor(p["fw"], requires_grad=True)
+    fb = torch.tensor(p["fb"], requires_grad=True)
+    ids = torch.tensor(p["IDS"])
+    yidx = torch.tensor(p["Y"][:, 0])
+    losses = []
+    for _ in range(STEPS):
+        emb = F.embedding(ids, ew)             # [B, T, E] gather
+        pooled = emb.mean(dim=1)               # [B, E]
+        logits = pooled @ fw + fb
+        probs = F.softmax(logits, dim=1)
+        loss = -torch.log(probs[torch.arange(B), yidx]).mean()
+        losses.append(float(loss))
+        for t in (ew, fw, fb):
+            t.grad = None
+        loss.backward()
+        with torch.no_grad():
+            for t in (ew, fw, fb):
+                t -= LR * t.grad
+    path = os.path.join(FIXDIR, "golden_embedding_trajectory.npz")
+    np.savez(path, losses=np.asarray(losses, np.float64),
+             **{k: p[k] for k in ("ew", "fw", "fb", "IDS", "Y")})
+    print("wrote", path)
+    print("losses:", np.round(losses, 6))
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    if which not in ("mnist", "conv", "bert", "bert_adam", "all"):
+    kinds = ("mnist", "conv", "bert", "bert_adam", "embedding")
+    if which not in kinds + ("all",):
         raise SystemExit(f"unknown fixture '{which}'; one of "
-                         f"mnist|conv|bert|bert_adam|all")
+                         f"{'|'.join(kinds)}|all")
     if which in ("mnist", "all"):
         make_mnist()
     if which in ("conv", "all"):
@@ -261,6 +315,8 @@ def main():
         make_bert()
     if which in ("bert_adam", "all"):
         make_bert_adam()
+    if which in ("embedding", "all"):
+        make_embedding()
 
 
 if __name__ == "__main__":
