@@ -12,6 +12,12 @@ but checked numerically, step for step, not as an accuracy bar):
   bert  — torch-float64 single transformer encoder layer (2-head
           attention, gelu FFN, two layer_norms, eps 1e-5) under an MSE
           loss, SGD, 8 steps. Pins the attention/layernorm/gelu paths.
+  bert_adam — the same encoder under hand-rolled paddle-formula Adam
+          (pow accumulators start at beta, eps scaled by sqrt(1-b2^t)).
+          Pins the adam op and accumulator wiring.
+  embedding — embedding (repeated in-batch ids) → mean pool → fc
+          softmax → cross_entropy, SGD, 10 steps. Pins the gather /
+          scatter-add sparse-lookup grad path.
 
 torch (CPU) is an independent oracle: none of paddle_tpu's executor,
 op registry, or JAX is involved in producing the fixtures.
