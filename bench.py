@@ -395,6 +395,9 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
     eps = ",".join(f"127.0.0.1:{free_port()}" for _ in range(n_pservers))
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+    # CPU-pinned workers must not pay the axon register() startup stall
+    # (~100s per process with a half-open tunnel)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     workers = []
     trainer_procs = []
     try:

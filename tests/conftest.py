@@ -10,6 +10,14 @@ import sys
 
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
+# The axon sitecustomize calls register() at EVERY python start when this
+# var is set; with the TPU tunnel half-open that blocks ~100s per process
+# (round-5 measurement). This process already paid the toll before
+# conftest ran — dropping the var here spares every SUBPROCESS the suite
+# spawns (launch tests, PS workers, native builds), which would otherwise
+# stack minutes of dead wait into the round-end gate. CPU-only suite, so
+# no TPU capability is lost.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import jax  # noqa: E402
 
