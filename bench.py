@@ -510,6 +510,83 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
                 w.kill()
 
 
+def bench_longctx(iters=8):
+    """Long-context attention lane (SURVEY §5: long-context is
+    first-class here — ring/Ulysses SP + flash kernels — where the
+    reference's v1.7 answer was LoD ragged batching). Two shapes:
+
+    TPU (one chip): causal Pallas flash attention fwd+bwd at S=8192,
+    bf16 — the single-chip long-sequence path, scan-timed so the tunnel
+    RTT stays out of the number.
+    CPU (virtual mesh): 8-device ring attention fwd+bwd, the
+    sequence-parallel path whose K/V blocks rotate over ppermute.
+
+    Reports tokens/s and attention-only achieved TFLOPs (causal fwd
+    2·B·H·S²·D multiply-adds ≈ 4·B·H·S²·D FLOPs halved for causality,
+    ×3.5 for fwd+bwd)."""
+    import jax
+    import jax.numpy as jnp
+    from tools.flash_smoke import _timed_scan
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # the CPU lane measures the 8-device ring — force the virtual
+        # mesh BEFORE the backend initializes (ambient XLA_FLAGS must
+        # not be a prerequisite; a 1-device "ring" never exercises the
+        # ppermute rotation this lane exists for)
+        try:
+            jax.config.update("jax_num_cpu_devices", 8)
+        except Exception:
+            pass  # backend already initialized (e.g. env-forced count)
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    rng = np.random.RandomState(0)
+    if on_tpu:
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        B, H, S, D = 1, 12, 8192, 64
+        dt_ = jnp.bfloat16
+        q, k, v = (jnp.asarray(rng.randn(B, H, S, D) * 0.3, dt_)
+                   for _ in range(3))
+        sm = 1.0 / float(np.sqrt(D))
+
+        def fwdbwd(q_, k_, v_):
+            def loss(q2, k2, v2):
+                return jnp.sum(
+                    flash_attention(q2, k2, v2, sm, causal=True)
+                    .astype(jnp.float32) ** 2)
+            l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(
+                q_, k_, v_)
+            return l + sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                           for g in grads)
+        ms = _timed_scan(fwdbwd, q, k, v, iters)
+        mode = "flash_causal_1chip"
+        n_dev = 1
+    else:
+        from paddle_tpu.parallel.ring_attention import (ring_attention,
+                                                        sequence_mesh)
+        n_dev = len(jax.devices())
+        mesh = sequence_mesh(n_dev)
+        B, H, D = 1, 4, 64
+        S = 512 * max(1, n_dev)
+        q, k, v = (jnp.asarray(rng.randn(B, H, S, D) * 0.3, jnp.float32)
+                   for _ in range(3))
+        sm = 1.0 / float(np.sqrt(D))
+
+        def fwdbwd(q_, k_, v_):
+            def loss(q2, k2, v2):
+                return jnp.sum(ring_attention(q2, k2, v2, sm, causal=True,
+                                              mesh=mesh) ** 2)
+            l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(
+                q_, k_, v_)
+            return l + sum(jnp.sum(g) for g in grads)
+        ms = _timed_scan(fwdbwd, q, k, v, iters)
+        mode = f"ring_sp{n_dev}_virtual"
+    flops = 4.0 * B * H * S * S * D / 2.0 * 3.5  # causal fwd+bwd
+    return {"metric": "longctx_attention_tokens_per_sec",
+            "value": round(B * S / (ms / 1e3), 1), "unit": "tokens/s",
+            "vs_baseline": 1.0, "seq_len": S, "heads": H, "head_dim": D,
+            "mode": mode, "devices": n_dev, "step_ms": round(ms, 3),
+            "attn_tflops": round(flops / (ms / 1e3) / 1e12, 3)}
+
+
 def bench_flash():
     """Pallas flash-attention Mosaic bring-up: compile (no interpret),
     parity vs einsum, block-size sweep. Per-config JSON rows go to
@@ -585,7 +662,7 @@ def main():
                "resnet": bench_resnet50, "allreduce": bench_allreduce_dp,
                "wide_deep": bench_wide_deep,
                "wide_deep_1b": bench_wide_deep_1b,
-               "flash": bench_flash}
+               "flash": bench_flash, "longctx": bench_longctx}
     if which not in benches:
         raise SystemExit(f"unknown bench '{which}'; one of "
                          f"{sorted(benches)}")

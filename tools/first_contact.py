@@ -20,7 +20,9 @@ prioritized so a SHORT window still banks the headline number first:
   5. mfu_bert    — tools/mfu_report.py bert (XLA cost-analysis MFU)
   6. flash_sweep — bench.py flash (resumable block sweep; banks rows)
   7. resnet      — bench.py resnet
-  8. mnist       — bench.py mnist (host-overhead trend row)
+  8. longctx     — bench.py longctx (flash causal S=8192 bf16 fwd+bwd —
+                   the single-chip long-context lane)
+  9. mnist       — bench.py mnist (host-overhead trend row)
 
 Every stage runs in a SUBPROCESS with its own timeout (a hung tunnel
 cannot take the plan down) and its one-line JSON result is appended to
@@ -112,7 +114,7 @@ def probe_alive(timeout=90):
 
 def main():
     stages = ["flash_gate", "bert", "bert_warm", "bert_b512", "mfu_bert",
-              "flash_sweep", "resnet", "mnist"]
+              "flash_sweep", "resnet", "longctx", "mnist"]
     argv = sys.argv[1:]
     for i, a in enumerate(argv):
         if a == "--stages" and i + 1 < len(argv):
@@ -168,6 +170,8 @@ def main():
             results[s] = run_stage(s, [py, "bench.py", "flash"], 2400)
         elif s == "resnet":
             results[s] = run_stage(s, [py, "bench.py", "resnet"], 1800)
+        elif s == "longctx":
+            results[s] = run_stage(s, [py, "bench.py", "longctx"], 900)
         elif s == "mnist":
             results[s] = run_stage(s, [py, "bench.py", "mnist"], 900)
         else:
