@@ -116,13 +116,16 @@ def _timed_steps(exe, main, feed, fetch_list, steps, warmup, mesh=None):
     return time.perf_counter() - t0
 
 
+LAST_FETCHES = None  # final-step fetch values of the last timed loop
+
+
 def _timed_steps_loop(exe, main, feed, fetch_list, steps, warmup,
                       mesh=None):
     """Per-step dispatch variant for MULTI-PROCESS benches whose sync
     plane barriers every step (the PS plane lock-steps subprocess
     trainers by run count — a scanned window would change trainer 0's
     barrier count and deadlock the plane)."""
-    global LAST_COMPILE_S
+    global LAST_COMPILE_S, LAST_FETCHES
     from paddle_tpu.fluid import core as _core
     _core.set_flag("FLAGS_feed_device_cache", True)
     for i in range(warmup):
@@ -136,6 +139,7 @@ def _timed_steps_loop(exe, main, feed, fetch_list, steps, warmup,
         out = exe.run(main, feed=feed, fetch_list=fetch_list, mesh=mesh,
                       return_numpy=False)
     _ = float(np.asarray(out[0].array).ravel()[0])  # sync
+    LAST_FETCHES = out
     return time.perf_counter() - t0
 
 
@@ -343,7 +347,13 @@ def bench_allreduce_dp(steps=10, warmup=3):
 def bench_wide_deep(batch=4096, steps=20, warmup=5):
     """Wide&Deep CTR train step, samples/sec (BASELINE.md sparse-scale row
     scaled to one chip: dense embeddings + MLP compile into the jitted
-    step; the beyond-HBM table path is exercised by the PS tests)."""
+    step; the beyond-HBM table path is exercised by the PS tests).
+
+    The AUC metric op stays IN the train program: the segmented executor
+    compiles fwd+bwd+update as jitted segments around the stateful auc
+    island, instead of de-compiling the whole block (the pre-r6
+    interpreter cliff). The row carries compiled_metric: true when that
+    path actually served the run."""
     import jax
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import core
@@ -362,11 +372,19 @@ def bench_wide_deep(batch=4096, steps=20, warmup=5):
     feed = nb()
     with fluid.scope_guard(scope):
         exe.run(startup)
-        dt = _timed_steps(exe, main, feed, [loss], steps, warmup)
+        # per-step dispatch: the segmented step runs its islands host-side
+        # each step, so the scanned window doesn't apply
+        dt = _timed_steps_loop(exe, main, feed, [loss, auc], steps, warmup)
+        # streaming AUC after the timed window's final step (no extra
+        # training step just to read the metric)
+        auc_val = float(np.asarray(LAST_FETCHES[1].array).ravel()[0])
     return {"metric": "wide_deep_ctr_samples_per_sec_per_chip",
             "value": round(batch * steps / dt, 1), "unit": "samples/s",
             "vs_baseline": 1.0, "batch": batch,
-            "embedding_params": int(26 * 1e6 * 16 + 26 * 1e6)}
+            "embedding_params": int(26 * 1e6 * 16 + 26 * 1e6),
+            "compiled_metric": exe._last_run_mode == "segmented",
+            "executor_mode": exe._last_run_mode,
+            "auc": round(auc_val, 4)}
 
 
 def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
@@ -493,7 +511,12 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
                 "value": round(total_sps, 1), "unit": "samples/s",
                 "vs_baseline": 1.0, "batch": batch,
                 "embedding_params": int(emb_params),
-                "pservers": n_pservers, "trainers": n_trainers}
+                "pservers": n_pservers, "trainers": n_trainers,
+                # the AUC op rides in-graph: fwd+bwd+update run as
+                # compiled jitted segments around the stateful islands
+                # (auc + RPC ops) instead of the whole-block interpreter
+                "compiled_metric": exe._last_run_mode == "segmented",
+                "executor_mode": exe._last_run_mode}
     finally:
         try:
             from paddle_tpu.fluid.ps_rpc import VarClient
@@ -563,6 +586,19 @@ def bench_longctx(iters=8):
         from paddle_tpu.parallel.ring_attention import (ring_attention,
                                                         sequence_mesh)
         n_dev = len(jax.devices())
+        if n_dev == 1:
+            # the jax_num_cpu_devices update silently no-ops once the
+            # backend is initialized; a 1-device "ring" never exercises
+            # the ppermute rotation this lane exists to measure — emit an
+            # explicit degraded row instead of a normal-looking number
+            # (r5 advisor finding)
+            return {"metric": "longctx_attention_tokens_per_sec",
+                    "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+                    "ok": False, "mode": "ring_sp1_degenerate",
+                    "devices": 1,
+                    "error": "CPU ring lane requires a multi-device "
+                             "virtual mesh; backend initialized before "
+                             "jax_num_cpu_devices could take effect"}
         mesh = sequence_mesh(n_dev)
         B, H, D = 1, 4, 64
         S = 512 * max(1, n_dev)
@@ -667,6 +703,18 @@ def main():
         raise SystemExit(f"unknown bench '{which}'; one of "
                          f"{sorted(benches)}")
     backend = _ensure_backend()
+    if which == "longctx" and (backend in ("cpu", "cpu_fallback")
+                               or os.environ.get("JAX_PLATFORMS",
+                                                 "").startswith("cpu")):
+        # the CPU ring lane needs the 8-device virtual mesh BEFORE any
+        # backend init in this process (enable_compile_cache below
+        # initializes it; after that jax_num_cpu_devices silently no-ops
+        # and the lane degrades to ring_sp1_degenerate). Checked AFTER
+        # _ensure_backend so the probe-failure path — which sets
+        # JAX_PLATFORMS=cpu itself — is covered too; XLA_FLAGS is read at
+        # backend init, so setting it here is still in time.
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
     _enable_compile_cache()
     entries_before = _cache_entries()
     try:

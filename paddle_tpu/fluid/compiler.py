@@ -60,6 +60,11 @@ class ExecutionStrategy:
         self.num_iteration_per_run = 1
         self.allow_op_delay = False
         self.use_thread_barrier = True
+        # segmented compilation for blocks with stateful/host ops (jitted
+        # islands around interpreted ops — fluid/executor.py
+        # _SegmentedBlock). False pins such blocks to the pure op-by-op
+        # interpreter, the correctness oracle.
+        self.allow_mixed_compilation = True
 
 
 class CompiledProgram:
@@ -133,6 +138,22 @@ class CompiledProgram:
         batch over the device mesh (see parallel/data_parallel.py); on a
         single chip this is a plain jitted run."""
         self._apply_build_strategy_passes(scope, fetch_list)
+        if self._exec_strategy is not None and \
+                not self._exec_strategy.allow_mixed_compilation:
+            from .core import globals_ as _g
+            prev = _g["FLAGS_executor_segmentation"]
+            _g["FLAGS_executor_segmentation"] = False
+            try:
+                return self._run_impl(executor, feed, fetch_list, scope,
+                                      return_numpy, mesh, param_shardings,
+                                      n_steps)
+            finally:
+                _g["FLAGS_executor_segmentation"] = prev
+        return self._run_impl(executor, feed, fetch_list, scope,
+                              return_numpy, mesh, param_shardings, n_steps)
+
+    def _run_impl(self, executor, feed, fetch_list, scope, return_numpy,
+                  mesh, param_shardings, n_steps):
         if self._is_data_parallel:
             from ..parallel.data_parallel import run_data_parallel
             if n_steps != 1:

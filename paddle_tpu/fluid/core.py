@@ -597,6 +597,17 @@ class _GlobalFlags:
         "FLAGS_rpc_retry_times": 3,
         "FLAGS_sync_nccl_allreduce": True,   # no-op: ICI collectives are compiled
         "FLAGS_executor_mode": "compiled",   # compiled | interpreted
+        # segmented compilation: when a block fails the all-or-nothing
+        # compiled check (a stateful/host op like auc/print/read among
+        # pure ops), partition it into jitted segments around interpreted
+        # islands instead of interpreting EVERYTHING (fluid/executor.py
+        # _SegmentedBlock, fluid/ir.py analyze_block_segments). OFF means
+        # such blocks take the pure interpreter (the correctness oracle).
+        "FLAGS_executor_segmentation": True,
+        # don't bother jitting segments for tiny blocks: below this many
+        # compilable ops the per-segment dispatch + compile overhead
+        # exceeds the interpreter's per-op cost
+        "FLAGS_executor_seg_min_ops": 8,
         "FLAGS_seed": 0,
         # bf16 inputs on MXU matmuls/convs with f32 accumulate (params and
         # activations stay f32 outside the unit) — the TPU-native analogue

@@ -8,13 +8,21 @@ a pure function ``(state, feeds, rng) -> (fetches, new_state)`` and compiles
 it with ``jax.jit`` — op fusion, layout, memory planning and GC all become
 XLA's job, and parameter updates alias in-place via buffer donation.
 
-Two paths:
+Three paths:
   * compiled (default): pure-traceable blocks. Program cache keyed like the
     reference's (executor.py:1171 cache) by (program id, version, feeds,
     fetches, scope).
+  * segmented (default when the block is NOT fully traceable): the op list
+    is partitioned into maximal pure runs — each jitted as its own donated
+    computation — around stateful/host-op *islands* the interpreter
+    dispatches eagerly (``_SegmentedBlock``; analysis in
+    fluid/ir.py:analyze_block_segments). One auc/print/read op no longer
+    de-compiles the whole block: the reference pays per-op dispatch
+    everywhere (executor.cc:469-475), this build pays it only at islands.
   * interpreted: the correctness oracle, also used for startup programs and
-    blocks containing stateful/host ops (control flow over scopes, save/load,
-    py_func, readers). Still executes on device, just eagerly.
+    blocks with nothing worth jitting (FLAGS_executor_segmentation=False
+    forces it for all partially-stateful blocks). Still executes on
+    device, just eagerly.
 
 Feed/fetch: direct dict-in/list-out like the reference API; programs that
 already contain feed/fetch ops (e.g. deserialized reference models) work
@@ -34,7 +42,8 @@ import jax.numpy as jnp
 from . import core
 from .core import LoDTensor, Scope, global_scope
 from .framework import Program, Variable, default_main_program
-from ..ops.registry import OPS, run_generic_grad, GRAD_SUFFIX
+from ..ops.registry import (OPS, run_generic_grad, GRAD_SUFFIX,
+                            resolve_base_info as _resolve_base_info)
 
 __all__ = ["Executor", "global_scope", "scope_guard", "FetchHandler"]
 
@@ -161,19 +170,6 @@ def _op_reads_host_values(op) -> bool:
     return False
 
 
-def _resolve_base_info(op_type: str):
-    """Registry info for an op type, resolving *_grad / *_grad_grad
-    names to their base op. None for unknown types."""
-    t = op_type
-    if OPS.has(t):
-        return OPS.get(t)
-    while t.endswith("_grad"):
-        t = t[:-5]
-        if OPS.has(t):
-            return OPS.get(t)
-    return None
-
-
 def _op_is_stateful(op) -> bool:
     info = _resolve_base_info(op.type)
     if info is None:
@@ -270,8 +266,55 @@ def _propagate_lods(op, outs, in_lods, set_lod, get_len):
                 set_lod(n, src)
 
 
+def _classify_block_state(ops, block, feed_names, scope):
+    """Classify a block's variables for a traced step: names read before
+    any write that are initialized LoDTensors in the scope become *state*
+    (threaded through the step and donated when overwritten); everything
+    written (including sub-block writes) lands in *written*. Raises for
+    data vars missing from the feed and for uninitialized persistables —
+    the same contract for the fused and segmented compiled paths."""
+    written: set = set()
+    state_names: List[str] = []
+    block_vars = block.vars
+    for op in ops:
+        for name in op.input_arg_names:
+            if name in written or name in feed_names or name in state_names:
+                continue
+            bv = block_vars.get(name)
+            if bv is not None and (bv.is_data or bv.need_check_feed):
+                # a data var must come from the feed dict — pulling a
+                # stale value from scope would silently compute on the
+                # previous batch (reference: executor feed checks)
+                raise KeyError(
+                    f"feed variable '{name}' is required by the program "
+                    f"but was not provided in feed=")
+            v = scope.find_var(name)
+            if v is not None and v.is_initialized() and isinstance(
+                    v.value(), LoDTensor):
+                state_names.append(name)
+            elif bv is not None and bv.persistable:
+                raise RuntimeError(
+                    f"persistable variable '{name}' (read by op "
+                    f"'{op.type}') is not initialized in the scope — "
+                    f"run the startup program first")
+        written.update(op.output_arg_names)
+        sub = op.attrs.get("sub_block")
+        if sub is not None:
+            stack = [sub]
+            while stack:
+                b = stack.pop()
+                for sop in b.ops:
+                    written.update(sop.output_arg_names)
+                    sb = sop.attrs.get("sub_block")
+                    if sb is not None:
+                        stack.append(sb)
+    return state_names, written
+
+
 class _CompiledBlock:
     """One traced+jitted step function for (program, feeds, fetches)."""
+
+    kind = "compiled"
 
     def __init__(self, program: Program, feed_names: Tuple[str, ...],
                  fetch_names: Tuple[str, ...], scope: Scope, seed: int,
@@ -294,41 +337,8 @@ class _CompiledBlock:
         self.ops = ops
 
         # classify variables: read-before-write & initialized in scope -> state
-        written: set = set()
-        state_names: List[str] = []
-        block_vars = block.vars
-        for op in ops:
-            for name in op.input_arg_names:
-                if name in written or name in feed_names or name in state_names:
-                    continue
-                bv = block_vars.get(name)
-                if bv is not None and (bv.is_data or bv.need_check_feed):
-                    # a data var must come from the feed dict — pulling a
-                    # stale value from scope would silently compute on the
-                    # previous batch (reference: executor feed checks)
-                    raise KeyError(
-                        f"feed variable '{name}' is required by the program "
-                        f"but was not provided in feed=")
-                v = scope.find_var(name)
-                if v is not None and v.is_initialized() and isinstance(
-                        v.value(), LoDTensor):
-                    state_names.append(name)
-                elif bv is not None and bv.persistable:
-                    raise RuntimeError(
-                        f"persistable variable '{name}' (read by op "
-                        f"'{op.type}') is not initialized in the scope — "
-                        f"run the startup program first")
-            written.update(op.output_arg_names)
-            sub = op.attrs.get("sub_block")
-            if sub is not None:
-                stack = [sub]
-                while stack:
-                    b = stack.pop()
-                    for sop in b.ops:
-                        written.update(sop.output_arg_names)
-                        sb = sop.attrs.get("sub_block")
-                        if sb is not None:
-                            stack.append(sb)
+        state_names, written = _classify_block_state(ops, block, feed_names,
+                                                     scope)
         self.written = written
         # state vars that get overwritten -> donated & written back
         self.mut_state = tuple(n for n in state_names if n in written)
@@ -443,8 +453,12 @@ class _CompiledBlock:
         final.pop(_IT, None)
         env.update(final)
 
-    def _exec_ops(self, ops, env, lod_env, rng):
-        for idx, op in enumerate(ops):
+    def _exec_ops(self, ops, env, lod_env, rng, idx0=0):
+        # ``idx0``: global index of ops[0] in the block's (feed/fetch-free)
+        # op list — per-op rng keys fold from GLOBAL indices so a segmented
+        # run draws the same streams as the fused compiled run would
+        for local_idx, op in enumerate(ops):
+            idx = idx0 + local_idx
             otype = op.type
             if otype == "while":
                 self._exec_while(op, env, lod_env, rng)
@@ -554,7 +568,10 @@ class _CompiledBlock:
                 lambda n: (env[n].shape[0] if n in env and
                            getattr(env[n], "ndim", 0) else None))
 
-    def run(self, scope: Scope, feeds: Dict[str, Any], rng, n_steps=1):
+    def _place_inputs(self, scope: Scope, feeds: Dict[str, Any], rng):
+        """State from the scope + feeds, device-placed for the step (mesh
+        sharding applied when data-parallel). Shared by run() and by
+        HLO-inspection helpers (lowered())."""
         mut = {n: scope.find_var(n).get_tensor().array for n in self.mut_state}
         ro = {n: scope.find_var(n).get_tensor().array for n in self.ro_state}
         if self.mesh is not None:
@@ -594,6 +611,18 @@ class _CompiledBlock:
                 # every rank, jit replicates it (key arrays can't go
                 # through make_array_from_process_local_data)
                 rng = jax.device_put(rng, repl)
+        return mut, ro, feeds, rng
+
+    def lowered(self, scope: Scope, feeds: Dict[str, Any], rng):
+        """jax lowering of the single-step function over the CURRENT scope
+        state — ``.compile().as_text()`` is the optimized HLO the step
+        actually runs (donated aliases, collectives, fusions). Used by
+        tests/test_ir_passes.py to EVIDENCE the absorbed-pass claims."""
+        mut, ro, feeds, rng = self._place_inputs(scope, feeds, rng)
+        return self._jitted.lower(mut, ro, feeds, rng)
+
+    def run(self, scope: Scope, feeds: Dict[str, Any], rng, n_steps=1):
+        mut, ro, feeds, rng = self._place_inputs(scope, feeds, rng)
         from . import profiler as _profiler
         if n_steps > 1:
             if _profiler.is_profiling():
@@ -678,6 +707,307 @@ class _CompiledBlock:
         return None
 
 
+class _NotSegmentable(Exception):
+    """Raised at build time when a block gains nothing from segmentation
+    (no/too-few compilable ops) — the caller falls back to the pure
+    interpreter quietly."""
+
+
+def _effective_reads(op) -> List[str]:
+    """Names an op may read, including through its sub-blocks (an island
+    while/conditional re-enters the eager executor on the sub-block, whose
+    ops read the scope directly)."""
+    names = list(op.input_arg_names)
+    stack = [op.attrs.get("sub_block")]
+    while stack:
+        b = stack.pop()
+        if b is None:
+            continue
+        for sop in b.ops:
+            names.extend(sop.input_arg_names)
+            stack.append(sop.attrs.get("sub_block"))
+    return names
+
+
+def _effective_writes(op) -> List[str]:
+    names = list(op.output_arg_names)
+    stack = [op.attrs.get("sub_block")]
+    while stack:
+        b = stack.pop()
+        if b is None:
+            continue
+        for sop in b.ops:
+            names.extend(sop.output_arg_names)
+            stack.append(sop.attrs.get("sub_block"))
+    return names
+
+
+class _SegmentedBlock(_CompiledBlock):
+    """Segmented compilation: the block's op list partitioned into maximal
+    pure runs — each traced+jitted as its own donated step — separated by
+    stateful/host-op *islands* the interpreter dispatches eagerly.
+
+    Kills the whole-block interpreter cliff: before this, ONE stateful op
+    (auc, print, read, ...) among hundreds routed the ENTIRE block to
+    op-by-op interpretation with per-op host sync (`_ops_compilable` at
+    the top of Executor.run is all-or-nothing). The reference pays per-op
+    dispatch everywhere by design (executor.cc:469-475); this build pays
+    it only at the islands — fwd+bwd+optimizer stay fused XLA
+    computations.
+
+    Env handoff contract: one step threads a host-side ``env`` dict of
+    DEVICE arrays through the segments in program order. Compiled segments
+    consume/produce env entries through their jitted functions (state they
+    overwrite is donated, exactly like the fused path); islands read env
+    values pushed into the scope (a LoDTensor wrap of the device array —
+    no host copy; only values the island actually reads are pushed) and
+    their scope writes are pulled back into env. Values cross segment
+    boundaries on device — the only host syncs are the ones island kernels
+    themselves perform (e.g. auc's histogram update).
+
+    Inherits the op tracing/lowering machinery from _CompiledBlock; the
+    whole-step jit, pipeline/remat plans and multi-step scan are replaced
+    by the per-segment plan (islands have per-step side effects, so
+    multi-step windows run as a host loop in Executor.run)."""
+
+    kind = "segmented"
+
+    def __init__(self, program: Program, feed_names: Tuple[str, ...],
+                 fetch_names: Tuple[str, ...], scope: Scope, seed: int,
+                 feed_lods=None):
+        from .ir import analyze_block_segments
+        self._scope_ref = weakref.ref(scope)
+        self._init_lods: Dict[str, tuple] = dict(feed_lods or {})
+        self.fetch_lods: List = [None] * len(fetch_names)
+        self.mesh = None
+        self.param_shardings = {}
+        self.program = program
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+        block = program.global_block()
+        ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+        self.ops = ops
+        self.seed = seed
+        self._pipeline_plan = None
+        self._remat_plan = None
+
+        self.segments = analyze_block_segments(ops)
+        n_compilable = sum(len(s.ops) for s in self.segments
+                           if s.kind == "compiled")
+        if n_compilable < core.globals_["FLAGS_executor_seg_min_ops"]:
+            raise _NotSegmentable(
+                f"only {n_compilable} compilable ops (< "
+                f"FLAGS_executor_seg_min_ops)")
+
+        state_names, written = _classify_block_state(ops, block, feed_names,
+                                                     scope)
+        self.written = written
+        self.mut_state = tuple(n for n in state_names if n in written)
+        self.ro_state = tuple(n for n in state_names if n not in written)
+        for n in state_names:
+            lv = _normalize_lod(scope.find_var(n).get_tensor().lod())
+            if lv:
+                self._init_lods.setdefault(n, lv)
+        persistable = {v.name for v in block.vars.values() if v.persistable}
+        self.extra_writeback = tuple(
+            n for n in written
+            if n in persistable and n not in self.mut_state
+            and n not in feed_names)
+
+        # ---- per-segment dataflow: external reads / writes -------------
+        seg_reads: List[List[str]] = []
+        seg_writes: List[set] = []
+        for seg in self.segments:
+            reads: List[str] = []
+            written_in: set = set()
+            op_io = []
+            for op in seg.ops:
+                r, w = _effective_reads(op), _effective_writes(op)
+                op_io.append((op, r, w))
+                for n in r:
+                    if n not in written_in and n not in reads:
+                        reads.append(n)
+                written_in.update(w)
+            seg_reads.append(reads)
+            seg_writes.append(written_in)
+            if seg.kind == "island":
+                # static per-op read/write lists: the island dispatch
+                # pushes/pulls these every step — don't re-walk sub-block
+                # trees on the hot path
+                seg.op_io = op_io
+
+        # fetch names must be resolvable BEFORE anything runs: a compiled
+        # segment may donate state buffers, so failing at fetch-collection
+        # time (the interpreter's behavior) would leave the scope pointing
+        # at deleted arrays
+        producible = set()
+        for w in seg_writes:
+            producible |= w
+        for n in fetch_names:
+            if n not in producible and n not in state_names \
+                    and n not in feed_names and scope.find_var(n) is None:
+                raise KeyError(f"fetch var '{n}' not produced by program")
+
+        # liveness: a compiled segment only returns what someone later
+        # needs (later segments/islands, the fetch list, state/persistable
+        # writeback); state it overwrites is donated — whole-state
+        # donation, segment by segment
+        need_at_end = (set(fetch_names) | set(self.mut_state)
+                       | set(self.extra_writeback))
+        donatable = set(self.mut_state)
+        for i, seg in enumerate(self.segments):
+            if seg.kind != "compiled":
+                continue
+            later_reads: set = set()
+            for r in seg_reads[i + 1:]:
+                later_reads.update(r)
+            seg.out_names = tuple(sorted(
+                n for n in seg_writes[i]
+                if n in later_reads or n in need_at_end))
+            seg.donated_names = tuple(sorted(
+                n for n in seg_reads[i]
+                if n in donatable and n in seg_writes[i]))
+            seg.in_names = tuple(sorted(
+                set(seg_reads[i]) - set(seg.donated_names)))
+            seg._cache = {}  # lod-key -> [jitted step, captured out lods]
+
+    # -------------------------------------------------------------- step
+    def _seg_dispatch(self, seg, env, lod_env, rng, profiling):
+        """Run one compiled segment: jit-cache keyed by the LoD of its
+        inputs (trace-time-static, same contract as the fused path's
+        feed-LoD-keyed program cache)."""
+        in_all = seg.in_names + seg.donated_names
+        lkey = tuple((n, lod_env[n]) for n in in_all if n in lod_env)
+        entry = seg._cache.get(lkey)
+        first = entry is None
+        if first:
+            static_lods = dict(lkey)
+            captured: Dict[str, Any] = {}
+            seg_ops, start, out_names = seg.ops, seg.start, seg.out_names
+
+            def step(donated, held, rng_):
+                e = dict(held)
+                e.update(donated)
+                le = dict(static_lods)
+                self._exec_ops(seg_ops, e, le, rng_, idx0=start)
+                captured.clear()
+                captured.update({n: le[n] for n in out_names if n in le})
+                return {n: e[n] for n in out_names if n in e}
+
+            entry = seg._cache[lkey] = [
+                jax.jit(step, donate_argnums=(0,)), captured]
+        jitted, captured = entry
+        donated = {n: env[n] for n in seg.donated_names if n in env}
+        held = {n: env[n] for n in seg.in_names if n in env}
+        if profiling:
+            from . import profiler as _profiler
+            tag = "compile" if first else "exec"
+            with _profiler.RecordEvent(
+                    f"segment[{seg.start}:{seg.stop}]:{tag}",
+                    cat="segment"):
+                outs = jitted(donated, held, rng)
+                jax.block_until_ready(outs)
+        else:
+            outs = jitted(donated, held, rng)
+        env.update(outs)
+        for n, lv in captured.items():
+            if lv:
+                lod_env[n] = lv
+        return outs
+
+    def _island_dispatch(self, seg, env, lod_env, rng, scope, executor,
+                         profiling):
+        """Run one island through the eager interpreter: push the env
+        values the island reads into the scope (device-array wrap, no host
+        copy), dispatch each op, pull its writes back into env."""
+        ctx = None
+        if profiling:
+            from . import profiler as _profiler
+            ctx = _profiler.RecordEvent(
+                f"island[{seg.start}:{seg.stop}]:"
+                + ",".join(sorted({o.type for o in seg.ops})),
+                cat="segment")
+            ctx.__enter__()
+        try:
+            for off, (op, op_reads, op_writes) in enumerate(seg.op_io):
+                for n in op_reads:
+                    if n in env:
+                        scope.var(n).set_value(
+                            LoDTensor(env[n], lod_env.get(n)))
+                executor._run_op_eager(op, scope, rng, seg.start + off)
+                for n in op_writes:
+                    v = scope.find_var(n)
+                    if v is None or not v.is_initialized():
+                        continue
+                    val = v.value()
+                    if isinstance(val, LoDTensor) and val.array is not None:
+                        env[n] = val.array
+                        lv = _normalize_lod(val.lod())
+                        if lv:
+                            lod_env[n] = lv
+        finally:
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+
+    def run_step(self, scope: Scope, feeds: Dict[str, Any], rng, executor):
+        """One training/inference step through the segment plan. Returns
+        (fetch arrays, fetch lods)."""
+        from . import profiler as _profiler
+        profiling = _profiler.is_profiling()
+        env: Dict[str, Any] = {}
+        for n in self.ro_state + self.mut_state:
+            env[n] = scope.find_var(n).get_tensor().array
+        env.update(feeds)
+        lod_env: Dict[str, tuple] = dict(self._init_lods)
+        n_comp = sum(1 for s in self.segments if s.kind == "compiled")
+        try:
+            with _profiler.RecordEvent(
+                    f"segmented_step[{n_comp}c/"
+                    f"{len(self.segments) - n_comp}i]", cat="segment") \
+                    if profiling else contextlib.nullcontext():
+                for seg in self.segments:
+                    if seg.kind == "compiled":
+                        self._seg_dispatch(seg, env, lod_env, rng,
+                                           profiling)
+                    else:
+                        self._island_dispatch(seg, env, lod_env, rng,
+                                              scope, executor, profiling)
+        except Exception:
+            # a failure AFTER a donating segment ran would leave the scope
+            # pointing at deleted buffers; restore the freshest state
+            # (interpreter-like partial-step semantics) before surfacing
+            self._write_back_state(scope, env, lod_env)
+            raise
+        fetched, fetch_lods = [], []
+        for n in self.fetch_names:
+            if n in env:
+                fetched.append(env[n])
+                fetch_lods.append(lod_env.get(n))
+                continue
+            v = scope.find_var(n)
+            if v is None or not v.is_initialized():
+                raise KeyError(f"fetch var '{n}' not produced by program")
+            val = v.value()
+            if isinstance(val, LoDTensor):
+                fetched.append(val.array)
+                fetch_lods.append(_normalize_lod(val.lod()))
+            else:
+                fetched.append(val)
+                fetch_lods.append(None)
+        self.fetch_lods = fetch_lods
+        self._write_back_state(scope, env, lod_env)
+        return fetched, fetch_lods
+
+    def _write_back_state(self, scope, env, lod_env):
+        for n in self.mut_state + self.extra_writeback:
+            v = env.get(n)
+            if v is None:
+                continue
+            if isinstance(v, jax.Array) and v.is_deleted():
+                continue  # donated by a segment that then failed mid-run
+            scope.var(n).set_value(LoDTensor(v, lod_env.get(n)))
+
+
 class Executor:
     """Drop-in equivalent of fluid.Executor (reference executor.py:457)."""
 
@@ -686,6 +1016,35 @@ class Executor:
             core.TPUPlace(0) if core.is_compiled_with_tpu() else core.CPUPlace())
         self._compiled_cache: Dict[Tuple, _CompiledBlock] = {}
         self._closed = False
+        # how the LAST run executed: "compiled" | "segmented" |
+        # "interpreted" (observability for tests/bench — e.g. the
+        # compiled_metric flag in bench.py wide_deep rows)
+        self._last_run_mode: Optional[str] = None
+
+    def _build_segmented(self, program, feed, fetch_names, scope, seed,
+                         feed_lods) -> Optional[_SegmentedBlock]:
+        """Build the segment plan for a block that failed the all-or-
+        nothing compiled check. None -> pure interpreter (too few
+        compilable ops, or the plan could not be built — the interpreter
+        stays the correctness oracle and fallback). Contract violations
+        raise exactly like the fused compiled path: KeyError for a data
+        var missing from feed= / an unproducible fetch, RuntimeError for
+        an uninitialized persistable (startup program not run)."""
+        try:
+            return _SegmentedBlock(program, tuple(sorted(feed)),
+                                   tuple(fetch_names), scope, seed,
+                                   feed_lods=feed_lods)
+        except _NotSegmentable:
+            return None
+        except (KeyError, RuntimeError):
+            raise  # user errors, not fallback cases
+        except Exception as e:  # noqa: BLE001 — any plan failure
+            import warnings as _warnings
+            _warnings.warn(
+                f"segmented compilation unavailable for this program "
+                f"({e!r}); falling back to the op-by-op interpreter",
+                stacklevel=3)
+            return None
 
     # ------------------------------------------------------------------ API
     def close(self):
@@ -748,8 +1107,16 @@ class Executor:
         mode = core.globals_["FLAGS_executor_mode"]
         compiled_ok = (mode == "compiled"
                        and _ops_compilable(program.global_block().ops))
+        # segmented compilation (default when the all-or-nothing check
+        # fails): jitted islands of pure ops around interpreted stateful
+        # ops, instead of interpreting the WHOLE block. Mesh runs keep
+        # their existing paths (compiled or interpreted).
+        try_segmented = (not compiled_ok and mode == "compiled"
+                         and mesh is None
+                         and core.globals_["FLAGS_executor_segmentation"])
 
-        if compiled_ok:
+        cb = None
+        if compiled_ok or try_segmented:
             key = (id(program), program._version, tuple(sorted(feed)),
                    tuple(fetch_names), id(scope),
                    tuple(sorted(feed_lods.items())),
@@ -758,29 +1125,55 @@ class Executor:
                    None if not param_shardings else
                    tuple(sorted((k, str(v))
                                 for k, v in param_shardings.items())))
-            cb = self._compiled_cache.get(key)
+            cached = self._compiled_cache.get(key)
             # guard id() reuse: a dead scope's id can be recycled by a new
-            # scope with different state — validate the weakref identity
-            if cb is not None and (cb._scope_ref() is not scope):
-                cb = None
-            if cb is None:
-                cb = _CompiledBlock(program, tuple(sorted(feed)),
-                                    tuple(fetch_names), scope,
-                                    program.random_seed
-                                    or core.globals_["FLAGS_seed"],
-                                    mesh=mesh,
-                                    param_shardings=param_shardings,
-                                    feed_lods=feed_lods)
-                self._compiled_cache[key] = cb
+            # scope with different state — every cache entry (including
+            # the "interpreted" unprofitable-key marker) validates a scope
+            # weakref before being trusted
+            cb, rebuild = None, True
+            if isinstance(cached, tuple):  # ("interpreted", scope_ref)
+                if cached[1]() is scope:
+                    rebuild = False  # known unprofitable for this scope
+            elif cached is not None and cached._scope_ref() is scope:
+                cb, rebuild = cached, False
+            if rebuild:
+                seed = (program.random_seed
+                        or core.globals_["FLAGS_seed"])
+                if compiled_ok:
+                    cb = _CompiledBlock(program, tuple(sorted(feed)),
+                                        tuple(fetch_names), scope, seed,
+                                        mesh=mesh,
+                                        param_shardings=param_shardings,
+                                        feed_lods=feed_lods)
+                else:
+                    cb = self._build_segmented(
+                        program, feed, fetch_names, scope, seed,
+                        feed_lods)
+                self._compiled_cache[key] = (
+                    cb if cb is not None
+                    else ("interpreted", weakref.ref(scope)))
+
+        if cb is not None and cb.kind == "compiled":
             rng = self._next_rng(scope, program)
             fetched = cb.run(scope, feed_arrays, rng, n_steps=n_steps)
             fetch_lods = cb.fetch_lods
+            self._last_run_mode = "compiled"
+        elif cb is not None:  # segmented: host loop per step (islands
+            # have per-step side effects); final step's fetches returned,
+            # the interpreter contract
+            fetched, fetch_lods = [], []
+            for _ in range(n_steps):
+                rng = self._next_rng(scope, program)
+                fetched, fetch_lods = cb.run_step(scope, feed_arrays, rng,
+                                                  self)
+            self._last_run_mode = "segmented"
         else:
             for _ in range(n_steps - 1):  # same feeds, repeated steps
                 rng = self._next_rng(scope, program)
                 self._run_block_eager(program.global_block(), scope, rng)
             rng = self._next_rng(scope, program)
             self._run_block_eager(program.global_block(), scope, rng)
+            self._last_run_mode = "interpreted"
             fetched = []
             fetch_lods = []
             for n in fetch_names:

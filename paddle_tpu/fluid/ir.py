@@ -33,6 +33,8 @@ import numpy as np
 __all__ = [
     "Graph", "IrGraph", "Pass", "PassManager", "register_pass", "get_pass",
     "all_registered_passes", "apply_inference_passes",
+    "BlockSegment", "analyze_block_segments", "op_island_reason",
+    "segment_summary",
 ]
 
 
@@ -1238,6 +1240,119 @@ for _n, _note in {
     "quant_conv2d_dequant_fuse_pass": "int8 deploy; out of scope on TPU",
 }.items():
     _register_absorbed(_n, _note)
+
+
+# --------------------------------------------------------------------------
+# Block segmentation analysis (segmented compilation)
+# --------------------------------------------------------------------------
+# The whole-block compiled path is all-or-nothing: ONE stateful/host op
+# (auc, print, read, save, ...) among hundreds routes the entire block to
+# the op-by-op interpreter with per-op host dispatch. The reference pays
+# per-op dispatch everywhere by design (executor.cc:469-475); a TPU build
+# should pay it only where it must. This analysis partitions a block's op
+# list into maximal *compiled* runs (pure ops, traceable into one jitted
+# XLA computation each) separated by *island* runs (stateful/host ops the
+# interpreter executes eagerly). `fluid/executor.py:_SegmentedBlock`
+# executes the partition; the `block_segmentation_pass` below makes it
+# inspectable from the pass system without running anything.
+
+# ops whose compiled lowering traces sub-blocks to lax primitives on the
+# whole-block path. In a MIXED block they are executed as islands instead:
+# the interpreter's single-branch/scope semantics compose with island
+# side effects, while the compiled conditional lowering's both-branch
+# trace + mask-merge would not.
+_SEG_CONTROL = frozenset({"while", "conditional_block",
+                          "conditional_block_infer", "select_input",
+                          "select_output"})
+
+
+def op_island_reason(op) -> Optional[str]:
+    """None when ``op`` can be traced into a jitted segment; otherwise a
+    short reason string ('stateful' | 'host_inputs' | 'unregistered' |
+    'control_flow')."""
+    from ..ops.registry import resolve_base_info
+    info = resolve_base_info(op.type)
+    if info is None:
+        return "unregistered"
+    if info.stateful:
+        return "stateful"
+    if info.host_inputs:
+        return "host_inputs"
+    if op.type in _SEG_CONTROL or op.attrs.get("sub_block") is not None:
+        return "control_flow"
+    return None
+
+
+class BlockSegment:
+    """One maximal run of a block's op list: ``kind`` is 'compiled' (pure
+    ops, jitted as one computation) or 'island' (dispatched per-op by the
+    interpreter). ``start`` is the index of the first op in the analyzed
+    (feed/fetch-free) op list — the executor folds per-op rng keys from
+    these global indices so segmented and fused runs draw identically."""
+
+    __slots__ = ("kind", "start", "ops", "island_reasons",
+                 # filled by the executor when it builds a step plan
+                 "in_names", "donated_names", "out_names", "_cache",
+                 "op_io")
+
+    def __init__(self, kind: str, start: int):
+        self.kind = kind
+        self.start = start
+        self.ops: List[Any] = []
+        self.island_reasons: List[Optional[str]] = []
+
+    @property
+    def stop(self) -> int:
+        return self.start + len(self.ops)
+
+    def __repr__(self):
+        kinds = ",".join(o.type for o in self.ops[:4])
+        more = "..." if len(self.ops) > 4 else ""
+        return (f"<BlockSegment {self.kind} [{self.start}:{self.stop}) "
+                f"{kinds}{more}>")
+
+
+def analyze_block_segments(ops) -> List["BlockSegment"]:
+    """Partition ``ops`` (a feed/fetch-free op list) into maximal
+    compiled/island segments. Adjacent ops of the same kind merge, so the
+    result alternates kinds; the partition covers every op exactly once."""
+    segments: List[BlockSegment] = []
+    for idx, op in enumerate(ops):
+        reason = op_island_reason(op)
+        kind = "island" if reason is not None else "compiled"
+        if not segments or segments[-1].kind != kind:
+            segments.append(BlockSegment(kind, idx))
+        segments[-1].ops.append(op)
+        if kind == "island":
+            segments[-1].island_reasons.append(reason)
+    return segments
+
+
+def segment_summary(segments) -> List[Dict[str, Any]]:
+    """JSON-ish view of a partition (what the pass stores on the graph)."""
+    return [{"kind": s.kind, "start": s.start, "stop": s.stop,
+             "n_ops": len(s.ops), "op_types": [o.type for o in s.ops],
+             "island_reasons": list(s.island_reasons)}
+            for s in segments]
+
+
+@register_pass("block_segmentation_pass")
+class BlockSegmentationPass(Pass):
+    """Analysis-only: compute the compiled/island partition the segmented
+    executor will use for this block and store it on the graph attr
+    'segments' and the program attr ``_segment_plan``. Mutates nothing —
+    run it to see where a training program falls off the compiled path
+    and why (reference analog: there is none — the reference interprets
+    everywhere; here per-op dispatch is the exception and this pass makes
+    each exception visible)."""
+
+    def apply(self, graph: Graph) -> Graph:  # no drop_orphan_vars
+        ops = [op for op in graph.block.ops
+               if op.type not in ("feed", "fetch")]
+        summary = segment_summary(analyze_block_segments(ops))
+        graph.set("segments", summary)
+        graph.program._segment_plan = summary
+        return graph
 
 
 # --------------------------------------------------------------------------

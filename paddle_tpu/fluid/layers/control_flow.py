@@ -326,6 +326,7 @@ def Print(input, first_n=-1, message=None, summarize=20,
           print_phase="both"):
     helper = LayerHelper("print")
     out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape  # reference print_op InferShape ShareDim
     helper.append_op(type="print", inputs={"In": [input]},
                      outputs={"Out": [out]},
                      attrs={"first_n": first_n, "message": message or "",

@@ -254,6 +254,10 @@ def _plan_het(cb, plan, sections, rest, all_written, interior_written):
         for op in sec:
             w.update(op.output_arg_names)
         sec_written.append(w)
+    pre_written = set()
+    for op in plan.pre_ops:
+        pre_written.update(op.output_arg_names)
+    preceding: set = set()  # union of vars written by sections 0..i-1
     for i, sec in enumerate(sections):
         if cut_vars[i + 1] not in sec_written[i]:
             return (f"section {i} does not produce its cut var "
@@ -263,10 +267,25 @@ def _plan_het(cb, plan, sections, rest, all_written, interior_written):
         for n in externals:
             if n == cut_vars[i]:
                 continue  # the ring activation input
-            if n in interior_written and n not in sec_written[i]:
-                return (f"section {i} reads '{n}' produced by another "
-                        f"section (cross-stage skip doesn't fit the "
-                        f"1-activation ring)")
+            # a read of ANY preceding section's output is a cross-stage
+            # read — including read-before-overwrite where this section
+            # also writes n itself (n in sec_written[i] must NOT mask the
+            # check: the closure snapshot {n: env[n]} would KeyError
+            # inside the jitted step, since interior writes never land in
+            # env)
+            if n in preceding:
+                return (f"section {i} reads '{n}' produced by a "
+                        f"preceding section (cross-stage skip doesn't "
+                        f"fit the 1-activation ring)")
+            # n written only by this or a LATER section: the fused
+            # oracle would read the pre-interior value — it must exist
+            # outside the interior (pre ops or state), else the closure
+            # snapshot has nothing to snapshot
+            if n in interior_written and n not in pre_written \
+                    and n not in state:
+                return (f"section {i} reads '{n}' before it is written "
+                        f"inside the interior, and no pre-section op or "
+                        f"state provides it")
             if n in state and grad_var_name(n) in all_written:
                 params.append(n)
             else:
@@ -280,6 +299,7 @@ def _plan_het(cb, plan, sections, rest, all_written, interior_written):
                 closure.append(n)
         plan.sec_param_names.append(params)
         plan.sec_closure.append(closure)
+        preceding |= sec_written[i]
     plan.het = True
     plan.sections = sections
     err = _finish_plan(cb, plan, rest, interior_written,

@@ -29,13 +29,14 @@ __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
 
 
 class _Event:
-    __slots__ = ("name", "start", "end", "tid")
+    __slots__ = ("name", "start", "end", "tid", "cat")
 
-    def __init__(self, name, start, end, tid):
+    def __init__(self, name, start, end, tid, cat="host"):
         self.name = name
         self.start = start
         self.end = end
         self.tid = tid
+        self.cat = cat
 
 
 class _ProfilerState:
@@ -108,18 +109,22 @@ def reset_profiler():
         _prof.t0 = time.perf_counter()
 
 
-def _record(name: str, start: float, end: float):
+def _record(name: str, start: float, end: float, cat: str = "host"):
     with _prof.lock:
         _prof.events.append(_Event(name, start, end,
-                                   threading.get_ident()))
+                                   threading.get_ident(), cat))
 
 
 class RecordEvent:
     """RAII span (reference platform/profiler.h:124). Usable as a context
-    manager or decorator; no-op when profiling is off."""
+    manager or decorator; no-op when profiling is off. ``cat`` groups
+    spans in the chrome trace — the segmented executor emits its
+    per-segment compile/exec and island spans under cat='segment' so the
+    compiled/interpreted partition of a step is visible at a glance."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, cat: str = "host"):
         self.name = name
+        self.cat = cat
         self._start = 0.0
 
     def __enter__(self):
@@ -134,7 +139,7 @@ class RecordEvent:
         # landing mid-span must not leak the entered TraceAnnotation
         if self._start:
             self._ann.__exit__(exc_type, exc_val, exc_tb)
-            _record(self.name, self._start, time.perf_counter())
+            _record(self.name, self._start, time.perf_counter(), self.cat)
             self._start = 0.0
         return False
 
@@ -194,7 +199,7 @@ def _write_chrome_trace(events: List[_Event], path: str):
         trace["traceEvents"].append({
             "name": e.name, "ph": "X", "pid": os.getpid(), "tid": e.tid,
             "ts": (e.start - _prof.t0) * 1e6,
-            "dur": (e.end - e.start) * 1e6, "cat": "host"})
+            "dur": (e.end - e.start) * 1e6, "cat": e.cat})
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

@@ -58,13 +58,21 @@ def wide_deep_net(dense, sparse_slots, sparse_dim=int(1e4), embedding_dim=16,
 def build_wide_deep_program(num_dense=13, num_slots=26, sparse_dim=int(1e4),
                             embedding_dim=16, hidden=(400, 400, 400),
                             lr=1e-3, is_sparse=False, is_distributed=False,
-                            optimizer=None):
+                            optimizer=None, with_auc=True):
     """Returns (main, startup, feed_names, loss, auc_var).
 
     ``is_distributed=True`` marks the embedding tables for the
     DistributeTranspiler's distributed_lookup_table rewrite (tables live on
     pservers); the driver then trains via the fleet PS mode exactly like
-    the reference CTR jobs."""
+    the reference CTR jobs.
+
+    ``with_auc``: keep the streaming AUC metric op in the train program
+    (the reference CTR shape). The op is stateful (host-side histogram
+    update), so the executor runs the block SEGMENTED — fwd+bwd+update as
+    compiled jitted segments, auc as an interpreted island
+    (fluid/executor.py _SegmentedBlock). ``with_auc=False`` drops the
+    metric for a fully-compiled step — the A/B pair that isolates the
+    segmentation overhead in bench.py. Returns auc_var=None then."""
     import paddle_tpu.fluid as fluid
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -76,8 +84,10 @@ def build_wide_deep_program(num_dense=13, num_slots=26, sparse_dim=int(1e4),
                              hidden, is_sparse, is_distributed)
         labelf = fluid.layers.cast(label, "float32")
         loss = layers.mean(layers.log_loss(prob, labelf))
-        auc, _ = layers.auc(layers.concat(
-            [1.0 - prob, prob], axis=1), label)
+        auc = None
+        if with_auc:
+            auc, _ = layers.auc(layers.concat(
+                [1.0 - prob, prob], axis=1), label)
         opt = optimizer or fluid.optimizer.Adam(lr)
         opt.minimize(loss)
     feeds = ["dense"] + ["slot_%d" % i for i in range(num_slots)] + ["label"]

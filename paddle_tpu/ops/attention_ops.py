@@ -89,8 +89,14 @@ def _fused_attention_qkv(ins, attrs):
                             dropout_rate=drop, dropout_seed=seed,
                             bias=kp_bias if flash_can else None)
     else:
-        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh).astype(jnp.float32) \
-            * sm_scale
+        # f32-accumulation contract shared with the flash kernel: bf16
+        # MXU tiles accumulate in f32 (preferred_element_type), so the
+        # softmax statistics see f32 scores — NOT scores rounded to bf16
+        # by a bf16-output dot. Without this the two dispatch paths
+        # diverge numerically for the same program depending on bias
+        # shape (r5 advisor finding).
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                       preferred_element_type=jnp.float32) * sm_scale
         if bias is not None:
             s = s + bias.astype(jnp.float32)
         if causal:
@@ -102,7 +108,8 @@ def _fused_attention_qkv(ins, attrs):
         if drop > 0.0:
             keep = jax.random.bernoulli(attrs["_rng"], 1.0 - drop, p.shape)
             p = jnp.where(keep, p / (1.0 - drop), 0.0).astype(p.dtype)
-        o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, vh,
+                       preferred_element_type=jnp.float32)
     return out(Out=_merge_heads(o).astype(out_dtype))
 
 
@@ -152,10 +159,13 @@ def _multihead_matmul(ins, attrs):
     if _pallas_ok(q, k) and (bias_qk is None or kp_bias is not None):
         o = flash_attention(q, k, v, alpha, causal=False, bias=kp_bias)
     else:
-        s_mat = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) \
-            * alpha
+        # same f32-accumulation contract as the flash path (see
+        # _fused_attention_qkv above)
+        s_mat = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) * alpha
         if bias_qk is not None:
             s_mat = s_mat + bias_qk.astype(jnp.float32)
         p = jax.nn.softmax(s_mat, axis=-1).astype(q.dtype)
-        o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v,
+                       preferred_element_type=jnp.float32).astype(q.dtype)
     return out(Out=_merge_heads(o))

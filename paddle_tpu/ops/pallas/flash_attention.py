@@ -51,6 +51,15 @@ try:
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
+if _HAS_PALLAS:
+    # jax renamed TPUCompilerParams -> CompilerParams (~0.6); accept both,
+    # and degrade to the no-pallas path (like any other pallas
+    # incompatibility) if a future jax drops both names
+    _compiler_params = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams", None)
+    if _compiler_params is None:
+        _HAS_PALLAS = False
+
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30  # finite mask value: avoids inf-inf → NaN in the rescale
@@ -81,14 +90,20 @@ def interpret_guard():
 
 
 def _ref_attention(q, k, v, sm_scale, causal=False):
-    """Pure-jax reference: q,k,v [B,H,S,D]."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    """Pure-jax reference: q,k,v [B,H,S,D]. Matches the kernel's
+    f32-accumulation contract: bf16 operands accumulate in f32
+    (preferred_element_type), so softmax statistics are f32 — this is
+    also the CPU dispatch target of the flash path, and the einsum path
+    in ops/attention_ops.py follows the same contract."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
     if causal:
         S, Sk = q.shape[2], k.shape[2]
         mask = jnp.arange(S)[:, None] >= jnp.arange(Sk)[None, :]
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
 
 
 def _on_tpu() -> bool:
@@ -314,7 +329,7 @@ def _pallas_fwd(q, k, v, seed, sm_scale, causal, blk_q, blk_k,
             pltpu.VMEM((blk_q, 128), jnp.float32),
             pltpu.VMEM((blk_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_INTERPRET and not _on_tpu(),
     )(*args)
@@ -508,7 +523,7 @@ def _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal, blk_q, blk_k,
                    pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0))),
         scratch_shapes=[pltpu.VMEM((blk_k, D), jnp.float32),
                         pltpu.VMEM((blk_k, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interp,
     )(*kv_args)
@@ -534,7 +549,7 @@ def _pallas_bwd(q, k, v, o, lse, seed, g, sm_scale, causal, blk_q, blk_k,
         in_specs=q_specs,
         out_specs=pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
         scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interp,
     )(*q_args)

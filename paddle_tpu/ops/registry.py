@@ -96,6 +96,21 @@ class OpInfoMap:
 OPS = OpInfoMap()
 
 
+def resolve_base_info(op_type: str):
+    """Registry info for an op type, resolving *_grad / *_grad_grad names
+    to their base op. None for unknown types. Shared by the executor's
+    compilability checks and the ir-level segmentation analysis — ONE
+    resolver so the two can never classify the same op differently."""
+    t = op_type
+    if OPS.has(t):
+        return OPS.get(t)
+    while t.endswith("_grad"):
+        t = t[:-5]
+        if OPS.has(t):
+            return OPS.get(t)
+    return None
+
+
 def register_op(type_: str, *, no_grad: bool = False, needs_rng: bool = False,
                 stateful: bool = False, needs_lod: bool = False,
                 diff_inputs: Optional[Sequence[str]] = None,

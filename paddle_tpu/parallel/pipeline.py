@@ -111,10 +111,12 @@ def gpipe(stage_fn: Callable[[Any, Any], Any], stacked_params, xs, *,
         init = (jnp.zeros_like(xs_local[0]),
                 jnp.zeros((n_micro,) + xs_local.shape[1:], xs_local.dtype))
         # carry becomes device-varying after the first tick; mark it so
+        # (older jax < 0.6 has neither primitive — there shard_map's
+        # rep-tracking handles the transition without explicit marking)
         if hasattr(lax, "pcast"):
             init = jax.tree_util.tree_map(
                 lambda x: lax.pcast(x, (axis,), to="varying"), init)
-        else:
+        elif hasattr(lax, "pvary"):
             init = jax.tree_util.tree_map(
                 lambda x: lax.pvary(x, (axis,)), init)
         (_, ys), _ = lax.scan(tick, init, jnp.arange(total))

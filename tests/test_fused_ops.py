@@ -383,3 +383,60 @@ def test_fused_attention_bf16_matmul_flag(monkeypatch):
             assert np.isfinite(np.asarray(g)).all() and np.abs(g).max() > 0
     finally:
         core.set_flag("FLAGS_use_bf16_matmul", prev)
+
+
+def test_bf16_dispatch_paths_share_f32_accumulation(monkeypatch):
+    """Under FLAGS_use_bf16_matmul the einsum path must follow the flash
+    kernel's f32-accumulation contract (preferred_element_type=f32 on
+    QK^T and PV): softmax statistics see f32 scores on BOTH dispatch
+    paths, so the same program gets the same numerics whichever way the
+    bias shape routes it (r5 advisor finding: the einsum path used to
+    round scores to bf16 before softmax)."""
+    import jax.numpy as jnp
+    from paddle_tpu.fluid import core
+    from paddle_tpu.ops import attention_ops as ao
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.ops.registry import OPS
+
+    r = np.random.RandomState(5)
+    # scale 2.0 makes |scores| ~ O(10): bf16 has ~3 significant digits,
+    # so bf16-ROUNDED scores (the old einsum path) are off by ~0.06
+    # absolute — softmax is sensitive to ABSOLUTE score error, so the
+    # old path lands ~0.08 from the flash path, 5x the bf16 output-
+    # rounding floor (~0.016) the fixed path sits on
+    B, S, H, D = 2, 64, 2, 32
+    q, k, v = (jnp.asarray(r.normal(size=(B, S, H * D)) * 2.0, jnp.float32)
+               for _ in range(3))
+    kern = OPS.get("fused_attention_qkv").kernel
+    attrs = {"num_heads": H, "dropout_rate": 0.0, "causal": False}
+    prev = core.globals_["FLAGS_use_bf16_matmul"]
+    core.set_flag("FLAGS_use_bf16_matmul", True)
+    monkeypatch.setattr(ao, "_mxu_backend", lambda: True)
+    calls = []
+    real = ao.flash_attention
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+    monkeypatch.setattr(ao, "flash_attention", counting)
+    try:
+        # no bias -> flash path (on CPU its dispatch target is
+        # _ref_attention, which carries the same f32-accumulation
+        # contract as the Mosaic kernel)
+        o_flash = np.asarray(kern(
+            {"Q": [q], "K": [k], "V": [v], "Bias": [None]},
+            dict(attrs))["Out"][0])
+        assert calls, "no-bias call must take the flash path"
+        del calls[:]
+        # an all-zero GENERIC bias shape forces the einsum path while
+        # leaving the math identical to no-bias
+        zero_bias = jnp.zeros((B, H, S, S), jnp.float32)
+        o_einsum = np.asarray(kern(
+            {"Q": [q], "K": [k], "V": [v], "Bias": [zero_bias]},
+            dict(attrs))["Out"][0])
+        assert not calls, "generic bias must route to the einsum path"
+    finally:
+        core.set_flag("FLAGS_use_bf16_matmul", prev)
+    # 2 bf16 ulps at this output scale; the bf16-rounded-scores bug sat
+    # at ~0.08 here
+    assert np.max(np.abs(o_flash - o_einsum)) < 0.03

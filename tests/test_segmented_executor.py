@@ -1,0 +1,453 @@
+"""Segmented compilation tests (VERDICT r5 Weak #1 / Next-round item 2).
+
+The whole-block compiled path is all-or-nothing: one stateful/host op
+(auc, print, read, ...) used to route the ENTIRE block to the op-by-op
+interpreter. The segmenter (fluid/ir.py analyze_block_segments +
+fluid/executor.py _SegmentedBlock) partitions the block into maximal
+jitted segments around interpreted islands instead.
+
+Oracle: the pure interpreter (FLAGS_executor_segmentation=False). Every
+parity test here runs the same program both ways and compares losses /
+metrics step for step.
+"""
+import contextlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.executor import _SegmentedBlock
+from paddle_tpu.fluid.ir import (analyze_block_segments, get_pass, Graph,
+                                 op_island_reason, segment_summary)
+
+
+@contextlib.contextmanager
+def _segmentation(enabled, min_ops=None):
+    prev = core.globals_["FLAGS_executor_segmentation"]
+    prev_min = core.globals_["FLAGS_executor_seg_min_ops"]
+    core.set_flag("FLAGS_executor_segmentation", enabled)
+    if min_ops is not None:
+        core.set_flag("FLAGS_executor_seg_min_ops", min_ops)
+    try:
+        yield
+    finally:
+        core.set_flag("FLAGS_executor_segmentation", prev)
+        core.set_flag("FLAGS_executor_seg_min_ops", prev_min)
+
+
+def _segmented_blocks(exe):
+    # tuples are ("interpreted", scope_ref) unprofitable-key markers
+    return [v for v in exe._compiled_cache.values()
+            if not isinstance(v, tuple) and v.kind == "segmented"]
+
+
+# --------------------------------------------------------------- analysis
+def test_analysis_partitions_maximal_runs():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.scale(x, scale=2.0)
+        h = fluid.layers.Print(h, message="dbg")
+        h = fluid.layers.scale(h, scale=3.0)
+        h = fluid.layers.relu(h)
+    ops = [op for op in main.global_block().ops
+           if op.type not in ("feed", "fetch")]
+    segs = analyze_block_segments(ops)
+    assert [s.kind for s in segs] == ["compiled", "island", "compiled"]
+    assert [len(s.ops) for s in segs] == [1, 1, 2]
+    assert segs[1].island_reasons == ["stateful"]
+    # segments tile the op list exactly
+    assert [(s.start, s.stop) for s in segs] == [(0, 1), (1, 2), (2, 4)]
+
+
+def test_island_reasons():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        fluid.layers.relu(x)
+    relu_op = [op for op in main.global_block().ops
+               if op.type == "relu"][0]
+    assert op_island_reason(relu_op) is None
+
+    class FakeOp:
+        type = "no_such_op_xyz"
+        attrs = {}
+    assert op_island_reason(FakeOp()) == "unregistered"
+
+
+def test_block_segmentation_pass_is_inspectable():
+    """The pass stores the partition on the graph and program WITHOUT
+    mutating the block."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, 8)
+        h = fluid.layers.Print(h)
+        fluid.layers.relu(h)
+    n_ops = len(main.global_block().ops)
+    g = Graph(main)
+    get_pass("block_segmentation_pass").apply(g)
+    assert len(main.global_block().ops) == n_ops  # analysis-only
+    segs = g.get("segments")
+    assert segs is not None and segs == main._segment_plan
+    kinds = [s["kind"] for s in segs]
+    assert "island" in kinds and "compiled" in kinds
+    isl = [s for s in segs if s["kind"] == "island"][0]
+    assert isl["op_types"] == ["print"] \
+        and isl["island_reasons"] == ["stateful"]
+
+
+# ------------------------------------------------------- acceptance: auc
+def _build_auc_trainer(num_dense=4, num_slots=3, sparse_dim=50,
+                       embedding_dim=4, hidden=(16, 16)):
+    """Wide&Deep shape (models/wide_deep.py) scaled down for tests: the
+    train program fetches AUC, so the block contains the stateful `auc`
+    op among hundreds of pure ops."""
+    from paddle_tpu.models import wide_deep
+    return wide_deep.build_wide_deep_program(
+        num_dense=num_dense, num_slots=num_slots, sparse_dim=sparse_dim,
+        embedding_dim=embedding_dim, hidden=hidden, lr=1e-2)
+
+
+def _run_auc_trainer(segmentation, steps=4, batch=32):
+    from paddle_tpu.models import wide_deep
+    with _segmentation(segmentation):
+        main, startup, feeds, loss, auc = _build_auc_trainer()
+        exe = fluid.Executor()
+        scope = core.Scope()
+        nb = wide_deep.ctr_reader(batch, num_dense=4, num_slots=3,
+                                  sparse_dim=50, seed=3)
+        feed = nb()
+        out = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(steps):
+                l, a = exe.run(main, feed=feed, fetch_list=[loss, auc])
+                out.append((float(np.asarray(l).ravel()[0]),
+                            float(np.asarray(a).ravel()[0])))
+    return out, exe
+
+
+def test_wide_deep_auc_trains_as_compiled_segments():
+    """Acceptance (VERDICT next-round item 2's done-bar): a Wide&Deep
+    train program fetching AUC executes fwd+bwd+update as compiled jitted
+    segments — only the auc op stays an island — with loss AND metric
+    parity vs the pure interpreter."""
+    seg, exe = _run_auc_trainer(True)
+    assert exe._last_run_mode == "segmented"
+    sbs = _segmented_blocks(exe)
+    assert len(sbs) == 1
+    sb = sbs[0]
+    # every island op is the stateful metric; everything else compiled
+    island_ops = [o.type for s in sb.segments if s.kind == "island"
+                  for o in s.ops]
+    assert island_ops == ["auc"]
+    compiled_ops = [o.type for s in sb.segments if s.kind == "compiled"
+                    for o in s.ops]
+    assert "sgd" in compiled_ops or "adam" in compiled_ops
+    assert any(t.endswith("_grad") for t in compiled_ops)  # bwd compiled
+    # jitted-segment evidence: each compiled segment holds a traced jit
+    # cache entry after running
+    n_jitted = sum(len(s._cache) for s in sb.segments
+                   if s.kind == "compiled")
+    assert n_jitted == sum(1 for s in sb.segments if s.kind == "compiled")
+    # parity vs the pure interpreter, loss and AUC, step for step
+    interp, exe2 = _run_auc_trainer(False)
+    assert exe2._last_run_mode == "interpreted"
+    np.testing.assert_allclose(np.asarray(seg), np.asarray(interp),
+                               rtol=1e-5, atol=1e-6)
+    # it actually trains
+    assert seg[-1][0] < seg[0][0]
+
+
+# ----------------------------------------------------- acceptance: print
+def _run_print_trainer(segmentation, steps=3):
+    with _segmentation(segmentation):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[8], dtype="float32")
+            y = fluid.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, 16, act="relu")
+            pred = fluid.layers.fc(h, 4, act="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(pred, y))
+            fluid.layers.Print(loss, message="loss=", summarize=1)
+            fluid.optimizer.Momentum(0.1, momentum=0.9).minimize(loss)
+        exe = fluid.Executor()
+        scope = core.Scope()
+        r = np.random.RandomState(0)
+        X = r.rand(32, 8).astype("float32")
+        Y = r.randint(0, 4, (32, 1)).astype("int64")
+        out = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(steps):
+                (l,) = exe.run(main, feed={"x": X, "y": Y},
+                               fetch_list=[loss])
+                out.append(float(np.asarray(l).ravel()[0]))
+    return out, exe
+
+
+def test_print_program_trains_as_compiled_segments(capsys):
+    """Acceptance: a train program with a Print debug op keeps
+    fwd+bwd+update compiled (print is the only island) with loss parity
+    vs the interpreter — and the print side effect still happens every
+    step."""
+    seg, exe = _run_print_trainer(True)
+    assert exe._last_run_mode == "segmented"
+    sb = _segmented_blocks(exe)[0]
+    island_ops = [o.type for s in sb.segments if s.kind == "island"
+                  for o in s.ops]
+    assert island_ops == ["print"]
+    compiled_ops = [o.type for s in sb.segments if s.kind == "compiled"
+                    for o in s.ops]
+    assert "momentum" in compiled_ops
+    assert any(t.endswith("_grad") for t in compiled_ops)
+    printed = capsys.readouterr().out
+    assert printed.count("loss=") == 3  # side effect per step
+    interp, _ = _run_print_trainer(False)
+    np.testing.assert_allclose(seg, interp, rtol=1e-5, atol=1e-6)
+    assert seg[-1] < seg[0]
+
+
+# ------------------------------------------------------------ env handoff
+def test_island_output_feeds_compiled_segment_and_back():
+    """Handoff contract both directions: compiled segment -> island
+    (py_func reads a computed tensor host-side) -> compiled segment
+    (consumes the island's output). Values must round-trip exactly."""
+    import paddle_tpu.fluid.layers as layers
+    with _segmentation(True, min_ops=2):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[4], dtype="float32")
+            a = layers.scale(x, scale=2.0)
+            b = layers.elementwise_add(a, a)          # compiled
+            c = main.global_block().create_var(name="seg_pyf_out",
+                                               dtype="float32")
+            layers.py_func(lambda t: t + 1.0, b, c)   # island
+            d = layers.scale(c, scale=0.5)            # compiled again
+        exe = fluid.Executor()
+        scope = core.Scope()
+        X = np.arange(8, dtype="float32").reshape(2, 4)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            (o,) = exe.run(main, feed={"x": X}, fetch_list=[d])
+        assert exe._last_run_mode == "segmented"
+        np.testing.assert_allclose(np.asarray(o), (4 * X + 1) * 0.5,
+                                   rtol=1e-6)
+
+
+def test_state_donation_and_writeback_across_steps():
+    """Param/optimizer state written by a compiled segment must land back
+    in the scope (donated buffers replaced by the new values), and the
+    next step must consume the updated state — i.e. repeated same-batch
+    steps keep moving the params."""
+    with _segmentation(True):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[4], dtype="float32")
+            y = fluid.data("y", shape=[1], dtype="float32")
+            p = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(
+                name="sdw_w"), bias_attr=False)
+            loss = fluid.layers.mean(fluid.layers.square(
+                fluid.layers.elementwise_sub(p, y)))
+            fluid.layers.Print(loss, summarize=1)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor()
+        scope = core.Scope()
+        r = np.random.RandomState(4)
+        X = r.rand(16, 4).astype("float32")
+        Y = r.rand(16, 1).astype("float32")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            w0 = np.asarray(scope.find_var("sdw_w").get_tensor().array)
+            losses = []
+            for _ in range(5):
+                (l,) = exe.run(main, feed={"x": X, "y": Y},
+                               fetch_list=[loss])
+                losses.append(float(np.asarray(l).ravel()[0]))
+            w1 = np.asarray(scope.find_var("sdw_w").get_tensor().array)
+        assert exe._last_run_mode == "segmented"
+        assert not np.allclose(w0, w1)          # state written back
+        assert losses[-1] < losses[0] * 0.9     # and consumed next step
+
+
+# ------------------------------------------------------------- fallbacks
+def test_all_island_block_stays_interpreted():
+    """A block with nothing worth jitting (below the min-ops threshold)
+    must quietly take the pure interpreter."""
+    with _segmentation(True):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[4], dtype="float32")
+            h = fluid.layers.scale(x, scale=2.0)
+            fluid.layers.Print(h)
+        exe = fluid.Executor()
+        scope = core.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                    fetch_list=[h])
+        assert exe._last_run_mode == "interpreted"
+
+
+def test_flag_off_restores_interpreter():
+    with _segmentation(False):
+        out, exe = _run_print_trainer(False)
+        assert exe._last_run_mode == "interpreted"
+
+
+def test_exec_strategy_can_pin_interpreter():
+    """CompiledProgram + ExecutionStrategy.allow_mixed_compilation=False
+    pins a partially-stateful block to the interpreter."""
+    from paddle_tpu.fluid.compiler import CompiledProgram, ExecutionStrategy
+    with _segmentation(True):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[8], dtype="float32")
+            y = fluid.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, 16, act="relu")
+            pred = fluid.layers.fc(h, 4, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+            fluid.layers.Print(loss, summarize=1)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        es = ExecutionStrategy()
+        es.allow_mixed_compilation = False
+        cp = CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, exec_strategy=es, places=[core.CPUPlace()])
+        cp._is_data_parallel = False  # exercise the plain delegate path
+        exe = fluid.Executor()
+        scope = core.Scope()
+        r = np.random.RandomState(0)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(cp, feed={"x": r.rand(8, 8).astype("float32"),
+                              "y": r.randint(0, 4, (8, 1)).astype("int64")},
+                    fetch_list=[loss])
+        assert exe._last_run_mode == "interpreted"
+        # and the flag is restored afterwards
+        assert core.globals_["FLAGS_executor_segmentation"] is True
+
+
+def test_unknown_fetch_fails_before_donation():
+    """Regression: fetching an unknown var from a segmented block used to
+    raise only AFTER compiled segments had run — and donated the param
+    buffers — leaving the scope pointing at deleted arrays and poisoning
+    every subsequent step. The fetch must fail at build time, and the
+    program must keep training afterwards."""
+    with _segmentation(True):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[8], dtype="float32")
+            y = fluid.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, 16, act="relu")
+            pred = fluid.layers.fc(h, 4, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+            fluid.layers.Print(loss, summarize=1)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor()
+        scope = core.Scope()
+        r = np.random.RandomState(0)
+        feed = {"x": r.rand(8, 8).astype("float32"),
+                "y": r.randint(0, 4, (8, 1)).astype("int64")}
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(main, feed=feed, fetch_list=[loss])
+            with pytest.raises(KeyError, match="no_such_var"):
+                exe.run(main, feed=feed, fetch_list=["no_such_var"])
+            # the failed fetch must not have consumed the state buffers
+            (l,) = exe.run(main, feed=feed, fetch_list=[loss])
+        assert np.isfinite(np.asarray(l)).all()
+
+
+def test_uninitialized_persistable_raises_like_compiled():
+    """A fresh scope without the startup program must raise the compiled
+    path's RuntimeError naming the var — not silently fall back to the
+    interpreter and crash inside a kernel."""
+    with _segmentation(True):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[8], dtype="float32")
+            h = fluid.layers.fc(x, 16, act="relu",
+                                param_attr=fluid.ParamAttr(name="up_w"))
+            fluid.layers.Print(h, summarize=1)
+            for _ in range(6):
+                h = fluid.layers.scale(h, scale=1.0)
+        exe = fluid.Executor()
+        scope = core.Scope()  # startup NOT run
+        with fluid.scope_guard(scope):
+            with pytest.raises(RuntimeError, match="up_w"):
+                exe.run(main, feed={"x": np.ones((2, 8), "float32")},
+                        fetch_list=[h])
+
+
+# ------------------------------------------------------------- profiler
+def test_per_segment_profiler_spans():
+    """The segmented step surfaces per-segment compile/exec spans and
+    island spans (cat='segment') through fluid/profiler.py."""
+    from paddle_tpu.fluid import profiler
+    with _segmentation(True):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[8], dtype="float32")
+            y = fluid.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, 16, act="relu")
+            pred = fluid.layers.fc(h, 4, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+            fluid.layers.Print(loss, summarize=1)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor()
+        scope = core.Scope()
+        r = np.random.RandomState(0)
+        feed = {"x": r.rand(8, 8).astype("float32"),
+                "y": r.randint(0, 4, (8, 1)).astype("int64")}
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            profiler.start_profiler(state="CPU")
+            exe.run(main, feed=feed, fetch_list=[loss])  # compile spans
+            exe.run(main, feed=feed, fetch_list=[loss])  # exec spans
+            events = list(profiler._prof.events)
+            profiler.stop_profiler(profile_path="")
+        names = [e.name for e in events]
+        assert any(n.startswith("segmented_step[") for n in names)
+        assert any(":compile" in n and n.startswith("segment[")
+                   for n in names)
+        assert any(":exec" in n and n.startswith("segment[")
+                   for n in names)
+        assert any(n.startswith("island[") for n in names)
+        seg_events = [e for e in events if e.name.startswith(("segment",
+                                                              "island"))]
+        assert all(e.cat == "segment" for e in seg_events)
+
+
+# ------------------------------------------------------ rng determinism
+def test_segmented_rng_matches_fused_compiled():
+    """A dropout program sliced by an off-path Print must draw the SAME
+    rng streams as the fused compiled path (per-op keys fold from global
+    op indices), so removing the island does not change the trajectory.
+    """
+    def run(with_print):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 1234
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[8], dtype="float32")
+            h = fluid.layers.dropout(x, dropout_prob=0.5)
+            o = fluid.layers.scale(h, scale=1.0)
+            for _ in range(4):  # pad past the min-ops threshold
+                o = fluid.layers.scale(o, scale=1.0)
+            if with_print:
+                fluid.layers.Print(o, summarize=1)
+        exe = fluid.Executor()
+        scope = core.Scope()
+        X = np.ones((4, 8), "float32")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            (v,) = exe.run(main, feed={"x": X}, fetch_list=[o])
+        return np.asarray(v), exe._last_run_mode
+
+    with _segmentation(True, min_ops=4):
+        seg, m1 = run(True)
+        fused, m2 = run(False)
+    assert m1 == "segmented" and m2 == "compiled"
+    np.testing.assert_allclose(seg, fused)
