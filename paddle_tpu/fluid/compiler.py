@@ -136,7 +136,14 @@ class CompiledProgram:
              mesh=None, param_shardings=None, n_steps=1):
         """Delegate to the executor. Data-parallel execution shards the feed
         batch over the device mesh (see parallel/data_parallel.py); on a
-        single chip this is a plain jitted run."""
+        single chip this is a plain jitted run. ``n_steps``/windowed feeds
+        (a leading [K, ...] dim of distinct batches — docs/INPUT_PIPELINE.md)
+        ride through to Executor.run untouched. The with_data_parallel
+        wrapper rejects an explicit n_steps>1 (its per-run sharding
+        protocol is single-step); a WindowBatch fed through it reaches
+        the executor, which takes the documented per-step mesh fallback —
+        for one-dispatch scanned windows pass mesh= to a plain
+        Executor.run."""
         self._apply_build_strategy_passes(scope, fetch_list)
         if self._exec_strategy is not None and \
                 not self._exec_strategy.allow_mixed_compilation:

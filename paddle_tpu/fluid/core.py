@@ -34,8 +34,16 @@ __all__ = [
     "LazyEmbeddingTable",
     "Variable", "Scope", "globals_", "get_flag", "set_flag",
     "dtype_to_np", "np_to_dtype", "dtype_to_jnp", "is_float_dtype",
-    "is_compiled_with_tpu",
+    "is_compiled_with_tpu", "EOFException",
 ]
+
+
+class EOFException(Exception):
+    """Raised by non-iterable DataLoader/PyReader ``next()`` when the
+    underlying generator is drained (reference: the C++ reader's
+    EnforceNotMet-EOF that ``exe.run`` surfaces in the py_reader loop;
+    the user catches it, calls ``reader.reset()`` and starts the next
+    epoch)."""
 
 
 # --------------------------------------------------------------------------
@@ -622,6 +630,20 @@ class _GlobalFlags:
         # fingerprint makes this safe under in-place mutation, so it is
         # ON by default
         "FLAGS_feed_device_cache": True,
+        # opt-in persistent XLA executable cache: non-empty -> every
+        # Executor routes compiles through
+        # jax_compilation_cache_dir=<dir> (inference.enable_compile_cache)
+        # so a SECOND process running the same program loads the
+        # executable from disk instead of recompiling
+        "FLAGS_compilation_cache_dir": "",
+        # multiprocess DataLoader liveness probe: how long the consumer
+        # waits on the batch queue before checking whether the worker
+        # process died (a killed worker surfaces RuntimeError instead of
+        # hanging forever); per-loader kwarg worker_timeout overrides
+        "FLAGS_dataloader_worker_timeout": 5.0,
+        # how long to wait for the worker process to exit at iterator
+        # teardown before it is killed
+        "FLAGS_dataloader_join_timeout": 5.0,
     }
 
     def __init__(self):

@@ -155,9 +155,60 @@ def _as_lodtensor(data, place) -> LoDTensor:
         if not isinstance(data.array, jax.Array):
             data.set(np.asarray(data.array), place)
         return data
+    if isinstance(data, jax.Array):
+        # already device-resident (e.g. the DataLoader window prefetch
+        # stage device_put the batch while the previous window computed)
+        # — wrap without a host round-trip, nothing to re-upload
+        return LoDTensor(data)
     t = LoDTensor()
-    t.set(np.asarray(data), place)
+    t.set(data if isinstance(data, np.ndarray) else np.asarray(data), place)
     return t
+
+
+def _window_feed_names(program, feed, n_steps) -> Tuple[str, ...]:
+    """Feeds carrying a leading window dimension: value rank is the
+    program var's rank + 1 and the leading dim equals ``n_steps`` —
+    `feed={x: [K, batch, ...]}` with `n_steps=K` means the K slices are
+    K *distinct* batches, consumed one per step (lax.scan xs on the
+    compiled path). A rank-matched feed whose leading dim disagrees
+    with n_steps is a user error and raises; LoD cannot describe a
+    stacked window, so a windowed feed with LoD raises too."""
+    names = []
+    block = program.global_block()
+    for name, data in feed.items():
+        arr = data.array if isinstance(data, LoDTensor) else data
+        shp = getattr(arr, "shape", None)
+        if not shp:
+            continue
+        v = block._find_var_recursive(name)
+        vshape = getattr(v, "shape", None) if v is not None else None
+        if vshape is None or len(shp) != len(vshape) + 1:
+            continue
+        # only batch-majored vars (first dim -1, the fluid.data shape)
+        # are unambiguous: a normal feed has exactly the var's rank, so
+        # rank+1 can only mean a leading window dim. Vars declared with
+        # a concrete full shape (raw create_var) commonly take feeds of
+        # any rank through rank-polymorphic kernels — never windowed.
+        if vshape[0] != -1:
+            continue
+        if shp[0] != n_steps:
+            if n_steps == 1:
+                # a plain run may legitimately feed extra-rank data to
+                # rank-polymorphic ops — only an explicit multi-step
+                # request makes the mismatch a user error
+                continue
+            raise ValueError(
+                f"feed '{name}' has shape {tuple(shp)} — rank says it "
+                f"carries a leading window dimension (program var rank "
+                f"{len(vshape)}), but the window length {shp[0]} does not "
+                f"match n_steps={n_steps}")
+        if isinstance(data, LoDTensor) and data.lod():
+            raise NotImplementedError(
+                f"windowed feed '{name}' carries LoD — one LoD cannot "
+                f"describe K stacked batches; feed dense windows or run "
+                f"per-step (n_steps=1)")
+        names.append(name)
+    return tuple(names)
 
 
 def _op_reads_host_values(op) -> bool:
@@ -377,7 +428,9 @@ class _CompiledBlock:
                 "checkpoints; the pipelined schedule runs and the "
                 "checkpoints are NOT rematerialized", stacklevel=2)
         self._jitted = jax.jit(self._step, donate_argnums=(0,))
-        self._multi_jit: Dict[int, Any] = {}  # n_steps → scanned jit
+        # (n_steps, windowed-feed names) → scanned jit; shape changes
+        # within a key retrace inside jax.jit as usual
+        self._multi_jit: Dict[Tuple[int, Tuple[str, ...]], Any] = {}
 
     def _step(self, mut_state: Dict[str, Any], ro_state: Dict[str, Any],
               feeds: Dict[str, Any], rng):
@@ -621,19 +674,11 @@ class _CompiledBlock:
         mut, ro, feeds, rng = self._place_inputs(scope, feeds, rng)
         return self._jitted.lower(mut, ro, feeds, rng)
 
-    def run(self, scope: Scope, feeds: Dict[str, Any], rng, n_steps=1):
+    def run(self, scope: Scope, feeds: Dict[str, Any], rng):
+        """One training/inference step: ONE dispatch of the jitted step."""
         mut, ro, feeds, rng = self._place_inputs(scope, feeds, rng)
         from . import profiler as _profiler
-        if n_steps > 1:
-            if _profiler.is_profiling():
-                with _profiler.RecordEvent(f"compiled_steps_x{n_steps}"):
-                    fetches, new_mut, extra = self._run_multi(
-                        mut, ro, feeds, rng, n_steps)
-                    jax.block_until_ready(fetches)
-            else:
-                fetches, new_mut, extra = self._run_multi(
-                    mut, ro, feeds, rng, n_steps)
-        elif _profiler.is_profiling():
+        if _profiler.is_profiling():
             # the whole program is ONE dispatch on TPU — a single span
             # (per-op timing lives in the device XPlane trace)
             with _profiler.RecordEvent("compiled_step"):
@@ -641,43 +686,83 @@ class _CompiledBlock:
                 jax.block_until_ready(fetches)
         else:
             fetches, new_mut, extra = self._jitted(mut, ro, feeds, rng)
-        for n, v in {**new_mut, **extra}.items():
-            scope.var(n).set_value(LoDTensor(v))
+        self._write_back(scope, new_mut, extra)
         return fetches
 
-    def _run_multi(self, mut, ro, feeds, rng, n_steps):
-        """Execute ``n_steps`` with the SAME feeds as ONE dispatched
-        lax.scan — host and wire (TPU-tunnel RTT ≈ 10 ms/dispatch) costs
-        amortize to one dispatch per window, the real training-loop
-        shape for benchmarking. Fetches come back stacked [n_steps, ...]
-        (per-step rng folds by step index). Programs with
-        extra-writeback vars fall back to a per-step dispatch loop with
-        the same stacked contract. LoD-carrying fetches are refused: a
-        single-step LoD cannot describe a stacked [n_steps, ...] dim."""
+    def run_window(self, scope: Scope, feeds: Dict[str, Any], rng_base,
+                   idx0: int, n_steps: int, window_names=()):
+        """``n_steps`` as ONE dispatched lax.scan window. Feeds named in
+        ``window_names`` carry a leading [n_steps, ...] dim of *distinct*
+        batches consumed one slice per step (scan xs); every other feed
+        broadcasts to all steps (the degenerate same-feeds mode — the
+        pre-window benchmark shape). Host and wire costs (TPU-tunnel RTT
+        ≈ 10 ms/dispatch) amortize to one dispatch per window. Fetches
+        come back stacked [n_steps, ...]."""
+        mut, ro, feeds, rng_base = self._place_inputs(scope, feeds,
+                                                      rng_base)
+        from . import profiler as _profiler
+        if _profiler.is_profiling():
+            tag = "realdata" if window_names else "broadcast"
+            with _profiler.RecordEvent(f"window[{n_steps}]:{tag}",
+                                       cat="window"):
+                fetches, new_mut, extra = self._run_multi(
+                    mut, ro, feeds, rng_base, idx0, n_steps, window_names)
+                jax.block_until_ready(fetches)
+        else:
+            fetches, new_mut, extra = self._run_multi(
+                mut, ro, feeds, rng_base, idx0, n_steps, window_names)
+        self._write_back(scope, new_mut, extra)
+        return fetches
+
+    def _write_back(self, scope, new_mut, extra):
+        for n, v in {**new_mut, **extra}.items():
+            scope.var(n).set_value(LoDTensor(v))
+
+    def _run_multi(self, mut, ro, feeds, rng_base, idx0, n_steps,
+                   window_names):
+        """The scanned window body. ``rng_base`` is the UNfolded program
+        key and ``idx0`` the global step index of the window's first
+        step: per-step keys fold by global index (idx0 + i), which are
+        EXACTLY the keys ``n_steps`` sequential single-step run() calls
+        would draw — windowed and per-step training see identical rng
+        streams. Programs with extra-writeback vars fall back to a
+        per-step dispatch loop with the same stacked-fetch contract.
+        LoD-carrying fetches are refused: a single-step LoD cannot
+        describe a stacked [n_steps, ...] dim."""
         self._check_no_lod_fetch()
+        xs = {n: feeds[n] for n in window_names}
+        bcast = {n: v for n, v in feeds.items() if n not in window_names}
         if not self.extra_writeback:
-            jitted = self._multi_jit.get(n_steps)
+            key = (n_steps, tuple(sorted(window_names)))
+            jitted = self._multi_jit.get(key)
             if jitted is None:
                 from jax import lax
 
-                def many(mut, ro, feeds, rng):
-                    def body(mut_c, i):
+                def many(mut, ro, bcast, xs, rng_b, i0):
+                    def body(mut_c, x):
+                        i, sl = x
+                        f = dict(bcast)
+                        f.update(sl)
                         fetches, new_mut, _ = self._step(
-                            mut_c, ro, feeds, jax.random.fold_in(rng, i))
+                            mut_c, ro, f, jax.random.fold_in(rng_b, i))
                         return new_mut, fetches
-                    new_mut, ys = lax.scan(body, mut,
-                                           jnp.arange(n_steps))
+                    new_mut, ys = lax.scan(
+                        body, mut, (i0 + jnp.arange(n_steps), xs))
                     return ys, new_mut
                 jitted = jax.jit(many, donate_argnums=(0,))
-                self._multi_jit[n_steps] = jitted
-            ys, new_mut = jitted(mut, ro, feeds, rng)
+                self._multi_jit[key] = jitted
+            ys, new_mut = jitted(mut, ro, bcast, xs, rng_base,
+                                 jnp.int32(idx0))
             self._check_no_lod_fetch()  # lods appear during the trace
             return ys, new_mut, {}
         per_step = []
         extra = {}
         for i in range(n_steps):
+            f = dict(bcast)
+            for n, a in xs.items():
+                f[n] = a[i]
             fetches, mut, extra = self._jitted(
-                mut, ro, feeds, jax.random.fold_in(rng, i))
+                mut, ro, f, jax.random.fold_in(rng_base, idx0 + i))
             per_step.append(fetches)
         self._check_no_lod_fetch()
         stacked = [jnp.stack([s[k] for s in per_step])
@@ -1016,6 +1101,7 @@ class Executor:
             core.TPUPlace(0) if core.is_compiled_with_tpu() else core.CPUPlace())
         self._compiled_cache: Dict[Tuple, _CompiledBlock] = {}
         self._closed = False
+        self._maybe_enable_compile_cache()
         # how the LAST run executed: "compiled" | "segmented" |
         # "interpreted" (observability for tests/bench — e.g. the
         # compiled_metric flag in bench.py wide_deep rows)
@@ -1046,6 +1132,19 @@ class Executor:
                 stacklevel=3)
             return None
 
+    def _maybe_enable_compile_cache(self):
+        """Opt-in persistent XLA executable cache: repeated processes
+        running the same program skip the compile (the executable loads
+        from disk, keyed by HLO hash). Checked at construction AND per
+        run — like the dataloader timeout flags, setting
+        FLAGS_compilation_cache_dir after the Executor exists must not
+        be silently ignored (enable_compile_cache is idempotent per
+        dir, so the per-run check is a dict lookup)."""
+        cache_dir = core.globals_["FLAGS_compilation_cache_dir"]
+        if cache_dir:
+            from ..inference import enable_compile_cache
+            enable_compile_cache(cache_dir)
+
     # ------------------------------------------------------------------ API
     def close(self):
         self._closed = True
@@ -1062,6 +1161,7 @@ class Executor:
         — the benchmark/training-loop shape. Interpreted programs run
         the steps sequentially and return the final fetch values."""
         from .compiler import CompiledProgram
+        self._maybe_enable_compile_cache()
         if program is None:
             program = default_main_program()
         if isinstance(program, CompiledProgram):
@@ -1088,6 +1188,52 @@ class Executor:
                 pruned = cache[pkey] = program._prune(list(fetch_names))
             program = pruned
 
+        # a WindowBatch (DataLoader.window) knows its own window length —
+        # forgetting n_steps=k must not silently broadcast the [K, ...]
+        # stack as one giant step
+        window_names: Tuple[str, ...] = ()
+        wk = getattr(feed, "k", None)
+        if isinstance(wk, int) and wk > 0:
+            if n_steps == 1:
+                n_steps = wk
+            elif n_steps != wk:
+                raise ValueError(
+                    f"feed is a WindowBatch of {wk} stacked batches but "
+                    f"n_steps={n_steps} was requested")
+            # every WindowBatch entry is K stacked real batches by
+            # construction, so slicing is always correct — no rank
+            # heuristic (which would silently BROADCAST the stack for a
+            # var it cannot classify, e.g. a concrete-first-dim var)
+            window_names = tuple(feed)
+        elif feed and n_steps > 1:
+            # raw dict feeds: a leading [n_steps, ...] dim means n_steps
+            # DISTINCT batches consumed one slice per step; empty tuple
+            # = the same-feeds broadcast degenerate case. Detection only
+            # engages for an explicit multi-step request — a plain
+            # n_steps=1 dict run keeps the pre-window semantics for
+            # rank-polymorphic feeds and skips the per-feed var scan on
+            # the hot path.
+            window_names = _window_feed_names(program, feed, n_steps)
+
+        mode = core.globals_["FLAGS_executor_mode"]
+        compiled_ok = (mode == "compiled"
+                       and _ops_compilable(program.global_block().ops))
+
+        if window_names and not (compiled_ok and mesh is None):
+            # Documented per-step fallback for windowed feeds on paths
+            # where the window cannot collapse to one dispatch:
+            # segmented blocks (islands have per-step host side
+            # effects), interpreted blocks, and device meshes (batch-dim
+            # feed sharding would land on the window dim). Same contract
+            # as the compiled window: step i consumes slice i of every
+            # windowed feed, rng advances one global step per slice,
+            # fetches come back stacked [n_steps, ...]. Decided BEFORE
+            # the feed upload below — the whole [K, ...] stack must not
+            # be device_put just to be re-uploaded slice by slice.
+            return self._run_window_fallback(
+                program, feed, fetch_list, scope, return_numpy, mesh,
+                param_shardings, n_steps, window_names)
+
         # materialize program vars' metadata for persistables (create slots)
         # feeds → device
         use_feed_cache = core.globals_["FLAGS_feed_device_cache"]
@@ -1103,10 +1249,6 @@ class Executor:
             lv = _normalize_lod(t.lod())
             if lv:
                 feed_lods[name] = lv
-
-        mode = core.globals_["FLAGS_executor_mode"]
-        compiled_ok = (mode == "compiled"
-                       and _ops_compilable(program.global_block().ops))
         # segmented compilation (default when the all-or-nothing check
         # fails): jitted islands of pure ops around interpreted stateful
         # ops, instead of interpreting the WHOLE block. Mesh runs keep
@@ -1154,8 +1296,14 @@ class Executor:
                     else ("interpreted", weakref.ref(scope)))
 
         if cb is not None and cb.kind == "compiled":
-            rng = self._next_rng(scope, program)
-            fetched = cb.run(scope, feed_arrays, rng, n_steps=n_steps)
+            if n_steps > 1 or window_names:
+                rng_base, idx0 = self._next_rng_window(scope, program,
+                                                       n_steps)
+                fetched = cb.run_window(scope, feed_arrays, rng_base,
+                                        idx0, n_steps, window_names)
+            else:
+                rng = self._next_rng(scope, program)
+                fetched = cb.run(scope, feed_arrays, rng)
             fetch_lods = cb.fetch_lods
             self._last_run_mode = "compiled"
         elif cb is not None:  # segmented: host loop per step (islands
@@ -1207,7 +1355,7 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           fetch_handler=None, mesh=None):
+                           fetch_handler=None, mesh=None, window_size=1):
         """One pass over a Dataset (reference: executor.py:1438
         train_from_dataset → C++ MultiTrainer/HogwildWorker threads,
         trainer.h:64). The TPU inversion: batches stream from the native
@@ -1215,22 +1363,41 @@ class Executor:
         the reference's per-thread op loops. ``mesh``: a device mesh for
         the step; with a "pp" axis, a PipelineOptimizer-sectioned program
         runs stage-parallel (the SectionWorker/PipelineTrainer role —
-        section_worker.cc:142 — via fluid/pipeline_lowering.py)."""
+        section_worker.cc:142 — via fluid/pipeline_lowering.py).
+        ``window_size=K``: stack K consecutive dense same-shape batches
+        into one [K, ...]-windowed run (ONE dispatch on the compiled
+        path — docs/INPUT_PIPELINE.md); batches that carry LoD or ragged
+        shapes run per-step as before."""
         return self._run_from_dataset(program, dataset, scope, fetch_list,
                                       fetch_info, print_period,
-                                      fetch_handler, mesh=mesh)
+                                      fetch_handler, mesh=mesh,
+                                      window_size=window_size)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           fetch_handler=None, mesh=None):
+                           fetch_handler=None, mesh=None, window_size=1):
         return self._run_from_dataset(program, dataset, scope, fetch_list,
                                       fetch_info, print_period,
-                                      fetch_handler, mesh=mesh)
+                                      fetch_handler, mesh=mesh,
+                                      window_size=window_size)
+
+    @staticmethod
+    def _stack_dataset_window(feeds: List[Dict[str, Any]]):
+        """[{name: LoDTensor}] * K → WindowBatch of [K, ...] arrays when
+        every value is LoD-free and shapes match across the window; None
+        otherwise (the caller falls back to per-step runs). Same
+        assembly contract as DataLoader.window (reader._stack_window),
+        just non-raising."""
+        from .reader import _stack_window
+        try:
+            return _stack_window(feeds, len(feeds), len(feeds))
+        except (ValueError, KeyError):
+            return None
 
     def _run_from_dataset(self, program, dataset, scope, fetch_list,
                           fetch_info, print_period, fetch_handler=None,
-                          mesh=None):
+                          mesh=None, window_size=1):
         if dataset is None:
             raise ValueError("dataset must be provided")
         if program is None:
@@ -1247,17 +1414,59 @@ class Executor:
             monitor.start()
         step = 0
         last = []
+
+        def report(vals, count=1):
+            # fire once per print_period: when a period boundary falls
+            # in [step, step + count) — per-step runs (count=1) print
+            # exactly at multiples of print_period like before, windows
+            # print once per crossed boundary (labelled by the window's
+            # first global step; the value is the window's final step)
+            if not (fetch_names and print_period):
+                return
+            off = step % print_period
+            if off != 0 and off + count <= print_period:
+                return
+            infos = fetch_info or fetch_names
+            msg = ", ".join(
+                f"{i}={np.asarray(v).reshape(-1)[-1]:.6f}"
+                for i, v in zip(infos, vals))
+            print(f"[train_from_dataset] step {step}: {msg}")
+
+        pending: List[Dict[str, Any]] = []
+
+        def flush():
+            nonlocal step, last
+            if not pending:
+                return
+            # _stack_dataset_window returns a WindowBatch, which run()
+            # treats as windowed WHOLESALE (no rank heuristic that could
+            # silently broadcast an unclassifiable var's stack)
+            stacked = (self._stack_dataset_window(pending)
+                       if len(pending) > 1 else None)
+            if stacked is not None:
+                last = self.run(program, feed=stacked,
+                                fetch_list=fetch_list, scope=scope,
+                                mesh=mesh, n_steps=len(pending))
+                # report BEFORE advancing: the label is the window's
+                # first global step (matching per-step mode's step 0
+                # baseline row)
+                report(last, count=len(pending))
+                step += len(pending)
+            else:
+                for f in pending:
+                    last = self.run(program, feed=f,
+                                    fetch_list=fetch_list, scope=scope,
+                                    mesh=mesh)
+                    report(last)
+                    step += 1
+            pending.clear()
+
         try:
             for feed in dataset._iter_batches():
-                last = self.run(program, feed=feed, fetch_list=fetch_list,
-                                scope=scope, mesh=mesh)
-                if fetch_names and print_period and \
-                        step % print_period == 0:
-                    infos = fetch_info or fetch_names
-                    msg = ", ".join(f"{i}={np.asarray(v).reshape(-1)[0]:.6f}"
-                                    for i, v in zip(infos, last))
-                    print(f"[train_from_dataset] step {step}: {msg}")
-                step += 1
+                pending.append(feed)
+                if len(pending) >= max(1, window_size):
+                    flush()
+            flush()
         finally:
             if monitor is not None:
                 monitor.stop()
@@ -1267,20 +1476,28 @@ class Executor:
     _fold_rng = None  # class-level jitted fold: one dispatch per step
     _rng_counters = weakref.WeakKeyDictionary()  # scope -> host step count
 
-    def _next_rng(self, scope: Scope, program: Program):
+    def _advance_rng_counter(self, scope: Scope, n: int) -> int:
         # the step counter is a host int per scope (a device round-trip per
         # step costs ~0.4ms of pure overhead); the scope var mirrors it for
-        # inspection, stored as a lazy numpy buffer. The fold is jitted
-        # once so deriving the step key is one cached dispatch.
+        # inspection, stored as a lazy numpy buffer
         cnt = Executor._rng_counters.get(scope)
         if cnt is None:
             v = scope.var("@RNG_COUNTER@")
             cnt = (int(np.asarray(v.get_tensor().array).reshape(-1)[0])
                    if v.is_initialized() else 0)
-        Executor._rng_counters[scope] = cnt + 1
+        Executor._rng_counters[scope] = cnt + n
         scope.var("@RNG_COUNTER@").set_value(
-            LoDTensor(np.asarray([cnt + 1], np.int32)))
-        seed = int(program.random_seed or core.globals_["FLAGS_seed"])
+            LoDTensor(np.asarray([cnt + n], np.int32)))
+        return cnt
+
+    def _program_seed(self, program: Program) -> int:
+        return int(program.random_seed or core.globals_["FLAGS_seed"])
+
+    def _next_rng(self, scope: Scope, program: Program):
+        # the fold is jitted once so deriving the step key is one cached
+        # dispatch
+        cnt = self._advance_rng_counter(scope, 1)
+        seed = self._program_seed(program)
         if Executor._fold_rng is None:
             Executor._fold_rng = jax.jit(
                 lambda s, c: jax.random.fold_in(jax.random.key(s), c))
@@ -1288,6 +1505,62 @@ class Executor:
                 self._seed_cache[0] != seed:
             self._seed_cache = (seed, jnp.int32(seed))
         return Executor._fold_rng(self._seed_cache[1], np.int32(cnt))
+
+    def _next_rng_window(self, scope: Scope, program: Program,
+                         n_steps: int):
+        """Base key + starting global step index for a windowed run. The
+        counter advances by n_steps, so the per-step keys the scan body
+        derives — fold_in(key(seed), idx0 + i) — are EXACTLY the keys
+        n_steps sequential single-step run() calls would draw."""
+        cnt = self._advance_rng_counter(scope, n_steps)
+        seed = self._program_seed(program)
+        if getattr(self, "_base_key_cache", None) is None or \
+                self._base_key_cache[0] != seed:
+            self._base_key_cache = (seed, jax.random.key(seed))
+        return self._base_key_cache[1], cnt
+
+    def _run_window_fallback(self, program, feed, fetch_list, scope,
+                             return_numpy, mesh, param_shardings, n_steps,
+                             window_names):
+        """Per-step loop with the windowed-run CONTRACT (slice i per
+        step, one global rng step per slice, stacked fetches) for paths
+        where one-dispatch scanning is unavailable — see the call site
+        in run(). Each step re-enters run() with n_steps=1, so the
+        per-path semantics (segment islands, interpreter, mesh
+        placement) are exactly the sequential-loop ones."""
+        from . import profiler as _profiler
+        ctx = (_profiler.RecordEvent(f"window[{n_steps}]:fallback",
+                                     cat="window")
+               if _profiler.is_profiling() else contextlib.nullcontext())
+        per_step = []
+        with ctx:
+            for i in range(n_steps):
+                f = {}
+                for n, v in feed.items():
+                    if n in window_names:
+                        a = v.array if isinstance(v, LoDTensor) else v
+                        f[n] = a[i]
+                    else:
+                        f[n] = v
+                per_step.append(self.run(
+                    program, feed=f, fetch_list=fetch_list, scope=scope,
+                    return_numpy=return_numpy, mesh=mesh,
+                    param_shardings=param_shardings))
+        if not per_step or not per_step[0]:
+            return per_step[-1] if per_step else []
+        n_fetch = len(per_step[0])
+        if return_numpy:
+            return [np.stack([s[k] for s in per_step])
+                    for k in range(n_fetch)]
+        stacked = []
+        for k in range(n_fetch):
+            if any(s[k].lod() for s in per_step):
+                raise NotImplementedError(
+                    "windowed run cannot stack LoD-carrying fetches — "
+                    "fetch dense vars or run per-step (n_steps=1)")
+            stacked.append(
+                LoDTensor(jnp.stack([s[k].array for s in per_step])))
+        return stacked
 
     # feeds above this size pay more for the content scan than the
     # device_put it could skip; they always re-upload

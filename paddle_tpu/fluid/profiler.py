@@ -120,7 +120,10 @@ class RecordEvent:
     manager or decorator; no-op when profiling is off. ``cat`` groups
     spans in the chrome trace — the segmented executor emits its
     per-segment compile/exec and island spans under cat='segment' so the
-    compiled/interpreted partition of a step is visible at a glance."""
+    compiled/interpreted partition of a step is visible at a glance, and
+    multi-step windows emit one cat='window' span per dispatched window
+    (window[K]:realdata | :broadcast | :fallback — the one-dispatch-per-
+    window evidence tests/test_window_executor.py counts)."""
 
     def __init__(self, name: str, cat: str = "host"):
         self.name = name

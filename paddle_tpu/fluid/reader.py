@@ -4,7 +4,13 @@ DataLoader.from_generator/from_dataset, GeneratorLoader).
 TPU design: the async C++ BufferedReader/py_reader double-buffering of the
 reference is replaced by a host-side prefetch thread; device transfer
 overlaps with compute because jax dispatch is async. set_sample_generator /
-set_sample_list_generator / set_batch_generator mirror the reference API."""
+set_sample_list_generator / set_batch_generator mirror the reference API.
+
+``window(k)`` goes one further than the reference's double buffering: a
+background stage stacks K host batches into ONE [K, batch, ...] feed dict
+and device_puts window i+1 while window i computes — the executor consumes
+it as a single dispatched lax.scan over K *distinct* batches
+(``Executor.run(n_steps=K)``; docs/INPUT_PIPELINE.md)."""
 from __future__ import annotations
 
 import queue
@@ -17,19 +23,125 @@ from . import core
 from .data_feeder import DataFeeder
 from .framework import Variable
 
-__all__ = ["DataLoader", "PyReader"]
+__all__ = ["DataLoader", "PyReader", "WindowBatch"]
+
+
+class WindowBatch(dict):
+    """K stacked batches as one feed dict: every value carries a leading
+    [k, ...] window dim — feed it straight into ``Executor.run`` with
+    ``n_steps=k``. ``n_valid`` ≤ k counts the real (unpadded) steps;
+    ``mask`` is a [k] float32 0/1 vector. A padded tail window
+    (``drop_last=False``) repeats its final real batch, and those padded
+    steps DO execute — weight per-step stacked fetches by ``mask`` (and
+    be aware padded steps also apply optimizer updates; drop the tail
+    when exact epoch semantics matter)."""
+
+    def __init__(self, data, k: int, n_valid: int):
+        super().__init__(data)
+        self.k = int(k)
+        self.n_valid = int(n_valid)
+
+    @property
+    def mask(self) -> np.ndarray:
+        m = np.zeros(self.k, np.float32)
+        m[:self.n_valid] = 1.0
+        return m
+
+
+def _iter_through_queue(src_iter, capacity: int, transform=None):
+    """Bridge ``src_iter`` through a bounded queue filled by a daemon
+    thread (the prefetch shape every loader stage here uses). The
+    producer applies ``transform`` to each item (e.g. the device upload)
+    so that work overlaps the consumer's compute; generator errors
+    re-raise in the consumer. When the consumer goes away early (break,
+    exception, GC) the ``finally`` signals the producer, which abandons
+    its blocked put instead of pinning ``capacity`` buffered items for
+    the process lifetime."""
+    q: "queue.Queue" = queue.Queue(max(1, capacity))
+    DONE, ERR = object(), object()
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in src_iter:
+                if transform is not None:
+                    item = transform(item)
+                if not put(item):
+                    return  # consumer gone
+            put(DONE)
+        except BaseException as e:  # surface in the consumer
+            put((ERR, e))
+
+    threading.Thread(target=producer, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is DONE:
+                return
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and item[0] is ERR:
+                raise item[1]
+            yield item
+    finally:
+        stop.set()
+
+
+def _stack_window(batches, k: int, n_valid: int) -> WindowBatch:
+    """Stack a list of feed dicts along a new leading window dim. LoD
+    batches are refused (one LoD cannot describe K stacked batches) and
+    ragged batch shapes get a pointed error instead of np.stack's."""
+    first = batches[0]
+    out = {}
+    for name in first:
+        parts = []
+        for b in batches:
+            v = b[name]
+            if isinstance(v, core.LoDTensor):
+                if v.lod():
+                    raise ValueError(
+                        f"window(): batch var '{name}' carries LoD — "
+                        f"stacked windows need dense batches; keep LoD "
+                        f"data on the per-step path")
+                v = v.array
+            parts.append(np.asarray(v))
+        if any(p.shape != parts[0].shape for p in parts[1:]):
+            raise ValueError(
+                f"window(): ragged batch shapes for '{name}' "
+                f"({sorted({p.shape for p in parts})}) — use a "
+                f"fixed batch_size (drop_last=True upstream) so K "
+                f"batches stack")
+        out[name] = np.stack(parts)
+    return WindowBatch(out, k, n_valid)
 
 
 class _GeneratorLoader:
     def __init__(self, feed_list, capacity=16, iterable=True,
-                 return_list=False, use_multiprocess=False):
+                 return_list=False, use_multiprocess=False,
+                 drop_last=True, worker_timeout=None, join_timeout=None):
         self._feed_list = feed_list or []
         self._capacity = capacity
         self._iterable = iterable
         self._return_list = return_list
         self._use_multiprocess = use_multiprocess
+        self._drop_last = drop_last
+        # multiprocess liveness/teardown timeouts: kwarg wins, else the
+        # FLAGS_dataloader_*_timeout globals (read at iteration time so
+        # tests/flags can adjust after construction)
+        self._worker_timeout = worker_timeout
+        self._join_timeout = join_timeout
         self._batch_fn: Optional[Callable] = None
         self._places = None
+        self._it = None     # non-iterable (start/next/reset) mode state
+        self._mp_proc = None  # last multiprocess worker (observability)
 
     # -- reference API -----------------------------------------------------
     def set_sample_generator(self, reader, batch_size, drop_last=True,
@@ -82,22 +194,10 @@ class _GeneratorLoader:
         if self._capacity <= 1:
             yield from self._batch_fn()
             return
-        q: "queue.Queue" = queue.Queue(self._capacity)
-        DONE = object()
-
-        def producer():
-            try:
-                for item in self._batch_fn():
-                    q.put(item)
-            finally:
-                q.put(DONE)
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is DONE:
-                break
-            yield item
+        # NOTE: the old inline thread swallowed generator errors
+        # (finally: put(DONE)) and left an abandoned producer blocked on
+        # put forever — the shared bridge fixes both
+        yield from _iter_through_queue(self._batch_fn(), self._capacity)
 
     def _iter_multiprocess(self):
         """Producer process + shared-memory batch transport (reference:
@@ -149,6 +249,12 @@ class _GeneratorLoader:
 
         proc = ctx.Process(target=producer, daemon=True)
         core.start_forked_quietly([proc])
+        self._mp_proc = proc  # observable for tests/debugging
+        liveness = (self._worker_timeout if self._worker_timeout is not None
+                    else float(core.globals_[
+                        "FLAGS_dataloader_worker_timeout"]))
+        join_t = (self._join_timeout if self._join_timeout is not None
+                  else float(core.globals_["FLAGS_dataloader_join_timeout"]))
 
         def _unlink_meta(meta):
             for shm_name, _, _ in meta.values():
@@ -163,8 +269,9 @@ class _GeneratorLoader:
             while True:
                 try:
                     # bounded get + liveness check: a killed child must not
-                    # hang the consumer forever
-                    kind, meta = meta_q.get(timeout=5.0)
+                    # hang the consumer forever (FLAGS_dataloader_worker_
+                    # timeout / worker_timeout= kwarg)
+                    kind, meta = meta_q.get(timeout=liveness)
                 except queue.Empty:
                     if not proc.is_alive():
                         raise RuntimeError(
@@ -189,7 +296,7 @@ class _GeneratorLoader:
                 yield batch
         finally:
             proc.terminate()
-            proc.join(timeout=5.0)
+            proc.join(timeout=join_t)
             # drain the queue unlinking any segments the consumer never
             # touched (early break / producer error), so /dev/shm doesn't
             # accumulate leaked blocks
@@ -201,12 +308,95 @@ class _GeneratorLoader:
                 if kind == "batch":
                     _unlink_meta(meta)
 
+    # ------------------------------------------------------------ windows
+    def window(self, k: int, drop_last=None, prefetch_to_device=True,
+               prefetch_depth=2):
+        """Iterate WindowBatch dicts of K stacked batches (the real-data
+        multi-step shape: ``exe.run(feed=w, n_steps=k)`` scans the K
+        slices in ONE dispatch on the compiled path).
+
+        ``drop_last`` (None → the loader's drop_last): True drops a
+        ragged tail of < k batches; False pads the tail window to k by
+        repeating the final batch and marks it via ``n_valid``/``mask``
+        (pad-and-mask keeps the jit cache at ONE window shape — the TPU
+        trade; the padded steps do execute).
+
+        ``prefetch_to_device``: a background stage device_puts window
+        i+1 while window i computes — jax dispatch is async, so the
+        host→device transfer overlaps compute and the executor receives
+        already-resident arrays it never re-uploads
+        (``_as_lodtensor`` fast path). ``prefetch_depth`` bounds the
+        in-flight windows (2 = classic double buffering)."""
+        if k < 1:
+            raise ValueError(f"window size must be >= 1, got {k}")
+        if drop_last is None:
+            drop_last = self._drop_last
+
+        def assemble():
+            buf = []
+            for batch in self:
+                buf.append(batch)
+                if len(buf) == k:
+                    yield _stack_window(buf, k, k)
+                    buf = []
+            if buf and not drop_last:
+                n = len(buf)
+                buf = buf + [buf[-1]] * (k - n)
+                yield _stack_window(buf, k, n)
+
+        if not prefetch_to_device:
+            return assemble()
+        return _iter_through_queue(assemble(), prefetch_depth,
+                                   transform=self._upload_window)
+
+    @staticmethod
+    def _upload_window(w: WindowBatch) -> WindowBatch:
+        """Device-upload stage run on the prefetch thread: issues the
+        (async) host→device transfer for the NEXT window while the
+        consumer computes on the current one. _to_device_array applies
+        the device int policy (int64 → int32) exactly like the
+        executor's feed path would."""
+        for name in list(w):
+            w[name] = core._to_device_array(w[name])
+        return w
+
     def __call__(self):
         return iter(self)
 
-    # non-iterable (start/reset) mode used with py_reader-style loops
+    # ------------------- non-iterable (start/next/reset) py_reader mode
+    # Reference loop (reader.py PyReader, iterable=False):
+    #     reader.start()
+    #     while True:
+    #         try:    exe.run(feed=reader.next(), ...)
+    #         except fluid.core.EOFException:
+    #             reader.reset(); break
+    # (The reference feeds through in-program read ops; here next()
+    # hands the feed dict to exe.run explicitly.)
     def start(self):
+        if self._iterable:
+            raise RuntimeError(
+                "start() is the non-iterable protocol — construct the "
+                "loader with iterable=False, or just iterate it")
+        if self._it is not None:
+            raise RuntimeError(
+                "DataLoader already started; call reset() before "
+                "starting the next epoch")
         self._it = iter(self)
+
+    def next(self):
+        """Next feed dict; raises core.EOFException when the epoch is
+        drained (reset() then start() rearms — iter(self) re-invokes the
+        generator factory, so epochs restart cleanly)."""
+        if self._it is None:
+            raise RuntimeError("DataLoader not started — call start()")
+        try:
+            return next(self._it)
+        except StopIteration:
+            raise core.EOFException(
+                "DataLoader drained — call reset() (and start() for the "
+                "next epoch)") from None
+
+    next_batch = next  # py_reader-era alias
 
     def reset(self):
         self._it = None
@@ -225,9 +415,13 @@ class DataLoader:
     @staticmethod
     def from_generator(feed_list=None, capacity=16, use_double_buffer=True,
                        iterable=True, return_list=False,
-                       use_multiprocess=False, drop_last=True):
+                       use_multiprocess=False, drop_last=True,
+                       worker_timeout=None, join_timeout=None):
         return _GeneratorLoader(feed_list, capacity, iterable, return_list,
-                                use_multiprocess=use_multiprocess)
+                                use_multiprocess=use_multiprocess,
+                                drop_last=drop_last,
+                                worker_timeout=worker_timeout,
+                                join_timeout=join_timeout)
 
     @staticmethod
     def from_dataset(dataset, places, drop_last=True):

@@ -121,6 +121,44 @@ def test_train_from_dataset(tmp_path, capsys):
     assert final <= first + 0.5
 
 
+def test_train_from_dataset_window_size_lod_fallback(tmp_path):
+    """window_size=K on a dataset whose batches carry LoD must fall back
+    to per-step runs transparently — same training as window_size=1
+    (docs/INPUT_PIPELINE.md: LoD cannot describe stacked windows)."""
+    files, rows = make_files(tmp_path, n_files=2, rows_per_file=8)
+
+    def run(window_size):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 9
+        with fluid.program_guard(main, startup):
+            ids = fluid.data("ids", shape=[1], dtype="int64", lod_level=1)
+            dense = fluid.data("dense", shape=[4], dtype="float32")
+            label = fluid.data("label", shape=[1], dtype="int64")
+            emb = fluid.layers.embedding(ids, size=[20, 8])
+            pooled = fluid.layers.sequence_pool(emb, "sum")
+            feat = fluid.layers.concat([pooled, dense], axis=1)
+            pred = fluid.layers.fc(feat, 2, act="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(pred, label))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(4)
+        ds.set_thread(1)
+        ds.set_filelist(files)
+        ds.set_use_var([ids, dense, label])
+        ds.load_into_memory()
+        exe = fluid.Executor()
+        scope = core.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            out = exe.train_from_dataset(main, ds, fetch_list=[loss],
+                                         print_period=0,
+                                         window_size=window_size)
+        return float(np.asarray(out[0]).reshape(-1)[0])
+
+    np.testing.assert_allclose(run(2), run(1), rtol=2e-5, atol=1e-6)
+
+
 def test_fetch_handler(tmp_path):
     """FetchHandler gets periodic {name: numpy} snapshots during
     train_from_dataset (reference: executor.py FetchHandler +

@@ -1,0 +1,243 @@
+"""DataLoader window assembly + device prefetch (ISSUE 2) and the
+py_reader non-iterable start/next/reset/EOF contract, plus the
+configurable multiprocess liveness timeout."""
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+from paddle_tpu.fluid.reader import DataLoader, PyReader, WindowBatch
+
+
+def _batches(n, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"x": rng.rand(batch, 6).astype("float32"),
+             "y": rng.randint(0, 5, (batch, 1)).astype("int64")}
+            for _ in range(n)]
+
+
+def _loader(batches, **kw):
+    dl = DataLoader.from_generator(capacity=4, **kw)
+    dl.set_batch_generator(lambda: iter(batches))
+    return dl
+
+
+# ------------------------------------------------------- window assembly
+def test_window_stacks_k_batches():
+    batches = _batches(10)
+    ws = list(_loader(batches).window(4, drop_last=True,
+                                      prefetch_to_device=False))
+    assert len(ws) == 2  # ragged tail of 2 dropped
+    for w in ws:
+        assert isinstance(w, WindowBatch)
+        assert w.k == w.n_valid == 4
+        assert w["x"].shape == (4, 8, 6) and w["y"].shape == (4, 8, 1)
+    np.testing.assert_array_equal(ws[0]["x"][1], batches[1]["x"])
+
+
+def test_window_pad_and_mask_tail():
+    batches = _batches(10)
+    ws = list(_loader(batches).window(4, drop_last=False,
+                                      prefetch_to_device=False))
+    assert len(ws) == 3
+    tail = ws[-1]
+    assert tail.k == 4 and tail.n_valid == 2
+    np.testing.assert_array_equal(tail.mask, [1.0, 1.0, 0.0, 0.0])
+    # padding repeats the final real batch
+    np.testing.assert_array_equal(tail["x"][2], batches[9]["x"])
+    np.testing.assert_array_equal(tail["x"][3], batches[9]["x"])
+
+
+def test_window_uses_loader_drop_last_default():
+    batches = _batches(10)
+    assert len(list(_loader(batches, drop_last=True)
+                    .window(4, prefetch_to_device=False))) == 2
+    assert len(list(_loader(batches, drop_last=False)
+                    .window(4, prefetch_to_device=False))) == 3
+
+
+def test_window_refuses_ragged_and_lod_batches():
+    ragged = _batches(3) + [{"x": np.ones((5, 6), np.float32),
+                             "y": np.ones((5, 1), np.int64)}]
+    with pytest.raises(ValueError, match="ragged"):
+        list(_loader(ragged).window(4, drop_last=False,
+                                    prefetch_to_device=False))
+    lod = [{"x": core.LoDTensor(np.ones((8, 6), np.float32),
+                                lod=[[0, 3, 8]])} for _ in range(2)]
+    with pytest.raises(ValueError, match="LoD"):
+        list(_loader(lod).window(2, prefetch_to_device=False))
+
+
+def test_window_prefetch_hands_device_arrays():
+    """The prefetch stage device_puts windows in the background — the
+    consumer receives resident jax arrays with the device int policy
+    (int64 → int32) already applied."""
+    ws = list(_loader(_batches(8)).window(4))
+    assert len(ws) == 2
+    for w in ws:
+        assert all(isinstance(v, jax.Array) for v in w.values())
+        assert w["y"].dtype == np.int32  # device integer policy
+
+
+def test_abandoned_window_iterator_releases_producers():
+    """Breaking out of a window() loop mid-epoch must not leave the
+    prefetch/capacity producer threads blocked on a full queue forever
+    (they'd pin prefetch_depth device-resident windows for the process
+    lifetime)."""
+    import threading
+    before = set(threading.enumerate())
+    for _w in _loader(_batches(64)).window(2, prefetch_depth=1):
+        break  # abandon: generator close() signals the producers
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        leftover = [t for t in threading.enumerate()
+                    if t not in before and t.is_alive()]
+        if not leftover:
+            break
+        time.sleep(0.05)
+    assert not leftover, f"producer threads leaked: {leftover}"
+
+
+def test_window_prefetch_surfaces_generator_error():
+    def bad():
+        yield {"x": np.ones((8, 6), np.float32)}
+        raise RuntimeError("boom in generator")
+    dl = DataLoader.from_generator(capacity=2)
+    dl.set_batch_generator(bad)
+    with pytest.raises(RuntimeError, match="boom in generator"):
+        list(dl.window(1, drop_last=True))
+
+
+def test_window_end_to_end_matches_sequential():
+    """loader.window(k) → exe.run(n_steps=k) == per-batch exe.run."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 3
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[6], dtype="float32")
+            y = fluid.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, 8, act="tanh")
+            pred = fluid.layers.fc(h, 5, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        return main, startup, loss
+
+    batches = _batches(8)
+    main, startup, loss = build()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    win_losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for w in _loader(batches).window(4, drop_last=True):
+            (l,) = exe.run(main, feed=w, fetch_list=[loss], n_steps=w.k)
+            win_losses.extend(np.asarray(l).ravel().tolist())
+
+    main2, startup2, loss2 = build()
+    exe2 = fluid.Executor()
+    scope2 = core.Scope()
+    seq_losses = []
+    with fluid.scope_guard(scope2):
+        exe2.run(startup2)
+        for b in batches:
+            (l,) = exe2.run(main2, feed=b, fetch_list=[loss2])
+            seq_losses.append(float(np.asarray(l).ravel()[0]))
+    np.testing.assert_allclose(win_losses, seq_losses, rtol=2e-5,
+                               atol=1e-6)
+
+
+def test_window_batch_implies_n_steps():
+    """A WindowBatch carries its own window length: forgetting n_steps=k
+    must run K steps anyway (not broadcast the stack as one giant
+    step), and a contradictory n_steps raises."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 3
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[6], dtype="float32")
+            h = fluid.layers.fc(x, 4)
+            loss = fluid.layers.mean(h)
+        return main, startup, loss
+
+    batches = _batches(4)
+    w = next(iter(_loader(batches).window(4, drop_last=True)))
+    main, startup, loss = build()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (l,) = exe.run(main, feed=w, fetch_list=[loss])  # no n_steps=
+        assert np.asarray(l).shape[0] == 4  # ran as a 4-step window
+        with pytest.raises(ValueError, match="WindowBatch"):
+            exe.run(main, feed=w, fetch_list=[loss], n_steps=2)
+
+
+# ------------------------------- non-iterable start/next/reset contract
+def test_py_reader_start_next_reset_eof():
+    """Regression (ISSUE 2 satellite): the old start() set self._it but
+    nothing consumed it and reset() couldn't restart an epoch."""
+    batches = _batches(5)
+    pr = PyReader(iterable=False)
+    pr.decorate_batch_generator(lambda: iter(batches))
+
+    for _epoch in range(2):  # reset() + start() must rearm cleanly
+        pr.start()
+        seen = 0
+        while True:
+            try:
+                b = pr.next()
+            except core.EOFException:
+                pr.reset()
+                break
+            np.testing.assert_array_equal(b["x"], batches[seen]["x"])
+            seen += 1
+        assert seen == 5
+
+
+def test_py_reader_contract_misuse_raises():
+    batches = _batches(2)
+    pr = PyReader(iterable=False)
+    pr.decorate_batch_generator(lambda: iter(batches))
+    with pytest.raises(RuntimeError, match="not started"):
+        pr.next()
+    pr.start()
+    with pytest.raises(RuntimeError, match="already started"):
+        pr.start()
+    pr.reset()
+    # iterable loaders don't take the protocol
+    it_loader = _loader(batches)
+    with pytest.raises(RuntimeError, match="iterable=False"):
+        it_loader.start()
+
+
+# ------------------------------------ multiprocess liveness timeout
+def _slow_gen():
+    yield {"x": np.ones((4, 3), np.float32)}
+    time.sleep(600)  # never yields again; worker must be killed
+
+
+def test_multiprocess_killed_worker_raises_not_hangs():
+    """A killed worker must surface RuntimeError within ~worker_timeout
+    (was a hardcoded 5 s; now FLAGS_dataloader_worker_timeout or the
+    worker_timeout kwarg)."""
+    dl = DataLoader.from_generator(capacity=2, use_multiprocess=True,
+                                   worker_timeout=0.5, join_timeout=2.0)
+    dl.set_batch_generator(_slow_gen)
+    it = iter(dl)
+    first = next(it)
+    assert first["x"].shape == (4, 3)
+    assert dl._mp_proc is not None and dl._mp_proc.is_alive()
+    dl._mp_proc.kill()
+    t0 = time.time()
+    with pytest.raises(RuntimeError, match="died without"):
+        next(it)
+    assert time.time() - t0 < 10.0  # bounded by the liveness probe
+
+
+def test_dataloader_timeout_flags_exist():
+    assert core.globals_["FLAGS_dataloader_worker_timeout"] == 5.0
+    assert core.globals_["FLAGS_dataloader_join_timeout"] == 5.0
