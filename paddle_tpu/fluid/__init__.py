@@ -35,7 +35,8 @@ from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
 from . import io
 from .io import (save_vars, save_params, save_persistables, load_vars,
                  load_params, load_persistables, save_inference_model,
-                 load_inference_model, save, load)
+                 load_inference_model, save, load, save_checkpoint,
+                 load_checkpoint, latest_checkpoint, validate_checkpoint)
 from . import dygraph
 from . import metrics
 from . import profiler

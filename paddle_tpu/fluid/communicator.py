@@ -12,13 +12,18 @@ here instead of issuing one RPC each; per-var merge threads sum up to
 AsyncCommunicator contract). SYNC mode needs no communicator at all."""
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from . import core
+
 __all__ = ["Communicator", "LargeScaleKV"]
+
+_LOG = logging.getLogger("paddle_tpu.ps")
 
 
 class Communicator:
@@ -31,6 +36,12 @@ class Communicator:
         self._max_merge = int(envs.get("communicator_max_merge_var_num", 20))
         self._wait_times = float(
             envs.get("communicator_send_wait_times", 0.005))
+        # stop(): how long to wait per merge thread before logging a
+        # warning and moving on (env wins, then the FLAG)
+        jt = envs.get("communicator_send_join_timeout")
+        self._join_timeout = (float(jt) if jt is not None else
+                              float(core.globals_[
+                                  "FLAGS_communicator_join_timeout"]))
         self._queues: Dict[Tuple[str, str], "queue.Queue"] = {}
         self._threads: list = []
         self._lock = threading.Lock()
@@ -45,7 +56,16 @@ class Communicator:
         if Communicator._global is self:
             Communicator._global = None
         for t in self._threads:
-            t.join(timeout=1.0)
+            t.join(timeout=self._join_timeout)
+            if t.is_alive():
+                # a leaked thread means a send is wedged (dead pserver,
+                # RPC retry loop) — name it so the operator can tell
+                # WHICH var/endpoint queue is stuck
+                _LOG.warning(
+                    "Communicator.stop: merge thread %r still running "
+                    "after %.1fs join timeout — a send to its endpoint "
+                    "is wedged; its queued grads may be dropped",
+                    t.name, self._join_timeout)
         # flush whatever is still queued — fully, not just one merge batch.
         # Snapshot under the lock and bound the loop so a misbehaving
         # producer still pushing during stop() can't spin this forever.
@@ -79,11 +99,34 @@ class Communicator:
             q = self._queues.get(key)
             if q is None:
                 q = self._queues[key] = queue.Queue()
-                t = threading.Thread(target=self._merge_loop,
-                                     args=(key, trainer_id), daemon=True)
+                t = threading.Thread(
+                    target=self._merge_loop, args=(key, trainer_id),
+                    name=f"communicator-merge-{name}@{endpoint}",
+                    daemon=True)
                 t.start()
                 self._threads.append(t)
         q.put(np.asarray(value))
+
+    def _send_merged(self, name, ep, merged, trainer_id) -> bool:
+        """Ship one merged grad; ANY failure — transport failure past
+        the RPC plane's own retries, or a server-side rejection — DROPS
+        it with a warning instead of killing the merge thread
+        (async/GEO semantics tolerate a lost delta — a dead thread
+        would silently pin the queue and every later grad)."""
+        from .ps_rpc import VarClient
+        try:
+            VarClient.of(ep).send_var(name, merged, trainer_id=trainer_id)
+            return True
+        except (ConnectionError, OSError) as e:
+            _LOG.warning(
+                "Communicator: dropping merged grad '%s' for %s — "
+                "endpoint unreachable after RPC retries (%r)", name, ep, e)
+            return False
+        except Exception as e:  # noqa: BLE001 — server-side rejection
+            _LOG.warning(
+                "Communicator: dropping merged grad '%s' for %s — "
+                "server rejected it (%r)", name, ep, e)
+            return False
 
     def _drain(self, key, trainer_id=0):
         name, ep = key
@@ -100,8 +143,7 @@ class Communicator:
             merged = v if merged is None else merged + v
             n += 1
         if merged is not None:
-            from .ps_rpc import VarClient
-            VarClient.of(ep).send_var(name, merged, trainer_id=trainer_id)
+            self._send_merged(name, ep, merged, trainer_id)
 
     def _merge_loop(self, key, trainer_id):
         name, ep = key
@@ -122,8 +164,7 @@ class Communicator:
                     n += 1
                 except queue.Empty:
                     break
-            from .ps_rpc import VarClient
-            VarClient.of(ep).send_var(name, merged, trainer_id=trainer_id)
+            self._send_merged(name, ep, merged, trainer_id)
 
     def recv(self):
         pass

@@ -34,7 +34,8 @@ __all__ = [
     "LazyEmbeddingTable",
     "Variable", "Scope", "globals_", "get_flag", "set_flag",
     "dtype_to_np", "np_to_dtype", "dtype_to_jnp", "is_float_dtype",
-    "is_compiled_with_tpu", "EOFException",
+    "is_compiled_with_tpu", "EOFException", "WorkerDeadError",
+    "RpcProtocolError", "CheckpointError",
 ]
 
 
@@ -44,6 +45,27 @@ class EOFException(Exception):
     EnforceNotMet-EOF that ``exe.run`` surfaces in the py_reader loop;
     the user catches it, calls ``reader.reset()`` and starts the next
     epoch)."""
+
+
+class WorkerDeadError(RuntimeError):
+    """A collective operation (barrier / reduce) released because a
+    participant was declared dead by the pserver's HeartBeatMonitor —
+    survivors get this promptly (≈ the heartbeat timeout) instead of
+    blocking for the full barrier deadline. The message names the dead
+    worker id(s) so launchers can act (docs/FAULT_TOLERANCE.md)."""
+
+
+class RpcProtocolError(ConnectionError):
+    """The RPC wire framing is invalid — e.g. a length prefix beyond
+    FLAGS_rpc_max_message_size (garbage or malicious peer). Never
+    retried: retry applies to transient transport failures, not to a
+    corrupted protocol stream."""
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint directory failed validation (missing manifest,
+    missing files, size/CRC mismatches) or load_vars found missing
+    files. The message aggregates EVERY bad file, not just the first."""
 
 
 # --------------------------------------------------------------------------
@@ -601,8 +623,23 @@ class _GlobalFlags:
         "FLAGS_fraction_of_gpu_memory_to_use": 1.0,
         "FLAGS_paddle_num_threads": 1,
         "FLAGS_use_pinned_memory": True,
+        # RPC fault tolerance (fluid/ps_rpc.py VarClient.call): per-call
+        # deadline in MILLISECONDS (reference FLAGS_rpc_deadline), and how
+        # many times a transient ConnectionError/OSError is retried with
+        # exponential backoff + reconnect before surfacing
         "FLAGS_rpc_deadline": 180000,
         "FLAGS_rpc_retry_times": 3,
+        # wire-framing guard: a length prefix beyond this raises
+        # RpcProtocolError instead of attempting a giant allocation
+        # (default 1 GiB — generous; real payloads are var-sized blobs)
+        "FLAGS_rpc_max_message_size": 1 << 30,
+        # how long a pserver-side collective (sync barrier / reduce) waits
+        # for stragglers before raising TimeoutError, in seconds; a DEAD
+        # participant releases much earlier with WorkerDeadError
+        "FLAGS_barrier_deadline": 300.0,
+        # Communicator.stop(): how long to wait for each merge thread to
+        # drain before logging a warning and moving on
+        "FLAGS_communicator_join_timeout": 1.0,
         "FLAGS_sync_nccl_allreduce": True,   # no-op: ICI collectives are compiled
         "FLAGS_executor_mode": "compiled",   # compiled | interpreted
         # segmented compilation: when a block fails the all-or-nothing

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+import os
 import weakref
 
 import numpy as np
@@ -1106,6 +1107,9 @@ class Executor:
         # "interpreted" (observability for tests/bench — e.g. the
         # compiled_metric flag in bench.py wide_deep rows)
         self._last_run_mode: Optional[str] = None
+        # periodic atomic checkpointing (set_auto_checkpoint /
+        # resume_from — docs/FAULT_TOLERANCE.md)
+        self._auto_ckpt: Optional[Dict[str, Any]] = None
 
     def _build_segmented(self, program, feed, fetch_names, scope, seed,
                          feed_lods) -> Optional[_SegmentedBlock]:
@@ -1148,6 +1152,95 @@ class Executor:
     # ------------------------------------------------------------------ API
     def close(self):
         self._closed = True
+
+    # ------------------------------------------- fault-tolerant training
+    def set_auto_checkpoint(self, dirname, every_n_steps: int,
+                            program=None, scope: Optional[Scope] = None,
+                            max_to_keep: int = 3, dataloader=None):
+        """Enable periodic atomic checkpoints: every run() whose global
+        step counter crosses a multiple of ``every_n_steps`` snapshots
+        all persistables (params + optimizer slots) plus the rng fold
+        counter to ``dirname/ckpt-<step>`` (io.save_checkpoint — temp
+        dir, fsync, rename; a kill mid-save can't corrupt an existing
+        checkpoint). ``program``/``scope`` (when given) restrict which
+        runs are counted — pass the TRAINING program so startup or eval
+        runs don't trigger saves. ``dataloader``: its state_dict() rides
+        the manifest so resume can fast-forward the input stream.
+        ``every_n_steps <= 0`` disables."""
+        if not dirname or every_n_steps <= 0:
+            self._auto_ckpt = None
+            return
+        self._auto_ckpt = {
+            "dir": dirname, "every": int(every_n_steps),
+            "program": program, "scope": scope,
+            "max_to_keep": int(max_to_keep), "dataloader": dataloader,
+            "last_step": 0,
+        }
+
+    def resume_from(self, path, program=None, scope: Optional[Scope] = None,
+                    dataloader=None) -> Optional[Dict[str, Any]]:
+        """Restore the newest VALID checkpoint under ``path`` (or that
+        exact ckpt dir): parameters, optimizer slot vars, the global rng
+        fold counter, and (when ``dataloader`` is passed) the input
+        stream position — a killed-and-resumed run then produces
+        bit-identical per-step losses to an uninterrupted one (the
+        kill-resume parity test in tests/test_fault_tolerance.py).
+        Returns the manifest, or None when ``path`` has no checkpoint
+        yet (a fresh start — callers can treat both cases uniformly)."""
+        from . import io as _io
+        if scope is None:
+            scope = global_scope()
+        if isinstance(path, str) and not os.path.isdir(path):
+            return None  # checkpoint root never created: fresh start
+        try:
+            manifest = _io.load_checkpoint(self, path,
+                                           main_program=program,
+                                           scope=scope)
+        except core.CheckpointError:
+            if _io.latest_checkpoint(path) is None and \
+                    not os.path.exists(os.path.join(path,
+                                                    _io.CKPT_MANIFEST)):
+                # nothing restorable: fresh start — loud when ckpt dirs
+                # exist but ALL failed validation (vs. a truly empty root)
+                if _io._checkpoint_steps(path):
+                    import warnings as _warnings
+                    _warnings.warn(
+                        f"resume_from({path!r}): checkpoints exist but "
+                        f"none validated — starting FRESH from step 0",
+                        stacklevel=2)
+                return None
+            raise
+        if dataloader is not None and manifest.get("dataloader"):
+            dataloader.load_state_dict(manifest["dataloader"])
+        if self._auto_ckpt is not None:
+            self._auto_ckpt["last_step"] = int(manifest["global_step"])
+        return manifest
+
+    def _maybe_auto_checkpoint(self, program, scope: Scope):
+        cfg = self._auto_ckpt
+        if cfg is None:
+            return
+        if cfg["program"] is not None and program is not cfg["program"]:
+            return
+        if cfg["scope"] is not None and scope is not cfg["scope"]:
+            return
+        step = Executor._rng_counters.get(scope)
+        if step is None:
+            return
+        every = cfg["every"]
+        if step // every <= cfg["last_step"] // every:
+            return  # no boundary crossed since the last save
+        from . import io as _io
+        dl = cfg["dataloader"]
+        dl_state = (dl.state_dict()
+                    if dl is not None and hasattr(dl, "state_dict")
+                    else None)
+        _io.save_checkpoint(self, cfg["dir"],
+                            main_program=cfg["program"] or program,
+                            scope=scope, global_step=step,
+                            dataloader_state=dl_state,
+                            max_to_keep=cfg["max_to_keep"])
+        cfg["last_step"] = step
 
     def run(self, program: Optional[Program] = None, feed=None,
             fetch_list=None, feed_var_name="feed", fetch_var_name="fetch",
@@ -1336,6 +1429,10 @@ class Executor:
                     fetched.append(val)
                     fetch_lods.append(None)
 
+        # periodic atomic checkpoint AFTER the step's state writeback —
+        # the snapshot sees exactly the post-step scope
+        self._maybe_auto_checkpoint(program, scope)
+
         if fetch_names and return_numpy:
             return [_restore_fetch_dtype(program, n, _fetch_to_host(f))
                     for n, f in zip(fetch_names, fetched)]
@@ -1355,7 +1452,9 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           fetch_handler=None, mesh=None, window_size=1):
+                           fetch_handler=None, mesh=None, window_size=1,
+                           checkpoint_dir=None,
+                           checkpoint_every_n_steps=0, resume_from=None):
         """One pass over a Dataset (reference: executor.py:1438
         train_from_dataset → C++ MultiTrainer/HogwildWorker threads,
         trainer.h:64). The TPU inversion: batches stream from the native
@@ -1367,7 +1466,22 @@ class Executor:
         ``window_size=K``: stack K consecutive dense same-shape batches
         into one [K, ...]-windowed run (ONE dispatch on the compiled
         path — docs/INPUT_PIPELINE.md); batches that carry LoD or ragged
-        shapes run per-step as before."""
+        shapes run per-step as before.
+
+        ``checkpoint_dir`` + ``checkpoint_every_n_steps``: enable
+        periodic atomic checkpoints for this training program (see
+        set_auto_checkpoint); ``resume_from``: restore the newest valid
+        checkpoint under that path first (see resume_from) — together
+        they make a killed-and-relaunched dataset run continue with
+        bit-identical rng streams (docs/FAULT_TOLERANCE.md)."""
+        if program is None:
+            program = default_main_program()
+        if checkpoint_dir and checkpoint_every_n_steps > 0:
+            self.set_auto_checkpoint(checkpoint_dir,
+                                     checkpoint_every_n_steps,
+                                     program=program, scope=scope)
+        if resume_from:
+            self.resume_from(resume_from, program=program, scope=scope)
         return self._run_from_dataset(program, dataset, scope, fetch_list,
                                       fetch_info, print_period,
                                       fetch_handler, mesh=mesh,

@@ -13,9 +13,13 @@ Format per LoDTensor:
 """
 from __future__ import annotations
 
+import json
+import logging
 import os
+import shutil
 import struct
-from typing import List, Optional
+import zlib
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -28,7 +32,11 @@ __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
     "load_inference_model", "save", "load",
+    "save_checkpoint", "load_checkpoint", "latest_checkpoint",
+    "validate_checkpoint",
 ]
+
+_LOG = logging.getLogger("paddle_tpu.io")
 
 
 def _serialize_lod_tensor(t: LoDTensor, as_fp16: bool = False) -> bytes:
@@ -152,10 +160,18 @@ def load_vars(executor, dirname, main_program=None, vars=None,
                 if predicate is None or predicate(v)]
     scope = global_scope()
     if filename is None:
+        # collect EVERY missing file before failing — a checkpoint with
+        # 40 absent slot vars reports all 40 in one error, not a
+        # 40-iteration whack-a-mole (CheckpointError IS a RuntimeError,
+        # so existing handlers keep working)
+        missing = [os.path.join(dirname, v.name) for v in vars
+                   if not os.path.exists(os.path.join(dirname, v.name))]
+        if missing:
+            raise core.CheckpointError(
+                f"{len(missing)} checkpoint file(s) missing under "
+                f"{dirname}: " + ", ".join(sorted(missing)))
         for v in vars:
             path = os.path.join(dirname, v.name)
-            if not os.path.exists(path):
-                raise RuntimeError(f"missing checkpoint file {path}")
             with open(path, "rb") as f:
                 scope.var(v.name).set_value(_deserialize_lod_tensor(f.read()))
     else:
@@ -283,3 +299,231 @@ def load(program: Program, model_path: str, executor=None, var_list=None):
         t = LoDTensor()
         t.set(arr)
         scope.var(name).set_value(t)
+
+
+# --------------------------------------------------------------------------
+# Atomic checkpoints with bit-exact resume (docs/FAULT_TOLERANCE.md)
+#
+# Layout: <root>/ckpt-<global_step>/ holding one reference-format tensor
+# blob per persistable var plus MANIFEST.json (format_version, global_step,
+# rng_counter, per-file crc32+size, dataloader state, extra). A checkpoint
+# is written to a temp dir, every file fsynced, then renamed into place —
+# a kill mid-save leaves only a .tmp-* dir that validation never selects,
+# so the previous intact checkpoint always wins (the reference's
+# save_persistables writes in place and a mid-save kill corrupts the only
+# copy — checkpoint_notify_op.cc has no atomicity either).
+# --------------------------------------------------------------------------
+CKPT_PREFIX = "ckpt-"
+CKPT_MANIFEST = "MANIFEST.json"
+CKPT_FORMAT_VERSION = 1
+RNG_COUNTER_VAR = "@RNG_COUNTER@"
+
+
+def _fsync_path(path: str):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _scope_rng_counter(scope) -> int:
+    v = scope.find_var(RNG_COUNTER_VAR)
+    if v is None or not v.is_initialized():
+        return 0
+    return int(np.asarray(v.get_tensor().array).reshape(-1)[0])
+
+
+def save_checkpoint(executor, dirname, main_program=None, scope=None,
+                    global_step: int = 0, dataloader_state=None,
+                    extra=None, max_to_keep: int = 3) -> str:
+    """Write one atomic checkpoint to ``<dirname>/ckpt-<global_step>``.
+
+    Captures every initialized persistable LoDTensor of ``main_program``
+    (parameters AND optimizer slot vars — momentum velocities, adam
+    moments, LR schedules), the scope's global rng fold counter (what
+    makes resumed dropout streams bit-identical), plus opaque
+    ``dataloader_state`` (e.g. ``DataLoader.state_dict()``) and ``extra``
+    for the manifest. Keeps the newest ``max_to_keep`` checkpoints.
+    Returns the final checkpoint directory."""
+    if main_program is None:
+        main_program = default_main_program()
+    if scope is None:
+        scope = global_scope()
+    step = int(global_step)
+    os.makedirs(dirname, exist_ok=True)
+    tmp = os.path.join(dirname, f".tmp-{CKPT_PREFIX}{step}-{os.getpid()}")
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    files: Dict[str, Dict[str, int]] = {}
+    for v in main_program.list_vars():
+        if not _is_persistable(v):
+            continue
+        sv = scope.find_var(v.name)
+        if sv is None or not sv.is_initialized():
+            continue
+        val = sv.value()
+        if not isinstance(val, LoDTensor):
+            _LOG.warning("checkpoint: skipping non-dense persistable "
+                         "'%s' (%s)", v.name, type(val).__name__)
+            continue
+        blob = _serialize_lod_tensor(val)
+        path = os.path.join(tmp, v.name)
+        with open(path, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        files[v.name] = {"crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+                         "size": len(blob)}
+    manifest = {
+        "format_version": CKPT_FORMAT_VERSION,
+        "global_step": step,
+        "rng_counter": _scope_rng_counter(scope),
+        "files": files,
+        "dataloader": dataloader_state,
+        "extra": extra,
+    }
+    mpath = os.path.join(tmp, CKPT_MANIFEST)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_path(tmp)
+    final = os.path.join(dirname, f"{CKPT_PREFIX}{step}")
+    aside = None
+    if os.path.exists(final):
+        # same-step overwrite: move the old dir ASIDE first so a kill
+        # between here and the rename below can never destroy the only
+        # copy — the aside dir (non-numeric suffix) is never a resume
+        # candidate and gets pruned
+        aside = f"{final}.old-{os.getpid()}"
+        if os.path.exists(aside):
+            shutil.rmtree(aside)
+        os.rename(final, aside)
+    os.rename(tmp, final)
+    _fsync_path(dirname)
+    if aside is not None:
+        shutil.rmtree(aside, ignore_errors=True)
+    _prune_checkpoints(dirname, max_to_keep)
+    return final
+
+
+def _checkpoint_steps(dirname) -> List[int]:
+    steps = []
+    try:
+        entries = os.listdir(dirname)
+    except OSError:
+        return steps
+    for name in entries:
+        if not name.startswith(CKPT_PREFIX):
+            continue
+        try:
+            steps.append(int(name[len(CKPT_PREFIX):]))
+        except ValueError:
+            continue
+    return sorted(steps)
+
+
+def _prune_checkpoints(dirname, max_to_keep: int):
+    if not max_to_keep or max_to_keep <= 0:
+        return
+    steps = _checkpoint_steps(dirname)
+    for step in steps[:-max_to_keep]:
+        shutil.rmtree(os.path.join(dirname, f"{CKPT_PREFIX}{step}"),
+                      ignore_errors=True)
+    # stale temp/aside dirs from killed saves are garbage by definition
+    for name in os.listdir(dirname):
+        if name.startswith(".tmp-" + CKPT_PREFIX) or \
+                (name.startswith(CKPT_PREFIX) and ".old-" in name):
+            shutil.rmtree(os.path.join(dirname, name), ignore_errors=True)
+
+
+def validate_checkpoint(ckpt_dir) -> Dict[str, Any]:
+    """Validate one checkpoint directory against its manifest. Returns
+    the manifest; raises ``core.CheckpointError`` aggregating EVERY
+    problem (missing manifest, missing files, size/CRC mismatches) —
+    a truncated or bit-flipped checkpoint is rejected wholesale."""
+    mpath = os.path.join(ckpt_dir, CKPT_MANIFEST)
+    if not os.path.exists(mpath):
+        raise core.CheckpointError(
+            f"checkpoint {ckpt_dir}: no {CKPT_MANIFEST} — incomplete "
+            f"save (killed mid-write) or not a checkpoint directory")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (ValueError, OSError) as e:
+        raise core.CheckpointError(
+            f"checkpoint {ckpt_dir}: unreadable manifest: {e}") from e
+    problems = []
+    for name, meta in sorted(manifest.get("files", {}).items()):
+        path = os.path.join(ckpt_dir, name)
+        if not os.path.exists(path):
+            problems.append(f"missing file '{name}'")
+            continue
+        size = os.path.getsize(path)
+        if size != int(meta["size"]):
+            problems.append(
+                f"'{name}' truncated ({size} bytes, manifest says "
+                f"{meta['size']})")
+            continue
+        crc = 0
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                crc = zlib.crc32(chunk, crc)
+        if (crc & 0xFFFFFFFF) != int(meta["crc32"]):
+            problems.append(f"'{name}' CRC mismatch (corrupted)")
+    if problems:
+        raise core.CheckpointError(
+            f"checkpoint {ckpt_dir} failed validation "
+            f"({len(problems)} problem(s)): " + "; ".join(problems))
+    return manifest
+
+
+def _latest_valid(dirname):
+    """(dir, manifest) of the newest checkpoint under ``dirname`` that
+    PASSES validation, or (None, None). Corrupt/incomplete candidates
+    are logged and skipped — a kill mid-save can never shadow the
+    previous intact checkpoint."""
+    for step in reversed(_checkpoint_steps(dirname)):
+        cand = os.path.join(dirname, f"{CKPT_PREFIX}{step}")
+        try:
+            return cand, validate_checkpoint(cand)
+        except core.CheckpointError as e:
+            _LOG.warning("skipping invalid checkpoint %s: %s", cand, e)
+    return None, None
+
+
+def latest_checkpoint(dirname) -> Optional[str]:
+    return _latest_valid(dirname)[0]
+
+
+def load_checkpoint(executor, path, main_program=None, scope=None
+                    ) -> Dict[str, Any]:
+    """Restore a checkpoint saved by ``save_checkpoint``. ``path`` may be
+    a specific ``ckpt-<n>`` directory or a root holding several (the
+    newest VALID one is picked). Restores every manifest-listed var into
+    ``scope`` and the global rng fold counter — the next step after
+    resume folds the same per-step keys an uninterrupted run would, so
+    dropout streams (and hence losses) are bit-identical. Returns the
+    manifest (global_step, dataloader state, extra)."""
+    if scope is None:
+        scope = global_scope()
+    if os.path.exists(os.path.join(path, CKPT_MANIFEST)):
+        ckpt_dir, manifest = path, validate_checkpoint(path)
+    else:  # one validation pass total: pick + validate together
+        ckpt_dir, manifest = _latest_valid(path)
+        if ckpt_dir is None:
+            raise core.CheckpointError(
+                f"no valid checkpoint found under {path}")
+    for name in manifest.get("files", {}):
+        with open(os.path.join(ckpt_dir, name), "rb") as f:
+            scope.var(name).set_value(_deserialize_lod_tensor(f.read()))
+    counter = int(manifest.get("rng_counter", 0))
+    scope.var(RNG_COUNTER_VAR).set_value(
+        LoDTensor(np.asarray([counter], np.int32)))
+    # the Executor mirrors the counter in a host-side WeakKeyDictionary —
+    # sync it or the next _advance_rng_counter would ignore the scope var
+    from .executor import Executor
+    Executor._rng_counters[scope] = counter
+    return manifest

@@ -11,20 +11,42 @@ fine here: the payloads are numpy blobs and the work is IO-bound.
 
 Wire format: 8-byte big-endian length + pickle of a dict
 {"method": ..., **kwargs}; response likewise {"ok": bool, ...}.
+
+Fault tolerance (docs/FAULT_TOLERANCE.md):
+  * ``VarClient.call`` retries transient ``ConnectionError``/``OSError``
+    with exponential backoff and reconnect, up to FLAGS_rpc_retry_times
+    attempts, each bounded by FLAGS_rpc_deadline ms (reference
+    grpc_client.cc FLAGS_rpc_deadline/FLAGS_rpc_retry_times). Idempotent
+    methods are re-sent verbatim; every other method carries a send-dedup
+    token the server replays from a bounded cache, so a retry after a
+    lost response cannot double-apply a gradient.
+  * ``_recv_msg`` rejects length prefixes beyond
+    FLAGS_rpc_max_message_size with ``RpcProtocolError`` (never retried).
+  * ``BarrierManager`` + ``HeartBeatMonitor``: barriers release with
+    ``WorkerDeadError`` as soon as a participant is declared dead instead
+    of blocking for the full FLAGS_barrier_deadline.
 """
 from __future__ import annotations
 
+import itertools
+import logging
+import os
 import pickle
 import socket
 import socketserver
 import struct
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from . import core
+
 _LEN = struct.Struct(">Q")
+
+_LOG = logging.getLogger("paddle_tpu.ps")
 
 
 def _send_msg(sock: socket.socket, obj) -> None:
@@ -44,20 +66,51 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def _recv_msg(sock: socket.socket):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    limit = int(core.globals_["FLAGS_rpc_max_message_size"])
+    if n > limit:
+        # a garbage/malicious prefix must fail as a PROTOCOL error, not
+        # as a MemoryError from trying to buffer it
+        raise core.RpcProtocolError(
+            f"rpc message length prefix {n} exceeds "
+            f"FLAGS_rpc_max_message_size={limit} — corrupted or "
+            f"malicious peer stream")
     return pickle.loads(_recv_exact(sock, n))
 
 
 class VarServer:
     """Serves variables + barriers for one pserver process (reference:
-    listen_and_serv_op.cc:333 RunImpl's gRPC server)."""
+    listen_and_serv_op.cc:333 RunImpl's gRPC server).
+
+    Requests carrying a ``_dedup`` token (non-idempotent methods from a
+    retrying VarClient) execute AT MOST ONCE per server lifetime: the
+    token is reserved the moment the request is read, a retry arriving
+    while the original is still executing (client timed out mid-call)
+    WAITS for that execution's outcome, and a retry arriving after
+    completion replays the cached response — at-least-once delivery,
+    exactly-once application. The cache does not survive a server
+    restart."""
+
+    _DEDUP_CAP = 4096
 
     def __init__(self, endpoint: str,
                  handlers: Dict[str, Callable[..., Any]]):
         host, port = endpoint.rsplit(":", 1)
         self._handlers = handlers
+        self._dedup: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._dedup_lock = threading.Lock()
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
+
+            def finish(self):
+                with outer._conns_lock:
+                    outer._conns.discard(self.request)
+
             def handle(self):
                 try:
                     while True:
@@ -67,6 +120,19 @@ class VarServer:
                             _send_msg(self.request, {"ok": True})
                             outer._stop_evt.set()
                             return
+                        token = msg.pop("_dedup", None)
+                        if token is not None:
+                            kind, val = outer._dedup_begin(token)
+                            if kind == "done":
+                                _send_msg(self.request, val)
+                                continue
+                            if kind == "pending":
+                                # the original execution (from a timed-
+                                # out connection) is still running —
+                                # wait for ITS outcome, never re-execute
+                                _send_msg(self.request,
+                                          outer._dedup_wait(token, val))
+                                continue
                         fn = outer._handlers.get(method)
                         if fn is None:
                             _send_msg(self.request,
@@ -75,10 +141,20 @@ class VarServer:
                             continue
                         try:
                             res = fn(**msg)
-                            _send_msg(self.request, {"ok": True, "result": res})
+                            resp = {"ok": True, "result": res}
                         except Exception as e:  # surfaced to the client
-                            _send_msg(self.request,
-                                      {"ok": False, "error": repr(e)})
+                            # error_type lets the client re-raise the
+                            # TYPED exception (WorkerDeadError survives
+                            # the wire — tests/launchers dispatch on it)
+                            resp = {"ok": False, "error": repr(e),
+                                    "error_type": type(e).__name__}
+                        if token is not None:
+                            outer._dedup_put(token, resp)
+                        _send_msg(self.request, resp)
+                except core.RpcProtocolError:
+                    _LOG.warning("VarServer: dropping connection with "
+                                 "invalid framing", exc_info=True)
+                    return
                 except (ConnectionError, OSError):
                     return
 
@@ -90,6 +166,49 @@ class VarServer:
         self._stop_evt = threading.Event()
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
+
+    def _dedup_begin(self, token):
+        """Reserve a token. Returns ("new", event) when this call owns
+        execution, ("pending", event) when another connection is
+        executing it right now, ("done", response) when it completed."""
+        t = tuple(token)
+        with self._dedup_lock:
+            entry = self._dedup.get(t)
+            if entry is not None:
+                return entry
+            ev = threading.Event()
+            entry = self._dedup[t] = ("pending", ev)
+            return ("new", ev)
+
+    def _dedup_wait(self, token, event):
+        t = tuple(token)
+        while not event.wait(1.0):
+            if self._stop_evt.is_set():
+                return {"ok": False,
+                        "error": "server stopping before the original "
+                                 "execution of this request completed"}
+        with self._dedup_lock:
+            entry = self._dedup.get(t)
+        if entry is not None and entry[0] == "done":
+            return entry[1]
+        return {"ok": False, "error": "dedup entry lost mid-wait"}
+
+    def _dedup_put(self, token, resp):
+        t = tuple(token)
+        with self._dedup_lock:
+            prev = self._dedup.get(t)
+            self._dedup[t] = ("done", resp)
+            self._dedup.move_to_end(t)
+            if len(self._dedup) > self._DEDUP_CAP:
+                # evict oldest COMPLETED entries; pending ones belong to
+                # live executions and their waiters
+                for k in list(self._dedup):
+                    if len(self._dedup) <= self._DEDUP_CAP:
+                        break
+                    if self._dedup[k][0] == "done" and k != t:
+                        del self._dedup[k]
+        if prev is not None and prev[0] == "pending":
+            prev[1].set()
 
     @property
     def port(self) -> int:
@@ -106,32 +225,99 @@ class VarServer:
         self._stop_evt.set()
         self._srv.shutdown()
         self._srv.server_close()
+        # sever live connections like a process death would — peers see
+        # ConnectionError immediately (and their retry plane kicks in)
+        # instead of blocked reads on a half-dead server
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+# errors a pserver handler may legitimately raise that the client should
+# re-raise TYPED instead of as a generic RuntimeError
+_WIRE_ERRORS: Dict[str, type] = {
+    "WorkerDeadError": core.WorkerDeadError,
+    "TimeoutError": TimeoutError,
+    "KeyError": KeyError,
+}
 
 
 class VarClient:
     """Per-endpoint client with one persistent connection (reference:
-    grpc_client.h AsyncSendVar/AsyncGetVar calling convention)."""
+    grpc_client.h AsyncSendVar/AsyncGetVar calling convention).
+
+    ``call`` survives transient transport failures: the socket is closed,
+    re-connected, and the request re-sent with exponential backoff up to
+    FLAGS_rpc_retry_times attempts. Methods in ``_IDEMPOTENT`` are safe
+    verbatim; every other method is stamped with a per-client dedup token
+    the server replays instead of re-executing."""
 
     _pool: Dict[str, "VarClient"] = {}
     _pool_lock = threading.Lock()
 
+    # read-only methods: re-sending after a lost response cannot change
+    # server state. NOTE barrier/reduce_get are deliberately NOT here:
+    # a barrier retry that lands AFTER its round released would enroll
+    # as a phantom arrival in the NEXT round (and a reduce_get retry
+    # after a generation reset would re-join a fresh generation) — they
+    # ride the dedup-token path instead, replaying the completed
+    # response; in-round duplicates are additionally absorbed by the
+    # trainer-id keying.
+    _IDEMPOTENT = frozenset({
+        "get_var", "prefetch_rows", "heartbeat",
+        "dead_workers", "alive_workers", "table_stats",
+    })
+
     def __init__(self, endpoint: str, connect_timeout: float = 30.0):
         self.endpoint = endpoint
-        host, port = endpoint.rsplit(":", 1)
+        self._host, port = endpoint.rsplit(":", 1)
+        self._port = int(port)
+        self._connect_timeout = connect_timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._token_prefix = f"{os.getpid()}:{id(self):x}"
+        self._seq = itertools.count()
+        with self._lock:
+            self._connect_locked(connect_timeout)
+
+    # ------------------------------------------------------------ plumbing
+    @property
+    def _deadline_s(self) -> float:
+        return float(core.globals_["FLAGS_rpc_deadline"]) / 1000.0
+
+    def _connect_locked(self, connect_timeout: float):
+        """(Re)establish the connection; the server may be down/restarting
+        — poll until ``connect_timeout`` elapses."""
         deadline = time.time() + connect_timeout
         last = None
         while time.time() < deadline:
             try:
-                self._sock = socket.create_connection((host, int(port)),
-                                                      timeout=120.0)
-                break
-            except OSError as e:  # server may not be up yet — retry
+                self._sock = socket.create_connection(
+                    (self._host, self._port), timeout=self._deadline_s)
+                return
+            except OSError as e:  # server not up (yet) — retry
                 last = e
                 time.sleep(0.1)
-        else:
-            raise ConnectionError(
-                f"cannot reach pserver {endpoint}: {last}")
-        self._lock = threading.Lock()
+        self._sock = None
+        raise ConnectionError(
+            f"cannot reach pserver {self.endpoint}: {last}")
+
+    def _close_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     @classmethod
     def of(cls, endpoint: str) -> "VarClient":
@@ -145,20 +331,60 @@ class VarClient:
     def reset_pool(cls):
         with cls._pool_lock:
             for c in cls._pool.values():
-                try:
-                    c._sock.close()
-                except OSError:
-                    pass
+                with c._lock:
+                    c._close_locked()
             cls._pool.clear()
 
-    def call(self, method: str, **kwargs):
-        with self._lock:
-            _send_msg(self._sock, {"method": method, **kwargs})
-            resp = _recv_msg(self._sock)
+    # ---------------------------------------------------------------- call
+    def call(self, method: str, _rpc_timeout: Optional[float] = None,
+             _rpc_retries: Optional[int] = None, **kwargs):
+        """One RPC with retry/backoff/reconnect for transient transport
+        errors. Protocol errors (bad framing) and application errors
+        (ok=False responses) are never retried. ``_rpc_timeout`` (s) /
+        ``_rpc_retries`` override the FLAGS for this call only (the
+        heartbeat thread uses short ones so a dead server can't pin it)."""
+        deadline_s = (self._deadline_s if _rpc_timeout is None
+                      else float(_rpc_timeout))
+        retries = (max(0, int(core.globals_["FLAGS_rpc_retry_times"]))
+                   if _rpc_retries is None else max(0, int(_rpc_retries)))
+        msg = {"method": method, **kwargs}
+        if method not in self._IDEMPOTENT:
+            msg["_dedup"] = (self._token_prefix, next(self._seq))
+        attempt = 0
+        while True:
+            try:
+                with self._lock:
+                    if self._sock is None:
+                        self._connect_locked(self._connect_timeout)
+                    self._sock.settimeout(deadline_s)
+                    _send_msg(self._sock, msg)
+                    resp = _recv_msg(self._sock)
+                break
+            except core.RpcProtocolError:
+                with self._lock:
+                    self._close_locked()
+                raise
+            except (ConnectionError, OSError) as e:
+                with self._lock:
+                    self._close_locked()
+                attempt += 1
+                if attempt > retries:
+                    raise ConnectionError(
+                        f"rpc {method} on {self.endpoint} failed after "
+                        f"{retries} retries: {e!r}") from e
+                backoff = min(2.0, 0.05 * (2 ** (attempt - 1)))
+                _LOG.warning(
+                    "rpc %s on %s hit %r — retry %d/%d in %.2fs",
+                    method, self.endpoint, e, attempt, retries, backoff)
+                time.sleep(backoff)
         if not resp.get("ok"):
+            err = resp.get("error")
+            etype = _WIRE_ERRORS.get(resp.get("error_type"))
+            if etype is not None:
+                raise etype(
+                    f"rpc {method} on {self.endpoint} failed: {err}")
             raise RuntimeError(
-                f"rpc {method} on {self.endpoint} failed: "
-                f"{resp.get('error')}")
+                f"rpc {method} on {self.endpoint} failed: {err}")
         return resp.get("result")
 
     # convenience wrappers mirroring send_recv.proto service methods
@@ -182,6 +408,8 @@ class VarClient:
     def stop(self):
         try:
             with self._lock:
+                if self._sock is None:
+                    return
                 _send_msg(self._sock, {"method": "stop"})
                 _recv_msg(self._sock)
         except (ConnectionError, OSError):
@@ -192,9 +420,10 @@ class HeartBeatMonitor:
     """Worker-liveness watchdog on the pserver (reference:
     operators/distributed/heart_beat_monitor.h:54 — every worker RPC
     updates its beat; a monitor thread flags workers whose last beat is
-    older than the timeout). Detection only, like the reference: dead
-    workers are logged and queryable; tearing the job down is the
-    launcher's job (launch.py watch loop)."""
+    older than the timeout). Dead workers are logged and queryable, AND
+    death listeners fire so collectives (BarrierManager, ReduceService)
+    release their waiters promptly with WorkerDeadError; tearing the
+    whole job down remains the launcher's call (launch.py watch loop)."""
 
     def __init__(self, worker_num: int, timeout: float = 60.0,
                  check_interval: float = 3.0,
@@ -202,12 +431,19 @@ class HeartBeatMonitor:
         self.worker_num = worker_num
         self.timeout = timeout
         self.check_interval = check_interval
-        self._on_dead = on_dead
+        self._listeners: List[Callable[[int], None]] = []
+        if on_dead is not None:
+            self._listeners.append(on_dead)
         self._beats: Dict[int, float] = {}
         self._dead: set = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def add_dead_listener(self, cb: Callable[[int], None]) -> None:
+        """Register an extra callback fired (off-lock) for every newly
+        declared-dead worker id."""
+        self._listeners.append(cb)
 
     def update(self, worker_id: int) -> None:
         now = time.time()
@@ -223,6 +459,10 @@ class HeartBeatMonitor:
         with self._lock:
             return sorted(set(self._beats) - self._dead)
 
+    def is_dead(self, worker_id: int) -> bool:
+        with self._lock:
+            return int(worker_id) in self._dead
+
     def _scan(self):
         while not self._stop.wait(self.check_interval):
             now = time.time()
@@ -233,12 +473,14 @@ class HeartBeatMonitor:
                         self._dead.add(wid)
                         newly_dead.append(wid)
             for wid in newly_dead:
-                import logging
-                logging.getLogger("paddle_tpu.ps").warning(
+                _LOG.warning(
                     "HeartBeatMonitor: worker %d silent for >%.0fs — "
                     "presumed dead", wid, self.timeout)
-                if self._on_dead is not None:
-                    self._on_dead(wid)
+                for cb in self._listeners:
+                    try:
+                        cb(wid)
+                    except Exception:
+                        _LOG.exception("dead-worker listener failed")
 
     def start_monitor(self) -> "HeartBeatMonitor":
         self._thread = threading.Thread(target=self._scan, daemon=True)
@@ -259,15 +501,107 @@ class HeartBeatMonitor:
                 "alive_workers": lambda trainer_id=0: self.alive_workers()}
 
 
+class BarrierManager:
+    """Dead-worker-aware rendezvous for ``world`` trainers (replaces the
+    reference's RPCServer barrier counters — rpc_server.cc
+    IncreaseBatchBarrier/WaitBarrier, which block until a condition or
+    forever).
+
+    Arrival is keyed by trainer id, so duplicate arrivals WITHIN a round
+    (e.g. a retry racing its still-executing original) are absorbed with
+    no double-count; retries landing after the round released are handled
+    one layer down by the VarServer dedup cache (barrier RPCs carry
+    ``_dedup`` tokens), so they replay the completed response instead of
+    phantom-arriving in the next round. When every
+    participant arrived, the releasing arrival runs ``on_release`` (the
+    pserver's aggregate+optimize action) under the lock, bumps the round
+    and wakes everyone. If the HeartBeatMonitor declares a participant
+    dead, ALL current and future waiters of the in-flight round raise
+    ``WorkerDeadError`` naming the dead worker(s) — within roughly one
+    monitor check interval, never the full deadline. Stragglers without
+    a death verdict time out after ``deadline`` (FLAGS_barrier_deadline)
+    with a TimeoutError naming the missing count."""
+
+    def __init__(self, world: int, monitor: Optional[HeartBeatMonitor]
+                 = None, deadline: Optional[float] = None, lock=None):
+        self._world = int(world)
+        self._monitor = monitor
+        self._deadline = (float(core.globals_["FLAGS_barrier_deadline"])
+                          if deadline is None else float(deadline))
+        self._cv = threading.Condition(lock)
+        self._state: Dict[str, Dict[str, Any]] = {}
+        if monitor is not None:
+            monitor.add_dead_listener(self._on_dead)
+
+    def _on_dead(self, wid: int):
+        with self._cv:
+            self._cv.notify_all()
+
+    def _check_dead_locked(self, kind: str, st: Dict[str, Any],
+                           trainer_id: int):
+        if self._monitor is None:
+            return
+        dead = [d for d in self._monitor.dead_workers()
+                if d != int(trainer_id)]
+        if dead:
+            # abort the in-flight round: every waiter re-checks this on
+            # wake and raises too; arrivals reset so a later round (after
+            # revival or relaunch) starts clean
+            st["arrived"] = set()
+            raise core.WorkerDeadError(
+                f"barrier '{kind}': worker(s) {dead} declared dead by the "
+                f"heartbeat monitor while {self._world} participants were "
+                f"expected")
+
+    def arrive(self, kind: str, trainer_id: int,
+               on_release: Optional[Callable[[], None]] = None,
+               deadline: Optional[float] = None) -> int:
+        """Block until all ``world`` participants arrived at ``kind``.
+        Returns the completed round number."""
+        deadline = self._deadline if deadline is None else float(deadline)
+        with self._cv:
+            st = self._state.setdefault(kind,
+                                        {"arrived": set(), "round": 0})
+            self._check_dead_locked(kind, st, trainer_id)
+            st["arrived"].add(int(trainer_id))
+            if len(st["arrived"]) >= self._world:
+                if on_release is not None:
+                    on_release()
+                st["arrived"] = set()
+                st["round"] += 1
+                self._cv.notify_all()
+                return st["round"]
+            rnd = st["round"]
+            end = time.time() + deadline
+            while st["round"] == rnd:
+                remaining = end - time.time()
+                if remaining <= 0:
+                    missing = self._world - len(st["arrived"])
+                    st["arrived"].discard(int(trainer_id))
+                    raise TimeoutError(
+                        f"barrier '{kind}': {missing} of {self._world} "
+                        f"participants missing after {deadline:.0f}s")
+                self._cv.wait(min(1.0, remaining))
+                self._check_dead_locked(kind, st, trainer_id)
+            return st["round"]
+
+
 class WorkerHeartBeat:
     """Worker-side beat thread: pings every pserver endpoint periodically
     (reference workers beat inside their send RPCs; an idle worker still
-    beats here so slow data pipelines aren't declared dead)."""
+    beats here so slow data pipelines aren't declared dead).
+
+    Beats ride PRIVATE connections, not the pooled VarClient: the pooled
+    client serializes calls on one socket, so a data RPC blocked in a
+    long server-side barrier would stall the beats and get this very
+    worker declared dead. Each beat is one short-timeout, zero-retry
+    attempt — a missed beat is information, the monitor sees silence."""
 
     def __init__(self, endpoints, trainer_id: int, interval: float = 5.0):
         self.endpoints = list(endpoints)
         self.trainer_id = trainer_id
         self.interval = interval
+        self._clients: Dict[str, VarClient] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -275,10 +609,17 @@ class WorkerHeartBeat:
         while not self._stop.wait(self.interval):
             for ep in self.endpoints:
                 try:
-                    VarClient.of(ep).call("heartbeat",
-                                          trainer_id=self.trainer_id)
+                    cli = self._clients.get(ep)
+                    if cli is None:
+                        cli = self._clients[ep] = VarClient(
+                            ep, connect_timeout=max(1.0, self.interval))
+                    cli.call("heartbeat", trainer_id=self.trainer_id,
+                             _rpc_timeout=max(1.0, self.interval * 2),
+                             _rpc_retries=0)
                 except Exception:
-                    pass  # server gone/restarting; the monitor sees silence
+                    # server gone/restarting; the monitor sees silence.
+                    # drop the client so the next beat reconnects fresh
+                    self._clients.pop(ep, None)
 
     def start(self) -> "WorkerHeartBeat":
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -289,6 +630,12 @@ class WorkerHeartBeat:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=self.interval * 2)
+        # snapshot: the beat thread may outlive the bounded join and
+        # still be mutating the dict
+        for cli in list(self._clients.values()):
+            with cli._lock:
+                cli._close_locked()
+        self._clients.clear()
 
 
 class ReduceService:
@@ -297,14 +644,24 @@ class ReduceService:
     push a named array; get blocks until all ``world`` contributions of the
     current generation arrived, then every worker reads the sum. The
     generation resets once all workers fetched, so the same name can be
-    reduced repeatedly."""
+    reduced repeatedly. With a ``monitor``, a dead worker that has not yet
+    contributed releases every waiter with WorkerDeadError instead of
+    letting them run out the full timeout."""
 
-    def __init__(self):
+    def __init__(self, monitor: Optional[HeartBeatMonitor] = None):
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        self._monitor = monitor
         self._sums: Dict[str, np.ndarray] = {}
         self._contrib: Dict[str, set] = {}
         self._fetched: Dict[str, set] = {}
+        if monitor is not None:
+            monitor.add_dead_listener(
+                lambda wid: self._notify_all())
+
+    def _notify_all(self):
+        with self._cv:
+            self._cv.notify_all()
 
     def push(self, name: str, value, trainer_id: int):
         arr = np.asarray(value, np.float64)
@@ -321,14 +678,26 @@ class ReduceService:
 
     def get(self, name: str, trainer_id: int, world: int,
             timeout: float = 300.0):
+        end = time.time() + timeout
         with self._cv:
-            ok = self._cv.wait_for(
-                lambda: len(self._contrib.get(name, ())) >= world, timeout)
-            if not ok:
-                raise TimeoutError(
-                    f"reduce '{name}': only "
-                    f"{len(self._contrib.get(name, ()))}/{world} workers "
-                    f"contributed within {timeout}s")
+            while len(self._contrib.get(name, ())) < world:
+                if self._monitor is not None:
+                    dead = [d for d in self._monitor.dead_workers()
+                            if d != int(trainer_id)
+                            and d not in self._contrib.get(name, ())]
+                    if dead:
+                        raise core.WorkerDeadError(
+                            f"reduce '{name}': worker(s) {dead} declared "
+                            f"dead before contributing "
+                            f"({len(self._contrib.get(name, ()))}/{world} "
+                            f"arrived)")
+                remaining = end - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"reduce '{name}': only "
+                        f"{len(self._contrib.get(name, ()))}/{world} "
+                        f"workers contributed within {timeout}s")
+                self._cv.wait(min(1.0, remaining))
             result = self._sums[name]
             fetched = self._fetched.setdefault(name, set())
             fetched.add(trainer_id)

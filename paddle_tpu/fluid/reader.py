@@ -142,6 +142,12 @@ class _GeneratorLoader:
         self._places = None
         self._it = None     # non-iterable (start/next/reset) mode state
         self._mp_proc = None  # last multiprocess worker (observability)
+        # epoch/position counters for checkpoint manifests
+        # (state_dict/load_state_dict — docs/FAULT_TOLERANCE.md): epoch =
+        # completed passes, position = batches yielded this epoch
+        self._epoch = 0
+        self._position = 0
+        self._skip_next = 0
 
     # -- reference API -----------------------------------------------------
     def set_sample_generator(self, reader, batch_size, drop_last=True,
@@ -187,6 +193,24 @@ class _GeneratorLoader:
         return self
 
     def __iter__(self):
+        """Wraps the raw batch stream with epoch/position accounting.
+        After ``load_state_dict`` the first ``position`` batches of the
+        epoch are consumed WITHOUT being yielded (fast-forward): with a
+        deterministic generator the resumed stream continues exactly
+        where the checkpointed run was cut."""
+        inner = self._iter_raw()
+        skip, self._skip_next = self._skip_next, 0
+        pos = 0
+        for batch in inner:
+            pos += 1
+            if pos <= skip:
+                continue
+            self._position = pos
+            yield batch
+        self._epoch += 1
+        self._position = 0
+
+    def _iter_raw(self):
         assert self._batch_fn is not None, "no generator set"
         if self._use_multiprocess:
             yield from self._iter_multiprocess()
@@ -198,6 +222,21 @@ class _GeneratorLoader:
         # (finally: put(DONE)) and left an abandoned producer blocked on
         # put forever — the shared bridge fixes both
         yield from _iter_through_queue(self._batch_fn(), self._capacity)
+
+    # -------------------------------------------------- checkpoint state
+    def state_dict(self):
+        """Input-stream position for a checkpoint manifest (picked up by
+        Executor.set_auto_checkpoint(dataloader=...))."""
+        return {"epoch": self._epoch, "position": self._position}
+
+    def load_state_dict(self, state):
+        """Restore counters from a manifest; the NEXT iteration of this
+        loader fast-forwards ``position`` batches (they are generated
+        and discarded, not yielded). Exactness requires the same
+        deterministic generator the checkpointed run used."""
+        self._epoch = int(state.get("epoch", 0))
+        self._position = int(state.get("position", 0))
+        self._skip_next = self._position
 
     def _iter_multiprocess(self):
         """Producer process + shared-memory batch transport (reference:

@@ -343,7 +343,7 @@ def _merge_ids(ins, attrs):
 def _listen_and_serv(ins, attrs):
     """Server loop: blocks until a stop RPC (parity with RunImpl's
     server_thread join, listen_and_serv_op.cc:382)."""
-    from ..fluid.ps_rpc import HeartBeatMonitor, VarServer
+    from ..fluid.ps_rpc import BarrierManager, HeartBeatMonitor, VarServer
     ctx = attrs["_ctx"]
     scope, executor = ctx.scope, ctx.executor
     endpoint = attrs["endpoint"]
@@ -354,8 +354,19 @@ def _listen_and_serv(ins, attrs):
         kv.split(":") for kv in attrs.get("grad_to_block_id") or [])
     sparse_lr = float(attrs.get("sparse_lr", 0.01))
 
-    lock = threading.Condition()
-    state = {"pending": {}, "send_barriers": 0, "round": 0}
+    # ONE lock guards grad state for send/geo handlers AND backs the
+    # BarrierManager's condition — the release action (aggregate +
+    # optimize) runs holding it, so it can't race a straggler send
+    lock = threading.RLock()
+    state = {"pending": {}}
+
+    # failure-detection cadence is deploy-tunable (tests shrink it to
+    # seconds; reference FLAGS_worker_update_interval_secs plays this role)
+    hb_timeout = float(os.environ.get("PADDLE_PS_HEARTBEAT_TIMEOUT", 60.0))
+    monitor = HeartBeatMonitor(
+        fanin, timeout=hb_timeout,
+        check_interval=min(3.0, max(0.2, hb_timeout / 4)))
+    barriers = BarrierManager(fanin, monitor=monitor, lock=lock)
 
     def _apply_sparse(name, value, rows):
         # row-wise SGD on the host-resident table (reference async sparse
@@ -398,42 +409,35 @@ def _listen_and_serv(ins, attrs):
                 _run_block_for(name)
         return True
 
+    def _release_send_round():
+        # aggregate: average each grad across trainers (the reference
+        # transpiler's sum + scale(1/trainers) on the server optimize
+        # path), then run optimize. Runs under the shared lock, invoked
+        # by the LAST arrival inside BarrierManager.arrive.
+        for name, parts in state["pending"].items():
+            total = parts[0]
+            for p in parts[1:]:
+                total = total + p
+            scope.var(name).set_value(
+                core.LoDTensor(jnp.asarray(total / len(parts))))
+        for name in list(state["pending"]):
+            _run_block_for(name)
+        state["pending"].clear()
+
     def h_barrier(kind, trainer_id=0):
         monitor.update(trainer_id)
         if not sync or kind != "send":
             return True
-        with lock:
-            state["send_barriers"] += 1
-            if state["send_barriers"] >= fanin:
-                # aggregate: average each grad across trainers (the
-                # reference transpiler's sum + scale(1/trainers) on the
-                # server optimize path), then run optimize
-                for name, parts in state["pending"].items():
-                    total = parts[0]
-                    for p in parts[1:]:
-                        total = total + p
-                    scope.var(name).set_value(
-                        core.LoDTensor(jnp.asarray(total / len(parts))))
-                for name in list(state["pending"]):
-                    _run_block_for(name)
+        try:
+            barriers.arrive("send", trainer_id,
+                            on_release=_release_send_round)
+        except core.WorkerDeadError:
+            # drop the dead trainer's (and the whole aborted round's)
+            # pending grads so the next round starts clean instead of
+            # double-counting a partial batch
+            with lock:
                 state["pending"].clear()
-                state["send_barriers"] = 0
-                state["round"] += 1
-                lock.notify_all()
-            else:
-                rnd = state["round"]
-                while state["round"] == rnd:
-                    lock.wait(timeout=5.0)
-                    # a dead peer would leave this barrier waiting
-                    # forever — surface it to the caller as an RPC error
-                    # instead (the monitor flags workers silent past the
-                    # heartbeat timeout)
-                    dead = [d for d in monitor.dead_workers()
-                            if d != trainer_id]
-                    if dead and state["round"] == rnd:
-                        raise RuntimeError(
-                            f"sync send barrier: waiting on dead "
-                            f"trainer(s) {dead}")
+            raise
         return True
 
     def h_get_var(name, trainer_id=0):
@@ -485,12 +489,7 @@ def _listen_and_serv(ins, attrs):
                     jnp.asarray(cur + np.asarray(value))))
         return True
 
-    # failure-detection cadence is deploy-tunable (tests shrink it to
-    # seconds; reference FLAGS_worker_update_interval_secs plays this role)
-    hb_timeout = float(os.environ.get("PADDLE_PS_HEARTBEAT_TIMEOUT", 60.0))
-    monitor = HeartBeatMonitor(
-        fanin, timeout=hb_timeout,
-        check_interval=min(3.0, max(0.2, hb_timeout / 4))).start_monitor()
+    monitor.start_monitor()
     srv = VarServer(endpoint, {
         "send_var": h_send_var, "barrier": h_barrier, "get_var": h_get_var,
         "prefetch_rows": h_prefetch_rows, "checkpoint": h_checkpoint,

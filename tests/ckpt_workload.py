@@ -1,0 +1,97 @@
+"""Auto-checkpoint training workload for the SIGKILL-resume parity test
+(tests/test_fault_tolerance.py). Run as a subprocess so variable /
+accumulator names come from a fresh unique_name counter — the oracle,
+killed, and resumed runs then agree on every name.
+
+argv: ckpt_dir losses_file total_steps every_n [--resume]
+      [--step-sleep=S]   (slows steps so a scheduled SIGKILL lands
+                          mid-window instead of after the run finished)
+
+Model: fc→relu→dropout→fc + Momentum (velocity slot vars), so the parity
+check covers parameters, optimizer accumulators AND the per-step dropout
+rng stream. Batches derive deterministically from the TRAIN step index;
+per-step losses append to ``losses_file`` as JSONL (fsync per line, so a
+SIGKILL truncates at a line boundary at worst).
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[8], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, 16, act="relu",
+                            param_attr=fluid.ParamAttr(name="w1"),
+                            bias_attr=fluid.ParamAttr(name="b1"))
+        h = fluid.layers.dropout(h, dropout_prob=0.5)
+        pred = fluid.layers.fc(h, 1,
+                               param_attr=fluid.ParamAttr(name="w2"),
+                               bias_attr=fluid.ParamAttr(name="b2"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Momentum(0.05, momentum=0.9).minimize(loss)
+    return main, startup, loss
+
+
+def batch_for(step):
+    rs = np.random.RandomState(1234 + step)
+    X = rs.rand(16, 8).astype(np.float32)
+    Y = (X.sum(1, keepdims=True) * 0.5).astype(np.float32)
+    return X, Y
+
+
+def main():
+    ckpt_dir, losses_path = sys.argv[1], sys.argv[2]
+    total_steps, every = int(sys.argv[3]), int(sys.argv[4])
+    resume = "--resume" in sys.argv
+    step_sleep = 0.0
+    for a in sys.argv:
+        if a.startswith("--step-sleep="):
+            step_sleep = float(a.split("=", 1)[1])
+
+    main_prog, startup, loss = build()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.set_auto_checkpoint(ckpt_dir, every, program=main_prog,
+                                scope=scope)
+        start = 0
+        if resume:
+            manifest = exe.resume_from(ckpt_dir, program=main_prog,
+                                       scope=scope)
+            if manifest is not None:
+                # the rng/global-step counter counts the startup run too
+                # (one advance per exe.run on this scope): train steps
+                # completed = global_step - 1
+                start = int(manifest["global_step"]) - 1
+        out = open(losses_path, "a")
+        for step in range(start, total_steps):
+            X, Y = batch_for(step)
+            (lv,) = exe.run(main_prog, feed={"x": X, "y": Y},
+                            fetch_list=[loss])
+            out.write(json.dumps(
+                {"step": step,
+                 "loss": repr(float(np.asarray(lv).reshape(-1)[0]))})
+                + "\n")
+            out.flush()
+            os.fsync(out.fileno())
+            if step_sleep:
+                import time
+                time.sleep(step_sleep)
+        out.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "full: full-tier-only tests (skipped by the quick "
         "per-commit tier: pytest -m 'not full')")
+    config.addinivalue_line(
+        "markers", "faults: fault-injection suite (tests/faultinject.py "
+        "— killed/paused processes, corrupted checkpoints). Fast "
+        "injections (<10s) stay in the tier-1 non-slow set; the heavier "
+        "multiprocess ones also carry 'slow'. All injections run "
+        "JAX_PLATFORMS=cpu subprocesses, so PADDLE_TPU_TEST_SHARD "
+        "file-level sharding applies unchanged.")
 
 
 def pytest_collection_modifyitems(config, items):

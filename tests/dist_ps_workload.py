@@ -59,6 +59,10 @@ def main():
     sparse = "--sparse" in sys.argv
     geo = "--geo" in sys.argv
     no_stop = "--no-stop" in sys.argv
+    # --expect-dead: a surviving SYNC trainer expects a peer to die —
+    # it records the WorkerDeadError (and how long the barrier held it)
+    # to outfile instead of failing (tests/test_fault_tolerance.py)
+    expect_dead = "--expect-dead" in sys.argv
     die_after = int(_flag_value("--die-after", 0) or 0)
     step_sleep = float(_flag_value("--step-sleep", 0) or 0)
     tid, trainers, steps = int(tid), int(trainers), int(steps)
@@ -112,10 +116,20 @@ def main():
                 feed = {"x": X, "y": Y}
                 if sparse:
                     feed["tok"] = toks
-                (lv,) = exe.run(prog, feed=feed, fetch_list=[loss])
+                import time
+                t_step = time.time()
+                try:
+                    (lv,) = exe.run(prog, feed=feed, fetch_list=[loss])
+                except core.WorkerDeadError as e:
+                    if not expect_dead:
+                        raise
+                    json.dump({"worker_dead": True, "error": str(e),
+                               "wait_s": time.time() - t_step, "step": s,
+                               "losses": losses}, open(outfile, "w"))
+                    beat.stop()
+                    return
                 losses.append(float(np.asarray(lv).reshape(-1)[0]))
                 if step_sleep:
-                    import time
                     time.sleep(step_sleep)
     except BaseException:
         # a failed step must still release the pservers, or the cluster

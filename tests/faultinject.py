@@ -1,0 +1,141 @@
+"""Fault-injection harness for the ``faults`` test suite
+(docs/FAULT_TOLERANCE.md).
+
+Kills/pauses worker and pserver subprocesses on schedule and corrupts
+checkpoint directories the way real failures do (truncation, bit flips,
+missing files, torn manifests). Reference analogue: the
+test_dist_base.py cluster driver, which only ever tears processes down
+cleanly — these helpers model the UNclean paths the fault-tolerance
+layer exists for.
+
+All subprocesses run with JAX_PLATFORMS=cpu (single-core box: the
+injections must not depend on accelerator state).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn_py(args, log_path, env_extra=None):
+    """Launch a repo python subprocess on the CPU backend, log to file.
+    Returns (Popen, tail_fn)."""
+    env = dict(os.environ,
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""),
+               JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    log = open(log_path, "wb+")
+    p = subprocess.Popen([sys.executable] + list(args), env=env,
+                         stdout=log, stderr=log)
+
+    def tail(n=3000):
+        log.flush()
+        log.seek(0)
+        return log.read().decode(errors="replace")[-n:]
+
+    return p, tail
+
+
+def kill_when(proc, predicate, sig=signal.SIGKILL, poll=0.05,
+              timeout=120.0):
+    """Background thread: SIGKILL (default) ``proc`` as soon as
+    ``predicate()`` is true. Returns the thread; join it to confirm the
+    injection fired (thread exits without killing if the process ends
+    first or the timeout passes)."""
+
+    def watch():
+        end = time.time() + timeout
+        while time.time() < end and proc.poll() is None:
+            if predicate():
+                try:
+                    proc.send_signal(sig)
+                except OSError:
+                    pass
+                return
+            time.sleep(poll)
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    return t
+
+
+def pause(proc, duration):
+    """SIGSTOP the process for ``duration`` seconds, then SIGCONT — the
+    'grey failure' injection (a hung-but-alive peer)."""
+    proc.send_signal(signal.SIGSTOP)
+    try:
+        time.sleep(duration)
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGCONT)
+
+
+def count_lines(path):
+    if not os.path.exists(path):
+        return 0
+    with open(path, "rb") as f:
+        return sum(1 for _ in f)
+
+
+def wait_for(predicate, timeout, interval=0.1, desc="condition"):
+    end = time.time() + timeout
+    while time.time() < end:
+        if predicate():
+            return True
+        time.sleep(interval)
+    raise TimeoutError(f"{desc} not reached within {timeout}s")
+
+
+def read_jsonl(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+# ----------------------------------------------------------- checkpoints
+def _data_files(ckpt_dir):
+    from paddle_tpu.fluid.io import CKPT_MANIFEST
+    return sorted(n for n in os.listdir(ckpt_dir) if n != CKPT_MANIFEST)
+
+
+def corrupt_checkpoint(ckpt_dir, mode):
+    """Damage a checkpoint directory in place. Modes:
+    ``truncate``  — chop the largest tensor blob in half (torn write)
+    ``flip``      — flip one byte mid-file (silent media corruption)
+    ``delete``    — remove one tensor blob (partial rsync/cleanup)
+    ``manifest``  — remove MANIFEST.json (killed before the rename fence)
+    Returns the damaged file name."""
+    from paddle_tpu.fluid.io import CKPT_MANIFEST
+    if mode == "manifest":
+        os.remove(os.path.join(ckpt_dir, CKPT_MANIFEST))
+        return CKPT_MANIFEST
+    names = _data_files(ckpt_dir)
+    assert names, f"no tensor blobs in {ckpt_dir}"
+    victim = max(names,
+                 key=lambda n: os.path.getsize(os.path.join(ckpt_dir, n)))
+    path = os.path.join(ckpt_dir, victim)
+    if mode == "truncate":
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(1, size // 2))
+    elif mode == "flip":
+        with open(path, "r+b") as f:
+            f.seek(os.path.getsize(path) // 2)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0xFF]))
+    elif mode == "delete":
+        os.remove(path)
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    return victim
