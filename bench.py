@@ -564,12 +564,22 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
     init-on-touch lazy tables (fleet_wrapper.h DownpourSparseTable role).
     ``n_trainers`` data-parallel trainers train in lock step through the
     sync plane (trainer 0 in-process, the rest as subprocesses); the row
-    reports the SUMMED samples/sec. Includes the RPC pulls."""
+    reports the SUMMED samples/sec. Includes the RPC pulls.
+
+    Paired data-plane lanes (docs/PS_DATA_PLANE.md): the default lane
+    rides the overhauled plane (binary framing, channel pool, parallel
+    shard fan-out, lookup dedup); PADDLE_TPU_PS_PICKLE_WIRE=1 restores
+    the full LEGACY plane for every client (subprocess trainers inherit
+    the env). Same model, same feeds, and every legacy-gated difference
+    is numerics-exact, so the two rows' final losses must agree
+    bit-for-bit (the recorded parity flag)."""
     import socket
     import numpy as np
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     os.environ["FLAGS_lazy_sparse_table_threshold"] = "1000000"
+    wire = ("pickle" if os.environ.get("PADDLE_TPU_PS_PICKLE_WIRE") == "1"
+            else "binary")
     from tools import wide_deep_ps_worker as W
 
     def free_port():
@@ -676,11 +686,16 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
                         errors="replace"))
             total_sps += json.load(open(out_path))["samples_per_sec"]
         emb_params = 26 * sparse_dim * 16 + 26 * sparse_dim
+        final_loss = float(np.asarray(LAST_FETCHES[0].array).ravel()[0])
         return {"metric": "wide_deep_1b_ps_samples_per_sec",
                 "value": round(total_sps, 1), "unit": "samples/s",
                 "vs_baseline": 1.0, "batch": batch,
                 "embedding_params": int(emb_params),
                 "pservers": n_pservers, "trainers": n_trainers,
+                # wire lane + trainer-0 final loss: the paired
+                # binary-vs-pickle rows must agree on this bit-for-bit
+                # (framing must never change the numerics)
+                "wire": wire, "final_loss": final_loss,
                 # the AUC op rides in-graph: fwd+bwd+update run as
                 # compiled jitted segments around the stateful islands
                 # (auc + RPC ops) instead of the whole-block interpreter

@@ -113,28 +113,52 @@ class Communicator:
         it with a warning instead of killing the merge thread
         (async/GEO semantics tolerate a lost delta — a dead thread
         would silently pin the queue and every later grad)."""
-        from .ps_rpc import VarClient
+        return self._send_batch(ep, [(name, merged)], trainer_id)
+
+    def _send_batch(self, ep, items, trainer_id) -> bool:
+        """Ship one coalesced flush: a single-var batch goes out as the
+        plain ``send_var`` every server understands; multiple vars for
+        the same endpoint ride ONE ``send_vars_batch`` RPC (the server
+        applies the whole batch under its grad lock, and the call's
+        dedup token covers all of it). An OLD server without the batch
+        method falls back to per-var sends (ps_rpc.send_vars_batch —
+        only on "no method", when nothing was applied; a PARTIALLY
+        applied batch must not be re-sent per-var). Other failures
+        drop-with-warning like _send_merged."""
+        from .ps_rpc import VarClient, send_vars_batch
+        names = [n for n, _ in items]
         try:
-            VarClient.of(ep).send_var(name, merged, trainer_id=trainer_id)
+            if len(items) == 1:
+                VarClient.of(ep).send_var(names[0], items[0][1],
+                                          trainer_id=trainer_id)
+            else:
+                send_vars_batch(VarClient.of(ep), items,
+                                trainer_id=trainer_id)
             return True
         except (ConnectionError, OSError) as e:
             _LOG.warning(
-                "Communicator: dropping merged grad '%s' for %s — "
-                "endpoint unreachable after RPC retries (%r)", name, ep, e)
+                "Communicator: dropping merged grads %s for %s — "
+                "endpoint unreachable after RPC retries (%r)", names, ep, e)
             return False
         except Exception as e:  # noqa: BLE001 — server-side rejection
             _LOG.warning(
-                "Communicator: dropping merged grad '%s' for %s — "
-                "server rejected it (%r)", name, ep, e)
+                "Communicator: dropping merged grads %s for %s — "
+                "server rejected them (%r)", names, ep, e)
             return False
 
     def _drain(self, key, trainer_id=0):
         name, ep = key
+        merged = self._drain_nowait(key)
+        if merged is not None:
+            self._send_merged(name, ep, merged, trainer_id)
+
+    def _drain_nowait(self, key):
+        """Merge whatever is queued for ``key`` right now (no waiting);
+        None when its queue is empty."""
         q = self._queues.get(key)
         if q is None:
-            return
-        merged = None
-        n = 0
+            return None
+        merged, n = None, 0
         while n < self._max_merge:
             try:
                 v = q.get_nowait()
@@ -142,8 +166,7 @@ class Communicator:
                 break
             merged = v if merged is None else merged + v
             n += 1
-        if merged is not None:
-            self._send_merged(name, ep, merged, trainer_id)
+        return merged
 
     def _merge_loop(self, key, trainer_id):
         name, ep = key
@@ -164,7 +187,24 @@ class Communicator:
                     n += 1
                 except queue.Empty:
                     break
-            self._send_merged(name, ep, merged, trainer_id)
+            # coalesced flush: piggyback OTHER vars pending for the same
+            # endpoint onto this send (one multi-var RPC instead of one
+            # RPC per var — the reference AsyncCommunicator's batched
+            # send queues). queue.get_nowait is atomic, so a concurrent
+            # sibling merge thread never double-takes a grad. The legacy
+            # data-plane lane (PADDLE_TPU_PS_PICKLE_WIRE=1) keeps the
+            # pre-overhaul one-RPC-per-var behavior.
+            from .ps_rpc import _pickle_wire_forced
+            batch = [(name, merged)]
+            if not _pickle_wire_forced():
+                with self._lock:
+                    siblings = [k for k in self._queues
+                                if k[1] == ep and k != key]
+                for k in siblings:
+                    other = self._drain_nowait(k)
+                    if other is not None:
+                        batch.append((k[0], other))
+            self._send_batch(ep, batch, trainer_id)
 
     def recv(self):
         pass

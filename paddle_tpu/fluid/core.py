@@ -424,10 +424,17 @@ class LazyEmbeddingTable:
     a 1e9-parameter logical table costs only O(touched rows) memory; an
     optional LRU bound evicts least-recently-used rows (an evicted, later
     re-touched row re-initializes — the reference's shrink() makes the
-    same trade)."""
+    same trade).
+
+    Storage is a CONTIGUOUS slab (``_data``) plus an id→slot index, so
+    the PS-plane hot paths are vectorized: ``get_rows`` is one
+    fancy-index gather and ``apply_grad`` one ``np.subtract.at`` scatter
+    — per-id python work is a single dict lookup, not a per-row
+    stack/astype (the pserver applies thousands of rows per step on the
+    wide_deep lanes; docs/PS_DATA_PLANE.md)."""
 
     __slots__ = ("height", "dim", "dtype", "seed", "scale", "max_rows",
-                 "_rows", "evictions")
+                 "_index", "_data", "_free", "evictions")
 
     def __init__(self, height: int, dim: int, seed: int = 0,
                  scale: Optional[float] = None, max_rows: Optional[int] = None,
@@ -440,7 +447,11 @@ class LazyEmbeddingTable:
         self.scale = float(scale) if scale is not None \
             else 1.0 / float(np.sqrt(dim))
         self.max_rows = int(max_rows) if max_rows else None
-        self._rows: "OrderedDict[int, np.ndarray]" = OrderedDict()
+        # id -> slot in _data; insertion order doubles as LRU order when
+        # max_rows bounds the table
+        self._index: "OrderedDict[int, int]" = OrderedDict()
+        self._data = np.empty((0, self.dim), self.dtype)
+        self._free: list = []  # recycled slots of evicted rows
         self.evictions = 0
 
     def _init_row(self, r: int) -> np.ndarray:
@@ -449,44 +460,88 @@ class LazyEmbeddingTable:
         return rs.uniform(-self.scale, self.scale,
                           self.dim).astype(self.dtype)
 
-    def _touch(self, r: int) -> np.ndarray:
-        row = self._rows.get(r)
-        if row is None:
-            row = self._rows[r] = self._init_row(r)
-            if self.max_rows is not None and len(self._rows) > self.max_rows:
-                self._rows.popitem(last=False)  # LRU out
-                self.evictions += 1
-        else:
-            self._rows.move_to_end(r)
-        return row
+    def _alloc(self, r: int) -> int:
+        """Materialize row ``r``: claim a slot (recycled or new, growing
+        the slab by doubling), init deterministically, LRU-evict."""
+        n_alloc = len(self._index) + len(self._free)
+        s = self._free.pop() if self._free else n_alloc
+        if s >= len(self._data):
+            cap = max(1024, 2 * len(self._data))
+            grown = np.empty((cap, self.dim), self.dtype)
+            grown[:len(self._data)] = self._data
+            self._data = grown
+        self._data[s] = self._init_row(r)
+        self._index[r] = s
+        if self.max_rows is not None and len(self._index) > self.max_rows:
+            _evicted, old_slot = self._index.popitem(last=False)  # LRU out
+            self._free.append(old_slot)
+            self.evictions += 1
+        return s
+
+    def _slots_of(self, ids: np.ndarray) -> list:
+        """Slot per id, materializing misses (UNBOUNDED tables only —
+        slots stay valid for the whole batch because nothing evicts).
+        One dict hit per id."""
+        get = self._index.get
+        alloc = self._alloc
+        return [s if (s := get(r)) is not None else alloc(r)
+                for r in ids.tolist()]
+
+    def _slot_of_bounded(self, r: int) -> int:
+        s = self._index.get(r)
+        if s is None:
+            return self._alloc(r)
+        self._index.move_to_end(r)
+        return s
 
     def get_rows(self, ids) -> np.ndarray:
         ids = np.asarray(ids).reshape(-1)
-        return np.stack([self._touch(int(r)) for r in ids]) \
-            if len(ids) else np.zeros((0, self.dim), self.dtype)
+        if not len(ids):
+            return np.zeros((0, self.dim), self.dtype)
+        if self.max_rows is None:
+            slots = self._slots_of(ids)  # FIRST: may grow/replace _data
+            return self._data[slots]
+        # bounded table: an eviction later in THIS batch may recycle an
+        # earlier id's slot — copy each row at touch time (the dict
+        # implementation's semantics) instead of batch-gathering stale
+        # slot numbers
+        out = np.empty((len(ids), self.dim), self.dtype)
+        for i, r in enumerate(ids.tolist()):
+            s = self._slot_of_bounded(r)  # FIRST: may grow/replace _data
+            out[i] = self._data[s]
+        return out
 
     def apply_grad(self, ids, grads, lr: float) -> None:
-        """Row-wise SGD: rows[id] -= lr * grad (duplicate ids accumulate)."""
+        """Row-wise SGD: rows[id] -= lr * grad (duplicate ids accumulate,
+        in id order — one vectorized scatter for unbounded tables)."""
         ids = np.asarray(ids).reshape(-1)
+        if not len(ids):
+            return
         grads = np.asarray(grads).reshape(len(ids), self.dim)
-        for r, g in zip(ids, grads):
-            self._touch(int(r))
-            self._rows[int(r)] = (self._rows[int(r)]
-                                  - lr * g).astype(self.dtype)
+        step = (lr * grads).astype(self.dtype, copy=False)
+        if self.max_rows is None:
+            slots = np.asarray(self._slots_of(ids), np.int64)
+            np.subtract.at(self._data, slots, step)
+            return
+        # bounded: apply at touch time so a later in-batch eviction
+        # can't scatter into a recycled slot
+        for i, r in enumerate(ids.tolist()):
+            s = self._slot_of_bounded(r)  # FIRST: may grow/replace _data
+            self._data[s] -= step[i]
 
     # -- introspection ----------------------------------------------------
     def touched_rows(self) -> int:
-        return len(self._rows)
+        return len(self._index)
 
     def nbytes(self) -> int:
-        return len(self._rows) * self.dim * self.dtype.itemsize
+        return len(self._index) * self.dim * self.dtype.itemsize
 
     def logical_params(self) -> int:
         return self.height * self.dim
 
     def __repr__(self):
         return (f"LazyEmbeddingTable(height={self.height}, dim={self.dim}, "
-                f"touched={len(self._rows)}, evictions={self.evictions})")
+                f"touched={len(self._index)}, evictions={self.evictions})")
 
 
 class LoDRankTable:
@@ -631,8 +686,16 @@ class _GlobalFlags:
         "FLAGS_rpc_retry_times": 3,
         # wire-framing guard: a length prefix beyond this raises
         # RpcProtocolError instead of attempting a giant allocation
-        # (default 1 GiB — generous; real payloads are var-sized blobs)
+        # (default 1 GiB — generous; real payloads are var-sized blobs).
+        # Applies to BOTH frame parts of the binary wire (pickled header
+        # and the declared raw-buffer total).
         "FLAGS_rpc_max_message_size": 1 << 30,
+        # data-plane connection pool: how many sockets VarClient keeps
+        # per endpoint so concurrent RPCs (sharded lookup fan-out,
+        # communicator flushes) don't serialize on one connection
+        # (reference: grpc_client.h FLAGS_rpc_client_threads /
+        # channel-per-call overlap in parameter_prefetch.cc)
+        "FLAGS_rpc_channels_per_endpoint": 2,
         # how long a pserver-side collective (sync barrier / reduce) waits
         # for stragglers before raising TimeoutError, in seconds; a DEAD
         # participant releases much earlier with WorkerDeadError

@@ -25,18 +25,20 @@ from typing import Dict, List, Optional
 import jax
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
-           "stop_profiler", "record_event", "RecordEvent", "is_profiling"]
+           "stop_profiler", "record_event", "RecordEvent", "is_profiling",
+           "record_span"]
 
 
 class _Event:
-    __slots__ = ("name", "start", "end", "tid", "cat")
+    __slots__ = ("name", "start", "end", "tid", "cat", "args")
 
-    def __init__(self, name, start, end, tid, cat="host"):
+    def __init__(self, name, start, end, tid, cat="host", args=None):
         self.name = name
         self.start = start
         self.end = end
         self.tid = tid
         self.cat = cat
+        self.args = args  # chrome-trace "args" payload (e.g. rpc bytes)
 
 
 class _ProfilerState:
@@ -109,10 +111,20 @@ def reset_profiler():
         _prof.t0 = time.perf_counter()
 
 
-def _record(name: str, start: float, end: float, cat: str = "host"):
+def _record(name: str, start: float, end: float, cat: str = "host",
+            args=None):
     with _prof.lock:
         _prof.events.append(_Event(name, start, end,
-                                   threading.get_ident(), cat))
+                                   threading.get_ident(), cat, args))
+
+
+def record_span(name: str, start: float, end: float, cat: str = "host",
+                args=None) -> None:
+    """Record an already-timed span (perf_counter endpoints). No-op when
+    profiling is off. Used by layers that time work themselves — the PS
+    RPC client attaches byte/retry counts as chrome-trace args here."""
+    if _prof.enabled:
+        _record(name, start, end, cat, args)
 
 
 class RecordEvent:
@@ -199,10 +211,13 @@ def _write_chrome_trace(events: List[_Event], path: str):
     reference)."""
     trace = {"traceEvents": [], "displayTimeUnit": "ms"}
     for e in events:
-        trace["traceEvents"].append({
+        ev = {
             "name": e.name, "ph": "X", "pid": os.getpid(), "tid": e.tid,
             "ts": (e.start - _prof.t0) * 1e6,
-            "dur": (e.end - e.start) * 1e6, "cat": e.cat})
+            "dur": (e.end - e.start) * 1e6, "cat": e.cat}
+        if e.args:
+            ev["args"] = e.args
+        trace["traceEvents"].append(ev)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

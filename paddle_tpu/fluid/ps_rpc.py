@@ -9,8 +9,21 @@ parameter-server configs (beyond-HBM embedding tables live in host RAM on
 pserver processes, like the reference's Wide&Deep path). Python threads are
 fine here: the payloads are numpy blobs and the work is IO-bound.
 
-Wire format: 8-byte big-endian length + pickle of a dict
-{"method": ..., **kwargs}; response likewise {"ok": bool, ...}.
+Wire format — two generations, negotiated per connection:
+  * legacy (v1): 8-byte big-endian length + pickle of a dict
+    {"method": ..., **kwargs}; response likewise {"ok": bool, ...}.
+  * binary (v2, docs/PS_DATA_PLANE.md): tensor bytes never enter pickle.
+    Each frame is a SMALL pickled header (op, name, dtype/shape specs,
+    dedup token) followed by the raw contiguous buffers, sent with
+    ``sendall(memoryview)`` and received with ``recv_into`` directly
+    into preallocated arrays — the reference's gRPC
+    ``SerializeToByteBuffer`` zero-copy framing
+    (grpc_serde.cc GetTensorPayload / grpc_bytebuffer_stream.h).
+    A new client opens every connection with a legacy-framed ``_hello``
+    probe; a new server upgrades the connection, an old server answers
+    "no method" and the client stays on v1 — old-frame peers keep
+    working in both directions. ``PADDLE_TPU_PS_PICKLE_WIRE=1`` pins a
+    client to v1 (the paired-bench legacy lane).
 
 Fault tolerance (docs/FAULT_TOLERANCE.md):
   * ``VarClient.call`` retries transient ``ConnectionError``/``OSError``
@@ -37,7 +50,7 @@ import socketserver
 import struct
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -48,10 +61,91 @@ _LEN = struct.Struct(">Q")
 
 _LOG = logging.getLogger("paddle_tpu.ps")
 
+# wire protocol generations (negotiated per connection via "_hello")
+PROTO_PICKLE = 1   # legacy: one pickle blob carries tensors too
+PROTO_BINARY = 2   # v2: pickled header + raw zero-copy tensor buffers
+WIRE_VERSION = 2
 
-def _send_msg(sock: socket.socket, obj) -> None:
-    payload = pickle.dumps(obj, protocol=4)
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+def _pickle_wire_forced() -> bool:
+    """PADDLE_TPU_PS_PICKLE_WIRE=1 is the LEGACY DATA-PLANE mode: the
+    pre-throughput-overhaul behavior end to end — v1 pickle frames, one
+    connection per endpoint, serial shard walks, no duplicate-id dedup,
+    no coalesced flushes (docs/PS_DATA_PLANE.md; the paired lane of
+    `bench.py wide_deep_1b`). Checked dynamically so tests can flip it
+    per client."""
+    return os.environ.get("PADDLE_TPU_PS_PICKLE_WIRE", "") == "1"
+
+
+class _NDRef:
+    """Placeholder left in the pickled header where an ndarray was
+    extracted into the frame's raw-buffer section (index into it)."""
+
+    __slots__ = ("i",)
+
+    def __init__(self, i):
+        self.i = i
+
+    def __reduce__(self):
+        return (_NDRef, (self.i,))
+
+
+def _strip_arrays(obj, bufs: list):
+    """Replace every ndarray in ``obj`` (recursively through
+    dicts/lists/tuples) with an _NDRef and append the contiguous array
+    to ``bufs``. 0-d and object-dtype arrays stay inline — they are
+    header-sized and sidestep buffer-protocol edge cases."""
+    if isinstance(obj, np.ndarray) and obj.ndim >= 1 \
+            and obj.dtype != object:
+        bufs.append(np.ascontiguousarray(obj))
+        return _NDRef(len(bufs) - 1)
+    if isinstance(obj, dict):
+        return {k: _strip_arrays(v, bufs) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        walked = [_strip_arrays(v, bufs) for v in obj]
+        return walked if isinstance(obj, list) else tuple(walked)
+    return obj
+
+
+def _plant_arrays(obj, bufs: list):
+    if isinstance(obj, _NDRef):
+        return bufs[obj.i]
+    if isinstance(obj, dict):
+        return {k: _plant_arrays(v, bufs) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        walked = [_plant_arrays(v, bufs) for v in obj]
+        return walked if isinstance(obj, list) else tuple(walked)
+    return obj
+
+
+def _encode_frame(obj, proto: int):
+    """Serialize ``obj`` into wire parts. Returns (parts, nbytes); parts
+    are bytes/memoryview objects sent back-to-back — retry/replay paths
+    re-send them VERBATIM, no re-serialization."""
+    if proto == PROTO_PICKLE:
+        payload = pickle.dumps(obj, protocol=4)
+        return [_LEN.pack(len(payload)) + payload], _LEN.size + len(payload)
+    bufs: list = []
+    stripped = _strip_arrays(obj, bufs)
+    header = pickle.dumps(
+        {"h": stripped, "b": [(b.dtype.str, b.shape) for b in bufs]},
+        protocol=4)
+    parts = [_LEN.pack(len(header)) + header]
+    nbytes = _LEN.size + len(header)
+    for b in bufs:
+        mv = memoryview(b).cast("B")
+        parts.append(mv)
+        nbytes += mv.nbytes
+    if nbytes <= (1 << 16) and len(parts) > 1:
+        # small frame: one syscall beats zero-copy — join the parts
+        # (the copy is cheaper than extra sendall round-trips)
+        parts = [b"".join(parts)]
+    return parts, nbytes
+
+
+def _send_parts(sock: socket.socket, parts) -> None:
+    for p in parts:
+        sock.sendall(p)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -64,7 +158,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
-def _recv_msg(sock: socket.socket):
+def _recv_into_exact(sock: socket.socket, mv: memoryview) -> None:
+    while len(mv):
+        n = sock.recv_into(mv)
+        if n == 0:
+            raise ConnectionError("peer closed")
+        mv = mv[n:]
+
+
+def _recv_frame(sock: socket.socket, proto: int):
+    """Read one frame. Returns (obj, nbytes). The
+    FLAGS_rpc_max_message_size guard applies to BOTH parts: the pickled
+    header's length prefix and the declared raw-buffer total."""
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     limit = int(core.globals_["FLAGS_rpc_max_message_size"])
     if n > limit:
@@ -74,7 +179,56 @@ def _recv_msg(sock: socket.socket):
             f"rpc message length prefix {n} exceeds "
             f"FLAGS_rpc_max_message_size={limit} — corrupted or "
             f"malicious peer stream")
-    return pickle.loads(_recv_exact(sock, n))
+    obj = pickle.loads(_recv_exact(sock, n))
+    nbytes = _LEN.size + n
+    if proto == PROTO_PICKLE:
+        return obj, nbytes
+    if not (isinstance(obj, dict) and "h" in obj and "b" in obj):
+        raise core.RpcProtocolError(
+            "binary-wire frame without header/buffer sections — peer "
+            "framing desynchronized")
+    specs = obj["b"]
+    raw_total = 0
+    try:
+        for dt, shape in specs:
+            if any(int(d) < 0 for d in shape):
+                raise core.RpcProtocolError(
+                    f"rpc raw-buffer spec with negative dim {shape} — "
+                    f"corrupted or malicious peer stream")
+            # python-int product: an attacker-chosen shape must not
+            # int64-overflow past the size guard below
+            n_elems = 1
+            for d in shape:
+                n_elems *= int(d)
+            raw_total += int(np.dtype(dt).itemsize) * n_elems
+    except core.RpcProtocolError:
+        raise
+    except Exception as e:  # bad dtype string / malformed spec entry
+        raise core.RpcProtocolError(
+            f"rpc raw-buffer spec malformed ({e!r}) — corrupted or "
+            f"malicious peer stream") from e
+    if raw_total > limit:
+        raise core.RpcProtocolError(
+            f"rpc raw-buffer total {raw_total} exceeds "
+            f"FLAGS_rpc_max_message_size={limit} — corrupted or "
+            f"malicious peer stream")
+    bufs = []
+    for dt, shape in specs:
+        arr = np.empty(shape, np.dtype(dt))
+        _recv_into_exact(sock, memoryview(arr).cast("B"))
+        bufs.append(arr)
+    return _plant_arrays(obj["h"], bufs), nbytes + raw_total
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    """Legacy-framed send (v1). Kept as the negotiation substrate and
+    for raw-socket tests."""
+    _send_parts(sock, _encode_frame(obj, PROTO_PICKLE)[0])
+
+
+def _recv_msg(sock: socket.socket):
+    """Legacy-framed receive (v1) — see _recv_frame for the guard."""
+    return _recv_frame(sock, PROTO_PICKLE)[0]
 
 
 class VarServer:
@@ -93,13 +247,22 @@ class VarServer:
     _DEDUP_CAP = 4096
 
     def __init__(self, endpoint: str,
-                 handlers: Dict[str, Callable[..., Any]]):
+                 handlers: Dict[str, Callable[..., Any]],
+                 legacy_wire: bool = False):
         host, port = endpoint.rsplit(":", 1)
         self._handlers = handlers
+        # legacy_wire simulates an old-frame-only peer: _hello is
+        # rejected like any unknown method, every connection stays v1
+        # (wire-compat tests exercise new-client↔old-server)
+        self._legacy_wire = bool(legacy_wire)
         self._dedup: "OrderedDict[tuple, dict]" = OrderedDict()
         self._dedup_lock = threading.Lock()
         self._conns: set = set()
         self._conns_lock = threading.Lock()
+        # per-op observability counters, served by the built-in "stats"
+        # RPC (calls/bytes_in/bytes_out/dedup_replays per method)
+        self._op_stats: Dict[str, Dict[str, int]] = {}
+        self._stats_lock = threading.Lock()
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -112,45 +275,85 @@ class VarServer:
                     outer._conns.discard(self.request)
 
             def handle(self):
+                proto = PROTO_PICKLE  # every connection starts legacy
+
+                def send(resp) -> int:
+                    parts, n = _encode_frame(resp, proto)
+                    _send_parts(self.request, parts)
+                    return n
+
                 try:
                     while True:
-                        msg = _recv_msg(self.request)
+                        msg, nin = _recv_frame(self.request, proto)
                         method = msg.pop("method")
+                        if method == "_hello":
+                            # wire negotiation: acknowledge and upgrade
+                            # THIS connection; an old server (or a
+                            # legacy_wire one) never reaches here and
+                            # answers "no method" below instead
+                            if not outer._legacy_wire and \
+                                    int(msg.get("version", 0)) >= 2:
+                                send({"ok": True,
+                                      "result": {"version": WIRE_VERSION}})
+                                proto = PROTO_BINARY
+                            else:
+                                send({"ok": False,
+                                      "error": "no method _hello"})
+                            continue
                         if method == "stop":
-                            _send_msg(self.request, {"ok": True})
+                            send({"ok": True})
                             outer._stop_evt.set()
                             return
+                        nout = 0
                         token = msg.pop("_dedup", None)
-                        if token is not None:
-                            kind, val = outer._dedup_begin(token)
-                            if kind == "done":
-                                _send_msg(self.request, val)
-                                continue
-                            if kind == "pending":
-                                # the original execution (from a timed-
-                                # out connection) is still running —
-                                # wait for ITS outcome, never re-execute
-                                _send_msg(self.request,
-                                          outer._dedup_wait(token, val))
-                                continue
-                        fn = outer._handlers.get(method)
-                        if fn is None:
-                            _send_msg(self.request,
-                                      {"ok": False,
-                                       "error": f"no method {method}"})
-                            continue
                         try:
-                            res = fn(**msg)
-                            resp = {"ok": True, "result": res}
-                        except Exception as e:  # surfaced to the client
-                            # error_type lets the client re-raise the
-                            # TYPED exception (WorkerDeadError survives
-                            # the wire — tests/launchers dispatch on it)
-                            resp = {"ok": False, "error": repr(e),
-                                    "error_type": type(e).__name__}
-                        if token is not None:
-                            outer._dedup_put(token, resp)
-                        _send_msg(self.request, resp)
+                            if method == "stats":
+                                nout = send({"ok": True,
+                                             "result": outer.stats()})
+                                continue
+                            if token is not None:
+                                kind, val = outer._dedup_begin(token)
+                                if kind == "done":
+                                    outer._bump(method, replays=1)
+                                    nout = send(val)
+                                    continue
+                                if kind == "pending":
+                                    # the original execution (from a
+                                    # timed-out connection) is still
+                                    # running — wait for ITS outcome,
+                                    # never re-execute
+                                    outer._bump(method, replays=1)
+                                    nout = send(
+                                        outer._dedup_wait(token, val))
+                                    continue
+                            fn = outer._handlers.get(method)
+                            if fn is None:
+                                resp = {"ok": False,
+                                        "error": f"no method {method}"}
+                                if token is not None:
+                                    # resolve the reservation _dedup_begin
+                                    # made, or a retry of this token
+                                    # would wait forever on a pending
+                                    # entry nothing will complete
+                                    outer._dedup_put(token, resp)
+                                nout = send(resp)
+                                continue
+                            try:
+                                res = fn(**msg)
+                                resp = {"ok": True, "result": res}
+                            except Exception as e:  # surfaced to client
+                                # error_type lets the client re-raise
+                                # the TYPED exception (WorkerDeadError
+                                # survives the wire — tests/launchers
+                                # dispatch on it)
+                                resp = {"ok": False, "error": repr(e),
+                                        "error_type": type(e).__name__}
+                            if token is not None:
+                                outer._dedup_put(token, resp)
+                            nout = send(resp)
+                        finally:
+                            outer._bump(method, calls=1, bytes_in=nin,
+                                        bytes_out=nout)
                 except core.RpcProtocolError:
                     _LOG.warning("VarServer: dropping connection with "
                                  "invalid framing", exc_info=True)
@@ -210,6 +413,23 @@ class VarServer:
         if prev is not None and prev[0] == "pending":
             prev[1].set()
 
+    def _bump(self, method: str, calls: int = 0, bytes_in: int = 0,
+              bytes_out: int = 0, replays: int = 0) -> None:
+        with self._stats_lock:
+            st = self._op_stats.setdefault(
+                method, {"calls": 0, "bytes_in": 0, "bytes_out": 0,
+                         "dedup_replays": 0})
+            st["calls"] += calls
+            st["bytes_in"] += bytes_in
+            st["bytes_out"] += bytes_out
+            st["dedup_replays"] += replays
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-op counters (calls, bytes in/out, dedup replays) — also
+        served over the wire by the built-in idempotent "stats" RPC."""
+        with self._stats_lock:
+            return {k: dict(v) for k, v in self._op_stats.items()}
+
     @property
     def port(self) -> int:
         return self._srv.server_address[1]
@@ -251,15 +471,38 @@ _WIRE_ERRORS: Dict[str, type] = {
 }
 
 
-class VarClient:
-    """Per-endpoint client with one persistent connection (reference:
-    grpc_client.h AsyncSendVar/AsyncGetVar calling convention).
+class _Channel:
+    """One pooled connection: socket + its negotiated wire protocol."""
 
-    ``call`` survives transient transport failures: the socket is closed,
-    re-connected, and the request re-sent with exponential backoff up to
-    FLAGS_rpc_retry_times attempts. Methods in ``_IDEMPOTENT`` are safe
-    verbatim; every other method is stamped with a per-client dedup token
-    the server replays instead of re-executing."""
+    __slots__ = ("sock", "proto")
+
+    def __init__(self):
+        self.sock: Optional[socket.socket] = None
+        self.proto = PROTO_PICKLE
+
+    def close(self):
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+        self.proto = PROTO_PICKLE
+
+
+class VarClient:
+    """Per-endpoint client over a small connection pool (reference:
+    grpc_client.h AsyncSendVar/AsyncGetVar calling convention; the pool
+    plays the role of gRPC channel multiplexing so the parameter_prefetch
+    fan-out's concurrent section RPCs don't serialize on one socket).
+
+    ``call`` survives transient transport failures: the channel is
+    closed, re-connected, and the ENCODED frame re-sent verbatim with
+    exponential backoff up to FLAGS_rpc_retry_times attempts. Methods in
+    ``_IDEMPOTENT`` are safe as-is; every other method is stamped with a
+    per-client dedup token the server replays instead of re-executing.
+    Each connection negotiates the wire protocol at connect time
+    (binary v2 with a new server, legacy pickle with an old one)."""
 
     _pool: Dict[str, "VarClient"] = {}
     _pool_lock = threading.Lock()
@@ -273,51 +516,106 @@ class VarClient:
     # response; in-round duplicates are additionally absorbed by the
     # trainer-id keying.
     _IDEMPOTENT = frozenset({
-        "get_var", "prefetch_rows", "heartbeat",
-        "dead_workers", "alive_workers", "table_stats",
+        "get_var", "get_vars_batch", "prefetch_rows", "heartbeat",
+        "dead_workers", "alive_workers", "table_stats", "stats",
     })
 
-    def __init__(self, endpoint: str, connect_timeout: float = 30.0):
+    def __init__(self, endpoint: str, connect_timeout: float = 30.0,
+                 channels: Optional[int] = None):
         self.endpoint = endpoint
         self._host, port = endpoint.rsplit(":", 1)
         self._port = int(port)
         self._connect_timeout = connect_timeout
-        self._lock = threading.Lock()
-        self._sock: Optional[socket.socket] = None
+        if channels is None:
+            # legacy mode pins the pool to the pre-overhaul single
+            # connection per endpoint
+            n = (1 if _pickle_wire_forced() else
+                 int(core.globals_["FLAGS_rpc_channels_per_endpoint"]))
+        else:
+            n = int(channels)
+        self._channels = [_Channel() for _ in range(max(1, n))]
+        self._free = deque(self._channels)
+        self._cv = threading.Condition()
         self._token_prefix = f"{os.getpid()}:{id(self):x}"
         self._seq = itertools.count()
-        with self._lock:
-            self._connect_locked(connect_timeout)
+        # methods this endpoint's server answered "no method" to — the
+        # batch helpers probe once, then fall back without the wasted
+        # round trip (server lifetime assumption: capabilities don't
+        # shrink; a restart with fewer methods re-probes only after a
+        # new VarClient)
+        self._missing_methods: set = set()
+        # connect ONE channel eagerly: an unreachable pserver surfaces
+        # now, and negotiation happens off the data path. The remaining
+        # channels connect lazily on first concurrent use.
+        ch = self._acquire()
+        try:
+            self._connect_channel(ch, connect_timeout)
+        finally:
+            self._release(ch)
 
     # ------------------------------------------------------------ plumbing
     @property
     def _deadline_s(self) -> float:
         return float(core.globals_["FLAGS_rpc_deadline"]) / 1000.0
 
-    def _connect_locked(self, connect_timeout: float):
-        """(Re)establish the connection; the server may be down/restarting
-        — poll until ``connect_timeout`` elapses."""
+    def _acquire(self) -> _Channel:
+        with self._cv:
+            while not self._free:
+                self._cv.wait()
+            return self._free.popleft()
+
+    def _release(self, ch: _Channel) -> None:
+        with self._cv:
+            self._free.append(ch)
+            self._cv.notify()
+
+    def _connect_channel(self, ch: _Channel, connect_timeout: float):
+        """(Re)establish one connection; the server may be down or
+        restarting — poll until ``connect_timeout`` elapses. Negotiates
+        the wire protocol: a legacy-framed ``_hello`` probe upgrades the
+        connection to binary v2 when the server supports it; an old
+        server answers "no method" and the channel stays legacy."""
         deadline = time.time() + connect_timeout
         last = None
         while time.time() < deadline:
             try:
-                self._sock = socket.create_connection(
+                sock = socket.create_connection(
                     (self._host, self._port), timeout=self._deadline_s)
-                return
             except OSError as e:  # server not up (yet) — retry
                 last = e
                 time.sleep(0.1)
-        self._sock = None
+                continue
+            ch.sock, ch.proto = sock, PROTO_PICKLE
+            if _pickle_wire_forced():
+                return
+            try:
+                _send_msg(sock, {"method": "_hello",
+                                 "version": WIRE_VERSION})
+                resp = _recv_msg(sock)
+            except core.RpcProtocolError:
+                # a poisoned stream is NOT a transient connect failure —
+                # surface it typed, never retry into it
+                ch.close()
+                raise
+            except (ConnectionError, OSError) as e:
+                ch.close()
+                last = e
+                time.sleep(0.1)
+                continue
+            if resp.get("ok") and int((resp.get("result") or {})
+                                      .get("version", 0)) >= 2:
+                ch.proto = PROTO_BINARY
+            return
+        ch.close()
         raise ConnectionError(
             f"cannot reach pserver {self.endpoint}: {last}")
 
-    def _close_locked(self):
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+    def close(self):
+        """Close every channel (in-flight calls on other threads surface
+        a transport error and take the retry plane)."""
+        with self._cv:
+            for ch in self._channels:
+                ch.close()
 
     @classmethod
     def of(cls, endpoint: str) -> "VarClient":
@@ -331,8 +629,7 @@ class VarClient:
     def reset_pool(cls):
         with cls._pool_lock:
             for c in cls._pool.values():
-                with c._lock:
-                    c._close_locked()
+                c.close()
             cls._pool.clear()
 
     # ---------------------------------------------------------------- call
@@ -342,7 +639,10 @@ class VarClient:
         errors. Protocol errors (bad framing) and application errors
         (ok=False responses) are never retried. ``_rpc_timeout`` (s) /
         ``_rpc_retries`` override the FLAGS for this call only (the
-        heartbeat thread uses short ones so a dead server can't pin it)."""
+        heartbeat thread uses short ones so a dead server can't pin it).
+        Frames are encoded ONCE per wire protocol and retries re-send
+        the cached parts verbatim. When the profiler is on, every call
+        emits a cat="rpc" span carrying byte and retry counts."""
         deadline_s = (self._deadline_s if _rpc_timeout is None
                       else float(_rpc_timeout))
         retries = (max(0, int(core.globals_["FLAGS_rpc_retry_times"]))
@@ -350,33 +650,47 @@ class VarClient:
         msg = {"method": method, **kwargs}
         if method not in self._IDEMPOTENT:
             msg["_dedup"] = (self._token_prefix, next(self._seq))
+        frames: Dict[int, tuple] = {}  # proto -> (parts, nbytes)
         attempt = 0
-        while True:
-            try:
-                with self._lock:
-                    if self._sock is None:
-                        self._connect_locked(self._connect_timeout)
-                    self._sock.settimeout(deadline_s)
-                    _send_msg(self._sock, msg)
-                    resp = _recv_msg(self._sock)
-                break
-            except core.RpcProtocolError:
-                with self._lock:
-                    self._close_locked()
-                raise
-            except (ConnectionError, OSError) as e:
-                with self._lock:
-                    self._close_locked()
-                attempt += 1
-                if attempt > retries:
-                    raise ConnectionError(
-                        f"rpc {method} on {self.endpoint} failed after "
-                        f"{retries} retries: {e!r}") from e
-                backoff = min(2.0, 0.05 * (2 ** (attempt - 1)))
-                _LOG.warning(
-                    "rpc %s on %s hit %r — retry %d/%d in %.2fs",
-                    method, self.endpoint, e, attempt, retries, backoff)
+        bytes_out = bytes_in = 0
+        t_start = time.perf_counter()
+        try:
+            while True:
+                backoff = 0.0
+                ch = self._acquire()
+                try:
+                    if ch.sock is None:
+                        self._connect_channel(ch, self._connect_timeout)
+                    ch.sock.settimeout(deadline_s)
+                    if ch.proto not in frames:
+                        frames[ch.proto] = _encode_frame(msg, ch.proto)
+                    parts, nb = frames[ch.proto]
+                    _send_parts(ch.sock, parts)
+                    bytes_out += nb
+                    resp, nin = _recv_frame(ch.sock, ch.proto)
+                    bytes_in += nin
+                    break
+                except core.RpcProtocolError:
+                    ch.close()
+                    raise
+                except (ConnectionError, OSError) as e:
+                    ch.close()
+                    attempt += 1
+                    if attempt > retries:
+                        raise ConnectionError(
+                            f"rpc {method} on {self.endpoint} failed "
+                            f"after {retries} retries: {e!r}") from e
+                    backoff = min(2.0, 0.05 * (2 ** (attempt - 1)))
+                    _LOG.warning(
+                        "rpc %s on %s hit %r — retry %d/%d in %.2fs",
+                        method, self.endpoint, e, attempt, retries,
+                        backoff)
+                finally:
+                    self._release(ch)
                 time.sleep(backoff)
+        finally:
+            _record_rpc_span(method, kwargs.get("name"), self.endpoint,
+                             t_start, bytes_out, bytes_in, attempt)
         if not resp.get("ok"):
             err = resp.get("error")
             etype = _WIRE_ERRORS.get(resp.get("error_type"))
@@ -390,9 +704,12 @@ class VarClient:
     # convenience wrappers mirroring send_recv.proto service methods
     def send_var(self, name: str, value: np.ndarray, trainer_id: int = 0,
                  rows=None, height: int = 0):
+        # rows ride as an int64 ndarray: a raw buffer on the binary wire
+        # instead of a pickled python list of boxed ints
         return self.call("send_var", name=name, value=np.asarray(value),
                          trainer_id=trainer_id,
-                         rows=None if rows is None else list(map(int, rows)),
+                         rows=None if rows is None
+                         else np.asarray(rows, np.int64).reshape(-1),
                          height=int(height))
 
     def get_var(self, name: str, trainer_id: int = 0) -> np.ndarray:
@@ -400,20 +717,76 @@ class VarClient:
 
     def prefetch_rows(self, name: str, rows) -> np.ndarray:
         return self.call("prefetch_rows", name=name,
-                         rows=list(map(int, rows)))
+                         rows=np.asarray(rows, np.int64).reshape(-1))
 
     def barrier(self, kind: str, trainer_id: int = 0):
         return self.call("barrier", kind=kind, trainer_id=trainer_id)
 
     def stop(self):
         try:
-            with self._lock:
-                if self._sock is None:
+            ch = self._acquire()
+            try:
+                if ch.sock is None:
+                    # prefer an idle channel that is already connected
+                    with self._cv:
+                        for other in list(self._free):
+                            if other.sock is not None:
+                                self._free.remove(other)
+                                self._free.append(ch)
+                                ch = other
+                                break
+                if ch.sock is None:
+                    # no live connection anywhere — a dead/never-reached
+                    # server has nothing to stop; don't burn a connect
+                    # poll on teardown
                     return
-                _send_msg(self._sock, {"method": "stop"})
-                _recv_msg(self._sock)
+                ch.sock.settimeout(self._deadline_s)
+                _send_parts(ch.sock,
+                            _encode_frame({"method": "stop"},
+                                          ch.proto)[0])
+                _recv_frame(ch.sock, ch.proto)
+            finally:
+                self._release(ch)
         except (ConnectionError, OSError):
             pass
+
+
+def send_vars_batch(client: "VarClient", items, trainer_id: int = 0):
+    """One coalesced multi-var send (items: [(name, value), ...]). Falls
+    back to per-var ``send_var`` ONLY when the server predates the batch
+    method ("no method" — nothing was applied); any other failure
+    propagates, because a partially-applied batch must NOT be re-sent
+    per-var under fresh dedup tokens (that would double-apply its
+    already-applied prefix). The missing method is memoized on the
+    client so only the FIRST call against an old server pays the probe
+    round trip."""
+    if "send_vars_batch" not in client._missing_methods:
+        try:
+            client.call("send_vars_batch",
+                        vars=[{"name": n, "value": np.asarray(v)}
+                              for n, v in items],
+                        trainer_id=trainer_id)
+            return
+        except RuntimeError as e:
+            if "no method send_vars_batch" not in str(e):
+                raise
+            client._missing_methods.add("send_vars_batch")
+    for n, v in items:
+        client.send_var(n, v, trainer_id=trainer_id)
+
+
+def _record_rpc_span(method, var, endpoint, t_start, bytes_out, bytes_in,
+                     retries):
+    """cat="rpc" profiler span per client call (name ``op:var@ep``) so
+    chrome traces show RPC time next to cat="segment"/"window" spans."""
+    from . import profiler
+    if not profiler.is_profiling():
+        return
+    profiler.record_span(
+        f"{method}:{var or '-'}@{endpoint}", t_start,
+        time.perf_counter(), cat="rpc",
+        args={"bytes_out": int(bytes_out), "bytes_in": int(bytes_in),
+              "retries": int(retries)})
 
 
 class HeartBeatMonitor:
@@ -611,8 +984,11 @@ class WorkerHeartBeat:
                 try:
                     cli = self._clients.get(ep)
                     if cli is None:
+                        # one private channel is enough: beats are tiny
+                        # and strictly sequential on this thread
                         cli = self._clients[ep] = VarClient(
-                            ep, connect_timeout=max(1.0, self.interval))
+                            ep, connect_timeout=max(1.0, self.interval),
+                            channels=1)
                     cli.call("heartbeat", trainer_id=self.trainer_id,
                              _rpc_timeout=max(1.0, self.interval * 2),
                              _rpc_retries=0)
@@ -633,8 +1009,7 @@ class WorkerHeartBeat:
         # snapshot: the beat thread may outlive the bounded join and
         # still be mutating the dict
         for cli in list(self._clients.values()):
-            with cli._lock:
-                cli._close_locked()
+            cli.close()
         self._clients.clear()
 
 

@@ -183,7 +183,8 @@ class DistributeTranspiler:
         keep = [op for op in block.ops
                 if not (op.attrs.get("op_role") == OP_ROLE_OPTIMIZE
                         and op.attrs.get("op_role_var"))]
-        # rewrite distributed embeddings to remote pulls
+        # rewrite distributed embeddings to remote pulls, and their grad
+        # ops to remote row pushes
         for op in keep:
             if op.type in ("lookup_table", "lookup_table_v2") and \
                     op.input("W")[0] in self.sparse_tables:
@@ -198,6 +199,24 @@ class DistributeTranspiler:
                     # its id-subset (lazily for beyond-HBM tables)
                     "epmap": list(self.pserver_endpoints),
                     "trainer_id": self.trainer_id})
+            elif op.type in ("lookup_table_grad", "lookup_table_v2_grad") \
+                    and op.input("W")[0] in self.sparse_tables:
+                # the table lives on the pservers, so its row gradient
+                # must CROSS THE WIRE (distributed_lookup_table_grad:
+                # duplicate-premerged, row-sharded sends — the reference
+                # transpiler's sparse-grad send rewrite). Leaving the
+                # local lookup_table_grad here would drop the sparse
+                # update on the trainer floor: the embedding would never
+                # train.
+                w = op.input("W")[0]
+                op.type = "distributed_lookup_table_grad"
+                op.inputs = {"Ids": op.input("Ids"), "W": [w],
+                             "Outputs@GRAD": op.input("Out@GRAD")}
+                op.outputs = {}
+                op.attrs.update({
+                    "table_names": [w],
+                    "epmap": list(self.pserver_endpoints),
+                    "trainer_id": self.trainer_id})
         block.ops[:] = keep
 
         # group dense sends/recvs per endpoint
@@ -210,6 +229,11 @@ class DistributeTranspiler:
             by_ep_grads.setdefault(ep, []).append(g)
             by_ep_params.setdefault(ep, []).append(p)
         eps = sorted(by_ep_grads)
+        # barriers go to EVERY pserver, not just the ones hosting dense
+        # grads: a sparse-only shard defers its row applies to the send-
+        # barrier release (listen_and_serv sync mode) and would never
+        # train if no barrier reached it
+        barrier_eps = list(self.pserver_endpoints)
         for ep in eps:
             block.append_op(
                 type="send", inputs={"X": by_ep_grads[ep]}, outputs={},
@@ -217,7 +241,7 @@ class DistributeTranspiler:
                        "trainer_id": self.trainer_id})
         if self.sync_mode:
             block.append_op(type="send_barrier", inputs={}, outputs={},
-                            attrs={"endpoints": eps,
+                            attrs={"endpoints": barrier_eps,
                                    "trainer_id": self.trainer_id})
         for ep in eps:
             block.append_op(
@@ -227,7 +251,7 @@ class DistributeTranspiler:
                        "trainer_id": self.trainer_id})
         if self.sync_mode:
             block.append_op(type="fetch_barrier", inputs={}, outputs={},
-                            attrs={"endpoints": eps,
+                            attrs={"endpoints": barrier_eps,
                                    "trainer_id": self.trainer_id})
         self.trainer_program = prog
 

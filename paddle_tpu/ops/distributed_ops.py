@@ -28,6 +28,49 @@ def _client(ep):
     return VarClient.of(ep)
 
 
+# shared fan-out pool for per-pserver RPC overlap (reference:
+# parameter_prefetch.cc issues every section's RPC before waiting on any
+# of them). Threads are IO-bound socket waiters, so a small shared pool
+# is plenty; VarClient's per-endpoint channel pool keeps the concurrent
+# calls from serializing on one socket.
+_FANOUT_POOL = None
+_FANOUT_LOCK = threading.Lock()
+
+
+def _legacy_dataplane() -> bool:
+    """PADDLE_TPU_PS_PICKLE_WIRE=1 = the full legacy data plane (serial
+    shard walks, no dedup, no batched RPCs) — one source of truth in
+    ps_rpc so the bench lanes can't drift."""
+    from ..fluid.ps_rpc import _pickle_wire_forced
+    return _pickle_wire_forced()
+
+
+def _fanout(tasks):
+    """Run callables concurrently; return their results in order. The
+    FIRST error wins — the rest are drained (awaited) first so no RPC is
+    left in flight against a half-torn-down scope."""
+    if len(tasks) == 1 or _legacy_dataplane():
+        return [t() for t in tasks]
+    global _FANOUT_POOL
+    with _FANOUT_LOCK:
+        if _FANOUT_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _FANOUT_POOL = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="ps-fanout")
+    futs = [_FANOUT_POOL.submit(t) for t in tasks]
+    results, first_err = [], None
+    for f in futs:
+        try:
+            results.append(f.result())
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            if first_err is None:
+                first_err = e
+            results.append(None)
+    if first_err is not None:
+        raise first_err
+    return results
+
+
 def _np_of(scope, name):
     v = scope.find_var(name)
     if v is None or not v.is_initialized():
@@ -41,18 +84,34 @@ def _np_of(scope, name):
 # --------------------------------------------------------------------------
 # trainer-side ops
 # --------------------------------------------------------------------------
+# send op: vars whose scope slot was never initialized (a conditional
+# branch that never ran, an optimizer slot created late) are SKIPPED with
+# a one-time warning instead of shipping None into send_var and crashing
+# the pserver handler
+_warned_uninit_sends = set()
+
+
 @register_op("send", stateful=True, no_grad=True,
              attr_defaults={"epmap": [], "trainer_id": 0})
 def _send(ins, attrs):
+    import logging
     from ..fluid.communicator import Communicator
     ctx = attrs["_ctx"]
     names = ctx.op.input("X")
     epmap = attrs.get("epmap") or []
     tid = int(attrs.get("trainer_id", 0))
     comm = Communicator.global_instance()
+    dense_by_ep: dict = {}
     for i, name in enumerate(names):
         ep = epmap[i if i < len(epmap) else -1]
         val = _np_of(ctx.scope, name)
+        if val is None:
+            if name not in _warned_uninit_sends:
+                _warned_uninit_sends.add(name)
+                logging.getLogger("paddle_tpu.ps").warning(
+                    "send op: var '%s' is uninitialized in this scope — "
+                    "skipping its RPC to %s (warned once)", name, ep)
+            continue
         if isinstance(val, core.SelectedRows):
             _client(ep).send_var(name, np.asarray(val.get_tensor().array),
                                  trainer_id=tid, rows=val.rows(),
@@ -62,7 +121,17 @@ def _send(ins, attrs):
             # merge thread (reference AsyncCommunicator::Send)
             comm.push(name, val, ep, trainer_id=tid)
         else:
-            _client(ep).send_var(name, val, trainer_id=tid)
+            dense_by_ep.setdefault(ep, []).append((name, val))
+    # dense grads coalesce into ONE batched RPC per endpoint (the dedup
+    # token covers the batch, old servers get the per-var fallback —
+    # ps_rpc.send_vars_batch; the legacy lane keeps one RPC per var)
+    from ..fluid.ps_rpc import send_vars_batch
+    for ep, items in dense_by_ep.items():
+        if len(items) > 1 and not _legacy_dataplane():
+            send_vars_batch(_client(ep), items, trainer_id=tid)
+        else:
+            for name, val in items:
+                _client(ep).send_var(name, val, trainer_id=tid)
     return {}
 
 
@@ -153,10 +222,31 @@ def _recv(ins, attrs):
     names = ctx.op.output("Out")
     epmap = attrs.get("epmap") or []
     tid = int(attrs.get("trainer_id", 0))
+    by_ep: dict = {}
     for i, name in enumerate(names):
         ep = epmap[i if i < len(epmap) else -1]
-        arr = _client(ep).get_var(name, trainer_id=tid)
-        ctx.scope.var(name).set_value(core.LoDTensor(jnp.asarray(arr)))
+        by_ep.setdefault(ep, []).append(name)
+    for ep, ep_names in by_ep.items():
+        cli = _client(ep)
+        if len(ep_names) == 1 or _legacy_dataplane() \
+                or "get_vars_batch" in cli._missing_methods:
+            got = [cli.get_var(n, trainer_id=tid) for n in ep_names]
+        else:
+            # one batched fetch per endpoint (get_vars_batch; falls back
+            # per-var when an old server doesn't know the method — any
+            # other failure propagates; the miss is memoized so only
+            # the first call pays the probe)
+            try:
+                got = cli.call("get_vars_batch", names=ep_names,
+                               trainer_id=tid)
+            except RuntimeError as e:
+                if "no method get_vars_batch" not in str(e):
+                    raise
+                cli._missing_methods.add("get_vars_batch")
+                got = [cli.get_var(n, trainer_id=tid) for n in ep_names]
+        for name, arr in zip(ep_names, got):
+            ctx.scope.var(name).set_value(
+                core.LoDTensor(jnp.asarray(arr)))
     return {}
 
 
@@ -199,6 +289,17 @@ def _table_dim(ctx, w_name):
     return 1
 
 
+def _table_dtype(ctx, w_name):
+    """The table's declared dtype from the block var desc — the empty-ids
+    fast path must carry it (an fp16/bf16 table must not silently upcast
+    its zero-row result to float32)."""
+    try:
+        v = ctx.op.block.var(w_name)
+        return jnp.dtype(core.dtype_to_np(v.dtype))
+    except Exception:
+        return jnp.float32
+
+
 @register_op("distributed_lookup_table", stateful=True,
              attr_defaults={"epmap": [], "table_names": [], "padding_idx": -1,
                             "is_distributed": True, "trainer_id": 0})
@@ -216,27 +317,41 @@ def _distributed_lookup_table(ins, attrs):
         ids = np.asarray(ctx.scope.find_var(nm).value().array).reshape(-1)
         if len(ids) == 0:
             # legitimately empty id batch: no RPC; the result must still
-            # carry the table's embedding dim or downstream ops reject
-            # the shape (ADVICE r2)
+            # carry the table's embedding dim AND dtype or downstream
+            # ops reject the shape / silently upcast (ADVICE r2)
             outs.append(jnp.zeros((0, _table_dim(ctx, w_name)),
-                                  jnp.float32))
+                                  _table_dtype(ctx, w_name)))
             continue
-        if len(eps) == 1:
-            rows = np.asarray(_client(eps[0]).prefetch_rows(w_name, ids))
+        # duplicate-id dedup: a CTR batch repeats hot ids heavily — pull
+        # each distinct row ONCE and scatter back via the inverse map
+        # (reference parameter_prefetch merges ids per section the same
+        # way); cuts the payload by the batch's duplication factor
+        if _legacy_dataplane():
+            uniq, inv = ids, np.arange(len(ids))
         else:
-            shard = ids % len(eps)
-            rows = None
-            for k, ep in enumerate(eps):
-                sel = np.where(shard == k)[0]
-                if not len(sel):
-                    continue
-                part = np.asarray(
-                    _client(ep).prefetch_rows(w_name, ids[sel]))
-                if rows is None:
-                    rows = np.zeros((len(ids), part.shape[-1]),
-                                    part.dtype)
-                rows[sel] = part
-        outs.append(jnp.asarray(rows))
+            uniq, inv = np.unique(ids, return_inverse=True)
+        if len(eps) == 1:
+            rows_u = np.asarray(
+                _client(eps[0]).prefetch_rows(w_name, uniq))
+        else:
+            # all per-pserver section RPCs issued concurrently, joined
+            # after (reference parameter_prefetch overlap)
+            shard = uniq % len(eps)
+            sels = [np.where(shard == k)[0] for k in range(len(eps))]
+            live = [(ep, sel) for ep, sel in zip(eps, sels) if len(sel)]
+
+            def _pull(ep, sel):
+                return np.asarray(
+                    _client(ep).prefetch_rows(w_name, uniq[sel]))
+
+            parts = _fanout([
+                (lambda ep=ep, sel=sel: _pull(ep, sel))
+                for ep, sel in live])
+            rows_u = np.empty((len(uniq), parts[0].shape[-1]),
+                              parts[0].dtype)
+            for (_ep, sel), part in zip(live, parts):
+                rows_u[sel] = part
+        outs.append(jnp.asarray(rows_u[inv]))
     return {"Outputs": outs}
 
 
@@ -270,17 +385,34 @@ def _distributed_lookup_table_grad(ins, attrs):
             continue  # nothing to push, no RPC
         g = np.asarray(ctx.scope.find_var(gn).value().array)
         g = g.reshape(len(ids), -1)
+        # pre-merge duplicate rows client-side: the server applies ONE
+        # row per distinct id (sum of the duplicates), the payload
+        # shrinks by the duplication factor. NOT gated by the legacy
+        # lane: merging changes fp accumulation ORDER, and the paired
+        # bench rows assert bit-exact loss parity across lanes — every
+        # legacy-gated difference must be numerics-exact
+        # (wire/fan-out/pool/coalescing/lookup-dedup all are)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        if len(uniq) < len(ids):
+            merged = np.zeros((len(uniq), g.shape[1]), g.dtype)
+            np.add.at(merged, inv, g)
+            ids, g = uniq, merged
         if len(eps) == 1:
             _client(eps[0]).send_var(w_name + "@GRAD", g, trainer_id=tid,
                                      rows=ids, height=0)
             continue
+        # concurrent per-pserver sends, first error wins (fan-out like
+        # the forward pull)
         shard = ids % len(eps)
-        for k, ep in enumerate(eps):
-            sel = np.where(shard == k)[0]
-            if len(sel):
-                _client(ep).send_var(w_name + "@GRAD", g[sel],
-                                     trainer_id=tid, rows=ids[sel],
-                                     height=0)
+        sels = [np.where(shard == k)[0] for k in range(len(eps))]
+        live = [(ep, sel) for ep, sel in zip(eps, sels) if len(sel)]
+
+        def _push(ep, sel):
+            _client(ep).send_var(w_name + "@GRAD", g[sel],
+                                 trainer_id=tid, rows=ids[sel], height=0)
+
+        _fanout([(lambda ep=ep, sel=sel: _push(ep, sel))
+                 for ep, sel in live])
     return {}
 
 
@@ -356,9 +488,15 @@ def _listen_and_serv(ins, attrs):
 
     # ONE lock guards grad state for send/geo handlers AND backs the
     # BarrierManager's condition — the release action (aggregate +
-    # optimize) runs holding it, so it can't race a straggler send
+    # optimize) runs holding it, so it can't race a straggler send.
+    # pending: dense grads per name; pending_sparse: row grads as
+    # (trainer_id, seq, name, value, rows) — in SYNC mode sparse applies
+    # are DEFERRED to the barrier release (reference RunSyncLoop applies
+    # everything after the send barrier), so every trainer's pulls
+    # within a round see the same pre-round table, and the release
+    # applies entries in a deterministic (trainer, seq) order.
     lock = threading.RLock()
-    state = {"pending": {}}
+    state = {"pending": {}, "pending_sparse": [], "sparse_seq": 0}
 
     # failure-detection cadence is deploy-tunable (tests shrink it to
     # seconds; reference FLAGS_worker_update_interval_secs plays this role)
@@ -381,7 +519,7 @@ def _listen_and_serv(ins, attrs):
         if isinstance(val, core.LazyEmbeddingTable):
             val.apply_grad(rows, np.asarray(value) * scale, sparse_lr)
             return
-        tbl = np.asarray(val.array)
+        tbl = np.array(val.array)  # jax-array views are read-only
         np.subtract.at(tbl, np.asarray(rows, np.int64),
                        sparse_lr * scale * np.asarray(value))
         var.set_value(core.LoDTensor(jnp.asarray(tbl)))
@@ -394,26 +532,55 @@ def _listen_and_serv(ins, attrs):
                 if blk_id is not None:
                     break
 
+    def _apply_one_locked(name, value, rows, trainer_id=0):
+        if rows is not None:
+            if sync:
+                state["sparse_seq"] += 1
+                state["pending_sparse"].append(
+                    (int(trainer_id), state["sparse_seq"], name,
+                     np.asarray(value), np.asarray(rows, np.int64)))
+            else:
+                _apply_sparse(name, value, rows)
+            return
+        if sync:
+            state["pending"].setdefault(name, []).append(
+                np.asarray(value))
+        else:
+            scope.var(name).set_value(
+                core.LoDTensor(jnp.asarray(value)))
+            _run_block_for(name)
+
     def h_send_var(name, value, trainer_id=0, rows=None, height=0):
         monitor.update(trainer_id)
         with lock:
-            if rows is not None:
-                _apply_sparse(name, value, rows)
-                return True
-            if sync:
-                state["pending"].setdefault(name, []).append(
-                    np.asarray(value))
-            else:
-                scope.var(name).set_value(
-                    core.LoDTensor(jnp.asarray(value)))
-                _run_block_for(name)
+            _apply_one_locked(name, value, rows, trainer_id)
+        return True
+
+    def h_send_vars_batch(vars, trainer_id=0):
+        """Coalesced multi-var send (Communicator flush): every entry
+        applies under ONE grad-lock acquisition; the caller's dedup
+        token covers the whole batch, so a replayed retry re-applies
+        none of it."""
+        monitor.update(trainer_id)
+        with lock:
+            for v in vars:
+                _apply_one_locked(v["name"], v["value"], v.get("rows"),
+                                  trainer_id)
         return True
 
     def _release_send_round():
         # aggregate: average each grad across trainers (the reference
         # transpiler's sum + scale(1/trainers) on the server optimize
         # path), then run optimize. Runs under the shared lock, invoked
-        # by the LAST arrival inside BarrierManager.arrive.
+        # by the LAST arrival inside BarrierManager.arrive. Sparse row
+        # grads deferred by _apply_one_locked apply FIRST, in
+        # (trainer, seq) order — deterministic regardless of arrival
+        # interleaving, so lock-stepped trainers reproduce bit-for-bit.
+        for tid, seq, name, value, rows in sorted(
+                state["pending_sparse"], key=lambda e: (e[0], e[1])):
+            _apply_sparse(name, value, rows)
+        state["pending_sparse"].clear()
+        state["sparse_seq"] = 0
         for name, parts in state["pending"].items():
             total = parts[0]
             for p in parts[1:]:
@@ -437,6 +604,7 @@ def _listen_and_serv(ins, attrs):
             # double-counting a partial batch
             with lock:
                 state["pending"].clear()
+                state["pending_sparse"].clear()
             raise
         return True
 
@@ -446,12 +614,22 @@ def _listen_and_serv(ins, attrs):
             raise KeyError(f"pserver has no var '{name}'")
         return np.asarray(arr)
 
+    def h_get_vars_batch(names, trainer_id=0):
+        """Batched fetch: the recv op pulls all of an endpoint's params
+        in ONE RPC (read-only, idempotent like get_var)."""
+        return [h_get_var(n, trainer_id) for n in names]
+
     def h_prefetch_rows(name, rows):
-        val = scope.find_var(name).value()
-        if isinstance(val, core.LazyEmbeddingTable):
-            return val.get_rows(rows)
-        tbl = np.asarray(val.array)
-        return tbl[np.asarray(rows, np.int64)]
+        # under the grad lock: get_rows materializes rows (slab growth,
+        # index/LRU mutation) and must not interleave with a concurrent
+        # apply_grad — the channel pool + fan-out make overlapping RPCs
+        # from one trainer routine now
+        with lock:
+            val = scope.find_var(name).value()
+            if isinstance(val, core.LazyEmbeddingTable):
+                return val.get_rows(rows)
+            tbl = np.asarray(val.array)
+            return tbl[np.asarray(rows, np.int64)]
 
     def h_table_stats(name):
         """Introspection for tests/monitoring: touched rows + evictions."""
@@ -491,7 +669,9 @@ def _listen_and_serv(ins, attrs):
 
     monitor.start_monitor()
     srv = VarServer(endpoint, {
-        "send_var": h_send_var, "barrier": h_barrier, "get_var": h_get_var,
+        "send_var": h_send_var, "send_vars_batch": h_send_vars_batch,
+        "barrier": h_barrier, "get_var": h_get_var,
+        "get_vars_batch": h_get_vars_batch,
         "prefetch_rows": h_prefetch_rows, "checkpoint": h_checkpoint,
         "table_stats": h_table_stats,
         "geo_delta": h_geo_delta,

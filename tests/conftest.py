@@ -38,6 +38,11 @@ def pytest_configure(config):
         "multiprocess ones also carry 'slow'. All injections run "
         "JAX_PLATFORMS=cpu subprocesses, so PADDLE_TPU_TEST_SHARD "
         "file-level sharding applies unchanged.")
+    config.addinivalue_line(
+        "markers", "rpcbench: PS-RPC data-plane microbench smoke "
+        "(tools/rpc_microbench.py loopback sweep at tiny sizes — the "
+        "full 4KB..64MB run is a manual tool invocation). In-process "
+        "and fast, stays in the tier-1 non-slow set.")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -313,14 +313,121 @@ def test_recv_msg_rejects_oversized_length_prefix():
 
         th = threading.Thread(target=bogus_server, daemon=True)
         th.start()
-        cli = VarClient(f"127.0.0.1:{lst.getsockname()[1]}")
         t0 = time.time()
         with pytest.raises(core.RpcProtocolError):
+            # the poison prefix may land during the connect-time wire
+            # negotiation or during the call — either way it must
+            # surface TYPED and unretried
+            cli = VarClient(f"127.0.0.1:{lst.getsockname()[1]}")
             cli.call("get_var", name="x")
         assert time.time() - t0 < 5.0  # no retry/backoff burned
         lst.close()
     finally:
         core.set_flag("FLAGS_rpc_max_message_size", old)
+
+
+def test_binary_frame_interrupted_send_retried_exactly_once():
+    """A server death mid-call over the BINARY wire (the multi-part
+    frame may be half-sent when the socket dies) is absorbed by retry:
+    the cached frame parts are re-sent verbatim to the restarted server
+    and the dedup token guarantees exactly-once application."""
+    from paddle_tpu.fluid.ps_rpc import PROTO_BINARY, VarClient, VarServer
+
+    applied = []
+
+    def h_send(name, value, trainer_id=0, rows=None, height=0):
+        applied.append(np.asarray(value))
+        return True
+
+    port = free_port()
+    ep = f"127.0.0.1:{port}"
+    srv = VarServer(ep, {"send_var": h_send}).start()
+    cli = VarClient(ep, channels=1)
+    assert cli._channels[0].proto == PROTO_BINARY
+    try:
+        # sever the negotiated connection server-side, like a crash —
+        # the in-flight/next frame dies mid-stream
+        srv.shutdown()
+        srv2 = VarServer(ep, {"send_var": h_send}).start()
+        big = np.arange(1 << 16, dtype=np.float32)  # multi-part frame
+        assert cli.send_var("w", big) is True
+        assert len(applied) == 1                    # exactly once
+        np.testing.assert_array_equal(applied[0], big)
+        # the retried frame arrived on a re-negotiated BINARY channel
+        assert cli._channels[0].proto == PROTO_BINARY
+        assert srv2.stats()["send_var"]["calls"] == 1
+    finally:
+        for s in (srv, srv2):
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+
+
+def test_oversized_raw_buffer_spec_rejected_as_protocol_error():
+    """Binary-wire guard: a frame whose HEADER is small but whose
+    declared raw-buffer total exceeds FLAGS_rpc_max_message_size must
+    die as RpcProtocolError (connection dropped, no giant allocation),
+    and the server keeps serving."""
+    import pickle
+
+    from paddle_tpu.fluid import core
+    from paddle_tpu.fluid.ps_rpc import (VarClient, VarServer, _LEN,
+                                         _recv_msg, _send_msg)
+
+    old = core.globals_["FLAGS_rpc_max_message_size"]
+    core.set_flag("FLAGS_rpc_max_message_size", 1 << 16)
+    try:
+        srv = VarServer(f"127.0.0.1:{free_port()}",
+                        {"get_var": lambda name, trainer_id=0: 1}).start()
+        try:
+            raw = socket.create_connection(("127.0.0.1", srv.port),
+                                           timeout=10)
+            _send_msg(raw, {"method": "_hello", "version": 2})
+            assert _recv_msg(raw).get("ok")  # connection upgraded to v2
+            # tiny header, huge declared buffer: 2^40 float32 rows
+            header = pickle.dumps(
+                {"h": {"method": "send_var", "name": "w",
+                       "value": None},
+                 "b": [("<f4", (1 << 40,))]}, protocol=4)
+            raw.sendall(_LEN.pack(len(header)) + header)
+            assert raw.recv(1) == b""  # dropped, no MemoryError crash
+            raw.close()
+            cli = VarClient(f"127.0.0.1:{srv.port}")
+            assert cli.call("get_var", name="x") == 1  # still serving
+        finally:
+            srv.shutdown()
+    finally:
+        core.set_flag("FLAGS_rpc_max_message_size", old)
+
+
+def test_batched_send_dedup_token_replays_whole_batch():
+    """A send_vars_batch retry (same dedup token) must apply the WHOLE
+    batch exactly once and replay the cached response."""
+    from paddle_tpu.fluid.ps_rpc import VarServer, _recv_msg, _send_msg
+
+    applied = []
+    srv = VarServer(
+        f"127.0.0.1:{free_port()}",
+        {"send_vars_batch": lambda vars, trainer_id=0:
+         applied.append([v["name"] for v in vars]) or len(applied)})
+    srv.start()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        msg = {"method": "send_vars_batch",
+               "vars": [{"name": "a", "value": 1.0},
+                        {"name": "b", "value": 2.0}],
+               "_dedup": ("tok", 42)}
+        _send_msg(s, msg)
+        r1 = _recv_msg(s)
+        _send_msg(s, dict(msg))  # the retry
+        r2 = _recv_msg(s)
+        s.close()
+        assert r1 == r2 == {"ok": True, "result": 1}
+        assert applied == [["a", "b"]]  # whole batch, exactly once
+        assert srv.stats()["send_vars_batch"]["dedup_replays"] == 1
+    finally:
+        srv.shutdown()
 
 
 def test_communicator_stop_warns_on_wedged_thread(caplog):

@@ -4,8 +4,14 @@ by tools/make_golden_fixtures.py using the protobuf runtime over the
 reference framework.proto (compiled with protoc) and byte-packed per the
 reference stream layout (lod_tensor.cc:220 SerializeToStream,
 tensor_util.cc:385 TensorToStream, framework.proto:25 ProgramDesc).
-A self-round-trip can't catch a format drift; these can."""
+A self-round-trip can't catch a format drift; these can.
+
+Also covers PS-RPC wire GENERATION compat (docs/PS_DATA_PLANE.md): a
+legacy pickle-frame client must keep working against a binary-capable
+server, and a new client must downgrade cleanly against a legacy-only
+server — negotiation happens per connection via the ``_hello`` probe."""
 import os
+import socket
 
 import numpy as np
 
@@ -70,6 +76,109 @@ def test_native_loader_accepts_golden_program():
     report = inspect_program_bytes(_golden("golden_fc.program.pb"))
     assert not report.get("errors"), report
     assert report.get("num_ops", 2) == 2 or report.get("ops") is not None
+
+
+# --------------------------------------------------------------------------
+# PS-RPC wire generations (ps_rpc.py framing negotiation)
+# --------------------------------------------------------------------------
+def _rpc_free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _echo_server(legacy_wire=False):
+    from paddle_tpu.fluid.ps_rpc import VarServer
+
+    store = {}
+    srv = VarServer(
+        f"127.0.0.1:{_rpc_free_port()}",
+        {"send_var": lambda name, value, trainer_id=0, rows=None,
+         height=0: store.__setitem__(
+             name, (np.asarray(value),
+                    None if rows is None else np.asarray(rows))) or True,
+         "get_var": lambda name, trainer_id=0: store[name][0]},
+        legacy_wire=legacy_wire).start()
+    return srv, f"127.0.0.1:{srv.port}", store
+
+
+def test_legacy_frame_client_talks_to_new_server(monkeypatch):
+    """Old-frame peers keep working: a pickle-wire client (simulated via
+    PADDLE_TPU_PS_PICKLE_WIRE=1, exactly the pre-negotiation frames)
+    round-trips tensors through a binary-capable server."""
+    from paddle_tpu.fluid.ps_rpc import PROTO_PICKLE, VarClient
+
+    srv, ep, store = _echo_server()
+    try:
+        monkeypatch.setenv("PADDLE_TPU_PS_PICKLE_WIRE", "1")
+        cli = VarClient(ep, channels=1)
+        assert cli._channels[0].proto == PROTO_PICKLE
+        w = np.arange(30, dtype=np.float16).reshape(5, 6)
+        cli.send_var("w", w, rows=[4, 0, 2], height=5)
+        got = np.asarray(cli.get_var("w"))
+        assert got.dtype == w.dtype
+        np.testing.assert_array_equal(got, w)
+        np.testing.assert_array_equal(store["w"][1], [4, 0, 2])
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_new_client_downgrades_to_legacy_frame_server():
+    """The _hello probe against an old server (legacy_wire VarServer
+    answers 'no method' exactly like the pre-PR4 server) leaves the
+    connection on v1 pickle frames and everything still round-trips."""
+    from paddle_tpu.fluid.ps_rpc import PROTO_PICKLE, VarClient
+
+    srv, ep, _store = _echo_server(legacy_wire=True)
+    try:
+        cli = VarClient(ep, channels=1)
+        assert cli._channels[0].proto == PROTO_PICKLE  # downgraded
+        w = np.arange(12, dtype=np.int64).reshape(3, 4)
+        cli.send_var("w", w)
+        got = np.asarray(cli.get_var("w"))
+        assert got.dtype == w.dtype
+        np.testing.assert_array_equal(got, w)
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_binary_and_legacy_wire_deliver_identical_tensors(monkeypatch):
+    """Same payload through both wire generations == bit-identical bytes
+    on arrival (framing must never touch tensor contents)."""
+    from paddle_tpu.fluid.ps_rpc import (PROTO_BINARY, PROTO_PICKLE,
+                                         VarClient)
+
+    srv, ep, store = _echo_server()
+    try:
+        rng = np.random.RandomState(3)
+        payloads = {
+            "f32": rng.randn(17, 9).astype(np.float32),
+            "f16": rng.randn(8, 3).astype(np.float16),
+            "i64": rng.randint(-5, 5, (11,)).astype(np.int64),
+            "bool": (rng.rand(6) > 0.5),
+        }
+        cli_bin = VarClient(ep, channels=1)
+        assert cli_bin._channels[0].proto == PROTO_BINARY
+        monkeypatch.setenv("PADDLE_TPU_PS_PICKLE_WIRE", "1")
+        cli_leg = VarClient(ep, channels=1)
+        assert cli_leg._channels[0].proto == PROTO_PICKLE
+        for key, val in payloads.items():
+            cli_bin.send_var("bin_" + key, val)
+            cli_leg.send_var("leg_" + key, val)
+            a = np.asarray(cli_bin.get_var("leg_" + key))  # cross-read
+            b = np.asarray(cli_leg.get_var("bin_" + key))
+            assert a.dtype == b.dtype == val.dtype
+            np.testing.assert_array_equal(a, val)
+            np.testing.assert_array_equal(b, val)
+            assert a.tobytes() == b.tobytes() == val.tobytes()
+        cli_bin.close()
+        cli_leg.close()
+    finally:
+        srv.shutdown()
 
 
 def test_golden_inference_model_dir_loads_and_runs():

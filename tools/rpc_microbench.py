@@ -1,0 +1,109 @@
+"""Loopback PS-RPC data-plane microbench: pickle wire vs binary frames.
+
+Starts a VarServer with an echo handler on 127.0.0.1 and sweeps payload
+sizes through one VarClient per wire generation, printing MB/s for the
+round trip (send + echo receive). This isolates the framing cost the
+wide_deep_1b PS lane pays per tensor: the legacy wire pickles every
+ndarray into the message blob (two full copies plus pickle overhead per
+direction); the binary wire ships a small pickled header plus the raw
+buffer via sendall(memoryview)/recv_into (docs/PS_DATA_PLANE.md).
+
+Usage:
+    python tools/rpc_microbench.py                 # 4KB..64MB sweep
+    python tools/rpc_microbench.py --smoke         # tiny fast sweep (CI)
+
+The smoke invocation is also exercised by the tier-1 suite
+(tests/test_ps_data_plane.py, marker ``rpcbench``).
+"""
+import argparse
+import os
+import socket
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import numpy as np  # noqa: E402
+
+DEFAULT_SIZES = [1 << 12, 1 << 14, 1 << 16, 1 << 18, 1 << 20, 1 << 22,
+                 1 << 24, 1 << 26]
+SMOKE_SIZES = [1 << 12, 1 << 16, 1 << 20]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def run(sizes=None, repeats=5, warmup=1):
+    """Returns a list of rows: {"bytes", "pickle_mb_s", "binary_mb_s",
+    "speedup"} — each the round-trip goodput of an echo RPC carrying a
+    float32 payload of that size."""
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+
+    sizes = list(sizes or DEFAULT_SIZES)
+    srv = VarServer(f"127.0.0.1:{_free_port()}",
+                    {"echo": lambda value, trainer_id=0: value}).start()
+    ep = f"127.0.0.1:{srv.port}"
+    rows = []
+    try:
+        clients = {}
+        old_env = os.environ.get("PADDLE_TPU_PS_PICKLE_WIRE")
+        try:
+            os.environ["PADDLE_TPU_PS_PICKLE_WIRE"] = "1"
+            clients["pickle"] = VarClient(ep, channels=1)
+            os.environ.pop("PADDLE_TPU_PS_PICKLE_WIRE", None)
+            clients["binary"] = VarClient(ep, channels=1)
+        finally:
+            if old_env is None:
+                os.environ.pop("PADDLE_TPU_PS_PICKLE_WIRE", None)
+            else:
+                os.environ["PADDLE_TPU_PS_PICKLE_WIRE"] = old_env
+        for size in sizes:
+            payload = np.arange(size // 4, dtype=np.float32)
+            row = {"bytes": int(size)}
+            for wire, cli in clients.items():
+                for _ in range(warmup):
+                    cli.call("echo", value=payload)
+                t0 = time.perf_counter()
+                for _ in range(repeats):
+                    out = cli.call("echo", value=payload)
+                dt = time.perf_counter() - t0
+                assert np.asarray(out).nbytes == payload.nbytes
+                # bytes cross the loopback twice per echo (there + back)
+                row[f"{wire}_mb_s"] = round(
+                    2 * payload.nbytes * repeats / dt / 1e6, 1)
+            row["speedup"] = round(row["binary_mb_s"]
+                                   / max(row["pickle_mb_s"], 1e-9), 2)
+            rows.append(row)
+        for cli in clients.values():
+            cli.close()
+    finally:
+        srv.shutdown()
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny fast sweep (CI smoke)")
+    ap.add_argument("--repeats", type=int, default=None)
+    args = ap.parse_args(argv)
+    repeats = args.repeats or (2 if args.smoke else 5)
+    rows = run(sizes=SMOKE_SIZES if args.smoke else DEFAULT_SIZES,
+               repeats=repeats)
+    print(f"{'payload':>10} {'pickle MB/s':>12} {'binary MB/s':>12} "
+          f"{'speedup':>8}")
+    for r in rows:
+        print(f"{r['bytes']:>10} {r['pickle_mb_s']:>12} "
+              f"{r['binary_mb_s']:>12} {r['speedup']:>8}")
+    return rows
+
+
+if __name__ == "__main__":
+    main()
