@@ -35,7 +35,7 @@ __all__ = [
     "Variable", "Scope", "globals_", "get_flag", "set_flag",
     "dtype_to_np", "np_to_dtype", "dtype_to_jnp", "is_float_dtype",
     "is_compiled_with_tpu", "EOFException", "WorkerDeadError",
-    "RpcProtocolError", "CheckpointError",
+    "RpcProtocolError", "CheckpointError", "NumericFaultError",
 ]
 
 
@@ -66,6 +66,15 @@ class CheckpointError(RuntimeError):
     """A checkpoint directory failed validation (missing manifest,
     missing files, size/CRC mismatches) or load_vars found missing
     files. The message aggregates EVERY bad file, not just the first."""
+
+
+class NumericFaultError(FloatingPointError):
+    """The numeric fault plane (FLAGS_check_nan_inf +
+    FLAGS_nan_inf_action — docs/FAULT_TOLERANCE.md "Numeric faults")
+    could not contain a NaN/Inf: rollback retries exhausted, no intact
+    checkpoint to roll back to, or a tripped step the raise-mode
+    localizer could not reproduce. Subclasses FloatingPointError so
+    pre-existing FLAGS_check_nan_inf handlers keep catching it."""
 
 
 # --------------------------------------------------------------------------
@@ -671,6 +680,32 @@ def _switch_scope(scope: Scope) -> Scope:
 class _GlobalFlags:
     _DEFAULTS: Dict[str, Any] = {
         "FLAGS_check_nan_inf": False,
+        # what the numeric fault plane DOES when FLAGS_check_nan_inf
+        # finds a non-finite step (docs/FAULT_TOLERANCE.md "Numeric
+        # faults"):
+        #   raise    — localize the first bad op/var and raise
+        #              FloatingPointError (the reference
+        #              nan_inf_utils behavior)
+        #   skip     — fused discard: params/optimizer state select
+        #              back to their pre-step values ON DEVICE and
+        #              training continues (zero host syncs on the
+        #              happy path)
+        #   rollback — skip + count consecutive bad steps; after
+        #              FLAGS_nan_inf_tolerance of them restore the
+        #              last intact PR-3 checkpoint (bit-exact, rng
+        #              counters included), at most
+        #              FLAGS_nan_inf_max_rollbacks times before a
+        #              typed core.NumericFaultError
+        "FLAGS_nan_inf_action": "raise",
+        "FLAGS_nan_inf_tolerance": 3,
+        "FLAGS_nan_inf_max_rollbacks": 2,
+        # pserver-side guard (VarServer/listen_and_serv): what to do
+        # with a non-finite sparse grad row or dense update —
+        # "" (off, apply as-is) | "drop" (discard the bad rows/update,
+        # count it) | "reject" (raise NumericFaultError back to the
+        # sending trainer). Trip counters ride the built-in "stats"
+        # RPC under the "health" key.
+        "FLAGS_ps_reject_nonfinite": "",
         "FLAGS_cpu_deterministic": False,
         "FLAGS_benchmark": False,
         "FLAGS_eager_delete_tensor_gb": 0.0,

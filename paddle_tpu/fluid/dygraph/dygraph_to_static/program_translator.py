@@ -136,17 +136,24 @@ class ConcreteProgram:
         for n, p in self.param_vars.items():
             scope.var(n).set_value(LoDTensor(p._array))
         self._scope = scope
+        # guard=False: the numeric fault plane's policies live in
+        # Executor.run — this tape op has no post-step host hook, so a
+        # baked-in guard would silently REVERT a NaN step with nobody
+        # reading the verdict. Dygraph keeps the pre-guard behavior
+        # (the NaN propagates visibly into params/loss); the eager
+        # kernels remain covered by the interpreter-path check.
         self._cb = _CompiledBlock(
             self.main_program, tuple(self.feed_names),
             tuple(self.fetch_names), scope,
-            self.main_program.random_seed or core.globals_["FLAGS_seed"])
+            self.main_program.random_seed or core.globals_["FLAGS_seed"],
+            guard=False)
         self.mut_names = list(self._cb.mut_state)
         self.ro_names = list(self._cb.ro_state)
         self.state_names = self.mut_names + self.ro_names
         cb = self._cb
 
         def _flat(xs, mut_ps, ro_ps, rng):
-            fetches, new_mut, _extra = cb._step(
+            fetches, new_mut, _extra, _health = cb._step(
                 dict(zip(self.mut_names, mut_ps)),
                 dict(zip(self.ro_names, ro_ps)),
                 dict(zip(self.feed_names, xs)), rng)

@@ -166,6 +166,20 @@ def _as_lodtensor(data, place) -> LoDTensor:
     return t
 
 
+def _initialized_tensor(scope, name) -> Optional[LoDTensor]:
+    """The scope var's holder when it exists and is an initialized dense
+    LoDTensor; None otherwise. THE numeric-fault-plane state predicate:
+    the compiled guard classification (_CompiledBlock._init_guard) and
+    the interpreter oracle (_interp_guard_cfg/_run_interpreted_step)
+    must agree on it or their health/select variable sets drift and the
+    bit-parity contract breaks."""
+    v = scope.find_var(name)
+    if v is not None and v.is_initialized() and isinstance(v.value(),
+                                                           LoDTensor):
+        return v.value()
+    return None
+
+
 def _window_feed_names(program, feed, n_steps) -> Tuple[str, ...]:
     """Feeds carrying a leading window dimension: value rank is the
     program var's rank + 1 and the leading dim equals ``n_steps`` —
@@ -363,6 +377,56 @@ def _classify_block_state(ops, block, feed_names, scope):
     return state_names, written
 
 
+_GUARD_ACTIONS = frozenset({"raise", "skip", "rollback"})
+
+
+def _block_reads_amp_scale(ops, amp) -> bool:
+    """True when the (feed/fetch-free) op list actually consumes the AMP
+    loss-scaling var — i.e. the scaled-loss/unscale machinery survived
+    into this program. A clone/prune that sliced it away (forward-only
+    eval programs) must not run the scale epilogue: eval steps would
+    silently inflate the shared training scale and counters."""
+    name = amp["scale"]
+    return any(name in op.input_arg_names for op in ops)
+
+
+def _amp_scale_update(healthy, scale, good, bad, cfg):
+    """Dynamic loss-scaling state transition (reference:
+    operators/amp/update_loss_scaling_op.h Update<T>), fused into the
+    step from the SAME health scalar the numeric fault guard computes —
+    the scaler never re-reduces the grads:
+
+      healthy: good+=1; bad=0; good==incr_every_n_steps -> scale*=incr
+      tripped: bad+=1;  good=0; bad==decr_every_n_nan_or_inf -> scale*=decr
+               (floored at 1.0 — the reference clamps the decayed scale
+               so persistent overflow can't drive it to fp32 zero,
+               where 0*incr == 0 sticks forever and the zeroed scaled
+               loss would read as "healthy")
+
+    All arrays are shape [1] (scale float, counters int32); ``healthy``
+    is the scalar bool. Pure jnp, so the compiled path fuses it and the
+    interpreter oracle runs the IDENTICAL arithmetic (bit-parity)."""
+    good_i = good + 1
+    bad_i = bad + 1
+    incr_hit = good_i >= jnp.asarray(int(cfg["incr_every_n_steps"]),
+                                     good.dtype)
+    decr_hit = bad_i >= jnp.asarray(int(cfg["decr_every_n_nan_or_inf"]),
+                                    bad.dtype)
+    scale_good = jnp.where(incr_hit,
+                           scale * jnp.asarray(cfg["incr_ratio"],
+                                               scale.dtype), scale)
+    scale_bad = jnp.where(decr_hit,
+                          jnp.maximum(
+                              scale * jnp.asarray(cfg["decr_ratio"],
+                                                  scale.dtype),
+                              jnp.asarray(1.0, scale.dtype)), scale)
+    zero = jnp.zeros_like(good)
+    new_scale = jnp.where(healthy, scale_good, scale_bad)
+    new_good = jnp.where(healthy, jnp.where(incr_hit, zero, good_i), zero)
+    new_bad = jnp.where(healthy, zero, jnp.where(decr_hit, zero, bad_i))
+    return new_scale, new_good, new_bad
+
+
 class _CompiledBlock:
     """One traced+jitted step function for (program, feeds, fetches)."""
 
@@ -370,7 +434,8 @@ class _CompiledBlock:
 
     def __init__(self, program: Program, feed_names: Tuple[str, ...],
                  fetch_names: Tuple[str, ...], scope: Scope, seed: int,
-                 mesh=None, param_shardings=None, feed_lods=None):
+                 mesh=None, param_shardings=None, feed_lods=None,
+                 guard: bool = True):
         import weakref
         self._scope_ref = weakref.ref(scope)
         # trace-time-static LoD of feeds + initialized state vars
@@ -408,6 +473,7 @@ class _CompiledBlock:
             if n in persistable and n not in self.mut_state
             and n not in feed_names)
         self.seed = seed
+        self._init_guard(program, scope, enabled=guard)
         # PipelineOptimizer-sectioned program + a mesh with a "pp" axis:
         # lower the homogeneous interior onto the compiled gpipe schedule
         # (fused fallback with a warning otherwise)
@@ -433,8 +499,194 @@ class _CompiledBlock:
         # within a key retrace inside jax.jit as usual
         self._multi_jit: Dict[Tuple[int, Tuple[str, ...]], Any] = {}
 
+    # ---------------------------------------------- numeric fault guard
+    def _init_guard(self, program: Program, scope: Scope,
+                    enabled: bool = True):
+        """Capture the numeric-fault-plane config at build time (the
+        guard is BAKED into the trace; the Executor's program cache is
+        keyed by the flags, so flipping them rebuilds rather than
+        retraces per step — docs/FAULT_TOLERANCE.md "Numeric faults").
+
+          _guard_check  FLAGS_check_nan_inf at build
+          _guard_action raise | skip | rollback
+          _amp          program._amp_dynamic (AMP dynamic loss scaling
+                        state names + hyperparams) or None
+          _guard_select True when the step must keep its pre-step state
+                        reachable for the fused bad-step discard (skip/
+                        rollback, and always under AMP — an overflowed
+                        step is dropped, its scale update applied)
+
+        Under select, initialized extra-writeback persistables are
+        promoted into mut_state so the discard covers EVERY persistable
+        the step writes, and the AMP state vars join mut_state so the
+        epilogue's scale/counter updates thread through the step (and
+        ride the lax.scan carry on the windowed path)."""
+        if not enabled:
+            # build-time opt-out (the dygraph tape op: no post-step
+            # host hook exists there, so a baked-in guard would revert
+            # NaN steps with nobody reading the verdict) — skipped
+            # BEFORE any classification side effect (mut-state
+            # promotion, AMP var splicing, scale-var init checks)
+            self._guard_check = False
+            self._guard_action = "raise"
+            self._amp = None
+            self._guard_select = False
+            self._guard_active = False
+            self._select_names = ()
+            self._health_names: Tuple[str, ...] = ()
+            return
+        self._guard_check = bool(core.globals_["FLAGS_check_nan_inf"])
+        self._guard_action = str(core.globals_["FLAGS_nan_inf_action"])
+        if self._guard_check and self._guard_action not in _GUARD_ACTIONS:
+            # a typo'd action must not silently disable every policy
+            # while the check flag still claims protection is on
+            raise ValueError(
+                f"FLAGS_nan_inf_action={self._guard_action!r} is not one "
+                f"of {sorted(_GUARD_ACTIONS)}")
+        self._amp = getattr(program, "_amp_dynamic", None)
+        if self._amp is not None and not _block_reads_amp_scale(
+                self.ops, self._amp):
+            # a clone/prune sliced the scaled-loss machinery away (e.g.
+            # an eval program pruned to a forward fetch) — the epilogue
+            # must NOT keep mutating the shared scale/counters there
+            self._amp = None
+        # raise keeps the select too: the localizer re-runs the tripped
+        # step through the interpreter and needs exactly the pre-step
+        # state to reproduce it
+        self._guard_select = (self._amp is not None
+                              or (self._guard_check and self._guard_action
+                                  in ("raise", "skip", "rollback")))
+        self._guard_active = self._guard_check or self._amp is not None
+        if not self._guard_active:
+            self._select_names: Tuple[str, ...] = ()
+            return
+
+        def _scope_tensor_ok(n):
+            return _initialized_tensor(scope, n) is not None
+
+        if self._guard_select:
+            promoted = tuple(n for n in self.extra_writeback
+                             if _scope_tensor_ok(n))
+            if promoted:
+                self.mut_state = self.mut_state + promoted
+                self.extra_writeback = tuple(
+                    n for n in self.extra_writeback if n not in promoted)
+        if self._amp is not None:
+            for n in (self._amp["scale"], self._amp["good"],
+                      self._amp["bad"]):
+                if n in self.ro_state:
+                    self.ro_state = tuple(x for x in self.ro_state
+                                          if x != n)
+                if n not in self.mut_state:
+                    if not _scope_tensor_ok(n):
+                        raise RuntimeError(
+                            f"AMP dynamic loss scaling var '{n}' is not "
+                            f"initialized in the scope — run the startup "
+                            f"program first")
+                    self.mut_state = self.mut_state + (n,)
+        # the bad-step discard covers exactly the state the step
+        # overwrites; the AMP vars are epilogue-managed (never reverted
+        # — a dropped step still updates the scale)
+        amp_names = (set() if self._amp is None else
+                     {self._amp["scale"], self._amp["good"],
+                      self._amp["bad"]})
+        self._select_names = tuple(
+            n for n in self.mut_state
+            if n in self.written and n not in amp_names)
+        # health reduces over the PARAM GRADIENTS (+ float fetches), not
+        # the updated params: finite grads into a finite optimizer step
+        # keep params finite, and the health scalar is then available
+        # BEFORE the update ops at the XLA level — no reduction barrier
+        # on the new state (reducing the updated params measured 37%
+        # lane overhead; the grad-sourced reduce itself measures ~0%,
+        # every remaining cost is the discard select — BENCH_LOCAL
+        # mnist_realdata_guard note). Param grads subsume activation
+        # grads (chain rule drags any upstream NaN into them), and
+        # skipping the batch-sized activation-grad reductions measured
+        # ~9% of the lane back. Blocks with no param grads fall back to
+        # all grads, then to the written state itself (inference/eval).
+        grads = {n for n in self.written if n.endswith(GRAD_SUFFIX)}
+        self._health_names = tuple(
+            n + GRAD_SUFFIX for n in self._select_names
+            if n + GRAD_SUFFIX in grads) or tuple(sorted(grads))
+
+    def _warn_unselectable(self, name, old, new):
+        """A state var whose SHAPE changed during the step cannot be
+        selected back — on a tripped step it keeps its (possibly
+        non-finite) post-step value while everything else reverts. That
+        hole in the discard must be loud, once per var: a NaN parked
+        there re-trips every following step and burns the rollback
+        budget on what looked like a transient fault."""
+        import warnings as _warnings
+        warned = getattr(self, "_warned_unselectable", None)
+        if warned is None:
+            warned = self._warned_unselectable = set()
+        if name in warned:
+            return
+        warned.add(name)
+        _warnings.warn(
+            f"numeric fault guard: state var '{name}' changes shape "
+            f"during the step ({getattr(old, 'shape', None)} -> "
+            f"{getattr(new, 'shape', None)}) and CANNOT be covered by "
+            f"the bad-step discard — on a tripped step it keeps its "
+            f"post-step value", stacklevel=3)
+
+    def _guard_epilogue(self, orig_mut, new_mut, fetches, env):
+        """Fused guard tail of one traced step: the single health
+        scalar (over grads + float fetches — see _init_guard), the
+        bad-step discard (select back to the pre-step state), and the
+        AMP scale transition — all device-side, zero host round-trips.
+        Returns (new_mut, health)."""
+        from .ir import fused_health
+        vals = [env[n] for n in self._health_names if n in env]
+        if not vals:  # no grads in this block: reduce the state writes
+            vals = [new_mut[n] for n in self._select_names
+                    if n in new_mut]
+        vals = vals + list(fetches)
+        health = fused_health(vals)
+        return self._apply_discard(new_mut, orig_mut, health), health
+
+    def _apply_discard(self, store, orig, health):
+        """The fused bad-step discard (select back to the pre-step
+        state, shape-mismatch vars warned once) + the AMP scale
+        transition, over one name→array mapping — ``new_mut`` for the
+        fused epilogue, ``env`` for the segmented step. ONE
+        implementation, so the paths whose bit-parity the design
+        depends on cannot drift apart."""
+        if self._guard_select:
+            for n in self._select_names:
+                new, old = store.get(n), orig.get(n)
+                if new is None or old is None or new is old:
+                    continue
+                if getattr(new, "shape", None) == getattr(old, "shape",
+                                                          None):
+                    store[n] = jnp.where(health, new, old)
+                else:
+                    self._warn_unselectable(n, old, new)
+        if self._amp is not None:
+            a = self._amp
+            olds = (store[a["scale"]], store[a["good"]], store[a["bad"]])
+            news = _amp_scale_update(health, *olds, a)
+            if self._guard_check and self._guard_action == "raise":
+                # raise mode replays the tripped step through the
+                # interpreter localizer from its exact pre-step state —
+                # INCLUDING the loss scale: letting the decay land first
+                # would shrink loss*scale on the replay, the overflow
+                # would not reproduce, and the localizer would mis-report
+                # "the fault did not replay". The scale vars are
+                # epilogue-managed (step ops only read them), so the
+                # pre-transition values ARE the pre-step values.
+                news = tuple(jnp.where(health, nv, ov)
+                             for nv, ov in zip(news, olds))
+            store[a["scale"]], store[a["good"]], store[a["bad"]] = news
+        return store
+
     def _step(self, mut_state: Dict[str, Any], ro_state: Dict[str, Any],
               feeds: Dict[str, Any], rng):
+        # the pre-step state refs stay reachable for the guard's fused
+        # bad-step discard (jax arrays are immutable; XLA resolves the
+        # donation aliasing)
+        orig_mut = dict(mut_state) if self._guard_select else None
         env: Dict[str, Any] = {}
         env.update(ro_state)
         env.update(mut_state)
@@ -456,7 +708,11 @@ class _CompiledBlock:
             self.fetch_lods[i] = lod_env.get(n)
         new_mut = {n: env[n] for n in self.mut_state}
         extra = {n: env[n] for n in self.extra_writeback if n in env}
-        return fetches, new_mut, extra
+        health = jnp.bool_(True)
+        if self._guard_active:
+            new_mut, health = self._guard_epilogue(orig_mut, new_mut,
+                                                   fetches, env)
+        return fetches, new_mut, extra, health
 
     # -------------------------------------------------- control-flow lowering
     # The reference interprets while/conditional_block by re-entering the
@@ -676,19 +932,24 @@ class _CompiledBlock:
         return self._jitted.lower(mut, ro, feeds, rng)
 
     def run(self, scope: Scope, feeds: Dict[str, Any], rng):
-        """One training/inference step: ONE dispatch of the jitted step."""
+        """One training/inference step: ONE dispatch of the jitted step.
+        Returns (fetches, health) — health is the step's fused finite
+        scalar (constant True when the guard is off), LAZY on device so
+        the happy path costs no host sync."""
         mut, ro, feeds, rng = self._place_inputs(scope, feeds, rng)
         from . import profiler as _profiler
         if _profiler.is_profiling():
             # the whole program is ONE dispatch on TPU — a single span
             # (per-op timing lives in the device XPlane trace)
             with _profiler.RecordEvent("compiled_step"):
-                fetches, new_mut, extra = self._jitted(mut, ro, feeds, rng)
+                fetches, new_mut, extra, health = self._jitted(
+                    mut, ro, feeds, rng)
                 jax.block_until_ready(fetches)
         else:
-            fetches, new_mut, extra = self._jitted(mut, ro, feeds, rng)
+            fetches, new_mut, extra, health = self._jitted(mut, ro, feeds,
+                                                           rng)
         self._write_back(scope, new_mut, extra)
-        return fetches
+        return fetches, health
 
     def run_window(self, scope: Scope, feeds: Dict[str, Any], rng_base,
                    idx0: int, n_steps: int, window_names=()):
@@ -698,7 +959,11 @@ class _CompiledBlock:
         broadcasts to all steps (the degenerate same-feeds mode — the
         pre-window benchmark shape). Host and wire costs (TPU-tunnel RTT
         ≈ 10 ms/dispatch) amortize to one dispatch per window. Fetches
-        come back stacked [n_steps, ...]."""
+        come back stacked [n_steps, ...], and so does the per-step
+        health flag ([n_steps] bool; the guard rides the scan carry —
+        a bad step's discard selects against THAT step's carry-in, so
+        step i+1 of a faulted window continues from step i's pre-fault
+        state)."""
         mut, ro, feeds, rng_base = self._place_inputs(scope, feeds,
                                                       rng_base)
         from . import profiler as _profiler
@@ -706,14 +971,14 @@ class _CompiledBlock:
             tag = "realdata" if window_names else "broadcast"
             with _profiler.RecordEvent(f"window[{n_steps}]:{tag}",
                                        cat="window"):
-                fetches, new_mut, extra = self._run_multi(
+                fetches, new_mut, extra, health = self._run_multi(
                     mut, ro, feeds, rng_base, idx0, n_steps, window_names)
                 jax.block_until_ready(fetches)
         else:
-            fetches, new_mut, extra = self._run_multi(
+            fetches, new_mut, extra, health = self._run_multi(
                 mut, ro, feeds, rng_base, idx0, n_steps, window_names)
         self._write_back(scope, new_mut, extra)
-        return fetches
+        return fetches, health
 
     def _write_back(self, scope, new_mut, extra):
         for n, v in {**new_mut, **extra}.items():
@@ -744,31 +1009,33 @@ class _CompiledBlock:
                         i, sl = x
                         f = dict(bcast)
                         f.update(sl)
-                        fetches, new_mut, _ = self._step(
+                        fetches, new_mut, _, health = self._step(
                             mut_c, ro, f, jax.random.fold_in(rng_b, i))
-                        return new_mut, fetches
-                    new_mut, ys = lax.scan(
+                        return new_mut, (fetches, health)
+                    new_mut, (ys, healths) = lax.scan(
                         body, mut, (i0 + jnp.arange(n_steps), xs))
-                    return ys, new_mut
+                    return ys, new_mut, healths
                 jitted = jax.jit(many, donate_argnums=(0,))
                 self._multi_jit[key] = jitted
-            ys, new_mut = jitted(mut, ro, bcast, xs, rng_base,
-                                 jnp.int32(idx0))
+            ys, new_mut, healths = jitted(mut, ro, bcast, xs, rng_base,
+                                          jnp.int32(idx0))
             self._check_no_lod_fetch()  # lods appear during the trace
-            return ys, new_mut, {}
+            return ys, new_mut, {}, healths
         per_step = []
+        step_health = []
         extra = {}
         for i in range(n_steps):
             f = dict(bcast)
             for n, a in xs.items():
                 f[n] = a[i]
-            fetches, mut, extra = self._jitted(
+            fetches, mut, extra, health = self._jitted(
                 mut, ro, f, jax.random.fold_in(rng_base, idx0 + i))
             per_step.append(fetches)
+            step_health.append(health)
         self._check_no_lod_fetch()
         stacked = [jnp.stack([s[k] for s in per_step])
                    for k in range(len(self.fetch_names))]
-        return stacked, mut, extra
+        return stacked, mut, extra, jnp.stack(step_health)
 
     def _check_no_lod_fetch(self):
         if any(l is not None for l in self.fetch_lods):
@@ -899,6 +1166,7 @@ class _SegmentedBlock(_CompiledBlock):
             n for n in written
             if n in persistable and n not in self.mut_state
             and n not in feed_names)
+        self._init_guard(program, scope)
 
         # ---- per-segment dataflow: external reads / writes -------------
         seg_reads: List[List[str]] = []
@@ -955,13 +1223,26 @@ class _SegmentedBlock(_CompiledBlock):
                 if n in donatable and n in seg_writes[i]))
             seg.in_names = tuple(sorted(
                 set(seg_reads[i]) - set(seg.donated_names)))
+            if self._guard_select:
+                # the fused bad-step discard needs the step's pre-state
+                # refs alive until the select at the end of run_step —
+                # per-segment donation would delete them mid-step
+                seg.in_names = tuple(sorted(
+                    set(seg.in_names) | set(seg.donated_names)))
+                seg.donated_names = ()
+            seg.guard_names = ()
             seg._cache = {}  # lod-key -> [jitted step, captured out lods]
 
     # -------------------------------------------------------------- step
     def _seg_dispatch(self, seg, env, lod_env, rng, profiling):
         """Run one compiled segment: jit-cache keyed by the LoD of its
         inputs (trace-time-static, same contract as the fused path's
-        feed-LoD-keyed program cache)."""
+        feed-LoD-keyed program cache). When the numeric fault guard is
+        on, a per-segment finite check over the segment's float outputs
+        is FUSED into the jitted step and returned as one extra bool —
+        run_step ANDs the flags into the step health with no host sync.
+        Returns (outs, health_flag_or_None)."""
+        from .ir import fused_health, guarded_float_names
         in_all = seg.in_names + seg.donated_names
         lkey = tuple((n, lod_env[n]) for n in in_all if n in lod_env)
         entry = seg._cache.get(lkey)
@@ -970,6 +1251,7 @@ class _SegmentedBlock(_CompiledBlock):
             static_lods = dict(lkey)
             captured: Dict[str, Any] = {}
             seg_ops, start, out_names = seg.ops, seg.start, seg.out_names
+            guard = self._guard_active
 
             def step(donated, held, rng_):
                 e = dict(held)
@@ -978,7 +1260,12 @@ class _SegmentedBlock(_CompiledBlock):
                 self._exec_ops(seg_ops, e, le, rng_, idx0=start)
                 captured.clear()
                 captured.update({n: le[n] for n in out_names if n in le})
-                return {n: e[n] for n in out_names if n in e}
+                res = {n: e[n] for n in out_names if n in e}
+                if not guard:
+                    return res, jnp.bool_(True)
+                seg.guard_names = tuple(guarded_float_names(out_names, e))
+                return res, fused_health(
+                    [e[n] for n in seg.guard_names])
 
             entry = seg._cache[lkey] = [
                 jax.jit(step, donate_argnums=(0,)), captured]
@@ -991,15 +1278,15 @@ class _SegmentedBlock(_CompiledBlock):
             with _profiler.RecordEvent(
                     f"segment[{seg.start}:{seg.stop}]:{tag}",
                     cat="segment"):
-                outs = jitted(donated, held, rng)
+                outs, seg_health = jitted(donated, held, rng)
                 jax.block_until_ready(outs)
         else:
-            outs = jitted(donated, held, rng)
+            outs, seg_health = jitted(donated, held, rng)
         env.update(outs)
         for n, lv in captured.items():
             if lv:
                 lod_env[n] = lv
-        return outs
+        return outs, (seg_health if self._guard_active else None)
 
     def _island_dispatch(self, seg, env, lod_env, rng, scope, executor,
                          profiling):
@@ -1037,15 +1324,26 @@ class _SegmentedBlock(_CompiledBlock):
 
     def run_step(self, scope: Scope, feeds: Dict[str, Any], rng, executor):
         """One training/inference step through the segment plan. Returns
-        (fetch arrays, fetch lods)."""
+        (fetch arrays, fetch lods, health). Health is the AND of every
+        compiled segment's fused finite flag, the islands' written float
+        env values, and the float fetches — all device-side, so the
+        happy path stays sync-free. Under a select action (skip/
+        rollback/AMP) a tripped step's state writes select back to
+        their pre-step values; island-INTERNAL side effects (an auc
+        histogram, a print) cannot be unwound and are documented as
+        out of the discard's reach."""
         from . import profiler as _profiler
+        from .ir import fused_health
         profiling = _profiler.is_profiling()
         env: Dict[str, Any] = {}
         for n in self.ro_state + self.mut_state:
             env[n] = scope.find_var(n).get_tensor().array
         env.update(feeds)
+        orig = ({n: env[n] for n in self._select_names if n in env}
+                if self._guard_select else None)
         lod_env: Dict[str, tuple] = dict(self._init_lods)
         n_comp = sum(1 for s in self.segments if s.kind == "compiled")
+        seg_flags: List[Tuple[str, Any]] = []  # (segment label, bool flag)
         try:
             with _profiler.RecordEvent(
                     f"segmented_step[{n_comp}c/"
@@ -1053,15 +1351,34 @@ class _SegmentedBlock(_CompiledBlock):
                     if profiling else contextlib.nullcontext():
                 for seg in self.segments:
                     if seg.kind == "compiled":
-                        self._seg_dispatch(seg, env, lod_env, rng,
-                                           profiling)
+                        _outs, flag = self._seg_dispatch(
+                            seg, env, lod_env, rng, profiling)
+                        if flag is not None:
+                            seg_flags.append(
+                                (f"segment[{seg.start}:{seg.stop}]", flag))
                     else:
                         self._island_dispatch(seg, env, lod_env, rng,
                                               scope, executor, profiling)
+                        if self._guard_active:
+                            written = {n for _op, _r, w in seg.op_io
+                                       for n in w}
+                            vals = [env[n] for n in sorted(written)
+                                    if n in env]
+                            seg_flags.append(
+                                (f"island[{seg.start}:{seg.stop}]",
+                                 fused_health(vals)))
         except Exception:
+            if orig is not None:
+                # guard-select runs promise the PRE-step state on any
+                # trip — an island's raise-mode localizer fires mid-step
+                # (before the end-of-step select), so earlier segments'
+                # partial writes must not be committed (donation is
+                # disabled under select, the refs are intact)
+                env.update(orig)
             # a failure AFTER a donating segment ran would leave the scope
             # pointing at deleted buffers; restore the freshest state
-            # (interpreter-like partial-step semantics) before surfacing
+            # (interpreter-like partial-step semantics for unguarded
+            # runs) before surfacing
             self._write_back_state(scope, env, lod_env)
             raise
         fetched, fetch_lods = [], []
@@ -1081,8 +1398,15 @@ class _SegmentedBlock(_CompiledBlock):
                 fetched.append(val)
                 fetch_lods.append(None)
         self.fetch_lods = fetch_lods
+        health = jnp.bool_(True)
+        if self._guard_active:
+            health = fused_health(list(fetched))
+            for _label, flag in seg_flags:
+                health = jnp.logical_and(health, flag)
+            self._last_seg_flags = seg_flags  # trip localization (lazy)
+            self._apply_discard(env, orig, health)
         self._write_back_state(scope, env, lod_env)
-        return fetched, fetch_lods
+        return fetched, fetch_lods, health
 
     def _write_back_state(self, scope, env, lod_env):
         for n in self.mut_state + self.extra_writeback:
@@ -1092,6 +1416,102 @@ class _SegmentedBlock(_CompiledBlock):
             if isinstance(v, jax.Array) and v.is_deleted():
                 continue  # donated by a segment that then failed mid-run
             scope.var(n).set_value(LoDTensor(v, lod_env.get(n)))
+
+
+class HealthMonitor:
+    """Rollback policy engine of the numeric fault plane
+    (FLAGS_nan_inf_action=rollback — docs/FAULT_TOLERANCE.md "Numeric
+    faults"). Consumes the per-step fused health flag the compiled/
+    windowed/segmented paths already produce; after
+    ``tolerance`` CONSECUTIVE tripped steps it restores the last intact
+    PR-3 checkpoint under ``ckpt_dir`` (parameters, optimizer slots,
+    rng fold counter, optional DataLoader position — bit-exact, so the
+    re-run of the faulted window matches an oracle that never saw the
+    fault). At most ``max_rollbacks`` restores; the next trip past that
+    (or a trip with no intact checkpoint to restore) raises
+    ``core.NumericFaultError``. Until tolerance is reached, tripped
+    steps are discarded by the fused skip-select, so state never holds
+    a NaN between observations."""
+
+    def __init__(self, executor, ckpt_dir, program=None, scope=None,
+                 tolerance: Optional[int] = None,
+                 max_rollbacks: Optional[int] = None, dataloader=None,
+                 on_rollback=None):
+        self.executor = executor
+        self.ckpt_dir = ckpt_dir
+        self.program = program
+        self.scope = scope
+        self.dataloader = dataloader
+        self.on_rollback = on_rollback
+        self.tolerance = max(1, int(
+            core.globals_["FLAGS_nan_inf_tolerance"]
+            if tolerance is None else tolerance))
+        self.max_rollbacks = int(
+            core.globals_["FLAGS_nan_inf_max_rollbacks"]
+            if max_rollbacks is None else max_rollbacks)
+        self.trips = 0
+        self.consecutive_bad = 0
+        self.rollbacks = 0
+        self.last_trip_step: Optional[int] = None
+        self.last_rollback_step: Optional[int] = None
+        self.last_manifest: Optional[Dict[str, Any]] = None
+
+    def observe(self, healthy: bool, step: int) -> str:
+        """Feed one step's health verdict. Returns "ok" | "tripped" |
+        "rolled_back"; raises core.NumericFaultError when the retry
+        budget is spent."""
+        if healthy:
+            self.consecutive_bad = 0
+            return "ok"
+        from . import profiler as _profiler
+        self.trips += 1
+        self.consecutive_bad += 1
+        self.last_trip_step = int(step)
+        _profiler.record_instant(
+            f"health:trip[step {step}]", cat="health",
+            args={"step": int(step), "action": "rollback",
+                  "consecutive_bad": self.consecutive_bad})
+        if self.consecutive_bad < self.tolerance:
+            return "tripped"
+        return self._rollback(step)
+
+    def _rollback(self, step: int) -> str:
+        from . import io as _io
+        from . import profiler as _profiler
+        if self.rollbacks >= self.max_rollbacks:
+            raise core.NumericFaultError(
+                f"numeric fault at step {step}: "
+                f"{self.consecutive_bad} consecutive non-finite steps "
+                f"and the rollback budget "
+                f"(FLAGS_nan_inf_max_rollbacks={self.max_rollbacks}) is "
+                f"spent — the fault is persistent, not transient")
+        scope = self.scope if self.scope is not None else global_scope()
+        manifest = _io.rollback_to_latest(self.executor, self.ckpt_dir,
+                                          main_program=self.program,
+                                          scope=scope)
+        if manifest is None:
+            raise core.NumericFaultError(
+                f"numeric fault at step {step}: "
+                f"FLAGS_nan_inf_action=rollback but no intact checkpoint "
+                f"under {self.ckpt_dir!r} to roll back to")
+        if self.dataloader is not None and manifest.get("dataloader"):
+            self.dataloader.load_state_dict(manifest["dataloader"])
+        self.rollbacks += 1
+        self.consecutive_bad = 0
+        self.last_rollback_step = int(step)
+        self.last_manifest = manifest
+        cfg = self.executor._auto_ckpt
+        if cfg is not None:
+            cfg["last_step"] = int(manifest["global_step"])
+        _profiler.record_instant(
+            f"health:rollback[step {step}->"
+            f"{manifest['global_step']}]", cat="health",
+            args={"step": int(step), "action": "rollback",
+                  "restored_step": int(manifest["global_step"]),
+                  "rollbacks": self.rollbacks})
+        if self.on_rollback is not None:
+            self.on_rollback(manifest)
+        return "rolled_back"
 
 
 class Executor:
@@ -1110,6 +1530,19 @@ class Executor:
         # periodic atomic checkpointing (set_auto_checkpoint /
         # resume_from — docs/FAULT_TOLERANCE.md)
         self._auto_ckpt: Optional[Dict[str, Any]] = None
+        # numeric fault plane (FLAGS_check_nan_inf +
+        # FLAGS_nan_inf_action): the last step's LAZY device health
+        # flag(s), host-side trip counters (only advanced on paths that
+        # sync — raise/rollback/profiling), and the rollback monitor
+        self._last_health = None
+        self._health_stats = {"steps_checked": 0, "trips": 0}
+        self._health_monitor: Optional[HealthMonitor] = None
+        # True while the just-finished step tripped the guard (only
+        # meaningful on synced paths): gates the auto-checkpoint so a
+        # snapshot is never taken from inside a fault window — its rng
+        # counter would record the DISCARDED step and break the
+        # rollback replay's bit-exactness
+        self._last_step_tripped = False
 
     def _build_segmented(self, program, feed, fetch_names, scope, seed,
                          feed_lods) -> Optional[_SegmentedBlock]:
@@ -1220,6 +1653,8 @@ class Executor:
         cfg = self._auto_ckpt
         if cfg is None:
             return
+        if self._last_step_tripped:
+            return  # never checkpoint out of a fault window
         if cfg["program"] is not None and program is not cfg["program"]:
             return
         if cfg["scope"] is not None and scope is not cfg["scope"]:
@@ -1241,6 +1676,289 @@ class Executor:
                             dataloader_state=dl_state,
                             max_to_keep=cfg["max_to_keep"])
         cfg["last_step"] = step
+
+    # ------------------------------------------------ numeric fault plane
+    def set_health_monitor(self, ckpt_dir, program=None, scope=None,
+                           tolerance=None, max_rollbacks=None,
+                           dataloader=None, on_rollback=None
+                           ) -> HealthMonitor:
+        """Explicitly configure the FLAGS_nan_inf_action=rollback
+        monitor (docs/FAULT_TOLERANCE.md "Numeric faults"). Without
+        this, the monitor is derived lazily from the auto-checkpoint
+        config (set_auto_checkpoint / train_from_dataset
+        checkpoint_dir=) on the first tripped step."""
+        self._health_monitor = HealthMonitor(
+            self, ckpt_dir, program=program, scope=scope,
+            tolerance=tolerance, max_rollbacks=max_rollbacks,
+            dataloader=dataloader, on_rollback=on_rollback)
+        return self._health_monitor
+
+    def _ensure_health_monitor(self, program, scope) -> HealthMonitor:
+        if self._health_monitor is not None:
+            return self._health_monitor
+        cfg = self._auto_ckpt
+        if cfg is None or not cfg.get("dir"):
+            raise core.NumericFaultError(
+                "FLAGS_nan_inf_action=rollback tripped but no checkpoint "
+                "plane is configured — call set_auto_checkpoint() (or "
+                "pass checkpoint_dir= to train_from_dataset), or wire "
+                "set_health_monitor() explicitly")
+        self._health_monitor = HealthMonitor(
+            self, cfg["dir"], program=cfg["program"] or program,
+            scope=cfg["scope"] or scope, dataloader=cfg.get("dataloader"))
+        return self._health_monitor
+
+    @staticmethod
+    def _offending_segment(cb) -> Optional[str]:
+        """Label of the first segment whose fused flag tripped (only
+        meaningful for segmented blocks; one host sync per flag — called
+        exclusively on the already-tripped slow path)."""
+        for label, flag in getattr(cb, "_last_seg_flags", ()) or ():
+            if not bool(np.asarray(flag)):
+                return label
+        return None
+
+    def _localize_and_raise(self, cb, program, scope, rng, step: int):
+        """raise-mode tail: the fused health scalar tripped, the select
+        kept the pre-step state — re-run the SAME step (same feeds in
+        scope, same rng key) through the interpreter, whose per-op
+        localizer names the first bad op/var/indices. Segmented blocks:
+        island side effects (auc/print) run a second time on this crash
+        path — documented in docs/FAULT_TOLERANCE.md."""
+        from . import profiler as _profiler
+        seg = self._offending_segment(cb)
+        _profiler.record_instant(
+            f"health:trip[step {step}]", cat="health",
+            args={"step": int(step), "action": "raise",
+                  "segment": seg or "-"})
+        try:
+            self._run_block_eager(program.global_block(), scope, rng)
+        except FloatingPointError as e:
+            raise FloatingPointError(
+                f"numeric fault at global step {step}"
+                + (f" (first tripped {seg})" if seg else "")
+                + f": {e}") from e
+        raise core.NumericFaultError(
+            f"health guard tripped at global step {step}"
+            + (f" in {seg}" if seg else "")
+            + " but the interpreter re-run reproduced no non-finite op "
+            "output — the fault did not replay (e.g. a poisoned feed "
+            "replaced since, or island-stateful nondeterminism)")
+
+    def _process_health(self, cb, program, scope, health, step0: int,
+                        n_steps: int, rng=None):
+        """Post-step policy dispatch over the fused health flag(s).
+        skip (and AMP-only) stays LAZY — no host sync unless the
+        profiler wants trip markers; raise and rollback read the flags
+        back (that sync is those actions' documented cost)."""
+        if not cb._guard_active:
+            return
+        self._last_health = health
+        from . import profiler as _profiler
+        profiling = _profiler.is_profiling()
+        action = cb._guard_action if cb._guard_check else None
+        if action not in ("raise", "rollback") and not profiling:
+            return
+        flags = np.asarray(health).reshape(-1).astype(bool)
+        self._health_stats["steps_checked"] += len(flags)
+        n_bad = int((~flags).sum())
+        self._health_stats["trips"] += n_bad
+        if action in ("raise", "rollback"):
+            # sticky across the steps of ONE run (segmented/window
+            # loops): any tripped step gates this run's auto-checkpoint
+            # — a rollback target must never come from inside a fault
+            # window. ONLY policy-bearing actions set it: skip always
+            # syncs here only when profiling, and observability must
+            # not change checkpoint cadence (a skip-discarded step
+            # leaves clean state, so snapshotting it is valid).
+            self._last_step_tripped = self._last_step_tripped \
+                or bool(n_bad)
+        if action == "raise":
+            if n_bad:
+                bad = int(np.flatnonzero(~flags)[0])
+                if rng is None:
+                    # no single-step rng context (mesh window path) —
+                    # surface typed instead of mis-localizing
+                    raise core.NumericFaultError(
+                        f"numeric fault at global step {step0 + bad} "
+                        f"(windowed mesh run — re-run per-step for the "
+                        f"op-level localization)")
+                self._localize_and_raise(cb, program, scope, rng,
+                                         step0 + bad)
+            return
+        if action == "rollback":
+            mon = self._health_monitor
+            for i, ok_ in enumerate(flags):
+                if ok_:
+                    if mon is not None:
+                        mon.observe(True, step0 + i)
+                    continue
+                if mon is None:
+                    mon = self._ensure_health_monitor(program, scope)
+                if mon.observe(False, step0 + i) == "rolled_back":
+                    # flags past the restore describe discarded compute
+                    break
+            return
+        if n_bad and profiling:  # skip / AMP-only: markers, no policy
+            seg = self._offending_segment(cb)
+            for i in np.flatnonzero(~flags):
+                _profiler.record_instant(
+                    f"health:trip[step {step0 + int(i)}]", cat="health",
+                    args={"step": int(step0 + int(i)),
+                          "action": action or "amp",
+                          "segment": seg or "-"})
+
+    def health_stats(self) -> Dict[str, int]:
+        """Host-side guard counters. Only paths that sync (raise/
+        rollback/profiling) advance them — skip mode is deliberately
+        sync-free; read ``_last_health`` (device) for its verdicts."""
+        return dict(self._health_stats)
+
+    def _interp_guard_cfg(self, program, feed_names, scope):
+        """The interpreter oracle's guard plan, mirroring
+        _CompiledBlock._init_guard's state classification so compiled
+        and interpreted runs reduce health over the SAME variable set
+        (the AMP bit-parity contract). None when the fault plane is
+        off."""
+        check = bool(core.globals_["FLAGS_check_nan_inf"])
+        amp = getattr(program, "_amp_dynamic", None)
+        if not check and amp is None:
+            return None
+        action = str(core.globals_["FLAGS_nan_inf_action"])
+        if check and action not in _GUARD_ACTIONS:
+            raise ValueError(
+                f"FLAGS_nan_inf_action={action!r} is not one of "
+                f"{sorted(_GUARD_ACTIONS)}")
+        # the classification is invariant per (program version, feeds,
+        # scope, flags) — cache ON the program (dies with it, like
+        # _prune_cache; the scope weakref guards id reuse), mirroring
+        # the compiled path's classify-once-at-build semantics instead
+        # of re-walking every op each interpreted step
+        ckey = (program._version, tuple(sorted(feed_names)), check,
+                action)
+        cache = program.__dict__.setdefault("_interp_guard_cache", {})
+        hit = cache.get(ckey)
+        if hit is not None and hit[0]() is scope:
+            return hit[1]
+        block = program.global_block()
+        ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+        if amp is not None and not _block_reads_amp_scale(ops, amp):
+            amp = None  # pruned-away machinery: same rule as _init_guard
+        if not check and amp is None:
+            cache[ckey] = (weakref.ref(scope), None)
+            return None
+
+        def _ok(n):
+            return _initialized_tensor(scope, n) is not None
+
+        written: set = set()
+        rbw: List[str] = []
+        for op in ops:
+            for name in op.input_arg_names:
+                if name in written or name in feed_names or name in rbw:
+                    continue
+                if _ok(name):
+                    rbw.append(name)
+            written.update(_effective_writes(op))
+        persistable = {v.name for v in block.vars.values()
+                       if v.persistable}
+        amp_names = (set() if amp is None else
+                     {amp["scale"], amp["good"], amp["bad"]})
+        sel = [n for n in rbw if n in written and n not in amp_names]
+        for n in sorted(written):
+            if (n in persistable and n not in sel
+                    and n not in feed_names and n not in amp_names
+                    and _ok(n)):
+                sel.append(n)
+        cfg = {"check": check, "action": action, "amp": amp,
+               "select_names": tuple(sel),
+               # same health source as the compiled epilogue: param
+               # grads (+ fetches), falling back to all grads then to
+               # the written state
+               "health_names": tuple(
+                   n + GRAD_SUFFIX for n in sel
+                   if n + GRAD_SUFFIX in written) or tuple(
+                   n for n in sorted(written)
+                   if n.endswith(GRAD_SUFFIX)),
+               "select": amp is not None or (
+                   check and action in ("skip", "rollback"))}
+        cache[ckey] = (weakref.ref(scope), cfg)
+        return cfg
+
+    def _run_interpreted_step(self, program, scope, rng, guard,
+                              fetch_names) -> bool:
+        """One eager step + the numeric-fault epilogue (same health
+        set, same select/AMP arithmetic as the compiled epilogue — the
+        interpreter is the oracle the compiled guard is tested
+        against). raise-mode localization fires PER OP inside
+        _run_op_eager, so a bad op raises mid-step with full detail;
+        skip/rollback restore the pre-step state refs (jax arrays are
+        immutable, so the snapshot is free). Returns the step's health
+        verdict (True when unguarded)."""
+        block = program.global_block()
+        if guard is None:
+            self._run_block_eager(block, scope, rng)
+            return True
+        from .ir import fused_health
+
+        def _val(n):
+            return _initialized_tensor(scope, n)
+        snap = {}
+        if guard["select"]:
+            for n in guard["select_names"]:
+                t = _val(n)
+                if t is not None:
+                    snap[n] = (t.array, t.lod())
+        self._run_block_eager(block, scope, rng)
+        vals = []
+        for n in guard["health_names"]:
+            t = _val(n)
+            if t is not None:
+                vals.append(t.array)
+        if not vals:
+            for n in guard["select_names"]:
+                t = _val(n)
+                if t is not None:
+                    vals.append(t.array)
+        for n in fetch_names or ():
+            t = _val(n)
+            if t is not None:
+                vals.append(t.array)
+        health = fused_health(vals)
+        healthy = bool(np.asarray(health))
+        if guard["amp"] is not None and not (
+                guard["check"] and guard["action"] == "raise"
+                and not healthy):
+            # same rule as _apply_discard: under raise a tripped step
+            # keeps its pre-step scale/counters (the localizer replay
+            # must see the exact overflow-producing scale)
+            a = guard["amp"]
+            new_scale, new_good, new_bad = _amp_scale_update(
+                health, _val(a["scale"]).array, _val(a["good"]).array,
+                _val(a["bad"]).array, a)
+            scope.var(a["scale"]).set_value(LoDTensor(new_scale))
+            scope.var(a["good"]).set_value(LoDTensor(new_good))
+            scope.var(a["bad"]).set_value(LoDTensor(new_bad))
+        self._last_health = health
+        self._health_stats["steps_checked"] += 1
+        if guard["check"] and guard["action"] in ("raise", "rollback"):
+            # same rule as _process_health: only policy-bearing actions
+            # gate the auto-checkpoint
+            self._last_step_tripped = self._last_step_tripped \
+                or not healthy
+        if not healthy:
+            self._health_stats["trips"] += 1
+            if guard["select"]:
+                for n, (arr, lod) in snap.items():
+                    scope.var(n).set_value(LoDTensor(arr, lod))
+        if guard["check"] and guard["action"] == "rollback":
+            step = Executor._rng_counters.get(scope, 1) - 1
+            mon = self._health_monitor
+            if not healthy and mon is None:
+                mon = self._ensure_health_monitor(program, scope)
+            if mon is not None:
+                mon.observe(healthy, step)
+        return healthy
 
     def run(self, program: Optional[Program] = None, feed=None,
             fetch_list=None, feed_var_name="feed", fetch_var_name="fetch",
@@ -1265,6 +1983,8 @@ class Executor:
             scope = global_scope()
         feed = feed or {}
         fetch_names = _to_fetch_names(fetch_list)
+        # stale trip verdicts must not gate THIS run's auto-checkpoint
+        self._last_step_tripped = False
 
         if use_prune and fetch_names:
             # backward-slice to the fetch targets (reference executor.py
@@ -1327,6 +2047,21 @@ class Executor:
                 program, feed, fetch_list, scope, return_numpy, mesh,
                 param_shardings, n_steps, window_names)
 
+        if (n_steps > 1 or window_names) and compiled_ok \
+                and mesh is None \
+                and core.globals_["FLAGS_check_nan_inf"] \
+                and core.globals_["FLAGS_nan_inf_action"] == "raise":
+            # raise is the DEBUGGING action: the offending step must
+            # re-run through the interpreter localizer from exactly its
+            # pre-step state, so windows take the documented per-step
+            # fallback instead of one fused scan. Decided BEFORE the
+            # feed upload below, like the fallback above — the [K, ...]
+            # stack must not be device_put just to be re-uploaded slice
+            # by slice.
+            return self._run_window_fallback(
+                program, feed, fetch_list, scope, return_numpy, mesh,
+                param_shardings, n_steps, window_names)
+
         # materialize program vars' metadata for persistables (create slots)
         # feeds → device
         use_feed_cache = core.globals_["FLAGS_feed_device_cache"]
@@ -1355,6 +2090,12 @@ class Executor:
             key = (id(program), program._version, tuple(sorted(feed)),
                    tuple(fetch_names), id(scope),
                    tuple(sorted(feed_lods.items())),
+                   # the numeric fault guard is BAKED into the trace —
+                   # flipping its flags rebuilds the program instead of
+                   # silently running an unguarded (or stale-action)
+                   # executable
+                   (core.globals_["FLAGS_check_nan_inf"],
+                    core.globals_["FLAGS_nan_inf_action"]),
                    None if mesh is None else
                    (tuple(mesh.shape.items()), tuple(map(id, mesh.devices.flat))),
                    None if not param_shardings else
@@ -1392,11 +2133,17 @@ class Executor:
             if n_steps > 1 or window_names:
                 rng_base, idx0 = self._next_rng_window(scope, program,
                                                        n_steps)
-                fetched = cb.run_window(scope, feed_arrays, rng_base,
-                                        idx0, n_steps, window_names)
+                fetched, health = cb.run_window(scope, feed_arrays,
+                                                rng_base, idx0, n_steps,
+                                                window_names)
+                self._process_health(cb, program, scope, health, idx0,
+                                     n_steps)
             else:
                 rng = self._next_rng(scope, program)
-                fetched = cb.run(scope, feed_arrays, rng)
+                fetched, health = cb.run(scope, feed_arrays, rng)
+                self._process_health(
+                    cb, program, scope, health,
+                    Executor._rng_counters.get(scope, 1) - 1, 1, rng=rng)
             fetch_lods = cb.fetch_lods
             self._last_run_mode = "compiled"
         elif cb is not None:  # segmented: host loop per step (islands
@@ -1405,15 +2152,21 @@ class Executor:
             fetched, fetch_lods = [], []
             for _ in range(n_steps):
                 rng = self._next_rng(scope, program)
-                fetched, fetch_lods = cb.run_step(scope, feed_arrays, rng,
-                                                  self)
+                fetched, fetch_lods, health = cb.run_step(
+                    scope, feed_arrays, rng, self)
+                self._process_health(
+                    cb, program, scope, health,
+                    Executor._rng_counters.get(scope, 1) - 1, 1, rng=rng)
             self._last_run_mode = "segmented"
         else:
+            guard = self._interp_guard_cfg(program, set(feed), scope)
             for _ in range(n_steps - 1):  # same feeds, repeated steps
                 rng = self._next_rng(scope, program)
-                self._run_block_eager(program.global_block(), scope, rng)
+                self._run_interpreted_step(program, scope, rng, guard,
+                                           fetch_names)
             rng = self._next_rng(scope, program)
-            self._run_block_eager(program.global_block(), scope, rng)
+            self._run_interpreted_step(program, scope, rng, guard,
+                                       fetch_names)
             self._last_run_mode = "interpreted"
             fetched = []
             fetch_lods = []
@@ -1736,20 +2489,33 @@ class Executor:
         cache[name] = (prefix, fp, data, t, misses)
         return t
 
-    def _run_block_eager(self, block, scope: Scope, rng_base):
+    def _run_block_eager(self, block, scope: Scope, rng_base,
+                         check_nan: Optional[bool] = None):
+        """``check_nan``: None infers the per-op localizer from the
+        flags (raise mode only — skip/rollback get the end-of-step
+        fused check instead); True forces it regardless of action.
+        listen_and_serv forces it for pserver optimize blocks, which
+        run OUTSIDE Executor.run and would otherwise lose all guarding
+        under skip/rollback (the server has no step epilogue — raising
+        back to the trainer is its containment)."""
         for idx, op in enumerate(block.ops):
-            self._run_op_eager(op, scope, rng_base, idx)
+            self._run_op_eager(op, scope, rng_base, idx,
+                               check_nan=check_nan)
 
-    def _run_op_eager(self, op, scope: Scope, rng_base, idx: int = 0):
+    def _run_op_eager(self, op, scope: Scope, rng_base, idx: int = 0,
+                      check_nan: Optional[bool] = None):
         from . import profiler as _profiler
         if _profiler.is_profiling():
             # per-op host span (reference operator.cc:948-977 RecordEvent
             # hooks around prepare/infer_shape/compute)
             with _profiler.RecordEvent(op.type):
-                return self._run_op_eager_impl(op, scope, rng_base, idx)
-        return self._run_op_eager_impl(op, scope, rng_base, idx)
+                return self._run_op_eager_impl(op, scope, rng_base, idx,
+                                               check_nan)
+        return self._run_op_eager_impl(op, scope, rng_base, idx,
+                                       check_nan)
 
-    def _run_op_eager_impl(self, op, scope: Scope, rng_base, idx: int = 0):
+    def _run_op_eager_impl(self, op, scope: Scope, rng_base, idx: int = 0,
+                           check_nan: Optional[bool] = None):
         otype = op.type
         stateful = _op_is_stateful(op)
         attrs = op.attrs
@@ -1816,15 +2582,14 @@ class Executor:
                                           list(op.inputs.keys())))
         else:
             raise NotImplementedError(f"op '{otype}' is not implemented")
-        if core.globals_["FLAGS_check_nan_inf"]:
-            for slot, vals in (outs or {}).items():
-                if slot.startswith("_"):  # "_lod"-style metadata, not tensors
-                    continue
-                for v in vals or []:
-                    if v is not None and jnp.issubdtype(v.dtype, jnp.inexact):
-                        if not bool(jnp.all(jnp.isfinite(v))):
-                            raise FloatingPointError(
-                                f"NaN/Inf in output {slot} of op {otype}")
+        if check_nan is None:
+            check_nan = (core.globals_["FLAGS_check_nan_inf"]
+                         and core.globals_["FLAGS_nan_inf_action"]
+                         == "raise")
+        if check_nan:
+            # raise-mode per-op localizer; skip/rollback use the
+            # end-of-step fused health instead (no per-op host syncs)
+            _check_op_outputs_finite(op, idx, outs)
         for slot, names in op.outputs.items():
             vals = (outs or {}).get(slot)
             if vals is None:
@@ -1847,6 +2612,48 @@ class Executor:
                 return v.value().array.shape[0]
             return None
         _propagate_lods(op, outs, in_lods, _set_scope_lod, _scope_len)
+
+
+def _check_op_outputs_finite(op, idx: int, outs) -> None:
+    """raise-mode localizer (interpreter path). ONE device fetch per op:
+    each float output contributes a fused ``isfinite().all()`` flag and
+    the stacked flags cross to host together — the reference pays one
+    blocking device→host copy PER OUTPUT (nan_inf_utils_detail.cc
+    CheckVarHasNanOrInf), and so did this port before. On a trip the
+    slow path re-walks the outputs and names the op index/type, output
+    slot, var name, dtype, NaN/Inf counts, and the first offending flat
+    indices — the FloatingPointError message the raise action exists
+    for."""
+    flat = []  # (slot, var name, value)
+    for slot, vals in (outs or {}).items():
+        if slot.startswith("_"):  # "_lod"-style metadata, not tensors
+            continue
+        names = op.outputs.get(slot) or []
+        for k, v in enumerate(vals or []):
+            if v is not None and hasattr(v, "dtype") \
+                    and jnp.issubdtype(v.dtype, jnp.inexact):
+                flat.append((slot,
+                             names[k] if k < len(names) else f"[{k}]", v))
+    if not flat:
+        return
+    flags = jnp.stack([jnp.all(jnp.isfinite(v)) for _, _, v in flat])
+    host_flags = np.asarray(flags)  # the ONE host sync for this op
+    if host_flags.all():
+        return
+    problems = []
+    for ok_, (slot, name, v) in zip(host_flags, flat):
+        if ok_:
+            continue
+        arr = np.asarray(v)
+        bad = np.flatnonzero(~np.isfinite(arr.reshape(-1)))[:8].tolist()
+        problems.append(
+            f"output {slot} (var '{name}', dtype {arr.dtype}, shape "
+            f"{tuple(arr.shape)}): {int(np.isnan(arr).sum())} NaN / "
+            f"{int(np.isinf(arr).sum())} Inf, first offending flat "
+            f"indices {bad}")
+    raise FloatingPointError(
+        f"NaN/Inf in output of op #{idx} '{op.type}': "
+        + "; ".join(problems))
 
 
 def _fetch_to_host(f) -> np.ndarray:

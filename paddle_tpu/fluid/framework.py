@@ -687,6 +687,15 @@ class Program:
         p.current_block_idx = self.current_block_idx
         p._seed = self._seed
         p.lr_sheduler = self.lr_sheduler
+        # AMP dynamic loss scaling state names/hyperparams ride the
+        # program (mixed_precision.decorator); a clone (CompiledProgram
+        # build-strategy re-apply, transpiled trainer programs,
+        # use_prune=True) must keep them or the cloned program keeps the
+        # scaled-loss/unscale ops but silently loses the scale update
+        # and the overflow-step discard
+        amp = getattr(self, "_amp_dynamic", None)
+        if amp is not None:
+            p._amp_dynamic = dict(amp)
         return p
 
     def _prune(self, targets):

@@ -33,7 +33,7 @@ __all__ = [
     "load_params", "load_persistables", "save_inference_model",
     "load_inference_model", "save", "load",
     "save_checkpoint", "load_checkpoint", "latest_checkpoint",
-    "validate_checkpoint",
+    "validate_checkpoint", "rollback_to_latest",
 ]
 
 _LOG = logging.getLogger("paddle_tpu.io")
@@ -496,6 +496,27 @@ def _latest_valid(dirname):
 
 def latest_checkpoint(dirname) -> Optional[str]:
     return _latest_valid(dirname)[0]
+
+
+def rollback_to_latest(executor, dirname, main_program=None, scope=None
+                       ) -> Optional[Dict[str, Any]]:
+    """Numeric-fault rollback entry point (executor.HealthMonitor —
+    docs/FAULT_TOLERANCE.md "Numeric faults"): restore the newest VALID
+    checkpoint under ``dirname`` — parameters, optimizer slots, and the
+    rng fold counter, so the re-run of the faulted window is bit-exact —
+    and return its manifest. Returns None when nothing under ``dirname``
+    validates (the caller escalates to core.NumericFaultError instead of
+    training on from a poisoned state)."""
+    if not dirname or not os.path.isdir(dirname):
+        return None
+    try:
+        # ONE pick+validate sweep (load_checkpoint's _latest_valid); a
+        # separate latest_checkpoint() probe would CRC every candidate
+        # twice and open a TOCTOU window mid-recovery
+        return load_checkpoint(executor, dirname,
+                               main_program=main_program, scope=scope)
+    except core.CheckpointError:
+        return None
 
 
 def load_checkpoint(executor, path, main_program=None, scope=None

@@ -1266,6 +1266,45 @@ _SEG_CONTROL = frozenset({"while", "conditional_block",
                           "select_output"})
 
 
+# --------------------------------------------------------------------------
+# numeric fault plane helpers (docs/FAULT_TOLERANCE.md "Numeric faults")
+# --------------------------------------------------------------------------
+def fused_health(values) -> Any:
+    """ONE boolean health scalar over every inexact-dtype array in
+    ``values``: True iff every element of every float tensor is finite.
+    This is the per-step reduction the FLAGS_check_nan_inf guard fuses
+    into the jitted step (and rides the lax.scan carry on the windowed
+    path): each tensor contributes a single ``isfinite().all()`` that
+    XLA fuses into the producer loop already writing it, and the flags
+    AND into one scalar — no per-op host sync, unlike the reference's
+    per-op ``CheckVarHasNanOrInf`` device→host copies
+    (framework/details/nan_inf_utils_detail.cc). Non-float tensors
+    (int counters, bool masks) are skipped; an empty list is healthy."""
+    import jax.numpy as jnp
+    acc = None
+    for v in values:
+        if v is None or not hasattr(v, "dtype") \
+                or not jnp.issubdtype(v.dtype, jnp.inexact):
+            continue
+        flag = jnp.all(jnp.isfinite(v))
+        acc = flag if acc is None else jnp.logical_and(acc, flag)
+    return jnp.bool_(True) if acc is None else acc
+
+
+def guarded_float_names(names, env) -> List[str]:
+    """The subset of ``names`` whose current ``env`` value is an
+    inexact-dtype array — the vars a health reduction actually covers
+    (observability: segment_summary/tests report these)."""
+    import jax.numpy as jnp
+    out = []
+    for n in names:
+        v = env.get(n)
+        if v is not None and hasattr(v, "dtype") \
+                and jnp.issubdtype(v.dtype, jnp.inexact):
+            out.append(n)
+    return out
+
+
 def op_island_reason(op) -> Optional[str]:
     """None when ``op`` can be traced into a jitted segment; otherwise a
     short reason string ('stateful' | 'host_inputs' | 'unregistered' |
@@ -1293,7 +1332,11 @@ class BlockSegment:
     __slots__ = ("kind", "start", "ops", "island_reasons",
                  # filled by the executor when it builds a step plan
                  "in_names", "donated_names", "out_names", "_cache",
-                 "op_io")
+                 "op_io",
+                 # float out_names covered by the per-segment fused
+                 # finite check when the numeric fault guard is on
+                 # (executor._SegmentedBlock; fused_health above)
+                 "guard_names")
 
     def __init__(self, kind: str, start: int):
         self.kind = kind
@@ -1332,7 +1375,8 @@ def segment_summary(segments) -> List[Dict[str, Any]]:
     """JSON-ish view of a partition (what the pass stores on the graph)."""
     return [{"kind": s.kind, "start": s.start, "stop": s.stop,
              "n_ops": len(s.ops), "op_types": [o.type for o in s.ops],
-             "island_reasons": list(s.island_reasons)}
+             "island_reasons": list(s.island_reasons),
+             "guard_names": list(getattr(s, "guard_names", ()) or ())}
             for s in segments]
 
 

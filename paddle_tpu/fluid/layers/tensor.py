@@ -255,15 +255,26 @@ def reverse(x, axis):
 
 
 def has_inf(x):
+    """True iff ``x`` contains an Inf (reference: isinf over AnyVisitor
+    — a NaN-only tensor reports False; NOT the same as ``not
+    isfinite``, which the old port conflated both helpers into)."""
     helper = LayerHelper("isinf")
     out = helper.create_variable_for_type_inference(VarDesc.VarType.BOOL)
-    helper.append_op(type="logical_not", inputs={"X": [isfinite(x)]},
+    out.shape = (1,)
+    helper.append_op(type="isinf", inputs={"X": [x]},
                      outputs={"Out": [out]})
     return out
 
 
 def has_nan(x):
-    return has_inf(x)
+    """True iff ``x`` contains a NaN (an Inf-only tensor reports
+    False)."""
+    helper = LayerHelper("isnan")
+    out = helper.create_variable_for_type_inference(VarDesc.VarType.BOOL)
+    out.shape = (1,)
+    helper.append_op(type="isnan", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
 
 
 def isfinite(x):

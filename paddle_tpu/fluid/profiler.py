@@ -127,6 +127,17 @@ def record_span(name: str, start: float, end: float, cat: str = "host",
         _record(name, start, end, cat, args)
 
 
+def record_instant(name: str, cat: str = "host", args=None) -> None:
+    """Zero-duration marker event. No-op when profiling is off. The
+    numeric fault plane emits its trip/rollback markers here under
+    cat='health' (args carry the step, the offending segment, and the
+    action taken) so they land beside the cat='segment'/'window'/'rpc'
+    spans in the chrome trace."""
+    if _prof.enabled:
+        t = time.perf_counter()
+        _record(name, t, t, cat, args)
+
+
 class RecordEvent:
     """RAII span (reference platform/profiler.h:124). Usable as a context
     manager or decorator; no-op when profiling is off. ``cat`` groups

@@ -93,9 +93,11 @@ class _NDRef:
 def _strip_arrays(obj, bufs: list):
     """Replace every ndarray in ``obj`` (recursively through
     dicts/lists/tuples) with an _NDRef and append the contiguous array
-    to ``bufs``. 0-d and object-dtype arrays stay inline — they are
-    header-sized and sidestep buffer-protocol edge cases."""
-    if isinstance(obj, np.ndarray) and obj.ndim >= 1 \
+    to ``bufs``. 0-d, zero-SIZE, and object-dtype arrays stay inline —
+    they are header-sized and sidestep buffer-protocol edge cases
+    (memoryview cannot cast a view with zeros in its shape, so an empty
+    sparse update would kill the frame encoder)."""
+    if isinstance(obj, np.ndarray) and obj.ndim >= 1 and obj.size \
             and obj.dtype != object:
         bufs.append(np.ascontiguousarray(obj))
         return _NDRef(len(bufs) - 1)
@@ -263,6 +265,11 @@ class VarServer:
         # RPC (calls/bytes_in/bytes_out/dedup_replays per method)
         self._op_stats: Dict[str, Dict[str, int]] = {}
         self._stats_lock = threading.Lock()
+        # extra stats() sections contributed by the hosting op (e.g.
+        # listen_and_serv's FLAGS_ps_reject_nonfinite trip counters ride
+        # under a "health" key) — each source returns a dict merged into
+        # the stats() payload
+        self._stats_sources: List[Callable[[], Dict[str, Any]]] = []
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -424,11 +431,25 @@ class VarServer:
             st["bytes_out"] += bytes_out
             st["dedup_replays"] += replays
 
+    def add_stats_source(self, fn: Callable[[], Dict[str, Any]]) -> None:
+        """Register an extra section for stats() (and so for the "stats"
+        RPC). The numeric fault plane's pserver trip counters surface
+        this way (docs/FAULT_TOLERANCE.md "Numeric faults")."""
+        self._stats_sources.append(fn)
+
     def stats(self) -> Dict[str, Dict[str, int]]:
-        """Per-op counters (calls, bytes in/out, dedup replays) — also
-        served over the wire by the built-in idempotent "stats" RPC."""
+        """Per-op counters (calls, bytes in/out, dedup replays) plus any
+        add_stats_source sections — also served over the wire by the
+        built-in idempotent "stats" RPC."""
         with self._stats_lock:
-            return {k: dict(v) for k, v in self._op_stats.items()}
+            base: Dict[str, Any] = {k: dict(v)
+                                    for k, v in self._op_stats.items()}
+        for fn in self._stats_sources:
+            try:
+                base.update(fn() or {})
+            except Exception:  # a broken source must not break stats
+                _LOG.exception("VarServer stats source failed")
+        return base
 
     @property
     def port(self) -> int:
@@ -468,6 +489,9 @@ _WIRE_ERRORS: Dict[str, type] = {
     "WorkerDeadError": core.WorkerDeadError,
     "TimeoutError": TimeoutError,
     "KeyError": KeyError,
+    # FLAGS_ps_reject_nonfinite=reject: the pserver refuses a poisoned
+    # grad and the SENDING trainer gets the typed numeric fault back
+    "NumericFaultError": core.NumericFaultError,
 }
 
 

@@ -498,6 +498,71 @@ def _listen_and_serv(ins, attrs):
     lock = threading.RLock()
     state = {"pending": {}, "pending_sparse": [], "sparse_seq": 0}
 
+    # numeric fault plane, pserver side (FLAGS_ps_reject_nonfinite —
+    # docs/FAULT_TOLERANCE.md "Numeric faults"): trip counters surface
+    # through the built-in "stats" RPC under the "health" key. They get
+    # their OWN innermost lock (like VarServer's _stats_lock) so a
+    # monitoring stats RPC never blocks behind an in-flight sync
+    # optimize round holding the grad lock.
+    health = {"dropped_sparse_rows": 0, "dropped_dense_updates": 0,
+              "rejected_calls": 0, "per_var": {}}
+    health_lock = threading.Lock()
+
+    def _bump_health(key, name, n):
+        with health_lock:
+            health[key] += n
+            health["per_var"][name] = health["per_var"].get(name, 0) + n
+
+    def _guard_nonfinite(name, value, rows, trainer_id):
+        """Apply FLAGS_ps_reject_nonfinite to one incoming update.
+        Returns (value, rows, apply?) — sparse updates drop only their
+        non-finite rows, a non-finite dense update drops wholesale;
+        "reject" raises NumericFaultError back to the SENDING trainer
+        (typed across the wire), leaving server state untouched. The
+        checks run on host numpy — the grads already live there."""
+        mode = str(core.globals_["FLAGS_ps_reject_nonfinite"] or "")
+        if not mode:
+            return value, rows, True
+        value = np.asarray(value)
+        if not np.issubdtype(value.dtype, np.floating):
+            return value, rows, True
+        if rows is not None and len(rows) == 0:
+            # benign no-op update (public send_var allows it): nothing
+            # to check, and reshape(0, -1) cannot infer a dimension
+            return value, rows, False
+        if rows is not None:
+            n = len(rows)
+            if value.shape[0] != n:
+                # flat payload: row-major it so per-row masking works
+                value = value.reshape(n, -1)
+            # check on a 2-D VIEW; the clean pass-through and the
+            # filtered value keep the sender's original shape (a 1-D
+            # payload must not come back (n, 1) just because the guard
+            # flag is on)
+            per_row = np.isfinite(value.reshape(n, -1)).all(axis=1)
+            if per_row.all():
+                return value, rows, True
+            n_bad = int((~per_row).sum())
+            if mode == "reject":
+                _bump_health("rejected_calls", name, 1)
+                raise core.NumericFaultError(
+                    f"pserver rejected sparse grad '{name}' from trainer "
+                    f"{trainer_id}: {n_bad}/{len(rows)} non-finite rows "
+                    f"(FLAGS_ps_reject_nonfinite=reject)")
+            _bump_health("dropped_sparse_rows", name, n_bad)
+            return (value[per_row],
+                    np.asarray(rows).reshape(-1)[per_row], True)
+        if np.isfinite(value).all():
+            return value, rows, True
+        if mode == "reject":
+            _bump_health("rejected_calls", name, 1)
+            raise core.NumericFaultError(
+                f"pserver rejected dense update '{name}' from trainer "
+                f"{trainer_id}: non-finite values "
+                f"(FLAGS_ps_reject_nonfinite=reject)")
+        _bump_health("dropped_dense_updates", name, 1)
+        return value, rows, False
+
     # failure-detection cadence is deploy-tunable (tests shrink it to
     # seconds; reference FLAGS_worker_update_interval_secs plays this role)
     hb_timeout = float(os.environ.get("PADDLE_PS_HEARTBEAT_TIMEOUT", 60.0))
@@ -526,13 +591,21 @@ def _listen_and_serv(ins, attrs):
 
     def _run_block_for(grad_name):
         blk_id = grad_to_block.get(grad_name)
+        # the pserver optimize block runs OUTSIDE any Executor.run step
+        # epilogue, so the per-op localizer is its ONLY numeric guard —
+        # force it whenever the check flag is on, regardless of the
+        # (trainer-oriented) action: a NaN minted here raises back to
+        # the trainer typed instead of landing in the served params
+        check = bool(core.globals_["FLAGS_check_nan_inf"]) or None
         for i, blk in enumerate(optimize_blocks):
             if blk_id is None or str(i) == str(blk_id):
-                executor._run_block_eager(blk, scope, ctx.rng_base)
+                executor._run_block_eager(blk, scope, ctx.rng_base,
+                                          check_nan=check)
                 if blk_id is not None:
                     break
 
-    def _apply_one_locked(name, value, rows, trainer_id=0):
+    def _apply_checked_locked(name, value, rows, trainer_id=0):
+        """Apply one already-guarded update (rows pre-filtered)."""
         if rows is not None:
             if sync:
                 state["sparse_seq"] += 1
@@ -550,6 +623,13 @@ def _listen_and_serv(ins, attrs):
                 core.LoDTensor(jnp.asarray(value)))
             _run_block_for(name)
 
+    def _apply_one_locked(name, value, rows, trainer_id=0):
+        value, rows, apply_ = _guard_nonfinite(name, value, rows,
+                                               trainer_id)
+        if not apply_ or (rows is not None and len(rows) == 0):
+            return
+        _apply_checked_locked(name, value, rows, trainer_id)
+
     def h_send_var(name, value, trainer_id=0, rows=None, height=0):
         monitor.update(trainer_id)
         with lock:
@@ -560,12 +640,20 @@ def _listen_and_serv(ins, attrs):
         """Coalesced multi-var send (Communicator flush): every entry
         applies under ONE grad-lock acquisition; the caller's dedup
         token covers the whole batch, so a replayed retry re-applies
-        none of it."""
+        none of it. The numeric guard runs over the WHOLE batch before
+        anything applies (one scan per array, not two): under
+        FLAGS_ps_reject_nonfinite=reject a half-applied batch would be
+        unrecoverable — the dedup cache replays the error on retry and
+        nothing re-sends the tail — so reject must leave server state
+        untouched."""
         monitor.update(trainer_id)
         with lock:
-            for v in vars:
-                _apply_one_locked(v["name"], v["value"], v.get("rows"),
-                                  trainer_id)
+            checked = [(v["name"],) + _guard_nonfinite(
+                v["name"], v["value"], v.get("rows"), trainer_id)
+                for v in vars]
+            for name, value, rows, apply_ in checked:
+                if apply_ and not (rows is not None and len(rows) == 0):
+                    _apply_checked_locked(name, value, rows, trainer_id)
         return True
 
     def _release_send_round():
@@ -676,7 +764,22 @@ def _listen_and_serv(ins, attrs):
         "table_stats": h_table_stats,
         "geo_delta": h_geo_delta,
         **monitor.handlers(),
-    }).start()
+    })
+    def _health_stats_snapshot():
+        # the dedicated counter lock, NOT the grad lock: an unlocked
+        # dict() copy can die mid-iteration against a _bump_health
+        # writer, and the grad lock would stall this observability RPC
+        # behind a whole sync optimize round
+        with health_lock:
+            return {"health": {
+                "dropped_sparse_rows": health["dropped_sparse_rows"],
+                "dropped_dense_updates": health["dropped_dense_updates"],
+                "rejected_calls": health["rejected_calls"],
+                "per_var": dict(health["per_var"]),
+            }}
+
+    srv.add_stats_source(_health_stats_snapshot)
+    srv.start()
     try:
         srv.wait_stopped()
     finally:

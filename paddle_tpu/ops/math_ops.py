@@ -447,6 +447,21 @@ def _isfinite(ins, attrs):
     return out(Out=jnp.all(jnp.isfinite(first(ins, "X"))).reshape((1,)))
 
 
+# isnan/isinf are DISTINCT reductions (reference: isfinite_op.cc
+# registers all three over the Any/All functors): isnan answers "any
+# NaN?", isinf "any Inf?" — an Inf-only tensor has has_nan()==False and
+# a NaN-only tensor has has_inf()==False (layers.tensor.has_nan/has_inf
+# build on these; the old port aliased both to NOT isfinite).
+@register_op("isnan", inputs=("X",), no_grad=True)
+def _isnan(ins, attrs):
+    return out(Out=jnp.any(jnp.isnan(first(ins, "X"))).reshape((1,)))
+
+
+@register_op("isinf", inputs=("X",), no_grad=True)
+def _isinf(ins, attrs):
+    return out(Out=jnp.any(jnp.isinf(first(ins, "X"))).reshape((1,)))
+
+
 @register_op("allclose", inputs=("Input", "Other"), no_grad=True,
              attr_defaults={"rtol": 1e-5, "atol": 1e-8, "equal_nan": False})
 def _allclose(ins, attrs):

@@ -102,6 +102,131 @@ def read_jsonl(path):
     return out
 
 
+# ------------------------------------------------------- numeric poison
+_POISON_VALUES = {"nan": float("nan"), "inf": float("inf"),
+                  "-inf": float("-inf")}
+
+
+def poison_array(arr, kind="nan", index=0):
+    """Copy of ``arr`` with one element replaced by NaN/Inf (kind in
+    {'nan','inf','-inf'}; ``index`` is a flat offset). The building
+    block the feed/param/PS poisoners share."""
+    import numpy as np
+    out = np.array(arr, copy=True)
+    flat = out.reshape(-1)
+    flat[index % max(1, flat.size)] = _POISON_VALUES[kind]
+    return out
+
+
+def poison_feed(feed, name, kind="nan", index=0):
+    """New feed dict with ``feed[name]`` poisoned (the original dict and
+    arrays are untouched — a transient bad-batch injection)."""
+    out = dict(feed)
+    out[name] = poison_array(out[name], kind, index)
+    return out
+
+
+def poison_param(scope, name, kind="nan", index=0):
+    """Poison a scope-resident parameter/buffer in place (models silent
+    state corruption, e.g. a bad PS pull). Returns the poisoned numpy
+    copy that was installed."""
+    import numpy as np
+    from paddle_tpu.fluid.core import LoDTensor
+    var = scope.find_var(name)
+    assert var is not None and var.is_initialized(), name
+    bad = poison_array(np.asarray(var.get_tensor().array), kind, index)
+    var.set_value(LoDTensor(bad))
+    return bad
+
+
+class poison_var:
+    """Context manager: poison a named var at a scheduled step across
+    the three injection surfaces the fault plane guards —
+
+      poison_var(name, step, kind, where="feed")  wrap a feed dict per
+          step via ``.feed(feed, step)``; slips NaN/Inf into feeds at
+          the scheduled step(s) only
+      poison_var(name, step, kind, where="param", scope=...)  call
+          ``.maybe(step)`` in the training loop; corrupts the scope
+          param just before the scheduled step
+      poison_var(name, step, kind, where="push")  monkeypatches BOTH
+          VarClient.send_var AND ps_rpc.send_vars_batch (the coalesced
+          path the send op / Communicator flush actually takes) so the
+          ``step``-th push whose var name matches gets poisoned on the
+          wire (models a poisoned trainer in a PS cluster)
+
+    ``step`` may be an int or a set/range of ints; ``fired`` counts
+    injections."""
+
+    def __init__(self, name, step, kind="nan", where="feed", scope=None,
+                 index=0):
+        self.name = name
+        self.steps = {step} if isinstance(step, int) else set(step)
+        self.kind = kind
+        self.where = where
+        self.scope = scope
+        self.index = index
+        self.fired = 0
+        self._push_seen = 0
+        self._orig_send = None
+        self._orig_batch = None
+
+    # ---- where="feed"
+    def feed(self, feed, step):
+        if self.where == "feed" and step in self.steps \
+                and self.name in feed:
+            self.fired += 1
+            return poison_feed(feed, self.name, self.kind, self.index)
+        return feed
+
+    # ---- where="param"
+    def maybe(self, step):
+        if self.where == "param" and step in self.steps:
+            assert self.scope is not None, "param poisoning needs scope="
+            poison_param(self.scope, self.name, self.kind, self.index)
+            self.fired += 1
+
+    # ---- where="push"
+    def _maybe_poison(self, name, value):
+        if name != self.name:
+            return value
+        if self._push_seen in self.steps:
+            value = poison_array(value, self.kind, self.index)
+            self.fired += 1
+        self._push_seen += 1
+        return value
+
+    def __enter__(self):
+        if self.where != "push":
+            return self
+        from paddle_tpu.fluid import ps_rpc
+        inj = self
+        self._orig_send = ps_rpc.VarClient.send_var
+        self._orig_batch = ps_rpc.send_vars_batch
+
+        def send_var(cli, name, value, trainer_id=0, rows=None, height=0):
+            return inj._orig_send(cli, name,
+                                  inj._maybe_poison(name, value),
+                                  trainer_id=trainer_id, rows=rows,
+                                  height=height)
+
+        def send_vars_batch(client, items, trainer_id=0):
+            items = [(n, inj._maybe_poison(n, v)) for n, v in items]
+            return inj._orig_batch(client, items, trainer_id=trainer_id)
+
+        ps_rpc.VarClient.send_var = send_var
+        ps_rpc.send_vars_batch = send_vars_batch
+        return self
+
+    def __exit__(self, *exc):
+        if self._orig_send is not None:
+            from paddle_tpu.fluid import ps_rpc
+            ps_rpc.VarClient.send_var = self._orig_send
+            ps_rpc.send_vars_batch = self._orig_batch
+            self._orig_send = self._orig_batch = None
+        return False
+
+
 # ----------------------------------------------------------- checkpoints
 def _data_files(ckpt_dir):
     from paddle_tpu.fluid.io import CKPT_MANIFEST

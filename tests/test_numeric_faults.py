@@ -1,0 +1,634 @@
+"""Numeric fault plane (docs/FAULT_TOLERANCE.md "Numeric faults"):
+fused NaN/Inf guards with skip/rollback/raise policies across the
+compiled, windowed, segmented and PS paths, plus the isnan/isinf op
+split and the interpreter localizer.
+
+Reference analogue: FLAGS_check_nan_inf + framework/details/
+nan_inf_utils per-op localization — which only ever CRASHES; the skip/
+rollback policies and the fused (sync-free) guard are this port's
+production hardening."""
+import glob
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+
+from tests import faultinject
+
+
+# ---------------------------------------------------------------- helpers
+def _mlp_program(seed=7, lr=0.1, with_print=False):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data("x", shape=[8], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+        if with_print:
+            loss = fluid.layers.Print(loss, message="l",
+                                      print_phase="forward")
+        fluid.optimizer.Momentum(lr, momentum=0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _batch(rng, n=16):
+    return {"x": rng.rand(n, 8).astype("float32"),
+            "y": rng.randint(0, 4, (n, 1)).astype("int64")}
+
+
+def _state_snapshot(scope, program):
+    out = {}
+    for v in program.list_vars():
+        if not v.persistable:
+            continue
+        sv = scope.find_var(v.name)
+        if sv is not None and sv.is_initialized():
+            out[v.name] = np.asarray(sv.get_tensor().array).copy()
+    return out
+
+
+@pytest.fixture
+def guard_flags():
+    """Set/restore the fault-plane flags around a test."""
+    saved = {k: core.globals_[k] for k in
+             ("FLAGS_check_nan_inf", "FLAGS_nan_inf_action",
+              "FLAGS_nan_inf_tolerance", "FLAGS_nan_inf_max_rollbacks",
+              "FLAGS_ps_reject_nonfinite", "FLAGS_executor_mode",
+              "FLAGS_executor_seg_min_ops")}
+    yield core.set_flag
+    for k, v in saved.items():
+        core.set_flag(k, v)
+
+
+# ======================================================================
+# satellite: isnan/isinf are distinct reductions
+# ======================================================================
+def test_has_nan_has_inf_distinct():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        flags = [fluid.layers.has_nan(x), fluid.layers.has_inf(x),
+                 fluid.layers.isfinite(x)]
+    exe = fluid.Executor()
+    scope = core.Scope()
+    inf_only = np.array([[1.0, np.inf, 2.0, 3.0]], np.float32)
+    nan_only = np.array([[1.0, np.nan, 2.0, 3.0]], np.float32)
+    clean = np.ones((1, 4), np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+
+        def probe(arr):
+            vals = exe.run(main, feed={"x": arr}, fetch_list=flags)
+            return [bool(np.asarray(v).reshape(-1)[0]) for v in vals]
+
+        assert probe(inf_only) == [False, True, False]  # Inf ≠ NaN
+        assert probe(nan_only) == [True, False, False]  # NaN ≠ Inf
+        assert probe(clean) == [False, False, True]
+
+
+# ======================================================================
+# satellite: interpreter raise-mode localizer
+# ======================================================================
+def test_interpreter_localizer_names_op_var_dtype_indices(guard_flags):
+    guard_flags("FLAGS_check_nan_inf", True)
+    guard_flags("FLAGS_nan_inf_action", "raise")
+    guard_flags("FLAGS_executor_mode", "interpreted")
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    feed = faultinject.poison_feed(_batch(rng), "x", "nan", index=3)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(FloatingPointError) as ei:
+            exe.run(main, feed=feed, fetch_list=[loss])
+    msg = str(ei.value)
+    # op index + type, output slot, var name, dtype, counts, indices
+    assert "op #" in msg and "output Out" in msg
+    assert "var '" in msg and "float32" in msg
+    assert "NaN" in msg and "first offending flat indices" in msg
+
+
+def test_compiled_raise_localizes_through_interpreter(guard_flags):
+    guard_flags("FLAGS_check_nan_inf", True)
+    guard_flags("FLAGS_nan_inf_action", "raise")
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    clean = _batch(rng)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=clean, fetch_list=[loss])
+        assert exe._last_run_mode == "compiled"
+        with pytest.raises(FloatingPointError) as ei:
+            exe.run(main, feed=faultinject.poison_feed(clean, "x", "inf"),
+                    fetch_list=[loss])
+    msg = str(ei.value)
+    assert "numeric fault at global step" in msg
+    assert "op #" in msg and "Inf" in msg
+
+
+# ======================================================================
+# tentpole: fused skip action — compiled, windowed, segmented
+# ======================================================================
+def test_skip_leaves_params_and_slots_bit_identical(guard_flags):
+    guard_flags("FLAGS_check_nan_inf", True)
+    guard_flags("FLAGS_nan_inf_action", "skip")
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    clean = _batch(rng)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=clean, fetch_list=[loss])
+        before = _state_snapshot(scope, main)  # params AND momentum slots
+        (bad_loss,) = exe.run(
+            main, feed=faultinject.poison_feed(clean, "x", "nan"),
+            fetch_list=[loss])
+        after = _state_snapshot(scope, main)
+        assert not bool(np.asarray(exe._last_health))
+        assert np.isnan(np.asarray(bad_loss)).any()  # fetch shows the NaN
+        assert set(before) == set(after)
+        for n in before:
+            np.testing.assert_array_equal(before[n], after[n],
+                                          err_msg=n)
+        # and training continues with a finite step
+        (lv,) = exe.run(main, feed=clean, fetch_list=[loss])
+        assert np.isfinite(np.asarray(lv)).all()
+        assert bool(np.asarray(exe._last_health))
+
+
+def test_skip_window_scan_discards_only_the_bad_slice(guard_flags):
+    """One fused scan window with slice 2 poisoned must land on the
+    SAME state as sequentially training the clean slices only — the
+    guard rides the scan carry."""
+    guard_flags("FLAGS_check_nan_inf", True)
+    guard_flags("FLAGS_nan_inf_action", "skip")
+    K = 4
+    rng = np.random.RandomState(1)
+    xw = rng.rand(K, 16, 8).astype("float32")
+    yw = rng.randint(0, 4, (K, 16, 1)).astype("int64")
+    xbad = xw.copy()
+    xbad[2, 0, 0] = np.inf
+
+    # faulted window
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out = exe.run(main, feed={"x": xbad, "y": yw},
+                      fetch_list=[loss], n_steps=K)
+        got = _state_snapshot(scope, main)
+        health = np.asarray(exe._last_health)
+    losses = np.asarray(out[0]).ravel()
+    assert list(health) == [True, True, False, True]
+    assert np.isnan(losses[2]) and np.isfinite(losses[[0, 1, 3]]).all()
+
+    # oracle: clean slices 0,1,3 applied sequentially with the SAME
+    # global-step rng keys (counter advances over the skipped step too)
+    main2, startup2, loss2 = _mlp_program()
+    exe2 = fluid.Executor()
+    scope2 = core.Scope()
+    with fluid.scope_guard(scope2):
+        exe2.run(startup2)
+        for i in (0, 1, 3):
+            # advance the rng counter to the global step index i
+            while fluid.Executor._rng_counters.get(scope2, 0) < i:
+                fluid.Executor._rng_counters[scope2] = \
+                    fluid.Executor._rng_counters.get(scope2, 0) + 1
+            exe2.run(main2, feed={"x": xw[i], "y": yw[i]},
+                     fetch_list=[loss2])
+        want = _state_snapshot(scope2, main2)
+    for n in want:
+        if n == "@RNG_COUNTER@":
+            continue
+        np.testing.assert_array_equal(got[n], want[n], err_msg=n)
+
+
+def test_skip_segmented_block_discards_bad_step(guard_flags):
+    guard_flags("FLAGS_check_nan_inf", True)
+    guard_flags("FLAGS_nan_inf_action", "skip")
+    guard_flags("FLAGS_executor_seg_min_ops", 1)
+    main, startup, loss = _mlp_program(with_print=True)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    clean = _batch(rng)
+    import contextlib, io
+    with fluid.scope_guard(scope), \
+            contextlib.redirect_stdout(io.StringIO()):
+        exe.run(startup)
+        exe.run(main, feed=clean, fetch_list=[loss])
+        assert exe._last_run_mode == "segmented"
+        before = _state_snapshot(scope, main)
+        exe.run(main, feed=faultinject.poison_feed(clean, "x", "nan"),
+                fetch_list=[loss])
+        after = _state_snapshot(scope, main)
+    assert not bool(np.asarray(exe._last_health))
+    for n in before:
+        if n == "@RNG_COUNTER@":
+            continue
+        np.testing.assert_array_equal(before[n], after[n], err_msg=n)
+
+
+def test_guard_no_per_step_recompile(guard_flags):
+    """Acceptance: jit cache entry count stable after warmup with the
+    guard enabled — the health scalar/select are part of the ONE traced
+    step, not a per-step retrace."""
+    guard_flags("FLAGS_check_nan_inf", True)
+    guard_flags("FLAGS_nan_inf_action", "skip")
+    K = 4
+    rng = np.random.RandomState(2)
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        windows = [
+            {"x": rng.rand(K, 16, 8).astype("float32"),
+             "y": rng.randint(0, 4, (K, 16, 1)).astype("int64")}
+            for _ in range(5)]
+        bad = windows[1]["x"].copy()
+        bad[1, 0, 0] = np.nan
+        windows[1] = {"x": bad, "y": windows[1]["y"]}
+        exe.run(main, feed=windows[0], fetch_list=[loss], n_steps=K)
+        (cb,) = [v for k, v in exe._compiled_cache.items()
+                 if k[0] == id(main) and not isinstance(v, tuple)]
+        # second call = the documented warmup boundary (the first call
+        # compiles against uncommitted startup state — BENCH note r7)
+        exe.run(main, feed=windows[1], fetch_list=[loss], n_steps=K)
+        sizes = (len(cb._multi_jit),
+                 [j._cache_size() for j in cb._multi_jit.values()])
+        for w in windows[2:]:
+            exe.run(main, feed=w, fetch_list=[loss], n_steps=K)
+        sizes2 = (len(cb._multi_jit),
+                  [j._cache_size() for j in cb._multi_jit.values()])
+    # guard on + a tripped window in the mix: ZERO new jit entries after
+    # warmup — the health scalar/select/scan-carry are in the one trace
+    assert sizes == sizes2
+    assert sizes[0] == 1
+
+
+def test_flipping_guard_flags_rebuilds_program(guard_flags):
+    """The guard is baked into the trace; the program cache must key on
+    the flags so a flip takes effect instead of reusing a stale
+    executable."""
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    clean = _batch(rng)
+    bad = faultinject.poison_feed(clean, "x", "nan")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=clean, fetch_list=[loss])  # unguarded build
+        before = _state_snapshot(scope, main)
+        guard_flags("FLAGS_check_nan_inf", True)
+        guard_flags("FLAGS_nan_inf_action", "skip")
+        exe.run(main, feed=bad, fetch_list=[loss])  # guarded rebuild
+        after = _state_snapshot(scope, main)
+    for n in before:
+        if n == "@RNG_COUNTER@":
+            continue
+        np.testing.assert_array_equal(before[n], after[n], err_msg=n)
+
+
+# ======================================================================
+# tentpole: rollback action
+# ======================================================================
+@pytest.mark.faults
+def test_rollback_resumes_bit_identical_to_unfaulted_oracle(
+        guard_flags, tmp_path):
+    """Acceptance: after FLAGS_nan_inf_tolerance consecutive poisoned
+    steps the run restores the last intact checkpoint (params, slots,
+    rng counter) and the replayed steps produce losses bit-identical to
+    an oracle that never saw the fault window."""
+    guard_flags("FLAGS_check_nan_inf", True)
+    guard_flags("FLAGS_nan_inf_action", "rollback")
+    guard_flags("FLAGS_nan_inf_tolerance", 2)
+    rng = np.random.RandomState(3)
+    feeds = [_batch(rng) for _ in range(8)]
+
+    # oracle: never sees the fault
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        oracle = [float(np.asarray(exe.run(main, feed=f,
+                                           fetch_list=[loss])[0])[0])
+                  for f in feeds]
+
+    # faulted run: steps 4 and 5 poisoned ONCE (a transient fault)
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rolled = {}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # the startup run consumed rng counter ticks: feed index i maps
+        # to post-step counter base + i + 1
+        base = fluid.Executor._rng_counters.get(scope, 0)
+        exe.set_auto_checkpoint(str(tmp_path), every_n_steps=2,
+                                program=main, scope=scope)
+        exe.set_health_monitor(str(tmp_path), program=main, scope=scope,
+                               on_rollback=lambda m: rolled.update(m))
+        got = [None] * len(feeds)
+        poisoned = {4, 5}
+        i = 0
+        while i < len(feeds):
+            feed = feeds[i]
+            if i in poisoned:
+                feed = faultinject.poison_feed(feed, "x", "nan")
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            mon = exe._health_monitor
+            if mon.last_rollback_step is not None and rolled:
+                # restored to the last intact checkpoint (taken OUTSIDE
+                # the fault window — tripped steps never checkpoint):
+                # rewind the feed cursor to the restored step and clear
+                # the fault (transient); the faulted window replays
+                i = int(rolled["global_step"]) - base
+                assert i < 4, "checkpoint must predate the fault window"
+                poisoned = set()
+                rolled.clear()
+                continue
+            got[i] = float(np.asarray(lv)[0])
+            i += 1
+        assert mon.rollbacks == 1
+        assert mon.trips == 2
+    assert got == oracle  # bit-identical, including the replayed window
+
+
+@pytest.mark.faults
+def test_rollback_exhausts_retries_with_typed_error(guard_flags,
+                                                    tmp_path):
+    """A PERSISTENT fault (poisoned parameter re-poisoned after each
+    restore) must burn the rollback budget and surface
+    core.NumericFaultError."""
+    guard_flags("FLAGS_check_nan_inf", True)
+    guard_flags("FLAGS_nan_inf_action", "rollback")
+    guard_flags("FLAGS_nan_inf_tolerance", 1)
+    guard_flags("FLAGS_nan_inf_max_rollbacks", 1)
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    clean = _batch(rng)
+    bad = faultinject.poison_feed(clean, "x", "nan")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.set_auto_checkpoint(str(tmp_path), every_n_steps=1,
+                                program=main, scope=scope)
+        exe.run(main, feed=clean, fetch_list=[loss])  # ckpt-1 exists
+        exe.run(main, feed=bad, fetch_list=[loss])    # trip -> rollback 1
+        assert exe._health_monitor.rollbacks == 1
+        with pytest.raises(core.NumericFaultError) as ei:
+            exe.run(main, feed=bad, fetch_list=[loss])  # budget spent
+    assert "rollback budget" in str(ei.value)
+
+
+@pytest.mark.faults
+def test_rollback_without_checkpoint_plane_is_typed(guard_flags):
+    guard_flags("FLAGS_check_nan_inf", True)
+    guard_flags("FLAGS_nan_inf_action", "rollback")
+    guard_flags("FLAGS_nan_inf_tolerance", 1)
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(core.NumericFaultError) as ei:
+            exe.run(main,
+                    feed=faultinject.poison_feed(_batch(rng), "x", "nan"),
+                    fetch_list=[loss])
+    assert "no checkpoint plane" in str(ei.value)
+
+
+def test_unknown_action_is_rejected_not_silently_inert(guard_flags):
+    """A typo'd FLAGS_nan_inf_action must raise, not quietly disable
+    every policy while FLAGS_check_nan_inf still claims protection."""
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        guard_flags("FLAGS_check_nan_inf", True)
+        guard_flags("FLAGS_nan_inf_action", "abort")
+        with pytest.raises(ValueError, match="FLAGS_nan_inf_action"):
+            exe.run(main, feed=_batch(rng), fetch_list=[loss])
+
+
+# ======================================================================
+# observability: cat="health" events
+# ======================================================================
+def test_health_trip_events_in_chrome_trace(guard_flags, tmp_path):
+    from paddle_tpu.fluid import profiler
+    guard_flags("FLAGS_check_nan_inf", True)
+    guard_flags("FLAGS_nan_inf_action", "skip")
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    clean = _batch(rng)
+    trace = str(tmp_path / "trace.json")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=clean, fetch_list=[loss])
+        with profiler.profiler(state="CPU", profile_path=trace):
+            exe.run(main, feed=clean, fetch_list=[loss])
+            exe.run(main,
+                    feed=faultinject.poison_feed(clean, "x", "nan"),
+                    fetch_list=[loss])
+    with open(trace) as f:
+        events = json.load(f)["traceEvents"]
+    health = [e for e in events if e.get("cat") == "health"]
+    assert health, "no cat='health' events recorded"
+    args = health[0].get("args") or {}
+    assert args.get("action") == "skip" and "step" in args
+    # and the guard's host counters advanced on the synced (profiled) path
+    assert exe.health_stats()["trips"] >= 1
+
+
+# ======================================================================
+# PS plane: FLAGS_ps_reject_nonfinite
+# ======================================================================
+def _start_ps(sync_mode, fanin, sparse_table=None, seed_vars=()):
+    """In-process listen_and_serv on a fresh scope/thread. Returns
+    (endpoint, scope, join_fn)."""
+    from tests.test_ps_data_plane import free_port
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        main.global_block().append_op(
+            type="listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": f"127.0.0.1:{free_port()}",
+                   "sync_mode": sync_mode, "Fanin": fanin,
+                   "optimize_blocks": [], "grad_to_block_id": [],
+                   "sparse_lr": 0.5})
+    scope = core.Scope()
+    for name, arr in seed_vars:
+        scope.var(name).set_value(core.LoDTensor(np.asarray(arr)))
+    exe = fluid.Executor()
+    th = threading.Thread(
+        target=lambda: exe.run(main, scope=scope, feed={}, fetch_list=[]),
+        daemon=True)
+    th.start()
+    ep = main.global_block().ops[0].attrs["endpoint"]
+    return ep, scope, th
+
+
+@pytest.mark.faults
+def test_ps_drop_nonfinite_rows_and_dense_with_stats(guard_flags):
+    from paddle_tpu.fluid.ps_rpc import VarClient
+    guard_flags("FLAGS_ps_reject_nonfinite", "drop")
+    table = np.ones((8, 4), np.float32)
+    ep, scope, th = _start_ps(sync_mode=False, fanin=1,
+                              seed_vars=[("emb", table.copy()),
+                                         ("w", np.zeros(3, np.float32))])
+    try:
+        cli = VarClient(ep)
+        # sparse: row 5's grad is NaN -> dropped; rows 1,2 apply
+        grads = np.ones((3, 4), np.float32)
+        grads[1, 2] = np.nan
+        cli.send_var("emb@GRAD", grads, rows=[1, 5, 2], height=8)
+        got = np.asarray(cli.get_var("emb"))
+        want = table.copy()
+        want[1] -= 0.5  # lr 0.5 * grad 1.0
+        want[2] -= 0.5
+        np.testing.assert_array_equal(got, want)  # row 5 untouched
+        # empty sparse update: benign no-op, not a reshape crash
+        cli.send_var("emb@GRAD", np.zeros((0, 4), np.float32), rows=[],
+                     height=8)
+        np.testing.assert_array_equal(np.asarray(cli.get_var("emb")),
+                                      want)
+        # dense: non-finite update dropped wholesale
+        cli.send_var("w", np.array([1.0, np.inf, 2.0], np.float32))
+        np.testing.assert_array_equal(np.asarray(cli.get_var("w")),
+                                      np.zeros(3, np.float32))
+        stats = cli.call("stats")
+        health = stats["health"]
+        assert health["dropped_sparse_rows"] == 1
+        assert health["dropped_dense_updates"] == 1
+        assert health["per_var"]["emb@GRAD"] == 1
+        assert health["per_var"]["w"] == 1
+        cli.stop()
+        th.join(timeout=30)
+    finally:
+        VarClient.reset_pool()
+
+
+@pytest.mark.faults
+def test_ps_reject_nonfinite_raises_typed_at_sender(guard_flags):
+    from paddle_tpu.fluid.ps_rpc import VarClient
+    guard_flags("FLAGS_ps_reject_nonfinite", "reject")
+    ep, scope, th = _start_ps(sync_mode=False, fanin=1,
+                              seed_vars=[("w", np.zeros(2, np.float32))])
+    try:
+        cli = VarClient(ep)
+        with pytest.raises(core.NumericFaultError):
+            cli.send_var("w", np.array([np.nan, 1.0], np.float32))
+        # server state untouched, still serving
+        np.testing.assert_array_equal(np.asarray(cli.get_var("w")),
+                                      np.zeros(2, np.float32))
+        assert cli.call("stats")["health"]["rejected_calls"] == 1
+        cli.stop()
+        th.join(timeout=30)
+    finally:
+        VarClient.reset_pool()
+
+
+@pytest.mark.faults
+def test_ps_reject_batch_send_is_atomic(guard_flags):
+    """reject + a coalesced send_vars_batch whose SECOND entry is
+    poisoned: nothing from the batch may apply — the dedup cache
+    replays the error on retry, so a half-applied batch would be
+    unrecoverable."""
+    from paddle_tpu.fluid.ps_rpc import VarClient
+    guard_flags("FLAGS_ps_reject_nonfinite", "reject")
+    ep, scope, th = _start_ps(sync_mode=False, fanin=1,
+                              seed_vars=[("u", np.zeros(2, np.float32)),
+                                         ("w", np.zeros(2, np.float32))])
+    try:
+        cli = VarClient(ep)
+        with pytest.raises(core.NumericFaultError):
+            cli.call("send_vars_batch", trainer_id=0, vars=[
+                {"name": "u", "value": np.ones(2, np.float32)},
+                {"name": "w",
+                 "value": np.array([np.nan, 1.0], np.float32)}])
+        # the FIRST (clean) entry must not have applied either
+        np.testing.assert_array_equal(np.asarray(cli.get_var("u")),
+                                      np.zeros(2, np.float32))
+        np.testing.assert_array_equal(np.asarray(cli.get_var("w")),
+                                      np.zeros(2, np.float32))
+        cli.stop()
+        th.join(timeout=30)
+    finally:
+        VarClient.reset_pool()
+
+
+@pytest.mark.faults
+def test_ps_sync_poisoned_trainer_does_not_corrupt_agreement(
+        guard_flags):
+    """3-trainer sync round where trainer 1 pushes a poisoned sparse
+    grad AND a poisoned dense grad (via the faultinject push poisoner):
+    with drop mode the round completes deterministically and every
+    trainer pulls bit-identical state."""
+    from paddle_tpu.fluid.ps_rpc import VarClient
+    guard_flags("FLAGS_ps_reject_nonfinite", "drop")
+    table = np.ones((6, 2), np.float32)
+    ep, scope, th = _start_ps(sync_mode=True, fanin=3,
+                              seed_vars=[("emb", table.copy())])
+    pulls = {}
+    errs = []
+
+    def trainer_inline(tid):
+        try:
+            cli = VarClient(ep)
+            g = np.full((2, 2), float(tid + 1), np.float32)
+            if tid == 1:
+                g = faultinject.poison_array(g, "nan", index=0)
+            cli.send_var("emb@GRAD", g, trainer_id=tid, rows=[tid, 3],
+                         height=6)
+            cli.barrier("send", trainer_id=tid)
+            pulls[tid] = np.asarray(cli.get_var("emb", trainer_id=tid))
+        except Exception as e:
+            errs.append((tid, e))
+
+    try:
+        threads = [threading.Thread(target=trainer_inline, args=(t,))
+                   for t in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, errs
+        assert len(pulls) == 3
+        np.testing.assert_array_equal(pulls[0], pulls[1])
+        np.testing.assert_array_equal(pulls[1], pulls[2])
+        # tid 0: rows [0, 3] grads 1.0       (both finite)
+        # tid 1: rows [1, 3] grads 2.0, g[0] poisoned -> row 1 dropped
+        # tid 2: rows [2, 3] grads 3.0       (both finite)
+        # applied at sparse_lr 0.5 scaled by 1/fanin
+        want = table.copy()
+        want[0] -= 0.5 * (1.0 / 3) * 1.0
+        want[2] -= 0.5 * (1.0 / 3) * 3.0
+        want[3] -= 0.5 * (1.0 / 3) * (1.0 + 2.0 + 3.0)
+        np.testing.assert_allclose(pulls[0], want, rtol=0, atol=1e-6)
+        assert pulls[0][1].tolist() == table[1].tolist()  # dropped row
+        cli = VarClient(ep)
+        assert cli.call("stats")["health"]["dropped_sparse_rows"] == 1
+        cli.stop()
+        th.join(timeout=30)
+    finally:
+        VarClient.reset_pool()

@@ -3,6 +3,7 @@ tests/unittests/test_quantization_pass.py, test_fake_quantize_op.py,
 contrib/tests/test_image_classification_fp16.py,
 test_sync_batch_norm_op.py)."""
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import core
@@ -85,6 +86,296 @@ def test_amp_decorate_trains_bf16():
             first = first if first is not None else v
             last = v
     assert last < first
+
+
+def _amp_dyn_program(seed=3, incr_every=2, decr_every=1, white_list=None):
+    from paddle_tpu.fluid.contrib.mixed_precision import (
+        AutoMixedPrecisionLists, decorate)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data("x", shape=[8], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+        lists = AutoMixedPrecisionLists()
+        if white_list is not None:
+            lists.white_list = set(white_list)
+        opt = decorate(fluid.optimizer.SGD(0.1), amp_lists=lists,
+                       init_loss_scaling=8.0,
+                       incr_every_n_steps=incr_every,
+                       decr_every_n_nan_or_inf=decr_every,
+                       incr_ratio=2.0, decr_ratio=0.5, use_fp16=True)
+        opt.minimize(loss)
+    return main, startup, loss, opt
+
+
+def _amp_dyn_run(mode, inject_at=(2,), steps=6, **build_kw):
+    """(losses, scales) over ``steps`` with overflow injected at the
+    given step indices, executed under FLAGS_executor_mode=``mode``."""
+    saved = core.globals_["FLAGS_executor_mode"]
+    core.set_flag("FLAGS_executor_mode", mode)
+    try:
+        main, startup, loss, opt = _amp_dyn_program(**build_kw)
+        exe = fluid.Executor()
+        scope = core.Scope()
+        rng = np.random.RandomState(0)
+        X = rng.rand(16, 8).astype("float32")
+        Y = rng.randint(0, 4, (16, 1)).astype("int64")
+        Xbad = X.copy()
+        Xbad[0, 0] = np.inf
+        losses, scales = [], []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for i in range(steps):
+                (lv,) = exe.run(
+                    main, feed={"x": Xbad if i in inject_at else X,
+                                "y": Y}, fetch_list=[loss])
+                losses.append(np.asarray(lv).item())
+                scales.append(np.asarray(scope.find_var(
+                    opt._loss_scaling_var.name).get_tensor().array
+                    ).item())
+        return losses, scales, exe._last_run_mode
+    finally:
+        core.set_flag("FLAGS_executor_mode", saved)
+
+
+def test_amp_dynamic_scaling_compiled_halves_and_regrows():
+    """Closes the test_quant_amp gap: REAL dynamic loss scaling on the
+    fully compiled path — an injected overflow halves the scale
+    (decr_every_n_nan_or_inf=1, decr_ratio=0.5), incr_every_n_steps=2
+    clean steps regrow it (incr_ratio=2.0), and the overflowed step is
+    discarded whole (params revert via the fused guard select, which
+    the scaler shares its health scalar with)."""
+    losses, scales, mode = _amp_dyn_run("compiled")
+    assert mode == "compiled"
+    # steps:   0      1     2(bad)  3     4      5
+    # scale:  8->8  8->16  16->8   8->8  8->16  16->16
+    assert scales == [8.0, 16.0, 8.0, 8.0, 16.0, 16.0]
+    assert np.isnan(losses[2])
+    clean = losses[:2] + losses[3:]
+    assert np.isfinite(clean).all()
+
+
+def test_amp_dynamic_scaling_bit_identical_to_interpreter_oracle():
+    """The scale/counter transition and the step trajectory must be
+    BIT-identical between the compiled path and the interpreter oracle
+    — both consume the same fused health scalar and run the same
+    _amp_scale_update arithmetic. The white list is emptied so the
+    comparison isolates the scaler (bf16 cast folding differs across
+    XLA fusion boundaries by design and has its own parity test)."""
+    lc, sc, _ = _amp_dyn_run("compiled", white_list=())
+    li, si, _ = _amp_dyn_run("interpreted", white_list=())
+    assert sc == si
+    assert np.array_equal(np.asarray(lc), np.asarray(li), equal_nan=True)
+
+
+def test_amp_raise_replay_sees_pre_step_scale():
+    """raise-mode regression: the interpreter replay must run from the
+    EXACT pre-step loss scale. Here the overflow is caused by the scale
+    magnitude itself (grad = scale*x overflows fp32 at scale 4 but is
+    finite at the decayed scale 2), so if the tripped step's AMP decay
+    landed before the replay, the replay would run CLEAN at scale 2,
+    mis-report "the fault did not replay", and its phantom optimizer
+    update would corrupt the pre-step state the select kept."""
+    from paddle_tpu.fluid.contrib.mixed_precision import (
+        AutoMixedPrecisionLists, decorate)
+    saved = {k: core.globals_[k] for k in
+             ("FLAGS_check_nan_inf", "FLAGS_nan_inf_action")}
+    core.set_flag("FLAGS_check_nan_inf", True)
+    core.set_flag("FLAGS_nan_inf_action", "raise")
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.data("x", shape=[1], dtype="float32")
+            h = fluid.layers.fc(
+                x, 1, bias_attr=False,
+                param_attr=fluid.ParamAttr(
+                    name="amp_raise_w",
+                    initializer=fluid.initializer.Constant(0.1)))
+            loss = fluid.layers.mean(h)
+            lists = AutoMixedPrecisionLists()
+            lists.white_list = set()  # keep everything fp32
+            opt = decorate(fluid.optimizer.SGD(1e-4), amp_lists=lists,
+                           init_loss_scaling=4.0, incr_every_n_steps=1000,
+                           decr_every_n_nan_or_inf=1, incr_ratio=2.0,
+                           decr_ratio=0.5, use_fp16=True)
+            opt.minimize(loss)
+        exe = fluid.Executor()
+        scope = core.Scope()
+        # scaled grad_w = scale * x: 4e38 overflows fp32, 2e38 does not
+        X = np.full((1, 1), 1e38, np.float32)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            w0 = np.asarray(
+                scope.find_var("amp_raise_w").get_tensor().array).copy()
+            with pytest.raises(FloatingPointError) as ei:
+                exe.run(main, feed={"x": X}, fetch_list=[loss])
+            # op-level localization, not the non-reproduction fallback
+            assert "op #" in str(ei.value), ei.value
+            assert "did not replay" not in str(ei.value)
+            scale = np.asarray(scope.find_var(
+                opt._loss_scaling_var.name).get_tensor().array).item()
+            assert scale == 4.0  # pre-step scale preserved for the replay
+            w1 = np.asarray(
+                scope.find_var("amp_raise_w").get_tensor().array)
+            assert np.array_equal(w0, w1)  # no phantom-replay update
+    finally:
+        for k, v in saved.items():
+            core.set_flag(k, v)
+
+
+def test_amp_scale_floors_at_one_under_persistent_overflow():
+    """The decayed scale clamps at 1.0 (reference update_loss_scaling):
+    without the floor a persistent fault would underflow the fp32 scale
+    to exactly 0, where it sticks (0*incr==0) and the zeroed scaled
+    loss reads as healthy — a silent training freeze."""
+    losses, scales, _ = _amp_dyn_run(
+        "compiled", inject_at=set(range(8)), steps=8)
+    # 8 -> 4 -> 2 -> 1 -> 1 -> ... (decr_every=1, decr_ratio=0.5)
+    assert scales == [4.0, 2.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0]
+
+
+def test_amp_static_scaling_when_dynamic_disabled():
+    """decorate(use_fp16=True, use_dynamic_loss_scaling=False) must
+    apply STATIC scaling (loss*const, grads/const) — not silently drop
+    the requested init_loss_scaling. Scaling by a power of two is exact
+    in fp32, so the trajectory is bit-identical to an undecorated run."""
+    from paddle_tpu.fluid.contrib.mixed_precision import (
+        AutoMixedPrecisionLists, decorate)
+
+    def build(static_amp):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 9
+        with fluid.program_guard(main, startup), fluid.unique_name.guard():
+            x = fluid.data("x", shape=[8], dtype="float32")
+            y = fluid.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, 16, act="relu")
+            pred = fluid.layers.fc(h, 4, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+            opt = fluid.optimizer.SGD(0.1)
+            if static_amp:
+                lists = AutoMixedPrecisionLists()
+                lists.white_list = set()  # isolate the scaling machinery
+                opt = decorate(opt, amp_lists=lists,
+                               init_loss_scaling=1024.0,
+                               use_dynamic_loss_scaling=False,
+                               use_fp16=True)
+            opt.minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(16, 8).astype("float32")
+    Y = rng.randint(0, 4, (16, 1)).astype("int64")
+    out = {}
+    for static_amp in (False, True):
+        main, startup, loss = build(static_amp)
+        if static_amp:  # the static scaled-loss op made it into the graph
+            assert "scale" in [op.type for op in main.global_block().ops]
+        exe = fluid.Executor()
+        scope = core.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            out[static_amp] = [
+                np.asarray(exe.run(main, feed={"x": X, "y": Y},
+                                   fetch_list=[loss])[0]).item()
+                for _ in range(6)]
+    assert out[True] == out[False], (out[True], out[False])
+
+
+def test_amp_split_backward_apply_optimize_unscales():
+    """The reference split API (backward() then apply_optimize()) must
+    route through the wrapper's unscale — the inner optimizer's
+    apply_optimize would apply the still-scaled grads raw (a 2**15x
+    update that diverges on step 1 with every grad finite)."""
+    from paddle_tpu.fluid.contrib.mixed_precision import decorate
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 6
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.data("x", shape=[8], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+        opt = decorate(fluid.optimizer.SGD(0.1),
+                       init_loss_scaling=2.0 ** 15, use_fp16=True)
+        pg = opt.backward(loss)
+        opt.apply_optimize(loss, None, pg)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    X = rng.rand(16, 8).astype("float32")
+    Y = rng.randint(0, 4, (16, 1)).astype("int64")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [np.asarray(exe.run(main, feed={"x": X, "y": Y},
+                                     fetch_list=[loss])[0]).item()
+                  for _ in range(5)]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses  # scaled-raw grads would blow up
+
+
+def test_amp_epilogue_inert_on_forward_only_pruned_program():
+    """A clone/prune that slices the scaled-loss machinery away (eval
+    pruned to a forward fetch) must NOT keep running the scale
+    epilogue: eval steps would silently inflate the shared training
+    scale and good/bad counters."""
+    main, startup, loss, opt = _amp_dyn_program(incr_every=1)
+    # forward-only eval program: prune to the softmax, whose slice
+    # contains no grad/scale ops
+    pred_name = [op for op in main.global_block().ops
+                 if op.type == "softmax"][0].output_arg_names[0]
+    eval_prog = main._prune([pred_name])
+    types = [op.type for op in eval_prog.global_block().ops]
+    assert "elementwise_mul" not in types  # scaled-loss op sliced away
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    X = rng.rand(16, 8).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        scale_name = opt._loss_scaling_var.name
+        before = np.asarray(
+            scope.find_var(scale_name).get_tensor().array).item()
+        for _ in range(3):  # incr_every=1: any epilogue run would x2
+            exe.run(eval_prog, feed={"x": X}, fetch_list=[pred_name])
+        after = np.asarray(
+            scope.find_var(scale_name).get_tensor().array).item()
+    assert after == before, (before, after)
+
+
+def test_amp_dynamic_state_survives_program_clone():
+    """Program.clone() must carry _amp_dynamic (CompiledProgram
+    build-strategy re-apply, transpiled trainer programs): the clone
+    keeps the scaled-loss and unscale ops, so losing the state dict
+    would silently freeze the scale and stop discarding overflowed
+    steps. A CLONED full training program must halve/regrow exactly
+    like the original; a backward slice that drops the scale-consuming
+    ops instead deactivates the epilogue (see the forward-only test
+    below)."""
+    main, startup, loss, opt = _amp_dyn_program()
+    cloned = main.clone()
+    assert getattr(cloned, "_amp_dynamic", None) == main._amp_dynamic
+    assert getattr(main._prune([loss.name]), "_amp_dynamic", None) \
+        == main._amp_dynamic  # the dict rides every clone; activation
+    #                           is decided per-block by who reads scale
+    exe = fluid.Executor()
+    scope = core.Scope()
+    rng = np.random.RandomState(0)
+    X = rng.rand(16, 8).astype("float32")
+    Y = rng.randint(0, 4, (16, 1)).astype("int64")
+    Xbad = X.copy()
+    Xbad[0, 0] = np.inf
+    scales = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i in range(4):
+            exe.run(cloned, feed={"x": Xbad if i == 2 else X, "y": Y},
+                    fetch_list=[loss.name])
+            scales.append(np.asarray(scope.find_var(
+                opt._loss_scaling_var.name).get_tensor().array).item())
+    assert scales == [8.0, 16.0, 8.0, 8.0], scales
 
 
 def test_sync_batch_norm_same_as_batch_norm_single_chip():

@@ -199,6 +199,10 @@ def test_checkpoint_notify_empty_epmap_noop():
 INTEGRATION_COVERED = {
     "feed": ("test_every_registered_op_is_used_structurally",
              "driven by every Executor.run feed in the whole suite"),
+    "isnan": ("test_has_nan_has_inf_distinct",
+              "layers.has_nan parity probes, tests/test_numeric_faults.py"),
+    "isinf": ("test_has_nan_has_inf_distinct",
+              "layers.has_inf parity probes, tests/test_numeric_faults.py"),
     "prefetch": ("test_ps_billion_param_lazy_sparse_table",
                  "sparse distributed embedding path, test_dist_ps.py "
                  "(server handler prefetch_rows)"),
