@@ -45,6 +45,12 @@ class Communicator:
         self._queues: Dict[Tuple[str, str], "queue.Queue"] = {}
         self._threads: list = []
         self._lock = threading.Lock()
+        # per-(var, endpoint) first-transport-failure time: merged grads
+        # REQUEUE during an endpoint outage (a failover promotes the
+        # replica within ~2× the heartbeat timeout and the slot resolves
+        # there) and only drop once FLAGS_ps_failover_deadline passed —
+        # the pre-elastic behavior silently lost the round's grads
+        self._fail_since: Dict[Tuple[str, str], float] = {}
 
     # ---------------------------------------------------------- lifecycle
     def start(self):
@@ -107,15 +113,14 @@ class Communicator:
                 self._threads.append(t)
         q.put(np.asarray(value))
 
-    def _send_merged(self, name, ep, merged, trainer_id) -> bool:
-        """Ship one merged grad; ANY failure — transport failure past
-        the RPC plane's own retries, or a server-side rejection — DROPS
-        it with a warning instead of killing the merge thread
-        (async/GEO semantics tolerate a lost delta — a dead thread
-        would silently pin the queue and every later grad)."""
+    def _send_merged(self, name, ep, merged, trainer_id) -> str:
+        """Ship one merged grad; a failure warns instead of killing the
+        merge thread (a dead thread would silently pin the queue and
+        every later grad). Returns _send_batch's "ok"/"retry"/"drop" —
+        the stop()-time flush ignores it (no requeue while stopping)."""
         return self._send_batch(ep, [(name, merged)], trainer_id)
 
-    def _send_batch(self, ep, items, trainer_id) -> bool:
+    def _send_batch(self, ep, items, trainer_id) -> str:
         """Ship one coalesced flush: a single-var batch goes out as the
         plain ``send_var`` every server understands; multiple vars for
         the same endpoint ride ONE ``send_vars_batch`` RPC (the server
@@ -123,8 +128,12 @@ class Communicator:
         dedup token covers all of it). An OLD server without the batch
         method falls back to per-var sends (ps_rpc.send_vars_batch —
         only on "no method", when nothing was applied; a PARTIALLY
-        applied batch must not be re-sent per-var). Other failures
-        drop-with-warning like _send_merged."""
+        applied batch must not be re-sent per-var).
+
+        Returns "ok" | "retry" (transport failure — the endpoint may be
+        failing over to a promoted replica, requeue) | "drop" (the
+        server REJECTED the content; re-sending the same grads would
+        just be rejected again)."""
         from .ps_rpc import VarClient, send_vars_batch
         names = [n for n, _ in items]
         try:
@@ -134,17 +143,28 @@ class Communicator:
             else:
                 send_vars_batch(VarClient.of(ep), items,
                                 trainer_id=trainer_id)
-            return True
+            return "ok"
         except (ConnectionError, OSError) as e:
             _LOG.warning(
-                "Communicator: dropping merged grads %s for %s — "
+                "Communicator: merged grads %s for %s undeliverable — "
                 "endpoint unreachable after RPC retries (%r)", names, ep, e)
-            return False
+            return "retry"
+        except core.StaleClusterViewError as e:
+            # the call's re-route budget ran out while membership was
+            # still converging (a drain racing a failover) — NOT a
+            # content rejection: the views settle moments later, so
+            # requeue like a transport outage instead of silently
+            # losing the round's merged grads
+            _LOG.warning(
+                "Communicator: merged grads %s for %s caught a "
+                "stale-view convergence window (%r) — requeueing",
+                names, ep, e)
+            return "retry"
         except Exception as e:  # noqa: BLE001 — server-side rejection
             _LOG.warning(
                 "Communicator: dropping merged grads %s for %s — "
                 "server rejected them (%r)", names, ep, e)
-            return False
+            return "drop"
 
     def _drain(self, key, trainer_id=0):
         name, ep = key
@@ -204,7 +224,37 @@ class Communicator:
                     other = self._drain_nowait(k)
                     if other is not None:
                         batch.append((k[0], other))
-            self._send_batch(ep, batch, trainer_id)
+            outcome = self._send_batch(ep, batch, trainer_id)
+            if outcome == "retry" and self._running:
+                # endpoint outage (possibly a failover in progress):
+                # requeue every merged grad onto its own queue — the
+                # NEXT flush re-resolves the slot and reaches the
+                # promoted replica. Give up only past the failover
+                # deadline; a permanently dead endpoint must not spin
+                # the thread and pin stale grads forever.
+                import time as _time
+                now = _time.time()
+                first = self._fail_since.setdefault(key, now)
+                limit = float(core.globals_["FLAGS_ps_failover_deadline"])
+                if now - first <= limit:
+                    for n, v in batch:
+                        self.push(n, v, ep, trainer_id=trainer_id)
+                    # breathe: don't hot-loop against a dead endpoint
+                    threading.Event().wait(self._wait_times * 10)
+                else:
+                    _LOG.warning(
+                        "Communicator: giving up on %s after %.0fs of "
+                        "transport failures — dropping %d merged "
+                        "grad(s)", ep, now - first,
+                        len(batch))
+                    self._fail_since.pop(key, None)
+            else:
+                # "ok" AND "drop" both end the outage streak ("drop" =
+                # the server was reachable and rejected): a stale
+                # first-failure stamp would make a later unrelated
+                # outage give up on its first "retry" instead of
+                # requeueing through the failover window
+                self._fail_since.pop(key, None)
 
     def recv(self):
         pass

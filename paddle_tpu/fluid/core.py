@@ -36,6 +36,7 @@ __all__ = [
     "dtype_to_np", "np_to_dtype", "dtype_to_jnp", "is_float_dtype",
     "is_compiled_with_tpu", "EOFException", "WorkerDeadError",
     "RpcProtocolError", "CheckpointError", "NumericFaultError",
+    "StaleClusterViewError",
 ]
 
 
@@ -66,6 +67,22 @@ class CheckpointError(RuntimeError):
     """A checkpoint directory failed validation (missing manifest,
     missing files, size/CRC mismatches) or load_vars found missing
     files. The message aggregates EVERY bad file, not just the first."""
+
+
+class StaleClusterViewError(RuntimeError):
+    """A PS data RPC reached a server that no longer owns the shard —
+    the pserver drained/handed its state off (or is a standby that has
+    not been promoted), and the client's ClusterView is stale. Carries
+    the server's current view as a plain dict in ``view_dict`` (None
+    when the server itself has no newer view, e.g. an unpromoted
+    standby); the RPC client installs it and replays the SAME encoded
+    frame — same dedup token — against the new owner, so exactly-once
+    application survives the re-route (docs/FAULT_TOLERANCE.md
+    "Elastic membership")."""
+
+    def __init__(self, msg: str, view=None):
+        super().__init__(msg)
+        self.view_dict = view
 
 
 class NumericFaultError(FloatingPointError):
@@ -538,6 +555,47 @@ class LazyEmbeddingTable:
             s = self._slot_of_bounded(r)  # FIRST: may grow/replace _data
             self._data[s] -= step[i]
 
+    # -- handoff (elastic membership, docs/FAULT_TOLERANCE.md) ------------
+    def export_state(self):
+        """Snapshot for a CRC-manifested shard handoff: (meta, ids,
+        rows). ``ids`` lists materialized row ids in LRU order (oldest
+        first — OrderedDict insertion order IS the eviction order) and
+        ``rows`` their current values, so ``import_state`` on the
+        destination rebuilds a bit-identical table INCLUDING future
+        eviction decisions. Never-touched rows don't ship: they
+        re-materialize from the same deterministic per-row init."""
+        n = len(self._index)
+        ids = np.fromiter(self._index.keys(), np.int64, n)
+        slots = np.fromiter(self._index.values(), np.int64, n)
+        rows = (self._data[slots] if n
+                else np.empty((0, self.dim), self.dtype))
+        meta = {"height": self.height, "dim": self.dim, "seed": self.seed,
+                "scale": self.scale, "max_rows": self.max_rows,
+                "dtype": self.dtype.str, "evictions": self.evictions}
+        return meta, ids, np.ascontiguousarray(rows)
+
+    @classmethod
+    def from_state(cls, meta, ids, rows) -> "LazyEmbeddingTable":
+        tbl = cls(height=int(meta["height"]), dim=int(meta["dim"]),
+                  seed=int(meta["seed"]), scale=float(meta["scale"]),
+                  max_rows=meta.get("max_rows"),
+                  dtype=np.dtype(meta["dtype"]))
+        tbl.import_state(ids, rows)
+        tbl.evictions = int(meta.get("evictions", 0))
+        return tbl
+
+    def import_state(self, ids, rows) -> None:
+        """Install a handoff snapshot wholesale (replaces any current
+        content). Rows land compacted in the given order, which
+        ``export_state`` guarantees is the source's LRU order."""
+        from collections import OrderedDict
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        rows = np.asarray(rows, self.dtype).reshape(len(ids), self.dim)
+        self._index = OrderedDict(
+            (int(r), i) for i, r in enumerate(ids.tolist()))
+        self._data = np.array(rows, self.dtype, copy=True)
+        self._free = []
+
     # -- introspection ----------------------------------------------------
     def touched_rows(self) -> int:
         return len(self._index)
@@ -706,6 +764,21 @@ class _GlobalFlags:
         # sending trainer). Trip counters ride the built-in "stats"
         # RPC under the "health" key.
         "FLAGS_ps_reject_nonfinite": "",
+        # elastic PS membership plane (docs/FAULT_TOLERANCE.md "Elastic
+        # membership"): replica count per pserver slot — 2 means every
+        # applied update chain-forwards to a warm standby that the
+        # dead-primary listener promotes, so trainers fail over instead
+        # of aborting with WorkerDeadError. 1 (default) = no replication.
+        "FLAGS_ps_replicas": 1,
+        # how long a client-side sender (Communicator requeue, failover
+        # reconnects) keeps retrying toward a slot whose primary is
+        # unreachable before giving up, in seconds — covers the
+        # promotion window (~2× the heartbeat timeout) with slack
+        "FLAGS_ps_failover_deadline": 60.0,
+        # drain: how long the source pserver waits for the in-flight
+        # sync round to quiesce (pending grads applied, barrier empty)
+        # before aborting the drain with the source still serving
+        "FLAGS_ps_drain_quiesce_deadline": 60.0,
         "FLAGS_cpu_deterministic": False,
         "FLAGS_benchmark": False,
         "FLAGS_eager_delete_tensor_gb": 0.0,

@@ -34,6 +34,7 @@ __all__ = [
     "load_inference_model", "save", "load",
     "save_checkpoint", "load_checkpoint", "latest_checkpoint",
     "validate_checkpoint", "rollback_to_latest",
+    "build_handoff_manifest", "check_handoff_section",
 ]
 
 _LOG = logging.getLogger("paddle_tpu.io")
@@ -517,6 +518,66 @@ def rollback_to_latest(executor, dirname, main_program=None, scope=None
                                main_program=main_program, scope=scope)
     except core.CheckpointError:
         return None
+
+
+
+# --------------------------------------------------------------------------
+# Elastic-membership shard handoff manifests (docs/FAULT_TOLERANCE.md
+# "Elastic membership"). Same per-blob integrity record as the checkpoint
+# MANIFEST above ({"crc32", "size"} per section), but the sections travel
+# over the PS binary wire instead of through the filesystem: the draining
+# pserver streams each section to the destination, which validates it
+# against this manifest BEFORE anything is installed — a corrupted handoff
+# is rejected wholesale and the drain aborts with the source still serving.
+# --------------------------------------------------------------------------
+HANDOFF_FORMAT_VERSION = 1
+
+
+def build_handoff_manifest(slot: str, epoch_next: int, view_next,
+                           sections: Dict[str, Dict[str, Any]],
+                           dedup_hwms=None, extra=None) -> Dict[str, Any]:
+    """Manifest for one shard handoff. ``sections`` maps section name →
+    {"kind": ..., "bytes": <payload>, "meta": {...}}; the payload itself
+    is NOT embedded — only its crc32/size, checkpoint-manifest style."""
+    files = {}
+    for name, sec in sections.items():
+        blob = sec["bytes"]
+        files[name] = {"crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+                       "size": len(blob), "kind": sec.get("kind", "raw"),
+                       "meta": sec.get("meta") or {}}
+    return {
+        "format_version": HANDOFF_FORMAT_VERSION,
+        "slot": slot,
+        "epoch_next": int(epoch_next),
+        "view_next": view_next,
+        "sections": files,
+        "dedup_hwms": dict(dedup_hwms or {}),
+        "extra": extra,
+    }
+
+
+def check_handoff_section(manifest: Dict[str, Any], name: str,
+                          payload: bytes) -> Dict[str, Any]:
+    """Validate one streamed section against the handoff manifest.
+    Returns the section's manifest entry; raises ``core.CheckpointError``
+    (the same rejection type torn checkpoints use) on an undeclared
+    section, size mismatch, or CRC mismatch."""
+    entry = (manifest or {}).get("sections", {}).get(name)
+    problems = []
+    if entry is None:
+        raise core.CheckpointError(
+            f"handoff section '{name}' not declared in the manifest — "
+            f"source/destination desynchronized")
+    if len(payload) != int(entry["size"]):
+        problems.append(
+            f"size {len(payload)} != manifest {entry['size']} (truncated)")
+    elif (zlib.crc32(payload) & 0xFFFFFFFF) != int(entry["crc32"]):
+        problems.append("CRC mismatch (corrupted in flight)")
+    if problems:
+        raise core.CheckpointError(
+            f"handoff section '{name}' failed validation: "
+            + "; ".join(problems))
+    return entry
 
 
 def load_checkpoint(executor, path, main_program=None, scope=None

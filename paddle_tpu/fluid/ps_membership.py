@@ -1,0 +1,430 @@
+"""Elastic PS membership plane — epoch-stamped cluster views, live
+pserver drain/rejoin, replica failover (docs/FAULT_TOLERANCE.md
+"Elastic membership").
+
+The transpiler's static shard map (pserver endpoint list + round-robin
+param placement) becomes a versioned ``ClusterView``: slot i is named by
+its epoch-0 endpoint forever, and the view maps each slot to the
+endpoint CURRENTLY serving it (plus warm replicas). Programs keep slot
+endpoints baked into their op attrs; the RPC client resolves a slot to
+its current server at connect time, so membership changes never touch a
+compiled program.
+
+Three moving parts:
+
+  * client side — a process-global view registry (``install_view`` /
+    ``resolve``). ``VarClient`` resolves through it on every (re)connect
+    and installs newer views shipped back in typed
+    ``StaleClusterViewError`` responses, then replays the SAME encoded
+    frame — same dedup token — against the new owner (exactly-once
+    survives the re-route). During an outage ``refresh_view_for`` polls
+    the slot's replicas for a newer view (the promotion path).
+
+  * server side — ``MembershipPlane`` holds one pserver's state machine
+    (ACTIVE → DRAINING → DRAINED for a drain; STANDBY → ACTIVE for a
+    join/promotion) and answers the data-plane guard: a server that no
+    longer owns its shard raises ``StaleClusterViewError`` carrying its
+    current view instead of silently serving stale parameters.
+
+  * the drain protocol itself lives in ``ops/distributed_ops.py``
+    (listen_and_serv owns the scope, grad lock, and barriers); this
+    module only keeps the pieces both sides share.
+
+Reference analogue: the PSLib stack's fixed pserver set (SURVEY
+§distributed) has no such plane — a resize is a full restart from
+checkpoint. Here the PR 3 barrier/dedup primitives plus the PR 4 binary
+wire make the resharding epoch a between-rounds view flip.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_LOG = logging.getLogger("paddle_tpu.ps")
+
+# membership states a pserver slot-server moves through
+ACTIVE = "active"        # owns its shard, serves data RPCs
+STANDBY = "standby"      # warm spare: accepts handoffs/forwards only
+DRAINING = "draining"    # handoff in progress; still the owner
+DRAINED = "drained"      # handed off; answers StaleClusterViewError
+
+
+class ClusterView:
+    """Epoch-stamped slot → endpoint map. A slot is named by its
+    epoch-0 endpoint (what the transpiler baked into the programs);
+    ``resolve`` returns the endpoint currently serving it. Immutable:
+    membership changes mint a NEW view with a bumped epoch."""
+
+    __slots__ = ("epoch", "slots")
+
+    def __init__(self, slots: Dict[str, Dict[str, Any]], epoch: int = 0):
+        # slots: {slot_ep: {"primary": ep, "replicas": [ep, ...]}}
+        self.epoch = int(epoch)
+        self.slots = {
+            s: {"primary": str(e.get("primary") or s),
+                "replicas": [str(r) for r in (e.get("replicas") or [])]}
+            for s, e in slots.items()}
+
+    # ------------------------------------------------------------ builders
+    @classmethod
+    def initial(cls, endpoints: List[str],
+                replica_map: Optional[Dict[str, str]] = None
+                ) -> "ClusterView":
+        """Epoch-0 view: every slot serves itself. ``replica_map``
+        (slot → replica endpoint) defaults to the
+        ``PADDLE_PS_REPLICA_MAP`` env var ("slot=replica,..."), the one
+        source both trainers and pservers read so every process starts
+        from the same view."""
+        if replica_map is None:
+            replica_map = parse_replica_map_env()
+        slots = {}
+        for ep in endpoints:
+            ep = str(ep)
+            reps = [replica_map[ep]] if ep in replica_map else []
+            slots[ep] = {"primary": ep, "replicas": reps}
+        return cls(slots, epoch=0)
+
+    def moved(self, slot: str, new_primary: str,
+              epoch: Optional[int] = None) -> "ClusterView":
+        """New view with ``slot`` served by ``new_primary`` (a committed
+        drain, or a replica promotion). The new primary is removed from
+        the slot's replica list; the OLD primary does not become a
+        replica (it drained or died — a rejoin is a fresh standby).
+        ``epoch`` overrides the default self.epoch+1 — minting servers
+        must clear the cluster-wide floor their MembershipPlane tracks,
+        not just their own view's epoch."""
+        slots = {s: {"primary": e["primary"],
+                     "replicas": list(e["replicas"])}
+                 for s, e in self.slots.items()}
+        if slot not in slots:
+            raise KeyError(f"unknown pserver slot {slot!r}")
+        slots[slot]["primary"] = str(new_primary)
+        slots[slot]["replicas"] = [r for r in slots[slot]["replicas"]
+                                   if r != str(new_primary)]
+        return ClusterView(
+            slots, epoch=(self.epoch + 1 if epoch is None else int(epoch)))
+
+    # ------------------------------------------------------------- queries
+    def resolve(self, ep: str) -> str:
+        """Current server for ``ep``; endpoints that aren't slot names
+        (replicas, handoff destinations, raw test servers) pass
+        through unchanged."""
+        entry = self.slots.get(ep)
+        return entry["primary"] if entry is not None else ep
+
+    def replicas(self, slot: str) -> List[str]:
+        entry = self.slots.get(slot)
+        return list(entry["replicas"]) if entry is not None else []
+
+    def endpoints(self) -> List[str]:
+        """Every currently-serving primary, slot order preserved."""
+        return [e["primary"] for e in self.slots.values()]
+
+    # --------------------------------------------------------------- wire
+    def to_dict(self) -> Dict[str, Any]:
+        return {"epoch": self.epoch,
+                "slots": {s: {"primary": e["primary"],
+                              "replicas": list(e["replicas"])}
+                          for s, e in self.slots.items()}}
+
+    @classmethod
+    def from_dict(cls, d) -> "ClusterView":
+        return cls(d.get("slots") or {}, epoch=int(d.get("epoch", 0)))
+
+    def __repr__(self):
+        parts = ", ".join(
+            f"{s}→{e['primary']}" + (f"+{len(e['replicas'])}r"
+                                     if e["replicas"] else "")
+            for s, e in self.slots.items())
+        return f"ClusterView(epoch={self.epoch}, {parts})"
+
+
+def parse_replica_map_env() -> Dict[str, str]:
+    """PADDLE_PS_REPLICA_MAP="slot_ep=replica_ep,slot2=replica2"."""
+    raw = os.environ.get("PADDLE_PS_REPLICA_MAP", "")
+    out: Dict[str, str] = {}
+    for pair in raw.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        if "=" not in pair:
+            raise ValueError(
+                f"PADDLE_PS_REPLICA_MAP entry {pair!r} is not "
+                f"'slot_ep=replica_ep'")
+        slot, rep = pair.split("=", 1)
+        out[slot.strip()] = rep.strip()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# process-global view registry (client side)
+# ---------------------------------------------------------------------------
+_view_lock = threading.Lock()
+_current_view: Optional[ClusterView] = None
+# refresh_view_for rate limiter: slot -> last probe time
+_refresh_at: Dict[str, float] = {}
+_REFRESH_INTERVAL = 0.25
+
+
+def install_view(view) -> Optional[ClusterView]:
+    """Install a (possibly newer) view process-wide. Accepts a
+    ClusterView or its dict form; epochs are MONOTONIC — an older or
+    equal epoch never replaces a newer one (a late stale-error from a
+    long-dead server can't roll the process back). Returns the view now
+    in force."""
+    global _current_view
+    if view is None:
+        return _current_view
+    if not isinstance(view, ClusterView):
+        view = ClusterView.from_dict(view)
+    with _view_lock:
+        if _current_view is None or view.epoch > _current_view.epoch:
+            if _current_view is not None and \
+                    view.epoch > _current_view.epoch:
+                _LOG.info("cluster view updated: epoch %d -> %d (%r)",
+                          _current_view.epoch, view.epoch, view)
+            _current_view = view
+        return _current_view
+
+
+def current_view() -> Optional[ClusterView]:
+    with _view_lock:
+        return _current_view
+
+
+def current_epoch() -> Optional[int]:
+    v = current_view()
+    return None if v is None else v.epoch
+
+
+def resolve(ep: str) -> str:
+    v = current_view()
+    return ep if v is None else v.resolve(ep)
+
+
+def reset_views() -> None:
+    """Drop the process view (tests)."""
+    global _current_view
+    with _view_lock:
+        _current_view = None
+        _refresh_at.clear()
+
+
+def refresh_view_for(slot: str) -> bool:
+    """Failover probe: ask ``slot``'s replicas for their view and
+    install any newer one (a promoted replica answers with the epoch it
+    minted at promotion). Called from the RPC client's reconnect poll
+    while the slot's primary is unreachable; rate-limited so the poll
+    loop doesn't hammer the standby. Returns True when a newer view was
+    installed."""
+    view = current_view()
+    if view is None:
+        return False
+    now = time.time()
+    with _view_lock:
+        if now - _refresh_at.get(slot, 0.0) < _REFRESH_INTERVAL:
+            return False
+        _refresh_at[slot] = now
+    candidates = view.replicas(slot)
+    before = view.epoch
+    for ep in candidates:
+        try:
+            from .ps_rpc import VarClient
+            cli = VarClient(ep, connect_timeout=1.0, channels=1,
+                            resolve=False)
+            try:
+                got = cli.call("get_view", _rpc_timeout=2.0,
+                               _rpc_retries=0)
+            finally:
+                cli.close()
+        except Exception:  # standby down/unreachable — try the next one
+            continue
+        if got:
+            installed = install_view(got)
+            if installed is not None and installed.epoch > before:
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# server side
+# ---------------------------------------------------------------------------
+# data-plane methods that carry the client's view epoch and are refused
+# (typed StaleClusterViewError) by a server that no longer owns its shard
+DATA_METHODS = frozenset({
+    "send_var", "send_vars_batch", "get_var", "get_vars_batch",
+    "prefetch_rows", "barrier", "geo_delta", "table_stats",
+})
+
+# test hook (tests/faultinject.py corrupt_handoff): maps a section's
+# payload bytes just before they leave the draining source — AFTER the
+# manifest CRCs were stamped — so the destination's validation must
+# catch the corruption
+_corrupt_section_hook = None
+
+
+class MembershipPlane:
+    """One pserver's membership state machine + counters. Owned by the
+    listen_and_serv op; the VarServer consults ``pre_dispatch`` before
+    every data RPC, and write handlers re-check ``check_serving`` under
+    the grad lock (the race-free guard a drain commit relies on)."""
+
+    def __init__(self, slot: str, bind: str, view: ClusterView,
+                 state: str = ACTIVE, replica_of: str = ""):
+        self.slot = slot
+        self.bind = bind
+        self.state = state
+        self.view = view
+        self.replica_of = replica_of
+        # highest view epoch this server has SEEN anywhere — its own
+        # view, client gossip (``_view``/``_view_epoch`` on data RPCs),
+        # primary→replica forwards, get_view probes. Epochs are minted
+        # by different servers (each drain source, each promoting
+        # replica), so every locally minted epoch must clear this floor
+        # or monotonic clients would reject it and never re-route.
+        self._max_seen = view.epoch if view is not None else 0
+        self.promotions = 0
+        self.demotions = 0
+        self.handoff = {"bytes": 0, "sections_done": 0,
+                        "total_sections": 0, "in_progress": False,
+                        "aborts": 0, "completed": 0}
+        self.replication = {"forwarded_calls": 0, "forward_failures": 0}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- guards
+    def serving(self) -> bool:
+        return self.state in (ACTIVE, DRAINING)
+
+    def stale_error(self) -> Any:
+        from . import core
+        v = self.view
+        return core.StaleClusterViewError(
+            f"pserver slot {self.slot!r} is {self.state} at {self.bind} "
+            f"— shard served by "
+            f"{v.resolve(self.slot) if v else 'unknown'} "
+            f"(view epoch {v.epoch if v else '?'})",
+            view=None if v is None else v.to_dict())
+
+    def pre_dispatch(self, method: str, epoch, view=None) -> None:
+        """VarServer hook, called before dispatching any method carrying
+        (or eligible to carry) a view epoch. Absorbs the client's view
+        gossip FIRST (even from a call about to be refused — a stale
+        server still learns), then guards. Replays from the dedup
+        cache are exempt one layer up — a retry of an already-applied
+        call must replay even on a drained server."""
+        if epoch is not None or view is not None:
+            self.note_gossip(epoch=epoch, view=view)
+        if method in DATA_METHODS and not self.serving():
+            _LOG.info("membership: refusing %s on %s (state=%s, "
+                      "view epoch %s, client epoch %s)", method,
+                      self.bind, self.state,
+                      None if self.view is None else self.view.epoch,
+                      epoch)
+            raise self.stale_error()
+
+    def check_serving(self) -> None:
+        """Under-the-grad-lock write guard: the drain commit flips
+        ``state`` to DRAINED while holding that lock, so a write that
+        passed ``pre_dispatch`` but lost the race to the handoff is
+        refused HERE instead of mutating a shard that already moved.
+        DRAINING still serves: the drain QUIESCES by waiting for the
+        in-flight round to complete — refusing its writes would
+        deadlock the round it is waiting on."""
+        if not self.serving():
+            raise self.stale_error()
+
+    # ------------------------------------------------------------ changes
+    def note_gossip(self, epoch=None, view=None) -> None:
+        """Absorb membership gossip: a FULL view (client ``_view``
+        stamps on data RPCs, primary→replica forwards/beats) installs
+        when newer; a bare epoch number (``_view_epoch``) only raises
+        the minting floor. Without this, a replica that never saw the
+        epochs other slots' drains minted would promote at an epoch
+        monotonic clients reject — and they would never re-route.
+
+        Fencing: when the absorbed view is NEWER and maps this slot to
+        a DIFFERENT endpoint while we think we are ACTIVE, someone else
+        was legitimately made the owner (a false-positive promotion
+        after a GC pause / partition that has since healed) — serving
+        on would split the shard, so step down to STANDBY and answer
+        data RPCs with the newer view from here on."""
+        if view is not None:
+            self.install(view)
+            with self._lock:
+                v = self.view
+                if (self.state == ACTIVE and v is not None
+                        and v.resolve(self.slot) != self.bind):
+                    self.state = STANDBY
+                    self.demotions += 1
+                    _LOG.warning(
+                        "membership: %s DEMOTED — a newer view (epoch "
+                        "%d) maps slot %s to %s; this server was "
+                        "presumed dead and replaced. Serving on would "
+                        "fork the shard; stepping down to standby.",
+                        self.bind, v.epoch, self.slot,
+                        v.resolve(self.slot))
+        if epoch is not None:
+            with self._lock:
+                if int(epoch) > self._max_seen:
+                    self._max_seen = int(epoch)
+
+    def install(self, view) -> ClusterView:
+        if not isinstance(view, ClusterView):
+            view = ClusterView.from_dict(view)
+        with self._lock:
+            if view.epoch > self._max_seen:
+                self._max_seen = view.epoch
+            if self.view is None or view.epoch > self.view.epoch:
+                self.view = view
+        install_view(view)  # keep the process registry in step
+        return self.view
+
+    def mint_moved(self, slot: str, new_primary: str) -> ClusterView:
+        """Mint the drain-commit view: ``slot`` → ``new_primary`` at an
+        epoch above BOTH this server's own view and every epoch gossip
+        has shown it (two successive drains of different slots each
+        mint on a different server — without the shared floor the
+        second would re-mint an epoch clients already hold)."""
+        with self._lock:
+            base = self.view
+            return base.moved(slot, new_primary,
+                              epoch=max(base.epoch, self._max_seen) + 1)
+
+    def promote(self) -> Optional[ClusterView]:
+        """Replica → primary (dead-primary listener). Mints the new
+        view locally — slot served by this server's bind endpoint, at
+        an epoch clearing the gossip floor — and installs it. Returns
+        the new view (None when not a standby)."""
+        with self._lock:
+            if self.state != STANDBY:
+                return None
+            self.state = ACTIVE
+            self.promotions += 1
+            base = self.view or ClusterView.initial([self.slot], {})
+            floor = max(base.epoch, self._max_seen)
+            self.view = base.moved(self.slot, self.bind, epoch=floor + 1)
+            self._max_seen = floor + 1
+        install_view(self.view)
+        _LOG.warning(
+            "membership: replica %s PROMOTED to primary for slot %s "
+            "(view epoch %d)", self.bind, self.slot, self.view.epoch)
+        return self.view
+
+    # -------------------------------------------------------------- stats
+    def stats_section(self) -> Dict[str, Any]:
+        v = self.view
+        return {"membership": {
+            "slot": self.slot,
+            "bind": self.bind,
+            "state": self.state,
+            "epoch": None if v is None else v.epoch,
+            "shards_owned": ([self.slot] if self.state == ACTIVE else []),
+            "replica_of": self.replica_of or None,
+            "promotions": self.promotions,
+            "demotions": self.demotions,
+            "handoff": dict(self.handoff),
+            "replication": dict(self.replication),
+        }}

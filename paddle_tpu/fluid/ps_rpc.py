@@ -38,6 +38,18 @@ Fault tolerance (docs/FAULT_TOLERANCE.md):
   * ``BarrierManager`` + ``HeartBeatMonitor``: barriers release with
     ``WorkerDeadError`` as soon as a participant is declared dead instead
     of blocking for the full FLAGS_barrier_deadline.
+
+Elastic membership (docs/FAULT_TOLERANCE.md "Elastic membership"):
+  * Programs bake SLOT endpoints into their op attrs; ``VarClient``
+    resolves a slot to the endpoint currently serving it through the
+    process-global ``ps_membership`` view on every (re)connect, stamps
+    data RPCs with the client's view epoch, and — on a typed
+    ``StaleClusterViewError`` response — installs the newer view the
+    server shipped back and replays the SAME cached frame (same dedup
+    token) against the new owner. Exactly-once survives both re-routes
+    and replica failovers: a drained server transfers its dedup
+    high-water marks to the destination, which answers replayed tokens
+    below the mark without re-executing.
 """
 from __future__ import annotations
 
@@ -56,6 +68,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from . import core
+from . import ps_membership
 
 _LEN = struct.Struct(">Q")
 
@@ -228,6 +241,33 @@ def _send_msg(sock: socket.socket, obj) -> None:
     _send_parts(sock, _encode_frame(obj, PROTO_PICKLE)[0])
 
 
+# per-request context: the dedup token and serving VarServer of the call
+# the CURRENT handler thread is executing — lets deep handler code
+# (listen_and_serv's apply path) mark a token as applied for the dedup
+# high-water mark without threading it through every signature
+_REQUEST = threading.local()
+
+
+def request_dedup_token():
+    """Dedup token of the in-flight request on THIS handler thread
+    (None outside a VarServer dispatch or for idempotent calls)."""
+    return getattr(_REQUEST, "token", None)
+
+
+def note_request_token_applied() -> None:
+    """Record that the current request's state mutation has been applied
+    — bumps the serving VarServer's per-prefix dedup high-water mark.
+    Called by write handlers UNDER the grad lock, so a shard handoff
+    (which snapshots the marks under the same lock) sees exactly the
+    applies that are part of the transferred state: a replayed token at
+    or below the transferred mark is answered without re-executing,
+    one above it executes fresh — exactly-once across the re-route."""
+    srv = getattr(_REQUEST, "server", None)
+    token = getattr(_REQUEST, "token", None)
+    if srv is not None and token is not None:
+        srv._note_token_applied(token)
+
+
 def _recv_msg(sock: socket.socket):
     """Legacy-framed receive (v1) — see _recv_frame for the guard."""
     return _recv_frame(sock, PROTO_PICKLE)[0]
@@ -250,14 +290,31 @@ class VarServer:
 
     def __init__(self, endpoint: str,
                  handlers: Dict[str, Callable[..., Any]],
-                 legacy_wire: bool = False):
+                 legacy_wire: bool = False, membership=None):
         host, port = endpoint.rsplit(":", 1)
         self._handlers = handlers
+        # elastic-membership hook (ps_membership.MembershipPlane):
+        # consulted before dispatching data-plane methods so a server
+        # that handed its shard off answers StaleClusterViewError
+        # (carrying the new view) instead of serving stale state
+        self._membership = membership
         # legacy_wire simulates an old-frame-only peer: _hello is
         # rejected like any unknown method, every connection stays v1
         # (wire-compat tests exercise new-client↔old-server)
         self._legacy_wire = bool(legacy_wire)
         self._dedup: "OrderedDict[tuple, dict]" = OrderedDict()
+        # per-token-prefix EXACT applied-seq tracking of non-idempotent
+        # calls (note_request_token_applied): [floor, extra] where every
+        # seq <= floor applied, plus a sparse set of applied seqs above
+        # it (concurrent in-flight calls apply out of order). A retry
+        # whose seq is tracked applied but whose cache entry is gone —
+        # evicted, or the apply happened on the pserver this server took
+        # a handoff from — replays a generic success instead of
+        # double-applying. A seq in a GAP (lost frame racing a
+        # later-seq sibling, or a failed call) is NOT tracked and
+        # re-executes — a max-only high-water mark would falsely replay
+        # it as success and silently drop the update.
+        self._dedup_applied: Dict[Any, list] = {}
         self._dedup_lock = threading.Lock()
         self._conns: set = set()
         self._conns_lock = threading.Lock()
@@ -313,12 +370,18 @@ class VarServer:
                             return
                         nout = 0
                         token = msg.pop("_dedup", None)
+                        epoch = msg.pop("_view_epoch", None)
+                        gview = msg.pop("_view", None)
                         try:
                             if method == "stats":
                                 nout = send({"ok": True,
                                              "result": outer.stats()})
                                 continue
                             if token is not None:
+                                # dedup BEFORE the membership guard: a
+                                # retry of an already-applied call must
+                                # replay its cached response even after
+                                # this server drained its shard
                                 kind, val = outer._dedup_begin(token)
                                 if kind == "done":
                                     outer._bump(method, replays=1)
@@ -345,7 +408,12 @@ class VarServer:
                                     outer._dedup_put(token, resp)
                                 nout = send(resp)
                                 continue
+                            _REQUEST.token = token
+                            _REQUEST.server = outer
                             try:
+                                if outer._membership is not None:
+                                    outer._membership.pre_dispatch(
+                                        method, epoch, gview)
                                 res = fn(**msg)
                                 resp = {"ok": True, "result": res}
                             except Exception as e:  # surfaced to client
@@ -355,6 +423,15 @@ class VarServer:
                                 # dispatch on it)
                                 resp = {"ok": False, "error": repr(e),
                                         "error_type": type(e).__name__}
+                                if isinstance(
+                                        e, core.StaleClusterViewError):
+                                    # ship the server's newer view so
+                                    # the client can re-route + replay
+                                    resp["error_data"] = {
+                                        "view": e.view_dict}
+                            finally:
+                                _REQUEST.token = None
+                                _REQUEST.server = None
                             if token is not None:
                                 outer._dedup_put(token, resp)
                             nout = send(resp)
@@ -377,18 +454,112 @@ class VarServer:
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
 
+    # bound on the sparse applied-seq set per prefix: a permanent gap
+    # (a call that failed and never re-applied) would otherwise pin the
+    # floor and grow the set for the client's lifetime. On overflow the
+    # set collapses to its max (the old high-water-mark semantics) —
+    # a seq that stale retrying is beyond any real retry window.
+    _APPLIED_GAP_CAP = 1024
+
     def _dedup_begin(self, token):
         """Reserve a token. Returns ("new", event) when this call owns
         execution, ("pending", event) when another connection is
-        executing it right now, ("done", response) when it completed."""
+        executing it right now, ("done", response) when it completed —
+        either from the bounded response cache or, for (prefix, seq)
+        tokens tracked APPLIED (cache evicted, or the apply happened
+        pre-handoff on the server this one inherited the shard from),
+        as a generic success."""
         t = tuple(token)
         with self._dedup_lock:
+            applied = False
+            if len(t) == 2 and isinstance(t[1], int):
+                st = self._dedup_applied.get(t[0])
+                applied = st is not None and (t[1] <= st[0]
+                                              or t[1] in st[1])
             entry = self._dedup.get(t)
+            if entry is not None and entry[0] == "done" \
+                    and not entry[1].get("ok", False) \
+                    and entry[1].get("error_type") == \
+                    "StaleClusterViewError":
+                # a membership REFUSAL mutated nothing, so it must not
+                # pin the token's outcome: after a rejoin this server
+                # owns the shard again and the replay must EXECUTE —
+                # and while still drained, re-evaluating issues a fresh
+                # refusal carrying the newest view instead of a stale
+                # one (a drain+rejoin pair 50ms apart poisoned tokens
+                # this way — every trainer looped on the cached epoch-1
+                # refusal from a server already serving at epoch 2)
+                del self._dedup[t]
+                entry = None
             if entry is not None:
+                if applied and entry[0] == "done" \
+                        and not entry[1].get("ok", False):
+                    # the cached outcome is a REFUSAL (e.g. a stale-view
+                    # error from before this server handed its shard
+                    # off) but the applied-seq tracking — possibly
+                    # imported back via a handoff manifest — says the
+                    # call's mutation DID land on the then-owner: the
+                    # truthful replay is the success, not the stale
+                    # refusal
+                    return ("done", {"ok": True, "result": True})
                 return entry
+            if applied:
+                # the write-method contract is a bare True result,
+                # which is what the evicted/transferred response
+                # carried
+                return ("done", {"ok": True, "result": True})
             ev = threading.Event()
             entry = self._dedup[t] = ("pending", ev)
             return ("new", ev)
+
+    def _applied_add(self, prefix, seq: int) -> None:
+        # caller holds _dedup_lock
+        st = self._dedup_applied.get(prefix)
+        if st is None:
+            st = self._dedup_applied[prefix] = [-1, set()]
+        floor, extra = st
+        if seq <= floor or seq in extra:
+            return
+        extra.add(seq)
+        while floor + 1 in extra:
+            floor += 1
+            extra.discard(floor)
+        st[0] = floor
+        if len(extra) > self._APPLIED_GAP_CAP:
+            st[0] = max(extra)
+            extra.clear()
+
+    def _note_token_applied(self, token) -> None:
+        t = tuple(token)
+        if len(t) != 2 or not isinstance(t[1], int):
+            return
+        with self._dedup_lock:
+            self._applied_add(t[0], t[1])
+
+    def dedup_hwms(self) -> Dict[Any, tuple]:
+        """Snapshot of the applied-seq tracking (shard handoff):
+        prefix -> (floor, sorted extra seqs above it)."""
+        with self._dedup_lock:
+            return {p: (st[0], sorted(st[1]))
+                    for p, st in self._dedup_applied.items()}
+
+    def install_dedup_hwms(self, hwms) -> None:
+        """Merge transferred applied-seq tracking. Accepts the
+        (floor, extra) pairs ``dedup_hwms`` exports, or a bare int
+        floor; floors take the max, extras union and re-compact."""
+        with self._dedup_lock:
+            for prefix, val in (hwms or {}).items():
+                if isinstance(val, (list, tuple)):
+                    fl, ex = int(val[0]), {int(s) for s in val[1]}
+                else:
+                    fl, ex = int(val), set()
+                st = self._dedup_applied.setdefault(prefix, [-1, set()])
+                if fl > st[0]:
+                    st[0] = fl
+                st[1] = {s for s in (st[1] | ex) if s > st[0]}
+                while st[0] + 1 in st[1]:
+                    st[0] += 1
+                    st[1].discard(st[0])
 
     def _dedup_wait(self, token, event):
         t = tuple(token)
@@ -492,7 +663,15 @@ _WIRE_ERRORS: Dict[str, type] = {
     # FLAGS_ps_reject_nonfinite=reject: the pserver refuses a poisoned
     # grad and the SENDING trainer gets the typed numeric fault back
     "NumericFaultError": core.NumericFaultError,
+    # elastic membership: surfaced only after the client exhausted its
+    # stale-view replays (VarClient.call re-routes transparently first)
+    "StaleClusterViewError": core.StaleClusterViewError,
 }
+
+
+# process-lifetime client serial for dedup token prefixes (never reused,
+# unlike id())
+_CLIENT_SERIAL = itertools.count()
 
 
 class _Channel:
@@ -542,13 +721,26 @@ class VarClient:
     _IDEMPOTENT = frozenset({
         "get_var", "get_vars_batch", "prefetch_rows", "heartbeat",
         "dead_workers", "alive_workers", "table_stats", "stats",
+        "get_view", "participant_states",
     })
 
+    # how many StaleClusterViewError re-routes one call tolerates before
+    # surfacing (each installs a newer view, so 3 covers a drain racing
+    # a failover racing a rejoin)
+    _STALE_RETRIES = 3
+
     def __init__(self, endpoint: str, connect_timeout: float = 30.0,
-                 channels: Optional[int] = None):
+                 channels: Optional[int] = None, resolve: bool = True):
+        # ``endpoint`` is the SLOT name (what the transpiler baked into
+        # the program). With ``resolve`` (the default), every
+        # (re)connect maps it through the installed ClusterView to the
+        # endpoint currently serving the slot — membership-plane
+        # internals (handoff streams, replica forwards, view probes)
+        # pass resolve=False to reach a PHYSICAL endpoint.
         self.endpoint = endpoint
-        self._host, port = endpoint.rsplit(":", 1)
-        self._port = int(port)
+        self._resolve = bool(resolve)
+        if ":" not in endpoint:
+            raise ValueError(f"endpoint {endpoint!r} is not host:port")
         self._connect_timeout = connect_timeout
         if channels is None:
             # legacy mode pins the pool to the pre-overhaul single
@@ -560,7 +752,11 @@ class VarClient:
         self._channels = [_Channel() for _ in range(max(1, n))]
         self._free = deque(self._channels)
         self._cv = threading.Condition()
-        self._token_prefix = f"{os.getpid()}:{id(self):x}"
+        # token prefix must be unique per client LIFETIME, not per live
+        # object: id() recycles after gc, and a recycled prefix whose
+        # predecessor raised the server's dedup high-water mark would
+        # get this client's fresh calls falsely replayed
+        self._token_prefix = f"{os.getpid()}:{next(_CLIENT_SERIAL)}"
         self._seq = itertools.count()
         # methods this endpoint's server answered "no method" to — the
         # batch helpers probe once, then fall back without the wasted
@@ -595,18 +791,29 @@ class VarClient:
 
     def _connect_channel(self, ch: _Channel, connect_timeout: float):
         """(Re)establish one connection; the server may be down or
-        restarting — poll until ``connect_timeout`` elapses. Negotiates
-        the wire protocol: a legacy-framed ``_hello`` probe upgrades the
-        connection to binary v2 when the server supports it; an old
-        server answers "no method" and the channel stays legacy."""
+        restarting — poll until ``connect_timeout`` elapses. Each poll
+        re-resolves the slot through the installed ClusterView, and a
+        failed attempt probes the slot's replicas for a NEWER view
+        (ps_membership.refresh_view_for) — this poll loop IS the
+        trainer's failover path: once the dead primary's replica
+        promotes itself, resolution flips and the connect lands there.
+        Negotiates the wire protocol: a legacy-framed ``_hello`` probe
+        upgrades the connection to binary v2 when the server supports
+        it; an old server answers "no method" and the channel stays
+        legacy."""
         deadline = time.time() + connect_timeout
         last = None
         while time.time() < deadline:
+            target = (ps_membership.resolve(self.endpoint)
+                      if self._resolve else self.endpoint)
+            host, port = target.rsplit(":", 1)
             try:
                 sock = socket.create_connection(
-                    (self._host, self._port), timeout=self._deadline_s)
+                    (host, int(port)), timeout=self._deadline_s)
             except OSError as e:  # server not up (yet) — retry
                 last = e
+                if self._resolve:
+                    ps_membership.refresh_view_for(self.endpoint)
                 time.sleep(0.1)
                 continue
             ch.sock, ch.proto = sock, PROTO_PICKLE
@@ -665,22 +872,50 @@ class VarClient:
         ``_rpc_retries`` override the FLAGS for this call only (the
         heartbeat thread uses short ones so a dead server can't pin it).
         Frames are encoded ONCE per wire protocol and retries re-send
-        the cached parts verbatim. When the profiler is on, every call
-        emits a cat="rpc" span carrying byte and retry counts."""
+        the cached parts verbatim. A typed ``StaleClusterViewError``
+        response installs the newer view the server shipped and replays
+        the SAME frame (same dedup token) against the new shard owner —
+        a re-route is not a new logical call, so exactly-once holds
+        across it. When the profiler is on, every call emits a
+        cat="rpc" span carrying byte and retry counts."""
         deadline_s = (self._deadline_s if _rpc_timeout is None
                       else float(_rpc_timeout))
         retries = (max(0, int(core.globals_["FLAGS_rpc_retry_times"]))
                    if _rpc_retries is None else max(0, int(_rpc_retries)))
         msg = {"method": method, **kwargs}
+        if self._resolve and method in ps_membership.DATA_METHODS:
+            cur_view = ps_membership.current_view()
+            if cur_view is not None and cur_view.epoch > 0:
+                # gossip the epoch + FULL view once membership has
+                # CHANGED: servers that missed an epoch — a replica's
+                # primary, a server about to mint the NEXT epoch —
+                # learn it from the clients that already hold it.
+                # Epoch-0 clusters stamp NOTHING: they are exactly the
+                # clusters that may still contain pre-elastic servers
+                # whose dispatch would pass an unexpected _view_epoch
+                # kwarg straight into the handler (TypeError).
+                # Known cost: the full view rides EVERY post-epoch-0
+                # data call (~100 B/slot in the pickled header). Fine at
+                # the few-slot scale this repo runs; a 50+-slot cluster
+                # should dedup it (ship the view once per epoch per
+                # connection — note_gossip only needs each server to
+                # hear each epoch once), which must be re-validated
+                # against the chaos loop's promotion-floor races before
+                # it lands.
+                msg["_view_epoch"] = cur_view.epoch
+                msg["_view"] = cur_view.to_dict()
         if method not in self._IDEMPOTENT:
             msg["_dedup"] = (self._token_prefix, next(self._seq))
         frames: Dict[int, tuple] = {}  # proto -> (parts, nbytes)
         attempt = 0
+        stale = 0
+        stale_wait_end = None
         bytes_out = bytes_in = 0
         t_start = time.perf_counter()
         try:
             while True:
                 backoff = 0.0
+                got = False
                 ch = self._acquire()
                 try:
                     if ch.sock is None:
@@ -693,7 +928,7 @@ class VarClient:
                     bytes_out += nb
                     resp, nin = _recv_frame(ch.sock, ch.proto)
                     bytes_in += nin
-                    break
+                    got = True
                 except core.RpcProtocolError:
                     ch.close()
                     raise
@@ -711,6 +946,55 @@ class VarClient:
                         backoff)
                 finally:
                     self._release(ch)
+                if got:
+                    if (self._resolve and not resp.get("ok")
+                            and resp.get("error_type") ==
+                            "StaleClusterViewError"):
+                        # shard moved: install the server's newer view,
+                        # sever the now-misrouted pool, and replay the
+                        # cached frame against the new owner
+                        prev_owner = ps_membership.resolve(self.endpoint)
+                        view = (resp.get("error_data") or {}).get("view")
+                        if view is not None:
+                            ps_membership.install_view(view)
+                        else:  # unpromoted standby: poll for promotion
+                            ps_membership.refresh_view_for(self.endpoint)
+                        moved = (ps_membership.resolve(self.endpoint)
+                                 != prev_owner)
+                        if moved:
+                            # progress: counts against the re-route
+                            # budget (3 covers a drain racing a failover
+                            # racing a rejoin)
+                            stale += 1
+                        if stale_wait_end is None:
+                            stale_wait_end = time.time() + float(
+                                core.globals_[
+                                    "FLAGS_ps_failover_deadline"])
+                        if moved and stale <= self._STALE_RETRIES:
+                            _LOG.info(
+                                "rpc %s on %s: stale cluster view — "
+                                "re-routing to %s (replay %d/%d)",
+                                method, self.endpoint,
+                                ps_membership.resolve(self.endpoint),
+                                stale, self._STALE_RETRIES)
+                            self.close()
+                            time.sleep(0.05)
+                            continue
+                        if not moved and time.time() < stale_wait_end:
+                            # mid-handoff convergence window: the
+                            # answering server's view could not advance
+                            # ours (monotonic install refuses older
+                            # epochs — e.g. a rejoin destination that
+                            # has not committed yet), so an immediate
+                            # replay hits the same refusal. Wait for
+                            # the commit/promotion to land, probing the
+                            # slot's replicas, bounded by
+                            # FLAGS_ps_failover_deadline.
+                            self.close()
+                            time.sleep(0.3)
+                            ps_membership.refresh_view_for(self.endpoint)
+                            continue
+                    break
                 time.sleep(backoff)
         finally:
             _record_rpc_span(method, kwargs.get("name"), self.endpoint,
@@ -719,8 +1003,12 @@ class VarClient:
             err = resp.get("error")
             etype = _WIRE_ERRORS.get(resp.get("error_type"))
             if etype is not None:
-                raise etype(
+                exc = etype(
                     f"rpc {method} on {self.endpoint} failed: {err}")
+                if isinstance(exc, core.StaleClusterViewError):
+                    exc.view_dict = (resp.get("error_data")
+                                     or {}).get("view")
+                raise exc
             raise RuntimeError(
                 f"rpc {method} on {self.endpoint} failed: {err}")
         return resp.get("result")
@@ -833,6 +1121,12 @@ class HeartBeatMonitor:
             self._listeners.append(on_dead)
         self._beats: Dict[int, float] = {}
         self._dead: set = set()
+        # participants in an INTENTIONAL drain: silence past the timeout
+        # is expected (state streaming, planned leave) and must NOT fire
+        # the dead-listeners — which would abort every in-flight barrier
+        # with WorkerDeadError for a worker that is fine
+        # (docs/FAULT_TOLERANCE.md "Elastic membership")
+        self._draining: set = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -848,6 +1142,24 @@ class HeartBeatMonitor:
             self._beats[int(worker_id)] = now
             self._dead.discard(int(worker_id))
 
+    def mark_draining(self, worker_id: int) -> None:
+        """Flag an intentional drain: the participant may go silent past
+        the timeout without being declared dead. Sticky until
+        ``clear_draining`` — a beat alone does not clear it (a draining
+        participant keeps beating while it streams its state, and its
+        eventual silence is still not a death)."""
+        with self._lock:
+            self._draining.add(int(worker_id))
+            self._dead.discard(int(worker_id))
+            # restart the silence clock so a pre-drain beat gap can't
+            # flip it to dead the instant draining is cleared
+            self._beats[int(worker_id)] = time.time()
+
+    def clear_draining(self, worker_id: int) -> None:
+        with self._lock:
+            self._draining.discard(int(worker_id))
+            self._beats[int(worker_id)] = time.time()
+
     def dead_workers(self):
         with self._lock:
             return sorted(self._dead)
@@ -860,13 +1172,25 @@ class HeartBeatMonitor:
         with self._lock:
             return int(worker_id) in self._dead
 
+    def participant_states(self) -> Dict[int, str]:
+        """wid → "dead" | "draining" | "alive" for every participant
+        that ever beat (drain tooling polls this over the wire)."""
+        with self._lock:
+            out = {}
+            for wid in set(self._beats) | self._dead | self._draining:
+                out[wid] = ("draining" if wid in self._draining else
+                            "dead" if wid in self._dead else "alive")
+            return out
+
     def _scan(self):
         while not self._stop.wait(self.check_interval):
             now = time.time()
             newly_dead = []
             with self._lock:
                 for wid, t in self._beats.items():
-                    if wid not in self._dead and now - t > self.timeout:
+                    if wid in self._dead or wid in self._draining:
+                        continue
+                    if now - t > self.timeout:
                         self._dead.add(wid)
                         newly_dead.append(wid)
             for wid in newly_dead:
@@ -895,7 +1219,16 @@ class HeartBeatMonitor:
                 # liveness is queryable over RPC (the reference exposes it
                 # via GetWorkerStatus on the monitor thread)
                 "dead_workers": lambda trainer_id=0: self.dead_workers(),
-                "alive_workers": lambda trainer_id=0: self.alive_workers()}
+                "alive_workers": lambda trainer_id=0: self.alive_workers(),
+                "participant_states": lambda trainer_id=0:
+                    self.participant_states(),
+                # intentional-leave plumbing: a draining participant (or
+                # the admin driving its drain) flags itself so silence
+                # is not death
+                "mark_draining": lambda trainer_id=0:
+                    (self.mark_draining(trainer_id) or True),
+                "clear_draining": lambda trainer_id=0:
+                    (self.clear_draining(trainer_id) or True)}
 
 
 class BarrierManager:
@@ -933,6 +1266,15 @@ class BarrierManager:
     def _on_dead(self, wid: int):
         with self._cv:
             self._cv.notify_all()
+
+    def idle(self, kind: str) -> bool:
+        """True when no participant is parked at ``kind`` — the
+        between-rounds window a shard drain quiesces into. Safe to call
+        while already holding the shared lock (the Condition wraps an
+        RLock in the listen_and_serv wiring)."""
+        with self._cv:
+            st = self._state.get(kind)
+            return st is None or not st["arrived"]
 
     def _check_dead_locked(self, kind: str, st: Dict[str, Any],
                            trainer_id: int):
@@ -1002,20 +1344,52 @@ class WorkerHeartBeat:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    def _targets(self):
+        """Physical endpoints to beat THIS round: each configured slot's
+        current primary (the view re-points beats after a drain or
+        failover) plus its warm replicas — a standby must see trainer
+        beats BEFORE promotion or its own trainer-liveness monitor
+        would start from silence the moment it takes over."""
+        view = ps_membership.current_view()
+        out = []
+        for ep in self.endpoints:
+            cur = ep if view is None else view.resolve(ep)
+            if cur not in out:
+                out.append(cur)
+            if view is not None:
+                for r in view.replicas(ep):
+                    if r not in out:
+                        out.append(r)
+        return out
+
     def _loop(self):
         while not self._stop.wait(self.interval):
-            for ep in self.endpoints:
+            # beats carry the trainer's view gossip: a standby whose
+            # primary dies the instant after an epoch was minted
+            # elsewhere (drain/rejoin) would otherwise promote BELOW
+            # the epoch the trainers already hold — monotonic installs
+            # refuse the promotion view and no one ever re-routes. The
+            # resolve=False beat clients skip the data-path stamping,
+            # so stamp explicitly (epoch-0 clusters stamp nothing —
+            # wire compat with pre-elastic servers, same rule as call).
+            gossip = {}
+            view = ps_membership.current_view()
+            if view is not None and view.epoch > 0:
+                gossip["_view_epoch"] = view.epoch
+                gossip["_view"] = view.to_dict()
+            for ep in self._targets():
                 try:
                     cli = self._clients.get(ep)
                     if cli is None:
                         # one private channel is enough: beats are tiny
-                        # and strictly sequential on this thread
+                        # and strictly sequential on this thread;
+                        # targets are already physical — no resolution
                         cli = self._clients[ep] = VarClient(
                             ep, connect_timeout=max(1.0, self.interval),
-                            channels=1)
+                            channels=1, resolve=False)
                     cli.call("heartbeat", trainer_id=self.trainer_id,
                              _rpc_timeout=max(1.0, self.interval * 2),
-                             _rpc_retries=0)
+                             _rpc_retries=0, **gossip)
                 except Exception:
                     # server gone/restarting; the monitor sees silence.
                     # drop the client so the next beat reconnects fresh

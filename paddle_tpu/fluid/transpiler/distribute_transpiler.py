@@ -64,6 +64,30 @@ class DistributeTranspiler:
         self.pserver_endpoints = [ep.strip() for ep in pservers.split(",")
                                   if ep.strip()]
 
+        # elastic membership (docs/FAULT_TOLERANCE.md "Elastic
+        # membership"): the static shard map becomes an epoch-stamped
+        # ClusterView. Programs keep these SLOT endpoints in their op
+        # attrs forever; the RPC layer resolves a slot to whichever
+        # server currently owns it, so a drain/rejoin/failover never
+        # touches a transpiled program. Installing the epoch-0 view here
+        # seeds every process (trainer and pserver both transpile).
+        from .. import ps_membership
+        self.cluster_view = ps_membership.ClusterView.initial(
+            self.pserver_endpoints)
+        # A DIFFERENT slot set means a NEW cluster, not a membership
+        # change of the current one (slots are immutable epoch-0 names;
+        # drains/failovers remap owners, never the slot list). Without
+        # the reset, a long-lived process that trains job 2 after job 1
+        # — with an ephemeral port reused across the two pserver lists —
+        # would resolve job 2's slot through job 1's high-epoch view to
+        # a dead endpoint, and the monotonic install could never seed
+        # job 2's epoch-0 view over it.
+        cur = ps_membership.current_view()
+        if cur is not None and \
+                set(cur.slots) != set(self.pserver_endpoints):
+            ps_membership.reset_views()
+        ps_membership.install_view(self.cluster_view)
+
         # 1. discover (param, grad, optimize op) triples
         self.param_grad_ops = []     # (param_name, grad_name, op)
         block = self.origin_program.global_block()
@@ -259,10 +283,25 @@ class DistributeTranspiler:
     def get_trainer_program(self, wait_port: bool = True) -> Program:
         return self.trainer_program
 
-    def get_pserver_program(self, endpoint: str) -> Program:
+    def get_pserver_program(self, endpoint: str, bind_endpoint: str = "",
+                            standby: bool = False,
+                            replica_of: str = "") -> Program:
+        """Pserver program for slot ``endpoint``. The elastic-membership
+        kwargs build the program for a process serving that slot from
+        ANOTHER address: ``bind_endpoint`` is where it actually listens,
+        ``standby`` starts it as a warm drain/rejoin destination, and
+        ``replica_of`` additionally makes it a failover replica that
+        applies the primary's chain-forwarded updates and promotes
+        itself when the primary dies (FLAGS_ps_replicas=2)."""
         prog = Program()
         gblock = prog.global_block()
         origin_block = self.origin_program.global_block()
+        member_attrs = {
+            "pserver_endpoints": list(self.pserver_endpoints),
+            "bind_endpoint": str(bind_endpoint or ""),
+            "standby": bool(standby),
+            "replica_of": str(replica_of or ""),
+        }
 
         # sparse tables are row-sharded: EVERY pserver hosts its id-subset
         mine = [(p, g, op) for p, g, op in self.param_grad_ops
@@ -281,7 +320,8 @@ class DistributeTranspiler:
                 type="listen_and_serv", inputs={}, outputs={},
                 attrs={"endpoint": endpoint, "sync_mode": False,
                        "Fanin": self.trainer_num, "optimize_blocks": [],
-                       "grad_to_block_id": [], "distributed_mode": 2})
+                       "grad_to_block_id": [], "distributed_mode": 2,
+                       **member_attrs})
             prog._ps_endpoint = endpoint
             prog._pserver_params = [p for p, _, _ in mine]
             return prog
@@ -314,7 +354,8 @@ class DistributeTranspiler:
                    "Fanin": self.trainer_num,
                    "optimize_blocks": optimize_blocks,
                    "grad_to_block_id": grad_to_block_id,
-                   "distributed_mode": 0 if self.sync_mode else 1})
+                   "distributed_mode": 0 if self.sync_mode else 1,
+                   **member_attrs})
         prog._ps_endpoint = endpoint
         prog._pserver_params = [p for p, _, _ in mine]
         return prog

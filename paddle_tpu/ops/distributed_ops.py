@@ -14,7 +14,9 @@ blocks, then serve gets until the next round. Async follows RunAsyncLoop
 from __future__ import annotations
 
 import os
+import socket
 import threading
+import time
 
 import numpy as np
 import jax.numpy as jnp
@@ -471,11 +473,24 @@ def _merge_ids(ins, attrs):
 @register_op("listen_and_serv", stateful=True, no_grad=True,
              attr_defaults={"endpoint": "", "sync_mode": True, "Fanin": 1,
                             "grad_to_block_id": [], "sparse_lr": 0.01,
-                            "distributed_mode": 0})
+                            "distributed_mode": 0,
+                            # elastic membership (docs/FAULT_TOLERANCE.md
+                            # "Elastic membership"): the full slot list,
+                            # whether this process starts as a warm
+                            # standby (drain destination / replica), the
+                            # slot it replicates, and the PHYSICAL
+                            # endpoint to bind when serving a slot
+                            # program at another address
+                            "pserver_endpoints": [], "standby": False,
+                            "replica_of": "", "bind_endpoint": ""})
 def _listen_and_serv(ins, attrs):
     """Server loop: blocks until a stop RPC (parity with RunImpl's
     server_thread join, listen_and_serv_op.cc:382)."""
-    from ..fluid.ps_rpc import BarrierManager, HeartBeatMonitor, VarServer
+    from ..fluid import io as fio
+    from ..fluid import ps_membership
+    from ..fluid.ps_rpc import (BarrierManager, HeartBeatMonitor,
+                                VarClient, VarServer,
+                                note_request_token_applied)
     ctx = attrs["_ctx"]
     scope, executor = ctx.scope, ctx.executor
     endpoint = attrs["endpoint"]
@@ -485,6 +500,22 @@ def _listen_and_serv(ins, attrs):
     grad_to_block = dict(
         kv.split(":") for kv in attrs.get("grad_to_block_id") or [])
     sparse_lr = float(attrs.get("sparse_lr", 0.01))
+
+    # ---- elastic membership plane -------------------------------------
+    # ``endpoint`` is the SLOT name (what the transpiler baked into every
+    # program); ``bind`` is where THIS process actually listens — they
+    # differ for standbys/replicas serving a slot program elsewhere.
+    bind = str(attrs.get("bind_endpoint") or "") or endpoint
+    slot_eps = [str(e) for e in (attrs.get("pserver_endpoints") or [])] \
+        or [endpoint]
+    replica_of = str(attrs.get("replica_of") or "")
+    standby = bool(attrs.get("standby", False)) or bool(replica_of)
+    membership = ps_membership.MembershipPlane(
+        slot=endpoint, bind=bind,
+        view=ps_membership.ClusterView.initial(slot_eps),
+        state=(ps_membership.STANDBY if standby
+               else ps_membership.ACTIVE),
+        replica_of=replica_of)
 
     # ONE lock guards grad state for send/geo handlers AND backs the
     # BarrierManager's condition — the release action (aggregate +
@@ -616,8 +647,16 @@ def _listen_and_serv(ins, attrs):
                 _apply_sparse(name, value, rows)
             return
         if sync:
+            # tagged (trainer, seq) like the sparse entries: the release
+            # SORTS before summing, so the fp accumulation order is
+            # deterministic regardless of arrival interleaving — what
+            # makes a 3-trainer round bit-identical run-to-run (2-way
+            # sums are commutative, 3-way sums are not) and across a
+            # replica failover's re-ordered replays
+            state["sparse_seq"] += 1
             state["pending"].setdefault(name, []).append(
-                np.asarray(value))
+                (int(trainer_id), state["sparse_seq"],
+                 np.asarray(value)))
         else:
             scope.var(name).set_value(
                 core.LoDTensor(jnp.asarray(value)))
@@ -630,30 +669,54 @@ def _listen_and_serv(ins, attrs):
             return
         _apply_checked_locked(name, value, rows, trainer_id)
 
+    def _apply_batch_locked(vars, trainer_id=0):
+        """The numeric guard runs over the WHOLE batch before anything
+        applies (one scan per array, not two): under
+        FLAGS_ps_reject_nonfinite=reject a half-applied batch would be
+        unrecoverable — the dedup cache replays the error on retry and
+        nothing re-sends the tail — so reject must leave server state
+        untouched."""
+        checked = [(v["name"],) + _guard_nonfinite(
+            v["name"], v["value"], v.get("rows"), trainer_id)
+            for v in vars]
+        for name, value, rows, apply_ in checked:
+            if apply_ and not (rows is not None and len(rows) == 0):
+                _apply_checked_locked(name, value, rows, trainer_id)
+
     def h_send_var(name, value, trainer_id=0, rows=None, height=0):
         monitor.update(trainer_id)
         with lock:
+            # race-free drain guard: the handoff commit flips the
+            # membership state while holding this same lock, so a send
+            # that slipped past the server-level pre_dispatch is
+            # refused HERE — never applied to a shard that moved
+            membership.check_serving()
             _apply_one_locked(name, value, rows, trainer_id)
+            # forward BEFORE noting the token applied: the forward is
+            # where a false promotion surfaces (typed stale refusal),
+            # and a token noted first would let a lost-response retry
+            # replay a cached success for an apply that only ever
+            # mutated this server's fenced-out state
+            _forward("send_var", {"name": name,
+                                  "value": np.asarray(value),
+                                  "trainer_id": int(trainer_id),
+                                  "rows": rows, "height": int(height)})
+            note_request_token_applied()
         return True
 
     def h_send_vars_batch(vars, trainer_id=0):
         """Coalesced multi-var send (Communicator flush): every entry
         applies under ONE grad-lock acquisition; the caller's dedup
         token covers the whole batch, so a replayed retry re-applies
-        none of it. The numeric guard runs over the WHOLE batch before
-        anything applies (one scan per array, not two): under
-        FLAGS_ps_reject_nonfinite=reject a half-applied batch would be
-        unrecoverable — the dedup cache replays the error on retry and
-        nothing re-sends the tail — so reject must leave server state
-        untouched."""
+        none of it."""
         monitor.update(trainer_id)
         with lock:
-            checked = [(v["name"],) + _guard_nonfinite(
-                v["name"], v["value"], v.get("rows"), trainer_id)
-                for v in vars]
-            for name, value, rows, apply_ in checked:
-                if apply_ and not (rows is not None and len(rows) == 0):
-                    _apply_checked_locked(name, value, rows, trainer_id)
+            membership.check_serving()
+            _apply_batch_locked(vars, trainer_id)
+            # forward-then-note, same fencing rationale as h_send_var
+            _forward("send_vars_batch", {"vars": vars,
+                                         "trainer_id": int(trainer_id)})
+            note_request_token_applied()
         return True
 
     def _release_send_round():
@@ -670,30 +733,56 @@ def _listen_and_serv(ins, attrs):
         state["pending_sparse"].clear()
         state["sparse_seq"] = 0
         for name, parts in state["pending"].items():
-            total = parts[0]
-            for p in parts[1:]:
+            entries = sorted(parts, key=lambda e: (e[0], e[1]))
+            total = entries[0][2]
+            for _tid, _seq, p in entries[1:]:
                 total = total + p
             scope.var(name).set_value(
-                core.LoDTensor(jnp.asarray(total / len(parts))))
+                core.LoDTensor(jnp.asarray(total / len(entries))))
         for name in list(state["pending"]):
             _run_block_for(name)
         state["pending"].clear()
+        # chain replication: the standby buffered this round's forwarded
+        # sends in ITS pending state; releasing its round from here (in
+        # primary order, under the primary's lock) keeps the replica's
+        # optimize trajectory bit-identical to the primary's
+        _forward("round_release", {})
 
     def h_barrier(kind, trainer_id=0):
         monitor.update(trainer_id)
         if not sync or kind != "send":
             return True
-        try:
-            barriers.arrive("send", trainer_id,
-                            on_release=_release_send_round)
-        except core.WorkerDeadError:
-            # drop the dead trainer's (and the whole aborted round's)
-            # pending grads so the next round starts clean instead of
-            # double-counting a partial batch
-            with lock:
+        # the whole rendezvous runs under the shared grad RLock (the
+        # BarrierManager Condition wraps it and fully releases it in
+        # wait()), so the drain guard, the arrival, and the
+        # applied-token note are one atomic step against a concurrent
+        # handoff commit
+        with lock:
+            membership.check_serving()
+            try:
+                barriers.arrive("send", trainer_id,
+                                on_release=_release_send_round)
+            except core.WorkerDeadError:
+                # drop the dead trainer's (and the whole aborted
+                # round's) pending grads so the next round starts clean
+                # instead of double-counting a partial batch — and the
+                # standby's forwarded copy of them too: the survivors'
+                # retried round would otherwise average in the aborted
+                # entries on the replica only, so a later promotion
+                # would serve a silently diverged trajectory
                 state["pending"].clear()
                 state["pending_sparse"].clear()
-            raise
+                state["sparse_seq"] = 0
+                _forward("round_abort", {})
+                raise
+            # a completed barrier must replay (not re-arrive) if its
+            # lost response is retried against the post-drain owner — a
+            # fresh arrival there would phantom-join the next round
+            note_request_token_applied()
+            # and the same for the FAILOVER owner: register this
+            # completed barrier's token on the replica so a lost-ack
+            # retry replays there too instead of phantom-arriving
+            _forward("barrier_done", {})
         return True
 
     def h_get_var(name, trainer_id=0):
@@ -735,36 +824,542 @@ def _listen_and_serv(ins, attrs):
     def h_checkpoint(dir=""):
         return True
 
+    def _geo_apply_locked(name, value, rows):
+        var = scope.find_var(name)
+        if var is None:
+            raise KeyError(f"geo pserver has no param '{name}'")
+        cur = np.asarray(var.value().array)
+        if rows is not None:
+            cur = np.array(cur)  # jax-array views are read-only
+            np.add.at(cur, np.asarray(rows, np.int64),
+                      np.asarray(value))
+            var.set_value(core.LoDTensor(jnp.asarray(cur)))
+        else:
+            var.set_value(core.LoDTensor(
+                jnp.asarray(cur + np.asarray(value))))
+
     def h_geo_delta(name, value, trainer_id=0, rows=None):
         """GEO-SGD delta apply: param += delta on arrival; with ``rows``
         only those table rows are touched (reference GeoSgdCommunicator
         sparse-id sync, communicator.h:383 SendUpdateSparseVars)."""
         monitor.update(trainer_id)
         with lock:
-            var = scope.find_var(name)
-            if var is None:
-                raise KeyError(f"geo pserver has no param '{name}'")
-            cur = np.asarray(var.value().array)
-            if rows is not None:
-                cur = np.array(cur)  # jax-array views are read-only
-                np.add.at(cur, np.asarray(rows, np.int64),
-                          np.asarray(value))
-                var.set_value(core.LoDTensor(jnp.asarray(cur)))
-            else:
-                var.set_value(core.LoDTensor(
-                    jnp.asarray(cur + np.asarray(value))))
+            membership.check_serving()
+            _geo_apply_locked(name, value, rows)
+            # forward-then-note, same fencing rationale as h_send_var
+            _forward("geo_delta", {"name": name,
+                                   "value": np.asarray(value),
+                                   "rows": rows})
+            note_request_token_applied()
         return True
 
+    # ---- replication: chain-forward applied updates to a warm standby
+    # (FLAGS_ps_replicas=2 — docs/FAULT_TOLERANCE.md "Elastic
+    # membership"). Forwards run UNDER the grad lock in receipt order on
+    # one private single-channel client, so the replica sees the exact
+    # apply sequence the primary ran — bit-identical state. A forward
+    # failure marks replication broken (warn once, stop forwarding):
+    # promoting a replica that missed updates would diverge, which the
+    # docs call out as the replica-consistency caveat.
+    fwd = {"client": None, "broken": False, "warned": False}
+
+    def _replica_target(for_beat=False):
+        # DRAINING still accepts writes (the quiesce window), so the
+        # chain must keep forwarding through it — a gap here would
+        # silently diverge the warm standby without marking it BROKEN.
+        # A BROKEN chain stops data forwards but NOT liveness beats:
+        # beats keep flowing with chain_broken=True so the stale
+        # standby disables its own promotion — without them the break
+        # itself looks like primary death and the standby promotes
+        # over a live primary with state missing every update since
+        # the break (split views, silent rollback at the real death).
+        if int(core.globals_["FLAGS_ps_replicas"]) < 2 \
+                or (fwd["broken"] and not for_beat) \
+                or membership.state not in (ps_membership.ACTIVE,
+                                            ps_membership.DRAINING):
+            return None
+        reps = [r for r in membership.view.replicas(endpoint)
+                if r != bind]
+        return reps[0] if reps else None
+
+    def _forward(method, kw):
+        target = _replica_target()
+        if target is None:
+            return
+        from ..fluid.ps_rpc import request_dedup_token
+        token = request_dedup_token()
+        try:
+            cli = fwd.get("client")
+            if cli is None or cli.endpoint != target:
+                cli = fwd["client"] = VarClient(
+                    target, connect_timeout=5.0, channels=1,
+                    resolve=False)
+            # the view rides every forward: the replica's minting floor
+            # must track epochs OTHER slots' drains created, or its
+            # promotion would mint an epoch trainers already hold
+            # bounded schedule: this runs holding the grad lock, so the
+            # full FLAGS_rpc_deadline×retries ladder against a hung
+            # replica would stall every data handler on this pserver —
+            # one dedup-tokened retry inside ~2×hb, then BROKEN
+            cli.call("replica_apply", fwd_method=method, kw=kw,
+                     token=token, from_ep=bind,
+                     view=membership.view.to_dict(),
+                     _rpc_timeout=max(1.0, hb_timeout), _rpc_retries=1)
+            membership.replication["forwarded_calls"] += 1
+        except core.StaleClusterViewError as e:
+            # the replica refused the forward: it PROMOTED while this
+            # server was presumed dead (GC pause / healed partition).
+            # Absorb its newer view — note_gossip demotes this server
+            # out of ACTIVE so it stops serving a shard that moved —
+            # and stop forwarding (the chain inverted).
+            membership.replication["forward_failures"] += 1
+            fwd["broken"] = True
+            membership.note_gossip(view=getattr(e, "view_dict", None))
+            if not fwd["warned"]:
+                fwd["warned"] = True
+                import logging
+                logging.getLogger("paddle_tpu.ps").warning(
+                    "replica forward refused by %s (%r) — the replica "
+                    "promoted; this server has been replaced as the "
+                    "owner of slot %s", target, e, endpoint)
+            if method in ("send_var", "send_vars_batch", "geo_delta"):
+                # surface the refusal to the CLIENT of the data call:
+                # its re-route replays the same token on the true owner
+                # (this server's local apply is on fenced-out state).
+                # Barrier-internal forwards (round_release/barrier_done)
+                # swallow instead — clients learn at their next data RPC
+                raise membership.stale_error()
+        except Exception as e:  # noqa: BLE001 — degraded, not fatal
+            membership.replication["forward_failures"] += 1
+            fwd["broken"] = True
+            if not fwd["warned"]:
+                fwd["warned"] = True
+                import logging
+                logging.getLogger("paddle_tpu.ps").warning(
+                    "replica forward to %s failed (%r) — replication "
+                    "for slot %s is BROKEN from here on; a later "
+                    "promotion of that replica would serve stale state",
+                    target, e, endpoint)
+
+    # ---- replica side: primary-liveness monitor + forwarded applies.
+    # The primary is participant 0 of a dedicated monitor; its forwards
+    # and replica_beat pings are the beats. On silence past the timeout
+    # the dead-listener PROMOTES this standby: it mints view epoch+1
+    # with itself as the slot's primary, and trainers pick it up through
+    # the get_view probes their reconnect loops run.
+    upstream = {"ep": None, "stale": False}
+    pmon = None
+    if replica_of:
+        pmon = HeartBeatMonitor(
+            1, timeout=hb_timeout,
+            check_interval=min(1.0, max(0.1, hb_timeout / 4)))
+
+        def _on_primary_dead(_wid):
+            if upstream["stale"]:
+                # the primary told us the replication chain is BROKEN
+                # (we missed forwards): promoting would serve state
+                # missing those updates. Failover is disabled for this
+                # slot — the next primary death is a WorkerDeadError
+                # abort, exactly the documented broken-chain caveat.
+                import logging
+                logging.getLogger("paddle_tpu.ps").warning(
+                    "standby %s: primary %s silent but this standby is "
+                    "STALE (replication chain broke earlier) — refusing "
+                    "promotion; failover for this slot is disabled",
+                    bind, upstream["ep"] or replica_of)
+                return
+            if upstream["ep"] is None:
+                # never heard a single forward/beat: the primary may
+                # still be BOOTING (process spawned, socket not serving
+                # yet). Probe its liveness before a first-contact
+                # promotion; a connectable primary just hasn't found us
+                # — re-arm and keep waiting.
+                target = membership.view.resolve(replica_of) \
+                    if membership.view is not None else replica_of
+                host, port = target.rsplit(":", 1)
+                try:
+                    socket.create_connection(
+                        (host, int(port)), timeout=1.0).close()
+                    pmon.update(0)
+                    return
+                except OSError:
+                    pass
+            membership.promote()
+
+        pmon.add_dead_listener(_on_primary_dead)
+        pmon.start_monitor()
+        # seed the silence clock: without a first beat the monitor's
+        # table is empty and a primary that dies BEFORE its first
+        # forward/beat (or was already down when this replica started
+        # to restore redundancy) would never be declared dead
+        pmon.update(0)
+
+    def _on_upstream(from_ep):
+        if pmon is None:
+            return
+        if from_ep and upstream["ep"] != from_ep:
+            # a NEW upstream (the post-drain owner) took over forwarding
+            # — an intentional-drain mark left by the old one no longer
+            # applies to it
+            upstream["ep"] = from_ep
+            pmon.clear_draining(0)
+        pmon.update(0)
+
+    def h_replica_apply(fwd_method, kw, token=None, from_ep="",
+                        view=None):
+        """Apply one forwarded primary update on the standby. The
+        ORIGINAL caller's dedup token is registered as completed here,
+        so a trainer replaying that very call after failing over to
+        this (promoted) replica gets the cached response instead of a
+        double apply — exactly-once across the failover. The primary's
+        view piggybacks so a later promotion mints ABOVE every epoch
+        the cluster has seen and maps the OTHER slots correctly."""
+        membership.note_gossip(view=view)
+        if membership.state != ps_membership.STANDBY:
+            # ownership fence: this replica PROMOTED (its primary was
+            # presumed dead) — the forwarder is a demoted-but-alive
+            # primary whose updates must not double-apply on top of the
+            # re-routed trainers' direct sends. The typed refusal
+            # carries our newer view; the primary absorbs it and steps
+            # down (note_gossip demotion).
+            raise membership.stale_error()
+        _on_upstream(from_ep)
+        with lock:
+            if fwd_method == "send_var":
+                _apply_one_locked(kw["name"], kw["value"],
+                                  kw.get("rows"),
+                                  kw.get("trainer_id", 0))
+            elif fwd_method == "send_vars_batch":
+                _apply_batch_locked(kw["vars"], kw.get("trainer_id", 0))
+            elif fwd_method == "round_release":
+                _release_send_round()
+            elif fwd_method == "round_abort":
+                # the primary aborted the round (WorkerDeadError): wipe
+                # the forwarded pending grads so the survivors' retried
+                # round isn't double-counted on this standby
+                state["pending"].clear()
+                state["pending_sparse"].clear()
+                state["sparse_seq"] = 0
+            elif fwd_method == "barrier_done":
+                pass  # only the token registration below matters
+            elif fwd_method == "geo_delta":
+                _geo_apply_locked(kw["name"], kw["value"],
+                                  kw.get("rows"))
+            else:
+                raise KeyError(
+                    f"replica_apply: unknown forwarded method "
+                    f"{fwd_method!r}")
+            if token is not None:
+                srv_box[0]._dedup_put(tuple(token),
+                                      {"ok": True, "result": True})
+                srv_box[0]._note_token_applied(tuple(token))
+        return True
+
+    def h_replica_beat(from_ep="", view=None, chain_broken=False):
+        membership.note_gossip(view=view)
+        if chain_broken and pmon is not None and not upstream["stale"]:
+            # permanent for this process lifetime: the missed forwards
+            # are unrecoverable short of a full handoff, which installs
+            # state wholesale and flips this server out of STANDBY
+            upstream["stale"] = True
+            membership.replication["stale_standby"] = 1
+            import logging
+            logging.getLogger("paddle_tpu.ps").warning(
+                "standby %s: primary %s reports the replication chain "
+                "BROKEN — this standby missed updates and will refuse "
+                "promotion", bind, from_ep)
+        _on_upstream(from_ep)
+        return True
+
+    def h_peer_draining(from_ep=""):
+        """The primary announces an INTENTIONAL drain before it goes
+        silent: its silence afterwards must not trigger a promotion —
+        the new owner's first forward re-arms monitoring."""
+        if pmon is not None:
+            pmon.mark_draining(0)
+        return True
+
+    def h_get_view():
+        return membership.view.to_dict()
+
+    # ---- drain / handoff (the elastic resharding protocol) ------------
+    # destination-side staging: sections validate against the manifest's
+    # crc32/size as they stream in; nothing touches the scope until
+    # handoff_commit has the complete, validated set
+    staging = {}
+    staging_lock = threading.Lock()
+
+    def h_handoff_begin(manifest):
+        # STANDBY is the normal destination; DRAINED covers the REJOIN
+        # without a restart — drain A→B, later drain B→A re-uses the
+        # still-running drained A as the destination
+        if membership.state not in (ps_membership.STANDBY,
+                                    ps_membership.DRAINED):
+            raise RuntimeError(
+                f"handoff destination must be a standby or drained "
+                f"server (state={membership.state})")
+        if str(manifest.get("slot", "")) != endpoint:
+            # a drain aimed at the wrong standby (swapped endpoints in
+            # an operator script) would otherwise CRC-validate and
+            # commit another slot's shard onto this server
+            raise RuntimeError(
+                f"handoff manifest is for slot "
+                f"{manifest.get('slot')!r} but this server hosts slot "
+                f"{endpoint!r}")
+        if int(manifest.get("format_version", 0)) != \
+                fio.HANDOFF_FORMAT_VERSION:
+            raise core.CheckpointError(
+                f"handoff manifest format "
+                f"{manifest.get('format_version')!r} not supported")
+        with staging_lock:
+            staging.clear()
+            staging["manifest"] = manifest
+            staging["payloads"] = {}
+        return True
+
+    def h_handoff_section(name, payload):
+        blob = np.asarray(payload, np.uint8).tobytes()
+        with staging_lock:
+            man = staging.get("manifest")
+            if man is None:
+                raise RuntimeError("handoff_section before handoff_begin")
+            fio.check_handoff_section(man, name, blob)
+            staging["payloads"][name] = blob
+        return True
+
+    def h_handoff_commit():
+        with staging_lock:
+            man = staging.get("manifest")
+            if man is None:
+                raise RuntimeError("handoff_commit before handoff_begin")
+            missing = sorted(set(man["sections"])
+                             - set(staging["payloads"]))
+            if missing:
+                raise core.CheckpointError(
+                    f"handoff incomplete: {len(missing)} section(s) "
+                    f"never arrived: {', '.join(missing)}")
+            lazy_meta = (man.get("extra") or {}).get("lazy_meta") or {}
+            with lock:
+                slabs = {}
+                for name, entry in man["sections"].items():
+                    blob = staging["payloads"][name]
+                    if entry["kind"] == "dense":
+                        scope.var(entry["meta"]["var"]).set_value(
+                            fio._deserialize_lod_tensor(blob))
+                    elif entry["kind"] in ("slab_ids", "slab_rows"):
+                        slabs.setdefault(entry["meta"]["var"],
+                                         {})[entry["kind"]] = blob
+                for var_name, parts in slabs.items():
+                    meta = lazy_meta[var_name]
+                    ids = np.frombuffer(parts["slab_ids"], np.int64)
+                    rows = np.frombuffer(
+                        parts["slab_rows"],
+                        np.dtype(meta["dtype"])).reshape(
+                            len(ids), int(meta["dim"]))
+                    scope.var(var_name).set_value(
+                        core.LazyEmbeddingTable.from_state(
+                            meta, ids, rows))
+                srv_box[0].install_dedup_hwms(man.get("dedup_hwms"))
+                membership.state = ps_membership.ACTIVE
+                membership.install(man["view_next"])
+            staging.clear()
+        return True
+
+    def h_handoff_abort():
+        with staging_lock:
+            staging.clear()
+        return True
+
+    def _handoff_sections_locked():
+        """Snapshot every scope-resident piece of shard state as
+        CRC-manifested sections (called under the grad lock, round
+        quiesced): dense vars AND optimizer slots as reference-format
+        tensor blobs, LazyEmbeddingTable sparse shards as slab
+        (ids, rows) pairs with their meta riding the manifest."""
+        sections, lazy_meta = {}, {}
+        for name in scope.local_var_names():
+            var = scope.find_var(name)
+            if var is None or not var.is_initialized():
+                continue
+            val = var.value()
+            if isinstance(val, core.LazyEmbeddingTable):
+                meta, ids, rows = val.export_state()
+                lazy_meta[name] = meta
+                sections[f"slab:{name}:ids"] = {
+                    "kind": "slab_ids", "bytes": ids.tobytes(),
+                    "meta": {"var": name}}
+                sections[f"slab:{name}:rows"] = {
+                    "kind": "slab_rows",
+                    "bytes": np.ascontiguousarray(rows).tobytes(),
+                    "meta": {"var": name}}
+            elif isinstance(val, core.LoDTensor):
+                sections[f"var:{name}"] = {
+                    "kind": "dense",
+                    "bytes": fio._serialize_lod_tensor(val),
+                    "meta": {"var": name}}
+        return sections, lazy_meta
+
+    def h_drain(dest):
+        """Admin RPC on the current owner: quiesce, stream this slot's
+        state to ``dest`` in CRC-manifested sections, and commit the
+        epoch bump — the between-rounds view flip that keeps
+        lock-stepped sync training bit-identical across the move. Any
+        failure aborts with the source still serving. A REJOIN is the
+        same call with ``dest`` = the restarted original endpoint
+        (running as a standby) — the protocol in reverse."""
+        import logging
+        log = logging.getLogger("paddle_tpu.ps")
+        dest = str(dest)
+        # check-and-set under the grad lock: two concurrent drain RPCs
+        # (e.g. an operator retry from a fresh client — different dedup
+        # token) must not both pass the ACTIVE gate and hand the shard
+        # to two destinations
+        with lock:
+            if membership.state != ps_membership.ACTIVE:
+                raise RuntimeError(
+                    f"drain: server for slot {endpoint!r} is "
+                    f"{membership.state}, not active")
+            membership.state = ps_membership.DRAINING
+        membership.handoff.update(in_progress=True, bytes=0,
+                                  sections_done=0, total_sections=0)
+        committed = False
+        dest_cli = None
+        try:
+            dest_cli = VarClient(dest, connect_timeout=10.0, channels=1,
+                                 resolve=False)
+            quiesce_end = time.time() + float(
+                core.globals_["FLAGS_ps_drain_quiesce_deadline"])
+            while True:
+                with lock:
+                    if not state["pending"] and \
+                            not state["pending_sparse"] and \
+                            barriers.idle("send"):
+                        summary = _do_handoff_locked(dest_cli, dest)
+                        committed = True
+                        break
+                if time.time() > quiesce_end:
+                    raise TimeoutError(
+                        f"drain: slot {endpoint!r} could not quiesce "
+                        f"within FLAGS_ps_drain_quiesce_deadline — a "
+                        f"sync round never reached a between-rounds "
+                        f"window")
+                time.sleep(0.02)
+            log.warning("membership: slot %s DRAINED %d bytes in %d "
+                        "sections to %s (view epoch %d)", endpoint,
+                        summary["bytes"], summary["sections"], dest,
+                        summary["epoch"])
+            return summary
+        except BaseException as e:
+            membership.handoff["aborts"] += 1
+            if not committed:
+                # clean abort: the source keeps serving, the destination
+                # discards whatever it staged
+                if dest_cli is not None:
+                    try:
+                        dest_cli.call("handoff_abort", _rpc_retries=0)
+                    except Exception:
+                        pass
+                membership.state = ps_membership.ACTIVE
+                log.warning("membership: drain of slot %s to %s "
+                            "ABORTED (%r) — source still serving",
+                            endpoint, dest, e)
+            raise
+        finally:
+            membership.handoff["in_progress"] = False
+            if dest_cli is not None:
+                dest_cli.close()
+
+    def _do_handoff_locked(dest_cli, dest):
+        """Runs holding the grad lock with the round quiesced: snapshot,
+        stream, commit, flip. Everything before handoff_commit is
+        staged destination-side only, so an error anywhere leaves the
+        source authoritative."""
+        sections, lazy_meta = _handoff_sections_locked()
+        new_view = membership.mint_moved(endpoint, dest)
+        manifest = fio.build_handoff_manifest(
+            endpoint, new_view.epoch, new_view.to_dict(), sections,
+            dedup_hwms=srv_box[0].dedup_hwms(),
+            extra={"lazy_meta": lazy_meta, "source": bind})
+        membership.handoff["total_sections"] = len(sections)
+        dest_cli.call("handoff_begin", manifest=manifest)
+        for name, sec in sections.items():
+            payload = sec["bytes"]
+            if ps_membership._corrupt_section_hook is not None:
+                payload = ps_membership._corrupt_section_hook(
+                    name, payload)
+            dest_cli.call("handoff_section", name=name,
+                          payload=np.frombuffer(payload, np.uint8))
+            membership.handoff["bytes"] += len(payload)
+            membership.handoff["sections_done"] += 1
+        try:
+            dest_cli.call("handoff_commit")
+        except Exception:
+            # lost-ack hazard: the destination may have committed and
+            # become the epoch+1 owner before the ack died in transit.
+            # Reverting this source to ACTIVE then would fork the shard
+            # (both ends serving), so probe the destination's view on a
+            # fresh connection before deciding the commit failed. An
+            # unreachable destination can't serve either side of a
+            # split, so aborting is safe there (in-memory staging dies
+            # with it); see the residual-partition caveat in
+            # docs/FAULT_TOLERANCE.md.
+            committed_remote = False
+            try:
+                probe = VarClient(dest, connect_timeout=5.0, channels=1,
+                                  resolve=False)
+                try:
+                    v = probe.call("get_view", _rpc_retries=1)
+                    committed_remote = bool(v) and \
+                        int(v.get("epoch", -1)) >= new_view.epoch
+                finally:
+                    probe.close()
+            except Exception:
+                pass
+            if not committed_remote:
+                raise
+        # tell our replica (if any) the coming silence is intentional —
+        # the new owner's first forward re-arms its monitoring
+        for rep in membership.view.replicas(endpoint):
+            if rep == dest:
+                continue
+            try:
+                rc = VarClient(rep, connect_timeout=2.0, channels=1,
+                               resolve=False)
+                try:
+                    rc.call("peer_draining", from_ep=bind,
+                            _rpc_retries=0)
+                finally:
+                    rc.close()
+            except Exception:
+                pass
+        membership.state = ps_membership.DRAINED
+        membership.install(new_view)
+        membership.handoff["completed"] += 1
+        return {"bytes": membership.handoff["bytes"],
+                "sections": len(sections), "dest": dest,
+                "epoch": new_view.epoch}
+
     monitor.start_monitor()
-    srv = VarServer(endpoint, {
+    srv_box = []
+    srv = VarServer(bind, {
         "send_var": h_send_var, "send_vars_batch": h_send_vars_batch,
         "barrier": h_barrier, "get_var": h_get_var,
         "get_vars_batch": h_get_vars_batch,
         "prefetch_rows": h_prefetch_rows, "checkpoint": h_checkpoint,
         "table_stats": h_table_stats,
         "geo_delta": h_geo_delta,
+        # elastic membership plane
+        "drain": h_drain, "get_view": h_get_view,
+        "handoff_begin": h_handoff_begin,
+        "handoff_section": h_handoff_section,
+        "handoff_commit": h_handoff_commit,
+        "handoff_abort": h_handoff_abort,
+        "replica_apply": h_replica_apply,
+        "replica_beat": h_replica_beat,
+        "peer_draining": h_peer_draining,
         **monitor.handlers(),
-    })
+    }, membership=membership)
+    srv_box.append(srv)
     def _health_stats_snapshot():
         # the dedicated counter lock, NOT the grad lock: an unlocked
         # dict() copy can die mid-iteration against a _bump_health
@@ -779,11 +1374,49 @@ def _listen_and_serv(ins, attrs):
             }}
 
     srv.add_stats_source(_health_stats_snapshot)
+    # drain tooling / tests poll epoch, state, handoff progress, and
+    # failover promotions through the same stats RPC the health and
+    # per-op counters ride (docs/FAULT_TOLERANCE.md "Elastic membership")
+    srv.add_stats_source(membership.stats_section)
+
+    # primary → replica liveness pings: forwards already beat, but an
+    # IDLE primary (no traffic) must still prove liveness or the replica
+    # would promote over a quiet cluster
+    beat_stop = threading.Event()
+
+    def _replica_beat_loop():
+        beat_cli = {}
+        interval = min(2.0, max(0.2, hb_timeout / 4))
+        while not beat_stop.wait(interval):
+            target = _replica_target(for_beat=True)
+            if target is None:
+                continue
+            try:
+                cli = beat_cli.get(target)
+                if cli is None:
+                    cli = beat_cli[target] = VarClient(
+                        target, connect_timeout=max(1.0, interval),
+                        channels=1, resolve=False)
+                cli.call("replica_beat", from_ep=bind,
+                         view=membership.view.to_dict(),
+                         chain_broken=bool(fwd["broken"]),
+                         _rpc_timeout=max(1.0, interval * 2),
+                         _rpc_retries=0)
+            except Exception:
+                beat_cli.pop(target, None)
+
+    beat_thread = threading.Thread(target=_replica_beat_loop,
+                                   name=f"ps-replica-beat-{bind}",
+                                   daemon=True)
+    beat_thread.start()
     srv.start()
     try:
         srv.wait_stopped()
     finally:
+        beat_stop.set()
         monitor.stop()
+        if pmon is not None:
+            pmon.stop()
         srv.shutdown()
     return {}
 

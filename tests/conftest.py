@@ -39,6 +39,14 @@ def pytest_configure(config):
         "JAX_PLATFORMS=cpu subprocesses, so PADDLE_TPU_TEST_SHARD "
         "file-level sharding applies unchanged.")
     config.addinivalue_line(
+        "markers", "chaos: PS-membership chaos suite (tools/chaos_ps.py "
+        "+ tests/test_ps_membership.py — live pserver drains, SIGKILL "
+        "replica failover, corrupted shard handoffs). The in-process "
+        "protocol tests run fast heartbeat/deadline settings and stay "
+        "in the tier-1 non-slow set; the multiprocess scenario drivers "
+        "also carry 'slow'. Subprocesses run JAX_PLATFORMS=cpu, so "
+        "PADDLE_TPU_TEST_SHARD file-level sharding applies unchanged.")
+    config.addinivalue_line(
         "markers", "rpcbench: PS-RPC data-plane microbench smoke "
         "(tools/rpc_microbench.py loopback sweep at tiny sizes — the "
         "full 4KB..64MB run is a manual tool invocation). In-process "

@@ -7,8 +7,17 @@ Model: linear regression y = x @ w + b on a fixed dataset; sync PS SGD.
 With --sparse: adds a distributed embedding pulled from the pserver.
 """
 import json
+import logging
 import os
 import sys
+
+if os.environ.get("PADDLE_TPU_PS_LOG"):
+    # debug hook for the chaos/fault drivers: surface the rpc/membership
+    # INFO lines (re-routes, view installs) in the per-process logs
+    logging.basicConfig(
+        level=getattr(logging, os.environ["PADDLE_TPU_PS_LOG"].upper(),
+                      logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
 
 # CPU keeps subprocess startup fast and deterministic for the loss oracle.
 # The machine sitecustomize pins the TPU platform in-process, so env vars
@@ -85,9 +94,18 @@ def main():
 
     exe = fluid.Executor()
     scope = core.Scope()
-    if role == "pserver":
-        ep = eps.split(",")[tid]  # tid = this pserver's index
-        pprog = t.get_pserver_program(ep)
+    if role in ("pserver", "standby"):
+        ep = eps.split(",")[tid]  # tid = this pserver's SLOT index
+        if role == "standby":
+            # warm spare for slot ep: drain destination (plain standby)
+            # or failover replica (--replica), listening at --bind
+            bind = _flag_value("--bind")
+            assert bind, "standby role needs --bind=host:port"
+            pprog = t.get_pserver_program(
+                ep, bind_endpoint=bind, standby=True,
+                replica_of=ep if "--replica" in sys.argv else "")
+        else:
+            pprog = t.get_pserver_program(ep)
         pstart = t.get_startup_program(ep, pprog)
         with fluid.scope_guard(scope):
             exe.run(pstart)
@@ -129,6 +147,11 @@ def main():
                     beat.stop()
                     return
                 losses.append(float(np.asarray(lv).reshape(-1)[0]))
+                if "--progress" in sys.argv:
+                    # one line per completed step so a chaos driver can
+                    # time its drain/kill events (tools/chaos_ps.py)
+                    with open(outfile + ".progress", "a") as pf:
+                        pf.write(f"{s} {losses[-1]!r}\n")
                 if step_sleep:
                     time.sleep(step_sleep)
     except BaseException:

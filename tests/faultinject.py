@@ -227,6 +227,51 @@ class poison_var:
         return False
 
 
+# ------------------------------------------------------ shard handoffs
+class corrupt_handoff:
+    """Context manager: flip one byte of a drain-handoff section ON THE
+    WIRE, after the source stamped the manifest CRCs — the destination's
+    per-section validation must reject it and the drain must abort
+    cleanly with the source still serving (docs/FAULT_TOLERANCE.md
+    "Elastic membership").
+
+    ``section`` selects which section to poison (substring match on the
+    section name, e.g. "var:w" or "slab:emb"); default poisons the
+    first section streamed. ``fired`` counts corruptions."""
+
+    def __init__(self, section=None, index=None):
+        self.section = section
+        self.index = index
+        self.fired = 0
+
+    def _hook(self, name, payload):
+        if self.section is not None and self.section not in name:
+            return payload
+        if self.section is None and self.fired:
+            return payload
+        if not len(payload):
+            # nothing to flip (e.g. the ids slab of a never-touched
+            # table); wait for a non-empty section instead of
+            # IndexError-ing the drain
+            return payload
+        self.fired += 1
+        idx = (len(payload) // 2) if self.index is None else self.index
+        bad = bytearray(payload)
+        bad[idx % len(bad)] ^= 0xFF
+        return bytes(bad)
+
+    def __enter__(self):
+        from paddle_tpu.fluid import ps_membership
+        self._prev = ps_membership._corrupt_section_hook
+        ps_membership._corrupt_section_hook = self._hook
+        return self
+
+    def __exit__(self, *exc):
+        from paddle_tpu.fluid import ps_membership
+        ps_membership._corrupt_section_hook = self._prev
+        return False
+
+
 # ----------------------------------------------------------- checkpoints
 def _data_files(ckpt_dir):
     from paddle_tpu.fluid.io import CKPT_MANIFEST

@@ -1,0 +1,826 @@
+"""Elastic PS membership plane tests (docs/FAULT_TOLERANCE.md
+"Elastic membership").
+
+Covers the three legs of the plane:
+  * epoch-stamped ClusterViews + typed StaleClusterViewError re-route
+    with same-dedup-token replay (exactly-once survives the move),
+  * live drain/rejoin over CRC-manifested shard handoffs (a corrupted
+    section aborts cleanly with the source still serving),
+  * replica failover — death-before-ack replays on the promoted
+    standby instead of double-applying, and the Communicator requeues
+    merged grads across the promotion window.
+
+The in-process protocol tests run fast heartbeat/deadline settings and
+stay tier-1 non-slow; the multiprocess scenario drivers
+(tools/chaos_ps.py — real SIGKILLs, loss bit-parity vs a no-fault
+oracle) also carry `slow`.
+"""
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import faultinject as FI
+
+REPO = FI.REPO
+
+pytestmark = pytest.mark.chaos
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(autouse=True)
+def _membership_isolation():
+    """Every test starts from a clean process-global view registry and a
+    fresh client pool; flags touched by tests are restored."""
+    from paddle_tpu.fluid import core, ps_membership
+    from paddle_tpu.fluid.ps_rpc import VarClient
+
+    saved = {k: core.globals_[k] for k in
+             ("FLAGS_rpc_retry_times", "FLAGS_rpc_deadline",
+              "FLAGS_ps_replicas", "FLAGS_ps_failover_deadline",
+              "FLAGS_ps_drain_quiesce_deadline")}
+    ps_membership.reset_views()
+    yield
+    ps_membership.reset_views()
+    VarClient.reset_pool()
+    for k, v in saved.items():
+        core.globals_[k] = v
+
+
+# ==========================================================================
+# ClusterView: the epoch protocol
+# ==========================================================================
+def test_cluster_view_move_mints_next_epoch_and_resolves():
+    from paddle_tpu.fluid.ps_membership import ClusterView
+
+    v0 = ClusterView.initial(["a:1", "b:2"], {"a:1": "r:9"})
+    assert v0.epoch == 0
+    assert v0.resolve("a:1") == "a:1" and v0.resolve("b:2") == "b:2"
+    assert v0.replicas("a:1") == ["r:9"]
+    assert v0.resolve("not-a-slot:7") == "not-a-slot:7"  # passthrough
+
+    v1 = v0.moved("a:1", "c:3")
+    assert (v1.epoch, v1.resolve("a:1")) == (1, "c:3")
+    assert v0.resolve("a:1") == "a:1"          # views are immutable
+    assert v1.endpoints() == ["c:3", "b:2"]    # slot order preserved
+    # promoting the replica removes it from the slot's replica list
+    v2 = v1.moved("a:1", "r:9")
+    assert v2.replicas("a:1") == []
+    with pytest.raises(KeyError):
+        v0.moved("nope:0", "c:3")
+    # wire round-trip
+    from paddle_tpu.fluid.ps_membership import ClusterView as CV
+    back = CV.from_dict(v1.to_dict())
+    assert back.epoch == 1 and back.resolve("a:1") == "c:3"
+
+
+def test_install_view_is_epoch_monotonic():
+    from paddle_tpu.fluid import ps_membership as m
+
+    v0 = m.ClusterView.initial(["a:1"])
+    v1 = v0.moved("a:1", "b:2")
+    assert m.install_view(v1).epoch == 1
+    assert m.resolve("a:1") == "b:2"
+    # an older (or equal) epoch never rolls the process back — a late
+    # stale-error from a long-dead server must be a no-op
+    assert m.install_view(v0).epoch == 1
+    assert m.install_view(v1.to_dict()).epoch == 1
+    assert m.resolve("a:1") == "b:2"
+    assert m.current_epoch() == 1
+
+
+def test_replica_map_env_parses_and_rejects_malformed(monkeypatch):
+    from paddle_tpu.fluid import ps_membership as m
+
+    monkeypatch.setenv("PADDLE_PS_REPLICA_MAP", "a:1=r:9, b:2=r:8")
+    assert m.parse_replica_map_env() == {"a:1": "r:9", "b:2": "r:8"}
+    v = m.ClusterView.initial(["a:1", "b:2"])
+    assert v.replicas("a:1") == ["r:9"] and v.replicas("b:2") == ["r:8"]
+    monkeypatch.setenv("PADDLE_PS_REPLICA_MAP", "garbage")
+    with pytest.raises(ValueError):
+        m.parse_replica_map_env()
+
+
+# ==========================================================================
+# shard state snapshots + dedup high-water marks
+# ==========================================================================
+def test_lazy_table_handoff_roundtrip_preserves_lru_order():
+    """export_state/from_state must rebuild a bit-identical table
+    INCLUDING future eviction decisions (ids travel in LRU order)."""
+    from paddle_tpu.fluid import core
+
+    src = core.LazyEmbeddingTable(height=100, dim=3, seed=7, max_rows=4)
+    for rid in (5, 17, 42, 63):
+        src.get_rows(np.array([rid], np.int64))
+    src.get_rows(np.array([5], np.int64))  # refresh 5 → 17 is now LRU
+    meta, ids, rows = src.export_state()
+    assert list(ids) == [17, 42, 63, 5]
+
+    dst = core.LazyEmbeddingTable.from_state(meta, ids, rows)
+    np.testing.assert_array_equal(
+        dst.get_rows(np.array([17, 42, 63, 5], np.int64)),
+        src.get_rows(np.array([17, 42, 63, 5], np.int64)))
+    # both evict the SAME row on the next overflow — bit-identical
+    # trajectories across the handoff
+    for t in (src, dst):
+        t.get_rows(np.array([99], np.int64))
+    assert 17 not in dict(src._index) and 17 not in dict(dst._index)
+    np.testing.assert_array_equal(
+        dst.get_rows(np.array([42, 63, 5, 99], np.int64)),
+        src.get_rows(np.array([42, 63, 5, 99], np.int64)))
+
+
+def test_dedup_applied_tracking_replays_exactly():
+    """A (prefix, seq) token tracked APPLIED replays a generic success
+    even when its cache entry is gone — the transferred-marks path a
+    re-routed retry takes after a handoff. A seq in a GAP (its frame
+    was lost while a concurrent later seq applied) must NOT replay: a
+    max-only high-water mark would silently drop that update."""
+    from paddle_tpu.fluid.ps_rpc import VarServer
+
+    srv = VarServer(f"127.0.0.1:{free_port()}", {})
+    for s in (0, 1, 3):                       # seq 2 lost in flight
+        srv._note_token_applied(("c", s))
+    assert srv.dedup_hwms() == {"c": (1, [3])}
+    assert srv._dedup_begin(("c", 1))[1] == {"ok": True, "result": True}
+    assert srv._dedup_begin(("c", 3))[0] == "done"
+    kind, _ = srv._dedup_begin(("c", 2))
+    assert kind == "new"                      # the gap RE-EXECUTES
+    kind, _ = srv._dedup_begin(("c", 4))
+    assert kind == "new"                      # never applied: executes
+    # late apply of the gap compacts the floor through the extras
+    srv._note_token_applied(("c", 2))
+    assert srv.dedup_hwms()["c"] == (3, [])
+    # a handoff merges the transferred tracking (floor max, extra union)
+    srv.install_dedup_hwms({"c": (1, [5]), "d": (7, [])})
+    assert srv.dedup_hwms() == {"c": (3, [5]), "d": (7, [])}
+    assert srv._dedup_begin(("d", 7))[0] == "done"
+    assert srv._dedup_begin(("c", 5))[0] == "done"
+    assert srv._dedup_begin(("d", 8))[0] == "new"
+
+
+def test_stale_refusal_is_never_pinned_as_token_outcome():
+    """A cached StaleClusterViewError REFUSAL must not become a token's
+    permanent outcome: a drain+rejoin pair can complete within one
+    client re-route window (observed ~50ms apart at hb=1.0), after
+    which the original server owns the shard again and the SAME dedup
+    token arrives back — it must re-execute against current membership,
+    not replay the old epoch's refusal forever (every trainer wedged on
+    the cached epoch-1 refusal from a server already serving epoch 2)."""
+    from paddle_tpu.fluid.ps_rpc import VarServer
+
+    srv = VarServer(f"127.0.0.1:{free_port()}", {})
+    tok = ("c", 0)
+    kind, _ev = srv._dedup_begin(tok)
+    assert kind == "new"
+    srv._dedup_put(tok, {"ok": False, "error": "drained",
+                         "error_type": "StaleClusterViewError",
+                         "error_data": {"view": None}})
+    # the replay drops the pinned refusal and re-executes
+    kind, _ev = srv._dedup_begin(tok)
+    assert kind == "new"
+    # a genuine completed outcome still replays verbatim
+    srv._dedup_put(tok, {"ok": True, "result": True})
+    assert srv._dedup_begin(tok) == \
+        ("done", {"ok": True, "result": True})
+    # a non-stale cached ERROR for a token the handoff manifest marked
+    # APPLIED replays as the transferred success — the mutation landed
+    # on the then-owner even though THIS server's attempt failed
+    tok2 = ("c", 1)
+    srv._dedup_begin(tok2)
+    srv._dedup_put(tok2, {"ok": False, "error": "boom",
+                          "error_type": "KeyError"})
+    srv.install_dedup_hwms({"c": (1, [])})
+    assert srv._dedup_begin(tok2)[1] == {"ok": True, "result": True}
+
+
+# ==========================================================================
+# heartbeat: DRAINING is not dead
+# ==========================================================================
+def test_draining_participant_is_never_declared_dead():
+    from paddle_tpu.fluid.ps_rpc import HeartBeatMonitor
+
+    dead = []
+    mon = HeartBeatMonitor(2, timeout=0.3, check_interval=0.05,
+                           on_dead=dead.append)
+    mon.update(0)
+    mon.update(1)
+    mon.mark_draining(1)
+    mon.start_monitor()
+    try:
+        deadline = time.time() + 2.0
+        while not dead and time.time() < deadline:
+            mon.update(0)  # keep 0 alive; 1 is silent but draining
+            time.sleep(0.05)
+        assert not dead
+        assert mon.participant_states()[1] == "draining"
+        # a beat alone must NOT clear the draining flag (the server
+        # keeps beating while it streams its state out)
+        mon.update(1)
+        assert mon.participant_states()[1] == "draining"
+        # once cleared, silence is death again
+        mon.clear_draining(1)
+        deadline = time.time() + 3.0
+        while not dead and time.time() < deadline:
+            mon.update(0)
+            time.sleep(0.05)
+        assert dead == [1]
+        assert mon.participant_states()[1] == "dead"
+    finally:
+        mon.stop()
+
+
+# ==========================================================================
+# transpiler: slot programs + standby/replica programs
+# ==========================================================================
+def test_transpiler_seeds_view_and_builds_standby_programs():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import ps_membership
+    from paddle_tpu.fluid.transpiler import DistributeTranspiler
+
+    eps = [f"127.0.0.1:{free_port()}" for _ in range(2)]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        t = DistributeTranspiler()
+        t.transpile(trainer_id=0, pservers=",".join(eps), trainers=2,
+                    sync_mode=True, program=main,
+                    startup_program=startup)
+
+    # transpiling seeds the process with the epoch-0 view of the slots
+    view = ps_membership.current_view()
+    assert view is not None and view.epoch == 0
+    assert view.endpoints() == eps
+
+    prog = t.get_pserver_program(eps[0])
+    attrs = prog.global_block().ops[-1].attrs
+    assert attrs["endpoint"] == eps[0]
+    assert attrs["pserver_endpoints"] == eps
+    assert not attrs["standby"] and not attrs["bind_endpoint"]
+
+    bind = f"127.0.0.1:{free_port()}"
+    sprog = t.get_pserver_program(eps[0], bind_endpoint=bind,
+                                  standby=True, replica_of=eps[0])
+    sattrs = sprog.global_block().ops[-1].attrs
+    assert sattrs["endpoint"] == eps[0]       # slot name stays baked in
+    assert sattrs["bind_endpoint"] == bind    # serving address differs
+    assert sattrs["standby"] and sattrs["replica_of"] == eps[0]
+
+
+def test_transpiler_reseeds_registry_for_a_new_cluster():
+    """A high-epoch view left by a finished job must not misroute a new
+    job in the same process whose pserver list reuses an endpoint: a
+    DIFFERENT slot set means a new cluster, so transpile resets the
+    registry and seeds epoch 0; the SAME slot set keeps the learned
+    epochs (a mid-job retranspile must never roll the views back)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import ps_membership
+    from paddle_tpu.fluid.transpiler import DistributeTranspiler
+
+    def _transpile(eps):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", shape=[4], dtype="float32")
+            y = fluid.data("y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+            DistributeTranspiler().transpile(
+                trainer_id=0, pservers=",".join(eps), trainers=2,
+                sync_mode=True, program=main, startup_program=startup)
+
+    a, b, c = "127.0.0.1:6170", "127.0.0.1:6171", "127.0.0.1:6172"
+    _transpile([a, b])
+    # job 1 learns epoch 1: slot a drained to c
+    ps_membership.install_view(
+        ps_membership.current_view().moved(a, c))
+    assert ps_membership.resolve(a) == c
+
+    # same cluster retranspiled: the learned epoch survives
+    _transpile([a, b])
+    assert ps_membership.current_epoch() == 1
+    assert ps_membership.resolve(a) == c
+
+    # job 2 reuses endpoint a in a DIFFERENT slot set: fresh registry,
+    # a resolves to itself again instead of job 1's dead handoff dest
+    d = "127.0.0.1:6173"
+    _transpile([a, d])
+    assert ps_membership.current_epoch() == 0
+    assert ps_membership.resolve(a) == a
+
+
+def test_heartbeat_gossip_raises_standby_promotion_floor():
+    """The gossip-floor race the full chaos scenario exposed: a rejoin
+    mints epoch 2, the other slot's primary learns it and is SIGKILLed
+    ~200ms later — before any forward/beat relayed it to its standby —
+    and the standby promotes at epoch 1, a view every trainer's
+    monotonic install refuses (nobody ever re-routes; trainers die on
+    connect retries to the dead primary). Trainer heartbeats carry the
+    trainer's view gossip (the resolve=False beat clients stamp it
+    explicitly), so the standby's minting floor tracks the TRAINERS,
+    not just its dead primary, and the promotion clears their epoch."""
+    from paddle_tpu.fluid import ps_membership
+    from paddle_tpu.fluid.ps_rpc import VarServer, WorkerHeartBeat
+
+    slot = f"127.0.0.1:{free_port()}"
+    rep = f"127.0.0.1:{free_port()}"
+    epoch2 = ps_membership.ClusterView(
+        {slot: {"primary": slot, "replicas": [rep]}}, epoch=2)
+    plane = ps_membership.MembershipPlane(
+        slot, bind=rep, view=ps_membership.ClusterView.initial(
+            [slot], {slot: rep}),
+        state=ps_membership.STANDBY, replica_of=slot)
+    srv = VarServer(rep, {"heartbeat": lambda trainer_id=0: True},
+                    membership=plane).start()
+    try:
+        # the trainer process holds epoch 2 (a rejoin elsewhere)
+        ps_membership.install_view(epoch2)
+        beat = WorkerHeartBeat([slot], 0, interval=0.05).start()
+        try:
+            deadline = time.time() + 5.0
+            while time.time() < deadline and plane._max_seen < 2:
+                time.sleep(0.05)
+        finally:
+            beat.stop()
+        assert plane._max_seen >= 2       # the floor tracked the beats
+        promoted = plane.promote()
+        assert promoted is not None and promoted.epoch >= 3
+        # monotonic trainers ACCEPT the promotion view
+        assert ps_membership.install_view(promoted).epoch == \
+            promoted.epoch
+        assert ps_membership.resolve(slot) == rep
+    finally:
+        srv.shutdown()
+
+
+# ==========================================================================
+# stale-view re-route: exactly-once across a failover
+# ==========================================================================
+def test_death_before_ack_replays_exactly_once_on_promoted_replica():
+    """The satellite contract: a pserver dies mid-``send_vars_batch`` —
+    AFTER applying and chain-forwarding, BEFORE the ack reaches the
+    client. The client's retry fails over to the promoted replica and
+    must REPLAY the same dedup token from the forwarded registration,
+    never re-apply the batch."""
+    from paddle_tpu.fluid import ps_membership
+    from paddle_tpu.fluid.ps_rpc import (VarClient, VarServer,
+                                         request_dedup_token)
+
+    ep_p = f"127.0.0.1:{free_port()}"
+    ep_r = f"127.0.0.1:{free_port()}"
+    base = ps_membership.ClusterView.initial([ep_p], {ep_p: ep_r})
+    ps_membership.install_view(base)
+    promoted = base.moved(ep_p, ep_r)  # what the replica mints on death
+
+    applied_p, applied_r = [], []
+    rsrv = VarServer(ep_r, {
+        "send_vars_batch":
+            lambda vars, trainer_id=0: applied_r.append(vars) or True,
+        "get_view": lambda: promoted.to_dict(),
+    }).start()
+
+    box = {}
+
+    def h_send(vars, trainer_id=0):
+        applied_p.append(vars)
+        token = tuple(request_dedup_token())
+        # the chain forward the real listen_and_serv runs: register the
+        # original caller's token as COMPLETED on the replica
+        rsrv._dedup_put(token, {"ok": True, "result": True})
+        rsrv._note_token_applied(token)
+        # die before acking — severs every connection like SIGKILL
+        box["psrv"].shutdown()
+        return True
+
+    box["psrv"] = VarServer(ep_p, {"send_vars_batch": h_send}).start()
+    cli = VarClient(ep_p, channels=1)
+    try:
+        ok = cli.call(
+            "send_vars_batch",
+            vars=[{"name": "g", "value": np.ones(4, np.float32)}],
+            _rpc_timeout=10.0)
+        assert ok is True
+        # applied exactly once, on the primary; the replica served the
+        # retry from the forwarded token — its handler never ran
+        assert len(applied_p) == 1 and applied_r == []
+        assert rsrv.stats()["send_vars_batch"]["dedup_replays"] >= 1
+        # the failover installed the promoted view process-wide
+        assert ps_membership.current_epoch() == 1
+        assert ps_membership.resolve(ep_p) == ep_r
+    finally:
+        cli.close()
+        for s in (box["psrv"], rsrv):
+            try:
+                s.shutdown()
+            except Exception:
+                pass
+
+
+# ==========================================================================
+# drain / handoff against the real listen_and_serv
+# ==========================================================================
+def _start_pserver_thread(endpoint, bind="", standby=False,
+                          pserver_endpoints=(), sync=False, fanin=1,
+                          replica_of=""):
+    """One in-process listen_and_serv on its own scope — the 2-server
+    harness the drain/replication protocol tests run on."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        main.global_block().append_op(
+            type="listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": endpoint, "sync_mode": sync,
+                   "Fanin": fanin, "optimize_blocks": [],
+                   "grad_to_block_id": [],
+                   "pserver_endpoints": list(pserver_endpoints)
+                   or [endpoint],
+                   "bind_endpoint": bind, "standby": standby,
+                   "replica_of": replica_of})
+    scope = core.Scope()
+    exe = fluid.Executor()
+    th = threading.Thread(
+        target=lambda: exe.run(main, scope=scope, feed={},
+                               fetch_list=[]), daemon=True)
+    th.start()
+    return th, scope
+
+
+def _stop_server(physical_ep, thread):
+    from paddle_tpu.fluid.ps_rpc import VarClient
+    try:
+        c = VarClient(physical_ep, connect_timeout=5.0, channels=1,
+                      resolve=False)
+        c.stop()
+        c.close()
+    except Exception:
+        pass
+    thread.join(timeout=10)
+
+
+def test_live_drain_moves_shard_and_stale_client_reroutes():
+    """Full drain protocol against two real listen_and_serv loops: the
+    shard state moves in CRC-manifested sections, the source flips to
+    DRAINED, and a client still holding the OLD view is re-routed by
+    the typed stale error — transparently, inside one call."""
+    from paddle_tpu.fluid import ps_membership
+    from paddle_tpu.fluid.ps_rpc import VarClient
+
+    slot = f"127.0.0.1:{free_port()}"
+    bind_b = f"127.0.0.1:{free_port()}"
+    th_a, _ = _start_pserver_thread(slot)
+    th_b, _ = _start_pserver_thread(slot, bind=bind_b, standby=True)
+    try:
+        cli = VarClient(slot, connect_timeout=30.0)
+        val = np.arange(6, dtype=np.float32)
+        cli.send_var("u", val)
+
+        # a standby refuses data RPCs until it owns the shard
+        probe = VarClient(bind_b, connect_timeout=5.0, resolve=False)
+        import paddle_tpu.fluid.core as core
+        with pytest.raises(core.StaleClusterViewError):
+            probe.call("get_var", name="u", _rpc_retries=0)
+
+        admin = VarClient(slot, connect_timeout=5.0, resolve=False)
+        summary = admin.call("drain", dest=bind_b, _rpc_timeout=60.0)
+        assert summary["epoch"] == 1 and summary["sections"] >= 1
+
+        # stats surface the state machine on both ends
+        a_stats = admin.call("stats")["membership"]
+        assert a_stats["state"] == "drained"
+        assert a_stats["shards_owned"] == []
+        assert a_stats["handoff"]["completed"] == 1
+        b_stats = probe.call("stats")["membership"]
+        assert b_stats["state"] == "active"
+        assert (b_stats["epoch"], b_stats["shards_owned"]) == (1, [slot])
+
+        # a client with the STALE epoch-0 view calls the old owner: the
+        # typed error re-routes it inside the same logical call
+        ps_membership.reset_views()
+        ps_membership.install_view(ps_membership.ClusterView.initial(
+            [slot]))
+        c2 = VarClient(slot, connect_timeout=10.0)
+        np.testing.assert_array_equal(np.asarray(c2.get_var("u")), val)
+        assert ps_membership.current_epoch() == 1  # view was installed
+        c2.close()
+        cli.close()
+    finally:
+        _stop_server(bind_b, th_b)
+        _stop_server(slot, th_a)
+
+
+def test_corrupted_handoff_rejected_and_source_keeps_serving():
+    """CRC acceptance leg: a byte flipped on the wire AFTER the manifest
+    was stamped must fail the destination's per-section validation; the
+    drain aborts cleanly and the SOURCE stays authoritative."""
+    from paddle_tpu.fluid.ps_rpc import VarClient
+
+    slot = f"127.0.0.1:{free_port()}"
+    bind_b = f"127.0.0.1:{free_port()}"
+    th_a, _ = _start_pserver_thread(slot)
+    th_b, _ = _start_pserver_thread(slot, bind=bind_b, standby=True)
+    try:
+        cli = VarClient(slot, connect_timeout=30.0)
+        val = np.arange(8, dtype=np.float32) * 0.5
+        cli.send_var("w", val)
+
+        admin = VarClient(slot, connect_timeout=5.0, resolve=False)
+        with FI.corrupt_handoff() as inj:
+            with pytest.raises(RuntimeError, match="failed validation"):
+                admin.call("drain", dest=bind_b, _rpc_timeout=60.0)
+        assert inj.fired == 1
+
+        a_stats = admin.call("stats")["membership"]
+        assert a_stats["state"] == "active"       # source still serving
+        assert a_stats["handoff"]["aborts"] == 1
+        assert a_stats["handoff"]["completed"] == 0
+        np.testing.assert_array_equal(np.asarray(cli.get_var("w")), val)
+        probe = VarClient(bind_b, connect_timeout=5.0, resolve=False)
+        assert probe.call("stats")["membership"]["state"] == "standby"
+
+        # the aborted drain left everything reusable: a clean retry works
+        summary = admin.call("drain", dest=bind_b, _rpc_timeout=60.0)
+        assert summary["epoch"] == 1
+        cli.close()
+    finally:
+        _stop_server(bind_b, th_b)
+        _stop_server(slot, th_a)
+
+
+# ==========================================================================
+# Communicator: requeue across the failover window
+# ==========================================================================
+def test_communicator_requeues_merged_grads_across_endpoint_outage():
+    """A transport failure used to DROP the merged grad silently; now it
+    requeues until FLAGS_ps_failover_deadline so the next flush reaches
+    the recovered (or promoted) endpoint."""
+    from paddle_tpu.fluid import core
+    from paddle_tpu.fluid.communicator import Communicator
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+
+    applied = []
+
+    def h_send(name, value, trainer_id=0, rows=None, height=0):
+        applied.append(np.asarray(value))
+        return True
+
+    port = free_port()
+    ep = f"127.0.0.1:{port}"
+    core.globals_["FLAGS_rpc_retry_times"] = 0
+    core.globals_["FLAGS_rpc_deadline"] = 2000
+    core.globals_["FLAGS_ps_failover_deadline"] = 30.0
+
+    srv1 = VarServer(ep, {"send_var": h_send}).start()
+    comm = Communicator(envs={"communicator_send_wait_times": "0.01"})
+    comm.start()
+    srv2 = None
+    try:
+        v1 = np.full(3, 2.0, np.float32)
+        comm.push("g", v1, ep)
+        deadline = time.time() + 15
+        while len(applied) < 1 and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(applied) == 1
+
+        srv1.shutdown()                      # endpoint goes dark
+        v2 = np.full(3, 7.0, np.float32)
+        comm.push("g", v2, ep)
+        time.sleep(0.6)                      # several failed flushes
+        assert len(applied) == 1             # not delivered, not lost
+
+        srv2 = VarServer(ep, {"send_var": h_send}).start()
+        deadline = time.time() + 20
+        while len(applied) < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(applied) == 2             # requeued grad arrived
+        np.testing.assert_array_equal(applied[1], v2)
+    finally:
+        comm.stop()
+        for s in (srv1, srv2):
+            try:
+                if s is not None:
+                    s.shutdown()
+            except Exception:
+                pass
+        VarClient.reset_pool()
+
+
+def test_communicator_requeues_on_stale_view_convergence_window():
+    """A StaleClusterViewError that SURFACES from a send (the call's
+    re-route budget ran out while membership was still converging) is a
+    timing condition, not a content rejection: the Communicator must
+    requeue ("retry"), not drop — dropping silently loses the round's
+    merged grads exactly like the pre-elastic behavior this PR fixes."""
+    from paddle_tpu.fluid import core
+    from paddle_tpu.fluid.communicator import Communicator
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+
+    ep = f"127.0.0.1:{free_port()}"
+    core.globals_["FLAGS_rpc_retry_times"] = 0
+    core.globals_["FLAGS_rpc_deadline"] = 2000
+    # a short convergence window so the stale error surfaces fast
+    core.globals_["FLAGS_ps_failover_deadline"] = 0.2
+
+    def h_send(name, value, trainer_id=0, rows=None, height=0):
+        raise core.StaleClusterViewError("shard mid-handoff")
+
+    srv = VarServer(ep, {"send_var": h_send}).start()
+    comm = Communicator(envs={"communicator_send_wait_times": "0.01"})
+    try:
+        out = comm._send_batch(ep, [("g", np.ones(3, np.float32))], 0)
+        assert out == "retry"     # was "drop": grads silently lost
+    finally:
+        srv.shutdown()
+        VarClient.reset_pool()
+
+
+# ==========================================================================
+# broken replication chain: beats keep flowing, the stale standby
+# refuses promotion, and a round abort reaches the standby
+# ==========================================================================
+def test_broken_chain_beats_keep_flowing_with_stale_mark(monkeypatch):
+    """A forward failure marks replication BROKEN — but the liveness
+    beats must keep flowing, now carrying chain_broken=True. If the
+    break silenced the beats too, the (alive again after a blip)
+    standby would read that silence as primary death and promote over
+    a LIVE primary with state missing every update since the break."""
+    from paddle_tpu.fluid import core
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+
+    slot = f"127.0.0.1:{free_port()}"
+    ep_r = f"127.0.0.1:{free_port()}"
+    monkeypatch.setenv("PADDLE_PS_HEARTBEAT_TIMEOUT", "1.0")
+    monkeypatch.setenv("PADDLE_PS_REPLICA_MAP", f"{slot}={ep_r}")
+    core.globals_["FLAGS_ps_replicas"] = 2
+
+    beats = []
+
+    def h_apply(fwd_method, kw, token=None, from_ep="", view=None):
+        raise RuntimeError("replica blip: forward refused")
+
+    rsrv = VarServer(ep_r, {
+        "replica_apply": h_apply,
+        "replica_beat": lambda from_ep="", view=None, chain_broken=False:
+            beats.append(bool(chain_broken)) or True,
+    }).start()
+    th, _ = _start_pserver_thread(slot)
+    try:
+        cli = VarClient(slot, connect_timeout=30.0)
+        cli.send_var("g", np.ones(4, np.float32))  # forward -> BROKEN
+        deadline = time.time() + 10.0
+        while time.time() < deadline and not any(beats):
+            time.sleep(0.05)
+        assert any(beats)  # beats survived the break, stale-marked
+        admin = VarClient(slot, connect_timeout=5.0, resolve=False)
+        rep = admin.call("stats")["membership"]["replication"]
+        assert rep["forward_failures"] >= 1
+        cli.close()
+    finally:
+        rsrv.shutdown()
+        _stop_server(slot, th)
+
+
+def test_broken_chain_standby_refuses_promotion(monkeypatch):
+    """The standby half: once a beat carried chain_broken=True this
+    standby is STALE — on real primary silence it must NOT promote
+    (its state misses the forwards the break swallowed); the next
+    primary death is a clean WorkerDeadError abort for the trainers,
+    never a silent rollback to diverged replica state."""
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+
+    slot = f"127.0.0.1:{free_port()}"
+    ep_r = f"127.0.0.1:{free_port()}"
+    monkeypatch.setenv("PADDLE_PS_HEARTBEAT_TIMEOUT", "1.0")
+    # a live (empty) server at the slot keeps the standby's
+    # first-contact liveness probe re-arming until the beats arrive
+    psrv = VarServer(slot, {}).start()
+    th, _ = _start_pserver_thread(slot, bind=ep_r, replica_of=slot)
+    try:
+        probe = VarClient(ep_r, connect_timeout=30.0, resolve=False)
+        probe.call("replica_beat", from_ep=slot, chain_broken=False)
+        probe.call("replica_beat", from_ep=slot, chain_broken=True)
+        st = probe.call("stats")["membership"]
+        assert st["replication"]["stale_standby"] == 1
+        assert st["state"] == "standby"
+        psrv.shutdown()       # now the primary REALLY dies
+        time.sleep(3.0)       # > 2x hb: the dead-listener has fired
+        st = probe.call("stats")["membership"]
+        assert st["state"] == "standby"  # refused the promotion
+        assert st["epoch"] == 0          # no view minted
+    finally:
+        try:
+            psrv.shutdown()
+        except Exception:
+            pass
+        _stop_server(ep_r, th)
+
+
+def test_round_abort_clears_standby_pending(monkeypatch):
+    """A WorkerDeadError round abort wipes the primary's pending grads;
+    the standby's forwarded copy must be wiped too — otherwise the
+    survivors' retried round double-counts on the replica alone and a
+    later promotion serves a silently diverged trajectory."""
+    from paddle_tpu.fluid import core
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+
+    slot = f"127.0.0.1:{free_port()}"
+    ep_r = f"127.0.0.1:{free_port()}"
+    monkeypatch.setenv("PADDLE_PS_HEARTBEAT_TIMEOUT", "1.5")
+    monkeypatch.setenv("PADDLE_PS_REPLICA_MAP", f"{slot}={ep_r}")
+    core.globals_["FLAGS_ps_replicas"] = 2
+
+    fwds = []
+    rsrv = VarServer(ep_r, {
+        "replica_apply": lambda fwd_method, kw, token=None, from_ep="",
+        view=None: fwds.append(fwd_method) or True,
+        "replica_beat": lambda from_ep="", view=None, chain_broken=False:
+            True,
+    }).start()
+    th, _ = _start_pserver_thread(slot, sync=True, fanin=2)
+    try:
+        cli = VarClient(slot, connect_timeout=30.0)
+        cli.call("heartbeat", trainer_id=1)  # trainer 1 checks in once
+        cli.send_var("g", np.ones(4, np.float32), trainer_id=0)
+        # trainer 1 goes silent; trainer 0's barrier aborts typed
+        with pytest.raises(core.WorkerDeadError):
+            cli.call("barrier", kind="send", trainer_id=0,
+                     _rpc_timeout=30.0)
+        assert "send_var" in fwds        # the round's grad was forwarded
+        assert "round_abort" in fwds     # ...and its abort reached the
+        cli.close()                      # standby too
+    finally:
+        rsrv.shutdown()
+        _stop_server(slot, th)
+
+
+# ==========================================================================
+# multiprocess chaos scenarios (tools/chaos_ps.py) — real SIGKILLs,
+# loss bit-parity vs a no-fault oracle
+# ==========================================================================
+def _run_chaos(scenario, tmp_path, **kw):
+    from tools import chaos_ps
+    return chaos_ps.run_scenario(scenario, str(tmp_path), model="linear",
+                                 trainers=2, n_pservers=2, steps=10,
+                                 hb=2.0, **kw)
+
+
+@pytest.mark.slow
+def test_chaos_drain_rejoin_sync_training_bit_identical(tmp_path):
+    """A live drain to a standby and a later rejoin-in-place, under
+    lock-stepped sync training with sparse tables: the trainers never
+    restart and every per-step loss matches the no-fault oracle bit for
+    bit (the between-rounds view flip is invisible to the math)."""
+    res = _run_chaos("drain_rejoin", tmp_path, drain_at=2, rejoin_at=6)
+    assert [e[0] for e in res["events"]] == ["drain", "rejoin"]
+    assert res["events"][0][3]["epoch"] == 1
+    assert res["events"][1][3]["epoch"] == 2
+    assert res["bit_identical"], (res["losses"], res["oracle_losses"])
+
+
+@pytest.mark.slow
+def test_chaos_sigkill_failover_bit_identical_and_bounded_stall(
+        tmp_path):
+    """SIGKILL the primary mid-training with FLAGS_ps_replicas=2: the
+    replica promotes itself, trainers stall at most ~2x the heartbeat
+    timeout, and — because applied updates were chain-forwarded and
+    replayed tokens answer from the forwarded registrations — the final
+    losses are bit-identical to the oracle (a double-applied or lost
+    update could not be)."""
+    res = _run_chaos("failover", tmp_path, kill_at=3)
+    assert res["events"][0][0] == "sigkill"
+    assert res["failover_stall_s"] < 2 * 2.0 + 8.0  # ~2x hb + slack
+    assert res["bit_identical"], (res["losses"], res["oracle_losses"])
+
+
+@pytest.mark.slow
+def test_chaos_wide_deep_full_acceptance(tmp_path):
+    """The ISSUE 6 acceptance run: a 3-trainer sync wide_deep cluster
+    survives a drain+rejoin on slot 0 AND a SIGKILL failover on slot 1
+    in one training run, finishing bit-identical to the no-fault
+    oracle."""
+    from tools import chaos_ps
+    res = chaos_ps.run_scenario("full", str(tmp_path),
+                                model="wide_deep", trainers=3,
+                                n_pservers=2, steps=14, hb=3.0)
+    kinds = [e[0] for e in res["events"]]
+    assert kinds == ["drain", "rejoin", "sigkill"]
+    assert res["failover_stall_s"] < 2 * 3.0 + 10.0
+    assert res["bit_identical"], (res["losses"], res["oracle_losses"])

@@ -1,0 +1,435 @@
+"""Scripted PS-membership chaos driver — drain / kill / rejoin
+(docs/FAULT_TOLERANCE.md "Elastic membership").
+
+Drives a real multiprocess sync PS cluster through membership faults and
+checks the training outcome against a no-fault oracle:
+
+  * ``drain_rejoin`` — live-drain pserver slot 0 to a warm standby
+    mid-training, later drain it BACK (rejoin-in-place: the drained
+    source is the destination of the reverse handoff). Trainers never
+    restart; per-step losses must be bit-identical to the oracle.
+  * ``failover`` — SIGKILL slot 0's primary mid-training with
+    FLAGS_ps_replicas=2 and a warm replica attached; trainers stall at
+    most ~2x the heartbeat timeout, then finish against the promoted
+    replica, bit-identical to the oracle.
+  * ``full`` — drain+rejoin on slot 0 AND a SIGKILL failover on slot 1,
+    one run (the ISSUE 6 acceptance scenario).
+
+Models: ``linear`` (tests/dist_ps_workload.py — tiny, fast) and
+``wide_deep`` (the CTR model from paddle_tpu.models.wide_deep with
+distributed embeddings, served by this module's ``worker`` subcommand).
+
+CLI:
+  python tools/chaos_ps.py --scenario full --model wide_deep \
+      --trainers 3 --steps 12 --hb 2.0
+
+Exit code 0 iff the faulted run finished AND matched the oracle
+bit-for-bit. The ``chaos`` pytest marker's slow acceptance test calls
+``run_scenario`` directly.
+"""
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # the driver's own admin RPCs import paddle_tpu
+    sys.path.insert(0, REPO)
+LINEAR_WORKLOAD = os.path.join(REPO, "tests", "dist_ps_workload.py")
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _spawn(args, log_path, env_extra=None):
+    env = dict(os.environ,
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu")
+    env.update(env_extra or {})
+    log = open(log_path, "wb+")
+    proc = subprocess.Popen([sys.executable] + list(args), env=env,
+                            stdout=log, stderr=log)
+
+    def tail(n=3000):
+        log.flush()
+        log.seek(0)
+        return log.read().decode(errors="replace")[-n:]
+
+    return proc, tail
+
+
+def _wait_file(path, timeout, procs=(), desc="file"):
+    end = time.time() + timeout
+    while time.time() < end:
+        if os.path.exists(path):
+            return
+        for p, tail in procs:
+            if p.poll() is not None:
+                raise RuntimeError(
+                    f"process died waiting for {desc}: {tail()}")
+        time.sleep(0.1)
+    raise TimeoutError(f"{desc} not ready within {timeout}s")
+
+
+def _progress(path):
+    try:
+        with open(path) as f:
+            return sum(1 for ln in f if ln.strip())
+    except OSError:
+        return 0
+
+
+def admin_drain(owner_ep, dest_ep, timeout=120.0):
+    """Drain the shard served at ``owner_ep`` (the slot's CURRENT
+    primary) into the standby at ``dest_ep``. Returns the handoff
+    summary dict from the source."""
+    from paddle_tpu.fluid.ps_rpc import VarClient
+    cli = VarClient(owner_ep, connect_timeout=min(10.0, timeout),
+                    channels=1, resolve=False)
+    try:
+        return cli.call("drain", dest=dest_ep, _rpc_timeout=timeout)
+    finally:
+        cli.close()
+
+
+def server_stats(ep):
+    from paddle_tpu.fluid.ps_rpc import VarClient
+    cli = VarClient(ep, connect_timeout=5.0, channels=1, resolve=False)
+    try:
+        return cli.call("stats", _rpc_timeout=10.0)
+    finally:
+        cli.close()
+
+
+class Cluster:
+    """One sync PS cluster run: n pservers (+ optional standbys and
+    replicas for chosen slots), t trainers logging per-step losses."""
+
+    def __init__(self, workdir, model="linear", trainers=2, n_pservers=2,
+                 steps=20, hb=2.0, step_sleep=0.15, standby_slots=(),
+                 replica_slots=(), sparse_dim=200, batch=32, tag="run"):
+        self.workdir = workdir
+        self.model = model
+        self.trainers = trainers
+        self.steps = steps
+        self.tag = tag
+        os.makedirs(workdir, exist_ok=True)
+        self.slot_eps = [f"127.0.0.1:{free_port()}"
+                         for _ in range(n_pservers)]
+        self.standby_eps = {i: f"127.0.0.1:{free_port()}"
+                            for i in standby_slots}
+        self.replica_eps = {i: f"127.0.0.1:{free_port()}"
+                            for i in replica_slots}
+        self.env = {"PADDLE_PS_HEARTBEAT_TIMEOUT": str(hb)}
+        if self.replica_eps:
+            self.env["FLAGS_ps_replicas"] = "2"
+            self.env["PADDLE_PS_REPLICA_MAP"] = ",".join(
+                f"{self.slot_eps[i]}={ep}"
+                for i, ep in self.replica_eps.items())
+        self.step_sleep = step_sleep
+        self.sparse_dim = sparse_dim
+        self.batch = batch
+        self.procs = []   # (name, proc, tail)
+        self.pserver_procs = {}  # slot idx -> (proc, tail)
+
+    # ------------------------------------------------------------ workers
+    def _worker_args(self, role, idx, outfile, extra=()):
+        eps = ",".join(self.slot_eps)
+        if self.model == "linear":
+            # model flags go to EVERY role: pservers transpile the same
+            # program to host the sparse table shards
+            base = [LINEAR_WORKLOAD, role, eps, str(idx),
+                    str(self.trainers), str(self.steps), outfile,
+                    "--sparse", f"--sparse-dim={self.sparse_dim}"]
+            if role == "trainer":
+                base += ["--progress", "--no-stop",
+                         f"--step-sleep={self.step_sleep}"]
+        else:
+            base = [os.path.abspath(__file__), "worker", role, eps,
+                    str(idx), str(self.trainers), str(self.steps),
+                    outfile, f"--sparse-dim={self.sparse_dim}",
+                    f"--batch={self.batch}",
+                    f"--step-sleep={self.step_sleep}"]
+        return base + list(extra)
+
+    def _out(self, name):
+        return os.path.join(self.workdir, f"{self.tag}-{name}")
+
+    def start_servers(self, timeout=120.0):
+        waits = []
+        for i, ep in enumerate(self.slot_eps):
+            ready = self._out(f"ps{i}.ready")
+            p, tail = _spawn(self._worker_args("pserver", i, ready),
+                             self._out(f"ps{i}.log"), self.env)
+            self.procs.append((f"ps{i}", p, tail))
+            self.pserver_procs[i] = (p, tail)
+            waits.append((ready, p, tail))
+        for i, bind in self.standby_eps.items():
+            ready = self._out(f"standby{i}.ready")
+            p, tail = _spawn(
+                self._worker_args("standby", i, ready,
+                                  extra=[f"--bind={bind}"]),
+                self._out(f"standby{i}.log"), self.env)
+            self.procs.append((f"standby{i}", p, tail))
+            waits.append((ready, p, tail))
+        for i, bind in self.replica_eps.items():
+            ready = self._out(f"replica{i}.ready")
+            p, tail = _spawn(
+                self._worker_args("standby", i, ready,
+                                  extra=[f"--bind={bind}", "--replica"]),
+                self._out(f"replica{i}.log"), self.env)
+            self.procs.append((f"replica{i}", p, tail))
+            waits.append((ready, p, tail))
+        for ready, p, tail in waits:
+            _wait_file(ready, timeout, [(p, tail)], desc=ready)
+
+    def start_trainers(self):
+        self.trainer_outs = []
+        for t in range(self.trainers):
+            out = self._out(f"t{t}.json")
+            p, tail = _spawn(self._worker_args("trainer", t, out),
+                             self._out(f"t{t}.log"), self.env)
+            self.procs.append((f"t{t}", p, tail))
+            self.trainer_outs.append((out, p, tail))
+
+    def trainer_progress(self, t=0):
+        return _progress(self.trainer_outs[t][0] + ".progress")
+
+    def wait_progress(self, n, t=0, timeout=300.0):
+        end = time.time() + timeout
+        while time.time() < end:
+            if self.trainer_progress(t) >= n:
+                return
+            p, tail = self.trainer_outs[t][1:]
+            if p.poll() is not None:
+                raise RuntimeError(
+                    f"trainer {t} died at progress "
+                    f"{self.trainer_progress(t)}: {tail()}")
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"trainer {t} stuck at {self.trainer_progress(t)}/{n}")
+
+    def kill_pserver(self, slot):
+        p, _tail = self.pserver_procs[slot]
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=30)
+
+    def join_trainers(self, timeout=600.0):
+        losses = []
+        for out, p, tail in self.trainer_outs:
+            rc = p.wait(timeout=timeout)
+            if rc != 0:
+                raise RuntimeError(f"trainer exited rc={rc}: {tail()}")
+            data = json.load(open(out))
+            losses.append(data if isinstance(data, list)
+                          else data.get("losses"))
+        return losses
+
+    def shutdown(self):
+        for _name, p, _tail in self.procs:
+            if p.poll() is None:
+                p.kill()
+        for _name, p, _tail in self.procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+def run_scenario(scenario, workdir, model="linear", trainers=3,
+                 n_pservers=2, steps=14, hb=2.0, drain_at=3, rejoin_at=7,
+                 kill_at=5, step_sleep=0.15, sparse_dim=200, batch=32,
+                 with_oracle=True):
+    """Run one chaos scenario (+ a no-fault oracle) and compare
+    per-trainer per-step losses bit-for-bit. Returns a result dict."""
+    result = {"scenario": scenario, "model": model, "events": []}
+    common = dict(model=model, trainers=trainers, n_pservers=n_pservers,
+                  steps=steps, hb=hb, step_sleep=step_sleep,
+                  sparse_dim=sparse_dim, batch=batch)
+    if with_oracle:
+        oracle = Cluster(workdir, tag="oracle", **common)
+        try:
+            oracle.start_servers()
+            oracle.start_trainers()
+            result["oracle_losses"] = oracle.join_trainers()
+        finally:
+            oracle.shutdown()
+
+    standby_slots = (0,) if scenario in ("drain_rejoin", "full") else ()
+    replica_slots = () if scenario == "drain_rejoin" else \
+        ((1,) if scenario == "full" and n_pservers > 1 else (0,))
+    run = Cluster(workdir, tag="chaos", standby_slots=standby_slots,
+                  replica_slots=replica_slots, **common)
+    try:
+        run.start_servers()
+        run.start_trainers()
+        stall_bound = 3 * hb + 10
+        if scenario in ("drain_rejoin", "full"):
+            slot = run.slot_eps[0]
+            standby = run.standby_eps[0]
+            run.wait_progress(drain_at)
+            summary = admin_drain(slot, standby)
+            result["events"].append(("drain", slot, standby, summary))
+            run.wait_progress(rejoin_at, timeout=stall_bound + 120)
+            summary = admin_drain(standby, slot)  # rejoin-in-place
+            result["events"].append(("rejoin", standby, slot, summary))
+        if scenario in ("failover", "full"):
+            kslot = 1 if scenario == "full" and n_pservers > 1 else 0
+            base = max(drain_at, rejoin_at) if scenario == "full" \
+                else 0
+            run.wait_progress(base + kill_at, timeout=stall_bound + 180)
+            t_kill = time.time()
+            run.kill_pserver(kslot)
+            result["events"].append(
+                ("sigkill", run.slot_eps[kslot], None, None))
+            # trainers must get moving again within ~2x hb (+slack)
+            target = run.trainer_progress(0) + 2
+            run.wait_progress(min(target, steps),
+                              timeout=stall_bound + 60)
+            result["failover_stall_s"] = time.time() - t_kill
+        result["losses"] = run.join_trainers(timeout=600.0)
+    finally:
+        run.shutdown()
+    if with_oracle:
+        result["bit_identical"] = \
+            result["losses"] == result["oracle_losses"]
+    return result
+
+
+# ---------------------------------------------------------------------------
+# wide_deep worker subcommand (pserver / standby / trainer roles)
+# ---------------------------------------------------------------------------
+def _flag_value(name, default=None):
+    for a in sys.argv:
+        if a.startswith(name + "="):
+            return a.split("=", 1)[1]
+    return default
+
+
+def run_worker():
+    role, eps, idx, trainers, steps, outfile = sys.argv[2:8]
+    idx, trainers, steps = int(idx), int(trainers), int(steps)
+    sparse_dim = int(_flag_value("--sparse-dim", 200) or 200)
+    batch = int(_flag_value("--batch", 32) or 32)
+    step_sleep = float(_flag_value("--step-sleep", 0) or 0)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.fluid.transpiler import DistributeTranspiler
+    from paddle_tpu.models import wide_deep
+
+    def build():
+        return wide_deep.build_wide_deep_program(
+            num_dense=4, num_slots=3, sparse_dim=sparse_dim,
+            embedding_dim=4, hidden=(16, 16), lr=1e-2, with_auc=False,
+            is_distributed=True, optimizer=fluid.optimizer.SGD(1e-2))
+
+    main, startup, feeds, loss, _auc = build()
+    t = DistributeTranspiler()
+    with fluid.program_guard(main, startup):
+        t.transpile(trainer_id=idx if role == "trainer" else 0,
+                    pservers=eps, trainers=trainers, sync_mode=True,
+                    program=main, startup_program=startup)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    if role in ("pserver", "standby"):
+        ep = eps.split(",")[idx]
+        if role == "standby":
+            bind = _flag_value("--bind")
+            pprog = t.get_pserver_program(
+                ep, bind_endpoint=bind, standby=True,
+                replica_of=ep if "--replica" in sys.argv else "")
+        else:
+            pprog = t.get_pserver_program(ep)
+        pstart = t.get_startup_program(ep, pprog)
+        with fluid.scope_guard(scope):
+            exe.run(pstart)
+            open(outfile, "w").write("ready")
+            exe.run(pprog)
+        return
+
+    from paddle_tpu.fluid.ps_rpc import VarClient, WorkerHeartBeat
+    hb_interval = max(0.25, float(
+        os.environ.get("PADDLE_PS_HEARTBEAT_TIMEOUT", 60.0)) / 4)
+    beat = WorkerHeartBeat(eps.split(","), idx,
+                           interval=hb_interval).start()
+    nb = wide_deep.ctr_reader(batch, num_dense=4, num_slots=3,
+                              sparse_dim=sparse_dim, seed=idx)
+    losses = []
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            prog = t.get_trainer_program()
+            for s in range(steps):
+                (lv,) = exe.run(prog, feed=nb(), fetch_list=[loss])
+                losses.append(float(np.asarray(lv).reshape(-1)[0]))
+                with open(outfile + ".progress", "a") as pf:
+                    pf.write(f"{s} {losses[-1]!r}\n")
+                if step_sleep:
+                    time.sleep(step_sleep)
+    finally:
+        beat.stop()
+    json.dump(losses, open(outfile, "w"))
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "worker":
+        run_worker()
+        return 0
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scenario", default="full",
+                    choices=["drain_rejoin", "failover", "full"])
+    ap.add_argument("--model", default="linear",
+                    choices=["linear", "wide_deep"])
+    ap.add_argument("--trainers", type=int, default=3)
+    ap.add_argument("--pservers", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=14)
+    ap.add_argument("--hb", type=float, default=2.0)
+    ap.add_argument("--drain-at", type=int, default=3)
+    ap.add_argument("--rejoin-at", type=int, default=7)
+    ap.add_argument("--kill-at", type=int, default=5)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--no-oracle", action="store_true")
+    args = ap.parse_args()
+    workdir = args.workdir or os.path.join(
+        tempfile.gettempdir(), f"chaos_ps_{int(time.time())}")
+    res = run_scenario(args.scenario, workdir, model=args.model,
+                       trainers=args.trainers, n_pservers=args.pservers,
+                       steps=args.steps, hb=args.hb,
+                       drain_at=args.drain_at, rejoin_at=args.rejoin_at,
+                       kill_at=args.kill_at,
+                       with_oracle=not args.no_oracle)
+    print(json.dumps(
+        {k: v for k, v in res.items() if "losses" not in k}, indent=1,
+        default=str))
+    if res.get("oracle_losses") is not None:
+        print("bit_identical:", res["bit_identical"])
+        if not res["bit_identical"]:
+            for t, (a, b) in enumerate(zip(res["losses"],
+                                           res["oracle_losses"])):
+                if a != b:
+                    print(f"trainer {t} diverged: chaos={a[-3:]} "
+                          f"oracle={b[-3:]}")
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
