@@ -1127,7 +1127,7 @@ class _SegmentedBlock(_CompiledBlock):
 
     def __init__(self, program: Program, feed_names: Tuple[str, ...],
                  fetch_names: Tuple[str, ...], scope: Scope, seed: int,
-                 feed_lods=None):
+                 feed_lods=None, seg_min_ops: Optional[int] = None):
         from .ir import analyze_block_segments
         self._scope_ref = weakref.ref(scope)
         self._init_lods: Dict[str, tuple] = dict(feed_lods or {})
@@ -1147,7 +1147,9 @@ class _SegmentedBlock(_CompiledBlock):
         self.segments = analyze_block_segments(ops)
         n_compilable = sum(len(s.ops) for s in self.segments
                            if s.kind == "compiled")
-        if n_compilable < core.globals_["FLAGS_executor_seg_min_ops"]:
+        if seg_min_ops is None:
+            seg_min_ops = core.globals_["FLAGS_executor_seg_min_ops"]
+        if n_compilable < seg_min_ops:
             raise _NotSegmentable(
                 f"only {n_compilable} compilable ops (< "
                 f"FLAGS_executor_seg_min_ops)")
@@ -1543,6 +1545,12 @@ class Executor:
         # counter would record the DISCARDED step and break the
         # rollback replay's bit-exactness
         self._last_step_tripped = False
+        # per-instance override of FLAGS_executor_seg_min_ops (None =
+        # use the global). The serving engine pins its private executor
+        # to 1 so even tiny stateful programs run their dense chains as
+        # compiled segments — an instance attribute, NOT a global flag
+        # swap, so a co-resident training executor can never observe it
+        self._seg_min_ops_override: Optional[int] = None
 
     def _build_segmented(self, program, feed, fetch_names, scope, seed,
                          feed_lods) -> Optional[_SegmentedBlock]:
@@ -1556,7 +1564,8 @@ class Executor:
         try:
             return _SegmentedBlock(program, tuple(sorted(feed)),
                                    tuple(fetch_names), scope, seed,
-                                   feed_lods=feed_lods)
+                                   feed_lods=feed_lods,
+                                   seg_min_ops=self._seg_min_ops_override)
         except _NotSegmentable:
             return None
         except (KeyError, RuntimeError):

@@ -227,11 +227,32 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     for i, name in enumerate(target_names):
         block.append_op(type="fetch", inputs={"X": [name]},
                         outputs={"Out": ["fetch"]}, attrs={"col": i})
+    # drop vars no surviving op references (optimizer slot vars — adam
+    # moments/beta pows — that the backward+optimize prune orphaned):
+    # they must neither serialize into the inference ProgramDesc nor be
+    # saved below, and load_inference_model's load_persistables reads
+    # the program's own var list, so program and params stay consistent.
+    # Scan EVERY block, not just the global one: a persistable read only
+    # inside a while/conditional_block sub-block must survive the drop
+    used = {"feed", "fetch"}
+    for blk in pruned.blocks:
+        for op in blk.ops:
+            used.update(op.input_arg_names)
+            used.update(op.output_arg_names)
+    for name in [n for n in block.vars if n not in used]:
+        del block.vars[name]
     model_name = model_filename or "__model__"
     with open(os.path.join(dirname, model_name), "wb") as f:
         f.write(pruned.serialize_to_string())
     if not program_only:
-        save_persistables(executor, dirname, main_program, params_filename)
+        # persistables of the PRUNED program, not the training program:
+        # optimizer slot vars (adam moments, ...) are dead weight in a
+        # serving dir — for wide_deep they dwarf the model — and a
+        # params_filename combined stream saved from the full var list
+        # would not line up with the pruned list load_inference_model
+        # deserializes against (reference io.py saves the pruned
+        # program's vars for the same reason)
+        save_persistables(executor, dirname, pruned, params_filename)
     return [v.name if isinstance(v, Variable) else v for v in target_vars]
 
 

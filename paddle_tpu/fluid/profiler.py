@@ -26,7 +26,7 @@ import jax
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
            "stop_profiler", "record_event", "RecordEvent", "is_profiling",
-           "record_span"]
+           "record_span", "record_instant"]
 
 
 class _Event:
@@ -143,14 +143,18 @@ class RecordEvent:
     manager or decorator; no-op when profiling is off. ``cat`` groups
     spans in the chrome trace — the segmented executor emits its
     per-segment compile/exec and island spans under cat='segment' so the
-    compiled/interpreted partition of a step is visible at a glance, and
+    compiled/interpreted partition of a step is visible at a glance,
     multi-step windows emit one cat='window' span per dispatched window
     (window[K]:realdata | :broadcast | :fallback — the one-dispatch-per-
-    window evidence tests/test_window_executor.py counts)."""
+    window evidence tests/test_window_executor.py counts), and the
+    serving plane emits cat='serve' queue-wait/exec spans whose ``args``
+    carry bucket + batch-size chrome-trace payloads
+    (docs/SERVING.md)."""
 
-    def __init__(self, name: str, cat: str = "host"):
+    def __init__(self, name: str, cat: str = "host", args=None):
         self.name = name
         self.cat = cat
+        self.args = args
         self._start = 0.0
 
     def __enter__(self):
@@ -165,7 +169,8 @@ class RecordEvent:
         # landing mid-span must not leak the entered TraceAnnotation
         if self._start:
             self._ann.__exit__(exc_type, exc_val, exc_tb)
-            _record(self.name, self._start, time.perf_counter(), self.cat)
+            _record(self.name, self._start, time.perf_counter(), self.cat,
+                    self.args)
             self._start = 0.0
         return False
 

@@ -79,6 +79,31 @@ PROTO_PICKLE = 1   # legacy: one pickle blob carries tensors too
 PROTO_BINARY = 2   # v2: pickled header + raw zero-copy tensor buffers
 WIRE_VERSION = 2
 
+# ---------------------------------------------------------------------------
+# serving-time embedding row cache hook (docs/SERVING.md). When a cache is
+# installed, distributed_lookup_table FORWARD pulls consult it before
+# fanning out to the pservers — a fully-hit lookup issues zero RPCs.
+# Gradient pushes never touch it, and nothing installs one in training
+# processes; the ServingEngine installs its EmbeddingCache for its
+# lifetime. Process-global by design (the op kernels have no serving
+# context): the last installed cache wins, installers restore the
+# previous one on teardown.
+_ROW_CACHE = None
+
+
+def install_row_cache(cache):
+    """Install ``cache`` (EmbeddingCache-shaped: ``lookup(table, ids,
+    fetch_fn)``) as the process row cache; returns the previously
+    installed cache (or None) so callers can restore it."""
+    global _ROW_CACHE
+    prev = _ROW_CACHE
+    _ROW_CACHE = cache
+    return prev
+
+
+def current_row_cache():
+    return _ROW_CACHE
+
 
 def _pickle_wire_forced() -> bool:
     """PADDLE_TPU_PS_PICKLE_WIRE=1 is the LEGACY DATA-PLANE mode: the

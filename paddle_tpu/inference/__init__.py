@@ -57,6 +57,18 @@ def enable_compile_cache(cache_dir: str):
     os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
     os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
     os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "-1"
+    # jax initializes the cache module LAZILY at the first compile and
+    # never re-reads the config after that — enabling the cache in a
+    # process that already compiled anything (a predictor created after
+    # model-building ran, the serving cold-start shape) was a silent
+    # no-op: zero entries ever written. Force a re-init so the NEXT
+    # compile picks the directory up.
+    try:
+        from jax._src import compilation_cache as _cc
+        if getattr(_cc, "is_initialized", None) and _cc.is_initialized():
+            _cc.reset_cache()
+    except Exception:
+        pass  # older/newer jax: first-compile init reads the config
     _COMPILE_CACHE_DIR = cache_dir
 
 

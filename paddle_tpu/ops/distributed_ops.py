@@ -302,6 +302,29 @@ def _table_dtype(ctx, w_name):
         return jnp.float32
 
 
+def _pull_rows_sharded(eps, w_name, uniq):
+    """One deduped row pull, row-sharded across ``eps`` by
+    ``id %% n_pservers`` with every per-pserver section RPC issued
+    concurrently (reference parameter_prefetch overlap). ``uniq`` must
+    hold distinct ids; returns [len(uniq), dim] in input order."""
+    uniq = np.asarray(uniq)
+    if len(eps) == 1:
+        return np.asarray(_client(eps[0]).prefetch_rows(w_name, uniq))
+    shard = uniq % len(eps)
+    sels = [np.where(shard == k)[0] for k in range(len(eps))]
+    live = [(ep, sel) for ep, sel in zip(eps, sels) if len(sel)]
+
+    def _pull(ep, sel):
+        return np.asarray(_client(ep).prefetch_rows(w_name, uniq[sel]))
+
+    parts = _fanout([(lambda ep=ep, sel=sel: _pull(ep, sel))
+                     for ep, sel in live])
+    rows_u = np.empty((len(uniq), parts[0].shape[-1]), parts[0].dtype)
+    for (_ep, sel), part in zip(live, parts):
+        rows_u[sel] = part
+    return rows_u
+
+
 @register_op("distributed_lookup_table", stateful=True,
              attr_defaults={"epmap": [], "table_names": [], "padding_idx": -1,
                             "is_distributed": True, "trainer_id": 0})
@@ -309,7 +332,14 @@ def _distributed_lookup_table(ins, attrs):
     """Pulls embedding rows from the pserver-resident table, row-sharded
     across ALL endpoints in epmap by ``id %% n_pservers`` (reference:
     distributed_lookup_table_op.cc over parameter_prefetch.cc, which
-    splits ids per-section the same way)."""
+    splits ids per-section the same way).
+
+    Serving mode (docs/SERVING.md): when a row cache is installed
+    (``ps_rpc.install_row_cache`` — the ServingEngine's EmbeddingCache),
+    the deduped id set consults it first and only the misses fan out;
+    a fully-hit lookup issues ZERO RPCs. Training paths never install a
+    cache, so this is dead code there."""
+    from ..fluid import ps_rpc as _ps_rpc
     ctx = attrs["_ctx"]
     id_names = ctx.op.input("Ids")
     w_name = (attrs.get("table_names") or ctx.op.input("W"))[0]
@@ -332,27 +362,13 @@ def _distributed_lookup_table(ins, attrs):
             uniq, inv = ids, np.arange(len(ids))
         else:
             uniq, inv = np.unique(ids, return_inverse=True)
-        if len(eps) == 1:
-            rows_u = np.asarray(
-                _client(eps[0]).prefetch_rows(w_name, uniq))
+        cache = _ps_rpc.current_row_cache()
+        if cache is not None:
+            rows_u = cache.lookup(
+                w_name, uniq,
+                lambda miss: _pull_rows_sharded(eps, w_name, miss))
         else:
-            # all per-pserver section RPCs issued concurrently, joined
-            # after (reference parameter_prefetch overlap)
-            shard = uniq % len(eps)
-            sels = [np.where(shard == k)[0] for k in range(len(eps))]
-            live = [(ep, sel) for ep, sel in zip(eps, sels) if len(sel)]
-
-            def _pull(ep, sel):
-                return np.asarray(
-                    _client(ep).prefetch_rows(w_name, uniq[sel]))
-
-            parts = _fanout([
-                (lambda ep=ep, sel=sel: _pull(ep, sel))
-                for ep, sel in live])
-            rows_u = np.empty((len(uniq), parts[0].shape[-1]),
-                              parts[0].dtype)
-            for (_ep, sel), part in zip(live, parts):
-                rows_u[sel] = part
+            rows_u = _pull_rows_sharded(eps, w_name, uniq)
         outs.append(jnp.asarray(rows_u[inv]))
     return {"Outputs": outs}
 

@@ -1,0 +1,40 @@
+"""Online inference serving plane (docs/SERVING.md).
+
+The reference ships a standalone inference engine — AnalysisConfig/
+AnalysisPredictor, ZeroCopyTensor, predictor Clone() for multi-threaded
+serving, PredictorPool (analysis_predictor.cc:288,:497) — and leaves
+request batching and remote-table serving to the application. This
+package is that missing production layer over `paddle_tpu.inference`:
+
+  * `BatchingQueue` — continuous batcher: concurrent `predict()` calls
+    coalesce into padded power-of-two buckets (PR 2 stack-and-mask,
+    pad rows provably inert), `max_batch` / `max_queue_delay_ms` knobs.
+  * `ServingEngine` — the predictor pool: N worker threads share ONE
+    compiled executable + read-only param scope (reference Clone()
+    semantics, zero weight copies); per-bucket jit caching so
+    steady-state traffic never recompiles; `stats()` with QPS,
+    batch-size histogram, p50/p99 and cache hit rate; cat="serve"
+    profiler spans.
+  * `EmbeddingCache` + `rewrite_sparse_lookups` — serving-time sparse
+    path: `distributed_lookup_table` pulls over the PR 4 binary wire
+    against live pservers, fronted by a TTL + LRU row cache, so
+    wide_deep serves without materializing the table in-process (and a
+    PR 6 drain/failover re-routes transparently mid-serving).
+
+Quick start::
+
+    pred = inference.create_predictor(inference.Config(model_dir))
+    with ServingEngine(pred, max_batch=32,
+                       max_queue_delay_ms=2.0) as eng:
+        eng.warm()
+        (prob,) = eng.predict({"x": row})       # blocks, [1, *out]
+        fut = eng.submit({"x": row})            # async, .wait()
+        print(eng.stats()["qps"])
+"""
+from .batching import BatchingQueue, Request, next_bucket
+from .embedding_cache import EmbeddingCache
+from .engine import ServingEngine
+from .sparse import rewrite_sparse_lookups
+
+__all__ = ["ServingEngine", "BatchingQueue", "Request", "next_bucket",
+           "EmbeddingCache", "rewrite_sparse_lookups"]

@@ -1,0 +1,163 @@
+"""Dynamic/continuous request batching for the online serving plane.
+
+The reference serves concurrent traffic by cloning AnalysisPredictor per
+thread (analysis_predictor.cc Clone + paddle_inference_api.h
+PredictorPool) and leaves batching to the application. Here batching is
+the system's job: a ``BatchingQueue`` coalesces concurrent ``predict()``
+calls — each a single row (or a small row group) — into ONE padded
+power-of-two bucket per dispatch, the same stack-and-mask idiom the
+PR 2 window machinery uses for training feeds (``WindowBatch.n_valid``):
+pad rows repeat the last real row and are sliced away after the
+dispatch, so they can never change a real row's output.
+
+Flush policy (the continuous-batching contract):
+  * a batch dispatches as soon as ``max_batch`` rows are pending, or
+  * when the OLDEST pending request has waited ``max_queue_delay_ms``
+    — a lone request never waits for company longer than the knob.
+Requests are atomic: a multi-row request rides one bucket whole.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["BatchingQueue", "Request", "next_bucket"]
+
+
+def next_bucket(n: int) -> int:
+    """Smallest power of two >= n — the compiled bucket a batch of n
+    rows pads into. Bounding the shape set to powers of two is what
+    makes steady-state traffic stop recompiling: every batch size in
+    [1, max_batch] lands in one of log2(max_batch)+1 cached
+    executables."""
+    if n <= 1:
+        return 1
+    return 1 << (int(n - 1).bit_length())
+
+
+class Request:
+    """One in-flight predict() call: ``rows`` maps feed name to an
+    [n, *sample] array; the worker fulfils ``_event`` with either the
+    per-fetch row slices or an error. Also the future handed back by
+    the async submit path."""
+
+    __slots__ = ("rows", "n", "t_submit", "t_dispatch", "t_done",
+                 "_event", "_result", "_error")
+
+    def __init__(self, rows: Dict[str, np.ndarray], n: int):
+        self.rows = rows
+        self.n = int(n)
+        self.t_submit = time.perf_counter()
+        self.t_dispatch = 0.0
+        self.t_done = 0.0  # stamped at fulfilment (open-loop latency)
+        self._event = threading.Event()
+        self._result: Optional[List[np.ndarray]] = None
+        self._error: Optional[BaseException] = None
+
+    # -------------------------------------------------- future surface
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Block until the batch carrying this request executed; returns
+        one [n, *out] array per fetch target, or re-raises the batch's
+        error."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"predict() result not ready after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    # worker-side
+    def set_result(self, result: List[np.ndarray]) -> None:
+        self._result = result
+        self.t_done = time.perf_counter()
+        self._event.set()
+
+    def set_error(self, err: BaseException) -> None:
+        self._error = err
+        self.t_done = time.perf_counter()
+        self._event.set()
+
+
+class BatchingQueue:
+    """The continuous batcher: clients ``submit`` row requests, worker
+    threads ``take`` coalesced batches. Thread-safe; ``close()`` wakes
+    every waiter (pending requests still drain — a server shutdown must
+    not drop accepted work)."""
+
+    def __init__(self, max_batch: int = 64,
+                 max_queue_delay_ms: float = 2.0):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = int(max_batch)
+        self.max_queue_delay_s = float(max_queue_delay_ms) / 1000.0
+        self._pending: "deque[Request]" = deque()
+        self._rows_pending = 0
+        self._cv = threading.Condition()
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._cv:
+            return self._rows_pending
+
+    def submit(self, req: Request) -> Request:
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("BatchingQueue is closed")
+            self._pending.append(req)
+            self._rows_pending += req.n
+            self._cv.notify_all()
+        return req
+
+    def take(self, timeout: Optional[float] = None) -> List[Request]:
+        """Block until a batch is ready under the flush policy and pop
+        it (whole requests, up to ``max_batch`` rows — an oversized
+        request larger than max_batch dispatches alone). Returns [] on
+        ``timeout`` with nothing pending, or when closed and drained —
+        the worker-loop poll shape."""
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        with self._cv:
+            while True:
+                now = time.perf_counter()
+                if self._pending:
+                    flush_at = (self._pending[0].t_submit
+                                + self.max_queue_delay_s)
+                    if (self._rows_pending >= self.max_batch
+                            or now >= flush_at or self._closed):
+                        return self._pop_locked()
+                    wait = flush_at - now
+                    if deadline is not None:
+                        wait = min(wait, deadline - now)
+                else:
+                    if self._closed:
+                        return []
+                    if deadline is not None:
+                        wait = deadline - now
+                        if wait <= 0:
+                            return []
+                    else:
+                        wait = None
+                self._cv.wait(wait if wait is None else max(wait, 1e-4))
+
+    def _pop_locked(self) -> List[Request]:
+        batch: List[Request] = []
+        rows = 0
+        while self._pending and (
+                not batch
+                or rows + self._pending[0].n <= self.max_batch):
+            r = self._pending.popleft()
+            batch.append(r)
+            rows += r.n
+        self._rows_pending -= rows
+        return batch
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()

@@ -1,0 +1,141 @@
+"""Client-side embedding-row cache for serving-time sparse lookups.
+
+At serving QPS the hot ids of a CTR workload repeat heavily batch to
+batch; pulling them from the pservers on every request wastes the wire
+the PR 4 data plane made fast. This cache fronts
+``distributed_lookup_table`` pulls (hook: ``fluid.ps_rpc
+.install_row_cache``): a fully-hit lookup issues ZERO RPCs, misses
+fan out to the pservers as usual and fill the cache.
+
+Consistency contract (docs/SERVING.md "Embedding-cache staleness"): a
+cached row is served for up to ``ttl_s`` seconds after its fetch even
+if a trainer has since updated the table — online serving trades
+bounded staleness for RPC elision, exactly like the reference's
+serving-side quantized/compressed table snapshots. Set ``ttl_s=0`` to
+make every lookup re-validate (cache becomes a dedup layer only), or
+don't install the cache where bit-freshness matters.
+
+Bounded: ``max_entries`` rows, LRU-evicted. All counters are exposed
+via ``stats()`` and surface in ``ServingEngine.stats()``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict
+
+import numpy as np
+
+__all__ = ["EmbeddingCache"]
+
+
+class EmbeddingCache:
+    """(table, id) -> row cache with TTL + max-entries LRU.
+
+    ``lookup`` is the one entry point: resolves hits under the lock,
+    fetches the missing ids through ``fetch_fn`` OUTSIDE the lock (an
+    RPC must never block other threads' hit paths), then fills. Two
+    threads missing the same id may both fetch it — benign duplicate
+    work, never wrong data."""
+
+    def __init__(self, ttl_s: float = 30.0, max_entries: int = 1_000_000):
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        self.ttl_s = float(ttl_s)
+        self.max_entries = int(max_entries)
+        self._rows: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._lock = threading.Lock()
+        # bumped by invalidate(): an in-flight miss fetch that STARTED
+        # before the invalidation must not fill the cache afterwards —
+        # it may carry pre-push rows, and caching them would defeat the
+        # "visible immediately" contract for up to another ttl_s
+        self._gen = 0
+        # injectable clock so tests drive TTL expiry without sleeping
+        self._clock = time.monotonic
+        self.hits = 0
+        self.misses = 0
+        self.expired = 0      # staleness counter: TTL'd entries refetched
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def lookup(self, table: str, ids, fetch_fn: Callable) -> np.ndarray:
+        """Rows for ``ids`` (any int array-like), cached where possible.
+        ``fetch_fn(missing_ids)`` -> [len(missing), dim] array pulls the
+        rest from the pservers. Returns [len(ids), dim] in input order,
+        bit-identical to an uncached pull while the table is
+        unchanged."""
+        ids = np.asarray(ids).reshape(-1)
+        out = [None] * len(ids)
+        missing_idx = []
+        now = self._clock()
+        with self._lock:
+            gen0 = self._gen
+            for i, id_ in enumerate(ids.tolist()):
+                key = (table, id_)
+                ent = self._rows.get(key)
+                if ent is not None:
+                    row, stamp = ent
+                    if self.ttl_s > 0 and (now - stamp) <= self.ttl_s:
+                        self._rows.move_to_end(key)
+                        out[i] = row
+                        self.hits += 1
+                        continue
+                    # stale: drop now so a concurrent hit can't serve it
+                    # while our refetch is in flight
+                    del self._rows[key]
+                    self.expired += 1
+                self.misses += 1
+                missing_idx.append(i)
+        if missing_idx:
+            miss_ids = ids[missing_idx]
+            # duplicate ids within the miss set fetch once
+            uniq, inv = np.unique(miss_ids, return_inverse=True)
+            fetched = np.asarray(fetch_fn(uniq))
+            if fetched.shape[0] != len(uniq):
+                raise ValueError(
+                    f"fetch_fn returned {fetched.shape[0]} rows for "
+                    f"{len(uniq)} ids")
+            now = self._clock()
+            with self._lock:
+                if self._gen == gen0:  # no invalidate() raced the fetch
+                    for j, id_ in enumerate(uniq.tolist()):
+                        # detach: the caller may mutate/donate its arrays
+                        self._rows[(table, id_)] = (np.array(fetched[j]),
+                                                    now)
+                    while len(self._rows) > self.max_entries:
+                        self._rows.popitem(last=False)
+                        self.evictions += 1
+            for k, i in enumerate(missing_idx):
+                out[i] = fetched[inv[k]]
+        return np.asarray(out)
+
+    def invalidate(self, table: str = None) -> None:
+        """Drop every entry (or just one table's) — e.g. after a model/
+        table push the operator wants visible immediately. Also fences
+        in-flight miss fetches: rows fetched before this call cannot
+        fill the cache after it."""
+        with self._lock:
+            self._gen += 1
+            if table is None:
+                self._rows.clear()
+                return
+            for key in [k for k in self._rows if k[0] == table]:
+                del self._rows[key]
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "entries": len(self._rows),
+                "max_entries": self.max_entries,
+                "ttl_s": self.ttl_s,
+                "hits": self.hits,
+                "misses": self.misses,
+                "expired": self.expired,
+                "evictions": self.evictions,
+                "hit_rate": (self.hits / total) if total else 0.0,
+            }

@@ -1,0 +1,443 @@
+"""ServingEngine — concurrent predictor pool over one shared compiled
+executable, fed by the continuous batcher.
+
+Reference shape (analysis_predictor.cc Clone + paddle_inference_api.h
+PredictorPool): N serving threads share ONE params scope and one
+prepared executor. TPU inversion: the "prepared executor" is a single
+traced+jitted step (`fluid.executor._CompiledBlock`) whose parameters
+are read-only jax arrays in the shared scope — worker threads dispatch
+it concurrently with zero per-clone weight copies and no locking on the
+happy path (jit dispatch is thread-safe; a forward program has no
+mutable state to write back).
+
+Execution modes (picked automatically, overridable):
+
+  * ``scan`` (fully-compilable programs, the default): a bucket of K
+    rows dispatches as ONE ``lax.scan`` over K single-row steps — the
+    PR 2 window machinery driven at n_steps=K with every feed windowed.
+    Per-row outputs are BIT-IDENTICAL to the single-row unbatched
+    oracle by construction (each scan slice traces the exact single-row
+    computation), which a fused batch-dim gemm is NOT: XLA CPU blocks
+    reductions differently per batch size (measured up to ~1e-6
+    relative drift — docs/SERVING.md "Batching contract"). Pad rows
+    repeat the last real row and are sliced away: provably inert.
+    The per-bucket scanned-jit cache (`_CompiledBlock._multi_jit`,
+    keyed by K) is exactly the serving bucket cache — power-of-two
+    padding bounds it to log2(max_batch)+1 executables, so steady-state
+    traffic never recompiles.
+
+  * ``fused``: the bucket runs as one batch-dim step (one gemm over
+    [K, ...]). Fastest on real MXU hardware; per-row bits drift within
+    fp tolerance across bucket sizes. Programs with stateful ops
+    (serving-time ``distributed_lookup_table`` pulls, metrics) always
+    take this mode through a lock-serialized private Executor — for the
+    PS path batching is what coalesces B rows' ids into ONE deduped
+    RPC fan-out per table.
+
+Sparse serving: pass ``embedding_cache=EmbeddingCache(...)`` and the
+engine installs it as the process row cache
+(``fluid.ps_rpc.install_row_cache``) for its lifetime — cache-hit
+lookups issue zero RPCs (docs/SERVING.md staleness caveat applies).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .batching import BatchingQueue, Request, next_bucket
+
+__all__ = ["ServingEngine", "percentiles_ms"]
+
+
+def percentiles_ms(vals_s, qs=(50, 99), suffix: str = "") -> Dict[str, float]:
+    """Latency percentiles in ms over seconds samples — the ONE helper
+    both the engine's stats() and tools/serving_loadgen report through,
+    so the two latency surfaces benches compare side by side can never
+    drift in interpolation or units."""
+    keys = [f"p{q}{suffix}" for q in qs] + [f"mean{suffix}",
+                                            f"max{suffix}"]
+    if not len(vals_s):
+        return {k: 0.0 for k in keys}
+    a = np.asarray(vals_s, np.float64) * 1e3
+    out = {f"p{q}{suffix}": float(np.percentile(a, q)) for q in qs}
+    out[f"mean{suffix}"] = float(a.mean())
+    out[f"max{suffix}"] = float(a.max())
+    return out
+
+
+class ServingEngine:
+    def __init__(self, predictor=None, *, program=None, scope=None,
+                 feed_names: Optional[Sequence[str]] = None,
+                 fetch_names: Optional[Sequence[str]] = None,
+                 num_workers: int = 2, max_batch: int = 64,
+                 max_queue_delay_ms: float = 2.0,
+                 batch_mode: Optional[str] = None,
+                 embedding_cache=None, seed: int = 0):
+        import jax
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import core
+        from paddle_tpu.fluid import executor as executor_mod
+
+        if predictor is not None:
+            program = predictor._program
+            scope = predictor._scope
+            feed_names = list(predictor._feed_names)
+            fetch_names = list(predictor._fetch_names)
+        if program is None or scope is None or not feed_names \
+                or not fetch_names:
+            raise ValueError(
+                "ServingEngine needs a predictor OR explicit "
+                "program/scope/feed_names/fetch_names")
+        self._program = program
+        self._scope = scope
+        self._feed_names = tuple(feed_names)
+        self._fetch_names = tuple(
+            n.name if hasattr(n, "name") else n for n in fetch_names)
+        self._core = core
+
+        block = program.global_block()
+        ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+        compilable = (core.globals_["FLAGS_executor_mode"] == "compiled"
+                      and executor_mod._ops_compilable(ops))
+        if batch_mode is None:
+            batch_mode = "scan" if compilable else "fused"
+        if batch_mode not in ("scan", "fused"):
+            raise ValueError(f"batch_mode must be 'scan' or 'fused', "
+                             f"got {batch_mode!r}")
+        if batch_mode == "scan" and not compilable:
+            raise ValueError(
+                "batch_mode='scan' needs a fully-compilable program — "
+                "this one has stateful/host ops (e.g. serving-time "
+                "distributed_lookup_table); use batch_mode='fused'")
+        self.batch_mode = batch_mode
+
+        # feed sample shapes/dtypes from the block var descs: rows are
+        # validated + cast ONCE at submit so a float64 client row can't
+        # poison the jit cache with a second signature
+        self._sample: Dict[str, Tuple[tuple, Any]] = {}
+        for n in self._feed_names:
+            v = block.vars.get(n)
+            shape = tuple(getattr(v, "shape", ()) or ())
+            if shape and int(shape[0]) < 0:
+                shape = shape[1:]
+            try:
+                dt = np.dtype(core.dtype_to_np(v.dtype))
+            except Exception:
+                dt = np.dtype(np.float32)
+            self._sample[n] = (tuple(int(d) for d in shape), dt)
+
+        self._cb = None
+        self._exe = None
+        self._exe_lock = threading.Lock()
+        self._rng = jax.random.PRNGKey(int(seed))
+        if compilable:
+            seed_v = program.random_seed or core.globals_["FLAGS_seed"]
+            # ONE compiled block shared by every worker — the
+            # PredictorPool "clone" that never copies weights. guard
+            # off: a serving step has no optimizer state for the
+            # numeric fault plane to select back.
+            self._cb = executor_mod._CompiledBlock(
+                program, tuple(sorted(self._feed_names)),
+                self._fetch_names, scope, seed_v, guard=False)
+        else:
+            self._exe = fluid.Executor()
+            # force segmentation even for tiny programs: the min-ops
+            # heuristic is a training tradeoff (a small program isn't
+            # worth the compile), but a serving step runs the same
+            # bucket forever AND the eager per-op interpreter's fp
+            # fusion drifts ~1 ulp from the compiled local-table oracle
+            # — segmented dense chains are both faster and bit-exact
+            # (docs/SERVING.md "Batching contract"). Per-instance
+            # override: a co-resident training executor never sees it.
+            self._exe._seg_min_ops_override = 1
+
+        # ---- stats --------------------------------------------------
+        self._stats_lock = threading.Lock()
+        self._t_start = time.perf_counter()
+        self._n_requests = 0
+        self._n_rows = 0
+        self._n_batches = 0
+        self._n_errors = 0
+        self._batch_hist: Dict[int, int] = {}
+        self._bucket_hist: Dict[int, int] = {}
+        self._buckets_seen: set = set()  # survives reset_stats
+        self._done: "deque[tuple]" = deque(maxlen=16384)  # (t, lat_s)
+        self._qwait: "deque[float]" = deque(maxlen=16384)
+
+        # ---- worker pool --------------------------------------------
+        self._queue = BatchingQueue(max_batch=max_batch,
+                                    max_queue_delay_ms=max_queue_delay_ms)
+        self._closed = False
+        self._workers = []
+        for i in range(max(1, int(num_workers))):
+            t = threading.Thread(target=self._worker_loop,
+                                 name=f"serving-worker-{i}", daemon=True)
+            t.start()
+            self._workers.append(t)
+
+        # ---- embedding cache (process-global hook) ------------------
+        # installed LAST: every earlier init step can raise, and a
+        # constructor that dies after installing would leak the cache
+        # into the process (close() is unreachable on a half-built
+        # engine) — all subsequent lookups would silently serve stale
+        self.embedding_cache = embedding_cache
+        self._cache_installed = False
+        if embedding_cache is not None:
+            from paddle_tpu.fluid import ps_rpc
+            self._cache_prev = ps_rpc.install_row_cache(embedding_cache)
+            self._cache_installed = True
+
+    # ------------------------------------------------------------ client
+    def _normalize(self, feed: Dict[str, Any], many: bool):
+        missing = [n for n in self._feed_names if n not in feed]
+        if missing:
+            raise KeyError(f"predict(): feed missing {missing}")
+        rows: Dict[str, np.ndarray] = {}
+        n = None
+        for name in self._feed_names:
+            shape, dt = self._sample[name]
+            a = np.asarray(feed[name])
+            if a.dtype != dt:
+                a = a.astype(dt)
+            if many:
+                if tuple(a.shape[1:]) != shape:
+                    raise ValueError(
+                        f"predict_many(): '{name}' rows must be "
+                        f"[n, {shape}], got {a.shape}")
+            else:
+                if tuple(a.shape) == shape:
+                    a = a[None]
+                elif tuple(a.shape) != (1,) + shape:
+                    raise ValueError(
+                        f"predict(): '{name}' must be one sample of "
+                        f"shape {shape} (or [1, *sample]), got {a.shape}")
+            if n is None:
+                n = a.shape[0]
+            elif a.shape[0] != n:
+                raise ValueError(
+                    f"predict(): ragged row counts across feeds "
+                    f"({n} vs {a.shape[0]} for '{name}')")
+            rows[name] = a
+        if n == 0:
+            raise ValueError("predict(): zero rows")
+        return rows, n
+
+    def submit(self, feed: Dict[str, Any], many: bool = False) -> Request:
+        """Async submit: returns the request future (``.wait()``).
+        The open-loop loadgen path."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        rows, n = self._normalize(feed, many)
+        return self._queue.submit(Request(rows, n))
+
+    def predict(self, feed: Dict[str, Any],
+                timeout: Optional[float] = 120.0) -> List[np.ndarray]:
+        """One sample in, one row out: blocks until this row's batch
+        executed; returns one [1, *out] array per fetch target —
+        exactly the shape ``AnalysisPredictor.run([sample[None]])``
+        returns, so the single-row oracle comparison is direct."""
+        return self.submit(feed, many=False).wait(timeout)
+
+    def predict_many(self, feed: Dict[str, Any],
+                     timeout: Optional[float] = 120.0) -> List[np.ndarray]:
+        """A row group [n, *sample] riding one bucket atomically;
+        returns [n, *out] per fetch target."""
+        return self.submit(feed, many=True).wait(timeout)
+
+    # ------------------------------------------------------------ worker
+    def _worker_loop(self):
+        while True:
+            reqs = self._queue.take(timeout=0.2)
+            if not reqs:
+                if self._closed and not len(self._queue):
+                    return
+                continue
+            try:
+                self._execute(reqs)
+            except BaseException as e:  # deliver, don't kill the worker
+                for r in reqs:
+                    # only genuinely unfulfilled requests get the error:
+                    # an exception AFTER some set_result calls (e.g. a
+                    # shape mismatch slicing a later request) must not
+                    # turn an already-delivered good result into a
+                    # spurious error for a client that hasn't woken yet
+                    if not r.done():
+                        r.set_error(e)
+                with self._stats_lock:
+                    self._n_errors += 1
+
+    def _execute(self, reqs: List[Request]):
+        from paddle_tpu.fluid import profiler as _profiler
+
+        t_take = time.perf_counter()
+        n_valid = sum(r.n for r in reqs)
+        bucket = next_bucket(n_valid)
+        stacked: Dict[str, np.ndarray] = {}
+        for name in self._feed_names:
+            arr = (reqs[0].rows[name] if len(reqs) == 1
+                   else np.concatenate([r.rows[name] for r in reqs],
+                                       axis=0))
+            if bucket > n_valid:
+                # stack-and-mask idiom (WindowBatch.n_valid): pad rows
+                # repeat the last real row, results sliced to n_valid
+                arr = np.concatenate(
+                    [arr, np.repeat(arr[-1:], bucket - n_valid, axis=0)],
+                    axis=0)
+            if self.batch_mode == "scan":
+                arr = arr[:, None]  # [K, 1, *sample]: one row per step
+            stacked[name] = arr
+        for r in reqs:
+            r.t_dispatch = t_take
+        _profiler.record_span(
+            "serve:queue_wait", reqs[0].t_submit, t_take, cat="serve",
+            args={"rows": n_valid, "requests": len(reqs)})
+
+        t0 = time.perf_counter()
+        if self.batch_mode == "scan":
+            if bucket == 1:
+                # the naive one-request-one-dispatch degenerate case
+                fetches, _health = self._cb.run(
+                    self._scope, {n: a[0] for n, a in stacked.items()},
+                    self._rng)
+                outs = [np.asarray(f)[None] for f in fetches]
+            else:
+                fetches, _health = self._cb.run_window(
+                    self._scope, stacked, self._rng, 0, bucket,
+                    window_names=tuple(stacked))
+                outs = [np.asarray(f) for f in fetches]
+            # [K, 1, *out] -> [K, *out]
+            outs = [o.reshape((o.shape[0],) + o.shape[2:]) for o in outs]
+        elif self._cb is not None:
+            fetches, _health = self._cb.run(self._scope, stacked,
+                                            self._rng)
+            outs = [np.asarray(f) for f in fetches]
+        else:
+            # stateful program (PS lookups, ...): lock-serialized
+            # executor — batching still coalesces the RPC fan-out
+            with self._exe_lock:
+                outs = self._exe.run(self._program, feed=stacked,
+                                     fetch_list=list(self._fetch_names),
+                                     scope=self._scope, return_numpy=True)
+        t1 = time.perf_counter()
+        _profiler.record_span(
+            f"serve:exec[{bucket}]", t0, t1, cat="serve",
+            args={"bucket": bucket, "n_valid": n_valid,
+                  "mode": self.batch_mode})
+
+        i0 = 0
+        for r in reqs:
+            r.set_result([o[i0:i0 + r.n] for o in outs])
+            i0 += r.n
+        t_done = time.perf_counter()
+        with self._stats_lock:
+            self._n_requests += len(reqs)
+            self._n_rows += n_valid
+            self._n_batches += 1
+            self._batch_hist[n_valid] = \
+                self._batch_hist.get(n_valid, 0) + 1
+            self._bucket_hist[bucket] = \
+                self._bucket_hist.get(bucket, 0) + 1
+            self._buckets_seen.add(bucket)
+            for r in reqs:
+                self._done.append((t_done, t_done - r.t_submit))
+                self._qwait.append(t_take - r.t_submit)
+
+    # ------------------------------------------------------------- stats
+    _pct = staticmethod(percentiles_ms)
+
+    def buckets_compiled(self) -> List[int]:
+        """The scanned-jit bucket cache keys — the no-recompile
+        evidence surface (steady-state traffic must not grow it)."""
+        if self._cb is None or self.batch_mode != "scan":
+            # fused/executor paths: every bucket shares one step fn that
+            # retraces per batch shape — the seen set IS the shape set
+            return sorted(self._buckets_seen)
+        # list() on the dict is a single GIL-atomic snapshot — a worker
+        # inserting a first-seen bucket mid-stats() must not blow up a
+        # monitoring thread's iteration
+        keys = {k[0] for k in list(self._cb._multi_jit)}
+        # bucket 1 runs the single-step jit, not a scanned one
+        keys |= {b for b in self._buckets_seen if b == 1}
+        return sorted(keys)
+
+    def stats(self) -> Dict[str, Any]:
+        """QPS / batch-size histogram / latency percentiles / cache hit
+        rate — the ``stats`` RPC surface of the serving plane."""
+        with self._stats_lock:
+            now = time.perf_counter()
+            done = list(self._done)
+            window = [d for d in done if now - d[0] <= 60.0]
+            span = (now - min(d[0] for d in window)) if window else 0.0
+            st = {
+                "requests": self._n_requests,
+                "rows": self._n_rows,
+                "batches": self._n_batches,
+                "errors": self._n_errors,
+                "uptime_s": now - self._t_start,
+                "qps": (len(window) / span) if span > 1e-9 else 0.0,
+                "avg_batch": (self._n_rows / self._n_batches
+                              if self._n_batches else 0.0),
+                "batch_size_hist": dict(sorted(self._batch_hist.items())),
+                "bucket_hist": dict(sorted(self._bucket_hist.items())),
+                "latency_ms": self._pct([d[1] for d in done]),
+                "queue_wait_ms": self._pct(list(self._qwait)),
+                "mode": self.batch_mode,
+                "max_batch": self._queue.max_batch,
+                "workers": len(self._workers),
+                "buckets_compiled": self.buckets_compiled(),
+            }
+        if self.embedding_cache is not None:
+            st["embedding_cache"] = self.embedding_cache.stats()
+        return st
+
+    def reset_stats(self) -> None:
+        """Drop counters/histograms (benches call this after warmup so
+        the reported histogram covers only the measured window)."""
+        with self._stats_lock:
+            self._t_start = time.perf_counter()
+            self._n_requests = self._n_rows = self._n_batches = 0
+            self._n_errors = 0
+            self._batch_hist.clear()
+            self._bucket_hist.clear()
+            self._done.clear()
+            self._qwait.clear()
+
+    # ------------------------------------------------------------- admin
+    def warm(self, buckets: Optional[Sequence[int]] = None) -> List[int]:
+        """Trace/compile the given buckets (default: every power of two
+        up to max_batch) with zero-filled rows so live traffic never
+        pays a compile. Returns the warmed bucket list."""
+        if buckets is None:
+            buckets = [1]
+            while buckets[-1] < self._queue.max_batch:
+                buckets.append(buckets[-1] * 2)
+        for b in buckets:
+            feed = {}
+            for name in self._feed_names:
+                shape, dt = self._sample[name]
+                feed[name] = np.zeros((int(b),) + shape, dt)
+            self.predict_many(feed)
+        return list(buckets)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.close()
+        for t in self._workers:
+            t.join(timeout=30)
+        if self._cache_installed:
+            from paddle_tpu.fluid import ps_rpc
+            ps_rpc.install_row_cache(self._cache_prev)
+            self._cache_installed = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

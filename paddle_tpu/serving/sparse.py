@@ -1,0 +1,81 @@
+"""Serving-time sparse path: rewrite a loaded inference program's
+embedding lookups into ``distributed_lookup_table`` pulls against live
+pservers.
+
+This is the serving-side half of the DistributeTranspiler rewrite
+(fluid/transpiler/distribute_transpiler.py ``_build_trainer_program``):
+training bakes the pserver endpoints into the TRAINER program, but an
+inference program saved by ``io.save_inference_model`` still carries
+plain ``lookup_table`` ops — serving it would require materializing the
+full table in the predictor process, exactly what a beyond-HBM table
+cannot do. ``rewrite_sparse_lookups`` clones the program and points the
+marked tables at the PS plane instead; the predictor process then never
+holds table rows beyond what the ``EmbeddingCache`` pins.
+
+The rewritten ops ride the whole PR 4/6 client stack unchanged: binary
+wire, per-endpoint channel pools, concurrent shard fan-out, duplicate-id
+dedup, and — because pulls resolve slots through the installed
+ClusterView — a pserver drain/failover mid-serving re-routes
+transparently inside the call (``StaleClusterViewError`` replay).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+__all__ = ["rewrite_sparse_lookups"]
+
+_LOOKUP_TYPES = ("lookup_table", "lookup_table_v2")
+
+
+def rewrite_sparse_lookups(program, endpoints: Sequence[str],
+                           tables: Optional[Sequence[str]] = None,
+                           trainer_id: int = 0) -> Tuple[object, List[str]]:
+    """Clone ``program`` with its sparse lookups rewritten to remote
+    pulls row-sharded across ``endpoints`` (id % n_pservers — the same
+    routing the training transpiler bakes in, so a table sharded by
+    training is served from the same shards).
+
+    ``tables``: table var names to rewrite; default = every lookup
+    marked ``is_distributed`` (the wide_deep ``is_distributed=True``
+    build). Returns ``(rewritten_program, rewritten_table_names)``;
+    raises ``ValueError`` when nothing matches — a silent no-op rewrite
+    would serve from a local table the caller believes is remote."""
+    eps = [str(e) for e in endpoints if e]
+    if not eps:
+        raise ValueError("rewrite_sparse_lookups: empty endpoint list")
+    want = set(tables) if tables is not None else None
+    prog = program.clone()
+    block = prog.global_block()
+    hit: List[str] = []
+    for op in block.ops:
+        if op.type not in _LOOKUP_TYPES:
+            continue
+        w = op.input("W")[0]
+        if want is None:
+            if not op.attrs.get("is_distributed"):
+                continue
+        elif w not in want:
+            continue
+        op.type = "distributed_lookup_table"
+        op.inputs = {"Ids": op.input("Ids"), "W": [w]}
+        op.outputs = {"Outputs": op.output("Out")}
+        op.attrs.update({
+            "table_names": [w],
+            "epmap": list(eps),
+            "trainer_id": int(trainer_id),
+            "is_distributed": True,
+        })
+        hit.append(w)
+    if not hit:
+        raise ValueError(
+            "rewrite_sparse_lookups: no lookup_table op matched "
+            + ("tables=" + repr(sorted(want)) if want is not None
+               else "is_distributed=True")
+            + " — the program would silently keep serving local tables")
+    if want is not None:
+        missed = want - set(hit)
+        if missed:
+            raise ValueError(
+                f"rewrite_sparse_lookups: tables {sorted(missed)} have "
+                f"no lookup_table op in the program")
+    return prog, hit

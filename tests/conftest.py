@@ -47,6 +47,15 @@ def pytest_configure(config):
         "also carry 'slow'. Subprocesses run JAX_PLATFORMS=cpu, so "
         "PADDLE_TPU_TEST_SHARD file-level sharding applies unchanged.")
     config.addinivalue_line(
+        "markers", "serving: online-serving plane suite "
+        "(paddle_tpu/serving/ — continuous batcher, predictor pool, "
+        "serving-time embedding fetch; tests/test_serving.py). "
+        "In-process tests (incl. the thread-harness pserver ones) stay "
+        "in the tier-1 non-slow set; the multiprocess ones (cross-"
+        "process compile-cache cold start, loadgen subprocess drivers) "
+        "also carry 'slow'. Subprocesses run JAX_PLATFORMS=cpu, so "
+        "PADDLE_TPU_TEST_SHARD file-level sharding applies unchanged.")
+    config.addinivalue_line(
         "markers", "rpcbench: PS-RPC data-plane microbench smoke "
         "(tools/rpc_microbench.py loopback sweep at tiny sizes — the "
         "full 4KB..64MB run is a manual tool invocation). In-process "

@@ -1,0 +1,281 @@
+"""Serving-plane load generator — closed- and open-loop traffic against
+a ServingEngine (docs/SERVING.md "Bench methodology").
+
+Library (bench.py + tests/test_serving.py import these):
+  * ``run_closed_loop(predict, feeds, clients, duration_s)`` — N client
+    threads, each submits its next request the moment the previous one
+    completes (throughput-under-concurrency; latency EXCLUDES client
+    think time). The shape bench.py's serving lanes measure.
+  * ``run_open_loop(submit, feeds, rate_qps, duration_s)`` — one pacing
+    thread fires async submits on a fixed-rate schedule regardless of
+    completions (latency-under-load; queueing delay INCLUDED — the
+    number a p99 SLO is about). Reports ``behind`` when the pacer
+    cannot hold the target rate.
+  * ``start_inproc_pserver`` / ``push_table`` — the in-process
+    listen_and_serv harness the serving PS lanes and tests run against
+    (same shape as tests/test_ps_membership.py's protocol harness).
+
+CLI (manual runs)::
+
+    JAX_PLATFORMS=cpu python tools/serving_loadgen.py \
+        --clients 16 --duration 3 --max-batch 16 --mode closed
+    python tools/serving_loadgen.py --mode open --rate 500 --naive
+
+Prints one JSON line: loadgen results + the engine's stats() surface.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentiles(lats_s: Sequence[float]) -> Dict[str, float]:
+    from paddle_tpu.serving.engine import percentiles_ms
+    return percentiles_ms(lats_s, suffix="_ms")
+
+
+def run_closed_loop(predict: Callable[[dict], object],
+                    feeds: Sequence[dict], clients: int = 16,
+                    duration_s: float = 3.0,
+                    warmup_s: float = 0.5) -> Dict[str, float]:
+    """Closed loop: ``clients`` threads call ``predict(feed)`` back to
+    back for ``duration_s`` (after ``warmup_s`` whose samples are
+    discarded — first-touch compiles and cold caches must not land in
+    the percentiles). Returns qps + latency percentiles over the
+    measured window."""
+    results: List[List] = [[] for _ in range(clients)]
+    errors: List[BaseException] = []
+    go = threading.Event()
+    t_box = {}
+
+    def worker(wid: int):
+        rs = results[wid]
+        go.wait()
+        end = t_box["t0"] + warmup_s + duration_s
+        i = wid
+        while time.perf_counter() < end:
+            feed = feeds[i % len(feeds)]
+            i += clients
+            t = time.perf_counter()
+            try:
+                predict(feed)
+            except BaseException as e:  # surface, don't hang the join
+                errors.append(e)
+                return
+            rs.append((time.perf_counter(), t))
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(clients)]
+    for t in threads:
+        t.start()
+    t_box["t0"] = time.perf_counter()
+    go.set()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    cut = t_box["t0"] + warmup_s
+    done = sorted((td, td - ts) for rs in results for td, ts in rs
+                  if ts >= cut)
+    if not done:
+        return {"qps": 0.0, "n": 0, "clients": clients,
+                **_percentiles([])}
+    span = done[-1][0] - cut
+    out = {"qps": len(done) / span if span > 1e-9 else 0.0,
+           "n": len(done), "clients": clients,
+           "duration_s": round(span, 3)}
+    out.update(_percentiles([lat for _t, lat in done]))
+    return out
+
+
+def run_open_loop(submit: Callable[[dict], object], feeds: Sequence[dict],
+                  rate_qps: float, duration_s: float = 3.0,
+                  timeout_s: float = 120.0) -> Dict[str, float]:
+    """Open loop: submit async requests at ``rate_qps`` for
+    ``duration_s``; latency = submit→fulfilment (futures must expose
+    ``.wait(timeout)`` and ``.t_submit``/``.t_done`` stamps — the
+    serving Request contract). ``behind`` counts schedule slots the
+    pacer missed (the engine saturated: achieved rate < target)."""
+    if rate_qps <= 0:
+        raise ValueError("rate_qps must be > 0")
+    period = 1.0 / float(rate_qps)
+    futs = []
+    behind = 0
+    start = time.perf_counter()
+    next_t = start
+    i = 0
+    while True:
+        now = time.perf_counter()
+        if now >= start + duration_s:
+            break
+        if now < next_t:
+            time.sleep(next_t - now)
+        fut = submit(feeds[i % len(feeds)])
+        futs.append(fut)
+        i += 1
+        next_t += period
+        if time.perf_counter() > next_t + period:
+            behind += 1
+    for f in futs:
+        f.wait(timeout_s)
+    lats = [f.t_done - f.t_submit for f in futs]
+    span = (max(f.t_done for f in futs) - start) if futs else 0.0
+    out = {"target_qps": float(rate_qps),
+           "qps": len(futs) / span if span > 1e-9 else 0.0,
+           "n": len(futs), "behind": behind,
+           "duration_s": round(span, 3)}
+    out.update(_percentiles(lats))
+    return out
+
+
+# ------------------------------------------------------------------ harness
+def start_inproc_pserver(endpoint: str, bind: str = "",
+                         standby: bool = False,
+                         pserver_endpoints: Sequence[str] = (),
+                         sync: bool = False):
+    """One in-process listen_and_serv loop on its own scope/thread —
+    the serving PS lanes' pserver harness. Returns (thread, scope);
+    stop with ``stop_inproc_pserver``."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        main.global_block().append_op(
+            type="listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": endpoint, "sync_mode": sync,
+                   "Fanin": 1, "optimize_blocks": [],
+                   "grad_to_block_id": [],
+                   "pserver_endpoints": list(pserver_endpoints)
+                   or [endpoint],
+                   "bind_endpoint": bind, "standby": standby,
+                   "replica_of": ""})
+    scope = core.Scope()
+    exe = fluid.Executor()
+    th = threading.Thread(
+        target=lambda: exe.run(main, scope=scope, feed={},
+                               fetch_list=[]), daemon=True)
+    th.start()
+    return th, scope
+
+
+def stop_inproc_pserver(physical_ep: str, thread) -> None:
+    from paddle_tpu.fluid.ps_rpc import VarClient
+    try:
+        c = VarClient(physical_ep, connect_timeout=5.0, channels=1,
+                      resolve=False)
+        c.stop()
+        c.close()
+    except Exception:
+        pass
+    thread.join(timeout=10)
+
+
+def push_table(endpoints: Sequence[str], name: str,
+               table: np.ndarray) -> None:
+    """Install a full embedding table on every pserver (each serves its
+    ``id %% n`` shard out of it; prefetch_rows indexes by GLOBAL id, so
+    shipping the whole array keeps the harness trivially bit-equal to
+    the local oracle)."""
+    from paddle_tpu.fluid.ps_rpc import VarClient
+    for ep in endpoints:
+        c = VarClient(ep, connect_timeout=30.0, channels=1)
+        c.send_var(name, np.asarray(table))
+        c.close()
+
+
+def free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def build_mlp_serving_model(n_feeds: int = 64):
+    """The mnist-shaped serving model every mnist lane measures — ONE
+    builder so the CLI loadgen and bench.py serve_mnist stay comparable
+    by construction. Returns (program, scope, out_name, feeds) with
+    params initialized and ``feeds`` a list of single-row feed dicts."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[784], dtype="float32")
+        h = fluid.layers.fc(x, 256, act="relu")
+        out = fluid.layers.fc(h, 10, act="softmax")
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.rand(784).astype(np.float32)}
+             for _ in range(n_feeds)]
+    return main, scope, out.name, feeds
+
+
+# ---------------------------------------------------------------------- CLI
+def _build_mlp_engine(max_batch: int, delay_ms: float, workers: int):
+    from paddle_tpu.serving import ServingEngine
+
+    main, scope, out_name, feeds = build_mlp_serving_model()
+    eng = ServingEngine(program=main, scope=scope, feed_names=["x"],
+                        fetch_names=[out_name], max_batch=max_batch,
+                        max_queue_delay_ms=delay_ms, num_workers=workers)
+    return eng, feeds
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--rate", type=float, default=500.0,
+                    help="open-loop target QPS")
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--warmup", type=float, default=0.5)
+    ap.add_argument("--max-batch", type=int, default=16)
+    ap.add_argument("--delay-ms", type=float, default=2.0)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--naive", action="store_true",
+                    help="one-request-one-dispatch lane (max_batch=1)")
+    args = ap.parse_args(argv)
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+    if not os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", "cpu")
+
+    max_batch = 1 if args.naive else args.max_batch
+    eng, feeds = _build_mlp_engine(max_batch, args.delay_ms, args.workers)
+    try:
+        eng.warm()
+        eng.reset_stats()
+        if args.mode == "closed":
+            res = run_closed_loop(eng.predict, feeds,
+                                  clients=args.clients,
+                                  duration_s=args.duration,
+                                  warmup_s=args.warmup)
+        else:
+            res = run_open_loop(eng.submit, feeds, rate_qps=args.rate,
+                                duration_s=args.duration)
+        print(json.dumps({"mode": args.mode, "naive": bool(args.naive),
+                          "result": res, "engine": eng.stats()},
+                         default=str))
+    finally:
+        eng.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
