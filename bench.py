@@ -652,7 +652,8 @@ def bench_wide_deep(batch=4096, steps=20, warmup=5):
 
 
 def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
-                       sparse_dim=int(2.5e6), n_trainers=2):
+                       sparse_dim=int(2.5e6), n_trainers=2,
+                       async_staleness=0, window_k=1, metric=None):
     """Wide&Deep CTR with ≥1e9 embedding parameters over the distributed
     PS plane (BASELINE.md sparse-scale row): 26 deep [2.5M, 16] + 26 wide
     [2.5M, 1] per-slot tables, row-sharded across pserver subprocesses as
@@ -667,12 +668,25 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
     the full LEGACY plane for every client (subprocess trainers inherit
     the env). Same model, same feeds, and every legacy-gated difference
     is numerics-exact, so the two rows' final losses must agree
-    bit-for-bit (the recorded parity flag)."""
+    bit-for-bit (the recorded parity flag).
+
+    Async-overlap lanes (docs/PS_DATA_PLANE.md "Async overlap"):
+    ``async_staleness=k`` pipelines every trainer's comm tail behind
+    its next step (FLAGS_async_staleness rides into the subprocess
+    trainers via env) and ``window_k`` feeds [K, ...] stacks so the
+    window fallback stages sparse prefetch for slice i+1 while slice i
+    computes. The async row additionally records overlap EVIDENCE from
+    a short profiled epilogue — cat="comm" span seconds concurrent
+    with cat="segment" step spans — plus the trainer-side prefetch hit
+    rate and the pservers' prefetch-tagged pull counters, because on
+    this 1-core box the summed samples/s is scheduler-bound, not
+    wire-bound (the PR 4 lesson)."""
     import socket
     import numpy as np
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     os.environ["FLAGS_lazy_sparse_table_threshold"] = "1000000"
+    os.environ["FLAGS_async_staleness"] = str(int(async_staleness))
     wire = ("pickle" if os.environ.get("PADDLE_TPU_PS_PICKLE_WIRE") == "1"
             else "binary")
     from tools import wide_deep_ps_worker as W
@@ -736,7 +750,7 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
                 [sys.executable, "-m", "tools.wide_deep_ps_worker",
                  "trainer", eps, str(tid), str(n_trainers),
                  str(sparse_dim), str(batch), str(steps), str(warmup),
-                 tf.name],
+                 tf.name, str(window_k)],
                 env=env, stdout=tl, stderr=subprocess.STDOUT))
         # startup grace: a trainer that dies before its first barrier
         # would hang trainer 0 in the sync plane (the pserver-side
@@ -750,8 +764,10 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
                         errors="replace"))
 
         import paddle_tpu.fluid as fluid
-        from paddle_tpu.fluid import core
+        from paddle_tpu.fluid import async_overlap, core, profiler
+        from paddle_tpu.fluid.communicator import drain_async_rounds
         from paddle_tpu.models import wide_deep
+        core.set_flag("FLAGS_async_staleness", int(async_staleness))
         main_p, startup, feeds, loss, auc = W.build(sparse_dim)
         t = W.transpile(main_p, startup, eps, trainer_id=0,
                         trainers=n_trainers)
@@ -760,14 +776,70 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
         scope = core.Scope()
         nb = wide_deep.ctr_reader(batch, num_dense=13, num_slots=26,
                                   sparse_dim=sparse_dim, seed=0)
-        feed = nb()
+        evidence = {}
         from paddle_tpu.fluid.ps_rpc import WorkerHeartBeat
         beat = WorkerHeartBeat(eps.split(","), 0, interval=1.0).start()
         try:
             with fluid.scope_guard(scope):
                 exe.run(startup)
-                dt = _timed_steps_loop(exe, prog, feed, [loss], steps,
-                                       warmup)
+                if window_k <= 1:
+                    feed = nb()
+                    dt = _timed_steps_loop(exe, prog, feed, [loss],
+                                           steps, warmup)
+                else:
+                    # [K, ...] stacks of K DISTINCT batches — the
+                    # window-fallback shape that staggers sparse
+                    # prefetch across the slices
+                    assert steps % window_k == 0 \
+                        and warmup % window_k == 0
+                    batches = [nb() for _ in range(window_k)]
+                    feed = {n: np.stack([b[n] for b in batches])
+                            for n in batches[0]}
+                    global LAST_FETCHES
+                    n_warm = warmup // window_k
+                    for w in range(n_warm):
+                        if w == n_warm - 1:
+                            # evidence window: profile the LAST WARMUP
+                            # window (it runs the identical production
+                            # path) so the timed loop below stays free
+                            # of profiling overhead — cat="comm" spans
+                            # from the round pipeline / prefetch
+                            # threads concurrent with cat="segment"
+                            # step spans prove the wire ran behind the
+                            # step
+                            profiler.start_profiler("CPU")
+                        out = exe.run(prog, feed=feed,
+                                      fetch_list=[loss],
+                                      n_steps=window_k,
+                                      return_numpy=False)
+                    ev = profiler.snapshot_events()
+                    profiler.stop_profiler(profile_path="")
+                    t0 = time.perf_counter()
+                    for _ in range(steps // window_k):
+                        out = exe.run(prog, feed=feed,
+                                      fetch_list=[loss],
+                                      n_steps=window_k,
+                                      return_numpy=False)
+                    # in-flight rounds are part of the measured work
+                    drain_async_rounds()
+                    dt = time.perf_counter() - t0
+                    comm_s = sum(e["end"] - e["start"] for e in ev
+                                 if e["cat"] == "comm")
+                    overlap_s = profiler.concurrent_seconds(
+                        "comm", "segment", events=ev)
+                    evidence = {
+                        "comm_span_s": round(comm_s, 4),
+                        "comm_overlap_s": round(overlap_s, 4),
+                        "comm_overlap_frac": round(
+                            overlap_s / comm_s, 4) if comm_s else 0.0,
+                    }
+                    plane = async_overlap.active_plane()
+                    if plane is not None:
+                        s = plane.stats()
+                        evidence["prefetch_hit_rate"] = round(
+                            s["hit_rate"], 4)
+                        evidence["prefetch_stages"] = s["stages"]
+                    LAST_FETCHES = out
         finally:
             beat.stop()
         total_sps = batch * steps / dt
@@ -781,16 +853,33 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
                         errors="replace"))
             total_sps += json.load(open(out_path))["samples_per_sec"]
         emb_params = 26 * sparse_dim * 16 + 26 * sparse_dim
-        final_loss = float(np.asarray(LAST_FETCHES[0].array).ravel()[0])
-        return {"metric": "wide_deep_1b_ps_samples_per_sec",
+        final_loss = float(np.asarray(LAST_FETCHES[0].array).ravel()[-1])
+        if int(async_staleness) > 0:
+            # server-side view of the prefetch traffic (stats RPC)
+            try:
+                from paddle_tpu.fluid.ps_rpc import VarClient
+                pf = [VarClient.of(ep).call("stats").get("prefetch", {})
+                      for ep in eps.split(",")]
+                evidence["server_prefetch_calls"] = sum(
+                    int(p.get("calls", 0)) for p in pf)
+                evidence["server_prefetch_rows"] = sum(
+                    int(p.get("rows", 0)) for p in pf)
+            except Exception:
+                pass
+        return {"metric": metric or "wide_deep_1b_ps_samples_per_sec",
                 "value": round(total_sps, 1), "unit": "samples/s",
                 "vs_baseline": 1.0, "batch": batch,
                 "embedding_params": int(emb_params),
                 "pservers": n_pservers, "trainers": n_trainers,
                 # wire lane + trainer-0 final loss: the paired
                 # binary-vs-pickle rows must agree on this bit-for-bit
-                # (framing must never change the numerics)
+                # (framing must never change the numerics; the
+                # staleness>0 lane is NOT bit-comparable — bounded-
+                # staleness reads are the point)
                 "wire": wire, "final_loss": final_loss,
+                "async_staleness": int(async_staleness),
+                "window_k": int(window_k),
+                **evidence,
                 # the AUC op rides in-graph: fwd+bwd+update run as
                 # compiled jitted segments around the stateful islands
                 # (auc + RPC ops) instead of the whole-block interpreter
@@ -810,6 +899,90 @@ def bench_wide_deep_1b(batch=512, steps=10, warmup=2, n_pservers=2,
                 w.wait(timeout=10)
             except Exception:
                 w.kill()
+        # never leak the overlap plane into a later lane of the same
+        # bench invocation
+        os.environ.pop("FLAGS_async_staleness", None)
+        try:
+            from paddle_tpu.fluid import async_overlap as _ao
+            from paddle_tpu.fluid import communicator as _comm
+            from paddle_tpu.fluid import core as _core
+            _core.set_flag("FLAGS_async_staleness", 0)
+            _ao.reset_plane()
+            _comm.reset_round_pipeline()
+        except Exception:
+            pass
+
+
+def bench_wide_deep_1b_syncw(batch=512, steps=16, warmup=16,
+                             n_pservers=2, sparse_dim=int(2.5e6),
+                             n_trainers=2):
+    """Windowed SYNC baseline of the async-overlap pair: same [K=8]
+    window stacks, same cluster shape, FLAGS_async_staleness=0 (the
+    plain send/barrier/recv/fetch tail). Pairs with wide_deep_1b_async
+    and wide_deep_1b_ceiling (docs/PS_DATA_PLANE.md "Async overlap")."""
+    return bench_wide_deep_1b(
+        batch=batch, steps=steps, warmup=warmup, n_pservers=n_pservers,
+        sparse_dim=sparse_dim, n_trainers=n_trainers, async_staleness=0,
+        window_k=8, metric="wide_deep_1b_ps_syncw_samples_per_sec")
+
+
+def bench_wide_deep_1b_async(batch=512, steps=16, warmup=16,
+                             n_pservers=2, sparse_dim=int(2.5e6),
+                             n_trainers=2, staleness=2):
+    """Async-overlap lane: FLAGS_async_staleness=2 pipelines every
+    trainer's round (push/barrier/pull) behind its next step and the
+    window fallback prefetches slice i+1's embedding rows while slice
+    i computes. Row carries overlap evidence (comm∩segment span
+    seconds from the profiled last window, prefetch hit rate, server
+    prefetch counters) because summed samples/s on the 1-core box is
+    scheduler-bound (docs/PS_DATA_PLANE.md "Async overlap")."""
+    return bench_wide_deep_1b(
+        batch=batch, steps=steps, warmup=warmup, n_pservers=n_pservers,
+        sparse_dim=sparse_dim, n_trainers=n_trainers,
+        async_staleness=staleness, window_k=8,
+        metric="wide_deep_1b_ps_async_samples_per_sec")
+
+
+def bench_wide_deep_1b_ceiling(batch=512, steps=16, warmup=8,
+                               sparse_dim=20000, window_k=8):
+    """No-PS compiled ceiling PROXY for the wide_deep_1b pair: the same
+    arch/batch/window shape with LOCAL embedding tables at a reduced
+    sparse_dim — the true 2.5M-row×26-slot tables are ~4.3 GB dense and
+    exactly why the PS plane exists, so the ceiling is what the
+    compiled step could do if the wire were free. Single process, no
+    pservers; with_auc keeps the segmented execution shape of the PS
+    lanes."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.models import wide_deep
+
+    main, startup, feeds, loss, auc = wide_deep.build_wide_deep_program(
+        num_dense=13, num_slots=26, sparse_dim=sparse_dim,
+        embedding_dim=16, hidden=(64, 64), lr=1e-3,
+        optimizer=fluid.optimizer.SGD(1e-3))
+    exe = fluid.Executor()
+    scope = core.Scope()
+    nb = wide_deep.ctr_reader(batch, num_dense=13, num_slots=26,
+                              sparse_dim=sparse_dim, seed=0)
+    batches = [nb() for _ in range(window_k)]
+    feed = {n: np.stack([b[n] for b in batches]) for n in batches[0]}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(max(1, warmup // window_k)):
+            exe.run(main, feed=feed, fetch_list=[loss],
+                    n_steps=window_k, return_numpy=False)
+        t0 = time.perf_counter()
+        for _ in range(max(1, steps // window_k)):
+            out = exe.run(main, feed=feed, fetch_list=[loss],
+                          n_steps=window_k, return_numpy=False)
+        _ = float(np.asarray(out[0].array).ravel()[-1])
+        dt = time.perf_counter() - t0
+    return {"metric": "wide_deep_1b_nops_ceiling_samples_per_sec",
+            "value": round(batch * steps / dt, 1), "unit": "samples/s",
+            "vs_baseline": 1.0, "batch": batch,
+            "sparse_dim_proxy": int(sparse_dim), "window_k": window_k,
+            "executor_mode": exe._last_run_mode,
+            "note": "no-PS ceiling proxy at reduced local table size"}
 
 
 def bench_serving_mnist(clients=16, duration=2.5, warmup_s=0.5):
@@ -1167,6 +1340,9 @@ def main():
                "resnet": bench_resnet50, "allreduce": bench_allreduce_dp,
                "wide_deep": bench_wide_deep,
                "wide_deep_1b": bench_wide_deep_1b,
+               "wide_deep_1b_syncw": bench_wide_deep_1b_syncw,
+               "wide_deep_1b_async": bench_wide_deep_1b_async,
+               "wide_deep_1b_ceiling": bench_wide_deep_1b_ceiling,
                "mnist_realdata": bench_mnist_realdata,
                "mnist_guard": bench_mnist_realdata_guard,
                "wide_deep_realdata": bench_wide_deep_realdata,

@@ -1,0 +1,386 @@
+"""Async overlap plane — trainer-side machinery that hides the PS wire
+behind the compiled step (docs/PS_DATA_PLANE.md "Async overlap";
+ROADMAP item 3; reference: HalfAsyncCommunicator's decoupled send
+threads, communicator.h:299, and parameter_prefetch.cc's
+section-overlap pulls).
+
+Three overlapped streams, all gated on ``FLAGS_async_staleness > 0``:
+
+  * bounded-staleness rounds — the transpiler's async-mode rewrite
+    collapses the sync comm tail into one ``ps_round`` op; its kernel
+    submits push→barrier→pull→barrier to the communicator's
+    ``RoundPipeline`` and returns, so the executor launches window i+1
+    while round i drains. ``FLAGS_async_staleness`` bounds the
+    submitted-but-unacked rounds (ps_rpc.AckWindow); =0 runs the round
+    inline, bit-identical to the pre-overlap 4-op tail.
+  * sparse prefetch (this module) — while window i computes, a
+    background thread pulls window i+1's embedding rows into a
+    per-step ``PrefetchBuffer`` that ``distributed_lookup_table``
+    consumes through the PR 7 row-cache consult hook
+    (ps_rpc.install_row_cache); a fully-hit lookup issues ZERO RPCs.
+    The buffer invalidates rows the trainer pushes grads for
+    (``invalidate_rows`` from distributed_lookup_table_grad).
+  * double-buffered dense pulls — each round's ``get_vars_batch``
+    lands in the pipeline's latest-pull buffer; the next ``ps_round``
+    installs it into the scope at the step boundary.
+
+Staleness contract: every value a step consumes — dense params,
+prefetched sparse rows — is at most ``FLAGS_async_staleness`` rounds
+old, and a trainer never runs more than that many rounds ahead of its
+own acknowledged comm. Prefetched rows are additionally at most one
+round staler than a synchronous pull would see (they are fetched
+while the PREVIOUS round may still be releasing).
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import core
+
+__all__ = ["PrefetchBuffer", "OverlapPlane", "maybe_plane",
+           "active_plane", "reset_plane", "prefetch_plan"]
+
+_LOG = logging.getLogger("paddle_tpu.ps")
+
+
+class PrefetchBuffer:
+    """Per-step sparse prefetch buffer, (table, id) -> row.
+
+    Implements the ``lookup(table, ids, fetch_fn)`` row-cache interface
+    the serving EmbeddingCache defined (ps_rpc.install_row_cache), so
+    the lookup op consults it with zero new plumbing — but the policy
+    is different: a row is served AT MOST ONCE (consumed on hit — rows
+    change every round, so nothing is ever served across windows), a
+    fill MERGES the staged window's rows into the buffer (window i's
+    unconsumed rows survive window i+1's early-landing fill), lookup
+    misses are NOT cached (they were fetched fresh; caching them would
+    serve them stale next step), and ``invalidate_rows`` drops rows
+    the trainer just pushed grads for, including out of an in-flight
+    fill (the dirty set)."""
+
+    # a runaway buffer (lookups never consuming what stages fill) is
+    # dropped wholesale rather than silently growing; warned once
+    _MAX_ROWS_PER_TABLE = 1 << 20
+
+    def __init__(self, wait_pending_s: float = 5.0):
+        self.wait_pending_s = float(wait_pending_s)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._rows: Dict[str, Dict[int, np.ndarray]] = {}
+        # id -> fence stage-seq: a fill whose fetch STARTED at or
+        # before this seq must skip the id (its fetched copy may
+        # predate the grad push); a fill staged after the push is
+        # fresh-enough again (bounded staleness) and clears the fence
+        self._dirty: Dict[str, Dict[int, int]] = {}
+        self._stage_seq: Dict[str, int] = {}
+        # per-table id set of every fill currently in flight (several
+        # stages can be queued behind one prefetch thread). A lookup
+        # that needs one of those ids waits for its fill (bounded)
+        # instead of re-issuing the very RPCs the prefetch thread is
+        # already running; lookups for unrelated ids never wait. Each
+        # fill removes only ITS ids — an earlier fill completing must
+        # not unblock lookups still waiting on a later one.
+        self._pending_ids: Dict[str, set] = {}
+        self._warned_overflow = False
+        self.hits = 0
+        self.misses = 0
+        self.staged_rows = 0
+        self.invalidated_rows = 0
+
+    def begin_fill(self, table: str, ids) -> int:
+        """Register an in-flight fill; returns its stage token (passed
+        back to ``fill`` so invalidation can tell pre-push fetches from
+        post-push ones)."""
+        ids = np.asarray(ids).reshape(-1)
+        with self._cv:
+            self._pending_ids.setdefault(table, set()).update(
+                int(i) for i in ids.tolist())
+            token = self._stage_seq.get(table, 0) + 1
+            self._stage_seq[table] = token
+            return token
+
+    def fill(self, table: str, ids: np.ndarray, rows: np.ndarray,
+             token: int) -> None:
+        """Merge one staged window's rows into the buffer (``token``
+        from the matching ``begin_fill``). Ids invalidated after the
+        fetch was staged are skipped — the trainer pushed grads for
+        them and the fetched copy may predate that push."""
+        ids = np.asarray(ids).reshape(-1)
+        with self._cv:
+            dirty = self._dirty.get(table) or {}
+            tbl = self._rows.setdefault(table, {})
+            if len(tbl) + len(ids) > self._MAX_ROWS_PER_TABLE:
+                if not self._warned_overflow:
+                    self._warned_overflow = True
+                    _LOG.warning(
+                        "PrefetchBuffer: table %r exceeded %d buffered "
+                        "rows (lookups are not consuming the staged "
+                        "windows) — dropping the stale buffer", table,
+                        self._MAX_ROWS_PER_TABLE)
+                tbl.clear()
+            n = 0
+            for k, i in enumerate(ids.tolist()):
+                i = int(i)
+                fence = dirty.get(i)
+                if fence is not None:
+                    if token <= fence:
+                        continue  # fetch started before the push: drop
+                    del dirty[i]  # post-push fetch supersedes the fence
+                tbl[i] = rows[k]
+                n += 1
+            self.staged_rows += n
+            if dirty:
+                # prune dead fences: fills complete in stage order (one
+                # FIFO prefetch thread), so every still-in-flight fill
+                # has a token > this one and a fence < token can never
+                # fire again — without the prune, ids pushed but never
+                # re-prefetched (long-tail CTR ids) accumulate forever
+                live = {i: f for i, f in dirty.items() if f >= token}
+                if len(live) != len(dirty):
+                    self._dirty[table] = live
+            self._unpend_locked(table, ids)
+
+    def _unpend_locked(self, table: str, ids) -> None:
+        pend = self._pending_ids.get(table)
+        if pend is not None:
+            pend.difference_update(int(i) for i in ids.tolist())
+            if not pend:
+                del self._pending_ids[table]
+        self._cv.notify_all()
+
+    def abort_fill(self, table: str, ids) -> None:
+        with self._cv:
+            self._unpend_locked(table, np.asarray(ids).reshape(-1))
+
+    def lookup(self, table: str, ids, fetch_fn) -> np.ndarray:
+        """Row-cache hook entry point (called by the lookup op with the
+        DEDUPED id set). Buffered rows serve without an RPC and are
+        consumed; the rest fan out through ``fetch_fn``. When a fill
+        covering some of these ids is in flight it is awaited (bounded)
+        — the residual wait is strictly less than what the synchronous
+        pull would have spent."""
+        ids = np.asarray(ids).reshape(-1)
+        id_list = [int(i) for i in ids.tolist()]
+        end = time.monotonic() + self.wait_pending_s
+        out = [None] * len(ids)
+        missing_idx: List[int] = []
+        with self._cv:
+            while True:
+                pend = self._pending_ids.get(table)
+                if pend is None or not any(i in pend for i in id_list):
+                    break
+                left = end - time.monotonic()
+                if left <= 0:
+                    _LOG.warning(
+                        "PrefetchBuffer: fill for table %r still in "
+                        "flight after %.1fs — falling through to a "
+                        "direct pull", table, self.wait_pending_s)
+                    break
+                self._cv.wait(min(left, 0.5))
+            rows = self._rows.get(table) or {}
+            for i, id_ in enumerate(ids.tolist()):
+                row = rows.pop(int(id_), None)  # consume on hit
+                if row is not None:
+                    out[i] = row
+                    self.hits += 1
+                else:
+                    missing_idx.append(i)
+                    self.misses += 1
+        if missing_idx:
+            fetched = np.asarray(fetch_fn(ids[missing_idx]))
+            for k, i in enumerate(missing_idx):
+                out[i] = fetched[k]
+        return np.asarray(out)
+
+    def invalidate_rows(self, table: str, ids) -> None:
+        """The trainer pushed grads for ``ids``: drop their buffered
+        rows and fence them out of any in-flight fill. Called inline
+        (main thread) by distributed_lookup_table_grad BEFORE the push
+        ships, so a lookup can never race a known-dirty row."""
+        ids = np.asarray(ids).reshape(-1)
+        with self._lock:
+            rows = self._rows.get(table)
+            dirty = self._dirty.setdefault(table, {})
+            fence = self._stage_seq.get(table, 0)
+            for id_ in ids.tolist():
+                id_ = int(id_)
+                dirty[id_] = fence
+                if rows is not None and rows.pop(id_, None) is not None:
+                    self.invalidated_rows += 1
+
+    def invalidate(self, table: Optional[str] = None) -> None:
+        with self._lock:
+            if table is None:
+                self._rows.clear()
+            else:
+                self._rows.pop(table, None)
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "hits": self.hits, "misses": self.misses,
+                "hit_rate": (self.hits / total) if total else 0.0,
+                "staged_rows": self.staged_rows,
+                "invalidated_rows": self.invalidated_rows,
+                "tables": len(self._rows),
+            }
+
+
+class OverlapPlane:
+    """Owns the prefetch thread + buffer and the row-cache hook install.
+    One per trainer process (module-global, like the row cache); created
+    lazily by ``maybe_plane`` when FLAGS_async_staleness > 0."""
+
+    def __init__(self):
+        from . import ps_rpc
+        self.prefetch = PrefetchBuffer()
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._installed_over = None
+        self.stages = 0
+        if ps_rpc.current_row_cache() is None:
+            # never fight a serving EmbeddingCache for the hook — a
+            # process that serves AND trains keeps the serving cache
+            # (its TTL bounds staleness there); prefetch just degrades
+            # to direct pulls
+            self._installed_over = ps_rpc.install_row_cache(self.prefetch)
+            self._hook_owned = True
+        else:
+            self._hook_owned = False
+
+    # ------------------------------------------------------------- stage
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="ps-sparse-prefetch",
+                    daemon=True)
+                self._thread.start()
+
+    def stage(self, table: str, ids, eps: List[str]) -> None:
+        """Queue a prefetch of ``ids`` (the NEXT window slice's id feed)
+        for ``table``, row-sharded across ``eps`` — issued on the
+        prefetch thread while the current step computes."""
+        ids = np.asarray(ids).reshape(-1)
+        if not self._hook_owned:
+            # a serving EmbeddingCache owns the consult hook: lookups
+            # would never see this buffer, so fetching into it would
+            # just duplicate the row-pull RPC traffic every window —
+            # prefetch degrades to direct pulls, as documented
+            return
+        if len(ids) == 0 or not eps or not eps[0]:
+            return
+        uniq = np.unique(ids)
+        self._ensure_thread()
+        self.stages += 1
+        token = self.prefetch.begin_fill(table, uniq)
+        self._q.put((table, uniq, list(eps), token))
+
+    def _loop(self):
+        from . import profiler as _profiler
+        from ..ops.distributed_ops import _pull_rows_sharded
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            table, uniq, eps, token = item
+            try:
+                if _profiler.is_profiling():
+                    with _profiler.RecordEvent(
+                            f"prefetch[{table}]", cat="comm",
+                            args={"ids": int(len(uniq))}):
+                        rows = _pull_rows_sharded(eps, table, uniq,
+                                                  prefetch=True)
+                else:
+                    rows = _pull_rows_sharded(eps, table, uniq,
+                                              prefetch=True)
+                self.prefetch.fill(table, uniq, rows, token)
+            except Exception as e:  # noqa: BLE001 — prefetch is advisory
+                # a failed prefetch must never fail the step: the
+                # lookup just misses and pulls directly (which will
+                # surface a real outage with proper retries/typing)
+                self.prefetch.abort_fill(table, uniq)
+                _LOG.warning("sparse prefetch for %r failed (%r) — the "
+                             "lookup will pull directly", table, e)
+
+    def stats(self) -> Dict[str, float]:
+        s = self.prefetch.stats()
+        s["stages"] = self.stages
+        return s
+
+    def close(self):
+        from . import ps_rpc
+        if self._hook_owned and ps_rpc.current_row_cache() is \
+                self.prefetch:
+            ps_rpc.install_row_cache(self._installed_over)
+        if self._thread is not None and self._thread.is_alive():
+            self._q.put(None)
+            self._thread.join(timeout=2.0)
+
+
+_plane: Optional[OverlapPlane] = None
+_plane_lock = threading.Lock()
+
+
+def overlap_active() -> bool:
+    return int(core.globals_["FLAGS_async_staleness"]) > 0
+
+
+def maybe_plane() -> Optional[OverlapPlane]:
+    """The process OverlapPlane iff the overlap plane is on
+    (FLAGS_async_staleness > 0 and FLAGS_sparse_prefetch); created on
+    first use."""
+    if not overlap_active() or not core.globals_["FLAGS_sparse_prefetch"]:
+        return None
+    global _plane
+    with _plane_lock:
+        if _plane is None:
+            _plane = OverlapPlane()
+        return _plane
+
+
+def active_plane() -> Optional[OverlapPlane]:
+    return _plane
+
+
+def reset_plane():
+    global _plane
+    with _plane_lock:
+        plane, _plane = _plane, None
+    if plane is not None:
+        plane.close()
+
+
+# --------------------------------------------------------------------------
+# program scan: which feed vars carry embedding ids for which tables
+# --------------------------------------------------------------------------
+def prefetch_plan(program) -> Tuple[Tuple[str, str, Tuple[str, ...]], ...]:
+    """(table, ids_var_name, endpoints) per distributed_lookup_table op
+    whose Ids input could be a direct feed — cached on the program. The
+    executor's window fallback stages slice i+1 of every windowed id
+    feed named here."""
+    key = ("_prefetch_plan", program._version)
+    cached = program.__dict__.get("_prefetch_plan_cache")
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    plan: List[Tuple[str, str, Tuple[str, ...]]] = []
+    for op in program.global_block().ops:
+        if op.type != "distributed_lookup_table":
+            continue
+        eps = tuple(e for e in (op.attrs.get("epmap") or []) if e)
+        if not eps:
+            continue
+        table = (op.attrs.get("table_names") or op.input("W"))[0]
+        for nm in op.input("Ids"):
+            plan.append((table, nm, eps))
+    result = tuple(plan)
+    program.__dict__["_prefetch_plan_cache"] = (key, result)
+    return result

@@ -15,15 +15,213 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from . import core
 
-__all__ = ["Communicator", "LargeScaleKV"]
+__all__ = ["Communicator", "LargeScaleKV", "RoundPipeline",
+           "round_pipeline", "active_round_pipeline",
+           "drain_async_rounds", "reset_round_pipeline"]
 
 _LOG = logging.getLogger("paddle_tpu.ps")
+
+
+class RoundPipeline:
+    """The half-async round engine of the async overlap plane
+    (docs/PS_DATA_PLANE.md "Async overlap"; reference
+    HalfAsyncCommunicator, operators/distributed/communicator.h:299).
+
+    A sync trainer's comm tail (push grads → send barrier → pull params
+    → fetch barrier) is submitted here as ONE callable per round; a
+    single FIFO worker thread runs rounds in submit order — the
+    server's sync protocol needs exactly one send per trainer per round
+    and in-order barrier arrivals, so rounds never overlap EACH OTHER
+    on the wire, only the trainer's compute. The ps_rpc.AckWindow
+    bounds how many submitted-but-unacked rounds may be in flight
+    (FLAGS_async_staleness); a full pipe blocks ``submit`` — i.e. the
+    step. Round callables return the round's pulled params (the
+    double-buffer fill); ``take_fresh_pulls`` hands the NEWEST
+    completed buffer to the main thread exactly once, which installs it
+    into the scope at the next step boundary.
+
+    Ordered non-round tasks (``submit_task``) ride the same FIFO — the
+    async sparse-grad pushes of step i+1 must reach the server after
+    round i's release and before round i+1's sends, exactly where the
+    sync path would have put them."""
+
+    def __init__(self, name: str = "ps-async-rounds"):
+        from .ps_rpc import AckWindow
+        self._name = name
+        self._q: "queue.Queue" = queue.Queue()
+        self._ack = AckWindow()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._running = True
+        # newest completed pull buffer: (round_id, {param: ndarray});
+        # _installed tracks what the main thread already consumed
+        self._latest = (-1, None)
+        self._installed = -1
+        # queued-or-executing side tasks: the AckWindow only tracks
+        # ROUNDS, but drain() must also cover a sparse push that was
+        # dequeued and is still on the wire (otherwise a drain with no
+        # round behind the push returns early and the push is lost to
+        # a following server stop)
+        self._tasks_cv = threading.Condition()
+        self._tasks_pending = 0
+
+    # ------------------------------------------------------------ submit
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name=self._name, daemon=True)
+                self._thread.start()
+
+    def submit(self, fn, staleness: int, label: str = "round") -> int:
+        """Submit one round; blocks while ``staleness`` rounds are in
+        flight (the full-pipe backpressure) and re-raises any deferred
+        background error typed on this (the main) thread."""
+        from . import profiler as _profiler
+        self._ensure_thread()
+        if self._ack.inflight() >= max(1, int(staleness)) \
+                and _profiler.is_profiling():
+            with _profiler.RecordEvent(
+                    f"{label}:stall[pipe_full]", cat="comm",
+                    args={"inflight": self._ack.inflight()}):
+                rid = self._ack.acquire_slot(staleness)
+        else:
+            rid = self._ack.acquire_slot(staleness)
+        self._q.put(("round", rid, fn, label))
+        return rid
+
+    def submit_task(self, fn, label: str = "task") -> None:
+        """FIFO side task (async sparse push): ordered with the rounds,
+        outside the staleness accounting; errors surface at the next
+        submit()/drain()."""
+        self._ensure_thread()
+        with self._tasks_cv:
+            self._tasks_pending += 1
+        self._q.put(("task", -1, fn, label))
+
+    # -------------------------------------------------------------- loop
+    def _loop(self):
+        from . import profiler as _profiler
+        while True:
+            kind, rid, fn, label = self._q.get()
+            if kind == "stop":
+                return
+            try:
+                if _profiler.is_profiling():
+                    with _profiler.RecordEvent(
+                            f"{label}[{rid}]" if kind == "round"
+                            else label, cat="comm"):
+                        result = fn()
+                else:
+                    result = fn()
+                if kind == "round" and isinstance(result, dict) \
+                        and result:
+                    with self._lock:
+                        if rid > self._latest[0]:
+                            self._latest = (rid, result)
+                err = None
+            except BaseException as e:  # noqa: BLE001 — deferred, typed
+                err = e
+                _LOG.warning("%s: background %s %s failed: %r",
+                             self._name, kind, label, e)
+            if kind == "round":
+                self._ack.ack(err)
+            else:
+                if err is not None:
+                    self._ack.record_error(err)
+                with self._tasks_cv:
+                    self._tasks_pending -= 1
+                    self._tasks_cv.notify_all()
+
+    # ------------------------------------------------------ double buffer
+    def take_fresh_pulls(self):
+        """The newest completed round's pulled params, or None when the
+        main thread already installed them — the at-a-step-boundary
+        half of the double-buffered dense pull."""
+        with self._lock:
+            rid, buf = self._latest
+            if buf is None or rid <= self._installed:
+                return None
+            self._installed = rid
+            return buf
+
+    # -------------------------------------------------------------- drain
+    def inflight(self) -> int:
+        return self._ack.inflight()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait for every submitted round (and queued task) to finish —
+        FIFO, so the flush order is deterministic. Returns False on
+        timeout. Deferred errors re-raise here."""
+        end = None if timeout is None else time.time() + timeout
+        while not self._q.empty():
+            if end is not None and time.time() > end:
+                return False
+            time.sleep(0.005)
+        if not self._ack.wait_all(
+                None if end is None else max(0.0, end - time.time())):
+            return False
+        with self._tasks_cv:
+            while self._tasks_pending > 0:
+                wait = None if end is None else end - time.time()
+                if wait is not None and wait <= 0:
+                    return False
+                self._tasks_cv.wait(wait if wait is None
+                                    else min(wait, 1.0))
+        return True
+
+    def stop(self, timeout: Optional[float] = None):
+        try:
+            self.drain(timeout)
+        except BaseException as e:  # noqa: BLE001 — teardown must finish
+            _LOG.warning("%s: error surfaced during stop-drain: %r",
+                         self._name, e)
+        self._q.put(("stop", -1, None, ""))
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+
+
+# process-global pipeline: the ps_round op kernels have no trainer
+# context, and one trainer process runs one staleness pipe (mirrors the
+# install_row_cache layering in ps_rpc)
+_round_pipe: Optional[RoundPipeline] = None
+_round_pipe_lock = threading.Lock()
+
+
+def round_pipeline() -> RoundPipeline:
+    global _round_pipe
+    with _round_pipe_lock:
+        if _round_pipe is None:
+            _round_pipe = RoundPipeline()
+        return _round_pipe
+
+
+def active_round_pipeline() -> Optional[RoundPipeline]:
+    return _round_pipe
+
+
+def drain_async_rounds(timeout: Optional[float] = None) -> bool:
+    """Flush the staleness pipe (no-op without one). Call before
+    stopping pservers / comparing trainer state — in-flight rounds
+    still hold unpushed grads and unconsumed pulls."""
+    pipe = _round_pipe
+    return True if pipe is None else pipe.drain(timeout)
+
+
+def reset_round_pipeline():
+    global _round_pipe
+    with _round_pipe_lock:
+        pipe, _round_pipe = _round_pipe, None
+    if pipe is not None:
+        pipe.stop(timeout=5.0)
 
 
 class Communicator:
@@ -58,6 +256,29 @@ class Communicator:
         Communicator._global = self
 
     def stop(self):
+        # a stop racing an in-flight async-overlap window must drain
+        # the staleness pipe FIRST, in FIFO submit order: the pipe's
+        # rounds still hold unpushed grads and barrier arrivals the
+        # server is counting on, and the merge-queue flush below
+        # assumes SYNC rounds (no round may land AFTER the flush, or
+        # the server's round accounting sees a phantom late send).
+        # Deterministic order = the single pipeline worker's FIFO; the
+        # drain is bounded so a wedged round (dead pserver) degrades to
+        # the same warn-and-continue contract as the merge threads.
+        pipe = _round_pipe
+        if pipe is not None:
+            try:
+                if not pipe.drain(timeout=max(self._join_timeout * 10,
+                                              10.0)):
+                    _LOG.warning(
+                        "Communicator.stop: async round pipe still has "
+                        "%d round(s) in flight after the drain timeout "
+                        "— a pserver is unreachable; their grads/pulls "
+                        "are dropped", pipe.inflight())
+            except BaseException as e:  # noqa: BLE001 — stop() finishes
+                _LOG.warning(
+                    "Communicator.stop: deferred async-round error "
+                    "surfaced during the pre-flush drain: %r", e)
         self._running = False
         if Communicator._global is self:
             Communicator._global = None

@@ -811,6 +811,21 @@ class _GlobalFlags:
         # Communicator.stop(): how long to wait for each merge thread to
         # drain before logging a warning and moving on
         "FLAGS_communicator_join_timeout": 1.0,
+        # async overlap plane (docs/PS_DATA_PLANE.md "Async overlap"):
+        # how many UNACKNOWLEDGED sync rounds a trainer may keep in
+        # flight while it computes ahead — the ps_round op submits the
+        # round's push/barrier/pull to a background pipeline and
+        # returns; a full pipe blocks the step. 0 (default) = fully
+        # synchronous: the round runs inline and the trajectory is
+        # bit-identical to the pre-overlap send/send_barrier/recv/
+        # fetch_barrier sequence (the golden-oracle contract).
+        "FLAGS_async_staleness": 0,
+        # sparse prefetch under the overlap plane: while window i
+        # computes, a background thread pulls window i+1's embedding
+        # rows into a per-step buffer the lookup op consumes without an
+        # RPC. Only active when FLAGS_async_staleness > 0 (prefetched
+        # rows are up to one round stale by construction).
+        "FLAGS_sparse_prefetch": True,
         "FLAGS_sync_nccl_allreduce": True,   # no-op: ICI collectives are compiled
         "FLAGS_executor_mode": "compiled",   # compiled | interpreted
         # segmented compilation: when a block fails the all-or-nothing

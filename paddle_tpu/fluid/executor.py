@@ -2405,12 +2405,35 @@ class Executor:
         per-path semantics (segment islands, interpreter, mesh
         placement) are exactly the sequential-loop ones."""
         from . import profiler as _profiler
+        from . import async_overlap as _ao
+        # sparse prefetch (docs/PS_DATA_PLANE.md "Async overlap"): with
+        # the overlap plane on, window i+1's embedding ids are staged to
+        # the prefetch thread BEFORE step i dispatches — its deduped
+        # row fan-out runs while step i computes, and step i+1's
+        # distributed_lookup_table consumes the buffered rows without
+        # an RPC (the row-cache consult hook).
+        plane = _ao.maybe_plane()
+        plan = _ao.prefetch_plan(program) if plane is not None else ()
+
+        def _slice(name, i):
+            v = feed[name]
+            a = v.array if isinstance(v, LoDTensor) else v
+            return a[i]
+
+        def _stage(i):
+            for table, ids_name, eps in plan:
+                if ids_name in window_names and ids_name in feed:
+                    plane.stage(table, np.asarray(_slice(ids_name, i)),
+                                list(eps))
+
         ctx = (_profiler.RecordEvent(f"window[{n_steps}]:fallback",
                                      cat="window")
                if _profiler.is_profiling() else contextlib.nullcontext())
         per_step = []
         with ctx:
             for i in range(n_steps):
+                if plan and i + 1 < n_steps:
+                    _stage(i + 1)
                 f = {}
                 for n, v in feed.items():
                     if n in window_names:

@@ -26,7 +26,8 @@ import jax
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
            "stop_profiler", "record_event", "RecordEvent", "is_profiling",
-           "record_span", "record_instant"]
+           "record_span", "record_instant", "snapshot_events",
+           "concurrent_seconds"]
 
 
 class _Event:
@@ -138,6 +139,57 @@ def record_instant(name: str, cat: str = "host", args=None) -> None:
         _record(name, t, t, cat, args)
 
 
+def snapshot_events():
+    """Thread-safe copy of the recorded host events as plain dicts
+    (name/start/end/tid/cat/args) — for tests and bench lanes that
+    compute evidence from a live profile (e.g. the async-overlap
+    concurrency check) without stopping the profiler."""
+    with _prof.lock:
+        return [{"name": e.name, "start": e.start, "end": e.end,
+                 "tid": e.tid, "cat": e.cat, "args": e.args}
+                for e in _prof.events]
+
+
+def _merge_intervals(spans):
+    out = []
+    for s, e in sorted(spans):
+        if out and s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return out
+
+
+def concurrent_seconds(cat_a: str, cat_b: str, events=None) -> float:
+    """Wall seconds during which a ``cat_a`` span overlaps IN TIME with
+    a ``cat_b`` span recorded on a DIFFERENT thread — the async-overlap
+    plane's evidence metric (docs/PS_DATA_PLANE.md "Async overlap"):
+    cat='comm' spans (round pipeline / prefetch threads) concurrent
+    with cat='segment'/'window' step spans on the main thread prove the
+    wire ran behind the compiled step instead of taking turns with
+    it. Both span sets are union-merged first so nesting never double
+    counts."""
+    events = snapshot_events() if events is None else events
+    total = 0.0
+    a_tids = {e["tid"] for e in events if e["cat"] == cat_a}
+    for tid in a_tids:
+        a = _merge_intervals([(e["start"], e["end"]) for e in events
+                              if e["cat"] == cat_a and e["tid"] == tid])
+        b = _merge_intervals([(e["start"], e["end"]) for e in events
+                              if e["cat"] == cat_b and e["tid"] != tid])
+        i = j = 0
+        while i < len(a) and j < len(b):
+            s = max(a[i][0], b[j][0])
+            e = min(a[i][1], b[j][1])
+            if e > s:
+                total += e - s
+            if a[i][1] <= b[j][1]:
+                i += 1
+            else:
+                j += 1
+    return total
+
+
 class RecordEvent:
     """RAII span (reference platform/profiler.h:124). Usable as a context
     manager or decorator; no-op when profiling is off. ``cat`` groups
@@ -146,10 +198,13 @@ class RecordEvent:
     compiled/interpreted partition of a step is visible at a glance,
     multi-step windows emit one cat='window' span per dispatched window
     (window[K]:realdata | :broadcast | :fallback — the one-dispatch-per-
-    window evidence tests/test_window_executor.py counts), and the
-    serving plane emits cat='serve' queue-wait/exec spans whose ``args``
-    carry bucket + batch-size chrome-trace payloads
-    (docs/SERVING.md)."""
+    window evidence tests/test_window_executor.py counts), the serving
+    plane emits cat='serve' queue-wait/exec spans whose ``args`` carry
+    bucket + batch-size chrome-trace payloads (docs/SERVING.md), and the
+    async overlap plane emits cat='comm' spans from its background
+    threads (ps_round[i] rounds, sparse_push tasks, prefetch[table]
+    fetches, plus main-thread round:stall[pipe_full] backpressure) whose
+    concurrency with the step spans ``concurrent_seconds`` measures."""
 
     def __init__(self, name: str, cat: str = "host", args=None):
         self.name = name

@@ -105,6 +105,108 @@ def current_row_cache():
     return _ROW_CACHE
 
 
+class AckWindow:
+    """Ack plumbing for the bounded-staleness async plane
+    (docs/PS_DATA_PLANE.md "Async overlap"). Counts submitted vs
+    acknowledged rounds under one condition variable: ``acquire_slot``
+    blocks while ``max_inflight`` rounds are submitted-but-unacked (a
+    full pipe blocks the trainer's step), ``ack`` releases a slot and
+    records the round's error if it failed. A recorded error surfaces
+    TYPED on the main thread at the next ``acquire_slot``/``wait_all``
+    — a background round failure (WorkerDeadError, NumericFaultError
+    from a rejecting pserver) must stop the training loop, not vanish
+    into a daemon thread."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._submitted = 0
+        self._acked = 0
+        self._error: Optional[BaseException] = None
+
+    def inflight(self) -> int:
+        with self._cv:
+            return self._submitted - self._acked
+
+    def _raise_pending_locked(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def record_error(self, err: BaseException) -> None:
+        """Record a failure from a non-round pipeline task (e.g. an
+        async sparse push) without touching the slot accounting."""
+        with self._cv:
+            if self._error is None:
+                self._error = err
+            self._cv.notify_all()
+
+    def acquire_slot(self, max_inflight: int,
+                     timeout: Optional[float] = None) -> int:
+        """Block until a slot frees, then count one submission and
+        return its 0-based round index. Raises the first deferred round
+        error instead of submitting (the error is consumed)."""
+        max_inflight = max(1, int(max_inflight))
+        end = None if timeout is None else time.time() + timeout
+        with self._cv:
+            while True:
+                self._raise_pending_locked()
+                if self._submitted - self._acked < max_inflight:
+                    rid = self._submitted
+                    self._submitted += 1
+                    return rid
+                wait = None if end is None else end - time.time()
+                if wait is not None and wait <= 0:
+                    raise TimeoutError(
+                        f"AckWindow: pipe full ({max_inflight} rounds "
+                        f"in flight) past the deadline")
+                self._cv.wait(wait if wait is None else min(wait, 1.0))
+
+    def ack(self, error: Optional[BaseException] = None) -> None:
+        with self._cv:
+            self._acked += 1
+            if error is not None and self._error is None:
+                self._error = error
+            self._cv.notify_all()
+
+    def wait_all(self, timeout: Optional[float] = None) -> bool:
+        """Wait until every submitted round acked. Returns False on
+        timeout; re-raises the first deferred error (consumed)."""
+        end = None if timeout is None else time.time() + timeout
+        with self._cv:
+            while self._submitted > self._acked:
+                wait = None if end is None else end - time.time()
+                if wait is not None and wait <= 0:
+                    return False
+                self._cv.wait(wait if wait is None else min(wait, 1.0))
+            self._raise_pending_locked()
+            return True
+
+
+# fault injection (tests/faultinject.py rpc_delay): a pserver sleeps
+# this many ms before dispatching each data-plane call — models a slow
+# wire/congested server so the async-overlap tests can prove the
+# staleness pipe actually decouples the step from the RPCs. Heartbeat /
+# membership traffic is exempt by default (delaying beats would declare
+# live workers dead).
+_DELAY_DEFAULT_METHODS = frozenset({
+    "send_var", "send_vars_batch", "get_var", "get_vars_batch",
+    "prefetch_rows", "barrier"})
+
+
+def _maybe_inject_rpc_delay(method: str) -> None:
+    ms = os.environ.get("PADDLE_TPU_PS_RPC_DELAY_MS")
+    if not ms:
+        return
+    allowed = os.environ.get("PADDLE_TPU_PS_RPC_DELAY_METHODS")
+    methods = (frozenset(allowed.split(",")) if allowed
+               else _DELAY_DEFAULT_METHODS)
+    if method in methods:
+        try:
+            time.sleep(float(ms) / 1000.0)
+        except ValueError:
+            pass
+
+
 def _pickle_wire_forced() -> bool:
     """PADDLE_TPU_PS_PICKLE_WIRE=1 is the LEGACY DATA-PLANE mode: the
     pre-throughput-overhaul behavior end to end — v1 pickle frames, one
@@ -393,6 +495,7 @@ class VarServer:
                             send({"ok": True})
                             outer._stop_evt.set()
                             return
+                        _maybe_inject_rpc_delay(method)
                         nout = 0
                         token = msg.pop("_dedup", None)
                         epoch = msg.pop("_view_epoch", None)
@@ -1052,9 +1155,23 @@ class VarClient:
     def get_var(self, name: str, trainer_id: int = 0) -> np.ndarray:
         return self.call("get_var", name=name, trainer_id=trainer_id)
 
-    def prefetch_rows(self, name: str, rows) -> np.ndarray:
-        return self.call("prefetch_rows", name=name,
-                         rows=np.asarray(rows, np.int64).reshape(-1))
+    def prefetch_rows(self, name: str, rows,
+                      prefetch: bool = False) -> np.ndarray:
+        """Row pull. ``prefetch=True`` tags the call as an async-overlap
+        early fetch so the server's stats() can count prefetch traffic
+        separately; an old server without the kwarg gets the untagged
+        call (memoized fallback — the method is idempotent, so the
+        retry is safe)."""
+        rows = np.asarray(rows, np.int64).reshape(-1)
+        if prefetch and "prefetch_rows#tag" not in self._missing_methods:
+            try:
+                return self.call("prefetch_rows", name=name, rows=rows,
+                                 prefetch=True)
+            except (RuntimeError, TypeError) as e:
+                if "unexpected keyword" not in str(e):
+                    raise
+                self._missing_methods.add("prefetch_rows#tag")
+        return self.call("prefetch_rows", name=name, rows=rows)
 
     def barrier(self, kind: str, trainer_id: int = 0):
         return self.call("barrier", kind=kind, trainer_id=trainer_id)

@@ -44,6 +44,14 @@ class DistributeTranspilerConfig:
     # memory bound for LazyEmbeddingTable-hosted sparse tables (rows kept
     # per pserver before LRU eviction); 0 = unbounded
     sparse_table_max_rows = 0
+    # async overlap plane (docs/PS_DATA_PLANE.md "Async overlap"): the
+    # sync trainer's send/send_barrier/recv/fetch_barrier tail collapses
+    # into ONE ps_round op whose kernel pipelines the round behind the
+    # next step's compute, bounded by FLAGS_async_staleness
+    # (0 = the round runs inline, bit-identical to the plain sync tail).
+    # Also turned on implicitly when FLAGS_async_staleness > 0 at
+    # transpile time, so subprocess trainers enable it via env.
+    async_overlap = False
 
 
 class DistributeTranspiler:
@@ -258,6 +266,30 @@ class DistributeTranspiler:
         # barrier release (listen_and_serv sync mode) and would never
         # train if no barrier reached it
         barrier_eps = list(self.pserver_endpoints)
+        from .. import core as _core
+        if self.sync_mode and (
+                self.config.async_overlap
+                or int(_core.globals_["FLAGS_async_staleness"]) > 0):
+            # async-mode rewrite (docs/PS_DATA_PLANE.md "Async
+            # overlap"): the whole comm tail becomes ONE ps_round op —
+            # grads/params flattened in the same sorted-endpoint order
+            # the per-ep send/recv ops would have used, barriers to
+            # every pserver as above. The op's kernel replays exactly
+            # this sequence inline at FLAGS_async_staleness=0 and
+            # pipelines it behind the next step's compute at
+            # staleness>0.
+            grads = [g for ep in eps for g in by_ep_grads[ep]]
+            gmap = [ep for ep in eps for _ in by_ep_grads[ep]]
+            params = [p for ep in eps for p in by_ep_params[ep]]
+            pmap = [ep for ep in eps for _ in by_ep_params[ep]]
+            block.append_op(
+                type="ps_round", inputs={"X": grads},
+                outputs={"Out": params},
+                attrs={"grad_epmap": gmap, "param_epmap": pmap,
+                       "endpoints": barrier_eps,
+                       "trainer_id": self.trainer_id})
+            self.trainer_program = prog
+            return
         for ep in eps:
             block.append_op(
                 type="send", inputs={"X": by_ep_grads[ep]}, outputs={},

@@ -270,6 +270,118 @@ register_op("fetch_barrier", stateful=True, no_grad=True,
     _barrier_op("fetch"))
 
 
+@register_op("ps_round", stateful=True, no_grad=True,
+             attr_defaults={"grad_epmap": [], "param_epmap": [],
+                            "endpoints": [], "trainer_id": 0})
+def _ps_round(ins, attrs):
+    """The whole sync comm tail — push grads → send barrier → pull
+    params → fetch barrier — as ONE op, emitted by the transpiler's
+    async-mode rewrite (docs/PS_DATA_PLANE.md "Async overlap").
+
+    ``FLAGS_async_staleness = 0``: the round runs INLINE, replaying the
+    exact RPC sequence of the pre-overlap send/send_barrier/recv/
+    fetch_barrier tail — the trajectory is bit-identical to sync mode
+    (the golden-oracle contract; tested on the 3-trainer wide_deep
+    agreement run).
+
+    ``FLAGS_async_staleness = k > 0``: the round is SUBMITTED to the
+    communicator's RoundPipeline and the op returns immediately, so the
+    executor launches window i+1 while round i's wire work drains in
+    the background; at most k submitted-but-unacked rounds may be in
+    flight (a full pipe blocks here — backpressure, not divergence).
+    Each round's pulled params land in the pipeline's latest-pull
+    buffer; the newest completed buffer is installed into the scope at
+    this (step-boundary) call — the double-buffered dense pull. A
+    background round failure re-raises TYPED at the next submit."""
+    import logging
+    ctx = attrs["_ctx"]
+    scope = ctx.scope
+    grad_names = list(ctx.op.input("X") or [])
+    param_names = list(ctx.op.output("Out") or [])
+    gmap = [str(e) for e in (attrs.get("grad_epmap") or [])]
+    pmap = [str(e) for e in (attrs.get("param_epmap") or [])]
+    beps = list(dict.fromkeys(
+        str(e) for e in (attrs.get("endpoints") or [])))
+    tid = int(attrs.get("trainer_id", 0))
+    legacy = _legacy_dataplane()
+
+    # snapshot grads NOW (jax arrays are immutable, so holding the refs
+    # is safe while the next step replaces the scope slots); host
+    # conversion happens inside the round so the D2H wait overlaps too
+    send_groups: dict = {}
+    for i, name in enumerate(grad_names):
+        ep = gmap[i if i < len(gmap) else -1]
+        val = _np_of(scope, name)
+        if val is None:
+            if name not in _warned_uninit_sends:
+                _warned_uninit_sends.add(name)
+                logging.getLogger("paddle_tpu.ps").warning(
+                    "ps_round: var '%s' is uninitialized in this scope "
+                    "— skipping its push to %s (warned once)", name, ep)
+            continue
+        send_groups.setdefault(ep, []).append((name, val))
+    recv_groups: dict = {}
+    for i, name in enumerate(param_names):
+        ep = pmap[i if i < len(pmap) else -1]
+        recv_groups.setdefault(ep, []).append(name)
+
+    def do_round():
+        from ..fluid.ps_rpc import send_vars_batch
+        for ep, items in send_groups.items():
+            dense = []
+            for n, v in items:
+                if isinstance(v, core.SelectedRows):
+                    _client(ep).send_var(
+                        n, np.asarray(v.get_tensor().array),
+                        trainer_id=tid, rows=v.rows(),
+                        height=v.height())
+                else:
+                    dense.append((n, np.asarray(v)))
+            if len(dense) > 1 and not legacy:
+                send_vars_batch(_client(ep), dense, trainer_id=tid)
+            else:
+                for n, v in dense:
+                    _client(ep).send_var(n, v, trainer_id=tid)
+        for ep in beps:
+            _client(ep).barrier("send", trainer_id=tid)
+        pulled = {}
+        for ep, names in recv_groups.items():
+            cli = _client(ep)
+            if len(names) == 1 or legacy \
+                    or "get_vars_batch" in cli._missing_methods:
+                got = [cli.get_var(n, trainer_id=tid) for n in names]
+            else:
+                try:
+                    got = cli.call("get_vars_batch", names=names,
+                                   trainer_id=tid)
+                except RuntimeError as e:
+                    if "no method get_vars_batch" not in str(e):
+                        raise
+                    cli._missing_methods.add("get_vars_batch")
+                    got = [cli.get_var(n, trainer_id=tid)
+                           for n in names]
+            pulled.update(zip(names, got))
+        for ep in beps:
+            _client(ep).barrier("fetch", trainer_id=tid)
+        return pulled
+
+    def install(pulled):
+        for name, arr in pulled.items():
+            scope.var(name).set_value(core.LoDTensor(jnp.asarray(arr)))
+
+    staleness = int(core.globals_["FLAGS_async_staleness"])
+    if staleness <= 0:
+        install(do_round())
+        return {}
+    from ..fluid import communicator as _comm
+    pipe = _comm.round_pipeline()
+    pipe.submit(do_round, staleness, label="ps_round")
+    fresh = pipe.take_fresh_pulls()
+    if fresh:
+        install(fresh)
+    return {}
+
+
 @register_op("checkpoint_notify", stateful=True, no_grad=True,
              attr_defaults={"epmap": [], "dir": ""})
 def _checkpoint_notify(ins, attrs):
@@ -302,20 +414,24 @@ def _table_dtype(ctx, w_name):
         return jnp.float32
 
 
-def _pull_rows_sharded(eps, w_name, uniq):
+def _pull_rows_sharded(eps, w_name, uniq, prefetch=False):
     """One deduped row pull, row-sharded across ``eps`` by
     ``id %% n_pservers`` with every per-pserver section RPC issued
     concurrently (reference parameter_prefetch overlap). ``uniq`` must
-    hold distinct ids; returns [len(uniq), dim] in input order."""
+    hold distinct ids; returns [len(uniq), dim] in input order.
+    ``prefetch=True`` tags the RPCs as async-overlap early fetches for
+    the server-side stats counter."""
     uniq = np.asarray(uniq)
     if len(eps) == 1:
-        return np.asarray(_client(eps[0]).prefetch_rows(w_name, uniq))
+        return np.asarray(_client(eps[0]).prefetch_rows(
+            w_name, uniq, prefetch=prefetch))
     shard = uniq % len(eps)
     sels = [np.where(shard == k)[0] for k in range(len(eps))]
     live = [(ep, sel) for ep, sel in zip(eps, sels) if len(sel)]
 
     def _pull(ep, sel):
-        return np.asarray(_client(ep).prefetch_rows(w_name, uniq[sel]))
+        return np.asarray(_client(ep).prefetch_rows(
+            w_name, uniq[sel], prefetch=prefetch))
 
     parts = _fanout([(lambda ep=ep, sel=sel: _pull(ep, sel))
                      for ep, sel in live])
@@ -373,6 +489,18 @@ def _distributed_lookup_table(ins, attrs):
     return {"Outputs": outs}
 
 
+def _program_has_ps_round(program) -> bool:
+    """Whether the trainer program was async-rewritten (ps_round tail);
+    cached per program version."""
+    cached = program.__dict__.get("_has_ps_round")
+    if cached is None or cached[0] != program._version:
+        has = any(op.type == "ps_round"
+                  for op in program.global_block().ops)
+        program.__dict__["_has_ps_round"] = cached = \
+            (program._version, has)
+    return cached[1]
+
+
 @register_grad_maker("distributed_lookup_table")
 def _dist_lookup_grad_maker(op, grad_map):
     return [{
@@ -391,12 +519,21 @@ def _dist_lookup_grad_maker(op, grad_map):
 def _distributed_lookup_table_grad(ins, attrs):
     """Pushes SelectedRows gradients back, row-sharded across epmap the
     same way the forward pull routes ids."""
+    from ..fluid import ps_rpc as _ps_rpc
     ctx = attrs["_ctx"]
     id_names = ctx.op.input("Ids")
     w_name = (attrs.get("table_names") or ctx.op.input("W"))[0]
     eps = [e for e in (attrs.get("epmap") or []) if e] or [None]
     tid = int(attrs.get("trainer_id", 0))
     g_names = ctx.op.input("Outputs@GRAD")
+    # async pushes require the ps_round tail, not just the flag: in a
+    # program still carrying the plain send_barrier tail (flag flipped
+    # after transpile) a backgrounded push could land AFTER the
+    # main-thread barrier released its round — a phantom next-round
+    # arrival — and with no ps_round submit()/drain() on this program
+    # a failed push's deferred error would never re-raise
+    overlap = int(core.globals_["FLAGS_async_staleness"]) > 0 \
+        and _program_has_ps_round(ctx.op.block.program)
     for nm, gn in zip(id_names, g_names):
         ids = np.asarray(ctx.scope.find_var(nm).value().array).reshape(-1)
         if len(ids) == 0:
@@ -415,22 +552,45 @@ def _distributed_lookup_table_grad(ins, attrs):
             merged = np.zeros((len(uniq), g.shape[1]), g.dtype)
             np.add.at(merged, inv, g)
             ids, g = uniq, merged
-        if len(eps) == 1:
-            _client(eps[0]).send_var(w_name + "@GRAD", g, trainer_id=tid,
-                                     rows=ids, height=0)
-            continue
-        # concurrent per-pserver sends, first error wins (fan-out like
-        # the forward pull)
-        shard = ids % len(eps)
-        sels = [np.where(shard == k)[0] for k in range(len(eps))]
-        live = [(ep, sel) for ep, sel in zip(eps, sels) if len(sel)]
+        # async overlap: the prefetch buffer must drop its copies of
+        # the rows this push dirties BEFORE the push even enqueues —
+        # inline on the main thread, so no later lookup can race a
+        # known-dirty row (docs/PS_DATA_PLANE.md "Async overlap")
+        cache = _ps_rpc.current_row_cache()
+        if cache is not None and hasattr(cache, "invalidate_rows"):
+            cache.invalidate_rows(w_name, ids)
 
-        def _push(ep, sel):
-            _client(ep).send_var(w_name + "@GRAD", g[sel],
-                                 trainer_id=tid, rows=ids[sel], height=0)
+        def _push_all(ids=ids, g=g):
+            if len(eps) == 1:
+                _client(eps[0]).send_var(w_name + "@GRAD", g,
+                                         trainer_id=tid, rows=ids,
+                                         height=0)
+                return
+            # concurrent per-pserver sends, first error wins (fan-out
+            # like the forward pull)
+            shard = ids % len(eps)
+            sels = [np.where(shard == k)[0] for k in range(len(eps))]
+            live = [(ep, sel) for ep, sel in zip(eps, sels) if len(sel)]
 
-        _fanout([(lambda ep=ep, sel=sel: _push(ep, sel))
-                 for ep, sel in live])
+            def _push(ep, sel):
+                _client(ep).send_var(w_name + "@GRAD", g[sel],
+                                     trainer_id=tid, rows=ids[sel],
+                                     height=0)
+
+            _fanout([(lambda ep=ep, sel=sel: _push(ep, sel))
+                     for ep, sel in live])
+
+        if overlap:
+            # ride the round pipeline's FIFO: the push lands after the
+            # previous round's release and before this round's sends —
+            # exactly where the inline path would have put it — while
+            # the main thread keeps computing. Errors surface typed at
+            # the next ps_round submit.
+            from ..fluid import communicator as _comm
+            _comm.round_pipeline().submit_task(
+                _push_all, label=f"sparse_push:{w_name}")
+        else:
+            _push_all()
     return {}
 
 
@@ -554,6 +714,10 @@ def _listen_and_serv(ins, attrs):
     health = {"dropped_sparse_rows": 0, "dropped_dense_updates": 0,
               "rejected_calls": 0, "per_var": {}}
     health_lock = threading.Lock()
+    # async-overlap observability: row pulls tagged prefetch=True (the
+    # trainer-side prefetch thread's early fetches) — shares the
+    # innermost counter lock with the health counters
+    prefetch_stats = {"calls": 0, "rows": 0}
 
     def _bump_health(key, name, n):
         with health_lock:
@@ -812,7 +976,16 @@ def _listen_and_serv(ins, attrs):
         in ONE RPC (read-only, idempotent like get_var)."""
         return [h_get_var(n, trainer_id) for n in names]
 
-    def h_prefetch_rows(name, rows):
+    def h_prefetch_rows(name, rows, prefetch=False):
+        # ``prefetch=True`` marks an async-overlap early fetch (the
+        # trainer pulled window i+1's rows while window i computed) —
+        # counted separately under stats()["prefetch"] so operators can
+        # see how much of the row traffic moved off the step's critical
+        # path (docs/PS_DATA_PLANE.md "Async overlap")
+        if prefetch:
+            with health_lock:
+                prefetch_stats["calls"] += 1
+                prefetch_stats["rows"] += len(rows)
         # under the grad lock: get_rows materializes rows (slab growth,
         # index/LRU mutation) and must not interleave with a concurrent
         # apply_grad — the channel pool + fan-out make overlapping RPCs
@@ -1387,7 +1560,7 @@ def _listen_and_serv(ins, attrs):
                 "dropped_dense_updates": health["dropped_dense_updates"],
                 "rejected_calls": health["rejected_calls"],
                 "per_var": dict(health["per_var"]),
-            }}
+            }, "prefetch": dict(prefetch_stats)}
 
     srv.add_stats_source(_health_stats_snapshot)
     # drain tooling / tests poll epoch, state, handoff progress, and

@@ -86,6 +86,10 @@ def main():
         cfg.geo_sgd_need_push_nums = 5
     if max_rows:
         cfg.sparse_table_max_rows = max_rows
+    if "--async-overlap" in sys.argv:
+        # ps_round comm tail (docs/PS_DATA_PLANE.md "Async overlap");
+        # the runtime staleness knob rides the FLAGS_async_staleness env
+        cfg.async_overlap = True
     t = DistributeTranspiler(cfg)
     with fluid.program_guard(main_prog, startup):
         t.transpile(trainer_id=tid, pservers=eps, trainers=trainers,
@@ -154,6 +158,11 @@ def main():
                         pf.write(f"{s} {losses[-1]!r}\n")
                 if step_sleep:
                     time.sleep(step_sleep)
+            # async overlap: flush the staleness pipe before releasing
+            # the pservers — in-flight rounds still hold this trainer's
+            # barrier arrivals (no-op in plain sync mode)
+            from paddle_tpu.fluid.communicator import drain_async_rounds
+            drain_async_rounds()
     except BaseException:
         # a failed step must still release the pservers, or the cluster
         # test dies by timeout hiding the real traceback

@@ -102,6 +102,44 @@ def read_jsonl(path):
     return out
 
 
+# ------------------------------------------------------------ rpc delay
+class rpc_delay:
+    """Context manager: every data-plane RPC a pserver handles sleeps
+    ``ms`` milliseconds before dispatch (ps_rpc._maybe_inject_rpc_delay
+    reads the env at call time). Models a slow/congested wire so the
+    async-overlap tests can prove the staleness pipe decouples the
+    step from the RPCs. Heartbeats/membership traffic are exempt
+    unless ``methods`` names them explicitly.
+
+    Works on in-process VarServers immediately; subprocess pservers
+    inherit the env vars when SPAWNED INSIDE the context (set env
+    before the cluster starts)."""
+
+    def __init__(self, ms, methods=None):
+        self.ms = float(ms)
+        self.methods = methods
+        self._saved = {}
+
+    def __enter__(self):
+        for k, v in (("PADDLE_TPU_PS_RPC_DELAY_MS", str(self.ms)),
+                     ("PADDLE_TPU_PS_RPC_DELAY_METHODS",
+                      ",".join(self.methods) if self.methods else None)):
+            self._saved[k] = os.environ.get(k)
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        return self
+
+    def __exit__(self, *exc):
+        for k, old in self._saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        return False
+
+
 # ------------------------------------------------------- numeric poison
 _POISON_VALUES = {"nan": float("nan"), "inf": float("inf"),
                   "-inf": float("-inf")}

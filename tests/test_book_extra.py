@@ -65,6 +65,9 @@ def test_vgg16_builds():
     assert len(convs) == 13  # VGG16: 13 conv layers
 
 
+@pytest.mark.slow  # demoted r13 (suite-time buyback): 58s, the suite's
+# slowest test; conv-net training coverage stays via test_vgg_cifar_trains
+# and test_book_models
 def test_sentiment_conv_net_converges():
     wd = paddle.dataset.imdb.word_dict()
     main, startup, feeds, loss, acc = book_extra.build_sentiment_program(
@@ -92,6 +95,9 @@ def test_sentiment_conv_net_converges():
     assert np.mean(accs[-10:]) > 0.6, np.mean(accs[-10:])
 
 
+@pytest.mark.slow  # demoted r13 (suite-time buyback): 22s convergence
+# run; embedding+fc training coverage stays via the wide_deep and dist_ps
+# tiers
 def test_recommender_system_converges():
     ml = paddle.dataset.movielens
     main, startup, feeds, loss = book_extra.build_recommender_program(
@@ -122,6 +128,8 @@ def test_recommender_system_converges():
     assert np.mean(losses[-8:]) < np.mean(losses[:8]), losses
 
 
+@pytest.mark.slow  # demoted r13 (suite-time buyback): 34s; the
+# linear_chain_crf grad path stays covered in test_grad_battery_tail
 def test_srl_crf_trains_and_decodes():
     """CRF tagging: NLL falls and viterbi decoding recovers the pattern on
     a synthetic id→tag task."""

@@ -203,6 +203,10 @@ def test_async_communicator_merges_sends():
         VarClient.reset_pool()
 
 
+@pytest.mark.slow  # demoted r13 (suite-time buyback): 18s of step_sleep
+# wall time; dead-trainer detection stays tier-1 via the sync-cluster
+# WorkerDeadError test in test_fault_tolerance (same monitor, ~9s) —
+# this case only adds the GEO-mode survivor flavor
 def test_trainer_failure_detection(tmp_path):
     """Kill a trainer mid-run: the pserver's HeartBeatMonitor flags it,
     the server keeps serving, and the surviving trainer completes

@@ -70,7 +70,10 @@ def test_gan_style_alternating_optimizers():
             1e-2, parameter_list=disc.parameters())
 
         d_losses, g_losses = [], []
-        for step in range(200):
+        # 120 steps (was 200, r13 suite-time buyback): the direction
+        # assert below crosses 0.5 by ~step 80 on this seed; 120 keeps
+        # margin without paying the full 18s eager loop
+        for step in range(120):
             real = rng.randn(32, 2).astype("float32") * 0.5 + 2.0
             noise = rng.randn(32, 2).astype("float32")
 

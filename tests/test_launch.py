@@ -5,6 +5,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = """
@@ -86,6 +88,9 @@ def test_two_process_dp_matches_single_process(tmp_path):
         assert abs(a - b) < 1e-4, (multi, single)
 
 
+@pytest.mark.slow  # demoted r13 (suite-time buyback): 19s, 5 processes;
+# the DP half stays tier-1 via the 2/4-process parity tests and the PS
+# lazy-table half via test_dist_ps — this case only composes the two
 def test_combined_dp_trainers_with_ps_lazy_tables(tmp_path):
     """VERDICT r2 #5 — the BASELINE.md Wide&Deep shape in one job:
     launcher-driven 2-process trainers (jax.distributed bring-up) that

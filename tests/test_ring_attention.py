@@ -31,7 +31,12 @@ def test_ring_matches_dense(causal):
                                rtol=2e-5, atol=2e-6)
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize(
+    "causal",
+    [pytest.param(False, marks=pytest.mark.slow), True])
+# causal=False demoted r13 (suite-time buyback): the pair cost 31s and
+# causal=True exercises strictly more of the ring schedule (masked
+# blocks + skip logic); the non-causal grad path keeps slow coverage
 def test_ring_grads_match_dense(causal):
     q, k, v = _qkv(1)
     mesh = sequence_mesh(SP)

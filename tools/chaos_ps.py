@@ -118,7 +118,8 @@ class Cluster:
 
     def __init__(self, workdir, model="linear", trainers=2, n_pservers=2,
                  steps=20, hb=2.0, step_sleep=0.15, standby_slots=(),
-                 replica_slots=(), sparse_dim=200, batch=32, tag="run"):
+                 replica_slots=(), sparse_dim=200, batch=32, tag="run",
+                 env_extra=None, worker_extra=()):
         self.workdir = workdir
         self.model = model
         self.trainers = trainers
@@ -132,6 +133,8 @@ class Cluster:
         self.replica_eps = {i: f"127.0.0.1:{free_port()}"
                             for i in replica_slots}
         self.env = {"PADDLE_PS_HEARTBEAT_TIMEOUT": str(hb)}
+        self.env.update(env_extra or {})
+        self.worker_extra = tuple(worker_extra)
         if self.replica_eps:
             self.env["FLAGS_ps_replicas"] = "2"
             self.env["PADDLE_PS_REPLICA_MAP"] = ",".join(
@@ -161,7 +164,7 @@ class Cluster:
                     outfile, f"--sparse-dim={self.sparse_dim}",
                     f"--batch={self.batch}",
                     f"--step-sleep={self.step_sleep}"]
-        return base + list(extra)
+        return base + list(self.worker_extra) + list(extra)
 
     def _out(self, name):
         return os.path.join(self.workdir, f"{self.tag}-{name}")
@@ -342,7 +345,13 @@ def run_worker():
             is_distributed=True, optimizer=fluid.optimizer.SGD(1e-2))
 
     main, startup, feeds, loss, _auc = build()
-    t = DistributeTranspiler()
+    from paddle_tpu.fluid.transpiler import DistributeTranspilerConfig
+    cfg = DistributeTranspilerConfig()
+    if "--async-overlap" in sys.argv:
+        # ps_round comm tail (docs/PS_DATA_PLANE.md "Async overlap");
+        # FLAGS_async_staleness rides the env into this subprocess
+        cfg.async_overlap = True
+    t = DistributeTranspiler(cfg)
     with fluid.program_guard(main, startup):
         t.transpile(trainer_id=idx if role == "trainer" else 0,
                     pservers=eps, trainers=trainers, sync_mode=True,
@@ -384,6 +393,10 @@ def run_worker():
                     pf.write(f"{s} {losses[-1]!r}\n")
                 if step_sleep:
                     time.sleep(step_sleep)
+            # flush the async-overlap staleness pipe before the
+            # pservers are released (no-op in plain sync mode)
+            from paddle_tpu.fluid.communicator import drain_async_rounds
+            drain_async_rounds()
     finally:
         beat.stop()
     json.dump(losses, open(outfile, "w"))

@@ -61,10 +61,14 @@ def run_pserver(eps, idx, sparse_dim, trainers=1):
 
 
 def run_trainer(eps, trainer_id, trainers, sparse_dim, batch, steps,
-                warmup, outfile):
+                warmup, outfile, window_k=1):
     """Subprocess trainer for the multi-trainer bench row: trains its
     shard of the deterministic batch stream against the shared PS plane
-    and writes its samples/sec."""
+    and writes its samples/sec. ``window_k > 1`` feeds a [K, ...] stack
+    of K distinct batches per run (the async-overlap lanes' shape —
+    the executor's window fallback staggers sparse prefetch across the
+    slices); ``warmup``/``steps`` stay TOTAL step counts so the sync
+    plane's per-round barrier accounting matches trainer 0's."""
     import json
     import time
 
@@ -72,6 +76,7 @@ def run_trainer(eps, trainer_id, trainers, sparse_dim, batch, steps,
 
     fluid = _fluid()
     from paddle_tpu.fluid import core
+    from paddle_tpu.fluid.communicator import drain_async_rounds
     from paddle_tpu.fluid.ps_rpc import WorkerHeartBeat
     from paddle_tpu.models import wide_deep
 
@@ -83,16 +88,28 @@ def run_trainer(eps, trainer_id, trainers, sparse_dim, batch, steps,
     scope = core.Scope()
     nb = wide_deep.ctr_reader(batch, num_dense=13, num_slots=26,
                               sparse_dim=sparse_dim, seed=trainer_id)
-    feed = nb()
+    window_k = max(1, int(window_k))
+    if window_k > 1:
+        assert steps % window_k == 0 and warmup % window_k == 0, \
+            (steps, warmup, window_k)
+        batches = [nb() for _ in range(window_k)]
+        feed = {n: np.stack([b[n] for b in batches])
+                for n in batches[0]}
+        kw = {"n_steps": window_k}
+    else:
+        feed = nb()
+        kw = {}
     beat = WorkerHeartBeat(eps.split(","), trainer_id, interval=0.5).start()
     try:
         with fluid.scope_guard(scope):
             exe.run(startup)
-            for _ in range(warmup):
-                exe.run(prog, feed=feed, fetch_list=[loss])
+            for _ in range(warmup // window_k):
+                exe.run(prog, feed=feed, fetch_list=[loss], **kw)
             t0 = time.perf_counter()
-            for _ in range(steps):
-                exe.run(prog, feed=feed, fetch_list=[loss])
+            for _ in range(steps // window_k):
+                exe.run(prog, feed=feed, fetch_list=[loss], **kw)
+            # in-flight async rounds are part of the measured work
+            drain_async_rounds()
             dt = time.perf_counter() - t0
     finally:
         beat.stop()
@@ -109,6 +126,7 @@ if __name__ == "__main__":
     elif role == "trainer":
         run_trainer(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
                     int(sys.argv[5]), int(sys.argv[6]), int(sys.argv[7]),
-                    int(sys.argv[8]), sys.argv[9])
+                    int(sys.argv[8]), sys.argv[9],
+                    int(sys.argv[10]) if len(sys.argv) > 10 else 1)
     else:
         raise SystemExit(f"unknown role {role!r}")
