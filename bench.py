@@ -1175,6 +1175,53 @@ def bench_serving_wide_deep(clients=8, duration=2.0, warmup_s=0.5,
             "pservers": len(eps)}
 
 
+def bench_serve_http_overload(clients=16, duration=2.5, warmup_s=0.5,
+                              overload_factor=4.0):
+    """HTTP ingress overload lane (docs/SERVING.md "Ingress &
+    overload"): the full serving stack on the wire — ThreadingHTTP
+    ingress → admission queue → continuous batcher → scan-mode engine —
+    measured closed-loop at capacity (1× load), then open-loop at 1×
+    and 4× the measured capacity with 16 HTTP clients. Reports the
+    accepted-request p99 at 1× and 4×, the shed rate (typed 429s; any
+    untyped 5xx/transport failure fails the lane), and the engine's
+    shed/deadline counters. The robustness claim is the RATIO: under
+    4× offered load the accepted p99 stays bounded (admission bound +
+    CoDel head-drop) and every refused request is answered typed.
+    1-core caveat: clients, ingress handlers, and engine workers
+    time-slice one core, so absolute QPS is trend-only (PR 7 serving
+    caveat) — ratio and typed-refusal figures are the robust
+    numbers."""
+    from tools.serving_loadgen import run_overload_scenario
+
+    res = run_overload_scenario(clients=clients, duration_s=duration,
+                                warmup_s=warmup_s,
+                                overload_factor=overload_factor)
+    return {
+        "metric": "serve_http_overload_p99_ratio",
+        "value": res["p99_ratio"],
+        "unit": "x (accepted p99 at 4x / 1x)",
+        "vs_baseline": res["p99_ratio"],
+        "clients": clients,
+        "capacity_qps_1x": res["capacity_qps_1x"],
+        "accepted_p99_ms_1x": round(res["accepted_p99_ms_1x"], 2),
+        "accepted_p99_ms_1x_open": round(
+            res["accepted_p99_ms_1x_open"], 2),
+        "accepted_p99_ms_overload": round(
+            res["accepted_p99_ms_overload"], 2),
+        "p99_ratio_vs_open_1x": res["p99_ratio_vs_open_1x"],
+        "shed_rate_overload": res["shed_rate_overload"],
+        "overload_statuses": res["open_overload"]["statuses"],
+        "untyped_failures": res["untyped_failures"],
+        "all_refusals_typed": res["all_refusals_typed"],
+        "engine_shed": res["engine"]["shed"],
+        "engine_deadline_expired": res["engine"]["deadline_expired"],
+        # the bound/deadline the scenario actually resolved and ran
+        # with — re-deriving its defaults here would silently drift
+        "max_queue_rows": res["max_queue_rows"],
+        "deadline_ms": res["deadline_ms"],
+    }
+
+
 def bench_longctx(iters=8):
     """Long-context attention lane (SURVEY §5: long-context is
     first-class here — ring/Ulysses SP + flash kernels — where the
@@ -1348,6 +1395,7 @@ def main():
                "wide_deep_realdata": bench_wide_deep_realdata,
                "serve_mnist": bench_serving_mnist,
                "serve_wide_deep": bench_serving_wide_deep,
+               "serve_http_overload": bench_serve_http_overload,
                "flash": bench_flash, "longctx": bench_longctx}
     if which not in benches:
         raise SystemExit(f"unknown bench '{which}'; one of "

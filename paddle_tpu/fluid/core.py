@@ -94,6 +94,47 @@ class NumericFaultError(FloatingPointError):
     pre-existing FLAGS_check_nan_inf handlers keep catching it."""
 
 
+class DeadlineExceededError(TimeoutError):
+    """A request's propagated deadline expired before the work finished
+    (docs/SERVING.md "Ingress & overload"): the serving ingress stamps
+    each request with a budget, and queue wait, bucket dispatch, and PS
+    row fetches (``ps_rpc.call_budget``) all check the remaining budget
+    — an expired request surfaces this typed error (HTTP 504) instead
+    of holding a worker or an RPC channel. Subclasses TimeoutError so
+    pre-existing timeout handling keeps catching it. ``queue_wait_s``
+    carries the time the request sat admitted-but-undispatched when the
+    expiry happened in the queue."""
+
+    def __init__(self, msg: str, queue_wait_s: float = None):
+        super().__init__(msg)
+        self.queue_wait_s = queue_wait_s
+
+
+class OverloadedError(RuntimeError):
+    """The serving admission plane shed this request (HTTP 429): the
+    bounded admission queue is full, the token-bucket rate gate refused
+    it, or the CoDel-style oldest-drop evicted it to keep accepted-
+    request p99 bounded under sustained overload. ``retry_after_s`` is
+    the server's drain-time estimate from its rolling QPS/latency stats
+    — monotone in queue depth, so a well-behaved client backs off
+    harder the deeper the overload (docs/SERVING.md)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class CircuitOpenError(ConnectionError):
+    """A per-endpoint circuit breaker (fluid/ps_rpc.py, enabled by
+    FLAGS_rpc_circuit_breaker) is OPEN for this pserver endpoint:
+    recent calls died with transport/typed worker-dead errors, so new
+    calls fail fast instead of burning their deadline against a dead
+    server. Serving's sparse path catches it (with the other transport
+    errors) and flips into serve-stale degraded mode; the breaker
+    half-opens after FLAGS_rpc_breaker_reset_s and one probe call
+    closes it again (docs/SERVING.md "Ingress & overload")."""
+
+
 # --------------------------------------------------------------------------
 # dtypes
 # --------------------------------------------------------------------------
@@ -798,6 +839,21 @@ class _GlobalFlags:
         # Applies to BOTH frame parts of the binary wire (pickled header
         # and the declared raw-buffer total).
         "FLAGS_rpc_max_message_size": 1 << 30,
+        # per-endpoint circuit breaker (serving ingress robustness,
+        # docs/SERVING.md "Ingress & overload"): OFF by default — the
+        # training planes rely on the PR 3 retry ladder + PR 6 failover
+        # and must not fast-fail. Serving processes flip it on so a
+        # dead pserver costs ONE deadline-bounded failure per endpoint
+        # instead of every request's full retry ladder; while open,
+        # calls raise CircuitOpenError immediately and the sparse path
+        # serves stale cache rows flagged degraded.
+        "FLAGS_rpc_circuit_breaker": False,
+        # consecutive transport/worker-dead failures that trip an
+        # endpoint's breaker OPEN
+        "FLAGS_rpc_breaker_failures": 3,
+        # how long an OPEN breaker waits before letting ONE half-open
+        # probe call through (success closes it, failure re-opens)
+        "FLAGS_rpc_breaker_reset_s": 5.0,
         # data-plane connection pool: how many sockets VarClient keeps
         # per endpoint so concurrent RPCs (sharded lookup fan-out,
         # communicator flushes) don't serialize on one connection

@@ -200,7 +200,11 @@ class RecordEvent:
     (window[K]:realdata | :broadcast | :fallback — the one-dispatch-per-
     window evidence tests/test_window_executor.py counts), the serving
     plane emits cat='serve' queue-wait/exec spans whose ``args`` carry
-    bucket + batch-size chrome-trace payloads (docs/SERVING.md), and the
+    bucket + batch-size chrome-trace payloads plus serve:shed /
+    serve:deadline_expired / serve:degraded instants from the ingress
+    overload plane (record_instant — args name the drop site:
+    admission | codel | rate_gate; docs/SERVING.md "Ingress &
+    overload"), and the
     async overlap plane emits cat='comm' spans from its background
     threads (ps_round[i] rounds, sparse_push tasks, prefetch[table]
     fetches, plus main-thread round:stall[pipe_full] backpressure) whose

@@ -105,6 +105,179 @@ def current_row_cache():
     return _ROW_CACHE
 
 
+# ---------------------------------------------------------------------------
+# deadline-aware call budget (docs/SERVING.md "Ingress & overload"). The
+# serving ingress stamps each request with a deadline; the engine installs
+# the batch's remaining budget on the dispatching thread and every
+# VarClient.call under it caps its socket/connect timeouts at the
+# remainder — an expired budget raises core.DeadlineExceededError instead
+# of starting (or retrying) an RPC the caller can no longer use. Thread-
+# local because concurrent requests carry independent budgets; the
+# sharded-pull fan-out re-installs the submitting thread's budget on its
+# pool threads (_fanout in ops/distributed_ops.py).
+_CALL_BUDGET = threading.local()
+
+
+def current_call_budget():
+    """Absolute time.monotonic deadline of the budget installed on THIS
+    thread, or None when unbudgeted."""
+    return getattr(_CALL_BUDGET, "deadline", None)
+
+
+def budget_remaining():
+    """Seconds left in this thread's call budget (None = unbudgeted;
+    can be <= 0 when already expired)."""
+    d = current_call_budget()
+    return None if d is None else d - time.monotonic()
+
+
+class call_budget:
+    """Context manager installing an absolute time.monotonic ``deadline``
+    as this thread's RPC budget (None = no-op). Nested budgets take the
+    MINIMUM — an inner scope can only tighten the outer one."""
+
+    def __init__(self, deadline):
+        self._deadline = deadline
+
+    def __enter__(self):
+        self._prev = current_call_budget()
+        if self._deadline is not None:
+            d = self._deadline
+            if self._prev is not None:
+                d = min(d, self._prev)
+            _CALL_BUDGET.deadline = d
+        return self
+
+    def __exit__(self, *exc):
+        _CALL_BUDGET.deadline = self._prev
+        return False
+
+
+def _check_budget(method: str, endpoint: str):
+    """Raise typed when this thread's budget is already spent; returns
+    the remaining seconds (None = unbudgeted)."""
+    rem = budget_remaining()
+    if rem is not None and rem <= 0:
+        raise core.DeadlineExceededError(
+            f"rpc {method} on {endpoint}: request deadline expired "
+            f"before the call could start")
+    return rem
+
+
+# ---------------------------------------------------------------------------
+# per-endpoint circuit breaker (docs/SERVING.md "Ingress & overload").
+# State machine: CLOSED —(FLAGS_rpc_breaker_failures consecutive
+# transport/worker-dead failures)→ OPEN —(FLAGS_rpc_breaker_reset_s
+# cooldown)→ HALF-OPEN (exactly one probe call passes) —success→ CLOSED
+# / —failure→ OPEN. Recording happens whenever the flag is on; fast-fail
+# (CircuitOpenError) only on data-plane calls, never heartbeats — the
+# monitor must keep seeing real silence, not synthesized failures.
+class CircuitBreaker:
+    """One endpoint's breaker. Thread-safe; keyed by the SLOT endpoint
+    (what programs bake in), so a PR 6 failover's half-open probe lands
+    on the promoted replica and closes the breaker — the automatic
+    un-degrade path."""
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._probing = False
+        self.trips = 0  # cumulative CLOSED→OPEN transitions
+
+    def _threshold(self) -> int:
+        return max(1, int(core.globals_["FLAGS_rpc_breaker_failures"]))
+
+    def _reset_s(self) -> float:
+        return float(core.globals_["FLAGS_rpc_breaker_reset_s"])
+
+    def state(self) -> str:
+        with self._lock:
+            if self._opened_at is None:
+                return "closed"
+            if time.monotonic() - self._opened_at >= self._reset_s():
+                return "half-open"
+            return "open"
+
+    def allow(self) -> bool:
+        """True when a call may proceed. While OPEN only the first
+        caller past the cooldown gets through (the half-open probe);
+        everyone else keeps failing fast until its outcome lands."""
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if time.monotonic() - self._opened_at < self._reset_s():
+                return False
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+            self._probing = False
+
+    def record_neutral(self) -> None:
+        """Resolve an allow()'d call without judging the endpoint —
+        the CALLER's deadline expired (its budget, not the server's
+        fault) or an unexpected non-transport error aborted the call.
+        Only releases a reserved half-open probe so the next caller
+        can retry it; failure counts and the open clock are
+        untouched — tight-deadline traffic against a slow-but-healthy
+        endpoint must neither trip the breaker nor hold it open."""
+        with self._lock:
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._opened_at is not None:
+                # half-open probe failed (or late failures while open):
+                # restart the cooldown
+                self._opened_at = time.monotonic()
+                self._probing = False
+            elif self._failures >= self._threshold():
+                self._opened_at = time.monotonic()
+                self._probing = False
+                self.trips += 1
+                _LOG.warning(
+                    "circuit breaker OPEN for pserver %s after %d "
+                    "consecutive failures (reset in %.1fs)",
+                    self.endpoint, self._failures, self._reset_s())
+
+
+_BREAKERS: Dict[str, CircuitBreaker] = {}
+_BREAKERS_LOCK = threading.Lock()
+
+
+def breaker_for(endpoint: str) -> CircuitBreaker:
+    with _BREAKERS_LOCK:
+        b = _BREAKERS.get(endpoint)
+        if b is None:
+            b = _BREAKERS[endpoint] = CircuitBreaker(endpoint)
+        return b
+
+
+def breaker_states() -> Dict[str, Dict[str, Any]]:
+    """endpoint -> {state, trips} snapshot — the serving stats()
+    ``breaker_open`` evidence surface."""
+    with _BREAKERS_LOCK:
+        bs = list(_BREAKERS.items())
+    return {ep: {"state": b.state(), "trips": b.trips} for ep, b in bs}
+
+
+def reset_breakers() -> None:
+    with _BREAKERS_LOCK:
+        _BREAKERS.clear()
+
+
+def _breaker_enabled() -> bool:
+    return bool(core.globals_["FLAGS_rpc_circuit_breaker"])
+
+
 class AckWindow:
     """Ack plumbing for the bounded-staleness async plane
     (docs/PS_DATA_PLANE.md "Async overlap"). Counts submitted vs
@@ -894,10 +1067,36 @@ class VarClient:
         self._missing_methods: set = set()
         # connect ONE channel eagerly: an unreachable pserver surfaces
         # now, and negotiation happens off the data path. The remaining
-        # channels connect lazily on first concurrent use.
+        # channels connect lazily on first concurrent use. Data-plane
+        # clients (resolve=True) participate in the endpoint's circuit
+        # breaker: an open breaker fails construction fast, and the
+        # eager connect is the half-open probe when one is due.
+        brk = (breaker_for(endpoint)
+               if _breaker_enabled() and self._resolve else None)
+        if brk is not None and not brk.allow():
+            raise core.CircuitOpenError(
+                f"pserver {endpoint}: circuit breaker open — failing "
+                f"fast instead of a connect poll")
         ch = self._acquire()
         try:
             self._connect_channel(ch, connect_timeout)
+        except core.DeadlineExceededError:
+            # the caller's budget, not the endpoint's fault: release a
+            # reserved probe but record no failure
+            if brk is not None:
+                brk.record_neutral()
+            raise
+        except (ConnectionError, OSError):
+            if brk is not None:
+                brk.record_failure()
+            raise
+        except BaseException:
+            if brk is not None:  # never leak a reserved probe
+                brk.record_neutral()
+            raise
+        else:
+            if brk is not None:
+                brk.record_success()
         finally:
             self._release(ch)
 
@@ -932,6 +1131,14 @@ class VarClient:
         deadline = time.time() + connect_timeout
         last = None
         while time.time() < deadline:
+            rem = budget_remaining()
+            if rem is not None and rem <= 0:
+                # the caller's request deadline expired mid-poll: a
+                # connection it can no longer use is not worth making
+                ch.close()
+                raise core.DeadlineExceededError(
+                    f"pserver {self.endpoint}: request deadline expired "
+                    f"while polling for a connection ({last!r})")
             target = (ps_membership.resolve(self.endpoint)
                       if self._resolve else self.endpoint)
             host, port = target.rsplit(":", 1)
@@ -1010,6 +1217,31 @@ class VarClient:
                       else float(_rpc_timeout))
         retries = (max(0, int(core.globals_["FLAGS_rpc_retry_times"]))
                    if _rpc_retries is None else max(0, int(_rpc_retries)))
+        # serving robustness plane (docs/SERVING.md "Ingress &
+        # overload"): an already-spent request budget never starts an
+        # RPC, and an OPEN endpoint breaker fails fast — both typed, so
+        # the serving layers map them to 504/degraded instead of a
+        # generic transport error. Data-plane clients only
+        # (resolve=True); heartbeats are exempt so the monitor keeps
+        # seeing real silence.
+        _check_budget(method, self.endpoint)
+        brk = (breaker_for(self.endpoint)
+               if _breaker_enabled() and self._resolve
+               and method != "heartbeat" else None)
+        if brk is not None:
+            probing = brk.state() != "closed"
+            if not brk.allow():
+                raise core.CircuitOpenError(
+                    f"rpc {method} on {self.endpoint}: circuit breaker "
+                    f"open — failing fast")
+            if probing:
+                # the half-open probe decides recovery: start it from
+                # fresh connections — pooled channels that were live
+                # when the endpoint died hold severed sockets whose
+                # first use answers "peer closed", which would fail the
+                # probe against a server (or promoted replica) that is
+                # actually healthy
+                self.close()
         msg = {"method": method, **kwargs}
         if self._resolve and method in ps_membership.DATA_METHODS:
             cur_view = ps_membership.current_view()
@@ -1039,16 +1271,32 @@ class VarClient:
         stale = 0
         stale_wait_end = None
         bytes_out = bytes_in = 0
+        # breaker outcome: "fail" unless the call completes ("ok") or
+        # dies of the CALLER's own expired budget ("neutral" — resolves
+        # a reserved half-open probe without judging the endpoint)
+        brk_outcome = "fail"
         t_start = time.perf_counter()
         try:
             while True:
+                rem = budget_remaining()
+                if rem is not None and rem <= 0:
+                    raise core.DeadlineExceededError(
+                        f"rpc {method} on {self.endpoint}: request "
+                        f"deadline expired"
+                        + (f" after {attempt} transport retries"
+                           if attempt else ""))
                 backoff = 0.0
                 got = False
                 ch = self._acquire()
                 try:
                     if ch.sock is None:
-                        self._connect_channel(ch, self._connect_timeout)
-                    ch.sock.settimeout(deadline_s)
+                        self._connect_channel(
+                            ch, self._connect_timeout if rem is None
+                            else max(0.05, min(self._connect_timeout,
+                                               rem)))
+                    ch.sock.settimeout(
+                        deadline_s if rem is None
+                        else max(0.05, min(deadline_s, rem)))
                     if ch.proto not in frames:
                         frames[ch.proto] = _encode_frame(msg, ch.proto)
                     parts, nb = frames[ch.proto]
@@ -1060,8 +1308,24 @@ class VarClient:
                 except core.RpcProtocolError:
                     ch.close()
                     raise
+                except core.DeadlineExceededError:
+                    # DeadlineExceededError ⊂ TimeoutError ⊂ OSError:
+                    # without this arm the transient-transport handler
+                    # below would swallow and retry a spent budget
+                    ch.close()
+                    raise
                 except (ConnectionError, OSError) as e:
                     ch.close()
+                    rem_now = budget_remaining()
+                    if rem_now is not None and rem_now <= 0:
+                        # the budget-capped socket timeout just fired
+                        # (or the failure consumed the remainder): the
+                        # caller's deadline is the real story — typed,
+                        # and NOT an endpoint-failure breaker signal
+                        raise core.DeadlineExceededError(
+                            f"rpc {method} on {self.endpoint}: request "
+                            f"deadline expired during the call "
+                            f"({e!r})") from e
                     attempt += 1
                     if attempt > retries:
                         raise ConnectionError(
@@ -1122,9 +1386,22 @@ class VarClient:
                             time.sleep(0.3)
                             ps_membership.refresh_view_for(self.endpoint)
                             continue
+                    # breaker classification: a served response means
+                    # the endpoint is alive UNLESS it is the typed
+                    # worker-dead/timeout family the breaker exists to
+                    # consume (PR 3 errors crossing the wire)
+                    brk_outcome = ("ok" if resp.get("error_type")
+                                   not in ("WorkerDeadError",
+                                           "TimeoutError") else "fail")
                     break
                 time.sleep(backoff)
+        except core.DeadlineExceededError:
+            brk_outcome = "neutral"
+            raise
         finally:
+            if brk is not None:
+                {"ok": brk.record_success, "fail": brk.record_failure,
+                 "neutral": brk.record_neutral}[brk_outcome]()
             _record_rpc_span(method, kwargs.get("name"), self.endpoint,
                              t_start, bytes_out, bytes_in, attempt)
         if not resp.get("ok"):

@@ -50,7 +50,11 @@ def _legacy_dataplane() -> bool:
 def _fanout(tasks):
     """Run callables concurrently; return their results in order. The
     FIRST error wins — the rest are drained (awaited) first so no RPC is
-    left in flight against a half-torn-down scope."""
+    left in flight against a half-torn-down scope. The submitting
+    thread's RPC call budget (serving deadline propagation,
+    ps_rpc.call_budget) is re-installed on the pool threads — without
+    it every sharded section RPC of a deadline-stamped request would
+    run unbudgeted."""
     if len(tasks) == 1 or _legacy_dataplane():
         return [t() for t in tasks]
     global _FANOUT_POOL
@@ -59,6 +63,10 @@ def _fanout(tasks):
             from concurrent.futures import ThreadPoolExecutor
             _FANOUT_POOL = ThreadPoolExecutor(
                 max_workers=8, thread_name_prefix="ps-fanout")
+    from ..fluid import ps_rpc as _ps_rpc
+    budget = _ps_rpc.current_call_budget()
+    if budget is not None:
+        tasks = [(lambda t=t: _run_budgeted(t, budget)) for t in tasks]
     futs = [_FANOUT_POOL.submit(t) for t in tasks]
     results, first_err = [], None
     for f in futs:
@@ -71,6 +79,12 @@ def _fanout(tasks):
     if first_err is not None:
         raise first_err
     return results
+
+
+def _run_budgeted(task, budget):
+    from ..fluid import ps_rpc as _ps_rpc
+    with _ps_rpc.call_budget(budget):
+        return task()
 
 
 def _np_of(scope, name):

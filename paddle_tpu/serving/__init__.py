@@ -20,6 +20,14 @@ package is that missing production layer over `paddle_tpu.inference`:
     against live pservers, fronted by a TTL + LRU row cache, so
     wide_deep serves without materializing the table in-process (and a
     PR 6 drain/failover re-routes transparently mid-serving).
+  * `ServingIngress` + `AdmissionController`/`TokenBucket` — the
+    network front end and its overload-robustness contract: JSON-rows
+    HTTP (`/predict`, `/healthz`, `/readyz`, `/stats`, multi-model
+    routing), deadline propagation down to the PS row fetches, typed
+    429/504 shedding with computed `Retry-After`, CoDel-style
+    oldest-drop, serve-stale degraded mode under an open per-pserver
+    circuit breaker, and SIGTERM graceful drain that loses zero
+    accepted requests (docs/SERVING.md "Ingress & overload").
 
 Quick start::
 
@@ -31,10 +39,13 @@ Quick start::
         fut = eng.submit({"x": row})            # async, .wait()
         print(eng.stats()["qps"])
 """
+from .admission import AdmissionController, TokenBucket
 from .batching import BatchingQueue, Request, next_bucket
 from .embedding_cache import EmbeddingCache
 from .engine import ServingEngine
+from .ingress import ServingIngress
 from .sparse import rewrite_sparse_lookups
 
-__all__ = ["ServingEngine", "BatchingQueue", "Request", "next_bucket",
+__all__ = ["ServingEngine", "ServingIngress", "AdmissionController",
+           "TokenBucket", "BatchingQueue", "Request", "next_bucket",
            "EmbeddingCache", "rewrite_sparse_lookups"]

@@ -1,0 +1,163 @@
+"""Admission control + load shedding for the serving ingress
+(docs/SERVING.md "Ingress & overload").
+
+A production engine at 4× capacity is defined by what it REFUSES: work
+it cannot finish inside the caller's deadline must be shed immediately
+with a typed answer (429 + Retry-After), never queued to die. Three
+cooperating gates:
+
+  * ``TokenBucket`` — a rate gate at the HTTP edge: sustained offered
+    load beyond the configured QPS is refused before it costs a queue
+    slot (reference role: BRPC's max_concurrency / ingress qps quota).
+  * ``AdmissionController`` — a bounded admission queue: past
+    ``max_queue_rows`` pending rows the engine sheds at submit with
+    ``core.OverloadedError`` carrying a Retry-After computed from the
+    rolling row-throughput estimate (monotone in queue depth).
+  * CoDel-style oldest-drop (in ``ServingEngine._execute``): when the
+    head-of-queue sojourn exceeds ``codel_target_ms`` continuously for
+    ``codel_interval_ms``, the OLDEST request is dropped (typed 429) —
+    head drops shrink everyone else's wait, which is what bounds
+    accepted-request p99 under sustained overload (CoDel's insight;
+    tail drops would punish the newest request while the queue stays
+    just as stale).
+
+The module also owns the per-dispatch DEGRADED scope: when the sparse
+path serves beyond-TTL cache rows because the pservers are unreachable
+(EmbeddingCache serve-stale under an open circuit breaker), it flags
+the scope and the engine marks every request of the bucket
+``degraded=True`` — a 200 with a warning label, not a 5xx.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from paddle_tpu.fluid import core
+
+__all__ = ["TokenBucket", "AdmissionController", "degraded_scope",
+           "note_degraded"]
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate_qps`` tokens/s refill up to
+    ``burst``. ``try_acquire`` never blocks — the ingress maps a refusal
+    straight to 429 (shedding at the edge must not hold the socket).
+    Thread-safe; injectable clock for tests."""
+
+    def __init__(self, rate_qps: float, burst: Optional[float] = None):
+        if rate_qps <= 0:
+            raise ValueError(f"rate_qps must be > 0, got {rate_qps}")
+        self.rate_qps = float(rate_qps)
+        self.burst = float(burst if burst is not None
+                           else max(1.0, rate_qps / 10.0))
+        self._tokens = self.burst
+        self._lock = threading.Lock()
+        self._clock = time.monotonic
+        self._t_last = self._clock()
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.burst,
+                self._tokens + (now - self._t_last) * self.rate_qps)
+            self._t_last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def retry_after_s(self, n: float = 1.0) -> float:
+        """Seconds until ``n`` tokens will have refilled — the
+        Retry-After a rate-gate 429 carries."""
+        with self._lock:
+            deficit = max(0.0, n - self._tokens)
+        return max(0.05, deficit / self.rate_qps)
+
+
+class AdmissionController:
+    """Queue-bound + CoDel knobs for one ServingEngine.
+
+    ``max_queue_rows`` bounds the admission queue in ROWS (the unit the
+    batcher flushes in); ``codel_target_ms``/``codel_interval_ms`` are
+    the CoDel pair: sojourn above target for longer than interval ⇒
+    drop the head. ``fallback_row_s`` prices a queued row when no
+    throughput estimate exists yet (cold engine) so Retry-After is
+    still monotone in depth from the first shed."""
+
+    def __init__(self, max_queue_rows: int = 256,
+                 codel_target_ms: float = 100.0,
+                 codel_interval_ms: float = 500.0,
+                 fallback_row_s: float = 0.005,
+                 max_retry_after_s: float = 10.0):
+        if max_queue_rows < 1:
+            raise ValueError("max_queue_rows must be >= 1")
+        self.max_queue_rows = int(max_queue_rows)
+        self.codel_target_s = float(codel_target_ms) / 1e3
+        self.codel_interval_s = float(codel_interval_ms) / 1e3
+        self.fallback_row_s = float(fallback_row_s)
+        self.max_retry_after_s = float(max_retry_after_s)
+
+    def retry_after_s(self, pending_rows: int,
+                      row_rate: float = 0.0) -> float:
+        """Drain-time estimate for ``pending_rows`` at the engine's
+        recent ``row_rate`` (rows/s; <=0 = unknown → fallback price).
+        Monotone nondecreasing in pending_rows for a fixed rate — the
+        contract the overload test asserts — and clamped so a transient
+        stall can't tell clients to go away for minutes."""
+        if row_rate > 0:
+            est = pending_rows / row_rate
+        else:
+            est = pending_rows * self.fallback_row_s
+        return min(self.max_retry_after_s, max(0.05, est))
+
+    def admit(self, n_rows: int, pending_rows: int,
+              row_rate: float = 0.0) -> None:
+        """Raise typed ``core.OverloadedError`` when accepting
+        ``n_rows`` more would exceed the queue bound; no-op otherwise.
+        The shed happens BEFORE the queue ever sees the request —
+        "never queued to die"."""
+        if pending_rows + n_rows > self.max_queue_rows:
+            raise core.OverloadedError(
+                f"admission queue full ({pending_rows} rows pending, "
+                f"bound {self.max_queue_rows}) — shedding",
+                retry_after_s=self.retry_after_s(pending_rows, row_rate))
+
+
+# ---------------------------------------------------------------------------
+# degraded scope: per-dispatch thread-local accumulator. The engine
+# enters it around a bucket's execution; EmbeddingCache.lookup bumps it
+# when it serves beyond-TTL rows on a fetch failure (the lookup runs on
+# the dispatching worker thread, so thread-local attribution is exact).
+# ---------------------------------------------------------------------------
+_DEGRADED = threading.local()
+
+
+class degraded_scope:
+    """Context manager collecting degraded-serve events on this thread.
+    ``scope.count`` after exit = stale rows served inside it."""
+
+    def __enter__(self):
+        self._prev = getattr(_DEGRADED, "box", None)
+        self._box = [0]
+        _DEGRADED.box = self._box
+        return self
+
+    def __exit__(self, *exc):
+        _DEGRADED.box = self._prev
+        if self._prev is not None:
+            self._prev[0] += self._box[0]  # nested scopes roll up
+        return False
+
+    @property
+    def count(self) -> int:
+        return self._box[0]
+
+
+def note_degraded(n: int = 1) -> None:
+    """Record ``n`` stale rows served degraded in the enclosing scope
+    (no-op outside one)."""
+    box = getattr(_DEGRADED, "box", None)
+    if box is not None:
+        box[0] += int(n)

@@ -46,14 +46,29 @@ class Request:
     the async submit path."""
 
     __slots__ = ("rows", "n", "t_submit", "t_dispatch", "t_done",
-                 "_event", "_result", "_error")
+                 "deadline", "degraded", "admin", "_event", "_result",
+                 "_error")
 
-    def __init__(self, rows: Dict[str, np.ndarray], n: int):
+    def __init__(self, rows: Dict[str, np.ndarray], n: int,
+                 deadline: Optional[float] = None,
+                 admin: bool = False):
         self.rows = rows
         self.n = int(n)
         self.t_submit = time.perf_counter()
         self.t_dispatch = 0.0
         self.t_done = 0.0  # stamped at fulfilment (open-loop latency)
+        # absolute perf_counter deadline (None = unbudgeted): checked at
+        # take time (expired requests 504 instead of holding a worker)
+        # and propagated into PS row fetches as the RPC call budget
+        self.deadline = deadline
+        # set by the worker when the bucket was served from beyond-TTL
+        # stale cache rows (pservers unreachable) — a 200 with a
+        # warning label, surfaced as degraded=true by the HTTP ingress
+        self.degraded = False
+        # admin requests (warm()) bypassed admission at submit and are
+        # exempt from the CoDel head-drop too — shedding the compile
+        # you asked for defeats the op
+        self.admin = bool(admin)
         self._event = threading.Event()
         self._result: Optional[List[np.ndarray]] = None
         self._error: Optional[BaseException] = None

@@ -15,6 +15,29 @@ serving-side quantized/compressed table snapshots. Set ``ttl_s=0`` to
 make every lookup re-validate (cache becomes a dedup layer only), or
 don't install the cache where bit-freshness matters.
 
+Two robustness extensions (docs/SERVING.md "Ingress & overload"):
+
+  * **serve-stale degraded mode** (``serve_stale=True``, the default):
+    a refetch of beyond-TTL rows that dies with a transport-typed
+    error (ConnectionError incl. the circuit breaker's fast-fail,
+    timeout/deadline, ``WorkerDeadError``, a surfaced
+    ``StaleClusterViewError`` mid-failover) is answered from the
+    RETAINED stale copies instead of failing the request — flagged
+    through ``admission.note_degraded`` so the engine marks the
+    response ``degraded=True`` and counts it. Only rows the cache has
+    EVER held qualify; an uncovered row re-raises (the caller's 5xx is
+    honest there). Recovery is automatic: the moment a fetch succeeds
+    again (breaker half-open probe, PR 6 replica promotion installing
+    a new view), fresh rows overwrite and the degraded flag stops.
+  * **trainer-pushed invalidation** (``invalidate_rows``):
+    ``distributed_lookup_table_grad`` pushes call it inline for their
+    row ids (the same hook contract the PR 8 ``PrefetchBuffer``
+    defined), so in a train+serve process staleness is PUSH-bounded,
+    not only TTL-bounded. Per-key stage-seq fences close the race the
+    PrefetchBuffer closed: a miss fetch in flight ACROSS the push must
+    not re-fill pre-push rows (its copy may predate the update), while
+    a fetch that STARTED after the push is fresh and clears the fence.
+
 Bounded: ``max_entries`` rows, LRU-evicted. All counters are exposed
 via ``stats()`` and surface in ``ServingEngine.stats()``.
 """
@@ -27,7 +50,17 @@ from typing import Callable, Dict
 
 import numpy as np
 
+from paddle_tpu.fluid import core
+
 __all__ = ["EmbeddingCache"]
+
+# fetch failures the serve-stale path may absorb: the transport family
+# (breaker fast-fail CircuitOpenError ⊂ ConnectionError, deadline ⊂
+# TimeoutError ⊂ OSError), the PR 3 typed worker-death, and a
+# StaleClusterViewError that SURFACED (re-route budget spent while
+# membership converges — rows are unreachable for the moment, not gone)
+_STALE_SERVABLE = (ConnectionError, OSError, TimeoutError,
+                   core.WorkerDeadError, core.StaleClusterViewError)
 
 
 class EmbeddingCache:
@@ -39,11 +72,18 @@ class EmbeddingCache:
     threads missing the same id may both fetch it — benign duplicate
     work, never wrong data."""
 
-    def __init__(self, ttl_s: float = 30.0, max_entries: int = 1_000_000):
+    # per-key fence-map bound: past this the invalidation degrades to
+    # the global generation fence (conservative: NO in-flight fill may
+    # land) instead of growing without bound on long-tail pushed ids
+    _FENCE_CAP = 1 << 20
+
+    def __init__(self, ttl_s: float = 30.0, max_entries: int = 1_000_000,
+                 serve_stale: bool = True):
         if max_entries < 1:
             raise ValueError("max_entries must be >= 1")
         self.ttl_s = float(ttl_s)
         self.max_entries = int(max_entries)
+        self.serve_stale = bool(serve_stale)
         self._rows: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._lock = threading.Lock()
         # bumped by invalidate(): an in-flight miss fetch that STARTED
@@ -51,12 +91,19 @@ class EmbeddingCache:
         # it may carry pre-push rows, and caching them would defeat the
         # "visible immediately" contract for up to another ttl_s
         self._gen = 0
+        # per-key push fences (invalidate_rows): key -> seq of the last
+        # push; a fill whose fetch started at or before that seq skips
+        # the key, one that started after it clears the fence
+        self._seq = 0
+        self._fence: Dict[tuple, int] = {}
         # injectable clock so tests drive TTL expiry without sleeping
         self._clock = time.monotonic
         self.hits = 0
         self.misses = 0
         self.expired = 0      # staleness counter: TTL'd entries refetched
         self.evictions = 0
+        self.stale_served = 0      # degraded: beyond-TTL rows served
+        self.invalidated_rows = 0  # trainer-pushed row invalidations
 
     def __len__(self) -> int:
         with self._lock:
@@ -67,13 +114,17 @@ class EmbeddingCache:
         ``fetch_fn(missing_ids)`` -> [len(missing), dim] array pulls the
         rest from the pservers. Returns [len(ids), dim] in input order,
         bit-identical to an uncached pull while the table is
-        unchanged."""
+        unchanged. A transport-dead refetch of rows the cache still
+        holds beyond TTL serves the stale copies flagged degraded
+        (``serve_stale`` above) instead of raising."""
         ids = np.asarray(ids).reshape(-1)
         out = [None] * len(ids)
         missing_idx = []
+        stale_fallback: Dict[int, np.ndarray] = {}
         now = self._clock()
         with self._lock:
             gen0 = self._gen
+            tok0 = self._seq
             for i, id_ in enumerate(ids.tolist()):
                 key = (table, id_)
                 ent = self._rows.get(key)
@@ -84,17 +135,31 @@ class EmbeddingCache:
                         out[i] = row
                         self.hits += 1
                         continue
-                    # stale: drop now so a concurrent hit can't serve it
-                    # while our refetch is in flight
-                    del self._rows[key]
+                    # beyond TTL: refetch, but RETAIN the copy — hits
+                    # check TTL so nothing serves it fresh, and it is
+                    # the serve-stale fallback if the pservers are dark
                     self.expired += 1
+                    stale_fallback[i] = row
                 self.misses += 1
                 missing_idx.append(i)
         if missing_idx:
             miss_ids = ids[missing_idx]
             # duplicate ids within the miss set fetch once
             uniq, inv = np.unique(miss_ids, return_inverse=True)
-            fetched = np.asarray(fetch_fn(uniq))
+            try:
+                fetched = np.asarray(fetch_fn(uniq))
+            except _STALE_SERVABLE:
+                if not self.serve_stale \
+                        or any(i not in stale_fallback
+                               for i in missing_idx):
+                    raise  # an uncovered row: the failure is real
+                from . import admission as _admission
+                with self._lock:
+                    self.stale_served += len(missing_idx)
+                _admission.note_degraded(len(missing_idx))
+                for i in missing_idx:
+                    out[i] = stale_fallback[i]
+                return np.asarray(out)
             if fetched.shape[0] != len(uniq):
                 raise ValueError(
                     f"fetch_fn returned {fetched.shape[0]} rows for "
@@ -103,15 +168,45 @@ class EmbeddingCache:
             with self._lock:
                 if self._gen == gen0:  # no invalidate() raced the fetch
                     for j, id_ in enumerate(uniq.tolist()):
-                        # detach: the caller may mutate/donate its arrays
-                        self._rows[(table, id_)] = (np.array(fetched[j]),
-                                                    now)
+                        key = (table, id_)
+                        fence = self._fence.get(key)
+                        if fence is not None:
+                            if fence > tok0:
+                                # pushed AFTER this fetch started: the
+                                # fetched copy may predate the push —
+                                # serve it (fresh enough for THIS call)
+                                # but never cache it
+                                continue
+                            del self._fence[key]  # post-push fetch
+                        # detach: the caller may mutate/donate arrays
+                        self._rows[key] = (np.array(fetched[j]), now)
                     while len(self._rows) > self.max_entries:
                         self._rows.popitem(last=False)
                         self.evictions += 1
             for k, i in enumerate(missing_idx):
                 out[i] = fetched[inv[k]]
         return np.asarray(out)
+
+    def invalidate_rows(self, table: str, ids) -> None:
+        """The trainer pushed grads for ``ids`` (called inline by
+        ``distributed_lookup_table_grad`` BEFORE the push ships — the
+        PR 8 row-cache hook contract): drop their cached rows and fence
+        them out of any in-flight miss fetch, so the next lookup
+        refetches post-push values. Staleness becomes push-bounded."""
+        ids = np.asarray(ids).reshape(-1)
+        with self._lock:
+            self._seq += 1
+            for id_ in ids.tolist():
+                key = (table, int(id_))
+                self._fence[key] = self._seq
+                if self._rows.pop(key, None) is not None:
+                    self.invalidated_rows += 1
+            if len(self._fence) > self._FENCE_CAP:
+                # long-tail overflow: collapse to the global generation
+                # fence (no in-flight fill lands) instead of unbounded
+                # per-key state
+                self._fence.clear()
+                self._gen += 1
 
     def invalidate(self, table: str = None) -> None:
         """Drop every entry (or just one table's) — e.g. after a model/
@@ -122,9 +217,12 @@ class EmbeddingCache:
             self._gen += 1
             if table is None:
                 self._rows.clear()
+                self._fence.clear()
                 return
             for key in [k for k in self._rows if k[0] == table]:
                 del self._rows[key]
+            for key in [k for k in self._fence if k[0] == table]:
+                del self._fence[key]
 
     def stats(self) -> Dict[str, float]:
         with self._lock:
@@ -137,5 +235,7 @@ class EmbeddingCache:
                 "misses": self.misses,
                 "expired": self.expired,
                 "evictions": self.evictions,
+                "stale_served": self.stale_served,
+                "invalidated_rows": self.invalidated_rows,
                 "hit_rate": (self.hits / total) if total else 0.0,
             }

@@ -76,7 +76,8 @@ class ServingEngine:
                  num_workers: int = 2, max_batch: int = 64,
                  max_queue_delay_ms: float = 2.0,
                  batch_mode: Optional[str] = None,
-                 embedding_cache=None, seed: int = 0):
+                 embedding_cache=None, seed: int = 0,
+                 admission=None, default_deadline_s: float = None):
         import jax
         import paddle_tpu.fluid as fluid
         from paddle_tpu.fluid import core
@@ -155,6 +156,16 @@ class ServingEngine:
             # override: a co-resident training executor never sees it.
             self._exe._seg_min_ops_override = 1
 
+        # ---- admission / robustness contract ------------------------
+        # (docs/SERVING.md "Ingress & overload"): admission is an
+        # AdmissionController or None (None = the pre-ingress engine,
+        # nothing sheds); default_deadline_s stamps requests that carry
+        # no explicit budget
+        self._admission = admission
+        self._default_deadline_s = (None if default_deadline_s is None
+                                    else float(default_deadline_s))
+        self._codel_above_since: Optional[float] = None
+
         # ---- stats --------------------------------------------------
         self._stats_lock = threading.Lock()
         self._t_start = time.perf_counter()
@@ -162,11 +173,20 @@ class ServingEngine:
         self._n_rows = 0
         self._n_batches = 0
         self._n_errors = 0
+        self._n_shed = 0              # admission-bound + CoDel drops (429)
+        self._n_deadline_expired = 0  # typed 504s
+        self._n_degraded = 0          # requests served from stale cache
         self._batch_hist: Dict[int, int] = {}
         self._bucket_hist: Dict[int, int] = {}
         self._buckets_seen: set = set()  # survives reset_stats
         self._done: "deque[tuple]" = deque(maxlen=16384)  # (t, lat_s)
         self._qwait: "deque[float]" = deque(maxlen=16384)
+        self._rows_done: "deque[tuple]" = deque(maxlen=4096)  # (t, rows)
+        # rows taken by a worker but not yet answered: the admission
+        # bound covers queued + executing (outstanding) rows — bounding
+        # only the queue would let the worker pipeline hide a full
+        # latency budget of invisible work
+        self._inflight_rows = 0
 
         # ---- worker pool --------------------------------------------
         self._queue = BatchingQueue(max_batch=max_batch,
@@ -226,27 +246,93 @@ class ServingEngine:
             raise ValueError("predict(): zero rows")
         return rows, n
 
-    def submit(self, feed: Dict[str, Any], many: bool = False) -> Request:
+    def _recent_row_rate(self, window_s: float = 5.0) -> float:
+        """Rows/s completed over the recent window — the drain-rate
+        estimate Retry-After is computed from (0.0 = no evidence yet)."""
+        now = time.perf_counter()
+        with self._stats_lock:
+            rows = [(t, n) for t, n in self._rows_done
+                    if now - t <= window_s]
+        if not rows:
+            return 0.0
+        span = max(now - rows[0][0], 1e-3)
+        return sum(n for _t, n in rows) / span
+
+    def outstanding_rows(self) -> int:
+        """Rows admitted but unanswered: queued + taken-by-a-worker.
+        The admission bound's denominator."""
+        with self._stats_lock:
+            inflight = self._inflight_rows
+        return len(self._queue) + inflight
+
+    def retry_after_s(self) -> float:
+        """The server's current back-off advice (the Retry-After a shed
+        carries): estimated drain time of the outstanding rows at the
+        recent row rate. Monotone in queue depth."""
+        adm = self._admission
+        if adm is None:
+            return 1.0
+        return adm.retry_after_s(self.outstanding_rows(),
+                                 self._recent_row_rate())
+
+    def submit(self, feed: Dict[str, Any], many: bool = False,
+               deadline_s: Optional[float] = None,
+               _admit: bool = True) -> Request:
         """Async submit: returns the request future (``.wait()``).
-        The open-loop loadgen path."""
+        The open-loop loadgen path. ``deadline_s`` is this request's
+        budget from NOW (falls back to the engine default); admission
+        may shed with typed ``core.OverloadedError`` before the request
+        ever queues — never queued to die. ``_admit=False`` bypasses
+        the gates (internal: warm() is an admin op, not traffic)."""
+        from paddle_tpu.fluid import profiler as _profiler
+
         if self._closed:
             raise RuntimeError("ServingEngine is closed")
         rows, n = self._normalize(feed, many)
-        return self._queue.submit(Request(rows, n))
+        if not _admit:
+            return self._queue.submit(Request(rows, n, admin=True))
+        if deadline_s is None:
+            deadline_s = self._default_deadline_s
+        deadline = None
+        if deadline_s is not None:
+            if deadline_s <= 0:
+                with self._stats_lock:
+                    self._n_deadline_expired += 1
+                raise self._core.DeadlineExceededError(
+                    f"request budget {deadline_s * 1e3:.0f}ms already "
+                    f"spent at submit", queue_wait_s=0.0)
+            deadline = time.perf_counter() + float(deadline_s)
+        if self._admission is not None:
+            try:
+                self._admission.admit(n, self.outstanding_rows(),
+                                      self._recent_row_rate())
+            except self._core.OverloadedError:
+                with self._stats_lock:
+                    self._n_shed += 1
+                _profiler.record_instant(
+                    "serve:shed", cat="serve",
+                    args={"rows": n, "where": "admission"})
+                raise
+        return self._queue.submit(Request(rows, n, deadline=deadline))
 
     def predict(self, feed: Dict[str, Any],
-                timeout: Optional[float] = 120.0) -> List[np.ndarray]:
+                timeout: Optional[float] = 120.0,
+                deadline_s: Optional[float] = None) -> List[np.ndarray]:
         """One sample in, one row out: blocks until this row's batch
         executed; returns one [1, *out] array per fetch target —
         exactly the shape ``AnalysisPredictor.run([sample[None]])``
         returns, so the single-row oracle comparison is direct."""
-        return self.submit(feed, many=False).wait(timeout)
+        return self.submit(feed, many=False,
+                           deadline_s=deadline_s).wait(timeout)
 
     def predict_many(self, feed: Dict[str, Any],
-                     timeout: Optional[float] = 120.0) -> List[np.ndarray]:
+                     timeout: Optional[float] = 120.0,
+                     deadline_s: Optional[float] = None
+                     ) -> List[np.ndarray]:
         """A row group [n, *sample] riding one bucket atomically;
         returns [n, *out] per fetch target."""
-        return self.submit(feed, many=True).wait(timeout)
+        return self.submit(feed, many=True,
+                           deadline_s=deadline_s).wait(timeout)
 
     # ------------------------------------------------------------ worker
     def _worker_loop(self):
@@ -270,12 +356,93 @@ class ServingEngine:
                 with self._stats_lock:
                     self._n_errors += 1
 
-    def _execute(self, reqs: List[Request]):
+    def _expire_or_shed(self, reqs: List[Request],
+                        t_take: float) -> List[Request]:
+        """The robustness gate between take and dispatch
+        (docs/SERVING.md "Ingress & overload"): requests whose deadline
+        passed while queued answer a typed 504 NOW — with their
+        serve:queue_wait span — instead of holding a worker; under
+        sustained head-of-queue sojourn above the CoDel target the
+        OLDEST request is dropped (typed 429) so the rest of the
+        queue's wait shrinks and accepted-request p99 stays bounded."""
         from paddle_tpu.fluid import profiler as _profiler
 
+        live: List[Request] = []
+        n_expired = 0
+        for r in reqs:
+            if r.deadline is not None and t_take >= r.deadline:
+                wait = t_take - r.t_submit
+                _profiler.record_span(
+                    "serve:queue_wait", r.t_submit, t_take, cat="serve",
+                    args={"rows": r.n, "expired": True})
+                _profiler.record_instant(
+                    "serve:deadline_expired", cat="serve",
+                    args={"rows": r.n,
+                          "queue_wait_ms": round(wait * 1e3, 3)})
+                r.set_error(self._core.DeadlineExceededError(
+                    f"deadline expired after {wait * 1e3:.1f}ms in the "
+                    f"admission queue", queue_wait_s=wait))
+                n_expired += 1
+                continue
+            live.append(r)
+        if n_expired:
+            with self._stats_lock:
+                self._n_deadline_expired += n_expired
+
+        adm = self._admission
+        if adm is not None and live:
+            sojourn = t_take - live[0].t_submit
+            # state machine under the stats lock: concurrent workers
+            # racing an unlocked read-modify-write could double-drop
+            # within one interval (or miss the interval edge)
+            drop_head = False
+            with self._stats_lock:
+                if sojourn <= adm.codel_target_s:
+                    self._codel_above_since = None
+                elif self._codel_above_since is None:
+                    self._codel_above_since = t_take
+                elif (t_take - self._codel_above_since
+                      >= adm.codel_interval_s):
+                    # one drop per interval: restart the clock (admin
+                    # requests — warm() compiles — are never shed)
+                    drop_head = not live[0].admin
+                    self._codel_above_since = t_take
+            if drop_head:
+                head = live.pop(0)
+                head.set_error(self._core.OverloadedError(
+                    f"shed by CoDel oldest-drop after "
+                    f"{sojourn * 1e3:.1f}ms queued (target "
+                    f"{adm.codel_target_s * 1e3:.0f}ms)",
+                    retry_after_s=self.retry_after_s()))
+                with self._stats_lock:
+                    self._n_shed += 1
+                _profiler.record_instant(
+                    "serve:shed", cat="serve",
+                    args={"rows": head.n, "where": "codel",
+                          "sojourn_ms": round(sojourn * 1e3, 3)})
+        return live
+
+    def _execute(self, reqs: List[Request]):
         t_take = time.perf_counter()
+        reqs = self._expire_or_shed(reqs, t_take)
+        if not reqs:
+            return
         n_valid = sum(r.n for r in reqs)
         bucket = next_bucket(n_valid)
+        with self._stats_lock:
+            self._inflight_rows += n_valid
+        try:
+            self._dispatch(reqs, t_take, n_valid, bucket)
+        finally:
+            with self._stats_lock:
+                self._inflight_rows -= n_valid
+
+    def _dispatch(self, reqs: List[Request], t_take: float,
+                  n_valid: int, bucket: int):
+        from paddle_tpu.fluid import profiler as _profiler
+        from paddle_tpu.fluid import ps_rpc as _ps_rpc
+        from . import admission as _admission_mod
+
         stacked: Dict[str, np.ndarray] = {}
         for name in self._feed_names:
             arr = (reqs[0].rows[name] if len(reqs) == 1
@@ -296,33 +463,61 @@ class ServingEngine:
             "serve:queue_wait", reqs[0].t_submit, t_take, cat="serve",
             args={"rows": n_valid, "requests": len(reqs)})
 
+        # deadline propagation into the dispatch: the bucket's PS row
+        # fetches run under the TIGHTEST member deadline as the RPC
+        # call budget (ps_rpc caps socket/connect timeouts at the
+        # remainder and raises typed when spent); the degraded scope
+        # collects serve-stale events so the whole bucket can be
+        # flagged. perf_counter deadlines convert to the budget's
+        # monotonic clock via the current offset.
+        deadlines = [r.deadline for r in reqs if r.deadline is not None]
+        budget = None
+        if deadlines:
+            budget = time.monotonic() + (min(deadlines)
+                                         - time.perf_counter())
+        dg = _admission_mod.degraded_scope()
         t0 = time.perf_counter()
-        if self.batch_mode == "scan":
-            if bucket == 1:
-                # the naive one-request-one-dispatch degenerate case
-                fetches, _health = self._cb.run(
-                    self._scope, {n: a[0] for n, a in stacked.items()},
-                    self._rng)
-                outs = [np.asarray(f)[None] for f in fetches]
-            else:
-                fetches, _health = self._cb.run_window(
-                    self._scope, stacked, self._rng, 0, bucket,
-                    window_names=tuple(stacked))
+        with dg, _ps_rpc.call_budget(budget):
+            if self.batch_mode == "scan":
+                if bucket == 1:
+                    # the naive one-request-one-dispatch degenerate case
+                    fetches, _health = self._cb.run(
+                        self._scope,
+                        {n: a[0] for n, a in stacked.items()},
+                        self._rng)
+                    outs = [np.asarray(f)[None] for f in fetches]
+                else:
+                    fetches, _health = self._cb.run_window(
+                        self._scope, stacked, self._rng, 0, bucket,
+                        window_names=tuple(stacked))
+                    outs = [np.asarray(f) for f in fetches]
+                # [K, 1, *out] -> [K, *out]
+                outs = [o.reshape((o.shape[0],) + o.shape[2:])
+                        for o in outs]
+            elif self._cb is not None:
+                fetches, _health = self._cb.run(self._scope, stacked,
+                                                self._rng)
                 outs = [np.asarray(f) for f in fetches]
-            # [K, 1, *out] -> [K, *out]
-            outs = [o.reshape((o.shape[0],) + o.shape[2:]) for o in outs]
-        elif self._cb is not None:
-            fetches, _health = self._cb.run(self._scope, stacked,
-                                            self._rng)
-            outs = [np.asarray(f) for f in fetches]
-        else:
-            # stateful program (PS lookups, ...): lock-serialized
-            # executor — batching still coalesces the RPC fan-out
-            with self._exe_lock:
-                outs = self._exe.run(self._program, feed=stacked,
-                                     fetch_list=list(self._fetch_names),
-                                     scope=self._scope, return_numpy=True)
+            else:
+                # stateful program (PS lookups, ...): lock-serialized
+                # executor — batching still coalesces the RPC fan-out
+                with self._exe_lock:
+                    outs = self._exe.run(
+                        self._program, feed=stacked,
+                        fetch_list=list(self._fetch_names),
+                        scope=self._scope, return_numpy=True)
         t1 = time.perf_counter()
+        if dg.count:
+            # beyond-TTL cache rows stood in for unreachable pservers:
+            # the whole bucket shares the fetch, so every member is
+            # flagged (a 200 with a warning label, never a 5xx)
+            for r in reqs:
+                r.degraded = True
+            with self._stats_lock:
+                self._n_degraded += len(reqs)
+            _profiler.record_instant(
+                "serve:degraded", cat="serve",
+                args={"requests": len(reqs), "stale_rows": dg.count})
         _profiler.record_span(
             f"serve:exec[{bucket}]", t0, t1, cat="serve",
             args={"bucket": bucket, "n_valid": n_valid,
@@ -342,6 +537,7 @@ class ServingEngine:
             self._bucket_hist[bucket] = \
                 self._bucket_hist.get(bucket, 0) + 1
             self._buckets_seen.add(bucket)
+            self._rows_done.append((t_done, n_valid))
             for r in reqs:
                 self._done.append((t_done, t_done - r.t_submit))
                 self._qwait.append(t_take - r.t_submit)
@@ -389,7 +585,21 @@ class ServingEngine:
                 "max_batch": self._queue.max_batch,
                 "workers": len(self._workers),
                 "buckets_compiled": self.buckets_compiled(),
+                # overload/degrade evidence surface (docs/SERVING.md
+                # "Ingress & overload"): sheds (admission bound +
+                # CoDel), typed 504s, degraded responses
+                "shed": self._n_shed,
+                "deadline_expired": self._n_deadline_expired,
+                "degraded": self._n_degraded,
+                "queue_rows": len(self._queue),
             }
+        # per-endpoint circuit breakers (ps_rpc): open count + states
+        from paddle_tpu.fluid import ps_rpc as _ps_rpc
+        brk = _ps_rpc.breaker_states()
+        st["breaker_open"] = sum(1 for b in brk.values()
+                                 if b["state"] != "closed")
+        if brk:
+            st["breakers"] = brk
         if self.embedding_cache is not None:
             st["embedding_cache"] = self.embedding_cache.stats()
         return st
@@ -401,10 +611,13 @@ class ServingEngine:
             self._t_start = time.perf_counter()
             self._n_requests = self._n_rows = self._n_batches = 0
             self._n_errors = 0
+            self._n_shed = self._n_deadline_expired = 0
+            self._n_degraded = 0
             self._batch_hist.clear()
             self._bucket_hist.clear()
             self._done.clear()
             self._qwait.clear()
+            self._rows_done.clear()
 
     # ------------------------------------------------------------- admin
     def warm(self, buckets: Optional[Sequence[int]] = None) -> List[int]:
@@ -420,7 +633,9 @@ class ServingEngine:
             for name in self._feed_names:
                 shape, dt = self._sample[name]
                 feed[name] = np.zeros((int(b),) + shape, dt)
-            self.predict_many(feed)
+            # admin traffic: bypass the admission gates (a warm bucket
+            # larger than the queue bound is still worth compiling)
+            self.submit(feed, many=True, _admit=False).wait(120.0)
         return list(buckets)
 
     def close(self) -> None:

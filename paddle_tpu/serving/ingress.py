@@ -1,0 +1,454 @@
+"""Serving ingress — the network front end over one or more
+``ServingEngine``s (docs/SERVING.md "Ingress & overload"; ROADMAP
+item 1's missing half: "the engine is in-process only — no HTTP/RPC
+ingress").
+
+A threaded HTTP server (stdlib ``ThreadingHTTPServer`` — python
+threads are the right tool: the handler is IO-bound glue, the work
+happens in the engine's worker pool) speaking JSON rows:
+
+  * ``POST /predict`` (default model) and
+    ``POST /models/<name>/predict`` — body
+    ``{"feed": {name: row|rows}, "many": bool}``; optional
+    ``X-Deadline-Ms`` header carries the request budget (falls back to
+    the server default). 200 bodies carry ``outputs`` (row-major
+    lists; cast back to ``dtypes`` for the bit-exact values),
+    ``degraded`` and ``latency_ms``.
+  * ``GET /healthz`` — process liveness (200 while the server runs,
+    draining included: a draining pod is alive, just not ready).
+  * ``GET /readyz`` — admission readiness (503 once draining).
+  * ``GET /stats`` — ingress counters + every model's engine stats.
+
+The robustness contract enforced at this layer (the engine enforces
+the rest — queue-expiry 504s, CoDel drops, PS fetch budgets):
+
+  * **typed refusals** — ``core.OverloadedError`` → 429 with a
+    ``Retry-After`` computed from the engine's rolling drain rate
+    (monotone in queue depth), ``core.DeadlineExceededError`` → 504
+    with the queue-wait evidence, engine closed / draining → 503 with
+    ``Connection: close``. A refused request never holds a worker.
+  * **rate gate** — an optional ``TokenBucket`` sheds sustained
+    offered load past ``rate_qps`` at the edge, before it costs a
+    queue slot.
+  * **graceful drain** — ``drain()`` (or SIGTERM via
+    ``install_signal_handlers``) stops admitting (503 +
+    ``Connection: close``), lets every accepted request finish
+    (engine queues drain to completion), then tears the engines and
+    the listener down: a rolling restart loses ZERO accepted requests.
+
+Quick start::
+
+    ing = ServingIngress({"mnist": engine}, default_deadline_ms=500,
+                         rate_qps=2000, max_queue_rows=256).start()
+    # curl -XPOST localhost:<port>/predict -d '{"feed":{"x":[...]}}'
+    ing.close()   # graceful drain
+"""
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from paddle_tpu.fluid import core
+from .admission import TokenBucket
+
+__all__ = ["ServingIngress"]
+
+_LOG = logging.getLogger("paddle_tpu.serving")
+
+
+def _json_bytes(obj) -> bytes:
+    return json.dumps(obj).encode("utf-8")
+
+
+def _retry_after_header(s: float) -> str:
+    """RFC 7231 Retry-After is integer delta-seconds (clients do
+    int(header) — a fractional value is silently discarded); the
+    precise float rides the JSON body as retry_after_ms."""
+    return str(max(1, math.ceil(s)))
+
+
+class ServingIngress:
+    """HTTP front end + drain coordinator over named ServingEngines.
+
+    ``models``: ``{name: ServingEngine}`` (or a bare engine, exposed as
+    ``"default"``). ``default_model`` picks the ``/predict`` target
+    (single-model maps default to that model). The ingress OWNS the
+    engines' lifecycle when ``close_engines`` (default): ``close()``
+    drains and closes them."""
+
+    def __init__(self, models, *, host: str = "127.0.0.1", port: int = 0,
+                 default_model: Optional[str] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 rate_qps: Optional[float] = None,
+                 rate_burst: Optional[float] = None,
+                 close_engines: bool = True,
+                 drain_timeout_s: float = 30.0,
+                 max_body_bytes: int = 16 << 20):
+        if not isinstance(models, dict):
+            models = {"default": models}
+        if not models:
+            raise ValueError("ServingIngress needs at least one model")
+        self._models: Dict[str, Any] = dict(models)
+        if default_model is None:
+            default_model = (next(iter(models)) if len(models) == 1
+                             else None)
+        elif default_model not in models:
+            raise ValueError(f"default_model {default_model!r} not in "
+                             f"models {sorted(models)}")
+        self._default_model = default_model
+        self._default_deadline_s = (None if default_deadline_ms is None
+                                    else float(default_deadline_ms) / 1e3)
+        self._bucket = (TokenBucket(rate_qps, rate_burst)
+                        if rate_qps else None)
+        self._close_engines = bool(close_engines)
+        self._drain_timeout_s = float(drain_timeout_s)
+        self._max_body_bytes = int(max_body_bytes)
+
+        self._admitting = True
+        self._closed = False
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._lock = threading.Lock()
+        self._counters = {
+            "requests": 0, "ok": 0, "shed_429": 0, "expired_504": 0,
+            "unavailable_503": 0, "bad_request_400": 0,
+            "not_found_404": 0, "upstream_5xx": 0, "rate_limited": 0,
+            "degraded_responses": 0,
+        }
+        self._srv = ThreadingHTTPServer((host, int(port)),
+                                        self._make_handler())
+        self._srv.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="serving-ingress",
+            daemon=True)
+
+    # ------------------------------------------------------------ admin
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._srv.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def start(self) -> "ServingIngress":
+        self._thread.start()
+        return self
+
+    def drain(self) -> None:
+        """Stop admitting: /readyz flips 503, /predict answers 503 with
+        ``Connection: close``. Accepted (already-queued) requests keep
+        draining — this is the first half of the SIGTERM sequence."""
+        self._admitting = False
+
+    def close(self) -> None:
+        """Graceful teardown: stop admitting, let every accepted
+        request finish (engine queues drain; in-flight HTTP handlers
+        flush their responses), then close the engines and the
+        listener. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self.drain()
+        if self._close_engines:
+            for eng in self._models.values():
+                try:
+                    eng.close()  # drains the queue, joins the workers
+                except Exception:
+                    _LOG.exception("ingress: engine close failed")
+        end = time.monotonic() + self._drain_timeout_s
+        with self._inflight_cv:
+            while self._inflight > 0:
+                left = end - time.monotonic()
+                if left <= 0:
+                    _LOG.warning(
+                        "ingress: %d HTTP handlers still in flight "
+                        "after %.0fs drain — shutting down anyway",
+                        self._inflight, self._drain_timeout_s)
+                    break
+                self._inflight_cv.wait(min(left, 0.5))
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def install_signal_handlers(self) -> bool:
+        """SIGTERM → graceful drain+close on a helper thread (the
+        rolling-restart contract). Returns False when not on the main
+        thread (signal registration is main-thread-only)."""
+        import signal
+
+        def _on_term(signum, frame):
+            _LOG.warning("ingress: SIGTERM — draining")
+            threading.Thread(target=self.close, daemon=True,
+                             name="ingress-sigterm-drain").start()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+            return True
+        except ValueError:
+            return False
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            counters = dict(self._counters)
+        return {
+            "ingress": {**counters, "admitting": self._admitting,
+                        "inflight": self._inflight,
+                        "default_model": self._default_model,
+                        "rate_qps": (self._bucket.rate_qps
+                                     if self._bucket else None)},
+            "models": {name: eng.stats()
+                       for name, eng in self._models.items()},
+        }
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[key] += n
+
+    # ---------------------------------------------------------- handler
+    def _route(self, path: str):
+        """'/predict' → default engine; '/models/<name>/predict' →
+        named engine. Returns (name, engine) or (None, None)."""
+        if path == "/predict":
+            name = self._default_model
+            if name is None:
+                return None, None
+            return name, self._models.get(name)
+        parts = path.strip("/").split("/")
+        if len(parts) == 3 and parts[0] == "models" \
+                and parts[2] == "predict":
+            return parts[1], self._models.get(parts[1])
+        return None, None
+
+    def _make_handler(self):
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            server_version = "paddle-tpu-serving"
+
+            def log_message(self, fmt, *args):  # stay off stderr
+                _LOG.debug("ingress %s " + fmt,
+                           self.client_address[0], *args)
+
+            # ---------------------------------------------- responses
+            def _reply(self, status: int, obj,
+                       headers: Optional[Dict[str, str]] = None,
+                       close_conn: bool = False) -> None:
+                body = _json_bytes(obj)
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                if close_conn:
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_unavailable(self) -> None:
+                outer._bump("unavailable_503")
+                self._reply(
+                    503, {"error": "draining",
+                          "detail": "server is draining — not "
+                                    "admitting new requests"},
+                    headers={"Retry-After": "1"}, close_conn=True)
+
+            # --------------------------------------------------- GETs
+            def do_GET(self):
+                if self.path == "/healthz":
+                    # liveness: a draining pod is alive, just not ready
+                    self._reply(200, {"status": "ok"})
+                    return
+                if self.path == "/readyz":
+                    if outer._admitting:
+                        self._reply(200, {"status": "ready"})
+                    else:
+                        outer._bump("unavailable_503")
+                        self._reply(503, {"status": "draining"},
+                                    close_conn=True)
+                    return
+                if self.path == "/stats":
+                    self._reply(200, outer.stats())
+                    return
+                outer._bump("not_found_404")
+                self._reply(404, {"error": "not_found",
+                                  "detail": self.path})
+
+            # --------------------------------------------------- POST
+            def do_POST(self):
+                with outer._inflight_cv:
+                    outer._inflight += 1
+                try:
+                    self._predict()
+                finally:
+                    with outer._inflight_cv:
+                        outer._inflight -= 1
+                        outer._inflight_cv.notify_all()
+
+            def _predict(self):
+                outer._bump("requests")
+                # consume the body FIRST: an early error return (404,
+                # 429) that leaves it unread would desync the
+                # keep-alive stream — the next request line would parse
+                # from body bytes. JSON decoding still waits until
+                # after the cheap gates.
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    n = -1
+                if n < 0 or n > outer._max_body_bytes:
+                    # bound the buffer BEFORE reading: the overload
+                    # layer must not be OOM-able by one giant
+                    # Content-Length. Close the connection — a body
+                    # this size is not worth draining to stay in sync.
+                    outer._bump("bad_request_400")
+                    self._reply(
+                        413 if n > 0 else 400,
+                        {"error": "payload_too_large" if n > 0
+                         else "bad_request",
+                         "max_body_bytes": outer._max_body_bytes},
+                        close_conn=True)
+                    return
+                try:
+                    raw = self.rfile.read(n) if n > 0 else b""
+                except OSError:
+                    outer._bump("bad_request_400")
+                    self._reply(400, {"error": "bad_request",
+                                      "detail": "unreadable body"},
+                                close_conn=True)
+                    return
+                if not outer._admitting:
+                    self._reply_unavailable()
+                    return
+                name, eng = outer._route(self.path)
+                if eng is None:
+                    outer._bump("not_found_404")
+                    self._reply(404, {
+                        "error": "not_found",
+                        "detail": f"no model at {self.path!r}; models: "
+                                  f"{sorted(outer._models)}"})
+                    return
+
+                # edge rate gate: sustained load past the configured
+                # QPS sheds here, before it costs a queue slot
+                if outer._bucket is not None \
+                        and not outer._bucket.try_acquire():
+                    ra = outer._bucket.retry_after_s()
+                    outer._bump("shed_429")
+                    outer._bump("rate_limited")
+                    self._reply(
+                        429, {"error": "overloaded",
+                              "where": "rate_gate",
+                              "retry_after_ms": round(ra * 1e3, 3)},
+                        headers={"Retry-After":
+                                 _retry_after_header(ra)})
+                    return
+
+                try:
+                    payload = json.loads(raw.decode("utf-8"))
+                    feed_in = payload["feed"]
+                    many = bool(payload.get("many", False))
+                    feed = {k: np.asarray(v) for k, v in feed_in.items()}
+                except Exception as e:
+                    outer._bump("bad_request_400")
+                    self._reply(400, {"error": "bad_request",
+                                      "detail": repr(e)})
+                    return
+
+                deadline_s = outer._default_deadline_s
+                hdr = self.headers.get("X-Deadline-Ms")
+                if hdr is not None:
+                    try:
+                        deadline_s = float(hdr) / 1e3
+                    except ValueError:
+                        outer._bump("bad_request_400")
+                        self._reply(400, {
+                            "error": "bad_request",
+                            "detail": f"X-Deadline-Ms: {hdr!r}"})
+                        return
+
+                t0 = time.perf_counter()
+                try:
+                    req = eng.submit(feed, many=many,
+                                     deadline_s=deadline_s)
+                    wait_s = (120.0 if deadline_s is None
+                              else deadline_s + 5.0)
+                    outs = req.wait(wait_s)
+                except core.OverloadedError as e:
+                    outer._bump("shed_429")
+                    self._reply(
+                        429, {"error": "overloaded",
+                              "retry_after_ms": round(
+                                  e.retry_after_s * 1e3, 3),
+                              "detail": str(e)},
+                        headers={"Retry-After": _retry_after_header(
+                            e.retry_after_s)})
+                    return
+                except core.DeadlineExceededError as e:
+                    outer._bump("expired_504")
+                    body = {"error": "deadline_exceeded",
+                            "detail": str(e)}
+                    if e.queue_wait_s is not None:
+                        body["queue_wait_ms"] = round(
+                            e.queue_wait_s * 1e3, 3)
+                    self._reply(504, body)
+                    return
+                except TimeoutError as e:
+                    outer._bump("expired_504")
+                    self._reply(504, {"error": "deadline_exceeded",
+                                      "detail": repr(e)})
+                    return
+                except (KeyError, ValueError) as e:
+                    # engine feed validation
+                    outer._bump("bad_request_400")
+                    self._reply(400, {"error": "bad_request",
+                                      "detail": repr(e)})
+                    return
+                except RuntimeError as e:
+                    if "closed" in str(e):
+                        self._reply_unavailable()
+                        return
+                    outer._bump("upstream_5xx")
+                    self._reply(502, {"error": "upstream_error",
+                                      "detail": repr(e)})
+                    return
+                except Exception as e:
+                    outer._bump("upstream_5xx")
+                    self._reply(502, {"error": "upstream_error",
+                                      "detail": repr(e)})
+                    return
+
+                outer._bump("ok")
+                if req.degraded:
+                    outer._bump("degraded_responses")
+                # row-major float lists: f32 → f64 widening is exact
+                # and repr(f64) round-trips, so casting back to the
+                # shipped dtypes recovers the engine's bits exactly
+                # (the HTTP bit-parity acceptance leg)
+                self._reply(200, {
+                    "model": name,
+                    "outputs": [np.asarray(o).tolist() for o in outs],
+                    "dtypes": [str(np.asarray(o).dtype) for o in outs],
+                    "degraded": bool(req.degraded),
+                    "latency_ms": round(
+                        (time.perf_counter() - t0) * 1e3, 3),
+                })
+
+        return _Handler

@@ -1,0 +1,594 @@
+"""Serving ingress & overload-robustness plane (ISSUE 9,
+docs/SERVING.md "Ingress & overload").
+
+Acceptance legs covered here:
+  * HTTP bit-parity — accepted requests through the ingress return
+    byte-identical predictions to in-process ``ServingEngine.predict``;
+  * typed refusals — admission-bound sheds are 429 with monotone
+    Retry-After, expired deadlines are 504 with the queue-wait
+    evidence, a draining server answers 503 + Connection: close;
+  * deadline propagation — the budget caps queue wait AND the PS RPC
+    layer (``ps_rpc.call_budget``), surfacing typed
+    ``DeadlineExceededError`` instead of a slow transport error;
+  * circuit breaker + serve-stale degradation — a killed pserver
+    mid-HTTP-serving yields degraded (flagged) 200s from beyond-TTL
+    cache rows with ZERO 5xx for cache-covered rows, and un-degrades
+    automatically after a PR 6-style promoted view;
+  * graceful drain — a SIGTERM mid-burst loses zero accepted requests.
+"""
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+pytestmark = pytest.mark.serving
+
+
+# ======================================================================
+# harness
+# ======================================================================
+@pytest.fixture(scope="module")
+def mlp():
+    """Tiny forward model shared by the ingress tests (module-scoped:
+    one compile)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+        out = fluid.layers.fc(h, 4, act="softmax")
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    rng = np.random.RandomState(0)
+    return {"main": main, "scope": scope, "out": out.name,
+            "X": rng.rand(16, 8).astype(np.float32)}
+
+
+def _engine(m, **kw):
+    from paddle_tpu.serving import ServingEngine
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_queue_delay_ms", 2.0)
+    kw.setdefault("num_workers", 2)
+    return ServingEngine(program=m["main"], scope=m["scope"],
+                         feed_names=["x"], fetch_names=[m["out"]], **kw)
+
+
+def _http(ing):
+    from tools.serving_loadgen import HttpClient
+    return HttpClient("127.0.0.1", ing.port)
+
+
+# ======================================================================
+# ingress smoke: routing + bit-parity + health surfaces (tier-1 fast)
+# ======================================================================
+def test_ingress_smoke_routing_health_and_http_bit_parity(mlp):
+    """The non-slow in-process ingress smoke: healthz/readyz/stats,
+    default + named-model routing, 404 on unknown models, and the
+    bit-parity acceptance — JSON outputs cast back to the shipped
+    dtype equal the in-process predict() bits exactly (f32→f64→repr
+    round-trips exactly)."""
+    from paddle_tpu.serving import ServingIngress
+
+    eng = _engine(mlp)
+    ing = ServingIngress({"mlp": eng}).start()
+    cli = _http(ing)
+    try:
+        eng.warm()
+        assert cli.get("/healthz")[0] == 200
+        assert cli.get("/readyz")[0] == 200
+
+        X = mlp["X"]
+        for i in range(len(X)):
+            (oracle,) = eng.predict({"x": X[i]})
+            status, obj = cli.predict({"x": X[i]}, model="mlp")
+            assert status == 200
+            got = np.asarray(obj["outputs"][0], obj["dtypes"][0])
+            assert got.shape == oracle.shape
+            assert (got == oracle).all(), \
+                f"HTTP row {i} not bit-identical"
+            assert obj["degraded"] is False
+
+        # default route (single model) == named route
+        status, obj = cli.predict({"x": X[0]})
+        assert status == 200 and obj["model"] == "mlp"
+        # unknown model / path → 404
+        assert cli.predict({"x": X[0]}, model="nope")[0] == 404
+        assert cli.get("/nothing")[0] == 404
+        # garbage body → 400
+        status, _r, obj = cli._request(
+            "POST", "/predict", b"not json",
+            {"Content-Type": "application/json"})
+        assert status == 400
+
+        status, st = cli.get("/stats")
+        assert status == 200
+        assert st["ingress"]["ok"] >= len(X) + 1
+        assert st["models"]["mlp"]["requests"] >= len(X)
+        for k in ("shed", "deadline_expired", "degraded",
+                  "breaker_open"):
+            assert k in st["models"]["mlp"]
+    finally:
+        cli.close()
+        ing.close()
+
+
+# ======================================================================
+# typed 429s: admission bound + monotone Retry-After (overload unit)
+# ======================================================================
+def test_admission_retry_after_monotone_in_queue_depth():
+    from paddle_tpu.serving import AdmissionController
+
+    adm = AdmissionController(max_queue_rows=8)
+    # fixed rate: deeper queue → never-smaller advice
+    for rate in (0.0, 200.0):
+        vals = [adm.retry_after_s(d, rate) for d in (4, 8, 16, 64, 256)]
+        assert vals == sorted(vals), (rate, vals)
+    # shed carries the advice typed
+    with pytest.raises(core.OverloadedError) as ei:
+        adm.admit(1, pending_rows=8, row_rate=100.0)
+    assert ei.value.retry_after_s > 0
+
+
+def test_overload_sheds_typed_429_never_queued_to_die(mlp):
+    """Drive the admission queue past its bound from concurrent
+    clients: some requests shed with typed OverloadedError carrying
+    monotone Retry-After; every accepted request completes; nothing
+    hangs. The engine-level half of the overload acceptance."""
+    from paddle_tpu.serving import AdmissionController
+
+    eng = _engine(mlp, admission=AdmissionController(max_queue_rows=4),
+                  num_workers=1)
+    try:
+        eng.warm()
+        eng.reset_stats()
+        X = mlp["X"]
+        ok, shed, other = [0], [0], []
+        lock = threading.Lock()
+
+        def client(wid):
+            for k in range(30):
+                try:
+                    eng.predict({"x": X[(wid + k) % len(X)]},
+                                timeout=30.0)
+                    with lock:
+                        ok[0] += 1
+                except core.OverloadedError as e:
+                    assert e.retry_after_s > 0
+                    with lock:
+                        shed[0] += 1
+                except BaseException as e:  # noqa: BLE001
+                    other.append(repr(e))
+
+        ths = [threading.Thread(target=client, args=(w,))
+               for w in range(10)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert not other, other[:3]
+        assert shed[0] > 0, "bound never engaged"
+        assert ok[0] > 0
+        st = eng.stats()
+        assert st["shed"] == shed[0]
+        assert st["requests"] == ok[0]  # every accepted one answered
+    finally:
+        eng.close()
+
+
+def test_ingress_maps_shed_to_429_with_retry_after_header(mlp):
+    """An ingress-level shed is an HTTP 429 whose Retry-After header
+    and retry_after_ms body field carry the engine's advice; the
+    token-bucket rate gate sheds the same way."""
+    from paddle_tpu.serving import (AdmissionController, ServingEngine,
+                                    ServingIngress)
+
+    eng = _engine(mlp, admission=AdmissionController(max_queue_rows=2),
+                  num_workers=1)
+    ing = ServingIngress({"mlp": eng}, rate_qps=10000.0).start()
+    cli = _http(ing)
+    try:
+        eng.warm()
+        X = mlp["X"]
+        saw_429 = [False]
+        headers_ra = []
+
+        def hammer(wid):
+            c = _http(ing)
+            for k in range(20):
+                status, _r, obj = c._request(
+                    "POST", "/predict",
+                    json.dumps({"feed": {"x": X[k % len(X)].tolist()}}),
+                    {"Content-Type": "application/json"})
+                if status == 429:
+                    saw_429[0] = True
+                    assert obj["retry_after_ms"] > 0
+                    headers_ra.append(float(
+                        _r.headers.get("Retry-After")))
+            c.close()
+
+        ths = [threading.Thread(target=hammer, args=(w,))
+               for w in range(8)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert saw_429[0], "no HTTP shed happened"
+        assert all(ra > 0 for ra in headers_ra)
+    finally:
+        cli.close()
+        ing.close()
+
+
+# ======================================================================
+# deadlines: queue expiry 504 + RPC budget propagation
+# ======================================================================
+def test_expired_deadline_is_typed_504_with_queue_wait_span(mlp):
+    """A request whose budget dies in the queue answers typed (504 over
+    HTTP) WITH its serve:queue_wait span — instead of holding a
+    worker. Driven through the real take path: the worker is pinned by
+    a slow in-flight bucket while a zero-ish-budget request queues
+    behind it."""
+    from paddle_tpu.fluid import profiler
+    from paddle_tpu.serving.batching import Request
+
+    eng = _engine(mlp, num_workers=1)
+    try:
+        eng.warm()
+        # direct unit on the gate: manufactured requests, one expired
+        profiler.start_profiler(state="CPU")
+        try:
+            r_live = Request({"x": mlp["X"][:1]}, 1,
+                             deadline=time.perf_counter() + 60)
+            r_dead = Request({"x": mlp["X"][:1]}, 1,
+                             deadline=time.perf_counter() - 0.01)
+            live = eng._expire_or_shed([r_dead, r_live],
+                                       time.perf_counter())
+            assert live == [r_live]
+            assert r_dead.done()
+            with pytest.raises(core.DeadlineExceededError) as ei:
+                r_dead.wait(0)
+            assert ei.value.queue_wait_s is not None
+            ev = [e for e in profiler.snapshot_events()
+                  if e["name"] == "serve:queue_wait"
+                  and (e["args"] or {}).get("expired")]
+            assert ev, "expired request recorded no queue_wait span"
+        finally:
+            profiler.stop_profiler(profile_path="")
+        # engine surface: an already-spent budget at submit is typed
+        with pytest.raises(core.DeadlineExceededError):
+            eng.predict({"x": mlp["X"][0]}, deadline_s=0.0)
+        assert eng.stats()["deadline_expired"] >= 2
+    finally:
+        eng.close()
+
+
+def test_rpc_call_budget_caps_deadline_and_raises_typed():
+    """ps_rpc deadline propagation: a call under an expiring budget
+    must cap its socket deadline at the remainder and surface typed
+    DeadlineExceededError — never burn the full FLAGS_rpc_deadline
+    ladder against a slow server."""
+    from paddle_tpu.fluid.ps_rpc import (VarClient, VarServer,
+                                         call_budget)
+    from tools.serving_loadgen import free_port
+
+    ep = f"127.0.0.1:{free_port()}"
+
+    def slow(name, trainer_id=0):
+        time.sleep(1.0)
+        return np.zeros(2, np.float32)
+
+    srv = VarServer(ep, {"get_var": slow}).start()
+    cli = VarClient(ep, connect_timeout=5.0, channels=1)
+    try:
+        t0 = time.perf_counter()
+        with call_budget(time.monotonic() + 0.2):
+            with pytest.raises(core.DeadlineExceededError):
+                cli.call("get_var", name="v")
+        took = time.perf_counter() - t0
+        assert took < 0.9, f"budget did not cap the call ({took:.2f}s)"
+        # spent budget refuses to even start
+        with call_budget(time.monotonic() - 0.01):
+            with pytest.raises(core.DeadlineExceededError):
+                cli.call("get_var", name="v")
+        # unbudgeted call still works
+        assert cli.call("get_var", name="v").shape == (2,)
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
+# ======================================================================
+# circuit breaker (fluid/ps_rpc.py)
+# ======================================================================
+@pytest.fixture
+def _breaker_flags():
+    keys = ("FLAGS_rpc_circuit_breaker", "FLAGS_rpc_breaker_failures",
+            "FLAGS_rpc_breaker_reset_s", "FLAGS_rpc_retry_times")
+    before = {k: core.globals_[k] for k in keys}
+    from paddle_tpu.fluid.ps_rpc import VarClient, reset_breakers
+    reset_breakers()
+    yield
+    for k, v in before.items():
+        core.globals_[k] = v
+    reset_breakers()
+    VarClient.reset_pool()
+
+
+def test_breaker_state_machine_and_fast_fail(_breaker_flags):
+    """CLOSED --N failures--> OPEN --cooldown--> HALF-OPEN (one probe)
+    --success--> CLOSED; while OPEN, data calls fail fast with typed
+    CircuitOpenError instead of a connect poll."""
+    from paddle_tpu.fluid.ps_rpc import VarClient, breaker_states
+    from tools.serving_loadgen import free_port
+
+    core.globals_["FLAGS_rpc_circuit_breaker"] = True
+    core.globals_["FLAGS_rpc_breaker_failures"] = 2
+    core.globals_["FLAGS_rpc_breaker_reset_s"] = 0.3
+    core.globals_["FLAGS_rpc_retry_times"] = 0
+
+    ep = f"127.0.0.1:{free_port()}"  # nothing listening
+    for _ in range(2):  # two refused connects trip the breaker
+        with pytest.raises(ConnectionError):
+            VarClient(ep, connect_timeout=0.3)
+    assert breaker_states()[ep]["state"] == "open"
+    t0 = time.perf_counter()
+    with pytest.raises(core.CircuitOpenError):
+        VarClient(ep, connect_timeout=5.0)
+    assert time.perf_counter() - t0 < 0.1, "open breaker not fast"
+
+    # recovery: a server appears; the half-open probe closes it
+    from paddle_tpu.fluid.ps_rpc import VarServer
+    srv = VarServer(ep, {"get_var":
+                         lambda name, trainer_id=0:
+                         np.ones(1, np.float32)}).start()
+    try:
+        time.sleep(0.35)  # past the cooldown → half-open
+        cli = VarClient(ep, connect_timeout=2.0)
+        assert cli.call("get_var", name="v")[0] == 1.0
+        assert breaker_states()[ep]["state"] == "closed"
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+def test_breaker_ignores_caller_deadline_expiry(_breaker_flags):
+    """Review regression: a call that dies of the CALLER's expired
+    budget (DeadlineExceededError) is the client's deadline, not the
+    endpoint's failure — tight-deadline traffic against a healthy-but-
+    slow pserver must neither trip the breaker nor wedge a reserved
+    half-open probe."""
+    from paddle_tpu.fluid.ps_rpc import (VarClient, VarServer,
+                                         breaker_states, call_budget)
+    from tools.serving_loadgen import free_port
+
+    core.globals_["FLAGS_rpc_circuit_breaker"] = True
+    core.globals_["FLAGS_rpc_breaker_failures"] = 2
+    core.globals_["FLAGS_rpc_retry_times"] = 0
+
+    ep = f"127.0.0.1:{free_port()}"
+
+    def slow(name, trainer_id=0):
+        time.sleep(0.4)
+        return np.zeros(1, np.float32)
+
+    srv = VarServer(ep, {"get_var": slow}).start()
+    cli = VarClient(ep, connect_timeout=5.0)
+    try:
+        for _ in range(3):  # >= threshold expiries: must NOT trip
+            with call_budget(time.monotonic() + 0.1):
+                with pytest.raises(core.DeadlineExceededError):
+                    cli.call("get_var", name="v")
+        assert breaker_states()[ep]["state"] == "closed", \
+            "caller deadline expiry tripped the breaker"
+        # endpoint still healthy for an unbudgeted call
+        assert cli.call("get_var", name="v").shape == (1,)
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
+# ======================================================================
+# EmbeddingCache: serve-stale degradation + trainer-pushed invalidation
+# ======================================================================
+def test_embedding_cache_serves_stale_degraded_and_recovers():
+    from paddle_tpu.serving import EmbeddingCache
+    from paddle_tpu.serving.admission import degraded_scope
+
+    cache = EmbeddingCache(ttl_s=10.0, max_entries=100)
+    table = {i: np.full(2, float(i), np.float32) for i in range(8)}
+    alive = [True]
+
+    def fetch(ids):
+        if not alive[0]:
+            raise ConnectionError("pserver dead")
+        return np.stack([table[int(i)] for i in ids])
+
+    out = cache.lookup("t", [1, 2, 3], fetch)
+    np.testing.assert_array_equal(out[0], table[1])
+
+    # beyond TTL + dead pserver → stale rows served, flagged degraded
+    real = cache._clock
+    cache._clock = lambda: real() + 11.0
+    alive[0] = False
+    with degraded_scope() as dg:
+        out2 = cache.lookup("t", [1, 2, 3], fetch)
+    np.testing.assert_array_equal(out2, out)  # the retained copies
+    assert dg.count == 3
+    assert cache.stats()["stale_served"] == 3
+
+    # an UNCOVERED row keeps the typed failure (honest 5xx upstream)
+    with pytest.raises(ConnectionError):
+        cache.lookup("t", [1, 7], fetch)
+    # serve_stale=False keeps the old fail-hard contract
+    strict = EmbeddingCache(ttl_s=10.0, serve_stale=False)
+    strict.lookup("t", [1],
+                  lambda ids: np.stack([table[int(i)] for i in ids]))
+    strict._clock = lambda: real() + 11.0
+    with pytest.raises(ConnectionError):
+        strict.lookup("t", [1], fetch)
+
+    # recovery: pserver back → fresh fetch, no degradation
+    alive[0] = True
+    with degraded_scope() as dg2:
+        out3 = cache.lookup("t", [1, 2, 3], fetch)
+    assert dg2.count == 0
+    np.testing.assert_array_equal(out3, out)
+
+
+def test_embedding_cache_trainer_push_invalidation_and_fence():
+    """The trainer-pushed invalidation satellite: invalidate_rows (the
+    distributed_lookup_table_grad hook — the kernel calls it on the
+    installed row cache) makes a post-push fetch MISS and refetch; the
+    stage-seq fence keeps an in-flight fetch that straddles the push
+    from re-filling pre-push rows."""
+    from paddle_tpu.serving import EmbeddingCache
+
+    # the grad kernel gates on hasattr(cache, "invalidate_rows"):
+    # the serving cache must expose the PrefetchBuffer's hook contract
+    assert hasattr(EmbeddingCache(), "invalidate_rows")
+
+    cache = EmbeddingCache(ttl_s=30.0)
+    version = [0]
+    calls = []
+
+    def fetch(ids):
+        calls.append(np.asarray(ids).tolist())
+        return np.stack([np.full(2, 10 * version[0] + int(i),
+                                 np.float32) for i in ids])
+
+    cache.lookup("t", [1, 2], fetch)
+    assert cache.lookup("t", [1], fetch)[0][0] == 1.0  # cached hit
+    assert len(calls) == 1
+
+    # trainer pushes rows 1: post-push fetch must miss and refetch
+    version[0] = 1
+    cache.invalidate_rows("t", [1])
+    assert cache.stats()["invalidated_rows"] == 1
+    out = cache.lookup("t", [1, 2], fetch)
+    assert out[0][0] == 11.0   # refetched post-push value
+    assert out[1][0] == 2.0    # row 2 untouched, still cached
+    assert calls[-1] == [1]
+
+    # fence: a fetch IN FLIGHT across the push must not re-fill its
+    # pre-push copy — fetch_fn invalidates mid-flight (the racing push)
+    cache2 = EmbeddingCache(ttl_s=30.0)
+
+    def racing_fetch(ids):
+        rows = np.stack([np.full(2, float(i), np.float32)
+                         for i in ids])
+        cache2.invalidate_rows("t", ids)  # push lands mid-fetch
+        return rows
+
+    got = cache2.lookup("t", [5], racing_fetch)
+    assert got[0][0] == 5.0          # THIS call still serves its rows
+    misses0 = cache2.misses
+    cache2.lookup("t", [5], lambda ids: np.stack(
+        [np.full(2, 99.0, np.float32) for _ in ids]))
+    assert cache2.misses == misses0 + 1, \
+        "pre-push fetch re-filled the cache across the fence"
+
+
+# ======================================================================
+# chaos: pserver killed mid-HTTP-serving → degraded, zero 5xx, recovery
+# ======================================================================
+@pytest.mark.chaos
+def test_pserver_kill_mid_http_serving_degrades_then_recovers():
+    """The degradation acceptance, end to end over HTTP: kill the
+    pserver under live ingress traffic (connection-severing shutdown —
+    the in-process SIGKILL), and every cache-covered row keeps
+    answering 200 flagged degraded (zero 5xx); a PR 6-style promoted
+    view recovers the path automatically (breaker half-open probe
+    lands on the new owner)."""
+    from tools.serving_loadgen import run_chaos_scenario
+
+    res = run_chaos_scenario(n_feeds=16, ttl_s=0.25,
+                             breaker_reset_s=0.5)
+    assert res["warm"]["5xx"] == 0 and res["warm"]["degraded"] == 0
+    # dark window: all covered rows 200+degraded, zero 5xx
+    assert res["dark"]["5xx"] == 0, res
+    assert res["dark"]["ok"] == 16 and res["dark"]["degraded"] == 16
+    # recovery after the promoted view: fresh, un-degraded
+    assert res["recovered_fresh"]["degraded"] == 0, res
+    assert res["recovered_fresh"]["ok"] == 16
+    assert res["cache"]["stale_served"] > 0
+    assert res["ok"] is True
+
+
+# ======================================================================
+# graceful drain: SIGTERM mid-burst loses zero accepted requests
+# ======================================================================
+def test_sigterm_graceful_drain_loses_zero_accepted_requests(mlp):
+    """SIGTERM during a client burst: after the drain no request ever
+    saw a 5xx or a torn connection mid-response — every response is a
+    bit-true 200 (accepted before the drain) or a typed 503 (refused
+    after it). Accepted requests already in the queue complete."""
+    from paddle_tpu.serving import ServingIngress
+
+    eng = _engine(mlp)
+    ing = ServingIngress({"mlp": eng}).start()
+    assert ing.install_signal_handlers()
+    X = mlp["X"]
+    eng.warm()
+    (oracle,) = eng.predict({"x": X[0]})
+    eng.reset_stats()  # count only the burst's accepted requests
+
+    results = {"ok": 0, "503": 0, "bad": []}
+    lock = threading.Lock()
+
+    def client(wid):
+        c = _http(ing)
+        for k in range(40):
+            try:
+                status, obj = c.predict({"x": X[0]})
+            except OSError:
+                # connection refused AFTER the listener closed is a
+                # clean refusal (the restart window), not a lost
+                # request — but only count it once the drain began
+                with lock:
+                    if results["503"] or not ing._admitting:
+                        results["ok"] += 0
+                    else:
+                        results["bad"].append("transport before drain")
+                return
+            with lock:
+                if status == 200:
+                    got = np.asarray(obj["outputs"][0],
+                                     obj["dtypes"][0])
+                    if not (got == oracle).all():
+                        results["bad"].append("bit mismatch")
+                    results["ok"] += 1
+                elif status == 503:
+                    results["503"] += 1
+                else:
+                    results["bad"].append(f"status {status}")
+        c.close()
+
+    ths = [threading.Thread(target=client, args=(w,)) for w in range(6)]
+    for t in ths:
+        t.start()
+    time.sleep(0.10)  # mid-burst
+    os.kill(os.getpid(), signal.SIGTERM)
+    for t in ths:
+        t.join()
+    # the SIGTERM handler closes on a helper thread; wait for it
+    deadline = time.time() + 15
+    while not ing._closed and time.time() < deadline:
+        time.sleep(0.05)
+    assert ing._closed
+    assert not results["bad"], results["bad"][:5]
+    assert results["ok"] > 0, "no request completed before the drain"
+    st = eng.stats()
+    assert st["errors"] == 0
+    assert st["requests"] == results["ok"], \
+        "accepted requests were lost across the drain"
