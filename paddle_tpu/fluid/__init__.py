@@ -40,6 +40,7 @@ from .io import (save_vars, save_params, save_persistables, load_vars,
 from . import dygraph
 from . import metrics
 from . import profiler
+from . import telemetry
 from .data_feeder import DataFeeder
 from . import reader
 from .reader import DataLoader
@@ -149,7 +150,8 @@ def get_flags(names):
 
 __all__ = [
     "core", "framework", "layers", "optimizer", "backward", "initializer",
-    "regularizer", "clip", "io", "dygraph", "metrics", "profiler", "contrib",
+    "regularizer", "clip", "io", "dygraph", "metrics", "profiler",
+    "telemetry", "contrib",
     "Program", "Variable", "Executor", "CompiledProgram", "BuildStrategy",
     "ExecutionStrategy", "CPUPlace", "TPUPlace", "CUDAPlace",
     "CUDAPinnedPlace", "LoDTensor", "LoDTensorArray", "Scope", "ParamAttr",

@@ -240,12 +240,17 @@ class OverlapPlane:
 
     def __init__(self):
         from . import ps_rpc
+        from . import telemetry
         self.prefetch = PrefetchBuffer()
         self._q: "queue.Queue" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self._installed_over = None
         self.stages = 0
+        # metrics view (docs/OBSERVABILITY.md): hit rate / staged rows /
+        # invalidations scrape as ps_prefetch_* gauges
+        self._metrics_view = telemetry.REGISTRY.register_view(
+            "ps_prefetch", self.stats)
         if ps_rpc.current_row_cache() is None:
             # never fight a serving EmbeddingCache for the hook — a
             # process that serves AND trains keeps the serving cache
@@ -318,6 +323,10 @@ class OverlapPlane:
 
     def close(self):
         from . import ps_rpc
+        from . import telemetry
+        if self._metrics_view is not None:
+            telemetry.REGISTRY.unregister_view(self._metrics_view)
+            self._metrics_view = None
         if self._hook_owned and ps_rpc.current_row_cache() is \
                 self.prefetch:
             ps_rpc.install_row_cache(self._installed_over)

@@ -156,6 +156,22 @@ class RoundPipeline:
     def inflight(self) -> int:
         return self._ack.inflight()
 
+    def stats(self) -> dict:
+        """Round-pipeline counters (docs/OBSERVABILITY.md): submitted/
+        acked/inflight rounds, pending side tasks, and the double-buffer
+        install watermark — registered as the ``ps_round_pipeline``
+        metrics view by ``round_pipeline()``."""
+        submitted, acked = self._ack.counts()
+        with self._tasks_cv:
+            tasks_pending = self._tasks_pending
+        with self._lock:
+            latest, installed = self._latest[0], self._installed
+        return {"rounds_submitted": submitted, "rounds_acked": acked,
+                "rounds_inflight": submitted - acked,
+                "tasks_pending": tasks_pending,
+                "latest_pull_round": latest,
+                "installed_pull_round": installed}
+
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Wait for every submitted round (and queued task) to finish —
         FIFO, so the flush order is deterministic. Returns False on
@@ -194,13 +210,17 @@ class RoundPipeline:
 # install_row_cache layering in ps_rpc)
 _round_pipe: Optional[RoundPipeline] = None
 _round_pipe_lock = threading.Lock()
+_round_pipe_view = None
 
 
 def round_pipeline() -> RoundPipeline:
-    global _round_pipe
+    global _round_pipe, _round_pipe_view
     with _round_pipe_lock:
         if _round_pipe is None:
             _round_pipe = RoundPipeline()
+            from . import telemetry
+            _round_pipe_view = telemetry.REGISTRY.register_view(
+                "ps_round_pipeline", _round_pipe.stats)
         return _round_pipe
 
 
@@ -217,9 +237,13 @@ def drain_async_rounds(timeout: Optional[float] = None) -> bool:
 
 
 def reset_round_pipeline():
-    global _round_pipe
+    global _round_pipe, _round_pipe_view
     with _round_pipe_lock:
         pipe, _round_pipe = _round_pipe, None
+        view, _round_pipe_view = _round_pipe_view, None
+    if view is not None:
+        from . import telemetry
+        telemetry.REGISTRY.unregister_view(view)
     if pipe is not None:
         pipe.stop(timeout=5.0)
 

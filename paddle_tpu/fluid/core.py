@@ -923,6 +923,31 @@ class _GlobalFlags:
         # how long to wait for the worker process to exit at iterator
         # teardown before it is killed
         "FLAGS_dataloader_join_timeout": 5.0,
+        # ---- unified telemetry plane (docs/OBSERVABILITY.md) ----
+        # non-empty: every process streams its profiler spans into a
+        # bounded chrome-trace shard <dir>/trace-<pid>.json (raw
+        # monotonic timestamps + clock-offset metadata from the ps_rpc
+        # _hello handshake); tools/timeline.py merge aligns the shards
+        # into ONE clock-corrected cluster timeline keyed by trace id.
+        # Spans record even without start_profiler() while this is set.
+        "FLAGS_trace_dir": "",
+        # ring-buffer bound of one trace shard — oldest events drop
+        # (counted in the shard metadata) so a long run's shard stays
+        # O(bound), not O(steps)
+        "FLAGS_trace_shard_max_events": 65536,
+        # in-memory profiler event bound (ring semantics): beyond this
+        # the OLDEST events drop and a dropped-events counter surfaces
+        # in the summary/snapshot — a long profiled run can no longer
+        # grow the host heap without bound. Applied at start_profiler/
+        # reset_profiler time.
+        "FLAGS_profiler_max_events": 1_000_000,
+        # opt-in lightweight /metrics sidecar (Prometheus text format
+        # over the telemetry registry): >0 binds 127.0.0.1:<port> at
+        # pserver/ingress/executor startup so bench.py and the chaos/
+        # loadgen tools scrape instead of poking process internals.
+        # 0 (default) = off; the serving ingress additionally always
+        # serves GET /metrics on its own port.
+        "FLAGS_metrics_port": 0,
     }
 
     def __init__(self):

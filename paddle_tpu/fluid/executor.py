@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from . import core
+from . import telemetry as _telemetry
 from .core import LoDTensor, Scope, global_scope
 from .framework import Program, Variable, default_main_program
 from ..ops.registry import (OPS, run_generic_grad, GRAD_SUFFIX,
@@ -498,6 +499,9 @@ class _CompiledBlock:
         # (n_steps, windowed-feed names) → scanned jit; shape changes
         # within a key retrace inside jax.jit as usual
         self._multi_jit: Dict[Tuple[int, Tuple[str, ...]], Any] = {}
+        # step telemetry (docs/OBSERVABILITY.md): first dispatch of the
+        # single-step jit bumps executor_compiles_total{kind="step"}
+        self._dispatched = False
 
     # ---------------------------------------------- numeric fault guard
     def _init_guard(self, program: Program, scope: Scope,
@@ -938,13 +942,27 @@ class _CompiledBlock:
         the happy path costs no host sync."""
         mut, ro, feeds, rng = self._place_inputs(scope, feeds, rng)
         from . import profiler as _profiler
+        first = not self._dispatched
+        if first:
+            self._dispatched = True
+            _telemetry.count_compile("step")
         if _profiler.is_profiling():
             # the whole program is ONE dispatch on TPU — a single span
-            # (per-op timing lives in the device XPlane trace)
+            # (per-op timing lives in the device XPlane trace). The
+            # first dispatch additionally carries a cat="compile" span:
+            # that is where jax traces+compiles the step (the backend
+            # listener records the exact compile durations inside it).
             with _profiler.RecordEvent("compiled_step"):
-                fetches, new_mut, extra, health = self._jitted(
-                    mut, ro, feeds, rng)
-                jax.block_until_ready(fetches)
+                cm = (_profiler.RecordEvent("compile:step",
+                                            cat="compile")
+                      if first else contextlib.nullcontext())
+                with cm:
+                    fetches, new_mut, extra, health = self._jitted(
+                        mut, ro, feeds, rng)
+                    if _profiler.is_session():
+                        # only a real profiler session pays the sync;
+                        # shard-only spans measure dispatch
+                        jax.block_until_ready(fetches)
         else:
             fetches, new_mut, extra, health = self._jitted(mut, ro, feeds,
                                                            rng)
@@ -973,7 +991,8 @@ class _CompiledBlock:
                                        cat="window"):
                 fetches, new_mut, extra, health = self._run_multi(
                     mut, ro, feeds, rng_base, idx0, n_steps, window_names)
-                jax.block_until_ready(fetches)
+                if _profiler.is_session():
+                    jax.block_until_ready(fetches)
         else:
             fetches, new_mut, extra, health = self._run_multi(
                 mut, ro, feeds, rng_base, idx0, n_steps, window_names)
@@ -1001,7 +1020,13 @@ class _CompiledBlock:
         if not self.extra_writeback:
             key = (n_steps, tuple(sorted(window_names)))
             jitted = self._multi_jit.get(key)
-            if jitted is None:
+            fresh = jitted is None
+            if fresh:
+                # a miss AFTER warm-up is a retrace (a new window/bucket
+                # signature appeared late) — the scrapeable form of the
+                # serving plane's no-recompile claim
+                _telemetry.count_compile(
+                    "window", retrace=bool(self._multi_jit))
                 from jax import lax
 
                 def many(mut, ro, bcast, xs, rng_b, i0):
@@ -1017,8 +1042,17 @@ class _CompiledBlock:
                     return ys, new_mut, healths
                 jitted = jax.jit(many, donate_argnums=(0,))
                 self._multi_jit[key] = jitted
-            ys, new_mut, healths = jitted(mut, ro, bcast, xs, rng_base,
-                                          jnp.int32(idx0))
+            from . import profiler as _profiler
+            if fresh and _profiler.is_profiling():
+                with _profiler.RecordEvent(
+                        f"compile:window[{n_steps}]", cat="compile",
+                        args={"n_steps": int(n_steps)}):
+                    ys, new_mut, healths = jitted(mut, ro, bcast, xs,
+                                                  rng_base,
+                                                  jnp.int32(idx0))
+            else:
+                ys, new_mut, healths = jitted(mut, ro, bcast, xs,
+                                              rng_base, jnp.int32(idx0))
             self._check_no_lod_fetch()  # lods appear during the trace
             return ys, new_mut, {}, healths
         per_step = []
@@ -1250,6 +1284,9 @@ class _SegmentedBlock(_CompiledBlock):
         entry = seg._cache.get(lkey)
         first = entry is None
         if first:
+            # a new LoD key on a warm segment cache IS a retrace
+            _telemetry.count_compile("segment",
+                                     retrace=bool(seg._cache))
             static_lods = dict(lkey)
             captured: Dict[str, Any] = {}
             seg_ops, start, out_names = seg.ops, seg.start, seg.out_names
@@ -1281,7 +1318,8 @@ class _SegmentedBlock(_CompiledBlock):
                     f"segment[{seg.start}:{seg.stop}]:{tag}",
                     cat="segment"):
                 outs, seg_health = jitted(donated, held, rng)
-                jax.block_until_ready(outs)
+                if _profiler.is_session():
+                    jax.block_until_ready(outs)
         else:
             outs, seg_health = jitted(donated, held, rng)
         env.update(outs)
@@ -1525,6 +1563,12 @@ class Executor:
         self._compiled_cache: Dict[Tuple, _CompiledBlock] = {}
         self._closed = False
         self._maybe_enable_compile_cache()
+        # step telemetry (docs/OBSERVABILITY.md): backend-compile
+        # listener (cat="compile" spans + jax_backend_compiles_total)
+        # and the opt-in FLAGS_metrics_port sidecar — both idempotent
+        # process-wide, so per-Executor construction is free
+        _telemetry.install_jax_compile_listener()
+        _telemetry.maybe_start_metrics_server()
         # how the LAST run executed: "compiled" | "segmented" |
         # "interpreted" (observability for tests/bench — e.g. the
         # compiled_metric flag in bench.py wide_deep rows)
@@ -1764,7 +1808,10 @@ class Executor:
             return
         self._last_health = health
         from . import profiler as _profiler
-        profiling = _profiler.is_profiling()
+        # trip markers need a host readback of the flags — only a real
+        # profiler session pays it; FLAGS_trace_dir shard streaming
+        # must not re-add the per-step sync skip-mode avoids
+        profiling = _profiler.is_session()
         action = cb._guard_action if cb._guard_check else None
         if action not in ("raise", "rollback") and not profiling:
             return
@@ -1981,6 +2028,24 @@ class Executor:
         — the benchmark/training-loop shape. Interpreted programs run
         the steps sequentially and return the final fetch values."""
         from .compiler import CompiledProgram
+        from . import profiler as _profiler
+        if _profiler.is_profiling() and _telemetry.current_trace() is None:
+            # trace correlation (docs/OBSERVABILITY.md): one root trace
+            # per run() — every span this step records (segments,
+            # windows, the PS round's rpc calls and their pserver
+            # handler spans) shares one trace id, which is what makes a
+            # training round followable trainer→pserver in the merged
+            # cluster timeline. Serving/batch callers that already
+            # installed a context keep theirs.
+            with _telemetry.trace_scope():
+                return self.run(
+                    program=program, feed=feed, fetch_list=fetch_list,
+                    feed_var_name=feed_var_name,
+                    fetch_var_name=fetch_var_name, scope=scope,
+                    return_numpy=return_numpy,
+                    use_program_cache=use_program_cache,
+                    use_prune=use_prune, mesh=mesh,
+                    param_shardings=param_shardings, n_steps=n_steps)
         self._maybe_enable_compile_cache()
         if program is None:
             program = default_main_program()

@@ -20,33 +20,55 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 import jax
 
+from . import core
+from . import telemetry
+
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
            "stop_profiler", "record_event", "RecordEvent", "is_profiling",
            "record_span", "record_instant", "snapshot_events",
-           "concurrent_seconds"]
+           "concurrent_seconds", "dropped_events"]
 
 
 class _Event:
-    __slots__ = ("name", "start", "end", "tid", "cat", "args")
+    __slots__ = ("name", "start", "end", "tid", "cat", "args",
+                 "trace_id", "span_id", "parent_id")
 
-    def __init__(self, name, start, end, tid, cat="host", args=None):
+    def __init__(self, name, start, end, tid, cat="host", args=None,
+                 trace_id=None, span_id=None, parent_id=None):
         self.name = name
         self.start = start
         self.end = end
         self.tid = tid
         self.cat = cat
         self.args = args  # chrome-trace "args" payload (e.g. rpc bytes)
+        # trace correlation (telemetry.trace_scope): stamped from the
+        # recording thread's installed context, None outside any trace
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+
+
+def _ring(maxlen_hint: Optional[int] = None) -> deque:
+    """FLAGS_profiler_max_events-bounded event store: beyond the bound
+    the OLDEST events drop (counted) instead of growing the host heap
+    for a long run's lifetime. The bound is read at ring creation —
+    start_profiler / reset_profiler — not per append."""
+    n = maxlen_hint if maxlen_hint is not None else int(
+        core.globals_["FLAGS_profiler_max_events"])
+    return deque(maxlen=max(1, n))
 
 
 class _ProfilerState:
     def __init__(self):
         self.enabled = False
         self.state = "All"
-        self.events: List[_Event] = []
+        self.events: deque = _ring(1024)
+        self.dropped = 0
         self.lock = threading.Lock()
         self.t0 = 0.0
         self.trace_dir: Optional[str] = None
@@ -58,7 +80,28 @@ _prof = _ProfilerState()
 
 
 def is_profiling() -> bool:
+    """True when spans should be recorded: an explicit profiler session
+    is on OR FLAGS_trace_dir shard streaming is active (the cluster-
+    timeline mode records without start_profiler)."""
+    return _prof.enabled or telemetry.shard_active()
+
+
+def is_session() -> bool:
+    """True ONLY during an explicit start_profiler() session — the gate
+    for measurement-mode side effects (executor block_until_ready,
+    numeric-guard flag readbacks). FLAGS_trace_dir shard streaming
+    records spans WITHOUT them: a shard-only step span measures
+    dispatch, not device completion, so always-on cluster tracing never
+    re-adds the per-step host syncs PR 5 engineered away
+    (docs/OBSERVABILITY.md "1-core caveats")."""
     return _prof.enabled
+
+
+def dropped_events() -> int:
+    """Events dropped by the FLAGS_profiler_max_events ring since the
+    last start/reset."""
+    with _prof.lock:
+        return _prof.dropped
 
 
 def start_profiler(state="All", tracer_option="Default",
@@ -70,9 +113,11 @@ def start_profiler(state="All", tracer_option="Default",
         _prof.depth += 1  # nested enable: inner stop becomes a no-op pair
         return
     _prof.depth = 1
+    with _prof.lock:
+        _prof.events = _ring()
+        _prof.dropped = 0
     _prof.enabled = True
     _prof.state = state
-    _prof.events = []
     _prof.t0 = time.perf_counter()
     _prof.device_tracing = state in ("GPU", "All")
     if _prof.device_tracing:
@@ -98,7 +143,13 @@ def stop_profiler(sorted_key: Optional[str] = None,
         jax.profiler.stop_trace()
         print(f"[profiler] device XPlane trace in {_prof.trace_dir} "
               f"(TensorBoard / Perfetto)")
-    events = _prof.events
+    with _prof.lock:
+        events = list(_prof.events)
+        dropped = _prof.dropped
+    if dropped:
+        print(f"[profiler] {dropped} oldest event(s) dropped by the "
+              f"FLAGS_profiler_max_events ring "
+              f"(bound {_prof.events.maxlen})")
     _summary(events, sorted_key)
     if profile_path:
         _write_chrome_trace(events, profile_path)
@@ -108,15 +159,28 @@ def stop_profiler(sorted_key: Optional[str] = None,
 
 def reset_profiler():
     with _prof.lock:
-        _prof.events = []
+        _prof.events = _ring()
+        _prof.dropped = 0
         _prof.t0 = time.perf_counter()
 
 
 def _record(name: str, start: float, end: float, cat: str = "host",
             args=None):
-    with _prof.lock:
-        _prof.events.append(_Event(name, start, end,
-                                   threading.get_ident(), cat, args))
+    tctx = telemetry.current_trace()
+    tid = threading.get_ident()
+    if _prof.enabled:
+        if tctx is None:
+            ev = _Event(name, start, end, tid, cat, args)
+        else:
+            ev = _Event(name, start, end, tid, cat, args,
+                        tctx.trace_id, tctx.span_id, tctx.parent_id)
+        with _prof.lock:
+            if len(_prof.events) == _prof.events.maxlen:
+                _prof.dropped += 1
+            _prof.events.append(ev)
+    # cluster-timeline shard (FLAGS_trace_dir): every recorded span also
+    # streams to the process's chrome-trace shard — no-op when off
+    telemetry.shard_record(name, start, end, tid, cat, args, tctx)
 
 
 def record_span(name: str, start: float, end: float, cat: str = "host",
@@ -124,7 +188,7 @@ def record_span(name: str, start: float, end: float, cat: str = "host",
     """Record an already-timed span (perf_counter endpoints). No-op when
     profiling is off. Used by layers that time work themselves — the PS
     RPC client attaches byte/retry counts as chrome-trace args here."""
-    if _prof.enabled:
+    if is_profiling():
         _record(name, start, end, cat, args)
 
 
@@ -134,19 +198,21 @@ def record_instant(name: str, cat: str = "host", args=None) -> None:
     cat='health' (args carry the step, the offending segment, and the
     action taken) so they land beside the cat='segment'/'window'/'rpc'
     spans in the chrome trace."""
-    if _prof.enabled:
+    if is_profiling():
         t = time.perf_counter()
         _record(name, t, t, cat, args)
 
 
 def snapshot_events():
     """Thread-safe copy of the recorded host events as plain dicts
-    (name/start/end/tid/cat/args) — for tests and bench lanes that
-    compute evidence from a live profile (e.g. the async-overlap
-    concurrency check) without stopping the profiler."""
+    (name/start/end/tid/cat/args + trace correlation ids) — for tests
+    and bench lanes that compute evidence from a live profile (e.g. the
+    async-overlap concurrency check) without stopping the profiler."""
     with _prof.lock:
         return [{"name": e.name, "start": e.start, "end": e.end,
-                 "tid": e.tid, "cat": e.cat, "args": e.args}
+                 "tid": e.tid, "cat": e.cat, "args": e.args,
+                 "trace_id": e.trace_id, "span_id": e.span_id,
+                 "parent_id": e.parent_id}
                 for e in _prof.events]
 
 
@@ -221,13 +287,19 @@ class RecordEvent:
             self._start = time.perf_counter()
             self._ann = jax.profiler.TraceAnnotation(self.name)
             self._ann.__enter__()
+        elif telemetry.shard_active():
+            # FLAGS_trace_dir shard-only mode: record the span without
+            # the jax device-trace annotation (no XPlane session is on)
+            self._start = time.perf_counter()
+            self._ann = None
         return self
 
     def __exit__(self, exc_type, exc_val, exc_tb):
         # gate on the per-span state, not the global flag: a stop_profiler
         # landing mid-span must not leak the entered TraceAnnotation
         if self._start:
-            self._ann.__exit__(exc_type, exc_val, exc_tb)
+            if self._ann is not None:
+                self._ann.__exit__(exc_type, exc_val, exc_tb)
             _record(self.name, self._start, time.perf_counter(), self.cat,
                     self.args)
             self._start = 0.0
@@ -290,8 +362,14 @@ def _write_chrome_trace(events: List[_Event], path: str):
             "name": e.name, "ph": "X", "pid": os.getpid(), "tid": e.tid,
             "ts": (e.start - _prof.t0) * 1e6,
             "dur": (e.end - e.start) * 1e6, "cat": e.cat}
-        if e.args:
-            ev["args"] = e.args
+        args = dict(e.args) if e.args else {}
+        if e.trace_id is not None:
+            args["trace_id"] = e.trace_id
+            args["span_id"] = e.span_id
+            if e.parent_id:
+                args["parent_id"] = e.parent_id
+        if args:
+            ev["args"] = args
         trace["traceEvents"].append(ev)
     d = os.path.dirname(path)
     if d:

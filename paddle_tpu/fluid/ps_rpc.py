@@ -53,6 +53,7 @@ Elastic membership (docs/FAULT_TOLERANCE.md "Elastic membership"):
 """
 from __future__ import annotations
 
+import contextlib
 import itertools
 import logging
 import os
@@ -69,6 +70,7 @@ import numpy as np
 
 from . import core
 from . import ps_membership
+from . import telemetry
 
 _LEN = struct.Struct(">Q")
 
@@ -299,6 +301,11 @@ class AckWindow:
     def inflight(self) -> int:
         with self._cv:
             return self._submitted - self._acked
+
+    def counts(self):
+        """(submitted, acked) — the round pipeline's stats() surface."""
+        with self._cv:
+            return self._submitted, self._acked
 
     def _raise_pending_locked(self):
         if self._error is not None:
@@ -592,6 +599,7 @@ class VarServer:
                  handlers: Dict[str, Callable[..., Any]],
                  legacy_wire: bool = False, membership=None):
         host, port = endpoint.rsplit(":", 1)
+        self._endpoint = endpoint
         self._handlers = handlers
         # elastic-membership hook (ps_membership.MembershipPlane):
         # consulted before dispatching data-plane methods so a server
@@ -654,11 +662,21 @@ class VarServer:
                             # wire negotiation: acknowledge and upgrade
                             # THIS connection; an old server (or a
                             # legacy_wire one) never reaches here and
-                            # answers "no method" below instead
+                            # answers "no method" below instead.
+                            # "mono" is the clock-offset half of the
+                            # handshake (docs/OBSERVABILITY.md): this
+                            # process's time.perf_counter() at reply
+                            # time — the client turns it into an
+                            # NTP-style offset estimate the timeline
+                            # merger uses to align trace shards. Old
+                            # clients ignore the extra key; old servers
+                            # never send it — compatible both ways.
                             if not outer._legacy_wire and \
                                     int(msg.get("version", 0)) >= 2:
                                 send({"ok": True,
-                                      "result": {"version": WIRE_VERSION}})
+                                      "result": {
+                                          "version": WIRE_VERSION,
+                                          "mono": time.perf_counter()}})
                                 proto = PROTO_BINARY
                             else:
                                 send({"ok": False,
@@ -673,6 +691,14 @@ class VarServer:
                         token = msg.pop("_dedup", None)
                         epoch = msg.pop("_view_epoch", None)
                         gview = msg.pop("_view", None)
+                        # distributed trace correlation
+                        # (docs/OBSERVABILITY.md): the caller's
+                        # (trace_id, span_id) header — installed around
+                        # handler execution so every span the handler
+                        # records carries the CALLER's trace id with a
+                        # server-minted span id parented on the
+                        # caller's rpc span
+                        trace = msg.pop("_trace", None)
                         try:
                             if method == "stats":
                                 nout = send({"ok": True,
@@ -686,6 +712,7 @@ class VarServer:
                                 kind, val = outer._dedup_begin(token)
                                 if kind == "done":
                                     outer._bump(method, replays=1)
+                                    outer._trace_replay(method, trace)
                                     nout = send(val)
                                     continue
                                 if kind == "pending":
@@ -694,6 +721,7 @@ class VarServer:
                                     # running — wait for ITS outcome,
                                     # never re-execute
                                     outer._bump(method, replays=1)
+                                    outer._trace_replay(method, trace)
                                     nout = send(
                                         outer._dedup_wait(token, val))
                                     continue
@@ -711,28 +739,53 @@ class VarServer:
                                 continue
                             _REQUEST.token = token
                             _REQUEST.server = outer
-                            try:
-                                if outer._membership is not None:
-                                    outer._membership.pre_dispatch(
-                                        method, epoch, gview)
-                                res = fn(**msg)
-                                resp = {"ok": True, "result": res}
-                            except Exception as e:  # surfaced to client
-                                # error_type lets the client re-raise
-                                # the TYPED exception (WorkerDeadError
-                                # survives the wire — tests/launchers
-                                # dispatch on it)
-                                resp = {"ok": False, "error": repr(e),
-                                        "error_type": type(e).__name__}
-                                if isinstance(
-                                        e, core.StaleClusterViewError):
-                                    # ship the server's newer view so
-                                    # the client can re-route + replay
-                                    resp["error_data"] = {
-                                        "view": e.view_dict}
-                            finally:
-                                _REQUEST.token = None
-                                _REQUEST.server = None
+                            tcm = (telemetry.trace_scope(
+                                       trace_id=trace[0],
+                                       parent_span_id=trace[1])
+                                   if trace else
+                                   contextlib.nullcontext())
+                            with tcm:
+                                t_handler = time.perf_counter()
+                                try:
+                                    if outer._membership is not None:
+                                        outer._membership.pre_dispatch(
+                                            method, epoch, gview)
+                                    res = fn(**msg)
+                                    resp = {"ok": True, "result": res}
+                                except Exception as e:  # to client
+                                    # error_type lets the client
+                                    # re-raise the TYPED exception
+                                    # (WorkerDeadError survives the
+                                    # wire — tests/launchers dispatch
+                                    # on it)
+                                    resp = {"ok": False,
+                                            "error": repr(e),
+                                            "error_type":
+                                                type(e).__name__}
+                                    if isinstance(
+                                            e,
+                                            core.StaleClusterViewError):
+                                        # ship the server's newer view
+                                        # so the client can re-route +
+                                        # replay
+                                        resp["error_data"] = {
+                                            "view": e.view_dict}
+                                finally:
+                                    _REQUEST.token = None
+                                    _REQUEST.server = None
+                                # handler span recorded INSIDE the
+                                # trace scope: it carries the caller's
+                                # trace id (the propagation tests pin
+                                # trainer rpc span → pserver handler
+                                # span linkage on this)
+                                from . import profiler as _profiler
+                                if _profiler.is_profiling():
+                                    _profiler.record_span(
+                                        f"rpc_handler:{method}",
+                                        t_handler, time.perf_counter(),
+                                        cat="rpc",
+                                        args={"ok": bool(
+                                            resp.get("ok"))})
                             if token is not None:
                                 outer._dedup_put(token, resp)
                             nout = send(resp)
@@ -892,6 +945,21 @@ class VarServer:
         if prev is not None and prev[0] == "pending":
             prev[1].set()
 
+    def _trace_replay(self, method: str, trace) -> None:
+        """A dedup replay answered without re-executing: record a
+        zero-duration marker carrying the caller's trace id so the
+        retry is FOLLOWABLE in the merged timeline — the trace shows
+        the same trace id landing twice with the second occurrence
+        marked as a replay (same trace id, new server-side span id)."""
+        from . import profiler as _profiler
+        if trace is None or not _profiler.is_profiling():
+            return
+        with telemetry.trace_scope(trace_id=trace[0],
+                                   parent_span_id=trace[1]):
+            _profiler.record_instant(
+                f"rpc_handler:{method}", cat="rpc",
+                args={"dedup_replay": True})
+
     def _bump(self, method: str, calls: int = 0, bytes_in: int = 0,
               bytes_out: int = 0, replays: int = 0) -> None:
         with self._stats_lock:
@@ -928,6 +996,19 @@ class VarServer:
         return self._srv.server_address[1]
 
     def start(self):
+        # metrics-registry view over stats() — the per-op counters,
+        # health trips, membership and prefetch sections all become
+        # scrape-able as ps_server_*{endpoint=...} gauges; the opt-in
+        # FLAGS_metrics_port sidecar makes them HTTP-reachable without
+        # the stats RPC (docs/OBSERVABILITY.md)
+        # label with the BOUND endpoint (an ephemeral ":0" construction
+        # endpoint resolves to the real port only after bind)
+        label_ep = (self._endpoint if not self._endpoint.endswith(":0")
+                    else f"{self._endpoint.rsplit(':', 1)[0]}:"
+                         f"{self.port}")
+        self._metrics_view = telemetry.REGISTRY.register_view(
+            "ps_server", self.stats, labels={"endpoint": label_ep})
+        telemetry.maybe_start_metrics_server()
         self._thread.start()
         return self
 
@@ -935,6 +1016,10 @@ class VarServer:
         return self._stop_evt.wait(timeout)
 
     def shutdown(self):
+        view = getattr(self, "_metrics_view", None)
+        if view is not None:
+            telemetry.REGISTRY.unregister_view(view)
+            self._metrics_view = None
         self._stop_evt.set()
         self._srv.shutdown()
         self._srv.server_close()
@@ -1065,6 +1150,11 @@ class VarClient:
         # shrink; a restart with fewer methods re-probes only after a
         # new VarClient)
         self._missing_methods: set = set()
+        # did the last _hello carry the telemetry fields (clock offset)?
+        # Gates the _trace header: a peer that never answered the
+        # telemetry hello would pass _trace straight into its handler
+        # as an unexpected kwarg — same wire-compat rule as _view_epoch
+        self._telemetry_ok = False
         # connect ONE channel eagerly: an unreachable pserver surfaces
         # now, and negotiation happens off the data path. The remaining
         # channels connect lazily on first concurrent use. Data-plane
@@ -1155,9 +1245,11 @@ class VarClient:
             if _pickle_wire_forced():
                 return
             try:
+                t_hello = time.perf_counter()
                 _send_msg(sock, {"method": "_hello",
                                  "version": WIRE_VERSION})
                 resp = _recv_msg(sock)
+                t_reply = time.perf_counter()
             except core.RpcProtocolError:
                 # a poisoned stream is NOT a transient connect failure —
                 # surface it typed, never retry into it
@@ -1171,6 +1263,21 @@ class VarClient:
             if resp.get("ok") and int((resp.get("result") or {})
                                       .get("version", 0)) >= 2:
                 ch.proto = PROTO_BINARY
+                mono = (resp.get("result") or {}).get("mono")
+                self._telemetry_ok = mono is not None
+                if mono is not None:
+                    # NTP-style single-sample offset: the server read
+                    # its perf_counter ~rtt/2 after we sent — offset =
+                    # peer clock minus ours at the same instant. Keyed
+                    # by the PHYSICAL endpoint (what the server's trace
+                    # shard is labeled with); timeline merge consumes
+                    # it via the shard metadata.
+                    rtt = t_reply - t_hello
+                    telemetry.note_clock_offset(
+                        target,
+                        float(mono) - (t_hello + rtt / 2.0), rtt)
+            else:
+                self._telemetry_ok = False
             return
         ch.close()
         raise ConnectionError(
@@ -1264,6 +1371,18 @@ class VarClient:
                 # it lands.
                 msg["_view_epoch"] = cur_view.epoch
                 msg["_view"] = cur_view.to_dict()
+        # trace correlation: each call is its own child span of the
+        # caller's context; the (trace_id, span_id) header rides the
+        # ENCODED frame, so a dedup retry or stale-view re-route
+        # replays the SAME trace/span ids — the server mints fresh
+        # handler span ids per execution. Gated on the hello-probed
+        # capability (old peers would choke on the kwarg) exactly like
+        # the _view_epoch stamp.
+        tscope = None
+        if self._telemetry_ok and telemetry.current_trace() is not None:
+            tscope = telemetry.trace_scope()
+            tctx = tscope.__enter__()
+            msg["_trace"] = (tctx.trace_id, tctx.span_id)
         if method not in self._IDEMPOTENT:
             msg["_dedup"] = (self._token_prefix, next(self._seq))
         frames: Dict[int, tuple] = {}  # proto -> (parts, nbytes)
@@ -1294,6 +1413,16 @@ class VarClient:
                             ch, self._connect_timeout if rem is None
                             else max(0.05, min(self._connect_timeout,
                                                rem)))
+                        if "_trace" in msg and not self._telemetry_ok:
+                            # mid-call failover/re-route landed on a
+                            # peer that never advertised telemetry in
+                            # its hello: strip the header and re-encode
+                            # or fn(**msg) dies on the unexpected kwarg
+                            # (the _view_epoch wire-compat rule). The
+                            # dedup token is untouched — exactly-once
+                            # is unaffected by the re-encode.
+                            msg.pop("_trace")
+                            frames.clear()
                     ch.sock.settimeout(
                         deadline_s if rem is None
                         else max(0.05, min(deadline_s, rem)))
@@ -1402,8 +1531,12 @@ class VarClient:
             if brk is not None:
                 {"ok": brk.record_success, "fail": brk.record_failure,
                  "neutral": brk.record_neutral}[brk_outcome]()
+            # recorded INSIDE the call's trace scope so the client rpc
+            # span carries the span id the server parented on
             _record_rpc_span(method, kwargs.get("name"), self.endpoint,
                              t_start, bytes_out, bytes_in, attempt)
+            if tscope is not None:
+                tscope.__exit__(None, None, None)
         if not resp.get("ok"):
             err = resp.get("error")
             etype = _WIRE_ERRORS.get(resp.get("error_type"))

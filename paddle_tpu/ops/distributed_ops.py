@@ -64,9 +64,16 @@ def _fanout(tasks):
             _FANOUT_POOL = ThreadPoolExecutor(
                 max_workers=8, thread_name_prefix="ps-fanout")
     from ..fluid import ps_rpc as _ps_rpc
+    from ..fluid import telemetry as _telemetry
     budget = _ps_rpc.current_call_budget()
-    if budget is not None:
-        tasks = [(lambda t=t: _run_budgeted(t, budget)) for t in tasks]
+    # the submitting thread's TRACE context rides along with its budget:
+    # every sharded section RPC of one lookup must carry the same trace
+    # id or the pserver-side handler spans fall out of the request's
+    # timeline (docs/OBSERVABILITY.md)
+    tctx = _telemetry.current_trace()
+    if budget is not None or tctx is not None:
+        tasks = [(lambda t=t: _run_budgeted(t, budget, tctx))
+                 for t in tasks]
     futs = [_FANOUT_POOL.submit(t) for t in tasks]
     results, first_err = [], None
     for f in futs:
@@ -81,9 +88,13 @@ def _fanout(tasks):
     return results
 
 
-def _run_budgeted(task, budget):
+def _run_budgeted(task, budget, tctx=None):
     from ..fluid import ps_rpc as _ps_rpc
-    with _ps_rpc.call_budget(budget):
+    from ..fluid import telemetry as _telemetry
+    import contextlib
+    tcm = (_telemetry.trace_scope(adopt=tctx) if tctx is not None
+           else contextlib.nullcontext())
+    with tcm, _ps_rpc.call_budget(budget):
         return task()
 
 
@@ -1543,6 +1554,12 @@ def _listen_and_serv(ins, attrs):
                 "epoch": new_view.epoch}
 
     monitor.start_monitor()
+    # cluster-timeline identity (docs/OBSERVABILITY.md): label this
+    # process's trace shard with its pserver bind so the timeline
+    # merger can match it against the clock offsets trainers measured
+    # in the _hello handshake (PADDLE_TPU_TRACE_ROLE env still wins)
+    from ..fluid import telemetry as _telemetry
+    _telemetry.set_process_role(f"pserver-{bind}", endpoint=bind)
     srv_box = []
     srv = VarServer(bind, {
         "send_var": h_send_var, "send_vars_batch": h_send_vars_batch,

@@ -46,14 +46,19 @@ class Request:
     the async submit path."""
 
     __slots__ = ("rows", "n", "t_submit", "t_dispatch", "t_done",
-                 "deadline", "degraded", "admin", "_event", "_result",
-                 "_error")
+                 "deadline", "degraded", "admin", "trace", "_event",
+                 "_result", "_error")
 
     def __init__(self, rows: Dict[str, np.ndarray], n: int,
                  deadline: Optional[float] = None,
                  admin: bool = False):
         self.rows = rows
         self.n = int(n)
+        # trace correlation (telemetry.trace_scope): the submitting
+        # thread's context, re-installed by the worker around this
+        # request's queue_wait/exec spans and PS sparse fetches so the
+        # HTTP X-Trace-Id follows the request across the thread hop
+        self.trace = None
         self.t_submit = time.perf_counter()
         self.t_dispatch = 0.0
         self.t_done = 0.0  # stamped at fulfilment (open-loop latency)

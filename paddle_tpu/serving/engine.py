@@ -41,6 +41,7 @@ lookups issue zero RPCs (docs/SERVING.md staleness caveat applies).
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -48,9 +49,14 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from contextlib import nullcontext as _nullcontext
+
 from .batching import BatchingQueue, Request, next_bucket
 
 __all__ = ["ServingEngine", "percentiles_ms"]
+
+# default telemetry labels: engine0, engine1, ... per process lifetime
+_ENGINE_SERIAL = itertools.count()
 
 
 def percentiles_ms(vals_s, qs=(50, 99), suffix: str = "") -> Dict[str, float]:
@@ -77,11 +83,13 @@ class ServingEngine:
                  max_queue_delay_ms: float = 2.0,
                  batch_mode: Optional[str] = None,
                  embedding_cache=None, seed: int = 0,
-                 admission=None, default_deadline_s: float = None):
+                 admission=None, default_deadline_s: float = None,
+                 name: Optional[str] = None):
         import jax
         import paddle_tpu.fluid as fluid
         from paddle_tpu.fluid import core
         from paddle_tpu.fluid import executor as executor_mod
+        from paddle_tpu.fluid import telemetry as _telemetry
 
         if predictor is not None:
             program = predictor._program
@@ -167,15 +175,41 @@ class ServingEngine:
         self._codel_above_since: Optional[float] = None
 
         # ---- stats --------------------------------------------------
+        # The scalar counters live in the telemetry REGISTRY (PR 10,
+        # docs/OBSERVABILITY.md), labeled by engine name; stats() reads
+        # them back, so the dict API is a VIEW over the registry and
+        # GET /metrics can never drift from stats(). Histograms /
+        # latency deques stay engine-local (they reset with
+        # reset_stats and are exposed through the stats view).
+        self.name = name if name else f"engine{next(_ENGINE_SERIAL)}"
+        self._telemetry = _telemetry
+        reg = _telemetry.REGISTRY
+        label = {"engine": self.name}
+
+        self._m_families = []
+
+        def _counter(cname, help):
+            fam = reg.counter(cname, help, labelnames=("engine",))
+            self._m_families.append(fam)
+            return fam.labels(**label)
+        self._m_requests = _counter(
+            "serving_requests_total", "requests answered OK")
+        self._m_rows = _counter(
+            "serving_rows_total", "rows answered OK")
+        self._m_batches = _counter(
+            "serving_batches_total", "buckets dispatched")
+        self._m_errors = _counter(
+            "serving_errors_total", "worker-loop execution errors")
+        self._m_shed = _counter(
+            "serving_shed_total",
+            "admission-bound + CoDel drops (typed 429s)")
+        self._m_deadline_expired = _counter(
+            "serving_deadline_expired_total", "typed 504s")
+        self._m_degraded = _counter(
+            "serving_degraded_total",
+            "requests served from beyond-TTL stale cache rows")
         self._stats_lock = threading.Lock()
         self._t_start = time.perf_counter()
-        self._n_requests = 0
-        self._n_rows = 0
-        self._n_batches = 0
-        self._n_errors = 0
-        self._n_shed = 0              # admission-bound + CoDel drops (429)
-        self._n_deadline_expired = 0  # typed 504s
-        self._n_degraded = 0          # requests served from stale cache
         self._batch_hist: Dict[int, int] = {}
         self._bucket_hist: Dict[int, int] = {}
         self._buckets_seen: set = set()  # survives reset_stats
@@ -206,6 +240,21 @@ class ServingEngine:
         # engine) — all subsequent lookups would silently serve stale
         self.embedding_cache = embedding_cache
         self._cache_installed = False
+        # registry views (docs/OBSERVABILITY.md): queue depth gauges +
+        # the embedding cache's stats() dict, labeled by engine —
+        # /metrics exposes serving_engine_queue_rows{engine=...} and
+        # serving_cache_hits{engine=...} beside the counters above
+        self._metrics_views = [
+            _telemetry.REGISTRY.register_view(
+                "serving_engine",
+                lambda: {"queue_rows": len(self._queue),
+                         "outstanding_rows": self.outstanding_rows()},
+                labels={"engine": self.name})]
+        if embedding_cache is not None:
+            self._metrics_views.append(
+                _telemetry.REGISTRY.register_view(
+                    "serving_cache", embedding_cache.stats,
+                    labels={"engine": self.name}))
         if embedding_cache is not None:
             from paddle_tpu.fluid import ps_rpc
             self._cache_prev = ps_rpc.install_row_cache(embedding_cache)
@@ -290,14 +339,15 @@ class ServingEngine:
             raise RuntimeError("ServingEngine is closed")
         rows, n = self._normalize(feed, many)
         if not _admit:
-            return self._queue.submit(Request(rows, n, admin=True))
+            req = Request(rows, n, admin=True)
+            req.trace = self._telemetry.current_trace()
+            return self._queue.submit(req)
         if deadline_s is None:
             deadline_s = self._default_deadline_s
         deadline = None
         if deadline_s is not None:
             if deadline_s <= 0:
-                with self._stats_lock:
-                    self._n_deadline_expired += 1
+                self._m_deadline_expired.inc()
                 raise self._core.DeadlineExceededError(
                     f"request budget {deadline_s * 1e3:.0f}ms already "
                     f"spent at submit", queue_wait_s=0.0)
@@ -307,13 +357,17 @@ class ServingEngine:
                 self._admission.admit(n, self.outstanding_rows(),
                                       self._recent_row_rate())
             except self._core.OverloadedError:
-                with self._stats_lock:
-                    self._n_shed += 1
+                self._m_shed.inc()
                 _profiler.record_instant(
                     "serve:shed", cat="serve",
                     args={"rows": n, "where": "admission"})
                 raise
-        return self._queue.submit(Request(rows, n, deadline=deadline))
+        req = Request(rows, n, deadline=deadline)
+        # the submitting thread's trace context follows the request to
+        # the worker (the HTTP X-Trace-Id → queue_wait/exec/PS-fetch
+        # span linkage)
+        req.trace = self._telemetry.current_trace()
+        return self._queue.submit(req)
 
     def predict(self, feed: Dict[str, Any],
                 timeout: Optional[float] = 120.0,
@@ -353,8 +407,7 @@ class ServingEngine:
                     # spurious error for a client that hasn't woken yet
                     if not r.done():
                         r.set_error(e)
-                with self._stats_lock:
-                    self._n_errors += 1
+                self._m_errors.inc()
 
     def _expire_or_shed(self, reqs: List[Request],
                         t_take: float) -> List[Request]:
@@ -372,13 +425,18 @@ class ServingEngine:
         for r in reqs:
             if r.deadline is not None and t_take >= r.deadline:
                 wait = t_take - r.t_submit
-                _profiler.record_span(
-                    "serve:queue_wait", r.t_submit, t_take, cat="serve",
-                    args={"rows": r.n, "expired": True})
-                _profiler.record_instant(
-                    "serve:deadline_expired", cat="serve",
-                    args={"rows": r.n,
-                          "queue_wait_ms": round(wait * 1e3, 3)})
+                # expiry evidence recorded under the REQUEST's trace so
+                # a 504's queue_wait span is findable by X-Trace-Id
+                with self._telemetry.trace_scope(adopt=r.trace) \
+                        if r.trace else _nullcontext():
+                    _profiler.record_span(
+                        "serve:queue_wait", r.t_submit, t_take,
+                        cat="serve",
+                        args={"rows": r.n, "expired": True})
+                    _profiler.record_instant(
+                        "serve:deadline_expired", cat="serve",
+                        args={"rows": r.n,
+                              "queue_wait_ms": round(wait * 1e3, 3)})
                 r.set_error(self._core.DeadlineExceededError(
                     f"deadline expired after {wait * 1e3:.1f}ms in the "
                     f"admission queue", queue_wait_s=wait))
@@ -386,8 +444,7 @@ class ServingEngine:
                 continue
             live.append(r)
         if n_expired:
-            with self._stats_lock:
-                self._n_deadline_expired += n_expired
+            self._m_deadline_expired.inc(n_expired)
 
         adm = self._admission
         if adm is not None and live:
@@ -414,8 +471,7 @@ class ServingEngine:
                     f"{sojourn * 1e3:.1f}ms queued (target "
                     f"{adm.codel_target_s * 1e3:.0f}ms)",
                     retry_after_s=self.retry_after_s()))
-                with self._stats_lock:
-                    self._n_shed += 1
+                self._m_shed.inc()
                 _profiler.record_instant(
                     "serve:shed", cat="serve",
                     args={"rows": head.n, "where": "codel",
@@ -439,6 +495,20 @@ class ServingEngine:
 
     def _dispatch(self, reqs: List[Request], t_take: float,
                   n_valid: int, bucket: int):
+        # the bucket is ONE dispatch, so it runs under the FIRST
+        # member's trace (new span parented on the request's HTTP/
+        # submit span); every member's trace id is listed on the exec
+        # span args — the documented batching caveat of trace
+        # correlation (docs/OBSERVABILITY.md)
+        tr = reqs[0].trace
+        if tr is None:
+            return self._dispatch_inner(reqs, t_take, n_valid, bucket)
+        with self._telemetry.trace_scope(trace_id=tr.trace_id,
+                                         parent_span_id=tr.span_id):
+            return self._dispatch_inner(reqs, t_take, n_valid, bucket)
+
+    def _dispatch_inner(self, reqs: List[Request], t_take: float,
+                        n_valid: int, bucket: int):
         from paddle_tpu.fluid import profiler as _profiler
         from paddle_tpu.fluid import ps_rpc as _ps_rpc
         from . import admission as _admission_mod
@@ -513,25 +583,30 @@ class ServingEngine:
             # flagged (a 200 with a warning label, never a 5xx)
             for r in reqs:
                 r.degraded = True
-            with self._stats_lock:
-                self._n_degraded += len(reqs)
+            self._m_degraded.inc(len(reqs))
             _profiler.record_instant(
                 "serve:degraded", cat="serve",
                 args={"requests": len(reqs), "stale_rows": dg.count})
+        exec_args = {"bucket": bucket, "n_valid": n_valid,
+                     "mode": self.batch_mode}
+        member_traces = [r.trace.trace_id for r in reqs
+                         if r.trace is not None]
+        if member_traces:
+            # every bucket member is findable from the one exec span
+            exec_args["trace_ids"] = member_traces[:32]
         _profiler.record_span(
             f"serve:exec[{bucket}]", t0, t1, cat="serve",
-            args={"bucket": bucket, "n_valid": n_valid,
-                  "mode": self.batch_mode})
+            args=exec_args)
 
         i0 = 0
         for r in reqs:
             r.set_result([o[i0:i0 + r.n] for o in outs])
             i0 += r.n
         t_done = time.perf_counter()
+        self._m_requests.inc(len(reqs))
+        self._m_rows.inc(n_valid)
+        self._m_batches.inc()
         with self._stats_lock:
-            self._n_requests += len(reqs)
-            self._n_rows += n_valid
-            self._n_batches += 1
             self._batch_hist[n_valid] = \
                 self._batch_hist.get(n_valid, 0) + 1
             self._bucket_hist[bucket] = \
@@ -568,15 +643,17 @@ class ServingEngine:
             done = list(self._done)
             window = [d for d in done if now - d[0] <= 60.0]
             span = (now - min(d[0] for d in window)) if window else 0.0
+            n_rows = self._m_rows.value()
+            n_batches = self._m_batches.value()
             st = {
-                "requests": self._n_requests,
-                "rows": self._n_rows,
-                "batches": self._n_batches,
-                "errors": self._n_errors,
+                "requests": self._m_requests.value(),
+                "rows": n_rows,
+                "batches": n_batches,
+                "errors": self._m_errors.value(),
                 "uptime_s": now - self._t_start,
                 "qps": (len(window) / span) if span > 1e-9 else 0.0,
-                "avg_batch": (self._n_rows / self._n_batches
-                              if self._n_batches else 0.0),
+                "avg_batch": (n_rows / n_batches
+                              if n_batches else 0.0),
                 "batch_size_hist": dict(sorted(self._batch_hist.items())),
                 "bucket_hist": dict(sorted(self._bucket_hist.items())),
                 "latency_ms": self._pct([d[1] for d in done]),
@@ -588,9 +665,9 @@ class ServingEngine:
                 # overload/degrade evidence surface (docs/SERVING.md
                 # "Ingress & overload"): sheds (admission bound +
                 # CoDel), typed 504s, degraded responses
-                "shed": self._n_shed,
-                "deadline_expired": self._n_deadline_expired,
-                "degraded": self._n_degraded,
+                "shed": self._m_shed.value(),
+                "deadline_expired": self._m_deadline_expired.value(),
+                "degraded": self._m_degraded.value(),
                 "queue_rows": len(self._queue),
             }
         # per-endpoint circuit breakers (ps_rpc): open count + states
@@ -609,10 +686,10 @@ class ServingEngine:
         the reported histogram covers only the measured window)."""
         with self._stats_lock:
             self._t_start = time.perf_counter()
-            self._n_requests = self._n_rows = self._n_batches = 0
-            self._n_errors = 0
-            self._n_shed = self._n_deadline_expired = 0
-            self._n_degraded = 0
+            for m in (self._m_requests, self._m_rows, self._m_batches,
+                      self._m_errors, self._m_shed,
+                      self._m_deadline_expired, self._m_degraded):
+                m._reset()
             self._batch_hist.clear()
             self._bucket_hist.clear()
             self._done.clear()
@@ -649,6 +726,16 @@ class ServingEngine:
             from paddle_tpu.fluid import ps_rpc
             ps_rpc.install_row_cache(self._cache_prev)
             self._cache_installed = False
+        for v in self._metrics_views:
+            self._telemetry.REGISTRY.unregister_view(v)
+        self._metrics_views = []
+        # drop this engine's labeled counter children too — a process
+        # that cycles engines (reloads, test suites) must not export
+        # frozen series for engines that no longer exist; the engine's
+        # own stats() keeps working through its child references
+        for fam in self._m_families:
+            fam.remove(engine=self.name)
+        self._m_families = []
 
     def __enter__(self):
         return self

@@ -56,6 +56,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from paddle_tpu.fluid import core
+from paddle_tpu.fluid import telemetry
 from .admission import TokenBucket
 
 __all__ = ["ServingIngress"]
@@ -140,6 +141,13 @@ class ServingIngress:
         return f"http://{host}:{self.port}"
 
     def start(self) -> "ServingIngress":
+        # Prometheus surface (docs/OBSERVABILITY.md): the ingress's own
+        # counters join the registry so GET /metrics (served below and
+        # on the optional FLAGS_metrics_port sidecar) exposes them
+        # beside every model engine's counters/views
+        self._metrics_view = telemetry.REGISTRY.register_view(
+            "serving_ingress", lambda: self.stats()["ingress"])
+        telemetry.maybe_start_metrics_server()
         self._thread.start()
         return self
 
@@ -176,6 +184,10 @@ class ServingIngress:
                         self._inflight, self._drain_timeout_s)
                     break
                 self._inflight_cv.wait(min(left, 0.5))
+        view = getattr(self, "_metrics_view", None)
+        if view is not None:
+            telemetry.REGISTRY.unregister_view(view)
+            self._metrics_view = None
         self._srv.shutdown()
         self._srv.server_close()
 
@@ -253,6 +265,13 @@ class ServingIngress:
                 body = _json_bytes(obj)
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
+                trace_id = getattr(self, "_trace_id", None)
+                if trace_id:
+                    # round-trip contract: every /predict response —
+                    # 200, 429, 504, 400 alike — names the trace id the
+                    # request ran under, minted here when the client
+                    # sent none
+                    self.send_header("X-Trace-Id", trace_id)
                 self.send_header("Content-Length", str(len(body)))
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
@@ -272,6 +291,10 @@ class ServingIngress:
 
             # --------------------------------------------------- GETs
             def do_GET(self):
+                # a keep-alive connection reuses this handler object:
+                # a previous /predict's trace id must not leak onto an
+                # unrelated GET response
+                self._trace_id = None
                 if self.path == "/healthz":
                     # liveness: a draining pod is alive, just not ready
                     self._reply(200, {"status": "ok"})
@@ -286,6 +309,20 @@ class ServingIngress:
                     return
                 if self.path == "/stats":
                     self._reply(200, outer.stats())
+                    return
+                if self.path == "/metrics":
+                    # Prometheus text exposition over the process
+                    # registry — counters here are the SAME objects
+                    # stats() reads, so the two surfaces cannot drift
+                    body = telemetry.REGISTRY.exposition() \
+                        .encode("utf-8")
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 outer._bump("not_found_404")
                 self._reply(404, {"error": "not_found",
@@ -304,6 +341,15 @@ class ServingIngress:
 
             def _predict(self):
                 outer._bump("requests")
+                # trace correlation (docs/OBSERVABILITY.md): accept the
+                # caller's X-Trace-Id (sanitized) or mint one; the
+                # request executes under it and every response carries
+                # it back
+                hdr = self.headers.get("X-Trace-Id")
+                if hdr:
+                    hdr = "".join(ch for ch in hdr.strip()[:64]
+                                  if ch.isalnum() or ch in "-_")
+                self._trace_id = hdr or telemetry.new_trace_id()
                 # consume the body FIRST: an early error return (404,
                 # 429) that leaves it unread would desync the
                 # keep-alive stream — the next request line would parse
@@ -385,11 +431,16 @@ class ServingIngress:
                         return
 
                 t0 = time.perf_counter()
+                wait_s = (120.0 if deadline_s is None
+                          else deadline_s + 5.0)
                 try:
-                    req = eng.submit(feed, many=many,
-                                     deadline_s=deadline_s)
-                    wait_s = (120.0 if deadline_s is None
-                              else deadline_s + 5.0)
+                    # the submit runs under the request's trace: the
+                    # engine stamps it on the Request, and the worker
+                    # re-installs it around queue_wait/exec spans and
+                    # the PS sparse fetches
+                    with telemetry.trace_scope(trace_id=self._trace_id):
+                        req = eng.submit(feed, many=many,
+                                         deadline_s=deadline_s)
                     outs = req.wait(wait_s)
                 except core.OverloadedError as e:
                     outer._bump("shed_429")

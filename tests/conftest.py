@@ -56,6 +56,14 @@ def pytest_configure(config):
         "also carry 'slow'. Subprocesses run JAX_PLATFORMS=cpu, so "
         "PADDLE_TPU_TEST_SHARD file-level sharding applies unchanged.")
     config.addinivalue_line(
+        "markers", "obs: unified-telemetry-plane suite "
+        "(fluid/telemetry.py + tools/timeline.py merge — trace "
+        "propagation, metrics registry/exposition, trace shards; "
+        "tests/test_telemetry.py). In-process tests stay in the tier-1 "
+        "non-slow set; the multiprocess timeline-merge acceptance also "
+        "carries 'slow'. Subprocesses run JAX_PLATFORMS=cpu, so "
+        "PADDLE_TPU_TEST_SHARD file-level sharding applies unchanged.")
+    config.addinivalue_line(
         "markers", "rpcbench: PS-RPC data-plane microbench smoke "
         "(tools/rpc_microbench.py loopback sweep at tiny sizes — the "
         "full 4KB..64MB run is a manual tool invocation). In-process "

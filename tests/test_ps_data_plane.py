@@ -362,8 +362,17 @@ def test_rpc_spans_land_in_chrome_trace_with_byte_counts(tmp_path):
         cli.get_var("w")
         profiler.stop_profiler(profile_path=path)
         trace = json.load(open(path))
-        rpc = [e for e in trace["traceEvents"] if e.get("cat") == "rpc"]
+        # an in-process server shares the profiler: its PR 10 handler
+        # spans (rpc_handler:*) land beside the client spans — split
+        all_rpc = [e for e in trace["traceEvents"]
+                   if e.get("cat") == "rpc"]
+        handler = [e for e in all_rpc
+                   if e["name"].startswith("rpc_handler:")]
+        rpc = [e for e in all_rpc
+               if not e["name"].startswith("rpc_handler:")]
         assert len(rpc) == 2, trace["traceEvents"]
+        assert sorted(e["name"] for e in handler) == \
+            ["rpc_handler:get_var", "rpc_handler:send_var"]
         names = sorted(e["name"] for e in rpc)
         assert names == [f"get_var:w@{ep}", f"send_var:w@{ep}"]
         for e in rpc:

@@ -1,0 +1,733 @@
+"""Unified telemetry plane tests (docs/OBSERVABILITY.md).
+
+Covers the three legs of ISSUE 10:
+  * distributed trace correlation — trace_scope semantics, profiler
+    stamping, RPC header propagation (client rpc span ↔ VarServer
+    handler span linkage), dedup-retry replays and stale-view
+    re-routes keeping the trace id, HTTP X-Trace-Id round trips;
+  * metrics registry — primitives, stats-dict views, Prometheus
+    exposition, GET /metrics == stats() on a live ingress, the opt-in
+    sidecar server;
+  * merged cluster timelines — FLAGS_trace_dir shard streaming (ring
+    bound + metadata), hello clock-offset capture, tools/timeline.py
+    merge clock correction and trace-id filtering.
+
+In-process tests stay tier-1 non-slow; the 2-trainer×2-pserver
+wide_deep timeline acceptance also carries `slow`.
+"""
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.obs
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_isolation():
+    """Flags restored; the shard writer and clock offsets reset so one
+    test's FLAGS_trace_dir can't leak into the next."""
+    from paddle_tpu.fluid import core, telemetry
+    from paddle_tpu.fluid.ps_rpc import VarClient
+
+    saved = {k: core.globals_[k] for k in
+             ("FLAGS_trace_dir", "FLAGS_trace_shard_max_events",
+              "FLAGS_profiler_max_events", "FLAGS_metrics_port")}
+    yield
+    for k, v in saved.items():
+        core.globals_[k] = v
+    telemetry.reset_trace_shard()
+    telemetry.reset_clock_offsets()
+    VarClient.reset_pool()
+
+
+# ======================================================================
+# metrics registry
+# ======================================================================
+def test_registry_primitives_labels_and_exposition():
+    from paddle_tpu.fluid.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "requests", labelnames=("code",))
+    c.labels(code="200").inc()
+    c.labels(code="200").inc(2)
+    c.labels(code="429").inc()
+    g = reg.gauge("depth")
+    g.set(7)
+    h = reg.histogram("lat_s", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(9.0)
+
+    assert c.value(code="200") == 3
+    assert c.value(code="429") == 1
+    assert g.value() == 7
+
+    text = reg.exposition()
+    assert '# TYPE req_total counter' in text
+    assert 'req_total{code="200"} 3' in text
+    assert 'req_total{code="429"} 1' in text
+    assert "depth 7" in text
+    assert 'lat_s_bucket{le="0.1"} 1' in text
+    assert 'lat_s_bucket{le="1.0"} 2' in text
+    assert 'lat_s_bucket{le="+Inf"} 3' in text
+    assert "lat_s_count 3" in text
+
+    # kind/label conflicts are refused, get-or-create is idempotent
+    assert reg.counter("req_total", labelnames=("code",)) is c
+    with pytest.raises(ValueError):
+        reg.gauge("req_total")
+    with pytest.raises(ValueError):
+        reg.counter("req_total", labelnames=("other",))
+
+
+def test_registry_view_exposes_stats_dict_numbers_exactly():
+    """A registered view's numeric leaves surface as gauges whose
+    values equal the dict's EXACTLY (floats repr-round-trip); strings
+    and lists are skipped — the dict API stays authoritative."""
+    from paddle_tpu.fluid.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    stats = {"shed": 17, "hit_rate": 0.8749999731,
+             "nested": {"p99": 12.5}, "mode": "scan",
+             "buckets": [1, 2, 4], "flag": True}
+    reg.register_view("eng", lambda: stats, labels={"engine": "e0"})
+    got = reg.collect()
+    assert got["eng_shed"]["samples"] == [({"engine": "e0"}, 17)]
+    assert got["eng_hit_rate"]["samples"][0][1] == stats["hit_rate"]
+    assert got["eng_nested_p99"]["samples"][0][1] == 12.5
+    assert got["eng_flag"]["samples"][0][1] == 1
+    assert "eng_mode" not in got and "eng_buckets" not in got
+    # text round trip preserves the float bits
+    text = reg.exposition()
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("eng_hit_rate")][0]
+    assert float(line.split()[-1]) == stats["hit_rate"]
+    # a raising view is skipped, never breaks the scrape
+    reg.register_view("bad", lambda: 1 / 0)
+    assert "eng_shed" in reg.exposition()
+
+
+def test_trace_scope_root_child_adopt_and_cross_process_form():
+    from paddle_tpu.fluid import telemetry as T
+
+    assert T.current_trace() is None
+    with T.trace_scope() as root:
+        assert root.parent_id is None
+        with T.trace_scope() as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+            assert child.span_id != root.span_id
+        # cross-process adoption: same trace id, NEW span id
+        with T.trace_scope(trace_id="t123",
+                           parent_span_id="s456") as remote:
+            assert (remote.trace_id, remote.parent_id) == ("t123",
+                                                           "s456")
+        # verbatim adoption (fan-out pool threads)
+        with T.trace_scope(adopt=root) as same:
+            assert same is root
+        assert T.current_trace() is root
+    assert T.current_trace() is None
+
+
+def test_profiler_stamps_trace_ids_and_ring_bounds_events():
+    from paddle_tpu.fluid import core, profiler, telemetry
+
+    core.globals_["FLAGS_profiler_max_events"] = 4
+    profiler.start_profiler("CPU")
+    try:
+        with telemetry.trace_scope() as ctx:
+            profiler.record_instant("traced")
+        for i in range(6):
+            profiler.record_instant(f"fill{i}")
+        evs = profiler.snapshot_events()
+        assert len(evs) == 4  # ring bound
+        assert profiler.dropped_events() == 3
+        assert all(e["trace_id"] is None for e in evs)  # traced dropped
+        profiler.reset_profiler()
+        with telemetry.trace_scope() as ctx:
+            profiler.record_instant("traced2")
+        (ev,) = profiler.snapshot_events()
+        assert ev["trace_id"] == ctx.trace_id
+        assert ev["span_id"] == ctx.span_id
+    finally:
+        profiler.stop_profiler(profile_path="")
+
+
+# ======================================================================
+# RPC propagation
+# ======================================================================
+def test_rpc_trace_propagates_to_handler_spans_and_offsets_recorded():
+    """The tentpole contract in one process: a traced client call's
+    rpc span and the server's handler span share the trace id; the
+    handler span is a NEW span parented on the client's rpc span; the
+    _hello handshake recorded a clock offset for the endpoint."""
+    from paddle_tpu.fluid import profiler, telemetry
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+
+    srv = VarServer("127.0.0.1:0", {"echo": lambda x=0: x + 1}).start()
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        cli = VarClient(ep)
+        assert cli._telemetry_ok
+        off = telemetry.clock_offsets()[ep]
+        assert abs(off[0]) < 5.0 and 0 < off[1] < 5.0  # same host
+        profiler.start_profiler("CPU")
+        try:
+            with telemetry.trace_scope() as ctx:
+                assert cli.call("echo", x=1) == 2
+            rpc = [e for e in profiler.snapshot_events()
+                   if e["cat"] == "rpc"]
+            client_span = next(e for e in rpc
+                               if e["name"].startswith("echo"))
+            handler = next(e for e in rpc
+                           if e["name"] == "rpc_handler:echo")
+            assert client_span["trace_id"] == ctx.trace_id
+            assert handler["trace_id"] == ctx.trace_id
+            assert handler["parent_id"] == client_span["span_id"]
+            assert handler["span_id"] != client_span["span_id"]
+            assert handler["args"]["ok"] is True
+            # untraced calls stamp nothing
+            cli.call("echo", x=5)
+            handlers = [e for e in profiler.snapshot_events()
+                        if e["name"] == "rpc_handler:echo"]
+            assert handlers[-1]["trace_id"] is None
+        finally:
+            profiler.stop_profiler(profile_path="")
+    finally:
+        srv.shutdown()
+
+
+def test_legacy_peers_keep_working_without_trace_or_offset():
+    """Both compat directions of the hello extension: an old-frame
+    server (rejects _hello) never sees _trace and records no offset; a
+    legacy-pinned client (PADDLE_TPU_PS_PICKLE_WIRE=1) never probes and
+    still interoperates — traced calls succeed in both cases."""
+    from paddle_tpu.fluid import telemetry
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+
+    seen = []
+
+    def echo(x=0, **kw):
+        seen.append(sorted(kw))
+        return x + 1
+
+    srv = VarServer("127.0.0.1:0", {"echo": echo},
+                    legacy_wire=True).start()
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        cli = VarClient(ep)
+        assert not cli._telemetry_ok
+        assert ep not in telemetry.clock_offsets()
+        with telemetry.trace_scope():
+            assert cli.call("echo", x=1) == 2
+        assert seen == [[]]  # no _trace kwarg leaked into the handler
+    finally:
+        srv.shutdown()
+
+    os.environ["PADDLE_TPU_PS_PICKLE_WIRE"] = "1"
+    try:
+        srv2 = VarServer("127.0.0.1:0",
+                         {"echo": lambda x=0: x + 1}).start()
+        ep2 = f"127.0.0.1:{srv2.port}"
+        cli2 = VarClient(ep2)
+        assert not cli2._telemetry_ok
+        with telemetry.trace_scope():
+            assert cli2.call("echo", x=3) == 4
+        srv2.shutdown()
+    finally:
+        os.environ.pop("PADDLE_TPU_PS_PICKLE_WIRE", None)
+
+
+def test_dedup_retry_replays_same_trace_id_with_new_span_id():
+    """A PR 3 retry (same dedup token) executes ONCE; the replay is
+    still followable: the server records a replay marker carrying the
+    SAME trace id with a fresh server-side span id."""
+    from paddle_tpu.fluid import profiler
+    from paddle_tpu.fluid import ps_rpc
+    from paddle_tpu.fluid.ps_rpc import VarServer, _send_msg, _recv_msg
+
+    calls = []
+    srv = VarServer("127.0.0.1:0",
+                    {"bump": lambda: calls.append(1) or True}).start()
+    profiler.start_profiler("CPU")
+    try:
+        def raw_call(msg):
+            s = socket.create_connection(("127.0.0.1", srv.port), 5.0)
+            try:
+                _send_msg(s, dict(msg))
+                return _recv_msg(s)
+            finally:
+                s.close()
+
+        msg = {"method": "bump", "_dedup": ("cliX", 0),
+               "_trace": ("traceT", "spanS")}
+        r1 = raw_call(msg)
+        r2 = raw_call(msg)  # the retry: replayed, never re-executed
+        assert r1["ok"] and r2["ok"] and r1["result"] == r2["result"]
+        assert len(calls) == 1
+        handlers = [e for e in profiler.snapshot_events()
+                    if e["name"] == "rpc_handler:bump"]
+        assert len(handlers) == 2
+        execution, replay = handlers
+        assert {e["trace_id"] for e in handlers} == {"traceT"}
+        assert {e["parent_id"] for e in handlers} == {"spanS"}
+        assert execution["span_id"] != replay["span_id"]
+        assert replay["args"] == {"dedup_replay": True}
+        assert srv.stats()["bump"]["dedup_replays"] == 1
+    finally:
+        profiler.stop_profiler(profile_path="")
+        srv.shutdown()
+
+
+def test_stale_view_reroute_keeps_trace_id_across_owners():
+    """A PR 6 re-route is ONE logical call: the refusing old owner and
+    the executing new owner both record handler spans under the SAME
+    trace id (new span ids), parented on the one client rpc span."""
+    from paddle_tpu.fluid import core, profiler, ps_membership, telemetry
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+
+    ps_membership.reset_views()
+    slot = f"127.0.0.1:{free_port()}"
+    srv_b = VarServer("127.0.0.1:0",
+                      {"get_var": lambda name, trainer_id=0:
+                       np.arange(3, dtype=np.float32)}).start()
+    bind_b = f"127.0.0.1:{srv_b.port}"
+    moved = ps_membership.ClusterView.initial([slot]).moved(
+        slot, bind_b, epoch=1)
+
+    def refuse(name, trainer_id=0):
+        err = core.StaleClusterViewError(
+            f"shard {slot} moved to {bind_b}")
+        err.view_dict = moved.to_dict()
+        raise err
+
+    srv_a = VarServer(slot, {"get_var": refuse}).start()
+    try:
+        ps_membership.install_view(ps_membership.ClusterView.initial(
+            [slot]))
+        profiler.start_profiler("CPU")
+        try:
+            cli = VarClient(slot)
+            with telemetry.trace_scope() as ctx:
+                out = cli.call("get_var", name="v")
+            np.testing.assert_array_equal(
+                np.asarray(out), np.arange(3, dtype=np.float32))
+            assert ps_membership.current_epoch() == 1
+            evs = profiler.snapshot_events()
+            handlers = [e for e in evs
+                        if e["name"] == "rpc_handler:get_var"]
+            client_spans = [e for e in evs
+                            if e["name"].startswith("get_var:")]
+            assert len(handlers) == 2  # refusal on A + execution on B
+            assert {e["trace_id"] for e in handlers} == {ctx.trace_id}
+            assert len({e["span_id"] for e in handlers}) == 2
+            # one logical call: every handler parent is the client span
+            assert {e["parent_id"] for e in handlers} == \
+                {client_spans[0]["span_id"]}
+            oks = sorted(e["args"]["ok"] for e in handlers)
+            assert oks == [False, True]
+        finally:
+            profiler.stop_profiler(profile_path="")
+    finally:
+        srv_a.shutdown()
+        srv_b.shutdown()
+        ps_membership.reset_views()
+
+
+# ======================================================================
+# serving: X-Trace-Id + /metrics
+# ======================================================================
+@pytest.fixture(scope="module")
+def mlp_engine_parts():
+    from tools.serving_loadgen import build_mlp_serving_model
+    prog, scope, out_name, feeds = build_mlp_serving_model(n_feeds=4)
+    return prog, scope, out_name, feeds
+
+
+def _mk_engine(parts, **kw):
+    from paddle_tpu.serving import ServingEngine
+    prog, scope, out_name, _ = parts
+    kw.setdefault("num_workers", 2)
+    kw.setdefault("max_batch", 8)
+    return ServingEngine(program=prog, scope=scope, feed_names=["x"],
+                         fetch_names=[out_name], **kw)
+
+
+def _post(url, body, headers=None):
+    req = urllib.request.Request(url, data=json.dumps(body).encode(),
+                                 headers=headers or {})
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def test_http_x_trace_id_round_trips_and_spans_carry_it(
+        mlp_engine_parts):
+    """Satellite: X-Trace-Id in → same id out (on every status);
+    minted when absent; the engine's serve spans run under it."""
+    from paddle_tpu.fluid import profiler
+    from paddle_tpu.serving import ServingIngress
+
+    eng = _mk_engine(mlp_engine_parts, name="traced-mlp")
+    ing = ServingIngress({"mlp": eng}).start()
+    x = mlp_engine_parts[3][0]["x"].tolist()
+    profiler.start_profiler("CPU")
+    try:
+        r = _post(ing.url + "/predict", {"feed": {"x": x}},
+                  {"X-Trace-Id": "req-42"})
+        assert r.status == 200
+        assert r.headers.get("X-Trace-Id") == "req-42"
+        # minted when the client sends none
+        r2 = _post(ing.url + "/predict", {"feed": {"x": x}})
+        minted = r2.headers.get("X-Trace-Id")
+        assert minted and len(minted) == 16 and minted != "req-42"
+        # error paths carry the header too (bad feed -> 400)
+        try:
+            _post(ing.url + "/predict", {"feed": {"wrong": x}},
+                  {"X-Trace-Id": "req-43"})
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert e.headers.get("X-Trace-Id") == "req-43"
+        serve = [e for e in profiler.snapshot_events()
+                 if e["cat"] == "serve"]
+        traced = [e for e in serve if e["trace_id"] == "req-42"]
+        names = {e["name"].split("[")[0] for e in traced}
+        assert "serve:queue_wait" in names
+        assert "serve:exec" in names
+        exec_span = next(e for e in traced
+                         if e["name"].startswith("serve:exec"))
+        assert "req-42" in exec_span["args"]["trace_ids"]
+    finally:
+        profiler.stop_profiler(profile_path="")
+        ing.close()
+
+
+def test_ingress_metrics_endpoint_matches_stats_exactly(
+        mlp_engine_parts):
+    """Acceptance leg: GET /metrics exposes the shed / deadline /
+    degraded / request counters and the cache hit counters with values
+    EQUAL to stats() — same underlying objects, no drift possible."""
+    import re
+    from paddle_tpu.serving import AdmissionController, ServingIngress
+    from paddle_tpu.serving.embedding_cache import EmbeddingCache
+
+    cache = EmbeddingCache(ttl_s=60.0, max_entries=64)
+    eng = _mk_engine(mlp_engine_parts, name="m0",
+                     admission=AdmissionController(max_queue_rows=4),
+                     num_workers=1, embedding_cache=cache)
+    ing = ServingIngress({"mlp": eng}).start()
+    x = mlp_engine_parts[3][0]["x"].tolist()
+    try:
+        # light concurrent flood so sheds and OKs both happen
+        errs = []
+
+        def client(wid):
+            for _ in range(12):
+                try:
+                    _post(ing.url + "/predict", {"feed": {"x": x}})
+                except urllib.error.HTTPError as e:
+                    if e.code not in (429, 504):
+                        errs.append(e.code)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+
+        ths = [threading.Thread(target=client, args=(w,))
+               for w in range(8)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert not errs, errs[:3]
+
+        text = urllib.request.urlopen(
+            ing.url + "/metrics", timeout=30).read().decode()
+        st = eng.stats()
+
+        def metric(name, labels='engine="m0"'):
+            m = re.search(rf"^{name}{{{labels}}} (\S+)$", text, re.M)
+            assert m, f"{name} missing from /metrics"
+            return float(m.group(1))
+
+        assert metric("serving_requests_total") == st["requests"]
+        assert metric("serving_shed_total") == st["shed"]
+        assert metric("serving_deadline_expired_total") == \
+            st["deadline_expired"]
+        assert metric("serving_degraded_total") == st["degraded"]
+        assert metric("serving_cache_hits") == \
+            st["embedding_cache"]["hits"]
+        assert metric("serving_cache_hit_rate") == \
+            st["embedding_cache"]["hit_rate"]
+        # ingress's own counters are views over the same dict
+        ist = ing.stats()["ingress"]
+        m = re.search(r"^serving_ingress_requests (\S+)$", text, re.M)
+        # requests moved between the scrape and stats(); allow the gap
+        assert m and float(m.group(1)) <= ist["requests"]
+        assert "# TYPE serving_requests_total counter" in text
+    finally:
+        ing.close()
+
+
+def test_metrics_sidecar_server_and_flag_gate():
+    from paddle_tpu.fluid import core, telemetry
+
+    # flag 0 = off
+    core.globals_["FLAGS_metrics_port"] = 0
+    assert telemetry.maybe_start_metrics_server() is None
+    port = telemetry.start_metrics_server(0)
+    try:
+        assert port and telemetry.metrics_server_port() == port
+        telemetry.REGISTRY.counter("sidecar_probe_total").inc(3)
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) \
+            .read().decode()
+        assert "sidecar_probe_total 3" in text
+        ok = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10)
+        assert ok.status == 200
+        # idempotent: a second start returns the same port
+        assert telemetry.start_metrics_server(0) == port
+    finally:
+        telemetry.stop_metrics_server()
+
+
+def test_executor_compile_and_retrace_counters():
+    """Satellite: compile/retrace cache-miss counters — a repeated
+    window K is cached (no growth), a NEW K after warm-up counts as a
+    retrace; steady state stays flat."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core, telemetry
+
+    reg = telemetry.REGISTRY
+    compiles = reg.counter("executor_compiles_total",
+                           labelnames=("kind",))
+    retraces = reg.counter("executor_retraces_total",
+                           labelnames=("kind",))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, 2)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        step0 = compiles.value(kind="step")
+        w0 = compiles.value(kind="window")
+        rw0 = retraces.value(kind="window")
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+        assert compiles.value(kind="step") > step0
+        feed2 = {"x": np.ones((2, 2, 4), np.float32)}
+        exe.run(main, feed=feed2, fetch_list=[loss], n_steps=2)
+        assert compiles.value(kind="window") == w0 + 1
+        assert retraces.value(kind="window") == rw0
+        # same K again: cached, nothing moves (steady state is flat)
+        exe.run(main, feed=feed2, fetch_list=[loss], n_steps=2)
+        assert compiles.value(kind="window") == w0 + 1
+        # a NEW K after warm-up is a retrace
+        exe.run(main, feed={"x": np.ones((4, 2, 4), np.float32)},
+                fetch_list=[loss], n_steps=4)
+        assert compiles.value(kind="window") == w0 + 2
+        assert retraces.value(kind="window") == rw0 + 1
+        assert reg.counter("jax_backend_compiles_total").value() > 0
+
+
+# ======================================================================
+# trace shards + timeline merge
+# ======================================================================
+def test_trace_shard_streams_ring_bounded_with_metadata(tmp_path):
+    from paddle_tpu.fluid import core, profiler, telemetry
+
+    core.globals_["FLAGS_trace_dir"] = str(tmp_path)
+    core.globals_["FLAGS_trace_shard_max_events"] = 1024
+    assert profiler.is_profiling()  # shard-only mode records
+    with telemetry.trace_scope() as ctx:
+        with profiler.RecordEvent("step", cat="segment"):
+            pass
+    path = telemetry.flush_trace_shard()
+    shard = json.load(open(path))
+    assert shard["metadata"]["pid"] == os.getpid()
+    assert shard["metadata"]["anchor_wall_us"] > 0
+    (ev,) = shard["traceEvents"]
+    assert ev["name"] == "step" and ev["cat"] == "segment"
+    assert ev["args"]["trace_id"] == ctx.trace_id
+    # ring: the shard never exceeds the bound, drops are counted
+    for i in range(1030):
+        profiler.record_instant(f"i{i}")
+    telemetry.flush_trace_shard()
+    shard = json.load(open(path))
+    assert len(shard["traceEvents"]) == 1024
+    assert shard["metadata"]["dropped_events"] > 0
+
+
+def test_timeline_merge_clock_corrects_with_hello_offsets(tmp_path):
+    """Synthetic 2-shard merge: the pserver shard's clock is 100 s
+    ahead; the trainer's measured hello offset must pull its spans
+    back so the rpc→handler nesting is monotone in ONE clock."""
+    from tools.timeline import merge_shards
+
+    ep = "127.0.0.1:7001"
+    # trainer: rpc span [1.0, 1.4] s on its own clock
+    trainer = {
+        "traceEvents": [
+            {"name": "send:w@" + ep, "ph": "X", "pid": 1, "tid": 1,
+             "ts": 1.0e6, "dur": 0.4e6, "cat": "rpc",
+             "args": {"trace_id": "T", "span_id": "a"}}],
+        "metadata": {"pid": 1, "role": "trainer0", "endpoint": None,
+                     "anchor_wall_us": 5e6, "anchor_perf_us": 0.0,
+                     "peer_offsets": {
+                         ep: {"offset_us": 100.0e6, "rtt_us": 400.0}}},
+    }
+    # pserver: handler span inside the rpc window, on a clock +100 s
+    pserver = {
+        "traceEvents": [
+            {"name": "rpc_handler:send", "ph": "X", "pid": 2, "tid": 9,
+             "ts": 101.1e6, "dur": 0.2e6, "cat": "rpc",
+             "args": {"trace_id": "T", "span_id": "b",
+                      "parent_id": "a"}}],
+        "metadata": {"pid": 2, "role": "pserver0", "endpoint": ep,
+                     # wall anchor deliberately WRONG (1h off) to prove
+                     # the measured offset wins over the fallback
+                     "anchor_wall_us": 3600e6,
+                     "anchor_perf_us": 100.0e6,
+                     "peer_offsets": {}},
+    }
+    (tmp_path / "trace-1.json").write_text(json.dumps(trainer))
+    (tmp_path / "trace-2.json").write_text(json.dumps(pserver))
+    out = str(tmp_path / "timeline.json")
+    summary = merge_shards(str(tmp_path), out=out, trace_id="T")
+    assert summary["n_shards"] == 2 and summary["n_events"] == 2
+    assert summary["processes"]["pserver0"]["source"] == "hello-offset"
+    assert summary["processes"]["pserver0"]["delta_us"] == -100.0e6
+    merged = json.load(open(out))
+    spans = {e["args"]["trace_id"] + ":" + e["args"]["span_id"]: e
+             for e in merged["traceEvents"] if e.get("ph") == "X"}
+    rpc, handler = spans["T:a"], spans["T:b"]
+    # clock-corrected monotone nesting: the handler runs INSIDE the
+    # client call's window
+    assert rpc["ts"] <= handler["ts"]
+    assert handler["ts"] + handler["dur"] <= rpc["ts"] + rpc["dur"]
+    # wall fallback kicks in when no offset links the shards
+    trainer["metadata"]["peer_offsets"] = {}
+    (tmp_path / "trace-1.json").write_text(json.dumps(trainer))
+    summary = merge_shards(str(tmp_path), out=None)
+    assert summary["processes"]["pserver0"]["source"] == "wall-anchor"
+
+
+def test_varserver_stats_view_lands_in_registry():
+    from paddle_tpu.fluid import telemetry
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+
+    srv = VarServer("127.0.0.1:0", {"echo": lambda x=0: x}).start()
+    ep = f"127.0.0.1:{srv.port}"
+    try:
+        cli = VarClient(ep)
+        cli.call("echo", x=1)
+        text = telemetry.REGISTRY.exposition()
+        assert f'ps_server_echo_calls{{endpoint="{ep}"}}' in text
+    finally:
+        srv.shutdown()
+    # unregistered at shutdown: the next scrape drops the view
+    assert f'endpoint="{ep}"' not in telemetry.REGISTRY.exposition()
+
+
+# ======================================================================
+# multiprocess acceptance (slow): 2-trainer × 2-pserver wide_deep
+# ======================================================================
+@pytest.mark.slow
+def test_cluster_timeline_merge_wide_deep_2x2_acceptance(tmp_path):
+    """ISSUE 10 acceptance: a 2-trainer×2-pserver wide_deep run with
+    FLAGS_trace_dir set produces one shard per process;
+    tools/timeline.py merge combines them into a timeline where a
+    single training round's trace id links the trainer's rpc spans to
+    the owning pserver's handler spans — clock-corrected, with the
+    handler inside the client call's span window (monotone ordering)."""
+    from tools.chaos_ps import Cluster
+    from tools.timeline import merge_shards
+
+    trace_dir = tmp_path / "shards"
+    trace_dir.mkdir()
+    run = Cluster(str(tmp_path), model="wide_deep", trainers=2,
+                  n_pservers=2, steps=5, hb=10.0, step_sleep=0.0,
+                  sparse_dim=64, batch=16, tag="obs",
+                  env_extra={"FLAGS_trace_dir": str(trace_dir)})
+    try:
+        run.start_servers()
+        run.start_trainers()
+        run.join_trainers(timeout=420.0)
+        # pserver shards flush on the ~2s background cadence — give the
+        # last round's handler spans one beat to land before the kill
+        time.sleep(4.0)
+    finally:
+        run.shutdown()
+
+    out = str(tmp_path / "timeline.json")
+    summary = merge_shards(str(trace_dir), out=out, ref="trainer0")
+    assert summary["n_shards"] >= 4, summary  # 2 trainers + 2 pservers
+    roles = set(summary["processes"])
+    assert {"trainer0", "trainer1"} <= roles
+    assert sum(1 for r in roles if r.startswith("pserver")) == 2
+    # every pserver shard was aligned by a MEASURED hello offset
+    for role, info in summary["processes"].items():
+        if role.startswith("pserver"):
+            assert info["source"] == "hello-offset", summary
+
+    merged = json.load(open(out))
+    events = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    pid_role = {e["pid"]: e["args"]["name"]
+                for e in merged["traceEvents"] if e.get("ph") == "M"}
+    trainer_pids = {p for p, r in pid_role.items()
+                    if r.startswith("trainer")}
+    pserver_pids = {p for p, r in pid_role.items()
+                    if r.startswith("pserver")}
+
+    # pick a training round's trace: a trainer rpc span whose trace id
+    # also appears on a pserver handler span
+    by_trace = {}
+    for e in events:
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(e)
+    linked = 0
+    for tid, evs in by_trace.items():
+        rpc = [e for e in evs if e["pid"] in trainer_pids
+               and e["cat"] == "rpc"
+               and not e["name"].startswith("rpc_handler")]
+        handlers = [e for e in evs if e["pid"] in pserver_pids
+                    and e["name"].startswith("rpc_handler")]
+        if not (rpc and handlers):
+            continue
+        linked += 1
+        spans = {e["args"]["span_id"]: e for e in rpc}
+        for h in handlers:
+            parent = spans.get(h["args"].get("parent_id"))
+            if parent is None:
+                continue
+            # clock-corrected monotone ordering: the handler span nests
+            # inside its client rpc span (generous slack for the
+            # single-sample offset estimate on a loaded 1-core box)
+            slack = 50e3  # 50 ms in us
+            assert parent["ts"] - slack <= h["ts"], (tid, parent, h)
+            assert h["ts"] + h["dur"] <= \
+                parent["ts"] + parent["dur"] + slack, (tid, parent, h)
+    # rounds from BOTH trainers must have linked trainer→pserver traces
+    assert linked >= 4, (linked, summary)

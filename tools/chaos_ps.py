@@ -174,7 +174,9 @@ class Cluster:
         for i, ep in enumerate(self.slot_eps):
             ready = self._out(f"ps{i}.ready")
             p, tail = _spawn(self._worker_args("pserver", i, ready),
-                             self._out(f"ps{i}.log"), self.env)
+                             self._out(f"ps{i}.log"),
+                             dict(self.env,
+                                  PADDLE_TPU_TRACE_ROLE=f"pserver{i}"))
             self.procs.append((f"ps{i}", p, tail))
             self.pserver_procs[i] = (p, tail)
             waits.append((ready, p, tail))
@@ -202,7 +204,9 @@ class Cluster:
         for t in range(self.trainers):
             out = self._out(f"t{t}.json")
             p, tail = _spawn(self._worker_args("trainer", t, out),
-                             self._out(f"t{t}.log"), self.env)
+                             self._out(f"t{t}.log"),
+                             dict(self.env,
+                                  PADDLE_TPU_TRACE_ROLE=f"trainer{t}"))
             self.procs.append((f"t{t}", p, tail))
             self.trainer_outs.append((out, p, tail))
 
@@ -420,9 +424,19 @@ def main():
     ap.add_argument("--kill-at", type=int, default=5)
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--no-oracle", action="store_true")
+    ap.add_argument("--trace-dir", default=None,
+                    help="stream FLAGS_trace_dir shards from every "
+                         "chaos process and run a tools/timeline.py "
+                         "merge smoke over them afterwards "
+                         "(docs/OBSERVABILITY.md)")
     args = ap.parse_args()
     workdir = args.workdir or os.path.join(
         tempfile.gettempdir(), f"chaos_ps_{int(time.time())}")
+    if args.trace_dir:
+        # subprocesses inherit the env; the chaos trainers/pservers
+        # each stream a shard the merge smoke below combines
+        os.makedirs(args.trace_dir, exist_ok=True)
+        os.environ["FLAGS_trace_dir"] = args.trace_dir
     res = run_scenario(args.scenario, workdir, model=args.model,
                        trainers=args.trainers, n_pservers=args.pservers,
                        steps=args.steps, hb=args.hb,
@@ -432,6 +446,19 @@ def main():
     print(json.dumps(
         {k: v for k, v in res.items() if "losses" not in k}, indent=1,
         default=str))
+    if args.trace_dir:
+        # timeline-merge smoke: the shards the run just streamed must
+        # combine into one clock-corrected timeline (exit non-zero on
+        # an empty/unmergeable dir — the chaos driver doubles as the
+        # obs plane's multiprocess canary)
+        from tools import timeline as _timeline
+        summary = _timeline.merge_shards(
+            args.trace_dir,
+            out=os.path.join(args.trace_dir, "timeline.json"))
+        print("trace merge:", json.dumps(summary, indent=1))
+        if summary["n_events"] == 0:
+            print("trace merge produced ZERO events — shards empty?")
+            return 1
     if res.get("oracle_losses") is not None:
         print("bit_identical:", res["bit_identical"])
         if not res["bit_identical"]:

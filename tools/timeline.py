@@ -1,18 +1,41 @@
 #!/usr/bin/env python
 """Timeline viewer/merger (reference: tools/timeline.py — converts profiler
-protobufs to chrome://tracing). Our profiler already writes chrome-trace
-JSON; this tool merges several profile files (e.g. one per worker) into one
-timeline with distinct pids, ready for chrome://tracing or Perfetto.
+protobufs to chrome://tracing).
 
-Usage:
-    python tools/timeline.py --profile_path p0.json,p1.json \
-        --timeline_path timeline.json
-Also accepts the reference's "name=file" form: trainer0=prof0.json.
+Two modes:
+
+* **legacy profile merge** — our profiler writes chrome-trace JSON per
+  process; this merges several profile files into one timeline with
+  distinct pids::
+
+      python tools/timeline.py --profile_path p0.json,p1.json \
+          --timeline_path timeline.json
+
+  Also accepts the reference's "name=file" form: trainer0=prof0.json.
+
+* **cluster trace-shard merge** (PR 10, docs/OBSERVABILITY.md) — every
+  process running with ``FLAGS_trace_dir`` streams a bounded
+  chrome-trace shard with RAW ``time.perf_counter`` timestamps plus the
+  monotonic clock offsets it measured against its peers in the ps_rpc
+  ``_hello`` handshake. ``merge`` aligns all shards onto ONE reference
+  clock (measured offsets first, wall-clock anchor fallback), labels
+  each process row, and optionally filters to a single trace id::
+
+      python tools/timeline.py merge --trace_dir /tmp/shards \
+          --out timeline.json [--trace_id abc123] [--ref trainer0]
+
+  The result opens in chrome://tracing / Perfetto; ``args.trace_id`` on
+  every span is what links a trainer's rpc spans to the owning
+  pserver's handler spans across processes.
 """
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
 
 
 def load_profile(path: str):
@@ -40,7 +63,161 @@ def merge(profiles, timeline_path: str):
     print(f"merged {len(profiles)} profile(s) -> {timeline_path}")
 
 
+# ---------------------------------------------------------------------------
+# cluster trace-shard merge
+# ---------------------------------------------------------------------------
+def load_shards(trace_dir: str) -> List[dict]:
+    """Load every ``trace-*.json`` shard under ``trace_dir``; each is
+    {"traceEvents": [...], "metadata": {...}} as written by
+    fluid.telemetry's shard streamer."""
+    shards = []
+    for path in sorted(glob.glob(os.path.join(trace_dir,
+                                              "trace-*.json"))):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"[timeline] skipping unreadable shard {path}: {e!r}",
+                  file=sys.stderr)
+            continue
+        if "traceEvents" not in data or "metadata" not in data:
+            print(f"[timeline] skipping {path}: not a trace shard",
+                  file=sys.stderr)
+            continue
+        data["path"] = path
+        shards.append(data)
+    return shards
+
+
+def _pick_reference(shards: List[dict],
+                    ref: Optional[str]) -> dict:
+    """The shard whose clock everything aligns to. ``--ref`` matches a
+    role substring; default prefers a trainer shard (trainers measured
+    the offsets — they dial every pserver) then falls back to the
+    first shard."""
+    if ref:
+        for s in shards:
+            if ref in (s["metadata"].get("role") or ""):
+                return s
+        raise ValueError(
+            f"--ref {ref!r} matches no shard role; roles: "
+            f"{[s['metadata'].get('role') for s in shards]}")
+    for s in shards:
+        role = s["metadata"].get("role") or ""
+        if "trainer" in role:
+            return s
+    return shards[0]
+
+
+def _shard_delta_us(shard: dict, refshard: dict) -> Tuple[float, str]:
+    """Microseconds to ADD to this shard's raw perf timestamps to land
+    on the reference shard's clock, plus the source of the estimate.
+
+    Priority: the reference's measured offset to this shard's endpoint
+    (hello handshake, NTP-style) > this shard's measured offset to the
+    reference's endpoint (sign flipped) > wall-clock anchor pair
+    (exact on one host — perf and wall tick together; cross-host it is
+    only as good as NTP)."""
+    if shard is refshard:
+        return 0.0, "reference"
+    ref_meta, meta = refshard["metadata"], shard["metadata"]
+    ep = meta.get("endpoint")
+    ref_offsets = ref_meta.get("peer_offsets") or {}
+    if ep and ep in ref_offsets:
+        # offset = peer_perf - ref_perf ⇒ peer ts - offset = ref ts
+        return -float(ref_offsets[ep]["offset_us"]), "hello-offset"
+    ref_ep = ref_meta.get("endpoint")
+    offsets = meta.get("peer_offsets") or {}
+    if ref_ep and ref_ep in offsets:
+        return float(offsets[ref_ep]["offset_us"]), "hello-offset-rev"
+    wall_delta = ((meta["anchor_wall_us"] - meta["anchor_perf_us"])
+                  - (ref_meta["anchor_wall_us"]
+                     - ref_meta["anchor_perf_us"]))
+    return wall_delta, "wall-anchor"
+
+
+def merge_shards(trace_dir: str, out: Optional[str] = None,
+                 trace_id: Optional[str] = None,
+                 ref: Optional[str] = None) -> dict:
+    """Merge a FLAGS_trace_dir's shards into one clock-corrected
+    timeline. Returns a summary dict (and writes ``out`` when given):
+
+      {"n_shards", "n_events", "out",
+       "processes": {role: {"delta_us", "source", "n_events",
+                            "dropped_events"}}}
+    """
+    shards = load_shards(trace_dir)
+    if not shards:
+        raise ValueError(f"no trace-*.json shards under {trace_dir!r}")
+    refshard = _pick_reference(shards, ref)
+    merged: List[dict] = []
+    summary: Dict[str, dict] = {}
+    for rank, shard in enumerate(shards):
+        meta = shard["metadata"]
+        role = meta.get("role") or f"proc{meta.get('pid', rank)}"
+        if role in summary:
+            # a respawned process reuses its role (chaos rejoin): keep
+            # BOTH summary entries — a clock problem or event drop in
+            # the first incarnation must stay visible
+            role = f"{role}#{meta.get('pid', rank)}"
+        delta_us, source = _shard_delta_us(shard, refshard)
+        kept = 0
+        for e in shard["traceEvents"]:
+            if trace_id is not None and \
+                    (e.get("args") or {}).get("trace_id") != trace_id:
+                continue
+            e = dict(e)
+            e["pid"] = rank
+            e["ts"] = float(e["ts"]) + delta_us
+            merged.append(e)
+            kept += 1
+        merged.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "args": {"name": role}})
+        summary[role] = {"delta_us": delta_us, "source": source,
+                         "n_events": kept,
+                         "dropped_events": meta.get("dropped_events",
+                                                    0)}
+    # rebase to zero so chrome://tracing doesn't render hour-long
+    # leading dead space (perf_counter epochs are arbitrary)
+    spans = [e for e in merged if e.get("ph") == "X"]
+    if spans:
+        t0 = min(e["ts"] for e in spans)
+        for e in spans:
+            e["ts"] -= t0
+    spans.sort(key=lambda e: e["ts"])
+    result = {"n_shards": len(shards), "n_events": len(spans),
+              "out": out, "processes": summary}
+    if out:
+        with open(out, "w") as f:
+            json.dump({"traceEvents": merged,
+                       "displayTimeUnit": "ms"}, f)
+    return result
+
+
+def _main_merge(argv) -> int:
+    p = argparse.ArgumentParser(
+        prog="timeline.py merge",
+        description="merge FLAGS_trace_dir shards into one "
+                    "clock-corrected timeline")
+    p.add_argument("--trace_dir", required=True)
+    p.add_argument("--out", default="timeline.json")
+    p.add_argument("--trace_id", default=None,
+                   help="keep only spans of this trace id")
+    p.add_argument("--ref", default=None,
+                   help="role substring of the reference-clock shard "
+                        "(default: a trainer shard)")
+    args = p.parse_args(argv)
+    summary = merge_shards(args.trace_dir, out=args.out,
+                           trace_id=args.trace_id, ref=args.ref)
+    print(json.dumps(summary, indent=2))
+    print(f"merged {summary['n_shards']} shard(s), "
+          f"{summary['n_events']} event(s) -> {args.out}")
+    return 0
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "merge":
+        raise SystemExit(_main_merge(sys.argv[2:]))
     p = argparse.ArgumentParser()
     p.add_argument("--profile_path", required=True,
                    help="comma-separated profile files; each may be "
