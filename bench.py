@@ -943,6 +943,101 @@ def bench_wide_deep_1b_async(batch=512, steps=16, warmup=16,
         metric="wide_deep_1b_ps_async_samples_per_sec")
 
 
+def bench_wide_deep_geo(batch=256, steps=64, warmup=8, n_pservers=2,
+                        sparse_dim=20000, n_trainers=2):
+    """Compressed geo WAN lane (docs/PS_DATA_PLANE.md "Compression"):
+    the same wide_deep cluster as wide_deep_1b but geo-SGD transpiled
+    (local optimizer + delta pushes every 8 steps), under an emulated
+    WAN — 50ms injected server-side delay with 10ms jitter on every
+    data RPC — with the whole compression stack on: geo deltas ride
+    the async RoundPipeline (staleness 2), DGC top-k sparsifies them
+    (error feedback in @GEO_OLD), and the wire runs int8 quantized
+    frames. Non-lazy tables (geo keeps the optimizer local), so
+    sparse_dim stays small. Pairs with wide_deep_geo_sync: plain sync
+    mode under the SAME delay — the ratio is the WAN-survivability
+    claim. The row carries the dgc/quant compression ratios from the
+    in-process trainer."""
+    from paddle_tpu.fluid import communicator as _comm
+    from paddle_tpu.fluid import ps_rpc as _ps_rpc
+    saved = {k: os.environ.get(k) for k in
+             ("PADDLE_TPU_PS_RPC_DELAY_MS",
+              "PADDLE_TPU_PS_RPC_DELAY_JITTER_MS", "PADDLE_TPU_WD_GEO",
+              "FLAGS_dgc", "FLAGS_ps_wire_quant",
+              "FLAGS_lazy_sparse_table_threshold")}
+    os.environ.update({
+        "PADDLE_TPU_PS_RPC_DELAY_MS": "50",
+        "PADDLE_TPU_PS_RPC_DELAY_JITTER_MS": "10",
+        "PADDLE_TPU_WD_GEO": "1",
+        "FLAGS_dgc": "1", "FLAGS_ps_wire_quant": "int8",
+        # geo refuses lazy tables; keep the small tables dense-hosted
+        "FLAGS_lazy_sparse_table_threshold": str(1 << 26)})
+    from paddle_tpu.fluid import core as _core
+    _core.set_flag("FLAGS_dgc", True)
+    _core.set_flag("FLAGS_ps_wire_quant", "int8")
+    _core.set_flag("FLAGS_lazy_sparse_table_threshold", 1 << 26)
+    _comm.reset_dgc()
+    _ps_rpc.reset_quant_wire_stats()
+    try:
+        row = bench_wide_deep_1b(
+            batch=batch, steps=steps, warmup=warmup,
+            n_pservers=n_pservers, sparse_dim=sparse_dim,
+            n_trainers=n_trainers, async_staleness=2, window_k=1,
+            metric="wide_deep_geo_wan_samples_per_sec")
+        dgc = _comm.active_dgc_stats()
+        quant = _ps_rpc.quant_wire_stats()
+        row.update({
+            "mode": "geo+dgc+int8", "rpc_delay_ms": 50,
+            "dgc_compression_ratio": dgc.get("compression_ratio"),
+            "wire_bytes_raw": quant.get("bytes_raw_total"),
+            "wire_bytes_sent": quant.get("bytes_sent_total"),
+            "wire_ratio": round(
+                quant.get("bytes_raw_total", 0)
+                / max(1, quant.get("bytes_sent_total", 1)), 2)})
+        return row
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _core.set_flag("FLAGS_dgc", False)
+        _core.set_flag("FLAGS_ps_wire_quant", "")
+        _core.set_flag("FLAGS_lazy_sparse_table_threshold", 1 << 26)
+
+
+def bench_wide_deep_geo_sync(batch=256, steps=8, warmup=2, n_pservers=2,
+                             sparse_dim=20000, n_trainers=2):
+    """Plain-sync counterpart of wide_deep_geo under the SAME 50ms+
+    jitter WAN emulation: every step pays the full send/barrier/recv
+    tail plus one delayed row pull per sparse table — which is exactly
+    why the step count is small (each step costs seconds). Same model,
+    same cluster shape, compression off."""
+    saved = {k: os.environ.get(k) for k in
+             ("PADDLE_TPU_PS_RPC_DELAY_MS",
+              "PADDLE_TPU_PS_RPC_DELAY_JITTER_MS",
+              "FLAGS_lazy_sparse_table_threshold")}
+    os.environ.update({
+        "PADDLE_TPU_PS_RPC_DELAY_MS": "50",
+        "PADDLE_TPU_PS_RPC_DELAY_JITTER_MS": "10",
+        "FLAGS_lazy_sparse_table_threshold": str(1 << 26)})
+    from paddle_tpu.fluid import core as _core
+    _core.set_flag("FLAGS_lazy_sparse_table_threshold", 1 << 26)
+    try:
+        row = bench_wide_deep_1b(
+            batch=batch, steps=steps, warmup=warmup,
+            n_pservers=n_pservers, sparse_dim=sparse_dim,
+            n_trainers=n_trainers, async_staleness=0, window_k=1,
+            metric="wide_deep_geo_sync_wan_samples_per_sec")
+        row.update({"mode": "sync", "rpc_delay_ms": 50})
+        return row
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def bench_wide_deep_1b_ceiling(batch=512, steps=16, warmup=8,
                                sparse_dim=20000, window_k=8):
     """No-PS compiled ceiling PROXY for the wide_deep_1b pair: the same
@@ -1390,6 +1485,8 @@ def main():
                "wide_deep_1b_syncw": bench_wide_deep_1b_syncw,
                "wide_deep_1b_async": bench_wide_deep_1b_async,
                "wide_deep_1b_ceiling": bench_wide_deep_1b_ceiling,
+               "wide_deep_geo": bench_wide_deep_geo,
+               "wide_deep_geo_sync": bench_wide_deep_geo_sync,
                "mnist_realdata": bench_mnist_realdata,
                "mnist_guard": bench_mnist_realdata_guard,
                "wide_deep_realdata": bench_wide_deep_realdata,

@@ -24,9 +24,186 @@ from . import core
 
 __all__ = ["Communicator", "LargeScaleKV", "RoundPipeline",
            "round_pipeline", "active_round_pipeline",
-           "drain_async_rounds", "reset_round_pipeline"]
+           "drain_async_rounds", "reset_round_pipeline",
+           "DGCCompressor", "dgc_compressor", "dgc_enabled",
+           "reset_dgc", "topk_sparsify", "geo_round_pipeline",
+           "active_geo_pipeline", "reset_geo_pipeline"]
 
 _LOG = logging.getLogger("paddle_tpu.ps")
+
+
+# ---------------------------------------------------------------------------
+# DGC — deep gradient compression (docs/PS_DATA_PLANE.md "Compression";
+# reference WITH_DGC, paddle/fluid/operators/dgc_op + DGCMomentumOptimizer;
+# Lin et al., "Deep Gradient Compression", ICLR 2018). Dense grads on the
+# sync send / ps_round paths sparsify to their top-k elements before the
+# wire; the unsent mass stays in a LOCAL error-feedback accumulator and
+# ships in later pushes, so the sum of everything sent plus the residual
+# always equals the true accumulated gradient (the convergence contract —
+# tested in tests/test_ps_compression.py).
+# ---------------------------------------------------------------------------
+def dgc_enabled() -> bool:
+    return bool(core.globals_["FLAGS_dgc"])
+
+
+def topk_sparsify(flat: np.ndarray, sparsity: float):
+    """Top-k-by-magnitude selection: keep ceil(n*(1-sparsity)) entries
+    (at least 1). Returns (sorted int64 indices, their values) —
+    sorted so the server-side scatter order is deterministic."""
+    n = int(flat.size)
+    k = max(1, int(round(n * (1.0 - float(sparsity)))))
+    if k >= n:
+        idx = np.arange(n, dtype=np.int64)
+    else:
+        idx = np.argpartition(np.abs(flat), n - k)[n - k:]
+        idx = np.sort(idx).astype(np.int64)
+    return idx, np.ascontiguousarray(flat[idx])
+
+
+class DGCCompressor:
+    """Per-trainer DGC state: for each grad name a momentum-corrected
+    velocity ``u`` (u = m*u + g) and an error-feedback accumulator
+    ``v`` (v += u). Each push selects the top-k of |v|, zeroes the
+    selected entries of BOTH u and v (the paper's momentum factor
+    masking), and ships (indices, values); everything unselected stays
+    local and accumulates into later pushes. Warm-up ramps sparsity
+    exponentially toward FLAGS_dgc_sparsity over the first
+    FLAGS_dgc_warmup_steps pushes per grad."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state: Dict[str, dict] = {}
+        self._stats = {"elements_total": 0, "elements_sent": 0,
+                       "bytes_raw_total": 0, "bytes_sent_total": 0,
+                       "pushes_total": 0, "dense_fallbacks_total": 0}
+
+    @staticmethod
+    def _sparsity_at(step: int) -> float:
+        final = min(0.9999, max(0.0,
+                    float(core.globals_["FLAGS_dgc_sparsity"])))
+        warm = int(core.globals_["FLAGS_dgc_warmup_steps"])
+        if warm > 0 and step < warm and final > 0:
+            # exponential ramp (the paper's per-epoch 75%→99.9%
+            # schedule, per-push): drop rate approaches `final` as
+            # (1-final)^((step+1)/warm)
+            return 1.0 - (1.0 - final) ** (float(step + 1) / warm)
+        return final
+
+    def compress(self, name: str, grad: np.ndarray):
+        """Fold ``grad`` into the local accumulators and select this
+        push's top-k. Returns (indices, values) over the FLAT grad, or
+        None when the grad should ship dense (non-f32 or smaller than
+        FLAGS_dgc_min_elements)."""
+        g = np.asarray(grad)
+        if g.dtype != np.float32 \
+                or g.size < int(core.globals_["FLAGS_dgc_min_elements"]):
+            return None
+        m = float(core.globals_["FLAGS_dgc_momentum"])
+        with self._lock:
+            st = self._state.get(name)
+            if st is None or st["u"].size != g.size:
+                st = self._state[name] = {
+                    "u": np.zeros(g.size, np.float32),
+                    "v": np.zeros(g.size, np.float32), "step": 0}
+            u, v = st["u"], st["v"]
+            flat = g.reshape(-1)
+            if m > 0.0:
+                u *= np.float32(m)
+                u += flat
+            else:
+                u[:] = flat
+            v += u
+            idx, vals = topk_sparsify(
+                v, self._sparsity_at(st["step"]))
+            st["step"] += 1
+            v[idx] = 0.0
+            u[idx] = 0.0  # momentum factor masking
+            self._stats["elements_total"] += int(g.size)
+            self._stats["elements_sent"] += int(idx.size)
+            self._stats["bytes_raw_total"] += int(g.nbytes)
+            self._stats["bytes_sent_total"] += int(idx.nbytes
+                                                   + vals.nbytes)
+            self._stats["pushes_total"] += 1
+        return idx, vals
+
+    def restore_dense(self, name: str, idx: np.ndarray,
+                      vals: np.ndarray) -> np.ndarray:
+        """Undo a compress() whose dgc_send met an old server ("no
+        method"): put the selected mass back and return the FULL flat
+        accumulator to ship dense instead — the residual clears, so
+        nothing is lost or double-sent across the fallback."""
+        with self._lock:
+            st = self._state[name]
+            v = st["v"]
+            v[idx] += vals  # selected entries were zeroed above
+            full = v.copy()
+            v[:] = 0.0
+            st["u"][:] = 0.0
+            self._stats["dense_fallbacks_total"] += 1
+        return full
+
+    def note_external(self, total_elems: int, sent_elems: int,
+                      raw_bytes: int, sent_bytes: int) -> None:
+        """Fold an externally-compressed push (the geo-delta top-k
+        lane keeps its error feedback in @GEO_OLD, not in u/v) into
+        the same dgc_* counters so dgc_compression_ratio covers the
+        whole compressed plane."""
+        with self._lock:
+            self._stats["elements_total"] += int(total_elems)
+            self._stats["elements_sent"] += int(sent_elems)
+            self._stats["bytes_raw_total"] += int(raw_bytes)
+            self._stats["bytes_sent_total"] += int(sent_bytes)
+            self._stats["pushes_total"] += 1
+
+    def residual(self, name: str):
+        """Copy of the error-feedback accumulator (tests/debugging)."""
+        with self._lock:
+            st = self._state.get(name)
+            return None if st is None else st["v"].copy()
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+        out["compression_ratio"] = round(
+            out["elements_total"] / max(1, out["elements_sent"]), 2)
+        return out
+
+
+_dgc: Optional[DGCCompressor] = None
+_dgc_lock = threading.Lock()
+_dgc_view = None
+
+
+def dgc_compressor() -> DGCCompressor:
+    """Process-global compressor (one trainer per process, like the
+    round pipeline); registers the ``dgc`` metrics view — the
+    ``dgc_compression_ratio`` gauge — on first use."""
+    global _dgc, _dgc_view
+    with _dgc_lock:
+        if _dgc is None:
+            _dgc = DGCCompressor()
+            from . import telemetry
+            _dgc_view = telemetry.REGISTRY.register_view(
+                "dgc", _dgc.stats)
+        return _dgc
+
+
+def active_dgc_stats() -> dict:
+    """Compression counters of the live compressor ({} when DGC never
+    ran in this process) — the subprocess-evidence surface the WAN
+    scenario and bench lanes collect."""
+    d = _dgc
+    return {} if d is None else d.stats()
+
+
+def reset_dgc():
+    global _dgc, _dgc_view
+    with _dgc_lock:
+        _dgc = None
+        view, _dgc_view = _dgc_view, None
+    if view is not None:
+        from . import telemetry
+        telemetry.REGISTRY.unregister_view(view)
 
 
 class RoundPipeline:
@@ -228,12 +405,53 @@ def active_round_pipeline() -> Optional[RoundPipeline]:
     return _round_pipe
 
 
+# geo-delta WAN lane (docs/PS_DATA_PLANE.md "Compression"): geo_sgd_send
+# submits its delta-merge rounds here when FLAGS_async_staleness > 0 —
+# a SEPARATE pipe from the sync ps_round one (a process never runs
+# both, but the stats views must not conflate them and geo rounds have
+# their own install protocol: a FIFO shift queue, not the newest-pull
+# double buffer).
+_geo_pipe: Optional[RoundPipeline] = None
+_geo_pipe_view = None
+
+
+def geo_round_pipeline() -> RoundPipeline:
+    global _geo_pipe, _geo_pipe_view
+    with _round_pipe_lock:
+        if _geo_pipe is None:
+            _geo_pipe = RoundPipeline(name="ps-geo-rounds")
+            from . import telemetry
+            _geo_pipe_view = telemetry.REGISTRY.register_view(
+                "ps_geo_pipeline", _geo_pipe.stats)
+        return _geo_pipe
+
+
+def active_geo_pipeline() -> Optional[RoundPipeline]:
+    return _geo_pipe
+
+
 def drain_async_rounds(timeout: Optional[float] = None) -> bool:
-    """Flush the staleness pipe (no-op without one). Call before
+    """Flush the staleness pipes (no-op without one). Call before
     stopping pservers / comparing trainer state — in-flight rounds
-    still hold unpushed grads and unconsumed pulls."""
-    pipe = _round_pipe
-    return True if pipe is None else pipe.drain(timeout)
+    still hold unpushed grads and unconsumed pulls. Covers BOTH the
+    sync ps_round pipe and the geo delta pipe."""
+    ok = True
+    for pipe in (_round_pipe, _geo_pipe):
+        if pipe is not None:
+            ok = pipe.drain(timeout) and ok
+    return ok
+
+
+def reset_geo_pipeline():
+    global _geo_pipe, _geo_pipe_view
+    with _round_pipe_lock:
+        pipe, _geo_pipe = _geo_pipe, None
+        view, _geo_pipe_view = _geo_pipe_view, None
+    if view is not None:
+        from . import telemetry
+        telemetry.REGISTRY.unregister_view(view)
+    if pipe is not None:
+        pipe.stop(timeout=5.0)
 
 
 def reset_round_pipeline():
@@ -246,6 +464,7 @@ def reset_round_pipeline():
         telemetry.REGISTRY.unregister_view(view)
     if pipe is not None:
         pipe.stop(timeout=5.0)
+    reset_geo_pipeline()
 
 
 class Communicator:

@@ -882,6 +882,36 @@ class _GlobalFlags:
         # RPC. Only active when FLAGS_async_staleness > 0 (prefetched
         # rows are up to one round stale by construction).
         "FLAGS_sparse_prefetch": True,
+        # ---- compressed PS data plane (docs/PS_DATA_PLANE.md
+        # "Compression") ----
+        # wire v3 payload quantization: "" (off, exact frames) | "fp16"
+        # (downcast) | "int8" (per-row absmax scale). Lossy and OPT-IN;
+        # applies only to float32 data-plane payloads on connections
+        # that negotiated wire v3 in the _hello handshake — old peers
+        # on either side keep exchanging exact frames. Bytes-saved
+        # evidence scrapes as ps_wire_bytes_{raw,sent}_total.
+        "FLAGS_ps_wire_quant": "",
+        # DGC deep gradient compression (reference WITH_DGC; Lin et
+        # al., ICLR 2018): dense grads on the sync send / ps_round /
+        # geo-delta paths sparsify to their top-k elements with local
+        # error-feedback accumulation — unsent mass stays in the
+        # trainer's residual and ships later, so convergence follows
+        # the full gradient. OFF by default: bit-identical behavior.
+        "FLAGS_dgc": False,
+        # final sparsity: fraction of elements DROPPED per push (0.999
+        # = ship the top 0.1%, the paper's steady-state setting)
+        "FLAGS_dgc_sparsity": 0.999,
+        # momentum correction factor for the compressor's local
+        # velocity accumulation (u = m*u + g; 0 disables — pair with
+        # a momentum-free server optimizer to keep semantics plain SGD)
+        "FLAGS_dgc_momentum": 0.0,
+        # warm-up: over the first N pushes per grad the sparsity ramps
+        # exponentially from ~75% toward FLAGS_dgc_sparsity (the
+        # paper's epoch ramp, per-push); 0 = no warm-up
+        "FLAGS_dgc_warmup_steps": 0,
+        # grads smaller than this many elements ship dense — top-k
+        # bookkeeping on a bias vector costs more than it saves
+        "FLAGS_dgc_min_elements": 512,
         "FLAGS_sync_nccl_allreduce": True,   # no-op: ICI collectives are compiled
         "FLAGS_executor_mode": "compiled",   # compiled | interpreted
         # segmented compilation: when a block fails the all-or-nothing

@@ -252,12 +252,21 @@ def refresh_view_for(slot: str) -> bool:
 # ---------------------------------------------------------------------------
 # server side
 # ---------------------------------------------------------------------------
-# data-plane methods that carry the client's view epoch and are refused
-# (typed StaleClusterViewError) by a server that no longer owns its shard
-DATA_METHODS = frozenset({
+# the canonical tensor data plane: every method that ships tensor
+# payloads between trainers and pservers. ps_rpc derives its
+# quantization and fault-injection allowlists from THIS set (explicit
+# deltas only), so a new data method added here picks up stale-view
+# refusal, wire quantization, and WAN-delay coverage in one place.
+TENSOR_DATA_METHODS = frozenset({
     "send_var", "send_vars_batch", "get_var", "get_vars_batch",
-    "prefetch_rows", "barrier", "geo_delta", "table_stats",
+    "prefetch_rows", "geo_delta", "dgc_send",
 })
+
+# data-plane methods that carry the client's view epoch and are refused
+# (typed StaleClusterViewError) by a server that no longer owns its
+# shard — the tensor plane plus the round/introspection calls that must
+# also land on the current owner
+DATA_METHODS = TENSOR_DATA_METHODS | {"barrier", "table_stats"}
 
 # test hook (tests/faultinject.py corrupt_handoff): maps a section's
 # payload bytes just before they leave the draining source — AFTER the

@@ -77,9 +77,110 @@ _LEN = struct.Struct(">Q")
 _LOG = logging.getLogger("paddle_tpu.ps")
 
 # wire protocol generations (negotiated per connection via "_hello")
-PROTO_PICKLE = 1   # legacy: one pickle blob carries tensors too
-PROTO_BINARY = 2   # v2: pickled header + raw zero-copy tensor buffers
-WIRE_VERSION = 2
+PROTO_PICKLE = 1    # legacy: one pickle blob carries tensors too
+PROTO_BINARY = 2    # v2: pickled header + raw zero-copy tensor buffers
+PROTO_BINARY_Q = 3  # v3: v2 + quantized buffer specs (fp16 / int8+scale)
+WIRE_VERSION = 3
+
+# ---------------------------------------------------------------------------
+# wire v3 — quantized tensor frames (docs/PS_DATA_PLANE.md "Compression").
+# FLAGS_ps_wire_quant ("" | "fp16" | "int8") turns float32 payload buffers
+# of DATA-PLANE methods into lossy wire encodings: fp16 is a plain
+# downcast; int8 ships per-row absmax scales (row = leading axis; a 1-D
+# array is one row) as an extra f32 buffer right after the int8 buffer.
+# Gated three ways so it can never corrupt a peer or a control frame:
+#   * negotiation — only connections that agreed on wire v3 in the
+#     _hello handshake carry quantized specs (a v2/v1 peer keeps
+#     receiving exact frames, both directions);
+#   * method allowlist — only the tensor data plane quantizes; control,
+#     membership, handoff, and replica-forward frames stay exact (the
+#     replica chain MUST forward the decoded apply, not the compressed
+#     frame, or the standby diverges from the primary bit-for-bit);
+#   * dtype/finiteness — only finite float32 arrays quantize; a
+#     non-finite int8 candidate ships RAW so the pserver's
+#     FLAGS_ps_reject_nonfinite guard sees the poison exactly (fp16
+#     keeps NaN/Inf representable, and an fp16 OVERFLOW becomes Inf —
+#     also caught by the guard at dequant-on-receive).
+_QUANT_MODES = ("", "fp16", "int8")
+# derived from the canonical tensor-plane set (ps_membership) minus
+# dgc_send: DGC's compression IS the sparsity, its values are ~0.1% of
+# the payload already, and quantizing them would inject error AFTER the
+# compressor zeroed the residual by the exact values — a systematic
+# per-push bias that error feedback never corrects (the geo flat-delta
+# path tolerates quantization because its pull-telescoped shifts feed
+# the wire error back into the baseline; the direct grad path has no
+# such loop).
+_QUANT_METHODS = ps_membership.TENSOR_DATA_METHODS - {"dgc_send"}
+
+
+def _quant_mode() -> str:
+    mode = str(core.globals_["FLAGS_ps_wire_quant"] or "")
+    if mode not in _QUANT_MODES:
+        raise ValueError(
+            f"FLAGS_ps_wire_quant={mode!r} — expected one of "
+            f"{_QUANT_MODES}")
+    return mode
+
+
+# bytes-saved evidence (docs/OBSERVABILITY.md): raw = the quantized
+# arrays' pre-quant payload bytes, sent = their on-wire bytes (incl.
+# int8 scale vectors). Registered lazily as the "ps_wire" metrics view
+# so ps_wire_bytes_{raw,sent}_total land on GET /metrics the moment the
+# first quantized frame is encoded.
+_QUANT_STATS = {"bytes_raw_total": 0, "bytes_sent_total": 0,
+                "frames_quantized_total": 0}
+_QUANT_STATS_LOCK = threading.Lock()
+_QUANT_VIEW = None
+
+
+def quant_wire_stats() -> Dict[str, int]:
+    with _QUANT_STATS_LOCK:
+        return dict(_QUANT_STATS)
+
+
+def reset_quant_wire_stats() -> None:
+    with _QUANT_STATS_LOCK:
+        for k in _QUANT_STATS:
+            _QUANT_STATS[k] = 0
+
+
+def _bump_quant_stats(raw: int, sent: int) -> None:
+    global _QUANT_VIEW
+    with _QUANT_STATS_LOCK:
+        _QUANT_STATS["bytes_raw_total"] += int(raw)
+        _QUANT_STATS["bytes_sent_total"] += int(sent)
+        _QUANT_STATS["frames_quantized_total"] += 1
+        need_view = _QUANT_VIEW is None
+        if need_view:
+            _QUANT_VIEW = True  # claim before dropping the lock
+    if need_view:
+        _QUANT_VIEW = telemetry.REGISTRY.register_view(
+            "ps_wire", quant_wire_stats)
+
+
+def _quant_int8(arr: np.ndarray):
+    """Per-row symmetric int8: scale[r] = absmax(row r)/127 (1.0 for
+    all-zero rows so dequant stays exact zeros). Returns (q, scale).
+    Multiplies by the reciprocal scale with out= reuse — the encode is
+    the hot half of the codec (decode is one cast + one multiply)."""
+    n = arr.shape[0] if arr.ndim > 1 else 1
+    a2 = arr.reshape(n, -1)
+    absmax = np.abs(a2).max(axis=1).astype(np.float32)
+    scale = absmax / np.float32(127.0)
+    scale[scale == 0] = 1.0
+    tmp = a2 * (np.float32(1.0) / scale)[:, None]
+    np.rint(tmp, out=tmp)
+    np.clip(tmp, -127, 127, out=tmp)
+    return tmp.astype(np.int8).reshape(arr.shape), scale
+
+
+def _dequant_int8(q: np.ndarray, scale: np.ndarray,
+                  dtype: np.dtype) -> np.ndarray:
+    n = q.shape[0] if q.ndim > 1 else 1
+    q2 = q.reshape(n, -1)
+    out = (q2.astype(np.float32) * scale.reshape(-1, 1)).astype(
+        dtype, copy=False)
+    return out.reshape(q.shape)
 
 # ---------------------------------------------------------------------------
 # serving-time embedding row cache hook (docs/SERVING.md). When a cache is
@@ -363,28 +464,43 @@ class AckWindow:
 
 
 # fault injection (tests/faultinject.py rpc_delay): a pserver sleeps
-# this many ms before dispatching each data-plane call — models a slow
-# wire/congested server so the async-overlap tests can prove the
-# staleness pipe actually decouples the step from the RPCs. Heartbeat /
-# membership traffic is exempt by default (delaying beats would declare
-# live workers dead).
-_DELAY_DEFAULT_METHODS = frozenset({
-    "send_var", "send_vars_batch", "get_var", "get_vars_batch",
-    "prefetch_rows", "barrier"})
+# PADDLE_TPU_PS_RPC_DELAY_MS before dispatching each data-plane call —
+# models a slow wire/congested server so the async-overlap and WAN
+# tests can prove the staleness/geo pipes decouple the step from the
+# RPCs. Two refinements for honest WAN emulation
+# (docs/PS_DATA_PLANE.md "Compression"):
+#   * PADDLE_TPU_PS_RPC_DELAY_RESP_MS delays the RESPONSE direction
+#     independently (asymmetric up/down links);
+#   * PADDLE_TPU_PS_RPC_DELAY_JITTER_MS adds a uniform [0, j) extra to
+#     every injected delay (real WAN RTTs are never constant) — it
+#     rides on top of a configured base and does nothing alone.
+# Heartbeat / membership traffic stays exempt by default (delaying
+# beats would declare live workers dead).
+# the tensor plane plus barriers: the round's rendezvous RPCs pay the
+# emulated RTT like any data call (heartbeats/membership stay exempt)
+_DELAY_DEFAULT_METHODS = ps_membership.TENSOR_DATA_METHODS | {"barrier"}
 
 
-def _maybe_inject_rpc_delay(method: str) -> None:
-    ms = os.environ.get("PADDLE_TPU_PS_RPC_DELAY_MS")
+def _maybe_inject_rpc_delay(method: str, response: bool = False) -> None:
+    ms = os.environ.get("PADDLE_TPU_PS_RPC_DELAY_RESP_MS" if response
+                        else "PADDLE_TPU_PS_RPC_DELAY_MS")
     if not ms:
         return
     allowed = os.environ.get("PADDLE_TPU_PS_RPC_DELAY_METHODS")
     methods = (frozenset(allowed.split(",")) if allowed
                else _DELAY_DEFAULT_METHODS)
-    if method in methods:
-        try:
-            time.sleep(float(ms) / 1000.0)
-        except ValueError:
-            pass
+    if method not in methods:
+        return
+    try:
+        delay = float(ms)
+        jitter = float(
+            os.environ.get("PADDLE_TPU_PS_RPC_DELAY_JITTER_MS") or 0.0)
+        if jitter > 0:
+            import random
+            delay += random.uniform(0.0, jitter)
+        time.sleep(delay / 1000.0)
+    except ValueError:
+        pass
 
 
 def _pickle_wire_forced() -> bool:
@@ -410,21 +526,71 @@ class _NDRef:
         return (_NDRef, (self.i,))
 
 
-def _strip_arrays(obj, bufs: list):
+def _strip_arrays(obj, bufs: list, specs: list, quant: str = "",
+                  qinfo: Optional[dict] = None):
     """Replace every ndarray in ``obj`` (recursively through
-    dicts/lists/tuples) with an _NDRef and append the contiguous array
-    to ``bufs``. 0-d, zero-SIZE, and object-dtype arrays stay inline —
-    they are header-sized and sidestep buffer-protocol edge cases
-    (memoryview cannot cast a view with zeros in its shape, so an empty
-    sparse update would kill the frame encoder)."""
+    dicts/lists/tuples) with an _NDRef and append the WIRE arrays to
+    ``bufs`` with their spec entries in ``specs``. 0-d, zero-SIZE, and
+    object-dtype arrays stay inline — they are header-sized and
+    sidestep buffer-protocol edge cases (memoryview cannot cast a view
+    with zeros in its shape, so an empty sparse update would kill the
+    frame encoder).
+
+    Specs (wire v2): ``(dtype.str, shape)`` per buffer. Wire v3 adds
+    quantized entries when ``quant`` is set: an fp16 downcast is
+    ``(wire_dtype, shape, ["f", orig_dtype])``; an int8 buffer is
+    ``(wire_dtype, shape, ["i", orig_dtype])`` followed IMMEDIATELY by
+    its per-row scale buffer ``("<f4", (rows,), ["s"])`` — two wire
+    buffers, ONE logical _NDRef slot. The decoder rebuilds logical
+    arrays in spec order, so _NDRef indices stay dense."""
+    if qinfo is None:
+        qinfo = {}
     if isinstance(obj, np.ndarray) and obj.ndim >= 1 and obj.size \
             and obj.dtype != object:
-        bufs.append(np.ascontiguousarray(obj))
-        return _NDRef(len(bufs) - 1)
+        arr = np.ascontiguousarray(obj)
+        # logical index: an int8 buffer + its scale fill ONE logical
+        # slot, so the running counter (not len(bufs)) is the ref
+        ref = _NDRef(qinfo.get("slots", 0))
+        qinfo["slots"] = qinfo.get("slots", 0) + 1
+        # int8 profitability gate: the per-row f32 scale costs 4 bytes,
+        # so a buffer with fewer than ~1.34 elements per row would
+        # EXPAND on the wire (a 1-element top-k delta: 5B vs 4B raw) —
+        # ship such slivers raw
+        n_rows = arr.shape[0] if arr.ndim > 1 else 1
+        if quant == "int8" and 4 * n_rows >= 3 * arr.size:
+            quant_this = ""
+        else:
+            quant_this = quant
+        if quant_this and arr.dtype == np.float32 \
+                and (quant_this == "fp16" or np.isfinite(arr).all()):
+            if quant_this == "fp16":
+                wire = arr.astype(np.float16)
+                bufs.append(wire)
+                specs.append((wire.dtype.str, wire.shape,
+                              ["f", arr.dtype.str]))
+                sent = wire.nbytes
+            else:
+                q, scale = _quant_int8(arr)
+                bufs.append(q)
+                specs.append((q.dtype.str, q.shape,
+                              ["i", arr.dtype.str]))
+                bufs.append(scale)
+                specs.append((scale.dtype.str, scale.shape, ["s"]))
+                sent = q.nbytes + scale.nbytes
+            if qinfo is not None:
+                qinfo["raw"] = qinfo.get("raw", 0) + arr.nbytes
+                qinfo["sent"] = qinfo.get("sent", 0) + sent
+                qinfo["n"] = qinfo.get("n", 0) + 1
+        else:
+            bufs.append(arr)
+            specs.append((arr.dtype.str, arr.shape))
+        return ref
     if isinstance(obj, dict):
-        return {k: _strip_arrays(v, bufs) for k, v in obj.items()}
+        return {k: _strip_arrays(v, bufs, specs, quant, qinfo)
+                for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
-        walked = [_strip_arrays(v, bufs) for v in obj]
+        walked = [_strip_arrays(v, bufs, specs, quant, qinfo)
+                  for v in obj]
         return walked if isinstance(obj, list) else tuple(walked)
     return obj
 
@@ -440,18 +606,30 @@ def _plant_arrays(obj, bufs: list):
     return obj
 
 
-def _encode_frame(obj, proto: int):
+def _encode_frame(obj, proto: int, quant: str = "",
+                  info: Optional[dict] = None):
     """Serialize ``obj`` into wire parts. Returns (parts, nbytes); parts
     are bytes/memoryview objects sent back-to-back — retry/replay paths
-    re-send them VERBATIM, no re-serialization."""
+    re-send them VERBATIM, no re-serialization (a dedup-tokened retry
+    of a QUANTIZED frame replays the exact quantized bytes). ``quant``
+    only takes effect on a v3 connection — v2/v1 peers always get
+    exact frames. ``info`` (optional dict) receives the quantization
+    evidence for the caller's rpc span args."""
     if proto == PROTO_PICKLE:
         payload = pickle.dumps(obj, protocol=4)
         return [_LEN.pack(len(payload)) + payload], _LEN.size + len(payload)
+    if proto < PROTO_BINARY_Q:
+        quant = ""
     bufs: list = []
-    stripped = _strip_arrays(obj, bufs)
-    header = pickle.dumps(
-        {"h": stripped, "b": [(b.dtype.str, b.shape) for b in bufs]},
-        protocol=4)
+    specs: list = []
+    qinfo: dict = {}
+    stripped = _strip_arrays(obj, bufs, specs, quant, qinfo)
+    if qinfo.get("n"):
+        _bump_quant_stats(qinfo["raw"], qinfo["sent"])
+        if info is not None:
+            info.update(quant=quant, bytes_raw=qinfo["raw"],
+                        bytes_quant=qinfo["sent"])
+    header = pickle.dumps({"h": stripped, "b": specs}, protocol=4)
     parts = [_LEN.pack(len(header)) + header]
     nbytes = _LEN.size + len(header)
     for b in bufs:
@@ -465,9 +643,32 @@ def _encode_frame(obj, proto: int):
     return parts, nbytes
 
 
+# thin-pipe emulation (docs/PS_DATA_PLANE.md "Compression"):
+# PADDLE_TPU_PS_RPC_BANDWIDTH_MBPS rate-limits every frame SEND by
+# sleeping nbytes/bandwidth after the write — models a bandwidth-bound
+# WAN/DCN link the way PADDLE_TPU_PS_RPC_DELAY_MS models its latency.
+# Loopback itself is CPU-bound, so compression claims are measured
+# against this emulated pipe (tools/rpc_microbench.py --quant). Applies
+# to both directions (each side pays for what IT sends). Heartbeats
+# ride it too, but at ~100 B a beat the cost is microseconds.
+def _maybe_throttle_send(nbytes: int) -> None:
+    bw = os.environ.get("PADDLE_TPU_PS_RPC_BANDWIDTH_MBPS")
+    if not bw:
+        return
+    try:
+        mbps = float(bw)
+        if mbps > 0:
+            time.sleep(nbytes / (mbps * 1e6))
+    except ValueError:
+        pass
+
+
 def _send_parts(sock: socket.socket, parts) -> None:
+    n = 0
     for p in parts:
         sock.sendall(p)
+        n += p.nbytes if isinstance(p, memoryview) else len(p)
+    _maybe_throttle_send(n)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -512,7 +713,8 @@ def _recv_frame(sock: socket.socket, proto: int):
     specs = obj["b"]
     raw_total = 0
     try:
-        for dt, shape in specs:
+        for spec in specs:
+            dt, shape = spec[0], spec[1]
             if any(int(d) < 0 for d in shape):
                 raise core.RpcProtocolError(
                     f"rpc raw-buffer spec with negative dim {shape} — "
@@ -534,11 +736,49 @@ def _recv_frame(sock: socket.socket, proto: int):
             f"rpc raw-buffer total {raw_total} exceeds "
             f"FLAGS_rpc_max_message_size={limit} — corrupted or "
             f"malicious peer stream")
+    # wire v3 quantized entries dequantize HERE — handlers and callers
+    # only ever see full-precision arrays, so the pserver's
+    # FLAGS_ps_reject_nonfinite guard runs over exactly what will be
+    # applied (an fp16 overflow arrives as Inf and trips it)
     bufs = []
-    for dt, shape in specs:
-        arr = np.empty(shape, np.dtype(dt))
-        _recv_into_exact(sock, memoryview(arr).cast("B"))
-        bufs.append(arr)
+    pending_int8 = None  # (q_array, orig_dtype) awaiting its scale
+    try:
+        for spec in specs:
+            arr = np.empty(spec[1], np.dtype(spec[0]))
+            _recv_into_exact(sock, memoryview(arr).cast("B"))
+            if len(spec) == 2:
+                if pending_int8 is not None:
+                    raise core.RpcProtocolError(
+                        "rpc int8 buffer without its scale entry")
+                bufs.append(arr)
+                continue
+            tag = spec[2][0]
+            if tag == "f":
+                bufs.append(arr.astype(np.dtype(spec[2][1])))
+            elif tag == "i":
+                if pending_int8 is not None:
+                    raise core.RpcProtocolError(
+                        "rpc int8 buffer without its scale entry")
+                pending_int8 = (arr, np.dtype(spec[2][1]))
+            elif tag == "s":
+                if pending_int8 is None:
+                    raise core.RpcProtocolError(
+                        "rpc scale entry without an int8 buffer")
+                q, odt = pending_int8
+                pending_int8 = None
+                bufs.append(_dequant_int8(q, arr, odt))
+            else:
+                raise core.RpcProtocolError(
+                    f"rpc buffer spec with unknown quant tag {tag!r}")
+        if pending_int8 is not None:
+            raise core.RpcProtocolError(
+                "rpc int8 buffer without its scale entry")
+    except (core.RpcProtocolError, ConnectionError, OSError):
+        raise
+    except Exception as e:  # malformed quant metadata
+        raise core.RpcProtocolError(
+            f"rpc quantized-buffer spec malformed ({e!r}) — corrupted "
+            f"or malicious peer stream") from e
     return _plant_arrays(obj["h"], bufs), nbytes + raw_total
 
 
@@ -597,10 +837,16 @@ class VarServer:
 
     def __init__(self, endpoint: str,
                  handlers: Dict[str, Callable[..., Any]],
-                 legacy_wire: bool = False, membership=None):
+                 legacy_wire: bool = False, membership=None,
+                 wire_version: int = WIRE_VERSION):
         host, port = endpoint.rsplit(":", 1)
         self._endpoint = endpoint
         self._handlers = handlers
+        # negotiation cap (tests pin 2 to simulate a pre-quant server;
+        # the hello answers min(cap, client version) so a v3 client
+        # against a v2 server settles on v2 — exact frames only)
+        self._wire_version = max(PROTO_BINARY,
+                                 min(int(wire_version), WIRE_VERSION))
         # elastic-membership hook (ps_membership.MembershipPlane):
         # consulted before dispatching data-plane methods so a server
         # that handed its shard off answers StaleClusterViewError
@@ -649,8 +895,8 @@ class VarServer:
             def handle(self):
                 proto = PROTO_PICKLE  # every connection starts legacy
 
-                def send(resp) -> int:
-                    parts, n = _encode_frame(resp, proto)
+                def send(resp, quant: str = "") -> int:
+                    parts, n = _encode_frame(resp, proto, quant=quant)
                     _send_parts(self.request, parts)
                     return n
 
@@ -673,11 +919,19 @@ class VarServer:
                             # never send it — compatible both ways.
                             if not outer._legacy_wire and \
                                     int(msg.get("version", 0)) >= 2:
+                                # both ends speak the LOWER of their
+                                # generations: a v2 client on a v3
+                                # server (and the reverse) stays on
+                                # exact v2 frames — quantized specs
+                                # only ever cross a both-ends-v3 link
+                                negotiated = min(
+                                    outer._wire_version,
+                                    int(msg.get("version", 0)))
                                 send({"ok": True,
                                       "result": {
-                                          "version": WIRE_VERSION,
+                                          "version": negotiated,
                                           "mono": time.perf_counter()}})
-                                proto = PROTO_BINARY
+                                proto = negotiated
                             else:
                                 send({"ok": False,
                                       "error": "no method _hello"})
@@ -788,7 +1042,22 @@ class VarServer:
                                             resp.get("ok"))})
                             if token is not None:
                                 outer._dedup_put(token, resp)
-                            nout = send(resp)
+                            # response-direction WAN emulation (the
+                            # asymmetric half of the rpc_delay hook)
+                            _maybe_inject_rpc_delay(method,
+                                                    response=True)
+                            # row pulls / dense batches quantize on the
+                            # way OUT too when this server's flag is on
+                            # and the connection negotiated v3 —
+                            # "quantized rows on the wire" covers both
+                            # directions of the data plane
+                            nout = send(
+                                resp,
+                                quant=(_quant_mode()
+                                       if proto >= PROTO_BINARY_Q
+                                       and resp.get("ok")
+                                       and method in _QUANT_METHODS
+                                       else ""))
                         finally:
                             outer._bump(method, calls=1, bytes_in=nin,
                                         bytes_out=nout)
@@ -1116,7 +1385,8 @@ class VarClient:
     _STALE_RETRIES = 3
 
     def __init__(self, endpoint: str, connect_timeout: float = 30.0,
-                 channels: Optional[int] = None, resolve: bool = True):
+                 channels: Optional[int] = None, resolve: bool = True,
+                 wire_version: int = WIRE_VERSION):
         # ``endpoint`` is the SLOT name (what the transpiler baked into
         # the program). With ``resolve`` (the default), every
         # (re)connect maps it through the installed ClusterView to the
@@ -1128,6 +1398,10 @@ class VarClient:
         if ":" not in endpoint:
             raise ValueError(f"endpoint {endpoint!r} is not host:port")
         self._connect_timeout = connect_timeout
+        # negotiation cap, mirroring VarServer's (tests pin 2 to model
+        # a pre-quant client against a new server)
+        self._wire_version = max(PROTO_BINARY,
+                                 min(int(wire_version), WIRE_VERSION))
         if channels is None:
             # legacy mode pins the pool to the pre-overhaul single
             # connection per endpoint
@@ -1247,7 +1521,7 @@ class VarClient:
             try:
                 t_hello = time.perf_counter()
                 _send_msg(sock, {"method": "_hello",
-                                 "version": WIRE_VERSION})
+                                 "version": self._wire_version})
                 resp = _recv_msg(sock)
                 t_reply = time.perf_counter()
             except core.RpcProtocolError:
@@ -1260,9 +1534,14 @@ class VarClient:
                 last = e
                 time.sleep(0.1)
                 continue
-            if resp.get("ok") and int((resp.get("result") or {})
-                                      .get("version", 0)) >= 2:
-                ch.proto = PROTO_BINARY
+            srv_version = int((resp.get("result") or {})
+                              .get("version", 0)) if resp.get("ok") else 0
+            if srv_version >= 2:
+                # settle on the LOWER generation (an old v2 server
+                # answers 2 → this channel never carries quantized
+                # specs; a v3 server answering a capped client already
+                # clamped to our hello version)
+                ch.proto = min(self._wire_version, srv_version)
                 mono = (resp.get("result") or {}).get("mono")
                 self._telemetry_ok = mono is not None
                 if mono is not None:
@@ -1385,6 +1664,13 @@ class VarClient:
             msg["_trace"] = (tctx.trace_id, tctx.span_id)
         if method not in self._IDEMPOTENT:
             msg["_dedup"] = (self._token_prefix, next(self._seq))
+        # wire v3 quantization: data-plane payloads only, and only on
+        # channels that negotiated v3 (encode applies it per proto —
+        # a mid-call failover to a v2 peer re-encodes exact frames).
+        # The dedup token rides the header, so a retry of a quantized
+        # frame replays the exact same quantized bytes.
+        qmode = _quant_mode() if method in _QUANT_METHODS else ""
+        enc_info: dict = {}
         frames: Dict[int, tuple] = {}  # proto -> (parts, nbytes)
         attempt = 0
         stale = 0
@@ -1427,7 +1713,8 @@ class VarClient:
                         deadline_s if rem is None
                         else max(0.05, min(deadline_s, rem)))
                     if ch.proto not in frames:
-                        frames[ch.proto] = _encode_frame(msg, ch.proto)
+                        frames[ch.proto] = _encode_frame(
+                            msg, ch.proto, quant=qmode, info=enc_info)
                     parts, nb = frames[ch.proto]
                     _send_parts(ch.sock, parts)
                     bytes_out += nb
@@ -1534,7 +1821,8 @@ class VarClient:
             # recorded INSIDE the call's trace scope so the client rpc
             # span carries the span id the server parented on
             _record_rpc_span(method, kwargs.get("name"), self.endpoint,
-                             t_start, bytes_out, bytes_in, attempt)
+                             t_start, bytes_out, bytes_in, attempt,
+                             quant_info=enc_info)
             if tscope is not None:
                 tscope.__exit__(None, None, None)
         if not resp.get("ok"):
@@ -1640,17 +1928,23 @@ def send_vars_batch(client: "VarClient", items, trainer_id: int = 0):
 
 
 def _record_rpc_span(method, var, endpoint, t_start, bytes_out, bytes_in,
-                     retries):
+                     retries, quant_info=None):
     """cat="rpc" profiler span per client call (name ``op:var@ep``) so
-    chrome traces show RPC time next to cat="segment"/"window" spans."""
+    chrome traces show RPC time next to cat="segment"/"window" spans.
+    Quantized frames additionally carry quant/bytes_raw args — the
+    per-call compression evidence beside the registry counters."""
     from . import profiler
     if not profiler.is_profiling():
         return
+    args = {"bytes_out": int(bytes_out), "bytes_in": int(bytes_in),
+            "retries": int(retries)}
+    if quant_info:
+        args["quant"] = quant_info.get("quant", "")
+        args["bytes_raw"] = int(quant_info.get("bytes_raw", 0))
+        args["bytes_quant"] = int(quant_info.get("bytes_quant", 0))
     profiler.record_span(
         f"{method}:{var or '-'}@{endpoint}", t_start,
-        time.perf_counter(), cat="rpc",
-        args={"bytes_out": int(bytes_out), "bytes_in": int(bytes_in),
-              "retries": int(retries)})
+        time.perf_counter(), cat="rpc", args=args)
 
 
 class HeartBeatMonitor:

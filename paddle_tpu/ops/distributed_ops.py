@@ -118,6 +118,50 @@ def _np_of(scope, name):
 _warned_uninit_sends = set()
 
 
+def _push_dense_batch(ep, items, tid, legacy=False):
+    """Ship one endpoint's dense grads: with FLAGS_dgc on, eligible
+    grads go out as top-k (indices, values) ``dgc_send`` frames with
+    the unsent mass staying in the trainer's error-feedback residual
+    (docs/PS_DATA_PLANE.md "Compression"); everything else takes the
+    PR 4 coalesced ``send_vars_batch`` path. An old server without
+    ``dgc_send`` ("no method" — nothing applied) gets the FULL
+    accumulated grad dense instead, residual cleared, so the fallback
+    neither loses nor double-sends mass; the miss is memoized."""
+    from ..fluid import communicator as _comm
+    from ..fluid.ps_rpc import send_vars_batch
+    cli = _client(ep)
+    rest = []
+    if _comm.dgc_enabled() and not legacy:
+        comp = _comm.dgc_compressor()
+        for name, val in items:
+            val = np.asarray(val)
+            enc = (comp.compress(name, val)
+                   if "dgc_send" not in cli._missing_methods else None)
+            if enc is None:
+                rest.append((name, val))
+                continue
+            idx, vals = enc
+            try:
+                cli.call("dgc_send", name=name, values=vals,
+                         indices=idx, shape=list(val.shape),
+                         trainer_id=tid)
+            except RuntimeError as e:
+                if "no method dgc_send" not in str(e):
+                    raise
+                cli._missing_methods.add("dgc_send")
+                full = comp.restore_dense(name, idx, vals)
+                rest.append((name, full.reshape(val.shape)))
+    else:
+        rest = [(n, v) for n, v in items]
+    if not rest:
+        return
+    if len(rest) > 1 and not legacy:
+        send_vars_batch(cli, rest, trainer_id=tid)
+    else:
+        for name, val in rest:
+            cli.send_var(name, val, trainer_id=tid)
+
+
 @register_op("send", stateful=True, no_grad=True,
              attr_defaults={"epmap": [], "trainer_id": 0})
 def _send(ins, attrs):
@@ -151,15 +195,140 @@ def _send(ins, attrs):
             dense_by_ep.setdefault(ep, []).append((name, val))
     # dense grads coalesce into ONE batched RPC per endpoint (the dedup
     # token covers the batch, old servers get the per-var fallback —
-    # ps_rpc.send_vars_batch; the legacy lane keeps one RPC per var)
-    from ..fluid.ps_rpc import send_vars_batch
+    # ps_rpc.send_vars_batch; the legacy lane keeps one RPC per var);
+    # FLAGS_dgc routes eligible grads through top-k compression first
     for ep, items in dense_by_ep.items():
-        if len(items) > 1 and not _legacy_dataplane():
-            send_vars_batch(_client(ep), items, trainer_id=tid)
-        else:
-            for name, val in items:
-                _client(ep).send_var(name, val, trainer_id=tid)
+        _push_dense_batch(ep, items, tid, legacy=_legacy_dataplane())
     return {}
+
+
+# --------------------------------------------------------------------------
+# geo async WAN lane (docs/PS_DATA_PLANE.md "Compression"): when
+# FLAGS_async_staleness > 0, geo_sgd_send submits each DENSE delta-merge
+# round (push delta → pull merged param) to the communicator's geo
+# RoundPipeline instead of blocking the local step on the WAN RTT. The
+# pipeline worker computes each round's REMOTE increment ("shift") by
+# telescoping against the previous round's pull — shift_j = F_j -
+# (F_{j-1} + sent_j) — and queues it FIFO; the op installs every queued
+# shift at the next step boundary onto BOTH the param and its @GEO_OLD
+# baseline, so local progress and the un-pushed residual survive the
+# merge. One state per process, like the round pipeline (one trainer
+# per process); the step-1 anchor resets it for a fresh job.
+_GEO_ASYNC = {"last_f": {}, "shifts": None, "push_step": 0}
+_GEO_ASYNC_LOCK = threading.Lock()
+
+
+def _geo_async_reset():
+    from collections import deque
+    with _GEO_ASYNC_LOCK:
+        _GEO_ASYNC["last_f"] = {}
+        _GEO_ASYNC["shifts"] = deque()
+        _GEO_ASYNC["push_step"] = 0
+
+
+def _geo_install_shifts(scope):
+    """Apply every completed round's queued remote increment, FIFO.
+    Shifts translate the param AND its @GEO_OLD baseline by the same
+    amount, so the pending local delta (cur - old) is untouched."""
+    q = _GEO_ASYNC["shifts"]
+    if not q:
+        return
+    while True:
+        try:
+            shift_map = q.popleft()
+        except IndexError:
+            break
+        for name, shift in shift_map.items():
+            if not np.any(shift):
+                continue
+            var = scope.find_var(name)
+            if var is None or not var.is_initialized():
+                continue
+            cur = np.asarray(var.value().array)
+            var.set_value(core.LoDTensor(jnp.asarray(cur + shift)))
+            old_var = scope.var(name + "@GEO_OLD")
+            if old_var.is_initialized():
+                old = np.asarray(old_var.get_tensor().array)
+                old_var.set_value(core.LoDTensor(old + shift))
+
+
+def _geo_dense_round_async(ctx, scope, names, epmap, tid, staleness):
+    """Submit one dense delta-merge round to the geo RoundPipeline.
+
+    Error feedback happens HERE, synchronously: ``old`` advances by
+    exactly what this round will push (under FLAGS_dgc, only the top-k
+    selection — the residual stays in cur-old and ships next round).
+    The background closure pushes the captured payloads, pulls each
+    merged param, and queues shift = fresh - (last_f + sent): with no
+    remote regions both terms are the same fp add, so the shift is
+    exactly zero and a single-region async run tracks the inline one."""
+    from ..fluid import communicator as _comm
+    pushes = []
+    dgc = _comm.dgc_enabled()
+    min_el = int(core.globals_["FLAGS_dgc_min_elements"])
+    push_step = _GEO_ASYNC["push_step"]
+    _GEO_ASYNC["push_step"] = push_step + 1
+    for i, name in enumerate(names):
+        ep = epmap[i if i < len(epmap) else -1]
+        var = scope.find_var(name)
+        if var is None or not var.is_initialized():
+            continue
+        cur = np.asarray(var.value().array)
+        old_var = scope.var(name + "@GEO_OLD")
+        old = np.asarray(old_var.get_tensor().array)
+        delta = np.ascontiguousarray(cur - old)
+        if dgc and delta.dtype == np.float32 and delta.size >= min_el:
+            sparsity = _comm.DGCCompressor._sparsity_at(push_step)
+            idx, vals = _comm.topk_sparsify(delta.reshape(-1), sparsity)
+            sent = np.zeros(delta.size, delta.dtype)
+            sent[idx] = vals
+            sent = sent.reshape(delta.shape)
+            _comm.dgc_compressor().note_external(
+                delta.size, idx.size, delta.nbytes,
+                idx.nbytes + vals.nbytes)
+            pushes.append((name, ep, idx, vals, sent))
+        else:
+            sent = delta
+            pushes.append((name, ep, None, None, sent))
+        # error feedback: the baseline advances by the SENT part only
+        old_var.set_value(core.LoDTensor(old + sent))
+    if not pushes:
+        return
+
+    def do_geo_round():
+        cli_of = _client
+        shift_map = {}
+        for name, ep, idx, vals, sent in pushes:
+            cli = cli_of(ep)
+            if idx is not None \
+                    and "geo_delta#flat" not in cli._missing_methods:
+                try:
+                    cli.call("geo_delta", name=name, value=vals,
+                             rows=idx, flat=True, trainer_id=tid)
+                except (RuntimeError, TypeError) as e:
+                    if "unexpected keyword" not in str(e) \
+                            and "no method" not in str(e):
+                        raise
+                    # pre-compression server: ship the dense sent mass
+                    # (same applied values — idx/vals scattered)
+                    cli._missing_methods.add("geo_delta#flat")
+                    cli.call("geo_delta", name=name, value=sent,
+                             trainer_id=tid)
+            else:
+                cli.call("geo_delta", name=name, value=sent,
+                         trainer_id=tid)
+            fresh = np.asarray(cli.get_var(name, trainer_id=tid))
+            last_f = _GEO_ASYNC["last_f"].get(name)
+            if last_f is None or last_f.shape != fresh.shape:
+                shift = np.zeros_like(fresh)
+            else:
+                shift = fresh - (last_f + sent)
+            _GEO_ASYNC["last_f"][name] = fresh
+            shift_map[name] = shift
+        _GEO_ASYNC["shifts"].append(shift_map)
+
+    _comm.geo_round_pipeline().submit(do_geo_round, staleness,
+                                      label="geo_round")
 
 
 @register_op("geo_sgd_send", stateful=True, no_grad=True,
@@ -170,13 +339,28 @@ def _geo_sgd_send(ins, attrs):
     communicator.h:383): every ``push_nums`` local steps push
     (param - snapshot) to the param's pserver, pull the merged global
     param back, and reset the snapshot. Between syncs training is fully
-    local, so the step stays on-device."""
+    local, so the step stays on-device.
+
+    With FLAGS_async_staleness > 0 the dense sync rides the geo
+    RoundPipeline (see _GEO_ASYNC above): the push/pull round drains in
+    the background while local steps continue, bounded at k rounds in
+    flight, and FLAGS_dgc additionally top-k-sparsifies each delta with
+    the residual kept in the @GEO_OLD baseline (old advances only by
+    what was SENT — exact error feedback). Sparse tables keep the
+    inline row-delta sync at push points (their merge is row-keyed, not
+    translatable by a dense shift). At staleness 0 the path below is
+    byte-for-byte the pre-compression inline code — bit-identical."""
     ctx = attrs["_ctx"]
     scope = ctx.scope
     names = ctx.op.input("Params")
     epmap = attrs.get("epmap") or []
     tid = int(attrs.get("trainer_id", 0))
     push_nums = max(1, int(attrs.get("push_nums", 100)))
+    staleness = int(core.globals_["FLAGS_async_staleness"])
+
+    if staleness > 0:
+        # step boundary: land every completed background round first
+        _geo_install_shifts(scope)
 
     cvar = scope.var("@GEO_STEP@")
     step = 0
@@ -190,27 +374,35 @@ def _geo_sgd_send(ins, attrs):
         # as the delta baseline (reference GeoSgdCommunicator pulls at
         # init_worker; trainers and server share the startup init, so
         # this is the common start)
+        if staleness > 0:
+            _geo_async_reset()
         all_names = list(names) + list(ctx.op.input("SparseParams") or [])
         for i, name in enumerate(all_names):
             ep = epmap[i if i < len(epmap) else -1]
             fresh = np.asarray(_client(ep).get_var(name, trainer_id=tid))
             scope.var(name + "@GEO_OLD").set_value(
                 core.LoDTensor(fresh.copy()))
+            if staleness > 0 and name in names:
+                _GEO_ASYNC["last_f"][name] = fresh.copy()
         return {}
     if step % push_nums != 0:
         return {}
 
-    for i, name in enumerate(names):
-        ep = epmap[i if i < len(epmap) else -1]
-        cur = np.asarray(scope.find_var(name).value().array)
-        old_var = scope.var(name + "@GEO_OLD")
-        old = np.asarray(old_var.get_tensor().array)
-        _client(ep).call("geo_delta", name=name,
-                         value=np.ascontiguousarray(cur - old),
-                         trainer_id=tid)
-        fresh = np.asarray(_client(ep).get_var(name, trainer_id=tid))
-        scope.find_var(name).set_value(core.LoDTensor(jnp.asarray(fresh)))
-        old_var.set_value(core.LoDTensor(fresh.copy()))
+    if staleness > 0:
+        _geo_dense_round_async(ctx, scope, names, epmap, tid, staleness)
+    else:
+        for i, name in enumerate(names):
+            ep = epmap[i if i < len(epmap) else -1]
+            cur = np.asarray(scope.find_var(name).value().array)
+            old_var = scope.var(name + "@GEO_OLD")
+            old = np.asarray(old_var.get_tensor().array)
+            _client(ep).call("geo_delta", name=name,
+                             value=np.ascontiguousarray(cur - old),
+                             trainer_id=tid)
+            fresh = np.asarray(_client(ep).get_var(name, trainer_id=tid))
+            scope.find_var(name).set_value(
+                core.LoDTensor(jnp.asarray(fresh)))
+            old_var.set_value(core.LoDTensor(fresh.copy()))
 
     # sparse tables: push only the TOUCHED row deltas, pull those rows'
     # merged values back (reference GeoSgdCommunicator
@@ -351,7 +543,6 @@ def _ps_round(ins, attrs):
         recv_groups.setdefault(ep, []).append(name)
 
     def do_round():
-        from ..fluid.ps_rpc import send_vars_batch
         for ep, items in send_groups.items():
             dense = []
             for n, v in items:
@@ -362,11 +553,8 @@ def _ps_round(ins, attrs):
                         height=v.height())
                 else:
                     dense.append((n, np.asarray(v)))
-            if len(dense) > 1 and not legacy:
-                send_vars_batch(_client(ep), dense, trainer_id=tid)
-            else:
-                for n, v in dense:
-                    _client(ep).send_var(n, v, trainer_id=tid)
+            if dense:
+                _push_dense_batch(ep, dense, tid, legacy=legacy)
         for ep in beps:
             _client(ep).barrier("send", trainer_id=tid)
         pulled = {}
@@ -990,6 +1178,36 @@ def _listen_and_serv(ins, attrs):
             _forward("barrier_done", {})
         return True
 
+    def h_dgc_send(name, values, indices, shape, trainer_id=0):
+        """DGC top-k dense-grad push (docs/PS_DATA_PLANE.md
+        "Compression"): scatter the (indices, values) selection into a
+        dense zeros grad and apply it EXACTLY like send_var would —
+        sync mode defers it into the round's pending set, async runs
+        the optimize block. The values arrive already dequantized
+        (wire v3 decodes at receive), so the FLAGS_ps_reject_nonfinite
+        guard inside _apply_one_locked sees the real numbers. The
+        replica chain forwards the DECODED dense apply, never the
+        compressed frame — a warm standby must stay bit-identical to
+        the primary through a quantized/DGC push."""
+        monitor.update(trainer_id)
+        vals = np.asarray(values).reshape(-1)
+        dims = [int(d) for d in shape]
+        n_elems = 1
+        for d in dims:
+            n_elems *= d
+        dense = np.zeros(n_elems, vals.dtype)
+        dense[np.asarray(indices, np.int64).reshape(-1)] = vals
+        dense = dense.reshape(dims)
+        with lock:
+            membership.check_serving()
+            _apply_one_locked(name, dense, None, trainer_id)
+            # forward-then-note, same fencing rationale as h_send_var
+            _forward("send_var", {"name": name, "value": dense,
+                                  "trainer_id": int(trainer_id),
+                                  "rows": None, "height": 0})
+            note_request_token_applied()
+        return True
+
     def h_get_var(name, trainer_id=0):
         arr = _np_of(scope, name)
         if arr is None:
@@ -1038,12 +1256,20 @@ def _listen_and_serv(ins, attrs):
     def h_checkpoint(dir=""):
         return True
 
-    def _geo_apply_locked(name, value, rows):
+    def _geo_apply_locked(name, value, rows, flat=False):
         var = scope.find_var(name)
         if var is None:
             raise KeyError(f"geo pserver has no param '{name}'")
         cur = np.asarray(var.value().array)
-        if rows is not None:
+        if rows is not None and flat:
+            # DGC'd delta: ``rows`` are FLAT element indices of the
+            # top-k selection, not leading-axis row ids
+            cur = np.array(cur)
+            flat_view = cur.reshape(-1)
+            np.add.at(flat_view, np.asarray(rows, np.int64).reshape(-1),
+                      np.asarray(value).reshape(-1))
+            var.set_value(core.LoDTensor(jnp.asarray(cur)))
+        elif rows is not None:
             cur = np.array(cur)  # jax-array views are read-only
             np.add.at(cur, np.asarray(rows, np.int64),
                       np.asarray(value))
@@ -1052,18 +1278,22 @@ def _listen_and_serv(ins, attrs):
             var.set_value(core.LoDTensor(
                 jnp.asarray(cur + np.asarray(value))))
 
-    def h_geo_delta(name, value, trainer_id=0, rows=None):
+    def h_geo_delta(name, value, trainer_id=0, rows=None, flat=False):
         """GEO-SGD delta apply: param += delta on arrival; with ``rows``
         only those table rows are touched (reference GeoSgdCommunicator
-        sparse-id sync, communicator.h:383 SendUpdateSparseVars)."""
+        sparse-id sync, communicator.h:383 SendUpdateSparseVars);
+        ``flat=True`` marks a DGC top-k delta whose ``rows`` are flat
+        element indices (docs/PS_DATA_PLANE.md "Compression")."""
         monitor.update(trainer_id)
         with lock:
             membership.check_serving()
-            _geo_apply_locked(name, value, rows)
-            # forward-then-note, same fencing rationale as h_send_var
+            _geo_apply_locked(name, value, rows, flat=bool(flat))
+            # forward-then-note, same fencing rationale as h_send_var.
+            # The forwarded values are the DECODED delta (post-dequant)
+            # so the standby applies bit-identically to this primary.
             _forward("geo_delta", {"name": name,
                                    "value": np.asarray(value),
-                                   "rows": rows})
+                                   "rows": rows, "flat": bool(flat)})
             note_request_token_applied()
         return True
 
@@ -1258,7 +1488,8 @@ def _listen_and_serv(ins, attrs):
                 pass  # only the token registration below matters
             elif fwd_method == "geo_delta":
                 _geo_apply_locked(kw["name"], kw["value"],
-                                  kw.get("rows"))
+                                  kw.get("rows"),
+                                  flat=bool(kw.get("flat", False)))
             else:
                 raise KeyError(
                     f"replica_apply: unknown forwarded method "
@@ -1563,6 +1794,7 @@ def _listen_and_serv(ins, attrs):
     srv_box = []
     srv = VarServer(bind, {
         "send_var": h_send_var, "send_vars_batch": h_send_vars_batch,
+        "dgc_send": h_dgc_send,
         "barrier": h_barrier, "get_var": h_get_var,
         "get_vars_batch": h_get_vars_batch,
         "prefetch_rows": h_prefetch_rows, "checkpoint": h_checkpoint,

@@ -64,6 +64,15 @@ def pytest_configure(config):
         "carries 'slow'. Subprocesses run JAX_PLATFORMS=cpu, so "
         "PADDLE_TPU_TEST_SHARD file-level sharding applies unchanged.")
     config.addinivalue_line(
+        "markers", "wan: compressed PS data-plane / WAN-emulation suite "
+        "(docs/PS_DATA_PLANE.md 'Compression' — wire v3 quantized "
+        "frames, DGC top-k grads, geo-delta rounds under injected "
+        "RTT/jitter/bandwidth; tests/test_ps_compression.py). Units and "
+        "in-process thread-harness tests stay tier-1 non-slow; the "
+        "multiprocess 2-region 50ms-RTT scenario also carries 'slow'. "
+        "Subprocesses run JAX_PLATFORMS=cpu, so PADDLE_TPU_TEST_SHARD "
+        "file-level sharding applies unchanged.")
+    config.addinivalue_line(
         "markers", "rpcbench: PS-RPC data-plane microbench smoke "
         "(tools/rpc_microbench.py loopback sweep at tiny sizes — the "
         "full 4KB..64MB run is a manual tool invocation). In-process "

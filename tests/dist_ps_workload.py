@@ -83,7 +83,10 @@ def main():
     cfg = DistributeTranspilerConfig()
     if geo:
         cfg.geo_sgd_mode = True
-        cfg.geo_sgd_need_push_nums = 5
+        # WAN scenarios widen the push interval (fewer delta rounds per
+        # local step — the knob geo-SGD exists to turn)
+        cfg.geo_sgd_need_push_nums = int(
+            os.environ.get("PADDLE_TPU_GEO_PUSH_NUMS", "5"))
     if max_rows:
         cfg.sparse_table_max_rows = max_rows
     if "--async-overlap" in sys.argv:
@@ -128,10 +131,13 @@ def main():
     from paddle_tpu.fluid.ps_rpc import WorkerHeartBeat
     beat = WorkerHeartBeat(eps.split(","), tid, interval=0.5).start()
     losses = []
+    loop_elapsed = 0.0
     try:
         with fluid.scope_guard(scope):
             exe.run(startup)
             prog = t.get_trainer_program()
+            import time as _time
+            _loop_t0 = _time.perf_counter()
             for s in range(steps):
                 if die_after and s >= die_after:
                     os._exit(1)  # simulated crash: no cleanup at all
@@ -158,11 +164,12 @@ def main():
                         pf.write(f"{s} {losses[-1]!r}\n")
                 if step_sleep:
                     time.sleep(step_sleep)
-            # async overlap: flush the staleness pipe before releasing
+            # async overlap: flush the staleness pipes before releasing
             # the pservers — in-flight rounds still hold this trainer's
-            # barrier arrivals (no-op in plain sync mode)
+            # barrier arrivals / geo deltas (no-op in plain sync mode)
             from paddle_tpu.fluid.communicator import drain_async_rounds
             drain_async_rounds()
+            loop_elapsed = _time.perf_counter() - _loop_t0
     except BaseException:
         # a failed step must still release the pservers, or the cluster
         # test dies by timeout hiding the real traceback
@@ -180,6 +187,17 @@ def main():
         stats = [VarClient.of(ep).call("table_stats", name="dist_emb")
                  for ep in eps.split(",")]
         json.dump({"losses": losses, "stats": stats}, open(outfile, "w"))
+    elif "--timing" in sys.argv:
+        # WAN-lane evidence (tests/test_ps_compression.py): in-loop
+        # seconds (startup excluded) plus this process's compression
+        # counters so the 2-region scenario can report throughput AND
+        # bytes-saved without scraping subprocess internals
+        from paddle_tpu.fluid import communicator as _comm
+        from paddle_tpu.fluid.ps_rpc import quant_wire_stats
+        dgc = _comm.active_dgc_stats()
+        json.dump({"losses": losses, "elapsed_s": loop_elapsed,
+                   "steps": steps, "quant": quant_wire_stats(),
+                   "dgc": dgc}, open(outfile, "w"))
     else:
         json.dump(losses, open(outfile, "w"))
     if tid == 0 and not no_stop:

@@ -107,23 +107,37 @@ class rpc_delay:
     """Context manager: every data-plane RPC a pserver handles sleeps
     ``ms`` milliseconds before dispatch (ps_rpc._maybe_inject_rpc_delay
     reads the env at call time). Models a slow/congested wire so the
-    async-overlap tests can prove the staleness pipe decouples the
-    step from the RPCs. Heartbeats/membership traffic are exempt
-    unless ``methods`` names them explicitly.
+    async-overlap and WAN tests can prove the staleness/geo pipes
+    decouple the step from the RPCs. Heartbeats/membership traffic are
+    exempt unless ``methods`` names them explicitly.
+
+    WAN-emulation refinements (docs/PS_DATA_PLANE.md "Compression"):
+    ``resp_ms`` delays the RESPONSE direction independently (asymmetric
+    up/down links — a geo pull pays it, a barrier ack pays it, but the
+    request leg doesn't double-pay), and ``jitter_ms`` adds a uniform
+    [0, j) extra to every injected delay (real RTTs are never flat).
 
     Works on in-process VarServers immediately; subprocess pservers
     inherit the env vars when SPAWNED INSIDE the context (set env
     before the cluster starts)."""
 
-    def __init__(self, ms, methods=None):
+    def __init__(self, ms, methods=None, jitter_ms=None, resp_ms=None):
         self.ms = float(ms)
         self.methods = methods
+        self.jitter_ms = jitter_ms
+        self.resp_ms = resp_ms
         self._saved = {}
 
     def __enter__(self):
         for k, v in (("PADDLE_TPU_PS_RPC_DELAY_MS", str(self.ms)),
                      ("PADDLE_TPU_PS_RPC_DELAY_METHODS",
-                      ",".join(self.methods) if self.methods else None)):
+                      ",".join(self.methods) if self.methods else None),
+                     ("PADDLE_TPU_PS_RPC_DELAY_JITTER_MS",
+                      None if self.jitter_ms is None
+                      else str(float(self.jitter_ms))),
+                     ("PADDLE_TPU_PS_RPC_DELAY_RESP_MS",
+                      None if self.resp_ms is None
+                      else str(float(self.resp_ms)))):
             self._saved[k] = os.environ.get(k)
             if v is None:
                 os.environ.pop(k, None)

@@ -30,6 +30,10 @@ import numpy as np  # noqa: E402
 DEFAULT_SIZES = [1 << 12, 1 << 14, 1 << 16, 1 << 18, 1 << 20, 1 << 22,
                  1 << 24, 1 << 26]
 SMOKE_SIZES = [1 << 12, 1 << 16, 1 << 20]
+# quantized-frame sweep (docs/PS_DATA_PLANE.md "Compression"): the
+# payload range where the data path is bandwidth-bound and quantization
+# pays — 64KB..16MB
+QUANT_SIZES = [1 << 16, 1 << 18, 1 << 20, 1 << 22, 1 << 24]
 
 
 def _free_port():
@@ -88,13 +92,109 @@ def run(sizes=None, repeats=5, warmup=1):
     return rows
 
 
+def run_quant(sizes=None, repeats=5, warmup=1, bandwidth_mbps=None):
+    """Wire v3 quantized-frame sweep: raw (exact f32) vs fp16 vs int8
+    frames through ONE loopback echo server, both directions quantized
+    (request by the client flag, response by the server's — one
+    process, one flag). Rows report EFFECTIVE MB/s: logical f32
+    payload bytes per second, regardless of how few bytes crossed the
+    wire — the number a training round actually experiences — plus the
+    on-wire compression ratio from ps_rpc's byte counters.
+
+    ``bandwidth_mbps`` emulates a thin pipe via the
+    PADDLE_TPU_PS_RPC_BANDWIDTH_MBPS send throttle — the regime the
+    compression claims are about. Raw loopback is CPU/syscall-bound at
+    GB/s, so there quantization's codec cost can exceed the bytes it
+    saves (the 1-core caveat, recorded in BENCH_LOCAL both ways)."""
+    from paddle_tpu.fluid import core
+    from paddle_tpu.fluid import ps_rpc
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+
+    sizes = list(sizes or QUANT_SIZES)
+    old_bw = os.environ.get("PADDLE_TPU_PS_RPC_BANDWIDTH_MBPS")
+    if bandwidth_mbps:
+        os.environ["PADDLE_TPU_PS_RPC_BANDWIDTH_MBPS"] = \
+            str(float(bandwidth_mbps))
+    # the echo method must ride the data-plane quant allowlist for the
+    # duration of the sweep (restored in the finally — tests call this
+    # in-process and must not leak a widened allowlist)
+    old_methods = ps_rpc._QUANT_METHODS
+    ps_rpc._QUANT_METHODS = old_methods | {"echo"}
+    srv = VarServer(f"127.0.0.1:{_free_port()}",
+                    {"echo": lambda value, trainer_id=0: value}).start()
+    ep = f"127.0.0.1:{srv.port}"
+    rows = []
+    cli = None
+    old_flag = core.globals_["FLAGS_ps_wire_quant"]
+    try:
+        cli = VarClient(ep, channels=1)
+        for size in sizes:
+            rng = np.random.RandomState(0)
+            payload = rng.randn(max(1, size // 256), 64).astype(
+                np.float32)  # row-shaped, like embedding pulls
+            row = {"bytes": int(payload.nbytes),
+                   "bandwidth_mbps": (float(bandwidth_mbps)
+                                      if bandwidth_mbps else None)}
+            for mode in ("", "fp16", "int8"):
+                core.set_flag("FLAGS_ps_wire_quant", mode)
+                for _ in range(warmup):
+                    cli.call("echo", value=payload)
+                ps_rpc.reset_quant_wire_stats()
+                t0 = time.perf_counter()
+                for _ in range(repeats):
+                    out = cli.call("echo", value=payload)
+                dt = time.perf_counter() - t0
+                assert np.asarray(out).shape == payload.shape
+                key = mode or "raw"
+                row[f"{key}_mb_s"] = round(
+                    2 * payload.nbytes * repeats / dt / 1e6, 1)
+                if mode:
+                    qs = ps_rpc.quant_wire_stats()
+                    row[f"{key}_wire_ratio"] = round(
+                        qs["bytes_raw_total"]
+                        / max(1, qs["bytes_sent_total"]), 2)
+            row["fp16_speedup"] = round(
+                row["fp16_mb_s"] / max(row["raw_mb_s"], 1e-9), 2)
+            row["int8_speedup"] = round(
+                row["int8_mb_s"] / max(row["raw_mb_s"], 1e-9), 2)
+            rows.append(row)
+    finally:
+        ps_rpc._QUANT_METHODS = old_methods
+        core.set_flag("FLAGS_ps_wire_quant", old_flag)
+        if old_bw is None:
+            os.environ.pop("PADDLE_TPU_PS_RPC_BANDWIDTH_MBPS", None)
+        else:
+            os.environ["PADDLE_TPU_PS_RPC_BANDWIDTH_MBPS"] = old_bw
+        if cli is not None:
+            cli.close()
+        srv.shutdown()
+    return rows
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fast sweep (CI smoke)")
+    ap.add_argument("--quant", action="store_true",
+                    help="wire v3 quantized-frame sweep (raw vs fp16 "
+                         "vs int8 effective MB/s)")
+    ap.add_argument("--bandwidth-mbps", type=float, default=None,
+                    help="emulate a thin pipe at this many MB/s "
+                         "(PADDLE_TPU_PS_RPC_BANDWIDTH_MBPS throttle)")
     ap.add_argument("--repeats", type=int, default=None)
     args = ap.parse_args(argv)
     repeats = args.repeats or (2 if args.smoke else 5)
+    if args.quant:
+        rows = run_quant(sizes=SMOKE_SIZES if args.smoke
+                         else QUANT_SIZES, repeats=repeats,
+                         bandwidth_mbps=args.bandwidth_mbps)
+        print(f"{'payload':>10} {'raw MB/s':>10} {'fp16 MB/s':>10} "
+              f"{'int8 MB/s':>10} {'fp16 x':>7} {'int8 x':>7}")
+        for r in rows:
+            print(f"{r['bytes']:>10} {r['raw_mb_s']:>10} "
+                  f"{r['fp16_mb_s']:>10} {r['int8_mb_s']:>10} "
+                  f"{r['fp16_speedup']:>7} {r['int8_speedup']:>7}")
+        return rows
     rows = run(sizes=SMOKE_SIZES if args.smoke else DEFAULT_SIZES,
                repeats=repeats)
     print(f"{'payload':>10} {'pickle MB/s':>12} {'binary MB/s':>12} "

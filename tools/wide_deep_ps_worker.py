@@ -36,11 +36,24 @@ def build(sparse_dim, embedding_dim=16, num_dense=13, num_slots=26,
 
 def transpile(main, startup, eps, trainer_id=0, trainers=1):
     fluid = _fluid()
-    from paddle_tpu.fluid.transpiler import DistributeTranspiler
-    t = DistributeTranspiler()
+    from paddle_tpu.fluid.transpiler import (DistributeTranspiler,
+                                             DistributeTranspilerConfig)
+    # PADDLE_TPU_WD_GEO=1 flips the cluster into geo-SGD delta-sync mode
+    # (bench.py wide_deep_geo WAN lanes): local optimizer + periodic
+    # geo_sgd_send, pservers apply deltas on arrival. Env-keyed so the
+    # pserver subprocesses of ONE bench lane agree with the in-process
+    # trainer without new argv plumbing.
+    geo = os.environ.get("PADDLE_TPU_WD_GEO") == "1"
+    cfg = DistributeTranspilerConfig()
+    if geo:
+        cfg.geo_sgd_mode = True
+        cfg.geo_sgd_need_push_nums = int(
+            os.environ.get("PADDLE_TPU_WD_GEO_PUSH_NUMS", "8"))
+    t = DistributeTranspiler(cfg)
     with fluid.program_guard(main, startup):
         t.transpile(trainer_id=trainer_id, pservers=eps, trainers=trainers,
-                    sync_mode=True, program=main, startup_program=startup)
+                    sync_mode=not geo, program=main,
+                    startup_program=startup)
     return t
 
 
