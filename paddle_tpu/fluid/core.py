@@ -36,7 +36,7 @@ __all__ = [
     "dtype_to_np", "np_to_dtype", "dtype_to_jnp", "is_float_dtype",
     "is_compiled_with_tpu", "EOFException", "WorkerDeadError",
     "RpcProtocolError", "CheckpointError", "NumericFaultError",
-    "StaleClusterViewError",
+    "StaleClusterViewError", "SpillCorruptionError",
 ]
 
 
@@ -67,6 +67,15 @@ class CheckpointError(RuntimeError):
     """A checkpoint directory failed validation (missing manifest,
     missing files, size/CRC mismatches) or load_vars found missing
     files. The message aggregates EVERY bad file, not just the first."""
+
+
+class SpillCorruptionError(CheckpointError):
+    """A LazyEmbeddingTable spill-log segment (docs/PS_DATA_PLANE.md
+    "Capacity tier") failed its CRC/size validation: the log was
+    truncated, bit-flipped, or deleted out from under the table. The
+    table REFUSES to serve the affected rows — same contract as a torn
+    checkpoint (CheckpointError subclass, so existing rejection
+    handlers keep working). Hot rows pinned in RAM keep serving."""
 
 
 class StaleClusterViewError(RuntimeError):
@@ -480,6 +489,68 @@ class LoDTensorArray(list):
     pass
 
 
+class _SpillTier:
+    """Tier state of one LazyEmbeddingTable (docs/PS_DATA_PLANE.md
+    "Capacity tier"): the spill store + cold-row map, the entry-gate
+    counters, decay-shrink scores, and the telemetry counters the
+    pserver stats plane scrapes. ``store`` is None for an entry-gated
+    but un-spilled table."""
+
+    __slots__ = ("store", "spill_path", "hot_rows", "quant", "seg_rows",
+                 "entry_threshold", "track_scores", "cold", "backing",
+                 "seg_live", "seg_cold", "freq", "scores",
+                 "hits", "misses", "promoted_rows", "spilled_rows_total",
+                 "clean_evictions", "spill_batches", "entry_denied",
+                 "grad_dropped_rows", "poison_dropped_rows",
+                 "shrunk_rows")
+
+    def __init__(self, spill_path, hot_rows, quant, seg_rows,
+                 entry_threshold, dim, dtype, track_scores=None):
+        self.spill_path = spill_path
+        self.hot_rows = int(hot_rows)
+        self.quant = quant
+        self.seg_rows = int(seg_rows)
+        self.entry_threshold = int(entry_threshold)
+        # per-row touch scores feed shrink(); tracked when the entry
+        # gate is on (or explicitly requested) — a plain spill tier
+        # skips the per-touch dict update on its hot path
+        self.track_scores = bool(entry_threshold > 0
+                                 if track_scores is None
+                                 else track_scores)
+        self.store = None
+        if spill_path:
+            from . import slab_spill
+            self.store = slab_spill.SpillStore(spill_path, dim, dtype)
+        self.cold: Dict[int, tuple] = {}      # id -> (seg_id, row_pos)
+        # CLEAN promoted rows keep their disk copy as backing: evicting
+        # an unmodified row just flips it back to cold — zero write-back
+        # (page-cache dirty-bit semantics). apply_grad dirties.
+        self.backing: Dict[int, tuple] = {}   # hot id -> (seg, pos)
+        self.seg_live: Dict[int, int] = {}    # seg -> cold+backing refs
+        # seg -> COLD refs only, maintained incrementally wherever cold
+        # refs move — tier_stats() reads it so a telemetry scrape under
+        # the grad lock is O(segments), never O(spilled rows)
+        self.seg_cold: Dict[int, int] = {}
+        self.freq: Dict[int, int] = {}        # unentered id -> pull count
+        self.scores: Dict[int, float] = {}    # materialized id -> score
+        self.hits = 0
+        self.misses = 0
+        self.promoted_rows = 0
+        self.spilled_rows_total = 0
+        self.clean_evictions = 0
+        self.spill_batches = 0
+        self.entry_denied = 0
+        self.grad_dropped_rows = 0
+        self.poison_dropped_rows = 0
+        self.shrunk_rows = 0
+
+    def deref_seg(self, sid) -> None:
+        self.seg_live[sid] -= 1
+        if self.seg_live[sid] == 0:
+            self.seg_live.pop(sid)
+            self.store.free(sid)
+
+
 class LazyEmbeddingTable:
     """Beyond-HBM host-RAM embedding table for the sparse PS path
     (reference: framework/fleet/fleet_wrapper.h:86-190 — DownpourSparseTable
@@ -498,14 +569,32 @@ class LazyEmbeddingTable:
     fancy-index gather and ``apply_grad`` one ``np.subtract.at`` scatter
     — per-id python work is a single dict lookup, not a per-row
     stack/astype (the pserver applies thousands of rows per step on the
-    wide_deep lanes; docs/PS_DATA_PLANE.md)."""
+    wide_deep lanes; docs/PS_DATA_PLANE.md).
+
+    CAPACITY TIER (docs/PS_DATA_PLANE.md "Capacity tier"): with
+    ``spill_path`` + ``hot_rows`` the slab becomes the PINNED HOT SET of
+    a two-tier table — LRU overflow writes back to an mmap-backed,
+    CRC-stamped segment log (``fluid/slab_spill.SpillStore``), cold
+    rows promote back into the slab on touch (one segment read per
+    touched segment, not one seek per id), and ``at_rest_quant``
+    ("fp16"/"int8") stores spilled rows through the PR 11 wire codec at
+    2-3.8× density with dequant-on-touch feeding the
+    FLAGS_ps_reject_nonfinite guard. ``entry_threshold`` > 1
+    frequency-gates entry creation (an id must be PULLED that many
+    times before it earns a slot — reference PSLib entry gating) and
+    ``shrink()`` decays per-row touch scores and drops idle rows. All
+    of it opt-in: an unconfigured table runs the exact pre-tier code
+    paths."""
 
     __slots__ = ("height", "dim", "dtype", "seed", "scale", "max_rows",
-                 "_index", "_data", "_free", "evictions")
+                 "_index", "_data", "_free", "evictions", "_tier")
 
     def __init__(self, height: int, dim: int, seed: int = 0,
                  scale: Optional[float] = None, max_rows: Optional[int] = None,
-                 dtype=np.float32):
+                 dtype=np.float32, spill_path: Optional[str] = None,
+                 hot_rows: Optional[int] = None, at_rest_quant: str = "",
+                 entry_threshold: int = 0, spill_seg_rows: int = 0,
+                 track_scores: Optional[bool] = None):
         from collections import OrderedDict
         self.height = int(height)
         self.dim = int(dim)
@@ -515,11 +604,36 @@ class LazyEmbeddingTable:
             else 1.0 / float(np.sqrt(dim))
         self.max_rows = int(max_rows) if max_rows else None
         # id -> slot in _data; insertion order doubles as LRU order when
-        # max_rows bounds the table
+        # max_rows bounds the table (and as the hot set's promotion/
+        # eviction order when the spill tier bounds it)
         self._index: "OrderedDict[int, int]" = OrderedDict()
         self._data = np.empty((0, self.dim), self.dtype)
         self._free: list = []  # recycled slots of evicted rows
         self.evictions = 0
+        self._tier = None
+        tiered = bool(spill_path) and bool(hot_rows)
+        if self.max_rows is not None and (
+                tiered or int(entry_threshold) > 0 or track_scores):
+            # the tiered code paths never run the max_rows eviction, so
+            # accepting both would SILENTLY drop the RAM bound
+            raise ValueError(
+                "LazyEmbeddingTable: max_rows (evict-to-oblivion LRU) "
+                "cannot combine with the capacity tier (spill/"
+                "entry_threshold/track_scores) — the tier's hot_rows "
+                "IS the RAM bound there")
+        if at_rest_quant not in ("", "fp16", "int8"):
+            raise ValueError(
+                f"at_rest_quant={at_rest_quant!r} — expected '' | "
+                f"'fp16' | 'int8'")
+        if tiered or int(entry_threshold) > 0 or track_scores:
+            self._tier = _SpillTier(
+                spill_path=spill_path if tiered else None,
+                hot_rows=int(hot_rows) if tiered else 0,
+                quant=at_rest_quant,
+                seg_rows=int(spill_seg_rows) or 4096,
+                entry_threshold=int(entry_threshold),
+                dim=self.dim, dtype=self.dtype,
+                track_scores=track_scores)
 
     def _init_row(self, r: int) -> np.ndarray:
         rs = np.random.RandomState((self.seed * 1000003 + int(r))
@@ -527,16 +641,42 @@ class LazyEmbeddingTable:
         return rs.uniform(-self.scale, self.scale,
                           self.dim).astype(self.dtype)
 
+    def _grow_to(self, min_cap: int) -> None:
+        """Grow the slab to at least ``min_cap`` rows by doubling —
+        the ONE growth policy every claim/install path shares."""
+        if min_cap <= len(self._data):
+            return
+        cap = max(1024, 2 * len(self._data), min_cap)
+        grown = np.empty((cap, self.dim), self.dtype)
+        grown[:len(self._data)] = self._data
+        self._data = grown
+
+    def _claim_slot(self) -> int:
+        """Claim a slab slot (recycled or new, growing by doubling).
+        The caller must insert the slot into ``_index`` before the next
+        claim — fresh-slot numbering assumes every prior slot is either
+        indexed or free (use ``_claim_slots`` for bulk claims)."""
+        n_alloc = len(self._index) + len(self._free)
+        s = self._free.pop() if self._free else n_alloc
+        self._grow_to(s + 1)
+        return s
+
+    def _claim_slots(self, n: int) -> np.ndarray:
+        """Claim ``n`` slots at once (recycled first, then a contiguous
+        fresh run) WITHOUT requiring interleaved index insertions."""
+        free = self._free
+        out = [free.pop() for _ in range(min(n, len(free)))]
+        m = n - len(out)
+        if m:
+            base = len(self._index) + len(free) + len(out)
+            self._grow_to(base + m)
+            out.extend(range(base, base + m))
+        return np.asarray(out, np.int64)
+
     def _alloc(self, r: int) -> int:
         """Materialize row ``r``: claim a slot (recycled or new, growing
         the slab by doubling), init deterministically, LRU-evict."""
-        n_alloc = len(self._index) + len(self._free)
-        s = self._free.pop() if self._free else n_alloc
-        if s >= len(self._data):
-            cap = max(1024, 2 * len(self._data))
-            grown = np.empty((cap, self.dim), self.dtype)
-            grown[:len(self._data)] = self._data
-            self._data = grown
+        s = self._claim_slot()
         self._data[s] = self._init_row(r)
         self._index[r] = s
         if self.max_rows is not None and len(self._index) > self.max_rows:
@@ -565,6 +705,8 @@ class LazyEmbeddingTable:
         ids = np.asarray(ids).reshape(-1)
         if not len(ids):
             return np.zeros((0, self.dim), self.dtype)
+        if self._tier is not None:
+            return self._get_rows_tiered(ids)
         if self.max_rows is None:
             slots = self._slots_of(ids)  # FIRST: may grow/replace _data
             return self._data[slots]
@@ -586,6 +728,9 @@ class LazyEmbeddingTable:
             return
         grads = np.asarray(grads).reshape(len(ids), self.dim)
         step = (lr * grads).astype(self.dtype, copy=False)
+        if self._tier is not None:
+            self._apply_grad_tiered(ids, step)
+            return
         if self.max_rows is None:
             slots = np.asarray(self._slots_of(ids), np.int64)
             np.subtract.at(self._data, slots, step)
@@ -596,6 +741,412 @@ class LazyEmbeddingTable:
             s = self._slot_of_bounded(r)  # FIRST: may grow/replace _data
             self._data[s] -= step[i]
 
+    # -- capacity tier (docs/PS_DATA_PLANE.md "Capacity tier") -------------
+    def _promote_for(self, id_list, t) -> None:
+        """Promote every cold id in ``id_list`` into the hot slab with
+        ONE store read per touched segment (the batched I/O fan-in —
+        never one seek per id). Counts hot hits / cold misses."""
+        idx = self._index
+        if t.store is None:
+            t.hits += sum(1 for r in id_list if r in idx)
+            return
+        cold = t.cold
+        by_seg: Dict[int, list] = {}
+        queued = set()
+        for r in id_list:
+            if r in idx or r in queued:
+                t.hits += 1
+                continue
+            cr = cold.get(r)
+            if cr is not None:
+                by_seg.setdefault(cr[0], []).append(r)
+                queued.add(r)
+        for sid in sorted(by_seg):
+            self._promote_segment(sid, by_seg[sid], t)
+
+    def _promote_segment(self, sid, rs, t) -> None:
+        seg_ids, rows = t.store.read(sid)  # CRC-verified, dequantized
+        n = len(rs)
+        t.misses += n
+        cold = t.cold
+        pos = np.fromiter((cold[r][1] for r in rs), np.int64, n)
+        rs_arr = np.asarray(rs, np.int64)
+        if (seg_ids[pos] != rs_arr).any():
+            bad = int(np.argmax(seg_ids[pos] != rs_arr))
+            raise SpillCorruptionError(
+                f"spill segment {sid}: row {int(pos[bad])} holds id "
+                f"{int(seg_ids[pos[bad]])}, cold map expected "
+                f"{rs[bad]} — log/directory desynchronized")
+        take = rows[pos]
+        # dequant-on-touch guard: a poisoned spilled row surfaces HERE,
+        # exactly like a poisoned wire frame surfaces at decode
+        # (FLAGS_ps_reject_nonfinite — docs/FAULT_TOLERANCE.md)
+        mode = str(globals_["FLAGS_ps_reject_nonfinite"] or "") \
+            if np.issubdtype(self.dtype, np.floating) else ""
+        dropped = set()
+        if mode:
+            finite = np.isfinite(take).all(axis=1)
+            if not finite.all():
+                if mode == "reject":
+                    bad = rs[int(np.argmin(finite))]
+                    raise NumericFaultError(
+                        f"spilled embedding row {bad} dequantized "
+                        f"non-finite at touch "
+                        f"(FLAGS_ps_reject_nonfinite=reject) — "
+                        f"refusing to serve it")
+                # drop: poisoned rows re-initialize deterministically
+                # (the disk copy is poison — no clean backing for them)
+                for i in np.flatnonzero(~finite):
+                    take[i] = self._init_row(rs[int(i)])
+                    dropped.add(rs[int(i)])
+                    t.poison_dropped_rows += 1
+        # bulk install: one fancy-index copy + one dict batch-update
+        # (the promote loop is the cold-pull hot path — per-row python
+        # here caps the spilled lane's throughput)
+        slots = self._claim_slots(n)
+        self._data[slots] = take
+        self._index.update(zip((int(r) for r in rs), slots.tolist()))
+        # NO score bump here: the caller's gather loop finds the id hot
+        # now and bumps exactly once — a cold touch must not outscore a
+        # hot touch
+        # a CLEAN promote keeps its disk copy as backing — the segment
+        # ref just moves cold→backing, and a later eviction of the
+        # still-unmodified row is free (no re-encode, no write)
+        backing = t.backing
+        for r in rs:
+            entry = cold.pop(r)
+            if r in dropped:
+                t.deref_seg(entry[0])
+            else:
+                backing[r] = entry
+        t.seg_cold[sid] -= n
+        if t.seg_cold[sid] <= 0:
+            t.seg_cold.pop(sid)
+        t.promoted_rows += n
+
+    def _alloc_tiered(self, r: int) -> int:
+        s = self._claim_slot()
+        self._data[s] = self._init_row(r)
+        self._index[r] = s
+        t = self._tier
+        if t.track_scores:
+            t.scores[r] = t.scores.get(r, 0.0) + 1.0
+        return s
+
+    def _spill_overflow(self) -> None:
+        """Write back the LRU overflow of the hot set as spill-log
+        segments (batch-level granularity: eviction runs once per
+        get_rows/apply_grad call, AFTER the whole batch touched, so an
+        id can never lose its slot to a sibling id of the same batch
+        mid-gather)."""
+        t = self._tier
+        if t.store is None:
+            return
+        n_over = len(self._index) - t.hot_rows
+        if n_over <= 0:
+            return
+        backing, cold, free = t.backing, t.cold, self._free
+        dirty_ids: list = []
+        dirty_slots: list = []
+        for _ in range(n_over):
+            r, s = self._index.popitem(last=False)  # LRU out
+            free.append(s)
+            b = backing.pop(r, None)
+            if b is not None:
+                # CLEAN eviction: the disk copy is still the row's
+                # value — flip back to cold, zero bytes written
+                cold[r] = b
+                t.seg_cold[b[0]] = t.seg_cold.get(b[0], 0) + 1
+                t.clean_evictions += 1
+            else:
+                dirty_ids.append(r)
+                dirty_slots.append(s)
+        if dirty_ids:
+            # slots were freed above but nothing claims between here
+            # and the gather — the rows are intact
+            rows = self._data[np.asarray(dirty_slots, np.int64)]
+            ids_arr = np.asarray(dirty_ids, np.int64)
+            for lo in range(0, len(dirty_ids), t.seg_rows):
+                hi = min(lo + t.seg_rows, len(dirty_ids))
+                sid = t.store.append(ids_arr[lo:hi], rows[lo:hi],
+                                     quant=t.quant)
+                t.seg_live[sid] = hi - lo
+                t.seg_cold[sid] = hi - lo
+                for j in range(lo, hi):
+                    cold[int(ids_arr[j])] = (sid, j - lo)
+                t.spill_batches += 1
+        t.spilled_rows_total += n_over
+
+    def _get_rows_tiered(self, ids: np.ndarray) -> np.ndarray:
+        t = self._tier
+        id_list = [int(r) for r in ids.tolist()]
+        self._promote_for(id_list, t)
+        idx = self._index
+        thr = t.entry_threshold
+        track = t.track_scores
+        slots = np.empty(len(id_list), np.int64)
+        gated: Dict[int, int] = {}  # out position -> id (no slot yet)
+        for i, r in enumerate(id_list):
+            s = idx.get(r)
+            if s is None:
+                if thr > 1:
+                    c = t.freq.get(r, 0) + 1
+                    if c < thr:
+                        # below the entry gate: serve the deterministic
+                        # init row WITHOUT materializing — a garbage id
+                        # never earns a slot (reference PSLib entry
+                        # frequency gating)
+                        t.freq[r] = c
+                        t.entry_denied += 1
+                        gated[i] = r
+                        slots[i] = -1
+                        continue
+                    t.freq.pop(r, None)
+                s = self._alloc_tiered(r)
+            else:
+                idx.move_to_end(r)
+                if track:
+                    t.scores[r] = t.scores.get(r, 0.0) + 1.0
+            slots[i] = s
+        out = np.empty((len(id_list), self.dim), self.dtype)
+        live = slots >= 0
+        if live.all():
+            out[:] = self._data[slots]
+        elif live.any():
+            out[live] = self._data[slots[live]]
+        for i, r in gated.items():
+            out[i] = self._init_row(r)
+        self._spill_overflow()
+        return out
+
+    def _apply_grad_tiered(self, ids: np.ndarray, step: np.ndarray) -> None:
+        t = self._tier
+        id_list = [int(r) for r in ids.tolist()]
+        self._promote_for(id_list, t)
+        idx = self._index
+        thr = t.entry_threshold
+        track = t.track_scores
+        backing = t.backing
+        slots = np.empty(len(id_list), np.int64)
+        keep = np.ones(len(id_list), bool)
+        for i, r in enumerate(id_list):
+            s = idx.get(r)
+            if s is None:
+                if thr > 1:
+                    # entry creation is PULL-driven (reference PSLib):
+                    # a grad for an id that never earned a slot is
+                    # dropped, counted — garbage ids can't train
+                    keep[i] = False
+                    t.grad_dropped_rows += 1
+                    continue
+                s = self._alloc_tiered(r)
+            else:
+                idx.move_to_end(r)
+                if track:
+                    t.scores[r] = t.scores.get(r, 0.0) + 1.0
+            # the update DIRTIES the row: its clean disk copy (if any)
+            # is no longer its value — drop the backing ref
+            if backing:
+                b = backing.pop(r, None)
+                if b is not None:
+                    t.deref_seg(b[0])
+            slots[i] = s
+        if keep.all():
+            np.subtract.at(self._data, slots, step)
+        elif keep.any():
+            np.subtract.at(self._data, slots[keep], step[keep])
+        self._spill_overflow()
+
+    def shrink(self, decay: float = 0.5, threshold: float = 0.5) -> int:
+        """Decay-based shrink (reference PSLib table shrink / entry
+        expiry): every materialized row's touch score multiplies by
+        ``decay``; rows falling below ``threshold`` are DROPPED — hot
+        slots freed, cold rows erased from the spill log's live set
+        (fully-dead segments freed and eventually compacted away) — and
+        so are below-threshold entry-gate counters. A dropped id that
+        comes back re-initializes deterministically, the same trade the
+        in-RAM LRU bound makes. Returns the number of rows dropped."""
+        t = self._tier
+        if t is None or not t.track_scores:
+            raise RuntimeError(
+                "shrink() needs touch-score tracking — construct the "
+                "table with entry_threshold > 0 or track_scores=True "
+                "(FLAGS_ps_entry_threshold / FLAGS_ps_slab_track_scores "
+                "on a pserver)")
+        decay = float(decay)
+        dropped = 0
+        new_scores: Dict[int, float] = {}
+        for r, sc in t.scores.items():
+            sc *= decay
+            if sc >= threshold:
+                new_scores[r] = sc
+                continue
+            s = self._index.pop(r, None)
+            if s is not None:
+                self._free.append(s)
+                b = t.backing.pop(r, None)
+                if b is not None:
+                    t.deref_seg(b[0])
+                dropped += 1
+                continue
+            cr = t.cold.pop(r, None)
+            if cr is not None:
+                t.seg_cold[cr[0]] -= 1
+                if t.seg_cold[cr[0]] <= 0:
+                    t.seg_cold.pop(cr[0])
+                t.deref_seg(cr[0])
+                dropped += 1
+        t.scores = new_scores
+        if t.freq:
+            t.freq = {r: c for r, c in
+                      ((r, int(c * decay)) for r, c in t.freq.items())
+                      if c > 0}
+        t.shrunk_rows += dropped
+        return dropped
+
+    def tier_stats(self) -> Dict[str, Any]:
+        """Telemetry gauges of the capacity tier (scraped through the
+        pserver stats plane as ``ps_server_slab_*`` — docs/
+        OBSERVABILITY.md). Empty dict for an untiered table."""
+        t = self._tier
+        if t is None:
+            return {}
+        cold_rows = len(t.cold)
+        spilled_bytes = 0
+        if t.store is not None and cold_rows:
+            # bytes attributable to the COLD rows (backing copies of
+            # clean hot rows are a write-elision byproduct, not spilled
+            # capacity): the incrementally-maintained per-segment cold
+            # counts keep this O(segments) — a stats scrape under the
+            # grad lock must never walk every spilled row
+            for sid, n_cold in t.seg_cold.items():
+                sm = t.store.seg_meta(sid)
+                if sm["n_rows"]:
+                    spilled_bytes += int(
+                        round(sm["row_bytes"] * n_cold / sm["n_rows"]))
+        logical = cold_rows * self.dim * self.dtype.itemsize
+        touches = t.hits + t.misses
+        out = {
+            "resident_rows": len(self._index),
+            "spilled_rows": cold_rows,
+            "resident_bytes": len(self._index) * self.dim
+            * self.dtype.itemsize,
+            "spilled_bytes": spilled_bytes,
+            "logical_spilled_bytes": logical,
+            "density_x": round(logical / spilled_bytes, 3)
+            if spilled_bytes else 0.0,
+            "hits": t.hits, "misses": t.misses,
+            "hit_rate": round(t.hits / touches, 4) if touches else 0.0,
+            "backing_rows": len(t.backing),
+            "promoted_rows": t.promoted_rows,
+            "spilled_rows_total": t.spilled_rows_total,
+            "clean_evictions": t.clean_evictions,
+            "spill_batches": t.spill_batches,
+            "entry_denied": t.entry_denied,
+            "grad_dropped_rows": t.grad_dropped_rows,
+            "poison_dropped_rows": t.poison_dropped_rows,
+            "shrunk_rows": t.shrunk_rows,
+            "gate_pending_ids": len(t.freq),
+        }
+        if t.store is not None:
+            out.update({
+                "spill_file_bytes": t.store.file_bytes(),
+                "spill_live_bytes": t.store.live_bytes(),
+                "store_reads": t.store.reads,
+                "store_writes": t.store.writes,
+                "compactions": t.store.compactions,
+                "crc_failures": t.store.crc_failures,
+            })
+        return out
+
+    def close_spill(self, unlink: bool = False) -> None:
+        t = self._tier
+        if t is not None and t.store is not None:
+            (t.store.unlink if unlink else t.store.close)()
+
+    # -- section-stream plumbing (slab_spill.table_sections /
+    #    build_table_from_sections — the handoff + checkpoint legs) ------
+    def export_meta(self) -> Dict[str, Any]:
+        meta = {"height": self.height, "dim": self.dim,
+                "seed": self.seed, "scale": self.scale,
+                "max_rows": self.max_rows, "dtype": self.dtype.str,
+                "evictions": self.evictions}
+        t = self._tier
+        if t is not None:
+            meta["tier"] = {"hot_rows": t.hot_rows, "quant": t.quant,
+                            "entry_threshold": t.entry_threshold,
+                            "seg_rows": t.seg_rows,
+                            "track_scores": t.track_scores,
+                            "spilled": t.store is not None}
+        return meta
+
+    def _install_hot_rows(self, ids: np.ndarray, rows: np.ndarray) -> None:
+        """Streaming rebuild: append one hot chunk to the slab in
+        order (fresh table only — slots contiguous from 0)."""
+        base = len(self._index)
+        need = base + len(rows)
+        # doubling growth (shared policy): per-chunk exact sizing would
+        # re-copy the whole accumulated slab once per streamed chunk
+        self._grow_to(need)
+        self._data[base:need] = np.asarray(rows, self.dtype)
+        for i, r in enumerate(ids.tolist()):
+            self._index[int(r)] = base + i
+
+    def _install_spilled_segment(self, record, sm) -> None:
+        """Streaming rebuild: install one VERBATIM spill record plus
+        its live map (bit-identical residency on the destination)."""
+        t = self._tier
+        if t is None or t.store is None:
+            raise SpillCorruptionError(
+                "slab stream carries spilled segments but the rebuilt "
+                "table has no spill tier")
+        sid = t.store.append_raw(record, int(sm["n_rows"]),
+                                 sm.get("quant", ""),
+                                 int(sm["row_bytes"]),
+                                 expect_crc=sm.get("crc"))
+        n_rows = int(sm["n_rows"])
+        ids = np.frombuffer(record[:n_rows * 8], np.int64) \
+            if not isinstance(record, np.ndarray) \
+            else np.frombuffer(record.tobytes()[:n_rows * 8], np.int64)
+        runs = sm.get("live_runs")
+        if runs is None:
+            live = sm.get("live_pos")
+            live = range(n_rows) if live is None else live
+        else:
+            live = (p for start, n in runs
+                    for p in range(int(start), int(start) + int(n)))
+        n_live = 0
+        for pos in live:
+            t.cold[int(ids[int(pos)])] = (sid, int(pos))
+            n_live += 1
+        t.seg_live[sid] = n_live
+        if n_live:
+            t.seg_cold[sid] = n_live
+        else:
+            t.seg_live.pop(sid)
+            t.store.free(sid)
+
+    def _export_gate_state(self):
+        t = self._tier
+        empty = np.empty(0, np.int64)
+        if t is None:
+            return empty, np.empty(0, np.float32), empty, empty
+        sc_ids = np.fromiter(t.scores.keys(), np.int64, len(t.scores))
+        sc_vals = np.fromiter(t.scores.values(), np.float32,
+                              len(t.scores))
+        fq_ids = np.fromiter(t.freq.keys(), np.int64, len(t.freq))
+        fq_cnt = np.fromiter(t.freq.values(), np.int64, len(t.freq))
+        return sc_ids, sc_vals, fq_ids, fq_cnt
+
+    def _import_gate_state(self, sc_ids, sc_vals, fq_ids, fq_cnt) -> None:
+        t = self._tier
+        if t is None:
+            return
+        t.scores = {int(r): float(v)
+                    for r, v in zip(sc_ids.tolist(), sc_vals.tolist())}
+        t.freq = {int(r): int(c)
+                  for r, c in zip(fq_ids.tolist(), fq_cnt.tolist())}
+
     # -- handoff (elastic membership, docs/FAULT_TOLERANCE.md) ------------
     def export_state(self):
         """Snapshot for a CRC-manifested shard handoff: (meta, ids,
@@ -604,23 +1155,59 @@ class LazyEmbeddingTable:
         ``rows`` their current values, so ``import_state`` on the
         destination rebuilds a bit-identical table INCLUDING future
         eviction decisions. Never-touched rows don't ship: they
-        re-materialize from the same deterministic per-row init."""
+        re-materialize from the same deterministic per-row init.
+
+        Tiered tables MATERIALIZE here (cold rows dequantized, listed
+        oldest-first ahead of the hot LRU run — spill order IS the
+        eviction order); the RSS-bounded path for big spilled tables is
+        ``slab_spill.table_sections`` (what handoffs and checkpoints
+        use). Gate/score state does not ride this materialized API."""
         n = len(self._index)
         ids = np.fromiter(self._index.keys(), np.int64, n)
         slots = np.fromiter(self._index.values(), np.int64, n)
         rows = (self._data[slots] if n
                 else np.empty((0, self.dim), self.dtype))
-        meta = {"height": self.height, "dim": self.dim, "seed": self.seed,
-                "scale": self.scale, "max_rows": self.max_rows,
-                "dtype": self.dtype.str, "evictions": self.evictions}
-        return meta, ids, np.ascontiguousarray(rows)
+        t = self._tier
+        if t is not None and t.cold:
+            cold = sorted(((sid, pos, r)
+                           for r, (sid, pos) in t.cold.items()))
+            cold_ids = np.asarray([r for _, _, r in cold], np.int64)
+            cold_rows = np.empty((len(cold), self.dim), self.dtype)
+            seg_cache_sid, seg_cache_rows = None, None
+            for i, (sid, pos, _r) in enumerate(cold):
+                if sid != seg_cache_sid:  # one read per segment
+                    seg_cache_sid = sid
+                    _sids, seg_cache_rows = t.store.read(sid)
+                cold_rows[i] = seg_cache_rows[pos]
+            ids = np.concatenate([cold_ids, ids])
+            rows = np.concatenate(
+                [cold_rows, np.asarray(rows, self.dtype)]) \
+                if n else cold_rows
+        return self.export_meta(), ids, np.ascontiguousarray(rows)
 
     @classmethod
-    def from_state(cls, meta, ids, rows) -> "LazyEmbeddingTable":
+    def from_state(cls, meta, ids, rows,
+                   spill_path: Optional[str] = None) -> "LazyEmbeddingTable":
+        tier = meta.get("tier") or {}
+        kw = {}
+        if tier and (tier.get("spilled") or spill_path):
+            if spill_path is None:
+                import tempfile
+                spill_path = os.path.join(
+                    tempfile.mkdtemp(prefix="pt-slab-"), "spill.log")
+            kw = dict(spill_path=spill_path,
+                      hot_rows=int(tier["hot_rows"]),
+                      at_rest_quant=tier.get("quant", ""),
+                      entry_threshold=int(tier.get("entry_threshold", 0)),
+                      spill_seg_rows=int(tier.get("seg_rows", 0)),
+                      track_scores=tier.get("track_scores"))
+        elif tier:
+            kw = dict(entry_threshold=int(tier.get("entry_threshold", 0)),
+                      track_scores=tier.get("track_scores"))
         tbl = cls(height=int(meta["height"]), dim=int(meta["dim"]),
                   seed=int(meta["seed"]), scale=float(meta["scale"]),
                   max_rows=meta.get("max_rows"),
-                  dtype=np.dtype(meta["dtype"]))
+                  dtype=np.dtype(meta["dtype"]), **kw)
         tbl.import_state(ids, rows)
         tbl.evictions = int(meta.get("evictions", 0))
         return tbl
@@ -628,7 +1215,11 @@ class LazyEmbeddingTable:
     def import_state(self, ids, rows) -> None:
         """Install a handoff snapshot wholesale (replaces any current
         content). Rows land compacted in the given order, which
-        ``export_state`` guarantees is the source's LRU order."""
+        ``export_state`` guarantees is the source's LRU order. On a
+        TIERED table the overflow beyond ``hot_rows`` — exactly the
+        oldest prefix, i.e. the source's cold set — is written back to
+        the spill tier (int8/fp16 re-encoding of already-dequantized
+        values is exact, so residency round-trips are bit-identical)."""
         from collections import OrderedDict
         ids = np.asarray(ids, np.int64).reshape(-1)
         rows = np.asarray(rows, self.dtype).reshape(len(ids), self.dim)
@@ -636,20 +1227,43 @@ class LazyEmbeddingTable:
             (int(r), i) for i, r in enumerate(ids.tolist()))
         self._data = np.array(rows, self.dtype, copy=True)
         self._free = []
+        t = self._tier
+        if t is not None:
+            if t.store is not None:  # wholesale replace: old log is dead
+                t.store.clear()
+            t.cold.clear()
+            t.backing.clear()
+            t.seg_live.clear()
+            t.seg_cold.clear()
+            t.freq.clear()
+            t.scores = ({int(r): 1.0 for r in ids.tolist()}
+                        if t.track_scores else {})
+            self._spill_overflow()
 
     # -- introspection ----------------------------------------------------
     def touched_rows(self) -> int:
-        return len(self._index)
+        """Materialized entries — hot slab rows plus spilled rows."""
+        n = len(self._index)
+        if self._tier is not None:
+            n += len(self._tier.cold)
+        return n
 
     def nbytes(self) -> int:
+        """RESIDENT bytes (the hot slab); spilled bytes are on disk —
+        see tier_stats()."""
         return len(self._index) * self.dim * self.dtype.itemsize
 
     def logical_params(self) -> int:
         return self.height * self.dim
 
     def __repr__(self):
+        tier = ""
+        if self._tier is not None:
+            tier = (f", hot={len(self._index)}"
+                    f", spilled={len(self._tier.cold)}")
         return (f"LazyEmbeddingTable(height={self.height}, dim={self.dim}, "
-                f"touched={len(self._index)}, evictions={self.evictions})")
+                f"touched={self.touched_rows()}, "
+                f"evictions={self.evictions}{tier})")
 
 
 class LoDRankTable:
@@ -933,6 +1547,36 @@ class _GlobalFlags:
         # sparse tables with at least this many elements are hosted as
         # init-on-touch LazyEmbeddingTable on pservers (beyond-HBM scale)
         "FLAGS_lazy_sparse_table_threshold": 1 << 26,
+        # ---- capacity tier (docs/PS_DATA_PLANE.md "Capacity tier") ----
+        # non-empty: pserver lazy tables grow a DISK tier — LRU overflow
+        # of the hot set spills to an mmap-backed CRC-stamped segment
+        # log under this directory and promotes back on touch. Empty
+        # (default) = pure in-RAM slab, bit-identical to the pre-tier
+        # behavior.
+        "FLAGS_ps_slab_spill_dir": "",
+        # rows pinned hot in RAM per table when the spill tier is on
+        # (the table's entire RAM bound; must be > 0 with a spill dir)
+        "FLAGS_ps_slab_hot_rows": 0,
+        # at-rest row encoding in the spill log: "" (raw table dtype) |
+        # "fp16" | "int8" (per-row absmax scales — the PR 11 wire codec
+        # reused AT REST, ~3.6x row density at embedding widths; lossy,
+        # error bound absmax_row/254 per element per spill cycle).
+        # Segments holding non-finite rows store raw so
+        # dequant-on-touch surfaces the poison to
+        # FLAGS_ps_reject_nonfinite exactly.
+        "FLAGS_ps_at_rest_quant": "",
+        # frequency-gated entry creation (reference PSLib entry gating):
+        # an id must be PULLED this many times before it materializes a
+        # slot; grads for unentered ids are dropped+counted. 0/1 = off.
+        "FLAGS_ps_entry_threshold": 0,
+        # eviction write-back batch bound: one spill-log segment holds
+        # at most this many rows (one segment read serves a whole cold
+        # batch — the I/O fan-in unit)
+        "FLAGS_ps_slab_seg_rows": 4096,
+        # track per-row touch scores on tiered tables even without the
+        # entry gate, so the table_shrink admin RPC works (costs one
+        # dict update per touched row; gating implies it)
+        "FLAGS_ps_slab_track_scores": False,
         # reuse the device copy when the SAME ndarray object with the
         # SAME content fingerprint is fed again (skips the per-step
         # device_put — the dominant host cost of a small step); the

@@ -118,6 +118,64 @@ def _is_parameter(var) -> bool:
     return isinstance(var, Parameter)
 
 
+def _write_slab_var(path: str, tbl) -> None:
+    """Persist one LazyEmbeddingTable as a slab section stream — spilled
+    segments go disk→disk one bounded section at a time, never
+    materializing the table in RAM (docs/PS_DATA_PLANE.md "Capacity
+    tier")."""
+    from . import slab_spill
+    with open(path, "wb") as f:
+        slab_spill.write_section_stream(
+            f, slab_spill.table_sections(tbl, with_crc=False))
+
+
+def _load_slab_var(path: str):
+    """Inverse of ``_write_slab_var``: rebuild the table section-by-
+    section (ONE section in RAM at a time — the header scan seeks past
+    payloads, and ``build_table_from_sections`` pulls each payload on
+    demand; a tiered table re-spills under FLAGS_ps_slab_spill_dir or
+    a fresh tempdir)."""
+    from . import slab_spill
+    with open(path, "rb") as f:
+        index = {name: (off, plen) for name, off, plen
+                 in slab_spill.scan_section_headers(f)}
+
+        def _sec(n):
+            if n not in index:
+                raise core.SpillCorruptionError(
+                    f"{path}: section {n!r} missing from the stream")
+            off, plen = index[n]
+            f.seek(off)
+            payload = f.read(plen)
+            if len(payload) != plen:
+                raise core.SpillCorruptionError(
+                    f"{path}: section {n!r} truncated")
+            return payload
+
+        meta = json.loads(_sec("tier:meta"))
+        return slab_spill.build_table_from_sections(meta, _sec)
+
+
+def _drop_replaced_table(var) -> None:
+    """Release the spill log of a tiered table about to be replaced
+    wholesale — set_value alone would leak the on-disk log + fd."""
+    old = var.value() if var is not None else None
+    if isinstance(old, core.LazyEmbeddingTable):
+        try:
+            old.close_spill(unlink=True)
+        except Exception:
+            pass
+
+
+def _is_slab_file(path: str) -> bool:
+    from .slab_spill import SLAB_STREAM_MAGIC
+    try:
+        with open(path, "rb") as f:
+            return f.read(len(SLAB_STREAM_MAGIC)) == SLAB_STREAM_MAGIC
+    except OSError:
+        return False
+
+
 def save_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
     if main_program is None:
@@ -132,10 +190,26 @@ def save_vars(executor, dirname, main_program=None, vars=None,
             sv = scope.find_var(v.name)
             if sv is None or not sv.is_initialized():
                 continue
+            if isinstance(sv.value(), core.LazyEmbeddingTable):
+                # slab table (possibly spill-tiered): streamed section
+                # file instead of a RAM-materializing dense export
+                _write_slab_var(os.path.join(dirname, v.name),
+                                sv.value())
+                continue
             with open(os.path.join(dirname, v.name), "wb") as f:
                 f.write(_serialize_lod_tensor(sv.get_tensor()))
     else:
         os.makedirs(dirname or ".", exist_ok=True)
+        slabs = [v.name for v in vars
+                 if (sv := scope.find_var(v.name)) is not None
+                 and sv.is_initialized()
+                 and isinstance(sv.value(), core.LazyEmbeddingTable)]
+        if slabs:
+            raise ValueError(
+                f"save_vars(filename=...): slab tables "
+                f"{', '.join(slabs)} cannot join a combined tensor "
+                f"stream — save them per-var (filename=None), where "
+                f"they stream section-by-section")
         with open(os.path.join(dirname, filename), "wb") as f:
             for v in vars:
                 sv = scope.find_var(v.name)
@@ -173,8 +247,16 @@ def load_vars(executor, dirname, main_program=None, vars=None,
                 f"{dirname}: " + ", ".join(sorted(missing)))
         for v in vars:
             path = os.path.join(dirname, v.name)
-            with open(path, "rb") as f:
-                scope.var(v.name).set_value(_deserialize_lod_tensor(f.read()))
+            if _is_slab_file(path):
+                new_val = _load_slab_var(path)
+            else:
+                with open(path, "rb") as f:
+                    new_val = _deserialize_lod_tensor(f.read())
+            # release a live tiered table's spill log only AFTER the
+            # replacement loaded — dropping first would brick the
+            # still-installed table's cold rows on a torn restore
+            _drop_replaced_table(scope.find_var(v.name))
+            scope.var(v.name).set_value(new_val)
     else:
         with open(os.path.join(dirname, filename), "rb") as f:
             data = f.read()
@@ -386,12 +468,26 @@ def save_checkpoint(executor, dirname, main_program=None, scope=None,
         if sv is None or not sv.is_initialized():
             continue
         val = sv.value()
+        path = os.path.join(tmp, v.name)
+        if isinstance(val, core.LazyEmbeddingTable):
+            # slab table: STREAM the section file (spilled segments go
+            # disk→disk one bounded section at a time — a part-spilled
+            # table checkpoints at O(one section) peak RSS) and record
+            # the incrementally-computed crc32/size in the manifest
+            # like any tensor blob
+            from . import slab_spill
+            with open(path, "wb") as f:
+                crc, size = slab_spill.write_section_stream(
+                    f, slab_spill.table_sections(val, with_crc=False))
+                f.flush()
+                os.fsync(f.fileno())
+            files[v.name] = {"crc32": crc, "size": size}
+            continue
         if not isinstance(val, LoDTensor):
             _LOG.warning("checkpoint: skipping non-dense persistable "
                          "'%s' (%s)", v.name, type(val).__name__)
             continue
         blob = _serialize_lod_tensor(val)
-        path = os.path.join(tmp, v.name)
         with open(path, "wb") as f:
             f.write(blob)
             f.flush()
@@ -559,12 +655,19 @@ def build_handoff_manifest(slot: str, epoch_next: int, view_next,
                            dedup_hwms=None, extra=None) -> Dict[str, Any]:
     """Manifest for one shard handoff. ``sections`` maps section name →
     {"kind": ..., "bytes": <payload>, "meta": {...}}; the payload itself
-    is NOT embedded — only its crc32/size, checkpoint-manifest style."""
+    is NOT embedded — only its crc32/size, checkpoint-manifest style.
+    Streaming sections (the capacity tier's spilled-table legs) carry
+    precomputed ``crc32``/``size`` instead of ``bytes`` — the payload
+    is regenerated at stream time, never held for the manifest."""
     files = {}
     for name, sec in sections.items():
-        blob = sec["bytes"]
-        files[name] = {"crc32": zlib.crc32(blob) & 0xFFFFFFFF,
-                       "size": len(blob), "kind": sec.get("kind", "raw"),
+        if "bytes" in sec:
+            blob = sec["bytes"]
+            crc, size = zlib.crc32(blob) & 0xFFFFFFFF, len(blob)
+        else:
+            crc, size = int(sec["crc32"]), int(sec["size"])
+        files[name] = {"crc32": crc,
+                       "size": size, "kind": sec.get("kind", "raw"),
                        "meta": sec.get("meta") or {}}
     return {
         "format_version": HANDOFF_FORMAT_VERSION,
@@ -620,8 +723,19 @@ def load_checkpoint(executor, path, main_program=None, scope=None
             raise core.CheckpointError(
                 f"no valid checkpoint found under {path}")
     for name in manifest.get("files", {}):
-        with open(os.path.join(ckpt_dir, name), "rb") as f:
-            scope.var(name).set_value(_deserialize_lod_tensor(f.read()))
+        fpath = os.path.join(ckpt_dir, name)
+        if _is_slab_file(fpath):
+            # slab table section stream (validated by the manifest's
+            # whole-file CRC above, like every other blob)
+            new_val = _load_slab_var(fpath)
+        else:
+            with open(fpath, "rb") as f:
+                new_val = _deserialize_lod_tensor(f.read())
+        # release a live tiered table's spill log only AFTER the
+        # replacement loaded — dropping first would brick the still-
+        # installed table's cold rows on a torn restore
+        _drop_replaced_table(scope.find_var(name))
+        scope.var(name).set_value(new_val)
     counter = int(manifest.get("rng_counter", 0))
     scope.var(RNG_COUNTER_VAR).set_value(
         LoDTensor(np.asarray([counter], np.int32)))

@@ -1321,6 +1321,11 @@ _WIRE_ERRORS: Dict[str, type] = {
     # elastic membership: surfaced only after the client exhausted its
     # stale-view replays (VarClient.call re-routes transparently first)
     "StaleClusterViewError": core.StaleClusterViewError,
+    # capacity tier: a pull touching a torn/bit-flipped spill segment
+    # is REFUSED typed (docs/PS_DATA_PLANE.md "Capacity tier") — the
+    # trainer sees the integrity fault, never silently-corrupt rows
+    "SpillCorruptionError": core.SpillCorruptionError,
+    "CheckpointError": core.CheckpointError,
 }
 
 

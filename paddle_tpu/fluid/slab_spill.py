@@ -1,0 +1,734 @@
+"""Disk tier for ``core.LazyEmbeddingTable`` — the capacity half of the
+reference's PSLib SSD-tiered sparse tables (reference:
+framework/fleet/fleet_wrapper.h DownpourSparseTable + the
+``distributed/`` SSD table stack: tables far larger than host RAM keep a
+pinned hot set resident and page cold features through a disk log).
+
+``SpillStore`` is a per-table, append-only, CRC-stamped segment log:
+
+  * one segment = one eviction batch (ids + encoded rows), written as a
+    single contiguous record and read back with ONE mmap slice — a cold
+    ``get_rows`` costs one I/O fan-in per touched segment, never one
+    seek per id;
+  * every record carries its crc32 in the in-RAM directory and is
+    verified on every read — a torn, bit-flipped, or deleted log
+    surfaces ``core.SpillCorruptionError`` (the PR 3 checkpoint
+    contract: corrupt state is REFUSED, never served);
+  * rows are encoded AT REST with the PR 11 wire codec
+    (``ps_rpc._quant_int8`` / fp16 downcast): ``""`` raw, ``"fp16"``
+    half-precision, ``"int8"`` per-row absmax scales — ~2×/~3.6× row
+    density over f32 before a byte even spills. A segment containing
+    non-finite rows stores RAW so dequant-on-touch sees the poison
+    exactly (the FLAGS_ps_reject_nonfinite guard decides, docs/
+    PS_DATA_PLANE.md "Capacity tier");
+  * dead bytes (promoted/shrunk rows, freed segments) are compacted
+    away once they exceed the live half of the log.
+
+The section-stream helpers at the bottom (``table_sections`` /
+``build_table_from_sections``) are the ONE serialization of a tiered
+table, shared by the PR 6 drain/rejoin handoff and ``io.save_checkpoint``
+— both stream a part-spilled table section-by-section without ever
+materializing it in RAM (spilled segments travel as their VERBATIM
+encoded records, so a handoff is bit-identical by construction).
+"""
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import threading
+import zlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from . import core
+
+__all__ = ["SpillStore", "encode_rows", "decode_rows",
+           "table_sections", "build_table_from_sections",
+           "scan_section_headers", "iter_section_stream",
+           "write_section_stream", "merge_tier_stats",
+           "SLAB_STREAM_MAGIC"]
+
+# at-rest quantization modes (same vocabulary as FLAGS_ps_wire_quant)
+QUANT_MODES = ("", "fp16", "int8")
+
+
+def _wire_codec():
+    # the PR 11 wire codec, imported lazily: core must stay importable
+    # without the RPC stack, and by the time a table spills the pserver
+    # has ps_rpc loaded anyway
+    from . import ps_rpc
+    return ps_rpc._quant_int8, ps_rpc._dequant_int8
+
+
+def encode_rows(rows: np.ndarray, quant: str) -> Tuple[bytes, str, int]:
+    """Encode one eviction batch for the log. Returns ``(payload,
+    quant_used, row_bytes)`` — ``quant_used`` may downgrade to ``""``
+    when the rows are non-float, already narrower than the target, or
+    contain non-finite values (poison must reach dequant-on-touch
+    exactly; masking it behind a lossy encode would let a NaN row
+    round-trip as a finite one). ``row_bytes`` is the stored byte count
+    attributable to row data (incl. int8 scales) — the density-gauge
+    numerator's denominator."""
+    rows = np.ascontiguousarray(rows)
+    if quant not in QUANT_MODES:
+        raise ValueError(f"at-rest quant mode {quant!r} — expected one "
+                         f"of {QUANT_MODES}")
+    if quant and (not np.issubdtype(rows.dtype, np.floating)
+                  or not np.isfinite(rows).all()):
+        quant = ""
+    if quant == "fp16" and rows.dtype.itemsize <= 2:
+        quant = ""
+    if quant == "int8":
+        # same expansion gate as the wire codec: the 4-byte per-row
+        # scale EXPANDS very narrow rows (a [*, 1] wide table stored
+        # int8 would be 5 B/row vs 4 B raw) — store those raw
+        dim = rows.shape[-1] if rows.ndim > 1 else rows.size
+        if dim * rows.dtype.itemsize <= dim + 4:
+            quant = ""
+    if quant == "fp16":
+        with np.errstate(over="ignore"):  # overflow detected just below
+            cast = rows.astype(np.float16)
+        if not np.isfinite(cast).all():
+            # a FINITE row overflowed the fp16 range (|v| > 65504):
+            # storing the inf would mint poison out of healthy values
+            # (and trip/skip the non-finite guard wrongly) — store raw
+            blob = rows.tobytes()
+            return blob, "", len(blob)
+        blob = cast.tobytes()
+        return blob, "fp16", len(blob)
+    if quant == "int8":
+        qi8, _ = _wire_codec()
+        q, scale = qi8(rows.astype(np.float32, copy=False))
+        blob = scale.astype(np.float32).tobytes() + q.tobytes()
+        return blob, "int8", len(blob)
+    blob = rows.tobytes()
+    return blob, "", len(blob)
+
+
+def decode_rows(payload: bytes, quant: str, n_rows: int, dim: int,
+                dtype: np.dtype) -> np.ndarray:
+    """Inverse of ``encode_rows`` — dequant-on-touch. Accepts any
+    buffer (mmap slices included); always returns a fresh writable
+    array in the table's dtype."""
+    dtype = np.dtype(dtype)
+    if quant == "fp16":
+        arr = np.frombuffer(payload, np.float16).reshape(n_rows, dim)
+        return arr.astype(dtype)
+    if quant == "int8":
+        _, dq = _wire_codec()
+        scale = np.frombuffer(payload, np.float32, n_rows)
+        q = np.frombuffer(payload, np.int8, n_rows * dim,
+                          offset=n_rows * 4).reshape(n_rows, dim)
+        return dq(q, scale, dtype).copy()
+    return np.frombuffer(payload, dtype).reshape(n_rows, dim).copy()
+
+
+class _Seg:
+    __slots__ = ("off", "nbytes", "crc", "n_rows", "quant", "row_bytes")
+
+    def __init__(self, off, nbytes, crc, n_rows, quant, row_bytes):
+        self.off = int(off)
+        self.nbytes = int(nbytes)
+        self.crc = int(crc)
+        self.n_rows = int(n_rows)
+        self.quant = quant
+        self.row_bytes = int(row_bytes)
+
+    def meta(self) -> Dict[str, Any]:
+        return {"n_rows": self.n_rows, "quant": self.quant,
+                "row_bytes": self.row_bytes, "crc": self.crc,
+                "nbytes": self.nbytes}
+
+
+class SpillStore:
+    """Append-only segment log for one table's cold rows.
+
+    Record layout (all offsets/CRCs live in the in-RAM directory — the
+    log is a CACHE tier, rebuilt from handoff/checkpoint sections on
+    restart, so it needs no self-describing framing):
+
+        int64 ids[n_rows] | encoded rows payload (encode_rows)
+
+    Reads go through one ``mmap`` remapped as the file grows; the CRC
+    of the whole record is verified on EVERY read, so serving a row
+    from a torn or bit-flipped log is impossible
+    (``core.SpillCorruptionError``, tests/faultinject.corrupt_spill)."""
+
+    def __init__(self, path: str, dim: int, dtype=np.float32):
+        self.path = str(path)
+        self.dim = int(dim)
+        self.dtype = np.dtype(dtype)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._f = open(self.path, "wb+")
+        self._mm: Optional[mmap.mmap] = None
+        self._next_seg = 0
+        self._segs: Dict[int, _Seg] = {}
+        self._lock = threading.Lock()
+        self._dead_bytes = 0
+        self._live_bytes = 0  # incremental mirror of sum(seg.nbytes)
+        # counters (scraped through the table's tier stats)
+        self.reads = 0
+        self.writes = 0
+        self.compactions = 0
+        self.crc_failures = 0
+
+    # -- write side -------------------------------------------------------
+    def append(self, ids: np.ndarray, rows: np.ndarray,
+               quant: str = "") -> int:
+        """Write one eviction batch; returns its segment id."""
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
+        payload, quant_used, row_bytes = encode_rows(rows, quant)
+        record = ids.tobytes() + payload
+        return self._append_record(record, len(ids), quant_used,
+                                   row_bytes)
+
+    def append_raw(self, record: bytes, n_rows: int, quant: str,
+                   row_bytes: int, expect_crc: Optional[int] = None) -> int:
+        """Install a VERBATIM record (handoff/checkpoint rebuild). The
+        caller supplies the directory fields; ``expect_crc`` re-checks
+        the bytes against the source's stamp before they enter the log."""
+        if expect_crc is not None:
+            crc = zlib.crc32(record) & 0xFFFFFFFF
+            if crc != int(expect_crc):
+                self.crc_failures += 1
+                raise core.SpillCorruptionError(
+                    f"spill segment rebuild for {self.path}: record CRC "
+                    f"{crc:#x} != manifest {int(expect_crc):#x}")
+        return self._append_record(bytes(record), int(n_rows), quant,
+                                   int(row_bytes))
+
+    def _append_record(self, record: bytes, n_rows: int, quant: str,
+                       row_bytes: int) -> int:
+        with self._lock:
+            self._f.seek(0, os.SEEK_END)
+            off = self._f.tell()
+            self._f.write(record)
+            self._f.flush()
+            sid = self._next_seg
+            self._next_seg += 1
+            self._segs[sid] = _Seg(off, len(record),
+                                   zlib.crc32(record) & 0xFFFFFFFF,
+                                   n_rows, quant, row_bytes)
+            self._live_bytes += len(record)
+            self.writes += 1
+            return sid
+
+    # -- read side --------------------------------------------------------
+    def _record_view(self, seg: _Seg) -> memoryview:
+        """Zero-copy view of one record via the shared mmap (remapped
+        when the file has grown past the current mapping)."""
+        end = seg.off + seg.nbytes
+        if self._mm is None or len(self._mm) < end:
+            if self._mm is not None:
+                self._mm.close()
+                self._mm = None
+            size = os.path.getsize(self.path)
+            if size < end:
+                self.crc_failures += 1
+                raise core.SpillCorruptionError(
+                    f"spill log {self.path} truncated: segment needs "
+                    f"bytes [{seg.off}, {end}) but the file holds "
+                    f"{size}")
+            self._mm = mmap.mmap(self._f.fileno(), size,
+                                 access=mmap.ACCESS_READ)
+        return memoryview(self._mm)[seg.off:end]
+
+    def read(self, seg_id: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(ids, rows) of one segment — ONE mmap slice, CRC-verified,
+        rows dequantized to the table dtype."""
+        with self._lock:
+            seg = self._segs[seg_id]
+            try:
+                view = self._record_view(seg)
+            except (OSError, ValueError) as e:
+                self.crc_failures += 1
+                raise core.SpillCorruptionError(
+                    f"spill log {self.path} unreadable for segment "
+                    f"{seg_id}: {e}") from e
+            if (zlib.crc32(view) & 0xFFFFFFFF) != seg.crc:
+                self.crc_failures += 1
+                raise core.SpillCorruptionError(
+                    f"spill segment {seg_id} of {self.path} failed its "
+                    f"CRC check (torn write or bit rot) — refusing to "
+                    f"serve its rows")
+            ids = np.frombuffer(view, np.int64, seg.n_rows).copy()
+            rows = decode_rows(view[seg.n_rows * 8:], seg.quant,
+                               seg.n_rows, self.dim, self.dtype)
+            self.reads += 1
+            return ids, rows
+
+    def read_record(self, seg_id: int) -> Tuple[bytes, _Seg]:
+        """Verbatim (record bytes, directory entry) — the handoff/
+        checkpoint stream leg. CRC-verified like ``read``."""
+        with self._lock:
+            seg = self._segs[seg_id]
+            try:
+                view = self._record_view(seg)
+            except (OSError, ValueError) as e:
+                self.crc_failures += 1
+                raise core.SpillCorruptionError(
+                    f"spill log {self.path} unreadable for segment "
+                    f"{seg_id}: {e}") from e
+            if (zlib.crc32(view) & 0xFFFFFFFF) != seg.crc:
+                self.crc_failures += 1
+                raise core.SpillCorruptionError(
+                    f"spill segment {seg_id} of {self.path} failed its "
+                    f"CRC check — refusing to export it")
+            return bytes(view), seg
+
+    # -- lifecycle --------------------------------------------------------
+    def free(self, seg_id: int) -> None:
+        """Drop a fully-promoted/shrunk segment; compact when dead
+        bytes outweigh live ones."""
+        with self._lock:
+            seg = self._segs.pop(seg_id, None)
+            if seg is None:
+                return
+            self._dead_bytes += seg.nbytes
+            self._live_bytes -= seg.nbytes
+            need_compact = (self._dead_bytes
+                            > max(self._live_bytes, 1 << 20))
+        if need_compact:
+            self.compact()
+
+    def compact(self) -> None:
+        """Rewrite live segments into a fresh log (one segment in RAM
+        at a time), dropping dead bytes. Directory offsets update;
+        segment ids are stable, so table-side (seg, row) refs survive."""
+        with self._lock:
+            tmp_path = self.path + ".compact"
+            tmp = open(tmp_path, "wb+")
+            new_off = {}
+            try:
+                for sid, seg in self._segs.items():
+                    view = self._record_view(seg)
+                    try:
+                        if (zlib.crc32(view) & 0xFFFFFFFF) != seg.crc:
+                            self.crc_failures += 1
+                            raise core.SpillCorruptionError(
+                                f"spill segment {sid} of {self.path} "
+                                f"failed its CRC during compaction — "
+                                f"log abandoned")
+                        new_off[sid] = tmp.tell()
+                        tmp.write(view)
+                    finally:
+                        # an exported view would make the mmap close
+                        # below raise BufferError
+                        view.release()
+            except BaseException:
+                # any failure (CRC, truncated-log read) must not leak
+                # the temp file or its fd
+                tmp.close()
+                try:
+                    os.unlink(tmp_path)
+                except OSError:
+                    pass
+                raise
+            tmp.flush()
+            if self._mm is not None:
+                self._mm.close()
+                self._mm = None
+            self._f.close()
+            os.replace(tmp_path, self.path)
+            self._f = tmp
+            for sid, off in new_off.items():
+                self._segs[sid].off = off
+            self._dead_bytes = 0
+            self.compactions += 1
+
+    def clear(self) -> None:
+        """Drop EVERY segment and truncate the log in one step — the
+        wholesale-replace path (``import_state``). Per-segment
+        ``free()`` there would trip compaction repeatedly, rewriting
+        segments that are about to be dropped anyway."""
+        with self._lock:
+            if self._mm is not None:
+                self._mm.close()
+                self._mm = None
+            self._segs.clear()
+            self._dead_bytes = 0
+            self._live_bytes = 0
+            self._f.seek(0)
+            self._f.truncate()
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._mm is not None:
+                self._mm.close()
+                self._mm = None
+            try:
+                self._f.close()
+            except Exception:
+                pass
+
+    def unlink(self) -> None:
+        self.close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        # the rebuild paths mkdtemp() a private "pt-…" dir per table
+        # when no spill dir is configured — remove it once its log is
+        # gone (rmdir refuses non-empty dirs, so a shared configured
+        # dir is never touched; the prefix guard keeps us off any
+        # user-named dir that happens to be empty)
+        parent = os.path.dirname(self.path)
+        if os.path.basename(parent).startswith("pt-"):
+            try:
+                os.rmdir(parent)
+            except OSError:
+                pass
+
+    # -- introspection ----------------------------------------------------
+    def segments(self) -> List[int]:
+        with self._lock:
+            return sorted(self._segs)
+
+    def seg_meta(self, seg_id: int) -> Dict[str, Any]:
+        with self._lock:
+            return self._segs[seg_id].meta()
+
+    def file_bytes(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def live_bytes(self) -> int:
+        with self._lock:
+            return self._live_bytes
+
+
+# ===========================================================================
+# section streams — the ONE serialization of a (possibly tiered) table.
+#
+# Section vocabulary (names are relative; callers prefix the var name):
+#   tier:meta     json — table meta + tier config + layout (hot chunking,
+#                 segment order + per-segment directory fields, live maps)
+#   tier:hotids   int64 hot ids in LRU order (oldest first)
+#   tier:hot:<k>  raw rows of hot chunk k, table dtype, LRU order
+#   tier:seg:<j>  VERBATIM spill-log record of the j-th live segment
+#   tier:state    gate/shrink state: score ids+f32 scores, freq ids+i64
+#                 counts (empty arrays when tracking is off)
+#
+# Every section is bounded (hot chunks at HOT_CHUNK_ROWS, segments at the
+# eviction batch size), so both producing and consuming sides stay
+# RSS-bounded no matter how large the spilled table is.
+# ===========================================================================
+HOT_CHUNK_ROWS = 65536
+
+# process-monotonic suffix for rebuilt spill logs (two rebuilds into one
+# configured spill dir must never truncate each other's live log)
+import itertools as _itertools  # noqa: E402
+_REBUILD_SEQ = _itertools.count()
+
+
+def merge_tier_stats(stats_list) -> Dict[str, Any]:
+    """Aggregate tier_stats() dicts (across tables or across servers):
+    numeric leaves sum, then the RATIO gauges — hit_rate, density_x —
+    are recomputed from the summed counters (summed ratios are
+    garbage). The ONE merge rule the pserver slab snapshot and the
+    bench evidence scrape share."""
+    agg: Dict[str, Any] = {}
+    n = 0
+    for s in stats_list:
+        if not s:
+            continue
+        n += 1
+        for k, v in s.items():
+            if isinstance(v, (int, float)):
+                agg[k] = agg.get(k, 0) + v
+    if not n:
+        return {}
+    touches = agg.get("hits", 0) + agg.get("misses", 0)
+    agg["hit_rate"] = round(agg.get("hits", 0) / touches, 4) \
+        if touches else 0.0
+    sp = agg.get("spilled_bytes", 0)
+    agg["density_x"] = round(
+        agg.get("logical_spilled_bytes", 0) / sp, 3) if sp else 0.0
+    # second-level merges (bench over per-server aggregates) already
+    # carry summed table counts — keep them; first-level merges count
+    # the input dicts
+    if "tables" not in agg:
+        agg["tables"] = n
+    return agg
+
+
+def _pack_arrays(*arrays: np.ndarray) -> bytes:
+    parts = []
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        parts.append(np.int64(a.nbytes).tobytes())
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def _unpack_arrays(blob, specs) -> List[np.ndarray]:
+    out, off = [], 0
+    view = memoryview(blob)
+    for dtype in specs:
+        (nbytes,) = np.frombuffer(view, np.int64, 1, offset=off)
+        off += 8
+        out.append(np.frombuffer(view, np.dtype(dtype),
+                                 int(nbytes) // np.dtype(dtype).itemsize,
+                                 offset=off).copy())
+        off += int(nbytes)
+    return out
+
+
+def table_sections(tbl, with_crc: bool = True
+                   ) -> "OrderedDict[str, Dict[str, Any]]":
+    """Streaming export of ANY LazyEmbeddingTable: an ordered map of
+    section name → {"kind", "meta", "read"} where ``read()``
+    regenerates the section's bytes on demand. With ``with_crc`` (the
+    handoff path) per-section crc32/size are precomputed ONE bounded
+    section at a time so the CRC manifest can be built without holding
+    the payload; the checkpoint path passes False — its integrity is
+    the manifest's whole-file CRC, and the per-section pass would
+    encode+CRC the hot slab twice. Deterministic as long as the table
+    is not mutated between the crc pass and the stream pass (the
+    handoff holds the grad lock across both). Spill segments carry
+    their directory crc/size either way (free, and verbatim bytes)."""
+    tier = tbl._tier
+    meta = dict(tbl.export_meta())
+    sections: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+
+    def _add(name, read_fn, kind="tier"):
+        sec = {"kind": kind, "meta": {}, "read": read_fn}
+        if with_crc:
+            blob = read_fn()
+            sec["size"] = len(blob)
+            sec["crc32"] = zlib.crc32(blob) & 0xFFFFFFFF
+        sections[name] = sec
+
+    n_hot = len(tbl._index)
+    hot_ids = np.fromiter(tbl._index.keys(), np.int64, n_hot)
+    hot_slots = np.fromiter(tbl._index.values(), np.int64, n_hot)
+    chunks = []
+    for k in range(0, max(n_hot, 1), HOT_CHUNK_ROWS):
+        lo, hi = k, min(k + HOT_CHUNK_ROWS, n_hot)
+        chunks.append((lo, hi))
+
+    seg_dir = []
+    if tier is not None and tier.store is not None:
+        # segment stream order = segment id order (append order); the
+        # per-segment LIVE map (which record rows are still cold) rides
+        # the meta so the rebuild can skip promoted-out rows
+        live_by_seg: Dict[int, List[Tuple[int, int]]] = {}
+        for rid, (sid, pos) in tier.cold.items():
+            live_by_seg.setdefault(sid, []).append((pos, int(rid)))
+        for sid in tier.store.segments():
+            live = sorted(live_by_seg.get(sid, []))
+            if not live:
+                # backing-only segment: every ref is a CLEAN hot row,
+                # whose value ships in the hot sections — the record
+                # itself has nothing the destination needs
+                continue
+            sm = tier.store.seg_meta(sid)
+            sm["sid"] = sid
+            # run-length encode the live positions (fresh segments are
+            # fully live = one run; promotions punch holes) — keeps
+            # the manifest metadata O(runs), not O(spilled rows)
+            runs: List[List[int]] = []
+            for p, _ in live:
+                if runs and p == runs[-1][0] + runs[-1][1]:
+                    runs[-1][1] += 1
+                else:
+                    runs.append([p, 1])
+            sm["live_runs"] = runs
+            seg_dir.append(sm)
+
+    meta["tier_layout"] = {
+        "n_hot": int(n_hot),
+        "hot_chunk_rows": HOT_CHUNK_ROWS,
+        "hot_chunks": len(chunks) if n_hot else 0,
+        "segments": seg_dir,
+    }
+
+    _add("tier:meta",
+         lambda m=meta: json.dumps(m, sort_keys=True).encode(),
+         kind="tier_meta")
+    _add("tier:hotids", lambda a=hot_ids: a.tobytes())
+    if n_hot:
+        for k, (lo, hi) in enumerate(chunks):
+            _add(f"tier:hot:{k}",
+                 lambda lo=lo, hi=hi: np.ascontiguousarray(
+                     tbl._data[hot_slots[lo:hi]]).tobytes())
+    for sm in seg_dir:
+        sid = sm["sid"]
+
+        def _read_seg(sid=sid, crc=sm["crc"]):
+            record, seg = tier.store.read_record(sid)
+            return record
+
+        sections[f"tier:seg:{sid}"] = {
+            "kind": "tier", "meta": {},
+            "size": int(sm["nbytes"]), "crc32": int(sm["crc"]),
+            "read": _read_seg}
+
+    def _read_state():
+        sc_ids, sc_vals, fq_ids, fq_cnt = tbl._export_gate_state()
+        return _pack_arrays(sc_ids, sc_vals, fq_ids, fq_cnt)
+
+    _add("tier:state", _read_state)
+    return sections
+
+
+def build_table_from_sections(meta: Dict[str, Any],
+                              section_bytes: Callable[[str], bytes],
+                              spill_path: Optional[str] = None):
+    """Rebuild a table from a ``table_sections`` stream. ``meta`` is the
+    decoded ``tier:meta`` json; ``section_bytes(name)`` returns one
+    section's payload (from staged files, a checkpoint stream, ...) —
+    called one section at a time, so peak RSS is one bounded section
+    plus the hot slab. ``spill_path`` overrides where the rebuilt
+    table's spill log lives (required when the meta says tiered)."""
+    from .core import LazyEmbeddingTable
+    layout = meta["tier_layout"]
+    tier = meta.get("tier") or {}
+    kw = {}
+    if tier:
+        if tier.get("spilled") and not spill_path:
+            # never reuse the SOURCE's log path (both processes may
+            # share the box): configured spill dir, else a fresh
+            # tempdir; a process-monotonic counter keeps concurrent
+            # rebuilds in one dir from colliding
+            import tempfile
+            sdir = str(core.globals_["FLAGS_ps_slab_spill_dir"] or "") \
+                or tempfile.mkdtemp(prefix="pt-slab-")
+            spill_path = os.path.join(
+                sdir,
+                f"rebuild-{os.getpid()}-{next(_REBUILD_SEQ)}.slab")
+        kw = dict(spill_path=spill_path if tier.get("spilled") else None,
+                  hot_rows=int(tier.get("hot_rows", 0)),
+                  at_rest_quant=tier.get("quant", ""),
+                  entry_threshold=int(tier.get("entry_threshold", 0)),
+                  spill_seg_rows=int(tier.get("seg_rows", 0)),
+                  track_scores=tier.get("track_scores"))
+    tbl = LazyEmbeddingTable(
+        height=int(meta["height"]), dim=int(meta["dim"]),
+        seed=int(meta["seed"]), scale=float(meta["scale"]),
+        max_rows=meta.get("max_rows"), dtype=np.dtype(meta["dtype"]),
+        **kw)
+    try:
+        tbl.evictions = int(meta.get("evictions", 0))
+
+        n_hot = int(layout["n_hot"])
+        hot_ids = np.frombuffer(section_bytes("tier:hotids"), np.int64)
+        if len(hot_ids) != n_hot:
+            raise core.SpillCorruptionError(
+                f"slab stream: hot id section holds {len(hot_ids)} "
+                f"ids, meta says {n_hot}")
+        # hot slab, chunk at a time, LRU order preserved
+        filled = 0
+        for k in range(int(layout.get("hot_chunks", 0))):
+            rows = np.frombuffer(section_bytes(f"tier:hot:{k}"),
+                                 tbl.dtype).reshape(-1, tbl.dim)
+            tbl._install_hot_rows(hot_ids[filled:filled + len(rows)],
+                                  rows)
+            filled += len(rows)
+        if filled != n_hot:
+            raise core.SpillCorruptionError(
+                f"slab stream: hot chunks supplied {filled} rows, "
+                f"meta says {n_hot}")
+        # spilled segments, verbatim records
+        for sm in layout.get("segments", []):
+            record = section_bytes(f"tier:seg:{sm['sid']}")
+            tbl._install_spilled_segment(record, sm)
+        sc_ids, sc_vals, fq_ids, fq_cnt = _unpack_arrays(
+            section_bytes("tier:state"),
+            (np.int64, np.float32, np.int64, np.int64))
+        tbl._import_gate_state(sc_ids, sc_vals, fq_ids, fq_cnt)
+    except BaseException:
+        # a rejected (torn/short) stream must not leak the partially
+        # built table's fresh spill log — rejection is a tested,
+        # RETRIED path
+        tbl.close_spill(unlink=True)
+        raise
+    return tbl
+
+
+# ---------------------------------------------------------------------------
+# one-file section-stream container (io.save_checkpoint / save_persistables
+# of a slab table): MAGIC, then per section u32 name_len | name |
+# u64 payload_len | payload, in table_sections order. Self-delimiting;
+# whole-file integrity rides the checkpoint manifest's crc32/size like any
+# other tensor blob.
+# ---------------------------------------------------------------------------
+SLAB_STREAM_MAGIC = b"PTSLAB01"
+
+
+def write_section_stream(fobj, sections) -> Tuple[int, int]:
+    """Stream ``table_sections`` output into ``fobj`` one section at a
+    time. Returns (crc32, size) of everything written — computed
+    incrementally, so a spilled table checkpoints at O(one section)
+    peak RSS."""
+    import struct
+    crc = zlib.crc32(SLAB_STREAM_MAGIC)
+    size = len(SLAB_STREAM_MAGIC)
+    fobj.write(SLAB_STREAM_MAGIC)
+    for name, sec in sections.items():
+        payload = sec["read"]()
+        nm = name.encode()
+        head = struct.pack("<I", len(nm)) + nm + \
+            struct.pack("<Q", len(payload))
+        fobj.write(head)
+        fobj.write(payload)
+        crc = zlib.crc32(head, crc)
+        crc = zlib.crc32(payload, crc)
+        size += len(head) + len(payload)
+    return crc & 0xFFFFFFFF, size
+
+
+def scan_section_headers(fobj) -> Iterable[Tuple[str, int, int]]:
+    """Yield (name, payload_offset, payload_len) from a
+    ``write_section_stream`` file, SEEKING past payloads — the one
+    framing parser both the streaming iterator and the on-demand
+    loader build on. Torn framing surfaces as the typed
+    ``core.SpillCorruptionError`` (the corruption contract), never a
+    bare struct/decode error."""
+    import struct
+    magic = fobj.read(len(SLAB_STREAM_MAGIC))
+    if magic != SLAB_STREAM_MAGIC:
+        raise core.SpillCorruptionError(
+            "slab stream: bad magic — not a slab-table section stream")
+    while True:
+        head = fobj.read(4)
+        if not head:
+            return
+        try:
+            (nlen,) = struct.unpack("<I", head)
+            if nlen > 4096:
+                # section names are tens of bytes; a huge length is a
+                # corrupt header — reading it would slurp the file
+                raise core.SpillCorruptionError(
+                    f"slab stream: absurd section-name length {nlen} "
+                    f"(corrupt header)")
+            name = fobj.read(nlen).decode()
+            (plen,) = struct.unpack("<Q", fobj.read(8))
+        except (struct.error, UnicodeDecodeError) as e:
+            raise core.SpillCorruptionError(
+                f"slab stream: torn section header ({e})") from e
+        off = fobj.tell()
+        fobj.seek(0, os.SEEK_END)
+        end = fobj.tell()
+        if off + plen > end:
+            raise core.SpillCorruptionError(
+                f"slab stream: section {name!r} truncated "
+                f"({end - off}/{plen} bytes)")
+        yield name, off, plen
+        fobj.seek(off + plen)
+
+
+def iter_section_stream(fobj) -> Iterable[Tuple[str, bytes]]:
+    """Yield (name, payload) from a ``write_section_stream`` file, one
+    section in RAM at a time."""
+    for name, off, plen in scan_section_headers(fobj):
+        fobj.seek(off)
+        payload = fobj.read(plen)
+        fobj.seek(off + plen)
+        yield name, payload

@@ -13,6 +13,7 @@ blocks, then serve gets until the next round. Async follows RunAsyncLoop
 """
 from __future__ import annotations
 
+import itertools
 import os
 import socket
 import threading
@@ -807,21 +808,69 @@ def _distributed_lookup_table_grad(ins, attrs):
     return {}
 
 
+_SPILL_PATH_SEQ = itertools.count()
+
+
+def _safe_name(name: str) -> str:
+    """Filesystem-safe var/section name — ONE collision-sensitive rule
+    shared by every spill/staging path builder (always paired with a
+    uniquifying sequence, since the mapping is lossy)."""
+    return "".join(c if c.isalnum() else "_" for c in name)
+
+
 @register_op("lazy_table_init", stateful=True, no_grad=True,
              attr_defaults={"height": 0, "dim": 0, "seed": 0,
                             "scale": 0.0, "max_rows": 0})
 def _lazy_table_init(ins, attrs):
     """Initializes a pserver var as a LazyEmbeddingTable: rows materialize
     on first touch, so the logical [height, dim] never allocates
-    (reference: fleet_wrapper.h DownpourSparseTable pull-creates)."""
+    (reference: fleet_wrapper.h DownpourSparseTable pull-creates).
+
+    Capacity tier (docs/PS_DATA_PLANE.md "Capacity tier"): the spill/
+    gating FLAGS are read HERE, at pserver startup — env-settable, so
+    subprocess pservers of one bench/test lane configure the tier
+    without new program attrs (the async-overlap flag precedent). With
+    the flags at their defaults the table is the exact pre-tier slab."""
     ctx = attrs["_ctx"]
+    name = ctx.op.output("Out")[0]
     scale = float(attrs.get("scale") or 0.0)
+    tier_kw = {}
+    spill_dir = str(core.globals_["FLAGS_ps_slab_spill_dir"] or "")
+    if spill_dir:
+        hot = int(core.globals_["FLAGS_ps_slab_hot_rows"])
+        if hot <= 0:
+            raise ValueError(
+                "FLAGS_ps_slab_spill_dir is set but "
+                "FLAGS_ps_slab_hot_rows is 0 — the spill tier needs a "
+                "hot-set bound (silently ignoring the spill dir would "
+                "run the table unbounded in RAM)")
+        # per-process sequence: two table names that sanitize to the
+        # same string (or a handoff-rebuilt replacement) must never
+        # open — and truncate — each other's live log
+        tier_kw = dict(
+            spill_path=os.path.join(
+                spill_dir,
+                f"{_safe_name(name)}-{os.getpid()}"
+                f"-i{next(_SPILL_PATH_SEQ)}.slab"),
+            hot_rows=hot,
+            at_rest_quant=str(
+                core.globals_["FLAGS_ps_at_rest_quant"] or ""),
+            spill_seg_rows=int(core.globals_["FLAGS_ps_slab_seg_rows"]),
+            track_scores=(True if core.globals_[
+                "FLAGS_ps_slab_track_scores"] else None))
+    thr = int(core.globals_["FLAGS_ps_entry_threshold"])
+    if thr > 1:
+        tier_kw["entry_threshold"] = thr
     tbl = core.LazyEmbeddingTable(
         height=int(attrs["height"]), dim=int(attrs["dim"]),
         seed=int(attrs.get("seed", 0)),
         scale=scale if scale > 0 else None,
-        max_rows=int(attrs.get("max_rows") or 0) or None)
-    ctx.scope.var(ctx.op.output("Out")[0]).set_value(tbl)
+        max_rows=int(attrs.get("max_rows") or 0) or None, **tier_kw)
+    # a startup re-run over a scope already holding a tiered table
+    # must release the old spill log (every replacement path does)
+    from ..fluid import io as fio
+    fio._drop_replaced_table(ctx.scope.find_var(name))
+    ctx.scope.var(name).set_value(tbl)
     return {}
 
 
@@ -1241,17 +1290,49 @@ def _listen_and_serv(ins, attrs):
             return tbl[np.asarray(rows, np.int64)]
 
     def h_table_stats(name):
-        """Introspection for tests/monitoring: touched rows + evictions."""
+        """Introspection for tests/monitoring: touched rows + evictions
+        (+ capacity-tier gauges for tiered tables)."""
         val = scope.find_var(name).value()
         if isinstance(val, core.LazyEmbeddingTable):
-            return {"touched": val.touched_rows(),
-                    "evictions": val.evictions,
-                    "nbytes": val.nbytes(),
-                    "logical_params": val.logical_params()}
+            out = {"touched": val.touched_rows(),
+                   "evictions": val.evictions,
+                   "nbytes": val.nbytes(),
+                   "logical_params": val.logical_params()}
+            # bounded acquire like _slab_stats_snapshot: a drain or a
+            # wedged optimize round holds the grad lock for seconds and
+            # this poll must not stall behind it (it just omits the
+            # tier section then)
+            if val._tier is not None and lock.acquire(timeout=1.0):
+                try:
+                    tier = val.tier_stats()
+                finally:
+                    lock.release()
+                if tier:
+                    out["tier"] = tier
+            return out
         arr = np.asarray(val.array)
         return {"touched": int(arr.shape[0]), "evictions": 0,
                 "nbytes": int(arr.nbytes),
                 "logical_params": int(arr.size)}
+
+    def h_table_shrink(name="", decay=0.5, threshold=0.5):
+        """Decay-based shrink of one named (or every) tiered/gated
+        table — the reference PSLib shrink() admin RPC. Runs under the
+        grad lock so it can't interleave with an apply."""
+        out = {}
+        with lock:
+            names = [name] if name else list(scope.local_var_names())
+            for n in names:
+                var = scope.find_var(n)
+                if var is None or not var.is_initialized():
+                    continue
+                val = var.value()
+                if isinstance(val, core.LazyEmbeddingTable) \
+                        and val._tier is not None \
+                        and val._tier.track_scores:
+                    out[n] = val.shrink(decay=float(decay),
+                                        threshold=float(threshold))
+        return out
 
     def h_checkpoint(dir=""):
         return True
@@ -1534,6 +1615,29 @@ def _listen_and_serv(ins, attrs):
     staging = {}
     staging_lock = threading.Lock()
 
+    def _clear_staging_locked():
+        sdir = staging.pop("dir", None)
+        staging.clear()
+        if sdir:
+            import shutil
+            shutil.rmtree(sdir, ignore_errors=True)
+
+    hand_seq = itertools.count()
+
+    def _dest_spill_path(var_name):
+        """Where a handed-off table's spill log lands on THIS server:
+        the configured spill dir, else a fresh tempdir (never the
+        source's path — both processes may share the box; the sequence
+        keeps a rebuilt table from truncating the log of the still-
+        installed table it replaces)."""
+        import tempfile
+        sdir = str(core.globals_["FLAGS_ps_slab_spill_dir"] or "")
+        if not sdir:
+            sdir = tempfile.mkdtemp(prefix="pt-slab-handoff-")
+        return os.path.join(
+            sdir, f"{_safe_name(var_name)}-{os.getpid()}"
+            f"-h{next(hand_seq)}.slab")
+
     def h_handoff_begin(manifest):
         # STANDBY is the normal destination; DRAINED covers the REJOIN
         # without a restart — drain A→B, later drain B→A re-uses the
@@ -1557,9 +1661,10 @@ def _listen_and_serv(ins, attrs):
                 f"handoff manifest format "
                 f"{manifest.get('format_version')!r} not supported")
         with staging_lock:
-            staging.clear()
+            _clear_staging_locked()
             staging["manifest"] = manifest
             staging["payloads"] = {}
+            staging["files"] = {}
         return True
 
     def h_handoff_section(name, payload):
@@ -1568,8 +1673,28 @@ def _listen_and_serv(ins, attrs):
             man = staging.get("manifest")
             if man is None:
                 raise RuntimeError("handoff_section before handoff_begin")
-            fio.check_handoff_section(man, name, blob)
-            staging["payloads"][name] = blob
+            entry = fio.check_handoff_section(man, name, blob)
+            if str(entry.get("kind", "")).startswith("tier"):
+                # capacity-tier sections STAGE ON DISK: the sum of a
+                # spilled table's sections is the whole table, and the
+                # destination's RSS must stay bounded by one section
+                # (docs/PS_DATA_PLANE.md "Capacity tier")
+                sdir = staging.get("dir")
+                if sdir is None:
+                    import tempfile
+                    sdir = staging["dir"] = tempfile.mkdtemp(
+                        prefix="pt-handoff-stage-")
+                # index prefix: two section names that sanitize to the
+                # same string must not clobber each other's staged
+                # bytes (the map below is keyed by the TRUE name)
+                path = os.path.join(
+                    sdir,
+                    f"{len(staging['files'])}-{_safe_name(name)}")
+                with open(path, "wb") as f:
+                    f.write(blob)
+                staging["files"][name] = path
+            else:
+                staging["payloads"][name] = blob
         return True
 
     def h_handoff_commit():
@@ -1578,15 +1703,28 @@ def _listen_and_serv(ins, attrs):
             if man is None:
                 raise RuntimeError("handoff_commit before handoff_begin")
             missing = sorted(set(man["sections"])
-                             - set(staging["payloads"]))
+                             - set(staging["payloads"])
+                             - set(staging["files"]))
             if missing:
                 raise core.CheckpointError(
                     f"handoff incomplete: {len(missing)} section(s) "
                     f"never arrived: {', '.join(missing)}")
             lazy_meta = (man.get("extra") or {}).get("lazy_meta") or {}
+
+            def _staged_bytes(name):
+                path = staging["files"].get(name)
+                if path is not None:
+                    with open(path, "rb") as f:
+                        return f.read()
+                return staging["payloads"][name]
+
             with lock:
                 slabs = {}
+                tier_vars = set()
                 for name, entry in man["sections"].items():
+                    if str(entry.get("kind", "")).startswith("tier"):
+                        tier_vars.add(entry["meta"]["var"])
+                        continue
                     blob = staging["payloads"][name]
                     if entry["kind"] == "dense":
                         scope.var(entry["meta"]["var"]).set_value(
@@ -1601,18 +1739,44 @@ def _listen_and_serv(ins, attrs):
                         parts["slab_rows"],
                         np.dtype(meta["dtype"])).reshape(
                             len(ids), int(meta["dim"]))
-                    scope.var(var_name).set_value(
-                        core.LazyEmbeddingTable.from_state(
-                            meta, ids, rows))
+                    new_tbl = core.LazyEmbeddingTable.from_state(
+                        meta, ids, rows)
+                    # drop the replaced table only AFTER the new one
+                    # built — a failed rebuild must not brick the
+                    # still-installed table's cold rows
+                    fio._drop_replaced_table(scope.find_var(var_name))
+                    scope.var(var_name).set_value(new_tbl)
+                for var_name in sorted(tier_vars):
+                    # tiered rebuild: sections feed in one at a time
+                    # from the staged files — peak RSS is one section
+                    # plus the hot slab, never the spilled payload
+                    from ..fluid import slab_spill
+                    import json as _json
+                    prefix = f"tier:{var_name}:"
+
+                    def _sec(rel, prefix=prefix):
+                        return _staged_bytes(
+                            prefix + rel[len("tier:"):])
+
+                    t_meta = _json.loads(_sec("tier:meta"))
+                    spilled = bool(
+                        (t_meta.get("tier") or {}).get("spilled"))
+                    new_tbl = slab_spill.build_table_from_sections(
+                        t_meta, _sec,
+                        spill_path=(_dest_spill_path(var_name)
+                                    if spilled else None))
+                    # drop-after-build, same rationale as from_state
+                    fio._drop_replaced_table(scope.find_var(var_name))
+                    scope.var(var_name).set_value(new_tbl)
                 srv_box[0].install_dedup_hwms(man.get("dedup_hwms"))
                 membership.state = ps_membership.ACTIVE
                 membership.install(man["view_next"])
-            staging.clear()
+            _clear_staging_locked()
         return True
 
     def h_handoff_abort():
         with staging_lock:
-            staging.clear()
+            _clear_staging_locked()
         return True
 
     def _handoff_sections_locked():
@@ -1628,6 +1792,23 @@ def _listen_and_serv(ins, attrs):
                 continue
             val = var.value()
             if isinstance(val, core.LazyEmbeddingTable):
+                if val._tier is not None:
+                    # capacity tier: STREAM the table section-by-section
+                    # (hot chunks + verbatim spill-log records) instead
+                    # of a RAM-materializing export — source RSS stays
+                    # O(one section) no matter how much is spilled, and
+                    # quantized segments move bit-identically
+                    # (docs/PS_DATA_PLANE.md "Capacity tier")
+                    from ..fluid import slab_spill
+                    for rel, sec in slab_spill.table_sections(
+                            val).items():
+                        full = f"tier:{name}:{rel[len('tier:'):]}"
+                        sections[full] = {
+                            "kind": sec["kind"], "meta": {"var": name},
+                            "size": sec["size"], "crc32": sec["crc32"],
+                            "read": sec["read"]}
+                    lazy_meta[name] = {"tiered": True}
+                    continue
                 meta, ids, rows = val.export_state()
                 lazy_meta[name] = meta
                 sections[f"slab:{name}:ids"] = {
@@ -1728,7 +1909,10 @@ def _listen_and_serv(ins, attrs):
         membership.handoff["total_sections"] = len(sections)
         dest_cli.call("handoff_begin", manifest=manifest)
         for name, sec in sections.items():
-            payload = sec["bytes"]
+            # tier sections regenerate on demand (read()) so the whole
+            # spilled table is never resident; plain sections carry
+            # their bytes inline as before
+            payload = sec["bytes"] if "bytes" in sec else sec["read"]()
             if ps_membership._corrupt_section_hook is not None:
                 payload = ps_membership._corrupt_section_hook(
                     name, payload)
@@ -1798,7 +1982,7 @@ def _listen_and_serv(ins, attrs):
         "barrier": h_barrier, "get_var": h_get_var,
         "get_vars_batch": h_get_vars_batch,
         "prefetch_rows": h_prefetch_rows, "checkpoint": h_checkpoint,
-        "table_stats": h_table_stats,
+        "table_stats": h_table_stats, "table_shrink": h_table_shrink,
         "geo_delta": h_geo_delta,
         # elastic membership plane
         "drain": h_drain, "get_view": h_get_view,
@@ -1830,6 +2014,34 @@ def _listen_and_serv(ins, attrs):
     # failover promotions through the same stats RPC the health and
     # per-op counters ride (docs/FAULT_TOLERANCE.md "Elastic membership")
     srv.add_stats_source(membership.stats_section)
+
+    def _slab_stats_snapshot():
+        """Capacity-tier gauges aggregated over every tiered table —
+        resident/spilled rows+bytes, hit rate, spill/promote counters,
+        at-rest density (docs/PS_DATA_PLANE.md "Capacity tier"). Rides
+        the stats RPC whose numeric leaves the PR 10 registry view
+        scrapes as ps_server_slab_* gauges. Takes the grad lock with a
+        bounded wait: a wedged optimize round costs the scrape its
+        slab section, never a stall."""
+        if not lock.acquire(timeout=1.0):
+            return {}
+        try:
+            from ..fluid import slab_spill
+            per_table = []
+            for n in scope.local_var_names():
+                var = scope.find_var(n)
+                if var is None or not var.is_initialized():
+                    continue
+                val = var.value()
+                if isinstance(val, core.LazyEmbeddingTable) \
+                        and val._tier is not None:
+                    per_table.append(val.tier_stats())
+            agg = slab_spill.merge_tier_stats(per_table)
+            return {"slab": agg} if agg else {}
+        finally:
+            lock.release()
+
+    srv.add_stats_source(_slab_stats_snapshot)
 
     # primary → replica liveness pings: forwards already beat, but an
     # IDLE primary (no traffic) must still prove liveness or the replica

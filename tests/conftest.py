@@ -73,6 +73,15 @@ def pytest_configure(config):
         "Subprocesses run JAX_PLATFORMS=cpu, so PADDLE_TPU_TEST_SHARD "
         "file-level sharding applies unchanged.")
     config.addinivalue_line(
+        "markers", "capacity: PS capacity-tier suite (fluid/"
+        "slab_spill.py + LazyEmbeddingTable disk tier — slab spill/"
+        "promotion, at-rest quantized rows, entry gating, decay "
+        "shrink, corrupt-spill rejection, streaming handoff/"
+        "checkpoint; tests/test_ps_capacity.py). In-process tier "
+        "tests stay tier-1 non-slow; multiprocess spill lanes also "
+        "carry 'slow'. Subprocesses run JAX_PLATFORMS=cpu, so "
+        "PADDLE_TPU_TEST_SHARD file-level sharding applies unchanged.")
+    config.addinivalue_line(
         "markers", "rpcbench: PS-RPC data-plane microbench smoke "
         "(tools/rpc_microbench.py loopback sweep at tiny sizes — the "
         "full 4KB..64MB run is a manual tool invocation). In-process "

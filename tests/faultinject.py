@@ -324,6 +324,54 @@ class corrupt_handoff:
         return False
 
 
+# ------------------------------------------------------------ spill logs
+def corrupt_spill(table, mode, seg=None):
+    """Damage a LazyEmbeddingTable's spill log in place — the disk-tier
+    mirror of ``corrupt_checkpoint`` (docs/PS_DATA_PLANE.md "Capacity
+    tier"). The table's per-segment CRC check must REFUSE to serve the
+    affected cold rows with a typed ``core.SpillCorruptionError``;
+    pinned hot rows keep serving. Modes:
+
+    ``truncate`` — chop the log so the targeted segment's tail is gone
+                   (torn write / dying disk)
+    ``flip``     — flip one byte inside the segment record (bit rot)
+    ``delete``   — remove the log file entirely (operator cleanup /
+                   lost volume)
+
+    ``seg`` picks the victim segment id (default: the LAST live one —
+    truncation at its midpoint leaves earlier segments intact).
+    Returns the victim segment id (None for ``delete``)."""
+    tier = getattr(table, "_tier", None)
+    assert tier is not None and tier.store is not None, \
+        "corrupt_spill needs a spill-tiered LazyEmbeddingTable"
+    store = tier.store
+    segs = store.segments()
+    assert segs, "no spilled segments to corrupt"
+    victim = segs[-1] if seg is None else seg
+    entry = store._segs[victim]
+    # drop the read mmap so the file-level damage below is what the
+    # next read sees (a live mapping would keep serving stale bytes)
+    with store._lock:
+        if store._mm is not None:
+            store._mm.close()
+            store._mm = None
+    if mode == "delete":
+        os.remove(store.path)
+        return None
+    if mode == "truncate":
+        with open(store.path, "r+b") as f:
+            f.truncate(entry.off + max(1, entry.nbytes // 2))
+        return victim
+    if mode == "flip":
+        with open(store.path, "r+b") as f:
+            f.seek(entry.off + entry.nbytes // 2)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0xFF]))
+        return victim
+    raise ValueError(f"unknown spill corruption mode {mode!r}")
+
+
 # ----------------------------------------------------------- checkpoints
 def _data_files(ckpt_dir):
     from paddle_tpu.fluid.io import CKPT_MANIFEST

@@ -171,6 +171,87 @@ def run_quant(sizes=None, repeats=5, warmup=1, bandwidth_mbps=None):
     return rows
 
 
+# spill-tier sweep (docs/PS_DATA_PLANE.md "Capacity tier"): the resident
+# fractions a production hot set actually runs at
+SPILL_FRACS = [1.0, 0.5, 0.25, 0.1]
+
+
+def run_spill(n_rows=20000, dim=64, fracs=None, batch=2048, repeats=10,
+              warmup=2, quant=""):
+    """Spill-tier pull sweep: ONE in-process VarServer serving
+    ``prefetch_rows`` over a LazyEmbeddingTable whose hot set is capped
+    at ``frac * n_rows`` — rows-resident fraction vs effective pull
+    MB/s (logical f32 row bytes per second through the served path,
+    cold promotes + write-back evictions included). frac=1.0 is the
+    all-in-RAM oracle lane the spilled rows are judged against.
+
+    Uniform-random ids over the whole working set are the WORST case
+    for a hot set (no skew to pin); real CTR traffic is zipfian and
+    does better. On this 1-core box the loopback RPC dominates small
+    batches — the sweep reports the tier's relative cost, not disk
+    bandwidth."""
+    import tempfile
+    import threading
+    from paddle_tpu.fluid import core
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+
+    fracs = list(fracs or SPILL_FRACS)
+    rows_bytes = batch * dim * 4
+    rows_out = []
+    for frac in fracs:
+        hot = max(1, int(n_rows * frac))
+        # the frac=1.0 oracle lane is tier-free: no tempdir to mint
+        d = tempfile.mkdtemp(prefix="pt-spillbench-") \
+            if frac < 1.0 else None
+        tbl = core.LazyEmbeddingTable(
+            height=max(n_rows, 1) * 10, dim=dim, seed=0,
+            spill_path=os.path.join(d, "t.slab") if frac < 1.0 else None,
+            hot_rows=hot if frac < 1.0 else None,
+            at_rest_quant=quant if frac < 1.0 else "",
+            spill_seg_rows=max(256, batch))
+        rng = np.random.RandomState(0)
+        # materialize the whole working set (spills the cold tail)
+        for lo in range(0, n_rows, batch):
+            tbl.get_rows(np.arange(lo, min(lo + batch, n_rows)))
+        lock = threading.Lock()
+
+        def h_prefetch(name, rows, prefetch=False, tbl=tbl, lock=lock):
+            with lock:
+                return tbl.get_rows(rows)
+
+        srv = VarServer(f"127.0.0.1:{_free_port()}",
+                        {"prefetch_rows": h_prefetch}).start()
+        cli = VarClient(f"127.0.0.1:{srv.port}", channels=1)
+        try:
+            for _ in range(warmup):
+                cli.call("prefetch_rows", name="t",
+                         rows=rng.randint(0, n_rows, batch))
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                out = cli.call("prefetch_rows", name="t",
+                               rows=rng.randint(0, n_rows, batch))
+            dt = time.perf_counter() - t0
+            assert np.asarray(out).shape == (batch, dim)
+            st = tbl.tier_stats()
+            rows_out.append({
+                "resident_frac": frac, "hot_rows": hot,
+                "n_rows": n_rows, "dim": dim, "batch": batch,
+                "quant": quant if frac < 1.0 else "",
+                "pull_mb_s": round(rows_bytes * repeats / dt / 1e6, 1),
+                "hit_rate": st.get("hit_rate", 1.0),
+                "store_reads": st.get("store_reads", 0),
+                "density_x": st.get("density_x", 0.0),
+            })
+        finally:
+            cli.close()
+            srv.shutdown()
+            tbl.close_spill(unlink=True)
+    base = rows_out[0]["pull_mb_s"] if rows_out else 1.0
+    for r in rows_out:
+        r["vs_resident"] = round(r["pull_mb_s"] / max(base, 1e-9), 2)
+    return rows_out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
@@ -178,12 +259,32 @@ def main(argv=None):
     ap.add_argument("--quant", action="store_true",
                     help="wire v3 quantized-frame sweep (raw vs fp16 "
                          "vs int8 effective MB/s)")
+    ap.add_argument("--spill", action="store_true",
+                    help="spill-tier sweep (rows-resident fraction vs "
+                         "effective pull MB/s)")
+    ap.add_argument("--at-rest-quant", default="",
+                    help="spill sweep at-rest encoding: '' | fp16 | "
+                         "int8")
     ap.add_argument("--bandwidth-mbps", type=float, default=None,
                     help="emulate a thin pipe at this many MB/s "
                          "(PADDLE_TPU_PS_RPC_BANDWIDTH_MBPS throttle)")
     ap.add_argument("--repeats", type=int, default=None)
     args = ap.parse_args(argv)
     repeats = args.repeats or (2 if args.smoke else 5)
+    if args.spill:
+        rows = run_spill(
+            n_rows=2000 if args.smoke else 20000,
+            batch=256 if args.smoke else 2048,
+            repeats=repeats if args.repeats else (2 if args.smoke
+                                                  else 10),
+            quant=args.at_rest_quant)
+        print(f"{'resident':>9} {'pull MB/s':>10} {'vs 1.0':>7} "
+              f"{'hit rate':>9} {'reads':>7} {'density':>8}")
+        for r in rows:
+            print(f"{r['resident_frac']:>9} {r['pull_mb_s']:>10} "
+                  f"{r['vs_resident']:>7} {r['hit_rate']:>9} "
+                  f"{r['store_reads']:>7} {r['density_x']:>8}")
+        return rows
     if args.quant:
         rows = run_quant(sizes=SMOKE_SIZES if args.smoke
                          else QUANT_SIZES, repeats=repeats,
