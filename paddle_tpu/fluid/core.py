@@ -502,7 +502,7 @@ class _SpillTier:
                  "hits", "misses", "promoted_rows", "spilled_rows_total",
                  "clean_evictions", "spill_batches", "entry_denied",
                  "grad_dropped_rows", "poison_dropped_rows",
-                 "shrunk_rows")
+                 "shrunk_rows", "shrink_runs")
 
     def __init__(self, spill_path, hot_rows, quant, seg_rows,
                  entry_threshold, dim, dtype, track_scores=None):
@@ -543,6 +543,7 @@ class _SpillTier:
         self.grad_dropped_rows = 0
         self.poison_dropped_rows = 0
         self.shrunk_rows = 0
+        self.shrink_runs = 0
 
     def deref_seg(self, sid) -> None:
         self.seg_live[sid] -= 1
@@ -1002,6 +1003,7 @@ class LazyEmbeddingTable:
                       ((r, int(c * decay)) for r, c in t.freq.items())
                       if c > 0}
         t.shrunk_rows += dropped
+        t.shrink_runs += 1
         return dropped
 
     def tier_stats(self) -> Dict[str, Any]:
@@ -1046,6 +1048,7 @@ class LazyEmbeddingTable:
             "grad_dropped_rows": t.grad_dropped_rows,
             "poison_dropped_rows": t.poison_dropped_rows,
             "shrunk_rows": t.shrunk_rows,
+            "shrink_runs": t.shrink_runs,
             "gate_pending_ids": len(t.freq),
         }
         if t.store is not None:
@@ -1577,6 +1580,14 @@ class _GlobalFlags:
         # entry gate, so the table_shrink admin RPC works (costs one
         # dict update per touched row; gating implies it)
         "FLAGS_ps_slab_track_scores": False,
+        # trainer-driven shrink cron (reference PSLib save/shrink cron):
+        # every N of trainer 0's sync rounds it fires ONE table_shrink
+        # admin RPC per pserver (decay/threshold below), so idle rows
+        # decay out of gated/tiered tables without an operator in the
+        # loop; 0 = off. Counted server-side as slab "shrink_runs".
+        "FLAGS_ps_shrink_every_steps": 0,
+        "FLAGS_ps_shrink_decay": 0.98,
+        "FLAGS_ps_shrink_threshold": 0.5,
         # reuse the device copy when the SAME ndarray object with the
         # SAME content fingerprint is fed again (skips the per-step
         # device_put — the dominant host cost of a small step); the

@@ -882,10 +882,15 @@ class _CompiledBlock:
                 lambda n: (env[n].shape[0] if n in env and
                            getattr(env[n], "ndim", 0) else None))
 
-    def _place_inputs(self, scope: Scope, feeds: Dict[str, Any], rng):
+    def _place_inputs(self, scope: Scope, feeds: Dict[str, Any], rng,
+                      window_names=()):
         """State from the scope + feeds, device-placed for the step (mesh
         sharding applied when data-parallel). Shared by run() and by
-        HLO-inspection helpers (lowered())."""
+        HLO-inspection helpers (lowered()). Feeds named in
+        ``window_names`` are [K, batch, ...] window STACKS: their batch
+        dim is dim 1, so the mesh placement shards THAT dim over "dp"
+        and leaves the window dim whole for the scan (one device_put
+        per window — docs/INPUT_PIPELINE.md)."""
         mut = {n: scope.find_var(n).get_tensor().array for n in self.mut_state}
         ro = {n: scope.find_var(n).get_tensor().array for n in self.ro_state}
         if self.mesh is not None:
@@ -918,7 +923,8 @@ class _CompiledBlock:
                 return jax.device_put(a, sh)
             mut = {n: place(n, a) for n, a in mut.items()}
             ro = {n: place(n, a) for n, a in ro.items()}
-            feeds = {n: shard_feed(self.mesh, n, a)
+            feeds = {n: shard_feed(self.mesh, n, a,
+                                   window=n in window_names)
                      for n, a in feeds.items()}
             if not multiproc:
                 # multi-process: leave the key uncommitted — identical on
@@ -982,8 +988,8 @@ class _CompiledBlock:
         a bad step's discard selects against THAT step's carry-in, so
         step i+1 of a faulted window continues from step i's pre-fault
         state)."""
-        mut, ro, feeds, rng_base = self._place_inputs(scope, feeds,
-                                                      rng_base)
+        mut, ro, feeds, rng_base = self._place_inputs(
+            scope, feeds, rng_base, window_names=window_names)
         from . import profiler as _profiler
         if _profiler.is_profiling():
             tag = "realdata" if window_names else "broadcast"
@@ -2106,23 +2112,28 @@ class Executor:
         compiled_ok = (mode == "compiled"
                        and _ops_compilable(program.global_block().ops))
 
-        if window_names and not (compiled_ok and mesh is None):
+        if window_names and not compiled_ok:
             # Documented per-step fallback for windowed feeds on paths
             # where the window cannot collapse to one dispatch:
             # segmented blocks (islands have per-step host side
-            # effects), interpreted blocks, and device meshes (batch-dim
-            # feed sharding would land on the window dim). Same contract
-            # as the compiled window: step i consumes slice i of every
+            # effects) and interpreted blocks. Same contract as the
+            # compiled window: step i consumes slice i of every
             # windowed feed, rng advances one global step per slice,
             # fetches come back stacked [n_steps, ...]. Decided BEFORE
             # the feed upload below — the whole [K, ...] stack must not
             # be device_put just to be re-uploaded slice by slice.
+            # Compiled MESH programs scan the window like the 1-device
+            # path since the 3D lane work: the stack is device_put ONCE
+            # with its batch dim (dim 1) sharded over "dp" and the
+            # window dim left whole for the scan — pipeline-sectioned
+            # programs consume DataLoader window stacks directly, the
+            # microbatch slices carved on-device inside the scanned
+            # step.
             return self._run_window_fallback(
                 program, feed, fetch_list, scope, return_numpy, mesh,
                 param_shardings, n_steps, window_names)
 
         if (n_steps > 1 or window_names) and compiled_ok \
-                and mesh is None \
                 and core.globals_["FLAGS_check_nan_inf"] \
                 and core.globals_["FLAGS_nan_inf_action"] == "raise":
             # raise is the DEBUGGING action: the offending step must

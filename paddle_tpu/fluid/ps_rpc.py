@@ -953,6 +953,14 @@ class VarServer:
                         # server-minted span id parented on the
                         # caller's rpc span
                         trace = msg.pop("_trace", None)
+                        # calls/bytes_in count BEFORE the handler runs
+                        # and the response ships: the old finally-bump
+                        # landed AFTER send(), so a client reading
+                        # stats() on a second pooled channel the moment
+                        # its data call returned could miss the call it
+                        # just made (observed as a load-dependent
+                        # KeyError flake in the per-op counter tests)
+                        outer._bump(method, calls=1, bytes_in=nin)
                         try:
                             if method == "stats":
                                 nout = send({"ok": True,
@@ -1059,8 +1067,7 @@ class VarServer:
                                        and method in _QUANT_METHODS
                                        else ""))
                         finally:
-                            outer._bump(method, calls=1, bytes_in=nin,
-                                        bytes_out=nout)
+                            outer._bump(method, bytes_out=nout)
                 except core.RpcProtocolError:
                     _LOG.warning("VarServer: dropping connection with "
                                  "invalid framing", exc_info=True)

@@ -470,12 +470,60 @@ def _recv(ins, attrs):
     return {}
 
 
+_SHRINK_CRON_STEPS: dict = {}   # (endpoints) -> trainer-0 round count
+_shrink_cron_warned: set = set()
+
+
+def reset_shrink_cron() -> None:
+    """Forget cron round counts (tests / a new transpiled job)."""
+    _SHRINK_CRON_STEPS.clear()
+
+
+def _shrink_cron_tick(endpoints, tid) -> None:
+    """Trainer-driven shrink schedule (FLAGS_ps_shrink_every_steps — the
+    PSLib save/shrink cron analogue, docs/PS_DATA_PLANE.md): trainer 0
+    counts its completed sync rounds per endpoint set and, every N-th,
+    fires ONE `table_shrink` admin RPC at each pserver (decay/threshold
+    from FLAGS_ps_shrink_decay/_threshold). The RPC lands between
+    rounds — the server runs it under the grad lock — so training never
+    observes a half-shrunk table. Best-effort like the reference cron:
+    a failed shrink warns (once per endpoint) and training continues;
+    evidence is the server-side slab "shrink_runs"/"shrunk_rows"
+    counters."""
+    every = int(core.globals_["FLAGS_ps_shrink_every_steps"] or 0)
+    if every <= 0 or tid != 0 or not endpoints:
+        return
+    key = tuple(endpoints)
+    n = _SHRINK_CRON_STEPS.get(key, 0) + 1
+    _SHRINK_CRON_STEPS[key] = n
+    if n % every:
+        return
+    import logging
+    decay = float(core.globals_["FLAGS_ps_shrink_decay"])
+    threshold = float(core.globals_["FLAGS_ps_shrink_threshold"])
+    for ep in dict.fromkeys(endpoints):
+        try:
+            _client(ep).call("table_shrink", decay=decay,
+                             threshold=threshold)
+        except Exception as e:  # noqa: BLE001 — cron is best-effort
+            if ep not in _shrink_cron_warned:
+                _shrink_cron_warned.add(ep)
+                logging.getLogger("paddle_tpu.ps").warning(
+                    "shrink cron: table_shrink on %s failed (%r) — "
+                    "continuing (warned once)", ep, e)
+
+
 def _barrier_op(kind):
     def _kernel(ins, attrs):
         ctx = attrs["_ctx"]
         tid = int(attrs.get("trainer_id", 0))
-        for ep in dict.fromkeys(attrs.get("endpoints") or []):
+        eps = list(dict.fromkeys(attrs.get("endpoints") or []))
+        for ep in eps:
             _client(ep).barrier(kind, trainer_id=tid)
+        if kind == "fetch":
+            # the fetch barrier closes trainer 0's sync round — the
+            # between-rounds window the shrink cron fires in
+            _shrink_cron_tick(eps, tid)
         return {}
     return _kernel
 
@@ -586,6 +634,8 @@ def _ps_round(ins, attrs):
     staleness = int(core.globals_["FLAGS_async_staleness"])
     if staleness <= 0:
         install(do_round())
+        # round complete — same cron point as the sync fetch_barrier
+        _shrink_cron_tick(beps, tid)
         return {}
     from ..fluid import communicator as _comm
     pipe = _comm.round_pipeline()
@@ -593,6 +643,9 @@ def _ps_round(ins, attrs):
     fresh = pipe.take_fresh_pulls()
     if fresh:
         install(fresh)
+    # async rounds: count at submit — the shrink RPC itself serializes
+    # on the server's grad lock, so landing mid-drain is still safe
+    _shrink_cron_tick(beps, tid)
     return {}
 
 

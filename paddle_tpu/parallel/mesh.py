@@ -34,6 +34,21 @@ def axis_mesh(n: int, axis_name: str, devices=None) -> Mesh:
     return Mesh(np.asarray(devs), (axis_name,))
 
 
+def mesh3d(dp: int = 2, pp: int = 2, sp: int = 2, devices=None) -> Mesh:
+    """The composed 3D-parallel mesh ("dp", "pp", "sp") — data ×
+    pipeline × sequence over dp·pp·sp devices (the 8-device virtual
+    mesh at 2×2×2). Expert parallelism reuses one of these axes as the
+    all-to-all group (parallel/lm3d.py dispatches experts over "dp"),
+    so a 4th axis is never materialized."""
+    n = dp * pp * sp
+    devs = list(devices if devices is not None else jax.devices())[:n]
+    if len(devs) != n:
+        raise ValueError(
+            f"mesh3d(dp={dp}, pp={pp}, sp={sp}) needs {n} devices, "
+            f"have {len(devs)}")
+    return Mesh(np.asarray(devs).reshape(dp, pp, sp), ("dp", "pp", "sp"))
+
+
 def build_mesh(num_devices: Optional[int] = None, model_parallel: int = 1,
                devices=None) -> Mesh:
     devs = list(devices if devices is not None else jax.devices())
@@ -57,12 +72,20 @@ def batch_sharded(mesh: Mesh, ndim: int) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
 
 
-def shard_feed(mesh: Mesh, name: str, array) -> jax.Array:
+def shard_feed(mesh: Mesh, name: str, array, window: bool = False) -> jax.Array:
     """Place a host batch onto the mesh, sharded on dim 0. In multi-process
     mode the given array is this process's LOCAL shard. Meshes without a
-    data axis (e.g. a pure "pp" pipeline mesh) replicate the feed."""
+    data axis (e.g. a pure "pp" pipeline mesh) replicate the feed.
+
+    ``window=True``: the array is a [K, batch, ...] WINDOW STACK of K
+    distinct batches (docs/INPUT_PIPELINE.md) — the window dim stays
+    unsharded (it is the executor's scan axis) and the BATCH dim (dim 1)
+    shards over "dp", so ONE device_put places the whole window and the
+    per-step slices come out batch-sharded on-device. Window stacks too
+    flat to carry a batch dim (ndim < 2) replicate."""
     arr = np.asarray(array)
-    if DATA_AXIS not in mesh.shape:
+    bdim = 1 if window else 0
+    if DATA_AXIS not in mesh.shape or (window and arr.ndim < 2):
         repl = replicated(mesh)
         if jax.process_count() > 1:
             # device_put can't target non-addressable devices; every
@@ -71,11 +94,15 @@ def shard_feed(mesh: Mesh, name: str, array) -> jax.Array:
                 repl, arr, global_shape=arr.shape)
         return jax.device_put(arr, repl)
     dp = mesh.shape[DATA_AXIS]
-    sharding = batch_sharded(mesh, max(arr.ndim, 1))
+    if window:
+        sharding = NamedSharding(mesh, P(
+            None, DATA_AXIS, *([None] * (arr.ndim - 2))))
+    else:
+        sharding = batch_sharded(mesh, max(arr.ndim, 1))
     if jax.process_count() > 1:
         return jax.make_array_from_process_local_data(sharding, arr)
-    if arr.shape[0] % dp != 0:
+    if arr.shape[bdim] % dp != 0:
         raise ValueError(
-            f"feed '{name}' batch {arr.shape[0]} not divisible by "
+            f"feed '{name}' batch {arr.shape[bdim]} not divisible by "
             f"data-parallel degree {dp}")
     return jax.device_put(arr, sharding)

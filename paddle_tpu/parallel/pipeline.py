@@ -54,16 +54,23 @@ def stack_stage_params(per_stage: Sequence[Any]):
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage)
 
 
-def _shard_stacked(mesh: Mesh, stacked):
-    """Place stacked stage params: leading (stage) dim over the pp axis."""
-    def put(x):
-        spec = P(PIPELINE_AXIS, *([None] * (x.ndim - 1)))
+def _shard_stacked(mesh: Mesh, stacked, param_specs=None):
+    """Place stacked stage params: leading (stage) dim over the pp axis
+    (or the caller's explicit per-leaf specs, for params that ALSO shard
+    over other mesh axes — e.g. MoE expert slices over "dp")."""
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(
+            lambda x: P(PIPELINE_AXIS, *([None] * (x.ndim - 1))), stacked)
+
+    def put(x, spec):
         return jax.device_put(x, NamedSharding(mesh, spec))
-    return jax.tree_util.tree_map(put, stacked)
+    return jax.tree_util.tree_map(put, stacked, param_specs), param_specs
 
 
-def gpipe(stage_fn: Callable[[Any, Any], Any], stacked_params, xs, *,
-          mesh: Mesh, axis: str = PIPELINE_AXIS):
+def gpipe(stage_fn: Callable[..., Any], stacked_params, xs, *,
+          mesh: Mesh, axis: str = PIPELINE_AXIS, param_specs=None,
+          xs_spec: P = P(), with_aux: bool = False,
+          pass_micro: bool = False):
     """Run microbatches ``xs`` through an ``n_stages``-deep pipeline.
 
     stage_fn(params_i, x) -> y          one stage; same signature per stage
@@ -77,14 +84,34 @@ def gpipe(stage_fn: Callable[[Any, Any], Any], stacked_params, xs, *,
     (y.shape == stage input shape) — the usual transformer/MLP residual-width
     case. The tick loop runs n_micro + n_stages - 1 steps; bubbles compute on
     garbage and are masked out, exactly the GPipe cost model.
+
+    Composition hooks (the 3D lane, parallel/lm3d.py):
+      param_specs   pytree of PartitionSpecs matching ``stacked_params``
+                    for leaves that shard over MORE than the leading
+                    stage dim (every spec must still lead with ``axis``;
+                    e.g. MoE expert weights P("pp", "dp", ...)). Default:
+                    P(axis, None, ...) per leaf.
+      xs_spec       PartitionSpec of ``xs`` (and of the returned ys) on
+                    the non-pipeline mesh axes — dim 0 is the microbatch
+                    dim and must stay unsharded (it is the scan axis);
+                    e.g. P(None, "dp", "sp", None) for [n_micro, mb, S, D]
+                    batch/sequence sharding. Default replicated.
+      with_aux      stage_fn returns ``(y, aux_scalar)``; the aux values
+                    of VALID ticks (bubbles excluded) are summed over
+                    ticks, stages, and every other mesh axis, and
+                    returned replicated as ``(ys, aux_total)`` — e.g.
+                    counted MoE token drops across the whole schedule.
+      pass_micro    stage_fn is called ``stage_fn(params_i, x, micro)``
+                    with the (clamped) global microbatch index this tick
+                    computes — the rng-fold hook: a stage body folding
+                    its dropout key by (stage, layer, micro) draws the
+                    same masks the sequential oracle does.
     """
     n_stages = mesh.shape[axis]
     n_micro = xs.shape[0]
     total = n_micro + n_stages - 1
-    stacked_params = _shard_stacked(mesh, stacked_params)
-
-    pspec_params = jax.tree_util.tree_map(
-        lambda x: P(axis, *([None] * (x.ndim - 1))), stacked_params)
+    stacked_params, pspec_params = _shard_stacked(mesh, stacked_params,
+                                                  param_specs)
 
     def per_device(params, xs_local):
         # params leaves arrive with leading dim 1 (this stage's slice)
@@ -93,12 +120,24 @@ def gpipe(stage_fn: Callable[[Any, Any], Any], stacked_params, xs, *,
         right = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def tick(carry, t):
-            inbuf, ys = carry
-            # stage 0 ingests microbatch t (clamped; bubbles masked later)
-            mb = lax.dynamic_index_in_dim(
-                xs_local, jnp.clip(t, 0, n_micro - 1), keepdims=False)
+            inbuf, ys, aux_acc = carry
+            # the microbatch THIS stage computes at tick t (stage 0
+            # ingests mb t; stage s is s ticks behind; clamped — bubble
+            # ticks compute on garbage and are masked below)
+            midx = jnp.clip(t - sidx, 0, n_micro - 1)
+            mb = lax.dynamic_index_in_dim(xs_local, jnp.clip(
+                t, 0, n_micro - 1), keepdims=False)
             x = jnp.where(sidx == 0, mb, inbuf)
-            y = stage_fn(params, x)
+            args = (params, x, midx) if pass_micro else (params, x)
+            y = stage_fn(*args)
+            if with_aux:
+                y, aux = y
+                # a bubble tick's aux is garbage-in-garbage-out: count
+                # only ticks where this stage holds a real microbatch
+                live = jnp.logical_and(t - sidx >= 0,
+                                       t - sidx < n_micro)
+                aux_acc = aux_acc + jnp.where(live, aux,
+                                              jnp.zeros_like(aux))
             # last stage writes microbatch (t - n_stages + 1) when valid
             oidx = t - (n_stages - 1)
             valid = jnp.logical_and(sidx == n_stages - 1, oidx >= 0)
@@ -106,10 +145,21 @@ def gpipe(stage_fn: Callable[[Any, Any], Any], stacked_params, xs, *,
                 ys, y, jnp.clip(oidx, 0, n_micro - 1), 0)
             ys = jnp.where(valid, upd, ys)
             nxt = lax.ppermute(y, axis, right)
-            return (nxt, ys), None
+            return (nxt, ys, aux_acc), None
 
-        init = (jnp.zeros_like(xs_local[0]),
-                jnp.zeros((n_micro,) + xs_local.shape[1:], xs_local.dtype))
+        x0 = jnp.zeros_like(xs_local[0])
+        aux0 = jnp.zeros((), jnp.int32)
+        if with_aux:
+            # discover the aux dtype/shape from an abstract stage eval
+            aux_shape = jax.eval_shape(
+                lambda p, x: stage_fn(*((p, x, jnp.int32(0))
+                                        if pass_micro else (p, x)))[1],
+                params, x0)
+            aux0 = jnp.zeros(aux_shape.shape, aux_shape.dtype)
+        init = (x0,
+                jnp.zeros((n_micro,) + xs_local.shape[1:],
+                          xs_local.dtype),
+                aux0)
         # carry becomes device-varying after the first tick; mark it so
         # (older jax < 0.6 has neither primitive — there shard_map's
         # rep-tracking handles the transition without explicit marking)
@@ -119,15 +169,20 @@ def gpipe(stage_fn: Callable[[Any, Any], Any], stacked_params, xs, *,
         elif hasattr(lax, "pvary"):
             init = jax.tree_util.tree_map(
                 lambda x: lax.pvary(x, (axis,)), init)
-        (_, ys), _ = lax.scan(tick, init, jnp.arange(total))
+        (_, ys, aux_acc), _ = lax.scan(tick, init, jnp.arange(total))
         # ys is only populated on the last stage; zero elsewhere + psum
         # replicates it to every stage (single all-reduce over ICI).
         ys = lax.psum(jnp.where(sidx == n_stages - 1, ys,
                                 jnp.zeros_like(ys)), axis)
+        if with_aux:
+            # total over stages AND the data/sequence shards — the
+            # schedule-global count, replicated everywhere
+            return ys, lax.psum(aux_acc, tuple(mesh.axis_names))
         return ys
 
+    out_specs = (xs_spec, P()) if with_aux else xs_spec
     fn = shard_map(per_device, mesh=mesh,
-                   in_specs=(pspec_params, P()), out_specs=P())
+                   in_specs=(pspec_params, xs_spec), out_specs=out_specs)
     return fn(stacked_params, xs)
 
 

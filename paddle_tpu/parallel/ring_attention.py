@@ -34,7 +34,7 @@ SEQUENCE_AXIS = "sp"
 _NEG = -1e30
 
 __all__ = ["SEQUENCE_AXIS", "sequence_mesh", "ring_attention",
-           "ulysses_attention"]
+           "ring_attention_local", "ulysses_attention"]
 
 
 def sequence_mesh(n: int, devices=None) -> Mesh:
@@ -66,6 +66,36 @@ def _block_update(q, k, v, o, m, l, sm_scale, q_off, k_off, causal):
     return o, m_new, l
 
 
+def ring_attention_local(q, k, v, sm_scale=None, causal=False, *,
+                         axis: str = SEQUENCE_AXIS, n: int):
+    """One device's ring-attention body inside an OPEN shard_map region
+    — q,k,v are this device's [B,H,S/n,D] sequence shards, ``axis`` the
+    (manual) mesh axis the sequence shards over, ``n`` its size. This
+    is the composable form: parallel/lm3d.py nests it inside a GPipe
+    stage over the dp×pp×sp mesh with axis="sp". ``n == 1`` degrades to
+    plain blockwise attention with no ppermute (so one code path covers
+    every composition). ``ring_attention`` below is the standalone
+    shard_map wrapper."""
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    B, H, sq, D = q.shape
+    idx = lax.axis_index(axis) if n > 1 else 0
+    right = [(i, (i + 1) % n) for i in range(n)]
+    o = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full((B, H, sq, 1), _NEG, jnp.float32)
+    l = jnp.zeros((B, H, sq, 1), jnp.float32)
+    k_cur, v_cur = k, v
+    for step in range(n):
+        src = (idx - step) % n  # owner of the block we hold now
+        o, m, l = _block_update(q, k_cur, v_cur, o, m, l, sm_scale,
+                                q_off=idx * sq, k_off=src * sq,
+                                causal=causal)
+        if step != n - 1:
+            k_cur = lax.ppermute(k_cur, axis, right)
+            v_cur = lax.ppermute(v_cur, axis, right)
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
 def ring_attention(q, k, v, sm_scale=None, causal=False, *, mesh,
                    axis: str = SEQUENCE_AXIS):
     """Attention over a sequence sharded on `axis`. q,k,v: [B,H,S,D] global
@@ -76,22 +106,8 @@ def ring_attention(q, k, v, sm_scale=None, causal=False, *, mesh,
     seq_spec = P(None, None, axis, None)
 
     def per_device(q, k, v):
-        B, H, sq, D = q.shape
-        idx = lax.axis_index(axis)
-        right = [(i, (i + 1) % n) for i in range(n)]
-        o = jnp.zeros(q.shape, jnp.float32)
-        m = jnp.full((B, H, sq, 1), _NEG, jnp.float32)
-        l = jnp.zeros((B, H, sq, 1), jnp.float32)
-        k_cur, v_cur = k, v
-        for step in range(n):
-            src = (idx - step) % n  # owner of the block we hold now
-            o, m, l = _block_update(q, k_cur, v_cur, o, m, l, sm_scale,
-                                    q_off=idx * sq, k_off=src * sq,
-                                    causal=causal)
-            if step != n - 1:
-                k_cur = lax.ppermute(k_cur, axis, right)
-                v_cur = lax.ppermute(v_cur, axis, right)
-        return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+        return ring_attention_local(q, k, v, sm_scale, causal,
+                                    axis=axis, n=n)
 
     fn = shard_map(per_device, mesh=mesh,
                    in_specs=(seq_spec, seq_spec, seq_spec),

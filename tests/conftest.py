@@ -86,6 +86,13 @@ def pytest_configure(config):
         "(tools/rpc_microbench.py loopback sweep at tiny sizes — the "
         "full 4KB..64MB run is a manual tool invocation). In-process "
         "and fast, stays in the tier-1 non-slow set.")
+    config.addinivalue_line(
+        "markers", "parallel3d: composed 3D-parallel lane suite "
+        "(parallel/lm3d.py dp×pp×sp+MoE on the virtual 8-device mesh, "
+        "gpipe/MoE composition units, executor window×pipeline "
+        "parity — docs/ci.md). Small-shape units stay in the tier-1 "
+        "non-slow set; the full bench-scale composition acceptance "
+        "also carries 'slow'.")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -506,6 +506,9 @@ def _run_wide_deep_cluster(tmpdir, tag, trainers=3, steps=6,
         run.shutdown()
 
 
+@pytest.mark.slow  # 24s: 3-trainer x 2-pserver MULTIPROCESS golden
+# acceptance — multiprocess drivers carry `slow` by suite convention
+# (docs/ci.md); the in-process staleness units above stay tier-1
 def test_async_staleness0_bit_identical_to_sync_oracle_wide_deep(
         tmp_path):
     """ISSUE 8 acceptance: the async-rewritten trainer program at

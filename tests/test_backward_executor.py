@@ -76,19 +76,27 @@ def test_all_optimizers_step(opt_name):
         exe.run(startup)
         X = np.random.rand(64, 8).astype("float32")
         Y = np.random.randint(0, 4, (64, 1)).astype("int64")
-        l0 = None
-        for i in range(5):
+        losses = []
+        for i in range(8):
             lv, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
-            if l0 is None:
-                l0 = float(lv[0])
+            losses.append(float(lv[0]))
+        l0 = losses[0]
         assert np.isfinite(lv[0])
-        # same batch repeated → the update must move the loss (strictly
-        # decreasing for well-conditioned optimizers; Ftrl/Adadelta move
-        # slowly, so just require change + no blowup)
+        # same batch repeated → the update must move the loss DOWN for
+        # well-conditioned optimizers (Ftrl/Adadelta move slowly, so
+        # just require change + no blowup). The horizon is 8 steps, not
+        # 5: Adagrad's early lr/sqrt(moment) steps OSCILLATE on this
+        # trajectory (1.4034 → 1.2950 → 1.4040 at step 5 — an
+        # oscillation peak 6e-4 ABOVE the start — → 1.2352 by step 8,
+        # compiled and interpreted paths bit-identical; op-level math
+        # is pinned by test_op_battery_extra::test_adagrad), so a
+        # 5-step endpoint read a descending-but-ringing trajectory as a
+        # regression. This was the standing tier-1 "Adagrad flake".
         if opt_name in ("SGD", "Adam", "Momentum", "Adagrad", "RMSProp"):
-            assert float(lv[0]) < l0
+            assert losses[-1] < l0, losses
+            assert min(losses[1:]) < l0, losses
         else:
-            assert float(lv[0]) != l0 and float(lv[0]) < l0 * 3
+            assert losses[-1] != l0 and losses[-1] < l0 * 3
 
 
 def test_lookahead_and_dgc_momentum():

@@ -37,6 +37,9 @@ def test_fit_a_line_converges():
     assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
 
 
+@pytest.mark.slow  # 16s: VGG conv-stack convergence duplicates the
+# conv/pool/bn coverage of mnist-conv + resnet18 + SE-ResNeXt trainers
+# (PR 13 suite-time buyback, PR 8 precedent)
 def test_vgg_cifar_trains():
     main, startup, feeds, loss, acc = book_extra.build_vgg_cifar(
         image_size=32, lr=2e-3)

@@ -81,6 +81,9 @@ def test_ptb_lm_trains():
     assert losses[-1] < losses[0] * 0.5, losses  # memorizes the window
 
 
+@pytest.mark.slow  # 11s: transformer-MT convergence duplicates the
+# attention/encoder coverage of bert_tiny + the flash/ring suites
+# (PR 13 suite-time buyback, PR 8 precedent)
 def test_transformer_wmt_trains():
     from paddle_tpu.models.transformer import (build_wmt_train_program,
                                                transformer_base_config)

@@ -107,6 +107,9 @@ def test_fused_attention_op_grad():
     assert abs(float(g[0, 1, 2]) - float(num)) < 1e-2
 
 
+@pytest.mark.slow  # 28s: BERT-scale remat parity is full-tier; the
+# per-commit remat coverage is test_backward_executor's recompute test
+# (PR 13 suite-time buyback, PR 8 precedent)
 def test_bert_recompute_checkpoints_engage_and_match():
     """build_bert_pretrain_program(recompute=True): per-layer remat
     engages (no fallback warning, plan present) and per-step losses

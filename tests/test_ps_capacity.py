@@ -600,3 +600,88 @@ def test_spill_microbench_smoke():
     rows8 = MB.run_spill(n_rows=1500, dim=32, batch=256, repeats=1,
                          warmup=1, fracs=[0.25], quant="int8")
     assert rows8[0]["density_x"] >= 3.0
+
+
+# ==========================================================================
+# trainer-driven shrink cron (FLAGS_ps_shrink_every_steps, PR 13)
+# ==========================================================================
+def _start_cron_pserver(endpoint):
+    import threading
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        main.global_block().append_op(
+            type="listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": endpoint, "sync_mode": False,
+                   "Fanin": 1, "optimize_blocks": [],
+                   "grad_to_block_id": [],
+                   "pserver_endpoints": [endpoint]})
+    scope = core.Scope()
+    exe = fluid.Executor()
+    th = threading.Thread(
+        target=lambda: exe.run(main, scope=scope, feed={},
+                               fetch_list=[]), daemon=True)
+    th.start()
+    return th, scope
+
+
+def test_trainer_driven_shrink_cron_fires_every_n_rounds(tmp_path):
+    """FLAGS_ps_shrink_every_steps: trainer 0's fetch_barrier closes a
+    sync round; every N-th round ONE table_shrink admin RPC reaches the
+    pserver (PSLib save/shrink cron analogue) — visible as the slab
+    stats "shrink_runs" counter and decayed-out idle rows. Non-zero
+    trainer ids never fire it."""
+    import time as _time
+    from paddle_tpu.fluid.ps_rpc import VarClient
+    from paddle_tpu.ops import distributed_ops as dops
+
+    ep = f"127.0.0.1:{free_port()}"
+    th, scope = _start_cron_pserver(ep)
+    prev = {k: core.globals_[k] for k in
+            ("FLAGS_ps_shrink_every_steps", "FLAGS_ps_shrink_decay",
+             "FLAGS_ps_shrink_threshold")}
+    dops.reset_shrink_cron()
+    try:
+        _time.sleep(0.5)
+        tbl = core.LazyEmbeddingTable(height=1000, dim=4, seed=1,
+                                      track_scores=True)
+        tbl.get_rows(np.arange(20))  # materialize + score 20 rows
+        scope.var("emb").set_value(tbl)
+        core.set_flag("FLAGS_ps_shrink_every_steps", 2)
+        core.set_flag("FLAGS_ps_shrink_decay", 0.0)   # one run drops all
+        core.set_flag("FLAGS_ps_shrink_threshold", 0.5)
+
+        def round_program(tid):
+            main = fluid.Program()
+            with fluid.program_guard(main, fluid.Program()):
+                main.global_block().append_op(
+                    type="fetch_barrier", inputs={}, outputs={},
+                    attrs={"endpoints": [ep], "trainer_id": tid})
+            return main
+
+        exe = fluid.Executor()
+        tscope = core.Scope()
+        with fluid.scope_guard(tscope):
+            exe.run(round_program(1))   # trainer 1 never drives the cron
+            exe.run(round_program(1))
+            exe.run(round_program(0))   # round 1: below the period
+            admin = VarClient(ep, connect_timeout=5.0, resolve=False)
+            assert admin.call("table_stats",
+                              name="emb")["tier"]["shrink_runs"] == 0
+            exe.run(round_program(0))   # round 2: cron fires
+        ts = admin.call("table_stats", name="emb")["tier"]
+        assert ts["shrink_runs"] == 1
+        assert ts["shrunk_rows"] == 20      # decay 0.0 drops every row
+        assert ts["resident_rows"] == 0
+        admin.close()
+    finally:
+        for k, v in prev.items():
+            core.set_flag(k, v)
+        dops.reset_shrink_cron()
+        try:
+            c = VarClient(ep, connect_timeout=5.0, channels=1,
+                          resolve=False)
+            c.stop()
+            c.close()
+        except Exception:
+            pass
+        th.join(timeout=10)
