@@ -14,6 +14,7 @@ from .framework import (Program, Variable, program_guard,
                         in_dygraph_mode, device_guard)
 from . import unique_name
 from . import ir
+from . import analysis
 from . import initializer
 from . import regularizer
 from . import clip

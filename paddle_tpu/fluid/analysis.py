@@ -1,0 +1,916 @@
+"""Static-analysis plane: the Program verifier (docs/ANALYSIS.md).
+
+The reference framework validates operators eagerly — AddOp-time attr
+checkers + InferShape (op_desc.cc, attribute_checker.h) — and runs IR
+passes over the ProgramDesc before execution, so a malformed program
+dies with a precise report instead of a deep runtime error. This build's
+Python objects ARE the program (framework.py), so nothing checked them
+until the executor traced — and the costliest defects of this repo's
+history were all statically detectable (the PR 4 un-rewritten sparse
+grad, the PR 5/7 donation/segment cross-path hazards, the PR 13 retrace
+pins). This module is the regression wall: dataflow analysis over
+``framework.Program`` blocks plus a distributed-protocol checker for
+transpiled programs, emitting structured ``Diagnostic``s.
+
+Three choke points call ``maybe_verify`` behind ``FLAGS_program_verify``
+("" | "warn" | "error"):
+
+  * ``Executor.run`` at the FIRST COMPILE of a program version (and the
+    interpreter's once-per-version config build) — never per step;
+  * the ``DistributeTranspiler`` on its own trainer-program output;
+  * ``tools/verify_program.py`` over saved inference dirs (and
+    ``io.save_inference_model`` unconditionally at level="error" — the
+    PR 7 multi-block var-drop invariant as a permanent rule).
+
+Diagnostics are counted as ``program_verify_diagnostics_total{rule,
+severity}`` through the telemetry registry and the verifier's runtime is
+recorded as a cat="segment" span (``verify:<where>``) so the first-compile
+cost stays visible next to the segment/window spans it delays.
+
+The concurrency half of the plane (lock-order cycles, blocking calls
+under locks) is source-level, not program-level — see tools/lockcheck.py.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import (Any, Callable, Dict, Iterable, List, Optional, Sequence,
+                    Set, Tuple)
+
+from . import core
+
+_LOG = logging.getLogger("paddle_tpu.analysis")
+
+__all__ = [
+    "Diagnostic", "ProgramVerifyError", "verify_program", "maybe_verify",
+    "enforce", "install_collector", "remove_collector", "rule_ids",
+    "RULE_SEVERITY",
+]
+
+
+# --------------------------------------------------------------------------
+# diagnostics
+# --------------------------------------------------------------------------
+@dataclass(frozen=True)
+class Diagnostic:
+    """One structured finding. ``op_idx`` indexes the op list of ``block``
+    (feed/fetch ops included, matching ``Block.ops``); None for
+    program-level findings."""
+
+    rule: str
+    severity: str                  # "error" | "warn"
+    message: str
+    block: int = 0
+    op_idx: Optional[int] = None
+    var: Optional[str] = None
+    fix_hint: str = ""
+
+    def format(self) -> str:
+        loc = f"block {self.block}"
+        if self.op_idx is not None:
+            loc += f" op#{self.op_idx}"
+        if self.var:
+            loc += f" var '{self.var}'"
+        s = f"[{self.severity}] {self.rule} @ {loc}: {self.message}"
+        if self.fix_hint:
+            s += f" (fix: {self.fix_hint})"
+        return s
+
+
+class ProgramVerifyError(RuntimeError):
+    """Raised by level="error" verification when error-severity
+    diagnostics are present. ``.diagnostics`` carries the full list
+    (warn-severity included)."""
+
+    def __init__(self, diagnostics: Sequence[Diagnostic], where: str):
+        self.diagnostics = list(diagnostics)
+        errs = [d for d in self.diagnostics if d.severity == "error"]
+        lines = "\n  ".join(d.format() for d in errs[:16])
+        more = f"\n  ... and {len(errs) - 16} more" if len(errs) > 16 else ""
+        super().__init__(
+            f"program verification failed at '{where}' with "
+            f"{len(errs)} error(s):\n  {lines}{more}")
+
+
+# rule id -> default severity. Rule ids are STABLE — the mutation corpus,
+# allowlists and the telemetry label set key on them.
+RULE_SEVERITY: Dict[str, str] = {
+    "def-before-use": "error",
+    "missing-var-desc": "error",
+    "undeclared-sub-block-read": "warn",
+    "dtype-mismatch": "warn",
+    "shape-mismatch": "warn",
+    "dead-op": "warn",
+    "dead-var": "warn",
+    "donation-safety": "error",
+    "dist-local-sparse-grad": "error",
+    "dist-barrier-pairing": "error",
+    "dist-ps-round-tail": "warn",
+    "retrace-partition-spec": "warn",
+    "retrace-feed-shape": "warn",
+}
+
+
+def rule_ids() -> List[str]:
+    return sorted(RULE_SEVERITY)
+
+
+# --------------------------------------------------------------------------
+# verification context
+# --------------------------------------------------------------------------
+class _Ctx:
+    def __init__(self, program, feed_names, fetch_names, param_shardings,
+                 segment_plan, where, scope=None):
+        self.program = program
+        # executor contract: a read-before-write var whose LoDTensor is
+        # already initialized in the scope is STATE, not a def-before-use
+        # bug (_classify_block_state) — when the caller has a scope, the
+        # dataflow rule honors it
+        self.scope = scope
+        self.feed_names: Set[str] = set(feed_names or ())
+        # fetch_names=None means "unknown" (transpiler choke point): rules
+        # that would mistake an un-fetched-but-fetchable output for dead
+        # code must skip (ir.Graph.is_internal documents the same hazard)
+        self.fetch_known = fetch_names is not None
+        self.fetch_names: Set[str] = set(fetch_names or ())
+        self.param_shardings = dict(param_shardings or {})
+        self.segment_plan = segment_plan
+        self.where = where
+        self.diags: List[Diagnostic] = []
+
+    def emit(self, rule: str, message: str, *, block: int = 0,
+             op_idx: Optional[int] = None, var: Optional[str] = None,
+             fix_hint: str = "", severity: Optional[str] = None) -> None:
+        self.diags.append(Diagnostic(
+            rule=rule, severity=severity or RULE_SEVERITY[rule],
+            message=message, block=block, op_idx=op_idx, var=var,
+            fix_hint=fix_hint))
+
+
+def _sub_blocks(op) -> List[Any]:
+    """Block-valued attrs of ``op`` (sub_block, optimize_blocks, ...)."""
+    from .framework import Block
+    subs: List[Any] = []
+    for val in op.attrs.values():
+        if isinstance(val, Block):
+            subs.append(val)
+        elif isinstance(val, (list, tuple)) and val \
+                and isinstance(val[0], Block):
+            subs.extend(val)
+    return subs
+
+
+def _is_loop_op(op_type: str) -> bool:
+    # loop bodies have carried values: a sub-block write is visible at the
+    # top of the NEXT iteration, so strict program-order def-before-use
+    # does not apply inside them
+    return op_type.startswith("while") or op_type.startswith("recurrent")
+
+
+def _all_writes(block) -> Set[str]:
+    written: Set[str] = set()
+    stack = [block]
+    while stack:
+        b = stack.pop()
+        for op in b.ops:
+            written.update(op.output_arg_names)
+            stack.extend(_sub_blocks(op))
+    return written
+
+
+def _reads_with_subs(op) -> Set[str]:
+    names = set(op.input_arg_names)
+    stack = list(_sub_blocks(op))
+    while stack:
+        b = stack.pop()
+        for sop in b.ops:
+            names.update(sop.input_arg_names)
+            stack.extend(_sub_blocks(sop))
+    return names
+
+
+def _is_sentinel(name: str) -> bool:
+    """Names that are slot placeholders, not variables: the backward
+    pass's @EMPTY@ grad sentinel and @DEPENDENCY control-dep markers
+    (framework.py CONTROL_DEP_VAR_PREFIX) never get a VarDesc."""
+    return name == "@EMPTY@" or name.startswith("@DEPENDENCY")
+
+
+def _resolvable(block, name: str):
+    """VarDesc for ``name`` visible from ``block`` (walking parents),
+    falling back to a whole-program scan — transpiler/backward-built
+    blocks sometimes reference vars declared in sibling blocks; the PR 7
+    rule is about descs EXISTING, not about the exact block chain."""
+    v = block._find_var_recursive(name)
+    if v is not None:
+        return v
+    for b in block.program.blocks:
+        if name in b.vars:
+            return b.vars[name]
+    return None
+
+
+# --------------------------------------------------------------------------
+# rule: dataflow (def-before-use, missing-var-desc,
+#                 undeclared-sub-block-read)
+# --------------------------------------------------------------------------
+def _check_dataflow(ctx: _Ctx) -> None:
+    program = ctx.program
+    defined: Set[str] = set(ctx.feed_names) | {"feed", "fetch"}
+    _walk_block(ctx, program.global_block(), defined, in_loop=False,
+                visited=set())
+
+
+def _walk_block(ctx: _Ctx, block, defined: Set[str], in_loop: bool,
+                visited: Set[int]) -> None:
+    if id(block) in visited:
+        return
+    visited.add(id(block))
+    local = set(defined)
+    if in_loop:
+        local |= _all_writes(block)
+    reported: Set[str] = set()
+    for idx, op in enumerate(block.ops):
+        if op.type == "feed":
+            local.update(op.output_arg_names)
+            continue
+        if op.type == "fetch":
+            continue
+        for name in op.input_arg_names:
+            if _is_sentinel(name):
+                continue
+            v = _resolvable(block, name)
+            if v is None:
+                if name not in reported:
+                    reported.add(name)
+                    ctx.emit(
+                        "missing-var-desc",
+                        f"op '{op.type}' references '{name}' but no "
+                        "VarDesc for it is reachable from this block — "
+                        "a program serialized like this fails the native "
+                        "load validation (the PR 7 save var-drop hazard)",
+                        block=block.idx, op_idx=idx, var=name,
+                        fix_hint="declare the var in a visible block or "
+                                 "stop dropping it from the saved program")
+                continue
+            if name in local or name in reported:
+                continue
+            if getattr(v, "persistable", False) or getattr(v, "is_data",
+                                                           False) \
+                    or getattr(v, "need_check_feed", False):
+                local.add(name)
+                continue
+            if ctx.scope is not None:
+                sv = ctx.scope.find_var(name)
+                if sv is not None and sv.is_initialized():
+                    local.add(name)   # pre-seeded state (executor rule)
+                    continue
+            reported.add(name)
+            ctx.emit(
+                "def-before-use",
+                f"op '{op.type}' reads non-persistable '{name}' before "
+                "any producer wrote it (and it is not a feed/data var)",
+                block=block.idx, op_idx=idx, var=name,
+                fix_hint="feed it, mark it persistable state, or reorder "
+                         "the producing op before this one")
+        subs = _sub_blocks(op)
+        if subs:
+            declared = set(op.input_arg_names)
+            sub_loop = in_loop or _is_loop_op(op.type)
+            for sb in subs:
+                _check_external_reads(ctx, op, idx, block, sb, local,
+                                      declared, sub_loop)
+                _walk_block(ctx, sb, local, sub_loop, visited)
+            # conservative: sub-block writes become visible after the op
+            # (the interpreter writes them through the scope)
+            for sb in subs:
+                local |= _all_writes(sb)
+        local.update(op.output_arg_names)
+
+
+def _check_external_reads(ctx: _Ctx, op, op_idx: int, block, sub,
+                          outer_defined: Set[str], declared: Set[str],
+                          sub_loop: bool) -> None:
+    """The declared-external-reads invariant (PR 7): a sub-block op
+    reading a NON-persistable var of an outer block should see that var
+    listed in the parent op's input slots — prune/var-drop/feed analysis
+    all reason about the parent op's declared interface."""
+    produced: Set[str] = set()
+    if sub_loop:
+        produced |= _all_writes(sub)
+    for sop in sub.ops:
+        for name in sop.input_arg_names:
+            if name in produced or name in declared or _is_sentinel(name):
+                continue
+            if name in sub.vars:      # sub-block-local declaration
+                continue
+            v = _resolvable(sub, name)
+            if v is None:
+                continue              # missing-var-desc covers it
+            if getattr(v, "persistable", False) or getattr(v, "is_data",
+                                                           False) \
+                    or getattr(v, "need_check_feed", False):
+                continue
+            declared.add(name)        # report once per parent op
+            ctx.emit(
+                "undeclared-sub-block-read",
+                f"sub-block op '{sop.type}' reads outer var '{name}' "
+                f"that parent op '{op.type}' does not declare in its "
+                "input slots",
+                block=sub.idx, var=name,
+                fix_hint="add the var to the parent op's input slots so "
+                         "prune/save interface analysis sees the read")
+        produced.update(sop.output_arg_names)
+
+
+# --------------------------------------------------------------------------
+# rule: dtype / shape propagation
+# --------------------------------------------------------------------------
+_SAME_DTYPE_OPS = frozenset({
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_pow", "elementwise_min",
+    "elementwise_max", "sum", "concat", "mul", "matmul", "matmul_v2",
+})
+
+
+def _np_dtype_name(dtype) -> Optional[str]:
+    try:
+        import numpy as np
+        return np.dtype(core.dtype_to_np(dtype)).name
+    except Exception:
+        return None
+
+
+def _static_dims(shape) -> Optional[Tuple[int, ...]]:
+    if shape is None:
+        return None
+    t = tuple(int(d) for d in shape)
+    return t if t else None
+
+
+def _check_dtype_shape(ctx: _Ctx) -> None:
+    for block in ctx.program.blocks:
+        for idx, op in enumerate(block.ops):
+            if op.type in _SAME_DTYPE_OPS:
+                _check_same_dtype(ctx, block, idx, op)
+            if op.type == "cast":
+                _check_cast(ctx, block, idx, op)
+            if op.type == "mul":
+                _check_mul_shape(ctx, block, idx, op)
+            elif op.type in ("matmul", "matmul_v2"):
+                _check_matmul_shape(ctx, block, idx, op)
+
+
+def _check_same_dtype(ctx: _Ctx, block, idx, op) -> None:
+    seen: Dict[str, str] = {}
+    for slot in ("X", "Y"):
+        for name in op.input(slot):
+            v = _resolvable(block, name)
+            dt = _np_dtype_name(getattr(v, "dtype", None)) if v else None
+            if dt is not None:
+                seen[name] = dt
+    kinds = set(seen.values())
+    if len(kinds) > 1:
+        detail = ", ".join(f"{n}:{d}" for n, d in sorted(seen.items()))
+        ctx.emit(
+            "dtype-mismatch",
+            f"op '{op.type}' mixes input dtypes ({detail}) — the traced "
+            "kernel will silently promote (or XLA will reject) what the "
+            "reference validates at AddOp time",
+            block=block.idx, op_idx=idx, var=next(iter(seen)),
+            fix_hint="insert an explicit cast op")
+
+
+def _check_cast(ctx: _Ctx, block, idx, op) -> None:
+    outs = op.output("Out")
+    if not outs:
+        return
+    v = _resolvable(block, outs[0])
+    want = op.attr("out_dtype")
+    if v is None or want is None or v.dtype is None:
+        return
+    a, b = _np_dtype_name(v.dtype), _np_dtype_name(want)
+    if a and b and a != b:
+        ctx.emit(
+            "dtype-mismatch",
+            f"cast declares out_dtype={b} but output var '{outs[0]}' is "
+            f"declared {a}",
+            block=block.idx, op_idx=idx, var=outs[0],
+            fix_hint="align the var desc dtype with the cast attr")
+
+
+def _flat_dim(shape: Tuple[int, ...], start: int, stop: int) -> int:
+    """Product of dims [start:stop); -1 (unknown) poisons to -1."""
+    prod = 1
+    for d in shape[start:stop]:
+        if d <= 0:
+            return -1
+        prod *= d
+    return prod
+
+
+def _check_mul_shape(ctx: _Ctx, block, idx, op) -> None:
+    xs, ys = op.input("X"), op.input("Y")
+    if not xs or not ys:
+        return
+    xv, yv = _resolvable(block, xs[0]), _resolvable(block, ys[0])
+    xsh = _static_dims(getattr(xv, "shape", None)) if xv else None
+    ysh = _static_dims(getattr(yv, "shape", None)) if yv else None
+    if not xsh or not ysh:
+        return
+    xn = int(op.attr("x_num_col_dims") or 1)
+    yn = int(op.attr("y_num_col_dims") or 1)
+    inner_x = _flat_dim(xsh, xn, len(xsh))
+    inner_y = _flat_dim(ysh, 0, yn)
+    if inner_x > 0 and inner_y > 0 and inner_x != inner_y:
+        ctx.emit(
+            "shape-mismatch",
+            f"mul inner dims disagree: {xs[0]}{list(xsh)} flattened at "
+            f"x_num_col_dims={xn} gives K={inner_x}, {ys[0]}{list(ysh)} "
+            f"gives K={inner_y}",
+            block=block.idx, op_idx=idx, var=xs[0],
+            fix_hint="fix the weight shape or the num_col_dims attrs")
+
+
+def _check_matmul_shape(ctx: _Ctx, block, idx, op) -> None:
+    xs, ys = op.input("X"), op.input("Y")
+    if not xs or not ys:
+        return
+    xv, yv = _resolvable(block, xs[0]), _resolvable(block, ys[0])
+    xsh = _static_dims(getattr(xv, "shape", None)) if xv else None
+    ysh = _static_dims(getattr(yv, "shape", None)) if yv else None
+    if not xsh or not ysh or len(xsh) < 2 or len(ysh) < 2:
+        return
+    tx = bool(op.attr("transpose_X") or op.attr("trans_x"))
+    ty = bool(op.attr("transpose_Y") or op.attr("trans_y"))
+    kx = xsh[-2] if tx else xsh[-1]
+    ky = ysh[-1] if ty else ysh[-2]
+    if kx > 0 and ky > 0 and kx != ky:
+        ctx.emit(
+            "shape-mismatch",
+            f"matmul contraction dims disagree: {xs[0]}{list(xsh)} "
+            f"(transpose_X={tx}) K={kx} vs {ys[0]}{list(ysh)} "
+            f"(transpose_Y={ty}) K={ky}",
+            block=block.idx, op_idx=idx, var=xs[0],
+            fix_hint="fix the operand shapes or transpose attrs")
+
+
+# --------------------------------------------------------------------------
+# rule: dead ops / dead vars
+# --------------------------------------------------------------------------
+def _op_has_side_effects(op) -> bool:
+    from .ir import op_island_reason
+    # island ops (stateful kernels, host-input readers, control flow,
+    # unregistered types) and the distributed data-plane ops act beyond
+    # their declared outputs — never dead
+    return op_island_reason(op) is not None
+
+
+def _check_dead(ctx: _Ctx) -> None:
+    if not ctx.fetch_known:
+        # consumer-less outputs may be fetch targets of a later run — the
+        # fetch list is not part of the program (ir.Graph.is_internal)
+        return
+    block = ctx.program.global_block()
+    indexed = [(i, op) for i, op in enumerate(block.ops)
+               if op.type not in ("feed", "fetch")]
+    live_names = set(ctx.fetch_names)
+    keep = {}
+    persistable = {n for n, v in block.vars.items()
+                   if getattr(v, "persistable", False)}
+    for i, op in indexed:
+        if _op_has_side_effects(op) \
+                or any(n in persistable for n in op.output_arg_names):
+            keep[i] = True
+    for i, op in reversed(indexed):
+        if keep.get(i) or (set(op.output_arg_names) & live_names):
+            keep[i] = True
+            live_names.update(_reads_with_subs(op))
+    for i, op in indexed:
+        if not keep.get(i):
+            outs = op.output_arg_names
+            if op.type.endswith("_grad") or (
+                    outs and all(o.endswith("@GRAD") or o == "@EMPTY@"
+                                 for o in outs)):
+                # mechanically generated backward ops compute grads for
+                # EVERY differentiable input, and append_backward seeds
+                # a fill for every loss grad; unconsumed leaf grads /
+                # seeds over severed grad paths are the documented
+                # backward contract and XLA DCEs them — not dead code
+                # anyone wrote (docs/ANALYSIS.md "dead-op")
+                continue
+            ctx.emit(
+                "dead-op",
+                f"op '{op.type}' outputs "
+                f"{sorted(op.output_arg_names)[:4]} are never read, "
+                "fetched, or persisted",
+                block=0, op_idx=i,
+                var=(op.output_arg_names[0] if op.output_arg_names
+                     else None),
+                fix_hint="remove the op or fetch its output")
+
+    referenced: Set[str] = set()
+    for b in ctx.program.blocks:
+        for op in b.ops:
+            referenced.update(op.input_arg_names)
+            referenced.update(op.output_arg_names)
+    for name, v in block.vars.items():
+        if name in referenced or name in ("feed", "fetch"):
+            continue
+        if getattr(v, "persistable", False) or getattr(v, "is_data", False):
+            continue
+        if name in ctx.feed_names or name in ctx.fetch_names:
+            continue
+        ctx.emit(
+            "dead-var",
+            f"var '{name}' is referenced by no op in any block",
+            block=0, var=name,
+            fix_hint="drop it (ir.Graph.drop_orphan_vars) or wire it up")
+
+
+# --------------------------------------------------------------------------
+# rule: donation safety (cross-checked against a segment plan)
+# --------------------------------------------------------------------------
+def _plan_entry(seg) -> Dict[str, Any]:
+    if isinstance(seg, dict):
+        return {"kind": seg.get("kind"), "start": int(seg.get("start", 0)),
+                "stop": int(seg.get("stop", 0)),
+                "n_ops": int(seg.get("stop", 0)) - int(seg.get("start", 0)),
+                "out_names": tuple(seg.get("out_names", ()) or ()),
+                "donated_names": tuple(seg.get("donated_names", ()) or ())}
+    return {"kind": seg.kind, "start": seg.start, "stop": seg.stop,
+            "n_ops": len(seg.ops),
+            "out_names": tuple(getattr(seg, "out_names", ()) or ()),
+            "donated_names": tuple(getattr(seg, "donated_names", ()) or ())}
+
+
+def _check_donation(ctx: _Ctx) -> None:
+    """A buffer donated by a compiled segment is DELETED when the jitted
+    step runs — any later consumer must read the segment's returned
+    output, so the name must be on the segment's out list. Cross-checks
+    the plan the segmented executor actually built (or a
+    ``ir.analyze_block_segments`` summary extended with out/donated
+    names) against the CURRENT program — the drift between the two is
+    exactly the PR 5/7 review-round hazard class, and the regression wall
+    ROADMAP item 5's executor lowering refactor lands behind."""
+    if ctx.segment_plan is None:
+        return
+    block = ctx.program.global_block()
+    ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+    segs = [_plan_entry(s) for s in ctx.segment_plan]
+    covered = sum(s["n_ops"] for s in segs)
+    if covered != len(ops):
+        ctx.emit(
+            "donation-safety",
+            f"segment plan covers {covered} ops but the program has "
+            f"{len(ops)} — the program changed since the plan was built, "
+            "so donation/liveness decisions are stale",
+            fix_hint="rebuild the segment plan (bump program version and "
+                     "let the executor recompile) before running")
+        return
+    guard_select = (core.globals_["FLAGS_check_nan_inf"]
+                    and core.globals_["FLAGS_nan_inf_action"]
+                    in ("skip", "rollback"))
+    persistable = {n for n, v in block.vars.items()
+                   if getattr(v, "persistable", False)}
+    for seg in segs:
+        if seg["kind"] != "compiled" or not seg["donated_names"]:
+            continue
+        if guard_select:
+            ctx.emit(
+                "donation-safety",
+                f"segment [{seg['start']}:{seg['stop']}) donates "
+                f"{list(seg['donated_names'])[:4]} while the numeric "
+                "fault guard's select action needs the pre-step buffers "
+                "alive until the end-of-step discard (the PR 5 "
+                "donation/guard hazard)",
+                fix_hint="build the plan with per-segment donation "
+                         "disabled under skip/rollback actions")
+            continue
+        out = set(seg["out_names"])
+        later_reads: Set[str] = set()
+        for op in ops[seg["stop"]:]:
+            later_reads |= _reads_with_subs(op)
+        for n in seg["donated_names"]:
+            needed = (n in later_reads or n in ctx.fetch_names
+                      or n in persistable)
+            if needed and n not in out:
+                ctx.emit(
+                    "donation-safety",
+                    f"'{n}' is donated (buffer deleted) by segment "
+                    f"[{seg['start']}:{seg['stop']}) but a later "
+                    "op/island, the fetch list, or the persistable "
+                    "writeback still needs it and it is not among the "
+                    "segment's outputs",
+                    var=n,
+                    fix_hint="return the updated value from the segment "
+                             "(out_names) or stop donating the buffer")
+
+
+# --------------------------------------------------------------------------
+# rule: distributed protocol (transpiled programs)
+# --------------------------------------------------------------------------
+def _check_distributed(ctx: _Ctx) -> None:
+    block = ctx.program.global_block()
+    indexed = [(i, op) for i, op in enumerate(block.ops)]
+    send_idx = [i for i, op in indexed if op.type == "send"]
+    sb_idx = [i for i, op in indexed if op.type == "send_barrier"]
+    recv_idx = [i for i, op in indexed if op.type == "recv"]
+    fb_idx = [i for i, op in indexed if op.type == "fetch_barrier"]
+    psr_idx = [i for i, op in indexed if op.type == "ps_round"]
+
+    # tables served by the PS plane: anything a distributed lookup/grad
+    # names (the transpiler stamps table_names + W on both rewrites)
+    dist_tables: Set[str] = set()
+    for _i, op in indexed:
+        if op.type in ("distributed_lookup_table",
+                       "distributed_lookup_table_grad"):
+            dist_tables.update(op.input("W"))
+            dist_tables.update(op.attr("table_names") or ())
+
+    # --- the PR 4 bug as a permanent rule: a LOCAL sparse lookup/grad on
+    # a pserver-hosted table silently drops the update on the trainer
+    # floor — the embedding never trains
+    for i, op in indexed:
+        if op.type in ("lookup_table_grad", "lookup_table_v2_grad") \
+                and op.input("W") and op.input("W")[0] in dist_tables:
+            ctx.emit(
+                "dist-local-sparse-grad",
+                f"local '{op.type}' on pserver-hosted table "
+                f"'{op.input('W')[0]}' — the sparse update never crosses "
+                "the wire (the PR 4 pserver-embeddings-never-train bug)",
+                op_idx=i, var=op.input("W")[0],
+                fix_hint="rewrite to distributed_lookup_table_grad "
+                         "(row-sharded remote pushes)")
+        elif op.type in ("lookup_table", "lookup_table_v2") \
+                and op.input("W") and op.input("W")[0] in dist_tables:
+            ctx.emit(
+                "dist-local-sparse-grad",
+                f"local '{op.type}' on pserver-hosted table "
+                f"'{op.input('W')[0]}' — the rows live on the pservers; "
+                "a local lookup reads a stale or absent trainer copy",
+                op_idx=i, var=op.input("W")[0],
+                fix_hint="rewrite to distributed_lookup_table")
+
+    # --- send/send_barrier/recv/fetch_barrier pairing & ordering. A
+    # program with NO barrier ops is async-mode (legitimate); any barrier
+    # present means the sync protocol applies in full.
+    is_sync = bool(sb_idx or fb_idx)
+    if is_sync:
+        if send_idx and not sb_idx:
+            ctx.emit(
+                "dist-barrier-pairing",
+                "sync trainer program has send ops but no send_barrier — "
+                "pservers defer grad application to the barrier release; "
+                "sparse-only shards would never train",
+                op_idx=send_idx[0],
+                fix_hint="append send_barrier after the last send "
+                         "(endpoints = EVERY pserver)")
+        if recv_idx and not fb_idx:
+            ctx.emit(
+                "dist-barrier-pairing",
+                "sync trainer program has recv ops but no fetch_barrier — "
+                "the next step's sends can interleave with this step's "
+                "pulls on the wire",
+                op_idx=recv_idx[0],
+                fix_hint="append fetch_barrier after the last recv")
+        if len(sb_idx) > 1:
+            ctx.emit("dist-barrier-pairing",
+                     f"{len(sb_idx)} send_barrier ops in one program",
+                     op_idx=sb_idx[1],
+                     fix_hint="exactly one per sync round")
+        if len(fb_idx) > 1:
+            ctx.emit("dist-barrier-pairing",
+                     f"{len(fb_idx)} fetch_barrier ops in one program",
+                     op_idx=fb_idx[1],
+                     fix_hint="exactly one per sync round")
+        if sb_idx:
+            for i in send_idx:
+                if i > sb_idx[0]:
+                    ctx.emit(
+                        "dist-barrier-pairing",
+                        "send op AFTER send_barrier — its grad lands in "
+                        "the NEXT round's reduce window",
+                        op_idx=i,
+                        fix_hint="move every send before the barrier")
+            for i in recv_idx:
+                if i < sb_idx[0]:
+                    ctx.emit(
+                        "dist-barrier-pairing",
+                        "recv op BEFORE send_barrier — it pulls params "
+                        "from before this round's grads applied",
+                        op_idx=i,
+                        fix_hint="move every recv after send_barrier")
+        if fb_idx:
+            for i in recv_idx:
+                if i > fb_idx[-1]:
+                    ctx.emit(
+                        "dist-barrier-pairing",
+                        "recv op AFTER fetch_barrier — it races the next "
+                        "round's updates",
+                        op_idx=i,
+                        fix_hint="move every recv before fetch_barrier")
+            if sb_idx and fb_idx[0] < sb_idx[0]:
+                ctx.emit(
+                    "dist-barrier-pairing",
+                    "fetch_barrier precedes send_barrier",
+                    op_idx=fb_idx[0],
+                    fix_hint="order: sends, send_barrier, recvs, "
+                             "fetch_barrier")
+
+    # --- ps_round tail consistency (async overlap plane)
+    if psr_idx:
+        if send_idx or sb_idx or recv_idx or fb_idx:
+            ctx.emit(
+                "dist-ps-round-tail", severity="error",
+                message="program mixes a ps_round op with the inline "
+                        "send/barrier/recv tail — the round would run "
+                        "twice against the same pserver reduce window",
+                op_idx=psr_idx[0],
+                fix_hint="the async-overlap rewrite REPLACES the 4-op "
+                         "tail with one ps_round")
+        if len(psr_idx) > 1:
+            ctx.emit("dist-ps-round-tail", severity="error",
+                     message=f"{len(psr_idx)} ps_round ops in one "
+                             "program — one round per step",
+                     op_idx=psr_idx[1],
+                     fix_hint="exactly one ps_round per trainer step")
+    elif is_sync and send_idx \
+            and int(core.globals_["FLAGS_async_staleness"]) > 0:
+        ctx.emit(
+            "dist-ps-round-tail",
+            f"FLAGS_async_staleness="
+            f"{core.globals_['FLAGS_async_staleness']} but the program "
+            "carries the inline sync tail (no ps_round op) — the overlap "
+            "plane never engages and every step pays the full wire wait",
+            op_idx=send_idx[0],
+            fix_hint="transpile with DistributeTranspilerConfig."
+                     "async_overlap=True (or set the staleness flag "
+                     "BEFORE transpiling)")
+
+
+# --------------------------------------------------------------------------
+# rule: retrace lints (the PR 13 steady-state pins)
+# --------------------------------------------------------------------------
+def _check_retrace(ctx: _Ctx) -> None:
+    for pname, spec in sorted(ctx.param_shardings.items()):
+        try:
+            entries = tuple(spec)
+        except TypeError:
+            continue
+        if entries and entries[-1] is None:
+            ctx.emit(
+                "retrace-partition-spec",
+                f"sharding for '{pname}' uses the long-form "
+                f"PartitionSpec {entries!r} with trailing None dims — "
+                "NamedSharding __eq__ (the jit cache key) treats "
+                "P('pp') != P('pp', None), so mixing forms forks the "
+                "cache and retraces every window (PR 13 pin)",
+                var=pname,
+                fix_hint="drop trailing None dims: use the short form "
+                         "everywhere")
+    seen: Set[str] = set()
+    for block in ctx.program.blocks:
+        for name, v in block.vars.items():
+            if name in seen:
+                continue
+            if not (getattr(v, "is_data", False)
+                    or getattr(v, "need_check_feed", False)):
+                continue
+            shape = tuple(getattr(v, "shape", ()) or ())
+            if any(d == -1 for d in shape[1:]):
+                seen.add(name)
+                ctx.emit(
+                    "retrace-feed-shape",
+                    f"feed var '{name}' is shape-polymorphic beyond the "
+                    f"batch dim (shape {list(shape)}) — every distinct "
+                    "concrete shape is a new jit signature, so windowed "
+                    "runs retrace in steady state (PR 13 pin)",
+                    block=block.idx, var=name,
+                    fix_hint="pad/bucket the trailing dims to a fixed "
+                             "set of shapes")
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+_CHECKS: List[Callable[[_Ctx], None]] = [
+    _check_dataflow,
+    _check_dtype_shape,
+    _check_dead,
+    _check_donation,
+    _check_distributed,
+    _check_retrace,
+]
+
+
+def verify_program(program, *, feed_names: Iterable[str] = (),
+                   fetch_names: Optional[Iterable[str]] = None,
+                   param_shardings: Optional[Dict[str, Any]] = None,
+                   segment_plan: Optional[Sequence[Any]] = None,
+                   rules: Optional[Iterable[str]] = None,
+                   where: str = "api", scope=None) -> List[Diagnostic]:
+    """Run every verifier rule over ``program`` and return the
+    diagnostics (pure — no logging, no counters, no raising; see
+    ``enforce``/``maybe_verify`` for policy).
+
+    ``fetch_names=None`` means the fetch list is UNKNOWN (dead-code rules
+    skip — a consumer-less output may be a later run's fetch target).
+    ``segment_plan`` enables the donation-safety cross-check: pass the
+    segmented executor's ``cb.segments`` (or dicts with kind/start/stop/
+    out_names/donated_names). ``rules`` filters to a subset of
+    ``rule_ids()``."""
+    ctx = _Ctx(program, feed_names, fetch_names, param_shardings,
+               segment_plan, where, scope=scope)
+    for check in _CHECKS:
+        check(ctx)
+    diags = ctx.diags
+    if rules is not None:
+        wanted = set(rules)
+        diags = [d for d in diags if d.rule in wanted]
+    return diags
+
+
+# fixture/test hooks: each enforced diagnostic is handed to every
+# installed collector (tests/conftest.py's opt-in autouse fixture)
+_COLLECTORS: List[Callable[[Diagnostic], None]] = []
+
+
+def install_collector(fn: Callable[[Diagnostic], None]):
+    _COLLECTORS.append(fn)
+    return fn
+
+
+def remove_collector(fn) -> None:
+    try:
+        _COLLECTORS.remove(fn)
+    except ValueError:
+        pass
+
+
+def enforce(diags: Sequence[Diagnostic], level: str,
+            where: str = "api") -> List[Diagnostic]:
+    """Apply the ``FLAGS_program_verify`` policy to ``diags``: count every
+    diagnostic through the telemetry registry, log warn-level lines, call
+    the installed collectors, and raise ``ProgramVerifyError`` at
+    level="error" when error-severity diagnostics exist."""
+    if level not in ("warn", "error"):
+        raise ValueError(
+            f"verify level must be 'warn' or 'error', got {level!r}")
+    if diags:
+        from . import telemetry
+        counter = telemetry.REGISTRY.counter(
+            "program_verify_diagnostics_total",
+            "Program verifier diagnostics by rule and severity",
+            labelnames=("rule", "severity"))
+        for d in diags:
+            counter.labels(rule=d.rule, severity=d.severity).inc()
+            _LOG.warning("program-verify[%s]: %s", where, d.format())
+            for fn in list(_COLLECTORS):
+                fn(d)
+    if level == "error" and any(d.severity == "error" for d in diags):
+        raise ProgramVerifyError(diags, where)
+    return list(diags)
+
+
+def maybe_verify(program, where: str, *, feed_names: Iterable[str] = (),
+                 fetch_names: Optional[Iterable[str]] = None,
+                 param_shardings: Optional[Dict[str, Any]] = None,
+                 segment_plan: Optional[Sequence[Any]] = None,
+                 level: Optional[str] = None, scope=None
+                 ) -> Optional[List[Diagnostic]]:
+    """Choke-point entry: verify ``program`` ONCE per (program version,
+    choke point) when ``FLAGS_program_verify`` (or an explicit ``level``)
+    asks for it. Steady state pays one dict probe per first-compile — the
+    flag's no-per-step-cost contract. A level="error" failure is NOT
+    cached, so every retry re-verifies and re-raises."""
+    if level is None:
+        level = str(core.globals_["FLAGS_program_verify"] or "")
+    if not level:
+        return None
+    if level not in ("warn", "error"):
+        raise ValueError(
+            f"FLAGS_program_verify must be ''|'warn'|'error', "
+            f"got {level!r}")
+    cache = program.__dict__.setdefault("_verify_versions", {})
+    key = (program._version, where)
+    if key in cache:
+        return None
+    t0 = time.perf_counter()
+    diags = verify_program(
+        program, feed_names=feed_names, fetch_names=fetch_names,
+        param_shardings=param_shardings, segment_plan=segment_plan,
+        where=where, scope=scope)
+    t1 = time.perf_counter()
+    from . import profiler as _profiler
+    # cat="segment": the verifier runs exactly where segment compiles do
+    # (first compile of a program version) — its cost lands beside them
+    # in the chrome trace instead of hiding in the first step's latency
+    _profiler.record_span(
+        f"verify:{where}", t0, t1, cat="segment",
+        args={"where": where, "level": level, "diagnostics": len(diags),
+              "version": program._version})
+    enforce(diags, level, where)   # raises before caching on error
+    cache[key] = len(diags)
+    return diags

@@ -1530,6 +1530,15 @@ class _GlobalFlags:
         # bookkeeping on a bias vector costs more than it saves
         "FLAGS_dgc_min_elements": 512,
         "FLAGS_sync_nccl_allreduce": True,   # no-op: ICI collectives are compiled
+        # static-analysis plane (docs/ANALYSIS.md; fluid/analysis.py):
+        # verify Programs at the choke points — Executor first compile of
+        # a program version, the transpiler's own trainer-program output,
+        # tools/verify_program.py. "" (off, default) | "warn" (log each
+        # diagnostic + program_verify_diagnostics_total{rule,severity}
+        # counters) | "error" (additionally raise ProgramVerifyError on
+        # error-severity diagnostics). Runs ONCE per program version —
+        # never per step, so warn mode adds no steady-state cost.
+        "FLAGS_program_verify": "",
         "FLAGS_executor_mode": "compiled",   # compiled | interpreted
         # segmented compilation: when a block fails the all-or-nothing
         # compiled check (a stateful/host op like auc/print/read among

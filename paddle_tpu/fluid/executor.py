@@ -42,6 +42,7 @@ import jax.numpy as jnp
 
 from . import core
 from . import telemetry as _telemetry
+from . import analysis as _analysis
 from .core import LoDTensor, Scope, global_scope
 from .framework import Program, Variable, default_main_program
 from ..ops.registry import (OPS, run_generic_grad, GRAD_SUFFIX,
@@ -2198,6 +2199,15 @@ class Executor:
             elif cached is not None and cached._scope_ref() is scope:
                 cb, rebuild = cached, False
             if rebuild:
+                # static-analysis choke point (docs/ANALYSIS.md): verify
+                # ONCE per program version at its first compile, BEFORE
+                # tracing — a structural defect gets a diagnostic with a
+                # fix hint instead of a deep TracerError. An error-level
+                # failure caches nothing, so a retry re-verifies.
+                _analysis.maybe_verify(
+                    program, "executor", feed_names=tuple(sorted(feed)),
+                    fetch_names=tuple(fetch_names),
+                    param_shardings=param_shardings, scope=scope)
                 seed = (program.random_seed
                         or core.globals_["FLAGS_seed"])
                 if compiled_ok:
@@ -2210,6 +2220,15 @@ class Executor:
                     cb = self._build_segmented(
                         program, feed, fetch_names, scope, seed,
                         feed_lods)
+                if cb is not None and cb.kind == "segmented":
+                    # donation-safety cross-check against the plan the
+                    # segmented build ACTUALLY produced (own dedup key:
+                    # the plan exists only post-build)
+                    _analysis.maybe_verify(
+                        program, "executor-plan",
+                        feed_names=tuple(sorted(feed)),
+                        fetch_names=tuple(fetch_names),
+                        segment_plan=cb.segments, scope=scope)
                 self._compiled_cache[key] = (
                     cb if cb is not None
                     else ("interpreted", weakref.ref(scope)))
@@ -2244,6 +2263,12 @@ class Executor:
                     Executor._rng_counters.get(scope, 1) - 1, 1, rng=rng)
             self._last_run_mode = "segmented"
         else:
+            # interpreted programs have no compile event — the analysis
+            # choke point anchors on the once-per-version guard-config
+            # build instead (maybe_verify dedups by program version)
+            _analysis.maybe_verify(
+                program, "executor", feed_names=tuple(sorted(feed)),
+                fetch_names=tuple(fetch_names), scope=scope)
             guard = self._interp_guard_cfg(program, set(feed), scope)
             for _ in range(n_steps - 1):  # same feeds, repeated steps
                 rng = self._next_rng(scope, program)

@@ -130,4 +130,13 @@ class DistributedAdam(DistributedOptimizerImplBase):
                 continue
             new_ops.append(op)
         block.ops = new_ops
+        # the dense W@GRAD descs are orphans now — every op producing or
+        # consuming them was dropped above; leaving them would ship dead
+        # var descs (analysis.py dead-var rule)
+        used = set()
+        for op in new_ops:
+            used.update(op.input_arg_names)
+            used.update(op.output_arg_names)
+        for g in grad_of - used:
+            block.vars.pop(g, None)
         program._version += 1

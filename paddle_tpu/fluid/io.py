@@ -323,6 +323,18 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
             used.update(op.output_arg_names)
     for name in [n for n in block.vars if n not in used]:
         del block.vars[name]
+    # the PR 7 multi-block var-drop invariant promoted to a verifier
+    # rule: the program about to serialize must be structurally complete
+    # (every op input in every block resolves to a VarDesc, no
+    # def-before-use, distributed tails paired). Unconditional — a save
+    # dir that fails level="error" verification would fail the native
+    # load validation anyway, just later and without the fix hints.
+    from . import analysis
+    analysis.enforce(
+        analysis.verify_program(
+            pruned, feed_names=tuple(feeded_var_names),
+            fetch_names=tuple(target_names), where="save"),
+        level="error", where="save")
     model_name = model_filename or "__model__"
     with open(os.path.join(dirname, model_name), "wb") as f:
         f.write(pruned.serialize_to_string())

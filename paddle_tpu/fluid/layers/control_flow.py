@@ -524,6 +524,22 @@ class StaticRNN:
                       self._var_of(n) for n in collected[name]]
             outs.append(stack(vars_t, axis=0))
         self._outputs = outs
+        # the step placeholders and the template's original output vars
+        # only existed for recording — after the per-step renaming no op
+        # references them; drop them so the program carries no dead var
+        # descs (the verifier's dead-var rule keys on exactly this)
+        used = set()
+        for op in block.ops:
+            used.update(op.input_arg_names)
+            used.update(op.output_arg_names)
+        scratch = {ph.name for ph, _src in self._step_inputs}
+        scratch |= {m["ph"] for m in self._memories}
+        for op in template:
+            scratch.update(op.output_arg_names)
+        for name in scratch - used:
+            v = block.vars.get(name)
+            if v is not None and not v.persistable:
+                del block.vars[name]
 
     def _var_of(self, name):
         v = self._block.vars.get(name)

@@ -1482,9 +1482,21 @@ class VarClient:
         return float(core.globals_["FLAGS_rpc_deadline"]) / 1000.0
 
     def _acquire(self) -> _Channel:
+        # bounded wait (lockcheck cv-wait-no-timeout): releases are
+        # finally-guaranteed in-process, so a starved pool means a leaked
+        # channel (a bug) or pathological contention — surface a typed
+        # deadline like every other stalled wait in the RPC plane instead
+        # of hanging the trainer forever on a lost notify
+        deadline = time.time() + self._deadline_s
         with self._cv:
             while not self._free:
-                self._cv.wait()
+                if not self._cv.wait(timeout=min(
+                        1.0, max(0.0, deadline - time.time()))) \
+                        and time.time() >= deadline:
+                    raise core.DeadlineExceededError(
+                        f"no free RPC channel to {self.endpoint} within "
+                        f"FLAGS_rpc_deadline — "
+                        f"{len(self._channels)} channel(s) all busy")
             return self._free.popleft()
 
     def _release(self, ch: _Channel) -> None:

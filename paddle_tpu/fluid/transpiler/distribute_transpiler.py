@@ -174,6 +174,15 @@ class DistributeTranspiler:
             self._build_geo_trainer_program()
         else:
             self._build_trainer_program()
+        # static-analysis choke point (docs/ANALYSIS.md): the transpiler
+        # verifies its OWN output — the distributed-protocol rules
+        # (barrier pairing, sparse-grad rewrite completeness, ps_round
+        # tail vs FLAGS_async_staleness) exist because transpiler bugs of
+        # exactly these classes shipped before (the PR 4 silent LOCAL
+        # lookup_table_grad). Fetch list unknown here, so dead-code rules
+        # skip; gated on FLAGS_program_verify like every choke point.
+        from .. import analysis
+        analysis.maybe_verify(self.trainer_program, "transpiler")
         return self
 
     # ------------------------------------------------------------------

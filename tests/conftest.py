@@ -87,12 +87,65 @@ def pytest_configure(config):
         "full 4KB..64MB run is a manual tool invocation). In-process "
         "and fast, stays in the tier-1 non-slow set.")
     config.addinivalue_line(
+        "markers", "analysis: static-analysis plane suite "
+        "(fluid/analysis.py program verifier + tools/lockcheck.py "
+        "concurrency lint; tests/test_analysis.py — per-rule units, the "
+        "seeded-mutation corpus, the repo-wide lockcheck run, CLI "
+        "smokes; docs/ANALYSIS.md). All in-process and tier-1 non-slow. "
+        "The opt-in PADDLE_TPU_VERIFY=1 sweep additionally verifies "
+        "every Program the whole suite builds (conftest "
+        "_verify_programs fixture + tests/verify_allowlist.py).")
+    config.addinivalue_line(
         "markers", "parallel3d: composed 3D-parallel lane suite "
         "(parallel/lm3d.py dp×pp×sp+MoE on the virtual 8-device mesh, "
         "gpipe/MoE composition units, executor window×pipeline "
         "parity — docs/ci.md). Small-shape units stay in the tier-1 "
         "non-slow set; the full bench-scale composition acceptance "
         "also carries 'slow'.")
+
+
+import pytest as _pytest
+
+
+@_pytest.fixture(autouse=True)
+def _verify_programs(request):
+    """Opt-in (PADDLE_TPU_VERIFY=1) program-verify sweep: run the
+    static-analysis plane in warn mode over every Program this test
+    compiles/interprets (the Executor/transpiler choke points fire
+    behind FLAGS_program_verify) and fail on any diagnostic
+    tests/verify_allowlist.py does not explain. Off by default so the
+    tier-1 gate's time budget is untouched."""
+    if not os.environ.get("PADDLE_TPU_VERIFY"):
+        yield
+        return
+    if "analysis" in request.node.keywords:
+        # the analysis suite exercises the verifier itself — its tests
+        # emit diagnostics on purpose
+        yield
+        return
+    from paddle_tpu.fluid import analysis, core as _core
+    collected = []
+    hook = analysis.install_collector(collected.append)
+    old = _core.globals_["FLAGS_program_verify"]
+    _core.set_flag("FLAGS_program_verify", "warn")
+    try:
+        yield
+    finally:
+        _core.set_flag("FLAGS_program_verify", old)
+        analysis.remove_collector(hook)
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "verify_allowlist",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "verify_allowlist.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bad = mod.unexplained(collected, request.node.nodeid.replace(
+        os.sep, "/"))
+    assert not bad, (
+        "program verifier surfaced unexplained diagnostics — fix the "
+        "program or add a rationale entry to tests/verify_allowlist.py:"
+        "\n" + "\n".join(d.format() for d in bad))
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,717 @@
+"""Static-analysis plane tests (fluid/analysis.py + tools/lockcheck.py;
+docs/ANALYSIS.md).
+
+Three layers:
+  * per-rule verifier units over hand-built programs;
+  * the seeded-mutation corpus the acceptance criteria pin: a dropped
+    send_barrier, an un-rewritten sparse grad (the PR 4 bug), a read of
+    a donated buffer (stale/tampered segment plan), a lock-order
+    inversion, and a blocking call under a grad-class lock — each must
+    be flagged with its exact rule id, and the UNMUTATED repo/programs
+    must verify clean;
+  * choke-point integration: Executor first-compile verification runs
+    once per program version (no per-step cost, retraces stay 0),
+    save_inference_model gates on level="error", the CLI tools work,
+    and the repo-wide lockcheck run is clean modulo the annotated
+    allowlist.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import analysis, core, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools import lockcheck  # noqa: E402
+from tools.verify_program import verify_bytes  # noqa: E402
+
+pytestmark = pytest.mark.analysis
+
+
+def _rules(diags):
+    return [d.rule for d in diags]
+
+
+def _flag(value):
+    """Set FLAGS_program_verify, returning a restore function."""
+    old = core.globals_["FLAGS_program_verify"]
+    core.set_flag("FLAGS_program_verify", value)
+    return lambda: core.set_flag("FLAGS_program_verify", old)
+
+
+# --------------------------------------------------------------- builders
+def _mlp_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+        y = fluid.layers.fc(h, 4)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _sparse_dist_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, size=[100, 8], is_sparse=True, is_distributed=True,
+            param_attr="emb_w")
+        emb = fluid.layers.reshape(emb, [-1, 8])
+        y = fluid.layers.fc(emb, 1)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main,
+                pservers="127.0.0.1:6174,127.0.0.1:6175", trainers=2,
+                sync_mode=True, startup_program=startup)
+    return t.get_trainer_program()
+
+
+def _island_program():
+    """Segmentable trainer: compiled fwd+bwd+sgd around a Print island."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        h = fluid.layers.fc(h, 8, act="relu")
+        y = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(y)
+        # island side effect OFF the grad path (print has no grad, so
+        # minimizing its output would sever the backward pass)
+        fluid.layers.Print(loss, message="loss")
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _run_segmented(main, startup, loss):
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                fetch_list=[loss])
+    cbs = [v for v in exe._compiled_cache.values()
+           if not isinstance(v, tuple) and v.kind == "segmented"]
+    assert cbs, "program did not take the segmented path"
+    return exe, scope, cbs[0]
+
+
+# ===================================================== per-rule units
+def test_clean_mlp_verifies_clean():
+    main, startup, loss = _mlp_program()
+    assert analysis.verify_program(main, fetch_names=[loss.name]) == []
+    assert analysis.verify_program(startup, fetch_names=[]) == []
+
+
+def test_def_before_use_flagged():
+    main = fluid.Program()
+    b = main.global_block()
+    b.create_var(name="ghost", shape=(2, 4), dtype="float32",
+                 persistable=False)
+    b.create_var(name="out", shape=(2, 4), dtype="float32")
+    b.append_op(type="relu", inputs={"X": ["ghost"]},
+                outputs={"Out": ["out"]}, attrs={})
+    diags = analysis.verify_program(main, fetch_names=["out"])
+    assert "def-before-use" in _rules(diags)
+    d = [x for x in diags if x.rule == "def-before-use"][0]
+    assert d.severity == "error" and d.var == "ghost" and d.fix_hint
+
+
+def test_missing_var_desc_flagged():
+    main = fluid.Program()
+    b = main.global_block()
+    b.create_var(name="out", shape=(2,), dtype="float32")
+    b.append_op(type="relu", inputs={"X": ["never_declared"]},
+                outputs={"Out": ["out"]}, attrs={})
+    diags = analysis.verify_program(main, fetch_names=["out"])
+    assert "missing-var-desc" in _rules(diags)
+    # the @EMPTY@ / @DEPENDENCY sentinels are slot placeholders, never
+    # diagnosed
+    b2 = fluid.Program().global_block()
+    b2.create_var(name="o", shape=(2,), dtype="float32")
+    b2.append_op(type="relu", inputs={"X": ["@EMPTY@"]},
+                 outputs={"Out": ["o"]}, attrs={})
+    assert "missing-var-desc" not in _rules(
+        analysis.verify_program(b2.program, fetch_names=["o"]))
+
+
+def test_dtype_mismatch_flagged():
+    main = fluid.Program()
+    b = main.global_block()
+    b.create_var(name="a", shape=(4,), dtype="float32", persistable=True)
+    b.create_var(name="i", shape=(4,), dtype="int32", persistable=True)
+    b.create_var(name="out", shape=(4,), dtype="float32")
+    b.append_op(type="elementwise_add", inputs={"X": ["a"], "Y": ["i"]},
+                outputs={"Out": ["out"]}, attrs={})
+    diags = analysis.verify_program(main, fetch_names=["out"])
+    assert "dtype-mismatch" in _rules(diags)
+    assert all(d.severity == "warn" for d in diags
+               if d.rule == "dtype-mismatch")
+
+
+def test_shape_mismatch_mul_flagged():
+    main = fluid.Program()
+    b = main.global_block()
+    b.create_var(name="x", shape=(-1, 8), dtype="float32",
+                 persistable=True)
+    b.create_var(name="w", shape=(9, 4), dtype="float32",
+                 persistable=True)
+    b.create_var(name="out", shape=(-1, 4), dtype="float32")
+    b.append_op(type="mul", inputs={"X": ["x"], "Y": ["w"]},
+                outputs={"Out": ["out"]},
+                attrs={"x_num_col_dims": 1, "y_num_col_dims": 1})
+    diags = analysis.verify_program(main, fetch_names=["out"])
+    assert "shape-mismatch" in _rules(diags)
+    # compatible shapes stay clean
+    main2 = fluid.Program()
+    b2 = main2.global_block()
+    b2.create_var(name="x", shape=(-1, 8), dtype="float32",
+                  persistable=True)
+    b2.create_var(name="w", shape=(8, 4), dtype="float32",
+                  persistable=True)
+    b2.create_var(name="out", shape=(-1, 4), dtype="float32")
+    b2.append_op(type="mul", inputs={"X": ["x"], "Y": ["w"]},
+                 outputs={"Out": ["out"]},
+                 attrs={"x_num_col_dims": 1, "y_num_col_dims": 1})
+    assert "shape-mismatch" not in _rules(
+        analysis.verify_program(main2, fetch_names=["out"]))
+
+
+def test_dead_op_and_dead_var_flagged():
+    main, startup, loss = _mlp_program()
+    b = main.global_block()
+    # dead op: pure compute nobody reads, fetches, or persists
+    b.create_var(name="unused_out", shape=(1,), dtype="float32")
+    b.append_op(type="scale", inputs={"X": [loss.name]},
+                outputs={"Out": ["unused_out"]}, attrs={"scale": 2.0})
+    # dead var: declared, never referenced
+    b.create_var(name="orphan", shape=(3,), dtype="float32")
+    diags = analysis.verify_program(main, fetch_names=[loss.name])
+    assert "dead-op" in _rules(diags)
+    assert any(d.rule == "dead-var" and d.var == "orphan" for d in diags)
+    # fetch list UNKNOWN -> dead rules must skip (a consumer-less output
+    # may be a later run's fetch target)
+    assert not any(d.rule in ("dead-op", "dead-var")
+                   for d in analysis.verify_program(main))
+    # fetching the output revives the op
+    assert "dead-op" not in _rules(analysis.verify_program(
+        main, fetch_names=[loss.name, "unused_out"]))
+
+
+def test_undeclared_sub_block_read_flagged():
+    main = fluid.Program()
+    b = main.global_block()
+    b.create_var(name="x", shape=(4,), dtype="float32", persistable=True)
+    b.create_var(name="hidden", shape=(4,), dtype="float32")
+    b.append_op(type="scale", inputs={"X": ["x"]},
+                outputs={"Out": ["hidden"]}, attrs={"scale": 1.0})
+    sub = main._create_block()
+    sub.create_var(name="sub_out", shape=(4,), dtype="float32")
+    sub.append_op(type="relu", inputs={"X": ["hidden"]},
+                  outputs={"Out": ["sub_out"]}, attrs={})
+    main._rollback()
+    b.create_var(name="cond", shape=(1,), dtype="bool", persistable=True)
+    # parent op does NOT declare 'hidden' in its inputs
+    b.append_op(type="conditional_block", inputs={"Cond": ["cond"]},
+                outputs={}, attrs={"sub_block": sub})
+    diags = analysis.verify_program(main)
+    hits = [d for d in diags if d.rule == "undeclared-sub-block-read"]
+    assert hits and hits[0].var == "hidden"
+    # declaring the read silences it
+    main.global_block().ops[-1].inputs["Input"] = ["hidden"]
+    assert not any(d.rule == "undeclared-sub-block-read"
+                   for d in analysis.verify_program(main))
+
+
+def test_retrace_lints():
+    main, _startup, _loss = _mlp_program()
+    from jax.sharding import PartitionSpec as P
+    diags = analysis.verify_program(
+        main, param_shardings={"w_long": P("pp", None, None),
+                               "w_short": P("pp")})
+    hits = [d for d in diags if d.rule == "retrace-partition-spec"]
+    assert [d.var for d in hits] == ["w_long"]
+    # feed-shape polymorphism beyond the batch dim
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        fluid.data("ragged", shape=[-1, 8], dtype="float32")
+    diags = analysis.verify_program(prog)
+    assert any(d.rule == "retrace-feed-shape" and d.var == "ragged"
+               for d in diags)
+
+
+# ============================================ mutation corpus: protocol
+def test_clean_transpiled_program_verifies_clean():
+    tp = _sparse_dist_program()
+    assert analysis.verify_program(tp) == []
+
+
+def test_mutation_dropped_barrier_flagged():
+    tp = _sparse_dist_program().clone()
+    blk = tp.global_block()
+    blk.ops = [op for op in blk.ops if op.type != "send_barrier"]
+    diags = analysis.verify_program(tp)
+    hits = [d for d in diags if d.rule == "dist-barrier-pairing"]
+    assert hits and all(d.severity == "error" for d in hits)
+    # dropping fetch_barrier instead is equally flagged
+    tp2 = _sparse_dist_program().clone()
+    blk2 = tp2.global_block()
+    blk2.ops = [op for op in blk2.ops if op.type != "fetch_barrier"]
+    assert "dist-barrier-pairing" in _rules(analysis.verify_program(tp2))
+
+
+def test_mutation_unrewritten_sparse_grad_flagged():
+    """The PR 4 critical bug as a permanent rule: a LOCAL
+    lookup_table_grad on a pserver-hosted table means the embedding
+    never trains."""
+    tp = _sparse_dist_program().clone()
+    for op in tp.global_block().ops:
+        if op.type == "distributed_lookup_table_grad":
+            op.type = "lookup_table_grad"
+    diags = analysis.verify_program(tp)
+    hits = [d for d in diags if d.rule == "dist-local-sparse-grad"]
+    assert hits and hits[0].severity == "error"
+    assert "PR 4" in hits[0].message
+
+
+def test_ps_round_tail_rules():
+    tp = _sparse_dist_program()
+    # staleness configured but inline tail present -> warn
+    old = core.globals_["FLAGS_async_staleness"]
+    core.set_flag("FLAGS_async_staleness", 2)
+    try:
+        diags = analysis.verify_program(tp)
+        hits = [d for d in diags if d.rule == "dist-ps-round-tail"]
+        assert hits and hits[0].severity == "warn"
+    finally:
+        core.set_flag("FLAGS_async_staleness", old)
+    # mixed tail (ps_round + inline barriers) -> error
+    tp2 = tp.clone()
+    tp2.global_block().append_op(
+        type="ps_round", inputs={"X": []}, outputs={"Out": []},
+        attrs={"grad_epmap": [], "param_epmap": [], "endpoints": [],
+               "trainer_id": 0})
+    diags = analysis.verify_program(tp2)
+    hits = [d for d in diags if d.rule == "dist-ps-round-tail"]
+    assert hits and hits[0].severity == "error"
+
+
+# ============================================ mutation corpus: donation
+def test_mutation_donated_buffer_read_flagged():
+    """'Read a donated buffer': the segmented executor's REAL plan,
+    cross-checked against (a) a program that grew a reader after the
+    plan was built and (b) a plan whose output leg was dropped — the
+    drift class behind the PR 5/7 review rounds and the regression wall
+    for the ROADMAP-5 lowering refactor."""
+    main, startup, loss = _island_program()
+    _exe, _scope, cb = _run_segmented(main, startup, loss)
+    donating = [s for s in cb.segments
+                if s.kind == "compiled" and s.donated_names]
+    assert donating, "no donated buffers — test premise broken"
+    fetch = [loss.name]
+
+    # the exact plan the executor built verifies clean
+    assert analysis.verify_program(
+        main, fetch_names=fetch, segment_plan=cb.segments) == []
+
+    # (a) program mutated after the plan was built: stale plan
+    main.global_block().create_var(name="w_read", shape=(1,),
+                                   dtype="float32")
+    main.global_block().append_op(
+        type="scale", inputs={"X": [donating[0].donated_names[0]]},
+        outputs={"Out": ["w_read"]}, attrs={"scale": 1.0})
+    diags = analysis.verify_program(main, fetch_names=fetch,
+                                    segment_plan=cb.segments)
+    hits = [d for d in diags if d.rule == "donation-safety"]
+    assert hits and hits[0].severity == "error"
+    main.global_block().ops.pop()
+    main.global_block().vars.pop("w_read")
+
+    # (b) tampered plan: donated param's output leg dropped
+    seg = donating[0]
+    victim = seg.donated_names[0]
+    orig_out = seg.out_names
+    seg.out_names = tuple(n for n in orig_out if n != victim)
+    try:
+        diags = analysis.verify_program(main, fetch_names=fetch,
+                                        segment_plan=cb.segments)
+        assert any(d.rule == "donation-safety" and d.var == victim
+                   for d in diags)
+    finally:
+        seg.out_names = orig_out
+
+
+def test_donation_guard_select_hazard_flagged():
+    """A plan donating buffers while the numeric-fault discard needs
+    pre-step refs (the exact PR 5 hazard the executor disables
+    per-segment donation for)."""
+    main, startup, loss = _island_program()
+    _exe, _scope, cb = _run_segmented(main, startup, loss)
+    assert any(getattr(s, "donated_names", ()) for s in cb.segments)
+    old_check = core.globals_["FLAGS_check_nan_inf"]
+    old_action = core.globals_["FLAGS_nan_inf_action"]
+    core.set_flag("FLAGS_check_nan_inf", True)
+    core.set_flag("FLAGS_nan_inf_action", "skip")
+    try:
+        diags = analysis.verify_program(
+            main, fetch_names=[loss.name], segment_plan=cb.segments)
+        assert any(d.rule == "donation-safety"
+                   and "pre-step" in d.message for d in diags)
+    finally:
+        core.set_flag("FLAGS_check_nan_inf", old_check)
+        core.set_flag("FLAGS_nan_inf_action", old_action)
+
+
+def test_segmented_choke_point_plan_check_clean():
+    """FLAGS_program_verify=warn through the segmented executor: the
+    freshly built plan self-checks clean (no diagnostics collected)."""
+    main, startup, loss = _island_program()
+    collected = []
+    hook = analysis.install_collector(collected.append)
+    restore = _flag("warn")
+    try:
+        _run_segmented(main, startup, loss)
+    finally:
+        restore()
+        analysis.remove_collector(hook)
+    assert collected == []
+    assert any(k[1] == "executor-plan"
+               for k in main.__dict__["_verify_versions"])
+
+
+# ========================================== mutation corpus: lockcheck
+_INVERSION_SRC = '''
+import threading
+
+class PushPlane:
+    def __init__(self):
+        self._grad_lock = threading.Lock()
+        self._table_lock = threading.Lock()
+
+    def push(self):
+        with self._grad_lock:
+            with self._table_lock:
+                pass
+
+    def shrink(self):
+        with self._table_lock:
+            with self._grad_lock:
+                pass
+'''
+
+_BLOCKING_SRC = '''
+import threading
+
+class Merger:
+    def __init__(self):
+        self._grad_lock = threading.Lock()
+        self._cv = threading.Condition(self._grad_lock)
+
+    def flush(self, sock):
+        with self._grad_lock:
+            payload = open("/tmp/spill").read()
+            sock.sendall(payload)
+
+    def waiter(self):
+        with self._cv:
+            self._cv.wait()
+
+    def bounded_waiter(self):
+        with self._cv:
+            self._cv.wait(timeout=1.0)
+'''
+
+_CALL_CYCLE_SRC = '''
+import threading
+
+_STATE_LOCK = threading.Lock()
+
+def reenter():
+    with _STATE_LOCK:
+        helper()
+
+def helper():
+    with _STATE_LOCK:
+        pass
+'''
+
+
+def test_mutation_lock_inversion_flagged():
+    findings = lockcheck.analyze_files({"plane.py": _INVERSION_SRC})
+    cycles = [f for f in findings if f.rule == "lock-order-cycle"]
+    assert len(cycles) == 1
+    assert "PushPlane._grad_lock" in cycles[0].key
+    assert "PushPlane._table_lock" in cycles[0].key
+    # both acquisition stacks reported
+    assert len(cycles[0].sites) >= 2
+
+
+def test_mutation_blocking_under_grad_lock_flagged():
+    findings = lockcheck.analyze_files({"merger.py": _BLOCKING_SRC})
+    rules = {f.rule for f in findings}
+    assert "file-io-under-lock" in rules
+    assert "socket-under-lock" in rules
+    waits = [f for f in findings if f.rule == "cv-wait-no-timeout"]
+    # the unbounded wait is flagged; the bounded one is not
+    assert len(waits) == 1 and "waiter" in waits[0].key
+
+
+def test_lockcheck_call_propagated_self_cycle():
+    findings = lockcheck.analyze_files({"reent.py": _CALL_CYCLE_SRC})
+    assert any(f.rule == "lock-self-cycle"
+               and "_STATE_LOCK" in f.key for f in findings)
+
+
+def test_lockcheck_condition_aliases_its_lock():
+    src = (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cv = threading.Condition(self._lock)\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            with self._cv:\n"
+        "                pass\n")
+    # cv IS the lock: the nested with must not fabricate a 2-lock cycle
+    findings = lockcheck.analyze_files({"a.py": src})
+    assert not any(f.rule == "lock-order-cycle" for f in findings)
+    # but re-entering a non-reentrant Lock through its alias IS flagged
+    assert any(f.rule == "lock-self-cycle" for f in findings)
+
+
+def test_lockcheck_repo_clean_tier1():
+    """The tier-1 wall: the repo's own lock graph has no un-vetted
+    inversions or blocking-calls-under-locks. Vetted exceptions live in
+    tools/lockcheck_allow.txt with rationales."""
+    active, suppressed = lockcheck.run(
+        os.path.join(REPO, "paddle_tpu"),
+        os.path.join(REPO, "tools", "lockcheck_allow.txt"))
+    assert active == [], "\n".join(f.format() for f in active)
+    # the allowlist is not dead weight: its entries suppress real sites
+    assert suppressed, "allowlist no longer matches anything — prune it"
+
+
+def test_lockcheck_allowlist_requires_rationale(tmp_path):
+    p = tmp_path / "allow.txt"
+    p.write_text("file-io-under-lock some:key\n")
+    with pytest.raises(SystemExit, match="rationale"):
+        lockcheck.load_allowlist(str(p))
+
+
+# =========================================== choke-point integration
+def test_executor_verifies_once_per_version_no_per_step_cost(
+        monkeypatch):
+    main, startup, loss = _mlp_program()
+    calls = []
+    real = analysis.verify_program
+
+    def counting(*a, **kw):
+        calls.append(kw.get("where"))
+        return real(*a, **kw)
+
+    monkeypatch.setattr(analysis, "verify_program", counting)
+    restore = _flag("warn")
+    try:
+        exe = fluid.Executor()
+        scope = core.Scope()
+
+        def retraces():
+            fam = telemetry.REGISTRY.get("executor_retraces_total")
+            if fam is None:
+                return 0.0
+            return sum(c.value for c in fam.children())
+
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            r0 = retraces()
+            for _ in range(4):
+                exe.run(main, feed={"x": np.ones((2, 8), "float32")},
+                        fetch_list=[loss])
+        # one verification for startup, one for main — 4 steps, no more
+        assert calls.count("executor") == 2
+        # steady state: no retraces introduced by the verify plane
+        assert retraces() == r0
+    finally:
+        restore()
+
+
+def test_executor_error_level_preempts_trace(monkeypatch):
+    """An error-severity diagnostic at level=error raises the typed
+    ProgramVerifyError BEFORE tracing — not a deep KeyError from the
+    jit trace."""
+    main = fluid.Program()
+    b = main.global_block()
+    b.create_var(name="x", shape=(-1, 4), dtype="float32", is_data=True,
+                 need_check_feed=True)
+    b.create_var(name="ghost", shape=(2, 4), dtype="float32")
+    b.create_var(name="out", shape=(2, 4), dtype="float32")
+    b.append_op(type="elementwise_add",
+                inputs={"X": ["x"], "Y": ["ghost"]},
+                outputs={"Out": ["out"]}, attrs={})
+    restore = _flag("error")
+    try:
+        exe = fluid.Executor()
+        scope = core.Scope()
+        with fluid.scope_guard(scope):
+            with pytest.raises(analysis.ProgramVerifyError) as ei:
+                exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                        fetch_list=["out"])
+        assert any(d.rule == "def-before-use"
+                   for d in ei.value.diagnostics)
+    finally:
+        restore()
+
+
+def test_diagnostics_counter_and_span():
+    """Telemetry satellite: program_verify_diagnostics_total{rule,
+    severity} counts each enforced diagnostic, and the verifier's
+    runtime lands as a cat='segment' span beside the compile spans."""
+    from paddle_tpu.fluid import profiler
+    main, startup, loss = _mlp_program()
+    b = main.global_block()
+    b.create_var(name="orphan_v", shape=(2,), dtype="float32")
+
+    fam = telemetry.REGISTRY.counter(
+        "program_verify_diagnostics_total",
+        "Program verifier diagnostics by rule and severity",
+        labelnames=("rule", "severity"))
+    before = fam.value(rule="dead-var", severity="warn")
+    profiler.start_profiler(state="CPU")
+    try:
+        restore = _flag("warn")
+        try:
+            exe = fluid.Executor()
+            scope = core.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                # interpreted-path choke point is enough — and cheap
+                core.set_flag("FLAGS_executor_mode", "interpreted")
+                try:
+                    exe.run(main,
+                            feed={"x": np.ones((2, 8), "float32")},
+                            fetch_list=[loss])
+                finally:
+                    core.set_flag("FLAGS_executor_mode", "compiled")
+        finally:
+            restore()
+        events = profiler.snapshot_events()
+    finally:
+        profiler.stop_profiler()
+    after = fam.value(rule="dead-var", severity="warn")
+    assert after == before + 1
+    spans = [e for e in events if e["name"] == "verify:executor"]
+    assert spans and all(s["cat"] == "segment" for s in spans)
+    assert any(s["args"]["diagnostics"] >= 1 for s in spans)
+
+
+def test_transpiler_verifies_own_output():
+    collected = []
+    hook = analysis.install_collector(collected.append)
+    restore = _flag("warn")
+    try:
+        tp = _sparse_dist_program()
+    finally:
+        restore()
+        analysis.remove_collector(hook)
+    assert collected == []           # the real transpiler is clean
+    assert any(k[1] == "transpiler"
+               for k in tp.__dict__["_verify_versions"])
+
+
+# ==================================== save path + CLI (satellites)
+def test_save_inference_model_gates_on_error(tmp_path, monkeypatch):
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    bad = analysis.Diagnostic(rule="missing-var-desc", severity="error",
+                              message="seeded", var="w")
+
+    def fake_verify(*a, **kw):
+        return [bad]
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        monkeypatch.setattr(analysis, "verify_program", fake_verify)
+        with pytest.raises(analysis.ProgramVerifyError):
+            fluid.io.save_inference_model(
+                str(tmp_path / "m"), ["x"], [loss], exe, main)
+
+
+def test_wide_deep_save_dir_regression(tmp_path):
+    """Satellite: a wide_deep save dir passes verify_program at
+    level='error' AND the CLI reports it clean — the PR 7 multi-block
+    var-drop invariant as a permanent regression test."""
+    from paddle_tpu.models.wide_deep import build_wide_deep_program
+    main, startup, feeds, loss, _auc = build_wide_deep_program(
+        num_dense=4, num_slots=3, sparse_dim=50, embedding_dim=4,
+        hidden=(8,), optimizer=fluid.optimizer.Adam(1e-3))
+    exe = fluid.Executor()
+    scope = core.Scope()
+    d = str(tmp_path / "wd")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        pred = main.global_block().var("click_prob") \
+            if main.global_block().has_var("click_prob") else loss
+        fluid.io.save_inference_model(d, feeds[:-1], [pred], exe, main)
+    with open(os.path.join(d, "__model__"), "rb") as f:
+        _prog, feed_names, fetch_names, diags = verify_bytes(f.read())
+    assert diags == [], "\n".join(x.format() for x in diags)
+    assert feed_names and fetch_names
+
+
+def test_verify_program_cli(tmp_path):
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    d = str(tmp_path / "m")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [loss], exe, main)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "verify_program.py"),
+         d, "--json"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert res.returncode == 0, res.stderr[-1500:]
+    rep = json.loads(res.stdout)
+    assert rep["diagnostics"] == [] and rep["feeds"] == ["x"]
+
+
+def test_inspect_program_verify_flag(tmp_path):
+    main, startup, loss = _mlp_program()
+    exe = fluid.Executor()
+    scope = core.Scope()
+    d = str(tmp_path / "m")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [loss], exe, main)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "inspect_program.py"),
+         os.path.join(d, "__model__"), "--verify"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert res.returncode == 0, res.stderr[-1500:]
+    rep = json.loads(res.stdout)
+    assert rep["diagnostics"] == [] and rep["errors"] == []
+
+
+def test_lockcheck_cli_json():
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lockcheck.py"),
+         "--json"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert res.returncode == 0, res.stdout[-1500:] + res.stderr[-800:]
+    rep = json.loads(res.stdout)
+    assert rep["findings"] == [] and rep["suppressed"]

@@ -445,6 +445,11 @@ def test_recv_save_writes_reference_format_blob(tmp_path):
         VarClient.reset_pool()
 
 
+@pytest.mark.slow
+# demoted r19 (suite-time buyback, 9s): a 3-trainer × 3-pserver
+# multiprocess cluster driver — the class docs/ci.md routes to `slow`
+# by convention; sync semantics + lazy sparse tables keep tier-1
+# coverage via the 2×2 and single-trainer tests above
 def test_ps_three_pservers_three_trainers_lazy_sparse(tmp_path):
     """Beyond the 2×2 cap (VERDICT r2 weak #6): 3 sync trainers × 3
     pservers with a beyond-threshold lazy sparse table — convergence,

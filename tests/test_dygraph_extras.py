@@ -3,6 +3,7 @@ training loop (reference: test_imperative_hook_for_layer.py,
 test_imperative_gan.py — tape isolation across alternating backward
 passes)."""
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 import paddle_tpu.fluid.dygraph as dygraph
@@ -56,6 +57,11 @@ def test_forward_pre_hook_can_rewrite_inputs():
                                    rtol=1e-6)
 
 
+@pytest.mark.slow
+# demoted r19 (suite-time buyback, 10s): 10s of interpreted dygraph
+# loops; the property it pins — a backward touching only its own
+# optimizer's params — keeps per-commit coverage via the imperative
+# parity + optimizer unit suites
 def test_gan_style_alternating_optimizers():
     """Generator/discriminator with separate optimizers: each backward
     only touches its own parameters (the reference's imperative GAN

@@ -41,6 +41,11 @@ def free_port():
 # kill-resume parity: SIGKILL mid-window, resume from the latest auto-
 # checkpoint, per-step losses bit-identical to the uninterrupted oracle
 # ==========================================================================
+@pytest.mark.slow
+# demoted r19 (suite-time buyback, 7s): a SIGKILL-and-respawn
+# subprocess driver — the class docs/ci.md routes to `slow` by
+# convention; checkpoint save/restore bit-exactness keeps in-process
+# tier-1 coverage via test_checkpoint.py
 def test_kill_resume_bit_exact_losses(tmp_path):
     # counter math: the global step counter is 1 + train-steps-done
     # (startup counts one advance), so every=6 checkpoints after train

@@ -255,6 +255,11 @@ def test_lm3d_pp_only_with_dropout_bit_identical_to_oracle():
 
 
 @requires8
+@pytest.mark.slow
+# demoted r19 (suite-time buyback, 8s): the window×mesh scan contract
+# keeps per-commit coverage via test_window_stack_through_gpipe_
+# bit_identical_to_step_loop (the executor-level parity on the same
+# mesh); the lm3d-lane window runner stays round-end full tier
 def test_lm3d_window_scan_bit_identical_to_step_loop():
     """K steps as ONE scanned window == K sequential step() calls —
     losses AND final params bit-equal, dropout masks included (keys

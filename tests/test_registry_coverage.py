@@ -26,7 +26,7 @@ def test_select_input_select_output():
     with fluid.program_guard(prog, fluid.Program()):
         b = prog.global_block()
         for n in ("si_a", "si_b", "si_mask", "si_out",
-                  "so_x", "so_o0", "so_o1"):
+                  "so_o0", "so_o1"):
             b.create_var(name=n)
         b.append_op(type="select_input",
                     inputs={"X": ["si_a", "si_b"], "Mask": ["si_mask"]},

@@ -129,6 +129,11 @@ def _run_auc_trainer(segmentation, steps=4, batch=32):
     return out, exe
 
 
+@pytest.mark.slow
+# demoted r19 (suite-time buyback, 9s): segmented-vs-interpreter
+# parity with a host island stays tier-1 via test_print_program_
+# trains_as_compiled_segments, and wide_deep convergence via
+# test_wide_deep.py; this AUC-island acceptance runs round-end
 def test_wide_deep_auc_trains_as_compiled_segments():
     """Acceptance (VERDICT next-round item 2's done-bar): a Wide&Deep
     train program fetching AUC executes fwd+bwd+update as compiled jitted

@@ -503,6 +503,11 @@ def test_embedding_cache_trainer_push_invalidation_and_fence():
 # chaos: pserver killed mid-HTTP-serving → degraded, zero 5xx, recovery
 # ======================================================================
 @pytest.mark.chaos
+@pytest.mark.slow
+# demoted r19 (suite-time buyback, 17s): a kill-under-live-traffic
+# chaos driver — the class docs/ci.md routes to `slow` by convention;
+# the degraded-mode/breaker/promoted-view properties it composes each
+# keep cheaper tier-1 tests in this file
 def test_pserver_kill_mid_http_serving_degrades_then_recovers():
     """The degradation acceptance, end to end over HTTP: kill the
     pserver under live ingress traffic (connection-severing shutdown —

@@ -3,19 +3,44 @@
 (reference: the debugging several reference tools do over ProgramDesc;
 backed by paddle_tpu/native/programdesc.cpp).
 
-Usage: python tools/inspect_program.py path/to/__model__
+Usage: python tools/inspect_program.py path/to/__model__ [--verify]
+
+--verify additionally runs the static-analysis plane (fluid/analysis.py,
+docs/ANALYSIS.md) over the parsed program and prints each diagnostic
+next to the op dump — the report JSON grows a "diagnostics" list and
+each diagnosed op entry is annotated in place.
 """
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    if len(sys.argv) != 2:
+    args = [a for a in sys.argv[1:] if a != "--verify"]
+    verify = "--verify" in sys.argv[1:]
+    if len(args) != 1:
         raise SystemExit(__doc__)
-    with open(sys.argv[1], "rb") as f:
+    with open(args[0], "rb") as f:
         data = f.read()
     from paddle_tpu.native import inspect_program_bytes
-    print(json.dumps(inspect_program_bytes(data), indent=2))
+    report = inspect_program_bytes(data)
+    if verify:
+        from tools.verify_program import verify_bytes
+        _prog, _feeds, _fetches, diags = verify_bytes(data)
+        report["diagnostics"] = [vars(d) for d in diags]
+        # annotate the native op dump in place so a diagnostic reads
+        # next to the op it fires on
+        blocks = report.get("blocks") or []
+        for d in diags:
+            if d.op_idx is None or d.block >= len(blocks):
+                continue
+            ops = blocks[d.block].get("ops") or []
+            if d.op_idx < len(ops) and isinstance(ops[d.op_idx], dict):
+                ops[d.op_idx].setdefault("diagnostics", []).append(
+                    d.format())
+    print(json.dumps(report, indent=2))
 
 
 if __name__ == "__main__":

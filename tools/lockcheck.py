@@ -1,0 +1,665 @@
+#!/usr/bin/env python
+"""Concurrency lint: static lock-order + blocking-call analysis over
+paddle_tpu/ (the second half of the static-analysis plane —
+docs/ANALYSIS.md; the program-level half is fluid/analysis.py).
+
+The lock-order races this repo has actually shipped — the ps_rpc /
+ps_membership / slab_spill inversions found only by chaos loops, the
+PR 6/10/12 hardening rounds' blocking-calls-under-locks — are all
+visible in the source: a ``with self._lock:`` nested (directly or
+through a call) inside another, in the opposite order somewhere else.
+This tool walks the AST of every module, builds the lock-acquisition
+graph, and reports:
+
+  * ``lock-order-cycle`` — two (or more) locks acquired in both orders
+    on some pair of code paths: a potential deadlock. Both acquisition
+    stacks are reported.
+  * ``lock-self-cycle`` — a non-reentrant ``threading.Lock`` re-acquired
+    while already held (directly or through a call chain): a guaranteed
+    deadlock when that path runs.
+  * ``cv-wait-no-timeout`` — ``Condition.wait()``/``wait_for()`` with no
+    timeout: an unbounded block that turns a lost notify into a hang
+    (the chaos-loop signature).
+  * ``socket-under-lock`` — socket send/recv/accept/connect while
+    holding a lock: the wire stalls every thread behind the lock.
+  * ``file-io-under-lock`` — file I/O (open/os.replace/os.fsync/...)
+    while holding a grad/slab/table-class lock (the PR 12 hardening
+    class): disk latency serializes the training data plane.
+
+Lock identity is per *declaration site* — ``mod:Class.attr`` for
+``self.attr = threading.Lock()`` and ``mod:NAME`` for module globals;
+``threading.Condition(self._lock)`` aliases the condition to its
+underlying lock. Distinct instances of one class share an identity
+(the standard, slightly conservative lint approximation); vetted
+exceptions live in an annotated allowlist (tools/lockcheck_allow.txt,
+every entry carries a rationale) and suppressed findings are still
+reported as suppressed.
+
+Usage:
+    python tools/lockcheck.py [--root paddle_tpu]
+                              [--allowlist tools/lockcheck_allow.txt]
+                              [--json]
+Exit status: 0 clean (allowlisted findings excluded), 1 otherwise.
+Runs as a tier-1 test (tests/test_analysis.py).
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import fnmatch
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cv"}
+
+_SOCKET_METHODS = {"sendall", "recv", "recv_into", "accept", "connect"}
+
+# file-I/O call shapes flagged under data-plane locks
+_OS_IO = {"replace", "fsync", "rename", "remove", "fdopen"}
+
+# lock ids matching any of these substrings guard the training data
+# plane (grad merge, slab/table rows) — disk I/O under them is the
+# PR 12 hardening class
+_IO_LOCK_HINTS = ("grad", "slab", "spill", "table", "merge", "staging")
+
+
+class Finding:
+    def __init__(self, rule: str, key: str, message: str,
+                 sites: Sequence[Tuple[str, int]]):
+        self.rule = rule
+        self.key = key
+        self.message = message
+        self.sites = list(sites)
+
+    @property
+    def full_key(self) -> str:
+        return f"{self.rule}:{self.key}"
+
+    def format(self) -> str:
+        locs = ", ".join(f"{f}:{ln}" for f, ln in self.sites[:6])
+        return f"[{self.rule}] {self.key}\n    {self.message}\n    at {locs}"
+
+    def as_dict(self):
+        return {"rule": self.rule, "key": self.key,
+                "message": self.message, "sites": self.sites}
+
+
+class _Acq:
+    """One lock acquisition site: lock id + where."""
+
+    __slots__ = ("lock", "file", "line", "func")
+
+    def __init__(self, lock: str, file: str, line: int, func: str):
+        self.lock = lock
+        self.file = file
+        self.line = line
+        self.func = func
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """Pass 1 over one module: lock declarations, cv aliases, class and
+    function inventory, import aliases."""
+
+    def __init__(self, mod: str, file: str):
+        self.mod = mod
+        self.file = file
+        self.locks: Dict[str, str] = {}        # lock id -> kind
+        self.aliases: Dict[str, str] = {}      # cv lock id -> aliased id
+        self.class_attrs: Dict[str, Set[str]] = {}   # Class -> lock attrs
+        self.bases: Dict[str, List[str]] = {}  # Class -> local base names
+        self.functions: Set[str] = set()       # qualified local func names
+        self.imports: Dict[str, str] = {}      # local alias -> module name
+        self._class: Optional[str] = None
+        self._func: List[str] = []
+
+    # ---- structure -----------------------------------------------------
+    def visit_ClassDef(self, node):
+        prev = self._class
+        self._class = node.name
+        self.class_attrs.setdefault(node.name, set())
+        self.bases[node.name] = [b.id for b in node.bases
+                                 if isinstance(b, ast.Name)]
+        self.generic_visit(node)
+        self._class = prev
+
+    def _visit_func(self, node):
+        self._func.append(node.name)
+        qual = ".".join(self._func)
+        self.functions.add(f"{self._class}.{qual}" if self._class else qual)
+        self.generic_visit(node)
+        self._func.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.imports[a.asname or a.name.split(".")[0]] = a.name
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        for a in node.names:
+            # best-effort: record "from x import y" so y.fn() can resolve
+            base = node.module or ""
+            self.imports[a.asname or a.name] = (
+                f"{base}.{a.name}" if base else a.name)
+        self.generic_visit(node)
+
+    # ---- lock declarations ---------------------------------------------
+    @staticmethod
+    def _lock_ctor(call) -> Optional[Tuple[str, ast.AST]]:
+        """('lock'|'rlock'|'cv', first_arg_or_None) when ``call`` is a
+        threading.Lock()/RLock()/Condition(...) constructor."""
+        if not isinstance(call, ast.Call):
+            return None
+        fn = call.func
+        name = None
+        if isinstance(fn, ast.Attribute):
+            name = fn.attr
+        elif isinstance(fn, ast.Name):
+            name = fn.id
+        kind = _LOCK_CTORS.get(name or "")
+        if kind is None:
+            return None
+        arg = call.args[0] if call.args else None
+        return kind, arg
+
+    def _target_lock_id(self, target) -> Optional[str]:
+        if isinstance(target, ast.Name) and self._func == []:
+            return f"{self.mod}:{target.id}"
+        if isinstance(target, ast.Name) and self._func:
+            return None  # function-local lock: invisible outside
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self" and self._class:
+            return f"{self.mod}:{self._class}.{target.attr}"
+        return None
+
+    def visit_Assign(self, node):
+        ctor = self._lock_ctor(node.value)
+        if ctor is not None:
+            kind, arg = ctor
+            for t in node.targets:
+                lid = self._target_lock_id(t)
+                if lid is None:
+                    continue
+                self.locks[lid] = kind
+                if isinstance(t, ast.Attribute) and self._class:
+                    self.class_attrs[self._class].add(t.attr)
+                if kind == "cv" and arg is not None:
+                    src = self._target_lock_id(arg)
+                    if src is not None:
+                        self.aliases[lid] = src
+        self.generic_visit(node)
+
+
+class _FuncWalker(ast.NodeVisitor):
+    """Pass 2 over one function: with-lock nesting, calls under locks,
+    blocking-call findings."""
+
+    def __init__(self, an: "Analyzer", idx: _ModuleIndex,
+                 cls: Optional[str], qual: str):
+        self.an = an
+        self.idx = idx
+        self.cls = cls
+        self.qual = qual            # "mod:Class.method" / "mod:func"
+        self.held: List[_Acq] = []
+
+    # nested defs are walked as their own functions by the analyzer
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        pass
+
+    # ---- helpers -------------------------------------------------------
+    def _resolve_lock(self, expr) -> Optional[str]:
+        lid = None
+        if isinstance(expr, ast.Name):
+            cand = f"{self.idx.mod}:{expr.id}"
+            if cand in self.an.locks:
+                lid = cand
+        elif isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and self.cls:
+            lid = self.an.resolve_self_attr(self.idx, self.cls, expr.attr)
+        return self.an.canonical(lid) if lid else None
+
+    def _resolve_callee(self, fn) -> Optional[str]:
+        mod = self.idx.mod
+        if isinstance(fn, ast.Name):
+            if fn.id in self.idx.functions:
+                return f"{mod}:{fn.id}"
+            return None
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            if isinstance(recv, ast.Name) and recv.id == "self" \
+                    and self.cls:
+                return self.an.resolve_self_method(self.idx, self.cls,
+                                                   fn.attr)
+            if isinstance(recv, ast.Name):
+                target_mod = self.an.resolve_import(self.idx, recv.id)
+                if target_mod and f"{target_mod}:{fn.attr}" \
+                        in self.an.func_acquires:
+                    return f"{target_mod}:{fn.attr}"
+        return None
+
+    def _site(self, node) -> Tuple[str, int]:
+        return (self.idx.file, getattr(node, "lineno", 0))
+
+    # ---- with ----------------------------------------------------------
+    def _visit_with(self, node):
+        pushed = 0
+        for item in node.items:
+            lid = self._resolve_lock(item.context_expr)
+            if lid is None:
+                continue
+            acq = _Acq(lid, self.idx.file, item.context_expr.lineno
+                       if hasattr(item.context_expr, "lineno")
+                       else node.lineno, self.qual)
+            for held in self.held:
+                self.an.add_edge(held, acq, via=None)
+            self.an.func_direct[self.qual].append(acq)
+            self.held.append(acq)
+            pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    # ---- calls ---------------------------------------------------------
+    def visit_Call(self, node):
+        fn = node.func
+        # blocking-call findings -----------------------------------------
+        if isinstance(fn, ast.Attribute):
+            attr = fn.attr
+            if attr in ("wait", "wait_for"):
+                self._check_wait(node, fn)
+            elif attr in _SOCKET_METHODS and self.held:
+                self._flag_socket(node, fn)
+            elif attr in _OS_IO and isinstance(fn.value, ast.Name) \
+                    and fn.value.id == "os":
+                self._check_file_io(node, f"os.{attr}")
+        elif isinstance(fn, ast.Name) and fn.id == "open":
+            self._check_file_io(node, "open")
+        # call-graph recording -------------------------------------------
+        callee = self._resolve_callee(fn)
+        if callee is not None:
+            self.an.func_calls[self.qual].append(
+                (callee, tuple(self.held), self._site(node)))
+        self.generic_visit(node)
+
+    def _check_wait(self, node, fn):
+        recv = fn.value
+        is_cv = False
+        if isinstance(recv, ast.Attribute) and isinstance(recv.value,
+                                                          ast.Name) \
+                and recv.value.id == "self" and self.cls:
+            lid = self.an.resolve_self_attr(self.idx, self.cls, recv.attr)
+            is_cv = lid is not None and self.an.locks.get(lid) == "cv"
+        elif isinstance(recv, ast.Name):
+            lid = f"{self.idx.mod}:{recv.id}"
+            is_cv = self.an.locks.get(lid) == "cv"
+        if not is_cv:
+            return
+        has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
+        pos_needed = 1 if fn.attr == "wait" else 2  # wait_for(pred, t)
+        if len(node.args) >= pos_needed:
+            has_timeout = True
+        if not has_timeout:
+            f, ln = self._site(node)
+            self.an.findings.append(Finding(
+                "cv-wait-no-timeout", f"{self.qual}:{fn.attr}",
+                f"Condition.{fn.attr}() without a timeout in {self.qual} "
+                "— a lost notify (killed peer, exception before "
+                "notify_all) hangs this thread forever; every waiter in "
+                "this codebase bounds its wait and re-checks liveness",
+                [(f, ln)]))
+
+    def _flag_socket(self, node, fn):
+        f, ln = self._site(node)
+        top = self.held[-1]
+        self.an.findings.append(Finding(
+            "socket-under-lock",
+            f"{top.lock}:{fn.attr}",
+            f"socket .{fn.attr}() while holding {top.lock} in "
+            f"{self.qual} — the peer's scheduling delay stalls every "
+            "thread contending for the lock (bounded only by the socket "
+            "timeout, if one is set)",
+            [(f, ln)]))
+
+    def _check_file_io(self, node, what):
+        for held in self.held:
+            low = held.lock.lower()
+            if any(h in low for h in _IO_LOCK_HINTS):
+                f, ln = self._site(node)
+                self.an.findings.append(Finding(
+                    "file-io-under-lock",
+                    f"{held.lock}:{what}",
+                    f"{what}(...) while holding data-plane lock "
+                    f"{held.lock} in {self.qual} — disk latency "
+                    "serializes the grad/row path behind this lock "
+                    "(the PR 12 hardening class)",
+                    [(f, ln)]))
+                return
+
+
+class Analyzer:
+    def __init__(self):
+        self.indexes: Dict[str, _ModuleIndex] = {}
+        self.locks: Dict[str, str] = {}
+        self.aliases: Dict[str, str] = {}
+        # lock graph: (A, B) -> list of evidence sites
+        self.edges: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+        self.func_direct: Dict[str, List[_Acq]] = {}
+        self.func_calls: Dict[str, List] = {}
+        self.func_acquires: Dict[str, Set[str]] = {}
+        self.findings: List[Finding] = []
+
+    # ---- identity ------------------------------------------------------
+    def canonical(self, lid: str) -> str:
+        seen = set()
+        while lid in self.aliases and lid not in seen:
+            seen.add(lid)
+            lid = self.aliases[lid]
+        return lid
+
+    def resolve_self_attr(self, idx: _ModuleIndex, cls: str,
+                          attr: str) -> Optional[str]:
+        """self.<attr> as a lock id: exact class, then local base
+        classes, then — only if UNIQUE — any class in the module (covers
+        mixins); ambiguity returns None rather than guessing."""
+        cand = f"{idx.mod}:{cls}.{attr}"
+        if cand in self.locks:
+            return cand
+        for base in idx.bases.get(cls, ()):
+            got = self.resolve_self_attr(idx, base, attr)
+            if got is not None:
+                return got
+        owners = [c for c, attrs in idx.class_attrs.items() if attr in attrs]
+        if len(owners) == 1:
+            return f"{idx.mod}:{owners[0]}.{attr}"
+        return None
+
+    def resolve_self_method(self, idx: _ModuleIndex, cls: str,
+                            meth: str) -> Optional[str]:
+        cand = f"{cls}.{meth}"
+        if cand in idx.functions:
+            return f"{idx.mod}:{cand}"
+        for base in idx.bases.get(cls, ()):
+            got = self.resolve_self_method(idx, base, meth)
+            if got is not None:
+                return got
+        return None
+
+    def resolve_import(self, idx: _ModuleIndex, alias: str
+                       ) -> Optional[str]:
+        target = idx.imports.get(alias)
+        if target is None:
+            return None
+        # match the tail of any analyzed module path
+        for mod in self.indexes:
+            if mod == target or mod.endswith("." + target.split(".")[-1]) \
+                    and target.split(".")[-1] == mod.rsplit(".", 1)[-1]:
+                return mod
+        return None
+
+    def add_edge(self, held: _Acq, acq: _Acq,
+                 via: Optional[str]) -> None:
+        a, b = held.lock, acq.lock
+        evid = (acq.file, acq.line,
+                f"{acq.func}" + (f" via {via}" if via else "")
+                + f" (outer {held.lock} at {held.file}:{held.line})")
+        self.edges.setdefault((a, b), []).append(evid)
+
+    # ---- pipeline ------------------------------------------------------
+    def index_files(self, files: Dict[str, str]) -> None:
+        for relpath, src in sorted(files.items()):
+            mod = relpath[:-3].replace(os.sep, "/").replace("/", ".")
+            try:
+                tree = ast.parse(src)
+            except SyntaxError as e:  # pragma: no cover
+                self.findings.append(Finding(
+                    "parse-error", relpath, str(e), [(relpath, 0)]))
+                continue
+            idx = _ModuleIndex(mod, relpath)
+            idx.visit(tree)
+            idx._tree = tree
+            self.indexes[mod] = idx
+            self.locks.update(idx.locks)
+            self.aliases.update(idx.aliases)
+
+    def walk_functions(self) -> None:
+        for mod, idx in self.indexes.items():
+            self._walk_module(idx, idx._tree, cls=None, prefix=())
+
+    def _walk_module(self, idx, node, cls, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._walk_module(idx, child, cls=child.name, prefix=())
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                q = prefix + (child.name,)
+                qual = f"{idx.mod}:" + (f"{cls}." if cls else "") \
+                    + ".".join(q)
+                self.func_direct.setdefault(qual, [])
+                self.func_calls.setdefault(qual, [])
+                w = _FuncWalker(self, idx, cls, qual)
+                for stmt in child.body:
+                    w.visit(stmt)
+                # nested defs: separate walk (thread bodies live there),
+                # same class context
+                self._walk_module(idx, child, cls=cls, prefix=q)
+
+    def propagate(self) -> None:
+        """Transitive lock sets per function, then call-mediated edges:
+        holding L while calling f() that (transitively) acquires M is an
+        L->M ordering."""
+        acq: Dict[str, Set[str]] = {
+            f: {a.lock for a in acquisitions}
+            for f, acquisitions in self.func_direct.items()}
+        self.func_acquires = acq
+        changed = True
+        while changed:
+            changed = False
+            for f, calls in self.func_calls.items():
+                for callee, _held, _site in calls:
+                    extra = acq.get(callee, set()) - acq.setdefault(f,
+                                                                    set())
+                    if extra:
+                        acq[f] |= extra
+                        changed = True
+        for f, calls in self.func_calls.items():
+            for callee, held, site in calls:
+                if not held:
+                    continue
+                for target in sorted(acq.get(callee, ())):
+                    for h in held:
+                        fake = _Acq(target, site[0], site[1], callee)
+                        self.add_edge(h, fake, via=callee)
+
+    def detect_cycles(self) -> None:
+        # self-cycles: non-reentrant Lock re-acquired while held
+        for (a, b), evid in sorted(self.edges.items()):
+            if a == b and self.locks.get(self.canonical(a)) == "lock":
+                self.findings.append(Finding(
+                    "lock-self-cycle", a,
+                    f"non-reentrant {a} (threading.Lock) acquired while "
+                    "already held — guaranteed deadlock when this path "
+                    "runs",
+                    [(f, ln) for f, ln, _ in evid[:4]]))
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+                graph.setdefault(b, set())
+        for comp in _sccs(graph):
+            if len(comp) < 2:
+                continue
+            cyc = sorted(comp)
+            sites: List[Tuple[str, int]] = []
+            detail = []
+            for (a, b), evid in sorted(self.edges.items()):
+                if a in comp and b in comp and a != b:
+                    f, ln, ctx = evid[0]
+                    sites.append((f, ln))
+                    detail.append(f"{a} -> {b} [{ctx}]")
+            self.findings.append(Finding(
+                "lock-order-cycle", "|".join(cyc),
+                "locks acquired in conflicting orders — potential "
+                "deadlock; acquisition stacks: " + "; ".join(detail[:6]),
+                sites))
+
+
+def _sccs(graph: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Tarjan strongly-connected components (iterative)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+
+    def strongconnect(v0):
+        work = [(v0, iter(sorted(graph.get(v0, ()))))]
+        index[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        on.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[v])
+            if low[v] == index[v]:
+                comp = set()
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.add(w)
+                    if w == v:
+                        break
+                out.append(comp)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+# --------------------------------------------------------------------------
+# allowlist
+# --------------------------------------------------------------------------
+def load_allowlist(path: Optional[str]) -> List[Tuple[str, str]]:
+    """Lines: ``<rule-id> <key-glob>  # rationale``. The rationale is
+    MANDATORY — an entry without one is itself an error (the point of
+    the allowlist is recorded judgment, not silencing)."""
+    entries: List[Tuple[str, str]] = []
+    if not path or not os.path.exists(path):
+        return entries
+    with open(path) as f:
+        for i, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "#" not in line:
+                raise SystemExit(
+                    f"{path}:{i}: allowlist entry without a rationale "
+                    f"comment: {line!r}")
+            body = line.split("#", 1)[0].strip()
+            parts = body.split(None, 1)
+            if len(parts) != 2:
+                raise SystemExit(
+                    f"{path}:{i}: expected '<rule> <key-glob> # why', "
+                    f"got {line!r}")
+            entries.append((parts[0], parts[1]))
+    return entries
+
+
+def split_findings(findings: Sequence[Finding],
+                   allow: Sequence[Tuple[str, str]]
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    active, suppressed = [], []
+    for f in findings:
+        if any(f.rule == rule and fnmatch.fnmatch(f.key, pat)
+               for rule, pat in allow):
+            suppressed.append(f)
+        else:
+            active.append(f)
+    return active, suppressed
+
+
+# --------------------------------------------------------------------------
+# entry points
+# --------------------------------------------------------------------------
+def analyze_files(files: Dict[str, str]) -> List[Finding]:
+    """Full pipeline over {relpath: source} — the unit-test entry."""
+    an = Analyzer()
+    an.index_files(files)
+    an.walk_functions()
+    an.propagate()
+    an.detect_cycles()
+    return an.findings
+
+
+def collect_sources(root: str) -> Dict[str, str]:
+    files: Dict[str, str] = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, fn)
+            with open(p, encoding="utf-8") as f:
+                files[os.path.relpath(p, os.path.dirname(root))] = f.read()
+    return files
+
+
+def run(root: str, allow_path: Optional[str] = None
+        ) -> Tuple[List[Finding], List[Finding]]:
+    findings = analyze_files(collect_sources(root))
+    return split_findings(findings, load_allowlist(allow_path))
+
+
+def main(argv=None) -> int:
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(here)
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--root", default=os.path.join(repo, "paddle_tpu"))
+    ap.add_argument("--allowlist",
+                    default=os.path.join(here, "lockcheck_allow.txt"))
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    active, suppressed = run(args.root, args.allowlist)
+    if args.json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in active],
+            "suppressed": [f.as_dict() for f in suppressed]}, indent=2))
+    else:
+        for f in active:
+            print(f.format())
+        print(f"{len(active)} finding(s), {len(suppressed)} allowlisted")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
