@@ -1441,6 +1441,146 @@ def bench_serve_http_overload(clients=16, duration=2.5, warmup_s=0.5,
     }
 
 
+def bench_serve_fleet(members=4, clients=8, duration=3.0, warmup_s=0.5,
+                      n_rows=256, dim=8):
+    """Serving-fleet scale lane (docs/SERVING.md "Fleet"): ``members``
+    REAL engine subprocesses (tools/chaos_ps.py serving-member — each
+    its own interpreter, ingress, EmbeddingCache and invalidation
+    subscriber) behind a FleetDirectory, driven closed-loop through
+    the FleetRouter, vs the SAME load against one member. Also probes
+    the fleet contracts outside the timed loops: per-member response
+    parity (every member must answer a probe id identically — they
+    serve one table), and the trainer-push freshness window (publish →
+    new value in a remote HTTP response, wall-clock measured).
+
+    1-core caveat: all member processes time-slice one core, so the
+    fleet/single QPS ratio is trend-only there — the acceptance
+    evidence arm is parity + freshness + the per-endpoint spread
+    showing genuine multi-process overlap (PR 7 serving caveat; the
+    ≥3× scale claim needs ≥``members`` cores)."""
+    import tempfile
+    import threading
+
+    from tools.chaos_ps import (_spawn, _wait_file, free_port)
+    from tools.serving_loadgen import (HttpClient,
+                                       run_http_fleet_closed_loop)
+    from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+    from paddle_tpu.serving import FleetDirectory, InvalidationPublisher
+
+    rng = np.random.RandomState(7)
+    table = rng.rand(n_rows, dim).astype(np.float32)
+    tlock = threading.Lock()
+
+    def serve_table(name, rows, prefetch=False, trainer_id=0):
+        with tlock:
+            return table[np.asarray(rows, np.int64)].copy()
+
+    workdir = tempfile.mkdtemp(prefix="bench_fleet_")
+    table_ep = f"127.0.0.1:{free_port()}"
+    pub_ep = f"127.0.0.1:{free_port()}"
+    dir_ep = f"127.0.0.1:{free_port()}"
+    srv = VarServer(table_ep, {"prefetch_rows": serve_table}).start()
+    pub = InvalidationPublisher(pub_ep).start()
+    directory = FleetDirectory(dir_ep, heartbeat_timeout_s=2.0).start()
+    chaos_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "chaos_ps.py")
+    procs = []
+    try:
+        waits = []
+        for i in range(members):
+            ready = os.path.join(workdir, f"m{i}.ready")
+            p, tail = _spawn(
+                [chaos_py, "serving-member", f"m{i}", table_ep, pub_ep,
+                 dir_ep, ready, f"--rows={n_rows}", f"--dim={dim}",
+                 "--hb=2.0"],
+                os.path.join(workdir, f"m{i}.log"))
+            procs.append(p)
+            waits.append((ready, p, tail))
+        ports = []
+        for ready, p, tail in waits:
+            _wait_file(ready, 180, [(p, tail)], desc=ready)
+            ports.append(int(open(ready).read().strip()))
+
+        feeds = [{"ids": np.array([[i % n_rows]], np.int64)}
+                 for i in range(64)]
+        # per-member parity probe: one table, identical answers
+        probe_id = 13
+        answers = []
+        for port in ports:
+            cli = HttpClient("127.0.0.1", port)
+            try:
+                status, obj = cli.predict({"ids": [[probe_id]]},
+                                          model="fleet")
+            finally:
+                cli.close()
+            assert status == 200, (status, obj)
+            answers.append(float(np.asarray(obj["outputs"][0])
+                                 .reshape(-1)[0]))
+        parity_ok = all(a == answers[0] for a in answers)
+
+        single = run_http_fleet_closed_loop(
+            [f"127.0.0.1:{ports[0]}"], feeds, clients=clients,
+            duration_s=duration, warmup_s=warmup_s, model="fleet")
+        fleet = run_http_fleet_closed_loop(
+            [], feeds, clients=clients, duration_s=duration,
+            warmup_s=warmup_s, model="fleet", directory_ep=dir_ep)
+
+        # freshness: a trainer push must reach a REMOTE response fast
+        with tlock:
+            table[probe_id] += 1.0
+            expect = float(table[probe_id].sum())
+        t_push = time.time()
+        pub.publish("emb_fleet", [probe_id])
+        window = None
+        cli = HttpClient("127.0.0.1", ports[-1])
+        try:
+            while time.time() - t_push < 10.0:
+                status, obj = cli.predict({"ids": [[probe_id]]},
+                                          model="fleet")
+                if status == 200 and abs(
+                        float(np.asarray(obj["outputs"][0])
+                              .reshape(-1)[0]) - expect) < 1e-3:
+                    window = time.time() - t_push
+                    break
+                time.sleep(0.01)
+        finally:
+            cli.close()
+
+        ratio = (fleet["qps"] / single["qps"]) if single["qps"] else 0.0
+        return {
+            "metric": "serve_fleet_scale",
+            "value": round(ratio, 3),
+            "unit": f"x ({members}-member fleet QPS / 1-member QPS; "
+                    "trend-only on 1 core)",
+            "vs_baseline": round(ratio, 3),
+            "members": members, "clients": clients,
+            "fleet_qps": round(fleet["qps"], 1),
+            "single_qps": round(single["qps"], 1),
+            "fleet_p99_ms": round(fleet["p99_ms"], 2),
+            "single_p99_ms": round(single["p99_ms"], 2),
+            "by_endpoint_ok": {
+                ep: d.get("ok", 0)
+                for ep, d in fleet["by_endpoint"].items()},
+            "parity_ok": bool(parity_ok),
+            "freshness_window_s": (round(window, 4)
+                                   if window is not None else None),
+            "cores": os.cpu_count(),
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except Exception:
+                p.kill()
+        directory.close()
+        pub.close()
+        srv.shutdown()
+        VarClient.reset_pool()
+
+
 def bench_longctx(iters=8):
     """Long-context attention lane (SURVEY §5: long-context is
     first-class here — ring/Ulysses SP + flash kernels — where the
@@ -1793,6 +1933,7 @@ def main():
                "serve_mnist": bench_serving_mnist,
                "serve_wide_deep": bench_serving_wide_deep,
                "serve_http_overload": bench_serve_http_overload,
+               "serve_fleet": bench_serve_fleet,
                "flash": bench_flash, "longctx": bench_longctx,
                "lm3d": bench_lm3d}
     if which not in benches:

@@ -208,6 +208,28 @@ def current_row_cache():
     return _ROW_CACHE
 
 
+# Cross-process half of the same contract (docs/SERVING.md "Fleet"): a
+# TRAINER process installs an invalidation publisher
+# (serving.fleet.InvalidationPublisher-shaped: ``publish(table, ids)``)
+# and the grad-push site fans the pushed row ids to every remote serving
+# EmbeddingCache over the wire — never installed as a row cache (a
+# publisher must not be consulted on forward lookups).
+_INV_PUBLISHER = None
+
+
+def install_invalidation_publisher(pub):
+    """Install ``pub`` as the process invalidation publisher; returns
+    the previously installed one (or None) so callers can restore it."""
+    global _INV_PUBLISHER
+    prev = _INV_PUBLISHER
+    _INV_PUBLISHER = pub
+    return prev
+
+
+def current_invalidation_publisher():
+    return _INV_PUBLISHER
+
+
 # ---------------------------------------------------------------------------
 # deadline-aware call budget (docs/SERVING.md "Ingress & overload"). The
 # serving ingress stamps each request with a deadline; the engine installs

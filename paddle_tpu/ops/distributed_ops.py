@@ -826,6 +826,13 @@ def _distributed_lookup_table_grad(ins, attrs):
         cache = _ps_rpc.current_row_cache()
         if cache is not None and hasattr(cache, "invalidate_rows"):
             cache.invalidate_rows(w_name, ids)
+        # cross-process half (docs/SERVING.md "Fleet"): fan the same
+        # pushed-row invalidation to every REMOTE serving cache via the
+        # fleet publisher — enqueue-only here (subscribers long-poll),
+        # so the push path never blocks on a slow serving box
+        pub = _ps_rpc.current_invalidation_publisher()
+        if pub is not None:
+            pub.publish(w_name, ids)
 
         def _push_all(ids=ids, g=g):
             if len(eps) == 1:

@@ -60,6 +60,16 @@ def init_distributed(coordinator_address: Optional[str] = None) -> bool:
         raise RuntimeError(
             "init_distributed needs PADDLE_TRAINER_ENDPOINTS or "
             "JAX_COORDINATOR_ADDRESS to locate the coordinator")
+    # The CPU backend refuses cross-process computations ("Multiprocess
+    # computations aren't implemented on the CPU backend") unless a CPU
+    # collectives implementation is selected BEFORE the backend is
+    # created; this jaxlib ships gloo, so multi-process CPU meshes (the
+    # launch-parity lanes) need it switched on here, not at step time.
+    if os.getenv("JAX_PLATFORMS", "").startswith("cpu"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # older jax: flag absent, single-host CPU still works
     jax.distributed.initialize(coordinator_address=addr,
                                num_processes=n, process_id=rank())
     _distributed_initialized = True

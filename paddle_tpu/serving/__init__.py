@@ -28,6 +28,12 @@ package is that missing production layer over `paddle_tpu.inference`:
     oldest-drop, serve-stale degraded mode under an open per-pserver
     circuit breaker, and SIGTERM graceful drain that loses zero
     accepted requests (docs/SERVING.md "Ingress & overload").
+  * `fleet` — the self-healing multi-process layer (docs/SERVING.md
+    "Fleet"): trainer→serving invalidation pub/sub over the PR 4 wire
+    (`InvalidationPublisher`/`InvalidationSubscriber`), epoch-stamped
+    serving membership with heartbeat eviction and zero-lost rolling
+    drain (`FleetDirectory`/`FleetMember`/`FleetRouter`), and the
+    SLO-holding `Autopilot` the chaos harness exercises.
 
 Quick start::
 
@@ -43,9 +49,15 @@ from .admission import AdmissionController, TokenBucket
 from .batching import BatchingQueue, Request, next_bucket
 from .embedding_cache import EmbeddingCache
 from .engine import ServingEngine
+from .fleet import (Autopilot, FleetDirectory, FleetMember, FleetRouter,
+                    InvalidationPublisher, InvalidationSubscriber,
+                    NoLiveMembersError, SLO)
 from .ingress import ServingIngress
 from .sparse import rewrite_sparse_lookups
 
 __all__ = ["ServingEngine", "ServingIngress", "AdmissionController",
            "TokenBucket", "BatchingQueue", "Request", "next_bucket",
-           "EmbeddingCache", "rewrite_sparse_lookups"]
+           "EmbeddingCache", "rewrite_sparse_lookups",
+           "InvalidationPublisher", "InvalidationSubscriber",
+           "FleetDirectory", "FleetMember", "FleetRouter",
+           "SLO", "Autopilot", "NoLiveMembersError"]

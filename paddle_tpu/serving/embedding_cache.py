@@ -50,9 +50,36 @@ from typing import Callable, Dict
 
 import numpy as np
 
-from paddle_tpu.fluid import core
+from paddle_tpu.fluid import core, telemetry
 
 __all__ = ["EmbeddingCache"]
+
+
+# ------------------------------------------------------------------ metrics
+# Registry-native invalidation evidence (docs/SERVING.md "Fleet"): the
+# fleet acceptance numbers — rows invalidated by trainer pushes, fence
+# overflows collapsing to the generation fence, and the push→applied
+# staleness window — must be scrapeable at GET /metrics, not hand-probed
+# from stats() dicts. Families are fetched per use (get-or-create) so a
+# REGISTRY.reset() between tests can never leave dangling children.
+def _m_rows_invalidated():
+    return telemetry.REGISTRY.counter(
+        "serving_cache_rows_invalidated_total",
+        "embedding-cache rows dropped by trainer-push invalidations")
+
+
+def _m_fence_overflow():
+    return telemetry.REGISTRY.counter(
+        "serving_cache_fence_overflow_total",
+        "per-key fence maps collapsed to the generation fence")
+
+
+def _m_staleness_window():
+    return telemetry.REGISTRY.histogram(
+        "serving_cache_staleness_window_seconds",
+        "trainer push -> invalidation applied at a serving cache",
+        buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                 0.1, 0.25, 0.5, 1.0, 2.5, 5.0))
 
 # fetch failures the serve-stale path may absorb: the transport family
 # (breaker fast-fail CircuitOpenError ⊂ ConnectionError, deadline ⊂
@@ -104,6 +131,7 @@ class EmbeddingCache:
         self.evictions = 0
         self.stale_served = 0      # degraded: beyond-TTL rows served
         self.invalidated_rows = 0  # trainer-pushed row invalidations
+        self.fence_overflows = 0   # fence maps collapsed to generation
 
     def __len__(self) -> int:
         with self._lock:
@@ -194,6 +222,8 @@ class EmbeddingCache:
         them out of any in-flight miss fetch, so the next lookup
         refetches post-push values. Staleness becomes push-bounded."""
         ids = np.asarray(ids).reshape(-1)
+        dropped = 0
+        overflowed = False
         with self._lock:
             self._seq += 1
             for id_ in ids.tolist():
@@ -201,12 +231,27 @@ class EmbeddingCache:
                 self._fence[key] = self._seq
                 if self._rows.pop(key, None) is not None:
                     self.invalidated_rows += 1
+                    dropped += 1
             if len(self._fence) > self._FENCE_CAP:
                 # long-tail overflow: collapse to the global generation
                 # fence (no in-flight fill lands) instead of unbounded
                 # per-key state
                 self._fence.clear()
                 self._gen += 1
+                self.fence_overflows += 1
+                overflowed = True
+        if dropped:
+            _m_rows_invalidated().inc(dropped)
+        if overflowed:
+            _m_fence_overflow().inc()
+
+    def note_staleness(self, lag_s: float) -> None:
+        """Record one push→applied staleness-window sample (seconds) —
+        called by the fleet invalidation subscriber with the publisher's
+        stamp delta the moment it applies the event. Scrape
+        ``serving_cache_staleness_window_seconds`` for the freshness
+        acceptance number."""
+        _m_staleness_window().observe(max(0.0, float(lag_s)))
 
     def invalidate(self, table: str = None) -> None:
         """Drop every entry (or just one table's) — e.g. after a model/
@@ -237,5 +282,6 @@ class EmbeddingCache:
                 "evictions": self.evictions,
                 "stale_served": self.stale_served,
                 "invalidated_rows": self.invalidated_rows,
+                "fence_overflows": self.fence_overflows,
                 "hit_rate": (self.hits / total) if total else 0.0,
             }

@@ -724,7 +724,12 @@ class ServingEngine:
             t.join(timeout=30)
         if self._cache_installed:
             from paddle_tpu.fluid import ps_rpc
-            ps_rpc.install_row_cache(self._cache_prev)
+            # Restore only while OUR cache is the installed one. Engines
+            # closed out of install order (fleets cycle members freely)
+            # must not re-install a saved prev over a newer engine's
+            # cache — or worse, resurrect an already-closed one.
+            if ps_rpc.current_row_cache() is self.embedding_cache:
+                ps_rpc.install_row_cache(self._cache_prev)
             self._cache_installed = False
         for v in self._metrics_views:
             self._telemetry.REGISTRY.unregister_view(v)

@@ -1,0 +1,1009 @@
+"""Self-healing serving fleet (docs/SERVING.md "Fleet").
+
+One serving process (PRs 7/9/10) became N engines behaving as one
+service. Three legs, each a robustness contract with a typed-error
+budget of zero:
+
+  1. **Trainer→serving invalidation wire** — ``InvalidationPublisher``
+     (trainer side) + ``InvalidationSubscriber`` (serving side): a
+     pub/sub channel over the PR 4 binary wire (v3 ``_hello``
+     negotiation and the dedup plane for free, because both ends are
+     plain ``VarServer``/``VarClient``) fanning the PR 9 in-process
+     ``invalidate_rows`` hook contract cross-process. The grad-push
+     site (``_distributed_lookup_table_grad``) publishes the pushed row
+     ids through ``ps_rpc.install_invalidation_publisher``; every
+     remote ``EmbeddingCache`` applies them with the same per-key
+     stage-seq fences, so fleet-wide serving staleness is push-bounded.
+     The push→applied window is measured per event into the
+     registry-scraped ``serving_cache_staleness_window_seconds``
+     histogram. Events are idempotent row invalidations, so replays
+     (retry, dedup, resync) are safe by construction; a subscriber
+     outage degrades to TTL-bounded staleness — typed, counted, never
+     silent.
+
+  2. **Serving membership** — ``FleetDirectory`` + ``FleetMember`` +
+     ``FleetRouter``: engines join/drain as epoch-stamped
+     ``ClusterView`` participants (the PR 6 machinery, on a
+     fleet-scoped view separate from the PS slot view). A rolling
+     restart drains each member (directory first — the router stops
+     routing to it — then the PR 9 ingress drain finishes every
+     accepted request), so zero accepted requests are lost. A
+     SIGKILLed member is detected by heartbeat and evicted within
+     ~2×``heartbeat_timeout_s``; the router fails its in-flight
+     requests typed (connection reset → counted retry) and replays
+     them against a live replica.
+
+  3. **Chaos autopilot** — ``Autopilot``: a controller loop scraping
+     the PR 10 registry surface across the fleet (queue_rows, shed
+     rate, breaker states, p99) and calling ``spawn_fn``/``drain_fn``
+     to hold an ``SLO``. ``decide`` is a pure function (decision-table
+     tested); the chaos harness (``tools/chaos_ps.py --scenario
+     serving_fleet``) injects kills/restarts around it and asserts the
+     SLO held.
+
+1-core caveat: on the bench box every member time-slices one core, so
+fleet-vs-single QPS is trend-only; the acceptance evidence arm is
+per-member parity + the freshness/chaos contracts (docs/SERVING.md).
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.fluid import core, ps_membership, telemetry
+from paddle_tpu.fluid.ps_membership import ClusterView
+from paddle_tpu.fluid.ps_rpc import VarClient, VarServer
+
+__all__ = [
+    "InvalidationPublisher", "InvalidationSubscriber",
+    "FleetDirectory", "FleetMember", "FleetRouter",
+    "SLO", "Autopilot", "decide", "NoLiveMembersError",
+]
+
+_LOG = logging.getLogger("paddle_tpu.fleet")
+
+
+class NoLiveMembersError(ConnectionError):
+    """Every fleet member refused or dropped the request — the typed
+    "fleet dark" failure the router raises instead of a bare socket
+    error (callers map it to 503, never a silent hang)."""
+
+
+# ---------------------------------------------------------------------------
+# leg 1: trainer→serving invalidation wire
+# ---------------------------------------------------------------------------
+class InvalidationPublisher:
+    """Trainer-side end of the invalidation wire: a seq-stamped ring of
+    ``(table, ids)`` events that remote subscribers long-poll over the
+    PR 4 wire. ``publish`` is enqueue-only (the grad-push path must
+    never block on a slow serving box); ``inv_poll`` is the one wire
+    method — read-only and cursor-idempotent, so dedup replays and
+    transport retries are safe by construction.
+
+    Ring overflow is the bounded-staleness escape hatch: a subscriber
+    whose cursor fell off the ring is told to RESYNC (full cache
+    invalidate — conservative, never stale) instead of replaying an
+    unbounded backlog.
+    """
+
+    def __init__(self, endpoint: Optional[str] = None,
+                 ring_capacity: int = 4096):
+        if ring_capacity < 1:
+            raise ValueError("ring_capacity must be >= 1")
+        self._endpoint = endpoint
+        self._cap = int(ring_capacity)
+        self._cv = threading.Condition()
+        self._events: List[dict] = []   # oldest first
+        self._seq = 0                   # seq of the newest event
+        self._floor = 0                 # seq of the newest DROPPED event
+        self._server: Optional[VarServer] = None
+        self._owns_server = False
+        self.published_total = 0
+        self.dropped_total = 0
+        self._pollers: Dict[str, int] = {}   # subscriber -> last cursor
+        self._view_handle = None
+
+    # ------------------------------------------------------------- publish
+    def publish(self, table: str, ids) -> int:
+        """Enqueue one invalidation event; returns its seq. ``t_pub``
+        is wall-clock (time.time()) — subscribers difference it against
+        their own clock for the staleness-window histogram, so on one
+        box the number is exact and across boxes it carries the NTP
+        skew (the hello clock-offset estimate bounds it)."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        with self._cv:
+            self._seq += 1
+            self._events.append({
+                "seq": self._seq, "table": str(table),
+                "ids": ids.tolist(), "t_pub": time.time()})
+            self.published_total += 1
+            while len(self._events) > self._cap:
+                dropped = self._events.pop(0)
+                self._floor = dropped["seq"]
+                self.dropped_total += 1
+            self._cv.notify_all()
+            return self._seq
+
+    # ---------------------------------------------------------------- wire
+    def inv_poll(self, cursor: int = 0, wait_s: float = 0.0,
+                 subscriber: str = "", max_events: int = 512):
+        """Long-poll for events past ``cursor``. Returns
+        ``{"events": [...], "cursor": n}`` or, when ``cursor`` fell off
+        the ring, ``{"reset": True, "cursor": head}`` — the subscriber
+        must fully invalidate its cache and resume from ``head``."""
+        cursor = int(cursor)
+        deadline = time.monotonic() + max(0.0, float(wait_s))
+        with self._cv:
+            if subscriber:
+                self._pollers[str(subscriber)] = cursor
+            while self._seq <= cursor:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    break
+                self._cv.wait(rem)
+            if cursor < self._floor:
+                return {"reset": True, "cursor": self._seq,
+                        "t_floor": time.time()}
+            out = [e for e in self._events if e["seq"] > cursor]
+            out = out[:max(1, int(max_events))]
+            new_cursor = out[-1]["seq"] if out else cursor
+            return {"events": out, "cursor": new_cursor}
+
+    def handlers(self) -> Dict[str, Callable]:
+        """Wire handlers, attachable to an existing ``VarServer`` (a
+        pserver can host its own invalidation feed) or served by the
+        publisher's own server via ``start()``."""
+        return {"inv_poll": self.inv_poll}
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "InvalidationPublisher":
+        if self._endpoint is None:
+            raise ValueError("publisher has no endpoint to serve on "
+                             "(attach handlers() to a VarServer instead)")
+        self._server = VarServer(self._endpoint, self.handlers()).start()
+        self._owns_server = True
+        self._view_handle = telemetry.REGISTRY.register_view(
+            "fleet_pub", self.stats)
+        return self
+
+    def close(self) -> None:
+        with self._cv:
+            self._cv.notify_all()
+        if self._view_handle is not None:
+            telemetry.REGISTRY.unregister_view(self._view_handle)
+            self._view_handle = None
+        if self._owns_server and self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cv:
+            return {
+                "published_total": self.published_total,
+                "dropped_total": self.dropped_total,
+                "ring": len(self._events),
+                "seq": self._seq,
+                "floor": self._floor,
+                "subscribers": len(self._pollers),
+            }
+
+
+class InvalidationSubscriber:
+    """Serving-side end: a background thread long-polling a publisher
+    and applying events to the local ``EmbeddingCache`` via the same
+    ``invalidate_rows`` (per-key stage-seq fence) contract the
+    in-process hook uses — so the fence-vs-in-flight-fetch race is
+    closed identically cross-process.
+
+    Outage contract: when the publisher is unreachable the subscriber
+    counts the outage (``outages_total``), flips ``connected`` false
+    (both registry-scraped), and keeps retrying with backoff — the
+    cache's ``ttl_s`` still bounds staleness, so the degradation is
+    TTL-bounded and TYPED, never silent-unbounded. On reconnect after
+    a ring overflow the publisher orders a RESYNC (full invalidate):
+    bounded-conservative, counted in ``resyncs_total``.
+    """
+
+    def __init__(self, endpoint: str, cache, name: str = "",
+                 poll_wait_s: float = 1.0, retry_s: float = 0.2):
+        self._endpoint = str(endpoint)
+        self._cache = cache
+        self.name = name or f"sub@{endpoint}"
+        self._poll_wait_s = float(poll_wait_s)
+        self._retry_s = float(retry_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._client: Optional[VarClient] = None
+        self._lock = threading.Lock()
+        self._cursor = 0
+        self.connected = False
+        self.events_applied = 0
+        self.rows_applied = 0
+        self.resyncs = 0
+        self.outages = 0
+        self.last_error = ""
+        self.last_lag_s = 0.0
+        self._view_handle = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "InvalidationSubscriber":
+        self._thread = threading.Thread(
+            target=self._run, name=f"inv-sub-{self.name}", daemon=True)
+        self._view_handle = telemetry.REGISTRY.register_view(
+            "fleet_sub", self.stats, labels={"subscriber": self.name})
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._client is not None:
+            try:
+                self._client.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self._view_handle is not None:
+            telemetry.REGISTRY.unregister_view(self._view_handle)
+            self._view_handle = None
+
+    # ---------------------------------------------------------------- loop
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self._client is None:
+                    # resolve=False: the publisher endpoint is not a PS
+                    # slot; channels=1 keeps the long-poll serialized
+                    self._client = VarClient(
+                        self._endpoint, connect_timeout=5.0,
+                        channels=1, resolve=False)
+                resp = self._client.call(
+                    "inv_poll", cursor=self._cursor,
+                    wait_s=self._poll_wait_s, subscriber=self.name,
+                    _rpc_timeout=self._poll_wait_s + 10.0,
+                    _rpc_retries=0)
+                self._apply(resp)
+                with self._lock:
+                    if not self.connected:
+                        self.connected = True
+            except Exception as e:  # typed + counted, then retry
+                if self._stop.is_set():
+                    break
+                with self._lock:
+                    if self.connected or not self.last_error:
+                        self.outages += 1
+                    self.connected = False
+                    self.last_error = type(e).__name__
+                if self._client is not None:
+                    try:
+                        self._client.close()
+                    except OSError:
+                        pass
+                    self._client = None
+                self._stop.wait(self._retry_s)
+
+    def _apply(self, resp: dict) -> None:
+        now = time.time()
+        if resp.get("reset"):
+            # cursor fell off the publisher ring: conservative full
+            # invalidate — bounded staleness, never a silent gap
+            self._cache.invalidate()
+            with self._lock:
+                self.resyncs += 1
+                self._cursor = int(resp.get("cursor", self._cursor))
+            return
+        events = resp.get("events") or []
+        for ev in events:
+            self._cache.invalidate_rows(
+                ev["table"], np.asarray(ev["ids"], dtype=np.int64))
+            lag = now - float(ev.get("t_pub", now))
+            note = getattr(self._cache, "note_staleness", None)
+            if note is not None:
+                note(lag)
+            with self._lock:
+                self.events_applied += 1
+                self.rows_applied += len(ev["ids"])
+                self.last_lag_s = lag
+                self._cursor = max(self._cursor, int(ev["seq"]))
+        if not events:
+            with self._lock:
+                self._cursor = max(self._cursor,
+                                   int(resp.get("cursor", self._cursor)))
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "connected": int(self.connected),
+                "cursor": self._cursor,
+                "events_applied": self.events_applied,
+                "rows_applied": self.rows_applied,
+                "resyncs": self.resyncs,
+                "outages": self.outages,
+                "last_lag_s": self.last_lag_s,
+            }
+
+
+# ---------------------------------------------------------------------------
+# leg 2: serving membership
+# ---------------------------------------------------------------------------
+class FleetDirectory:
+    """Membership authority for the serving fleet: members join/beat/
+    drain/leave; silence past ~2×``heartbeat_timeout_s`` evicts. Every
+    membership change mints a NEW epoch-stamped ``ClusterView`` (slot
+    name = member name, primary = its HTTP endpoint) — the PR 6
+    monotonic-install contract, on a fleet-scoped view that never
+    touches the process-global PS slot view.
+
+    Runs in-process (call the methods directly) or as a wire service
+    (``start()`` serves ``fleet_join``/``fleet_beat``/``fleet_drain``/
+    ``fleet_leave``/``fleet_view`` on its own ``VarServer``). A beat
+    from an evicted or unknown member answers a typed
+    ``StaleClusterViewError`` carrying the current view — the member
+    knows it was evicted and rejoins fresh instead of serving under a
+    dead epoch.
+    """
+
+    def __init__(self, endpoint: Optional[str] = None,
+                 heartbeat_timeout_s: float = 2.0):
+        self._endpoint = endpoint
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self._lock = threading.Lock()
+        # name -> {"endpoint", "last_beat", "state"}
+        self._members: Dict[str, Dict[str, Any]] = {}
+        self._epoch = 0
+        self._view = ClusterView({}, epoch=0)
+        self._server: Optional[VarServer] = None
+        self._monitor: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.joins_total = 0
+        self.drains_total = 0
+        self.evictions_total = 0
+        self._view_handle = None
+
+    # ---------------------------------------------------------- view mint
+    def _mint_locked(self) -> None:
+        """Rebuild the view from live (non-draining) members; epoch
+        bumps monotonically on EVERY membership change."""
+        self._epoch += 1
+        slots = {name: {"primary": m["endpoint"], "replicas": []}
+                 for name, m in self._members.items()
+                 if m["state"] == ps_membership.ACTIVE}
+        self._view = ClusterView(slots, epoch=self._epoch)
+
+    def view(self) -> ClusterView:
+        with self._lock:
+            return self._view
+
+    # ---------------------------------------------------------------- wire
+    def fleet_join(self, name: str, endpoint: str) -> dict:
+        with self._lock:
+            self._members[str(name)] = {
+                "endpoint": str(endpoint),
+                "last_beat": time.monotonic(),
+                "state": ps_membership.ACTIVE}
+            self.joins_total += 1
+            self._mint_locked()
+            return self._view.to_dict()
+
+    def fleet_beat(self, name: str, epoch: int = 0) -> dict:
+        with self._lock:
+            m = self._members.get(str(name))
+            if m is None:
+                raise core.StaleClusterViewError(
+                    f"fleet member {name!r} is not in the view "
+                    f"(evicted or never joined) — rejoin required",
+                    view=self._view.to_dict())
+            m["last_beat"] = time.monotonic()
+            if int(epoch) < self._epoch:
+                return {"epoch": self._epoch,
+                        "view": self._view.to_dict()}
+            return {"epoch": self._epoch}
+
+    def fleet_drain(self, name: str) -> dict:
+        """Phase 1 of a graceful exit: the member leaves the ROUTABLE
+        view (routers stop sending new work) but stays a heartbeating
+        member while its ingress drains accepted requests."""
+        with self._lock:
+            m = self._members.get(str(name))
+            if m is None:
+                raise core.StaleClusterViewError(
+                    f"fleet member {name!r} unknown",
+                    view=self._view.to_dict())
+            if m["state"] != ps_membership.DRAINING:
+                m["state"] = ps_membership.DRAINING
+                self.drains_total += 1
+                self._mint_locked()
+            return self._view.to_dict()
+
+    def fleet_leave(self, name: str) -> dict:
+        with self._lock:
+            if self._members.pop(str(name), None) is not None:
+                self._mint_locked()
+            return self._view.to_dict()
+
+    def fleet_view(self) -> dict:
+        with self._lock:
+            return self._view.to_dict()
+
+    def handlers(self) -> Dict[str, Callable]:
+        return {"fleet_join": self.fleet_join,
+                "fleet_beat": self.fleet_beat,
+                "fleet_drain": self.fleet_drain,
+                "fleet_leave": self.fleet_leave,
+                "fleet_view": self.fleet_view}
+
+    # ------------------------------------------------------------- monitor
+    def check_eviction(self) -> List[str]:
+        """One monitor pass: evict members silent past 2×hb. Returns
+        the evicted names (the monitor thread calls this; tests drive
+        it directly for determinism)."""
+        now = time.monotonic()
+        bound = 2.0 * self.heartbeat_timeout_s
+        evicted = []
+        with self._lock:
+            for name, m in list(self._members.items()):
+                if now - m["last_beat"] > bound:
+                    del self._members[name]
+                    evicted.append(name)
+                    self.evictions_total += 1
+            if evicted:
+                self._mint_locked()
+        for name in evicted:
+            _LOG.warning("fleet: evicted silent member %s (>%gs)",
+                         name, bound)
+        return evicted
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_timeout_s / 2.0):
+            self.check_eviction()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "FleetDirectory":
+        if self._endpoint is not None:
+            self._server = VarServer(self._endpoint,
+                                     self.handlers()).start()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="fleet-dir-monitor",
+            daemon=True)
+        self._monitor.start()
+        self._view_handle = telemetry.REGISTRY.register_view(
+            "fleet_dir", self.stats)
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=10)
+        if self._view_handle is not None:
+            telemetry.REGISTRY.unregister_view(self._view_handle)
+            self._view_handle = None
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "members": len(self._members),
+                "routable": len(self._view.slots),
+                "epoch": self._epoch,
+                "joins_total": self.joins_total,
+                "drains_total": self.drains_total,
+                "evictions_total": self.evictions_total,
+            }
+
+
+class FleetMember:
+    """One serving process's membership agent: joins the directory,
+    heartbeats, and sequences the graceful exit — directory drain
+    FIRST (routers stop sending), then the PR 9 ingress drain (every
+    accepted request completes), then leave. A beat answered with
+    ``StaleClusterViewError`` means this member was evicted (e.g. a
+    long GC pause outlived 2×hb): it rejoins fresh and counts it.
+    """
+
+    def __init__(self, name: str, directory_ep: str, advertise_ep: str,
+                 ingress=None, beat_interval_s: float = 0.5):
+        self.name = str(name)
+        self._dir_ep = str(directory_ep)
+        self._advertise = str(advertise_ep)
+        self._ingress = ingress
+        self._interval = float(beat_interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._epoch = 0
+        self.rejoins = 0
+        self.beat_errors = 0
+        self.draining = False
+
+    def _cli(self) -> VarClient:
+        return VarClient(self._dir_ep, connect_timeout=5.0, channels=1,
+                         resolve=False)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "FleetMember":
+        cli = self._cli()
+        try:
+            view = cli.call("fleet_join", name=self.name,
+                            endpoint=self._advertise,
+                            _rpc_timeout=10.0)
+            with self._lock:
+                self._epoch = int(view.get("epoch", 0))
+        finally:
+            cli.close()
+        self._thread = threading.Thread(
+            target=self._beat_loop, name=f"fleet-beat-{self.name}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def _beat_loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            cli = None
+            try:
+                cli = self._cli()
+                resp = cli.call("fleet_beat", name=self.name,
+                                epoch=self._epoch, _rpc_timeout=5.0,
+                                _rpc_retries=0)
+                with self._lock:
+                    self._epoch = int(resp.get("epoch", self._epoch))
+            except core.StaleClusterViewError:
+                # evicted while alive (paused past 2×hb): rejoin fresh
+                # unless this member is deliberately on its way out
+                if self.draining or self._stop.is_set():
+                    break
+                try:
+                    view = cli.call("fleet_join", name=self.name,
+                                    endpoint=self._advertise,
+                                    _rpc_timeout=10.0)
+                    with self._lock:
+                        self._epoch = int(view.get("epoch", 0))
+                        self.rejoins += 1
+                except Exception:
+                    with self._lock:
+                        self.beat_errors += 1
+            except Exception:
+                with self._lock:
+                    self.beat_errors += 1
+            finally:
+                if cli is not None:
+                    cli.close()
+
+    def drain(self) -> None:
+        """The rolling-restart exit: unroutable first, then drain the
+        ingress to empty (zero lost accepted requests), then leave."""
+        self.draining = True
+        cli = self._cli()
+        try:
+            cli.call("fleet_drain", name=self.name, _rpc_timeout=10.0)
+        except Exception:
+            pass  # directory gone: the ingress drain still holds
+        finally:
+            cli.close()
+        if self._ingress is not None:
+            self._ingress.drain()
+        self.leave()
+
+    def leave(self) -> None:
+        self._stop.set()
+        cli = self._cli()
+        try:
+            cli.call("fleet_leave", name=self.name, _rpc_timeout=10.0)
+        except Exception:
+            pass
+        finally:
+            cli.close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def close(self) -> None:
+        if not self._stop.is_set():
+            self.leave()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"epoch": self._epoch, "rejoins": self.rejoins,
+                    "beat_errors": self.beat_errors,
+                    "draining": int(self.draining)}
+
+
+class FleetRouter:
+    """Client-side front router: holds a monotonically-installed fleet
+    view and spreads HTTP requests round-robin over routable members.
+    A 503 (member draining — its directory exit may not have reached
+    us yet) or a transport drop (SIGKILLed member) fails TYPED, is
+    counted per endpoint, triggers a view refresh, and the request is
+    retried against the next live member — an accepted request is only
+    lost if EVERY member refuses it, which surfaces as the typed
+    ``NoLiveMembersError`` (the zero-lost-accepted contract's honest
+    boundary).
+
+    Also usable endpoint-pinned (``endpoints=[...]`` without a
+    directory) — the shape ``tools/serving_loadgen.py`` builds its
+    multi-endpoint loops on.
+    """
+
+    def __init__(self, directory_ep: Optional[str] = None,
+                 endpoints: Optional[Sequence[str]] = None,
+                 timeout_s: float = 30.0, max_attempts: Optional[int] = None):
+        if directory_ep is None and not endpoints:
+            raise ValueError("need a directory endpoint or a static "
+                             "endpoint list")
+        self._dir_ep = directory_ep
+        self._timeout_s = float(timeout_s)
+        self._max_attempts = max_attempts
+        self._lock = threading.Lock()
+        self._view = ClusterView({}, epoch=0)
+        self._static = [str(e) for e in (endpoints or [])]
+        self._rr = 0
+        self._conns: Dict[str, http.client.HTTPConnection] = {}
+        # per-endpoint breakdown: ep -> {"ok": n, "retries": n, ...}
+        self.by_endpoint: Dict[str, Dict[str, int]] = {}
+        self.reroutes = 0
+        if directory_ep is not None:
+            self.refresh()
+
+    # ----------------------------------------------------------- membership
+    def install_view(self, view: ClusterView) -> bool:
+        """Monotonic install (the PR 6 rule): an older epoch can never
+        overwrite a newer one — a late fleet_view response racing an
+        eviction must not resurrect the dead member."""
+        with self._lock:
+            if view.epoch < self._view.epoch:
+                return False
+            self._view = view
+            return True
+
+    def refresh(self) -> ClusterView:
+        if self._dir_ep is None:
+            return self._view
+        cli = VarClient(self._dir_ep, connect_timeout=5.0, channels=1,
+                        resolve=False)
+        try:
+            d = cli.call("fleet_view", _rpc_timeout=5.0)
+            view = ClusterView.from_dict(d)
+            self.install_view(view)
+            return view
+        finally:
+            cli.close()
+
+    def endpoints(self) -> List[str]:
+        with self._lock:
+            eps = self._view.endpoints()
+            return eps if eps else list(self._static)
+
+    # ---------------------------------------------------------------- http
+    def _bump(self, ep: str, key: str, n: int = 1) -> None:
+        with self._lock:
+            d = self.by_endpoint.setdefault(ep, {})
+            d[key] = d.get(key, 0) + n
+
+    def _request(self, ep: str, method: str, path: str, body, headers):
+        host, port = ep.rsplit(":", 1)
+        with self._lock:
+            conn = self._conns.pop(ep, None)
+        if conn is None:
+            conn = http.client.HTTPConnection(host, int(port),
+                                              timeout=self._timeout_s)
+        try:
+            conn.request(method, path, body=body, headers=headers or {})
+            r = conn.getresponse()
+            data = r.read()
+        except (http.client.HTTPException, OSError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise
+        if r.will_close:
+            conn.close()
+        else:
+            with self._lock:
+                old = self._conns.pop(ep, None)
+                self._conns[ep] = conn
+            if old is not None and old is not conn:
+                try:
+                    old.close()
+                except OSError:
+                    pass
+        try:
+            obj = json.loads(data) if data else {}
+        except ValueError:
+            obj = {"raw": data.decode("utf-8", "replace")}
+        return r.status, obj
+
+    def request(self, method: str, path: str, body=None, headers=None):
+        """One routed request: round-robin start, retry across members
+        on 503/transport-drop (counted per endpoint + ``reroutes``).
+        Non-retriable statuses (200, 429, 504, 400...) return as-is —
+        shedding is a RESULT, not a routing failure."""
+        eps = self.endpoints()
+        if not eps:
+            self.refresh()
+            eps = self.endpoints()
+        if not eps:
+            raise NoLiveMembersError("fleet view has no routable members")
+        attempts = (self._max_attempts if self._max_attempts is not None
+                    else len(eps) + 1)
+        with self._lock:
+            start = self._rr
+            self._rr += 1
+        last_err: Optional[BaseException] = None
+        for i in range(attempts):
+            ep = eps[(start + i) % len(eps)]
+            t0 = time.perf_counter()
+            try:
+                status, obj = self._request(ep, method, path, body,
+                                            headers)
+            except (http.client.HTTPException, OSError) as e:
+                self._bump(ep, "transport")
+                last_err = e
+            else:
+                self._bump(ep, str(status) if status != 200 else "ok")
+                if status != 503:
+                    self._bump_lat(ep, time.perf_counter() - t0)
+                    return status, obj, ep
+                last_err = None
+            # 503/drop: this member is draining or dead — refresh the
+            # view (the directory may have already evicted it) and
+            # re-route to the next member
+            with self._lock:
+                self.reroutes += 1
+            try:
+                self.refresh()
+            except Exception:
+                pass
+            new_eps = self.endpoints()
+            if new_eps:
+                eps = new_eps
+        raise NoLiveMembersError(
+            f"every fleet member refused {method} {path} "
+            f"after {attempts} attempts"
+            + (f" (last: {last_err!r})" if last_err else ""))
+
+    def _bump_lat(self, ep: str, lat_s: float) -> None:
+        with self._lock:
+            d = self.by_endpoint.setdefault(ep, {})
+            d["lat_sum_ms"] = d.get("lat_sum_ms", 0.0) + lat_s * 1e3
+            d["lat_n"] = d.get("lat_n", 0) + 1
+
+    def predict(self, feed: dict, model: Optional[str] = None,
+                deadline_ms: Optional[float] = None, many: bool = False):
+        path = ("/predict" if model is None
+                else f"/models/{model}/predict")
+        body = json.dumps({
+            "feed": {k: np.asarray(v).tolist() for k, v in feed.items()},
+            "many": many})
+        headers = {"Content-Type": "application/json"}
+        if deadline_ms is not None:
+            headers["X-Deadline-Ms"] = str(float(deadline_ms))
+        status, obj, ep = self.request("POST", path, body, headers)
+        return status, obj
+
+    def close(self) -> None:
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"epoch": self._view.epoch,
+                    "members": len(self._view.slots) or len(self._static),
+                    "reroutes": self.reroutes,
+                    "by_endpoint": {
+                        ep: dict(d)
+                        for ep, d in self.by_endpoint.items()}}
+
+
+# ---------------------------------------------------------------------------
+# leg 3: SLO autopilot
+# ---------------------------------------------------------------------------
+class SLO:
+    """The service-level objective the autopilot holds: accepted-p99
+    under ``p99_ms``, shed rate under ``max_shed_rate``, fleet queue
+    depth under ``max_queue_rows``; member count in
+    [min_members, max_members]."""
+
+    def __init__(self, p99_ms: float = 500.0, max_shed_rate: float = 0.05,
+                 max_queue_rows: int = 64, min_members: int = 1,
+                 max_members: int = 8):
+        self.p99_ms = float(p99_ms)
+        self.max_shed_rate = float(max_shed_rate)
+        self.max_queue_rows = int(max_queue_rows)
+        self.min_members = int(min_members)
+        self.max_members = int(max_members)
+
+
+def decide(snap: Dict[str, float], slo: SLO) -> str:
+    """The scale decision as a PURE function of one aggregated scrape —
+    decision-table tested, no clock, no side effects.
+
+    ``snap``: members, p99_ms, shed_rate, queue_rows, breakers_open.
+    Returns "up", "down", or "hold".
+
+    Up wins over down (a breached SLO scales even if some signal looks
+    idle); a breached SLO at max_members holds — the autopilot reports
+    the breach instead of flapping.
+    """
+    members = int(snap.get("members", 0))
+    breach = (snap.get("p99_ms", 0.0) > slo.p99_ms
+              or snap.get("shed_rate", 0.0) > slo.max_shed_rate
+              or snap.get("queue_rows", 0.0) > slo.max_queue_rows
+              or snap.get("breakers_open", 0.0) > 0)
+    if members < slo.min_members:
+        return "up"
+    if breach:
+        return "up" if members < slo.max_members else "hold"
+    idle = (snap.get("p99_ms", 0.0) < 0.5 * slo.p99_ms
+            and snap.get("shed_rate", 0.0) == 0.0
+            and snap.get("queue_rows", 0.0)
+            <= 0.25 * slo.max_queue_rows)
+    if idle and members > slo.min_members:
+        return "down"
+    return "hold"
+
+
+def scrape_http_member(endpoint: str, timeout_s: float = 5.0
+                       ) -> Dict[str, float]:
+    """Scrape one member's PR 10 stats surface (GET /stats) into the
+    autopilot's snapshot shape. Raises on transport failure — the
+    autopilot counts that member dark (its share of the fleet is the
+    breach signal, not a silent hole)."""
+    host, port = str(endpoint).rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port),
+                                      timeout=timeout_s)
+    try:
+        conn.request("GET", "/stats")
+        r = conn.getresponse()
+        obj = json.loads(r.read() or b"{}")
+    finally:
+        conn.close()
+    agg = {"p99_ms": 0.0, "shed": 0.0, "requests": 0.0,
+           "queue_rows": 0.0, "breakers_open": 0.0}
+    for eng in (obj.get("models") or {}).values():
+        agg["p99_ms"] = max(agg["p99_ms"],
+                            float(eng.get("latency_ms", {}).get("p99", 0)
+                                  or 0))
+        agg["shed"] += float(eng.get("shed", 0) or 0)
+        agg["requests"] += float(eng.get("requests", 0) or 0)
+        agg["queue_rows"] += float(eng.get("queue_rows", 0) or 0)
+        agg["breakers_open"] += float(eng.get("breaker_open", 0) or 0)
+    return agg
+
+
+class Autopilot:
+    """The SLO-holding controller loop: each tick scrapes every member
+    (``scrape_fn`` → list of per-member snapshots, dark members as
+    None), aggregates, runs ``decide``, and calls ``spawn_fn()`` /
+    ``drain_fn()`` under a cooldown (no flapping). Shed RATE is
+    windowed from the cumulative counters between ticks. Chaos mode is
+    external (tools/chaos_ps.py kills members around a running
+    autopilot); ``history`` + ``snapshot()`` are the assertion surface
+    — the chaos harness checks the SLO held and the autopilot healed
+    the fleet back to target."""
+
+    def __init__(self, scrape_fn: Callable[[], List[Optional[dict]]],
+                 slo: SLO, spawn_fn: Callable[[], Any],
+                 drain_fn: Callable[[], Any],
+                 interval_s: float = 1.0, cooldown_s: float = 3.0):
+        self._scrape = scrape_fn
+        self.slo = slo
+        self._spawn = spawn_fn
+        self._drain = drain_fn
+        self._interval = float(interval_s)
+        self._cooldown = float(cooldown_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._last_action_t = 0.0
+        self._prev_counters: Dict[str, float] = {}
+        self.history: List[dict] = []   # [{t, snap, decision, acted}]
+        self.breaches = 0
+        self.dark_scrapes = 0
+        self._view_handle = None
+
+    # ------------------------------------------------------------ one tick
+    def tick(self) -> dict:
+        """One scrape→aggregate→decide→act pass (the loop calls this;
+        tests drive it directly for determinism)."""
+        per_member = self._scrape()
+        live = [m for m in per_member if m is not None]
+        dark = len(per_member) - len(live)
+        if dark:
+            with self._lock:
+                self.dark_scrapes += dark
+        shed = sum(float(m.get("shed", 0)) for m in live)
+        req = sum(float(m.get("requests", 0)) for m in live)
+        d_shed = shed - self._prev_counters.get("shed", 0.0)
+        d_req = req - self._prev_counters.get("requests", 0.0)
+        self._prev_counters = {"shed": shed, "requests": req}
+        snap = {
+            "members": len(live),
+            "dark": dark,
+            "p99_ms": max([float(m.get("p99_ms", 0)) for m in live],
+                          default=0.0),
+            "queue_rows": sum(float(m.get("queue_rows", 0))
+                              for m in live),
+            "breakers_open": sum(float(m.get("breakers_open", 0))
+                                 for m in live),
+            # windowed rate over the tick, from cumulative counters; a
+            # counter reset (member restart) clamps at 0, never negative
+            "shed_rate": (max(0.0, d_shed) / max(1.0, max(0.0, d_req))
+                          if d_req > 0 else (1.0 if d_shed > 0 else 0.0)),
+        }
+        decision = decide(snap, self.slo)
+        now = time.monotonic()
+        acted = False
+        if decision != "hold" \
+                and now - self._last_action_t >= self._cooldown:
+            try:
+                (self._spawn if decision == "up" else self._drain)()
+                acted = True
+                self._last_action_t = now
+            except Exception:
+                _LOG.exception("autopilot %s action failed", decision)
+        breach = (snap["p99_ms"] > self.slo.p99_ms
+                  or snap["shed_rate"] > self.slo.max_shed_rate
+                  or snap["breakers_open"] > 0)
+        with self._lock:
+            if breach:
+                self.breaches += 1
+            self.history.append({"t": time.time(), "snap": snap,
+                                 "decision": decision, "acted": acted})
+            if len(self.history) > 1024:
+                del self.history[:512]
+        return {"snap": snap, "decision": decision, "acted": acted}
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.tick()
+            except Exception:
+                _LOG.exception("autopilot tick failed")
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "Autopilot":
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-autopilot", daemon=True)
+        self._thread.start()
+        self._view_handle = telemetry.REGISTRY.register_view(
+            "fleet_autopilot", self.stats)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self._view_handle is not None:
+            telemetry.REGISTRY.unregister_view(self._view_handle)
+            self._view_handle = None
+
+    def snapshot(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self.history[-1]) if self.history else None
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            last = self.history[-1] if self.history else None
+            return {
+                "ticks": len(self.history),
+                "breaches": self.breaches,
+                "dark_scrapes": self.dark_scrapes,
+                "last_members": (last["snap"]["members"] if last else 0),
+                "last_p99_ms": (last["snap"]["p99_ms"] if last else 0.0),
+                "last_decision": (
+                    {"hold": 0, "up": 1, "down": -1}[last["decision"]]
+                    if last else 0),
+            }

@@ -96,6 +96,17 @@ def pytest_configure(config):
         "every Program the whole suite builds (conftest "
         "_verify_programs fixture + tests/verify_allowlist.py).")
     config.addinivalue_line(
+        "markers", "fleet: self-healing serving-fleet suite "
+        "(serving/fleet.py — trainer→serving invalidation pub/sub over "
+        "the binary wire, epoch-stamped fleet membership with heartbeat "
+        "eviction and zero-lost rolling drain, SLO autopilot; "
+        "tests/test_fleet.py). In-process protocol/unit tests (thread-"
+        "harness publishers/directories) stay in the tier-1 non-slow "
+        "set; the multiprocess chaos acceptance (tools/chaos_ps.py "
+        "--scenario serving_fleet) also carries 'slow'. Subprocesses "
+        "run JAX_PLATFORMS=cpu, so PADDLE_TPU_TEST_SHARD file-level "
+        "sharding applies unchanged.")
+    config.addinivalue_line(
         "markers", "parallel3d: composed 3D-parallel lane suite "
         "(parallel/lm3d.py dp×pp×sp+MoE on the virtual 8-device mesh, "
         "gpipe/MoE composition units, executor window×pipeline "

@@ -526,6 +526,10 @@ def test_async_staleness0_bit_identical_to_sync_oracle_wide_deep(
 
 
 @pytest.mark.faults
+# r19 fleet-PR buyback (~9s convergence-under-delay): the staleness
+# bound + overlap-span units stay per-commit; the multiprocess
+# staleness-0 golden acceptance is already slow (PR 13).
+@pytest.mark.slow
 def test_async_staleness_converges_under_injected_rpc_delay(tmp_path):
     """Staleness=k smoke: with every data-plane RPC slowed 15ms
     server-side (faultinject.rpc_delay), a staleness=3 linear cluster

@@ -112,6 +112,10 @@ def test_ps_geo_sgd_converges(tmp_path):
     assert losses[-1] < losses[0] * 0.2, losses
 
 
+# r19 fleet-PR buyback (~7s): test_ps_geo_sgd_converges +
+# test_ps_geo_sgd_sparse_embedding keep geo-SGD per-commit; the
+# two-trainer merge contract re-proves in the full tier.
+@pytest.mark.slow
 def test_ps_geo_sgd_two_trainers(tmp_path):
     l0, l1 = run_cluster(2, 40, str(tmp_path), geo=True)
     assert l0[-1] < l0[0] * 0.5, l0
@@ -275,6 +279,10 @@ def test_trainer_failure_detection(tmp_path):
             log.close()
 
 
+# r19 fleet-PR buyback (~6s scale smoke): lazy-table mechanics stay
+# per-commit via test_ps_lazy_table_eviction_bound + the capacity
+# suite (test_ps_capacity).
+@pytest.mark.slow
 def test_ps_billion_param_lazy_sparse_table(tmp_path):
     """Beyond-HBM sparse scale (reference fleet_wrapper.h:86-190): a
     [62.5M, 16] = 1e9-float logical embedding (4GB dense) row-sharded
@@ -318,6 +326,9 @@ def test_ps_geo_sgd_sparse_embedding(tmp_path):
     assert losses[-1] < losses[0] * 0.3, losses
 
 
+# r19 fleet-PR buyback (~7s): same rationale as the dense
+# two-trainer variant above.
+@pytest.mark.slow
 def test_ps_geo_sgd_sparse_two_trainers(tmp_path):
     l0, l1 = run_cluster(2, 40, str(tmp_path), sparse=True, geo=True)
     assert l0[-1] < l0[0] * 0.6, l0

@@ -122,6 +122,10 @@ def test_gan_style_alternating_optimizers():
         assert np.mean(fake) > 0.5, np.mean(fake)
 
 
+# r19 fleet-PR buyback (~5s): the PR 14 dygraph-GAN precedent —
+# dygraph training coverage stays via the remaining per-commit
+# dygraph tests; RL smoke re-runs in the full tier.
+@pytest.mark.slow
 def test_reinforce_policy_gradient():
     """REINFORCE on a contextual bandit: -log pi(a|s) * advantage backward
     through softmax (reference test_imperative_reinforcement.py shape)."""

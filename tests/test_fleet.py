@@ -1,0 +1,591 @@
+"""Self-healing serving-fleet suite (serving/fleet.py — the `fleet`
+marker; docs/SERVING.md "Fleet").
+
+Tier-1 non-slow: in-process protocol units over REAL wire servers on
+loopback — invalidation pub/sub (freshness, fence-vs-push race, ring
+overflow resync, outage degradation), directory membership (join/beat/
+evict/stale-beat, monotonic router installs), the zero-lost rolling
+drain over two live ingresses, and the autopilot decision table +
+cooldown/heal loop. The multiprocess acceptance (rolling restart + one
+SIGKILL under open-loop load, tools/chaos_ps.py --scenario
+serving_fleet) also carries `slow`; its cheap tier-1 twin here drives
+the same drain/kill mechanics with thread-harness members.
+"""
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from paddle_tpu.fluid import core, telemetry
+from paddle_tpu.fluid.ps_membership import ClusterView
+from paddle_tpu.serving import (Autopilot, EmbeddingCache, FleetDirectory,
+                                FleetMember, FleetRouter,
+                                InvalidationPublisher,
+                                InvalidationSubscriber, NoLiveMembersError,
+                                ServingEngine, ServingIngress, SLO)
+from paddle_tpu.serving.fleet import decide
+
+pytestmark = [pytest.mark.fleet, pytest.mark.serving]
+
+
+@pytest.fixture(autouse=True)
+def _isolate_process_hooks():
+    """The row-cache / invalidation-publisher hooks are process-global
+    (ps_rpc) and fleet tests cycle engines in arbitrary close order —
+    an engine closed out of install order deliberately leaves the newer
+    cache installed (engine.close), so clear both hooks uncondition-
+    ally after every test or a dead member's cache answers the next
+    test file's lookups."""
+    from paddle_tpu.fluid import ps_rpc
+    yield
+    ps_rpc.install_row_cache(None)
+    ps_rpc.install_invalidation_publisher(None)
+
+
+def free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _ep():
+    return f"127.0.0.1:{free_port()}"
+
+
+def _wait(cond, timeout=10.0, what="condition"):
+    end = time.time() + timeout
+    while time.time() < end:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise TimeoutError(f"{what} not reached within {timeout}s")
+
+
+def _fetch_rows(table):
+    def fetch(ids):
+        return table[np.asarray(ids, np.int64)].copy()
+    return fetch
+
+
+# ---------------------------------------------------------------------------
+# leg 1: invalidation wire
+# ---------------------------------------------------------------------------
+class TestInvalidationWire:
+    def test_push_visible_and_staleness_measured(self):
+        """A publish lands in the remote cache (rows dropped, next
+        lookup refetches) and the push→applied window is recorded in
+        the registry histogram — the freshness acceptance surface."""
+        table = np.arange(40, dtype=np.float32).reshape(10, 4)
+        pub = InvalidationPublisher(_ep()).start()
+        cache = EmbeddingCache(ttl_s=60.0)
+        sub = InvalidationSubscriber(pub._endpoint, cache, name="t0",
+                                     poll_wait_s=0.2).start()
+        try:
+            cache.lookup("w", [1, 2, 3], _fetch_rows(table))
+            assert len(cache) == 3
+            table[2] += 100.0
+            pub.publish("w", [2])
+            _wait(lambda: sub.stats()["events_applied"] >= 1,
+                  what="invalidation applied")
+            assert len(cache) == 2
+            out = cache.lookup("w", [2], _fetch_rows(table))
+            np.testing.assert_allclose(out[0], table[2])
+            st = sub.stats()
+            assert st["rows_applied"] == 1
+            assert 0.0 <= st["last_lag_s"] < 5.0
+            fams = telemetry.REGISTRY.collect()
+            cnt = fams["serving_cache_staleness_window_seconds_count"]
+            assert cnt["samples"][0][1] >= 1
+            ctr = fams["serving_cache_rows_invalidated_total"]
+            assert ctr["samples"][0][1] >= 1
+        finally:
+            sub.stop()
+            pub.close()
+
+    def test_fence_races_inflight_fetch_through_subscriber(self):
+        """The PrefetchBuffer race, cross-process: a miss fetch in
+        flight ACROSS a remote push must not re-fill pre-push rows.
+        The fetch blocks, the subscriber applies the invalidation
+        mid-flight, and the stale fetched copy must not be cached."""
+        table = np.zeros((4, 2), np.float32)
+        pub = InvalidationPublisher(_ep()).start()
+        cache = EmbeddingCache(ttl_s=60.0)
+        sub = InvalidationSubscriber(pub._endpoint, cache, name="race",
+                                     poll_wait_s=0.2).start()
+        in_fetch = threading.Event()
+        release = threading.Event()
+
+        def slow_fetch(ids):
+            in_fetch.set()
+            assert release.wait(10)
+            return table[np.asarray(ids, np.int64)].copy()  # PRE-push
+
+        try:
+            t = threading.Thread(
+                target=lambda: cache.lookup("w", [0], slow_fetch),
+                daemon=True)
+            t.start()
+            assert in_fetch.wait(10)
+            pub.publish("w", [0])          # push lands mid-fetch
+            _wait(lambda: sub.stats()["events_applied"] >= 1,
+                  what="mid-flight invalidation")
+            table[0] += 7.0                # the post-push truth
+            release.set()
+            t.join(10)
+            # the stale copy must NOT have been cached: a fresh lookup
+            # refetches and sees the post-push value
+            out = cache.lookup("w", [0], _fetch_rows(table))
+            np.testing.assert_allclose(out[0], table[0])
+        finally:
+            sub.stop()
+            pub.close()
+
+    def test_ring_overflow_forces_conservative_resync(self):
+        """A subscriber whose cursor fell off the bounded ring gets
+        RESET: full cache invalidate (bounded-conservative staleness),
+        counted — never a silent event gap."""
+        table = np.ones((64, 2), np.float32)
+        pub = InvalidationPublisher(_ep(), ring_capacity=4).start()
+        cache = EmbeddingCache(ttl_s=60.0)
+        cache.lookup("w", [50, 51], _fetch_rows(table))
+        # overflow the ring BEFORE the subscriber's first poll
+        for i in range(10):
+            pub.publish("w", [i])
+        sub = InvalidationSubscriber(pub._endpoint, cache, name="re",
+                                     poll_wait_s=0.2).start()
+        try:
+            _wait(lambda: sub.stats()["resyncs"] >= 1, what="resync")
+            assert len(cache) == 0          # full invalidate
+            assert pub.stats()["dropped_total"] >= 6
+            # and the feed continues normally past the reset
+            pub.publish("w", [50])
+            _wait(lambda: sub.stats()["events_applied"] >= 1,
+                  what="post-resync event")
+        finally:
+            sub.stop()
+            pub.close()
+
+    def test_outage_is_typed_counted_never_silent(self):
+        """Publisher death flips the subscriber to a counted, typed
+        disconnected state (TTL still bounds staleness); a replacement
+        publisher at the same endpoint is picked up by the retry loop
+        with a resync (fresh ring ⇒ cursor reset ⇒ full invalidate) —
+        replay-safe because invalidations are idempotent."""
+        ep = _ep()
+        pub = InvalidationPublisher(ep).start()
+        cache = EmbeddingCache(ttl_s=60.0)
+        sub = InvalidationSubscriber(ep, cache, name="out",
+                                     poll_wait_s=0.2, retry_s=0.05)
+        sub.start()
+        try:
+            pub.publish("w", [1])
+            _wait(lambda: sub.stats()["events_applied"] >= 1,
+                  what="first event")
+            pub.close()
+            _wait(lambda: not sub.stats()["connected"], what="outage")
+            st = sub.stats()
+            assert st["outages"] >= 1 and sub.last_error
+            pub2 = InvalidationPublisher(ep).start()
+            try:
+                pub2.publish("w", [2])
+                _wait(lambda: sub.stats()["connected"], timeout=15,
+                      what="reconnect")
+            finally:
+                pub2.close()
+        finally:
+            sub.stop()
+
+    def test_publish_is_enqueue_only(self):
+        """No subscriber at all: publish must not block (the grad-push
+        site calls it inline)."""
+        pub = InvalidationPublisher(ring_capacity=8)
+        t0 = time.perf_counter()
+        for i in range(100):
+            pub.publish("w", [i])
+        assert time.perf_counter() - t0 < 1.0
+        st = pub.stats()
+        assert st["published_total"] == 100
+        assert st["ring"] == 8 and st["dropped_total"] == 92
+
+
+# ---------------------------------------------------------------------------
+# leg 2: membership
+# ---------------------------------------------------------------------------
+class TestFleetMembership:
+    def test_join_beat_evict_and_stale_beat(self):
+        d = FleetDirectory(heartbeat_timeout_s=0.2)
+        v0 = ClusterView.from_dict(d.fleet_join("a", "127.0.0.1:9001"))
+        assert v0.endpoints() == ["127.0.0.1:9001"]
+        d.fleet_join("b", "127.0.0.1:9002")
+        assert len(d.view().endpoints()) == 2
+        assert d.view().epoch > v0.epoch
+        # beat keeps a member alive; silence evicts at 2xhb
+        end = time.time() + 0.7
+        while time.time() < end:
+            d.fleet_beat("a")
+            time.sleep(0.05)
+        evicted = d.check_eviction()
+        assert evicted == ["b"]
+        assert d.view().endpoints() == ["127.0.0.1:9001"]
+        # the evicted member's next beat is answered TYPED with the
+        # current view — it must rejoin, not keep serving a dead epoch
+        with pytest.raises(core.StaleClusterViewError) as ei:
+            d.fleet_beat("b")
+        assert ei.value.view_dict["epoch"] == d.view().epoch
+        assert d.stats()["evictions_total"] == 1
+
+    def test_drain_leaves_routable_view_keeps_membership(self):
+        d = FleetDirectory(heartbeat_timeout_s=5.0)
+        d.fleet_join("a", "127.0.0.1:9001")
+        d.fleet_join("b", "127.0.0.1:9002")
+        e0 = d.view().epoch
+        d.fleet_drain("a")
+        v = d.view()
+        assert v.endpoints() == ["127.0.0.1:9002"]
+        assert v.epoch > e0
+        # draining member still beats (its ingress is finishing work)
+        assert d.fleet_beat("a")["epoch"] == v.epoch
+        d.fleet_leave("a")
+        assert d.stats()["members"] == 1
+
+    def test_member_agent_over_wire_rejoins_after_eviction(self):
+        """A live FleetMember whose beats stall past 2×hb (GC pause)
+        is evicted; its next beat sees StaleClusterViewError and the
+        agent rejoins automatically, counted."""
+        dir_ep = _ep()
+        d = FleetDirectory(dir_ep, heartbeat_timeout_s=0.3).start()
+        m = FleetMember("m", dir_ep, "127.0.0.1:9009",
+                        beat_interval_s=0.1).start()
+        try:
+            _wait(lambda: d.view().endpoints() == ["127.0.0.1:9009"],
+                  what="join")
+            # simulate the pause: directory forgets the member
+            d.fleet_leave("m")
+            assert d.view().endpoints() == []
+            _wait(lambda: m.stats()["rejoins"] >= 1, what="rejoin")
+            assert d.view().endpoints() == ["127.0.0.1:9009"]
+        finally:
+            m.close()
+            d.close()
+
+    def test_router_monotonic_install(self):
+        r = FleetRouter(endpoints=["127.0.0.1:9001"])
+        new = ClusterView({"a": {"primary": "127.0.0.1:9005"}}, epoch=5)
+        assert r.install_view(new)
+        # a LATE response carrying an older epoch must not resurrect
+        # the member it still lists
+        old = ClusterView({"a": {"primary": "127.0.0.1:9005"},
+                           "dead": {"primary": "127.0.0.1:9006"}},
+                          epoch=4)
+        assert not r.install_view(old)
+        assert r.endpoints() == ["127.0.0.1:9005"]
+
+    def test_router_all_dark_is_typed(self):
+        r = FleetRouter(endpoints=[f"127.0.0.1:{free_port()}"],
+                        timeout_s=2.0, max_attempts=2)
+        with pytest.raises(NoLiveMembersError):
+            r.request("GET", "/healthz")
+        assert r.stats()["by_endpoint"]  # the failure is per-ep counted
+
+
+# ---------------------------------------------------------------------------
+# leg 2 acceptance twin (in-process): rolling drain loses nothing
+# ---------------------------------------------------------------------------
+def _mini_member(name, dir_ep, table, pub_ep=None):
+    """One in-process fleet member: value-reflective engine (out =
+    sum of the embedding row) behind a real ingress — the thread-
+    harness twin of chaos_ps.py's serving-member subprocess."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.serving import rewrite_sparse_lookups
+    from paddle_tpu.fluid.ps_rpc import VarServer
+
+    n_rows, dim = table.shape
+    table_ep = _ep()
+    srv = VarServer(table_ep, {
+        "prefetch_rows": lambda name, rows, prefetch=False, trainer_id=0:
+            table[np.asarray(rows, np.int64)].copy()}).start()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[n_rows, dim],
+                                     param_attr=f"emb_{name}",
+                                     is_distributed=True)
+        out = fluid.layers.reduce_sum(
+            fluid.layers.reshape(emb, [-1, dim]), dim=1)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    ps_prog, _ = rewrite_sparse_lookups(main, [table_ep],
+                                        tables=[f"emb_{name}"])
+    cache = EmbeddingCache(ttl_s=60.0)
+    eng = ServingEngine(program=ps_prog, scope=scope, feed_names=["ids"],
+                        fetch_names=[out], max_batch=4,
+                        max_queue_delay_ms=0.5, num_workers=1,
+                        embedding_cache=cache)
+    ing = ServingIngress({"fleet": eng}).start()
+    mem = FleetMember(name, dir_ep, f"127.0.0.1:{ing.port}",
+                      ingress=ing, beat_interval_s=0.1).start()
+    sub = None
+    if pub_ep is not None:
+        sub = InvalidationSubscriber(pub_ep, cache, name=name,
+                                     poll_wait_s=0.2).start()
+    closers = [x for x in (sub and sub.stop, mem.close, ing.close,
+                           eng.close, srv.shutdown) if x]
+
+    def close():
+        for c in closers:
+            c()
+    return {"member": mem, "ingress": ing, "close": close,
+            "cache": cache, "port": ing.port}
+
+
+class TestRollingDrainInProcess:
+    def test_drain_under_load_loses_nothing(self):
+        """The tier-1 twin of the chaos acceptance: two live members
+        under closed-loop routed load; one drains mid-window. Every
+        response must be 200 (typed shed allowed, 5xx/dark NOT) and
+        the drained member's 503s all re-route."""
+        from serving_loadgen import run_http_fleet_closed_loop
+
+        rng = np.random.RandomState(0)
+        table = rng.rand(16, 4).astype(np.float32)
+        dir_ep = _ep()
+        d = FleetDirectory(dir_ep, heartbeat_timeout_s=2.0).start()
+        a = _mini_member("a", dir_ep, table)
+        b = _mini_member("b", dir_ep, table)
+        feeds = [{"ids": np.array([[i % 16]], np.int64)}
+                 for i in range(8)]
+        try:
+            _wait(lambda: len(d.view().endpoints()) == 2, what="joins")
+            stop = threading.Event()
+
+            def drainer():
+                time.sleep(0.8)
+                a["member"].drain()
+                stop.set()
+            th = threading.Thread(target=drainer, daemon=True)
+            th.start()
+            res = run_http_fleet_closed_loop(
+                [], feeds, clients=4, duration_s=1.8, warmup_s=0.1,
+                model="fleet", directory_ep=dir_ep)
+            th.join(10)
+            assert stop.is_set()
+            bad = {k: v for k, v in res["statuses"].items()
+                   if k not in ("ok", "429", "504")}
+            assert not bad, f"client-visible failures: {bad}"
+            assert res["n_ok"] > 0
+            assert len(d.view().endpoints()) == 1
+        finally:
+            a["close"]()
+            b["close"]()
+            d.close()
+
+    def test_kill_evicts_and_inflight_retries_against_replica(self):
+        """SIGKILL twin: hard-stop member a's ingress (connection-
+        severing close, no drain). The router's next requests to it
+        fail typed-transport, re-route to b, and the heartbeat monitor
+        evicts a within ~2×hb."""
+        rng = np.random.RandomState(1)
+        table = rng.rand(16, 4).astype(np.float32)
+        dir_ep = _ep()
+        d = FleetDirectory(dir_ep, heartbeat_timeout_s=0.4).start()
+        a = _mini_member("a", dir_ep, table)
+        b = _mini_member("b", dir_ep, table)
+        try:
+            _wait(lambda: len(d.view().endpoints()) == 2, what="joins")
+            router = FleetRouter(directory_ep=dir_ep, timeout_s=5.0)
+            # the kill: beats stop + sockets sever, no directory call
+            a["member"]._stop.set()
+            a["ingress"].close()
+            t0 = time.time()
+            oks = 0
+            for i in range(8):
+                status, obj = router.predict(
+                    {"ids": [[i % 16]]}, model="fleet")
+                oks += status == 200
+            assert oks == 8  # every request re-routed, zero failures
+            _wait(lambda: len(d.view().endpoints()) == 1, timeout=5,
+                  what="eviction")
+            assert time.time() - t0 < 2 * 0.4 + 4.0
+            assert d.stats()["evictions_total"] == 1
+            router.close()
+        finally:
+            a["close"]()
+            b["close"]()
+            d.close()
+
+
+# ---------------------------------------------------------------------------
+# leg 1+2 composed: cross-process freshness through a routed fleet
+# ---------------------------------------------------------------------------
+class TestFleetFreshness:
+    def test_push_becomes_visible_in_routed_responses(self):
+        """The tentpole contract end-to-end, in-process: a trainer-side
+        publish must change what a fleet member SERVES (not just what
+        it caches) within a bounded window."""
+        table = np.ones((8, 2), np.float32)
+        pub_ep = _ep()
+        pub = InvalidationPublisher(pub_ep).start()
+        dir_ep = _ep()
+        d = FleetDirectory(dir_ep, heartbeat_timeout_s=2.0).start()
+        m = _mini_member("f", dir_ep, table, pub_ep=pub_ep)
+        try:
+            _wait(lambda: len(d.view().endpoints()) == 1, what="join")
+            router = FleetRouter(directory_ep=dir_ep, timeout_s=10.0)
+            status, obj = router.predict({"ids": [[3]]}, model="fleet")
+            assert status == 200
+            assert abs(float(np.asarray(obj["outputs"][0]).reshape(-1)[0])
+                       - 2.0) < 1e-5
+            table[3] += 10.0               # the trainer push
+            t0 = time.time()
+            pub.publish("emb_f", [3])
+            _wait(lambda: m["cache"].stats()["invalidated_rows"] >= 1,
+                  what="remote invalidation")
+            status, obj = router.predict({"ids": [[3]]}, model="fleet")
+            window = time.time() - t0
+            assert status == 200
+            assert abs(float(np.asarray(obj["outputs"][0]).reshape(-1)[0])
+                       - 22.0) < 1e-5
+            assert window < 10.0
+            router.close()
+        finally:
+            m["close"]()
+            d.close()
+            pub.close()
+
+
+# ---------------------------------------------------------------------------
+# leg 3: autopilot
+# ---------------------------------------------------------------------------
+class TestAutopilot:
+    SLO = SLO(p99_ms=100.0, max_shed_rate=0.05, max_queue_rows=64,
+              min_members=1, max_members=4)
+
+    @pytest.mark.parametrize("snap,want", [
+        # p99 breach scales up; at max_members it holds (reported)
+        ({"members": 2, "p99_ms": 150.0}, "up"),
+        ({"members": 4, "p99_ms": 150.0}, "hold"),
+        # shed-rate / queue / breaker breaches also scale up
+        ({"members": 2, "p99_ms": 10.0, "shed_rate": 0.2}, "up"),
+        ({"members": 2, "p99_ms": 10.0, "queue_rows": 100}, "up"),
+        ({"members": 2, "p99_ms": 10.0, "breakers_open": 1}, "up"),
+        # idle fleet above the floor scales down; at the floor it holds
+        ({"members": 2, "p99_ms": 10.0, "shed_rate": 0.0,
+          "queue_rows": 0}, "down"),
+        ({"members": 1, "p99_ms": 10.0, "shed_rate": 0.0,
+          "queue_rows": 0}, "hold"),
+        # mid-band (not idle, not breached) holds
+        ({"members": 2, "p99_ms": 60.0, "shed_rate": 0.0,
+          "queue_rows": 0}, "hold"),
+        # below the membership floor always scales up (healing)
+        ({"members": 0}, "up"),
+    ])
+    def test_decision_table(self, snap, want):
+        assert decide(snap, self.SLO) == want
+
+    def test_tick_heals_and_respects_cooldown(self):
+        """Fleet below min_members: the first tick spawns, the next
+        tick inside the cooldown decides 'up' but does NOT act."""
+        fleet = [{"p99_ms": 5.0, "shed": 0, "requests": 10,
+                  "queue_rows": 0, "breakers_open": 0}]
+        actions = []
+        ap = Autopilot(lambda: list(fleet),
+                       SLO(min_members=2, max_members=4),
+                       spawn_fn=lambda: actions.append("spawn"),
+                       drain_fn=lambda: actions.append("drain"),
+                       interval_s=60.0, cooldown_s=60.0)
+        r1 = ap.tick()
+        assert r1["decision"] == "up" and r1["acted"]
+        assert actions == ["spawn"]
+        r2 = ap.tick()                  # inside the cooldown
+        assert r2["decision"] == "up" and not r2["acted"]
+        assert actions == ["spawn"]
+        # the spawn lands: a second member appears, fleet holds
+        fleet.append(dict(fleet[0]))
+        ap._last_action_t = 0.0
+        r3 = ap.tick()
+        assert r3["decision"] == "hold"
+
+    def test_shed_rate_windowed_from_cumulative_counters(self):
+        """Counters are cumulative; the autopilot must difference
+        per tick — an old shed burst must not breach forever."""
+        snaps = [{"p99_ms": 5.0, "shed": 100, "requests": 200,
+                  "queue_rows": 0, "breakers_open": 0}]
+        ap = Autopilot(lambda: [dict(snaps[0])],
+                       SLO(min_members=1, max_members=4,
+                           max_shed_rate=0.05),
+                       spawn_fn=lambda: None, drain_fn=lambda: None,
+                       interval_s=60.0, cooldown_s=0.0)
+        r1 = ap.tick()
+        assert r1["snap"]["shed_rate"] > 0.05  # the burst tick breaches
+        r2 = ap.tick()                          # no NEW shed since
+        assert r2["snap"]["shed_rate"] == 0.0
+        # fresh shedding breaches again
+        snaps[0] = {"p99_ms": 5.0, "shed": 150, "requests": 250,
+                    "queue_rows": 0, "breakers_open": 0}
+        r3 = ap.tick()
+        assert r3["snap"]["shed_rate"] == pytest.approx(1.0)
+
+    def test_dark_members_counted(self):
+        ap = Autopilot(lambda: [None, {"p99_ms": 1.0, "shed": 0,
+                                       "requests": 1, "queue_rows": 0,
+                                       "breakers_open": 0}],
+                       SLO(min_members=1, max_members=4),
+                       spawn_fn=lambda: None, drain_fn=lambda: None)
+        r = ap.tick()
+        assert r["snap"]["members"] == 1 and r["snap"]["dark"] == 1
+        assert ap.stats()["dark_scrapes"] == 1
+
+
+# ---------------------------------------------------------------------------
+# grad-push publisher hook (the ps_rpc trainer-side tap)
+# ---------------------------------------------------------------------------
+class TestPublisherHook:
+    def test_install_and_restore(self):
+        from paddle_tpu.fluid import ps_rpc
+        calls = []
+
+        class _Pub:
+            def publish(self, table, ids):
+                calls.append((table, list(np.asarray(ids).reshape(-1))))
+
+        prev = ps_rpc.install_invalidation_publisher(_Pub())
+        try:
+            ps_rpc.current_invalidation_publisher().publish(
+                "w", np.array([1, 2]))
+            assert calls == [("w", [1, 2])]
+        finally:
+            ps_rpc.install_invalidation_publisher(prev)
+        assert ps_rpc.current_invalidation_publisher() is prev
+
+
+# ---------------------------------------------------------------------------
+# multiprocess acceptance (slow tier): the chaos scenario, small config
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+class TestServingFleetChaos:
+    def test_serving_fleet_scenario(self, tmp_path):
+        """Real subprocess members, rolling restart + SIGKILL under
+        open-loop fleet-routed load — the ISSUE 18 acceptance run
+        (tools/chaos_ps.py --scenario serving_fleet, small config)."""
+        from chaos_ps import run_serving_fleet_scenario
+
+        res = run_serving_fleet_scenario(
+            str(tmp_path), members=2, hb=1.0, rate_qps=40.0,
+            duration_s=60.0, clients=4)
+        assert res["ok"], res["checks"]
+        assert res["freshness_window_s"] is not None
+        assert res["freshness_window_s"] < 10.0
+        assert res["evict_s"] <= 2 * 1.0 + 10
+        statuses = res["load"]["statuses"]
+        assert "5xx" not in statuses and "no_live" not in statuses

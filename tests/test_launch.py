@@ -59,6 +59,12 @@ def test_launch_propagates_worker_failure(tmp_path):
     assert res.returncode != 0
 
 
+# r19 fleet-PR buyback: now that the gloo collectives fix (parallel/
+# env.py) makes multi-proc launch WORK, this is a ~12s multiprocess
+# subprocess driver — those carry `slow` by the docs/ci.md convention.
+# Tier-1 keeps test_launch_spawns_workers_with_env + the failure-
+# propagation test as the per-commit launch coverage.
+@pytest.mark.slow
 def test_two_process_dp_matches_single_process(tmp_path):
     """The reference's N-vs-1 oracle (test_dist_base.py:933): the same
     model trained on a 2-process 4-device jax.distributed CPU mesh through
@@ -200,6 +206,10 @@ def test_combined_dp_trainers_with_ps_lazy_tables(tmp_path):
     assert r0["samples_per_sec"] > 0
 
 
+# r19 fleet-PR buyback: ~18s 4-proc subprocess driver; slow per the
+# docs/ci.md multiprocess-drivers-carry-slow convention (the 2-proc
+# twin above covers the same parity contract in the full tier).
+@pytest.mark.slow
 def test_four_process_dp_matches_single_process(tmp_path):
     """VERDICT r03 #8 — scale the multi-process proof past 2: a
     4-process 8-device jax.distributed CPU mesh through the launcher

@@ -9,6 +9,12 @@ from paddle_tpu.fluid import core
 from paddle_tpu.models import resnet, bert
 
 
+# r19 fleet-PR buyback: the STANDING KNOWN-FAIL (lr tuning — see
+# ROADMAP) burned ~23s of the per-commit window to fail
+# deterministically every run; it keeps failing in the full tier
+# where the known-fail is tracked. NOT a fix — the lr root cause
+# is untouched and still documented.
+@pytest.mark.slow
 def test_resnet18_tiny_trains():
     np.random.seed(0)
     main, startup, feeds, fetches = resnet.build_resnet_train_program(
@@ -38,6 +44,11 @@ def test_resnet50_builds():
     assert n_conv == 53
 
 
+# r19 fleet-PR buyback (~15s compile-dominated convergence smoke):
+# bert coverage stays per-commit via test_book_models bert feed +
+# the recompute path in test_backward_executor (PR 13 precedent:
+# vgg/transformer convergence twins live in the full tier).
+@pytest.mark.slow
 def test_bert_tiny_trains():
     cfg = dict(bert.bert_base_config())
     cfg.update(vocab_size=100, hidden=32, layers=2, heads=2, ffn=64,

@@ -106,6 +106,8 @@ def test_mesh3d_axes_and_capacity_validation():
 
 
 @requires8
+# r19 fleet-PR buyback: lm3d-level drop accounting (~7s); test_moe::test_moe_capacity_drops_overflow pins the drop mechanics per-commit.
+@pytest.mark.slow
 def test_moe_counted_drops_match_zeroed_tokens():
     """return_dropped: the schedule-global drop count equals the number
     of tokens the capacity bound zeroed (cross-checked against the
@@ -186,6 +188,8 @@ def test_gpipe_pass_micro_hands_each_tick_its_microbatch_index():
 
 # ------------------------------------------------------- lm3d lane parity
 @requires8
+# r19 fleet-PR buyback: full-3D+MoE oracle acceptance (~13s); the pp-only bit-identical parity below stays per-commit and the bench-scale slow acceptance re-proves the full composition.
+@pytest.mark.slow
 def test_lm3d_full_3d_moe_matches_oracle_and_guard_covers_it():
     """THE tentpole pin, one trace for the whole batch of claims: the
     full dp2×pp2×sp2 + 4-expert-MoE composition matches the oracle
@@ -294,6 +298,8 @@ def test_lm3d_window_scan_bit_identical_to_step_loop():
 
 # --------------------------------------------------- guard + AMP epilogue
 @requires8
+# r19 fleet-PR buyback: amp trip transition (~6s); test_quant_amp pins the dynamic-scale transition per-commit.
+@pytest.mark.slow
 def test_lm3d_amp_trip_discards_and_halves_scale():
     """amp=True: a tripped step keeps params bit-exact and runs the
     PR 5 dynamic loss-scale transition (scale × decr_ratio) off the
@@ -379,6 +385,8 @@ def _run_pipelined(mesh, windowed, k=4, n_stages=2, profile=False):
 
 
 @requires8
+# r19 fleet-PR buyback: window-stack parity (~13s); the executor-level windowed-guard + dataloader-window twins below stay per-commit.
+@pytest.mark.slow
 def test_window_stack_through_gpipe_bit_identical_to_step_loop():
     """The tentpole executor contract: a K-window feed consumed by a
     PipelineOptimizer-sectioned program on the pp mesh scans as ONE
@@ -405,6 +413,8 @@ def test_window_stack_through_gpipe_bit_identical_to_step_loop():
 
 
 @requires8
+# r19 fleet-PR buyback: raise-mode fallback parity (~6s); the executor-level per-step fallback tests stay per-commit.
+@pytest.mark.slow
 def test_window_raise_mode_falls_back_per_step_and_matches():
     """raise is the debugging action: the mesh window takes the
     documented per-step fallback (the localizer needs per-step rng

@@ -21,6 +21,10 @@ def _qkv(seed):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+# r19 fleet-PR buyback (~10s both params): lm3d pp-only parity
+# trains through ring_attention_local against its oracle per-commit
+# (PR 14 demoted the grad-parity sibling with the same twin).
+@pytest.mark.slow
 def test_ring_matches_dense(causal):
     q, k, v = _qkv(0)
     mesh = sequence_mesh(SP)
@@ -69,6 +73,9 @@ def test_ulysses_matches_dense(causal):
                                rtol=2e-5, atol=2e-6)
 
 
+# r19 fleet-PR buyback (~6s); same rationale as above — the lm3d
+# lane exercises the sp axis per-commit.
+@pytest.mark.slow
 def test_ulysses_grads_match_dense():
     q, k, v = _qkv(3)
     mesh = sequence_mesh(SP)

@@ -14,6 +14,12 @@ checks the training outcome against a no-fault oracle:
     replica, bit-identical to the oracle.
   * ``full`` — drain+rejoin on slot 0 AND a SIGKILL failover on slot 1,
     one run (the ISSUE 6 acceptance scenario).
+  * ``serving_fleet`` — the self-healing SERVING fleet run (ISSUE 18,
+    docs/SERVING.md "Fleet"): N engine subprocesses behind a
+    FleetDirectory under open-loop fleet-routed load; a trainer table
+    push must become visible in remote responses within a measured
+    window, a rolling restart plus one SIGKILL must lose zero accepted
+    requests with zero 5xx, and the autopilot must heal the fleet.
 
 Models: ``linear`` (tests/dist_ps_workload.py — tiny, fast) and
 ``wide_deep`` (the CTR model from paddle_tpu.models.wide_deep with
@@ -318,6 +324,328 @@ def run_scenario(scenario, workdir, model="linear", trainers=3,
 
 
 # ---------------------------------------------------------------------------
+# serving-fleet scenario (ISSUE 18): rolling restart + SIGKILL under load
+# ---------------------------------------------------------------------------
+def _scrape_metric_stat(host, port, name):
+    """Pull one histogram's (_sum, _count) off a member's /metrics
+    exposition — the registry-scraped freshness-window evidence."""
+    import http.client as _http
+    conn = _http.HTTPConnection(host, int(port), timeout=5.0)
+    try:
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode("utf-8", "replace")
+    finally:
+        conn.close()
+    s = c = None
+    for ln in text.splitlines():
+        if ln.startswith(name + "_sum"):
+            s = float(ln.rsplit(None, 1)[1])
+        elif ln.startswith(name + "_count"):
+            c = float(ln.rsplit(None, 1)[1])
+    return s, c
+
+
+def run_serving_fleet_scenario(workdir, members=3, n_rows=64, dim=8,
+                               hb=1.0, rate_qps=60.0, duration_s=75.0,
+                               clients=8):
+    """The self-healing-fleet acceptance run (docs/SERVING.md "Fleet"):
+
+    the driver hosts the embedding table (a raw VarServer), the
+    trainer-side ``InvalidationPublisher``, the ``FleetDirectory`` and
+    an ``Autopilot``; ``members`` serving engines run as REAL
+    subprocesses (``serving-member`` subcommand). Under open-loop
+    fleet-routed load it then injects, in order:
+
+      1. a trainer table push + invalidation broadcast — every member
+         must reflect the new rows in its HTTP responses within a
+         bounded, MEASURED window (wall-clock here, plus the members'
+         registry-scraped staleness histograms);
+      2. a rolling restart — each original member SIGTERMed (directory
+         drain → ingress drain → exit) and replaced, zero lost
+         accepted requests;
+      3. one SIGKILL — heartbeat eviction within ~2×hb, the autopilot
+         heals the fleet back to ``members``.
+
+    ``ok`` iff the load saw ZERO 5xx / fleet-dark errors, every
+    response is accounted (accepted or typed-shed), freshness was
+    in-bounds on every member, and the fleet healed.
+    """
+    import threading
+
+    import numpy as np
+
+    os.makedirs(workdir, exist_ok=True)
+    from paddle_tpu.fluid.ps_rpc import VarServer
+    from paddle_tpu.serving import (Autopilot, FleetDirectory,
+                                    InvalidationPublisher, SLO)
+    from paddle_tpu.serving.fleet import scrape_http_member
+    from tools.serving_loadgen import HttpClient, run_http_fleet_open_loop
+
+    result = {"scenario": "serving_fleet", "members": members,
+              "events": []}
+    rng = np.random.RandomState(7)
+    table = rng.rand(n_rows, dim).astype(np.float32)
+    tlock = threading.Lock()
+
+    def serve_table(name, rows, prefetch=False, trainer_id=0):
+        with tlock:
+            return table[np.asarray(rows, np.int64)].copy()
+
+    table_ep = f"127.0.0.1:{free_port()}"
+    pub_ep = f"127.0.0.1:{free_port()}"
+    dir_ep = f"127.0.0.1:{free_port()}"
+    srv = VarServer(table_ep, {"prefetch_rows": serve_table}).start()
+    pub = InvalidationPublisher(pub_ep).start()
+    directory = FleetDirectory(dir_ep, heartbeat_timeout_s=hb).start()
+
+    member_procs = {}       # name -> (proc, tail, ready_path)
+    next_idx = [0]
+    spawn_lock = threading.Lock()
+
+    def spawn_member():
+        with spawn_lock:
+            i = next_idx[0]
+            next_idx[0] += 1
+        name = f"m{i}"
+        ready = os.path.join(workdir, f"{name}.ready")
+        p, tail = _spawn(
+            [os.path.abspath(__file__), "serving-member", name,
+             table_ep, pub_ep, dir_ep, ready,
+             f"--rows={n_rows}", f"--dim={dim}", f"--hb={hb}"],
+            os.path.join(workdir, f"{name}.log"))
+        member_procs[name] = (p, tail, ready)
+        return name
+
+    def wait_member(name, timeout=120.0):
+        p, tail, ready = member_procs[name]
+        _wait_file(ready, timeout, [(p, tail)], desc=f"member {name}")
+        return int(open(ready).read().strip())
+
+    def wait_view(n, timeout=60.0, desc=""):
+        end = time.time() + timeout
+        while time.time() < end:
+            if len(directory.view().endpoints()) == n:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"fleet view stuck at {len(directory.view().endpoints())} "
+            f"members, want {n} {desc}")
+
+    def scrape_all():
+        out = []
+        for ep in directory.view().endpoints():
+            host, port = ep.rsplit(":", 1)
+            try:
+                out.append(scrape_http_member(ep))
+            except Exception:
+                out.append(None)
+        return out
+
+    autopilot = None
+    load_box = {}
+    try:
+        ports = {}
+        for _ in range(members):
+            name = spawn_member()
+            ports[name] = wait_member(name)
+        wait_view(members, desc="at start")
+
+        feeds = [{"ids": np.array([[i % n_rows]], np.int64)}
+                 for i in range(32)]
+
+        def load():
+            load_box["res"] = run_http_fleet_open_loop(
+                [], feeds, rate_qps=rate_qps, duration_s=duration_s,
+                clients=clients, model="fleet", directory_ep=dir_ep)
+        load_th = threading.Thread(target=load, daemon=True)
+        load_th.start()
+        time.sleep(1.0)  # let the loop establish against the fleet
+
+        # ---- 1. trainer push: update rows, broadcast, measure until
+        # every member's HTTP response reflects the new values
+        push_ids = list(range(8))
+        with tlock:
+            table[push_ids] += 1.0
+            expect = [float(table[i].sum()) for i in push_ids]
+        t_push = time.time()
+        pub.publish("emb_fleet", push_ids)
+        fresh_by_member = {}
+        deadline = t_push + 10.0
+        pending = dict(ports)
+        while pending and time.time() < deadline:
+            for name, port in list(pending.items()):
+                cli = HttpClient("127.0.0.1", port)
+                try:
+                    status, obj = cli.predict(
+                        {"ids": [[push_ids[0]]]}, model="fleet")
+                finally:
+                    cli.close()
+                if status == 200:
+                    got = float(np.asarray(obj["outputs"][0])
+                                .reshape(-1)[0])
+                    if abs(got - expect[0]) < 1e-3:
+                        fresh_by_member[name] = time.time() - t_push
+                        del pending[name]
+            if pending:
+                time.sleep(0.02)
+        result["freshness_s"] = {k: round(v, 4)
+                                 for k, v in fresh_by_member.items()}
+        result["events"].append(("push", push_ids, None, None))
+        fresh_ok = len(fresh_by_member) == members
+        result["freshness_window_s"] = (
+            round(max(fresh_by_member.values()), 4)
+            if fresh_by_member else None)
+
+        # ---- 2. rolling restart of every ORIGINAL member — surge
+        # style: the replacement JOINS before the old member drains,
+        # so the routable fleet never dips below target strength
+        for name in list(ports):
+            repl = spawn_member()
+            wait_member(repl)
+            wait_view(members + 1, desc=f"surge {repl} for {name}")
+            p, tail, _ready = member_procs[name]
+            p.send_signal(signal.SIGTERM)
+            rc = p.wait(timeout=120)
+            result["events"].append(("sigterm", name, rc, None))
+            wait_view(members, desc=f"after rolling {name}->{repl}")
+
+        # ---- 3. SIGKILL one member; eviction + autopilot heal. The
+        # autopilot arms only now: its min_members healing must not
+        # race the DELIBERATE drains of phase 2 (a real deployment
+        # coordinates restarts with the controller the same way)
+        slo = SLO(p99_ms=5000.0, max_shed_rate=1.0,
+                  max_queue_rows=1 << 20, min_members=members,
+                  max_members=members + 2)
+        autopilot = Autopilot(
+            scrape_all, slo,
+            spawn_fn=spawn_member,
+            drain_fn=lambda: None,  # scale-down is not this scenario
+            interval_s=0.5, cooldown_s=2.0).start()
+        victim = next(n for n, (p, _t, _r) in member_procs.items()
+                      if p.poll() is None)
+        vp = member_procs[victim][0]
+        t_kill = time.time()
+        vp.send_signal(signal.SIGKILL)
+        vp.wait(timeout=30)
+        wait_view(members - 1, timeout=2 * hb + 20,
+                  desc="eviction after SIGKILL")
+        result["evict_s"] = round(time.time() - t_kill, 3)
+        result["events"].append(("sigkill", victim, None, None))
+        wait_view(members, timeout=120, desc="autopilot heal")
+        result["heal_s"] = round(time.time() - t_kill, 3)
+
+        load_th.join(timeout=duration_s + 120)
+        res = load_box.get("res") or {}
+        result["load"] = res
+
+        # registry-scraped staleness evidence off one live member
+        for ep in directory.view().endpoints():
+            host, port = ep.rsplit(":", 1)
+            try:
+                s, c = _scrape_metric_stat(
+                    host, port, "serving_cache_staleness_window_seconds")
+            except Exception:
+                continue
+            if c:
+                result["staleness_hist"] = {
+                    "count": c, "mean_s": round(s / c, 6)}
+                break
+
+        statuses = dict(res.get("statuses") or {})
+        bad = {k: v for k, v in statuses.items()
+               if k not in ("ok", "429", "504")}
+        accounted = (sum(statuses.values()) == res.get("offered", -1))
+        result["checks"] = {
+            "zero_5xx_or_dark": not bad,
+            "all_requests_accounted": accounted,
+            "freshness_all_members": fresh_ok,
+            "evicted_within_2xhb": result["evict_s"] <= 2 * hb + 10,
+            "healed": True,
+        }
+        result["ok"] = all(result["checks"].values())
+        return result
+    finally:
+        if autopilot is not None:
+            autopilot.stop()
+        for name, (p, tail, _r) in member_procs.items():
+            if p.poll() is None:
+                p.kill()
+        for name, (p, _t, _r) in member_procs.items():
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        directory.close()
+        pub.close()
+        srv.shutdown()
+
+
+def run_serving_member():
+    """``serving-member`` subcommand: one fleet engine process — MLP-
+    free value-reflective model (``out = sum(emb[id])``, so a table
+    push is directly observable in the HTTP response), EmbeddingCache
+    + InvalidationSubscriber, ingress, FleetMember. SIGTERM runs the
+    zero-lost drain (directory first, then ingress) and exits 0."""
+    name, table_ep, pub_ep, dir_ep, ready_file = sys.argv[2:7]
+    n_rows = int(_flag_value("--rows", 64) or 64)
+    dim = int(_flag_value("--dim", 8) or 8)
+    hb = float(_flag_value("--hb", 1.0) or 1.0)
+    ttl_s = float(_flag_value("--ttl", 30.0) or 30.0)
+
+    import threading
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core
+    from paddle_tpu.serving import (EmbeddingCache, FleetMember,
+                                    InvalidationSubscriber, ServingEngine,
+                                    ServingIngress, rewrite_sparse_lookups)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[n_rows, dim],
+                                     param_attr="emb_fleet",
+                                     is_distributed=True)
+        out = fluid.layers.reduce_sum(
+            fluid.layers.reshape(emb, [-1, dim]), dim=1)
+    exe = fluid.Executor()
+    scope = core.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    ps_prog, _ = rewrite_sparse_lookups(main, [table_ep],
+                                        tables=["emb_fleet"])
+    cache = EmbeddingCache(ttl_s=ttl_s, max_entries=100000,
+                           serve_stale=True)
+    eng = ServingEngine(program=ps_prog, scope=scope, feed_names=["ids"],
+                        fetch_names=[out], max_batch=8,
+                        max_queue_delay_ms=1.0, num_workers=2,
+                        embedding_cache=cache)
+    ing = ServingIngress({"fleet": eng}).start()
+    sub = InvalidationSubscriber(pub_ep, cache, name=name,
+                                 poll_wait_s=0.5).start()
+    member = FleetMember(name, dir_ep, f"127.0.0.1:{ing.port}",
+                         ingress=ing, beat_interval_s=max(0.1, hb / 4))
+    member.start()
+
+    done = threading.Event()
+
+    def on_term(_sig, _frm):
+        # drain OFF the signal thread: member.drain() does wire RPCs +
+        # the ingress inflight wait — too much for a handler frame
+        threading.Thread(target=lambda: (member.drain(), done.set()),
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, on_term)
+    open(ready_file, "w").write(str(ing.port))
+    done.wait()
+    sub.stop()
+    ing.close()
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
 # wide_deep worker subcommand (pserver / standby / trainer roles)
 # ---------------------------------------------------------------------------
 def _flag_value(name, default=None):
@@ -410,9 +738,13 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "worker":
         run_worker()
         return 0
+    if len(sys.argv) > 1 and sys.argv[1] == "serving-member":
+        run_serving_member()
+        return 0
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--scenario", default="full",
-                    choices=["drain_rejoin", "failover", "full"])
+                    choices=["drain_rejoin", "failover", "full",
+                             "serving_fleet"])
     ap.add_argument("--model", default="linear",
                     choices=["linear", "wide_deep"])
     ap.add_argument("--trainers", type=int, default=3)
@@ -437,6 +769,12 @@ def main():
         # each stream a shard the merge smoke below combines
         os.makedirs(args.trace_dir, exist_ok=True)
         os.environ["FLAGS_trace_dir"] = args.trace_dir
+    if args.scenario == "serving_fleet":
+        res = run_serving_fleet_scenario(workdir, hb=args.hb)
+        print(json.dumps({k: v for k, v in res.items()
+                          if k != "load"}, indent=1, default=str))
+        print("load:", json.dumps(res.get("load", {}), default=str))
+        return 0 if res.get("ok") else 1
     res = run_scenario(args.scenario, workdir, model=args.model,
                        trainers=args.trainers, n_pservers=args.pservers,
                        steps=args.steps, hb=args.hb,

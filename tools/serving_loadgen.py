@@ -23,6 +23,11 @@ Library (bench.py + tests/test_serving*.py import these):
   * ``run_chaos_scenario`` — kills a pserver mid-HTTP-serving and
     reports degraded (stale-cache) responses, 5xx counts for
     cache-covered rows, and recovery after a PR 6-style promotion.
+  * ``run_http_fleet_closed_loop`` / ``run_http_fleet_open_loop`` —
+    the same two disciplines spread over a serving FLEET via
+    ``serving.FleetRouter`` (round-robin + retry-on-503/reset, live
+    directory view), reporting a per-endpoint status/latency breakdown
+    and the reroute count (docs/SERVING.md "Fleet").
   * ``start_inproc_pserver`` / ``push_table`` — the in-process
     listen_and_serv harness the serving PS lanes and tests run against
     (same shape as tests/test_ps_membership.py's protocol harness).
@@ -35,6 +40,10 @@ CLI (manual runs)::
     python tools/serving_loadgen.py --mode http                 # closed over HTTP
     python tools/serving_loadgen.py --mode http --scenario overload
     python tools/serving_loadgen.py --mode http --scenario chaos
+    python tools/serving_loadgen.py --mode http \
+        --endpoints 127.0.0.1:8801,127.0.0.1:8802   # fleet round-robin
+    python tools/serving_loadgen.py --mode http --directory 127.0.0.1:8700 \
+        --fleet-loop open --rate 300                # follow the live view
 
 Prints one JSON line: loadgen results + the engine's stats() surface
 (including the shed / deadline_expired / degraded / breaker_open
@@ -383,6 +392,190 @@ def run_http_open_loop(host: str, port: int, feeds: Sequence[dict],
     return out
 
 
+# ----------------------------------------------------------- fleet loops
+def _fleet_router(endpoints, directory_ep, timeout_s=60.0):
+    from paddle_tpu.serving import FleetRouter
+
+    return FleetRouter(directory_ep=directory_ep,
+                       endpoints=endpoints or None, timeout_s=timeout_s)
+
+
+def _merge_by_endpoint(routers) -> Dict[str, Dict[str, float]]:
+    """Aggregate the per-worker routers' per-endpoint breakdowns into
+    one table with derived mean latency — the multi-endpoint report
+    (docs/SERVING.md "Fleet") that shows WHERE the 503s/resets landed
+    and that the retried requests were absorbed elsewhere."""
+    agg: Dict[str, Dict[str, float]] = {}
+    for r in routers:
+        for ep, d in r.stats()["by_endpoint"].items():
+            a = agg.setdefault(ep, {})
+            for k, v in d.items():
+                a[k] = a.get(k, 0) + v
+    for d in agg.values():
+        n = d.pop("lat_n", 0)
+        s = d.pop("lat_sum_ms", 0.0)
+        if n:
+            d["lat_mean_ms"] = round(s / n, 3)
+    return {ep: dict(sorted(d.items())) for ep, d in sorted(agg.items())}
+
+
+def run_http_fleet_closed_loop(endpoints: Sequence[str], feeds,
+                               clients: int = 16, duration_s: float = 3.0,
+                               warmup_s: float = 0.5,
+                               deadline_ms: Optional[float] = None,
+                               model: Optional[str] = None,
+                               directory_ep: Optional[str] = None
+                               ) -> Dict[str, float]:
+    """Closed loop spread over a serving FLEET: each client thread owns
+    a ``FleetRouter`` (round-robin + retry across members on 503/
+    connection-reset, live-view refresh when ``directory_ep`` is
+    given). Reports the single-endpoint shape PLUS ``by_endpoint`` and
+    ``reroutes`` — a rolling restart shows up as per-member 503 counts
+    with zero client-visible failures."""
+    from paddle_tpu.serving import NoLiveMembersError
+
+    results: List[List] = [[] for _ in range(clients)]
+    counts: List[Dict[str, int]] = [{} for _ in range(clients)]
+    routers = [_fleet_router(list(endpoints), directory_ep)
+               for _ in range(clients)]
+    go = threading.Event()
+    t_box = {}
+
+    def worker(wid: int):
+        router = routers[wid]
+        rs, cs = results[wid], counts[wid]
+        go.wait()
+        end = t_box["t0"] + warmup_s + duration_s
+        i = wid
+        while time.perf_counter() < end:
+            feed = feeds[i % len(feeds)]
+            i += clients
+            t = time.perf_counter()
+            try:
+                status, obj = router.predict(feed, model=model,
+                                             deadline_ms=deadline_ms)
+            except NoLiveMembersError:
+                cs["no_live"] = cs.get("no_live", 0) + 1
+                time.sleep(0.05)
+                continue
+            key = _status_key(status)
+            cs[key] = cs.get(key, 0) + 1
+            if status == 200:
+                rs.append((time.perf_counter(), t))
+        router.close()
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(clients)]
+    for t in threads:
+        t.start()
+    t_box["t0"] = time.perf_counter()
+    go.set()
+    for t in threads:
+        t.join()
+    cut = t_box["t0"] + warmup_s
+    done = sorted((td, td - ts) for rs in results for td, ts in rs
+                  if ts >= cut)
+    hist: Dict[str, int] = {}
+    for cs in counts:
+        for k, v in cs.items():
+            hist[k] = hist.get(k, 0) + v
+    span = (done[-1][0] - cut) if done else 0.0
+    out = {"qps": len(done) / span if span > 1e-9 else 0.0,
+           "n_ok": len(done), "clients": clients,
+           "statuses": dict(sorted(hist.items())),
+           "reroutes": int(sum(r.stats()["reroutes"] for r in routers)),
+           "by_endpoint": _merge_by_endpoint(routers),
+           "duration_s": round(span, 3)}
+    out.update(_percentiles([lat for _t, lat in done]))
+    return out
+
+
+def run_http_fleet_open_loop(endpoints: Sequence[str], feeds,
+                             rate_qps: float, duration_s: float = 3.0,
+                             clients: int = 16,
+                             deadline_ms: Optional[float] = None,
+                             model: Optional[str] = None,
+                             directory_ep: Optional[str] = None
+                             ) -> Dict[str, float]:
+    """Open loop over a fleet: same pacer/sender-pool contract as
+    ``run_http_open_loop`` (scheduled-time latency, ``behind`` debt)
+    with the routing layer of the closed-loop variant — the chaos
+    scenario's load shape (a kill mid-run must NOT dent the accepted
+    rate beyond the retried requests' extra hop)."""
+    import queue as _queue
+
+    from paddle_tpu.serving import NoLiveMembersError
+
+    if rate_qps <= 0:
+        raise ValueError("rate_qps must be > 0")
+    period = 1.0 / float(rate_qps)
+    q: "_queue.Queue" = _queue.Queue()
+    acc: List[tuple] = []
+    hist: Dict[str, int] = {}
+    behind = [0]
+    lock = threading.Lock()
+    routers = [_fleet_router(list(endpoints), directory_ep)
+               for _ in range(clients)]
+
+    def sender(wid: int):
+        router = routers[wid]
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            t_sched, feed = item
+            t_start = time.perf_counter()
+            if t_start > t_sched + period:
+                with lock:
+                    behind[0] += 1
+            try:
+                status, obj = router.predict(feed, model=model,
+                                             deadline_ms=deadline_ms)
+            except NoLiveMembersError:
+                with lock:
+                    hist["no_live"] = hist.get("no_live", 0) + 1
+                continue
+            t_done = time.perf_counter()
+            with lock:
+                key = _status_key(status)
+                hist[key] = hist.get(key, 0) + 1
+                if status == 200:
+                    acc.append((t_done - t_start, t_done - t_sched))
+        router.close()
+
+    senders = [threading.Thread(target=sender, args=(w,), daemon=True)
+               for w in range(clients)]
+    for t in senders:
+        t.start()
+    start = time.perf_counter()
+    next_t = start
+    i = 0
+    while time.perf_counter() < start + duration_s:
+        now = time.perf_counter()
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.05))
+            continue
+        q.put((next_t, feeds[i % len(feeds)]))
+        i += 1
+        next_t += period
+    for _ in senders:
+        q.put(None)
+    for t in senders:
+        t.join()
+    n_offered = i
+    out = {"target_qps": float(rate_qps), "offered": n_offered,
+           "accepted": len(acc),
+           "accepted_rate": len(acc) / max(n_offered, 1),
+           "behind": behind[0], "clients": clients,
+           "statuses": dict(sorted(hist.items())),
+           "reroutes": int(sum(r.stats()["reroutes"] for r in routers)),
+           "by_endpoint": _merge_by_endpoint(routers)}
+    out.update(_percentiles([lat for lat, _s in acc]))
+    sched = _percentiles([s for _lat, s in acc])
+    out.update({f"sched_{k}": v for k, v in sched.items()})
+    return out
+
+
 # ------------------------------------------------------------------ harness
 def start_inproc_pserver(endpoint: str, bind: str = "",
                          standby: bool = False,
@@ -721,6 +914,18 @@ def main(argv=None):
                          "concurrency to engage)")
     ap.add_argument("--naive", action="store_true",
                     help="one-request-one-dispatch lane (max_batch=1)")
+    ap.add_argument("--endpoints", default=None,
+                    help="http-mode fleet targets, comma-separated "
+                         "host:port — round-robin + retry-on-503/"
+                         "reset across them instead of building a "
+                         "local engine")
+    ap.add_argument("--directory", default=None,
+                    help="fleet directory endpoint (host:port) — the "
+                         "router follows the live membership view; "
+                         "combinable with --endpoints as the seed list")
+    ap.add_argument("--fleet-loop", choices=("closed", "open"),
+                    default="closed",
+                    help="fleet-mode load shape (open paces --rate)")
     args = ap.parse_args(argv)
 
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -729,6 +934,30 @@ def main(argv=None):
         jax.config.update("jax_platforms", "cpu")
 
     if args.mode == "http":
+        if args.endpoints or args.directory:
+            # fleet mode: drive LIVE remote members (the chaos harness
+            # and multi-process fleet lanes), no local engine at all
+            eps = ([e.strip() for e in args.endpoints.split(",")
+                    if e.strip()] if args.endpoints else [])
+            rng = np.random.RandomState(0)
+            feeds = [{"x": rng.rand(784).astype(np.float32)}
+                     for _ in range(64)]
+            if args.fleet_loop == "open":
+                res = run_http_fleet_open_loop(
+                    eps, feeds, rate_qps=args.rate,
+                    duration_s=args.duration, clients=args.clients,
+                    deadline_ms=args.deadline_ms, model="mlp",
+                    directory_ep=args.directory)
+            else:
+                res = run_http_fleet_closed_loop(
+                    eps, feeds, clients=args.clients,
+                    duration_s=args.duration, warmup_s=args.warmup,
+                    deadline_ms=args.deadline_ms, model="mlp",
+                    directory_ep=args.directory)
+            print(json.dumps({"mode": "http-fleet",
+                              "loop": args.fleet_loop,
+                              "result": res}, default=str))
+            return 0
         if args.scenario == "overload":
             res = run_overload_scenario(
                 clients=args.clients, duration_s=args.duration,
