@@ -1581,6 +1581,75 @@ def bench_serve_fleet(members=4, clients=8, duration=3.0, warmup_s=0.5,
         VarClient.reset_pool()
 
 
+def bench_stream_ctr(steps=30, batch=8, step_sleep=0.12):
+    """Streaming online-learning CTR lane (docs/FAULT_TOLERANCE.md
+    "Streaming online learning"): runs the full chaos acceptance
+    scenario — sync-oracle leg, then the fully-async train+serve
+    cluster with its mid-run pserver SIGKILL — and reports async vs
+    sync-oracle trainer samples/s plus the event→served freshness p99
+    scraped off the serving member's /metrics histogram. Appends one
+    BENCH_LOCAL row per leg (the ISSUE 20 evidence contract).
+
+    1-core evidence-arm caveat (same as serve_fleet /
+    wide_deep_1b_async): every cluster process shares one core, so
+    samples/s is scheduler-bound evidence — the robustness checks
+    (zero typed-error leaks across the SIGKILL, loss in the oracle's
+    neighborhood) are the lane's primary product. The async trainer is
+    paced by ``step_sleep`` (it models event arrival; the oracle leg
+    is unpaced), so the row records the pacing and a pacing-adjusted
+    rate alongside the raw one. Faster pacing starves the co-located
+    serving member on one core (accepted p99 blows the bar at 0.05s),
+    so the default keeps the scenario's 0.12s event cadence."""
+    import tempfile
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.chaos_ps import run_streaming_scenario
+
+    wd = tempfile.mkdtemp(prefix="bench_stream_ctr_")
+    res = run_streaming_scenario(wd, steps=steps, batch=batch,
+                                 step_sleep=step_sleep,
+                                 kill_at=max(5, steps // 3))
+    n_async = int(res.get("async_steps_run") or steps)
+    wall_a = float(res.get("async_train_wall_s") or 0) or None
+    wall_o = float(res.get("oracle_train_wall_s") or 0) or None
+    sps_async = round(n_async * batch / wall_a, 2) if wall_a else None
+    sps_oracle = round(steps * batch / wall_o, 2) if wall_o else None
+    paced_out = n_async * step_sleep
+    sps_async_adj = (round(n_async * batch / (wall_a - paced_out), 2)
+                     if wall_a and wall_a > paced_out else None)
+    note = ("1-core box: all cluster processes share one core — "
+            "samples/s is scheduler-bound evidence; robustness checks "
+            "(zero typed leaks across SIGKILL, oracle-neighborhood "
+            "loss) are the lane's product")
+    rows = [
+        {"metric": "stream_ctr_async_samples_per_sec",
+         "value": sps_async, "unit": "samples/s",
+         "vs_baseline": (round(sps_async / sps_oracle, 3)
+                         if sps_async and sps_oracle else None),
+         "steps": n_async, "batch": batch, "step_sleep_s": step_sleep,
+         "pacing_adjusted_samples_per_sec": sps_async_adj,
+         "freshness_p99_s": res.get("freshness_p99_s"),
+         "freshness_samples": res.get("freshness_samples"),
+         "serving_p99_ms": (res.get("load") or {}).get("p99_ms"),
+         "shrink_runs": res.get("shrink_runs"),
+         "async_tail_mean": res.get("async_tail_mean"),
+         "ok": res.get("ok"), "note": note},
+        {"metric": "stream_ctr_sync_oracle_samples_per_sec",
+         "value": sps_oracle, "unit": "samples/s", "vs_baseline": 1.0,
+         "steps": steps, "batch": batch, "step_sleep_s": 0.0,
+         "oracle_tail_mean": res.get("oracle_tail_mean"),
+         "note": note},
+    ]
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_LOCAL.json")
+    try:
+        bl = json.load(open(path))
+    except (OSError, ValueError):
+        bl = {"note": "", "rows": []}
+    bl.setdefault("rows", []).extend(rows)
+    json.dump(bl, open(path, "w"), indent=1)
+    return rows[0]
+
+
 def bench_longctx(iters=8):
     """Long-context attention lane (SURVEY §5: long-context is
     first-class here — ring/Ulysses SP + flash kernels — where the
@@ -1934,6 +2003,7 @@ def main():
                "serve_wide_deep": bench_serving_wide_deep,
                "serve_http_overload": bench_serve_http_overload,
                "serve_fleet": bench_serve_fleet,
+               "stream_ctr": bench_stream_ctr,
                "flash": bench_flash, "longctx": bench_longctx,
                "lm3d": bench_lm3d}
     if which not in benches:

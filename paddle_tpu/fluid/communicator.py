@@ -468,15 +468,38 @@ def reset_round_pipeline():
 
 
 class Communicator:
+    """Fully-async grad plane (``sync_mode=False``; reference
+    AsyncCommunicator::SendThread/RecvThread, communicator.h:237).
+
+    Staleness is UNBOUNDED by design: pushes enqueue onto per-var
+    merge queues that never gate on an AckWindow — the trainer's step
+    is never blocked by the wire, and the server applies whatever
+    arrives whenever it arrives (listen_and_serv distributed_mode=1
+    applies on arrival). The price is the async consistency model:
+    loss tracks the sync oracle's NEIGHBORHOOD, not its trajectory
+    (docs/FAULT_TOLERANCE.md "Streaming online learning").
+
+    Every background failure is typed and counted (``stats()``,
+    ``ps_communicator`` metrics view): transport outages requeue under
+    FLAGS_ps_failover_deadline, server rejections and deadline
+    exhaustions drop with distinct counters — nothing is silently
+    lost without a counter naming the reason."""
+
     _global: Optional["Communicator"] = None
 
     def __init__(self, program=None, mode=None, kwargs=None, envs=None):
         self._running = False
         self._program = program
+        self._mode = mode or "async"
         envs = envs or {}
         self._max_merge = int(envs.get("communicator_max_merge_var_num", 20))
         self._wait_times = float(
             envs.get("communicator_send_wait_times", 0.005))
+        # independent recv thread cadence (reference
+        # independent_recv_thread): how often the background puller
+        # refreshes the dense-param double buffer
+        self._recv_interval = float(
+            envs.get("communicator_independent_recv_interval", 0.05))
         # stop(): how long to wait per merge thread before logging a
         # warning and moving on (env wins, then the FLAG)
         jt = envs.get("communicator_send_join_timeout")
@@ -492,11 +515,59 @@ class Communicator:
         # there) and only drop once FLAGS_ps_failover_deadline passed —
         # the pre-elastic behavior silently lost the round's grads
         self._fail_since: Dict[Tuple[str, str], float] = {}
+        # stop() flushes queues in SUBMIT order: first-push sequence per
+        # (var, endpoint) key — deterministic, matches the order the
+        # trainer first produced each grad stream
+        self._first_seq: Dict[Tuple[str, str], int] = {}
+        self._push_seq = 0
+        # typed-and-counted background outcomes; read via stats() and
+        # the ps_communicator telemetry view registered on start()
+        self._stats_lock = threading.Lock()
+        self._stats = {
+            "pushes_total": 0,            # grads enqueued by send ops
+            "merged_sends_total": 0,      # flush RPCs issued
+            "vars_sent_total": 0,         # vars across those flushes
+            "dgc_sends_total": 0,         # vars shipped top-k on async path
+            "send_ok_total": 0,
+            "send_retry_total": 0,        # typed: transport/stale-view
+            "requeued_grads_total": 0,    # grads put back during outage
+            "dropped_rejected_total": 0,  # typed: server rejected content
+            "dropped_deadline_total": 0,  # typed: failover deadline passed
+            "recv_rounds_total": 0,       # background recv-thread pulls
+            "recv_errors_total": 0,       # typed: recv pull failed
+            "stop_flushes_total": 0,
+        }
+        # independent recv plane: registered pull set + double buffer
+        self._recv_lock = threading.Lock()
+        self._recv_set: Optional[list] = None   # [(name, ep)]
+        self._recv_tid = 0
+        self._recv_thread: Optional[threading.Thread] = None
+        self._recv_buf = (-1, None)   # (seq, {name: ndarray})
+        self._recv_installed = -1
+        self._recv_primed = False     # first recv op primed synchronously
+        self._view = None
+
+    def _bump(self, key: str, n: int = 1):
+        with self._stats_lock:
+            self._stats[key] += n
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            out = dict(self._stats)
+        with self._lock:
+            out["queued_now"] = sum(q.qsize()
+                                    for q in self._queues.values())
+        out["running"] = bool(self._running)
+        return out
 
     # ---------------------------------------------------------- lifecycle
     def start(self):
         self._running = True
         Communicator._global = self
+        if self._view is None:
+            from . import telemetry
+            self._view = telemetry.REGISTRY.register_view(
+                "ps_communicator", self.stats)
 
     def stop(self):
         # a stop racing an in-flight async-overlap window must drain
@@ -525,6 +596,9 @@ class Communicator:
         self._running = False
         if Communicator._global is self:
             Communicator._global = None
+        rt = self._recv_thread
+        if rt is not None and rt.is_alive():
+            rt.join(timeout=self._join_timeout)
         for t in self._threads:
             t.join(timeout=self._join_timeout)
             if t.is_alive():
@@ -536,21 +610,40 @@ class Communicator:
                     "after %.1fs join timeout — a send to its endpoint "
                     "is wedged; its queued grads may be dropped",
                     t.name, self._join_timeout)
-        # flush whatever is still queued — fully, not just one merge batch.
-        # Snapshot under the lock and bound the loop so a misbehaving
-        # producer still pushing during stop() can't spin this forever.
+        # flush whatever is still queued — fully, not just one merge
+        # batch, in SUBMIT order (first-push sequence per queue): the
+        # pserver sees the tail of the stream in the same order the
+        # trainer produced it, so a final-state comparison right after
+        # stop() is deterministic. Snapshot under the lock and bound
+        # the loop so a misbehaving producer still pushing during
+        # stop() can't spin this forever.
         with self._lock:
             snapshot = dict(self._queues)
-        for key, q in snapshot.items():
+            order = sorted(snapshot,
+                           key=lambda k: self._first_seq.get(k, 0))
+        for key in order:
+            q = snapshot[key]
             flushes = 0
             while not q.empty() and flushes < 1000:
                 self._drain(key)
                 flushes += 1
+                self._bump("stop_flushes_total")
         with self._lock:
             # drop queues so a later start()/push() spawns fresh merge
             # threads (the old ones exited when _running went False)
             self._queues.clear()
             self._threads.clear()
+            self._first_seq.clear()
+        with self._recv_lock:
+            self._recv_set = None
+            self._recv_thread = None
+            self._recv_buf = (-1, None)
+            self._recv_installed = -1
+            self._recv_primed = False
+        if self._view is not None:
+            from . import telemetry
+            telemetry.REGISTRY.unregister_view(self._view)
+            self._view = None
 
     def is_running(self):
         return self._running
@@ -575,6 +668,9 @@ class Communicator:
                     daemon=True)
                 t.start()
                 self._threads.append(t)
+            self._push_seq += 1
+            self._first_seq.setdefault(key, self._push_seq)
+        self._bump("pushes_total")
         q.put(np.asarray(value))
 
     def _send_merged(self, name, ep, merged, trainer_id) -> str:
@@ -607,11 +703,15 @@ class Communicator:
             else:
                 send_vars_batch(VarClient.of(ep), items,
                                 trainer_id=trainer_id)
+            self._bump("send_ok_total")
+            self._bump("merged_sends_total")
+            self._bump("vars_sent_total", len(items))
             return "ok"
         except (ConnectionError, OSError) as e:
             _LOG.warning(
                 "Communicator: merged grads %s for %s undeliverable — "
                 "endpoint unreachable after RPC retries (%r)", names, ep, e)
+            self._bump("send_retry_total")
             return "retry"
         except core.StaleClusterViewError as e:
             # the call's re-route budget ran out while membership was
@@ -623,12 +723,58 @@ class Communicator:
                 "Communicator: merged grads %s for %s caught a "
                 "stale-view convergence window (%r) — requeueing",
                 names, ep, e)
+            self._bump("send_retry_total")
             return "retry"
         except Exception as e:  # noqa: BLE001 — server-side rejection
             _LOG.warning(
                 "Communicator: dropping merged grads %s for %s — "
                 "server rejected them (%r)", names, ep, e)
+            self._bump("dropped_rejected_total", len(items))
             return "drop"
+
+    def _send_dgc(self, ep, name, merged, trainer_id):
+        """Ship one merged grad top-k compressed on the async path
+        (FLAGS_dgc; the same dgc_send frame the sync _push_dense_batch
+        lane uses). compress() folds the grad into the error-feedback
+        residual and zeroes the selection, so a transport failure must
+        RESTORE the mass before requeueing — restore_dense() hands the
+        full accumulator back and clears the residual, and the caller
+        requeues that dense payload (re-compressed at the next flush:
+        mass is conserved across the outage, momentum state resets —
+        acceptable under an outage, documented contract). Returns
+        (outcome, requeue_payload_or_None): "sent" | "pass" (not
+        eligible / old server — caller ships dense) | "retry" |
+        "drop"."""
+        from .ps_rpc import VarClient
+        g = np.asarray(merged)
+        cli = VarClient.of(ep)
+        if "dgc_send" in cli._missing_methods:
+            return "pass", None
+        comp = dgc_compressor()
+        enc = comp.compress(name, g)
+        if enc is None:
+            return "pass", None
+        idx, vals = enc
+        try:
+            cli.call("dgc_send", name=name, values=vals, indices=idx,
+                     shape=list(g.shape), trainer_id=trainer_id)
+            self._bump("dgc_sends_total")
+            return "sent", None
+        except (ConnectionError, OSError, core.StaleClusterViewError) as e:
+            full = comp.restore_dense(name, idx, vals)
+            _LOG.warning(
+                "Communicator: dgc push %s for %s undeliverable (%r) — "
+                "restored residual, requeueing dense", name, ep, e)
+            return "retry", full.reshape(g.shape)
+        except Exception as e:  # noqa: BLE001 — old server / rejection
+            if "no method dgc_send" in str(e):
+                cli._missing_methods.add("dgc_send")
+                full = comp.restore_dense(name, idx, vals)
+                return "pass", full.reshape(g.shape)
+            _LOG.warning(
+                "Communicator: dropping dgc push %s for %s — server "
+                "rejected it (%r)", name, ep, e)
+            return "drop", None
 
     def _drain(self, key, trainer_id=0):
         name, ep = key
@@ -688,8 +834,31 @@ class Communicator:
                     other = self._drain_nowait(k)
                     if other is not None:
                         batch.append((k[0], other))
-            outcome = self._send_batch(ep, batch, trainer_id)
-            if outcome == "retry" and self._running:
+            # FLAGS_dgc: eligible merged grads ship as top-k dgc_send
+            # frames right here on the async path (the sync lane does
+            # this in _push_dense_batch); the rest — plus any restored
+            # dense fallbacks — ride the coalesced batch send below
+            send_items, requeue_now = [], []
+            if dgc_enabled() and not _pickle_wire_forced():
+                for n, v in batch:
+                    oc, payload = self._send_dgc(ep, n, v, trainer_id)
+                    if oc == "sent":
+                        continue
+                    if oc == "pass":
+                        send_items.append(
+                            (n, v if payload is None else payload))
+                    elif oc == "retry":
+                        requeue_now.append((n, payload))
+                    # "drop": counted in _send_dgc's rejection path
+            else:
+                send_items = batch
+            outcome = "ok"
+            if send_items:
+                outcome = self._send_batch(ep, send_items, trainer_id)
+            to_requeue = list(requeue_now)
+            if outcome == "retry":
+                to_requeue.extend(send_items)
+            if to_requeue and self._running:
                 # endpoint outage (possibly a failover in progress):
                 # requeue every merged grad onto its own queue — the
                 # NEXT flush re-resolves the slot and reaches the
@@ -701,8 +870,9 @@ class Communicator:
                 first = self._fail_since.setdefault(key, now)
                 limit = float(core.globals_["FLAGS_ps_failover_deadline"])
                 if now - first <= limit:
-                    for n, v in batch:
+                    for n, v in to_requeue:
                         self.push(n, v, ep, trainer_id=trainer_id)
+                    self._bump("requeued_grads_total", len(to_requeue))
                     # breathe: don't hot-loop against a dead endpoint
                     threading.Event().wait(self._wait_times * 10)
                 else:
@@ -710,9 +880,10 @@ class Communicator:
                         "Communicator: giving up on %s after %.0fs of "
                         "transport failures — dropping %d merged "
                         "grad(s)", ep, now - first,
-                        len(batch))
+                        len(to_requeue))
+                    self._bump("dropped_deadline_total", len(to_requeue))
                     self._fail_since.pop(key, None)
-            else:
+            elif outcome != "retry":
                 # "ok" AND "drop" both end the outage streak ("drop" =
                 # the server was reachable and rejected): a stale
                 # first-failure stamp would make a later unrelated
@@ -720,8 +891,98 @@ class Communicator:
                 # requeueing through the failover window
                 self._fail_since.pop(key, None)
 
-    def recv(self):
-        pass
+    # --------------------------------------------------- independent recv
+    # reference AsyncCommunicator::RecvThread: in async mode the trainer
+    # never blocks a step on a param pull — a background thread refreshes
+    # a double buffer at _recv_interval and the recv op installs the
+    # newest completed buffer at the next step boundary (same protocol
+    # as RoundPipeline.take_fresh_pulls). Registration happens lazily
+    # from the first recv op execution, which knows the (param, ep) set.
+
+    def register_recv(self, pairs, trainer_id: int = 0):
+        """Register the async pull set [(param_name, endpoint)] and
+        start the recv thread (idempotent)."""
+        with self._recv_lock:
+            merged = dict(self._recv_set or [])
+            merged.update(dict(pairs))
+            self._recv_set = sorted(merged.items())
+            self._recv_tid = int(trainer_id)
+            if self._recv_thread is None or \
+                    not self._recv_thread.is_alive():
+                self._recv_thread = threading.Thread(
+                    target=self._recv_loop, name="communicator-recv",
+                    daemon=True)
+                self._recv_thread.start()
+
+    def take_fresh_recv(self):
+        """Newest completed background pull, handed out exactly once
+        (None when the trainer already installed it)."""
+        with self._recv_lock:
+            seq, buf = self._recv_buf
+            if buf is None or seq <= self._recv_installed:
+                return None
+            self._recv_installed = seq
+            return buf
+
+    def _pull_once(self, pairs, tid) -> dict:
+        """Fetch every registered param once; an unreachable endpoint
+        skips its params for THIS refresh only (the trainer keeps the
+        last installed values — bounded staleness, never a crash) and
+        is typed + counted."""
+        from .ps_rpc import VarClient
+        by_ep: Dict[str, list] = {}
+        for n, ep in pairs:
+            by_ep.setdefault(ep, []).append(n)
+        buf = {}
+        for ep, names in by_ep.items():
+            cli = VarClient.of(ep)
+            try:
+                if len(names) > 1 and \
+                        "get_vars_batch" not in cli._missing_methods:
+                    try:
+                        got = cli.call("get_vars_batch", names=names,
+                                       trainer_id=tid)
+                    except RuntimeError as e:
+                        if "no method get_vars_batch" not in str(e):
+                            raise
+                        cli._missing_methods.add("get_vars_batch")
+                        got = [cli.get_var(n, trainer_id=tid)
+                               for n in names]
+                else:
+                    got = [cli.get_var(n, trainer_id=tid) for n in names]
+                for n, v in zip(names, got):
+                    buf[n] = np.asarray(v)
+            except Exception as e:  # noqa: BLE001 — typed + counted
+                self._bump("recv_errors_total")
+                _LOG.warning(
+                    "Communicator: background recv from %s failed "
+                    "(%r) — keeping last installed params", ep, e)
+        return buf
+
+    def _recv_loop(self):
+        seq = 0
+        while self._running:
+            threading.Event().wait(self._recv_interval)
+            if not self._running:
+                return
+            with self._recv_lock:
+                pairs, tid = self._recv_set, self._recv_tid
+            if not pairs:
+                continue
+            buf = self._pull_once(pairs, tid)
+            if buf:
+                seq += 1
+                with self._recv_lock:
+                    self._recv_buf = (seq, buf)
+                self._bump("recv_rounds_total")
+
+    def recv(self) -> dict:
+        """One synchronous pull of the registered set (start-up priming
+        / tests); returns the buffer without touching the double-buffer
+        seq accounting."""
+        with self._recv_lock:
+            pairs, tid = self._recv_set, self._recv_tid
+        return self._pull_once(pairs or [], tid)
 
 
 class LargeScaleKV:

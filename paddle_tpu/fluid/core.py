@@ -1585,9 +1585,12 @@ class _GlobalFlags:
         # at most this many rows (one segment read serves a whole cold
         # batch — the I/O fan-in unit)
         "FLAGS_ps_slab_seg_rows": 4096,
-        # track per-row touch scores on tiered tables even without the
-        # entry gate, so the table_shrink admin RPC works (costs one
-        # dict update per touched row; gating implies it)
+        # track per-row touch scores even without the entry gate or the
+        # spill tier, so the table_shrink admin RPC works (costs one
+        # dict update per touched row; gating implies it). On an
+        # untiered, un-bounded table this is the ONLY cost of making it
+        # shrinkable. Ignored for max_rows-bounded tables (LRU owns
+        # their eviction).
         "FLAGS_ps_slab_track_scores": False,
         # trainer-driven shrink cron (reference PSLib save/shrink cron):
         # every N of trainer 0's sync rounds it fires ONE table_shrink

@@ -724,7 +724,15 @@ def load_checkpoint(executor, path, main_program=None, scope=None
     ``scope`` and the global rng fold counter — the next step after
     resume folds the same per-step keys an uninterrupted run would, so
     dropout streams (and hence losses) are bit-identical. Returns the
-    manifest (global_step, dataloader state, extra)."""
+    manifest (global_step, dataloader state, extra).
+
+    When ``main_program`` is given, every initialized dense/table
+    persistable of the program must appear in the manifest — a var the
+    checkpoint doesn't cover would silently keep its startup init after
+    "resume", so the mismatch raises ``CheckpointError`` BEFORE the
+    scope is touched. The classic way to hit this is rebuilding the net
+    without ``fluid.unique_name.guard()``: the rebuilt params are named
+    ``fc_1.*`` while the checkpoint holds ``fc_0.*``."""
     if scope is None:
         scope = global_scope()
     if os.path.exists(os.path.join(path, CKPT_MANIFEST)):
@@ -734,6 +742,25 @@ def load_checkpoint(executor, path, main_program=None, scope=None
         if ckpt_dir is None:
             raise core.CheckpointError(
                 f"no valid checkpoint found under {path}")
+    if main_program is not None:
+        have = set(manifest.get("files", {}))
+        missing = []
+        for v in main_program.list_vars():
+            if not _is_persistable(v) or v.name in have:
+                continue
+            sv = scope.find_var(v.name)
+            if sv is None or not sv.is_initialized():
+                continue  # save_checkpoint skips these too
+            if not isinstance(sv.value(), (LoDTensor,
+                                           core.LazyEmbeddingTable)):
+                continue  # non-dense persistables are never captured
+            missing.append(v.name)
+        if missing:
+            raise core.CheckpointError(
+                f"checkpoint {ckpt_dir} does not cover program "
+                f"persistables {sorted(missing)} — resuming would leave "
+                f"them at their startup init (was the net rebuilt "
+                f"without fluid.unique_name.guard()?)")
     for name in manifest.get("files", {}):
         fpath = os.path.join(ckpt_dir, name)
         if _is_slab_file(fpath):

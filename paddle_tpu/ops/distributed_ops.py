@@ -223,6 +223,7 @@ def _geo_async_reset():
     from collections import deque
     with _GEO_ASYNC_LOCK:
         _GEO_ASYNC["last_f"] = {}
+        _GEO_ASYNC["last_f_sparse"] = {}
         _GEO_ASYNC["shifts"] = deque()
         _GEO_ASYNC["push_step"] = 0
 
@@ -230,7 +231,9 @@ def _geo_async_reset():
 def _geo_install_shifts(scope):
     """Apply every completed round's queued remote increment, FIFO.
     Shifts translate the param AND its @GEO_OLD baseline by the same
-    amount, so the pending local delta (cur - old) is untouched."""
+    amount, so the pending local delta (cur - old) is untouched.
+    Sparse-table entries are row-keyed — ("rows", row_ids, shift_rows)
+    — and translate only the touched rows of both tensors."""
     q = _GEO_ASYNC["shifts"]
     if not q:
         return
@@ -240,10 +243,24 @@ def _geo_install_shifts(scope):
         except IndexError:
             break
         for name, shift in shift_map.items():
-            if not np.any(shift):
-                continue
             var = scope.find_var(name)
             if var is None or not var.is_initialized():
+                continue
+            if isinstance(shift, tuple):
+                rows, sh = shift[1], shift[2]
+                if not np.any(sh):
+                    continue
+                cur = np.asarray(var.value().array).copy()
+                cur[rows] += sh
+                var.set_value(core.LoDTensor(jnp.asarray(cur)))
+                old_var = scope.var(name + "@GEO_OLD")
+                if old_var.is_initialized():
+                    old = np.asarray(
+                        old_var.get_tensor().array).copy()
+                    old[rows] += sh
+                    old_var.set_value(core.LoDTensor(old))
+                continue
+            if not np.any(shift):
                 continue
             cur = np.asarray(var.value().array)
             var.set_value(core.LoDTensor(jnp.asarray(cur + shift)))
@@ -332,6 +349,74 @@ def _geo_dense_round_async(ctx, scope, names, epmap, tid, staleness):
                                       label="geo_round")
 
 
+def _geo_sparse_round_async(ctx, scope, sparse_names, epmap, n_dense,
+                            tid, staleness):
+    """Submit one sparse row-delta round to the geo RoundPipeline (the
+    PR 11 remainder: these used to sync inline at every push point,
+    stalling the local step on the WAN RTT even at staleness > 0).
+
+    Same contract as the dense lane, row-keyed: error feedback happens
+    HERE synchronously (@GEO_OLD's touched rows advance by exactly the
+    pushed delta), the background closure pushes the row deltas, pulls
+    the merged rows, and queues a per-row telescoped shift —
+    shift_j[r] = F_j[r] - (F_{j-1}[r] + sent_j[r]) — installed FIFO
+    onto the param AND the baseline at the next step boundary. A row's
+    first-ever pull uses its baseline value at push time as the
+    F_{j-1} estimate (the baseline tracks anchor + sent + installed
+    shifts = our best estimate of the server row), so a single-region
+    run's shifts are exactly zero and it tracks the inline path."""
+    pushes = []
+    for j, name in enumerate(sparse_names):
+        ep_idx = n_dense + j
+        ep = epmap[ep_idx if ep_idx < len(epmap) else -1]
+        var = scope.find_var(name)
+        if var is None or not var.is_initialized():
+            continue
+        cur = np.asarray(var.value().array)
+        old_var = scope.var(name + "@GEO_OLD")
+        if not old_var.is_initialized():
+            old_var.set_value(core.LoDTensor(cur.copy()))
+            continue
+        old = np.asarray(old_var.get_tensor().array)
+        delta = cur - old
+        touched = np.where(np.abs(delta).reshape(len(delta), -1)
+                           .max(axis=1) > 0)[0]
+        if not len(touched):
+            continue
+        payload = np.ascontiguousarray(delta[touched])
+        prev_est = old[touched].copy()
+        pushes.append((name, ep, touched, payload, prev_est))
+        # error feedback: baseline rows advance by the SENT delta only
+        old = old.copy()
+        old[touched] = cur[touched]
+        old_var.set_value(core.LoDTensor(old))
+    if not pushes:
+        return
+    from ..fluid import communicator as _comm
+
+    def do_geo_sparse_round():
+        shift_map = {}
+        for name, ep, touched, payload, prev_est in pushes:
+            cli = _client(ep)
+            cli.call("geo_delta", name=name, value=payload,
+                     rows=touched, trainer_id=tid)
+            fresh_rows = np.asarray(cli.prefetch_rows(name, touched))
+            lf = _GEO_ASYNC["last_f_sparse"].setdefault(name, {})
+            shift = np.zeros_like(fresh_rows)
+            for i, r in enumerate(touched):
+                r = int(r)
+                prev = lf.get(r)
+                if prev is None or prev.shape != fresh_rows[i].shape:
+                    prev = prev_est[i]
+                shift[i] = fresh_rows[i] - (prev + payload[i])
+                lf[r] = fresh_rows[i].copy()
+            shift_map[name] = ("rows", touched, shift)
+        _GEO_ASYNC["shifts"].append(shift_map)
+
+    _comm.geo_round_pipeline().submit(do_geo_sparse_round, staleness,
+                                      label="geo_sparse_round")
+
+
 @register_op("geo_sgd_send", stateful=True, no_grad=True,
              attr_defaults={"epmap": [], "push_nums": 100, "trainer_id": 0,
                             "trainers": 1})
@@ -347,9 +432,9 @@ def _geo_sgd_send(ins, attrs):
     the background while local steps continue, bounded at k rounds in
     flight, and FLAGS_dgc additionally top-k-sparsifies each delta with
     the residual kept in the @GEO_OLD baseline (old advances only by
-    what was SENT — exact error feedback). Sparse tables keep the
-    inline row-delta sync at push points (their merge is row-keyed, not
-    translatable by a dense shift). At staleness 0 the path below is
+    what was SENT — exact error feedback). Sparse tables ride the same
+    pipeline with row-keyed deltas and per-row telescoped shifts
+    (_geo_sparse_round_async, r20). At staleness 0 the path below is
     byte-for-byte the pre-compression inline code — bit-identical."""
     ctx = attrs["_ctx"]
     scope = ctx.scope
@@ -391,19 +476,26 @@ def _geo_sgd_send(ins, attrs):
 
     if staleness > 0:
         _geo_dense_round_async(ctx, scope, names, epmap, tid, staleness)
-    else:
-        for i, name in enumerate(names):
-            ep = epmap[i if i < len(epmap) else -1]
-            cur = np.asarray(scope.find_var(name).value().array)
-            old_var = scope.var(name + "@GEO_OLD")
-            old = np.asarray(old_var.get_tensor().array)
-            _client(ep).call("geo_delta", name=name,
-                             value=np.ascontiguousarray(cur - old),
-                             trainer_id=tid)
-            fresh = np.asarray(_client(ep).get_var(name, trainer_id=tid))
-            scope.find_var(name).set_value(
-                core.LoDTensor(jnp.asarray(fresh)))
-            old_var.set_value(core.LoDTensor(fresh.copy()))
+        # sparse tables ride the SAME pipeline now (r20; formerly they
+        # synced inline even at staleness > 0 — the PR 11 remainder):
+        # row-keyed deltas push/pull in the background and install as
+        # per-row shifts at the next step boundary
+        _geo_sparse_round_async(
+            ctx, scope, list(ctx.op.input("SparseParams") or []),
+            epmap, len(names), tid, staleness)
+        return {}
+    for i, name in enumerate(names):
+        ep = epmap[i if i < len(epmap) else -1]
+        cur = np.asarray(scope.find_var(name).value().array)
+        old_var = scope.var(name + "@GEO_OLD")
+        old = np.asarray(old_var.get_tensor().array)
+        _client(ep).call("geo_delta", name=name,
+                         value=np.ascontiguousarray(cur - old),
+                         trainer_id=tid)
+        fresh = np.asarray(_client(ep).get_var(name, trainer_id=tid))
+        scope.find_var(name).set_value(
+            core.LoDTensor(jnp.asarray(fresh)))
+        old_var.set_value(core.LoDTensor(fresh.copy()))
 
     # sparse tables: push only the TOUCHED row deltas, pull those rows'
     # merged values back (reference GeoSgdCommunicator
@@ -442,6 +534,32 @@ def _recv(ins, attrs):
     names = ctx.op.output("Out")
     epmap = attrs.get("epmap") or []
     tid = int(attrs.get("trainer_id", 0))
+    from ..fluid.communicator import Communicator
+    comm = Communicator.global_instance()
+    if comm is not None:
+        # fully-async mode (reference AsyncCommunicator::RecvThread):
+        # never block the step on a pull — register the set once, let
+        # the communicator's background thread refresh a double buffer
+        # at its recv interval, and install only the newest completed
+        # buffer here at the step boundary. The FIRST call primes the
+        # buffer synchronously so params exist before step 1 computes.
+        pairs = [(n, epmap[i if i < len(epmap) else -1])
+                 for i, n in enumerate(names)]
+        comm.register_recv(pairs, trainer_id=tid)
+        buf = comm.take_fresh_recv()
+        if buf is None and not getattr(comm, "_recv_primed", False):
+            buf = comm.recv()
+            comm._recv_primed = True
+        if buf:
+            for name, arr in buf.items():
+                if name in names:
+                    ctx.scope.var(name).set_value(
+                        core.LoDTensor(jnp.asarray(arr)))
+        # async mode has no fetch_barrier, so the save/shrink cron
+        # (FLAGS_ps_shrink_every_steps) ticks here — the recv op is
+        # the one per-step boundary the async trainer still crosses
+        _shrink_cron_tick(list(dict.fromkeys(epmap)), tid)
+        return {}
     by_ep: dict = {}
     for i, name in enumerate(names):
         ep = epmap[i if i < len(epmap) else -1]
@@ -825,7 +943,12 @@ def _distributed_lookup_table_grad(ins, attrs):
         # known-dirty row (docs/PS_DATA_PLANE.md "Async overlap")
         cache = _ps_rpc.current_row_cache()
         if cache is not None and hasattr(cache, "invalidate_rows"):
-            cache.invalidate_rows(w_name, ids)
+            try:
+                # same-process train+serve: the push instant IS the
+                # event time for the freshness histogram
+                cache.invalidate_rows(w_name, ids, t_event=time.time())
+            except TypeError:
+                cache.invalidate_rows(w_name, ids)
         # cross-process half (docs/SERVING.md "Fleet"): fan the same
         # pushed-row invalidation to every REMOTE serving cache via the
         # fleet publisher — enqueue-only here (subscribers long-poll),
@@ -921,6 +1044,15 @@ def _lazy_table_init(ins, attrs):
     thr = int(core.globals_["FLAGS_ps_entry_threshold"])
     if thr > 1:
         tier_kw["entry_threshold"] = thr
+    # score tracking without the spill tier: FLAGS_ps_slab_track_scores
+    # alone makes the table shrinkable (the cron's table_shrink needs
+    # per-row touch scores; an online-learning pserver wants idle rows
+    # decaying out whether or not it also spills). max_rows-bounded
+    # tables keep their LRU semantics — the tier would reject the combo.
+    if core.globals_["FLAGS_ps_slab_track_scores"] \
+            and "track_scores" not in tier_kw \
+            and not int(attrs.get("max_rows") or 0):
+        tier_kw["track_scores"] = True
     tbl = core.LazyEmbeddingTable(
         height=int(attrs["height"]), dim=int(attrs["dim"]),
         seed=int(attrs.get("seed", 0)),

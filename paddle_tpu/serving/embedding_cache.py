@@ -81,6 +81,19 @@ def _m_staleness_window():
         buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                  0.1, 0.25, 0.5, 1.0, 2.5, 5.0))
 
+
+def _m_event_freshness():
+    # the streaming-lane acceptance number (docs/FAULT_TOLERANCE.md
+    # "Streaming online learning"): event observed by the trainer →
+    # FIRST served prediction that reads the refreshed row. Longer
+    # buckets than the staleness window — it additionally spans the
+    # wait until traffic next touches the key.
+    return telemetry.REGISTRY.histogram(
+        "serving_event_freshness_seconds",
+        "trainer-observed event -> first served prediction reflecting it",
+        buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                 0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
+
 # fetch failures the serve-stale path may absorb: the transport family
 # (breaker fast-fail CircuitOpenError ⊂ ConnectionError, deadline ⊂
 # TimeoutError ⊂ OSError), the PR 3 typed worker-death, and a
@@ -123,6 +136,13 @@ class EmbeddingCache:
         # the key, one that started after it clears the fence
         self._seq = 0
         self._fence: Dict[tuple, int] = {}
+        # event-freshness pending stamps (invalidate_rows t_event=):
+        # key -> wall-clock time the trainer observed the event; popped
+        # and observed into serving_event_freshness_seconds by the first
+        # post-fence fill that serves the refreshed row. EARLIEST stamp
+        # wins when pushes coalesce before a refetch — the conservative
+        # (upper-bound) freshness sample.
+        self._pending_fresh: Dict[tuple, float] = {}
         # injectable clock so tests drive TTL expiry without sleeping
         self._clock = time.monotonic
         self.hits = 0
@@ -132,6 +152,7 @@ class EmbeddingCache:
         self.stale_served = 0      # degraded: beyond-TTL rows served
         self.invalidated_rows = 0  # trainer-pushed row invalidations
         self.fence_overflows = 0   # fence maps collapsed to generation
+        self.freshness_samples = 0  # event→served samples observed
 
     def __len__(self) -> int:
         with self._lock:
@@ -193,8 +214,10 @@ class EmbeddingCache:
                     f"fetch_fn returned {fetched.shape[0]} rows for "
                     f"{len(uniq)} ids")
             now = self._clock()
+            fresh_lags = []
             with self._lock:
                 if self._gen == gen0:  # no invalidate() raced the fetch
+                    wall = time.time()
                     for j, id_ in enumerate(uniq.tolist()):
                         key = (table, id_)
                         fence = self._fence.get(key)
@@ -206,21 +229,39 @@ class EmbeddingCache:
                                 # but never cache it
                                 continue
                             del self._fence[key]  # post-push fetch
+                            # this fill serves the refreshed row: the
+                            # pending event is now REFLECTED in a
+                            # served prediction
+                            stamp = self._pending_fresh.pop(key, None)
+                            if stamp is not None:
+                                fresh_lags.append(max(0.0, wall - stamp))
+                                self.freshness_samples += 1
                         # detach: the caller may mutate/donate arrays
                         self._rows[key] = (np.array(fetched[j]), now)
                     while len(self._rows) > self.max_entries:
                         self._rows.popitem(last=False)
                         self.evictions += 1
+            if fresh_lags:
+                hist = _m_event_freshness()
+                for lag in fresh_lags:
+                    hist.observe(lag)
             for k, i in enumerate(missing_idx):
                 out[i] = fetched[inv[k]]
         return np.asarray(out)
 
-    def invalidate_rows(self, table: str, ids) -> None:
+    def invalidate_rows(self, table: str, ids, t_event=None) -> None:
         """The trainer pushed grads for ``ids`` (called inline by
         ``distributed_lookup_table_grad`` BEFORE the push ships — the
         PR 8 row-cache hook contract): drop their cached rows and fence
         them out of any in-flight miss fetch, so the next lookup
-        refetches post-push values. Staleness becomes push-bounded."""
+        refetches post-push values. Staleness becomes push-bounded.
+
+        ``t_event`` (wall-clock seconds): when the trainer OBSERVED the
+        event behind this push (the publisher's t_pub on the fleet
+        wire, time.time() on the inline path). Stamps the keys for the
+        event→served freshness histogram; the first post-fence fill
+        that serves a refreshed row observes ``now - t_event`` into
+        ``serving_event_freshness_seconds``."""
         ids = np.asarray(ids).reshape(-1)
         dropped = 0
         overflowed = False
@@ -229,6 +270,8 @@ class EmbeddingCache:
             for id_ in ids.tolist():
                 key = (table, int(id_))
                 self._fence[key] = self._seq
+                if t_event is not None:
+                    self._pending_fresh.setdefault(key, float(t_event))
                 if self._rows.pop(key, None) is not None:
                     self.invalidated_rows += 1
                     dropped += 1
@@ -240,6 +283,9 @@ class EmbeddingCache:
                 self._gen += 1
                 self.fence_overflows += 1
                 overflowed = True
+            if len(self._pending_fresh) > self._FENCE_CAP:
+                # same bound: drop the stamps, not the correctness
+                self._pending_fresh.clear()
         if dropped:
             _m_rows_invalidated().inc(dropped)
         if overflowed:
@@ -263,11 +309,15 @@ class EmbeddingCache:
             if table is None:
                 self._rows.clear()
                 self._fence.clear()
+                self._pending_fresh.clear()
                 return
             for key in [k for k in self._rows if k[0] == table]:
                 del self._rows[key]
             for key in [k for k in self._fence if k[0] == table]:
                 del self._fence[key]
+            for key in [k for k in self._pending_fresh
+                        if k[0] == table]:
+                del self._pending_fresh[key]
 
     def stats(self) -> Dict[str, float]:
         with self._lock:
@@ -283,5 +333,7 @@ class EmbeddingCache:
                 "stale_served": self.stale_served,
                 "invalidated_rows": self.invalidated_rows,
                 "fence_overflows": self.fence_overflows,
+                "freshness_samples": self.freshness_samples,
+                "freshness_pending": len(self._pending_fresh),
                 "hit_rate": (self.hits / total) if total else 0.0,
             }

@@ -300,8 +300,16 @@ class InvalidationSubscriber:
             return
         events = resp.get("events") or []
         for ev in events:
-            self._cache.invalidate_rows(
-                ev["table"], np.asarray(ev["ids"], dtype=np.int64))
+            try:
+                # t_event stamps the keys for the event→served
+                # freshness histogram (EmbeddingCache; a non-cache
+                # sink without the kwarg still gets the invalidation)
+                self._cache.invalidate_rows(
+                    ev["table"], np.asarray(ev["ids"], dtype=np.int64),
+                    t_event=float(ev.get("t_pub", now)))
+            except TypeError:
+                self._cache.invalidate_rows(
+                    ev["table"], np.asarray(ev["ids"], dtype=np.int64))
             lag = now - float(ev.get("t_pub", now))
             note = getattr(self._cache, "note_staleness", None)
             if note is not None:

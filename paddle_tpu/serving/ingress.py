@@ -35,6 +35,14 @@ the rest — queue-expiry 504s, CoDel drops, PS fetch budgets):
     ``Connection: close``), lets every accepted request finish
     (engine queues drain to completion), then tears the engines and
     the listener down: a rolling restart loses ZERO accepted requests.
+  * **bearer auth** — with ``auth_token`` set, ``/predict`` and
+    ``/stats`` require a matching ``X-Auth-Token`` header
+    (constant-time compare); a miss is a typed, counted 401.
+    ``/healthz``, ``/readyz`` and ``/metrics`` stay open — probes and
+    scrapers don't carry secrets. The token rides plaintext HTTP, so
+    it only authenticates inside a trusted network segment; TLS
+    termination (stdlib ``ssl.wrap`` of the listener or a fronting
+    proxy) is documented future work, not a claim this layer makes.
 
 Quick start::
 
@@ -45,9 +53,11 @@ Quick start::
 """
 from __future__ import annotations
 
+import hmac
 import json
 import logging
 import math
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -91,7 +101,8 @@ class ServingIngress:
                  rate_burst: Optional[float] = None,
                  close_engines: bool = True,
                  drain_timeout_s: float = 30.0,
-                 max_body_bytes: int = 16 << 20):
+                 max_body_bytes: int = 16 << 20,
+                 auth_token: Optional[str] = None):
         if not isinstance(models, dict):
             models = {"default": models}
         if not models:
@@ -111,6 +122,11 @@ class ServingIngress:
         self._close_engines = bool(close_engines)
         self._drain_timeout_s = float(drain_timeout_s)
         self._max_body_bytes = int(max_body_bytes)
+        if auth_token is None:
+            auth_token = os.environ.get(
+                "FLAGS_serving_auth_token") or None
+        self._auth_token = (auth_token.encode("utf-8")
+                            if auth_token else None)
 
         self._admitting = True
         self._closed = False
@@ -121,7 +137,7 @@ class ServingIngress:
             "requests": 0, "ok": 0, "shed_429": 0, "expired_504": 0,
             "unavailable_503": 0, "bad_request_400": 0,
             "not_found_404": 0, "upstream_5xx": 0, "rate_limited": 0,
-            "degraded_responses": 0,
+            "degraded_responses": 0, "unauthorized_401": 0,
         }
         self._srv = ThreadingHTTPServer((host, int(port)),
                                         self._make_handler())
@@ -281,6 +297,26 @@ class ServingIngress:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _check_auth(self) -> bool:
+                """True when the request may proceed. With a token
+                configured, compares X-Auth-Token in constant time
+                (hmac.compare_digest — a plain == would leak the match
+                prefix length through timing) and answers a typed,
+                counted 401 on a miss."""
+                tok = outer._auth_token
+                if tok is None:
+                    return True
+                got = (self.headers.get("X-Auth-Token") or "") \
+                    .encode("utf-8")
+                if hmac.compare_digest(got, tok):
+                    return True
+                outer._bump("unauthorized_401")
+                self._reply(
+                    401, {"error": "unauthorized",
+                          "detail": "missing or invalid X-Auth-Token"},
+                    headers={"WWW-Authenticate": "X-Auth-Token"})
+                return False
+
             def _reply_unavailable(self) -> None:
                 outer._bump("unavailable_503")
                 self._reply(
@@ -308,6 +344,8 @@ class ServingIngress:
                                     close_conn=True)
                     return
                 if self.path == "/stats":
+                    if not self._check_auth():
+                        return
                     self._reply(200, outer.stats())
                     return
                 if self.path == "/metrics":
@@ -379,6 +417,10 @@ class ServingIngress:
                     self._reply(400, {"error": "bad_request",
                                       "detail": "unreadable body"},
                                 close_conn=True)
+                    return
+                # auth after the body read (keep-alive stays in sync)
+                # but before anything that costs queue slots or tokens
+                if not self._check_auth():
                     return
                 if not outer._admitting:
                     self._reply_unavailable()

@@ -43,6 +43,19 @@ def rewrite_sparse_lookups(program, endpoints: Sequence[str],
     eps = [str(e) for e in endpoints if e]
     if not eps:
         raise ValueError("rewrite_sparse_lookups: empty endpoint list")
+    # Seed the epoch-0 ClusterView exactly like the training transpiler
+    # does (distribute_transpiler.py): a serving-only process never
+    # transpiles, and without a bootstrap view ps_membership.resolve is
+    # a pass-through and refresh_view_for can't probe replicas — so a
+    # pserver failover would leave serving dialing the dead physical
+    # endpoint until its deadline instead of re-routing to the promoted
+    # replica. Same slot-set rule: a DIFFERENT slot set is a new
+    # cluster, so drop any stale high-epoch view first.
+    from ..fluid import ps_membership
+    cur = ps_membership.current_view()
+    if cur is not None and set(cur.slots) != set(eps):
+        ps_membership.reset_views()
+    ps_membership.install_view(ps_membership.ClusterView.initial(eps))
     want = set(tables) if tables is not None else None
     prog = program.clone()
     block = prog.global_block()

@@ -47,6 +47,14 @@ def pytest_configure(config):
         "also carry 'slow'. Subprocesses run JAX_PLATFORMS=cpu, so "
         "PADDLE_TPU_TEST_SHARD file-level sharding applies unchanged.")
     config.addinivalue_line(
+        "markers", "streaming: streaming online-learning suite "
+        "(fully-async Communicator plane + resumable StreamLoader + "
+        "train-and-serve composition; tests/test_streaming.py, "
+        "tools/chaos_ps.py --scenario streaming). In-process units — "
+        "stream-offset resume bit-parity, typed async-failure "
+        "counters, freshness histogram, ingress auth — stay tier-1; "
+        "the multiprocess chaos twin also carries 'slow'.")
+    config.addinivalue_line(
         "markers", "serving: online-serving plane suite "
         "(paddle_tpu/serving/ — continuous batcher, predictor pool, "
         "serving-time embedding fetch; tests/test_serving.py). "

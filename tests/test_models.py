@@ -9,11 +9,16 @@ from paddle_tpu.fluid import core
 from paddle_tpu.models import resnet, bert
 
 
-# r19 fleet-PR buyback: the STANDING KNOWN-FAIL (lr tuning — see
-# ROADMAP) burned ~23s of the per-commit window to fail
-# deterministically every run; it keeps failing in the full tier
-# where the known-fail is tracked. NOT a fix — the lr root cause
-# is untouched and still documented.
+# Root-caused r20 (was the STANDING KNOWN-FAIL since PR 15): at
+# lr=0.05 / momentum=0.9 on one repeated 4-sample batch the first
+# ~6 steps are a ringing transient (loss overshoots to 11.9-15.6 at
+# step 3) that exponentially amplifies ULP-level reduction-order
+# differences — under the suite's --xla_force_host_platform_device_count=8
+# the step-5 loss lands at 3.55 (> initial 2.66) where the 1-device
+# run lands at 1.97 (<). Both converge to ~0 by step 7. The old
+# 5-step losses[-1] < losses[0] assertion sat inside the transient;
+# assert past it instead (PR 13 Adagrad-ringing precedent). Stays
+# `slow` as a ~20s heavyweight per the docs/ci.md convention.
 @pytest.mark.slow
 def test_resnet18_tiny_trains():
     np.random.seed(0)
@@ -27,11 +32,11 @@ def test_resnet18_tiny_trains():
     with fluid.scope_guard(scope):
         exe.run(startup)
         losses = []
-        for _ in range(5):
+        for _ in range(12):
             lv, _ = exe.run(main, feed=feed, fetch_list=fetches)
             losses.append(float(lv[0]))
-    assert np.isfinite(losses[-1])
-    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+    assert min(losses[6:]) < 0.5 * losses[0]
 
 
 def test_resnet50_builds():

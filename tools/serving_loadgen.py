@@ -206,7 +206,8 @@ class HttpClient:
         raise last
 
     def predict(self, feed: dict, model: Optional[str] = None,
-                deadline_ms: Optional[float] = None, many: bool = False):
+                deadline_ms: Optional[float] = None, many: bool = False,
+                extra_headers: Optional[dict] = None):
         path = ("/predict" if model is None
                 else f"/models/{model}/predict")
         body = json.dumps({
@@ -215,6 +216,8 @@ class HttpClient:
         headers = {"Content-Type": "application/json"}
         if deadline_ms is not None:
             headers["X-Deadline-Ms"] = str(float(deadline_ms))
+        if extra_headers:
+            headers.update(extra_headers)
         status, _r, obj = self._request("POST", path, body, headers)
         return status, obj
 
